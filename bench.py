@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Headline benchmark: simulated SWIM gossip rounds/sec at 1M virtual nodes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+BASELINE.md target: >= 10,000 simulated gossip rounds/s at 1M nodes
+(TPU v5e-8; here measured on however many chips are visible). vs_baseline
+is measured rounds/s divided by the 10k target.
+
+The workload is the "1m-lan" BASELINE config: 1M virtual members,
+DefaultLANConfig SWIM timing, Lifeguard on, 1% packet loss — the full
+failure-detector pipeline per round (probe/ack/indirect, suspicion
+scatter, Lifeguard timers, refutation race, epidemic dissemination).
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from consul_tpu.sim import (SimParams, init_state, make_run_rounds,
+                                make_mesh, make_sharded_run)
+    from consul_tpu.sim.mesh import init_sharded_state
+    from consul_tpu.config import GossipConfig
+
+    n = 1_000_000
+    # Timed config: protocol only (stats counters are experiment
+    # instrumentation the reference's memberlist doesn't carry either).
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=n, loss=0.01,
+                                     collect_stats=False)
+    p_diag = p.with_(collect_stats=True, tcp_fallback=False,
+                     slow_per_round=0.001)
+    chunk = 500          # rounds per device-side scan call
+    iters = 6            # timed calls
+
+    devices = jax.devices()
+    key = jax.random.key(0)
+
+    if len(devices) > 1:
+        mesh = make_mesh(devices)
+        run = make_sharded_run(p, chunk, mesh)
+        diag = make_sharded_run(p_diag, 200, mesh)
+        state = init_sharded_state(n, mesh)
+    else:
+        run = make_run_rounds(p, chunk)
+        diag = make_run_rounds(p_diag, 200)
+        state = init_state(n)
+
+    # compile + warmup
+    state = run(state, key)
+    state = run(state, jax.random.fold_in(key, 1))
+    jax.block_until_ready(state)
+
+    # best-of-3 trials (the shared-chip tunnel adds scheduling noise)
+    best_dt, rounds = float("inf"), chunk * iters
+    for trial in range(3):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            state = run(state, jax.random.fold_in(key, 10 * trial + i))
+        jax.block_until_ready(state)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+    dt = best_dt
+    rps = rounds / dt
+    print(json.dumps({
+        "metric": "gossip_rounds_per_sec_1M_nodes",
+        "value": round(rps, 1),
+        "unit": "rounds/s",
+        "vs_baseline": round(rps / 10_000.0, 3),
+    }))
+    # detector-quality diagnostics from an instrumented run (stderr;
+    # driver parses stdout only)
+    dstate = diag(state, jax.random.fold_in(key, 999))
+    st = jax.device_get(dstate.stats)
+    print(f"devices={len(devices)} rounds={rounds} wall={dt:.2f}s "
+          f"ms_per_round={dt/rounds*1000:.3f} | diag(200r,1%loss,slow): "
+          f"fp={int(st.false_positives)} susp={int(st.suspicions)} "
+          f"refutes={int(st.refutes)}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""consul-tpu — a TPU-native service-networking framework.
+
+A ground-up re-design of HashiCorp Consul's capability set (membership via
+SWIM gossip, Raft consensus, catalog/KV/health, agent plane, API/CLI) built
+TPU-first:
+
+* the SWIM gossip hot path (probe→ack→indirect-probe, Lifeguard suspicion,
+  piggybacked broadcast dissemination) is expressed as a batched JAX/XLA
+  message-passing simulation that runs millions of virtual agents on TPU
+  (``consul_tpu.sim``);
+* a host-side, event-driven gossip engine with the same semantics drives
+  real clusters (``consul_tpu.gossip``), behind a pluggable Transport seam
+  mirroring the reference's memberlist ``Transport`` interface
+  (reference: agent/consul/server_serf.go:188-212);
+* Raft consensus, an MVCC watchable state store, the RPC fabric, the agent
+  plane, and the HTTP/DNS/CLI surfaces are idiomatic-Python host components
+  (the reference is pure Go — there is no native tier to port; see
+  SURVEY.md §2.9 — our "native" tier is the XLA/Pallas kernel layer).
+
+Layer map (mirrors SURVEY.md §1):
+
+  L0 gossip/membership : consul_tpu.gossip (host) / consul_tpu.sim (TPU)
+  L1 consensus+state   : consul_tpu.raft, consul_tpu.state
+  L2 server core (RPC) : consul_tpu.server
+  L3 agent             : consul_tpu.agent
+  L4 CLI               : consul_tpu.cli
+  L5 client library    : consul_tpu.api
+  cross-cutting        : consul_tpu.acl, consul_tpu.utils, consul_tpu.types
+"""
+
+from consul_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,342 @@
+"""Layered runtime configuration.
+
+The reference builds an immutable RuntimeConfig from files + flags + defaults
+(agent/config/builder.go, 2880 LoC) and derives gossip tuning from
+memberlist's DefaultLANConfig/DefaultWANConfig (agent/consul/config.go:622-698,
+the canonical list of every memberlist field Consul touches).
+
+We keep the same shape: ``GossipConfig`` carries every SWIM knob both the
+host engine and the TPU simulation consume (one config drives both backends —
+that is the conformance seam), and ``RuntimeConfig`` is the merged, immutable
+agent configuration produced by ``load()`` from defaults → files → overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class GossipConfig:
+    """Every SWIM/gossip knob, in seconds (not time.Duration).
+
+    Defaults mirror memberlist DefaultLANConfig as consumed by the reference
+    (agent/consul/config.go:622 with ReconnectTimeout=72h overlay and the
+    gossip_lan/gossip_wan user tuning surface, agent/config/runtime.go:1264-1351).
+    """
+
+    # Failure detection
+    probe_interval: float = 1.0       # one SWIM protocol period
+    probe_timeout: float = 0.5        # direct-probe ack deadline
+    indirect_checks: int = 3          # k peers asked for indirect probe
+    disable_tcp_pings: bool = False   # TCP fallback probe on UDP timeout
+
+    # Suspicion (Lifeguard)
+    suspicion_mult: int = 4           # min timeout = mult*log10(n)*probe_interval
+    suspicion_max_timeout_mult: int = 6
+    awareness_max_multiplier: int = 8  # Local Health Awareness score ceiling
+
+    # Dissemination
+    gossip_interval: float = 0.2      # piggyback broadcast tick
+    gossip_nodes: int = 3             # fanout per gossip tick
+    retransmit_mult: int = 4          # per-rumor transmit budget = mult*ceil(log10(n+1))
+    gossip_to_the_dead_time: float = 30.0
+
+    # Full-state sync
+    push_pull_interval: float = 30.0
+
+    # serf overlay (reference: internal/gossip/libserf/serf.go:19-36)
+    leave_propagate_delay: float = 3.0   # sized for 99.99% @ 100k nodes
+    min_queue_depth: int = 4096
+    queue_depth_warning: int = 1_000_000
+    reconnect_timeout: float = 72 * 3600.0
+    tombstone_timeout: float = 24 * 3600.0
+    reap_interval: float = 15.0
+    dead_node_reclaim_time: float = 30.0  # agent/consul/config.go:634
+
+    @staticmethod
+    def lan() -> "GossipConfig":
+        return GossipConfig()
+
+    @staticmethod
+    def wan() -> "GossipConfig":
+        """memberlist DefaultWANConfig deltas (agent/consul/config.go:627)."""
+        return GossipConfig(
+            probe_interval=5.0, probe_timeout=3.0,
+            suspicion_mult=6, gossip_interval=0.5, gossip_nodes=4,
+            push_pull_interval=60.0,
+        )
+
+    @staticmethod
+    def local() -> "GossipConfig":
+        """memberlist DefaultLocalConfig-style fast timing for tests."""
+        return GossipConfig(
+            probe_interval=0.2, probe_timeout=0.1, gossip_interval=0.05,
+            push_pull_interval=5.0, leave_propagate_delay=0.2,
+            reap_interval=0.5,
+        )
+
+    # --- derived quantities shared by host engine and TPU sim -------------
+
+    def suspicion_min_timeout(self, n: int, local_health: int = 0) -> float:
+        """Lifeguard min suspicion timeout, scaled by local health score."""
+        node_scale = max(1.0, math.log10(max(1.0, float(n))))
+        return self.suspicion_mult * node_scale * self.probe_interval * (local_health + 1)
+
+    def suspicion_max_timeout(self, n: int, local_health: int = 0) -> float:
+        return self.suspicion_max_timeout_mult * self.suspicion_min_timeout(n, local_health)
+
+    def retransmit_limit(self, n: int) -> int:
+        return self.retransmit_mult * int(math.ceil(math.log10(float(n) + 1.0)))
+
+    def scaled_probe_timeout(self, local_health: int) -> float:
+        return self.probe_timeout * (local_health + 1)
+
+
+@dataclass(frozen=True)
+class TelemetryConfig:
+    disable_hostname: bool = True
+    prefix: str = "consul"
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Immutable merged agent configuration (reference: agent/config/runtime.go)."""
+
+    node_name: str = ""
+    node_id: str = ""
+    datacenter: str = "dc1"
+    primary_datacenter: str = ""
+    data_dir: str = ""
+    server_mode: bool = False
+    bootstrap: bool = False
+    bootstrap_expect: int = 0
+    dev_mode: bool = False
+
+    bind_addr: str = "127.0.0.1"
+    advertise_addr: str = ""
+    ports: dict[str, int] = field(default_factory=lambda: {
+        # reference defaults: agent/config/default.go (dns 8600, http 8500,
+        # serf_lan 8301, serf_wan 8302, server 8300, grpc 8502)
+        "dns": 8600, "http": 8500, "serf_lan": 8301, "serf_wan": 8302,
+        "server": 8300, "grpc": 8502,
+    })
+
+    retry_join_lan: tuple[str, ...] = ()
+    retry_join_wan: tuple[str, ...] = ()
+    retry_join_interval: float = 30.0
+    rejoin_after_leave: bool = False
+
+    gossip_lan: GossipConfig = field(default_factory=GossipConfig.lan)
+    gossip_wan: GossipConfig = field(default_factory=GossipConfig.wan)
+    encrypt_key: str = ""  # base64 16/24/32-byte gossip key
+
+    # Raft (reference: agent/consul/config.go:639-648)
+    raft_heartbeat_timeout: float = 1.0
+    raft_election_timeout: float = 1.0
+    raft_snapshot_interval: float = 30.0
+    raft_snapshot_threshold: int = 16384
+    raft_trailing_logs: int = 10240
+
+    # Leader/reconcile loop (reference: agent/consul/config.go:538-539,572-574)
+    reconcile_interval: float = 60.0
+    serf_flood_interval: float = 60.0
+    coordinate_update_period: float = 5.0
+    coordinate_update_batch_size: int = 128
+    coordinate_update_max_batches: int = 5
+
+    # Blocking queries (reference: agent/consul/config.go:609-610)
+    default_query_time: float = 300.0
+    max_query_time: float = 600.0
+
+    # Anti-entropy (reference: agent/ae/ae.go:57)
+    sync_coalesce_timeout: float = 0.2
+
+    # Check output truncation (reference: agent/consul/config.go:576)
+    check_output_max_size: int = 4096
+
+    # ACL
+    acl_enabled: bool = False
+    acl_default_policy: str = "allow"
+    acl_down_policy: str = "extend-cache"
+    acl_initial_management_token: str = ""
+    acl_token_ttl: float = 30.0
+
+    # DNS
+    dns_domain: str = "consul."
+    dns_recursors: tuple[str, ...] = ()
+    dns_allow_stale: bool = True
+    dns_max_stale: float = 87600 * 3600.0
+    dns_node_ttl: float = 0.0
+    dns_service_ttl: dict[str, float] = field(default_factory=dict)
+    dns_enable_truncate: bool = False
+    dns_only_passing: bool = False
+
+    # Simulation backend (`agent -dev -gossip-sim=tpu`, BASELINE north star)
+    gossip_sim: str = ""          # "" (off) | "tpu" | "cpu"
+    gossip_sim_nodes: int = 1000
+
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    log_level: str = "INFO"
+
+    @property
+    def advertise(self) -> str:
+        return self.advertise_addr or self.bind_addr
+
+    def port(self, name: str) -> int:
+        return self.ports[name]
+
+
+_CONFIG_ALIASES = {
+    # HCL/JSON file keys → RuntimeConfig fields (subset of the reference's
+    # agent/config translation table).
+    "node_name": "node_name",
+    "node_id": "node_id",
+    "datacenter": "datacenter",
+    "primary_datacenter": "primary_datacenter",
+    "data_dir": "data_dir",
+    "server": "server_mode",
+    "bootstrap": "bootstrap",
+    "bootstrap_expect": "bootstrap_expect",
+    "bind_addr": "bind_addr",
+    "advertise_addr": "advertise_addr",
+    "encrypt": "encrypt_key",
+    "retry_join": "retry_join_lan",
+    "retry_join_wan": "retry_join_wan",
+    "rejoin_after_leave": "rejoin_after_leave",
+    "log_level": "log_level",
+    "acl_default_policy": "acl_default_policy",
+    "domain": "dns_domain",
+}
+
+class ConfigError(Exception):
+    pass
+
+
+def _merge_file(cfg: dict[str, Any], data: dict[str, Any]) -> None:
+    for k, v in data.items():
+        if k in ("ports", "dns_config", "gossip_lan", "gossip_wan",
+                 "performance", "telemetry", "acl"):
+            cfg.setdefault(k, {}).update(v or {})
+        elif k in ("retry_join", "retry_join_wan", "recursors"):
+            # join/recursor address lists accumulate across sources
+            # (reference: agent/config/builder.go slice concat)
+            vals = list(v) if isinstance(v, (list, tuple)) else [v]
+            cfg.setdefault(k, [])
+            cfg[k] = list(cfg[k]) + vals
+        else:
+            cfg[k] = v
+
+
+def load(
+    files: Optional[list[str]] = None,
+    overrides: Optional[dict[str, Any]] = None,
+    dev: bool = False,
+) -> RuntimeConfig:
+    """Build a RuntimeConfig: defaults → config files (JSON) → overrides.
+
+    Mirrors the reference's layered builder (agent/config/builder.go): later
+    sources win; list-valued join addresses accumulate.
+    """
+    raw: dict[str, Any] = {}
+    for path in files or []:
+        if os.path.isdir(path):
+            names = sorted(
+                n for n in os.listdir(path) if n.endswith(".json"))
+            for n in names:
+                with open(os.path.join(path, n)) as f:
+                    _merge_file(raw, json.load(f))
+        else:
+            with open(path) as f:
+                _merge_file(raw, json.load(f))
+    _merge_file(raw, overrides or {})
+
+    kwargs: dict[str, Any] = {}
+    for k, v in raw.items():
+        if k in _CONFIG_ALIASES:
+            tgt = _CONFIG_ALIASES[k]
+            if tgt in ("retry_join_lan", "retry_join_wan", "dns_recursors"):
+                v = tuple(v) if isinstance(v, (list, tuple)) else (v,)
+            kwargs[tgt] = v
+        elif k in {f.name for f in dataclasses.fields(RuntimeConfig)}:
+            kwargs[k] = v
+
+    if "ports" in raw:
+        ports = dict(RuntimeConfig().ports)
+        ports.update(raw["ports"])
+        kwargs["ports"] = ports
+
+    for blk, factory in (("gossip_lan", GossipConfig.lan),
+                         ("gossip_wan", GossipConfig.wan)):
+        base = factory()
+        if dev and blk == "gossip_lan":
+            base = GossipConfig.local()
+        gossip_fields = {f.name for f in dataclasses.fields(GossipConfig)}
+        user = {k: v for k, v in raw.get(blk, {}).items()
+                if k in gossip_fields}
+        kwargs[blk] = replace(base, **user)
+
+    # dns_config / telemetry / acl blocks → their RuntimeConfig fields
+    # (reference: agent/config/runtime.go flattens these the same way).
+    dns = raw.get("dns_config", {})
+    for src, tgt in (("allow_stale", "dns_allow_stale"),
+                     ("max_stale", "dns_max_stale"),
+                     ("node_ttl", "dns_node_ttl"),
+                     ("service_ttl", "dns_service_ttl"),
+                     ("enable_truncate", "dns_enable_truncate"),
+                     ("only_passing", "dns_only_passing")):
+        if src in dns:
+            kwargs[tgt] = dns[src]
+    if "recursors" in raw:
+        kwargs["dns_recursors"] = tuple(raw["recursors"])
+    if "telemetry" in raw:
+        tel = {k: v for k, v in raw["telemetry"].items()
+               if k in {f.name for f in dataclasses.fields(TelemetryConfig)}}
+        kwargs["telemetry"] = TelemetryConfig(**tel)
+    acl = raw.get("acl", {})
+    for src, tgt in (("enabled", "acl_enabled"),
+                     ("default_policy", "acl_default_policy"),
+                     ("down_policy", "acl_down_policy"),
+                     ("token_ttl", "acl_token_ttl")):
+        if src in acl:
+            kwargs[tgt] = acl[src]
+    if "initial_management" in acl.get("tokens", {}):
+        kwargs["acl_initial_management_token"] = \
+            acl["tokens"]["initial_management"]
+
+    if dev:
+        kwargs.setdefault("server_mode", True)
+        kwargs.setdefault("bootstrap", True)
+        kwargs["dev_mode"] = True
+
+    cfg = RuntimeConfig(**kwargs)
+    validate(cfg)
+    return cfg
+
+
+def validate(cfg: RuntimeConfig) -> None:
+    """Reference: `consul validate` + builder validation rules."""
+    if cfg.bootstrap and not cfg.server_mode:
+        raise ConfigError("bootstrap mode requires server mode")
+    if cfg.bootstrap_expect and not cfg.server_mode:
+        raise ConfigError("bootstrap_expect requires server mode")
+    if cfg.bootstrap_expect and cfg.bootstrap:
+        raise ConfigError("bootstrap and bootstrap_expect are mutually exclusive")
+    if cfg.bootstrap_expect == 1:
+        raise ConfigError("bootstrap_expect=1 is not allowed; use bootstrap")
+    if not cfg.dev_mode and cfg.server_mode and not cfg.data_dir:
+        raise ConfigError("server mode requires data_dir")
+    if cfg.encrypt_key:
+        import base64
+
+        try:
+            key = base64.b64decode(cfg.encrypt_key)
+        except Exception as e:  # noqa: BLE001
+            raise ConfigError(f"invalid encrypt key: {e}") from e
+        if len(key) not in (16, 24, 32):
+            raise ConfigError("encrypt key must be 16, 24 or 32 bytes")

@@ -1,0 +1,43 @@
+"""TPU gossip simulation backend — the north-star subsystem.
+
+Lifts the SWIM gossip hot path (memberlist's probe→ack→indirect-probe cycle,
+piggybacked broadcast dissemination, Lifeguard suspicion timers — consumed by
+the reference at agent/consul/server_serf.go and tuned at
+agent/consul/config.go:661-698) into a batched JAX message-passing simulation:
+N virtual agents' state lives in per-node tensors, and one protocol period
+(one "round") is a single jit-compiled function of elementwise updates,
+gathers, and scatter-adds — no per-node Python, no dynamic shapes.
+
+Modeling approach (mean-field rumor-centric SWIM):
+
+* Ground truth per node: ``up`` (process liveness, driven by churn/leave
+  injection) — the thing the failure detector is trying to learn.
+* Cluster knowledge per node: the *current rumor* about that node
+  (status, incarnation), its epidemic spread fraction ``informed``, and its
+  Lifeguard suspicion timer (start, deadline, independent confirmations).
+  This replaces the O(N²) per-viewer membership matrix with O(N) state,
+  which is what makes 1M nodes feasible (SURVEY.md §5: 1M × O(100B) ≈ 100MB).
+* Probing is exact and stochastic: every live node picks a uniform target,
+  ack success is one Bernoulli draw with the exact composed probability of
+  direct UDP (2 legs), k indirect relays (4 legs each through live peers),
+  and TCP fallback — so failure-detector false positives arise the same way
+  they do in memberlist: loss-induced missed acks racing refutation.
+* Dissemination is epidemic mean-field: a rumor's informed fraction grows
+  by 1-exp(-fanout·ticks·informed·(1-loss)) per round; refutation of one's
+  own suspicion is a Bernoulli draw against that spread (the Lifeguard race).
+
+The same ``GossipConfig`` drives this backend and the host engine
+(consul_tpu.gossip), which is the behavioral-conformance seam (like the
+reference's internal/storage/conformance shared suite).
+"""
+
+from consul_tpu.sim.params import SimParams
+from consul_tpu.sim.state import SimState, init_state, ALIVE, SUSPECT, DEAD, LEFT
+from consul_tpu.sim.round import gossip_round, run_rounds, make_run_rounds
+from consul_tpu.sim.mesh import make_sharded_run, make_mesh
+
+__all__ = [
+    "SimParams", "SimState", "init_state", "gossip_round", "run_rounds",
+    "make_run_rounds", "make_sharded_run", "make_mesh",
+    "ALIVE", "SUSPECT", "DEAD", "LEFT",
+]

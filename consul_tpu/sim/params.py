@@ -1,0 +1,165 @@
+"""Static simulation parameters (hashable → usable as jit static args).
+
+Derived from the same ``GossipConfig`` the host engine uses; plus the
+network/workload model (loss, churn) that the reference's container tests
+inject with iptables (sdk/iptables) and the BASELINE.json configs specify.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from consul_tpu.config import GossipConfig
+
+
+@dataclass(frozen=True)
+class SimParams:
+    """All static knobs for the batched SWIM simulation.
+
+    Times are in seconds; one simulation round advances ``probe_interval``
+    (one SWIM protocol period). Rates suffixed ``_per_round`` are per-node
+    Bernoulli probabilities per round.
+    """
+
+    n: int = 1024
+
+    # SWIM failure detection (mirrors GossipConfig / memberlist fields)
+    probe_interval: float = 1.0
+    probe_timeout: float = 0.5
+    indirect_checks: int = 3
+    tcp_fallback: bool = True
+
+    # Lifeguard suspicion
+    suspicion_mult: int = 4
+    suspicion_max_timeout_mult: int = 6
+    awareness_max: int = 8
+    lifeguard: bool = True   # off → fixed timers, no awareness scaling
+
+    # Dissemination
+    gossip_interval: float = 0.2
+    gossip_nodes: int = 3
+    retransmit_mult: int = 4
+
+    # Network model
+    loss: float = 0.0            # i.i.d. UDP packet-loss probability
+    tcp_fail: float = 0.0        # TCP fallback connection-failure probability
+
+    # Degraded-node model (Lifeguard's target failure mode: slow message
+    # processing at a live node). A slow node handles each message duty on
+    # time only with probability slow_factor; Lifeguard probers mitigate by
+    # waiting longer (timeout scaling with local health).
+    slow_per_round: float = 0.0     # P(live node enters slow state) / round
+    slow_recover_per_round: float = 0.05
+    slow_factor: float = 0.1
+
+    # Keep cumulative detector statistics (a few extra scalar reductions
+    # per round). Disable for pure-throughput benchmarking.
+    collect_stats: bool = True
+
+    # Workload model (churn injection)
+    fail_per_round: float = 0.0     # P(live node crashes) per round
+    rejoin_per_round: float = 0.0   # P(dead node rejoins) per round
+    leave_per_round: float = 0.0    # P(live node gracefully leaves) per round
+
+    # --- derived (computed at trace time; all Python floats/ints) ---------
+
+    def _gc(self) -> GossipConfig:
+        """The equivalent GossipConfig — single source of the derived-
+        quantity formulas (the host-engine/sim conformance seam)."""
+        return GossipConfig(
+            probe_interval=self.probe_interval,
+            probe_timeout=self.probe_timeout,
+            indirect_checks=self.indirect_checks,
+            disable_tcp_pings=not self.tcp_fallback,
+            suspicion_mult=self.suspicion_mult,
+            suspicion_max_timeout_mult=self.suspicion_max_timeout_mult,
+            awareness_max_multiplier=self.awareness_max,
+            gossip_interval=self.gossip_interval,
+            gossip_nodes=self.gossip_nodes,
+            retransmit_mult=self.retransmit_mult)
+
+    @property
+    def gossip_ticks_per_round(self) -> float:
+        return max(1.0, self.probe_interval / self.gossip_interval)
+
+    @property
+    def suspicion_min_s(self) -> float:
+        return self._gc().suspicion_min_timeout(self.n)
+
+    @property
+    def suspicion_max_s(self) -> float:
+        if not self.lifeguard:
+            return self.suspicion_min_s
+        return self._gc().suspicion_max_timeout(self.n)
+
+    @property
+    def confirmation_k(self) -> int:
+        """Expected independent confirmations that drive the timer to its
+        minimum (memberlist uses SuspicionMult-2 as the k of its log-shrink)."""
+        return max(1, self.suspicion_mult - 2)
+
+    @property
+    def retransmit_limit(self) -> int:
+        return self._gc().retransmit_limit(self.n)
+
+    @property
+    def p_direct(self) -> float:
+        """Direct UDP probe round-trip success (2 packet legs)."""
+        return (1.0 - self.loss) ** 2
+
+    @property
+    def p_relay(self) -> float:
+        """One indirect ping-req relay success (4 packet legs)."""
+        return (1.0 - self.loss) ** 4
+
+    @property
+    def p_tcp(self) -> float:
+        return (1.0 - self.tcp_fail) if self.tcp_fallback else 0.0
+
+    @staticmethod
+    def from_gossip_config(cfg: GossipConfig, n: int, **kw) -> "SimParams":
+        return SimParams(
+            n=n,
+            probe_interval=cfg.probe_interval,
+            probe_timeout=cfg.probe_timeout,
+            indirect_checks=cfg.indirect_checks,
+            tcp_fallback=not cfg.disable_tcp_pings,
+            suspicion_mult=cfg.suspicion_mult,
+            suspicion_max_timeout_mult=cfg.suspicion_max_timeout_mult,
+            awareness_max=cfg.awareness_max_multiplier,
+            gossip_interval=cfg.gossip_interval,
+            gossip_nodes=cfg.gossip_nodes,
+            retransmit_mult=cfg.retransmit_mult,
+            **kw,
+        )
+
+    def with_(self, **kw) -> "SimParams":
+        return replace(self, **kw)
+
+
+# The BASELINE.json benchmark configurations (see BASELINE.md):
+def baseline_configs() -> dict[str, SimParams]:
+    lan = GossipConfig.lan()
+    wan = GossipConfig.wan()
+    # "5%/min churn": 5% of membership experiences a join-or-leave event per
+    # minute — half crashes (2.5%/min of live nodes), half joins. With the
+    # dead pool holding ~5% of slots at steady state, the per-dead-node
+    # rejoin rate is (0.95/0.05)≈19x the per-live-node crash rate, keeping
+    # crash and rejoin event *volumes* equal.
+    crash_round = 0.025 / 60.0 * wan.probe_interval
+    return {
+        # 1k nodes, DefaultLANConfig, Lifeguard disabled
+        "1k-lan-nolifeguard": SimParams.from_gossip_config(
+            lan, n=1_000, lifeguard=False),
+        # 100k nodes, Lifeguard on, 1% packet loss
+        "100k-lan-lifeguard-loss1": SimParams.from_gossip_config(
+            lan, n=100_000, loss=0.01),
+        # 1M nodes, DefaultWANConfig, 5%/min churn
+        "1m-wan-churn5": SimParams.from_gossip_config(
+            wan, n=1_000_000,
+            fail_per_round=crash_round,
+            rejoin_per_round=crash_round * 19.0,
+        ),
+        # headline perf config: 1M nodes, LAN timing (1 round = 1s simulated)
+        "1m-lan": SimParams.from_gossip_config(lan, n=1_000_000, loss=0.01),
+    }

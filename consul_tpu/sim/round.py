@@ -1,0 +1,319 @@
+"""One SWIM protocol period as a single jit-compiled tensor program.
+
+Replaces the reference's event-driven per-node goroutine machinery
+(memberlist state.go probe cycle, suspicion.go Lifeguard timers,
+broadcast.go piggyback queue — consumed via agent/consul/server_serf.go)
+with a batch-synchronous, fully *Poissonized* update.
+
+Why no gathers/scatters: XLA scatter/gather at 1M random indices costs
+~10ms each on TPU — catastrophically serial. The model is rumor-centric
+mean-field already, so per-pair probe wiring carries no information the
+statistics need: a prober's ack outcome depends on the *population* of
+targets, and a target's failed-probe count is Poisson with a rate set by
+the *population* of probers. Both expectations are EXACT under the model:
+
+  * node timeliness g is two-valued (1 or slow_factor), so every moment
+    E[g^k] and every mixture over a random endpoint reduces to the slow
+    fraction s̄ — we evaluate p_noack at both endpoint values and mix;
+  * per-target failed-probe counts are Binomial(n_live, ~1/n_elig) ≈
+    Poisson(λ_j), sampled by truncated inverse-CDF (4 comparisons).
+
+The entire round is then elementwise math + ~10 scalar reductions, which
+is bandwidth-bound: ~0.1-1 ms/round at 1M nodes on one chip, and the
+sharded version (sim/mesh.py) needs only *scalar* psums cross-device.
+
+Lifeguard timer algebra: memberlist's suspicion timeout with c
+independent confirmations is timeout(c) = max(min_s, max_s −
+(max_s−min_s)·log(c+1)/log(k+1)) · (LH+1). The (LH+1) scale factorizes,
+so we never store it: deadline' = start + (deadline − start) ·
+shrink(c')/shrink(c), with shrink(c) = max(r, 1 − (1−r)·log(c+1)/
+log(k+1)), r = min_s/max_s.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.sim.params import SimParams
+from consul_tpu.sim.state import (ALIVE, DEAD, INF, LEFT, SUSPECT, SimState,
+                                  SimStats)
+
+Reducer = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _shrink(c: jnp.ndarray, p: SimParams) -> jnp.ndarray:
+    """Normalized Lifeguard timeout shrink factor for c confirmations."""
+    if not p.lifeguard or p.suspicion_max_s <= p.suspicion_min_s:
+        return jnp.ones_like(c, jnp.float32)
+    r = p.suspicion_min_s / p.suspicion_max_s
+    frac = jnp.log(c.astype(jnp.float32) + 1.0) / jnp.log(
+        float(p.confirmation_k) + 1.0)
+    return jnp.maximum(r, 1.0 - (1.0 - r) * frac)
+
+
+def _trunc_poisson(u: jnp.ndarray, lam: jnp.ndarray, kmax: int = 4
+                   ) -> jnp.ndarray:
+    """Poisson sample via inverse CDF truncated at kmax (elementwise)."""
+    nf = jnp.zeros_like(lam, jnp.int32)
+    term = jnp.exp(-lam)
+    c = term
+    for k in range(1, kmax + 1):
+        nf = nf + (u > c).astype(jnp.int32)
+        term = term * lam / k
+        c = c + term
+    return nf
+
+
+def gossip_round(state: SimState, key: jax.Array, p: SimParams,
+                 reduce_sum: Reducer = jnp.sum) -> SimState:
+    """Advance the cluster by one protocol period (p.probe_interval).
+
+    `reduce_sum` turns a per-node array into the *global* scalar sum —
+    jnp.sum on one device; psum-wrapped in the sharded engine. All
+    cross-node coupling flows through these scalars (mean-field).
+    """
+    n = p.n
+    t = state.t
+    t_end = t + p.probe_interval
+    k_churn, k_slow, k_ack, k_pois, k_hear = jax.random.split(key, 5)
+    L = state.up.shape[0]  # local rows (== n on a single device)
+
+    up = state.up
+    status = state.status
+    inc = state.incarnation
+    informed = state.informed
+    age = state.rumor_age
+    s_start = state.susp_start
+    s_dead = state.susp_deadline
+    s_conf = state.susp_conf
+    lh = state.local_health
+    slow = state.slow
+    st = state.stats
+    new_rumor = jnp.zeros((L,), jnp.bool_)
+
+    # ------------------------------------------------------------------ churn
+    if p.fail_per_round or p.leave_per_round or p.rejoin_per_round:
+        u = jax.random.uniform(k_churn, (L,))
+        crash = up & (u < p.fail_per_round)
+        leave = up & (u >= p.fail_per_round) & (
+            u < p.fail_per_round + p.leave_per_round)
+        rejoin = (~up) & (u < p.rejoin_per_round)
+        up = (up & ~(crash | leave)) | rejoin
+        down_time = jnp.where(crash | leave, t, state.down_time)
+        down_time = jnp.where(rejoin, INF, down_time)
+        # Graceful leave: intent broadcast starts immediately (serf leave).
+        status = jnp.where(leave, jnp.int8(LEFT), status)
+        # Rejoin: alive rumor with bumped incarnation beats any dead rumor
+        # (max-incarnation resolution, as in memberlist aliveNode()).
+        status = jnp.where(rejoin, jnp.int8(ALIVE), status)
+        inc = jnp.where(rejoin, inc + 1, inc)
+        lh = jnp.where(rejoin, jnp.int8(0), lh)
+        started = leave | rejoin
+        informed = jnp.where(started, 1.0 / n, informed)
+        age = jnp.where(started, 0.0, age)
+        s_dead = jnp.where(started, INF, s_dead)
+        new_rumor |= started
+        if p.collect_stats:
+            st = st._replace(
+                crashes=st.crashes + reduce_sum(crash.astype(jnp.int32)),
+                leaves=st.leaves + reduce_sum(leave.astype(jnp.int32)),
+                rejoins=st.rejoins + reduce_sum(rejoin.astype(jnp.int32)))
+    else:
+        down_time = state.down_time
+
+    # -------------------------------------------------- degraded-node churn
+    if p.slow_per_round:
+        u_s = jax.random.uniform(k_slow, (L,))
+        slow = jnp.where(slow, u_s >= p.slow_recover_per_round,
+                         u_s < p.slow_per_round) & up
+
+    # --------------------------------------------- mean-field population
+    upf = up.astype(jnp.float32)
+    elig = (status == ALIVE) | (status == SUSPECT)  # still in member lists
+    eligf = elig.astype(jnp.float32)
+    n_live = reduce_sum(upf)
+    n_elig = jnp.maximum(reduce_sum(eligf), 1.0)
+    n_up_elig = jnp.maximum(reduce_sum(upf * eligf), 1e-9)
+    frac_up_elig = n_up_elig / n_elig
+    # slow fraction among live eligible targets (g is two-valued!)
+    sbar = reduce_sum((slow & up & elig).astype(jnp.float32)) / n_up_elig
+
+    g = jnp.where(slow, p.slow_factor, 1.0)
+    if p.lifeguard and p.slow_per_round:
+        patience = 1.0 - jnp.exp2(-lh.astype(jnp.float32))
+    else:
+        patience = jnp.zeros((L,), jnp.float32)
+
+    # Per-prober miss probability against a live target of timeliness gj,
+    # exact mixture over the two-valued target/peer population.
+    def noack_given(gj_val: float | jnp.ndarray) -> jnp.ndarray:
+        gj = jnp.asarray(gj_val, jnp.float32)
+        ge_i = g + (1.0 - g) * patience
+        ge_j = gj + (1.0 - gj) * patience
+        pair2 = (ge_i * ge_j) ** 2
+        p_d = p.p_direct * pair2
+        # a relay peer is live w.p. live_frac; its timeliness is the same
+        # two-point mix → E[ge_peer^4] from sbar (exact, two values).
+        ge_p_slow = p.slow_factor + (1.0 - p.slow_factor) * patience
+        e_gp4 = (1.0 - sbar) * 1.0 + sbar * ge_p_slow ** 4
+        live_frac = n_live / n
+        p_relay1 = live_frac * p.p_relay * pair2 * e_gp4
+        p_no_relay = (1.0 - p_relay1) ** p.indirect_checks
+        p_tcp = p.p_tcp * ge_i * ge_j
+        return (1.0 - p_d) * p_no_relay * (1.0 - p_tcp)
+
+    pf_fast = noack_given(1.0)            # [L] per prober, healthy target
+    pf_slow = noack_given(p.slow_factor)  # [L] per prober, slow target
+
+    # ---------------------------------------------------- prober-side probe
+    # P(ack | this node probes): random eligible target; down targets never
+    # ack. One Bernoulli draw ≡ drawing target + channels separately.
+    mix_i = (1.0 - sbar) * pf_fast + sbar * pf_slow
+    p_ack = frac_up_elig * (1.0 - mix_i)
+    prober = up
+    ack = prober & (jax.random.uniform(k_ack, (L,)) < p_ack)
+    failed = prober & ~ack
+
+    # Lifeguard awareness: successful probe −1, missed ack +1
+    # (memberlist awareness.go deltas applied in state.go probeNode).
+    if p.lifeguard:
+        delta = jnp.where(ack, -1, 0) + jnp.where(failed, 1, 0)
+        lh = jnp.clip(lh.astype(jnp.int32) + delta, 0,
+                      p.awareness_max).astype(lh.dtype)
+
+    # --------------------------------------------- target-side suspicion
+    # Failed probes ARRIVING at each target: probers pick uniformly among
+    # eligible members, so arrivals are ≈ Poisson(n_live/n_elig); each
+    # fails with the population-mean miss probability for this target's
+    # liveness/timeliness class.
+    e_pf_fast = reduce_sum(upf * pf_fast) / jnp.maximum(n_live, 1e-9)
+    e_pf_slow = reduce_sum(upf * pf_slow) / jnp.maximum(n_live, 1e-9)
+    probe_rate = n_live / jnp.maximum(n_elig - 1.0, 1.0)
+    p_fail_j = jnp.where(up, jnp.where(slow, e_pf_slow, e_pf_fast), 1.0)
+    lam_fail = probe_rate * p_fail_j * eligf
+    n_fail = _trunc_poisson(jax.random.uniform(k_pois, (L,)), lam_fail)
+
+    # Mean Lifeguard (LH+1) scale of failing probers — the timer that
+    # declares dead runs at a suspector, scaled by ITS local health.
+    w_fail = upf * (1.0 - p_ack)
+    lfail_num = reduce_sum(w_fail * (lh.astype(jnp.float32) + 1.0))
+    lfail_den = jnp.maximum(reduce_sum(w_fail), 1e-9)
+    scale = lfail_num / lfail_den if p.lifeguard else jnp.float32(1.0)
+
+    starts = (n_fail > 0) & (status == ALIVE)
+    confirms = (n_fail > 0) & (status == SUSPECT)
+    # New suspicions: c = n_fail−1 extra confirmers arrived simultaneously.
+    c0 = jnp.maximum(n_fail - 1, 0)
+    timeout0 = scale * p.suspicion_max_s * _shrink(c0, p)
+    status = jnp.where(starts, jnp.int8(SUSPECT), status)
+    s_start = jnp.where(starts, t_end, s_start)
+    s_dead = jnp.where(starts, t_end + timeout0, s_dead)
+    s_conf = jnp.where(starts, c0, s_conf)
+    informed = jnp.where(starts, 1.0 / n, informed)
+    age = jnp.where(starts, 0.0, age)
+    new_rumor |= starts
+    if p.collect_stats:
+        st = st._replace(
+            suspicions=st.suspicions + reduce_sum(starts.astype(jnp.int32)))
+
+    # Existing suspicions: independent confirmations shrink the deadline
+    # (ratio update is exact — see module docstring).
+    c_new = s_conf + n_fail
+    ratio = _shrink(c_new, p) / _shrink(s_conf, p)
+    s_dead = jnp.where(confirms, s_start + (s_dead - s_start) * ratio, s_dead)
+    s_conf = jnp.where(confirms, c_new, s_conf)
+
+    # ------------------------------------------------- refutation (the race)
+    # A live node refutes a suspect/dead rumor about itself once the rumor
+    # reaches it; hearing probability per round follows the epidemic
+    # spread. A slow suspect processes its incoming gossip late (factor g).
+    lam_hear = (p.gossip_nodes * p.gossip_ticks_per_round
+                * informed * (1.0 - p.loss) * g)
+    p_hear = 1.0 - jnp.exp(-lam_hear)
+    wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
+    refute = wrongly & (jax.random.uniform(k_hear, (L,)) < p_hear)
+    status = jnp.where(refute, jnp.int8(ALIVE), status)
+    inc = jnp.where(refute, inc + 1, inc)
+    informed = jnp.where(refute, 1.0 / n, informed)
+    age = jnp.where(refute, 0.0, age)
+    s_dead = jnp.where(refute, INF, s_dead)
+    s_conf = jnp.where(refute, 0, s_conf)
+    new_rumor |= refute
+    if p.lifeguard:
+        lh = jnp.clip(lh.astype(jnp.int32) + refute.astype(jnp.int32), 0,
+                      p.awareness_max).astype(lh.dtype)
+    if p.collect_stats:
+        st = st._replace(
+            refutes=st.refutes + reduce_sum(refute.astype(jnp.int32)))
+
+    # ------------------------------------------------------ dead declaration
+    declare = (status == SUSPECT) & (t_end >= s_dead)
+    status = jnp.where(declare, jnp.int8(DEAD), status)
+    informed = jnp.where(declare, 1.0 / n, informed)
+    age = jnp.where(declare, 0.0, age)
+    s_dead = jnp.where(declare, INF, s_dead)
+    new_rumor |= declare
+    if p.collect_stats:
+        fp, tp = declare & up, declare & ~up
+        st = st._replace(
+            false_positives=st.false_positives
+            + reduce_sum(fp.astype(jnp.int32)),
+            true_deaths_declared=st.true_deaths_declared
+            + reduce_sum(tp.astype(jnp.int32)),
+            detect_latency_sum=st.detect_latency_sum
+            + reduce_sum(jnp.where(tp, t_end - down_time, 0.0)))
+
+    # ------------------------------------------------- epidemic dissemination
+    # Mean-field piggyback gossip: each of the ~informed·N carriers sends
+    # gossip_nodes messages per tick; an uninformed node misses them all
+    # with probability exp(-fanout·ticks·informed·(1−loss)).
+    grow = (~new_rumor) & (informed < 1.0)
+    lam_g = (p.gossip_nodes * p.gossip_ticks_per_round
+             * informed * (1.0 - p.loss))
+    informed = jnp.where(
+        grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_g)), informed)
+    age = age + 1.0
+
+    return SimState(
+        up=up, down_time=down_time, status=status, incarnation=inc,
+        informed=informed, rumor_age=age, susp_start=s_start,
+        susp_deadline=s_dead, susp_conf=s_conf, local_health=lh, slow=slow,
+        t=t_end, round_idx=state.round_idx + 1, stats=st)
+
+
+@functools.partial(jax.jit, static_argnames=("p", "rounds", "trace_node"))
+def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
+               trace_node: Optional[int] = None):
+    """Run `rounds` periods on-device via lax.scan.
+
+    Returns (final_state, trace) where trace is the per-round informed
+    fraction of `trace_node` (for propagation/convergence curves) or None.
+    """
+
+    def body(carry, k):
+        s = gossip_round(carry, k, p)
+        out = s.informed[trace_node] if trace_node is not None else None
+        return s, out
+
+    keys = jax.random.split(key, rounds)
+    final, trace = jax.lax.scan(body, state, keys)
+    return final, trace
+
+
+def make_run_rounds(p: SimParams, rounds: int):
+    """A pre-bound compiled runner: state, key -> state (bench hot loop)."""
+
+    @jax.jit
+    def run(state: SimState, key: jax.Array) -> SimState:
+        def body(carry, k):
+            return gossip_round(carry, k, p), None
+
+        keys = jax.random.split(key, rounds)
+        final, _ = jax.lax.scan(body, state, keys)
+        return final
+
+    return run

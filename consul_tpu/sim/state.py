@@ -1,0 +1,96 @@
+"""Per-node simulation state tensors.
+
+One row per virtual agent; the whole cluster is a struct-of-arrays pytree.
+At 1M nodes this is ~30 bytes/node ≈ 30MB — single-chip HBM is not the
+constraint; the sharding axis (sim/mesh.py) exists for bandwidth and
+multi-DC topology, mirroring SURVEY.md §5's long-context analysis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Rumor/member status encodings — match consul_tpu.types.MemberStatus.
+ALIVE = 1
+SUSPECT = 2
+DEAD = 3
+LEFT = 5
+
+INF = jnp.float32(3.4e38)
+
+
+class SimStats(NamedTuple):
+    """Cumulative scalar counters (int32/float32 0-d arrays)."""
+
+    false_positives: jnp.ndarray      # up nodes declared dead
+    refutes: jnp.ndarray              # suspicions refuted in time
+    suspicions: jnp.ndarray           # suspicion rumors started
+    true_deaths_declared: jnp.ndarray # down nodes declared dead
+    detect_latency_sum: jnp.ndarray   # sum of (declare time - crash time), s
+    crashes: jnp.ndarray              # churn-injected crashes
+    rejoins: jnp.ndarray
+    leaves: jnp.ndarray
+
+    @staticmethod
+    def zeros() -> "SimStats":
+        z = jnp.zeros((), jnp.int32)
+        return SimStats(z, z, z, z, jnp.zeros((), jnp.float32), z, z, z)
+
+
+class SimState(NamedTuple):
+    """Struct-of-arrays cluster state; all [N] unless noted."""
+
+    # Ground truth
+    up: jnp.ndarray           # bool — process liveness
+    down_time: jnp.ndarray    # f32  — sim time of crash (INF while up)
+
+    # Cluster-wide rumor about each node
+    status: jnp.ndarray       # int8 — ALIVE/SUSPECT/DEAD/LEFT
+    incarnation: jnp.ndarray  # int32 — incarnation the rumor carries
+    informed: jnp.ndarray     # f32 — fraction of cluster that has the rumor
+    rumor_age: jnp.ndarray    # f32 — rounds since rumor started
+
+    # Lifeguard suspicion timer (valid while status == SUSPECT)
+    susp_start: jnp.ndarray    # f32 — sim time suspicion began
+    susp_deadline: jnp.ndarray # f32 — current declare-dead deadline
+    susp_conf: jnp.ndarray     # int32 — independent confirmations
+
+    # Lifeguard local-health awareness score (0..awareness_max)
+    local_health: jnp.ndarray  # int8
+
+    # Degraded-node model: slow nodes delay acks/processing (GC pause,
+    # overload) — the failure mode Lifeguard exists for (its paper's "slow
+    # message processing"; memberlist awareness.go).
+    slow: jnp.ndarray         # bool
+
+    # Scalars
+    t: jnp.ndarray            # f32 — sim time, seconds
+    round_idx: jnp.ndarray    # int32
+    stats: SimStats
+
+
+def init_state(n: int, dtype_small: jnp.dtype = jnp.int8) -> SimState:
+    """Everyone alive, fully converged, health perfect."""
+    return SimState(
+        up=jnp.ones((n,), jnp.bool_),
+        down_time=jnp.full((n,), INF, jnp.float32),
+        status=jnp.full((n,), ALIVE, dtype_small),
+        incarnation=jnp.zeros((n,), jnp.int32),
+        informed=jnp.ones((n,), jnp.float32),
+        rumor_age=jnp.zeros((n,), jnp.float32),
+        susp_start=jnp.zeros((n,), jnp.float32),
+        susp_deadline=jnp.full((n,), INF, jnp.float32),
+        susp_conf=jnp.zeros((n,), jnp.int32),
+        local_health=jnp.zeros((n,), dtype_small),
+        slow=jnp.zeros((n,), jnp.bool_),
+        t=jnp.zeros((), jnp.float32),
+        round_idx=jnp.zeros((), jnp.int32),
+        stats=SimStats.zeros(),
+    )
+
+
+def state_bytes(s: SimState) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(s))

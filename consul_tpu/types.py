@@ -1,0 +1,251 @@
+"""Core wire/state types shared by every layer.
+
+The reference spreads these across agent/structs/ (44k LoC of Go structs).
+We keep one small module of frozen dataclasses with msgpack-dict codecs;
+everything the TPU simulation needs is integer-codable (status enums are
+small ints so member state packs into int8 tensors).
+
+Reference: agent/structs/structs.go (RegisterRequest, Node, NodeService,
+HealthCheck), serf member model (agent/consul/server_serf.go:30-36 status
+names), api/health.go check states.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+import uuid
+from dataclasses import dataclass, field, asdict
+from typing import Any, Optional
+
+
+class MemberStatus(enum.IntEnum):
+    """SWIM member state. Values are wire/tensor encodings — do not reorder.
+
+    Mirrors memberlist's StateAlive/StateSuspect/StateDead/StateLeft plus
+    serf's StatusLeaving/StatusReap overlay (reference:
+    agent/consul/server_serf.go:33 StatusReap).
+    """
+
+    NONE = 0
+    ALIVE = 1
+    SUSPECT = 2
+    DEAD = 3
+    LEAVING = 4
+    LEFT = 5
+    REAP = 6
+
+
+class CheckStatus(str, enum.Enum):
+    """Health check states (reference: api/health.go HealthPassing etc.)."""
+
+    PASSING = "passing"
+    WARNING = "warning"
+    CRITICAL = "critical"
+    MAINT = "maintenance"
+
+    @staticmethod
+    def worst(statuses: "list[CheckStatus]") -> "CheckStatus":
+        order = [CheckStatus.MAINT, CheckStatus.CRITICAL, CheckStatus.WARNING,
+                 CheckStatus.PASSING]
+        for s in order:
+            if s in statuses:
+                return s
+        return CheckStatus.PASSING
+
+
+#: Name of the implicit gossip-driven node health check (reference:
+#: structs.SerfCheckID / "serfHealth" in leader_registrator_v1.go).
+SERF_CHECK_ID = "serfHealth"
+SERF_CHECK_NAME = "Serf Health Status"
+
+
+def new_node_id() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass(frozen=True)
+class Member:
+    """A gossip-pool member: node identity + tags + SWIM state.
+
+    Tags are the server-advertisement mechanism (role/dc/id/port/vsn...),
+    mirroring agent/consul/server_serf.go:101-146.
+    """
+
+    name: str
+    addr: str
+    port: int
+    tags: dict[str, str] = field(default_factory=dict)
+    status: MemberStatus = MemberStatus.ALIVE
+    incarnation: int = 0
+
+    @property
+    def node_id(self) -> str:
+        return self.tags.get("id", "")
+
+    @property
+    def is_server(self) -> bool:
+        return self.tags.get("role") == "consul"
+
+    @property
+    def datacenter(self) -> str:
+        return self.tags.get("dc", "")
+
+    def to_dict(self) -> dict[str, Any]:
+        d = asdict(self)
+        d["status"] = int(self.status)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "Member":
+        d = dict(d)
+        d["status"] = MemberStatus(d.get("status", 1))
+        return Member(**d)
+
+
+@dataclass
+class Node:
+    """Catalog node record (reference: structs.Node)."""
+
+    node: str
+    address: str
+    node_id: str = ""
+    datacenter: str = ""
+    tagged_addresses: dict[str, str] = field(default_factory=dict)
+    meta: dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "ID": self.node_id, "Node": self.node, "Address": self.address,
+            "Datacenter": self.datacenter,
+            "TaggedAddresses": self.tagged_addresses, "Meta": self.meta,
+            "CreateIndex": self.create_index, "ModifyIndex": self.modify_index,
+        }
+
+
+@dataclass
+class NodeService:
+    """Catalog service instance (reference: structs.NodeService)."""
+
+    id: str
+    service: str
+    tags: list[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+    meta: dict[str, str] = field(default_factory=dict)
+    weights: dict[str, int] = field(default_factory=lambda: {"Passing": 1, "Warning": 1})
+    kind: str = ""  # "", "connect-proxy", "mesh-gateway", ...
+    proxy: dict[str, Any] = field(default_factory=dict)
+    connect_native: bool = False
+    create_index: int = 0
+    modify_index: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "ID": self.id, "Service": self.service, "Tags": list(self.tags),
+            "Address": self.address, "Port": self.port, "Meta": self.meta,
+            "Weights": self.weights, "Kind": self.kind, "Proxy": self.proxy,
+            "Connect": {"Native": self.connect_native},
+            "CreateIndex": self.create_index, "ModifyIndex": self.modify_index,
+        }
+
+
+@dataclass
+class HealthCheck:
+    """Catalog health check (reference: structs.HealthCheck)."""
+
+    node: str
+    check_id: str
+    name: str
+    status: CheckStatus = CheckStatus.CRITICAL
+    notes: str = ""
+    output: str = ""
+    service_id: str = ""
+    service_name: str = ""
+    check_type: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "Node": self.node, "CheckID": self.check_id, "Name": self.name,
+            "Status": self.status.value, "Notes": self.notes,
+            "Output": self.output, "ServiceID": self.service_id,
+            "ServiceName": self.service_name, "Type": self.check_type,
+            "CreateIndex": self.create_index, "ModifyIndex": self.modify_index,
+        }
+
+
+@dataclass
+class KVEntry:
+    """KV store entry (reference: structs.DirEntry)."""
+
+    key: str
+    value: bytes = b""
+    flags: int = 0
+    session: str = ""
+    lock_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        import base64
+
+        return {
+            "Key": self.key,
+            "Value": base64.b64encode(self.value).decode() if self.value else None,
+            "Flags": self.flags, "Session": self.session or None,
+            "LockIndex": self.lock_index,
+            "CreateIndex": self.create_index, "ModifyIndex": self.modify_index,
+        }
+
+
+@dataclass
+class Session:
+    """Session for locks/TTL semantics (reference: structs.Session)."""
+
+    id: str
+    name: str = ""
+    node: str = ""
+    checks: list[str] = field(default_factory=lambda: [SERF_CHECK_ID])
+    lock_delay_s: float = 15.0
+    behavior: str = "release"  # or "delete"
+    ttl: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "ID": self.id, "Name": self.name, "Node": self.node,
+            "Checks": self.checks, "LockDelay": int(self.lock_delay_s * 1e9),
+            "Behavior": self.behavior, "TTL": self.ttl,
+            "CreateIndex": self.create_index, "ModifyIndex": self.modify_index,
+        }
+
+
+@dataclass(frozen=True)
+class Coordinate:
+    """Vivaldi network coordinate (reference: serf/coordinate, consumed at
+    internal/gossip/librtt/rtt.go:16-22)."""
+
+    vec: tuple[float, ...] = (0.0,) * 8
+    error: float = 1.5
+    adjustment: float = 0.0
+    height: float = 1e-5
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"Vec": list(self.vec), "Error": self.error,
+                "Adjustment": self.adjustment, "Height": self.height}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "Coordinate":
+        return Coordinate(vec=tuple(d.get("Vec", (0.0,) * 8)),
+                          error=d.get("Error", 1.5),
+                          adjustment=d.get("Adjustment", 0.0),
+                          height=d.get("Height", 1e-5))
+
+
+def now_ns() -> int:
+    return time.time_ns()

@@ -1,0 +1,114 @@
+"""Real and simulated clocks.
+
+The reference tests SWIM semantics with deterministic time; our host gossip
+engine takes a Clock so tests drive the protocol with a virtual clock and the
+TPU-conformance suite can step both engines in lockstep (SURVEY.md §7 hard
+part f).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Clock:
+    """Wall clock + timer scheduling abstraction."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class Timer:
+    __slots__ = ("deadline", "fn", "cancelled", "seq")
+
+    def __init__(self, deadline: float, fn: Callable[[], None], seq: int):
+        self.deadline = deadline
+        self.fn = fn
+        self.cancelled = False
+        self.seq = seq
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def __lt__(self, other: "Timer") -> bool:
+        return (self.deadline, self.seq) < (other.deadline, other.seq)
+
+
+class SimClock(Clock):
+    """Deterministic virtual clock with a timer heap.
+
+    ``advance(dt)`` moves virtual time forward, firing due timers in
+    deadline order. Single-threaded by design: the host gossip engine in
+    simulated-clock mode runs all protocol logic on the advancing thread.
+    """
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = start
+        self._heap: list[Timer] = []
+        self._seq = itertools.count()
+        self._lock = threading.RLock()
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+    def after(self, delay: float, fn: Callable[[], None]) -> Timer:
+        with self._lock:
+            t = Timer(self._now + max(0.0, delay), fn, next(self._seq))
+            heapq.heappush(self._heap, t)
+            return t
+
+    def advance(self, dt: float) -> None:
+        with self._lock:
+            target = self._now + dt
+            while self._heap and self._heap[0].deadline <= target:
+                t = heapq.heappop(self._heap)
+                self._now = max(self._now, t.deadline)
+                if not t.cancelled:
+                    t.fn()
+            self._now = target
+
+    def run_until_idle(self, max_time: float = 3600.0) -> None:
+        with self._lock:
+            limit = self._now + max_time
+            while self._heap and self._heap[0].deadline <= limit:
+                t = heapq.heappop(self._heap)
+                self._now = max(self._now, t.deadline)
+                if not t.cancelled:
+                    t.fn()
+
+
+class RealTimers:
+    """threading.Timer-based scheduling with the Timer.cancel interface."""
+
+    def __init__(self) -> None:
+        self._timers: set[threading.Timer] = set()
+        self._lock = threading.Lock()
+
+    def after(self, delay: float, fn: Callable[[], None]) -> threading.Timer:
+        def run() -> None:
+            with self._lock:
+                self._timers.discard(t)
+            fn()
+
+        t = threading.Timer(delay, run)
+        t.daemon = True
+        t.start()
+        with self._lock:
+            self._timers.add(t)
+        return t
+
+    def cancel_all(self) -> None:
+        with self._lock:
+            for t in self._timers:
+                t.cancel()
+            self._timers.clear()

@@ -1,0 +1,84 @@
+"""hclog-style named sub-loggers with intercept support.
+
+The reference uses hclog named loggers (logging/names.go, logging/logger.go:65)
+and `NamedIntercept` to live-stream serf/memberlist logs to `/v1/agent/monitor`
+(agent/consul/server_serf.go:155-165). We provide the same surface: named
+loggers, a process-wide level, and attachable sinks for the monitor endpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+from typing import Callable, Optional
+
+# Logger names (reference: logging/names.go)
+AGENT = "agent"
+SERF = "serf"
+MEMBERLIST = "memberlist"
+RAFT = "raft"
+FSM = "fsm"
+HTTP = "http"
+DNS = "dns"
+RPC = "rpc"
+LEADER = "leader"
+ANTI_ENTROPY = "anti_entropy"
+SIM = "sim"
+
+_root = logging.getLogger("consul_tpu")
+_configured = False
+_lock = threading.Lock()
+_sinks: list[Callable[[str], None]] = []
+
+
+class _SinkHandler(logging.Handler):
+    def emit(self, record: logging.LogRecord) -> None:
+        if not _sinks:
+            return
+        msg = self.format(record)
+        for sink in list(_sinks):
+            try:
+                sink(msg)
+            except Exception:  # noqa: BLE001 — sinks must never kill logging
+                pass
+
+
+def setup(level: str = "INFO", stream=None) -> None:
+    """Configure process logging once (reference: logging.Setup, logger.go:65)."""
+    global _configured
+    with _lock:
+        fmt = logging.Formatter(
+            "%(asctime)s [%(levelname)s] %(name)s: %(message)s",
+            datefmt="%Y-%m-%dT%H:%M:%S",
+        )
+        if not _configured:
+            h = logging.StreamHandler(stream or sys.stderr)
+            h.setFormatter(fmt)
+            _root.addHandler(h)
+            s = _SinkHandler()
+            s.setFormatter(fmt)
+            _root.addHandler(s)
+            _root.propagate = False
+            _configured = True
+        _root.setLevel(level.upper())
+
+
+def named(name: str) -> logging.Logger:
+    """A named sub-logger, e.g. named('serf.lan')."""
+    if not _configured:
+        setup()
+    return _root.getChild(name)
+
+
+def add_sink(fn: Callable[[str], None]) -> Callable[[], None]:
+    """Attach a log sink (for `/v1/agent/monitor`); returns a detach fn."""
+    _sinks.append(fn)
+
+    def detach() -> None:
+        try:
+            _sinks.remove(fn)
+        except ValueError:
+            pass
+
+    return detach

@@ -1,0 +1,120 @@
+"""In-memory metrics registry (go-metrics equivalent).
+
+The reference wires go-metrics with an always-on inmem sink served at
+`/v1/agent/metrics` (lib/telemetry.go:15-18) and emits counters/gauges/timers
+inline everywhere (e.g. agent/consul/rpc.go:145). We keep one process-global
+registry with the same three kinds plus labels, and a prometheus-text dump.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Iterable, Optional
+
+_Label = tuple[tuple[str, str], ...]
+
+
+def _key(name: str, labels: Optional[dict[str, str]]) -> tuple[str, _Label]:
+    return name, tuple(sorted((labels or {}).items()))
+
+
+class Metrics:
+    def __init__(self, prefix: str = "consul") -> None:
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, _Label], float] = defaultdict(float)
+        self._gauges: dict[tuple[str, _Label], float] = {}
+        self._samples: dict[tuple[str, _Label], list[float]] = defaultdict(list)
+
+    def incr(self, name: str, value: float = 1.0,
+             labels: Optional[dict[str, str]] = None) -> None:
+        with self._lock:
+            self._counters[_key(name, labels)] += value
+
+    def gauge(self, name: str, value: float,
+              labels: Optional[dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[_key(name, labels)] = value
+
+    def sample(self, name: str, value: float,
+               labels: Optional[dict[str, str]] = None) -> None:
+        with self._lock:
+            buf = self._samples[_key(name, labels)]
+            buf.append(value)
+            if len(buf) > 4096:
+                del buf[: len(buf) - 4096]
+
+    def measure_since(self, name: str, start: float,
+                      labels: Optional[dict[str, str]] = None) -> None:
+        self.sample(name, (time.monotonic() - start) * 1000.0, labels)
+
+    def time(self, name: str, labels: Optional[dict[str, str]] = None):
+        start = time.monotonic()
+        metrics = self
+
+        class _Ctx:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                metrics.measure_since(name, start, labels)
+                return False
+
+        return _Ctx()
+
+    # --- export ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON shape compatible with `/v1/agent/metrics`."""
+        with self._lock:
+            out = {"Counters": [], "Gauges": [], "Samples": []}
+            for (name, labels), v in sorted(self._counters.items()):
+                out["Counters"].append(
+                    {"Name": f"{self.prefix}.{name}", "Count": v,
+                     "Labels": dict(labels)})
+            for (name, labels), v in sorted(self._gauges.items()):
+                out["Gauges"].append(
+                    {"Name": f"{self.prefix}.{name}", "Value": v,
+                     "Labels": dict(labels)})
+            for (name, labels), buf in sorted(self._samples.items()):
+                if not buf:
+                    continue
+                srt = sorted(buf)
+                out["Samples"].append({
+                    "Name": f"{self.prefix}.{name}", "Count": len(buf),
+                    "Min": srt[0], "Max": srt[-1],
+                    "Mean": sum(buf) / len(buf),
+                    "P50": srt[len(srt) // 2],
+                    "P99": srt[min(len(srt) - 1, int(len(srt) * 0.99))],
+                    "Labels": dict(labels)})
+            return out
+
+    def prometheus(self) -> str:
+        lines = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                lines.append(_prom_line(self.prefix, name, labels, v, "_total"))
+            for (name, labels), v in sorted(self._gauges.items()):
+                lines.append(_prom_line(self.prefix, name, labels, v))
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._samples.clear()
+
+
+def _prom_line(prefix: str, name: str, labels: _Label, v: float,
+               suffix: str = "") -> str:
+    metric = (prefix + "_" + name).replace(".", "_").replace("-", "_") + suffix
+    if labels:
+        lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+        return f"{metric}{{{lbl}}} {v}"
+    return f"{metric} {v}"
+
+
+#: Process-global registry (the reference's global go-metrics instance).
+default = Metrics()

@@ -1,0 +1,8 @@
+"""Version info (reference: version/version.go)."""
+
+__version__ = "0.1.0-dev"
+
+# Protocol versions advertised in gossip tags, mirroring the reference's
+# Consul protocol negotiation (reference: agent/consul/server_serf.go:101-146).
+PROTOCOL_VERSION_MIN = 1
+PROTOCOL_VERSION_MAX = 1

@@ -1,0 +1,33 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Must set the env vars before jax is imported anywhere; pytest imports
+conftest first. This mirrors how the reference tests multi-server logic
+in one process (agent/consul/*_test.go spin N servers on loopback —
+SURVEY.md §4): we spin N virtual devices on one host.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's site hook (PYTHONPATH sitecustomize) pre-imports jax before
+# conftest runs, so env vars alone are too late — repoint the platform at
+# runtime as well (works as long as no arrays were created yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,94 @@
+"""Config layering tests (reference behavior: agent/config/builder.go)."""
+
+import base64
+import json
+import os
+
+import pytest
+
+from consul_tpu.config import ConfigError, GossipConfig, RuntimeConfig, load
+
+
+def write(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+def test_defaults_and_dev_mode():
+    cfg = load(dev=True)
+    assert cfg.server_mode and cfg.bootstrap and cfg.dev_mode
+    assert cfg.datacenter == "dc1"
+    assert cfg.port("http") == 8500
+    # dev mode uses fast local gossip timing
+    assert cfg.gossip_lan.probe_interval == pytest.approx(0.2)
+
+
+def test_layering_later_files_win(tmp_path):
+    a = write(tmp_path, "a.json", {"node_name": "a", "datacenter": "dc9"})
+    b = write(tmp_path, "b.json", {"node_name": "b"})
+    cfg = load(files=[a, b], dev=True)
+    assert cfg.node_name == "b"
+    assert cfg.datacenter == "dc9"
+
+
+def test_retry_join_accumulates_across_sources(tmp_path):
+    a = write(tmp_path, "a.json", {"retry_join": ["10.0.0.1"]})
+    b = write(tmp_path, "b.json", {"retry_join": ["10.0.0.2"]})
+    cfg = load(files=[a, b], dev=True)
+    assert cfg.retry_join_lan == ("10.0.0.1", "10.0.0.2")
+
+
+def test_config_dir_sorted_merge(tmp_path):
+    d = tmp_path / "conf.d"
+    d.mkdir()
+    (d / "01.json").write_text(json.dumps({"node_name": "early"}))
+    (d / "02.json").write_text(json.dumps({"node_name": "late"}))
+    cfg = load(files=[str(d)], dev=True)
+    assert cfg.node_name == "late"
+
+
+def test_gossip_block_tuning(tmp_path):
+    a = write(tmp_path, "a.json",
+              {"gossip_lan": {"probe_interval": 2.5, "gossip_nodes": 7}})
+    cfg = load(files=[a], dev=True)
+    assert cfg.gossip_lan.probe_interval == 2.5
+    assert cfg.gossip_lan.gossip_nodes == 7
+    # untouched knobs keep defaults
+    assert cfg.gossip_wan.probe_interval == GossipConfig.wan().probe_interval
+
+
+def test_dns_telemetry_acl_blocks_apply(tmp_path):
+    a = write(tmp_path, "a.json", {
+        "dns_config": {"allow_stale": False, "only_passing": True},
+        "recursors": ["8.8.8.8"],
+        "telemetry": {"prefix": "myapp"},
+        "acl": {"enabled": True, "default_policy": "deny",
+                "tokens": {"initial_management": "root-token"}},
+    })
+    cfg = load(files=[a], dev=True)
+    assert cfg.dns_allow_stale is False
+    assert cfg.dns_only_passing is True
+    assert cfg.dns_recursors == ("8.8.8.8",)
+    assert cfg.telemetry.prefix == "myapp"
+    assert cfg.acl_enabled and cfg.acl_default_policy == "deny"
+    assert cfg.acl_initial_management_token == "root-token"
+
+
+def test_validation_rules():
+    with pytest.raises(ConfigError, match="bootstrap mode requires"):
+        load(overrides={"bootstrap": True, "server": False})
+    with pytest.raises(ConfigError, match="mutually exclusive"):
+        load(overrides={"server": True, "bootstrap": True,
+                        "bootstrap_expect": 3, "data_dir": "/tmp/x"})
+    with pytest.raises(ConfigError, match="bootstrap_expect=1"):
+        load(overrides={"server": True, "bootstrap_expect": 1,
+                        "data_dir": "/tmp/x"})
+    with pytest.raises(ConfigError, match="requires data_dir"):
+        load(overrides={"server": True})
+    with pytest.raises(ConfigError, match="16, 24 or 32"):
+        load(dev=True, overrides={
+            "encrypt": base64.b64encode(b"short").decode()})
+    # valid 32-byte key passes
+    load(dev=True, overrides={
+        "encrypt": base64.b64encode(os.urandom(32)).decode()})
