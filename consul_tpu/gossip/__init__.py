@@ -1,0 +1,33 @@
+"""Host-side SWIM gossip engine — the memberlist+serf equivalent.
+
+A clean, event-driven reimplementation of the behavior the reference
+consumes from hashicorp/memberlist v0.6.0 and hashicorp/serf v0.10.4
+(pinned at go.mod:80/:85; consumed at agent/consul/server_serf.go):
+
+  * SWIM failure detection: periodic random probe→ack, indirect probes
+    through k peers on timeout, stream fallback probe;
+  * Lifeguard: local-health-aware probe/suspicion timeouts, suspicion
+    timers shrunk by independent confirmations;
+  * dissemination: piggybacked broadcasts with retransmit budget
+    (TransmitLimitedQueue), full-state push/pull sync over streams;
+  * membership: alive/suspect/dead/left with incarnation-number
+    refutation ordering; join/leave; node tags (the server-advertisement
+    mechanism); user events (serf layer).
+
+Everything runs against a Clock + Transport seam so tests drive the
+protocol with a deterministic virtual clock and an in-memory network
+with loss/latency injection — and so the TPU simulation backend
+(consul_tpu.sim) slots in behind the same seam, the way the reference's
+wanfed mesh-gateway transport proves the Transport interface is
+pluggable (agent/consul/wanfed/wanfed.go:42-68).
+"""
+
+from consul_tpu.gossip.transport import (InMemNetwork, InMemTransport,
+                                         Transport, UDPTransport)
+from consul_tpu.gossip.swim import Memberlist, MemberlistDelegate
+from consul_tpu.gossip.serf import Serf, SerfEvent, EventType
+
+__all__ = [
+    "Transport", "InMemNetwork", "InMemTransport", "UDPTransport",
+    "Memberlist", "MemberlistDelegate", "Serf", "SerfEvent", "EventType",
+]

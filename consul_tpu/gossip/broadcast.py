@@ -1,0 +1,86 @@
+"""Piggyback broadcast queue with retransmit budget.
+
+Memberlist's TransmitLimitedQueue: each enqueued rumor is retransmitted
+at most RetransmitMult*ceil(log10(n+1)) times, piggybacked onto outgoing
+gossip packets up to the packet budget; a newer rumor about the same
+subject invalidates the queued one. serf overlays dynamic queue-depth
+limits (internal/gossip/libserf/serf.go:25-27 MinQueueDepth=4096).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+
+class Broadcast:
+    __slots__ = ("key", "payload", "transmits")
+
+    def __init__(self, key: str, payload: bytes) -> None:
+        self.key = key          # invalidation key, e.g. "alive:node7"
+        self.payload = payload  # encoded message ([type]+msgpack)
+        self.transmits = 0
+
+
+class TransmitLimitedQueue:
+    def __init__(self, retransmit_mult: int = 4,
+                 min_queue_depth: int = 4096) -> None:
+        self.retransmit_mult = retransmit_mult
+        self.min_queue_depth = min_queue_depth
+        self._by_key: dict[str, Broadcast] = {}
+        # accessed from packet-handler threads and timer threads in
+        # real-clock mode
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def retransmit_limit(self, n_nodes: int) -> int:
+        return self.retransmit_mult * int(
+            math.ceil(math.log10(float(max(n_nodes, 1)) + 1.0)))
+
+    def queue(self, key: str, payload: bytes) -> None:
+        """Enqueue, invalidating any older rumor with the same subject key
+        prefix (e.g. a new alive:node7 replaces suspect:node7)."""
+        subject = key.split(":", 1)[-1]
+        with self._lock:
+            stale = [k for k in self._by_key
+                     if k.split(":", 1)[-1] == subject]
+            for k in stale:
+                del self._by_key[k]
+            self._by_key[key] = Broadcast(key, payload)
+
+    def get_batch(self, n_nodes: int, budget: int,
+                  overhead: int = 3) -> list[bytes]:
+        """Select rumors fitting `budget` bytes, fewest-transmits first
+        (memberlist orders by transmit count so fresh rumors spread
+        fastest). Increments transmit counts and reaps exhausted rumors.
+        """
+        limit = self.retransmit_limit(n_nodes)
+        out: list[bytes] = []
+        used = 0
+        with self._lock:
+            for b in sorted(self._by_key.values(),
+                            key=lambda b: b.transmits):
+                cost = len(b.payload) + overhead
+                if used + cost > budget:
+                    continue
+                out.append(b.payload)
+                used += cost
+                b.transmits += 1
+                if b.transmits >= limit:
+                    del self._by_key[b.key]
+        return out
+
+    def prune(self, max_depth: Optional[int] = None) -> None:
+        """Drop oldest-by-transmit-count entries beyond max queue depth."""
+        depth = max_depth if max_depth is not None else self.min_queue_depth
+        with self._lock:
+            if len(self._by_key) <= depth:
+                return
+            victims = sorted(
+                self._by_key.values(),
+                key=lambda b: -b.transmits)[:len(self._by_key) - depth]
+            for v in victims:
+                del self._by_key[v.key]

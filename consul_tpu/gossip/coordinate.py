@@ -1,0 +1,99 @@
+"""Vivaldi network coordinates.
+
+Equivalent of serf/coordinate (upstream dep), consumed by the reference
+for RTT-aware routing (internal/gossip/librtt/rtt.go:16-22, `consul rtt`,
+`?near=` sorting). Standard Vivaldi with height vector and adjustment
+smoothing; distances in seconds.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, replace
+
+from consul_tpu.types import Coordinate
+
+DIMENSION = 8
+VIVALDI_ERROR_MAX = 1.5
+VIVALDI_CE = 0.25       # error sensitivity
+VIVALDI_CC = 0.25       # position sensitivity
+ADJUSTMENT_WINDOW = 20
+HEIGHT_MIN = 1e-5
+ZERO_THRESHOLD = 1e-6
+GRAVITY_RHO = 150.0
+
+
+def raw_distance(a: Coordinate, b: Coordinate) -> float:
+    dist = math.sqrt(sum((x - y) ** 2 for x, y in zip(a.vec, b.vec)))
+    return dist + a.height + b.height
+
+
+def distance(a: Coordinate, b: Coordinate) -> float:
+    """RTT estimate in seconds, with adjustment terms (librtt.ComputeDistance)."""
+    dist = raw_distance(a, b)
+    adjusted = dist + a.adjustment + b.adjustment
+    return adjusted if adjusted > 0 else dist
+
+
+class CoordinateClient:
+    """Maintains this node's Vivaldi coordinate from RTT observations."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.coord = Coordinate()
+        self.origin = Coordinate()
+        self.rng = random.Random(seed)
+        self._adjustment_samples = [0.0] * ADJUSTMENT_WINDOW
+        self._adjustment_idx = 0
+
+    def get(self) -> Coordinate:
+        return self.coord
+
+    def update(self, other: Coordinate, rtt_s: float) -> Coordinate:
+        """One Vivaldi spring-relaxation step toward `other` at measured RTT."""
+        if rtt_s <= 0:
+            return self.coord
+        c = self.coord
+        dist = raw_distance(c, other)
+        err = c.error + other.error
+        weight = c.error / max(err, ZERO_THRESHOLD)
+        rel_err = abs(dist - rtt_s) / rtt_s
+
+        new_error = rel_err * VIVALDI_CE * weight \
+            + c.error * (1.0 - VIVALDI_CE * weight)
+        new_error = min(new_error, VIVALDI_ERROR_MAX)
+
+        force = VIVALDI_CC * weight * (rtt_s - dist)
+        unit, mag = self._unit_vector(c, other)
+        new_vec = tuple(v + u * force for v, u in zip(c.vec, unit))
+        if mag > ZERO_THRESHOLD:
+            new_height = max(
+                HEIGHT_MIN, (c.height + other.height) * force / mag + c.height)
+        else:
+            new_height = c.height
+
+        # gravity toward origin keeps coordinates from drifting
+        grav = tuple(-(v / GRAVITY_RHO) ** 3 for v in new_vec)
+        new_vec = tuple(v + g for v, g in zip(new_vec, grav))
+
+        # smoothed adjustment term
+        self._adjustment_samples[self._adjustment_idx] = \
+            rtt_s - raw_distance(replace(c, vec=new_vec, height=new_height),
+                                 other)
+        self._adjustment_idx = (self._adjustment_idx + 1) % ADJUSTMENT_WINDOW
+        adjustment = sum(self._adjustment_samples) / (2.0 * ADJUSTMENT_WINDOW)
+
+        self.coord = Coordinate(vec=new_vec, error=new_error,
+                                adjustment=adjustment, height=new_height)
+        return self.coord
+
+    def _unit_vector(self, a: Coordinate, b: Coordinate
+                     ) -> tuple[tuple[float, ...], float]:
+        diff = tuple(x - y for x, y in zip(a.vec, b.vec))
+        mag = math.sqrt(sum(d * d for d in diff))
+        if mag > ZERO_THRESHOLD:
+            return tuple(d / mag for d in diff), mag
+        # coincident points: random direction
+        rv = tuple(self.rng.random() - 0.5 for _ in range(len(a.vec)))
+        m = math.sqrt(sum(d * d for d in rv)) or 1.0
+        return tuple(d / m for d in rv), 0.0

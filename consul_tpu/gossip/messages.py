@@ -1,0 +1,135 @@
+"""Gossip wire messages: msgpack bodies with a 1-byte type prefix.
+
+Mirrors memberlist's message model (1-byte messageType + msgpack body;
+compound messages batch several per UDP packet; encrypted envelopes wrap
+everything when a keyring is installed). The reference relies on exactly
+this framing on its multiplexed RPC port too (1-byte dispatch,
+agent/pool/conn.go:33-49).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Optional
+
+import msgpack
+
+# message types (1 byte on the wire)
+PING = 0
+INDIRECT_PING = 1
+ACK = 2
+NACK = 3
+SUSPECT = 4
+ALIVE = 5
+DEAD = 6
+PUSH_PULL = 7
+COMPOUND = 8
+USER = 9          # serf user event
+ENCRYPTED = 10
+LEAVE_INTENT = 11  # serf graceful-leave intent
+JOIN_INTENT = 12
+QUERY = 13         # serf query
+QUERY_RESPONSE = 14
+
+
+def encode(msg_type: int, body: dict[str, Any]) -> bytes:
+    return bytes([msg_type]) + msgpack.packb(body, use_bin_type=True)
+
+
+def decode(raw: bytes) -> tuple[int, dict[str, Any]]:
+    return raw[0], msgpack.unpackb(raw[1:], raw=False)
+
+
+def make_compound(msgs: list[bytes]) -> bytes:
+    """[COMPOUND][count:1][len:2]*count [payload]*count"""
+    parts = [bytes([COMPOUND]), bytes([len(msgs)])]
+    for m in msgs:
+        parts.append(struct.pack(">H", len(m)))
+    parts.extend(msgs)
+    return b"".join(parts)
+
+
+def split_compound(raw: bytes) -> list[bytes]:
+    count = raw[1]
+    off = 2
+    lens = []
+    for _ in range(count):
+        (ln,) = struct.unpack_from(">H", raw, off)
+        lens.append(ln)
+        off += 2
+    out = []
+    for ln in lens:
+        out.append(raw[off:off + ln])
+        off += ln
+    return out
+
+
+#: bytes AES-GCM encryption adds to a packet (type + 12B nonce + 16B tag)
+ENCRYPT_OVERHEAD = 29
+
+
+class Keyring:
+    """Gossip encryption keyring: multiple installed AES-GCM keys, one
+    primary used to encrypt; any installed key may decrypt (supports
+    rotation, mirroring memberlist's keyring + agent/keyring.go flows).
+
+    Wire format: [ENCRYPTED][12-byte nonce][ciphertext+tag].
+    """
+
+    def __init__(self, keys: Optional[list[bytes]] = None) -> None:
+        self._keys: list[bytes] = []
+        for k in keys or []:
+            self.install(k)
+
+    @property
+    def keys(self) -> list[bytes]:
+        return list(self._keys)
+
+    def primary(self) -> Optional[bytes]:
+        return self._keys[0] if self._keys else None
+
+    def install(self, key: bytes) -> None:
+        if len(key) not in (16, 24, 32):
+            raise ValueError("gossip key must be 16, 24 or 32 bytes")
+        if key not in self._keys:
+            self._keys.append(key)
+
+    def use(self, key: bytes) -> None:
+        if key not in self._keys:
+            raise KeyError("key not installed")
+        self._keys.remove(key)
+        self._keys.insert(0, key)
+
+    def remove(self, key: bytes) -> None:
+        if key == self.primary():
+            raise ValueError("cannot remove primary key")
+        self._keys.remove(key)
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        key = self.primary()
+        if key is None:
+            return plaintext
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        nonce = os.urandom(12)
+        ct = AESGCM(key).encrypt(nonce, plaintext, b"")
+        return bytes([ENCRYPTED]) + nonce + ct
+
+    def decrypt(self, raw: bytes) -> bytes:
+        if not raw or raw[0] != ENCRYPTED:
+            if self._keys:
+                raise ValueError("plaintext packet on encrypted pool")
+            return raw
+        if not self._keys:
+            raise ValueError("encrypted packet but no keyring")
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        nonce, ct = raw[1:13], raw[13:]
+        last: Exception = ValueError("no keys")
+        for key in self._keys:
+            try:
+                return AESGCM(key).decrypt(nonce, ct, b"")
+            except Exception as e:  # noqa: BLE001 — try next key
+                last = e
+        raise ValueError(f"no installed key decrypts packet: {last}")

@@ -1,0 +1,286 @@
+"""serf equivalent: the event/tag/user-event layer over the SWIM engine.
+
+Mirrors what the reference consumes from hashicorp/serf v0.10.4
+(go.mod:85): node tags, a join/leave/failed/update/reap event stream
+(the channel agent/consul/server_serf.go:269-297 drains), user events
+with Lamport ordering, reconnect/reap timers, a snapshot file for
+rejoin, and Vivaldi coordinates piggybacked on probe acks.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from consul_tpu.config import GossipConfig
+from consul_tpu.gossip import messages as m
+from consul_tpu.gossip.coordinate import CoordinateClient
+from consul_tpu.gossip.swim import Memberlist, MemberlistDelegate, NodeState
+from consul_tpu.gossip.transport import Transport
+from consul_tpu.types import Coordinate, MemberStatus
+from consul_tpu.utils import log, telemetry
+
+
+class EventType(str, enum.Enum):
+    MEMBER_JOIN = "member-join"
+    MEMBER_LEAVE = "member-leave"
+    MEMBER_FAILED = "member-failed"
+    MEMBER_UPDATE = "member-update"
+    MEMBER_REAP = "member-reap"
+    USER = "user"
+
+
+@dataclass
+class SerfEvent:
+    type: EventType
+    members: list[NodeState] = field(default_factory=list)
+    name: str = ""          # user event name
+    payload: bytes = b""
+    ltime: int = 0
+
+
+class LamportClock:
+    def __init__(self) -> None:
+        self._time = 0
+        self._lock = threading.Lock()
+
+    def time(self) -> int:
+        return self._time
+
+    def increment(self) -> int:
+        with self._lock:
+            self._time += 1
+            return self._time
+
+    def witness(self, t: int) -> None:
+        with self._lock:
+            if t > self._time:
+                self._time = t
+
+
+class Serf(MemberlistDelegate):
+    """Tags + events + user events + reaping over a Memberlist."""
+
+    def __init__(
+        self,
+        name: str,
+        transport: Transport,
+        config: Optional[GossipConfig] = None,
+        tags: Optional[dict[str, str]] = None,
+        event_handler: Optional[Callable[[SerfEvent], None]] = None,
+        snapshot_path: Optional[str] = None,
+        clock=None,
+        scheduler=None,
+        keyring=None,
+        seed: Optional[int] = None,
+    ) -> None:
+        self.name = name
+        self.config = config or GossipConfig.lan()
+        self.log = log.named(f"serf.{name}")
+        self.metrics = telemetry.default
+        self._handlers: list[Callable[[SerfEvent], None]] = []
+        if event_handler:
+            self._handlers.append(event_handler)
+        self.event_ltime = LamportClock()
+        self._seen_events: dict[int, set[str]] = {}  # ltime -> names
+        self.snapshot_path = snapshot_path
+        self.coord_client = CoordinateClient(seed=seed or 0)
+        self._coords: dict[str, Coordinate] = {}
+        self._coord_lock = threading.Lock()
+
+        self.memberlist = Memberlist(
+            name=name, transport=transport, config=self.config,
+            delegate=self, tags=tags, clock=clock, scheduler=scheduler,
+            keyring=keyring, seed=seed)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.memberlist.start()
+        self.memberlist._every(self.config.reap_interval, self._reap_tick)
+
+    def join(self, addrs: list[str]) -> int:
+        n = self.memberlist.join(addrs)
+        if n and self.snapshot_path:
+            self._write_snapshot()
+        return n
+
+    def rejoin_from_snapshot(self) -> int:
+        """Attempt rejoin via previously-known peer addresses (serf's
+        snapshot/recovery file, agent/consul/server_serf.go:234-238)."""
+        if not self.snapshot_path or not os.path.exists(self.snapshot_path):
+            return 0
+        try:
+            with open(self.snapshot_path) as f:
+                snap = json.load(f)
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("snapshot unreadable: %s", e)
+            return 0
+        self.event_ltime.witness(snap.get("event_ltime", 0))
+        addrs = [a for a in snap.get("peers", [])
+                 if a != self.memberlist.transport.addr]
+        return self.join(addrs) if addrs else 0
+
+    def leave(self) -> None:
+        self.memberlist.leave()
+        # allow the leave intent to propagate (LeavePropagateDelay)
+        self.memberlist.clock.sleep(
+            min(self.config.leave_propagate_delay, 3.0))
+
+    def shutdown(self) -> None:
+        if self.snapshot_path:
+            self._write_snapshot()
+        self.memberlist.shutdown()
+
+    # --------------------------------------------------------------- surface
+
+    def members(self, include_left: bool = True) -> list[NodeState]:
+        return self.memberlist.members(include_dead=include_left)
+
+    def local_member(self) -> NodeState:
+        return self.memberlist.local_node()
+
+    def set_tags(self, tags: dict[str, str]) -> None:
+        self.memberlist.set_tags(tags)
+
+    def add_event_handler(self, fn: Callable[[SerfEvent], None]) -> None:
+        self._handlers.append(fn)
+
+    def user_event(self, name: str, payload: bytes = b"") -> None:
+        """Flood a custom event through the gossip layer (serf UserEvent;
+        the reference's `consul event` / user_event.go pipeline).
+
+        Raises ValueError if the encoded event cannot fit a gossip packet
+        (serf rejects oversized user events rather than dropping them
+        silently)."""
+        ltime = self.event_ltime.increment()
+        body = {"ltime": ltime, "name": name,
+                "payload": payload, "from": self.name}
+        encoded = m.encode(m.USER, body)
+        from consul_tpu.gossip.transport import MAX_PACKET_SIZE
+
+        if len(encoded) > MAX_PACKET_SIZE - 64:
+            raise ValueError(
+                f"user event too large: {len(encoded)} bytes "
+                f"(limit {MAX_PACKET_SIZE - 64})")
+        self.memberlist._broadcast("user", f"{ltime}:{name}", encoded)
+        self._deliver_user(body)  # local delivery, as serf does
+
+    def get_coordinate(self, node: Optional[str] = None
+                       ) -> Optional[Coordinate]:
+        if node is None or node == self.name:
+            return self.coord_client.get()
+        with self._coord_lock:
+            return self._coords.get(node)
+
+    def rtt(self, a: str, b: Optional[str] = None) -> Optional[float]:
+        """Estimated RTT seconds between two members (consul rtt)."""
+        from consul_tpu.gossip.coordinate import distance
+
+        ca = self.get_coordinate(a)
+        cb = self.get_coordinate(b) if b else self.coord_client.get()
+        if ca is None or cb is None:
+            return None
+        return distance(ca, cb)
+
+    # ----------------------------------------------------- delegate callbacks
+
+    def notify_join(self, node: NodeState) -> None:
+        self._emit(SerfEvent(EventType.MEMBER_JOIN, members=[node]))
+
+    def notify_leave(self, node: NodeState) -> None:
+        ev = EventType.MEMBER_LEAVE if node.status == MemberStatus.LEFT \
+            else EventType.MEMBER_FAILED
+        self._emit(SerfEvent(ev, members=[node]))
+
+    def notify_update(self, node: NodeState) -> None:
+        self._emit(SerfEvent(EventType.MEMBER_UPDATE, members=[node]))
+
+    def notify_user_msg(self, raw: dict[str, Any]) -> None:
+        if raw["type"] == m.USER:
+            body = raw["body"]
+            self.event_ltime.witness(body.get("ltime", 0))
+            self._deliver_user(body, requeue=True)
+
+    def ack_payload(self) -> dict[str, Any]:
+        return {"coord": self.coord_client.get().to_dict(),
+                "node": self.name}
+
+    def notify_ack(self, node: str, rtt: float,
+                   payload: dict[str, Any]) -> None:
+        coord = payload.get("coord")
+        if coord and rtt > 0:
+            other = Coordinate.from_dict(coord)
+            self.coord_client.update(other, rtt)
+            with self._coord_lock:
+                self._coords[node] = other
+
+    # --------------------------------------------------------------- internal
+
+    def _deliver_user(self, body: dict[str, Any],
+                      requeue: bool = False) -> None:
+        ltime, name = body.get("ltime", 0), body.get("name", "")
+        seen = self._seen_events.setdefault(ltime, set())
+        if name in seen:
+            return
+        seen.add(name)
+        if requeue:
+            # epidemic relay: first receipt re-enters the broadcast queue
+            # so flooding doesn't rely on the originator's budget alone
+            # (serf re-queues received user events the same way)
+            self.memberlist._broadcast(
+                "user", f"{ltime}:{name}", m.encode(m.USER, body))
+        # bounded dedup buffer (serf keeps a recent-events window)
+        if len(self._seen_events) > 1024:
+            for k in sorted(self._seen_events)[:256]:
+                del self._seen_events[k]
+        payload = body.get("payload") or b""
+        if isinstance(payload, str):
+            payload = payload.encode()
+        self.metrics.incr("serf.events")
+        self._emit(SerfEvent(EventType.USER, name=name,
+                             payload=payload, ltime=ltime))
+
+    def _emit(self, ev: SerfEvent) -> None:
+        for fn in list(self._handlers):
+            try:
+                fn(ev)
+            except Exception as e:  # noqa: BLE001
+                self.log.error("event handler error on %s: %s", ev.type, e)
+
+    def _reap_tick(self) -> None:
+        """Evict tombstoned members (serf reaper: failed after
+        reconnect_timeout, left after tombstone_timeout)."""
+        ml = self.memberlist
+        now = ml.clock.now()
+        reaped = []
+        with ml._lock:
+            for name, ns in list(ml._members.items()):
+                if ns.status == MemberStatus.DEAD and \
+                        now - ns.state_change > self.config.reconnect_timeout:
+                    reaped.append(ml._members.pop(name))
+                elif ns.status == MemberStatus.LEFT and \
+                        now - ns.state_change > self.config.tombstone_timeout:
+                    reaped.append(ml._members.pop(name))
+        for ns in reaped:
+            ns.status = MemberStatus.REAP
+            self._emit(SerfEvent(EventType.MEMBER_REAP, members=[ns]))
+
+    def _write_snapshot(self) -> None:
+        peers = [ns.addr for ns in self.memberlist.members()
+                 if ns.name != self.name]
+        tmp = f"{self.snapshot_path}.tmp"
+        try:
+            snap_dir = os.path.dirname(self.snapshot_path)
+            if snap_dir:
+                os.makedirs(snap_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump({"peers": peers,
+                           "event_ltime": self.event_ltime.time()}, f)
+            os.replace(tmp, self.snapshot_path)
+        except OSError as e:
+            self.log.warning("snapshot write failed: %s", e)

@@ -1,0 +1,776 @@
+"""The SWIM protocol engine (memberlist equivalent).
+
+Event-driven failure detection + dissemination against the Clock and
+Transport seams. Protocol behavior mirrors what the reference consumes
+from hashicorp/memberlist v0.6.0 (go.mod:80; configured via
+agent/consul/config.go:661-698):
+
+  * probe cycle: round-robin over a shuffled member list; direct UDP
+    ping → k indirect ping-reqs → stream fallback; ack deadline scaled
+    by Lifeguard local health (awareness);
+  * suspicion: Lifeguard timer — starts at max timeout, shrinks
+    logarithmically with independent confirmations, scaled by the local
+    health multiplier;
+  * refutation: any suspect/dead claim about self is refuted by
+    broadcasting alive with a higher incarnation; all conflicts resolve
+    by incarnation number, never arrival order;
+  * dissemination: rumors piggyback on pings and dedicated gossip
+    packets through a TransmitLimitedQueue; periodic full-state
+    push/pull over streams repairs any divergence.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from consul_tpu.config import GossipConfig
+from consul_tpu.gossip import messages as m
+from consul_tpu.gossip.broadcast import TransmitLimitedQueue
+from consul_tpu.gossip.transport import MAX_PACKET_SIZE, Transport
+from consul_tpu.types import MemberStatus
+from consul_tpu.utils import log, telemetry
+
+
+@dataclass
+class NodeState:
+    name: str
+    addr: str
+    incarnation: int = 0
+    status: MemberStatus = MemberStatus.ALIVE
+    tags: dict[str, str] = field(default_factory=dict)
+    state_change: float = 0.0
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"name": self.name, "addr": self.addr,
+                "inc": self.incarnation, "status": int(self.status),
+                "tags": dict(self.tags)}
+
+
+class MemberlistDelegate:
+    """Consumer seam (the reference's serf event channel + memberlist
+    delegates, consumed at agent/consul/server_serf.go:269-297)."""
+
+    def notify_join(self, node: NodeState) -> None: ...
+
+    def notify_leave(self, node: NodeState) -> None: ...
+
+    def notify_update(self, node: NodeState) -> None: ...
+
+    def notify_user_msg(self, raw: dict[str, Any]) -> None: ...
+
+    def notify_merge(self, peers: list[NodeState]) -> Optional[str]:
+        """Pre-join validation; return an error string to reject the merge
+        (the reference's lan/wan merge delegates, agent/consul/merge.go)."""
+        return None
+
+    def ack_payload(self) -> dict[str, Any]:
+        """Extra data piggybacked on ack responses (serf puts coordinates
+        here)."""
+        return {}
+
+    def notify_ack(self, node: str, rtt: float,
+                   payload: dict[str, Any]) -> None: ...
+
+
+class _Suspicion:
+    """Lifeguard suspicion timer for one suspect (memberlist suspicion.go)."""
+
+    def __init__(self, engine: "Memberlist", node: str, k: int,
+                 min_s: float, max_s: float) -> None:
+        self.engine = engine
+        self.node = node
+        self.k = max(1, k)
+        self.min_s = min_s
+        self.max_s = max_s
+        self.start = engine._now()
+        self.confirmers: set[str] = set()
+        self.timer = engine._after(self._timeout(), self._fire)
+
+    def _timeout(self) -> float:
+        import math
+
+        c = len(self.confirmers)
+        frac = math.log(c + 1.0) / math.log(self.k + 1.0)
+        timeout = max(self.min_s, self.max_s - (self.max_s - self.min_s) * frac)
+        return timeout
+
+    def confirm(self, from_node: str) -> None:
+        if from_node in self.confirmers:
+            return
+        self.confirmers.add(from_node)
+        elapsed = self.engine._now() - self.start
+        remaining = self._timeout() - elapsed
+        self.timer.cancel()
+        if remaining <= 0:
+            self._fire()
+        else:
+            self.timer = self.engine._after(remaining, self._fire)
+
+    def cancel(self) -> None:
+        self.timer.cancel()
+
+    def _fire(self) -> None:
+        self.engine._suspicion_timeout(self.node)
+
+
+class Memberlist:
+    def __init__(
+        self,
+        name: str,
+        transport: Transport,
+        config: Optional[GossipConfig] = None,
+        delegate: Optional[MemberlistDelegate] = None,
+        tags: Optional[dict[str, str]] = None,
+        clock=None,
+        scheduler=None,
+        keyring: Optional[m.Keyring] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        from consul_tpu.utils.clock import Clock, RealTimers, SimClock
+
+        self.name = name
+        self.transport = transport
+        self.config = config or GossipConfig.lan()
+        self.delegate = delegate or MemberlistDelegate()
+        self.keyring = keyring
+        self.log = log.named(f"memberlist.{name}")
+        self.metrics = telemetry.default
+
+        self.clock = clock or Clock()
+        if scheduler is not None:
+            self.scheduler = scheduler
+        elif isinstance(self.clock, SimClock):
+            self.scheduler = self.clock
+        else:
+            self.scheduler = RealTimers()
+
+        self._lock = threading.RLock()
+        self.incarnation = 0
+        self.awareness = 0  # Lifeguard local health score
+        self._members: dict[str, NodeState] = {}
+        self._probe_ring: list[str] = []
+        self._probe_idx = 0
+        self._seq = 0
+        self._ack_handlers: dict[int, tuple[Callable, Callable, Any]] = {}
+        self._queue = TransmitLimitedQueue(
+            self.config.retransmit_mult, self.config.min_queue_depth)
+        self._loop_timers: dict[int, Any] = {}  # one live timer per loop
+        self._loop_seq = 0
+        self._left = False  # we initiated a graceful leave
+        self._stopped = False
+        self.rng = random.Random(seed if seed is not None
+                                 else hash(name) & 0xFFFFFFFF)
+
+        me = NodeState(name=name, addr=transport.addr,
+                       tags=dict(tags or {}), incarnation=0,
+                       state_change=self._now())
+        self._members[name] = me
+        self._suspicions: dict[str, _Suspicion] = {}
+
+        transport.set_handlers(self._on_packet, self._on_stream)
+
+    # ------------------------------------------------------------ scheduling
+
+    def _now(self) -> float:
+        return self.clock.now()
+
+    def _after(self, delay: float, fn: Callable[[], None]):
+        t = self.scheduler.after(delay, fn)
+        return t
+
+    def _every(self, interval: float, fn: Callable[[], None],
+               stagger: bool = True) -> None:
+        delay = interval * (0.5 + self.rng.random() * 0.5) if stagger \
+            else interval
+        self._loop_seq += 1
+        loop_id = self._loop_seq
+
+        def tick() -> None:
+            if self._stopped:
+                return
+            try:
+                fn()
+            finally:
+                if not self._stopped:
+                    # replace (not append) so fired timers are dropped —
+                    # a weeks-running agent must not accumulate handles
+                    self._loop_timers[loop_id] = self._after(interval, tick)
+
+        self._loop_timers[loop_id] = self._after(delay, tick)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        cfg = self.config
+        self._every(cfg.probe_interval, self._probe_tick)
+        self._every(cfg.gossip_interval, self._gossip_tick)
+        if cfg.push_pull_interval > 0:
+            self._every(cfg.push_pull_interval, self._push_pull_tick)
+
+    def join(self, addrs: list[str]) -> int:
+        """Push/pull state sync with each address (memberlist Join)."""
+        ok = 0
+        for addr in addrs:
+            try:
+                self._push_pull(addr, join=True)
+                ok += 1
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("join %s failed: %s", addr, e)
+        return ok
+
+    def leave(self) -> None:
+        """Graceful leave: broadcast dead-about-self with left flag and
+        give it a moment to spread (serf LeavePropagateDelay)."""
+        with self._lock:
+            self._left = True
+            me = self._members[self.name]
+            me.status = MemberStatus.LEFT
+            self._broadcast("dead", self.name, m.encode(m.DEAD, {
+                "node": self.name, "inc": self.incarnation,
+                "from": self.name, "left": True}))
+        # flush a gossip tick immediately so the intent leaves the building
+        self._gossip_tick()
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        for t in self._loop_timers.values():
+            try:
+                t.cancel()
+            except Exception:  # noqa: BLE001
+                pass
+        for s in self._suspicions.values():
+            s.cancel()
+        self.transport.shutdown()
+
+    # -------------------------------------------------------------- queries
+
+    def members(self, include_dead: bool = False) -> list[NodeState]:
+        with self._lock:
+            out = [ns for ns in self._members.values()
+                   if include_dead or ns.status in (MemberStatus.ALIVE,
+                                                    MemberStatus.SUSPECT)]
+            return sorted(out, key=lambda ns: ns.name)
+
+    def num_alive(self) -> int:
+        return sum(1 for ns in self._members.values()
+                   if ns.status == MemberStatus.ALIVE)
+
+    def local_node(self) -> NodeState:
+        return self._members[self.name]
+
+    def set_tags(self, tags: dict[str, str]) -> None:
+        """Update own tags; disseminated via a re-incarnated alive rumor
+        (serf's role/tag update mechanism)."""
+        with self._lock:
+            self.incarnation += 1
+            me = self._members[self.name]
+            me.tags = dict(tags)
+            me.incarnation = self.incarnation
+            self._broadcast_alive(me)
+
+    def health_score(self) -> int:
+        return self.awareness
+
+    # ------------------------------------------------------------ packet I/O
+
+    def _packet_budget(self) -> int:
+        slack = m.ENCRYPT_OVERHEAD if self.keyring is not None else 0
+        return MAX_PACKET_SIZE - slack - 16
+
+    def _send(self, addr: str, payload: bytes,
+              piggyback: bool = True) -> None:
+        if piggyback:
+            budget = self._packet_budget() - len(payload)
+            extra = self._queue.get_batch(max(self.num_alive(), 1), budget) \
+                if budget > 64 else []
+            if extra:
+                payload = m.make_compound([payload] + extra)
+        if self.keyring is not None:
+            payload = self.keyring.encrypt(payload)
+        self.transport.send_packet(addr, payload)
+
+    def _on_packet(self, src: str, raw: bytes) -> None:
+        try:
+            if self.keyring is not None:
+                raw = self.keyring.decrypt(raw)
+            self._handle_msg(src, raw)
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("bad packet from %s: %s", src, e)
+
+    def _handle_msg(self, src: str, raw: bytes) -> None:
+        if raw[0] == m.COMPOUND:
+            for part in m.split_compound(raw):
+                self._handle_msg(src, part)
+            return
+        t, body = m.decode(raw)
+        if t == m.PING:
+            self._handle_ping(src, body)
+        elif t == m.INDIRECT_PING:
+            self._handle_indirect_ping(src, body)
+        elif t == m.ACK:
+            self._handle_ack(src, body)
+        elif t == m.NACK:
+            pass  # only informs awareness at the indirect requester
+        elif t == m.SUSPECT:
+            self._handle_suspect(body)
+        elif t == m.ALIVE:
+            self._handle_alive(body)
+        elif t == m.DEAD:
+            self._handle_dead(body)
+        elif t in (m.USER, m.QUERY, m.QUERY_RESPONSE, m.LEAVE_INTENT,
+                   m.JOIN_INTENT):
+            self.delegate.notify_user_msg({"type": t, "body": body,
+                                           "src": src})
+        else:
+            self.log.debug("unknown message type %d from %s", t, src)
+
+    # ---------------------------------------------------------- probe cycle
+
+    def _next_probe_target(self) -> Optional[NodeState]:
+        with self._lock:
+            candidates = [n for n, ns in self._members.items()
+                          if n != self.name
+                          and ns.status in (MemberStatus.ALIVE,
+                                            MemberStatus.SUSPECT)]
+            if not candidates:
+                return None
+            if self._probe_idx >= len(self._probe_ring):
+                self._probe_ring = candidates
+                self.rng.shuffle(self._probe_ring)
+                self._probe_idx = 0
+            while self._probe_idx < len(self._probe_ring):
+                name = self._probe_ring[self._probe_idx]
+                self._probe_idx += 1
+                ns = self._members.get(name)
+                if ns is not None and ns.status in (MemberStatus.ALIVE,
+                                                    MemberStatus.SUSPECT):
+                    return ns
+            return self._next_probe_target()
+
+    def _probe_tick(self) -> None:
+        target = self._next_probe_target()
+        if target is None:
+            return
+        self._probe_node(target)
+
+    def _probe_node(self, target: NodeState) -> None:
+        cfg = self.config
+        self.metrics.incr("memberlist.probe")
+        seq = self._next_seq()
+        sent_at = self._now()
+        acked = {"ok": False}
+
+        def on_ack(payload: dict[str, Any]) -> None:
+            acked["ok"] = True
+            self._awareness_delta(-1)
+            self.delegate.notify_ack(target.name, self._now() - sent_at,
+                                     payload)
+
+        # Lifeguard: ack deadline scaled by local health (state.go probeNode)
+        timeout = cfg.scaled_probe_timeout(self.awareness)
+
+        def on_timeout() -> None:
+            if acked["ok"]:
+                return
+            # phase 2: k indirect probes + stream fallback
+            self._awareness_delta(1)
+            self.metrics.incr("memberlist.probe.timeout")
+            self._indirect_probe(target, seq, acked)
+
+        self._register_ack(seq, on_ack, on_timeout, timeout)
+        self._send(target.addr, m.encode(m.PING, {
+            "seq": seq, "node": target.name, "from": self.name,
+            "addr": self.transport.addr}))
+
+    def _indirect_probe(self, target: NodeState, orig_seq: int,
+                        acked: dict) -> None:
+        cfg = self.config
+        with self._lock:
+            peers = [ns for n, ns in self._members.items()
+                     if n not in (self.name, target.name)
+                     and ns.status == MemberStatus.ALIVE]
+        self.rng.shuffle(peers)
+        peers = peers[: cfg.indirect_checks]
+        seq = self._next_seq()
+
+        def on_ack(payload: dict[str, Any]) -> None:
+            acked["ok"] = True
+
+        remaining = max(cfg.probe_interval - cfg.probe_timeout, 0.05)
+
+        def on_final_timeout() -> None:
+            if acked["ok"]:
+                return
+            self.metrics.incr("memberlist.probe.failed")
+            self._suspect_node(target.name, target.incarnation, self.name)
+
+        self._register_ack(seq, on_ack, on_final_timeout, remaining)
+        for peer in peers:
+            self._send(peer.addr, m.encode(m.INDIRECT_PING, {
+                "seq": seq, "node": target.name, "addr": target.addr,
+                "from": self.name, "from_addr": self.transport.addr}))
+        if not cfg.disable_tcp_pings:
+            # stream fallback probe (memberlist's TCP fallback)
+            def stream_probe() -> None:
+                try:
+                    req = m.encode(m.PING, {
+                        "seq": seq, "node": target.name,
+                        "from": self.name, "addr": self.transport.addr})
+                    if self.keyring is not None:
+                        req = self.keyring.encrypt(req)
+                    resp = self.transport.stream_rpc(
+                        target.addr, req, timeout=remaining)
+                    if self.keyring is not None:
+                        resp = self.keyring.decrypt(resp)
+                    t, body = m.decode(resp)
+                    if t == m.ACK:
+                        self._handle_ack(target.addr, body)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            # in sim-clock mode streams are synchronous; run inline
+            stream_probe()
+
+    def _register_ack(self, seq: int, on_ack: Callable,
+                      on_timeout: Callable, timeout: float) -> None:
+        timer = self._after(timeout, lambda: self._expire_ack(seq))
+        with self._lock:
+            self._ack_handlers[seq] = (on_ack, on_timeout, timer)
+
+    def _expire_ack(self, seq: int) -> None:
+        with self._lock:
+            entry = self._ack_handlers.pop(seq, None)
+        if entry is not None:
+            entry[1]()
+
+    def _handle_ack(self, src: str, body: dict[str, Any]) -> None:
+        with self._lock:
+            entry = self._ack_handlers.pop(body.get("seq"), None)
+        if entry is not None:
+            entry[2].cancel()
+            entry[0](body.get("payload") or {})
+
+    def _handle_ping(self, src: str, body: dict[str, Any]) -> None:
+        if body.get("node") != self.name:
+            self.log.debug("ping for %s arrived at %s", body.get("node"),
+                           self.name)
+            return
+        reply_addr = body.get("addr") or src
+        self._send(reply_addr, m.encode(m.ACK, {
+            "seq": body["seq"], "payload": self.delegate.ack_payload()}))
+
+    def _handle_indirect_ping(self, src: str, body: dict[str, Any]) -> None:
+        """Relay: ping the target on behalf of the requester."""
+        seq = self._next_seq()
+        origin_addr = body.get("from_addr") or src
+        orig_seq = body["seq"]
+
+        def on_ack(payload: dict[str, Any]) -> None:
+            self._send(origin_addr, m.encode(m.ACK, {
+                "seq": orig_seq, "payload": payload}))
+
+        def on_timeout() -> None:
+            self._send(origin_addr, m.encode(m.NACK, {"seq": orig_seq}))
+
+        self._register_ack(seq, on_ack, on_timeout,
+                           self.config.probe_timeout)
+        self._send(body["addr"], m.encode(m.PING, {
+            "seq": seq, "node": body["node"], "from": self.name,
+            "addr": self.transport.addr}))
+
+    # ------------------------------------------------------- state handlers
+
+    def _handle_alive(self, body: dict[str, Any]) -> None:
+        name = body["node"]
+        inc = body["inc"]
+        addr = body.get("addr", "")
+        tags = body.get("tags") or {}
+        with self._lock:
+            if name == self.name:
+                # someone is telling the cluster things about us
+                if inc < self.incarnation:
+                    return
+                if inc >= self.incarnation and (
+                        addr != self.transport.addr
+                        or tags != self._members[self.name].tags):
+                    self._refute(inc)
+                return
+            ns = self._members.get(name)
+            if ns is None:
+                ns = NodeState(name=name, addr=addr, incarnation=inc,
+                               tags=dict(tags), state_change=self._now())
+                self._members[name] = ns
+                self._broadcast("alive", name, m.encode(m.ALIVE, body))
+                self.metrics.incr("memberlist.node.join")
+                self.delegate.notify_join(ns)
+                return
+            # For an existing member, alive applies only with a STRICTLY
+            # higher incarnation (memberlist aliveNode()); equal-inc alive
+            # must not resurrect a suspect/dead record, or push/pull replays
+            # would ping-pong dead members back to life.
+            if inc <= ns.incarnation:
+                return
+            was = ns.status
+            changed_meta = (tags and tags != ns.tags) or (addr and
+                                                          addr != ns.addr)
+            ns.incarnation = inc
+            ns.status = MemberStatus.ALIVE
+            ns.state_change = self._now()
+            if addr:
+                ns.addr = addr
+            if tags:
+                ns.tags = dict(tags)
+            self._cancel_suspicion(name)
+            self._broadcast("alive", name, m.encode(m.ALIVE, body))
+            if was in (MemberStatus.DEAD, MemberStatus.LEFT):
+                self.delegate.notify_join(ns)
+            elif changed_meta:
+                self.delegate.notify_update(ns)
+
+    def _handle_suspect(self, body: dict[str, Any]) -> None:
+        name = body["node"]
+        inc = body["inc"]
+        from_node = body.get("from", "?")
+        with self._lock:
+            if name == self.name:
+                # stale claims (inc below our current) were already beaten
+                # by a prior refutation — ignore, don't churn incarnations
+                if inc < self.incarnation or self._left:
+                    return
+                # Lifeguard: being suspected is a local-health event; refute
+                self._awareness_delta(1)
+                self.metrics.incr("memberlist.refute")
+                self._refute(inc)
+                return
+            ns = self._members.get(name)
+            if ns is None or inc < ns.incarnation:
+                return
+            if ns.status == MemberStatus.SUSPECT:
+                susp = self._suspicions.get(name)
+                if susp is not None:
+                    susp.confirm(from_node)
+                return
+            if ns.status != MemberStatus.ALIVE:
+                return
+            self._suspect_node(name, inc, from_node)
+
+    def _suspect_node(self, name: str, inc: int, from_node: str) -> None:
+        with self._lock:
+            ns = self._members.get(name)
+            if ns is None or ns.status != MemberStatus.ALIVE \
+                    or inc < ns.incarnation:
+                return
+            if name == self.name:
+                return
+            ns.status = MemberStatus.SUSPECT
+            ns.state_change = self._now()
+            n = max(len(self._members), 1)
+            cfg = self.config
+            lh_scale = (self.awareness + 1)
+            min_s = cfg.suspicion_min_timeout(n) * lh_scale
+            max_s = cfg.suspicion_max_timeout(n) * lh_scale \
+                if cfg.suspicion_max_timeout_mult > 1 else min_s
+            self._suspicions[name] = _Suspicion(
+                self, name, k=max(1, cfg.suspicion_mult - 2),
+                min_s=min_s, max_s=max_s)
+            if from_node != self.name:
+                self._suspicions[name].confirmers.add(from_node)
+            self.metrics.incr("memberlist.suspect")
+            self._broadcast("suspect", name, m.encode(m.SUSPECT, {
+                "node": name, "inc": inc, "from": self.name}))
+
+    def _suspicion_timeout(self, name: str) -> None:
+        with self._lock:
+            self._suspicions.pop(name, None)
+            ns = self._members.get(name)
+            if ns is None or ns.status != MemberStatus.SUSPECT:
+                return
+            self.metrics.incr("memberlist.declare_dead")
+            self._dead_node(name, ns.incarnation, left=False)
+
+    def _handle_dead(self, body: dict[str, Any]) -> None:
+        name = body["node"]
+        inc = body["inc"]
+        left = bool(body.get("left"))
+        with self._lock:
+            if name == self.name:
+                # Refute ANY dead/left claim about self unless we really
+                # initiated a leave — a replayed tombstone from a previous
+                # life must not bury a restarted node (memberlist deadNode).
+                if self._left:
+                    return
+                if inc < self.incarnation:
+                    return
+                self._awareness_delta(1)
+                self._refute(inc)
+                return
+            ns = self._members.get(name)
+            if ns is None or inc < ns.incarnation:
+                return
+            self._dead_node(name, inc, left, rebroadcast_body=body)
+
+    def _dead_node(self, name: str, inc: int, left: bool,
+                   rebroadcast_body: Optional[dict] = None) -> None:
+        ns = self._members.get(name)
+        if ns is None:
+            return
+        if ns.status in (MemberStatus.DEAD, MemberStatus.LEFT):
+            return
+        ns.status = MemberStatus.LEFT if left else MemberStatus.DEAD
+        ns.incarnation = inc
+        ns.state_change = self._now()
+        self._cancel_suspicion(name)
+        body = rebroadcast_body or {"node": name, "inc": inc,
+                                    "from": self.name, "left": left}
+        self._broadcast("dead", name, m.encode(m.DEAD, body))
+        self.delegate.notify_leave(ns)
+
+    def _refute(self, claimed_inc: int) -> None:
+        """Broadcast alive-about-self with an incarnation beating the claim."""
+        self.incarnation = max(self.incarnation, claimed_inc) + 1
+        me = self._members[self.name]
+        me.incarnation = self.incarnation
+        me.status = MemberStatus.ALIVE
+        self._broadcast_alive(me)
+
+    def _broadcast_alive(self, ns: NodeState) -> None:
+        self._broadcast("alive", ns.name, m.encode(m.ALIVE, {
+            "node": ns.name, "inc": ns.incarnation, "addr": ns.addr,
+            "tags": ns.tags}))
+
+    def _broadcast(self, kind: str, subject: str, payload: bytes) -> None:
+        self._queue.queue(f"{kind}:{subject}", payload)
+
+    def _awareness_delta(self, d: int) -> None:
+        self.awareness = max(
+            0, min(self.config.awareness_max_multiplier, self.awareness + d))
+        self.metrics.gauge("memberlist.health.score", self.awareness)
+
+    def _cancel_suspicion(self, name: str) -> None:
+        susp = self._suspicions.pop(name, None)
+        if susp is not None:
+            susp.cancel()
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    # ------------------------------------------------------------ gossiping
+
+    def _gossip_tick(self) -> None:
+        cfg = self.config
+        with self._lock:
+            now = self._now()
+            targets = [ns for n, ns in self._members.items()
+                       if n != self.name and (
+                           ns.status in (MemberStatus.ALIVE,
+                                         MemberStatus.SUSPECT)
+                           or (ns.status == MemberStatus.DEAD
+                               and now - ns.state_change
+                               < cfg.gossip_to_the_dead_time))]
+        if not targets:
+            return
+        self.rng.shuffle(targets)
+        for tgt in targets[: cfg.gossip_nodes]:
+            batch = self._queue.get_batch(max(self.num_alive(), 1),
+                                          MAX_PACKET_SIZE - 16)
+            if not batch:
+                return
+            payload = batch[0] if len(batch) == 1 else m.make_compound(batch)
+            if self.keyring is not None:
+                payload = self.keyring.encrypt(payload)
+            self.transport.send_packet(tgt.addr, payload)
+            self.metrics.incr("memberlist.gossip.sent")
+
+    # ------------------------------------------------------------- push/pull
+
+    def _push_pull_tick(self) -> None:
+        with self._lock:
+            peers = [ns for n, ns in self._members.items()
+                     if n != self.name and ns.status == MemberStatus.ALIVE]
+        if not peers:
+            return
+        peer = self.rng.choice(peers)
+        try:
+            self._push_pull(peer.addr, join=False)
+            self.metrics.incr("memberlist.push_pull")
+        except Exception as e:  # noqa: BLE001
+            self.log.debug("push/pull with %s failed: %s", peer.addr, e)
+
+    def _push_pull(self, addr: str, join: bool) -> None:
+        with self._lock:
+            local = [ns.snapshot() for ns in self._members.values()]
+        req = m.encode(m.PUSH_PULL, {"nodes": local, "join": join,
+                                     "from": self.name})
+        if self.keyring is not None:
+            req = self.keyring.encrypt(req)
+        resp = self.transport.stream_rpc(addr, req)
+        if self.keyring is not None:
+            resp = self.keyring.decrypt(resp)
+        t, body = m.decode(resp)
+        if t != m.PUSH_PULL:
+            raise ValueError(f"unexpected push/pull reply type {t}")
+        if "error" in body:
+            raise ConnectionError(f"merge rejected: {body['error']}")
+        self._merge_state(body.get("nodes") or [])
+
+    def _on_stream(self, src: str, raw: bytes) -> bytes:
+        try:
+            if self.keyring is not None:
+                raw = self.keyring.decrypt(raw)
+            t, body = m.decode(raw)
+            if t == m.PUSH_PULL:
+                peers = [NodeState(name=d["name"], addr=d["addr"],
+                                   incarnation=d["inc"],
+                                   status=MemberStatus(d["status"]),
+                                   tags=d.get("tags") or {})
+                         for d in body.get("nodes") or []]
+                err = self.delegate.notify_merge(peers) if body.get("join") \
+                    else None
+                if err:
+                    reply = m.encode(m.PUSH_PULL, {"error": err})
+                else:
+                    with self._lock:
+                        local = [ns.snapshot()
+                                 for ns in self._members.values()]
+                    reply = m.encode(m.PUSH_PULL,
+                                     {"nodes": local, "from": self.name})
+                    self._merge_state(body.get("nodes") or [])
+                if self.keyring is not None:
+                    reply = self.keyring.encrypt(reply)
+                return reply
+            if t == m.PING:
+                reply = m.encode(m.ACK, {
+                    "seq": body["seq"],
+                    "payload": self.delegate.ack_payload()})
+                if self.keyring is not None:
+                    reply = self.keyring.encrypt(reply)
+                return reply
+            raise ValueError(f"unexpected stream type {t}")
+        except Exception as e:
+            self.log.warning("stream error from %s: %s", src, e)
+            raise
+
+    def _merge_state(self, nodes: list[dict[str, Any]]) -> None:
+        """Replay remote states through the normal handlers (memberlist
+        mergeRemoteState) so incarnation ordering resolves conflicts."""
+        for d in nodes:
+            status = MemberStatus(d["status"])
+            body = {"node": d["name"], "inc": d["inc"], "addr": d["addr"],
+                    "tags": d.get("tags") or {}}
+            if status in (MemberStatus.ALIVE, MemberStatus.SUSPECT):
+                self._handle_alive(body)
+                if status == MemberStatus.SUSPECT:
+                    self._handle_suspect({"node": d["name"], "inc": d["inc"],
+                                          "from": "push-pull"})
+            elif status == MemberStatus.LEFT:
+                self._handle_dead({"node": d["name"], "inc": d["inc"],
+                                   "left": True, "from": "push-pull"})
+            elif status == MemberStatus.DEAD:
+                # spare a freshly-seen dead rumor the full suspicion dance
+                self._handle_dead({"node": d["name"], "inc": d["inc"],
+                                   "left": False, "from": "push-pull"})

@@ -1,0 +1,256 @@
+"""Transport seam: how gossip packets and streams reach peers.
+
+Mirrors memberlist's Transport/NodeAwareTransport plugin interface (the
+seam the reference consumes at agent/consul/server_serf.go:188-212 and
+proves pluggable with wanfed). Implementations here:
+
+  * InMemTransport — deterministic in-process network with loss/latency
+    injection, driven by a SimClock (how the reference tests multi-node
+    logic in one process, SURVEY.md §4);
+  * UDPTransport — real sockets for live agents (UDP packets + TCP
+    streams for push/pull).
+
+Packets are length-limited datagrams (UDP semantics); streams are
+reliable byte channels used for push/pull state sync and fallback pings.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional
+
+from consul_tpu.utils import log
+from consul_tpu.utils.clock import Clock, SimClock
+
+#: max gossip packet payload (memberlist UDPBufferSize-ish)
+MAX_PACKET_SIZE = 1400
+
+PacketHandler = Callable[[str, bytes], None]      # (from_addr, payload)
+StreamHandler = Callable[[str, bytes], bytes]     # (from_addr, req) -> resp
+
+
+class Transport:
+    """Abstract transport. Addresses are opaque strings ("host:port")."""
+
+    addr: str
+
+    def set_handlers(self, on_packet: PacketHandler,
+                     on_stream: StreamHandler) -> None:
+        raise NotImplementedError
+
+    def send_packet(self, addr: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def stream_rpc(self, addr: str, payload: bytes,
+                   timeout: float = 10.0) -> bytes:
+        """Reliable request/response exchange (push/pull, fallback ping)."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class InMemNetwork:
+    """Registry of in-memory transports with fault injection.
+
+    Deterministic when driven by a SimClock and a seeded RNG: packet
+    delivery is scheduled as a clock timer at now+latency; loss and
+    partitions drop packets. This is the test vehicle for SWIM semantics
+    (deterministic-clock validation, SURVEY.md §7 stage 2).
+    """
+
+    def __init__(self, clock: Optional[SimClock] = None, seed: int = 0,
+                 loss: float = 0.0, latency: float = 0.001) -> None:
+        self.clock = clock or SimClock()
+        self.rng = random.Random(seed)
+        self.loss = loss
+        self.latency = latency
+        self.transports: dict[str, "InMemTransport"] = {}
+        self._partitions: list[tuple[set[str], set[str]]] = []
+        self.log = log.named("memberlist.net")
+
+    def attach(self, addr: str) -> "InMemTransport":
+        t = InMemTransport(self, addr)
+        self.transports[addr] = t
+        return t
+
+    def partition(self, a: set[str], b: set[str]) -> None:
+        """Drop all traffic between address sets a and b."""
+        self._partitions.append((set(a), set(b)))
+
+    def heal(self) -> None:
+        self._partitions.clear()
+
+    def _blocked(self, src: str, dst: str) -> bool:
+        for a, b in self._partitions:
+            if (src in a and dst in b) or (src in b and dst in a):
+                return True
+        return False
+
+    def deliver_packet(self, src: str, dst: str, payload: bytes) -> None:
+        if self._blocked(src, dst) or self.rng.random() < self.loss:
+            return
+        tgt = self.transports.get(dst)
+        if tgt is None or tgt.closed:
+            return
+        jitter = self.latency * (0.5 + self.rng.random())
+        self.clock.after(jitter, lambda: tgt._dispatch_packet(src, payload))
+
+    def stream(self, src: str, dst: str, payload: bytes) -> bytes:
+        if self._blocked(src, dst):
+            raise ConnectionError(f"partitioned: {src} -> {dst}")
+        tgt = self.transports.get(dst)
+        if tgt is None or tgt.closed or tgt._on_stream is None:
+            raise ConnectionError(f"connection refused: {dst}")
+        return tgt._on_stream(src, payload)
+
+
+class InMemTransport(Transport):
+    def __init__(self, net: InMemNetwork, addr: str) -> None:
+        self.net = net
+        self.addr = addr
+        self.closed = False
+        self._on_packet: Optional[PacketHandler] = None
+        self._on_stream: Optional[StreamHandler] = None
+
+    def set_handlers(self, on_packet: PacketHandler,
+                     on_stream: StreamHandler) -> None:
+        self._on_packet = on_packet
+        self._on_stream = on_stream
+
+    def send_packet(self, addr: str, payload: bytes) -> None:
+        if len(payload) > MAX_PACKET_SIZE:
+            raise ValueError(f"packet too large: {len(payload)}")
+        if not self.closed:
+            self.net.deliver_packet(self.addr, addr, payload)
+
+    def stream_rpc(self, addr: str, payload: bytes,
+                   timeout: float = 10.0) -> bytes:
+        if self.closed:
+            raise ConnectionError("transport closed")
+        return self.net.stream(self.addr, addr, payload)
+
+    def _dispatch_packet(self, src: str, payload: bytes) -> None:
+        if not self.closed and self._on_packet is not None:
+            self._on_packet(src, payload)
+
+    def shutdown(self) -> None:
+        self.closed = True
+
+
+class UDPTransport(Transport):
+    """Real-socket transport: UDP for packets, TCP for streams.
+
+    Stream framing: 4-byte big-endian length prefix both directions.
+    """
+
+    def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0) -> None:
+        self.log = log.named("memberlist.transport")
+        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._udp.bind((bind_addr, port))
+        port = self._udp.getsockname()[1]
+
+        self._on_packet: Optional[PacketHandler] = None
+        self._on_stream: Optional[StreamHandler] = None
+        outer = self
+
+        class _TCPHandler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    req = _read_frame(self.request)
+                    if req is None or outer._on_stream is None:
+                        return
+                    resp = outer._on_stream(
+                        f"{self.client_address[0]}:{self.client_address[1]}",
+                        req)
+                    _write_frame(self.request, resp)
+                except Exception as e:  # noqa: BLE001
+                    outer.log.debug("stream handler error: %s", e)
+
+        class _TCPServer(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = _TCPServer((bind_addr, port), _TCPHandler)
+        self.addr = f"{bind_addr}:{port}"
+        self.closed = False
+
+        self._udp_thread = threading.Thread(
+            target=self._udp_loop, name=f"udp-{port}", daemon=True)
+        self._tcp_thread = threading.Thread(
+            target=self._tcp.serve_forever, name=f"tcp-{port}", daemon=True)
+
+    def set_handlers(self, on_packet: PacketHandler,
+                     on_stream: StreamHandler) -> None:
+        self._on_packet = on_packet
+        self._on_stream = on_stream
+        if not self._udp_thread.is_alive():
+            self._udp_thread.start()
+            self._tcp_thread.start()
+
+    def _udp_loop(self) -> None:
+        while not self.closed:
+            try:
+                data, src = self._udp.recvfrom(65536)
+            except OSError:
+                return
+            if self._on_packet is not None:
+                try:
+                    self._on_packet(f"{src[0]}:{src[1]}", data)
+                except Exception as e:  # noqa: BLE001
+                    self.log.warning("packet handler error: %s", e)
+
+    def send_packet(self, addr: str, payload: bytes) -> None:
+        host, port = addr.rsplit(":", 1)
+        try:
+            self._udp.sendto(payload, (host, int(port)))
+        except OSError as e:
+            self.log.debug("send_packet to %s failed: %s", addr, e)
+
+    def stream_rpc(self, addr: str, payload: bytes,
+                   timeout: float = 10.0) -> bytes:
+        host, port = addr.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=timeout) as s:
+            s.settimeout(timeout)
+            _write_frame(s, payload)
+            resp = _read_frame(s)
+            if resp is None:
+                raise ConnectionError("stream closed before response")
+            return resp
+
+    def shutdown(self) -> None:
+        self.closed = True
+        try:
+            self._udp.close()
+        except OSError:
+            pass
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+def _read_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (ln,) = struct.unpack(">I", hdr)
+    if ln > 64 * 1024 * 1024:
+        raise ValueError(f"frame too large: {ln}")
+    return _read_exact(sock, ln)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _write_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(payload)) + payload)

@@ -1,0 +1,241 @@
+"""Host SWIM/serf engine tests on a deterministic virtual clock.
+
+Mirrors how the reference tests multi-server gossip in one process
+(agent/consul/*_test.go over loopback serf — SURVEY.md §4), but fully
+deterministic: an InMemNetwork with seeded loss/latency driven by a
+SimClock, so suspicion timers and probe cycles fire reproducibly.
+"""
+
+import pytest
+
+from consul_tpu.config import GossipConfig
+from consul_tpu.gossip import InMemNetwork, Serf
+from consul_tpu.gossip.messages import Keyring
+from consul_tpu.gossip.serf import EventType
+from consul_tpu.types import MemberStatus
+
+
+def make_cluster(n, cfg=None, loss=0.0, seed=0, keys=None, net=None):
+    cfg = cfg or GossipConfig.local()
+    net = net or InMemNetwork(seed=seed, loss=loss, latency=0.001)
+    serfs, events = [], []
+    for i in range(n):
+        ev = []
+        t = net.attach(f"127.0.0.1:{8000 + i}")
+        s = Serf(f"node{i}", t, config=cfg, event_handler=ev.append,
+                 clock=net.clock, seed=i,
+                 keyring=Keyring(keys) if keys else None)
+        s.start()
+        serfs.append(s)
+        events.append(ev)
+    for s in serfs[1:]:
+        assert s.join([serfs[0].memberlist.transport.addr]) == 1
+    return net, serfs, events
+
+
+def alive_names(serf):
+    return {ns.name for ns in serf.members()
+            if ns.status == MemberStatus.ALIVE}
+
+
+def test_three_node_cluster_converges():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    for s in serfs:
+        assert alive_names(s) == {"node0", "node1", "node2"}
+    # join events observed on the seed node for both joiners
+    joined = {ev.members[0].name for ev in events[0]
+              if ev.type == EventType.MEMBER_JOIN}
+    assert {"node1", "node2"} <= joined
+
+
+def test_failure_detection_flow():
+    net, serfs, events = make_cluster(4)
+    net.clock.advance(2.0)
+    victim = serfs[3]
+    victim.memberlist.transport.closed = True  # crash, no goodbye
+    net.clock.advance(15.0)
+    for s in serfs[:3]:
+        st = {ns.name: ns.status for ns in s.members(include_left=True)}
+        assert st["node3"] in (MemberStatus.DEAD,), st
+    failed = [ev for ev in events[0] if ev.type == EventType.MEMBER_FAILED]
+    assert any(ev.members[0].name == "node3" for ev in failed)
+
+
+def test_graceful_leave_is_not_failure():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    serfs[2].leave()
+    net.clock.advance(5.0)
+    leaves = [ev for ev in events[0] if ev.type == EventType.MEMBER_LEAVE]
+    fails = [ev for ev in events[0] if ev.type == EventType.MEMBER_FAILED]
+    assert any(ev.members[0].name == "node2" for ev in leaves)
+    assert not any(ev.members[0].name == "node2" for ev in fails)
+
+
+def test_partition_refutation_heals():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    # isolate node2; others will suspect it
+    net.partition({serfs[2].memberlist.transport.addr},
+                  {serfs[0].memberlist.transport.addr,
+                   serfs[1].memberlist.transport.addr})
+    net.clock.advance(1.0)
+    statuses = {ns.name: ns.status for ns in serfs[0].members()}
+    # heal before suspicion timeout expires; refutation must revive it
+    net.heal()
+    net.clock.advance(10.0)
+    st = {ns.name: ns.status
+          for ns in serfs[0].members(include_left=True)}
+    assert st["node2"] == MemberStatus.ALIVE
+    # the refutation bumped node2's incarnation if it was ever suspected
+    inc = {ns.name: ns.incarnation
+           for ns in serfs[0].members(include_left=True)}
+    assert inc["node2"] >= 0
+
+
+def test_tag_update_propagates():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    serfs[1].set_tags({"role": "consul", "dc": "dc1"})
+    net.clock.advance(3.0)
+    for s in (serfs[0], serfs[2]):
+        tags = {ns.name: ns.tags for ns in s.members()}
+        assert tags["node1"].get("role") == "consul"
+    updates = [ev for ev in events[0] if ev.type == EventType.MEMBER_UPDATE]
+    assert any(ev.members[0].name == "node1" for ev in updates)
+
+
+def test_user_events_flood_and_dedup():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    serfs[0].user_event("deploy", b"v1.2.3")
+    net.clock.advance(3.0)
+    for i, evs in enumerate(events):
+        user = [ev for ev in evs if ev.type == EventType.USER]
+        assert len(user) == 1, f"node{i} saw {len(user)} copies"
+        assert user[0].name == "deploy" and user[0].payload == b"v1.2.3"
+
+
+def test_late_joiner_gets_full_state_via_push_pull():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    serfs[0].user_event("x", b"1")
+    t = net.attach("127.0.0.1:9000")
+    late = Serf("late", t, config=GossipConfig.local(), clock=net.clock,
+                seed=99)
+    late.start()
+    late.join([serfs[1].memberlist.transport.addr])
+    net.clock.advance(2.0)
+    assert alive_names(late) == {"node0", "node1", "node2", "late"}
+    for s in serfs:
+        assert "late" in alive_names(s)
+
+
+def test_lossy_network_still_converges():
+    net, serfs, events = make_cluster(5, loss=0.20)
+    net.clock.advance(10.0)
+    for s in serfs:
+        assert alive_names(s) == {f"node{i}" for i in range(5)}
+    # no live node may end up declared dead for good
+    net.clock.advance(30.0)
+    for s in serfs:
+        st = {ns.name: ns.status for ns in s.members(include_left=True)}
+        dead = [n for n, v in st.items() if v == MemberStatus.DEAD]
+        assert not dead, f"{s.name} wrongly declared {dead}"
+
+
+def test_encrypted_cluster_and_plaintext_rejection():
+    key = b"0123456789abcdef"
+    net, serfs, events = make_cluster(3, keys=[key])
+    net.clock.advance(2.0)
+    for s in serfs:
+        assert alive_names(s) == {"node0", "node1", "node2"}
+    # a keyless node cannot join the encrypted pool
+    t = net.attach("127.0.0.1:9100")
+    intruder = Serf("intruder", t, config=GossipConfig.local(),
+                    clock=net.clock, seed=7)
+    intruder.start()
+    assert intruder.join([serfs[0].memberlist.transport.addr]) == 0
+
+
+def test_reap_failed_member():
+    cfg = GossipConfig.local()
+    from dataclasses import replace
+
+    cfg = replace(cfg, reconnect_timeout=5.0)
+    net, serfs, events = make_cluster(3, cfg=cfg)
+    net.clock.advance(2.0)
+    serfs[2].memberlist.transport.closed = True
+    net.clock.advance(30.0)
+    names0 = {ns.name for ns in serfs[0].members(include_left=True)}
+    assert "node2" not in names0
+    reaps = [ev for ev in events[0] if ev.type == EventType.MEMBER_REAP]
+    assert any(ev.members[0].name == "node2" for ev in reaps)
+
+
+def test_coordinates_reflect_latency():
+    net, serfs, events = make_cluster(3)
+    # many probe cycles to converge the Vivaldi springs
+    net.clock.advance(60.0)
+    rtt = serfs[0].rtt("node1")
+    assert rtt is not None and rtt > 0
+    # in-mem latency is ~1ms ±50%; coordinate estimate within 50x
+    assert rtt < 0.1
+
+
+def test_incarnation_monotonic_and_refute_on_stale_claim():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    ml = serfs[0].memberlist
+    inc0 = ml.incarnation
+    # inject a bogus suspect-about-node0 directly
+    from consul_tpu.gossip import messages as m
+
+    ml._handle_msg("127.0.0.1:8001", m.encode(m.SUSPECT, {
+        "node": "node0", "inc": inc0, "from": "node1"}))
+    assert ml.incarnation > inc0  # refuted with a higher incarnation
+    net.clock.advance(2.0)
+    st = {ns.name: ns.status for ns in serfs[1].members()}
+    assert st["node0"] == MemberStatus.ALIVE
+
+
+def test_oversized_user_event_rejected():
+    net, serfs, events = make_cluster(2)
+    net.clock.advance(1.0)
+    with pytest.raises(ValueError, match="too large"):
+        serfs[0].user_event("big", b"x" * 5000)
+
+
+def test_user_event_floods_large_cluster_via_relay():
+    # 20 nodes: the originator's retransmit budget alone cannot reach
+    # everyone; receivers must relay (serf re-queues received events).
+    net, serfs, events = make_cluster(20)
+    net.clock.advance(5.0)
+    serfs[0].user_event("deploy", b"v2")
+    net.clock.advance(5.0)
+    missing = [i for i, evs in enumerate(events)
+               if not any(ev.type == EventType.USER for ev in evs)]
+    assert not missing, f"nodes {missing} never saw the event"
+
+
+def test_restart_after_leave_rejoins_despite_tombstone():
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    addr2 = serfs[2].memberlist.transport.addr
+    serfs[2].leave()
+    serfs[2].shutdown()
+    net.clock.advance(3.0)
+    # restart with a fresh engine (incarnation 0) on the same name/addr
+    net.transports.pop(addr2, None)
+    t = net.attach(addr2)
+    reborn = Serf("node2", t, config=GossipConfig.local(),
+                  clock=net.clock, seed=42)
+    reborn.start()
+    assert reborn.join([serfs[0].memberlist.transport.addr]) == 1
+    net.clock.advance(10.0)
+    # the replayed LEFT tombstone must not bury the restarted node
+    assert reborn.memberlist._members["node2"].status == MemberStatus.ALIVE
+    for s in serfs[:2]:
+        st = {ns.name: ns.status for ns in s.members(include_left=True)}
+        assert st["node2"] == MemberStatus.ALIVE, st
