@@ -1,0 +1,19 @@
+"""Raft consensus (reference: external hashicorp/raft + raft-wal, wired
+in at agent/consul/server.go:917 setupRaft).
+
+Host-side subsystem — consensus has no TPU role (SURVEY.md §7 stage 4).
+A clean single-decree-pipeline Raft: leader election with randomized
+timeouts, log replication with conflict rollback, commitment rules
+(current-term majority), persistent term/vote + WAL log, snapshots with
+log compaction, and single-server membership changes, all behind a
+transport seam (in-memory for deterministic tests; the server RPC layer
+carries it between real agents the way the reference's RaftLayer rides
+the multiplexed port byte RPCRaft, agent/pool/conn.go:36).
+"""
+
+from consul_tpu.raft.raft import RaftNode, Role
+from consul_tpu.raft.transport import InMemRaftNetwork, RaftTransport
+from consul_tpu.raft.storage import RaftStorage
+
+__all__ = ["RaftNode", "Role", "InMemRaftNetwork", "RaftTransport",
+           "RaftStorage"]

@@ -1,0 +1,565 @@
+"""Raft consensus core: elections, replication, commitment, snapshots.
+
+The protocol engine behind every strongly-consistent subsystem (catalog,
+KV, sessions — the reference's raftApply path, agent/consul/rpc.go:926).
+Runs against the Clock/scheduler seam (deterministic with SimClock) and
+the RaftTransport seam.
+
+Simplifications vs hashicorp/raft, deliberate for round 1:
+  * RPCs are synchronous calls on the caller's thread (the in-mem
+    transport is instant; the TCP transport blocks its caller);
+  * replication is push-on-heartbeat + push-on-apply;
+  * membership changes are single-server config entries.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Callable, Optional
+
+from consul_tpu.raft.storage import RaftStorage
+from consul_tpu.raft.transport import RaftTransport
+from consul_tpu.utils import log, telemetry
+from consul_tpu.utils.clock import Clock, RealTimers, SimClock
+
+
+class Role(enum.Enum):
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+
+class NotLeader(Exception):
+    def __init__(self, leader: Optional[str]) -> None:
+        super().__init__(f"not leader (leader hint: {leader})")
+        self.leader = leader
+
+
+class ApplyTimeout(Exception):
+    pass
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: str,
+        transport: RaftTransport,
+        apply_fn: Callable[[bytes, int], Any],
+        peers: Optional[list[str]] = None,
+        storage: Optional[RaftStorage] = None,
+        clock: Optional[Clock] = None,
+        scheduler=None,
+        heartbeat_interval: float = 0.1,
+        election_timeout: float = 0.5,
+        snapshot_threshold: int = 16384,
+        snapshot_fn: Optional[Callable[[], bytes]] = None,
+        restore_fn: Optional[Callable[[bytes], None]] = None,
+        seed: Optional[int] = None,
+    ) -> None:
+        import random
+
+        self.id = node_id
+        self.transport = transport
+        self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.store = storage or RaftStorage()
+        self.log = log.named(f"raft.{node_id}")
+        self.metrics = telemetry.default
+        self.clock = clock or Clock()
+        if scheduler is not None:
+            self.scheduler = scheduler
+        elif isinstance(self.clock, SimClock):
+            self.scheduler = self.clock
+        else:
+            self.scheduler = RealTimers()
+        self.rng = random.Random(seed if seed is not None
+                                 else hash(node_id) & 0xFFFFFFFF)
+
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        self.snapshot_threshold = snapshot_threshold
+
+        self._lock = threading.RLock()
+        self._applied_cv = threading.Condition(self._lock)
+        self.role = Role.FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.commit_index = self.store.snapshot_index
+        self.last_applied = self.store.snapshot_index
+        # configuration: voting members (including self), from log or static
+        self.peers: set[str] = set(peers or []) | {transport.addr}
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+        self._election_timer = None
+        self._heartbeat_timer = None
+        self._stopped = False
+        self._last_leader_contact = 0.0
+        self._apply_results: dict[int, Any] = {}
+
+        # restore FSM from snapshot if present
+        if self.store.snapshot_data is not None and restore_fn is not None:
+            restore_fn(self.store.snapshot_data)
+
+        transport.set_handler(self._handle_rpc)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._reset_election_timer()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+            for t in (self._election_timer, self._heartbeat_timer):
+                if t is not None:
+                    t.cancel()
+            self.store.close()
+            self._applied_cv.notify_all()
+
+    # ------------------------------------------------------------- surface
+
+    def is_leader(self) -> bool:
+        return self.role == Role.LEADER
+
+    def leader(self) -> Optional[str]:
+        return self.transport.addr if self.is_leader() else self.leader_id
+
+    def apply(self, data: bytes, timeout: float = 10.0) -> Any:
+        """Replicate one command; returns the FSM's apply result.
+
+        Raises NotLeader on followers (reference: callers forward to the
+        leader, rpc.go:637 ForwardRPC), and if the FSM handler raised, its
+        exception propagates here rather than being returned as a value.
+        """
+        with self._lock:
+            if self.role != Role.LEADER or self._stopped:
+                raise NotLeader(self.leader_id)
+            term = self.store.term
+            entry = {"term": term, "data": data, "kind": "cmd"}
+            self.store.append([entry])
+            index = self.store.last_index()
+            self.metrics.incr("raft.apply")
+        self._replicate_all()
+        # wait for the entry to be applied locally
+        deadline = self.clock.now() + timeout
+        with self._lock:
+            while self.last_applied < index and not self._stopped:
+                if isinstance(self.clock, SimClock):
+                    raise ApplyTimeout(
+                        f"index {index} not committed (commit="
+                        f"{self.commit_index}); sim-clock apply cannot block")
+                remaining = deadline - self.clock.now()
+                if remaining <= 0:
+                    raise ApplyTimeout(f"apply index {index} timed out")
+                self._applied_cv.wait(remaining)
+            if self._stopped and self.last_applied < index:
+                raise ApplyTimeout("node stopped")
+            # a new leader may have overwritten our uncommitted entry —
+            # success only if OUR entry (same term) survived at `index`
+            if self.store.term_at(index) != term:
+                raise NotLeader(self.leader_id)
+            result = self._apply_results.pop(index, None)
+            if isinstance(result, Exception):
+                raise result
+            return result
+
+    def barrier(self, timeout: float = 10.0) -> None:
+        """Commit an empty entry and wait for it: asserts leadership and
+        gives a linearizable read point (hashicorp/raft Barrier)."""
+        self.apply(b"", timeout=timeout)
+
+    def apply_noop(self) -> None:
+        with self._lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            self.store.append([{"term": self.store.term, "data": b"",
+                                "kind": "noop"}])
+        self._replicate_all()
+
+    def add_peer(self, addr: str) -> None:
+        """Single-server membership change (AddVoter)."""
+        with self._lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            if addr in self.peers:
+                return
+            self.store.append([{"term": self.store.term, "kind": "config",
+                                "data": b"", "add": addr}])
+            self.peers.add(addr)
+            self._next_index[addr] = self.store.first_index()
+            self._match_index[addr] = 0
+        self._replicate_all()
+
+    def remove_peer(self, addr: str) -> None:
+        with self._lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            if addr not in self.peers:
+                return
+            self.store.append([{"term": self.store.term, "kind": "config",
+                                "data": b"", "remove": addr}])
+            self.peers.discard(addr)
+            self._next_index.pop(addr, None)
+            self._match_index.pop(addr, None)
+        self._replicate_all()
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self.role.value, "term": self.store.term,
+                "last_log_index": self.store.last_index(),
+                "commit_index": self.commit_index,
+                "applied_index": self.last_applied,
+                "leader": self.leader(),
+                "num_peers": len(self.peers) - 1,
+                "peers": sorted(self.peers),
+            }
+
+    # ------------------------------------------------------------ elections
+
+    def _reset_election_timer(self) -> None:
+        if self._election_timer is not None:
+            self._election_timer.cancel()
+        timeout = self.election_timeout * (1.0 + self.rng.random())
+        self._election_timer = self.scheduler.after(
+            timeout, self._election_timeout)
+
+    def _election_timeout(self) -> None:
+        if self._stopped or self.role == Role.LEADER:
+            return
+        self._start_election()
+
+    def _start_election(self) -> None:
+        # RPCs happen OUTSIDE the lock (a simultaneous election on a real
+        # thread must not AB-BA deadlock two nodes' locks)
+        with self._lock:
+            self.role = Role.CANDIDATE
+            self.store.set_term_vote(self.store.term + 1, self.id)
+            term = self.store.term
+            self.leader_id = None
+            last_idx = self.store.last_index()
+            last_term = self.store.term_at(last_idx)
+            peers = [p for p in self.peers if p != self.transport.addr]
+            self._reset_election_timer()
+        self.metrics.incr("raft.election.start")
+        self.log.info("starting election for term %d", term)
+        votes = 1  # self-vote
+        for peer in peers:
+            try:
+                reply = self.transport.call(peer, "request_vote", {
+                    "term": term, "candidate": self.id,
+                    "candidate_addr": self.transport.addr,
+                    "last_log_index": last_idx, "last_log_term": last_term})
+            except Exception:  # noqa: BLE001 — unreachable peer
+                continue
+            with self._lock:
+                if self._stopped or self.role != Role.CANDIDATE \
+                        or self.store.term != term:
+                    return
+                if reply.get("term", 0) > term:
+                    self._step_down(reply["term"])
+                    return
+            if reply.get("granted"):
+                votes += 1
+        with self._lock:
+            if self._stopped or self.role != Role.CANDIDATE \
+                    or self.store.term != term:
+                return
+            if votes * 2 > len(self.peers):
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.role = Role.LEADER
+        self.leader_id = self.transport.addr
+        self.metrics.incr("raft.election.won")
+        self.log.info("won election for term %d", self.store.term)
+        nxt = self.store.last_index() + 1
+        for p in self.peers:
+            self._next_index[p] = nxt
+            self._match_index[p] = 0
+        if self._election_timer is not None:
+            self._election_timer.cancel()
+        # commit a no-op to learn the commit frontier of prior terms
+        self.store.append([{"term": self.store.term, "data": b"",
+                            "kind": "noop"}])
+        self._replicate_all()
+        self._schedule_heartbeat()
+
+    def _step_down(self, term: int) -> None:
+        if term > self.store.term:
+            self.store.set_term_vote(term, None)
+        was_leader = self.role == Role.LEADER
+        self.role = Role.FOLLOWER
+        if was_leader and self._heartbeat_timer is not None:
+            self._heartbeat_timer.cancel()
+        self._reset_election_timer()
+
+    # ---------------------------------------------------------- replication
+
+    def _schedule_heartbeat(self) -> None:
+        if self._heartbeat_timer is not None:
+            self._heartbeat_timer.cancel()
+
+        def beat() -> None:
+            with self._lock:
+                if self._stopped or self.role != Role.LEADER:
+                    return
+            self._replicate_all()
+            with self._lock:
+                if not self._stopped and self.role == Role.LEADER:
+                    self._schedule_heartbeat()
+
+        self._heartbeat_timer = self.scheduler.after(
+            self.heartbeat_interval, beat)
+
+    def _replicate_all(self) -> None:
+        with self._lock:
+            if self.role != Role.LEADER:
+                return
+            peers = [p for p in self.peers if p != self.transport.addr]
+        if isinstance(self.clock, SimClock) or len(peers) <= 1:
+            for peer in peers:
+                self._replicate_one(peer)
+        else:
+            # real mode: per-peer RPCs run concurrently so one dead peer's
+            # connect timeout cannot starve heartbeats to healthy peers
+            threads = [threading.Thread(target=self._replicate_one,
+                                        args=(p,), daemon=True)
+                       for p in peers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=self.heartbeat_interval * 4)
+        self._advance_commit()
+
+    def _replicate_one(self, peer: str) -> None:
+        send_snap = False
+        with self._lock:
+            if self.role != Role.LEADER:
+                return
+            term = self.store.term
+            nxt = self._next_index.get(peer, self.store.last_index() + 1)
+            send_snap = nxt < self.store.first_index()
+        if send_snap:
+            self._send_snapshot(peer)
+            return
+        with self._lock:
+            if self.role != Role.LEADER:
+                return
+            prev_idx = nxt - 1
+            prev_term = self.store.term_at(prev_idx)
+            entries = self.store.entries_from(nxt)
+            args = {
+                "term": term, "leader": self.transport.addr,
+                "prev_log_index": prev_idx, "prev_log_term": prev_term,
+                "entries": entries, "leader_commit": self.commit_index,
+            }
+        try:
+            reply = self.transport.call(peer, "append_entries", args)
+        except Exception:  # noqa: BLE001 — peer unreachable
+            return
+        with self._lock:
+            if self._stopped or self.store.term != term \
+                    or self.role != Role.LEADER:
+                return
+            if reply.get("term", 0) > term:
+                self._step_down(reply["term"])
+                return
+            if reply.get("success"):
+                if entries:
+                    match = prev_idx + len(entries)
+                    self._match_index[peer] = max(
+                        self._match_index.get(peer, 0), match)
+                    self._next_index[peer] = match + 1
+            else:
+                # conflict rollback, optionally accelerated by hint
+                hint = reply.get("conflict_index")
+                self._next_index[peer] = max(
+                    1, hint if hint else nxt - 1)
+
+    def _send_snapshot(self, peer: str) -> None:
+        # prepare under lock, RPC outside it (same discipline as
+        # _replicate_one — a blocked install must not freeze the node)
+        with self._lock:
+            snap_data = self.store.snapshot_data
+            if snap_data is None and self.snapshot_fn is not None:
+                self._take_snapshot()
+                snap_data = self.store.snapshot_data
+            if snap_data is None:
+                return
+            args = {"term": self.store.term, "leader": self.transport.addr,
+                    "last_index": self.store.snapshot_index,
+                    "last_term": self.store.snapshot_term,
+                    "data": snap_data}
+        try:
+            reply = self.transport.call(peer, "install_snapshot", args)
+        except Exception:  # noqa: BLE001
+            return
+        with self._lock:
+            if reply.get("term", 0) > self.store.term:
+                self._step_down(reply["term"])
+                return
+            self._next_index[peer] = self.store.snapshot_index + 1
+            self._match_index[peer] = self.store.snapshot_index
+
+    def _advance_commit(self) -> None:
+        with self._lock:
+            if self.role != Role.LEADER:
+                return
+            for idx in range(self.store.last_index(), self.commit_index, -1):
+                if self.store.term_at(idx) != self.store.term:
+                    break  # only current-term entries commit by counting
+                votes = 1 + sum(
+                    1 for p, mi in self._match_index.items()
+                    if p != self.transport.addr and mi >= idx)
+                if votes * 2 > len(self.peers):
+                    self.commit_index = idx
+                    break
+            self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            idx = self.last_applied + 1
+            e = self.store.entry(idx)
+            if e is None:
+                break
+            if e["kind"] == "cmd" and e["data"]:
+                try:
+                    result = self.apply_fn(e["data"], idx)
+                except Exception as ex:  # noqa: BLE001
+                    self.log.error("fsm apply failed at %d: %s", idx, ex)
+                    result = ex
+                if self.role == Role.LEADER:
+                    self._apply_results[idx] = result
+                    if len(self._apply_results) > 4096:
+                        for k in sorted(self._apply_results)[:1024]:
+                            self._apply_results.pop(k, None)
+            elif e["kind"] == "config":
+                if e.get("add"):
+                    self.peers.add(e["add"])
+                    if self.role == Role.LEADER and \
+                            e["add"] not in self._next_index:
+                        self._next_index[e["add"]] = \
+                            self.store.last_index() + 1
+                        self._match_index[e["add"]] = 0
+                if e.get("remove"):
+                    self.peers.discard(e["remove"])
+            self.last_applied = idx
+        self._applied_cv.notify_all()
+        self._maybe_snapshot()
+
+    def _maybe_snapshot(self) -> None:
+        if self.snapshot_fn is None:
+            return
+        if self.last_applied - self.store.snapshot_index \
+                < self.snapshot_threshold:
+            return
+        self._take_snapshot()
+
+    def _take_snapshot(self) -> None:
+        data = self.snapshot_fn()
+        term = self.store.term_at(self.last_applied)
+        self.store.save_snapshot(self.last_applied, term, data)
+        self.metrics.incr("raft.snapshot.taken")
+
+    # ------------------------------------------------------------- handlers
+
+    def _handle_rpc(self, method: str, src: str,
+                    args: dict[str, Any]) -> dict[str, Any]:
+        if method == "request_vote":
+            return self._on_request_vote(args)
+        if method == "append_entries":
+            return self._on_append_entries(args)
+        if method == "install_snapshot":
+            return self._on_install_snapshot(args)
+        raise ValueError(f"unknown raft rpc {method}")
+
+    def _on_request_vote(self, args: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            term = args["term"]
+            if term < self.store.term:
+                return {"term": self.store.term, "granted": False}
+            if term > self.store.term:
+                self._step_down(term)
+            up_to_date = (
+                args["last_log_term"], args["last_log_index"]
+            ) >= (
+                self.store.term_at(self.store.last_index()),
+                self.store.last_index())
+            can_vote = self.store.voted_for in (None, args["candidate"])
+            granted = up_to_date and can_vote
+            if granted:
+                self.store.set_term_vote(term, args["candidate"])
+                self._reset_election_timer()
+            return {"term": self.store.term, "granted": granted}
+
+    def _on_append_entries(self, args: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            term = args["term"]
+            if term < self.store.term:
+                return {"term": self.store.term, "success": False}
+            if term > self.store.term or self.role != Role.FOLLOWER:
+                self._step_down(term)
+            self.leader_id = args["leader"]
+            self._last_leader_contact = self.clock.now()
+            self._reset_election_timer()
+
+            prev_idx = args["prev_log_index"]
+            prev_term = args["prev_log_term"]
+            if prev_idx > 0 and prev_idx > self.store.snapshot_index:
+                local = self.store.term_at(prev_idx)
+                if prev_idx > self.store.last_index() or local != prev_term:
+                    # conflict hint: first index of the conflicting term or
+                    # just past our log end
+                    hint = min(prev_idx, self.store.last_index() + 1)
+                    return {"term": self.store.term, "success": False,
+                            "conflict_index": max(hint, 1)}
+            elif prev_idx < self.store.snapshot_index:
+                # leader is behind our snapshot; tell it where we are
+                return {"term": self.store.term, "success": False,
+                        "conflict_index": self.store.snapshot_index + 1}
+
+            # append, truncating on conflicts; strip the sender's idx so
+            # storage re-stamps entries at their local raft positions
+            def strip(entries):
+                return [{k: v for k, v in en.items() if k != "idx"}
+                        for en in entries]
+
+            new_entries = args.get("entries") or []
+            insert_at = prev_idx + 1
+            for i, e in enumerate(new_entries):
+                idx = insert_at + i
+                if idx <= self.store.last_index():
+                    if self.store.term_at(idx) != e["term"]:
+                        self.store.truncate_from(idx)
+                        self.store.append(strip(new_entries[i:]))
+                        break
+                else:
+                    self.store.append(strip(new_entries[i:]))
+                    break
+            if args["leader_commit"] > self.commit_index:
+                self.commit_index = min(args["leader_commit"],
+                                        self.store.last_index())
+                self._apply_committed()
+            return {"term": self.store.term, "success": True}
+
+    def _on_install_snapshot(self, args: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            term = args["term"]
+            if term < self.store.term:
+                return {"term": self.store.term}
+            self._step_down(term)
+            self.leader_id = args["leader"]
+            idx, sterm = args["last_index"], args["last_term"]
+            if idx <= self.store.snapshot_index:
+                return {"term": self.store.term}
+            self.store.log.clear()
+            self.store.snapshot_index = 0  # force save to re-point
+            self.store.save_snapshot(idx, sterm, args["data"])
+            if self.restore_fn is not None:
+                self.restore_fn(args["data"])
+            self.commit_index = max(self.commit_index, idx)
+            self.last_applied = idx
+            self._reset_election_timer()
+            return {"term": self.store.term}

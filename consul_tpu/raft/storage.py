@@ -1,0 +1,180 @@
+"""Raft persistence: stable store (term/vote), WAL log, snapshots.
+
+Equivalent of the reference's raft-wal log store + snapshot store
+(selected at agent/consul/server.go:985-1032). Msgpack-framed append-only
+log with 4-byte length prefixes; atomic snapshot files with log
+compaction; in-memory mode for tests (data_dir=None).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Optional
+
+import msgpack
+
+
+class RaftStorage:
+    def __init__(self, data_dir: Optional[str] = None,
+                 sync: bool = False) -> None:
+        self.data_dir = data_dir
+        self.sync = sync
+        # log[i] = {"term": t, "data": bytes, "kind": "cmd"|"noop"|"config"}
+        # 1-based raft indexing: log entry at raft index i lives at
+        # self.log[i - 1 - self.snapshot_index]
+        self.log: list[dict[str, Any]] = []
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.snapshot_index = 0   # last log index covered by snapshot
+        self.snapshot_term = 0
+        self.snapshot_data: Optional[bytes] = None
+        self._wal = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+            self._wal = open(self._wal_path(), "ab")
+
+    # ------------------------------------------------------------- paths
+
+    def _wal_path(self) -> str:
+        return os.path.join(self.data_dir, "wal.log")
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.data_dir, "meta.mp")
+
+    def _snap_path(self) -> str:
+        return os.path.join(self.data_dir, "snapshot.mp")
+
+    # ------------------------------------------------------------ loading
+
+    def _load(self) -> None:
+        if os.path.exists(self._meta_path()):
+            with open(self._meta_path(), "rb") as f:
+                meta = msgpack.unpackb(f.read(), raw=False)
+            self.term = meta.get("term", 0)
+            self.voted_for = meta.get("voted_for")
+        if os.path.exists(self._snap_path()):
+            with open(self._snap_path(), "rb") as f:
+                snap = msgpack.unpackb(f.read(), raw=False)
+            self.snapshot_index = snap["index"]
+            self.snapshot_term = snap["term"]
+            self.snapshot_data = snap["data"]
+        if os.path.exists(self._wal_path()):
+            with open(self._wal_path(), "rb") as f:
+                buf = f.read()
+            off = 0
+            while off + 4 <= len(buf):
+                (ln,) = struct.unpack_from(">I", buf, off)
+                if off + 4 + ln > len(buf):
+                    break  # torn tail write — discard
+                rec = msgpack.unpackb(buf[off + 4: off + 4 + ln], raw=False)
+                off += 4 + ln
+                if rec.get("_trunc") is not None:
+                    # logical truncation marker from conflict rollback:
+                    # keep entries with raft index <= _trunc
+                    keep = rec["_trunc"] - self.snapshot_index
+                    del self.log[max(keep, 0):]
+                else:
+                    idx = rec.get("idx", 0)
+                    if idx <= self.snapshot_index:
+                        continue  # already folded into the snapshot
+                    if idx != self.last_index() + 1:
+                        break  # gap/misalignment: discard the tail
+                    self.log.append(rec)
+
+    # ------------------------------------------------------------ indices
+
+    def first_index(self) -> int:
+        return self.snapshot_index + 1
+
+    def last_index(self) -> int:
+        return self.snapshot_index + len(self.log)
+
+    def term_at(self, index: int) -> int:
+        if index == 0:
+            return 0
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        e = self.entry(index)
+        return e["term"] if e else 0
+
+    def entry(self, index: int) -> Optional[dict[str, Any]]:
+        i = index - 1 - self.snapshot_index
+        if 0 <= i < len(self.log):
+            return self.log[i]
+        return None
+
+    def entries_from(self, index: int, limit: int = 512
+                     ) -> list[dict[str, Any]]:
+        i = max(index - 1 - self.snapshot_index, 0)
+        return self.log[i: i + limit]
+
+    # ----------------------------------------------------------- mutation
+
+    def set_term_vote(self, term: int, voted_for: Optional[str]) -> None:
+        self.term = term
+        self.voted_for = voted_for
+        if self.data_dir:
+            blob = msgpack.packb({"term": term, "voted_for": voted_for})
+            tmp = self._meta_path() + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                if self.sync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, self._meta_path())
+
+    def append(self, entries: list[dict[str, Any]]) -> None:
+        for e in entries:
+            e.setdefault("idx", self.last_index() + 1)
+            self.log.append(e)
+        if self._wal is not None:
+            for e in entries:
+                blob = msgpack.packb(e)
+                self._wal.write(struct.pack(">I", len(blob)) + blob)
+            self._wal.flush()
+            if self.sync:
+                os.fsync(self._wal.fileno())
+
+    def truncate_from(self, index: int) -> None:
+        """Drop entries at raft index >= index (conflict rollback)."""
+        keep = index - 1 - self.snapshot_index
+        del self.log[max(keep, 0):]
+        if self._wal is not None:
+            blob = msgpack.packb({"_trunc": index - 1})
+            self._wal.write(struct.pack(">I", len(blob)) + blob)
+            self._wal.flush()
+
+    def save_snapshot(self, index: int, term: int, data: bytes) -> None:
+        """Persist snapshot and compact the log (keep a trailing window)."""
+        self.snapshot_data = data
+        # keep entries after `index` only
+        keep_from = index - self.snapshot_index
+        self.log = self.log[keep_from:] if keep_from > 0 else self.log
+        self.snapshot_index = index
+        self.snapshot_term = term
+        if self.data_dir:
+            tmp = self._snap_path() + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(msgpack.packb(
+                    {"index": index, "term": term, "data": data}))
+                if self.sync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, self._snap_path())
+            self._rewrite_wal()
+
+    def _rewrite_wal(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+        tmp = self._wal_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in self.log:
+                blob = msgpack.packb(e)
+                f.write(struct.pack(">I", len(blob)) + blob)
+        os.replace(tmp, self._wal_path())
+        self._wal = open(self._wal_path(), "ab")
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None

@@ -1,0 +1,83 @@
+"""Raft RPC transport seam.
+
+Request/response RPCs between raft peers: request_vote, append_entries,
+install_snapshot. The in-memory implementation supports partitions and
+per-link drops for deterministic election/replication tests; real
+deployments carry these RPCs on the server's multiplexed port
+(reference: RaftLayer over byte RPCRaft, agent/consul/raft_rpc.go).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+Handler = Callable[[str, dict[str, Any]], dict[str, Any]]
+
+
+class RaftTransport:
+    addr: str
+
+    def set_handler(self, handler: Callable[[str, str, dict], dict]) -> None:
+        """handler(method, from_addr, args) -> reply"""
+        raise NotImplementedError
+
+    def call(self, peer: str, method: str, args: dict[str, Any],
+             timeout: float = 5.0) -> dict[str, Any]:
+        raise NotImplementedError
+
+
+class InMemRaftNetwork:
+    """Directly-wired in-process raft links with fault injection."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[str, "InMemRaftTransport"] = {}
+        self._partitions: list[tuple[set[str], set[str]]] = []
+        self._down: set[str] = set()
+
+    def attach(self, addr: str) -> "InMemRaftTransport":
+        t = InMemRaftTransport(self, addr)
+        self.nodes[addr] = t
+        return t
+
+    def partition(self, a: set[str], b: set[str]) -> None:
+        self._partitions.append((set(a), set(b)))
+
+    def heal(self) -> None:
+        self._partitions.clear()
+
+    def take_down(self, addr: str) -> None:
+        self._down.add(addr)
+
+    def bring_up(self, addr: str) -> None:
+        self._down.discard(addr)
+
+    def _blocked(self, src: str, dst: str) -> bool:
+        if src in self._down or dst in self._down:
+            return True
+        for a, b in self._partitions:
+            if (src in a and dst in b) or (src in b and dst in a):
+                return True
+        return False
+
+    def call(self, src: str, dst: str, method: str,
+             args: dict[str, Any]) -> dict[str, Any]:
+        if self._blocked(src, dst):
+            raise ConnectionError(f"unreachable: {src} -> {dst}")
+        tgt = self.nodes.get(dst)
+        if tgt is None or tgt._handler is None:
+            raise ConnectionError(f"connection refused: {dst}")
+        return tgt._handler(method, src, args)
+
+
+class InMemRaftTransport(RaftTransport):
+    def __init__(self, net: InMemRaftNetwork, addr: str) -> None:
+        self.net = net
+        self.addr = addr
+        self._handler: Optional[Callable[[str, str, dict], dict]] = None
+
+    def set_handler(self, handler: Callable[[str, str, dict], dict]) -> None:
+        self._handler = handler
+
+    def call(self, peer: str, method: str, args: dict[str, Any],
+             timeout: float = 5.0) -> dict[str, Any]:
+        return self.net.call(self.addr, peer, method, args)

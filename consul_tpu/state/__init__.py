@@ -1,0 +1,8 @@
+"""Replicated state: MVCC-style store with watches + the FSM command
+registry (reference: agent/consul/state/ over go-memdb, and
+agent/consul/fsm/)."""
+
+from consul_tpu.state.store import StateStore
+from consul_tpu.state.fsm import FSM, MessageType
+
+__all__ = ["StateStore", "FSM", "MessageType"]

@@ -1,0 +1,234 @@
+"""FSM: the replicated command registry.
+
+Mirrors the reference's FSM (agent/consul/fsm/fsm.go:169 Apply +
+registerCommand :38): a raft log entry is a 1-byte message type +
+msgpack body; handlers mutate the state store deterministically on every
+server. Snapshot/restore delegate to the store (fsm/snapshot.go).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from consul_tpu.state.store import StateStore
+from consul_tpu.types import CheckStatus, Session
+from consul_tpu.utils import log, telemetry
+
+
+class MessageType(enum.IntEnum):
+    """Command types (reference: structs.MessageType, consumed at
+    fsm/commands_ce.go:115-151)."""
+
+    REGISTER = 0
+    DEREGISTER = 1
+    KVS = 2
+    SESSION = 3
+    COORDINATE_BATCH_UPDATE = 4
+    PREPARED_QUERY = 5
+    TXN = 6
+    ACL_TOKEN = 7
+    ACL_POLICY = 8
+    CONFIG_ENTRY = 9
+    INTENTION = 10
+    AUTOPILOT = 11
+    SYSTEM_METADATA = 12
+
+
+def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
+    return bytes([int(msg_type)]) + msgpack.packb(body, use_bin_type=True)
+
+
+class FSM:
+    def __init__(self, store: Optional[StateStore] = None) -> None:
+        self.store = store or StateStore()
+        self.log = log.named("fsm")
+        self.metrics = telemetry.default
+        self._handlers: dict[int, Callable[[dict[str, Any], int], Any]] = {
+            MessageType.REGISTER: self._apply_register,
+            MessageType.DEREGISTER: self._apply_deregister,
+            MessageType.KVS: self._apply_kvs,
+            MessageType.SESSION: self._apply_session,
+            MessageType.COORDINATE_BATCH_UPDATE: self._apply_coordinates,
+            MessageType.TXN: self._apply_txn,
+            MessageType.PREPARED_QUERY: self._apply_prepared_query,
+            MessageType.ACL_TOKEN: self._apply_acl_token,
+            MessageType.ACL_POLICY: self._apply_acl_policy,
+            MessageType.CONFIG_ENTRY: self._apply_config_entry,
+            MessageType.INTENTION: self._apply_intention,
+        }
+
+    def apply(self, data: bytes, raft_index: int) -> Any:
+        msg_type = data[0]
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            # unknown commands must be ignored, not crash the cluster
+            # (forward compatibility, fsm.go Apply)
+            self.log.warning("ignoring unknown command type %d", msg_type)
+            return None
+        body = msgpack.unpackb(data[1:], raw=False)
+        with telemetry.default.time("fsm.apply",
+                                    {"type": MessageType(msg_type).name}):
+            return handler(body, raft_index)
+
+    def snapshot(self) -> bytes:
+        return self.store.dump()
+
+    def restore(self, data: bytes) -> None:
+        self.store.restore(data)
+
+    # ------------------------------------------------------------- handlers
+
+    def _apply_register(self, b: dict[str, Any], idx: int) -> Any:
+        return self.store.ensure_registration(
+            node=b["Node"], address=b.get("Address", ""),
+            node_id=b.get("ID", ""), datacenter=b.get("Datacenter", ""),
+            tagged_addresses=b.get("TaggedAddresses"),
+            node_meta=b.get("NodeMeta"),
+            service=b.get("Service"), check=b.get("Check"),
+            checks=b.get("Checks"))
+
+    def _apply_deregister(self, b: dict[str, Any], idx: int) -> Any:
+        node = b["Node"]
+        if b.get("ServiceID"):
+            return self.store.delete_service(node, b["ServiceID"])
+        if b.get("CheckID"):
+            return self.store.delete_check(node, b["CheckID"])
+        return self.store.delete_node(node)
+
+    def _apply_kvs(self, b: dict[str, Any], idx: int) -> Any:
+        op = b.get("Op", "set")
+        d = b.get("DirEnt") or {}
+        key = d.get("Key", "")
+        value = d.get("Value") or b""
+        flags = d.get("Flags", 0)
+        if op == "set":
+            _, ok = self.store.kv_set(key, value, flags)
+            return ok
+        if op == "cas":
+            _, ok = self.store.kv_set(
+                key, value, flags, cas_index=d.get("ModifyIndex", 0))
+            return ok
+        if op == "lock":
+            _, ok = self.store.kv_set(key, value, flags,
+                                      acquire=d.get("Session", ""))
+            return ok
+        if op == "unlock":
+            _, ok = self.store.kv_set(key, value, flags,
+                                      release=d.get("Session", ""))
+            return ok
+        if op == "delete":
+            _, ok = self.store.kv_delete(key)
+            return ok
+        if op == "delete-cas":
+            _, ok = self.store.kv_delete(
+                key, cas_index=d.get("ModifyIndex", 0))
+            return ok
+        if op == "delete-tree":
+            _, ok = self.store.kv_delete(key, recurse=True)
+            return ok
+        raise ValueError(f"unknown KVS op {op}")
+
+    def _apply_session(self, b: dict[str, Any], idx: int) -> Any:
+        op = b.get("Op", "create")
+        if op == "create":
+            s = b.get("Session") or {}
+            sess = Session(
+                id=s["ID"], name=s.get("Name", ""), node=s.get("Node", ""),
+                checks=list(s.get("Checks") or ["serfHealth"]),
+                lock_delay_s=s.get("LockDelay", 15e9) / 1e9,
+                behavior=s.get("Behavior", "release"),
+                ttl=s.get("TTL", ""))
+            self.store.session_create(sess)
+            return sess.id
+        if op == "destroy":
+            self.store.session_destroy(b["Session"]["ID"]
+                                       if isinstance(b.get("Session"), dict)
+                                       else b["Session"])
+            return True
+        raise ValueError(f"unknown session op {op}")
+
+    def _apply_coordinates(self, b: dict[str, Any], idx: int) -> Any:
+        return self.store.coordinate_batch_update(b.get("Updates") or [])
+
+    def _apply_txn(self, b: dict[str, Any], idx: int) -> Any:
+        """All-or-nothing multi-op transaction (structs.TxnRequest).
+
+        Verify phase runs all preconditions first; only then mutate —
+        the store lock makes the two phases atomic."""
+        ops = b.get("Ops") or []
+        with self.store._lock:
+            results = []
+            for op in ops:
+                kv = op.get("KV")
+                if not kv:
+                    return {"Errors": [
+                        {"What": "only KV txn ops supported"}]}
+                verb = kv.get("Verb", "set")
+                key = kv.get("Key", "")
+                cur = self.store.kv_get(key)
+                if verb in ("cas", "delete-cas") and (
+                        cur is None
+                        or cur.modify_index != kv.get("Index", 0)):
+                    return {"Errors": [{"OpIndex": len(results),
+                                        "What": f"cas failed for {key}"}]}
+                if verb == "check-index" and (
+                        cur is None
+                        or cur.modify_index != kv.get("Index", 0)):
+                    return {"Errors": [{"OpIndex": len(results),
+                                        "What": f"index check failed"}]}
+                if verb == "check-not-exists" and cur is not None:
+                    return {"Errors": [{"OpIndex": len(results),
+                                        "What": f"{key} exists"}]}
+                results.append((verb, kv, cur))
+            out = []
+            for verb, kv, cur in results:
+                key = kv.get("Key", "")
+                if verb in ("set", "cas"):
+                    self.store.kv_set(key, kv.get("Value") or b"",
+                                      kv.get("Flags", 0))
+                    out.append({"KV": self.store.kv_get(key).to_dict()})
+                elif verb in ("delete", "delete-cas"):
+                    self.store.kv_delete(key)
+                elif verb == "delete-tree":
+                    self.store.kv_delete(key, recurse=True)
+                elif verb == "get":
+                    out.append({"KV": cur.to_dict() if cur else None})
+            return {"Results": out, "Errors": None}
+
+    def _raw_op(self, table: str, write_ops: tuple[str, ...], op: str,
+                key: Any, value: Any) -> Any:
+        if op in write_ops:
+            return self.store.raw_upsert(table, key, value)
+        if op == "delete":
+            return self.store.raw_delete(table, key)
+        raise ValueError(f"unknown {table} op {op}")
+
+    def _apply_prepared_query(self, b: dict[str, Any], idx: int) -> Any:
+        q = b.get("Query") or {}
+        return self._raw_op("prepared_queries", ("create", "update"),
+                            b.get("Op", "create"), q.get("ID"), q)
+
+    def _apply_acl_token(self, b: dict[str, Any], idx: int) -> Any:
+        t = b.get("Token") or {}
+        return self._raw_op("acl_tokens", ("set",), b.get("Op", "set"),
+                            t.get("SecretID"), t)
+
+    def _apply_acl_policy(self, b: dict[str, Any], idx: int) -> Any:
+        p = b.get("Policy") or {}
+        return self._raw_op("acl_policies", ("set",), b.get("Op", "set"),
+                            p.get("ID"), p)
+
+    def _apply_config_entry(self, b: dict[str, Any], idx: int) -> Any:
+        e = b.get("Entry") or {}
+        key = f"{e.get('Kind', '')}/{e.get('Name', '')}"
+        return self._raw_op("config_entries", ("upsert",),
+                            b.get("Op", "upsert"), key, e)
+
+    def _apply_intention(self, b: dict[str, Any], idx: int) -> Any:
+        i = b.get("Intention") or {}
+        key = f"{i.get('SourceName', '*')}->{i.get('DestinationName', '*')}"
+        return self._raw_op("intentions", ("upsert",),
+                            b.get("Op", "upsert"), key, i)

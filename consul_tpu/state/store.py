@@ -1,0 +1,521 @@
+"""The state store: tables, per-table modify indexes, watches.
+
+Equivalent of the reference's go-memdb database (agent/consul/state/
+state_store.go:105, schema at schema.go:14-55): every table change bumps
+a monotone index recorded on the affected records; blocking queries wait
+on watch notifications and re-run when a relevant table moves past their
+min-index (agent/blockingquery/blockingquery.go:117).
+
+Tables (subset of the reference's ~32, the serving core):
+  nodes, services, checks   — the catalog (catalog_schema.go)
+  kv                        — key/value store
+  sessions                  — session/lock machinery
+  coordinates               — Vivaldi coordinates
+
+Concurrency: one RWLock-ish mutex; watchers wait on a Condition that
+fires on every commit and re-check their tables' indexes (bounded
+thundering herd — fine at this scale, mirrors memdb WatchSet wakeups).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+import msgpack
+
+from consul_tpu.types import (CheckStatus, Coordinate, HealthCheck, KVEntry,
+                              Node, NodeService, SERF_CHECK_ID, Session)
+
+TABLES = ("nodes", "services", "checks", "kv", "sessions", "coordinates",
+          "prepared_queries", "acl_tokens", "acl_policies", "config_entries",
+          "intentions")
+
+
+class StateStore:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._index = 0
+        # nodes[name] = Node; services[(node, svc_id)] = NodeService;
+        # checks[(node, check_id)] = HealthCheck; kv[key] = KVEntry;
+        # sessions[id] = Session; coordinates[node] = Coordinate dict
+        self.tables: dict[str, dict[Any, Any]] = {t: {} for t in TABLES}
+        self._table_index: dict[str, int] = {t: 0 for t in TABLES}
+        # change hooks (the stream publisher seam — event streaming feeds
+        # from here like catalog_events.go feeds the EventPublisher)
+        self._change_hooks: list[Callable[[str, int], None]] = []
+
+    # --------------------------------------------------------------- watches
+
+    @property
+    def index(self) -> int:
+        return self._index
+
+    def table_index(self, *tables: str) -> int:
+        with self._lock:
+            return max((self._table_index[t] for t in tables),
+                       default=self._index)
+
+    def add_change_hook(self, fn: Callable[[str, int], None]) -> None:
+        self._change_hooks.append(fn)
+
+    def _bump(self, *tables: str) -> int:
+        self._index += 1
+        for t in tables:
+            self._table_index[t] = self._index
+        self._cv.notify_all()
+        for fn in self._change_hooks:
+            try:
+                fn(",".join(tables), self._index)
+            except Exception:  # noqa: BLE001
+                pass
+        return self._index
+
+    def block_until(self, tables: Iterable[str], min_index: int,
+                    timeout: float) -> int:
+        """Wait until any of `tables` moves past min_index (or timeout).
+        Returns the current max index over the tables.
+
+        Real-time only: Condition waits can't ride the SimClock, so
+        deterministic tests drive this with short timeouts."""
+        import time as _time
+
+        tables = tuple(tables)
+        end = _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                cur = max((self._table_index[t] for t in tables),
+                          default=self._index)
+                if cur > min_index:
+                    return cur
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    return cur
+                self._cv.wait(remaining)
+
+    # ---------------------------------------------------------------- catalog
+
+    def ensure_registration(self, node: str, address: str = "",
+                            node_id: str = "", datacenter: str = "",
+                            tagged_addresses: Optional[dict] = None,
+                            node_meta: Optional[dict] = None,
+                            service: Optional[dict] = None,
+                            check: Optional[dict] = None,
+                            checks: Optional[list[dict]] = None) -> int:
+        """Atomic node+service+check upsert (structs.RegisterRequest →
+        state.EnsureRegistration)."""
+        with self._lock:
+            touched = ["nodes"]
+            n = self.tables["nodes"].get(node)
+            if n is None:
+                n = Node(node=node, address=address, node_id=node_id,
+                         datacenter=datacenter,
+                         tagged_addresses=tagged_addresses or {},
+                         meta=node_meta or {})
+                n.create_index = self._index + 1
+            else:
+                n.address = address or n.address
+                n.node_id = node_id or n.node_id
+                if tagged_addresses:
+                    n.tagged_addresses.update(tagged_addresses)
+                if node_meta is not None:
+                    n.meta = dict(node_meta)
+            if service is not None:
+                svc = _service_from_dict(service)
+                key = (node, svc.id)
+                prev = self.tables["services"].get(key)
+                svc.create_index = prev.create_index if prev \
+                    else self._index + 1
+                svc.modify_index = self._index + 1
+                self.tables["services"][key] = svc
+                touched.append("services")
+            all_checks = list(checks or [])
+            if check is not None:
+                all_checks.append(check)
+            for c in all_checks:
+                hc = _check_from_dict(node, c)
+                key = (node, hc.check_id)
+                prev = self.tables["checks"].get(key)
+                hc.create_index = prev.create_index if prev \
+                    else self._index + 1
+                hc.modify_index = self._index + 1
+                self.tables["checks"][key] = hc
+                touched.append("checks")
+            idx = self._bump(*set(touched))
+            n.modify_index = idx
+            self.tables["nodes"][node] = n
+            return idx
+
+    def ensure_check_status(self, node: str, check_id: str,
+                            status: CheckStatus, output: str = "") -> int:
+        with self._lock:
+            hc = self.tables["checks"].get((node, check_id))
+            if hc is None:
+                return self._index
+            if hc.status == status and hc.output == output:
+                return self._index
+            hc.status = status
+            hc.output = output
+            idx = self._bump("checks")
+            hc.modify_index = idx
+            return idx
+
+    def delete_node(self, node: str) -> int:
+        """Deregister a node and everything on it (state.DeleteNode)."""
+        with self._lock:
+            self.tables["nodes"].pop(node, None)
+            for key in [k for k in self.tables["services"]
+                        if k[0] == node]:
+                del self.tables["services"][key]
+            for key in [k for k in self.tables["checks"] if k[0] == node]:
+                del self.tables["checks"][key]
+            self.tables["coordinates"].pop(node, None)
+            # invalidate sessions bound to the node (session_ttl semantics)
+            dead_sessions = [s for s in self.tables["sessions"].values()
+                             if s.node == node]
+            for s in dead_sessions:
+                self._destroy_session_locked(s.id)
+            # sessions/kv watchers must wake too: session destruction
+            # releases or deletes held locks
+            return self._bump("nodes", "services", "checks", "coordinates",
+                              "sessions", "kv")
+
+    def delete_service(self, node: str, service_id: str) -> int:
+        with self._lock:
+            self.tables["services"].pop((node, service_id), None)
+            for key in [k for k, c in self.tables["checks"].items()
+                        if k[0] == node and c.service_id == service_id]:
+                del self.tables["checks"][key]
+            return self._bump("services", "checks")
+
+    def delete_check(self, node: str, check_id: str) -> int:
+        with self._lock:
+            self.tables["checks"].pop((node, check_id), None)
+            return self._bump("checks")
+
+    # catalog queries ------------------------------------------------------
+
+    def get_node(self, node: str) -> Optional[Node]:
+        with self._lock:
+            return self.tables["nodes"].get(node)
+
+    def nodes(self) -> list[Node]:
+        with self._lock:
+            return sorted(self.tables["nodes"].values(),
+                          key=lambda n: n.node)
+
+    def node_services(self, node: str) -> list[NodeService]:
+        with self._lock:
+            return [s for (n, _), s in self.tables["services"].items()
+                    if n == node]
+
+    def services(self) -> dict[str, list[str]]:
+        """service name -> sorted union of tags (catalog /v1/catalog/services)."""
+        with self._lock:
+            out: dict[str, set[str]] = {}
+            for s in self.tables["services"].values():
+                out.setdefault(s.service, set()).update(s.tags)
+            return {k: sorted(v) for k, v in sorted(out.items())}
+
+    def service_nodes(self, service: str, tag: Optional[str] = None
+                      ) -> list[tuple[Node, NodeService]]:
+        with self._lock:
+            out = []
+            for (node, _), s in self.tables["services"].items():
+                if s.service != service:
+                    continue
+                if tag and tag not in s.tags:
+                    continue
+                n = self.tables["nodes"].get(node)
+                if n is not None:
+                    out.append((n, s))
+            return sorted(out, key=lambda t: (t[0].node, t[1].id))
+
+    def node_checks(self, node: str) -> list[HealthCheck]:
+        with self._lock:
+            return sorted((c for (n, _), c in self.tables["checks"].items()
+                           if n == node), key=lambda c: c.check_id)
+
+    def service_checks(self, service: str) -> list[HealthCheck]:
+        with self._lock:
+            return [c for c in self.tables["checks"].values()
+                    if c.service_name == service]
+
+    def checks_in_state(self, status: str) -> list[HealthCheck]:
+        with self._lock:
+            if status == "any":
+                return sorted(self.tables["checks"].values(),
+                              key=lambda c: (c.node, c.check_id))
+            return sorted((c for c in self.tables["checks"].values()
+                           if c.status.value == status),
+                          key=lambda c: (c.node, c.check_id))
+
+    def check_service_nodes(self, service: str, tag: Optional[str] = None,
+                            passing_only: bool = False
+                            ) -> list[dict[str, Any]]:
+        """The health endpoint's join: (node, service, node+svc checks)
+        (state.CheckServiceNodes)."""
+        with self._lock:
+            out = []
+            for n, s in self.service_nodes(service, tag):
+                checks = [c for c in self.node_checks(n.node)
+                          if c.service_id in ("", s.id)]
+                if passing_only and any(
+                        c.status != CheckStatus.PASSING for c in checks):
+                    continue
+                out.append({"Node": n.to_dict(), "Service": s.to_dict(),
+                            "Checks": [c.to_dict() for c in checks]})
+            return out
+
+    # -------------------------------------------------------------------- KV
+
+    def kv_set(self, key: str, value: bytes, flags: int = 0,
+               cas_index: Optional[int] = None,
+               acquire: str = "", release: str = "") -> tuple[int, bool]:
+        """Returns (index, success). CAS semantics follow the reference:
+        cas_index=0 → only-if-absent; else must match modify_index."""
+        with self._lock:
+            cur = self.tables["kv"].get(key)
+            if cas_index is not None:
+                if cas_index == 0 and cur is not None:
+                    return self._index, False
+                if cas_index != 0 and (cur is None
+                                       or cur.modify_index != cas_index):
+                    return self._index, False
+            if acquire:
+                sess = self.tables["sessions"].get(acquire)
+                if sess is None:
+                    return self._index, False
+                if cur is not None and cur.session \
+                        and cur.session != acquire:
+                    return self._index, False
+            if release:
+                if cur is None or cur.session != release:
+                    return self._index, False
+            e = cur or KVEntry(key=key)
+            if cur is None:
+                e.create_index = self._index + 1
+            e.value = value
+            e.flags = flags
+            if acquire:
+                if e.session != acquire:
+                    e.lock_index += 1
+                e.session = acquire
+            if release:
+                e.session = ""
+            idx = self._bump("kv")
+            e.modify_index = idx
+            self.tables["kv"][key] = e
+            return idx, True
+
+    def kv_get(self, key: str) -> Optional[KVEntry]:
+        with self._lock:
+            return self.tables["kv"].get(key)
+
+    def kv_list(self, prefix: str) -> list[KVEntry]:
+        with self._lock:
+            return sorted((e for k, e in self.tables["kv"].items()
+                           if k.startswith(prefix)), key=lambda e: e.key)
+
+    def kv_keys(self, prefix: str, separator: str = "") -> list[str]:
+        with self._lock:
+            keys = sorted(k for k in self.tables["kv"] if
+                          k.startswith(prefix))
+        if not separator:
+            return keys
+        out: list[str] = []
+        for k in keys:
+            rest = k[len(prefix):]
+            if separator in rest:
+                trunc = prefix + rest.split(separator, 1)[0] + separator
+                if not out or out[-1] != trunc:
+                    out.append(trunc)
+            else:
+                out.append(k)
+        return out
+
+    def kv_delete(self, key: str, recurse: bool = False,
+                  cas_index: Optional[int] = None) -> tuple[int, bool]:
+        with self._lock:
+            if cas_index is not None and not recurse:
+                cur = self.tables["kv"].get(key)
+                if cur is None or cur.modify_index != cas_index:
+                    return self._index, False
+            victims = [k for k in self.tables["kv"]
+                       if (k.startswith(key) if recurse else k == key)]
+            if not victims:
+                return self._index, True
+            for k in victims:
+                del self.tables["kv"][k]
+            return self._bump("kv"), True
+
+    # --------------------------------------------------------------- sessions
+
+    def session_create(self, sess: Session) -> int:
+        with self._lock:
+            idx = self._bump("sessions")
+            sess.create_index = idx
+            sess.modify_index = idx
+            self.tables["sessions"][sess.id] = sess
+            return idx
+
+    def session_get(self, sid: str) -> Optional[Session]:
+        with self._lock:
+            return self.tables["sessions"].get(sid)
+
+    def session_list(self, node: Optional[str] = None) -> list[Session]:
+        with self._lock:
+            return [s for s in self.tables["sessions"].values()
+                    if node is None or s.node == node]
+
+    def session_destroy(self, sid: str) -> int:
+        with self._lock:
+            self._destroy_session_locked(sid)
+            return self._bump("sessions", "kv")
+
+    def _destroy_session_locked(self, sid: str) -> None:
+        sess = self.tables["sessions"].pop(sid, None)
+        if sess is None:
+            return
+        # release or delete held locks per session behavior
+        for k, e in list(self.tables["kv"].items()):
+            if e.session == sid:
+                if sess.behavior == "delete":
+                    del self.tables["kv"][k]
+                else:
+                    e.session = ""
+                    e.modify_index = self._index + 1
+
+    def invalidate_sessions_for_check(self, node: str,
+                                      check_id: str) -> None:
+        """A critical check invalidates sessions bound to it
+        (session_ttl.go semantics)."""
+        with self._lock:
+            doomed = [s.id for s in self.tables["sessions"].values()
+                      if s.node == node and check_id in s.checks]
+            for sid in doomed:
+                self._destroy_session_locked(sid)
+            if doomed:
+                self._bump("sessions", "kv")
+
+    # ------------------------------------------------------------ coordinates
+
+    def coordinate_batch_update(self, updates: list[dict[str, Any]]) -> int:
+        with self._lock:
+            for u in updates:
+                self.tables["coordinates"][u["Node"]] = u
+            return self._bump("coordinates")
+
+    def coordinates(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return sorted(self.tables["coordinates"].values(),
+                          key=lambda c: c["Node"])
+
+    def coordinate_get(self, node: str) -> Optional[dict[str, Any]]:
+        with self._lock:
+            return self.tables["coordinates"].get(node)
+
+    # ------------------------------------------------------------ raw tables
+
+    def raw_upsert(self, table: str, key: Any, value: Any) -> int:
+        """Generic upsert for dict-valued tables (config entries, ACL,
+        intentions, prepared queries) — keeps the lock/bump protocol in
+        one place for FSM handlers."""
+        with self._lock:
+            self.tables[table][key] = value
+            return self._bump(table)
+
+    def raw_delete(self, table: str, key: Any) -> int:
+        with self._lock:
+            self.tables[table].pop(key, None)
+            return self._bump(table)
+
+    def raw_get(self, table: str, key: Any) -> Any:
+        with self._lock:
+            return self.tables[table].get(key)
+
+    def raw_list(self, table: str) -> list[Any]:
+        with self._lock:
+            return [self.tables[table][k]
+                    for k in sorted(self.tables[table])]
+
+    # ---------------------------------------------------------- snapshotting
+
+    def dump(self) -> bytes:
+        """Serialize everything (FSM snapshot, fsm/snapshot.go)."""
+        with self._lock:
+            blob = {
+                "index": self._index,
+                "table_index": dict(self._table_index),
+                "nodes": {k: v.__dict__ for k, v in
+                          self.tables["nodes"].items()},
+                "services": [[list(k), v.__dict__] for k, v in
+                             self.tables["services"].items()],
+                "checks": [[list(k),
+                            {**v.__dict__, "status": v.status.value}]
+                           for k, v in self.tables["checks"].items()],
+                "kv": {k: v.__dict__ for k, v in self.tables["kv"].items()},
+                "sessions": {k: v.__dict__ for k, v in
+                             self.tables["sessions"].items()},
+                "coordinates": dict(self.tables["coordinates"]),
+                "config_entries": dict(self.tables["config_entries"]),
+                "acl_tokens": dict(self.tables["acl_tokens"]),
+                "acl_policies": dict(self.tables["acl_policies"]),
+                "intentions": dict(self.tables["intentions"]),
+                "prepared_queries": dict(self.tables["prepared_queries"]),
+            }
+            return msgpack.packb(blob, use_bin_type=True)
+
+    def restore(self, data: bytes) -> None:
+        blob = msgpack.unpackb(data, raw=False)
+        with self._lock:
+            self._index = blob["index"]
+            self._table_index.update(blob.get("table_index", {}))
+            self.tables["nodes"] = {
+                k: Node(**v) for k, v in blob["nodes"].items()}
+            self.tables["services"] = {
+                tuple(k): NodeService(**v) for k, v in blob["services"]}
+            self.tables["checks"] = {
+                tuple(k): HealthCheck(
+                    **{**v, "status": CheckStatus(v["status"])})
+                for k, v in blob["checks"]}
+            self.tables["kv"] = {
+                k: KVEntry(**v) for k, v in blob["kv"].items()}
+            self.tables["sessions"] = {
+                k: Session(**v) for k, v in blob["sessions"].items()}
+            self.tables["coordinates"] = blob.get("coordinates", {})
+            for t in ("config_entries", "acl_tokens", "acl_policies",
+                      "intentions", "prepared_queries"):
+                self.tables[t] = blob.get(t, {})
+            self._cv.notify_all()
+
+
+def _service_from_dict(d: dict[str, Any]) -> NodeService:
+    return NodeService(
+        id=d.get("ID") or d.get("Service", ""),
+        service=d.get("Service", ""),
+        tags=list(d.get("Tags") or []),
+        address=d.get("Address", ""),
+        port=d.get("Port", 0) or 0,
+        meta=dict(d.get("Meta") or {}),
+        weights=dict(d.get("Weights") or {"Passing": 1, "Warning": 1}),
+        kind=d.get("Kind", ""),
+        proxy=dict(d.get("Proxy") or {}),
+        connect_native=bool((d.get("Connect") or {}).get("Native")),
+    )
+
+
+def _check_from_dict(node: str, d: dict[str, Any]) -> HealthCheck:
+    return HealthCheck(
+        node=d.get("Node") or node,
+        check_id=d.get("CheckID") or d.get("Name", ""),
+        name=d.get("Name", ""),
+        status=CheckStatus(d.get("Status", "critical")),
+        notes=d.get("Notes", ""),
+        output=d.get("Output", ""),
+        service_id=d.get("ServiceID", ""),
+        service_name=d.get("ServiceName", ""),
+        check_type=d.get("Type", ""),
+    )

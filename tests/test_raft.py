@@ -1,0 +1,249 @@
+"""Raft consensus tests on a deterministic clock + in-memory transport.
+
+Mirrors the reference's in-process multi-server cluster tests
+(agent/consul/leader_test.go style, SURVEY.md §4): N RaftNodes over
+instant links, elections driven by a virtual clock, partitions injected
+at the transport.
+"""
+
+import msgpack
+import pytest
+
+from consul_tpu.raft import InMemRaftNetwork, RaftNode, Role
+from consul_tpu.raft.raft import NotLeader
+from consul_tpu.raft.storage import RaftStorage
+from consul_tpu.utils.clock import SimClock
+
+
+def make_cluster(n=3, clock=None, net=None, data_dirs=None):
+    clock = clock or SimClock()
+    net = net or InMemRaftNetwork()
+    addrs = [f"raft{i}" for i in range(n)]
+    nodes = []
+    applied = []  # shared: (node_idx, data, index)
+    for i, addr in enumerate(addrs):
+        t = net.attach(addr)
+        logbook = []
+        applied.append(logbook)
+
+        def mk(logbook):
+            return lambda data, idx: logbook.append((data, idx)) or len(
+                logbook)
+
+        node = RaftNode(
+            node_id=addr, transport=t, apply_fn=mk(logbook),
+            peers=addrs, clock=clock, seed=i,
+            storage=RaftStorage(data_dirs[i] if data_dirs else None),
+            heartbeat_interval=0.05, election_timeout=0.3)
+        nodes.append(node)
+    for node in nodes:
+        node.start()
+    return clock, net, nodes, applied
+
+
+def wait_leader(clock, nodes, timeout=10.0):
+    t0 = clock.now()
+    while clock.now() - t0 < timeout:
+        clock.advance(0.05)
+        leaders = [n for n in nodes if n.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+    raise AssertionError(
+        f"no single leader: {[n.stats() for n in nodes]}")
+
+
+def test_elects_single_leader():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    terms = {n.store.term for n in nodes}
+    assert len(terms) == 1
+    followers = [n for n in nodes if n is not leader]
+    assert all(n.role == Role.FOLLOWER for n in followers)
+    assert all(n.leader() == leader.transport.addr for n in followers)
+
+
+def test_replicates_and_applies_in_order():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    for i in range(5):
+        leader.apply(f"cmd{i}".encode())
+    clock.advance(0.5)  # heartbeats carry commit index to followers
+    for i, node in enumerate(nodes):
+        data = [d for d, _ in applied[i]]
+        assert data == [f"cmd{j}".encode() for j in range(5)], \
+            f"node {i}: {data}"
+
+
+def test_follower_rejects_apply():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    follower = next(n for n in nodes if n is not leader)
+    with pytest.raises(NotLeader) as ei:
+        follower.apply(b"nope")
+    assert ei.value.leader == leader.transport.addr
+
+
+def test_leader_failure_triggers_reelection_and_continuity():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    leader.apply(b"before")
+    clock.advance(0.5)
+    net.take_down(leader.transport.addr)
+    survivors = [n for n in nodes if n is not leader]
+    new_leader = wait_leader(clock, survivors)
+    assert new_leader is not leader
+    new_leader.apply(b"after")
+    clock.advance(0.5)
+    for n in survivors:
+        i = nodes.index(n)
+        data = [d for d, _ in applied[i]]
+        assert data == [b"before", b"after"]
+
+
+def test_partitioned_minority_cannot_commit():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    minority = leader.transport.addr
+    others = {n.transport.addr for n in nodes if n is not leader}
+    net.partition({minority}, others)
+    # old leader can't reach quorum; survivors elect a new one
+    survivors = [n for n in nodes if n is not leader]
+    new_leader = wait_leader(clock, survivors)
+    new_leader.apply(b"majority-write")
+    clock.advance(1.0)
+    # the partitioned node must not have the entry
+    i = nodes.index(leader)
+    assert b"majority-write" not in [d for d, _ in applied[i]]
+    # heal: old leader steps down, catches up
+    net.heal()
+    clock.advance(2.0)
+    assert not leader.is_leader()
+    assert b"majority-write" in [d for d, _ in applied[i]]
+
+
+def test_old_leader_writes_discarded_after_heal():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    others = {n.transport.addr for n in nodes if n is not leader}
+    net.partition({leader.transport.addr}, others)
+    # leader can't commit this (no quorum) — append locally only
+    try:
+        leader.apply(b"doomed", timeout=0.1)
+    except Exception:
+        pass
+    survivors = [n for n in nodes if n is not leader]
+    new_leader = wait_leader(clock, survivors)
+    new_leader.apply(b"kept")
+    net.heal()
+    clock.advance(2.0)
+    for i, n in enumerate(nodes):
+        data = [d for d, _ in applied[i]]
+        assert b"doomed" not in data
+        assert b"kept" in data
+
+
+def test_snapshot_and_catch_up_via_install(tmp_path):
+    clock, net, nodes, applied = make_cluster(3)
+    # give the leader a snapshot function
+    snap_state = {"n": 0}
+
+    leader = wait_leader(clock, nodes)
+    for n in nodes:
+        n.snapshot_threshold = 10
+        n.snapshot_fn = lambda n=n: msgpack.packb(
+            {"count": len(applied[nodes.index(n)])})
+        n.restore_fn = lambda data, n=n: applied[nodes.index(n)].extend(
+            [(b"<restored>", 0)] * msgpack.unpackb(data)["count"])
+
+    victim = next(n for n in nodes if n is not leader)
+    net.take_down(victim.transport.addr)
+    for i in range(25):
+        leader.apply(f"x{i}".encode())
+    clock.advance(1.0)
+    # leader compacted beyond the dead follower's next index
+    assert leader.store.snapshot_index > 0
+    net.bring_up(victim.transport.addr)
+    clock.advance(2.0)
+    vi = nodes.index(victim)
+    assert len(applied[vi]) >= 25
+    assert victim.last_applied == leader.last_applied
+
+
+def test_persistence_across_restart(tmp_path):
+    dirs = [str(tmp_path / f"r{i}") for i in range(3)]
+    clock, net, nodes, applied = make_cluster(3, data_dirs=dirs)
+    leader = wait_leader(clock, nodes)
+    for i in range(3):
+        leader.apply(f"p{i}".encode())
+    clock.advance(0.5)
+    term_before = leader.store.term
+    for n in nodes:
+        n.shutdown()
+
+    # restart from disk
+    clock2, net2, nodes2, applied2 = make_cluster(3, data_dirs=dirs)
+    for i, n in enumerate(nodes2):
+        assert n.store.term >= term_before
+        assert n.store.last_index() >= 3
+    leader2 = wait_leader(clock2, nodes2)
+    leader2.apply(b"after-restart")
+    clock2.advance(0.5)
+    li = nodes2.index(leader2)
+    data = [d for d, _ in applied2[li]]
+    assert data[-1] == b"after-restart"
+    # all pre-restart commands re-applied in order before the new one
+    assert data[:3] == [b"p0", b"p1", b"p2"]
+
+
+def test_add_peer_catches_up():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    for i in range(4):
+        leader.apply(f"a{i}".encode())
+    # grow the cluster
+    t4 = net.attach("raft3")
+    book4 = []
+    n4 = RaftNode(node_id="raft3", transport=t4,
+                  apply_fn=lambda d, i: book4.append((d, i)),
+                  peers=[n.transport.addr for n in nodes] + ["raft3"],
+                  clock=clock, seed=9, heartbeat_interval=0.05,
+                  election_timeout=0.3)
+    n4.start()
+    leader.add_peer("raft3")
+    clock.advance(2.0)
+    assert [d for d, _ in book4] == [f"a{i}".encode() for i in range(4)]
+    assert "raft3" in leader.peers
+
+
+def test_raft_fsm_state_store_integration():
+    """3 servers, each with its own FSM+StateStore; a KV write through the
+    leader appears in every store (the §3.3 write path minus RPC)."""
+    from consul_tpu.state import FSM, MessageType
+    from consul_tpu.state.fsm import encode_command
+
+    clock = SimClock()
+    net = InMemRaftNetwork()
+    addrs = [f"s{i}" for i in range(3)]
+    fsms = [FSM() for _ in range(3)]
+    nodes = []
+    for i, addr in enumerate(addrs):
+        node = RaftNode(
+            node_id=addr, transport=net.attach(addr),
+            apply_fn=fsms[i].apply, peers=addrs, clock=clock, seed=i,
+            snapshot_fn=fsms[i].snapshot, restore_fn=fsms[i].restore,
+            heartbeat_interval=0.05, election_timeout=0.3)
+        nodes.append(node)
+        node.start()
+    leader = wait_leader(clock, nodes)
+    li = nodes.index(leader)
+
+    ok = leader.apply(encode_command(MessageType.KVS, {
+        "Op": "set", "DirEnt": {"Key": "cfg/x", "Value": b"42"}}))
+    assert ok is True
+    leader.apply(encode_command(MessageType.REGISTER, {
+        "Node": "web-1", "Address": "10.1.1.1",
+        "Service": {"ID": "web", "Service": "web", "Port": 80}}))
+    clock.advance(0.5)
+    for i, f in enumerate(fsms):
+        assert f.store.kv_get("cfg/x").value == b"42", f"server {i}"
+        assert [n.node for n in f.store.nodes()] == ["web-1"], f"server {i}"

@@ -1,0 +1,209 @@
+"""State store + FSM tests (reference behaviors: agent/consul/state/,
+agent/consul/fsm/)."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.state import FSM, MessageType, StateStore
+from consul_tpu.state.fsm import encode_command
+from consul_tpu.types import CheckStatus, Session
+
+
+@pytest.fixture
+def fsm():
+    return FSM()
+
+
+def register(fsm, node="n1", addr="10.0.0.1", service=None, check=None,
+             idx=1):
+    body = {"Node": node, "Address": addr}
+    if service:
+        body["Service"] = service
+    if check:
+        body["Check"] = check
+    return fsm.apply(encode_command(MessageType.REGISTER, body), idx)
+
+
+def test_register_and_query_catalog(fsm):
+    register(fsm, service={"ID": "web1", "Service": "web", "Port": 80,
+                           "Tags": ["primary"]},
+             check={"CheckID": "web-check", "Name": "web alive",
+                    "Status": "passing", "ServiceID": "web1",
+                    "ServiceName": "web"})
+    s = fsm.store
+    assert [n.node for n in s.nodes()] == ["n1"]
+    assert s.services() == {"web": ["primary"]}
+    pairs = s.service_nodes("web")
+    assert len(pairs) == 1 and pairs[0][1].port == 80
+    csn = s.check_service_nodes("web")
+    assert csn[0]["Checks"][0]["Status"] == "passing"
+    # tag filter
+    assert s.service_nodes("web", tag="primary")
+    assert not s.service_nodes("web", tag="backup")
+
+
+def test_health_filtering_passing_only(fsm):
+    register(fsm, node="a", service={"ID": "w", "Service": "web"},
+             check={"CheckID": "c", "Status": "passing",
+                    "ServiceID": "w", "ServiceName": "web"})
+    register(fsm, node="b", addr="10.0.0.2",
+             service={"ID": "w", "Service": "web"},
+             check={"CheckID": "c", "Status": "critical",
+                    "ServiceID": "w", "ServiceName": "web"})
+    all_nodes = fsm.store.check_service_nodes("web")
+    passing = fsm.store.check_service_nodes("web", passing_only=True)
+    assert len(all_nodes) == 2 and len(passing) == 1
+    assert passing[0]["Node"]["Node"] == "a"
+
+
+def test_deregister_cascades(fsm):
+    register(fsm, service={"ID": "web1", "Service": "web"},
+             check={"CheckID": "c1", "ServiceID": "web1"})
+    fsm.apply(encode_command(MessageType.DEREGISTER, {"Node": "n1"}), 2)
+    s = fsm.store
+    assert not s.nodes()
+    assert not s.service_nodes("web")
+    assert not s.node_checks("n1")
+
+
+def test_kv_ops_and_cas(fsm):
+    def kv(op, key, value=b"", **extra):
+        d = {"Key": key, "Value": value, **extra}
+        return fsm.apply(encode_command(
+            MessageType.KVS, {"Op": op, "DirEnt": d}), 1)
+
+    assert kv("set", "a/b", b"1") is True
+    assert fsm.store.kv_get("a/b").value == b"1"
+    idx = fsm.store.kv_get("a/b").modify_index
+    # cas with right index wins, wrong index loses
+    assert kv("cas", "a/b", b"2", ModifyIndex=idx) is True
+    assert kv("cas", "a/b", b"3", ModifyIndex=idx) is False
+    assert fsm.store.kv_get("a/b").value == b"2"
+    # cas-create (index 0) only when absent
+    assert kv("cas", "new", b"x", ModifyIndex=0) is True
+    assert kv("cas", "new", b"y", ModifyIndex=0) is False
+    # list/keys with separator
+    kv("set", "a/c/d", b"4")
+    assert [e.key for e in fsm.store.kv_list("a/")] == ["a/b", "a/c/d"]
+    assert fsm.store.kv_keys("a/", separator="/") == ["a/b", "a/c/"]
+    # delete-tree
+    assert kv("delete-tree", "a/") is True
+    assert not fsm.store.kv_list("a/")
+    assert fsm.store.kv_get("new") is not None
+
+
+def test_kv_lock_semantics(fsm):
+    register(fsm)  # session needs a node
+    sid = fsm.apply(encode_command(MessageType.SESSION, {
+        "Op": "create", "Session": {"ID": "sess-1", "Node": "n1"}}), 2)
+    assert sid == "sess-1"
+
+    def kv(op, key, **extra):
+        return fsm.apply(encode_command(MessageType.KVS, {
+            "Op": op, "DirEnt": {"Key": key, "Value": b"v", **extra}}), 3)
+
+    # acquire with a live session
+    assert kv("lock", "locks/x", Session="sess-1") is True
+    e = fsm.store.kv_get("locks/x")
+    assert e.session == "sess-1" and e.lock_index == 1
+    # someone else can't steal it
+    fsm.apply(encode_command(MessageType.SESSION, {
+        "Op": "create", "Session": {"ID": "sess-2", "Node": "n1"}}), 4)
+    assert kv("lock", "locks/x", Session="sess-2") is False
+    # release, re-acquire bumps lock_index
+    assert kv("unlock", "locks/x", Session="sess-1") is True
+    assert kv("lock", "locks/x", Session="sess-2") is True
+    assert fsm.store.kv_get("locks/x").lock_index == 2
+    # destroying the session releases the lock
+    fsm.apply(encode_command(MessageType.SESSION, {
+        "Op": "destroy", "Session": "sess-2"}), 5)
+    assert fsm.store.kv_get("locks/x").session == ""
+
+
+def test_session_delete_behavior(fsm):
+    register(fsm)
+    fsm.apply(encode_command(MessageType.SESSION, {
+        "Op": "create", "Session": {"ID": "s", "Node": "n1",
+                                    "Behavior": "delete"}}), 2)
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "lock", "DirEnt": {"Key": "k", "Value": b"v",
+                                 "Session": "s"}}), 3)
+    fsm.apply(encode_command(MessageType.SESSION,
+                             {"Op": "destroy", "Session": "s"}), 4)
+    assert fsm.store.kv_get("k") is None  # delete behavior removes the key
+
+
+def test_node_deletion_invalidates_sessions(fsm):
+    register(fsm)
+    fsm.apply(encode_command(MessageType.SESSION, {
+        "Op": "create", "Session": {"ID": "s", "Node": "n1"}}), 2)
+    fsm.apply(encode_command(MessageType.DEREGISTER, {"Node": "n1"}), 3)
+    assert fsm.store.session_get("s") is None
+
+
+def test_txn_atomicity(fsm):
+    ops_ok = [{"KV": {"Verb": "set", "Key": "t/a", "Value": b"1"}},
+              {"KV": {"Verb": "set", "Key": "t/b", "Value": b"2"}}]
+    res = fsm.apply(encode_command(MessageType.TXN, {"Ops": ops_ok}), 1)
+    assert res["Errors"] is None
+    # failing precondition rolls back everything
+    ops_bad = [{"KV": {"Verb": "set", "Key": "t/c", "Value": b"3"}},
+               {"KV": {"Verb": "check-not-exists", "Key": "t/a"}}]
+    res = fsm.apply(encode_command(MessageType.TXN, {"Ops": ops_bad}), 2)
+    assert res["Errors"]
+    assert fsm.store.kv_get("t/c") is None  # first op not applied
+
+
+def test_blocking_query_wakeup(fsm):
+    s = fsm.store
+    idx0 = s.table_index("kv")
+    results = {}
+
+    def waiter():
+        results["idx"] = s.block_until(["kv"], idx0, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "set", "DirEnt": {"Key": "wake", "Value": b"!"}}), 1)
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert results["idx"] > idx0
+    # unrelated-table change does not wake a kv waiter early
+    idx1 = s.table_index("kv")
+    t2 = threading.Thread(
+        target=lambda: results.update(
+            t2_idx=s.block_until(["kv"], idx1, timeout=0.3)))
+    t2.start()
+    register(fsm)  # touches nodes table only
+    t2.join()
+    assert results["t2_idx"] == idx1  # timed out, index unchanged
+
+
+def test_snapshot_restore_roundtrip(fsm):
+    register(fsm, service={"ID": "w", "Service": "web", "Port": 80},
+             check={"CheckID": "c", "Status": "warning",
+                    "ServiceID": "w", "ServiceName": "web"})
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "set", "DirEnt": {"Key": "k", "Value": b"v",
+                                "Flags": 42}}), 2)
+    fsm.apply(encode_command(MessageType.SESSION, {
+        "Op": "create", "Session": {"ID": "s", "Node": "n1"}}), 3)
+    blob = fsm.snapshot()
+
+    fsm2 = FSM()
+    fsm2.restore(blob)
+    s2 = fsm2.store
+    assert [n.node for n in s2.nodes()] == ["n1"]
+    assert s2.kv_get("k").flags == 42
+    assert s2.session_get("s").node == "n1"
+    assert s2.check_service_nodes("web")[0]["Checks"][0]["Status"] \
+        == "warning"
+    assert s2.index == fsm.store.index
+
+
+def test_unknown_command_ignored(fsm):
+    assert fsm.apply(bytes([200]) + b"junk", 1) is None
