@@ -311,7 +311,8 @@ def load(
 
     if dev:
         kwargs.setdefault("server_mode", True)
-        kwargs.setdefault("bootstrap", True)
+        if kwargs.get("server_mode") and not kwargs.get("bootstrap_expect"):
+            kwargs.setdefault("bootstrap", True)
         kwargs["dev_mode"] = True
 
     cfg = RuntimeConfig(**kwargs)

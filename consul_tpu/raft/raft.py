@@ -96,6 +96,7 @@ class RaftNode:
         self._stopped = False
         self._last_leader_contact = 0.0
         self._apply_results: dict[int, Any] = {}
+        self._leadership_era = 0  # bumps on every role transition
 
         # restore FSM from snapshot if present
         if self.store.snapshot_data is not None and restore_fn is not None:
@@ -136,6 +137,7 @@ class RaftNode:
             if self.role != Role.LEADER or self._stopped:
                 raise NotLeader(self.leader_id)
             term = self.store.term
+            era = self._leadership_era
             entry = {"term": term, "data": data, "kind": "cmd"}
             self.store.append([entry])
             index = self.store.last_index()
@@ -156,8 +158,13 @@ class RaftNode:
             if self._stopped and self.last_applied < index:
                 raise ApplyTimeout("node stopped")
             # a new leader may have overwritten our uncommitted entry —
-            # success only if OUR entry (same term) survived at `index`
-            if self.store.term_at(index) != term:
+            # success only if OUR entry (same term) survived at `index`.
+            # If the entry is still in the log, check its term; if it was
+            # compacted, it committed — ours iff leadership never lapsed.
+            if index > self.store.snapshot_index:
+                if self.store.term_at(index) != term:
+                    raise NotLeader(self.leader_id)
+            elif self._leadership_era != era:
                 raise NotLeader(self.leader_id)
             result = self._apply_results.pop(index, None)
             if isinstance(result, Exception):
@@ -244,30 +251,51 @@ class RaftNode:
             self._reset_election_timer()
         self.metrics.incr("raft.election.start")
         self.log.info("starting election for term %d", term)
-        votes = 1  # self-vote
-        for peer in peers:
+        need = len(self.peers) // 2 + 1
+        votes = [1]  # self-vote
+        votes_lock = threading.Lock()
+
+        def try_win() -> None:
+            with self._lock:
+                if self._stopped or self.role != Role.CANDIDATE \
+                        or self.store.term != term:
+                    return
+                if votes[0] >= need and self.role == Role.CANDIDATE:
+                    self._become_leader()
+
+        def ask(peer: str) -> None:
             try:
                 reply = self.transport.call(peer, "request_vote", {
                     "term": term, "candidate": self.id,
                     "candidate_addr": self.transport.addr,
-                    "last_log_index": last_idx, "last_log_term": last_term})
+                    "last_log_index": last_idx, "last_log_term": last_term},
+                    timeout=self.election_timeout)
             except Exception:  # noqa: BLE001 — unreachable peer
-                continue
+                return
             with self._lock:
-                if self._stopped or self.role != Role.CANDIDATE \
-                        or self.store.term != term:
+                if self._stopped or self.store.term != term:
                     return
                 if reply.get("term", 0) > term:
                     self._step_down(reply["term"])
                     return
             if reply.get("granted"):
-                votes += 1
-        with self._lock:
-            if self._stopped or self.role != Role.CANDIDATE \
-                    or self.store.term != term:
-                return
-            if votes * 2 > len(self.peers):
-                self._become_leader()
+                with votes_lock:
+                    votes[0] += 1
+                # majority check after EVERY grant: a dead peer's connect
+                # timeout must never stall the win past the next election
+                try_win()
+
+        if isinstance(self.clock, SimClock):
+            for peer in peers:
+                ask(peer)
+        else:
+            threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                       for p in peers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=self.election_timeout)
+        try_win()
 
     def _become_leader(self) -> None:
         self.role = Role.LEADER
@@ -280,9 +308,14 @@ class RaftNode:
             self._match_index[p] = 0
         if self._election_timer is not None:
             self._election_timer.cancel()
-        # commit a no-op to learn the commit frontier of prior terms
-        self.store.append([{"term": self.store.term, "data": b"",
-                            "kind": "noop"}])
+        # commit a no-op to learn the commit frontier of prior terms, and
+        # make sure our own address is in the REPLICATED configuration —
+        # a bootstrap seed otherwise never appears in followers' peer sets
+        # (inconsistent quorums → split-brain risk)
+        self.store.append([
+            {"term": self.store.term, "data": b"", "kind": "noop"},
+            {"term": self.store.term, "data": b"", "kind": "config",
+             "add": self.transport.addr}])
         self._replicate_all()
         self._schedule_heartbeat()
 
@@ -290,6 +323,8 @@ class RaftNode:
         if term > self.store.term:
             self.store.set_term_vote(term, None)
         was_leader = self.role == Role.LEADER
+        if was_leader:
+            self._leadership_era += 1
         self.role = Role.FOLLOWER
         if was_leader and self._heartbeat_timer is not None:
             self._heartbeat_timer.cancel()
@@ -334,27 +369,30 @@ class RaftNode:
         self._advance_commit()
 
     def _replicate_one(self, peer: str) -> None:
-        send_snap = False
+        # build args under the lock (one critical section — the log may be
+        # compacted by a concurrent snapshot, so next_index and
+        # first_index must be read together); RPC outside it
         with self._lock:
             if self.role != Role.LEADER:
                 return
             term = self.store.term
             nxt = self._next_index.get(peer, self.store.last_index() + 1)
-            send_snap = nxt < self.store.first_index()
+            if nxt < self.store.first_index():
+                send_snap = True
+                args = None
+            else:
+                send_snap = False
+                prev_idx = nxt - 1
+                prev_term = self.store.term_at(prev_idx)
+                entries = self.store.entries_from(nxt)
+                args = {
+                    "term": term, "leader": self.transport.addr,
+                    "prev_log_index": prev_idx, "prev_log_term": prev_term,
+                    "entries": entries, "leader_commit": self.commit_index,
+                }
         if send_snap:
             self._send_snapshot(peer)
             return
-        with self._lock:
-            if self.role != Role.LEADER:
-                return
-            prev_idx = nxt - 1
-            prev_term = self.store.term_at(prev_idx)
-            entries = self.store.entries_from(nxt)
-            args = {
-                "term": term, "leader": self.transport.addr,
-                "prev_log_index": prev_idx, "prev_log_term": prev_term,
-                "entries": entries, "leader_commit": self.commit_index,
-            }
         try:
             reply = self.transport.call(peer, "append_entries", args)
         except Exception:  # noqa: BLE001 — peer unreachable

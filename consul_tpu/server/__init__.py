@@ -1,0 +1,14 @@
+"""L2 server core: the RPC fabric + consensus + catalog brain.
+
+Mirrors agent/consul/ in the reference: one multiplexed TCP port serving
+byte-tag-dispatched protocols (agent/pool/conn.go:33-49), msgpack RPC
+endpoints with leader forwarding and blocking queries, the leader's
+serf→catalog reconcile loop (SURVEY.md §3.4), session TTLs, and
+coordinate batching.
+"""
+
+from consul_tpu.server.rpc import RPCServer, ConnPool, RPCError
+from consul_tpu.server.server import Server
+from consul_tpu.server.client import Client
+
+__all__ = ["RPCServer", "ConnPool", "RPCError", "Server", "Client"]

@@ -1,0 +1,109 @@
+"""Client agent delegate: no raft, RPCs forwarded to servers.
+
+Mirrors consul.Client (agent/agent.go:745): joins the LAN gossip pool
+with role="node" tags, discovers servers from member tags, and forwards
+every RPC through the connection pool to a randomly-picked server
+(rebalanced on membership changes — agent/router's job in the
+reference).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import uuid
+from typing import Any, Optional
+
+from consul_tpu.config import RuntimeConfig
+from consul_tpu.gossip import Serf
+from consul_tpu.gossip.serf import EventType, SerfEvent
+from consul_tpu.gossip.transport import Transport, UDPTransport
+from consul_tpu.server.rpc import ConnPool, RPCError
+from consul_tpu.types import MemberStatus
+from consul_tpu.utils import log
+
+
+class NoServersError(RPCError):
+    pass
+
+
+class Client:
+    def __init__(self, config: RuntimeConfig,
+                 serf_transport: Optional[Transport] = None) -> None:
+        self.config = config
+        self.name = config.node_name or f"client-{uuid.uuid4().hex[:8]}"
+        self.node_id = config.node_id or str(uuid.uuid4())
+        self.log = log.named(f"client.{self.name}")
+        self.pool = ConnPool()
+        self._lock = threading.Lock()
+        self._servers: list[str] = []
+        self.rng = random.Random()
+
+        tags = {"role": "node", "dc": config.datacenter, "id": self.node_id}
+        self.serf = Serf(
+            name=self.name,
+            transport=serf_transport or UDPTransport(
+                config.bind_addr,
+                config.port("serf_lan") if not config.dev_mode else 0),
+            config=config.gossip_lan,
+            tags=tags,
+            event_handler=self._serf_event)
+
+    def start(self) -> None:
+        self.serf.start()
+
+    def join(self, addrs: list[str]) -> int:
+        n = self.serf.join(addrs)
+        self._refresh_servers()
+        return n
+
+    def leave(self) -> None:
+        self.serf.leave()
+
+    def shutdown(self) -> None:
+        self.serf.shutdown()
+        self.pool.close()
+
+    # ----------------------------------------------------------------- RPC
+
+    def rpc(self, method: str, args: dict[str, Any],
+            retries: int = 3) -> Any:
+        """Forward to a server; retry on transport errors with another
+        server (router rebalancing-lite)."""
+        last: Exception = NoServersError("no known servers")
+        for _ in range(retries):
+            server = self._pick_server()
+            if server is None:
+                self._refresh_servers()
+                server = self._pick_server()
+                if server is None:
+                    raise NoServersError("no consul servers in gossip pool")
+            try:
+                return self.pool.call(server, method, args)
+            except ConnectionError as e:
+                last = e
+                with self._lock:
+                    if server in self._servers:
+                        self._servers.remove(server)
+        raise last
+
+    def _pick_server(self) -> Optional[str]:
+        with self._lock:
+            if not self._servers:
+                return None
+            return self.rng.choice(self._servers)
+
+    def _refresh_servers(self) -> None:
+        servers = [m.tags.get("rpc_addr", "")
+                   for m in self.serf.members()
+                   if m.tags.get("role") == "consul"
+                   and m.status == MemberStatus.ALIVE
+                   and m.tags.get("rpc_addr")]
+        with self._lock:
+            self._servers = servers
+
+    def _serf_event(self, ev: SerfEvent) -> None:
+        if ev.type in (EventType.MEMBER_JOIN, EventType.MEMBER_FAILED,
+                       EventType.MEMBER_LEAVE, EventType.MEMBER_UPDATE,
+                       EventType.MEMBER_REAP):
+            self._refresh_servers()

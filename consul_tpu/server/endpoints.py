@@ -1,0 +1,255 @@
+"""RPC endpoints: the msgpack net/rpc surface.
+
+Mirrors the reference's *_endpoint.go files registered in
+agent/consul/server_register.go:8-26. Read endpoints support blocking
+queries (MinQueryIndex/MaxQueryTime) and stale reads; writes go through
+forward_or_apply (leader forwarding, §3.3).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+from consul_tpu.server.rpc import RPCError
+from consul_tpu.state import MessageType
+from consul_tpu.types import CheckStatus
+
+
+def register_endpoints(srv) -> None:
+    e = srv.endpoints
+    state = srv.state
+
+    def read(name, fn):
+        """Register a read endpoint with consistency modes (rpc.go
+        ForwardRPC): default → forwarded to the leader (read-your-writes);
+        AllowStale → served from local replicated state."""
+
+        def wrapper(args):
+            if not args.get("AllowStale") and not srv.is_leader():
+                return srv._forward_to_leader(name, args)
+            return fn(args)
+
+        e[name] = wrapper
+
+    # ----------------------------------------------------------- Status
+    def status_leader(args):
+        return srv.leader_rpc_addr() or ""
+
+    def status_peers(args):
+        return sorted(srv.raft.peers)
+
+    e["Status.Leader"] = status_leader
+    e["Status.Peers"] = status_peers
+    e["Status.Ping"] = lambda args: "pong"
+    read("Status.RaftStats", lambda args: srv.raft.stats())
+
+    # --------------------------------------------------------- Internal
+    def internal_apply(args):
+        """Leader-side landing pad for forwarded writes."""
+        if not srv.is_leader():
+            raise RPCError("not leader")
+        from consul_tpu.state.fsm import encode_command
+
+        return srv.raft.apply(encode_command(
+            MessageType(args["Type"]), args["Body"]))
+
+    e["Internal.Apply"] = internal_apply
+
+    # ---------------------------------------------------------- Catalog
+    def catalog_register(args):
+        return srv.forward_or_apply(MessageType.REGISTER, args)
+
+    def catalog_deregister(args):
+        return srv.forward_or_apply(MessageType.DEREGISTER, args)
+
+    def catalog_list_nodes(args):
+        return srv.blocking_query(args, ("nodes",), lambda: {
+            "Nodes": [n.to_dict() for n in state.nodes()]})
+
+    def catalog_list_services(args):
+        return srv.blocking_query(args, ("services",), lambda: {
+            "Services": state.services()})
+
+    def catalog_service_nodes(args):
+        svc = args.get("ServiceName", "")
+        tag = args.get("ServiceTag") or None
+        return srv.blocking_query(args, ("services", "nodes"), lambda: {
+            "ServiceNodes": [
+                {**n.to_dict(), **{
+                    "ServiceID": s.id, "ServiceName": s.service,
+                    "ServiceTags": s.tags, "ServiceAddress": s.address,
+                    "ServicePort": s.port, "ServiceMeta": s.meta}}
+                for n, s in state.service_nodes(svc, tag)]})
+
+    def catalog_node_services(args):
+        node = args.get("Node", "")
+        n = state.get_node(node)
+        return srv.blocking_query(args, ("services", "nodes"), lambda: {
+            "NodeServices": None if n is None else {
+                "Node": n.to_dict(),
+                "Services": {s.id: s.to_dict()
+                             for s in state.node_services(node)}}})
+
+    e["Catalog.Register"] = catalog_register
+    e["Catalog.Deregister"] = catalog_deregister
+    read("Catalog.ListNodes", catalog_list_nodes)
+    read("Catalog.ListServices", catalog_list_services)
+    read("Catalog.ServiceNodes", catalog_service_nodes)
+    read("Catalog.NodeServices", catalog_node_services)
+
+    # ------------------------------------------------------------ Health
+    def health_service_nodes(args):
+        svc = args.get("ServiceName", "")
+        tag = args.get("ServiceTag") or None
+        passing = bool(args.get("MustBePassing"))
+        return srv.blocking_query(
+            args, ("services", "nodes", "checks"), lambda: {
+                "Nodes": state.check_service_nodes(
+                    svc, tag, passing_only=passing)})
+
+    def health_node_checks(args):
+        node = args.get("Node", "")
+        return srv.blocking_query(args, ("checks",), lambda: {
+            "HealthChecks": [c.to_dict()
+                             for c in state.node_checks(node)]})
+
+    def health_service_checks(args):
+        svc = args.get("ServiceName", "")
+        return srv.blocking_query(args, ("checks",), lambda: {
+            "HealthChecks": [c.to_dict()
+                             for c in state.service_checks(svc)]})
+
+    def health_checks_in_state(args):
+        status = args.get("State", "any")
+        return srv.blocking_query(args, ("checks",), lambda: {
+            "HealthChecks": [c.to_dict()
+                             for c in state.checks_in_state(status)]})
+
+    read("Health.ServiceNodes", health_service_nodes)
+    read("Health.NodeChecks", health_node_checks)
+    read("Health.ServiceChecks", health_service_checks)
+    read("Health.ChecksInState", health_checks_in_state)
+
+    # ---------------------------------------------------------------- KV
+    KV_OPS = {"set", "cas", "lock", "unlock", "delete", "delete-cas",
+              "delete-tree"}
+
+    def kv_apply(args):
+        # preApply validation: reject before anything reaches the raft log
+        # (reference: kvs_endpoint.go preApply)
+        op = args.get("Op", "set")
+        if op not in KV_OPS:
+            raise RPCError(f"unknown KV operation {op!r}")
+        d = args.get("DirEnt") or {}
+        if not d.get("Key"):
+            raise RPCError("missing key")
+        return srv.forward_or_apply(MessageType.KVS, args)
+
+    def kv_get(args):
+        key = args.get("Key", "")
+        return srv.blocking_query(args, ("kv",), lambda: {
+            "Entries": [e_.to_dict()] if (e_ := state.kv_get(key)) else []})
+
+    def kv_list(args):
+        prefix = args.get("Key", "")
+        return srv.blocking_query(args, ("kv",), lambda: {
+            "Entries": [x.to_dict() for x in state.kv_list(prefix)]})
+
+    def kv_keys(args):
+        return srv.blocking_query(args, ("kv",), lambda: {
+            "Keys": state.kv_keys(args.get("Prefix", ""),
+                                  args.get("Seperator",
+                                           args.get("Separator", "")))})
+
+    e["KVS.Apply"] = kv_apply
+    read("KVS.Get", kv_get)
+    read("KVS.List", kv_list)
+    read("KVS.ListKeys", kv_keys)
+
+    # ------------------------------------------------------------ Session
+    def session_apply(args):
+        op = args.get("Op", "create")
+        if op == "create":
+            sess = dict(args.get("Session") or {})
+            sess.setdefault("ID", str(uuid.uuid4()))
+            return srv.forward_or_apply(
+                MessageType.SESSION, {"Op": "create", "Session": sess})
+        return srv.forward_or_apply(MessageType.SESSION, args)
+
+    def session_get(args):
+        sid = args.get("SessionID", "")
+        return srv.blocking_query(args, ("sessions",), lambda: {
+            "Sessions": [s.to_dict()]
+            if (s := state.session_get(sid)) else []})
+
+    def session_list(args):
+        return srv.blocking_query(args, ("sessions",), lambda: {
+            "Sessions": [s.to_dict() for s in state.session_list(
+                args.get("Node"))]})
+
+    def session_renew(args):
+        sid = args.get("SessionID", "")
+        if not srv.is_leader():
+            return srv._forward_to_leader("Session.Renew", args)
+        if not srv.renew_session(sid):
+            return {"Sessions": []}
+        s = state.session_get(sid)
+        return {"Sessions": [s.to_dict()] if s else []}
+
+    e["Session.Apply"] = session_apply
+    read("Session.Get", session_get)
+    read("Session.List", session_list)
+    e["Session.Renew"] = session_renew
+
+    # --------------------------------------------------------- Coordinate
+    def coordinate_update(args):
+        if not srv.is_leader():
+            return srv._forward_to_leader("Coordinate.Update", args)
+        srv.queue_coordinate_update(args.get("Node", ""),
+                                    args.get("Coord") or {})
+        return True
+
+    def coordinate_list(args):
+        return srv.blocking_query(args, ("coordinates",), lambda: {
+            "Coordinates": state.coordinates()})
+
+    def coordinate_node(args):
+        node = args.get("Node", "")
+        return srv.blocking_query(args, ("coordinates",), lambda: {
+            "Coordinates": [c] if (c := state.coordinate_get(node)) else []})
+
+    e["Coordinate.Update"] = coordinate_update
+    read("Coordinate.ListNodes", coordinate_list)
+    read("Coordinate.Node", coordinate_node)
+
+    # ---------------------------------------------------------------- Txn
+    def txn_apply(args):
+        return srv.forward_or_apply(MessageType.TXN, args)
+
+    e["Txn.Apply"] = txn_apply
+
+    # ------------------------------------------------------- ConfigEntry
+    def config_apply(args):
+        return srv.forward_or_apply(MessageType.CONFIG_ENTRY, args)
+
+    def config_get(args):
+        key = f"{args.get('Kind', '')}/{args.get('Name', '')}"
+        return srv.blocking_query(args, ("config_entries",), lambda: {
+            "Entry": state.raw_get("config_entries", key)})
+
+    def config_list(args):
+        kind = args.get("Kind", "")
+        return srv.blocking_query(args, ("config_entries",), lambda: {
+            "Entries": [v for v in state.raw_list("config_entries")
+                        if not kind or v.get("Kind") == kind]})
+
+    e["ConfigEntry.Apply"] = config_apply
+    read("ConfigEntry.Get", config_get)
+    read("ConfigEntry.List", config_list)
+
+    # ------------------------------------------------------------- Agent-ish
+    def members(args):
+        return [m.snapshot() for m in srv.serf.members(include_left=True)]
+
+    e["Internal.Members"] = members

@@ -1,0 +1,276 @@
+"""The multiplexed RPC port + client connection pool.
+
+One TCP listener, first-byte protocol dispatch — the reference's
+scheme (agent/consul/rpc.go:157-242 handleConn over the tags in
+agent/pool/conn.go:33-49). We serve two tags:
+
+  RPC_CONSUL (0x00): length-prefixed msgpack request/response frames
+      {seq, method, args} → {seq, result | error}; one in-flight
+      request per connection (blocking queries park the connection,
+      so clients pool connections — like yamux streams, simplified).
+  RPC_RAFT (0x01): raft RPCs {method, args} → reply, the RaftLayer
+      equivalent (agent/consul/raft_rpc.go).
+
+Frames: 4-byte big-endian length + msgpack body. 64MB frame cap.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from consul_tpu.utils import log, telemetry
+
+RPC_CONSUL = 0x00
+RPC_RAFT = 0x01
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class RPCError(Exception):
+    """Application-level error returned by a remote handler."""
+
+
+def read_frame(sock: socket.socket) -> Optional[dict[str, Any]]:
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (ln,) = struct.unpack(">I", hdr)
+    if ln > MAX_FRAME:
+        raise ValueError(f"frame too large: {ln}")
+    body = _read_exact(sock, ln)
+    if body is None:
+        return None
+    return msgpack.unpackb(body, raw=False)
+
+
+def write_frame(sock: socket.socket, obj: dict[str, Any]) -> None:
+    blob = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class RPCServer:
+    """The server side of the multiplexed port."""
+
+    def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0) -> None:
+        self.log = log.named("rpc.server")
+        self.metrics = telemetry.default
+        self._rpc_handler: Optional[Callable[[str, dict, str], Any]] = None
+        self._raft_handler: Optional[Callable[[str, str, dict], dict]] = None
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                try:
+                    tag = _read_exact(sock, 1)
+                    if tag is None:
+                        return
+                    src = f"{self.client_address[0]}:{self.client_address[1]}"
+                    if tag[0] == RPC_CONSUL:
+                        outer._serve_consul(sock, src)
+                    elif tag[0] == RPC_RAFT:
+                        outer._serve_raft(sock, src)
+                    else:
+                        outer.log.warning("unknown protocol byte %d from %s",
+                                          tag[0], src)
+                except Exception as e:  # noqa: BLE001
+                    outer.log.debug("conn error: %s", e)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Server((bind_addr, port), _Handler)
+        self.addr = "%s:%d" % self._srv.server_address
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name=f"rpc-{self.addr}")
+
+    def start(self, rpc_handler: Callable[[str, dict, str], Any],
+              raft_handler: Optional[Callable[[str, str, dict], dict]] = None
+              ) -> None:
+        self._rpc_handler = rpc_handler
+        self._raft_handler = raft_handler
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def _serve_consul(self, sock: socket.socket, src: str) -> None:
+        while True:
+            req = read_frame(sock)
+            if req is None:
+                return
+            seq = req.get("seq", 0)
+            method = req.get("method", "")
+            start = telemetry.time_now()
+            try:
+                result = self._rpc_handler(method, req.get("args") or {},
+                                           src)
+                write_frame(sock, {"seq": seq, "result": result})
+            except RPCError as e:
+                write_frame(sock, {"seq": seq, "error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("rpc %s failed: %s", method, e)
+                write_frame(sock, {"seq": seq, "error": f"internal: {e}"})
+            finally:
+                self.metrics.measure_since(
+                    "rpc.request", start, {"method": method})
+
+    def _serve_raft(self, sock: socket.socket, src: str) -> None:
+        while True:
+            req = read_frame(sock)
+            if req is None:
+                return
+            try:
+                reply = self._raft_handler(req["method"], src,
+                                           req.get("args") or {})
+                write_frame(sock, {"result": reply})
+            except Exception as e:  # noqa: BLE001
+                write_frame(sock, {"error": str(e)})
+
+
+class _Conn:
+    def __init__(self, addr: str, tag: int, timeout: float) -> None:
+        host, port = addr.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+        self.sock.sendall(bytes([tag]))
+        self.addr = addr
+        self.seq = 0
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ConnPool:
+    """Client-side pooled connections to servers (agent/pool/ConnPool).
+
+    One in-flight request per pooled connection; blocking queries hold a
+    connection for their duration, so the pool grows on demand (capped).
+    """
+
+    def __init__(self, max_per_addr: int = 8,
+                 connect_timeout: float = 5.0) -> None:
+        self.max_per_addr = max_per_addr
+        self.connect_timeout = connect_timeout
+        self._idle: dict[str, list[_Conn]] = {}
+        self._lock = threading.Lock()
+        self.log = log.named("rpc.pool")
+
+    def call(self, addr: str, method: str, args: dict[str, Any],
+             timeout: float = 60.0) -> Any:
+        """Consul-RPC request/response. Raises RPCError for app errors,
+        ConnectionError for transport failures. A stale idle connection
+        (reaped server-side while pooled) gets one retry on a fresh dial
+        before the server is reported unreachable."""
+        conn, pooled = self._get(addr)
+        try:
+            return self._call_on(conn, addr, method, args, timeout)
+        except ConnectionError:
+            if not pooled:
+                raise
+            conn = _Conn(addr, RPC_CONSUL, self.connect_timeout)
+            return self._call_on(conn, addr, method, args, timeout)
+
+    def _call_on(self, conn: "_Conn", addr: str, method: str,
+                 args: dict[str, Any], timeout: float) -> Any:
+        try:
+            conn.seq += 1
+            conn.sock.settimeout(timeout)
+            write_frame(conn.sock, {"seq": conn.seq, "method": method,
+                                    "args": args})
+            resp = read_frame(conn.sock)
+            if resp is None:
+                raise ConnectionError(f"connection closed by {addr}")
+            if resp.get("error") is not None:
+                self._put(addr, conn)
+                raise RPCError(resp["error"])
+            self._put(addr, conn)
+            return resp.get("result")
+        except (OSError, ValueError) as e:
+            conn.close()
+            raise ConnectionError(f"rpc to {addr} failed: {e}") from e
+
+    def raft_call(self, addr: str, method: str,
+                  args: dict[str, Any], timeout: float = 5.0) -> dict:
+        """One-shot raft RPC (separate conns, tag RPC_RAFT)."""
+        conn = _Conn(addr, RPC_RAFT, self.connect_timeout)
+        try:
+            conn.sock.settimeout(timeout)
+            write_frame(conn.sock, {"method": method, "args": args})
+            resp = read_frame(conn.sock)
+            if resp is None:
+                raise ConnectionError(f"connection closed by {addr}")
+            if resp.get("error") is not None:
+                raise ConnectionError(resp["error"])
+            return resp.get("result") or {}
+        finally:
+            conn.close()
+
+    def _get(self, addr: str) -> tuple[_Conn, bool]:
+        """Returns (conn, came_from_pool)."""
+        with self._lock:
+            idle = self._idle.get(addr)
+            if idle:
+                return idle.pop(), True
+        return _Conn(addr, RPC_CONSUL, self.connect_timeout), False
+
+    def _put(self, addr: str, conn: _Conn) -> None:
+        with self._lock:
+            idle = self._idle.setdefault(addr, [])
+            if len(idle) < self.max_per_addr:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            for conns in self._idle.values():
+                for c in conns:
+                    c.close()
+            self._idle.clear()
+
+
+class PooledRaftTransport:
+    """RaftTransport over the multiplexed port (RaftLayer equivalent)."""
+
+    def __init__(self, addr: str, pool: ConnPool) -> None:
+        self.addr = addr
+        self.pool = pool
+        self._handler = None
+
+    def set_handler(self, handler) -> None:
+        self._handler = handler
+
+    def handle(self, method: str, src: str, args: dict) -> dict:
+        if self._handler is None:
+            raise ConnectionError("raft not ready")
+        return self._handler(method, src, args)
+
+    def call(self, peer: str, method: str, args: dict[str, Any],
+             timeout: float = 5.0) -> dict[str, Any]:
+        return self.pool.raft_call(peer, method, args, timeout)

@@ -1,0 +1,458 @@
+"""The Server: serf + raft + FSM + RPC endpoints + leader loops.
+
+Mirrors consul.Server (agent/consul/server.go:467) and its startup
+sequence (SURVEY.md §3.1): RPC listener with byte dispatch, raft with
+the FSM, LAN serf with server-advertisement tags, the serf event
+handler feeding the leader's reconcile loop (§3.4 — the north-star
+path: member failure → catalog health flip), gossip-driven raft
+bootstrap (maybeBootstrap, server_serf.go:391), leader-side session TTL
+timers, and coordinate update batching.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from consul_tpu.config import RuntimeConfig
+from consul_tpu.gossip import Serf
+from consul_tpu.gossip.serf import EventType, SerfEvent
+from consul_tpu.gossip.transport import Transport, UDPTransport
+from consul_tpu.raft import RaftNode
+from consul_tpu.raft.raft import NotLeader
+from consul_tpu.raft.storage import RaftStorage
+from consul_tpu.server.endpoints import register_endpoints
+from consul_tpu.server.rpc import (ConnPool, PooledRaftTransport, RPCError,
+                                   RPCServer)
+from consul_tpu.state import FSM, MessageType
+from consul_tpu.state.fsm import encode_command
+from consul_tpu.types import (CheckStatus, MemberStatus, SERF_CHECK_ID,
+                              SERF_CHECK_NAME)
+from consul_tpu.utils import log, telemetry
+from consul_tpu.utils.clock import RealTimers
+
+
+class NoLeaderError(RPCError):
+    pass
+
+
+class Server:
+    def __init__(self, config: RuntimeConfig,
+                 serf_transport: Optional[Transport] = None,
+                 rpc_bind: Optional[str] = None) -> None:
+        self.config = config
+        self.name = config.node_name or f"server-{uuid.uuid4().hex[:8]}"
+        self.node_id = config.node_id or str(uuid.uuid4())
+        self.log = log.named(f"server.{self.name}")
+        self.metrics = telemetry.default
+        self.scheduler = RealTimers()
+        self._shutdown = False
+
+        # L1: replicated state
+        self.fsm = FSM()
+        self.state = self.fsm.store
+
+        # RPC port (serves consul RPC + raft)
+        self.rpc = RPCServer(rpc_bind or config.bind_addr,
+                             config.port("server")
+                             if not config.dev_mode else 0)
+        self.pool = ConnPool()
+        self.raft_transport = PooledRaftTransport(self.rpc.addr, self.pool)
+
+        data_dir = None
+        if config.data_dir:
+            import os
+
+            data_dir = os.path.join(config.data_dir, "raft")
+        self.raft = RaftNode(
+            node_id=self.name,
+            transport=self.raft_transport,
+            apply_fn=self.fsm.apply,
+            snapshot_fn=self.fsm.snapshot,
+            restore_fn=self.fsm.restore,
+            storage=RaftStorage(data_dir),
+            peers=[self.rpc.addr],
+            heartbeat_interval=config.raft_heartbeat_timeout / 10,
+            election_timeout=config.raft_election_timeout,
+            snapshot_threshold=config.raft_snapshot_threshold)
+
+        # L0: gossip membership. Tags advertise the server role + RPC addr
+        # (reference: agent/consul/server_serf.go:101-146).
+        tags = {
+            "role": "consul", "dc": config.datacenter, "id": self.node_id,
+            "rpc_addr": self.rpc.addr,
+            "expect": str(config.bootstrap_expect or 0),
+            "bootstrap": "1" if config.bootstrap else "0",
+        }
+        self._reconcile_ch: list[SerfEvent] = []
+        self._reconcile_lock = threading.Lock()
+        self.serf = Serf(
+            name=self.name,
+            transport=serf_transport or UDPTransport(
+                config.bind_addr,
+                config.port("serf_lan") if not config.dev_mode else 0),
+            config=config.gossip_lan,
+            tags=tags,
+            event_handler=self._serf_event,
+            keyring=self._keyring())
+
+        # endpoint registry: "Service.Method" -> handler(args, ctx)
+        self.endpoints: dict[str, Any] = {}
+        register_endpoints(self)
+
+        # leader-side session TTL bookkeeping (session_ttl.go)
+        self._session_expiry: dict[str, float] = {}
+        self._coord_updates: dict[str, dict[str, Any]] = {}
+        self._coord_lock = threading.Lock()
+        self._maybe_bootstrapped = False
+        self._was_leader = False
+        self._loop_timers = []
+
+    def _keyring(self):
+        if not self.config.encrypt_key:
+            return None
+        import base64
+
+        from consul_tpu.gossip.messages import Keyring
+
+        return Keyring([base64.b64decode(self.config.encrypt_key)])
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self.rpc.start(self.handle_rpc, self.raft_transport.handle)
+        # passive raft start: no self-elections until bootstrapped/contacted
+        if self.config.bootstrap:
+            self.raft.start()
+            self._maybe_bootstrapped = True
+        self.serf.start()
+        self._every(1.0, self._leader_tick)
+        self._every(self.config.reconcile_interval, self._full_reconcile)
+        self._every(self.config.coordinate_update_period, self._flush_coords)
+        self.log.info("server started: rpc=%s serf=%s", self.rpc.addr,
+                      self.serf.memberlist.transport.addr)
+
+    def join(self, addrs: list[str]) -> int:
+        return self.serf.join(addrs)
+
+    def leave(self) -> None:
+        if self.is_leader() and len(self.raft.peers) > 1:
+            try:
+                self.raft.remove_peer(self.raft.transport.addr)
+            except Exception:  # noqa: BLE001
+                pass
+        self.serf.leave()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for t in self._loop_timers:
+            if t is not None:
+                t.cancel()
+        self.serf.shutdown()
+        self.raft.shutdown()
+        self.rpc.shutdown()
+        self.pool.close()
+
+    def _every(self, interval: float, fn) -> None:
+        slot = len(self._loop_timers)
+        self._loop_timers.append(None)
+
+        def tick() -> None:
+            if self._shutdown:
+                return
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                self.log.error("loop %s: %s", fn.__name__, e)
+            if not self._shutdown:
+                # replace, never append: fired timers must not accumulate
+                self._loop_timers[slot] = self.scheduler.after(interval,
+                                                               tick)
+
+        self._loop_timers[slot] = self.scheduler.after(interval, tick)
+
+    # --------------------------------------------------------------- surface
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    def leader_rpc_addr(self) -> Optional[str]:
+        return self.raft.leader()
+
+    # ------------------------------------------------------------------- RPC
+
+    def handle_rpc(self, method: str, args: dict[str, Any],
+                   src: str) -> Any:
+        handler = self.endpoints.get(method)
+        if handler is None:
+            raise RPCError(f"unknown RPC method {method!r}")
+        return handler(args)
+
+    def forward_or_apply(self, msg_type: MessageType,
+                         body: dict[str, Any]) -> Any:
+        """The write path (§3.3): leader applies via raft; followers
+        forward to the leader (ForwardRPC, rpc.go:637-649)."""
+        if self.is_leader():
+            return self.raft.apply(encode_command(msg_type, body))
+        return self._forward_to_leader(
+            f"Internal.Apply", {"Type": int(msg_type), "Body": body})
+
+    def _forward_to_leader(self, method: str, args: dict[str, Any],
+                           retries: int = 5) -> Any:
+        last: Exception = NoLeaderError("no known leader")
+        for _ in range(retries):
+            if self.is_leader():
+                # leadership arrived mid-retry — serve locally
+                return self.handle_rpc(method, args, "local")
+            leader = self.leader_rpc_addr()
+            if leader and leader != self.rpc.addr:
+                try:
+                    return self.pool.call(leader, method, args)
+                except ConnectionError as e:
+                    last = e
+                except RPCError as e:
+                    # retry only leadership races — application errors
+                    # must not be re-submitted (a bad command would be
+                    # re-committed on every retry)
+                    if "not leader" not in str(e):
+                        raise
+                    last = e
+            time.sleep(0.2)
+        raise NoLeaderError(f"failed to reach leader: {last}")
+
+    # --------------------------------------------------- blocking queries
+
+    def blocking_query(self, args: dict[str, Any], tables: tuple[str, ...],
+                       run) -> dict[str, Any]:
+        """agent/blockingquery/blockingquery.go:117 — run the query; if
+        index <= MinQueryIndex, wait for a change and re-run."""
+        min_index = int(args.get("MinQueryIndex") or 0)
+        max_time = min(float(args.get("MaxQueryTime")
+                             or self.config.default_query_time),
+                       self.config.max_query_time)
+        deadline = time.monotonic() + max_time
+        while True:
+            idx = self.state.table_index(*tables)
+            result = run()
+            if idx > min_index or min_index == 0:
+                return {"Index": max(idx, 1), **result}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"Index": max(idx, 1), **result}
+            self.state.block_until(tables, min_index,
+                                   min(remaining, 1.0))
+
+    # ----------------------------------------------------- serf event plane
+
+    def _serf_event(self, ev: SerfEvent) -> None:
+        """lanEventHandler (server_serf.go:270-297): track servers, feed
+        the reconcile queue, maybe bootstrap raft."""
+        if ev.type in (EventType.MEMBER_JOIN, EventType.MEMBER_FAILED,
+                       EventType.MEMBER_LEAVE, EventType.MEMBER_REAP,
+                       EventType.MEMBER_UPDATE):
+            with self._reconcile_lock:
+                self._reconcile_ch.append(ev)
+            if ev.type == EventType.MEMBER_JOIN:
+                self._maybe_bootstrap()
+
+    def _servers(self) -> list[dict[str, str]]:
+        """Known server members from serf tags (role=consul)."""
+        out = []
+        for m in self.serf.members():
+            if m.tags.get("role") == "consul" \
+                    and m.status == MemberStatus.ALIVE:
+                out.append({"name": m.name,
+                            "rpc_addr": m.tags.get("rpc_addr", ""),
+                            "id": m.tags.get("id", "")})
+        return out
+
+    def _maybe_bootstrap(self) -> None:
+        """Gossip-driven raft bootstrap (server_serf.go:391-512): once
+        bootstrap_expect servers are visible, the one with the smallest
+        RPC address seeds the cluster; the leader then adds the rest."""
+        if self._maybe_bootstrapped:
+            return
+        expect = self.config.bootstrap_expect
+        if not expect:
+            return
+        servers = self._servers()
+        if len(servers) < expect:
+            return
+        addrs = sorted(s["rpc_addr"] for s in servers if s["rpc_addr"])
+        self._maybe_bootstrapped = True
+        if addrs and addrs[0] == self.rpc.addr:
+            self.log.info("bootstrapping raft (expect=%d reached)", expect)
+            self.raft.start()
+        # non-seed servers stay passive; the elected leader add_peer()s
+        # them (handled in _leader_tick), and their election timers arm
+        # on first AppendEntries contact.
+
+    # --------------------------------------------------------- leader loops
+
+    def _leader_tick(self) -> None:
+        """Leader duties (leader.go leaderLoop): raft membership from serf,
+        reconcile queued member events, expire TTL sessions."""
+        if not self.is_leader():
+            self._was_leader = False
+            # only the leader reconciles; drop stale queued events
+            # (reference: localMemberEvent is leader-gated,
+            # server_serf.go:301-321)
+            with self._reconcile_lock:
+                self._reconcile_ch.clear()
+            return
+        if not self._was_leader:
+            # establishLeadership (leader.go:281): reconcile the full
+            # membership immediately — including ourselves, for whom serf
+            # emits no join event
+            self._was_leader = True
+            self._full_reconcile()
+        # raft membership follows serf server membership (autopilot-lite)
+        servers = {s["rpc_addr"] for s in self._servers() if s["rpc_addr"]}
+        for addr in servers - self.raft.peers:
+            self.log.info("adding raft peer %s", addr)
+            try:
+                self.raft.add_peer(addr)
+            except NotLeader:
+                return
+        # dead-server cleanup: remove raft peers whose serf member failed
+        failed_addrs = {
+            m.tags.get("rpc_addr") for m in self.serf.members(True)
+            if m.tags.get("role") == "consul"
+            and m.status in (MemberStatus.DEAD, MemberStatus.LEFT)}
+        for addr in (self.raft.peers & failed_addrs) - {self.rpc.addr}:
+            self.log.info("removing failed raft peer %s", addr)
+            try:
+                self.raft.remove_peer(addr)
+            except NotLeader:
+                return
+        self._drain_reconcile()
+        self._expire_sessions()
+
+    def _drain_reconcile(self) -> None:
+        with self._reconcile_lock:
+            events, self._reconcile_ch = self._reconcile_ch, []
+        for ev in events:
+            for member in ev.members:
+                try:
+                    self._reconcile_member(member.name, member.addr,
+                                           member.tags, ev.type)
+                except Exception as e:  # noqa: BLE001
+                    self.log.error("reconcile %s: %s", member.name, e)
+
+    def _reconcile_member(self, name: str, addr: str,
+                          tags: dict[str, str], ev: EventType) -> None:
+        """§3.4: serf membership → catalog registration with the implicit
+        serfHealth check (leader_registrator_v1.go:221-231)."""
+        if ev in (EventType.MEMBER_JOIN, EventType.MEMBER_UPDATE):
+            self.raft.apply(encode_command(MessageType.REGISTER, {
+                "Node": name, "Address": addr.rsplit(":", 1)[0],
+                "ID": tags.get("id", ""),
+                "Check": {"CheckID": SERF_CHECK_ID, "Name": SERF_CHECK_NAME,
+                          "Status": "passing",
+                          "Output": "Agent alive and reachable"}}))
+        elif ev == EventType.MEMBER_FAILED:
+            node = self.state.get_node(name)
+            if node is not None:
+                # the critical serfHealth check also invalidates the
+                # node's sessions, inside the replicated command (FSM)
+                self.raft.apply(encode_command(MessageType.REGISTER, {
+                    "Node": name, "Address": addr.rsplit(":", 1)[0],
+                    "Check": {"CheckID": SERF_CHECK_ID,
+                              "Name": SERF_CHECK_NAME,
+                              "Status": "critical",
+                              "Output": "Agent not live or unreachable"}}))
+        elif ev in (EventType.MEMBER_LEAVE, EventType.MEMBER_REAP):
+            if self.state.get_node(name) is not None:
+                self.raft.apply(encode_command(MessageType.DEREGISTER,
+                                               {"Node": name}))
+
+    def _full_reconcile(self) -> None:
+        """Periodic drift repair between serf membership and the catalog
+        (leader.go:949 reconcile/reconcileReaped)."""
+        if not self.is_leader():
+            return
+        members = {m.name: m for m in self.serf.members(include_left=True)}
+        catalog = {n.node for n in self.state.nodes()}
+        for name, m in members.items():
+            ev = {MemberStatus.ALIVE: EventType.MEMBER_JOIN,
+                  MemberStatus.SUSPECT: None,
+                  MemberStatus.DEAD: EventType.MEMBER_FAILED,
+                  MemberStatus.LEFT: EventType.MEMBER_LEAVE,
+                  MemberStatus.REAP: EventType.MEMBER_REAP,
+                  }.get(m.status)
+            if ev is None:
+                continue
+            # only repair drift: skip if catalog already agrees
+            if ev == EventType.MEMBER_JOIN and name in catalog:
+                checks = {c.check_id: c for c in self.state.node_checks(name)}
+                sh = checks.get(SERF_CHECK_ID)
+                if sh is not None and sh.status == CheckStatus.PASSING:
+                    continue
+            self._reconcile_member(m.name, m.addr, m.tags, ev)
+
+    def _expire_sessions(self) -> None:
+        """Leader-side TTL timers (session_ttl.go)."""
+        now = time.monotonic()
+        for sess in self.state.session_list():
+            if not sess.ttl:
+                self._session_expiry.pop(sess.id, None)
+                continue
+            ttl = _parse_ttl(sess.ttl)
+            exp = self._session_expiry.get(sess.id)
+            if exp is None:
+                # TTLs are doubled as a grace window (reference behavior)
+                self._session_expiry[sess.id] = now + 2 * ttl
+            elif now >= exp:
+                self.log.info("expiring session %s (TTL %s)", sess.id,
+                              sess.ttl)
+                self.raft.apply(encode_command(MessageType.SESSION, {
+                    "Op": "destroy", "Session": sess.id}))
+                self._session_expiry.pop(sess.id, None)
+
+    def renew_session(self, sid: str) -> bool:
+        sess = self.state.session_get(sid)
+        if sess is None:
+            return False
+        if sess.ttl:
+            self._session_expiry[sid] = \
+                time.monotonic() + 2 * _parse_ttl(sess.ttl)
+        return True
+
+    # ----------------------------------------------------- coordinate batch
+
+    def queue_coordinate_update(self, node: str,
+                                coord: dict[str, Any]) -> None:
+        """Coordinate.Update buffering: one raft apply per period, batched
+        (agent/consul/config.go:572-574, fsm CoordinateBatchUpdate)."""
+        with self._coord_lock:
+            self._coord_updates[node] = {"Node": node, "Coord": coord}
+
+    def _flush_coords(self) -> None:
+        if not self.is_leader():
+            return
+        with self._coord_lock:
+            updates, self._coord_updates = \
+                list(self._coord_updates.values()), {}
+        if not updates:
+            return
+        batch = self.config.coordinate_update_batch_size \
+            * self.config.coordinate_update_max_batches
+        self.raft.apply(encode_command(
+            MessageType.COORDINATE_BATCH_UPDATE,
+            {"Updates": updates[:batch]}))
+
+
+def _parse_ttl(ttl: str) -> float:
+    """'15s' / '1m' / '90' → seconds."""
+    ttl = ttl.strip()
+    if ttl.endswith("ms"):
+        return float(ttl[:-2]) / 1000.0
+    if ttl.endswith("s"):
+        return float(ttl[:-1])
+    if ttl.endswith("m"):
+        return float(ttl[:-1]) * 60.0
+    if ttl.endswith("h"):
+        return float(ttl[:-1]) * 3600.0
+    return float(ttl)

@@ -82,13 +82,24 @@ class FSM:
     # ------------------------------------------------------------- handlers
 
     def _apply_register(self, b: dict[str, Any], idx: int) -> Any:
-        return self.store.ensure_registration(
+        out = self.store.ensure_registration(
             node=b["Node"], address=b.get("Address", ""),
             node_id=b.get("ID", ""), datacenter=b.get("Datacenter", ""),
             tagged_addresses=b.get("TaggedAddresses"),
             node_meta=b.get("NodeMeta"),
             service=b.get("Service"), check=b.get("Check"),
             checks=b.get("Checks"))
+        # a check going critical invalidates sessions bound to it — this
+        # must happen INSIDE the replicated command so every replica's
+        # store agrees (session_ttl.go semantics, deterministically)
+        all_checks = list(b.get("Checks") or [])
+        if b.get("Check"):
+            all_checks.append(b["Check"])
+        for c in all_checks:
+            if c.get("Status") == "critical":
+                self.store.invalidate_sessions_for_check(
+                    b["Node"], c.get("CheckID") or c.get("Name", ""))
+        return out
 
     def _apply_deregister(self, b: dict[str, Any], idx: int) -> Any:
         node = b["Node"]
@@ -169,9 +180,17 @@ class FSM:
                 verb = kv.get("Verb", "set")
                 key = kv.get("Key", "")
                 cur = self.store.kv_get(key)
-                if verb in ("cas", "delete-cas") and (
-                        cur is None
-                        or cur.modify_index != kv.get("Index", 0)):
+                want = kv.get("Index", 0)
+                if verb == "cas":
+                    # Index 0 = create-if-absent, matching KVS.Apply cas
+                    # semantics (store.kv_set)
+                    failed = (cur is not None) if want == 0 else (
+                        cur is None or cur.modify_index != want)
+                    if failed:
+                        return {"Errors": [{"OpIndex": len(results),
+                                            "What": f"cas failed for {key}"}]}
+                if verb == "delete-cas" and (
+                        cur is None or cur.modify_index != want):
                     return {"Errors": [{"OpIndex": len(results),
                                         "What": f"cas failed for {key}"}]}
                 if verb == "check-index" and (

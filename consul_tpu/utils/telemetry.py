@@ -116,5 +116,10 @@ def _prom_line(prefix: str, name: str, labels: _Label, v: float,
     return f"{metric} {v}"
 
 
+def time_now() -> float:
+    """Start stamp for measure_since."""
+    return time.monotonic()
+
+
 #: Process-global registry (the reference's global go-metrics instance).
 default = Metrics()

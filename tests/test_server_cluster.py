@@ -1,0 +1,156 @@
+"""In-process multi-server cluster tests over real loopback sockets.
+
+The reference's core test pattern (agent/consul/server_test.go +
+testrpc.WaitForLeader, SURVEY.md §4): N real Servers in one process on
+ephemeral ports, joined via real serf gossip, raft bootstrapped through
+gossip (bootstrap_expect), driven through the real RPC port.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.config import load
+from consul_tpu.server import Client, Server
+from consul_tpu.types import CheckStatus
+
+
+def wait_for(cond, timeout=15.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def cluster():
+    servers = []
+    cfg0 = load(dev=True, overrides={
+        "node_name": "srv0", "bootstrap": False, "bootstrap_expect": 3,
+        "server": True})
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"srv{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True})
+        s = Server(cfg)
+        s.start()
+        servers.append(s)
+    for s in servers[1:]:
+        assert s.join([servers[0].serf.memberlist.transport.addr]) == 1
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="leader election")
+    # all servers in the raft config
+    wait_for(lambda: len(leader.raft.peers) == 3, what="3 raft peers")
+    yield servers, leader
+    for s in servers:
+        s.shutdown()
+
+
+def test_cluster_forms_and_replicates(cluster):
+    servers, leader = cluster
+    follower = next(s for s in servers if s is not leader)
+    # write through a FOLLOWER's RPC port: must forward to the leader
+    ok = follower.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "cfg/x", "Value": b"42"}}, "test")
+    assert ok is True
+    wait_for(lambda: all(
+        s.state.kv_get("cfg/x") is not None for s in servers),
+        what="kv replication")
+    # read from any server
+    res = follower.handle_rpc("KVS.Get", {"Key": "cfg/x"}, "test")
+    assert res["Entries"][0]["Key"] == "cfg/x"
+    assert res["Index"] > 0
+
+
+def test_members_registered_in_catalog(cluster):
+    servers, leader = cluster
+    wait_for(lambda: len(leader.state.nodes()) == 3,
+             what="catalog registration of all members")
+    checks = leader.state.node_checks("srv1")
+    assert any(c.check_id == "serfHealth"
+               and c.status == CheckStatus.PASSING for c in checks)
+
+
+def test_failure_flips_catalog_health(cluster):
+    """The north-star loop (§3.4): kill a server; its serfHealth check
+    must go critical (or the node deregister) on the leader."""
+    servers, leader = cluster
+    wait_for(lambda: len(leader.state.nodes()) == 3, what="3 catalog nodes")
+    victim = next(s for s in servers if s is not leader)
+    victim.shutdown()
+
+    def victim_down():
+        checks = {c.check_id: c for c in
+                  leader.state.node_checks(victim.name)}
+        sh = checks.get("serfHealth")
+        return (sh is not None and sh.status == CheckStatus.CRITICAL) \
+            or leader.state.get_node(victim.name) is None
+
+    wait_for(victim_down, timeout=30.0, what="serfHealth critical")
+    # and raft membership shrank (dead-server cleanup)
+    wait_for(lambda: victim.rpc.addr not in leader.raft.peers,
+             timeout=30.0, what="raft peer removal")
+
+
+def test_blocking_query_fires_on_write(cluster):
+    servers, leader = cluster
+    res0 = leader.handle_rpc("KVS.Get", {"Key": "watch/me"}, "t")
+    idx0 = res0["Index"]
+    got = {}
+
+    def blocker():
+        got["res"] = leader.handle_rpc("KVS.Get", {
+            "Key": "watch/me", "MinQueryIndex": idx0,
+            "MaxQueryTime": 10.0}, "t")
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive(), "query should be parked"
+    leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "watch/me", "Value": b"!"}}, "t")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert got["res"]["Entries"][0]["Key"] == "watch/me"
+    assert got["res"]["Index"] > idx0
+
+
+def test_client_agent_forwards_rpcs(cluster):
+    servers, leader = cluster
+    cfg = load(dev=True, overrides={"node_name": "cli0", "server": False})
+    client = Client(cfg)
+    client.start()
+    try:
+        assert client.join(
+            [servers[0].serf.memberlist.transport.addr]) == 1
+        wait_for(lambda: client._pick_server() is not None,
+                 what="server discovery")
+        assert client.rpc("Status.Ping", {}) == "pong"
+        ok = client.rpc("KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": "from/client", "Value": b"hi"}})
+        assert ok is True
+        res = client.rpc("KVS.Get", {"Key": "from/client"})
+        assert res["Entries"][0]["Key"] == "from/client"
+        # client registered in the catalog by the leader reconcile loop
+        wait_for(lambda: leader.state.get_node("cli0") is not None,
+                 what="client catalog registration")
+    finally:
+        client.shutdown()
+
+
+def test_session_ttl_expiry(cluster):
+    servers, leader = cluster
+    wait_for(lambda: leader.state.get_node(leader.name) is not None,
+             what="self registration")
+    res = leader.handle_rpc("Session.Apply", {
+        "Op": "create", "Session": {"Node": leader.name, "TTL": "1s"}}, "t")
+    sid = res
+    assert leader.state.session_get(sid) is not None
+    # without renewal the leader expires it (2x TTL grace)
+    wait_for(lambda: leader.state.session_get(sid) is None,
+             timeout=15.0, what="session TTL expiry")
