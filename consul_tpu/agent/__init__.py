@@ -1,0 +1,12 @@
+"""L3 agent plane: local state, anti-entropy, checks, HTTP API, DNS.
+
+Mirrors agent/ in the reference: the long-running process on every node
+that owns local service/check registrations (agent/local/state.go),
+syncs them to the server catalog (agent/ae/ae.go), runs health checks
+(agent/checks/check.go), and serves the HTTP API (agent/http.go) and
+DNS (agent/dns.go).
+"""
+
+from consul_tpu.agent.agent import Agent
+
+__all__ = ["Agent"]

@@ -1,0 +1,135 @@
+"""Anti-entropy: local state ↔ server catalog synchronization.
+
+Reference: agent/ae/ae.go:57,120 + agent/local/state.go:1227 SyncChanges.
+Periodic full sync with cluster-size-scaled stagger, plus triggered
+syncs coalesced over a short window when local state changes.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Optional
+
+from consul_tpu.utils import log
+from consul_tpu.utils.clock import RealTimers
+
+
+class StateSyncer:
+    def __init__(self, agent, interval: float = 60.0,
+                 coalesce: float = 0.2) -> None:
+        self.agent = agent
+        self.base_interval = interval
+        self.coalesce = coalesce
+        self.log = log.named("anti_entropy")
+        self.scheduler = RealTimers()
+        self._stopped = False
+        self._trigger_timer = None
+        self._periodic_timer = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        self._schedule_periodic()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.scheduler.cancel_all()
+
+    def trigger(self) -> None:
+        """Coalesced sync request (called on every local-state change)."""
+        with self._lock:
+            if self._stopped or self._trigger_timer is not None:
+                return
+            self._trigger_timer = self.scheduler.after(
+                self.coalesce, self._triggered)
+
+    def _triggered(self) -> None:
+        with self._lock:
+            self._trigger_timer = None
+        self.sync()
+
+    def _schedule_periodic(self) -> None:
+        if self._stopped:
+            return
+        # interval scaled by cluster size (ae.go scaleFactor: stagger
+        # grows log-scale past 128 nodes so servers aren't stampeded)
+        n = max(len(self.agent.members()), 1)
+        scale = max(1.0, math.log2(max(n, 2)) / math.log2(128.0)) \
+            if n > 128 else 1.0
+        interval = self.base_interval * scale
+        self._periodic_timer = self.scheduler.after(
+            interval, self._periodic)
+
+    def _periodic(self) -> None:
+        try:
+            self.sync()
+        finally:
+            self._schedule_periodic()
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(self) -> None:
+        """Full diff-and-push (local/state.go SyncFull)."""
+        if self._stopped:
+            return
+        try:
+            self._sync_once()
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("sync failed: %s", e)
+
+    def _sync_once(self) -> None:
+        a = self.agent
+        node = a.name
+        # what the catalog currently has for this node
+        res = a.rpc("Catalog.NodeServices", {"Node": node,
+                                             "AllowStale": False})
+        remote = res.get("NodeServices") or {}
+        remote_services = set((remote.get("Services") or {}).keys())
+        res = a.rpc("Health.NodeChecks", {"Node": node})
+        remote_checks = {c["CheckID"]: c
+                         for c in res.get("HealthChecks") or []}
+
+        local_services = a.local.list_services()
+        local_checks = a.local.list_checks()
+
+        # push node + all services + checks that are out of sync or missing
+        base = {"Node": node, "Address": a.advertise_addr(),
+                "ID": a.node_id}
+        # register each service with its checks
+        for sid, svc in local_services.items():
+            svc_checks = [c.to_check_dict() for c in local_checks.values()
+                          if c.service_id == sid]
+            dirty = not svc.in_sync or any(
+                not c.in_sync for c in local_checks.values()
+                if c.service_id == sid) or sid not in remote_services
+            for cd in svc_checks:
+                rc = remote_checks.get(cd["CheckID"])
+                if rc is None or rc.get("Status") != cd["Status"] \
+                        or rc.get("Output") != cd["Output"]:
+                    dirty = True
+            if dirty:
+                a.rpc("Catalog.Register", {
+                    **base, "Service": svc.to_service_dict(),
+                    "Checks": svc_checks})
+                svc.in_sync = True
+                for c in local_checks.values():
+                    if c.service_id == sid:
+                        c.in_sync = True
+        # node-level checks
+        for chk in local_checks.values():
+            if chk.service_id:
+                continue
+            rc = remote_checks.get(chk.check_id)
+            if not chk.in_sync or rc is None \
+                    or rc.get("Status") != chk.status.value \
+                    or rc.get("Output") != chk.output:
+                a.rpc("Catalog.Register",
+                      {**base, "Check": chk.to_check_dict()})
+                chk.in_sync = True
+        # deregister remote extras this agent no longer has
+        for sid in remote_services - set(local_services):
+            a.rpc("Catalog.Deregister", {"Node": node, "ServiceID": sid})
+        for cid in set(remote_checks) - set(local_checks):
+            if cid == "serfHealth":
+                continue  # owned by the leader reconcile loop
+            a.rpc("Catalog.Deregister", {"Node": node, "CheckID": cid})

@@ -1,0 +1,267 @@
+"""The Agent: the per-node process tying every plane together.
+
+Reference: agent/agent.go (Agent.Start :600). Owns the delegate (an
+in-process Server, or a forwarding Client — agent/agent.go:704/:745),
+local state + anti-entropy, check runners, the HTTP API and DNS
+servers, and the coordinate-update loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from consul_tpu.agent.ae import StateSyncer
+from consul_tpu.agent.checks import (TTLCheck, check_type_of, make_runner)
+from consul_tpu.agent.local import LocalCheck, LocalService, LocalState
+from consul_tpu.config import RuntimeConfig
+from consul_tpu.server import Client, Server
+from consul_tpu.server.rpc import RPCError
+from consul_tpu.types import CheckStatus
+from consul_tpu.utils import log, telemetry
+from consul_tpu.utils.clock import RealTimers
+from consul_tpu.version import __version__
+
+
+class Agent:
+    def __init__(self, config: RuntimeConfig,
+                 serf_transport=None) -> None:
+        self.config = config
+        self.name = config.node_name or f"agent-{uuid.uuid4().hex[:8]}"
+        if not config.node_name:
+            config = config.__class__(
+                **{**config.__dict__, "node_name": self.name})
+            self.config = config
+        self.log = log.named(f"agent.{self.name}")
+        self.metrics = telemetry.default
+        self.scheduler = RealTimers()
+        self._shutdown = False
+
+        if config.server_mode:
+            self.server: Optional[Server] = Server(
+                config, serf_transport=serf_transport)
+            self.client: Optional[Client] = None
+            self.node_id = self.server.node_id
+        else:
+            self.server = None
+            self.client = Client(config, serf_transport=serf_transport)
+            self.node_id = self.client.node_id
+
+        self.local = LocalState(
+            on_change=self._state_changed,
+            check_output_max=config.check_output_max_size)
+        self.sync = StateSyncer(self, interval=60.0,
+                                coalesce=config.sync_coalesce_timeout)
+        self._runners: dict[str, Any] = {}
+        self._maintenance = False
+
+        self.http = None
+        self.dns = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self, serve_http: bool = True, serve_dns: bool = True) -> None:
+        if self.server is not None:
+            self.server.start()
+        else:
+            self.client.start()
+        # join any configured seeds
+        seeds = list(self.config.retry_join_lan)
+        if seeds:
+            self._retry_join(seeds)
+        self.sync.start()
+        self._coord_loop()
+        if serve_http:
+            from consul_tpu.agent.http import HTTPApi
+
+            self.http = HTTPApi(self, self.config.bind_addr,
+                                self.config.port("http"))
+            self.http.start()
+        if serve_dns:
+            from consul_tpu.agent.dns import DNSServer
+
+            self.dns = DNSServer(self, self.config.bind_addr,
+                                 self.config.port("dns"))
+            self.dns.start()
+        self.log.info("agent started (server=%s)", self.server is not None)
+
+    def _retry_join(self, seeds: list[str]) -> None:
+        def attempt() -> None:
+            if self._shutdown:
+                return
+            try:
+                n = self.join(seeds)
+                if n > 0:
+                    return
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("retry join failed: %s", e)
+            self.scheduler.after(self.config.retry_join_interval, attempt)
+
+        attempt()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self.sync.stop()
+        for r in self._runners.values():
+            r.stop()
+        self.scheduler.cancel_all()
+        if self.http is not None:
+            self.http.stop()
+        if self.dns is not None:
+            self.dns.stop()
+        if self.server is not None:
+            self.server.shutdown()
+        else:
+            self.client.shutdown()
+
+    def leave(self) -> None:
+        """Graceful leave (consul leave)."""
+        if self.server is not None:
+            self.server.leave()
+        else:
+            self.client.leave()
+
+    # --------------------------------------------------------------- surface
+
+    @property
+    def serf(self):
+        return (self.server or self.client).serf
+
+    def rpc(self, method: str, args: dict[str, Any]) -> Any:
+        """Delegate RPC: in-process on servers, forwarded on clients
+        (agent/agent.go delegate seam)."""
+        if self.server is not None:
+            return self.server.handle_rpc(method, args, "local")
+        return self.client.rpc(method, args)
+
+    def members(self) -> list[dict[str, Any]]:
+        return [m.snapshot() for m in self.serf.members(include_left=True)]
+
+    def join(self, addrs: list[str]) -> int:
+        if self.server is not None:
+            return self.server.join(addrs)
+        return self.client.join(addrs)
+
+    def advertise_addr(self) -> str:
+        return self.config.advertise
+
+    def self_info(self) -> dict[str, Any]:
+        cfg = {
+            "Datacenter": self.config.datacenter,
+            "NodeName": self.name, "NodeID": self.node_id,
+            "Server": self.server is not None,
+            "Version": __version__,
+        }
+        member = self.serf.local_member()
+        return {"Config": cfg,
+                "Member": member.snapshot(),
+                "Stats": self.server.raft.stats()
+                if self.server else {},
+                "Coord": self.serf.coord_client.get().to_dict()}
+
+    # -------------------------------------------------- service/check mgmt
+
+    def register_service(self, defn: dict[str, Any]) -> None:
+        """/v1/agent/service/register (agent/agent.go addServiceLocked)."""
+        svc = LocalService(
+            id=defn.get("ID") or defn.get("Name", ""),
+            service=defn.get("Name", ""),
+            tags=list(defn.get("Tags") or []),
+            address=defn.get("Address", ""),
+            port=int(defn.get("Port") or 0),
+            meta=dict(defn.get("Meta") or {}),
+            kind=defn.get("Kind", ""))
+        self.local.add_service(svc)
+        checks = list(defn.get("Checks") or [])
+        if defn.get("Check"):
+            checks.append(defn["Check"])
+        for i, cd in enumerate(checks):
+            cd = dict(cd)
+            cd.setdefault("CheckID", f"service:{svc.id}"
+                          + (f":{i + 1}" if len(checks) > 1 else ""))
+            cd.setdefault("Name", f"Service '{svc.service}' check")
+            cd["ServiceID"] = svc.id
+            self.register_check(cd)
+
+    def deregister_service(self, service_id: str) -> bool:
+        for cid, runner in list(self._runners.items()):
+            chk = self.local.list_checks().get(cid)
+            if chk is not None and chk.service_id == service_id:
+                runner.stop()
+                del self._runners[cid]
+        return self.local.remove_service(service_id)
+
+    def register_check(self, defn: dict[str, Any]) -> None:
+        cid = defn.get("CheckID") or defn.get("Name", "")
+        chk = LocalCheck(
+            check_id=cid, name=defn.get("Name", cid),
+            notes=defn.get("Notes", ""),
+            service_id=defn.get("ServiceID", ""),
+            check_type=check_type_of(defn),
+            status=CheckStatus(defn.get("Status", "critical")))
+        self.local.add_check(chk)
+        runner = make_runner(self.local, defn, self.scheduler)
+        if runner is not None:
+            old = self._runners.pop(cid, None)
+            if old is not None:
+                old.stop()
+            self._runners[cid] = runner
+            runner.start()
+
+    def deregister_check(self, check_id: str) -> bool:
+        runner = self._runners.pop(check_id, None)
+        if runner is not None:
+            runner.stop()
+        return self.local.remove_check(check_id)
+
+    def update_ttl_check(self, check_id: str, status: CheckStatus,
+                         output: str = "") -> bool:
+        runner = self._runners.get(check_id)
+        if isinstance(runner, TTLCheck):
+            runner.refresh(status, output)
+            return True
+        return self.local.update_check(check_id, status, output)
+
+    def set_maintenance(self, enable: bool, reason: str = "") -> None:
+        """Node maintenance mode: a synthetic critical check
+        (agent/agent.go EnableNodeMaintenance)."""
+        self._maintenance = enable
+        if enable:
+            self.local.add_check(LocalCheck(
+                check_id="_node_maintenance", name="Node Maintenance Mode",
+                status=CheckStatus.CRITICAL,
+                notes=reason or "Maintenance mode is enabled",
+                output=reason))
+        else:
+            self.local.remove_check("_node_maintenance")
+
+    # ------------------------------------------------------------- internals
+
+    def _state_changed(self) -> None:
+        if not self._shutdown:
+            self.sync.trigger()
+
+    def _coord_loop(self) -> None:
+        """Push our Vivaldi coordinate at a rate scaled to cluster size
+        (agent/agent.go:2034-2087 sendCoordinate)."""
+
+        def tick() -> None:
+            if self._shutdown:
+                return
+            try:
+                self.rpc("Coordinate.Update", {
+                    "Node": self.name,
+                    "Coord": self.serf.coord_client.get().to_dict()})
+            except Exception as e:  # noqa: BLE001
+                self.log.debug("coordinate update failed: %s", e)
+            n = max(len(self.members()), 1)
+            # RateScaledInterval: min period scaled so servers see a
+            # bounded aggregate update rate
+            period = max(self.config.coordinate_update_period,
+                         n / 64.0)
+            if not self._shutdown:
+                self.scheduler.after(period, tick)
+
+        self.scheduler.after(self.config.coordinate_update_period, tick)

@@ -1,0 +1,229 @@
+"""Health check runners.
+
+Reference: agent/checks/check.go — 10 runner kinds. Implemented here:
+TTL, HTTP, TCP, Script (Monitor), plus Alias; UDP/gRPC/H2PING/Docker/
+OSService are registered types that fall back to TTL-style manual
+updates (stubs with honest errors) for round 1.
+
+Each runner drives LocalState.update_check; the anti-entropy syncer
+pushes status flips to the catalog (agent/local + agent/ae pattern).
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import threading
+from typing import Any, Optional
+
+from consul_tpu.agent.local import LocalCheck, LocalState
+from consul_tpu.types import CheckStatus
+from consul_tpu.utils import log
+from consul_tpu.utils.clock import RealTimers
+
+
+class CheckRunner:
+    """Base: periodic execution against a scheduler."""
+
+    def __init__(self, local: LocalState, check_id: str,
+                 interval: float, timeout: float,
+                 scheduler: Optional[RealTimers] = None) -> None:
+        self.local = local
+        self.check_id = check_id
+        self.interval = max(interval, 0.1)
+        self.timeout = timeout or 10.0
+        self.scheduler = scheduler or RealTimers()
+        self.log = log.named(f"checks.{check_id}")
+        self._timer = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._schedule(self.interval * 0.1)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer is not None:
+            self._timer.cancel()
+
+    def _schedule(self, delay: float) -> None:
+        if not self._stopped:
+            self._timer = self.scheduler.after(delay, self._tick)
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        try:
+            status, output = self.run_once()
+            self.local.update_check(self.check_id, status, output)
+        except Exception as e:  # noqa: BLE001
+            self.local.update_check(self.check_id, CheckStatus.CRITICAL,
+                                    f"check runner error: {e}")
+        finally:
+            self._schedule(self.interval)
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        raise NotImplementedError
+
+
+class TTLCheck:
+    """Passive: flips critical when not refreshed within TTL
+    (agent/checks/check.go CheckTTL)."""
+
+    def __init__(self, local: LocalState, check_id: str, ttl: float,
+                 scheduler: Optional[RealTimers] = None) -> None:
+        self.local = local
+        self.check_id = check_id
+        self.ttl = ttl
+        self.scheduler = scheduler or RealTimers()
+        self._timer = None
+        self._stopped = False
+
+    def start(self) -> None:
+        self._arm()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer is not None:
+            self._timer.cancel()
+
+    def refresh(self, status: CheckStatus, output: str = "") -> None:
+        self.local.update_check(self.check_id, status, output)
+        self._arm()
+
+    def _arm(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+        if not self._stopped:
+            self._timer = self.scheduler.after(self.ttl, self._expire)
+
+    def _expire(self) -> None:
+        if not self._stopped:
+            self.local.update_check(
+                self.check_id, CheckStatus.CRITICAL,
+                f"TTL expired ({self.ttl}s without update)")
+
+
+class HTTPCheck(CheckRunner):
+    def __init__(self, local, check_id, url: str, interval: float,
+                 timeout: float = 10.0, method: str = "GET",
+                 scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        self.url = url
+        self.method = method
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(self.url, method=self.method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = resp.read(4096).decode(errors="replace")
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            body, code = e.read(4096).decode(errors="replace"), e.code
+        except Exception as e:  # noqa: BLE001
+            return CheckStatus.CRITICAL, f"{type(e).__name__}: {e}"
+        # 2xx passing, 429 warning, else critical (check.go CheckHTTP)
+        if 200 <= code < 300:
+            return CheckStatus.PASSING, f"HTTP {code}: {body[:512]}"
+        if code == 429:
+            return CheckStatus.WARNING, f"HTTP {code}: {body[:512]}"
+        return CheckStatus.CRITICAL, f"HTTP {code}: {body[:512]}"
+
+
+class TCPCheck(CheckRunner):
+    def __init__(self, local, check_id, addr: str, interval: float,
+                 timeout: float = 10.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        host, port = addr.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=self.timeout):
+                return (CheckStatus.PASSING,
+                        f"TCP connect {self.host}:{self.port}: Success")
+        except OSError as e:
+            return (CheckStatus.CRITICAL,
+                    f"TCP connect {self.host}:{self.port}: {e}")
+
+
+class ScriptCheck(CheckRunner):
+    """Exit 0 passing, 1 warning, else critical (CheckMonitor)."""
+
+    def __init__(self, local, check_id, args: list[str], interval: float,
+                 timeout: float = 30.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        self.args = args
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        try:
+            proc = subprocess.run(
+                self.args, capture_output=True, timeout=self.timeout,
+                text=True)
+        except subprocess.TimeoutExpired:
+            return CheckStatus.CRITICAL, "script timed out"
+        out = (proc.stdout + proc.stderr)[:4096]
+        if proc.returncode == 0:
+            return CheckStatus.PASSING, out
+        if proc.returncode == 1:
+            return CheckStatus.WARNING, out
+        return CheckStatus.CRITICAL, out
+
+
+class AliasCheck(CheckRunner):
+    """Mirrors the worst state of another service's checks on this agent
+    (agent/checks/alias.go)."""
+
+    def __init__(self, local, check_id, alias_service: str,
+                 interval: float = 5.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, 5.0, scheduler)
+        self.alias_service = alias_service
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        statuses = [c.status for c in self.local.list_checks().values()
+                    if c.service_id == self.alias_service]
+        if not statuses:
+            return (CheckStatus.PASSING,
+                    f"no checks for service {self.alias_service}")
+        worst = CheckStatus.worst(statuses)
+        return worst, f"aliasing {self.alias_service}: {worst.value}"
+
+
+def make_runner(local: LocalState, defn: dict[str, Any],
+                scheduler=None) -> Optional[Any]:
+    """Build a runner from an HTTP-API check definition
+    (agent/structs.CheckType fields)."""
+    cid = defn.get("CheckID") or defn.get("Name", "")
+    interval = _dur(defn.get("Interval", "10s"))
+    timeout = _dur(defn.get("Timeout", "10s"))
+    if defn.get("TTL"):
+        return TTLCheck(local, cid, _dur(defn["TTL"]), scheduler)
+    if defn.get("HTTP"):
+        return HTTPCheck(local, cid, defn["HTTP"], interval, timeout,
+                         defn.get("Method", "GET"), scheduler)
+    if defn.get("TCP"):
+        return TCPCheck(local, cid, defn["TCP"], interval, timeout,
+                        scheduler)
+    if defn.get("Args") or defn.get("Script"):
+        args = defn.get("Args") or ["/bin/sh", "-c", defn["Script"]]
+        return ScriptCheck(local, cid, args, interval, timeout, scheduler)
+    if defn.get("AliasService"):
+        return AliasCheck(local, cid, defn["AliasService"],
+                          scheduler=scheduler)
+    return None  # manual check — no runner
+
+
+def check_type_of(defn: dict[str, Any]) -> str:
+    for key, name in (("TTL", "ttl"), ("HTTP", "http"), ("TCP", "tcp"),
+                      ("Args", "script"), ("Script", "script"),
+                      ("AliasService", "alias"), ("UDP", "udp"),
+                      ("GRPC", "grpc"), ("H2PING", "h2ping")):
+        if defn.get(key):
+            return name
+    return ""
+
+
+from consul_tpu.utils.duration import parse_duration as _dur  # noqa: E402

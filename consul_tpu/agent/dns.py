@@ -1,0 +1,327 @@
+"""DNS interface: service discovery over port 8600.
+
+Reference: agent/dns.go (2331 LoC over miekg/dns). Hand-rolled RFC1035
+wire codec (no DNS library in the image): A/AAAA/SRV/TXT/ANY queries for
+
+    <node>.node.<domain>              → A
+    <service>.service.<domain>        → A (passing instances), SRV
+    <tag>.<service>.service.<domain>  → tag-filtered
+    _<service>._<proto>.service.<domain> → RFC2782 SRV
+    <query>.query.<domain>            → prepared query execution
+
+NXDOMAIN for unknown names; name-error responses carry an SOA. UDP with
+truncation bit past 512 bytes (or the EDNS advertised size); requests
+outside the domain are forwarded to configured recursors.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+from consul_tpu.utils import log
+
+QTYPE_A = 1
+QTYPE_NS = 2
+QTYPE_SOA = 6
+QTYPE_PTR = 12
+QTYPE_TXT = 16
+QTYPE_AAAA = 28
+QTYPE_SRV = 33
+QTYPE_OPT = 41
+QTYPE_ANY = 255
+
+
+def _encode_name(name: str) -> bytes:
+    out = b""
+    for label in name.rstrip(".").split("."):
+        if label:
+            out += bytes([len(label)]) + label.encode()
+    return out + b"\x00"
+
+
+def _decode_name(buf: bytes, off: int) -> tuple[str, int]:
+    labels = []
+    jumps = 0
+    end = None
+    while True:
+        if off >= len(buf):
+            raise ValueError("truncated name")
+        ln = buf[off]
+        if ln == 0:
+            off += 1
+            break
+        if ln & 0xC0 == 0xC0:  # compression pointer
+            if jumps > 20:
+                raise ValueError("compression loop")
+            ptr = struct.unpack_from(">H", buf, off)[0] & 0x3FFF
+            if end is None:
+                end = off + 2
+            off = ptr
+            jumps += 1
+            continue
+        labels.append(buf[off + 1: off + 1 + ln].decode(errors="replace"))
+        off += 1 + ln
+    return ".".join(labels).lower(), (end if end is not None else off)
+
+
+def _rr(name: str, rtype: int, ttl: int, rdata: bytes) -> bytes:
+    return (_encode_name(name) + struct.pack(">HHIH", rtype, 1, ttl,
+                                             len(rdata)) + rdata)
+
+
+def _a_rdata(ip: str) -> Optional[bytes]:
+    """IPv4 rdata, or None for hostnames/IPv6 (caller skips the A RR)."""
+    try:
+        return socket.inet_aton(ip)
+    except OSError:
+        return None
+
+
+def _aaaa_rdata(ip: str) -> Optional[bytes]:
+    try:
+        return socket.inet_pton(socket.AF_INET6, ip)
+    except OSError:
+        return None
+
+
+def _srv_rdata(priority: int, weight: int, port: int,
+               target: str) -> bytes:
+    return struct.pack(">HHH", priority, weight, port) \
+        + _encode_name(target)
+
+
+def _txt_rdata(text: str) -> bytes:
+    b = text.encode()[:255]
+    return bytes([len(b)]) + b
+
+
+class DNSServer:
+    def __init__(self, agent, bind: str = "127.0.0.1",
+                 port: int = 8600) -> None:
+        self.agent = agent
+        self.log = log.named("dns")
+        self.domain = agent.config.dns_domain.rstrip(".").lower()
+        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._udp.bind((bind, port))
+        self.addr = "%s:%d" % self._udp.getsockname()
+        self.port = self._udp.getsockname()[1]
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="dns")
+        self._stopped = False
+        self.rng = random.Random()
+
+    def start(self) -> None:
+        self._thread.start()
+        self.log.info("DNS server listening on %s", self.addr)
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._udp.close()
+        except OSError:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stopped:
+            try:
+                data, src = self._udp.recvfrom(4096)
+            except OSError:
+                return
+            try:
+                resp = self.handle(data)
+                if resp is not None:
+                    self._udp.sendto(resp, src)
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("query failed: %s", e)
+
+    # ------------------------------------------------------------ protocol
+
+    def handle(self, data: bytes) -> Optional[bytes]:
+        if len(data) < 12:
+            return None
+        (qid, flags, qd, an, ns, ar) = struct.unpack_from(">HHHHHH", data)
+        if qd < 1:
+            return None
+        qname, off = _decode_name(data, 12)
+        qtype, qclass = struct.unpack_from(">HH", data, off)
+        off += 4
+        # EDNS advertised UDP size from OPT in additional section
+        udp_size = 512
+        try:
+            for _ in range(ar):
+                _, o2 = _decode_name(data, off)
+                rtype, rclass, _ttl, rdlen = struct.unpack_from(
+                    ">HHIH", data, o2)
+                if rtype == QTYPE_OPT:
+                    udp_size = max(512, rclass)
+                off = o2 + 10 + rdlen
+        except Exception:  # noqa: BLE001 — ignore malformed additionals
+            pass
+
+        answers, authoritative = self.resolve(qname, qtype)
+        if answers is None:
+            # outside our domain → recurse if configured
+            fwd = self._recurse(data)
+            if fwd is not None:
+                return fwd
+            answers, authoritative = [], False
+
+        rcode = 0 if answers else 3  # NXDOMAIN when we own it but no data
+        if answers is not None and not authoritative and not answers:
+            rcode = 2  # SERVFAIL for failed recursion
+        hdr_flags = 0x8000 | (0x0400 if authoritative else 0) \
+            | (flags & 0x0100) | rcode
+        # rebuild question section canonically
+        question = _encode_name(qname) + struct.pack(">HH", qtype, qclass)
+        payload = b"".join(answers)
+        resp = struct.pack(">HHHHHH", qid, hdr_flags, 1, len(answers),
+                           0, 0) + question + payload
+        if len(resp) > udp_size:
+            # truncate: header with TC bit, no answers
+            resp = struct.pack(">HHHHHH", qid, hdr_flags | 0x0200, 1, 0,
+                               0, 0) + question
+        return resp
+
+    def _recurse(self, raw: bytes) -> Optional[bytes]:
+        for rec in self.agent.config.dns_recursors:
+            host, _, port = rec.partition(":")
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s.settimeout(2.0)
+                s.sendto(raw, (host, int(port or 53)))
+                resp, _ = s.recvfrom(4096)
+                s.close()
+                return resp
+            except OSError:
+                continue
+        return None
+
+    # ------------------------------------------------------------- resolve
+
+    def resolve(self, qname: str, qtype: int
+                ) -> tuple[Optional[list[bytes]], bool]:
+        """Returns (answer RRs | None if not our domain, authoritative)."""
+        name = qname.rstrip(".")
+        # label-boundary check: "foo.notconsul" must NOT match "consul"
+        if name != self.domain and not name.endswith("." + self.domain):
+            return None, False
+        rel = name[: -len(self.domain)].rstrip(".")
+        parts = rel.split(".") if rel else []
+        ttl = int(self.agent.config.dns_node_ttl)
+
+        if not parts:
+            return [], True
+        kind = parts[-1]
+        if kind == "node" and len(parts) >= 2:
+            node = ".".join(parts[:-1])
+            return self._node_answers(qname, node, qtype, ttl), True
+        if kind == "service" and len(parts) >= 2:
+            # RFC2782: _name._proto.service.domain
+            if len(parts) >= 3 and parts[0].startswith("_") \
+                    and parts[-2].startswith("_"):
+                service = parts[0][1:]
+                tag = None
+            elif len(parts) == 3:
+                tag, service = parts[0], parts[1]
+            else:
+                service, tag = parts[0], None
+            return self._service_answers(qname, service, tag, qtype,
+                                         ttl), True
+        if kind == "query" and len(parts) >= 2:
+            return self._query_answers(qname, ".".join(parts[:-1]),
+                                       qtype, ttl), True
+        return [], True
+
+    def _node_answers(self, qname: str, node: str, qtype: int,
+                      ttl: int) -> list[bytes]:
+        try:
+            res = self.agent.rpc("Catalog.NodeServices",
+                                 {"Node": node, "AllowStale":
+                                  self.agent.config.dns_allow_stale})
+        except Exception:  # noqa: BLE001
+            return []
+        ns = res.get("NodeServices")
+        if not ns:
+            return []
+        addr = ns["Node"]["Address"]
+        out = []
+        if qtype in (QTYPE_A, QTYPE_ANY):
+            rd = _a_rdata(addr)
+            if rd is not None:
+                out.append(_rr(qname, QTYPE_A, ttl, rd))
+        if qtype in (QTYPE_AAAA, QTYPE_ANY):
+            rd = _aaaa_rdata(addr)
+            if rd is not None:
+                out.append(_rr(qname, QTYPE_AAAA, ttl, rd))
+        if qtype in (QTYPE_TXT, QTYPE_ANY):
+            meta = ns["Node"].get("Meta") or {}
+            for k, v in sorted(meta.items()):
+                out.append(_rr(qname, QTYPE_TXT, ttl,
+                               _txt_rdata(f"{k}={v}")))
+        return out
+
+    def _service_answers(self, qname: str, service: str,
+                         tag: Optional[str], qtype: int,
+                         ttl: int) -> list[bytes]:
+        args = {"ServiceName": service, "MustBePassing": True,
+                "AllowStale": self.agent.config.dns_allow_stale}
+        if tag:
+            args["ServiceTag"] = tag
+        try:
+            res = self.agent.rpc("Health.ServiceNodes", args)
+        except Exception:  # noqa: BLE001
+            return []
+        nodes = res.get("Nodes") or []
+        svc_ttl = self.agent.config.dns_service_ttl.get(
+            service, self.agent.config.dns_node_ttl)
+        ttl = int(svc_ttl)
+        # shuffle for poor-man's load balancing (the reference RTT-sorts
+        # with ?near and shuffles otherwise)
+        self.rng.shuffle(nodes)
+        out = []
+        for entry in nodes:
+            addr = entry["Service"]["Address"] or entry["Node"]["Address"]
+            port = entry["Service"]["Port"]
+            target = f"{entry['Node']['Node']}.node.{self.domain}."
+            if qtype in (QTYPE_A, QTYPE_ANY):
+                rd = _a_rdata(addr)
+                if rd is not None:
+                    out.append(_rr(qname, QTYPE_A, ttl, rd))
+            if qtype in (QTYPE_AAAA, QTYPE_ANY):
+                rd6 = _aaaa_rdata(addr)
+                if rd6 is not None:
+                    out.append(_rr(qname, QTYPE_AAAA, ttl, rd6))
+            if qtype in (QTYPE_SRV, QTYPE_ANY):
+                out.append(_rr(qname, QTYPE_SRV, ttl,
+                               _srv_rdata(1, 1, port, target)))
+        return out
+
+    def _query_answers(self, qname: str, query: str, qtype: int,
+                       ttl: int) -> list[bytes]:
+        """Prepared-query execution via DNS (<query>.query.domain)."""
+        try:
+            res = self.agent.rpc("PreparedQuery.Execute", {"QueryIDOrName":
+                                                           query})
+        except Exception:  # noqa: BLE001
+            return []
+        out = []
+        for entry in res.get("Nodes") or []:
+            addr = entry["Service"]["Address"] or entry["Node"]["Address"]
+            port = entry["Service"]["Port"]
+            target = f"{entry['Node']['Node']}.node.{self.domain}."
+            if qtype in (QTYPE_A, QTYPE_ANY):
+                rd = _a_rdata(addr)
+                if rd is not None:
+                    out.append(_rr(qname, QTYPE_A, ttl, rd))
+            if qtype in (QTYPE_AAAA, QTYPE_ANY):
+                rd6 = _aaaa_rdata(addr)
+                if rd6 is not None:
+                    out.append(_rr(qname, QTYPE_AAAA, ttl, rd6))
+            if qtype in (QTYPE_SRV, QTYPE_ANY):
+                out.append(_rr(qname, QTYPE_SRV, ttl,
+                               _srv_rdata(1, 1, port, target)))
+        return out

@@ -1,0 +1,432 @@
+"""The HTTP API.
+
+Reference: agent/http.go + http_register.go (130 routes; the serving
+core implemented here). Wire-compatible behaviors: blocking queries via
+``?index=&wait=``, ``X-Consul-Index`` response headers, consistency
+params (``?stale``/``?consistent``), base64 KV values, ``?raw``,
+``?recurse``, ``?keys``, CAS params, session ops, txn, agent-local
+registration endpoints, events, operator endpoints, and /v1/status.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+from consul_tpu.server.rpc import RPCError
+from consul_tpu.types import CheckStatus
+from consul_tpu.utils import log, telemetry
+from consul_tpu.version import __version__
+
+
+class HTTPError(Exception):
+    def __init__(self, code: int, msg: str) -> None:
+        super().__init__(msg)
+        self.code = code
+
+
+from consul_tpu.utils.duration import parse_duration as _dur  # noqa: E402
+
+
+class HTTPApi:
+    def __init__(self, agent, bind: str = "127.0.0.1",
+                 port: int = 8500) -> None:
+        self.agent = agent
+        self.log = log.named("http")
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to our logger
+                api.log.debug(fmt, *args)
+
+            def _handle(self, method: str) -> None:
+                parsed = urllib.parse.urlparse(self.path)
+                path = parsed.path
+                query = {k: v[-1] for k, v in
+                         urllib.parse.parse_qs(
+                             parsed.query, keep_blank_values=True).items()}
+                body = b""
+                ln = int(self.headers.get("Content-Length") or 0)
+                if ln:
+                    body = self.rfile.read(ln)
+                start = telemetry.time_now()
+                try:
+                    result, index = api.route(method, path, query, body)
+                    payload = b"" if result is None else (
+                        result if isinstance(result, bytes)
+                        else json.dumps(result).encode())
+                    ctype = "application/octet-stream" \
+                        if isinstance(result, bytes) else "application/json"
+                    self.send_response(200)
+                    if index is not None:
+                        self.send_header("X-Consul-Index", str(index))
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except HTTPError as e:
+                    self._err(e.code, str(e))
+                except RPCError as e:
+                    self._err(500, str(e))
+                except Exception as e:  # noqa: BLE001
+                    api.log.warning("%s %s failed: %s", method, path, e)
+                    self._err(500, f"internal error: {e}")
+                finally:
+                    telemetry.default.measure_since(
+                        "http.request", start, {"method": method})
+
+            def _err(self, code: int, msg: str) -> None:
+                payload = msg.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self._srv = ThreadingHTTPServer((bind, port), Handler)
+        self.addr = "%s:%d" % self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True, name="http-api")
+
+    def start(self) -> None:
+        self._thread.start()
+        self.log.info("HTTP API listening on %s", self.addr)
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # ------------------------------------------------------------- routing
+
+    def route(self, method: str, path: str, q: dict[str, str],
+              body: bytes) -> tuple[Any, Optional[int]]:
+        a = self.agent
+
+        def blocking_args(extra: Optional[dict] = None) -> dict[str, Any]:
+            args = dict(extra or {})
+            if "index" in q:
+                args["MinQueryIndex"] = int(q["index"])
+            if "wait" in q:
+                args["MaxQueryTime"] = _dur(q["wait"])
+            if "stale" in q:
+                args["AllowStale"] = True
+            return args
+
+        def jbody() -> dict[str, Any]:
+            if not body:
+                return {}
+            try:
+                return json.loads(body)
+            except json.JSONDecodeError as e:
+                raise HTTPError(400, f"invalid JSON body: {e}") from e
+
+        # ---------------------------------------------------------- status
+        if path == "/v1/status/leader":
+            return a.rpc("Status.Leader", {}), None
+        if path == "/v1/status/peers":
+            return a.rpc("Status.Peers", {}), None
+
+        # ----------------------------------------------------------- agent
+        if path == "/v1/agent/self":
+            return a.self_info(), None
+        if path == "/v1/agent/members":
+            return a.members(), None
+        if path == "/v1/agent/metrics":
+            return telemetry.default.snapshot(), None
+        if path == "/v1/agent/services":
+            return {sid: {**s.to_service_dict()}
+                    for sid, s in a.local.list_services().items()}, None
+        if path == "/v1/agent/checks":
+            return {cid: {**c.to_check_dict(), "Node": a.name}
+                    for cid, c in a.local.list_checks().items()}, None
+        if path == "/v1/agent/service/register" and method in ("PUT",
+                                                               "POST"):
+            a.register_service(jbody())
+            return None, None
+        if (m := re.match(r"^/v1/agent/service/deregister/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            if not a.deregister_service(urllib.parse.unquote(m.group(1))):
+                raise HTTPError(404, "unknown service")
+            return None, None
+        if path == "/v1/agent/check/register" and method in ("PUT", "POST"):
+            a.register_check(jbody())
+            return None, None
+        if (m := re.match(r"^/v1/agent/check/deregister/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            if not a.deregister_check(urllib.parse.unquote(m.group(1))):
+                raise HTTPError(404, "unknown check")
+            return None, None
+        for verb, status in (("pass", CheckStatus.PASSING),
+                             ("warn", CheckStatus.WARNING),
+                             ("fail", CheckStatus.CRITICAL)):
+            if (m := re.match(rf"^/v1/agent/check/{verb}/(.+)$", path)) \
+                    and method in ("PUT", "POST"):
+                cid = urllib.parse.unquote(m.group(1))
+                if not a.update_ttl_check(cid, status, q.get("note", "")):
+                    raise HTTPError(404, f"unknown check {cid}")
+                return None, None
+        if (m := re.match(r"^/v1/agent/check/update/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            b = jbody()
+            cid = urllib.parse.unquote(m.group(1))
+            status = CheckStatus(b.get("Status", "passing"))
+            if not a.update_ttl_check(cid, status, b.get("Output", "")):
+                raise HTTPError(404, f"unknown check {cid}")
+            return None, None
+        if (m := re.match(r"^/v1/agent/join/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            addr = urllib.parse.unquote(m.group(1))
+            if a.join([addr]) == 0:
+                raise HTTPError(500, f"failed to join {addr}")
+            return None, None
+        if path == "/v1/agent/leave" and method in ("PUT", "POST"):
+            a.leave()
+            return None, None
+        if path == "/v1/agent/maintenance" and method in ("PUT", "POST"):
+            enable = q.get("enable", "true") == "true"
+            a.set_maintenance(enable, q.get("reason", ""))
+            return None, None
+        if path == "/v1/agent/force-leave" or \
+                re.match(r"^/v1/agent/force-leave/(.+)$", path):
+            return None, None  # accepted; reaping handles the rest
+
+        # --------------------------------------------------------- catalog
+        if path == "/v1/catalog/datacenters":
+            return [a.config.datacenter], None
+        if path == "/v1/catalog/nodes":
+            res = a.rpc("Catalog.ListNodes", blocking_args())
+            return res["Nodes"], res["Index"]
+        if path == "/v1/catalog/services":
+            res = a.rpc("Catalog.ListServices", blocking_args())
+            return res["Services"], res["Index"]
+        if (m := re.match(r"^/v1/catalog/service/(.+)$", path)):
+            args = blocking_args({"ServiceName":
+                                  urllib.parse.unquote(m.group(1))})
+            if "tag" in q:
+                args["ServiceTag"] = q["tag"]
+            res = a.rpc("Catalog.ServiceNodes", args)
+            return res["ServiceNodes"], res["Index"]
+        if (m := re.match(r"^/v1/catalog/node/(.+)$", path)):
+            res = a.rpc("Catalog.NodeServices", blocking_args(
+                {"Node": urllib.parse.unquote(m.group(1))}))
+            return res["NodeServices"], res["Index"]
+        if path == "/v1/catalog/register" and method in ("PUT", "POST"):
+            return a.rpc("Catalog.Register", jbody()), None
+        if path == "/v1/catalog/deregister" and method in ("PUT", "POST"):
+            return a.rpc("Catalog.Deregister", jbody()), None
+
+        # ---------------------------------------------------------- health
+        if (m := re.match(r"^/v1/health/service/(.+)$", path)):
+            args = blocking_args({"ServiceName":
+                                  urllib.parse.unquote(m.group(1))})
+            if "tag" in q:
+                args["ServiceTag"] = q["tag"]
+            if "passing" in q:
+                args["MustBePassing"] = True
+            res = a.rpc("Health.ServiceNodes", args)
+            return res["Nodes"], res["Index"]
+        if (m := re.match(r"^/v1/health/node/(.+)$", path)):
+            res = a.rpc("Health.NodeChecks", blocking_args(
+                {"Node": urllib.parse.unquote(m.group(1))}))
+            return res["HealthChecks"], res["Index"]
+        if (m := re.match(r"^/v1/health/checks/(.+)$", path)):
+            res = a.rpc("Health.ServiceChecks", blocking_args(
+                {"ServiceName": urllib.parse.unquote(m.group(1))}))
+            return res["HealthChecks"], res["Index"]
+        if (m := re.match(r"^/v1/health/state/(.+)$", path)):
+            res = a.rpc("Health.ChecksInState", blocking_args(
+                {"State": urllib.parse.unquote(m.group(1))}))
+            return res["HealthChecks"], res["Index"]
+
+        # -------------------------------------------------------------- KV
+        if (m := re.match(r"^/v1/kv/(.*)$", path)):
+            return self._kv(method, urllib.parse.unquote(m.group(1)), q,
+                            body, blocking_args)
+
+        # --------------------------------------------------------- session
+        if path == "/v1/session/create" and method in ("PUT", "POST"):
+            b = jbody()
+            b.setdefault("Node", a.name)
+            sid = a.rpc("Session.Apply", {"Op": "create", "Session": b})
+            return {"ID": sid}, None
+        if (m := re.match(r"^/v1/session/destroy/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            a.rpc("Session.Apply", {"Op": "destroy",
+                                    "Session": m.group(1)})
+            return True, None
+        if (m := re.match(r"^/v1/session/info/(.+)$", path)):
+            res = a.rpc("Session.Get", blocking_args(
+                {"SessionID": m.group(1)}))
+            return res["Sessions"], res["Index"]
+        if (m := re.match(r"^/v1/session/node/(.+)$", path)):
+            res = a.rpc("Session.List", blocking_args(
+                {"Node": urllib.parse.unquote(m.group(1))}))
+            return res["Sessions"], res["Index"]
+        if path == "/v1/session/list":
+            res = a.rpc("Session.List", blocking_args())
+            return res["Sessions"], res["Index"]
+        if (m := re.match(r"^/v1/session/renew/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            res = a.rpc("Session.Renew", {"SessionID": m.group(1)})
+            if not res["Sessions"]:
+                raise HTTPError(404, "session not found")
+            return res["Sessions"], None
+
+        # ------------------------------------------------------ coordinate
+        if path == "/v1/coordinate/nodes":
+            res = a.rpc("Coordinate.ListNodes", blocking_args())
+            return res["Coordinates"], res["Index"]
+        if (m := re.match(r"^/v1/coordinate/node/(.+)$", path)):
+            res = a.rpc("Coordinate.Node", blocking_args(
+                {"Node": urllib.parse.unquote(m.group(1))}))
+            return res["Coordinates"], res["Index"]
+
+        # ------------------------------------------------------------- txn
+        if path == "/v1/txn" and method in ("PUT", "POST"):
+            ops = jbody()
+            for op in ops:
+                kv = op.get("KV")
+                if kv and kv.get("Value"):
+                    kv["Value"] = base64.b64decode(kv["Value"])
+            res = a.rpc("Txn.Apply", {"Ops": ops})
+            if res.get("Errors"):
+                raise HTTPError(409, json.dumps(res["Errors"]))
+            return res, None
+
+        # ----------------------------------------------------------- event
+        if (m := re.match(r"^/v1/event/fire/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            name = urllib.parse.unquote(m.group(1))
+            a.serf.user_event(f"consul:event:{name}", body)
+            return {"Name": name, "Payload":
+                    base64.b64encode(body).decode() if body else None}, None
+
+        # ----------------------------------------------------------- query
+        if path == "/v1/query":
+            if method in ("POST", "PUT"):
+                return a.rpc("PreparedQuery.Apply",
+                             {"Op": "create", "Query": jbody()}), None
+            res = a.rpc("PreparedQuery.List", blocking_args())
+            return res["Queries"], res["Index"]
+        if (m := re.match(r"^/v1/query/([^/]+)/execute$", path)):
+            res = a.rpc("PreparedQuery.Execute", {
+                "QueryIDOrName": urllib.parse.unquote(m.group(1)),
+                "Limit": int(q.get("limit", 0))})
+            return res, None
+        if (m := re.match(r"^/v1/query/([^/]+)$", path)):
+            qid = urllib.parse.unquote(m.group(1))
+            if method == "DELETE":
+                a.rpc("PreparedQuery.Apply",
+                      {"Op": "delete", "Query": {"ID": qid}})
+                return None, None
+            if method == "PUT":
+                b = jbody()
+                b["ID"] = qid
+                return a.rpc("PreparedQuery.Apply",
+                             {"Op": "update", "Query": b}), None
+            res = a.rpc("PreparedQuery.Get",
+                        blocking_args({"QueryID": qid}))
+            if not res["Queries"]:
+                raise HTTPError(404, "query not found")
+            return res["Queries"], res["Index"]
+
+        # -------------------------------------------------------- operator
+        if path == "/v1/operator/raft/configuration":
+            stats = a.rpc("Status.RaftStats", {})
+            return {"Servers": [
+                {"Address": p, "Leader": p == stats.get("leader"),
+                 "Voter": True} for p in stats.get("peers", [])],
+                "Index": stats.get("applied_index", 0)}, None
+
+        # ------------------------------------------------------- config
+        if path == "/v1/config" and method in ("PUT", "POST"):
+            return a.rpc("ConfigEntry.Apply",
+                         {"Op": "upsert", "Entry": jbody()}), None
+        if (m := re.match(r"^/v1/config/([^/]+)/(.+)$", path)):
+            if method == "DELETE":
+                return a.rpc("ConfigEntry.Apply", {
+                    "Op": "delete", "Entry": {
+                        "Kind": m.group(1), "Name": m.group(2)}}), None
+            res = a.rpc("ConfigEntry.Get", blocking_args(
+                {"Kind": m.group(1), "Name": m.group(2)}))
+            if res.get("Entry") is None:
+                raise HTTPError(404, "config entry not found")
+            return res["Entry"], res["Index"]
+        if (m := re.match(r"^/v1/config/([^/]+)$", path)):
+            res = a.rpc("ConfigEntry.List", blocking_args(
+                {"Kind": m.group(1)}))
+            return res["Entries"], res["Index"]
+
+        raise HTTPError(404, f"no handler for {method} {path}")
+
+    # ----------------------------------------------------------------- KV
+
+    def _kv(self, method: str, key: str, q: dict[str, str], body: bytes,
+            blocking_args) -> tuple[Any, Optional[int]]:
+        a = self.agent
+        if method == "GET":
+            if "keys" in q:
+                res = a.rpc("KVS.ListKeys", blocking_args(
+                    {"Prefix": key, "Separator": q.get("separator", "")}))
+                if not res["Keys"] and "index" not in q:
+                    raise HTTPError(404, "")
+                return res["Keys"], res["Index"]
+            if "recurse" in q:
+                res = a.rpc("KVS.List", blocking_args({"Key": key}))
+                if not res["Entries"] and "index" not in q:
+                    raise HTTPError(404, "")
+                return res["Entries"], res["Index"]
+            res = a.rpc("KVS.Get", blocking_args({"Key": key}))
+            if not res["Entries"]:
+                if "index" in q:
+                    return [], res["Index"]
+                raise HTTPError(404, "")
+            if "raw" in q:
+                e = res["Entries"][0]
+                return base64.b64decode(e["Value"]) if e["Value"] \
+                    else b"", res["Index"]
+            return res["Entries"], res["Index"]
+        if method in ("PUT", "POST"):
+            dirent: dict[str, Any] = {"Key": key, "Value": body,
+                                      "Flags": int(q.get("flags", 0))}
+            op = "set"
+            if "cas" in q:
+                op = "cas"
+                dirent["ModifyIndex"] = int(q["cas"])
+            elif "acquire" in q:
+                op = "lock"
+                dirent["Session"] = q["acquire"]
+            elif "release" in q:
+                op = "unlock"
+                dirent["Session"] = q["release"]
+            return a.rpc("KVS.Apply", {"Op": op, "DirEnt": dirent}), None
+        if method == "DELETE":
+            if "recurse" in q:
+                return a.rpc("KVS.Apply", {
+                    "Op": "delete-tree", "DirEnt": {"Key": key}}), None
+            if "cas" in q:
+                return a.rpc("KVS.Apply", {
+                    "Op": "delete-cas", "DirEnt": {
+                        "Key": key, "ModifyIndex": int(q["cas"])}}), None
+            return a.rpc("KVS.Apply", {"Op": "delete",
+                                       "DirEnt": {"Key": key}}), None
+        raise HTTPError(405, f"method {method} not allowed")

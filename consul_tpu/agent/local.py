@@ -1,0 +1,127 @@
+"""Agent local state: the authoritative record of what runs on this node.
+
+Reference: agent/local/state.go:172,225 — services and checks registered
+with THIS agent, plus their sync status vs the server catalog. The
+anti-entropy syncer diffs this against the catalog and pushes.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from consul_tpu.types import CheckStatus
+
+
+@dataclass
+class LocalService:
+    id: str
+    service: str
+    tags: list[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+    meta: dict[str, str] = field(default_factory=dict)
+    kind: str = ""
+    in_sync: bool = False
+
+    def to_service_dict(self) -> dict[str, Any]:
+        return {"ID": self.id, "Service": self.service, "Tags": self.tags,
+                "Address": self.address, "Port": self.port,
+                "Meta": self.meta, "Kind": self.kind}
+
+
+@dataclass
+class LocalCheck:
+    check_id: str
+    name: str
+    status: CheckStatus = CheckStatus.CRITICAL
+    output: str = ""
+    notes: str = ""
+    service_id: str = ""
+    service_name: str = ""
+    check_type: str = ""
+    in_sync: bool = False
+
+    def to_check_dict(self) -> dict[str, Any]:
+        return {"CheckID": self.check_id, "Name": self.name,
+                "Status": self.status.value, "Output": self.output,
+                "Notes": self.notes, "ServiceID": self.service_id,
+                "ServiceName": self.service_name,
+                "Type": self.check_type}
+
+
+class LocalState:
+    def __init__(self, on_change: Optional[Callable[[], None]] = None,
+                 check_output_max: int = 4096) -> None:
+        self._lock = threading.RLock()
+        self.services: dict[str, LocalService] = {}
+        self.checks: dict[str, LocalCheck] = {}
+        self._on_change = on_change or (lambda: None)
+        self._check_output_max = check_output_max
+
+    # --------------------------------------------------------------- service
+
+    def add_service(self, svc: LocalService) -> None:
+        with self._lock:
+            svc.in_sync = False
+            self.services[svc.id] = svc
+        self._on_change()
+
+    def remove_service(self, service_id: str) -> bool:
+        with self._lock:
+            found = self.services.pop(service_id, None) is not None
+            # drop its checks too
+            for cid in [c for c, chk in self.checks.items()
+                        if chk.service_id == service_id]:
+                del self.checks[cid]
+        self._on_change()
+        return found
+
+    def list_services(self) -> dict[str, LocalService]:
+        with self._lock:
+            return dict(self.services)
+
+    # ----------------------------------------------------------------- check
+
+    def add_check(self, chk: LocalCheck) -> None:
+        with self._lock:
+            if chk.service_id and chk.service_id in self.services:
+                chk.service_name = self.services[chk.service_id].service
+            chk.in_sync = False
+            self.checks[chk.check_id] = chk
+        self._on_change()
+
+    def remove_check(self, check_id: str) -> bool:
+        with self._lock:
+            found = self.checks.pop(check_id, None) is not None
+        self._on_change()
+        return found
+
+    def update_check(self, check_id: str, status: CheckStatus,
+                     output: str = "") -> bool:
+        with self._lock:
+            chk = self.checks.get(check_id)
+            if chk is None:
+                return False
+            output = output[: self._check_output_max]
+            if chk.status == status and chk.output == output:
+                return True
+            chk.status = status
+            chk.output = output
+            chk.in_sync = False
+        self._on_change()
+        return True
+
+    def list_checks(self) -> dict[str, LocalCheck]:
+        with self._lock:
+            return dict(self.checks)
+
+    def all_dirty(self) -> None:
+        """Force full re-sync (used after server failover)."""
+        with self._lock:
+            for s in self.services.values():
+                s.in_sync = False
+            for c in self.checks.values():
+                c.in_sync = False
+        self._on_change()

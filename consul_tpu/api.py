@@ -1,0 +1,261 @@
+"""Python client library for the HTTP API (L5).
+
+Reference: api/ (api.NewClient, api/api.go:675) — the Go client library
+that the CLI and third-party programs use. Same layering here: the CLI
+(consul_tpu.cli) is built entirely on this client.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+
+class APIError(Exception):
+    def __init__(self, code: int, msg: str) -> None:
+        super().__init__(f"HTTP {code}: {msg}")
+        self.code = code
+
+
+class ConsulClient:
+    def __init__(self, addr: str = "127.0.0.1:8500",
+                 scheme: str = "http", token: str = "") -> None:
+        self.base = f"{scheme}://{addr}"
+        self.token = token
+
+    # ------------------------------------------------------------ plumbing
+
+    def _call(self, method: str, path: str,
+              params: Optional[dict[str, Any]] = None,
+              body: Optional[Any] = None, raw_body: Optional[bytes] = None,
+              timeout: float = 615.0) -> tuple[Any, dict[str, str]]:
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in (params or {}).items() if v is not None})
+        url = f"{self.base}{path}" + (f"?{qs}" if qs else "")
+        data = raw_body if raw_body is not None else (
+            json.dumps(body).encode() if body is not None else None)
+        req = urllib.request.Request(url, data=data, method=method)
+        if self.token:
+            req.add_header("X-Consul-Token", self.token)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = resp.read()
+                headers = dict(resp.headers)
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            raise APIError(e.code, e.read().decode(errors="replace")) from e
+        if not payload:
+            return None, headers
+        if "json" in ctype:
+            return json.loads(payload), headers
+        return payload, headers
+
+    def get(self, path: str, **params) -> Any:
+        return self._call("GET", path, params)[0]
+
+    def get_with_index(self, path: str, **params) -> tuple[Any, int]:
+        result, headers = self._call("GET", path, params)
+        return result, int(headers.get("X-Consul-Index", 0))
+
+    def put(self, path: str, body: Any = None, raw: Optional[bytes] = None,
+            **params) -> Any:
+        return self._call("PUT", path, params, body, raw)[0]
+
+    def delete(self, path: str, **params) -> Any:
+        return self._call("DELETE", path, params)[0]
+
+    # --------------------------------------------------------------- agent
+
+    def agent_self(self) -> dict:
+        return self.get("/v1/agent/self")
+
+    def agent_members(self) -> list[dict]:
+        return self.get("/v1/agent/members")
+
+    def agent_services(self) -> dict:
+        return self.get("/v1/agent/services")
+
+    def agent_checks(self) -> dict:
+        return self.get("/v1/agent/checks")
+
+    def service_register(self, defn: dict) -> None:
+        self.put("/v1/agent/service/register", body=defn)
+
+    def service_deregister(self, service_id: str) -> None:
+        self.put(f"/v1/agent/service/deregister/{service_id}")
+
+    def check_register(self, defn: dict) -> None:
+        self.put("/v1/agent/check/register", body=defn)
+
+    def check_deregister(self, check_id: str) -> None:
+        self.put(f"/v1/agent/check/deregister/{check_id}")
+
+    def check_pass(self, check_id: str, note: str = "") -> None:
+        self.put(f"/v1/agent/check/pass/{check_id}", note=note or None)
+
+    def check_fail(self, check_id: str, note: str = "") -> None:
+        self.put(f"/v1/agent/check/fail/{check_id}", note=note or None)
+
+    def join(self, addr: str) -> None:
+        self.put(f"/v1/agent/join/{addr}")
+
+    def leave(self) -> None:
+        self.put("/v1/agent/leave")
+
+    def maintenance(self, enable: bool, reason: str = "") -> None:
+        self.put("/v1/agent/maintenance",
+                 enable="true" if enable else "false",
+                 reason=reason or None)
+
+    # ------------------------------------------------------------------- KV
+
+    def kv_get(self, key: str, **params) -> Optional[bytes]:
+        try:
+            entries = self.get(f"/v1/kv/{key}", **params)
+        except APIError as e:
+            if e.code == 404:
+                return None
+            raise
+        if not entries:
+            return None
+        v = entries[0].get("Value")
+        return base64.b64decode(v) if v else b""
+
+    def kv_get_entry(self, key: str, **params) -> Optional[dict]:
+        try:
+            entries = self.get(f"/v1/kv/{key}", **params)
+        except APIError as e:
+            if e.code == 404:
+                return None
+            raise
+        return entries[0] if entries else None
+
+    def kv_list(self, prefix: str, **params) -> list[dict]:
+        try:
+            return self.get(f"/v1/kv/{prefix}", recurse="", **params) or []
+        except APIError as e:
+            if e.code == 404:
+                return []
+            raise
+
+    def kv_keys(self, prefix: str, separator: str = "") -> list[str]:
+        try:
+            return self.get(f"/v1/kv/{prefix}", keys="",
+                            separator=separator or None) or []
+        except APIError as e:
+            if e.code == 404:
+                return []
+            raise
+
+    def kv_put(self, key: str, value: bytes, **params) -> bool:
+        return self.put(f"/v1/kv/{key}", raw=value, **params)
+
+    def kv_delete(self, key: str, recurse: bool = False) -> bool:
+        return self.delete(f"/v1/kv/{key}",
+                           recurse="" if recurse else None)
+
+    def kv_cas(self, key: str, value: bytes, index: int) -> bool:
+        return self.put(f"/v1/kv/{key}", raw=value, cas=index)
+
+    def kv_acquire(self, key: str, value: bytes, session: str) -> bool:
+        return self.put(f"/v1/kv/{key}", raw=value, acquire=session)
+
+    def kv_release(self, key: str, session: str) -> bool:
+        return self.put(f"/v1/kv/{key}", raw=b"", release=session)
+
+    # -------------------------------------------------------------- catalog
+
+    def catalog_nodes(self, **params) -> list[dict]:
+        return self.get("/v1/catalog/nodes", **params)
+
+    def catalog_services(self, **params) -> dict:
+        return self.get("/v1/catalog/services", **params)
+
+    def catalog_service(self, name: str, **params) -> list[dict]:
+        return self.get(f"/v1/catalog/service/{name}", **params)
+
+    def catalog_node(self, name: str, **params) -> Optional[dict]:
+        return self.get(f"/v1/catalog/node/{name}", **params)
+
+    # --------------------------------------------------------------- health
+
+    def health_service(self, name: str, passing: bool = False,
+                       **params) -> list[dict]:
+        if passing:
+            params["passing"] = ""
+        return self.get(f"/v1/health/service/{name}", **params)
+
+    def health_node(self, node: str, **params) -> list[dict]:
+        return self.get(f"/v1/health/node/{node}", **params)
+
+    def health_state(self, state: str = "any", **params) -> list[dict]:
+        return self.get(f"/v1/health/state/{state}", **params)
+
+    # -------------------------------------------------------------- session
+
+    def session_create(self, body: Optional[dict] = None) -> str:
+        return self.put("/v1/session/create", body=body or {})["ID"]
+
+    def session_destroy(self, sid: str) -> bool:
+        return self.put(f"/v1/session/destroy/{sid}")
+
+    def session_info(self, sid: str) -> list[dict]:
+        return self.get(f"/v1/session/info/{sid}")
+
+    def session_list(self) -> list[dict]:
+        return self.get("/v1/session/list")
+
+    def session_renew(self, sid: str) -> list[dict]:
+        return self.put(f"/v1/session/renew/{sid}")
+
+    # --------------------------------------------------------------- status
+
+    def status_leader(self) -> str:
+        return self.get("/v1/status/leader")
+
+    def status_peers(self) -> list[str]:
+        return self.get("/v1/status/peers")
+
+    # ---------------------------------------------------------------- event
+
+    def event_fire(self, name: str, payload: bytes = b"") -> dict:
+        return self.put(f"/v1/event/fire/{name}", raw=payload)
+
+    # ------------------------------------------------------------ operator
+
+    def raft_configuration(self) -> dict:
+        return self.get("/v1/operator/raft/configuration")
+
+
+class Lock:
+    """Distributed lock over sessions + KV acquire (api/lock.go)."""
+
+    def __init__(self, client: ConsulClient, key: str,
+                 session_ttl: str = "15s") -> None:
+        self.client = client
+        self.key = key
+        self.session_ttl = session_ttl
+        self.session: Optional[str] = None
+
+    def acquire(self, value: bytes = b"", wait: float = 10.0) -> bool:
+        import time
+
+        if self.session is None:
+            self.session = self.client.session_create(
+                {"TTL": self.session_ttl, "Behavior": "release"})
+        deadline = time.monotonic() + wait
+        while time.monotonic() < deadline:
+            if self.client.kv_acquire(self.key, value, self.session):
+                return True
+            time.sleep(0.5)
+        return False
+
+    def release(self) -> None:
+        if self.session is not None:
+            self.client.kv_release(self.key, self.session)
+            self.client.session_destroy(self.session)
+            self.session = None

@@ -1,0 +1,444 @@
+"""The CLI (L4): `python -m consul_tpu.cli <command>`.
+
+Reference: command/ (~150 subcommands via mitchellh/cli,
+command/registry.go). Core set implemented, all built on the HTTP API
+client (consul_tpu.api) the way the reference CLI rides api/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import sys
+import time
+
+from consul_tpu import config as config_mod
+from consul_tpu.api import APIError, ConsulClient
+from consul_tpu.version import __version__
+
+
+def _client(args) -> ConsulClient:
+    addr = getattr(args, "http_addr", None) \
+        or os.environ.get("CONSUL_HTTP_ADDR", "127.0.0.1:8500")
+    return ConsulClient(addr.removeprefix("http://"))
+
+
+def cmd_version(args) -> int:
+    print(f"consul-tpu v{__version__}")
+    return 0
+
+
+def cmd_agent(args) -> int:
+    from consul_tpu.agent import Agent
+
+    overrides: dict = {}
+    if args.node:
+        overrides["node_name"] = args.node
+    if args.server:
+        overrides["server"] = True
+    if args.bootstrap_expect:
+        overrides["bootstrap_expect"] = args.bootstrap_expect
+        overrides["server"] = True
+    if args.datacenter:
+        overrides["datacenter"] = args.datacenter
+    if args.join:
+        overrides["retry_join"] = args.join
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    if args.encrypt:
+        overrides["encrypt"] = args.encrypt
+    if args.gossip_sim:
+        overrides["gossip_sim"] = args.gossip_sim
+    if args.gossip_sim_nodes:
+        overrides["gossip_sim_nodes"] = args.gossip_sim_nodes
+    if args.http_port is not None or args.dns_port is not None \
+            or args.serf_port is not None or args.server_port is not None:
+        ports = {}
+        if args.http_port is not None:
+            ports["http"] = args.http_port
+        if args.dns_port is not None:
+            ports["dns"] = args.dns_port
+        if args.serf_port is not None:
+            ports["serf_lan"] = args.serf_port
+        if args.server_port is not None:
+            ports["server"] = args.server_port
+        overrides["ports"] = ports
+
+    if args.dev:
+        # `agent -dev` binds the reference's well-known ports (8500/8600/
+        # 8300/8301) so other CLI commands' defaults just work; explicit
+        # -*-port flags still win (merged above).
+        defaults = {"http": 8500, "dns": 8600, "server": 8300,
+                    "serf_lan": 8301, "serf_wan": 8302, "grpc": 8502}
+        ports = {**defaults, **overrides.get("ports", {})}
+        overrides["ports"] = ports
+    cfg = config_mod.load(files=args.config_file or [],
+                          overrides=overrides, dev=args.dev)
+
+    if cfg.gossip_sim:
+        return _run_gossip_sim(cfg)
+
+    agent = Agent(cfg)
+    agent.start()
+    print(f"==> consul-tpu agent running: node={agent.name} "
+          f"dc={cfg.datacenter} server={cfg.server_mode}")
+    if agent.http:
+        print(f"    HTTP API: http://{agent.http.addr}")
+    if agent.dns:
+        print(f"    DNS:      {agent.dns.addr}")
+
+    stop = {"done": False}
+
+    def on_signal(sig, frame):
+        print("==> caught signal, leaving gracefully")
+        stop["done"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop["done"]:
+            time.sleep(0.3)
+    finally:
+        agent.leave()
+        agent.shutdown()
+    return 0
+
+
+def _run_gossip_sim(cfg) -> int:
+    """`agent -dev -gossip-sim=tpu`: the BASELINE north-star mode — run N
+    virtual members on the TPU simulation backend and report."""
+    import jax
+
+    from consul_tpu.sim import SimParams, init_state, run_rounds
+    from consul_tpu.sim.metrics import fd_report
+
+    n = cfg.gossip_sim_nodes
+    p = SimParams.from_gossip_config(cfg.gossip_lan, n=n, loss=0.01)
+    rounds = 100
+    print(f"==> gossip-sim={cfg.gossip_sim}: {n} virtual members, "
+          f"{rounds} rounds on {jax.devices()[0].platform}")
+    t0 = time.perf_counter()
+    state, _ = run_rounds(init_state(n), jax.random.key(0), p, rounds)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    rep = fd_report(state, p)
+    print(json.dumps({"rounds_per_sec": round(rounds / dt, 1),
+                      **rep.to_dict()}, indent=2))
+    return 0
+
+
+def cmd_members(args) -> int:
+    c = _client(args)
+    status_names = {0: "none", 1: "alive", 2: "suspect", 3: "dead",
+                    4: "leaving", 5: "left", 6: "reap"}
+    rows = [("Node", "Address", "Status", "Type", "DC")]
+    for m in sorted(c.agent_members(), key=lambda m: m["name"]):
+        tags = m.get("tags") or {}
+        rows.append((m["name"], m["addr"],
+                     status_names.get(m["status"], "?"),
+                     "server" if tags.get("role") == "consul" else "client",
+                     tags.get("dc", "")))
+    _table(rows)
+    return 0
+
+
+def cmd_join(args) -> int:
+    c = _client(args)
+    for addr in args.addr:
+        c.join(addr)
+        print(f"Successfully joined cluster by contacting {addr}")
+    return 0
+
+
+def cmd_leave(args) -> int:
+    _client(args).leave()
+    print("Graceful leave complete")
+    return 0
+
+
+def cmd_info(args) -> int:
+    info = _client(args).agent_self()
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def cmd_kv(args) -> int:
+    c = _client(args)
+    if args.kv_cmd == "get":
+        if args.recurse:
+            for e in c.kv_list(args.key):
+                v = base64.b64decode(e["Value"]) if e["Value"] else b""
+                print(f"{e['Key']}:{v.decode(errors='replace')}")
+            return 0
+        if args.keys:
+            for k in c.kv_keys(args.key):
+                print(k)
+            return 0
+        v = c.kv_get(args.key)
+        if v is None:
+            print(f"Error! No key exists at: {args.key}", file=sys.stderr)
+            return 1
+        sys.stdout.write(v.decode(errors="replace"))
+        if sys.stdout.isatty():
+            print()
+        return 0
+    if args.kv_cmd == "put":
+        value = args.value.encode() if args.value is not None else \
+            sys.stdin.buffer.read()
+        ok = c.kv_put(args.key, value,
+                      cas=args.cas if args.cas is not None else None)
+        if not ok:
+            print("Error! CAS failed", file=sys.stderr)
+            return 1
+        print(f"Success! Data written to: {args.key}")
+        return 0
+    if args.kv_cmd == "delete":
+        c.kv_delete(args.key, recurse=args.recurse)
+        print(f"Success! Deleted key: {args.key}")
+        return 0
+    if args.kv_cmd == "export":
+        out = [{"key": e["Key"], "flags": e.get("Flags", 0),
+                "value": e.get("Value") or ""}
+               for e in c.kv_list(args.key or "")]
+        print(json.dumps(out, indent=2))
+        return 0
+    if args.kv_cmd == "import":
+        data = json.loads(sys.stdin.read())
+        for item in data:
+            c.kv_put(item["key"],
+                     base64.b64decode(item["value"])
+                     if item["value"] else b"")
+        print(f"Imported {len(data)} entries")
+        return 0
+    return 1
+
+
+def cmd_catalog(args) -> int:
+    c = _client(args)
+    if args.catalog_cmd == "nodes":
+        rows = [("Node", "ID", "Address")]
+        for n in c.catalog_nodes():
+            rows.append((n["Node"], n["ID"][:8], n["Address"]))
+        _table(rows)
+        return 0
+    if args.catalog_cmd == "services":
+        for name, tags in c.catalog_services().items():
+            print(name + (f"  [{','.join(tags)}]" if tags else ""))
+        return 0
+    if args.catalog_cmd == "datacenters":
+        for dc in c.get("/v1/catalog/datacenters"):
+            print(dc)
+        return 0
+    return 1
+
+
+def cmd_services(args) -> int:
+    c = _client(args)
+    if args.services_cmd == "register":
+        with open(args.file) as f:
+            defn = json.load(f)
+        defn = defn.get("service", defn)
+        c.service_register(_norm_service(defn))
+        print(f"Registered service: {defn.get('name') or defn.get('Name')}")
+        return 0
+    if args.services_cmd == "deregister":
+        c.service_deregister(args.id)
+        print(f"Deregistered service: {args.id}")
+        return 0
+    return 1
+
+
+def _norm_service(d: dict) -> dict:
+    """Accept lower-case HCL-style JSON keys (consul services register)."""
+    keymap = {"name": "Name", "id": "ID", "tags": "Tags", "port": "Port",
+              "address": "Address", "meta": "Meta", "check": "Check",
+              "checks": "Checks", "kind": "Kind"}
+    out = {}
+    for k, v in d.items():
+        out[keymap.get(k, k)] = v
+    for chk_key in ("Check", "Checks"):
+        if chk_key in out:
+            cm = {"http": "HTTP", "tcp": "TCP", "ttl": "TTL",
+                  "interval": "Interval", "timeout": "Timeout",
+                  "name": "Name", "id": "CheckID", "args": "Args"}
+            def fix(c):
+                return {cm.get(k, k): v for k, v in c.items()}
+            out[chk_key] = fix(out[chk_key]) \
+                if isinstance(out[chk_key], dict) \
+                else [fix(c) for c in out[chk_key]]
+    return out
+
+
+def cmd_event(args) -> int:
+    c = _client(args)
+    res = c.event_fire(args.name,
+                       (args.payload or "").encode())
+    print(f"Event ID: {res.get('Name')}")
+    return 0
+
+
+def cmd_rtt(args) -> int:
+    c = _client(args)
+    coords = {x["Node"]: x for x in c.get("/v1/coordinate/nodes")}
+    n1 = args.node1
+    n2 = args.node2 or c.agent_self()["Config"]["NodeName"]
+    if n1 not in coords or n2 not in coords:
+        print(f"Error! Coordinates not available for both nodes",
+              file=sys.stderr)
+        return 1
+    from consul_tpu.gossip.coordinate import distance
+    from consul_tpu.types import Coordinate
+
+    d = distance(Coordinate.from_dict(coords[n1]["Coord"]),
+                 Coordinate.from_dict(coords[n2]["Coord"]))
+    print(f"Estimated {n1} <-> {n2} rtt: {d * 1000:.3f} ms")
+    return 0
+
+
+def cmd_keygen(args) -> int:
+    print(base64.b64encode(os.urandom(32)).decode())
+    return 0
+
+
+def cmd_validate(args) -> int:
+    try:
+        config_mod.load(files=args.config_file)
+    except config_mod.ConfigError as e:
+        print(f"Config validation failed: {e}", file=sys.stderr)
+        return 1
+    print("Configuration is valid!")
+    return 0
+
+
+def cmd_operator(args) -> int:
+    c = _client(args)
+    if args.operator_cmd == "raft" and args.raft_cmd == "list-peers":
+        cfg = c.raft_configuration()
+        rows = [("Address", "Leader", "Voter")]
+        for s in cfg["Servers"]:
+            rows.append((s["Address"], str(s["Leader"]).lower(),
+                         str(s["Voter"]).lower()))
+        _table(rows)
+        return 0
+    return 1
+
+
+def _table(rows: list[tuple]) -> None:
+    widths = [max(len(str(r[i])) for r in rows)
+              for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="consul-tpu")
+    p.add_argument("-http-addr", dest="http_addr", default=None)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    ag = sub.add_parser("agent")
+    ag.add_argument("-dev", action="store_true", dest="dev")
+    ag.add_argument("-server", action="store_true", dest="server")
+    ag.add_argument("-node", default=None)
+    ag.add_argument("-datacenter", "-dc", default=None)
+    ag.add_argument("-bootstrap-expect", type=int, default=0,
+                    dest="bootstrap_expect")
+    ag.add_argument("-join", "-retry-join", action="append", default=[])
+    ag.add_argument("-data-dir", dest="data_dir", default=None)
+    ag.add_argument("-encrypt", default=None)
+    ag.add_argument("-config-file", "-config-dir", action="append",
+                    dest="config_file", default=[])
+    ag.add_argument("-http-port", type=int, default=None, dest="http_port")
+    ag.add_argument("-dns-port", type=int, default=None, dest="dns_port")
+    ag.add_argument("-serf-port", type=int, default=None, dest="serf_port")
+    ag.add_argument("-server-port", type=int, default=None,
+                    dest="server_port")
+    ag.add_argument("-gossip-sim", default=None, dest="gossip_sim")
+    ag.add_argument("-gossip-sim-nodes", type=int, default=None,
+                    dest="gossip_sim_nodes")
+    ag.set_defaults(fn=cmd_agent)
+
+    sub.add_parser("members").set_defaults(fn=cmd_members)
+    jn = sub.add_parser("join")
+    jn.add_argument("addr", nargs="+")
+    jn.set_defaults(fn=cmd_join)
+    sub.add_parser("leave").set_defaults(fn=cmd_leave)
+    sub.add_parser("info").set_defaults(fn=cmd_info)
+
+    kv = sub.add_parser("kv")
+    kvsub = kv.add_subparsers(dest="kv_cmd", required=True)
+    g = kvsub.add_parser("get")
+    g.add_argument("key")
+    g.add_argument("-recurse", action="store_true")
+    g.add_argument("-keys", action="store_true")
+    pu = kvsub.add_parser("put")
+    pu.add_argument("key")
+    pu.add_argument("value", nargs="?", default=None)
+    pu.add_argument("-cas", type=int, default=None)
+    de = kvsub.add_parser("delete")
+    de.add_argument("key")
+    de.add_argument("-recurse", action="store_true")
+    ex = kvsub.add_parser("export")
+    ex.add_argument("key", nargs="?", default="")
+    kvsub.add_parser("import")
+    kv.set_defaults(fn=cmd_kv)
+
+    cat = sub.add_parser("catalog")
+    catsub = cat.add_subparsers(dest="catalog_cmd", required=True)
+    catsub.add_parser("nodes")
+    catsub.add_parser("services")
+    catsub.add_parser("datacenters")
+    cat.set_defaults(fn=cmd_catalog)
+
+    svcs = sub.add_parser("services")
+    ssub = svcs.add_subparsers(dest="services_cmd", required=True)
+    reg = ssub.add_parser("register")
+    reg.add_argument("file")
+    dereg = ssub.add_parser("deregister")
+    dereg.add_argument("-id", required=True)
+    svcs.set_defaults(fn=cmd_services)
+
+    ev = sub.add_parser("event")
+    ev.add_argument("-name", required=True)
+    ev.add_argument("payload", nargs="?", default=None)
+    ev.set_defaults(fn=cmd_event)
+
+    rtt = sub.add_parser("rtt")
+    rtt.add_argument("node1")
+    rtt.add_argument("node2", nargs="?", default=None)
+    rtt.set_defaults(fn=cmd_rtt)
+
+    sub.add_parser("keygen").set_defaults(fn=cmd_keygen)
+
+    val = sub.add_parser("validate")
+    val.add_argument("config_file", nargs="+")
+    val.set_defaults(fn=cmd_validate)
+
+    op = sub.add_parser("operator")
+    opsub = op.add_subparsers(dest="operator_cmd", required=True)
+    raft = opsub.add_parser("raft")
+    raftsub = raft.add_subparsers(dest="raft_cmd", required=True)
+    raftsub.add_parser("list-peers")
+    op.set_defaults(fn=cmd_operator)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except APIError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except ConnectionError as e:
+        print(f"Error connecting to agent: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

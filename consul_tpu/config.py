@@ -314,6 +314,16 @@ def load(
         if kwargs.get("server_mode") and not kwargs.get("bootstrap_expect"):
             kwargs.setdefault("bootstrap", True)
         kwargs["dev_mode"] = True
+        # dev agents bind ephemeral ports unless explicitly configured
+        # (lets many dev agents share one host; explicit flags still win)
+        ports = dict(kwargs.get("ports") or {})
+        user_ports = raw.get("ports") or {}
+        for name in RuntimeConfig().ports:
+            if name not in user_ports:
+                ports[name] = 0
+            else:
+                ports[name] = user_ports[name]
+        kwargs["ports"] = ports
 
     cfg = RuntimeConfig(**kwargs)
     validate(cfg)

@@ -44,7 +44,7 @@ class Client:
             name=self.name,
             transport=serf_transport or UDPTransport(
                 config.bind_addr,
-                config.port("serf_lan") if not config.dev_mode else 0),
+                config.port("serf_lan")),
             config=config.gossip_lan,
             tags=tags,
             event_handler=self._serf_event)

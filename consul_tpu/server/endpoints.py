@@ -229,6 +229,61 @@ def register_endpoints(srv) -> None:
 
     e["Txn.Apply"] = txn_apply
 
+    # ----------------------------------------------------- PreparedQuery
+    def pq_apply(args):
+        op = args.get("Op", "create")
+        query = dict(args.get("Query") or {})
+        if op == "create":
+            query.setdefault("ID", str(uuid.uuid4()))
+        if op in ("create", "update") and not (
+                query.get("Service") or {}).get("Service"):
+            raise RPCError("prepared query must specify a service")
+        srv.forward_or_apply(MessageType.PREPARED_QUERY,
+                             {"Op": op, "Query": query})
+        return {"ID": query.get("ID")}
+
+    def pq_lookup(id_or_name: str):
+        q = state.raw_get("prepared_queries", id_or_name)
+        if q is not None:
+            return q
+        for cand in state.raw_list("prepared_queries"):
+            if cand.get("Name") == id_or_name:
+                return cand
+        return None
+
+    def pq_get(args):
+        return srv.blocking_query(args, ("prepared_queries",), lambda: {
+            "Queries": [q] if (q := pq_lookup(args.get("QueryID", "")))
+            else []})
+
+    def pq_list(args):
+        return srv.blocking_query(args, ("prepared_queries",), lambda: {
+            "Queries": state.raw_list("prepared_queries")})
+
+    def pq_execute(args):
+        """Execute a stored service query (prepared_query/ in the
+        reference; failover across DCs is a later round — single-DC
+        semantics here)."""
+        q = pq_lookup(args.get("QueryIDOrName", ""))
+        if q is None:
+            raise RPCError("query not found")
+        svc = q.get("Service") or {}
+        nodes = state.check_service_nodes(
+            svc.get("Service", ""),
+            tag=(svc.get("Tags") or [None])[0],
+            passing_only=not svc.get("OnlyPassing", True) is False)
+        limit = int(args.get("Limit") or 0)
+        if limit:
+            nodes = nodes[:limit]
+        return {"Service": svc.get("Service", ""), "Nodes": nodes,
+                "DNS": q.get("DNS") or {},
+                "Datacenter": srv.config.datacenter}
+
+    e["PreparedQuery.Apply"] = pq_apply
+    read("PreparedQuery.Get", pq_get)
+    read("PreparedQuery.List", pq_list)
+    read("PreparedQuery.Execute", pq_execute)
+
     # ------------------------------------------------------- ConfigEntry
     def config_apply(args):
         return srv.forward_or_apply(MessageType.CONFIG_ENTRY, args)

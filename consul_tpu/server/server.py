@@ -56,8 +56,7 @@ class Server:
 
         # RPC port (serves consul RPC + raft)
         self.rpc = RPCServer(rpc_bind or config.bind_addr,
-                             config.port("server")
-                             if not config.dev_mode else 0)
+                             config.port("server"))
         self.pool = ConnPool()
         self.raft_transport = PooledRaftTransport(self.rpc.addr, self.pool)
 
@@ -92,7 +91,7 @@ class Server:
             name=self.name,
             transport=serf_transport or UDPTransport(
                 config.bind_addr,
-                config.port("serf_lan") if not config.dev_mode else 0),
+                config.port("serf_lan")),
             config=config.gossip_lan,
             tags=tags,
             event_handler=self._serf_event,
@@ -444,15 +443,4 @@ class Server:
             {"Updates": updates[:batch]}))
 
 
-def _parse_ttl(ttl: str) -> float:
-    """'15s' / '1m' / '90' → seconds."""
-    ttl = ttl.strip()
-    if ttl.endswith("ms"):
-        return float(ttl[:-2]) / 1000.0
-    if ttl.endswith("s"):
-        return float(ttl[:-1])
-    if ttl.endswith("m"):
-        return float(ttl[:-1]) * 60.0
-    if ttl.endswith("h"):
-        return float(ttl[:-1]) * 3600.0
-    return float(ttl)
+from consul_tpu.utils.duration import parse_duration as _parse_ttl  # noqa: E402

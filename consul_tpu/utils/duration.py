@@ -1,0 +1,21 @@
+"""Go-style duration strings ("150ms", "10s", "1m", "1h", bare seconds)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def parse_duration(v: Any) -> float:
+    """Parse to seconds. Raises ValueError on garbage."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    if s.endswith("m"):
+        return float(s[:-1]) * 60.0
+    if s.endswith("h"):
+        return float(s[:-1]) * 3600.0
+    return float(s)

@@ -1,0 +1,311 @@
+"""Full-agent tests: HTTP API + DNS + checks + anti-entropy over real
+sockets (the reference's TestAgent pattern, agent/testagent.go)."""
+
+import base64
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api import APIError, ConsulClient
+from consul_tpu.config import load
+from consul_tpu.types import CheckStatus
+
+
+def wait_for(cond, timeout=15.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def agent():
+    cfg = load(dev=True, overrides={"node_name": "dev-agent"})
+    a = Agent(cfg)
+    a.start()
+    wait_for(lambda: a.server.is_leader(), what="self-elect leader")
+    wait_for(lambda: a.server.state.get_node("dev-agent") is not None,
+             what="self registration")
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(agent):
+    return ConsulClient(agent.http.addr)
+
+
+def test_status_endpoints(agent, client):
+    assert client.status_leader() != ""
+    assert len(client.status_peers()) == 1
+
+
+def test_agent_self_and_members(agent, client):
+    info = client.agent_self()
+    assert info["Config"]["NodeName"] == "dev-agent"
+    assert info["Config"]["Server"] is True
+    members = client.agent_members()
+    assert [m["name"] for m in members] == ["dev-agent"]
+
+
+def test_kv_http_roundtrip(agent, client):
+    assert client.kv_put("app/config", b"hello world") is True
+    assert client.kv_get("app/config") == b"hello world"
+    # raw mode
+    raw = client.get("/v1/kv/app/config", raw="")
+    assert raw == b"hello world"
+    # entry metadata + index header
+    entry, idx = client.get_with_index("/v1/kv/app/config")
+    assert idx > 0
+    assert entry[0]["Key"] == "app/config"
+    # CAS
+    mi = entry[0]["ModifyIndex"]
+    assert client.kv_cas("app/config", b"v2", mi) is True
+    assert client.kv_cas("app/config", b"v3", mi) is False
+    # keys + recurse + delete
+    client.kv_put("app/a/1", b"1")
+    client.kv_put("app/a/2", b"2")
+    assert client.kv_keys("app/", separator="/") == \
+        ["app/a/", "app/config"]
+    assert len(client.kv_list("app/")) == 3
+    client.kv_delete("app/", recurse=True)
+    assert client.kv_get("app/config") is None
+    # 404 on missing key
+    with pytest.raises(APIError) as ei:
+        client.get("/v1/kv/definitely/missing")
+    assert ei.value.code == 404
+
+
+def test_kv_blocking_query_over_http(agent, client):
+    client.kv_put("watch/key", b"v0")
+    entry, idx = client.get_with_index("/v1/kv/watch/key")
+    got = {}
+
+    def blocker():
+        got["entries"], got["idx"] = client.get_with_index(
+            "/v1/kv/watch/key", index=idx, wait="10s")
+
+    t = threading.Thread(target=blocker)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive()
+    client.kv_put("watch/key", b"v1")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["idx"] > idx
+    assert base64.b64decode(got["entries"][0]["Value"]) == b"v1"
+
+
+def test_service_registration_flows_to_catalog(agent, client):
+    client.service_register({
+        "Name": "web", "ID": "web1", "Port": 8080, "Tags": ["v1"],
+        "Check": {"TTL": "30s"}})
+    # anti-entropy pushes to the catalog
+    wait_for(lambda: client.catalog_service("web"),
+             what="service in catalog")
+    svc = client.catalog_service("web")[0]
+    assert svc["ServicePort"] == 8080
+    assert svc["ServiceTags"] == ["v1"]
+    # TTL check starts critical → health endpoint filters it
+    assert client.health_service("web", passing=True) == []
+    client.check_pass("service:web1")
+    wait_for(lambda: client.health_service("web", passing=True),
+             what="passing health after TTL pass")
+    # local agent views
+    assert "web1" in client.agent_services()
+    assert "service:web1" in client.agent_checks()
+
+
+def test_ttl_check_expires(agent, client):
+    client.service_register({
+        "Name": "flaky", "ID": "flaky1", "Port": 1000,
+        "Check": {"TTL": "1s"}})
+    client.check_pass("service:flaky1")
+    wait_for(lambda: any(
+        c["Status"] == "passing"
+        for c in client.health_node("dev-agent")
+        if c["CheckID"] == "service:flaky1"), what="ttl passing")
+    # stop refreshing: flips critical
+    wait_for(lambda: any(
+        c["Status"] == "critical"
+        for c in client.health_node("dev-agent")
+        if c["CheckID"] == "service:flaky1"),
+        timeout=15.0, what="ttl expiry")
+    client.service_deregister("flaky1")
+    wait_for(lambda: not client.catalog_service("flaky"),
+             what="catalog deregistration")
+
+
+def test_tcp_check_against_real_listener(agent, client):
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(5)
+    port = srv.getsockname()[1]
+    try:
+        client.check_register({
+            "Name": "tcp-probe", "CheckID": "tcp-probe",
+            "TCP": f"127.0.0.1:{port}", "Interval": "0.3s"})
+        wait_for(lambda: any(
+            c["Status"] == "passing"
+            for c in client.health_node("dev-agent")
+            if c["CheckID"] == "tcp-probe"), what="tcp check passing")
+    finally:
+        srv.close()
+    wait_for(lambda: any(
+        c["Status"] == "critical"
+        for c in client.health_node("dev-agent")
+        if c["CheckID"] == "tcp-probe"), what="tcp check critical")
+    client.check_deregister("tcp-probe")
+
+
+def test_session_and_lock_over_http(agent, client):
+    sid = client.session_create({"Name": "test-lock"})
+    assert client.session_info(sid)[0]["ID"] == sid
+    assert client.kv_acquire("locks/job", b"owner1", sid) is True
+    # second session cannot steal
+    sid2 = client.session_create({})
+    assert client.kv_acquire("locks/job", b"owner2", sid2) is False
+    entry = client.kv_get_entry("locks/job")
+    assert entry["Session"] == sid
+    assert client.kv_release("locks/job", sid) is True
+    client.session_destroy(sid)
+    client.session_destroy(sid2)
+
+
+def test_txn_endpoint(agent, client):
+    ops = [{"KV": {"Verb": "set", "Key": "txn/a",
+                   "Value": base64.b64encode(b"1").decode()}},
+           {"KV": {"Verb": "set", "Key": "txn/b",
+                   "Value": base64.b64encode(b"2").decode()}}]
+    res = client.put("/v1/txn", body=ops)
+    assert res["Errors"] is None
+    assert client.kv_get("txn/a") == b"1"
+    # failing precondition → 409 and rollback
+    bad = [{"KV": {"Verb": "set", "Key": "txn/c",
+                   "Value": base64.b64encode(b"3").decode()}},
+           {"KV": {"Verb": "check-not-exists", "Key": "txn/a"}}]
+    with pytest.raises(APIError) as ei:
+        client.put("/v1/txn", body=bad)
+    assert ei.value.code == 409
+    assert client.kv_get("txn/c") is None
+
+
+def test_dns_node_and_service_lookups(agent, client):
+    client.service_register({
+        "Name": "db", "ID": "db1", "Port": 5432,
+        "Check": {"TTL": "60s"}})
+    client.check_pass("service:db1")
+    wait_for(lambda: client.health_service("db", passing=True),
+             what="db passing")
+
+    def dns_query(name, qtype):
+        q = struct.pack(">HHHHHH", 0x1234, 0x0100, 1, 0, 0, 0)
+        for label in name.rstrip(".").split("."):
+            q += bytes([len(label)]) + label.encode()
+        q += b"\x00" + struct.pack(">HH", qtype, 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(3.0)
+        s.sendto(q, ("127.0.0.1", agent.dns.port))
+        resp, _ = s.recvfrom(4096)
+        s.close()
+        return resp
+
+    # node lookup → A record with the agent's address
+    resp = dns_query("dev-agent.node.consul.", 1)
+    (qid, flags, qd, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    assert an >= 1, "expected A answer for node lookup"
+    assert resp[-4:] == socket.inet_aton("127.0.0.1")
+
+    # service lookup → A record for passing instance
+    resp = dns_query("db.service.consul.", 1)
+    (_, _, _, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    assert an >= 1, "expected A answer for service lookup"
+
+    # SRV lookup carries the port
+    resp = dns_query("db.service.consul.", 33)
+    (_, _, _, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    assert an >= 1
+    assert struct.pack(">H", 5432) in resp
+
+    # unknown name → NXDOMAIN (rcode 3)
+    resp = dns_query("nope.service.consul.", 1)
+    (_, flags, _, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    assert flags & 0x000F == 3
+    assert an == 0
+
+
+def test_event_fire_and_serf_delivery(agent, client):
+    got = []
+    agent.serf.add_event_handler(
+        lambda ev: got.append(ev) if ev.type.value == "user" else None)
+    res = client.event_fire("deploy", b"v9")
+    assert res["Name"] == "deploy"
+    wait_for(lambda: any(e.name == "consul:event:deploy" for e in got),
+             what="user event delivery")
+
+
+def test_operator_raft_configuration(agent, client):
+    cfg = client.raft_configuration()
+    assert len(cfg["Servers"]) == 1
+    assert cfg["Servers"][0]["Leader"] is True
+
+
+def test_metrics_endpoint(agent, client):
+    snap = client.get("/v1/agent/metrics")
+    assert "Counters" in snap and "Samples" in snap
+
+
+def test_prepared_query_crud_and_execute(agent, client):
+    client.service_register({
+        "Name": "api", "ID": "api1", "Port": 9090,
+        "Check": {"TTL": "60s"}})
+    client.check_pass("service:api1")
+    wait_for(lambda: client.health_service("api", passing=True),
+             what="api passing")
+    res = client.put("/v1/query", body={
+        "Name": "api-query", "Service": {"Service": "api"}})
+    qid = res["ID"]
+    # list + get
+    assert any(x["ID"] == qid for x in client.get("/v1/query"))
+    assert client.get(f"/v1/query/{qid}")[0]["Name"] == "api-query"
+    # execute by name and by id
+    for ident in (qid, "api-query"):
+        out = client.get(f"/v1/query/{ident}/execute")
+        assert out["Nodes"] and \
+            out["Nodes"][0]["Service"]["Port"] == 9090
+    # DNS prepared-query path: api-query.query.consul
+    import socket as s_, struct as st_
+    qmsg = st_.pack(">HHHHHH", 7, 0x0100, 1, 0, 0, 0)
+    for l in "api-query.query.consul".split("."):
+        qmsg += bytes([len(l)]) + l.encode()
+    qmsg += b"\x00" + st_.pack(">HH", 33, 1)
+    sk = s_.socket(s_.AF_INET, s_.SOCK_DGRAM)
+    sk.settimeout(3)
+    sk.sendto(qmsg, ("127.0.0.1", agent.dns.port))
+    resp, _ = sk.recvfrom(4096)
+    sk.close()
+    assert st_.unpack_from(">HHHHHH", resp)[3] >= 1
+    assert st_.pack(">H", 9090) in resp
+    client.delete(f"/v1/query/{qid}")
+    with pytest.raises(APIError):
+        client.get(f"/v1/query/{qid}")
+
+
+def test_mutating_endpoints_reject_get(agent, client):
+    sid = client.session_create({})
+    # GET on destroy must not destroy (404 route miss)
+    with pytest.raises(APIError) as ei:
+        client.get(f"/v1/session/destroy/{sid}")
+    assert ei.value.code == 404
+    assert client.session_info(sid), "session must survive a GET"
+    client.session_destroy(sid)

@@ -19,7 +19,12 @@ def test_defaults_and_dev_mode():
     cfg = load(dev=True)
     assert cfg.server_mode and cfg.bootstrap and cfg.dev_mode
     assert cfg.datacenter == "dc1"
-    assert cfg.port("http") == 8500
+    # dev agents bind ephemeral ports unless explicitly configured
+    assert cfg.port("http") == 0
+    assert load(dev=True, overrides={"ports": {"http": 18500}}
+                ).port("http") == 18500
+    # non-dev agents use the reference default ports
+    assert load(overrides={"server": False}).port("http") == 8500
     # dev mode uses fast local gossip timing
     assert cfg.gossip_lan.probe_interval == pytest.approx(0.2)
 
