@@ -1,0 +1,23 @@
+"""ACL engine: tokens → policies → enforcement.
+
+Reference: acl/ (the policy language + authorizer, ~11k LoC) and
+agent/consul/acl*.go (the resolver embedded in every server,
+server.go:180). Model implemented here:
+
+  * policies: rules over resources (key/key_prefix, service/
+    service_prefix, node/node_prefix, agent, event/event_prefix,
+    query/query_prefix, session/session_prefix, keyring, operator, acl)
+    with levels deny < read < write; longest-prefix match wins, exact
+    beats prefix (acl/policy.go semantics);
+  * tokens: SecretID → set of policies; the distinguished management
+    policy grants everything (acl:write);
+  * resolution: default policy (allow/deny) applies when no rule
+    matches; anonymous token for requests without one;
+  * bootstrap: one-shot initial management token creation
+    (acl_endpoint.go Bootstrap).
+"""
+
+from consul_tpu.acl.policy import Authorizer, Policy, parse_policy
+from consul_tpu.acl.resolver import ACLResolver
+
+__all__ = ["Authorizer", "Policy", "parse_policy", "ACLResolver"]

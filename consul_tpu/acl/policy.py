@@ -1,0 +1,218 @@
+"""ACL policy language and authorizer.
+
+Reference: acl/policy.go + acl/authorizer.go. Policies are JSON (the
+reference also accepts HCL; JSON is the wire format its API uses):
+
+    {"key_prefix": {"app/": {"policy": "write"}},
+     "key": {"app/secret": {"policy": "deny"}},
+     "service_prefix": {"": {"policy": "read"}},
+     "node_prefix": {"": {"policy": "read"}},
+     "agent": {"policy": "write"},
+     "operator": "read",
+     "acl": "write"}
+
+Enforcement semantics (acl/policy_authorizer.go): exact-match rules
+beat prefix rules; among prefix rules the LONGEST match wins; absent
+any match the default policy applies.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+DENY = 0
+READ = 1
+WRITE = 2
+
+_LEVELS = {"deny": DENY, "read": READ, "write": WRITE}
+
+#: resources with exact + prefix rule maps
+PREFIXED = ("key", "service", "node", "event", "query", "session")
+#: scalar resources (single level)
+SCALAR = ("agent", "operator", "acl", "keyring", "mesh")
+
+
+@dataclass
+class Policy:
+    id: str = ""
+    name: str = ""
+    # exact[resource][name] = level; prefix[resource][prefix] = level
+    exact: dict[str, dict[str, int]] = field(default_factory=dict)
+    prefix: dict[str, dict[str, int]] = field(default_factory=dict)
+    scalar: dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"ID": self.id, "Name": self.name,
+                "Rules": self.rules_json()}
+
+    def rules_json(self) -> str:
+        out: dict[str, Any] = {}
+        for res, rules in self.exact.items():
+            out[res] = {n: {"policy": _level_name(lv)}
+                        for n, lv in rules.items()}
+        for res, rules in self.prefix.items():
+            out[f"{res}_prefix"] = {n: {"policy": _level_name(lv)}
+                                    for n, lv in rules.items()}
+        for res, lv in self.scalar.items():
+            out[res] = _level_name(lv)
+        return json.dumps(out)
+
+
+def _level_name(lv: int) -> str:
+    return {DENY: "deny", READ: "read", WRITE: "write"}[lv]
+
+
+def parse_policy(rules: str | dict[str, Any], pid: str = "",
+                 name: str = "") -> Policy:
+    """Parse JSON policy rules (raises ValueError on malformed input)."""
+    if isinstance(rules, str):
+        data = json.loads(rules) if rules.strip() else {}
+    else:
+        data = rules
+    p = Policy(id=pid, name=name)
+    for key, val in data.items():
+        if key in SCALAR:
+            level = val.get("policy") if isinstance(val, dict) else val
+            p.scalar[key] = _parse_level(level)
+        elif key in PREFIXED:
+            p.exact.setdefault(key, {}).update(
+                {n: _parse_level(_rule_level(r)) for n, r in val.items()})
+        elif key.endswith("_prefix") and key[:-7] in PREFIXED:
+            p.prefix.setdefault(key[:-7], {}).update(
+                {n: _parse_level(_rule_level(r)) for n, r in val.items()})
+        else:
+            raise ValueError(f"unknown policy resource {key!r}")
+    return p
+
+
+def _rule_level(r: Any) -> str:
+    if isinstance(r, dict):
+        return r.get("policy", "deny")
+    return str(r)
+
+
+def _parse_level(level: Any) -> int:
+    lv = _LEVELS.get(str(level).lower())
+    if lv is None:
+        raise ValueError(f"unknown policy level {level!r}")
+    return lv
+
+
+class Authorizer:
+    """The merged view of a token's policies. Merge semantics follow the
+    reference (acl docs: "deny always wins"): more-specific rules beat
+    less-specific ones; at EQUAL specificity across policies, a deny
+    from any policy wins over grants from others."""
+
+    def __init__(self, policies: list[Policy],
+                 default_level: int = WRITE,
+                 is_management: bool = False) -> None:
+        self.policies = policies
+        self.default_level = default_level
+        self.is_management = is_management
+
+    # resource checks ------------------------------------------------------
+
+    def _resolve(self, resource: str, name: str) -> int:
+        if self.is_management:
+            return WRITE
+        best: Optional[tuple[int, int, int]] = None  # (exact, len, level)
+        for p in self.policies:
+            lv = p.exact.get(resource, {}).get(name)
+            if lv is not None:
+                cand = (1, len(name), lv)
+                best = _merge(best, cand)
+            for pref, plv in p.prefix.get(resource, {}).items():
+                if name.startswith(pref):
+                    best = _merge(best, (0, len(pref), plv))
+        if best is None:
+            return self.default_level
+        return best[2]
+
+    def _scalar(self, resource: str) -> int:
+        if self.is_management:
+            return WRITE
+        levels = [p.scalar[resource] for p in self.policies
+                  if resource in p.scalar]
+        if not levels:
+            return self.default_level
+        return DENY if DENY in levels else max(levels)
+
+    # public surface (mirrors acl.Authorizer methods) ----------------------
+
+    def key_read(self, key: str) -> bool:
+        return self._resolve("key", key) >= READ
+
+    def key_write(self, key: str) -> bool:
+        return self._resolve("key", key) >= WRITE
+
+    def service_read(self, name: str) -> bool:
+        return self._resolve("service", name) >= READ
+
+    def service_write(self, name: str) -> bool:
+        return self._resolve("service", name) >= WRITE
+
+    def node_read(self, name: str) -> bool:
+        return self._resolve("node", name) >= READ
+
+    def node_write(self, name: str) -> bool:
+        return self._resolve("node", name) >= WRITE
+
+    def event_read(self, name: str) -> bool:
+        return self._resolve("event", name) >= READ
+
+    def event_write(self, name: str) -> bool:
+        return self._resolve("event", name) >= WRITE
+
+    def query_read(self, name: str) -> bool:
+        return self._resolve("query", name) >= READ
+
+    def query_write(self, name: str) -> bool:
+        return self._resolve("query", name) >= WRITE
+
+    def session_read(self, node: str) -> bool:
+        return self._resolve("session", node) >= READ
+
+    def session_write(self, node: str) -> bool:
+        return self._resolve("session", node) >= WRITE
+
+    def agent_read(self) -> bool:
+        return self._scalar("agent") >= READ
+
+    def agent_write(self) -> bool:
+        return self._scalar("agent") >= WRITE
+
+    def operator_read(self) -> bool:
+        return self._scalar("operator") >= READ
+
+    def operator_write(self) -> bool:
+        return self._scalar("operator") >= WRITE
+
+    def acl_read(self) -> bool:
+        return self._scalar("acl") >= READ
+
+    def acl_write(self) -> bool:
+        return self._scalar("acl") >= WRITE
+
+    def keyring_read(self) -> bool:
+        return self._scalar("keyring") >= READ
+
+    def keyring_write(self) -> bool:
+        return self._scalar("keyring") >= WRITE
+
+
+def _merge(best: Optional[tuple[int, int, int]],
+           cand: tuple[int, int, int]) -> tuple[int, int, int]:
+    """More specific wins (exactness, then prefix length); at equal
+    specificity across policies, deny wins over any grant."""
+    if best is None:
+        return cand
+    if cand[:2] > best[:2]:
+        return cand
+    if cand[:2] == best[:2]:
+        merged = DENY if DENY in (best[2], cand[2]) \
+            else max(best[2], cand[2])
+        return (best[0], best[1], merged)
+    return best

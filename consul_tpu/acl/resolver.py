@@ -1,0 +1,88 @@
+"""Token → Authorizer resolution with caching.
+
+Reference: agent/consul/acl.go ACLResolver (cached token/policy
+resolution with TTLs and down-policy). Tokens and policies live in the
+replicated state store (acl_tokens / acl_policies tables, written via
+the ACL FSM commands); resolution happens on every authenticated
+request.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from consul_tpu.acl.policy import Authorizer, DENY, WRITE, parse_policy
+from consul_tpu.utils import log
+
+ANONYMOUS_TOKEN_ID = "anonymous"
+
+
+class ACLDisabledError(Exception):
+    pass
+
+
+class PermissionDeniedError(Exception):
+    def __init__(self, what: str = "Permission denied") -> None:
+        super().__init__(what)
+
+
+class ACLResolver:
+    def __init__(self, state, enabled: bool, default_policy: str = "allow",
+                 token_ttl: float = 30.0) -> None:
+        self.state = state
+        self.enabled = enabled
+        self.default_level = WRITE if default_policy == "allow" else DENY
+        self.token_ttl = token_ttl
+        self.log = log.named("acl")
+        self._cache: dict[str, tuple[float, Authorizer]] = {}
+
+    def resolve(self, secret_id: str) -> Authorizer:
+        """SecretID → merged Authorizer. Unknown tokens resolve to the
+        anonymous authorizer (reference behavior: unknown token =
+        anonymous unless down-policy says otherwise)."""
+        if not self.enabled:
+            return Authorizer([], default_level=WRITE)
+        secret_id = secret_id or ANONYMOUS_TOKEN_ID
+        now = time.monotonic()
+        hit = self._cache.get(secret_id)
+        if hit is not None and now - hit[0] < self.token_ttl:
+            return hit[1]
+        authz = self._resolve_uncached(secret_id)
+        self._cache[secret_id] = (now, authz)
+        if len(self._cache) > 4096:
+            cutoff = now - self.token_ttl
+            self._cache = {k: v for k, v in self._cache.items()
+                           if v[0] >= cutoff}
+        return authz
+
+    def _resolve_uncached(self, secret_id: str) -> Authorizer:
+        token = self.state.raw_get("acl_tokens", secret_id)
+        if token is None:
+            # anonymous: no policies, default policy applies
+            return Authorizer([], default_level=self.default_level)
+        if token.get("Management") or any(
+                p.get("ID") == "global-management"
+                for p in token.get("Policies") or []):
+            return Authorizer([], default_level=WRITE, is_management=True)
+        policies = []
+        for ref in token.get("Policies") or []:
+            pol = self.state.raw_get("acl_policies", ref.get("ID", ""))
+            if pol is None:
+                # fall back to by-name lookup
+                for cand in self.state.raw_list("acl_policies"):
+                    if cand.get("Name") == ref.get("Name"):
+                        pol = cand
+                        break
+            if pol is not None:
+                try:
+                    policies.append(parse_policy(
+                        pol.get("Rules", "{}"), pol.get("ID", ""),
+                        pol.get("Name", "")))
+                except ValueError as e:
+                    self.log.warning("bad policy %s: %s",
+                                     pol.get("Name"), e)
+        return Authorizer(policies, default_level=self.default_level)
+
+    def invalidate(self) -> None:
+        self._cache.clear()

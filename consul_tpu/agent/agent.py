@@ -73,6 +73,9 @@ class Agent:
             self._retry_join(seeds)
         self.sync.start()
         self._coord_loop()
+        # keyring ops propagate cluster-wide as internal user events
+        # (the reference uses serf queries, agent/keyring.go:234-262)
+        self.serf.add_event_handler(self._internal_event)
         if serve_http:
             from consul_tpu.agent.http import HTTPApi
 
@@ -238,6 +241,26 @@ class Agent:
             self.local.remove_check("_node_maintenance")
 
     # ------------------------------------------------------------- internals
+
+    def _internal_event(self, ev) -> None:
+        from consul_tpu.gossip.serf import EventType
+
+        if ev.type != EventType.USER \
+                or not ev.name.startswith("consul:keyring:"):
+            return
+        op = ev.name.rsplit(":", 1)[1]
+        kr = self.serf.memberlist.keyring
+        if kr is None:
+            return
+        try:
+            if op == "install":
+                kr.install(ev.payload)
+            elif op == "use":
+                kr.use(ev.payload)
+            elif op == "remove":
+                kr.remove(ev.payload)
+        except (KeyError, ValueError) as e:
+            self.log.debug("keyring event %s: %s", op, e)
 
     def _state_changed(self) -> None:
         if not self._shutdown:

@@ -56,9 +56,12 @@ class HTTPApi:
                 ln = int(self.headers.get("Content-Length") or 0)
                 if ln:
                     body = self.rfile.read(ln)
+                token = self.headers.get("X-Consul-Token") \
+                    or query.pop("token", "")
                 start = telemetry.time_now()
                 try:
-                    result, index = api.route(method, path, query, body)
+                    result, index = api.route(method, path, query, body,
+                                              token)
                     payload = b"" if result is None else (
                         result if isinstance(result, bytes)
                         else json.dumps(result).encode())
@@ -74,7 +77,8 @@ class HTTPApi:
                 except HTTPError as e:
                     self._err(e.code, str(e))
                 except RPCError as e:
-                    self._err(500, str(e))
+                    code = 403 if "Permission denied" in str(e) else 500
+                    self._err(code, str(e))
                 except Exception as e:  # noqa: BLE001
                     api.log.warning("%s %s failed: %s", method, path, e)
                     self._err(500, f"internal error: {e}")
@@ -118,11 +122,15 @@ class HTTPApi:
     # ------------------------------------------------------------- routing
 
     def route(self, method: str, path: str, q: dict[str, str],
-              body: bytes) -> tuple[Any, Optional[int]]:
+              body: bytes, token: str = "") -> tuple[Any, Optional[int]]:
         a = self.agent
+
+        def rpc(name: str, args: dict[str, Any]) -> Any:
+            return a.rpc(name, {**args, "AuthToken": token})
 
         def blocking_args(extra: Optional[dict] = None) -> dict[str, Any]:
             args = dict(extra or {})
+            args["AuthToken"] = token
             if "index" in q:
                 args["MinQueryIndex"] = int(q["index"])
             if "wait" in q:
@@ -141,9 +149,9 @@ class HTTPApi:
 
         # ---------------------------------------------------------- status
         if path == "/v1/status/leader":
-            return a.rpc("Status.Leader", {}), None
+            return rpc("Status.Leader", {}), None
         if path == "/v1/status/peers":
-            return a.rpc("Status.Peers", {}), None
+            return rpc("Status.Peers", {}), None
 
         # ----------------------------------------------------------- agent
         if path == "/v1/agent/self":
@@ -213,26 +221,26 @@ class HTTPApi:
         if path == "/v1/catalog/datacenters":
             return [a.config.datacenter], None
         if path == "/v1/catalog/nodes":
-            res = a.rpc("Catalog.ListNodes", blocking_args())
+            res = rpc("Catalog.ListNodes", blocking_args())
             return res["Nodes"], res["Index"]
         if path == "/v1/catalog/services":
-            res = a.rpc("Catalog.ListServices", blocking_args())
+            res = rpc("Catalog.ListServices", blocking_args())
             return res["Services"], res["Index"]
         if (m := re.match(r"^/v1/catalog/service/(.+)$", path)):
             args = blocking_args({"ServiceName":
                                   urllib.parse.unquote(m.group(1))})
             if "tag" in q:
                 args["ServiceTag"] = q["tag"]
-            res = a.rpc("Catalog.ServiceNodes", args)
+            res = rpc("Catalog.ServiceNodes", args)
             return res["ServiceNodes"], res["Index"]
         if (m := re.match(r"^/v1/catalog/node/(.+)$", path)):
-            res = a.rpc("Catalog.NodeServices", blocking_args(
+            res = rpc("Catalog.NodeServices", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
             return res["NodeServices"], res["Index"]
         if path == "/v1/catalog/register" and method in ("PUT", "POST"):
-            return a.rpc("Catalog.Register", jbody()), None
+            return rpc("Catalog.Register", jbody()), None
         if path == "/v1/catalog/deregister" and method in ("PUT", "POST"):
-            return a.rpc("Catalog.Deregister", jbody()), None
+            return rpc("Catalog.Deregister", jbody()), None
 
         # ---------------------------------------------------------- health
         if (m := re.match(r"^/v1/health/service/(.+)$", path)):
@@ -242,61 +250,61 @@ class HTTPApi:
                 args["ServiceTag"] = q["tag"]
             if "passing" in q:
                 args["MustBePassing"] = True
-            res = a.rpc("Health.ServiceNodes", args)
+            res = rpc("Health.ServiceNodes", args)
             return res["Nodes"], res["Index"]
         if (m := re.match(r"^/v1/health/node/(.+)$", path)):
-            res = a.rpc("Health.NodeChecks", blocking_args(
+            res = rpc("Health.NodeChecks", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
             return res["HealthChecks"], res["Index"]
         if (m := re.match(r"^/v1/health/checks/(.+)$", path)):
-            res = a.rpc("Health.ServiceChecks", blocking_args(
+            res = rpc("Health.ServiceChecks", blocking_args(
                 {"ServiceName": urllib.parse.unquote(m.group(1))}))
             return res["HealthChecks"], res["Index"]
         if (m := re.match(r"^/v1/health/state/(.+)$", path)):
-            res = a.rpc("Health.ChecksInState", blocking_args(
+            res = rpc("Health.ChecksInState", blocking_args(
                 {"State": urllib.parse.unquote(m.group(1))}))
             return res["HealthChecks"], res["Index"]
 
         # -------------------------------------------------------------- KV
         if (m := re.match(r"^/v1/kv/(.*)$", path)):
             return self._kv(method, urllib.parse.unquote(m.group(1)), q,
-                            body, blocking_args)
+                            body, blocking_args, rpc)
 
         # --------------------------------------------------------- session
         if path == "/v1/session/create" and method in ("PUT", "POST"):
             b = jbody()
             b.setdefault("Node", a.name)
-            sid = a.rpc("Session.Apply", {"Op": "create", "Session": b})
+            sid = rpc("Session.Apply", {"Op": "create", "Session": b})
             return {"ID": sid}, None
         if (m := re.match(r"^/v1/session/destroy/(.+)$", path)) \
                 and method in ("PUT", "POST"):
-            a.rpc("Session.Apply", {"Op": "destroy",
+            rpc("Session.Apply", {"Op": "destroy",
                                     "Session": m.group(1)})
             return True, None
         if (m := re.match(r"^/v1/session/info/(.+)$", path)):
-            res = a.rpc("Session.Get", blocking_args(
+            res = rpc("Session.Get", blocking_args(
                 {"SessionID": m.group(1)}))
             return res["Sessions"], res["Index"]
         if (m := re.match(r"^/v1/session/node/(.+)$", path)):
-            res = a.rpc("Session.List", blocking_args(
+            res = rpc("Session.List", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
             return res["Sessions"], res["Index"]
         if path == "/v1/session/list":
-            res = a.rpc("Session.List", blocking_args())
+            res = rpc("Session.List", blocking_args())
             return res["Sessions"], res["Index"]
         if (m := re.match(r"^/v1/session/renew/(.+)$", path)) \
                 and method in ("PUT", "POST"):
-            res = a.rpc("Session.Renew", {"SessionID": m.group(1)})
+            res = rpc("Session.Renew", {"SessionID": m.group(1)})
             if not res["Sessions"]:
                 raise HTTPError(404, "session not found")
             return res["Sessions"], None
 
         # ------------------------------------------------------ coordinate
         if path == "/v1/coordinate/nodes":
-            res = a.rpc("Coordinate.ListNodes", blocking_args())
+            res = rpc("Coordinate.ListNodes", blocking_args())
             return res["Coordinates"], res["Index"]
         if (m := re.match(r"^/v1/coordinate/node/(.+)$", path)):
-            res = a.rpc("Coordinate.Node", blocking_args(
+            res = rpc("Coordinate.Node", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
             return res["Coordinates"], res["Index"]
 
@@ -307,7 +315,7 @@ class HTTPApi:
                 kv = op.get("KV")
                 if kv and kv.get("Value"):
                     kv["Value"] = base64.b64decode(kv["Value"])
-            res = a.rpc("Txn.Apply", {"Ops": ops})
+            res = rpc("Txn.Apply", {"Ops": ops})
             if res.get("Errors"):
                 raise HTTPError(409, json.dumps(res["Errors"]))
             return res, None
@@ -320,38 +328,104 @@ class HTTPApi:
             return {"Name": name, "Payload":
                     base64.b64encode(body).decode() if body else None}, None
 
+        # ------------------------------------------------------------- acl
+        if path == "/v1/acl/bootstrap" and method in ("PUT", "POST"):
+            return rpc("ACL.Bootstrap", {}), None
+        if path == "/v1/acl/token" and method in ("PUT", "POST"):
+            return rpc("ACL.TokenSet", {"Token": jbody()}), None
+        if (m := re.match(r"^/v1/acl/token/(.+)$", path)):
+            tid = urllib.parse.unquote(m.group(1))
+            if method == "DELETE":
+                if not rpc("ACL.TokenDelete", {"TokenID": tid}):
+                    raise HTTPError(404, "token not found")
+                return True, None
+            if method == "PUT":
+                b = jbody()
+                b.setdefault("AccessorID", tid)
+                return rpc("ACL.TokenSet", {"Token": b}), None
+            res = rpc("ACL.TokenRead", {"TokenID": tid})
+            if res.get("Token") is None:
+                raise HTTPError(404, "token not found")
+            return res["Token"], None
+        if path == "/v1/acl/tokens":
+            return rpc("ACL.TokenList", {})["Tokens"], None
+        if path == "/v1/acl/policy" and method in ("PUT", "POST"):
+            return rpc("ACL.PolicySet", {"Policy": jbody()}), None
+        if (m := re.match(r"^/v1/acl/policy/(.+)$", path)):
+            pid = urllib.parse.unquote(m.group(1))
+            if method == "DELETE":
+                rpc("ACL.PolicyDelete", {"PolicyID": pid})
+                return True, None
+            if method == "PUT":
+                b = jbody()
+                b.setdefault("ID", pid)
+                return rpc("ACL.PolicySet", {"Policy": b}), None
+            res = rpc("ACL.PolicyRead", {"PolicyID": pid})
+            if res.get("Policy") is None:
+                raise HTTPError(404, "policy not found")
+            return res["Policy"], None
+        if path == "/v1/acl/policies":
+            return rpc("ACL.PolicyList", {})["Policies"], None
+
         # ----------------------------------------------------------- query
         if path == "/v1/query":
             if method in ("POST", "PUT"):
-                return a.rpc("PreparedQuery.Apply",
+                return rpc("PreparedQuery.Apply",
                              {"Op": "create", "Query": jbody()}), None
-            res = a.rpc("PreparedQuery.List", blocking_args())
+            res = rpc("PreparedQuery.List", blocking_args())
             return res["Queries"], res["Index"]
         if (m := re.match(r"^/v1/query/([^/]+)/execute$", path)):
-            res = a.rpc("PreparedQuery.Execute", {
+            res = rpc("PreparedQuery.Execute", {
                 "QueryIDOrName": urllib.parse.unquote(m.group(1)),
                 "Limit": int(q.get("limit", 0))})
             return res, None
         if (m := re.match(r"^/v1/query/([^/]+)$", path)):
             qid = urllib.parse.unquote(m.group(1))
             if method == "DELETE":
-                a.rpc("PreparedQuery.Apply",
+                rpc("PreparedQuery.Apply",
                       {"Op": "delete", "Query": {"ID": qid}})
                 return None, None
             if method == "PUT":
                 b = jbody()
                 b["ID"] = qid
-                return a.rpc("PreparedQuery.Apply",
+                return rpc("PreparedQuery.Apply",
                              {"Op": "update", "Query": b}), None
-            res = a.rpc("PreparedQuery.Get",
+            res = rpc("PreparedQuery.Get",
                         blocking_args({"QueryID": qid}))
             if not res["Queries"]:
                 raise HTTPError(404, "query not found")
             return res["Queries"], res["Index"]
 
+        # -------------------------------------------------------- snapshot
+        if path == "/v1/snapshot":
+            if method == "GET":
+                return rpc("Snapshot.Save", {}), None
+            if method == "PUT":
+                meta = rpc("Snapshot.Restore", {"Archive": body})
+                return meta, None
+
+        # -------------------------------------------------------- keyring
+        if path == "/v1/operator/keyring":
+            if method == "GET":
+                res = rpc("Keyring.Op", {"Op": "list"})
+                return [{"Keys": {k: len(a.members())
+                                  for k in res["Keys"]},
+                         "NumNodes": len(a.members())}], None
+            op = {"POST": "install", "PUT": "use",
+                  "DELETE": "remove"}.get(method)
+            if op:
+                key_b64 = jbody().get("Key", "")
+                import base64 as b64mod
+
+                key = b64mod.b64decode(key_b64)
+                rpc("Keyring.Op", {"Op": op, "Key": key})
+                # propagate cluster-wide through the gossip layer
+                a.serf.user_event(f"consul:keyring:{op}", key)
+                return None, None
+
         # -------------------------------------------------------- operator
         if path == "/v1/operator/raft/configuration":
-            stats = a.rpc("Status.RaftStats", {})
+            stats = rpc("Status.RaftStats", {})
             return {"Servers": [
                 {"Address": p, "Leader": p == stats.get("leader"),
                  "Voter": True} for p in stats.get("peers", [])],
@@ -359,20 +433,20 @@ class HTTPApi:
 
         # ------------------------------------------------------- config
         if path == "/v1/config" and method in ("PUT", "POST"):
-            return a.rpc("ConfigEntry.Apply",
+            return rpc("ConfigEntry.Apply",
                          {"Op": "upsert", "Entry": jbody()}), None
         if (m := re.match(r"^/v1/config/([^/]+)/(.+)$", path)):
             if method == "DELETE":
-                return a.rpc("ConfigEntry.Apply", {
+                return rpc("ConfigEntry.Apply", {
                     "Op": "delete", "Entry": {
                         "Kind": m.group(1), "Name": m.group(2)}}), None
-            res = a.rpc("ConfigEntry.Get", blocking_args(
+            res = rpc("ConfigEntry.Get", blocking_args(
                 {"Kind": m.group(1), "Name": m.group(2)}))
             if res.get("Entry") is None:
                 raise HTTPError(404, "config entry not found")
             return res["Entry"], res["Index"]
         if (m := re.match(r"^/v1/config/([^/]+)$", path)):
-            res = a.rpc("ConfigEntry.List", blocking_args(
+            res = rpc("ConfigEntry.List", blocking_args(
                 {"Kind": m.group(1)}))
             return res["Entries"], res["Index"]
 
@@ -381,21 +455,20 @@ class HTTPApi:
     # ----------------------------------------------------------------- KV
 
     def _kv(self, method: str, key: str, q: dict[str, str], body: bytes,
-            blocking_args) -> tuple[Any, Optional[int]]:
-        a = self.agent
+            blocking_args, rpc) -> tuple[Any, Optional[int]]:
         if method == "GET":
             if "keys" in q:
-                res = a.rpc("KVS.ListKeys", blocking_args(
+                res = rpc("KVS.ListKeys", blocking_args(
                     {"Prefix": key, "Separator": q.get("separator", "")}))
                 if not res["Keys"] and "index" not in q:
                     raise HTTPError(404, "")
                 return res["Keys"], res["Index"]
             if "recurse" in q:
-                res = a.rpc("KVS.List", blocking_args({"Key": key}))
+                res = rpc("KVS.List", blocking_args({"Key": key}))
                 if not res["Entries"] and "index" not in q:
                     raise HTTPError(404, "")
                 return res["Entries"], res["Index"]
-            res = a.rpc("KVS.Get", blocking_args({"Key": key}))
+            res = rpc("KVS.Get", blocking_args({"Key": key}))
             if not res["Entries"]:
                 if "index" in q:
                     return [], res["Index"]
@@ -418,15 +491,15 @@ class HTTPApi:
             elif "release" in q:
                 op = "unlock"
                 dirent["Session"] = q["release"]
-            return a.rpc("KVS.Apply", {"Op": op, "DirEnt": dirent}), None
+            return rpc("KVS.Apply", {"Op": op, "DirEnt": dirent}), None
         if method == "DELETE":
             if "recurse" in q:
-                return a.rpc("KVS.Apply", {
+                return rpc("KVS.Apply", {
                     "Op": "delete-tree", "DirEnt": {"Key": key}}), None
             if "cas" in q:
-                return a.rpc("KVS.Apply", {
+                return rpc("KVS.Apply", {
                     "Op": "delete-cas", "DirEnt": {
                         "Key": key, "ModifyIndex": int(q["cas"])}}), None
-            return a.rpc("KVS.Apply", {"Op": "delete",
+            return rpc("KVS.Apply", {"Op": "delete",
                                        "DirEnt": {"Key": key}}), None
         raise HTTPError(405, f"method {method} not allowed")

@@ -326,6 +326,143 @@ def cmd_operator(args) -> int:
     return 1
 
 
+def cmd_snapshot(args) -> int:
+    c = _client(args)
+    if args.snapshot_cmd == "save":
+        data = c.get("/v1/snapshot")
+        with open(args.file, "wb") as f:
+            f.write(data)
+        print(f"Saved and verified snapshot to index "
+              f"({len(data)} bytes): {args.file}")
+        return 0
+    if args.snapshot_cmd == "restore":
+        with open(args.file, "rb") as f:
+            meta = c.put("/v1/snapshot", raw=f.read())
+        print(f"Restored snapshot (index {meta.get('Index')})")
+        return 0
+    if args.snapshot_cmd == "inspect":
+        from consul_tpu.server.snapshot import read_archive
+
+        with open(args.file, "rb") as f:
+            meta, blob = read_archive(f.read())
+        print(json.dumps({**meta, "SizeBytes": len(blob)}, indent=2))
+        return 0
+    return 1
+
+
+def cmd_keyring(args) -> int:
+    c = _client(args)
+    if args.list_keys:
+        rings = c.get("/v1/operator/keyring")
+        for ring in rings:
+            for k in ring["Keys"]:
+                print(k)
+        return 0
+    if args.install:
+        c._call("POST", "/v1/operator/keyring",
+                body={"Key": args.install})
+        print("Successfully installed key")
+        return 0
+    if args.use:
+        c._call("PUT", "/v1/operator/keyring", body={"Key": args.use})
+        print("Successfully changed primary key")
+        return 0
+    if args.remove:
+        c._call("DELETE", "/v1/operator/keyring",
+                body={"Key": args.remove})
+        print("Successfully removed key")
+        return 0
+    print("specify one of -list, -install, -use, -remove",
+          file=sys.stderr)
+    return 1
+
+
+def cmd_acl(args) -> int:
+    c = _client(args)
+    if args.acl_cmd == "bootstrap":
+        tok = c.put("/v1/acl/bootstrap")
+        print(f"SecretID:    {tok['SecretID']}")
+        print(f"AccessorID:  {tok['AccessorID']}")
+        return 0
+    if args.acl_cmd == "token":
+        if args.acl_sub == "create":
+            body = {"Description": args.description or ""}
+            if args.policy_name:
+                body["Policies"] = [{"Name": n} for n in args.policy_name]
+            tok = c.put("/v1/acl/token", body=body)
+            print(json.dumps(tok, indent=2))
+            return 0
+        if args.acl_sub == "list":
+            for t in c.get("/v1/acl/tokens"):
+                print(f"{t.get('AccessorID')}  {t.get('Description','')}")
+            return 0
+        if args.acl_sub == "delete":
+            c.delete(f"/v1/acl/token/{args.id}")
+            print(f"Token {args.id} deleted")
+            return 0
+    if args.acl_cmd == "policy":
+        if args.acl_sub == "create":
+            rules = args.rules
+            if rules and rules.startswith("@"):
+                with open(rules[1:]) as f:
+                    rules = f.read()
+            pol = c.put("/v1/acl/policy",
+                        body={"Name": args.name, "Rules": rules or "{}"})
+            print(json.dumps(pol, indent=2))
+            return 0
+        if args.acl_sub == "list":
+            for p in c.get("/v1/acl/policies"):
+                print(f"{p.get('ID')}  {p.get('Name','')}")
+            return 0
+        if args.acl_sub == "delete":
+            c.delete(f"/v1/acl/policy/{args.id}")
+            print(f"Policy {args.id} deleted")
+            return 0
+    return 1
+
+
+def cmd_watch(args) -> int:
+    """Long-poll a watched view and print (and optionally exec a handler
+    on) each change (api/watch + command/watch)."""
+    import subprocess
+
+    c = _client(args)
+    paths = {
+        "key": (f"/v1/kv/{args.key}", {}),
+        "keyprefix": (f"/v1/kv/{args.prefix}", {"recurse": ""}),
+        "services": ("/v1/catalog/services", {}),
+        "nodes": ("/v1/catalog/nodes", {}),
+        "service": (f"/v1/health/service/{args.service}", {}),
+        "checks": (f"/v1/health/state/any", {}),
+    }
+    if args.type not in paths:
+        print(f"unknown watch type {args.type}", file=sys.stderr)
+        return 1
+    path, params = paths[args.type]
+    index = 0
+    while True:
+        try:
+            result, index2 = c.get_with_index(path, index=index,
+                                              wait="30s", **params)
+        except APIError as e:
+            if e.code == 404:
+                index2 = index + 0
+                result = None
+                time.sleep(1)
+            else:
+                raise
+        if index2 != index or index == 0:
+            index = index2
+            out = json.dumps(result, indent=2)
+            if args.exec_cmd:
+                subprocess.run(args.exec_cmd, input=out.encode(),
+                               shell=True)
+            else:
+                print(out, flush=True)
+        if args.once:
+            return 0
+
+
 def _table(rows: list[tuple]) -> None:
     widths = [max(len(str(r[i])) for r in rows)
               for i in range(len(rows[0]))]
@@ -417,6 +554,51 @@ def build_parser() -> argparse.ArgumentParser:
     val = sub.add_parser("validate")
     val.add_argument("config_file", nargs="+")
     val.set_defaults(fn=cmd_validate)
+
+    snap = sub.add_parser("snapshot")
+    snapsub = snap.add_subparsers(dest="snapshot_cmd", required=True)
+    for name in ("save", "restore", "inspect"):
+        sp = snapsub.add_parser(name)
+        sp.add_argument("file")
+    snap.set_defaults(fn=cmd_snapshot)
+
+    kr = sub.add_parser("keyring")
+    kr.add_argument("-list", action="store_true", dest="list_keys")
+    kr.add_argument("-install", default=None)
+    kr.add_argument("-use", default=None)
+    kr.add_argument("-remove", default=None)
+    kr.set_defaults(fn=cmd_keyring)
+
+    acl = sub.add_parser("acl")
+    aclsub = acl.add_subparsers(dest="acl_cmd", required=True)
+    aclsub.add_parser("bootstrap")
+    tokp = aclsub.add_parser("token")
+    toksub = tokp.add_subparsers(dest="acl_sub", required=True)
+    tc = toksub.add_parser("create")
+    tc.add_argument("-description", dest="description", default="")
+    tc.add_argument("-policy-name", dest="policy_name", action="append",
+                    default=[])
+    toksub.add_parser("list")
+    td = toksub.add_parser("delete")
+    td.add_argument("-id", required=True)
+    polp = aclsub.add_parser("policy")
+    polsub = polp.add_subparsers(dest="acl_sub", required=True)
+    pc = polsub.add_parser("create")
+    pc.add_argument("-name", required=True)
+    pc.add_argument("-rules", default="")
+    polsub.add_parser("list")
+    pd = polsub.add_parser("delete")
+    pd.add_argument("-id", required=True)
+    acl.set_defaults(fn=cmd_acl)
+
+    w = sub.add_parser("watch")
+    w.add_argument("-type", required=True)
+    w.add_argument("-key", default="")
+    w.add_argument("-prefix", default="")
+    w.add_argument("-service", default="")
+    w.add_argument("-once", action="store_true")
+    w.add_argument("exec_cmd", nargs="?", default=None)
+    w.set_defaults(fn=cmd_watch)
 
     op = sub.add_parser("operator")
     opsub = op.add_subparsers(dest="operator_cmd", required=True)

@@ -20,6 +20,18 @@ def register_endpoints(srv) -> None:
     e = srv.endpoints
     state = srv.state
 
+    def authz(args):
+        return srv.acl.resolve(args.get("AuthToken", ""))
+
+    def require(ok: bool, what: str = "Permission denied") -> None:
+        if not ok:
+            raise RPCError(f"Permission denied: {what}")
+
+    def clean(args: dict) -> dict:
+        """Strip the auth token before anything reaches the raft log —
+        secrets must never be replicated/persisted."""
+        return {k: v for k, v in args.items() if k != "AuthToken"}
+
     def read(name, fn):
         """Register a read endpoint with consistency modes (rpc.go
         ForwardRPC): default → forwarded to the leader (read-your-writes);
@@ -58,21 +70,37 @@ def register_endpoints(srv) -> None:
 
     # ---------------------------------------------------------- Catalog
     def catalog_register(args):
+        az = authz(args)
+        require(az.node_write(args.get("Node", "")),
+                f"node write on {args.get('Node')!r}")
+        if args.get("Service"):
+            require(az.service_write(args["Service"].get("Service", "")),
+                    "service write")
+        args = {k: v for k, v in args.items() if k != "AuthToken"}
         return srv.forward_or_apply(MessageType.REGISTER, args)
 
     def catalog_deregister(args):
+        require(authz(args).node_write(args.get("Node", "")),
+                f"node write on {args.get('Node')!r}")
+        args = {k: v for k, v in args.items() if k != "AuthToken"}
         return srv.forward_or_apply(MessageType.DEREGISTER, args)
 
     def catalog_list_nodes(args):
+        az = authz(args)
         return srv.blocking_query(args, ("nodes",), lambda: {
-            "Nodes": [n.to_dict() for n in state.nodes()]})
+            "Nodes": [n.to_dict() for n in state.nodes()
+                      if az.node_read(n.node)]})
 
     def catalog_list_services(args):
+        az = authz(args)
         return srv.blocking_query(args, ("services",), lambda: {
-            "Services": state.services()})
+            "Services": {name: tags for name, tags
+                         in state.services().items()
+                         if az.service_read(name)}})
 
     def catalog_service_nodes(args):
         svc = args.get("ServiceName", "")
+        require(authz(args).service_read(svc), f"service read on {svc!r}")
         tag = args.get("ServiceTag") or None
         return srv.blocking_query(args, ("services", "nodes"), lambda: {
             "ServiceNodes": [
@@ -101,6 +129,7 @@ def register_endpoints(srv) -> None:
     # ------------------------------------------------------------ Health
     def health_service_nodes(args):
         svc = args.get("ServiceName", "")
+        require(authz(args).service_read(svc), f"service read on {svc!r}")
         tag = args.get("ServiceTag") or None
         passing = bool(args.get("MustBePassing"))
         return srv.blocking_query(
@@ -144,23 +173,32 @@ def register_endpoints(srv) -> None:
         d = args.get("DirEnt") or {}
         if not d.get("Key"):
             raise RPCError("missing key")
+        require(authz(args).key_write(d["Key"]),
+                f"key write on {d['Key']!r}")
+        args = {k: v for k, v in args.items() if k != "AuthToken"}
         return srv.forward_or_apply(MessageType.KVS, args)
 
     def kv_get(args):
         key = args.get("Key", "")
+        require(authz(args).key_read(key), f"key read on {key!r}")
         return srv.blocking_query(args, ("kv",), lambda: {
             "Entries": [e_.to_dict()] if (e_ := state.kv_get(key)) else []})
 
     def kv_list(args):
         prefix = args.get("Key", "")
+        az = authz(args)
         return srv.blocking_query(args, ("kv",), lambda: {
-            "Entries": [x.to_dict() for x in state.kv_list(prefix)]})
+            "Entries": [x.to_dict() for x in state.kv_list(prefix)
+                        if az.key_read(x.key)]})
 
     def kv_keys(args):
+        az = authz(args)
         return srv.blocking_query(args, ("kv",), lambda: {
-            "Keys": state.kv_keys(args.get("Prefix", ""),
-                                  args.get("Seperator",
-                                           args.get("Separator", "")))})
+            "Keys": [k for k in
+                     state.kv_keys(args.get("Prefix", ""),
+                                   args.get("Seperator",
+                                            args.get("Separator", "")))
+                     if az.key_read(k)]})
 
     e["KVS.Apply"] = kv_apply
     read("KVS.Get", kv_get)
@@ -170,6 +208,10 @@ def register_endpoints(srv) -> None:
     # ------------------------------------------------------------ Session
     def session_apply(args):
         op = args.get("Op", "create")
+        node = (args.get("Session") or {}).get("Node", "") \
+            if isinstance(args.get("Session"), dict) else ""
+        require(authz(args).session_write(node), "session write")
+        args = clean(args)
         if op == "create":
             sess = dict(args.get("Session") or {})
             sess.setdefault("ID", str(uuid.uuid4()))
@@ -225,9 +267,176 @@ def register_endpoints(srv) -> None:
 
     # ---------------------------------------------------------------- Txn
     def txn_apply(args):
-        return srv.forward_or_apply(MessageType.TXN, args)
+        az = authz(args)
+        for op in args.get("Ops") or []:
+            kv = op.get("KV") or {}
+            verb, key = kv.get("Verb", "set"), kv.get("Key", "")
+            if verb in ("get", "check-index", "check-not-exists"):
+                require(az.key_read(key), f"key read on {key!r}")
+            else:
+                require(az.key_write(key), f"key write on {key!r}")
+        return srv.forward_or_apply(MessageType.TXN, clean(args))
 
     e["Txn.Apply"] = txn_apply
+
+    # ---------------------------------------------------------- Snapshot
+    def snapshot_save(args):
+        """Full-state snapshot archive (snapshot/snapshot.go Save)."""
+        require(authz(args).operator_read(), "operator read")
+        from consul_tpu.server.snapshot import write_archive
+        from consul_tpu.version import __version__
+
+        if not srv.is_leader():
+            return srv._forward_to_leader("Snapshot.Save", args)
+        srv.raft.barrier()
+        return write_archive(srv.fsm.snapshot(),
+                             srv.raft.last_applied,
+                             srv.raft.store.term, __version__)
+
+    def snapshot_restore(args):
+        require(authz(args).operator_write(), "operator write")
+        from consul_tpu.server.snapshot import read_archive
+
+        meta, blob = read_archive(args["Archive"])
+        srv.forward_or_apply(MessageType.SNAPSHOT_RESTORE, {"Data": blob})
+        return meta
+
+    e["Snapshot.Save"] = snapshot_save
+    e["Snapshot.Restore"] = snapshot_restore
+
+    # ----------------------------------------------------------- Keyring
+    def keyring_op(args):
+        """List/install/use/remove gossip keys on THIS server's ring;
+        cluster-wide propagation rides user events (agent/keyring.go
+        keyringProcess over serf queries in the reference)."""
+        op = args.get("Op", "list")
+        kr = srv.serf.memberlist.keyring
+        if kr is None:
+            raise RPCError("gossip encryption is not enabled")
+        if op == "list":
+            require(authz(args).keyring_read(), "keyring read")
+            import base64 as b64
+
+            return {"Keys": [b64.b64encode(k).decode() for k in kr.keys]}
+        require(authz(args).keyring_write(), "keyring write")
+        key = args.get("Key") or b""
+        if op == "install":
+            kr.install(key)
+        elif op == "use":
+            kr.use(key)
+        elif op == "remove":
+            kr.remove(key)
+        else:
+            raise RPCError(f"unknown keyring op {op!r}")
+        return True
+
+    e["Keyring.Op"] = keyring_op
+
+    # --------------------------------------------------------------- ACL
+    def acl_bootstrap(args):
+        """One-shot cluster ACL bootstrap (acl_endpoint.go Bootstrap).
+        The one-shot check runs INSIDE the replicated command, so a stale
+        follower or two racing calls cannot double-bootstrap."""
+        if not srv.acl.enabled:
+            raise RPCError("ACL support disabled")
+        token = {"SecretID": str(uuid.uuid4()),
+                 "AccessorID": str(uuid.uuid4()),
+                 "Description": "Bootstrap Token (Global Management)",
+                 "Management": True}
+        res = srv.forward_or_apply(MessageType.ACL_TOKEN,
+                                   {"Op": "bootstrap", "Token": token})
+        if res is not True:
+            raise RPCError("ACL bootstrap no longer allowed")
+        return token
+
+    def _find_token(ident: str):
+        tok = state.raw_get("acl_tokens", ident)
+        if tok is not None:
+            return tok
+        for t in state.raw_list("acl_tokens"):
+            if t.get("AccessorID") == ident:
+                return t
+        return None
+
+    def acl_token_set(args):
+        require(authz(args).acl_write(), "acl write")
+        tok = dict(args.get("Token") or {})
+        if "SecretID" not in tok and tok.get("AccessorID"):
+            # update-by-accessor REPLACES the existing token (the table is
+            # keyed by SecretID — minting a new secret would leave the old
+            # one valid forever, breaking revocation)
+            existing = _find_token(tok["AccessorID"])
+            if existing is not None:
+                tok["SecretID"] = existing["SecretID"]
+        tok.setdefault("SecretID", str(uuid.uuid4()))
+        tok.setdefault("AccessorID", str(uuid.uuid4()))
+        srv.forward_or_apply(MessageType.ACL_TOKEN,
+                             {"Op": "set", "Token": tok})
+        return tok
+
+    def acl_token_delete(args):
+        require(authz(args).acl_write(), "acl write")
+        tok = _find_token(args.get("TokenID", ""))
+        if tok is None:
+            return False
+        srv.forward_or_apply(MessageType.ACL_TOKEN,
+                             {"Op": "delete", "Token": tok})
+        return True
+
+    def acl_token_read(args):
+        require(authz(args).acl_read(), "acl read")
+        tok = _find_token(args.get("TokenID", ""))
+        return {"Token": tok}
+
+    def acl_token_list(args):
+        require(authz(args).acl_read(), "acl read")
+        return {"Tokens": [
+            {k: v for k, v in t.items() if k != "SecretID"}
+            for t in state.raw_list("acl_tokens")]}
+
+    def acl_policy_set(args):
+        require(authz(args).acl_write(), "acl write")
+        from consul_tpu.acl import parse_policy
+
+        pol = dict(args.get("Policy") or {})
+        pol.setdefault("ID", str(uuid.uuid4()))
+        try:
+            parse_policy(pol.get("Rules", "{}"))  # validate up front
+        except ValueError as ex:
+            raise RPCError(f"invalid policy rules: {ex}") from ex
+        srv.forward_or_apply(MessageType.ACL_POLICY,
+                             {"Op": "set", "Policy": pol})
+        return pol
+
+    def acl_policy_delete(args):
+        require(authz(args).acl_write(), "acl write")
+        srv.forward_or_apply(MessageType.ACL_POLICY, {
+            "Op": "delete", "Policy": {"ID": args.get("PolicyID", "")}})
+        return True
+
+    def acl_policy_read(args):
+        require(authz(args).acl_read(), "acl read")
+        pol = state.raw_get("acl_policies", args.get("PolicyID", ""))
+        if pol is None:
+            for p in state.raw_list("acl_policies"):
+                if p.get("Name") == args.get("PolicyID"):
+                    pol = p
+                    break
+        return {"Policy": pol}
+
+    def acl_policy_list(args):
+        require(authz(args).acl_read(), "acl read")
+        return {"Policies": state.raw_list("acl_policies")}
+
+    e["ACL.Bootstrap"] = acl_bootstrap
+    e["ACL.TokenSet"] = acl_token_set
+    e["ACL.TokenDelete"] = acl_token_delete
+    read("ACL.TokenRead", acl_token_read)
+    read("ACL.TokenList", acl_token_list)
+    e["ACL.PolicySet"] = acl_policy_set
+    e["ACL.PolicyDelete"] = acl_policy_delete
+    read("ACL.PolicyRead", acl_policy_read)
+    read("ACL.PolicyList", acl_policy_list)
 
     # ----------------------------------------------------- PreparedQuery
     def pq_apply(args):
@@ -238,6 +447,8 @@ def register_endpoints(srv) -> None:
         if op in ("create", "update") and not (
                 query.get("Service") or {}).get("Service"):
             raise RPCError("prepared query must specify a service")
+        require(authz(args).query_write(query.get("Name", "")),
+                "query write")
         srv.forward_or_apply(MessageType.PREPARED_QUERY,
                              {"Op": op, "Query": query})
         return {"ID": query.get("ID")}
@@ -286,7 +497,8 @@ def register_endpoints(srv) -> None:
 
     # ------------------------------------------------------- ConfigEntry
     def config_apply(args):
-        return srv.forward_or_apply(MessageType.CONFIG_ENTRY, args)
+        require(authz(args).operator_write(), "operator write")
+        return srv.forward_or_apply(MessageType.CONFIG_ENTRY, clean(args))
 
     def config_get(args):
         key = f"{args.get('Kind', '')}/{args.get('Name', '')}"

@@ -97,6 +97,17 @@ class Server:
             event_handler=self._serf_event,
             keyring=self._keyring())
 
+        # ACL resolver over the replicated token/policy tables
+        # (reference: ACLResolver embedded in Server, server.go:180)
+        from consul_tpu.acl import ACLResolver
+
+        self.acl = ACLResolver(self.state, enabled=config.acl_enabled,
+                               default_policy=config.acl_default_policy,
+                               token_ttl=config.acl_token_ttl)
+        self.state.add_change_hook(
+            lambda tables, idx: self.acl.invalidate()
+            if "acl" in tables else None)
+
         # endpoint registry: "Service.Method" -> handler(args, ctx)
         self.endpoints: dict[str, Any] = {}
         register_endpoints(self)
@@ -304,9 +315,11 @@ class Server:
         if not self._was_leader:
             # establishLeadership (leader.go:281): reconcile the full
             # membership immediately — including ourselves, for whom serf
-            # emits no join event
+            # emits no join event — and seed the configured initial
+            # management token (leader_acl.go initializeACLs)
             self._was_leader = True
             self._full_reconcile()
+            self._ensure_initial_management_token()
         # raft membership follows serf server membership (autopilot-lite)
         servers = {s["rpc_addr"] for s in self._servers() if s["rpc_addr"]}
         for addr in servers - self.raft.peers:
@@ -409,6 +422,17 @@ class Server:
                 self.raft.apply(encode_command(MessageType.SESSION, {
                     "Op": "destroy", "Session": sess.id}))
                 self._session_expiry.pop(sess.id, None)
+
+    def _ensure_initial_management_token(self) -> None:
+        tok = self.config.acl_initial_management_token
+        if not self.config.acl_enabled or not tok:
+            return
+        if self.state.raw_get("acl_tokens", tok) is None:
+            self.raft.apply(encode_command(MessageType.ACL_TOKEN, {
+                "Op": "set", "Token": {
+                    "SecretID": tok, "AccessorID": str(uuid.uuid4()),
+                    "Description": "Initial Management Token",
+                    "Management": True}}))
 
     def renew_session(self, sid: str) -> bool:
         sess = self.state.session_get(sid)

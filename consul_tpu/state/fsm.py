@@ -35,6 +35,7 @@ class MessageType(enum.IntEnum):
     INTENTION = 10
     AUTOPILOT = 11
     SYSTEM_METADATA = 12
+    SNAPSHOT_RESTORE = 13  # operator restore, replicated to all FSMs
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -58,6 +59,7 @@ class FSM:
             MessageType.ACL_POLICY: self._apply_acl_policy,
             MessageType.CONFIG_ENTRY: self._apply_config_entry,
             MessageType.INTENTION: self._apply_intention,
+            MessageType.SNAPSHOT_RESTORE: self._apply_snapshot_restore,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -217,6 +219,13 @@ class FSM:
                     out.append({"KV": cur.to_dict() if cur else None})
             return {"Results": out, "Errors": None}
 
+    def _apply_snapshot_restore(self, b: dict[str, Any], idx: int) -> Any:
+        """Operator restore: replace the whole store (snapshot_endpoint.go
+        → raft.Restore, here carried through the log so every replica
+        resets identically)."""
+        self.store.restore(b["Data"])
+        return True
+
     def _raw_op(self, table: str, write_ops: tuple[str, ...], op: str,
                 key: Any, value: Any) -> Any:
         if op in write_ops:
@@ -232,7 +241,16 @@ class FSM:
 
     def _apply_acl_token(self, b: dict[str, Any], idx: int) -> Any:
         t = b.get("Token") or {}
-        return self._raw_op("acl_tokens", ("set",), b.get("Op", "set"),
+        op = b.get("Op", "set")
+        if op == "bootstrap":
+            # atomic one-shot: the check and the write are one command
+            with self.store._lock:
+                for tok in self.store.tables["acl_tokens"].values():
+                    if tok.get("Management"):
+                        return "bootstrap no longer allowed"
+            self.store.raw_upsert("acl_tokens", t.get("SecretID"), t)
+            return True
+        return self._raw_op("acl_tokens", ("set",), op,
                             t.get("SecretID"), t)
 
     def _apply_acl_policy(self, b: dict[str, Any], idx: int) -> Any:

@@ -471,8 +471,12 @@ class StateStore:
     def restore(self, data: bytes) -> None:
         blob = msgpack.unpackb(data, raw=False)
         with self._lock:
-            self._index = blob["index"]
-            self._table_index.update(blob.get("table_index", {}))
+            # never rewind the index: parked blocking queries must wake
+            # and observe the restored data, and X-Consul-Index stays
+            # monotonic for watchers
+            self._index = max(self._index, blob["index"]) + 1
+            for t in self._table_index:
+                self._table_index[t] = self._index
             self.tables["nodes"] = {
                 k: Node(**v) for k, v in blob["nodes"].items()}
             self.tables["services"] = {
@@ -490,6 +494,11 @@ class StateStore:
                       "intentions", "prepared_queries"):
                 self.tables[t] = blob.get(t, {})
             self._cv.notify_all()
+            for fn in self._change_hooks:
+                try:
+                    fn(",".join(TABLES), self._index)
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 def _service_from_dict(d: dict[str, Any]) -> NodeService:

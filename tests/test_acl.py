@@ -1,0 +1,158 @@
+"""ACL engine + enforcement tests.
+
+Reference behaviors: acl/policy_test.go semantics (longest-prefix,
+exact-beats-prefix, permissive merge), acl_endpoint.go bootstrap
+one-shot, enforcement on KV/catalog endpoints, default-policy modes.
+"""
+
+import time
+
+import pytest
+
+from consul_tpu.acl import Authorizer, parse_policy
+from consul_tpu.acl.policy import DENY, READ, WRITE
+from consul_tpu.agent import Agent
+from consul_tpu.api import APIError, ConsulClient
+from consul_tpu.config import load
+
+
+def test_policy_parse_and_levels():
+    p = parse_policy({
+        "key_prefix": {"app/": {"policy": "write"},
+                       "": {"policy": "read"}},
+        "key": {"app/secret": {"policy": "deny"}},
+        "service_prefix": {"": {"policy": "read"}},
+        "operator": "read"})
+    az = Authorizer([p], default_level=DENY)
+    assert az.key_write("app/x")          # app/ prefix write
+    assert not az.key_write("other")      # "" prefix read only
+    assert az.key_read("other")
+    assert not az.key_read("app/secret")  # exact deny beats prefix write
+    assert az.service_read("anything")
+    assert not az.service_write("anything")
+    assert az.operator_read() and not az.operator_write()
+
+
+def test_longest_prefix_wins():
+    p = parse_policy({
+        "key_prefix": {"a/": {"policy": "deny"},
+                       "a/b/": {"policy": "write"}}})
+    az = Authorizer([p], default_level=DENY)
+    assert not az.key_read("a/x")
+    assert az.key_write("a/b/c")
+
+
+def test_multiple_policies_merge_permissively():
+    p1 = parse_policy({"key_prefix": {"shared/": {"policy": "read"}}})
+    p2 = parse_policy({"key_prefix": {"shared/": {"policy": "write"}}})
+    az = Authorizer([p1, p2], default_level=DENY)
+    assert az.key_write("shared/x")
+
+
+def test_management_token_grants_all():
+    az = Authorizer([], default_level=DENY, is_management=True)
+    assert az.key_write("anything") and az.acl_write() \
+        and az.operator_write()
+
+
+def test_bad_policy_rejected():
+    with pytest.raises(ValueError):
+        parse_policy({"key": {"x": {"policy": "sudo"}}})
+    with pytest.raises(ValueError):
+        parse_policy({"starship": "write"})
+
+
+@pytest.fixture(scope="module")
+def acl_agent():
+    cfg = load(dev=True, overrides={
+        "node_name": "acl-agent",
+        "acl": {"enabled": True, "default_policy": "deny",
+                "tokens": {"initial_management": "root-secret"}}})
+    a = Agent(cfg)
+    a.start(serve_dns=False)
+
+    def up():
+        return a.server.is_leader() and a.server.state.raw_get(
+            "acl_tokens", "root-secret") is not None
+
+    t0 = time.time()
+    while time.time() - t0 < 15 and not up():
+        time.sleep(0.1)
+    assert up(), "management token never seeded"
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def root(acl_agent):
+    return ConsulClient(acl_agent.http.addr, token="root-secret")
+
+
+def test_anonymous_denied_under_deny_policy(acl_agent, root):
+    anon = ConsulClient(acl_agent.http.addr)
+    with pytest.raises(APIError, match="Permission denied"):
+        anon.kv_put("x", b"1")
+    with pytest.raises(APIError, match="Permission denied"):
+        anon.kv_get("x")
+    # management token works
+    assert root.kv_put("x", b"1") is True
+    assert root.kv_get("x") == b"1"
+
+
+def test_scoped_token_enforcement(acl_agent, root):
+    pol = root.put("/v1/acl/policy", body={
+        "Name": "app-rw",
+        "Rules": '{"key_prefix": {"app/": {"policy": "write"}},'
+                 ' "service_prefix": {"web": {"policy": "read"}}}'})
+    tok = root.put("/v1/acl/token", body={
+        "Description": "app token",
+        "Policies": [{"ID": pol["ID"]}]})
+    c = ConsulClient(acl_agent.http.addr, token=tok["SecretID"])
+    # within scope
+    assert c.kv_put("app/cfg", b"ok") is True
+    assert c.kv_get("app/cfg") == b"ok"
+    # outside scope
+    with pytest.raises(APIError, match="Permission denied"):
+        c.kv_put("secret/x", b"no")
+    with pytest.raises(APIError, match="Permission denied"):
+        c.kv_get("secret/x")
+    # service read allowed, catalog write denied
+    c.health_service("web")
+    with pytest.raises(APIError, match="Permission denied"):
+        c.put("/v1/catalog/register",
+              body={"Node": "rogue", "Address": "1.2.3.4"})
+    # acl endpoints denied for non-management token
+    with pytest.raises(APIError, match="Permission denied"):
+        c.get("/v1/acl/tokens")
+
+
+def test_kv_list_filtered_by_acl(acl_agent, root):
+    root.kv_put("app/visible", b"1")
+    root.kv_put("private/hidden", b"2")
+    pol = root.put("/v1/acl/policy", body={
+        "Name": "app-ro",
+        "Rules": '{"key_prefix": {"app/": {"policy": "read"}}}'})
+    tok = root.put("/v1/acl/token", body={"Policies": [{"ID": pol["ID"]}]})
+    c = ConsulClient(acl_agent.http.addr, token=tok["SecretID"])
+    keys = {e["Key"] for e in c.kv_list("")}
+    assert "app/visible" in keys
+    assert "private/hidden" not in keys
+
+
+def test_bootstrap_one_shot(acl_agent, root):
+    # management token already exists (seeded) → bootstrap refused
+    with pytest.raises(APIError, match="no longer allowed"):
+        root.put("/v1/acl/bootstrap")
+
+
+def test_token_lifecycle(acl_agent, root):
+    tok = root.put("/v1/acl/token", body={"Description": "temp"})
+    acc = tok["AccessorID"]
+    got = root.get(f"/v1/acl/token/{acc}")
+    assert got["Description"] == "temp"
+    # token list redacts secrets
+    listed = root.get("/v1/acl/tokens")
+    assert all("SecretID" not in t for t in listed)
+    assert root.delete(f"/v1/acl/token/{acc}") is True
+    with pytest.raises(APIError):
+        root.get(f"/v1/acl/token/{acc}")

@@ -309,3 +309,27 @@ def test_mutating_endpoints_reject_get(agent, client):
     assert ei.value.code == 404
     assert client.session_info(sid), "session must survive a GET"
     client.session_destroy(sid)
+
+
+def test_snapshot_save_restore_roundtrip(agent, client):
+    client.kv_put("snap/keep", b"precious")
+    archive = client.get("/v1/snapshot")
+    assert isinstance(archive, bytes) and len(archive) > 100
+    # inspect the archive structure
+    from consul_tpu.server.snapshot import read_archive
+
+    meta, blob = read_archive(archive)
+    assert meta["Index"] > 0 and len(blob) > 0
+    # mutate, then restore: the mutation must be rolled back
+    client.kv_put("snap/keep", b"overwritten")
+    client.kv_put("snap/junk", b"post-snapshot")
+    meta2 = client.put("/v1/snapshot", raw=archive)
+    assert meta2["Index"] == meta["Index"]
+    wait_for(lambda: client.kv_get("snap/keep") == b"precious",
+             what="restored value")
+    assert client.kv_get("snap/junk") is None
+
+
+def test_snapshot_corrupt_archive_rejected(agent, client):
+    with pytest.raises(APIError):
+        client.put("/v1/snapshot", raw=b"not a snapshot archive")
