@@ -59,6 +59,9 @@ class Agent:
 
         self.http = None
         self.dns = None
+        # recent user events ring buffer (/v1/event/list,
+        # agent/user_event.go UserEvents)
+        self._recent_events: list[dict] = []
 
     # ------------------------------------------------------------- lifecycle
 
@@ -158,11 +161,15 @@ class Agent:
             "Version": __version__,
         }
         member = self.serf.local_member()
-        return {"Config": cfg,
-                "Member": member.snapshot(),
-                "Stats": self.server.raft.stats()
-                if self.server else {},
-                "Coord": self.serf.coord_client.get().to_dict()}
+        out = {"Config": cfg,
+               "Member": member.snapshot(),
+               "Stats": self.server.raft.stats()
+               if self.server else {},
+               "Coord": self.serf.coord_client.get().to_dict()}
+        if self.server is not None and self.server.serf_wan is not None:
+            out["WanAddr"] = \
+                self.server.serf_wan.memberlist.transport.addr
+        return out
 
     # -------------------------------------------------- service/check mgmt
 
@@ -245,8 +252,21 @@ class Agent:
     def _internal_event(self, ev) -> None:
         from consul_tpu.gossip.serf import EventType
 
-        if ev.type != EventType.USER \
-                or not ev.name.startswith("consul:keyring:"):
+        if ev.type != EventType.USER:
+            return
+        if ev.name.startswith("consul:event:"):
+            import base64 as b64
+            import uuid as uuid_mod
+
+            self._recent_events.append({
+                "ID": str(uuid_mod.uuid4()),
+                "Name": ev.name.removeprefix("consul:event:"),
+                "Payload": b64.b64encode(ev.payload).decode()
+                if ev.payload else None,
+                "LTime": ev.ltime})
+            del self._recent_events[:-256]
+            return
+        if not ev.name.startswith("consul:keyring:"):
             return
         op = ev.name.rsplit(":", 1)[1]
         kr = self.serf.memberlist.keyring

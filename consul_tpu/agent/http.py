@@ -126,7 +126,10 @@ class HTTPApi:
         a = self.agent
 
         def rpc(name: str, args: dict[str, Any]) -> Any:
-            return a.rpc(name, {**args, "AuthToken": token})
+            args = {**args, "AuthToken": token}
+            if "dc" in q:
+                args.setdefault("Datacenter", q["dc"])
+            return a.rpc(name, args)
 
         def blocking_args(extra: Optional[dict] = None) -> dict[str, Any]:
             args = dict(extra or {})
@@ -157,6 +160,8 @@ class HTTPApi:
         if path == "/v1/agent/self":
             return a.self_info(), None
         if path == "/v1/agent/members":
+            if "wan" in q:
+                return rpc("Internal.Members", {"WAN": True}), None
             return a.members(), None
         if path == "/v1/agent/metrics":
             return telemetry.default.snapshot(), None
@@ -203,6 +208,10 @@ class HTTPApi:
         if (m := re.match(r"^/v1/agent/join/(.+)$", path)) \
                 and method in ("PUT", "POST"):
             addr = urllib.parse.unquote(m.group(1))
+            if "wan" in q:
+                if rpc("Internal.JoinWAN", {"Addrs": [addr]}) == 0:
+                    raise HTTPError(500, f"failed to join -wan {addr}")
+                return None, None
             if a.join([addr]) == 0:
                 raise HTTPError(500, f"failed to join {addr}")
             return None, None
@@ -219,7 +228,7 @@ class HTTPApi:
 
         # --------------------------------------------------------- catalog
         if path == "/v1/catalog/datacenters":
-            return [a.config.datacenter], None
+            return rpc("Catalog.ListDatacenters", {}), None
         if path == "/v1/catalog/nodes":
             res = rpc("Catalog.ListNodes", blocking_args())
             return res["Nodes"], res["Index"]
@@ -328,6 +337,48 @@ class HTTPApi:
             return {"Name": name, "Payload":
                     base64.b64encode(body).decode() if body else None}, None
 
+        # --------------------------------------------------------- connect
+        if path == "/v1/connect/ca/roots" or \
+                path == "/v1/agent/connect/ca/roots":
+            res = rpc("ConnectCA.Roots", blocking_args())
+            return res, res.get("Index")
+        if (m := re.match(r"^/v1/agent/connect/ca/leaf/(.+)$", path)):
+            svc = urllib.parse.unquote(m.group(1))
+            return rpc("ConnectCA.Sign", {"Service": svc}), None
+        if path == "/v1/connect/ca/rotate" and method in ("PUT", "POST"):
+            return rpc("ConnectCA.Rotate", {}), None
+        if path == "/v1/connect/intentions":
+            if method in ("POST", "PUT"):
+                return rpc("Intention.Apply",
+                           {"Op": "upsert", "Intention": jbody()}), None
+            res = rpc("Intention.List", blocking_args())
+            return res["Intentions"], res["Index"]
+        if path == "/v1/connect/intentions/match":
+            res = rpc("Intention.Match", blocking_args(
+                {"DestinationName": q.get("by-name", q.get("name", ""))}))
+            return res["Matches"], res["Index"]
+        if path == "/v1/connect/intentions/check":
+            return rpc("Intention.Check", {
+                "SourceName": q.get("source", ""),
+                "DestinationName": q.get("destination", "")}), None
+        if path == "/v1/connect/intentions/exact" and method == "DELETE":
+            rpc("Intention.Apply", {"Op": "delete", "Intention": {
+                "SourceName": q.get("source", "*"),
+                "DestinationName": q.get("destination", "*")}})
+            return None, None
+        if path == "/v1/agent/connect/authorize" \
+                and method in ("PUT", "POST"):
+            b = jbody()
+            # ClientCertURI carries the SPIFFE source identity
+            src = b.get("ClientCertURI", "")
+            src_svc = src.rsplit("/svc/", 1)[-1] if "/svc/" in src \
+                else b.get("Source", "")
+            res = rpc("Intention.Check", {
+                "SourceName": src_svc,
+                "DestinationName": b.get("Target", "")})
+            return {"Authorized": res["Allowed"],
+                    "Reason": res["Reason"]}, None
+
         # ------------------------------------------------------------- acl
         if path == "/v1/acl/bootstrap" and method in ("PUT", "POST"):
             return rpc("ACL.Bootstrap", {}), None
@@ -395,6 +446,12 @@ class HTTPApi:
             if not res["Queries"]:
                 raise HTTPError(404, "query not found")
             return res["Queries"], res["Index"]
+
+        if path == "/v1/event/list":
+            name = q.get("name")
+            evs = [e for e in a._recent_events
+                   if not name or e["Name"] == name]
+            return evs, len(evs)
 
         # -------------------------------------------------------- snapshot
         if path == "/v1/snapshot":

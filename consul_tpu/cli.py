@@ -54,8 +54,9 @@ def cmd_agent(args) -> int:
         overrides["gossip_sim"] = args.gossip_sim
     if args.gossip_sim_nodes:
         overrides["gossip_sim_nodes"] = args.gossip_sim_nodes
-    if args.http_port is not None or args.dns_port is not None \
-            or args.serf_port is not None or args.server_port is not None:
+    if any(x is not None for x in (args.http_port, args.dns_port,
+                                   args.serf_port, args.server_port,
+                                   args.serf_wan_port)):
         ports = {}
         if args.http_port is not None:
             ports["http"] = args.http_port
@@ -65,6 +66,8 @@ def cmd_agent(args) -> int:
             ports["serf_lan"] = args.serf_port
         if args.server_port is not None:
             ports["server"] = args.server_port
+        if args.serf_wan_port is not None:
+            ports["serf_wan"] = args.serf_wan_port
         overrides["ports"] = ports
 
     if args.dev:
@@ -135,7 +138,9 @@ def cmd_members(args) -> int:
     status_names = {0: "none", 1: "alive", 2: "suspect", 3: "dead",
                     4: "leaving", 5: "left", 6: "reap"}
     rows = [("Node", "Address", "Status", "Type", "DC")]
-    for m in sorted(c.agent_members(), key=lambda m: m["name"]):
+    members = c.get("/v1/agent/members", wan="") \
+        if getattr(args, "wan", False) else c.agent_members()
+    for m in sorted(members, key=lambda m: m["name"]):
         tags = m.get("tags") or {}
         rows.append((m["name"], m["addr"],
                      status_names.get(m["status"], "?"),
@@ -148,7 +153,10 @@ def cmd_members(args) -> int:
 def cmd_join(args) -> int:
     c = _client(args)
     for addr in args.addr:
-        c.join(addr)
+        if getattr(args, "wan", False):
+            c.put(f"/v1/agent/join/{addr}", wan="")
+        else:
+            c.join(addr)
         print(f"Successfully joined cluster by contacting {addr}")
     return 0
 
@@ -494,14 +502,19 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-serf-port", type=int, default=None, dest="serf_port")
     ag.add_argument("-server-port", type=int, default=None,
                     dest="server_port")
+    ag.add_argument("-serf-wan-port", type=int, default=None,
+                    dest="serf_wan_port")
     ag.add_argument("-gossip-sim", default=None, dest="gossip_sim")
     ag.add_argument("-gossip-sim-nodes", type=int, default=None,
                     dest="gossip_sim_nodes")
     ag.set_defaults(fn=cmd_agent)
 
-    sub.add_parser("members").set_defaults(fn=cmd_members)
+    mem = sub.add_parser("members")
+    mem.add_argument("-wan", action="store_true")
+    mem.set_defaults(fn=cmd_members)
     jn = sub.add_parser("join")
     jn.add_argument("addr", nargs="+")
+    jn.add_argument("-wan", action="store_true")
     jn.set_defaults(fn=cmd_join)
     sub.add_parser("leave").set_defaults(fn=cmd_leave)
     sub.add_parser("info").set_defaults(fn=cmd_info)

@@ -1,0 +1,16 @@
+"""Connect service mesh plane (subset): CA + intentions + authorize.
+
+Reference: agent/connect/ca (built-in CA provider), CAManager
+(agent/consul/leader_connect_ca.go), intentions (intention_endpoint.go)
+and the authorize hot path Envoy hits (/v1/agent/connect/authorize).
+
+Round-1 scope: built-in CA with an EC root + SPIFFE-URI leaf signing,
+replicated through raft; intention allow/deny graph with exact-beats-
+wildcard matching; authorize() combining intentions with the ACL
+default policy. xDS/proxycfg/gateways are round-2 targets (SURVEY.md
+§2.5 lists the full surface).
+"""
+
+from consul_tpu.connect.ca import CAManager, spiffe_id
+
+__all__ = ["CAManager", "spiffe_id"]

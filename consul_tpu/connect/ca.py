@@ -1,0 +1,182 @@
+"""Built-in Connect CA: EC root certificate + SPIFFE leaf signing.
+
+Reference: agent/connect/ca/provider_consul.go (the built-in provider),
+agent/connect/uri*.go (SPIFFE identities), csr.go. The root key/cert
+are replicated through raft (a CONFIG_ENTRY of kind "connect-ca") so
+any leader can sign; leaves are short-lived EC certs with the service's
+SPIFFE URI SAN.
+"""
+
+from __future__ import annotations
+
+import datetime
+import uuid
+from typing import Any, Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+
+def spiffe_id(trust_domain: str, dc: str, service: str) -> str:
+    return f"spiffe://{trust_domain}/ns/default/dc/{dc}/svc/{service}"
+
+
+def generate_root(trust_domain: str, dc: str,
+                  ttl_days: int = 3650) -> dict[str, str]:
+    """Create a self-signed EC root; returns PEM cert+key + metadata."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME,
+                           f"Consul CA {uuid.uuid4().hex[:8]}")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=ttl_days))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                           critical=True)
+            .add_extension(x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True,
+                crl_sign=True, content_commitment=False,
+                key_encipherment=False, data_encipherment=False,
+                key_agreement=False, encipher_only=False,
+                decipher_only=False), critical=True)
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.UniformResourceIdentifier(
+                    f"spiffe://{trust_domain}")]), critical=False)
+            .sign(key, hashes.SHA256()))
+    return {
+        "ID": uuid.uuid4().hex,
+        "RootCert": cert.public_bytes(
+            serialization.Encoding.PEM).decode(),
+        "PrivateKey": key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()).decode(),
+        "TrustDomain": trust_domain,
+        "Datacenter": dc,
+        "Active": True,
+    }
+
+
+def sign_leaf(root: dict[str, str], service: str, dc: str,
+              ttl_hours: float = 72.0) -> dict[str, str]:
+    """Issue a leaf cert+key for a service (provider_consul.go Sign)."""
+    ca_key = serialization.load_pem_private_key(
+        root["PrivateKey"].encode(), password=None)
+    ca_cert = x509.load_pem_x509_certificate(root["RootCert"].encode())
+    key = ec.generate_private_key(ec.SECP256R1())
+    uri = spiffe_id(root["TrustDomain"], dc, service)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(x509.Name([
+                x509.NameAttribute(NameOID.COMMON_NAME, service)]))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(hours=ttl_hours))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.UniformResourceIdentifier(uri)]), critical=False)
+            .add_extension(x509.BasicConstraints(ca=False,
+                                                 path_length=None),
+                           critical=True)
+            .add_extension(x509.ExtendedKeyUsage([
+                x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH,
+                x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]),
+                critical=False)
+            .sign(ca_key, hashes.SHA256()))
+    return {
+        "SerialNumber": format(cert.serial_number, "x"),
+        "CertPEM": cert.public_bytes(
+            serialization.Encoding.PEM).decode(),
+        "PrivateKeyPEM": key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()).decode(),
+        "Service": service,
+        "ServiceURI": uri,
+        "ValidAfter": cert.not_valid_before_utc.isoformat(),
+        "ValidBefore": cert.not_valid_after_utc.isoformat(),
+    }
+
+
+def verify_leaf(root_pem: str, leaf_pem: str) -> Optional[str]:
+    """Verify chain + return the leaf's SPIFFE URI (or None)."""
+    root = x509.load_pem_x509_certificate(root_pem.encode())
+    leaf = x509.load_pem_x509_certificate(leaf_pem.encode())
+    try:
+        leaf.verify_directly_issued_by(root)
+    except Exception:  # noqa: BLE001 — invalid signature/issuer
+        return None
+    try:
+        san = leaf.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        uris = san.get_values_for_type(x509.UniformResourceIdentifier)
+        return uris[0] if uris else None
+    except x509.ExtensionNotFound:
+        return None
+
+
+class CAManager:
+    """Leader-side CA state access (leader_connect_ca.go CAManager).
+
+    The active root (cert+key) lives in the replicated config_entries
+    table under kind "connect-ca"; initialization happens once on the
+    leader.
+    """
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def active_root(self) -> Optional[dict[str, Any]]:
+        entry = self.server.state.raw_get("config_entries",
+                                          "connect-ca/root")
+        return entry.get("Root") if entry else None
+
+    def initialize(self) -> dict[str, Any]:
+        root = self.active_root()
+        if root is not None:
+            return root
+        trust_domain = f"{uuid.uuid4()}.consul"
+        root = generate_root(trust_domain, self.server.config.datacenter)
+        from consul_tpu.state import MessageType
+
+        self.server.forward_or_apply(MessageType.CONFIG_ENTRY, {
+            "Op": "upsert", "Entry": {"Kind": "connect-ca", "Name": "root",
+                                      "Root": root}})
+        return self.active_root() or root
+
+    def rotate(self) -> dict[str, Any]:
+        """Generate and activate a new root. ALL prior roots stay
+        verifiable until their leaves expire (a second rotation must not
+        orphan leaves signed by the first root)."""
+        entry = self.server.state.raw_get("config_entries",
+                                          "connect-ca/root") or {}
+        old = entry.get("Root")
+        previous = list(entry.get("PreviousRoots") or [])
+        if old is not None:
+            previous.insert(0, old)
+        trust_domain = old["TrustDomain"] if old \
+            else f"{uuid.uuid4()}.consul"
+        new = generate_root(trust_domain, self.server.config.datacenter)
+        from consul_tpu.state import MessageType
+
+        self.server.forward_or_apply(MessageType.CONFIG_ENTRY, {
+            "Op": "upsert", "Entry": {
+                "Kind": "connect-ca", "Name": "root", "Root": new,
+                "PreviousRoots": previous}})
+        return new
+
+    def roots(self) -> list[dict[str, Any]]:
+        entry = self.server.state.raw_get("config_entries",
+                                          "connect-ca/root")
+        if not entry:
+            return []
+        out = [entry["Root"]]
+        out.extend(entry.get("PreviousRoots") or [])
+        return out

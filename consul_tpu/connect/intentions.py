@@ -1,0 +1,43 @@
+"""Intentions: the service-to-service allow/deny graph.
+
+Reference: agent/consul/intention_endpoint.go + state/
+config_entry_intention.go. Match semantics: exact source/destination
+beats wildcard; among matches the most specific wins; absent any
+intention the ACL default policy decides (deny when ACLs are on in
+deny mode, allow otherwise).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def match_intention(intentions: list[dict[str, Any]], source: str,
+                    destination: str) -> Optional[dict[str, Any]]:
+    """Most-specific intention for (source, destination), or None."""
+    best = None
+    best_score = -1
+    for i in intentions:
+        src = i.get("SourceName", "*")
+        dst = i.get("DestinationName", "*")
+        if src not in ("*", source) or dst not in ("*", destination):
+            continue
+        score = (src != "*") * 2 + (dst != "*")
+        if score > best_score:
+            best, best_score = i, score
+    return best
+
+
+def authorize(intentions: list[dict[str, Any]], source: str,
+              destination: str, default_allow: bool) -> tuple[bool, str]:
+    """The agent/connect authorize decision (agent_endpoint.go
+    AgentConnectAuthorize)."""
+    m = match_intention(intentions, source, destination)
+    if m is None:
+        return (default_allow,
+                "Default behavior configured by ACLs"
+                if not default_allow else "Default allow")
+    allowed = m.get("Action", "allow") == "allow"
+    reason = (f"Matched intention: {m.get('SourceName')} => "
+              f"{m.get('DestinationName')} ({m.get('Action', 'allow')})")
+    return allowed, reason

@@ -495,13 +495,99 @@ def register_endpoints(srv) -> None:
     read("PreparedQuery.List", pq_list)
     read("PreparedQuery.Execute", pq_execute)
 
+    # ------------------------------------------------------------ Connect
+    def ca_roots(args):
+        return srv.blocking_query(args, ("config_entries",), lambda: {
+            "Roots": [{k: v for k, v in r.items() if k != "PrivateKey"}
+                      for r in srv.ca.roots()],
+            "TrustDomain": (srv.ca.active_root() or {}).get(
+                "TrustDomain", "")})
+
+    def ca_sign(args):
+        """Issue a leaf for a service (ConnectCA.Sign; leaf manager path
+        agent/leafcert in the reference)."""
+        service = args.get("Service", "")
+        require(authz(args).service_write(service),
+                f"service write on {service!r}")
+        if not srv.is_leader():
+            return srv._forward_to_leader("ConnectCA.Sign", args)
+        from consul_tpu.connect.ca import sign_leaf
+
+        root = srv.ca.initialize()
+        return sign_leaf(root, service, srv.config.datacenter)
+
+    def ca_rotate(args):
+        require(authz(args).operator_write(), "operator write")
+        if not srv.is_leader():
+            return srv._forward_to_leader("ConnectCA.Rotate", args)
+        new = srv.ca.rotate()
+        return {k: v for k, v in new.items() if k != "PrivateKey"}
+
+    read("ConnectCA.Roots", ca_roots)
+    e["ConnectCA.Sign"] = ca_sign
+    e["ConnectCA.Rotate"] = ca_rotate
+
+    def intention_apply(args):
+        i = args.get("Intention") or {}
+        require(authz(args).service_write(
+            i.get("DestinationName", "")), "intention write needs "
+            "service write on the destination")
+        if args.get("Op", "upsert") == "upsert":
+            i.setdefault("ID", str(uuid.uuid4()))
+            i.setdefault("Action", "allow")
+        return srv.forward_or_apply(MessageType.INTENTION, {
+            "Op": args.get("Op", "upsert"), "Intention": i})
+
+    def intention_list(args):
+        az = authz(args)
+        return srv.blocking_query(args, ("intentions",), lambda: {
+            "Intentions": [i for i in state.raw_list("intentions")
+                           if az.service_read(
+                               i.get("DestinationName", ""))]})
+
+    def intention_match(args):
+        from consul_tpu.connect.intentions import match_intention
+
+        dst = args.get("DestinationName", args.get("Name", ""))
+        require(authz(args).service_read(dst),
+                f"service read on {dst!r}")
+        return srv.blocking_query(args, ("intentions",), lambda: {
+            "Matches": [i for i in state.raw_list("intentions")
+                        if i.get("DestinationName") in ("*", dst)]})
+
+    def intention_check(args):
+        from consul_tpu.connect.intentions import authorize as _authz
+
+        require(authz(args).service_read(
+            args.get("DestinationName", "")), "service read")
+
+        default_allow = srv.config.acl_default_policy == "allow" \
+            or not srv.config.acl_enabled
+        allowed, reason = _authz(
+            state.raw_list("intentions"),
+            args.get("SourceName", ""), args.get("DestinationName", ""),
+            default_allow)
+        return {"Allowed": allowed, "Reason": reason}
+
+    e["Intention.Apply"] = intention_apply
+    read("Intention.List", intention_list)
+    read("Intention.Match", intention_match)
+    read("Intention.Check", intention_check)
+
     # ------------------------------------------------------- ConfigEntry
     def config_apply(args):
         require(authz(args).operator_write(), "operator write")
+        if (args.get("Entry") or {}).get("Kind") == "connect-ca":
+            raise RPCError("Permission denied: reserved config kind")
         return srv.forward_or_apply(MessageType.CONFIG_ENTRY, clean(args))
 
     def config_get(args):
-        key = f"{args.get('Kind', '')}/{args.get('Name', '')}"
+        kind = args.get("Kind", "")
+        if kind == "connect-ca":
+            # internal CA state (holds the signing key) is NOT part of
+            # the config API surface
+            raise RPCError("Permission denied: reserved config kind")
+        key = f"{kind}/{args.get('Name', '')}"
         return srv.blocking_query(args, ("config_entries",), lambda: {
             "Entry": state.raw_get("config_entries", key)})
 
@@ -509,7 +595,8 @@ def register_endpoints(srv) -> None:
         kind = args.get("Kind", "")
         return srv.blocking_query(args, ("config_entries",), lambda: {
             "Entries": [v for v in state.raw_list("config_entries")
-                        if not kind or v.get("Kind") == kind]})
+                        if v.get("Kind") != "connect-ca"
+                        and (not kind or v.get("Kind") == kind)]})
 
     e["ConfigEntry.Apply"] = config_apply
     read("ConfigEntry.Get", config_get)
@@ -517,6 +604,15 @@ def register_endpoints(srv) -> None:
 
     # ------------------------------------------------------------- Agent-ish
     def members(args):
+        if args.get("WAN"):
+            return [m.snapshot() for m in srv.wan_members()]
         return [m.snapshot() for m in srv.serf.members(include_left=True)]
 
     e["Internal.Members"] = members
+    e["Catalog.ListDatacenters"] = lambda args: srv.datacenters()
+
+    def join_wan(args):
+        require(authz(args).agent_write(), "agent write")
+        return srv.join_wan(list(args.get("Addrs") or []))
+
+    e["Internal.JoinWAN"] = join_wan

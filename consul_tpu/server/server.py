@@ -108,6 +108,32 @@ class Server:
             lambda tables, idx: self.acl.invalidate()
             if "acl" in tables else None)
 
+        # WAN gossip pool: servers across datacenters, name.dc identity
+        # (reference: setupSerf WAN, server.go:684; wanfed tunnels aside)
+        self.serf_wan: Optional[Serf] = None
+        if config.port("serf_wan") >= 0:  # -1 disables the WAN pool
+            wan_tags = {"role": "consul", "dc": config.datacenter,
+                        "id": self.node_id, "rpc_addr": self.rpc.addr}
+            self.serf_wan = Serf(
+                name=f"{self.name}.{config.datacenter}",
+                transport=UDPTransport(config.bind_addr,
+                                       config.port("serf_wan")),
+                config=config.gossip_wan,
+                tags=wan_tags,
+                keyring=self._keyring())
+
+        # Connect CA manager (leader_connect_ca.go CAManager)
+        from consul_tpu.connect import CAManager
+
+        self.ca = CAManager(self)
+
+        # event streaming fan-out fed by store commits
+        # (stream.EventPublisher, event_publisher.go:15)
+        from consul_tpu.server.stream import EventPublisher
+
+        self.publisher = EventPublisher()
+        self.publisher.attach_to_store(self.state)
+
         # endpoint registry: "Service.Method" -> handler(args, ctx)
         self.endpoints: dict[str, Any] = {}
         register_endpoints(self)
@@ -138,6 +164,10 @@ class Server:
             self.raft.start()
             self._maybe_bootstrapped = True
         self.serf.start()
+        if self.serf_wan is not None:
+            self.serf_wan.start()
+            if self.config.retry_join_wan:
+                self.serf_wan.join(list(self.config.retry_join_wan))
         self._every(1.0, self._leader_tick)
         self._every(self.config.reconcile_interval, self._full_reconcile)
         self._every(self.config.coordinate_update_period, self._flush_coords)
@@ -161,6 +191,8 @@ class Server:
             if t is not None:
                 t.cancel()
         self.serf.shutdown()
+        if self.serf_wan is not None:
+            self.serf_wan.shutdown()
         self.raft.shutdown()
         self.rpc.shutdown()
         self.pool.close()
@@ -195,10 +227,50 @@ class Server:
 
     def handle_rpc(self, method: str, args: dict[str, Any],
                    src: str) -> Any:
+        dc = args.get("Datacenter")
+        if dc and dc != self.config.datacenter:
+            return self._forward_dc(method, args, dc)
         handler = self.endpoints.get(method)
         if handler is None:
             raise RPCError(f"unknown RPC method {method!r}")
         return handler(args)
+
+    def wan_members(self):
+        return self.serf_wan.members() if self.serf_wan else []
+
+    def datacenters(self) -> list[str]:
+        dcs = {self.config.datacenter}
+        for m in self.wan_members():
+            if m.tags.get("dc"):
+                dcs.add(m.tags["dc"])
+        return sorted(dcs)
+
+    def join_wan(self, addrs: list[str]) -> int:
+        if self.serf_wan is None:
+            raise RPCError("WAN pool not enabled")
+        return self.serf_wan.join(addrs)
+
+    def _forward_dc(self, method: str, args: dict[str, Any],
+                    dc: str) -> Any:
+        """Route to any server in the target DC over the WAN pool
+        (rpc.go:849 forwardDC via the router)."""
+        from consul_tpu.types import MemberStatus
+
+        candidates = [m for m in self.wan_members()
+                      if m.tags.get("dc") == dc
+                      and m.status == MemberStatus.ALIVE
+                      and m.tags.get("rpc_addr")]
+        if not candidates:
+            raise RPCError(f"no path to datacenter {dc!r}")
+        import random as _random
+
+        last: Exception = RPCError(f"no servers in {dc}")
+        for m in _random.sample(candidates, len(candidates))[:3]:
+            try:
+                return self.pool.call(m.tags["rpc_addr"], method, args)
+            except OSError as e:  # incl. ConnectionError and timeouts
+                last = e
+        raise RPCError(f"failed to reach datacenter {dc!r}: {last}")
 
     def forward_or_apply(self, msg_type: MessageType,
                          body: dict[str, Any]) -> Any:

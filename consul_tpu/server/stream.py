@@ -1,0 +1,104 @@
+"""Event streaming: topic-keyed pub/sub fed by state-store commits.
+
+Reference: agent/consul/stream/event_publisher.go (topic fan-out with
+snapshot-then-follow subscriptions) feeding the subscribe gRPC service
+and agent-side materialized views (agent/submatview). Here: a compact
+EventPublisher with per-topic ring buffers and blocking subscriptions;
+topics are fed from the store's change hooks the way catalog_events.go
+translates commits into typed events.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+TOPIC_FOR_TABLE = {
+    "services": "ServiceList", "checks": "ServiceHealth",
+    "nodes": "ServiceHealth", "kv": "KV",
+    "acl_tokens": "ACLToken", "acl_policies": "ACLPolicy",
+    "config_entries": "ConfigEntry", "intentions": "ConfigEntry",
+    "sessions": "Session", "coordinates": "Coordinate",
+    "prepared_queries": "PreparedQuery",
+}
+
+
+@dataclass
+class Event:
+    topic: str
+    index: int
+    payload: dict[str, Any] = field(default_factory=dict)
+
+
+class Subscription:
+    def __init__(self, pub: "EventPublisher", topic: str,
+                 start_index: int) -> None:
+        self.pub = pub
+        self.topic = topic
+        self.next_index = start_index
+        self.closed = False
+
+    def next(self, timeout: float = 10.0) -> Optional[Event]:
+        """Block until an event newer than next_index arrives."""
+        import time as _time
+
+        end = _time.monotonic() + timeout
+        with self.pub._cv:
+            while not self.closed:
+                ev = self.pub._first_after(self.topic, self.next_index)
+                if ev is not None:
+                    self.next_index = ev.index
+                    return ev
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    return None
+                self.pub._cv.wait(remaining)
+        return None
+
+    def close(self) -> None:
+        with self.pub._cv:
+            self.closed = True
+            self.pub._cv.notify_all()
+
+
+class EventPublisher:
+    def __init__(self, buffer_size: int = 2048) -> None:
+        self._buffers: dict[str, deque[Event]] = {}
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self.buffer_size = buffer_size
+
+    def publish(self, ev: Event) -> None:
+        with self._cv:
+            buf = self._buffers.setdefault(
+                ev.topic, deque(maxlen=self.buffer_size))
+            buf.append(ev)
+            self._cv.notify_all()
+
+    def subscribe(self, topic: str, index: int = 0) -> Subscription:
+        return Subscription(self, topic, index)
+
+    def _first_after(self, topic: str, index: int) -> Optional[Event]:
+        buf = self._buffers.get(topic)
+        if not buf:
+            return None
+        for ev in buf:
+            if ev.index > index:
+                return ev
+        return None
+
+    def attach_to_store(self, store) -> None:
+        """Feed topics from table commits (catalog_events.go seam)."""
+
+        def hook(tables: str, index: int) -> None:
+            seen = set()
+            for t in tables.split(","):
+                topic = TOPIC_FOR_TABLE.get(t)
+                if topic and topic not in seen:
+                    seen.add(topic)
+                    self.publish(Event(topic=topic, index=index,
+                                       payload={"Tables": tables}))
+
+        store.add_change_hook(hook)

@@ -1,0 +1,14 @@
+"""Shared test helpers (importable because pytest puts the conftest
+directory on sys.path)."""
+
+import time
+
+
+def wait_for(cond, timeout=15.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")

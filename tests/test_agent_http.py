@@ -18,14 +18,7 @@ from consul_tpu.config import load
 from consul_tpu.types import CheckStatus
 
 
-def wait_for(cond, timeout=15.0, what="condition"):
-    t0 = time.time()
-    while time.time() - t0 < timeout:
-        v = cond()
-        if v:
-            return v
-        time.sleep(0.1)
-    raise AssertionError(f"timed out waiting for {what}")
+from helpers import wait_for  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -333,3 +326,33 @@ def test_snapshot_save_restore_roundtrip(agent, client):
 def test_snapshot_corrupt_archive_rejected(agent, client):
     with pytest.raises(APIError):
         client.put("/v1/snapshot", raw=b"not a snapshot archive")
+
+
+def test_event_list_buffer(agent, client):
+    client.event_fire("release", b"r1")
+    client.event_fire("release", b"r2")
+    wait_for(lambda: len(client.get("/v1/event/list", name="release")) >= 2,
+             what="event buffer")
+    evs = client.get("/v1/event/list", name="release")
+    assert [base64.b64decode(e["Payload"]) for e in evs[-2:]] == \
+        [b"r1", b"r2"]
+    assert evs[-1]["LTime"] > evs[-2]["LTime"]
+
+
+def test_event_publisher_stream(agent, client):
+    pub = agent.server.publisher
+    sub = pub.subscribe("KV", index=agent.server.state.index)
+    import threading as thr
+
+    got = {}
+
+    def consume():
+        got["ev"] = sub.next(timeout=5.0)
+
+    t = thr.Thread(target=consume)
+    t.start()
+    client.kv_put("stream/x", b"1")
+    t.join(timeout=6)
+    assert got["ev"] is not None
+    assert got["ev"].topic == "KV"
+    sub.close()

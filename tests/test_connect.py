@@ -1,0 +1,136 @@
+"""Connect plane tests: CA root/leaf lifecycle + intentions/authorize.
+
+Reference behaviors: built-in CA provider (provider_consul.go),
+SPIFFE URIs (connect/uri*.go), intention matching with exact-beats-
+wildcard (intention_endpoint.go), agent authorize.
+"""
+
+import time
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api import APIError, ConsulClient
+from consul_tpu.config import load
+from consul_tpu.connect.ca import generate_root, sign_leaf, verify_leaf
+from consul_tpu.connect.intentions import authorize, match_intention
+
+
+def test_root_and_leaf_crypto_roundtrip():
+    root = generate_root("test-domain.consul", "dc1")
+    leaf = sign_leaf(root, "web", "dc1")
+    uri = verify_leaf(root["RootCert"], leaf["CertPEM"])
+    assert uri == "spiffe://test-domain.consul/ns/default/dc/dc1/svc/web"
+    # a leaf signed by a DIFFERENT root must not verify
+    other = generate_root("evil.consul", "dc1")
+    forged = sign_leaf(other, "web", "dc1")
+    assert verify_leaf(root["RootCert"], forged["CertPEM"]) is None
+
+
+def test_intention_matching_specificity():
+    intentions = [
+        {"SourceName": "*", "DestinationName": "*", "Action": "deny"},
+        {"SourceName": "web", "DestinationName": "*", "Action": "allow"},
+        {"SourceName": "web", "DestinationName": "db", "Action": "deny"},
+    ]
+    assert match_intention(intentions, "web", "db")["Action"] == "deny"
+    assert match_intention(intentions, "web", "cache")["Action"] == "allow"
+    assert match_intention(intentions, "cron", "db")["Action"] == "deny"
+    assert match_intention([], "a", "b") is None
+    # authorize falls back to default when nothing matches
+    assert authorize([], "a", "b", default_allow=True)[0] is True
+    assert authorize([], "a", "b", default_allow=False)[0] is False
+
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(load(dev=True, overrides={"node_name": "mesh-agent"}))
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="leader")
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(agent):
+    return ConsulClient(agent.http.addr)
+
+
+def test_ca_leaf_over_http(agent, client):
+    leaf = client.get("/v1/agent/connect/ca/leaf/web")
+    assert "BEGIN CERTIFICATE" in leaf["CertPEM"]
+    assert "BEGIN PRIVATE KEY" in leaf["PrivateKeyPEM"]
+    assert leaf["ServiceURI"].endswith("/svc/web")
+    roots = client.get("/v1/connect/ca/roots")
+    assert len(roots["Roots"]) == 1
+    # private keys NEVER leave the servers via the roots endpoint
+    assert all("PrivateKey" not in r for r in roots["Roots"])
+    assert verify_leaf(roots["Roots"][0]["RootCert"],
+                       leaf["CertPEM"]) == leaf["ServiceURI"]
+
+
+def test_ca_rotation_keeps_old_root_verifiable(agent, client):
+    leaf_old = client.get("/v1/agent/connect/ca/leaf/api")
+    client.put("/v1/connect/ca/rotate")
+    roots = client.get("/v1/connect/ca/roots")
+    assert len(roots["Roots"]) == 2
+    leaf_new = client.get("/v1/agent/connect/ca/leaf/api")
+    # new leaf verifies against the new active root; old against old
+    pems = [r["RootCert"] for r in roots["Roots"]]
+    assert any(verify_leaf(p, leaf_new["CertPEM"]) for p in pems)
+    assert any(verify_leaf(p, leaf_old["CertPEM"]) for p in pems)
+
+
+def test_intentions_and_authorize_over_http(agent, client):
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "*", "DestinationName": "db", "Action": "deny"})
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "web", "DestinationName": "db", "Action": "allow"})
+    listed = client.get("/v1/connect/intentions")
+    assert len(listed) == 2
+    # check endpoint
+    res = client.get("/v1/connect/intentions/check", source="web",
+                     destination="db")
+    assert res["Allowed"] is True
+    res = client.get("/v1/connect/intentions/check", source="cron",
+                     destination="db")
+    assert res["Allowed"] is False
+    # the Envoy-facing authorize path with a SPIFFE client URI
+    res = client.put("/v1/agent/connect/authorize", body={
+        "Target": "db",
+        "ClientCertURI":
+            "spiffe://x.consul/ns/default/dc/dc1/svc/web"})
+    assert res["Authorized"] is True and "web => db" in res["Reason"]
+    res = client.put("/v1/agent/connect/authorize", body={
+        "Target": "db",
+        "ClientCertURI":
+            "spiffe://x.consul/ns/default/dc/dc1/svc/cron"})
+    assert res["Authorized"] is False
+    # match endpoint
+    matches = client.get("/v1/connect/intentions/match", **{"by-name": "db"})
+    assert len(matches) == 2
+
+
+def test_ca_private_key_not_leaked_via_config_api(agent, client):
+    # the reserved connect-ca kind is invisible to the config API
+    with pytest.raises(APIError, match="reserved|denied|not found"):
+        client.get("/v1/config/connect-ca/root")
+    entries = client.get("/v1/config/connect-ca")
+    assert entries == []
+    # and cannot be overwritten through it either
+    with pytest.raises(APIError, match="reserved|denied"):
+        client.put("/v1/config", body={"Kind": "connect-ca",
+                                       "Name": "root", "Root": {}})
+
+
+def test_double_rotation_keeps_all_roots(agent, client):
+    leaf_a = client.get("/v1/agent/connect/ca/leaf/svc-a")
+    client.put("/v1/connect/ca/rotate")
+    client.put("/v1/connect/ca/rotate")
+    roots = client.get("/v1/connect/ca/roots")["Roots"]
+    pems = [r["RootCert"] for r in roots]
+    # the oldest leaf still verifies against SOME retained root
+    assert any(verify_leaf(p, leaf_a["CertPEM"]) for p in pems)

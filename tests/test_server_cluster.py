@@ -16,14 +16,7 @@ from consul_tpu.server import Client, Server
 from consul_tpu.types import CheckStatus
 
 
-def wait_for(cond, timeout=15.0, what="condition"):
-    t0 = time.time()
-    while time.time() - t0 < timeout:
-        v = cond()
-        if v:
-            return v
-        time.sleep(0.1)
-    raise AssertionError(f"timed out waiting for {what}")
+from helpers import wait_for  # noqa: E402
 
 
 @pytest.fixture
