@@ -1,0 +1,123 @@
+"""BASELINE.json scenario runners.
+
+The five configs from BASELINE.md: 1k LAN (Lifeguard off), 100k LAN
+(Lifeguard + 1% loss), 1M WAN + churn, 1M LAN headline, and the
+multi-DC partition-heal federation scenario.
+
+Architecture note for the multi-DC scenario: in the reference, each DC
+is an INDEPENDENT LAN gossip pool; only servers join the cross-DC WAN
+pool (SURVEY.md §2.4). We model it the same way: the massive LAN pools
+run as per-DC simulations (the mesh's "dc" axis — independent mean-
+field pools), while the WAN server mesh is small (3-5 servers × DCs)
+and is itself simulated with partition injection expressed through the
+loss model: during the partition, a WAN member's probes toward the
+other side always fail.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import GossipConfig
+from consul_tpu.sim.metrics import fd_report
+from consul_tpu.sim.params import SimParams, baseline_configs
+from consul_tpu.sim.round import run_rounds
+from consul_tpu.sim.state import ALIVE, DEAD, INF, init_state
+
+
+@dataclass
+class PartitionHealReport:
+    n_dcs: int
+    servers_per_dc: int
+    lan_nodes_per_dc: int
+    partition_rounds: int
+    detected_cross_dc_failures: int   # WAN members declared dead
+    false_positives_during_partition: int
+    healed_recovery_rounds: float     # rounds until all WAN members alive
+    lan_false_positives: int          # LAN pools must be unaffected
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self.__dict__)
+
+
+def partition_heal(n_dcs: int = 3, servers_per_dc: int = 3,
+                   lan_nodes_per_dc: int = 10_000,
+                   partition_rounds: int = 120,
+                   seed: int = 0) -> PartitionHealReport:
+    """BASELINE config 5: WAN partition between DC 0 and the rest, then
+    heal; remote servers must be declared failed during the partition
+    (that IS correct FD behavior) and must recover after the heal, while
+    the per-DC LAN pools keep running undisturbed."""
+    wan_cfg = GossipConfig.wan()
+    n_wan = n_dcs * servers_per_dc
+    # WAN pool with the partition expressed as total loss toward/from the
+    # minority side: model by marking DC-0 servers down from the OTHERS'
+    # standpoint is wrong (they're up) — instead run two phases:
+    #   phase 1 (partition): DC0 servers probe-unreachable ⇒ up=False in
+    #     the majority's pool AND vice versa, tracked as two pools.
+    # Mean-field single-pool approximation: flip DC0's `up` to False for
+    # the partition phase (unreachable ≡ dead from the pool's view),
+    # then flip back and watch refutation/rejoin dynamics.
+    # the WAN pool is tiny; the mean-field model needs a handful of
+    # members to be meaningful — refuse degenerate pools rather than
+    # padding with phantoms the report would misdescribe
+    if n_wan < 6:
+        raise ValueError(
+            f"WAN pool too small for the mean-field model: {n_wan} < 6")
+    p_wan = SimParams.from_gossip_config(wan_cfg, n=n_wan)
+    state = init_state(p_wan.n)
+    key = jax.random.key(seed)
+
+    dc0 = jnp.arange(p_wan.n) < servers_per_dc
+    # partition: DC0 unreachable from the majority pool
+    state = state._replace(
+        up=jnp.where(dc0, False, state.up),
+        down_time=jnp.where(dc0, 0.0, state.down_time))
+    state, _ = run_rounds(state, key, p_wan, partition_rounds)
+    during = fd_report(state, p_wan)
+    detected = int(jnp.sum((state.status == DEAD) & dc0))
+
+    # heal: DC0 reachable again; members rejoin with bumped incarnations
+    state = state._replace(
+        up=jnp.where(dc0, True, state.up),
+        down_time=jnp.where(dc0, INF, state.down_time))
+    recovery = None
+    for chunk in range(40):
+        state, _ = run_rounds(state, jax.random.fold_in(key, chunk),
+                              p_wan, 10)
+        alive = bool(jnp.all((state.status == ALIVE) | ~dc0))
+        if alive:
+            recovery = (chunk + 1) * 10
+            break
+
+    # the per-DC LAN pools: independent, with mild loss — must stay clean
+    lan_fp = 0
+    p_lan = SimParams.from_gossip_config(GossipConfig.lan(),
+                                         n=lan_nodes_per_dc, loss=0.01)
+    for dc in range(n_dcs):
+        s = init_state(p_lan.n)
+        s, _ = run_rounds(s, jax.random.fold_in(key, 1000 + dc), p_lan,
+                          partition_rounds)
+        lan_fp += int(s.stats.false_positives)
+
+    return PartitionHealReport(
+        n_dcs=n_dcs, servers_per_dc=servers_per_dc,
+        lan_nodes_per_dc=lan_nodes_per_dc,
+        partition_rounds=partition_rounds,
+        detected_cross_dc_failures=detected,
+        false_positives_during_partition=during.false_positives,
+        healed_recovery_rounds=float(recovery or -1),
+        lan_false_positives=lan_fp)
+
+
+def run_baseline_config(name: str, rounds: int = 300,
+                        seed: int = 0) -> dict[str, Any]:
+    """Run one of the named BASELINE configs and report FD quality."""
+    p = baseline_configs()[name]
+    state, _ = run_rounds(init_state(p.n), jax.random.key(seed), p, rounds)
+    return {"config": name, "rounds": rounds,
+            **fd_report(state, p).to_dict()}

@@ -1,0 +1,150 @@
+"""Host-engine ↔ TPU-sim behavioral conformance.
+
+The same GossipConfig drives both backends (the seam SURVEY.md §7 hard
+part (f) calls for, mirroring internal/storage/conformance). These tests
+drive the event-driven host engine (deterministic clock, in-mem network)
+and the batched simulation with identical protocol parameters and assert
+the aggregate failure-detector statistics agree to within generous
+factors — the sim is mean-field, the host engine is exact, so the
+comparison is order-of-magnitude behavioral, not bit-exact.
+"""
+
+from dataclasses import replace
+
+import jax
+from consul_tpu.config import GossipConfig
+from consul_tpu.gossip import InMemNetwork, Serf
+from consul_tpu.sim import SimParams, init_state, run_rounds
+from consul_tpu.sim.metrics import fd_report, propagation_curve
+from consul_tpu.types import MemberStatus
+from consul_tpu.utils import telemetry
+
+# one protocol config for BOTH engines: LAN-ish timing scaled down,
+# stream/TCP fallback off so loss actually bites in both worlds
+CFG = replace(GossipConfig.local(), disable_tcp_pings=True,
+              suspicion_mult=4, gossip_nodes=3)
+
+
+def build_host_cluster(n, loss=0.0, seed=0):
+    net = InMemNetwork(seed=seed, loss=loss, latency=0.0005)
+    serfs = []
+    for i in range(n):
+        t = net.attach(f"127.0.0.1:{9000 + i}")
+        s = Serf(f"n{i}", t, config=CFG, clock=net.clock, seed=i)
+        s.start()
+        serfs.append(s)
+    for s in serfs[1:]:
+        s.join([serfs[0].memberlist.transport.addr])
+    net.clock.advance(3.0)
+    return net, serfs
+
+
+def host_detection_time(n=20, seed=0):
+    """Crash one node; virtual seconds until every peer declares DEAD."""
+    net, serfs = build_host_cluster(n, seed=seed)
+    victim = serfs[-1]
+    victim.memberlist.transport.closed = True
+    t0 = net.clock.now()
+    for _ in range(400):
+        net.clock.advance(0.1)
+        views = [{m.name: m.status
+                  for m in s.members(include_left=True)}
+                 for s in serfs[:-1]]
+        if all(v.get(victim.name) == MemberStatus.DEAD for v in views):
+            return net.clock.now() - t0
+    raise AssertionError("host engine never detected the crash")
+
+
+def sim_detection_time(n=20, seed=0):
+    p = SimParams.from_gossip_config(CFG, n=n)
+    state = init_state(n)
+    state = state._replace(up=state.up.at[n - 1].set(False),
+                           down_time=state.down_time.at[n - 1].set(0.0))
+    state, _ = run_rounds(state, jax.random.key(seed), p, 200)
+    rep = fd_report(state, p)
+    assert rep.true_deaths_declared == 1
+    return rep.mean_detect_latency_s
+
+
+def test_detection_latency_same_ballpark():
+    host = [host_detection_time(seed=s) for s in range(3)]
+    sim = [sim_detection_time(seed=s) for s in range(3)]
+    h, s = sum(host) / len(host), sum(sim) / len(sim)
+    # identical protocol constants → identical order of magnitude
+    assert 0.2 < s / h < 5.0, f"host={h:.2f}s sim={s:.2f}s"
+
+
+def test_suspicion_rate_under_loss_same_ballpark():
+    n, loss, sim_rounds = 24, 0.30, 600
+    # host: count suspicion starts over a fixed virtual-time window
+    telemetry.default.reset()
+    net, serfs = build_host_cluster(n, loss=loss, seed=3)
+    telemetry.default.reset()  # drop join-phase noise
+    window = 60.0  # virtual seconds == probe rounds per node
+    net.clock.advance(window)
+    snap = telemetry.default.snapshot()
+    host_susp = next((c["Count"] for c in snap["Counters"]
+                      if c["Name"].endswith("memberlist.suspect")), 0)
+    # unit alignment: the host counter fires once per MEMBER that marks a
+    # node suspect (≈ n echoes of one cluster-wide incident); the sim
+    # counts suspicion-rumor starts. Divide by n to compare incidents.
+    host_rate = host_susp / n / (n * window / CFG.probe_interval)
+
+    p = SimParams.from_gossip_config(CFG, n=n, loss=loss)
+    state, _ = run_rounds(init_state(n), jax.random.key(5), p, sim_rounds)
+    rep = fd_report(state, p)
+    sim_rate = rep.suspicions / (n * sim_rounds)
+    assert host_rate > 0 and sim_rate > 0, \
+        f"no suspicions at 30% loss (host={host_rate}, sim={sim_rate})"
+    ratio = sim_rate / host_rate
+    assert 0.1 < ratio < 10.0, \
+        f"suspicion rates diverge: host={host_rate:.4f}/node-round " \
+        f"sim={sim_rate:.4f}/node-round"
+
+
+def test_false_positive_agreement_no_loss():
+    """Clean network: NEITHER engine may produce false positives."""
+    telemetry.default.reset()
+    net, serfs = build_host_cluster(16, seed=7)
+    net.clock.advance(120.0)
+    for s in serfs:
+        dead = [m.name for m in s.members(include_left=True)
+                if m.status == MemberStatus.DEAD]
+        assert not dead, f"host engine wrongly declared {dead}"
+
+    p = SimParams.from_gossip_config(CFG, n=16)
+    state, _ = run_rounds(init_state(16), jax.random.key(9), p, 600)
+    assert int(state.stats.false_positives) == 0
+
+
+def test_leave_propagation_same_ballpark():
+    # host: graceful leave; time until every peer sees LEFT
+    net, serfs = build_host_cluster(20, seed=11)
+    victim = serfs[-1]
+    victim.leave()
+    t0 = net.clock.now()
+    host_t = None
+    for _ in range(200):
+        net.clock.advance(0.05)
+        views = [{m.name: m.status for m in s.members(include_left=True)}
+                 for s in serfs[:-1]]
+        if all(v.get(victim.name) == MemberStatus.LEFT for v in views):
+            host_t = net.clock.now() - t0
+            break
+    assert host_t is not None, "leave never fully propagated"
+
+    # sim: informed-fraction curve of a LEFT rumor crossing ~full coverage
+    from consul_tpu.sim.state import LEFT as SIM_LEFT
+
+    p = SimParams.from_gossip_config(CFG, n=20)
+    state = init_state(p.n)
+    state = state._replace(
+        up=state.up.at[3].set(False),
+        status=state.status.at[3].set(SIM_LEFT),
+        informed=state.informed.at[3].set(1.0 / p.n))
+    state, trace = run_rounds(state, jax.random.key(13), p, 50,
+                              trace_node=3)
+    _, sim_t = propagation_curve(trace, p.probe_interval, threshold=0.95)
+    assert sim_t != float("inf")
+    assert 0.05 < sim_t / host_t < 20.0, \
+        f"leave spread: host={host_t:.2f}s sim={sim_t:.2f}s"

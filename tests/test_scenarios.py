@@ -1,0 +1,28 @@
+"""BASELINE scenario runners (sim/scenarios.py)."""
+
+from consul_tpu.sim.scenarios import partition_heal, run_baseline_config
+
+
+def test_partition_heal_scenario():
+    rep = partition_heal(n_dcs=3, servers_per_dc=3,
+                         lan_nodes_per_dc=2000, partition_rounds=60)
+    # during the partition, the isolated DC's servers must be declared
+    # failed by the majority pool (that IS correct detection)
+    assert rep.detected_cross_dc_failures == rep.servers_per_dc
+    # detection of unreachable peers is not a false positive
+    assert rep.false_positives_during_partition == 0
+    # after the heal, every server recovers
+    assert rep.healed_recovery_rounds > 0
+    # the big per-DC LAN pools were never disturbed
+    assert rep.lan_false_positives == 0
+
+
+def test_baseline_config_1k_nolifeguard():
+    rep = run_baseline_config("1k-lan-nolifeguard", rounds=150)
+    assert rep["false_positives"] == 0
+    assert rep["live_fraction"] == 1.0
+
+
+def test_baseline_config_100k_lifeguard_loss():
+    rep = run_baseline_config("100k-lan-lifeguard-loss1", rounds=100)
+    assert rep["false_positives"] == 0  # TCP fallback + refutation hold
