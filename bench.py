@@ -23,6 +23,7 @@ def main() -> None:
 
     from consul_tpu.sim import (SimParams, init_state, make_run_rounds,
                                 make_mesh, make_sharded_run)
+    from consul_tpu.sim.round import make_run_rounds_fast
     from consul_tpu.sim.mesh import init_sharded_state
     from consul_tpu.config import GossipConfig
 
@@ -45,7 +46,9 @@ def main() -> None:
         diag = make_sharded_run(p_diag, 200, mesh)
         state = init_sharded_state(n, mesh)
     else:
-        run = make_run_rounds(p, chunk)
+        # stale-scalar fused hot path (statistical conformance with the
+        # live-scalar round asserted in tests/test_sim_round.py)
+        run = make_run_rounds_fast(p, chunk)
         diag = make_run_rounds(p_diag, 200)
         state = init_state(n)
 

@@ -150,6 +150,35 @@ class TCPCheck(CheckRunner):
                     f"TCP connect {self.host}:{self.port}: {e}")
 
 
+class UDPCheck(CheckRunner):
+    """Sends a datagram; passing unless the socket reports the port
+    closed (ICMP unreachable) — matching check.go CheckUDP semantics."""
+
+    def __init__(self, local, check_id, addr: str, interval: float,
+                 timeout: float = 10.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        host, port = addr.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(self.timeout)
+        try:
+            s.connect((self.host, self.port))
+            s.send(b"consul-tpu-udp-check")
+            try:
+                s.recv(1024)
+            except socket.timeout:
+                pass  # no reply is still success for UDP
+            return (CheckStatus.PASSING,
+                    f"UDP {self.host}:{self.port}: Success")
+        except OSError as e:
+            return (CheckStatus.CRITICAL,
+                    f"UDP {self.host}:{self.port}: {e}")
+        finally:
+            s.close()
+
+
 class ScriptCheck(CheckRunner):
     """Exit 0 passing, 1 warning, else critical (CheckMonitor)."""
 
@@ -206,6 +235,9 @@ def make_runner(local: LocalState, defn: dict[str, Any],
                          defn.get("Method", "GET"), scheduler)
     if defn.get("TCP"):
         return TCPCheck(local, cid, defn["TCP"], interval, timeout,
+                        scheduler)
+    if defn.get("UDP"):
+        return UDPCheck(local, cid, defn["UDP"], interval, timeout,
                         scheduler)
     if defn.get("Args") or defn.get("Script"):
         args = defn.get("Args") or ["/bin/sh", "-c", defn["Script"]]

@@ -206,6 +206,13 @@ class DNSServer:
                 ) -> tuple[Optional[list[bytes]], bool]:
         """Returns (answer RRs | None if not our domain, authoritative)."""
         name = qname.rstrip(".")
+        # reverse lookups: <d.c.b.a>.in-addr.arpa → node name PTR;
+        # unknown addresses fall through to the recursors (dns.go PTR)
+        if name.endswith(".in-addr.arpa"):
+            answers = self._ptr_answers(qname, name, qtype)
+            if not answers:
+                return None, False
+            return answers, True
         # label-boundary check: "foo.notconsul" must NOT match "consul"
         if name != self.domain and not name.endswith("." + self.domain):
             return None, False
@@ -235,6 +242,24 @@ class DNSServer:
             return self._query_answers(qname, ".".join(parts[:-1]),
                                        qtype, ttl), True
         return [], True
+
+    def _ptr_answers(self, qname: str, name: str,
+                     qtype: int) -> list[bytes]:
+        if qtype not in (QTYPE_PTR, QTYPE_ANY):
+            return []
+        octets = name[: -len(".in-addr.arpa")].split(".")
+        ip = ".".join(reversed(octets))
+        try:
+            res = self.agent.rpc("Catalog.ListNodes",
+                                 {"AllowStale": True})
+        except Exception:  # noqa: BLE001
+            return []
+        out = []
+        for n in res.get("Nodes") or []:
+            if n["Address"] == ip:
+                target = f"{n['Node']}.node.{self.domain}."
+                out.append(_rr(qname, QTYPE_PTR, 0, _encode_name(target)))
+        return out
 
     def _node_answers(self, qname: str, node: str, qtype: int,
                       ttl: int) -> list[bytes]:

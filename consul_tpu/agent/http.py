@@ -240,6 +240,8 @@ class HTTPApi:
                                   urllib.parse.unquote(m.group(1))})
             if "tag" in q:
                 args["ServiceTag"] = q["tag"]
+            if "near" in q:
+                args["Near"] = q["near"]
             res = rpc("Catalog.ServiceNodes", args)
             return res["ServiceNodes"], res["Index"]
         if (m := re.match(r"^/v1/catalog/node/(.+)$", path)):
@@ -259,6 +261,8 @@ class HTTPApi:
                 args["ServiceTag"] = q["tag"]
             if "passing" in q:
                 args["MustBePassing"] = True
+            if "near" in q:
+                args["Near"] = q["near"]
             res = rpc("Health.ServiceNodes", args)
             return res["Nodes"], res["Index"]
         if (m := re.match(r"^/v1/health/node/(.+)$", path)):
@@ -481,6 +485,22 @@ class HTTPApi:
                 return None, None
 
         # -------------------------------------------------------- operator
+        if path == "/v1/operator/autopilot/health":
+            return rpc("Operator.AutopilotHealth", {}), None
+        if path == "/v1/agent/monitor":
+            # bounded capture of live log output (the reference streams;
+            # we return a window — ?duration= seconds, default 2, cap 10)
+            rpc("Internal.AgentRead", {})  # ACL: agent read
+            from consul_tpu.utils import log as log_mod
+            import time as _t
+
+            lines: list[str] = []
+            detach = log_mod.add_sink(lines.append)
+            try:
+                _t.sleep(min(_dur(q.get("duration", "2s")), 10.0))
+            finally:
+                detach()
+            return "\n".join(lines).encode(), None
         if path == "/v1/operator/raft/configuration":
             stats = rpc("Status.RaftStats", {})
             return {"Servers": [

@@ -429,6 +429,42 @@ def cmd_acl(args) -> int:
     return 1
 
 
+def cmd_lock(args) -> int:
+    """`consul lock prefix child_cmd`: acquire a session-backed KV lock,
+    run the command, release (api/lock.go + command/lock)."""
+    import subprocess
+
+    from consul_tpu.api import Lock
+
+    import threading
+
+    client = _client(args)
+    lock = Lock(client, f"{args.prefix.rstrip('/')}/.lock")
+    if not lock.acquire(b"consul-tpu lock", wait=args.timeout):
+        print("Lock acquisition failed", file=sys.stderr)
+        return 1
+    print(f"Lock acquired on {args.prefix}")
+    # renew the session for the whole hold (api/lock.go renewSession) —
+    # without this the 15s TTL expires mid-command and the lock is lost
+    stop_renewal = threading.Event()
+
+    def renew_loop():
+        while not stop_renewal.wait(5.0):
+            try:
+                client.session_renew(lock.session)
+            except Exception:  # noqa: BLE001 — retried next tick
+                pass
+
+    renewer = threading.Thread(target=renew_loop, daemon=True)
+    renewer.start()
+    try:
+        return subprocess.run(args.child, shell=True).returncode
+    finally:
+        stop_renewal.set()
+        lock.release()
+        print("Lock released")
+
+
 def cmd_watch(args) -> int:
     """Long-poll a watched view and print (and optionally exec a handler
     on) each change (api/watch + command/watch)."""
@@ -603,6 +639,12 @@ def build_parser() -> argparse.ArgumentParser:
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
+
+    lk = sub.add_parser("lock")
+    lk.add_argument("prefix")
+    lk.add_argument("child")
+    lk.add_argument("-timeout", type=float, default=15.0)
+    lk.set_defaults(fn=cmd_lock)
 
     w = sub.add_parser("watch")
     w.add_argument("-type", required=True)

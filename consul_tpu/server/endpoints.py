@@ -102,13 +102,15 @@ def register_endpoints(srv) -> None:
         svc = args.get("ServiceName", "")
         require(authz(args).service_read(svc), f"service read on {svc!r}")
         tag = args.get("ServiceTag") or None
+        near = args.get("Near", "")
         return srv.blocking_query(args, ("services", "nodes"), lambda: {
-            "ServiceNodes": [
+            "ServiceNodes": _near_sort([
                 {**n.to_dict(), **{
                     "ServiceID": s.id, "ServiceName": s.service,
                     "ServiceTags": s.tags, "ServiceAddress": s.address,
                     "ServicePort": s.port, "ServiceMeta": s.meta}}
-                for n, s in state.service_nodes(svc, tag)]})
+                for n, s in state.service_nodes(svc, tag)],
+                near, lambda e: e["Node"])})
 
     def catalog_node_services(args):
         node = args.get("Node", "")
@@ -127,15 +129,39 @@ def register_endpoints(srv) -> None:
     read("Catalog.NodeServices", catalog_node_services)
 
     # ------------------------------------------------------------ Health
+    def _near_sort(entries, near, node_of):
+        """RTT-sort results relative to `near` using Vivaldi coordinates
+        (agent/consul/rtt.go nodeSorter / ?near=)."""
+        if not near:
+            return entries
+        from consul_tpu.gossip.coordinate import distance
+        from consul_tpu.types import Coordinate
+
+        ref = state.coordinate_get(near)
+        if ref is None:
+            return entries
+        ref_c = Coordinate.from_dict(ref["Coord"])
+
+        def key(e):
+            c = state.coordinate_get(node_of(e))
+            if c is None:
+                return float("inf")
+            return distance(ref_c, Coordinate.from_dict(c["Coord"]))
+
+        return sorted(entries, key=key)
+
     def health_service_nodes(args):
         svc = args.get("ServiceName", "")
         require(authz(args).service_read(svc), f"service read on {svc!r}")
         tag = args.get("ServiceTag") or None
         passing = bool(args.get("MustBePassing"))
+        near = args.get("Near", "")
         return srv.blocking_query(
             args, ("services", "nodes", "checks"), lambda: {
-                "Nodes": state.check_service_nodes(
-                    svc, tag, passing_only=passing)})
+                "Nodes": _near_sort(
+                    state.check_service_nodes(svc, tag,
+                                              passing_only=passing),
+                    near, lambda e: e["Node"]["Node"])})
 
     def health_node_checks(args):
         node = args.get("Node", "")
@@ -609,6 +635,39 @@ def register_endpoints(srv) -> None:
         return [m.snapshot() for m in srv.serf.members(include_left=True)]
 
     e["Internal.Members"] = members
+
+    def autopilot_health(args):
+        require(authz(args).operator_read(), "operator read")
+        stats = srv.raft.stats()
+        servers = []
+        healthy = True
+        from consul_tpu.types import MemberStatus as MS
+
+        for m in srv.serf.members(include_left=True):
+            if m.tags.get("role") != "consul":
+                continue
+            # a decommissioned (left/leaving) server is not a failure
+            if m.status in (MS.LEFT, MS.LEAVING, MS.REAP):
+                continue
+            alive = int(m.status) == 1
+            healthy = healthy and alive
+            servers.append({
+                "Name": m.name, "Address": m.tags.get("rpc_addr", ""),
+                "SerfStatus": "alive" if alive else "failed",
+                "Leader": m.tags.get("rpc_addr") == stats.get("leader"),
+                "Voter": m.tags.get("rpc_addr") in srv.raft.peers,
+                "Healthy": alive})
+        return {"Healthy": healthy,
+                "FailureTolerance": max(0, (len(srv.raft.peers) - 1) // 2),
+                "Servers": servers}
+
+    e["Operator.AutopilotHealth"] = autopilot_health
+
+    def agent_read_check(args):
+        require(authz(args).agent_read(), "agent read")
+        return True
+
+    e["Internal.AgentRead"] = agent_read_check
     e["Catalog.ListDatacenters"] = lambda args: srv.datacenters()
 
     def join_wan(args):

@@ -46,7 +46,7 @@ def state_sharding(mesh: Mesh) -> SimState:
 
     return SimState(
         up=row, down_time=row, status=row, incarnation=row, informed=row,
-        rumor_age=row, susp_start=row, susp_deadline=row, susp_conf=row,
+        susp_start=row, susp_deadline=row, susp_conf=row,
         local_health=row, slow=row, t=rep, round_idx=rep,
         stats=SimStats(*[rep] * len(SimStats._fields)))
 

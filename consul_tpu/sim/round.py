@@ -68,13 +68,14 @@ def _trunc_poisson(u: jnp.ndarray, lam: jnp.ndarray, kmax: int = 4
     return nf
 
 
-def gossip_round(state: SimState, key: jax.Array, p: SimParams,
-                 reduce_sum: Reducer = jnp.sum) -> SimState:
-    """Advance the cluster by one protocol period (p.probe_interval).
+def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
+                reduce_sum: Reducer = jnp.sum):
+    """ONE protocol period — the single copy of the protocol body.
 
-    `reduce_sum` turns a per-node array into the *global* scalar sum —
-    jnp.sum on one device; psum-wrapped in the sharded engine. All
-    cross-node coupling flows through these scalars (mean-field).
+    `scalars=None` → live mode: population scalars computed from the
+    post-churn arrays (gossip_round). `scalars=vector` → stale mode:
+    last round's scalars are used and the next round's are produced in
+    the same fused pass (gossip_round_fast). Returns (state, scalars').
     """
     n = p.n
     t = state.t
@@ -86,7 +87,6 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     status = state.status
     inc = state.incarnation
     informed = state.informed
-    age = state.rumor_age
     s_start = state.susp_start
     s_dead = state.susp_deadline
     s_conf = state.susp_conf
@@ -114,7 +114,6 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
         lh = jnp.where(rejoin, jnp.int8(0), lh)
         started = leave | rejoin
         informed = jnp.where(started, 1.0 / n, informed)
-        age = jnp.where(started, 0.0, age)
         s_dead = jnp.where(started, INF, s_dead)
         new_rumor |= started
         if p.collect_stats:
@@ -135,39 +134,22 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     upf = up.astype(jnp.float32)
     elig = (status == ALIVE) | (status == SUSPECT)  # still in member lists
     eligf = elig.astype(jnp.float32)
-    n_live = reduce_sum(upf)
-    n_elig = jnp.maximum(reduce_sum(eligf), 1.0)
-    n_up_elig = jnp.maximum(reduce_sum(upf * eligf), 1e-9)
-    frac_up_elig = n_up_elig / n_elig
-    # slow fraction among live eligible targets (g is two-valued!)
-    sbar = reduce_sum((slow & up & elig).astype(jnp.float32)) / n_up_elig
-
-    g = jnp.where(slow, p.slow_factor, 1.0)
-    if p.lifeguard and p.slow_per_round:
-        patience = 1.0 - jnp.exp2(-lh.astype(jnp.float32))
+    if scalars is None:
+        # live mode: scalars from the post-churn arrays
+        n_live = reduce_sum(upf)
+        n_elig = jnp.maximum(reduce_sum(eligf), 1.0)
+        n_up_elig = jnp.maximum(reduce_sum(upf * eligf), 1e-9)
+        sbar = reduce_sum(
+            (slow & up & elig).astype(jnp.float32)) / n_up_elig
     else:
-        patience = jnp.zeros((L,), jnp.float32)
+        # stale mode: last round's scalars (populations drift O(churn)
+        # per round; statistically equivalent, lets XLA fuse the whole
+        # round into one pass)
+        n_live, n_elig, n_up_elig = scalars[0], scalars[1], scalars[2]
+        sbar = scalars[3] / n_up_elig
+    frac_up_elig = n_up_elig / n_elig
 
-    # Per-prober miss probability against a live target of timeliness gj,
-    # exact mixture over the two-valued target/peer population.
-    def noack_given(gj_val: float | jnp.ndarray) -> jnp.ndarray:
-        gj = jnp.asarray(gj_val, jnp.float32)
-        ge_i = g + (1.0 - g) * patience
-        ge_j = gj + (1.0 - gj) * patience
-        pair2 = (ge_i * ge_j) ** 2
-        p_d = p.p_direct * pair2
-        # a relay peer is live w.p. live_frac; its timeliness is the same
-        # two-point mix → E[ge_peer^4] from sbar (exact, two values).
-        ge_p_slow = p.slow_factor + (1.0 - p.slow_factor) * patience
-        e_gp4 = (1.0 - sbar) * 1.0 + sbar * ge_p_slow ** 4
-        live_frac = n_live / n
-        p_relay1 = live_frac * p.p_relay * pair2 * e_gp4
-        p_no_relay = (1.0 - p_relay1) ** p.indirect_checks
-        p_tcp = p.p_tcp * ge_i * ge_j
-        return (1.0 - p_d) * p_no_relay * (1.0 - p_tcp)
-
-    pf_fast = noack_given(1.0)            # [L] per prober, healthy target
-    pf_slow = noack_given(p.slow_factor)  # [L] per prober, slow target
+    g, pf_fast, pf_slow = _pf_arrays(slow, lh, sbar, n_live / n, p)
 
     # ---------------------------------------------------- prober-side probe
     # P(ack | this node probes): random eligible target; down targets never
@@ -190,8 +172,12 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     # eligible members, so arrivals are ≈ Poisson(n_live/n_elig); each
     # fails with the population-mean miss probability for this target's
     # liveness/timeliness class.
-    e_pf_fast = reduce_sum(upf * pf_fast) / jnp.maximum(n_live, 1e-9)
-    e_pf_slow = reduce_sum(upf * pf_slow) / jnp.maximum(n_live, 1e-9)
+    if scalars is None:
+        e_pf_fast = reduce_sum(upf * pf_fast) / jnp.maximum(n_live, 1e-9)
+        e_pf_slow = reduce_sum(upf * pf_slow) / jnp.maximum(n_live, 1e-9)
+    else:
+        e_pf_fast = scalars[4] / jnp.maximum(n_live, 1e-9)
+        e_pf_slow = scalars[5] / jnp.maximum(n_live, 1e-9)
     probe_rate = n_live / jnp.maximum(n_elig - 1.0, 1.0)
     p_fail_j = jnp.where(up, jnp.where(slow, e_pf_slow, e_pf_fast), 1.0)
     lam_fail = probe_rate * p_fail_j * eligf
@@ -199,9 +185,12 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
 
     # Mean Lifeguard (LH+1) scale of failing probers — the timer that
     # declares dead runs at a suspector, scaled by ITS local health.
-    w_fail = upf * (1.0 - p_ack)
-    lfail_num = reduce_sum(w_fail * (lh.astype(jnp.float32) + 1.0))
-    lfail_den = jnp.maximum(reduce_sum(w_fail), 1e-9)
+    if scalars is None:
+        w_fail = upf * (1.0 - p_ack)
+        lfail_num = reduce_sum(w_fail * (lh.astype(jnp.float32) + 1.0))
+        lfail_den = jnp.maximum(reduce_sum(w_fail), 1e-9)
+    else:
+        lfail_num, lfail_den = scalars[6], scalars[7]
     scale = lfail_num / lfail_den if p.lifeguard else jnp.float32(1.0)
 
     starts = (n_fail > 0) & (status == ALIVE)
@@ -212,9 +201,8 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     status = jnp.where(starts, jnp.int8(SUSPECT), status)
     s_start = jnp.where(starts, t_end, s_start)
     s_dead = jnp.where(starts, t_end + timeout0, s_dead)
-    s_conf = jnp.where(starts, c0, s_conf)
+    s_conf = jnp.where(starts, c0, s_conf.astype(jnp.int32))
     informed = jnp.where(starts, 1.0 / n, informed)
-    age = jnp.where(starts, 0.0, age)
     new_rumor |= starts
     if p.collect_stats:
         st = st._replace(
@@ -225,7 +213,8 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     c_new = s_conf + n_fail
     ratio = _shrink(c_new, p) / _shrink(s_conf, p)
     s_dead = jnp.where(confirms, s_start + (s_dead - s_start) * ratio, s_dead)
-    s_conf = jnp.where(confirms, c_new, s_conf)
+    s_conf = jnp.where(confirms, c_new,
+                       s_conf.astype(jnp.int32)).astype(jnp.int16)
 
     # ------------------------------------------------- refutation (the race)
     # A live node refutes a suspect/dead rumor about itself once the rumor
@@ -239,9 +228,8 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     status = jnp.where(refute, jnp.int8(ALIVE), status)
     inc = jnp.where(refute, inc + 1, inc)
     informed = jnp.where(refute, 1.0 / n, informed)
-    age = jnp.where(refute, 0.0, age)
     s_dead = jnp.where(refute, INF, s_dead)
-    s_conf = jnp.where(refute, 0, s_conf)
+    s_conf = jnp.where(refute, 0, s_conf).astype(jnp.int16)
     new_rumor |= refute
     if p.lifeguard:
         lh = jnp.clip(lh.astype(jnp.int32) + refute.astype(jnp.int32), 0,
@@ -254,7 +242,6 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     declare = (status == SUSPECT) & (t_end >= s_dead)
     status = jnp.where(declare, jnp.int8(DEAD), status)
     informed = jnp.where(declare, 1.0 / n, informed)
-    age = jnp.where(declare, 0.0, age)
     s_dead = jnp.where(declare, INF, s_dead)
     new_rumor |= declare
     if p.collect_stats:
@@ -276,13 +263,126 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
              * informed * (1.0 - p.loss))
     informed = jnp.where(
         grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_g)), informed)
-    age = age + 1.0
 
-    return SimState(
+    out = SimState(
         up=up, down_time=down_time, status=status, incarnation=inc,
-        informed=informed, rumor_age=age, susp_start=s_start,
+        informed=informed, susp_start=s_start,
         susp_deadline=s_dead, susp_conf=s_conf, local_health=lh, slow=slow,
         t=t_end, round_idx=state.round_idx + 1, stats=st)
+    if scalars is None:
+        return out, None
+    # stale mode: produce next round's scalars in this same fused pass
+    upf2 = up.astype(jnp.float32)
+    elig2 = (status == ALIVE) | (status == SUSPECT)
+    elig2f = elig2.astype(jnp.float32)
+    w_fail2 = upf2 * (1.0 - p_ack)
+    new_scalars = jnp.stack([
+        reduce_sum(upf2),
+        jnp.maximum(reduce_sum(elig2f), 1.0),
+        jnp.maximum(reduce_sum(upf2 * elig2f), 1e-9),
+        reduce_sum((slow & up & elig2).astype(jnp.float32)),
+        reduce_sum(upf2 * pf_fast), reduce_sum(upf2 * pf_slow),
+        reduce_sum(w_fail2 * (lh.astype(jnp.float32) + 1.0)),
+        jnp.maximum(reduce_sum(w_fail2), 1e-9)])
+    return out, new_scalars
+
+
+def gossip_round(state: SimState, key: jax.Array, p: SimParams,
+                 reduce_sum: Reducer = jnp.sum) -> SimState:
+    """Advance one protocol period with LIVE population scalars.
+
+    `reduce_sum` turns a per-node array into the *global* scalar sum —
+    jnp.sum on one device; psum-wrapped in the sharded engine. All
+    cross-node coupling flows through these scalars (mean-field)."""
+    out, _ = _round_core(state, None, key, p, reduce_sum)
+    return out
+
+
+#: scalar vector layout for the stale-scalars fast path
+#: [n_live, n_elig, n_up_elig, n_slow_up_elig,
+#:  sum(up·pf_fast), sum(up·pf_slow), lfail_num, lfail_den]
+N_SCALARS = 8
+
+
+def _pf_arrays(slow, lh, sbar, live_frac, p: SimParams):
+    """Per-prober miss probabilities for fast/slow targets given the
+    population scalars (same math as gossip_round's noack_given)."""
+    g = jnp.where(slow, p.slow_factor, 1.0)
+    if p.lifeguard and p.slow_per_round:
+        patience = 1.0 - jnp.exp2(-lh.astype(jnp.float32))
+    else:
+        patience = jnp.zeros_like(g)
+
+    def noack_given(gj_val):
+        gj = jnp.asarray(gj_val, jnp.float32)
+        ge_i = g + (1.0 - g) * patience
+        ge_j = gj + (1.0 - gj) * patience
+        pair2 = (ge_i * ge_j) ** 2
+        p_d = p.p_direct * pair2
+        ge_p_slow = p.slow_factor + (1.0 - p.slow_factor) * patience
+        e_gp4 = (1.0 - sbar) * 1.0 + sbar * ge_p_slow ** 4
+        p_relay1 = live_frac * p.p_relay * pair2 * e_gp4
+        p_no_relay = (1.0 - p_relay1) ** p.indirect_checks
+        p_tcp = p.p_tcp * ge_i * ge_j
+        return (1.0 - p_d) * p_no_relay * (1.0 - p_tcp)
+
+    return g, noack_given(1.0), noack_given(p.slow_factor)
+
+
+def init_scalars(state: SimState, p: SimParams,
+                 reduce_sum: Reducer = jnp.sum) -> jnp.ndarray:
+    """Exact population scalars for the fast path's first round."""
+    up, status, slow, lh = (state.up, state.status, state.slow,
+                            state.local_health)
+    upf = up.astype(jnp.float32)
+    elig = (status == ALIVE) | (status == SUSPECT)
+    eligf = elig.astype(jnp.float32)
+    n_live = reduce_sum(upf)
+    n_elig = jnp.maximum(reduce_sum(eligf), 1.0)
+    n_up_elig = jnp.maximum(reduce_sum(upf * eligf), 1e-9)
+    n_slow = reduce_sum((slow & up & elig).astype(jnp.float32))
+    sbar = n_slow / n_up_elig
+    _, pf_fast, pf_slow = _pf_arrays(slow, lh, sbar, n_live / p.n, p)
+    mix = (1.0 - sbar) * pf_fast + sbar * pf_slow
+    p_ack = (n_up_elig / n_elig) * (1.0 - mix)
+    w_fail = upf * (1.0 - p_ack)
+    return jnp.stack([
+        n_live, n_elig, n_up_elig, n_slow,
+        reduce_sum(upf * pf_fast), reduce_sum(upf * pf_slow),
+        reduce_sum(w_fail * (lh.astype(jnp.float32) + 1.0)),
+        jnp.maximum(reduce_sum(w_fail), 1e-9)])
+
+
+def gossip_round_fast(state: SimState, scalars: jnp.ndarray,
+                      key: jax.Array, p: SimParams,
+                      reduce_sum: Reducer = jnp.sum
+                      ) -> tuple[SimState, jnp.ndarray]:
+    """One protocol period using LAST round's population scalars.
+
+    Same protocol body as gossip_round (_round_core) — only the scalar
+    source differs, so the two paths cannot drift. Statistical
+    conformance is additionally asserted in tests/test_sim_round.py.
+    """
+    return _round_core(state, scalars, key, p, reduce_sum)
+
+
+def make_run_rounds_fast(p: SimParams, rounds: int):
+    """Stale-scalar hot loop: state, key -> state (max throughput)."""
+
+    @jax.jit
+    def run(state: SimState, key: jax.Array) -> SimState:
+        scalars = init_scalars(state, p)
+
+        def body(carry, k):
+            s, sc = carry
+            s2, sc2 = gossip_round_fast(s, sc, k, p)
+            return (s2, sc2), None
+
+        keys = jax.random.split(key, rounds)
+        (final, _), _ = jax.lax.scan(body, (state, scalars), keys)
+        return final
+
+    return run
 
 
 @functools.partial(jax.jit, static_argnames=("p", "rounds", "trace_node"))

@@ -51,12 +51,11 @@ class SimState(NamedTuple):
     status: jnp.ndarray       # int8 — ALIVE/SUSPECT/DEAD/LEFT
     incarnation: jnp.ndarray  # int32 — incarnation the rumor carries
     informed: jnp.ndarray     # f32 — fraction of cluster that has the rumor
-    rumor_age: jnp.ndarray    # f32 — rounds since rumor started
 
     # Lifeguard suspicion timer (valid while status == SUSPECT)
     susp_start: jnp.ndarray    # f32 — sim time suspicion began
     susp_deadline: jnp.ndarray # f32 — current declare-dead deadline
-    susp_conf: jnp.ndarray     # int32 — independent confirmations
+    susp_conf: jnp.ndarray     # int16 — independent confirmations
 
     # Lifeguard local-health awareness score (0..awareness_max)
     local_health: jnp.ndarray  # int8
@@ -80,10 +79,9 @@ def init_state(n: int, dtype_small: jnp.dtype = jnp.int8) -> SimState:
         status=jnp.full((n,), ALIVE, dtype_small),
         incarnation=jnp.zeros((n,), jnp.int32),
         informed=jnp.ones((n,), jnp.float32),
-        rumor_age=jnp.zeros((n,), jnp.float32),
         susp_start=jnp.zeros((n,), jnp.float32),
         susp_deadline=jnp.full((n,), INF, jnp.float32),
-        susp_conf=jnp.zeros((n,), jnp.int32),
+        susp_conf=jnp.zeros((n,), jnp.int16),
         local_health=jnp.zeros((n,), dtype_small),
         slow=jnp.zeros((n,), jnp.bool_),
         t=jnp.zeros((), jnp.float32),

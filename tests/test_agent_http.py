@@ -356,3 +356,60 @@ def test_event_publisher_stream(agent, client):
     assert got["ev"] is not None
     assert got["ev"].topic == "KV"
     sub.close()
+
+
+def test_near_sorting_with_coordinates(agent, client):
+    # seed coordinates: the agent itself + two fake nodes at different
+    # distances, each running "geo" service instances
+    agent.rpc("Catalog.Register", {
+        "Node": "near-node", "Address": "10.0.0.10",
+        "Service": {"ID": "geo", "Service": "geo", "Port": 1}})
+    agent.rpc("Catalog.Register", {
+        "Node": "far-node", "Address": "10.0.0.11",
+        "Service": {"ID": "geo", "Service": "geo", "Port": 2}})
+    agent.rpc("Coordinate.Update", {
+        "Node": "dev-agent", "Coord": {"Vec": [0.0] * 8, "Error": 0.1,
+                                       "Adjustment": 0, "Height": 1e-5}})
+    agent.rpc("Coordinate.Update", {
+        "Node": "near-node", "Coord": {"Vec": [0.001] + [0.0] * 7,
+                                       "Error": 0.1, "Adjustment": 0,
+                                       "Height": 1e-5}})
+    agent.rpc("Coordinate.Update", {
+        "Node": "far-node", "Coord": {"Vec": [0.5] + [0.0] * 7,
+                                      "Error": 0.1, "Adjustment": 0,
+                                      "Height": 1e-5}})
+    wait_for(lambda: len(client.get("/v1/coordinate/nodes")) >= 3,
+             what="coordinate batch flush")
+    svc = client.get("/v1/catalog/service/geo", near="dev-agent")
+    assert [e["Node"] for e in svc] == ["near-node", "far-node"]
+    svc = client.get("/v1/catalog/service/geo", near="far-node")
+    assert [e["Node"] for e in svc] == ["far-node", "near-node"]
+
+
+def test_autopilot_health_endpoint(agent, client):
+    h = client.get("/v1/operator/autopilot/health")
+    assert h["Healthy"] is True
+    assert len(h["Servers"]) == 1
+    assert h["Servers"][0]["Leader"] is True
+
+
+def test_dns_ptr_lookup(agent, client):
+    import socket as s_, struct as st_
+
+    def q(name, qtype):
+        msg = st_.pack(">HHHHHH", 9, 0x0100, 1, 0, 0, 0)
+        for l in name.rstrip(".").split("."):
+            msg += bytes([len(l)]) + l.encode()
+        msg += b"\x00" + st_.pack(">HH", qtype, 1)
+        sk = s_.socket(s_.AF_INET, s_.SOCK_DGRAM)
+        sk.settimeout(3)
+        sk.sendto(msg, ("127.0.0.1", agent.dns.port))
+        r, _ = sk.recvfrom(4096)
+        sk.close()
+        return r
+
+    # dev-agent has Address 127.0.0.1
+    resp = q("1.0.0.127.in-addr.arpa.", 12)
+    an = st_.unpack_from(">HHHHHH", resp)[3]
+    assert an >= 1
+    assert b"dev-agent" in resp

@@ -138,3 +138,31 @@ def test_round_is_jit_pure():
     b = f(s, k, p)
     for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_fast_round_statistically_matches_reference_round():
+    """The stale-scalar hot path must agree with the live-scalar round on
+    FD behavior (same protocol, one-round-stale mean-field inputs)."""
+    from consul_tpu.sim.round import make_run_rounds_fast
+
+    p = SimParams(n=4096, loss=0.08, tcp_fallback=False,
+                  fail_per_round=0.002, rejoin_per_round=0.02,
+                  collect_stats=False)
+    rounds = 150
+
+    ref, _ = run_rounds(init_state(p.n), jax.random.key(3), p, rounds)
+    fast = make_run_rounds_fast(p, rounds)(init_state(p.n),
+                                           jax.random.key(4))
+
+    import numpy as np
+
+    ref_live = float(np.mean(np.asarray(ref.up)))
+    fast_live = float(np.mean(np.asarray(fast.up)))
+    assert abs(ref_live - fast_live) < 0.05
+    ref_dead = int(np.sum(np.asarray(ref.status) == DEAD))
+    fast_dead = int(np.sum(np.asarray(fast.status) == DEAD))
+    assert ref_dead > 0 and fast_dead > 0
+    assert 0.5 < (fast_dead + 1) / (ref_dead + 1) < 2.0
+    ref_susp = int(np.sum(np.asarray(ref.status) == SUSPECT))
+    fast_susp = int(np.sum(np.asarray(fast.status) == SUSPECT))
+    assert abs(fast_susp - ref_susp) < p.n * 0.05
