@@ -59,6 +59,11 @@ class Agent:
 
         self.http = None
         self.dns = None
+        # read-through cache (agent/cache): client agents avoid a server
+        # round-trip per DNS query; server agents read in-process already
+        from consul_tpu.agent.cache import AgentCache
+
+        self.cache = AgentCache(self.rpc) if self.server is None else None
         # recent user events ring buffer (/v1/event/list,
         # agent/user_event.go UserEvents)
         self._recent_events: list[dict] = []
@@ -79,6 +84,10 @@ class Agent:
         # keyring ops propagate cluster-wide as internal user events
         # (the reference uses serf queries, agent/keyring.go:234-262)
         self.serf.add_event_handler(self._internal_event)
+        # remote exec rides gossip queries (`consul exec`); off by default
+        if self.config.enable_remote_exec:
+            self.serf.register_query_handler("consul:exec",
+                                             self._handle_exec)
         if serve_http:
             from consul_tpu.agent.http import HTTPApi
 
@@ -113,6 +122,8 @@ class Agent:
         for r in self._runners.values():
             r.stop()
         self.scheduler.cancel_all()
+        if self.cache is not None:
+            self.cache.stop()
         if self.http is not None:
             self.http.stop()
         if self.dns is not None:
@@ -135,12 +146,22 @@ class Agent:
     def serf(self):
         return (self.server or self.client).serf
 
-    def rpc(self, method: str, args: dict[str, Any]) -> Any:
+    def rpc(self, method: str, args: dict[str, Any],
+            src: str = "local") -> Any:
         """Delegate RPC: in-process on servers, forwarded on clients
-        (agent/agent.go delegate seam)."""
+        (agent/agent.go delegate seam). `src` distinguishes the agent's
+        own control loops ("local", never rate-limited) from external
+        client traffic relayed by the HTTP layer ("http")."""
         if self.server is not None:
-            return self.server.handle_rpc(method, args, "local")
+            return self.server.handle_rpc(method, args, src)
         return self.client.rpc(method, args)
+
+    def cached_rpc(self, method: str, args: dict[str, Any],
+                   ttl: float = 3.0) -> Any:
+        """Read-through-cached RPC for hot read paths (DNS)."""
+        if self.cache is None:
+            return self.rpc(method, args)
+        return self.cache.get(method, args, ttl=ttl)
 
     def members(self) -> list[dict[str, Any]]:
         return [m.snapshot() for m in self.serf.members(include_left=True)]
@@ -248,6 +269,21 @@ class Agent:
             self.local.remove_check("_node_maintenance")
 
     # ------------------------------------------------------------- internals
+
+    def _handle_exec(self, payload: bytes, from_node: str) -> bytes:
+        """Run a shell command on behalf of `consul exec` (reference:
+        agent/remote_exec.go over KV+events; here over gossip queries).
+        Only reachable when enable_remote_exec is set."""
+        import subprocess
+
+        try:
+            proc = subprocess.run(payload.decode(), shell=True,
+                                  capture_output=True, timeout=30,
+                                  text=True)
+            out = proc.stdout + proc.stderr
+            return f"rc={proc.returncode}\n{out[:4000]}".encode()
+        except subprocess.TimeoutExpired:
+            return b"rc=-1\ntimed out"
 
     def _internal_event(self, ev) -> None:
         from consul_tpu.gossip.serf import EventType

@@ -1,0 +1,109 @@
+"""Agent read-through cache with background refresh.
+
+Reference: agent/cache (TTL + background-refresh read-through cache of
+server RPCs, ~25 typed entries) and agent/cache/watch.go Notify. Here:
+one generic cache keyed by (method, args); `get` serves a TTL'd copy,
+`notify` runs a background blocking-query loop pushing updates to a
+callback (the submatview-lite seam the DNS hot path uses on client
+agents).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from consul_tpu.utils import log, telemetry
+
+
+class AgentCache:
+    def __init__(self, rpc: Callable[[str, dict], Any],
+                 default_ttl: float = 3.0, max_entries: int = 4096) -> None:
+        self.rpc = rpc
+        self.default_ttl = default_ttl
+        self.max_entries = max_entries
+        self.log = log.named("cache")
+        self._lock = threading.Lock()
+        # key -> (value, fetched_at, index)
+        self._entries: dict[bytes, tuple[Any, float, int]] = {}
+        self._notifiers: list[tuple[threading.Event,
+                                    threading.Thread]] = []
+        self._stopped = False
+
+    @staticmethod
+    def _key(method: str, args: dict[str, Any]) -> bytes:
+        return msgpack.packb([method, sorted(args.items())],
+                             use_bin_type=True)
+
+    def get(self, method: str, args: dict[str, Any],
+            ttl: Optional[float] = None) -> Any:
+        """Read-through with TTL (cache.Get, agent/cache/cache.go:323)."""
+        ttl = self.default_ttl if ttl is None else ttl
+        key = self._key(method, args)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None and now - hit[1] < ttl:
+                telemetry.default.incr("cache.hit", labels={"m": method})
+                return hit[0]
+        telemetry.default.incr("cache.miss", labels={"m": method})
+        value = self.rpc(method, args)
+        index = value.get("Index", 0) if isinstance(value, dict) else 0
+        with self._lock:
+            # stamp AFTER the fetch: a slow RPC must not produce an
+            # entry that is already expired at birth
+            self._entries[key] = (value, time.monotonic(), index)
+            if len(self._entries) > self.max_entries:
+                oldest = sorted(self._entries.items(),
+                                key=lambda kv: kv[1][1])
+                for k, _ in oldest[: len(self._entries) // 4]:
+                    del self._entries[k]
+        return value
+
+    def notify(self, method: str, args: dict[str, Any],
+               callback: Callable[[Any], None]) -> Callable[[], None]:
+        """Background blocking-query refresh loop (cache watch.go:51):
+        keeps the entry warm and pushes each change to `callback`.
+        Returns a cancel function."""
+        cancelled = threading.Event()
+        key = self._key(method, args)
+
+        def loop() -> None:
+            index = 0
+            while not cancelled.is_set() and not self._stopped:
+                try:
+                    res = self.rpc(method, {
+                        **args, "MinQueryIndex": index,
+                        "MaxQueryTime": 30.0})
+                    new_index = res.get("Index", 0) \
+                        if isinstance(res, dict) else 0
+                    with self._lock:
+                        self._entries[key] = (res, time.monotonic(),
+                                              new_index)
+                    if new_index != index:
+                        index = new_index
+                        callback(res)
+                except Exception as e:  # noqa: BLE001
+                    self.log.debug("notify %s: %s", method, e)
+                    cancelled.wait(2.0)
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"cache-notify-{method}")
+        t.start()
+        with self._lock:
+            # prune finished loops so repeated notify/cancel cycles
+            # don't accumulate dead entries
+            self._notifiers = [(e, th) for e, th in self._notifiers
+                               if th.is_alive()]
+            self._notifiers.append((cancelled, t))
+        return cancelled.set
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._lock:
+            for cancelled, _ in self._notifiers:
+                cancelled.set()
+            self._notifiers.clear()

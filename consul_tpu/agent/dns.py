@@ -264,9 +264,11 @@ class DNSServer:
     def _node_answers(self, qname: str, node: str, qtype: int,
                       ttl: int) -> list[bytes]:
         try:
-            res = self.agent.rpc("Catalog.NodeServices",
-                                 {"Node": node, "AllowStale":
-                                  self.agent.config.dns_allow_stale})
+            res = self.agent.cached_rpc(
+                "Catalog.NodeServices",
+                {"Node": node,
+                 "AllowStale": self.agent.config.dns_allow_stale},
+                ttl=1.0)
         except Exception:  # noqa: BLE001
             return []
         ns = res.get("NodeServices")
@@ -297,7 +299,8 @@ class DNSServer:
         if tag:
             args["ServiceTag"] = tag
         try:
-            res = self.agent.rpc("Health.ServiceNodes", args)
+            res = self.agent.cached_rpc("Health.ServiceNodes", args,
+                                        ttl=1.0)
         except Exception:  # noqa: BLE001
             return []
         nodes = res.get("Nodes") or []

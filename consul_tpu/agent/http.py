@@ -129,7 +129,7 @@ class HTTPApi:
             args = {**args, "AuthToken": token}
             if "dc" in q:
                 args.setdefault("Datacenter", q["dc"])
-            return a.rpc(name, args)
+            return a.rpc(name, args, src="http")
 
         def blocking_args(extra: Optional[dict] = None) -> dict[str, Any]:
             args = dict(extra or {})
@@ -456,6 +456,25 @@ class HTTPApi:
             evs = [e for e in a._recent_events
                    if not name or e["Name"] == name]
             return evs, len(evs)
+
+        if path == "/v1/internal/query" and method in ("PUT", "POST"):
+            # fire a gossip query and collect responses (serf query;
+            # carries `consul exec` among others)
+            b = jbody()
+            if b.get("Name", "").startswith("consul:exec"):
+                # remote COMMAND EXECUTION requires write-level ACL
+                # (the reference gates exec behind KV write on _rexec)
+                rpc("Internal.AgentWrite", {})
+            else:
+                rpc("Internal.AgentRead", {})
+            timeout = b.get("Timeout")
+            timeout = 3.0 if timeout is None else float(timeout)
+            coll = a.serf.query(b.get("Name", ""),
+                                (b.get("Payload") or "").encode(),
+                                timeout=timeout)
+            responses = coll.wait(a.serf.memberlist.clock)
+            return [{"Node": n, "Payload": p.decode(errors="replace")}
+                    for n, p in responses], None
 
         # -------------------------------------------------------- snapshot
         if path == "/v1/snapshot":

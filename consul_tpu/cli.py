@@ -429,6 +429,24 @@ def cmd_acl(args) -> int:
     return 1
 
 
+def cmd_exec(args) -> int:
+    """`consul exec <cmd>`: run a command on every agent with remote
+    exec enabled (reference: command/exec over KV+events)."""
+    c = _client(args)
+    responses = c.put("/v1/internal/query", body={
+        "Name": "consul:exec", "Payload": args.command,
+        "Timeout": args.wait})
+    if not responses:
+        print("0 nodes responded (is enable_remote_exec set?)",
+              file=sys.stderr)
+        return 1
+    for r in responses:
+        print(f"==> {r['Node']}:")
+        print(r["Payload"])
+    print(f"{len(responses)} node(s) responded")
+    return 0
+
+
 def cmd_lock(args) -> int:
     """`consul lock prefix child_cmd`: acquire a session-backed KV lock,
     run the command, release (api/lock.go + command/lock)."""
@@ -639,6 +657,11 @@ def build_parser() -> argparse.ArgumentParser:
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
+
+    ex = sub.add_parser("exec")
+    ex.add_argument("command")
+    ex.add_argument("-wait", type=float, default=3.0)
+    ex.set_defaults(fn=cmd_exec)
 
     lk = sub.add_parser("lock")
     lk.add_argument("prefix")

@@ -177,6 +177,15 @@ class RuntimeConfig:
     dns_enable_truncate: bool = False
     dns_only_passing: bool = False
 
+    # Remote exec (`consul exec`); disabled by default like the reference
+    # (disable_remote_exec defaults true since 0.8)
+    enable_remote_exec: bool = False
+
+    # Global incoming-RPC rate limits (reference: agent/consul/rate;
+    # 0 disables). Requests/second across all clients.
+    rpc_rate_limit: float = 0.0
+    rpc_rate_burst: int = 500
+
     # Simulation backend (`agent -dev -gossip-sim=tpu`, BASELINE north star)
     gossip_sim: str = ""          # "" (off) | "tpu" | "cpu"
     gossip_sim_nodes: int = 1000
@@ -212,6 +221,7 @@ _CONFIG_ALIASES = {
     "log_level": "log_level",
     "acl_default_policy": "acl_default_policy",
     "domain": "dns_domain",
+    "enable_remote_exec": "enable_remote_exec",
 }
 
 class ConfigError(Exception):

@@ -43,6 +43,35 @@ class SerfEvent:
     ltime: int = 0
 
 
+class QueryCollector:
+    """Accumulates query responses until its deadline (serf QueryResponse)."""
+
+    def __init__(self, qid: str, deadline: float) -> None:
+        self.qid = qid
+        self.deadline = deadline
+        self.responses: list[tuple[str, bytes]] = []
+        self._lock = threading.Lock()
+        self._seen: set[str] = set()
+
+    def add(self, node: str, payload: bytes) -> None:
+        with self._lock:
+            if node not in self._seen:
+                self._seen.add(node)
+                self.responses.append((node, payload))
+
+    def wait(self, clock=None) -> list[tuple[str, bytes]]:
+        """Real-time wait until the deadline; SimClock callers advance
+        the virtual clock themselves and read .responses directly."""
+        import time as _time
+
+        ref_now = clock.now() if clock is not None else _time.monotonic()
+        real_deadline = _time.monotonic() + max(
+            0.0, self.deadline - ref_now)
+        while _time.monotonic() < real_deadline:
+            _time.sleep(0.05)
+        return list(self.responses)
+
+
 class LamportClock:
     def __init__(self) -> None:
         self._time = 0
@@ -88,6 +117,10 @@ class Serf(MemberlistDelegate):
         self.event_ltime = LamportClock()
         self._seen_events: dict[int, set[str]] = {}  # ltime -> names
         self.snapshot_path = snapshot_path
+        self._query_handlers: dict[str, Any] = {}
+        self._query_collectors: dict[str, "QueryCollector"] = {}
+        # insertion-ordered (dict) so eviction drops OLDEST ids
+        self._seen_queries: dict[str, None] = {}
         self.coord_client = CoordinateClient(seed=seed or 0)
         self._coords: dict[str, Coordinate] = {}
         self._coord_lock = threading.Lock()
@@ -170,6 +203,82 @@ class Serf(MemberlistDelegate):
         self.memberlist._broadcast("user", f"{ltime}:{name}", encoded)
         self._deliver_user(body)  # local delivery, as serf does
 
+    # ----------------------------------------------------------- queries
+
+    def register_query_handler(self, name: str, fn) -> None:
+        """fn(payload: bytes, from_node: str) -> Optional[bytes]; a
+        non-None return is sent back to the querier (serf queries,
+        the reference's keyring/exec transport)."""
+        self._query_handlers[name] = fn
+
+    def query(self, name: str, payload: bytes = b"",
+              timeout: float = 3.0) -> "QueryCollector":
+        """Broadcast a query through the gossip layer; responders reply
+        directly to our transport address. Returns a collector that
+        accumulates (node, payload) responses until `timeout`."""
+        qid = f"{self.name}:{self.event_ltime.increment()}"
+        # reap expired collectors here too — zero-response queries must
+        # not leak
+        now = self.memberlist.clock.now()
+        for old in [q for q, c in self._query_collectors.items()
+                    if now > c.deadline + 60]:
+            del self._query_collectors[old]
+        collector = QueryCollector(qid, deadline=now + timeout)
+        self._query_collectors[qid] = collector
+        body = {"id": qid, "name": name, "payload": payload,
+                "from": self.name,
+                "addr": self.memberlist.transport.addr}
+        self.memberlist._broadcast("query", qid, m.encode(m.QUERY, body))
+        # answer locally too (serf queries include the originator)
+        self._handle_query(body)
+        return collector
+
+    def _handle_query(self, body: dict[str, Any]) -> None:
+        qid = body.get("id", "")
+        if qid in self._seen_queries:
+            return
+        self._seen_queries[qid] = None
+        if len(self._seen_queries) > 4096:
+            for k in list(self._seen_queries)[:1024]:  # oldest first
+                del self._seen_queries[k]
+        # epidemic relay (first receipt re-enters the broadcast queue)
+        if body.get("from") != self.name:
+            self.memberlist._broadcast("query", qid,
+                                       m.encode(m.QUERY, body))
+        fn = self._query_handlers.get(body.get("name", ""))
+        if fn is None:
+            return
+        payload = body.get("payload") or b""
+        if isinstance(payload, str):
+            payload = payload.encode()
+        try:
+            resp = fn(payload, body.get("from", ""))
+        except Exception as e:  # noqa: BLE001
+            self.log.error("query handler %s: %s", body.get("name"), e)
+            return
+        if resp is None:
+            return
+        reply = m.encode(m.QUERY_RESPONSE, {
+            "id": qid, "from": self.name, "payload": resp})
+        if body.get("from") == self.name:
+            self._handle_query_response({"id": qid, "from": self.name,
+                                         "payload": resp})
+        else:
+            self.memberlist._send(body.get("addr", ""), reply)
+
+    def _handle_query_response(self, body: dict[str, Any]) -> None:
+        collector = self._query_collectors.get(body.get("id", ""))
+        if collector is not None:
+            payload = body.get("payload") or b""
+            if isinstance(payload, str):
+                payload = payload.encode()
+            collector.add(body.get("from", ""), payload)
+        # reap expired collectors
+        now = self.memberlist.clock.now()
+        for qid in [q for q, c in self._query_collectors.items()
+                    if now > c.deadline + 60]:
+            del self._query_collectors[qid]
+
     def get_coordinate(self, node: Optional[str] = None
                        ) -> Optional[Coordinate]:
         if node is None or node == self.name:
@@ -205,6 +314,10 @@ class Serf(MemberlistDelegate):
             body = raw["body"]
             self.event_ltime.witness(body.get("ltime", 0))
             self._deliver_user(body, requeue=True)
+        elif raw["type"] == m.QUERY:
+            self._handle_query(raw["body"])
+        elif raw["type"] == m.QUERY_RESPONSE:
+            self._handle_query_response(raw["body"])
 
     def ack_payload(self) -> dict[str, Any]:
         return {"coord": self.coord_client.get().to_dict(),

@@ -667,7 +667,12 @@ def register_endpoints(srv) -> None:
         require(authz(args).agent_read(), "agent read")
         return True
 
+    def agent_write_check(args):
+        require(authz(args).agent_write(), "agent write")
+        return True
+
     e["Internal.AgentRead"] = agent_read_check
+    e["Internal.AgentWrite"] = agent_write_check
     e["Catalog.ListDatacenters"] = lambda args: srv.datacenters()
 
     def join_wan(args):

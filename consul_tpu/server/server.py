@@ -134,6 +134,14 @@ class Server:
         self.publisher = EventPublisher()
         self.publisher.attach_to_store(self.state)
 
+        # global incoming-RPC rate limiter (agent/consul/rate/handler.go)
+        self._limiter = None
+        if config.rpc_rate_limit > 0:
+            from consul_tpu.utils.ratelimit import TokenBucket
+
+            self._limiter = TokenBucket(config.rpc_rate_limit,
+                                        config.rpc_rate_burst)
+
         # endpoint registry: "Service.Method" -> handler(args, ctx)
         self.endpoints: dict[str, Any] = {}
         register_endpoints(self)
@@ -227,6 +235,12 @@ class Server:
 
     def handle_rpc(self, method: str, args: dict[str, Any],
                    src: str) -> Any:
+        if self._limiter is not None and src != "local" \
+                and not self._limiter.allow():
+            # only NETWORK callers are limited; the agent's own control
+            # loops (anti-entropy, DNS, reconcile) must never starve
+            self.metrics.incr("rpc.rate_limited")
+            raise RPCError("rate limit exceeded, try again later")
         dc = args.get("Datacenter")
         if dc and dc != self.config.datacenter:
             return self._forward_dc(method, args, dc)

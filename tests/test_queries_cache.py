@@ -1,0 +1,129 @@
+"""Serf queries, remote exec, agent cache, rate limiting."""
+
+import time
+
+import pytest
+
+from consul_tpu.config import GossipConfig, load
+from consul_tpu.gossip import InMemNetwork, Serf
+from consul_tpu.utils.ratelimit import TokenBucket
+
+from helpers import wait_for  # noqa: E402
+
+
+def test_serf_query_roundtrip():
+    net = InMemNetwork(seed=0, latency=0.001)
+    serfs = []
+    for i in range(4):
+        t = net.attach(f"127.0.0.1:{7100 + i}")
+        s = Serf(f"q{i}", t, config=GossipConfig.local(),
+                 clock=net.clock, seed=i)
+        s.start()
+        serfs.append(s)
+    for s in serfs[1:]:
+        s.join([serfs[0].memberlist.transport.addr])
+    net.clock.advance(2.0)
+    # everyone answers uptime queries
+    for s in serfs:
+        s.register_query_handler(
+            "uptime", lambda payload, frm, name=s.name:
+            f"{name}: up".encode())
+    coll = serfs[0].query("uptime", b"", timeout=5.0)
+    net.clock.advance(5.0)
+    nodes = {n for n, _ in coll.responses}
+    assert nodes == {"q0", "q1", "q2", "q3"}
+    # handler payloads came through
+    assert all(p.endswith(b": up") for _, p in coll.responses)
+    # non-handled query name → only silence
+    coll2 = serfs[0].query("nope", b"", timeout=2.0)
+    net.clock.advance(3.0)
+    assert coll2.responses == []
+
+
+def test_remote_exec_disabled_by_default_and_works_when_enabled():
+    from consul_tpu.agent import Agent
+    from consul_tpu.api import ConsulClient
+
+    a1 = Agent(load(dev=True, overrides={
+        "node_name": "exec1", "enable_remote_exec": True}))
+    a1.start(serve_dns=False)
+    try:
+        wait_for(lambda: a1.server.is_leader(), what="leader")
+        c = ConsulClient(a1.http.addr)
+        out = c.put("/v1/internal/query", body={
+            "Name": "consul:exec", "Payload": "echo hello-from-exec",
+            "Timeout": 2.0})
+        assert len(out) == 1
+        assert "hello-from-exec" in out[0]["Payload"]
+        assert out[0]["Payload"].startswith("rc=0")
+    finally:
+        a1.shutdown()
+
+    a2 = Agent(load(dev=True, overrides={"node_name": "exec2"}))
+    a2.start(serve_dns=False)
+    try:
+        wait_for(lambda: a2.server.is_leader(), what="leader")
+        c = ConsulClient(a2.http.addr)
+        out = c.put("/v1/internal/query", body={
+            "Name": "consul:exec", "Payload": "echo nope",
+            "Timeout": 1.0})
+        assert out == []  # disabled by default — nobody answers
+    finally:
+        a2.shutdown()
+
+
+def test_agent_cache_ttl_and_refresh():
+    from consul_tpu.agent.cache import AgentCache
+
+    calls = {"n": 0}
+
+    def fake_rpc(method, args):
+        calls["n"] += 1
+        return {"Index": calls["n"], "Value": args.get("Key")}
+
+    cache = AgentCache(fake_rpc, default_ttl=0.5)
+    a = cache.get("KVS.Get", {"Key": "x"})
+    b = cache.get("KVS.Get", {"Key": "x"})
+    assert a == b and calls["n"] == 1      # TTL hit
+    cache.get("KVS.Get", {"Key": "y"})
+    assert calls["n"] == 2                 # different key → miss
+    time.sleep(0.6)
+    cache.get("KVS.Get", {"Key": "x"})
+    assert calls["n"] == 3                 # TTL expired → refetch
+
+    # notify loop pushes updates on index change
+    got = []
+    cancel = cache.notify("KVS.Get", {"Key": "w"}, got.append)
+    wait_for(lambda: len(got) >= 2, timeout=5.0,
+             what="notify updates")
+    cancel()
+
+
+def test_token_bucket():
+    tb = TokenBucket(rate=100.0, burst=5)
+    assert sum(tb.allow() for _ in range(10)) == 5  # burst drained
+    time.sleep(0.05)  # ~5 tokens refill
+    assert tb.allow()
+
+
+def test_rpc_rate_limit_enforced():
+    from consul_tpu.agent import Agent
+    from consul_tpu.api import APIError, ConsulClient
+
+    a = Agent(load(dev=True, overrides={
+        "node_name": "rl", "rpc_rate_limit": 5.0, "rpc_rate_burst": 5}))
+    a.start(serve_dns=False, serve_http=True)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="leader")
+        c = ConsulClient(a.http.addr)
+        hit_limit = False
+        for i in range(30):
+            try:
+                c.kv_put(f"k{i}", b"v")
+            except APIError as e:
+                assert "rate limit" in str(e)
+                hit_limit = True
+                break
+        assert hit_limit, "30 rapid writes should exceed 5 rps/burst 5"
+    finally:
+        a.shutdown()
