@@ -57,6 +57,18 @@ class Agent:
         self._runners: dict[str, Any] = {}
         self._maintenance = False
 
+        # central TLS configurator (tlsutil Configurator)
+        self.tls = None
+        if config.tls_cert_file and config.tls_key_file:
+            from consul_tpu.utils.tlsutil import TLSConfigurator
+
+            self.tls = TLSConfigurator(
+                ca_file=config.tls_ca_file,
+                cert_file=config.tls_cert_file,
+                key_file=config.tls_key_file,
+                verify_incoming=config.tls_verify_incoming,
+                verify_outgoing=config.tls_verify_outgoing)
+
         self.http = None
         self.dns = None
         # read-through cache (agent/cache): client agents avoid a server
@@ -91,8 +103,12 @@ class Agent:
         if serve_http:
             from consul_tpu.agent.http import HTTPApi
 
+            tls_ctx = None
+            if self.config.tls_https and self.tls is not None:
+                tls_ctx = self.tls.server_context()
             self.http = HTTPApi(self, self.config.bind_addr,
-                                self.config.port("http"))
+                                self.config.port("http"),
+                                tls_context=tls_ctx)
             self.http.start()
         if serve_dns:
             from consul_tpu.agent.dns import DNSServer
@@ -204,6 +220,7 @@ class Agent:
             port=int(defn.get("Port") or 0),
             meta=dict(defn.get("Meta") or {}),
             kind=defn.get("Kind", ""))
+        svc.proxy = dict(defn.get("Proxy") or {})
         self.local.add_service(svc)
         checks = list(defn.get("Checks") or [])
         if defn.get("Check"):
@@ -215,6 +232,22 @@ class Agent:
             cd.setdefault("Name", f"Service '{svc.service}' check")
             cd["ServiceID"] = svc.id
             self.register_check(cd)
+        # Connect sidecar expansion: registering a service with
+        # Connect.SidecarService auto-registers its proxy
+        # (agent/sidecar_service.go)
+        sidecar = (defn.get("Connect") or {}).get("SidecarService")
+        if sidecar is not None:
+            sc = dict(sidecar)
+            sc.setdefault("Name", f"{svc.service}-sidecar-proxy")
+            sc.setdefault("ID", f"{svc.id}-sidecar-proxy")
+            sc.setdefault("Kind", "connect-proxy")
+            sc.setdefault("Port", self._next_sidecar_port())
+            proxy = dict(sc.get("Proxy") or {})
+            proxy.setdefault("DestinationServiceName", svc.service)
+            proxy.setdefault("DestinationServiceID", svc.id)
+            proxy.setdefault("LocalServicePort", svc.port)
+            sc["Proxy"] = proxy
+            self.register_service(sc)
 
     def deregister_service(self, service_id: str) -> bool:
         for cid, runner in list(self._runners.items()):
@@ -222,7 +255,22 @@ class Agent:
             if chk is not None and chk.service_id == service_id:
                 runner.stop()
                 del self._runners[cid]
-        return self.local.remove_service(service_id)
+        found = self.local.remove_service(service_id)
+        # an auto-registered sidecar goes away with its parent
+        # (agent.go removeServiceLocked)
+        sidecar_id = f"{service_id}-sidecar-proxy"
+        if found and sidecar_id in self.local.list_services():
+            self.deregister_service(sidecar_id)
+        return found
+
+    def _next_sidecar_port(self) -> int:
+        """First free port in the sidecar range (the reference's
+        sidecar_min_port..sidecar_max_port allocation, 21000-21255)."""
+        used = {s.port for s in self.local.list_services().values()}
+        for port in range(21000, 21256):
+            if port not in used:
+                return port
+        raise RPCError("sidecar port range exhausted (21000-21255)")
 
     def register_check(self, defn: dict[str, Any]) -> None:
         cid = defn.get("CheckID") or defn.get("Name", "")

@@ -35,9 +35,10 @@ from consul_tpu.utils.duration import parse_duration as _dur  # noqa: E402
 
 class HTTPApi:
     def __init__(self, agent, bind: str = "127.0.0.1",
-                 port: int = 8500) -> None:
+                 port: int = 8500, tls_context=None) -> None:
         self.agent = agent
         self.log = log.named("http")
+        self.tls = tls_context is not None
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -106,7 +107,22 @@ class HTTPApi:
             def do_DELETE(self):
                 self._handle("DELETE")
 
-        self._srv = ThreadingHTTPServer((bind, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            ssl_ctx = tls_context
+
+            def finish_request(self, request, client_address):
+                # handshake runs in the per-connection worker thread
+                # with a timeout — a stalled client must never block
+                # the accept loop
+                if self.ssl_ctx is not None:
+                    request.settimeout(10.0)
+                    request = self.ssl_ctx.wrap_socket(
+                        request, server_side=True)
+                    request.settimeout(None)
+                super().finish_request(request, client_address)
+
+        self._srv = _Server((bind, port), Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True, name="http-api")

@@ -23,12 +23,14 @@ class LocalService:
     port: int = 0
     meta: dict[str, str] = field(default_factory=dict)
     kind: str = ""
+    proxy: dict[str, Any] = field(default_factory=dict)
     in_sync: bool = False
 
     def to_service_dict(self) -> dict[str, Any]:
         return {"ID": self.id, "Service": self.service, "Tags": self.tags,
                 "Address": self.address, "Port": self.port,
-                "Meta": self.meta, "Kind": self.kind}
+                "Meta": self.meta, "Kind": self.kind,
+                "Proxy": self.proxy}
 
 
 @dataclass

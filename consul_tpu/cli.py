@@ -429,6 +429,43 @@ def cmd_acl(args) -> int:
     return 1
 
 
+def _write_pem(path: str, data: str, private: bool = False) -> None:
+    if os.path.exists(path):
+        raise SystemExit(f"refusing to overwrite existing file: {path}")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                 0o600 if private else 0o644)
+    with os.fdopen(fd, "w") as f:
+        f.write(data)
+
+
+def cmd_tls(args) -> int:
+    from consul_tpu.utils.tlsutil import create_ca, create_cert
+
+    if args.tls_cmd == "ca" and args.tls_sub == "create":
+        cert, key = create_ca(days=args.days)
+        _write_pem("consul-agent-ca.pem", cert)
+        _write_pem("consul-agent-ca-key.pem", key, private=True)
+        print("==> Saved consul-agent-ca.pem")
+        print("==> Saved consul-agent-ca-key.pem")
+        return 0
+    if args.tls_cmd == "cert" and args.tls_sub == "create":
+        ca = open(args.ca).read()
+        ca_key = open(args.ca_key).read()
+        name = f"server.{args.dc}.consul" if args.server \
+            else f"client.{args.dc}.consul"
+        cert, key = create_cert(
+            ca, ca_key, name,
+            dns_names=[name, "localhost"] + args.additional_dnsname,
+            days=args.days)
+        prefix = f"{args.dc}-{'server' if args.server else 'client'}-consul"
+        _write_pem(f"{prefix}.pem", cert)
+        _write_pem(f"{prefix}-key.pem", key, private=True)
+        print(f"==> Saved {prefix}.pem")
+        print(f"==> Saved {prefix}-key.pem")
+        return 0
+    return 1
+
+
 def cmd_exec(args) -> int:
     """`consul exec <cmd>`: run a command on every agent with remote
     exec enabled (reference: command/exec over KV+events)."""
@@ -657,6 +694,26 @@ def build_parser() -> argparse.ArgumentParser:
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
+
+    tlsp = sub.add_parser("tls")
+    tlssub = tlsp.add_subparsers(dest="tls_cmd", required=True)
+    tca = tlssub.add_parser("ca")
+    tcasub = tca.add_subparsers(dest="tls_sub", required=True)
+    cac = tcasub.add_parser("create")
+    cac.add_argument("-days", type=int, default=1825)
+    tcert = tlssub.add_parser("cert")
+    tcertsub = tcert.add_subparsers(dest="tls_sub", required=True)
+    cc = tcertsub.add_parser("create")
+    cc.add_argument("-server", action="store_true")
+    cc.add_argument("-client", action="store_true")
+    cc.add_argument("-dc", default="dc1")
+    cc.add_argument("-days", type=int, default=365)
+    cc.add_argument("-ca", default="consul-agent-ca.pem")
+    cc.add_argument("-ca-key", dest="ca_key",
+                    default="consul-agent-ca-key.pem")
+    cc.add_argument("-additional-dnsname", action="append",
+                    dest="additional_dnsname", default=[])
+    tlsp.set_defaults(fn=cmd_tls)
 
     ex = sub.add_parser("exec")
     ex.add_argument("command")

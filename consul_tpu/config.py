@@ -177,6 +177,14 @@ class RuntimeConfig:
     dns_enable_truncate: bool = False
     dns_only_passing: bool = False
 
+    # TLS (reference: tlsutil Configurator; tls{} config block)
+    tls_ca_file: str = ""
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_verify_incoming: bool = False
+    tls_verify_outgoing: bool = False
+    tls_https: bool = False   # serve the HTTP API over TLS
+
     # Remote exec (`consul exec`); disabled by default like the reference
     # (disable_remote_exec defaults true since 0.8)
     enable_remote_exec: bool = False
@@ -230,8 +238,16 @@ class ConfigError(Exception):
 
 def _merge_file(cfg: dict[str, Any], data: dict[str, Any]) -> None:
     for k, v in data.items():
-        if k in ("ports", "dns_config", "gossip_lan", "gossip_wan",
-                 "performance", "telemetry", "acl"):
+        if k == "tls":
+            # deep-merge: two files may both use tls{defaults{...}}
+            blk = cfg.setdefault(k, {})
+            for kk, vv in (v or {}).items():
+                if kk == "defaults":
+                    blk.setdefault("defaults", {}).update(vv or {})
+                else:
+                    blk[kk] = vv
+        elif k in ("ports", "dns_config", "gossip_lan", "gossip_wan",
+                   "performance", "telemetry", "acl"):
             cfg.setdefault(k, {}).update(v or {})
         elif k in ("retry_join", "retry_join_wan", "recursors"):
             # join/recursor address lists accumulate across sources
@@ -308,6 +324,18 @@ def load(
         tel = {k: v for k, v in raw["telemetry"].items()
                if k in {f.name for f in dataclasses.fields(TelemetryConfig)}}
         kwargs["telemetry"] = TelemetryConfig(**tel)
+    tls = raw.get("tls", {})
+    # accept both the nested tls{defaults{}} form and flat keys
+    tls = {**(tls.get("defaults") or {}),
+           **{k: v for k, v in tls.items() if k != "defaults"}}
+    for src, tgt in (("ca_file", "tls_ca_file"),
+                     ("cert_file", "tls_cert_file"),
+                     ("key_file", "tls_key_file"),
+                     ("verify_incoming", "tls_verify_incoming"),
+                     ("verify_outgoing", "tls_verify_outgoing"),
+                     ("https", "tls_https")):
+        if src in tls:
+            kwargs[tgt] = tls[src]
     acl = raw.get("acl", {})
     for src, tgt in (("enabled", "acl_enabled"),
                      ("default_policy", "acl_default_policy"),
@@ -352,6 +380,13 @@ def validate(cfg: RuntimeConfig) -> None:
         raise ConfigError("bootstrap_expect=1 is not allowed; use bootstrap")
     if not cfg.dev_mode and cfg.server_mode and not cfg.data_dir:
         raise ConfigError("server mode requires data_dir")
+    if cfg.tls_https and not (cfg.tls_cert_file and cfg.tls_key_file):
+        raise ConfigError(
+            "tls.https requires cert_file and key_file")
+    if cfg.tls_verify_incoming and not cfg.tls_ca_file:
+        raise ConfigError("tls.verify_incoming requires ca_file")
+    if cfg.tls_verify_outgoing and not cfg.tls_ca_file:
+        raise ConfigError("tls.verify_outgoing requires ca_file")
     if cfg.encrypt_key:
         import base64
 

@@ -1,0 +1,181 @@
+"""TLS configurator: central, hot-reloadable TLS for HTTP and RPC.
+
+Reference: tlsutil/ (the Configurator consumed by every listener —
+RPC/HTTPS/gRPC — with verify_incoming/verify_outgoing and hot reload).
+Also provides cert generation helpers backing the `consul-tpu tls ca
+create` / `tls cert create` CLI (command/tls in the reference), built
+on the same EC/x509 machinery as the Connect CA.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+import threading
+from typing import Any, Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+
+class TLSConfigurator:
+    """Builds server/client SSLContexts from file paths; reload() re-reads
+    the files so rotated certs apply without restart (tlsutil hot
+    reload)."""
+
+    def __init__(self, ca_file: str = "", cert_file: str = "",
+                 key_file: str = "", verify_incoming: bool = False,
+                 verify_outgoing: bool = False,
+                 server_name: str = "") -> None:
+        self.ca_file = ca_file
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self.verify_incoming = verify_incoming
+        self.verify_outgoing = verify_outgoing
+        self.server_name = server_name
+        self._lock = threading.Lock()
+        self._server_ctx: Optional[ssl.SSLContext] = None
+        self._client_ctx: Optional[ssl.SSLContext] = None
+        if self.enabled:
+            self.reload()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.cert_file and self.key_file)
+
+    def reload(self) -> None:
+        """(Re)load cert material. The SAME context objects are mutated
+        in place, so listeners already wrapped with them serve the new
+        certificates on subsequent handshakes (hot rotation)."""
+        with self._lock:
+            server = self._server_ctx or ssl.SSLContext(
+                ssl.PROTOCOL_TLS_SERVER)
+            server.minimum_version = ssl.TLSVersion.TLSv1_2
+            server.load_cert_chain(self.cert_file, self.key_file)
+            if self.verify_incoming:
+                if not self.ca_file:
+                    raise ValueError(
+                        "verify_incoming requires a ca_file")
+                server.verify_mode = ssl.CERT_REQUIRED
+                server.load_verify_locations(self.ca_file)
+
+            client = self._client_ctx or ssl.SSLContext(
+                ssl.PROTOCOL_TLS_CLIENT)
+            client.minimum_version = ssl.TLSVersion.TLSv1_2
+            if self.verify_outgoing:
+                if not self.ca_file:
+                    raise ValueError(
+                        "verify_outgoing requires a ca_file")
+                client.load_verify_locations(self.ca_file)
+                client.check_hostname = bool(self.server_name)
+            else:
+                client.check_hostname = False
+                client.verify_mode = ssl.CERT_NONE
+            # mutual TLS: present our cert to servers that require it
+            client.load_cert_chain(self.cert_file, self.key_file)
+            self._server_ctx = server
+            self._client_ctx = client
+
+    def server_context(self) -> Optional[ssl.SSLContext]:
+        with self._lock:
+            return self._server_ctx
+
+    def client_context(self) -> Optional[ssl.SSLContext]:
+        with self._lock:
+            return self._client_ctx
+
+
+# ------------------------------------------------------------ generation
+
+def create_ca(common_name: str = "Consul Agent CA",
+              days: int = 1825) -> tuple[str, str]:
+    """Self-signed CA; returns (cert_pem, key_pem) — `tls ca create`."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,
+                                         common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.BasicConstraints(ca=True,
+                                                 path_length=None),
+                           critical=True)
+            .add_extension(x509.KeyUsage(
+                digital_signature=True, key_cert_sign=True, crl_sign=True,
+                content_commitment=False, key_encipherment=False,
+                data_encipherment=False, key_agreement=False,
+                encipher_only=False, decipher_only=False), critical=True)
+            .sign(key, hashes.SHA256()))
+    return (cert.public_bytes(serialization.Encoding.PEM).decode(),
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption()).decode())
+
+
+def create_cert(ca_cert_pem: str, ca_key_pem: str, common_name: str,
+                dns_names: Optional[list[str]] = None,
+                ip_addresses: Optional[list[str]] = None,
+                days: int = 365) -> tuple[str, str]:
+    """Server/client cert signed by the CA — `tls cert create`."""
+    ca_key = serialization.load_pem_private_key(ca_key_pem.encode(),
+                                                password=None)
+    ca_cert = x509.load_pem_x509_certificate(ca_cert_pem.encode())
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    sans: list[x509.GeneralName] = [
+        x509.DNSName(n) for n in (dns_names or ["localhost"])]
+    for ip in ip_addresses or ["127.0.0.1"]:
+        sans.append(x509.IPAddress(ipaddress.ip_address(ip)))
+    cert = (x509.CertificateBuilder()
+            .subject_name(x509.Name([
+                x509.NameAttribute(NameOID.COMMON_NAME, common_name)]))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.SubjectAlternativeName(sans),
+                           critical=False)
+            .add_extension(x509.BasicConstraints(ca=False,
+                                                 path_length=None),
+                           critical=True)
+            .add_extension(x509.ExtendedKeyUsage([
+                x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
+                x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]),
+                critical=False)
+            .sign(ca_key, hashes.SHA256()))
+    return (cert.public_bytes(serialization.Encoding.PEM).decode(),
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption()).decode())
+
+
+def write_test_certs(directory: str) -> dict[str, str]:
+    """Generate a CA + localhost server cert into `directory` (tests and
+    dev bootstrapping). Returns the file-path dict for RuntimeConfig."""
+    ca_pem, ca_key = create_ca()
+    cert_pem, key_pem = create_cert(ca_pem, ca_key, "server.dc1.consul",
+                                    dns_names=["localhost",
+                                               "server.dc1.consul"])
+    os.makedirs(directory, exist_ok=True)
+    paths = {"ca_file": os.path.join(directory, "ca.pem"),
+             "cert_file": os.path.join(directory, "server.pem"),
+             "key_file": os.path.join(directory, "server-key.pem")}
+    with open(paths["ca_file"], "w") as f:
+        f.write(ca_pem)
+    with open(paths["cert_file"], "w") as f:
+        f.write(cert_pem)
+    with open(paths["key_file"], "w") as f:
+        f.write(key_pem)
+    with open(os.path.join(directory, "ca-key.pem"), "w") as f:
+        f.write(ca_key)
+    return paths

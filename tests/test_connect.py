@@ -134,3 +134,28 @@ def test_double_rotation_keeps_all_roots(agent, client):
     pems = [r["RootCert"] for r in roots]
     # the oldest leaf still verifies against SOME retained root
     assert any(verify_leaf(p, leaf_a["CertPEM"]) for p in pems)
+
+
+def test_sidecar_service_expansion(agent, client):
+    client.service_register({
+        "Name": "payments", "ID": "pay1", "Port": 9400,
+        "Connect": {"SidecarService": {}}})
+    svcs = client.agent_services()
+    assert "pay1-sidecar-proxy" in svcs
+    sc = svcs["pay1-sidecar-proxy"]
+    assert sc["Kind"] == "connect-proxy"
+    assert sc["Proxy"]["DestinationServiceName"] == "payments"
+    # allocated from the sidecar range (21000-21255), collision-free
+    assert 21000 <= sc["Port"] <= 21255
+    # a second sidecar-bearing service gets a DIFFERENT port
+    client.service_register({
+        "Name": "billing", "ID": "bill1", "Port": 9400,
+        "Connect": {"SidecarService": {}}})
+    svcs2 = client.agent_services()
+    assert svcs2["bill1-sidecar-proxy"]["Port"] != sc["Port"]
+    # deregistering the parent removes the sidecar too
+    client.service_deregister("bill1")
+    assert "bill1-sidecar-proxy" not in client.agent_services()
+    # flows to the catalog with the proxy kind
+    wait_for(lambda: client.catalog_service("payments-sidecar-proxy"),
+             what="sidecar in catalog")

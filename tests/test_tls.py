@@ -1,0 +1,68 @@
+"""TLS configurator + HTTPS API tests (reference: tlsutil/)."""
+
+import ssl
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api import ConsulClient
+from consul_tpu.config import load
+from consul_tpu.utils.tlsutil import (TLSConfigurator, create_ca,
+                                      create_cert, write_test_certs)
+
+from helpers import wait_for  # noqa: E402
+
+
+def test_ca_and_cert_generation(tmp_path):
+    ca_pem, ca_key = create_ca()
+    cert, key = create_cert(ca_pem, ca_key, "server.dc1.consul",
+                            dns_names=["server.dc1.consul"],
+                            ip_addresses=["127.0.0.1"])
+    assert "BEGIN CERTIFICATE" in cert
+    # the generated chain is valid per the ssl module itself
+    paths = write_test_certs(str(tmp_path))
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(paths["ca_file"])  # parses + trusts the CA
+
+
+def test_configurator_requires_ca_for_verify(tmp_path):
+    paths = write_test_certs(str(tmp_path))
+    with pytest.raises(ValueError, match="verify_incoming requires"):
+        TLSConfigurator(cert_file=paths["cert_file"],
+                        key_file=paths["key_file"],
+                        verify_incoming=True)
+    cfg = TLSConfigurator(**paths, verify_incoming=True,
+                          verify_outgoing=True)
+    assert cfg.server_context() is not None
+    assert cfg.client_context() is not None
+
+
+def test_https_api_end_to_end(tmp_path):
+    paths = write_test_certs(str(tmp_path))
+    a = Agent(load(dev=True, overrides={
+        "node_name": "tls-agent",
+        "tls": {**paths, "https": True}}))
+    a.start(serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="leader")
+        # plain HTTP must fail against the TLS listener
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://{a.http.addr}/v1/status/leader",
+                                   timeout=2)
+        # HTTPS with the CA works
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(paths["ca_file"])
+        ctx.check_hostname = False
+        with urllib.request.urlopen(
+                f"https://{a.http.addr}/v1/status/leader",
+                context=ctx, timeout=5) as resp:
+            assert resp.status == 200
+        # HTTPS without trusting the CA is rejected
+        strict = ssl.create_default_context()
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"https://{a.http.addr}/v1/status/leader",
+                context=strict, timeout=2)
+    finally:
+        a.shutdown()
