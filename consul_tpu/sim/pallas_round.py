@@ -1,0 +1,287 @@
+"""Pallas TPU kernel for the SWIM round — the native tier.
+
+One fused pass over the node-state tensors per protocol period: on-chip
+PRNG (pltpu.prng_random_bits — no separate threefry kernels), all
+elementwise protocol logic in VMEM, per-block partial sums emitted for
+the next round's stale population scalars (sim/round.py fast-path
+model). This is the hand-scheduled version of `gossip_round_fast`,
+reaching for the HBM-bandwidth floor that XLA's multi-kernel lowering
+leaves on the table.
+
+Scope: the benchmark/stable-protocol configuration — no churn, no
+slow-node model, no stats counters (those configs use the XLA paths).
+Statistical conformance with gossip_round is asserted in
+tests/test_pallas_round.py (TPU-gated).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from consul_tpu.sim.params import SimParams
+from consul_tpu.sim.round import N_SCALARS, init_scalars, _shrink
+from consul_tpu.sim.state import ALIVE, DEAD, LEFT, SUSPECT, SimState
+
+INF = 3.4e38  # python float: jnp constants can't be captured by kernels
+
+LANES = 1024  # row width: multiple of 128 lanes; int8 tiles need 32 rows
+ROWS_PER_BLOCK = 256
+
+
+def _u01(shape) -> jnp.ndarray:
+    """Fresh on-chip random bits → uniform [0,1) float32 (24-bit
+    mantissa). prng_random_bits yields int32 — MUST bitcast to uint32
+    before shifting, or the arithmetic shift produces negative
+    "uniforms"."""
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    # Mosaic can't cast u32->f32; >>8 leaves 24 bits, safe as int32
+    top24 = pltpu.bitcast(bits >> 8, jnp.int32)
+    return top24.astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
+                  up_ref, status_ref, inc_ref, informed_ref,
+                  s_start_ref, s_dead_ref, s_conf_ref, lh_ref,
+                  up_o, status_o, inc_o, informed_o,
+                  s_start_o, s_dead_o, s_conf_o, lh_o,
+                  partial_o,
+                  *, p: SimParams):
+    """One block of one protocol period (grid = node blocks)."""
+    blk = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0] + blk)
+
+    t = t_ref[0]
+    t_end = t + p.probe_interval
+    n = p.n
+
+    # stale scalars for this round
+    n_live = scal_ref[0]
+    n_elig = scal_ref[1]
+    n_up_elig = scal_ref[2]
+    lfail_num, lfail_den = scal_ref[6], scal_ref[7]
+    frac_up_elig = n_up_elig / n_elig
+    e_pf = scal_ref[4] / jnp.maximum(n_live, 1e-9)
+    scale = lfail_num / lfail_den if p.lifeguard else jnp.float32(1.0)
+
+    # load small ints as int32 FIRST: i1 masks inherit the source's
+    # tiling, and int8-derived (32,128) masks cannot combine with
+    # f32/int32-derived (8,128) masks under Mosaic
+    up = up_ref[:].astype(jnp.int32) != 0
+    status = status_ref[:].astype(jnp.int32)
+    inc = inc_ref[:]
+    informed = informed_ref[:]
+    s_start = s_start_ref[:]
+    s_dead = s_dead_ref[:]
+    s_conf = s_conf_ref[:].astype(jnp.int32)
+    lh = lh_ref[:].astype(jnp.int32)
+    shape = up.shape
+    new_rumor = jnp.zeros(shape, jnp.bool_)
+
+    # prober-side ack (no slow nodes: pf is the same for every prober)
+    live_frac = n_live / n
+    p_relay1 = live_frac * p.p_relay
+    pf = ((1.0 - p.p_direct) * (1.0 - p_relay1) ** p.indirect_checks
+          * (1.0 - p.p_tcp))
+    # Mosaic: comparisons against SMEM-sourced scalars produce
+    # replicated-layout masks that can't AND with memory-sourced masks —
+    # materialize the scalar as a vector first.
+    p_ack = frac_up_elig * (1.0 - pf)
+    p_ack_v = jnp.zeros(shape, jnp.float32) + p_ack
+    u_ack = _u01(shape)
+    ack = up & (u_ack < p_ack_v)
+    failed = up & ~ack
+    if p.lifeguard:
+        delta = jnp.where(ack, -1, 0) + jnp.where(failed, 1, 0)
+        lh = jnp.clip(lh + delta, 0, p.awareness_max)
+
+    # target-side suspicion arrivals (truncated-Poisson inverse CDF)
+    eligf = ((status == ALIVE) | (status == SUSPECT)).astype(jnp.float32)
+    probe_rate = n_live / jnp.maximum(n_elig - 1.0, 1.0)
+    p_fail_j = jnp.where(up, e_pf, 1.0)
+    lam = probe_rate * p_fail_j * eligf
+    u_p = _u01(shape)
+    term = jnp.exp(-lam)
+    c = term
+    n_fail = jnp.zeros(shape, jnp.int32)
+    for k in range(1, 5):
+        n_fail = n_fail + (u_p > c).astype(jnp.int32)
+        term = term * lam / k
+        c = c + term
+
+    starts = (n_fail > 0) & (status == ALIVE)
+    confirms = (n_fail > 0) & (status == SUSPECT)
+    c0 = jnp.maximum(n_fail - 1, 0)
+    timeout0 = scale * p.suspicion_max_s * _shrink(c0, p)
+    status = jnp.where(starts, SUSPECT, status)
+    s_start = jnp.where(starts, t_end, s_start)
+    s_dead = jnp.where(starts, t_end + timeout0, s_dead)
+    s_conf = jnp.where(starts, c0, s_conf)
+    informed = jnp.where(starts, 1.0 / n, informed)
+    new_rumor |= starts
+
+    c_new = s_conf + n_fail
+    ratio = _shrink(c_new, p) / _shrink(s_conf, p)
+    s_dead = jnp.where(confirms, s_start + (s_dead - s_start) * ratio,
+                       s_dead)
+    s_conf = jnp.where(confirms, c_new, s_conf)
+
+    # refutation race
+    lam_hear = (p.gossip_nodes * p.gossip_ticks_per_round * informed
+                * (1.0 - p.loss))
+    p_hear = 1.0 - jnp.exp(-lam_hear)
+    u_h = _u01(shape)
+    wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
+    refute = wrongly & (u_h < p_hear)
+    status = jnp.where(refute, ALIVE, status)
+    inc = jnp.where(refute, inc + 1, inc)
+    informed = jnp.where(refute, 1.0 / n, informed)
+    s_dead = jnp.where(refute, INF, s_dead)
+    s_conf = jnp.where(refute, 0, s_conf)
+    new_rumor |= refute
+    if p.lifeguard:
+        lh = jnp.clip(lh + refute.astype(jnp.int32), 0, p.awareness_max)
+
+    # declaration
+    t_end_v = jnp.zeros(shape, jnp.float32) + t_end
+    declare = (status == SUSPECT) & (t_end_v >= s_dead)
+    status = jnp.where(declare, DEAD, status)
+    informed = jnp.where(declare, 1.0 / n, informed)
+    s_dead = jnp.where(declare, INF, s_dead)
+    new_rumor |= declare
+
+    # dissemination
+    grow = (~new_rumor) & (informed < 1.0)
+    informed = jnp.where(
+        grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_hear)),
+        informed)
+
+    # write back
+    up_o[:] = up.astype(up_ref.dtype)
+    status_o[:] = status.astype(status_ref.dtype)
+    inc_o[:] = inc
+    informed_o[:] = informed
+    s_start_o[:] = s_start
+    s_dead_o[:] = s_dead
+    s_conf_o[:] = s_conf.astype(s_conf_ref.dtype)
+    lh_o[:] = lh.astype(lh_ref.dtype)
+
+    # next round's partial sums for this block
+    upf = up.astype(jnp.float32)
+    elig2f = ((status == ALIVE) | (status == SUSPECT)).astype(jnp.float32)
+    w_fail = upf * (1.0 - p_ack_v)
+    s_up = jnp.sum(upf)
+    sums = [s_up, jnp.sum(elig2f), jnp.sum(upf * elig2f),
+            jnp.float32(0.0),                  # slow count (model off)
+            s_up * pf, s_up * pf,              # Σ up·pf (pf uniform)
+            jnp.sum(w_fail * (lh.astype(jnp.float32) + 1.0)),
+            jnp.sum(w_fail)]
+    # TPU blocks must be (8,128)-tiled: place the 8 sums at row 0,
+    # cols 0..7 of a padded tile
+    row = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
+    padded = jnp.zeros((8, 128), jnp.float32)
+    for k, v in enumerate(sums):
+        padded = padded + jnp.where((row == 0) & (col == k), v, 0.0)
+    partial_o[:] = padded
+
+
+def make_run_rounds_pallas(p: SimParams, rounds: int,
+                           interpret: bool = False):
+    """Compiled hot loop using the fused Pallas round kernel.
+
+    Requires: no churn/slow-node injection (those configs use the XLA
+    paths) and n divisible by the block size."""
+    assert not (p.fail_per_round or p.leave_per_round
+                or p.rejoin_per_round or p.slow_per_round), \
+        "pallas path covers the stable-protocol configuration"
+    assert not p.collect_stats, \
+        "pallas path has no stats plumbing; use collect_stats=False"
+    n = p.n
+    block = ROWS_PER_BLOCK * LANES
+    assert n % block == 0, f"n={n} must be a multiple of {block}"
+    grid = n // block
+    rows = n // LANES
+
+    kernel = functools.partial(_round_kernel, p=p)
+
+    def row_spec(dtype=None):
+        return pl.BlockSpec((ROWS_PER_BLOCK, LANES),
+                            lambda i, *_: (i, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # scalars, seed, t
+        grid=(grid,),
+        in_specs=[row_spec() for _ in range(8)],
+        out_specs=[row_spec() for _ in range(8)]
+        + [pl.BlockSpec((8, 128), lambda i, *_: (i, 0))],
+    )
+
+    def one_round(args, scalars, seed, t):
+        (up, status, inc, informed, s_start, s_dead, s_conf, lh) = args
+        outs = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, LANES), up.dtype),
+                jax.ShapeDtypeStruct((rows, LANES), status.dtype),
+                jax.ShapeDtypeStruct((rows, LANES), inc.dtype),
+                jax.ShapeDtypeStruct((rows, LANES), informed.dtype),
+                jax.ShapeDtypeStruct((rows, LANES), s_start.dtype),
+                jax.ShapeDtypeStruct((rows, LANES), s_dead.dtype),
+                jax.ShapeDtypeStruct((rows, LANES), s_conf.dtype),
+                jax.ShapeDtypeStruct((rows, LANES), lh.dtype),
+                jax.ShapeDtypeStruct((grid * 8, 128), jnp.float32),
+            ],
+            interpret=interpret,
+        )(scalars, seed, t, up, status, inc, informed, s_start, s_dead,
+          s_conf, lh)
+        *state_out, partials = outs
+        sums = partials.reshape(grid, 8, 128)[:, 0, :N_SCALARS].sum(axis=0)
+        return tuple(state_out), sums
+
+    @jax.jit
+    def run(state: SimState, key: jax.Array) -> SimState:
+        scalars = init_scalars(state, p)
+        # clamp the tiny epsilons the XLA path uses
+        scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
+        seeds = jax.random.randint(key, (rounds,), 0, 2**31 - 1,
+                                   dtype=jnp.int32)
+
+        def to2d(x):
+            return x.reshape(rows, LANES)
+
+        args = (to2d(state.up.astype(jnp.int8)), to2d(state.status),
+                to2d(state.incarnation), to2d(state.informed),
+                to2d(state.susp_start), to2d(state.susp_deadline),
+                to2d(state.susp_conf), to2d(state.local_health))
+
+        def body(carry, x):
+            args, scalars, t = carry
+            seed = x
+            args2, partials = one_round(
+                args, scalars, seed[None], t[None])
+            partials = partials.at[1].max(1.0).at[2].max(1e-9) \
+                .at[7].max(1e-9)
+            return (args2, partials, t + p.probe_interval), None
+
+        (args, scalars, t_final), _ = jax.lax.scan(
+            body, (args, scalars, state.t), seeds)
+        (up, status, inc, informed, s_start, s_dead, s_conf, lh) = args
+        return SimState(
+            up=up.reshape(-1) != 0, down_time=state.down_time,
+            status=status.reshape(-1), incarnation=inc.reshape(-1),
+            informed=informed.reshape(-1),
+            susp_start=s_start.reshape(-1),
+            susp_deadline=s_dead.reshape(-1),
+            susp_conf=s_conf.reshape(-1),
+            local_health=lh.reshape(-1),
+            slow=state.slow, t=t_final,
+            round_idx=state.round_idx + rounds, stats=state.stats)
+
+    return run

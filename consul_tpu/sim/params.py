@@ -118,12 +118,12 @@ class SimParams:
 
     @staticmethod
     def from_gossip_config(cfg: GossipConfig, n: int, **kw) -> "SimParams":
+        kw.setdefault("tcp_fallback", not cfg.disable_tcp_pings)
         return SimParams(
             n=n,
             probe_interval=cfg.probe_interval,
             probe_timeout=cfg.probe_timeout,
             indirect_checks=cfg.indirect_checks,
-            tcp_fallback=not cfg.disable_tcp_pings,
             suspicion_mult=cfg.suspicion_mult,
             suspicion_max_timeout_mult=cfg.suspicion_max_timeout_mult,
             awareness_max=cfg.awareness_max_multiplier,

@@ -1,0 +1,50 @@
+"""Pallas-kernel round conformance (TPU only — skipped on the CPU mesh).
+
+The fused kernel must reproduce the reference round's aggregate FD
+dynamics; the PRNG-sign bug this guards against (int32 arithmetic-shift
+"uniforms") silently disabled the whole failure detector while leaving
+convergence-looking state intact.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from consul_tpu.sim import SimParams, init_state, run_rounds
+from consul_tpu.sim.state import DEAD, SUSPECT
+
+tpu_only = pytest.mark.skipif(
+    jax.devices()[0].platform not in ("tpu", "axon"),
+    reason="pallas kernel targets TPU; CPU suite runs the XLA paths")
+
+
+@tpu_only
+def test_pallas_matches_reference_dynamics():
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.30, tcp_fallback=False,
+                  collect_stats=False)
+    pal = make_run_rounds_pallas(p, 150)(init_state(n), jax.random.key(0))
+    ref, _ = run_rounds(init_state(n), jax.random.key(1), p, 150)
+    pal_susp = int(jnp.sum(pal.status == SUSPECT))
+    ref_susp = int(jnp.sum(ref.status == SUSPECT))
+    assert ref_susp > 0
+    assert 0.9 < pal_susp / ref_susp < 1.1
+    # refutation active: incarnations move in both engines
+    assert int(jnp.sum(pal.incarnation > 0)) > 0
+
+
+@tpu_only
+def test_pallas_crash_detection():
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.01, collect_stats=False)
+    s = init_state(n)
+    s = s._replace(up=s.up.at[7].set(False),
+                   down_time=s.down_time.at[7].set(0.0))
+    out = make_run_rounds_pallas(p, 60)(s, jax.random.key(2))
+    assert int(out.status[7]) == DEAD
+    assert int(jnp.sum(out.status == DEAD)) == 1  # no false positives
+    assert float(out.informed[7]) > 0.99
