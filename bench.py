@@ -23,7 +23,7 @@ def main() -> None:
 
     from consul_tpu.sim import (SimParams, init_state, make_run_rounds,
                                 make_mesh, make_sharded_run)
-    from consul_tpu.sim.round import make_run_rounds_fast  # noqa: F401
+    from consul_tpu.sim.round import make_run_rounds_fast
     from consul_tpu.sim.mesh import init_sharded_state
     from consul_tpu.config import GossipConfig
 
@@ -78,8 +78,6 @@ def main() -> None:
     # Every trial ends with a device->host VALUE fetch: block_until_ready
     # alone has proven unreliable through the tunnel, and a fetched
     # checksum makes each timing end-to-end honest.
-    import numpy as np
-
     best_dt, rounds = float("inf"), chunk * iters
     for trial in range(3):
         t0 = time.perf_counter()

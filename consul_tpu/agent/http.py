@@ -358,6 +358,14 @@ class HTTPApi:
                     base64.b64encode(body).decode() if body else None}, None
 
         # --------------------------------------------------------- connect
+        if (m := re.match(r"^/v1/agent/connect/proxy/(.+)$", path)):
+            from consul_tpu.connect.proxycfg import assemble_snapshot
+
+            snap = assemble_snapshot(
+                a, urllib.parse.unquote(m.group(1)), rpc=rpc)
+            if snap is None:
+                raise HTTPError(404, "unknown proxy service")
+            return snap, None
         if path == "/v1/connect/ca/roots" or \
                 path == "/v1/agent/connect/ca/roots":
             res = rpc("ConnectCA.Roots", blocking_args())

@@ -466,6 +466,28 @@ def cmd_tls(args) -> int:
     return 1
 
 
+def cmd_connect(args) -> int:
+    """`connect envoy -sidecar-for <id> -bootstrap`: print the Envoy
+    bootstrap config materialized from the proxy's config snapshot
+    (command/connect/envoy in the reference)."""
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    if not args.sidecar_for and not args.proxy_id:
+        print("Error: one of -sidecar-for or -proxy-id is required",
+              file=sys.stderr)
+        return 1
+    if not args.bootstrap:
+        print("Error: only -bootstrap mode is supported (this build "
+              "does not exec envoy)", file=sys.stderr)
+        return 1
+    c = _client(args)
+    proxy_id = args.proxy_id or f"{args.sidecar_for}-sidecar-proxy"
+    snap = c.get(f"/v1/agent/connect/proxy/{proxy_id}")
+    cfg = bootstrap_config(snap, admin_port=args.admin_port)
+    print(json.dumps(cfg, indent=2))
+    return 0
+
+
 def cmd_exec(args) -> int:
     """`consul exec <cmd>`: run a command on every agent with remote
     exec enabled (reference: command/exec over KV+events)."""
@@ -694,6 +716,16 @@ def build_parser() -> argparse.ArgumentParser:
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
+
+    cn = sub.add_parser("connect")
+    cnsub = cn.add_subparsers(dest="connect_cmd", required=True)
+    envoy = cnsub.add_parser("envoy")
+    envoy.add_argument("-sidecar-for", dest="sidecar_for", default="")
+    envoy.add_argument("-proxy-id", dest="proxy_id", default="")
+    envoy.add_argument("-bootstrap", action="store_true")
+    envoy.add_argument("-admin-bind-port", type=int, default=19000,
+                       dest="admin_port")
+    cn.set_defaults(fn=cmd_connect)
 
     tlsp = sub.add_parser("tls")
     tlssub = tlsp.add_subparsers(dest="tls_cmd", required=True)

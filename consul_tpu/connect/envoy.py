@@ -1,0 +1,153 @@
+"""Envoy bootstrap generation from a proxycfg snapshot.
+
+Reference: command/connect/envoy (generates bootstrap JSON, execs
+envoy). The reference's bootstrap points Envoy at the agent's xDS
+stream; ours materializes a fully STATIC config from the snapshot:
+a public mTLS listener terminating Connect TLS in front of the local
+service, and one listener+cluster per upstream (local bind → remote
+sidecars over mTLS). Intentions are enforced at the authorize seam
+and reflected here by omitting denied upstreams.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def bootstrap_config(snapshot: dict[str, Any],
+                     admin_port: int = 19000) -> dict[str, Any]:
+    leaf = snapshot["Leaf"]
+    roots_pem = "".join(r["RootCert"] for r in snapshot["Roots"])
+    tls_context = {
+        "common_tls_context": {
+            "tls_certificates": [{
+                "certificate_chain": {"inline_string": leaf["CertPEM"]},
+                "private_key": {"inline_string": leaf["PrivateKeyPEM"]},
+            }],
+            "validation_context": {
+                "trusted_ca": {"inline_string": roots_pem}},
+        },
+        "require_client_certificate": True,
+    }
+
+    def spiffe_principal(source: str) -> dict[str, Any]:
+        if source == "*":
+            return {"any": True}
+        suffix = f"/svc/{source}"
+        return {"authenticated": {"principal_name": {
+            "suffix": suffix}}}
+
+    def rbac_filter() -> Optional[dict[str, Any]]:
+        """Destination-side intention enforcement (xds rbac.go): the
+        mTLS handshake only proves mesh membership — the LISTENER must
+        enforce which SPIFFE identities may connect."""
+        intentions = snapshot.get("Intentions") or []
+        default_allow = snapshot.get("DefaultAllow", True)
+        allows = [i["SourceName"] for i in intentions
+                  if i.get("Action", "allow") == "allow"]
+        denies = [i["SourceName"] for i in intentions
+                  if i.get("Action") == "deny"]
+        if default_allow and not denies:
+            return None  # everything allowed; no filter needed
+        if default_allow:
+            action, sources = "DENY", denies
+        else:
+            action, sources = "ALLOW", allows
+        if not sources and action == "ALLOW":
+            sources = []  # allow nobody: empty policy set denies all
+        policies = {}
+        if sources:
+            policies["consul-intentions"] = {
+                "permissions": [{"any": True}],
+                "principals": [spiffe_principal(s) for s in sources]}
+        return {
+            "name": "envoy.filters.network.rbac",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "filters.network.rbac.v3.RBAC",
+                "stat_prefix": "connect_authz",
+                "rules": {"action": action, "policies": policies}}}
+
+    pub = snapshot["PublicListener"]
+    clusters = [{
+        "name": "local_app",
+        "type": "STATIC",
+        "connect_timeout": "5s",
+        "load_assignment": _endpoints("local_app", [{
+            "Address": pub["LocalServiceAddress"],
+            "Port": pub["LocalServicePort"]}]),
+    }]
+    listeners = [{
+        "name": "public_listener",
+        "address": _addr(pub["Address"], pub["Port"]),
+        "filter_chains": [{
+            "transport_socket": {
+                "name": "tls",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "transport_sockets.tls.v3.DownstreamTlsContext",
+                    **tls_context}},
+            "filters": ([f] if (f := rbac_filter()) else [])
+            + [_tcp_proxy("public_listener", "local_app")],
+        }],
+    }]
+
+    for up in snapshot["Upstreams"]:
+        if not up.get("Allowed", True):
+            continue  # intention-denied upstreams are not materialized
+        name = f"upstream_{up['DestinationName']}"
+        clusters.append({
+            "name": name,
+            "type": "STATIC",
+            "connect_timeout": "5s",
+            "transport_socket": {
+                "name": "tls",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "transport_sockets.tls.v3.UpstreamTlsContext",
+                    "common_tls_context":
+                        tls_context["common_tls_context"]}},
+            "load_assignment": _endpoints(name, up["Endpoints"]),
+        })
+        listeners.append({
+            "name": name,
+            "address": _addr("127.0.0.1", up["LocalBindPort"]),
+            "filter_chains": [{
+                "filters": [_tcp_proxy(name, name)]}],
+        })
+
+    return {
+        "admin": {"address": _addr("127.0.0.1", admin_port)},
+        "node": {"id": snapshot["ProxyID"],
+                 "cluster": snapshot["Service"],
+                 "metadata": {"namespace": "default",
+                              "trust_domain": snapshot["TrustDomain"]}},
+        "static_resources": {"listeners": listeners,
+                             "clusters": clusters},
+    }
+
+
+def _addr(host: str, port: int) -> dict[str, Any]:
+    return {"socket_address": {"address": host, "port_value": port}}
+
+
+def _tcp_proxy(stat_prefix: str, cluster: str) -> dict[str, Any]:
+    return {
+        "name": "envoy.filters.network.tcp_proxy",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters."
+                     "network.tcp_proxy.v3.TcpProxy",
+            "stat_prefix": stat_prefix,
+            "cluster": cluster,
+        },
+    }
+
+
+def _endpoints(cluster: str, eps: list[dict[str, Any]]) -> dict[str, Any]:
+    return {
+        "cluster_name": cluster,
+        "endpoints": [{
+            "lb_endpoints": [{
+                "endpoint": {"address": _addr(e["Address"], e["Port"])}}
+                for e in eps]}],
+    }

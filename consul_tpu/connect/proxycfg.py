@@ -1,0 +1,89 @@
+"""proxycfg-lite: assemble a proxy's full configuration snapshot.
+
+Reference: agent/proxycfg (22k LoC) subscribes a state machine to ~20
+data sources and fans them into a ConfigSnapshot consumed by the xDS
+server. This compact equivalent assembles the same core snapshot
+on demand: proxy registration + CA roots + leaf cert + upstream
+endpoint sets + intention decisions — enough to materialize a static
+Envoy bootstrap (connect/envoy.py) or drive any external proxy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def assemble_snapshot(agent, proxy_id: str,
+                      rpc=None) -> Optional[dict[str, Any]]:
+    """Build the ConfigSnapshot for a locally-registered connect proxy.
+
+    `rpc(method, args)` must carry the caller's auth token (the HTTP
+    layer passes its token-injecting closure); defaults to the agent's
+    own identity for in-process callers."""
+    rpc = rpc or agent.rpc
+    services = agent.local.list_services()
+    proxy = services.get(proxy_id)
+    if proxy is None or proxy.kind != "connect-proxy":
+        return None
+    dest_name = proxy.proxy.get("DestinationServiceName", "")
+    dest_id = proxy.proxy.get("DestinationServiceID", "")
+    dest = services.get(dest_id)
+
+    roots = rpc("ConnectCA.Roots", {})
+    leaf = rpc("ConnectCA.Sign", {"Service": dest_name})
+
+    upstreams = []
+    for u in proxy.proxy.get("Upstreams") or []:
+        uname = u.get("DestinationName", "")
+        error = ""
+        nodes = []
+        try:
+            eps = rpc("Health.ServiceNodes", {
+                "ServiceName": f"{uname}-sidecar-proxy",
+                "MustBePassing": True, "AllowStale": True})
+            nodes = eps.get("Nodes") or []
+            if not nodes:
+                # no sidecar instances: fall back to the service itself
+                eps = rpc("Health.ServiceNodes", {
+                    "ServiceName": uname, "MustBePassing": True,
+                    "AllowStale": True})
+                nodes = eps.get("Nodes") or []
+        except Exception as e:  # noqa: BLE001
+            # a degraded lookup must be VISIBLE, not an empty cluster
+            # that silently blackholes traffic
+            error = f"{type(e).__name__}: {e}"
+        check = rpc("Intention.Check", {
+            "SourceName": dest_name, "DestinationName": uname})
+        upstreams.append({
+            "DestinationName": uname,
+            "LocalBindPort": u.get("LocalBindPort", 0),
+            "Allowed": check.get("Allowed", False),
+            "Error": error,
+            "Endpoints": [{
+                "Address": e["Service"]["Address"]
+                or e["Node"]["Address"],
+                "Port": e["Service"]["Port"]} for e in nodes],
+        })
+
+    matches = rpc("Intention.Match", {"DestinationName": dest_name})
+    default_allow = not agent.config.acl_enabled \
+        or agent.config.acl_default_policy == "allow"
+    return {
+        "ProxyID": proxy_id,
+        "Intentions": matches.get("Matches", []),
+        "DefaultAllow": default_allow,
+        "Kind": "connect-proxy",
+        "Service": dest_name,
+        "Proxy": proxy.proxy,
+        "PublicListener": {
+            "Address": proxy.address or agent.advertise_addr(),
+            "Port": proxy.port,
+            "LocalServiceAddress": "127.0.0.1",
+            "LocalServicePort": proxy.proxy.get(
+                "LocalServicePort", dest.port if dest else 0),
+        },
+        "Roots": roots.get("Roots", []),
+        "TrustDomain": roots.get("TrustDomain", ""),
+        "Leaf": leaf,
+        "Upstreams": upstreams,
+    }

@@ -26,7 +26,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import N_SCALARS, init_scalars, _shrink
-from consul_tpu.sim.state import ALIVE, DEAD, LEFT, SUSPECT, SimState
+from consul_tpu.sim.state import ALIVE, DEAD, SUSPECT, SimState
 
 INF = 3.4e38  # python float: jnp constants can't be captured by kernels
 
@@ -210,7 +210,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
     kernel = functools.partial(_round_kernel, p=p)
 
-    def row_spec(dtype=None):
+    def row_spec():
         return pl.BlockSpec((ROWS_PER_BLOCK, LANES),
                             lambda i, *_: (i, 0))
 

@@ -159,3 +159,93 @@ def test_sidecar_service_expansion(agent, client):
     # flows to the catalog with the proxy kind
     wait_for(lambda: client.catalog_service("payments-sidecar-proxy"),
              what="sidecar in catalog")
+
+
+def test_proxy_config_snapshot_and_envoy_bootstrap(agent, client):
+    # mesh topology: api -> db, with an intention allowing it
+    client.service_register({
+        "Name": "db2", "ID": "db2", "Port": 5433,
+        "Check": {"TTL": "60s"},
+        "Connect": {"SidecarService": {}}})
+    client.service_register({
+        "Name": "api2", "ID": "api2", "Port": 9500,
+        "Connect": {"SidecarService": {
+            "Proxy": {"Upstreams": [
+                {"DestinationName": "db2", "LocalBindPort": 9191},
+                {"DestinationName": "forbidden", "LocalBindPort": 9192},
+            ]}}}})
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "api2", "DestinationName": "db2",
+        "Action": "allow"})
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "*", "DestinationName": "forbidden",
+        "Action": "deny"})
+    client.check_pass("service:db2")
+    wait_for(lambda: client.health_service("db2-sidecar-proxy"),
+             what="db2 sidecar in catalog")
+
+    snap = client.get("/v1/agent/connect/proxy/api2-sidecar-proxy")
+    assert snap["Service"] == "api2"
+    assert snap["Leaf"]["ServiceURI"].endswith("/svc/api2")
+    assert snap["Roots"]
+    ups = {u["DestinationName"]: u for u in snap["Upstreams"]}
+    assert ups["db2"]["Allowed"] is True
+    assert ups["db2"]["Endpoints"], "db2 sidecar endpoints expected"
+    assert ups["forbidden"]["Allowed"] is False
+
+    # bootstrap materialization
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    cfg = bootstrap_config(snap)
+    names = {c["name"] for c in cfg["static_resources"]["clusters"]}
+    assert "local_app" in names and "upstream_db2" in names
+    assert "upstream_forbidden" not in names  # intention-denied
+    listeners = {l["name"] for l in
+                 cfg["static_resources"]["listeners"]}
+    assert "public_listener" in listeners and "upstream_db2" in listeners
+    # the public listener terminates mTLS with the leaf
+    pl = next(l for l in cfg["static_resources"]["listeners"]
+              if l["name"] == "public_listener")
+    tls = pl["filter_chains"][0]["transport_socket"]["typed_config"]
+    assert "BEGIN CERTIFICATE" in \
+        tls["common_tls_context"]["tls_certificates"][0][
+            "certificate_chain"]["inline_string"]
+    assert tls["require_client_certificate"] is True
+
+
+def test_bootstrap_rbac_enforces_intentions(agent, client):
+    """The public listener must carry destination-side RBAC — mTLS alone
+    only proves mesh membership, not authorization."""
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    # default-allow + a deny intention → DENY-action filter naming it
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "cron", "DestinationName": "db2",
+        "Action": "deny"})
+    snap = client.get("/v1/agent/connect/proxy/db2-sidecar-proxy")
+    assert any(i["DestinationName"] == "db2" for i in snap["Intentions"])
+    cfg = bootstrap_config(snap)
+    pl = next(l for l in cfg["static_resources"]["listeners"]
+              if l["name"] == "public_listener")
+    filters = pl["filter_chains"][0]["filters"]
+    assert filters[0]["name"] == "envoy.filters.network.rbac"
+    rules = filters[0]["typed_config"]["rules"]
+    assert rules["action"] == "DENY"
+    principal = rules["policies"]["consul-intentions"]["principals"][0]
+    assert principal["authenticated"]["principal_name"]["suffix"] \
+        == "/svc/cron"
+    assert filters[-1]["name"] == "envoy.filters.network.tcp_proxy"
+
+    # default-DENY world: only explicit allows pass (ALLOW action)
+    snap2 = dict(snap)
+    snap2["DefaultAllow"] = False
+    snap2["Intentions"] = [{"SourceName": "api2",
+                            "DestinationName": "db2",
+                            "Action": "allow"}]
+    cfg2 = bootstrap_config(snap2)
+    pl2 = next(l for l in cfg2["static_resources"]["listeners"]
+               if l["name"] == "public_listener")
+    rules2 = pl2["filter_chains"][0]["filters"][0]["typed_config"]["rules"]
+    assert rules2["action"] == "ALLOW"
+    assert rules2["policies"]["consul-intentions"]["principals"][0][
+        "authenticated"]["principal_name"]["suffix"] == "/svc/api2"
