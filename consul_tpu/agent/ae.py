@@ -81,11 +81,11 @@ class StateSyncer:
         a = self.agent
         node = a.name
         # what the catalog currently has for this node
-        res = a.rpc("Catalog.NodeServices", {"Node": node,
-                                             "AllowStale": False})
+        res = a.agent_rpc("Catalog.NodeServices",
+                          {"Node": node, "AllowStale": False})
         remote = res.get("NodeServices") or {}
         remote_services = set((remote.get("Services") or {}).keys())
-        res = a.rpc("Health.NodeChecks", {"Node": node})
+        res = a.agent_rpc("Health.NodeChecks", {"Node": node})
         remote_checks = {c["CheckID"]: c
                          for c in res.get("HealthChecks") or []}
 
@@ -108,7 +108,7 @@ class StateSyncer:
                         or rc.get("Output") != cd["Output"]:
                     dirty = True
             if dirty:
-                a.rpc("Catalog.Register", {
+                a.agent_rpc("Catalog.Register", {
                     **base, "Service": svc.to_service_dict(),
                     "Checks": svc_checks})
                 svc.in_sync = True
@@ -123,13 +123,15 @@ class StateSyncer:
             if not chk.in_sync or rc is None \
                     or rc.get("Status") != chk.status.value \
                     or rc.get("Output") != chk.output:
-                a.rpc("Catalog.Register",
-                      {**base, "Check": chk.to_check_dict()})
+                a.agent_rpc("Catalog.Register",
+                            {**base, "Check": chk.to_check_dict()})
                 chk.in_sync = True
         # deregister remote extras this agent no longer has
         for sid in remote_services - set(local_services):
-            a.rpc("Catalog.Deregister", {"Node": node, "ServiceID": sid})
+            a.agent_rpc("Catalog.Deregister",
+                        {"Node": node, "ServiceID": sid})
         for cid in set(remote_checks) - set(local_checks):
             if cid == "serfHealth":
                 continue  # owned by the leader reconcile loop
-            a.rpc("Catalog.Deregister", {"Node": node, "CheckID": cid})
+            a.agent_rpc("Catalog.Deregister",
+                        {"Node": node, "CheckID": cid})

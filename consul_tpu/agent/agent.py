@@ -168,9 +168,21 @@ class Agent:
         (agent/agent.go delegate seam). `src` distinguishes the agent's
         own control loops ("local", never rate-limited) from external
         client traffic relayed by the HTTP layer ("http")."""
+        if self.config.acl_default_token and "AuthToken" not in args:
+            # acl.tokens.default backs requests that arrive WITHOUT a
+            # token (DNS); deliberately NOT the agent token — DNS must
+            # never escalate to the agent's own privileges
+            args = {**args, "AuthToken": self.config.acl_default_token}
         if self.server is not None:
             return self.server.handle_rpc(method, args, src)
         return self.client.rpc(method, args)
+
+    def agent_rpc(self, method: str, args: dict[str, Any]) -> Any:
+        """The agent's OWN operations (anti-entropy, coordinate pushes)
+        authenticate with acl.tokens.agent."""
+        if self.config.acl_agent_token:
+            args = {**args, "AuthToken": self.config.acl_agent_token}
+        return self.rpc(method, args)
 
     def cached_rpc(self, method: str, args: dict[str, Any],
                    ttl: float = 3.0) -> Any:
@@ -378,7 +390,7 @@ class Agent:
             if self._shutdown:
                 return
             try:
-                self.rpc("Coordinate.Update", {
+                self.agent_rpc("Coordinate.Update", {
                     "Node": self.name,
                     "Coord": self.serf.coord_client.get().to_dict()})
             except Exception as e:  # noqa: BLE001

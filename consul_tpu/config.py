@@ -165,6 +165,8 @@ class RuntimeConfig:
     acl_default_policy: str = "allow"
     acl_down_policy: str = "extend-cache"
     acl_initial_management_token: str = ""
+    acl_agent_token: str = ""    # the agent's OWN operations (AE sync)
+    acl_default_token: str = ""  # requests arriving without a token (DNS)
     acl_token_ttl: float = 30.0
 
     # DNS
@@ -343,9 +345,14 @@ def load(
                      ("token_ttl", "acl_token_ttl")):
         if src in acl:
             kwargs[tgt] = acl[src]
-    if "initial_management" in acl.get("tokens", {}):
+    tokens = acl.get("tokens", {})
+    if "initial_management" in tokens:
         kwargs["acl_initial_management_token"] = \
-            acl["tokens"]["initial_management"]
+            tokens["initial_management"]
+    if "agent" in tokens:
+        kwargs["acl_agent_token"] = tokens["agent"]
+    if "default" in tokens:
+        kwargs["acl_default_token"] = tokens["default"]
 
     if dev:
         kwargs.setdefault("server_mode", True)

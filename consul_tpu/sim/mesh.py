@@ -51,20 +51,28 @@ def state_sharding(mesh: Mesh) -> SimState:
         stats=SimStats(*[rep] * len(SimStats._fields)))
 
 
-def make_sharded_run(p: SimParams, rounds: int, mesh: Mesh):
-    """Compiled multi-device runner: (sharded state, key) -> sharded state."""
+def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
+                   reduce_axes) -> "jax.stages.Wrapped":
+    """One factory for both mesh runners: `reduce_axes` scopes the
+    population coupling — ("dc","nodes") = one global pool,
+    ("nodes",) = independent per-DC pools."""
+    if p.collect_stats and tuple(reduce_axes) != AXES:
+        # stats out-specs are replicated; axis-scoped psums would leave
+        # per-DC partial counters masquerading as global totals
+        raise ValueError(
+            "per-DC pools cannot carry global stats counters; build "
+            "SimParams with collect_stats=False")
     shardings = state_sharding(mesh)
     specs = jax.tree.map(lambda s: s.spec, shardings,
                          is_leaf=lambda x: isinstance(x, NamedSharding))
 
     def psum_reduce(x: jnp.ndarray) -> jnp.ndarray:
-        return jax.lax.psum(jnp.sum(x), AXES)
+        return jax.lax.psum(jnp.sum(x), reduce_axes)
 
     def shard_body(state: SimState, keys: jax.Array) -> SimState:
-        # Per-shard independent RNG streams; stats accumulate shard-locally
-        # from zero via the plain-sum reducer is wrong — with the psum
-        # reducer every shard holds identical (already-global) totals, so
-        # the carried-in totals stay exact across rounds.
+        # per-shard independent RNG streams; with the psum reducer every
+        # shard (within the reduced axes) holds identical totals, so
+        # carried-in stats stay exact across rounds
         shard = (jax.lax.axis_index("dc") * jax.lax.psum(1, "nodes")
                  + jax.lax.axis_index("nodes"))
 
@@ -84,6 +92,20 @@ def make_sharded_run(p: SimParams, rounds: int, mesh: Mesh):
         return mapped(state, jax.random.split(key, rounds))
 
     return run
+
+
+def make_sharded_run(p: SimParams, rounds: int, mesh: Mesh):
+    """Compiled multi-device runner over ONE global pool."""
+    return _make_mesh_run(p, rounds, mesh, AXES)
+
+
+def make_multidc_run(p: SimParams, rounds: int, mesh: Mesh):
+    """Per-DC independent LAN pools on the mesh's "dc" axis.
+
+    The reference's datacenters are ISOLATED LAN gossip pools
+    (SURVEY.md §2.4): population scalars psum over "nodes" ONLY, so
+    pools never couple. p.n is the PER-DC pool size."""
+    return _make_mesh_run(p, rounds, mesh, ("nodes",))
 
 
 def init_sharded_state(n: int, mesh: Mesh) -> SimState:

@@ -156,3 +156,34 @@ def test_token_lifecycle(acl_agent, root):
     assert root.delete(f"/v1/acl/token/{acc}") is True
     with pytest.raises(APIError):
         root.get(f"/v1/acl/token/{acc}")
+
+
+def test_agent_token_authenticates_anti_entropy():
+    """With deny-policy ACLs, the agent's own sync loops authenticate
+    with acl.tokens.agent (otherwise anti-entropy is anonymously
+    denied and local services never reach the catalog)."""
+    cfg = load(dev=True, overrides={
+        "node_name": "ae-agent",
+        "acl": {"enabled": True, "default_policy": "deny",
+                "tokens": {"initial_management": "root-ae",
+                           "agent": "root-ae"}}})
+    a = Agent(cfg)
+    a.start(serve_dns=False)
+    try:
+        t0 = time.time()
+        while time.time() - t0 < 15 and not (
+                a.server.is_leader() and a.server.state.raw_get(
+                    "acl_tokens", "root-ae")):
+            time.sleep(0.1)
+        root = ConsulClient(a.http.addr, token="root-ae")
+        root.service_register({"Name": "secured", "ID": "sec1",
+                               "Port": 7777})
+        t0 = time.time()
+        while time.time() - t0 < 15:
+            if root.catalog_service("secured"):
+                break
+            time.sleep(0.2)
+        assert root.catalog_service("secured"), \
+            "anti-entropy must push with the agent token"
+    finally:
+        a.shutdown()

@@ -65,3 +65,30 @@ def test_sharded_state_round_trips(devices8):
     assert host.up.shape == (p.n,)
     assert bool(np.all(host.up))
     assert int(host.round_idx) == 3
+
+
+def test_multidc_pools_are_isolated(devices8):
+    """The dc axis = independent LAN pools: crashes in DC0 are detected
+    by DC0's own mean-field pool and leave other DCs untouched."""
+    from consul_tpu.sim import make_mesh, make_multidc_run
+    from consul_tpu.sim.mesh import init_sharded_state
+    from consul_tpu.sim.state import DEAD
+
+    p = SimParams(n=512, collect_stats=False)  # per-DC pool size
+    mesh = make_mesh(devices8, dc=2)
+    total = p.n * 2  # global rows across the dc axis
+    state = init_sharded_state(total, mesh)
+    # crash 5 nodes in DC0's half only
+    import jax.numpy as jnp
+
+    kill = jnp.arange(5)
+    state = state._replace(
+        up=state.up.at[kill].set(False),
+        down_time=state.down_time.at[kill].set(0.0))
+    run = make_multidc_run(p, rounds=60, mesh=mesh)
+    out = run(state, jax.random.key(0))
+    host = jax.device_get(out)
+    dc0, dc1 = host.status[:p.n], host.status[p.n:]
+    assert int((dc0 == DEAD).sum()) == 5, "DC0 detects its crashes"
+    assert int((dc1 == DEAD).sum()) == 0, "DC1 pool undisturbed"
+    assert bool(host.up[p.n:].all())
