@@ -438,6 +438,46 @@ def _write_pem(path: str, data: str, private: bool = False) -> None:
         f.write(data)
 
 
+def cmd_debug(args) -> int:
+    """Capture a diagnostic bundle (command/debug): self/members/
+    metrics/raft config/log window into a gzip tar. Every capture is
+    best-effort — a partial bundle always beats no bundle."""
+    import time as _t
+
+    from consul_tpu.server.snapshot import tar_gz
+
+    c = _client(args)
+    # the agent caps the monitor window at 10s; record the EFFECTIVE one
+    duration = min(args.duration, 10.0)
+
+    def capture(fn):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            return {"error": str(e)}
+
+    captures = {
+        "self.json": capture(c.agent_self),
+        "members.json": capture(c.agent_members),
+        "metrics.json": capture(lambda: c.get("/v1/agent/metrics")),
+        "raft.json": capture(c.raft_configuration),
+        "host.json": {"CollectedAt": _t.strftime("%Y-%m-%dT%H:%M:%S"),
+                      "Duration": duration},
+        "consul.log": capture(lambda: c.get(
+            "/v1/agent/monitor", duration=f"{duration}s") or b""),
+    }
+    files = {}
+    for name, data in captures.items():
+        files[name] = data if isinstance(data, bytes) else (
+            data if isinstance(data, str)
+            else json.dumps(data, indent=2)).encode()
+    out = args.output or f"consul-debug-{int(_t.time())}.tar.gz"
+    with open(out, "wb") as f:
+        f.write(tar_gz(files))
+    print(f"Saved debug archive: {out}")
+    return 0
+
+
 def cmd_tls(args) -> int:
     from consul_tpu.utils.tlsutil import create_ca, create_cert
 
@@ -716,6 +756,11 @@ def build_parser() -> argparse.ArgumentParser:
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
+
+    dbg = sub.add_parser("debug")
+    dbg.add_argument("-duration", type=float, default=2.0)
+    dbg.add_argument("-output", default=None)
+    dbg.set_defaults(fn=cmd_debug)
 
     cn = sub.add_parser("connect")
     cnsub = cn.add_subparsers(dest="connect_cmd", required=True)

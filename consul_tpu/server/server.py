@@ -179,6 +179,7 @@ class Server:
         self._every(1.0, self._leader_tick)
         self._every(self.config.reconcile_interval, self._full_reconcile)
         self._every(self.config.coordinate_update_period, self._flush_coords)
+        self._every(10.0, self._usage_metrics)
         self.log.info("server started: rpc=%s serf=%s", self.rpc.addr,
                       self.serf.memberlist.transport.addr)
 
@@ -508,6 +509,17 @@ class Server:
                 self.raft.apply(encode_command(MessageType.SESSION, {
                     "Op": "destroy", "Session": sess.id}))
                 self._session_expiry.pop(sess.id, None)
+
+    def _usage_metrics(self) -> None:
+        """Periodic usage gauges (agent/consul/usagemetrics)."""
+        counts = self.state.usage_counts()
+        self.metrics.gauge("state.nodes", counts["nodes"])
+        self.metrics.gauge("state.services", counts["services"])
+        self.metrics.gauge("state.checks", counts["checks"])
+        self.metrics.gauge("state.kv_entries", counts["kv"])
+        self.metrics.gauge("state.sessions", counts["sessions"])
+        self.metrics.gauge("raft.applied_index", self.raft.last_applied)
+        self.metrics.gauge("serf.lan.members", len(self.serf.members()))
 
     def _ensure_initial_management_token(self) -> None:
         tok = self.config.acl_initial_management_token

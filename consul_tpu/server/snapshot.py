@@ -17,6 +17,19 @@ import time
 from typing import Any
 
 
+def tar_gz(files: dict[str, bytes]) -> bytes:
+    """In-memory gzip tar of name→bytes (shared by snapshots and the
+    debug bundle)."""
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb") as gz:
+        with tarfile.open(fileobj=gz, mode="w|") as tar:
+            for name, data in files.items():
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
 def write_archive(state_blob: bytes, index: int, term: int,
                   version: str) -> bytes:
     meta = json.dumps({
@@ -26,16 +39,8 @@ def write_archive(state_blob: bytes, index: int, term: int,
     sums = (f"{hashlib.sha256(meta).hexdigest()}  metadata.json\n"
             f"{hashlib.sha256(state_blob).hexdigest()}  state.bin\n"
             ).encode()
-    buf = io.BytesIO()
-    with gzip.GzipFile(fileobj=buf, mode="wb") as gz:
-        with tarfile.open(fileobj=gz, mode="w|") as tar:
-            for name, data in (("metadata.json", meta),
-                               ("state.bin", state_blob),
-                               ("SHA256SUMS", sums)):
-                info = tarfile.TarInfo(name)
-                info.size = len(data)
-                tar.addfile(info, io.BytesIO(data))
-    return buf.getvalue()
+    return tar_gz({"metadata.json": meta, "state.bin": state_blob,
+                   "SHA256SUMS": sums})
 
 
 def read_archive(raw: bytes) -> tuple[dict[str, Any], bytes]:

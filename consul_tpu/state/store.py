@@ -417,6 +417,11 @@ class StateStore:
         with self._lock:
             return self.tables["coordinates"].get(node)
 
+    def usage_counts(self) -> dict[str, int]:
+        """Table sizes for usage gauges (agent/consul/usagemetrics)."""
+        with self._lock:
+            return {t: len(self.tables[t]) for t in TABLES}
+
     # ------------------------------------------------------------ raw tables
 
     def raw_upsert(self, table: str, key: Any, value: Any) -> int:
