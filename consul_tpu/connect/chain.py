@@ -1,0 +1,71 @@
+"""Discovery chain (lite): compile resolver/splitter config entries
+into an upstream resolution plan.
+
+Reference: agent/consul/discoverychain (~8k LoC) compiles
+service-resolver / service-splitter / service-router config entries
+into a routing DAG for xDS. This compact equivalent handles the two
+load-bearing kinds:
+
+  service-resolver: {"Kind": "service-resolver", "Name": "db",
+                     "Redirect": {"Service": "db-v2"},
+                     "Failover": {"*": {"Service": "db-backup"}}}
+  service-splitter: {"Kind": "service-splitter", "Name": "api",
+                     "Splits": [{"Weight": 90, "Service": "api"},
+                                {"Weight": 10, "Service": "api-canary"}]}
+
+`compile_targets` resolves a service name through redirect chains and
+splits into weighted concrete targets, each with an optional failover
+service — the shape proxycfg feeds into Envoy weighted clusters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+MAX_HOPS = 8  # redirect-loop guard (the reference also bounds chains)
+
+
+def compile_targets(name: str,
+                    get_entry: Callable[[str, str], Optional[dict]],
+                    ) -> list[dict[str, Any]]:
+    """Resolve `name` through splitters and resolver redirects.
+
+    Returns [{"Service", "Weight", "Failover"}] with weights summing to
+    100 (single target → weight 100).
+    """
+    splitter = get_entry("service-splitter", name)
+    if splitter is not None:
+        out = []
+        for split in splitter.get("Splits") or []:
+            svc = split.get("Service", name)
+            # a split target resolves through ITS resolver (but further
+            # splitters don't nest, matching the reference)
+            resolved = _resolve(svc, get_entry)
+            out.append({**resolved,
+                        "Weight": float(split.get("Weight", 0))})
+        total = sum(t["Weight"] for t in out) or 1.0
+        for t in out:
+            t["Weight"] = round(t["Weight"] * 100.0 / total, 2)
+        return out
+    return [{**_resolve(name, get_entry), "Weight": 100.0}]
+
+
+def _resolve(name: str,
+             get_entry: Callable[[str, str], Optional[dict]],
+             ) -> dict[str, Any]:
+    seen = []
+    for _ in range(MAX_HOPS):
+        resolver = get_entry("service-resolver", name)
+        if resolver is None:
+            break
+        redirect = (resolver.get("Redirect") or {}).get("Service")
+        if redirect and redirect != name:
+            if redirect in seen:
+                break  # loop guard
+            seen.append(name)
+            name = redirect
+            continue
+        failover = ((resolver.get("Failover") or {}).get("*") or {}) \
+            .get("Service")
+        return {"Service": name, "Failover": failover}
+    return {"Service": name, "Failover": None}

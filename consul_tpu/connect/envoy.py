@@ -96,24 +96,44 @@ def bootstrap_config(snapshot: dict[str, Any],
         if not up.get("Allowed", True):
             continue  # intention-denied upstreams are not materialized
         name = f"upstream_{up['DestinationName']}"
-        clusters.append({
-            "name": name,
-            "type": "STATIC",
-            "connect_timeout": "5s",
-            "transport_socket": {
-                "name": "tls",
+        targets = up.get("Targets") or [
+            {"Service": up["DestinationName"], "Weight": 100.0,
+             "Endpoints": up.get("Endpoints", [])}]
+        upstream_tls = {
+            "name": "tls",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "transport_sockets.tls.v3.UpstreamTlsContext",
+                "common_tls_context":
+                    tls_context["common_tls_context"]}}
+        for t in targets:
+            clusters.append({
+                "name": f"{name}_{t['Service']}",
+                "type": "STATIC",
+                "connect_timeout": "5s",
+                "transport_socket": upstream_tls,
+                "load_assignment": _endpoints(
+                    f"{name}_{t['Service']}", t.get("Endpoints", [])),
+            })
+        if len(targets) == 1:
+            filt = _tcp_proxy(name, f"{name}_{targets[0]['Service']}")
+        else:
+            # discovery-chain splits → weighted clusters
+            filt = {
+                "name": "envoy.filters.network.tcp_proxy",
                 "typed_config": {
                     "@type": "type.googleapis.com/envoy.extensions."
-                             "transport_sockets.tls.v3.UpstreamTlsContext",
-                    "common_tls_context":
-                        tls_context["common_tls_context"]}},
-            "load_assignment": _endpoints(name, up["Endpoints"]),
-        })
+                             "filters.network.tcp_proxy.v3.TcpProxy",
+                    "stat_prefix": name,
+                    "weighted_clusters": {"clusters": [
+                        {"name": f"{name}_{t['Service']}",
+                         "weight": int(round(t["Weight"]))}
+                        for t in targets]},
+                }}
         listeners.append({
             "name": name,
             "address": _addr("127.0.0.1", up["LocalBindPort"]),
-            "filter_chains": [{
-                "filters": [_tcp_proxy(name, name)]}],
+            "filter_chains": [{"filters": [filt]}],
         })
 
     return {

@@ -29,25 +29,48 @@ def assemble_snapshot(agent, proxy_id: str,
     dest_id = proxy.proxy.get("DestinationServiceID", "")
     dest = services.get(dest_id)
 
-    roots = rpc("ConnectCA.Roots", {})
+    # sign FIRST: it initializes the CA on first use, so the roots
+    # read below is never empty on a fresh cluster
     leaf = rpc("ConnectCA.Sign", {"Service": dest_name})
+    roots = rpc("ConnectCA.Roots", {})
+
+    from consul_tpu.connect.chain import compile_targets
+
+    def get_entry(kind: str, name: str):
+        try:
+            res = rpc("ConfigEntry.Get", {"Kind": kind, "Name": name,
+                                          "AllowStale": True})
+            return res.get("Entry")
+        except Exception:  # noqa: BLE001
+            return None
+
+    def lookup_endpoints(svc: str):
+        eps = rpc("Health.ServiceNodes", {
+            "ServiceName": f"{svc}-sidecar-proxy",
+            "MustBePassing": True, "AllowStale": True})
+        nodes = eps.get("Nodes") or []
+        if not nodes:
+            # no sidecar instances: fall back to the service itself
+            eps = rpc("Health.ServiceNodes", {
+                "ServiceName": svc, "MustBePassing": True,
+                "AllowStale": True})
+            nodes = eps.get("Nodes") or []
+        return [{"Address": e["Service"]["Address"]
+                 or e["Node"]["Address"],
+                 "Port": e["Service"]["Port"]} for e in nodes]
 
     upstreams = []
     for u in proxy.proxy.get("Upstreams") or []:
         uname = u.get("DestinationName", "")
         error = ""
-        nodes = []
+        # discovery chain: resolver redirects + splitter weights
+        targets = compile_targets(uname, get_entry)
         try:
-            eps = rpc("Health.ServiceNodes", {
-                "ServiceName": f"{uname}-sidecar-proxy",
-                "MustBePassing": True, "AllowStale": True})
-            nodes = eps.get("Nodes") or []
-            if not nodes:
-                # no sidecar instances: fall back to the service itself
-                eps = rpc("Health.ServiceNodes", {
-                    "ServiceName": uname, "MustBePassing": True,
-                    "AllowStale": True})
-                nodes = eps.get("Nodes") or []
+            for t in targets:
+                t["Endpoints"] = lookup_endpoints(t["Service"])
+                if not t["Endpoints"] and t.get("Failover"):
+                    t["Endpoints"] = lookup_endpoints(t["Failover"])
+                    t["UsingFailover"] = bool(t["Endpoints"])
         except Exception as e:  # noqa: BLE001
             # a degraded lookup must be VISIBLE, not an empty cluster
             # that silently blackholes traffic
@@ -59,10 +82,10 @@ def assemble_snapshot(agent, proxy_id: str,
             "LocalBindPort": u.get("LocalBindPort", 0),
             "Allowed": check.get("Allowed", False),
             "Error": error,
-            "Endpoints": [{
-                "Address": e["Service"]["Address"]
-                or e["Node"]["Address"],
-                "Port": e["Service"]["Port"]} for e in nodes],
+            "Targets": targets,
+            # flattened view (back-compat for single-target consumers)
+            "Endpoints": [e for t in targets
+                          for e in t.get("Endpoints", [])],
         })
 
     matches = rpc("Intention.Match", {"DestinationName": dest_name})

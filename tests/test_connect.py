@@ -198,8 +198,9 @@ def test_proxy_config_snapshot_and_envoy_bootstrap(agent, client):
 
     cfg = bootstrap_config(snap)
     names = {c["name"] for c in cfg["static_resources"]["clusters"]}
-    assert "local_app" in names and "upstream_db2" in names
-    assert "upstream_forbidden" not in names  # intention-denied
+    assert "local_app" in names and "upstream_db2_db2" in names
+    assert not any(n.startswith("upstream_forbidden")
+                   for n in names)  # intention-denied
     listeners = {l["name"] for l in
                  cfg["static_resources"]["listeners"]}
     assert "public_listener" in listeners and "upstream_db2" in listeners
@@ -249,3 +250,65 @@ def test_bootstrap_rbac_enforces_intentions(agent, client):
     assert rules2["action"] == "ALLOW"
     assert rules2["policies"]["consul-intentions"]["principals"][0][
         "authenticated"]["principal_name"]["suffix"] == "/svc/api2"
+
+
+def test_discovery_chain_compile_unit():
+    from consul_tpu.connect.chain import compile_targets
+
+    entries = {
+        ("service-resolver", "db"): {"Redirect": {"Service": "db-v2"}},
+        ("service-resolver", "db-v2"): {
+            "Failover": {"*": {"Service": "db-backup"}}},
+        ("service-splitter", "api"): {"Splits": [
+            {"Weight": 90, "Service": "api"},
+            {"Weight": 10, "Service": "api-canary"}]},
+        # redirect loop must not hang
+        ("service-resolver", "loop-a"): {"Redirect": {"Service": "loop-b"}},
+        ("service-resolver", "loop-b"): {"Redirect": {"Service": "loop-a"}},
+    }
+    get = lambda kind, name: entries.get((kind, name))
+    t = compile_targets("db", get)
+    assert t == [{"Service": "db-v2", "Failover": "db-backup",
+                  "Weight": 100.0}]
+    t = compile_targets("api", get)
+    assert [(x["Service"], x["Weight"]) for x in t] == \
+        [("api", 90.0), ("api-canary", 10.0)]
+    t = compile_targets("loop-a", get)  # bounded, no hang
+    assert len(t) == 1
+    t = compile_targets("plain", get)
+    assert t == [{"Service": "plain", "Failover": None, "Weight": 100.0}]
+
+
+def test_discovery_chain_in_proxy_snapshot(agent, client):
+    # canary split for db2 + a new canary instance
+    client.service_register({
+        "Name": "db2-canary", "ID": "db2c", "Port": 5533,
+        "Check": {"TTL": "60s"}, "Connect": {"SidecarService": {}}})
+    client.check_pass("service:db2c")
+    client.put("/v1/config", body={
+        "Kind": "service-splitter", "Name": "db2",
+        "Splits": [{"Weight": 75, "Service": "db2"},
+                   {"Weight": 25, "Service": "db2-canary"}]})
+    wait_for(lambda: client.health_service("db2-canary-sidecar-proxy"),
+             what="canary sidecar")
+    snap = client.get("/v1/agent/connect/proxy/api2-sidecar-proxy")
+    up = next(u for u in snap["Upstreams"]
+              if u["DestinationName"] == "db2")
+    assert [(t["Service"], t["Weight"]) for t in up["Targets"]] == \
+        [("db2", 75.0), ("db2-canary", 25.0)]
+    assert all(t["Endpoints"] for t in up["Targets"])
+
+    # envoy materialization: weighted clusters
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    cfg = bootstrap_config(snap)
+    names = {c["name"] for c in cfg["static_resources"]["clusters"]}
+    assert {"upstream_db2_db2", "upstream_db2_db2-canary"} <= names
+    lst = next(l for l in cfg["static_resources"]["listeners"]
+               if l["name"] == "upstream_db2")
+    wc = lst["filter_chains"][0]["filters"][0]["typed_config"][
+        "weighted_clusters"]["clusters"]
+    assert {(c["name"], c["weight"]) for c in wc} == \
+        {("upstream_db2_db2", 75), ("upstream_db2_db2-canary", 25)}
+    # cleanup the splitter so other tests see plain resolution
+    client.delete("/v1/config/service-splitter/db2")
