@@ -29,7 +29,13 @@ def cluster():
         cfg = load(dev=True, overrides={
             "node_name": f"srv{i}", "bootstrap": False,
             "bootstrap_expect": 3, "server": True})
-        s = Server(cfg)
+        # under full-suite socket churn an ephemeral bind occasionally
+        # collides; one retry removes the flake
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
         s.start()
         servers.append(s)
     for s in servers[1:]:
