@@ -150,10 +150,6 @@ class UDPTransport(Transport):
 
     def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0) -> None:
         self.log = log.named("memberlist.transport")
-        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._udp.bind((bind_addr, port))
-        port = self._udp.getsockname()[1]
-
         self._on_packet: Optional[PacketHandler] = None
         self._on_stream: Optional[StreamHandler] = None
         outer = self
@@ -175,8 +171,22 @@ class UDPTransport(Transport):
             allow_reuse_address = True
             daemon_threads = True
 
-        self._tcp = _TCPServer((bind_addr, port), _TCPHandler)
-        self.addr = f"{bind_addr}:{port}"
+        # gossip needs UDP and TCP on the SAME port number; with an
+        # ephemeral request the UDP bind picks a port whose TCP side may
+        # already be taken by an unrelated socket — retry with a fresh
+        # pair rather than flaking
+        for attempt in range(16):
+            self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._udp.bind((bind_addr, port))
+            bound = self._udp.getsockname()[1]
+            try:
+                self._tcp = _TCPServer((bind_addr, bound), _TCPHandler)
+                break
+            except OSError:
+                self._udp.close()
+                if port != 0 or attempt == 15:
+                    raise
+        self.addr = f"{bind_addr}:{self._udp.getsockname()[1]}"
         self.closed = False
 
         self._udp_thread = threading.Thread(
