@@ -279,6 +279,10 @@ class HTTPApi:
                 args["MustBePassing"] = True
             if "near" in q:
                 args["Near"] = q["near"]
+            if "peer" in q:
+                args["Peer"] = q["peer"]
+                res = rpc("Health.ServiceNodesPeer", args)
+                return res["Nodes"], res.get("Index")
             res = rpc("Health.ServiceNodes", args)
             return res["Nodes"], res["Index"]
         if (m := re.match(r"^/v1/health/node/(.+)$", path)):
@@ -499,6 +503,18 @@ class HTTPApi:
             responses = coll.wait(a.serf.memberlist.clock)
             return [{"Node": n, "Payload": p.decode(errors="replace")}
                     for n, p in responses], None
+
+        # --------------------------------------------------------- peering
+        if path == "/v1/peering/token" and method in ("POST", "PUT"):
+            return rpc("Peering.GenerateToken", jbody()), None
+        if path == "/v1/peering/establish" and method in ("POST", "PUT"):
+            return rpc("Peering.Establish", jbody()), None
+        if path == "/v1/peerings":
+            return rpc("Peering.List", {})["Peerings"], None
+        if (m := re.match(r"^/v1/peering/(.+)$", path)) \
+                and method == "DELETE":
+            return rpc("Peering.Delete",
+                       {"Name": urllib.parse.unquote(m.group(1))}), None
 
         # -------------------------------------------------------- snapshot
         if path == "/v1/snapshot":

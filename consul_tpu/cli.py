@@ -438,6 +438,29 @@ def _write_pem(path: str, data: str, private: bool = False) -> None:
         f.write(data)
 
 
+def cmd_peering(args) -> int:
+    c = _client(args)
+    if args.peering_cmd == "generate-token":
+        res = c.put("/v1/peering/token", body={"PeerName": args.name})
+        print(res["PeeringToken"])
+        return 0
+    if args.peering_cmd == "establish":
+        c.put("/v1/peering/establish", body={
+            "PeerName": args.name, "PeeringToken": args.peering_token})
+        print(f"Successfully established peering connection with "
+              f"{args.name}")
+        return 0
+    if args.peering_cmd == "list":
+        for p in c.get("/v1/peerings"):
+            print(f"{p.get('Name')}  {p.get('State')}")
+        return 0
+    if args.peering_cmd == "delete":
+        c.delete(f"/v1/peering/{args.name}")
+        print(f"Deleted peering {args.name}")
+        return 0
+    return 1
+
+
 def cmd_debug(args) -> int:
     """Capture a diagnostic bundle (command/debug): self/members/
     metrics/raft config/log window into a gzip tar. Every capture is
@@ -756,6 +779,18 @@ def build_parser() -> argparse.ArgumentParser:
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
+
+    peer = sub.add_parser("peering")
+    peersub = peer.add_subparsers(dest="peering_cmd", required=True)
+    pg = peersub.add_parser("generate-token")
+    pg.add_argument("-name", required=True)
+    pe = peersub.add_parser("establish")
+    pe.add_argument("-name", required=True)
+    pe.add_argument("-peering-token", dest="peering_token", required=True)
+    peersub.add_parser("list")
+    pd = peersub.add_parser("delete")
+    pd.add_argument("-name", required=True)
+    peer.set_defaults(fn=cmd_peering)
 
     dbg = sub.add_parser("debug")
     dbg.add_argument("-duration", type=float, default=2.0)

@@ -464,6 +464,142 @@ def register_endpoints(srv) -> None:
     read("ACL.PolicyRead", acl_policy_read)
     read("ACL.PolicyList", acl_policy_list)
 
+    # ------------------------------------------------------------ Peering
+    # Cluster peering (reference: agent/rpc/peering + peerstream gRPC
+    # streams). Simplified transport: peers exchange a bearer secret at
+    # establish time; cross-peer reads are on-demand RPCs authenticated
+    # by that secret rather than persistent subscription streams.
+    def peering_generate_token(args):
+        """Cluster A mints a token the acceptor hands to cluster B."""
+        require(authz(args).operator_write(), "operator write")
+        import base64 as b64
+        import os as os_mod
+
+        peer_name = args.get("PeerName", "")
+        if not peer_name:
+            raise RPCError("PeerName is required")
+        secret = b64.b64encode(os_mod.urandom(24)).decode()
+        srv.forward_or_apply(MessageType.PEERING, {"Op": "set", "Peering": {
+            "Name": peer_name, "State": "PENDING", "Secret": secret,
+            "Dialer": False}})
+        import json as json_mod
+
+        token = {"ServerAddresses": [srv.rpc.addr],
+                 "PeerName": srv.config.datacenter,
+                 "Secret": secret}
+        return {"PeeringToken": b64.b64encode(
+            json_mod.dumps(token).encode()).decode()}
+
+    def peering_establish(args):
+        """Cluster B consumes the token and dials cluster A."""
+        require(authz(args).operator_write(), "operator write")
+        import base64 as b64
+        import json as json_mod
+
+        peer_name = args.get("PeerName", "")
+        try:
+            token = json_mod.loads(
+                b64.b64decode(args.get("PeeringToken", "")))
+        except Exception as ex:  # noqa: BLE001
+            raise RPCError(f"invalid peering token: {ex}") from ex
+        addr = (token.get("ServerAddresses") or [None])[0]
+        secret = token.get("Secret", "")
+        if not addr or not secret:
+            raise RPCError("peering token missing address or secret")
+        # handshake: prove the secret to the acceptor
+        try:
+            res = srv.pool.call(addr, "PeerStream.Open", {
+                "Secret": secret,
+                "PeerName": srv.config.datacenter,
+                "ServerAddresses": [srv.rpc.addr]})
+        except ConnectionError as ex:
+            raise RPCError(f"failed to reach peer: {ex}") from ex
+        if not res.get("OK"):
+            raise RPCError("peer rejected the peering secret")
+        srv.forward_or_apply(MessageType.PEERING, {"Op": "set", "Peering": {
+            "Name": peer_name, "State": "ACTIVE", "Secret": secret,
+            "ServerAddresses": [addr], "Dialer": True}})
+        return True
+
+    def peer_stream_open(args):
+        """Acceptor side of establish: validate the secret, activate."""
+        secret = args.get("Secret", "")
+        match = next((p for p in state.raw_list("peerings")
+                      if p.get("Secret") == secret
+                      and not p.get("Dialer")), None)
+        if match is None:
+            return {"OK": False}
+        srv.forward_or_apply(MessageType.PEERING, {"Op": "set", "Peering": {
+            **match, "State": "ACTIVE",
+            "ServerAddresses": args.get("ServerAddresses") or []}})
+        return {"OK": True}
+
+    def _peer_by_name(name: str):
+        return state.raw_get("peerings", name)
+
+    def peering_list(args):
+        require(authz(args).operator_read(), "operator read")
+        return {"Peerings": [
+            {k: v for k, v in p.items() if k != "Secret"}
+            for p in state.raw_list("peerings")]}
+
+    def peering_delete(args):
+        require(authz(args).operator_write(), "operator write")
+        srv.forward_or_apply(MessageType.PEERING, {
+            "Op": "delete", "Peering": {"Name": args.get("Name", "")}})
+        return True
+
+    def peer_stream_query(args):
+        """Incoming cross-peer read: secret-authenticated, restricted to
+        services the exported-services config entry names. Honors
+        MinQueryIndex so cross-peer watches long-poll HERE instead of
+        hot-looping over the wire."""
+        secret = args.get("Secret", "")
+        if not any(p.get("Secret") == secret
+                   for p in state.raw_list("peerings")):
+            raise RPCError("Permission denied: unknown peering secret")
+        svc = args.get("ServiceName", "")
+        exported = state.raw_get("config_entries",
+                                 "exported-services/default") or {}
+        allowed = {s.get("Name") for s in exported.get("Services") or []}
+        if svc not in allowed:
+            raise RPCError(
+                f"Permission denied: service {svc!r} is not exported")
+        return srv.blocking_query(
+            args, ("services", "nodes", "checks"), lambda: {
+                "Nodes": state.check_service_nodes(
+                    svc, passing_only=bool(args.get("MustBePassing")))})
+
+    def health_service_peer(args):
+        """Local side of `?peer=`: forward the query to the peer. Same
+        ACL bar as the local health path; blocking params pass through
+        so watches long-poll at the acceptor."""
+        svc = args.get("ServiceName", "")
+        require(authz(args).service_read(svc), f"service read on {svc!r}")
+        peer = _peer_by_name(args.get("Peer", ""))
+        if peer is None:
+            raise RPCError(f"unknown peer {args.get('Peer')!r}")
+        addrs = peer.get("ServerAddresses") or []
+        if not addrs:
+            raise RPCError("peering has no server addresses")
+        return srv.pool.call(addrs[0], "PeerStream.Query", {
+            "Secret": peer.get("Secret", ""),
+            "ServiceName": svc,
+            "MustBePassing": args.get("MustBePassing", False),
+            "MinQueryIndex": args.get("MinQueryIndex", 0),
+            "MaxQueryTime": args.get("MaxQueryTime", 0) or 30.0},
+            timeout=120.0)
+
+    e["Peering.GenerateToken"] = peering_generate_token
+    e["Peering.Establish"] = peering_establish
+    e["Peering.Delete"] = peering_delete
+    # reads of the peering table go through the leader so a token minted
+    # moments ago is always visible (no stale-follower rejections)
+    read("Peering.List", peering_list)
+    read("PeerStream.Open", peer_stream_open)
+    read("PeerStream.Query", peer_stream_query)
+    read("Health.ServiceNodesPeer", health_service_peer)
+
     # ----------------------------------------------------- PreparedQuery
     def pq_apply(args):
         op = args.get("Op", "create")

@@ -36,6 +36,7 @@ class MessageType(enum.IntEnum):
     AUTOPILOT = 11
     SYSTEM_METADATA = 12
     SNAPSHOT_RESTORE = 13  # operator restore, replicated to all FSMs
+    PEERING = 14
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -60,6 +61,7 @@ class FSM:
             MessageType.CONFIG_ENTRY: self._apply_config_entry,
             MessageType.INTENTION: self._apply_intention,
             MessageType.SNAPSHOT_RESTORE: self._apply_snapshot_restore,
+            MessageType.PEERING: self._apply_peering,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -225,6 +227,11 @@ class FSM:
         resets identically)."""
         self.store.restore(b["Data"])
         return True
+
+    def _apply_peering(self, b: dict[str, Any], idx: int) -> Any:
+        p = b.get("Peering") or {}
+        return self._raw_op("peerings", ("set",), b.get("Op", "set"),
+                            p.get("Name"), p)
 
     def _raw_op(self, table: str, write_ops: tuple[str, ...], op: str,
                 key: Any, value: Any) -> Any:

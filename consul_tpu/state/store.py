@@ -30,7 +30,7 @@ from consul_tpu.types import (CheckStatus, Coordinate, HealthCheck, KVEntry,
 
 TABLES = ("nodes", "services", "checks", "kv", "sessions", "coordinates",
           "prepared_queries", "acl_tokens", "acl_policies", "config_entries",
-          "intentions")
+          "intentions", "peerings")
 
 
 class StateStore:
@@ -470,6 +470,7 @@ class StateStore:
                 "acl_policies": dict(self.tables["acl_policies"]),
                 "intentions": dict(self.tables["intentions"]),
                 "prepared_queries": dict(self.tables["prepared_queries"]),
+                "peerings": dict(self.tables["peerings"]),
             }
             return msgpack.packb(blob, use_bin_type=True)
 
@@ -496,7 +497,7 @@ class StateStore:
                 k: Session(**v) for k, v in blob["sessions"].items()}
             self.tables["coordinates"] = blob.get("coordinates", {})
             for t in ("config_entries", "acl_tokens", "acl_policies",
-                      "intentions", "prepared_queries"):
+                      "intentions", "prepared_queries", "peerings"):
                 self.tables[t] = blob.get(t, {})
             self._cv.notify_all()
             for fn in self._change_hooks:
