@@ -100,6 +100,11 @@ class Agent:
         if self.config.enable_remote_exec:
             self.serf.register_query_handler("consul:exec",
                                              self._handle_exec)
+        # auto-encrypt: client agents bootstrap TLS material from the
+        # servers' cluster CA once they can reach one (retried until a
+        # server is reachable — it must survive racing retry_join)
+        if self.config.auto_encrypt and self.server is None:
+            self._auto_encrypt_retry()
         if serve_http:
             from consul_tpu.agent.http import HTTPApi
 
@@ -117,6 +122,49 @@ class Agent:
                                  self.config.port("dns"))
             self.dns.start()
         self.log.info("agent started (server=%s)", self.server is not None)
+
+    def _auto_encrypt_retry(self) -> None:
+        if self._auto_encrypt() or self._shutdown:
+            return
+        self.scheduler.after(5.0, self._auto_encrypt_retry)
+
+    def _auto_encrypt(self) -> bool:
+        import os as os_mod
+        import tempfile
+
+        if self.tls is not None:
+            # an operator-configured TLS setup always wins — silently
+            # replacing it would drop verify_incoming and their certs
+            self.log.info("auto-encrypt skipped: TLS already configured")
+            return True
+        try:
+            res = self.rpc("AutoEncrypt.Sign", {"Node": self.name})
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("auto-encrypt failed (will retry): %s", e)
+            return False
+        cert = res["Cert"]
+        cert_dir = os_mod.path.join(
+            self.config.data_dir or tempfile.mkdtemp(
+                prefix="consul-tpu-ae-"), "auto-encrypt")
+        os_mod.makedirs(cert_dir, exist_ok=True)
+        paths = {"ca_file": os_mod.path.join(cert_dir, "ca.pem"),
+                 "cert_file": os_mod.path.join(cert_dir, "agent.pem"),
+                 "key_file": os_mod.path.join(cert_dir, "agent-key.pem")}
+        with open(paths["ca_file"], "w") as f:
+            f.write("".join(r["RootCert"] for r in res["Roots"]))
+        with open(paths["cert_file"], "w") as f:
+            f.write(cert["CertPEM"])
+        fd = os_mod.open(paths["key_file"],
+                         os_mod.O_WRONLY | os_mod.O_CREAT
+                         | os_mod.O_TRUNC, 0o600)
+        with os_mod.fdopen(fd, "w") as f:
+            f.write(cert["PrivateKeyPEM"])
+        from consul_tpu.utils.tlsutil import TLSConfigurator
+
+        self.tls = TLSConfigurator(**paths, verify_outgoing=True)
+        self.log.info("auto-encrypt: TLS material installed in %s",
+                      cert_dir)
+        return True
 
     def _retry_join(self, seeds: list[str]) -> None:
         def attempt() -> None:

@@ -186,6 +186,7 @@ class RuntimeConfig:
     tls_verify_incoming: bool = False
     tls_verify_outgoing: bool = False
     tls_https: bool = False   # serve the HTTP API over TLS
+    auto_encrypt: bool = False  # client agents fetch TLS certs at join
 
     # Remote exec (`consul exec`); disabled by default like the reference
     # (disable_remote_exec defaults true since 0.8)
@@ -330,6 +331,10 @@ def load(
     # accept both the nested tls{defaults{}} form and flat keys
     tls = {**(tls.get("defaults") or {}),
            **{k: v for k, v in tls.items() if k != "defaults"}}
+    if "auto_encrypt" in raw:
+        ae_blk = raw["auto_encrypt"]
+        kwargs["auto_encrypt"] = bool(
+            ae_blk.get("tls") if isinstance(ae_blk, dict) else ae_blk)
     for src, tgt in (("ca_file", "tls_ca_file"),
                      ("cert_file", "tls_cert_file"),
                      ("key_file", "tls_key_file"),

@@ -464,6 +464,31 @@ def register_endpoints(srv) -> None:
     read("ACL.PolicyRead", acl_policy_read)
     read("ACL.PolicyList", acl_policy_list)
 
+    # -------------------------------------------------------- AutoEncrypt
+    def auto_encrypt_sign(args):
+        """Bootstrap TLS for joining agents (agent/consul/
+        auto_config_endpoint.go + auto_encrypt): returns an agent cert
+        signed by the cluster CA plus the trusted roots. Deliberately
+        reachable without a client certificate — this IS the channel
+        that hands new agents their certificates; gossip-keyring
+        membership is the admission bar (an agent must have joined the
+        encrypted pool to learn a server's RPC address)."""
+        node = args.get("Node", "")
+        if not node:
+            raise RPCError("Node is required")
+        if not srv.is_leader():
+            return srv._forward_to_leader("AutoEncrypt.Sign", args)
+        from consul_tpu.connect.ca import sign_leaf
+
+        root = srv.ca.initialize()
+        cert = sign_leaf(root, f"agent/{node}", srv.config.datacenter,
+                         ttl_hours=72.0)
+        return {"Cert": cert,
+                "Roots": [{"RootCert": r["RootCert"]}
+                          for r in srv.ca.roots()]}
+
+    e["AutoEncrypt.Sign"] = auto_encrypt_sign
+
     # ------------------------------------------------------------ Peering
     # Cluster peering (reference: agent/rpc/peering + peerstream gRPC
     # streams). Simplified transport: peers exchange a bearer secret at
@@ -497,6 +522,8 @@ def register_endpoints(srv) -> None:
         import json as json_mod
 
         peer_name = args.get("PeerName", "")
+        if not peer_name:
+            raise RPCError("PeerName is required")
         try:
             token = json_mod.loads(
                 b64.b64decode(args.get("PeeringToken", "")))
@@ -568,7 +595,8 @@ def register_endpoints(srv) -> None:
         return srv.blocking_query(
             args, ("services", "nodes", "checks"), lambda: {
                 "Nodes": state.check_service_nodes(
-                    svc, passing_only=bool(args.get("MustBePassing")))})
+                    svc, tag=args.get("ServiceTag") or None,
+                    passing_only=bool(args.get("MustBePassing")))})
 
     def health_service_peer(args):
         """Local side of `?peer=`: forward the query to the peer. Same
@@ -582,9 +610,12 @@ def register_endpoints(srv) -> None:
         addrs = peer.get("ServerAddresses") or []
         if not addrs:
             raise RPCError("peering has no server addresses")
+        # Near is NOT forwarded: Vivaldi coordinates are not comparable
+        # across clusters
         return srv.pool.call(addrs[0], "PeerStream.Query", {
             "Secret": peer.get("Secret", ""),
             "ServiceName": svc,
+            "ServiceTag": args.get("ServiceTag", ""),
             "MustBePassing": args.get("MustBePassing", False),
             "MinQueryIndex": args.get("MinQueryIndex", 0),
             "MaxQueryTime": args.get("MaxQueryTime", 0) or 30.0},

@@ -66,3 +66,36 @@ def test_https_api_end_to_end(tmp_path):
                 context=strict, timeout=2)
     finally:
         a.shutdown()
+
+
+def test_auto_encrypt_bootstraps_client_tls():
+    """Client agents with auto_encrypt fetch agent certs from the
+    cluster CA at start (auto_encrypt equivalent)."""
+    from consul_tpu.connect.ca import verify_leaf
+
+    srv = Agent(load(dev=True, overrides={"node_name": "ae-srv"}))
+    srv.start(serve_dns=False)
+    try:
+        wait_for(lambda: srv.server.is_leader(), what="leader")
+        cli = Agent(load(dev=True, overrides={
+            "node_name": "ae-cli", "server": False,
+            "auto_encrypt": True,
+            "retry_join": [srv.serf.memberlist.transport.addr]}))
+        cli.start(serve_http=False, serve_dns=False)
+        try:
+            wait_for(lambda: cli.tls is not None,
+                     what="auto-encrypt TLS configurator")
+            assert cli.tls.enabled
+            # the issued cert chains to the cluster CA and names the agent
+            cert_pem = open(cli.tls.cert_file).read()
+            roots = srv.server.ca.roots()
+            uri = verify_leaf(roots[0]["RootCert"], cert_pem)
+            assert uri is not None and uri.endswith("/svc/agent/ae-cli")
+            # key is private
+            import os as os_mod
+
+            assert os_mod.stat(cli.tls.key_file).st_mode & 0o077 == 0
+        finally:
+            cli.shutdown()
+    finally:
+        srv.shutdown()
