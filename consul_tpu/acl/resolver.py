@@ -66,7 +66,47 @@ class ACLResolver:
                 for p in token.get("Policies") or []):
             return Authorizer([], default_level=WRITE, is_management=True)
         policies = []
-        for ref in token.get("Policies") or []:
+        # service/node identities synthesize their templated policies
+        # (acl/policy_templated.go): service → service:write + discovery
+        # reads; node → node:write + service reads
+        for ident in token.get("ServiceIdentities") or []:
+            name = ident.get("ServiceName", "")
+            if name:
+                policies.append(parse_policy({
+                    "service": {name: "write",
+                                f"{name}-sidecar-proxy": "write"},
+                    "service_prefix": {"": "read"},
+                    "node_prefix": {"": "read"}},
+                    name=f"service-identity:{name}"))
+        for ident in token.get("NodeIdentities") or []:
+            name = ident.get("NodeName", "")
+            if name:
+                policies.append(parse_policy({
+                    "node": {name: "write"},
+                    "service_prefix": {"": "read"}},
+                    name=f"node-identity:{name}"))
+        # roles bundle policies (and their own identities)
+        policy_refs = list(token.get("Policies") or [])
+        for rref in token.get("Roles") or []:
+            role = self.state.raw_get("acl_roles", rref.get("ID", ""))
+            if role is None:
+                for cand in self.state.raw_list("acl_roles"):
+                    if cand.get("Name") == rref.get("Name"):
+                        role = cand
+                        break
+            if role is None:
+                continue
+            policy_refs.extend(role.get("Policies") or [])
+            for ident in role.get("ServiceIdentities") or []:
+                name = ident.get("ServiceName", "")
+                if name:
+                    policies.append(parse_policy({
+                        "service": {name: "write",
+                                    f"{name}-sidecar-proxy": "write"},
+                        "service_prefix": {"": "read"},
+                        "node_prefix": {"": "read"}},
+                        name=f"service-identity:{name}"))
+        for ref in policy_refs:
             pol = self.state.raw_get("acl_policies", ref.get("ID", ""))
             if pol is None:
                 # fall back to by-name lookup

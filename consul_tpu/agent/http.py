@@ -432,6 +432,15 @@ class HTTPApi:
             return res["Token"], None
         if path == "/v1/acl/tokens":
             return rpc("ACL.TokenList", {})["Tokens"], None
+        if path == "/v1/acl/role" and method in ("PUT", "POST"):
+            return rpc("ACL.RoleSet", {"Role": jbody()}), None
+        if (m := re.match(r"^/v1/acl/role/(.+)$", path)) \
+                and method == "DELETE":
+            rpc("ACL.RoleDelete",
+                {"RoleID": urllib.parse.unquote(m.group(1))})
+            return True, None
+        if path == "/v1/acl/roles":
+            return rpc("ACL.RoleList", {})["Roles"], None
         if path == "/v1/acl/policy" and method in ("PUT", "POST"):
             return rpc("ACL.PolicySet", {"Policy": jbody()}), None
         if (m := re.match(r"^/v1/acl/policy/(.+)$", path)):

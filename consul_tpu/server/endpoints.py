@@ -454,6 +454,28 @@ def register_endpoints(srv) -> None:
         require(authz(args).acl_read(), "acl read")
         return {"Policies": state.raw_list("acl_policies")}
 
+    def acl_role_set(args):
+        require(authz(args).acl_write(), "acl write")
+        role = dict(args.get("Role") or {})
+        role.setdefault("ID", str(uuid.uuid4()))
+        srv.forward_or_apply(MessageType.ACL_ROLE,
+                             {"Op": "set", "Role": role})
+        return role
+
+    def acl_role_delete(args):
+        require(authz(args).acl_write(), "acl write")
+        srv.forward_or_apply(MessageType.ACL_ROLE, {
+            "Op": "delete", "Role": {"ID": args.get("RoleID", "")}})
+        return True
+
+    def acl_role_list(args):
+        require(authz(args).acl_read(), "acl read")
+        return {"Roles": state.raw_list("acl_roles")}
+
+    e["ACL.RoleSet"] = acl_role_set
+    e["ACL.RoleDelete"] = acl_role_delete
+    read("ACL.RoleList", acl_role_list)
+
     e["ACL.Bootstrap"] = acl_bootstrap
     e["ACL.TokenSet"] = acl_token_set
     e["ACL.TokenDelete"] = acl_token_delete

@@ -37,6 +37,7 @@ class MessageType(enum.IntEnum):
     SYSTEM_METADATA = 12
     SNAPSHOT_RESTORE = 13  # operator restore, replicated to all FSMs
     PEERING = 14
+    ACL_ROLE = 15
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -62,6 +63,7 @@ class FSM:
             MessageType.INTENTION: self._apply_intention,
             MessageType.SNAPSHOT_RESTORE: self._apply_snapshot_restore,
             MessageType.PEERING: self._apply_peering,
+            MessageType.ACL_ROLE: self._apply_acl_role,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -227,6 +229,11 @@ class FSM:
         resets identically)."""
         self.store.restore(b["Data"])
         return True
+
+    def _apply_acl_role(self, b: dict[str, Any], idx: int) -> Any:
+        r = b.get("Role") or {}
+        return self._raw_op("acl_roles", ("set",), b.get("Op", "set"),
+                            r.get("ID"), r)
 
     def _apply_peering(self, b: dict[str, Any], idx: int) -> Any:
         p = b.get("Peering") or {}

@@ -30,7 +30,7 @@ from consul_tpu.types import (CheckStatus, Coordinate, HealthCheck, KVEntry,
 
 TABLES = ("nodes", "services", "checks", "kv", "sessions", "coordinates",
           "prepared_queries", "acl_tokens", "acl_policies", "config_entries",
-          "intentions", "peerings")
+          "intentions", "peerings", "acl_roles")
 
 
 class StateStore:
@@ -471,6 +471,7 @@ class StateStore:
                 "intentions": dict(self.tables["intentions"]),
                 "prepared_queries": dict(self.tables["prepared_queries"]),
                 "peerings": dict(self.tables["peerings"]),
+                "acl_roles": dict(self.tables["acl_roles"]),
             }
             return msgpack.packb(blob, use_bin_type=True)
 
@@ -497,7 +498,8 @@ class StateStore:
                 k: Session(**v) for k, v in blob["sessions"].items()}
             self.tables["coordinates"] = blob.get("coordinates", {})
             for t in ("config_entries", "acl_tokens", "acl_policies",
-                      "intentions", "prepared_queries", "peerings"):
+                      "intentions", "prepared_queries", "peerings",
+                      "acl_roles"):
                 self.tables[t] = blob.get(t, {})
             self._cv.notify_all()
             for fn in self._change_hooks:

@@ -187,3 +187,59 @@ def test_agent_token_authenticates_anti_entropy():
             "anti-entropy must push with the agent token"
     finally:
         a.shutdown()
+
+
+def test_service_identity_token(acl_agent, root):
+    """ServiceIdentities synthesize templated policies
+    (acl/policy_templated.go): write on the service + discovery reads."""
+    # node write comes from a policy; the service-identity supplies the
+    # service-write half (catalog registration needs BOTH, as in the
+    # reference)
+    npol = root.put("/v1/acl/policy", body={
+        "Name": "node-rw",
+        "Rules": '{"node_prefix": {"": {"policy": "write"}}}'})
+    tok = root.put("/v1/acl/token", body={
+        "Description": "web workload",
+        "Policies": [{"ID": npol["ID"]}],
+        "ServiceIdentities": [{"ServiceName": "webapp"}]})
+    c = ConsulClient(acl_agent.http.addr, token=tok["SecretID"])
+    # may register ITS service (service-identity grants its write)
+    c.put("/v1/catalog/register", body={
+        "Node": "acl-agent", "Address": "127.0.0.1",
+        "Service": {"ID": "webapp", "Service": "webapp", "Port": 80}})
+    # discovery reads allowed everywhere
+    c.health_service("anything")
+    c.catalog_nodes()
+    # but NOT key access or other services' writes
+    with pytest.raises(APIError, match="Permission denied"):
+        c.kv_put("x", b"1")
+    with pytest.raises(APIError, match="Permission denied"):
+        c.put("/v1/catalog/register", body={
+            "Node": "acl-agent", "Address": "127.0.0.1",
+            "Service": {"ID": "other", "Service": "other"}})
+
+
+def test_acl_roles_bundle_policies(acl_agent, root):
+    pol = root.put("/v1/acl/policy", body={
+        "Name": "ops-kv",
+        "Rules": '{"key_prefix": {"ops/": {"policy": "write"}}}'})
+    role = root.put("/v1/acl/role", body={
+        "Name": "operator-role", "Policies": [{"ID": pol["ID"]}],
+        "ServiceIdentities": [{"ServiceName": "opsvc"}]})
+    assert any(r["Name"] == "operator-role"
+               for r in root.get("/v1/acl/roles"))
+    tok = root.put("/v1/acl/token", body={
+        "Roles": [{"ID": role["ID"]}]})
+    c = ConsulClient(acl_agent.http.addr, token=tok["SecretID"])
+    # via the role's policy
+    assert c.kv_put("ops/a", b"1") is True
+    # via the role's service identity
+    c.health_service("opsvc")
+    # outside the role: denied
+    with pytest.raises(APIError, match="Permission denied"):
+        c.kv_put("prod/a", b"1")
+    # deleting the role revokes (after cache TTL — force invalidation)
+    root.delete(f"/v1/acl/role/{role['ID']}")
+    acl_agent.server.acl.invalidate()
+    with pytest.raises(APIError, match="Permission denied"):
+        c.kv_put("ops/b", b"1")
