@@ -68,24 +68,28 @@ class ACLResolver:
         policies = []
         # service/node identities synthesize their templated policies
         # (acl/policy_templated.go): service → service:write + discovery
-        # reads; node → node:write + service reads
-        for ident in token.get("ServiceIdentities") or []:
-            name = ident.get("ServiceName", "")
-            if name:
-                policies.append(parse_policy({
-                    "service": {name: "write",
-                                f"{name}-sidecar-proxy": "write"},
-                    "service_prefix": {"": "read"},
-                    "node_prefix": {"": "read"}},
-                    name=f"service-identity:{name}"))
-        for ident in token.get("NodeIdentities") or []:
-            name = ident.get("NodeName", "")
-            if name:
-                policies.append(parse_policy({
-                    "node": {name: "write"},
-                    "service_prefix": {"": "read"}},
-                    name=f"node-identity:{name}"))
-        # roles bundle policies (and their own identities)
+        # reads; node → node:write + service reads. ONE template source
+        # serves both the token-level and role-level identity lists.
+        def add_identities(holder: dict) -> None:
+            for ident in holder.get("ServiceIdentities") or []:
+                name = ident.get("ServiceName", "")
+                if name:
+                    policies.append(parse_policy({
+                        "service": {name: "write",
+                                    f"{name}-sidecar-proxy": "write"},
+                        "service_prefix": {"": "read"},
+                        "node_prefix": {"": "read"}},
+                        name=f"service-identity:{name}"))
+            for ident in holder.get("NodeIdentities") or []:
+                name = ident.get("NodeName", "")
+                if name:
+                    policies.append(parse_policy({
+                        "node": {name: "write"},
+                        "service_prefix": {"": "read"}},
+                        name=f"node-identity:{name}"))
+
+        add_identities(token)
+        # roles bundle policies and identities
         policy_refs = list(token.get("Policies") or [])
         for rref in token.get("Roles") or []:
             role = self.state.raw_get("acl_roles", rref.get("ID", ""))
@@ -97,15 +101,10 @@ class ACLResolver:
             if role is None:
                 continue
             policy_refs.extend(role.get("Policies") or [])
-            for ident in role.get("ServiceIdentities") or []:
-                name = ident.get("ServiceName", "")
-                if name:
-                    policies.append(parse_policy({
-                        "service": {name: "write",
-                                    f"{name}-sidecar-proxy": "write"},
-                        "service_prefix": {"": "read"},
-                        "node_prefix": {"": "read"}},
-                        name=f"service-identity:{name}"))
+            add_identities(role)
+        # global-management attached through a role counts too
+        if any(p.get("ID") == "global-management" for p in policy_refs):
+            return Authorizer([], default_level=WRITE, is_management=True)
         for ref in policy_refs:
             pol = self.state.raw_get("acl_policies", ref.get("ID", ""))
             if pol is None:

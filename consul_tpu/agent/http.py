@@ -434,11 +434,19 @@ class HTTPApi:
             return rpc("ACL.TokenList", {})["Tokens"], None
         if path == "/v1/acl/role" and method in ("PUT", "POST"):
             return rpc("ACL.RoleSet", {"Role": jbody()}), None
-        if (m := re.match(r"^/v1/acl/role/(.+)$", path)) \
-                and method == "DELETE":
-            rpc("ACL.RoleDelete",
-                {"RoleID": urllib.parse.unquote(m.group(1))})
-            return True, None
+        if (m := re.match(r"^/v1/acl/role/(.+)$", path)):
+            rid = urllib.parse.unquote(m.group(1))
+            if method == "DELETE":
+                rpc("ACL.RoleDelete", {"RoleID": rid})
+                return True, None
+            if method == "PUT":
+                b = jbody()
+                b.setdefault("ID", rid)
+                return rpc("ACL.RoleSet", {"Role": b}), None
+            res = rpc("ACL.RoleRead", {"RoleID": rid})
+            if res.get("Role") is None:
+                raise HTTPError(404, "role not found")
+            return res["Role"], None
         if path == "/v1/acl/roles":
             return rpc("ACL.RoleList", {})["Roles"], None
         if path == "/v1/acl/policy" and method in ("PUT", "POST"):

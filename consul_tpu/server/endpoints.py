@@ -472,8 +472,20 @@ def register_endpoints(srv) -> None:
         require(authz(args).acl_read(), "acl read")
         return {"Roles": state.raw_list("acl_roles")}
 
+    def acl_role_read(args):
+        require(authz(args).acl_read(), "acl read")
+        rid = args.get("RoleID", "")
+        role = state.raw_get("acl_roles", rid)
+        if role is None:
+            for cand in state.raw_list("acl_roles"):
+                if cand.get("Name") == rid:
+                    role = cand
+                    break
+        return {"Role": role}
+
     e["ACL.RoleSet"] = acl_role_set
     e["ACL.RoleDelete"] = acl_role_delete
+    read("ACL.RoleRead", acl_role_read)
     read("ACL.RoleList", acl_role_list)
 
     e["ACL.Bootstrap"] = acl_bootstrap
