@@ -8,10 +8,11 @@ model). This is the hand-scheduled version of `gossip_round_fast`,
 reaching for the HBM-bandwidth floor that XLA's multi-kernel lowering
 leaves on the table.
 
-Scope: the benchmark/stable-protocol configuration — no churn, no
-slow-node model, no stats counters (those configs use the XLA paths).
-Statistical conformance with gossip_round is asserted in
-tests/test_pallas_round.py (TPU-gated).
+Covers the FULL protocol model — churn injection, the slow-node/
+Lifeguard-patience degradation model, suspicion, refutation,
+dissemination — everything except the stats counters (instrumented
+runs use the XLA paths). Statistical conformance with gossip_round is
+asserted in tests/test_pallas_round.py (TPU-gated).
 """
 
 from __future__ import annotations
@@ -26,12 +27,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import N_SCALARS, init_scalars, _shrink
-from consul_tpu.sim.state import ALIVE, DEAD, SUSPECT, SimState
+from consul_tpu.sim.state import ALIVE, DEAD, LEFT, SUSPECT, SimState
 
 INF = 3.4e38  # python float: jnp constants can't be captured by kernels
 
 LANES = 1024  # row width: multiple of 128 lanes; int8 tiles need 32 rows
-ROWS_PER_BLOCK = 256
+ROWS_PER_BLOCK = 128  # 10 arrays/block must fit 16MB VMEM
 
 
 def _u01(shape) -> jnp.ndarray:
@@ -45,14 +46,28 @@ def _u01(shape) -> jnp.ndarray:
     return top24.astype(jnp.float32) * (1.0 / (1 << 24))
 
 
+def _model_arrays(p: SimParams) -> bool:
+    """Whether the config needs the down_time/slow arrays in the kernel
+    (skipping them saves ~20%% of HBM traffic for stable configs)."""
+    return bool(p.fail_per_round or p.leave_per_round
+                or p.rejoin_per_round or p.slow_per_round)
+
+
 def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
-                  up_ref, status_ref, inc_ref, informed_ref,
-                  s_start_ref, s_dead_ref, s_conf_ref, lh_ref,
-                  up_o, status_o, inc_o, informed_o,
-                  s_start_o, s_dead_o, s_conf_o, lh_o,
-                  partial_o,
-                  *, p: SimParams):
+                  *refs, p: SimParams):
     """One block of one protocol period (grid = node blocks)."""
+    n_arrays = 10 if _model_arrays(p) else 8
+    ins, outs = refs[:n_arrays], refs[n_arrays:2 * n_arrays]
+    partial_o = refs[2 * n_arrays]
+    (up_ref, status_ref, inc_ref, informed_ref,
+     s_start_ref, s_dead_ref, s_conf_ref, lh_ref) = ins[:8]
+    (up_o, status_o, inc_o, informed_o,
+     s_start_o, s_dead_o, s_conf_o, lh_o) = outs[:8]
+    if n_arrays == 10:
+        down_ref, slow_ref = ins[8], ins[9]
+        down_o, slow_o = outs[8], outs[9]
+    else:
+        down_ref = slow_ref = down_o = slow_o = None
     blk = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0] + blk)
 
@@ -64,9 +79,12 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     n_live = scal_ref[0]
     n_elig = scal_ref[1]
     n_up_elig = scal_ref[2]
+    n_slow = scal_ref[3]
     lfail_num, lfail_den = scal_ref[6], scal_ref[7]
     frac_up_elig = n_up_elig / n_elig
-    e_pf = scal_ref[4] / jnp.maximum(n_live, 1e-9)
+    sbar = n_slow / jnp.maximum(n_up_elig, 1e-9)
+    e_pf_fast = scal_ref[4] / jnp.maximum(n_live, 1e-9)
+    e_pf_slow = scal_ref[5] / jnp.maximum(n_live, 1e-9)
     scale = lfail_num / lfail_den if p.lifeguard else jnp.float32(1.0)
 
     # load small ints as int32 FIRST: i1 masks inherit the source's
@@ -80,19 +98,72 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     s_dead = s_dead_ref[:]
     s_conf = s_conf_ref[:].astype(jnp.int32)
     lh = lh_ref[:].astype(jnp.int32)
+    if down_ref is not None:
+        down_time = down_ref[:]
+        slow = slow_ref[:].astype(jnp.int32) != 0
+    else:
+        down_time = None
+        slow = jnp.zeros(up.shape, jnp.bool_)
     shape = up.shape
     new_rumor = jnp.zeros(shape, jnp.bool_)
 
-    # prober-side ack (no slow nodes: pf is the same for every prober)
+    # ------------------------------------------------------------- churn
+    if p.fail_per_round or p.leave_per_round or p.rejoin_per_round:
+        u_c = _u01(shape)
+        crash = up & (u_c < p.fail_per_round)
+        leave = up & (u_c >= p.fail_per_round) & (
+            u_c < p.fail_per_round + p.leave_per_round)
+        rejoin = (~up) & (u_c < p.rejoin_per_round)
+        up = (up & ~(crash | leave)) | rejoin
+        t_v = jnp.zeros(shape, jnp.float32) + t
+        down_time = jnp.where(crash | leave, t_v, down_time)
+        down_time = jnp.where(rejoin, INF, down_time)
+        status = jnp.where(leave, LEFT, status)
+        status = jnp.where(rejoin, ALIVE, status)
+        inc = jnp.where(rejoin, inc + 1, inc)
+        lh = jnp.where(rejoin, 0, lh)
+        started = leave | rejoin
+        informed = jnp.where(started, 1.0 / n, informed)
+        s_dead = jnp.where(started, INF, s_dead)
+        new_rumor |= started
+
+    # ------------------------------------------------ degraded-node churn
+    if p.slow_per_round:
+        u_s = _u01(shape)
+        # Mosaic can't select between i1 vectors — go through int32
+        stay = (u_s >= p.slow_recover_per_round).astype(jnp.int32)
+        enter = (u_s < p.slow_per_round).astype(jnp.int32)
+        slow = (jnp.where(slow, stay, enter) != 0) & up
+
+    # prober-side ack with the full slow/Lifeguard-patience model
+    # (identical math to round.py _pf_arrays)
     live_frac = n_live / n
-    p_relay1 = live_frac * p.p_relay
-    pf = ((1.0 - p.p_direct) * (1.0 - p_relay1) ** p.indirect_checks
-          * (1.0 - p.p_tcp))
+    g = jnp.where(slow, p.slow_factor, 1.0)
+    if p.lifeguard and p.slow_per_round:
+        patience = 1.0 - jnp.exp2(-lh.astype(jnp.float32))
+    else:
+        patience = jnp.zeros(shape, jnp.float32)
+    ge_i = g + (1.0 - g) * patience
+    ge_p_slow = p.slow_factor + (1.0 - p.slow_factor) * patience
+    e_gp4 = (1.0 - sbar) * 1.0 + sbar * ge_p_slow ** 4
+
+    def noack_given(gj_const: float) -> jnp.ndarray:
+        ge_j = gj_const + (1.0 - gj_const) * patience
+        pair2 = (ge_i * ge_j) ** 2
+        p_d = p.p_direct * pair2
+        p_relay1 = live_frac * p.p_relay * pair2 * e_gp4
+        p_no_relay = (1.0 - p_relay1) ** p.indirect_checks
+        p_tcp = p.p_tcp * ge_i * ge_j
+        return (1.0 - p_d) * p_no_relay * (1.0 - p_tcp)
+
+    pf_fast = noack_given(1.0)
+    pf_slow = noack_given(p.slow_factor)
+    mix_i = (1.0 - sbar) * pf_fast + sbar * pf_slow
     # Mosaic: comparisons against SMEM-sourced scalars produce
     # replicated-layout masks that can't AND with memory-sourced masks —
-    # materialize the scalar as a vector first.
-    p_ack = frac_up_elig * (1.0 - pf)
-    p_ack_v = jnp.zeros(shape, jnp.float32) + p_ack
+    # p_ack is already a vector here (per-prober), so compare directly.
+    p_ack_v = frac_up_elig * (1.0 - mix_i) \
+        + jnp.zeros(shape, jnp.float32)
     u_ack = _u01(shape)
     ack = up & (u_ack < p_ack_v)
     failed = up & ~ack
@@ -103,7 +174,10 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     # target-side suspicion arrivals (truncated-Poisson inverse CDF)
     eligf = ((status == ALIVE) | (status == SUSPECT)).astype(jnp.float32)
     probe_rate = n_live / jnp.maximum(n_elig - 1.0, 1.0)
-    p_fail_j = jnp.where(up, e_pf, 1.0)
+    e_pf_fast_v = jnp.zeros(shape, jnp.float32) + e_pf_fast
+    e_pf_slow_v = jnp.zeros(shape, jnp.float32) + e_pf_slow
+    p_fail_j = jnp.where(up,
+                         jnp.where(slow, e_pf_slow_v, e_pf_fast_v), 1.0)
     lam = probe_rate * p_fail_j * eligf
     u_p = _u01(shape)
     term = jnp.exp(-lam)
@@ -133,8 +207,10 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
 
     # refutation race
     lam_hear = (p.gossip_nodes * p.gossip_ticks_per_round * informed
-                * (1.0 - p.loss))
+                * (1.0 - p.loss) * g)
     p_hear = 1.0 - jnp.exp(-lam_hear)
+    lam_grow = (p.gossip_nodes * p.gossip_ticks_per_round * informed
+                * (1.0 - p.loss))
     u_h = _u01(shape)
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
     refute = wrongly & (u_h < p_hear)
@@ -158,7 +234,7 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     # dissemination
     grow = (~new_rumor) & (informed < 1.0)
     informed = jnp.where(
-        grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_hear)),
+        grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_grow)),
         informed)
 
     # write back
@@ -170,15 +246,20 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     s_dead_o[:] = s_dead
     s_conf_o[:] = s_conf.astype(s_conf_ref.dtype)
     lh_o[:] = lh.astype(lh_ref.dtype)
+    if down_o is not None:
+        down_o[:] = down_time
+        slow_o[:] = slow.astype(slow_ref.dtype)
 
     # next round's partial sums for this block
     upf = up.astype(jnp.float32)
-    elig2f = ((status == ALIVE) | (status == SUSPECT)).astype(jnp.float32)
+    elig2 = (status == ALIVE) | (status == SUSPECT)
+    elig2f = elig2.astype(jnp.float32)
     w_fail = upf * (1.0 - p_ack_v)
     s_up = jnp.sum(upf)
+    slowf = (slow & up & elig2).astype(jnp.float32)
     sums = [s_up, jnp.sum(elig2f), jnp.sum(upf * elig2f),
-            jnp.float32(0.0),                  # slow count (model off)
-            s_up * pf, s_up * pf,              # Σ up·pf (pf uniform)
+            jnp.sum(slowf),
+            jnp.sum(upf * pf_fast), jnp.sum(upf * pf_slow),
             jnp.sum(w_fail * (lh.astype(jnp.float32) + 1.0)),
             jnp.sum(w_fail)]
     # TPU blocks must be (8,128)-tiled: place the 8 sums at row 0,
@@ -197,9 +278,6 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
     Requires: no churn/slow-node injection (those configs use the XLA
     paths) and n divisible by the block size."""
-    assert not (p.fail_per_round or p.leave_per_round
-                or p.rejoin_per_round or p.slow_per_round), \
-        "pallas path covers the stable-protocol configuration"
     assert not p.collect_stats, \
         "pallas path has no stats plumbing; use collect_stats=False"
     n = p.n
@@ -214,33 +292,24 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         return pl.BlockSpec((ROWS_PER_BLOCK, LANES),
                             lambda i, *_: (i, 0))
 
+    n_arrays = 10 if _model_arrays(p) else 8
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # scalars, seed, t
         grid=(grid,),
-        in_specs=[row_spec() for _ in range(8)],
-        out_specs=[row_spec() for _ in range(8)]
+        in_specs=[row_spec() for _ in range(n_arrays)],
+        out_specs=[row_spec() for _ in range(n_arrays)]
         + [pl.BlockSpec((8, 128), lambda i, *_: (i, 0))],
     )
 
     def one_round(args, scalars, seed, t):
-        (up, status, inc, informed, s_start, s_dead, s_conf, lh) = args
         outs = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
-            out_shape=[
-                jax.ShapeDtypeStruct((rows, LANES), up.dtype),
-                jax.ShapeDtypeStruct((rows, LANES), status.dtype),
-                jax.ShapeDtypeStruct((rows, LANES), inc.dtype),
-                jax.ShapeDtypeStruct((rows, LANES), informed.dtype),
-                jax.ShapeDtypeStruct((rows, LANES), s_start.dtype),
-                jax.ShapeDtypeStruct((rows, LANES), s_dead.dtype),
-                jax.ShapeDtypeStruct((rows, LANES), s_conf.dtype),
-                jax.ShapeDtypeStruct((rows, LANES), lh.dtype),
-                jax.ShapeDtypeStruct((grid * 8, 128), jnp.float32),
-            ],
+            out_shape=[jax.ShapeDtypeStruct((rows, LANES), a.dtype)
+                       for a in args]
+            + [jax.ShapeDtypeStruct((grid * 8, 128), jnp.float32)],
             interpret=interpret,
-        )(scalars, seed, t, up, status, inc, informed, s_start, s_dead,
-          s_conf, lh)
+        )(scalars, seed, t, *args)
         *state_out, partials = outs
         sums = partials.reshape(grid, 8, 128)[:, 0, :N_SCALARS].sum(axis=0)
         return tuple(state_out), sums
@@ -260,6 +329,9 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                 to2d(state.incarnation), to2d(state.informed),
                 to2d(state.susp_start), to2d(state.susp_deadline),
                 to2d(state.susp_conf), to2d(state.local_health))
+        if n_arrays == 10:
+            args = args + (to2d(state.down_time),
+                           to2d(state.slow.astype(jnp.int8)))
 
         def body(carry, x):
             args, scalars, t = carry
@@ -272,16 +344,23 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
         (args, scalars, t_final), _ = jax.lax.scan(
             body, (args, scalars, state.t), seeds)
-        (up, status, inc, informed, s_start, s_dead, s_conf, lh) = args
+        (up, status, inc, informed, s_start, s_dead, s_conf,
+         lh) = args[:8]
+        if n_arrays == 10:
+            down, slow = args[8], args[9]
+            down_flat, slow_flat = (down.reshape(-1),
+                                    slow.reshape(-1) != 0)
+        else:
+            down_flat, slow_flat = state.down_time, state.slow
         return SimState(
-            up=up.reshape(-1) != 0, down_time=state.down_time,
+            up=up.reshape(-1) != 0, down_time=down_flat,
             status=status.reshape(-1), incarnation=inc.reshape(-1),
             informed=informed.reshape(-1),
             susp_start=s_start.reshape(-1),
             susp_deadline=s_dead.reshape(-1),
             susp_conf=s_conf.reshape(-1),
             local_health=lh.reshape(-1),
-            slow=state.slow, t=t_final,
+            slow=slow_flat, t=t_final,
             round_idx=state.round_idx + rounds, stats=state.stats)
 
     return run

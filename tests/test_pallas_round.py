@@ -36,6 +36,26 @@ def test_pallas_matches_reference_dynamics():
 
 
 @tpu_only
+def test_pallas_full_model_conformance():
+    """Churn + slow-node + Lifeguard through the kernel must match the
+    XLA reference on every aggregate statistic."""
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.002, rejoin_per_round=0.02,
+                  slow_per_round=0.002, slow_recover_per_round=0.03,
+                  slow_factor=0.05, collect_stats=False)
+    pal = make_run_rounds_pallas(p, 200)(init_state(n), jax.random.key(0))
+    ref, _ = run_rounds(init_state(n), jax.random.key(1), p, 200)
+    assert abs(float(pal.up.mean()) - float(ref.up.mean())) < 0.02
+    assert abs(float(pal.slow.mean()) - float(ref.slow.mean())) < 0.01
+    ps, rs = int(jnp.sum(pal.status == SUSPECT)),         int(jnp.sum(ref.status == SUSPECT))
+    assert 0.85 < ps / max(rs, 1) < 1.15
+    assert int(jnp.sum(pal.incarnation > 0)) > 0
+
+
+@tpu_only
 def test_pallas_crash_detection():
     from consul_tpu.sim.pallas_round import make_run_rounds_pallas
 
