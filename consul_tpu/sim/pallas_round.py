@@ -26,13 +26,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from consul_tpu.sim.params import SimParams
-from consul_tpu.sim.round import N_SCALARS, init_scalars, _shrink
+from consul_tpu.sim.round import (N_SCALARS, init_scalars,
+                                  _pf_arrays, _shrink)
 from consul_tpu.sim.state import ALIVE, DEAD, LEFT, SUSPECT, SimState
 
 INF = 3.4e38  # python float: jnp constants can't be captured by kernels
 
 LANES = 1024  # row width: multiple of 128 lanes; int8 tiles need 32 rows
-ROWS_PER_BLOCK = 128  # 10 arrays/block must fit 16MB VMEM
+# rows per block: 10-array (churn/slow) kernels must fit 16MB VMEM;
+# 8-array stable kernels take double blocks for fewer grid steps
+ROWS_FULL, ROWS_STABLE = 128, 256
 
 
 def _u01(shape) -> jnp.ndarray:
@@ -135,29 +138,10 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
         enter = (u_s < p.slow_per_round).astype(jnp.int32)
         slow = (jnp.where(slow, stay, enter) != 0) & up
 
-    # prober-side ack with the full slow/Lifeguard-patience model
-    # (identical math to round.py _pf_arrays)
-    live_frac = n_live / n
-    g = jnp.where(slow, p.slow_factor, 1.0)
-    if p.lifeguard and p.slow_per_round:
-        patience = 1.0 - jnp.exp2(-lh.astype(jnp.float32))
-    else:
-        patience = jnp.zeros(shape, jnp.float32)
-    ge_i = g + (1.0 - g) * patience
-    ge_p_slow = p.slow_factor + (1.0 - p.slow_factor) * patience
-    e_gp4 = (1.0 - sbar) * 1.0 + sbar * ge_p_slow ** 4
-
-    def noack_given(gj_const: float) -> jnp.ndarray:
-        ge_j = gj_const + (1.0 - gj_const) * patience
-        pair2 = (ge_i * ge_j) ** 2
-        p_d = p.p_direct * pair2
-        p_relay1 = live_frac * p.p_relay * pair2 * e_gp4
-        p_no_relay = (1.0 - p_relay1) ** p.indirect_checks
-        p_tcp = p.p_tcp * ge_i * ge_j
-        return (1.0 - p_d) * p_no_relay * (1.0 - p_tcp)
-
-    pf_fast = noack_given(1.0)
-    pf_slow = noack_given(p.slow_factor)
+    # prober-side ack: the SAME _pf_arrays the XLA paths use (pure
+    # jnp elementwise — lowers under Mosaic; sharing it is what keeps
+    # pallas/XLA statistical conformance from drifting)
+    g, pf_fast, pf_slow = _pf_arrays(slow, lh, sbar, n_live / n, p)
     mix_i = (1.0 - sbar) * pf_fast + sbar * pf_slow
     # Mosaic: comparisons against SMEM-sourced scalars produce
     # replicated-layout masks that can't AND with memory-sourced masks —
@@ -276,12 +260,15 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                            interpret: bool = False):
     """Compiled hot loop using the fused Pallas round kernel.
 
-    Requires: no churn/slow-node injection (those configs use the XLA
-    paths) and n divisible by the block size."""
+    Covers the full protocol model including churn and slow-node
+    injection; only collect_stats configs fall back to the XLA paths.
+    Requires n divisible by the block size."""
     assert not p.collect_stats, \
         "pallas path has no stats plumbing; use collect_stats=False"
     n = p.n
-    block = ROWS_PER_BLOCK * LANES
+    n_arrays = 10 if _model_arrays(p) else 8
+    rows_per_block = ROWS_FULL if n_arrays == 10 else ROWS_STABLE
+    block = rows_per_block * LANES
     assert n % block == 0, f"n={n} must be a multiple of {block}"
     grid = n // block
     rows = n // LANES
@@ -289,10 +276,9 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     kernel = functools.partial(_round_kernel, p=p)
 
     def row_spec():
-        return pl.BlockSpec((ROWS_PER_BLOCK, LANES),
+        return pl.BlockSpec((rows_per_block, LANES),
                             lambda i, *_: (i, 0))
 
-    n_arrays = 10 if _model_arrays(p) else 8
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # scalars, seed, t
         grid=(grid,),
@@ -315,7 +301,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         return tuple(state_out), sums
 
     @jax.jit
-    def run(state: SimState, key: jax.Array) -> SimState:
+    def _run(state: SimState, key: jax.Array) -> SimState:
         scalars = init_scalars(state, p)
         # clamp the tiny epsilons the XLA path uses
         scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
@@ -362,5 +348,32 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             local_health=lh.reshape(-1),
             slow=slow_flat, t=t_final,
             round_idx=state.round_idx + rounds, stats=state.stats)
+
+    if n_arrays == 10:
+        return _run
+
+    seen_ok: list = [None]
+
+    def run(state: SimState, key: jax.Array) -> SimState:
+        # the 8-array kernel carries no slow array: running it over a
+        # state with residual slow nodes would silently drop their
+        # degraded dynamics (the XLA paths honor state.slow regardless
+        # of params) — refuse rather than diverge. The check costs a
+        # host round-trip, so it runs once per slow buffer: this path
+        # passes state.slow through BY IDENTITY, making chained calls
+        # (the hot loop) free.
+        if state.slow is not seen_ok[0]:
+            if bool(state.slow.any()):
+                raise ValueError(
+                    "state has slow nodes but params disable the "
+                    "slow-node model; use a SimParams with "
+                    "slow_per_round>0 (10-array kernel) or the XLA "
+                    "run_rounds for this state")
+        out = _run(state, key)
+        # cache the OUTPUT buffer: jit returns a fresh Array object even
+        # for a passed-through input, so caching state.slow would never
+        # hit on chained calls
+        seen_ok[0] = out.slow
+        return out
 
     return run

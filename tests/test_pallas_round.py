@@ -68,3 +68,17 @@ def test_pallas_crash_detection():
     assert int(out.status[7]) == DEAD
     assert int(jnp.sum(out.status == DEAD)) == 1  # no false positives
     assert float(out.informed[7]) > 0.99
+
+def test_stable_kernel_refuses_stale_slow_state():
+    """A no-churn config builds the 8-array kernel, which carries no
+    slow array — feeding it a state with residual slow nodes must be
+    refused, not silently treated as all-fast (runs on CPU: the guard
+    fires before any Mosaic lowering)."""
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.01, collect_stats=False)
+    s = init_state(n)
+    with pytest.raises(ValueError, match="slow nodes"):
+        make_run_rounds_pallas(p, 1)(
+            s._replace(slow=s.slow.at[3].set(True)), jax.random.key(0))
