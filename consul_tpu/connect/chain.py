@@ -1,21 +1,27 @@
-"""Discovery chain (lite): compile resolver/splitter config entries
-into an upstream resolution plan.
+"""Discovery chain (lite): compile router/splitter/resolver config
+entries into an upstream resolution plan.
 
 Reference: agent/consul/discoverychain (~8k LoC) compiles
-service-resolver / service-splitter / service-router config entries
-into a routing DAG for xDS. This compact equivalent handles the two
-load-bearing kinds:
+service-router / service-splitter / service-resolver config entries
+into a routing DAG for xDS. This compact equivalent handles all three
+load-bearing kinds with the reference's layering (router on top,
+splits under each route, resolver redirects at the bottom):
 
-  service-resolver: {"Kind": "service-resolver", "Name": "db",
-                     "Redirect": {"Service": "db-v2"},
-                     "Failover": {"*": {"Service": "db-backup"}}}
+  service-router:   {"Kind": "service-router", "Name": "api",
+                     "Routes": [{"Match": {"HTTP": {"PathPrefix": "/v2"}},
+                                 "Destination": {"Service": "api-v2"}}]}
   service-splitter: {"Kind": "service-splitter", "Name": "api",
                      "Splits": [{"Weight": 90, "Service": "api"},
                                 {"Weight": 10, "Service": "api-canary"}]}
+  service-resolver: {"Kind": "service-resolver", "Name": "db",
+                     "Redirect": {"Service": "db-v2"},
+                     "Failover": {"*": {"Service": "db-backup"}}}
 
 `compile_targets` resolves a service name through redirect chains and
-splits into weighted concrete targets, each with an optional failover
-service — the shape proxycfg feeds into Envoy weighted clusters.
+splits into weighted concrete targets; `compile_chain` adds the L7
+router layer (HTTP-protocol services only, as in the reference) —
+the shapes proxycfg feeds into Envoy route configs and weighted
+clusters.
 """
 
 from __future__ import annotations
@@ -48,6 +54,121 @@ def compile_targets(name: str,
             t["Weight"] = round(t["Weight"] * 100.0 / total, 2)
         return out
     return [{**_resolve(name, get_entry), "Weight": 100.0}]
+
+
+def service_protocol(name: str,
+                     get_entry: Callable[[str, str], Optional[dict]],
+                     ) -> str:
+    """Effective protocol for a service: service-defaults beats the
+    proxy-defaults global, default tcp (configentry resolution order in
+    the reference's service manager)."""
+    sd = get_entry("service-defaults", name)
+    if sd and sd.get("Protocol"):
+        return str(sd["Protocol"]).lower()
+    pd = get_entry("proxy-defaults", "global")
+    if pd:
+        proto = pd.get("Protocol") or (pd.get("Config") or {}).get(
+            "protocol")
+        if proto:
+            return str(proto).lower()
+    return "tcp"
+
+
+def compile_chain(name: str,
+                  get_entry: Callable[[str, str], Optional[dict]],
+                  ) -> dict[str, Any]:
+    """Full discovery chain for `name`: the L7 router's routes (HTTP
+    protocols only — routers over tcp services are ignored, as the
+    reference refuses them at the protocol gate), each resolved through
+    splitter + resolver, plus the implicit default catch-all route.
+
+    Returns {"ServiceName", "Protocol",
+             "Routes": [{"Match": ...|None, "Destination", "Targets"}]}
+    where the LAST route is always the default (Match=None).
+    """
+    protocol = service_protocol(name, get_entry)
+    routes: list[dict[str, Any]] = []
+    router = get_entry("service-router", name)
+    if router is not None and protocol in ("http", "http2", "grpc"):
+        for r in router.get("Routes") or []:
+            dest = dict(r.get("Destination") or {})
+            svc = dest.get("Service") or name
+            routes.append({"Match": r.get("Match"),
+                           "Destination": dest,
+                           "Targets": compile_targets(svc, get_entry)})
+    routes.append({"Match": None, "Destination": {"Service": name},
+                   "Targets": compile_targets(name, get_entry)})
+    return {"ServiceName": name, "Protocol": protocol, "Routes": routes}
+
+
+def validate_entry(entry: dict) -> None:
+    """Shape validation for discovery-chain config entries, applied at
+    ConfigEntry.Apply time (the reference validates in the struct's
+    Validate() before raft). Raises ValueError."""
+    kind = entry.get("Kind", "")
+
+    def dicts(items, what: str) -> list[dict]:
+        for it in items:
+            if not isinstance(it, dict):
+                raise ValueError(f"{what} entries must be maps")
+        return items
+
+    if kind == "service-splitter":
+        splits = entry.get("Splits")
+        if not isinstance(splits, list) or not splits:
+            raise ValueError("service-splitter requires Splits")
+        dicts(splits, "Splits")
+        if sum(float(s.get("Weight", 0)) for s in splits) <= 0:
+            raise ValueError("service-splitter weights must sum > 0")
+    elif kind == "service-resolver":
+        redirect = entry.get("Redirect")
+        if redirect is not None and not isinstance(redirect, dict):
+            raise ValueError("service-resolver Redirect must be a map")
+    elif kind == "service-router":
+        routes = entry.get("Routes")
+        if not isinstance(routes, list):
+            raise ValueError("service-router requires Routes")
+        for r in dicts(routes, "Routes"):
+            match = (r.get("Match") or {}).get("HTTP") or {}
+            path_kinds = [k for k in
+                          ("PathExact", "PathPrefix", "PathRegex")
+                          if match.get(k)]
+            if len(path_kinds) > 1:
+                raise ValueError(
+                    "route Match.HTTP allows only one of "
+                    "PathExact/PathPrefix/PathRegex")
+            for k in ("PathExact", "PathPrefix"):
+                if match.get(k) and not str(match[k]).startswith("/"):
+                    raise ValueError(f"{k} must begin with '/'")
+            for h in dicts(match.get("Header") or [], "Header"):
+                if not h.get("Name"):
+                    raise ValueError("header match requires Name")
+            dest = r.get("Destination")
+            if dest is not None and not isinstance(dest, dict):
+                raise ValueError("route Destination must be a map")
+    elif kind == "ingress-gateway":
+        listeners = entry.get("Listeners")
+        if not isinstance(listeners, list):
+            raise ValueError("ingress-gateway requires Listeners")
+        for lst in dicts(listeners, "Listeners"):
+            if not int(lst.get("Port") or 0):
+                raise ValueError("ingress listener requires Port")
+            proto = (lst.get("Protocol") or "tcp").lower()
+            svcs = lst.get("Services") or []
+            if proto == "tcp" and len(svcs) > 1:
+                raise ValueError(
+                    "tcp ingress listener allows exactly one service")
+            for s in dicts(svcs, "Services"):
+                if not s.get("Name"):
+                    raise ValueError("ingress service requires Name")
+    elif kind == "terminating-gateway":
+        svcs = entry.get("Services")
+        if not isinstance(svcs, list) or not svcs:
+            raise ValueError("terminating-gateway requires Services")
+        for s in dicts(svcs, "Services"):
+            if not s.get("Name"):
+                raise ValueError(
+                    "terminating-gateway service requires Name")
 
 
 def _resolve(name: str,

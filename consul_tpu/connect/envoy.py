@@ -14,11 +14,60 @@ from __future__ import annotations
 from typing import Any, Optional
 
 
-def bootstrap_config(snapshot: dict[str, Any],
-                     admin_port: int = 19000) -> dict[str, Any]:
-    leaf = snapshot["Leaf"]
+def _spiffe_principal(source: str) -> dict[str, Any]:
+    if source == "*":
+        return {"any": True}
+    return {"authenticated": {"principal_name": {
+        "suffix": f"/svc/{source}"}}}
+
+
+def _rbac(action: str, sources: list[str]) -> dict[str, Any]:
+    policies = {}
+    if sources:
+        policies["consul-intentions"] = {
+            "permissions": [{"any": True}],
+            "principals": [_spiffe_principal(s) for s in sources]}
+    return {
+        "name": "envoy.filters.network.rbac",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions."
+                     "filters.network.rbac.v3.RBAC",
+            "stat_prefix": "connect_authz",
+            "rules": {"action": action, "policies": policies}}}
+
+
+def _rbac_filters(intentions: list[dict[str, Any]],
+                  default_allow: bool) -> list[dict[str, Any]]:
+    """Destination-side intention enforcement (xds rbac.go): the
+    mTLS handshake only proves mesh membership — the LISTENER must
+    enforce which SPIFFE identities may connect.
+
+    Intention precedence (exact deny beats wildcard allow, exact allow
+    beats wildcard deny) maps onto an ordered filter PAIR: a DENY
+    filter for the explicit denies runs first, then an ALLOW filter
+    grants the listed sources when the effective default is deny. A
+    single-action filter cannot express mixed precedence."""
+    intentions = intentions or []
+    allows = [i["SourceName"] for i in intentions
+              if i.get("Action", "allow") == "allow"]
+    denies = [i["SourceName"] for i in intentions
+              if i.get("Action") == "deny"]
+    exact_denies = [d for d in denies if d != "*"]
+    filters = []
+    if exact_denies:
+        filters.append(_rbac("DENY", exact_denies))
+    # a wildcard deny flips the effective default: only listed allows
+    # (which may include "*") pass
+    if not default_allow or "*" in denies:
+        filters.append(_rbac("ALLOW", allows))
+    return filters
+
+
+def _tls_context(snapshot: dict[str, Any],
+                 leaf: Optional[dict[str, Any]] = None) -> dict[str, Any]:
+    leaf = leaf or snapshot["Leaf"]
     roots_pem = "".join(r["RootCert"] for r in snapshot["Roots"])
-    tls_context = {
+    return {
         "common_tls_context": {
             "tls_certificates": [{
                 "certificate_chain": {"inline_string": leaf["CertPEM"]},
@@ -30,44 +79,17 @@ def bootstrap_config(snapshot: dict[str, Any],
         "require_client_certificate": True,
     }
 
-    def spiffe_principal(source: str) -> dict[str, Any]:
-        if source == "*":
-            return {"any": True}
-        suffix = f"/svc/{source}"
-        return {"authenticated": {"principal_name": {
-            "suffix": suffix}}}
 
-    def rbac_filter() -> Optional[dict[str, Any]]:
-        """Destination-side intention enforcement (xds rbac.go): the
-        mTLS handshake only proves mesh membership — the LISTENER must
-        enforce which SPIFFE identities may connect."""
-        intentions = snapshot.get("Intentions") or []
-        default_allow = snapshot.get("DefaultAllow", True)
-        allows = [i["SourceName"] for i in intentions
-                  if i.get("Action", "allow") == "allow"]
-        denies = [i["SourceName"] for i in intentions
-                  if i.get("Action") == "deny"]
-        if default_allow and not denies:
-            return None  # everything allowed; no filter needed
-        if default_allow:
-            action, sources = "DENY", denies
-        else:
-            action, sources = "ALLOW", allows
-        if not sources and action == "ALLOW":
-            sources = []  # allow nobody: empty policy set denies all
-        policies = {}
-        if sources:
-            policies["consul-intentions"] = {
-                "permissions": [{"any": True}],
-                "principals": [spiffe_principal(s) for s in sources]}
-        return {
-            "name": "envoy.filters.network.rbac",
-            "typed_config": {
-                "@type": "type.googleapis.com/envoy.extensions."
-                         "filters.network.rbac.v3.RBAC",
-                "stat_prefix": "connect_authz",
-                "rules": {"action": action, "policies": policies}}}
-
+def bootstrap_config(snapshot: dict[str, Any],
+                     admin_port: int = 19000) -> dict[str, Any]:
+    kind = snapshot.get("Kind", "connect-proxy")
+    if kind == "ingress-gateway":
+        return _ingress_bootstrap(snapshot, admin_port)
+    if kind == "terminating-gateway":
+        return _terminating_bootstrap(snapshot, admin_port)
+    if kind == "mesh-gateway":
+        return _mesh_bootstrap(snapshot, admin_port)
+    tls_context = _tls_context(snapshot)
     pub = snapshot["PublicListener"]
     clusters = [{
         "name": "local_app",
@@ -87,7 +109,9 @@ def bootstrap_config(snapshot: dict[str, Any],
                     "@type": "type.googleapis.com/envoy.extensions."
                              "transport_sockets.tls.v3.DownstreamTlsContext",
                     **tls_context}},
-            "filters": ([f] if (f := rbac_filter()) else [])
+            "filters": _rbac_filters(
+                snapshot.get("Intentions") or [],
+                snapshot.get("DefaultAllow", True))
             + [_tcp_proxy("public_listener", "local_app")],
         }],
     }]
@@ -96,9 +120,11 @@ def bootstrap_config(snapshot: dict[str, Any],
         if not up.get("Allowed", True):
             continue  # intention-denied upstreams are not materialized
         name = f"upstream_{up['DestinationName']}"
-        targets = up.get("Targets") or [
-            {"Service": up["DestinationName"], "Weight": 100.0,
-             "Endpoints": up.get("Endpoints", [])}]
+        routes = up.get("Routes") or [
+            {"Match": None, "Destination": {},
+             "Targets": up.get("Targets") or [
+                 {"Service": up["DestinationName"], "Weight": 100.0,
+                  "Endpoints": up.get("Endpoints", [])}]}]
         upstream_tls = {
             "name": "tls",
             "typed_config": {
@@ -106,30 +132,28 @@ def bootstrap_config(snapshot: dict[str, Any],
                          "transport_sockets.tls.v3.UpstreamTlsContext",
                 "common_tls_context":
                     tls_context["common_tls_context"]}}
-        for t in targets:
-            clusters.append({
-                "name": f"{name}_{t['Service']}",
-                "type": "STATIC",
-                "connect_timeout": "5s",
-                "transport_socket": upstream_tls,
-                "load_assignment": _endpoints(
-                    f"{name}_{t['Service']}", t.get("Endpoints", [])),
-            })
-        if len(targets) == 1:
-            filt = _tcp_proxy(name, f"{name}_{targets[0]['Service']}")
+        seen_clusters = set()
+        for route in routes:
+            for t in route["Targets"]:
+                cname = f"{name}_{t['Service']}"
+                if cname in seen_clusters:
+                    continue
+                seen_clusters.add(cname)
+                clusters.append({
+                    "name": cname,
+                    "type": "STATIC",
+                    "connect_timeout": "5s",
+                    "transport_socket": upstream_tls,
+                    "load_assignment": _endpoints(
+                        cname, t.get("Endpoints", [])),
+                })
+        is_http = up.get("Protocol", "tcp") in ("http", "http2", "grpc")
+        if is_http and len(routes) > 1:
+            # service-router → HTTP connection manager + route config
+            filt = _http_conn_manager(name, routes)
         else:
             # discovery-chain splits → weighted clusters
-            filt = {
-                "name": "envoy.filters.network.tcp_proxy",
-                "typed_config": {
-                    "@type": "type.googleapis.com/envoy.extensions."
-                             "filters.network.tcp_proxy.v3.TcpProxy",
-                    "stat_prefix": name,
-                    "weighted_clusters": {"clusters": [
-                        {"name": f"{name}_{t['Service']}",
-                         "weight": int(round(t["Weight"]))}
-                        for t in targets]},
-                }}
+            filt = _tcp_filter(name, name, routes[-1]["Targets"])
         listeners.append({
             "name": name,
             "address": _addr("127.0.0.1", up["LocalBindPort"]),
@@ -163,6 +187,136 @@ def _tcp_proxy(stat_prefix: str, cluster: str) -> dict[str, Any]:
     }
 
 
+def _route_match(match: Optional[dict[str, Any]]) -> dict[str, Any]:
+    """service-router Match.HTTP → Envoy RouteMatch (xds routes.go
+    makeRouteMatch): one path kind, header/query/method constraints."""
+    http = (match or {}).get("HTTP") or {}
+    out: dict[str, Any] = {}
+    if http.get("PathExact"):
+        out["path"] = http["PathExact"]
+    elif http.get("PathRegex"):
+        out["safe_regex"] = {"regex": http["PathRegex"]}
+    else:
+        out["prefix"] = http.get("PathPrefix") or "/"
+    headers = []
+    for h in http.get("Header") or []:
+        hm: dict[str, Any] = {"name": h.get("Name", "")}
+        if h.get("Present"):
+            hm["present_match"] = True
+        elif h.get("Exact") is not None:
+            hm["string_match"] = {"exact": h["Exact"]}
+        elif h.get("Prefix") is not None:
+            hm["string_match"] = {"prefix": h["Prefix"]}
+        elif h.get("Suffix") is not None:
+            hm["string_match"] = {"suffix": h["Suffix"]}
+        elif h.get("Regex") is not None:
+            hm["string_match"] = {"safe_regex": {"regex": h["Regex"]}}
+        else:
+            hm["present_match"] = True
+        if h.get("Invert"):
+            hm["invert_match"] = True
+        headers.append(hm)
+    if http.get("Methods"):
+        headers.append({"name": ":method", "string_match": {
+            "safe_regex": {"regex": "|".join(http["Methods"])}}})
+    if headers:
+        out["headers"] = headers
+    qps = []
+    for q in http.get("QueryParam") or []:
+        qm: dict[str, Any] = {"name": q.get("Name", "")}
+        if q.get("Present"):
+            qm["present_match"] = True
+        elif q.get("Exact") is not None:
+            qm["string_match"] = {"exact": q["Exact"]}
+        elif q.get("Regex") is not None:
+            qm["string_match"] = {"safe_regex": {"regex": q["Regex"]}}
+        else:
+            qm["present_match"] = True
+        qps.append(qm)
+    if qps:
+        out["query_parameters"] = qps
+    return out
+
+
+def _route_action(prefix: str, route: dict[str, Any]) -> dict[str, Any]:
+    """Compiled route → Envoy RouteAction: target cluster(s) plus the
+    Destination options (rewrite/timeout/retries). ONE builder serves
+    the sidecar and ingress paths so router semantics can't diverge."""
+    dest = route.get("Destination") or {}
+    targets = route["Targets"]
+    action: dict[str, Any]
+    if len(targets) == 1:
+        action = {"cluster": f"{prefix}_{targets[0]['Service']}"}
+    else:
+        action = {"weighted_clusters": {"clusters": [
+            {"name": f"{prefix}_{t['Service']}",
+             "weight": int(round(t["Weight"]))} for t in targets]}}
+    if dest.get("PrefixRewrite"):
+        action["prefix_rewrite"] = dest["PrefixRewrite"]
+    if dest.get("RequestTimeout"):
+        t = dest["RequestTimeout"]
+        action["timeout"] = t if isinstance(t, str) else f"{t}s"
+    retry_on = []
+    if dest.get("RetryOnConnectFailure"):
+        retry_on.append("connect-failure")
+    if dest.get("RetryOnStatusCodes"):
+        retry_on.append("retriable-status-codes")
+    if retry_on or dest.get("NumRetries"):
+        action["retry_policy"] = {
+            "retry_on": ",".join(retry_on) or "connect-failure",
+            "num_retries": int(dest.get("NumRetries", 1)),
+            **({"retriable_status_codes": dest["RetryOnStatusCodes"]}
+               if dest.get("RetryOnStatusCodes") else {})}
+    return action
+
+
+def _tcp_filter(stat_prefix: str, cluster_prefix: str,
+                targets: list[dict[str, Any]]) -> dict[str, Any]:
+    """tcp_proxy to one target, or weighted_clusters across a split."""
+    if len(targets) == 1:
+        return _tcp_proxy(stat_prefix,
+                          f"{cluster_prefix}_{targets[0]['Service']}")
+    return {
+        "name": "envoy.filters.network.tcp_proxy",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions."
+                     "filters.network.tcp_proxy.v3.TcpProxy",
+            "stat_prefix": stat_prefix,
+            "weighted_clusters": {"clusters": [
+                {"name": f"{cluster_prefix}_{t['Service']}",
+                 "weight": int(round(t["Weight"]))}
+                for t in targets]},
+        }}
+
+
+def _http_conn_manager(name: str,
+                       routes: list[dict[str, Any]]) -> dict[str, Any]:
+    """Routed upstream listener: HTTP connection manager whose route
+    config maps each service-router route (in order, default last) to
+    its compiled targets."""
+    envoy_routes = [{"match": _route_match(route.get("Match")),
+                     "route": _route_action(name, route)}
+                    for route in routes]
+    return {
+        "name": "envoy.filters.network.http_connection_manager",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters."
+                     "network.http_connection_manager.v3."
+                     "HttpConnectionManager",
+            "stat_prefix": name,
+            "http_filters": [{
+                "name": "envoy.filters.http.router",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "filters.http.router.v3.Router"}}],
+            "route_config": {
+                "name": name,
+                "virtual_hosts": [{
+                    "name": name, "domains": ["*"],
+                    "routes": envoy_routes}]},
+        }}
+
+
 def _endpoints(cluster: str, eps: list[dict[str, Any]]) -> dict[str, Any]:
     return {
         "cluster_name": cluster,
@@ -171,3 +325,189 @@ def _endpoints(cluster: str, eps: list[dict[str, Any]]) -> dict[str, Any]:
                 "endpoint": {"address": _addr(e["Address"], e["Port"])}}
                 for e in eps]}],
     }
+
+
+def _assemble(snapshot: dict[str, Any], admin_port: int,
+              listeners: list, clusters: list) -> dict[str, Any]:
+    return {
+        "admin": {"address": _addr("127.0.0.1", admin_port)},
+        "node": {"id": snapshot["ProxyID"],
+                 "cluster": snapshot["Service"],
+                 "metadata": {"namespace": "default",
+                              "trust_domain": snapshot["TrustDomain"]}},
+        "static_resources": {"listeners": listeners,
+                             "clusters": clusters},
+    }
+
+
+def _ingress_bootstrap(snapshot: dict[str, Any],
+                       admin_port: int) -> dict[str, Any]:
+    """Ingress gateway: outside traffic in, dialed into the mesh over
+    mTLS with the GATEWAY's identity (agent/xds for ingress-gateway).
+    One Envoy listener per config-entry listener; http listeners get a
+    virtual host per service keyed on its Hosts."""
+    upstream_tls = {
+        "name": "tls",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions."
+                     "transport_sockets.tls.v3.UpstreamTlsContext",
+            "common_tls_context":
+                _tls_context(snapshot)["common_tls_context"]}}
+    listeners, clusters, seen = [], [], set()
+    addr = snapshot.get("Address") or "0.0.0.0"
+    for lst in snapshot.get("Listeners") or []:
+        port = lst["Port"]
+        lname = f"ingress_{port}"
+        for s in lst["Services"]:
+            for route in s["Routes"]:
+                for t in route["Targets"]:
+                    cname = f"ingress_{s['Name']}_{t['Service']}"
+                    if cname in seen:
+                        continue
+                    seen.add(cname)
+                    clusters.append({
+                        "name": cname, "type": "STATIC",
+                        "connect_timeout": "5s",
+                        "transport_socket": upstream_tls,
+                        "load_assignment": _endpoints(
+                            cname, t.get("Endpoints", []))})
+        if lst["Protocol"] == "tcp":
+            # tcp listeners route to exactly one service (its splits
+            # still become weighted clusters)
+            svc = lst["Services"][0] if lst["Services"] else None
+            if svc is None:
+                continue
+            filt = _tcp_filter(lname, f"ingress_{svc['Name']}",
+                               svc["Routes"][-1]["Targets"])
+            listeners.append({
+                "name": lname, "address": _addr(addr, port),
+                "filter_chains": [{"filters": [filt]}]})
+        else:
+            vhosts = []
+            for s in lst["Services"]:
+                domains = s["Hosts"] or (
+                    ["*"] if len(lst["Services"]) == 1
+                    else [s["Name"], f"{s['Name']}.ingress.*"])
+                routes = [{"match": _route_match(route.get("Match")),
+                           "route": _route_action(
+                               f"ingress_{s['Name']}", route)}
+                          for route in s["Routes"]]
+                vhosts.append({"name": s["Name"], "domains": domains,
+                               "routes": routes})
+            hcm = {
+                "name": "envoy.filters.network."
+                        "http_connection_manager",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "filters.network."
+                             "http_connection_manager.v3."
+                             "HttpConnectionManager",
+                    "stat_prefix": lname,
+                    "http_filters": [{
+                        "name": "envoy.filters.http.router",
+                        "typed_config": {
+                            "@type": "type.googleapis.com/envoy."
+                                     "extensions.filters.http."
+                                     "router.v3.Router"}}],
+                    "route_config": {
+                        "name": lname, "virtual_hosts": vhosts},
+                }}
+            listeners.append({
+                "name": lname, "address": _addr(addr, port),
+                "filter_chains": [{"filters": [hcm]}]})
+    return _assemble(snapshot, admin_port, listeners, clusters)
+
+
+def _terminating_bootstrap(snapshot: dict[str, Any],
+                           admin_port: int) -> dict[str, Any]:
+    """Terminating gateway: one mTLS listener whose filter chains match
+    mesh SNI per linked service; each chain presents THAT service's
+    leaf, enforces its intentions via RBAC, and forwards to the
+    external instances."""
+    listeners, clusters = [], []
+    chains = []
+    default_allow = snapshot.get("DefaultAllow", True)
+    dc = snapshot.get("Datacenter", "")
+    domain = snapshot.get("TrustDomain", "")
+    for s in snapshot.get("Services") or []:
+        name = s["Name"]
+        cname = f"external_{name}"
+        clusters.append({
+            "name": cname, "type": "STATIC",
+            "connect_timeout": "5s",
+            "load_assignment": _endpoints(cname,
+                                          s.get("Endpoints", []))})
+        filters = _rbac_filters(s.get("Intentions") or [],
+                                default_allow)
+        filters.append(_tcp_proxy(cname, cname))
+        chains.append({
+            # exact SNI strings only: Envoy's server_names supports
+            # exact and *.suffix forms, NOT trailing wildcards
+            "filter_chain_match": {"server_names": [
+                name, f"{name}.default.{dc}.internal.{domain}"]},
+            "transport_socket": {
+                "name": "tls",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "transport_sockets.tls.v3."
+                             "DownstreamTlsContext",
+                    **_tls_context(snapshot, leaf=s["Leaf"])}},
+            "filters": filters})
+    listeners.append({
+        "name": "terminating_gateway",
+        "address": _addr(snapshot.get("Address") or "0.0.0.0",
+                         snapshot.get("Port") or 0),
+        "listener_filters": [{
+            "name": "envoy.filters.listener.tls_inspector",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "filters.listener.tls_inspector.v3."
+                         "TlsInspector"}}],
+        "filter_chains": chains})
+    return _assemble(snapshot, admin_port, listeners, clusters)
+
+
+def _mesh_bootstrap(snapshot: dict[str, Any],
+                    admin_port: int) -> dict[str, Any]:
+    """Mesh gateway: pure SNI router, NO TLS termination — end-to-end
+    mTLS stays between the sidecars. Local service SNI → that
+    service's sidecars; *.dc SNI → the remote DC's gateways."""
+    dc = snapshot.get("Datacenter", "")
+    domain = snapshot.get("TrustDomain", "")
+    listeners, clusters, chains = [], [], []
+    for s in snapshot.get("LocalServices") or []:
+        name = s["Name"]
+        cname = f"local_{name}"
+        clusters.append({
+            "name": cname, "type": "STATIC",
+            "connect_timeout": "5s",
+            "load_assignment": _endpoints(cname,
+                                          s.get("Endpoints", []))})
+        chains.append({
+            "filter_chain_match": {"server_names": [
+                f"{name}.default.{dc}.internal.{domain}"]},
+            "filters": [_tcp_proxy(cname, cname)]})
+    for r in snapshot.get("RemoteGateways") or []:
+        rdc = r["Datacenter"]
+        cname = f"remote_{rdc}"
+        clusters.append({
+            "name": cname, "type": "STATIC",
+            "connect_timeout": "5s",
+            "load_assignment": _endpoints(cname,
+                                          r.get("Endpoints", []))})
+        chains.append({
+            "filter_chain_match": {"server_names": [
+                f"*.default.{rdc}.internal.{domain}"]},
+            "filters": [_tcp_proxy(cname, cname)]})
+    listeners.append({
+        "name": "mesh_gateway",
+        "address": _addr(snapshot.get("Address") or "0.0.0.0",
+                         snapshot.get("Port") or 0),
+        "listener_filters": [{
+            "name": "envoy.filters.listener.tls_inspector",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "filters.listener.tls_inspector.v3."
+                         "TlsInspector"}}],
+        "filter_chains": chains})
+    return _assemble(snapshot, admin_port, listeners, clusters)

@@ -12,10 +12,45 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+GATEWAY_KINDS = ("ingress-gateway", "terminating-gateway",
+                 "mesh-gateway")
+
+
+def _entry_getter(rpc):
+    def get_entry(kind: str, name: str):
+        try:
+            res = rpc("ConfigEntry.Get", {"Kind": kind, "Name": name,
+                                          "AllowStale": True})
+            return res.get("Entry")
+        except Exception:  # noqa: BLE001
+            return None
+    return get_entry
+
+
+def _lookup_endpoints(rpc, svc: str, sidecar: bool = True,
+                      dc: str = "") -> list[dict[str, Any]]:
+    """Healthy endpoints for a service — its sidecars first (mesh
+    traffic dials proxies), falling back to the service itself."""
+    args: dict[str, Any] = {"MustBePassing": True, "AllowStale": True}
+    if dc:
+        args["Datacenter"] = dc
+    nodes = []
+    if sidecar:
+        eps = rpc("Health.ServiceNodes", {
+            **args, "ServiceName": f"{svc}-sidecar-proxy"})
+        nodes = eps.get("Nodes") or []
+    if not nodes:
+        eps = rpc("Health.ServiceNodes", {**args, "ServiceName": svc})
+        nodes = eps.get("Nodes") or []
+    return [{"Address": e["Service"]["Address"]
+             or e["Node"]["Address"],
+             "Port": e["Service"]["Port"]} for e in nodes]
+
 
 def assemble_snapshot(agent, proxy_id: str,
                       rpc=None) -> Optional[dict[str, Any]]:
-    """Build the ConfigSnapshot for a locally-registered connect proxy.
+    """Build the ConfigSnapshot for a locally-registered connect proxy
+    or gateway (dispatches on the registration's Kind).
 
     `rpc(method, args)` must carry the caller's auth token (the HTTP
     layer passes its token-injecting closure); defaults to the agent's
@@ -23,7 +58,11 @@ def assemble_snapshot(agent, proxy_id: str,
     rpc = rpc or agent.rpc
     services = agent.local.list_services()
     proxy = services.get(proxy_id)
-    if proxy is None or proxy.kind != "connect-proxy":
+    if proxy is None:
+        return None
+    if proxy.kind in GATEWAY_KINDS:
+        return _gateway_snapshot(agent, proxy, rpc)
+    if proxy.kind != "connect-proxy":
         return None
     dest_name = proxy.proxy.get("DestinationServiceName", "")
     dest_id = proxy.proxy.get("DestinationServiceID", "")
@@ -34,47 +73,37 @@ def assemble_snapshot(agent, proxy_id: str,
     leaf = rpc("ConnectCA.Sign", {"Service": dest_name})
     roots = rpc("ConnectCA.Roots", {})
 
-    from consul_tpu.connect.chain import compile_targets
+    from consul_tpu.connect.chain import compile_chain
 
-    def get_entry(kind: str, name: str):
-        try:
-            res = rpc("ConfigEntry.Get", {"Kind": kind, "Name": name,
-                                          "AllowStale": True})
-            return res.get("Entry")
-        except Exception:  # noqa: BLE001
-            return None
+    get_entry = _entry_getter(rpc)
+    ep_memo: dict[str, list] = {}
 
     def lookup_endpoints(svc: str):
-        eps = rpc("Health.ServiceNodes", {
-            "ServiceName": f"{svc}-sidecar-proxy",
-            "MustBePassing": True, "AllowStale": True})
-        nodes = eps.get("Nodes") or []
-        if not nodes:
-            # no sidecar instances: fall back to the service itself
-            eps = rpc("Health.ServiceNodes", {
-                "ServiceName": svc, "MustBePassing": True,
-                "AllowStale": True})
-            nodes = eps.get("Nodes") or []
-        return [{"Address": e["Service"]["Address"]
-                 or e["Node"]["Address"],
-                 "Port": e["Service"]["Port"]} for e in nodes]
+        # a router can reference the same service from many routes —
+        # one Health.ServiceNodes pair per distinct service
+        if svc not in ep_memo:
+            ep_memo[svc] = _lookup_endpoints(rpc, svc)
+        return ep_memo[svc]
 
     upstreams = []
     for u in proxy.proxy.get("Upstreams") or []:
         uname = u.get("DestinationName", "")
         error = ""
-        # discovery chain: resolver redirects + splitter weights
-        targets = compile_targets(uname, get_entry)
+        # discovery chain: L7 routes + splitter weights + resolver
+        # redirects; the LAST route is the default catch-all
+        chain = compile_chain(uname, get_entry)
         try:
-            for t in targets:
-                t["Endpoints"] = lookup_endpoints(t["Service"])
-                if not t["Endpoints"] and t.get("Failover"):
-                    t["Endpoints"] = lookup_endpoints(t["Failover"])
-                    t["UsingFailover"] = bool(t["Endpoints"])
+            for route in chain["Routes"]:
+                for t in route["Targets"]:
+                    t["Endpoints"] = lookup_endpoints(t["Service"])
+                    if not t["Endpoints"] and t.get("Failover"):
+                        t["Endpoints"] = lookup_endpoints(t["Failover"])
+                        t["UsingFailover"] = bool(t["Endpoints"])
         except Exception as e:  # noqa: BLE001
             # a degraded lookup must be VISIBLE, not an empty cluster
             # that silently blackholes traffic
             error = f"{type(e).__name__}: {e}"
+        targets = chain["Routes"][-1]["Targets"]  # default route
         check = rpc("Intention.Check", {
             "SourceName": dest_name, "DestinationName": uname})
         upstreams.append({
@@ -82,6 +111,8 @@ def assemble_snapshot(agent, proxy_id: str,
             "LocalBindPort": u.get("LocalBindPort", 0),
             "Allowed": check.get("Allowed", False),
             "Error": error,
+            "Protocol": chain["Protocol"],
+            "Routes": chain["Routes"],
             "Targets": targets,
             # flattened view (back-compat for single-target consumers)
             "Endpoints": [e for t in targets
@@ -110,3 +141,129 @@ def assemble_snapshot(agent, proxy_id: str,
         "Leaf": leaf,
         "Upstreams": upstreams,
     }
+
+
+def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
+    """ConfigSnapshot for the three gateway kinds (agent/proxycfg/
+    ingress_gateway.go, terminating_gateway.go, mesh_gateway.go).
+
+    ingress:     config-entry listeners -> per-service compiled chains
+                 dialed over mTLS with the gateway's own identity
+    terminating: per linked service, the SERVICE's leaf (the gateway
+                 answers mesh SNI as that service), its external
+                 (non-sidecar) endpoints, and its intentions
+    mesh:        SNI routing table: local mesh services' sidecar
+                 endpoints + remote DCs' gateway endpoints (passthrough,
+                 no TLS termination)
+    """
+    from consul_tpu.connect.chain import compile_chain
+
+    get_entry = _entry_getter(rpc)
+    gw_name = proxy.service
+    leaf = rpc("ConnectCA.Sign", {"Service": gw_name})
+    roots = rpc("ConnectCA.Roots", {})
+    snap: dict[str, Any] = {
+        "ProxyID": proxy.id,
+        "Kind": proxy.kind,
+        "Service": gw_name,
+        "Proxy": proxy.proxy,
+        "Address": proxy.address or agent.advertise_addr(),
+        "Port": proxy.port,
+        "Roots": roots.get("Roots", []),
+        "TrustDomain": roots.get("TrustDomain", ""),
+        "Leaf": leaf,
+        "Datacenter": agent.config.datacenter,
+    }
+
+    if proxy.kind == "ingress-gateway":
+        entry = get_entry("ingress-gateway", gw_name) or {}
+        ep_memo: dict[str, list] = {}
+        listeners = []
+        for lst in entry.get("Listeners") or []:
+            svcs = []
+            for s in lst.get("Services") or []:
+                name = s.get("Name", "")
+                chain = compile_chain(name, get_entry)
+                for route in chain["Routes"]:
+                    for t in route["Targets"]:
+                        if t["Service"] not in ep_memo:
+                            ep_memo[t["Service"]] = _lookup_endpoints(
+                                rpc, t["Service"])
+                        t["Endpoints"] = ep_memo[t["Service"]]
+                svcs.append({"Name": name,
+                             "Hosts": s.get("Hosts") or [],
+                             "Protocol": chain["Protocol"],
+                             "Routes": chain["Routes"]})
+            listeners.append({
+                "Port": int(lst.get("Port") or 0),
+                "Protocol": (lst.get("Protocol") or "tcp").lower(),
+                "Services": svcs})
+        snap["Listeners"] = listeners
+
+    elif proxy.kind == "terminating-gateway":
+        entry = get_entry("terminating-gateway", gw_name) or {}
+        default_allow = not agent.config.acl_enabled \
+            or agent.config.acl_default_policy == "allow"
+        svcs = []
+        for s in entry.get("Services") or []:
+            name = s.get("Name", "")
+            matches = rpc("Intention.Match", {"DestinationName": name})
+            svcs.append({
+                "Name": name,
+                # the gateway presents the SERVICE's identity to mesh
+                # callers — each linked service gets its own leaf
+                "Leaf": rpc("ConnectCA.Sign", {"Service": name}),
+                # external instances are registered directly (no
+                # sidecar): dial the service itself
+                "Endpoints": _lookup_endpoints(rpc, name,
+                                               sidecar=False),
+                "Intentions": matches.get("Matches", []),
+            })
+        snap["Services"] = svcs
+        snap["DefaultAllow"] = default_allow
+
+    else:  # mesh-gateway
+        local_dc = agent.config.datacenter
+        listing = rpc("Catalog.ListServices", {"AllowStale": True})
+        names = sorted((listing.get("Services") or {}).keys()
+                       if isinstance(listing.get("Services"), dict)
+                       else listing.get("Services") or [])
+        local = []
+        for name in names:
+            if not name.endswith("-sidecar-proxy"):
+                continue
+            svc = name[:-len("-sidecar-proxy")]
+            eps = _lookup_endpoints(rpc, svc)
+            if eps:
+                local.append({"Name": svc, "Endpoints": eps})
+        remote = []
+        try:
+            dcs = rpc("Catalog.ListDatacenters", {}) or []
+        except Exception:  # noqa: BLE001
+            dcs = []
+        for dc in dcs:
+            if dc == local_dc:
+                continue
+            # remote gateways are found by Kind (mesh_gateway.go uses
+            # ServiceDump with ServiceKind) — their service NAME in the
+            # remote DC is arbitrary
+            eps = []
+            try:
+                res = rpc("Catalog.ServiceNodes", {
+                    "ServiceKind": "mesh-gateway", "Datacenter": dc,
+                    "AllowStale": True})
+                eps = [{"Address": e.get("ServiceAddress")
+                        or e.get("Address", ""),
+                        "Port": e.get("ServicePort", 0)}
+                       for e in res.get("ServiceNodes") or []]
+            except Exception:  # noqa: BLE001
+                pass
+            if not eps:
+                eps = _lookup_endpoints(rpc, gw_name, sidecar=False,
+                                        dc=dc)
+            if eps:
+                remote.append({"Datacenter": dc, "Endpoints": eps})
+        snap["LocalServices"] = local
+        snap["RemoteGateways"] = remote
+
+    return snap

@@ -100,6 +100,22 @@ def register_endpoints(srv) -> None:
 
     def catalog_service_nodes(args):
         svc = args.get("ServiceName", "")
+        kind = args.get("ServiceKind", "")
+        if kind and not svc:
+            # ServiceKind listing (how mesh gateways are discovered
+            # cross-DC); results filtered to readable services
+            az = authz(args)
+            return srv.blocking_query(
+                args, ("services", "nodes"), lambda: {
+                    "ServiceNodes": [
+                        {**n.to_dict(), **{
+                            "ServiceID": s.id,
+                            "ServiceName": s.service,
+                            "ServiceKind": s.kind,
+                            "ServiceAddress": s.address,
+                            "ServicePort": s.port}}
+                        for n, s in state.service_nodes_by_kind(kind)
+                        if az.service_read(s.service)]})
         require(authz(args).service_read(svc), f"service read on {svc!r}")
         tag = args.get("ServiceTag") or None
         near = args.get("Near", "")
@@ -108,7 +124,8 @@ def register_endpoints(srv) -> None:
                 {**n.to_dict(), **{
                     "ServiceID": s.id, "ServiceName": s.service,
                     "ServiceTags": s.tags, "ServiceAddress": s.address,
-                    "ServicePort": s.port, "ServiceMeta": s.meta}}
+                    "ServicePort": s.port, "ServiceMeta": s.meta,
+                    "ServiceKind": s.kind}}
                 for n, s in state.service_nodes(svc, tag)],
                 near, lambda e: e["Node"])})
 
@@ -804,8 +821,16 @@ def register_endpoints(srv) -> None:
     # ------------------------------------------------------- ConfigEntry
     def config_apply(args):
         require(authz(args).operator_write(), "operator write")
-        if (args.get("Entry") or {}).get("Kind") == "connect-ca":
+        entry = args.get("Entry") or {}
+        if entry.get("Kind") == "connect-ca":
             raise RPCError("Permission denied: reserved config kind")
+        if args.get("Op", "upsert") != "delete":
+            try:
+                from consul_tpu.connect.chain import validate_entry
+
+                validate_entry(entry)
+            except ValueError as exc:
+                raise RPCError(f"invalid config entry: {exc}") from exc
         return srv.forward_or_apply(MessageType.CONFIG_ENTRY, clean(args))
 
     def config_get(args):

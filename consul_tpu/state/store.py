@@ -233,6 +233,20 @@ class StateStore:
                     out.append((n, s))
             return sorted(out, key=lambda t: (t[0].node, t[1].id))
 
+    def service_nodes_by_kind(self, kind: str
+                              ) -> list[tuple[Node, NodeService]]:
+        """All instances of a service Kind (catalog ServiceKind filter;
+        how mesh gateways are discovered across DCs)."""
+        with self._lock:
+            out = []
+            for (node, _), s in self.tables["services"].items():
+                if s.kind != kind:
+                    continue
+                n = self.tables["nodes"].get(node)
+                if n is not None:
+                    out.append((n, s))
+            return sorted(out, key=lambda t: (t[0].node, t[1].id))
+
     def node_checks(self, node: str) -> list[HealthCheck]:
         with self._lock:
             return sorted((c for (n, _), c in self.tables["checks"].items()

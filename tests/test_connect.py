@@ -312,3 +312,112 @@ def test_discovery_chain_in_proxy_snapshot(agent, client):
         {("upstream_db2_db2", 75), ("upstream_db2_db2-canary", 25)}
     # cleanup the splitter so other tests see plain resolution
     client.delete("/v1/config/service-splitter/db2")
+
+
+def test_service_router_compile_unit():
+    """Router layering (config_entry_discoverychain.go ServiceRouter):
+    routes compile on top of splits/redirects, HTTP protocols only."""
+    from consul_tpu.connect.chain import compile_chain, validate_entry
+
+    entries = {
+        ("service-defaults", "api"): {"Protocol": "http"},
+        ("service-router", "api"): {"Routes": [
+            {"Match": {"HTTP": {"PathPrefix": "/v2"}},
+             "Destination": {"Service": "api-v2"}},
+            {"Match": {"HTTP": {"Header": [
+                {"Name": "x-debug", "Present": True}]}},
+             "Destination": {"Service": "api-debug",
+                             "NumRetries": 3}}]},
+        ("service-splitter", "api-v2"): {"Splits": [
+            {"Weight": 50, "Service": "api-v2"},
+            {"Weight": 50, "Service": "api-v2-canary"}]},
+        ("service-router", "tcp-svc"): {"Routes": [
+            {"Match": {"HTTP": {"PathPrefix": "/x"}},
+             "Destination": {"Service": "elsewhere"}}]},
+    }
+    get = lambda kind, name: entries.get((kind, name))
+    chain = compile_chain("api", get)
+    assert chain["Protocol"] == "http"
+    assert len(chain["Routes"]) == 3  # 2 router routes + default
+    # route 1 resolves through api-v2's splitter
+    assert [(t["Service"], t["Weight"])
+            for t in chain["Routes"][0]["Targets"]] == \
+        [("api-v2", 50.0), ("api-v2-canary", 50.0)]
+    assert chain["Routes"][1]["Destination"]["NumRetries"] == 3
+    # default catch-all is last and matches everything
+    assert chain["Routes"][-1]["Match"] is None
+    assert chain["Routes"][-1]["Targets"][0]["Service"] == "api"
+    # router over a tcp service is ignored at the protocol gate
+    tcp = compile_chain("tcp-svc", get)
+    assert len(tcp["Routes"]) == 1 and tcp["Routes"][0]["Match"] is None
+
+    # validation: bad shapes are rejected before raft
+    with pytest.raises(ValueError, match="one of"):
+        validate_entry({"Kind": "service-router", "Routes": [
+            {"Match": {"HTTP": {"PathExact": "/a",
+                                "PathPrefix": "/b"}}}]})
+    with pytest.raises(ValueError, match="begin with"):
+        validate_entry({"Kind": "service-router", "Routes": [
+            {"Match": {"HTTP": {"PathPrefix": "no-slash"}}}]})
+    with pytest.raises(ValueError, match="Splits"):
+        validate_entry({"Kind": "service-splitter"})
+
+
+def test_service_router_in_snapshot_and_envoy(agent, client):
+    """An L7 router on an upstream materializes as an HTTP connection
+    manager with ordered route matches (xds routes.go)."""
+    from consul_tpu.api import APIError
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    client.put("/v1/config", body={
+        "Kind": "service-defaults", "Name": "db2", "Protocol": "http"})
+    client.put("/v1/config", body={
+        "Kind": "service-router", "Name": "db2", "Routes": [
+            {"Match": {"HTTP": {"PathPrefix": "/v2",
+                                "Methods": ["GET", "PUT"]}},
+             "Destination": {"Service": "db2-canary",
+                             "PrefixRewrite": "/",
+                             "RequestTimeout": 15,
+                             "NumRetries": 2,
+                             "RetryOnConnectFailure": True}}]})
+    try:
+        snap = client.get("/v1/agent/connect/proxy/api2-sidecar-proxy")
+        up = next(u for u in snap["Upstreams"]
+                  if u["DestinationName"] == "db2")
+        assert up["Protocol"] == "http"
+        assert len(up["Routes"]) == 2
+        assert up["Routes"][0]["Destination"]["Service"] == "db2-canary"
+        assert up["Routes"][-1]["Match"] is None
+
+        cfg = bootstrap_config(snap)
+        lst = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "upstream_db2")
+        hcm = lst["filter_chains"][0]["filters"][0]
+        assert hcm["name"] == \
+            "envoy.filters.network.http_connection_manager"
+        routes = hcm["typed_config"]["route_config"][
+            "virtual_hosts"][0]["routes"]
+        assert routes[0]["match"]["prefix"] == "/v2"
+        assert any(h["name"] == ":method"
+                   for h in routes[0]["match"]["headers"])
+        act = routes[0]["route"]
+        assert act["cluster"] == "upstream_db2_db2-canary"
+        assert act["prefix_rewrite"] == "/"
+        assert act["timeout"] == "15s"
+        assert act["retry_policy"]["num_retries"] == 2
+        # default catch-all still routes to db2 itself
+        assert routes[-1]["match"] == {"prefix": "/"}
+        assert routes[-1]["route"]["cluster"] == "upstream_db2_db2"
+        # both clusters materialized
+        names = {c["name"] for c in cfg["static_resources"]["clusters"]}
+        assert {"upstream_db2_db2", "upstream_db2_db2-canary"} <= names
+
+        # invalid router rejected at apply time
+        with pytest.raises(APIError):
+            client.put("/v1/config", body={
+                "Kind": "service-router", "Name": "db2",
+                "Routes": [{"Match": {"HTTP": {
+                    "PathPrefix": "bad"}}}]})
+    finally:
+        client.delete("/v1/config/service-router/db2")
+        client.delete("/v1/config/service-defaults/db2")

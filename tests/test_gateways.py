@@ -1,0 +1,227 @@
+"""Gateway tests: ingress / terminating / mesh snapshots + Envoy
+materialization.
+
+Reference behaviors: agent/proxycfg/{ingress_gateway,
+terminating_gateway, mesh_gateway}.go + the xDS builders for each kind
+(agent/xds/listeners.go gateway paths). Gateways register as catalog
+services with a Kind and compile their config entries into listener/
+cluster sets.
+"""
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api import APIError, ConsulClient
+from consul_tpu.config import load
+from consul_tpu.connect.envoy import bootstrap_config
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(load(dev=True, overrides={"node_name": "gw-agent"}))
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="leader")
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(agent):
+    return ConsulClient(agent.http.addr)
+
+
+def test_ingress_gateway_snapshot_and_bootstrap(agent, client):
+    # a mesh service behind a sidecar, reachable through the gateway
+    client.service_register({
+        "Name": "web", "ID": "web", "Port": 8080,
+        "Check": {"TTL": "60s"}, "Connect": {"SidecarService": {}}})
+    client.check_pass("service:web")
+    client.service_register({
+        "Name": "my-ingress", "ID": "my-ingress", "Port": 8443,
+        "Kind": "ingress-gateway"})
+    client.put("/v1/config", body={
+        "Kind": "service-defaults", "Name": "web", "Protocol": "http"})
+    client.put("/v1/config", body={
+        "Kind": "ingress-gateway", "Name": "my-ingress",
+        "Listeners": [
+            {"Port": 8080, "Protocol": "http",
+             "Services": [{"Name": "web",
+                           "Hosts": ["web.example.com"]}]},
+        ]})
+    wait_for(lambda: client.health_service("web-sidecar-proxy"),
+             what="web sidecar")
+    try:
+        snap = client.get("/v1/agent/connect/proxy/my-ingress")
+        assert snap["Kind"] == "ingress-gateway"
+        # the gateway dials the mesh with its OWN identity
+        assert snap["Leaf"]["ServiceURI"].endswith("/svc/my-ingress")
+        lst = snap["Listeners"][0]
+        assert lst["Port"] == 8080 and lst["Protocol"] == "http"
+        web = lst["Services"][0]
+        assert web["Name"] == "web" and web["Protocol"] == "http"
+        assert web["Routes"][-1]["Targets"][0]["Endpoints"]
+
+        cfg = bootstrap_config(snap)
+        l0 = cfg["static_resources"]["listeners"][0]
+        assert l0["name"] == "ingress_8080"
+        hcm = l0["filter_chains"][0]["filters"][0]
+        assert hcm["name"] == \
+            "envoy.filters.network.http_connection_manager"
+        vh = hcm["typed_config"]["route_config"]["virtual_hosts"][0]
+        assert vh["domains"] == ["web.example.com"]
+        assert vh["routes"][-1]["route"]["cluster"] == \
+            "ingress_web_web"
+        # upstream cluster dials sidecars over mTLS
+        cl = next(c for c in cfg["static_resources"]["clusters"]
+                  if c["name"] == "ingress_web_web")
+        assert cl["transport_socket"]["name"] == "tls"
+        assert cl["load_assignment"]["endpoints"][0]["lb_endpoints"]
+
+        # invalid: tcp listener with two services is rejected
+        with pytest.raises(APIError):
+            client.put("/v1/config", body={
+                "Kind": "ingress-gateway", "Name": "my-ingress",
+                "Listeners": [{"Port": 9, "Protocol": "tcp",
+                               "Services": [{"Name": "a"},
+                                            {"Name": "b"}]}]})
+    finally:
+        client.delete("/v1/config/ingress-gateway/my-ingress")
+        client.delete("/v1/config/service-defaults/web")
+
+
+def test_terminating_gateway_snapshot_and_bootstrap(agent, client):
+    # an EXTERNAL service: registered directly, no sidecar
+    client.service_register({
+        "Name": "legacy-db", "ID": "legacy-db", "Port": 5432,
+        "Address": "10.1.2.3"})
+    client.service_register({
+        "Name": "my-term", "ID": "my-term", "Port": 8444,
+        "Kind": "terminating-gateway"})
+    client.put("/v1/config", body={
+        "Kind": "terminating-gateway", "Name": "my-term",
+        "Services": [{"Name": "legacy-db"}]})
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "cron", "DestinationName": "legacy-db",
+        "Action": "deny"})
+    wait_for(lambda: client.health_service("legacy-db"),
+             what="legacy-db in catalog")
+    try:
+        snap = client.get("/v1/agent/connect/proxy/my-term")
+        assert snap["Kind"] == "terminating-gateway"
+        svc = snap["Services"][0]
+        # the gateway answers mesh SNI AS the service
+        assert svc["Leaf"]["ServiceURI"].endswith("/svc/legacy-db")
+        assert svc["Endpoints"] == [
+            {"Address": "10.1.2.3", "Port": 5432}]
+        assert any(i["SourceName"] == "cron"
+                   for i in svc["Intentions"])
+
+        cfg = bootstrap_config(snap)
+        l0 = cfg["static_resources"]["listeners"][0]
+        assert l0["name"] == "terminating_gateway"
+        chain = l0["filter_chains"][0]
+        assert "legacy-db" in \
+            chain["filter_chain_match"]["server_names"]
+        # presents the service's leaf, requires client certs
+        tls = chain["transport_socket"]["typed_config"]
+        assert tls["require_client_certificate"] is True
+        # intentions enforced at the gateway listener
+        assert chain["filters"][0]["name"] == \
+            "envoy.filters.network.rbac"
+        assert chain["filters"][-1]["typed_config"]["cluster"] == \
+            "external_legacy-db"
+        cl = next(c for c in cfg["static_resources"]["clusters"]
+                  if c["name"] == "external_legacy-db")
+        # plaintext to the external instance: no transport_socket
+        assert "transport_socket" not in cl
+    finally:
+        client.delete("/v1/config/terminating-gateway/my-term")
+
+
+def test_mesh_gateway_snapshot_and_bootstrap(agent, client):
+    client.service_register({
+        "Name": "mesh-gateway", "ID": "mesh-gateway", "Port": 8445,
+        "Kind": "mesh-gateway"})
+    snap = client.get("/v1/agent/connect/proxy/mesh-gateway")
+    assert snap["Kind"] == "mesh-gateway"
+    # local mesh services (with sidecars) appear in the SNI table
+    local = {s["Name"] for s in snap["LocalServices"]}
+    assert "web" in local
+    cfg = bootstrap_config(snap)
+    l0 = cfg["static_resources"]["listeners"][0]
+    assert l0["name"] == "mesh_gateway"
+    # SNI chains carry the trust-domain-qualified names, and the
+    # listener does NOT terminate TLS (end-to-end mTLS passthrough)
+    domain = snap["TrustDomain"]
+    dc = snap["Datacenter"]
+    chain = next(c for c in l0["filter_chains"]
+                 if f"web.default.{dc}.internal.{domain}"
+                 in c["filter_chain_match"]["server_names"])
+    assert "transport_socket" not in chain
+    assert chain["filters"][0]["typed_config"]["cluster"] == \
+        "local_web"
+    assert any(f["name"] == "envoy.filters.listener.tls_inspector"
+               for f in l0["listener_filters"])
+
+
+def test_rbac_precedence_filter_pair():
+    """Intention precedence maps to an ordered DENY→ALLOW filter pair:
+    exact deny beats wildcard allow, exact allow beats wildcard deny
+    (a single-action RBAC filter cannot express either)."""
+    from consul_tpu.connect.envoy import _rbac_filters
+
+    # default-deny + wildcard allow + exact deny: attacker must NOT
+    # ride the wildcard through
+    fs = _rbac_filters([
+        {"SourceName": "*", "Action": "allow"},
+        {"SourceName": "attacker", "Action": "deny"}],
+        default_allow=False)
+    assert [f["typed_config"]["rules"]["action"] for f in fs] == \
+        ["DENY", "ALLOW"]
+    deny_principals = fs[0]["typed_config"]["rules"]["policies"][
+        "consul-intentions"]["principals"]
+    assert deny_principals[0]["authenticated"]["principal_name"][
+        "suffix"] == "/svc/attacker"
+    allow_rules = fs[1]["typed_config"]["rules"]
+    assert allow_rules["policies"]["consul-intentions"][
+        "principals"] == [{"any": True}]
+
+    # default-allow + wildcard deny + exact allow: only web passes
+    fs = _rbac_filters([
+        {"SourceName": "*", "Action": "deny"},
+        {"SourceName": "web", "Action": "allow"}],
+        default_allow=True)
+    assert [f["typed_config"]["rules"]["action"] for f in fs] == \
+        ["ALLOW"]
+    # default-allow, no intentions: no filters at all
+    assert _rbac_filters([], default_allow=True) == []
+    # default-deny, no intentions: allow-nobody filter
+    fs = _rbac_filters([], default_allow=False)
+    assert fs[0]["typed_config"]["rules"] == \
+        {"action": "ALLOW", "policies": {}}
+
+
+def test_ingress_tcp_listener_keeps_split_weights():
+    """A tcp ingress listener over a split service must produce
+    weighted clusters, not silently send 100% to the first target."""
+    snap = {
+        "ProxyID": "gw", "Kind": "ingress-gateway", "Service": "gw",
+        "TrustDomain": "td", "Address": "0.0.0.0",
+        "Leaf": {"CertPEM": "C", "PrivateKeyPEM": "K"},
+        "Roots": [{"RootCert": "R"}],
+        "Listeners": [{"Port": 7000, "Protocol": "tcp", "Services": [
+            {"Name": "db", "Hosts": [], "Protocol": "tcp",
+             "Routes": [{"Match": None, "Destination": {},
+                         "Targets": [
+                 {"Service": "db", "Weight": 90.0, "Endpoints": []},
+                 {"Service": "db-canary", "Weight": 10.0,
+                  "Endpoints": []}]}]}]}],
+    }
+    cfg = bootstrap_config(snap)
+    filt = cfg["static_resources"]["listeners"][0][
+        "filter_chains"][0]["filters"][0]
+    wc = filt["typed_config"]["weighted_clusters"]["clusters"]
+    assert {(c["name"], c["weight"]) for c in wc} == \
+        {("ingress_db_db", 90), ("ingress_db_db-canary", 10)}

@@ -81,3 +81,37 @@ def test_unknown_dc_fails_cleanly(two_dcs):
 
     with pytest.raises(APIError, match="no path to datacenter"):
         c1.kv_get("x", dc="dc-mars")
+
+
+def test_mesh_gateway_discovers_remote_dc_gateways(two_dcs):
+    """Mesh gateways find remote-DC gateways by KIND over the WAN
+    (mesh_gateway.go watches ServiceKind=mesh-gateway per DC) — the
+    remote gateway's service NAME is arbitrary."""
+    a1, a2 = two_dcs
+    c1, c2 = ConsulClient(a1.http.addr), ConsulClient(a2.http.addr)
+    # dc1's gateway and dc2's gateway use DIFFERENT service names
+    c1.service_register({"Name": "gw-east", "ID": "gw-east",
+                         "Port": 8445, "Kind": "mesh-gateway"})
+    c2.service_register({"Name": "gw-west", "ID": "gw-west",
+                         "Port": 8446, "Address": "10.2.0.1",
+                         "Kind": "mesh-gateway"})
+    wait_for(lambda: any(
+        s.get("ServiceKind") == "mesh-gateway"
+        for s in c2.get("/v1/catalog/service/gw-west")),
+        what="dc2 gateway in catalog")
+    snap = c1.get("/v1/agent/connect/proxy/gw-east")
+    remotes = {r["Datacenter"]: r["Endpoints"]
+               for r in snap["RemoteGateways"]}
+    assert "dc2" in remotes
+    assert remotes["dc2"] == [{"Address": "10.2.0.1", "Port": 8446}]
+    # the bootstrap grows a wildcard SNI chain for dc2
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    cfg = bootstrap_config(snap)
+    l0 = cfg["static_resources"]["listeners"][0]
+    domain = snap["TrustDomain"]
+    chain = next(c for c in l0["filter_chains"]
+                 if c["filter_chain_match"]["server_names"][0]
+                 == f"*.default.dc2.internal.{domain}")
+    assert chain["filters"][0]["typed_config"]["cluster"] == \
+        "remote_dc2"
