@@ -1,0 +1,187 @@
+"""ACL auth methods: trusted-identity login → scoped tokens.
+
+Reference: agent/consul/authmethod/ (validator plugins), binding rules
+evaluated in acl_endpoint_login.go Login. The load-bearing method type
+is "jwt" (authmethod/jwtauth): verify a bearer JWS against configured
+public keys, check bound issuer/audiences, project claims through
+ClaimMappings into selector variables, then evaluate binding rules to
+decide what the resulting token may do. No external egress: JWKS URLs
+are out; static JWTValidationPubKeys are the supported key source.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import time
+from typing import Any, Optional
+
+
+class AuthError(Exception):
+    pass
+
+
+def _b64url(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def verify_jwt(bearer: str, config: dict[str, Any],
+               now: Optional[float] = None) -> dict[str, Any]:
+    """Validate a compact JWS and return its claims.
+
+    Checks: signature against any of JWTValidationPubKeys (ES256/RS256),
+    BoundIssuer, BoundAudiences, exp/nbf. Raises AuthError on any
+    failure — a login must never fall through to an unverified claim
+    set."""
+    try:
+        head_b64, payload_b64, sig_b64 = bearer.split(".")
+        header = json.loads(_b64url(head_b64))
+        claims = json.loads(_b64url(payload_b64))
+        sig = _b64url(sig_b64)
+    except Exception as exc:  # noqa: BLE001
+        raise AuthError(f"malformed JWT: {exc}") from exc
+
+    alg = header.get("alg", "")
+    keys = config.get("JWTValidationPubKeys") or []
+    if not keys:
+        raise AuthError("auth method has no JWTValidationPubKeys")
+    signed = f"{head_b64}.{payload_b64}".encode()
+    if not any(_check_sig(k, alg, signed, sig) for k in keys):
+        raise AuthError("JWT signature verification failed")
+
+    now = time.time() if now is None else now
+    if "exp" in claims and now >= float(claims["exp"]):
+        raise AuthError("JWT is expired")
+    if "nbf" in claims and now < float(claims["nbf"]):
+        raise AuthError("JWT not valid yet")
+    issuer = config.get("BoundIssuer")
+    if issuer and claims.get("iss") != issuer:
+        raise AuthError("JWT issuer is not allowed")
+    audiences = config.get("BoundAudiences") or []
+    if audiences:
+        aud = claims.get("aud")
+        auds = aud if isinstance(aud, list) else [aud]
+        if not any(a in audiences for a in auds):
+            raise AuthError("JWT audience is not allowed")
+    return claims
+
+
+def _check_sig(pub_pem: str, alg: str, signed: bytes, sig: bytes) -> bool:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec, padding, utils
+
+    try:
+        key = serialization.load_pem_public_key(pub_pem.encode())
+        if alg == "ES256":
+            # JWS ECDSA signatures are raw r||s; cryptography wants DER
+            half = len(sig) // 2
+            r = int.from_bytes(sig[:half], "big")
+            s = int.from_bytes(sig[half:], "big")
+            key.verify(utils.encode_dss_signature(r, s), signed,
+                       ec.ECDSA(hashes.SHA256()))
+        elif alg == "RS256":
+            key.verify(sig, signed, padding.PKCS1v15(), hashes.SHA256())
+        else:
+            return False
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def claim_vars(claims: dict[str, Any],
+               config: dict[str, Any]) -> dict[str, str]:
+    """Project claims through ClaimMappings into `value.<name>` selector
+    variables (jwtauth claim mapping). A mapping path may be dotted."""
+    out: dict[str, str] = {}
+    for path, name in (config.get("ClaimMappings") or {}).items():
+        cur: Any = claims
+        for part in path.split("."):
+            if not isinstance(cur, dict):
+                cur = None
+                break
+            cur = cur.get(part)
+        if cur is not None and not isinstance(cur, (dict, list)):
+            out[f"value.{name}"] = str(cur)
+    return out
+
+
+_SEL_TERM = re.compile(
+    r'^\s*([\w.]+)\s*(==|!=)\s*(?:"([^"]*)"|(\S+))\s*$')
+
+
+def evaluate_selector(selector: str, vars: dict[str, str]) -> bool:
+    """Minimal bexpr subset (the reference uses go-bexpr): `and`-joined
+    equality/inequality terms over the projected claim variables.
+    An empty selector matches everything (binding_rule.Selector docs)."""
+    if not selector.strip():
+        return True
+    for term in selector.split(" and "):
+        m = _SEL_TERM.match(term)
+        if m is None:
+            return False  # unparseable selector NEVER matches
+        key, op, quoted, bare = m.groups()
+        val = quoted if quoted is not None else bare
+        have = vars.get(key)
+        if op == "==" and have != val:
+            return False
+        if op == "!=" and have == val:
+            return False
+    return True
+
+
+_INTERP = re.compile(r"\$\{([\w.]+)\}")
+
+
+def interpolate(template: str, vars: dict[str, str]) -> str:
+    """`${value.name}`-style BindName interpolation. Unknown variables
+    raise: a partially-substituted identity name would grant access to
+    a literal-`${}` service."""
+    def sub(m: re.Match) -> str:
+        v = vars.get(m.group(1))
+        if v is None:
+            raise AuthError(f"binding references unknown variable "
+                            f"{m.group(1)!r}")
+        return v
+    return _INTERP.sub(sub, template)
+
+
+def compute_bindings(rules: list[dict[str, Any]],
+                     vars: dict[str, str]) -> dict[str, list]:
+    """Evaluate binding rules → token scoping. Returns the
+    ServiceIdentities / NodeIdentities / Roles for the login token.
+    Rules whose Selector doesn't match are skipped; a login that
+    matches NO rules must be rejected by the caller (Login in the
+    reference denies tokens that would be able to do nothing)."""
+    services, nodes, roles = [], [], []
+    for rule in rules:
+        if not evaluate_selector(rule.get("Selector", ""), vars):
+            continue
+        bind_type = rule.get("BindType", "service")
+        name = interpolate(rule.get("BindName", ""), vars)
+        if not name:
+            continue
+        if bind_type == "service":
+            services.append({"ServiceName": name})
+        elif bind_type == "node":
+            nodes.append({"NodeName": name})
+        elif bind_type == "role":
+            roles.append({"Name": name})
+    return {"ServiceIdentities": services, "NodeIdentities": nodes,
+            "Roles": roles}
+
+
+def validate_selector(selector: str) -> Optional[str]:
+    """Write-time validation (IsValidBindingRule): returns an error
+    string for selectors the evaluator cannot parse — including the
+    known subset limit that quoted strings must not contain ' and '."""
+    if not selector.strip():
+        return None
+    for term in selector.split(" and "):
+        if _SEL_TERM.match(term) is None:
+            return (f"unparseable term {term.strip()!r} (supported: "
+                    f"`var == \"value\"` / `var != \"value\"` joined "
+                    f"with ` and `; quoted values must not contain "
+                    f"' and ')")
+    return None

@@ -189,11 +189,21 @@ class HTTPApi:
                     for cid, c in a.local.list_checks().items()}, None
         if path == "/v1/agent/service/register" and method in ("PUT",
                                                                "POST"):
-            a.register_service(jbody())
+            body = jbody()
+            # vetServiceRegister: the CALLER's token needs service:write
+            # on the service being registered (agent/acl.go)
+            rpc("Internal.ServiceWrite",
+                {"Service": body.get("Name", "")})
+            a.register_service(body)
             return None, None
         if (m := re.match(r"^/v1/agent/service/deregister/(.+)$", path)) \
                 and method in ("PUT", "POST"):
-            if not a.deregister_service(urllib.parse.unquote(m.group(1))):
+            sid = urllib.parse.unquote(m.group(1))
+            existing = a.local.list_services().get(sid)
+            if existing is not None:
+                rpc("Internal.ServiceWrite",
+                    {"Service": existing.service})
+            if not a.deregister_service(sid):
                 raise HTTPError(404, "unknown service")
             return None, None
         if path == "/v1/agent/check/register" and method in ("PUT", "POST"):
@@ -449,6 +459,48 @@ class HTTPApi:
             return res["Role"], None
         if path == "/v1/acl/roles":
             return rpc("ACL.RoleList", {})["Roles"], None
+        if path == "/v1/acl/auth-method" and method in ("PUT", "POST"):
+            return rpc("ACL.AuthMethodSet",
+                       {"AuthMethod": jbody()}), None
+        if (m := re.match(r"^/v1/acl/auth-method/(.+)$", path)):
+            name = urllib.parse.unquote(m.group(1))
+            if method == "DELETE":
+                rpc("ACL.AuthMethodDelete", {"Name": name})
+                return True, None
+            if method == "PUT":
+                b = jbody()
+                b.setdefault("Name", name)
+                return rpc("ACL.AuthMethodSet", {"AuthMethod": b}), None
+            res = rpc("ACL.AuthMethodRead", {"Name": name})
+            if res.get("AuthMethod") is None:
+                raise HTTPError(404, "auth method not found")
+            return res["AuthMethod"], None
+        if path == "/v1/acl/auth-methods":
+            return rpc("ACL.AuthMethodList", {})["AuthMethods"], None
+        if path == "/v1/acl/binding-rule" and method in ("PUT", "POST"):
+            return rpc("ACL.BindingRuleSet",
+                       {"BindingRule": jbody()}), None
+        if (m := re.match(r"^/v1/acl/binding-rule/(.+)$", path)):
+            rid = urllib.parse.unquote(m.group(1))
+            if method == "DELETE":
+                rpc("ACL.BindingRuleDelete", {"BindingRuleID": rid})
+                return True, None
+            if method == "PUT":
+                b = jbody()
+                b.setdefault("ID", rid)
+                return rpc("ACL.BindingRuleSet",
+                           {"BindingRule": b}), None
+            res = rpc("ACL.BindingRuleRead", {"BindingRuleID": rid})
+            if res.get("BindingRule") is None:
+                raise HTTPError(404, "binding rule not found")
+            return res["BindingRule"], None
+        if path == "/v1/acl/binding-rules":
+            return rpc("ACL.BindingRuleList", {})["BindingRules"], None
+        if path == "/v1/acl/login" and method in ("PUT", "POST"):
+            return rpc("ACL.Login", {"Auth": jbody()}), None
+        if path == "/v1/acl/logout" and method in ("PUT", "POST"):
+            # the header token IS the login token being destroyed
+            return rpc("ACL.Logout", {}), None
         if path == "/v1/acl/policy" and method in ("PUT", "POST"):
             return rpc("ACL.PolicySet", {"Policy": jbody()}), None
         if (m := re.match(r"^/v1/acl/policy/(.+)$", path)):

@@ -65,6 +65,9 @@ class ConsulClient:
             **params) -> Any:
         return self._call("PUT", path, params, body, raw)[0]
 
+    def post(self, path: str, body: Any = None, **params) -> Any:
+        return self._call("POST", path, params, body)[0]
+
     def delete(self, path: str, **params) -> Any:
         return self._call("DELETE", path, params)[0]
 

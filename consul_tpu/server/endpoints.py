@@ -500,6 +500,143 @@ def register_endpoints(srv) -> None:
                     break
         return {"Role": role}
 
+    # ------------------------------------------- ACL auth methods / login
+    def acl_auth_method_set(args):
+        require(authz(args).acl_write(), "acl write")
+        m = dict(args.get("AuthMethod") or {})
+        if not m.get("Name"):
+            raise RPCError("auth method requires Name")
+        if m.get("Type") not in ("jwt",):
+            raise RPCError(f"unsupported auth method type "
+                           f"{m.get('Type')!r}")
+        srv.forward_or_apply(MessageType.ACL_AUTH_METHOD,
+                             {"Op": "set", "AuthMethod": m})
+        return m
+
+    def acl_auth_method_delete(args):
+        require(authz(args).acl_write(), "acl write")
+        # token/rule cascade happens INSIDE the FSM apply (atomic on
+        # every replica)
+        srv.forward_or_apply(MessageType.ACL_AUTH_METHOD, {
+            "Op": "delete", "AuthMethod": {"Name": args.get("Name", "")}})
+        return True
+
+    def acl_binding_rule_set(args):
+        require(authz(args).acl_write(), "acl write")
+        rule = dict(args.get("BindingRule") or {})
+        if not rule.get("AuthMethod"):
+            raise RPCError("binding rule requires AuthMethod")
+        if rule.get("BindType", "service") not in ("service", "node",
+                                                   "role"):
+            raise RPCError("BindType must be service, node, or role")
+        # reject unparseable selectors/templates at WRITE time
+        # (IsValidBindingRule): a rule that silently never matches is a
+        # misconfiguration with no diagnostic at login time
+        from consul_tpu.acl.authmethod import validate_selector
+
+        err = validate_selector(rule.get("Selector", ""))
+        if err:
+            raise RPCError(f"invalid binding rule Selector: {err}")
+        rule.setdefault("ID", str(uuid.uuid4()))
+        srv.forward_or_apply(MessageType.ACL_BINDING_RULE,
+                             {"Op": "set", "BindingRule": rule})
+        return rule
+
+    def acl_binding_rule_delete(args):
+        require(authz(args).acl_write(), "acl write")
+        srv.forward_or_apply(MessageType.ACL_BINDING_RULE, {
+            "Op": "delete",
+            "BindingRule": {"ID": args.get("BindingRuleID", "")}})
+        return True
+
+    def acl_login(args):
+        """Bearer-credential login → scoped token (acl_endpoint_login.go
+        Login). Deliberately UNAUTHENTICATED: the bearer IS the
+        credential."""
+        from consul_tpu.acl.authmethod import (AuthError, claim_vars,
+                                               compute_bindings,
+                                               verify_jwt)
+
+        if not srv.is_leader():
+            # read-your-writes: a follower may not have replicated the
+            # method/rules (or, for logout, a just-minted token) yet
+            return srv._forward_to_leader("ACL.Login", args)
+        auth = args.get("Auth") or {}
+        method = state.raw_get("acl_auth_methods",
+                               auth.get("AuthMethod", ""))
+        if method is None:
+            raise RPCError("auth method not found")
+        try:
+            claims = verify_jwt(auth.get("BearerToken", ""),
+                                method.get("Config") or {})
+            vars = claim_vars(claims, method.get("Config") or {})
+            rules = [r for r in state.raw_list("acl_binding_rules")
+                     if r.get("AuthMethod") == method["Name"]]
+            bindings = compute_bindings(rules, vars)
+        except AuthError as exc:
+            raise RPCError(f"login failed: {exc}") from exc
+        # role binds resolve AT LOGIN (binder.go): nonexistent roles
+        # are dropped — a dormant name-reference would silently acquire
+        # privileges when a matching role is created later
+        resolved_roles = []
+        for rref in bindings["Roles"]:
+            role = next((r for r in state.raw_list("acl_roles")
+                         if r.get("Name") == rref["Name"]), None)
+            if role is not None:
+                resolved_roles.append({"ID": role["ID"],
+                                       "Name": role["Name"]})
+        bindings["Roles"] = resolved_roles
+        if not any(bindings.values()):
+            # a token that can do nothing must not be minted
+            raise RPCError("Permission denied: no binding rules "
+                           "matched the login identity")
+        tok = {
+            "SecretID": str(uuid.uuid4()),
+            "AccessorID": str(uuid.uuid4()),
+            "Description": f"token created via login: "
+                           f"{method['Name']}",
+            "AuthMethod": method["Name"],
+            "Meta": dict(auth.get("Meta") or {}),
+            **bindings,
+        }
+        srv.forward_or_apply(MessageType.ACL_TOKEN,
+                             {"Op": "set", "Token": tok})
+        return tok
+
+    def acl_logout(args):
+        """Self-destruct a login token (acl_endpoint_login.go Logout).
+        Auth: the token itself — and ONLY login tokens may logout."""
+        if not srv.is_leader():
+            return srv._forward_to_leader("ACL.Logout", args)
+        secret = args.get("AuthToken", "")
+        tok = state.raw_get("acl_tokens", secret)
+        if tok is None or not tok.get("AuthMethod"):
+            raise RPCError("Permission denied: not a login token")
+        srv.forward_or_apply(MessageType.ACL_TOKEN,
+                             {"Op": "delete", "Token": tok})
+        return True
+
+    e["ACL.AuthMethodSet"] = acl_auth_method_set
+    e["ACL.AuthMethodDelete"] = acl_auth_method_delete
+    read("ACL.AuthMethodRead", lambda args: (
+        require(authz(args).acl_read(), "acl read") or
+        {"AuthMethod": state.raw_get("acl_auth_methods",
+                                     args.get("Name", ""))}))
+    read("ACL.AuthMethodList", lambda args: (
+        require(authz(args).acl_read(), "acl read") or
+        {"AuthMethods": state.raw_list("acl_auth_methods")}))
+    e["ACL.BindingRuleSet"] = acl_binding_rule_set
+    e["ACL.BindingRuleDelete"] = acl_binding_rule_delete
+    read("ACL.BindingRuleRead", lambda args: (
+        require(authz(args).acl_read(), "acl read") or
+        {"BindingRule": state.raw_get("acl_binding_rules",
+                                      args.get("BindingRuleID", ""))}))
+    read("ACL.BindingRuleList", lambda args: (
+        require(authz(args).acl_read(), "acl read") or
+        {"BindingRules": state.raw_list("acl_binding_rules")}))
+    e["ACL.Login"] = acl_login
+    e["ACL.Logout"] = acl_logout
+
     e["ACL.RoleSet"] = acl_role_set
     e["ACL.RoleDelete"] = acl_role_delete
     read("ACL.RoleRead", acl_role_read)
@@ -897,8 +1034,15 @@ def register_endpoints(srv) -> None:
         require(authz(args).agent_write(), "agent write")
         return True
 
+    def service_write_check(args):
+        svc = args.get("Service", "")
+        require(authz(args).service_write(svc),
+                f"service write on {svc!r}")
+        return True
+
     e["Internal.AgentRead"] = agent_read_check
     e["Internal.AgentWrite"] = agent_write_check
+    e["Internal.ServiceWrite"] = service_write_check
     e["Catalog.ListDatacenters"] = lambda args: srv.datacenters()
 
     def join_wan(args):

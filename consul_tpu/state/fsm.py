@@ -38,6 +38,8 @@ class MessageType(enum.IntEnum):
     SNAPSHOT_RESTORE = 13  # operator restore, replicated to all FSMs
     PEERING = 14
     ACL_ROLE = 15
+    ACL_AUTH_METHOD = 16
+    ACL_BINDING_RULE = 17
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -64,6 +66,8 @@ class FSM:
             MessageType.SNAPSHOT_RESTORE: self._apply_snapshot_restore,
             MessageType.PEERING: self._apply_peering,
             MessageType.ACL_ROLE: self._apply_acl_role,
+            MessageType.ACL_AUTH_METHOD: self._apply_acl_auth_method,
+            MessageType.ACL_BINDING_RULE: self._apply_acl_binding_rule,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -234,6 +238,31 @@ class FSM:
         r = b.get("Role") or {}
         return self._raw_op("acl_roles", ("set",), b.get("Op", "set"),
                             r.get("ID"), r)
+
+    def _apply_acl_auth_method(self, b: dict[str, Any], idx: int) -> Any:
+        m = b.get("AuthMethod") or {}
+        if b.get("Op") == "delete":
+            # cascade INSIDE the command so revocation is atomic on
+            # every replica (state_store.go ACLAuthMethodDeleteByName
+            # purges the method's tokens in the same txn): login tokens
+            # minted via the method and its binding rules die with it
+            name = m.get("Name")
+            for tok in list(self.store.raw_list("acl_tokens")):
+                if tok.get("AuthMethod") == name:
+                    self.store.raw_delete("acl_tokens",
+                                          tok.get("SecretID"))
+            for rule in list(self.store.raw_list("acl_binding_rules")):
+                if rule.get("AuthMethod") == name:
+                    self.store.raw_delete("acl_binding_rules",
+                                          rule.get("ID"))
+            return self.store.raw_delete("acl_auth_methods", name)
+        return self._raw_op("acl_auth_methods", ("set",),
+                            b.get("Op", "set"), m.get("Name"), m)
+
+    def _apply_acl_binding_rule(self, b: dict[str, Any], idx: int) -> Any:
+        r = b.get("BindingRule") or {}
+        return self._raw_op("acl_binding_rules", ("set",),
+                            b.get("Op", "set"), r.get("ID"), r)
 
     def _apply_peering(self, b: dict[str, Any], idx: int) -> Any:
         p = b.get("Peering") or {}

@@ -28,9 +28,12 @@ import msgpack
 from consul_tpu.types import (CheckStatus, Coordinate, HealthCheck, KVEntry,
                               Node, NodeService, SERF_CHECK_ID, Session)
 
-TABLES = ("nodes", "services", "checks", "kv", "sessions", "coordinates",
-          "prepared_queries", "acl_tokens", "acl_policies", "config_entries",
-          "intentions", "peerings", "acl_roles")
+# plain-dict tables serialized/restored generically (key -> msgpack map)
+RAW_TABLES = ("prepared_queries", "acl_tokens", "acl_policies",
+              "config_entries", "intentions", "peerings", "acl_roles",
+              "acl_auth_methods", "acl_binding_rules")
+TABLES = ("nodes", "services", "checks", "kv", "sessions",
+          "coordinates") + RAW_TABLES
 
 
 class StateStore:
@@ -479,13 +482,7 @@ class StateStore:
                 "sessions": {k: v.__dict__ for k, v in
                              self.tables["sessions"].items()},
                 "coordinates": dict(self.tables["coordinates"]),
-                "config_entries": dict(self.tables["config_entries"]),
-                "acl_tokens": dict(self.tables["acl_tokens"]),
-                "acl_policies": dict(self.tables["acl_policies"]),
-                "intentions": dict(self.tables["intentions"]),
-                "prepared_queries": dict(self.tables["prepared_queries"]),
-                "peerings": dict(self.tables["peerings"]),
-                "acl_roles": dict(self.tables["acl_roles"]),
+                **{t: dict(self.tables[t]) for t in RAW_TABLES},
             }
             return msgpack.packb(blob, use_bin_type=True)
 
@@ -511,9 +508,7 @@ class StateStore:
             self.tables["sessions"] = {
                 k: Session(**v) for k, v in blob["sessions"].items()}
             self.tables["coordinates"] = blob.get("coordinates", {})
-            for t in ("config_entries", "acl_tokens", "acl_policies",
-                      "intentions", "prepared_queries", "peerings",
-                      "acl_roles"):
+            for t in RAW_TABLES:
                 self.tables[t] = blob.get(t, {})
             self._cv.notify_all()
             for fn in self._change_hooks:

@@ -1,0 +1,229 @@
+"""ACL auth methods: JWT login → binding rules → scoped tokens.
+
+Reference behaviors: agent/consul/authmethod/jwtauth (bearer
+validation: signature, bound issuer/audiences, claim mappings),
+acl_endpoint_login.go Login/Logout (binding-rule evaluation, no-match
+denial, login-token-only logout), auth-method delete cascading its
+tokens and rules.
+"""
+
+import base64
+import json
+import time
+
+import pytest
+
+from consul_tpu.acl.authmethod import (AuthError, claim_vars,
+                                       compute_bindings,
+                                       evaluate_selector, interpolate,
+                                       verify_jwt)
+from consul_tpu.agent import Agent
+from consul_tpu.api import APIError, ConsulClient
+from consul_tpu.config import load
+
+
+def _es256_keypair():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+    return key, pub
+
+
+def _jwt(key, claims: dict) -> str:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec, utils
+
+    def b64(b: bytes) -> str:
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    head = b64(json.dumps({"alg": "ES256", "typ": "JWT"}).encode())
+    body = b64(json.dumps(claims).encode())
+    der = key.sign(f"{head}.{body}".encode(),
+                   ec.ECDSA(hashes.SHA256()))
+    r, s = utils.decode_dss_signature(der)
+    sig = b64(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+    return f"{head}.{body}.{sig}"
+
+
+def test_jwt_verify_unit():
+    key, pub = _es256_keypair()
+    cfg = {"JWTValidationPubKeys": [pub], "BoundIssuer": "idp",
+           "BoundAudiences": ["consul"]}
+    now = time.time()
+    good = _jwt(key, {"iss": "idp", "aud": "consul",
+                      "exp": now + 60, "sub": "web-svc"})
+    assert verify_jwt(good, cfg)["sub"] == "web-svc"
+    # wrong issuer / audience / expired / tampered all rejected
+    with pytest.raises(AuthError, match="issuer"):
+        verify_jwt(_jwt(key, {"iss": "evil", "aud": "consul",
+                              "exp": now + 60}), cfg)
+    with pytest.raises(AuthError, match="audience"):
+        verify_jwt(_jwt(key, {"iss": "idp", "aud": "other",
+                              "exp": now + 60}), cfg)
+    with pytest.raises(AuthError, match="expired"):
+        verify_jwt(_jwt(key, {"iss": "idp", "aud": "consul",
+                              "exp": now - 1}), cfg)
+    head, body, sig = good.split(".")
+    forged_body = base64.urlsafe_b64encode(json.dumps(
+        {"iss": "idp", "aud": "consul", "exp": now + 60,
+         "sub": "admin"}).encode()).rstrip(b"=").decode()
+    with pytest.raises(AuthError, match="signature"):
+        verify_jwt(f"{head}.{forged_body}.{sig}", cfg)
+    # a key that didn't sign it fails
+    _, other_pub = _es256_keypair()
+    with pytest.raises(AuthError, match="signature"):
+        verify_jwt(good, {**cfg, "JWTValidationPubKeys": [other_pub]})
+
+
+def test_selector_and_bindings_unit():
+    vars = {"value.name": "web", "value.ns": "prod"}
+    assert evaluate_selector("", vars)
+    assert evaluate_selector('value.name=="web"', vars)
+    assert evaluate_selector(
+        'value.name=="web" and value.ns!="dev"', vars)
+    assert not evaluate_selector('value.name=="db"', vars)
+    assert not evaluate_selector("garbage ~~ syntax", vars)
+    assert interpolate("svc-${value.name}", vars) == "svc-web"
+    with pytest.raises(AuthError):
+        interpolate("${value.missing}", vars)
+    b = compute_bindings([
+        {"Selector": 'value.ns=="prod"', "BindType": "service",
+         "BindName": "${value.name}"},
+        {"Selector": 'value.ns=="dev"', "BindType": "service",
+         "BindName": "never"},
+        {"Selector": "", "BindType": "role", "BindName": "ops"}],
+        vars)
+    assert b["ServiceIdentities"] == [{"ServiceName": "web"}]
+    assert b["Roles"] == [{"Name": "ops"}]
+    # claim mapping projects dotted paths
+    cv = claim_vars({"kubernetes": {"serviceaccount": {"name": "web"}}},
+                    {"ClaimMappings":
+                     {"kubernetes.serviceaccount.name": "name"}})
+    assert cv == {"value.name": "web"}
+
+
+@pytest.fixture(scope="module")
+def acl_agent():
+    a = Agent(load(dev=True, overrides={
+        "node_name": "am-agent",
+        "acl": {"enabled": True, "default_policy": "deny",
+                "tokens": {"initial_management": "root-secret"}}}))
+    a.start(serve_dns=False)
+    t0 = time.time()
+    while time.time() - t0 < 15 and not (
+            a.server.is_leader() and a.server.state.raw_get(
+                "acl_tokens", "root-secret")):
+        time.sleep(0.1)
+    yield a
+    a.shutdown()
+
+
+def test_login_logout_end_to_end(acl_agent):
+    root = ConsulClient(acl_agent.http.addr, token="root-secret")
+    anon = ConsulClient(acl_agent.http.addr)
+    key, pub = _es256_keypair()
+    root.put("/v1/acl/auth-method", body={
+        "Name": "idp-jwt", "Type": "jwt",
+        "Config": {
+            "JWTValidationPubKeys": [pub], "BoundIssuer": "idp",
+            "BoundAudiences": ["consul"],
+            "ClaimMappings": {"sub": "sub"}}})
+    root.put("/v1/acl/binding-rule", body={
+        "AuthMethod": "idp-jwt", "Selector": 'value.sub=="web-sa"',
+        "BindType": "service", "BindName": "web"})
+
+    bearer = _jwt(key, {"iss": "idp", "aud": "consul",
+                        "exp": time.time() + 300, "sub": "web-sa"})
+    tok = anon.post("/v1/acl/login", body={
+        "AuthMethod": "idp-jwt", "BearerToken": bearer})
+    assert tok["AuthMethod"] == "idp-jwt"
+    assert tok["ServiceIdentities"] == [{"ServiceName": "web"}]
+
+    # the minted token really carries the service identity: it can
+    # register 'web' but not 'db'
+    logged_in = ConsulClient(acl_agent.http.addr,
+                             token=tok["SecretID"])
+    logged_in.service_register({"Name": "web", "Port": 80})
+    with pytest.raises(APIError):
+        logged_in.service_register({"Name": "db", "Port": 81})
+
+    # a bearer whose claims match no rule is refused a token
+    other = _jwt(key, {"iss": "idp", "aud": "consul",
+                       "exp": time.time() + 300, "sub": "stranger"})
+    with pytest.raises(APIError, match="no binding rules"):
+        anon.post("/v1/acl/login", body={
+            "AuthMethod": "idp-jwt", "BearerToken": other})
+    # garbage bearer is refused
+    with pytest.raises(APIError, match="login failed"):
+        anon.post("/v1/acl/login", body={
+            "AuthMethod": "idp-jwt", "BearerToken": "not.a.jwt"})
+
+    # logout destroys the login token (and only login tokens may)
+    with pytest.raises(APIError):
+        root.post("/v1/acl/logout")  # management token: not a login
+    logged_in.post("/v1/acl/logout")
+    time.sleep(0.2)
+    with pytest.raises(APIError):
+        logged_in.service_register({"Name": "web", "Port": 80})
+
+
+def test_auth_method_delete_cascades(acl_agent):
+    root = ConsulClient(acl_agent.http.addr, token="root-secret")
+    anon = ConsulClient(acl_agent.http.addr)
+    key, pub = _es256_keypair()
+    root.put("/v1/acl/auth-method", body={
+        "Name": "tmp-m", "Type": "jwt",
+        "Config": {"JWTValidationPubKeys": [pub],
+                   "ClaimMappings": {"sub": "sub"}}})
+    root.put("/v1/acl/binding-rule", body={
+        "AuthMethod": "tmp-m", "BindType": "service",
+        "BindName": "${value.sub}"})
+    bearer = _jwt(key, {"exp": time.time() + 300, "sub": "thing"})
+    tok = anon.post("/v1/acl/login", body={
+        "AuthMethod": "tmp-m", "BearerToken": bearer})
+    root.delete("/v1/acl/auth-method/tmp-m")
+    # its tokens and rules are gone
+    assert acl_agent.server.state.raw_get(
+        "acl_tokens", tok["SecretID"]) is None
+    assert [r for r in acl_agent.server.state.raw_list(
+        "acl_binding_rules") if r["AuthMethod"] == "tmp-m"] == []
+    # unsupported method type rejected
+    with pytest.raises(APIError):
+        root.put("/v1/acl/auth-method", body={
+            "Name": "k8s", "Type": "kubernetes"})
+
+
+def test_role_binds_resolve_at_login(acl_agent):
+    """BindType=role resolves at LOGIN (binder.go): a nonexistent role
+    is dropped — no dormant token that acquires privileges when a
+    matching role appears later — and an existing role binds by ID."""
+    root = ConsulClient(acl_agent.http.addr, token="root-secret")
+    anon = ConsulClient(acl_agent.http.addr)
+    key, pub = _es256_keypair()
+    root.put("/v1/acl/auth-method", body={
+        "Name": "role-m", "Type": "jwt",
+        "Config": {"JWTValidationPubKeys": [pub],
+                   "ClaimMappings": {"sub": "sub"}}})
+    root.put("/v1/acl/binding-rule", body={
+        "AuthMethod": "role-m", "BindType": "role",
+        "BindName": "ghost-role"})
+    bearer = _jwt(key, {"exp": time.time() + 300, "sub": "x"})
+    # only binding is a nonexistent role -> no token
+    with pytest.raises(APIError, match="no binding rules"):
+        anon.post("/v1/acl/login", body={
+            "AuthMethod": "role-m", "BearerToken": bearer})
+    role = root.put("/v1/acl/role", body={"Name": "ghost-role"})
+    tok = anon.post("/v1/acl/login", body={
+        "AuthMethod": "role-m", "BearerToken": bearer})
+    assert tok["Roles"] == [{"ID": role["ID"], "Name": "ghost-role"}]
+    root.delete("/v1/acl/auth-method/role-m")
+    # bad selectors rejected at write time, not silently never-matching
+    with pytest.raises(APIError, match="Selector"):
+        root.put("/v1/acl/binding-rule", body={
+            "AuthMethod": "role-m", "BindType": "service",
+            "BindName": "x",
+            "Selector": 'value.team == "research and development"'})
