@@ -281,6 +281,10 @@ class Agent:
             meta=dict(defn.get("Meta") or {}),
             kind=defn.get("Kind", ""))
         svc.proxy = dict(defn.get("Proxy") or {})
+        # service manager (agent/service_manager.go): central defaults
+        # merge UNDER the registration BEFORE it enters local state —
+        # the anti-entropy sync must never push pre-merge content
+        self._merge_central_defaults(svc)
         self.local.add_service(svc)
         checks = list(defn.get("Checks") or [])
         if defn.get("Check"):
@@ -322,6 +326,45 @@ class Agent:
         if found and sidecar_id in self.local.list_services():
             self.deregister_service(sidecar_id)
         return found
+
+    def _merge_central_defaults(self, svc) -> None:
+        """Merge central config into a local registration (the service
+        manager's mergeServiceConfig): proxy-defaults global Config,
+        then service-defaults of the service (or, for a connect proxy,
+        of its destination) — local values always win. Best-effort: a
+        cluster that isn't up yet just skips the merge (the reference
+        blocks on a ConfigEntry watch; we re-merge on re-registration)."""
+        name = svc.proxy.get("DestinationServiceName") \
+            if svc.kind == "connect-proxy" else svc.service
+
+        def entry(kind: str, ename: str):
+            try:
+                res = self.agent_rpc("ConfigEntry.Get", {
+                    "Kind": kind, "Name": ename, "AllowStale": True})
+                return res.get("Entry") or {}
+            except Exception:  # noqa: BLE001
+                return {}
+
+        defaults = entry("service-defaults", name or "")
+        global_pd = entry("proxy-defaults", "global")
+        if not defaults and not global_pd:
+            return
+        meta = dict(defaults.get("Meta") or {})
+        meta.update(svc.meta)  # instance meta wins
+        svc.meta = meta
+        if svc.kind == "connect-proxy":
+            cfg = dict((global_pd.get("Config") or {}))
+            cfg.update(defaults.get("ProxyConfig") or {})
+            cfg.update(svc.proxy.get("Config") or {})
+            proxy = dict(svc.proxy)
+            if cfg:
+                proxy["Config"] = cfg
+            mesh_gw = (svc.proxy.get("MeshGateway")
+                       or defaults.get("MeshGateway")
+                       or global_pd.get("MeshGateway"))
+            if mesh_gw:
+                proxy["MeshGateway"] = mesh_gw
+            svc.proxy = proxy
 
     def _next_sidecar_port(self) -> int:
         """First free port in the sidecar range (the reference's

@@ -14,6 +14,7 @@ from __future__ import annotations
 import socket
 import subprocess
 import threading
+import time
 from typing import Any, Optional
 
 from consul_tpu.agent.local import LocalCheck, LocalState
@@ -179,6 +180,57 @@ class UDPCheck(CheckRunner):
             s.close()
 
 
+class H2PingCheck(CheckRunner):
+    """HTTP/2 connection health: send the client preface + a PING
+    frame, pass on receiving the PING ack (checks/check.go CheckH2PING,
+    sans TLS). Speaks raw h2 framing — no client library needed."""
+
+    PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+    def __init__(self, local, check_id, addr: str, interval: float,
+                 timeout: float = 10.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        host, port = addr.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        try:
+            with socket.create_connection(
+                    (self.host, self.port),
+                    timeout=self.timeout) as s:
+                s.settimeout(self.timeout)
+                # preface + empty SETTINGS, then PING (type=6) with an
+                # 8-byte opaque payload
+                settings = b"\x00\x00\x00\x04\x00\x00\x00\x00\x00"
+                ping = b"\x00\x00\x08\x06\x00\x00\x00\x00\x00" \
+                    + b"consulh2"
+                s.sendall(self.PREFACE + settings + ping)
+                deadline = time.monotonic() + self.timeout
+                buf = b""
+                while time.monotonic() < deadline:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    # walk frames looking for a PING ack (flags&0x1)
+                    i = 0
+                    while len(buf) - i >= 9:
+                        ln = int.from_bytes(buf[i:i + 3], "big")
+                        ftype, flags = buf[i + 3], buf[i + 4]
+                        if len(buf) - i < 9 + ln:
+                            break
+                        if ftype == 0x6 and flags & 0x1:
+                            return (CheckStatus.PASSING,
+                                    "HTTP2 ping acknowledged")
+                        i += 9 + ln
+                    buf = buf[i:]
+                return (CheckStatus.CRITICAL,
+                        "no HTTP2 ping ack before timeout")
+        except OSError as e:
+            return (CheckStatus.CRITICAL,
+                    f"h2ping {self.host}:{self.port}: {e}")
+
+
 class ScriptCheck(CheckRunner):
     """Exit 0 passing, 1 warning, else critical (CheckMonitor)."""
 
@@ -242,6 +294,9 @@ def make_runner(local: LocalState, defn: dict[str, Any],
     if defn.get("Args") or defn.get("Script"):
         args = defn.get("Args") or ["/bin/sh", "-c", defn["Script"]]
         return ScriptCheck(local, cid, args, interval, timeout, scheduler)
+    if defn.get("H2PING"):
+        return H2PingCheck(local, cid, defn["H2PING"], interval,
+                           timeout, scheduler)
     if defn.get("AliasService"):
         return AliasCheck(local, cid, defn["AliasService"],
                           scheduler=scheduler)

@@ -527,9 +527,14 @@ class HTTPApi:
             res = rpc("PreparedQuery.List", blocking_args())
             return res["Queries"], res["Index"]
         if (m := re.match(r"^/v1/query/([^/]+)/execute$", path)):
-            res = rpc("PreparedQuery.Execute", {
-                "QueryIDOrName": urllib.parse.unquote(m.group(1)),
-                "Limit": int(q.get("limit", 0))})
+            try:
+                res = rpc("PreparedQuery.Execute", {
+                    "QueryIDOrName": urllib.parse.unquote(m.group(1)),
+                    "Limit": int(q.get("limit", 0))})
+            except Exception as exc:  # noqa: BLE001
+                if "not found" in str(exc):
+                    raise HTTPError(404, "query not found") from exc
+                raise
             return res, None
         if (m := re.match(r"^/v1/query/([^/]+)$", path)):
             qid = urllib.parse.unquote(m.group(1))

@@ -828,20 +828,88 @@ def register_endpoints(srv) -> None:
         if op in ("create", "update") and not (
                 query.get("Service") or {}).get("Service"):
             raise RPCError("prepared query must specify a service")
+        tmpl = query.get("Template") or {}
+        if tmpl:
+            if tmpl.get("Type") != "name_prefix_match":
+                raise RPCError("unsupported template type "
+                               f"{tmpl.get('Type')!r}")
+            if tmpl.get("Regexp"):
+                import re as _re
+                try:
+                    _re.compile(tmpl["Regexp"])
+                except _re.error as exc:
+                    raise RPCError(
+                        f"invalid template Regexp: {exc}") from exc
         require(authz(args).query_write(query.get("Name", "")),
                 "query write")
         srv.forward_or_apply(MessageType.PREPARED_QUERY,
                              {"Op": op, "Query": query})
         return {"ID": query.get("ID")}
 
-    def pq_lookup(id_or_name: str):
+    def pq_lookup(id_or_name: str, templates: bool = False):
+        """Raw lookup by ID/Name; with templates=True (EXECUTE only —
+        Get/List always return raw definitions, template.go), template
+        queries render against the looked-up name, and the longest
+        prefix-matching template catches undefined names."""
         q = state.raw_get("prepared_queries", id_or_name)
+        if q is None:
+            for cand in state.raw_list("prepared_queries"):
+                if cand.get("Name") == id_or_name:
+                    q = cand
+                    break
         if q is not None:
+            if templates and (q.get("Template") or {}).get("Type") \
+                    == "name_prefix_match":
+                return _render_template(q, id_or_name)
             return q
+        if not templates:
+            return None
+        best = None
         for cand in state.raw_list("prepared_queries"):
-            if cand.get("Name") == id_or_name:
-                return cand
+            t = cand.get("Template") or {}
+            if t.get("Type") != "name_prefix_match":
+                continue
+            if not id_or_name.startswith(cand.get("Name", "")):
+                continue
+            if best is None or len(cand.get("Name", "")) > \
+                    len(best.get("Name", "")):
+                best = cand
+        if best is not None:
+            return _render_template(best, id_or_name)
         return None
+
+    def _render_template(q: dict, full_name: str) -> dict:
+        import copy
+        import re as _re
+
+        t = q.get("Template") or {}
+        prefix = q.get("Name", "")
+        vars = {"name.full": full_name, "name.prefix": prefix,
+                "name.suffix": full_name[len(prefix):]}
+        groups: list[str] = []
+        if t.get("Regexp"):
+            m = _re.match(t["Regexp"], full_name)
+            if m is not None:
+                groups = [m.group(0), *m.groups()]
+
+        def interp(s: str) -> str:
+            def sub(mm):
+                expr = mm.group(1).strip()
+                if (gm := _re.match(r"match\((\d+)\)$", expr)):
+                    i = int(gm.group(1))
+                    return groups[i] if i < len(groups) else ""
+                return vars.get(expr, "")
+            return _re.sub(r"\$\{([^}]*)\}", sub, s)
+
+        out = copy.deepcopy(q)
+        svc = out.get("Service") or {}
+        if svc.get("Service"):
+            svc["Service"] = interp(svc["Service"])
+        tags = [interp(x) for x in svc.get("Tags") or []]
+        if tags:
+            svc["Tags"] = tags
+        out["Service"] = svc
+        return out
 
     def pq_get(args):
         return srv.blocking_query(args, ("prepared_queries",), lambda: {
@@ -853,28 +921,62 @@ def register_endpoints(srv) -> None:
             "Queries": state.raw_list("prepared_queries")})
 
     def pq_execute(args):
-        """Execute a stored service query (prepared_query/ in the
-        reference; failover across DCs is a later round — single-DC
-        semantics here)."""
-        q = pq_lookup(args.get("QueryIDOrName", ""))
+        """Execute a stored service query (prepared_query/execute in
+        the reference): local lookup, then Service.Failover.Datacenters
+        in order until one returns healthy instances."""
+        q = pq_lookup(args.get("QueryIDOrName", ""), templates=True)
         if q is None:
             raise RPCError("query not found")
         svc = q.get("Service") or {}
+
+        nodes = _pq_nodes(svc, args)
+        dc_used = srv.config.datacenter
+        failovers = 0
+        if not nodes:
+            # the remote DC has no copy of the query definition —
+            # forward the QUERY ITSELF (prepared_query ExecuteRemote)
+            for dc in (svc.get("Failover") or {}).get(
+                    "Datacenters") or []:
+                if dc == srv.config.datacenter:
+                    continue
+                failovers += 1
+                try:
+                    res = srv._forward_dc(
+                        "PreparedQuery.ExecuteRemote",
+                        {**{k: v for k, v in args.items()
+                            if k != "QueryIDOrName"},
+                         "Query": q, "Datacenter": dc}, dc)
+                except Exception:  # noqa: BLE001
+                    continue  # an unreachable DC just tries the next
+                if res.get("Nodes"):
+                    return {**res, "Failovers": failovers}
+        return {"Service": svc.get("Service", ""), "Nodes": nodes,
+                "DNS": q.get("DNS") or {}, "Failovers": failovers,
+                "Datacenter": dc_used}
+
+    def _pq_nodes(svc, args):
         nodes = state.check_service_nodes(
             svc.get("Service", ""),
             tag=(svc.get("Tags") or [None])[0],
             passing_only=not svc.get("OnlyPassing", True) is False)
         limit = int(args.get("Limit") or 0)
-        if limit:
-            nodes = nodes[:limit]
-        return {"Service": svc.get("Service", ""), "Nodes": nodes,
-                "DNS": q.get("DNS") or {},
+        return nodes[:limit] if limit else nodes
+
+    def pq_execute_remote(args):
+        """Failover landing pad: execute a query definition shipped
+        from another DC against LOCAL state (no further failover)."""
+        q = args.get("Query") or {}
+        svc = q.get("Service") or {}
+        return {"Service": svc.get("Service", ""),
+                "Nodes": _pq_nodes(svc, args),
+                "DNS": q.get("DNS") or {}, "Failovers": 0,
                 "Datacenter": srv.config.datacenter}
 
     e["PreparedQuery.Apply"] = pq_apply
     read("PreparedQuery.Get", pq_get)
     read("PreparedQuery.List", pq_list)
     read("PreparedQuery.Execute", pq_execute)
+    read("PreparedQuery.ExecuteRemote", pq_execute_remote)
 
     # ------------------------------------------------------------ Connect
     def ca_roots(args):

@@ -413,3 +413,126 @@ def test_dns_ptr_lookup(agent, client):
     an = st_.unpack_from(">HHHHHH", resp)[3]
     assert an >= 1
     assert b"dev-agent" in resp
+
+
+def test_prepared_query_template_rendering(agent, client):
+    """name_prefix_match templates (prepared_query/template.go):
+    executing an undefined query name falls back to the longest
+    matching template with ${name.*} interpolation."""
+    client.service_register({"Name": "geo-db", "ID": "geo-db",
+                             "Port": 7100})
+    wait_for(lambda: client.health_service("geo-db"),
+             what="geo-db in catalog")
+    client.put("/v1/query", body={
+        "Name": "geo-", "Template": {"Type": "name_prefix_match"},
+        "Service": {"Service": "${name.full}"}})
+    res = client.get("/v1/query/geo-db/execute")
+    assert res["Service"] == "geo-db"
+    assert len(res["Nodes"]) == 1
+    # ${name.suffix} renders the part after the template prefix
+    client.put("/v1/query", body={
+        "Name": "suf-", "Template": {"Type": "name_prefix_match"},
+        "Service": {"Service": "${name.suffix}"}})
+    res2 = client.get("/v1/query/suf-geo-db/execute")
+    assert res2["Service"] == "geo-db"
+    # non-matching name still 404s
+    import pytest as _pytest
+
+    from consul_tpu.api import APIError as _APIError
+
+    with _pytest.raises(_APIError):
+        client.get("/v1/query/other-db/execute")
+
+
+def test_service_defaults_merge_into_registration(agent, client):
+    """Service manager central defaults (service_manager.go): Meta and
+    proxy Config merge UNDER the instance registration."""
+    client.put("/v1/config", body={
+        "Kind": "service-defaults", "Name": "merged",
+        "Meta": {"team": "infra", "tier": "gold"},
+        "ProxyConfig": {"protocol": "http"}})
+    client.put("/v1/config", body={
+        "Kind": "proxy-defaults", "Name": "global",
+        "Config": {"local_connect_timeout_ms": 5000}})
+    try:
+        client.service_register({
+            "Name": "merged", "ID": "merged", "Port": 7200,
+            "Meta": {"tier": "silver"},
+            "Connect": {"SidecarService": {}}})
+        svcs = client.get("/v1/agent/services")
+        m = svcs["merged"]
+        # central meta fills gaps; instance values win
+        assert m["Meta"] == {"team": "infra", "tier": "silver"}
+        sc = svcs["merged-sidecar-proxy"]
+        cfg = sc["Proxy"]["Config"]
+        assert cfg["protocol"] == "http"          # service-defaults
+        assert cfg["local_connect_timeout_ms"] == 5000  # proxy-defaults
+    finally:
+        client.delete("/v1/config/service-defaults/merged")
+        client.delete("/v1/config/proxy-defaults/global")
+
+
+def test_h2ping_check():
+    """H2PING pings a real HTTP/2 speaker (we fake the server side:
+    respond to the client preface with SETTINGS + PING ack)."""
+    import socket as _socket
+    import threading as _threading
+
+    from consul_tpu.agent.checks import H2PingCheck
+    from consul_tpu.agent.local import LocalState
+    from consul_tpu.types import CheckStatus
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def h2_server():
+        conn, _ = srv.accept()
+        conn.recv(65536)  # preface + settings + ping
+        # SETTINGS then PING ack (type 6, flags ACK)
+        conn.sendall(b"\x00\x00\x00\x04\x00\x00\x00\x00\x00"
+                     b"\x00\x00\x08\x06\x01\x00\x00\x00\x00consulh2")
+        conn.close()
+
+    t = _threading.Thread(target=h2_server, daemon=True)
+    t.start()
+    chk = H2PingCheck(LocalState("t"), "h2", f"127.0.0.1:{port}",
+                      interval=10, timeout=3)
+    status, out = chk.run_once()
+    assert status == CheckStatus.PASSING, out
+    srv.close()
+    # a plain closed port is critical
+    chk2 = H2PingCheck(LocalState("t"), "h2b", "127.0.0.1:1",
+                       interval=10, timeout=1)
+    status2, _ = chk2.run_once()
+    assert status2 == CheckStatus.CRITICAL
+
+
+def test_template_exact_name_renders_and_get_returns_raw(agent, client):
+    """Executing a template by its EXACT name still renders (prefix
+    match includes the empty suffix); Get returns the raw definition;
+    bad template regexps are rejected at apply time."""
+    client.put("/v1/query", body={
+        "Name": "tex-", "Template": {"Type": "name_prefix_match"},
+        "Service": {"Service": "x${name.suffix}"}})
+    res = client.get("/v1/query/tex-/execute")
+    assert res["Service"] == "x"  # rendered, suffix empty
+    # Get by name returns the RAW template, not a rendering
+    raw = client.get("/v1/query/tex-")
+    if isinstance(raw, list):
+        raw = raw[0]
+    assert raw["Service"]["Service"] == "x${name.suffix}"
+    import pytest as _pytest
+
+    from consul_tpu.api import APIError as _APIError
+
+    with _pytest.raises(_APIError, match="Regexp"):
+        client.put("/v1/query", body={
+            "Name": "bad-", "Template": {"Type": "name_prefix_match",
+                                         "Regexp": "("},
+            "Service": {"Service": "s"}})
+    with _pytest.raises(_APIError):
+        client.put("/v1/query", body={
+            "Name": "bad2-", "Template": {"Type": "weird"},
+            "Service": {"Service": "s"}})

@@ -115,3 +115,23 @@ def test_mesh_gateway_discovers_remote_dc_gateways(two_dcs):
                  == f"*.default.dc2.internal.{domain}")
     assert chain["filters"][0]["typed_config"]["cluster"] == \
         "remote_dc2"
+
+
+def test_prepared_query_cross_dc_failover(two_dcs):
+    """Service.Failover.Datacenters: an empty local result retries the
+    listed DCs in order (prepared_query/execute failover)."""
+    a1, a2 = two_dcs
+    c1, c2 = ConsulClient(a1.http.addr), ConsulClient(a2.http.addr)
+    c2.service_register({"Name": "fo-svc", "ID": "fo-svc",
+                         "Port": 7300})
+    wait_for(lambda: c2.health_service("fo-svc"),
+             what="fo-svc in dc2 catalog")
+    c1.put("/v1/query", body={
+        "Name": "fo", "Service": {
+            "Service": "fo-svc",
+            "Failover": {"Datacenters": ["dc2"]}}})
+    res = c1.get("/v1/query/fo/execute")
+    assert res["Datacenter"] == "dc2"
+    assert res["Failovers"] == 1
+    assert res["Nodes"] and \
+        res["Nodes"][0]["Service"]["Service"] == "fo-svc"
