@@ -298,6 +298,11 @@ class DNSServer:
                 "AllowStale": self.agent.config.dns_allow_stale}
         if tag:
             args["ServiceTag"] = tag
+        if self.agent.config.dns_sort_rtt:
+            # RTT-sort relative to THIS agent's coordinate (dns.go
+            # sortByNetworkCoordinates); the server's Near handling
+            # does the Vivaldi math
+            args["Near"] = self.agent.name
         try:
             res = self.agent.cached_rpc("Health.ServiceNodes", args,
                                         ttl=1.0)
@@ -307,9 +312,10 @@ class DNSServer:
         svc_ttl = self.agent.config.dns_service_ttl.get(
             service, self.agent.config.dns_node_ttl)
         ttl = int(svc_ttl)
-        # shuffle for poor-man's load balancing (the reference RTT-sorts
-        # with ?near and shuffles otherwise)
-        self.rng.shuffle(nodes)
+        if not self.agent.config.dns_sort_rtt:
+            # shuffle for poor-man's load balancing (the reference
+            # RTT-sorts with ?near and shuffles otherwise)
+            self.rng.shuffle(nodes)
         out = []
         for entry in nodes:
             addr = entry["Service"]["Address"] or entry["Node"]["Address"]

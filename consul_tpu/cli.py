@@ -72,11 +72,28 @@ def cmd_agent(args) -> int:
 
     if args.dev:
         # `agent -dev` binds the reference's well-known ports (8500/8600/
-        # 8300/8301) so other CLI commands' defaults just work; explicit
-        # -*-port flags still win (merged above).
+        # 8300/8301) so other CLI commands' defaults just work. Config
+        # FILE ports beat the dev defaults (overrides clobber files in
+        # load(), so they must be folded in here); explicit -*-port
+        # flags beat both.
         defaults = {"http": 8500, "dns": 8600, "server": 8300,
                     "serf_lan": 8301, "serf_wan": 8302, "grpc": 8502}
-        ports = {**defaults, **overrides.get("ports", {})}
+        file_ports: dict = {}
+        for path in args.config_file or []:
+            if os.path.isdir(path):
+                candidates = [os.path.join(path, f)
+                              for f in sorted(os.listdir(path))
+                              if f.endswith(".json")]  # as load() does
+            else:
+                candidates = [path]
+            for f in candidates:
+                try:
+                    with open(f) as fh:
+                        file_ports.update(
+                            (json.load(fh) or {}).get("ports") or {})
+                except Exception:  # noqa: BLE001
+                    continue  # load() reports unreadable configs
+        ports = {**defaults, **file_ports, **overrides.get("ports", {})}
         overrides["ports"] = ports
     cfg = config_mod.load(files=args.config_file or [],
                           overrides=overrides, dev=args.dev)

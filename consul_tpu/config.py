@@ -167,6 +167,7 @@ class RuntimeConfig:
     acl_initial_management_token: str = ""
     acl_agent_token: str = ""    # the agent's OWN operations (AE sync)
     acl_default_token: str = ""  # requests arriving without a token (DNS)
+    acl_replication_token: str = ""  # secondary-DC pulls from primary
     acl_token_ttl: float = 30.0
 
     # DNS
@@ -178,6 +179,9 @@ class RuntimeConfig:
     dns_service_ttl: dict[str, float] = field(default_factory=dict)
     dns_enable_truncate: bool = False
     dns_only_passing: bool = False
+    # RTT-sort DNS answers by Vivaldi distance from this agent
+    # (dns_config.sort_rtt; the reference sorts when ?near= is set)
+    dns_sort_rtt: bool = False
 
     # TLS (reference: tlsutil Configurator; tls{} config block)
     tls_ca_file: str = ""
@@ -318,7 +322,8 @@ def load(
                      ("node_ttl", "dns_node_ttl"),
                      ("service_ttl", "dns_service_ttl"),
                      ("enable_truncate", "dns_enable_truncate"),
-                     ("only_passing", "dns_only_passing")):
+                     ("only_passing", "dns_only_passing"),
+                     ("sort_rtt", "dns_sort_rtt")):
         if src in dns:
             kwargs[tgt] = dns[src]
     if "recursors" in raw:
@@ -356,6 +361,8 @@ def load(
             tokens["initial_management"]
     if "agent" in tokens:
         kwargs["acl_agent_token"] = tokens["agent"]
+    if "replication" in tokens:
+        kwargs["acl_replication_token"] = tokens["replication"]
     if "default" in tokens:
         kwargs["acl_default_token"] = tokens["default"]
 

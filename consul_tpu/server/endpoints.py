@@ -32,6 +32,22 @@ def register_endpoints(srv) -> None:
         secrets must never be replicated/persisted."""
         return {k: v for k, v in args.items() if k != "AuthToken"}
 
+    def primary_owned(name, fn):
+        """Register a write endpoint for a PRIMARY-owned table (ACL,
+        config entries, intentions): in a secondary DC the write
+        forwards to the primary (leader_acl.go: secondaries are
+        read-only replicas of these tables) and replication mirrors it
+        back."""
+
+        def wrapper(args):
+            pdc = srv.config.primary_datacenter
+            if pdc and pdc != srv.config.datacenter:
+                return srv._forward_dc(name, {**args,
+                                              "Datacenter": pdc}, pdc)
+            return fn(args)
+
+        e[name] = wrapper
+
     def read(name, fn):
         """Register a read endpoint with consistency modes (rpc.go
         ForwardRPC): default → forwarded to the leader (read-your-writes);
@@ -433,6 +449,11 @@ def register_endpoints(srv) -> None:
 
     def acl_token_list(args):
         require(authz(args).acl_read(), "acl read")
+        if args.get("IncludeSecrets"):
+            # replication pulls need the real SecretIDs (the table key);
+            # gated on acl:write like the reference's replication token
+            require(authz(args).acl_write(), "acl write")
+            return {"Tokens": state.raw_list("acl_tokens")}
         return {"Tokens": [
             {k: v for k, v in t.items() if k != "SecretID"}
             for t in state.raw_list("acl_tokens")]}
@@ -616,8 +637,8 @@ def register_endpoints(srv) -> None:
                              {"Op": "delete", "Token": tok})
         return True
 
-    e["ACL.AuthMethodSet"] = acl_auth_method_set
-    e["ACL.AuthMethodDelete"] = acl_auth_method_delete
+    primary_owned("ACL.AuthMethodSet", acl_auth_method_set)
+    primary_owned("ACL.AuthMethodDelete", acl_auth_method_delete)
     read("ACL.AuthMethodRead", lambda args: (
         require(authz(args).acl_read(), "acl read") or
         {"AuthMethod": state.raw_get("acl_auth_methods",
@@ -625,8 +646,8 @@ def register_endpoints(srv) -> None:
     read("ACL.AuthMethodList", lambda args: (
         require(authz(args).acl_read(), "acl read") or
         {"AuthMethods": state.raw_list("acl_auth_methods")}))
-    e["ACL.BindingRuleSet"] = acl_binding_rule_set
-    e["ACL.BindingRuleDelete"] = acl_binding_rule_delete
+    primary_owned("ACL.BindingRuleSet", acl_binding_rule_set)
+    primary_owned("ACL.BindingRuleDelete", acl_binding_rule_delete)
     read("ACL.BindingRuleRead", lambda args: (
         require(authz(args).acl_read(), "acl read") or
         {"BindingRule": state.raw_get("acl_binding_rules",
@@ -634,21 +655,21 @@ def register_endpoints(srv) -> None:
     read("ACL.BindingRuleList", lambda args: (
         require(authz(args).acl_read(), "acl read") or
         {"BindingRules": state.raw_list("acl_binding_rules")}))
-    e["ACL.Login"] = acl_login
-    e["ACL.Logout"] = acl_logout
+    primary_owned("ACL.Login", acl_login)
+    primary_owned("ACL.Logout", acl_logout)
 
-    e["ACL.RoleSet"] = acl_role_set
-    e["ACL.RoleDelete"] = acl_role_delete
+    primary_owned("ACL.RoleSet", acl_role_set)
+    primary_owned("ACL.RoleDelete", acl_role_delete)
     read("ACL.RoleRead", acl_role_read)
     read("ACL.RoleList", acl_role_list)
 
     e["ACL.Bootstrap"] = acl_bootstrap
-    e["ACL.TokenSet"] = acl_token_set
-    e["ACL.TokenDelete"] = acl_token_delete
+    primary_owned("ACL.TokenSet", acl_token_set)
+    primary_owned("ACL.TokenDelete", acl_token_delete)
     read("ACL.TokenRead", acl_token_read)
     read("ACL.TokenList", acl_token_list)
-    e["ACL.PolicySet"] = acl_policy_set
-    e["ACL.PolicyDelete"] = acl_policy_delete
+    primary_owned("ACL.PolicySet", acl_policy_set)
+    primary_owned("ACL.PolicyDelete", acl_policy_delete)
     read("ACL.PolicyRead", acl_policy_read)
     read("ACL.PolicyList", acl_policy_list)
 
@@ -958,7 +979,7 @@ def register_endpoints(srv) -> None:
         nodes = state.check_service_nodes(
             svc.get("Service", ""),
             tag=(svc.get("Tags") or [None])[0],
-            passing_only=not svc.get("OnlyPassing", True) is False)
+            passing_only=bool(svc.get("OnlyPassing", False)))
         limit = int(args.get("Limit") or 0)
         return nodes[:limit] if limit else nodes
 
@@ -1052,7 +1073,7 @@ def register_endpoints(srv) -> None:
             default_allow)
         return {"Allowed": allowed, "Reason": reason}
 
-    e["Intention.Apply"] = intention_apply
+    primary_owned("Intention.Apply", intention_apply)
     read("Intention.List", intention_list)
     read("Intention.Match", intention_match)
     read("Intention.Check", intention_check)
@@ -1089,7 +1110,7 @@ def register_endpoints(srv) -> None:
                         if v.get("Kind") != "connect-ca"
                         and (not kind or v.get("Kind") == kind)]})
 
-    e["ConfigEntry.Apply"] = config_apply
+    primary_owned("ConfigEntry.Apply", config_apply)
     read("ConfigEntry.Get", config_get)
     read("ConfigEntry.List", config_list)
 

@@ -79,11 +79,28 @@ class Server:
 
         # L0: gossip membership. Tags advertise the server role + RPC addr
         # (reference: agent/consul/server_serf.go:101-146).
+        # WAN gossip pool: servers across datacenters, name.dc identity
+        # (reference: setupSerf WAN, server.go:684). Created BEFORE the
+        # LAN pool so its transport address rides the LAN tags and
+        # servers can flood-join each other into the WAN mesh.
+        self.serf_wan: Optional[Serf] = None
+        if config.port("serf_wan") >= 0:  # -1 disables the WAN pool
+            wan_tags = {"role": "consul", "dc": config.datacenter,
+                        "id": self.node_id, "rpc_addr": self.rpc.addr}
+            self.serf_wan = Serf(
+                name=f"{self.name}.{config.datacenter}",
+                transport=UDPTransport(config.bind_addr,
+                                       config.port("serf_wan")),
+                config=config.gossip_wan,
+                tags=wan_tags,
+                keyring=self._keyring())
         tags = {
             "role": "consul", "dc": config.datacenter, "id": self.node_id,
             "rpc_addr": self.rpc.addr,
             "expect": str(config.bootstrap_expect or 0),
             "bootstrap": "1" if config.bootstrap else "0",
+            "wan_addr": (self.serf_wan.memberlist.transport.addr
+                         if self.serf_wan else ""),
         }
         self._reconcile_ch: list[SerfEvent] = []
         self._reconcile_lock = threading.Lock()
@@ -107,20 +124,6 @@ class Server:
         self.state.add_change_hook(
             lambda tables, idx: self.acl.invalidate()
             if "acl" in tables else None)
-
-        # WAN gossip pool: servers across datacenters, name.dc identity
-        # (reference: setupSerf WAN, server.go:684; wanfed tunnels aside)
-        self.serf_wan: Optional[Serf] = None
-        if config.port("serf_wan") >= 0:  # -1 disables the WAN pool
-            wan_tags = {"role": "consul", "dc": config.datacenter,
-                        "id": self.node_id, "rpc_addr": self.rpc.addr}
-            self.serf_wan = Serf(
-                name=f"{self.name}.{config.datacenter}",
-                transport=UDPTransport(config.bind_addr,
-                                       config.port("serf_wan")),
-                config=config.gossip_wan,
-                tags=wan_tags,
-                keyring=self._keyring())
 
         # Connect CA manager (leader_connect_ca.go CAManager)
         from consul_tpu.connect import CAManager
@@ -391,6 +394,7 @@ class Server:
     def _leader_tick(self) -> None:
         """Leader duties (leader.go leaderLoop): raft membership from serf,
         reconcile queued member events, expire TTL sessions."""
+        self._flood_join()  # every server floods, not just the leader
         if not self.is_leader():
             self._was_leader = False
             # only the leader reconciles; drop stale queued events
@@ -428,6 +432,109 @@ class Server:
                 return
         self._drain_reconcile()
         self._expire_sessions()
+        self._replicate_from_primary()
+
+    def _flood_join(self) -> None:
+        """Flood joiner (server_serf.go FloodJoins): every LAN server's
+        WAN address is pushed into the WAN pool, so operators only ever
+        `join -wan` ONE server per DC and the rest follow."""
+        if self.serf_wan is None:
+            return
+        wan_names = {m.name for m in self.serf_wan.members()}
+        for m in self.serf.members():
+            if m.tags.get("role") != "consul":
+                continue
+            wan_addr = m.tags.get("wan_addr")
+            wan_name = f"{m.name}.{self.config.datacenter}"
+            if not wan_addr or wan_name in wan_names:
+                continue
+            try:
+                self.serf_wan.join([wan_addr])
+            except Exception:  # noqa: BLE001
+                pass  # unreachable now; retried next tick
+
+    def _replicate_from_primary(self) -> None:
+        """Leader replication routines (leader.go startACLReplication /
+        startConfigReplication): a secondary DC's leader mirrors the
+        primary's ACL tables, config entries, and intentions into its
+        own raft. Writes of these types forward to the primary (see
+        endpoints), so the primary owns them and secondaries converge.
+        Preserved locally: connect-ca config (each DC runs its own CA)
+        and this DC's configured initial management token (lockout
+        guard)."""
+        pdc = self.config.primary_datacenter
+        if not pdc or pdc == self.config.datacenter:
+            return
+        self._repl_tick = getattr(self, "_repl_tick", 0) + 1
+        if self._repl_tick % 3:  # every ~3s on the 1s leader tick
+            return
+        token = self.config.acl_replication_token \
+            or self.config.acl_initial_management_token
+        auth = {"AuthToken": token} if token else {}
+
+        def pull(method, args=None):
+            return self._forward_dc(method, {**(args or {}), **auth,
+                                             "Datacenter": pdc}, pdc)
+
+        try:
+            self._mirror(
+                pull("ACL.PolicyList")["Policies"], "acl_policies",
+                lambda p: p.get("ID"),
+                MessageType.ACL_POLICY, "Policy")
+            self._mirror(
+                pull("ACL.RoleList")["Roles"], "acl_roles",
+                lambda r: r.get("ID"), MessageType.ACL_ROLE, "Role")
+            self._mirror(
+                pull("ACL.AuthMethodList")["AuthMethods"],
+                "acl_auth_methods", lambda m: m.get("Name"),
+                MessageType.ACL_AUTH_METHOD, "AuthMethod")
+            self._mirror(
+                pull("ACL.BindingRuleList")["BindingRules"],
+                "acl_binding_rules", lambda r: r.get("ID"),
+                MessageType.ACL_BINDING_RULE, "BindingRule")
+            keep = {self.config.acl_initial_management_token}
+            self._mirror(
+                pull("ACL.TokenList",
+                     {"IncludeSecrets": True})["Tokens"], "acl_tokens",
+                lambda t: t.get("SecretID"),
+                MessageType.ACL_TOKEN, "Token",
+                keep_local=lambda k, v: k in keep)
+            self._mirror(
+                pull("ConfigEntry.List")["Entries"], "config_entries",
+                lambda e: f"{e.get('Kind', '')}/{e.get('Name', '')}",
+                MessageType.CONFIG_ENTRY, "Entry", op_set="upsert",
+                keep_local=lambda k, v: v.get("Kind") == "connect-ca")
+            self._mirror(
+                pull("Intention.List")["Intentions"], "intentions",
+                lambda i: f"{i.get('SourceName', '*')}->"
+                          f"{i.get('DestinationName', '*')}",
+                MessageType.INTENTION, "Intention", op_set="upsert")
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("replication from %s failed: %s", pdc, e)
+
+    def _mirror(self, remote_list, table, key_of, msg_type, body_key,
+                op_set="set", keep_local=None) -> None:
+        """Diff a remote listing against a local raw table and apply
+        the difference through raft."""
+        remote = {key_of(v): v for v in remote_list or []
+                  if key_of(v) is not None}
+        local = {}
+        for v in self.state.raw_list(table):
+            k = key_of(v)
+            if k is not None:
+                local[k] = v
+        for k, v in remote.items():
+            lv = local.get(k)
+            if lv is None or _strip_idx(lv) != _strip_idx(v):
+                self.raft.apply(encode_command(
+                    msg_type, {"Op": op_set, body_key: _strip_idx(v)}))
+        for k, v in local.items():
+            if k in remote:
+                continue
+            if keep_local is not None and keep_local(k, v):
+                continue
+            self.raft.apply(encode_command(
+                msg_type, {"Op": "delete", body_key: v}))
 
     def _drain_reconcile(self) -> None:
         with self._reconcile_lock:
@@ -566,3 +673,9 @@ class Server:
 
 
 from consul_tpu.utils.duration import parse_duration as _parse_ttl  # noqa: E402
+
+
+def _strip_idx(d: dict) -> dict:
+    """Replication diffs ignore per-DC raft bookkeeping fields."""
+    return {k: v for k, v in d.items()
+            if k not in ("CreateIndex", "ModifyIndex", "RaftIndex")}

@@ -135,3 +135,131 @@ def test_prepared_query_cross_dc_failover(two_dcs):
     assert res["Failovers"] == 1
     assert res["Nodes"] and \
         res["Nodes"][0]["Service"]["Service"] == "fo-svc"
+
+
+def test_flood_join_brings_lan_peers_into_wan(two_dcs):
+    """Flood joiner (server_serf.go FloodJoins): a second dc1 server
+    that only joins the LAN shows up in every WAN pool automatically."""
+    a1, a2 = two_dcs
+    extra = Agent(load(dev=True, overrides={
+        "node_name": "dc1-srv2", "datacenter": "dc1",
+        "bootstrap": False,
+        "retry_join": [a1.server.serf.memberlist.transport.addr]}))
+    extra.start(serve_http=False, serve_dns=False)
+    try:
+        # NO join -wan anywhere: the flood loop must do it
+        wait_for(lambda: "dc1-srv2.dc1" in {
+            m.name for m in a2.server.wan_members()},
+            timeout=20.0, what="flood-joined WAN member in dc2")
+        assert "dc1-srv2.dc1" in {
+            m.name for m in a1.server.wan_members()}
+    finally:
+        extra.shutdown()
+
+
+def test_acl_and_config_replication_to_secondary():
+    """Leader replication routines (leader.go startACLReplication /
+    startConfigReplication): the secondary mirrors primary-owned tables
+    and forwards writes of those types to the primary."""
+    a1 = Agent(load(dev=True, overrides={
+        "node_name": "pri-srv", "datacenter": "dc1",
+        "primary_datacenter": "dc1"}))
+    a2 = Agent(load(dev=True, overrides={
+        "node_name": "sec-srv", "datacenter": "dc2",
+        "primary_datacenter": "dc1"}))
+    a1.start(serve_dns=False)
+    a2.start(serve_dns=False)
+    try:
+        wait_for(lambda: a1.server.is_leader()
+                 and a2.server.is_leader(), what="leaders")
+        assert a1.server.join_wan(
+            [a2.server.serf_wan.memberlist.transport.addr]) == 1
+        wait_for(lambda: len(a1.server.wan_members()) == 2
+                 and len(a2.server.wan_members()) == 2,
+                 what="wan convergence")
+        c1, c2 = ConsulClient(a1.http.addr), ConsulClient(a2.http.addr)
+        # a write SENT TO THE SECONDARY lands in the primary...
+        pol = c2.put("/v1/acl/policy", body={
+            "Name": "repl-pol", "Rules": {"key_prefix":
+                                          {"": "read"}}})
+        assert any(p["Name"] == "repl-pol"
+                   for p in c1.get("/v1/acl/policies"))
+        c2.put("/v1/config", body={
+            "Kind": "service-defaults", "Name": "repl-svc",
+            "Protocol": "http"})
+        assert c1.get("/v1/config/service-defaults/repl-svc")[
+            "Protocol"] == "http"
+        # ...and replication mirrors it into the secondary's OWN state
+        wait_for(lambda: a2.server.state.raw_get(
+            "acl_policies", pol["ID"]) is not None,
+            timeout=15.0, what="policy replicated to dc2")
+        wait_for(lambda: a2.server.state.raw_get(
+            "config_entries", "service-defaults/repl-svc") is not None,
+            timeout=15.0, what="config entry replicated to dc2")
+        # deletes in the primary propagate
+        c1.delete(f"/v1/acl/policy/{pol['ID']}")
+        wait_for(lambda: a2.server.state.raw_get(
+            "acl_policies", pol["ID"]) is None,
+            timeout=15.0, what="policy delete replicated")
+        # each DC keeps its own CA despite config mirroring: roots
+        # initialized in both DCs stay distinct through a replication
+        # cycle (the connect-ca config kind is excluded from the mirror)
+        c1.get("/v1/agent/connect/ca/leaf/w1")  # lazy CA init
+        c2.get("/v1/agent/connect/ca/leaf/w2")
+        r1 = c1.get("/v1/connect/ca/roots")
+        r2 = c2.get("/v1/connect/ca/roots")
+        assert r1["TrustDomain"] != r2["TrustDomain"]
+        time.sleep(4)  # a full replication interval
+        assert c2.get("/v1/connect/ca/roots")["TrustDomain"] == \
+            r2["TrustDomain"]
+    finally:
+        a1.shutdown()
+        a2.shutdown()
+
+
+def test_token_replication_with_acls_enabled():
+    """ACL token replication needs the real SecretIDs (IncludeSecrets
+    pull, gated on acl:write) — a redacted listing would make the
+    mirror destructive."""
+    acl = {"enabled": True, "default_policy": "deny",
+           "tokens": {"initial_management": "root-sec",
+                      "agent": "root-sec",
+                      "replication": "root-sec"}}
+    a1 = Agent(load(dev=True, overrides={
+        "node_name": "pri-acl", "datacenter": "dc1",
+        "primary_datacenter": "dc1", "acl": acl}))
+    a2 = Agent(load(dev=True, overrides={
+        "node_name": "sec-acl", "datacenter": "dc2",
+        "primary_datacenter": "dc1", "acl": acl}))
+    a1.start(serve_dns=False)
+    a2.start(serve_dns=False)
+    try:
+        wait_for(lambda: a1.server.is_leader()
+                 and a2.server.is_leader(), what="leaders")
+        wait_for(lambda: a1.server.state.raw_get(
+            "acl_tokens", "root-sec") is not None
+            and a2.server.state.raw_get(
+                "acl_tokens", "root-sec") is not None,
+            what="management tokens seeded")
+        assert a1.server.join_wan(
+            [a2.server.serf_wan.memberlist.transport.addr]) == 1
+        wait_for(lambda: len(a2.server.wan_members()) == 2,
+                 what="wan convergence")
+        c1 = ConsulClient(a1.http.addr, token="root-sec")
+        tok = c1.put("/v1/acl/token", body={
+            "Description": "replicated-token",
+            "Policies": []})
+        # the token (with secret) replicates into the secondary...
+        wait_for(lambda: a2.server.state.raw_get(
+            "acl_tokens", tok["SecretID"]) is not None,
+            timeout=20.0, what="token replicated")
+        # ...and the secondary's management token SURVIVES mirroring
+        time.sleep(4)
+        assert a2.server.state.raw_get("acl_tokens", "root-sec") \
+            is not None
+        # redacted listing still redacts for ordinary reads
+        toks = c1.get("/v1/acl/tokens")
+        assert all("SecretID" not in t for t in toks)
+    finally:
+        a1.shutdown()
+        a2.shutdown()
