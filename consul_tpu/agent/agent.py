@@ -79,6 +79,8 @@ class Agent:
         # recent user events ring buffer (/v1/event/list,
         # agent/user_event.go UserEvents)
         self._recent_events: list[dict] = []
+        # leaf-cert renewal cache (agent/leafcert LeafCertManager)
+        self._leaf_cache: dict[str, dict] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -326,6 +328,32 @@ class Agent:
         if found and sidecar_id in self.local.list_services():
             self.deregister_service(sidecar_id)
         return found
+
+    def leaf_cert(self, service: str, rpc=None) -> dict[str, Any]:
+        """Leaf manager (agent/leafcert): cache issued leaves, re-sign
+        past HALF their validity, and re-sign immediately when the CA's
+        active root changes — a rotation (possibly retiring a
+        compromised key) must reach the data path now, not at the
+        cert's half-life."""
+        import datetime as dt
+
+        rpc = rpc or self.rpc
+        try:
+            roots = rpc("ConnectCA.Roots", {"AllowStale": True})
+            active_id = (roots.get("Roots") or [{}])[0].get("ID", "")
+        except Exception:  # noqa: BLE001
+            active_id = ""
+        cached = self._leaf_cache.get(service)
+        now = dt.datetime.now(dt.timezone.utc)
+        if cached is not None and cached[0] == active_id:
+            leaf = cached[1]
+            after = dt.datetime.fromisoformat(leaf["ValidAfter"])
+            before = dt.datetime.fromisoformat(leaf["ValidBefore"])
+            if now < after + (before - after) / 2:
+                return leaf
+        leaf = rpc("ConnectCA.Sign", {"Service": service})
+        self._leaf_cache[service] = (active_id, leaf)
+        return leaf
 
     def _merge_central_defaults(self, svc) -> None:
         """Merge central config into a local registration (the service

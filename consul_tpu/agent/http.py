@@ -88,6 +88,12 @@ class HTTPApi:
                         "http.request", start, {"method": method})
 
             def _err(self, code: int, msg: str) -> None:
+                if code == 304:
+                    # RFC 7232: 304 carries NO body — stray bytes would
+                    # desync keep-alive clients (Envoy's xDS poller)
+                    self.send_response(code)
+                    self.end_headers()
+                    return
                 payload = msg.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "text/plain")
@@ -372,6 +378,23 @@ class HTTPApi:
                     base64.b64encode(body).decode() if body else None}, None
 
         # --------------------------------------------------------- connect
+        if (m := re.match(r"^/v3/discovery:(clusters|listeners)$",
+                          path)) and method == "POST":
+            # Envoy REST xDS poll (connect/xds.py): node.id names the
+            # proxy; matching version_info → 304 (no change)
+            from consul_tpu.connect.proxycfg import assemble_snapshot
+            from consul_tpu.connect.xds import discovery_response
+
+            body = jbody()
+            proxy_id = (body.get("node") or {}).get("id", "")
+            snap = assemble_snapshot(a, proxy_id, rpc=rpc)
+            if snap is None:
+                raise HTTPError(404, "unknown proxy service")
+            res = discovery_response(snap, m.group(1),
+                                     body.get("version_info", ""))
+            if res is None:
+                raise HTTPError(304, "not modified")
+            return res, None
         if (m := re.match(r"^/v1/agent/connect/proxy/(.+)$", path)):
             from consul_tpu.connect.proxycfg import assemble_snapshot
 
@@ -386,7 +409,7 @@ class HTTPApi:
             return res, res.get("Index")
         if (m := re.match(r"^/v1/agent/connect/ca/leaf/(.+)$", path)):
             svc = urllib.parse.unquote(m.group(1))
-            return rpc("ConnectCA.Sign", {"Service": svc}), None
+            return a.leaf_cert(svc, rpc), None
         if path == "/v1/connect/ca/rotate" and method in ("PUT", "POST"):
             return rpc("ConnectCA.Rotate", {}), None
         if path == "/v1/connect/intentions":

@@ -24,6 +24,7 @@ class APIError(Exception):
 class ConsulClient:
     def __init__(self, addr: str = "127.0.0.1:8500",
                  scheme: str = "http", token: str = "") -> None:
+        self.addr = addr
         self.base = f"{scheme}://{addr}"
         self.token = token
 

@@ -563,7 +563,15 @@ def cmd_connect(args) -> int:
     c = _client(args)
     proxy_id = args.proxy_id or f"{args.sidecar_for}-sidecar-proxy"
     snap = c.get(f"/v1/agent/connect/proxy/{proxy_id}")
-    cfg = bootstrap_config(snap, admin_port=args.admin_port)
+    if args.xds:
+        # dynamic bootstrap: Envoy polls the agent's REST xDS for live
+        # CDS/LDS updates instead of a frozen static config
+        from consul_tpu.connect.xds import dynamic_bootstrap
+
+        cfg = dynamic_bootstrap(snap, c.addr,
+                                admin_port=args.admin_port)
+    else:
+        cfg = bootstrap_config(snap, admin_port=args.admin_port)
     print(json.dumps(cfg, indent=2))
     return 0
 
@@ -675,6 +683,21 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="consul-tpu")
     p.add_argument("-http-addr", dest="http_addr", default=None)
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    def finish(parser=None):
+        # the reference accepts -http-addr AFTER the (sub)command too;
+        # argparse preserves a value already parsed by an outer parser
+        # (defaults only fill unset attributes). Recurses into nested
+        # subcommands (connect envoy, acl token, ...).
+        for act in (parser or p)._actions:
+            if isinstance(act, argparse._SubParsersAction):
+                for sp in act.choices.values():
+                    try:
+                        sp.add_argument("-http-addr", dest="http_addr",
+                                        default=None)
+                    except argparse.ArgumentError:
+                        pass
+                    finish(sp)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
 
@@ -820,6 +843,9 @@ def build_parser() -> argparse.ArgumentParser:
     envoy.add_argument("-sidecar-for", dest="sidecar_for", default="")
     envoy.add_argument("-proxy-id", dest="proxy_id", default="")
     envoy.add_argument("-bootstrap", action="store_true")
+    envoy.add_argument("-xds", action="store_true",
+                       help="dynamic bootstrap polling the agent's "
+                            "REST xDS (live updates)")
     envoy.add_argument("-admin-bind-port", type=int, default=19000,
                        dest="admin_port")
     cn.set_defaults(fn=cmd_connect)
@@ -871,6 +897,7 @@ def build_parser() -> argparse.ArgumentParser:
     raftsub.add_parser("list-peers")
     op.set_defaults(fn=cmd_operator)
 
+    finish()
     return p
 
 

@@ -105,6 +105,33 @@ def sign_leaf(root: dict[str, str], service: str, dc: str,
     }
 
 
+def cross_sign(old_root: dict[str, str],
+               new_root: dict[str, str]) -> str:
+    """Cross-sign the NEW root's key with the OLD root's key
+    (provider_consul.go CrossSignCA): an intermediate with the new
+    root's subject+public key, issued by the old root. Agents that
+    still only trust the old root can then verify leaves signed by the
+    new root through this bridge during rotation."""
+    old_key = serialization.load_pem_private_key(
+        old_root["PrivateKey"].encode(), password=None)
+    old_cert = x509.load_pem_x509_certificate(
+        old_root["RootCert"].encode())
+    new_cert = x509.load_pem_x509_certificate(
+        new_root["RootCert"].encode())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    xc = (x509.CertificateBuilder()
+          .subject_name(new_cert.subject)
+          .issuer_name(old_cert.subject)
+          .public_key(new_cert.public_key())
+          .serial_number(x509.random_serial_number())
+          .not_valid_before(now - datetime.timedelta(minutes=5))
+          .not_valid_after(old_cert.not_valid_after_utc)
+          .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                         critical=True)
+          .sign(old_key, hashes.SHA256()))
+    return xc.public_bytes(serialization.Encoding.PEM).decode()
+
+
 def verify_leaf(root_pem: str, leaf_pem: str) -> Optional[str]:
     """Verify chain + return the leaf's SPIFFE URI (or None)."""
     root = x509.load_pem_x509_certificate(root_pem.encode())
@@ -164,6 +191,9 @@ class CAManager:
         trust_domain = old["TrustDomain"] if old \
             else f"{uuid.uuid4()}.consul"
         new = generate_root(trust_domain, self.server.config.datacenter)
+        if old is not None:
+            # bridge cert for agents that still only trust the old root
+            new["CrossSignedIntermediate"] = cross_sign(old, new)
         from consul_tpu.state import MessageType
 
         self.server.forward_or_apply(MessageType.CONFIG_ENTRY, {

@@ -66,11 +66,17 @@ def _rbac_filters(intentions: list[dict[str, Any]],
 def _tls_context(snapshot: dict[str, Any],
                  leaf: Optional[dict[str, Any]] = None) -> dict[str, Any]:
     leaf = leaf or snapshot["Leaf"]
-    roots_pem = "".join(r["RootCert"] for r in snapshot["Roots"])
+    # trust bundle: every root plus any rotation bridge certs, so both
+    # pre- and post-rotation peers verify
+    roots_pem = "".join(
+        r["RootCert"] + r.get("CrossSignedIntermediate", "")
+        for r in snapshot["Roots"])
     return {
         "common_tls_context": {
             "tls_certificates": [{
-                "certificate_chain": {"inline_string": leaf["CertPEM"]},
+                "certificate_chain": {"inline_string":
+                                      leaf.get("CertChainPEM")
+                                      or leaf["CertPEM"]},
                 "private_key": {"inline_string": leaf["PrivateKeyPEM"]},
             }],
             "validation_context": {

@@ -69,8 +69,10 @@ def assemble_snapshot(agent, proxy_id: str,
     dest = services.get(dest_id)
 
     # sign FIRST: it initializes the CA on first use, so the roots
-    # read below is never empty on a fresh cluster
-    leaf = rpc("ConnectCA.Sign", {"Service": dest_name})
+    # read below is never empty on a fresh cluster. Via the agent's
+    # leaf manager: repeated snapshot assemblies (xDS polls) reuse the
+    # cached cert instead of minting a new keypair every time.
+    leaf = agent.leaf_cert(dest_name, rpc)
     roots = rpc("ConnectCA.Roots", {})
 
     from consul_tpu.connect.chain import compile_chain
@@ -160,7 +162,7 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
 
     get_entry = _entry_getter(rpc)
     gw_name = proxy.service
-    leaf = rpc("ConnectCA.Sign", {"Service": gw_name})
+    leaf = agent.leaf_cert(gw_name, rpc)
     roots = rpc("ConnectCA.Roots", {})
     snap: dict[str, Any] = {
         "ProxyID": proxy.id,
@@ -212,7 +214,7 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
                 "Name": name,
                 # the gateway presents the SERVICE's identity to mesh
                 # callers — each linked service gets its own leaf
-                "Leaf": rpc("ConnectCA.Sign", {"Service": name}),
+                "Leaf": agent.leaf_cert(name, rpc),
                 # external instances are registered directly (no
                 # sidecar): dial the service itself
                 "Endpoints": _lookup_endpoints(rpc, name,

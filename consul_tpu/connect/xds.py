@@ -1,0 +1,74 @@
+"""xDS over REST: live Envoy config updates without gRPC.
+
+Reference: agent/xds (the delta-gRPC xDS server). This serves the same
+CDS/LDS resource sets Envoy needs, over Envoy's REST config-source
+protocol (`api_type: REST` fetches POST /v3/discovery:<type>): each
+poll rebuilds the proxy's snapshot, so catalog/intention/chain changes
+reach a RUNNING Envoy within one refresh interval — the live-update
+capability the static bootstrap lacks. version_info is a content hash;
+an unchanged hash returns 304 so Envoy treats the poll as a no-op.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Optional
+
+from consul_tpu.connect.envoy import _addr, bootstrap_config
+
+CLUSTER_TYPE = "type.googleapis.com/envoy.config.cluster.v3.Cluster"
+LISTENER_TYPE = "type.googleapis.com/envoy.config.listener.v3.Listener"
+
+_KIND_TO_TYPE = {"clusters": CLUSTER_TYPE, "listeners": LISTENER_TYPE}
+
+
+def discovery_response(snapshot: dict[str, Any], kind: str,
+                       request_version: str = ""
+                       ) -> Optional[dict[str, Any]]:
+    """Build a DiscoveryResponse for `kind` ("clusters"/"listeners")
+    from a proxy snapshot. Returns None when request_version already
+    matches (caller answers 304 Not Modified)."""
+    type_url = _KIND_TO_TYPE.get(kind)
+    if type_url is None:
+        raise ValueError(f"unknown xds resource kind {kind!r}")
+    cfg = bootstrap_config(snapshot)
+    raw = cfg["static_resources"][kind]
+    resources = [{"@type": type_url, **r} for r in raw]
+    version = hashlib.sha256(
+        json.dumps(resources, sort_keys=True).encode()).hexdigest()[:16]
+    if request_version and request_version == version:
+        return None
+    return {"version_info": version, "resources": resources,
+            "type_url": type_url}
+
+
+def dynamic_bootstrap(snapshot: dict[str, Any], agent_http_addr: str,
+                      admin_port: int = 19000,
+                      refresh: str = "5s") -> dict[str, Any]:
+    """Envoy bootstrap in DYNAMIC mode: CDS/LDS fetched from the
+    agent's REST xDS endpoints instead of materialized statically
+    (command/connect/envoy bootstrap pointing at the agent's xDS)."""
+    host, _, port = agent_http_addr.rpartition(":")
+    source = {"api_config_source": {
+        "api_type": "REST", "transport_api_version": "V3",
+        "cluster_names": ["consul_xds"],
+        "refresh_delay": refresh}}
+    return {
+        "admin": {"address": _addr("127.0.0.1", admin_port)},
+        "node": {"id": snapshot["ProxyID"],
+                 "cluster": snapshot["Service"],
+                 "metadata": {"namespace": "default",
+                              "trust_domain": snapshot["TrustDomain"]}},
+        "dynamic_resources": {"cds_config": source,
+                              "lds_config": source},
+        "static_resources": {"clusters": [{
+            "name": "consul_xds", "type": "STATIC",
+            "connect_timeout": "5s",
+            "load_assignment": {
+                "cluster_name": "consul_xds",
+                "endpoints": [{"lb_endpoints": [{"endpoint": {
+                    "address": _addr(host or "127.0.0.1",
+                                     int(port))}}]}]},
+        }]},
+    }

@@ -1018,7 +1018,13 @@ def register_endpoints(srv) -> None:
         from consul_tpu.connect.ca import sign_leaf
 
         root = srv.ca.initialize()
-        return sign_leaf(root, service, srv.config.datacenter)
+        leaf = sign_leaf(root, service, srv.config.datacenter)
+        if root.get("CrossSignedIntermediate"):
+            # present the rotation bridge with the leaf so old-root
+            # verifiers can build a path to the new root
+            leaf["CertChainPEM"] = (leaf["CertPEM"]
+                                    + root["CrossSignedIntermediate"])
+        return leaf
 
     def ca_rotate(args):
         require(authz(args).operator_write(), "operator write")

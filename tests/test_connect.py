@@ -421,3 +421,95 @@ def test_service_router_in_snapshot_and_envoy(agent, client):
     finally:
         client.delete("/v1/config/service-router/db2")
         client.delete("/v1/config/service-defaults/db2")
+
+
+def test_rest_xds_discovery(agent, client):
+    """REST xDS (connect/xds.py): Envoy polls /v3/discovery:* for live
+    config; unchanged version_info gets 304, config changes flip the
+    version and the resource set."""
+    res = client.post("/v3/discovery:clusters",
+                      body={"node": {"id": "api2-sidecar-proxy"}})
+    assert res["type_url"].endswith("v3.Cluster")
+    names = {r["name"] for r in res["resources"]}
+    assert "local_app" in names
+    v1 = res["version_info"]
+    # same version → 304
+    import urllib.error
+
+    with pytest.raises(APIError) as ei:
+        client.post("/v3/discovery:clusters",
+                    body={"node": {"id": "api2-sidecar-proxy"},
+                          "version_info": v1})
+    assert ei.value.code == 304
+    # a config change (splitter) flips the version within one poll
+    client.put("/v1/config", body={
+        "Kind": "service-splitter", "Name": "db2",
+        "Splits": [{"Weight": 50, "Service": "db2"},
+                   {"Weight": 50, "Service": "db2-canary"}]})
+    try:
+        res2 = client.post("/v3/discovery:clusters",
+                           body={"node": {"id": "api2-sidecar-proxy"},
+                                 "version_info": v1})
+        assert res2["version_info"] != v1
+        assert "upstream_db2_db2-canary" in \
+            {r["name"] for r in res2["resources"]}
+        # listeners endpoint works too
+        lres = client.post("/v3/discovery:listeners",
+                           body={"node": {"id": "api2-sidecar-proxy"}})
+        assert any(l["name"] == "public_listener"
+                   for l in lres["resources"])
+    finally:
+        client.delete("/v1/config/service-splitter/db2")
+
+
+def test_ca_rotation_cross_signs(agent, client):
+    """Rotation cross-signs the new root with the old key
+    (provider_consul.go CrossSignCA): agents still pinning the old root
+    verify new-root leaves through the bridge intermediate."""
+    from consul_tpu.connect.ca import verify_leaf
+
+    roots_before = client.get("/v1/connect/ca/roots")["Roots"]
+    old_pem = roots_before[0]["RootCert"]
+    new = client.put("/v1/connect/ca/rotate")
+    assert "CrossSignedIntermediate" in new
+    # the old root verifies the bridge cert...
+    uri = verify_leaf(old_pem, new["CrossSignedIntermediate"])
+    # (the intermediate has no SPIFFE URI; verification not raising and
+    # chain check below are the point)
+    import cryptography.x509 as x509
+
+    xc = x509.load_pem_x509_certificate(
+        new["CrossSignedIntermediate"].encode())
+    old = x509.load_pem_x509_certificate(old_pem.encode())
+    xc.verify_directly_issued_by(old)
+    # ...and a leaf signed by the NEW root verifies against the bridge
+    leaf = client.get("/v1/agent/connect/ca/leaf/bridge-test")
+    newc = x509.load_pem_x509_certificate(
+        new["RootCert"].encode())
+    lc = x509.load_pem_x509_certificate(leaf["CertPEM"].encode())
+    lc.verify_directly_issued_by(newc)
+    assert lc.issuer == xc.subject
+
+
+def test_leaf_renewal_cache(agent, client):
+    """The agent's leaf manager caches certs and only re-signs past
+    half validity (agent/leafcert)."""
+    l1 = client.get("/v1/agent/connect/ca/leaf/cache-svc")
+    l2 = client.get("/v1/agent/connect/ca/leaf/cache-svc")
+    assert l1["SerialNumber"] == l2["SerialNumber"]
+    # forcing the cache entry past half-life re-signs
+    import datetime as dt
+
+    rid, cached = agent._leaf_cache["cache-svc"]
+    cached = dict(cached)
+    cached["ValidAfter"] = (dt.datetime.now(dt.timezone.utc)
+                            - dt.timedelta(hours=200)).isoformat()
+    agent._leaf_cache["cache-svc"] = (rid, cached)
+    l3 = client.get("/v1/agent/connect/ca/leaf/cache-svc")
+    assert l3["SerialNumber"] != l1["SerialNumber"]
+    # a CA rotation invalidates immediately (no half-life wait)
+    client.put("/v1/connect/ca/rotate")
+    l4 = client.get("/v1/agent/connect/ca/leaf/cache-svc")
+    assert l4["SerialNumber"] != l3["SerialNumber"]
+    # the new leaf presents the rotation bridge in its chain
+    assert l4.get("CertChainPEM", "").count("BEGIN CERTIFICATE") == 2
