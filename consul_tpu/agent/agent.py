@@ -39,9 +39,23 @@ class Agent:
         self.scheduler = RealTimers()
         self._shutdown = False
 
+        # central TLS configurator FIRST (tlsutil Configurator): the
+        # server's RPC port shares it, so a hot reload reaches every
+        # listener instead of a private copy going stale
+        self.tls = None
+        if config.tls_cert_file and config.tls_key_file:
+            from consul_tpu.utils.tlsutil import TLSConfigurator
+
+            self.tls = TLSConfigurator(
+                ca_file=config.tls_ca_file,
+                cert_file=config.tls_cert_file,
+                key_file=config.tls_key_file,
+                verify_incoming=config.tls_verify_incoming,
+                verify_outgoing=config.tls_verify_outgoing)
+
         if config.server_mode:
             self.server: Optional[Server] = Server(
-                config, serf_transport=serf_transport)
+                config, serf_transport=serf_transport, tls=self.tls)
             self.client: Optional[Client] = None
             self.node_id = self.server.node_id
         else:
@@ -56,18 +70,6 @@ class Agent:
                                 coalesce=config.sync_coalesce_timeout)
         self._runners: dict[str, Any] = {}
         self._maintenance = False
-
-        # central TLS configurator (tlsutil Configurator)
-        self.tls = None
-        if config.tls_cert_file and config.tls_key_file:
-            from consul_tpu.utils.tlsutil import TLSConfigurator
-
-            self.tls = TLSConfigurator(
-                ca_file=config.tls_ca_file,
-                cert_file=config.tls_cert_file,
-                key_file=config.tls_key_file,
-                verify_incoming=config.tls_verify_incoming,
-                verify_outgoing=config.tls_verify_outgoing)
 
         self.http = None
         self.dns = None

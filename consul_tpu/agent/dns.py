@@ -162,7 +162,9 @@ class DNSServer:
         except Exception:  # noqa: BLE001 — ignore malformed additionals
             pass
 
-        answers, authoritative = self.resolve(qname, qtype)
+        res = self.resolve(qname, qtype)
+        answers, authoritative = res[0], res[1]
+        forced_rcode = res[2] if len(res) > 2 else None
         if answers is None:
             # outside our domain → recurse if configured
             fwd = self._recurse(data)
@@ -173,6 +175,8 @@ class DNSServer:
         rcode = 0 if answers else 3  # NXDOMAIN when we own it but no data
         if answers is not None and not authoritative and not answers:
             rcode = 2  # SERVFAIL for failed recursion
+        if forced_rcode is not None:
+            rcode = forced_rcode
         hdr_flags = 0x8000 | (0x0400 if authoritative else 0) \
             | (flags & 0x0100) | rcode
         # rebuild question section canonically
@@ -241,6 +245,30 @@ class DNSServer:
         if kind == "query" and len(parts) >= 2:
             return self._query_answers(qname, ".".join(parts[:-1]),
                                        qtype, ttl), True
+        if kind == "virtual" and len(parts) >= 2:
+            # <service>.virtual.<domain> → the service's virtual IP
+            # (dns.go tproxy lookups; sidecars dial it and the proxy
+            # redirects into the mesh)
+            from consul_tpu.connect.virtualip import virtual_ip
+
+            service = parts[0]
+            try:
+                res = self.agent.cached_rpc("Catalog.ServiceNodes", {
+                    "ServiceName": service, "AllowStale": True},
+                    ttl=5.0)
+                known = bool(res.get("ServiceNodes"))
+            except Exception:  # noqa: BLE001
+                known = False
+            if not known:
+                return [], True  # NXDOMAIN for unregistered services
+            if qtype in (QTYPE_A, QTYPE_ANY):
+                rd = _a_rdata(virtual_ip(service))
+                return ([_rr(qname, QTYPE_A, ttl, rd)]
+                        if rd else []), True
+            # the NAME exists (A data available): AAAA/TXT/... must be
+            # NOERROR/NODATA, not NXDOMAIN, or dual-stack resolvers
+            # negative-cache the name and kill the A lookup too
+            return [], True, 0
         return [], True
 
     def _ptr_answers(self, qname: str, name: str,

@@ -37,7 +37,10 @@ def generate_root(trust_domain: str, dc: str,
             .serial_number(x509.random_serial_number())
             .not_valid_before(now - datetime.timedelta(minutes=5))
             .not_valid_after(now + datetime.timedelta(days=ttl_days))
-            .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+            # path_length=1: room for the cross-signed rotation bridge
+            # (a pathlen-0 root forbids ANY subordinate CA, which would
+            # invalidate the very chain cross-signing exists to enable)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=1),
                            critical=True)
             .add_extension(x509.KeyUsage(
                 digital_signature=True, key_cert_sign=True,
@@ -48,6 +51,8 @@ def generate_root(trust_domain: str, dc: str,
             .add_extension(x509.SubjectAlternativeName(
                 [x509.UniformResourceIdentifier(
                     f"spiffe://{trust_domain}")]), critical=False)
+            .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                key.public_key()), critical=False)
             .sign(key, hashes.SHA256()))
     return {
         "ID": uuid.uuid4().hex,
@@ -89,6 +94,13 @@ def sign_leaf(root: dict[str, str], service: str, dc: str,
                 x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH,
                 x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]),
                 critical=False)
+            # SKI/AKI chain-building hints: strict validators (the
+            # cryptography/BoringSSL policy engines) require them
+            .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                key.public_key()), critical=False)
+            .add_extension(
+                x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                    ca_key.public_key()), critical=False)
             .sign(ca_key, hashes.SHA256()))
     return {
         "SerialNumber": format(cert.serial_number, "x"),
@@ -128,6 +140,17 @@ def cross_sign(old_root: dict[str, str],
           .not_valid_after(old_cert.not_valid_after_utc)
           .add_extension(x509.BasicConstraints(ca=True, path_length=0),
                          critical=True)
+          .add_extension(x509.KeyUsage(
+              digital_signature=True, key_cert_sign=True,
+              crl_sign=True, content_commitment=False,
+              key_encipherment=False, data_encipherment=False,
+              key_agreement=False, encipher_only=False,
+              decipher_only=False), critical=True)
+          .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+              new_cert.public_key()), critical=False)
+          .add_extension(
+              x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                  old_key.public_key()), critical=False)
           .sign(old_key, hashes.SHA256()))
     return xc.public_bytes(serialization.Encoding.PEM).decode()
 

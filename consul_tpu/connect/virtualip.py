@@ -1,0 +1,20 @@
+"""Service virtual IPs for transparent-proxy dialing.
+
+Reference: agent/consul/state/catalog.go serviceVirtualIPs (sequential
+allocation from 240.0.0.0/4, replicated through raft). This compact
+equivalent derives the address from a stable hash of the service name:
+every agent computes the same IP with NO extra replicated table, at the
+cost of a ~1/2^24 collision chance between two services — acceptable
+for the class-E range whose packets never leave the local proxy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def virtual_ip(service: str) -> str:
+    """Stable virtual IP for a service in 240.0.0.0/4 (class E: never
+    routed; the sidecar's tproxy redirect intercepts it)."""
+    h = hashlib.sha256(service.encode()).digest()
+    return f"240.{h[0]}.{h[1]}.{h[2]}"

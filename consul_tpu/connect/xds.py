@@ -50,6 +50,8 @@ def dynamic_bootstrap(snapshot: dict[str, Any], agent_http_addr: str,
     agent's REST xDS endpoints instead of materialized statically
     (command/connect/envoy bootstrap pointing at the agent's xDS)."""
     host, _, port = agent_http_addr.rpartition(":")
+    if not port.isdigit():
+        host, port = agent_http_addr, "8500"  # port-less address
     source = {"api_config_source": {
         "api_type": "REST", "transport_api_version": "V3",
         "cluster_names": ["consul_xds"],

@@ -34,6 +34,14 @@ from consul_tpu.types import MemberStatus
 from consul_tpu.utils import log, telemetry
 
 
+# memberlist protocol versioning (memberlist ProtocolVersionMin/Max):
+# nodes advertise [min, cur, max] in their alive rumors; non-overlapping
+# ranges are refused at _handle_alive
+PROTOCOL_MIN = 1
+PROTOCOL_CUR = 2
+PROTOCOL_MAX = 2
+
+
 @dataclass
 class NodeState:
     name: str
@@ -41,12 +49,14 @@ class NodeState:
     incarnation: int = 0
     status: MemberStatus = MemberStatus.ALIVE
     tags: dict[str, str] = field(default_factory=dict)
+    vsn: Optional[list] = None  # [min, cur, max] protocol range
     state_change: float = 0.0
 
     def snapshot(self) -> dict[str, Any]:
         return {"name": self.name, "addr": self.addr,
                 "inc": self.incarnation, "status": int(self.status),
-                "tags": dict(self.tags)}
+                "tags": dict(self.tags),
+                **({"vsn": list(self.vsn)} if self.vsn else {})}
 
 
 class MemberlistDelegate:
@@ -166,6 +176,7 @@ class Memberlist:
 
         me = NodeState(name=name, addr=transport.addr,
                        tags=dict(tags or {}), incarnation=0,
+                       vsn=[PROTOCOL_MIN, PROTOCOL_CUR, PROTOCOL_MAX],
                        state_change=self._now())
         self._members[name] = me
         self._suspicions: dict[str, _Suspicion] = {}
@@ -488,6 +499,21 @@ class Memberlist:
         inc = body["inc"]
         addr = body.get("addr", "")
         tags = body.get("tags") or {}
+        # protocol-version negotiation (memberlist aliveNode vsn
+        # checks): a joiner advertises [min, cur, max]; members whose
+        # ranges don't overlap ours are refused membership — a node
+        # speaking an incompatible protocol must not be gossiped as
+        # alive
+        vsn = body.get("vsn")
+        if vsn and len(vsn) >= 3:
+            vsn = list(vsn)
+            their_min, _, their_max = vsn[0], vsn[1], vsn[2]
+            if their_min > PROTOCOL_MAX or their_max < PROTOCOL_MIN:
+                self.log.warning(
+                    "refusing node %s: protocol versions [%d, %d] "
+                    "incompatible with ours [%d, %d]", name,
+                    their_min, their_max, PROTOCOL_MIN, PROTOCOL_MAX)
+                return
         with self._lock:
             if name == self.name:
                 # someone is telling the cluster things about us
@@ -501,7 +527,8 @@ class Memberlist:
             ns = self._members.get(name)
             if ns is None:
                 ns = NodeState(name=name, addr=addr, incarnation=inc,
-                               tags=dict(tags), state_change=self._now())
+                               tags=dict(tags), vsn=vsn,
+                               state_change=self._now())
                 self._members[name] = ns
                 self._broadcast("alive", name, m.encode(m.ALIVE, body))
                 self.metrics.incr("memberlist.node.join")
@@ -523,6 +550,8 @@ class Memberlist:
                 ns.addr = addr
             if tags:
                 ns.tags = dict(tags)
+            if vsn:
+                ns.vsn = vsn
             self._cancel_suspicion(name)
             self._broadcast("alive", name, m.encode(m.ALIVE, body))
             if was in (MemberStatus.DEAD, MemberStatus.LEFT):
@@ -639,7 +668,8 @@ class Memberlist:
     def _broadcast_alive(self, ns: NodeState) -> None:
         self._broadcast("alive", ns.name, m.encode(m.ALIVE, {
             "node": ns.name, "inc": ns.incarnation, "addr": ns.addr,
-            "tags": ns.tags}))
+            "tags": ns.tags,
+            "vsn": [PROTOCOL_MIN, PROTOCOL_CUR, PROTOCOL_MAX]}))
 
     def _broadcast(self, kind: str, subject: str, payload: bytes) -> None:
         self._queue.queue(f"{kind}:{subject}", payload)
@@ -762,6 +792,8 @@ class Memberlist:
             status = MemberStatus(d["status"])
             body = {"node": d["name"], "inc": d["inc"], "addr": d["addr"],
                     "tags": d.get("tags") or {}}
+            if d.get("vsn"):
+                body["vsn"] = d["vsn"]
             if status in (MemberStatus.ALIVE, MemberStatus.SUSPECT):
                 self._handle_alive(body)
                 if status == MemberStatus.SUSPECT:

@@ -28,6 +28,7 @@ from consul_tpu.utils import log, telemetry
 
 RPC_CONSUL = 0x00
 RPC_RAFT = 0x01
+RPC_TLS = 0x02  # pool.RPCTLS: TLS handshake, then the REAL tag inside
 
 MAX_FRAME = 64 * 1024 * 1024
 
@@ -85,6 +86,17 @@ class RPCServer:
                     if tag is None:
                         return
                     src = f"{self.client_address[0]}:{self.client_address[1]}"
+                    if tag[0] == RPC_TLS:
+                        if outer.tls_context is None:
+                            outer.log.warning(
+                                "TLS RPC from %s but TLS is not "
+                                "configured", src)
+                            return
+                        sock = outer.tls_context.wrap_socket(
+                            sock, server_side=True)
+                        tag = _read_exact(sock, 1)
+                        if tag is None:
+                            return
                     if tag[0] == RPC_CONSUL:
                         outer._serve_consul(sock, src)
                     elif tag[0] == RPC_RAFT:
@@ -99,6 +111,7 @@ class RPCServer:
             allow_reuse_address = True
             daemon_threads = True
 
+        self.tls_context = None  # server ctx; set via set_tls()
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(
@@ -151,10 +164,17 @@ class RPCServer:
 
 
 class _Conn:
-    def __init__(self, addr: str, tag: int, timeout: float) -> None:
+    def __init__(self, addr: str, tag: int, timeout: float,
+                 tls_context=None) -> None:
         host, port = addr.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)),
                                              timeout=timeout)
+        if tls_context is not None:
+            # pool.go DialTimeout with TLS: send the TLS tag in the
+            # clear, handshake, then the real protocol tag rides inside
+            self.sock.sendall(bytes([RPC_TLS]))
+            self.sock = tls_context.wrap_socket(self.sock,
+                                                server_hostname=host)
         self.sock.sendall(bytes([tag]))
         self.addr = addr
         self.seq = 0
@@ -174,9 +194,11 @@ class ConnPool:
     """
 
     def __init__(self, max_per_addr: int = 8,
-                 connect_timeout: float = 5.0) -> None:
+                 connect_timeout: float = 5.0,
+                 tls_context=None) -> None:
         self.max_per_addr = max_per_addr
         self.connect_timeout = connect_timeout
+        self.tls_context = tls_context  # client ctx for RPC_TLS dials
         self._idle: dict[str, list[_Conn]] = {}
         self._lock = threading.Lock()
         self.log = log.named("rpc.pool")
@@ -193,7 +215,8 @@ class ConnPool:
         except ConnectionError:
             if not pooled:
                 raise
-            conn = _Conn(addr, RPC_CONSUL, self.connect_timeout)
+            conn = _Conn(addr, RPC_CONSUL, self.connect_timeout,
+                         self.tls_context)
             return self._call_on(conn, addr, method, args, timeout)
 
     def _call_on(self, conn: "_Conn", addr: str, method: str,
@@ -218,7 +241,8 @@ class ConnPool:
     def raft_call(self, addr: str, method: str,
                   args: dict[str, Any], timeout: float = 5.0) -> dict:
         """One-shot raft RPC (separate conns, tag RPC_RAFT)."""
-        conn = _Conn(addr, RPC_RAFT, self.connect_timeout)
+        conn = _Conn(addr, RPC_RAFT, self.connect_timeout,
+                     self.tls_context)
         try:
             conn.sock.settimeout(timeout)
             write_frame(conn.sock, {"method": method, "args": args})
@@ -237,7 +261,8 @@ class ConnPool:
             idle = self._idle.get(addr)
             if idle:
                 return idle.pop(), True
-        return _Conn(addr, RPC_CONSUL, self.connect_timeout), False
+        return _Conn(addr, RPC_CONSUL, self.connect_timeout,
+                     self.tls_context), False
 
     def _put(self, addr: str, conn: _Conn) -> None:
         with self._lock:

@@ -41,7 +41,7 @@ class NoLeaderError(RPCError):
 class Server:
     def __init__(self, config: RuntimeConfig,
                  serf_transport: Optional[Transport] = None,
-                 rpc_bind: Optional[str] = None) -> None:
+                 rpc_bind: Optional[str] = None, tls=None) -> None:
         self.config = config
         self.name = config.node_name or f"server-{uuid.uuid4().hex[:8]}"
         self.node_id = config.node_id or str(uuid.uuid4())
@@ -58,6 +58,27 @@ class Server:
         self.rpc = RPCServer(rpc_bind or config.bind_addr,
                              config.port("server"))
         self.pool = ConnPool()
+        # RPC-port TLS (tlsutil + pool.RPCTLS tag): servers accept
+        # TLS-wrapped RPC when certs are configured; verify_outgoing
+        # makes OUR dials to other servers use it. The configurator is
+        # the agent's CENTRAL one when embedded (hot reload reaches this
+        # port); standalone servers build their own.
+        if tls is None and config.tls_cert_file and config.tls_key_file:
+            from consul_tpu.utils.tlsutil import TLSConfigurator
+
+            tls = TLSConfigurator(
+                ca_file=config.tls_ca_file,
+                cert_file=config.tls_cert_file,
+                key_file=config.tls_key_file,
+                verify_incoming=config.tls_verify_incoming,
+                verify_outgoing=config.tls_verify_outgoing)
+        if tls is not None:
+            self.rpc.tls_context = tls.server_context()
+            if config.tls_verify_outgoing:
+                ctx = tls.client_context()
+                # internal addresses are IPs, not cert DNS names
+                ctx.check_hostname = False
+                self.pool.tls_context = ctx
         self.raft_transport = PooledRaftTransport(self.rpc.addr, self.pool)
 
         data_dir = None

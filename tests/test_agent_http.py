@@ -536,3 +536,43 @@ def test_template_exact_name_renders_and_get_returns_raw(agent, client):
         client.put("/v1/query", body={
             "Name": "bad2-", "Template": {"Type": "weird"},
             "Service": {"Service": "s"}})
+
+
+def test_virtual_ip_dns(agent, client):
+    """<service>.virtual.<domain> answers the service's stable virtual
+    IP in 240/4 (dns.go tproxy lookups); unknown services NXDOMAIN."""
+    import socket as _socket
+    import struct as _struct
+
+    from consul_tpu.connect.virtualip import virtual_ip
+
+    def dns_query(name, qtype=1):
+        q = _struct.pack(">HHHHHH", 0x4321, 0x0100, 1, 0, 0, 0)
+        for label in name.rstrip(".").split("."):
+            q += bytes([len(label)]) + label.encode()
+        q += b"\x00" + _struct.pack(">HH", qtype, 1)
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.settimeout(3.0)
+        s.sendto(q, ("127.0.0.1", agent.dns.port))
+        resp, _ = s.recvfrom(4096)
+        s.close()
+        return resp
+
+    vip = virtual_ip("db")
+    assert vip.startswith("240.")
+    resp = dns_query("db.virtual.consul.")
+    assert resp[3] & 0x0F == 0  # NOERROR
+    an_count = _struct.unpack_from(">H", resp, 6)[0]
+    assert an_count == 1
+    assert _socket.inet_aton(vip) in resp  # the A rdata
+    # stability: same name → same IP on every call
+    assert virtual_ip("db") == vip
+    # unknown service → NXDOMAIN (no answers, rcode 3)
+    resp2 = dns_query("ghost-svc.virtual.consul.")
+    assert _struct.unpack_from(">H", resp2, 6)[0] == 0
+    assert resp2[3] & 0x0F == 3
+    # AAAA on a KNOWN virtual name → NOERROR/NODATA (never NXDOMAIN:
+    # dual-stack resolvers would negative-cache the whole name)
+    resp3 = dns_query("db.virtual.consul.", qtype=28)
+    assert _struct.unpack_from(">H", resp3, 6)[0] == 0
+    assert resp3[3] & 0x0F == 0

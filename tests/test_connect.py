@@ -513,3 +513,27 @@ def test_leaf_renewal_cache(agent, client):
     assert l4["SerialNumber"] != l3["SerialNumber"]
     # the new leaf presents the rotation bridge in its chain
     assert l4.get("CertChainPEM", "").count("BEGIN CERTIFICATE") == 2
+
+
+def test_cross_sign_chain_passes_real_path_validation():
+    """The rotation bridge must survive REAL chain validation (pathlen
+    constraints included) — signature-only checks miss a root whose
+    path_length forbids subordinates."""
+    from cryptography import x509
+    from cryptography.x509.verification import (PolicyBuilder, Store)
+
+    from consul_tpu.connect.ca import (cross_sign, generate_root,
+                                       sign_leaf)
+
+    old = generate_root("td.consul", "dc1")
+    new = generate_root("td.consul", "dc1")
+    bridge = cross_sign(old, new)
+    leaf = sign_leaf(new, "web", "dc1")
+    store = Store([x509.load_pem_x509_certificate(
+        old["RootCert"].encode())])
+    verifier = PolicyBuilder().store(store).build_client_verifier()
+    chain = verifier.verify(
+        x509.load_pem_x509_certificate(leaf["CertPEM"].encode()),
+        [x509.load_pem_x509_certificate(bridge.encode())])
+    # verified through old root -> bridge -> leaf
+    assert chain.subjects is not None

@@ -239,3 +239,34 @@ def test_restart_after_leave_rejoins_despite_tombstone():
     for s in serfs[:2]:
         st = {ns.name: ns.status for ns in s.members(include_left=True)}
         assert st["node2"] == MemberStatus.ALIVE, st
+
+
+def test_protocol_version_negotiation():
+    """Incompatible protocol ranges are refused at alive handling
+    (memberlist aliveNode vsn checks); compatible ones join."""
+    from consul_tpu.gossip.swim import (Memberlist, PROTOCOL_MAX,
+                                        PROTOCOL_MIN)
+    from consul_tpu.gossip.transport import InMemNetwork
+
+    net = InMemNetwork()
+    ml = Memberlist("a", net.attach("127.0.0.1:9001"))
+    # compatible: overlapping range
+    ml._handle_alive({"node": "b", "inc": 1, "addr": "b",
+                      "vsn": [PROTOCOL_MIN, PROTOCOL_MAX,
+                              PROTOCOL_MAX]})
+    assert "b" in ml._members
+    # incompatible: entirely above our max
+    ml._handle_alive({"node": "c", "inc": 1, "addr": "c",
+                      "vsn": [PROTOCOL_MAX + 1, PROTOCOL_MAX + 1,
+                              PROTOCOL_MAX + 2]})
+    assert "c" not in ml._members
+    # incompatible: entirely below our min
+    ml._handle_alive({"node": "d", "inc": 1, "addr": "d",
+                      "vsn": [0, 0, PROTOCOL_MIN - 1]})
+    assert "d" not in ml._members
+    # legacy peers without vsn still join (pre-negotiation nodes)
+    ml._handle_alive({"node": "e", "inc": 1, "addr": "e"})
+    assert "e" in ml._members
+    # our own alive rumors advertise the range
+    me = ml._members["a"]
+    ml._broadcast_alive(me)

@@ -99,3 +99,33 @@ def test_auto_encrypt_bootstraps_client_tls():
             cli.shutdown()
     finally:
         srv.shutdown()
+
+
+def test_rpc_port_tls_tag(tmp_path):
+    """Server RPC over the RPC_TLS tag (pool.RPCTLS): a TLS-dialing
+    pool talks to a TLS-enabled server; plaintext dials still work
+    (tag 0x02 is opt-in per connection, like the reference)."""
+    from consul_tpu.agent import Agent as _Agent
+    from consul_tpu.server.rpc import ConnPool
+
+    paths = write_test_certs(str(tmp_path))
+    a = _Agent(load(dev=True, overrides={
+        "node_name": "rpc-tls",
+        "tls": {**paths, "verify_outgoing": True}}))
+    a.start(serve_http=False, serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="leader")
+        addr = a.server.rpc.addr
+        # TLS-wrapped dial
+        cfg = TLSConfigurator(**paths, verify_outgoing=True)
+        ctx = cfg.client_context()
+        ctx.check_hostname = False
+        pool = ConnPool(tls_context=ctx)
+        assert pool.call(addr, "Status.Ping", {}) == "pong"
+        # plaintext dial still served (opt-in tag)
+        plain = ConnPool()
+        assert plain.call(addr, "Status.Ping", {}) == "pong"
+        # the server's own pool dials itself over TLS
+        assert a.server.pool.tls_context is not None
+    finally:
+        a.shutdown()
