@@ -162,9 +162,8 @@ class DNSServer:
         except Exception:  # noqa: BLE001 — ignore malformed additionals
             pass
 
-        res = self.resolve(qname, qtype)
-        answers, authoritative = res[0], res[1]
-        forced_rcode = res[2] if len(res) > 2 else None
+        answers, authoritative, forced_rcode = self.resolve(
+            qname, qtype)
         if answers is None:
             # outside our domain → recurse if configured
             fwd = self._recurse(data)
@@ -207,8 +206,16 @@ class DNSServer:
     # ------------------------------------------------------------- resolve
 
     def resolve(self, qname: str, qtype: int
-                ) -> tuple[Optional[list[bytes]], bool]:
-        """Returns (answer RRs | None if not our domain, authoritative)."""
+                ) -> tuple[Optional[list[bytes]], bool, Optional[int]]:
+        """Returns (answers | None if not our domain, authoritative,
+        forced_rcode | None). Normalizes the branch returns so callers
+        can always 3-unpack."""
+        res = self._resolve(qname, qtype)
+        return res if len(res) == 3 else (res[0], res[1], None)
+
+    def _resolve(self, qname: str, qtype: int):
+        """Branch bodies below return 2-tuples, or 3-tuples when they
+        must force an rcode (virtual-name NODATA)."""
         name = qname.rstrip(".")
         # reverse lookups: <d.c.b.a>.in-addr.arpa → node name PTR;
         # unknown addresses fall through to the recursors (dns.go PTR)

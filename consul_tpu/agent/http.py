@@ -643,6 +643,24 @@ class HTTPApi:
         # -------------------------------------------------------- operator
         if path == "/v1/operator/autopilot/health":
             return rpc("Operator.AutopilotHealth", {}), None
+        if path == "/v1/operator/autopilot/configuration":
+            if method == "PUT":
+                rpc("Operator.AutopilotSetConfiguration",
+                    {"Config": jbody()})
+                return True, None
+            return rpc("Operator.AutopilotGetConfiguration", {}), None
+        if path == "/v1/operator/autopilot/state":
+            return rpc("Operator.AutopilotState", {}), None
+        if path == "/v1/internal/federation-states":
+            res = rpc("Internal.FederationStates", blocking_args())
+            return res["States"], res.get("Index")
+        if (m := re.match(r"^/v1/internal/federation-state/(.+)$",
+                          path)):
+            res = rpc("Internal.FederationState", blocking_args(
+                {"TargetDatacenter": urllib.parse.unquote(m.group(1))}))
+            if res.get("State") is None:
+                raise HTTPError(404, "no federation state for dc")
+            return res["State"], res.get("Index")
         if path == "/v1/agent/monitor":
             # bounded capture of live log output (the reference streams;
             # we return a window — ?duration= seconds, default 2, cap 10)

@@ -239,12 +239,28 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
             if eps:
                 local.append({"Name": svc, "Endpoints": eps})
         remote = []
+        # federation states first (replicated, no cross-DC round trip:
+        # leader_federation_state_ae.go keeps them current)
+        fed: dict[str, list] = {}
+        try:
+            res = rpc("Internal.FederationStates", {"AllowStale": True})
+            for fs in res.get("States") or []:
+                fed[fs.get("Datacenter", "")] = [
+                    {"Address": g.get("Address", ""),
+                     "Port": g.get("Port", 0)}
+                    for g in fs.get("MeshGateways") or []]
+        except Exception:  # noqa: BLE001
+            pass
         try:
             dcs = rpc("Catalog.ListDatacenters", {}) or []
         except Exception:  # noqa: BLE001
             dcs = []
-        for dc in dcs:
+        for dc in sorted(set(dcs) | set(fed)):
             if dc == local_dc:
+                continue
+            if fed.get(dc):
+                remote.append({"Datacenter": dc,
+                               "Endpoints": fed[dc]})
                 continue
             # remote gateways are found by Kind (mesh_gateway.go uses
             # ServiceDump with ServiceKind) — their service NAME in the

@@ -1172,6 +1172,76 @@ def register_endpoints(srv) -> None:
     e["Internal.AgentRead"] = agent_read_check
     e["Internal.AgentWrite"] = agent_write_check
     e["Internal.ServiceWrite"] = service_write_check
+
+    # --------------------------------------------- federation states
+    def federation_state_apply(args):
+        """Each DC's leader upserts its mesh-gateway list here; in a
+        federation the PRIMARY owns the table and replication mirrors
+        it down (leader_federation_state_ae.go)."""
+        require(authz(args).operator_write(), "operator write")
+        fs = args.get("State") or {}
+        if not fs.get("Datacenter"):
+            raise RPCError("federation state requires Datacenter")
+        return srv.forward_or_apply(MessageType.FEDERATION_STATE,
+                                    {"Op": args.get("Op", "set"),
+                                     "State": clean(fs)})
+
+    read("Internal.FederationStates", lambda args: srv.blocking_query(
+        args, ("federation_states",), lambda: {
+            "States": state.raw_list("federation_states")}))
+    # NOTE: the lookup key is TargetDatacenter — "Datacenter" would
+    # trigger cross-DC FORWARDING of the RPC itself
+    read("Internal.FederationState", lambda args: srv.blocking_query(
+        args, ("federation_states",), lambda: {
+            "State": state.raw_get("federation_states",
+                                   args.get("TargetDatacenter", ""))}))
+    primary_owned("Internal.FederationStateApply",
+                  federation_state_apply)
+
+    # ------------------------------------------------- autopilot config
+    AUTOPILOT_DEFAULTS = {
+        "CleanupDeadServers": True,
+        "LastContactThreshold": "200ms",
+        "MaxTrailingLogs": 250,
+        "MinQuorum": 0,
+        "ServerStabilizationTime": "10s",
+    }
+
+    def autopilot_get_config(args):
+        require(authz(args).operator_read(), "operator read")
+        stored = state.raw_get("config_entries", "autopilot/config") \
+            or {}
+        return {**AUTOPILOT_DEFAULTS,
+                **{k: v for k, v in stored.items()
+                   if k in AUTOPILOT_DEFAULTS}}
+
+    def autopilot_set_config(args):
+        require(authz(args).operator_write(), "operator write")
+        cfg = {k: v for k, v in (args.get("Config") or {}).items()
+               if k in AUTOPILOT_DEFAULTS}
+        srv.forward_or_apply(MessageType.CONFIG_ENTRY, {
+            "Op": "upsert", "Entry": {"Kind": "autopilot",
+                                      "Name": "config", **cfg}})
+        return True
+
+    def autopilot_state(args):
+        """Per-server operational detail (operator/autopilot/state)."""
+        health = autopilot_health(args)
+        stats = srv.raft.stats()
+        return {
+            "Healthy": health["Healthy"],
+            "FailureTolerance": health["FailureTolerance"],
+            "Leader": stats.get("leader", ""),
+            "Voters": sorted(srv.raft.peers),
+            "Servers": {s["Name"]: {
+                **s, "LastTerm": stats.get("term", 0),
+                "LastIndex": stats.get("applied_index", 0)}
+                for s in health["Servers"]},
+        }
+
+    read("Operator.AutopilotGetConfiguration", autopilot_get_config)
+    e["Operator.AutopilotSetConfiguration"] = autopilot_set_config
+    read("Operator.AutopilotState", autopilot_state)
     e["Catalog.ListDatacenters"] = lambda args: srv.datacenters()
 
     def join_wan(args):

@@ -97,6 +97,13 @@ class RPCServer:
                         tag = _read_exact(sock, 1)
                         if tag is None:
                             return
+                    elif outer.require_tls:
+                        # rpc.go: "non-TLS connection attempted with
+                        # VerifyIncoming set"
+                        outer.log.warning(
+                            "refusing plaintext RPC from %s: "
+                            "verify_incoming is set", src)
+                        return
                     if tag[0] == RPC_CONSUL:
                         outer._serve_consul(sock, src)
                     elif tag[0] == RPC_RAFT:
@@ -112,6 +119,7 @@ class RPCServer:
             daemon_threads = True
 
         self.tls_context = None  # server ctx; set via set_tls()
+        self.require_tls = False  # verify_incoming: refuse plaintext
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(

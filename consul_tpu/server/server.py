@@ -74,6 +74,7 @@ class Server:
                 verify_outgoing=config.tls_verify_outgoing)
         if tls is not None:
             self.rpc.tls_context = tls.server_context()
+            self.rpc.require_tls = config.tls_verify_incoming
             if config.tls_verify_outgoing:
                 ctx = tls.client_context()
                 # internal addresses are IPs, not cert DNS names
@@ -440,11 +441,16 @@ class Server:
                 self.raft.add_peer(addr)
             except NotLeader:
                 return
-        # dead-server cleanup: remove raft peers whose serf member failed
+        # dead-server cleanup (autopilot CleanupDeadServers — operator
+        # configurable): remove raft peers whose serf member failed
+        ap = self.state.raw_get("config_entries", "autopilot/config") \
+            or {}
+        cleanup = ap.get("CleanupDeadServers", True)
         failed_addrs = {
             m.tags.get("rpc_addr") for m in self.serf.members(True)
             if m.tags.get("role") == "consul"
-            and m.status in (MemberStatus.DEAD, MemberStatus.LEFT)}
+            and m.status in (MemberStatus.DEAD, MemberStatus.LEFT)} \
+            if cleanup else set()
         for addr in (self.raft.peers & failed_addrs) - {self.rpc.addr}:
             self.log.info("removing failed raft peer %s", addr)
             try:
@@ -454,6 +460,7 @@ class Server:
         self._drain_reconcile()
         self._expire_sessions()
         self._replicate_from_primary()
+        self._update_federation_state()
 
     def _flood_join(self) -> None:
         """Flood joiner (server_serf.go FloodJoins): every LAN server's
@@ -473,6 +480,34 @@ class Server:
                 self.serf_wan.join([wan_addr])
             except Exception:  # noqa: BLE001
                 pass  # unreachable now; retried next tick
+
+    def _update_federation_state(self) -> None:
+        """Federation-state anti-entropy (leader_federation_state_ae.go):
+        this DC's leader keeps its mesh-gateway list current in the
+        federation_states table (written through the primary when
+        federated, mirrored back by replication)."""
+        self._fedstate_tick = getattr(self, "_fedstate_tick", 0) + 1
+        if self._fedstate_tick % 5:
+            return
+        gws = [{"Address": s.address or n.address, "Port": s.port,
+                "Node": n.node}
+               for n, s in self.state.service_nodes_by_kind(
+                   "mesh-gateway")]
+        dc = self.config.datacenter
+        cur = self.state.raw_get("federation_states", dc) or {}
+        if cur.get("MeshGateways") == gws:
+            return
+        try:
+            self.endpoints["Internal.FederationStateApply"]({
+                "Op": "set",
+                "State": {"Datacenter": dc, "MeshGateways": gws},
+                # operator:write needed — management/replication
+                # tokens qualify; a node-scoped agent token does not
+                "AuthToken": self.config.acl_initial_management_token
+                or self.config.acl_replication_token
+                or self.config.acl_agent_token})
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("federation state update failed: %s", e)
 
     def _replicate_from_primary(self) -> None:
         """Leader replication routines (leader.go startACLReplication /
@@ -524,7 +559,14 @@ class Server:
                 pull("ConfigEntry.List")["Entries"], "config_entries",
                 lambda e: f"{e.get('Kind', '')}/{e.get('Name', '')}",
                 MessageType.CONFIG_ENTRY, "Entry", op_set="upsert",
-                keep_local=lambda k, v: v.get("Kind") == "connect-ca")
+                # per-DC state never mirrors: each DC has its own CA
+                # and its own autopilot settings
+                keep_local=lambda k, v: v.get("Kind") in (
+                    "connect-ca", "autopilot"))
+            self._mirror(
+                pull("Internal.FederationStates")["States"],
+                "federation_states", lambda f: f.get("Datacenter"),
+                MessageType.FEDERATION_STATE, "State")
             self._mirror(
                 pull("Intention.List")["Intentions"], "intentions",
                 lambda i: f"{i.get('SourceName', '*')}->"
@@ -545,6 +587,8 @@ class Server:
             if k is not None:
                 local[k] = v
         for k, v in remote.items():
+            if keep_local is not None and keep_local(k, v):
+                continue  # per-DC rows: never overwritten either
             lv = local.get(k)
             if lv is None or _strip_idx(lv) != _strip_idx(v):
                 self.raft.apply(encode_command(

@@ -40,6 +40,7 @@ class MessageType(enum.IntEnum):
     ACL_ROLE = 15
     ACL_AUTH_METHOD = 16
     ACL_BINDING_RULE = 17
+    FEDERATION_STATE = 18
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -68,6 +69,7 @@ class FSM:
             MessageType.ACL_ROLE: self._apply_acl_role,
             MessageType.ACL_AUTH_METHOD: self._apply_acl_auth_method,
             MessageType.ACL_BINDING_RULE: self._apply_acl_binding_rule,
+            MessageType.FEDERATION_STATE: self._apply_federation_state,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -263,6 +265,11 @@ class FSM:
         r = b.get("BindingRule") or {}
         return self._raw_op("acl_binding_rules", ("set",),
                             b.get("Op", "set"), r.get("ID"), r)
+
+    def _apply_federation_state(self, b: dict[str, Any], idx: int) -> Any:
+        fs = b.get("State") or {}
+        return self._raw_op("federation_states", ("set",),
+                            b.get("Op", "set"), fs.get("Datacenter"), fs)
 
     def _apply_peering(self, b: dict[str, Any], idx: int) -> Any:
         p = b.get("Peering") or {}

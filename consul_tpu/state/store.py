@@ -31,7 +31,8 @@ from consul_tpu.types import (CheckStatus, Coordinate, HealthCheck, KVEntry,
 # plain-dict tables serialized/restored generically (key -> msgpack map)
 RAW_TABLES = ("prepared_queries", "acl_tokens", "acl_policies",
               "config_entries", "intentions", "peerings", "acl_roles",
-              "acl_auth_methods", "acl_binding_rules")
+              "acl_auth_methods", "acl_binding_rules",
+              "federation_states")
 TABLES = ("nodes", "services", "checks", "kv", "sessions",
           "coordinates") + RAW_TABLES
 

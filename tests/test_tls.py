@@ -122,10 +122,40 @@ def test_rpc_port_tls_tag(tmp_path):
         ctx.check_hostname = False
         pool = ConnPool(tls_context=ctx)
         assert pool.call(addr, "Status.Ping", {}) == "pong"
-        # plaintext dial still served (opt-in tag)
+        # plaintext dial still served (opt-in tag; verify_incoming off)
         plain = ConnPool()
         assert plain.call(addr, "Status.Ping", {}) == "pong"
         # the server's own pool dials itself over TLS
         assert a.server.pool.tls_context is not None
+    finally:
+        a.shutdown()
+
+
+def test_verify_incoming_refuses_plaintext_rpc(tmp_path):
+    """verify_incoming makes the RPC port TLS-ONLY (rpc.go refuses
+    non-TLS bytes when VerifyIncoming is set)."""
+    from consul_tpu.agent import Agent as _Agent
+    from consul_tpu.server.rpc import ConnPool
+
+    paths = write_test_certs(str(tmp_path))
+    a = _Agent(load(dev=True, overrides={
+        "node_name": "rpc-mtls",
+        "tls": {**paths, "verify_incoming": True,
+                "verify_outgoing": True}}))
+    a.start(serve_http=False, serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="leader")
+        addr = a.server.rpc.addr
+        # plaintext is refused outright
+        plain = ConnPool()
+        with pytest.raises(ConnectionError):
+            plain.call(addr, "Status.Ping", {})
+        # mTLS (client cert) works
+        cfg = TLSConfigurator(**paths, verify_incoming=True,
+                              verify_outgoing=True)
+        ctx = cfg.client_context()
+        ctx.check_hostname = False
+        pool = ConnPool(tls_context=ctx)
+        assert pool.call(addr, "Status.Ping", {}) == "pong"
     finally:
         a.shutdown()

@@ -263,3 +263,39 @@ def test_token_replication_with_acls_enabled():
     finally:
         a1.shutdown()
         a2.shutdown()
+
+
+def test_federation_states_and_autopilot_config(two_dcs):
+    """Federation-state anti-entropy: each DC's leader publishes its
+    mesh gateways into the replicated federation_states table; the
+    mesh-gateway snapshot uses it without a cross-DC round trip.
+    Autopilot configuration is operator-settable."""
+    a1, a2 = two_dcs
+    c1, c2 = ConsulClient(a1.http.addr), ConsulClient(a2.http.addr)
+    c2.service_register({"Name": "fs-gw", "ID": "fs-gw", "Port": 8447,
+                         "Address": "10.2.0.9",
+                         "Kind": "mesh-gateway"})
+    wait_for(lambda: any(
+        g.get("Address") == "10.2.0.9"
+        for fs in c2.get("/v1/internal/federation-states")
+        if fs["Datacenter"] == "dc2"
+        for g in fs.get("MeshGateways") or []),
+        timeout=25.0, what="fs-gw in dc2 federation state")
+    fs = c2.get("/v1/internal/federation-state/dc2")
+    assert any(g["Address"] == "10.2.0.9" and g["Port"] == 8447
+               for g in fs["MeshGateways"])
+    # autopilot configuration round-trips and gates cleanup
+    cfg = c1.get("/v1/operator/autopilot/configuration")
+    assert cfg["CleanupDeadServers"] is True
+    c1.put("/v1/operator/autopilot/configuration",
+           body={"CleanupDeadServers": False, "MaxTrailingLogs": 500})
+    cfg2 = c1.get("/v1/operator/autopilot/configuration")
+    assert cfg2["CleanupDeadServers"] is False
+    assert cfg2["MaxTrailingLogs"] == 500
+    ap_state = c1.get("/v1/operator/autopilot/state")
+    # (a prior test's departed server may linger as unhealthy — assert
+    # the state SHAPE, not cluster-wide health)
+    assert ap_state["Leader"] and "dc1-srv" in ap_state["Servers"]
+    assert ap_state["Servers"]["dc1-srv"]["Healthy"] is True
+    c1.put("/v1/operator/autopilot/configuration",
+           body={"CleanupDeadServers": True})
