@@ -62,11 +62,18 @@ def main() -> None:
             probe = run(init_state(n), key)
             jax.block_until_ready(probe)
             del probe
+            # instrumented diagnostics ALSO run through the kernel
+            # (stats partial-sum lanes) — probe-trace it HERE so a
+            # Mosaic failure of the 10-array variant hits the fallback
+            diag = make_run_rounds_pallas(p_diag, 200)
+            probe = diag(init_state(n), key)
+            jax.block_until_ready(probe)
+            del probe
         except Exception as e:  # noqa: BLE001 — fall back to XLA path
             print(f"pallas unavailable ({e}); using XLA fused path",
                   file=sys.stderr)
             run = make_run_rounds_fast(p, chunk)
-        diag = make_run_rounds(p_diag, 200)
+            diag = make_run_rounds(p_diag, 200)
         state = init_state(n)
 
     # compile + warmup

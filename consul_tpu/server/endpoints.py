@@ -1186,15 +1186,20 @@ def register_endpoints(srv) -> None:
                                     {"Op": args.get("Op", "set"),
                                      "State": clean(fs)})
 
-    read("Internal.FederationStates", lambda args: srv.blocking_query(
-        args, ("federation_states",), lambda: {
-            "States": state.raw_list("federation_states")}))
+    read("Internal.FederationStates", lambda args: (
+        require(authz(args).operator_read(), "operator read")
+        or srv.blocking_query(
+            args, ("federation_states",), lambda: {
+                "States": state.raw_list("federation_states")})))
     # NOTE: the lookup key is TargetDatacenter — "Datacenter" would
     # trigger cross-DC FORWARDING of the RPC itself
-    read("Internal.FederationState", lambda args: srv.blocking_query(
-        args, ("federation_states",), lambda: {
-            "State": state.raw_get("federation_states",
-                                   args.get("TargetDatacenter", ""))}))
+    read("Internal.FederationState", lambda args: (
+        require(authz(args).operator_read(), "operator read")
+        or srv.blocking_query(
+            args, ("federation_states",), lambda: {
+                "State": state.raw_get(
+                    "federation_states",
+                    args.get("TargetDatacenter", ""))})))
     primary_owned("Internal.FederationStateApply",
                   federation_state_apply)
 

@@ -10,9 +10,9 @@ leaves on the table.
 
 Covers the FULL protocol model — churn injection, the slow-node/
 Lifeguard-patience degradation model, suspicion, refutation,
-dissemination — everything except the stats counters (instrumented
-runs use the XLA paths). Statistical conformance with gossip_round is
-asserted in tests/test_pallas_round.py (TPU-gated).
+dissemination, and the cumulative stats counters (extra partial-sum
+lanes). Statistical conformance with gossip_round is asserted in
+tests/test_pallas_round.py (TPU-gated).
 """
 
 from __future__ import annotations
@@ -51,9 +51,11 @@ def _u01(shape) -> jnp.ndarray:
 
 def _model_arrays(p: SimParams) -> bool:
     """Whether the config needs the down_time/slow arrays in the kernel
-    (skipping them saves ~20%% of HBM traffic for stable configs)."""
+    (skipping them saves ~20%% of HBM traffic for stable configs).
+    Stats collection needs down_time for detection latency."""
     return bool(p.fail_per_round or p.leave_per_round
-                or p.rejoin_per_round or p.slow_per_round)
+                or p.rejoin_per_round or p.slow_per_round
+                or p.collect_stats)
 
 
 def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
@@ -109,11 +111,12 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
         slow = jnp.zeros(up.shape, jnp.bool_)
     shape = up.shape
     new_rumor = jnp.zeros(shape, jnp.bool_)
+    crash = leave = rejoin = jnp.zeros(shape, jnp.bool_)
 
     # ------------------------------------------------------------- churn
     if p.fail_per_round or p.leave_per_round or p.rejoin_per_round:
         u_c = _u01(shape)
-        crash = up & (u_c < p.fail_per_round)
+        crash = up & (u_c < p.fail_per_round)  # noqa: F841 (stats)
         leave = up & (u_c >= p.fail_per_round) & (
             u_c < p.fail_per_round + p.leave_per_round)
         rejoin = (~up) & (u_c < p.rejoin_per_round)
@@ -246,8 +249,25 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
             jnp.sum(upf * pf_fast), jnp.sum(upf * pf_slow),
             jnp.sum(w_fail * (lh.astype(jnp.float32) + 1.0)),
             jnp.sum(w_fail)]
-    # TPU blocks must be (8,128)-tiled: place the 8 sums at row 0,
-    # cols 0..7 of a padded tile
+    if p.collect_stats:
+        # cumulative counters (round.py collect_stats blocks), appended
+        # as extra partial-sum lanes: [suspicions, refutes, fp, td,
+        # latency_sum, crashes, rejoins, leaves]
+        fp = declare & up
+        td = declare & ~up
+        sums += [
+            jnp.sum(starts.astype(jnp.float32)),
+            jnp.sum(refute.astype(jnp.float32)),
+            jnp.sum(fp.astype(jnp.float32)),
+            jnp.sum(td.astype(jnp.float32)),
+            jnp.sum(jnp.where(td, t_end - down_time, 0.0)),
+            jnp.sum(crash.astype(jnp.float32)),
+            jnp.sum(rejoin.astype(jnp.float32)),
+            jnp.sum(leave.astype(jnp.float32)),
+        ]
+    # TPU blocks must be (8,128)-tiled: place the sums at row 0,
+    # cols 0..7 (population scalars) and, with collect_stats, cols
+    # 8..15 (cumulative counters) of a padded tile
     row = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
     col = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
     padded = jnp.zeros((8, 128), jnp.float32)
@@ -260,11 +280,9 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                            interpret: bool = False):
     """Compiled hot loop using the fused Pallas round kernel.
 
-    Covers the full protocol model including churn and slow-node
-    injection; only collect_stats configs fall back to the XLA paths.
+    Covers the full protocol model including churn, slow-node
+    injection, and stats collection.
     Requires n divisible by the block size."""
-    assert not p.collect_stats, \
-        "pallas path has no stats plumbing; use collect_stats=False"
     n = p.n
     n_arrays = 10 if _model_arrays(p) else 8
     rows_per_block = ROWS_FULL if n_arrays == 10 else ROWS_STABLE
@@ -297,8 +315,10 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             interpret=interpret,
         )(scalars, seed, t, *args)
         *state_out, partials = outs
-        sums = partials.reshape(grid, 8, 128)[:, 0, :N_SCALARS].sum(axis=0)
-        return tuple(state_out), sums
+        row0 = partials.reshape(grid, 8, 128)[:, 0, :].sum(axis=0)
+        sums = row0[:N_SCALARS]
+        stat_sums = row0[N_SCALARS:N_SCALARS + 8]
+        return tuple(state_out), sums, stat_sums
 
     @jax.jit
     def _run(state: SimState, key: jax.Array) -> SimState:
@@ -320,16 +340,18 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                            to2d(state.slow.astype(jnp.int8)))
 
         def body(carry, x):
-            args, scalars, t = carry
+            args, scalars, t, acc = carry
             seed = x
-            args2, partials = one_round(
+            args2, partials, stat_sums = one_round(
                 args, scalars, seed[None], t[None])
             partials = partials.at[1].max(1.0).at[2].max(1e-9) \
                 .at[7].max(1e-9)
-            return (args2, partials, t + p.probe_interval), None
+            return (args2, partials, t + p.probe_interval,
+                    acc + stat_sums), None
 
-        (args, scalars, t_final), _ = jax.lax.scan(
-            body, (args, scalars, state.t), seeds)
+        acc0 = jnp.zeros((8,), jnp.float32)
+        (args, scalars, t_final, acc), _ = jax.lax.scan(
+            body, (args, scalars, state.t, acc0), seeds)
         (up, status, inc, informed, s_start, s_dead, s_conf,
          lh) = args[:8]
         if n_arrays == 10:
@@ -338,6 +360,19 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                                     slow.reshape(-1) != 0)
         else:
             down_flat, slow_flat = state.down_time, state.slow
+        st = state.stats
+        if p.collect_stats:
+            st = st._replace(
+                suspicions=st.suspicions + acc[0].astype(jnp.int32),
+                refutes=st.refutes + acc[1].astype(jnp.int32),
+                false_positives=st.false_positives
+                + acc[2].astype(jnp.int32),
+                true_deaths_declared=st.true_deaths_declared
+                + acc[3].astype(jnp.int32),
+                detect_latency_sum=st.detect_latency_sum + acc[4],
+                crashes=st.crashes + acc[5].astype(jnp.int32),
+                rejoins=st.rejoins + acc[6].astype(jnp.int32),
+                leaves=st.leaves + acc[7].astype(jnp.int32))
         return SimState(
             up=up.reshape(-1) != 0, down_time=down_flat,
             status=status.reshape(-1), incarnation=inc.reshape(-1),
@@ -347,7 +382,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             susp_conf=s_conf.reshape(-1),
             local_health=lh.reshape(-1),
             slow=slow_flat, t=t_final,
-            round_idx=state.round_idx + rounds, stats=state.stats)
+            round_idx=state.round_idx + rounds, stats=st)
 
     if n_arrays == 10:
         return _run

@@ -82,3 +82,27 @@ def test_stable_kernel_refuses_stale_slow_state():
     with pytest.raises(ValueError, match="slow nodes"):
         make_run_rounds_pallas(p, 1)(
             s._replace(slow=s.slow.at[3].set(True)), jax.random.key(0))
+
+
+@tpu_only
+def test_pallas_stats_conformance():
+    """Instrumented runs through the kernel: cumulative counters track
+    the XLA reference within statistical tolerance."""
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.20, tcp_fallback=False,
+                  fail_per_round=0.001, rejoin_per_round=0.01,
+                  collect_stats=True)
+    pal = make_run_rounds_pallas(p, 150)(init_state(n), jax.random.key(0))
+    ref, _ = run_rounds(init_state(n), jax.random.key(1), p, 150)
+    ps, rs = pal.stats, ref.stats
+    for field in ("suspicions", "refutes", "crashes", "rejoins",
+                  "true_deaths_declared"):
+        pv, rv = int(getattr(ps, field)), int(getattr(rs, field))
+        assert rv > 0, field
+        assert 0.8 < pv / rv < 1.25, (field, pv, rv)
+    # mean detection latency in the same ballpark
+    pl = float(ps.detect_latency_sum) / max(int(ps.true_deaths_declared), 1)
+    rl = float(rs.detect_latency_sum) / max(int(rs.true_deaths_declared), 1)
+    assert 0.7 < pl / rl < 1.4, (pl, rl)
