@@ -62,17 +62,23 @@ def main() -> None:
             probe = run(init_state(n), key)
             jax.block_until_ready(probe)
             del probe
-            # instrumented diagnostics ALSO run through the kernel
-            # (stats partial-sum lanes) — probe-trace it HERE so a
-            # Mosaic failure of the 10-array variant hits the fallback
-            diag = make_run_rounds_pallas(p_diag, 200)
-            probe = diag(init_state(n), key)
-            jax.block_until_ready(probe)
-            del probe
         except Exception as e:  # noqa: BLE001 — fall back to XLA path
             print(f"pallas unavailable ({e}); using XLA fused path",
                   file=sys.stderr)
             run = make_run_rounds_fast(p, chunk)
+        try:
+            # instrumented diagnostics ALSO run through the kernel
+            # (stats partial-sum lanes) — probed separately so a
+            # 10-array Mosaic failure can't downgrade the TIMED path
+            from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+            diag = make_run_rounds_pallas(p_diag, 200)
+            probe = diag(init_state(n), key)
+            jax.block_until_ready(probe)
+            del probe
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas diag unavailable ({e}); XLA diagnostics",
+                  file=sys.stderr)
             diag = make_run_rounds(p_diag, 200)
         state = init_state(n)
 

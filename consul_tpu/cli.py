@@ -23,7 +23,9 @@ from consul_tpu.version import __version__
 def _client(args) -> ConsulClient:
     addr = getattr(args, "http_addr", None) \
         or os.environ.get("CONSUL_HTTP_ADDR", "127.0.0.1:8500")
-    return ConsulClient(addr.removeprefix("http://"))
+    token = getattr(args, "token", None) \
+        or os.environ.get("CONSUL_HTTP_TOKEN", "")
+    return ConsulClient(addr.removeprefix("http://"), token=token)
 
 
 def cmd_version(args) -> int:
@@ -340,6 +342,28 @@ def cmd_validate(args) -> int:
 
 def cmd_operator(args) -> int:
     c = _client(args)
+    if args.operator_cmd == "autopilot":
+        if args.autopilot_cmd == "get-config":
+            cfg = c.get("/v1/operator/autopilot/configuration")
+            for k, v in cfg.items():
+                print(f"{k} = {json.dumps(v)}")
+            return 0
+        if args.autopilot_cmd == "set-config":
+            # get-modify-put: the server stores the entry wholesale, so
+            # a partial body would reset unspecified fields
+            body = c.get("/v1/operator/autopilot/configuration")
+            if args.cleanup_dead_servers is not None:
+                body["CleanupDeadServers"] = \
+                    args.cleanup_dead_servers == "true"
+            if args.max_trailing_logs is not None:
+                body["MaxTrailingLogs"] = args.max_trailing_logs
+            c.put("/v1/operator/autopilot/configuration", body=body)
+            print("Configuration updated!")
+            return 0
+        if args.autopilot_cmd == "state":
+            print(json.dumps(
+                c.get("/v1/operator/autopilot/state"), indent=2))
+            return 0
     if args.operator_cmd == "raft" and args.raft_cmd == "list-peers":
         cfg = c.raft_configuration()
         rows = [("Address", "Leader", "Voter")]
@@ -443,7 +467,93 @@ def cmd_acl(args) -> int:
             c.delete(f"/v1/acl/policy/{args.id}")
             print(f"Policy {args.id} deleted")
             return 0
+    if args.acl_cmd == "role":
+        if args.acl_sub == "create":
+            body = {"Name": args.name}
+            if args.policy_name:
+                body["Policies"] = [{"Name": n} for n in args.policy_name]
+            print(json.dumps(c.put("/v1/acl/role", body=body), indent=2))
+            return 0
+        if args.acl_sub == "list":
+            for r in c.get("/v1/acl/roles"):
+                print(f"{r.get('ID')}  {r.get('Name','')}")
+            return 0
+        if args.acl_sub == "delete":
+            c.delete(f"/v1/acl/role/{args.id}")
+            print(f"Role {args.id} deleted")
+            return 0
+    if args.acl_cmd == "auth-method":
+        if args.acl_sub == "create":
+            cfg = {}
+            if args.config:
+                raw = args.config
+                if raw.startswith("@"):
+                    with open(raw[1:]) as f:
+                        raw = f.read()
+                cfg = json.loads(raw)
+            m = c.put("/v1/acl/auth-method", body={
+                "Name": args.name, "Type": args.type, "Config": cfg})
+            print(json.dumps(m, indent=2))
+            return 0
+        if args.acl_sub == "list":
+            for m in c.get("/v1/acl/auth-methods"):
+                print(f"{m.get('Name')}  {m.get('Type','')}")
+            return 0
+        if args.acl_sub == "read":
+            print(json.dumps(
+                c.get(f"/v1/acl/auth-method/{args.name}"), indent=2))
+            return 0
+        if args.acl_sub == "delete":
+            c.delete(f"/v1/acl/auth-method/{args.name}")
+            print(f"Auth method {args.name} deleted")
+            return 0
+    if args.acl_cmd == "binding-rule":
+        if args.acl_sub == "create":
+            rule = c.put("/v1/acl/binding-rule", body={
+                "AuthMethod": args.method,
+                "BindType": args.bind_type,
+                "BindName": args.bind_name,
+                "Selector": args.selector})
+            print(json.dumps(rule, indent=2))
+            return 0
+        if args.acl_sub == "list":
+            for r in c.get("/v1/acl/binding-rules"):
+                print(f"{r.get('ID')}  {r.get('AuthMethod')}  "
+                      f"{r.get('BindType','service')}:"
+                      f"{r.get('BindName','')}")
+            return 0
+        if args.acl_sub == "delete":
+            c.delete(f"/v1/acl/binding-rule/{args.id}")
+            print(f"Binding rule {args.id} deleted")
+            return 0
     return 1
+
+
+def cmd_login(args) -> int:
+    """`consul login -method m -bearer-token-file f -token-sink-file s`
+    (command/login)."""
+    c = _client(args)
+    with open(args.bearer_token_file) as f:
+        bearer = f.read().strip()
+    tok = c.post("/v1/acl/login", body={
+        "AuthMethod": args.method, "BearerToken": bearer})
+    if args.token_sink_file:
+        # the sink is refreshed on every login (command/login writes
+        # over it); keep it private
+        fd = os.open(args.token_sink_file,
+                     os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(tok["SecretID"])
+    else:
+        print(tok["SecretID"])
+    return 0
+
+
+def cmd_logout(args) -> int:
+    c = _client(args)
+    c.post("/v1/acl/logout")
+    print("Logged out")
+    return 0
 
 
 def _write_pem(path: str, data: str, private: bool = False) -> None:
@@ -682,6 +792,7 @@ def _table(rows: list[tuple]) -> None:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="consul-tpu")
     p.add_argument("-http-addr", dest="http_addr", default=None)
+    p.add_argument("-token", dest="token", default=None)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     def finish(parser=None):
@@ -692,11 +803,13 @@ def build_parser() -> argparse.ArgumentParser:
         for act in (parser or p)._actions:
             if isinstance(act, argparse._SubParsersAction):
                 for sp in act.choices.values():
-                    try:
-                        sp.add_argument("-http-addr", dest="http_addr",
-                                        default=None)
-                    except argparse.ArgumentError:
-                        pass
+                    for flag, dest in (("-http-addr", "http_addr"),
+                                       ("-token", "token")):
+                        try:
+                            sp.add_argument(flag, dest=dest,
+                                            default=None)
+                        except argparse.ArgumentError:
+                            pass
                     finish(sp)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
@@ -818,7 +931,48 @@ def build_parser() -> argparse.ArgumentParser:
     polsub.add_parser("list")
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
+    rolep = aclsub.add_parser("role")
+    rolesub = rolep.add_subparsers(dest="acl_sub", required=True)
+    rc = rolesub.add_parser("create")
+    rc.add_argument("-name", required=True)
+    rc.add_argument("-policy-name", dest="policy_name", action="append",
+                    default=[])
+    rolesub.add_parser("list")
+    rd = rolesub.add_parser("delete")
+    rd.add_argument("-id", required=True)
+    amp = aclsub.add_parser("auth-method")
+    amsub = amp.add_subparsers(dest="acl_sub", required=True)
+    amc = amsub.add_parser("create")
+    amc.add_argument("-name", required=True)
+    amc.add_argument("-type", default="jwt")
+    amc.add_argument("-config", default="",
+                     help="method Config JSON (or @file)")
+    amsub.add_parser("list")
+    amr = amsub.add_parser("read")
+    amr.add_argument("-name", required=True)
+    amd = amsub.add_parser("delete")
+    amd.add_argument("-name", required=True)
+    brp = aclsub.add_parser("binding-rule")
+    brsub = brp.add_subparsers(dest="acl_sub", required=True)
+    brc = brsub.add_parser("create")
+    brc.add_argument("-method", required=True)
+    brc.add_argument("-bind-type", dest="bind_type", default="service")
+    brc.add_argument("-bind-name", dest="bind_name", required=True)
+    brc.add_argument("-selector", default="")
+    brsub.add_parser("list")
+    brd = brsub.add_parser("delete")
+    brd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
+
+    login = sub.add_parser("login")
+    login.add_argument("-method", required=True)
+    login.add_argument("-bearer-token-file", dest="bearer_token_file",
+                       required=True)
+    login.add_argument("-token-sink-file", dest="token_sink_file",
+                       default="")
+    login.set_defaults(fn=cmd_login)
+    logout = sub.add_parser("logout")
+    logout.set_defaults(fn=cmd_logout)
 
     peer = sub.add_parser("peering")
     peersub = peer.add_subparsers(dest="peering_cmd", required=True)
@@ -892,6 +1046,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     op = sub.add_parser("operator")
     opsub = op.add_subparsers(dest="operator_cmd", required=True)
+    ap = opsub.add_parser("autopilot")
+    apsub = ap.add_subparsers(dest="autopilot_cmd", required=True)
+    apsub.add_parser("get-config")
+    aps = apsub.add_parser("set-config")
+    aps.add_argument("-cleanup-dead-servers",
+                     dest="cleanup_dead_servers",
+                     choices=["true", "false"], default=None)
+    aps.add_argument("-max-trailing-logs", dest="max_trailing_logs",
+                     type=int, default=None)
+    apsub.add_parser("state")
     raft = opsub.add_parser("raft")
     raftsub = raft.add_subparsers(dest="raft_cmd", required=True)
     raftsub.add_parser("list-peers")

@@ -243,7 +243,8 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
         # leader_federation_state_ae.go keeps them current)
         fed: dict[str, list] = {}
         try:
-            res = rpc("Internal.FederationStates", {"AllowStale": True})
+            res = rpc("Internal.ListMeshGateways",
+                      {"AllowStale": True})
             for fs in res.get("States") or []:
                 fed[fs.get("Datacenter", "")] = [
                     {"Address": g.get("Address", ""),

@@ -1202,6 +1202,15 @@ def register_endpoints(srv) -> None:
                     args.get("TargetDatacenter", ""))})))
     primary_owned("Internal.FederationStateApply",
                   federation_state_apply)
+    # proxy-facing: gateway ADDRESSES only, no operator:read — mesh
+    # gateways run with service-scoped tokens (the reference exposes
+    # FederationState.ListMeshGateways the same way,
+    # federation_state_endpoint.go:180)
+    read("Internal.ListMeshGateways", lambda args: srv.blocking_query(
+        args, ("federation_states",), lambda: {
+            "States": [{"Datacenter": fs.get("Datacenter", ""),
+                        "MeshGateways": fs.get("MeshGateways") or []}
+                       for fs in state.raw_list("federation_states")]}))
 
     # ------------------------------------------------- autopilot config
     AUTOPILOT_DEFAULTS = {

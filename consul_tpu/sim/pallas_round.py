@@ -346,12 +346,19 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                 args, scalars, seed[None], t[None])
             partials = partials.at[1].max(1.0).at[2].max(1e-9) \
                 .at[7].max(1e-9)
+            # per-round block sums are < 2^24 (exact in f32); the
+            # CARRY accumulates in int32 — a long scan would pass f32's
+            # integer range and silently drop counts. Latency (lane 4)
+            # stays f32: it is a genuine real-valued sum.
             return (args2, partials, t + p.probe_interval,
-                    acc + stat_sums), None
+                    (acc[0]
+                     + stat_sums.at[4].set(0.0).astype(jnp.int32),
+                     acc[1] + stat_sums[4])), None
 
-        acc0 = jnp.zeros((8,), jnp.float32)
+        acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
         (args, scalars, t_final, acc), _ = jax.lax.scan(
             body, (args, scalars, state.t, acc0), seeds)
+        acc_i, acc_lat = acc
         (up, status, inc, informed, s_start, s_dead, s_conf,
          lh) = args[:8]
         if n_arrays == 10:
@@ -363,16 +370,15 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         st = state.stats
         if p.collect_stats:
             st = st._replace(
-                suspicions=st.suspicions + acc[0].astype(jnp.int32),
-                refutes=st.refutes + acc[1].astype(jnp.int32),
-                false_positives=st.false_positives
-                + acc[2].astype(jnp.int32),
+                suspicions=st.suspicions + acc_i[0],
+                refutes=st.refutes + acc_i[1],
+                false_positives=st.false_positives + acc_i[2],
                 true_deaths_declared=st.true_deaths_declared
-                + acc[3].astype(jnp.int32),
-                detect_latency_sum=st.detect_latency_sum + acc[4],
-                crashes=st.crashes + acc[5].astype(jnp.int32),
-                rejoins=st.rejoins + acc[6].astype(jnp.int32),
-                leaves=st.leaves + acc[7].astype(jnp.int32))
+                + acc_i[3],
+                detect_latency_sum=st.detect_latency_sum + acc_lat,
+                crashes=st.crashes + acc_i[5],
+                rejoins=st.rejoins + acc_i[6],
+                leaves=st.leaves + acc_i[7])
         return SimState(
             up=up.reshape(-1) != 0, down_time=down_flat,
             status=status.reshape(-1), incarnation=inc.reshape(-1),
