@@ -185,6 +185,24 @@ class HTTPApi:
             if "wan" in q:
                 return rpc("Internal.Members", {"WAN": True}), None
             return a.members(), None
+        if path == "/v1/agent/version":
+            return {"SHA": "", "HumanVersion": __version__}, None
+        if path == "/v1/agent/host":
+            import os as _os
+            import platform as _plat
+
+            rpc("Internal.AgentRead", {})  # operator-ish info: agent read
+            la = _os.getloadavg()
+            return {"Host": {"hostname": _plat.node(),
+                             "os": _plat.system().lower(),
+                             "kernelVersion": _plat.release(),
+                             "procs": sum(
+                                 e.isdigit()
+                                 for e in _os.listdir("/proc"))
+                             if _os.path.isdir("/proc") else 0},
+                    "CollectionTime": 0,
+                    "LoadAverage": {"load1": la[0], "load5": la[1],
+                                    "load15": la[2]}}, None
         if path == "/v1/agent/metrics":
             return telemetry.default.snapshot(), None
         if path == "/v1/agent/services":
@@ -286,6 +304,19 @@ class HTTPApi:
             return rpc("Catalog.Deregister", jbody()), None
 
         # ---------------------------------------------------------- health
+        if (m := re.match(r"^/v1/(?:health|catalog)/connect/(.+)$",
+                          path)):
+            # connect-capable instances of a service: its proxies (ANY
+            # registered name — matched on Proxy.DestinationServiceName)
+            # + natives, with the service's own ACL and the same tag/
+            # near/passing params as /v1/health/service
+            res = rpc("Health.ServiceNodes", blocking_args({
+                "ServiceName": urllib.parse.unquote(m.group(1)),
+                "Connect": True,
+                "ServiceTag": q.get("tag", ""),
+                "Near": q.get("near", ""),
+                "MustBePassing": "passing" in q}))
+            return res["Nodes"], res.get("Index")
         if (m := re.match(r"^/v1/health/service/(.+)$", path)):
             args = blocking_args({"ServiceName":
                                   urllib.parse.unquote(m.group(1))})
@@ -349,6 +380,12 @@ class HTTPApi:
             return res["Sessions"], None
 
         # ------------------------------------------------------ coordinate
+        if path == "/v1/coordinate/datacenters":
+            # WAN coordinates grouped by DC (coordinate_endpoint.go
+            # Datacenters) — one areas-less group per DC here
+            dcs = rpc("Catalog.ListDatacenters", {})
+            return [{"Datacenter": dc, "AreaID": "",
+                     "Coordinates": []} for dc in dcs], None
         if path == "/v1/coordinate/nodes":
             res = rpc("Coordinate.ListNodes", blocking_args())
             return res["Coordinates"], res["Index"]

@@ -185,15 +185,18 @@ def register_endpoints(srv) -> None:
 
     def health_service_nodes(args):
         svc = args.get("ServiceName", "")
+        # Connect lookups authorize on the DESTINATION service name
+        # (health_endpoint.go: the proxy rides the service's ACL)
         require(authz(args).service_read(svc), f"service read on {svc!r}")
         tag = args.get("ServiceTag") or None
         passing = bool(args.get("MustBePassing"))
         near = args.get("Near", "")
+        lookup = state.connect_service_nodes if args.get("Connect") \
+            else state.check_service_nodes
         return srv.blocking_query(
             args, ("services", "nodes", "checks"), lambda: {
                 "Nodes": _near_sort(
-                    state.check_service_nodes(svc, tag,
-                                              passing_only=passing),
+                    lookup(svc, tag, passing_only=passing),
                     near, lambda e: e["Node"]["Node"])})
 
     def health_node_checks(args):

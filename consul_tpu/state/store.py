@@ -287,6 +287,38 @@ class StateStore:
                             "Checks": [c.to_dict() for c in checks]})
             return out
 
+    def connect_service_nodes(self, service: str,
+                              tag: Optional[str] = None,
+                              passing_only: bool = False
+                              ) -> list[dict[str, Any]]:
+        """Connect-capable instances of a service: its connect proxies
+        (Kind=connect-proxy with Proxy.DestinationServiceName matching,
+        any registered name) plus connect-native instances
+        (state.CheckConnectServiceNodes)."""
+        with self._lock:
+            out = []
+            for (node, _), s in self.tables["services"].items():
+                is_proxy = (s.kind == "connect-proxy"
+                            and (s.proxy or {}).get(
+                                "DestinationServiceName") == service)
+                is_native = s.connect_native and s.service == service
+                if not (is_proxy or is_native):
+                    continue
+                if tag and tag not in s.tags:
+                    continue
+                n = self.tables["nodes"].get(node)
+                if n is None:
+                    continue
+                checks = [c for c in self.node_checks(node)
+                          if c.service_id in ("", s.id)]
+                if passing_only and any(
+                        c.status != CheckStatus.PASSING for c in checks):
+                    continue
+                out.append({"Node": n.to_dict(), "Service": s.to_dict(),
+                            "Checks": [c.to_dict() for c in checks]})
+            return sorted(out, key=lambda e: (e["Node"]["Node"],
+                                              e["Service"]["ID"]))
+
     # -------------------------------------------------------------------- KV
 
     def kv_set(self, key: str, value: bytes, flags: int = 0,

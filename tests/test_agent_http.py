@@ -576,3 +576,30 @@ def test_virtual_ip_dns(agent, client):
     resp3 = dns_query("db.virtual.consul.", qtype=28)
     assert _struct.unpack_from(">H", resp3, 6)[0] == 0
     assert resp3[3] & 0x0F == 0
+
+
+def test_minor_api_parity_routes(agent, client):
+    """Small reference routes: /v1/agent/version, /v1/agent/host,
+    /v1/coordinate/datacenters, /v1/health/connect/<svc>,
+    /v1/catalog/connect/<svc>."""
+    v = client.get("/v1/agent/version")
+    assert v["HumanVersion"]
+    h = client.get("/v1/agent/host")
+    assert h["Host"]["hostname"] and "load1" in h["LoadAverage"]
+    dcs = client.get("/v1/coordinate/datacenters")
+    assert dcs and dcs[0]["Datacenter"] == "dc1"
+    # connect-capable instances match on Proxy.DestinationServiceName,
+    # so CUSTOM-named sidecars are found too
+    client.service_register({
+        "Name": "cweb", "ID": "cweb", "Port": 8088,
+        "Check": {"TTL": "60s"},
+        "Connect": {"SidecarService": {"Name": "cweb-custom-proxy"}}})
+    client.check_pass("service:cweb")
+    wait_for(lambda: client.get("/v1/health/connect/cweb"),
+             what="connect instances")
+    nodes = client.get("/v1/health/connect/cweb")
+    assert nodes[0]["Service"]["Service"] == "cweb-custom-proxy"
+    assert client.get("/v1/catalog/connect/cweb")[0]["Service"][
+        "Service"] == "cweb-custom-proxy"
+    # a service with no proxy has no connect instances
+    assert client.get("/v1/health/connect/db") == []
