@@ -39,6 +39,14 @@ class Agent:
         self.scheduler = RealTimers()
         self._shutdown = False
 
+        # auto-config (agent/auto-config): a client agent exchanges its
+        # JWT intro token for the cluster bootstrap BEFORE anything
+        # else is constructed — the merged config then feeds the
+        # keyring, TLS configurator, and ACL tokens below
+        if config.auto_config_enabled and not config.server_mode:
+            config = self._fetch_auto_config(config)
+            self.config = config
+
         # central TLS configurator FIRST (tlsutil Configurator): the
         # server's RPC port shares it, so a hot reload reaches every
         # listener instead of a private copy going stale
@@ -60,7 +68,8 @@ class Agent:
             self.node_id = self.server.node_id
         else:
             self.server = None
-            self.client = Client(config, serf_transport=serf_transport)
+            self.client = Client(config, serf_transport=serf_transport,
+                                 tls=self.tls)
             self.node_id = self.client.node_id
 
         self.local = LocalState(
@@ -109,6 +118,10 @@ class Agent:
         # server is reachable — it must survive racing retry_join)
         if self.config.auto_encrypt and self.server is None:
             self._auto_encrypt_retry()
+        # a negative port disables the listener (reference: ports.http/
+        # ports.dns = -1)
+        serve_http = serve_http and self.config.port("http") >= 0
+        serve_dns = serve_dns and self.config.port("dns") >= 0
         if serve_http:
             from consul_tpu.agent.http import HTTPApi
 
@@ -126,6 +139,105 @@ class Agent:
                                  self.config.port("dns"))
             self.dns.start()
         self.log.info("agent started (server=%s)", self.server is not None)
+
+    def _install_tls_material(self, base_dir, subdir, roots,
+                              cert) -> dict:
+        """Write cluster-issued TLS material (CA bundle + agent cert +
+        0600 key) under <base_dir or tmp>/<subdir>; shared by
+        auto-encrypt and auto-config."""
+        import os as os_mod
+        import tempfile
+
+        cert_dir = os_mod.path.join(
+            base_dir or tempfile.mkdtemp(prefix="consul-tpu-tls-"),
+            subdir)
+        os_mod.makedirs(cert_dir, exist_ok=True)
+        paths = {"ca_file": os_mod.path.join(cert_dir, "ca.pem"),
+                 "cert_file": os_mod.path.join(cert_dir, "agent.pem"),
+                 "key_file": os_mod.path.join(cert_dir,
+                                              "agent-key.pem")}
+        with open(paths["ca_file"], "w") as f:
+            f.write("".join(r["RootCert"] for r in roots))
+        with open(paths["cert_file"], "w") as f:
+            f.write(cert.get("CertPEM", ""))
+        fd = os_mod.open(paths["key_file"],
+                         os_mod.O_WRONLY | os_mod.O_CREAT
+                         | os_mod.O_TRUNC, 0o600)
+        with os_mod.fdopen(fd, "w") as f:
+            f.write(cert.get("PrivateKeyPEM", ""))
+        self.log.info("TLS material installed in %s", cert_dir)
+        return paths
+
+    def _fetch_auto_config(self, config):
+        """Exchange the intro token for the cluster bootstrap
+        (auto_config.go readConfig/updateConfig): gossip key, TLS
+        material, ACL tokens, datacenter — merged UNDER any explicit
+        local settings."""
+        import os as os_mod
+        import tempfile
+
+        from consul_tpu.server.rpc import ConnPool
+
+        token = config.auto_config_intro_token
+        if not token and config.auto_config_intro_token_file:
+            with open(config.auto_config_intro_token_file) as f:
+                token = f.read().strip()
+        if not config.auto_config_server_addresses:
+            raise RuntimeError(
+                "auto-config failed: no server_addresses configured")
+        pool = ConnPool()
+        try:
+            res = None
+            last: Exception = RuntimeError("unreachable")
+            for attempt in range(5):
+                for addr in config.auto_config_server_addresses:
+                    try:
+                        res = pool.call(
+                            addr, "AutoConfig.InitialConfiguration",
+                            {"Node": self.name, "JWT": token})
+                        break
+                    except RPCError as e:
+                        # app-level refusal (bad JWT, disabled): final
+                        raise RuntimeError(
+                            f"auto-config failed: {e}") from e
+                    except Exception as e:  # noqa: BLE001
+                        last = e  # transport error: try next/retry
+                if res is not None:
+                    break
+                if attempt < 4:
+                    time.sleep(0.5 * (attempt + 1))
+            if res is None:
+                raise RuntimeError(f"auto-config failed: {last}")
+        finally:
+            pool.close()
+        central = res.get("Config") or {}
+        tokens = (central.get("acl") or {}).get("tokens") or {}
+        merged = {**config.__dict__}
+        # local explicit settings win; central fills the gaps. The
+        # datacenter merges only when locally EMPTY — the "dc1" default
+        # is indistinguishable from an explicit dc1, so it never flips.
+        if not merged.get("encrypt_key"):
+            merged["encrypt_key"] = central.get("encrypt", "")
+        if not merged.get("datacenter"):
+            merged["datacenter"] = central.get("datacenter", "")
+        if not merged.get("primary_datacenter"):
+            merged["primary_datacenter"] = central.get(
+                "primary_datacenter", "")
+        if not merged.get("acl_agent_token"):
+            merged["acl_agent_token"] = tokens.get("agent", "")
+        if not merged.get("acl_default_token"):
+            merged["acl_default_token"] = tokens.get("default", "")
+        if not merged.get("tls_cert_file"):
+            paths = self._install_tls_material(
+                config.data_dir, "auto-config",
+                res.get("Roots") or [], res.get("Certificate") or {})
+            merged.update(tls_ca_file=paths["ca_file"],
+                          tls_cert_file=paths["cert_file"],
+                          tls_key_file=paths["key_file"],
+                          tls_verify_outgoing=True)
+        self.log.info("auto-config: bootstrap received (gossip key=%s)",
+                      "yes" if merged["encrypt_key"] else "no")
+        return config.__class__(**merged)
 
     def _auto_encrypt_retry(self) -> None:
         if self._auto_encrypt() or self._shutdown:
@@ -146,28 +258,12 @@ class Agent:
         except Exception as e:  # noqa: BLE001
             self.log.warning("auto-encrypt failed (will retry): %s", e)
             return False
-        cert = res["Cert"]
-        cert_dir = os_mod.path.join(
-            self.config.data_dir or tempfile.mkdtemp(
-                prefix="consul-tpu-ae-"), "auto-encrypt")
-        os_mod.makedirs(cert_dir, exist_ok=True)
-        paths = {"ca_file": os_mod.path.join(cert_dir, "ca.pem"),
-                 "cert_file": os_mod.path.join(cert_dir, "agent.pem"),
-                 "key_file": os_mod.path.join(cert_dir, "agent-key.pem")}
-        with open(paths["ca_file"], "w") as f:
-            f.write("".join(r["RootCert"] for r in res["Roots"]))
-        with open(paths["cert_file"], "w") as f:
-            f.write(cert["CertPEM"])
-        fd = os_mod.open(paths["key_file"],
-                         os_mod.O_WRONLY | os_mod.O_CREAT
-                         | os_mod.O_TRUNC, 0o600)
-        with os_mod.fdopen(fd, "w") as f:
-            f.write(cert["PrivateKeyPEM"])
+        paths = self._install_tls_material(
+            self.config.data_dir, "auto-encrypt", res["Roots"],
+            res["Cert"])
         from consul_tpu.utils.tlsutil import TLSConfigurator
 
         self.tls = TLSConfigurator(**paths, verify_outgoing=True)
-        self.log.info("auto-encrypt: TLS material installed in %s",
-                      cert_dir)
         return True
 
     def _retry_join(self, seeds: list[str]) -> None:

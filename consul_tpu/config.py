@@ -191,6 +191,15 @@ class RuntimeConfig:
     tls_verify_outgoing: bool = False
     tls_https: bool = False   # serve the HTTP API over TLS
     auto_encrypt: bool = False  # client agents fetch TLS certs at join
+    # auto-config (agent/auto-config): client agents fetch their WHOLE
+    # bootstrap (gossip key, TLS, ACL tokens) from servers, authorized
+    # by a JWT intro token verified against server-side static keys
+    auto_config_enabled: bool = False
+    auto_config_intro_token: str = ""
+    auto_config_intro_token_file: str = ""
+    auto_config_server_addresses: tuple[str, ...] = ()
+    # server side: {"enabled": bool, "static": {jwt validation config}}
+    auto_config_authorization: dict = field(default_factory=dict)
 
     # Remote exec (`consul exec`); disabled by default like the reference
     # (disable_remote_exec defaults true since 0.8)
@@ -336,6 +345,16 @@ def load(
     # accept both the nested tls{defaults{}} form and flat keys
     tls = {**(tls.get("defaults") or {}),
            **{k: v for k, v in tls.items() if k != "defaults"}}
+    if "auto_config" in raw:
+        ac = raw["auto_config"] or {}
+        kwargs["auto_config_enabled"] = bool(ac.get("enabled"))
+        kwargs["auto_config_intro_token"] = ac.get("intro_token", "")
+        kwargs["auto_config_intro_token_file"] = \
+            ac.get("intro_token_file", "")
+        kwargs["auto_config_server_addresses"] = tuple(
+            ac.get("server_addresses") or [])
+        if "authorization" in ac:
+            kwargs["auto_config_authorization"] = ac["authorization"]
     if "auto_encrypt" in raw:
         ae_blk = raw["auto_encrypt"]
         kwargs["auto_encrypt"] = bool(

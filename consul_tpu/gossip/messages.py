@@ -133,3 +133,13 @@ class Keyring:
             except Exception as e:  # noqa: BLE001 — try next key
                 last = e
         raise ValueError(f"no installed key decrypts packet: {last}")
+
+
+def make_keyring(encrypt_key: str):
+    """Keyring from a base64 config key (shared by Server/Client), or
+    None when gossip encryption is off."""
+    if not encrypt_key:
+        return None
+    import base64
+
+    return Keyring([base64.b64decode(encrypt_key)])

@@ -29,17 +29,27 @@ class NoServersError(RPCError):
 
 class Client:
     def __init__(self, config: RuntimeConfig,
-                 serf_transport: Optional[Transport] = None) -> None:
+                 serf_transport: Optional[Transport] = None,
+                 tls=None) -> None:
         self.config = config
         self.name = config.node_name or f"client-{uuid.uuid4().hex[:8]}"
         self.node_id = config.node_id or str(uuid.uuid4())
         self.log = log.named(f"client.{self.name}")
         self.pool = ConnPool()
+        # verify_outgoing: RPC forwarding to servers rides RPC_TLS
+        # (same wiring as Server, server.py)
+        if tls is not None and config.tls_verify_outgoing:
+            ctx = tls.client_context()
+            ctx.check_hostname = False  # internal addrs are IPs
+            self.pool.tls_context = ctx
         self._lock = threading.Lock()
         self._servers: list[str] = []
         self.rng = random.Random()
 
         tags = {"role": "node", "dc": config.datacenter, "id": self.node_id}
+        from consul_tpu.gossip.messages import make_keyring
+
+        keyring = make_keyring(config.encrypt_key)
         self.serf = Serf(
             name=self.name,
             transport=serf_transport or UDPTransport(
@@ -47,7 +57,8 @@ class Client:
                 config.port("serf_lan")),
             config=config.gossip_lan,
             tags=tags,
-            event_handler=self._serf_event)
+            event_handler=self._serf_event,
+            keyring=keyring)
 
     def start(self) -> None:
         self.serf.start()

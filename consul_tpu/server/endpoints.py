@@ -701,6 +701,50 @@ def register_endpoints(srv) -> None:
 
     e["AutoEncrypt.Sign"] = auto_encrypt_sign
 
+    def auto_config_initial(args):
+        """Full agent bootstrap (auto_config_endpoint.go
+        InitialConfiguration): a JWT intro token — verified against the
+        server's auto_config.authorization.static keys — buys the
+        joining agent its gossip key, TLS material, and ACL agent
+        token. The JWT is the admission bar; no prior cluster
+        membership needed."""
+        authz_cfg = srv.config.auto_config_authorization or {}
+        if not authz_cfg.get("enabled"):
+            raise RPCError("auto-config is disabled")
+        node = args.get("Node", "")
+        if not node:
+            raise RPCError("Node is required")
+        from consul_tpu.acl.authmethod import AuthError, verify_jwt
+
+        try:
+            verify_jwt(args.get("JWT", ""),
+                       authz_cfg.get("static") or {})
+        except AuthError as exc:
+            raise RPCError(f"Permission denied: {exc}") from exc
+        if not srv.is_leader():
+            return srv._forward_to_leader(
+                "AutoConfig.InitialConfiguration", args)
+        from consul_tpu.connect.ca import sign_leaf
+
+        root = srv.ca.initialize()
+        cert = sign_leaf(root, f"agent/{node}", srv.config.datacenter,
+                         ttl_hours=72.0)
+        return {
+            "Config": {
+                "datacenter": srv.config.datacenter,
+                "primary_datacenter": srv.config.primary_datacenter,
+                "encrypt": srv.config.encrypt_key,
+                "acl": {"tokens": {
+                    "agent": srv.config.acl_agent_token,
+                    "default": srv.config.acl_default_token}},
+            },
+            "Certificate": cert,
+            "Roots": [{"RootCert": r["RootCert"]}
+                      for r in srv.ca.roots()],
+        }
+
+    e["AutoConfig.InitialConfiguration"] = auto_config_initial
+
     # ------------------------------------------------------------ Peering
     # Cluster peering (reference: agent/rpc/peering + peerstream gRPC
     # streams). Simplified transport: peers exchange a bearer secret at

@@ -180,13 +180,9 @@ class Server:
         self._loop_timers = []
 
     def _keyring(self):
-        if not self.config.encrypt_key:
-            return None
-        import base64
+        from consul_tpu.gossip.messages import make_keyring
 
-        from consul_tpu.gossip.messages import Keyring
-
-        return Keyring([base64.b64decode(self.config.encrypt_key)])
+        return make_keyring(self.config.encrypt_key)
 
     # ------------------------------------------------------------- lifecycle
 

@@ -159,3 +159,60 @@ def test_verify_incoming_refuses_plaintext_rpc(tmp_path):
         assert pool.call(addr, "Status.Ping", {}) == "pong"
     finally:
         a.shutdown()
+
+
+def test_auto_config_full_bootstrap():
+    """auto-config: a client agent with only a JWT intro token and a
+    server address receives the gossip key, TLS material, and ACL
+    agent token, then joins the ENCRYPTED pool (agent/auto-config)."""
+    import base64 as b64mod
+    import os as os_mod
+
+    from tests.test_auth_methods import _es256_keypair, _jwt
+    import time as time_mod
+
+    key, pub = _es256_keypair()
+    gossip_key = b64mod.b64encode(os_mod.urandom(32)).decode()
+    srv = Agent(load(dev=True, overrides={
+        "node_name": "ac-srv", "encrypt": gossip_key,
+        "acl": {"enabled": True, "default_policy": "allow",
+                "tokens": {"initial_management": "root-sec",
+                           "agent": "root-sec"}},
+        "auto_config": {"authorization": {
+            "enabled": True,
+            "static": {"JWTValidationPubKeys": [pub],
+                       "BoundAudiences": ["consul-tpu"]}}}}))
+    srv.start(serve_dns=False)
+    try:
+        wait_for(lambda: srv.server.is_leader(), what="leader")
+        intro = _jwt(key, {"aud": "consul-tpu",
+                           "exp": time_mod.time() + 600,
+                           "sub": "new-agent"})
+        cli = Agent(load(dev=True, overrides={
+            "node_name": "ac-cli", "server": False,
+            "auto_config": {
+                "enabled": True, "intro_token": intro,
+                "server_addresses": [srv.server.rpc.addr]},
+            "retry_join": [srv.serf.memberlist.transport.addr]}))
+        cli.start(serve_http=False, serve_dns=False)
+        try:
+            # the fetched gossip key let it join the ENCRYPTED pool
+            assert cli.config.encrypt_key == gossip_key
+            wait_for(lambda: len(srv.serf.members()) == 2,
+                     what="encrypted join")
+            # TLS material installed and ACL agent token applied
+            assert cli.tls is not None and cli.tls.enabled
+            assert cli.config.acl_agent_token == "root-sec"
+        finally:
+            cli.shutdown()
+        # a BAD intro token is refused
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="auto-config failed"):
+            Agent(load(dev=True, overrides={
+                "node_name": "ac-bad", "server": False,
+                "auto_config": {
+                    "enabled": True, "intro_token": "not.a.jwt",
+                    "server_addresses": [srv.server.rpc.addr]}}))
+    finally:
+        srv.shutdown()
