@@ -173,9 +173,6 @@ class Agent:
         (auto_config.go readConfig/updateConfig): gossip key, TLS
         material, ACL tokens, datacenter — merged UNDER any explicit
         local settings."""
-        import os as os_mod
-        import tempfile
-
         from consul_tpu.server.rpc import ConnPool
 
         token = config.auto_config_intro_token
@@ -197,6 +194,10 @@ class Agent:
                             {"Node": self.name, "JWT": token})
                         break
                     except RPCError as e:
+                        if "leader" in str(e).lower():
+                            # cluster still electing: transient
+                            last = e
+                            continue
                         # app-level refusal (bad JWT, disabled): final
                         raise RuntimeError(
                             f"auto-config failed: {e}") from e
@@ -218,8 +219,9 @@ class Agent:
         # is indistinguishable from an explicit dc1, so it never flips.
         if not merged.get("encrypt_key"):
             merged["encrypt_key"] = central.get("encrypt", "")
-        if not merged.get("datacenter"):
-            merged["datacenter"] = central.get("datacenter", "")
+        if not merged.get("datacenter_explicit"):
+            merged["datacenter"] = central.get(
+                "datacenter") or merged["datacenter"]
         if not merged.get("primary_datacenter"):
             merged["primary_datacenter"] = central.get(
                 "primary_datacenter", "")
@@ -245,9 +247,6 @@ class Agent:
         self.scheduler.after(5.0, self._auto_encrypt_retry)
 
     def _auto_encrypt(self) -> bool:
-        import os as os_mod
-        import tempfile
-
         if self.tls is not None:
             # an operator-configured TLS setup always wins — silently
             # replacing it would drop verify_incoming and their certs

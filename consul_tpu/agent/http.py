@@ -712,6 +712,10 @@ class HTTPApi:
             finally:
                 detach()
             return "\n".join(lines).encode(), None
+        if path == "/v1/operator/raft/peer" and method == "DELETE":
+            rpc("Operator.RaftRemovePeer",
+                {"Address": q.get("address", "")})
+            return True, None
         if path == "/v1/operator/raft/configuration":
             stats = rpc("Status.RaftStats", {})
             return {"Servers": [

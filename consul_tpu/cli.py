@@ -364,6 +364,10 @@ def cmd_operator(args) -> int:
             print(json.dumps(
                 c.get("/v1/operator/autopilot/state"), indent=2))
             return 0
+    if args.operator_cmd == "raft" and args.raft_cmd == "remove-peer":
+        c.delete("/v1/operator/raft/peer", address=args.address)
+        print(f"Removed peer with address \"{args.address}\"")
+        return 0
     if args.operator_cmd == "raft" and args.raft_cmd == "list-peers":
         cfg = c.raft_configuration()
         rows = [("Address", "Leader", "Voter")]
@@ -563,6 +567,52 @@ def _write_pem(path: str, data: str, private: bool = False) -> None:
                  0o600 if private else 0o644)
     with os.fdopen(fd, "w") as f:
         f.write(data)
+
+
+def cmd_troubleshoot(args) -> int:
+    """`troubleshoot upstreams|proxy -proxy-id <id>`: inspect a local
+    proxy's config snapshot — upstream health, intention decisions,
+    discovery-chain targets (command/troubleshoot, built on the same
+    snapshot the xDS layer serves)."""
+    c = _client(args)
+    proxy_id = args.proxy_id or f"{args.sidecar_for}-sidecar-proxy"
+    snap = c.get(f"/v1/agent/connect/proxy/{proxy_id}")
+    if args.ts_cmd == "upstreams":
+        rows = [("Upstream", "Allowed", "Protocol", "Targets",
+                 "Healthy endpoints", "Error")]
+        for u in snap.get("Upstreams") or []:
+            targets = ", ".join(
+                f"{t['Service']}({t['Weight']}%)"
+                for r in u.get("Routes") or [] for t in r["Targets"])
+            rows.append((u["DestinationName"],
+                         str(u.get("Allowed", True)).lower(),
+                         u.get("Protocol", "tcp"), targets or "-",
+                         str(len(u.get("Endpoints") or [])),
+                         u.get("Error", "") or "-"))
+        _table(rows)
+        return 0
+    if args.ts_cmd == "proxy":
+        print(f"Proxy ID:      {snap['ProxyID']}")
+        print(f"Kind:          {snap.get('Kind')}")
+        print(f"Service:       {snap.get('Service')}")
+        print(f"Trust domain:  {snap.get('TrustDomain')}")
+        leaf = snap.get("Leaf") or {}
+        print(f"Leaf valid to: {leaf.get('ValidBefore', '-')}")
+        print(f"CA roots:      {len(snap.get('Roots') or [])}")
+        bad = [u["DestinationName"] for u in snap.get("Upstreams") or []
+               if not u.get("Endpoints") and u.get("Allowed", True)]
+        denied = [u["DestinationName"]
+                  for u in snap.get("Upstreams") or []
+                  if not u.get("Allowed", True)]
+        if denied:
+            print(f"! intention-denied upstreams: {', '.join(denied)}")
+        if bad:
+            print(f"! upstreams with NO healthy endpoints: "
+                  f"{', '.join(bad)}")
+        if not bad and not denied:
+            print("No issues found.")
+        return 0
+    return 1
 
 
 def cmd_peering(args) -> int:
@@ -974,6 +1024,14 @@ def build_parser() -> argparse.ArgumentParser:
     logout = sub.add_parser("logout")
     logout.set_defaults(fn=cmd_logout)
 
+    ts = sub.add_parser("troubleshoot")
+    tssub = ts.add_subparsers(dest="ts_cmd", required=True)
+    for name in ("upstreams", "proxy"):
+        tsp = tssub.add_parser(name)
+        tsp.add_argument("-proxy-id", dest="proxy_id", default="")
+        tsp.add_argument("-sidecar-for", dest="sidecar_for", default="")
+    ts.set_defaults(fn=cmd_troubleshoot)
+
     peer = sub.add_parser("peering")
     peersub = peer.add_subparsers(dest="peering_cmd", required=True)
     pg = peersub.add_parser("generate-token")
@@ -1059,6 +1117,8 @@ def build_parser() -> argparse.ArgumentParser:
     raft = opsub.add_parser("raft")
     raftsub = raft.add_subparsers(dest="raft_cmd", required=True)
     raftsub.add_parser("list-peers")
+    rrm = raftsub.add_parser("remove-peer")
+    rrm.add_argument("-address", required=True)
     op.set_defaults(fn=cmd_operator)
 
     finish()

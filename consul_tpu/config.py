@@ -111,6 +111,9 @@ class RuntimeConfig:
     node_name: str = ""
     node_id: str = ""
     datacenter: str = "dc1"
+    # whether the operator SET datacenter (vs the dc1 default) — lets
+    # auto-config know the central value may fill it
+    datacenter_explicit: bool = False
     primary_datacenter: str = ""
     data_dir: str = ""
     server_mode: bool = False
@@ -308,6 +311,8 @@ def load(
         elif k in {f.name for f in dataclasses.fields(RuntimeConfig)}:
             kwargs[k] = v
 
+    if "datacenter" in raw:
+        kwargs["datacenter_explicit"] = True
     if "ports" in raw:
         ports = dict(RuntimeConfig().ports)
         ports.update(raw["ports"])

@@ -1300,6 +1300,33 @@ def register_endpoints(srv) -> None:
                 for s in health["Servers"]},
         }
 
+    def raft_remove_peer(args):
+        """Force-remove a stuck raft peer (operator_endpoint.go
+        RaftRemovePeerByAddress): for servers that died WITHOUT leaving
+        and will not come back."""
+        require(authz(args).operator_write(), "operator write")
+        addr = args.get("Address", "")
+        if not addr:
+            raise RPCError("Address is required")
+        if not srv.is_leader():
+            return srv._forward_to_leader("Operator.RaftRemovePeer",
+                                          args)
+        if addr == srv.rpc.addr:
+            raise RPCError("refusing to remove ourselves")
+        if addr not in srv.raft.peers:
+            # a typo'd address must not report success while the REAL
+            # dead peer keeps counting against quorum
+            raise RPCError(f"address {addr!r} was not found in the "
+                           f"Raft configuration")
+        from consul_tpu.raft.raft import NotLeader
+
+        try:
+            srv.raft.remove_peer(addr)
+        except NotLeader as exc:
+            raise RPCError("not leader") from exc
+        return True
+
+    e["Operator.RaftRemovePeer"] = raft_remove_peer
     read("Operator.AutopilotGetConfiguration", autopilot_get_config)
     e["Operator.AutopilotSetConfiguration"] = autopilot_set_config
     read("Operator.AutopilotState", autopilot_state)

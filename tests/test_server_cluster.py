@@ -153,3 +153,26 @@ def test_session_ttl_expiry(cluster):
     # without renewal the leader expires it (2x TTL grace)
     wait_for(lambda: leader.state.session_get(sid) is None,
              timeout=15.0, what="session TTL expiry")
+
+
+def test_operator_raft_remove_peer(cluster):
+    """Operator.RaftRemovePeer force-removes a stuck peer by address;
+    removing the leader itself is refused
+    (operator_endpoint.go RaftRemovePeerByAddress)."""
+    servers, leader = cluster
+    victim = next(s for s in servers if s is not leader)
+    victim_addr = victim.rpc.addr
+    # autopilot would re-add a live serf member: stop the victim first
+    victim.shutdown()
+    res = leader.endpoints["Operator.RaftRemovePeer"](
+        {"Address": victim_addr})
+    assert res is True
+    wait_for(lambda: victim_addr not in leader.raft.peers,
+             what="peer removed")
+    import pytest as _pytest
+
+    from consul_tpu.server.rpc import RPCError
+
+    with _pytest.raises(RPCError, match="ourselves"):
+        leader.endpoints["Operator.RaftRemovePeer"](
+            {"Address": leader.rpc.addr})

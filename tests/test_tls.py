@@ -216,3 +216,35 @@ def test_auto_config_full_bootstrap():
                     "server_addresses": [srv.server.rpc.addr]}}))
     finally:
         srv.shutdown()
+
+
+def test_auto_config_fills_datacenter_when_not_explicit():
+    """A client that never set datacenter adopts the cluster's; an
+    EXPLICIT local datacenter (even 'dc1') is never overwritten."""
+    import time as time_mod
+
+    from tests.test_auth_methods import _es256_keypair, _jwt
+
+    key, pub = _es256_keypair()
+    srv = Agent(load(dev=True, overrides={
+        "node_name": "dcfill-srv", "datacenter": "dc9",
+        "auto_config": {"authorization": {
+            "enabled": True,
+            "static": {"JWTValidationPubKeys": [pub]}}}}))
+    srv.start(serve_dns=False)
+    try:
+        wait_for(lambda: srv.server.is_leader(), what="leader")
+        intro = _jwt(key, {"exp": time_mod.time() + 600, "sub": "x"})
+        cli = Agent(load(dev=True, overrides={
+            "node_name": "dcfill-cli", "server": False,
+            "auto_config": {"enabled": True, "intro_token": intro,
+                            "server_addresses": [srv.server.rpc.addr]}}))
+        assert cli.config.datacenter == "dc9"  # adopted
+        cli2 = Agent(load(dev=True, overrides={
+            "node_name": "dcpin-cli", "server": False,
+            "datacenter": "dc1",  # EXPLICIT
+            "auto_config": {"enabled": True, "intro_token": intro,
+                            "server_addresses": [srv.server.rpc.addr]}}))
+        assert cli2.config.datacenter == "dc1"  # pinned
+    finally:
+        srv.shutdown()
