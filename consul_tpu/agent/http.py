@@ -677,6 +677,30 @@ class HTTPApi:
                 a.serf.user_event(f"consul:keyring:{op}", key)
                 return None, None
 
+        # --------------------------------------------------- UI data API
+        if path == "/v1/internal/ui/catalog-overview":
+            # overview manager (ui_endpoint.go CatalogOverview): counts
+            # from ONE all-checks RPC + the two catalog listings
+            nodes = rpc("Catalog.ListNodes", {"AllowStale": True})
+            svcs = rpc("Catalog.ListServices", {"AllowStale": True})
+            all_checks = rpc("Health.ChecksInState",
+                             {"State": "any", "AllowStale": True})
+            counts = {"passing": 0, "warning": 0, "critical": 0}
+            for c in all_checks["HealthChecks"]:
+                st = c.get("Status", "critical")
+                counts[st] = counts.get(st, 0) + 1
+            return {"Nodes": len(nodes["Nodes"]),
+                    "Services": len(svcs["Services"]),
+                    "Checks": counts}, None
+        if path == "/v1/internal/ui/nodes":
+            # server-side single-pass join; the index covers the checks
+            # table so health flips wake blocking watchers
+            res = rpc("Internal.UINodes", blocking_args())
+            return res["Nodes"], res.get("Index")
+        if path == "/v1/internal/ui/services":
+            res = rpc("Internal.UIServices", blocking_args())
+            return res["Services"], res.get("Index")
+
         # -------------------------------------------------------- operator
         if path == "/v1/operator/autopilot/health":
             return rpc("Operator.AutopilotHealth", {}), None

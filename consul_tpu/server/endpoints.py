@@ -1326,6 +1326,23 @@ def register_endpoints(srv) -> None:
             raise RPCError("not leader") from exc
         return True
 
+    def ui_nodes(args):
+        az = authz(args)
+        return srv.blocking_query(
+            args, ("nodes", "checks"), lambda: {
+                "Nodes": [n for n in state.ui_summaries()[0]
+                          if az.node_read(n["Node"])]})
+
+    def ui_services(args):
+        az = authz(args)
+        return srv.blocking_query(
+            args, ("services", "checks"), lambda: {
+                "Services": [s for s in state.ui_summaries()[1]
+                             if az.service_read(s["Name"])]})
+
+    read("Internal.UINodes", ui_nodes)
+    read("Internal.UIServices", ui_services)
+
     e["Operator.RaftRemovePeer"] = raft_remove_peer
     read("Operator.AutopilotGetConfiguration", autopilot_get_config)
     e["Operator.AutopilotSetConfiguration"] = autopilot_set_config

@@ -319,6 +319,45 @@ class StateStore:
             return sorted(out, key=lambda e: (e["Node"]["Node"],
                                               e["Service"]["ID"]))
 
+    def ui_summaries(self) -> tuple[list, list]:
+        """Single-pass aggregation backing the UI data API
+        (ui_endpoint.go): (nodes with their checks, per-service
+        summaries with instance counts + check-status tallies)."""
+        with self._lock:
+            nodes = [{**n.to_dict(),
+                      "Checks": [c.to_dict()
+                                 for c in self.node_checks(n.node)]}
+                     for n in sorted(self.tables["nodes"].values(),
+                                     key=lambda x: x.node)]
+            per: dict[str, dict] = {}
+            id_to_name: dict[tuple, str] = {}
+            for (node, _), s in self.tables["services"].items():
+                d = per.setdefault(s.service, {
+                    "Name": s.service, "Kind": s.kind,
+                    "Tags": set(), "InstanceCount": 0,
+                    "ChecksPassing": 0, "ChecksWarning": 0,
+                    "ChecksCritical": 0})
+                d["InstanceCount"] += 1
+                d["Tags"].update(s.tags)
+                id_to_name[(node, s.id)] = s.service
+            for (node, _), c in self.tables["checks"].items():
+                svc = c.service_name or id_to_name.get(
+                    (node, c.service_id), "")
+                if svc not in per:
+                    continue
+                key = {CheckStatus.PASSING: "ChecksPassing",
+                       CheckStatus.WARNING: "ChecksWarning"}.get(
+                    c.status, "ChecksCritical")
+                per[svc][key] += 1
+            services = []
+            for name in sorted(per):
+                d = per[name]
+                status = "critical" if d["ChecksCritical"] else (
+                    "warning" if d["ChecksWarning"] else "passing")
+                services.append({**d, "Tags": sorted(d["Tags"]),
+                                 "Status": status})
+            return nodes, services
+
     # -------------------------------------------------------------------- KV
 
     def kv_set(self, key: str, value: bytes, flags: int = 0,

@@ -603,3 +603,18 @@ def test_minor_api_parity_routes(agent, client):
         "Service"] == "cweb-custom-proxy"
     # a service with no proxy has no connect instances
     assert client.get("/v1/health/connect/db") == []
+
+
+def test_ui_data_endpoints(agent, client):
+    """UI data API (ui_endpoint.go): catalog overview counts + per-node
+    and per-service summaries."""
+    ov = client.get("/v1/internal/ui/catalog-overview")
+    assert ov["Nodes"] >= 1 and ov["Services"] >= 1
+    assert set(ov["Checks"]) >= {"passing", "warning", "critical"}
+    nodes = client.get("/v1/internal/ui/nodes")
+    assert any(n["Node"] == "dev-agent" and
+               isinstance(n["Checks"], list) for n in nodes)
+    svcs = client.get("/v1/internal/ui/services")
+    web = next(s for s in svcs if s["Name"] == "web")
+    assert web["InstanceCount"] >= 1
+    assert web["Status"] in ("passing", "warning", "critical")
