@@ -148,3 +148,40 @@ def test_leave_propagation_same_ballpark():
     assert sim_t != float("inf")
     assert 0.05 < sim_t / host_t < 20.0, \
         f"leave spread: host={host_t:.2f}s sim={sim_t:.2f}s"
+
+
+def test_false_positive_rate_under_loss_same_ballpark():
+    """BASELINE criterion: the sim's FD false-positive rate tracks the
+    CPU host engine under the same heavy loss (TCP fallback off in
+    BOTH engines via CFG/from_gossip_config, so the detector is
+    genuinely stressed). Commensurate units: cumulative wrong-DEAD
+    DECLARATION incidents per node-round on both sides — the host's
+    memberlist.declare_dead counter fires once per member marking a
+    node dead (÷n for incidents), the sim's stats.false_positives
+    counts declaration events directly."""
+    n, loss, window = 24, 0.45, 120.0
+    telemetry.default.reset()
+    net, serfs = build_host_cluster(n, loss=loss, seed=11)
+    telemetry.default.reset()  # drop join-phase noise
+    net.clock.advance(window)
+    snap = telemetry.default.snapshot()
+    host_dead = next((c["Count"] for c in snap["Counters"]
+                      if c["Name"].endswith("declare_dead")), 0)
+    host_rounds = window / CFG.probe_interval
+    # nobody actually crashed: every declaration is a false positive
+    host_rate = host_dead / n / (n * host_rounds)
+
+    sim_rounds = int(host_rounds)
+    p = SimParams.from_gossip_config(CFG, n=n, loss=loss)
+    state, _ = run_rounds(init_state(n), jax.random.key(13), p,
+                          sim_rounds)
+    sim_rate = int(state.stats.false_positives) / (n * sim_rounds)
+    # BASELINE: both rates within 1 percentage point of each other,
+    # AND neither engine an order of magnitude off the other when
+    # either produces a measurable rate
+    assert abs(sim_rate - host_rate) < 0.01, \
+        f"FP rates diverge: host={host_rate:.5f} sim={sim_rate:.5f}"
+    if max(sim_rate, host_rate) > 1e-4:
+        ratio = (sim_rate + 1e-6) / (host_rate + 1e-6)
+        assert 0.05 < ratio < 20.0, \
+            f"FP rates diverge: host={host_rate:.5f} sim={sim_rate:.5f}"

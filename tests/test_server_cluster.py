@@ -193,8 +193,9 @@ def test_agent_data_dir_persistence(tmp_path):
         wait_for(lambda: a.server.is_leader(), what="leader")
         c = ConsulClient(a.http.addr)
         assert c.kv_put("persist/key", b"survives") is True
-        c.put("/v1/config", body={"Kind": "service-defaults",
-                                  "Name": "pd", "Protocol": "http"})
+        assert c.put("/v1/config", body={
+            "Kind": "service-defaults", "Name": "pd",
+            "Protocol": "http"}) is not None
     finally:
         a.shutdown()
     b = Agent(load(dev=True, overrides=overrides))
