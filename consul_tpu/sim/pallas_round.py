@@ -276,14 +276,11 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     partial_o[:] = padded
 
 
-def make_run_rounds_pallas(p: SimParams, rounds: int,
-                           interpret: bool = False):
-    """Compiled hot loop using the fused Pallas round kernel.
-
-    Covers the full protocol model including churn, slow-node
-    injection, and stats collection.
-    Requires n divisible by the block size."""
-    n = p.n
+def _build_round(p: SimParams, n: int, interpret: bool = False):
+    """The per-round pallas_call for an n-node (or n-node SLICE)
+    tensor. `p.n` stays the GLOBAL population for the protocol math;
+    `n` only sizes the arrays — that split is what lets the sharded
+    runner reuse the kernel per mesh shard."""
     n_arrays = 10 if _model_arrays(p) else 8
     rows_per_block = ROWS_FULL if n_arrays == 10 else ROWS_STABLE
     block = rows_per_block * LANES
@@ -319,6 +316,64 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         sums = row0[:N_SCALARS]
         stat_sums = row0[N_SCALARS:N_SCALARS + 8]
         return tuple(state_out), sums, stat_sums
+
+    return one_round, rows, n_arrays
+
+
+def _pack(state: SimState, rows: int, n_arrays: int):
+    def to2d(x):
+        return x.reshape(rows, LANES)
+
+    args = (to2d(state.up.astype(jnp.int8)), to2d(state.status),
+            to2d(state.incarnation), to2d(state.informed),
+            to2d(state.susp_start), to2d(state.susp_deadline),
+            to2d(state.susp_conf), to2d(state.local_health))
+    if n_arrays == 10:
+        args = args + (to2d(state.down_time),
+                       to2d(state.slow.astype(jnp.int8)))
+    return args
+
+
+def _unpack(args, state: SimState, n_arrays: int, t_final, rounds,
+            acc_i, acc_lat, p: SimParams) -> SimState:
+    (up, status, inc, informed, s_start, s_dead, s_conf,
+     lh) = args[:8]
+    if n_arrays == 10:
+        down, slow = args[8], args[9]
+        down_flat, slow_flat = down.reshape(-1), slow.reshape(-1) != 0
+    else:
+        down_flat, slow_flat = state.down_time, state.slow
+    st = state.stats
+    if p.collect_stats:
+        st = st._replace(
+            suspicions=st.suspicions + acc_i[0],
+            refutes=st.refutes + acc_i[1],
+            false_positives=st.false_positives + acc_i[2],
+            true_deaths_declared=st.true_deaths_declared + acc_i[3],
+            detect_latency_sum=st.detect_latency_sum + acc_lat,
+            crashes=st.crashes + acc_i[5],
+            rejoins=st.rejoins + acc_i[6],
+            leaves=st.leaves + acc_i[7])
+    return SimState(
+        up=up.reshape(-1) != 0, down_time=down_flat,
+        status=status.reshape(-1), incarnation=inc.reshape(-1),
+        informed=informed.reshape(-1),
+        susp_start=s_start.reshape(-1),
+        susp_deadline=s_dead.reshape(-1),
+        susp_conf=s_conf.reshape(-1),
+        local_health=lh.reshape(-1),
+        slow=slow_flat, t=t_final,
+        round_idx=state.round_idx + rounds, stats=st)
+
+
+def make_run_rounds_pallas(p: SimParams, rounds: int,
+                           interpret: bool = False):
+    """Compiled hot loop using the fused Pallas round kernel.
+
+    Covers the full protocol model including churn, slow-node
+    injection, and stats collection.
+    Requires n divisible by the block size."""
+    one_round, rows, n_arrays = _build_round(p, p.n, interpret)
 
     @jax.jit
     def _run(state: SimState, key: jax.Array) -> SimState:
