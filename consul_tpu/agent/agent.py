@@ -549,11 +549,32 @@ class Agent:
     def _handle_exec(self, payload: bytes, from_node: str) -> bytes:
         """Run a shell command on behalf of `consul exec` (reference:
         agent/remote_exec.go over KV+events; here over gossip queries).
-        Only reachable when enable_remote_exec is set."""
+        Only reachable when enable_remote_exec is set, and the payload
+        must carry a leader-minted nonce bound to this exact command —
+        gossip-pool membership alone must never grant shell access (the
+        reference protects rexec through ACL'd KV writes; see
+        Internal.ExecToken)."""
+        import hashlib
         import subprocess
 
+        import msgpack
+
         try:
-            proc = subprocess.run(payload.decode(), shell=True,
+            req = msgpack.unpackb(payload, raw=False)
+            cmd = req["Cmd"] if isinstance(req, dict) else None
+            nonce = req.get("Nonce", "") if isinstance(req, dict) else ""
+        except Exception:  # noqa: BLE001
+            cmd, nonce = None, ""
+        if not isinstance(cmd, str):
+            return b"rc=-1\nmalformed exec payload"
+        try:
+            self.rpc("Internal.ExecVerify", {
+                "Nonce": nonce,
+                "CmdHash": hashlib.sha256(cmd.encode()).hexdigest()})
+        except Exception as e:  # noqa: BLE001
+            return f"rc=-1\nPermission denied: {e}".encode()
+        try:
+            proc = subprocess.run(cmd, shell=True,
                                   capture_output=True, timeout=30,
                                   text=True)
             out = proc.stdout + proc.stderr

@@ -623,16 +623,27 @@ class HTTPApi:
             # fire a gossip query and collect responses (serf query;
             # carries `consul exec` among others)
             b = jbody()
+            payload = (b.get("Payload") or "").encode()
             if b.get("Name", "").startswith("consul:exec"):
-                # remote COMMAND EXECUTION requires write-level ACL
-                # (the reference gates exec behind KV write on _rexec)
-                rpc("Internal.AgentWrite", {})
+                # remote COMMAND EXECUTION requires write-level ACL.
+                # The token itself never rides the gossip fabric:
+                # Internal.ExecToken (agent:write-gated) mints a
+                # command-hash-bound nonce that target agents verify
+                # with the leader before running anything.
+                import hashlib
+
+                import msgpack as _msgpack
+
+                cmd = b.get("Payload") or ""
+                nonce = rpc("Internal.ExecToken", {
+                    "CmdHash": hashlib.sha256(
+                        cmd.encode()).hexdigest()})["Nonce"]
+                payload = _msgpack.packb({"Cmd": cmd, "Nonce": nonce})
             else:
                 rpc("Internal.AgentRead", {})
             timeout = b.get("Timeout")
             timeout = 3.0 if timeout is None else float(timeout)
-            coll = a.serf.query(b.get("Name", ""),
-                                (b.get("Payload") or "").encode(),
+            coll = a.serf.query(b.get("Name", ""), payload,
                                 timeout=timeout)
             responses = coll.wait(a.serf.memberlist.clock)
             return [{"Node": n, "Payload": p.decode(errors="replace")}

@@ -590,7 +590,10 @@ class RaftNode:
             self._step_down(term)
             self.leader_id = args["leader"]
             idx, sterm = args["last_index"], args["last_term"]
-            if idx <= self.store.snapshot_index:
+            if idx <= self.store.snapshot_index or idx <= self.last_applied:
+                # a snapshot that lags what we've already applied must
+                # not roll the FSM backwards (raft §7: discard stale
+                # InstallSnapshot; re-replication covers the gap)
                 return {"term": self.store.term}
             self.store.log.clear()
             self.store.snapshot_index = 0  # force save to re-point

@@ -17,9 +17,14 @@ import msgpack
 
 class RaftStorage:
     def __init__(self, data_dir: Optional[str] = None,
-                 sync: bool = False) -> None:
+                 sync: Optional[bool] = None) -> None:
         self.data_dir = data_dir
-        self.sync = sync
+        # fsync before acking is the DEFAULT for persistent servers: a
+        # crash that forgets a granted vote can re-vote in the same term
+        # → two leaders per term → committed-entry loss (raft §5.2; the
+        # reference fsyncs stable store and log before acking). Tests
+        # pass sync=False explicitly for speed.
+        self.sync = bool(data_dir) if sync is None else sync
         # log[i] = {"term": t, "data": bytes, "kind": "cmd"|"noop"|"config"}
         # 1-based raft indexing: log entry at raft index i lives at
         # self.log[i - 1 - self.snapshot_index]
@@ -144,6 +149,10 @@ class RaftStorage:
             blob = msgpack.packb({"_trunc": index - 1})
             self._wal.write(struct.pack(">I", len(blob)) + blob)
             self._wal.flush()
+            if self.sync:
+                # a forgotten truncation re-surfaces conflicting entries
+                # after a crash, same durability class as append
+                os.fsync(self._wal.fileno())
 
     def save_snapshot(self, index: int, term: int, data: bytes) -> None:
         """Persist snapshot and compact the log (keep a trailing window)."""

@@ -32,19 +32,30 @@ def register_endpoints(srv) -> None:
         secrets must never be replicated/persisted."""
         return {k: v for k, v in args.items() if k != "AuthToken"}
 
+    def leader_exec(name, fn, args):
+        """Run on the leader, or forward the ORIGINAL call — token
+        included — so the leader re-runs the full handler, ACL and all
+        (reference: ForwardRPC rpc.go:637-649). Forwarding
+        pre-authorized raft payloads instead would let any node on the
+        RPC port submit arbitrary commands with no ACL enforcement."""
+        if not srv.is_leader():
+            return srv._forward_to_leader(name, args)
+        return fn(args)
+
     def primary_owned(name, fn):
         """Register a write endpoint for a PRIMARY-owned table (ACL,
         config entries, intentions): in a secondary DC the write
         forwards to the primary (leader_acl.go: secondaries are
         read-only replicas of these tables) and replication mirrors it
-        back."""
+        back. Within the owning DC the write executes on the leader,
+        which re-runs ACL (leader_exec)."""
 
         def wrapper(args):
             pdc = srv.config.primary_datacenter
             if pdc and pdc != srv.config.datacenter:
                 return srv._forward_dc(name, {**args,
                                               "Datacenter": pdc}, pdc)
-            return fn(args)
+            return leader_exec(name, fn, args)
 
         e[name] = wrapper
 
@@ -60,6 +71,11 @@ def register_endpoints(srv) -> None:
 
         e[name] = wrapper
 
+    def write(name, fn):
+        """Register a write endpoint: executes on the leader via
+        leader_exec (which see)."""
+        e[name] = lambda args: leader_exec(name, fn, args)
+
     # ----------------------------------------------------------- Status
     def status_leader(args):
         return srv.leader_rpc_addr() or ""
@@ -71,18 +87,6 @@ def register_endpoints(srv) -> None:
     e["Status.Peers"] = status_peers
     e["Status.Ping"] = lambda args: "pong"
     read("Status.RaftStats", lambda args: srv.raft.stats())
-
-    # --------------------------------------------------------- Internal
-    def internal_apply(args):
-        """Leader-side landing pad for forwarded writes."""
-        if not srv.is_leader():
-            raise RPCError("not leader")
-        from consul_tpu.state.fsm import encode_command
-
-        return srv.raft.apply(encode_command(
-            MessageType(args["Type"]), args["Body"]))
-
-    e["Internal.Apply"] = internal_apply
 
     # ---------------------------------------------------------- Catalog
     def catalog_register(args):
@@ -154,8 +158,8 @@ def register_endpoints(srv) -> None:
                 "Services": {s.id: s.to_dict()
                              for s in state.node_services(node)}}})
 
-    e["Catalog.Register"] = catalog_register
-    e["Catalog.Deregister"] = catalog_deregister
+    write("Catalog.Register", catalog_register)
+    write("Catalog.Deregister", catalog_deregister)
     read("Catalog.ListNodes", catalog_list_nodes)
     read("Catalog.ListServices", catalog_list_services)
     read("Catalog.ServiceNodes", catalog_service_nodes)
@@ -199,23 +203,35 @@ def register_endpoints(srv) -> None:
                     lookup(svc, tag, passing_only=passing),
                     near, lambda e: e["Node"]["Node"])})
 
+    def _check_visible(az, c) -> bool:
+        """aclFilter for health checks (reference filterACL on
+        HealthCheck lists): node checks need node:read, service checks
+        additionally service:read."""
+        if not az.node_read(c.node):
+            return False
+        return not c.service_name or az.service_read(c.service_name)
+
     def health_node_checks(args):
         node = args.get("Node", "")
+        az = authz(args)
         return srv.blocking_query(args, ("checks",), lambda: {
-            "HealthChecks": [c.to_dict()
-                             for c in state.node_checks(node)]})
+            "HealthChecks": [c.to_dict() for c in state.node_checks(node)
+                             if _check_visible(az, c)]})
 
     def health_service_checks(args):
         svc = args.get("ServiceName", "")
+        az = authz(args)
         return srv.blocking_query(args, ("checks",), lambda: {
-            "HealthChecks": [c.to_dict()
-                             for c in state.service_checks(svc)]})
+            "HealthChecks": [c.to_dict() for c in state.service_checks(svc)
+                             if _check_visible(az, c)]})
 
     def health_checks_in_state(args):
         status = args.get("State", "any")
+        az = authz(args)
         return srv.blocking_query(args, ("checks",), lambda: {
             "HealthChecks": [c.to_dict()
-                             for c in state.checks_in_state(status)]})
+                             for c in state.checks_in_state(status)
+                             if _check_visible(az, c)]})
 
     read("Health.ServiceNodes", health_service_nodes)
     read("Health.NodeChecks", health_node_checks)
@@ -262,7 +278,7 @@ def register_endpoints(srv) -> None:
                                             args.get("Separator", "")))
                      if az.key_read(k)]})
 
-    e["KVS.Apply"] = kv_apply
+    write("KVS.Apply", kv_apply)
     read("KVS.Get", kv_get)
     read("KVS.List", kv_list)
     read("KVS.ListKeys", kv_keys)
@@ -283,14 +299,17 @@ def register_endpoints(srv) -> None:
 
     def session_get(args):
         sid = args.get("SessionID", "")
+        az = authz(args)
         return srv.blocking_query(args, ("sessions",), lambda: {
             "Sessions": [s.to_dict()]
-            if (s := state.session_get(sid)) else []})
+            if (s := state.session_get(sid)) and az.session_read(s.node)
+            else []})
 
     def session_list(args):
+        az = authz(args)
         return srv.blocking_query(args, ("sessions",), lambda: {
             "Sessions": [s.to_dict() for s in state.session_list(
-                args.get("Node"))]})
+                args.get("Node")) if az.session_read(s.node)]})
 
     def session_renew(args):
         sid = args.get("SessionID", "")
@@ -301,7 +320,7 @@ def register_endpoints(srv) -> None:
         s = state.session_get(sid)
         return {"Sessions": [s.to_dict()] if s else []}
 
-    e["Session.Apply"] = session_apply
+    write("Session.Apply", session_apply)
     read("Session.Get", session_get)
     read("Session.List", session_list)
     e["Session.Renew"] = session_renew
@@ -315,11 +334,14 @@ def register_endpoints(srv) -> None:
         return True
 
     def coordinate_list(args):
+        az = authz(args)
         return srv.blocking_query(args, ("coordinates",), lambda: {
-            "Coordinates": state.coordinates()})
+            "Coordinates": [c for c in state.coordinates()
+                            if az.node_read(c.get("Node", ""))]})
 
     def coordinate_node(args):
         node = args.get("Node", "")
+        require(authz(args).node_read(node), f"node read on {node!r}")
         return srv.blocking_query(args, ("coordinates",), lambda: {
             "Coordinates": [c] if (c := state.coordinate_get(node)) else []})
 
@@ -339,7 +361,7 @@ def register_endpoints(srv) -> None:
                 require(az.key_write(key), f"key write on {key!r}")
         return srv.forward_or_apply(MessageType.TXN, clean(args))
 
-    e["Txn.Apply"] = txn_apply
+    write("Txn.Apply", txn_apply)
 
     # ---------------------------------------------------------- Snapshot
     def snapshot_save(args):
@@ -364,7 +386,7 @@ def register_endpoints(srv) -> None:
         return meta
 
     e["Snapshot.Save"] = snapshot_save
-    e["Snapshot.Restore"] = snapshot_restore
+    write("Snapshot.Restore", snapshot_restore)
 
     # ----------------------------------------------------------- Keyring
     def keyring_op(args):
@@ -666,7 +688,7 @@ def register_endpoints(srv) -> None:
     read("ACL.RoleRead", acl_role_read)
     read("ACL.RoleList", acl_role_list)
 
-    e["ACL.Bootstrap"] = acl_bootstrap
+    write("ACL.Bootstrap", acl_bootstrap)
     primary_owned("ACL.TokenSet", acl_token_set)
     primary_owned("ACL.TokenDelete", acl_token_delete)
     read("ACL.TokenRead", acl_token_read)
@@ -877,13 +899,13 @@ def register_endpoints(srv) -> None:
             "MaxQueryTime": args.get("MaxQueryTime", 0) or 30.0},
             timeout=120.0)
 
-    e["Peering.GenerateToken"] = peering_generate_token
-    e["Peering.Establish"] = peering_establish
-    e["Peering.Delete"] = peering_delete
+    write("Peering.GenerateToken", peering_generate_token)
+    write("Peering.Establish", peering_establish)
+    write("Peering.Delete", peering_delete)
     # reads of the peering table go through the leader so a token minted
     # moments ago is always visible (no stale-follower rejections)
     read("Peering.List", peering_list)
-    read("PeerStream.Open", peer_stream_open)
+    write("PeerStream.Open", peer_stream_open)
     read("PeerStream.Query", peer_stream_query)
     read("Health.ServiceNodesPeer", health_service_peer)
 
@@ -1040,7 +1062,7 @@ def register_endpoints(srv) -> None:
                 "DNS": q.get("DNS") or {}, "Failovers": 0,
                 "Datacenter": srv.config.datacenter}
 
-    e["PreparedQuery.Apply"] = pq_apply
+    write("PreparedQuery.Apply", pq_apply)
     read("PreparedQuery.Get", pq_get)
     read("PreparedQuery.List", pq_list)
     read("PreparedQuery.Execute", pq_execute)
@@ -1220,6 +1242,39 @@ def register_endpoints(srv) -> None:
     e["Internal.AgentWrite"] = agent_write_check
     e["Internal.ServiceWrite"] = service_write_check
 
+    # ------------------------------------------------------- remote exec
+    # `consul exec` authorization: the originator trades its ACL token
+    # for a leader-minted nonce BOUND TO THE COMMAND HASH; only the
+    # nonce rides the gossip fabric (the reference likewise never
+    # gossips tokens — rexec is gated through ACL'd KV writes,
+    # agent/remote_exec.go). Target agents verify the nonce with the
+    # leader before running anything. Replaying the nonce can only
+    # re-run the SAME command within its 60s window.
+    def exec_token(args):
+        require(authz(args).agent_write(), "agent write")
+        import os as os_mod
+        import time as time_mod
+
+        now = time_mod.time()
+        srv._exec_nonces = {
+            n: v for n, v in getattr(srv, "_exec_nonces", {}).items()
+            if v[1] > now}
+        nonce = os_mod.urandom(16).hex()
+        srv._exec_nonces[nonce] = (args.get("CmdHash", ""), now + 60.0)
+        return {"Nonce": nonce}
+
+    def exec_verify(args):
+        import time as time_mod
+
+        v = getattr(srv, "_exec_nonces", {}).get(args.get("Nonce", ""))
+        if v is None or v[0] != args.get("CmdHash", "") \
+                or time_mod.time() > v[1]:
+            raise RPCError("Permission denied: invalid exec nonce")
+        return True
+
+    write("Internal.ExecToken", exec_token)
+    write("Internal.ExecVerify", exec_verify)
+
     # --------------------------------------------- federation states
     def federation_state_apply(args):
         """Each DC's leader upserts its mesh-gateway list here; in a
@@ -1345,7 +1400,7 @@ def register_endpoints(srv) -> None:
 
     e["Operator.RaftRemovePeer"] = raft_remove_peer
     read("Operator.AutopilotGetConfiguration", autopilot_get_config)
-    e["Operator.AutopilotSetConfiguration"] = autopilot_set_config
+    write("Operator.AutopilotSetConfiguration", autopilot_set_config)
     read("Operator.AutopilotState", autopilot_state)
     e["Catalog.ListDatacenters"] = lambda args: srv.datacenters()
 

@@ -37,6 +37,39 @@ class RPCError(Exception):
     """Application-level error returned by a remote handler."""
 
 
+def keyring_raft_auth(get_keyring):
+    """(signer, verifier) pair deriving raft-RPC authentication from the
+    LIVE gossip keyring (get_keyring is a zero-arg callable — the ring
+    Keyring.Op mutates, so key rotation takes effect mid-flight): each
+    raft frame carries an HMAC-SHA256 over its msgpack body, keyed by
+    the primary gossip key; any installed key verifies. Without it,
+    anyone who can reach the RPC port could forge request_vote/
+    append_entries. The reference reaches the same end by restricting
+    the RaftLayer to mTLS server certs; with verify_incoming set we
+    ALSO require mTLS — the HMAC covers the common posture where gossip
+    encryption is on but TLS is not. Pass get_keyring=None when
+    encryption is off: returns (None, None) — an unencrypted, non-TLS
+    cluster trusts its network, as in the reference. Note the signed
+    framing is not wire-compatible with unsigned peers: every server in
+    an encrypted cluster must agree on encryption being on (same as the
+    gossip layer itself)."""
+    if get_keyring is None:
+        return None, None
+    import hmac as hmac_mod
+
+    def sign(body: bytes) -> bytes:
+        key = get_keyring().keys[0]
+        return hmac_mod.new(key, body, "sha256").digest()
+
+    def verify(body: bytes, sig: bytes) -> bool:
+        return any(
+            hmac_mod.compare_digest(
+                hmac_mod.new(k, body, "sha256").digest(), sig)
+            for k in get_keyring().keys)
+
+    return sign, verify
+
+
 def read_frame(sock: socket.socket) -> Optional[dict[str, Any]]:
     hdr = _read_exact(sock, 4)
     if hdr is None:
@@ -120,6 +153,7 @@ class RPCServer:
 
         self.tls_context = None  # server ctx; set via set_tls()
         self.require_tls = False  # verify_incoming: refuse plaintext
+        self.raft_verify = None  # keyring_raft_auth verifier, if any
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(
@@ -164,6 +198,17 @@ class RPCServer:
             if req is None:
                 return
             try:
+                if self.raft_verify is not None:
+                    body, sig = req.get("b"), req.get("sig")
+                    if not (isinstance(body, bytes)
+                            and isinstance(sig, bytes)
+                            and self.raft_verify(body, sig)):
+                        self.log.warning(
+                            "unauthenticated raft RPC from %s refused",
+                            src)
+                        write_frame(sock, {"error": "raft auth failed"})
+                        return
+                    req = msgpack.unpackb(body, raw=False)
                 reply = self._raft_handler(req["method"], src,
                                            req.get("args") or {})
                 write_frame(sock, {"result": reply})
@@ -207,6 +252,7 @@ class ConnPool:
         self.max_per_addr = max_per_addr
         self.connect_timeout = connect_timeout
         self.tls_context = tls_context  # client ctx for RPC_TLS dials
+        self.raft_sign = None  # keyring_raft_auth signer, if any
         self._idle: dict[str, list[_Conn]] = {}
         self._lock = threading.Lock()
         self.log = log.named("rpc.pool")
@@ -253,7 +299,11 @@ class ConnPool:
                      self.tls_context)
         try:
             conn.sock.settimeout(timeout)
-            write_frame(conn.sock, {"method": method, "args": args})
+            frame = {"method": method, "args": args}
+            if self.raft_sign is not None:
+                body = msgpack.packb(frame, use_bin_type=True)
+                frame = {"b": body, "sig": self.raft_sign(body)}
+            write_frame(conn.sock, frame)
             resp = read_frame(conn.sock)
             if resp is None:
                 raise ConnectionError(f"connection closed by {addr}")

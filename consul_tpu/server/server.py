@@ -80,6 +80,18 @@ class Server:
                 # internal addresses are IPs, not cert DNS names
                 ctx.check_hostname = False
                 self.pool.tls_context = ctx
+        # raft-RPC authentication rides the LIVE gossip keyring (see
+        # keyring_raft_auth): forged votes/appends from non-members are
+        # refused even without TLS, and Keyring.Op rotations keep
+        # verifying (the lambda reads serf's ring at call time; serf is
+        # created a few lines below, before any raft traffic flows)
+        from consul_tpu.server.rpc import keyring_raft_auth
+
+        sign, verify = keyring_raft_auth(
+            (lambda: self.serf.memberlist.keyring)
+            if config.encrypt_key else None)
+        self.pool.raft_sign = sign
+        self.rpc.raft_verify = verify
         self.raft_transport = PooledRaftTransport(self.rpc.addr, self.pool)
 
         data_dir = None
@@ -310,12 +322,17 @@ class Server:
 
     def forward_or_apply(self, msg_type: MessageType,
                          body: dict[str, Any]) -> Any:
-        """The write path (§3.3): leader applies via raft; followers
-        forward to the leader (ForwardRPC, rpc.go:637-649)."""
-        if self.is_leader():
-            return self.raft.apply(encode_command(msg_type, body))
-        return self._forward_to_leader(
-            f"Internal.Apply", {"Type": int(msg_type), "Body": body})
+        """The write path (§3.3): raft apply, leader-only. Follower
+        forwarding happens at the ENDPOINT layer (endpoints.write():
+        the original call — token included — re-runs on the leader, so
+        ACL enforcement and the raft apply are inseparable). A raw
+        "apply this command" RPC must never exist: it would let any
+        client on the RPC port bypass ACLs. If leadership is lost
+        between the endpoint wrapper and this call, the retryable
+        "not leader" error sends the client back through forwarding."""
+        if not self.is_leader():
+            raise RPCError("not leader")
+        return self.raft.apply(encode_command(msg_type, body))
 
     def _forward_to_leader(self, method: str, args: dict[str, Any],
                            retries: int = 5) -> Any:

@@ -1,0 +1,187 @@
+"""RPC-surface security: the round-2 hardening.
+
+Three properties (advisor round-1 findings):
+  1. There is NO raw "apply this raft command" RPC — forwarded writes
+     re-execute the original endpoint (ACL included) on the leader
+     (reference: ForwardRPC rpc.go:637-649 re-runs endpoints).
+  2. A follower-forwarded write is still ACL-checked: the token rides
+     with the forwarded call and the leader enforces it.
+  3. With gossip encryption on, raft RPCs require a keyring HMAC —
+     an outsider reaching the RPC port cannot forge votes/appends.
+"""
+
+import time
+
+import pytest
+
+from consul_tpu.config import load
+from consul_tpu.server import Server
+from consul_tpu.server.rpc import ConnPool, RPCError
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture
+def acl_cluster():
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"sec{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True,
+            "acl": {"enabled": True, "default_policy": "deny",
+                    "tokens": {"initial_management": "root-secret"}}})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    for s in servers[1:]:
+        assert s.join([servers[0].serf.memberlist.transport.addr]) == 1
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="leader election")
+    wait_for(lambda: leader.state.raw_get("acl_tokens", "root-secret")
+             is not None, what="management token seeded")
+    yield servers, leader
+    for s in servers:
+        s.shutdown()
+
+
+def test_no_raw_apply_rpc(acl_cluster):
+    """The round-1 Internal.Apply landing pad accepted arbitrary raft
+    commands from any client — e.g. minting a management token without
+    acl:write. It must not exist."""
+    servers, leader = acl_cluster
+    pool = ConnPool()
+    forged_token = {"SecretID": "stolen", "AccessorID": "stolen",
+                    "Management": True}
+    with pytest.raises(RPCError, match="unknown RPC method"):
+        pool.call(leader.rpc.addr, "Internal.Apply",
+                  {"Type": 5, "Body": {"Op": "set", "Token": forged_token}})
+    assert leader.state.raw_get("acl_tokens", "stolen") is None
+    pool.close()
+
+
+def test_follower_forwarded_write_is_acl_checked(acl_cluster):
+    """Writes through a FOLLOWER's RPC port forward the original call;
+    the leader re-runs the ACL check — no token, no write."""
+    servers, leader = acl_cluster
+    follower = next(s for s in servers if s is not leader)
+    pool = ConnPool()
+    put = {"Op": "set", "DirEnt": {"Key": "sec/x", "Value": b"v"}}
+    with pytest.raises(RPCError, match="Permission denied"):
+        pool.call(follower.rpc.addr, "KVS.Apply", put)
+    assert leader.state.kv_get("sec/x") is None
+    # the same write with the management token lands
+    pool.call(follower.rpc.addr, "KVS.Apply",
+              {**put, "AuthToken": "root-secret"})
+    wait_for(lambda: leader.state.kv_get("sec/x") is not None,
+             what="authorized write applied")
+    pool.close()
+
+
+def test_raft_rpc_requires_keyring_hmac():
+    """With gossip encryption on, an unsigned raft RPC is refused — a
+    forged request_vote with a huge term must not disturb the node."""
+    import base64
+    import os as os_mod
+
+    key = base64.b64encode(os_mod.urandom(32)).decode()
+    cfg = load(dev=True, overrides={
+        "node_name": "enc0", "server": True, "bootstrap": True,
+        "encrypt": key})
+    srv = Server(cfg)
+    srv.start()
+    try:
+        wait_for(srv.is_leader, what="single-node leadership")
+        term_before = srv.raft.store.term
+        pool = ConnPool()  # no raft_sign: an outsider's pool
+        with pytest.raises(ConnectionError, match="raft auth failed"):
+            pool.raft_call(srv.rpc.addr, "request_vote", {
+                "term": term_before + 100, "candidate": "evil",
+                "last_log_index": 10**9, "last_log_term": 10**9})
+        assert srv.raft.store.term == term_before
+        assert srv.is_leader()
+        pool.close()
+    finally:
+        srv.shutdown()
+
+
+def test_encrypted_cluster_still_forms():
+    """Signed raft traffic between keyring members works end to end."""
+    import base64
+    import os as os_mod
+
+    key = base64.b64encode(os_mod.urandom(32)).decode()
+    servers = []
+    for i in range(2):
+        cfg = load(dev=True, overrides={
+            "node_name": f"enc{i}", "bootstrap": False,
+            "bootstrap_expect": 2, "server": True, "encrypt": key})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    try:
+        assert servers[1].join(
+            [servers[0].serf.memberlist.transport.addr]) == 1
+        leader = wait_for(
+            lambda: next((s for s in servers if s.is_leader()), None),
+            what="leader election (encrypted)")
+        wait_for(lambda: len(leader.raft.peers) == 2, what="2 raft peers")
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_remote_exec_requires_nonce():
+    """A gossip member cannot shell into agents: the exec payload must
+    carry a leader-minted nonce bound to the exact command, and minting
+    one requires agent:write. ACL tokens never ride the gossip fabric
+    (reference protects rexec via ACL'd KV writes)."""
+    import hashlib
+
+    import msgpack
+
+    from consul_tpu.agent import Agent
+
+    cfg = load(dev=True, overrides={
+        "node_name": "exec-agent", "enable_remote_exec": True,
+        "acl": {"enabled": True, "default_policy": "deny",
+                "tokens": {"initial_management": "root-secret"}}})
+    a = Agent(cfg)
+    a.start(serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader() and a.server.state.raw_get(
+            "acl_tokens", "root-secret") is not None,
+            what="acl bootstrap")
+        # raw payload (no nonce envelope): refused
+        out = a._handle_exec(b"echo pwned", "attacker")
+        assert out.startswith(b"rc=-1")
+        # nonce-less structured payload: refused
+        out = a._handle_exec(
+            msgpack.packb({"Cmd": "echo pwned", "Nonce": ""}), "attacker")
+        assert b"Permission denied" in out
+        # minting a nonce requires agent:write
+        with pytest.raises(RPCError, match="Permission denied"):
+            a.rpc("Internal.ExecToken",
+                  {"AuthToken": "", "CmdHash": "x"})
+        # the authorized path: mint a command-bound nonce, then run
+        h = hashlib.sha256(b"echo ok").hexdigest()
+        nonce = a.rpc("Internal.ExecToken", {
+            "AuthToken": "root-secret", "CmdHash": h})["Nonce"]
+        out = a._handle_exec(
+            msgpack.packb({"Cmd": "echo ok", "Nonce": nonce}), "operator")
+        assert out.startswith(b"rc=0") and b"ok" in out
+        # the nonce authorizes ONLY that command
+        out = a._handle_exec(
+            msgpack.packb({"Cmd": "echo pwned", "Nonce": nonce}),
+            "attacker")
+        assert b"Permission denied" in out
+    finally:
+        a.shutdown()
