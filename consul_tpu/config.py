@@ -157,6 +157,10 @@ class RuntimeConfig:
     default_query_time: float = 300.0
     max_query_time: float = 600.0
 
+    # KV tombstone GC window (reference: config.go:561-562 TombstoneTTL;
+    # tombstones live between ttl and 2*ttl before the leader reaps)
+    tombstone_ttl: float = 900.0
+
     # Anti-entropy (reference: agent/ae/ae.go:57)
     sync_coalesce_timeout: float = 0.2
 
@@ -249,6 +253,7 @@ _CONFIG_ALIASES = {
     "acl_default_policy": "acl_default_policy",
     "domain": "dns_domain",
     "enable_remote_exec": "enable_remote_exec",
+    "tombstone_ttl": "tombstone_ttl",
 }
 
 class ConfigError(Exception):

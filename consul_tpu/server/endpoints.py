@@ -256,24 +256,31 @@ def register_endpoints(srv) -> None:
         args = {k: v for k, v in args.items() if k != "AuthToken"}
         return srv.forward_or_apply(MessageType.KVS, args)
 
+    # KV reads return PER-PREFIX indexes (kv_prefix_index): a watcher
+    # of one key/prefix re-blocks through writes elsewhere in the
+    # keyspace instead of waking its client (memdb radix subtree index)
     def kv_get(args):
         key = args.get("Key", "")
         require(authz(args).key_read(key), f"key read on {key!r}")
         return srv.blocking_query(args, ("kv",), lambda: {
+            "Index": state.kv_key_index(key),
             "Entries": [e_.to_dict()] if (e_ := state.kv_get(key)) else []})
 
     def kv_list(args):
         prefix = args.get("Key", "")
         az = authz(args)
         return srv.blocking_query(args, ("kv",), lambda: {
+            "Index": state.kv_prefix_index(prefix),
             "Entries": [x.to_dict() for x in state.kv_list(prefix)
                         if az.key_read(x.key)]})
 
     def kv_keys(args):
         az = authz(args)
+        prefix = args.get("Prefix", "")
         return srv.blocking_query(args, ("kv",), lambda: {
+            "Index": state.kv_prefix_index(prefix),
             "Keys": [k for k in
-                     state.kv_keys(args.get("Prefix", ""),
+                     state.kv_keys(prefix,
                                    args.get("Seperator",
                                             args.get("Separator", "")))
                      if az.key_read(k)]})

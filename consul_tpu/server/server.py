@@ -213,6 +213,7 @@ class Server:
         self._every(self.config.reconcile_interval, self._full_reconcile)
         self._every(self.config.coordinate_update_period, self._flush_coords)
         self._every(10.0, self._usage_metrics)
+        self._every(self.config.tombstone_ttl, self._reap_tombstones)
         self.log.info("server started: rpc=%s serf=%s", self.rpc.addr,
                       self.serf.memberlist.transport.addr)
 
@@ -362,7 +363,14 @@ class Server:
     def blocking_query(self, args: dict[str, Any], tables: tuple[str, ...],
                        run) -> dict[str, Any]:
         """agent/blockingquery/blockingquery.go:117 — run the query; if
-        index <= MinQueryIndex, wait for a change and re-run."""
+        index <= MinQueryIndex, wait for a change and re-run.
+
+        A query fn may return its own "Index" (e.g. a per-prefix KV
+        index from kv_prefix_index): the loop then keeps waiting until
+        THAT index moves, so a watch on one prefix sleeps through
+        writes elsewhere in the table (memdb radix subtree semantics).
+        The wait itself always rides the table WatchSet: we park until
+        the table moves past the snapshot we just read."""
         min_index = int(args.get("MinQueryIndex") or 0)
         max_time = min(float(args.get("MaxQueryTime")
                              or self.config.default_query_time),
@@ -371,12 +379,15 @@ class Server:
         while True:
             idx = self.state.table_index(*tables)
             result = run()
-            if idx > min_index or min_index == 0:
-                return {"Index": max(idx, 1), **result}
+            ridx = result.pop("Index", idx)
+            if ridx > min_index or min_index == 0:
+                return {"Index": max(ridx, 1), **result}
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                return {"Index": max(idx, 1), **result}
-            self.state.block_until(tables, min_index,
+                return {"Index": max(ridx, 1), **result}
+            # wait past the TABLE snapshot (idx), not min_index: with a
+            # per-result index the table may already be far ahead
+            self.state.block_until(tables, idx,
                                    min(remaining, 1.0))
 
     # ----------------------------------------------------- serf event plane
@@ -425,6 +436,26 @@ class Server:
         # on first AppendEntries contact.
 
     # --------------------------------------------------------- leader loops
+
+    def _reap_tombstones(self) -> None:
+        """Leader-driven KV tombstone GC: reap (via raft, so replicas
+        stay identical) everything older than the previous pass.
+        Tombstones therefore live between ttl and 2*ttl (the reference's
+        TombstoneGC granularity behaves the same way)."""
+        if not self.is_leader():
+            return
+        cutoff = getattr(self, "_tombstone_cutoff", 0)
+        self._tombstone_cutoff = self.state.index
+        # ship the KEY LIST, not the index cutoff: replica store
+        # counters drift after snapshot restores, the key set does not
+        keys = [k for k, i in self.state._kv_tombstones.items()
+                if i <= cutoff] if cutoff else []
+        if keys:
+            try:
+                self.forward_or_apply(MessageType.TOMBSTONE_REAP,
+                                      {"Keys": keys})
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("tombstone reap failed: %s", e)
 
     def _leader_tick(self) -> None:
         """Leader duties (leader.go leaderLoop): raft membership from serf,

@@ -41,6 +41,7 @@ class MessageType(enum.IntEnum):
     ACL_AUTH_METHOD = 16
     ACL_BINDING_RULE = 17
     FEDERATION_STATE = 18
+    TOMBSTONE_REAP = 19  # leader-driven KV tombstone GC (Tombstone.Reap)
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -70,6 +71,7 @@ class FSM:
             MessageType.ACL_AUTH_METHOD: self._apply_acl_auth_method,
             MessageType.ACL_BINDING_RULE: self._apply_acl_binding_rule,
             MessageType.FEDERATION_STATE: self._apply_federation_state,
+            MessageType.TOMBSTONE_REAP: self._apply_tombstone_reap,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -228,6 +230,13 @@ class FSM:
                 elif verb == "get":
                     out.append({"KV": cur.to_dict() if cur else None})
             return {"Results": out, "Errors": None}
+
+    def _apply_tombstone_reap(self, b: dict[str, Any], idx: int) -> Any:
+        """Reap the leader-chosen tombstone keys on every replica
+        identically (the reference routes tombstone GC through raft the
+        same way — a local timer-based reap would desync follower
+        prefix indexes)."""
+        return self.store.kv_reap_tombstones(list(b.get("Keys") or []))
 
     def _apply_snapshot_restore(self, b: dict[str, Any], idx: int) -> Any:
         """Operator restore: replace the whole store (snapshot_endpoint.go

@@ -12,9 +12,13 @@ Tables (subset of the reference's ~32, the serving core):
   sessions                  — session/lock machinery
   coordinates               — Vivaldi coordinates
 
-Concurrency: one RWLock-ish mutex; watchers wait on a Condition that
-fires on every commit and re-check their tables' indexes (bounded
-thundering herd — fine at this scale, mirrors memdb WatchSet wakeups).
+Concurrency: one RWLock-ish mutex; watchers register per-table WatchSet
+events (memdb WatchSet semantics, SURVEY §3.2): a commit wakes ONLY the
+watchers of the touched tables — a KV watcher sleeps through catalog
+churn. KV deletions leave tombstones so prefix watchers see a
+monotonic, per-prefix X-Consul-Index; a leader-driven raft command
+reaps them after tombstone_ttl (state_store.go tombstone GC,
+config.go:561-562).
 """
 
 from __future__ import annotations
@@ -40,13 +44,18 @@ TABLES = ("nodes", "services", "checks", "kv", "sessions",
 class StateStore:
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._cv = threading.Condition(self._lock)
         self._index = 0
         # nodes[name] = Node; services[(node, svc_id)] = NodeService;
         # checks[(node, check_id)] = HealthCheck; kv[key] = KVEntry;
         # sessions[id] = Session; coordinates[node] = Coordinate dict
         self.tables: dict[str, dict[Any, Any]] = {t: {} for t in TABLES}
         self._table_index: dict[str, int] = {t: 0 for t in TABLES}
+        # per-table WatchSets: block_until registers an Event under each
+        # watched table; _bump fires only the touched tables' events
+        self._watchers: dict[str, set[threading.Event]] = {
+            t: set() for t in TABLES}
+        # kv tombstones: key -> deletion index (reaped via raft)
+        self._kv_tombstones: dict[str, int] = {}
         # change hooks (the stream publisher seam — event streaming feeds
         # from here like catalog_events.go feeds the EventPublisher)
         self._change_hooks: list[Callable[[str, int], None]] = []
@@ -67,9 +76,12 @@ class StateStore:
 
     def _bump(self, *tables: str) -> int:
         self._index += 1
+        fired: set[threading.Event] = set()
         for t in tables:
             self._table_index[t] = self._index
-        self._cv.notify_all()
+            fired |= self._watchers[t]
+        for ev in fired:
+            ev.set()
         for fn in self._change_hooks:
             try:
                 fn(",".join(tables), self._index)
@@ -80,24 +92,36 @@ class StateStore:
     def block_until(self, tables: Iterable[str], min_index: int,
                     timeout: float) -> int:
         """Wait until any of `tables` moves past min_index (or timeout).
-        Returns the current max index over the tables.
+        Returns the current max index over the tables. Scoped: commits
+        to OTHER tables never wake this waiter (memdb WatchSet).
 
-        Real-time only: Condition waits can't ride the SimClock, so
+        Real-time only: Event waits can't ride the SimClock, so
         deterministic tests drive this with short timeouts."""
         import time as _time
 
         tables = tuple(tables)
         end = _time.monotonic() + timeout
-        with self._lock:
+        ev = threading.Event()
+        try:
             while True:
-                cur = max((self._table_index[t] for t in tables),
-                          default=self._index)
-                if cur > min_index:
-                    return cur
+                with self._lock:
+                    cur = max((self._table_index[t] for t in tables),
+                              default=self._index)
+                    if cur > min_index:
+                        return cur
+                    # register BEFORE releasing the lock: a commit that
+                    # lands between the check and the wait still fires ev
+                    for t in tables:
+                        self._watchers[t].add(ev)
                 remaining = end - _time.monotonic()
                 if remaining <= 0:
                     return cur
-                self._cv.wait(remaining)
+                ev.wait(remaining)
+                ev.clear()
+        finally:
+            with self._lock:
+                for t in tables:
+                    self._watchers[t].discard(ev)
 
     # ---------------------------------------------------------------- catalog
 
@@ -438,7 +462,50 @@ class StateStore:
                 return self._index, True
             for k in victims:
                 del self.tables["kv"][k]
-            return self._bump("kv"), True
+            idx = self._bump("kv")
+            for k in victims:
+                # tombstone: a prefix watcher's X-Consul-Index must move
+                # FORWARD on deletion even though the live entries'
+                # max(ModifyIndex) just shrank (state_store.go tombstones)
+                self._kv_tombstones[k] = idx
+            return idx, True
+
+    def kv_prefix_index(self, prefix: str) -> int:
+        """Per-prefix result index: max ModifyIndex over live entries
+        and unreaped tombstones under the prefix. This is what makes a
+        watch on one prefix immune to writes elsewhere in the keyspace
+        (go-memdb radix subtree index + tombstones)."""
+        with self._lock:
+            live = max((e.modify_index
+                        for k, e in self.tables["kv"].items()
+                        if k.startswith(prefix)), default=0)
+            dead = max((i for k, i in self._kv_tombstones.items()
+                        if k.startswith(prefix)), default=0)
+            return max(live, dead)
+
+    def kv_key_index(self, key: str) -> int:
+        """Exact-key result index for KVS.Get: the entry's ModifyIndex
+        or its tombstone. A watch on one key must NOT wake for sibling
+        keys that merely share a byte prefix (prefix semantics are for
+        list/keys only, as in the reference)."""
+        with self._lock:
+            e = self.tables["kv"].get(key)
+            return max(e.modify_index if e else 0,
+                       self._kv_tombstones.get(key, 0))
+
+    def kv_reap_tombstones(self, keys: list[str]) -> int:
+        """Drop exactly `keys` from the tombstone table. The leader
+        picks the keys and ships the LIST through raft — index cutoffs
+        would not replicate correctly because store counters drift
+        across replicas after snapshot restores (restore() bumps
+        _index), while the tombstoned key set is identical everywhere
+        (same replicated deletes, snapshots carry tombstones)."""
+        with self._lock:
+            n = 0
+            for k in keys:
+                if self._kv_tombstones.pop(k, None) is not None:
+                    n += 1
+            return n
 
     # --------------------------------------------------------------- sessions
 
@@ -473,6 +540,8 @@ class StateStore:
             if e.session == sid:
                 if sess.behavior == "delete":
                     del self.tables["kv"][k]
+                    # callers _bump right after; that index is this one
+                    self._kv_tombstones[k] = self._index + 1
                 else:
                     e.session = ""
                     e.modify_index = self._index + 1
@@ -554,6 +623,7 @@ class StateStore:
                 "sessions": {k: v.__dict__ for k, v in
                              self.tables["sessions"].items()},
                 "coordinates": dict(self.tables["coordinates"]),
+                "kv_tombstones": dict(self._kv_tombstones),
                 **{t: dict(self.tables[t]) for t in RAW_TABLES},
             }
             return msgpack.packb(blob, use_bin_type=True)
@@ -582,7 +652,10 @@ class StateStore:
             self.tables["coordinates"] = blob.get("coordinates", {})
             for t in RAW_TABLES:
                 self.tables[t] = blob.get(t, {})
-            self._cv.notify_all()
+            self._kv_tombstones = dict(blob.get("kv_tombstones", {}))
+            for watchers in self._watchers.values():
+                for ev in watchers:
+                    ev.set()
             for fn in self._change_hooks:
                 try:
                     fn(",".join(TABLES), self._index)

@@ -208,3 +208,61 @@ def test_snapshot_restore_roundtrip(fsm):
 
 def test_unknown_command_ignored(fsm):
     assert fsm.apply(bytes([200]) + b"junk", 1) is None
+
+
+def test_watchset_scoping_no_cross_table_wakeups(fsm):
+    """memdb WatchSet semantics: a kv waiter is NEVER woken by catalog
+    commits — not even transiently (the round-1 global Condition woke
+    every waiter on every commit)."""
+    s = fsm.store
+    idx = s.table_index("kv")
+    t0 = time.monotonic()
+    done = {}
+
+    def waiter():
+        done["idx"] = s.block_until(["kv"], idx, timeout=0.8)
+        done["elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    # hammer unrelated tables while the kv waiter sleeps
+    for i in range(50):
+        register(fsm, node=f"noise{i}", idx=i + 1)
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert done["idx"] == idx           # nothing on kv moved
+    assert done["elapsed"] >= 0.75      # slept the full window
+
+
+def test_kv_tombstones_and_prefix_index(fsm):
+    s = fsm.store
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "set", "DirEnt": {"Key": "a/x", "Value": b"1"}}), 1)
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "set", "DirEnt": {"Key": "b/y", "Value": b"2"}}), 2)
+    a_idx = s.kv_prefix_index("a/")
+    # writes elsewhere don't move this prefix's index
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "set", "DirEnt": {"Key": "b/z", "Value": b"3"}}), 3)
+    assert s.kv_prefix_index("a/") == a_idx
+    # deletion moves it FORWARD via a tombstone
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "delete", "DirEnt": {"Key": "a/x"}}), 4)
+    del_idx = s.kv_prefix_index("a/")
+    assert del_idx > a_idx
+    assert "a/x" in s._kv_tombstones
+    # exact-key index: sibling keys sharing a byte prefix do not move it
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "set", "DirEnt": {"Key": "b/yy", "Value": b"sib"}}), 5)
+    assert s.kv_key_index("b/y") < s.kv_prefix_index("b/y")
+    # raft-driven reap ships the key LIST (replica-safe: store counters
+    # drift after restores, key sets do not)
+    fsm.apply(encode_command(MessageType.TOMBSTONE_REAP,
+                             {"Keys": ["a/x"]}), 6)
+    assert "a/x" not in s._kv_tombstones
+    # tombstones survive snapshot/restore (replica consistency)
+    fsm.apply(encode_command(MessageType.KVS, {
+        "Op": "delete", "DirEnt": {"Key": "b/y"}}), 6)
+    clone = FSM()
+    clone.restore(fsm.snapshot())
+    assert "b/y" in clone.store._kv_tombstones
