@@ -1,0 +1,137 @@
+"""KV throughput bench vs BASELINE.md rows 1-5 (bench/results-0.7.1.md:
+3,780 PUT/s p50 15.1ms p99 48.9ms; 7,525 GET/s; 9,774 stale GET/s on a
+4-node DigitalOcean cluster).
+
+Topology mirrors the baseline's shape in-process: 3 servers over real
+loopback TCP (RPC_MUX sessions), concurrent worker threads driving
+PUT / GET / stale-GET through the RPC surface. One JSON line per
+metric on stdout; diagnostics on stderr.
+
+Run: python bench_kv.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import threading
+import time
+
+
+def wait_for(cond, timeout=20.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise RuntimeError(f"timed out: {what}")
+
+
+def run_workload(name, fn, n_threads, n_ops, baseline):
+    """fn(worker_id, op_id) -> None. Returns the metric dict."""
+    lat: list[list[float]] = [[] for _ in range(n_threads)]
+    errors = [0]
+    start_gate = threading.Barrier(n_threads + 1)
+
+    def worker(w):
+        mine = lat[w]
+        start_gate.wait()
+        for i in range(n_ops):
+            t0 = time.perf_counter()
+            try:
+                fn(w, i)
+            except Exception:  # noqa: BLE001
+                errors[0] += 1
+            mine.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(n_threads)]
+    for t in threads:
+        t.start()
+    start_gate.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    all_lat = sorted(x for lane in lat for x in lane)
+    total = len(all_lat)
+    rps = total / wall
+    p50 = statistics.quantiles(all_lat, n=100)[49] * 1e3
+    p99 = statistics.quantiles(all_lat, n=100)[98] * 1e3
+    print(f"  {name}: {rps:,.0f} req/s  p50={p50:.1f}ms p99={p99:.1f}ms "
+          f"({total} ops, {errors[0]} errors, {wall:.1f}s)",
+          file=sys.stderr)
+    return {"metric": name, "value": round(rps, 1), "unit": "req/s",
+            "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+            "errors": errors[0],
+            "vs_baseline": round(rps / baseline, 3)}
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    from consul_tpu.config import load
+    from consul_tpu.server import Server
+    from consul_tpu.server.rpc import ConnPool
+
+    print("building 3-server cluster...", file=sys.stderr)
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"bench{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True})
+        s = Server(cfg)
+        s.start()
+        servers.append(s)
+    for s in servers[1:]:
+        s.join([servers[0].serf.memberlist.transport.addr])
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="leader election")
+    wait_for(lambda: len(leader.raft.peers) == 3, what="3 raft peers")
+    follower = next(s for s in servers if s is not leader)
+
+    n_threads = 16 if quick else 32
+    n_ops = 30 if quick else 120
+    pools = [ConnPool() for _ in range(n_threads)]
+    results = []
+
+    # ---- KV PUT through the leader (replicated writes) ----
+    def put(w, i):
+        pools[w].call(leader.rpc.addr, "KVS.Apply", {
+            "Op": "set",
+            "DirEnt": {"Key": f"bench/{w}/{i}", "Value": b"x" * 64}})
+
+    results.append(run_workload(
+        "kv_put_rps", put, n_threads, n_ops, baseline=3780.0))
+
+    # ---- KV GET, default consistency (leader) ----
+    def get(w, i):
+        pools[w].call(leader.rpc.addr, "KVS.Get",
+                      {"Key": f"bench/{w}/{i % n_ops}"})
+
+    results.append(run_workload(
+        "kv_get_rps", get, n_threads, n_ops * 3, baseline=7525.0))
+
+    # ---- KV GET ?stale from a follower ----
+    def get_stale(w, i):
+        pools[w].call(follower.rpc.addr, "KVS.Get",
+                      {"Key": f"bench/{w}/{i % n_ops}",
+                       "AllowStale": True})
+
+    results.append(run_workload(
+        "kv_get_stale_rps", get_stale, n_threads, n_ops * 3,
+        baseline=9774.0))
+
+    for p in pools:
+        p.close()
+    for s in servers:
+        s.shutdown()
+
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

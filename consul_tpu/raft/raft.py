@@ -5,11 +5,15 @@ KV, sessions — the reference's raftApply path, agent/consul/rpc.go:926).
 Runs against the Clock/scheduler seam (deterministic with SimClock) and
 the RaftTransport seam.
 
-Simplifications vs hashicorp/raft, deliberate for round 1:
-  * RPCs are synchronous calls on the caller's thread (the in-mem
-    transport is instant; the TCP transport blocks its caller);
-  * replication is push-on-heartbeat + push-on-apply;
-  * membership changes are single-server config entries.
+Simplifications vs hashicorp/raft, deliberate:
+  * membership changes are single-server config entries;
+  * under SimClock, RPCs are synchronous calls on the caller's thread
+    (deterministic tests); under a real clock, replication is
+    PIPELINED — one replicator thread per peer streams batched
+    append_entries (up to 512 entries per RPC), so N concurrent
+    apply() callers ride shared RPC rounds instead of each paying a
+    full replication round (hashicorp/raft pipeline/batch semantics,
+    the difference between ~100 and thousands of writes/s).
 """
 
 from __future__ import annotations
@@ -97,6 +101,10 @@ class RaftNode:
         self._last_leader_contact = 0.0
         self._apply_results: dict[int, Any] = {}
         self._leadership_era = 0  # bumps on every role transition
+        # pipelined replication (real clock only): per-peer streamer
+        # threads parked on this condition; apply() just appends+notifies
+        self._repl_cv = threading.Condition(self._lock)
+        self._replicators: dict[str, tuple[int, threading.Thread]] = {}
 
         # restore FSM from snapshot if present
         if self.store.snapshot_data is not None and restore_fn is not None:
@@ -117,6 +125,7 @@ class RaftNode:
                     t.cancel()
             self.store.close()
             self._applied_cv.notify_all()
+            self._repl_cv.notify_all()
 
     # ------------------------------------------------------------- surface
 
@@ -328,6 +337,7 @@ class RaftNode:
         self.role = Role.FOLLOWER
         if was_leader and self._heartbeat_timer is not None:
             self._heartbeat_timer.cancel()
+        self._repl_cv.notify_all()  # parked replicators re-check and exit
         self._reset_election_timer()
 
     # ---------------------------------------------------------- replication
@@ -353,28 +363,71 @@ class RaftNode:
             if self.role != Role.LEADER:
                 return
             peers = [p for p in self.peers if p != self.transport.addr]
-        if isinstance(self.clock, SimClock) or len(peers) <= 1:
+        if isinstance(self.clock, SimClock):
             for peer in peers:
                 self._replicate_one(peer)
-        else:
-            # real mode: per-peer RPCs run concurrently so one dead peer's
-            # connect timeout cannot starve heartbeats to healthy peers
-            threads = [threading.Thread(target=self._replicate_one,
-                                        args=(p,), daemon=True)
-                       for p in peers]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=self.heartbeat_interval * 4)
-        self._advance_commit()
+            self._advance_commit()
+            return
+        # real clock: wake the per-peer replicator threads; the caller
+        # never blocks on network I/O (pipeline semantics)
+        with self._lock:
+            self._ensure_replicators_locked()
+            self._repl_cv.notify_all()
+        if not peers:
+            self._advance_commit()
 
-    def _replicate_one(self, peer: str) -> None:
-        # build args under the lock (one critical section — the log may be
-        # compacted by a concurrent snapshot, so next_index and
-        # first_index must be read together); RPC outside it
+    def _ensure_replicators_locked(self) -> None:
+        era = self._leadership_era
+        for peer in self.peers:
+            if peer == self.transport.addr:
+                continue
+            cur = self._replicators.get(peer)
+            if cur is not None and cur[0] == era and cur[1].is_alive():
+                continue
+            t = threading.Thread(target=self._replicator_loop,
+                                 args=(peer, era), daemon=True,
+                                 name=f"raft-repl-{self.id}-{peer}")
+            self._replicators[peer] = (era, t)
+            t.start()
+
+    def _replicator_loop(self, peer: str, era: int) -> None:
+        """One peer's replication stream: batch whatever the log has
+        accumulated since the last RPC (entries_from caps a round at 512),
+        heartbeat on idle, back off while the peer is unreachable."""
+        import time as _time
+
+        fails = 0
+        while True:
+            with self._lock:
+                if (self._stopped or self.role != Role.LEADER
+                        or self._leadership_era != era
+                        or peer not in self.peers):
+                    return
+                caught_up = self._next_index.get(
+                    peer, 1) > self.store.last_index()
+                if caught_up and fails == 0:
+                    # park until new entries or heartbeat time
+                    self._repl_cv.wait(self.heartbeat_interval)
+                    if (self._stopped or self.role != Role.LEADER
+                            or self._leadership_era != era):
+                        return
+            ok = self._replicate_one(peer)
+            self._advance_commit()
+            if ok:
+                fails = 0
+            else:
+                fails = min(fails + 1, 6)
+                _time.sleep(min(0.05 * (2 ** fails), 1.0))
+
+    def _replicate_one(self, peer: str) -> bool:
+        """One append_entries round to one peer. Returns False only when
+        the peer was unreachable (replicator loops use it to back off).
+        Build args under the lock (one critical section — the log may be
+        compacted by a concurrent snapshot, so next_index and
+        first_index must be read together); RPC outside it."""
         with self._lock:
             if self.role != Role.LEADER:
-                return
+                return True
             term = self.store.term
             nxt = self._next_index.get(peer, self.store.last_index() + 1)
             if nxt < self.store.first_index():
@@ -391,19 +444,18 @@ class RaftNode:
                     "entries": entries, "leader_commit": self.commit_index,
                 }
         if send_snap:
-            self._send_snapshot(peer)
-            return
+            return self._send_snapshot(peer)
         try:
             reply = self.transport.call(peer, "append_entries", args)
         except Exception:  # noqa: BLE001 — peer unreachable
-            return
+            return False
         with self._lock:
             if self._stopped or self.store.term != term \
                     or self.role != Role.LEADER:
-                return
+                return True
             if reply.get("term", 0) > term:
                 self._step_down(reply["term"])
-                return
+                return True
             if reply.get("success"):
                 if entries:
                     match = prev_idx + len(entries)
@@ -415,8 +467,9 @@ class RaftNode:
                 hint = reply.get("conflict_index")
                 self._next_index[peer] = max(
                     1, hint if hint else nxt - 1)
+            return True
 
-    def _send_snapshot(self, peer: str) -> None:
+    def _send_snapshot(self, peer: str) -> bool:
         # prepare under lock, RPC outside it (same discipline as
         # _replicate_one — a blocked install must not freeze the node)
         with self._lock:
@@ -425,7 +478,7 @@ class RaftNode:
                 self._take_snapshot()
                 snap_data = self.store.snapshot_data
             if snap_data is None:
-                return
+                return True
             args = {"term": self.store.term, "leader": self.transport.addr,
                     "last_index": self.store.snapshot_index,
                     "last_term": self.store.snapshot_term,
@@ -433,13 +486,14 @@ class RaftNode:
         try:
             reply = self.transport.call(peer, "install_snapshot", args)
         except Exception:  # noqa: BLE001
-            return
+            return False
         with self._lock:
             if reply.get("term", 0) > self.store.term:
                 self._step_down(reply["term"])
-                return
+                return True
             self._next_index[peer] = self.store.snapshot_index + 1
             self._match_index[peer] = self.store.snapshot_index
+            return True
 
     def _advance_commit(self) -> None:
         with self._lock:

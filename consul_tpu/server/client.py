@@ -80,7 +80,9 @@ class Client:
     def rpc(self, method: str, args: dict[str, Any],
             retries: int = 3) -> Any:
         """Forward to a server; retry on transport errors with another
-        server (router rebalancing-lite)."""
+        server (router rebalancing-lite). Snapshot ops ride the
+        dedicated RPC_SNAPSHOT stream — archives must not squeeze
+        through the request/response frame cap (pool.RPCSnapshot)."""
         last: Exception = NoServersError("no known servers")
         for _ in range(retries):
             server = self._pick_server()
@@ -90,6 +92,12 @@ class Client:
                 if server is None:
                     raise NoServersError("no consul servers in gossip pool")
             try:
+                if method == "Snapshot.Save":
+                    return self.pool.snapshot_save(server, args)
+                if method == "Snapshot.Restore":
+                    a = dict(args)
+                    return self.pool.snapshot_restore(
+                        server, a.pop("Archive", b""), a)
                 return self.pool.call(server, method, args)
             except ConnectionError as e:
                 last = e
