@@ -46,17 +46,22 @@ RPC_SNAPSHOT = 0x05  # dedicated snapshot stream
 MAX_FRAME = 64 * 1024 * 1024
 SNAPSHOT_CHUNK = 1 << 20  # 1MB snapshot stream chunks
 MAX_SNAPSHOT_STREAM = 1 << 30  # 1GB cumulative restore-upload cap
+MAX_MUX_STREAMS = 1024  # concurrent streams per mux session
 
 
 class RPCError(Exception):
     """Application-level error returned by a remote handler."""
 
 
-class StreamTimeout(ConnectionError):
+class StreamTimeout(RPCError):
     """One mux stream timed out. The SESSION is still healthy — other
     streams' responses keep flowing — so the pool must neither tear the
     session down nor blind-retry (the server-side handler may still be
-    running; re-sending a write could execute it twice)."""
+    running; re-sending a write could execute it twice). Deliberately
+    NOT a ConnectionError: every retry loop in the stack
+    (_forward_to_leader, Client.rpc, _forward_dc) treats
+    ConnectionError as safe-to-resend, which a timed-out in-flight
+    write is not."""
 
 
 def keyring_raft_auth(get_keyring):
@@ -229,6 +234,7 @@ class RPCServer:
         ({sid, result|error}) interleave under a write lock. A parked
         blocking query parks a thread, not the connection."""
         wlock = threading.Lock()
+        in_flight = [0]  # yamux-style stream cap (guarded by wlock)
 
         def safe_write(obj: dict[str, Any]) -> None:
             try:
@@ -243,6 +249,19 @@ class RPCServer:
                 return
             sid = req.get("sid", 0)
             method = req.get("method", "")
+            with wlock:
+                if in_flight[0] >= MAX_MUX_STREAMS:
+                    over = True
+                else:
+                    over = False
+                    in_flight[0] += 1
+            if over:
+                # unauthenticated resource exhaustion guard: one conn
+                # must not park unbounded handler threads (yamux caps
+                # streams per session the same way)
+                safe_write({"sid": sid,
+                            "error": "too many concurrent streams"})
+                continue
 
             def run(sid=sid, method=method, args=req.get("args") or {}):
                 start = telemetry.time_now()
@@ -256,6 +275,8 @@ class RPCServer:
                     self.log.warning("rpc %s failed: %s", method, e)
                     safe_write({"sid": sid, "error": f"internal: {e}"})
                 finally:
+                    with wlock:
+                        in_flight[0] -= 1
                     self.metrics.measure_since(
                         "rpc.request", start, {"method": method})
 
@@ -290,9 +311,16 @@ class RPCServer:
                     buf.extend(chunk.get("data") or b"")
                     if len(buf) > MAX_SNAPSHOT_STREAM:
                         # unbounded buffering = OOM from anyone who can
-                        # reach the port (auth runs after upload)
+                        # reach the port (auth runs after upload). Stop
+                        # reading but let the client's in-flight writes
+                        # die without an RST discarding our error frame
+                        # (SHUT_RD keeps the send side deliverable)
                         write_frame(sock, {
                             "error": "snapshot exceeds size limit"})
+                        try:
+                            sock.shutdown(socket.SHUT_RD)
+                        except OSError:
+                            pass
                         return
                 meta = self._rpc_handler("Snapshot.Restore", {
                     **(req.get("args") or {}), "Archive": bytes(buf)}, src)
@@ -473,17 +501,13 @@ class ConnPool:
         conn, fresh = self._mux_get(addr)
         try:
             return conn.call(method, args, timeout)
-        except StreamTimeout:
-            raise
-        except ConnectionError:
+        except ConnectionError:  # session death; StreamTimeout is RPCError
             self._discard(addr, conn)
             if fresh:
                 raise
             conn, _ = self._mux_get(addr)
             try:
                 return conn.call(method, args, timeout)
-            except StreamTimeout:
-                raise
             except ConnectionError:
                 self._discard(addr, conn)
                 raise
@@ -560,10 +584,21 @@ class ConnPool:
         try:
             conn.sock.settimeout(timeout)
             write_frame(conn.sock, {"op": "restore", "args": args})
-            for off in range(0, len(archive), SNAPSHOT_CHUNK):
-                write_frame(conn.sock,
-                            {"data": archive[off:off + SNAPSHOT_CHUNK]})
-            write_frame(conn.sock, {"eof": True})
+            try:
+                for off in range(0, len(archive), SNAPSHOT_CHUNK):
+                    write_frame(
+                        conn.sock,
+                        {"data": archive[off:off + SNAPSHOT_CHUNK]})
+                write_frame(conn.sock, {"eof": True})
+            except OSError as e:
+                # the server stopped reading mid-upload — usually an
+                # over-limit rejection with a pending error frame;
+                # surface THAT instead of a bare transport error
+                resp = read_frame(conn.sock)
+                if resp is not None and resp.get("error"):
+                    raise RPCError(resp["error"]) from e
+                raise ConnectionError(
+                    f"snapshot upload to {addr} failed: {e}") from e
             resp = read_frame(conn.sock)
             if resp is None:
                 raise ConnectionError("snapshot stream truncated")

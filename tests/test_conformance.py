@@ -3,10 +3,22 @@
 The same GossipConfig drives both backends (the seam SURVEY.md §7 hard
 part (f) calls for, mirroring internal/storage/conformance). These tests
 drive the event-driven host engine (deterministic clock, in-mem network)
-and the batched simulation with identical protocol parameters and assert
-the aggregate failure-detector statistics agree to within generous
-factors — the sim is mean-field, the host engine is exact, so the
-comparison is order-of-magnitude behavioral, not bit-exact.
+and the batched simulation with identical protocol parameters.
+
+Two tiers of assertion:
+  * the BASELINE fidelity criterion — the sim's failure-detector
+    false-positive rate within ONE PERCENTAGE POINT of the host
+    engine's, enforced at n=24/45% loss and n=100/30% loss with
+    Lifeguard both on and off (the 1pct tests below);
+  * ballpark agreement (bounded ratios) for detection latency,
+    suspicion rates, and propagation times, where mean-field vs exact
+    event dynamics legitimately diverge by small constant factors.
+Envelope: the mean-field sim has no per-node membership views, so it
+cannot answer per-node divergence/rumor-ordering questions, and it
+underestimates FP below ~40% loss (measured: 0 vs the host's 2.6e-4
+per node-round at 30% loss — inside the criterion). What it does
+claim — aggregate FD statistics under matched configs — is what these
+tests pin down.
 """
 
 from dataclasses import replace
@@ -185,3 +197,76 @@ def test_false_positive_rate_under_loss_same_ballpark():
         ratio = (sim_rate + 1e-6) / (host_rate + 1e-6)
         assert 0.05 < ratio < 20.0, \
             f"FP rates diverge: host={host_rate:.5f} sim={sim_rate:.5f}"
+
+
+def _host_fp_rate(n, loss, cfg, window, seed):
+    """Wrong-DEAD declaration incidents per node-round on the host
+    engine (nobody crashes, so every declaration is a false positive).
+    Unit note as in test_false_positive_rate_under_loss_same_ballpark:
+    declare_dead fires once per MEMBER marking a node dead — divide by
+    n for cluster-wide incidents."""
+    global CFG
+    old = CFG
+    try:
+        # build_host_cluster reads module CFG; swap it for this config
+        globals()["CFG"] = cfg
+        telemetry.default.reset()
+        net, serfs = build_host_cluster(n, loss=loss, seed=seed)
+        telemetry.default.reset()  # drop join-phase noise
+        net.clock.advance(window)
+        snap = telemetry.default.snapshot()
+        dead = next((c["Count"] for c in snap["Counters"]
+                     if c["Name"].endswith("declare_dead")), 0)
+        rounds = window / cfg.probe_interval
+        for s in serfs:
+            s.shutdown()
+        return dead / n / (n * rounds)
+    finally:
+        globals()["CFG"] = old
+
+
+def _sim_fp_rate(n, loss, cfg, rounds, seed):
+    p = SimParams.from_gossip_config(cfg, n=n, loss=loss)
+    state, _ = run_rounds(init_state(n), jax.random.key(seed), p, rounds)
+    return int(state.stats.false_positives) / (n * rounds)
+
+
+def test_fp_rate_1pct_criterion_n100_lifeguard_on_and_off():
+    """The BASELINE fidelity criterion at VERDICT round-1 scale: host
+    clusters of n=100 (SimClock), 30% loss, with Lifeguard ON and OFF
+    (awareness + suspicion-timeout shrink disabled), matched configs in
+    both engines. The sim's false-positive rate must sit within ONE
+    PERCENTAGE POINT of the host engine's in each mode — the north
+    star's fidelity half (BASELINE.md targets table)."""
+    n, loss, window = 100, 0.30, 30.0
+    lifeguard_on = CFG
+    lifeguard_off = replace(
+        CFG, awareness_max_multiplier=0,
+        suspicion_max_timeout_mult=CFG.suspicion_mult)
+    rounds = int(window / CFG.probe_interval)
+
+    rates = {}
+    for name, cfg in (("on", lifeguard_on), ("off", lifeguard_off)):
+        host = _host_fp_rate(n, loss, cfg, window, seed=17)
+        sim = _sim_fp_rate(n, loss, cfg, rounds, seed=19)
+        rates[name] = (host, sim)
+        assert abs(sim - host) < 0.01, \
+            f"lifeguard={name}: FP rates diverge past the 1% criterion:" \
+            f" host={host:.5f} sim={sim:.5f} /node-round"
+
+    # Non-vacuity: the host engine must actually produce false
+    # positives with Lifeguard off at this loss (measured ≈2.6e-4
+    # /node-round; the sim sits at 0 here — its mean-field refutation
+    # underestimates FP below ~40% loss, which is WITHIN the 1%
+    # criterion; the n=24/45%-loss test above exercises the regime
+    # where both engines are nonzero)
+    h_on, s_on = rates["on"]
+    h_off, s_off = rates["off"]
+    assert h_off > 0, "host produced no FPs — test is vacuous"
+
+    # Lifeguard's whole point: it must not INCREASE false positives,
+    # and both engines must agree on the direction of its effect
+    assert h_on <= h_off + 0.005, \
+        f"host: Lifeguard made FP worse ({h_on:.5f} > {h_off:.5f})"
+    assert s_on <= s_off + 0.005, \
+        f"sim: Lifeguard made FP worse ({s_on:.5f} > {s_off:.5f})"
