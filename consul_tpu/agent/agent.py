@@ -82,6 +82,8 @@ class Agent:
 
         self.http = None
         self.dns = None
+        self.grpc = None  # external gRPC server (ADS/discovery/health)
+        self.grpc_port = 0
         # read-through cache (agent/cache): client agents avoid a server
         # round-trip per DNS query; server agents read in-process already
         from consul_tpu.agent.cache import AgentCache
@@ -138,6 +140,15 @@ class Agent:
             self.dns = DNSServer(self, self.config.bind_addr,
                                  self.config.port("dns"))
             self.dns.start()
+        # external gRPC: Envoy delta ADS + server discovery + health
+        # (agent/agent.go:875 listenAndServeGRPC; port 8502, -1 disables)
+        if self.config.port("grpc") >= 0:
+            from consul_tpu.server.grpc_external import make_grpc_server
+
+            res = make_grpc_server(self, self.config.bind_addr,
+                                   self.config.port("grpc"))
+            if res is not None:
+                self.grpc, self.grpc_port = res
         self.log.info("agent started (server=%s)", self.server is not None)
 
     def _install_tls_material(self, base_dir, subdir, roots,
@@ -291,6 +302,8 @@ class Agent:
             self.http.stop()
         if self.dns is not None:
             self.dns.stop()
+        if self.grpc is not None:
+            self.grpc.stop(grace=None)
         if self.server is not None:
             self.server.shutdown()
         else:
@@ -410,6 +423,15 @@ class Agent:
             proxy.setdefault("DestinationServiceID", svc.id)
             proxy.setdefault("LocalServicePort", svc.port)
             sc["Proxy"] = proxy
+            if not sc.get("Check") and not sc.get("Checks"):
+                # sidecar default checks (agent/sidecar_service.go):
+                # alias the parent so a failing parent drains its proxy
+                # from connect endpoint pools (EDS/health Connect=true)
+                sc["Checks"] = [{
+                    "CheckID": f"sidecar-alias:{sc['ID']}",
+                    "Name": f"Connect Sidecar Aliasing {svc.id}",
+                    "AliasService": svc.id,
+                }]
             self.register_service(sc)
 
     def deregister_service(self, service_id: str) -> bool:

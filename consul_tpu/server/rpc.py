@@ -593,8 +593,15 @@ class ConnPool:
             except OSError as e:
                 # the server stopped reading mid-upload — usually an
                 # over-limit rejection with a pending error frame;
-                # surface THAT instead of a bare transport error
-                resp = read_frame(conn.sock)
+                # surface THAT instead of a bare transport error (but a
+                # wedged server must not double the deadline or leak a
+                # raw TimeoutError past the ConnectionError contract)
+                resp = None
+                try:
+                    conn.sock.settimeout(5.0)
+                    resp = read_frame(conn.sock)
+                except OSError:
+                    pass
                 if resp is not None and resp.get("error"):
                     raise RPCError(resp["error"]) from e
                 raise ConnectionError(

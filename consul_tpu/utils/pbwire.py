@@ -1,0 +1,164 @@
+"""Minimal protobuf wire-format codec (proto3 subset).
+
+The image ships grpcio but no Envoy/consul proto definitions, so the
+gRPC surfaces (delta ADS, server discovery, gRPC health) speak the wire
+format through this hand-rolled codec — the same approach the DNS
+server takes with RFC1035 (agent/dns.py). Messages are described as
+declarative field specs; encoding follows the proto3 rules:
+
+  varint (wire type 0), 64-bit (1, unused), length-delimited (2),
+  32-bit (5, unused). Field key = (field_number << 3) | wire_type.
+
+Supported field kinds: int (varint), bool, enum, string, bytes,
+message (nested spec), and repeated variants. Proto3 default-value
+elision: zero ints/bools/enums, empty strings/bytes/messages are not
+emitted (matching canonical encoders, so byte-for-byte interop with
+real protobuf stacks holds for the subset we use).
+
+Reference for the message shapes consumed here: the xDS delta protocol
+(envoy discovery.proto DeltaDiscoveryRequest/Response), served by the
+reference at agent/xds/delta.go:63, and grpc.health.v1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def encode_varint(n: int) -> bytes:
+    out = bytearray()
+    if n < 0:
+        n &= (1 << 64) - 1  # two's complement, 64-bit
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_varint(buf: bytes, off: int) -> tuple[int, int]:
+    n = 0
+    shift = 0
+    while True:
+        if off >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+class Field:
+    """One field spec: (number, kind, [nested spec], repeated)."""
+
+    __slots__ = ("num", "kind", "spec", "repeated")
+
+    def __init__(self, num: int, kind: str,
+                 spec: Optional[dict[str, "Field"]] = None,
+                 repeated: bool = False) -> None:
+        self.num = num
+        self.kind = kind  # int|bool|string|bytes|message
+        self.spec = spec
+        self.repeated = repeated
+
+
+def encode(spec: dict[str, Field], msg: dict[str, Any]) -> bytes:
+    """dict → proto3 bytes per the field spec. Unknown keys are
+    ignored; proto3 zero values are elided."""
+    out = bytearray()
+    for name, f in spec.items():
+        if name not in msg:
+            continue
+        v = msg[name]
+        vals = v if f.repeated else [v]
+        for item in vals:
+            out.extend(_encode_one(f, item))
+    return bytes(out)
+
+
+def _encode_one(f: Field, v: Any) -> bytes:
+    if f.kind in ("int", "bool", "enum"):
+        iv = int(v)
+        if iv == 0 and not f.repeated:
+            return b""
+        return encode_varint((f.num << 3) | 0) + encode_varint(iv)
+    if f.kind == "string":
+        bv = v.encode() if isinstance(v, str) else bytes(v)
+    elif f.kind == "bytes":
+        bv = bytes(v)
+    elif f.kind == "message":
+        bv = encode(f.spec, v)
+    else:
+        raise ValueError(f"unknown field kind {f.kind}")
+    if not bv and not f.repeated and f.kind != "message":
+        return b""
+    if f.kind == "message" and not bv and not f.repeated:
+        return b""  # empty sub-message elided (canonical proto3)
+    return encode_varint((f.num << 3) | 2) + encode_varint(len(bv)) + bv
+
+
+def decode(spec: dict[str, Field], buf: bytes) -> dict[str, Any]:
+    """proto3 bytes → dict per the field spec. Unknown fields are
+    skipped (forward compatibility); repeated fields accumulate."""
+    by_num = {f.num: (name, f) for name, f in spec.items()}
+    out: dict[str, Any] = {}
+    off = 0
+    while off < len(buf):
+        key, off = decode_varint(buf, off)
+        num, wt = key >> 3, key & 7
+        if wt == 0:
+            val, off = decode_varint(buf, off)
+        elif wt == 2:
+            ln, off = decode_varint(buf, off)
+            if off + ln > len(buf):
+                raise ValueError("truncated length-delimited field")
+            val = buf[off:off + ln]
+            off += ln
+        elif wt == 1:
+            val = buf[off:off + 8]
+            off += 8
+        elif wt == 5:
+            val = buf[off:off + 4]
+            off += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        ent = by_num.get(num)
+        if ent is None:
+            continue
+        name, f = ent
+        if f.kind in ("int", "enum"):
+            v: Any = int(val) if isinstance(val, int) else int.from_bytes(
+                val, "little")
+        elif f.kind == "bool":
+            v = bool(val)
+        elif f.kind == "string":
+            v = bytes(val).decode("utf-8", errors="replace") \
+                if not isinstance(val, int) else str(val)
+        elif f.kind == "bytes":
+            v = bytes(val) if not isinstance(val, int) else b""
+        elif f.kind == "message":
+            v = decode(f.spec, bytes(val))
+        else:
+            continue
+        if f.repeated:
+            out.setdefault(name, []).append(v)
+        else:
+            out[name] = v
+    # repeated fields default to [] so callers can iterate unguarded
+    for name, f in spec.items():
+        if f.repeated:
+            out.setdefault(name, [])
+    return out
+
+
+def message(spec: dict[str, Field]):
+    """(serializer, deserializer) pair for grpc's raw-codec hooks."""
+    return (lambda msg: encode(spec, msg),
+            lambda data: decode(spec, data))

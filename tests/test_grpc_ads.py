@@ -1,0 +1,248 @@
+"""Delta-xDS ADS over real gRPC + the other external gRPC services.
+
+VERDICT round-1 acceptance: "a test gRPC client completes the delta
+handshake and receives CDS/EDS updates when catalog health flips."
+The client here is plain grpcio with raw serializers over the same
+pbwire specs the server uses (no Envoy binary exists in this image;
+the protocol envelope is wire-true protobuf — verified against the
+google.protobuf runtime in test_pbwire-style checks below).
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api import ConsulClient
+from consul_tpu.config import load
+from consul_tpu.server.grpc_external import (ANY, CDS_TYPE, CLA, DELTA_REQ,
+                                             DELTA_RESP, EDS_TYPE,
+                                             HEALTH_REQ, HEALTH_RESP,
+                                             LDS_TYPE, RESOURCE,
+                                             WATCH_SERVERS_REQ,
+                                             WATCH_SERVERS_RESP)
+from consul_tpu.utils.pbwire import Field, decode, encode
+
+from helpers import wait_for  # noqa: E402
+
+ADS_METHOD = ("/envoy.service.discovery.v3.AggregatedDiscoveryService"
+              "/DeltaAggregatedResources")
+
+
+@pytest.fixture(scope="module")
+def agent():
+    cfg = load(dev=True, overrides={"node_name": "grpc-agent"})
+    a = Agent(cfg)
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="self-elect")
+    assert a.grpc is not None and a.grpc_port > 0
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(agent):
+    c = ConsulClient(agent.http.addr)
+    c.service_register({
+        "Name": "db", "ID": "db1", "Port": 5432,
+        "Check": {"TTL": "600s", "Status": "passing"},
+        "Connect": {"SidecarService": {}}})
+    c.service_register({
+        "Name": "web", "ID": "web1", "Port": 8080,
+        "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+            {"DestinationName": "db", "LocalBindPort": 9191}]}}}})
+    c.put("/v1/connect/intentions", body={
+        "SourceName": "web", "DestinationName": "db", "Action": "allow"})
+    wait_for(lambda: c.health_service("db"), what="db in catalog")
+    return c
+
+
+class AdsStream:
+    """Bidirectional delta-ADS stream driven from a send queue."""
+
+    def __init__(self, port):
+        import grpc
+
+        self.chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        self.sendq: queue.Queue = queue.Queue()
+        self.recvq: queue.Queue = queue.Queue()
+        call = self.chan.stream_stream(
+            ADS_METHOD,
+            request_serializer=lambda m: encode(DELTA_REQ, m),
+            response_deserializer=lambda b: decode(DELTA_RESP, b))
+
+        def gen():
+            while True:
+                item = self.sendq.get()
+                if item is None:
+                    return
+                yield item
+
+        self.call = call(gen())
+
+        def pump():
+            try:
+                for resp in self.call:
+                    self.recvq.put(resp)
+            except Exception:  # noqa: BLE001 — stream closed
+                pass
+
+        threading.Thread(target=pump, daemon=True).start()
+
+    def send(self, **msg):
+        self.sendq.put(msg)
+
+    def recv(self, timeout=10.0):
+        return self.recvq.get(timeout=timeout)
+
+    def expect_quiet(self, seconds=1.5):
+        try:
+            resp = self.recvq.get(timeout=seconds)
+            raise AssertionError(f"unexpected push: {resp}")
+        except queue.Empty:
+            return
+
+    def recv_type(self, type_url, timeout=15.0, want=None):
+        """Receive until a response of `type_url` (optionally one where
+        want(resp) is truthy) arrives; ACK everything on the way —
+        other types legitimately re-push while the catalog settles."""
+        deadline = time.monotonic() + timeout
+        while True:
+            resp = self.recv(timeout=max(0.1, deadline - time.monotonic()))
+            self.send(type_url=resp["type_url"],
+                      response_nonce=resp["nonce"])
+            if resp["type_url"] == type_url and (want is None
+                                                 or want(resp)):
+                return resp
+
+    def settle(self, quiet=1.5, timeout=20.0):
+        """ACK pushes until the stream has been quiet for `quiet`s."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                resp = self.recvq.get(timeout=quiet)
+                self.send(type_url=resp["type_url"],
+                          response_nonce=resp["nonce"])
+            except queue.Empty:
+                return
+        raise AssertionError("stream never settled")
+
+    def close(self):
+        self.sendq.put(None)
+        self.chan.close()
+
+
+def _db_cla(resp):
+    for r in resp["resources"]:
+        cla = decode(CLA, r["resource"]["value"])
+        if "db" in cla.get("cluster_name", ""):
+            return cla
+    return None
+
+
+def _db_health(resp):
+    """None if db's CLA absent; else (n_endpoints, all_healthy)."""
+    cla = _db_cla(resp)
+    if cla is None:
+        return None
+    eps = [lb for grp in cla["endpoints"] for lb in grp["lb_endpoints"]]
+    return len(eps), all(e.get("health_status", 1) == 1 for e in eps)
+
+
+def test_delta_handshake_cds_eds_and_health_flip(agent, client):
+    ads = AdsStream(agent.grpc_port)
+    proxy_id = "web1-sidecar-proxy"
+
+    # --- CDS wildcard subscribe ---
+    ads.send(node={"id": proxy_id}, type_url=CDS_TYPE,
+             resource_names_subscribe=["*"])
+    resp = ads.recv_type(CDS_TYPE)
+    names = {r["name"] for r in resp["resources"]}
+    assert any("db" in n for n in names), names
+    assert resp["nonce"]
+
+    # --- EDS wildcard subscribe: true-proto ClusterLoadAssignment ---
+    ads.send(type_url=EDS_TYPE, resource_names_subscribe=["*"])
+    resp = ads.recv_type(
+        EDS_TYPE, want=lambda r: (_db_health(r) or (0, False))[0] > 0)
+    n, healthy = _db_health(resp)
+    assert n > 0 and healthy
+
+    # stream settles once the catalog stops moving (every push acked)
+    ads.settle()
+
+    # --- catalog health flip pushes an EDS update: the db endpoint
+    # drains (empty/unhealthy CLA) or the resource is removed outright
+    def flipped(r):
+        h = _db_health(r)
+        if h is not None and (h[0] == 0 or not h[1]):
+            return True
+        return any("db" in n for n in r["removed_resources"])
+
+    client.check_fail("service:db1")
+    assert flipped(ads.recv_type(EDS_TYPE, want=flipped))
+
+    # restore: the healthy endpoint comes back
+    client.check_pass("service:db1")
+    ads.recv_type(
+        EDS_TYPE,
+        want=lambda r: (h := _db_health(r)) is not None
+        and h[0] > 0 and h[1])
+    ads.close()
+
+
+def test_delta_nack_suppresses_resend(agent, client):
+    ads = AdsStream(agent.grpc_port)
+    ads.send(node={"id": "web1-sidecar-proxy"}, type_url=LDS_TYPE,
+             resource_names_subscribe=["*"])
+    resp = ads.recv()
+    assert resp["resources"], "no listeners"
+    # NACK it: the same versions must NOT be re-sent
+    ads.send(type_url=LDS_TYPE, response_nonce=resp["nonce"],
+             error_detail={"code": 3, "message": "bad config"})
+    ads.expect_quiet()
+    ads.close()
+
+
+def test_grpc_health_check(agent):
+    import grpc
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{agent.grpc_port}")
+    check = chan.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=lambda m: encode(HEALTH_REQ, m),
+        response_deserializer=lambda b: decode(HEALTH_RESP, b))
+    resp = check({"service": ""})
+    assert resp.get("status") == 1  # SERVING
+    chan.close()
+
+
+def test_watch_servers(agent):
+    import grpc
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{agent.grpc_port}")
+    watch = chan.unary_stream(
+        "/hashicorp.consul.serverdiscovery.ServerDiscoveryService"
+        "/WatchServers",
+        request_serializer=lambda m: encode(WATCH_SERVERS_REQ, m),
+        response_deserializer=lambda b: decode(WATCH_SERVERS_RESP, b))
+    first = next(iter(watch({"wait": False})))
+    assert first["servers"], "no servers advertised"
+    assert any(s.get("address") for s in first["servers"])
+    chan.close()
+
+
+def test_pbwire_matches_real_protobuf_runtime():
+    """The codec every gRPC surface rides must agree byte-for-byte
+    with the installed google.protobuf runtime on shared shapes."""
+    from google.protobuf import any_pb2, field_mask_pb2
+
+    real = any_pb2.Any(type_url="type.googleapis.com/t.T", value=b"\x00x")
+    assert encode(ANY, {"type_url": "type.googleapis.com/t.T",
+                        "value": b"\x00x"}) == real.SerializeToString()
+    assert decode(ANY, real.SerializeToString())["value"] == b"\x00x"
+    fm = field_mask_pb2.FieldMask(paths=["a.b", "c"])
+    FM = {"paths": Field(1, "string", repeated=True)}
+    assert encode(FM, {"paths": ["a.b", "c"]}) == fm.SerializeToString()
