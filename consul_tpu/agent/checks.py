@@ -1,9 +1,11 @@
 """Health check runners.
 
-Reference: agent/checks/check.go — 10 runner kinds. Implemented here:
-TTL, HTTP, TCP, Script (Monitor), plus Alias; UDP/gRPC/H2PING/Docker/
-OSService are registered types that fall back to TTL-style manual
-updates (stubs with honest errors) for round 1.
+Reference: agent/checks/check.go — 10 runner kinds, all implemented:
+TTL, HTTP, TCP, UDP, Script (Monitor), H2PING, Alias, gRPC (the
+grpc.health.v1 protocol, check.go:858), Docker (exec in a container
+via the docker CLI, check.go:986), OSService (systemd unit liveness
+via systemctl, check.go:1067). Docker/OSService degrade to CRITICAL
+with an honest message when the host tooling is absent.
 
 Each runner drives LocalState.update_check; the anti-entropy syncer
 pushes status flips to the catalog (agent/local + agent/ae pattern).
@@ -273,6 +275,115 @@ class AliasCheck(CheckRunner):
         return worst, f"aliasing {self.alias_service}: {worst.value}"
 
 
+class GRPCCheck(CheckRunner):
+    """grpc.health.v1 Health/Check probe (check.go:858 CheckGRPC).
+    Target syntax mirrors the reference: "host:port[/service]". Rides
+    the same pbwire codec the agent's own gRPC health endpoint serves,
+    so a consul-tpu agent can gRPC-check another agent directly."""
+
+    def __init__(self, local, check_id, target: str, interval: float,
+                 timeout: float = 10.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        addr, _, svc = target.partition("/")
+        self.addr = addr
+        self.service = svc
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        try:
+            import grpc
+
+            from consul_tpu.server.grpc_external import (HEALTH_REQ,
+                                                         HEALTH_RESP)
+            from consul_tpu.utils.pbwire import decode, encode
+
+            with grpc.insecure_channel(self.addr) as chan:
+                check = chan.unary_unary(
+                    "/grpc.health.v1.Health/Check",
+                    request_serializer=lambda m: encode(HEALTH_REQ, m),
+                    response_deserializer=lambda b: decode(HEALTH_RESP,
+                                                           b))
+                resp = check({"service": self.service},
+                             timeout=self.timeout)
+            status = resp.get("status", 0)
+            if status == 1:
+                return (CheckStatus.PASSING,
+                        f"gRPC check {self.addr}: SERVING")
+            return (CheckStatus.CRITICAL,
+                    f"gRPC check {self.addr}: status {status}")
+        except Exception as e:  # noqa: BLE001 — incl. grpc.RpcError
+            return (CheckStatus.CRITICAL,
+                    f"gRPC check {self.addr} failed: {e}")
+
+
+class DockerCheck(CheckRunner):
+    """Exec a script inside a container (check.go:986 CheckDocker).
+    The reference drives the Docker Engine API; here the docker CLI is
+    the client — absent tooling degrades to CRITICAL, honestly."""
+
+    def __init__(self, local, check_id, container_id: str,
+                 args: list[str], interval: float,
+                 timeout: float = 10.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        self.container_id = container_id
+        self.args = args  # Shell-wrapping happens in make_runner
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        cmd = ["docker", "exec", self.container_id, *self.args]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self.timeout)
+        except FileNotFoundError:
+            return (CheckStatus.CRITICAL,
+                    "docker CLI not available on this host")
+        except subprocess.TimeoutExpired:
+            return (CheckStatus.CRITICAL,
+                    f"docker exec timed out after {self.timeout}s")
+        out = (proc.stdout + proc.stderr)[:4000]
+        # exec-SETUP failures (dead/missing container, daemon down) are
+        # CRITICAL regardless of exit code — the reference's CheckDocker
+        # separates them from the in-container script's own result.
+        # The docker CLI reports them on stderr (often with rc=1, the
+        # same code a WARNING script would use) or via rc 125-127.
+        if proc.returncode in (125, 126, 127) \
+                or "Error response from daemon" in proc.stderr \
+                or "Cannot connect to the Docker daemon" in proc.stderr:
+            return CheckStatus.CRITICAL, out
+        # exit-code convention matches Script checks (0/1/other)
+        if proc.returncode == 0:
+            return CheckStatus.PASSING, out
+        if proc.returncode == 1:
+            return CheckStatus.WARNING, out
+        return CheckStatus.CRITICAL, out
+
+
+class OSServiceCheck(CheckRunner):
+    """OS service liveness (check.go:1067 CheckOSService — systemd
+    here, where the reference also handles Windows SCM)."""
+
+    def __init__(self, local, check_id, service: str, interval: float,
+                 timeout: float = 10.0, scheduler=None) -> None:
+        super().__init__(local, check_id, interval, timeout, scheduler)
+        self.service = service
+
+    def run_once(self) -> tuple[CheckStatus, str]:
+        try:
+            proc = subprocess.run(
+                ["systemctl", "is-active", self.service],
+                capture_output=True, text=True, timeout=self.timeout)
+        except FileNotFoundError:
+            return (CheckStatus.CRITICAL,
+                    "systemctl not available on this host")
+        except subprocess.TimeoutExpired:
+            return (CheckStatus.CRITICAL,
+                    f"systemctl timed out after {self.timeout}s")
+        state = (proc.stdout or proc.stderr).strip()
+        if proc.returncode == 0 and state == "active":
+            return (CheckStatus.PASSING,
+                    f"service {self.service} is active")
+        return (CheckStatus.CRITICAL,
+                f"service {self.service}: {state or 'unknown'}")
+
+
 def make_runner(local: LocalState, defn: dict[str, Any],
                 scheduler=None) -> Optional[Any]:
     """Build a runner from an HTTP-API check definition
@@ -291,12 +402,32 @@ def make_runner(local: LocalState, defn: dict[str, Any],
     if defn.get("UDP"):
         return UDPCheck(local, cid, defn["UDP"], interval, timeout,
                         scheduler)
-    if defn.get("Args") or defn.get("Script"):
-        args = defn.get("Args") or ["/bin/sh", "-c", defn["Script"]]
-        return ScriptCheck(local, cid, args, interval, timeout, scheduler)
     if defn.get("H2PING"):
         return H2PingCheck(local, cid, defn["H2PING"], interval,
                            timeout, scheduler)
+    if defn.get("GRPC"):
+        return GRPCCheck(local, cid, defn["GRPC"], interval, timeout,
+                         scheduler)
+    # Docker BEFORE Args: a docker check carries Args for the
+    # in-container command (structs.CheckType precedence)
+    if defn.get("DockerContainerID"):
+        shell = defn.get("Shell", "/bin/sh")
+        if defn.get("Args"):
+            args = list(defn["Args"])
+        elif defn.get("Script"):
+            args = [shell, "-c", defn["Script"]]
+        else:
+            # no command = a check that can only lie; refuse it
+            # (the reference rejects docker checks without one)
+            return None
+        return DockerCheck(local, cid, defn["DockerContainerID"], args,
+                           interval, timeout, scheduler)
+    if defn.get("OSService"):
+        return OSServiceCheck(local, cid, defn["OSService"], interval,
+                              timeout, scheduler)
+    if defn.get("Args") or defn.get("Script"):
+        args = defn.get("Args") or ["/bin/sh", "-c", defn["Script"]]
+        return ScriptCheck(local, cid, args, interval, timeout, scheduler)
     if defn.get("AliasService"):
         return AliasCheck(local, cid, defn["AliasService"],
                           scheduler=scheduler)
@@ -305,6 +436,8 @@ def make_runner(local: LocalState, defn: dict[str, Any],
 
 def check_type_of(defn: dict[str, Any]) -> str:
     for key, name in (("TTL", "ttl"), ("HTTP", "http"), ("TCP", "tcp"),
+                      ("DockerContainerID", "docker"),
+                      ("OSService", "os_service"),
                       ("Args", "script"), ("Script", "script"),
                       ("AliasService", "alias"), ("UDP", "udp"),
                       ("GRPC", "grpc"), ("H2PING", "h2ping")):

@@ -138,6 +138,15 @@ class ConsulClient:
             raise
         return entries[0] if entries else None
 
+    def kv_get_meta(self, key: str,
+                    **params) -> tuple[Optional[bytes], int]:
+        """(value, ModifyIndex) — index 0 when absent, for create-CAS."""
+        e = self.kv_get_entry(key, **params)
+        if e is None:
+            return None, 0
+        v = e.get("Value")
+        return (base64.b64decode(v) if v else b""), e.get("ModifyIndex", 0)
+
     def kv_list(self, prefix: str, **params) -> list[dict]:
         try:
             return self.get(f"/v1/kv/{prefix}", recurse="", **params) or []
@@ -235,6 +244,37 @@ class ConsulClient:
         return self.get("/v1/operator/raft/configuration")
 
 
+class _SessionKeeper:
+    """Background TTL-session renewal while a lock/semaphore is held
+    (api/lock.go + api/semaphore.go both run renewSession): without it
+    the leader expires the session at ~2x TTL and the holder silently
+    loses its slot while still believing it holds it."""
+
+    def __init__(self, client: "ConsulClient", session: str,
+                 ttl: str) -> None:
+        import threading
+
+        from consul_tpu.utils.duration import parse_duration
+
+        self._client = client
+        self._session = session
+        self._interval = max(parse_duration(ttl) / 2.0, 0.5)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"session-renew-{session[:8]}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._client.session_renew(self._session)
+            except APIError:
+                return  # session is gone; the holder will find out
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 class Lock:
     """Distributed lock over sessions + KV acquire (api/lock.go)."""
 
@@ -244,6 +284,7 @@ class Lock:
         self.key = key
         self.session_ttl = session_ttl
         self.session: Optional[str] = None
+        self._keeper: Optional[_SessionKeeper] = None
 
     def acquire(self, value: bytes = b"", wait: float = 10.0) -> bool:
         import time
@@ -254,12 +295,112 @@ class Lock:
         deadline = time.monotonic() + wait
         while time.monotonic() < deadline:
             if self.client.kv_acquire(self.key, value, self.session):
+                self._keeper = _SessionKeeper(self.client, self.session,
+                                              self.session_ttl)
                 return True
             time.sleep(0.5)
         return False
 
     def release(self) -> None:
+        if self._keeper is not None:
+            self._keeper.stop()
+            self._keeper = None
         if self.session is not None:
             self.client.kv_release(self.key, self.session)
             self.client.session_destroy(self.session)
             self.session = None
+
+
+class Semaphore:
+    """Counting semaphore over sessions + KV (api/semaphore.go): up to
+    `limit` holders. Each holder parks a contender key under
+    `prefix/<session>`; the shared `prefix/.lock` coordination record
+    names the current holders and is updated with check-and-set, so
+    racing acquirers serialize through CAS retries."""
+
+    def __init__(self, client: ConsulClient, prefix: str, limit: int,
+                 session_ttl: str = "15s") -> None:
+        self.client = client
+        self.prefix = prefix.rstrip("/")
+        self.limit = limit
+        self.session_ttl = session_ttl
+        self.session: Optional[str] = None
+        self._keeper: Optional[_SessionKeeper] = None
+
+    def _lock_key(self) -> str:
+        return f"{self.prefix}/.lock"
+
+    def _live_contenders(self) -> set:
+        """Sessions with a live contender key under the prefix. Session
+        death deletes the key (Behavior=delete), so this IS the
+        live-holder set — no cluster-wide session listing needed
+        (api/semaphore.go prunes from the contender list the same way)."""
+        return {e.get("Session") for e in self.client.kv_list(self.prefix)
+                if e.get("Session") and not e["Key"].endswith("/.lock")}
+
+    def acquire(self, wait: float = 10.0) -> bool:
+        import time
+
+        if self.session is None:
+            self.session = self.client.session_create(
+                {"TTL": self.session_ttl, "Behavior": "delete"})
+        # contender entry, tied to our session lifetime
+        if not self.client.kv_acquire(f"{self.prefix}/{self.session}",
+                                      b"", self.session):
+            # session already expired (e.g. long pause since creation):
+            # start over with a fresh one
+            self.session = self.client.session_create(
+                {"TTL": self.session_ttl, "Behavior": "delete"})
+            if not self.client.kv_acquire(
+                    f"{self.prefix}/{self.session}", b"", self.session):
+                return False
+        deadline = time.monotonic() + wait
+        while time.monotonic() < deadline:
+            raw, idx = self.client.kv_get_meta(self._lock_key())
+            holders: list[str] = []
+            if raw:
+                data = json.loads(raw)
+                live = self._live_contenders()
+                # prune holders whose sessions died (semaphore.go
+                # pruneDeadHolders)
+                holders = [h for h in data.get("Holders", [])
+                           if h in live]
+            if self.session in holders:
+                self._keeper = _SessionKeeper(self.client, self.session,
+                                              self.session_ttl)
+                return True
+            if len(holders) < self.limit:
+                holders = sorted({*holders, self.session})
+                body = json.dumps(
+                    {"Limit": self.limit, "Holders": holders}).encode()
+                if self.client.kv_cas(self._lock_key(), body, idx):
+                    self._keeper = _SessionKeeper(
+                        self.client, self.session, self.session_ttl)
+                    return True
+                continue  # CAS race: re-read and retry immediately
+            time.sleep(0.3)
+        return False
+
+    def release(self) -> None:
+        import time
+
+        if self._keeper is not None:
+            self._keeper.stop()
+            self._keeper = None
+        if self.session is None:
+            return
+        for _ in range(32):
+            raw, idx = self.client.kv_get_meta(self._lock_key())
+            if not raw:
+                break
+            data = json.loads(raw)
+            holders = [h for h in data.get("Holders", [])
+                       if h != self.session]
+            body = json.dumps(
+                {"Limit": data.get("Limit", self.limit),
+                 "Holders": holders}).encode()
+            if self.client.kv_cas(self._lock_key(), body, idx):
+                break
+            time.sleep(0.05)
+        self.client.session_destroy(self.session)
+        self.session = None

@@ -1,0 +1,205 @@
+"""The round-2 check runners (gRPC / Docker / OSService,
+agent/checks/check.go:858,986,1067) and the KV semaphore
+(api/semaphore.go)."""
+
+import threading
+
+import pytest
+
+from consul_tpu.agent.checks import (DockerCheck, GRPCCheck,
+                                     OSServiceCheck, check_type_of,
+                                     make_runner)
+from consul_tpu.agent.local import LocalState
+from consul_tpu.types import CheckStatus
+
+from helpers import wait_for  # noqa: E402
+
+
+def _local():
+    return LocalState()
+
+
+def test_make_runner_dispatch():
+    local = _local()
+    assert isinstance(make_runner(local, {"CheckID": "g",
+                                          "GRPC": "127.0.0.1:1/x"}),
+                      GRPCCheck)
+    docker = make_runner(local, {
+        "CheckID": "d", "DockerContainerID": "abc",
+        "Args": ["/bin/true"]})
+    assert isinstance(docker, DockerCheck)  # Docker wins over Args
+    assert isinstance(make_runner(local, {"CheckID": "o",
+                                          "OSService": "sshd"}),
+                      OSServiceCheck)
+    assert check_type_of({"GRPC": "x"}) == "grpc"
+    assert check_type_of({"DockerContainerID": "x"}) == "docker"
+    assert check_type_of({"OSService": "x"}) == "os_service"
+
+
+def test_grpc_check_against_live_agent():
+    """The runner speaks real grpc.health.v1 against our own gRPC
+    endpoint — agent checks agent."""
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import load
+
+    cfg = load(dev=True, overrides={"node_name": "grpccheck"})
+    a = Agent(cfg)
+    a.start(serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="leadership")
+        assert a.grpc_port > 0
+        local = _local()
+        c = GRPCCheck(local, "g", f"127.0.0.1:{a.grpc_port}",
+                      interval=10.0, timeout=5.0)
+        status, out = c.run_once()
+        assert status == CheckStatus.PASSING, out
+        assert "SERVING" in out
+        # dead port → critical
+        c2 = GRPCCheck(local, "g2", "127.0.0.1:1", 10.0, timeout=2.0)
+        status, out = c2.run_once()
+        assert status == CheckStatus.CRITICAL
+    finally:
+        a.shutdown()
+
+
+def test_docker_and_osservice_degrade_honestly(monkeypatch):
+    """Absent host tooling → CRITICAL with a clear message, and the
+    success paths are exercised through a fake CLI."""
+    local = _local()
+    d = DockerCheck(local, "d", "cid", ["/bin/true"], 10.0)
+    o = OSServiceCheck(local, "o", "svc", 10.0)
+
+    import subprocess as sp
+
+    def missing(*a, **k):
+        raise FileNotFoundError("no such binary")
+
+    monkeypatch.setattr(sp, "run", missing)
+    st, out = d.run_once()
+    assert st == CheckStatus.CRITICAL and "docker" in out
+    st, out = o.run_once()
+    assert st == CheckStatus.CRITICAL and "systemctl" in out
+
+    class FakeProc:
+        def __init__(self, rc, out):
+            self.returncode = rc
+            self.stdout = out
+            self.stderr = ""
+
+    monkeypatch.setattr(sp, "run", lambda *a, **k: FakeProc(0, "ok"))
+    assert d.run_once()[0] == CheckStatus.PASSING
+    monkeypatch.setattr(sp, "run", lambda *a, **k: FakeProc(1, "warn"))
+    assert d.run_once()[0] == CheckStatus.WARNING
+    monkeypatch.setattr(sp, "run",
+                        lambda *a, **k: FakeProc(0, "active\n"))
+    assert o.run_once()[0] == CheckStatus.PASSING
+    monkeypatch.setattr(sp, "run",
+                        lambda *a, **k: FakeProc(3, "inactive\n"))
+    assert o.run_once()[0] == CheckStatus.CRITICAL
+
+
+@pytest.fixture(scope="module")
+def sem_agent():
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import load
+
+    cfg = load(dev=True, overrides={"node_name": "sem-agent"})
+    a = Agent(cfg)
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="leadership")
+    yield a
+    a.shutdown()
+
+
+def test_semaphore_limits_holders(sem_agent):
+    from consul_tpu.api import ConsulClient, Semaphore
+
+    def mk():
+        return Semaphore(ConsulClient(sem_agent.http.addr),
+                         "sem/test", limit=2)
+
+    s1, s2, s3 = mk(), mk(), mk()
+    assert s1.acquire(wait=5.0)
+    assert s2.acquire(wait=5.0)
+    assert not s3.acquire(wait=2.0), "third holder broke the limit"
+    # releasing one slot lets the third in
+    s1.release()
+    assert s3.acquire(wait=5.0)
+    s2.release()
+    s3.release()
+
+
+def test_semaphore_dead_holder_pruned(sem_agent):
+    from consul_tpu.api import ConsulClient, Semaphore
+
+    c = ConsulClient(sem_agent.http.addr)
+    s1 = Semaphore(c, "sem/prune", limit=1)
+    s2 = Semaphore(c, "sem/prune", limit=1)
+    assert s1.acquire(wait=5.0)
+    # holder dies without releasing: destroy its session directly
+    c.session_destroy(s1.session)
+    assert s2.acquire(wait=5.0), "dead holder never pruned"
+    s2.release()
+
+
+def test_semaphore_concurrent_cas_races(sem_agent):
+    """8 racing acquirers through CAS: exactly `limit` win."""
+    from consul_tpu.api import ConsulClient, Semaphore
+
+    sems = [Semaphore(ConsulClient(sem_agent.http.addr),
+                      "sem/race", limit=3) for _ in range(8)]
+    results = []
+
+    def go(s):
+        results.append(s.acquire(wait=4.0))
+
+    ts = [threading.Thread(target=go, args=(s,)) for s in sems]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(results) == 3, f"{sum(results)} holders at limit 3"
+    for s in sems:
+        s.release()
+
+
+def test_docker_daemon_error_is_critical(monkeypatch):
+    import subprocess as sp
+
+    local = _local()
+    d = DockerCheck(local, "d", "cid", ["/bin/true"], 10.0)
+
+    class FakeProc:
+        def __init__(self, rc, err):
+            self.returncode = rc
+            self.stdout = ""
+            self.stderr = err
+
+    monkeypatch.setattr(sp, "run", lambda *a, **k: FakeProc(
+        1, "Error response from daemon: container cid is not running"))
+    st, out = d.run_once()
+    assert st == CheckStatus.CRITICAL  # NOT warning: exec-setup failure
+    monkeypatch.setattr(sp, "run", lambda *a, **k: FakeProc(126, "x"))
+    assert d.run_once()[0] == CheckStatus.CRITICAL
+
+
+def test_docker_without_command_is_rejected():
+    assert make_runner(_local(), {
+        "CheckID": "d", "DockerContainerID": "cid"}) is None
+
+
+def test_lock_and_semaphore_renew_their_sessions(sem_agent):
+    """A holder outliving its TTL keeps its slot (renewal keeper)."""
+    import time
+
+    from consul_tpu.api import ConsulClient, Semaphore
+
+    c = ConsulClient(sem_agent.http.addr)
+    s = Semaphore(c, "sem/renew", limit=1, session_ttl="1s")
+    assert s.acquire(wait=5.0)
+    time.sleep(3.0)  # > 2x TTL: an unrenewed session would be expired
+    assert any(x["ID"] == s.session for x in c.session_list()), \
+        "session expired despite renewal keeper"
+    s2 = Semaphore(c, "sem/renew", limit=1)
+    assert not s2.acquire(wait=1.5), "slot was lost while held"
+    s.release()
