@@ -161,6 +161,11 @@ class RuntimeConfig:
     # tombstones live between ttl and 2*ttl before the leader reaps)
     tombstone_ttl: float = 900.0
 
+    # wanfed: cross-DC gossip tunnels through mesh gateways instead of
+    # direct WAN UDP (reference: connect.enable_mesh_gateway_wan_federation
+    # → agent/consul/wanfed transport wrap, server_serf.go:198-213)
+    wan_federation_via_mesh_gateways: bool = False
+
     # Anti-entropy (reference: agent/ae/ae.go:57)
     sync_coalesce_timeout: float = 0.2
 
@@ -347,6 +352,10 @@ def load(
             kwargs[tgt] = dns[src]
     if "recursors" in raw:
         kwargs["dns_recursors"] = tuple(raw["recursors"])
+    connect_blk = raw.get("connect", {})
+    if "enable_mesh_gateway_wan_federation" in connect_blk:
+        kwargs["wan_federation_via_mesh_gateways"] = bool(
+            connect_blk["enable_mesh_gateway_wan_federation"])
     if "telemetry" in raw:
         tel = {k: v for k, v in raw["telemetry"].items()
                if k in {f.name for f in dataclasses.fields(TelemetryConfig)}}

@@ -42,6 +42,7 @@ RPC_RAFT = 0x01
 RPC_TLS = 0x02  # pool.RPCTLS: TLS handshake, then the REAL tag inside
 RPC_MUX = 0x04  # yamux-equivalent multiplexed streams
 RPC_SNAPSHOT = 0x05  # dedicated snapshot stream
+RPC_GOSSIP = 0x06  # wanfed gossip ingestion (pool.RPCGossip)
 
 MAX_FRAME = 64 * 1024 * 1024
 SNAPSHOT_CHUNK = 1 << 20  # 1MB snapshot stream chunks
@@ -172,6 +173,8 @@ class RPCServer:
                         outer._serve_mux(sock, src)
                     elif tag[0] == RPC_SNAPSHOT:
                         outer._serve_snapshot(sock, src)
+                    elif tag[0] == RPC_GOSSIP:
+                        outer._serve_gossip(sock, src)
                     else:
                         outer.log.warning("unknown protocol byte %d from %s",
                                           tag[0], src)
@@ -190,6 +193,10 @@ class RPCServer:
         self.tls_context = None  # server ctx; set via set_tls()
         self.require_tls = False  # verify_incoming: refuse plaintext
         self.raft_verify = None  # keyring_raft_auth verifier, if any
+        # wanfed ingestion seam (set by Server when mesh-gateway WAN
+        # federation is on): .ingest_packet(src, data),
+        # .ingest_stream(src, data) -> bytes
+        self.gossip_ingest = None
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(
@@ -335,6 +342,41 @@ class RPCServer:
                 write_frame(sock, {"error": f"internal: {e}"})
             except OSError:
                 pass
+
+    def _serve_gossip(self, sock: socket.socket, src: str) -> None:
+        """wanfed tunnel termination (reference: the RPCGossip byte,
+        rpc.go handleConn → wanfed IngestionAwareTransport): packets
+        feed the WAN memberlist as if they arrived by UDP; streams get
+        their response frame back down the same tunnel. Gossip-level
+        encryption still applies inside `data` — the tunnel adds no
+        authority (a forged frame is just a forged gossip packet, which
+        the keyring already rejects)."""
+        if self.gossip_ingest is None:
+            self.log.warning("wanfed gossip from %s but mesh-gateway "
+                             "federation is not enabled", src)
+            return
+        while True:
+            req = read_frame(sock)
+            if req is None:
+                return
+            kind = req.get("kind")
+            origin = req.get("src", src)
+            data = req.get("data") or b""
+            try:
+                if kind == "packet":
+                    self.gossip_ingest.ingest_packet(origin, data)
+                elif kind == "stream":
+                    resp = self.gossip_ingest.ingest_stream(origin, data)
+                    write_frame(sock, {"resp": resp})
+                else:
+                    write_frame(sock, {"error": f"bad kind {kind!r}"})
+            except Exception as e:  # noqa: BLE001
+                self.log.debug("wanfed ingest error: %s", e)
+                if kind == "stream":
+                    try:
+                        write_frame(sock, {"error": str(e)})
+                    except OSError:
+                        return
 
     def _serve_raft(self, sock: socket.socket, src: str) -> None:
         while True:

@@ -41,7 +41,8 @@ class NoLeaderError(RPCError):
 class Server:
     def __init__(self, config: RuntimeConfig,
                  serf_transport: Optional[Transport] = None,
-                 rpc_bind: Optional[str] = None, tls=None) -> None:
+                 rpc_bind: Optional[str] = None, tls=None,
+                 wan_transport: Optional[Transport] = None) -> None:
         self.config = config
         self.name = config.node_name or f"server-{uuid.uuid4().hex[:8]}"
         self.node_id = config.node_id or str(uuid.uuid4())
@@ -121,10 +122,22 @@ class Server:
         if config.port("serf_wan") >= 0:  # -1 disables the WAN pool
             wan_tags = {"role": "consul", "dc": config.datacenter,
                         "id": self.node_id, "rpc_addr": self.rpc.addr}
+            wan_transport = wan_transport or UDPTransport(
+                config.bind_addr, config.port("serf_wan"))
+            if config.wan_federation_via_mesh_gateways:
+                # wanfed: cross-DC gossip tunnels through mesh gateways
+                # (agent/consul/wanfed; enabled by connect.
+                # enable_mesh_gateway_wan_federation)
+                from consul_tpu.gossip.wanfed import WanfedTransport
+
+                wan_transport = WanfedTransport(
+                    wan_transport, config.datacenter,
+                    dc_of=self._wan_dc_of,
+                    gateway_for=self._mesh_gateway_for)
+                self.rpc.gossip_ingest = wan_transport
             self.serf_wan = Serf(
                 name=f"{self.name}.{config.datacenter}",
-                transport=UDPTransport(config.bind_addr,
-                                       config.port("serf_wan")),
+                transport=wan_transport,
                 config=config.gossip_wan,
                 tags=wan_tags,
                 keyring=self._keyring())
@@ -195,6 +208,31 @@ class Server:
         from consul_tpu.gossip.messages import make_keyring
 
         return make_keyring(self.config.encrypt_key)
+
+    # ------------------------------------------------------------- wanfed
+
+    def _wan_dc_of(self, addr: str) -> Optional[str]:
+        """WAN transport addr → datacenter, from WAN member tags (the
+        reference routes by `name.dc`; our transport addresses need
+        this lookup instead)."""
+        if self.serf_wan is None:
+            return None
+        for m in self.serf_wan.members(include_left=True):
+            if m.addr == addr:
+                return m.tags.get("dc") or None
+        return None
+
+    def _mesh_gateway_for(self, dc: str) -> Optional[str]:
+        """Tunnel endpoint for a DC from the replicated federation-state
+        table (wanfed.go MeshGatewayResolver backed by
+        FederationStates)."""
+        fs = self.state.raw_get("federation_states", dc) or {}
+        for gw in fs.get("MeshGateways") or []:
+            addr = gw.get("Address", "")
+            port = gw.get("Port", 0)
+            if addr and port:
+                return f"{addr}:{port}"
+        return None
 
     # ------------------------------------------------------------- lifecycle
 

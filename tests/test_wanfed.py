@@ -1,0 +1,137 @@
+"""wanfed: WAN gossip through mesh-gateway tunnels.
+
+Reference: agent/consul/wanfed/wanfed.go:42-68 + pool.go — the VERDICT
+round-1 acceptance: "two-DC federation test where direct WAN UDP is
+disabled and gossip still flows."
+"""
+
+import time
+
+import pytest
+
+from consul_tpu.config import load
+from consul_tpu.gossip.transport import Transport, UDPTransport
+from consul_tpu.server import Server
+from consul_tpu.types import MemberStatus
+
+from helpers import wait_for  # noqa: E402
+
+
+class PacketFilter(Transport):
+    """Drops UDP gossip packets to blocked addrs (the 'no direct WAN
+    UDP between DCs' condition); streams pass through (the initial
+    join rides one)."""
+
+    def __init__(self, inner: Transport) -> None:
+        self.inner = inner
+        self.blocked: set[str] = set()
+        self.dropped = 0
+
+    @property
+    def addr(self) -> str:  # type: ignore[override]
+        return self.inner.addr
+
+    def set_handlers(self, on_packet, on_stream) -> None:
+        self.inner.set_handlers(on_packet, on_stream)
+
+    def send_packet(self, addr: str, payload: bytes) -> None:
+        if addr in self.blocked:
+            self.dropped += 1
+            return
+        self.inner.send_packet(addr, payload)
+
+    def stream_rpc(self, addr: str, payload: bytes,
+                   timeout: float = 10.0) -> bytes:
+        return self.inner.stream_rpc(addr, payload, timeout)
+
+    def shutdown(self) -> None:
+        self.inner.shutdown()
+
+
+FAST_WAN = {"probe_interval": 0.3, "probe_timeout": 0.15,
+            "gossip_interval": 0.1, "suspicion_mult": 3,
+            "disable_tcp_pings": True}
+
+
+def _dc_server(dc: str, wanfed: bool):
+    cfg = load(dev=True, overrides={
+        "node_name": f"{dc}-srv", "datacenter": dc, "server": True,
+        "bootstrap": True,
+        "gossip_wan": dict(FAST_WAN),
+        "connect": {"enable_mesh_gateway_wan_federation": wanfed}})
+    filt = PacketFilter(UDPTransport(cfg.bind_addr, 0))
+    srv = Server(cfg, wan_transport=filt)
+    srv.start()
+    return srv, filt
+
+
+def _federate(s1, f1, s2, f2):
+    wait_for(lambda: s1.is_leader() and s2.is_leader(),
+             what="both leaders")
+    # advertise each DC's "mesh gateway" — the tunnel endpoint is the
+    # remote server's RPC port (where a real deployment would put an
+    # SNI-routing gateway in front)
+    for target, other in ((s1, s2), (s2, s1)):
+        host, port = other.rpc.addr.rsplit(":", 1)
+        target.handle_rpc("Internal.FederationStateApply", {
+            "State": {"Datacenter": other.config.datacenter,
+                      "MeshGateways": [{"Address": host,
+                                        "Port": int(port)}]}}, "local")
+    w1 = s1.serf_wan.memberlist.transport.addr
+    w2 = s2.serf_wan.memberlist.transport.addr
+    # no direct WAN UDP in either direction, from the very start
+    f1.blocked.add(w2)
+    f2.blocked.add(w1)
+    assert s1.join_wan([w2]) == 1
+    wait_for(lambda: len(s1.wan_members()) == 2
+             and len(s2.wan_members()) == 2, what="wan membership")
+
+
+def test_gossip_flows_through_gateways_without_direct_udp():
+    s1, f1 = _dc_server("dc1", wanfed=True)
+    s2, f2 = _dc_server("dc2", wanfed=True)
+    try:
+        _federate(s1, f1, s2, f2)
+        # many probe rounds with direct UDP dead: members stay ALIVE
+        # because probes/acks tunnel through the gateways
+        time.sleep(4.0)
+        for s in (s1, s2):
+            statuses = {m.name: m.status for m in s.wan_members()}
+            assert all(st == MemberStatus.ALIVE
+                       for st in statuses.values()), statuses
+        # non-vacuity: cross-DC traffic actually rode gateway tunnels
+        # (the filter sits INSIDE the wanfed wrapper, so a correctly
+        # tunneling transport never even offers it a cross-DC packet)
+        assert s1.serf_wan.memberlist.transport._conns \
+            or s2.serf_wan.memberlist.transport._conns, \
+            "no gateway tunnel was ever opened"
+        # and the fabric is usable: cross-DC write through dc1
+        s1.handle_rpc("KVS.Apply", {
+            "Op": "set", "Datacenter": "dc2",
+            "DirEnt": {"Key": "wanfed/x", "Value": b"v"}}, "local")
+        wait_for(lambda: s2.state.kv_get("wanfed/x") is not None,
+                 what="cross-DC write")
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+
+
+def test_without_wanfed_blocked_udp_kills_membership():
+    """Control: same blocked network, wanfed off — failure detection
+    (correctly) declares the remote server suspect/dead."""
+    s1, f1 = _dc_server("dc3", wanfed=False)
+    s2, f2 = _dc_server("dc4", wanfed=False)
+    try:
+        _federate(s1, f1, s2, f2)
+
+        def degraded():
+            return any(m.status != MemberStatus.ALIVE
+                       for m in s1.wan_members()) \
+                or any(m.status != MemberStatus.ALIVE
+                       for m in s2.wan_members())
+
+        wait_for(degraded, timeout=20.0,
+                 what="membership degradation without wanfed")
+    finally:
+        s1.shutdown()
+        s2.shutdown()
