@@ -166,6 +166,14 @@ class RuntimeConfig:
     # → agent/consul/wanfed transport wrap, server_serf.go:198-213)
     wan_federation_via_mesh_gateways: bool = False
 
+    # Network segments (reference: agent/consul/segment_ce.go,
+    # server_serf.go:52): isolated LAN gossip pools within one DC.
+    # `segment` is THIS agent's segment ("" = the default segment);
+    # `segments` (servers only) declares the additional pools the server
+    # joins: ({"name": ..., "port": ...}, ...)
+    segment: str = ""
+    segments: tuple = ()
+
     # Anti-entropy (reference: agent/ae/ae.go:57)
     sync_coalesce_timeout: float = 0.2
 
@@ -259,6 +267,7 @@ _CONFIG_ALIASES = {
     "domain": "dns_domain",
     "enable_remote_exec": "enable_remote_exec",
     "tombstone_ttl": "tombstone_ttl",
+    "segment": "segment",
 }
 
 class ConfigError(Exception):
@@ -356,6 +365,10 @@ def load(
     if "enable_mesh_gateway_wan_federation" in connect_blk:
         kwargs["wan_federation_via_mesh_gateways"] = bool(
             connect_blk["enable_mesh_gateway_wan_federation"])
+    if "segments" in raw:
+        kwargs["segments"] = tuple(
+            {"name": s.get("name", ""), "port": int(s.get("port", 0))}
+            for s in raw["segments"])
     if "telemetry" in raw:
         tel = {k: v for k, v in raw["telemetry"].items()
                if k in {f.name for f in dataclasses.fields(TelemetryConfig)}}

@@ -91,6 +91,29 @@ class LamportClock:
                 self._time = t
 
 
+def segment_merge_check(datacenter: str, segment: str):
+    """The lan merge delegate shared by servers and clients
+    (agent/consul/merge.go + segment_ce.go): refuse members from other
+    datacenters, and refuse members tagged for other segments — servers
+    excepted, they live in every segment pool."""
+
+    def check(peers) -> Optional[str]:
+        for p in peers:
+            tags = getattr(p, "tags", {}) or {}
+            if tags.get("dc") and tags["dc"] != datacenter:
+                return (f"member {p.name} is from datacenter "
+                        f"{tags['dc']!r}, this pool is {datacenter!r}")
+            if tags.get("role") == "consul":
+                continue
+            if tags.get("segment", "") != segment:
+                return (f"member {p.name} is in segment "
+                        f"{tags.get('segment', '')!r}, this pool is "
+                        f"{segment!r}")
+        return None
+
+    return check
+
+
 class Serf(MemberlistDelegate):
     """Tags + events + user events + reaping over a Memberlist."""
 
@@ -106,9 +129,14 @@ class Serf(MemberlistDelegate):
         scheduler=None,
         keyring=None,
         seed: Optional[int] = None,
+        merge_check=None,
     ) -> None:
         self.name = name
         self.config = config or GossipConfig.lan()
+        # pre-join validation hook (the reference's lan/wan merge
+        # delegates, agent/consul/merge.go): returns an error string to
+        # refuse the merge. Network segments ride this seam.
+        self.merge_check = merge_check
         self.log = log.named(f"serf.{name}")
         self.metrics = telemetry.default
         self._handlers: list[Callable[[SerfEvent], None]] = []
@@ -297,6 +325,11 @@ class Serf(MemberlistDelegate):
         return distance(ca, cb)
 
     # ----------------------------------------------------- delegate callbacks
+
+    def notify_merge(self, peers) -> Optional[str]:
+        if self.merge_check is not None:
+            return self.merge_check(peers)
+        return None
 
     def notify_join(self, node: NodeState) -> None:
         self._emit(SerfEvent(EventType.MEMBER_JOIN, members=[node]))

@@ -746,6 +746,19 @@ class Memberlist:
             raise ValueError(f"unexpected push/pull reply type {t}")
         if "error" in body:
             raise ConnectionError(f"merge rejected: {body['error']}")
+        if join:
+            # BOTH sides validate a join merge (memberlist runs the
+            # merge delegate on initiator and acceptor): an acceptor
+            # without our policy must not hand us foreign-DC/segment
+            # members
+            peers = [NodeState(name=d["name"], addr=d["addr"],
+                               incarnation=d["inc"],
+                               status=MemberStatus(d["status"]),
+                               tags=d.get("tags") or {})
+                     for d in body.get("nodes") or []]
+            err = self.delegate.notify_merge(peers)
+            if err:
+                raise ConnectionError(f"merge rejected locally: {err}")
         self._merge_state(body.get("nodes") or [])
 
     def _on_stream(self, src: str, raw: bytes) -> bytes:

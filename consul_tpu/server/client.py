@@ -46,10 +46,15 @@ class Client:
         self._servers: list[str] = []
         self.rng = random.Random()
 
-        tags = {"role": "node", "dc": config.datacenter, "id": self.node_id}
+        tags = {"role": "node", "dc": config.datacenter, "id": self.node_id,
+                "segment": config.segment}
         from consul_tpu.gossip.messages import make_keyring
+        from consul_tpu.gossip.serf import segment_merge_check
 
         keyring = make_keyring(config.encrypt_key)
+        merge_check = segment_merge_check(config.datacenter,
+                                          config.segment)
+
         self.serf = Serf(
             name=self.name,
             transport=serf_transport or UDPTransport(
@@ -58,7 +63,8 @@ class Client:
             config=config.gossip_lan,
             tags=tags,
             event_handler=self._serf_event,
-            keyring=keyring)
+            keyring=keyring,
+            merge_check=merge_check)
 
     def start(self) -> None:
         self.serf.start()

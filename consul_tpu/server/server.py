@@ -141,6 +141,18 @@ class Server:
                 config=config.gossip_wan,
                 tags=wan_tags,
                 keyring=self._keyring())
+        # Network segments: one EXTRA isolated LAN pool per declared
+        # segment; servers sit in every pool (segment_ce.go,
+        # server_serf.go:52), agents only in theirs. Transports come
+        # first so the default pool's tags can advertise every segment
+        # listener (seg:<name>) — that is what lets servers flood-join
+        # each other's segment pools (router.FloodJoins covers segment
+        # ports in the reference).
+        seg_transports: dict[str, Transport] = {}
+        for seg in config.segments:
+            if seg.get("name"):
+                seg_transports[seg["name"]] = UDPTransport(
+                    config.bind_addr, int(seg.get("port", 0)))
         tags = {
             "role": "consul", "dc": config.datacenter, "id": self.node_id,
             "rpc_addr": self.rpc.addr,
@@ -148,9 +160,13 @@ class Server:
             "bootstrap": "1" if config.bootstrap else "0",
             "wan_addr": (self.serf_wan.memberlist.transport.addr
                          if self.serf_wan else ""),
+            "segment": "",
+            **{f"seg:{n}": t.addr for n, t in seg_transports.items()},
         }
         self._reconcile_ch: list[SerfEvent] = []
         self._reconcile_lock = threading.Lock()
+        from consul_tpu.gossip.serf import segment_merge_check
+
         self.serf = Serf(
             name=self.name,
             transport=serf_transport or UDPTransport(
@@ -159,7 +175,19 @@ class Server:
             config=config.gossip_lan,
             tags=tags,
             event_handler=self._serf_event,
-            keyring=self._keyring())
+            keyring=self._keyring(),
+            merge_check=segment_merge_check(config.datacenter, ""))
+        self.segment_serfs: dict[str, Serf] = {}
+        for seg_name, transport in seg_transports.items():
+            self.segment_serfs[seg_name] = Serf(
+                name=self.name,
+                transport=transport,
+                config=config.gossip_lan,
+                tags={**tags, "segment": seg_name},
+                event_handler=self._segment_event,
+                keyring=self._keyring(),
+                merge_check=segment_merge_check(config.datacenter,
+                                                seg_name))
 
         # ACL resolver over the replicated token/policy tables
         # (reference: ACLResolver embedded in Server, server.go:180)
@@ -209,6 +237,45 @@ class Server:
 
         return make_keyring(self.config.encrypt_key)
 
+    def _segment_event(self, ev: SerfEvent) -> None:
+        """Segment-pool events feed reconcile for AGENTS only: the
+        default pool is authoritative for servers, so a segment-pool
+        partition must never fail (or on reap, DEREGISTER) a server the
+        default pool still sees alive."""
+        from consul_tpu.gossip.serf import EventType as ET
+
+        if ev.type not in (ET.MEMBER_JOIN, ET.MEMBER_FAILED,
+                           ET.MEMBER_LEAVE, ET.MEMBER_REAP,
+                           ET.MEMBER_UPDATE):
+            return
+        members = [m for m in ev.members
+                   if m.tags.get("role") != "consul"]
+        if not members:
+            return
+        with self._reconcile_lock:
+            self._reconcile_ch.append(
+                SerfEvent(ev.type, members=members))
+
+    def _flood_segments(self) -> None:
+        """Servers join each other's segment pools via the seg:<name>
+        addresses advertised on the default LAN pool."""
+        if not self.segment_serfs:
+            return
+        for m in self.serf.members():
+            if m.tags.get("role") != "consul" or m.name == self.name:
+                continue
+            for seg_name, pool in self.segment_serfs.items():
+                addr = m.tags.get(f"seg:{seg_name}")
+                if not addr:
+                    continue
+                known = {x.addr for x in pool.members()}
+                if addr not in known:
+                    try:
+                        pool.join([addr])
+                    except Exception as e:  # noqa: BLE001
+                        self.log.debug("segment %s flood join %s: %s",
+                                       seg_name, addr, e)
+
     # ------------------------------------------------------------- wanfed
 
     def _wan_dc_of(self, addr: str) -> Optional[str]:
@@ -243,6 +310,8 @@ class Server:
             self.raft.start()
             self._maybe_bootstrapped = True
         self.serf.start()
+        for s in self.segment_serfs.values():
+            s.start()
         if self.serf_wan is not None:
             self.serf_wan.start()
             if self.config.retry_join_wan:
@@ -272,6 +341,8 @@ class Server:
             if t is not None:
                 t.cancel()
         self.serf.shutdown()
+        for s in self.segment_serfs.values():
+            s.shutdown()
         if self.serf_wan is not None:
             self.serf_wan.shutdown()
         self.raft.shutdown()
@@ -324,6 +395,17 @@ class Server:
 
     def wan_members(self):
         return self.serf_wan.members() if self.serf_wan else []
+
+    def segment_members(self, segment: str = ""):
+        """Members of one segment pool ("" = the default LAN pool)."""
+        if not segment:
+            return self.serf.members()
+        pool = self.segment_serfs.get(segment)
+        return pool.members() if pool else []
+
+    def segment_addr(self, segment: str) -> Optional[str]:
+        pool = self.segment_serfs.get(segment)
+        return pool.memberlist.transport.addr if pool else None
 
     def datacenters(self) -> list[str]:
         dcs = {self.config.datacenter}
@@ -547,7 +629,9 @@ class Server:
     def _flood_join(self) -> None:
         """Flood joiner (server_serf.go FloodJoins): every LAN server's
         WAN address is pushed into the WAN pool, so operators only ever
-        `join -wan` ONE server per DC and the rest follow."""
+        `join -wan` ONE server per DC and the rest follow. Segment pools
+        flood the same way off the seg:<name> tags."""
+        self._flood_segments()
         if self.serf_wan is None:
             return
         wan_names = {m.name for m in self.serf_wan.members()}
@@ -727,6 +811,13 @@ class Server:
         if not self.is_leader():
             return
         members = {m.name: m for m in self.serf.members(include_left=True)}
+        # segment-pool AGENTS too (drift repair must cover every pool;
+        # servers stay authoritative in the default pool only)
+        for pool in self.segment_serfs.values():
+            for m in pool.members(include_left=True):
+                if m.tags.get("role") != "consul" \
+                        and m.name not in members:
+                    members[m.name] = m
         catalog = {n.node for n in self.state.nodes()}
         for name, m in members.items():
             ev = {MemberStatus.ALIVE: EventType.MEMBER_JOIN,

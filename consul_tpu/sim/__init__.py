@@ -35,11 +35,11 @@ from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import SimState, init_state, ALIVE, SUSPECT, DEAD, LEFT
 from consul_tpu.sim.round import gossip_round, run_rounds, make_run_rounds
 from consul_tpu.sim.mesh import (make_sharded_run, make_mesh,
-                                 make_multidc_run)
+                                 make_multidc_run, make_segmented_run)
 
 __all__ = [
     "SimParams", "SimState", "init_state", "gossip_round", "run_rounds",
     "make_run_rounds", "make_sharded_run", "make_mesh",
-    "make_multidc_run",
+    "make_multidc_run", "make_segmented_run",
     "ALIVE", "SUSPECT", "DEAD", "LEFT",
 ]

@@ -108,6 +108,17 @@ def make_multidc_run(p: SimParams, rounds: int, mesh: Mesh):
     return _make_mesh_run(p, rounds, mesh, ("nodes",))
 
 
+def make_segmented_run(p: SimParams, rounds: int, mesh: Mesh):
+    """Network segments as a sim axis (agent/consul/segment_ce.go):
+    isolated LAN gossip pools WITHIN one datacenter. Mechanically
+    identical to the multi-DC shape — each mesh row along the "dc"
+    axis is one segment's pool and population scalars psum over
+    "nodes" only — so this shares make_multidc_run's kernel; the
+    distinct entry point keeps the framework axis (Server.segment_serfs)
+    and its sim twin visibly paired. p.n is the PER-SEGMENT pool size."""
+    return _make_mesh_run(p, rounds, mesh, ("nodes",))
+
+
 def init_sharded_state(n: int, mesh: Mesh) -> SimState:
     """Device-placed initial state with the node axis partitioned."""
     shardings = state_sharding(mesh)
