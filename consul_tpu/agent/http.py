@@ -68,6 +68,8 @@ class HTTPApi:
                         else json.dumps(result).encode())
                     ctype = "application/octet-stream" \
                         if isinstance(result, bytes) else "application/json"
+                    if path == "/" or path.startswith("/ui"):
+                        ctype = "text/html; charset=utf-8"
                     self.send_response(200)
                     if index is not None:
                         self.send_header("X-Consul-Index", str(index))
@@ -171,6 +173,14 @@ class HTTPApi:
                 return json.loads(body)
             except json.JSONDecodeError as e:
                 raise HTTPError(400, f"invalid JSON body: {e}") from e
+
+        # --------------------------------------------------------------- UI
+        if path == "/" or path == "/ui" or path.startswith("/ui/"):
+            # the web UI (agent/uiserver pattern): one self-contained
+            # page over the /v1/internal/ui data API
+            from consul_tpu.agent.ui import INDEX_HTML
+
+            return INDEX_HTML.encode(), None
 
         # ---------------------------------------------------------- status
         if path == "/v1/status/leader":

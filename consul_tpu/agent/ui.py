@@ -1,0 +1,174 @@
+"""The web UI: a single self-contained page served at /ui.
+
+Reference: ui/packages/consul-ui (an 841-file Ember app) served by
+agent/uiserver. This is deliberately NOT a port of that app — it is a
+dependency-free page over the same UI data API the reference's app
+consumes (ui_endpoint.go analogues at /v1/internal/ui/*), covering the
+operator's daily loop: service health rollups, node check detail, and
+KV browsing, live-updating via blocking queries (X-Consul-Index
+long-polls, the same change feed the Ember app rides)."""
+
+from __future__ import annotations
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>consul-tpu</title>
+<style>
+  :root { --ok:#0a7d43; --warn:#b8860b; --crit:#b3261e; --mut:#6b7280;
+          --line:#e5e7eb; --bg:#f9fafb; }
+  * { box-sizing:border-box; }
+  body { font:14px/1.45 system-ui,sans-serif; margin:0; color:#111827;
+         background:var(--bg); }
+  header { background:#1f2430; color:#fff; padding:10px 20px;
+           display:flex; gap:24px; align-items:baseline; }
+  header h1 { font-size:16px; margin:0; letter-spacing:.4px; }
+  header nav a { color:#cbd5e1; text-decoration:none; margin-right:16px;
+                 padding-bottom:2px; }
+  header nav a.active { color:#fff; border-bottom:2px solid #60a5fa; }
+  main { max-width:980px; margin:20px auto; padding:0 16px; }
+  table { width:100%; border-collapse:collapse; background:#fff;
+          border:1px solid var(--line); }
+  th,td { text-align:left; padding:8px 12px;
+          border-bottom:1px solid var(--line); }
+  th { background:#f3f4f6; font-weight:600; }
+  .dot { display:inline-block; width:10px; height:10px;
+         border-radius:50%; margin-right:6px; vertical-align:middle; }
+  .passing { background:var(--ok); } .warning { background:var(--warn); }
+  .critical { background:var(--crit); }
+  .tag { background:#eef2ff; border-radius:3px; padding:1px 6px;
+         margin-right:4px; font-size:12px; }
+  .mut { color:var(--mut); font-size:12px; }
+  input[type=text] { padding:6px 10px; border:1px solid var(--line);
+                     border-radius:4px; width:320px; }
+  pre { background:#fff; border:1px solid var(--line); padding:10px;
+        overflow:auto; }
+  .crumb a { text-decoration:none; }
+</style>
+</head>
+<body>
+<header>
+  <h1>consul-tpu</h1>
+  <nav id="nav">
+    <a href="#services">Services</a>
+    <a href="#nodes">Nodes</a>
+    <a href="#kv">Key/Value</a>
+  </nav>
+  <span class="mut" id="meta"></span>
+</header>
+<main id="view">Loading…</main>
+<script>
+"use strict";
+const $ = (s) => document.querySelector(s);
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+let index = {};   // per-view X-Consul-Index for blocking refresh
+let aborter = null;
+
+async function fetchIdx(url, key, wait) {
+  // blocking query: long-poll on the view's last seen index
+  const u = new URL(url, location.origin);
+  if (wait && index[key]) {
+    u.searchParams.set("index", index[key]);
+    u.searchParams.set("wait", "25s");
+  }
+  const r = await fetch(u, {signal: aborter.signal});
+  index[key] = r.headers.get("X-Consul-Index") || 0;
+  return r.json();
+}
+
+function dot(status) {
+  return `<span class="dot ${esc(status)}"></span>`;
+}
+
+async function services(wait) {
+  const rows = await fetchIdx("/v1/internal/ui/services", "svc", wait);
+  $("#view").innerHTML = `<table><tr><th>Service</th><th>Health</th>
+    <th>Instances</th><th>Tags</th></tr>` + rows.map((s) => `<tr>
+    <td>${dot(s.Status)}${esc(s.Name)}
+        ${s.Kind ? `<span class="mut">(${esc(s.Kind)})</span>` : ""}</td>
+    <td>${s.ChecksPassing} passing${s.ChecksWarning
+          ? `, ${s.ChecksWarning} warning` : ""}${s.ChecksCritical
+          ? `, ${s.ChecksCritical} critical` : ""}</td>
+    <td>${s.InstanceCount}</td>
+    <td>${(s.Tags || []).map((t) => `<span class="tag">${esc(t)}</span>`)
+         .join("")}</td></tr>`).join("") + "</table>";
+}
+
+async function nodes(wait) {
+  const rows = await fetchIdx("/v1/internal/ui/nodes", "node", wait);
+  $("#view").innerHTML = `<table><tr><th>Node</th><th>Address</th>
+    <th>Checks</th></tr>` + rows.map((n) => `<tr>
+    <td>${esc(n.Node)}</td><td>${esc(n.Address)}</td>
+    <td>${(n.Checks || []).map((c) =>
+      `${dot(c.Status)}<span title="${esc(c.Output)}">${esc(c.Name)}
+       </span>`).join(" &nbsp; ")}</td></tr>`).join("") + "</table>";
+}
+
+async function kv(wait, prefix) {
+  prefix = prefix ?? (location.hash.split(":")[1] || "");
+  const u = `/v1/kv/${encodeURIComponent(prefix).replaceAll("%2F", "/")}` +
+            `?keys&separator=/`;
+  let keys = [];
+  try { keys = await fetchIdx(u, "kv:" + prefix, wait); }
+  catch (e) { keys = []; }
+  const crumb = ["<a href='#kv'>kv</a>"];
+  let acc = "";
+  for (const part of prefix.split("/").filter(Boolean)) {
+    acc += part + "/";
+    crumb.push(`<a href="#kv:${esc(acc)}">${esc(part)}</a>`);
+  }
+  const rows = (Array.isArray(keys) ? keys : []).map((k) =>
+    k.endsWith("/")
+      ? `<tr><td><a href="#kv:${esc(k)}">📁 ${esc(k.slice(prefix.length))}
+         </a></td></tr>`
+      : `<tr><td><a href="#kvval:${esc(k)}">${esc(k.slice(prefix.length))}
+         </a></td></tr>`).join("");
+  $("#view").innerHTML = `<p class="crumb">${crumb.join(" / ")}</p>
+    <table><tr><th>Key</th></tr>${rows ||
+      "<tr><td class='mut'>(empty)</td></tr>"}</table>`;
+}
+
+async function kvval() {
+  const key = location.hash.slice("#kvval:".length);
+  const r = await fetch(`/v1/kv/${key}`);
+  const e = r.ok ? (await r.json())[0] : null;
+  const val = e && e.Value ? atob(e.Value) : "";
+  const up = key.includes("/")
+    ? key.slice(0, key.lastIndexOf("/") + 1) : "";
+  $("#view").innerHTML = `<p class="crumb">
+      <a href="#kv:${esc(up)}">← back</a></p>
+    <h3>${esc(key)}</h3><pre>${esc(val)}</pre>
+    <p class="mut">ModifyIndex ${e ? e.ModifyIndex : "?"} ·
+       Flags ${e ? e.Flags : "?"}</p>`;
+}
+
+const views = {services, nodes, kv};
+async function route() {
+  if (aborter) aborter.abort();
+  aborter = new AbortController();
+  const tab = (location.hash || "#services").slice(1).split(":")[0];
+  document.querySelectorAll("#nav a").forEach((a) =>
+    a.classList.toggle("active", a.hash.slice(1) === tab ||
+      (tab === "kvval" && a.hash === "#kv")));
+  try {
+    if (tab === "kvval") { await kvval(); return; }
+    const fn = views[tab] || services;
+    await fn(false);
+    while (tab !== "kv") { await fn(true); }  // live updates
+  } catch (e) { /* aborted on navigation */ }
+}
+window.addEventListener("hashchange", route);
+(async () => {
+  try {
+    const cfg = await (await fetch("/v1/agent/self")).json();
+    $("#meta").textContent =
+      `${cfg.Config?.NodeName ?? ""} · ${cfg.Config?.Datacenter ?? ""}`;
+  } catch (e) { /* agent/self optional */ }
+  route();
+})();
+</script>
+</body>
+</html>
+"""

@@ -618,3 +618,17 @@ def test_ui_data_endpoints(agent, client):
     web = next(s for s in svcs if s["Name"] == "web")
     assert web["InstanceCount"] >= 1
     assert web["Status"] in ("passing", "warning", "critical")
+
+
+def test_web_ui_served(agent, client):
+    """/ui serves the self-contained page (agent/uiserver pattern)."""
+    import urllib.request
+
+    for path in ("/ui", "/"):
+        with urllib.request.urlopen(
+                f"http://{agent.http.addr}{path}") as r:
+            assert r.status == 200
+            assert "text/html" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "consul-tpu" in body
+        assert "/v1/internal/ui/services" in body  # data API wired
