@@ -566,6 +566,63 @@ class Agent:
         else:
             self.local.remove_check("_node_maintenance")
 
+    def reload(self) -> list[str]:
+        """`consul reload` / SIGHUP (agent/agent.go ReloadConfig): the
+        hot-reloadable subset — TLS material from disk and the log
+        level. Gossip/port topology needs a restart, as in the
+        reference."""
+        reloaded = []
+        if self.tls is not None:
+            self.tls.reload()
+            reloaded.append("tls")
+        from consul_tpu.utils import log as log_mod
+
+        log_mod.setup(self.config.log_level)
+        reloaded.append("log_level")
+        return reloaded
+
+    def set_service_maintenance(self, service_id: str, enable: bool,
+                                reason: str = "") -> bool:
+        """Per-service maintenance mode (agent/agent.go
+        EnableServiceMaintenance): a synthetic critical check scoped to
+        the service pulls it from discovery without touching the node."""
+        if service_id not in self.local.list_services():
+            return False
+        cid = f"_service_maintenance:{service_id}"
+        if enable:
+            self.local.add_check(LocalCheck(
+                check_id=cid, name="Service Maintenance Mode",
+                status=CheckStatus.CRITICAL, service_id=service_id,
+                notes=reason or "Maintenance mode is enabled",
+                output=reason))
+        else:
+            self.local.remove_check(cid)
+        return True
+
+    def service_health(self, service_id: str = "",
+                       service_name: str = "") -> list[dict]:
+        """Agent-local health rollup per service instance
+        (agent/agent_endpoint.go AgentHealthServiceByID/Name):
+        [{ServiceID, ServiceName, AggregatedStatus}]."""
+        checks = self.local.list_checks().values()
+        out = []
+        for sid, svc in self.local.list_services().items():
+            if service_id and sid != service_id:
+                continue
+            if service_name and svc.service != service_name:
+                continue
+            mine = [c.status for c in checks
+                    if c.service_id in ("", sid)]
+            if CheckStatus.CRITICAL in mine:
+                agg = "critical"
+            elif CheckStatus.WARNING in mine:
+                agg = "warning"
+            else:
+                agg = "passing"
+            out.append({"ServiceID": sid, "ServiceName": svc.service,
+                        "AggregatedStatus": agg})
+        return out
+
     # ------------------------------------------------------------- internals
 
     def _handle_exec(self, payload: bytes, from_node: str) -> bytes:

@@ -98,7 +98,11 @@ class HTTPApi:
                     return
                 payload = msg.encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "text/plain")
+                # structured error bodies (the agent-health 429/503
+                # contract carries JSON rows) keep their content type
+                ctype = "application/json" \
+                    if msg[:1] in ("[", "{") else "text/plain"
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -285,6 +289,37 @@ class HTTPApi:
         if path == "/v1/agent/force-leave" or \
                 re.match(r"^/v1/agent/force-leave/(.+)$", path):
             return None, None  # accepted; reaping handles the rest
+        if (m := re.match(r"^/v1/agent/service/maintenance/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            sid = urllib.parse.unquote(m.group(1))
+            svc = a.local.list_services().get(sid)
+            if svc is None:
+                raise HTTPError(404, "unknown service id")
+            rpc("Internal.ServiceWrite", {"Service": svc.service})
+            a.set_service_maintenance(
+                sid, q.get("enable", "true") == "true",
+                q.get("reason", ""))
+            return None, None
+        if (m := re.match(r"^/v1/agent/health/service/(id|name)/(.+)$",
+                          path)):
+            key = urllib.parse.unquote(m.group(2))
+            rows = a.service_health(
+                service_id=key if m.group(1) == "id" else "",
+                service_name=key if m.group(1) == "name" else "")
+            if not rows:
+                raise HTTPError(404, "no such service")
+            worst = {"critical": 2, "warning": 1, "passing": 0}
+            agg = max(rows, key=lambda r: worst[r["AggregatedStatus"]])
+            status = agg["AggregatedStatus"]
+            # reference status-code contract: 200/429/503 by health
+            if status == "critical":
+                raise HTTPError(503, json.dumps(rows))
+            if status == "warning":
+                raise HTTPError(429, json.dumps(rows))
+            return rows, None
+        if path == "/v1/agent/reload" and method in ("PUT", "POST"):
+            rpc("Internal.AgentWrite", {})
+            return {"Reloaded": a.reload()}, None
 
         # --------------------------------------------------------- catalog
         if path == "/v1/catalog/datacenters":
@@ -308,6 +343,39 @@ class HTTPApi:
             res = rpc("Catalog.NodeServices", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
             return res["NodeServices"], res["Index"]
+        if (m := re.match(r"^/v1/catalog/node-services/(.+)$", path)):
+            # the LIST-shaped variant (catalog_endpoint.go
+            # CatalogNodeServiceList)
+            res = rpc("Catalog.NodeServices", blocking_args(
+                {"Node": urllib.parse.unquote(m.group(1))}))
+            ns = res["NodeServices"]
+            out = None if ns is None else {
+                "Node": ns["Node"],
+                "Services": list((ns.get("Services") or {}).values())}
+            return out, res["Index"]
+        if (m := re.match(r"^/v1/catalog/gateway-services/(.+)$", path)):
+            res = rpc("Internal.GatewayServices", blocking_args(
+                {"Gateway": urllib.parse.unquote(m.group(1))}))
+            return res["Services"], res["Index"]
+        if (m := re.match(r"^/v1/discovery-chain/(.+)$", path)):
+            res = rpc("Internal.DiscoveryChain", blocking_args(
+                {"Name": urllib.parse.unquote(m.group(1))}))
+            return res["Chain"], res["Index"]
+        if path == "/v1/exported-services":
+            return rpc("Internal.ExportedServices", {})["Services"], None
+        if path == "/v1/internal/service-virtual-ip":
+            from consul_tpu.connect.virtualip import virtual_ip
+
+            svc = q.get("service", "")
+            if not svc:
+                raise HTTPError(400, "service query param required")
+            return {"Service": svc, "VirtualIP": virtual_ip(svc)}, None
+        if (m := re.match(r"^/v1/internal/ui/service-topology/(.+)$",
+                          path)):
+            res = rpc("Internal.ServiceTopology", blocking_args(
+                {"ServiceName": urllib.parse.unquote(m.group(1))}))
+            idx = res.pop("Index", None)
+            return res, idx
         if path == "/v1/catalog/register" and method in ("PUT", "POST"):
             return rpc("Catalog.Register", jbody()), None
         if path == "/v1/catalog/deregister" and method in ("PUT", "POST"):
@@ -327,6 +395,28 @@ class HTTPApi:
                 "Near": q.get("near", ""),
                 "MustBePassing": "passing" in q}))
             return res["Nodes"], res.get("Index")
+        if (m := re.match(r"^/v1/health/ingress/(.+)$", path)):
+            # health of the INGRESS GATEWAYS fronting a service
+            # (health_endpoint.go IngressServiceNodes)
+            svc = urllib.parse.unquote(m.group(1))
+            out = []
+            idx = 1
+            entries = rpc("ConfigEntry.List",
+                          {"Kind": "ingress-gateway"})["Entries"]
+            for entry in entries:
+                fronted = {s.get("Name") for lst in
+                           entry.get("Listeners") or []
+                           for s in lst.get("Services") or []}
+                if svc in fronted or "*" in fronted:
+                    # inner lookups are NON-blocking (no index/wait
+                    # pass-through: each would park against a foreign
+                    # composite index); the composite result index is
+                    # the max of the parts
+                    res = rpc("Health.ServiceNodes",
+                              {"ServiceName": entry.get("Name", "")})
+                    out.extend(res["Nodes"])
+                    idx = max(idx, res.get("Index", 1))
+            return out, idx
         if (m := re.match(r"^/v1/health/service/(.+)$", path)):
             args = blocking_args({"ServiceName":
                                   urllib.parse.unquote(m.group(1))})
@@ -403,6 +493,11 @@ class HTTPApi:
             res = rpc("Coordinate.Node", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
             return res["Coordinates"], res["Index"]
+        if path == "/v1/coordinate/update" and method in ("PUT", "POST"):
+            b = jbody()
+            rpc("Coordinate.Update", {"Node": b.get("Node", ""),
+                                      "Coord": b.get("Coord") or {}})
+            return True, None
 
         # ------------------------------------------------------------- txn
         if path == "/v1/txn" and method in ("PUT", "POST"):
@@ -457,6 +552,14 @@ class HTTPApi:
         if (m := re.match(r"^/v1/agent/connect/ca/leaf/(.+)$", path)):
             svc = urllib.parse.unquote(m.group(1))
             return a.leaf_cert(svc, rpc), None
+        if path == "/v1/connect/ca/configuration":
+            # provider config WITHOUT key material (connect_ca_endpoint)
+            roots = rpc("ConnectCA.Roots", blocking_args())
+            return {"Provider": "consul-tpu-builtin",
+                    "Config": {"RotationPeriod": "2160h"},
+                    "State": {"Roots": len(roots.get("Roots") or []),
+                              "TrustDomain": roots.get("TrustDomain",
+                                                       "")}}, None
         if path == "/v1/connect/ca/rotate" and method in ("PUT", "POST"):
             return rpc("ConnectCA.Rotate", {}), None
         if path == "/v1/connect/intentions":
@@ -492,6 +595,28 @@ class HTTPApi:
                     "Reason": res["Reason"]}, None
 
         # ------------------------------------------------------------- acl
+        if path == "/v1/acl/token/self":
+            return rpc("ACL.TokenSelf", {})["Token"], None
+        if path == "/v1/acl/replication":
+            return rpc("ACL.ReplicationStatus", {}), None
+        if path == "/v1/internal/acl/authorize" and \
+                method in ("PUT", "POST"):
+            return rpc("ACL.Authorize", {"Requests": jbody()}), None
+        if path == "/v1/acl/templated-policies":
+            # the builtin templated policies the resolver synthesizes
+            # (acl/policy_templated.go)
+            return {
+                "builtin/service": {"TemplateName": "builtin/service",
+                                    "Schema": "{\"Name\": \"string\"}"},
+                "builtin/node": {"TemplateName": "builtin/node",
+                                 "Schema": "{\"Name\": \"string\"}"},
+            }, None
+        if (m := re.match(r"^/v1/acl/templated-policy/name/(.+)$", path)):
+            name = urllib.parse.unquote(m.group(1))
+            if name not in ("builtin/service", "builtin/node"):
+                raise HTTPError(404, "unknown templated policy")
+            return {"TemplateName": name,
+                    "Schema": "{\"Name\": \"string\"}"}, None
         if path == "/v1/acl/bootstrap" and method in ("PUT", "POST"):
             return rpc("ACL.Bootstrap", {}), None
         if path == "/v1/acl/token" and method in ("PUT", "POST"):
@@ -757,6 +882,13 @@ class HTTPApi:
             finally:
                 detach()
             return "\n".join(lines).encode(), None
+        if path == "/v1/operator/raft/transfer-leader" and \
+                method in ("PUT", "POST"):
+            return rpc("Operator.RaftTransferLeader",
+                       {"Address": q.get("id", q.get("address", ""))}), \
+                None
+        if path == "/v1/operator/usage":
+            return rpc("Operator.Usage", {})["Usage"], None
         if path == "/v1/operator/raft/peer" and method == "DELETE":
             rpc("Operator.RaftRemovePeer",
                 {"Address": q.get("address", "")})

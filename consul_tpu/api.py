@@ -104,6 +104,9 @@ class ConsulClient:
     def check_fail(self, check_id: str, note: str = "") -> None:
         self.put(f"/v1/agent/check/fail/{check_id}", note=note or None)
 
+    def check_warn(self, check_id: str, note: str = "") -> None:
+        self.put(f"/v1/agent/check/warn/{check_id}", note=note or None)
+
     def join(self, addr: str) -> None:
         self.put(f"/v1/agent/join/{addr}")
 

@@ -220,6 +220,38 @@ class RaftNode:
             self._match_index.pop(addr, None)
         self._replicate_all()
 
+    def transfer_leadership(self, target: str,
+                            timeout: float = 5.0) -> None:
+        """Leadership transfer (raft thesis §3.10 / hashicorp/raft
+        LeadershipTransfer): catch the target up, then send TimeoutNow
+        so it opens an election immediately — it wins because its log
+        is current and its term is newer than ours."""
+        with self._lock:
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            if target == self.transport.addr:
+                return
+            if target not in self.peers:
+                raise ValueError(f"{target!r} is not a raft peer")
+            term = self.store.term
+            last = self.store.last_index()
+        # wall-clock deadline: the catch-up loop sleeps real time, so a
+        # SimClock deadline would never advance and the handler thread
+        # would spin forever on an unreachable target
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            self._replicate_all()
+            with self._lock:
+                if self._match_index.get(target, 0) >= last:
+                    break
+            _time.sleep(0.05)
+        else:
+            raise ApplyTimeout(f"{target} never caught up for transfer")
+        self.transport.call(target, "timeout_now", {"term": term},
+                            timeout=timeout)
+
     def stats(self) -> dict[str, Any]:
         with self._lock:
             return {
@@ -565,6 +597,15 @@ class RaftNode:
             return self._on_append_entries(args)
         if method == "install_snapshot":
             return self._on_install_snapshot(args)
+        if method == "timeout_now":
+            # leadership transfer: start an election NOW, even though
+            # the leader is alive (thesis §3.10 — the sender asked)
+            with self._lock:
+                stale = args.get("term", 0) < self.store.term \
+                    or self._stopped
+            if not stale:
+                self.scheduler.after(0.0, self._start_election)
+            return {"term": self.store.term}
         raise ValueError(f"unknown raft rpc {method}")
 
     def _on_request_vote(self, args: dict[str, Any]) -> dict[str, Any]:

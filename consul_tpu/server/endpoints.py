@@ -1416,3 +1416,180 @@ def register_endpoints(srv) -> None:
         return srv.join_wan(list(args.get("Addrs") or []))
 
     e["Internal.JoinWAN"] = join_wan
+
+    # ----------------------------------------- round-2 breadth endpoints
+    def raft_transfer_leader(args):
+        """operator/raft/transfer-leader (operator_endpoint.go): hand
+        leadership to a named peer, or the most caught-up follower."""
+        require(authz(args).operator_write(), "operator write")
+        target = args.get("Address", "")
+        if not target:
+            candidates = [p for p in srv.raft.peers if p != srv.rpc.addr]
+            if not candidates:
+                raise RPCError("no follower to transfer to")
+            target = max(candidates,
+                         key=lambda p: srv.raft._match_index.get(p, 0))
+        try:
+            srv.raft.transfer_leadership(target)
+        except ValueError as ex:
+            raise RPCError(str(ex)) from ex
+        return {"Success": True, "Target": target}
+
+    write("Operator.RaftTransferLeader", raft_transfer_leader)
+
+    def operator_usage(args):
+        require(authz(args).operator_read(), "operator read")
+        counts = state.usage_counts()
+        return {"Usage": {srv.config.datacenter: {
+            "Nodes": counts.get("nodes", 0),
+            "Services": counts.get("service_names", 0),
+            "ServiceInstances": counts.get("services", 0),
+            "KVCount": counts.get("kv", 0),
+            "Sessions": counts.get("sessions", 0),
+            "ConnectServiceInstances": counts.get(
+                "connect_instances", 0),
+        }}}
+
+    read("Operator.Usage", operator_usage)
+
+    def acl_token_self(args):
+        """acl/token/self: a token reads ITSELF — the secret is the
+        authorization (acl_endpoint.go TokenRead self-policy)."""
+        tok = state.raw_get("acl_tokens", args.get("AuthToken", ""))
+        if tok is None:
+            raise RPCError("Permission denied: token not found")
+        return {"Token": tok}
+
+    read("ACL.TokenSelf", acl_token_self)
+
+    def acl_replication_status(args):
+        require(authz(args).operator_read(), "operator read")
+        pdc = srv.config.primary_datacenter
+        enabled = bool(pdc and pdc != srv.config.datacenter)
+        return {
+            "Enabled": enabled,
+            "Running": enabled and srv.is_leader(),
+            "SourceDatacenter": pdc if enabled else "",
+            "ReplicationType": "tokens" if enabled else "",
+            "ReplicatedIndex": state.table_index(
+                "acl_tokens", "acl_policies") if enabled else 0,
+        }
+
+    e["ACL.ReplicationStatus"] = acl_replication_status
+
+    def discovery_chain(args):
+        """discovery-chain/<service> (discoverychain_endpoint.go): the
+        compiled routing DAG."""
+        name = args.get("Name", "")
+        require(authz(args).service_read(name), f"service read {name!r}")
+        from consul_tpu.connect.chain import compile_chain
+
+        def get_entry(kind, ename):
+            return state.raw_get("config_entries", f"{kind}/{ename}")
+
+        return srv.blocking_query(args, ("config_entries",), lambda: {
+            "Chain": compile_chain(name, get_entry)})
+
+    read("Internal.DiscoveryChain", discovery_chain)
+
+    def gateway_services(args):
+        """catalog/gateway-services/<gateway> (catalog_endpoint.go
+        GatewayServices): what an ingress/terminating gateway fronts."""
+        gw = args.get("Gateway", "")
+        require(authz(args).service_read(gw), f"service read {gw!r}")
+
+        def run():
+            out = []
+            for kind in ("ingress-gateway", "terminating-gateway"):
+                entry = state.raw_get("config_entries", f"{kind}/{gw}")
+                if entry is None:
+                    continue
+                if kind == "ingress-gateway":
+                    for lst in entry.get("Listeners") or []:
+                        for s in lst.get("Services") or []:
+                            out.append({
+                                "Gateway": gw, "Service": s.get("Name"),
+                                "GatewayKind": kind,
+                                "Port": lst.get("Port", 0),
+                                "Protocol": lst.get("Protocol", "tcp")})
+                else:
+                    for s in entry.get("Services") or []:
+                        out.append({"Gateway": gw,
+                                    "Service": s.get("Name"),
+                                    "GatewayKind": kind})
+            return {"Services": out}
+
+        return srv.blocking_query(args, ("config_entries",), run)
+
+    read("Internal.GatewayServices", gateway_services)
+
+    def exported_services(args):
+        require(authz(args).operator_read(), "operator read")
+        entry = state.raw_get("config_entries",
+                              "exported-services/default") or {}
+        return {"Services": [
+            {"Service": s.get("Name", ""),
+             "Consumers": s.get("Consumers") or []}
+            for s in entry.get("Services") or []]}
+
+    read("Internal.ExportedServices", exported_services)
+
+    def acl_authorize(args):
+        """internal/acl/authorize (acl_endpoint.go Authorize): batch
+        permission checks for the given token."""
+        az = authz(args)
+        out = []
+        checks = {
+            ("key", "read"): az.key_read, ("key", "write"): az.key_write,
+            ("service", "read"): az.service_read,
+            ("service", "write"): az.service_write,
+            ("node", "read"): az.node_read,
+            ("node", "write"): az.node_write,
+            ("session", "read"): az.session_read,
+            ("session", "write"): az.session_write,
+        }
+        for req in args.get("Requests") or []:
+            fn = checks.get((req.get("Resource", ""),
+                             req.get("Access", "")))
+            if fn is None:
+                allow = {"operator": az.operator_read,
+                         "acl": az.acl_read}.get(
+                    req.get("Resource", ""), lambda: False)() \
+                    if req.get("Access") == "read" else \
+                    {"operator": az.operator_write,
+                     "acl": az.acl_write}.get(
+                        req.get("Resource", ""), lambda: False)()
+            else:
+                allow = fn(req.get("Segment", ""))
+            out.append({**req, "Allow": bool(allow)})
+        return out
+
+    e["ACL.Authorize"] = acl_authorize
+
+    def service_topology(args):
+        """internal/ui/service-topology: who this service may call and
+        who may call it, from the intention graph + catalog
+        (ui_endpoint.go ServiceTopology, simplified)."""
+        name = args.get("ServiceName", "")
+        require(authz(args).service_read(name), f"service read {name!r}")
+        from consul_tpu.connect.intentions import authorize as _iauthz
+
+        default_allow = srv.config.acl_default_policy == "allow" \
+            or not srv.config.acl_enabled
+
+        def run():
+            intentions = state.raw_list("intentions")
+            services = set(state.services())
+            ups, downs = [], []
+            for other in sorted(services - {name}):
+                if _iauthz(intentions, name, other, default_allow)[0]:
+                    ups.append({"Name": other, "Intention": "allow"})
+                if _iauthz(intentions, other, name, default_allow)[0]:
+                    downs.append({"Name": other, "Intention": "allow"})
+            return {"Upstreams": ups, "Downstreams": downs,
+                    "FilteredByACLs": False}
+
+        return srv.blocking_query(
+            args, ("intentions", "services"), run)
+
+    read("Internal.ServiceTopology", service_topology)

@@ -578,7 +578,13 @@ class StateStore:
     def usage_counts(self) -> dict[str, int]:
         """Table sizes for usage gauges (agent/consul/usagemetrics)."""
         with self._lock:
-            return {t: len(self.tables[t]) for t in TABLES}
+            counts = {t: len(self.tables[t]) for t in TABLES}
+            counts["service_names"] = len(
+                {s.service for s in self.tables["services"].values()})
+            counts["connect_instances"] = sum(
+                1 for s in self.tables["services"].values()
+                if s.kind == "connect-proxy" or s.connect_native)
+            return counts
 
     # ------------------------------------------------------------ raw tables
 

@@ -1,0 +1,146 @@
+"""Round-2 HTTP surface breadth (the long tail of the 130 routes:
+agent health/maintenance, acl self/replication/authorize, operator
+usage/transfer-leader, discovery-chain, gateway-services, topology,
+virtual IPs, reload)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api import APIError, ConsulClient
+from consul_tpu.config import load
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(load(dev=True, overrides={"node_name": "breadth"}))
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="leadership")
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(agent):
+    return ConsulClient(agent.http.addr)
+
+
+def _status(agent, path, method="GET"):
+    req = urllib.request.Request(
+        f"http://{agent.http.addr}{path}", method=method)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_agent_health_service_status_codes(agent, client):
+    client.service_register({
+        "Name": "hweb", "ID": "hweb1", "Port": 80,
+        "Check": {"TTL": "600s", "Status": "passing"}})
+    code, body = _status(agent, "/v1/agent/health/service/name/hweb")
+    assert code == 200
+    assert json.loads(body)[0]["AggregatedStatus"] == "passing"
+    client.check_warn("service:hweb1")
+    code, _ = _status(agent, "/v1/agent/health/service/id/hweb1")
+    assert code == 429  # warning, per the reference's code contract
+    client.check_fail("service:hweb1")
+    code, _ = _status(agent, "/v1/agent/health/service/name/hweb")
+    assert code == 503
+    client.check_pass("service:hweb1")
+    code, _ = _status(agent, "/v1/agent/health/service/name/nope")
+    assert code == 404
+
+
+def test_service_maintenance(agent, client):
+    client.service_register({"Name": "mweb", "ID": "mweb1", "Port": 81})
+    assert _status(agent,
+                   "/v1/agent/service/maintenance/mweb1?enable=true",
+                   "PUT")[0] == 200
+    code, _ = _status(agent, "/v1/agent/health/service/id/mweb1")
+    assert code == 503  # maintenance check forces critical
+    assert _status(agent,
+                   "/v1/agent/service/maintenance/mweb1?enable=false",
+                   "PUT")[0] == 200
+    code, _ = _status(agent, "/v1/agent/health/service/id/mweb1")
+    assert code == 200
+
+
+def test_acl_self_replication_authorize(agent, client):
+    # ACLs disabled on this agent: self returns 403-ish denial
+    with pytest.raises(APIError):
+        client.get("/v1/acl/token/self")
+    repl = client.get("/v1/acl/replication")
+    assert repl["Enabled"] is False
+    out = client.put("/v1/internal/acl/authorize", body=[
+        {"Resource": "key", "Access": "read", "Segment": "x"}])
+    assert out[0]["Allow"] is True  # ACLs off → allow
+    tp = client.get("/v1/acl/templated-policies")
+    assert "builtin/service" in tp
+
+
+def test_operator_usage_and_transfer(agent, client):
+    dc = agent.config.datacenter
+    usage = wait_for(
+        lambda: (u := client.get("/v1/operator/usage"))[dc]["Nodes"] >= 1
+        and u, what="self-registration reflected in usage")
+    # single-node: transfer with no follower is a clean error
+    with pytest.raises(APIError, match="no follower"):
+        client.put("/v1/operator/raft/transfer-leader")
+
+
+def test_discovery_chain_and_topology(agent, client):
+    client.put("/v1/config", body={
+        "Kind": "service-resolver", "Name": "chainsvc",
+        "ConnectTimeout": "5s"})
+    chain = client.get("/v1/discovery-chain/chainsvc")
+    assert chain["ServiceName"] == "chainsvc"
+    assert chain["Routes"][-1]["Match"] is None  # default catch-all
+    client.service_register({"Name": "topoa", "ID": "topoa", "Port": 1})
+    client.service_register({"Name": "topob", "ID": "topob", "Port": 2})
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "topoa", "DestinationName": "topob",
+        "Action": "allow"})
+    wait_for(lambda: client.catalog_service("topob"),
+             what="topob in catalog")
+    topo = client.get("/v1/internal/ui/service-topology/topoa")
+    assert any(u["Name"] == "topob" for u in topo["Upstreams"])
+
+
+def test_gateway_services_and_exports(agent, client):
+    client.put("/v1/config", body={
+        "Kind": "ingress-gateway", "Name": "igw",
+        "Listeners": [{"Port": 8080, "Protocol": "http",
+                       "Services": [{"Name": "hweb"}]}]})
+    rows = client.get("/v1/catalog/gateway-services/igw")
+    assert rows and rows[0]["Service"] == "hweb" \
+        and rows[0]["Port"] == 8080
+    client.put("/v1/config", body={
+        "Kind": "exported-services", "Name": "default",
+        "Services": [{"Name": "hweb",
+                      "Consumers": [{"Peer": "other"}]}]})
+    exp = client.get("/v1/exported-services")
+    assert exp[0]["Service"] == "hweb"
+
+
+def test_misc_breadth(agent, client):
+    vip = client.get("/v1/internal/service-virtual-ip", service="hweb")
+    assert vip["VirtualIP"].startswith("240.")
+    assert client.put("/v1/coordinate/update", body={
+        "Node": "breadth",
+        "Coord": {"Vec": [0.0] * 8, "Error": 1.5, "Adjustment": 0.0,
+                  "Height": 1e-5}}) is True
+    reloaded = client.put("/v1/agent/reload")["Reloaded"]
+    assert "log_level" in reloaded
+    ca = client.get("/v1/connect/ca/configuration")
+    assert ca["Provider"]
+    ns = client.get("/v1/catalog/node-services/breadth")
+    assert isinstance(ns["Services"], list)
+    ig = client.get("/v1/health/ingress/hweb")
+    assert isinstance(ig, list)

@@ -216,3 +216,22 @@ def test_agent_data_dir_persistence(tmp_path):
         wait_for(config_recovered, what="config entry recovered")
     finally:
         b.shutdown()
+
+
+def test_operator_transfer_leader(cluster):
+    """operator raft transfer-leader: leadership moves to the chosen
+    peer without an availability gap long enough to drop writes."""
+    servers, leader = cluster
+    target = next(s for s in servers if s is not leader)
+    res = leader.handle_rpc("Operator.RaftTransferLeader",
+                            {"Address": target.rpc.addr}, "local")
+    assert res["Success"] and res["Target"] == target.rpc.addr
+    new_leader = wait_for(
+        lambda: target.is_leader() and target or None,
+        what="target acquired leadership")
+    # the cluster still accepts writes through the NEW leader
+    new_leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "xfer/ok", "Value": b"1"}},
+        "local")
+    wait_for(lambda: new_leader.state.kv_get("xfer/ok") is not None,
+             what="post-transfer write")
