@@ -249,8 +249,19 @@ class RaftNode:
             _time.sleep(0.05)
         else:
             raise ApplyTimeout(f"{target} never caught up for transfer")
-        self.transport.call(target, "timeout_now", {"term": term},
-                            timeout=timeout)
+        with self._lock:
+            # re-read the term: a disturbance election during catch-up
+            # would make the captured term stale and the target would
+            # (rightly) ignore the TimeoutNow — but we must not then
+            # report the transfer as having happened
+            if self.role != Role.LEADER:
+                raise NotLeader(self.leader_id)
+            term = self.store.term
+        resp = self.transport.call(target, "timeout_now", {"term": term},
+                                   timeout=timeout)
+        if not (resp or {}).get("scheduled"):
+            raise ApplyTimeout(
+                f"{target} declined TimeoutNow (term moved on)")
 
     def stats(self) -> dict[str, Any]:
         with self._lock:
@@ -605,7 +616,7 @@ class RaftNode:
                     or self._stopped
             if not stale:
                 self.scheduler.after(0.0, self._start_election)
-            return {"term": self.store.term}
+            return {"term": self.store.term, "scheduled": not stale}
         raise ValueError(f"unknown raft rpc {method}")
 
     def _on_request_vote(self, args: dict[str, Any]) -> dict[str, Any]:

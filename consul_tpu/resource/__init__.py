@@ -1,0 +1,55 @@
+"""v2 resource storage: generic typed-resource CRUD + Watch.
+
+The reference grew a second storage vertical beside the v1 state store:
+a generic `storage.Backend` (internal/storage/storage.go:122) with two
+implementations — pure in-memory (internal/storage/inmem) and
+raft-backed with leader forwarding (internal/storage/raft/backend.go) —
+verified by one shared conformance suite
+(internal/storage/conformance/conformance.go). Controllers
+(internal/controller/) reconcile over it.
+
+This package is the TPU-framework equivalent: `ResourceStore` is the
+watchable in-memory table, `InMemBackend` serves it standalone, and
+`RaftBackend` rides the existing raft/FSM machinery (writes become
+RESOURCE log entries, reads come off the local replica, strong reads
+insist on leadership). The same conformance suite in
+tests/test_resource.py runs against both.
+"""
+
+from consul_tpu.resource.types import (
+    WILDCARD,
+    CASError,
+    GroupVersionMismatch,
+    NotFoundError,
+    Resource,
+    ResourceID,
+    ResourceType,
+    StorageError,
+    Tenancy,
+    WatchClosed,
+    WatchEvent,
+    WrongUidError,
+)
+from consul_tpu.resource.store import ResourceStore, Watch
+from consul_tpu.resource.backend import Backend, InMemBackend
+from consul_tpu.resource.raft import RaftBackend
+
+__all__ = [
+    "WILDCARD",
+    "Backend",
+    "CASError",
+    "GroupVersionMismatch",
+    "InMemBackend",
+    "NotFoundError",
+    "RaftBackend",
+    "Resource",
+    "ResourceID",
+    "ResourceStore",
+    "ResourceType",
+    "StorageError",
+    "Tenancy",
+    "Watch",
+    "WatchClosed",
+    "WatchEvent",
+    "WrongUidError",
+]

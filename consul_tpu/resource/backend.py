@@ -1,0 +1,73 @@
+"""Backend protocol + the pure in-memory implementation.
+
+storage.Backend (internal/storage/storage.go:122-274) in Python dress:
+read/write_cas/delete_cas/list/watch_list/list_by_owner with
+EVENTUAL/STRONG consistency modes. The conformance suite in
+tests/test_resource.py is the behavioral contract — run it against any
+new implementation.
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from typing import Any, Optional, Protocol
+
+from consul_tpu.resource.store import ResourceStore, Watch
+
+EVENTUAL = "eventual"
+STRONG = "strong"
+
+
+class Backend(Protocol):
+    def read(self, id_dict: dict[str, Any],
+             consistency: str = EVENTUAL) -> dict[str, Any]: ...
+
+    def write_cas(self, res: dict[str, Any]) -> dict[str, Any]: ...
+
+    def delete_cas(self, id_dict: dict[str, Any], version: str) -> None: ...
+
+    def list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+             name_prefix: str = "",
+             consistency: str = EVENTUAL) -> list[dict[str, Any]]: ...
+
+    def watch_list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+                   name_prefix: str = "") -> Watch: ...
+
+    def list_by_owner(self, id_dict: dict[str, Any]) -> list[dict[str, Any]]: ...
+
+
+class InMemBackend:
+    """Standalone in-memory backend (internal/storage/inmem): versions
+    from a local monotonic counter, uids minted on create. Strong and
+    eventual reads are the same thing — there's one copy."""
+
+    def __init__(self, store: Optional[ResourceStore] = None) -> None:
+        self.store = store or ResourceStore()
+        self._versions = itertools.count(1)
+
+    def read(self, id_dict: dict[str, Any],
+             consistency: str = EVENTUAL) -> dict[str, Any]:
+        return self.store.read(id_dict)
+
+    def write_cas(self, res: dict[str, Any]) -> dict[str, Any]:
+        res = dict(res)
+        res["Id"] = dict(res["Id"])
+        if not res.get("Version") and not res["Id"].get("Uid"):
+            res["Id"]["Uid"] = uuid.uuid4().hex
+        return self.store.write_cas(res, str(next(self._versions)))
+
+    def delete_cas(self, id_dict: dict[str, Any], version: str) -> None:
+        self.store.delete_cas(id_dict, version)
+
+    def list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+             name_prefix: str = "",
+             consistency: str = EVENTUAL) -> list[dict[str, Any]]:
+        return self.store.list(rtype, tenancy, name_prefix)
+
+    def watch_list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+                   name_prefix: str = "") -> Watch:
+        return self.store.watch_list(rtype, tenancy, name_prefix)
+
+    def list_by_owner(self, id_dict: dict[str, Any]) -> list[dict[str, Any]]:
+        return self.store.list_by_owner(id_dict)

@@ -1,0 +1,108 @@
+"""Raft-backed resource backend.
+
+Equivalent of internal/storage/raft/backend.go: durable writes ride the
+existing raft/FSM machinery (a RESOURCE log entry applied on every
+replica), reads come off the local replica's ResourceStore, and strong
+reads insist on leadership. Followers forward writes/strong reads by
+re-invoking the ORIGINAL RPC on the leader via the server's endpoint
+layer (the reference forwards over its internal gRPC channel,
+raft/forwarding.go — here the mux'd RPC pool is that channel), so ACL
+and CAS checks always run where the data is authoritative.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional
+
+from consul_tpu.resource.backend import EVENTUAL, STRONG
+from consul_tpu.resource.store import Watch
+from consul_tpu.resource.types import (
+    CASError,
+    InconsistentError,
+    NotFoundError,
+    WrongUidError,
+)
+
+
+class RaftBackend:
+    """In-process view bound to one server. Uses the server's RPC
+    surface (Resource.* endpoints in server/endpoints.py) so calls made
+    on a follower transparently forward to the leader."""
+
+    def __init__(self, srv, token: str = "") -> None:
+        self.srv = srv
+        self.token = token
+
+    def _call(self, method: str, args: dict[str, Any]) -> Any:
+        if self.token:
+            args = {**args, "AuthToken": self.token}
+        return self.srv.handle_rpc(method, args, "local")
+
+    # -------------------------------------------------------------- reads
+
+    def read(self, id_dict: dict[str, Any],
+             consistency: str = EVENTUAL) -> dict[str, Any]:
+        if consistency == STRONG and not self.srv.is_leader():
+            out = self._call("Resource.Read", {"ID": id_dict})
+            if out.get("Error") == "gvm":
+                from consul_tpu.resource.types import GroupVersionMismatch
+
+                raise GroupVersionMismatch(
+                    (id_dict.get("Type") or {}).get("GroupVersion", ""),
+                    out["Stored"])
+            if out.get("Error"):
+                raise _to_error(out["Error"])
+            return out["Resource"]
+        if consistency == STRONG:
+            # leader: barrier so the read reflects every committed write
+            # (the reference's EnsureStrongConsistency / consistentRead)
+            self.srv.raft.apply_noop()
+        return self.srv.state.resources.read(id_dict)
+
+    def list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+             name_prefix: str = "",
+             consistency: str = EVENTUAL) -> list[dict[str, Any]]:
+        if consistency == STRONG and not self.srv.is_leader():
+            out = self._call("Resource.List", {
+                "Type": rtype, "Tenancy": tenancy, "Prefix": name_prefix})
+            return out["Resources"]
+        return self.srv.state.resources.list(rtype, tenancy, name_prefix)
+
+    def list_by_owner(self, id_dict: dict[str, Any]) -> list[dict[str, Any]]:
+        return self.srv.state.resources.list_by_owner(id_dict)
+
+    def watch_list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+                   name_prefix: str = "") -> Watch:
+        return self.srv.state.resources.watch_list(rtype, tenancy,
+                                                   name_prefix)
+
+    # ------------------------------------------------------------- writes
+
+    def write_cas(self, res: dict[str, Any]) -> dict[str, Any]:
+        res = dict(res)
+        res["Id"] = dict(res["Id"])
+        if not res.get("Version") and not res["Id"].get("Uid"):
+            # uid minted OUTSIDE the log entry's apply (FSMs must be
+            # deterministic); it rides the log verbatim
+            res["Id"]["Uid"] = uuid.uuid4().hex
+        out = self._call("Resource.Write", {"Resource": res})
+        if out.get("Error"):
+            raise _to_error(out["Error"])
+        return out["Resource"]
+
+    def delete_cas(self, id_dict: dict[str, Any], version: str) -> None:
+        out = self._call("Resource.Delete", {"ID": id_dict,
+                                             "Version": version})
+        if out and out.get("Error"):
+            raise _to_error(out["Error"])
+
+
+def _to_error(marker: str) -> Exception:
+    """FSM handlers return error markers (values replicate; exceptions
+    don't) — rehydrate the typed storage error at the caller."""
+    return {
+        "cas": CASError("CAS operation failed"),
+        "wrong_uid": WrongUidError("uid mismatch"),
+        "not_found": NotFoundError("resource not found"),
+    }.get(marker, InconsistentError(marker))

@@ -1,0 +1,268 @@
+"""ResourceStore: the watchable in-memory resource table.
+
+Equivalent of internal/storage/inmem/{store,watch,event_index}.go —
+the single MVCC table both backends share (the reference's raft backend
+also wraps an inmem.Store as its replica view, raft/backend.go:52-56).
+
+Concurrency model: one lock; watches are queues appended under that
+lock in commit order, so every watcher observes the same total order
+(the reference gets this from memdb's radix snapshots + an event
+index). Mutations take an explicit new_version so the raft FSM can pin
+versions to raft indexes (deterministic across replicas) while the
+standalone backend uses a local counter.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from collections import deque
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from consul_tpu.resource.types import (
+    CASError,
+    GroupVersionMismatch,
+    NotFoundError,
+    WatchClosed,
+    WatchEvent,
+    WrongUidError,
+    storage_key,
+    tenancy_matches,
+)
+
+
+class Watch:
+    """Hand-off queue for one watcher. `next()` blocks for the next
+    event; raises WatchClosed after close() (snapshot restore)."""
+
+    def __init__(self, store: "ResourceStore") -> None:
+        self._store = store
+        self._events: deque[WatchEvent] = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def _push(self, ev: WatchEvent) -> None:
+        with self._cond:
+            self._events.append(ev)
+            self._cond.notify()
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Next event, or None on timeout."""
+        with self._cond:
+            if not self._events and not self._closed:
+                self._cond.wait(timeout)
+            if self._events:
+                return self._events.popleft()
+            if self._closed:
+                raise WatchClosed("watch closed")
+            return None
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._store._drop_watch(self)
+
+
+class ResourceStore:
+    def __init__(self, on_change: Optional[Callable[[], None]] = None) -> None:
+        self._lock = threading.RLock()
+        # storage_key -> stored resource dict (unversioned-type keyed)
+        self._items: dict[tuple, dict[str, Any]] = {}
+        # owner uid-key -> set of owned storage_keys (ListByOwner index)
+        self._owned: dict[tuple, set[tuple]] = {}
+        # (watch, group, kind, tenancy-want, prefix)
+        self._watches: list[tuple[Watch, str, str, dict, str]] = []
+        self._on_change = on_change
+
+    # ------------------------------------------------------------- reads
+
+    def read(self, id_dict: dict[str, Any]) -> dict[str, Any]:
+        """Read by ID. Empty Uid matches any lifetime (user reads);
+        non-empty must match exactly (controller reads,
+        storage.go:125-134). GroupVersion mismatch raises with the
+        stored resource attached."""
+        with self._lock:
+            stored = self._items.get(storage_key(id_dict))
+            if stored is None:
+                raise NotFoundError("resource not found")
+            want_uid = id_dict.get("Uid", "")
+            if want_uid and stored["Id"].get("Uid") != want_uid:
+                raise NotFoundError("resource not found (uid mismatch)")
+            want_gv = (id_dict.get("Type") or {}).get("GroupVersion", "")
+            have_gv = stored["Id"]["Type"].get("GroupVersion", "")
+            if want_gv and want_gv != have_gv:
+                raise GroupVersionMismatch(want_gv, copy.deepcopy(stored))
+            # a COPY: handing out the live dict would let callers mutate
+            # replicated state in place (diverging this replica) and
+            # defeat the Generation data-change comparison in write_cas
+            return copy.deepcopy(stored)
+
+    def list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+             name_prefix: str = "") -> list[dict[str, Any]]:
+        """List by unversioned type + (wildcardable) tenancy + name
+        prefix, sorted by name for determinism."""
+        g, k = rtype.get("Group", ""), rtype.get("Kind", "")
+        with self._lock:
+            out = [copy.deepcopy(r) for key, r in self._items.items()
+                   if key[0] == g and key[1] == k
+                   and tenancy_matches(r["Id"]["Tenancy"], tenancy)
+                   and key[5].startswith(name_prefix)]
+        return sorted(out, key=lambda r: storage_key(r["Id"]))
+
+    def list_by_owner(self, id_dict: dict[str, Any]) -> list[dict[str, Any]]:
+        """Resources owned by the given ID (cascading deletion,
+        storage.go:255-273). Uid-scoped: a re-created owner with a new
+        uid owns nothing from the old lifetime."""
+        okey = self._owner_key(id_dict)
+        with self._lock:
+            keys = self._owned.get(okey, set())
+            return [copy.deepcopy(self._items[k]) for k in sorted(keys)
+                    if k in self._items]
+
+    # ------------------------------------------------------------ writes
+
+    def write_cas(self, res: dict[str, Any],
+                  new_version: str) -> dict[str, Any]:
+        """CAS write of the full resource. res["Version"] is the
+        expected stored version ("" = create). Uid is immutable
+        (ErrWrongUid). Generation bumps to new_version only when Data
+        changes — status-only writes keep it, so controllers can compare
+        ObservedGeneration (pbresource semantics)."""
+        key = storage_key(res["Id"])
+        with self._lock:
+            stored = self._items.get(key)
+            expect = res.get("Version", "")
+            if stored is None:
+                if expect != "":
+                    raise CASError("create of existing version")
+            else:
+                if expect != stored["Version"]:
+                    raise CASError("version mismatch")
+                if res["Id"].get("Uid") and stored["Id"].get("Uid") \
+                        and res["Id"]["Uid"] != stored["Id"]["Uid"]:
+                    raise WrongUidError("uid mismatch")
+            # deep-copied: the stored record must never share structure
+            # with caller-held dicts (in-place edits would bypass CAS)
+            new = copy.deepcopy({
+                "Id": dict(res["Id"]),
+                "Data": res.get("Data") or {},
+                "Version": new_version,
+                "Generation": new_version,
+                "Owner": res.get("Owner"),
+                "Status": res.get("Status") or {},
+                "Metadata": res.get("Metadata") or {},
+            })
+            if stored is not None:
+                if not new["Id"].get("Uid"):
+                    new["Id"]["Uid"] = stored["Id"].get("Uid", "")
+                if new["Data"] == stored["Data"]:
+                    new["Generation"] = stored["Generation"]
+                self._unindex_owner(stored, key)
+            self._items[key] = new
+            self._index_owner(new, key)
+            self._emit(WatchEvent("upsert", copy.deepcopy(new)))
+            out = copy.deepcopy(new)
+        if self._on_change:
+            self._on_change()
+        return out
+
+    def delete_cas(self, id_dict: dict[str, Any], version: str) -> None:
+        """CAS delete. Missing resource is success (already gone);
+        uid mismatch is a no-op — the caller is deleting a different
+        lifetime (storage.go:174-199)."""
+        key = storage_key(id_dict)
+        with self._lock:
+            stored = self._items.get(key)
+            if stored is None:
+                return
+            want_uid = id_dict.get("Uid", "")
+            if want_uid and stored["Id"].get("Uid") != want_uid:
+                return
+            if version != "" and version != stored["Version"]:
+                raise CASError("version mismatch")
+            del self._items[key]
+            self._unindex_owner(stored, key)
+            self._emit(WatchEvent("delete", copy.deepcopy(stored)))
+        if self._on_change:
+            self._on_change()
+
+    # ----------------------------------------------------------- watches
+
+    def watch_list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
+                   name_prefix: str = "") -> Watch:
+        """Watch matching resources: current state arrives first as
+        upserts, then deltas, in commit order (storage.go:227-253).
+        Registering the watch and snapshotting current state happen
+        under one lock so no event is missed or duplicated."""
+        w = Watch(self)
+        with self._lock:
+            for r in self.list(rtype, tenancy, name_prefix):
+                w._push(WatchEvent("upsert", r))
+            self._watches.append((w, rtype.get("Group", ""),
+                                  rtype.get("Kind", ""), dict(tenancy or {}),
+                                  name_prefix))
+        return w
+
+    def _emit(self, ev: WatchEvent) -> None:
+        rid = ev.resource["Id"]
+        t, ten = rid["Type"], rid["Tenancy"]
+        for w, g, k, want_ten, prefix in self._watches:
+            if t.get("Group") == g and t.get("Kind") == k \
+                    and tenancy_matches(ten, want_ten) \
+                    and rid.get("Name", "").startswith(prefix):
+                w._push(ev)
+
+    def _drop_watch(self, w: Watch) -> None:
+        with self._lock:
+            self._watches = [t for t in self._watches if t[0] is not w]
+
+    def close_watches(self) -> None:
+        """Invalidate every watch (snapshot restore: events no longer
+        form a coherent history — inmem/snapshot.go)."""
+        with self._lock:
+            watches, self._watches = self._watches, []
+        for w, *_ in watches:
+            with w._cond:
+                w._closed = True
+                w._cond.notify_all()
+
+    # ------------------------------------------------------- owner index
+
+    @staticmethod
+    def _owner_key(id_dict: dict[str, Any]) -> tuple:
+        return storage_key(id_dict) + (id_dict.get("Uid", ""),)
+
+    def _index_owner(self, res: dict[str, Any], key: tuple) -> None:
+        if res.get("Owner"):
+            self._owned.setdefault(self._owner_key(res["Owner"]),
+                                   set()).add(key)
+
+    def _unindex_owner(self, res: dict[str, Any], key: tuple) -> None:
+        if res.get("Owner"):
+            okey = self._owner_key(res["Owner"])
+            owned = self._owned.get(okey)
+            if owned:
+                owned.discard(key)
+                if not owned:
+                    del self._owned[okey]
+
+    # ------------------------------------------------------- persistence
+
+    def dump(self) -> bytes:
+        with self._lock:
+            return msgpack.packb(list(self._items.values()),
+                                 use_bin_type=True)
+
+    def restore(self, data: bytes) -> None:
+        items = msgpack.unpackb(data, raw=False)
+        with self._lock:
+            self._items.clear()
+            self._owned.clear()
+            for r in items:
+                key = storage_key(r["Id"])
+                self._items[key] = r
+                self._index_owner(r, key)
+        self.close_watches()

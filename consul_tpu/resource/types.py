@@ -1,0 +1,184 @@
+"""Resource wire/store types and storage errors.
+
+Mirrors the shape of pbresource (proto-public/pbresource) and the error
+vocabulary of internal/storage/storage.go:18-40 — the semantics the
+conformance suite locks down. Resources are plain msgpack-able dicts on
+the wire; these dataclasses are the typed in-process view.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+#: Sentinel accepted in tenancy fields of list/watch calls to span all
+#: partitions/peers/namespaces (storage.go:16).
+WILDCARD = "*"
+
+
+class StorageError(Exception):
+    """Base class for resource-storage errors."""
+
+
+class NotFoundError(StorageError):
+    """The resource could not be found (storage.ErrNotFound)."""
+
+
+class CASError(StorageError):
+    """Write/delete failed: given version doesn't match what is stored
+    (storage.ErrCASFailure)."""
+
+
+class WrongUidError(StorageError):
+    """Write failed: the resource's Uid doesn't match what is stored —
+    the caller holds a stale lifetime of the name (storage.ErrWrongUid)."""
+
+
+class InconsistentError(StorageError):
+    """Consistency requirement can't be met (e.g. strong read on a
+    follower after forwarding failed) (storage.ErrInconsistent)."""
+
+
+class WatchClosed(StorageError):
+    """Watch invalidated (e.g. snapshot restore); consumers must discard
+    materialized state and re-watch (storage.ErrWatchClosed)."""
+
+
+class GroupVersionMismatch(StorageError):
+    """Resource stored under a different GroupVersion than requested;
+    carries the stored resource so callers can translate
+    (storage.GroupVersionMismatchError)."""
+
+    def __init__(self, requested_gv: str, stored: dict[str, Any]) -> None:
+        stored_gv = stored["Id"]["Type"].get("GroupVersion", "")
+        super().__init__(
+            f"resource requested with GroupVersion={requested_gv!r} "
+            f"but stored with GroupVersion={stored_gv!r}")
+        self.requested_gv = requested_gv
+        self.stored = stored
+
+
+@dataclass(frozen=True)
+class ResourceType:
+    group: str
+    group_version: str
+    kind: str
+
+    def to_dict(self) -> dict[str, str]:
+        return {"Group": self.group, "GroupVersion": self.group_version,
+                "Kind": self.kind}
+
+    @staticmethod
+    def from_dict(d: dict[str, str]) -> "ResourceType":
+        return ResourceType(d.get("Group", ""), d.get("GroupVersion", ""),
+                            d.get("Kind", ""))
+
+
+@dataclass(frozen=True)
+class Tenancy:
+    partition: str = "default"
+    peer_name: str = "local"
+    namespace: str = "default"
+
+    def to_dict(self) -> dict[str, str]:
+        return {"Partition": self.partition, "PeerName": self.peer_name,
+                "Namespace": self.namespace}
+
+    @staticmethod
+    def from_dict(d: Optional[dict[str, str]]) -> "Tenancy":
+        d = d or {}
+        return Tenancy(d.get("Partition") or "default",
+                       d.get("PeerName") or "local",
+                       d.get("Namespace") or "default")
+
+
+@dataclass(frozen=True)
+class ResourceID:
+    type: ResourceType
+    name: str
+    tenancy: Tenancy = field(default_factory=Tenancy)
+    uid: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"Type": self.type.to_dict(), "Name": self.name,
+                "Tenancy": self.tenancy.to_dict(), "Uid": self.uid}
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ResourceID":
+        return ResourceID(ResourceType.from_dict(d.get("Type") or {}),
+                          d.get("Name", ""),
+                          Tenancy.from_dict(d.get("Tenancy")),
+                          d.get("Uid", ""))
+
+
+@dataclass
+class Resource:
+    """One stored resource. `version` is the CAS token (opaque string,
+    "" means create); `generation` changes only when `data` changes, so
+    controllers can tell data edits from status-only writes; `status` is
+    keyed by controller name and carries ObservedGeneration."""
+
+    id: ResourceID
+    data: dict[str, Any] = field(default_factory=dict)
+    version: str = ""
+    generation: str = ""
+    owner: Optional[ResourceID] = None
+    status: dict[str, Any] = field(default_factory=dict)
+    metadata: dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "Id": self.id.to_dict(),
+            "Data": self.data,
+            "Version": self.version,
+            "Generation": self.generation,
+            "Owner": self.owner.to_dict() if self.owner else None,
+            "Status": self.status,
+            "Metadata": self.metadata,
+        }
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "Resource":
+        owner = d.get("Owner")
+        return Resource(
+            id=ResourceID.from_dict(d.get("Id") or {}),
+            data=d.get("Data") or {},
+            version=d.get("Version", ""),
+            generation=d.get("Generation", ""),
+            owner=ResourceID.from_dict(owner) if owner else None,
+            status=d.get("Status") or {},
+            metadata=d.get("Metadata") or {},
+        )
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    """One watch delta: op is "upsert" or "delete"; resource is the wire
+    dict (for deletes, the last stored form)."""
+
+    op: str
+    resource: dict[str, Any]
+
+
+# ------------------------------------------------------------ key helpers
+# Resources of one Group+Kind are equivalent across GroupVersions
+# (storage.go UnversionedType): the storage key drops the version.
+
+def storage_key(id_dict: dict[str, Any]) -> tuple:
+    t = id_dict.get("Type") or {}
+    ten = id_dict.get("Tenancy") or {}
+    return (t.get("Group", ""), t.get("Kind", ""),
+            ten.get("Partition") or "default",
+            ten.get("PeerName") or "local",
+            ten.get("Namespace") or "default",
+            id_dict.get("Name", ""))
+
+
+def tenancy_matches(ten: dict[str, Any], want: dict[str, Any]) -> bool:
+    """Wildcard-aware tenancy filter for list/watch."""
+    for k, default in (("Partition", "default"), ("PeerName", "local"),
+                       ("Namespace", "default")):
+        w = (want or {}).get(k) or default
+        if w != WILDCARD and (ten.get(k) or default) != w:
+            return False
+    return True

@@ -370,6 +370,59 @@ def register_endpoints(srv) -> None:
 
     write("Txn.Apply", txn_apply)
 
+    # ------------------------------------------------------ Resources (v2)
+    # The generic resource surface (internal/storage + pbresource). The
+    # reference gates each type through per-type ACL hooks registered
+    # with the resource service; this surface gates on operator
+    # permissions until per-type hooks exist.
+    def resource_write(args):
+        require(authz(args).operator_write(), "operator write (resource)")
+        r = dict(args["Resource"])
+        r["Id"] = dict(r.get("Id") or {})
+        if not r.get("Version") and not r["Id"].get("Uid"):
+            # mint the uid HERE on the leader, not only in client
+            # backends: a raw RPC create must still get a lifetime id
+            # (FSM can't mint — uuids aren't deterministic across
+            # replicas; in the log they replicate verbatim)
+            r["Id"]["Uid"] = uuid.uuid4().hex
+        return srv.forward_or_apply(MessageType.RESOURCE, {
+            "Op": "write", "Resource": r})
+
+    def resource_delete(args):
+        require(authz(args).operator_write(), "operator write (resource)")
+        return srv.forward_or_apply(MessageType.RESOURCE, {
+            "Op": "delete", "ID": args["ID"],
+            "Version": args.get("Version", "")})
+
+    def resource_read(args):
+        from consul_tpu.resource.types import (GroupVersionMismatch,
+                                               NotFoundError)
+
+        require(authz(args).operator_read(), "operator read (resource)")
+        try:
+            return {"Resource": state.resources.read(args["ID"])}
+        except NotFoundError:
+            return {"Error": "not_found"}
+        except GroupVersionMismatch as e:
+            return {"Error": "gvm", "Stored": e.stored}
+
+    def resource_list(args):
+        require(authz(args).operator_read(), "operator read (resource)")
+        return srv.blocking_query(args, ("resources",), lambda: {
+            "Resources": state.resources.list(
+                args.get("Type") or {}, args.get("Tenancy") or {},
+                args.get("Prefix", ""))})
+
+    def resource_list_by_owner(args):
+        require(authz(args).operator_read(), "operator read (resource)")
+        return {"Resources": state.resources.list_by_owner(args["ID"])}
+
+    write("Resource.Write", resource_write)
+    write("Resource.Delete", resource_delete)
+    read("Resource.Read", resource_read)
+    read("Resource.List", resource_list)
+    read("Resource.ListByOwner", resource_list_by_owner)
+
     # ---------------------------------------------------------- Snapshot
     def snapshot_save(args):
         """Full-state snapshot archive (snapshot/snapshot.go Save)."""
@@ -1548,19 +1601,21 @@ def register_endpoints(srv) -> None:
             ("session", "read"): az.session_read,
             ("session", "write"): az.session_write,
         }
+        scalar = {
+            ("operator", "read"): az.operator_read,
+            ("operator", "write"): az.operator_write,
+            ("acl", "read"): az.acl_read,
+            ("acl", "write"): az.acl_write,
+        }
         for req in args.get("Requests") or []:
-            fn = checks.get((req.get("Resource", ""),
-                             req.get("Access", "")))
-            if fn is None:
-                allow = {"operator": az.operator_read,
-                         "acl": az.acl_read}.get(
-                    req.get("Resource", ""), lambda: False)() \
-                    if req.get("Access") == "read" else \
-                    {"operator": az.operator_write,
-                     "acl": az.acl_write}.get(
-                        req.get("Resource", ""), lambda: False)()
+            pair = (req.get("Resource", ""), req.get("Access", ""))
+            if pair in checks:
+                allow = checks[pair](req.get("Segment", ""))
             else:
-                allow = fn(req.get("Segment", ""))
+                # unknown resource/access pairs DENY (the reference
+                # rejects them as errors; mapping a typo like "list"
+                # to a write check would over-grant)
+                allow = scalar.get(pair, lambda: False)()
             out.append({**req, "Allow": bool(allow)})
         return out
 

@@ -42,6 +42,7 @@ class MessageType(enum.IntEnum):
     ACL_BINDING_RULE = 17
     FEDERATION_STATE = 18
     TOMBSTONE_REAP = 19  # leader-driven KV tombstone GC (Tombstone.Reap)
+    RESOURCE = 20  # v2 resource CRUD (internal/storage/raft log ops)
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -72,6 +73,7 @@ class FSM:
             MessageType.ACL_BINDING_RULE: self._apply_acl_binding_rule,
             MessageType.FEDERATION_STATE: self._apply_federation_state,
             MessageType.TOMBSTONE_REAP: self._apply_tombstone_reap,
+            MessageType.RESOURCE: self._apply_resource,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -237,6 +239,29 @@ class FSM:
         same way — a local timer-based reap would desync follower
         prefix indexes)."""
         return self.store.kv_reap_tombstones(list(b.get("Keys") or []))
+
+    def _apply_resource(self, b: dict[str, Any], idx: int) -> Any:
+        """v2 resource CRUD (internal/storage/raft/backend.go: writes
+        ride the raft log; the CAS check runs HERE so it's atomic with
+        the apply on every replica). Versions pin to the raft index —
+        deterministic across replicas. Errors return as markers, not
+        exceptions: the outcome itself is part of replicated history."""
+        from consul_tpu.resource.types import CASError, WrongUidError
+
+        op = b.get("Op")
+        try:
+            if op == "write":
+                new = self.store.resources.write_cas(b["Resource"], str(idx))
+                return {"Resource": new}
+            if op == "delete":
+                self.store.resources.delete_cas(b["ID"],
+                                                b.get("Version", ""))
+                return {}
+        except CASError:
+            return {"Error": "cas"}
+        except WrongUidError:
+            return {"Error": "wrong_uid"}
+        return {"Error": f"unknown resource op {op!r}"}
 
     def _apply_snapshot_restore(self, b: dict[str, Any], idx: int) -> Any:
         """Operator restore: replace the whole store (snapshot_endpoint.go

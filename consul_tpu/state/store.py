@@ -38,7 +38,7 @@ RAW_TABLES = ("prepared_queries", "acl_tokens", "acl_policies",
               "acl_auth_methods", "acl_binding_rules",
               "federation_states")
 TABLES = ("nodes", "services", "checks", "kv", "sessions",
-          "coordinates") + RAW_TABLES
+          "coordinates", "resources") + RAW_TABLES
 
 
 class StateStore:
@@ -59,6 +59,16 @@ class StateStore:
         # change hooks (the stream publisher seam — event streaming feeds
         # from here like catalog_events.go feeds the EventPublisher)
         self._change_hooks: list[Callable[[str, int], None]] = []
+        # v2 resource table (internal/storage): its own watchable store,
+        # bumping the "resources" index so v1-style blocking queries can
+        # also ride it
+        from consul_tpu.resource.store import ResourceStore
+
+        self.resources = ResourceStore(on_change=self._resources_changed)
+
+    def _resources_changed(self) -> None:
+        with self._lock:
+            self._bump("resources")
 
     # --------------------------------------------------------------- watches
 
@@ -630,6 +640,7 @@ class StateStore:
                              self.tables["sessions"].items()},
                 "coordinates": dict(self.tables["coordinates"]),
                 "kv_tombstones": dict(self._kv_tombstones),
+                "resources": self.resources.dump(),
                 **{t: dict(self.tables[t]) for t in RAW_TABLES},
             }
             return msgpack.packb(blob, use_bin_type=True)
@@ -659,6 +670,12 @@ class StateStore:
             for t in RAW_TABLES:
                 self.tables[t] = blob.get(t, {})
             self._kv_tombstones = dict(blob.get("kv_tombstones", {}))
+            # replace (or, for pre-resource snapshots, clear) the v2
+            # table — restore means the WHOLE store. Closes resource
+            # watches: post-restore events can't extend the pre-restore
+            # history (inmem/snapshot.go)
+            self.resources.restore(blob.get("resources")
+                                   or msgpack.packb([]))
             for watchers in self._watchers.values():
                 for ev in watchers:
                     ev.set()

@@ -1,0 +1,249 @@
+"""v2 resource storage: one conformance suite, two backends.
+
+The reference's pattern (internal/storage/conformance/conformance.go,
+run against inmem in backend_test.go and raft in conformance_test.go):
+a single behavioral contract — CAS semantics, uid lifetimes,
+GroupVersion handling, tenancy wildcards, watch ordering, owner
+indexing — verified against every Backend implementation.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.config import load
+from consul_tpu.resource import (
+    CASError,
+    GroupVersionMismatch,
+    InMemBackend,
+    NotFoundError,
+    RaftBackend,
+    WatchClosed,
+    WrongUidError,
+)
+from consul_tpu.resource.backend import STRONG
+from consul_tpu.server import Server
+
+from helpers import wait_for  # noqa: E402
+
+
+def rtype(kind="Artist", gv="v1"):
+    return {"Group": "demo", "GroupVersion": gv, "Kind": kind}
+
+
+def rid(name, kind="Artist", gv="v1", uid="", **tenancy):
+    return {"Type": rtype(kind, gv), "Name": name,
+            "Tenancy": {"Partition": tenancy.get("partition", "default"),
+                        "PeerName": tenancy.get("peer", "local"),
+                        "Namespace": tenancy.get("namespace", "default")},
+            "Uid": uid}
+
+
+def res(name, data=None, version="", owner=None, **kw):
+    return {"Id": rid(name, **kw), "Data": data or {"v": 1},
+            "Version": version, "Owner": owner}
+
+
+@pytest.fixture(scope="module")
+def raft_server():
+    cfg = load(dev=True, overrides={
+        "node_name": "res0", "server": True, "bootstrap": True})
+    srv = Server(cfg)
+    srv.start()
+    wait_for(srv.is_leader, what="leadership")
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(params=["inmem", "raft"])
+def backend(request, raft_server):
+    if request.param == "inmem":
+        return InMemBackend()
+    return RaftBackend(raft_server)
+
+
+# ------------------------------------------------------------ conformance
+
+class TestConformance:
+    def test_create_read_roundtrip(self, backend):
+        w = backend.write_cas(res("hendrix", {"genre": "blues"}))
+        assert w["Version"] != "" and w["Id"]["Uid"] != ""
+        assert w["Generation"] == w["Version"]
+        got = backend.read(rid("hendrix"))
+        assert got["Data"] == {"genre": "blues"}
+
+    def test_read_missing_raises(self, backend):
+        with pytest.raises(NotFoundError):
+            backend.read(rid("nobody"))
+
+    def test_cas_create_requires_empty_version(self, backend):
+        backend.write_cas(res("cas-a"))
+        with pytest.raises(CASError):
+            backend.write_cas(res("cas-a"))  # version "" on existing
+
+    def test_cas_update_requires_current_version(self, backend):
+        w = backend.write_cas(res("cas-b"))
+        with pytest.raises(CASError):
+            backend.write_cas(res("cas-b", version="bogus"))
+        w2 = backend.write_cas(res("cas-b", {"v": 2}, version=w["Version"]))
+        assert w2["Version"] != w["Version"]
+
+    def test_generation_stable_on_status_only_write(self, backend):
+        w = backend.write_cas(res("gen", {"x": 1}))
+        r2 = dict(w)
+        r2["Status"] = {"ctl": {"ObservedGeneration": w["Generation"]}}
+        w2 = backend.write_cas(r2)
+        assert w2["Generation"] == w["Generation"]
+        assert w2["Version"] != w["Version"]
+        w3 = backend.write_cas({**w2, "Data": {"x": 2}})
+        assert w3["Generation"] != w2["Generation"]
+
+    def test_uid_immutable(self, backend):
+        w = backend.write_cas(res("uid-a"))
+        stale = res("uid-a", version=w["Version"])
+        stale["Id"]["Uid"] = "someone-else"
+        with pytest.raises(WrongUidError):
+            backend.write_cas(stale)
+
+    def test_read_with_uid_scopes_lifetime(self, backend):
+        w = backend.write_cas(res("life"))
+        old_uid = w["Id"]["Uid"]
+        backend.delete_cas(w["Id"], w["Version"])
+        backend.write_cas(res("life"))  # new lifetime, new uid
+        with pytest.raises(NotFoundError):
+            backend.read(rid("life", uid=old_uid))
+        assert backend.read(rid("life"))["Id"]["Uid"] != old_uid
+
+    def test_group_version_mismatch_carries_stored(self, backend):
+        backend.write_cas(res("gvm", gv="v2"))
+        with pytest.raises(GroupVersionMismatch) as ei:
+            backend.read(rid("gvm", gv="v1"))
+        assert ei.value.stored["Id"]["Type"]["GroupVersion"] == "v2"
+
+    def test_delete_missing_is_noop(self, backend):
+        backend.delete_cas(rid("ghost"), "any")  # no error
+
+    def test_delete_cas_checks_version(self, backend):
+        w = backend.write_cas(res("del-a"))
+        with pytest.raises(CASError):
+            backend.delete_cas(w["Id"], "bogus")
+        backend.delete_cas(w["Id"], w["Version"])
+        with pytest.raises(NotFoundError):
+            backend.read(rid("del-a"))
+
+    def test_delete_wrong_uid_is_noop(self, backend):
+        w = backend.write_cas(res("del-b"))
+        other = dict(w["Id"], Uid="stale-uid")
+        backend.delete_cas(other, "")
+        assert backend.read(rid("del-b"))  # still there
+
+    def test_list_prefix_and_tenancy_wildcard(self, backend):
+        backend.write_cas(res("list-x1", kind="Album"))
+        backend.write_cas(res("list-x2", kind="Album"))
+        backend.write_cas(res("other", kind="Album", namespace="ns2"))
+        names = [r["Id"]["Name"] for r in backend.list(
+            rtype("Album"), {"Partition": "default", "PeerName": "local",
+                             "Namespace": "default"}, "list-x")]
+        assert names == ["list-x1", "list-x2"]
+        wild = backend.list(rtype("Album"), {"Namespace": "*"})
+        assert {r["Id"]["Name"] for r in wild} >= {"list-x1", "list-x2",
+                                                   "other"}
+
+    def test_list_by_owner_uid_scoped(self, backend):
+        owner = backend.write_cas(res("owner-a", kind="Band"))
+        backend.write_cas(res("track1", kind="Track", owner=owner["Id"]))
+        backend.write_cas(res("track2", kind="Track", owner=owner["Id"]))
+        owned = backend.list_by_owner(owner["Id"])
+        assert {r["Id"]["Name"] for r in owned} == {"track1", "track2"}
+        # a different lifetime of the owner owns nothing
+        stale = dict(owner["Id"], Uid="other-uid")
+        assert backend.list_by_owner(stale) == []
+
+    def test_watch_snapshot_then_delta_in_order(self, backend):
+        backend.write_cas(res("w-pre", kind="Song"))
+        w = backend.watch_list(rtype("Song"), {})
+        ev = w.next(timeout=2)
+        assert ev.op == "upsert" and ev.resource["Id"]["Name"] == "w-pre"
+        wr = backend.write_cas(res("w-live", kind="Song"))
+        ev = w.next(timeout=2)
+        assert ev.op == "upsert" and ev.resource["Id"]["Name"] == "w-live"
+        backend.delete_cas(wr["Id"], wr["Version"])
+        ev = w.next(timeout=2)
+        assert ev.op == "delete" and ev.resource["Id"]["Name"] == "w-live"
+        w.close()
+
+    def test_watch_filters_by_prefix(self, backend):
+        w = backend.watch_list(rtype("Filt"), {}, "yes-")
+        backend.write_cas(res("no-match", kind="Filt"))
+        backend.write_cas(res("yes-match", kind="Filt"))
+        ev = w.next(timeout=2)
+        assert ev.resource["Id"]["Name"] == "yes-match"
+        w.close()
+
+
+# ------------------------------------------------------- raft specifics
+
+def test_raft_versions_are_raft_indexes(raft_server):
+    b = RaftBackend(raft_server)
+    w1 = b.write_cas(res("ridx-1", kind="Idx"))
+    w2 = b.write_cas(res("ridx-2", kind="Idx"))
+    assert int(w2["Version"]) > int(w1["Version"])
+
+
+def test_raft_strong_read_on_leader(raft_server):
+    b = RaftBackend(raft_server)
+    w = b.write_cas(res("strong", kind="Strong"))
+    assert b.read(w["Id"], consistency=STRONG)["Version"] == w["Version"]
+
+
+def test_raft_snapshot_restore_closes_watches(raft_server):
+    b = RaftBackend(raft_server)
+    b.write_cas(res("snapres", kind="Snap"))
+    w = b.watch_list(rtype("Snap"), {})
+    assert w.next(timeout=2).op == "upsert"
+    blob = raft_server.state.dump()
+    raft_server.state.restore(blob)
+    with pytest.raises(WatchClosed):
+        while True:
+            w.next(timeout=2)
+    # restored data still readable
+    assert b.read(rid("snapres", kind="Snap"))
+
+
+def test_raft_cluster_replicates_and_forwards():
+    """Follower-bound backend: writes forward to the leader, replicate
+    to every store (raft/forwarding.go's job, done here by endpoint
+    re-execution on the leader)."""
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"res-c{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    try:
+        for s in servers[1:]:
+            assert s.join([servers[0].serf.memberlist.transport.addr]) == 1
+        leader = wait_for(
+            lambda: next((s for s in servers if s.is_leader()), None),
+            what="leader election")
+        follower = next(s for s in servers if s is not leader)
+        b = RaftBackend(follower)
+        w = b.write_cas(res("fwd", kind="Fwd", data={"hello": "tpu"}))
+        assert w["Version"] != ""
+        # replicated everywhere
+        wait_for(lambda: all(
+            s.state.resources.list({"Group": "demo", "Kind": "Fwd"}, {})
+            for s in servers), what="resource replication")
+        # strong read from the follower forwards to the leader
+        got = b.read(w["Id"], consistency=STRONG)
+        assert got["Data"] == {"hello": "tpu"}
+    finally:
+        for s in servers:
+            s.shutdown()
