@@ -1,0 +1,20 @@
+"""Controller runtime: K8s-style reconcilers over v2 resources.
+
+Equivalent of the reference's internal/controller/: a Controller names
+a managed resource type and a Reconcile function; the runtime watches
+the managed type (plus any dependency-mapped watched types), dedupes
+work into per-controller queues, retries failures with exponential
+backoff, and — for leader-placed controllers — only runs while this
+server holds the raft lease (internal/controller/{controller,manager,
+runner,supervisor,lease}.go).
+"""
+
+from consul_tpu.controller.controller import (
+    Controller,
+    Request,
+    RequeueAfter,
+    map_owner,
+)
+from consul_tpu.controller.manager import Manager
+
+__all__ = ["Controller", "Manager", "Request", "RequeueAfter", "map_owner"]

@@ -1,0 +1,95 @@
+"""Controller definition: the builder the reference exposes
+(internal/controller/controller.go:63-190, NewController + With*).
+
+A controller = managed type + reconciler + optional dependency watches.
+The reconciler receives a Request (the managed resource's ID) and the
+runtime (backend access); dependency mappers turn events on OTHER types
+into requests for the managed type (dependencies.go DependencyMapper).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+#: Reconciler: fn(runtime, request) -> None. Raise to retry with
+#: backoff; raise RequeueAfter(seconds) for a deliberate revisit
+#: (controller.go:305-331 Reconciler + RequeueAfterError).
+Reconciler = Callable[["Runtime", "Request"], None]
+
+#: DependencyMapper: fn(runtime, watch_event) -> list[id_dict] — which
+#: managed resources are affected by an event on a watched type.
+DependencyMapper = Callable[[Any, Any], list]
+
+# Placement (controller.go:275-302): leader-only is the norm (writes
+# must go through the lease holder); each-server is for node-local
+# concerns (e.g. cert pushing).
+PLACEMENT_LEADER = "leader"
+PLACEMENT_EACH_SERVER = "each-server"
+
+
+@dataclass(frozen=True)
+class Request:
+    """One unit of reconcile work: the managed resource's ID dict
+    (controller.go:334-344)."""
+
+    id: dict
+
+    def key(self) -> tuple:
+        from consul_tpu.resource.types import storage_key
+
+        return storage_key(self.id)
+
+
+class RequeueAfter(Exception):
+    """Raised by a reconciler to schedule a revisit after `delay`
+    seconds without counting as a failure (controller.go:317-331)."""
+
+    def __init__(self, delay: float) -> None:
+        super().__init__(f"requeue after {delay}s")
+        self.delay = delay
+
+
+@dataclass
+class Controller:
+    name: str
+    managed_type: dict  # {"Group","GroupVersion","Kind"}
+    reconciler: Optional[Reconciler] = None
+    # [(watched_type, mapper)] — events on watched_type map to managed
+    # requests via mapper (WithWatch, controller.go:110)
+    watches: list[tuple[dict, DependencyMapper]] = field(
+        default_factory=list)
+    backoff_base: float = 0.05
+    backoff_max: float = 5.0
+    placement: str = PLACEMENT_LEADER
+    # re-reconcile everything at this cadence even without events
+    # (WithForceReconcileEvery, controller.go:183; guards drift)
+    force_reconcile_every: Optional[float] = None
+
+    def with_reconciler(self, fn: Reconciler) -> "Controller":
+        self.reconciler = fn
+        return self
+
+    def with_watch(self, watched_type: dict,
+                   mapper: DependencyMapper) -> "Controller":
+        self.watches.append((watched_type, mapper))
+        return self
+
+    def with_backoff(self, base: float, max_: float) -> "Controller":
+        self.backoff_base, self.backoff_max = base, max_
+        return self
+
+    def with_placement(self, placement: str) -> "Controller":
+        self.placement = placement
+        return self
+
+    def with_force_reconcile_every(self, every: float) -> "Controller":
+        self.force_reconcile_every = every
+        return self
+
+
+def map_owner(_runtime, event) -> list:
+    """The stock mapper: route an event on an owned resource to its
+    owner (dependency/mapper patterns — cascading status rollup)."""
+    owner = event.resource.get("Owner")
+    return [owner] if owner else []

@@ -1,0 +1,248 @@
+"""Controller manager + supervised runners.
+
+internal/controller/{manager,runner,supervisor}.go: the Manager holds
+registered controllers; run() starts one supervised runner per
+controller (watch pumps + a dedup work queue + the reconcile loop);
+leader-placed controllers only run while `is_leader()` holds (the
+lease, lease.go) — the manager polls leadership and starts/stops
+runners on transitions, so a deposed leader's controllers stop writing.
+
+Failure handling: a reconcile that raises is retried with exponential
+backoff per request key (supervisor.go backoff); RequeueAfter schedules
+a deliberate revisit; a closed watch (snapshot restore) tears down and
+restarts the runner from a fresh snapshot — matching storage's
+"discard materialized state and re-watch" contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from consul_tpu.controller.controller import (
+    PLACEMENT_LEADER,
+    Controller,
+    Request,
+    RequeueAfter,
+)
+from consul_tpu.resource.types import WILDCARD, WatchClosed
+from consul_tpu.utils import log
+
+
+class _Runner:
+    """One controller's execution: watch pumps feed a deduping queue;
+    the work loop reconciles with per-key backoff (runner.go)."""
+
+    def __init__(self, ctl: Controller, backend, runtime) -> None:
+        self.ctl = ctl
+        self.backend = backend
+        self.runtime = runtime
+        self.log = log.named(f"controller.{ctl.name}")
+        self._cond = threading.Condition()
+        # key -> (Request, not_before_monotonic, consecutive_failures)
+        self._queue: dict[tuple, tuple[Request, float, int]] = {}
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._watches: list = []
+
+    # ------------------------------------------------------------ enqueue
+
+    def enqueue(self, req: Request, delay: float = 0.0,
+                failures: int = 0) -> None:
+        key = req.key()
+        with self._cond:
+            prev = self._queue.get(key)
+            not_before = time.monotonic() + delay
+            if prev is not None:
+                # dedup: keep the earlier deadline, the higher failure
+                # count (a success event arriving during backoff must
+                # not clear the retry history mid-flight)
+                not_before = min(prev[1], not_before)
+                failures = max(prev[2], failures)
+            self._queue[key] = (req, not_before, failures)
+            self._cond.notify()
+
+    def _next(self, timeout: float = 0.5) -> Optional[tuple[Request, int]]:
+        with self._cond:
+            now = time.monotonic()
+            ready = [(nb, k) for k, (_, nb, _) in self._queue.items()
+                     if nb <= now]
+            if not ready:
+                due = min((nb for _, nb, _ in self._queue.values()),
+                          default=now + timeout)
+                self._cond.wait(min(timeout, max(0.0, due - now)) or 0.01)
+                return None
+            ready.sort()
+            _, key = ready[0]
+            req, _, failures = self._queue.pop(key)
+            return req, failures
+
+    # -------------------------------------------------------------- loops
+
+    def start(self) -> None:
+        wild = {"Partition": WILDCARD, "PeerName": WILDCARD,
+                "Namespace": WILDCARD}
+        # snapshot-then-delta watch on the managed type: the initial
+        # upserts double as the boot-time full reconcile pass
+        w = self.backend.watch_list(self.ctl.managed_type, wild)
+        self._watches.append(w)
+        self._spawn(self._pump_managed, w)
+        for wtype, mapper in self.ctl.watches:
+            dw = self.backend.watch_list(wtype, wild)
+            self._watches.append(dw)
+            self._spawn(self._pump_mapped, dw, mapper)
+        self._spawn(self._work_loop)
+        if self.ctl.force_reconcile_every:
+            self._spawn(self._force_loop)
+
+    def _spawn(self, fn, *args) -> None:
+        t = threading.Thread(target=fn, args=args, daemon=True,
+                             name=f"ctl-{self.ctl.name}")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w in self._watches:
+            w.close()
+        with self._cond:
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def _pump_managed(self, watch) -> None:
+        while not self._stop.is_set():
+            try:
+                ev = watch.next(timeout=0.5)
+            except WatchClosed:
+                self._rewatch()
+                return
+            if ev is not None:
+                self.enqueue(Request(ev.resource["Id"]))
+
+    def _pump_mapped(self, watch, mapper) -> None:
+        while not self._stop.is_set():
+            try:
+                ev = watch.next(timeout=0.5)
+            except WatchClosed:
+                self._rewatch()
+                return
+            if ev is None:
+                continue
+            try:
+                for rid in mapper(self.runtime, ev) or []:
+                    self.enqueue(Request(rid))
+            except Exception:  # noqa: BLE001
+                self.log.exception("dependency mapper failed")
+
+    def _rewatch(self) -> None:
+        """Watch invalidated (snapshot restore): restart this runner's
+        watches from a fresh snapshot — materialized history is void."""
+        if self._stop.is_set():
+            return
+        self.log.warning("watch closed; re-watching from snapshot")
+        for w in self._watches:
+            w.close()
+        self._watches.clear()
+        wild = {"Partition": WILDCARD, "PeerName": WILDCARD,
+                "Namespace": WILDCARD}
+        w = self.backend.watch_list(self.ctl.managed_type, wild)
+        self._watches.append(w)
+        self._spawn(self._pump_managed, w)
+        for wtype, mapper in self.ctl.watches:
+            dw = self.backend.watch_list(wtype, wild)
+            self._watches.append(dw)
+            self._spawn(self._pump_mapped, dw, mapper)
+
+    def _force_loop(self) -> None:
+        every = self.ctl.force_reconcile_every
+        while not self._stop.wait(every):
+            wild = {"Partition": WILDCARD, "PeerName": WILDCARD,
+                    "Namespace": WILDCARD}
+            for r in self.backend.list(self.ctl.managed_type, wild):
+                self.enqueue(Request(r["Id"]))
+
+    def _work_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._next()
+            if item is None:
+                continue
+            req, failures = item
+            try:
+                self.ctl.reconciler(self.runtime, req)
+            except RequeueAfter as rq:
+                self.enqueue(req, delay=rq.delay)
+            except Exception:  # noqa: BLE001
+                delay = min(self.ctl.backoff_base * (2 ** failures),
+                            self.ctl.backoff_max)
+                self.log.exception(
+                    "reconcile failed (attempt %d, retry in %.2fs)",
+                    failures + 1, delay)
+                self.enqueue(req, delay=delay, failures=failures + 1)
+
+
+class Runtime:
+    """What a reconciler gets to touch (controller.go Runtime): the
+    resource backend plus a logger."""
+
+    def __init__(self, backend, logger) -> None:
+        self.backend = backend
+        self.log = logger
+
+
+class Manager:
+    def __init__(self, backend,
+                 is_leader: Callable[[], bool] = lambda: True,
+                 poll_interval: float = 0.2) -> None:
+        self.backend = backend
+        self.is_leader = is_leader
+        self.poll_interval = poll_interval
+        self.log = log.named("controller-manager")
+        self._controllers: list[Controller] = []
+        self._runners: dict[str, _Runner] = {}
+        self._stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
+
+    def register(self, ctl: Controller) -> None:
+        if ctl.reconciler is None:
+            raise ValueError(f"controller {ctl.name} has no reconciler")
+        self._controllers.append(ctl)
+
+    def run(self) -> None:
+        """Start every controller (leader-placed ones only while the
+        lease holds; a watcher thread handles transitions)."""
+        self._sync_lease()
+        self._lease_thread = threading.Thread(target=self._lease_loop,
+                                              daemon=True,
+                                              name="ctl-lease")
+        self._lease_thread.start()
+
+    def _lease_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            self._sync_lease()
+
+    def _sync_lease(self) -> None:
+        leader = self.is_leader()
+        for ctl in self._controllers:
+            want = leader or ctl.placement != PLACEMENT_LEADER
+            have = ctl.name in self._runners
+            if want and not have:
+                self.log.info("starting controller %s", ctl.name)
+                r = _Runner(ctl, self.backend,
+                            Runtime(self.backend,
+                                    log.named(f"controller.{ctl.name}")))
+                self._runners[ctl.name] = r
+                r.start()
+            elif not want and have:
+                self.log.info("stopping controller %s (lost lease)",
+                              ctl.name)
+                self._runners.pop(ctl.name).stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._lease_thread:
+            self._lease_thread.join(timeout=2.0)
+        for r in self._runners.values():
+            r.stop()
+        self._runners.clear()

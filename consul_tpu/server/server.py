@@ -50,6 +50,7 @@ class Server:
         self.metrics = telemetry.default
         self.scheduler = RealTimers()
         self._shutdown = False
+        self._controller_manager = None
 
         # L1: replicated state
         self.fsm = FSM()
@@ -345,9 +346,29 @@ class Server:
             s.shutdown()
         if self.serf_wan is not None:
             self.serf_wan.shutdown()
+        if self._controller_manager is not None:
+            self._controller_manager.stop()
         self.raft.shutdown()
         self.rpc.shutdown()
         self.pool.close()
+
+    # ----------------------------------------------------------- controllers
+
+    @property
+    def controllers(self):
+        """The controller manager (reference: server.go:438 registers
+        the controller manager against the raft storage backend).
+        Created on first use — servers with no registered controllers
+        pay no thread cost — and wired to the raft lease: leader-placed
+        controllers start/stop with leadership."""
+        if self._controller_manager is None:
+            from consul_tpu.controller import Manager
+            from consul_tpu.resource import RaftBackend
+
+            self._controller_manager = Manager(
+                RaftBackend(self), is_leader=self.is_leader)
+            self._controller_manager.run()
+        return self._controller_manager
 
     def _every(self, interval: float, fn) -> None:
         slot = len(self._loop_timers)

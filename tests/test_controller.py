@@ -1,0 +1,231 @@
+"""Controller runtime: reconcile, dependency mapping, backoff, lease.
+
+Covers the behaviors internal/controller locks down in
+controller_test.go / supervisor_test.go: events drive reconciles,
+mappers fan dependency events into managed requests, failures retry
+with backoff, RequeueAfter revisits, leader placement follows the
+lease, and snapshot restores re-watch cleanly.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.controller import Controller, Manager, Request, RequeueAfter
+from consul_tpu.controller.controller import PLACEMENT_EACH_SERVER, map_owner
+from consul_tpu.resource import InMemBackend
+
+from helpers import wait_for  # noqa: E402
+
+
+def rtype(kind):
+    return {"Group": "test", "GroupVersion": "v1", "Kind": kind}
+
+
+def res(name, kind, data=None, owner=None):
+    return {"Id": {"Type": rtype(kind), "Name": name, "Tenancy": {},
+                   "Uid": ""},
+            "Data": data or {"n": 1}, "Version": "", "Owner": owner}
+
+
+@pytest.fixture
+def backend():
+    return InMemBackend()
+
+
+def run_manager(backend, *controllers, is_leader=lambda: True):
+    m = Manager(backend, is_leader=is_leader, poll_interval=0.05)
+    for c in controllers:
+        m.register(c)
+    m.run()
+    return m
+
+
+def test_write_triggers_reconcile(backend):
+    seen = []
+    ctl = Controller("tracker", rtype("Widget")).with_reconciler(
+        lambda rt, req: seen.append(req.id["Name"]))
+    m = run_manager(backend, ctl)
+    try:
+        backend.write_cas(res("w1", "Widget"))
+        wait_for(lambda: "w1" in seen, what="reconcile of w1")
+    finally:
+        m.stop()
+
+
+def test_boot_snapshot_reconciles_existing(backend):
+    backend.write_cas(res("pre", "Boot"))
+    seen = []
+    ctl = Controller("boot", rtype("Boot")).with_reconciler(
+        lambda rt, req: seen.append(req.id["Name"]))
+    m = run_manager(backend, ctl)
+    try:
+        wait_for(lambda: "pre" in seen, what="boot reconcile")
+    finally:
+        m.stop()
+
+
+def test_dependency_mapper_routes_to_owner(backend):
+    """An event on an owned Leaf reconciles the owning Root — the
+    stock owner mapper (dependencies.go pattern)."""
+    seen = []
+    ctl = (Controller("rollup", rtype("Root"))
+           .with_reconciler(lambda rt, req: seen.append(req.id["Name"]))
+           .with_watch(rtype("Leaf"), map_owner))
+    m = run_manager(backend, ctl)
+    try:
+        root = backend.write_cas(res("root-a", "Root"))
+        wait_for(lambda: seen.count("root-a") >= 1, what="managed event")
+        n = len(seen)
+        backend.write_cas(res("leaf-1", "Leaf", owner=root["Id"]))
+        wait_for(lambda: len(seen) > n and seen[-1] == "root-a",
+                 what="mapped reconcile")
+    finally:
+        m.stop()
+
+
+def test_failure_retries_with_backoff(backend):
+    calls = []
+    def flaky(rt, req):
+        calls.append(time.monotonic())
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+    ctl = (Controller("flaky", rtype("Flk"))
+           .with_reconciler(flaky).with_backoff(0.05, 1.0))
+    m = run_manager(backend, ctl)
+    try:
+        backend.write_cas(res("f1", "Flk"))
+        wait_for(lambda: len(calls) >= 3, what="retries")
+        # exponential: second gap at least as long as scheduled base
+        assert calls[1] - calls[0] >= 0.04
+        assert calls[2] - calls[1] >= 0.08
+    finally:
+        m.stop()
+
+
+def test_requeue_after_revisits_without_failure(backend):
+    calls = []
+    def periodic(rt, req):
+        calls.append(time.monotonic())
+        if len(calls) < 2:
+            raise RequeueAfter(0.1)
+    ctl = Controller("requeue", rtype("Rq")).with_reconciler(periodic)
+    m = run_manager(backend, ctl)
+    try:
+        backend.write_cas(res("r1", "Rq"))
+        wait_for(lambda: len(calls) >= 2, what="requeue revisit")
+        assert calls[1] - calls[0] >= 0.09
+    finally:
+        m.stop()
+
+
+def test_leader_placement_follows_lease(backend):
+    leader = threading.Event()
+    seen = []
+    ctl = Controller("leaderonly", rtype("Ld")).with_reconciler(
+        lambda rt, req: seen.append(req.id["Name"]))
+    m = run_manager(backend, ctl, is_leader=leader.is_set)
+    try:
+        backend.write_cas(res("l1", "Ld"))
+        time.sleep(0.3)
+        assert seen == []  # not leader: controller not running
+        leader.set()
+        # gaining the lease starts the runner; boot snapshot reconciles
+        wait_for(lambda: "l1" in seen, what="post-lease reconcile")
+        leader.clear()
+        wait_for(lambda: "leaderonly" not in m._runners,
+                 what="runner stopped on lease loss")
+    finally:
+        m.stop()
+
+
+def test_each_server_placement_ignores_lease(backend):
+    seen = []
+    ctl = (Controller("everywhere", rtype("Ev"))
+           .with_placement(PLACEMENT_EACH_SERVER)
+           .with_reconciler(lambda rt, req: seen.append(req.id["Name"])))
+    m = run_manager(backend, ctl, is_leader=lambda: False)
+    try:
+        backend.write_cas(res("e1", "Ev"))
+        wait_for(lambda: "e1" in seen, what="non-leader reconcile")
+    finally:
+        m.stop()
+
+
+def test_force_reconcile_every(backend):
+    seen = []
+    ctl = (Controller("cron", rtype("Cr"))
+           .with_reconciler(lambda rt, req: seen.append(time.monotonic()))
+           .with_force_reconcile_every(0.15))
+    m = run_manager(backend, ctl)
+    try:
+        backend.write_cas(res("c1", "Cr"))
+        wait_for(lambda: len(seen) >= 3, what="forced periodic reconciles")
+    finally:
+        m.stop()
+
+
+def test_rewatch_after_store_restore(backend):
+    """A snapshot restore closes watches; runners must re-watch and
+    keep reconciling (the storage contract's 'discard and re-watch')."""
+    seen = []
+    ctl = Controller("survivor", rtype("Sv")).with_reconciler(
+        lambda rt, req: seen.append(req.id["Name"]))
+    m = run_manager(backend, ctl)
+    try:
+        backend.write_cas(res("s1", "Sv"))
+        wait_for(lambda: "s1" in seen, what="pre-restore reconcile")
+        backend.store.restore(backend.store.dump())  # closes watches
+        time.sleep(0.2)  # let runners notice + rewatch
+        backend.write_cas(res("s2", "Sv"))
+        wait_for(lambda: "s2" in seen, what="post-restore reconcile")
+    finally:
+        m.stop()
+
+
+def test_dedup_coalesces_bursts(backend):
+    """N rapid writes to one resource reconcile fewer than N times
+    (the queue keys by resource — runner.go dedup)."""
+    lock = threading.Lock()
+    calls = []
+    def slow(rt, req):
+        with lock:
+            calls.append(req.id["Name"])
+        time.sleep(0.1)
+    ctl = Controller("dedup", rtype("Dd")).with_reconciler(slow)
+    m = run_manager(backend, ctl)
+    try:
+        w = backend.write_cas(res("d1", "Dd"))
+        for i in range(10):
+            w = backend.write_cas({**w, "Data": {"n": i}})
+        wait_for(lambda: len(calls) >= 1, what="first reconcile")
+        time.sleep(0.5)
+        assert 1 <= len(calls) < 10
+    finally:
+        m.stop()
+
+
+def test_server_integration_lease_and_reconcile():
+    """Controllers on a real Server: register via srv.controllers,
+    reconcile against the raft-backed resource store, leader lease
+    active (server.go:438 wiring)."""
+    from consul_tpu.config import load
+    from consul_tpu.server import Server
+
+    cfg = load(dev=True, overrides={
+        "node_name": "ctl0", "server": True, "bootstrap": True})
+    srv = Server(cfg)
+    srv.start()
+    try:
+        wait_for(srv.is_leader, what="leadership")
+        seen = []
+        ctl = Controller("live", rtype("Live")).with_reconciler(
+            lambda rt, req: seen.append(req.id["Name"]))
+        srv.controllers.register(ctl)
+        from consul_tpu.resource import RaftBackend
+
+        RaftBackend(srv).write_cas(res("lv1", "Live"))
+        wait_for(lambda: "lv1" in seen, what="server-hosted reconcile")
+    finally:
+        srv.shutdown()
