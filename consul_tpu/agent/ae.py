@@ -95,6 +95,8 @@ class StateSyncer:
         # push node + all services + checks that are out of sync or missing
         base = {"Node": node, "Address": a.advertise_addr(),
                 "ID": a.node_id}
+        if getattr(a.config, "partition", "default") != "default":
+            base["Partition"] = a.config.partition
         # register each service with its checks
         for sid, svc in local_services.items():
             svc_checks = [c.to_check_dict() for c in local_checks.values()

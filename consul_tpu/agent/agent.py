@@ -351,8 +351,20 @@ class Agent:
             return self.rpc(method, args)
         return self.cache.get(method, args, ttl=ttl)
 
-    def members(self) -> list[dict[str, Any]]:
-        return [m.snapshot() for m in self.serf.members(include_left=True)]
+    def members(self, partition: str = "") -> list[dict[str, Any]]:
+        """LAN members, scoped to this agent's admin partition unless
+        the caller asks otherwise ("" = own partition, "*" = all —
+        reference: LANMembersInAgentPartition). Servers carry no `ap`
+        tag and are visible from every partition."""
+        want = partition or getattr(self.config, "partition", "default")
+        out = []
+        for m in self.serf.members(include_left=True):
+            snap = m.snapshot()
+            ap = (snap.get("tags") or {}).get("ap", "")
+            if want != "*" and ap and ap != want:
+                continue
+            out.append(snap)
+        return out
 
     def join(self, addrs: list[str]) -> int:
         if self.server is not None:

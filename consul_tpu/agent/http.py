@@ -168,6 +168,8 @@ class HTTPApi:
                 args["MaxQueryTime"] = _dur(q["wait"])
             if "stale" in q:
                 args["AllowStale"] = True
+            if "partition" in q:
+                args["Partition"] = q["partition"]
             return args
 
         def jbody() -> dict[str, Any]:
@@ -198,7 +200,7 @@ class HTTPApi:
         if path == "/v1/agent/members":
             if "wan" in q:
                 return rpc("Internal.Members", {"WAN": True}), None
-            return a.members(), None
+            return a.members(q.get("partition", "")), None
         if path == "/v1/agent/version":
             return {"SHA": "", "HumanVersion": __version__}, None
         if path == "/v1/agent/host":

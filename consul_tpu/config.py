@@ -174,6 +174,12 @@ class RuntimeConfig:
     segment: str = ""
     segments: tuple = ()
 
+    # Admin partition (reference: server_serf.go:53, merge.go:27):
+    # tenancy partitioning of the ONE LAN gossip pool. Client agents
+    # live in exactly one partition; servers span all of them (and
+    # always sit in "default").
+    partition: str = "default"
+
     # Anti-entropy (reference: agent/ae/ae.go:57)
     sync_coalesce_timeout: float = 0.2
 
@@ -268,6 +274,7 @@ _CONFIG_ALIASES = {
     "enable_remote_exec": "enable_remote_exec",
     "tombstone_ttl": "tombstone_ttl",
     "segment": "segment",
+    "partition": "partition",
 }
 
 class ConfigError(Exception):
@@ -450,6 +457,10 @@ def validate(cfg: RuntimeConfig) -> None:
         raise ConfigError("bootstrap_expect=1 is not allowed; use bootstrap")
     if not cfg.dev_mode and cfg.server_mode and not cfg.data_dir:
         raise ConfigError("server mode requires data_dir")
+    if cfg.server_mode and cfg.partition not in ("", "default"):
+        # servers span all partitions (server_serf.go:53: Partition is
+        # a client-agent option; the WAN pool rejects it outright)
+        raise ConfigError("server agents cannot be placed in a partition")
     if cfg.tls_https and not (cfg.tls_cert_file and cfg.tls_key_file):
         raise ConfigError(
             "tls.https requires cert_file and key_file")

@@ -47,7 +47,7 @@ class Client:
         self.rng = random.Random()
 
         tags = {"role": "node", "dc": config.datacenter, "id": self.node_id,
-                "segment": config.segment}
+                "segment": config.segment, "ap": config.partition}
         from consul_tpu.gossip.messages import make_keyring
         from consul_tpu.gossip.serf import segment_merge_check
 

@@ -108,14 +108,15 @@ def register_endpoints(srv) -> None:
     def catalog_list_nodes(args):
         az = authz(args)
         return srv.blocking_query(args, ("nodes",), lambda: {
-            "Nodes": [n.to_dict() for n in state.nodes()
+            "Nodes": [n.to_dict()
+                      for n in state.nodes(args.get("Partition"))
                       if az.node_read(n.node)]})
 
     def catalog_list_services(args):
         az = authz(args)
         return srv.blocking_query(args, ("services",), lambda: {
             "Services": {name: tags for name, tags
-                         in state.services().items()
+                         in state.services(args.get("Partition")).items()
                          if az.service_read(name)}})
 
     def catalog_service_nodes(args):
@@ -146,7 +147,8 @@ def register_endpoints(srv) -> None:
                     "ServiceTags": s.tags, "ServiceAddress": s.address,
                     "ServicePort": s.port, "ServiceMeta": s.meta,
                     "ServiceKind": s.kind}}
-                for n, s in state.service_nodes(svc, tag)],
+                for n, s in state.service_nodes(svc, tag,
+                                                args.get("Partition"))],
                 near, lambda e: e["Node"])})
 
     def catalog_node_services(args):
@@ -195,13 +197,17 @@ def register_endpoints(srv) -> None:
         tag = args.get("ServiceTag") or None
         passing = bool(args.get("MustBePassing"))
         near = args.get("Near", "")
-        lookup = state.connect_service_nodes if args.get("Connect") \
-            else state.check_service_nodes
+        if args.get("Connect"):
+            lookup = lambda: state.connect_service_nodes(  # noqa: E731
+                svc, tag, passing_only=passing)
+        else:
+            lookup = lambda: state.check_service_nodes(  # noqa: E731
+                svc, tag, passing_only=passing,
+                partition=args.get("Partition"))
         return srv.blocking_query(
             args, ("services", "nodes", "checks"), lambda: {
                 "Nodes": _near_sort(
-                    lookup(svc, tag, passing_only=passing),
-                    near, lambda e: e["Node"]["Node"])})
+                    lookup(), near, lambda e: e["Node"]["Node"])})
 
     def _check_visible(az, c) -> bool:
         """aclFilter for health checks (reference filterACL on

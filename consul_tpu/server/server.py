@@ -807,6 +807,7 @@ class Server:
             self.raft.apply(encode_command(MessageType.REGISTER, {
                 "Node": name, "Address": addr.rsplit(":", 1)[0],
                 "ID": tags.get("id", ""),
+                "Partition": tags.get("ap", ""),
                 "Check": {"CheckID": SERF_CHECK_ID, "Name": SERF_CHECK_NAME,
                           "Status": "passing",
                           "Output": "Agent alive and reachable"}}))

@@ -104,7 +104,7 @@ class FSM:
             tagged_addresses=b.get("TaggedAddresses"),
             node_meta=b.get("NodeMeta"),
             service=b.get("Service"), check=b.get("Check"),
-            checks=b.get("Checks"))
+            checks=b.get("Checks"), partition=b.get("Partition", ""))
         # a check going critical invalidates sessions bound to it — this
         # must happen INSIDE the replicated command so every replica's
         # store agrees (session_ttl.go semantics, deterministically)

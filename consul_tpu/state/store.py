@@ -141,7 +141,8 @@ class StateStore:
                             node_meta: Optional[dict] = None,
                             service: Optional[dict] = None,
                             check: Optional[dict] = None,
-                            checks: Optional[list[dict]] = None) -> int:
+                            checks: Optional[list[dict]] = None,
+                            partition: str = "") -> int:
         """Atomic node+service+check upsert (structs.RegisterRequest →
         state.EnsureRegistration)."""
         with self._lock:
@@ -151,7 +152,8 @@ class StateStore:
                 n = Node(node=node, address=address, node_id=node_id,
                          datacenter=datacenter,
                          tagged_addresses=tagged_addresses or {},
-                         meta=node_meta or {})
+                         meta=node_meta or {},
+                         partition=partition or "default")
                 n.create_index = self._index + 1
             else:
                 n.address = address or n.address
@@ -160,6 +162,8 @@ class StateStore:
                     n.tagged_addresses.update(tagged_addresses)
                 if node_meta is not None:
                     n.meta = dict(node_meta)
+                if partition:
+                    n.partition = partition
             if service is not None:
                 svc = _service_from_dict(service)
                 key = (node, svc.id)
@@ -239,9 +243,17 @@ class StateStore:
         with self._lock:
             return self.tables["nodes"].get(node)
 
-    def nodes(self) -> list[Node]:
+    @staticmethod
+    def _pmatch(node_partition: str, want: Optional[str]) -> bool:
+        """Admin-partition filter: None/"" = caller didn't scope (all
+        partitions, the pre-partition behavior), "*" = explicit
+        wildcard, else exact."""
+        return not want or want == "*" or node_partition == want
+
+    def nodes(self, partition: Optional[str] = None) -> list[Node]:
         with self._lock:
-            return sorted(self.tables["nodes"].values(),
+            return sorted((n for n in self.tables["nodes"].values()
+                           if self._pmatch(n.partition, partition)),
                           key=lambda n: n.node)
 
     def node_services(self, node: str) -> list[NodeService]:
@@ -249,15 +261,22 @@ class StateStore:
             return [s for (n, _), s in self.tables["services"].items()
                     if n == node]
 
-    def services(self) -> dict[str, list[str]]:
-        """service name -> sorted union of tags (catalog /v1/catalog/services)."""
+    def services(self, partition: Optional[str] = None
+                 ) -> dict[str, list[str]]:
+        """service name -> sorted union of tags (catalog /v1/catalog/services).
+        Services inherit their node's partition (one source of truth)."""
         with self._lock:
             out: dict[str, set[str]] = {}
-            for s in self.tables["services"].values():
+            for (node, _), s in self.tables["services"].items():
+                if partition:
+                    n = self.tables["nodes"].get(node)
+                    if n is None or not self._pmatch(n.partition, partition):
+                        continue
                 out.setdefault(s.service, set()).update(s.tags)
             return {k: sorted(v) for k, v in sorted(out.items())}
 
-    def service_nodes(self, service: str, tag: Optional[str] = None
+    def service_nodes(self, service: str, tag: Optional[str] = None,
+                      partition: Optional[str] = None
                       ) -> list[tuple[Node, NodeService]]:
         with self._lock:
             out = []
@@ -267,7 +286,7 @@ class StateStore:
                 if tag and tag not in s.tags:
                     continue
                 n = self.tables["nodes"].get(node)
-                if n is not None:
+                if n is not None and self._pmatch(n.partition, partition):
                     out.append((n, s))
             return sorted(out, key=lambda t: (t[0].node, t[1].id))
 
@@ -305,13 +324,14 @@ class StateStore:
                           key=lambda c: (c.node, c.check_id))
 
     def check_service_nodes(self, service: str, tag: Optional[str] = None,
-                            passing_only: bool = False
+                            passing_only: bool = False,
+                            partition: Optional[str] = None
                             ) -> list[dict[str, Any]]:
         """The health endpoint's join: (node, service, node+svc checks)
         (state.CheckServiceNodes)."""
         with self._lock:
             out = []
-            for n, s in self.service_nodes(service, tag):
+            for n, s in self.service_nodes(service, tag, partition):
                 checks = [c for c in self.node_checks(n.node)
                           if c.service_id in ("", s.id)]
                 if passing_only and any(

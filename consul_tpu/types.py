@@ -113,6 +113,9 @@ class Node:
     datacenter: str = ""
     tagged_addresses: dict[str, str] = field(default_factory=dict)
     meta: dict[str, str] = field(default_factory=dict)
+    # admin partition (tenancy axis over ONE LAN pool — reference:
+    # structs' EnterpriseMeta, server_serf.go:53; CE pins "default")
+    partition: str = "default"
     create_index: int = 0
     modify_index: int = 0
 
@@ -121,6 +124,7 @@ class Node:
             "ID": self.node_id, "Node": self.node, "Address": self.address,
             "Datacenter": self.datacenter,
             "TaggedAddresses": self.tagged_addresses, "Meta": self.meta,
+            "Partition": self.partition,
             "CreateIndex": self.create_index, "ModifyIndex": self.modify_index,
         }
 

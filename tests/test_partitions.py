@@ -1,0 +1,136 @@
+"""Admin partitions: tenancy partitioning of one LAN gossip pool.
+
+Reference: server_serf.go:53 (Partition opt), merge.go:27 (delegate
+carries partition but same-DC members share the pool), enterprise-meta
+filtering on catalog queries. Client agents live in exactly one
+partition; servers span all; catalog queries scope by Partition with
+"*" as the wildcard.
+"""
+
+import pytest
+
+from consul_tpu.config import ConfigError, load, validate
+from consul_tpu.state.store import StateStore
+
+
+def test_server_rejects_partition_placement():
+    with pytest.raises(ConfigError):
+        validate(load(dev=True, overrides={
+            "server": True, "bootstrap": True, "partition": "team-a"}))
+
+
+def test_catalog_partition_scoping():
+    st = StateStore()
+    st.ensure_registration("n-default", "10.0.0.1",
+                           service={"Service": "web", "Port": 80})
+    st.ensure_registration("n-team-a", "10.0.0.2", partition="team-a",
+                           service={"Service": "web", "Port": 81})
+    st.ensure_registration("n-team-b", "10.0.0.3", partition="team-b",
+                           service={"Service": "db", "Port": 5432})
+
+    # unscoped (internal callers): everything
+    assert len(st.nodes()) == 3
+    # scoped: only the partition's nodes
+    assert [n.node for n in st.nodes("team-a")] == ["n-team-a"]
+    assert [n.node for n in st.nodes("default")] == ["n-default"]
+    # wildcard
+    assert len(st.nodes("*")) == 3
+    # services inherit the node's partition
+    assert set(st.services("team-a")) == {"web"}
+    assert set(st.services("team-b")) == {"db"}
+    assert set(st.services("*")) == {"web", "db"}
+    # service_nodes scoped
+    assert [n.node for n, _ in st.service_nodes("web", partition="team-a")] \
+        == ["n-team-a"]
+    assert len(st.service_nodes("web", partition="*")) == 2
+    # health join scoped
+    nodes = st.check_service_nodes("web", partition="team-a")
+    assert [e["Node"]["Node"] for e in nodes] == ["n-team-a"]
+    assert nodes[0]["Node"]["Partition"] == "team-a"
+
+
+def test_partition_survives_snapshot_roundtrip():
+    st = StateStore()
+    st.ensure_registration("pn", "10.1.1.1", partition="edge")
+    st2 = StateStore()
+    st2.restore(st.dump())
+    assert st2.get_node("pn").partition == "edge"
+
+
+def test_rpc_partition_threading():
+    """Partition arg flows HTTP-style args → endpoint → store filter on
+    a live server; serf-reconciled servers land in default."""
+    from consul_tpu.server import Server
+
+    from helpers import wait_for
+
+    cfg = load(dev=True, overrides={
+        "node_name": "ap0", "server": True, "bootstrap": True})
+    srv = Server(cfg)
+    srv.start()
+    try:
+        wait_for(srv.is_leader, what="leadership")
+        srv.handle_rpc("Catalog.Register", {
+            "Node": "edge-1", "Address": "10.9.9.9",
+            "Partition": "edge",
+            "Service": {"Service": "cam", "Port": 99}}, "test")
+        res = srv.handle_rpc("Catalog.ListNodes",
+                             {"Partition": "edge"}, "test")
+        assert [n["Node"] for n in res["Nodes"]] == ["edge-1"]
+        # the server's own serf-reconciled node sits in default
+        # (reconcile is periodic — wait for it)
+        wait_for(lambda: "ap0" in [
+            n["Node"] for n in srv.handle_rpc(
+                "Catalog.ListNodes",
+                {"Partition": "default"}, "test")["Nodes"]],
+            what="server self-registration in default partition")
+        res = srv.handle_rpc("Health.ServiceNodes", {
+            "ServiceName": "cam", "Partition": "edge"}, "test")
+        assert len(res["Nodes"]) == 1
+        res = srv.handle_rpc("Health.ServiceNodes", {
+            "ServiceName": "cam", "Partition": "other"}, "test")
+        assert res["Nodes"] == []
+    finally:
+        srv.shutdown()
+
+
+def test_agent_members_partition_filter():
+    """members() hides other partitions' client agents but always shows
+    servers (no ap tag) — LANMembersInAgentPartition semantics."""
+    from consul_tpu.agent.agent import Agent
+
+    from helpers import wait_for
+
+    srv_cfg = load(dev=True, overrides={
+        "node_name": "apm-srv", "server": True, "bootstrap": True})
+    a_cfg = load(dev=True, overrides={
+        "node_name": "apm-a", "server": False, "partition": "team-a"})
+    b_cfg = load(dev=True, overrides={
+        "node_name": "apm-b", "server": False, "partition": "team-b"})
+    srv_agent = Agent(srv_cfg)
+    srv_agent.start(serve_http=False, serve_dns=False)
+    aa = Agent(a_cfg)
+    aa.start(serve_http=False, serve_dns=False)
+    ab = Agent(b_cfg)
+    ab.start(serve_http=False, serve_dns=False)
+    try:
+        addr = srv_agent.server.serf.memberlist.transport.addr
+        assert aa.join([addr]) == 1
+        assert ab.join([addr]) == 1
+        wait_for(lambda: len(srv_agent.members("*")) == 3,
+                 what="3 LAN members")
+        # gossip must reach the CLIENTS' views too before filtering
+        wait_for(lambda: len(aa.members("*")) == 3
+                 and len(ab.members("*")) == 3,
+                 what="full membership convergence")
+        # each client sees: itself + the server, NOT the other partition
+        names_a = {m["name"] for m in aa.members()}
+        assert names_a == {"apm-a", "apm-srv"}
+        names_b = {m["name"] for m in ab.members()}
+        assert names_b == {"apm-b", "apm-srv"}
+        # explicit wildcard shows everything
+        assert len(aa.members("*")) == 3
+    finally:
+        ab.shutdown()
+        aa.shutdown()
+        srv_agent.shutdown()
