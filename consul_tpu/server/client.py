@@ -43,7 +43,16 @@ class Client:
             ctx.check_hostname = False  # internal addrs are IPs
             self.pool.tls_context = ctx
         self._lock = threading.Lock()
-        self._servers: list[str] = []
+        # ordered server list with failover cycling + periodic rebalance
+        # (agent/router Manager; ping = Status.Ping over the pool)
+        from consul_tpu.server.router import (DEFAULT_REBALANCE_INTERVAL,
+                                              ServerManager)
+
+        self.servers = ServerManager(ping=self._ping_server)
+        self._rebalance_interval = getattr(
+            config, "rebalance_interval", None) or DEFAULT_REBALANCE_INTERVAL
+        self._rebalance_stop = threading.Event()
+        self._rebalance_thread: Optional[threading.Thread] = None
         self.rng = random.Random()
 
         tags = {"role": "node", "dc": config.datacenter, "id": self.node_id,
@@ -68,6 +77,10 @@ class Client:
 
     def start(self) -> None:
         self.serf.start()
+        self._rebalance_thread = threading.Thread(
+            target=self._rebalance_loop, daemon=True,
+            name=f"rebalance-{self.name}")
+        self._rebalance_thread.start()
 
     def join(self, addrs: list[str]) -> int:
         n = self.serf.join(addrs)
@@ -78,6 +91,7 @@ class Client:
         self.serf.leave()
 
     def shutdown(self) -> None:
+        self._rebalance_stop.set()
         self.serf.shutdown()
         self.pool.close()
 
@@ -91,10 +105,10 @@ class Client:
         through the request/response frame cap (pool.RPCSnapshot)."""
         last: Exception = NoServersError("no known servers")
         for _ in range(retries):
-            server = self._pick_server()
+            server = self.servers.find()
             if server is None:
                 self._refresh_servers()
-                server = self._pick_server()
+                server = self.servers.find()
                 if server is None:
                     raise NoServersError("no consul servers in gossip pool")
             try:
@@ -107,25 +121,43 @@ class Client:
                 return self.pool.call(server, method, args)
             except ConnectionError as e:
                 last = e
-                with self._lock:
-                    if server in self._servers:
-                        self._servers.remove(server)
+                # cycle the failed head to the tail: the retry hits a
+                # DIFFERENT server (manager.go NotifyFailedServer)
+                self.servers.notify_failed(server)
         raise last
 
-    def _pick_server(self) -> Optional[str]:
-        with self._lock:
-            if not self._servers:
-                return None
-            return self.rng.choice(self._servers)
+    def _ping_server(self, addr: str) -> bool:
+        try:
+            return self.pool.call(addr, "Status.Ping", {}) == "pong"
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _rebalance_loop(self) -> None:
+        """Periodic shuffle+ping rebalance; period scales with cluster
+        size so fleet-wide ping load on servers stays constant
+        (manager.go:318, lib.RateScaledInterval)."""
+        from consul_tpu.server.router import rebalance_interval
+
+        while True:
+            n_nodes = len(self.serf.members(include_left=False))
+            period = rebalance_interval(self._rebalance_interval,
+                                        n_nodes,
+                                        max(1, self.servers.num_servers()))
+            if self._rebalance_stop.wait(period):
+                return
+            self.servers.rebalance()
 
     def _refresh_servers(self) -> None:
-        servers = [m.tags.get("rpc_addr", "")
-                   for m in self.serf.members()
-                   if m.tags.get("role") == "consul"
-                   and m.status == MemberStatus.ALIVE
-                   and m.tags.get("rpc_addr")]
-        with self._lock:
-            self._servers = servers
+        alive = {m.tags.get("rpc_addr", "")
+                 for m in self.serf.members()
+                 if m.tags.get("role") == "consul"
+                 and m.status == MemberStatus.ALIVE
+                 and m.tags.get("rpc_addr")}
+        for addr in self.servers.all_servers():
+            if addr not in alive:
+                self.servers.remove(addr)
+        for addr in alive:
+            self.servers.add(addr)
 
     def _serf_event(self, ev: SerfEvent) -> None:
         if ev.type in (EventType.MEMBER_JOIN, EventType.MEMBER_FAILED,

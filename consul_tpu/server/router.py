@@ -1,0 +1,181 @@
+"""Router: server tracking, failover cycling, periodic rebalance.
+
+Equivalent of agent/router/ (manager.go + router.go, 2894 LoC): a
+`ServerManager` keeps an ORDERED list of known servers for one area/DC —
+RPCs go to the head, a failed server cycles to the tail
+(NotifyFailedServer, manager.go:262-291), and a periodic rebalance
+shuffles the list then walks it pinging until a healthy head is found
+(RebalanceServers, manager.go:318-383). Rebalancing spreads client load
+evenly across servers after topology changes; the interval scales with
+cluster size so the fleet-wide ping load on servers stays constant
+(lib.RateScaledInterval semantics).
+
+`Router` multiplexes managers per (area, datacenter) — the WAN area gets
+one manager per DC fed from WAN serf events, so cross-DC forwarding
+inherits the same failover/rebalance behavior (router.go routeToDC).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Optional
+
+from consul_tpu.utils import log
+
+#: Base rebalance cadence (manager.go clientRPCMinReuseDuration=120s);
+#: tests shrink it.
+DEFAULT_REBALANCE_INTERVAL = 120.0
+
+#: One manager-initiated ping per server per this many seconds, fleet
+#: wide (clientRPCJitterFraction semantics, simplified).
+NODES_PER_SERVER_CYCLE = 128
+
+
+def rebalance_interval(base: float, n_nodes: int, n_servers: int) -> float:
+    """Scale the rebalance period up with cluster size so total ping
+    QPS against servers stays bounded (lib.RateScaledInterval)."""
+    if n_servers <= 0:
+        return base
+    scale = max(1.0, n_nodes / (NODES_PER_SERVER_CYCLE * n_servers))
+    return base * scale
+
+
+class ServerManager:
+    """Ordered server list for one area/DC (manager.go Manager)."""
+
+    def __init__(self, ping: Optional[Callable[[str], bool]] = None,
+                 seed: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._servers: list[str] = []
+        self._ping = ping
+        self.rng = random.Random(seed)
+
+    # ------------------------------------------------------------- list ops
+
+    def add(self, addr: str) -> None:
+        """Add (or re-add, idempotently) a server. New servers insert at
+        a random position — NOT the head — so a restarting fleet doesn't
+        stampede the newest server (manager.go AddServer)."""
+        with self._lock:
+            if addr in self._servers:
+                return
+            pos = self.rng.randint(0, len(self._servers)) \
+                if self._servers else 0
+            self._servers.insert(pos, addr)
+
+    def remove(self, addr: str) -> None:
+        with self._lock:
+            if addr in self._servers:
+                self._servers.remove(addr)
+
+    def find(self) -> Optional[str]:
+        """The current preferred server: always the head — stickiness
+        between rebalances keeps conn reuse high (manager.go:193)."""
+        with self._lock:
+            return self._servers[0] if self._servers else None
+
+    def notify_failed(self, addr: str) -> None:
+        """Cycle a failed server to the tail so the next find() returns
+        a different one (manager.go:262 NotifyFailedServer)."""
+        with self._lock:
+            if addr in self._servers and self._servers[0] == addr:
+                self._servers.append(self._servers.pop(0))
+
+    def num_servers(self) -> int:
+        with self._lock:
+            return len(self._servers)
+
+    def all_servers(self) -> list[str]:
+        with self._lock:
+            return list(self._servers)
+
+    def is_offline(self) -> bool:
+        """No servers, or (when a pinger is wired) none healthy
+        (manager.go:182)."""
+        with self._lock:
+            servers = list(self._servers)
+        if not servers:
+            return True
+        if self._ping is None:
+            return False
+        return not any(self._safe_ping(s) for s in servers)
+
+    # ------------------------------------------------------------ rebalance
+
+    def rebalance(self) -> Optional[str]:
+        """Shuffle, then walk the shuffled list pinging until a healthy
+        server is found and promoted to head (manager.go:318
+        RebalanceServers). Returns the new head (None if offline)."""
+        with self._lock:
+            servers = list(self._servers)
+        if not servers:
+            return None
+        self.rng.shuffle(servers)
+        head = None
+        for i, s in enumerate(servers):
+            if self._ping is None or self._safe_ping(s):
+                head = s
+                # rotate the healthy pick to the front, keep relative
+                # order of the rest (cycleServer until healthy head)
+                servers = servers[i:] + servers[:i]
+                break
+        with self._lock:
+            # membership may have moved under us: keep only/all current
+            current = set(self._servers)
+            merged = [s for s in servers if s in current]
+            merged += [s for s in self._servers if s not in set(merged)]
+            self._servers = merged
+        return head
+
+    def _safe_ping(self, addr: str) -> bool:
+        try:
+            return bool(self._ping(addr))
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Router:
+    """Managers keyed by (area, datacenter) (router.go Router). The LAN
+    area has one manager (own DC); the WAN area one per DC."""
+
+    AREA_LAN = "lan"
+    AREA_WAN = "wan"
+
+    def __init__(self, ping: Optional[Callable[[str], bool]] = None) -> None:
+        self._lock = threading.Lock()
+        self._managers: dict[tuple[str, str], ServerManager] = {}
+        self._ping = ping
+        self.log = log.named("router")
+
+    def manager(self, area: str, dc: str) -> ServerManager:
+        with self._lock:
+            key = (area, dc)
+            m = self._managers.get(key)
+            if m is None:
+                m = ServerManager(ping=self._ping)
+                self._managers[key] = m
+            return m
+
+    def add_server(self, area: str, dc: str, addr: str) -> None:
+        self.manager(area, dc).add(addr)
+
+    def remove_server(self, area: str, dc: str, addr: str) -> None:
+        self.manager(area, dc).remove(addr)
+
+    def find(self, area: str, dc: str) -> Optional[str]:
+        return self.manager(area, dc).find()
+
+    def notify_failed(self, area: str, dc: str, addr: str) -> None:
+        self.manager(area, dc).notify_failed(addr)
+
+    def datacenters(self, area: str = AREA_WAN) -> list[str]:
+        with self._lock:
+            return sorted({dc for (a, dc), m in self._managers.items()
+                           if a == area and m.num_servers() > 0})
+
+    def rebalance_all(self) -> None:
+        with self._lock:
+            managers = list(self._managers.values())
+        for m in managers:
+            m.rebalance()

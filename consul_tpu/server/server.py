@@ -60,6 +60,11 @@ class Server:
         self.rpc = RPCServer(rpc_bind or config.bind_addr,
                              config.port("server"))
         self.pool = ConnPool()
+        # per-(area, dc) server tracking with failover + rebalance
+        # (agent/router; WAN managers feed _forward_dc)
+        from consul_tpu.server.router import Router
+
+        self.router = Router()
         # RPC-port TLS (tlsutil + pool.RPCTLS tag): servers accept
         # TLS-wrapped RPC when certs are configured; verify_outgoing
         # makes OUR dials to other servers use it. The configurator is
@@ -442,24 +447,34 @@ class Server:
 
     def _forward_dc(self, method: str, args: dict[str, Any],
                     dc: str) -> Any:
-        """Route to any server in the target DC over the WAN pool
-        (rpc.go:849 forwardDC via the router)."""
+        """Route to a server in the target DC over the WAN pool
+        (rpc.go:849 forwardDC via the router). The per-DC ServerManager
+        keeps a sticky head between calls (connection reuse) and cycles
+        a failed server to the tail (router.go routeToDC +
+        manager.go NotifyFailedServer)."""
+        from consul_tpu.server.router import Router
         from consul_tpu.types import MemberStatus
 
-        candidates = [m for m in self.wan_members()
-                      if m.tags.get("dc") == dc
-                      and m.status == MemberStatus.ALIVE
-                      and m.tags.get("rpc_addr")]
-        if not candidates:
+        mgr = self.router.manager(Router.AREA_WAN, dc)
+        alive = {m.tags["rpc_addr"] for m in self.wan_members()
+                 if m.tags.get("dc") == dc
+                 and m.status == MemberStatus.ALIVE
+                 and m.tags.get("rpc_addr")}
+        for s in mgr.all_servers():
+            if s not in alive:
+                mgr.remove(s)
+        for s in alive:
+            mgr.add(s)
+        if mgr.num_servers() == 0:
             raise RPCError(f"no path to datacenter {dc!r}")
-        import random as _random
-
         last: Exception = RPCError(f"no servers in {dc}")
-        for m in _random.sample(candidates, len(candidates))[:3]:
+        for _ in range(min(3, mgr.num_servers())):
+            server = mgr.find()
             try:
-                return self.pool.call(m.tags["rpc_addr"], method, args)
+                return self.pool.call(server, method, args)
             except OSError as e:  # incl. ConnectionError and timeouts
                 last = e
+                mgr.notify_failed(server)
         raise RPCError(f"failed to reach datacenter {dc!r}: {last}")
 
     def forward_or_apply(self, msg_type: MessageType,

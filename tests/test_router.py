@@ -1,0 +1,137 @@
+"""Router: ordered server lists, failover cycling, rebalance.
+
+agent/router/manager_test.go behaviors: find() is sticky at the head,
+NotifyFailedServer cycles, RebalanceServers shuffles and promotes a
+healthy server, the interval scales with cluster size, and the WAN
+router keeps one manager per DC.
+"""
+
+import time
+
+from consul_tpu.server.router import (
+    NODES_PER_SERVER_CYCLE,
+    Router,
+    ServerManager,
+    rebalance_interval,
+)
+
+from helpers import wait_for  # noqa: E402
+
+
+def test_find_sticky_and_cycle_on_failure():
+    m = ServerManager(seed=7)
+    for s in ("s1", "s2", "s3"):
+        m.add(s)
+    head = m.find()
+    assert m.find() == head  # sticky
+    m.notify_failed(head)
+    assert m.find() != head  # cycled away
+    # failing a NON-head server must not churn the head
+    cur = m.find()
+    others = [s for s in m.all_servers() if s != cur]
+    m.notify_failed(others[0])
+    assert m.find() == cur
+
+
+def test_add_is_idempotent_and_not_head_biased():
+    m = ServerManager(seed=3)
+    m.add("a")
+    m.add("a")
+    assert m.num_servers() == 1
+    # many inserts land at varied positions, not always the head
+    for s in "bcdefgh":
+        m.add(s)
+    assert m.all_servers()[0] in "abcdefgh"
+
+
+def test_rebalance_promotes_healthy():
+    down = {"s1", "s2"}
+    m = ServerManager(ping=lambda s: s not in down, seed=1)
+    for s in ("s1", "s2", "s3"):
+        m.add(s)
+    head = m.rebalance()
+    assert head == "s3"
+    assert m.find() == "s3"
+
+
+def test_rebalance_none_healthy_reports_offline():
+    m = ServerManager(ping=lambda s: False)
+    m.add("s1")
+    assert m.rebalance() is None
+    assert m.is_offline()
+    m2 = ServerManager(ping=lambda s: True)
+    m2.add("s1")
+    assert not m2.is_offline()
+
+
+def test_rebalance_interval_scales_with_cluster():
+    base = 120.0
+    # small cluster: base cadence
+    assert rebalance_interval(base, 10, 3) == base
+    # huge cluster: stretched so fleet ping load stays bounded
+    big = rebalance_interval(base, 100_000, 3)
+    assert big > base * 100
+    assert big == base * (100_000 / (NODES_PER_SERVER_CYCLE * 3))
+
+
+def test_wan_router_per_dc_managers():
+    r = Router()
+    r.add_server(Router.AREA_WAN, "dc1", "a:1")
+    r.add_server(Router.AREA_WAN, "dc2", "b:1")
+    r.add_server(Router.AREA_WAN, "dc2", "b:2")
+    assert r.datacenters() == ["dc1", "dc2"]
+    assert r.find(Router.AREA_WAN, "dc1") == "a:1"
+    head2 = r.find(Router.AREA_WAN, "dc2")
+    r.notify_failed(Router.AREA_WAN, "dc2", head2)
+    assert r.find(Router.AREA_WAN, "dc2") != head2
+    r.remove_server(Router.AREA_WAN, "dc1", "a:1")
+    assert r.datacenters() == ["dc2"]
+
+
+def test_client_failover_cycles_to_live_server():
+    """A client whose preferred server dies retries against another —
+    end to end over real sockets, through the ServerManager."""
+    from consul_tpu.config import load
+    from consul_tpu.server import Client, Server
+
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"rt{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    client = None
+    try:
+        for s in servers[1:]:
+            assert s.join([servers[0].serf.memberlist.transport.addr]) == 1
+        wait_for(lambda: any(s.is_leader() for s in servers),
+                 what="leader election")
+        cfg = load(dev=True, overrides={"node_name": "rtc", "server": False})
+        client = Client(cfg)
+        client.start()
+        assert client.join([servers[0].serf.memberlist.transport.addr]) == 1
+        wait_for(lambda: client.servers.num_servers() == 3,
+                 what="3 servers discovered")
+        assert client.rpc("Status.Ping", {}) == "pong"
+        # kill the preferred server out from under the client
+        head = client.servers.find()
+        victim = next(s for s in servers
+                      if s.rpc.addr == head)
+        victim.shutdown()
+        # next RPC must cycle to a live server and still succeed
+        assert client.rpc("Status.Ping", {}) == "pong"
+        assert client.servers.find() != head
+    finally:
+        if client is not None:
+            client.shutdown()
+        for s in servers:
+            try:
+                s.shutdown()
+            except Exception:  # noqa: BLE001
+                pass

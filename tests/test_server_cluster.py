@@ -127,7 +127,7 @@ def test_client_agent_forwards_rpcs(cluster):
     try:
         assert client.join(
             [servers[0].serf.memberlist.transport.addr]) == 1
-        wait_for(lambda: client._pick_server() is not None,
+        wait_for(lambda: client.servers.find() is not None,
                  what="server discovery")
         assert client.rpc("Status.Ping", {}) == "pong"
         ok = client.rpc("KVS.Apply", {
