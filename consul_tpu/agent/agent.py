@@ -89,6 +89,8 @@ class Agent:
         from consul_tpu.agent.cache import AgentCache
 
         self.cache = AgentCache(self.rpc) if self.server is None else None
+        self._views = None  # lazy ViewStore (see .views)
+        self._views_lock = threading.Lock()
         # recent user events ring buffer (/v1/event/list,
         # agent/user_event.go UserEvents)
         self._recent_events: list[dict] = []
@@ -292,6 +294,8 @@ class Agent:
 
     def shutdown(self) -> None:
         self._shutdown = True
+        if self._views is not None:
+            self._views.stop()
         self.sync.stop()
         for r in self._runners.values():
             r.stop()
@@ -321,6 +325,28 @@ class Agent:
     @property
     def serf(self):
         return (self.server or self.client).serf
+
+    @property
+    def views(self):
+        """Streaming materialized-view store (agent/submatview): on
+        clients the subscribe stream rides the router-managed server
+        list; server agents stream from themselves over loopback —
+        same wire path either way."""
+        with self._views_lock:
+            # locked: concurrent first HTTP requests must not each
+            # build a store (the loser's views would leak their
+            # subscribe threads past shutdown)
+            if self._views is None:
+                from consul_tpu.agent.views import ViewStore
+
+                if self.server is not None:
+                    self._views = ViewStore(self.server.pool,
+                                            lambda: self.server.rpc.addr)
+                else:
+                    self._views = ViewStore(
+                        self.client.pool, self.client.servers.find,
+                        notify_failed=self.client.servers.notify_failed)
+            return self._views
 
     def rpc(self, method: str, args: dict[str, Any],
             src: str = "local") -> Any:

@@ -432,6 +432,24 @@ class HTTPApi:
                 args["Peer"] = q["peer"]
                 res = rpc("Health.ServiceNodesPeer", args)
                 return res["Nodes"], res.get("Index")
+            if a.config.use_streaming_backend and "dc" not in q \
+                    and not any(
+                    k in args for k in ("ServiceTag", "MustBePassing",
+                                        "Near", "Partition")):
+                # streaming path (UseStreamingBackend): blocking reads
+                # ride the local materialized view fed by the server's
+                # subscribe stream — no parked server thread per
+                # watcher. Filtered/cross-DC queries fall back to the
+                # RPC path (the view is local-DC, unfiltered).
+                view = a.views.get_view("ServiceHealth",
+                                        args["ServiceName"],
+                                        token=args.get("AuthToken", ""))
+                wait_s = float(args["MaxQueryTime"]) \
+                    if "MaxQueryTime" in args else 10.0
+                result, idx = view.get(
+                    min_index=args.get("MinQueryIndex", 0),
+                    timeout=wait_s)
+                return result or [], idx
             res = rpc("Health.ServiceNodes", args)
             return res["Nodes"], res["Index"]
         if (m := re.match(r"^/v1/health/node/(.+)$", path)):

@@ -1,0 +1,201 @@
+"""Materialized views: streaming-backed local result caches.
+
+Equivalent of agent/submatview/store.go: a view holds the CURRENT
+result for one topic+key, fed by the server's subscribe stream instead
+of repeated blocking queries. Readers block on the view's local index
+(Store.Get, store.go:126) — thousands of watchers cost one server
+stream, not one parked server thread each.
+
+Resilience: a dying stream (server restart/partition) reconnects with
+backoff to the next server the picker returns — the reference's
+resolver/balancer handoff (grpc-internal/resolver) — and the fresh
+snapshot replaces the materialized state wholesale.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+from consul_tpu.server.rpc import RPCError
+from consul_tpu.utils import log
+
+
+class MaterializedView:
+    def __init__(self, pool, pick_server: Callable[[], Optional[str]],
+                 topic: str, key: str, token: str = "",
+                 notify_failed: Optional[Callable[[str], None]] = None,
+                 backoff: float = 0.2) -> None:
+        self.topic, self.key = topic, key
+        self.log = log.named(f"view.{topic}.{key}")
+        self._pool = pool
+        self._pick = pick_server
+        self._token = token
+        self._notify_failed = notify_failed
+        self._backoff = backoff
+        self._cond = threading.Condition()
+        self._result: Any = None
+        self._index = 0
+        self._live = False  # end-of-snapshot seen on current stream
+        self._err: Optional[str] = None  # last stream error, if any
+        self._last_access = 0.0  # monotonic; ViewStore TTL eviction
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"view-{topic}-{key}")
+        self._thread.start()
+
+    # -------------------------------------------------------------- readers
+
+    def get(self, min_index: int = 0, timeout: float = 10.0
+            ) -> tuple[Any, int]:
+        """Blocking read: returns once the view is live and its index
+        exceeds min_index (or timeout → current state). Mirrors
+        submatview.Store.Get's blocking semantics."""
+        import time as _time
+
+        end = _time.monotonic() + timeout
+        with self._cond:
+            self._last_access = _time.monotonic()
+            while True:
+                # an erroring stream (ACL denial, server-side failure)
+                # surfaces ONLY while there's no materialized data —
+                # once a snapshot exists, stale-but-real results beat
+                # errors, and the feed loop keeps retrying (the error
+                # may be transient, or the token may get granted later)
+                if self._err is not None and self._result is None:
+                    raise RPCError(self._err)
+                if self._live and self._index > min_index:
+                    return self._result, self._index
+                remaining = end - _time.monotonic()
+                if remaining <= 0:
+                    return self._result, self._index
+                self._cond.wait(remaining)
+
+    @property
+    def index(self) -> int:
+        with self._cond:
+            return self._index
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---------------------------------------------------------------- feed
+
+    def _run(self) -> None:
+        backoff = self._backoff
+        while not self._stop.is_set():
+            addr = self._pick()
+            if addr is None:
+                if self._stop.wait(backoff):
+                    return
+                continue
+            handle = None
+            try:
+                handle = self._pool.subscribe(addr, "Subscribe.Subscribe", {
+                    "Topic": self.topic, "Key": self.key,
+                    "AuthToken": self._token})
+                self._consume(handle)
+                backoff = self._backoff  # healthy run: reset
+            except ConnectionError:
+                # server went away: tell the router, move on
+                if self._notify_failed is not None:
+                    self._notify_failed(addr)
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 5.0)
+            except RPCError as e:
+                # application error (ACL denial, server-side failure):
+                # record for readers, then RETRY with a longer backoff —
+                # the failure may be transient and a denied token may be
+                # granted later (the reference re-evaluates ACLs per
+                # subscribe call). A success clears the error.
+                with self._cond:
+                    self._err = str(e)
+                    self._cond.notify_all()
+                if self._stop.wait(max(backoff, 1.0)):
+                    return
+                backoff = min(max(backoff, 1.0) * 2, 5.0)
+            finally:
+                if handle is not None:
+                    handle.close()
+
+    def _consume(self, handle) -> None:
+        try:
+            while not self._stop.is_set():
+                ev = handle.next(timeout=0.5)
+                if ev is None:
+                    continue
+                with self._cond:
+                    t = ev.get("Type")
+                    if t == "snapshot":
+                        self._result = ev.get("Payload")
+                        self._index = ev.get("Index", 0)
+                        self._live = False  # until end_of_snapshot
+                        self._err = None  # healthy stream again
+                    elif t == "end_of_snapshot":
+                        self._live = True
+                    elif t == "update":
+                        self._result = ev.get("Payload")
+                        self._index = ev.get("Index", self._index)
+                    self._cond.notify_all()
+        except StopIteration:
+            pass  # server ended the stream cleanly; resubscribe
+        finally:
+            with self._cond:
+                self._live = False
+
+
+class ViewStore:
+    """Views keyed by (topic, key, token) with shared lifecycles and
+    idle-TTL eviction (agent/submatview/store.go:25: materializers
+    expire after going unread — without it every rotated token or
+    once-watched service would pin a thread + server stream forever)."""
+
+    def __init__(self, pool, pick_server,
+                 notify_failed: Optional[Callable[[str], None]] = None,
+                 idle_ttl: float = 600.0) -> None:
+        self._pool = pool
+        self._pick = pick_server
+        self._notify_failed = notify_failed
+        self._lock = threading.Lock()
+        self._views: dict[tuple, MaterializedView] = {}
+        self._idle_ttl = idle_ttl
+        self._stop = threading.Event()
+        self._reaper = threading.Thread(target=self._reap_loop,
+                                        daemon=True, name="view-reaper")
+        self._reaper.start()
+
+    def get_view(self, topic: str, key: str,
+                 token: str = "") -> MaterializedView:
+        import time as _time
+
+        with self._lock:
+            k = (topic, key, token)
+            v = self._views.get(k)
+            if v is None:
+                v = MaterializedView(self._pool, self._pick, topic, key,
+                                     token,
+                                     notify_failed=self._notify_failed)
+                self._views[k] = v
+            v._last_access = _time.monotonic()
+            return v
+
+    def _reap_loop(self) -> None:
+        import time as _time
+
+        while not self._stop.wait(max(self._idle_ttl / 4, 0.05)):
+            cutoff = _time.monotonic() - self._idle_ttl
+            with self._lock:
+                idle = [(k, v) for k, v in self._views.items()
+                        if v._last_access < cutoff]
+                for k, _ in idle:
+                    del self._views[k]
+            for _, v in idle:
+                v.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            for v in self._views.values():
+                v.stop()
+            self._views.clear()

@@ -180,6 +180,11 @@ class RuntimeConfig:
     # always sit in "default").
     partition: str = "default"
 
+    # Serve /v1/health/service reads from streaming materialized views
+    # instead of proxied blocking queries (reference: UseStreamingBackend,
+    # agent/submatview via the internal-gRPC subscribe service)
+    use_streaming_backend: bool = False
+
     # Anti-entropy (reference: agent/ae/ae.go:57)
     sync_coalesce_timeout: float = 0.2
 
@@ -275,6 +280,7 @@ _CONFIG_ALIASES = {
     "tombstone_ttl": "tombstone_ttl",
     "segment": "segment",
     "partition": "partition",
+    "use_streaming_backend": "use_streaming_backend",
 }
 
 class ConfigError(Exception):

@@ -148,16 +148,11 @@ class Client:
             self.servers.rebalance()
 
     def _refresh_servers(self) -> None:
-        alive = {m.tags.get("rpc_addr", "")
-                 for m in self.serf.members()
-                 if m.tags.get("role") == "consul"
-                 and m.status == MemberStatus.ALIVE
-                 and m.tags.get("rpc_addr")}
-        for addr in self.servers.all_servers():
-            if addr not in alive:
-                self.servers.remove(addr)
-        for addr in alive:
-            self.servers.add(addr)
+        self.servers.sync({m.tags["rpc_addr"]
+                           for m in self.serf.members()
+                           if m.tags.get("role") == "consul"
+                           and m.status == MemberStatus.ALIVE
+                           and m.tags.get("rpc_addr")})
 
     def _serf_event(self, ev: SerfEvent) -> None:
         if ev.type in (EventType.MEMBER_JOIN, EventType.MEMBER_FAILED,

@@ -69,6 +69,18 @@ class ServerManager:
             if addr in self._servers:
                 self._servers.remove(addr)
 
+    def sync(self, alive: set[str]) -> None:
+        """Reconcile the list against current membership in ONE lock
+        hold: drop the dead, add the new (at random positions). The one
+        place both clients and the WAN router do this."""
+        with self._lock:
+            self._servers = [s for s in self._servers if s in alive]
+            for addr in alive:
+                if addr not in self._servers:
+                    pos = self.rng.randint(0, len(self._servers)) \
+                        if self._servers else 0
+                    self._servers.insert(pos, addr)
+
     def find(self) -> Optional[str]:
         """The current preferred server: always the head — stickiness
         between rebalances keeps conn reuse high (manager.go:193)."""

@@ -31,6 +31,8 @@ import socket
 import socketserver
 import struct
 import threading
+import time
+from collections import deque
 from typing import Any, Callable, Optional
 
 import msgpack
@@ -137,11 +139,27 @@ class RPCServer:
         self.metrics = telemetry.default
         self._rpc_handler: Optional[Callable[[str, dict, str], Any]] = None
         self._raft_handler: Optional[Callable[[str, str, dict], dict]] = None
+        # server-streaming methods: name -> fn(args, src, push, cancel)
+        # (the internal-gRPC streaming services' seam)
+        self.stream_handlers: dict[str, Callable] = {}
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
                 sock = self.request
+                # track live conns so shutdown() can close them: a
+                # downed server must EOF its clients — parked queries
+                # and subscribe streams detect death by read error,
+                # not by silence
+                with outer._conns_lock:
+                    outer._conns.add(sock)
+                try:
+                    self._handle_tagged(sock)
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(sock)
+
+            def _handle_tagged(self, sock) -> None:
                 try:
                     tag = _read_exact(sock, 1)
                     if tag is None:
@@ -197,6 +215,8 @@ class RPCServer:
         # federation is on): .ingest_packet(src, data),
         # .ingest_stream(src, data) -> bytes
         self.gossip_ingest = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(
@@ -213,6 +233,17 @@ class RPCServer:
     def shutdown(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _serve_consul(self, sock: socket.socket, src: str) -> None:
         while True:
@@ -239,22 +270,44 @@ class RPCServer:
         """Yamux-session equivalent: every request frame ({sid, method,
         args}) runs in its own handler thread; response frames
         ({sid, result|error}) interleave under a write lock. A parked
-        blocking query parks a thread, not the connection."""
+        blocking query parks a thread, not the connection.
+
+        Streaming methods (self.stream_handlers — the internal-gRPC
+        server-streaming equivalent, e.g. the subscribe service) push
+        any number of {sid, more, event} frames before the final
+        {sid, result}; the client cancels with {sid, cancel}."""
         wlock = threading.Lock()
         in_flight = [0]  # yamux-style stream cap (guarded by wlock)
+        closed = [False]  # set when the client side is gone
+        cancels: dict[int, threading.Event] = {}
 
         def safe_write(obj: dict[str, Any]) -> None:
             try:
                 with wlock:
                     write_frame(sock, obj)
             except OSError:
-                pass  # client went away; its threads just drain
+                closed[0] = True  # streams stop pushing; threads drain
 
+        try:
+            self._mux_loop(sock, src, wlock, in_flight, closed, cancels,
+                           safe_write)
+        finally:
+            closed[0] = True
+            for ev in list(cancels.values()):
+                ev.set()  # conn gone: unblock every streaming handler
+
+    def _mux_loop(self, sock, src, wlock, in_flight, closed, cancels,
+                  safe_write) -> None:
         while True:
             req = read_frame(sock)
             if req is None:
                 return
             sid = req.get("sid", 0)
+            if req.get("cancel"):
+                ev = cancels.get(sid)
+                if ev is not None:
+                    ev.set()
+                continue
             method = req.get("method", "")
             with wlock:
                 if in_flight[0] >= MAX_MUX_STREAMS:
@@ -265,9 +318,18 @@ class RPCServer:
             if over:
                 # unauthenticated resource exhaustion guard: one conn
                 # must not park unbounded handler threads (yamux caps
-                # streams per session the same way)
+                # streams per session the same way) — subscriptions
+                # count too, they're the LONGEST-lived streams
                 safe_write({"sid": sid,
                             "error": "too many concurrent streams"})
+                continue
+            if method in self.stream_handlers:
+                def release():
+                    with wlock:
+                        in_flight[0] -= 1
+
+                self._run_stream(sid, method, req.get("args") or {}, src,
+                                 closed, cancels, safe_write, release)
                 continue
 
             def run(sid=sid, method=method, args=req.get("args") or {}):
@@ -289,6 +351,39 @@ class RPCServer:
 
             threading.Thread(target=run, daemon=True,
                              name=f"mux-{src}-{sid}").start()
+
+    def _run_stream(self, sid: int, method: str, args: dict[str, Any],
+                    src: str, closed, cancels,
+                    safe_write, release) -> None:
+        """One server-streaming call: handler(args, src, push, cancel)
+        pushes frames until done/cancelled (grpc-internal subscribe
+        semantics over the mux port)."""
+        cancel = threading.Event()
+        cancels[sid] = cancel
+
+        def push(payload: Any) -> bool:
+            """False once the stream should stop (cancel or conn gone)."""
+            if cancel.is_set() or closed[0]:
+                return False
+            safe_write({"sid": sid, "more": True, "event": payload})
+            return not (closed[0] or cancel.is_set())
+
+        def run() -> None:
+            fn = self.stream_handlers[method]
+            try:
+                fn(args, src, push, cancel)
+                safe_write({"sid": sid, "result": True})
+            except RPCError as e:
+                safe_write({"sid": sid, "error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("stream %s failed: %s", method, e)
+                safe_write({"sid": sid, "error": f"internal: {e}"})
+            finally:
+                cancels.pop(sid, None)
+                release()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"mux-stream-{src}-{sid}").start()
 
     def _serve_snapshot(self, sock: socket.socket, src: str) -> None:
         """Dedicated snapshot stream (reference RPCSnapshot byte +
@@ -425,6 +520,74 @@ class _Conn:
             pass
 
 
+class _StreamSlot:
+    """Client end of one server-streaming call: a queue of pushed
+    events, terminated by a final result/error frame or conn death."""
+
+    def __init__(self) -> None:
+        self.cond = threading.Condition()
+        self.items: deque = deque()
+        self.final: Optional[dict[str, Any]] = None
+        self.done = False
+
+    def push(self, resp: dict[str, Any]) -> None:
+        with self.cond:
+            if resp.get("more"):
+                self.items.append(resp.get("event"))
+            else:
+                self.final = resp
+                self.done = True
+            self.cond.notify_all()
+
+    def fail(self) -> None:
+        with self.cond:
+            self.done = True  # final stays None → ConnectionError
+            self.cond.notify_all()
+
+
+class StreamHandle:
+    """Iterator over a server stream. next() blocks for the next event;
+    returns None on timeout; raises StopIteration when the server ends
+    the stream, RPCError on a server error, ConnectionError if the
+    session died (resubscribe elsewhere)."""
+
+    def __init__(self, conn: "_MuxConn", sid: int,
+                 slot: _StreamSlot) -> None:
+        self._conn = conn
+        self._sid = sid
+        self._slot = slot
+
+    def next(self, timeout: float = 10.0) -> Any:
+        end = time.monotonic() + timeout
+        s = self._slot
+        with s.cond:
+            while True:
+                if s.items:
+                    return s.items.popleft()
+                if s.done:
+                    if s.final is None:
+                        raise ConnectionError("stream session died")
+                    if s.final.get("error") is not None:
+                        raise RPCError(s.final["error"])
+                    raise StopIteration
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return None
+                s.cond.wait(remaining)
+
+    def close(self) -> None:
+        """Cancel server-side and deregister (grpc stream cancel)."""
+        with self._conn._plock:
+            self._conn._pending.pop(self._sid, None)
+        try:
+            with self._conn._wlock:
+                write_frame(self._conn.sock, {"sid": self._sid,
+                                              "cancel": True})
+        except OSError:
+            pass
+        self._slot.fail()
+
+
 class _MuxConn:
     """Client end of one RPC_MUX session: a writer lock, a demux reader
     thread, and per-stream response slots. Many callers — including
@@ -456,8 +619,19 @@ class _MuxConn:
                 if resp is None:
                     break
                 with self._plock:
-                    slot = self._pending.pop(resp.get("sid"), None)
-                if slot is not None:  # timed-out streams just drop
+                    sid = resp.get("sid")
+                    slot = self._pending.get(sid)
+                    # stream slots stay registered while frames carry
+                    # "more"; everything else is one-shot
+                    if slot is not None and not (
+                            isinstance(slot, _StreamSlot)
+                            and resp.get("more")):
+                        self._pending.pop(sid, None)
+                if slot is None:  # timed-out streams just drop
+                    continue
+                if isinstance(slot, _StreamSlot):
+                    slot.push(resp)
+                else:
                     slot[1] = resp
                     slot[0].set()
         except (OSError, ValueError):
@@ -466,7 +640,10 @@ class _MuxConn:
         with self._plock:
             pending, self._pending = self._pending, {}
         for slot in pending.values():
-            slot[0].set()  # wake with resp=None → ConnectionError
+            if isinstance(slot, _StreamSlot):
+                slot.fail()
+            else:
+                slot[0].set()  # wake with resp=None → ConnectionError
         self.close()
 
     def call(self, method: str, args: dict[str, Any],
@@ -498,6 +675,27 @@ class _MuxConn:
         if resp.get("error") is not None:
             raise RPCError(resp["error"])
         return resp.get("result")
+
+    def subscribe(self, method: str,
+                  args: dict[str, Any]) -> StreamHandle:
+        """Open a server-streaming call on this session."""
+        slot = _StreamSlot()
+        with self._plock:
+            if self.dead:
+                raise ConnectionError(f"mux to {self.addr} is closed")
+            self._sid += 1
+            sid = self._sid
+            self._pending[sid] = slot
+        try:
+            with self._wlock:
+                write_frame(self.sock, {"sid": sid, "method": method,
+                                        "args": args})
+        except OSError as e:
+            with self._plock:
+                self._pending.pop(sid, None)
+            raise ConnectionError(
+                f"subscribe to {self.addr} failed: {e}") from e
+        return StreamHandle(self, sid, slot)
 
     def close(self) -> None:
         try:
@@ -550,6 +748,27 @@ class ConnPool:
             conn, _ = self._mux_get(addr)
             try:
                 return conn.call(method, args, timeout)
+            except ConnectionError:
+                self._discard(addr, conn)
+                raise
+
+    def subscribe(self, addr: str, method: str,
+                  args: dict[str, Any]) -> StreamHandle:
+        """Open a server-streaming subscription on a pooled session
+        (the internal-gRPC subscribe channel). Raises ConnectionError
+        if the server is unreachable; a dying session surfaces as
+        ConnectionError from StreamHandle.next() — resubscribe, ideally
+        to a different server."""
+        conn, fresh = self._mux_get(addr)
+        try:
+            return conn.subscribe(method, args)
+        except ConnectionError:
+            self._discard(addr, conn)
+            if fresh:
+                raise
+            conn, _ = self._mux_get(addr)
+            try:
+                return conn.subscribe(method, args)
             except ConnectionError:
                 self._discard(addr, conn)
                 raise

@@ -229,6 +229,9 @@ class Server:
         # endpoint registry: "Service.Method" -> handler(args, ctx)
         self.endpoints: dict[str, Any] = {}
         register_endpoints(self)
+        from consul_tpu.server.subscribe import register_stream_endpoints
+
+        register_stream_endpoints(self)
 
         # leader-side session TTL bookkeeping (session_ttl.go)
         self._session_expiry: dict[str, float] = {}
@@ -456,20 +459,15 @@ class Server:
         from consul_tpu.types import MemberStatus
 
         mgr = self.router.manager(Router.AREA_WAN, dc)
-        alive = {m.tags["rpc_addr"] for m in self.wan_members()
-                 if m.tags.get("dc") == dc
-                 and m.status == MemberStatus.ALIVE
-                 and m.tags.get("rpc_addr")}
-        for s in mgr.all_servers():
-            if s not in alive:
-                mgr.remove(s)
-        for s in alive:
-            mgr.add(s)
-        if mgr.num_servers() == 0:
-            raise RPCError(f"no path to datacenter {dc!r}")
+        mgr.sync({m.tags["rpc_addr"] for m in self.wan_members()
+                  if m.tags.get("dc") == dc
+                  and m.status == MemberStatus.ALIVE
+                  and m.tags.get("rpc_addr")})
         last: Exception = RPCError(f"no servers in {dc}")
-        for _ in range(min(3, mgr.num_servers())):
+        for _ in range(3):
             server = mgr.find()
+            if server is None:  # emptied concurrently, or never there
+                raise RPCError(f"no path to datacenter {dc!r}")
             try:
                 return self.pool.call(server, method, args)
             except OSError as e:  # incl. ConnectionError and timeouts

@@ -1,0 +1,74 @@
+"""Subscribe service: server-streaming change feeds over the mux port.
+
+Equivalent of the reference's internal-gRPC subscribe service
+(agent/grpc-internal/services/subscribe) fed by the EventPublisher:
+a subscriber names a topic+key and receives a snapshot, an
+end-of-snapshot marker, then updates until it cancels — the feed
+agent-side materialized views ride instead of re-polling blocking
+queries (agent/submatview/store.go).
+
+Delta granularity is the topic key's CURRENT materialized result: the
+publisher's events are table-change notifications (stream.py), so each
+wake re-queries the scoped result and pushes it when it changed. That
+is coarser than the reference's typed per-entity events but carries
+the same ordering/index guarantees.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from consul_tpu.server.rpc import RPCError
+
+
+def register_stream_endpoints(srv) -> None:
+    def authz(args):
+        return srv.acl.resolve(args.get("AuthToken", ""))
+
+    # topic -> (acl check, scoped query)
+    def _service_health(args):
+        key = args.get("Key", "")
+        if not authz(args).service_read(key):
+            raise RPCError(f"Permission denied: service read {key!r}")
+        return lambda: srv.state.check_service_nodes(
+            key, partition=args.get("Partition"))
+
+    def _kv(args):
+        key = args.get("Key", "")
+        if not authz(args).key_read(key):
+            raise RPCError(f"Permission denied: key read {key!r}")
+        return lambda: [e.to_dict()
+                        for e in srv.state.kv_list(key)]
+
+    TOPICS = {"ServiceHealth": _service_health, "KV": _kv}
+
+    def subscribe(args: dict[str, Any], src: str, push, cancel) -> None:
+        topic = args.get("Topic", "")
+        build = TOPICS.get(topic)
+        if build is None:
+            raise RPCError(f"unknown subscription topic {topic!r}")
+        query = build(args)  # raises on ACL denial before any data
+        idx = srv.state.index
+        last = query()
+        # snapshot, then the explicit end-of-snapshot marker the
+        # reference emits so views know they're live (subscribe proto)
+        if not push({"Type": "snapshot", "Index": idx, "Payload": last}):
+            return
+        if not push({"Type": "end_of_snapshot", "Index": idx}):
+            return
+        sub = srv.publisher.subscribe(topic, index=idx)
+        try:
+            while not cancel.is_set():
+                ev = sub.next(timeout=0.5)
+                if ev is None:
+                    continue
+                cur = query()
+                if cur != last:
+                    last = cur
+                    if not push({"Type": "update", "Index": ev.index,
+                                 "Payload": cur}):
+                        return
+        finally:
+            sub.close()
+
+    srv.rpc.stream_handlers["Subscribe.Subscribe"] = subscribe
