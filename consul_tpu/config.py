@@ -174,6 +174,12 @@ class RuntimeConfig:
     segment: str = ""
     segments: tuple = ()
 
+    # Connect CA provider plugin (reference: connect.ca_provider +
+    # ca_config → agent/connect/ca/provider_*.go): "consul" (built-in,
+    # root key replicated), "vault", "aws-pca" (key stays external)
+    connect_ca_provider: str = "consul"
+    connect_ca_config: dict = field(default_factory=dict)
+
     # Admin partition (reference: server_serf.go:53, merge.go:27):
     # tenancy partitioning of the ONE LAN gossip pool. Client agents
     # live in exactly one partition; servers span all of them (and
@@ -378,6 +384,10 @@ def load(
     if "enable_mesh_gateway_wan_federation" in connect_blk:
         kwargs["wan_federation_via_mesh_gateways"] = bool(
             connect_blk["enable_mesh_gateway_wan_federation"])
+    if "ca_provider" in connect_blk:
+        kwargs["connect_ca_provider"] = str(connect_blk["ca_provider"])
+    if "ca_config" in connect_blk:
+        kwargs["connect_ca_config"] = dict(connect_blk["ca_config"])
     if "segments" in raw:
         kwargs["segments"] = tuple(
             {"name": s.get("name", ""), "port": int(s.get("port", 0))}

@@ -182,6 +182,13 @@ class CAManager:
 
     def __init__(self, server) -> None:
         self.server = server
+        # CA provider plugin (provider.go seam): built-in by default;
+        # vault/aws-pca keep the root key at the external authority
+        from consul_tpu.connect.providers import make_provider
+
+        self.provider = make_provider(
+            getattr(server.config, "connect_ca_provider", "consul"),
+            getattr(server.config, "connect_ca_config", None))
 
     def active_root(self) -> Optional[dict[str, Any]]:
         entry = self.server.state.raw_get("config_entries",
@@ -193,13 +200,24 @@ class CAManager:
         if root is not None:
             return root
         trust_domain = f"{uuid.uuid4()}.consul"
-        root = generate_root(trust_domain, self.server.config.datacenter)
+        root = self.provider.generate_root(
+            trust_domain, self.server.config.datacenter)
         from consul_tpu.state import MessageType
 
         self.server.forward_or_apply(MessageType.CONFIG_ENTRY, {
             "Op": "upsert", "Entry": {"Kind": "connect-ca", "Name": "root",
                                       "Root": root}})
         return self.active_root() or root
+
+    def sign(self, service: str, ttl_hours: float = 72.0
+             ) -> dict[str, Any]:
+        """Issue a leaf via the active provider (ConnectCA.Sign path).
+        For the built-in provider the replicated root key signs
+        locally; external providers sign at the authority."""
+        root = self.initialize()
+        return self.provider.sign_leaf(
+            root, service, self.server.config.datacenter,
+            ttl_hours=ttl_hours)
 
     def rotate(self) -> dict[str, Any]:
         """Generate and activate a new root. ALL prior roots stay
@@ -213,10 +231,17 @@ class CAManager:
             previous.insert(0, old)
         trust_domain = old["TrustDomain"] if old \
             else f"{uuid.uuid4()}.consul"
-        new = generate_root(trust_domain, self.server.config.datacenter)
+        new = self.provider.generate_root(trust_domain,
+                                          self.server.config.datacenter)
         if old is not None:
-            # bridge cert for agents that still only trust the old root
-            new["CrossSignedIntermediate"] = cross_sign(old, new)
+            try:
+                # bridge cert for agents still trusting only the old root
+                new["CrossSignedIntermediate"] = \
+                    self.provider.cross_sign(old, new)
+            except NotImplementedError:
+                # aws-pca can't cross-sign (provider_aws.go): both
+                # roots stay served until old leaves expire
+                pass
         from consul_tpu.state import MessageType
 
         self.server.forward_or_apply(MessageType.CONFIG_ENTRY, {

@@ -1,0 +1,225 @@
+"""Connect CA provider plugins.
+
+Reference: agent/connect/ca/provider.go:65 (the Provider interface) and
+its three implementations — built-in (provider_consul.go), Vault
+(provider_vault.go), AWS ACM-PCA (provider_aws.go). The architectural
+property external providers buy: the ROOT PRIVATE KEY never enters
+Consul's replicated state — only certificates do; signing happens at
+the external authority.
+
+The Vault/AWS providers talk through an injectable client seam (this
+image has zero egress, so live endpoints are unreachable; the clients
+default to real HTTP/AWS-shaped calls and tests inject in-process
+fakes — the same boundary the reference mocks in provider_*_test.go).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+import uuid
+from typing import Any, Optional, Protocol
+
+from consul_tpu.connect import ca as _ca
+
+
+class CAProvider(Protocol):
+    """What CAManager needs from a provider (provider.go:65)."""
+
+    name: str
+
+    def generate_root(self, trust_domain: str, dc: str) -> dict[str, Any]:
+        """Create (or adopt) the active root. The returned dict lands
+        in REPLICATED state — external providers must omit the private
+        key."""
+        ...
+
+    def sign_leaf(self, root: dict[str, Any], service: str, dc: str,
+                  ttl_hours: float = 72.0) -> dict[str, Any]: ...
+
+    def cross_sign(self, old_root: dict[str, Any],
+                   new_root: dict[str, Any]) -> str: ...
+
+    def state(self) -> dict[str, str]:
+        """Provider bookkeeping persisted across reconfigurations
+        (resource ids etc. — NOT secrets; operator:read can see it)."""
+        ...
+
+
+class ConsulCAProvider:
+    """Built-in provider (provider_consul.go): keys live in replicated
+    state; every server can sign."""
+
+    name = "consul"
+
+    def __init__(self, config: Optional[dict[str, Any]] = None) -> None:
+        self.config = config or {}
+
+    def generate_root(self, trust_domain: str, dc: str) -> dict[str, Any]:
+        return _ca.generate_root(trust_domain, dc)
+
+    def sign_leaf(self, root: dict[str, Any], service: str, dc: str,
+                  ttl_hours: float = 72.0) -> dict[str, Any]:
+        return _ca.sign_leaf(root, service, dc, ttl_hours=ttl_hours)
+
+    def cross_sign(self, old_root: dict[str, Any],
+                   new_root: dict[str, Any]) -> str:
+        return _ca.cross_sign(old_root, new_root)
+
+    def state(self) -> dict[str, str]:
+        return {}
+
+
+class VaultHTTPClient:
+    """Minimal Vault KV-over-HTTP client (the transport seam the fake
+    replaces in tests; provider_vault.go uses the official client)."""
+
+    def __init__(self, address: str, token: str) -> None:
+        self.address = address.rstrip("/")
+        self.token = token
+
+    def write(self, path: str, **data: Any) -> dict[str, Any]:
+        req = urllib.request.Request(
+            f"{self.address}/v1/{path}",
+            data=json.dumps(data).encode(),
+            headers={"X-Vault-Token": self.token,
+                     "Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read() or b"{}").get("data") or {}
+
+
+class VaultCAProvider:
+    """Vault PKI-backed provider (provider_vault.go): the root key
+    stays inside Vault's PKI mount; Consul stores/replicates only the
+    certificate and asks Vault to sign leaves."""
+
+    name = "vault"
+
+    def __init__(self, config: Optional[dict[str, Any]] = None,
+                 client: Any = None) -> None:
+        cfg = config or {}
+        self.mount = cfg.get("RootPKIPath", "pki").strip("/")
+        self.client = client or VaultHTTPClient(
+            cfg.get("Address", "http://127.0.0.1:8200"),
+            cfg.get("Token", ""))
+
+    def generate_root(self, trust_domain: str, dc: str) -> dict[str, Any]:
+        data = self.client.write(
+            f"{self.mount}/root/generate/internal",
+            common_name=f"Consul CA (vault) {uuid.uuid4().hex[:8]}",
+            uri_sans=f"spiffe://{trust_domain}")
+        # NO PrivateKey field: it never left Vault
+        return {"ID": uuid.uuid4().hex,
+                "RootCert": data["certificate"],
+                "TrustDomain": trust_domain, "Datacenter": dc,
+                "Active": True, "Provider": self.name}
+
+    def sign_leaf(self, root: dict[str, Any], service: str, dc: str,
+                  ttl_hours: float = 72.0) -> dict[str, Any]:
+        uri = _ca.spiffe_id(root["TrustDomain"], dc, service)
+        data = self.client.write(
+            f"{self.mount}/issue/connect",
+            common_name=service, uri_sans=uri,
+            ttl=f"{int(ttl_hours * 3600)}s")
+        return {"SerialNumber": data.get("serial_number", ""),
+                "CertPEM": data["certificate"],
+                "PrivateKeyPEM": data["private_key"],
+                "Service": service, "ServiceURI": uri}
+
+    def cross_sign(self, old_root: dict[str, Any],
+                   new_root: dict[str, Any]) -> str:
+        data = self.client.write(
+            f"{self.mount}/root/sign-self-issued",
+            certificate=new_root["RootCert"])
+        return data["certificate"]
+
+    def state(self) -> dict[str, str]:
+        return {"mount": self.mount}
+
+
+class AWSPCAClientSeam(Protocol):
+    """boto3 acm-pca shape (provider_aws.go uses the AWS SDK)."""
+
+    def create_certificate_authority(self, **kw) -> dict: ...
+
+    def get_certificate_authority_certificate(self, **kw) -> dict: ...
+
+    def issue_certificate(self, **kw) -> dict: ...
+
+    def get_certificate(self, **kw) -> dict: ...
+
+
+class AWSPCAProvider:
+    """AWS ACM Private CA provider (provider_aws.go). The CA ARN is the
+    provider state the reference persists (so reconfigurations adopt
+    the same PCA instead of creating a new one)."""
+
+    name = "aws-pca"
+
+    def __init__(self, config: Optional[dict[str, Any]] = None,
+                 client: Optional[AWSPCAClientSeam] = None) -> None:
+        self.config = config or {}
+        if client is None:  # pragma: no cover — needs AWS creds+egress
+            import boto3  # noqa: F401  (gated; not in this image)
+
+            client = boto3.client("acm-pca")
+        self.client = client
+        self.ca_arn: Optional[str] = self.config.get("ExistingARN") or None
+
+    def generate_root(self, trust_domain: str, dc: str) -> dict[str, Any]:
+        if not self.ca_arn:
+            out = self.client.create_certificate_authority(
+                CertificateAuthorityType="ROOT",
+                CertificateAuthorityConfiguration={
+                    "KeyAlgorithm": "EC_prime256v1",
+                    "SigningAlgorithm": "SHA256WITHECDSA",
+                    "Subject": {"CommonName":
+                                f"Consul CA (aws) {trust_domain}"}})
+            self.ca_arn = out["CertificateAuthorityArn"]
+        cert = self.client.get_certificate_authority_certificate(
+            CertificateAuthorityArn=self.ca_arn)
+        return {"ID": uuid.uuid4().hex,
+                "RootCert": cert["Certificate"],
+                "TrustDomain": trust_domain, "Datacenter": dc,
+                "Active": True, "Provider": self.name}
+
+    def sign_leaf(self, root: dict[str, Any], service: str, dc: str,
+                  ttl_hours: float = 72.0) -> dict[str, Any]:
+        uri = _ca.spiffe_id(root["TrustDomain"], dc, service)
+        out = self.client.issue_certificate(
+            CertificateAuthorityArn=self.ca_arn,
+            CommonName=service, UriSans=[uri],
+            Validity={"Type": "ABSOLUTE_HOURS", "Value": int(ttl_hours)})
+        got = self.client.get_certificate(
+            CertificateAuthorityArn=self.ca_arn,
+            CertificateArn=out["CertificateArn"])
+        return {"SerialNumber": out.get("Serial", ""),
+                "CertPEM": got["Certificate"],
+                "PrivateKeyPEM": got.get("PrivateKey", ""),
+                "Service": service, "ServiceURI": uri}
+
+    def cross_sign(self, old_root: dict[str, Any],
+                   new_root: dict[str, Any]) -> str:
+        # ACM-PCA can't sign a foreign self-issued cert (the reference
+        # returns ErrNotSupported, provider_aws.go) — rotation away
+        # from aws-pca relies on serving both roots until leaves expire
+        raise NotImplementedError(
+            "aws-pca cannot cross-sign (provider_aws.go SupportsCrossSigning=false)")
+
+    def state(self) -> dict[str, str]:
+        return {"arn": self.ca_arn or ""}
+
+
+PROVIDERS = {"consul": ConsulCAProvider, "vault": VaultCAProvider,
+             "aws-pca": AWSPCAProvider}
+
+
+def make_provider(name: str, config: Optional[dict[str, Any]] = None,
+                  client: Any = None) -> Any:
+    cls = PROVIDERS.get(name or "consul")
+    if cls is None:
+        raise ValueError(f"unknown CA provider {name!r}")
+    if cls is ConsulCAProvider:
+        return cls(config)
+    return cls(config, client=client)

@@ -778,11 +778,8 @@ def register_endpoints(srv) -> None:
             raise RPCError("Node is required")
         if not srv.is_leader():
             return srv._forward_to_leader("AutoEncrypt.Sign", args)
-        from consul_tpu.connect.ca import sign_leaf
-
         root = srv.ca.initialize()
-        cert = sign_leaf(root, f"agent/{node}", srv.config.datacenter,
-                         ttl_hours=72.0)
+        cert = srv.ca.sign(f"agent/{node}", ttl_hours=72.0)
         return {"Cert": cert,
                 "Roots": [{"RootCert": r["RootCert"]}
                           for r in srv.ca.roots()]}
@@ -812,11 +809,8 @@ def register_endpoints(srv) -> None:
         if not srv.is_leader():
             return srv._forward_to_leader(
                 "AutoConfig.InitialConfiguration", args)
-        from consul_tpu.connect.ca import sign_leaf
-
         root = srv.ca.initialize()
-        cert = sign_leaf(root, f"agent/{node}", srv.config.datacenter,
-                         ttl_hours=72.0)
+        cert = srv.ca.sign(f"agent/{node}", ttl_hours=72.0)
         return {
             "Config": {
                 "datacenter": srv.config.datacenter,
@@ -1150,10 +1144,8 @@ def register_endpoints(srv) -> None:
                 f"service write on {service!r}")
         if not srv.is_leader():
             return srv._forward_to_leader("ConnectCA.Sign", args)
-        from consul_tpu.connect.ca import sign_leaf
-
         root = srv.ca.initialize()
-        leaf = sign_leaf(root, service, srv.config.datacenter)
+        leaf = srv.ca.sign(service)
         if root.get("CrossSignedIntermediate"):
             # present the rotation bridge with the leaf so old-root
             # verifiers can build a path to the new root

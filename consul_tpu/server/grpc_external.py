@@ -75,6 +75,71 @@ SERVER = {"id": Field(1, "string"), "address": Field(2, "string"),
 WATCH_SERVERS_RESP = {"servers": Field(1, "message", SERVER,
                                        repeated=True)}
 
+# hashicorp.consul.dataplane (proto-public/pbdataplane/dataplane.proto):
+# the service consul-dataplane proxies use INSTEAD of a local agent
+FEATURES_REQ: dict[str, Field] = {}
+_FEATURE = {"feature_name": Field(1, "enum"),
+            "supported": Field(2, "bool")}
+FEATURES_RESP = {"supported_dataplane_features":
+                 Field(1, "message", _FEATURE, repeated=True)}
+BOOTSTRAP_REQ = {
+    "node_id": Field(1, "string"), "node_name": Field(2, "string"),
+    "service_id": Field(3, "string"), "partition": Field(4, "string"),
+    "namespace": Field(5, "string"), "proxy_id": Field(6, "string"),
+}
+# google.protobuf.Struct (for the proxy's opaque Config)
+_PB_VALUE: dict[str, Field] = {}
+_PB_VALUE.update({
+    "null_value": Field(1, "enum"),
+    "number_value": Field(2, "double"),
+    "string_value": Field(3, "string"),
+    "bool_value": Field(4, "bool"),
+    "struct_value": Field(5, "message", _PB_VALUE),  # filled below
+    "list_value": Field(6, "message", _PB_VALUE),
+})
+_PB_FIELDS = {"key": Field(1, "string"),
+              "value": Field(2, "message", _PB_VALUE)}
+PB_STRUCT = {"fields": Field(1, "message", _PB_FIELDS, repeated=True)}
+_PB_LIST = {"values": Field(1, "message", _PB_VALUE, repeated=True)}
+_PB_VALUE["struct_value"] = Field(5, "message", PB_STRUCT)
+_PB_VALUE["list_value"] = Field(6, "message", _PB_LIST)
+BOOTSTRAP_RESP = {
+    "service_kind": Field(1, "enum"),
+    "service": Field(2, "string"),
+    "namespace": Field(3, "string"),
+    "partition": Field(4, "string"),
+    "datacenter": Field(5, "string"),
+    "config": Field(6, "message", PB_STRUCT),
+    "node_name": Field(8, "string"),
+    "access_logs": Field(9, "string", repeated=True),
+    "identity": Field(10, "string"),
+}
+
+SERVICE_KIND_ENUM = {"": 1, "connect-proxy": 2, "mesh-gateway": 3,
+                     "terminating-gateway": 4, "ingress-gateway": 5,
+                     "api-gateway": 6}
+
+
+def to_pb_struct(d: dict[str, Any]) -> dict[str, Any]:
+    """dict → google.protobuf.Struct message dict for pbwire."""
+    def val(v: Any) -> dict[str, Any]:
+        if v is None:
+            return {"null_value": 0}
+        if isinstance(v, bool):
+            return {"bool_value": v}
+        if isinstance(v, (int, float)):
+            return {"number_value": float(v)}
+        if isinstance(v, str):
+            return {"string_value": v}
+        if isinstance(v, dict):
+            return {"struct_value": to_pb_struct(v)}
+        if isinstance(v, (list, tuple)):
+            return {"list_value": {"values": [val(x) for x in v]}}
+        return {"string_value": str(v)}
+
+    return {"fields": [{"key": k, "value": val(v)}
+                       for k, v in sorted(d.items())]}
+
 CDS_TYPE = "type.googleapis.com/envoy.config.cluster.v3.Cluster"
 EDS_TYPE = "type.googleapis.com/envoy.config.endpoint.v3.ClusterLoadAssignment"
 LDS_TYPE = "type.googleapis.com/envoy.config.listener.v3.Listener"
@@ -347,6 +412,52 @@ def make_grpc_server(agent, bind_addr: str, port: int):
             if not context.is_active():
                 return
 
+    def dataplane_features(req: dict, context) -> bytes:
+        """pbdataplane GetSupportedDataplaneFeatures: what this server
+        can do for agent-less proxies (dataplane.proto:16-20)."""
+        return encode(FEATURES_RESP, {"supported_dataplane_features": [
+            {"feature_name": 1, "supported": True},   # WATCH_SERVERS
+            {"feature_name": 3, "supported": True},   # ENVOY_BOOTSTRAP
+            {"feature_name": 2, "supported": False},  # EDGE_CERT_MGMT
+        ]})
+
+    def dataplane_bootstrap(req: dict, context) -> bytes:
+        """pbdataplane GetEnvoyBootstrapParams: everything a
+        consul-dataplane needs to render an Envoy bootstrap without a
+        local agent — looked up from the CATALOG (the proxy has no
+        local state), services/dataplane/server.go."""
+        node = req.get("node_name", "")
+        proxy_id = req.get("proxy_id") or req.get("service_id", "")
+        if not node and req.get("node_id"):
+            for n in agent.rpc("Catalog.ListNodes",
+                               {"AllowStale": True})["Nodes"]:
+                if n.get("ID") == req["node_id"]:
+                    node = n["Node"]
+                    break
+        res = agent.rpc("Catalog.NodeServices",
+                        {"Node": node, "AllowStale": True})
+        services = ((res.get("NodeServices") or {}).get("Services")
+                    or {})
+        svc = services.get(proxy_id)
+        if svc is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"service {proxy_id!r} not found on "
+                          f"node {node!r}")
+        proxy = svc.get("Proxy") or {}
+        return encode(BOOTSTRAP_RESP, {
+            "service_kind": SERVICE_KIND_ENUM.get(svc.get("Kind", ""), 1),
+            "service": proxy.get("DestinationServiceName")
+            or svc.get("Service", ""),
+            "identity": proxy.get("DestinationServiceName")
+            or svc.get("Service", ""),
+            "namespace": "default",
+            "partition": req.get("partition") or "default",
+            "datacenter": agent.config.datacenter,
+            "config": to_pb_struct(proxy.get("Config") or {}),
+            "node_name": node,
+            "access_logs": [],
+        })
+
     class Handlers(grpc.GenericRpcHandler):
         def service(self, hcd):
             m = hcd.method
@@ -367,6 +478,20 @@ def make_grpc_server(agent, bind_addr: str, port: int):
                     watch_servers,
                     request_deserializer=lambda b: decode(
                         WATCH_SERVERS_REQ, b),
+                    response_serializer=lambda b: b)
+            if m == ("/hashicorp.consul.dataplane.DataplaneService/"
+                     "GetSupportedDataplaneFeatures"):
+                return grpc.unary_unary_rpc_method_handler(
+                    dataplane_features,
+                    request_deserializer=lambda b: decode(
+                        FEATURES_REQ, b),
+                    response_serializer=lambda b: b)
+            if m == ("/hashicorp.consul.dataplane.DataplaneService/"
+                     "GetEnvoyBootstrapParams"):
+                return grpc.unary_unary_rpc_method_handler(
+                    dataplane_bootstrap,
+                    request_deserializer=lambda b: decode(
+                        BOOTSTRAP_REQ, b),
                     response_serializer=lambda b: b)
             return None
 

@@ -9,8 +9,8 @@ declarative field specs; encoding follows the proto3 rules:
   varint (wire type 0), 64-bit (1, unused), length-delimited (2),
   32-bit (5, unused). Field key = (field_number << 3) | wire_type.
 
-Supported field kinds: int (varint), bool, enum, string, bytes,
-message (nested spec), and repeated variants. Proto3 default-value
+Supported field kinds: int (varint), bool, enum, double (fixed64),
+string, bytes, message (nested spec), and repeated variants. Proto3 default-value
 elision: zero ints/bools/enums, empty strings/bytes/messages are not
 emitted (matching canonical encoders, so byte-for-byte interop with
 real protobuf stacks holds for the subset we use).
@@ -89,6 +89,13 @@ def _encode_one(f: Field, v: Any) -> bytes:
         if iv == 0 and not f.repeated:
             return b""
         return encode_varint((f.num << 3) | 0) + encode_varint(iv)
+    if f.kind == "double":  # wire type 1, little-endian float64
+        import struct as _struct
+
+        dv = float(v)
+        if dv == 0.0 and not f.repeated:
+            return b""
+        return encode_varint((f.num << 3) | 1) + _struct.pack("<d", dv)
     if f.kind == "string":
         bv = v.encode() if isinstance(v, str) else bytes(v)
     elif f.kind == "bytes":
@@ -136,6 +143,11 @@ def decode(spec: dict[str, Field], buf: bytes) -> dict[str, Any]:
         if f.kind in ("int", "enum"):
             v: Any = int(val) if isinstance(val, int) else int.from_bytes(
                 val, "little")
+        elif f.kind == "double":
+            import struct as _struct
+
+            v = _struct.unpack("<d", bytes(val))[0] \
+                if not isinstance(val, int) else float(val)
         elif f.kind == "bool":
             v = bool(val)
         elif f.kind == "string":

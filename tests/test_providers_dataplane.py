@@ -1,0 +1,245 @@
+"""CA provider plugins + the dataplane gRPC service.
+
+Reference: agent/connect/ca/provider_{consul,vault,aws}.go and
+agent/grpc-external/services/dataplane. The external providers run
+against in-process fakes at the client seam (the same boundary
+provider_vault_test.go mocks) — what's verified is the architectural
+property: the root PRIVATE KEY never enters replicated state, yet
+leaves verify against the stored root cert.
+"""
+
+import pytest
+
+from consul_tpu.config import load
+from consul_tpu.connect import ca as ca_mod
+from consul_tpu.connect.providers import (
+    AWSPCAProvider,
+    ConsulCAProvider,
+    VaultCAProvider,
+    make_provider,
+)
+from consul_tpu.server import Server
+
+from helpers import wait_for  # noqa: E402
+
+
+class FakeVault:
+    """In-process stand-in for Vault's PKI engine: holds the root KEY
+    internally, answers the three PKI write paths the provider uses."""
+
+    def __init__(self) -> None:
+        self._root = None  # full root incl. PrivateKey — NEVER returned
+
+    def write(self, path, **data):
+        if path.endswith("/root/generate/internal"):
+            td = data.get("uri_sans", "spiffe://fake").split("//")[1]
+            self._root = ca_mod.generate_root(td, "dc1")
+            return {"certificate": self._root["RootCert"]}
+        if "/issue/" in path:
+            cn = data["common_name"]
+            svc = data["uri_sans"].rsplit("/svc/", 1)[-1]
+            dc = data["uri_sans"].split("/dc/")[1].split("/")[0]
+            leaf = ca_mod.sign_leaf(self._root, svc, dc)
+            assert cn == svc
+            return {"certificate": leaf["CertPEM"],
+                    "private_key": leaf["PrivateKeyPEM"],
+                    "serial_number": leaf["SerialNumber"]}
+        if path.endswith("/root/sign-self-issued"):
+            old, self._root_prev = self._root, self._root
+            import cryptography.x509 as x509
+
+            new_cert = x509.load_pem_x509_certificate(
+                data["certificate"].encode())
+            # re-use the library's cross-sign with a synthetic root dict
+            fake_new = {"RootCert": data["certificate"]}
+            return {"certificate": ca_mod.cross_sign(old, fake_new)}
+        raise AssertionError(f"unexpected vault path {path}")
+
+
+class FakePCA:
+    """acm-pca shaped fake (provider_aws_test.go's mock seam)."""
+
+    def __init__(self) -> None:
+        self._root = None
+        self._issued = {}
+
+    def create_certificate_authority(self, **kw):
+        cn = kw["CertificateAuthorityConfiguration"]["Subject"][
+            "CommonName"]
+        td = cn.split()[-1]
+        self._root = ca_mod.generate_root(td, "dc1")
+        return {"CertificateAuthorityArn": "arn:fake:pca/1"}
+
+    def get_certificate_authority_certificate(self, **kw):
+        return {"Certificate": self._root["RootCert"]}
+
+    def issue_certificate(self, **kw):
+        svc = kw["CommonName"]
+        dc = kw["UriSans"][0].split("/dc/")[1].split("/")[0]
+        leaf = ca_mod.sign_leaf(self._root, svc, dc)
+        arn = f"arn:fake:cert/{leaf['SerialNumber']}"
+        self._issued[arn] = leaf
+        return {"CertificateArn": arn, "Serial": leaf["SerialNumber"]}
+
+    def get_certificate(self, **kw):
+        leaf = self._issued[kw["CertificateArn"]]
+        return {"Certificate": leaf["CertPEM"],
+                "PrivateKey": leaf["PrivateKeyPEM"]}
+
+
+# ------------------------------------------------------------ providers
+
+def test_consul_provider_root_contains_key():
+    p = ConsulCAProvider()
+    root = p.generate_root("td.consul", "dc1")
+    assert "PrivateKey" in root  # built-in model: key replicates
+    leaf = p.sign_leaf(root, "web", "dc1")
+    assert ca_mod.verify_leaf(root["RootCert"], leaf["CertPEM"])
+
+
+@pytest.mark.parametrize("provider_f", [
+    lambda: VaultCAProvider({"RootPKIPath": "pki"}, client=FakeVault()),
+    lambda: AWSPCAProvider({}, client=FakePCA()),
+])
+def test_external_provider_key_never_in_root(provider_f):
+    p = provider_f()
+    root = p.generate_root("ext.consul", "dc1")
+    # THE property external providers buy (provider.go docs): no key
+    # material in what Consul will replicate
+    assert "PrivateKey" not in root
+    leaf = p.sign_leaf(root, "api", "dc1")
+    uri = ca_mod.verify_leaf(root["RootCert"], leaf["CertPEM"])
+    assert uri and uri.endswith("/svc/api")
+
+
+def test_vault_provider_cross_sign():
+    p = VaultCAProvider({}, client=FakeVault())
+    old = p.generate_root("old.consul", "dc1")
+    p2 = VaultCAProvider({}, client=FakeVault())
+    new = p2.generate_root("old.consul", "dc1")
+    bridge = p.cross_sign(old, new)
+    assert "BEGIN CERTIFICATE" in bridge
+
+
+def test_aws_provider_declines_cross_sign():
+    p = AWSPCAProvider({}, client=FakePCA())
+    r = p.generate_root("a.consul", "dc1")
+    with pytest.raises(NotImplementedError):
+        p.cross_sign(r, r)
+    assert p.state()["arn"] == "arn:fake:pca/1"
+
+
+def test_make_provider_rejects_unknown():
+    with pytest.raises(ValueError):
+        make_provider("nope")
+
+
+def test_server_with_vault_provider_signs_leaves():
+    """Full server path: ConnectCA.Sign rides the vault provider; the
+    replicated root entry has no private key."""
+    cfg = load(dev=True, overrides={
+        "node_name": "vaultca", "server": True, "bootstrap": True,
+        "connect": {"ca_provider": "vault"}})
+    srv = Server(cfg)
+    # inject the fake at the client seam BEFORE first use
+    srv.ca.provider = VaultCAProvider({}, client=FakeVault())
+    srv.start()
+    try:
+        wait_for(srv.is_leader, what="leadership")
+        leaf = srv.handle_rpc("ConnectCA.Sign", {"Service": "pay"},
+                              "test")
+        root = srv.ca.active_root()
+        assert "PrivateKey" not in root
+        assert ca_mod.verify_leaf(root["RootCert"], leaf["CertPEM"])
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------ dataplane
+
+@pytest.fixture(scope="module")
+def dp_agent():
+    from consul_tpu.agent.agent import Agent
+
+    cfg = load(dev=True, overrides={
+        "node_name": "dp0", "server": True, "bootstrap": True})
+    a = Agent(cfg)
+    a.start(serve_http=False, serve_dns=False)
+    wait_for(a.server.is_leader, what="leadership")
+    yield a
+    a.shutdown()
+
+
+def _grpc_channel(agent):
+    import grpc
+
+    port = agent.grpc_port
+    return grpc.insecure_channel(f"127.0.0.1:{port}")
+
+
+def test_dataplane_features(dp_agent):
+    import grpc  # noqa: F401
+
+    from consul_tpu.server.grpc_external import (FEATURES_REQ,
+                                                 FEATURES_RESP)
+    from consul_tpu.utils.pbwire import decode, encode
+
+    ch = _grpc_channel(dp_agent)
+    fn = ch.unary_unary(
+        "/hashicorp.consul.dataplane.DataplaneService/"
+        "GetSupportedDataplaneFeatures",
+        request_serializer=lambda m: encode(FEATURES_REQ, m),
+        response_deserializer=lambda b: decode(FEATURES_RESP, b))
+    resp = fn({}, timeout=10)
+    feats = {f["feature_name"]: f.get("supported", False)
+             for f in resp["supported_dataplane_features"]}
+    assert feats.get(1) and feats.get(3)  # WATCH_SERVERS + BOOTSTRAP
+    ch.close()
+
+
+def test_dataplane_bootstrap_params(dp_agent):
+    from consul_tpu.server.grpc_external import (BOOTSTRAP_REQ,
+                                                 BOOTSTRAP_RESP)
+    from consul_tpu.utils.pbwire import decode, encode
+
+    dp_agent.server.handle_rpc("Catalog.Register", {
+        "Node": "dp-node", "Address": "10.0.0.5",
+        "Service": {"ID": "web-sidecar", "Service": "web-sidecar",
+                    "Kind": "connect-proxy", "Port": 21000,
+                    "Proxy": {"DestinationServiceName": "web",
+                              "Config": {"protocol": "http",
+                                         "local_port": 8080}}}}, "test")
+    ch = _grpc_channel(dp_agent)
+    fn = ch.unary_unary(
+        "/hashicorp.consul.dataplane.DataplaneService/"
+        "GetEnvoyBootstrapParams",
+        request_serializer=lambda m: encode(BOOTSTRAP_REQ, m),
+        response_deserializer=lambda b: decode(BOOTSTRAP_RESP, b))
+    resp = fn({"node_name": "dp-node", "proxy_id": "web-sidecar"},
+              timeout=10)
+    assert resp["service_kind"] == 2  # CONNECT_PROXY
+    assert resp["service"] == "web"
+    assert resp["node_name"] == "dp-node"
+    cfg = {f["key"]: f["value"] for f in resp["config"]["fields"]}
+    assert cfg["protocol"]["string_value"] == "http"
+    assert cfg["local_port"]["number_value"] == 8080.0
+    ch.close()
+
+
+def test_dataplane_bootstrap_unknown_service(dp_agent):
+    import grpc
+
+    from consul_tpu.server.grpc_external import (BOOTSTRAP_REQ,
+                                                 BOOTSTRAP_RESP)
+    from consul_tpu.utils.pbwire import decode, encode
+
+    ch = _grpc_channel(dp_agent)
+    fn = ch.unary_unary(
+        "/hashicorp.consul.dataplane.DataplaneService/"
+        "GetEnvoyBootstrapParams",
+        request_serializer=lambda m: encode(BOOTSTRAP_REQ, m),
+        response_deserializer=lambda b: decode(BOOTSTRAP_RESP, b))
+    with pytest.raises(grpc.RpcError) as ei:
+        fn({"node_name": "dp-node", "proxy_id": "ghost"}, timeout=10)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    ch.close()
