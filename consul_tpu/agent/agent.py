@@ -619,6 +619,24 @@ class Agent:
         reloaded.append("log_level")
         return reloaded
 
+    def update_token(self, kind: str, value: str) -> bool:
+        """Runtime ACL-token update (agent_endpoint.go AgentToken /
+        UpdateTokens): swaps the immutable config for one with the new
+        token — in-flight requests keep the old snapshot, exactly the
+        property the reference's token store provides."""
+        import dataclasses as _dc
+
+        field_for = {"default": "acl_default_token",
+                     "agent": "acl_agent_token",
+                     "agent_master": "acl_agent_token",
+                     "agent_recovery": "acl_agent_token",
+                     "replication": "acl_replication_token"}
+        f = field_for.get(kind)
+        if f is None:
+            return False
+        self.config = _dc.replace(self.config, **{f: value})
+        return True
+
     def set_service_maintenance(self, service_id: str, enable: bool,
                                 reason: str = "") -> bool:
         """Per-service maintenance mode (agent/agent.go

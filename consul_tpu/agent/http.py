@@ -24,6 +24,14 @@ from consul_tpu.utils import log, telemetry
 from consul_tpu.version import __version__
 
 
+class StreamingBody:
+    """A route result that streams chunks instead of one JSON body
+    (/v1/agent/metrics/stream, /v1/agent/monitor pattern)."""
+
+    def __init__(self, gen) -> None:
+        self.gen = gen
+
+
 class HTTPError(Exception):
     def __init__(self, code: int, msg: str) -> None:
         super().__init__(msg)
@@ -63,6 +71,16 @@ class HTTPApi:
                 try:
                     result, index = api.route(method, path, query, body,
                                               token)
+                    if isinstance(result, StreamingBody):
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+                        for chunk in result.gen:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                        return
                     payload = b"" if result is None else (
                         result if isinstance(result, bytes)
                         else json.dumps(result).encode())
@@ -284,6 +302,49 @@ class HTTPApi:
         if path == "/v1/agent/leave" and method in ("PUT", "POST"):
             a.leave()
             return None, None
+        if (m := re.match(r"^/v1/agent/token/(.+)$", path)) \
+                and method in ("PUT", "POST"):
+            rpc("Internal.AgentWrite", {})  # agent:write gate
+            kind = urllib.parse.unquote(m.group(1))
+            if not a.update_token(kind, jbody().get("Token", "")):
+                raise HTTPError(404, f"unknown token type {kind!r}")
+            return None, None
+        if (m := re.match(r"^/v1/agent/service/([^/]+)$", path)) \
+                and m.group(1) not in ("register", "deregister",
+                                       "maintenance") \
+                and method == "GET":
+            # one LOCAL service's full registration
+            # (agent_endpoint.go AgentService — what `consul connect
+            # envoy` polls for sidecar config changes)
+            sid = urllib.parse.unquote(m.group(1))
+            svc = a.local.list_services().get(sid)
+            if svc is None:
+                raise HTTPError(404, f"unknown service ID {sid!r}")
+            d = svc.to_service_dict()
+            d["ContentHash"] = format(
+                abs(hash(json.dumps(d, sort_keys=True, default=str))),
+                "x")[:16]
+            return d, None
+        if path == "/v1/agent/metrics/stream":
+            # chunked metrics stream (http_register.go:40; what
+            # `consul debug` captures): one JSON snapshot per interval.
+            # Params validate BEFORE streaming starts — an error after
+            # the 200 header would corrupt the response
+            try:
+                intervals = int(q.get("intervals", "3"))
+                interval = float(q.get("interval", "1.0"))
+            except ValueError as exc:
+                raise HTTPError(400, f"bad stream params: {exc}") from exc
+
+            def metrics_stream():
+                import time as time_mod
+
+                for _ in range(intervals):
+                    yield (json.dumps(
+                        telemetry.default.snapshot()) + "\n").encode()
+                    time_mod.sleep(interval)
+
+            return StreamingBody(metrics_stream()), None
         if path == "/v1/agent/maintenance" and method in ("PUT", "POST"):
             enable = q.get("enable", "true") == "true"
             a.set_maintenance(enable, q.get("reason", ""))
@@ -573,13 +634,10 @@ class HTTPApi:
             svc = urllib.parse.unquote(m.group(1))
             return a.leaf_cert(svc, rpc), None
         if path == "/v1/connect/ca/configuration":
-            # provider config WITHOUT key material (connect_ca_endpoint)
-            roots = rpc("ConnectCA.Roots", blocking_args())
-            return {"Provider": "consul-tpu-builtin",
-                    "Config": {"RotationPeriod": "2160h"},
-                    "State": {"Roots": len(roots.get("Roots") or []),
-                              "TrustDomain": roots.get("TrustDomain",
-                                                       "")}}, None
+            if method == "PUT":
+                rpc("ConnectCA.ConfigurationSet", jbody())
+                return True, None
+            return rpc("ConnectCA.ConfigurationGet", {}), None
         if path == "/v1/connect/ca/rotate" and method in ("PUT", "POST"):
             return rpc("ConnectCA.Rotate", {}), None
         if path == "/v1/connect/intentions":
@@ -637,6 +695,25 @@ class HTTPApi:
                 raise HTTPError(404, "unknown templated policy")
             return {"TemplateName": name,
                     "Schema": "{\"Name\": \"string\"}"}, None
+        if (m := re.match(r"^/v1/acl/templated-policy/preview/(.+)$",
+                          path)) and method in ("PUT", "POST"):
+            # render the synthesized policy for given variables
+            # (acl_endpoint.go ACLTemplatedPolicyPreview; rules mirror
+            # the resolver's identity templates)
+            tname = urllib.parse.unquote(m.group(1))
+            var_name = jbody().get("Name", "")
+            if tname == "builtin/service":
+                rules = {"service": {var_name: "write",
+                                     f"{var_name}-sidecar-proxy": "write"},
+                         "service_prefix": {"": "read"},
+                         "node_prefix": {"": "read"}}
+            elif tname == "builtin/node":
+                rules = {"node": {var_name: "write"},
+                         "service_prefix": {"": "read"}}
+            else:
+                raise HTTPError(404, "unknown templated policy")
+            return {"TemplateName": tname, "Name": var_name,
+                    "Rules": json.dumps(rules)}, None
         if path == "/v1/acl/bootstrap" and method in ("PUT", "POST"):
             return rpc("ACL.Bootstrap", {}), None
         if path == "/v1/acl/token" and method in ("PUT", "POST"):
@@ -659,6 +736,12 @@ class HTTPApi:
             return rpc("ACL.TokenList", {})["Tokens"], None
         if path == "/v1/acl/role" and method in ("PUT", "POST"):
             return rpc("ACL.RoleSet", {"Role": jbody()}), None
+        if (m := re.match(r"^/v1/acl/role/name/(.+)$", path)):
+            res = rpc("ACL.RoleRead", {
+                "RoleID": urllib.parse.unquote(m.group(1))})
+            if res.get("Role") is None:
+                raise HTTPError(404, "role not found")
+            return res["Role"], None
         if (m := re.match(r"^/v1/acl/role/(.+)$", path)):
             rid = urllib.parse.unquote(m.group(1))
             if method == "DELETE":
@@ -718,6 +801,14 @@ class HTTPApi:
             return rpc("ACL.Logout", {}), None
         if path == "/v1/acl/policy" and method in ("PUT", "POST"):
             return rpc("ACL.PolicySet", {"Policy": jbody()}), None
+        if (m := re.match(r"^/v1/acl/policy/name/(.+)$", path)):
+            # by-name read (acl_endpoint.go ACLPolicyReadByName); the
+            # RPC's read falls back to name matching
+            res = rpc("ACL.PolicyRead", {
+                "PolicyID": urllib.parse.unquote(m.group(1))})
+            if res.get("Policy") is None:
+                raise HTTPError(404, "policy not found")
+            return res["Policy"], None
         if (m := re.match(r"^/v1/acl/policy/(.+)$", path)):
             pid = urllib.parse.unquote(m.group(1))
             if method == "DELETE":
@@ -866,6 +957,134 @@ class HTTPApi:
         if path == "/v1/internal/ui/services":
             res = rpc("Internal.UIServices", blocking_args())
             return res["Services"], res.get("Index")
+        if (m := re.match(r"^/v1/internal/ui/node/(.+)$", path)):
+            # one node's detail for the UI (ui_endpoint.go UINodeInfo):
+            # the catalog record + its services + all its checks
+            node = urllib.parse.unquote(m.group(1))
+            res = rpc("Catalog.NodeServices", blocking_args(
+                {"Node": node}))
+            ns = res.get("NodeServices")
+            if ns is None:
+                raise HTTPError(404, f"no such node {node!r}")
+            checks = rpc("Health.NodeChecks", {"Node": node})
+            return {**ns["Node"],
+                    "Services": list(ns["Services"].values()),
+                    "Checks": checks.get("HealthChecks") or []}, \
+                res.get("Index")
+        if path == "/v1/internal/ui/exported-services":
+            return rpc("Internal.ExportedServices", {})["Services"], None
+        if (m := re.match(r"^/v1/internal/ui/gateway-services-nodes/(.+)$",
+                          path)):
+            # instances behind a gateway (ui_endpoint.go
+            # UIGatewayServicesNodes): resolve the gateway's service
+            # list, then the health rows of each
+            gw = urllib.parse.unquote(m.group(1))
+            svcs = rpc("Internal.GatewayServices",
+                       {"Gateway": gw}).get("Services") or []
+            out = []
+            for entry in svcs:
+                res = rpc("Health.ServiceNodes",
+                          {"ServiceName": entry.get("Service",
+                                                    entry.get("Name", ""))})
+                out.extend(res["Nodes"])
+            return out, None
+        if (m := re.match(r"^/v1/internal/ui/gateway-intentions/(.+)$",
+                          path)):
+            # intentions whose destination routes through this gateway
+            gw = urllib.parse.unquote(m.group(1))
+            svcs = {e.get("Service", e.get("Name", ""))
+                    for e in (rpc("Internal.GatewayServices",
+                                  {"Gateway": gw}).get("Services") or [])}
+            all_intentions = rpc("Intention.List", {})["Intentions"]
+            return [i for i in all_intentions
+                    if i.get("DestinationName") in svcs
+                    or i.get("DestinationName") == "*"], None
+        if path.startswith("/v1/internal/ui/metrics-proxy/"):
+            # reverse proxy to the configured metrics backend
+            # (uiserver/proxy.go) — only when an operator opted in.
+            # ACL-gated like its sibling internal routes, and the
+            # path must stay under the configured base (no traversal)
+            rpc("Internal.AgentRead", {})
+            base_url = (getattr(a.config, "ui_metrics_proxy_url", "")
+                        or "").rstrip("/")
+            if not base_url:
+                raise HTTPError(
+                    503, "metrics proxy is not configured "
+                         "(ui_config.metrics_proxy)")
+            sub = path[len("/v1/internal/ui/metrics-proxy"):]
+            if ".." in sub or "://" in sub:
+                raise HTTPError(400, "invalid metrics-proxy path")
+            from urllib.request import urlopen as _urlopen
+
+            qs = urllib.parse.urlencode(q)
+            with _urlopen(f"{base_url}{sub}{'?' + qs if qs else ''}",
+                          timeout=10) as r:
+                return r.read(), None
+        # -------------------------------------------------- v2 resources
+        # HTTP projection of the pbresource surface (the reference
+        # serves this over gRPC; the CLI's `resource` commands ride it)
+        if (m := re.match(
+                r"^/v1/resource/([^/]+)/([^/]+)/([^/]+)/(.+)$", path)):
+            g, gv, kind, name = (urllib.parse.unquote(x)
+                                 for x in m.groups())
+            rid = {"Type": {"Group": g, "GroupVersion": gv,
+                            "Kind": kind},
+                   "Name": name, "Tenancy": {
+                       "Partition": q.get("partition", "default"),
+                       "PeerName": "local",
+                       "Namespace": q.get("namespace", "default")}}
+            if method == "DELETE":
+                res = rpc("Resource.Delete", {
+                    "ID": rid, "Version": q.get("version", "")})
+                if res and res.get("Error"):
+                    raise HTTPError(409, res["Error"])
+                return None, None
+            if method == "PUT":
+                b = jbody()
+                res = rpc("Resource.Write", {"Resource": {
+                    "Id": rid, "Data": b.get("Data") or b,
+                    "Version": q.get("version", ""),
+                    "Owner": b.get("Owner"),
+                    "Metadata": b.get("Metadata") or {}}})
+                if res.get("Error"):
+                    raise HTTPError(409, res["Error"])
+                return res["Resource"], None
+            res = rpc("Resource.Read", {"ID": rid})
+            if res.get("Error") == "not_found":
+                raise HTTPError(404, "resource not found")
+            if res.get("Error"):
+                raise HTTPError(409, res["Error"])
+            return res["Resource"], None
+        if (m := re.match(r"^/v1/resources/([^/]+)/([^/]+)/([^/]+)$",
+                          path)):
+            g, gv, kind = (urllib.parse.unquote(x) for x in m.groups())
+            res = rpc("Resource.List", blocking_args({
+                "Type": {"Group": g, "GroupVersion": gv, "Kind": kind},
+                "Tenancy": {"Partition": q.get("partition", "*"),
+                            "PeerName": "*",
+                            "Namespace": q.get("namespace", "*")},
+                "Prefix": q.get("name_prefix", "")}))
+            return res["Resources"], res.get("Index")
+        if path == "/v1/internal/federation-states/mesh-gateways":
+            # dc -> that dc's mesh gateways (wanfed routing table,
+            # federation_state_endpoint.go ListMeshGateways)
+            return rpc("Internal.ListMeshGateways", {}), None
+        if path == "/v1/imported-services":
+            return rpc("Internal.ImportedServices", {})["Services"], None
+        if path == "/v1/internal/rpc/methods":
+            # debug listing of the server's RPC surface (the
+            # introspection route the reference registers for ops)
+            rpc("Internal.AgentRead", {})
+            if a.server is not None:
+                return sorted(a.server.endpoints.keys()), None
+            return rpc("Status.RPCMethods", {}), None
+        if path == "/v1/operator/utilization":
+            # CE build: utilization bundle = usage counts + version
+            # (reporting is an enterprise license feature)
+            usage = rpc("Operator.Usage", {})["Usage"]
+            return {"Version": __version__,
+                    "Usage": usage,
+                    "Generated": True}, None
 
         # -------------------------------------------------------- operator
         if path == "/v1/operator/autopilot/health":

@@ -275,6 +275,40 @@ def cmd_services(args) -> int:
         c.service_deregister(args.id)
         print(f"Deregistered service: {args.id}")
         return 0
+    if args.services_cmd == "export":
+        # add the service to the exported-services config entry
+        # (command/services/export: consumers are peers)
+        try:
+            entry = c.get("/v1/config/exported-services/default")
+        except APIError:
+            entry = {"Kind": "exported-services", "Name": "default",
+                     "Services": []}
+        svcs = entry.get("Services") or []
+        match = next((s for s in svcs
+                      if s.get("Name") == args.name), None)
+        if match is None:
+            match = {"Name": args.name, "Consumers": []}
+            svcs.append(match)
+        consumers = match.setdefault("Consumers", [])
+        for peer in (args.consumer_peers or "").split(","):
+            if peer and not any(c0.get("Peer") == peer
+                                for c0 in consumers):
+                consumers.append({"Peer": peer})
+        entry["Services"] = svcs
+        c.put("/v1/config", body=entry)
+        print(f"Exported service {args.name} to: "
+              f"{args.consumer_peers}")
+        return 0
+    if args.services_cmd == "exported-services":
+        for s0 in c.get("/v1/exported-services"):
+            peers = ",".join(c0.get("Peer", "")
+                             for c0 in s0.get("Consumers") or [])
+            print(f"{s0.get('Service')}  {peers}")
+        return 0
+    if args.services_cmd == "imported-services":
+        for s0 in c.get("/v1/imported-services"):
+            print(f"{s0.get('Service')}  (peer: {s0.get('Peer')})")
+        return 0
     return 1
 
 
@@ -368,6 +402,21 @@ def cmd_operator(args) -> int:
         c.delete("/v1/operator/raft/peer", address=args.address)
         print(f"Removed peer with address \"{args.address}\"")
         return 0
+    if args.operator_cmd == "raft" and \
+            args.raft_cmd == "transfer-leader":
+        res = c.put("/v1/operator/raft/transfer-leader",
+                    id=getattr(args, "id", "") or "")
+        print("Success" if (res or {}).get("Success")
+              else "Transfer failed")
+        return 0 if (res or {}).get("Success") else 1
+    if args.operator_cmd == "usage":
+        usage = c.get("/v1/operator/usage")
+        for k, v in sorted(usage.items()):
+            print(f"{k}: {v}")
+        return 0
+    if args.operator_cmd == "utilization":
+        print(json.dumps(c.get("/v1/operator/utilization"), indent=2))
+        return 0
     if args.operator_cmd == "raft" and args.raft_cmd == "list-peers":
         cfg = c.raft_configuration()
         rows = [("Address", "Leader", "Voter")]
@@ -392,6 +441,24 @@ def cmd_snapshot(args) -> int:
         with open(args.file, "rb") as f:
             meta = c.put("/v1/snapshot", raw=f.read())
         print(f"Restored snapshot (index {meta.get('Index')})")
+        return 0
+    if args.snapshot_cmd == "decode":
+        # stream the archive's state as JSON lines (snapshot decode)
+        from consul_tpu.server.snapshot import read_archive
+        import msgpack as _mp
+
+        with open(args.file, "rb") as f:
+            meta, blob = read_archive(f.read())
+        state = _mp.unpackb(blob, raw=False)
+        for table, records in sorted(state.items()):
+            if isinstance(records, dict):
+                for k, v in records.items():
+                    print(json.dumps({"Table": table, "Key": str(k)},
+                                     default=str))
+            else:
+                print(json.dumps({"Table": table,
+                                  "Meta": str(records)[:80]},
+                                 default=str))
         return 0
     if args.snapshot_cmd == "inspect":
         from consul_tpu.server.snapshot import read_archive
@@ -432,6 +499,27 @@ def cmd_keyring(args) -> int:
 
 def cmd_acl(args) -> int:
     c = _client(args)
+    if args.acl_cmd == "set-agent-token":
+        c.put(f"/v1/agent/token/{args.kind}",
+              body={"Token": args.token_value})
+        print(f"ACL token \"{args.kind}\" set successfully")
+        return 0
+    if args.acl_cmd == "templated-policy":
+        if args.acl_sub == "list":
+            for name in c.get("/v1/acl/templated-policies"):
+                print(name)
+            return 0
+        if args.acl_sub == "read":
+            print(json.dumps(
+                c.get(f"/v1/acl/templated-policy/name/{args.name}"),
+                indent=2))
+            return 0
+        if args.acl_sub == "preview":
+            out = c.post(
+                f"/v1/acl/templated-policy/preview/{args.name}",
+                body={"Name": args.var_name})
+            print(out.get("Rules", ""))
+            return 0
     if args.acl_cmd == "bootstrap":
         tok = c.put("/v1/acl/bootstrap")
         print(f"SecretID:    {tok['SecretID']}")
@@ -452,6 +540,21 @@ def cmd_acl(args) -> int:
         if args.acl_sub == "delete":
             c.delete(f"/v1/acl/token/{args.id}")
             print(f"Token {args.id} deleted")
+            return 0
+        if args.acl_sub == "read":
+            print(json.dumps(c.get(f"/v1/acl/token/{args.id}"),
+                             indent=2))
+            return 0
+        if args.acl_sub == "clone":
+            src = c.get(f"/v1/acl/token/{args.id}")
+            body = {k: src[k] for k in ("Policies", "Roles",
+                                        "ServiceIdentities",
+                                        "NodeIdentities")
+                    if src.get(k)}
+            body["Description"] = args.description \
+                or f"Clone of {src.get('Description', args.id)}"
+            tok = c.put("/v1/acl/token", body=body)
+            print(json.dumps(tok, indent=2))
             return 0
     if args.acl_cmd == "policy":
         if args.acl_sub == "create":
@@ -635,6 +738,17 @@ def cmd_peering(args) -> int:
         c.delete(f"/v1/peering/{args.name}")
         print(f"Deleted peering {args.name}")
         return 0
+    if args.peering_cmd == "read":
+        for p in c.get("/v1/peerings"):
+            if p.get("Name") == args.name:
+                print(json.dumps(p, indent=2))
+                return 0
+        print(f"No peering named {args.name}", file=sys.stderr)
+        return 1
+    if args.peering_cmd == "exported-services":
+        for s0 in c.get("/v1/exported-services"):
+            print(s0.get("Service"))
+        return 0
     return 1
 
 
@@ -710,6 +824,20 @@ def cmd_connect(args) -> int:
     """`connect envoy -sidecar-for <id> -bootstrap`: print the Envoy
     bootstrap config materialized from the proxy's config snapshot
     (command/connect/envoy in the reference)."""
+    c = _client(args)
+    if args.connect_cmd == "ca":
+        if args.connect_sub == "get-config":
+            print(json.dumps(c.get("/v1/connect/ca/configuration"),
+                             indent=2))
+            return 0
+        if args.connect_sub == "set-config":
+            body = json.loads(open(args.config_file).read()
+                              if args.config_file != "-"
+                              else sys.stdin.read())
+            c.put("/v1/connect/ca/configuration", body=body)
+            print("Configuration updated!")
+            return 0
+        return 1
     from consul_tpu.connect.envoy import bootstrap_config
 
     if not args.sidecar_for and not args.proxy_id:
@@ -720,7 +848,6 @@ def cmd_connect(args) -> int:
         print("Error: only -bootstrap mode is supported (this build "
               "does not exec envoy)", file=sys.stderr)
         return 1
-    c = _client(args)
     proxy_id = args.proxy_id or f"{args.sidecar_for}-sidecar-proxy"
     snap = c.get(f"/v1/agent/connect/proxy/{proxy_id}")
     if args.xds:
@@ -832,6 +959,177 @@ def cmd_watch(args) -> int:
             return 0
 
 
+def cmd_intention(args) -> int:
+    """`consul intention` family (command/intention/*)."""
+    c = _client(args)
+    if args.intention_cmd == "create":
+        c.put("/v1/connect/intentions", body={
+            "SourceName": args.source, "DestinationName": args.destination,
+            "Action": "deny" if args.deny else "allow"})
+        print(f"Created: {args.source} => {args.destination} "
+              f"({'deny' if args.deny else 'allow'})")
+        return 0
+    if args.intention_cmd == "list":
+        rows = [("Source", "Action", "Destination", "Precedence")]
+        for i in c.get("/v1/connect/intentions"):
+            rows.append((i.get("SourceName"), i.get("Action"),
+                         i.get("DestinationName"),
+                         i.get("Precedence", "")))
+        _table(rows)
+        return 0
+    if args.intention_cmd == "check":
+        res = c.get("/v1/connect/intentions/check",
+                    source=args.source, destination=args.destination)
+        print("Allowed" if res.get("Allowed") else "Denied")
+        return 0 if res.get("Allowed") else 2
+    if args.intention_cmd == "match":
+        res = c.get("/v1/connect/intentions/match",
+                    by=args.by or "destination", name=args.name)
+        for i in (res if isinstance(res, list) else []):
+            print(f"{i.get('SourceName')} => {i.get('DestinationName')} "
+                  f"({i.get('Action')})")
+        return 0
+    if args.intention_cmd == "get":
+        for i in c.get("/v1/connect/intentions"):
+            if i.get("SourceName") == args.source and \
+                    i.get("DestinationName") == args.destination:
+                print(json.dumps(i, indent=2))
+                return 0
+        print("Intention not found", file=sys.stderr)
+        return 1
+    if args.intention_cmd == "delete":
+        c.delete("/v1/connect/intentions/exact",
+                 source=args.source, destination=args.destination)
+        print(f"Deleted: {args.source} => {args.destination}")
+        return 0
+    return 1
+
+
+def cmd_config(args) -> int:
+    """`consul config write/read/list/delete` (command/config/*)."""
+    c = _client(args)
+    if args.config_cmd == "write":
+        entry = json.loads(open(args.file).read()
+                           if args.file != "-" else sys.stdin.read())
+        c.put("/v1/config", body=entry)
+        print(f"Config entry written: {entry.get('Kind')}/"
+              f"{entry.get('Name')}")
+        return 0
+    if args.config_cmd == "read":
+        print(json.dumps(
+            c.get(f"/v1/config/{args.kind}/{args.name}"), indent=2))
+        return 0
+    if args.config_cmd == "list":
+        for entry in c.get(f"/v1/config/{args.kind}"):
+            print(entry.get("Name"))
+        return 0
+    if args.config_cmd == "delete":
+        c.delete(f"/v1/config/{args.kind}/{args.name}")
+        print(f"Config entry deleted: {args.kind}/{args.name}")
+        return 0
+    return 1
+
+
+def cmd_resource(args) -> int:
+    """`consul resource` (command/resource/*): v2 resource CRUD over
+    the HTTP projection of pbresource."""
+    c = _client(args)
+    if args.resource_cmd == "apply":
+        body = json.loads(open(args.file).read()
+                          if args.file != "-" else sys.stdin.read())
+        rid = body.get("Id") or {}
+        t = rid.get("Type") or {}
+        res = c.put(
+            f"/v1/resource/{t.get('Group')}/{t.get('GroupVersion')}/"
+            f"{t.get('Kind')}/{rid.get('Name')}",
+            body={"Data": body.get("Data") or {}},
+            version=body.get("Version", ""))
+        print(json.dumps(res, indent=2))
+        return 0
+    gvk = args.type.split(".") if getattr(args, "type", None) else []
+    if len(gvk) != 3:
+        print("-type must be group.version.kind", file=sys.stderr)
+        return 1
+    g, gv, kind = gvk
+    if args.resource_cmd == "read":
+        print(json.dumps(
+            c.get(f"/v1/resource/{g}/{gv}/{kind}/{args.name}"),
+            indent=2))
+        return 0
+    if args.resource_cmd == "list":
+        for r in c.get(f"/v1/resources/{g}/{gv}/{kind}"):
+            print(r["Id"]["Name"])
+        return 0
+    if args.resource_cmd == "delete":
+        c.delete(f"/v1/resource/{g}/{gv}/{kind}/{args.name}")
+        print("Deleted")
+        return 0
+    return 1
+
+
+def cmd_monitor(args) -> int:
+    """`consul monitor`: a window of live agent logs."""
+    c = _client(args)
+    out = c.get("/v1/agent/monitor", duration=f"{args.log_seconds}s")
+    if isinstance(out, bytes):
+        out = out.decode(errors="replace")
+    sys.stdout.write(out or "")
+    return 0
+
+
+def cmd_maint(args) -> int:
+    """`consul maint`: node or service maintenance mode."""
+    c = _client(args)
+    if args.enable == args.disable:
+        # exactly one required (the reference command errors likewise —
+        # a bare `maint` must never silently enable maintenance)
+        print("Error: one of -enable or -disable must be specified",
+              file=sys.stderr)
+        return 1
+    enable = "false" if args.disable else "true"
+    if args.service:
+        c.put(f"/v1/agent/service/maintenance/{args.service}",
+              enable=enable, reason=args.reason or "")
+        what = f"service {args.service}"
+    else:
+        c.put("/v1/agent/maintenance", enable=enable,
+              reason=args.reason or "")
+        what = "node"
+    print(f"Maintenance mode {'disabled' if args.disable else 'enabled'} "
+          f"for {what}")
+    return 0
+
+
+def cmd_force_leave(args) -> int:
+    _client(args).put(f"/v1/agent/force-leave/{args.node}")
+    print(f"Force leave sent for {args.node}")
+    return 0
+
+
+def cmd_reload(args) -> int:
+    res = _client(args).put("/v1/agent/reload")
+    print("Configuration reload triggered: "
+          + ",".join((res or {}).get("Reloaded") or []))
+    return 0
+
+
+def cmd_fmt(args) -> int:
+    """`consul fmt`: canonicalize a JSON config file (the reference
+    formats HCL; our config language is JSON)."""
+    raw = open(args.file).read() if args.file != "-" else sys.stdin.read()
+    try:
+        parsed = json.loads(raw)
+    except json.JSONDecodeError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    formatted = json.dumps(parsed, indent=2, sort_keys=True) + "\n"
+    if args.write and args.file != "-":
+        open(args.file, "w").write(formatted)
+    else:
+        sys.stdout.write(formatted)
+    return 0
+
+
 def _table(rows: list[tuple]) -> None:
     widths = [max(len(str(r[i])) for r in rows)
               for i in range(len(rows[0]))]
@@ -856,8 +1154,12 @@ def build_parser() -> argparse.ArgumentParser:
                     for flag, dest in (("-http-addr", "http_addr"),
                                        ("-token", "token")):
                         try:
+                            # SUPPRESS: an unused subcommand-level flag
+                            # must not clobber the value the OUTER
+                            # parser already parsed (this Python's
+                            # subparsers re-apply plain defaults)
                             sp.add_argument(flag, dest=dest,
-                                            default=None)
+                                            default=argparse.SUPPRESS)
                         except argparse.ArgumentError:
                             pass
                     finish(sp)
@@ -929,6 +1231,12 @@ def build_parser() -> argparse.ArgumentParser:
     reg.add_argument("file")
     dereg = ssub.add_parser("deregister")
     dereg.add_argument("-id", required=True)
+    sexp = ssub.add_parser("export")
+    sexp.add_argument("-name", required=True)
+    sexp.add_argument("-consumer-peers", dest="consumer_peers",
+                      required=True)
+    ssub.add_parser("exported-services")
+    ssub.add_parser("imported-services")
     svcs.set_defaults(fn=cmd_services)
 
     ev = sub.add_parser("event")
@@ -949,7 +1257,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     snap = sub.add_parser("snapshot")
     snapsub = snap.add_subparsers(dest="snapshot_cmd", required=True)
-    for name in ("save", "restore", "inspect"):
+    for name in ("save", "restore", "inspect", "decode"):
         sp = snapsub.add_parser(name)
         sp.add_argument("file")
     snap.set_defaults(fn=cmd_snapshot)
@@ -964,6 +1272,17 @@ def build_parser() -> argparse.ArgumentParser:
     acl = sub.add_parser("acl")
     aclsub = acl.add_subparsers(dest="acl_cmd", required=True)
     aclsub.add_parser("bootstrap")
+    sat = aclsub.add_parser("set-agent-token")
+    sat.add_argument("kind")
+    sat.add_argument("token_value")
+    tpp = aclsub.add_parser("templated-policy")
+    tpsub = tpp.add_subparsers(dest="acl_sub", required=True)
+    tpsub.add_parser("list")
+    tpr = tpsub.add_parser("read")
+    tpr.add_argument("-name", required=True)
+    tpv = tpsub.add_parser("preview")
+    tpv.add_argument("-name", required=True)
+    tpv.add_argument("-var-name", dest="var_name", required=True)
     tokp = aclsub.add_parser("token")
     toksub = tokp.add_subparsers(dest="acl_sub", required=True)
     tc = toksub.add_parser("create")
@@ -971,6 +1290,11 @@ def build_parser() -> argparse.ArgumentParser:
     tc.add_argument("-policy-name", dest="policy_name", action="append",
                     default=[])
     toksub.add_parser("list")
+    tr = toksub.add_parser("read")
+    tr.add_argument("-id", required=True)
+    tcl = toksub.add_parser("clone")
+    tcl.add_argument("-id", required=True)
+    tcl.add_argument("-description", default="")
     td = toksub.add_parser("delete")
     td.add_argument("-id", required=True)
     polp = aclsub.add_parser("policy")
@@ -1040,6 +1364,9 @@ def build_parser() -> argparse.ArgumentParser:
     pe.add_argument("-name", required=True)
     pe.add_argument("-peering-token", dest="peering_token", required=True)
     peersub.add_parser("list")
+    pr = peersub.add_parser("read")
+    pr.add_argument("-name", required=True)
+    peersub.add_parser("exported-services")
     pd = peersub.add_parser("delete")
     pd.add_argument("-name", required=True)
     peer.set_defaults(fn=cmd_peering)
@@ -1049,8 +1376,79 @@ def build_parser() -> argparse.ArgumentParser:
     dbg.add_argument("-output", default=None)
     dbg.set_defaults(fn=cmd_debug)
 
+    intent = sub.add_parser("intention")
+    isub = intent.add_subparsers(dest="intention_cmd", required=True)
+    ic = isub.add_parser("create")
+    ic.add_argument("source")
+    ic.add_argument("destination")
+    ic.add_argument("-deny", action="store_true")
+    isub.add_parser("list")
+    for nm in ("check", "get", "delete"):
+        ip = isub.add_parser(nm)
+        ip.add_argument("source")
+        ip.add_argument("destination")
+    im = isub.add_parser("match")
+    im.add_argument("name")
+    im.add_argument("-by", default="destination")
+    intent.set_defaults(fn=cmd_intention)
+
+    cfgp = sub.add_parser("config")
+    cfgsub = cfgp.add_subparsers(dest="config_cmd", required=True)
+    cw = cfgsub.add_parser("write")
+    cw.add_argument("file")
+    cr = cfgsub.add_parser("read")
+    cr.add_argument("-kind", required=True)
+    cr.add_argument("-name", required=True)
+    cl = cfgsub.add_parser("list")
+    cl.add_argument("-kind", required=True)
+    cd = cfgsub.add_parser("delete")
+    cd.add_argument("-kind", required=True)
+    cd.add_argument("-name", required=True)
+    cfgp.set_defaults(fn=cmd_config)
+
+    resp = sub.add_parser("resource")
+    ressub = resp.add_subparsers(dest="resource_cmd", required=True)
+    ra = ressub.add_parser("apply")
+    ra.add_argument("-f", dest="file", required=True)
+    for nm in ("read", "delete"):
+        rp = ressub.add_parser(nm)
+        rp.add_argument("-type", required=True,
+                        help="group.version.kind")
+        rp.add_argument("name")
+    rl = ressub.add_parser("list")
+    rl.add_argument("-type", required=True)
+    resp.set_defaults(fn=cmd_resource)
+
+    mon = sub.add_parser("monitor")
+    mon.add_argument("-log-seconds", dest="log_seconds", type=float,
+                     default=2.0)
+    mon.set_defaults(fn=cmd_monitor)
+
+    mnt = sub.add_parser("maint")
+    mnt.add_argument("-enable", action="store_true")
+    mnt.add_argument("-disable", action="store_true")
+    mnt.add_argument("-service", default="")
+    mnt.add_argument("-reason", default="")
+    mnt.set_defaults(fn=cmd_maint)
+
+    fl = sub.add_parser("force-leave")
+    fl.add_argument("node")
+    fl.set_defaults(fn=cmd_force_leave)
+
+    sub.add_parser("reload").set_defaults(fn=cmd_reload)
+
+    fmtp = sub.add_parser("fmt")
+    fmtp.add_argument("file")
+    fmtp.add_argument("-write", action="store_true")
+    fmtp.set_defaults(fn=cmd_fmt)
+
     cn = sub.add_parser("connect")
     cnsub = cn.add_subparsers(dest="connect_cmd", required=True)
+    cca = cnsub.add_parser("ca")
+    ccasub = cca.add_subparsers(dest="connect_sub", required=True)
+    ccasub.add_parser("get-config")
+    ccs = ccasub.add_parser("set-config")
+    ccs.add_argument("-config-file", dest="config_file", default="-")
     envoy = cnsub.add_parser("envoy")
     envoy.add_argument("-sidecar-for", dest="sidecar_for", default="")
     envoy.add_argument("-proxy-id", dest="proxy_id", default="")
@@ -1119,6 +1517,10 @@ def build_parser() -> argparse.ArgumentParser:
     raftsub.add_parser("list-peers")
     rrm = raftsub.add_parser("remove-peer")
     rrm.add_argument("-address", required=True)
+    rtl = raftsub.add_parser("transfer-leader")
+    rtl.add_argument("-id", default="")
+    opsub.add_parser("usage")
+    opsub.add_parser("utilization")
     op.set_defaults(fn=cmd_operator)
 
     finish()

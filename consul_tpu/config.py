@@ -186,6 +186,10 @@ class RuntimeConfig:
     # always sit in "default").
     partition: str = "default"
 
+    # UI metrics-proxy backend (reference: ui_config.metrics_proxy →
+    # agent/uiserver/proxy.go); empty = proxy disabled (503)
+    ui_metrics_proxy_url: str = ""
+
     # Serve /v1/health/service reads from streaming materialized views
     # instead of proxied blocking queries (reference: UseStreamingBackend,
     # agent/submatview via the internal-gRPC subscribe service)

@@ -182,13 +182,39 @@ class CAManager:
 
     def __init__(self, server) -> None:
         self.server = server
-        # CA provider plugin (provider.go seam): built-in by default;
-        # vault/aws-pca keep the root key at the external authority
+        self._provider = None
+        self._provider_key: Optional[tuple] = None
+
+    @property
+    def provider(self):
+        """The active CA provider (provider.go seam). Resolved from the
+        replicated `connect-ca/config` entry when one exists (so
+        `connect ca set-config` takes effect on whichever server leads)
+        falling back to the agent config; rebuilt only when the
+        selection changes. Tests may inject via the setter."""
+        import json as _json
+
         from consul_tpu.connect.providers import make_provider
 
-        self.provider = make_provider(
-            getattr(server.config, "connect_ca_provider", "consul"),
-            getattr(server.config, "connect_ca_config", None))
+        if self._provider_key == ("__injected__",):
+            return self._provider
+        entry = self.server.state.raw_get("config_entries",
+                                          "connect-ca/config")
+        name = (entry or {}).get("Provider") \
+            or getattr(self.server.config, "connect_ca_provider", "consul")
+        conf = (entry or {}).get("Config") \
+            if entry else getattr(self.server.config,
+                                  "connect_ca_config", None)
+        key = (name, _json.dumps(conf or {}, sort_keys=True))
+        if self._provider_key != key:
+            self._provider = make_provider(name, conf)
+            self._provider_key = key
+        return self._provider
+
+    @provider.setter
+    def provider(self, p) -> None:
+        self._provider = p
+        self._provider_key = ("__injected__",)
 
     def active_root(self) -> Optional[dict[str, Any]]:
         entry = self.server.state.raw_get("config_entries",
@@ -209,12 +235,15 @@ class CAManager:
                                       "Root": root}})
         return self.active_root() or root
 
-    def sign(self, service: str, ttl_hours: float = 72.0
-             ) -> dict[str, Any]:
+    def sign(self, service: str, ttl_hours: float = 72.0,
+             root: Optional[dict[str, Any]] = None) -> dict[str, Any]:
         """Issue a leaf via the active provider (ConnectCA.Sign path).
         For the built-in provider the replicated root key signs
-        locally; external providers sign at the authority."""
-        root = self.initialize()
+        locally; external providers sign at the authority. Callers that
+        already hold the active root pass it to skip a second
+        initialize()."""
+        if root is None:
+            root = self.initialize()
         return self.provider.sign_leaf(
             root, service, self.server.config.datacenter,
             ttl_hours=ttl_hours)
@@ -238,9 +267,11 @@ class CAManager:
                 # bridge cert for agents still trusting only the old root
                 new["CrossSignedIntermediate"] = \
                     self.provider.cross_sign(old, new)
-            except NotImplementedError:
-                # aws-pca can't cross-sign (provider_aws.go): both
-                # roots stay served until old leaves expire
+            except (NotImplementedError, KeyError):
+                # aws-pca can't cross-sign (provider_aws.go), and a
+                # provider SWITCH can't bridge either (the old root's
+                # key lives with the old provider): both roots stay
+                # served until old leaves expire
                 pass
         from consul_tpu.state import MessageType
 

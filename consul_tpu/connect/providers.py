@@ -56,7 +56,8 @@ class ConsulCAProvider:
         self.config = config or {}
 
     def generate_root(self, trust_domain: str, dc: str) -> dict[str, Any]:
-        return _ca.generate_root(trust_domain, dc)
+        return {**_ca.generate_root(trust_domain, dc),
+                "Provider": self.name}
 
     def sign_leaf(self, root: dict[str, Any], service: str, dc: str,
                   ttl_hours: float = 72.0) -> dict[str, Any]:

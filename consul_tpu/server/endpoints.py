@@ -86,6 +86,7 @@ def register_endpoints(srv) -> None:
     e["Status.Leader"] = status_leader
     e["Status.Peers"] = status_peers
     e["Status.Ping"] = lambda args: "pong"
+    e["Status.RPCMethods"] = lambda args: sorted(e.keys())
     read("Status.RaftStats", lambda args: srv.raft.stats())
 
     # ---------------------------------------------------------- Catalog
@@ -779,7 +780,7 @@ def register_endpoints(srv) -> None:
         if not srv.is_leader():
             return srv._forward_to_leader("AutoEncrypt.Sign", args)
         root = srv.ca.initialize()
-        cert = srv.ca.sign(f"agent/{node}", ttl_hours=72.0)
+        cert = srv.ca.sign(f"agent/{node}", ttl_hours=72.0, root=root)
         return {"Cert": cert,
                 "Roots": [{"RootCert": r["RootCert"]}
                           for r in srv.ca.roots()]}
@@ -810,7 +811,7 @@ def register_endpoints(srv) -> None:
             return srv._forward_to_leader(
                 "AutoConfig.InitialConfiguration", args)
         root = srv.ca.initialize()
-        cert = srv.ca.sign(f"agent/{node}", ttl_hours=72.0)
+        cert = srv.ca.sign(f"agent/{node}", ttl_hours=72.0, root=root)
         return {
             "Config": {
                 "datacenter": srv.config.datacenter,
@@ -959,9 +960,54 @@ def register_endpoints(srv) -> None:
             "MaxQueryTime": args.get("MaxQueryTime", 0) or 30.0},
             timeout=120.0)
 
+    def peer_stream_list_exported(args):
+        """What THIS cluster exports to the asking peer (secret-auth);
+        feeds the peer's /v1/imported-services view."""
+        secret = args.get("Secret", "")
+        peer = next((p for p in state.raw_list("peerings")
+                     if p.get("Secret") == secret), None)
+        if peer is None:
+            raise RPCError("Permission denied: unknown peering secret")
+        exported = state.raw_get("config_entries",
+                                 "exported-services/default") or {}
+        out = []
+        for s in exported.get("Services") or []:
+            consumers = s.get("Consumers") or []
+            # no explicit consumer list = exported to every peer
+            if not consumers or any(
+                    c.get("Peer") in ("", "*", peer.get("Name"))
+                    for c in consumers):
+                out.append(s.get("Name", ""))
+        return {"Services": sorted(filter(None, out))}
+
+    def imported_services(args):
+        """Services available here FROM peers (/v1/imported-services —
+        partition_exports semantics): ask each active peering what it
+        exports to us; unreachable peers are skipped, not fatal."""
+        require(authz(args).operator_read(), "operator read")
+        out = []
+        for p in state.raw_list("peerings"):
+            addrs = p.get("ServerAddresses") or []
+            if p.get("State") != "ACTIVE" or not addrs:
+                continue
+            try:
+                res = srv.pool.call(addrs[0], "PeerStream.ListExported",
+                                    {"Secret": p.get("Secret", "")},
+                                    timeout=10.0)
+            except (OSError, RPCError):
+                # OSError covers timeouts/gaierror too, not just
+                # refused conns — an unreachable peer is skipped
+                continue
+            for svc in res.get("Services") or []:
+                out.append({"Service": svc, "Peer": p.get("Name", "")})
+        return {"Services": sorted(out, key=lambda e: (e["Peer"],
+                                                       e["Service"]))}
+
     write("Peering.GenerateToken", peering_generate_token)
     write("Peering.Establish", peering_establish)
     write("Peering.Delete", peering_delete)
+    read("PeerStream.ListExported", peer_stream_list_exported)
+    read("Internal.ImportedServices", imported_services)
     # reads of the peering table go through the leader so a token minted
     # moments ago is always visible (no stale-follower rejections)
     read("Peering.List", peering_list)
@@ -1145,7 +1191,7 @@ def register_endpoints(srv) -> None:
         if not srv.is_leader():
             return srv._forward_to_leader("ConnectCA.Sign", args)
         root = srv.ca.initialize()
-        leaf = srv.ca.sign(service)
+        leaf = srv.ca.sign(service, root=root)
         if root.get("CrossSignedIntermediate"):
             # present the rotation bridge with the leaf so old-root
             # verifiers can build a path to the new root
@@ -1160,9 +1206,52 @@ def register_endpoints(srv) -> None:
         new = srv.ca.rotate()
         return {k: v for k, v in new.items() if k != "PrivateKey"}
 
+    def ca_get_config(args):
+        """connect ca get-config (connect_ca_endpoint.go
+        ConfigurationGet): provider name + user config + provider
+        state — never key material. Mirrors CAManager.provider's
+        resolution exactly: once an entry exists, ITS Config is the
+        truth even when empty (provider defaults), not the agent file."""
+        require(authz(args).operator_read(), "operator read")
+        entry = state.raw_get("config_entries", "connect-ca/config")
+        if entry is not None:
+            provider, config = entry.get("Provider") or "consul", \
+                entry.get("Config") or {}
+        else:
+            provider = srv.config.connect_ca_provider
+            config = dict(srv.config.connect_ca_config)
+        return {"Provider": provider, "Config": config,
+                "State": srv.ca.provider.state()}
+
+    def ca_set_config(args):
+        """connect ca set-config: replicated provider selection — every
+        server's CAManager re-resolves its provider from this entry.
+        Changing the provider ROTATES the root so the active root and
+        the signing provider always match (the old provider's root key
+        can't sign for the new one — leader_connect_ca.go
+        UpdateConfiguration regenerates via the new provider)."""
+        require(authz(args).operator_write(), "operator write")
+        provider = args.get("Provider") or "consul"
+        from consul_tpu.connect.providers import PROVIDERS
+
+        if provider not in PROVIDERS:
+            raise RPCError(f"unknown CA provider {provider!r}")
+        out = srv.forward_or_apply(MessageType.CONFIG_ENTRY, {
+            "Op": "upsert", "Entry": {
+                "Kind": "connect-ca", "Name": "config",
+                "Provider": provider,
+                "Config": args.get("Config") or {}}})
+        active = srv.ca.active_root()
+        if active is not None \
+                and (active.get("Provider") or "consul") != provider:
+            srv.ca.rotate()
+        return out
+
     read("ConnectCA.Roots", ca_roots)
     e["ConnectCA.Sign"] = ca_sign
     e["ConnectCA.Rotate"] = ca_rotate
+    read("ConnectCA.ConfigurationGet", ca_get_config)
+    write("ConnectCA.ConfigurationSet", ca_set_config)
 
     def intention_apply(args):
         i = args.get("Intention") or {}

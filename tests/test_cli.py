@@ -1,0 +1,166 @@
+"""CLI long tail: intention/config/resource/maint/monitor/acl extras/
+operator usage/connect ca — driven in-process through cli.main()
+against a live dev agent (the reference's pattern of CLI tests over a
+TestAgent)."""
+
+import json
+
+import pytest
+
+from consul_tpu import cli as cli_mod
+from consul_tpu.agent import Agent
+from consul_tpu.config import load
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(load(dev=True, overrides={"node_name": "cliagent"}))
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="leadership")
+    yield a
+    a.shutdown()
+
+
+def run(agent, *argv):
+    import io
+    import sys
+
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        rc = cli_mod.main(["-http-addr", agent.http.addr, *argv])
+    finally:
+        sys.stdout = old
+    return rc, buf.getvalue()
+
+
+def test_intention_lifecycle(agent):
+    rc, _ = run(agent, "intention", "create", "web", "db")
+    assert rc == 0
+    rc, out = run(agent, "intention", "list")
+    assert rc == 0 and "web" in out and "db" in out
+    rc, out = run(agent, "intention", "check", "web", "db")
+    assert rc == 0 and "Allowed" in out
+    rc, out = run(agent, "intention", "get", "web", "db")
+    assert rc == 0 and json.loads(out)["Action"] == "allow"
+    rc, _ = run(agent, "intention", "delete", "web", "db")
+    assert rc == 0
+    rc, _ = run(agent, "intention", "check", "web", "db")
+    # default-allow dev agent: still allowed after delete
+    assert rc == 0
+
+
+def test_config_write_read_list_delete(agent, tmp_path):
+    f = tmp_path / "sd.json"
+    f.write_text(json.dumps({"Kind": "service-defaults", "Name": "clisvc",
+                             "Protocol": "http"}))
+    rc, out = run(agent, "config", "write", str(f))
+    assert rc == 0 and "service-defaults/clisvc" in out
+    rc, out = run(agent, "config", "read", "-kind", "service-defaults",
+                  "-name", "clisvc")
+    assert rc == 0 and json.loads(out)["Protocol"] == "http"
+    rc, out = run(agent, "config", "list", "-kind", "service-defaults")
+    assert rc == 0 and "clisvc" in out
+    rc, _ = run(agent, "config", "delete", "-kind", "service-defaults",
+                "-name", "clisvc")
+    assert rc == 0
+
+
+def test_resource_apply_read_list_delete(agent, tmp_path):
+    f = tmp_path / "res.json"
+    f.write_text(json.dumps({
+        "Id": {"Type": {"Group": "demo", "GroupVersion": "v1",
+                        "Kind": "Thing"}, "Name": "one"},
+        "Data": {"size": 3}}))
+    rc, out = run(agent, "resource", "apply", "-f", str(f))
+    assert rc == 0 and json.loads(out)["Data"] == {"size": 3}
+    rc, out = run(agent, "resource", "read", "-type", "demo.v1.Thing",
+                  "one")
+    assert rc == 0 and json.loads(out)["Id"]["Name"] == "one"
+    rc, out = run(agent, "resource", "list", "-type", "demo.v1.Thing")
+    assert rc == 0 and "one" in out
+    rc, _ = run(agent, "resource", "delete", "-type", "demo.v1.Thing",
+                "one")
+    assert rc == 0
+    rc, _ = run(agent, "resource", "read", "-type", "demo.v1.Thing",
+                "one")
+    assert rc == 1
+
+
+def test_maint_and_reload(agent):
+    rc, out = run(agent, "maint", "-enable", "-reason", "upgrading")
+    assert rc == 0 and "enabled" in out
+    rc, out = run(agent, "maint", "-disable")
+    assert rc == 0 and "disabled" in out
+    rc, out = run(agent, "reload")
+    assert rc == 0 and "reload" in out.lower()
+
+
+def test_monitor_window(agent):
+    rc, _ = run(agent, "monitor", "-log-seconds", "0.2")
+    assert rc == 0
+
+
+def test_acl_extras(agent):
+    rc, out = run(agent, "acl", "templated-policy", "list")
+    assert rc == 0 and "builtin/service" in out
+    rc, out = run(agent, "acl", "templated-policy", "preview",
+                  "-name", "builtin/node", "-var-name", "n1")
+    assert rc == 0 and "n1" in out
+    rc, _ = run(agent, "acl", "set-agent-token", "agent", "cli-tok")
+    assert rc == 0
+    assert agent.config.acl_agent_token == "cli-tok"
+    agent.update_token("agent", "")
+
+
+def test_operator_usage_and_utilization(agent):
+    rc, out = run(agent, "operator", "usage")
+    assert rc == 0 and "nodes" in out.lower()
+    rc, out = run(agent, "operator", "utilization")
+    assert rc == 0 and "Usage" in out
+
+
+def test_connect_ca_config_roundtrip(agent):
+    rc, out = run(agent, "connect", "ca", "get-config")
+    assert rc == 0
+    assert json.loads(out)["Provider"] == "consul"
+
+
+def test_services_export_flow(agent, tmp_path):
+    f = tmp_path / "svc.json"
+    f.write_text(json.dumps({"name": "exp-svc", "port": 123}))
+    rc, _ = run(agent, "services", "register", str(f))
+    assert rc == 0
+    rc, _ = run(agent, "services", "export", "-name", "exp-svc",
+                "-consumer-peers", "other-dc")
+    assert rc == 0
+    rc, out = run(agent, "services", "exported-services")
+    assert rc == 0 and "exp-svc" in out
+    rc, out = run(agent, "peering", "exported-services")
+    assert rc == 0 and "exp-svc" in out
+    rc, out = run(agent, "services", "imported-services")
+    assert rc == 0  # no peers: empty list
+
+
+def test_fmt(tmp_path):
+    f = tmp_path / "cfg.json"
+    f.write_text('{"b":1,"a":{"z":2}}')
+    rc = cli_mod.main(["fmt", "-write", str(f)])
+    assert rc == 0
+    assert json.loads(f.read_text()) == {"b": 1, "a": {"z": 2}}
+    assert f.read_text().startswith("{\n")
+
+
+def test_snapshot_decode(agent, tmp_path):
+    f = tmp_path / "snap.bin"
+    rc, _ = run(agent, "kv", "put", "decode/me", "x")
+    assert rc == 0
+    rc, _ = run(agent, "snapshot", "save", str(f))
+    assert rc == 0
+    rc, out = run(agent, "snapshot", "decode", str(f))
+    assert rc == 0
+    tables = {json.loads(ln)["Table"] for ln in out.splitlines() if ln}
+    assert "kv" in tables

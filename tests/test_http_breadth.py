@@ -144,3 +144,124 @@ def test_misc_breadth(agent, client):
     assert isinstance(ns["Services"], list)
     ig = client.get("/v1/health/ingress/hweb")
     assert isinstance(ig, list)
+
+
+# ---------------------------- round-2 long-tail additions (this file's
+# sibling routes: by-name ACL reads, templated previews, agent token +
+# single-service reads, metrics stream, UI detail/gateway views,
+# rpc-methods introspection, utilization)
+
+def test_acl_reads_by_name(agent, client):
+    pol = client.put("/v1/acl/policy", {
+        "Name": "by-name-pol", "Rules": json.dumps(
+            {"key_prefix": {"": "read"}})})
+    code, body = _status(agent, "/v1/acl/policy/name/by-name-pol")
+    assert code == 200 and json.loads(body)["ID"] == pol["ID"]
+    code, _ = _status(agent, "/v1/acl/policy/name/ghost")
+    assert code == 404
+    role = client.put("/v1/acl/role", {"Name": "by-name-role"})
+    code, body = _status(agent, "/v1/acl/role/name/by-name-role")
+    assert code == 200 and json.loads(body)["ID"] == role["ID"]
+
+
+def test_templated_policy_preview(agent):
+    req = urllib.request.Request(
+        f"http://{agent.http.addr}/v1/acl/templated-policy/preview/"
+        "builtin%2Fservice",
+        data=json.dumps({"Name": "api"}).encode(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    rules = json.loads(out["Rules"])
+    assert rules["service"]["api"] == "write"
+    assert rules["service"]["api-sidecar-proxy"] == "write"
+
+
+def test_agent_token_update(agent):
+    req = urllib.request.Request(
+        f"http://{agent.http.addr}/v1/agent/token/agent",
+        data=json.dumps({"Token": "tok-123"}).encode(), method="PUT")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+    assert agent.config.acl_agent_token == "tok-123"
+    code, _ = _status(agent, "/v1/agent/token/bogus", method="PUT")
+    assert code == 404
+    agent.update_token("agent", "")  # restore
+
+
+def test_agent_single_service_read(agent, client):
+    client.service_register({"Name": "solo", "ID": "solo-1", "Port": 7})
+    code, body = _status(agent, "/v1/agent/service/solo-1")
+    d = json.loads(body)
+    assert code == 200 and d["Service"] == "solo" and d["ContentHash"]
+    code, _ = _status(agent, "/v1/agent/service/missing-id")
+    assert code == 404
+
+
+def test_agent_metrics_stream(agent):
+    with urllib.request.urlopen(
+            f"http://{agent.http.addr}/v1/agent/metrics/stream"
+            "?intervals=2&interval=0.05", timeout=10) as r:
+        lines = [ln for ln in r.read().split(b"\n") if ln]
+    assert len(lines) == 2
+    for ln in lines:
+        assert "Gauges" in json.loads(ln) or json.loads(ln) is not None
+
+
+def test_internal_ui_node_detail(agent, client):
+    client.service_register({"Name": "uisvc", "Port": 9})
+    node = agent.config.node_name
+    # serf->catalog reconcile and anti-entropy are async; wait for both
+    wait_for(lambda: _status(
+        agent, f"/v1/internal/ui/node/{node}")[0] == 200,
+        what="node in catalog")
+    wait_for(lambda: any(
+        s["Service"] == "uisvc" for s in json.loads(_status(
+            agent, f"/v1/internal/ui/node/{node}")[1])["Services"]),
+        what="service synced")
+    code, body = _status(agent, f"/v1/internal/ui/node/{node}")
+    d = json.loads(body)
+    assert code == 200 and d["Node"] == node
+    assert any(s["Service"] == "uisvc" for s in d["Services"])
+    assert isinstance(d["Checks"], list)
+    code, _ = _status(agent, "/v1/internal/ui/node/ghost-node")
+    assert code == 404
+
+
+def test_gateway_ui_views(agent, client):
+    client.put("/v1/config", {
+        "Kind": "ingress-gateway", "Name": "igw-ui",
+        "Listeners": [{"Port": 8080, "Protocol": "http",
+                       "Services": [{"Name": "uisvc"}]}]})
+    code, body = _status(agent,
+                         "/v1/internal/ui/gateway-services-nodes/igw-ui")
+    assert code == 200
+    names = {e["Service"]["Service"] for e in json.loads(body)}
+    assert "uisvc" in names
+    client.put("/v1/connect/intentions", {
+        "SourceName": "frontend", "DestinationName": "uisvc",
+        "Action": "allow"})
+    code, body = _status(agent,
+                         "/v1/internal/ui/gateway-intentions/igw-ui")
+    assert code == 200
+    assert any(i["DestinationName"] == "uisvc"
+               for i in json.loads(body))
+
+
+def test_rpc_methods_and_utilization(agent):
+    code, body = _status(agent, "/v1/internal/rpc/methods")
+    methods = json.loads(body)
+    assert code == 200 and "KVS.Apply" in methods \
+        and "Resource.Write" in methods
+    code, body = _status(agent, "/v1/operator/utilization")
+    d = json.loads(body)
+    assert code == 200 and "Usage" in d and d["Version"]
+
+
+def test_metrics_proxy_unconfigured_503(agent):
+    code, _ = _status(agent, "/v1/internal/ui/metrics-proxy/api/v1/query")
+    assert code == 503
+
+
+def test_imported_services_empty_without_peers(agent):
+    code, body = _status(agent, "/v1/imported-services")
+    assert code == 200 and json.loads(body) == []

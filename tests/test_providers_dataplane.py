@@ -243,3 +243,35 @@ def test_dataplane_bootstrap_unknown_service(dp_agent):
         fn({"node_name": "dp-node", "proxy_id": "ghost"}, timeout=10)
     assert ei.value.code() == grpc.StatusCode.NOT_FOUND
     ch.close()
+
+
+def test_provider_switch_rotates_root():
+    """connect ca set-config with a DIFFERENT provider must rotate the
+    root via the new provider, so signing keeps working (the old
+    provider's key can't sign for the new one)."""
+    from consul_tpu.connect.providers import VaultCAProvider
+
+    cfg = load(dev=True, overrides={
+        "node_name": "caswitch", "server": True, "bootstrap": True,
+        "connect": {"ca_provider": "vault"}})
+    srv = Server(cfg)
+    srv.ca.provider = VaultCAProvider({}, client=FakeVault())
+    srv.start()
+    try:
+        wait_for(srv.is_leader, what="leadership")
+        leaf1 = srv.handle_rpc("ConnectCA.Sign", {"Service": "a"}, "test")
+        assert "PrivateKey" not in srv.ca.active_root()
+        # switch to the built-in provider (clears the injected one)
+        srv.ca._provider_key = None
+        srv.handle_rpc("ConnectCA.ConfigurationSet",
+                       {"Provider": "consul"}, "test")
+        root = srv.ca.active_root()
+        assert root["Provider"] == "consul" and "PrivateKey" in root
+        # signing works against the NEW root
+        leaf2 = srv.handle_rpc("ConnectCA.Sign", {"Service": "b"}, "test")
+        assert ca_mod.verify_leaf(root["RootCert"], leaf2["CertPEM"])
+        assert leaf1["CertPEM"] != leaf2["CertPEM"]
+        cfg_out = srv.handle_rpc("ConnectCA.ConfigurationGet", {}, "test")
+        assert cfg_out["Provider"] == "consul"
+    finally:
+        srv.shutdown()
