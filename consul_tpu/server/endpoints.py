@@ -872,12 +872,17 @@ def register_endpoints(srv) -> None:
         secret = token.get("Secret", "")
         if not addr or not secret:
             raise RPCError("peering token missing address or secret")
-        # handshake: prove the secret to the acceptor
+        # handshake: prove the secret to the acceptor; CA roots ride
+        # both directions so each side stores the other's TRUST BUNDLE
+        # (pbpeering PeeringTrustBundle — what cross-cluster mTLS
+        # verifies against)
+        own_roots = [r.get("RootCert", "") for r in srv.ca.roots()]
         try:
             res = srv.pool.call(addr, "PeerStream.Open", {
                 "Secret": secret,
                 "PeerName": srv.config.datacenter,
-                "ServerAddresses": [srv.rpc.addr]})
+                "ServerAddresses": [srv.rpc.addr],
+                "CARoots": own_roots})
         except ConnectionError as ex:
             raise RPCError(f"failed to reach peer: {ex}") from ex
         if not res.get("OK"):
@@ -885,10 +890,16 @@ def register_endpoints(srv) -> None:
         srv.forward_or_apply(MessageType.PEERING, {"Op": "set", "Peering": {
             "Name": peer_name, "State": "ACTIVE", "Secret": secret,
             "ServerAddresses": [addr], "Dialer": True}})
+        if res.get("CARoots"):
+            srv.forward_or_apply(MessageType.PEERING, {
+                "Op": "set_trust_bundle", "Peer": peer_name,
+                "RootPEMs": res["CARoots"],
+                "TrustDomain": res.get("TrustDomain", "")})
         return True
 
     def peer_stream_open(args):
-        """Acceptor side of establish: validate the secret, activate."""
+        """Acceptor side of establish: validate the secret, activate,
+        exchange trust bundles."""
         secret = args.get("Secret", "")
         match = next((p for p in state.raw_list("peerings")
                       if p.get("Secret") == secret
@@ -898,7 +909,14 @@ def register_endpoints(srv) -> None:
         srv.forward_or_apply(MessageType.PEERING, {"Op": "set", "Peering": {
             **match, "State": "ACTIVE",
             "ServerAddresses": args.get("ServerAddresses") or []}})
-        return {"OK": True}
+        if args.get("CARoots"):
+            srv.forward_or_apply(MessageType.PEERING, {
+                "Op": "set_trust_bundle", "Peer": match.get("Name", ""),
+                "RootPEMs": args["CARoots"],
+                "TrustDomain": ""})
+        return {"OK": True,
+                "CARoots": [r.get("RootCert", "")
+                            for r in srv.ca.roots()]}
 
     def _peer_by_name(name: str):
         return state.raw_get("peerings", name)
@@ -1006,8 +1024,36 @@ def register_endpoints(srv) -> None:
     write("Peering.GenerateToken", peering_generate_token)
     write("Peering.Establish", peering_establish)
     write("Peering.Delete", peering_delete)
+    def trust_bundles(args):
+        """Peer trust bundles (pbpeering TrustBundleList): the CA roots
+        cross-cluster mTLS verifies against, per peer."""
+        require(authz(args).service_read(args.get("ServiceName", "")
+                                         or "*"), "service read")
+        bundles = state.raw_list("peering_trust_bundles")
+        peer = args.get("Peer", "")
+        if peer:
+            bundles = [b for b in bundles if b.get("Peer") == peer]
+        return {"Bundles": bundles}
+
+    def system_metadata_get(args):
+        require(authz(args).operator_read(), "operator read")
+        key = args.get("Key", "")
+        if key:
+            entry = state.raw_get("system_metadata", key)
+            return {"Entries": [entry] if entry else []}
+        return {"Entries": state.raw_list("system_metadata")}
+
+    def system_metadata_set(args):
+        require(authz(args).operator_write(), "operator write")
+        return srv.forward_or_apply(MessageType.SYSTEM_METADATA, {
+            "Op": args.get("Op", "set"), "Key": args.get("Key", ""),
+            "Value": args.get("Value", "")})
+
     read("PeerStream.ListExported", peer_stream_list_exported)
     read("Internal.ImportedServices", imported_services)
+    read("Internal.TrustBundles", trust_bundles)
+    read("Internal.SystemMetadataGet", system_metadata_get)
+    write("Internal.SystemMetadataSet", system_metadata_set)
     # reads of the peering table go through the leader so a token minted
     # moments ago is always visible (no stale-follower rejections)
     read("Peering.List", peering_list)

@@ -631,6 +631,7 @@ class Server:
             self._was_leader = True
             self._full_reconcile()
             self._ensure_initial_management_token()
+            self._write_system_metadata()
         # raft membership follows serf server membership (autopilot-lite)
         servers = {s["rpc_addr"] for s in self._servers() if s["rpc_addr"]}
         for addr in servers - self.raft.peers:
@@ -900,6 +901,25 @@ class Server:
         self.metrics.gauge("state.sessions", counts["sessions"])
         self.metrics.gauge("raft.applied_index", self.raft.last_applied)
         self.metrics.gauge("serf.lan.members", len(self.serf.members()))
+
+    def _write_system_metadata(self) -> None:
+        """Leader-written cluster markers (system_metadata.go: the
+        reference records e.g. intention-format and virtual-IP feature
+        flags so every server agrees on capabilities)."""
+        from consul_tpu.state.fsm import MessageType as MT
+        from consul_tpu.version import __version__
+
+        for key, value in (("consul-version", __version__),
+                           ("intention-format", "config-entry"),
+                           ("virtual-ips", "enabled")):
+            cur = self.state.raw_get("system_metadata", key)
+            if cur is None or cur.get("Value") != value:
+                try:
+                    self.raft.apply(encode_command(MT.SYSTEM_METADATA, {
+                        "Op": "set", "Key": key, "Value": value}))
+                except Exception as e:  # noqa: BLE001
+                    self.log.debug("system metadata write: %s", e)
+                    return
 
     def _ensure_initial_management_token(self) -> None:
         tok = self.config.acl_initial_management_token

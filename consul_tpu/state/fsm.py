@@ -68,6 +68,7 @@ class FSM:
             MessageType.INTENTION: self._apply_intention,
             MessageType.SNAPSHOT_RESTORE: self._apply_snapshot_restore,
             MessageType.PEERING: self._apply_peering,
+            MessageType.SYSTEM_METADATA: self._apply_system_metadata,
             MessageType.ACL_ROLE: self._apply_acl_role,
             MessageType.ACL_AUTH_METHOD: self._apply_acl_auth_method,
             MessageType.ACL_BINDING_RULE: self._apply_acl_binding_rule,
@@ -306,9 +307,31 @@ class FSM:
                             b.get("Op", "set"), fs.get("Datacenter"), fs)
 
     def _apply_peering(self, b: dict[str, Any], idx: int) -> Any:
+        """Peering CRUD + trust-bundle writes (the reference splits
+        these across 6 peering message types, commands_ce.go; one type
+        with ops here). Deleting a peering drops its trust bundle too —
+        a dangling bundle would keep authorizing a severed peer."""
+        op = b.get("Op", "set")
         p = b.get("Peering") or {}
-        return self._raw_op("peerings", ("set",), b.get("Op", "set"),
-                            p.get("Name"), p)
+        if op == "set_trust_bundle":
+            return self.store.raw_upsert(
+                "peering_trust_bundles", b.get("Peer", ""),
+                {"Peer": b.get("Peer", ""),
+                 "RootPEMs": b.get("RootPEMs") or [],
+                 "TrustDomain": b.get("TrustDomain", "")})
+        if op == "delete":
+            self.store.raw_delete("peering_trust_bundles",
+                                  p.get("Name"))
+        return self._raw_op("peerings", ("set",), op, p.get("Name"), p)
+
+    def _apply_system_metadata(self, b: dict[str, Any], idx: int) -> Any:
+        """Cluster-wide internal key/value metadata
+        (agent/consul/system_metadata.go; SystemMetadataRequestType):
+        leader-written feature/version markers every replica agrees on."""
+        return self._raw_op("system_metadata", ("set",),
+                            b.get("Op", "set"), b.get("Key", ""),
+                            {"Key": b.get("Key", ""),
+                             "Value": b.get("Value", "")})
 
     def _raw_op(self, table: str, write_ops: tuple[str, ...], op: str,
                 key: Any, value: Any) -> Any:

@@ -36,7 +36,8 @@ from consul_tpu.types import (CheckStatus, Coordinate, HealthCheck, KVEntry,
 RAW_TABLES = ("prepared_queries", "acl_tokens", "acl_policies",
               "config_entries", "intentions", "peerings", "acl_roles",
               "acl_auth_methods", "acl_binding_rules",
-              "federation_states")
+              "federation_states", "system_metadata",
+              "peering_trust_bundles")
 TABLES = ("nodes", "services", "checks", "kv", "sessions",
           "coordinates", "resources") + RAW_TABLES
 

@@ -6,6 +6,7 @@ import pytest
 from consul_tpu.agent import Agent
 from consul_tpu.api import APIError, ConsulClient
 from consul_tpu.config import load
+from consul_tpu.server import Server
 
 from helpers import wait_for  # noqa: E402
 
@@ -88,3 +89,65 @@ def test_peering_delete(clusters):
     assert all(p["Name"] != "alpha" for p in cb.get("/v1/peerings"))
     with pytest.raises(APIError, match="unknown peer"):
         cb.get("/v1/health/service/billing", peer="alpha")
+
+
+def test_trust_bundle_exchange_and_system_metadata():
+    """Establish exchanges CA trust bundles both ways
+    (pbpeering PeeringTrustBundle); leaders record system metadata
+    markers (system_metadata.go)."""
+    import time
+
+    from helpers import wait_for
+
+    a = Server(load(dev=True, overrides={
+        "node_name": "tb-a", "server": True, "bootstrap": True,
+        "datacenter": "dc-a"}))
+    b = Server(load(dev=True, overrides={
+        "node_name": "tb-b", "server": True, "bootstrap": True,
+        "datacenter": "dc-b"}))
+    for s in (a, b):
+        s.start()
+    try:
+        wait_for(lambda: a.is_leader() and b.is_leader(),
+                 what="both leaders")
+        # CAs initialized so roots exist to exchange
+        a.ca.initialize()
+        b.ca.initialize()
+        tok = a.handle_rpc("Peering.GenerateToken",
+                           {"PeerName": "dc-b"}, "test")
+        b.handle_rpc("Peering.Establish", {
+            "PeerName": "dc-a",
+            "PeeringToken": tok["PeeringToken"]}, "test")
+        # dialer (b) stored acceptor's bundle, acceptor (a) stored
+        # dialer's
+        wait_for(lambda: b.handle_rpc(
+            "Internal.TrustBundles", {}, "test")["Bundles"],
+            what="dialer trust bundle")
+        bundles_b = b.handle_rpc("Internal.TrustBundles", {},
+                                 "test")["Bundles"]
+        assert bundles_b[0]["Peer"] == "dc-a"
+        assert "BEGIN CERTIFICATE" in bundles_b[0]["RootPEMs"][0]
+        wait_for(lambda: a.handle_rpc(
+            "Internal.TrustBundles", {}, "test")["Bundles"],
+            what="acceptor trust bundle")
+        bundles_a = a.handle_rpc("Internal.TrustBundles", {},
+                                 "test")["Bundles"]
+        assert bundles_a[0]["Peer"] == "dc-b"
+        # the exchanged bundle IS the other side's active root
+        assert bundles_b[0]["RootPEMs"][0] == \
+            a.ca.active_root()["RootCert"]
+        # deleting the peering drops its bundle (no dangling trust)
+        b.handle_rpc("Peering.Delete", {"Name": "dc-a"}, "test")
+        wait_for(lambda: not b.handle_rpc(
+            "Internal.TrustBundles", {}, "test")["Bundles"],
+            what="bundle removed with peering")
+        # leader-written system metadata markers
+        wait_for(lambda: a.handle_rpc(
+            "Internal.SystemMetadataGet", {"Key": "consul-version"},
+            "test")["Entries"], what="system metadata")
+        entries = {e["Key"]: e["Value"] for e in a.handle_rpc(
+            "Internal.SystemMetadataGet", {}, "test")["Entries"]}
+        assert entries["intention-format"] == "config-entry"
+    finally:
+        a.shutdown()
+        b.shutdown()
