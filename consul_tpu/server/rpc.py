@@ -217,6 +217,12 @@ class RPCServer:
         self.gossip_ingest = None
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        from concurrent.futures import ThreadPoolExecutor
+
+        # shared pool for NON-blocking mux requests (blocking queries
+        # spawn their own threads — they'd starve a fixed pool)
+        self._workers = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="rpc-worker")
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(
@@ -233,6 +239,7 @@ class RPCServer:
     def shutdown(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        self._workers.shutdown(wait=False, cancel_futures=True)
         with self._conns_lock:
             conns, self._conns = set(self._conns), set()
         for sock in conns:
@@ -332,7 +339,9 @@ class RPCServer:
                                  closed, cancels, safe_write, release)
                 continue
 
-            def run(sid=sid, method=method, args=req.get("args") or {}):
+            req_args = req.get("args") or {}
+
+            def run(sid=sid, method=method, args=req_args):
                 start = telemetry.time_now()
                 try:
                     safe_write({"sid": sid,
@@ -349,8 +358,17 @@ class RPCServer:
                     self.metrics.measure_since(
                         "rpc.request", start, {"method": method})
 
-            threading.Thread(target=run, daemon=True,
-                             name=f"mux-{src}-{sid}").start()
+            # blocking queries park for up to MaxQueryTime (600s) — they
+            # get a dedicated thread. Everything else runs on the shared
+            # worker pool: thread spawn was ~half the per-request cost
+            # (the reference parks goroutines, which are free; Python
+            # threads are not)
+            if req_args.get("MinQueryIndex") or \
+                    req_args.get("MaxQueryTime"):
+                threading.Thread(target=run, daemon=True,
+                                 name=f"mux-{src}-{sid}").start()
+            else:
+                self._workers.submit(run)
 
     def _run_stream(self, sid: int, method: str, args: dict[str, Any],
                     src: str, closed, cancels,

@@ -29,6 +29,22 @@ Modeling approach (mean-field rumor-centric SWIM):
 The same ``GossipConfig`` drives this backend and the host engine
 (consul_tpu.gossip), which is the behavioral-conformance seam (like the
 reference's internal/storage/conformance shared suite).
+
+ENVELOPE — what this model can and cannot answer:
+
+* CAN: aggregate failure-detector statistics under matched configs —
+  false-positive rate, detection latency, suspicion counts, rumor
+  propagation curves, churn/partition-heal dynamics — at populations the
+  host engine can't touch (validated within the BASELINE 1%-FP criterion
+  against the host engine at n≤100, tests/test_conformance.py).
+* CANNOT: per-node membership-view divergence, rumor ORDERING between
+  concurrent updates, or push/pull repair of inconsistent views — there
+  are no per-viewer views (O(N) rumor state replaces the O(N²) matrix).
+  Questions of that shape belong to the host engine.
+* Known bias: FP is underestimated at low loss (<~40%): the mean-field
+  refutation race resolves by hearing probability, not socket timing.
+  Measured at 30% loss: 0 vs the host's 2.6e-4 per node-round — inside
+  the criterion, but directionally low, not noise.
 """
 
 from consul_tpu.sim.params import SimParams
