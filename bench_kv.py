@@ -63,10 +63,15 @@ def run_workload(name, fn, n_threads, n_ops, baseline):
     print(f"  {name}: {rps:,.0f} req/s  p50={p50:.1f}ms p99={p99:.1f}ms "
           f"({total} ops, {errors[0]} errors, {wall:.1f}s)",
           file=sys.stderr)
+    import os as _os
+
     return {"metric": name, "value": round(rps, 1), "unit": "req/s",
             "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
             "errors": errors[0],
-            "vs_baseline": round(rps / baseline, 3)}
+            "vs_baseline": round(rps / baseline, 3),
+            # the baseline ran on FOUR 8-core machines; this entire
+            # cluster + all clients share this host's cores
+            "host_cores": _os.cpu_count()}
 
 
 def main() -> None:

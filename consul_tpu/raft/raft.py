@@ -105,6 +105,12 @@ class RaftNode:
         # threads parked on this condition; apply() just appends+notifies
         self._repl_cv = threading.Condition(self._lock)
         self._replicators: dict[str, tuple[int, threading.Thread]] = {}
+        # async FSM applier (real clock only): commit acknowledgement
+        # must not wait on FSM apply — appends reply as soon as the log
+        # is durable, and the applier drains commit_index → last_applied
+        # off the replication hot path (hashicorp/raft runFSM)
+        self._apply_cv = threading.Condition(self._lock)
+        self._applier: Optional[threading.Thread] = None
 
         # restore FSM from snapshot if present
         if self.store.snapshot_data is not None and restore_fn is not None:
@@ -126,6 +132,7 @@ class RaftNode:
             self.store.close()
             self._applied_cv.notify_all()
             self._repl_cv.notify_all()
+            self._apply_cv.notify_all()
 
     # ------------------------------------------------------------- surface
 
@@ -554,6 +561,36 @@ class RaftNode:
             self._apply_committed()
 
     def _apply_committed(self) -> None:
+        """Bring last_applied up to commit_index. Under a SimClock this
+        runs inline (deterministic tests observe state synchronously);
+        real clocks hand the work to the applier thread so the caller —
+        an append handler or replicator — replies without paying FSM
+        cost (the apply() waiter is woken by the applier instead)."""
+        if not isinstance(self.clock, SimClock) \
+                and self.role != Role.LEADER:
+            if self._applier is None or not self._applier.is_alive():
+                self._applier = threading.Thread(
+                    target=self._applier_loop, daemon=True,
+                    name=f"raft-apply-{self.id}")
+                self._applier.start()
+            self._apply_cv.notify_all()
+            return
+        # leader (and SimClock) applies inline: the apply() caller is
+        # already parked on _applied_cv — an applier-thread hop would
+        # only add a wakeup to the latency path
+        self._apply_committed_locked()
+
+    def _applier_loop(self) -> None:
+        while True:
+            with self._lock:
+                while self.last_applied >= self.commit_index \
+                        and not self._stopped:
+                    self._apply_cv.wait(0.5)
+                if self._stopped:
+                    return
+                self._apply_committed_locked()
+
+    def _apply_committed_locked(self) -> None:
         while self.last_applied < self.commit_index:
             idx = self.last_applied + 1
             e = self.store.entry(idx)
