@@ -296,7 +296,17 @@ class RaftNode:
             return
         self._start_election()
 
-    def _start_election(self) -> None:
+    def _start_election(self, bypass_prevote: bool = False) -> None:
+        # Pre-vote first (thesis §9.6 / hashicorp/raft pre-vote): ask
+        # "WOULD you vote for me at term+1" without touching our own
+        # term. A partitioned node that keeps timing out no longer
+        # inflates its term unboundedly and forces a disruption when it
+        # heals — peers with a live leader refuse pre-votes. Leadership
+        # transfer bypasses it (the leader ASKED us to disturb it).
+        if not bypass_prevote and not self._pre_vote_round():
+            with self._lock:
+                self._reset_election_timer()
+            return
         # RPCs happen OUTSIDE the lock (a simultaneous election on a real
         # thread must not AB-BA deadlock two nodes' locks)
         with self._lock:
@@ -355,6 +365,69 @@ class RaftNode:
             for t in threads:
                 t.join(timeout=self.election_timeout)
         try_win()
+
+    def _pre_vote_round(self) -> bool:
+        """One pre-vote round: True = a majority would grant a real
+        vote, go disturb the cluster. Persistent state untouched."""
+        with self._lock:
+            if self._stopped:
+                return False
+            term = self.store.term + 1
+            last_idx = self.store.last_index()
+            last_term = self.store.term_at(last_idx)
+            peers = [p for p in self.peers if p != self.transport.addr]
+        if not peers:
+            return True
+        need = (len(peers) + 1) // 2 + 1
+        grants = [1]  # our own
+        glock = threading.Lock()
+
+        def ask(peer: str) -> None:
+            try:
+                reply = self.transport.call(peer, "pre_vote", {
+                    "term": term, "candidate": self.id,
+                    "last_log_index": last_idx,
+                    "last_log_term": last_term},
+                    timeout=self.election_timeout)
+            except Exception:  # noqa: BLE001 — unreachable peer
+                return
+            if reply.get("granted"):
+                with glock:
+                    grants[0] += 1
+
+        if isinstance(self.clock, SimClock):
+            for peer in peers:
+                ask(peer)
+        else:
+            threads = [threading.Thread(target=ask, args=(p,),
+                                        daemon=True) for p in peers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=self.election_timeout)
+        return grants[0] >= need
+
+    def _on_pre_vote(self, args: dict[str, Any]) -> dict[str, Any]:
+        """Grant iff we'd plausibly grant the REAL vote: candidate's
+        log is current, its term isn't behind ours, and we haven't
+        heard from a live leader within an election timeout (leader
+        stickiness — the half that stops healed partitions from
+        disturbing a healthy cluster). No state changes, no timer
+        resets."""
+        with self._lock:
+            if args.get("term", 0) < self.store.term:
+                return {"granted": False}
+            up_to_date = (
+                args.get("last_log_term", 0), args.get("last_log_index", 0)
+            ) >= (
+                self.store.term_at(self.store.last_index()),
+                self.store.last_index())
+            leader_fresh = (
+                self.role == Role.LEADER
+                or (self.leader_id is not None
+                    and self.clock.now() - self._last_leader_contact
+                    < self.election_timeout))
+            return {"granted": up_to_date and not leader_fresh}
 
     def _become_leader(self) -> None:
         self.role = Role.LEADER
@@ -645,6 +718,8 @@ class RaftNode:
             return self._on_append_entries(args)
         if method == "install_snapshot":
             return self._on_install_snapshot(args)
+        if method == "pre_vote":
+            return self._on_pre_vote(args)
         if method == "timeout_now":
             # leadership transfer: start an election NOW, even though
             # the leader is alive (thesis §3.10 — the sender asked)
@@ -652,7 +727,8 @@ class RaftNode:
                 stale = args.get("term", 0) < self.store.term \
                     or self._stopped
             if not stale:
-                self.scheduler.after(0.0, self._start_election)
+                self.scheduler.after(
+                    0.0, lambda: self._start_election(bypass_prevote=True))
             return {"term": self.store.term, "scheduled": not stale}
         raise ValueError(f"unknown raft rpc {method}")
 

@@ -455,12 +455,22 @@ class StateStore:
             return idx, True
 
     def kv_get(self, key: str) -> Optional[KVEntry]:
+        """Returns a COPY: the stored entry mutates in place on later
+        writes (kv_set bumps modify_index on the same object), so
+        handing out the live reference would let callers watch state
+        change under them — or corrupt it (model-fuzz caught this)."""
+        import dataclasses as _dc
+
         with self._lock:
-            return self.tables["kv"].get(key)
+            e = self.tables["kv"].get(key)
+            return _dc.replace(e) if e is not None else None
 
     def kv_list(self, prefix: str) -> list[KVEntry]:
+        import dataclasses as _dc
+
         with self._lock:
-            return sorted((e for k, e in self.tables["kv"].items()
+            return sorted((_dc.replace(e)
+                           for k, e in self.tables["kv"].items()
                            if k.startswith(prefix)), key=lambda e: e.key)
 
     def kv_keys(self, prefix: str, separator: str = "") -> list[str]:

@@ -247,3 +247,65 @@ def test_raft_fsm_state_store_integration():
     for i, f in enumerate(fsms):
         assert f.store.kv_get("cfg/x").value == b"42", f"server {i}"
         assert [n.node for n in f.store.nodes()] == ["web-1"], f"server {i}"
+
+
+def test_prevote_partitioned_node_does_not_inflate_term():
+    """Pre-vote (thesis §9.6): a node isolated long enough to time out
+    repeatedly must NOT bump its term — healing then causes no
+    disruption election, and the stable leader keeps leading."""
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    term_before = leader.store.term
+    victim = next(n for n in nodes if n is not leader)
+    others = [n for n in nodes if n is not victim]
+    net.partition({victim.transport.addr},
+                  {n.transport.addr for n in others})
+    # many election timeouts worth of isolation
+    clock.advance(5.0)
+    assert victim.store.term == term_before, \
+        "pre-vote must stop term inflation while partitioned"
+    assert leader.is_leader()
+    net.heal()
+    clock.advance(2.0)
+    # no disturbance: same leader, same term
+    assert leader.is_leader()
+    assert leader.store.term == term_before
+    assert victim.leader() == leader.transport.addr
+
+
+def test_prevote_denied_while_leader_fresh():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    follower = next(n for n in nodes if n is not leader)
+    # a fresh-leader follower refuses pre-votes
+    reply = follower._on_pre_vote({
+        "term": follower.store.term + 1, "candidate": "x",
+        "last_log_index": follower.store.last_index(),
+        "last_log_term": follower.store.term_at(
+            follower.store.last_index())})
+    assert reply["granted"] is False
+
+
+def test_prevote_granted_after_leader_silence():
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    net.take_down(leader.transport.addr)
+    survivors = [n for n in nodes if n is not leader]
+    # real election still succeeds through the pre-vote gate
+    new_leader = wait_leader(clock, survivors)
+    assert new_leader is not leader
+    assert new_leader.store.term > 0
+
+
+def test_transfer_bypasses_prevote():
+    """TimeoutNow elections skip pre-vote (the leader asked): transfer
+    completes even though every peer has a fresh leader."""
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    target = next(n for n in nodes if n is not leader)
+    leader.apply(b"x")
+    clock.advance(0.3)
+    leader.transfer_leadership(target.transport.addr)
+    clock.advance(1.0)
+    assert target.is_leader()
+    assert not leader.is_leader()

@@ -1,0 +1,265 @@
+"""Stress/fuzz tier — the race-detector analogue (SURVEY §4/§5).
+
+The reference leans on `go test -race` plus fault-injecting container
+suites; pure Python has no race detector, so this tier substitutes
+(a) model-based fuzzing: long seeded random op sequences checked
+against a plain-dict model, (b) linearizability-style raft checks
+under random partitions/crashes on the deterministic clock, and
+(c) real-thread contention storms over the store's lock/watch paths.
+All seeded — failures reproduce.
+"""
+
+import random
+import threading
+
+import msgpack
+
+from consul_tpu.raft import InMemRaftNetwork, RaftNode
+from consul_tpu.raft.raft import ApplyTimeout, NotLeader
+from consul_tpu.raft.storage import RaftStorage
+from consul_tpu.state.store import StateStore
+from consul_tpu.utils.clock import SimClock
+
+
+# ------------------------------------------------------- store model fuzz
+
+def test_kv_store_model_fuzz():
+    """2,000 random KV ops against the store AND a dict model; every
+    read agrees, every CAS outcome agrees."""
+    rng = random.Random(1234)
+    st = StateStore()
+    model: dict[str, bytes] = {}
+    keys = [f"k/{i}" for i in range(40)]
+    for step in range(2000):
+        op = rng.random()
+        k = rng.choice(keys)
+        if op < 0.45:
+            v = f"v{step}".encode()
+            st.kv_set(k, v)
+            model[k] = v
+        elif op < 0.6:
+            # CAS with a randomly right-or-wrong index
+            e = st.kv_get(k)
+            want = e.modify_index if (e and rng.random() < 0.5) \
+                else 999_999_999
+            _, ok = st.kv_set(k, b"cas", cas_index=want)
+            if e is not None and want == e.modify_index:
+                assert ok, f"step {step}: valid CAS refused"
+                model[k] = b"cas"
+            else:
+                assert not ok, f"step {step}: stale CAS accepted"
+        elif op < 0.75:
+            st.kv_delete(k)
+            model.pop(k, None)
+        elif op < 0.85:
+            prefix = rng.choice(["k/1", "k/2", "k/3", "k/"])
+            got = {e.key for e in st.kv_list(prefix)}
+            want_keys = {mk for mk in model if mk.startswith(prefix)}
+            assert got == want_keys, f"step {step}: list({prefix})"
+        else:
+            e = st.kv_get(k)
+            if k in model:
+                assert e is not None and e.value == model[k], \
+                    f"step {step}: get({k})"
+            else:
+                assert e is None, f"step {step}: ghost key {k}"
+    # final full agreement
+    assert {e.key: e.value for e in st.kv_list("")} == model
+
+
+def test_catalog_model_fuzz():
+    """Random register/deregister sequences: the catalog's node/service
+    views always match a model."""
+    rng = random.Random(99)
+    st = StateStore()
+    model: dict[str, dict[str, str]] = {}  # node -> {svc_id: name}
+    nodes = [f"n{i}" for i in range(12)]
+    for step in range(1500):
+        node = rng.choice(nodes)
+        r = rng.random()
+        if r < 0.5:
+            sid = f"s{rng.randrange(5)}"
+            st.ensure_registration(node, "10.0.0.1", service={
+                "ID": sid, "Service": f"svc-{sid}", "Port": 80})
+            model.setdefault(node, {})[sid] = f"svc-{sid}"
+        elif r < 0.7 and node in model and model[node]:
+            sid = rng.choice(list(model[node]))
+            st.delete_service(node, sid)
+            del model[node][sid]
+        elif r < 0.8 and node in model:
+            st.delete_node(node)
+            del model[node]
+        else:
+            got = {s.id for s in st.node_services(node)}
+            assert got == set(model.get(node, {})), f"step {step}"
+    assert {n.node for n in st.nodes()} == set(model)
+    for node, svcs in model.items():
+        assert {s.id for s in st.node_services(node)} == set(svcs)
+
+
+# ------------------------------------------------- raft fault-storm check
+
+def test_raft_linearizability_under_fault_storm():
+    """5 nodes, 60 random fault events (partitions, heals, node
+    crashes/restarts) interleaved with writes. Invariants at the end:
+    every ACKNOWLEDGED write survives exactly once, in the same order
+    on every live node, and no node applied a command twice."""
+    rng = random.Random(7)
+    clock = SimClock()
+    net = InMemRaftNetwork()
+    addrs = [f"r{i}" for i in range(5)]
+    applied: list[list[bytes]] = [[] for _ in addrs]
+    nodes = []
+    for i, addr in enumerate(addrs):
+        t = net.attach(addr)
+
+        def mk(lst):
+            return lambda data, idx: lst.append(data) or len(lst)
+
+        nodes.append(RaftNode(
+            node_id=addr, transport=t, apply_fn=mk(applied[i]),
+            peers=addrs, clock=clock, seed=i, storage=RaftStorage(None),
+            heartbeat_interval=0.05, election_timeout=0.3))
+    for n in nodes:
+        n.start()
+
+    def tick(dt=0.05, total=1.0):
+        t = 0.0
+        while t < total:
+            clock.advance(dt)
+            t += dt
+
+    def current_leader():
+        leaders = [n for n in nodes
+                   if n.is_leader()
+                   and n.transport.addr not in net._down]
+        return leaders[0] if leaders else None
+
+    acked: list[bytes] = []
+    seq = 0
+    down: set[str] = set()
+    for event in range(60):
+        r = rng.random()
+        if r < 0.2 and len(down) < 2:
+            victim = rng.choice([a for a in addrs if a not in down])
+            net.take_down(victim)
+            down.add(victim)
+        elif r < 0.35 and down:
+            back = rng.choice(sorted(down))
+            net.bring_up(back)
+            down.discard(back)
+        elif r < 0.45:
+            k = rng.randrange(1, 3)
+            side = set(rng.sample(addrs, k))
+            net.heal()
+            net.partition(side, set(addrs) - side)
+        elif r < 0.55:
+            net.heal()
+        else:
+            tick(total=0.6)
+            leader = current_leader()
+            if leader is not None:
+                for _ in range(rng.randrange(1, 4)):
+                    payload = f"w{seq}".encode()
+                    seq += 1
+                    try:
+                        leader.apply(payload, timeout=0.0)
+                    except (NotLeader, ApplyTimeout):
+                        pass  # unacknowledged — may or may not survive
+                    else:
+                        acked.append(payload)
+        tick(total=0.3)
+
+    # heal everything and let the cluster converge
+    net.heal()
+    for a in sorted(down):
+        net.bring_up(a)
+    tick(total=8.0)
+    leader = current_leader()
+    assert leader is not None, "cluster failed to converge"
+    leader.apply(b"final")
+    tick(total=2.0)
+
+    logs = [[d for d in lst if d] for lst in applied]
+    # 1. no duplicates anywhere
+    for i, lg in enumerate(logs):
+        assert len(lg) == len(set(lg)), f"node {i} double-applied"
+    # 2. acknowledged writes all survive on every node
+    for i, lg in enumerate(logs):
+        missing = [w for w in acked if w not in lg]
+        assert not missing, f"node {i} lost acked writes: {missing[:5]}"
+    # 3. identical order everywhere
+    for lg in logs[1:]:
+        assert lg == logs[0], "divergent apply order"
+    for n in nodes:
+        n.shutdown()
+
+
+def test_raft_apply_timeout_zero_counts_only_committed():
+    """Sanity for the storm's ack model: SimClock apply with timeout=0
+    raises unless the entry committed synchronously."""
+    clock = SimClock()
+    net = InMemRaftNetwork()
+    addrs = ["a0", "a1", "a2"]
+    nodes = []
+    for i, a in enumerate(addrs):
+        t = net.attach(a)
+        nodes.append(RaftNode(node_id=a, transport=t,
+                              apply_fn=lambda d, i: None, peers=addrs,
+                              clock=clock, seed=i,
+                              storage=RaftStorage(None),
+                              heartbeat_interval=0.05,
+                              election_timeout=0.3))
+    for n in nodes:
+        n.start()
+    t = 0.0
+    while t < 3.0 and not any(n.is_leader() for n in nodes):
+        clock.advance(0.05)
+        t += 0.05
+    leader = next(n for n in nodes if n.is_leader())
+    leader.apply(b"ok", timeout=0.0)  # instant links: commits inline
+    for n in nodes:
+        n.shutdown()
+
+
+# ------------------------------------------------- real-thread contention
+
+def test_store_thread_storm():
+    """16 real threads hammer disjoint+overlapping keys, watchers ride
+    block_until concurrently; no exceptions, watch indexes monotonic,
+    final state complete."""
+    st = StateStore()
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def writer(w):
+        try:
+            for i in range(300):
+                st.kv_set(f"storm/{w}/{i}", b"x")
+                if i % 50 == 0:
+                    st.kv_set("storm/shared", f"{w}:{i}".encode())
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    def watcher():
+        try:
+            idx = 0
+            while not stop.is_set():
+                nxt = st.block_until(("kv",), idx, timeout=0.2)
+                assert nxt >= idx
+                idx = nxt
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    writers = [threading.Thread(target=writer, args=(w,))
+               for w in range(12)]
+    watchers = [threading.Thread(target=watcher) for _ in range(4)]
+    for t in writers + watchers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in watchers:
+        t.join()
+    assert not errors, errors[:3]
+    assert len(st.kv_list("storm/")) == 12 * 300 + 1
