@@ -838,6 +838,39 @@ def cmd_connect(args) -> int:
             print("Configuration updated!")
             return 0
         return 1
+    if args.connect_cmd == "proxy":
+        # built-in mTLS proxy (connect/proxy) — no Envoy required
+        from consul_tpu.connect.proxy import ConnectProxy
+
+        if args.listen and not args.local_port:
+            print("Error: -listen requires -local-port (the local "
+                  "application port to splice to)", file=sys.stderr)
+            return 1
+        if args.listen:
+            bind, _, port = args.listen.rpartition(":")
+            if not port.isdigit():
+                print(f"Error: invalid -listen {args.listen!r} "
+                      "(want [addr]:port)", file=sys.stderr)
+                return 1
+        p = ConnectProxy(c, args.service)
+        if args.listen:
+            bind, _, port = args.listen.rpartition(":")
+            bound = p.start_public_listener(int(port),
+                                            args.local_port,
+                                            bind or "127.0.0.1")
+            print(f"public mTLS listener on :{bound} -> "
+                  f"127.0.0.1:{args.local_port}")
+        for up in args.upstream or []:
+            dest, _, lport = up.partition(":")
+            bound = p.add_upstream(int(lport or 0), dest)
+            print(f"upstream {dest} on 127.0.0.1:{bound}")
+        print("proxy running; ctrl-c to exit")
+        try:
+            while True:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            p.stop()
+        return 0
     from consul_tpu.connect.envoy import bootstrap_config
 
     if not args.sidecar_for and not args.proxy_id:
@@ -1444,6 +1477,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     cn = sub.add_parser("connect")
     cnsub = cn.add_subparsers(dest="connect_cmd", required=True)
+    cpx = cnsub.add_parser("proxy")
+    cpx.add_argument("-service", required=True)
+    cpx.add_argument("-listen", default="",
+                     help="public mTLS listener addr:port")
+    cpx.add_argument("-local-port", dest="local_port", type=int,
+                     default=0, help="local app port behind -listen")
+    cpx.add_argument("-upstream", action="append", default=[],
+                     help="dest_service:local_port (repeatable)")
     cca = cnsub.add_parser("ca")
     ccasub = cca.add_subparsers(dest="connect_sub", required=True)
     ccasub.add_parser("get-config")

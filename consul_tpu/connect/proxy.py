@@ -1,0 +1,288 @@
+"""Built-in Connect proxy: the mTLS data plane without Envoy.
+
+Reference: `consul connect proxy` (connect/proxy/ — the managed
+built-in proxy). Two halves, both plain TCP splice loops under SPIFFE
+mTLS:
+
+* PUBLIC listener: terminates inbound mTLS with this service's leaf,
+  requires a client cert signed by the cluster CA, extracts the
+  caller's SPIFFE URI, asks the agent `/v1/agent/connect/authorize`
+  (the intention graph), then splices to the local application port.
+* UPSTREAM listeners: accept plaintext from the local app, resolve a
+  healthy instance of the destination (its connect proxies/natives via
+  `/v1/health/connect/<svc>`), dial its public port presenting OUR
+  leaf, verify the server's SPIFFE URI names the destination service,
+  then splice.
+
+Certificates come from the agent's leaf manager
+(`/v1/agent/connect/ca/leaf/<svc>`) and roots from
+`/v1/connect/ca/roots`; both are re-fetched when the agent rotates
+them (cert_refresh drives re-wrap of the SSL contexts).
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import tempfile
+import threading
+from typing import Any, Optional
+
+from consul_tpu.utils import log
+
+
+def _spiffe_uri_of(cert_der: bytes) -> Optional[str]:
+    from cryptography import x509
+
+    cert = x509.load_der_x509_certificate(cert_der)
+    try:
+        san = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        uris = san.get_values_for_type(x509.UniformResourceIdentifier)
+        return uris[0] if uris else None
+    except x509.ExtensionNotFound:
+        return None
+
+
+def _splice(a: socket.socket, b: socket.socket) -> None:
+    """Bidirectional byte pump until either side closes."""
+    def pump(src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=pump, args=(b, a), daemon=True)
+    t.start()
+    pump(a, b)
+    t.join(timeout=1.0)
+    for s in (a, b):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+class ConnectProxy:
+    """One service's sidecar (connect/proxy Proxy)."""
+
+    def __init__(self, client, service: str) -> None:
+        """client: consul_tpu.api.ConsulClient bound to the local
+        agent."""
+        self.client = client
+        self.service = service
+        self.log = log.named(f"connect-proxy.{service}")
+        self._lock = threading.Lock()
+        self._listeners: list[socket.socket] = []
+        self._stop = threading.Event()
+        self._leaf: Optional[dict[str, Any]] = None
+        self._roots_pem = ""
+        # live contexts: handlers read these AT HANDSHAKE TIME, so a
+        # refresh (leaf renewal / root rotation) reaches new
+        # connections without restarting listeners
+        self._server_ctx: Optional[ssl.SSLContext] = None
+        self._client_ctx: Optional[ssl.SSLContext] = None
+        self._refresh_certs()
+        threading.Thread(target=self._refresh_loop, daemon=True,
+                         name=f"cp-certs-{service}").start()
+
+    # ------------------------------------------------------------- certs
+
+    def _refresh_certs(self) -> None:
+        leaf = self.client.get(
+            f"/v1/agent/connect/ca/leaf/{self.service}")
+        roots = self.client.get("/v1/connect/ca/roots")
+        pems = [r.get("RootCert", "") for r in roots.get("Roots") or []]
+        with self._lock:
+            changed = (leaf.get("SerialNumber")
+                       != (self._leaf or {}).get("SerialNumber")
+                       or "".join(pems) != self._roots_pem)
+            self._leaf = leaf
+            self._roots_pem = "".join(pems)
+        if changed:
+            server, client = self._build_ctx_pair()
+            with self._lock:
+                self._server_ctx, self._client_ctx = server, client
+
+    def _refresh_loop(self) -> None:
+        """Poll the agent's leaf manager (it renews at half-life and on
+        root rotation); rebuild contexts when material changes."""
+        while not self._stop.wait(30.0):
+            try:
+                self._refresh_certs()
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("cert refresh failed: %s", e)
+
+    def _build_ctx_pair(self) -> tuple[ssl.SSLContext, ssl.SSLContext]:
+        """(server_ctx, client_ctx) from the current leaf+roots. The
+        ssl module loads from disk, so material passes through temp
+        files that are unlinked as soon as the contexts hold them —
+        key material must not outlive the load."""
+        import os as _os
+
+        with self._lock:
+            leaf, roots = dict(self._leaf or {}), self._roots_pem
+        chain = leaf.get("CertChainPEM") or leaf.get("CertPEM", "")
+        paths = []
+        try:
+            for content in (chain, leaf.get("PrivateKeyPEM", ""), roots):
+                with tempfile.NamedTemporaryFile(
+                        "w", suffix=".pem", delete=False) as f:
+                    f.write(content)
+                    paths.append(f.name)
+            cert_file, key_file, roots_file = paths
+            server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            server.load_cert_chain(cert_file, key_file)
+            server.load_verify_locations(roots_file)
+            server.verify_mode = ssl.CERT_REQUIRED  # mTLS: prove it
+            client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            client.load_cert_chain(cert_file, key_file)
+            client.load_verify_locations(roots_file)
+            client.check_hostname = False  # identity = SPIFFE URI
+            return server, client
+        finally:
+            for pth in paths:
+                try:
+                    _os.unlink(pth)
+                except OSError:
+                    pass
+
+    # ---------------------------------------------------------- listeners
+
+    def start_public_listener(self, port: int, local_port: int,
+                              bind: str = "127.0.0.1") -> int:
+        """Inbound half: mTLS terminate → intention authorize → splice
+        to the local app. Returns the bound port."""
+        lsock = socket.create_server((bind, port))
+        self._listeners.append(lsock)
+        bound = lsock.getsockname()[1]
+
+        def accept_loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    conn, _addr = lsock.accept()
+                except OSError:
+                    return
+                threading.Thread(target=handle, args=(conn,),
+                                 daemon=True).start()
+
+        def handle(conn: socket.socket) -> None:
+            try:
+                tls = self._server_ctx.wrap_socket(conn,
+                                                   server_side=True)
+            except (ssl.SSLError, OSError) as e:
+                self.log.debug("inbound TLS failed: %s", e)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            peer_uri = _spiffe_uri_of(tls.getpeercert(True)) or ""
+            try:
+                res = self.client.post(
+                    "/v1/agent/connect/authorize", body={
+                        "Target": self.service,
+                        "ClientCertURI": peer_uri})
+            except Exception as e:  # noqa: BLE001
+                # agent unreachable: FAIL CLOSED — never admit traffic
+                # the intention graph couldn't vouch for
+                self.log.warning("authorize unavailable: %s", e)
+                tls.close()
+                return
+            if not res.get("Authorized"):
+                self.log.info("DENIED %s -> %s (%s)", peer_uri,
+                              self.service, res.get("Reason", ""))
+                tls.close()
+                return
+            try:
+                local = socket.create_connection(("127.0.0.1",
+                                                  local_port), timeout=5)
+            except OSError:
+                tls.close()
+                return
+            _splice(tls, local)
+
+        threading.Thread(target=accept_loop, daemon=True,
+                         name=f"cp-pub-{self.service}").start()
+        return bound
+
+    def add_upstream(self, local_port: int, dest_service: str,
+                     bind: str = "127.0.0.1") -> int:
+        """Outbound half: plaintext from the app → mTLS to a healthy
+        instance of dest_service, server identity verified by SPIFFE
+        URI. Returns the bound port."""
+        lsock = socket.create_server((bind, local_port))
+        self._listeners.append(lsock)
+        bound = lsock.getsockname()[1]
+
+        def accept_loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    conn, _addr = lsock.accept()
+                except OSError:
+                    return
+                threading.Thread(target=handle, args=(conn,),
+                                 daemon=True).start()
+
+        def handle(conn: socket.socket) -> None:
+            target = self._resolve(dest_service)
+            if target is None:
+                self.log.warning("no healthy instance of %s",
+                                 dest_service)
+                conn.close()
+                return
+            host, port = target
+            try:
+                raw = socket.create_connection((host, port), timeout=5)
+                tls = self._client_ctx.wrap_socket(raw)
+            except (OSError, ssl.SSLError) as e:
+                self.log.warning("upstream dial %s:%s failed: %s",
+                                 host, port, e)
+                conn.close()
+                return
+            uri = _spiffe_uri_of(tls.getpeercert(True)) or ""
+            if not uri.endswith(f"/svc/{dest_service}"):
+                self.log.warning(
+                    "upstream identity mismatch: %s is not %s",
+                    uri, dest_service)
+                tls.close()
+                conn.close()
+                return
+            _splice(conn, tls)
+
+        threading.Thread(target=accept_loop, daemon=True,
+                         name=f"cp-up-{dest_service}").start()
+        return bound
+
+    def _resolve(self, dest_service: str
+                 ) -> Optional[tuple[str, int]]:
+        """A healthy connect-capable instance (proxy public port or
+        native port) — /v1/health/connect semantics."""
+        rows = self.client.get(f"/v1/health/connect/{dest_service}",
+                               passing="")
+        for row in rows or []:
+            svc = row.get("Service") or {}
+            addr = svc.get("Address") or (row.get("Node") or {}).get(
+                "Address", "")
+            port = svc.get("Port", 0)
+            if addr and port:
+                return addr, port
+        return None
+
+    def stop(self) -> None:
+        self._stop.set()
+        for s in self._listeners:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -23,6 +23,7 @@ config.go:561-562).
 
 from __future__ import annotations
 
+import dataclasses
 import fnmatch
 import threading
 from typing import Any, Callable, Iterable, Optional
@@ -459,17 +460,13 @@ class StateStore:
         writes (kv_set bumps modify_index on the same object), so
         handing out the live reference would let callers watch state
         change under them — or corrupt it (model-fuzz caught this)."""
-        import dataclasses as _dc
-
         with self._lock:
             e = self.tables["kv"].get(key)
-            return _dc.replace(e) if e is not None else None
+            return dataclasses.replace(e) if e is not None else None
 
     def kv_list(self, prefix: str) -> list[KVEntry]:
-        import dataclasses as _dc
-
         with self._lock:
-            return sorted((_dc.replace(e)
+            return sorted((dataclasses.replace(e)
                            for k, e in self.tables["kv"].items()
                            if k.startswith(prefix)), key=lambda e: e.key)
 
