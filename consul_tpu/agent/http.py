@@ -1108,19 +1108,43 @@ class HTTPApi:
                 raise HTTPError(404, "no federation state for dc")
             return res["State"], res.get("Index")
         if path == "/v1/agent/monitor":
-            # bounded capture of live log output (the reference streams;
-            # we return a window — ?duration= seconds, default 2, cap 10)
+            # LIVE log stream (logging/monitor/monitor.go): lines flush
+            # as they happen for ?duration= seconds (default 2, cap 60)
             rpc("Internal.AgentRead", {})  # ACL: agent read
             from consul_tpu.utils import log as log_mod
-            import time as _t
 
-            lines: list[str] = []
-            detach = log_mod.add_sink(lines.append)
-            try:
-                _t.sleep(min(_dur(q.get("duration", "2s")), 10.0))
-            finally:
-                detach()
-            return "\n".join(lines).encode(), None
+            total = min(_dur(q.get("duration", "2s")), 60.0)
+
+            def monitor_stream():
+                import queue as queue_mod
+                import time as _t
+
+                lines: "queue_mod.Queue[str]" = queue_mod.Queue(
+                    maxsize=4096)
+
+                def sink(line: str) -> None:
+                    try:
+                        lines.put_nowait(line)
+                    except queue_mod.Full:
+                        pass  # log-drop semantics (agent/log-drop)
+
+                detach = log_mod.add_sink(sink)
+                end = _t.monotonic() + total
+                try:
+                    while True:
+                        remaining = end - _t.monotonic()
+                        if remaining <= 0:
+                            return
+                        try:
+                            line = lines.get(
+                                timeout=min(remaining, 0.25))
+                        except queue_mod.Empty:
+                            continue
+                        yield (line + "\n").encode()
+                finally:
+                    detach()
+
+            return StreamingBody(monitor_stream()), None
         if path == "/v1/operator/raft/transfer-leader" and \
                 method in ("PUT", "POST"):
             return rpc("Operator.RaftTransferLeader",

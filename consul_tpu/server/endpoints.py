@@ -260,6 +260,17 @@ def register_endpoints(srv) -> None:
             raise RPCError("missing key")
         require(authz(args).key_write(d["Key"]),
                 f"key write on {d['Key']!r}")
+        # Sentinel seam (sentinel_ce.go stub; KV is the one surface the
+        # reference attaches policies to): evaluates in preApply, like
+        # the ACL check — nothing policy-refused reaches the raft log
+        from consul_tpu.utils import sentinel
+
+        az = authz(args)
+        policy = getattr(az, "sentinel_policy", "") or ""
+        err = sentinel.evaluate(policy, sentinel.kv_scope(
+            d["Key"], d.get("Value") or b"", d.get("Flags", 0)))
+        if err:
+            raise RPCError(f"Sentinel policy rejected the write: {err}")
         args = {k: v for k, v in args.items() if k != "AuthToken"}
         return srv.forward_or_apply(MessageType.KVS, args)
 

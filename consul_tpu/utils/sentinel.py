@@ -1,0 +1,35 @@
+"""Sentinel policy-as-code seam.
+
+The reference ships only an enterprise stub (sentinel/, ~60 LoC: an
+Evaluator interface the CE build wires to a no-op — sentinel/
+sentinel_ce.go). Same here: KV writes flow through `evaluate()`, the
+default evaluator admits everything, and an enterprise-style evaluator
+can be registered to enforce policies attached to keys (the scope
+carries the same fields the reference builds for the KV scope)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+#: fn(policy_source, scope) -> error string or None
+Evaluator = Callable[[str, dict[str, Any]], Optional[str]]
+
+_evaluator: Optional[Evaluator] = None
+
+
+def register(evaluator: Optional[Evaluator]) -> None:
+    """Install (or clear, with None) the active evaluator."""
+    global _evaluator
+    _evaluator = evaluator
+
+
+def evaluate(policy: str, scope: dict[str, Any]) -> Optional[str]:
+    """Run the policy. No evaluator / no policy → allow (CE stub)."""
+    if _evaluator is None or not policy:
+        return None
+    return _evaluator(policy, scope)
+
+
+def kv_scope(key: str, value: bytes, flags: int) -> dict[str, Any]:
+    """The KV write scope (sentinel ScopeKVUpsert)."""
+    return {"key": key, "value": value, "flags": flags}

@@ -263,3 +263,54 @@ def test_store_thread_storm():
         t.join()
     assert not errors, errors[:3]
     assert len(st.kv_list("storm/")) == 12 * 300 + 1
+
+
+def test_sentinel_seam_blocks_kv_writes():
+    """The Sentinel stub seam: no evaluator = allow (CE); a registered
+    evaluator can refuse KV writes BEFORE they reach raft."""
+    from consul_tpu.config import load
+    from consul_tpu.server import Server
+    from consul_tpu.utils import sentinel
+
+    from helpers import wait_for
+
+    cfg = load(dev=True, overrides={
+        "node_name": "sent0", "server": True, "bootstrap": True})
+    srv = Server(cfg)
+    srv.start()
+    try:
+        wait_for(srv.is_leader, what="leadership")
+        # CE default: everything admitted
+        assert srv.handle_rpc("KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": "s/a", "Value": b"1"}},
+            "test") is True
+
+        def deny_protected(policy, scope):
+            if scope["key"].startswith("protected/"):
+                return "key is protected"
+            return None
+
+        sentinel.register(deny_protected)
+        # evaluator runs only for tokens WITH a policy attached; the
+        # seam itself admits policy-less writes
+        assert srv.handle_rpc("KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": "protected/x",
+                                    "Value": b"1"}}, "test") is True
+    finally:
+        sentinel.register(None)
+        srv.shutdown()
+
+
+def test_sentinel_evaluate_directly():
+    from consul_tpu.utils import sentinel
+
+    assert sentinel.evaluate("any-policy", {"key": "k"}) is None
+    sentinel.register(lambda p, s: "no" if s["key"] == "bad" else None)
+    try:
+        assert sentinel.evaluate("p", sentinel.kv_scope("bad", b"", 0)) \
+            == "no"
+        assert sentinel.evaluate("p", sentinel.kv_scope("ok", b"", 0)) \
+            is None
+        assert sentinel.evaluate("", {"key": "bad"}) is None  # no policy
+    finally:
+        sentinel.register(None)
