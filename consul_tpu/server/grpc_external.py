@@ -8,12 +8,12 @@ The image ships grpcio but no proto definitions, so every message rides
 the hand-rolled proto3 codec (utils/pbwire.py, verified byte-for-byte
 against the google.protobuf runtime). The delta-xDS PROTOCOL envelope
 (DeltaDiscoveryRequest/Response, subscribe/unsubscribe, nonces,
-ack/nack, removals) is wire-true protobuf; resource PAYLOADS inside
-Any are encoded as true proto for EDS (ClusterLoadAssignment — the
-hot, health-flip-driven type) and as canonical xDS JSON for
-CDS/LDS (a real Envoy needs proto lowering for those too — the
-envelope and protocol state machine are transport-complete today and
-the payload encoder is a per-type table away).
+ack/nack, removals) is wire-true protobuf, and so are the resource
+PAYLOADS: EDS (ClusterLoadAssignment) here, CDS/LDS via
+server/xds_proto.py (Cluster with STATIC/EDS + upstream TLS,
+Listener with tcp_proxy/RBAC chains + downstream mTLS + SNI
+matches — the shapes connect/envoy.py emits). A config outside that
+coverage falls back to canonical xDS JSON, visibly.
 
 Served methods:
   /envoy.service.discovery.v3.AggregatedDiscoveryService/DeltaAggregatedResources
@@ -213,15 +213,26 @@ def resources_from_cfg(cfg: dict[str, Any],
             blob = encode_cla(c["name"], eps)
             out[c["name"]] = (_version(blob), blob)
         return out
+    from consul_tpu.server.xds_proto import (UnloweredShape,
+                                             lower_cluster,
+                                             lower_listener)
+
     if type_url == CDS_TYPE:
         rows = cfg["static_resources"]["clusters"]
+        lower = lower_cluster
     elif type_url == LDS_TYPE:
         rows = cfg["static_resources"]["listeners"]
+        lower = lower_listener
     else:
         return {}
     for r in rows:
-        blob = json.dumps({"@type": type_url, **r},
-                          sort_keys=True).encode()
+        try:
+            # true proto (what a real Envoy requires)
+            blob = lower(r)
+        except UnloweredShape:
+            # shape outside the proto coverage: visible JSON fallback
+            blob = json.dumps({"@type": type_url, **r},
+                              sort_keys=True).encode()
         out[r["name"]] = (_version(blob), blob)
     return out
 

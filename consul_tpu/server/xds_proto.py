@@ -1,0 +1,291 @@
+"""True-proto lowering for CDS/LDS xDS payloads.
+
+The delta-ADS envelope (grpc_external.py) has always been wire-true
+protobuf; this module lowers the RESOURCE payloads for the Cluster and
+Listener types from the canonical xDS JSON our bootstrap builder emits
+into real envoy.config proto bytes — what an actual Envoy requires
+(the reference's 28k-LoC agent/xds translator emits proto natively).
+
+Coverage = exactly the shapes `connect/envoy.py` produces: STATIC/EDS
+clusters with upstream TLS (+SNI), listeners of tcp_proxy + network
+RBAC filter chains with downstream mTLS and optional SNI matches.
+A shape outside that envelope raises UnloweredShape and the caller
+falls back to the JSON payload (visible, not silent: the resource
+carries the JSON @type marker, and tests pin the real configs to the
+proto path).
+
+Field numbers are from the envoy/config + envoy/extensions protos
+(cluster.proto, listener.proto, tls.proto, tcp_proxy.proto,
+rbac.proto) — cited per spec below.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from consul_tpu.utils.pbwire import Field, encode
+
+# ---------------------------------------------------------- shared bits
+
+#: google.protobuf.Duration
+_DURATION = {"seconds": Field(1, "int"), "nanos": Field(2, "int")}
+#: google.protobuf.BoolValue
+_BOOL = {"value": Field(1, "bool")}
+#: config.core.v3.DataSource (base.proto): oneof specifier
+_DATA_SOURCE = {"filename": Field(1, "string"),
+                "inline_bytes": Field(2, "bytes"),
+                "inline_string": Field(3, "string")}
+_ANY = {"type_url": Field(1, "string"), "value": Field(2, "bytes")}
+#: config.core.v3.TransportSocket
+_TRANSPORT_SOCKET = {"name": Field(1, "string"),
+                     "typed_config": Field(3, "message", _ANY)}
+_SOCKET_ADDRESS = {"protocol": Field(1, "enum"),
+                   "address": Field(2, "string"),
+                   "port_value": Field(3, "int")}
+_ADDRESS = {"socket_address": Field(1, "message", _SOCKET_ADDRESS)}
+
+#: type.matcher.v3.StringMatcher (string.proto): oneof match_pattern
+_STRING_MATCHER = {"exact": Field(1, "string"),
+                   "prefix": Field(2, "string"),
+                   "suffix": Field(3, "string"),
+                   "contains": Field(7, "string")}
+
+# extensions.transport_sockets.tls.v3 (tls.proto)
+_TLS_CERT = {"certificate_chain": Field(1, "message", _DATA_SOURCE),
+             "private_key": Field(2, "message", _DATA_SOURCE)}
+_CERT_VALIDATION = {"trusted_ca": Field(1, "message", _DATA_SOURCE)}
+_COMMON_TLS = {
+    "tls_certificates": Field(2, "message", _TLS_CERT, repeated=True),
+    "validation_context": Field(3, "message", _CERT_VALIDATION),
+}
+_UPSTREAM_TLS = {"common_tls_context": Field(1, "message", _COMMON_TLS),
+                 "sni": Field(2, "string")}
+_DOWNSTREAM_TLS = {
+    "common_tls_context": Field(1, "message", _COMMON_TLS),
+    "require_client_certificate": Field(2, "message", _BOOL),
+}
+UPSTREAM_TLS_TYPE = ("type.googleapis.com/envoy.extensions."
+                     "transport_sockets.tls.v3.UpstreamTlsContext")
+DOWNSTREAM_TLS_TYPE = ("type.googleapis.com/envoy.extensions."
+                       "transport_sockets.tls.v3.DownstreamTlsContext")
+
+# ------------------------------------------------------------- clusters
+
+#: config.cluster.v3.Cluster.EdsClusterConfig
+_CONFIG_SOURCE_ADS = {"ads": Field(3, "message", {}, presence=True),
+                      "resource_api_version": Field(6, "enum")}  # V3=2
+_EDS_CLUSTER_CONFIG = {
+    "eds_config": Field(1, "message", _CONFIG_SOURCE_ADS),
+    "service_name": Field(2, "string"),
+}
+# load_assignment reuses grpc_external's CLA spec at field 33
+from consul_tpu.server.grpc_external import CLA  # noqa: E402
+
+_CLUSTER = {
+    "name": Field(1, "string"),
+    "type": Field(2, "enum"),  # STATIC=0, EDS=3 (cluster.proto)
+    "eds_cluster_config": Field(3, "message", _EDS_CLUSTER_CONFIG),
+    "connect_timeout": Field(4, "message", _DURATION),
+    "transport_socket": Field(24, "message", _TRANSPORT_SOCKET),
+    "load_assignment": Field(33, "message", CLA),
+}
+_CLUSTER_TYPE_ENUM = {"STATIC": 0, "STRICT_DNS": 1, "LOGICAL_DNS": 2,
+                      "EDS": 3, "ORIGINAL_DST": 4}
+
+# ------------------------------------------------------------ listeners
+
+#: extensions.filters.network.tcp_proxy.v3.TcpProxy
+_TCP_PROXY = {"stat_prefix": Field(1, "string"),
+              "cluster": Field(2, "string")}
+TCP_PROXY_TYPE = ("type.googleapis.com/envoy.extensions.filters."
+                  "network.tcp_proxy.v3.TcpProxy")
+
+#: config.rbac.v3 (rbac.proto)
+_PRINCIPAL_AUTHENTICATED = {
+    "principal_name": Field(2, "message", _STRING_MATCHER)}
+_PRINCIPAL = {"any": Field(1, "bool"),
+              "authenticated": Field(4, "message",
+                                     _PRINCIPAL_AUTHENTICATED)}
+_PERMISSION = {"any": Field(3, "bool")}
+_POLICY = {"permissions": Field(1, "message", _PERMISSION, repeated=True),
+           "principals": Field(2, "message", _PRINCIPAL, repeated=True)}
+_POLICY_ENTRY = {"key": Field(1, "string"),
+                 "value": Field(2, "message", _POLICY)}
+_RBAC_RULES = {"action": Field(1, "enum"),  # ALLOW=0, DENY=1
+               "policies": Field(2, "message", _POLICY_ENTRY,
+                                 repeated=True)}
+#: extensions.filters.network.rbac.v3.RBAC
+_NETWORK_RBAC = {"rules": Field(1, "message", _RBAC_RULES),
+                 "stat_prefix": Field(2, "string")}
+NETWORK_RBAC_TYPE = ("type.googleapis.com/envoy.extensions.filters."
+                     "network.rbac.v3.RBAC")
+
+_FILTER = {"name": Field(1, "string"),
+           "typed_config": Field(4, "message", _ANY)}
+_FILTER_CHAIN_MATCH = {
+    "server_names": Field(11, "string", repeated=True)}
+_FILTER_CHAIN = {
+    "filter_chain_match": Field(1, "message", _FILTER_CHAIN_MATCH),
+    "filters": Field(3, "message", _FILTER, repeated=True),
+    "transport_socket": Field(6, "message", _TRANSPORT_SOCKET),
+}
+_LISTENER = {
+    "name": Field(1, "string"),
+    "address": Field(2, "message", _ADDRESS),
+    "filter_chains": Field(3, "message", _FILTER_CHAIN, repeated=True),
+}
+
+
+class UnloweredShape(Exception):
+    """This JSON uses a construct outside the proto coverage; caller
+    falls back to the JSON payload."""
+
+
+def _duration(s: Any) -> dict[str, int]:
+    if isinstance(s, str) and s.endswith("s"):
+        val = float(s[:-1])
+        return {"seconds": int(val),
+                "nanos": int((val - int(val)) * 1e9)}
+    raise UnloweredShape(f"duration {s!r}")
+
+
+def _data_source(d: dict[str, Any]) -> dict[str, Any]:
+    return {k: v for k, v in d.items() if k in _DATA_SOURCE}
+
+
+def _common_tls(d: dict[str, Any]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    if d.get("tls_certificates"):
+        out["tls_certificates"] = [
+            {"certificate_chain": _data_source(c["certificate_chain"]),
+             "private_key": _data_source(c["private_key"])}
+            for c in d["tls_certificates"]]
+    vc = d.get("validation_context")
+    if vc:
+        out["validation_context"] = {
+            "trusted_ca": _data_source(vc["trusted_ca"])}
+    return out
+
+
+def _transport_socket(ts: dict[str, Any]) -> dict[str, Any]:
+    tc = ts.get("typed_config") or {}
+    at = tc.get("@type", "")
+    if at == UPSTREAM_TLS_TYPE:
+        msg = {"common_tls_context":
+               _common_tls(tc.get("common_tls_context") or {})}
+        if tc.get("sni"):
+            msg["sni"] = tc["sni"]
+        blob = encode(_UPSTREAM_TLS, msg)
+    elif at == DOWNSTREAM_TLS_TYPE:
+        msg = {"common_tls_context":
+               _common_tls(tc.get("common_tls_context") or {})}
+        if tc.get("require_client_certificate"):
+            msg["require_client_certificate"] = {"value": True}
+        blob = encode(_DOWNSTREAM_TLS, msg)
+    else:
+        raise UnloweredShape(f"transport socket {at!r}")
+    return {"name": "envoy.transport_sockets.tls",
+            "typed_config": {"type_url": at, "value": blob}}
+
+
+def lower_cluster(c: dict[str, Any]) -> bytes:
+    """envoy.config.cluster.v3.Cluster JSON → proto bytes."""
+    ctype = c.get("type", "STATIC")
+    if ctype not in _CLUSTER_TYPE_ENUM:
+        raise UnloweredShape(f"cluster type {ctype!r}")
+    msg: dict[str, Any] = {"name": c["name"],
+                           "type": _CLUSTER_TYPE_ENUM[ctype]}
+    if c.get("connect_timeout"):
+        msg["connect_timeout"] = _duration(c["connect_timeout"])
+    if c.get("eds_cluster_config"):
+        ecc = c["eds_cluster_config"]
+        msg["eds_cluster_config"] = {
+            "eds_config": {"ads": {}, "resource_api_version": 2},
+            "service_name": ecc.get("service_name", c["name"])}
+    la = c.get("load_assignment")
+    if la:
+        msg["load_assignment"] = {
+            "cluster_name": la.get("cluster_name", c["name"]),
+            "endpoints": [
+                {"lb_endpoints": [
+                    {"endpoint": {"address": {"socket_address": {
+                        "address": (lb.get("endpoint") or {})
+                        .get("address", {}).get("socket_address", {})
+                        .get("address", ""),
+                        "port_value": (lb.get("endpoint") or {})
+                        .get("address", {}).get("socket_address", {})
+                        .get("port_value", 0)}}},
+                     **({"health_status": lb["health_status"]}
+                        if isinstance(lb.get("health_status"), int)
+                        else {})}
+                    for lb in grp.get("lb_endpoints") or []]}
+                for grp in la.get("endpoints") or []]}
+    if c.get("transport_socket"):
+        msg["transport_socket"] = _transport_socket(
+            c["transport_socket"])
+    return encode(_CLUSTER, msg)
+
+
+def _lower_filter(f: dict[str, Any]) -> dict[str, Any]:
+    tc = f.get("typed_config") or {}
+    at = tc.get("@type", "")
+    if at == TCP_PROXY_TYPE:
+        blob = encode(_TCP_PROXY, {
+            "stat_prefix": tc.get("stat_prefix", ""),
+            "cluster": tc.get("cluster", "")})
+    elif at == NETWORK_RBAC_TYPE:
+        rules = tc.get("rules") or {}
+        action = {"ALLOW": 0, "DENY": 1}.get(rules.get("action"), None)
+        if action is None:
+            raise UnloweredShape(f"rbac action {rules.get('action')!r}")
+        policies = []
+        for name, pol in sorted((rules.get("policies") or {}).items()):
+            principals = []
+            for pr in pol.get("principals") or []:
+                if pr.get("any"):
+                    principals.append({"any": True})
+                elif pr.get("authenticated"):
+                    principals.append({"authenticated": {
+                        "principal_name": {
+                            k: v for k, v in
+                            pr["authenticated"]["principal_name"].items()
+                            if k in _STRING_MATCHER}}})
+                else:
+                    raise UnloweredShape(f"rbac principal {pr!r}")
+            policies.append({"key": name, "value": {
+                "permissions": [{"any": True}],
+                "principals": principals}})
+        blob = encode(_NETWORK_RBAC, {
+            "stat_prefix": tc.get("stat_prefix", ""),
+            "rules": {"action": action, "policies": policies}})
+    else:
+        raise UnloweredShape(f"filter {at!r}")
+    return {"name": f.get("name", ""),
+            "typed_config": {"type_url": at, "value": blob}}
+
+
+def lower_listener(lst: dict[str, Any]) -> bytes:
+    """envoy.config.listener.v3.Listener JSON → proto bytes."""
+    sa = (lst.get("address") or {}).get("socket_address") or {}
+    msg: dict[str, Any] = {
+        "name": lst["name"],
+        "address": {"socket_address": {
+            "address": sa.get("address", ""),
+            "port_value": sa.get("port_value", 0)}},
+        "filter_chains": [],
+    }
+    for fc in lst.get("filter_chains") or []:
+        chain: dict[str, Any] = {
+            "filters": [_lower_filter(f)
+                        for f in fc.get("filters") or []]}
+        fcm = fc.get("filter_chain_match")
+        if fcm:
+            if set(fcm) - {"server_names"}:
+                raise UnloweredShape(f"filter_chain_match {fcm!r}")
+            chain["filter_chain_match"] = {
+                "server_names": list(fcm.get("server_names") or [])}
+        if fc.get("transport_socket"):
+            chain["transport_socket"] = _transport_socket(
+                fc["transport_socket"])
+        msg["filter_chains"].append(chain)
+    return encode(_LISTENER, msg)

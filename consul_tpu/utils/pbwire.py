@@ -56,17 +56,22 @@ def decode_varint(buf: bytes, off: int) -> tuple[int, int]:
 
 
 class Field:
-    """One field spec: (number, kind, [nested spec], repeated)."""
+    """One field spec: (number, kind, [nested spec], repeated).
+    presence=True forces emitting an EMPTY sub-message — proto3
+    message fields have explicit presence, and some carry meaning by
+    mere existence (e.g. ConfigSource.ads, an empty oneof arm)."""
 
-    __slots__ = ("num", "kind", "spec", "repeated")
+    __slots__ = ("num", "kind", "spec", "repeated", "presence")
 
     def __init__(self, num: int, kind: str,
                  spec: Optional[dict[str, "Field"]] = None,
-                 repeated: bool = False) -> None:
+                 repeated: bool = False,
+                 presence: bool = False) -> None:
         self.num = num
         self.kind = kind  # int|bool|string|bytes|message
         self.spec = spec
         self.repeated = repeated
+        self.presence = presence
 
 
 def encode(spec: dict[str, Field], msg: dict[str, Any]) -> bytes:
@@ -106,7 +111,8 @@ def _encode_one(f: Field, v: Any) -> bytes:
         raise ValueError(f"unknown field kind {f.kind}")
     if not bv and not f.repeated and f.kind != "message":
         return b""
-    if f.kind == "message" and not bv and not f.repeated:
+    if f.kind == "message" and not bv and not f.repeated \
+            and not f.presence:
         return b""  # empty sub-message elided (canonical proto3)
     return encode_varint((f.num << 3) | 2) + encode_varint(len(bv)) + bv
 

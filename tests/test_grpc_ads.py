@@ -29,6 +29,7 @@ from helpers import wait_for  # noqa: E402
 
 ADS_METHOD = ("/envoy.service.discovery.v3.AggregatedDiscoveryService"
               "/DeltaAggregatedResources")
+PROXY_ID = "web1-sidecar-proxy"
 
 
 @pytest.fixture(scope="module")
@@ -246,3 +247,84 @@ def test_pbwire_matches_real_protobuf_runtime():
     fm = field_mask_pb2.FieldMask(paths=["a.b", "c"])
     FM = {"paths": Field(1, "string", repeated=True)}
     assert encode(FM, {"paths": ["a.b", "c"]}) == fm.SerializeToString()
+
+
+def test_cds_lds_payloads_are_true_proto(agent, client):
+    """CDS/LDS payloads over delta-ADS decode as REAL envoy proto
+    messages (xds_proto lowering), not JSON."""
+    from consul_tpu.server.grpc_external import (CDS_TYPE, LDS_TYPE,
+                                                 build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.utils.pbwire import decode
+
+    cfg = build_config(agent, PROXY_ID)
+    assert cfg is not None
+    cds = resources_from_cfg(cfg, CDS_TYPE)
+    assert cds
+    for name, (_, blob) in cds.items():
+        assert not blob.startswith(b"{"), f"{name} fell back to JSON"
+        msg = decode(xp._CLUSTER, blob)
+        assert msg["name"] == name
+        if name.startswith("upstream_"):
+            ts = msg["transport_socket"]
+            assert ts["typed_config"]["type_url"] == xp.UPSTREAM_TLS_TYPE
+            tls = decode(xp._UPSTREAM_TLS,
+                         ts["typed_config"]["value"])
+            certs = tls["common_tls_context"]["tls_certificates"]
+            assert "BEGIN CERTIFICATE" in \
+                certs[0]["certificate_chain"]["inline_string"]
+    lds = resources_from_cfg(cfg, LDS_TYPE)
+    assert lds
+    for name, (_, blob) in lds.items():
+        assert not blob.startswith(b"{"), f"{name} fell back to JSON"
+        msg = decode(xp._LISTENER, blob)
+        assert msg["name"] == name
+        chains = msg["filter_chains"]
+        assert chains
+        # public listener: mTLS + tcp_proxy (and RBAC when intentions
+        # exist); every filter's Any is a known type with proto bytes
+        for fc in chains:
+            for f in fc["filters"]:
+                at = f["typed_config"]["type_url"]
+                assert at in (xp.TCP_PROXY_TYPE, xp.NETWORK_RBAC_TYPE)
+                if at == xp.TCP_PROXY_TYPE:
+                    tp = decode(xp._TCP_PROXY,
+                                f["typed_config"]["value"])
+                    assert tp["cluster"]
+    pub = decode(xp._LISTENER, lds["public_listener"][1])
+    ts = pub["filter_chains"][0]["transport_socket"]
+    assert ts["typed_config"]["type_url"] == xp.DOWNSTREAM_TLS_TYPE
+    dtls = decode(xp._DOWNSTREAM_TLS, ts["typed_config"]["value"])
+    assert dtls["require_client_certificate"]["value"] is True
+
+
+def test_rbac_lowering_with_intentions(agent, client):
+    """Deny+allow intentions lower into ordered RBAC proto filters."""
+    from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.utils.pbwire import decode
+
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "evil", "DestinationName": "web",
+            "Action": "deny"}}, "test")
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        pub = decode(xp._LISTENER, lds["public_listener"][1])
+        filters = pub["filter_chains"][0]["filters"]
+        rbacs = [f for f in filters
+                 if f["typed_config"]["type_url"] == xp.NETWORK_RBAC_TYPE]
+        assert rbacs, "deny intention must add an RBAC filter"
+        rules = decode(xp._NETWORK_RBAC,
+                       rbacs[0]["typed_config"]["value"])["rules"]
+        assert rules["action"] == 1  # DENY
+        pol = rules["policies"][0]["value"]
+        pn = pol["principals"][0]["authenticated"]["principal_name"]
+        assert pn["suffix"] == "/svc/evil"
+    finally:
+        agent.server.handle_rpc("Intention.Apply", {
+            "Op": "delete", "Intention": {
+                "SourceName": "evil", "DestinationName": "web"}}, "test")
