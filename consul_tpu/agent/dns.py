@@ -181,8 +181,16 @@ class DNSServer:
         # rebuild question section canonically
         question = _encode_name(qname) + struct.pack(">HH", qtype, qclass)
         payload = b"".join(answers)
+        authority = b""
+        ns_count = 0
+        if authoritative and not answers:
+            # negative answer (NXDOMAIN or NODATA) in OUR domain: the
+            # SOA rides the authority section so resolvers can cache
+            # the negative per RFC 2308 (dns.go addSOA)
+            authority = self._soa_record()
+            ns_count = 1
         resp = struct.pack(">HHHHHH", qid, hdr_flags, 1, len(answers),
-                           0, 0) + question + payload
+                           ns_count, 0) + question + payload + authority
         if len(resp) > udp_size:
             # truncate: header with TC bit, no answers
             resp = struct.pack(">HHHHHH", qid, hdr_flags | 0x0200, 1, 0,
@@ -204,6 +212,17 @@ class DNSServer:
         return None
 
     # ------------------------------------------------------------- resolve
+
+    def _soa_record(self) -> bytes:
+        """The domain's SOA (dns.go makeSOA): minimum TTL 0 so negative
+        answers aren't cached into staleness by resolvers."""
+        import time as _time
+
+        rdata = (_encode_name(f"ns.{self.domain}.")
+                 + _encode_name(f"hostmaster.{self.domain}.")
+                 + struct.pack(">IIIII", int(_time.time()), 3600, 600,
+                               86400, 0))
+        return _rr(f"{self.domain}.", QTYPE_SOA, 0, rdata)
 
     def resolve(self, qname: str, qtype: int
                 ) -> tuple[Optional[list[bytes]], bool, Optional[int]]:
@@ -232,6 +251,25 @@ class DNSServer:
         ttl = int(self.agent.config.dns_node_ttl)
 
         if not parts:
+            # domain apex: SOA and NS are answerable (dns.go makeSOA /
+            # ns records — real resolvers need them for caching)
+            if qtype in (QTYPE_SOA, QTYPE_ANY):
+                return [self._soa_record()], True
+            if qtype == QTYPE_NS:
+                return [_rr(f"{self.domain}.", QTYPE_NS, ttl,
+                            _encode_name(f"ns.{self.domain}."))], True
+            return [], True
+        if parts == ["ns"]:
+            # ns.<domain> resolves to this agent (dns.go nameservers)
+            import socket as _socket
+
+            try:
+                addr = _socket.inet_aton(
+                    self.agent.advertise_addr() or "127.0.0.1")
+            except OSError:
+                addr = _socket.inet_aton("127.0.0.1")
+            if qtype in (QTYPE_A, QTYPE_ANY):
+                return [_rr(qname, QTYPE_A, ttl, addr)], True
             return [], True
         kind = parts[-1]
         if kind == "node" and len(parts) >= 2:

@@ -230,11 +230,25 @@ def test_dns_node_and_service_lookups(agent, client):
     assert an >= 1
     assert struct.pack(">H", 5432) in resp
 
-    # unknown name → NXDOMAIN (rcode 3)
+    # unknown name → NXDOMAIN (rcode 3) with the SOA in the authority
+    # section (RFC 2308 negative caching; dns.go addSOA)
     resp = dns_query("nope.service.consul.", 1)
-    (_, flags, _, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    (_, flags, _, an, ns, _) = struct.unpack_from(">HHHHHH", resp)
     assert flags & 0x000F == 3
     assert an == 0
+    assert ns == 1, "negative answer must carry the SOA"
+    assert b"hostmaster" in resp
+
+    # apex SOA and NS are answerable (dns.go makeSOA / nameservers)
+    resp = dns_query("consul.", 6)  # SOA
+    (_, _, _, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    assert an == 1 and b"hostmaster" in resp
+    resp = dns_query("consul.", 2)  # NS
+    (_, _, _, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    assert an == 1 and b"\x02ns" in resp
+    resp = dns_query("ns.consul.", 1)  # the nameserver's A record
+    (_, _, _, an, _, _) = struct.unpack_from(">HHHHHH", resp)
+    assert an == 1
 
 
 def test_event_fire_and_serf_delivery(agent, client):
