@@ -378,12 +378,37 @@ def register_endpoints(srv) -> None:
     def txn_apply(args):
         az = authz(args)
         for op in args.get("Ops") or []:
-            kv = op.get("KV") or {}
-            verb, key = kv.get("Verb", "set"), kv.get("Key", "")
-            if verb in ("get", "check-index", "check-not-exists"):
-                require(az.key_read(key), f"key read on {key!r}")
-            else:
-                require(az.key_write(key), f"key write on {key!r}")
+            if op.get("KV"):
+                kv = op["KV"]
+                verb, key = kv.get("Verb", "set"), kv.get("Key", "")
+                if verb in ("get", "check-index", "check-not-exists"):
+                    require(az.key_read(key), f"key read on {key!r}")
+                else:
+                    require(az.key_write(key), f"key write on {key!r}")
+                continue
+            # catalog families (txn_endpoint.go): node/service/check
+            if op.get("Node"):
+                name = (op["Node"].get("Node") or {}).get("Node", "")
+                if op["Node"].get("Verb", "set") == "get":
+                    require(az.node_read(name), f"node read {name!r}")
+                else:
+                    require(az.node_write(name), f"node write {name!r}")
+            elif op.get("Service"):
+                svc = (op["Service"].get("Service") or {})
+                name = svc.get("Service", "")
+                if op["Service"].get("Verb", "set") == "get":
+                    require(az.service_read(name),
+                            f"service read {name!r}")
+                else:
+                    require(az.service_write(name),
+                            f"service write {name!r}")
+            elif op.get("Check"):
+                node = op["Check"].get("Node", "") or (
+                    op["Check"].get("Check") or {}).get("Node", "")
+                if op["Check"].get("Verb", "set") == "get":
+                    require(az.node_read(node), f"node read {node!r}")
+                else:
+                    require(az.node_write(node), f"node write {node!r}")
         return srv.forward_or_apply(MessageType.TXN, clean(args))
 
     write("Txn.Apply", txn_apply)

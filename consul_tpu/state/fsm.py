@@ -192,8 +192,13 @@ class FSM:
             for op in ops:
                 kv = op.get("KV")
                 if not kv:
-                    return {"Errors": [
-                        {"What": "only KV txn ops supported"}]}
+                    # catalog op families (txn_endpoint.go Node/
+                    # Service/Check verbs) verify in _txn_catalog_check
+                    err = self._txn_catalog_check(op, len(results))
+                    if err is not None:
+                        return {"Errors": [err]}
+                    results.append(("catalog", op, None))
+                    continue
                 verb = kv.get("Verb", "set")
                 key = kv.get("Key", "")
                 cur = self.store.kv_get(key)
@@ -221,6 +226,11 @@ class FSM:
                 results.append((verb, kv, cur))
             out = []
             for verb, kv, cur in results:
+                if verb == "catalog":
+                    res = self._txn_catalog_apply(kv)
+                    if res is not None:
+                        out.append(res)
+                    continue
                 key = kv.get("Key", "")
                 if verb in ("set", "cas"):
                     self.store.kv_set(key, kv.get("Value") or b"",
@@ -233,6 +243,90 @@ class FSM:
                 elif verb == "get":
                     out.append({"KV": cur.to_dict() if cur else None})
             return {"Results": out, "Errors": None}
+
+    def _txn_catalog_check(self, op: dict[str, Any],
+                           op_index: int) -> Optional[dict[str, Any]]:
+        """Verify phase for Node/Service/Check txn ops."""
+        for fam in ("Node", "Service", "Check"):
+            body = op.get(fam)
+            if body is None:
+                continue
+            verb = body.get("Verb", "set")
+            if verb not in ("set", "get", "delete", "cas"):
+                return {"OpIndex": op_index,
+                        "What": f"unknown {fam} verb {verb!r}"}
+            if fam == "Node":
+                name = (body.get("Node") or {}).get("Node", "")
+                if not name:
+                    return {"OpIndex": op_index, "What": "missing node"}
+                cur = self.store.get_node(name)
+            elif fam == "Service":
+                node = body.get("Node", "")
+                sid = (body.get("Service") or {}).get("ID") \
+                    or (body.get("Service") or {}).get("Service", "")
+                cur = next((s for s in self.store.node_services(node)
+                            if s.id == sid), None)
+            else:
+                node = body.get("Node", "") or (
+                    body.get("Check") or {}).get("Node", "")
+                cid = (body.get("Check") or {}).get("CheckID", "")
+                cur = next((c for c in self.store.node_checks(node)
+                            if c.check_id == cid), None)
+            if verb == "cas":
+                want = body.get("Index", 0)
+                if cur is None or cur.modify_index != want:
+                    return {"OpIndex": op_index,
+                            "What": f"{fam.lower()} cas failed"}
+            if verb in ("get", "delete") and verb == "get" \
+                    and cur is None:
+                return {"OpIndex": op_index,
+                        "What": f"{fam.lower()} not found"}
+            return None
+        return {"OpIndex": op_index, "What": "empty txn op"}
+
+    def _txn_catalog_apply(self, op: dict[str, Any]
+                           ) -> Optional[dict[str, Any]]:
+        """Mutate phase for Node/Service/Check txn ops (verified)."""
+        if (body := op.get("Node")) is not None:
+            verb = body.get("Verb", "set")
+            n = body.get("Node") or {}
+            name = n.get("Node", "")
+            if verb in ("set", "cas"):
+                self.store.ensure_registration(
+                    name, address=n.get("Address", ""),
+                    node_id=n.get("ID", ""),
+                    node_meta=n.get("Meta"),
+                    partition=n.get("Partition", ""))
+            elif verb == "delete":
+                self.store.delete_node(name)
+            cur = self.store.get_node(name)
+            return {"Node": cur.to_dict()} if cur else None
+        if (body := op.get("Service")) is not None:
+            verb = body.get("Verb", "set")
+            node = body.get("Node", "")
+            svc = body.get("Service") or {}
+            sid = svc.get("ID") or svc.get("Service", "")
+            if verb in ("set", "cas"):
+                self.store.ensure_registration(
+                    node, service=svc)
+            elif verb == "delete":
+                self.store.delete_service(node, sid)
+            cur = next((s for s in self.store.node_services(node)
+                        if s.id == sid), None)
+            return {"Service": cur.to_dict()} if cur else None
+        if (body := op.get("Check")) is not None:
+            verb = body.get("Verb", "set")
+            chk = body.get("Check") or {}
+            node = body.get("Node", "") or chk.get("Node", "")
+            cid = chk.get("CheckID", "")
+            if verb in ("set", "cas"):
+                self.store.ensure_registration(node, check=chk)
+            elif verb == "delete":
+                self.store.delete_check(node, cid)
+            cur = next((c for c in self.store.node_checks(node)
+                        if c.check_id == cid), None)
+            return {"Check": cur.to_dict()} if cur else None
+        return None
 
     def _apply_tombstone_reap(self, b: dict[str, Any], idx: int) -> Any:
         """Reap the leader-chosen tombstone keys on every replica

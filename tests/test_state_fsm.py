@@ -266,3 +266,52 @@ def test_kv_tombstones_and_prefix_index(fsm):
     clone = FSM()
     clone.restore(fsm.snapshot())
     assert "b/y" in clone.store._kv_tombstones
+
+
+def test_txn_catalog_ops(fsm):
+    """Txn node/service/check families (txn_endpoint.go): mixed-verb
+    transactions mutate the catalog atomically; a failed CAS rolls
+    everything back."""
+    out = fsm.apply(encode_command(MessageType.TXN, {"Ops": [
+        {"Node": {"Verb": "set", "Node": {"Node": "tx-n1",
+                                          "Address": "10.1.1.1"}}},
+        {"Service": {"Verb": "set", "Node": "tx-n1",
+                     "Service": {"ID": "tx-s1", "Service": "txsvc",
+                                 "Port": 81}}},
+        {"Check": {"Verb": "set", "Node": "tx-n1",
+                   "Check": {"CheckID": "tx-c1", "Name": "c",
+                             "Status": "passing"}}},
+        {"KV": {"Verb": "set", "Key": "tx/k", "Value": b"v"}},
+    ]}), 1)
+    assert out["Errors"] is None
+    assert fsm.store.get_node("tx-n1").address == "10.1.1.1"
+    assert [s.id for s in fsm.store.node_services("tx-n1")] == ["tx-s1"]
+    assert [c.check_id for c in fsm.store.node_checks("tx-n1")] \
+        == ["tx-c1"]
+
+    # node CAS with a stale index fails the WHOLE txn: the kv write
+    # alongside it must not land
+    idx = fsm.store.get_node("tx-n1").modify_index
+    out = fsm.apply(encode_command(MessageType.TXN, {"Ops": [
+        {"Node": {"Verb": "cas", "Index": idx + 999,
+                  "Node": {"Node": "tx-n1", "Address": "10.2.2.2"}}},
+        {"KV": {"Verb": "set", "Key": "tx/should-not-land",
+                "Value": b"x"}},
+    ]}), 2)
+    assert out["Errors"]
+    assert fsm.store.get_node("tx-n1").address == "10.1.1.1"
+    assert fsm.store.kv_get("tx/should-not-land") is None
+
+    # valid CAS + deletes
+    out = fsm.apply(encode_command(MessageType.TXN, {"Ops": [
+        {"Node": {"Verb": "cas", "Index": idx,
+                  "Node": {"Node": "tx-n1", "Address": "10.3.3.3"}}},
+        {"Check": {"Verb": "delete", "Node": "tx-n1",
+                   "Check": {"CheckID": "tx-c1"}}},
+        {"Service": {"Verb": "delete", "Node": "tx-n1",
+                     "Service": {"ID": "tx-s1"}}},
+    ]}), 3)
+    assert out["Errors"] is None
+    assert fsm.store.get_node("tx-n1").address == "10.3.3.3"
+    assert fsm.store.node_services("tx-n1") == []
+    assert fsm.store.node_checks("tx-n1") == []
