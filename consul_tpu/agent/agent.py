@@ -108,6 +108,12 @@ class Agent:
         seeds = list(self.config.retry_join_lan)
         if seeds:
             self._retry_join(seeds)
+        # reload persisted registrations BEFORE anti-entropy starts so
+        # the first sync pushes them (agent.go:769 loadServices/
+        # loadChecks/restoreCheckState)
+        loaded = self.load_persisted()
+        if loaded:
+            self.log.info("loaded %d persisted registrations", loaded)
         self.sync.start()
         self._coord_loop()
         # keyring ops propagate cluster-wide as internal user events
@@ -420,7 +426,88 @@ class Agent:
 
     # -------------------------------------------------- service/check mgmt
 
-    def register_service(self, defn: dict[str, Any]) -> None:
+    # -------------------------------------------------- local persistence
+    # (agent/agent.go persistService/persistCheck + loadServices/
+    # loadChecks at :769: registrations survive agent restarts)
+
+    def _persist_path(self, kind: str, ident: str) -> Optional[str]:
+        if not self.config.data_dir:
+            return None
+        import base64 as _b64
+        import os as _os
+
+        d = _os.path.join(self.config.data_dir, kind)
+        _os.makedirs(d, exist_ok=True)
+        return _os.path.join(
+            d, _b64.urlsafe_b64encode(ident.encode()).decode() + ".json")
+
+    def _persist(self, kind: str, ident: str, payload: dict) -> None:
+        import json as _json
+
+        path = self._persist_path(kind, ident)
+        if path is None:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(payload, f)
+        import os as _os
+
+        _os.replace(tmp, path)
+
+    def _unpersist(self, kind: str, ident: str) -> None:
+        path = self._persist_path(kind, ident)
+        if path is not None:
+            import os as _os
+
+            try:
+                _os.unlink(path)
+            except OSError:
+                pass
+
+    def load_persisted(self) -> int:
+        """Reload persisted services/checks (+ unexpired TTL states)
+        into local state; returns how many registrations loaded."""
+        if not self.config.data_dir:
+            return 0
+        import json as _json
+        import os as _os
+        import time as _time
+
+        n = 0
+        for kind, register in (("services", self.register_service),
+                               ("checks", self.register_check)):
+            d = _os.path.join(self.config.data_dir, kind)
+            if not _os.path.isdir(d):
+                continue
+            for fn in sorted(_os.listdir(d)):
+                if not fn.endswith(".json"):
+                    continue
+                try:
+                    with open(_os.path.join(d, fn)) as f:
+                        register(_json.load(f), persist=False)
+                    n += 1
+                except Exception as e:  # noqa: BLE001
+                    self.log.warning("persisted %s %s unreadable: %s",
+                                     kind, fn, e)
+        # TTL check state (persistCheckState): restore status if the
+        # TTL window hasn't lapsed across the restart
+        d = _os.path.join(self.config.data_dir, "check_state")
+        if _os.path.isdir(d):
+            for fn in sorted(_os.listdir(d)):
+                try:
+                    with open(_os.path.join(d, fn)) as f:
+                        st = _json.load(f)
+                    if st.get("Expires", 0) > _time.time():
+                        self.local.update_check(
+                            st["CheckID"],
+                            CheckStatus(st.get("Status", "critical")),
+                            st.get("Output", ""))
+                except Exception:  # noqa: BLE001
+                    continue
+        return n
+
+    def register_service(self, defn: dict[str, Any],
+                         persist: bool = True) -> None:
         """/v1/agent/service/register (agent/agent.go addServiceLocked)."""
         svc = LocalService(
             id=defn.get("ID") or defn.get("Name", ""),
@@ -436,6 +523,8 @@ class Agent:
         # the anti-entropy sync must never push pre-merge content
         self._merge_central_defaults(svc)
         self.local.add_service(svc)
+        if persist:
+            self._persist("services", svc.id, defn)
         checks = list(defn.get("Checks") or [])
         if defn.get("Check"):
             checks.append(defn["Check"])
@@ -445,7 +534,9 @@ class Agent:
                           + (f":{i + 1}" if len(checks) > 1 else ""))
             cd.setdefault("Name", f"Service '{svc.service}' check")
             cd["ServiceID"] = svc.id
-            self.register_check(cd)
+            # embedded checks reload with the service defn — no
+            # separate persistence
+            self.register_check(cd, persist=False)
         # Connect sidecar expansion: registering a service with
         # Connect.SidecarService auto-registers its proxy
         # (agent/sidecar_service.go)
@@ -470,9 +561,11 @@ class Agent:
                     "Name": f"Connect Sidecar Aliasing {svc.id}",
                     "AliasService": svc.id,
                 }]
-            self.register_service(sc)
+            # the sidecar re-derives from the parent defn at reload
+            self.register_service(sc, persist=False)
 
     def deregister_service(self, service_id: str) -> bool:
+        self._unpersist("services", service_id)
         for cid, runner in list(self._runners.items()):
             chk = self.local.list_checks().get(cid)
             if chk is not None and chk.service_id == service_id:
@@ -560,8 +653,11 @@ class Agent:
                 return port
         raise RPCError("sidecar port range exhausted (21000-21255)")
 
-    def register_check(self, defn: dict[str, Any]) -> None:
+    def register_check(self, defn: dict[str, Any],
+                       persist: bool = True) -> None:
         cid = defn.get("CheckID") or defn.get("Name", "")
+        if persist:
+            self._persist("checks", cid, defn)
         chk = LocalCheck(
             check_id=cid, name=defn.get("Name", cid),
             notes=defn.get("Notes", ""),
@@ -578,6 +674,7 @@ class Agent:
             runner.start()
 
     def deregister_check(self, check_id: str) -> bool:
+        self._unpersist("checks", check_id)
         runner = self._runners.pop(check_id, None)
         if runner is not None:
             runner.stop()
@@ -588,6 +685,14 @@ class Agent:
         runner = self._runners.get(check_id)
         if isinstance(runner, TTLCheck):
             runner.refresh(status, output)
+            # persistCheckState: a restart inside the TTL window keeps
+            # the reported status instead of reverting to critical
+            import time as _time
+
+            self._persist("check_state", check_id, {
+                "CheckID": check_id, "Status": status.value,
+                "Output": output,
+                "Expires": _time.time() + runner.ttl})
             return True
         return self.local.update_check(check_id, status, output)
 

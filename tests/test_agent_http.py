@@ -646,3 +646,50 @@ def test_web_ui_served(agent, client):
             body = r.read().decode()
         assert "consul-tpu" in body
         assert "/v1/internal/ui/services" in body  # data API wired
+
+
+def test_agent_persists_registrations_across_restart(tmp_path):
+    """agent.go:769 loadServices/loadChecks + persistCheckState: local
+    registrations and in-window TTL status survive an agent restart."""
+    data_dir = str(tmp_path / "agent-data")
+    cfg = load(dev=True, overrides={
+        "node_name": "persist-a", "data_dir": data_dir})
+    a = Agent(cfg)
+    a.start(serve_http=False, serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="leadership")
+        a.register_service({
+            "Name": "keeper", "ID": "keeper-1", "Port": 1234,
+            "Check": {"TTL": "600s"}})
+        a.register_check({"CheckID": "solo-chk", "Name": "solo",
+                          "TTL": "600s"})
+        a.update_ttl_check("service:keeper-1", CheckStatus.PASSING,
+                           "all good")
+    finally:
+        a.shutdown()
+
+    # fresh process-equivalent: a NEW agent over the same data_dir
+    a2 = Agent(load(dev=True, overrides={
+        "node_name": "persist-a", "data_dir": data_dir}))
+    a2.start(serve_http=False, serve_dns=False)
+    try:
+        svcs = a2.local.list_services()
+        assert "keeper-1" in svcs and svcs["keeper-1"].port == 1234
+        checks = a2.local.list_checks()
+        assert "solo-chk" in checks
+        # TTL state restored within the window: still passing, not
+        # reverted to critical
+        assert checks["service:keeper-1"].status == CheckStatus.PASSING
+        assert "all good" in checks["service:keeper-1"].output
+        # deregistration removes persistence
+        a2.deregister_service("keeper-1")
+    finally:
+        a2.shutdown()
+    a3 = Agent(load(dev=True, overrides={
+        "node_name": "persist-a", "data_dir": data_dir}))
+    a3.start(serve_http=False, serve_dns=False)
+    try:
+        assert "keeper-1" not in a3.local.list_services()
+        assert "solo-chk" in a3.local.list_checks()
+    finally:
+        a3.shutdown()
