@@ -186,6 +186,8 @@ class HTTPApi:
                 args["MaxQueryTime"] = _dur(q["wait"])
             if "stale" in q:
                 args["AllowStale"] = True
+            if "consistent" in q:
+                args["RequireConsistent"] = True
             if "partition" in q:
                 args["Partition"] = q["partition"]
             return args

@@ -250,6 +250,8 @@ class RuntimeConfig:
     # 0 disables). Requests/second across all clients.
     rpc_rate_limit: float = 0.0
     rpc_rate_burst: int = 500
+    # per-client-IP RPC connection cap (limits.rpc_max_conns_per_client)
+    rpc_max_conns_per_client: int = 100
 
     # Simulation backend (`agent -dev -gossip-sim=tpu`, BASELINE north star)
     gossip_sim: str = ""          # "" (off) | "tpu" | "cpu"

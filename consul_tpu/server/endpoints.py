@@ -62,11 +62,20 @@ def register_endpoints(srv) -> None:
     def read(name, fn):
         """Register a read endpoint with consistency modes (rpc.go
         ForwardRPC): default → forwarded to the leader (read-your-writes);
-        AllowStale → served from local replicated state."""
+        AllowStale → served from local replicated state; ?consistent →
+        the leader commits a BARRIER first, so the read is linearizable
+        even across an unnoticed leadership loss (consistentRead,
+        rpc.go RequiredConsistent path)."""
 
         def wrapper(args):
             if not args.get("AllowStale") and not srv.is_leader():
                 return srv._forward_to_leader(name, args)
+            if args.get("RequireConsistent") and srv.is_leader():
+                try:
+                    srv.raft.barrier(timeout=5.0)
+                except Exception as ex:  # noqa: BLE001
+                    raise RPCError(
+                        f"consistent read unavailable: {ex}") from ex
             return fn(args)
 
         e[name] = wrapper

@@ -147,17 +147,39 @@ class RPCServer:
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
                 sock = self.request
-                # track live conns so shutdown() can close them: a
-                # downed server must EOF its clients — parked queries
-                # and subscribe streams detect death by read error,
-                # not by silence
+                ip = self.client_address[0]
+                # per-IP conn limit (connlimit, rpc.go:135-142): one
+                # misbehaving client must not exhaust the listener's
+                # fds for the whole fleet
                 with outer._conns_lock:
-                    outer._conns.add(sock)
+                    n = outer._conns_by_ip.get(ip, 0)
+                    if n >= outer.max_conns_per_ip:
+                        over = True
+                    else:
+                        over = False
+                        outer._conns_by_ip[ip] = n + 1
+                        # track live conns so shutdown() can close
+                        # them: a downed server must EOF its clients
+                        outer._conns.add(sock)
+                if over:
+                    outer.log.warning(
+                        "refusing conn from %s: per-IP limit (%d)",
+                        ip, outer.max_conns_per_ip)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return
                 try:
                     self._handle_tagged(sock)
                 finally:
                     with outer._conns_lock:
                         outer._conns.discard(sock)
+                        left = outer._conns_by_ip.get(ip, 1) - 1
+                        if left <= 0:
+                            outer._conns_by_ip.pop(ip, None)
+                        else:
+                            outer._conns_by_ip[ip] = left
 
             def _handle_tagged(self, sock) -> None:
                 try:
@@ -216,6 +238,9 @@ class RPCServer:
         # .ingest_stream(src, data) -> bytes
         self.gossip_ingest = None
         self._conns: set = set()
+        self._conns_by_ip: dict[str, int] = {}
+        # reference default: limits.rpc_max_conns_per_client=100
+        self.max_conns_per_ip = 100
         self._conns_lock = threading.Lock()
         from concurrent.futures import ThreadPoolExecutor
 

@@ -51,6 +51,9 @@ class Server:
         self.scheduler = RealTimers()
         self._shutdown = False
         self._controller_manager = None
+        # autopilot stabilization: when each not-yet-voting server was
+        # first seen in serf (cleared once it joins raft)
+        self._server_first_seen: dict[str, float] = {}
 
         # L1: replicated state
         self.fsm = FSM()
@@ -59,6 +62,7 @@ class Server:
         # RPC port (serves consul RPC + raft)
         self.rpc = RPCServer(rpc_bind or config.bind_addr,
                              config.port("server"))
+        self.rpc.max_conns_per_ip = config.rpc_max_conns_per_client
         self.pool = ConnPool()
         # per-(area, dc) server tracking with failover + rebalance
         # (agent/router; WAN managers feed _forward_dc)
@@ -581,6 +585,30 @@ class Server:
         if len(servers) < expect:
             return
         addrs = sorted(s["rpc_addr"] for s in servers if s["rpc_addr"])
+        # sanity check BEFORE seeding (server_serf.go:441-463): ask the
+        # other servers for their raft peer sets — if ANY already has a
+        # configuration, this cluster bootstrapped long ago and we are
+        # a LATE JOINER who must wait to be added, not seed a second
+        # raft cluster and steal leadership with a fresh term
+        for addr in addrs:
+            if addr == self.rpc.addr:
+                continue
+            try:
+                stats = self.pool.call(addr, "Status.RaftStats",
+                                       {"AllowStale": True},
+                                       timeout=3.0)
+            except (OSError, RPCError):
+                continue  # unreachable: assume not bootstrapped
+            # a non-empty LOG (or a multi-member config) means a raft
+            # already exists somewhere — a pristine passive node has
+            # last_log_index 0 and only itself in the peer set
+            if stats.get("last_log_index", 0) > 0 \
+                    or stats.get("num_peers", 0) > 0:
+                self.log.info(
+                    "existing raft found via %s; skipping bootstrap",
+                    addr)
+                self._maybe_bootstrapped = True
+                return
         self._maybe_bootstrapped = True
         if addrs and addrs[0] == self.rpc.addr:
             self.log.info("bootstrapping raft (expect=%d reached)", expect)
@@ -632,9 +660,31 @@ class Server:
             self._full_reconcile()
             self._ensure_initial_management_token()
             self._write_system_metadata()
-        # raft membership follows serf server membership (autopilot-lite)
+        # raft membership follows serf server membership (autopilot)
         servers = {s["rpc_addr"] for s in self._servers() if s["rpc_addr"]}
+        now = time.monotonic()
         for addr in servers - self.raft.peers:
+            self._server_first_seen.setdefault(addr, now)
+        for addr in list(self._server_first_seen):
+            if addr in self.raft.peers:
+                self._server_first_seen.pop(addr, None)
+        ap_cfg = self.state.raw_get("config_entries",
+                                    "autopilot/config") or {}
+        from consul_tpu.utils.duration import parse_duration
+
+        stab = parse_duration(
+            ap_cfg.get("ServerStabilizationTime", "10s"))
+        forming = len(self.raft.peers) < max(
+            self.config.bootstrap_expect, 1)
+        for addr in servers - self.raft.peers:
+            if not forming and \
+                    now - self._server_first_seen.get(addr, now) < stab:
+                # autopilot ServerStabilizationTime: a server joining an
+                # ESTABLISHED cluster must look healthy for the
+                # stabilization window before it gets a raft vote
+                # (raft-autopilot promotion gate); initial bootstrap is
+                # exempt — there is no cluster to protect yet
+                continue
             self.log.info("adding raft peer %s", addr)
             try:
                 self.raft.add_peer(addr)

@@ -235,3 +235,31 @@ def test_operator_transfer_leader(cluster):
         "local")
     wait_for(lambda: new_leader.state.kv_get("xfer/ok") is not None,
              what="post-transfer write")
+
+
+def test_autopilot_stabilization_gates_new_server(cluster):
+    """A server joining an ESTABLISHED cluster waits out
+    ServerStabilizationTime before getting a raft vote
+    (raft-autopilot promotion gate); shrinking the window via
+    operator config admits it."""
+    servers, leader = cluster
+    # shrink the stabilization window so the test observes the gate
+    # without a 10s sleep
+    leader.handle_rpc("Operator.AutopilotSetConfiguration", {
+        "Config": {"ServerStabilizationTime": "1.5s"}}, "test")
+    cfg = load(dev=True, overrides={
+        "node_name": "late-srv", "bootstrap": False,
+        "bootstrap_expect": 3, "server": True})
+    late = Server(cfg)
+    late.start()
+    try:
+        assert late.join([servers[0].serf.memberlist.transport.addr]) == 1
+        # immediately after joining serf it must NOT be a raft peer
+        time.sleep(0.6)
+        assert late.rpc.addr not in leader.raft.peers, \
+            "stabilization window ignored"
+        # after the window it gets promoted
+        wait_for(lambda: late.rpc.addr in leader.raft.peers,
+                 timeout=20, what="post-stabilization promotion")
+    finally:
+        late.shutdown()
