@@ -184,6 +184,10 @@ class HTTPApi:
                 args["MinQueryIndex"] = int(q["index"])
             if "wait" in q:
                 args["MaxQueryTime"] = _dur(q["wait"])
+            if "stale" in q and "consistent" in q:
+                # conflicting modes (http.go parseConsistency)
+                raise HTTPError(400, "cannot specify both stale and "
+                                     "consistent")
             if "stale" in q:
                 args["AllowStale"] = True
             if "consistent" in q:

@@ -410,6 +410,20 @@ def cmd_operator(args) -> int:
               else "Transfer failed")
         return 0 if (res or {}).get("Success") else 1
     if args.operator_cmd == "usage":
+        if getattr(args, "usage_cmd", None) == "instances":
+            # operator usage instances: per-service instance breakdown
+            # + totals (command/operator/usage/instances)
+            svcs = c.get("/v1/internal/ui/services")
+            rows = [("Services", "Service instances")]
+            for s in sorted(svcs, key=lambda s: s.get("Name", "")):
+                rows.append((s.get("Name", ""),
+                             str(s.get("InstanceCount", 0))))
+            _table(rows)
+            print()
+            print(f"Total Services: {len(svcs)}")
+            print("Total Service instances: "
+                  f"{sum(s.get('InstanceCount', 0) for s in svcs)}")
+            return 0
         usage = c.get("/v1/operator/usage")
         for k, v in sorted(usage.items()):
             print(f"{k}: {v}")
@@ -545,6 +559,24 @@ def cmd_acl(args) -> int:
             print(json.dumps(c.get(f"/v1/acl/token/{args.id}"),
                              indent=2))
             return 0
+        if args.acl_sub == "update":
+            # read-merge-put (command/acl/token/update): policies are
+            # MERGED with existing unless -no-merge
+            tok = c.get(f"/v1/acl/token/{args.id}")
+            if args.description:
+                tok["Description"] = args.description
+            if args.policy_name:
+                new = [{"Name": n} for n in args.policy_name]
+                if args.no_merge:
+                    tok["Policies"] = new
+                else:
+                    have = {p.get("Name")
+                            for p in tok.get("Policies") or []}
+                    tok["Policies"] = (tok.get("Policies") or []) + [
+                        p for p in new if p["Name"] not in have]
+            print(json.dumps(
+                c.put(f"/v1/acl/token/{args.id}", body=tok), indent=2))
+            return 0
         if args.acl_sub == "clone":
             src = c.get(f"/v1/acl/token/{args.id}")
             body = {k: src[k] for k in ("Policies", "Roles",
@@ -566,6 +598,19 @@ def cmd_acl(args) -> int:
                         body={"Name": args.name, "Rules": rules or "{}"})
             print(json.dumps(pol, indent=2))
             return 0
+        if args.acl_sub == "update":
+            pol = c.get(f"/v1/acl/policy/{args.id}")
+            if args.name:
+                pol["Name"] = args.name
+            if args.rules:
+                rules = args.rules
+                if rules.startswith("@"):
+                    with open(rules[1:]) as f:
+                        rules = f.read()
+                pol["Rules"] = rules
+            print(json.dumps(
+                c.put(f"/v1/acl/policy/{args.id}", body=pol), indent=2))
+            return 0
         if args.acl_sub == "list":
             for p in c.get("/v1/acl/policies"):
                 print(f"{p.get('ID')}  {p.get('Name','')}")
@@ -580,6 +625,22 @@ def cmd_acl(args) -> int:
             if args.policy_name:
                 body["Policies"] = [{"Name": n} for n in args.policy_name]
             print(json.dumps(c.put("/v1/acl/role", body=body), indent=2))
+            return 0
+        if args.acl_sub == "update":
+            role = c.get(f"/v1/acl/role/{args.id}")
+            if args.name:
+                role["Name"] = args.name
+            if args.policy_name:
+                new = [{"Name": n} for n in args.policy_name]
+                if args.no_merge:
+                    role["Policies"] = new
+                else:
+                    have = {p.get("Name")
+                            for p in role.get("Policies") or []}
+                    role["Policies"] = (role.get("Policies") or []) + [
+                        p for p in new if p["Name"] not in have]
+            print(json.dumps(
+                c.put(f"/v1/acl/role/{args.id}", body=role), indent=2))
             return 0
         if args.acl_sub == "list":
             for r in c.get("/v1/acl/roles"):
@@ -610,6 +671,20 @@ def cmd_acl(args) -> int:
             print(json.dumps(
                 c.get(f"/v1/acl/auth-method/{args.name}"), indent=2))
             return 0
+        if args.acl_sub == "update":
+            meth = c.get(f"/v1/acl/auth-method/{args.name}")
+            if args.config:
+                raw = args.config
+                if raw.startswith("@"):
+                    with open(raw[1:]) as f:
+                        raw = f.read()
+                meth["Config"] = json.loads(raw)
+            if args.description:
+                meth["Description"] = args.description
+            print(json.dumps(
+                c.put(f"/v1/acl/auth-method/{args.name}", body=meth),
+                indent=2))
+            return 0
         if args.acl_sub == "delete":
             c.delete(f"/v1/acl/auth-method/{args.name}")
             print(f"Auth method {args.name} deleted")
@@ -622,6 +697,18 @@ def cmd_acl(args) -> int:
                 "BindName": args.bind_name,
                 "Selector": args.selector})
             print(json.dumps(rule, indent=2))
+            return 0
+        if args.acl_sub == "update":
+            rule = c.get(f"/v1/acl/binding-rule/{args.id}")
+            for attr, key in (("bind_type", "BindType"),
+                              ("bind_name", "BindName"),
+                              ("selector", "Selector")):
+                v = getattr(args, attr, "")
+                if v:
+                    rule[key] = v
+            print(json.dumps(
+                c.put(f"/v1/acl/binding-rule/{args.id}", body=rule),
+                indent=2))
             return 0
         if args.acl_sub == "list":
             for r in c.get("/v1/acl/binding-rules"):
@@ -871,6 +958,156 @@ def cmd_connect(args) -> int:
         except KeyboardInterrupt:
             p.stop()
         return 0
+    if args.connect_cmd == "expose":
+        # command/connect/expose: add the service to an ingress-gateway
+        # listener (creating listener/config entry as needed), then
+        # ensure an allow intention gateway -> service
+        gw = args.ingress_gateway
+        try:
+            conf = c.get(f"/v1/config/ingress-gateway/{gw}")
+        except APIError as e:
+            if e.code != 404:
+                raise
+            conf = {"Kind": "ingress-gateway", "Name": gw,
+                    "Listeners": []}
+        svc_entry: dict = {"Name": args.service}
+        if args.host:
+            svc_entry["Hosts"] = args.host
+        listeners = conf.setdefault("Listeners", [])
+        for ln in listeners:
+            if ln.get("Port") != args.port:
+                continue
+            if (ln.get("Protocol") or "tcp") != args.protocol:
+                print(f"Error: listener on port {args.port} already "
+                      f"configured with conflicting protocol "
+                      f"{ln.get('Protocol')!r}", file=sys.stderr)
+                return 1
+            for i, s in enumerate(ln.get("Services") or []):
+                if s.get("Name") == args.service:
+                    if not args.host and s.get("Hosts"):
+                        # re-expose without -host keeps the stored
+                        # hosts — silently wiping them would break
+                        # host-based routing
+                        svc_entry["Hosts"] = s["Hosts"]
+                    ln["Services"][i] = svc_entry
+                    break
+            else:
+                ln.setdefault("Services", []).append(svc_entry)
+            break
+        else:
+            listeners.append({"Port": args.port,
+                              "Protocol": args.protocol,
+                              "Services": [svc_entry]})
+        c.put("/v1/config", body=conf)
+        print(f"Successfully updated config entry for ingress service "
+              f"{gw!r}")
+        existing = [i for i in c.get("/v1/connect/intentions")
+                    if i.get("SourceName") == gw
+                    and i.get("DestinationName") == args.service]
+        if existing:
+            print(f"Intention already exists for {gw!r} -> "
+                  f"{args.service!r}")
+        else:
+            c.put("/v1/connect/intentions", body={
+                "SourceName": gw, "DestinationName": args.service,
+                "Action": "allow"})
+            print(f"Successfully set up intention for {gw!r} -> "
+                  f"{args.service!r}")
+        return 0
+    if args.connect_cmd == "redirect-traffic":
+        # command/connect/redirect-traffic: transparent-proxy iptables
+        # rules, same chains/order as sdk/iptables. Printed (not
+        # executed) unless -run: applying NAT rules needs root and is
+        # host-destructive, so the default is the auditable rule list.
+        inbound = args.proxy_inbound_port
+        if not inbound and args.proxy_id:
+            snap = c.get(f"/v1/agent/connect/proxy/{args.proxy_id}")
+            inbound = snap.get("Port") or 20000
+        inbound = inbound or 20000
+        rules: list[list[str]] = []
+        for ch in ("CONSUL_PROXY_INBOUND", "CONSUL_PROXY_IN_REDIRECT",
+                   "CONSUL_PROXY_OUTPUT", "CONSUL_PROXY_REDIRECT"):
+            rules.append(["iptables", "-t", "nat", "-N", ch])
+        rules.append(["iptables", "-t", "nat", "-A",
+                      "CONSUL_PROXY_REDIRECT", "-p", "tcp", "-j",
+                      "REDIRECT", "--to-port",
+                      str(args.proxy_outbound_port)])
+        rules.append(["iptables", "-t", "nat", "-A",
+                      "CONSUL_PROXY_IN_REDIRECT", "-p", "tcp", "-j",
+                      "REDIRECT", "--to-port", str(inbound)])
+        rules.append(["iptables", "-t", "nat", "-A", "OUTPUT", "-p",
+                      "tcp", "-j", "CONSUL_PROXY_OUTPUT"])
+        if args.proxy_uid:
+            rules.append(["iptables", "-t", "nat", "-A",
+                          "CONSUL_PROXY_OUTPUT", "-m", "owner",
+                          "--uid-owner", args.proxy_uid, "-j",
+                          "RETURN"])
+        rules.append(["iptables", "-t", "nat", "-A",
+                      "CONSUL_PROXY_OUTPUT", "-d", "127.0.0.1/32",
+                      "-j", "RETURN"])
+        rules.append(["iptables", "-t", "nat", "-A",
+                      "CONSUL_PROXY_OUTPUT", "-j",
+                      "CONSUL_PROXY_REDIRECT"])
+        for port in args.exclude_outbound_port or []:
+            rules.append(["iptables", "-t", "nat", "-I",
+                          "CONSUL_PROXY_OUTPUT", "-p", "tcp",
+                          "--dport", str(port), "-j", "RETURN"])
+        for cidr in args.exclude_outbound_cidr or []:
+            rules.append(["iptables", "-t", "nat", "-I",
+                          "CONSUL_PROXY_OUTPUT", "-d", cidr, "-j",
+                          "RETURN"])
+        for uid in args.exclude_uid or []:
+            rules.append(["iptables", "-t", "nat", "-I",
+                          "CONSUL_PROXY_OUTPUT", "-m", "owner",
+                          "--uid-owner", str(uid), "-j", "RETURN"])
+        rules.append(["iptables", "-t", "nat", "-A", "PREROUTING",
+                      "-p", "tcp", "-j", "CONSUL_PROXY_INBOUND"])
+        rules.append(["iptables", "-t", "nat", "-A",
+                      "CONSUL_PROXY_INBOUND", "-p", "tcp", "-j",
+                      "CONSUL_PROXY_IN_REDIRECT"])
+        for port in args.exclude_inbound_port or []:
+            rules.append(["iptables", "-t", "nat", "-I",
+                          "CONSUL_PROXY_INBOUND", "-p", "tcp",
+                          "--dport", str(port), "-j", "RETURN"])
+        if args.run:
+            import subprocess
+
+            for r in rules:
+                rc = subprocess.run(r).returncode
+                if rc != 0:
+                    if r[3] == "-N":
+                        # chain already exists from a prior run —
+                        # re-runs must converge, not abort
+                        continue
+                    print(f"Error applying rule: {' '.join(r)}",
+                          file=sys.stderr)
+                    return rc
+            print("Successfully applied traffic redirection rules")
+        else:
+            for r in rules:
+                print(" ".join(r))
+        return 0
+    if getattr(args, "envoy_sub", None) == "pipe-bootstrap":
+        # command/connect/envoy/pipe-bootstrap: relay a bootstrap config
+        # from stdin into a named pipe so secrets never land on disk —
+        # which is defeated if a typo'd path silently creates a regular
+        # file, so the target must already exist and be a FIFO
+        import stat
+
+        try:
+            mode = os.stat(args.pipe).st_mode
+        except FileNotFoundError:
+            print(f"Error: named pipe {args.pipe!r} does not exist",
+                  file=sys.stderr)
+            return 1
+        if not stat.S_ISFIFO(mode):
+            print(f"Error: {args.pipe!r} is not a named pipe",
+                  file=sys.stderr)
+            return 1
+        data = sys.stdin.read()
+        with open(args.pipe, "w") as f:
+            f.write(data)
+        return 0
     from consul_tpu.connect.envoy import bootstrap_config
 
     if not args.sidecar_for and not args.proxy_id:
@@ -1063,9 +1300,61 @@ def cmd_config(args) -> int:
     return 1
 
 
+def _resource_grpc(addr: str, method: str, req_spec, resp_spec,
+                   payload: dict):
+    """One unary pbresource call over the agent's external gRPC port
+    (the transport real pbresource clients use; the non-grpc variants
+    ride the HTTP projection)."""
+    import grpc
+
+    from consul_tpu.server.grpc_external import RESOURCE_SVC
+    from consul_tpu.utils.pbwire import decode, encode
+
+    with grpc.insecure_channel(addr) as ch:
+        stub = ch.unary_unary(
+            f"{RESOURCE_SVC}/{method}",
+            request_serializer=lambda d: encode(req_spec, d),
+            response_deserializer=lambda b: decode(resp_spec, b))
+        return stub(payload, timeout=10)
+
+
 def cmd_resource(args) -> int:
     """`consul resource` (command/resource/*): v2 resource CRUD over
-    the HTTP projection of pbresource."""
+    the HTTP projection of pbresource, or over gRPC for the *-grpc
+    variants."""
+    from consul_tpu.server import grpc_external as ge
+
+    if args.resource_cmd == "apply-grpc":
+        body = json.loads(open(args.file).read()
+                          if args.file != "-" else sys.stdin.read())
+        resp = _resource_grpc(
+            args.grpc_addr, "Write", ge.RES_WRITE_REQ,
+            ge.RES_WRITE_RESP, {"resource": ge._res_to_pb(body)})
+        print(json.dumps(ge._res_from_pb(resp.get("resource") or {}),
+                         indent=2))
+        return 0
+    if args.resource_cmd in ("read-grpc", "list-grpc", "delete-grpc"):
+        g, gv, kind = (args.type.split(".") + ["", "", ""])[:3]
+        rtype = {"group": g, "group_version": gv, "kind": kind}
+        if args.resource_cmd == "list-grpc":
+            resp = _resource_grpc(
+                args.grpc_addr, "List", ge.RES_LIST_REQ,
+                ge.RES_LIST_RESP, {"type": rtype})
+            for r in resp.get("resources") or []:
+                print((r.get("id") or {}).get("name", ""))
+            return 0
+        rid = {"name": args.name, "type": rtype}
+        if args.resource_cmd == "read-grpc":
+            resp = _resource_grpc(
+                args.grpc_addr, "Read", ge.RES_READ_REQ,
+                ge.RES_READ_RESP, {"id": rid})
+            print(json.dumps(
+                ge._res_from_pb(resp.get("resource") or {}), indent=2))
+            return 0
+        _resource_grpc(args.grpc_addr, "Delete", ge.RES_DELETE_REQ,
+                       ge.RES_DELETE_RESP, {"id": rid})
+        print("Deleted")
+        return 0
     c = _client(args)
     if args.resource_cmd == "apply":
         body = json.loads(open(args.file).read()
@@ -1325,6 +1614,12 @@ def build_parser() -> argparse.ArgumentParser:
     toksub.add_parser("list")
     tr = toksub.add_parser("read")
     tr.add_argument("-id", required=True)
+    tu = toksub.add_parser("update")
+    tu.add_argument("-id", required=True)
+    tu.add_argument("-description", default="")
+    tu.add_argument("-policy-name", dest="policy_name",
+                    action="append", default=[])
+    tu.add_argument("-no-merge", dest="no_merge", action="store_true")
     tcl = toksub.add_parser("clone")
     tcl.add_argument("-id", required=True)
     tcl.add_argument("-description", default="")
@@ -1336,6 +1631,10 @@ def build_parser() -> argparse.ArgumentParser:
     pc.add_argument("-name", required=True)
     pc.add_argument("-rules", default="")
     polsub.add_parser("list")
+    pu = polsub.add_parser("update")
+    pu.add_argument("-id", required=True)
+    pu.add_argument("-name", default="")
+    pu.add_argument("-rules", default="")
     pd = polsub.add_parser("delete")
     pd.add_argument("-id", required=True)
     rolep = aclsub.add_parser("role")
@@ -1345,6 +1644,12 @@ def build_parser() -> argparse.ArgumentParser:
     rc.add_argument("-policy-name", dest="policy_name", action="append",
                     default=[])
     rolesub.add_parser("list")
+    ru = rolesub.add_parser("update")
+    ru.add_argument("-id", required=True)
+    ru.add_argument("-name", default="")
+    ru.add_argument("-policy-name", dest="policy_name",
+                    action="append", default=[])
+    ru.add_argument("-no-merge", dest="no_merge", action="store_true")
     rd = rolesub.add_parser("delete")
     rd.add_argument("-id", required=True)
     amp = aclsub.add_parser("auth-method")
@@ -1357,6 +1662,10 @@ def build_parser() -> argparse.ArgumentParser:
     amsub.add_parser("list")
     amr = amsub.add_parser("read")
     amr.add_argument("-name", required=True)
+    amu = amsub.add_parser("update")
+    amu.add_argument("-name", required=True)
+    amu.add_argument("-config", default="")
+    amu.add_argument("-description", default="")
     amd = amsub.add_parser("delete")
     amd.add_argument("-name", required=True)
     brp = aclsub.add_parser("binding-rule")
@@ -1367,6 +1676,11 @@ def build_parser() -> argparse.ArgumentParser:
     brc.add_argument("-bind-name", dest="bind_name", required=True)
     brc.add_argument("-selector", default="")
     brsub.add_parser("list")
+    bru = brsub.add_parser("update")
+    bru.add_argument("-id", required=True)
+    bru.add_argument("-bind-type", dest="bind_type", default="")
+    bru.add_argument("-bind-name", dest="bind_name", default="")
+    bru.add_argument("-selector", default="")
     brd = brsub.add_parser("delete")
     brd.add_argument("-id", required=True)
     acl.set_defaults(fn=cmd_acl)
@@ -1450,6 +1764,20 @@ def build_parser() -> argparse.ArgumentParser:
         rp.add_argument("name")
     rl = ressub.add_parser("list")
     rl.add_argument("-type", required=True)
+    rag = ressub.add_parser("apply-grpc")
+    rag.add_argument("-f", dest="file", required=True)
+    rag.add_argument("-grpc-addr", dest="grpc_addr",
+                     default="127.0.0.1:8502")
+    for nm in ("read-grpc", "delete-grpc"):
+        rg = ressub.add_parser(nm)
+        rg.add_argument("-type", required=True)
+        rg.add_argument("-grpc-addr", dest="grpc_addr",
+                        default="127.0.0.1:8502")
+        rg.add_argument("name")
+    rlg = ressub.add_parser("list-grpc")
+    rlg.add_argument("-type", required=True)
+    rlg.add_argument("-grpc-addr", dest="grpc_addr",
+                     default="127.0.0.1:8502")
     resp.set_defaults(fn=cmd_resource)
 
     mon = sub.add_parser("monitor")
@@ -1499,6 +1827,36 @@ def build_parser() -> argparse.ArgumentParser:
                             "REST xDS (live updates)")
     envoy.add_argument("-admin-bind-port", type=int, default=19000,
                        dest="admin_port")
+    envoysub = envoy.add_subparsers(dest="envoy_sub")
+    epb = envoysub.add_parser("pipe-bootstrap")
+    epb.add_argument("pipe")
+    exp = cnsub.add_parser("expose")
+    exp.add_argument("-service", required=True)
+    exp.add_argument("-ingress-gateway", dest="ingress_gateway",
+                     required=True)
+    exp.add_argument("-port", type=int, required=True)
+    exp.add_argument("-protocol", default="tcp")
+    exp.add_argument("-host", action="append", default=[])
+    rt = cnsub.add_parser("redirect-traffic")
+    rt.add_argument("-proxy-id", dest="proxy_id", default="")
+    rt.add_argument("-proxy-uid", dest="proxy_uid", default="")
+    rt.add_argument("-proxy-inbound-port", dest="proxy_inbound_port",
+                    type=int, default=0)
+    rt.add_argument("-proxy-outbound-port", dest="proxy_outbound_port",
+                    type=int, default=15001)
+    rt.add_argument("-exclude-inbound-port",
+                    dest="exclude_inbound_port", action="append",
+                    default=[])
+    rt.add_argument("-exclude-outbound-port",
+                    dest="exclude_outbound_port", action="append",
+                    default=[])
+    rt.add_argument("-exclude-outbound-cidr",
+                    dest="exclude_outbound_cidr", action="append",
+                    default=[])
+    rt.add_argument("-exclude-uid", dest="exclude_uid",
+                    action="append", default=[])
+    rt.add_argument("-run", action="store_true",
+                    help="apply the rules (default: print them)")
     cn.set_defaults(fn=cmd_connect)
 
     tlsp = sub.add_parser("tls")
@@ -1560,7 +1918,9 @@ def build_parser() -> argparse.ArgumentParser:
     rrm.add_argument("-address", required=True)
     rtl = raftsub.add_parser("transfer-leader")
     rtl.add_argument("-id", default="")
-    opsub.add_parser("usage")
+    usagep = opsub.add_parser("usage")
+    usagesub = usagep.add_subparsers(dest="usage_cmd")
+    usagesub.add_parser("instances")
     opsub.add_parser("utilization")
     op.set_defaults(fn=cmd_operator)
 
@@ -1578,6 +1938,15 @@ def main(argv=None) -> int:
     except ConnectionError as e:
         print(f"Error connecting to agent: {e}", file=sys.stderr)
         return 1
+    except Exception as e:
+        # grpc.RpcError from the *-grpc commands (NOT_FOUND, ABORTED,
+        # UNAVAILABLE) — grpc may not be importable, so duck-type it
+        # instead of naming the class in an except clause
+        if hasattr(e, "code") and hasattr(e, "details"):
+            print(f"Error: {e.code().name}: {e.details()}",
+                  file=sys.stderr)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

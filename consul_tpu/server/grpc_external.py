@@ -20,6 +20,12 @@ Served methods:
   /hashicorp.consul.serverdiscovery.ServerDiscoveryService/WatchServers
   /grpc.health.v1.Health/Check            (also the target protocol of
                                            the agent's gRPC check runner)
+  /hashicorp.consul.dataplane.DataplaneService/{GetSupportedDataplaneFeatures,
+                                                GetEnvoyBootstrapParams}
+  /hashicorp.consul.resource.ResourceService/{Read,Write,List,Delete}
+                                          (pbresource v2 CRUD — the
+                                           transport `consul resource
+                                           *-grpc` speaks)
 """
 
 from __future__ import annotations
@@ -118,6 +124,102 @@ BOOTSTRAP_RESP = {
 SERVICE_KIND_ENUM = {"": 1, "connect-proxy": 2, "mesh-gateway": 3,
                      "terminating-gateway": 4, "ingress-gateway": 5,
                      "api-gateway": 6}
+
+# hashicorp.consul.resource (proto-public/pbresource/resource.proto):
+# field numbers match the reference proto exactly so real pbresource
+# clients interoperate. Resource Data is a google.protobuf.Any; our
+# payloads are JSON documents, carried as Any{type_url:
+# "consul-tpu/json/<group>.<gv>.<kind>", value: canonical JSON bytes}.
+RES_TYPE = {"group": Field(1, "string"),
+            "group_version": Field(2, "string"),
+            "kind": Field(3, "string")}
+RES_TENANCY = {"partition": Field(1, "string"),
+               "namespace": Field(2, "string")}
+RES_ID = {"uid": Field(1, "string"), "name": Field(2, "string"),
+          "type": Field(3, "message", RES_TYPE),
+          "tenancy": Field(4, "message", RES_TENANCY)}
+RES_MSG = {
+    "id": Field(1, "message", RES_ID),
+    "owner": Field(2, "message", RES_ID),
+    "version": Field(3, "string"),
+    "generation": Field(4, "string"),
+    "metadata": Field(5, "message", _MAP_SS, repeated=True),
+    "data": Field(7, "message", ANY),
+}
+RES_READ_REQ = {"id": Field(1, "message", RES_ID)}
+RES_READ_RESP = {"resource": Field(1, "message", RES_MSG)}
+RES_LIST_REQ = {"type": Field(1, "message", RES_TYPE),
+                "tenancy": Field(2, "message", RES_TENANCY),
+                "name_prefix": Field(3, "string")}
+RES_LIST_RESP = {"resources": Field(1, "message", RES_MSG,
+                                    repeated=True)}
+RES_WRITE_REQ = {"resource": Field(1, "message", RES_MSG)}
+RES_WRITE_RESP = {"resource": Field(1, "message", RES_MSG)}
+RES_DELETE_REQ = {"id": Field(1, "message", RES_ID),
+                  "version": Field(2, "string")}
+RES_DELETE_RESP: dict[str, Field] = {}
+
+RESOURCE_SVC = "/hashicorp.consul.resource.ResourceService"
+
+
+def _res_to_pb(r: dict[str, Any]) -> dict[str, Any]:
+    """Store-dict (CamelCase) → pbresource message dict."""
+    def id_pb(i: dict[str, Any]) -> dict[str, Any]:
+        t = i.get("Type") or {}
+        ten = i.get("Tenancy") or {}
+        return {"uid": i.get("Uid", ""), "name": i.get("Name", ""),
+                "type": {"group": t.get("Group", ""),
+                         "group_version": t.get("GroupVersion", ""),
+                         "kind": t.get("Kind", "")},
+                "tenancy": {"partition": ten.get("Partition", ""),
+                            "namespace": ten.get("Namespace", "")}}
+
+    t = (r.get("Id") or {}).get("Type") or {}
+    out = {"id": id_pb(r.get("Id") or {}),
+           "version": r.get("Version", ""),
+           "generation": r.get("Generation", ""),
+           "metadata": [{"key": k, "value": v}
+                        for k, v in sorted(
+                            (r.get("Metadata") or {}).items())],
+           "data": {"type_url": "consul-tpu/json/"
+                    f"{t.get('Group','')}.{t.get('GroupVersion','')}."
+                    f"{t.get('Kind','')}",
+                    "value": json.dumps(r.get("Data") or {},
+                                        sort_keys=True).encode()}}
+    if r.get("Owner"):
+        out["owner"] = id_pb(r["Owner"])
+    return out
+
+
+def _res_from_pb(m: dict[str, Any]) -> dict[str, Any]:
+    """pbresource message dict → store-dict (CamelCase)."""
+    def id_dict(i: dict[str, Any]) -> dict[str, Any]:
+        t = i.get("type") or {}
+        ten = i.get("tenancy") or {}
+        return {"Uid": i.get("uid", ""), "Name": i.get("name", ""),
+                "Type": {"Group": t.get("group", ""),
+                         "GroupVersion": t.get("group_version", ""),
+                         "Kind": t.get("kind", "")},
+                "Tenancy": {"Partition": ten.get("partition", "")
+                            or "default",
+                            "Namespace": ten.get("namespace", "")
+                            or "default"}}
+
+    data: dict[str, Any] = {}
+    any_msg = m.get("data") or {}
+    if any_msg.get("value"):
+        try:
+            data = json.loads(any_msg["value"])
+        except (ValueError, UnicodeDecodeError):
+            data = {"_raw": any_msg["value"].hex()}
+    out = {"Id": id_dict(m.get("id") or {}),
+           "Version": m.get("version", ""),
+           "Metadata": {kv["key"]: kv.get("value", "")
+                        for kv in m.get("metadata") or []},
+           "Data": data}
+    if m.get("owner"):
+        out["Owner"] = id_dict(m["owner"])
+    return out
 
 
 def to_pb_struct(d: dict[str, Any]) -> dict[str, Any]:
@@ -469,9 +571,65 @@ def make_grpc_server(agent, bind_addr: str, port: int):
             "access_logs": [],
         })
 
+    def resource_read(req: dict, context) -> bytes:
+        res = agent.rpc("Resource.Read",
+                        {"ID": _res_from_pb({"id": req.get("id")})["Id"]})
+        if res.get("Error") == "not_found":
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          "resource not found")
+        if res.get("Error") == "gvm":
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "group version mismatch")
+        return encode(RES_READ_RESP,
+                      {"resource": _res_to_pb(res["Resource"])})
+
+    def resource_write(req: dict, context) -> bytes:
+        r = _res_from_pb(req.get("resource") or {})
+        out = agent.rpc("Resource.Write", {"Resource": r})
+        if out.get("Error"):  # CAS / uid conflicts → ABORTED
+            context.abort(grpc.StatusCode.ABORTED, out["Error"])
+        return encode(RES_WRITE_RESP,
+                      {"resource": _res_to_pb(out["Resource"])})
+
+    def resource_list(req: dict, context) -> bytes:
+        t = req.get("type") or {}
+        ten = req.get("tenancy") or {}
+        res = agent.rpc("Resource.List", {
+            "Type": {"Group": t.get("group", ""),
+                     "GroupVersion": t.get("group_version", ""),
+                     "Kind": t.get("kind", "")},
+            "Tenancy": {"Partition": ten.get("partition", "") or "*",
+                        "Namespace": ten.get("namespace", "") or "*"},
+            "Prefix": req.get("name_prefix", ""),
+            "AllowStale": True})
+        return encode(RES_LIST_RESP, {
+            "resources": [_res_to_pb(r) for r in res["Resources"]]})
+
+    def resource_delete(req: dict, context) -> bytes:
+        out = agent.rpc("Resource.Delete", {
+            "ID": _res_from_pb({"id": req.get("id")})["Id"],
+            "Version": req.get("version", "")})
+        if isinstance(out, dict) and out.get("Error"):
+            context.abort(grpc.StatusCode.ABORTED, out["Error"])
+        return encode(RES_DELETE_RESP, {})
+
+    resource_methods = {
+        f"{RESOURCE_SVC}/Read": (resource_read, RES_READ_REQ),
+        f"{RESOURCE_SVC}/Write": (resource_write, RES_WRITE_REQ),
+        f"{RESOURCE_SVC}/List": (resource_list, RES_LIST_REQ),
+        f"{RESOURCE_SVC}/Delete": (resource_delete, RES_DELETE_REQ),
+    }
+
     class Handlers(grpc.GenericRpcHandler):
         def service(self, hcd):
             m = hcd.method
+            if m in resource_methods:
+                fn, req_spec = resource_methods[m]
+                return grpc.unary_unary_rpc_method_handler(
+                    fn,
+                    request_deserializer=(
+                        lambda b, _s=req_spec: decode(_s, b)),
+                    response_serializer=lambda b: b)
             if m == ("/envoy.service.discovery.v3."
                      "AggregatedDiscoveryService/DeltaAggregatedResources"):
                 return grpc.stream_stream_rpc_method_handler(
@@ -520,5 +678,6 @@ def make_grpc_server(agent, bind_addr: str, port: int):
         return None
     server.start()
     logger.info("external gRPC listening on %s:%d (ADS, server "
-                "discovery, health)", bind_addr, bound)
+                "discovery, health, dataplane, resource)",
+                bind_addr, bound)
     return server, bound

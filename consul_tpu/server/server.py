@@ -32,6 +32,7 @@ from consul_tpu.types import (CheckStatus, MemberStatus, SERF_CHECK_ID,
                               SERF_CHECK_NAME)
 from consul_tpu.utils import log, telemetry
 from consul_tpu.utils.clock import RealTimers
+from consul_tpu.utils.duration import parse_duration
 
 
 class NoLeaderError(RPCError):
@@ -52,8 +53,11 @@ class Server:
         self._shutdown = False
         self._controller_manager = None
         # autopilot stabilization: when each not-yet-voting server was
-        # first seen in serf (cleared once it joins raft)
+        # first seen in serf (cleared once it joins raft or leaves serf)
         self._server_first_seen: dict[str, float] = {}
+        # flips true once the cluster first reaches bootstrap_expect
+        # voters; from then on new servers must pass stabilization
+        self._bootstrapped = False
 
         # L1: replicated state
         self.fsm = FSM()
@@ -666,24 +670,30 @@ class Server:
         for addr in servers - self.raft.peers:
             self._server_first_seen.setdefault(addr, now)
         for addr in list(self._server_first_seen):
-            if addr in self.raft.peers:
+            # drop entries once voted in AND entries whose serf member
+            # is gone — a stale timestamp would let a crashed-and-
+            # rejoined server bypass the stabilization window, and the
+            # dict would grow with every transient server
+            if addr in self.raft.peers or addr not in servers:
                 self._server_first_seen.pop(addr, None)
         ap_cfg = self.state.raw_get("config_entries",
                                     "autopilot/config") or {}
-        from consul_tpu.utils.duration import parse_duration
-
         stab = parse_duration(
             ap_cfg.get("ServerStabilizationTime", "10s"))
-        forming = len(self.raft.peers) < max(
-            self.config.bootstrap_expect, 1)
+        if not self._bootstrapped and \
+                len(self.raft.peers) >= max(self.config.bootstrap_expect,
+                                            1):
+            self._bootstrapped = True
         for addr in servers - self.raft.peers:
-            if not forming and \
+            if self._bootstrapped and \
                     now - self._server_first_seen.get(addr, now) < stab:
                 # autopilot ServerStabilizationTime: a server joining an
                 # ESTABLISHED cluster must look healthy for the
                 # stabilization window before it gets a raft vote
-                # (raft-autopilot promotion gate); initial bootstrap is
-                # exempt — there is no cluster to protect yet
+                # (raft-autopilot promotion gate). Only INITIAL
+                # bootstrap is exempt — a degraded cluster that lost
+                # peers still gates replacements (that is when an
+                # unstable voter hurts most)
                 continue
             self.log.info("adding raft peer %s", addr)
             try:
@@ -692,9 +702,7 @@ class Server:
                 return
         # dead-server cleanup (autopilot CleanupDeadServers — operator
         # configurable): remove raft peers whose serf member failed
-        ap = self.state.raw_get("config_entries", "autopilot/config") \
-            or {}
-        cleanup = ap.get("CleanupDeadServers", True)
+        cleanup = ap_cfg.get("CleanupDeadServers", True)
         failed_addrs = {
             m.tags.get("rpc_addr") for m in self.serf.members(True)
             if m.tags.get("role") == "consul"

@@ -693,3 +693,13 @@ def test_agent_persists_registrations_across_restart(tmp_path):
         assert "solo-chk" in a3.local.list_checks()
     finally:
         a3.shutdown()
+
+
+def test_stale_and_consistent_conflict(agent, client):
+    """?stale&?consistent together is a 400 (http.go parseConsistency:
+    'cannot specify both'), not a silent stale read."""
+    from consul_tpu.api import APIError
+
+    with pytest.raises(APIError) as ei:
+        client.get("/v1/catalog/nodes", stale="", consistent="")
+    assert ei.value.code == 400

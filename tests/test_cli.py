@@ -164,3 +164,186 @@ def test_snapshot_decode(agent, tmp_path):
     assert rc == 0
     tables = {json.loads(ln)["Table"] for ln in out.splitlines() if ln}
     assert "kv" in tables
+
+
+def test_acl_update_commands(agent):
+    rc, out = run(agent, "acl", "policy", "create", "-name", "upd-pol",
+                  "-rules", '{"key_prefix": {"": {"policy": "read"}}}')
+    assert rc == 0
+    pid = json.loads(out)["ID"]
+    rc, out = run(agent, "acl", "policy", "update", "-id", pid,
+                  "-rules", '{"key_prefix": {"": {"policy": "write"}}}')
+    assert rc == 0
+    assert "write" in json.loads(out)["Rules"]
+
+    rc, out = run(agent, "acl", "token", "create",
+                  "-description", "updatable")
+    assert rc == 0
+    tid = json.loads(out)["AccessorID"]
+    rc, out = run(agent, "acl", "token", "update", "-id", tid,
+                  "-description", "updated", "-policy-name", "upd-pol")
+    assert rc == 0
+    tok = json.loads(out)
+    assert tok["Description"] == "updated"
+    assert any(p["Name"] == "upd-pol" for p in tok["Policies"])
+    # merge: a second update with another policy keeps the first
+    rc, out = run(agent, "acl", "policy", "create", "-name", "upd-pol2",
+                  "-rules", "{}")
+    assert rc == 0
+    rc, out = run(agent, "acl", "token", "update", "-id", tid,
+                  "-policy-name", "upd-pol2")
+    assert rc == 0
+    names = {p["Name"] for p in json.loads(out)["Policies"]}
+    assert names == {"upd-pol", "upd-pol2"}
+    # -no-merge replaces
+    rc, out = run(agent, "acl", "token", "update", "-id", tid,
+                  "-policy-name", "upd-pol2", "-no-merge")
+    assert rc == 0
+    names = {p["Name"] for p in json.loads(out)["Policies"]}
+    assert names == {"upd-pol2"}
+
+    rc, out = run(agent, "acl", "role", "create", "-name", "upd-role")
+    assert rc == 0
+    rid = json.loads(out)["ID"]
+    rc, out = run(agent, "acl", "role", "update", "-id", rid,
+                  "-policy-name", "upd-pol")
+    assert rc == 0
+    assert any(p["Name"] == "upd-pol"
+               for p in json.loads(out)["Policies"])
+
+    rc, _ = run(agent, "acl", "auth-method", "create", "-name",
+                "upd-am", "-type", "jwt", "-config",
+                '{"SessionID": "s"}')
+    assert rc == 0
+    rc, out = run(agent, "acl", "auth-method", "update", "-name",
+                  "upd-am", "-config", '{"SessionID": "s2"}')
+    assert rc == 0
+    assert json.loads(out)["Config"]["SessionID"] == "s2"
+
+    rc, out = run(agent, "acl", "binding-rule", "create", "-method",
+                  "upd-am", "-bind-name", "svc-a")
+    assert rc == 0
+    brid = json.loads(out)["ID"]
+    rc, out = run(agent, "acl", "binding-rule", "update", "-id", brid,
+                  "-bind-name", "svc-b")
+    assert rc == 0
+    assert json.loads(out)["BindName"] == "svc-b"
+
+
+def test_connect_expose(agent):
+    rc, out = run(agent, "connect", "expose", "-service", "exp-web",
+                  "-ingress-gateway", "igw-cli", "-port", "8080",
+                  "-protocol", "http")
+    assert rc == 0 and "Successfully" in out
+    rc, out = run(agent, "config", "read", "-kind", "ingress-gateway",
+                  "-name", "igw-cli")
+    assert rc == 0
+    conf = json.loads(out)
+    ln = conf["Listeners"][0]
+    assert ln["Port"] == 8080 and ln["Protocol"] == "http"
+    assert ln["Services"][0]["Name"] == "exp-web"
+    # idempotent re-expose on the same listener adds a 2nd service
+    rc, _ = run(agent, "connect", "expose", "-service", "exp-api",
+                "-ingress-gateway", "igw-cli", "-port", "8080",
+                "-protocol", "http")
+    assert rc == 0
+    rc, out = run(agent, "config", "read", "-kind", "ingress-gateway",
+                  "-name", "igw-cli")
+    names = [s["Name"] for s in json.loads(out)["Listeners"][0]["Services"]]
+    assert names == ["exp-web", "exp-api"]
+    # intention was created
+    rc, out = run(agent, "intention", "get", "igw-cli", "exp-web")
+    assert rc == 0 and json.loads(out)["Action"] == "allow"
+    # conflicting protocol on the same port is refused
+    rc, _ = run(agent, "connect", "expose", "-service", "exp-tcp",
+                "-ingress-gateway", "igw-cli", "-port", "8080",
+                "-protocol", "tcp")
+    assert rc == 1
+
+
+def test_connect_redirect_traffic_prints_rules(agent):
+    rc, out = run(agent, "connect", "redirect-traffic",
+                  "-proxy-uid", "123",
+                  "-proxy-inbound-port", "20001",
+                  "-exclude-inbound-port", "22",
+                  "-exclude-uid", "0")
+    assert rc == 0
+    lines = out.splitlines()
+    assert any("CONSUL_PROXY_REDIRECT" in ln and "15001" in ln
+               for ln in lines)
+    assert any("CONSUL_PROXY_IN_REDIRECT" in ln and "20001" in ln
+               for ln in lines)
+    assert any("--uid-owner 123" in ln for ln in lines)
+    assert any("--dport 22" in ln for ln in lines)
+
+
+def test_connect_envoy_pipe_bootstrap(agent, tmp_path, monkeypatch):
+    import io
+    import os
+    import threading
+
+    # refuses a non-FIFO target: the command exists so secrets never
+    # land on disk — a typo'd path must not create a regular file
+    regular = tmp_path / "not-a-pipe.json"
+    monkeypatch.setattr("sys.stdin", io.StringIO('{"node": {}}'))
+    rc, _ = run(agent, "connect", "envoy", "pipe-bootstrap",
+                str(regular))
+    assert rc == 1 and not regular.exists()
+
+    pipe = tmp_path / "bootstrap.pipe"
+    os.mkfifo(pipe)
+    got: list[str] = []
+    reader = threading.Thread(
+        target=lambda: got.append(open(pipe).read()))
+    reader.start()
+    monkeypatch.setattr("sys.stdin", io.StringIO('{"node": {}}'))
+    rc, _ = run(agent, "connect", "envoy", "pipe-bootstrap", str(pipe))
+    reader.join(timeout=5)
+    assert rc == 0
+    assert json.loads(got[0]) == {"node": {}}
+
+
+def test_operator_usage_instances(agent, tmp_path):
+    f = tmp_path / "usage-svc.json"
+    f.write_text(json.dumps({"name": "usage-svc", "port": 1234}))
+    rc, _ = run(agent, "services", "register", str(f))
+    assert rc == 0
+    wait_for(lambda: "usage-svc" in run(
+        agent, "operator", "usage", "instances")[1],
+        what="anti-entropy sync of usage-svc")
+    rc, out = run(agent, "operator", "usage", "instances")
+    assert rc == 0
+    assert "usage-svc" in out and "Total Services:" in out
+
+
+def test_resource_grpc_crud(agent, tmp_path):
+    pytest.importorskip("grpc")
+    assert agent.grpc_port > 0
+    addr = f"127.0.0.1:{agent.grpc_port}"
+    f = tmp_path / "res.json"
+    f.write_text(json.dumps({
+        "Id": {"Name": "grpc-one",
+               "Type": {"Group": "demo", "GroupVersion": "v1",
+                        "Kind": "Artist"},
+               "Tenancy": {"Partition": "default",
+                           "Namespace": "default"}},
+        "Data": {"genre": "jazz"}}))
+    rc, out = run(agent, "resource", "apply-grpc", "-f", str(f),
+                  "-grpc-addr", addr)
+    assert rc == 0
+    written = json.loads(out)
+    assert written["Id"]["Name"] == "grpc-one"
+    assert written["Version"]
+    rc, out = run(agent, "resource", "read-grpc", "-type",
+                  "demo.v1.Artist", "-grpc-addr", addr, "grpc-one")
+    assert rc == 0
+    assert json.loads(out)["Data"] == {"genre": "jazz"}
+    rc, out = run(agent, "resource", "list-grpc", "-type",
+                  "demo.v1.Artist", "-grpc-addr", addr)
+    assert rc == 0 and "grpc-one" in out
+    rc, out = run(agent, "resource", "delete-grpc", "-type",
+                  "demo.v1.Artist", "-grpc-addr", addr, "grpc-one")
+    assert rc == 0 and "Deleted" in out
+    rc, out = run(agent, "resource", "list-grpc", "-type",
+                  "demo.v1.Artist", "-grpc-addr", addr)
+    assert rc == 0 and "grpc-one" not in out

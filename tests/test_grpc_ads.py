@@ -328,3 +328,50 @@ def test_rbac_lowering_with_intentions(agent, client):
         agent.server.handle_rpc("Intention.Apply", {
             "Op": "delete", "Intention": {
                 "SourceName": "evil", "DestinationName": "web"}}, "test")
+
+
+def test_resource_service_error_codes(agent):
+    """pbresource over gRPC: NOT_FOUND on missing read, ABORTED on CAS
+    version conflict (resource.proto DeleteRequest.version docs)."""
+    import grpc
+
+    from consul_tpu.server import grpc_external as ge
+
+    addr = f"127.0.0.1:{agent.grpc_port}"
+
+    def call(method, req_spec, resp_spec, payload):
+        with grpc.insecure_channel(addr) as ch:
+            stub = ch.unary_unary(
+                f"{ge.RESOURCE_SVC}/{method}",
+                request_serializer=lambda d: encode(req_spec, d),
+                response_deserializer=lambda b: decode(resp_spec, b))
+            return stub(payload, timeout=10)
+
+    rtype = {"group": "demo", "group_version": "v1", "kind": "Album"}
+    with pytest.raises(grpc.RpcError) as ei:
+        call("Read", ge.RES_READ_REQ, ge.RES_READ_RESP,
+             {"id": {"name": "nope", "type": rtype}})
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+    written = call("Write", ge.RES_WRITE_REQ, ge.RES_WRITE_RESP, {
+        "resource": {"id": {"name": "cas-album", "type": rtype},
+                     "data": {"type_url": "consul-tpu/json/demo",
+                              "value": b'{"x": 1}'}}})
+    ver = written["resource"]["version"]
+    assert ver
+    # stale-version write -> ABORTED (CAS)
+    with pytest.raises(grpc.RpcError) as ei:
+        call("Write", ge.RES_WRITE_REQ, ge.RES_WRITE_RESP, {
+            "resource": {"id": {"name": "cas-album", "type": rtype},
+                         "version": "stale",
+                         "data": {"type_url": "consul-tpu/json/demo",
+                                  "value": b'{"x": 2}'}}})
+    assert ei.value.code() == grpc.StatusCode.ABORTED
+    # delete with wrong version -> ABORTED; right version succeeds
+    with pytest.raises(grpc.RpcError) as ei:
+        call("Delete", ge.RES_DELETE_REQ, ge.RES_DELETE_RESP,
+             {"id": {"name": "cas-album", "type": rtype},
+              "version": "stale"})
+    assert ei.value.code() == grpc.StatusCode.ABORTED
+    call("Delete", ge.RES_DELETE_REQ, ge.RES_DELETE_RESP,
+         {"id": {"name": "cas-album", "type": rtype}, "version": ver})
