@@ -511,6 +511,17 @@ def cmd_keyring(args) -> int:
     return 1
 
 
+def _merge_policy_links(existing, names, no_merge: bool):
+    """-policy-name semantics shared by `acl token update` and `acl
+    role update`: merge by name unless -no-merge replaces outright."""
+    new = [{"Name": n} for n in names]
+    if no_merge:
+        return new
+    have = {p.get("Name") for p in existing or []}
+    return (existing or []) + [p for p in new
+                               if p["Name"] not in have]
+
+
 def cmd_acl(args) -> int:
     c = _client(args)
     if args.acl_cmd == "set-agent-token":
@@ -566,14 +577,9 @@ def cmd_acl(args) -> int:
             if args.description:
                 tok["Description"] = args.description
             if args.policy_name:
-                new = [{"Name": n} for n in args.policy_name]
-                if args.no_merge:
-                    tok["Policies"] = new
-                else:
-                    have = {p.get("Name")
-                            for p in tok.get("Policies") or []}
-                    tok["Policies"] = (tok.get("Policies") or []) + [
-                        p for p in new if p["Name"] not in have]
+                tok["Policies"] = _merge_policy_links(
+                    tok.get("Policies"), args.policy_name,
+                    args.no_merge)
             print(json.dumps(
                 c.put(f"/v1/acl/token/{args.id}", body=tok), indent=2))
             return 0
@@ -631,14 +637,9 @@ def cmd_acl(args) -> int:
             if args.name:
                 role["Name"] = args.name
             if args.policy_name:
-                new = [{"Name": n} for n in args.policy_name]
-                if args.no_merge:
-                    role["Policies"] = new
-                else:
-                    have = {p.get("Name")
-                            for p in role.get("Policies") or []}
-                    role["Policies"] = (role.get("Policies") or []) + [
-                        p for p in new if p["Name"] not in have]
+                role["Policies"] = _merge_policy_links(
+                    role.get("Policies"), args.policy_name,
+                    args.no_merge)
             print(json.dumps(
                 c.put(f"/v1/acl/role/{args.id}", body=role), indent=2))
             return 0
@@ -1105,8 +1106,18 @@ def cmd_connect(args) -> int:
                   file=sys.stderr)
             return 1
         data = sys.stdin.read()
-        with open(args.pipe, "w") as f:
-            f.write(data)
+        # no O_CREAT and a FIFO re-check on the OPENED fd: a path swap
+        # between the stat above and this open (TOCTOU) must not land
+        # the secrets in a regular file
+        fd = os.open(args.pipe, os.O_WRONLY)
+        try:
+            if not stat.S_ISFIFO(os.fstat(fd).st_mode):
+                print(f"Error: {args.pipe!r} is not a named pipe",
+                      file=sys.stderr)
+                return 1
+            os.write(fd, data.encode())
+        finally:
+            os.close(fd)
         return 0
     from consul_tpu.connect.envoy import bootstrap_config
 

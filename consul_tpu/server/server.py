@@ -680,10 +680,24 @@ class Server:
                                     "autopilot/config") or {}
         stab = parse_duration(
             ap_cfg.get("ServerStabilizationTime", "10s"))
-        if not self._bootstrapped and \
-                len(self.raft.peers) >= max(self.config.bootstrap_expect,
-                                            1):
-            self._bootstrapped = True
+        if not self._bootstrapped:
+            # the latch must survive leader failover: a new leader of a
+            # DEGRADED cluster (peers < bootstrap_expect after dead-
+            # server cleanup) must still gate replacement voters, so
+            # first-bootstrap is recorded in replicated system metadata
+            if self.state.raw_get("system_metadata",
+                                  "bootstrap-complete"):
+                self._bootstrapped = True
+            elif len(self.raft.peers) >= max(
+                    self.config.bootstrap_expect, 1):
+                self._bootstrapped = True
+                try:
+                    self.raft.apply(encode_command(
+                        MessageType.SYSTEM_METADATA,
+                        {"Op": "set", "Key": "bootstrap-complete",
+                         "Value": "true"}))
+                except Exception as e:  # noqa: BLE001
+                    self.log.debug("bootstrap marker write: %s", e)
         for addr in servers - self.raft.peers:
             if self._bootstrapped and \
                     now - self._server_first_seen.get(addr, now) < stab:

@@ -37,10 +37,13 @@ ENVELOPE — what this model can and cannot answer:
   propagation curves, churn/partition-heal dynamics — at populations the
   host engine can't touch (validated within the BASELINE 1%-FP criterion
   against the host engine at n≤100, tests/test_conformance.py).
-* CANNOT: per-node membership-view divergence, rumor ORDERING between
-  concurrent updates, or push/pull repair of inconsistent views — there
-  are no per-viewer views (O(N) rumor state replaces the O(N²) matrix).
-  Questions of that shape belong to the host engine.
+* CANNOT (this tier): per-node membership-view divergence, rumor
+  ORDERING between concurrent updates, or push/pull repair of
+  inconsistent views — there are no per-viewer views (O(N) rumor state
+  replaces the O(N²) matrix). Questions of that shape belong to
+  ``sim.views`` — the dense per-viewer tier (n ≲ 8k on one chip) whose
+  merges resolve scatter conflicts by (incarnation, precedence) max —
+  or, below n≈100, to the host engine.
 * Known bias: FP is underestimated at low loss (<~40%): the mean-field
   refutation race resolves by hearing probability, not socket timing.
   Measured at 30% loss: 0 vs the host's 2.6e-4 per node-round — inside
@@ -52,10 +55,14 @@ from consul_tpu.sim.state import SimState, init_state, ALIVE, SUSPECT, DEAD, LEF
 from consul_tpu.sim.round import gossip_round, run_rounds, make_run_rounds
 from consul_tpu.sim.mesh import (make_sharded_run, make_mesh,
                                  make_multidc_run, make_segmented_run)
+from consul_tpu.sim.views import (ViewState, init_views, views_round,
+                                  run_views, view_metrics)
 
 __all__ = [
     "SimParams", "SimState", "init_state", "gossip_round", "run_rounds",
     "make_run_rounds", "make_sharded_run", "make_mesh",
     "make_multidc_run", "make_segmented_run",
+    "ViewState", "init_views", "views_round", "run_views",
+    "view_metrics",
     "ALIVE", "SUSPECT", "DEAD", "LEFT",
 ]

@@ -1,0 +1,372 @@
+"""Per-node-VIEW simulation tier: dense O(N²) SWIM with real view state.
+
+The mean-field tier (sim/round.py) replaces per-viewer membership views
+with O(N) rumor aggregates — that is what makes 1M nodes feasible, and
+it is also why its ENVELOPE (sim/__init__.py) excludes questions about
+per-node view divergence, rumor ORDERING between concurrent updates,
+and push/pull repair. This module answers exactly those questions, on
+TPU, at populations (n ≈ 4k; ~250MB of view state) the host engine
+(consul_tpu.gossip, one Python object graph per node) cannot touch.
+
+Model — each of n viewers i holds a full membership view of subjects j:
+
+* ``status[i, j]``      what i believes about j (ALIVE/SUSPECT/DEAD)
+* ``inc[i, j]``         the incarnation that belief carries
+* suspicion metadata    per-(i,j) Lifeguard timer: start, deadline,
+                        independent-confirmation count
+* ``budget[i, j]``      piggyback retransmissions left for the entry
+                        (memberlist's TransmitLimitedQueue, per entry)
+
+One round = one SWIM protocol period (probe_interval), compiled to a
+single jit function of dense [n, n] elementwise ops, Gumbel-max random
+target picks, and ``segment_max`` merges — no per-node Python, static
+shapes throughout.
+
+**Rumor ordering is the point.** All belief merges go through a single
+total-order key (``_key``):
+
+    key = inc * 4 + precedence      (alive=0, suspect=1, dead=2)
+
+and every merge is a max — so when several senders' gossip lands on one
+receiver in the same round, the winner is decided by (incarnation,
+status precedence), never by arrival order. This is SURVEY.md hard part
+(b) (scatter conflicts must resolve by max-incarnation) implemented
+literally: ``segment_max`` over sender-addressed rows IS the conflict
+resolution. The key order encodes memberlist's override rules
+(state.go): suspect(inc) beats alive(inc); dead(inc) beats both;
+alive(inc') refutes either iff inc' > inc.
+
+Upstream behaviors reproduced (reference consumption points:
+agent/consul/server_serf.go; tuning agent/consul/config.go:661-698):
+
+* probe→ack with indirect relays and TCP fallback (composed
+  per-target ack probability, same formulas as the mean-field tier)
+* suspicion with Lifeguard timer shrink on independent confirmations
+  (log-shrink, memberlist suspicion.go) and refutation by the suspect
+  incrementing its own incarnation
+* piggybacked dissemination with a per-entry retransmit budget of
+  ``retransmit_mult·log(n)`` (memberlist queue.go)
+* periodic full-state push/pull anti-entropy (memberlist state.go
+  pushPullTrigger) — bidirectional full-row max-merge
+* a ``reach[i, j]`` matrix models partitions (the container tests'
+  iptables partition/heal scenarios, sdk/iptables)
+
+Deliberately out of envelope here: churn rejoin (mean-field covers it;
+a rejoining node would need row/column re-initialization), slow-node
+(degraded processing) modeling, and LEFT-status propagation. n² memory
+caps the tier at ~8k nodes on one chip — by design; it complements,
+not replaces, the mean-field tier.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.sim.params import SimParams
+from consul_tpu.sim.state import ALIVE, DEAD, SUSPECT
+
+_PREC = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
+_NO_DEADLINE = jnp.int32(2**31 - 1)
+
+
+class ViewState(NamedTuple):
+    """Dense per-viewer cluster state. [n, n] unless noted."""
+
+    up: jnp.ndarray         # [n] bool — ground-truth process liveness
+    down_round: jnp.ndarray  # [n] int32 — round of crash (MAX while up)
+    self_inc: jnp.ndarray   # [n] int32 — each node's own incarnation
+    status: jnp.ndarray     # int8 — viewer i's belief about subject j
+    inc: jnp.ndarray        # int32 — incarnation of that belief
+    susp_start: jnp.ndarray     # int32 — round suspicion began
+    susp_deadline: jnp.ndarray  # int32 — declare-dead round
+    susp_conf: jnp.ndarray  # int8 — independent confirmations seen
+    budget: jnp.ndarray     # int8 — piggyback retransmissions left
+    reach: jnp.ndarray      # bool — packets i→j deliverable
+    round: jnp.ndarray      # [] int32
+
+
+def init_views(n: int) -> ViewState:
+    eye = jnp.eye(n, dtype=bool)
+    return ViewState(
+        up=jnp.ones((n,), bool),
+        down_round=jnp.full((n,), 2**31 - 1, jnp.int32),
+        self_inc=jnp.zeros((n,), jnp.int32),
+        status=jnp.full((n, n), ALIVE, jnp.int8),
+        inc=jnp.zeros((n, n), jnp.int32),
+        susp_start=jnp.zeros((n, n), jnp.int32),
+        susp_deadline=jnp.full((n, n), _NO_DEADLINE),
+        susp_conf=jnp.zeros((n, n), jnp.int8),
+        budget=jnp.zeros((n, n), jnp.int8),
+        reach=jnp.ones((n, n), bool),
+        round=jnp.zeros((), jnp.int32),
+    )
+
+
+def _key(status: jnp.ndarray, inc: jnp.ndarray) -> jnp.ndarray:
+    """Total-order merge key: (incarnation, status precedence)."""
+    prec = jnp.where(status == DEAD, 2,
+                     jnp.where(status == SUSPECT, 1, 0))
+    return inc * 4 + prec.astype(jnp.int32)
+
+
+def _unkey(key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    prec = key % 4
+    status = jnp.where(prec == 2, DEAD,
+                       jnp.where(prec == 1, SUSPECT, ALIVE))
+    return status.astype(jnp.int8), key // 4
+
+
+def _timeout_rounds(p: SimParams) -> tuple[int, int]:
+    """(min, max) suspicion timeout in rounds (Lifeguard window)."""
+    min_r = max(1, round(p.suspicion_min_s / p.probe_interval))
+    max_r = max(min_r, round(p.suspicion_max_s / p.probe_interval))
+    return min_r, max_r
+
+
+def _pick(key: jax.Array, mask: jnp.ndarray) -> jnp.ndarray:
+    """Per-row Gumbel-max categorical draw over mask [n, n] → [n]."""
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(key, mask.shape, minval=1e-9, maxval=1.0)))
+    return jnp.argmax(jnp.where(mask, g, -jnp.inf), axis=1)
+
+
+def _merge(st: ViewState, inc_key: jnp.ndarray, confirm_src: jnp.ndarray,
+           p: SimParams) -> ViewState:
+    """Merge incoming belief keys into every receiver's view.
+
+    ``inc_key`` [n, n]: best key about subject j that reached receiver i
+    this step (-1 where nothing arrived). ``confirm_src`` bool [n, n]:
+    whether the arrival came from another node (a suspicion arriving
+    from elsewhere counts as an independent confirmation, memberlist
+    suspicion.go Confirm)."""
+    own_key = _key(st.status, st.inc)
+    new_key = jnp.maximum(own_key, inc_key)
+    changed = new_key > own_key
+    status, inc = _unkey(new_key)
+    min_r, max_r = _timeout_rounds(p)
+    k = p.confirmation_k
+
+    became_suspect = changed & (status == SUSPECT)
+    # Lifeguard confirmation: the same suspicion arriving again from
+    # another sender shrinks the timer (log-shrink toward min)
+    confirmed = (~changed) & confirm_src & (inc_key == own_key) & \
+        (st.status == SUSPECT)
+    conf = jnp.where(became_suspect, 0,
+                     jnp.minimum(st.susp_conf + confirmed.astype(jnp.int8),
+                                 jnp.int8(k)))
+    start = jnp.where(became_suspect, st.round, st.susp_start)
+    frac = jnp.log1p(conf.astype(jnp.float32)) / jnp.log1p(float(k))
+    shrunk = (start + max_r
+              - (frac * (max_r - min_r)).astype(jnp.int32))
+    deadline = jnp.where(status == SUSPECT,
+                         jnp.where(became_suspect | confirmed,
+                                   jnp.maximum(shrunk,
+                                               start + min_r),
+                                   st.susp_deadline),
+                         _NO_DEADLINE)
+    if not p.lifeguard:  # fixed timer, no confirmation shrink
+        deadline = jnp.where(status == SUSPECT,
+                             jnp.where(became_suspect,
+                                       st.round + min_r,
+                                       st.susp_deadline),
+                             _NO_DEADLINE)
+    # changed entries are re-broadcast (memberlist re-queues updates)
+    budget = jnp.where(changed, jnp.int8(p.retransmit_limit), st.budget)
+    return st._replace(status=status, inc=inc, susp_conf=conf,
+                       susp_start=start, susp_deadline=deadline,
+                       budget=budget)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
+    """One SWIM protocol period over the dense per-viewer state."""
+    n = p.n
+    eye = jnp.eye(n, dtype=bool)
+    k_crash, k_pick, k_ack, k_gossip, k_pp = jax.random.split(key, 5)
+
+    # -- churn: crash injection -----------------------------------------
+    if p.fail_per_round > 0.0:
+        crash = st.up & (jax.random.uniform(k_crash, (n,))
+                         < p.fail_per_round)
+        st = st._replace(
+            up=st.up & ~crash,
+            down_round=jnp.where(crash, st.round, st.down_round))
+
+    # -- probe: every up node probes one alive-view member --------------
+    view_alive = (st.status == ALIVE) & ~eye
+    has_target = view_alive.any(axis=1)
+    target = _pick(k_pick, view_alive)
+    t_up = st.up[target]
+    t_reach = jnp.take_along_axis(st.reach, target[:, None],
+                                  axis=1)[:, 0]
+    # composed ack probability: direct ∪ any-of-k relays ∪ TCP fallback
+    p_relay_all = (1.0 - p.p_relay) ** p.indirect_checks
+    p_noack = (1.0 - p.p_direct) * p_relay_all * (1.0 - p.p_tcp)
+    acked = t_up & t_reach & \
+        (jax.random.uniform(k_ack, (n,)) > p_noack)
+    suspect_it = st.up & has_target & ~acked
+    # direct suspicion: prober i marks target SUSPECT at its known inc
+    t_inc = jnp.take_along_axis(st.inc, target[:, None], axis=1)[:, 0]
+    sus_key = jnp.full((n, n), -1, jnp.int32)
+    sus_key = sus_key.at[jnp.arange(n), target].set(
+        jnp.where(suspect_it, t_inc * 4 + 1, -1))
+    st = _merge(st, sus_key, jnp.zeros((n, n), bool), p)
+
+    # -- gossip: fanout piggyback transmissions -------------------------
+    ticks = int(p.gossip_ticks_per_round)
+
+    def gossip_slot(slot_key, st: ViewState) -> ViewState:
+        kk_pick, kk_loss = jax.random.split(slot_key)
+        # gossip targets come from the non-dead view (memberlist
+        # gossips to alive+suspect members)
+        gmask = (st.status != DEAD) & ~eye
+        recv = _pick(kk_pick, gmask)
+        sendable = st.up & gmask.any(axis=1)
+        delivered = sendable & st.up[recv] & \
+            st.reach[jnp.arange(n), recv] & \
+            (jax.random.uniform(kk_loss, (n,)) > p.loss)
+        hot = st.budget > 0
+        sent_key = jnp.where(hot & delivered[:, None],
+                             _key(st.status, st.inc), -1)
+        # scatter-max into receivers: arrival order cannot matter
+        inc_key = jax.ops.segment_max(
+            sent_key, recv, num_segments=n,
+            indices_are_sorted=False)
+        inc_key = jnp.where(inc_key < -1, -1, inc_key)  # empty segs
+        confirm = inc_key >= 0
+        # the budget is charged on SEND, delivered or not —
+        # memberlist's TransmitLimitedQueue counts transmissions, so
+        # lost packets are not free retries
+        new_budget = jnp.where(hot & sendable[:, None],
+                               st.budget - 1, st.budget)
+        st = st._replace(budget=new_budget)
+        return _merge(st, inc_key, confirm, p)
+
+    for i, sk in enumerate(jax.random.split(k_gossip, ticks)):
+        st = gossip_slot(sk, st)
+
+    # -- push/pull anti-entropy (every push_pull_rounds) ----------------
+    pp_every = max(1, int(30.0 / p.probe_interval))  # ~30s like memberlist
+
+    def push_pull(st: ViewState) -> ViewState:
+        k_alive, k_dead = jax.random.split(k_pp)
+
+        def sync(st: ViewState, partner: jnp.ndarray,
+                 ok: jnp.ndarray) -> ViewState:
+            # bidirectional full-row merge: i pulls partner's view and
+            # pushes its own, budgets ignored (a full-state sync)
+            full_key = _key(st.status, st.inc)
+            pulled = jnp.where(ok[:, None], full_key[partner], -1)
+            pushed = jax.ops.segment_max(
+                jnp.where(ok[:, None], full_key, -1), partner,
+                num_segments=p.n)
+            pushed = jnp.where(pushed < -1, -1, pushed)
+            return _merge(st, jnp.maximum(pulled, pushed),
+                          jnp.zeros((p.n, p.n), bool), p)
+
+        partner = _pick(k_alive, (st.status != DEAD) & ~eye)
+        ok = st.up & st.up[partner] & \
+            st.reach[jnp.arange(n), partner]
+        st = sync(st, partner, ok)
+        # serf's reconnector (serf reconnect.go): each node also
+        # attempts one FAILED-view member. If the member is actually
+        # up and reachable again (partition healed), the sync hands it
+        # the dead rumor about itself — which it then refutes with a
+        # higher incarnation. This is the partition-heal repair path;
+        # without it DEAD entries are never gossiped to and never fix.
+        dead_view = (st.status == DEAD) & ~eye
+        partner2 = _pick(k_dead, dead_view)
+        ok2 = st.up & dead_view.any(axis=1) & st.up[partner2] & \
+            st.reach[jnp.arange(n), partner2]
+        return sync(st, partner2, ok2)
+
+    st = jax.lax.cond(
+        (st.round % pp_every) == (pp_every - 1), push_pull,
+        lambda s: s, st)
+
+    # -- suspicion expiry: SUSPECT past deadline → DEAD -----------------
+    expired = (st.status == SUSPECT) & (st.round >= st.susp_deadline) \
+        & st.up[:, None]
+    status = jnp.where(expired, jnp.int8(DEAD), st.status)
+    budget = jnp.where(expired, jnp.int8(p.retransmit_limit), st.budget)
+    st = st._replace(status=status, budget=budget,
+                     susp_deadline=jnp.where(expired, _NO_DEADLINE,
+                                             st.susp_deadline))
+
+    # -- refutation: a live node that sees itself suspected/dead --------
+    self_view = st.status[jnp.arange(n), jnp.arange(n)]
+    self_known_inc = st.inc[jnp.arange(n), jnp.arange(n)]
+    refute = st.up & (self_view != ALIVE)
+    new_self_inc = jnp.where(refute, self_known_inc + 1, st.self_inc)
+    status = st.status.at[jnp.arange(n), jnp.arange(n)].set(
+        jnp.where(st.up, jnp.int8(ALIVE),
+                  st.status[jnp.arange(n), jnp.arange(n)]))
+    inc = st.inc.at[jnp.arange(n), jnp.arange(n)].set(
+        jnp.where(st.up, new_self_inc,
+                  st.inc[jnp.arange(n), jnp.arange(n)]))
+    budget = st.budget.at[jnp.arange(n), jnp.arange(n)].set(
+        jnp.where(refute, jnp.int8(p.retransmit_limit),
+                  st.budget[jnp.arange(n), jnp.arange(n)]))
+    st = st._replace(self_inc=new_self_inc, status=status, inc=inc,
+                     budget=budget)
+
+    return st._replace(round=st.round + 1)
+
+
+@functools.partial(jax.jit, static_argnames=("p", "rounds"))
+def _run_views_scan(st: ViewState, key: jax.Array, p: SimParams,
+                    rounds: int) -> ViewState:
+    def body(st, k):
+        return views_round(st, k, p), None
+
+    st, _ = jax.lax.scan(body, st, jax.random.split(key, rounds))
+    return st
+
+
+def run_views(st: ViewState, key: jax.Array, p: SimParams,
+              rounds: int) -> ViewState:
+    """rounds × views_round under one jit (lax.scan over round keys).
+
+    Module-level jit wrapper so repeat calls with the same (p, rounds)
+    hit the compilation cache instead of retracing the n×n scan."""
+    return _run_views_scan(st, key, p, rounds)
+
+
+# ------------------------------------------------------------- metrics
+
+def view_metrics(st: ViewState) -> dict:
+    """Aggregate view-divergence / detector statistics (host-visible)."""
+    n = st.status.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+    up_i = st.up[:, None] & ~eye
+    live_pair = up_i & st.up[None, :]
+    dead_pair = up_i & ~st.up[None, :]
+    live_total = jnp.maximum(live_pair.sum(), 1)
+    dead_total = jnp.maximum(dead_pair.sum(), 1)
+    fp = (live_pair & (st.status == DEAD)).sum()
+    suspected = (live_pair & (st.status == SUSPECT)).sum()
+    detected = (dead_pair & (st.status == DEAD)).sum()
+    wrong = (live_pair & (st.status != ALIVE)) | \
+        (dead_pair & (st.status != DEAD))
+    return {
+        "round": int(st.round),
+        "up": int(st.up.sum()),
+        "false_positive_pairs": int(fp),
+        "fp_rate": float(fp / live_total),
+        "suspect_pairs": int(suspected),
+        "detected_frac": float(detected / dead_total),
+        "view_divergence": float(wrong.sum()
+                                 / jnp.maximum(up_i.sum(), 1)),
+        "max_incarnation": int(st.self_inc.max()),
+    }
+
+
+def partition_reach(n: int, split: int) -> jnp.ndarray:
+    """reach matrix for a clean partition: [0, split) ⇹ [split, n)."""
+    left = jnp.arange(n) < split
+    same = left[:, None] == left[None, :]
+    return same
