@@ -1109,7 +1109,12 @@ def cmd_connect(args) -> int:
         # no O_CREAT and a FIFO re-check on the OPENED fd: a path swap
         # between the stat above and this open (TOCTOU) must not land
         # the secrets in a regular file
-        fd = os.open(args.pipe, os.O_WRONLY)
+        try:
+            fd = os.open(args.pipe, os.O_WRONLY)
+        except OSError as e:
+            print(f"Error: cannot open {args.pipe!r}: {e}",
+                  file=sys.stderr)
+            return 1
         try:
             if not stat.S_ISFIFO(os.fstat(fd).st_mode):
                 print(f"Error: {args.pipe!r} is not a named pipe",

@@ -25,13 +25,24 @@ class Broadcast:
 
 class TransmitLimitedQueue:
     def __init__(self, retransmit_mult: int = 4,
-                 min_queue_depth: int = 4096) -> None:
+                 min_queue_depth: int = 4096,
+                 queue_depth_warning: int = 1_000_000) -> None:
         self.retransmit_mult = retransmit_mult
         self.min_queue_depth = min_queue_depth
+        # libserf sets this to 1e6 to silence serf's default 128-entry
+        # warning; we keep the knob so operators can lower it again
+        self.queue_depth_warning = queue_depth_warning
+        self._warned = False
         self._by_key: dict[str, Broadcast] = {}
         # accessed from packet-handler threads and timer threads in
         # real-clock mode
         self._lock = threading.Lock()
+
+    def max_depth(self, n_nodes: int) -> int:
+        """Dynamic queue-depth limit: max(MinQueueDepth, 2·n) — serf's
+        dynamic sizing enabled by libserf's MinQueueDepth=4096
+        (internal/gossip/libserf/serf.go:25-27; serf queueDepth)."""
+        return max(self.min_queue_depth, 2 * n_nodes)
 
     def __len__(self) -> int:
         return len(self._by_key)
@@ -58,9 +69,19 @@ class TransmitLimitedQueue:
         fastest). Increments transmit counts and reaps exhausted rumors.
         """
         limit = self.retransmit_limit(n_nodes)
+        self.prune(self.max_depth(n_nodes))
         out: list[bytes] = []
         used = 0
         with self._lock:
+            if len(self._by_key) > self.queue_depth_warning \
+                    and not self._warned:
+                self._warned = True
+                import logging
+
+                logging.getLogger("consul_tpu.gossip").warning(
+                    "broadcast queue depth %d exceeds warning "
+                    "threshold %d", len(self._by_key),
+                    self.queue_depth_warning)
             for b in sorted(self._by_key.values(),
                             key=lambda b: b.transmits):
                 cost = len(b.payload) + overhead

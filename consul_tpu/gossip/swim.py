@@ -166,7 +166,8 @@ class Memberlist:
         self._seq = 0
         self._ack_handlers: dict[int, tuple[Callable, Callable, Any]] = {}
         self._queue = TransmitLimitedQueue(
-            self.config.retransmit_mult, self.config.min_queue_depth)
+            self.config.retransmit_mult, self.config.min_queue_depth,
+            self.config.queue_depth_warning)
         self._loop_timers: dict[int, Any] = {}  # one live timer per loop
         self._loop_seq = 0
         self._left = False  # we initiated a graceful leave

@@ -96,6 +96,10 @@ class RaftNode:
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._election_timer = None
+        # real-clock election watchdog (see _reset_election_timer)
+        self._watchdog: Optional[threading.Thread] = None
+        self._watchdog_cv = threading.Condition()
+        self._election_deadline = 0.0
         self._heartbeat_timer = None
         self._stopped = False
         self._last_leader_contact = 0.0
@@ -133,6 +137,8 @@ class RaftNode:
             self._applied_cv.notify_all()
             self._repl_cv.notify_all()
             self._apply_cv.notify_all()
+        with self._watchdog_cv:
+            self._watchdog_cv.notify_all()
 
     # ------------------------------------------------------------- surface
 
@@ -285,11 +291,49 @@ class RaftNode:
     # ------------------------------------------------------------ elections
 
     def _reset_election_timer(self) -> None:
-        if self._election_timer is not None:
-            self._election_timer.cancel()
         timeout = self.election_timeout * (1.0 + self.rng.random())
-        self._election_timer = self.scheduler.after(
-            timeout, self._election_timeout)
+        if isinstance(self.clock, SimClock):
+            if self._election_timer is not None:
+                self._election_timer.cancel()
+            self._election_timer = self.scheduler.after(
+                timeout, self._election_timeout)
+            return
+        # real clock: one persistent watchdog thread per node with a
+        # movable deadline. Resets happen on EVERY append_entries (the
+        # leader's heartbeat path) — spawning a threading.Timer each
+        # time made timer churn the top cost of the replication
+        # hot loop (~900 thread starts per 2s of KV PUT bench)
+        import time as _time
+
+        with self._watchdog_cv:
+            # check-and-spawn under the cv: start() and an early
+            # append_entries RPC can race here, and two watchdogs
+            # would double the spurious election-timeout rate forever
+            self._election_deadline = _time.monotonic() + timeout
+            if self._watchdog is None or not self._watchdog.is_alive():
+                self._watchdog = threading.Thread(
+                    target=self._election_watchdog, daemon=True,
+                    name=f"raft-election-{self.id}")
+                self._watchdog.start()
+            else:
+                self._watchdog_cv.notify()
+
+    def _election_watchdog(self) -> None:
+        import time as _time
+
+        while True:
+            with self._watchdog_cv:
+                if self._stopped:
+                    return
+                remaining = self._election_deadline - _time.monotonic()
+                if remaining > 0:
+                    self._watchdog_cv.wait(remaining)
+                    continue
+                # rearm before firing so a slow election does not
+                # double-fire from a stale deadline
+                self._election_deadline = _time.monotonic() + \
+                    self.election_timeout * (1.0 + self.rng.random())
+            self._election_timeout()
 
     def _election_timeout(self) -> None:
         if self._stopped or self.role == Role.LEADER:

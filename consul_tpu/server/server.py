@@ -690,14 +690,18 @@ class Server:
                 self._bootstrapped = True
             elif len(self.raft.peers) >= max(
                     self.config.bootstrap_expect, 1):
-                self._bootstrapped = True
+                # latch only after the marker COMMITS — latching first
+                # would drop the retry on apply failure and leave the
+                # cluster unmarked across a failover
                 try:
                     self.raft.apply(encode_command(
                         MessageType.SYSTEM_METADATA,
                         {"Op": "set", "Key": "bootstrap-complete",
                          "Value": "true"}))
+                    self._bootstrapped = True
                 except Exception as e:  # noqa: BLE001
-                    self.log.debug("bootstrap marker write: %s", e)
+                    self.log.debug("bootstrap marker write (will "
+                                   "retry next tick): %s", e)
         for addr in servers - self.raft.peers:
             if self._bootstrapped and \
                     now - self._server_first_seen.get(addr, now) < stab:

@@ -69,7 +69,6 @@ import jax.numpy as jnp
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import ALIVE, DEAD, SUSPECT
 
-_PREC = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
 _NO_DEADLINE = jnp.int32(2**31 - 1)
 
 

@@ -270,3 +270,18 @@ def test_protocol_version_negotiation():
     # our own alive rumors advertise the range
     me = ml._members["a"]
     ml._broadcast_alive(me)
+
+
+def test_broadcast_queue_dynamic_depth():
+    """libserf dynamic queue sizing: depth limit = max(MinQueueDepth,
+    2n), enforced during batch selection (serf.go:25-27)."""
+    from consul_tpu.gossip.broadcast import TransmitLimitedQueue
+
+    q = TransmitLimitedQueue(min_queue_depth=8)
+    assert q.max_depth(3) == 8          # floor
+    assert q.max_depth(100) == 200      # dynamic: 2n
+    for i in range(50):
+        q.queue(f"alive:n{i}", b"x" * 4)
+    assert len(q) == 50
+    q.get_batch(n_nodes=3, budget=0)    # prunes to max_depth(3)=8
+    assert len(q) == 8
