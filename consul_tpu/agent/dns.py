@@ -140,7 +140,10 @@ class DNSServer:
 
     # ------------------------------------------------------------ protocol
 
-    def handle(self, data: bytes) -> Optional[bytes]:
+    def handle(self, data: bytes, tcp: bool = False) -> Optional[bytes]:
+        """Answer one wire-format DNS message. tcp=True lifts the UDP
+        512-byte/EDNS truncation (RFC 1035 §4.2.2 — TCP and the pbdns
+        gRPC transport carry up to 64KB, so no TC bit)."""
         if len(data) < 12:
             return None
         (qid, flags, qd, an, ns, ar) = struct.unpack_from(">HHHHHH", data)
@@ -191,6 +194,8 @@ class DNSServer:
             ns_count = 1
         resp = struct.pack(">HHHHHH", qid, hdr_flags, 1, len(answers),
                            ns_count, 0) + question + payload + authority
+        if tcp:
+            udp_size = 65535
         if len(resp) > udp_size:
             # truncate: header with TC bit, no answers
             resp = struct.pack(">HHHHHH", qid, hdr_flags | 0x0200, 1, 0,

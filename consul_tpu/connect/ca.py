@@ -117,6 +117,84 @@ def sign_leaf(root: dict[str, str], service: str, dc: str,
     }
 
 
+def csr_service(csr_pem: str) -> tuple[str, str]:
+    """(service, spiffe_uri) from a CSR's SPIFFE URI SAN, falling back
+    to the CN (connect/csr.go: the CSR carries the requested identity;
+    the CA decides whether the caller may have it)."""
+    csr = x509.load_pem_x509_csr(csr_pem.encode())
+    uri = ""
+    try:
+        sans = csr.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        uris = sans.get_values_for_type(x509.UniformResourceIdentifier)
+        if uris:
+            uri = uris[0]
+    except x509.ExtensionNotFound:
+        pass
+    if uri and "/svc/" in uri:
+        return uri.rsplit("/svc/", 1)[1], uri
+    cn = csr.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+    return (cn[0].value if cn else ""), uri
+
+
+def sign_csr(root: dict[str, str], csr_pem: str, dc: str,
+             ttl_hours: float = 72.0) -> dict[str, str]:
+    """Issue a leaf over a caller-provided CSR: the caller keeps its
+    private key (pbconnectca Sign / provider_consul.go Sign — the
+    reference's external-client path, unlike sign_leaf which mints the
+    keypair server-side for in-process callers)."""
+    ca_key = serialization.load_pem_private_key(
+        root["PrivateKey"].encode(), password=None)
+    ca_cert = x509.load_pem_x509_certificate(root["RootCert"].encode())
+    csr = x509.load_pem_x509_csr(csr_pem.encode())
+    service, uri = csr_service(csr_pem)
+    if not service:
+        raise ValueError("CSR carries no service identity")
+    # the signed identity must be EXACTLY the one the caller was
+    # authorized for: a CSR may not smuggle a foreign-trust-domain or
+    # non-service SPIFFE URI past a service:write ACL check (the
+    # reference validates the CSR URI against the token the same way)
+    expected = spiffe_id(root["TrustDomain"], dc, service)
+    if uri and uri != expected:
+        raise ValueError(
+            f"CSR URI SAN {uri!r} does not match the authorized "
+            f"identity {expected!r}")
+    uri = expected
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(x509.Name([
+                x509.NameAttribute(NameOID.COMMON_NAME, service)]))
+            .issuer_name(ca_cert.subject)
+            .public_key(csr.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(hours=ttl_hours))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.UniformResourceIdentifier(uri)]), critical=False)
+            .add_extension(x509.BasicConstraints(ca=False,
+                                                 path_length=None),
+                           critical=True)
+            .add_extension(x509.ExtendedKeyUsage([
+                x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH,
+                x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]),
+                critical=False)
+            .add_extension(x509.SubjectKeyIdentifier.from_public_key(
+                csr.public_key()), critical=False)
+            .add_extension(
+                x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                    ca_key.public_key()), critical=False)
+            .sign(ca_key, hashes.SHA256()))
+    return {
+        "SerialNumber": format(cert.serial_number, "x"),
+        "CertPEM": cert.public_bytes(
+            serialization.Encoding.PEM).decode(),
+        "Service": service,
+        "ServiceURI": uri,
+        "ValidAfter": cert.not_valid_before_utc.isoformat(),
+        "ValidBefore": cert.not_valid_after_utc.isoformat(),
+    }
+
+
 def cross_sign(old_root: dict[str, str],
                new_root: dict[str, str]) -> str:
     """Cross-sign the NEW root's key with the OLD root's key
@@ -247,6 +325,16 @@ class CAManager:
         return self.provider.sign_leaf(
             root, service, self.server.config.datacenter,
             ttl_hours=ttl_hours)
+
+    def sign_csr(self, csr_pem: str,
+                 ttl_hours: float = 72.0) -> dict[str, Any]:
+        """Issue a leaf over a caller-held CSR (pbconnectca Sign).
+        Built-in provider signs with the replicated root key; external
+        provider seams would forward the CSR to the authority."""
+        root = self.initialize()
+        return sign_csr(root, csr_pem,
+                        self.server.config.datacenter,
+                        ttl_hours=ttl_hours)
 
     def rotate(self) -> dict[str, Any]:
         """Generate and activate a new root. ALL prior roots stay

@@ -69,10 +69,9 @@ class TransmitLimitedQueue:
         fastest). Increments transmit counts and reaps exhausted rumors.
         """
         limit = self.retransmit_limit(n_nodes)
-        self.prune(self.max_depth(n_nodes))
-        out: list[bytes] = []
-        used = 0
         with self._lock:
+            # warn on the PRE-prune depth: prune is about to discard
+            # the very backlog the warning exists to surface
             if len(self._by_key) > self.queue_depth_warning \
                     and not self._warned:
                 self._warned = True
@@ -82,6 +81,10 @@ class TransmitLimitedQueue:
                     "broadcast queue depth %d exceeds warning "
                     "threshold %d", len(self._by_key),
                     self.queue_depth_warning)
+        self.prune(self.max_depth(n_nodes))
+        out: list[bytes] = []
+        used = 0
+        with self._lock:
             for b in sorted(self._by_key.values(),
                             key=lambda b: b.transmits):
                 cost = len(b.payload) + overhead

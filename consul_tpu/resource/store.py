@@ -192,15 +192,22 @@ class ResourceStore:
     # ----------------------------------------------------------- watches
 
     def watch_list(self, rtype: dict[str, Any], tenancy: dict[str, Any],
-                   name_prefix: str = "") -> Watch:
+                   name_prefix: str = "",
+                   mark_snapshot: bool = False) -> Watch:
         """Watch matching resources: current state arrives first as
         upserts, then deltas, in commit order (storage.go:227-253).
         Registering the watch and snapshotting current state happen
-        under one lock so no event is missed or duplicated."""
+        under one lock so no event is missed or duplicated.
+        mark_snapshot appends an "end_of_snapshot" sentinel after the
+        initial upserts (pbresource WatchList's EndOfSnapshot frame);
+        opt-in so controller loops keep their plain upsert/delete
+        stream."""
         w = Watch(self)
         with self._lock:
             for r in self.list(rtype, tenancy, name_prefix):
                 w._push(WatchEvent("upsert", r))
+            if mark_snapshot:
+                w._push(WatchEvent("end_of_snapshot", {}))
             self._watches.append((w, rtype.get("Group", ""),
                                   rtype.get("Kind", ""), dict(tenancy or {}),
                                   name_prefix))

@@ -1275,13 +1275,28 @@ def register_endpoints(srv) -> None:
 
     def ca_sign(args):
         """Issue a leaf for a service (ConnectCA.Sign; leaf manager path
-        agent/leafcert in the reference)."""
-        service = args.get("Service", "")
+        agent/leafcert in the reference). With a CSR the caller keeps
+        its key and the requested identity comes from the CSR's SPIFFE
+        SAN (pbconnectca Sign path)."""
+        csr = args.get("CSR", "")
+        if csr:
+            from consul_tpu.connect.ca import csr_service
+
+            service, _ = csr_service(csr)
+        else:
+            service = args.get("Service", "")
         require(authz(args).service_write(service),
                 f"service write on {service!r}")
         if not srv.is_leader():
             return srv._forward_to_leader("ConnectCA.Sign", args)
         root = srv.ca.initialize()
+        if csr:
+            leaf = srv.ca.sign_csr(csr)
+            if root.get("CrossSignedIntermediate"):
+                # same rotation bridge as the service path below
+                leaf["CertChainPEM"] = (
+                    leaf["CertPEM"] + root["CrossSignedIntermediate"])
+            return leaf
         leaf = srv.ca.sign(service, root=root)
         if root.get("CrossSignedIntermediate"):
             # present the rotation bridge with the leaf so old-root

@@ -22,10 +22,15 @@ Served methods:
                                            the agent's gRPC check runner)
   /hashicorp.consul.dataplane.DataplaneService/{GetSupportedDataplaneFeatures,
                                                 GetEnvoyBootstrapParams}
-  /hashicorp.consul.resource.ResourceService/{Read,Write,List,Delete}
-                                          (pbresource v2 CRUD — the
-                                           transport `consul resource
-                                           *-grpc` speaks)
+  /hashicorp.consul.resource.ResourceService/{Read,Write,List,Delete,
+                                              WatchList}
+                                          (pbresource v2 CRUD+watch —
+                                           the transport `consul
+                                           resource *-grpc` speaks)
+  /hashicorp.consul.dns.DNSService/Query  (raw DNS wire msg over gRPC)
+  /hashicorp.consul.connectca.ConnectCAService/{WatchRoots,Sign}
+                                          (root watch stream + CSR leaf
+                                           signing)
 """
 
 from __future__ import annotations
@@ -160,6 +165,43 @@ RES_DELETE_REQ = {"id": Field(1, "message", RES_ID),
 RES_DELETE_RESP: dict[str, Field] = {}
 
 RESOURCE_SVC = "/hashicorp.consul.resource.ResourceService"
+
+# pbresource WatchList (resource.proto WatchEvent: oneof
+# upsert=1 / delete=2 / end_of_snapshot=3)
+RES_WATCH_REQ = {"type": Field(1, "message", RES_TYPE),
+                 "tenancy": Field(2, "message", RES_TENANCY),
+                 "name_prefix": Field(3, "string")}
+_EVT_WRAP = {"resource": Field(1, "message", RES_MSG)}
+RES_WATCH_EVENT = {
+    "upsert": Field(1, "message", _EVT_WRAP),
+    "delete": Field(2, "message", _EVT_WRAP),
+    # an empty oneof arm whose mere presence IS the event
+    "end_of_snapshot": Field(3, "message", {}, presence=True),
+}
+
+# hashicorp.consul.dns (proto-public/pbdns/dns.proto): raw DNS wire
+# messages over gRPC — protocol 1=TCP, 2=UDP
+DNS_QUERY_REQ = {"msg": Field(1, "bytes"), "protocol": Field(2, "enum")}
+DNS_QUERY_RESP = {"msg": Field(1, "bytes")}
+
+# hashicorp.consul.connectca (proto-public/pbconnectca/ca.proto)
+CA_ROOT_MSG = {
+    "id": Field(1, "string"),
+    "name": Field(2, "string"),
+    "serial_number": Field(3, "int"),  # proto uint64
+    "signing_key_id": Field(4, "string"),
+    "root_cert": Field(5, "string"),
+    "intermediate_certs": Field(6, "string", repeated=True),
+    "active": Field(7, "bool"),
+}
+CA_WATCH_ROOTS_REQ: dict[str, Field] = {}
+CA_WATCH_ROOTS_RESP = {
+    "active_root_id": Field(1, "string"),
+    "trust_domain": Field(2, "string"),
+    "roots": Field(3, "message", CA_ROOT_MSG, repeated=True),
+}
+CA_SIGN_REQ = {"csr": Field(1, "string")}
+CA_SIGN_RESP = {"cert_pem": Field(2, "string")}
 
 
 def _res_to_pb(r: dict[str, Any]) -> dict[str, Any]:
@@ -613,19 +655,156 @@ def make_grpc_server(agent, bind_addr: str, port: int):
             context.abort(grpc.StatusCode.ABORTED, out["Error"])
         return encode(RES_DELETE_RESP, {})
 
+    def resource_watch_list(req: dict, context) -> Iterator[bytes]:
+        """pbresource WatchList: initial snapshot as upserts, an
+        EndOfSnapshot frame, then live deltas. Reads the LOCAL server's
+        store (the reference hosts this service on servers; watches are
+        stale-read by nature)."""
+        from consul_tpu.resource.types import WatchClosed
+
+        if agent.server is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "WatchList requires a server agent")
+        t = req.get("type") or {}
+        ten = req.get("tenancy") or {}
+        w = agent.server.state.resources.watch_list(
+            {"Group": t.get("group", ""),
+             "GroupVersion": t.get("group_version", ""),
+             "Kind": t.get("kind", "")},
+            {"Partition": ten.get("partition", "") or "*",
+             "Namespace": ten.get("namespace", "") or "*"},
+            req.get("name_prefix", ""), mark_snapshot=True)
+        try:
+            while context.is_active():
+                try:
+                    ev = w.next(timeout=1.0)
+                except WatchClosed:
+                    return
+                if ev is None:
+                    continue
+                if ev.op == "end_of_snapshot":
+                    yield encode(RES_WATCH_EVENT,
+                                 {"end_of_snapshot": {}})
+                else:
+                    yield encode(RES_WATCH_EVENT, {
+                        ev.op: {"resource": _res_to_pb(ev.resource)}})
+        finally:
+            w.close()
+
+    def dns_query(req: dict, context) -> bytes:
+        """pbdns Query: a raw DNS wire message answered by the same
+        RFC1035 codec the UDP/TCP listener uses (services/dns/server.go
+        feeds the in-process dns mux identically)."""
+        from consul_tpu.agent.dns import DNSServer
+
+        dns = agent.dns
+        if dns is None:
+            # agent runs without a DNS listener: codec-only instance
+            # (never start()ed, so no socket is bound)
+            dns = agent._grpc_dns_codec = getattr(
+                agent, "_grpc_dns_codec", None) or DNSServer(
+                    agent, agent.config.bind_addr, 0)
+        # protocol 1=TCP, 2=UDP (dns.proto): TCP semantics lift the
+        # 512-byte truncation — gRPC has no datagram size limit
+        out = dns.handle(req.get("msg", b""),
+                         tcp=req.get("protocol", 2) == 1)
+        if out is None:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "malformed DNS query")
+        return encode(DNS_QUERY_RESP, {"msg": out})
+
+    def _roots_frame(min_index: int) -> tuple[bytes, int]:
+        """One ConnectCA.Roots read as a pb frame. min_index > 0 makes
+        it a BLOCKING query — the stream parks server-side until the
+        config_entries table moves (no per-second polling per
+        watcher)."""
+        res = agent.rpc("ConnectCA.Roots", {
+            "AllowStale": True, "MinQueryIndex": min_index,
+            "MaxQueryTime": 30.0})
+        roots = []
+        active_id = ""
+        for i, r in enumerate(res.get("Roots") or []):
+            rid = hashlib.sha256(
+                r.get("RootCert", "").encode()).hexdigest()[:16]
+            if i == 0:
+                active_id = rid
+            inter = []
+            if r.get("CrossSignedIntermediate"):
+                inter.append(r["CrossSignedIntermediate"])
+            roots.append({"id": rid,
+                          "name": f"Consul CA Root Cert {rid[:8]}",
+                          "root_cert": r.get("RootCert", ""),
+                          "intermediate_certs": inter,
+                          "active": i == 0})
+        return encode(CA_WATCH_ROOTS_RESP, {
+            "active_root_id": active_id,
+            "trust_domain": res.get("TrustDomain", ""),
+            "roots": roots}), int(res.get("Index") or 0)
+
+    def ca_watch_roots(req: dict, context) -> Iterator[bytes]:
+        """pbconnectca WatchRoots: current roots immediately, then a
+        new frame on every root change (rotation), riding the blocking
+        query so an idle stream costs nothing between changes."""
+        last: Optional[bytes] = None
+        index = 0
+        while context.is_active():
+            frame, index = _roots_frame(index)
+            if frame != last:
+                last = frame
+                yield frame
+
+    def ca_sign(req: dict, context) -> bytes:
+        """pbconnectca Sign: leaf over a caller-held CSR."""
+        try:
+            leaf = agent.rpc("ConnectCA.Sign", {"CSR": req.get("csr",
+                                                               "")})
+        except ValueError as e:  # malformed CSR / identity mismatch
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except Exception as e:
+            # keep retry semantics honest for callers: credential
+            # problems are not malformed requests, and transient
+            # no-leader errors are not permanent ones
+            msg = str(e)
+            code = grpc.StatusCode.PERMISSION_DENIED \
+                if "Permission denied" in msg else \
+                grpc.StatusCode.INTERNAL
+            context.abort(code, msg)
+        return encode(CA_SIGN_RESP,
+                      {"cert_pem": leaf.get("CertPEM", "")})
+
     resource_methods = {
         f"{RESOURCE_SVC}/Read": (resource_read, RES_READ_REQ),
         f"{RESOURCE_SVC}/Write": (resource_write, RES_WRITE_REQ),
         f"{RESOURCE_SVC}/List": (resource_list, RES_LIST_REQ),
         f"{RESOURCE_SVC}/Delete": (resource_delete, RES_DELETE_REQ),
     }
+    stream_methods = {
+        f"{RESOURCE_SVC}/WatchList":
+            (resource_watch_list, RES_WATCH_REQ),
+        "/hashicorp.consul.connectca.ConnectCAService/WatchRoots":
+            (ca_watch_roots, CA_WATCH_ROOTS_REQ),
+    }
+    unary_methods = {
+        "/hashicorp.consul.dns.DNSService/Query":
+            (dns_query, DNS_QUERY_REQ),
+        "/hashicorp.consul.connectca.ConnectCAService/Sign":
+            (ca_sign, CA_SIGN_REQ),
+    }
 
     class Handlers(grpc.GenericRpcHandler):
         def service(self, hcd):
             m = hcd.method
-            if m in resource_methods:
-                fn, req_spec = resource_methods[m]
+            if m in resource_methods or m in unary_methods:
+                fn, req_spec = (resource_methods.get(m)
+                                or unary_methods[m])
                 return grpc.unary_unary_rpc_method_handler(
+                    fn,
+                    request_deserializer=(
+                        lambda b, _s=req_spec: decode(_s, b)),
+                    response_serializer=lambda b: b)
+            if m in stream_methods:
+                fn, req_spec = stream_methods[m]
+                return grpc.unary_stream_rpc_method_handler(
                     fn,
                     request_deserializer=(
                         lambda b, _s=req_spec: decode(_s, b)),
@@ -678,6 +857,6 @@ def make_grpc_server(agent, bind_addr: str, port: int):
         return None
     server.start()
     logger.info("external gRPC listening on %s:%d (ADS, server "
-                "discovery, health, dataplane, resource)",
-                bind_addr, bound)
+                "discovery, health, dataplane, resource, dns, "
+                "connectca)", bind_addr, bound)
     return server, bound

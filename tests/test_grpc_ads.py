@@ -375,3 +375,171 @@ def test_resource_service_error_codes(agent):
     assert ei.value.code() == grpc.StatusCode.ABORTED
     call("Delete", ge.RES_DELETE_REQ, ge.RES_DELETE_RESP,
          {"id": {"name": "cas-album", "type": rtype}, "version": ver})
+
+
+def _grpc_chan(agent):
+    import grpc
+
+    return grpc.insecure_channel(f"127.0.0.1:{agent.grpc_port}")
+
+
+def test_dns_service_over_grpc(agent, client):
+    """pbdns Query: raw DNS wire message in/out (dns.proto msg bytes)."""
+    from consul_tpu.server import grpc_external as ge
+
+    # A-record query for db.service.consul, RFC1035 by hand
+    qname = b"".join(bytes([len(p)]) + p
+                     for p in b"db.service.consul".split(b".")) + b"\0"
+    query = (b"\x12\x34\x01\x00\x00\x01\x00\x00\x00\x00\x00\x00"
+             + qname + b"\x00\x01\x00\x01")
+    with _grpc_chan(agent) as ch:
+        stub = ch.unary_unary(
+            "/hashicorp.consul.dns.DNSService/Query",
+            request_serializer=lambda d: encode(ge.DNS_QUERY_REQ, d),
+            response_deserializer=lambda b: decode(ge.DNS_QUERY_RESP,
+                                                   b))
+        resp = stub({"msg": query, "protocol": 2}, timeout=10)
+    out = resp["msg"]
+    assert out[:2] == b"\x12\x34"          # same query id
+    assert out[2] & 0x80                   # QR: response
+    ancount = int.from_bytes(out[6:8], "big")
+    assert ancount >= 1                    # db1 answered
+
+
+def test_connectca_grpc_watch_roots_and_sign(agent, client):
+    """pbconnectca: WatchRoots first frame carries the active root;
+    Sign issues a leaf over a caller-held CSR (key never leaves us)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    from consul_tpu.server import grpc_external as ge
+
+    with _grpc_chan(agent) as ch:
+        watch = ch.unary_stream(
+            "/hashicorp.consul.connectca.ConnectCAService/WatchRoots",
+            request_serializer=lambda d: encode(
+                ge.CA_WATCH_ROOTS_REQ, d),
+            response_deserializer=lambda b: decode(
+                ge.CA_WATCH_ROOTS_RESP, b))
+        it = watch({}, timeout=15)
+        frame = next(it)
+        assert frame["trust_domain"].endswith(".consul")
+        roots = frame["roots"]
+        assert roots and roots[0]["active"] is True
+        assert "BEGIN CERTIFICATE" in roots[0]["root_cert"]
+        assert frame["active_root_id"] == roots[0]["id"]
+        it.cancel()
+
+        key = ec.generate_private_key(ec.SECP256R1())
+        trust = frame["trust_domain"]
+        uri = f"spiffe://{trust}/ns/default/dc/dc1/svc/csr-svc"
+        csr = (x509.CertificateSigningRequestBuilder()
+               .subject_name(x509.Name([x509.NameAttribute(
+                   NameOID.COMMON_NAME, "csr-svc")]))
+               .add_extension(x509.SubjectAlternativeName(
+                   [x509.UniformResourceIdentifier(uri)]),
+                   critical=False)
+               .sign(key, hashes.SHA256()))
+        csr_pem = csr.public_bytes(serialization.Encoding.PEM).decode()
+        sign = ch.unary_unary(
+            "/hashicorp.consul.connectca.ConnectCAService/Sign",
+            request_serializer=lambda d: encode(ge.CA_SIGN_REQ, d),
+            response_deserializer=lambda b: decode(ge.CA_SIGN_RESP, b))
+        resp = sign({"csr": csr_pem}, timeout=10)
+    cert = x509.load_pem_x509_certificate(resp["cert_pem"].encode())
+    # the leaf carries OUR public key (we kept the private half)...
+    assert cert.public_key().public_numbers() == \
+        key.public_key().public_numbers()
+    # ...and the SPIFFE identity from the CSR
+    sans = cert.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName).value
+    assert uri in sans.get_values_for_type(
+        x509.UniformResourceIdentifier)
+
+
+def test_resource_watch_list_stream(agent):
+    """pbresource WatchList: snapshot upserts -> EndOfSnapshot -> live
+    deltas, over a real gRPC stream."""
+    import queue as queue_mod
+    import threading
+
+    from consul_tpu.server import grpc_external as ge
+
+    rtype = {"group": "demo", "group_version": "v1", "kind": "Watched"}
+    agent.rpc("Resource.Write", {"Resource": {
+        "Id": {"Name": "pre-existing",
+               "Type": {"Group": "demo", "GroupVersion": "v1",
+                        "Kind": "Watched"},
+               "Tenancy": {"Partition": "default",
+                           "Namespace": "default"}},
+        "Data": {"n": 1}}})
+    frames: "queue_mod.Queue" = queue_mod.Queue()
+    with _grpc_chan(agent) as ch:
+        watch = ch.unary_stream(
+            f"{ge.RESOURCE_SVC}/WatchList",
+            request_serializer=lambda d: encode(ge.RES_WATCH_REQ, d),
+            response_deserializer=lambda b: decode(
+                ge.RES_WATCH_EVENT, b))
+        it = watch({"type": rtype}, timeout=30)
+
+        def pump():
+            try:
+                for f in it:
+                    frames.put(f)
+            except Exception:  # noqa: BLE001 — stream cancelled
+                pass
+
+        threading.Thread(target=pump, daemon=True).start()
+        first = frames.get(timeout=10)
+        assert first.get("upsert"), first
+        assert first["upsert"]["resource"]["id"]["name"] == \
+            "pre-existing"
+        second = frames.get(timeout=10)
+        assert "end_of_snapshot" in second, second
+        # a live write arrives as an upsert delta
+        agent.rpc("Resource.Write", {"Resource": {
+            "Id": {"Name": "live-one",
+                   "Type": {"Group": "demo", "GroupVersion": "v1",
+                            "Kind": "Watched"},
+                   "Tenancy": {"Partition": "default",
+                               "Namespace": "default"}},
+            "Data": {"n": 2}}})
+        delta = frames.get(timeout=10)
+        assert delta.get("upsert"), delta
+        assert delta["upsert"]["resource"]["id"]["name"] == "live-one"
+        it.cancel()
+
+
+def test_connectca_sign_rejects_smuggled_identity(agent, client):
+    """A CSR whose URI SAN is not the exact identity the token was
+    authorized for (e.g. an agent identity behind an innocent CN) must
+    be refused, not signed verbatim."""
+    import grpc
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    from consul_tpu.server import grpc_external as ge
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    evil = "spiffe://other-trust.consul/agent/client/dc/dc1/id/node1"
+    csr = (x509.CertificateSigningRequestBuilder()
+           .subject_name(x509.Name([x509.NameAttribute(
+               NameOID.COMMON_NAME, "web")]))
+           .add_extension(x509.SubjectAlternativeName(
+               [x509.UniformResourceIdentifier(evil)]),
+               critical=False)
+           .sign(key, hashes.SHA256()))
+    with _grpc_chan(agent) as ch:
+        sign = ch.unary_unary(
+            "/hashicorp.consul.connectca.ConnectCAService/Sign",
+            request_serializer=lambda d: encode(ge.CA_SIGN_REQ, d),
+            response_deserializer=lambda b: decode(ge.CA_SIGN_RESP, b))
+        with pytest.raises(grpc.RpcError) as ei:
+            sign({"csr": csr.public_bytes(
+                serialization.Encoding.PEM).decode()}, timeout=10)
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "does not match" in ei.value.details()
