@@ -101,25 +101,37 @@ def _txt_rdata(text: str) -> bytes:
 
 class DNSServer:
     def __init__(self, agent, bind: str = "127.0.0.1",
-                 port: int = 8600) -> None:
+                 port: int = 8600, bind_socket: bool = True) -> None:
+        """bind_socket=False gives a codec-only instance (the pbdns
+        gRPC path on agents without a DNS listener): handle() works,
+        no UDP port is bound, start() is a no-op."""
         self.agent = agent
         self.log = log.named("dns")
         self.domain = agent.config.dns_domain.rstrip(".").lower()
-        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._udp.bind((bind, port))
-        self.addr = "%s:%d" % self._udp.getsockname()
-        self.port = self._udp.getsockname()[1]
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="dns")
+        self._udp = None
+        self.addr = ""
+        self.port = 0
+        self._thread = None
+        if bind_socket:
+            self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._udp.bind((bind, port))
+            self.addr = "%s:%d" % self._udp.getsockname()
+            self.port = self._udp.getsockname()[1]
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True, name="dns")
         self._stopped = False
         self.rng = random.Random()
 
     def start(self) -> None:
+        if self._thread is None:
+            return
         self._thread.start()
         self.log.info("DNS server listening on %s", self.addr)
 
     def stop(self) -> None:
         self._stopped = True
+        if self._udp is None:
+            return
         try:
             self._udp.close()
         except OSError:

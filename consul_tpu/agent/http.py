@@ -98,8 +98,10 @@ class HTTPApi:
                 except HTTPError as e:
                     self._err(e.code, str(e))
                 except RPCError as e:
-                    code = 403 if "Permission denied" in str(e) else 500
-                    self._err(code, str(e))
+                    msg = str(e)
+                    code = 403 if "Permission denied" in msg else \
+                        400 if "bad request" in msg else 500
+                    self._err(code, msg)
                 except Exception as e:  # noqa: BLE001
                     api.log.warning("%s %s failed: %s", method, path, e)
                     self._err(500, f"internal error: {e}")

@@ -1282,7 +1282,13 @@ def register_endpoints(srv) -> None:
         if csr:
             from consul_tpu.connect.ca import csr_service
 
-            service, _ = csr_service(csr)
+            try:
+                service, _ = csr_service(csr)
+            except ValueError as e:
+                # "bad request" keyword → HTTP 400 / gRPC
+                # INVALID_ARGUMENT even after forwarding strips the type
+                raise RPCError(f"bad request: malformed CSR: {e}") \
+                    from e
         else:
             service = args.get("Service", "")
         require(authz(args).service_write(service),
@@ -1291,7 +1297,10 @@ def register_endpoints(srv) -> None:
             return srv._forward_to_leader("ConnectCA.Sign", args)
         root = srv.ca.initialize()
         if csr:
-            leaf = srv.ca.sign_csr(csr)
+            try:
+                leaf = srv.ca.sign_csr(csr)
+            except ValueError as e:
+                raise RPCError(f"bad request: {e}") from e
             if root.get("CrossSignedIntermediate"):
                 # same rotation bridge as the service path below
                 leaf["CertChainPEM"] = (
@@ -1771,8 +1780,9 @@ def register_endpoints(srv) -> None:
 
     def exported_services(args):
         require(authz(args).operator_read(), "operator read")
+        partition = args.get("Partition") or "default"
         entry = state.raw_get("config_entries",
-                              "exported-services/default") or {}
+                              f"exported-services/{partition}") or {}
         return {"Services": [
             {"Service": s.get("Name", ""),
              "Consumers": s.get("Consumers") or []}

@@ -44,6 +44,9 @@ from typing import Any, Iterator, Optional
 from consul_tpu.utils import log
 from consul_tpu.utils.pbwire import Field, decode, encode
 
+# guards lazy construction of the codec-only DNS instance (dns_query)
+_dns_codec_lock = threading.Lock()
+
 # ----------------------------------------------------------- message specs
 
 STATUS = {"code": Field(1, "int"), "message": Field(2, "string")}
@@ -202,6 +205,32 @@ CA_WATCH_ROOTS_RESP = {
 }
 CA_SIGN_REQ = {"csr": Field(1, "string")}
 CA_SIGN_RESP = {"cert_pem": Field(2, "string")}
+
+# hashicorp.consul.acl (proto-public/pbacl/acl.proto)
+ACL_LOGIN_REQ = {
+    "auth_method": Field(1, "string"),
+    "bearer_token": Field(2, "string"),
+    "meta": Field(3, "message", _MAP_SS, repeated=True),
+    "namespace": Field(4, "string"),
+    "partition": Field(5, "string"),
+    "datacenter": Field(6, "string"),
+}
+_LOGIN_TOKEN = {"accessor_id": Field(1, "string"),
+                "secret_id": Field(2, "string")}
+ACL_LOGIN_RESP = {"token": Field(1, "message", _LOGIN_TOKEN)}
+ACL_LOGOUT_REQ = {"token": Field(1, "string"),
+                  "datacenter": Field(2, "string")}
+ACL_LOGOUT_RESP: dict[str, Field] = {}
+
+# hashicorp.consul.configentry (grpc-external/services/configentry;
+# messages from pbconfigentry GetResolvedExportedServices)
+CFG_EXPORTED_REQ = {"Partition": Field(1, "string")}
+_CONSUMERS = {"Peers": Field(1, "string", repeated=True),
+              "Partitions": Field(2, "string", repeated=True)}
+_RESOLVED_EXPORT = {"Service": Field(1, "string"),
+                    "Consumers": Field(3, "message", _CONSUMERS)}
+CFG_EXPORTED_RESP = {"services": Field(1, "message", _RESOLVED_EXPORT,
+                                       repeated=True)}
 
 
 def _res_to_pb(r: dict[str, Any]) -> dict[str, Any]:
@@ -699,11 +728,13 @@ def make_grpc_server(agent, bind_addr: str, port: int):
 
         dns = agent.dns
         if dns is None:
-            # agent runs without a DNS listener: codec-only instance
-            # (never start()ed, so no socket is bound)
-            dns = agent._grpc_dns_codec = getattr(
-                agent, "_grpc_dns_codec", None) or DNSServer(
-                    agent, agent.config.bind_addr, 0)
+            # agent runs without a DNS listener: codec-only instance,
+            # built under a lock so two first queries can't race
+            with _dns_codec_lock:
+                dns = getattr(agent, "_grpc_dns_codec", None)
+                if dns is None:
+                    dns = agent._grpc_dns_codec = DNSServer(
+                        agent, bind_socket=False)
         # protocol 1=TCP, 2=UDP (dns.proto): TCP semantics lift the
         # 512-byte truncation — gRPC has no datagram size limit
         out = dns.handle(req.get("msg", b""),
@@ -753,22 +784,26 @@ def make_grpc_server(agent, bind_addr: str, port: int):
                 last = frame
                 yield frame
 
+    def _grpc_status(e: Exception):
+        """Exception → honest gRPC status. Forwarding wraps everything
+        in RPCError, so classification keys on the message markers the
+        endpoints set ("bad request", "Permission denied") — not on
+        exception type, which only survives in-process."""
+        msg = str(e)
+        if isinstance(e, ValueError) or "bad request" in msg:
+            return grpc.StatusCode.INVALID_ARGUMENT, msg
+        if "Permission denied" in msg or "login failed" in msg \
+                or "no binding rules" in msg:
+            return grpc.StatusCode.PERMISSION_DENIED, msg
+        return grpc.StatusCode.INTERNAL, msg
+
     def ca_sign(req: dict, context) -> bytes:
         """pbconnectca Sign: leaf over a caller-held CSR."""
         try:
             leaf = agent.rpc("ConnectCA.Sign", {"CSR": req.get("csr",
                                                                "")})
-        except ValueError as e:  # malformed CSR / identity mismatch
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except Exception as e:
-            # keep retry semantics honest for callers: credential
-            # problems are not malformed requests, and transient
-            # no-leader errors are not permanent ones
-            msg = str(e)
-            code = grpc.StatusCode.PERMISSION_DENIED \
-                if "Permission denied" in msg else \
-                grpc.StatusCode.INTERNAL
-            context.abort(code, msg)
+            context.abort(*_grpc_status(e))
         return encode(CA_SIGN_RESP,
                       {"cert_pem": leaf.get("CertPEM", "")})
 
@@ -784,11 +819,59 @@ def make_grpc_server(agent, bind_addr: str, port: int):
         "/hashicorp.consul.connectca.ConnectCAService/WatchRoots":
             (ca_watch_roots, CA_WATCH_ROOTS_REQ),
     }
+    def acl_login(req: dict, context) -> bytes:
+        """pbacl Login: bearer credential → scoped token."""
+        try:
+            tok = agent.rpc("ACL.Login", {"Auth": {
+                "AuthMethod": req.get("auth_method", ""),
+                "BearerToken": req.get("bearer_token", ""),
+                "Meta": {kv.get("key", ""): kv.get("value", "")
+                         for kv in req.get("meta") or []}}})
+        except Exception as e:
+            context.abort(*_grpc_status(e))
+        return encode(ACL_LOGIN_RESP, {"token": {
+            "accessor_id": tok.get("AccessorID", ""),
+            "secret_id": tok.get("SecretID", "")}})
+
+    def acl_logout(req: dict, context) -> bytes:
+        """pbacl Logout: the token self-destructs; it IS the auth."""
+        try:
+            agent.rpc("ACL.Logout",
+                      {"AuthToken": req.get("token", "")})
+        except Exception as e:
+            context.abort(*_grpc_status(e))
+        return encode(ACL_LOGOUT_RESP, {})
+
+    def cfg_resolved_exports(req: dict, context) -> bytes:
+        """configentry GetResolvedExportedServices: the exported-
+        services config entry flattened to (service, consumers)."""
+        res = agent.rpc("Internal.ExportedServices",
+                        {"AllowStale": True,
+                         "Partition": req.get("Partition", "")})
+        services = []
+        for s in res.get("Services") or []:
+            consumers = s.get("Consumers") or []
+            services.append({
+                "Service": s.get("Service", ""),
+                "Consumers": {
+                    "Peers": [c["Peer"] for c in consumers
+                              if c.get("Peer")],
+                    "Partitions": [c["Partition"] for c in consumers
+                                   if c.get("Partition")]}})
+        return encode(CFG_EXPORTED_RESP, {"services": services})
+
     unary_methods = {
         "/hashicorp.consul.dns.DNSService/Query":
             (dns_query, DNS_QUERY_REQ),
         "/hashicorp.consul.connectca.ConnectCAService/Sign":
             (ca_sign, CA_SIGN_REQ),
+        "/hashicorp.consul.acl.ACLService/Login":
+            (acl_login, ACL_LOGIN_REQ),
+        "/hashicorp.consul.acl.ACLService/Logout":
+            (acl_logout, ACL_LOGOUT_REQ),
+        ("/hashicorp.consul.configentry.ConfigEntryService"
+         "/GetResolvedExportedServices"):
+            (cfg_resolved_exports, CFG_EXPORTED_REQ),
     }
 
     class Handlers(grpc.GenericRpcHandler):

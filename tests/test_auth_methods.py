@@ -227,3 +227,61 @@ def test_role_binds_resolve_at_login(acl_agent):
             "AuthMethod": "role-m", "BindType": "service",
             "BindName": "x",
             "Selector": 'value.team == "research and development"'})
+
+
+def test_acl_grpc_login_logout(acl_agent):
+    """pbacl over the external gRPC port: Login mints the same scoped
+    token the HTTP path does; Logout destroys it; a no-match bearer
+    gets PERMISSION_DENIED."""
+    grpc = pytest.importorskip("grpc")
+
+    from consul_tpu.server import grpc_external as ge
+    from consul_tpu.utils.pbwire import decode, encode
+
+    root = ConsulClient(acl_agent.http.addr, token="root-secret")
+    key, pub = _es256_keypair()
+    root.put("/v1/acl/auth-method", body={
+        "Name": "grpc-idp", "Type": "jwt",
+        "Config": {
+            "JWTValidationPubKeys": [pub], "BoundIssuer": "idp",
+            "BoundAudiences": ["consul"],
+            "ClaimMappings": {"sub": "sub"}}})
+    root.put("/v1/acl/binding-rule", body={
+        "AuthMethod": "grpc-idp", "Selector": 'value.sub=="api-sa"',
+        "BindType": "service", "BindName": "api"})
+    bearer = _jwt(key, {"iss": "idp", "aud": "consul",
+                        "exp": time.time() + 300, "sub": "api-sa"})
+    with grpc.insecure_channel(
+            f"127.0.0.1:{acl_agent.grpc_port}") as ch:
+        login = ch.unary_unary(
+            "/hashicorp.consul.acl.ACLService/Login",
+            request_serializer=lambda d: encode(ge.ACL_LOGIN_REQ, d),
+            response_deserializer=lambda b: decode(
+                ge.ACL_LOGIN_RESP, b))
+        resp = login({"auth_method": "grpc-idp",
+                      "bearer_token": bearer}, timeout=10)
+        tok = resp["token"]
+        assert tok["accessor_id"] and tok["secret_id"]
+        # the minted token works over HTTP too
+        c = ConsulClient(acl_agent.http.addr, token=tok["secret_id"])
+        c.service_register({"Name": "api", "Port": 82})
+
+        logout = ch.unary_unary(
+            "/hashicorp.consul.acl.ACLService/Logout",
+            request_serializer=lambda d: encode(ge.ACL_LOGOUT_REQ, d),
+            response_deserializer=lambda b: decode(
+                ge.ACL_LOGOUT_RESP, b))
+        logout({"token": tok["secret_id"]}, timeout=10)
+        # destroyed: the secret no longer resolves
+        with pytest.raises(APIError):
+            ConsulClient(acl_agent.http.addr,
+                         token=tok["secret_id"]).get(
+                             "/v1/acl/token/self")
+        # a stranger bearer is refused with PERMISSION_DENIED
+        other = _jwt(key, {"iss": "idp", "aud": "consul",
+                           "exp": time.time() + 300,
+                           "sub": "stranger"})
+        with pytest.raises(grpc.RpcError) as ei:
+            login({"auth_method": "grpc-idp", "bearer_token": other},
+                  timeout=10)
+        assert ei.value.code() == grpc.StatusCode.PERMISSION_DENIED

@@ -543,3 +543,27 @@ def test_connectca_sign_rejects_smuggled_identity(agent, client):
                 serialization.Encoding.PEM).decode()}, timeout=10)
     assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
     assert "does not match" in ei.value.details()
+
+
+def test_configentry_resolved_exported_services(agent, client):
+    """configentry GetResolvedExportedServices: exported-services
+    config entry flattened into (service, peer-consumers)."""
+    from consul_tpu.server import grpc_external as ge
+
+    agent.rpc("ConfigEntry.Apply", {"Op": "upsert", "Entry": {
+        "Kind": "exported-services", "Name": "default",
+        "Services": [{"Name": "web",
+                      "Consumers": [{"Peer": "dc2-peer"}]}]}})
+    with _grpc_chan(agent) as ch:
+        stub = ch.unary_unary(
+            "/hashicorp.consul.configentry.ConfigEntryService"
+            "/GetResolvedExportedServices",
+            request_serializer=lambda d: encode(ge.CFG_EXPORTED_REQ,
+                                                d),
+            response_deserializer=lambda b: decode(
+                ge.CFG_EXPORTED_RESP, b))
+        resp = stub({}, timeout=10)
+    svcs = resp["services"]
+    assert any(s["Service"] == "web"
+               and "dc2-peer" in s["Consumers"]["Peers"]
+               for s in svcs)
