@@ -1001,12 +1001,36 @@ def register_endpoints(srv) -> None:
                     passing_only=bool(args.get("MustBePassing")))})
 
     def health_service_peer(args):
-        """Local side of `?peer=`: forward the query to the peer. Same
-        ACL bar as the local health path; blocking params pass through
-        so watches long-poll at the acceptor."""
+        """Local side of `?peer=`: serve the peerstream-replicated
+        copy from OUR store when the replication stream has delivered
+        it (the reference model — imported data lives in the local
+        catalog), falling back to an on-demand cross-peer RPC while
+        the stream is still warming up or on non-leader acceptors."""
         svc = args.get("ServiceName", "")
         require(authz(args).service_read(svc), f"service read on {svc!r}")
-        peer = _peer_by_name(args.get("Peer", ""))
+        peer_name = args.get("Peer", "")
+        def _imported_nodes():
+            rec = state.raw_get("imported_services",
+                                f"{peer_name}/{svc}")
+            if rec is None:
+                return None
+            nodes = rec.get("Nodes") or []
+            if args.get("MustBePassing"):
+                nodes = [n for n in nodes
+                         if all(c.get("Status") == "passing"
+                                for c in n.get("Checks") or [])]
+            tag = args.get("ServiceTag", "")
+            if tag:
+                nodes = [n for n in nodes
+                         if tag in ((n.get("Service") or {})
+                                    .get("Tags") or [])]
+            return nodes
+
+        if _imported_nodes() is not None:
+            return srv.blocking_query(
+                args, ("imported_services",),
+                lambda: {"Nodes": _imported_nodes() or []})
+        peer = _peer_by_name(peer_name)
         if peer is None:
             raise RPCError(f"unknown peer {args.get('Peer')!r}")
         addrs = peer.get("ServerAddresses") or []
@@ -1023,25 +1047,83 @@ def register_endpoints(srv) -> None:
             "MaxQueryTime": args.get("MaxQueryTime", 0) or 30.0},
             timeout=120.0)
 
-    def peer_stream_list_exported(args):
-        """What THIS cluster exports to the asking peer (secret-auth);
-        feeds the peer's /v1/imported-services view."""
-        secret = args.get("Secret", "")
-        peer = next((p for p in state.raw_list("peerings")
+    def _peer_by_secret(secret: str):
+        return next((p for p in state.raw_list("peerings")
                      if p.get("Secret") == secret), None)
-        if peer is None:
-            raise RPCError("Permission denied: unknown peering secret")
+
+    def _exported_to(peer) -> list[str]:
+        """Service names the exported-services entry grants this peer
+        (no explicit consumer list = exported to every peer)."""
+        partition = peer.get("Partition") or "default"
         exported = state.raw_get("config_entries",
-                                 "exported-services/default") or {}
+                                 f"exported-services/{partition}") or {}
         out = []
         for s in exported.get("Services") or []:
             consumers = s.get("Consumers") or []
-            # no explicit consumer list = exported to every peer
             if not consumers or any(
                     c.get("Peer") in ("", "*", peer.get("Name"))
                     for c in consumers):
                 out.append(s.get("Name", ""))
-        return {"Services": sorted(filter(None, out))}
+        return sorted(filter(None, out))
+
+    def peer_stream_list_exported(args):
+        """What THIS cluster exports to the asking peer (secret-auth);
+        feeds the peer's /v1/imported-services view."""
+        peer = _peer_by_secret(args.get("Secret", ""))
+        if peer is None:
+            raise RPCError("Permission denied: unknown peering secret")
+        return {"Services": _exported_to(peer)}
+
+    def peer_stream_exported(args, src, push, cancel) -> None:
+        """PeerStream replication stream (reference: pbpeerstream
+        StreamResources): snapshot of every service exported to the
+        authenticated peer, an end-of-snapshot marker, then
+        upsert/delete deltas as catalog health or the export list
+        changes. The DIALER's leader consumes this and raft-applies
+        the payloads into its own catalog (imported_services), making
+        ?peer= reads local — the reference's push model, not
+        per-query round trips."""
+        peer = _peer_by_secret(args.get("Secret", ""))
+        if peer is None:
+            raise RPCError("Permission denied: unknown peering secret")
+        secret = args.get("Secret", "")
+        tables = ("services", "checks", "nodes", "config_entries",
+                  "peerings")
+
+        def frame_all() -> dict[str, list]:
+            return {svc: state.check_service_nodes(svc)
+                    for svc in _exported_to(peer)}
+
+        idx = state.table_index(*tables)
+        last = frame_all()
+        for svc in sorted(last):
+            if not push({"Type": "upsert", "Service": svc,
+                         "Nodes": last[svc]}):
+                return
+        if not push({"Type": "end_of_snapshot"}):
+            return
+        while not cancel.is_set():
+            state.block_until(tables, idx, 1.0)
+            if cancel.is_set():
+                return
+            if _peer_by_secret(secret) is None:
+                # peering deleted mid-stream: access is revoked NOW,
+                # not when the TCP session happens to die
+                return
+            idx = state.table_index(*tables)
+            cur = frame_all()
+            for svc in sorted(set(last) - set(cur)):
+                if not push({"Type": "delete", "Service": svc}):
+                    return
+            for svc in sorted(cur):
+                if last.get(svc) != cur[svc]:
+                    if not push({"Type": "upsert", "Service": svc,
+                                 "Nodes": cur[svc]}):
+                        return
+            last = cur
+
+    srv.rpc.stream_handlers["PeerStream.StreamExported"] = \
+        peer_stream_exported
 
     def imported_services(args):
         """Services available here FROM peers (/v1/imported-services —

@@ -845,9 +845,12 @@ def make_grpc_server(agent, bind_addr: str, port: int):
     def cfg_resolved_exports(req: dict, context) -> bytes:
         """configentry GetResolvedExportedServices: the exported-
         services config entry flattened to (service, consumers)."""
-        res = agent.rpc("Internal.ExportedServices",
-                        {"AllowStale": True,
-                         "Partition": req.get("Partition", "")})
+        try:
+            res = agent.rpc("Internal.ExportedServices",
+                            {"AllowStale": True,
+                             "Partition": req.get("Partition", "")})
+        except Exception as e:
+            context.abort(*_grpc_status(e))
         services = []
         for s in res.get("Services") or []:
             consumers = s.get("Consumers") or []

@@ -58,6 +58,8 @@ class Server:
         # flips true once the cluster first reaches bootstrap_expect
         # voters; from then on new servers must pass stabilization
         self._bootstrapped = False
+        # peerstream replication threads, one per ACTIVE dialed peering
+        self._peer_repl: dict[str, threading.Thread] = {}
 
         # L1: replicated state
         self.fsm = FSM()
@@ -732,10 +734,85 @@ class Server:
                 self.raft.remove_peer(addr)
             except NotLeader:
                 return
+        self._ensure_peer_replicators()
         self._drain_reconcile()
         self._expire_sessions()
         self._replicate_from_primary()
         self._update_federation_state()
+
+    # --------------------------------------------------- peerstream (dialer)
+
+    def _ensure_peer_replicators(self) -> None:
+        """Leader-only: one replication stream per ACTIVE dialed
+        peering (reference: leader_peering.go runs a peerstream per
+        peer). Frames land in the replicated store via raft, so every
+        server answers ?peer= from local state."""
+        for p in self.state.raw_list("peerings"):
+            if not p.get("Dialer") or p.get("State") != "ACTIVE":
+                continue
+            name = p.get("Name", "")
+            t = self._peer_repl.get(name)
+            if t is not None and t.is_alive():
+                continue
+            t = threading.Thread(target=self._peer_repl_loop,
+                                 args=(name,), daemon=True,
+                                 name=f"peerstream-"
+                                      f"{self.config.node_name}-{name}")
+            self._peer_repl[name] = t
+            t.start()
+
+    def _peer_repl_loop(self, name: str) -> None:
+        backoff = 0.5
+        while not self._shutdown and self.is_leader():
+            p = self.state.raw_get("peerings", name)
+            if p is None or not p.get("Dialer") \
+                    or p.get("State") != "ACTIVE":
+                return
+            addrs = p.get("ServerAddresses") or []
+            if not addrs:
+                time.sleep(1.0)
+                continue
+            handle = None
+            secret = p.get("Secret", "")
+            try:
+                handle = self.pool.subscribe(
+                    addrs[0], "PeerStream.StreamExported",
+                    {"Secret": secret})
+                backoff = 0.5  # reconnected: flappy-period over
+                while not self._shutdown and self.is_leader():
+                    cur = self.state.raw_get("peerings", name)
+                    if cur is None or cur.get("Secret") != secret \
+                            or cur.get("State") != "ACTIVE":
+                        # peering deleted/re-keyed mid-stream: stop
+                        # before a late frame resurrects imported
+                        # records with no owning peering
+                        return
+                    fr = handle.next(timeout=1.0)
+                    if fr is None:
+                        continue
+                    kind = fr.get("Type")
+                    if kind == "upsert":
+                        self.raft.apply(encode_command(
+                            MessageType.PEERING, {
+                                "Op": "set_imported", "Peer": name,
+                                "Service": fr.get("Service", ""),
+                                "Nodes": fr.get("Nodes") or []}))
+                    elif kind == "delete":
+                        self.raft.apply(encode_command(
+                            MessageType.PEERING, {
+                                "Op": "delete_imported", "Peer": name,
+                                "Service": fr.get("Service", "")}))
+            except StopIteration:
+                pass  # acceptor ended cleanly; resubscribe
+            except Exception as e:  # noqa: BLE001
+                self.log.debug("peerstream %s: %s (retrying)", name, e)
+                if self._shutdown:
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+            finally:
+                if handle is not None:
+                    handle.close()
 
     def _flood_join(self) -> None:
         """Flood joiner (server_serf.go FloodJoins): every LAN server's

@@ -413,9 +413,28 @@ class FSM:
                 {"Peer": b.get("Peer", ""),
                  "RootPEMs": b.get("RootPEMs") or [],
                  "TrustDomain": b.get("TrustDomain", "")})
+        if op == "set_imported":
+            # peerstream replication delivery: the peer's exported
+            # service health, replicated into OUR catalog so ?peer=
+            # reads are local (reference: peerstream upserts land in
+            # the catalog tagged with PeerName)
+            return self.store.raw_upsert(
+                "imported_services",
+                f"{b.get('Peer', '')}/{b.get('Service', '')}",
+                {"Peer": b.get("Peer", ""),
+                 "Service": b.get("Service", ""),
+                 "Nodes": b.get("Nodes") or []})
+        if op == "delete_imported":
+            return self.store.raw_delete(
+                "imported_services",
+                f"{b.get('Peer', '')}/{b.get('Service', '')}")
         if op == "delete":
             self.store.raw_delete("peering_trust_bundles",
                                   p.get("Name"))
+            # imported data dies with its peering
+            for key in [k for k in self.store.tables["imported_services"]
+                        if str(k).startswith(f"{p.get('Name')}/")]:
+                self.store.raw_delete("imported_services", key)
         return self._raw_op("peerings", ("set",), op, p.get("Name"), p)
 
     def _apply_system_metadata(self, b: dict[str, Any], idx: int) -> Any:

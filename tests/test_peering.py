@@ -151,3 +151,61 @@ def test_trust_bundle_exchange_and_system_metadata():
     finally:
         a.shutdown()
         b.shutdown()
+
+
+def test_peerstream_replication_delivers_locally(clusters):
+    """The dialer's leader consumes the acceptor's PeerStream and
+    raft-applies imported services into ITS OWN store — ?peer= then
+    reads locally (reference push model), and health flips propagate
+    through the stream, not per-query round trips."""
+    ca, cb, a, b = clusters
+    # earlier tests deleted the peering: re-establish fresh
+    token = ca.put("/v1/peering/token",
+                   body={"PeerName": "beta"})["PeeringToken"]
+    cb.put("/v1/peering/establish",
+           body={"PeerName": "alpha", "PeeringToken": token})
+    # replication is driven by the dialer leader tick; wait for the
+    # imported copy of alpha's exported 'billing' to land in beta
+    wait_for(lambda: b.server.state.raw_get(
+        "imported_services", "alpha/billing") is not None,
+        timeout=15, what="peerstream replication of billing")
+    rec = b.server.state.raw_get("imported_services", "alpha/billing")
+    assert rec["Nodes"] and \
+        rec["Nodes"][0]["Service"]["Port"] == 7000
+    # the ?peer= query is now served from beta's local store
+    nodes = cb.get("/v1/health/service/billing", peer="alpha")
+    assert nodes and nodes[0]["Service"]["Port"] == 7000
+
+    # a health flip in alpha propagates through the stream into
+    # beta's imported copy
+    ca.check_fail("service:bill", note="down for maintenance")
+    wait_for(lambda: any(
+        c.get("Status") == "critical"
+        for n in (b.server.state.raw_get(
+            "imported_services", "alpha/billing") or {}).get("Nodes")
+        or [] for c in n.get("Checks") or []),
+        timeout=15, what="health flip replicated to beta")
+    # passing-only filter over the IMPORTED copy now excludes it
+    assert cb.get("/v1/health/service/billing", peer="alpha",
+                  passing="") == []
+    ca.check_pass("service:bill")
+    wait_for(lambda: all(
+        c.get("Status") == "passing"
+        for n in (b.server.state.raw_get(
+            "imported_services", "alpha/billing") or {}).get("Nodes")
+        or [] for c in n.get("Checks") or []),
+        timeout=15, what="recovery replicated to beta")
+
+    # un-exporting deletes the imported copy on the dialer
+    try:
+        ca.put("/v1/config", body={
+            "Kind": "exported-services", "Name": "default",
+            "Services": []})
+        wait_for(lambda: b.server.state.raw_get(
+            "imported_services", "alpha/billing") is None,
+            timeout=15, what="un-export delete replicated")
+    finally:
+        # restore even on failure — later tests share the fixture
+        ca.put("/v1/config", body={
+            "Kind": "exported-services", "Name": "default",
+            "Services": [{"Name": "billing"}]})
