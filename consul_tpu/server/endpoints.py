@@ -1110,7 +1110,10 @@ def register_endpoints(srv) -> None:
                 # peering deleted mid-stream: access is revoked NOW,
                 # not when the TCP session happens to die
                 return
-            idx = state.table_index(*tables)
+            nidx = state.table_index(*tables)
+            if nidx == idx:
+                continue  # timeout wake: nothing moved, skip the join
+            idx = nidx
             cur = frame_all()
             for svc in sorted(set(last) - set(cur)):
                 if not push({"Type": "delete", "Service": svc}):

@@ -763,6 +763,7 @@ class Server:
 
     def _peer_repl_loop(self, name: str) -> None:
         backoff = 0.5
+        addr_i = 0  # rotate through the peer's servers on failure
         while not self._shutdown and self.is_leader():
             p = self.state.raw_get("peerings", name)
             if p is None or not p.get("Dialer") \
@@ -774,10 +775,12 @@ class Server:
                 continue
             handle = None
             secret = p.get("Secret", "")
+            snapshot_seen: set[str] = set()
+            in_snapshot = True
             try:
                 handle = self.pool.subscribe(
-                    addrs[0], "PeerStream.StreamExported",
-                    {"Secret": secret})
+                    addrs[addr_i % len(addrs)],
+                    "PeerStream.StreamExported", {"Secret": secret})
                 backoff = 0.5  # reconnected: flappy-period over
                 while not self._shutdown and self.is_leader():
                     cur = self.state.raw_get("peerings", name)
@@ -792,6 +795,8 @@ class Server:
                         continue
                     kind = fr.get("Type")
                     if kind == "upsert":
+                        if in_snapshot:
+                            snapshot_seen.add(fr.get("Service", ""))
                         self.raft.apply(encode_command(
                             MessageType.PEERING, {
                                 "Op": "set_imported", "Peer": name,
@@ -802,12 +807,29 @@ class Server:
                             MessageType.PEERING, {
                                 "Op": "delete_imported", "Peer": name,
                                 "Service": fr.get("Service", "")}))
+                    elif kind == "end_of_snapshot" and in_snapshot:
+                        in_snapshot = False
+                        # reconcile: a delete delta that happened while
+                        # the stream was down never replays, so purge
+                        # imported records absent from the snapshot
+                        prefix = f"{name}/"
+                        for k in list(
+                                self.state.tables["imported_services"]):
+                            svc = str(k)[len(prefix):]
+                            if str(k).startswith(prefix) \
+                                    and svc not in snapshot_seen:
+                                self.raft.apply(encode_command(
+                                    MessageType.PEERING, {
+                                        "Op": "delete_imported",
+                                        "Peer": name,
+                                        "Service": svc}))
             except StopIteration:
                 pass  # acceptor ended cleanly; resubscribe
             except Exception as e:  # noqa: BLE001
                 self.log.debug("peerstream %s: %s (retrying)", name, e)
                 if self._shutdown:
                     return
+                addr_i += 1  # next attempt tries the peer's next server
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
             finally:

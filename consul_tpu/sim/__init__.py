@@ -56,13 +56,15 @@ from consul_tpu.sim.round import gossip_round, run_rounds, make_run_rounds
 from consul_tpu.sim.mesh import (make_sharded_run, make_mesh,
                                  make_multidc_run, make_segmented_run)
 from consul_tpu.sim.views import (ViewState, init_views, views_round,
-                                  run_views, view_metrics)
+                                  run_views, view_metrics,
+                                  make_views_mesh,
+                                  make_sharded_views_round)
 
 __all__ = [
     "SimParams", "SimState", "init_state", "gossip_round", "run_rounds",
     "make_run_rounds", "make_sharded_run", "make_mesh",
     "make_multidc_run", "make_segmented_run",
     "ViewState", "init_views", "views_round", "run_views",
-    "view_metrics",
+    "view_metrics", "make_views_mesh", "make_sharded_views_round",
     "ALIVE", "SUSPECT", "DEAD", "LEFT",
 ]

@@ -369,3 +369,252 @@ def partition_reach(n: int, split: int) -> jnp.ndarray:
     left = jnp.arange(n) < split
     same = left[:, None] == left[None, :]
     return same
+
+
+# --------------------------------------------------- sharded views tier
+
+def make_views_mesh(devices=None):
+    """1-D viewer mesh: the VIEWER axis of the dense [n, n] view state
+    is partitioned across devices; the subject axis stays whole."""
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), ("viewers",))
+
+
+def make_sharded_views_round(p: SimParams, mesh):
+    """Multi-device dense SWIM round via shard_map over the viewer axis.
+
+    Collective design (the scaling-book recipe — pick a mesh, shard,
+    let collectives carry the exchange):
+
+    * probe + suspicion-timer math: viewer-row-local, zero comms.
+    * gossip merge: each device computes a partial ``segment_max`` of
+      its OWN senders' transmissions addressed to ALL receivers, then a
+      ``lax.pmax`` all-reduce combines partials and each device keeps
+      its receiver rows. One [n, n] int32 all-reduce per gossip tick —
+      gossip IS all-to-all communication, so the collective is the
+      honest cost (upgrade path: grouped all_to_all with per-
+      destination partials halves the traffic by skipping the
+      broadcast-back).
+    * push/pull + reconnect: ``lax.all_gather`` of the merge keys (the
+      full-state sync genuinely needs remote rows; it runs every ~30
+      virtual seconds, not every tick).
+    * ground truth (up/self_inc, [n]) is replicated — it is 1/n-th the
+      size of a single view row shard.
+
+    Returns (round_fn, init_fn); round_fn(state, key) is jit-compiled
+    over the mesh, state lives sharded P("viewers", None).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = p.n
+    d = mesh.devices.size
+    assert n % d == 0, f"n={n} not divisible by {d} devices"
+    nl = n // d  # local viewer rows per device
+    eye_cols = jnp.arange(n)
+
+    row = NamedSharding(mesh, P("viewers"))
+    rep = NamedSharding(mesh, P())
+    state_sharding = ViewState(
+        up=rep, down_round=rep, self_inc=rep,
+        status=row, inc=row, susp_start=row, susp_deadline=row,
+        susp_conf=row, budget=row, reach=row, round=rep)
+
+    def local_round(st: ViewState, key: jax.Array) -> ViewState:
+        """Per-device body. Local blocks are [nl, n]; global vectors
+        [n] are replicated."""
+        shard = jax.lax.axis_index("viewers")
+        gidx = shard * nl + jnp.arange(nl)  # global viewer ids
+        local_eye = gidx[:, None] == eye_cols[None, :]
+        # crash injection uses the UN-folded key: up/down_round are
+        # replicated, so every shard must draw the identical crashes
+        k_crash, key = jax.random.split(key)
+        k_pick, k_ack, k_gossip, k_pp = jax.random.split(
+            jax.random.fold_in(key, shard), 4)
+
+        if p.fail_per_round > 0.0:
+            crash = st.up & (jax.random.uniform(k_crash, (n,))
+                             < p.fail_per_round)
+            st = st._replace(
+                up=st.up & ~crash,
+                down_round=jnp.where(crash, st.round, st.down_round))
+
+        up_l = st.up[gidx]  # this shard's viewers' own liveness
+
+        def merge(st, inc_key, confirm_src):
+            own_key = _key(st.status, st.inc)
+            new_key = jnp.maximum(own_key, inc_key)
+            changed = new_key > own_key
+            status, inc = _unkey(new_key)
+            min_r, max_r = _timeout_rounds(p)
+            kk = p.confirmation_k
+            became = changed & (status == SUSPECT)
+            confirmed = (~changed) & confirm_src & \
+                (inc_key == own_key) & (st.status == SUSPECT)
+            conf = jnp.where(
+                became, 0,
+                jnp.minimum(st.susp_conf + confirmed.astype(jnp.int8),
+                            jnp.int8(kk)))
+            start = jnp.where(became, st.round, st.susp_start)
+            frac = jnp.log1p(conf.astype(jnp.float32)) \
+                / jnp.log1p(float(kk))
+            shrunk = (start + max_r
+                      - (frac * (max_r - min_r)).astype(jnp.int32))
+            deadline = jnp.where(
+                status == SUSPECT,
+                jnp.where(became | confirmed,
+                          jnp.maximum(shrunk, start + min_r),
+                          st.susp_deadline),
+                _NO_DEADLINE)
+            if not p.lifeguard:
+                deadline = jnp.where(
+                    status == SUSPECT,
+                    jnp.where(became, st.round + min_r,
+                              st.susp_deadline),
+                    _NO_DEADLINE)
+            budget = jnp.where(changed, jnp.int8(p.retransmit_limit),
+                               st.budget)
+            return st._replace(status=status, inc=inc, susp_conf=conf,
+                               susp_start=start, susp_deadline=deadline,
+                               budget=budget)
+
+        # -- probe (viewer-local) ---------------------------------------
+        view_alive = (st.status == ALIVE) & ~local_eye
+        has_target = view_alive.any(axis=1)
+        target = _pick(k_pick, view_alive)
+        t_up = st.up[target]
+        t_reach = jnp.take_along_axis(st.reach, target[:, None],
+                                      axis=1)[:, 0]
+        p_relay_all = (1.0 - p.p_relay) ** p.indirect_checks
+        p_noack = (1.0 - p.p_direct) * p_relay_all * (1.0 - p.p_tcp)
+        acked = t_up & t_reach & \
+            (jax.random.uniform(k_ack, (nl,)) > p_noack)
+        suspect_it = up_l & has_target & ~acked
+        t_inc = jnp.take_along_axis(st.inc, target[:, None],
+                                    axis=1)[:, 0]
+        sus_key = jnp.full((nl, n), -1, jnp.int32)
+        sus_key = sus_key.at[jnp.arange(nl), target].set(
+            jnp.where(suspect_it, t_inc * 4 + 1, -1))
+        st = merge(st, sus_key, jnp.zeros((nl, n), bool))
+
+        # -- gossip: partial segment_max + pmax all-reduce --------------
+        ticks = int(p.gossip_ticks_per_round)
+
+        def gossip_slot(slot_key, st):
+            kk_pick, kk_loss = jax.random.split(slot_key)
+            gmask = (st.status != DEAD) & ~local_eye
+            recv = _pick(kk_pick, gmask)  # GLOBAL receiver ids
+            sendable = up_l & gmask.any(axis=1)
+            delivered = sendable & st.up[recv] & \
+                st.reach[jnp.arange(nl), recv] & \
+                (jax.random.uniform(kk_loss, (nl,)) > p.loss)
+            hot = st.budget > 0
+            sent_key = jnp.where(hot & delivered[:, None],
+                                 _key(st.status, st.inc), -1)
+            partial = jax.ops.segment_max(sent_key, recv,
+                                          num_segments=n)
+            partial = jnp.where(partial < -1, -1, partial)
+            # the all-reduce IS the packet exchange: senders on every
+            # device may address receivers on any device
+            global_max = jax.lax.pmax(partial, "viewers")
+            inc_key = jax.lax.dynamic_slice_in_dim(
+                global_max, shard * nl, nl, axis=0)
+            new_budget = jnp.where(hot & sendable[:, None],
+                                   st.budget - 1, st.budget)
+            st = st._replace(budget=new_budget)
+            return merge(st, inc_key, inc_key >= 0)
+
+        for sk in jax.random.split(k_gossip, ticks):
+            st = gossip_slot(sk, st)
+
+        # -- push/pull + reconnect (all_gather full-state sync) ---------
+        pp_every = max(1, int(30.0 / p.probe_interval))
+
+        def push_pull(st):
+            k_alive, k_dead = jax.random.split(k_pp)
+
+            def sync(st, partner, ok):
+                # keys recomputed per sync so the reconnect exchange
+                # forwards beliefs just merged by the alive-partner
+                # sync (matches the single-device tier's ordering)
+                full_key_l = _key(st.status, st.inc)
+                full_key = jax.lax.all_gather(
+                    full_key_l, "viewers", tiled=True)  # [n, n]
+                pulled = jnp.where(ok[:, None], full_key[partner], -1)
+                partial = jax.ops.segment_max(
+                    jnp.where(ok[:, None], full_key_l, -1), partner,
+                    num_segments=n)
+                partial = jnp.where(partial < -1, -1, partial)
+                pushed_g = jax.lax.pmax(partial, "viewers")
+                pushed = jax.lax.dynamic_slice_in_dim(
+                    pushed_g, shard * nl, nl, axis=0)
+                return merge(st, jnp.maximum(pulled, pushed),
+                             jnp.zeros((nl, n), bool))
+
+            partner = _pick(k_alive, (st.status != DEAD) & ~local_eye)
+            ok = up_l & st.up[partner] & \
+                st.reach[jnp.arange(nl), partner]
+            st = sync(st, partner, ok)
+            dead_view = (st.status == DEAD) & ~local_eye
+            partner2 = _pick(k_dead, dead_view)
+            ok2 = up_l & dead_view.any(axis=1) & st.up[partner2] & \
+                st.reach[jnp.arange(nl), partner2]
+            return sync(st, partner2, ok2)
+
+        st = jax.lax.cond((st.round % pp_every) == (pp_every - 1),
+                          push_pull, lambda s: s, st)
+
+        # -- suspicion expiry -------------------------------------------
+        expired = (st.status == SUSPECT) & \
+            (st.round >= st.susp_deadline) & up_l[:, None]
+        st = st._replace(
+            status=jnp.where(expired, jnp.int8(DEAD), st.status),
+            budget=jnp.where(expired, jnp.int8(p.retransmit_limit),
+                             st.budget),
+            susp_deadline=jnp.where(expired, _NO_DEADLINE,
+                                    st.susp_deadline))
+
+        # -- refutation (own diagonal entry lives on this shard) --------
+        lidx = jnp.arange(nl)
+        self_view = st.status[lidx, gidx]
+        self_known_inc = st.inc[lidx, gidx]
+        refute = up_l & (self_view != ALIVE)
+        new_inc_l = jnp.where(refute, self_known_inc + 1,
+                              st.self_inc[gidx])
+        status = st.status.at[lidx, gidx].set(
+            jnp.where(up_l, jnp.int8(ALIVE), self_view))
+        inc = st.inc.at[lidx, gidx].set(
+            jnp.where(up_l, new_inc_l, self_known_inc))
+        budget = st.budget.at[lidx, gidx].set(
+            jnp.where(refute, jnp.int8(p.retransmit_limit),
+                      st.budget[lidx, gidx]))
+        # replicated self_inc: every shard contributes its viewers'
+        # updates; psum of deltas keeps replicas identical
+        delta = jnp.zeros((n,), jnp.int32).at[gidx].set(
+            new_inc_l - st.self_inc[gidx])
+        self_inc = st.self_inc + jax.lax.psum(delta, "viewers")
+        return st._replace(status=status, inc=inc, budget=budget,
+                           self_inc=self_inc, round=st.round + 1)
+
+    spec_state = ViewState(
+        up=P(), down_round=P(), self_inc=P(),
+        status=P("viewers"), inc=P("viewers"),
+        susp_start=P("viewers"), susp_deadline=P("viewers"),
+        susp_conf=P("viewers"), budget=P("viewers"),
+        reach=P("viewers"), round=P())
+
+    smapped = shard_map(
+        local_round, mesh=mesh,
+        in_specs=(spec_state, P()),
+        out_specs=spec_state, check_rep=False)
+    round_fn = jax.jit(smapped)
+
+    def init_fn() -> ViewState:
+        st = init_views(n)
+        return jax.device_put(st, state_sharding)
+
+    return round_fn, init_fn
