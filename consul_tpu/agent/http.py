@@ -871,7 +871,10 @@ class HTTPApi:
             name = q.get("name")
             evs = [e for e in a._recent_events
                    if not name or e["Name"] == name]
-            return evs, len(evs)
+            # a REAL monotonic index (total events ever fired): the
+            # ring buffer caps at 256, so len() would pin once full
+            # and watches would miss everything after
+            return evs, getattr(a, "_event_seq", 0)
 
         if path == "/v1/internal/query" and method in ("PUT", "POST"):
             # fire a gossip query and collect responses (serf query;

@@ -1216,13 +1216,23 @@ def cmd_watch(args) -> int:
         "nodes": ("/v1/catalog/nodes", {}),
         "service": (f"/v1/health/service/{args.service}", {}),
         "checks": (f"/v1/health/state/any", {}),
+        # the api/watch/funcs.go long tail
+        "event": (f"/v1/event/list", {"name": args.name}
+                  if args.name else {}),
+        "connect_roots": ("/v1/connect/ca/roots", {}),
+        "connect_leaf":
+            (f"/v1/agent/connect/ca/leaf/{args.service}", {}),
+        "agent_service": (f"/v1/agent/service/{args.service}", {}),
     }
     if args.type not in paths:
         print(f"unknown watch type {args.type}", file=sys.stderr)
         return 1
     path, params = paths[args.type]
     index = 0
+    last_out = None
+    first = True
     while True:
+        t0 = time.monotonic()
         try:
             result, index2 = c.get_with_index(path, index=index,
                                               wait="30s", **params)
@@ -1233,9 +1243,15 @@ def cmd_watch(args) -> int:
                 time.sleep(1)
             else:
                 raise
-        if index2 != index or index == 0:
+        out = json.dumps(result, indent=2)
+        # two change detectors: the blocking index when the endpoint
+        # serves one, else content comparison (connect_leaf /
+        # agent_service return no X-Consul-Index)
+        changed = (index2 != index) if index2 else (out != last_out)
+        if changed or first:
+            first = False
             index = index2
-            out = json.dumps(result, indent=2)
+            last_out = out
             if args.exec_cmd:
                 subprocess.run(args.exec_cmd, input=out.encode(),
                                shell=True)
@@ -1243,6 +1259,10 @@ def cmd_watch(args) -> int:
                 print(out, flush=True)
         if args.once:
             return 0
+        if time.monotonic() - t0 < 0.5:
+            # the endpoint answered without parking (no blocking
+            # support): pace the poll instead of hot-looping
+            time.sleep(1.0)
 
 
 def cmd_intention(args) -> int:
@@ -1911,6 +1931,7 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("-key", default="")
     w.add_argument("-prefix", default="")
     w.add_argument("-service", default="")
+    w.add_argument("-name", default="", help="event name filter")
     w.add_argument("-once", action="store_true")
     w.add_argument("exec_cmd", nargs="?", default=None)
     w.set_defaults(fn=cmd_watch)

@@ -812,17 +812,17 @@ class Server:
                         # reconcile: a delete delta that happened while
                         # the stream was down never replays, so purge
                         # imported records absent from the snapshot
-                        prefix = f"{name}/"
-                        for k in list(
-                                self.state.tables["imported_services"]):
-                            svc = str(k)[len(prefix):]
-                            if str(k).startswith(prefix) \
-                                    and svc not in snapshot_seen:
+                        for rec in self.state.raw_list(
+                                "imported_services"):
+                            if rec.get("Peer") == name and \
+                                    rec.get("Service") \
+                                    not in snapshot_seen:
                                 self.raft.apply(encode_command(
                                     MessageType.PEERING, {
                                         "Op": "delete_imported",
                                         "Peer": name,
-                                        "Service": svc}))
+                                        "Service": rec.get("Service",
+                                                           "")}))
             except StopIteration:
                 pass  # acceptor ended cleanly; resubscribe
             except Exception as e:  # noqa: BLE001

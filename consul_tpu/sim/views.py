@@ -446,41 +446,10 @@ def make_sharded_views_round(p: SimParams, mesh):
         up_l = st.up[gidx]  # this shard's viewers' own liveness
 
         def merge(st, inc_key, confirm_src):
-            own_key = _key(st.status, st.inc)
-            new_key = jnp.maximum(own_key, inc_key)
-            changed = new_key > own_key
-            status, inc = _unkey(new_key)
-            min_r, max_r = _timeout_rounds(p)
-            kk = p.confirmation_k
-            became = changed & (status == SUSPECT)
-            confirmed = (~changed) & confirm_src & \
-                (inc_key == own_key) & (st.status == SUSPECT)
-            conf = jnp.where(
-                became, 0,
-                jnp.minimum(st.susp_conf + confirmed.astype(jnp.int8),
-                            jnp.int8(kk)))
-            start = jnp.where(became, st.round, st.susp_start)
-            frac = jnp.log1p(conf.astype(jnp.float32)) \
-                / jnp.log1p(float(kk))
-            shrunk = (start + max_r
-                      - (frac * (max_r - min_r)).astype(jnp.int32))
-            deadline = jnp.where(
-                status == SUSPECT,
-                jnp.where(became | confirmed,
-                          jnp.maximum(shrunk, start + min_r),
-                          st.susp_deadline),
-                _NO_DEADLINE)
-            if not p.lifeguard:
-                deadline = jnp.where(
-                    status == SUSPECT,
-                    jnp.where(became, st.round + min_r,
-                              st.susp_deadline),
-                    _NO_DEADLINE)
-            budget = jnp.where(changed, jnp.int8(p.retransmit_limit),
-                               st.budget)
-            return st._replace(status=status, inc=inc, susp_conf=conf,
-                               susp_start=start, susp_deadline=deadline,
-                               budget=budget)
+            # _merge is shape-agnostic (elementwise + the replicated
+            # round scalar), so the [nl, n] local blocks reuse the
+            # single-device implementation verbatim — one copy to fix
+            return _merge(st, inc_key, confirm_src, p)
 
         # -- probe (viewer-local) ---------------------------------------
         view_alive = (st.status == ALIVE) & ~local_eye

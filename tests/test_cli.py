@@ -347,3 +347,25 @@ def test_resource_grpc_crud(agent, tmp_path):
     rc, out = run(agent, "resource", "list-grpc", "-type",
                   "demo.v1.Artist", "-grpc-addr", addr)
     assert rc == 0 and "grpc-one" not in out
+
+
+def test_watch_long_tail_types(agent, tmp_path):
+    """api/watch/funcs.go long tail: event, connect_roots,
+    connect_leaf, agent_service watch types resolve and print."""
+    rc, out = run(agent, "watch", "-type", "connect_roots", "-once")
+    assert rc == 0 and "Roots" in out
+    f = tmp_path / "wsvc.json"
+    f.write_text(json.dumps({"name": "watched-svc", "port": 9}))
+    rc, _ = run(agent, "services", "register", str(f))
+    assert rc == 0
+    rc, out = run(agent, "watch", "-type", "agent_service",
+                  "-service", "watched-svc", "-once")
+    assert rc == 0 and "watched-svc" in out
+    rc, out = run(agent, "watch", "-type", "connect_leaf",
+                  "-service", "watched-svc", "-once")
+    assert rc == 0 and "CertPEM" in out
+    rc, out = run(agent, "event", "-name", "deploy-done")
+    assert rc == 0
+    rc, out = run(agent, "watch", "-type", "event",
+                  "-name", "deploy-done", "-once")
+    assert rc == 0 and "deploy-done" in out

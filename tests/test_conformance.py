@@ -270,3 +270,52 @@ def test_fp_rate_1pct_criterion_n100_lifeguard_on_and_off():
         f"host: Lifeguard made FP worse ({h_on:.5f} > {h_off:.5f})"
     assert s_on <= s_off + 0.005, \
         f"sim: Lifeguard made FP worse ({s_on:.5f} > {s_off:.5f})"
+
+
+# ---------------------------------------------------- views-tier triangle
+
+def views_detection_time(n=20, seed=0):
+    """Crash one node; virtual seconds until EVERY live viewer's own
+    view (the per-viewer tier, structurally closest to the host
+    engine) declares it DEAD."""
+    from consul_tpu.sim.views import init_views, views_round
+
+    p = SimParams.from_gossip_config(CFG, n=n)
+    st = init_views(n)
+    st = st._replace(up=st.up.at[n - 1].set(False))
+    key = jax.random.key(seed)
+    for r in range(400):
+        key, k = jax.random.split(key)
+        st = views_round(st, k, p)
+        col = st.status[: n - 1, n - 1]
+        if bool((col == MemberStatus.DEAD.value).all()):
+            return (r + 1) * p.probe_interval
+    raise AssertionError("views tier never detected the crash")
+
+
+def test_views_tier_closes_the_conformance_triangle():
+    """host engine ↔ mean-field is pinned above; this closes the third
+    edge: the per-viewer tensor tier detects a crash in the same
+    ballpark as the event-driven host engine under the same
+    GossipConfig, and agrees exactly on the no-loss invariant."""
+    host = host_detection_time(n=20, seed=1)
+    views = views_detection_time(n=20, seed=1)
+    assert views <= host * 3.0 and views >= host / 3.0, \
+        f"views {views:.2f}s vs host {host:.2f}s out of ballpark"
+
+    # no-loss invariant: like the host engine, the views tier never
+    # suspects (let alone kills) anyone in a quiet cluster
+    from consul_tpu.sim.views import init_views, run_views, view_metrics
+
+    p = SimParams.from_gossip_config(CFG, n=24)
+    st = run_views(init_views(24), jax.random.key(3), p, 80)
+    m = view_metrics(st)
+    assert m["fp_rate"] == 0.0 and m["suspect_pairs"] == 0
+
+    # under heavy loss both per-viewer worlds show ACTIVE suspicion
+    # with refutation keeping live nodes alive
+    p_loss = SimParams.from_gossip_config(CFG, n=24, loss=0.45)
+    st = run_views(init_views(24), jax.random.key(4), p_loss, 150)
+    m = view_metrics(st)
+    assert m["max_incarnation"] > 0  # the refutation race ran
+    assert m["up"] == 24
