@@ -871,10 +871,12 @@ class HTTPApi:
             name = q.get("name")
             evs = [e for e in a._recent_events
                    if not name or e["Name"] == name]
-            # a REAL monotonic index (total events ever fired): the
-            # ring buffer caps at 256, so len() would pin once full
-            # and watches would miss everything after
-            return evs, getattr(a, "_event_seq", 0)
+            # index = max Lamport time of the FILTERED result: it is
+            # monotonic (unlike len(), which pins at the 256-entry
+            # ring cap) and a name-filtered watch stays quiet when
+            # unrelated events fire (agent_endpoint.go event index)
+            return evs, max((e.get("LTime", 0) for e in evs),
+                            default=0)
 
         if path == "/v1/internal/query" and method in ("PUT", "POST"):
             # fire a gossip query and collect responses (serf query;

@@ -1259,9 +1259,11 @@ def cmd_watch(args) -> int:
                 print(out, flush=True)
         if args.once:
             return 0
-        if time.monotonic() - t0 < 0.5:
+        if not changed and time.monotonic() - t0 < 0.5:
             # the endpoint answered without parking (no blocking
-            # support): pace the poll instead of hot-looping
+            # support) and nothing changed: pace the poll instead of
+            # hot-looping. A fast CHANGED answer re-polls immediately
+            # so blocking endpoints keep per-change latency.
             time.sleep(1.0)
 
 

@@ -11,9 +11,10 @@ against the google.protobuf runtime). The delta-xDS PROTOCOL envelope
 ack/nack, removals) is wire-true protobuf, and so are the resource
 PAYLOADS: EDS (ClusterLoadAssignment) here, CDS/LDS via
 server/xds_proto.py (Cluster with STATIC/EDS + upstream TLS,
-Listener with tcp_proxy/RBAC chains + downstream mTLS + SNI
-matches — the shapes connect/envoy.py emits). A config outside that
-coverage falls back to canonical xDS JSON, visibly.
+Listener with tcp_proxy/RBAC chains + downstream mTLS + SNI matches,
+and L7 http_connection_manager chains with inline route configs —
+the shapes connect/envoy.py emits). A config outside that coverage
+falls back to canonical xDS JSON, visibly.
 
 Served methods:
   /envoy.service.discovery.v3.AggregatedDiscoveryService/DeltaAggregatedResources

@@ -7,12 +7,14 @@ into real envoy.config proto bytes — what an actual Envoy requires
 (the reference's 28k-LoC agent/xds translator emits proto natively).
 
 Coverage = exactly the shapes `connect/envoy.py` produces: STATIC/EDS
-clusters with upstream TLS (+SNI), listeners of tcp_proxy + network
-RBAC filter chains with downstream mTLS and optional SNI matches.
-A shape outside that envelope raises UnloweredShape and the caller
-falls back to the JSON payload (visible, not silent: the resource
-carries the JSON @type marker, and tests pin the real configs to the
-proto path).
+clusters with upstream TLS (+SNI); listeners of tcp_proxy + network
+RBAC filter chains with downstream mTLS and optional SNI matches; and
+L7 chains — HttpConnectionManager with an inline RouteConfiguration
+(service-router path/header/query matches, splitter weighted
+clusters, rewrites, timeouts, retry policies). A shape outside that
+envelope raises UnloweredShape and the caller falls back to the JSON
+payload (visible, not silent: the resource carries the JSON @type
+marker, and tests pin the real configs to the proto path).
 
 Field numbers are from the envoy/config + envoy/extensions protos
 (cluster.proto, listener.proto, tls.proto, tcp_proxy.proto,
@@ -120,8 +122,208 @@ _NETWORK_RBAC = {"rules": Field(1, "message", _RBAC_RULES),
 NETWORK_RBAC_TYPE = ("type.googleapis.com/envoy.extensions.filters."
                      "network.rbac.v3.RBAC")
 
+# ------------------------------------------------- HTTP / route configs
+# config.route.v3 (route.proto, route_components.proto) + the HTTP
+# connection manager — what the L7 discovery chain (service-router /
+# splitter) lowers to. Field numbers cited per proto.
+
+#: google.protobuf.UInt32Value
+_UINT32 = {"value": Field(1, "int")}
+#: type.matcher.v3.RegexMatcher (regex.proto): google_re2=1, regex=2
+_REGEX = {"google_re2": Field(1, "message", {}, presence=True),
+          "regex": Field(2, "string")}
+#: StringMatcher grows safe_regex=5 for header/query matches
+_STRING_MATCHER_RE = {**_STRING_MATCHER,
+                      "safe_regex": Field(5, "message", _REGEX)}
+#: route_components.proto HeaderMatcher: name=1, invert_match=8,
+#: present_match=7, string_match=13
+_HEADER_MATCHER = {
+    "name": Field(1, "string"),
+    "present_match": Field(7, "bool"),
+    "invert_match": Field(8, "bool"),
+    "string_match": Field(13, "message", _STRING_MATCHER_RE),
+}
+#: QueryParameterMatcher: name=1, string_match=5, present_match=6
+_QUERY_MATCHER = {
+    "name": Field(1, "string"),
+    "string_match": Field(5, "message", _STRING_MATCHER_RE),
+    "present_match": Field(6, "bool"),
+}
+#: RouteMatch: prefix=1, path=2, safe_regex=10, headers=6,
+#: query_parameters=7
+_ROUTE_MATCH = {
+    "prefix": Field(1, "string"),
+    "path": Field(2, "string"),
+    "safe_regex": Field(10, "message", _REGEX),
+    "headers": Field(6, "message", _HEADER_MATCHER, repeated=True),
+    "query_parameters": Field(7, "message", _QUERY_MATCHER,
+                              repeated=True),
+}
+#: WeightedCluster.ClusterWeight: name=1, weight=2
+_CLUSTER_WEIGHT = {"name": Field(1, "string"),
+                   "weight": Field(2, "message", _UINT32)}
+_WEIGHTED = {"clusters": Field(1, "message", _CLUSTER_WEIGHT,
+                               repeated=True)}
+#: RetryPolicy: retry_on=1, num_retries=2, retriable_status_codes=7
+_RETRY_POLICY = {"retry_on": Field(1, "string"),
+                 "num_retries": Field(2, "message", _UINT32),
+                 "retriable_status_codes": Field(7, "int",
+                                                 repeated=True)}
+#: RouteAction: cluster=1, weighted_clusters=3, prefix_rewrite=5,
+#: timeout=8, retry_policy=9
+_ROUTE_ACTION = {
+    "cluster": Field(1, "string"),
+    "weighted_clusters": Field(3, "message", _WEIGHTED),
+    "prefix_rewrite": Field(5, "string"),
+    "timeout": Field(8, "message", _DURATION),
+    "retry_policy": Field(9, "message", _RETRY_POLICY),
+}
+#: Route: match=1, route=2
+_ROUTE = {"match": Field(1, "message", _ROUTE_MATCH),
+          "route": Field(2, "message", _ROUTE_ACTION)}
+#: VirtualHost: name=1, domains=2, routes=3
+_VIRTUAL_HOST = {"name": Field(1, "string"),
+                 "domains": Field(2, "string", repeated=True),
+                 "routes": Field(3, "message", _ROUTE, repeated=True)}
+#: RouteConfiguration (route.proto): name=1, virtual_hosts=2
+_ROUTE_CONFIG = {"name": Field(1, "string"),
+                 "virtual_hosts": Field(2, "message", _VIRTUAL_HOST,
+                                        repeated=True)}
+#: HttpConnectionManager: codec_type=1, stat_prefix=2, route_config=4,
+#: http_filters=5
+_HCM = {
+    "codec_type": Field(1, "enum"),  # AUTO = 0
+    "stat_prefix": Field(2, "string"),
+    "route_config": Field(4, "message", _ROUTE_CONFIG),
+    # HttpFilter shares (name=1, typed_config=4) with the network
+    # Filter schema below - one spec serves both
+    "http_filters": None,  # filled after _FILTER is defined
+}
+HCM_TYPE = ("type.googleapis.com/envoy.extensions.filters.network."
+            "http_connection_manager.v3.HttpConnectionManager")
+HTTP_ROUTER_TYPE = ("type.googleapis.com/envoy.extensions.filters."
+                    "http.router.v3.Router")
+
+
+def _safe_regex(d: dict[str, Any]) -> dict[str, Any]:
+    """One place builds the RegexMatcher (google_re2 presence arm)."""
+    return {"google_re2": {}, "regex": d.get("regex", "")}
+
+
+def _string_match(d: dict[str, Any]) -> dict[str, Any]:
+    out = {k: v for k, v in d.items() if k in _STRING_MATCHER}
+    if d.get("safe_regex"):
+        out["safe_regex"] = _safe_regex(d["safe_regex"])
+    unknown = set(d) - set(out)
+    if unknown - {"safe_regex"}:
+        raise UnloweredShape(f"string matcher {d!r}")
+    return out
+
+
+def _lower_route_match(m: dict[str, Any]) -> dict[str, Any]:
+    unknown = set(m) - {"prefix", "path", "safe_regex", "headers",
+                        "query_parameters"}
+    if unknown:
+        # stripping a constraint would make Envoy route traffic the
+        # chain said must NOT match — fall back to JSON instead
+        raise UnloweredShape(f"route match fields {unknown!r}")
+    out: dict[str, Any] = {}
+    for k in ("prefix", "path"):
+        if m.get(k) is not None:
+            out[k] = m[k]
+    if m.get("safe_regex"):
+        out["safe_regex"] = _safe_regex(m["safe_regex"])
+    if not (set(out) & {"prefix", "path", "safe_regex"}):
+        # RouteMatch.path_specifier is REQUIRED — an empty match would
+        # be NACKed by Envoy, not visibly fall back
+        raise UnloweredShape(f"route match without path specifier {m!r}")
+    headers = []
+    for h in m.get("headers") or []:
+        if set(h) - {"name", "present_match", "string_match",
+                     "invert_match"}:
+            raise UnloweredShape(f"header matcher {h!r}")
+        hm: dict[str, Any] = {"name": h.get("name", "")}
+        if h.get("present_match"):
+            hm["present_match"] = True
+        if h.get("string_match"):
+            hm["string_match"] = _string_match(h["string_match"])
+        if h.get("invert_match"):
+            hm["invert_match"] = True
+        headers.append(hm)
+    if headers:
+        out["headers"] = headers
+    qps = []
+    for q in m.get("query_parameters") or []:
+        if set(q) - {"name", "present_match", "string_match"}:
+            raise UnloweredShape(f"query matcher {q!r}")
+        qm: dict[str, Any] = {"name": q.get("name", "")}
+        if q.get("present_match"):
+            qm["present_match"] = True
+        if q.get("string_match"):
+            qm["string_match"] = _string_match(q["string_match"])
+        qps.append(qm)
+    if qps:
+        out["query_parameters"] = qps
+    return out
+
+
+def _lower_route_action(a: dict[str, Any]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    if a.get("cluster"):
+        out["cluster"] = a["cluster"]
+    elif a.get("weighted_clusters"):
+        out["weighted_clusters"] = {"clusters": [
+            {"name": c.get("name", ""),
+             "weight": {"value": int(c.get("weight", 0))}}
+            for c in a["weighted_clusters"].get("clusters") or []]}
+    else:
+        raise UnloweredShape(f"route action {a!r}")
+    if a.get("prefix_rewrite"):
+        out["prefix_rewrite"] = a["prefix_rewrite"]
+    if a.get("timeout"):
+        out["timeout"] = _duration(a["timeout"])
+    rp = a.get("retry_policy")
+    if rp:
+        out["retry_policy"] = {
+            "retry_on": rp.get("retry_on", ""),
+            "num_retries": {"value": int(rp.get("num_retries", 1))},
+            **({"retriable_status_codes":
+                [int(c) for c in rp["retriable_status_codes"]]}
+               if rp.get("retriable_status_codes") else {})}
+    return out
+
+
+def _lower_hcm(tc: dict[str, Any]) -> bytes:
+    """HttpConnectionManager with an INLINE RouteConfiguration — the
+    shape _http_conn_manager (connect/envoy.py) emits for L7 chains;
+    routes still update live because delta-ADS re-pushes the listener."""
+    rc = tc.get("route_config") or {}
+    vhosts = []
+    for vh in rc.get("virtual_hosts") or []:
+        vhosts.append({
+            "name": vh.get("name", ""),
+            "domains": list(vh.get("domains") or ["*"]),
+            "routes": [{"match": _lower_route_match(r.get("match")
+                                                    or {}),
+                        "route": _lower_route_action(r.get("route")
+                                                     or {})}
+                       for r in vh.get("routes") or []]})
+    filters = []
+    for f in tc.get("http_filters") or []:
+        at = (f.get("typed_config") or {}).get("@type", "")
+        if at != HTTP_ROUTER_TYPE:
+            raise UnloweredShape(f"http filter {at!r}")
+        filters.append({"name": f.get("name", ""),
+                        "typed_config": {"type_url": at, "value": b""}})
+    return encode(_HCM, {
+        "stat_prefix": tc.get("stat_prefix", ""),
+        "route_config": {"name": rc.get("name", ""),
+                         "virtual_hosts": vhosts},
+        "http_filters": filters})
+
 _FILTER = {"name": Field(1, "string"),
            "typed_config": Field(4, "message", _ANY)}
+_HCM["http_filters"] = Field(5, "message", _FILTER, repeated=True)
 _FILTER_CHAIN_MATCH = {
     "server_names": Field(11, "string", repeated=True)}
 _FILTER_CHAIN = {
@@ -258,6 +460,8 @@ def _lower_filter(f: dict[str, Any]) -> dict[str, Any]:
         blob = encode(_NETWORK_RBAC, {
             "stat_prefix": tc.get("stat_prefix", ""),
             "rules": {"action": action, "policies": policies}})
+    elif at == HCM_TYPE:
+        blob = _lower_hcm(tc)
     else:
         raise UnloweredShape(f"filter {at!r}")
     return {"name": f.get("name", ""),

@@ -567,3 +567,59 @@ def test_configentry_resolved_exported_services(agent, client):
     assert any(s["Service"] == "web"
                and "dc2-peer" in s["Consumers"]["Peers"]
                for s in svcs)
+
+
+def test_hcm_route_config_lowers_to_proto():
+    """L7 chains (service-router/splitter) lower to a true-proto
+    HttpConnectionManager with inline RouteConfiguration — path/header/
+    query matches, weighted clusters, rewrites, timeouts, retries."""
+    from consul_tpu.connect.envoy import _http_conn_manager
+    from consul_tpu.server import xds_proto as xp
+
+    routes = [
+        {"Match": {"HTTP": {"PathPrefix": "/api",
+                            "Header": [{"Name": "x-debug",
+                                        "Exact": "1"},
+                                       {"Name": "x-skip",
+                                        "Present": True,
+                                        "Invert": True}],
+                            "QueryParam": [{"Name": "v",
+                                            "Regex": "v[0-9]+"}],
+                            "Methods": ["GET", "POST"]}},
+         "Destination": {"PrefixRewrite": "/", "RequestTimeout": "5s",
+                         "NumRetries": 3,
+                         "RetryOnStatusCodes": [502, 503]},
+         "Targets": [{"Service": "api-v1", "Weight": 60},
+                     {"Service": "api-v2", "Weight": 40}]},
+        {"Match": {"HTTP": {"PathExact": "/health"}},
+         "Destination": {},
+         "Targets": [{"Service": "api-v1", "Weight": 100}]},
+    ]
+    filt = _http_conn_manager("web", routes)
+    lowered = xp._lower_filter(filt)
+    assert lowered["typed_config"]["type_url"] == xp.HCM_TYPE
+    hcm = decode(xp._HCM, lowered["typed_config"]["value"])
+    assert hcm["stat_prefix"] == "web"
+    assert hcm["http_filters"][0]["name"] == "envoy.filters.http.router"
+    vh = hcm["route_config"]["virtual_hosts"][0]
+    assert vh["domains"] == ["*"]
+    r0, r1 = vh["routes"]
+    m0 = r0["match"]
+    assert m0["prefix"] == "/api"
+    hdr_names = [h["name"] for h in m0["headers"]]
+    assert "x-debug" in hdr_names and ":method" in hdr_names
+    skip = next(h for h in m0["headers"] if h["name"] == "x-skip")
+    assert skip["present_match"] is True and skip["invert_match"] is True
+    qp = m0["query_parameters"][0]
+    assert qp["name"] == "v"
+    assert qp["string_match"]["safe_regex"]["regex"] == "v[0-9]+"
+    a0 = r0["route"]
+    wc = a0["weighted_clusters"]["clusters"]
+    assert [(c["name"], c["weight"]["value"]) for c in wc] == \
+        [("web_api-v1", 60), ("web_api-v2", 40)]
+    assert a0["prefix_rewrite"] == "/"
+    assert a0["timeout"] == {"seconds": 5}
+    assert a0["retry_policy"]["num_retries"]["value"] == 3
+    assert a0["retry_policy"]["retriable_status_codes"] == [502, 503]
+    assert r1["match"]["path"] == "/health"
+    assert r1["route"]["cluster"] == "web_api-v1"
