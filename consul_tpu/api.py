@@ -246,6 +246,81 @@ class ConsulClient:
     def raft_configuration(self) -> dict:
         return self.get("/v1/operator/raft/configuration")
 
+    # ------------------------------------------------------------------ txn
+
+    def txn(self, ops: list[dict]) -> dict:
+        """Atomic multi-op transaction (api/txn.go Txn). Each op is
+        {"KV": {...}} / {"Node": {...}} / {"Service": {...}} /
+        {"Check": {...}} with a Verb; raises APIError(409) with the
+        per-op errors on a failed CAS."""
+        return self.put("/v1/txn", body=ops)
+
+    # ------------------------------------------------------------------ acl
+
+    def acl_bootstrap(self) -> dict:
+        return self.put("/v1/acl/bootstrap")
+
+    def acl_token_create(self, body: dict) -> dict:
+        return self.put("/v1/acl/token", body=body)
+
+    def acl_token_read(self, accessor_id: str) -> dict:
+        return self.get(f"/v1/acl/token/{accessor_id}")
+
+    def acl_token_delete(self, accessor_id: str) -> bool:
+        return bool(self.delete(f"/v1/acl/token/{accessor_id}"))
+
+    def acl_token_list(self) -> list[dict]:
+        return self.get("/v1/acl/tokens")
+
+    def acl_policy_create(self, name: str, rules: str,
+                          description: str = "") -> dict:
+        return self.put("/v1/acl/policy", body={
+            "Name": name, "Rules": rules, "Description": description})
+
+    def acl_policy_read_by_name(self, name: str) -> dict:
+        return self.get(f"/v1/acl/policy/name/{name}")
+
+    def acl_policy_list(self) -> list[dict]:
+        return self.get("/v1/acl/policies")
+
+    def acl_login(self, auth_method: str, bearer_token: str) -> dict:
+        return self.post("/v1/acl/login", body={
+            "AuthMethod": auth_method, "BearerToken": bearer_token})
+
+    def acl_logout(self) -> None:
+        self.post("/v1/acl/logout")
+
+    # ----------------------------------------------------------- coordinate
+
+    def coordinate_nodes(self, **params) -> list[dict]:
+        return self.get("/v1/coordinate/nodes", **params)
+
+    def coordinate_datacenters(self) -> list[dict]:
+        return self.get("/v1/coordinate/datacenters")
+
+    # ------------------------------------------------------ prepared queries
+
+    def query_create(self, body: dict) -> dict:
+        return self.post("/v1/query", body=body)
+
+    def query_list(self) -> list[dict]:
+        return self.get("/v1/query")
+
+    def query_execute(self, name_or_id: str, **params) -> dict:
+        return self.get(f"/v1/query/{name_or_id}/execute", **params)
+
+    def query_delete(self, qid: str) -> None:
+        self.delete(f"/v1/query/{qid}")
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot_save(self) -> bytes:
+        """Atomic gzip-tar state snapshot (api/snapshot.go Save)."""
+        return self.get("/v1/snapshot")
+
+    def snapshot_restore(self, archive: bytes) -> dict:
+        return self.put("/v1/snapshot", raw=archive)
+
 
 class _SessionKeeper:
     """Background TTL-session renewal while a lock/semaphore is held

@@ -96,9 +96,16 @@ _CLUSTER_TYPE_ENUM = {"STATIC": 0, "STRICT_DNS": 1, "LOGICAL_DNS": 2,
 
 # ------------------------------------------------------------ listeners
 
-#: extensions.filters.network.tcp_proxy.v3.TcpProxy
+#: extensions.filters.network.tcp_proxy.v3.TcpProxy — cluster_specifier
+#: oneof: cluster=2 | weighted_clusters=10 (TcpProxy.WeightedCluster,
+#: whose ClusterWeight is name=1 + plain uint32 weight=2)
+_TCP_CLUSTER_WEIGHT = {"name": Field(1, "string"),
+                       "weight": Field(2, "int")}
+_TCP_WEIGHTED = {"clusters": Field(1, "message", _TCP_CLUSTER_WEIGHT,
+                                   repeated=True)}
 _TCP_PROXY = {"stat_prefix": Field(1, "string"),
-              "cluster": Field(2, "string")}
+              "cluster": Field(2, "string"),
+              "weighted_clusters": Field(10, "message", _TCP_WEIGHTED)}
 TCP_PROXY_TYPE = ("type.googleapis.com/envoy.extensions.filters."
                   "network.tcp_proxy.v3.TcpProxy")
 
@@ -206,8 +213,12 @@ HTTP_ROUTER_TYPE = ("type.googleapis.com/envoy.extensions.filters."
 
 
 def _safe_regex(d: dict[str, Any]) -> dict[str, Any]:
-    """One place builds the RegexMatcher (google_re2 presence arm)."""
-    return {"google_re2": {}, "regex": d.get("regex", "")}
+    """One place builds the RegexMatcher (google_re2 presence arm).
+    RegexMatcher.regex has min_len 1 — an empty regex would encode to
+    nothing and be NACKed, so it must fall back instead."""
+    if not d.get("regex"):
+        raise UnloweredShape(f"empty regex {d!r}")
+    return {"google_re2": {}, "regex": d["regex"]}
 
 
 def _string_match(d: dict[str, Any]) -> dict[str, Any]:
@@ -217,6 +228,11 @@ def _string_match(d: dict[str, Any]) -> dict[str, Any]:
     unknown = set(d) - set(out)
     if unknown - {"safe_regex"}:
         raise UnloweredShape(f"string matcher {d!r}")
+    if not any(v for v in out.values() if not isinstance(v, dict)) \
+            and not out.get("safe_regex"):
+        # the match_pattern oneof is required; empty strings elide on
+        # the wire and ship an invalid matcher
+        raise UnloweredShape(f"string matcher without pattern {d!r}")
     return out
 
 
@@ -432,9 +448,22 @@ def _lower_filter(f: dict[str, Any]) -> dict[str, Any]:
     tc = f.get("typed_config") or {}
     at = tc.get("@type", "")
     if at == TCP_PROXY_TYPE:
-        blob = encode(_TCP_PROXY, {
-            "stat_prefix": tc.get("stat_prefix", ""),
-            "cluster": tc.get("cluster", "")})
+        msg: dict[str, Any] = {"stat_prefix": tc.get("stat_prefix",
+                                                     "")}
+        if tc.get("cluster"):
+            msg["cluster"] = tc["cluster"]
+        elif tc.get("weighted_clusters"):
+            # tcp service-splitter (envoy.py _tcp_filter split arm)
+            msg["weighted_clusters"] = {"clusters": [
+                {"name": c.get("name", ""),
+                 "weight": int(c.get("weight", 0))}
+                for c in tc["weighted_clusters"].get("clusters")
+                or []]}
+        else:
+            # TcpProxy REQUIRES a cluster_specifier — an empty one
+            # would be NACKed, not visibly fall back
+            raise UnloweredShape(f"tcp_proxy without cluster {tc!r}")
+        blob = encode(_TCP_PROXY, msg)
     elif at == NETWORK_RBAC_TYPE:
         rules = tc.get("rules") or {}
         action = {"ALLOW": 0, "DENY": 1}.get(rules.get("action"), None)

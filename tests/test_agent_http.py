@@ -703,3 +703,36 @@ def test_stale_and_consistent_conflict(agent, client):
     with pytest.raises(APIError) as ei:
         client.get("/v1/catalog/nodes", stale="", consistent="")
     assert ei.value.code == 400
+
+
+def test_client_library_typed_helpers(agent, client):
+    """api.py typed families (api/txn.go, acl.go, coordinate.go,
+    prepared_query.go, snapshot.go equivalents) drive their endpoints."""
+    res = client.txn([
+        {"KV": {"Verb": "set", "Key": "lib/a", "Value": "MQ=="}},
+        {"KV": {"Verb": "set", "Key": "lib/b", "Value": "Mg=="}}])
+    assert len(res.get("Results") or []) == 2
+    assert client.kv_get("lib/a") == b"1"
+
+    pol = client.acl_policy_create("lib-pol", "{}")
+    assert client.acl_policy_read_by_name("lib-pol")["ID"] == pol["ID"]
+    assert any(p["Name"] == "lib-pol"
+               for p in client.acl_policy_list())
+    tok = client.acl_token_create({"Description": "lib",
+                                   "Policies": [{"Name": "lib-pol"}]})
+    assert client.acl_token_read(
+        tok["AccessorID"])["Description"] == "lib"
+    assert client.acl_token_delete(tok["AccessorID"])
+
+    assert isinstance(client.coordinate_nodes(), list)
+    assert client.coordinate_datacenters() is not None
+
+    q = client.query_create({"Name": "lib-q",
+                             "Service": {"Service": "web"}})
+    assert any(x["Name"] == "lib-q" for x in client.query_list())
+    client.query_delete(q["ID"])
+
+    snap = client.snapshot_save()
+    assert snap[:2] == b"\x1f\x8b"  # gzip magic
+    meta = client.snapshot_restore(snap)
+    assert meta.get("Index", 0) >= 0
