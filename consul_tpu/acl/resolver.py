@@ -1,21 +1,41 @@
-"""Token → Authorizer resolution with caching.
+"""Token → Authorizer resolution with caching, expiry and down-policy.
 
 Reference: agent/consul/acl.go ACLResolver (cached token/policy
-resolution with TTLs and down-policy). Tokens and policies live in the
-replicated state store (acl_tokens / acl_policies tables, written via
-the ACL FSM commands); resolution happens on every authenticated
-request.
+resolution with TTLs and down-policy, agent/consul/config.go:541-550).
+Tokens and policies live in the replicated state store (acl_tokens /
+acl_policies tables, written via the ACL FSM commands); resolution
+happens on every authenticated request.
+
+Three behaviors beyond plain lookup:
+
+* **Token expiration** (structs/acl.go:334-349 ExpirationTime):
+  a token past its ExpirationTime resolves exactly like a token that
+  does not exist — lazily, here, before the leader's reaper gets to
+  deleting it.
+* **Down-policy** (config ACLDownPolicy): when resolution requires a
+  REMOTE source (in a secondary DC, a token missing from the local
+  replica is looked up in the primary) and that source is unreachable,
+  ``extend-cache``/``async-cache`` re-use the cached authorizer past
+  its TTL, ``deny`` refuses the request, ``allow`` admits it.
+* **Negative caching**: unknown/expired tokens are cached like found
+  ones (same TTL) so a flood of bogus secrets cannot hammer the state
+  store; the cache is bounded and evicts oldest-first.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from consul_tpu.acl.policy import Authorizer, DENY, WRITE, parse_policy
 from consul_tpu.utils import log
 
 ANONYMOUS_TOKEN_ID = "anonymous"
+
+#: cache entries are kept (for extend-cache) up to this multiple of the
+#: TTL before the size-pruner may drop them
+_EXTEND_FACTOR = 20.0
+_CACHE_MAX = 4096
 
 
 class ACLDisabledError(Exception):
@@ -27,44 +47,108 @@ class PermissionDeniedError(Exception):
         super().__init__(what)
 
 
+class ACLRemoteError(Exception):
+    """The remote ACL source (primary DC) could not be reached."""
+
+
+def token_expired(token: dict, now: Optional[float] = None) -> bool:
+    """ExpirationTime (unix epoch seconds) in the past → the token
+    behaves as if it does not exist (acl.go ACLToken.IsExpired)."""
+    exp = token.get("ExpirationTime")
+    if not exp:
+        return False
+    return (now if now is not None else time.time()) >= float(exp)
+
+
 class ACLResolver:
     def __init__(self, state, enabled: bool, default_policy: str = "allow",
-                 token_ttl: float = 30.0) -> None:
+                 token_ttl: float = 30.0,
+                 down_policy: str = "extend-cache",
+                 remote_resolve: Optional[
+                     Callable[[str], Optional[dict]]] = None) -> None:
         self.state = state
         self.enabled = enabled
         self.default_level = WRITE if default_policy == "allow" else DENY
         self.token_ttl = token_ttl
+        self.down_policy = down_policy
+        #: secondary-DC hook: look a secret up in the primary; returns
+        #: the token dict, None if the primary says it doesn't exist,
+        #: or raises ACLRemoteError if the primary is unreachable
+        self.remote_resolve = remote_resolve
         self.log = log.named("acl")
-        self._cache: dict[str, tuple[float, Authorizer]] = {}
+        # secret → (monotonic stamp, Authorizer, token ExpirationTime)
+        self._cache: dict[str, tuple[float, Authorizer,
+                                     Optional[float]]] = {}
 
     def resolve(self, secret_id: str) -> Authorizer:
-        """SecretID → merged Authorizer. Unknown tokens resolve to the
-        anonymous authorizer (reference behavior: unknown token =
-        anonymous unless down-policy says otherwise)."""
+        """SecretID → merged Authorizer. Unknown and expired tokens
+        resolve to the anonymous authorizer (reference behavior),
+        subject to the down-policy when the primary is needed but
+        unreachable."""
         if not self.enabled:
             return Authorizer([], default_level=WRITE)
         secret_id = secret_id or ANONYMOUS_TOKEN_ID
         now = time.monotonic()
         hit = self._cache.get(secret_id)
-        if hit is not None and now - hit[0] < self.token_ttl:
+        if hit is not None and now - hit[0] < self.token_ttl and \
+                not (hit[2] is not None and time.time() >= hit[2]):
+            # expiry is honored on cache HITS too (acl.go checks
+            # identity.IsExpired even for cached identities)
             return hit[1]
-        authz = self._resolve_uncached(secret_id)
-        self._cache[secret_id] = (now, authz)
-        if len(self._cache) > 4096:
-            cutoff = now - self.token_ttl
+        try:
+            authz, exp = self._resolve_uncached(secret_id)
+        except ACLRemoteError:
+            return self._apply_down_policy(secret_id, hit)
+        self._cache[secret_id] = (now, authz, exp)
+        if len(self._cache) > _CACHE_MAX:
+            cutoff = now - self.token_ttl * _EXTEND_FACTOR
             self._cache = {k: v for k, v in self._cache.items()
                            if v[0] >= cutoff}
+            while len(self._cache) > _CACHE_MAX:  # still full: oldest out
+                self._cache.pop(min(self._cache,
+                                    key=lambda k: self._cache[k][0]))
         return authz
 
-    def _resolve_uncached(self, secret_id: str) -> Authorizer:
+    def _apply_down_policy(
+            self, secret_id: str,
+            hit: Optional[tuple[float, Authorizer,
+                                Optional[float]]]) -> Authorizer:
+        """The primary is unreachable (config.go:546-548 ACLDownPolicy)."""
+        dp = self.down_policy
+        if dp == "allow":
+            return Authorizer([], default_level=WRITE)
+        if dp in ("extend-cache", "async-cache") and hit is not None:
+            self.log.debug("ACL source down; extending cached "
+                           "authorizer for %s...", secret_id[:8])
+            return hit[1]
+        if dp == "deny":
+            raise PermissionDeniedError(
+                "Permission denied: ACL datasource unavailable "
+                "(down_policy=deny)")
+        # extend-cache with nothing cached: the token is indistinguish-
+        # able from unknown — anonymous, like a stale replica would say
+        return Authorizer([], default_level=self.default_level)
+
+    def _resolve_uncached(
+            self, secret_id: str) -> tuple[Authorizer, Optional[float]]:
         token = self.state.raw_get("acl_tokens", secret_id)
-        if token is None:
-            # anonymous: no policies, default policy applies
-            return Authorizer([], default_level=self.default_level)
+        if token is None and self.remote_resolve is not None \
+                and secret_id != ANONYMOUS_TOKEN_ID:
+            # secondary DC, token not (yet) replicated: ask the primary
+            # (acl.go resolveTokenToIdentity remote path). Raises
+            # ACLRemoteError when the primary is unreachable.
+            token = self.remote_resolve(secret_id)
+        if token is None or token_expired(token):
+            # anonymous: no policies, default policy applies (expired
+            # tokens behave as unknown — the reaper deletes them later)
+            return Authorizer([], default_level=self.default_level), None
+        exp = token.get("ExpirationTime")
+        exp = float(exp) if exp else None
         if token.get("Management") or any(
                 p.get("ID") == "global-management"
                 for p in token.get("Policies") or []):
-            return Authorizer([], default_level=WRITE, is_management=True)
+            return Authorizer([], default_level=WRITE,
+                              is_management=True), exp
         policies = []
         # service/node identities synthesize their templated policies
         # (acl/policy_templated.go): service → service:write + discovery
@@ -104,7 +188,8 @@ class ACLResolver:
             add_identities(role)
         # global-management attached through a role counts too
         if any(p.get("ID") == "global-management" for p in policy_refs):
-            return Authorizer([], default_level=WRITE, is_management=True)
+            return Authorizer([], default_level=WRITE,
+                              is_management=True), exp
         for ref in policy_refs:
             pol = self.state.raw_get("acl_policies", ref.get("ID", ""))
             if pol is None:
@@ -121,7 +206,8 @@ class ACLResolver:
                 except ValueError as e:
                     self.log.warning("bad policy %s: %s",
                                      pol.get("Name"), e)
-        return Authorizer(policies, default_level=self.default_level)
+        return Authorizer(policies,
+                          default_level=self.default_level), exp
 
     def invalidate(self) -> None:
         self._cache.clear()

@@ -831,7 +831,6 @@ class Agent:
             import base64 as b64
             import uuid as uuid_mod
 
-            self._event_seq = getattr(self, "_event_seq", 0) + 1
             self._recent_events.append({
                 "ID": str(uuid_mod.uuid4()),
                 "Name": ev.name.removeprefix("consul:event:"),

@@ -210,6 +210,10 @@ class RuntimeConfig:
     acl_default_token: str = ""  # requests arriving without a token (DNS)
     acl_replication_token: str = ""  # secondary-DC pulls from primary
     acl_token_ttl: float = 30.0
+    # mirror the primary's token table into secondaries (reference
+    # acl.enable_token_replication, default false: secondaries resolve
+    # unknown secrets via the primary, subject to acl_down_policy)
+    acl_enable_token_replication: bool = False
 
     # DNS
     dns_domain: str = "consul."
@@ -432,7 +436,9 @@ def load(
     for src, tgt in (("enabled", "acl_enabled"),
                      ("default_policy", "acl_default_policy"),
                      ("down_policy", "acl_down_policy"),
-                     ("token_ttl", "acl_token_ttl")):
+                     ("token_ttl", "acl_token_ttl"),
+                     ("enable_token_replication",
+                      "acl_enable_token_replication")):
         if src in acl:
             kwargs[tgt] = acl[src]
     tokens = acl.get("tokens", {})

@@ -8,6 +8,7 @@ forward_or_apply (leader forwarding, §3.3).
 
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Any
 
@@ -564,8 +565,23 @@ def register_endpoints(srv) -> None:
             existing = _find_token(tok["AccessorID"])
             if existing is not None:
                 tok["SecretID"] = existing["SecretID"]
+                # expiration is immutable after create (structs/acl.go
+                # ExpirationTime "cannot be changed once set")
+                if existing.get("ExpirationTime"):
+                    tok["ExpirationTime"] = existing["ExpirationTime"]
+                    tok.pop("ExpirationTTL", None)
         tok.setdefault("SecretID", str(uuid.uuid4()))
         tok.setdefault("AccessorID", str(uuid.uuid4()))
+        ttl = tok.pop("ExpirationTTL", None)
+        if ttl and not tok.get("ExpirationTime"):
+            # structs/acl.go:334-349: TTL at create → absolute
+            # ExpirationTime (epoch seconds); once minted, fixed
+            from consul_tpu.utils.duration import parse_duration
+
+            secs = parse_duration(ttl)
+            if secs <= 0:
+                raise RPCError("Token Expiration TTL must be positive")
+            tok["ExpirationTime"] = time.time() + secs
         srv.forward_or_apply(MessageType.ACL_TOKEN,
                              {"Op": "set", "Token": tok})
         return tok
@@ -757,6 +773,13 @@ def register_endpoints(srv) -> None:
             "Meta": dict(auth.get("Meta") or {}),
             **bindings,
         }
+        # auth-method MaxTokenTTL bounds the login token's lifetime
+        # (structs/acl.go ACLAuthMethod.MaxTokenTTL → ExpirationTime)
+        max_ttl = method.get("MaxTokenTTL")
+        if max_ttl:
+            from consul_tpu.utils.duration import parse_duration
+
+            tok["ExpirationTime"] = time.time() + parse_duration(max_ttl)
         srv.forward_or_apply(MessageType.ACL_TOKEN,
                              {"Op": "set", "Token": tok})
         return tok
@@ -1794,9 +1817,12 @@ def register_endpoints(srv) -> None:
 
     def acl_token_self(args):
         """acl/token/self: a token reads ITSELF — the secret is the
-        authorization (acl_endpoint.go TokenRead self-policy)."""
+        authorization (acl_endpoint.go TokenRead self-policy). An
+        expired token is indistinguishable from a deleted one."""
+        from consul_tpu.acl.resolver import token_expired
+
         tok = state.raw_get("acl_tokens", args.get("AuthToken", ""))
-        if tok is None:
+        if tok is None or token_expired(tok):
             raise RPCError("Permission denied: token not found")
         return {"Token": tok}
 

@@ -670,8 +670,13 @@ def make_grpc_server(agent, bind_addr: str, port: int):
             "Type": {"Group": t.get("group", ""),
                      "GroupVersion": t.get("group_version", ""),
                      "Kind": t.get("kind", "")},
-            "Tenancy": {"Partition": ten.get("partition", "") or "*",
-                        "Namespace": ten.get("namespace", "") or "*"},
+            # empty tenancy units default to "default" (reference
+            # list.go v1EntMetaToV2Tenancy); wildcard scope requires an
+            # explicit "*" from the client
+            "Tenancy": {"Partition": ten.get("partition", "")
+                        or "default",
+                        "Namespace": ten.get("namespace", "")
+                        or "default"},
             "Prefix": req.get("name_prefix", ""),
             "AllowStale": True})
         return encode(RES_LIST_RESP, {
@@ -701,8 +706,8 @@ def make_grpc_server(agent, bind_addr: str, port: int):
             {"Group": t.get("group", ""),
              "GroupVersion": t.get("group_version", ""),
              "Kind": t.get("kind", "")},
-            {"Partition": ten.get("partition", "") or "*",
-             "Namespace": ten.get("namespace", "") or "*"},
+            {"Partition": ten.get("partition", "") or "default",
+             "Namespace": ten.get("namespace", "") or "default"},
             req.get("name_prefix", ""), mark_snapshot=True)
         try:
             while context.is_active():

@@ -206,12 +206,37 @@ class Server:
                                                 seg_name))
 
         # ACL resolver over the replicated token/policy tables
-        # (reference: ACLResolver embedded in Server, server.go:180)
+        # (reference: ACLResolver embedded in Server, server.go:180).
+        # In a secondary DC, a secret missing from the local replica is
+        # looked up in the primary (acl.go remote identity resolution);
+        # an unreachable primary triggers the down-policy.
         from consul_tpu.acl import ACLResolver
+        from consul_tpu.acl.resolver import ACLRemoteError
 
+        def _remote_token(secret: str):
+            pdc = self.config.primary_datacenter
+            try:
+                res = self._forward_dc(
+                    "ACL.TokenSelf",
+                    {"AuthToken": secret, "Datacenter": pdc,
+                     "AllowStale": True}, pdc)
+            except RPCError as ex:
+                if "token not found" in str(ex):
+                    return None  # the primary answered: no such token
+                raise ACLRemoteError(str(ex)) from ex
+            except Exception as ex:  # noqa: BLE001 — transport failure
+                raise ACLRemoteError(str(ex)) from ex
+            return (res or {}).get("Token")
+
+        is_secondary = bool(config.primary_datacenter
+                            and config.primary_datacenter
+                            != config.datacenter)
         self.acl = ACLResolver(self.state, enabled=config.acl_enabled,
                                default_policy=config.acl_default_policy,
-                               token_ttl=config.acl_token_ttl)
+                               token_ttl=config.acl_token_ttl,
+                               down_policy=config.acl_down_policy,
+                               remote_resolve=_remote_token
+                               if is_secondary else None)
         self.state.add_change_hook(
             lambda tables, idx: self.acl.invalidate()
             if "acl" in tables else None)
@@ -737,8 +762,33 @@ class Server:
         self._ensure_peer_replicators()
         self._drain_reconcile()
         self._expire_sessions()
+        self._reap_expired_tokens()
         self._replicate_from_primary()
         self._update_federation_state()
+
+    def _reap_expired_tokens(self) -> None:
+        """Leader routine deleting ACL tokens past their ExpirationTime
+        (reference: leader.go startACLTokenReaping). The resolver
+        already refuses expired tokens lazily; reaping keeps the table
+        clean and revokes the secrets durably. Primary-owned —
+        secondaries receive the deletions via ACL replication."""
+        if not self.config.acl_enabled:
+            return
+        pdc = self.config.primary_datacenter
+        if pdc and pdc != self.config.datacenter:
+            return
+        from consul_tpu.acl.resolver import token_expired
+
+        now = time.time()
+        for tok in self.state.raw_list("acl_tokens"):
+            if token_expired(tok, now):
+                try:
+                    self.raft.apply(encode_command(
+                        MessageType.ACL_TOKEN,
+                        {"Op": "delete", "Token": tok}))
+                except Exception as e:  # noqa: BLE001
+                    self.log.debug("token reap (retry next tick): %s", e)
+                    return
 
     # --------------------------------------------------- peerstream (dialer)
 
@@ -781,7 +831,6 @@ class Server:
                 handle = self.pool.subscribe(
                     addrs[addr_i % len(addrs)],
                     "PeerStream.StreamExported", {"Secret": secret})
-                backoff = 0.5  # reconnected: flappy-period over
                 while not self._shutdown and self.is_leader():
                     cur = self.state.raw_get("peerings", name)
                     if cur is None or cur.get("Secret") != secret \
@@ -809,6 +858,11 @@ class Server:
                                 "Service": fr.get("Service", "")}))
                     elif kind == "end_of_snapshot" and in_snapshot:
                         in_snapshot = False
+                        # only a stream that got past its snapshot
+                        # counts as healthy — resetting on subscribe
+                        # alone lets an accept-then-close acceptor
+                        # drive a full-snapshot hot loop
+                        backoff = 0.5
                         # reconcile: a delete delta that happened while
                         # the stream was down never replays, so purge
                         # imported records absent from the snapshot
@@ -824,7 +878,12 @@ class Server:
                                         "Service": rec.get("Service",
                                                            "")}))
             except StopIteration:
-                pass  # acceptor ended cleanly; resubscribe
+                # acceptor ended cleanly; still pace the resubscribe —
+                # each cycle re-replays a full snapshot through raft
+                if self._shutdown:
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
             except Exception as e:  # noqa: BLE001
                 self.log.debug("peerstream %s: %s (retrying)", name, e)
                 if self._shutdown:
@@ -924,13 +983,18 @@ class Server:
                 pull("ACL.BindingRuleList")["BindingRules"],
                 "acl_binding_rules", lambda r: r.get("ID"),
                 MessageType.ACL_BINDING_RULE, "BindingRule")
-            keep = {self.config.acl_initial_management_token}
-            self._mirror(
-                pull("ACL.TokenList",
-                     {"IncludeSecrets": True})["Tokens"], "acl_tokens",
-                lambda t: t.get("SecretID"),
-                MessageType.ACL_TOKEN, "Token",
-                keep_local=lambda k, v: k in keep)
+            if self.config.acl_enable_token_replication:
+                # token mirroring is OPT-IN (reference
+                # acl.enable_token_replication, default false); without
+                # it secondaries resolve unknown secrets through the
+                # primary under acl_down_policy
+                keep = {self.config.acl_initial_management_token}
+                self._mirror(
+                    pull("ACL.TokenList",
+                         {"IncludeSecrets": True})["Tokens"],
+                    "acl_tokens", lambda t: t.get("SecretID"),
+                    MessageType.ACL_TOKEN, "Token",
+                    keep_local=lambda k, v: k in keep)
             self._mirror(
                 pull("ConfigEntry.List")["Entries"], "config_entries",
                 lambda e: f"{e.get('Kind', '')}/{e.get('Name', '')}",

@@ -52,10 +52,23 @@ agent/consul/server_serf.go; tuning agent/consul/config.go:661-698):
   iptables partition/heal scenarios, sdk/iptables)
 
 Deliberately out of envelope here: churn rejoin (mean-field covers it;
-a rejoining node would need row/column re-initialization), slow-node
-(degraded processing) modeling, and LEFT-status propagation. n² memory
-caps the tier at ~8k nodes on one chip — by design; it complements,
-not replaces, the mean-field tier.
+a rejoining node would need row/column re-initialization) and
+LEFT-status propagation. n² memory caps the tier at ~8k nodes on one
+chip — by design; it complements, not replaces, the mean-field tier.
+
+The degraded-node (slow) model IS in envelope since round 3: slow
+nodes miss probe duties with factor ``slow_factor`` exactly as in the
+mean-field tier (same ``p_d``/relay/TCP composition over endpoint
+timeliness), and process incoming gossip late (reception thinned by
+the factor — which is what delays their refutations), and each viewer
+carries a Lifeguard local-health score ``lh`` (memberlist
+awareness.go: ack −1, miss/refute +1) that scales its suspicion
+timers by (LH+1) and — when slow nodes are modeled — lends *patience*
+to its probes of slow targets (the awareness-mitigation term of the
+mean-field tier's ``_pf_arrays``). Cumulative subject-level detector
+statistics (``ViewStats``) make the tier directly comparable to the
+mean-field counters — the conformance seam tests/test_conformance.py
+closes at n=2-4k.
 """
 
 from __future__ import annotations
@@ -72,12 +85,45 @@ from consul_tpu.sim.state import ALIVE, DEAD, SUSPECT
 _NO_DEADLINE = jnp.int32(2**31 - 1)
 
 
+class ViewStats(NamedTuple):
+    """Cumulative detector counters (0-d arrays), in units chosen to be
+    commensurate with the mean-field tier's SimStats:
+
+    *subject-level incidents* — a column of the view matrix (what the
+    live cluster believes about subject j) transitioning from "no live
+    viewer holds X about j" to "some live viewer does". This matches
+    the mean-field tier's single aggregate rumor state per subject
+    (its ``suspicions``/``false_positives`` count exactly these
+    episode starts).
+
+    *pair-level events* — raw per-viewer detector actions (each
+    viewer's own suspicion adoption / timer expiry), the unit the
+    host engine's ``memberlist.suspect``/``declare_dead`` telemetry
+    counters fire in (once per member). Divide by the spread fraction
+    to compare across tiers."""
+
+    susp_incidents: jnp.ndarray   # int32 — columns newly SUSPECT
+    fp_incidents: jnp.ndarray     # int32 — up subject newly seen DEAD
+    deaths_declared: jnp.ndarray  # int32 — down subject newly seen DEAD
+    detect_latency_rounds: jnp.ndarray  # int32 — Σ (seen − crash) rounds
+    refutes: jnp.ndarray          # int32 — self-refutation events
+    pair_susp_starts: jnp.ndarray  # int32 — (viewer, subject) → SUSPECT
+    pair_fp_declares: jnp.ndarray  # int32 — local expiry on up subject
+
+    @staticmethod
+    def zeros() -> "ViewStats":
+        z = jnp.zeros((), jnp.int32)
+        return ViewStats(z, z, z, z, z, z, z)
+
+
 class ViewState(NamedTuple):
     """Dense per-viewer cluster state. [n, n] unless noted."""
 
     up: jnp.ndarray         # [n] bool — ground-truth process liveness
     down_round: jnp.ndarray  # [n] int32 — round of crash (MAX while up)
     self_inc: jnp.ndarray   # [n] int32 — each node's own incarnation
+    slow: jnp.ndarray       # [n] bool — degraded (late processing)
+    lh: jnp.ndarray         # [n] int8 — Lifeguard local-health score
     status: jnp.ndarray     # int8 — viewer i's belief about subject j
     inc: jnp.ndarray        # int32 — incarnation of that belief
     susp_start: jnp.ndarray     # int32 — round suspicion began
@@ -86,14 +132,16 @@ class ViewState(NamedTuple):
     budget: jnp.ndarray     # int8 — piggyback retransmissions left
     reach: jnp.ndarray      # bool — packets i→j deliverable
     round: jnp.ndarray      # [] int32
+    stats: ViewStats
 
 
 def init_views(n: int) -> ViewState:
-    eye = jnp.eye(n, dtype=bool)
     return ViewState(
         up=jnp.ones((n,), bool),
         down_round=jnp.full((n,), 2**31 - 1, jnp.int32),
         self_inc=jnp.zeros((n,), jnp.int32),
+        slow=jnp.zeros((n,), bool),
+        lh=jnp.zeros((n,), jnp.int8),
         status=jnp.full((n, n), ALIVE, jnp.int8),
         inc=jnp.zeros((n, n), jnp.int32),
         susp_start=jnp.zeros((n, n), jnp.int32),
@@ -102,6 +150,7 @@ def init_views(n: int) -> ViewState:
         budget=jnp.zeros((n, n), jnp.int8),
         reach=jnp.ones((n, n), bool),
         round=jnp.zeros((), jnp.int32),
+        stats=ViewStats.zeros(),
     )
 
 
@@ -133,21 +182,64 @@ def _pick(key: jax.Array, mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(jnp.where(mask, g, -jnp.inf), axis=1)
 
 
+def _p_noack_pair(g_i: jnp.ndarray, g_t: jnp.ndarray, pi_i: jnp.ndarray,
+                  sbar: jnp.ndarray, live_frac: jnp.ndarray,
+                  p: SimParams) -> jnp.ndarray:
+    """Per-(prober, target) probe-miss probability.
+
+    The mean-field tier's channel composition (round.py _pf_arrays)
+    evaluated at concrete endpoint timeliness g — direct UDP ∪ any of
+    ``indirect_checks`` relays (through a random live third node, hence
+    the population mixture e_gp4 over relay timeliness) ∪ TCP fallback.
+    ``pi_i`` is the PROBER's Lifeguard patience (1 − 2^−LH): a patient
+    prober's stretched timeout rescues a slow endpoint's lateness —
+    same rescue algebra as _pf_arrays' ``ge`` terms."""
+    ge_i = g_i + (1.0 - g_i) * pi_i
+    ge_t = g_t + (1.0 - g_t) * pi_i
+    pair2 = (ge_i * ge_t) ** 2
+    p_d = p.p_direct * pair2
+    ge_p_slow = p.slow_factor + (1.0 - p.slow_factor) * pi_i
+    e_gp4 = (1.0 - sbar) + sbar * ge_p_slow ** 4
+    p_relay1 = live_frac * p.p_relay * pair2 * e_gp4
+    p_no_relay = (1.0 - p_relay1) ** p.indirect_checks
+    p_tcp = p.p_tcp * ge_i * ge_t
+    return (1.0 - p_d) * p_no_relay * (1.0 - p_tcp)
+
+
+def _col_flags(st: ViewState, eye: jnp.ndarray
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[n] bool per subject: does ANY live viewer hold SUSPECT / DEAD
+    about it — the views-tier analogue of the mean-field tier's single
+    aggregate rumor status per subject."""
+    live_v = st.up[:, None] & ~eye
+    col_susp = (live_v & (st.status == SUSPECT)).any(axis=0)
+    col_dead = (live_v & (st.status == DEAD)).any(axis=0)
+    return col_susp, col_dead
+
+
 def _merge(st: ViewState, inc_key: jnp.ndarray, confirm_src: jnp.ndarray,
-           p: SimParams) -> ViewState:
+           p: SimParams, lh_rows: jnp.ndarray | None = None) -> ViewState:
     """Merge incoming belief keys into every receiver's view.
 
     ``inc_key`` [n, n]: best key about subject j that reached receiver i
     this step (-1 where nothing arrived). ``confirm_src`` bool [n, n]:
     whether the arrival came from another node (a suspicion arriving
     from elsewhere counts as an independent confirmation, memberlist
-    suspicion.go Confirm)."""
+    suspicion.go Confirm). ``lh_rows``: the receiving viewers' Lifeguard
+    health scores — a viewer starting its own suspicion timer stretches
+    it by (LH+1), memberlist suspicion timeout scaling."""
     own_key = _key(st.status, st.inc)
     new_key = jnp.maximum(own_key, inc_key)
     changed = new_key > own_key
     status, inc = _unkey(new_key)
     min_r, max_r = _timeout_rounds(p)
     k = p.confirmation_k
+    if p.lifeguard and lh_rows is not None:
+        lh_scale = (lh_rows.astype(jnp.float32) + 1.0)[:, None]
+    else:
+        lh_scale = jnp.float32(1.0)
+    min_rs = min_r * lh_scale
+    max_rs = max_r * lh_scale
 
     became_suspect = changed & (status == SUSPECT)
     # Lifeguard confirmation: the same suspicion arriving again from
@@ -159,12 +251,12 @@ def _merge(st: ViewState, inc_key: jnp.ndarray, confirm_src: jnp.ndarray,
                                  jnp.int8(k)))
     start = jnp.where(became_suspect, st.round, st.susp_start)
     frac = jnp.log1p(conf.astype(jnp.float32)) / jnp.log1p(float(k))
-    shrunk = (start + max_r
-              - (frac * (max_r - min_r)).astype(jnp.int32))
+    shrunk = (start.astype(jnp.float32) + max_rs
+              - frac * (max_rs - min_rs)).astype(jnp.int32)
+    floor = (start.astype(jnp.float32) + min_rs).astype(jnp.int32)
     deadline = jnp.where(status == SUSPECT,
                          jnp.where(became_suspect | confirmed,
-                                   jnp.maximum(shrunk,
-                                               start + min_r),
+                                   jnp.maximum(shrunk, floor),
                                    st.susp_deadline),
                          _NO_DEADLINE)
     if not p.lifeguard:  # fixed timer, no confirmation shrink
@@ -185,7 +277,11 @@ def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
     """One SWIM protocol period over the dense per-viewer state."""
     n = p.n
     eye = jnp.eye(n, dtype=bool)
-    k_crash, k_pick, k_ack, k_gossip, k_pp = jax.random.split(key, 5)
+    k_crash, k_slow, k_pick, k_ack, k_gossip, k_pp = \
+        jax.random.split(key, 6)
+    if p.collect_stats:
+        pre_susp, pre_dead = _col_flags(st, eye)
+        pre_status = st.status
 
     # -- churn: crash injection -----------------------------------------
     if p.fail_per_round > 0.0:
@@ -195,6 +291,13 @@ def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
             up=st.up & ~crash,
             down_round=jnp.where(crash, st.round, st.down_round))
 
+    # -- degraded-node churn --------------------------------------------
+    if p.slow_per_round > 0.0:
+        u_s = jax.random.uniform(k_slow, (n,))
+        st = st._replace(slow=jnp.where(
+            st.slow, u_s >= p.slow_recover_per_round,
+            u_s < p.slow_per_round) & st.up)
+
     # -- probe: every up node probes one alive-view member --------------
     view_alive = (st.status == ALIVE) & ~eye
     has_target = view_alive.any(axis=1)
@@ -202,51 +305,80 @@ def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
     t_up = st.up[target]
     t_reach = jnp.take_along_axis(st.reach, target[:, None],
                                   axis=1)[:, 0]
-    # composed ack probability: direct ∪ any-of-k relays ∪ TCP fallback
-    p_relay_all = (1.0 - p.p_relay) ** p.indirect_checks
-    p_noack = (1.0 - p.p_direct) * p_relay_all * (1.0 - p.p_tcp)
+    # composed ack probability: direct ∪ any-of-k relays ∪ TCP
+    # fallback, at the (prober, target) pair's concrete timeliness
+    g = jnp.where(st.slow, p.slow_factor, 1.0)
+    live_frac = st.up.mean()
+    sbar = (st.slow & st.up).sum() / jnp.maximum(st.up.sum(), 1)
+    if p.lifeguard and p.slow_per_round:
+        pi = 1.0 - jnp.exp2(-st.lh.astype(jnp.float32))
+    else:
+        pi = jnp.zeros((n,), jnp.float32)
+    p_noack = _p_noack_pair(g, g[target], pi, sbar, live_frac, p)
     acked = t_up & t_reach & \
         (jax.random.uniform(k_ack, (n,)) > p_noack)
     suspect_it = st.up & has_target & ~acked
+    # Lifeguard awareness: ack −1, missed ack +1 (awareness.go deltas)
+    if p.lifeguard:
+        delta = jnp.where(st.up & has_target,
+                          jnp.where(acked, -1, 1), 0)
+        st = st._replace(lh=jnp.clip(
+            st.lh.astype(jnp.int32) + delta, 0,
+            p.awareness_max).astype(jnp.int8))
     # direct suspicion: prober i marks target SUSPECT at its known inc
     t_inc = jnp.take_along_axis(st.inc, target[:, None], axis=1)[:, 0]
     sus_key = jnp.full((n, n), -1, jnp.int32)
     sus_key = sus_key.at[jnp.arange(n), target].set(
         jnp.where(suspect_it, t_inc * 4 + 1, -1))
-    st = _merge(st, sus_key, jnp.zeros((n, n), bool), p)
+    st = _merge(st, sus_key, jnp.zeros((n, n), bool), p, st.lh)
 
     # -- gossip: fanout piggyback transmissions -------------------------
+    # Each gossip tick every sender picks gossip_nodes random non-dead
+    # members (memberlist gossip() kRandomNodes(GossipNodes)) and sends
+    # its hot set to each; all k deliveries of a tick land in ONE
+    # segment_max + merge (arrival order cannot matter anyway).
     ticks = int(p.gossip_ticks_per_round)
+    fanout = int(p.gossip_nodes)
 
     def gossip_slot(slot_key, st: ViewState) -> ViewState:
-        kk_pick, kk_loss = jax.random.split(slot_key)
-        # gossip targets come from the non-dead view (memberlist
-        # gossips to alive+suspect members)
         gmask = (st.status != DEAD) & ~eye
-        recv = _pick(kk_pick, gmask)
         sendable = st.up & gmask.any(axis=1)
-        delivered = sendable & st.up[recv] & \
-            st.reach[jnp.arange(n), recv] & \
-            (jax.random.uniform(kk_loss, (n,)) > p.loss)
         hot = st.budget > 0
-        sent_key = jnp.where(hot & delivered[:, None],
-                             _key(st.status, st.inc), -1)
+        full_key = _key(st.status, st.inc)
+        recvs, sents = [], []
+        for fk in jax.random.split(slot_key, fanout):
+            kk_pick, kk_loss, kk_recv = jax.random.split(fk, 3)
+            recv = _pick(kk_pick, gmask)
+            # a slow receiver processes the packet on time only with
+            # probability slow_factor (the mean-field tier's g-scaled
+            # hearing rate — what delays slow nodes' refutations)
+            g_recv = jnp.where(st.slow[recv], p.slow_factor, 1.0)
+            delivered = sendable & st.up[recv] & \
+                st.reach[jnp.arange(n), recv] & \
+                (jax.random.uniform(kk_loss, (n,)) > p.loss) & \
+                (jax.random.uniform(kk_recv, (n,)) < g_recv)
+            recvs.append(recv)
+            sents.append(jnp.where(hot & delivered[:, None],
+                                   full_key, -1))
         # scatter-max into receivers: arrival order cannot matter
         inc_key = jax.ops.segment_max(
-            sent_key, recv, num_segments=n,
-            indices_are_sorted=False)
+            jnp.concatenate(sents, axis=0), jnp.concatenate(recvs),
+            num_segments=n, indices_are_sorted=False)
         inc_key = jnp.where(inc_key < -1, -1, inc_key)  # empty segs
         confirm = inc_key >= 0
         # the budget is charged on SEND, delivered or not —
         # memberlist's TransmitLimitedQueue counts transmissions, so
         # lost packets are not free retries
         new_budget = jnp.where(hot & sendable[:, None],
-                               st.budget - 1, st.budget)
+                               jnp.maximum(st.budget - fanout, 0),
+                               st.budget)
         st = st._replace(budget=new_budget)
-        return _merge(st, inc_key, confirm, p)
+        return _merge(st, inc_key, confirm, p, st.lh)
 
-    for i, sk in enumerate(jax.random.split(k_gossip, ticks)):
-        st = gossip_slot(sk, st)
+    # ticks are identical programs — scan keeps the traced graph one
+    # tick deep (5x faster compiles at n=2-4k; same keys, same result)
+    st, _ = jax.lax.scan(lambda s, sk: (gossip_slot(sk, s), None),
+                         st, jax.random.split(k_gossip, ticks))
 
     # -- push/pull anti-entropy (every push_pull_rounds) ----------------
     pp_every = max(1, int(30.0 / p.probe_interval))  # ~30s like memberlist
@@ -265,7 +397,7 @@ def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
                 num_segments=p.n)
             pushed = jnp.where(pushed < -1, -1, pushed)
             return _merge(st, jnp.maximum(pulled, pushed),
-                          jnp.zeros((p.n, p.n), bool), p)
+                          jnp.zeros((p.n, p.n), bool), p, st.lh)
 
         partner = _pick(k_alive, (st.status != DEAD) & ~eye)
         ok = st.up & st.up[partner] & \
@@ -312,6 +444,34 @@ def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
                   st.budget[jnp.arange(n), jnp.arange(n)]))
     st = st._replace(self_inc=new_self_inc, status=status, inc=inc,
                      budget=budget)
+    if p.lifeguard:  # refuting own suspicion is a health ding (+1)
+        st = st._replace(lh=jnp.clip(
+            st.lh.astype(jnp.int32) + refute.astype(jnp.int32), 0,
+            p.awareness_max).astype(jnp.int8))
+
+    # -- cumulative detector statistics ---------------------------------
+    if p.collect_stats:
+        post_susp, post_dead = _col_flags(st, eye)
+        new_susp = post_susp & ~pre_susp
+        new_dead = post_dead & ~pre_dead
+        fp_new = new_dead & st.up
+        tp_new = new_dead & ~st.up
+        s = st.stats
+        st = st._replace(stats=s._replace(
+            susp_incidents=s.susp_incidents
+            + new_susp.sum(dtype=jnp.int32),
+            fp_incidents=s.fp_incidents + fp_new.sum(dtype=jnp.int32),
+            deaths_declared=s.deaths_declared
+            + tp_new.sum(dtype=jnp.int32),
+            detect_latency_rounds=s.detect_latency_rounds + jnp.where(
+                tp_new, st.round + 1 - st.down_round, 0
+            ).sum(dtype=jnp.int32),
+            refutes=s.refutes + refute.sum(dtype=jnp.int32),
+            pair_susp_starts=s.pair_susp_starts + (
+                (st.status == SUSPECT) & (pre_status != SUSPECT)
+                & st.up[:, None]).sum(dtype=jnp.int32),
+            pair_fp_declares=s.pair_fp_declares
+            + (expired & st.up[None, :]).sum(dtype=jnp.int32)))
 
     return st._replace(round=st.round + 1)
 
@@ -364,6 +524,25 @@ def view_metrics(st: ViewState) -> dict:
     }
 
 
+def view_rates(st: ViewState, p: SimParams, rounds: int) -> dict:
+    """Cumulative counters → per-node-round rates and latency, in the
+    units the mean-field tier's fd_report uses (subject-level incidents;
+    latency in virtual seconds)."""
+    s = jax.device_get(st.stats)
+    nr = p.n * rounds
+    deaths = max(int(s.deaths_declared), 1)
+    return {
+        "susp_rate": int(s.susp_incidents) / nr,
+        "fp_rate": int(s.fp_incidents) / nr,
+        "deaths_declared": int(s.deaths_declared),
+        "mean_detect_latency_s": int(s.detect_latency_rounds)
+        / deaths * p.probe_interval,
+        "refute_rate": int(s.refutes) / nr,
+        "pair_susp_rate": int(s.pair_susp_starts) / nr,
+        "pair_fp_rate": int(s.pair_fp_declares) / nr,
+    }
+
+
 def partition_reach(n: int, split: int) -> jnp.ndarray:
     """reach matrix for a clean partition: [0, split) ⇹ [split, n)."""
     left = jnp.arange(n) < split
@@ -408,7 +587,7 @@ def make_sharded_views_round(p: SimParams, mesh):
     Returns (round_fn, init_fn); round_fn(state, key) is jit-compiled
     over the mesh, state lives sharded P("viewers", None).
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = p.n
@@ -420,9 +599,10 @@ def make_sharded_views_round(p: SimParams, mesh):
     row = NamedSharding(mesh, P("viewers"))
     rep = NamedSharding(mesh, P())
     state_sharding = ViewState(
-        up=rep, down_round=rep, self_inc=rep,
+        up=rep, down_round=rep, self_inc=rep, slow=rep, lh=row,
         status=row, inc=row, susp_start=row, susp_deadline=row,
-        susp_conf=row, budget=row, reach=row, round=rep)
+        susp_conf=row, budget=row, reach=row, round=rep,
+        stats=ViewStats(*([rep] * len(ViewStats._fields))))
 
     def local_round(st: ViewState, key: jax.Array) -> ViewState:
         """Per-device body. Local blocks are [nl, n]; global vectors
@@ -430,11 +610,26 @@ def make_sharded_views_round(p: SimParams, mesh):
         shard = jax.lax.axis_index("viewers")
         gidx = shard * nl + jnp.arange(nl)  # global viewer ids
         local_eye = gidx[:, None] == eye_cols[None, :]
-        # crash injection uses the UN-folded key: up/down_round are
-        # replicated, so every shard must draw the identical crashes
-        k_crash, key = jax.random.split(key)
+        # crash/slow injection uses UN-folded keys: up/down_round/slow
+        # are replicated, so every shard must draw identical churn
+        k_crash, k_slow, key = jax.random.split(key, 3)
         k_pick, k_ack, k_gossip, k_pp = jax.random.split(
             jax.random.fold_in(key, shard), 4)
+
+        def col_flags(st):
+            # cross-shard column aggregate: any LIVE viewer holds
+            # SUSPECT/DEAD about subject j (psum of local partials)
+            live_v = st.up[gidx][:, None] & ~local_eye
+            ls = (live_v & (st.status == SUSPECT)).sum(
+                axis=0, dtype=jnp.int32)
+            ld = (live_v & (st.status == DEAD)).sum(
+                axis=0, dtype=jnp.int32)
+            both = jax.lax.psum(jnp.stack([ls, ld]), "viewers")
+            return both[0] > 0, both[1] > 0
+
+        if p.collect_stats:
+            pre_susp, pre_dead = col_flags(st)
+            pre_status = st.status
 
         if p.fail_per_round > 0.0:
             crash = st.up & (jax.random.uniform(k_crash, (n,))
@@ -443,13 +638,19 @@ def make_sharded_views_round(p: SimParams, mesh):
                 up=st.up & ~crash,
                 down_round=jnp.where(crash, st.round, st.down_round))
 
+        if p.slow_per_round > 0.0:
+            u_s = jax.random.uniform(k_slow, (n,))
+            st = st._replace(slow=jnp.where(
+                st.slow, u_s >= p.slow_recover_per_round,
+                u_s < p.slow_per_round) & st.up)
+
         up_l = st.up[gidx]  # this shard's viewers' own liveness
 
         def merge(st, inc_key, confirm_src):
             # _merge is shape-agnostic (elementwise + the replicated
             # round scalar), so the [nl, n] local blocks reuse the
             # single-device implementation verbatim — one copy to fix
-            return _merge(st, inc_key, confirm_src, p)
+            return _merge(st, inc_key, confirm_src, p, st.lh)
 
         # -- probe (viewer-local) ---------------------------------------
         view_alive = (st.status == ALIVE) & ~local_eye
@@ -458,11 +659,24 @@ def make_sharded_views_round(p: SimParams, mesh):
         t_up = st.up[target]
         t_reach = jnp.take_along_axis(st.reach, target[:, None],
                                       axis=1)[:, 0]
-        p_relay_all = (1.0 - p.p_relay) ** p.indirect_checks
-        p_noack = (1.0 - p.p_direct) * p_relay_all * (1.0 - p.p_tcp)
+        g = jnp.where(st.slow, p.slow_factor, 1.0)  # replicated [n]
+        live_frac = st.up.mean()
+        sbar = (st.slow & st.up).sum() / jnp.maximum(st.up.sum(), 1)
+        if p.lifeguard and p.slow_per_round:
+            pi = 1.0 - jnp.exp2(-st.lh.astype(jnp.float32))  # [nl]
+        else:
+            pi = jnp.zeros((nl,), jnp.float32)
+        p_noack = _p_noack_pair(g[gidx], g[target], pi, sbar,
+                                live_frac, p)
         acked = t_up & t_reach & \
             (jax.random.uniform(k_ack, (nl,)) > p_noack)
         suspect_it = up_l & has_target & ~acked
+        if p.lifeguard:
+            delta = jnp.where(up_l & has_target,
+                              jnp.where(acked, -1, 1), 0)
+            st = st._replace(lh=jnp.clip(
+                st.lh.astype(jnp.int32) + delta, 0,
+                p.awareness_max).astype(jnp.int8))
         t_inc = jnp.take_along_axis(st.inc, target[:, None],
                                     axis=1)[:, 0]
         sus_key = jnp.full((nl, n), -1, jnp.int32)
@@ -471,21 +685,31 @@ def make_sharded_views_round(p: SimParams, mesh):
         st = merge(st, sus_key, jnp.zeros((nl, n), bool))
 
         # -- gossip: partial segment_max + pmax all-reduce --------------
+        # gossip_nodes receivers per tick per sender, batched into ONE
+        # partial segment_max + all-reduce per tick (fewer collectives)
         ticks = int(p.gossip_ticks_per_round)
+        fanout = int(p.gossip_nodes)
 
         def gossip_slot(slot_key, st):
-            kk_pick, kk_loss = jax.random.split(slot_key)
             gmask = (st.status != DEAD) & ~local_eye
-            recv = _pick(kk_pick, gmask)  # GLOBAL receiver ids
             sendable = up_l & gmask.any(axis=1)
-            delivered = sendable & st.up[recv] & \
-                st.reach[jnp.arange(nl), recv] & \
-                (jax.random.uniform(kk_loss, (nl,)) > p.loss)
             hot = st.budget > 0
-            sent_key = jnp.where(hot & delivered[:, None],
-                                 _key(st.status, st.inc), -1)
-            partial = jax.ops.segment_max(sent_key, recv,
-                                          num_segments=n)
+            full_key = _key(st.status, st.inc)
+            recvs, sents = [], []
+            for fk in jax.random.split(slot_key, fanout):
+                kk_pick, kk_loss, kk_recv = jax.random.split(fk, 3)
+                recv = _pick(kk_pick, gmask)  # GLOBAL receiver ids
+                g_recv = jnp.where(st.slow[recv], p.slow_factor, 1.0)
+                delivered = sendable & st.up[recv] & \
+                    st.reach[jnp.arange(nl), recv] & \
+                    (jax.random.uniform(kk_loss, (nl,)) > p.loss) & \
+                    (jax.random.uniform(kk_recv, (nl,)) < g_recv)
+                recvs.append(recv)
+                sents.append(jnp.where(hot & delivered[:, None],
+                                       full_key, -1))
+            partial = jax.ops.segment_max(
+                jnp.concatenate(sents, axis=0),
+                jnp.concatenate(recvs), num_segments=n)
             partial = jnp.where(partial < -1, -1, partial)
             # the all-reduce IS the packet exchange: senders on every
             # device may address receivers on any device
@@ -493,12 +717,13 @@ def make_sharded_views_round(p: SimParams, mesh):
             inc_key = jax.lax.dynamic_slice_in_dim(
                 global_max, shard * nl, nl, axis=0)
             new_budget = jnp.where(hot & sendable[:, None],
-                                   st.budget - 1, st.budget)
+                                   jnp.maximum(st.budget - fanout, 0),
+                                   st.budget)
             st = st._replace(budget=new_budget)
             return merge(st, inc_key, inc_key >= 0)
 
-        for sk in jax.random.split(k_gossip, ticks):
-            st = gossip_slot(sk, st)
+        st, _ = jax.lax.scan(lambda s, sk: (gossip_slot(sk, s), None),
+                             st, jax.random.split(k_gossip, ticks))
 
         # -- push/pull + reconnect (all_gather full-state sync) ---------
         pp_every = max(1, int(30.0 / p.probe_interval))
@@ -566,20 +791,58 @@ def make_sharded_views_round(p: SimParams, mesh):
         delta = jnp.zeros((n,), jnp.int32).at[gidx].set(
             new_inc_l - st.self_inc[gidx])
         self_inc = st.self_inc + jax.lax.psum(delta, "viewers")
-        return st._replace(status=status, inc=inc, budget=budget,
-                           self_inc=self_inc, round=st.round + 1)
+        st = st._replace(status=status, inc=inc, budget=budget,
+                         self_inc=self_inc)
+        if p.lifeguard:  # refuting own suspicion: health +1
+            st = st._replace(lh=jnp.clip(
+                st.lh.astype(jnp.int32) + refute.astype(jnp.int32), 0,
+                p.awareness_max).astype(jnp.int8))
+
+        # -- cumulative detector statistics (replicated scalars) --------
+        if p.collect_stats:
+            post_susp, post_dead = col_flags(st)
+            new_susp = post_susp & ~pre_susp
+            new_dead = post_dead & ~pre_dead
+            fp_new = new_dead & st.up
+            tp_new = new_dead & ~st.up
+            # pair-level/refute partials are local to this shard's
+            # viewer rows; one psum replicates the scalar sums
+            local3 = jnp.stack([
+                refute.sum(dtype=jnp.int32),
+                ((st.status == SUSPECT) & (pre_status != SUSPECT)
+                 & up_l[:, None]).sum(dtype=jnp.int32),
+                (expired & st.up[None, :]).sum(dtype=jnp.int32)])
+            ref_n, pss_n, pfd_n = jax.lax.psum(local3, "viewers")
+            s = st.stats
+            st = st._replace(stats=s._replace(
+                susp_incidents=s.susp_incidents
+                + new_susp.sum(dtype=jnp.int32),
+                fp_incidents=s.fp_incidents
+                + fp_new.sum(dtype=jnp.int32),
+                deaths_declared=s.deaths_declared
+                + tp_new.sum(dtype=jnp.int32),
+                detect_latency_rounds=s.detect_latency_rounds
+                + jnp.where(tp_new, st.round + 1 - st.down_round, 0
+                            ).sum(dtype=jnp.int32),
+                refutes=s.refutes + ref_n,
+                pair_susp_starts=s.pair_susp_starts + pss_n,
+                pair_fp_declares=s.pair_fp_declares + pfd_n))
+
+        return st._replace(round=st.round + 1)
 
     spec_state = ViewState(
-        up=P(), down_round=P(), self_inc=P(),
+        up=P(), down_round=P(), self_inc=P(), slow=P(),
+        lh=P("viewers"),
         status=P("viewers"), inc=P("viewers"),
         susp_start=P("viewers"), susp_deadline=P("viewers"),
         susp_conf=P("viewers"), budget=P("viewers"),
-        reach=P("viewers"), round=P())
+        reach=P("viewers"), round=P(),
+        stats=ViewStats(*([P()] * len(ViewStats._fields))))
 
     smapped = shard_map(
         local_round, mesh=mesh,
         in_specs=(spec_state, P()),
-        out_specs=spec_state, check_rep=False)
+        out_specs=spec_state, check_vma=False)
     round_fn = jax.jit(smapped)
 
     def init_fn() -> ViewState:

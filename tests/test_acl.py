@@ -243,3 +243,199 @@ def test_acl_roles_bundle_policies(acl_agent, root):
     acl_agent.server.acl.invalidate()
     with pytest.raises(APIError, match="Permission denied"):
         c.kv_put("ops/b", b"1")
+
+
+# ------------------------- token expiration + down-policy (round 3)
+
+def test_token_expiration_ttl_and_reaper(acl_agent, root):
+    """structs/acl.go:334-349: ExpirationTTL at create → absolute
+    ExpirationTime; an expired token denies (lazily, before the reaper
+    runs) and the leader's reaper then deletes it from the table."""
+    pol = root.put("/v1/acl/policy", body={
+        "Name": "exp-rw",
+        "Rules": '{"key_prefix": {"exp/": {"policy": "write"}}}'})
+    tok = root.put("/v1/acl/token", body={
+        "Description": "short-lived",
+        "Policies": [{"ID": pol["ID"]}],
+        "ExpirationTTL": "1s"})
+    assert tok.get("ExpirationTime"), "TTL not converted to ExpirationTime"
+    c = ConsulClient(acl_agent.http.addr, token=tok["SecretID"])
+    assert c.kv_put("exp/x", b"1") is True  # valid while fresh
+    time.sleep(1.2)
+    with pytest.raises(APIError, match="Permission denied"):
+        c.kv_put("exp/y", b"2")  # expired → anonymous → deny
+    # token/self reports it gone
+    with pytest.raises(APIError):
+        c.get("/v1/acl/token/self")
+    # the reaper (leader tick, 1s) deletes the row durably
+    t0 = time.time()
+    while time.time() - t0 < 10 and acl_agent.server.state.raw_get(
+            "acl_tokens", tok["SecretID"]) is not None:
+        time.sleep(0.2)
+    assert acl_agent.server.state.raw_get(
+        "acl_tokens", tok["SecretID"]) is None, "reaper never fired"
+
+
+def test_token_expiration_immutable_on_update(acl_agent, root):
+    tok = root.put("/v1/acl/token", body={
+        "Description": "fixed-exp", "ExpirationTTL": "3600s"})
+    exp = tok["ExpirationTime"]
+    upd = root.put("/v1/acl/token", body={
+        "AccessorID": tok["AccessorID"],
+        "Description": "renamed", "ExpirationTTL": "1s"})
+    assert upd["ExpirationTime"] == exp, \
+        "expiration must be immutable once set"
+
+
+class _FakeState:
+    """Minimal state-store stand-in for resolver unit tests."""
+
+    def __init__(self):
+        self.tokens = {}
+        self.gets = 0
+
+    def raw_get(self, table, key):
+        if table == "acl_tokens":
+            self.gets += 1
+            return self.tokens.get(key)
+        return None
+
+    def raw_list(self, table):
+        return []
+
+
+def test_resolver_expired_token_is_anonymous():
+    from consul_tpu.acl.resolver import ACLResolver
+
+    st = _FakeState()
+    st.tokens["sec"] = {"SecretID": "sec", "Management": True,
+                       "ExpirationTime": time.time() - 1}
+    r = ACLResolver(st, enabled=True, default_policy="deny")
+    assert not r.resolve("sec").key_read("x")
+
+
+def test_resolver_expiry_honored_on_cache_hit():
+    from consul_tpu.acl.resolver import ACLResolver
+
+    st = _FakeState()
+    st.tokens["sec"] = {"SecretID": "sec", "Management": True,
+                       "ExpirationTime": time.time() + 0.4}
+    r = ACLResolver(st, enabled=True, default_policy="deny",
+                    token_ttl=300.0)  # cache would outlive the token
+    assert r.resolve("sec").key_write("x")
+    time.sleep(0.5)
+    assert not r.resolve("sec").key_write("x"), \
+        "cached authorizer served past the token's expiry"
+
+
+def test_resolver_negative_caching_bounds_store_load():
+    from consul_tpu.acl.resolver import ACLResolver
+
+    st = _FakeState()
+    r = ACLResolver(st, enabled=True, default_policy="deny")
+    for _ in range(50):
+        r.resolve("bogus-secret")
+    assert st.gets == 1, \
+        f"unknown token hit the store {st.gets} times (no negative cache)"
+
+
+def test_resolver_down_policy_modes():
+    """config.go:546-548 ACLDownPolicy: with the primary unreachable,
+    extend-cache serves the stale cached authorizer, deny refuses,
+    allow admits; an uncached secret under extend-cache degrades to
+    anonymous."""
+    from consul_tpu.acl.resolver import (ACLRemoteError, ACLResolver,
+                                         PermissionDeniedError)
+
+    st = _FakeState()  # local replica has no tokens
+    calls = {"n": 0, "down": False}
+
+    def remote(secret):
+        calls["n"] += 1
+        if calls["down"]:
+            raise ACLRemoteError("primary unreachable")
+        return {"SecretID": secret, "Management": True}
+
+    r = ACLResolver(st, enabled=True, default_policy="deny",
+                    token_ttl=0.05, down_policy="extend-cache",
+                    remote_resolve=remote)
+    assert r.resolve("remote-sec").key_write("x")  # resolved via primary
+    calls["down"] = True
+    time.sleep(0.1)  # cache entry now stale → must consult primary
+    assert r.resolve("remote-sec").key_write("x"), \
+        "extend-cache did not extend the stale authorizer"
+    # an uncached secret during the outage: anonymous (default deny)
+    assert not r.resolve("never-seen").key_read("x")
+
+    r.down_policy = "deny"
+    with pytest.raises(PermissionDeniedError):
+        r.resolve("other-sec")
+
+    r.down_policy = "allow"
+    assert r.resolve("third-sec").key_write("x")
+
+
+def test_secondary_dc_resolves_via_primary_with_down_policy():
+    """Two-DC integration: with token replication OFF (the reference
+    default), a secondary resolves a primary-minted secret through the
+    primary; when the primary dies, extend-cache keeps the cached
+    authorizer serving and unknown secrets stay denied."""
+    from consul_tpu.config import load as _load
+    from helpers import wait_for
+
+    acl = {"enabled": True, "default_policy": "deny",
+           "token_ttl": 1.0,
+           "tokens": {"initial_management": "root-sec",
+                      "agent": "root-sec",
+                      "replication": "root-sec"}}
+    a1 = Agent(_load(dev=True, overrides={
+        "node_name": "pri-dp", "datacenter": "dc1",
+        "primary_datacenter": "dc1", "acl": acl}))
+    a2 = Agent(_load(dev=True, overrides={
+        "node_name": "sec-dp", "datacenter": "dc2",
+        "primary_datacenter": "dc1", "acl": acl}))
+    a1.start(serve_dns=False)
+    a2.start(serve_dns=False)
+    try:
+        wait_for(lambda: a1.server.is_leader()
+                 and a2.server.is_leader(), what="leaders")
+        wait_for(lambda: a1.server.state.raw_get(
+            "acl_tokens", "root-sec") is not None, what="mgmt token")
+        assert a1.server.join_wan(
+            [a2.server.serf_wan.memberlist.transport.addr]) == 1
+        wait_for(lambda: len(a2.server.wan_members()) == 2,
+                 what="wan convergence")
+        c1 = ConsulClient(a1.http.addr, token="root-sec")
+        pol = c1.put("/v1/acl/policy", body={
+            "Name": "dp-rw",
+            "Rules": '{"key_prefix": {"dp/": {"policy": "write"}}}'})
+        tok = c1.put("/v1/acl/token", body={
+            "Description": "primary-minted",
+            "Policies": [{"ID": pol["ID"]}]})
+        # policies replicate; the token itself must NOT (replication off)
+        wait_for(lambda: a2.server.state.raw_get(
+            "acl_policies", pol["ID"]) is not None, timeout=20.0,
+            what="policy replicated")
+        assert a2.server.state.raw_get(
+            "acl_tokens", tok["SecretID"]) is None, \
+            "token replicated despite enable_token_replication=false"
+        # the secondary resolves the secret THROUGH the primary
+        c2 = ConsulClient(a2.http.addr, token=tok["SecretID"])
+        assert c2.kv_put("dp/x", b"1") is True
+        # primary dies; cached authorizer goes stale after token_ttl=1s
+        a1.shutdown()
+        time.sleep(1.5)
+        assert c2.kv_put("dp/y", b"2") is True, \
+            "extend-cache did not keep the authorizer serving"
+        # unknown secrets stay anonymous → denied under default deny
+        c_bogus = ConsulClient(a2.http.addr, token="no-such-secret")
+        with pytest.raises(APIError, match="Permission denied"):
+            c_bogus.kv_put("dp/z", b"3")
+        # and flipping to down_policy=deny refuses even the cached one
+        a2.server.acl.down_policy = "deny"
+        time.sleep(1.1)  # let the cache go stale again
+        with pytest.raises(APIError, match="Permission denied"):
+            c2.kv_put("dp/w", b"4")
+    finally:
+        a1.shutdown()
+        a2.shutdown()

