@@ -118,6 +118,12 @@ class ACLResolver:
         if dp == "allow":
             return Authorizer([], default_level=WRITE)
         if dp in ("extend-cache", "async-cache") and hit is not None:
+            # even an extended-cache identity must not outlive its own
+            # ExpirationTime (acl.go:960 checks identity.IsExpired for
+            # cached identities too) — an expired token keeping its
+            # permissions for a whole primary outage would be a hole
+            if hit[2] is not None and time.time() >= hit[2]:
+                return Authorizer([], default_level=self.default_level)
             self.log.debug("ACL source down; extending cached "
                            "authorizer for %s...", secret_id[:8])
             return hit[1]

@@ -558,18 +558,26 @@ def register_endpoints(srv) -> None:
     def acl_token_set(args):
         require(authz(args).acl_write(), "acl write")
         tok = dict(args.get("Token") or {})
-        if "SecretID" not in tok and tok.get("AccessorID"):
+        existing = None
+        if tok.get("SecretID"):
+            existing = srv.state.raw_get("acl_tokens", tok["SecretID"])
+        elif tok.get("AccessorID"):
             # update-by-accessor REPLACES the existing token (the table is
             # keyed by SecretID — minting a new secret would leave the old
             # one valid forever, breaking revocation)
             existing = _find_token(tok["AccessorID"])
-            if existing is not None:
-                tok["SecretID"] = existing["SecretID"]
-                # expiration is immutable after create (structs/acl.go
-                # ExpirationTime "cannot be changed once set")
-                if existing.get("ExpirationTime"):
-                    tok["ExpirationTime"] = existing["ExpirationTime"]
-                    tok.pop("ExpirationTTL", None)
+        if existing is not None:  # an UPDATE, however it was addressed
+            tok["SecretID"] = existing["SecretID"]
+            # expiration is immutable after create (structs/acl.go
+            # ExpirationTime "cannot be changed once set") — a TTL on
+            # ANY update is rejected outright, even for a token that
+            # never expired (acl_endpoint.go "Cannot change expiration
+            # time"), and the minted ExpirationTime is carried over
+            if tok.get("ExpirationTTL"):
+                raise RPCError(
+                    "Cannot change expiration time of a token")
+            if existing.get("ExpirationTime"):
+                tok["ExpirationTime"] = existing["ExpirationTime"]
         tok.setdefault("SecretID", str(uuid.uuid4()))
         tok.setdefault("AccessorID", str(uuid.uuid4()))
         ttl = tok.pop("ExpirationTTL", None)

@@ -524,10 +524,19 @@ class Server:
             raise RPCError("not leader")
         return self.raft.apply(encode_command(msg_type, body))
 
-    def _forward_to_leader(self, method: str, args: dict[str, Any],
-                           retries: int = 5) -> Any:
+    def _forward_to_leader(self, method: str,
+                           args: dict[str, Any]) -> Any:
+        """Retry with a deadline scaled to the election timeout, not a
+        fixed count: a leadership race can legitimately take a full
+        randomized election round (up to 2x election_timeout) plus
+        scheduling noise on a loaded host, and the reference holds
+        forwarded RPCs for RPCHoldTimeout=7s for exactly this reason
+        (consul/rpc.go forward() + config RPCHoldTimeout). A fixed
+        5x0.2s=1s budget flaked twice under parallel test load."""
+        hold = max(7.0, 6.0 * self.raft.election_timeout)
+        deadline = time.monotonic() + hold
         last: Exception = NoLeaderError("no known leader")
-        for _ in range(retries):
+        while True:
             if self.is_leader():
                 # leadership arrived mid-retry — serve locally
                 return self.handle_rpc(method, args, "local")
@@ -544,7 +553,9 @@ class Server:
                     if "not leader" not in str(e):
                         raise
                     last = e
-            time.sleep(0.2)
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(min(0.1, max(0.0, deadline - time.monotonic())))
         raise NoLeaderError(f"failed to reach leader: {last}")
 
     # --------------------------------------------------- blocking queries

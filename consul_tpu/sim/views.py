@@ -343,12 +343,16 @@ def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
     def gossip_slot(slot_key, st: ViewState) -> ViewState:
         gmask = (st.status != DEAD) & ~eye
         sendable = st.up & gmask.any(axis=1)
-        hot = st.budget > 0
         full_key = _key(st.status, st.inc)
         recvs, sents = [], []
-        for fk in jax.random.split(slot_key, fanout):
+        for k, fk in enumerate(jax.random.split(slot_key, fanout)):
             kk_pick, kk_loss, kk_recv = jax.random.split(fk, 3)
             recv = _pick(kk_pick, gmask)
+            # the k-th fanout send only happens with >k credits left —
+            # TransmitLimitedQueue stops mid-fanout when the budget runs
+            # out, so a sender with 1 credit transmits once, not fanout
+            # times (it never overspends)
+            hot = st.budget > k
             # a slow receiver processes the packet on time only with
             # probability slow_factor (the mean-field tier's g-scaled
             # hearing rate — what delays slow nodes' refutations)
@@ -368,8 +372,9 @@ def views_round(st: ViewState, key: jax.Array, p: SimParams) -> ViewState:
         confirm = inc_key >= 0
         # the budget is charged on SEND, delivered or not —
         # memberlist's TransmitLimitedQueue counts transmissions, so
-        # lost packets are not free retries
-        new_budget = jnp.where(hot & sendable[:, None],
+        # lost packets are not free retries; a sender makes
+        # min(budget, fanout) sends, so the charge saturates at 0
+        new_budget = jnp.where(sendable[:, None],
                                jnp.maximum(st.budget - fanout, 0),
                                st.budget)
         st = st._replace(budget=new_budget)
@@ -693,12 +698,15 @@ def make_sharded_views_round(p: SimParams, mesh):
         def gossip_slot(slot_key, st):
             gmask = (st.status != DEAD) & ~local_eye
             sendable = up_l & gmask.any(axis=1)
-            hot = st.budget > 0
             full_key = _key(st.status, st.inc)
             recvs, sents = [], []
-            for fk in jax.random.split(slot_key, fanout):
+            for k, fk in enumerate(jax.random.split(slot_key, fanout)):
                 kk_pick, kk_loss, kk_recv = jax.random.split(fk, 3)
                 recv = _pick(kk_pick, gmask)  # GLOBAL receiver ids
+                # same per-credit gating as the dense tier: the k-th
+                # fanout send needs >k credits (TransmitLimitedQueue
+                # stops mid-fanout; no overspend)
+                hot = st.budget > k
                 g_recv = jnp.where(st.slow[recv], p.slow_factor, 1.0)
                 delivered = sendable & st.up[recv] & \
                     st.reach[jnp.arange(nl), recv] & \
@@ -716,7 +724,7 @@ def make_sharded_views_round(p: SimParams, mesh):
             global_max = jax.lax.pmax(partial, "viewers")
             inc_key = jax.lax.dynamic_slice_in_dim(
                 global_max, shard * nl, nl, axis=0)
-            new_budget = jnp.where(hot & sendable[:, None],
+            new_budget = jnp.where(sendable[:, None],
                                    jnp.maximum(st.budget - fanout, 0),
                                    st.budget)
             st = st._replace(budget=new_budget)

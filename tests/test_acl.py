@@ -280,11 +280,24 @@ def test_token_expiration_immutable_on_update(acl_agent, root):
     tok = root.put("/v1/acl/token", body={
         "Description": "fixed-exp", "ExpirationTTL": "3600s"})
     exp = tok["ExpirationTime"]
+    # a TTL on ANY update is rejected outright (acl_endpoint.go
+    # "Cannot change expiration time") — even re-sending one
+    with pytest.raises(APIError, match="expiration"):
+        root.put("/v1/acl/token", body={
+            "AccessorID": tok["AccessorID"],
+            "Description": "renamed", "ExpirationTTL": "1s"})
+    # the update-by-SecretID path enforces the same immutability
+    with pytest.raises(APIError, match="expiration"):
+        root.put("/v1/acl/token", body={
+            "SecretID": tok["SecretID"], "ExpirationTTL": "1s"})
+    # a plain update keeps the minted expiration — by accessor or secret
     upd = root.put("/v1/acl/token", body={
-        "AccessorID": tok["AccessorID"],
-        "Description": "renamed", "ExpirationTTL": "1s"})
+        "AccessorID": tok["AccessorID"], "Description": "renamed"})
     assert upd["ExpirationTime"] == exp, \
         "expiration must be immutable once set"
+    upd = root.put("/v1/acl/token", body={
+        "SecretID": tok["SecretID"], "Description": "renamed2"})
+    assert upd["ExpirationTime"] == exp
 
 
 class _FakeState:
@@ -373,6 +386,38 @@ def test_resolver_down_policy_modes():
 
     r.down_policy = "allow"
     assert r.resolve("third-sec").key_write("x")
+
+
+def test_resolver_down_policy_expired_token_not_extended():
+    """acl.go:960 — even an extend-cache identity is expiry-checked: a
+    token that expires DURING a primary outage must not keep its
+    permissions for the rest of the outage."""
+    from consul_tpu.acl.resolver import ACLRemoteError, ACLResolver
+
+    st = _FakeState()
+    calls = {"down": False}
+
+    exp_at = {"t": 0.0}
+
+    def remote(secret):
+        if calls["down"]:
+            raise ACLRemoteError("primary unreachable")
+        exp_at["t"] = time.time() + 2.0
+        return {"SecretID": secret, "Management": True,
+                "ExpirationTime": exp_at["t"]}
+
+    r = ACLResolver(st, enabled=True, default_policy="deny",
+                    token_ttl=0.05, down_policy="extend-cache",
+                    remote_resolve=remote)
+    assert r.resolve("sec").key_write("x")
+    calls["down"] = True
+    time.sleep(0.1)  # cache stale, token still live: extended
+    if time.time() < exp_at["t"] - 0.5:  # guard against a loaded host
+        assert r.resolve("sec").key_write("x")
+    while time.time() < exp_at["t"]:
+        time.sleep(0.05)  # token itself now expired: extension stops
+    assert not r.resolve("sec").key_write("x"), \
+        "expired token kept its permissions under extend-cache"
 
 
 def test_secondary_dc_resolves_via_primary_with_down_policy():

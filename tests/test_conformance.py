@@ -24,6 +24,7 @@ tests pin down.
 from dataclasses import replace
 
 import jax
+import pytest
 from consul_tpu.config import GossipConfig
 from consul_tpu.gossip import InMemNetwork, Serf
 from consul_tpu.sim import SimParams, init_state, run_rounds
@@ -383,6 +384,7 @@ def _assert_fp_criterion(mfr, vwr):
         f"{vwr['fp']:.4e} — the underestimate bound is broken"
 
 
+@pytest.mark.slow
 def test_views_mf_n2048_loss10():
     """Nominal operating regime: subject-level suspicion and refutation
     rates agree within 1.5x (measured ratio 1.01)."""
@@ -392,6 +394,7 @@ def test_views_mf_n2048_loss10():
     _assert_fp_criterion(mfr, vwr)
 
 
+@pytest.mark.slow
 def test_views_mf_n2048_loss30():
     """30% loss: both detectors run hot; episode rates agree within 2x
     (measured 0.96x susp, 1.4x refutes)."""
@@ -404,6 +407,7 @@ def test_views_mf_n2048_loss30():
     assert 0 < vwr["fp"] < 1e-3
 
 
+@pytest.mark.slow
 def test_views_mf_n2048_loss45_stress():
     """45% loss (pathological stress): views columns saturate so
     episode counts diverge by design — the refutation rate is the
@@ -415,6 +419,7 @@ def test_views_mf_n2048_loss45_stress():
     assert mfr["susp"] > 5e-2 and vwr["ref"] > 5e-2, "detector not hot"
 
 
+@pytest.mark.slow
 def test_views_mf_n2048_churn_detection():
     """Churn config (crashes at 0.05%/round): suspicion rate, mean
     detection latency, and death declarations agree within 1.5x
@@ -427,6 +432,7 @@ def test_views_mf_n2048_churn_detection():
     _assert_fp_criterion(mfr, vwr)
 
 
+@pytest.mark.slow
 def test_views_mf_n4096_scale_stability():
     """Same agreement holds at n=4096 (~130MB of exact view state),
     and the mean-field rate itself is scale-stable 2048→4096."""
@@ -436,6 +442,7 @@ def test_views_mf_n4096_scale_stability():
     _assert_ratio(mfr4["susp"], mfr2["susp"], 1.3, "scale stability")
 
 
+@pytest.mark.slow
 def test_bench_diag_suspicion_rate_calibration():
     """The 1M bench diagnostic's suspicion stream, explained and pinned
     (VERDICT round-2 weak #2: 'either the slow-node model is
@@ -485,3 +492,15 @@ def test_bench_diag_suspicion_rate_calibration():
                   "views-tier reproduction")
     _assert_ratio(vr["refute_rate"], rates[4096], 1.5,
                   "views refutes track mf suspicions")
+
+
+def test_views_mf_smoke_fast():
+    """Fast default-suite stand-in for the slow at-scale tier: the SAME
+    relative-bound structure (suspicion/refute ratio + one-sided FP
+    criterion) at n=512 x 120 rounds, with bounds loosened to absorb
+    the extra small-n variance. The slow tier (pytest -m slow) pins the
+    tight factors at n=2048-65536."""
+    mfr, vwr = _tier_rates(512, 120, loss=0.10)
+    _assert_ratio(mfr["susp"], vwr["susp"], 2.5, "suspicion rate")
+    _assert_ratio(mfr["ref"], vwr["ref"], 2.5, "refute rate")
+    _assert_fp_criterion(mfr, vwr)
