@@ -46,20 +46,110 @@ def _rbac_filters(intentions: list[dict[str, Any]],
     beats wildcard deny) maps onto an ordered filter PAIR: a DENY
     filter for the explicit denies runs first, then an ALLOW filter
     grants the listed sources when the effective default is deny. A
-    single-action filter cannot express mixed precedence."""
+    single-action filter cannot express mixed precedence.
+
+    A NETWORK filter cannot see HTTP attributes, so a source whose
+    intention carries L7 Permissions is handled conservatively here:
+    it is NOT granted at L4 (its requests are refused) — the HTTP
+    path (_rbac_http_filters, used when the service speaks http)
+    is where Permissions are actually enforced."""
     intentions = intentions or []
     allows = [i["SourceName"] for i in intentions
-              if i.get("Action", "allow") == "allow"]
+              if not i.get("Permissions")
+              and i.get("Action", "allow") == "allow"]
     denies = [i["SourceName"] for i in intentions
-              if i.get("Action") == "deny"]
-    exact_denies = [d for d in denies if d != "*"]
+              if not i.get("Permissions") and i.get("Action") == "deny"]
+    # L7 sources on a tcp listener: unanswerable per-request → deny
+    l7_sources = [i["SourceName"] for i in intentions
+                  if i.get("Permissions")]
+    exact_denies = [d for d in denies + l7_sources if d != "*"]
     filters = []
     if exact_denies:
         filters.append(_rbac("DENY", exact_denies))
     # a wildcard deny flips the effective default: only listed allows
     # (which may include "*") pass
-    if not default_allow or "*" in denies:
+    if not default_allow or "*" in denies or "*" in l7_sources:
         filters.append(_rbac("ALLOW", allows))
+    return filters
+
+
+def _http_rbac(action: str,
+               policies: dict[str, Any]) -> dict[str, Any]:
+    return {
+        "name": "envoy.filters.http.rbac",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions."
+                     "filters.http.rbac.v3.RBAC",
+            "rules": {"action": action, "policies": policies}}}
+
+
+def _rbac_http_filters(intentions: list[dict[str, Any]],
+                       default_allow: bool) -> list[dict[str, Any]]:
+    """HTTP-layer intention enforcement (xds rbac.go
+    makeRBACHTTPFilter): same two-filter precedence structure as the
+    network form, but sources with L7 Permissions get REAL per-request
+    permission lists instead of any/deny. Once a source defines
+    permissions, its unmatched requests are denied (the docs'
+    "permissions default-deny"), which is why in default-allow mode an
+    L7 source contributes NOT(any of its allows) to the DENY filter."""
+    from consul_tpu.connect.intentions import rbac_policy_permissions
+
+    intentions = intentions or []
+    l4_allows = [i["SourceName"] for i in intentions
+                 if not i.get("Permissions")
+                 and i.get("Action", "allow") == "allow"]
+    l4_denies = [i["SourceName"] for i in intentions
+                 if not i.get("Permissions")
+                 and i.get("Action") == "deny"]
+    l7 = [(i["SourceName"], i.get("Permissions") or [])
+          for i in intentions if i.get("Permissions")]
+
+    filters = []
+    deny_policies: dict[str, Any] = {}
+    exact_l4_denies = [d for d in l4_denies if d != "*"]
+    if exact_l4_denies:
+        deny_policies["consul-intentions-layer4-deny"] = {
+            "permissions": [{"any": True}],
+            "principals": [_spiffe_principal(s)
+                           for s in exact_l4_denies]}
+    effective_deny = not default_allow or "*" in l4_denies
+    if not effective_deny:
+        # default-allow: L7 sources are constrained by a DENY policy
+        # matching everything their allow permissions do NOT cover.
+        # A WILDCARD L7 source must not swallow sources that have
+        # their own higher-precedence exact intentions (rbac.go
+        # removeSourcePrecedence folds these in as not_id principals)
+        exact_named = [i["SourceName"] for i in intentions
+                       if i.get("SourceName", "*") != "*"]
+        for n, (src, perms) in enumerate(l7):
+            allows = rbac_policy_permissions(perms)
+            perm = {"not_rule": {"or_rules": {"rules": allows}}} \
+                if allows else {"any": True}
+            principal = _spiffe_principal(src)
+            if src == "*" and exact_named:
+                principal = {"and_ids": {"ids": [principal] + [
+                    {"not_id": _spiffe_principal(t)}
+                    for t in exact_named]}}
+            deny_policies[f"consul-intentions-layer7-{n}"] = {
+                "permissions": [perm],
+                "principals": [principal]}
+    if deny_policies:
+        filters.append(_http_rbac("DENY", deny_policies))
+    if effective_deny:
+        allow_policies: dict[str, Any] = {}
+        if l4_allows:
+            allow_policies["consul-intentions-layer4"] = {
+                "permissions": [{"any": True}],
+                "principals": [_spiffe_principal(s)
+                               for s in l4_allows]}
+        for n, (src, perms) in enumerate(l7):
+            allows = rbac_policy_permissions(perms)
+            if not allows:
+                continue  # only denies: nothing to grant
+            allow_policies[f"consul-intentions-layer7-{n}"] = {
+                "permissions": allows,
+                "principals": [_spiffe_principal(src)]}
+        filters.append(_http_rbac("ALLOW", allow_policies))
     return filters
 
 
@@ -105,6 +195,21 @@ def bootstrap_config(snapshot: dict[str, Any],
             "Address": pub["LocalServiceAddress"],
             "Port": pub["LocalServicePort"]}]),
     }]
+    # protocol http/http2/grpc: the public listener terminates HTTP so
+    # intentions with L7 Permissions are enforced per-request by an
+    # HTTP RBAC filter inside the connection manager (xds rbac.go
+    # makeRBACHTTPFilter); tcp keeps the network RBAC + tcp_proxy pair
+    is_http = snapshot.get("Protocol", "tcp") in ("http", "http2",
+                                                  "grpc")
+    if is_http:
+        inbound = [_public_hcm(
+            snapshot.get("Intentions") or [],
+            snapshot.get("DefaultAllow", True))]
+    else:
+        inbound = _rbac_filters(
+            snapshot.get("Intentions") or [],
+            snapshot.get("DefaultAllow", True)) \
+            + [_tcp_proxy("public_listener", "local_app")]
     listeners = [{
         "name": "public_listener",
         "address": _addr(pub["Address"], pub["Port"]),
@@ -115,10 +220,7 @@ def bootstrap_config(snapshot: dict[str, Any],
                     "@type": "type.googleapis.com/envoy.extensions."
                              "transport_sockets.tls.v3.DownstreamTlsContext",
                     **tls_context}},
-            "filters": _rbac_filters(
-                snapshot.get("Intentions") or [],
-                snapshot.get("DefaultAllow", True))
-            + [_tcp_proxy("public_listener", "local_app")],
+            "filters": inbound,
         }],
     }]
 
@@ -292,6 +394,33 @@ def _tcp_filter(stat_prefix: str, cluster_prefix: str,
                 {"name": f"{cluster_prefix}_{t['Service']}",
                  "weight": int(round(t["Weight"]))}
                 for t in targets]},
+        }}
+
+
+def _public_hcm(intentions: list[dict[str, Any]],
+                default_allow: bool) -> dict[str, Any]:
+    """Inbound HTTP connection manager: RBAC http filters (the L7
+    intention enforcement point) ahead of the router, one catch-all
+    route to the local app (xds listeners.go makeInboundListener)."""
+    return {
+        "name": "envoy.filters.network.http_connection_manager",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters."
+                     "network.http_connection_manager.v3."
+                     "HttpConnectionManager",
+            "stat_prefix": "public_listener",
+            "http_filters": _rbac_http_filters(intentions,
+                                               default_allow) + [{
+                "name": "envoy.filters.http.router",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "filters.http.router.v3.Router"}}],
+            "route_config": {
+                "name": "public_listener",
+                "virtual_hosts": [{
+                    "name": "public_listener", "domains": ["*"],
+                    "routes": [{"match": {"prefix": "/"},
+                                "route": {"cluster": "local_app"}}]}]},
         }}
 
 

@@ -1,15 +1,97 @@
-"""Intentions: the service-to-service allow/deny graph.
+"""Intentions: the service-to-service allow/deny graph, L4 and L7.
 
-Reference: agent/consul/intention_endpoint.go + state/
-config_entry_intention.go. Match semantics: exact source/destination
+Reference: agent/consul/intention_endpoint.go + agent/structs/
+config_entry_intentions.go. Match semantics: exact source/destination
 beats wildcard; among matches the most specific wins; absent any
 intention the ACL default policy decides (deny when ACLs are on in
 deny mode, allow otherwise).
+
+L7 permissions (config_entry_intentions.go:220-243): an intention may
+carry, INSTEAD of its L4 Action, an ordered list of HTTP-attribute
+permissions::
+
+    Permissions: [{Action, HTTP: {PathExact|PathPrefix|PathRegex,
+                                  Methods: [...], Header: [...]}}]
+
+Interpreted in order; in default-deny mode, deny permissions are
+logically subtracted from all FOLLOWING allow permissions, then the
+allows are ORed (the struct's own worked example:
+["deny /v2/admin", "allow /v2/*", "allow GET /healthz"] ==
+allow: [(/v2/* AND NOT /v2/admin), (GET /healthz AND NOT /v2/admin)]).
+A request matching no permission falls through to the opposite of the
+effective default. Enforcement happens in the destination proxy as an
+Envoy HTTP RBAC filter (agent/xds/rbac.go:12-17) — see
+rbac_policy_permissions() which builds exactly that shape.
 """
 
 from __future__ import annotations
 
 from typing import Any, Optional
+
+_HEADER_MATCH_KINDS = ("Present", "Exact", "Prefix", "Suffix",
+                       "Contains", "Regex")
+
+
+def validate_intention(i: dict[str, Any]) -> None:
+    """Apply-time validation (intention_endpoint.go prepareApply +
+    config_entry_intentions.go Validate): Action and Permissions are
+    mutually exclusive; every permission must be enforceable."""
+    perms = i.get("Permissions") or []
+    if perms and i.get("Action"):
+        raise ValueError(
+            "Action and Permissions are mutually exclusive: an "
+            "intention is either an L4 allow/deny or an ordered L7 "
+            "permission list")
+    if i.get("Action") not in (None, "", "allow", "deny"):
+        raise ValueError(f"invalid Action {i.get('Action')!r}")
+    for n, p in enumerate(perms):
+        if p.get("Action") not in ("allow", "deny"):
+            raise ValueError(
+                f"Permissions[{n}]: Action must be allow or deny")
+        http = p.get("HTTP")
+        if http is None:
+            raise ValueError(
+                f"Permissions[{n}]: HTTP match criteria are required")
+        paths = [k for k in ("PathExact", "PathPrefix", "PathRegex")
+                 if http.get(k)]
+        if len(paths) > 1:
+            raise ValueError(
+                f"Permissions[{n}]: PathExact/PathPrefix/PathRegex "
+                "are mutually exclusive")
+        if http.get("PathExact") and not str(
+                http["PathExact"]).startswith("/"):
+            raise ValueError(
+                f"Permissions[{n}]: PathExact must begin with '/'")
+        if http.get("PathPrefix") and not str(
+                http["PathPrefix"]).startswith("/"):
+            raise ValueError(
+                f"Permissions[{n}]: PathPrefix must begin with '/'")
+        for hn, h in enumerate(http.get("Header") or []):
+            if not h.get("Name"):
+                raise ValueError(
+                    f"Permissions[{n}].Header[{hn}]: Name is required")
+            kinds = [k for k in _HEADER_MATCH_KINDS
+                     if h.get(k) not in (None, "", False)]
+            if len(kinds) != 1:
+                raise ValueError(
+                    f"Permissions[{n}].Header[{hn}]: exactly one of "
+                    f"{'/'.join(_HEADER_MATCH_KINDS)} is required")
+        if not paths and not http.get("Header") \
+                and not http.get("Methods"):
+            raise ValueError(
+                f"Permissions[{n}]: at least one of path, Header or "
+                "Methods is required")
+
+
+def precedence(i: dict[str, Any]) -> int:
+    """structs/intention.go:370-391 UpdatePrecedence: DESTINATION
+    specificity sets the band (exact dest = 9, wildcard dest = 6,
+    namespaces always exact in this model), then an inexact source
+    subtracts one: exact→exact 9, *→exact 8, exact→* 6, *→* 5."""
+    src_exact = i.get("SourceName", "*") != "*"
+    dst_exact = i.get("DestinationName", "*") != "*"
+    base = 9 if dst_exact else 6
+    return base - (0 if src_exact else 1)
 
 
 def match_intention(intentions: list[dict[str, Any]], source: str,
@@ -22,22 +104,180 @@ def match_intention(intentions: list[dict[str, Any]], source: str,
         dst = i.get("DestinationName", "*")
         if src not in ("*", source) or dst not in ("*", destination):
             continue
-        score = (src != "*") * 2 + (dst != "*")
+        score = i.get("Precedence") or precedence(i)
         if score > best_score:
             best, best_score = i, score
     return best
 
 
 def authorize(intentions: list[dict[str, Any]], source: str,
-              destination: str, default_allow: bool) -> tuple[bool, str]:
-    """The agent/connect authorize decision (agent_endpoint.go
-    AgentConnectAuthorize)."""
+              destination: str, default_allow: bool,
+              allow_permissions: bool = False) -> tuple[bool, str]:
+    """The L4 authorize decision (state/intention.go
+    IntentionDecision). An intention carrying L7 Permissions cannot be
+    answered at connection level — the answer is `allow_permissions`
+    (False for Intention.Check and the built-in proxy, mirroring
+    intention_endpoint.go:777 AllowPermissions: false; True where the
+    caller only needs "may traffic flow at all", e.g. upstream
+    materialization, because the destination's HTTP RBAC filter is
+    what enforces the per-request answer)."""
     m = match_intention(intentions, source, destination)
     if m is None:
         return (default_allow,
                 "Default behavior configured by ACLs"
                 if not default_allow else "Default allow")
+    if m.get("Permissions"):
+        return (allow_permissions,
+                f"Matched L7 intention: {m.get('SourceName')} => "
+                f"{m.get('DestinationName')} (has Permissions; "
+                "enforced per-request by the destination proxy)")
     allowed = m.get("Action", "allow") == "allow"
     reason = (f"Matched intention: {m.get('SourceName')} => "
               f"{m.get('DestinationName')} ({m.get('Action', 'allow')})")
     return allowed, reason
+
+
+# --------------------------------------------------- L7 request check
+
+def _http_perm_matches(http: dict[str, Any], path: str, method: str,
+                       headers: dict[str, str]) -> bool:
+    import re
+
+    if http.get("PathExact") and path != http["PathExact"]:
+        return False
+    if http.get("PathPrefix") and not path.startswith(
+            http["PathPrefix"]):
+        return False
+    if http.get("PathRegex") and not re.fullmatch(http["PathRegex"],
+                                                  path):
+        # RE2 via Envoy's safe_regex is a FULL-string match — search
+        # semantics here would deny/allow differently than the proxy
+        return False
+    if http.get("Methods") and method.upper() not in [
+            m.upper() for m in http["Methods"]]:
+        return False
+    lower = {k.lower(): v for k, v in (headers or {}).items()}
+    for h in http.get("Header") or []:
+        raw = lower.get(h.get("Name", "").lower())
+        present = raw is not None
+        # ignore_case folds both sides for the string kinds; Envoy's
+        # safe_regex ignores the flag, so Regex stays case-sensitive
+        fold = bool(h.get("IgnoreCase"))
+        val = raw.lower() if (fold and present) else raw
+
+        def want(target):
+            return target.lower() if fold else target
+
+        if h.get("Present"):
+            ok = present
+        elif h.get("Exact") not in (None, ""):
+            ok = present and val == want(h["Exact"])
+        elif h.get("Prefix") not in (None, ""):
+            ok = present and val.startswith(want(h["Prefix"]))
+        elif h.get("Suffix") not in (None, ""):
+            ok = present and val.endswith(want(h["Suffix"]))
+        elif h.get("Contains") not in (None, ""):
+            ok = present and want(h["Contains"]) in val
+        elif h.get("Regex") not in (None, ""):
+            ok = present and re.fullmatch(h["Regex"], raw) is not None
+        else:
+            ok = present
+        if h.get("Invert"):
+            ok = not ok
+        if not ok:
+            return False
+    return True
+
+
+def authorize_l7(permissions: list[dict[str, Any]], path: str,
+                 method: str,
+                 headers: Optional[dict[str, str]] = None
+                 ) -> tuple[bool, str]:
+    """Evaluate an ordered permission list against one HTTP request —
+    the same first-match semantics Envoy's generated RBAC filter
+    enforces (rbac.go), usable by troubleshoot tooling and tests as
+    the reference implementation. A request matching NO permission is
+    denied, regardless of the mesh default (once a source defines L7
+    permissions, unmatched traffic from it is refused)."""
+    for n, p in enumerate(permissions or []):
+        if _http_perm_matches(p.get("HTTP") or {}, path, method,
+                              headers or {}):
+            return (p.get("Action") == "allow",
+                    f"matched Permissions[{n}] ({p.get('Action')})")
+    return False, "no permission matched; deny"
+
+
+# ------------------------------------------- Envoy RBAC policy builder
+
+def l7_permission_to_rbac(p: dict[str, Any]) -> dict[str, Any]:
+    """One IntentionPermission.HTTP → one envoy config.rbac.v3
+    Permission (JSON form of xds/rbac.go convertPermission): path →
+    url_path, methods → OR of :method header matches, headers → ANDed
+    HeaderMatchers; multiple criteria AND together."""
+    http = p.get("HTTP") or {}
+    parts: list[dict[str, Any]] = []
+    if http.get("PathExact"):
+        parts.append({"url_path": {"path": {"exact": http["PathExact"]}}})
+    elif http.get("PathPrefix"):
+        parts.append({"url_path": {"path": {
+            "prefix": http["PathPrefix"]}}})
+    elif http.get("PathRegex"):
+        parts.append({"url_path": {"path": {
+            "safe_regex": {"regex": http["PathRegex"]}}}})
+    if http.get("Methods"):
+        ms = [{"header": {"name": ":method",
+                          "string_match": {"exact": m.upper()}}}
+              for m in http["Methods"]]
+        parts.append(ms[0] if len(ms) == 1
+                     else {"or_rules": {"rules": ms}})
+    for h in http.get("Header") or []:
+        hm: dict[str, Any] = {"name": h.get("Name", "")}
+        if h.get("Present"):
+            hm["present_match"] = True
+        elif h.get("Exact") not in (None, ""):
+            hm["string_match"] = {"exact": h["Exact"]}
+        elif h.get("Prefix") not in (None, ""):
+            hm["string_match"] = {"prefix": h["Prefix"]}
+        elif h.get("Suffix") not in (None, ""):
+            hm["string_match"] = {"suffix": h["Suffix"]}
+        elif h.get("Contains") not in (None, ""):
+            hm["string_match"] = {"contains": h["Contains"]}
+        elif h.get("Regex") not in (None, ""):
+            hm["string_match"] = {"safe_regex": {"regex": h["Regex"]}}
+        else:
+            hm["present_match"] = True
+        if h.get("Invert"):
+            hm["invert_match"] = True
+        if h.get("IgnoreCase") and "string_match" in hm:
+            hm["string_match"]["ignore_case"] = True
+        parts.append({"header": hm})
+    if not parts:
+        return {"any": True}
+    if len(parts) == 1:
+        return parts[0]
+    return {"and_rules": {"rules": parts}}
+
+
+def rbac_policy_permissions(
+        permissions: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Ordered L7 permissions → the ALLOW-policy permission list for
+    one source principal, with precedence flattened exactly as the
+    struct documents (config_entry_intentions.go:226-237): each allow
+    becomes (allow AND NOT d1 AND NOT d2 ...) over the denies BEFORE
+    it; the resulting allows are ORed by RBAC's permission list. A
+    request matching no entry falls to the filter's default (deny)."""
+    out: list[dict[str, Any]] = []
+    denies: list[dict[str, Any]] = []
+    for p in permissions or []:
+        rp = l7_permission_to_rbac(p)
+        if p.get("Action") == "deny":
+            denies.append(rp)
+            continue
+        if denies:
+            # flatten an existing AND instead of nesting one
+            base = rp["and_rules"]["rules"] if set(rp) == {"and_rules"} \
+                else [rp]
+            rp = {"and_rules": {"rules": base + [
+                {"not_rule": d} for d in denies]}}
+        out.append(rp)
+    return out

@@ -106,8 +106,12 @@ def assemble_snapshot(agent, proxy_id: str,
             # that silently blackholes traffic
             error = f"{type(e).__name__}: {e}"
         targets = chain["Routes"][-1]["Targets"]  # default route
+        # AllowPermissions: an upstream gated by L7 permissions must
+        # still be materialized — the DESTINATION's HTTP RBAC filter
+        # answers per-request (state/intention.go IntentionDecision)
         check = rpc("Intention.Check", {
-            "SourceName": dest_name, "DestinationName": uname})
+            "SourceName": dest_name, "DestinationName": uname,
+            "AllowPermissions": True})
         upstreams.append({
             "DestinationName": uname,
             "LocalBindPort": u.get("LocalBindPort", 0),
@@ -124,11 +128,20 @@ def assemble_snapshot(agent, proxy_id: str,
     matches = rpc("Intention.Match", {"DestinationName": dest_name})
     default_allow = not agent.config.acl_enabled \
         or agent.config.acl_default_policy == "allow"
+    # the LOCAL service's protocol decides the inbound listener shape
+    # (http → HCM with L7 RBAC): service-defaults, then proxy-defaults
+    sd = get_entry("service-defaults", dest_name) or {}
+    protocol = sd.get("Protocol")
+    if not protocol:  # proxy-defaults only consulted when needed
+        pd = get_entry("proxy-defaults", "global") or {}
+        protocol = pd.get("Protocol")
+    protocol = (protocol or "tcp").lower()
     return {
         "ProxyID": proxy_id,
         "Intentions": matches.get("Matches", []),
         "DefaultAllow": default_allow,
         "Kind": "connect-proxy",
+        "Protocol": protocol,
         "Service": dest_name,
         "Proxy": proxy.proxy,
         "PublicListener": {

@@ -14,6 +14,7 @@ from typing import Any
 
 from consul_tpu.server.rpc import RPCError
 from consul_tpu.state import MessageType
+from consul_tpu.state.fsm import encode_command
 from consul_tpu.types import CheckStatus
 
 
@@ -259,9 +260,10 @@ def register_endpoints(srv) -> None:
     KV_OPS = {"set", "cas", "lock", "unlock", "delete", "delete-cas",
               "delete-tree"}
 
-    def kv_apply(args):
-        # preApply validation: reject before anything reaches the raft log
-        # (reference: kvs_endpoint.go preApply)
+    def _kv_pre_apply(args):
+        """preApply validation: reject before anything reaches the raft
+        log (reference: kvs_endpoint.go preApply). Returns the cleaned
+        (token-stripped) args ready for the FSM."""
         op = args.get("Op", "set")
         if op not in KV_OPS:
             raise RPCError(f"unknown KV operation {op!r}")
@@ -281,8 +283,23 @@ def register_endpoints(srv) -> None:
             d["Key"], d.get("Value") or b"", d.get("Flags", 0)))
         if err:
             raise RPCError(f"Sentinel policy rejected the write: {err}")
-        args = {k: v for k, v in args.items() if k != "AuthToken"}
-        return srv.forward_or_apply(MessageType.KVS, args)
+        return {k: v for k, v in args.items() if k != "AuthToken"}
+
+    def kv_apply(args):
+        return srv.forward_or_apply(MessageType.KVS, _kv_pre_apply(args))
+
+    def kv_apply_async(args, src, respond):
+        """Mux fast path: on the leader, validate on the reader thread
+        and ride the group-commit batcher via callback — no worker
+        thread parks for the commit wait. Declines (→ sync path, which
+        forwards) everywhere else."""
+        if not srv.is_leader():
+            return False
+        srv._batcher.apply_async(
+            encode_command(MessageType.KVS, _kv_pre_apply(args)), respond)
+        return True
+
+    srv.rpc.async_handlers["KVS.Apply"] = kv_apply_async
 
     # KV reads return PER-PREFIX indexes (kv_prefix_index): a watcher
     # of one key/prefix re-blocks through writes elsewhere in the
@@ -1485,13 +1502,42 @@ def register_endpoints(srv) -> None:
     write("ConnectCA.ConfigurationSet", ca_set_config)
 
     def intention_apply(args):
+        from consul_tpu.connect.intentions import (precedence,
+                                                   validate_intention)
+
         i = args.get("Intention") or {}
         require(authz(args).service_write(
             i.get("DestinationName", "")), "intention write needs "
             "service write on the destination")
         if args.get("Op", "upsert") == "upsert":
             i.setdefault("ID", str(uuid.uuid4()))
-            i.setdefault("Action", "allow")
+            if not i.get("Permissions"):
+                i.setdefault("Action", "allow")
+            try:
+                validate_intention(i)
+            except ValueError as ex:
+                raise RPCError(str(ex)) from ex
+            if i.get("Permissions"):
+                # L7 permissions need an L7 destination: without an
+                # http-ish protocol there is no request to match
+                # (intention_endpoint.go validateL7 via service-
+                # defaults; errors early instead of silently denying)
+                sd = state.raw_get(
+                    "config_entries",
+                    f"service-defaults/{i.get('DestinationName', '')}")
+                if not (sd or {}).get("Protocol"):
+                    sd = state.raw_get("config_entries",
+                                       "proxy-defaults/global")
+                proto = ((sd or {}).get("Protocol") or "tcp").lower()
+                if proto not in ("http", "http2", "grpc"):
+                    raise RPCError(
+                        f"service {i.get('DestinationName')!r} has "
+                        f"protocol {proto!r}: intention Permissions "
+                        "require http, http2 or grpc (set "
+                        "service-defaults Protocol first)")
+            # Precedence is read-only and recomputed on every save
+            # (config_entry_intentions.go:244-249)
+            i["Precedence"] = precedence(i)
         return srv.forward_or_apply(MessageType.INTENTION, {
             "Op": args.get("Op", "upsert"), "Intention": i})
 
@@ -1523,7 +1569,8 @@ def register_endpoints(srv) -> None:
         allowed, reason = _authz(
             state.raw_list("intentions"),
             args.get("SourceName", ""), args.get("DestinationName", ""),
-            default_allow)
+            default_allow,
+            allow_permissions=bool(args.get("AllowPermissions")))
         return {"Allowed": allowed, "Reason": reason}
 
     primary_owned("Intention.Apply", intention_apply)

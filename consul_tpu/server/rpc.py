@@ -248,6 +248,8 @@ class RPCServer:
         # spawn their own threads — they'd starve a fixed pool)
         self._workers = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="rpc-worker")
+        # method → fn(args, src, respond) -> bool; see _mux_loop
+        self.async_handlers: dict[str, Callable] = {}
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
         self._thread = threading.Thread(
@@ -365,6 +367,53 @@ class RPCServer:
                 continue
 
             req_args = req.get("args") or {}
+
+            # async fast path: a handler that validates inline and
+            # completes via callback (e.g. the KV write path riding the
+            # group-commit batcher) never occupies a worker thread —
+            # the commit wait costs no thread, the reply frame is
+            # written by whoever completes the commit. Falls through
+            # to the sync path when the handler declines (returns
+            # False — e.g. a follower that must forward).
+            afn = self.async_handlers.get(method)
+            if afn is not None:
+                start = telemetry.time_now()
+
+                def respond(result, sid=sid, method=method, start=start):
+                    # the reply write goes through the worker pool: the
+                    # completer (e.g. the single group-commit thread)
+                    # must never block on one client's full socket
+                    # buffer — that would stall every other caller's
+                    # commit behind a slow reader
+                    def write_reply():
+                        if isinstance(result, RPCError):
+                            safe_write({"sid": sid,
+                                        "error": str(result)})
+                        elif isinstance(result, Exception):
+                            self.log.warning("rpc %s failed: %s",
+                                             method, result)
+                            safe_write({"sid": sid,
+                                        "error": f"internal: {result}"})
+                        else:
+                            safe_write({"sid": sid, "result": result})
+                        with wlock:
+                            in_flight[0] -= 1
+                        self.metrics.measure_since(
+                            "rpc.request", start, {"method": method})
+
+                    try:
+                        self._workers.submit(write_reply)
+                    except RuntimeError:  # pool shut down mid-reply
+                        pass
+
+                try:
+                    handled = afn(req_args, src, respond)
+                except Exception as e:  # noqa: BLE001 — validation
+                    respond(e if isinstance(e, RPCError)
+                            else RPCError(f"internal: {e}"))
+                    continue
+                if handled:
+                    continue  # respond() owns the reply + bookkeeping
 
             def run(sid=sid, method=method, args=req_args):
                 start = telemetry.time_now()

@@ -112,10 +112,25 @@ TCP_PROXY_TYPE = ("type.googleapis.com/envoy.extensions.filters."
 #: config.rbac.v3 (rbac.proto)
 _PRINCIPAL_AUTHENTICATED = {
     "principal_name": Field(2, "message", _STRING_MATCHER)}
-_PRINCIPAL = {"any": Field(1, "bool"),
-              "authenticated": Field(4, "message",
-                                     _PRINCIPAL_AUTHENTICATED)}
-_PERMISSION = {"any": Field(3, "bool")}
+#: config.rbac.v3 Principal: and_ids=1, or_ids=2, any=3,
+#: authenticated=4, not_id=8 (self-referential, patched below)
+_PRINCIPAL: dict = {"any": Field(3, "bool"),
+                    "authenticated": Field(4, "message",
+                                           _PRINCIPAL_AUTHENTICATED)}
+_PRINCIPAL_SET = {"ids": Field(1, "message", _PRINCIPAL,
+                               repeated=True)}
+_PRINCIPAL["and_ids"] = Field(1, "message", _PRINCIPAL_SET)
+_PRINCIPAL["or_ids"] = Field(2, "message", _PRINCIPAL_SET)
+_PRINCIPAL["not_id"] = Field(8, "message", _PRINCIPAL)
+#: config.rbac.v3 Permission — the L7 arms (rbac.proto): and_rules=1 /
+#: or_rules=2 (Permission.Set), any=3, header=4 (route_components
+#: HeaderMatcher, spec defined later — patched in below), not_rule=8
+#: (self-referential), url_path=10 (type.matcher.v3.PathMatcher)
+_PERMISSION: dict = {"any": Field(3, "bool")}
+_PERM_SET = {"rules": Field(1, "message", _PERMISSION, repeated=True)}
+_PERMISSION["and_rules"] = Field(1, "message", _PERM_SET)
+_PERMISSION["or_rules"] = Field(2, "message", _PERM_SET)
+_PERMISSION["not_rule"] = Field(8, "message", _PERMISSION)
 _POLICY = {"permissions": Field(1, "message", _PERMISSION, repeated=True),
            "principals": Field(2, "message", _PRINCIPAL, repeated=True)}
 _POLICY_ENTRY = {"key": Field(1, "string"),
@@ -128,6 +143,10 @@ _NETWORK_RBAC = {"rules": Field(1, "message", _RBAC_RULES),
                  "stat_prefix": Field(2, "string")}
 NETWORK_RBAC_TYPE = ("type.googleapis.com/envoy.extensions.filters."
                      "network.rbac.v3.RBAC")
+#: extensions.filters.http.rbac.v3.RBAC: rules=1
+_HTTP_RBAC = {"rules": Field(1, "message", _RBAC_RULES)}
+HTTP_RBAC_TYPE = ("type.googleapis.com/envoy.extensions.filters."
+                  "http.rbac.v3.RBAC")
 
 # ------------------------------------------------- HTTP / route configs
 # config.route.v3 (route.proto, route_components.proto) + the HTTP
@@ -139,9 +158,11 @@ _UINT32 = {"value": Field(1, "int")}
 #: type.matcher.v3.RegexMatcher (regex.proto): google_re2=1, regex=2
 _REGEX = {"google_re2": Field(1, "message", {}, presence=True),
           "regex": Field(2, "string")}
-#: StringMatcher grows safe_regex=5 for header/query matches
+#: StringMatcher grows safe_regex=5 for header/query matches and
+#: ignore_case=6 (used by RBAC header permissions)
 _STRING_MATCHER_RE = {**_STRING_MATCHER,
-                      "safe_regex": Field(5, "message", _REGEX)}
+                      "safe_regex": Field(5, "message", _REGEX),
+                      "ignore_case": Field(6, "bool")}
 #: route_components.proto HeaderMatcher: name=1, invert_match=8,
 #: present_match=7, string_match=13
 _HEADER_MATCHER = {
@@ -150,6 +171,13 @@ _HEADER_MATCHER = {
     "invert_match": Field(8, "bool"),
     "string_match": Field(13, "message", _STRING_MATCHER_RE),
 }
+#: type.matcher.v3.PathMatcher (path.proto): path=1 (StringMatcher).
+#: Patch the RBAC Permission spec's forward references now that the
+#: matcher specs exist (the RBAC section is defined before these).
+_PATH_MATCHER = {"path": Field(1, "message", _STRING_MATCHER_RE)}
+_PERMISSION["header"] = Field(4, "message", _HEADER_MATCHER)
+_PERMISSION["url_path"] = Field(10, "message", _PATH_MATCHER)
+
 #: QueryParameterMatcher: name=1, string_match=5, present_match=6
 _QUERY_MATCHER = {
     "name": Field(1, "string"),
@@ -225,10 +253,13 @@ def _string_match(d: dict[str, Any]) -> dict[str, Any]:
     out = {k: v for k, v in d.items() if k in _STRING_MATCHER}
     if d.get("safe_regex"):
         out["safe_regex"] = _safe_regex(d["safe_regex"])
+    if d.get("ignore_case"):
+        out["ignore_case"] = True
     unknown = set(d) - set(out)
-    if unknown - {"safe_regex"}:
+    if unknown - {"safe_regex", "ignore_case"}:
         raise UnloweredShape(f"string matcher {d!r}")
-    if not any(v for v in out.values() if not isinstance(v, dict)) \
+    if not any(v for k, v in out.items()
+               if k in _STRING_MATCHER and not isinstance(v, dict)) \
             and not out.get("safe_regex"):
         # the match_pattern oneof is required; empty strings elide on
         # the wire and ship an invalid matcher
@@ -326,11 +357,19 @@ def _lower_hcm(tc: dict[str, Any]) -> bytes:
                        for r in vh.get("routes") or []]})
     filters = []
     for f in tc.get("http_filters") or []:
-        at = (f.get("typed_config") or {}).get("@type", "")
-        if at != HTTP_ROUTER_TYPE:
+        ftc = f.get("typed_config") or {}
+        at = ftc.get("@type", "")
+        if at == HTTP_ROUTER_TYPE:
+            blob = b""
+        elif at == HTTP_RBAC_TYPE:
+            # the L7 intention enforcement filter (xds rbac.go
+            # makeRBACHTTPFilter → _rbac_http_filters in envoy.py)
+            blob = encode(_HTTP_RBAC, {
+                "rules": _lower_rbac_rules(ftc.get("rules") or {})})
+        else:
             raise UnloweredShape(f"http filter {at!r}")
         filters.append({"name": f.get("name", ""),
-                        "typed_config": {"type_url": at, "value": b""}})
+                        "typed_config": {"type_url": at, "value": blob}})
     return encode(_HCM, {
         "stat_prefix": tc.get("stat_prefix", ""),
         "route_config": {"name": rc.get("name", ""),
@@ -444,6 +483,77 @@ def lower_cluster(c: dict[str, Any]) -> bytes:
     return encode(_CLUSTER, msg)
 
 
+def _lower_rbac_permission(p: dict[str, Any]) -> dict[str, Any]:
+    """config.rbac.v3 Permission JSON → spec-shaped message: any,
+    url_path, header, and the and/or/not combinators the L7 intention
+    builder emits (connect/intentions.py rbac_policy_permissions)."""
+    keys = set(p)
+    if keys == {"any"}:
+        return {"any": True}
+    if keys == {"url_path"}:
+        path = (p["url_path"] or {}).get("path") or {}
+        return {"url_path": {"path": _string_match(path)}}
+    if keys == {"header"}:
+        h = p["header"] or {}
+        if set(h) - {"name", "present_match", "string_match",
+                     "invert_match"}:
+            raise UnloweredShape(f"rbac header matcher {h!r}")
+        out: dict[str, Any] = {"name": h.get("name", "")}
+        if h.get("present_match"):
+            out["present_match"] = True
+        if h.get("string_match"):
+            out["string_match"] = _string_match(h["string_match"])
+        if h.get("invert_match"):
+            out["invert_match"] = True
+        return {"header": out}
+    if keys == {"and_rules"} or keys == {"or_rules"}:
+        (kind, rules), = p.items()
+        return {kind: {"rules": [_lower_rbac_permission(r)
+                                 for r in (rules or {}).get("rules")
+                                 or []]}}
+    if keys == {"not_rule"}:
+        return {"not_rule": _lower_rbac_permission(p["not_rule"])}
+    raise UnloweredShape(f"rbac permission {p!r}")
+
+
+def _lower_rbac_rules(rules: dict[str, Any]) -> dict[str, Any]:
+    """Shared RBAC rules lowering for the network and HTTP filter
+    forms: principals (SPIFFE string match or any) + the permission
+    tree each policy carries."""
+    action = {"ALLOW": 0, "DENY": 1}.get(rules.get("action"), None)
+    if action is None:
+        raise UnloweredShape(f"rbac action {rules.get('action')!r}")
+    policies = []
+    for name, pol in sorted((rules.get("policies") or {}).items()):
+        principals = [_lower_rbac_principal(pr)
+                      for pr in pol.get("principals") or []]
+        policies.append({"key": name, "value": {
+            "permissions": [_lower_rbac_permission(pp)
+                            for pp in pol.get("permissions")
+                            or [{"any": True}]],
+            "principals": principals}})
+    return {"action": action, "policies": policies}
+
+
+def _lower_rbac_principal(pr: dict[str, Any]) -> dict[str, Any]:
+    if pr.get("any"):
+        return {"any": True}
+    if pr.get("authenticated"):
+        return {"authenticated": {
+            "principal_name": {
+                k: v for k, v in
+                pr["authenticated"]["principal_name"].items()
+                if k in _STRING_MATCHER}}}
+    if pr.get("and_ids") or pr.get("or_ids"):
+        kind = "and_ids" if pr.get("and_ids") else "or_ids"
+        return {kind: {"ids": [_lower_rbac_principal(p)
+                               for p in (pr[kind] or {}).get("ids")
+                               or []]}}
+    if pr.get("not_id"):
+        return {"not_id": _lower_rbac_principal(pr["not_id"])}
+    raise UnloweredShape(f"rbac principal {pr!r}")
+
+
 def _lower_filter(f: dict[str, Any]) -> dict[str, Any]:
     tc = f.get("typed_config") or {}
     at = tc.get("@type", "")
@@ -465,30 +575,9 @@ def _lower_filter(f: dict[str, Any]) -> dict[str, Any]:
             raise UnloweredShape(f"tcp_proxy without cluster {tc!r}")
         blob = encode(_TCP_PROXY, msg)
     elif at == NETWORK_RBAC_TYPE:
-        rules = tc.get("rules") or {}
-        action = {"ALLOW": 0, "DENY": 1}.get(rules.get("action"), None)
-        if action is None:
-            raise UnloweredShape(f"rbac action {rules.get('action')!r}")
-        policies = []
-        for name, pol in sorted((rules.get("policies") or {}).items()):
-            principals = []
-            for pr in pol.get("principals") or []:
-                if pr.get("any"):
-                    principals.append({"any": True})
-                elif pr.get("authenticated"):
-                    principals.append({"authenticated": {
-                        "principal_name": {
-                            k: v for k, v in
-                            pr["authenticated"]["principal_name"].items()
-                            if k in _STRING_MATCHER}}})
-                else:
-                    raise UnloweredShape(f"rbac principal {pr!r}")
-            policies.append({"key": name, "value": {
-                "permissions": [{"any": True}],
-                "principals": principals}})
         blob = encode(_NETWORK_RBAC, {
             "stat_prefix": tc.get("stat_prefix", ""),
-            "rules": {"action": action, "policies": policies}})
+            "rules": _lower_rbac_rules(tc.get("rules") or {})})
     elif at == HCM_TYPE:
         blob = _lower_hcm(tc)
     else:

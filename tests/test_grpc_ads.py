@@ -623,3 +623,94 @@ def test_hcm_route_config_lowers_to_proto():
     assert a0["retry_policy"]["retriable_status_codes"] == [502, 503]
     assert r1["match"]["path"] == "/health"
     assert r1["route"]["cluster"] == "web_api-v1"
+
+
+def test_l7_intention_permissions_reach_subscriber_as_proto(agent,
+                                                            client):
+    """VERDICT round-3 #2 acceptance: a path/method-scoped L7 intention
+    deny reaches a delta-ADS subscriber as a TRUE-proto HTTP RBAC
+    filter inside the public listener's HttpConnectionManager, and
+    /v1/connect/intentions/check honors Permissions precedence
+    (state/intention.go IntentionDecision AllowPermissions)."""
+    from consul_tpu.server import xds_proto as xp
+
+    # L7 permissions require an http destination: tcp is rejected
+    perms = [{"Action": "deny", "HTTP": {"PathPrefix": "/admin"}},
+             {"Action": "allow", "HTTP": {"PathPrefix": "/",
+                                          "Methods": ["GET"]}}]
+    try:
+        agent.server.handle_rpc("Intention.Apply", {
+            "Op": "upsert", "Intention": {
+                "SourceName": "app", "DestinationName": "web",
+                "Permissions": perms}}, "test")
+        raise AssertionError("L7 intention accepted on tcp service")
+    except Exception as e:  # noqa: BLE001
+        assert "http" in str(e)
+
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {"Kind": "service-defaults",
+                                  "Name": "web",
+                                  "Protocol": "http"}}, "test")
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "app", "DestinationName": "web",
+            "Permissions": perms}}, "test")
+    try:
+        # ---- the L4 check endpoint answers AllowPermissions ----
+        chk = agent.server.handle_rpc("Intention.Check", {
+            "SourceName": "app", "DestinationName": "web"}, "test")
+        assert chk["Allowed"] is False and "Permissions" in chk["Reason"]
+        chk = agent.server.handle_rpc("Intention.Check", {
+            "SourceName": "app", "DestinationName": "web",
+            "AllowPermissions": True}, "test")
+        assert chk["Allowed"] is True
+
+        # ---- the deny reaches a subscribing ADS client as proto ----
+        ads = AdsStream(agent.grpc_port)
+        ads.send(node={"id": PROXY_ID}, type_url=LDS_TYPE,
+                 resource_names_subscribe=["*"])
+
+        def has_l7_rbac(resp):
+            for r in resp["resources"]:
+                if r["name"] != "public_listener":
+                    continue
+                blob = r["resource"]["value"]
+                if blob.startswith(b"{"):
+                    return False  # JSON fallback would be a regression
+                lst = decode(xp._LISTENER, blob)
+                for f in lst["filter_chains"][0]["filters"]:
+                    if f["typed_config"]["type_url"] != xp.HCM_TYPE:
+                        return False
+                    hcm = decode(xp._HCM, f["typed_config"]["value"])
+                    for hf in hcm["http_filters"]:
+                        if hf["typed_config"]["type_url"] \
+                                == xp.HTTP_RBAC_TYPE:
+                            return decode(
+                                xp._HTTP_RBAC,
+                                hf["typed_config"]["value"])
+            return False
+
+        rbac = ads.recv_type(LDS_TYPE, want=has_l7_rbac)
+        rbac = has_l7_rbac(rbac)
+        pol = rbac["rules"]["policies"][0]["value"]
+        assert pol["principals"][0]["authenticated"][
+            "principal_name"]["suffix"] == "/svc/app"
+        # dev agent = default-allow, so the L7 source is constrained
+        # by a DENY filter matching NOT(its allows): deny everything
+        # except ((prefix / AND GET) AND NOT /admin)
+        assert rbac["rules"]["action"] == 1  # DENY
+        allows = pol["permissions"][0]["not_rule"]["or_rules"]["rules"]
+        perm = allows[0]["and_rules"]["rules"]
+        assert perm[0]["url_path"]["path"]["prefix"] == "/"
+        assert perm[1]["header"]["name"] == ":method"
+        assert perm[1]["header"]["string_match"]["exact"] == "GET"
+        assert perm[-1]["not_rule"]["url_path"]["path"]["prefix"] \
+            == "/admin"
+        ads.close()
+    finally:
+        agent.server.handle_rpc("Intention.Apply", {
+            "Op": "delete", "Intention": {
+                "SourceName": "app", "DestinationName": "web"}}, "test")
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "delete", "Entry": {"Kind": "service-defaults",
+                                      "Name": "web"}}, "test")

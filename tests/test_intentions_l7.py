@@ -1,0 +1,284 @@
+"""Intention L7 permissions: validation, precedence, request
+evaluation, and the Envoy HTTP RBAC lowering.
+
+Reference semantics under test:
+  * structs/config_entry_intentions.go:220-243 — Action xor
+    Permissions; ordered permission lists with deny-subtraction
+    precedence (the struct's own worked example is pinned below);
+  * state/intention.go IntentionDecision — L4 Check answers
+    AllowPermissions when the matched intention is L7;
+  * xds/rbac.go — permissions lower to url_path/:method/header
+    matchers inside an HTTP RBAC filter (true proto via pbwire).
+"""
+
+import pytest
+
+from consul_tpu.connect.intentions import (authorize, authorize_l7,
+                                           l7_permission_to_rbac,
+                                           match_intention, precedence,
+                                           rbac_policy_permissions,
+                                           validate_intention)
+
+# the struct's own worked example (config_entry_intentions.go:226-237)
+WORKED = [
+    {"Action": "deny", "HTTP": {"PathPrefix": "/v2/admin"}},
+    {"Action": "allow", "HTTP": {"PathPrefix": "/v2/"}},
+    {"Action": "allow", "HTTP": {"PathExact": "/healthz",
+                                 "Methods": ["GET"]}},
+]
+
+
+# ------------------------------------------------------------ validate
+
+def test_action_and_permissions_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_intention({"SourceName": "a", "DestinationName": "b",
+                            "Action": "allow", "Permissions": WORKED})
+
+
+def test_permission_validation_errors():
+    with pytest.raises(ValueError, match="Action must be"):
+        validate_intention({"Permissions": [
+            {"HTTP": {"PathExact": "/x"}}]})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_intention({"Permissions": [
+            {"Action": "allow", "HTTP": {"PathExact": "/x",
+                                         "PathPrefix": "/y"}}]})
+    with pytest.raises(ValueError, match="begin with"):
+        validate_intention({"Permissions": [
+            {"Action": "allow", "HTTP": {"PathExact": "x"}}]})
+    with pytest.raises(ValueError, match="exactly one"):
+        validate_intention({"Permissions": [
+            {"Action": "allow", "HTTP": {"Header": [
+                {"Name": "x-id", "Exact": "a", "Prefix": "b"}]}}]})
+    with pytest.raises(ValueError, match="Name is required"):
+        validate_intention({"Permissions": [
+            {"Action": "allow", "HTTP": {"Header": [{"Exact": "a"}]}}]})
+    with pytest.raises(ValueError, match="at least one"):
+        validate_intention({"Permissions": [
+            {"Action": "allow", "HTTP": {}}]})
+    # a well-formed permission list passes
+    validate_intention({"Permissions": WORKED})
+
+
+# ----------------------------------------------------- precedence/match
+
+def test_precedence_table():
+    """structs/intention.go UpdatePrecedence: destination specificity
+    dominates — '* => db' (8) outranks 'app => *' (6)."""
+    assert precedence({"SourceName": "a", "DestinationName": "b"}) == 9
+    assert precedence({"SourceName": "*", "DestinationName": "b"}) == 8
+    assert precedence({"SourceName": "a", "DestinationName": "*"}) == 6
+    assert precedence({"SourceName": "*", "DestinationName": "*"}) == 5
+
+
+def test_wildcard_destination_does_not_outrank_exact():
+    """The inversion the round-4 review caught: '* => db' deny must
+    beat 'app => *' allow for app->db (the reference matches the
+    destination-specific intention first)."""
+    ixns = [
+        {"SourceName": "*", "DestinationName": "db", "Action": "deny"},
+        {"SourceName": "app", "DestinationName": "*",
+         "Action": "allow"},
+    ]
+    allowed, _ = authorize(ixns, "app", "db", default_allow=True)
+    assert not allowed, "wildcard-destination intention outranked " \
+                        "the destination-specific one"
+
+
+def test_match_prefers_exact_over_wildcard():
+    ixns = [
+        {"SourceName": "*", "DestinationName": "db", "Action": "allow"},
+        {"SourceName": "web", "DestinationName": "db",
+         "Action": "deny"},
+    ]
+    m = match_intention(ixns, "web", "db")
+    assert m["Action"] == "deny"
+    assert match_intention(ixns, "other", "db")["Action"] == "allow"
+
+
+def test_l4_check_on_l7_intention_answers_allow_permissions():
+    ixns = [{"SourceName": "web", "DestinationName": "api",
+             "Permissions": WORKED}]
+    allowed, reason = authorize(ixns, "web", "api", default_allow=False)
+    assert not allowed and "Permissions" in reason
+    allowed, _ = authorize(ixns, "web", "api", default_allow=False,
+                           allow_permissions=True)
+    assert allowed
+
+
+# ------------------------------------------------------- L7 evaluation
+
+def test_worked_example_request_evaluation():
+    cases = [
+        ("GET", "/v2/admin", False),        # deny wins
+        ("GET", "/v2/admin/users", False),  # prefix deny
+        ("POST", "/v2/items", True),        # allow /v2/*
+        ("GET", "/healthz", True),          # method-scoped allow
+        ("POST", "/healthz", False),        # wrong method, no match
+        ("GET", "/other", False),           # nothing matched → deny
+    ]
+    for method, path, want in cases:
+        got, reason = authorize_l7(WORKED, path, method)
+        assert got is want, f"{method} {path}: {reason}"
+
+
+def test_header_permission_evaluation():
+    perms = [{"Action": "allow", "HTTP": {"Header": [
+        {"Name": "X-Role", "Exact": "admin"},
+        {"Name": "X-Debug", "Present": True, "Invert": True},
+    ]}}]
+    ok, _ = authorize_l7(perms, "/x", "GET", {"x-role": "admin"})
+    assert ok
+    ok, _ = authorize_l7(perms, "/x", "GET",
+                         {"x-role": "admin", "x-debug": "1"})
+    assert not ok  # inverted presence
+    ok, _ = authorize_l7(perms, "/x", "GET", {"x-role": "user"})
+    assert not ok
+
+
+# --------------------------------------------------- RBAC construction
+
+def test_rbac_policy_permissions_worked_example():
+    perms = rbac_policy_permissions(WORKED)
+    assert len(perms) == 2  # two allows, deny folded in
+    for p in perms:
+        rules = p["and_rules"]["rules"]
+        assert rules[-1]["not_rule"]["url_path"]["path"]["prefix"] \
+            == "/v2/admin"
+    # first allow: the path prefix itself
+    assert perms[0]["and_rules"]["rules"][0]["url_path"]["path"][
+        "prefix"] == "/v2/"
+    # second allow: path AND method AND NOT deny
+    sub = perms[1]["and_rules"]["rules"]
+    assert sub[0]["url_path"]["path"]["exact"] == "/healthz"
+    assert sub[1]["header"]["name"] == ":method"
+    assert sub[1]["header"]["string_match"]["exact"] == "GET"
+
+
+def test_l7_permission_to_rbac_methods_or():
+    p = l7_permission_to_rbac({"Action": "allow", "HTTP": {
+        "Methods": ["GET", "HEAD"]}})
+    ms = p["or_rules"]["rules"]
+    assert [m["header"]["string_match"]["exact"] for m in ms] \
+        == ["GET", "HEAD"]
+
+
+def _mk_snapshot(protocol, intentions, default_allow=False):
+    return {
+        "ProxyID": "web1-sidecar-proxy", "Kind": "connect-proxy",
+        "Service": "web", "Proxy": {}, "Protocol": protocol,
+        "Intentions": intentions, "DefaultAllow": default_allow,
+        "PublicListener": {"Address": "127.0.0.1", "Port": 21000,
+                           "LocalServiceAddress": "127.0.0.1",
+                           "LocalServicePort": 8080},
+        "Roots": [{"RootCert": "PEM"}], "TrustDomain": "td",
+        "Leaf": {"CertPEM": "PEM", "PrivateKeyPEM": "PEM"},
+        "Upstreams": [],
+    }
+
+
+def test_http_public_listener_gets_http_rbac_filter():
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    ixns = [{"SourceName": "app", "DestinationName": "web",
+             "Permissions": WORKED},
+            {"SourceName": "ops", "DestinationName": "web",
+             "Action": "allow"}]
+    cfg = bootstrap_config(_mk_snapshot("http", ixns))
+    pub = cfg["static_resources"]["listeners"][0]
+    filters = pub["filter_chains"][0]["filters"]
+    assert len(filters) == 1
+    hcm = filters[0]["typed_config"]
+    assert "http_connection_manager" in hcm["@type"]
+    rbacs = [f for f in hcm["http_filters"]
+             if f["name"] == "envoy.filters.http.rbac"]
+    assert rbacs, "http rbac filter missing"
+    rules = rbacs[-1]["typed_config"]["rules"]
+    assert rules["action"] == "ALLOW"
+    l7pol = rules["policies"]["consul-intentions-layer7-0"]
+    assert len(l7pol["permissions"]) == 2
+    assert l7pol["principals"][0]["authenticated"]["principal_name"][
+        "suffix"] == "/svc/app"
+    l4pol = rules["policies"]["consul-intentions-layer4"]
+    assert l4pol["permissions"] == [{"any": True}]
+    # the router stays last
+    assert hcm["http_filters"][-1]["name"] == "envoy.filters.http.router"
+
+
+def test_tcp_listener_denies_l7_sources():
+    """A network filter cannot evaluate HTTP attributes: on a tcp
+    service the L7 source is conservatively refused, never silently
+    allowed."""
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    ixns = [{"SourceName": "app", "DestinationName": "web",
+             "Permissions": WORKED}]
+    cfg = bootstrap_config(_mk_snapshot("tcp", ixns,
+                                        default_allow=True))
+    pub = cfg["static_resources"]["listeners"][0]
+    filters = pub["filter_chains"][0]["filters"]
+    rbac = [f for f in filters
+            if f["name"] == "envoy.filters.network.rbac"]
+    assert rbac and rbac[0]["typed_config"]["rules"]["action"] == "DENY"
+    pn = rbac[0]["typed_config"]["rules"]["policies"][
+        "consul-intentions"]["principals"][0]
+    assert pn["authenticated"]["principal_name"]["suffix"] == "/svc/app"
+
+
+def test_default_allow_l7_source_constrained_by_deny_filter():
+    from consul_tpu.connect.envoy import bootstrap_config
+
+    ixns = [{"SourceName": "app", "DestinationName": "web",
+             "Permissions": WORKED}]
+    cfg = bootstrap_config(_mk_snapshot("http", ixns,
+                                        default_allow=True))
+    hcm = cfg["static_resources"]["listeners"][0][
+        "filter_chains"][0]["filters"][0]["typed_config"]
+    rbacs = [f for f in hcm["http_filters"]
+             if f["name"] == "envoy.filters.http.rbac"]
+    assert len(rbacs) == 1
+    rules = rbacs[0]["typed_config"]["rules"]
+    assert rules["action"] == "DENY"
+    perm = rules["policies"]["consul-intentions-layer7-0"][
+        "permissions"][0]
+    # DENY everything the allow permissions do NOT cover
+    assert "not_rule" in perm and "or_rules" in perm["not_rule"]
+
+
+def test_http_rbac_lowering_roundtrip():
+    """The HCM + HTTP RBAC JSON lowers to true proto and decodes back
+    with the permission tree intact (url_path, :method header,
+    and/or/not combinators)."""
+    from consul_tpu.connect.envoy import bootstrap_config
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.utils.pbwire import decode
+
+    ixns = [{"SourceName": "app", "DestinationName": "web",
+             "Permissions": WORKED}]
+    cfg = bootstrap_config(_mk_snapshot("http", ixns))
+    pub = cfg["static_resources"]["listeners"][0]
+    blob = xp.lower_listener(pub)
+    msg = decode(xp._LISTENER, blob)
+    hcm_any = msg["filter_chains"][0]["filters"][0]["typed_config"]
+    assert hcm_any["type_url"] == xp.HCM_TYPE
+    hcm = decode(xp._HCM, hcm_any["value"])
+    by_type = {f["typed_config"]["type_url"]: f
+               for f in hcm["http_filters"]}
+    assert xp.HTTP_RBAC_TYPE in by_type
+    rbac = decode(xp._HTTP_RBAC,
+                  by_type[xp.HTTP_RBAC_TYPE]["typed_config"]["value"])
+    rules = rbac["rules"]
+    assert rules.get("action", 0) == 0  # ALLOW (proto3 zero default)
+    pol = rules["policies"][0]["value"]
+    perms = pol["permissions"]
+    assert len(perms) == 2
+    first = perms[0]["and_rules"]["rules"]
+    assert first[0]["url_path"]["path"]["prefix"] == "/v2/"
+    assert first[1]["not_rule"]["url_path"]["path"]["prefix"] \
+        == "/v2/admin"
+    second = perms[1]["and_rules"]["rules"]
+    assert second[1]["header"]["name"] == ":method"
+    assert second[1]["header"]["string_match"]["exact"] == "GET"
+    assert pol["principals"][0]["authenticated"]["principal_name"][
+        "suffix"] == "/svc/app"
