@@ -139,9 +139,41 @@ class HTTPApi:
             def do_DELETE(self):
                 self._handle("DELETE")
 
+        max_conns_per_ip = getattr(agent.config,
+                                   "http_max_conns_per_client", 200)
+
         class _Server(ThreadingHTTPServer):
             daemon_threads = True
             ssl_ctx = tls_context
+            # per-client-IP connection cap (reference connlimit,
+            # limits.http_max_conns_per_client default 200): one
+            # misbehaving client cannot exhaust handler threads
+            _ip_lock = threading.Lock()
+            _conns_by_ip: dict[str, int] = {}
+            _conn_ip: dict[int, str] = {}
+
+            def verify_request(self, request, client_address):
+                ip = client_address[0]
+                with self._ip_lock:
+                    if self._conns_by_ip.get(ip, 0) >= max_conns_per_ip:
+                        return False  # refused at accept, like connlimit
+                    self._conns_by_ip[ip] = \
+                        self._conns_by_ip.get(ip, 0) + 1
+                    self._conn_ip[id(request)] = ip
+                return True
+
+            def shutdown_request(self, request):
+                try:
+                    super().shutdown_request(request)
+                finally:
+                    with self._ip_lock:
+                        ip = self._conn_ip.pop(id(request), None)
+                        if ip is not None:
+                            n = self._conns_by_ip.get(ip, 1) - 1
+                            if n <= 0:
+                                self._conns_by_ip.pop(ip, None)
+                            else:
+                                self._conns_by_ip[ip] = n
 
             def finish_request(self, request, client_address):
                 # handshake runs in the per-connection worker thread

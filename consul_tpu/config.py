@@ -256,6 +256,18 @@ class RuntimeConfig:
     rpc_rate_burst: int = 500
     # per-client-IP RPC connection cap (limits.rpc_max_conns_per_client)
     rpc_max_conns_per_client: int = 100
+    # per-client-IP HTTP connection cap (limits.http_max_conns_per_client)
+    http_max_conns_per_client: int = 200
+    # The mode-aware read/write rate-limit plane (limits.request_limits
+    # in the reference config, runtime-updatable via the
+    # control-plane-request-limit config entry):
+    # {"mode": "disabled|permissive|enforcing",
+    #  "read_rate": N, "write_rate": N}
+    request_limits: dict = field(default_factory=dict)
+    # xDS stream-capacity cap (agent/consul/xdscapacity): max concurrent
+    # ADS sessions this server accepts; excess streams are refused with
+    # RESOURCE_EXHAUSTED so load sheds visibly instead of queueing
+    xds_max_sessions: int = 512
 
     # Simulation backend (`agent -dev -gossip-sim=tpu`, BASELINE north star)
     gossip_sim: str = ""          # "" (off) | "tpu" | "cpu"

@@ -169,6 +169,27 @@ def validate_entry(entry: dict) -> None:
             if not s.get("Name"):
                 raise ValueError(
                     "terminating-gateway service requires Name")
+    elif kind == "control-plane-request-limit":
+        # runtime rate-limit retuning (structs.GlobalRateLimitConfig-
+        # Entry): bad values must die here, not at the refresh loop
+        if entry.get("Name") != "global":
+            # a missing Name would store under ".../" and silently
+            # never match the refresh loop's ".../global" read
+            raise ValueError(
+                "control-plane-request-limit must be named 'global'")
+        mode = entry.get("Mode", "permissive")
+        if mode not in ("disabled", "permissive", "enforcing"):
+            raise ValueError(f"invalid rate-limit Mode {mode!r}")
+        for k in ("ReadRate", "WriteRate"):
+            v = entry.get(k)
+            if v is None:
+                continue
+            try:
+                ok = float(v) >= 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValueError(f"{k} must be a number >= 0")
 
 
 def _resolve(name: str,

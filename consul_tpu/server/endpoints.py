@@ -295,6 +295,7 @@ def register_endpoints(srv) -> None:
         forwards) everywhere else."""
         if not srv.is_leader():
             return False
+        srv.check_rate_limit("KVS.Apply", src)
         srv._batcher.apply_async(
             encode_command(MessageType.KVS, _kv_pre_apply(args)), respond)
         return True

@@ -432,8 +432,35 @@ class _TypeState:
         self.nacked: dict[str, str] = {}  # name -> version envoy rejected
 
 
+class SessionLimiter:
+    """xDS stream-capacity shedding (agent/consul/xdscapacity/
+    capacity.go): a hard cap on concurrent ADS sessions so an Envoy
+    reconnect storm degrades into visible RESOURCE_EXHAUSTED errors
+    (which clients back off on) instead of an unbounded pile of
+    snapshot-building streams."""
+
+    def __init__(self, max_sessions: int) -> None:
+        self.max_sessions = max_sessions
+        self._lock = threading.Lock()
+        self.active = 0
+        self.drained = 0  # refused-over-capacity counter (telemetry)
+
+    def begin(self) -> bool:
+        with self._lock:
+            if self.max_sessions > 0 and self.active >= self.max_sessions:
+                self.drained += 1
+                return False
+            self.active += 1
+            return True
+
+    def end(self) -> None:
+        with self._lock:
+            self.active -= 1
+
+
 def delta_ads(agent, request_iterator: Iterator[dict],
-              context) -> Iterator[bytes]:
+              context, sessions: SessionLimiter | None = None
+              ) -> Iterator[bytes]:
     """The DeltaAggregatedResources state machine (one ADS stream, all
     types multiplexed — agent/xds/delta.go:63 semantics): subscribe /
     unsubscribe / wildcard, per-response nonces, NACK suppression
@@ -441,6 +468,24 @@ def delta_ads(agent, request_iterator: Iterator[dict],
     removed_resources on deletion. Pushes ride a short re-snapshot
     cadence, like the reference's proxycfg re-snapshot loop."""
     logger = log.named("grpc.ads")
+    if sessions is not None and not sessions.begin():
+        import grpc
+
+        logger.warning("ADS session refused: %d active >= cap %d",
+                       sessions.active, sessions.max_sessions)
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                      "too many xDS sessions")
+        return
+    try:
+        yield from _delta_ads_run(agent, request_iterator, context,
+                                  logger)
+    finally:
+        if sessions is not None:
+            sessions.end()
+
+
+def _delta_ads_run(agent, request_iterator: Iterator[dict],
+                   context, logger) -> Iterator[bytes]:
     q: queue.Queue = queue.Queue()
 
     def pump() -> None:
@@ -568,6 +613,9 @@ def make_grpc_server(agent, bind_addr: str, port: int):
     except ImportError:  # pragma: no cover — grpcio is in the image
         return None
     logger = log.named("grpc")
+    ads_sessions = SessionLimiter(
+        getattr(agent.config, "xds_max_sessions", 512))
+    agent.ads_sessions = ads_sessions  # surfaced for telemetry/tests
 
     def health_check(req: dict, context) -> bytes:
         return encode(HEALTH_RESP, {"status": 1})  # SERVING
@@ -904,7 +952,8 @@ def make_grpc_server(agent, bind_addr: str, port: int):
             if m == ("/envoy.service.discovery.v3."
                      "AggregatedDiscoveryService/DeltaAggregatedResources"):
                 return grpc.stream_stream_rpc_method_handler(
-                    lambda it, ctx: delta_ads(agent, it, ctx),
+                    lambda it, ctx: delta_ads(agent, it, ctx,
+                                              sessions=ads_sessions),
                     request_deserializer=lambda b: decode(DELTA_REQ, b),
                     response_serializer=lambda b: b)
             if m == "/grpc.health.v1.Health/Check":

@@ -31,6 +31,7 @@ from consul_tpu.state.fsm import encode_command
 from consul_tpu.types import (CheckStatus, MemberStatus, SERF_CHECK_ID,
                               SERF_CHECK_NAME)
 from consul_tpu.utils import log, telemetry
+from consul_tpu.utils.ratelimit import RateLimitError, RateLimitHandler
 from consul_tpu.utils.clock import RealTimers
 from consul_tpu.utils.duration import parse_duration
 
@@ -344,6 +345,15 @@ class Server:
 
             self._limiter = TokenBucket(config.rpc_rate_limit,
                                         config.rpc_rate_burst)
+        # the mode-aware read/write plane (rate/handler.go). Config
+        # block seeds it; the control-plane-request-limit config entry
+        # (watched in start()) can retune it at runtime cluster-wide.
+        rl = config.request_limits or {}
+        self.rate_handler = RateLimitHandler(
+            mode=rl.get("mode", "disabled"),
+            read_rate=float(rl.get("read_rate", 0) or 0),
+            write_rate=float(rl.get("write_rate", 0) or 0),
+            log=self.log, metrics=self.metrics)
 
         # endpoint registry: "Service.Method" -> handler(args, ctx)
         self.endpoints: dict[str, Any] = {}
@@ -449,6 +459,7 @@ class Server:
         self._every(self.config.coordinate_update_period, self._flush_coords)
         self._every(10.0, self._usage_metrics)
         self._every(self.config.tombstone_ttl, self._reap_tombstones)
+        self._every(5.0, self._refresh_rate_limits)
         self.log.info("server started: rpc=%s serf=%s", self.rpc.addr,
                       self.serf.memberlist.transport.addr)
 
@@ -526,14 +537,62 @@ class Server:
 
     # ------------------------------------------------------------------- RPC
 
-    def handle_rpc(self, method: str, args: dict[str, Any],
-                   src: str) -> Any:
-        if self._limiter is not None and src != "local" \
-                and not self._limiter.allow():
-            # only NETWORK callers are limited; the agent's own control
-            # loops (anti-entropy, DNS, reconcile) must never starve
+    def _refresh_rate_limits(self) -> None:
+        """Runtime retuning via the control-plane-request-limit config
+        entry (the reference's structs.GlobalRateLimitConfigEntry):
+        replicated through raft, every server converges on the new
+        mode/rates within one refresh interval. Deleting the entry
+        falls back to the static config block."""
+        entry = self.state.raw_get("config_entries",
+                                   "control-plane-request-limit/global")
+        rl = self.config.request_limits or {}
+        if entry is not None:
+            mode = entry.get("Mode", rl.get("mode", "permissive"))
+            read_rate = float(entry.get("ReadRate",
+                                        rl.get("read_rate", 0)) or 0)
+            write_rate = float(entry.get("WriteRate",
+                                         rl.get("write_rate", 0)) or 0)
+        else:
+            mode = rl.get("mode", "disabled")
+            read_rate = float(rl.get("read_rate", 0) or 0)
+            write_rate = float(rl.get("write_rate", 0) or 0)
+        h = self.rate_handler
+        # compare against the handler's ACTUAL state (mode + rates),
+        # not a cached desire — skipping the no-op update matters
+        # because update() re-mints the buckets (resetting budgets)
+        if (h.mode, h.read_rate, h.write_rate) != (mode, read_rate,
+                                                   write_rate):
+            try:
+                h.update(mode, read_rate, write_rate)
+            except ValueError as e:
+                self.log.warning("bad rate-limit config: %s", e)
+        h.limiter.reap()
+
+    def check_rate_limit(self, method: str, src: str,
+                         args: Optional[dict[str, Any]] = None) -> None:
+        """The request-rate gate every network entry point shares
+        (handle_rpc AND the mux async fast path). Only NETWORK callers
+        are limited; the agent's own control loops (anti-entropy, DNS,
+        reconcile) must never starve. Updates to the rate-limit config
+        entry ITSELF are exempt — otherwise an exhausted write budget
+        locks the operator out of the one knob that could fix it."""
+        if src == "local":
+            return
+        if method == "ConfigEntry.Apply" and args is not None and \
+                (args.get("Entry") or {}).get("Kind") \
+                == "control-plane-request-limit":
+            return
+        if self._limiter is not None and not self._limiter.allow():
             self.metrics.incr("rpc.rate_limited")
             raise RPCError("rate limit exceeded, try again later")
+        try:
+            self.rate_handler.allow(method, src, self.is_leader())
+        except RateLimitError as e:
+            raise RPCError(str(e)) from e
+
+    def handle_rpc(self, method: str, args: dict[str, Any],
+                   src: str) -> Any:
+        self.check_rate_limit(method, src, args)
         dc = args.get("Datacenter")
         if dc and dc != self.config.datacenter:
             return self._forward_dc(method, args, dc)

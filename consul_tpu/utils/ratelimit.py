@@ -1,10 +1,29 @@
-"""Token-bucket rate limiting (reference: agent/consul/rate over a
-sharded multilimiter — one global bucket here)."""
+"""The RPC rate-limit plane: token buckets, a sharded keyed
+multilimiter, and the global read/write-mode handler.
+
+Reference: agent/consul/rate/handler.go (modes, operation
+classification, leader-aware retry hints),
+agent/consul/multilimiter/multilimiter.go (prefix-configured keyed
+limiters with idle reaping). The per-IP CONNECTION cap lives at the
+accept layers (server/rpc.py max_conns_per_ip, agent/http.py), and the
+xDS session cap in server/grpc_external.py — this module is the
+request-rate tier they all share.
+"""
 
 from __future__ import annotations
 
 import threading
 import time
+from typing import Optional
+
+MODE_DISABLED = "disabled"
+MODE_PERMISSIVE = "permissive"
+MODE_ENFORCING = "enforcing"
+MODES = (MODE_DISABLED, MODE_PERMISSIVE, MODE_ENFORCING)
+
+OP_READ = "read"
+OP_WRITE = "write"
+OP_EXEMPT = "exempt"
 
 
 class TokenBucket:
@@ -25,3 +44,181 @@ class TokenBucket:
                 self._tokens -= n
                 return True
             return False
+
+
+class LimiterConfig:
+    __slots__ = ("rate", "burst")
+
+    def __init__(self, rate: float, burst: Optional[int] = None) -> None:
+        self.rate = rate
+        # reference default: burst = rate (one second of headroom)
+        self.burst = int(burst if burst is not None else max(1, rate))
+
+
+class MultiLimiter:
+    """Keyed token buckets configured by key PREFIX (multilimiter.go):
+    a config stored under ("global", "write") governs every key that
+    starts with that tuple, e.g. ("global", "write", <client-ip>).
+    Buckets are created lazily on first sight of a key and reaped once
+    idle — a scan flood cannot pin memory."""
+
+    def __init__(self, idle_ttl: float = 600.0) -> None:
+        self._lock = threading.Lock()
+        self._configs: dict[tuple, LimiterConfig] = {}
+        self._buckets: dict[tuple, tuple[TokenBucket, float]] = {}
+        self.idle_ttl = idle_ttl
+
+    def update_config(self, prefix: tuple, cfg: Optional[LimiterConfig]
+                      ) -> None:
+        """Set (or with None, clear) the config for a key prefix; live
+        buckets under the prefix are dropped so they re-mint with the
+        new rate."""
+        with self._lock:
+            if cfg is None:
+                self._configs.pop(prefix, None)
+            else:
+                self._configs[prefix] = cfg
+            self._buckets = {k: v for k, v in self._buckets.items()
+                             if k[:len(prefix)] != prefix}
+
+    def _config_for(self, key: tuple) -> Optional[LimiterConfig]:
+        # longest matching prefix wins
+        for n in range(len(key), 0, -1):
+            cfg = self._configs.get(key[:n])
+            if cfg is not None:
+                return cfg
+        return None
+
+    def allow(self, key: tuple) -> bool:
+        """True if the request under `key` may proceed. Keys with no
+        configured prefix are unlimited (rate.Inf in the reference)."""
+        now = time.monotonic()
+        with self._lock:
+            ent = self._buckets.get(key)
+            if ent is not None:
+                self._buckets[key] = (ent[0], now)
+                bucket = ent[0]
+            else:
+                cfg = self._config_for(key)
+                if cfg is None or cfg.rate <= 0:
+                    return True
+                bucket = TokenBucket(cfg.rate, cfg.burst)
+                self._buckets[key] = (bucket, now)
+        return bucket.allow()
+
+    def reap(self) -> int:
+        """Drop buckets idle past idle_ttl; returns how many died."""
+        cutoff = time.monotonic() - self.idle_ttl
+        with self._lock:
+            before = len(self._buckets)
+            self._buckets = {k: v for k, v in self._buckets.items()
+                             if v[1] >= cutoff}
+            return before - len(self._buckets)
+
+
+class RateLimitError(Exception):
+    """An enforced limit refused the operation. retry_elsewhere hints
+    that another server could serve it (reads); writes on the leader
+    get retry-later — no other server can help (handler.go:308-313)."""
+
+    def __init__(self, msg: str, retry_elsewhere: bool) -> None:
+        super().__init__(msg)
+        self.retry_elsewhere = retry_elsewhere
+
+
+# method-name classification (the reference generates this table per
+# endpoint: rate_limit_mappings.gen.go). Explicit entries first, then
+# suffix heuristics — write verbs change raft state, reads do not.
+_EXEMPT_PREFIXES = ("Status.", "AutoEncrypt.", "Snapshot.")
+_EXEMPT = {"ACL.Login", "ACL.Logout", "AutoConfig.InitialConfiguration"}
+_WRITE_SUFFIXES = ("Apply", "Register", "Deregister", "Set", "Delete",
+                   "Sign", "Rotate", "Renew", "Destroy", "Write",
+                   "Fire", "Update", "Upsert")
+_WRITE_METHODS = {"Operator.RaftRemovePeer", "Operator.TransferLeader",
+                  "Keyring.Op", "ConnectCA.ConfigurationSet",
+                  "Peering.Establish", "Peering.TokenGenerate"}
+
+
+def classify_op(method: str) -> str:
+    if method in _EXEMPT or method.startswith(_EXEMPT_PREFIXES):
+        return OP_EXEMPT
+    if method in _WRITE_METHODS or \
+            method.rsplit(".", 1)[-1].endswith(_WRITE_SUFFIXES):
+        return OP_WRITE
+    return OP_READ
+
+
+class RateLimitHandler:
+    """Global read/write rate limiting with three modes
+    (handler.go:40-56): disabled — no checks; permissive — measure and
+    log but always allow; enforcing — throttled requests are refused
+    with a leader-aware retry hint. `log` and `metrics` keep the
+    permissive mode observable (that is its whole point)."""
+
+    def __init__(self, mode: str = MODE_DISABLED,
+                 read_rate: float = 0.0, write_rate: float = 0.0,
+                 log=None, metrics=None) -> None:
+        self.limiter = MultiLimiter()
+        self.log = log
+        self.metrics = metrics
+        self._mode = MODE_DISABLED
+        # throttle-log limiter: one line per (method, op) per ~10s —
+        # the reference rate-limits these too; logging every shed
+        # request would amplify the very overload being shed
+        self._log_last: dict[tuple[str, str], float] = {}
+        self.update(mode, read_rate, write_rate)
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    def update(self, mode: str, read_rate: float,
+               write_rate: float) -> None:
+        if mode not in MODES:
+            raise ValueError(f"invalid rate-limit mode {mode!r}")
+        self._mode = mode
+        self.read_rate = read_rate
+        self.write_rate = write_rate
+        self.limiter.update_config(
+            ("global", OP_READ),
+            LimiterConfig(read_rate) if read_rate > 0 else None)
+        self.limiter.update_config(
+            ("global", OP_WRITE),
+            LimiterConfig(write_rate) if write_rate > 0 else None)
+
+    def allow(self, method: str, src: str, is_leader: bool) -> None:
+        """Raises RateLimitError when an ENFORCED limit is exhausted;
+        permissive mode logs + counts and lets the request pass."""
+        if self._mode == MODE_DISABLED:
+            return
+        op_type = classify_op(method)
+        if op_type == OP_EXEMPT:
+            return
+        if self.limiter.allow(("global", op_type)):
+            return
+        enforced = self._mode == MODE_ENFORCING
+        if self.metrics is not None:
+            self.metrics.incr("rpc.rate_limit.exceeded",
+                              labels={"op": method, "mode": self._mode,
+                                      "limit_type": f"global/{op_type}"})
+        if self.log is not None:
+            now = time.monotonic()
+            key = (method, op_type)
+            if now - self._log_last.get(key, 0.0) >= 10.0:
+                self._log_last[key] = now
+                if len(self._log_last) > 1024:  # flood of method names
+                    self._log_last.clear()
+                self.log.warning(
+                    "RPC exceeded allowed rate limit: rpc=%s source=%s "
+                    "limit_type=global/%s enforced=%s", method, src,
+                    op_type, enforced)
+        if not enforced:
+            return
+        if is_leader and op_type == OP_WRITE:
+            raise RateLimitError(
+                "rate limit exceeded for operation that can only be "
+                "performed by the leader, try again later",
+                retry_elsewhere=False)
+        raise RateLimitError(
+            "rate limit exceeded, try a different server",
+            retry_elsewhere=True)
