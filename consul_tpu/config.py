@@ -258,6 +258,10 @@ class RuntimeConfig:
     rpc_max_conns_per_client: int = 100
     # per-client-IP HTTP connection cap (limits.http_max_conns_per_client)
     http_max_conns_per_client: int = 200
+    # Non-voting read replica (reference read_replica, formerly
+    # non_voting_server): replicated to, serves stale reads, never
+    # votes or campaigns, excluded from bootstrap_expect counting
+    read_replica: bool = False
     # The mode-aware read/write rate-limit plane (limits.request_limits
     # in the reference config, runtime-updatable via the
     # control-plane-request-limit config entry):

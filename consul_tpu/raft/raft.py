@@ -20,10 +20,16 @@ from __future__ import annotations
 
 import enum
 import threading
+import uuid
 from typing import Any, Callable, Optional
 
 from consul_tpu.raft.storage import RaftStorage
 from consul_tpu.raft.transport import RaftTransport
+
+# one log entry's payload ceiling: a command above this is split into
+# chunk entries (rpc.go:783-793 / go-raftchunking). Far below the RPC
+# MAX_FRAME (64MB) so a replication batch of chunks still frames.
+CHUNK_SIZE = 4 * 1024 * 1024
 from consul_tpu.utils import log, telemetry
 from consul_tpu.utils.clock import Clock, RealTimers, SimClock
 
@@ -93,6 +99,13 @@ class RaftNode:
         self.last_applied = self.store.snapshot_index
         # configuration: voting members (including self), from log or static
         self.peers: set[str] = set(peers or []) | {transport.addr}
+        # non-voting read replicas (server_serf.go:124-129): replicated
+        # to, excluded from quorum counting and elections. Subset of
+        # peers; maintained by config log entries like peers itself.
+        self.nonvoters: set[str] = set()
+        # chunked-apply reassembly (go-raftchunking): id -> list of
+        # pieces; rebuilt deterministically during log replay
+        self._chunks: dict[str, list[Optional[bytes]]] = {}
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._election_timer = None
@@ -179,10 +192,28 @@ class RaftNode:
                 raise NotLeader(self.leader_id)
             term = self.store.term
             era = self._leadership_era
-            self.store.append([{"term": term, "data": d, "kind": "cmd"}
-                               for d in datas])
+            entries: list[dict[str, Any]] = []
+            result_offsets: list[int] = []  # per-command result entry
+            for d in datas:
+                if len(d) > CHUNK_SIZE:
+                    # oversized command → chunk entries (rpc.go:783-793
+                    # via go-raftchunking); the FSM result lands at the
+                    # FINAL piece's index
+                    cid = uuid.uuid4().hex
+                    pieces = [d[i:i + CHUNK_SIZE]
+                              for i in range(0, len(d), CHUNK_SIZE)]
+                    for seq, piece in enumerate(pieces):
+                        entries.append({"term": term, "kind": "chunk",
+                                        "data": piece, "cid": cid,
+                                        "seq": seq,
+                                        "total": len(pieces)})
+                else:
+                    entries.append({"term": term, "data": d,
+                                    "kind": "cmd"})
+                result_offsets.append(len(entries) - 1)
+            self.store.append(entries)
             last = self.store.last_index()
-            first = last - len(datas) + 1
+            first = last - len(entries) + 1
             self.metrics.incr("raft.apply", len(datas))
         self._replicate_all()
         # wait for the whole batch to be applied locally
@@ -209,8 +240,8 @@ class RaftNode:
                     raise NotLeader(self.leader_id)
             elif self._leadership_era != era:
                 raise NotLeader(self.leader_id)
-            return [self._apply_results.pop(i, None)
-                    for i in range(first, last + 1)]
+            return [self._apply_results.pop(first + off, None)
+                    for off in result_offsets]
 
     def barrier(self, timeout: float = 10.0) -> None:
         """Commit an empty entry and wait for it: asserts leadership and
@@ -225,18 +256,27 @@ class RaftNode:
                                 "kind": "noop"}])
         self._replicate_all()
 
-    def add_peer(self, addr: str) -> None:
-        """Single-server membership change (AddVoter)."""
+    def add_peer(self, addr: str, voter: bool = True) -> None:
+        """Single-server membership change (AddVoter / AddNonvoter).
+        voter=False adds a read replica: fully replicated to, excluded
+        from quorum and elections (server_serf.go:124-129)."""
         with self._lock:
             if self.role != Role.LEADER:
                 raise NotLeader(self.leader_id)
-            if addr in self.peers:
+            if addr in self.peers and \
+                    (addr in self.nonvoters) == (not voter):
                 return
             self.store.append([{"term": self.store.term, "kind": "config",
-                                "data": b"", "add": addr}])
+                                "data": b"", "add": addr,
+                                "voter": voter}])
             self.peers.add(addr)
-            self._next_index[addr] = self.store.first_index()
-            self._match_index[addr] = 0
+            if voter:
+                self.nonvoters.discard(addr)  # promotion
+            else:
+                self.nonvoters.add(addr)
+            if addr not in self._next_index:
+                self._next_index[addr] = self.store.first_index()
+                self._match_index[addr] = 0
         self._replicate_all()
 
     def remove_peer(self, addr: str) -> None:
@@ -245,6 +285,7 @@ class RaftNode:
                 raise NotLeader(self.leader_id)
             if addr not in self.peers:
                 return
+            self.nonvoters.discard(addr)
             self.store.append([{"term": self.store.term, "kind": "config",
                                 "data": b"", "remove": addr}])
             self.peers.discard(addr)
@@ -265,6 +306,10 @@ class RaftNode:
                 return
             if target not in self.peers:
                 raise ValueError(f"{target!r} is not a raft peer")
+            if target in self.nonvoters:
+                raise ValueError(
+                    f"{target!r} is a non-voting read replica and "
+                    "cannot lead")
             term = self.store.term
             last = self.store.last_index()
         # wall-clock deadline: the catch-up loop sleeps real time, so a
@@ -305,6 +350,7 @@ class RaftNode:
                 "leader": self.leader(),
                 "num_peers": len(self.peers) - 1,
                 "peers": sorted(self.peers),
+                "nonvoters": sorted(self.nonvoters),
             }
 
     # ------------------------------------------------------------ elections
@@ -357,9 +403,19 @@ class RaftNode:
     def _election_timeout(self) -> None:
         if self._stopped or self.role == Role.LEADER:
             return
+        if self.transport.addr in self.nonvoters:
+            # a read replica NEVER campaigns — it merely keeps the
+            # watchdog armed so a later promotion behaves normally
+            return
         self._start_election()
 
     def _start_election(self, bypass_prevote: bool = False) -> None:
+        if self.transport.addr in self.nonvoters:
+            # defense in depth for every entry path, including a
+            # misdirected TimeoutNow (timeout_now bypasses pre-vote
+            # AND the _election_timeout guard): a read replica never
+            # campaigns, full stop
+            return
         # Pre-vote first (thesis §9.6 / hashicorp/raft pre-vote): ask
         # "WOULD you vote for me at term+1" without touching our own
         # term. A partitioned node that keeps timing out no longer
@@ -379,11 +435,12 @@ class RaftNode:
             self.leader_id = None
             last_idx = self.store.last_index()
             last_term = self.store.term_at(last_idx)
-            peers = [p for p in self.peers if p != self.transport.addr]
+            voters = self.peers - self.nonvoters
+            peers = [p for p in voters if p != self.transport.addr]
             self._reset_election_timer()
         self.metrics.incr("raft.election.start")
         self.log.info("starting election for term %d", term)
-        need = len(self.peers) // 2 + 1
+        need = len(voters) // 2 + 1
         votes = [1]  # self-vote
         votes_lock = threading.Lock()
 
@@ -438,7 +495,8 @@ class RaftNode:
             term = self.store.term + 1
             last_idx = self.store.last_index()
             last_term = self.store.term_at(last_idx)
-            peers = [p for p in self.peers if p != self.transport.addr]
+            voters = self.peers - self.nonvoters
+            peers = [p for p in voters if p != self.transport.addr]
         if not peers:
             return True
         need = (len(peers) + 1) // 2 + 1
@@ -685,13 +743,18 @@ class RaftNode:
         with self._lock:
             if self.role != Role.LEADER:
                 return
+            # quorum counts VOTERS only — a read replica's ack must
+            # never commit an entry a voter majority hasn't stored
+            # (raft §4.2.1 non-voting members)
+            voters = self.peers - self.nonvoters
             for idx in range(self.store.last_index(), self.commit_index, -1):
                 if self.store.term_at(idx) != self.store.term:
                     break  # only current-term entries commit by counting
                 votes = 1 + sum(
                     1 for p, mi in self._match_index.items()
-                    if p != self.transport.addr and mi >= idx)
-                if votes * 2 > len(self.peers):
+                    if p != self.transport.addr and p in voters
+                    and mi >= idx)
+                if votes * 2 > len(voters):
                     self.commit_index = idx
                     break
             self._apply_committed()
@@ -732,6 +795,12 @@ class RaftNode:
             e = self.store.entry(idx)
             if e is None:
                 break
+            if e["kind"] != "chunk" and self._chunks:
+                # any non-chunk entry interrupts (and so orphans) an
+                # in-flight group — same contiguity argument as above
+                self.log.warning("dropping %d orphaned chunk group(s)",
+                                 len(self._chunks))
+                self._chunks.clear()
             if e["kind"] == "cmd" and e["data"]:
                 try:
                     result = self.apply_fn(e["data"], idx)
@@ -743,9 +812,38 @@ class RaftNode:
                     if len(self._apply_results) > 4096:
                         for k in sorted(self._apply_results)[:1024]:
                             self._apply_results.pop(k, None)
+            elif e["kind"] == "chunk":
+                # go-raftchunking: pieces of one oversized command ride
+                # separate log entries; the FSM sees the reassembled
+                # whole exactly once, at the FINAL piece's index.
+                # Pieces are appended CONTIGUOUSLY, so an incomplete
+                # group interrupted by any other cid is orphaned (its
+                # tail died with a deposed leader) — evict it, or the
+                # _maybe_snapshot guard would block compaction forever
+                cid, seq, total = e["cid"], e["seq"], e["total"]
+                for dead in [c for c in self._chunks if c != cid]:
+                    self.log.warning(
+                        "dropping orphaned chunk group %s", dead)
+                    del self._chunks[dead]
+                buf = self._chunks.setdefault(cid, [None] * total)
+                buf[seq] = e["data"]
+                if all(p is not None for p in buf):
+                    del self._chunks[cid]
+                    try:
+                        result = self.apply_fn(b"".join(buf), idx)
+                    except Exception as ex:  # noqa: BLE001
+                        self.log.error("fsm apply (chunked) failed "
+                                       "at %d: %s", idx, ex)
+                        result = ex
+                    if self.role == Role.LEADER:
+                        self._apply_results[idx] = result
             elif e["kind"] == "config":
                 if e.get("add"):
                     self.peers.add(e["add"])
+                    if e.get("voter", True):
+                        self.nonvoters.discard(e["add"])
+                    else:
+                        self.nonvoters.add(e["add"])
                     if self.role == Role.LEADER and \
                             e["add"] not in self._next_index:
                         self._next_index[e["add"]] = \
@@ -753,6 +851,7 @@ class RaftNode:
                         self._match_index[e["add"]] = 0
                 if e.get("remove"):
                     self.peers.discard(e["remove"])
+                    self.nonvoters.discard(e["remove"])
             self.last_applied = idx
         self._applied_cv.notify_all()
         self._maybe_snapshot()
@@ -762,6 +861,11 @@ class RaftNode:
             return
         if self.last_applied - self.store.snapshot_index \
                 < self.snapshot_threshold:
+            return
+        if self._chunks:
+            # never compact MID-chunk-group: the boundary would orphan
+            # the early pieces and a snapshot-restored follower could
+            # not reassemble the command
             return
         self._take_snapshot()
 
@@ -882,6 +986,10 @@ class RaftNode:
             self.store.save_snapshot(idx, sterm, args["data"])
             if self.restore_fn is not None:
                 self.restore_fn(args["data"])
+            # partial chunk groups predate the snapshot: their missing
+            # pieces are INSIDE it and will never replay — stale state
+            # here would block _maybe_snapshot forever
+            self._chunks.clear()
             self.commit_index = max(self.commit_index, idx)
             self.last_applied = idx
             self._reset_election_timer()

@@ -110,10 +110,22 @@ class RaftStorage:
             return self.log[i]
         return None
 
-    def entries_from(self, index: int, limit: int = 512
+    def entries_from(self, index: int, limit: int = 512,
+                     byte_limit: int = 16 * 1024 * 1024
                      ) -> list[dict[str, Any]]:
+        """A replication round's batch: capped by COUNT and by BYTES —
+        512 tiny KV writes batch fine, but four 4MB chunk entries
+        already fill a round (an uncapped batch of large entries would
+        blow the RPC MAX_FRAME and wedge replication forever)."""
         i = max(index - 1 - self.snapshot_index, 0)
-        return self.log[i: i + limit]
+        out: list[dict[str, Any]] = []
+        size = 0
+        for e in self.log[i: i + limit]:
+            size += len(e.get("data") or b"")
+            if out and size > byte_limit:
+                break
+            out.append(e)
+        return out
 
     # ----------------------------------------------------------- mutation
 

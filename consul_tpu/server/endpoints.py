@@ -1843,7 +1843,11 @@ def register_endpoints(srv) -> None:
         require(authz(args).operator_write(), "operator write")
         target = args.get("Address", "")
         if not target:
-            candidates = [p for p in srv.raft.peers if p != srv.rpc.addr]
+            # auto-pick: most caught-up VOTER (a read replica is often
+            # the most caught-up peer but can never lead)
+            candidates = [p for p in srv.raft.peers
+                          if p != srv.rpc.addr
+                          and p not in srv.raft.nonvoters]
             if not candidates:
                 raise RPCError("no follower to transfer to")
             target = max(candidates,

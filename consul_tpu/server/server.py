@@ -259,6 +259,10 @@ class Server:
             "rpc_addr": self.rpc.addr,
             "expect": str(config.bootstrap_expect or 0),
             "bootstrap": "1" if config.bootstrap else "0",
+            # advertised like the reference's read_replica serf tag
+            # (server_serf.go:124-129) so the leader adds us without a
+            # vote and peers never count us toward quorum
+            **({"read_replica": "1"} if config.read_replica else {}),
             "wan_addr": (self.serf_wan.memberlist.transport.addr
                          if self.serf_wan else ""),
             "segment": "",
@@ -758,7 +762,9 @@ class Server:
                     and m.status == MemberStatus.ALIVE:
                 out.append({"name": m.name,
                             "rpc_addr": m.tags.get("rpc_addr", ""),
-                            "id": m.tags.get("id", "")})
+                            "id": m.tags.get("id", ""),
+                            "read_replica":
+                                m.tags.get("read_replica", "")})
         return out
 
     def _maybe_bootstrap(self) -> None:
@@ -770,7 +776,10 @@ class Server:
         expect = self.config.bootstrap_expect
         if not expect:
             return
-        servers = self._servers()
+        if self.config.read_replica:
+            return  # a replica never seeds or counts toward expect
+        servers = [s for s in self._servers()
+                   if not s.get("read_replica")]
         if len(servers) < expect:
             return
         addrs = sorted(s["rpc_addr"] for s in servers if s["rpc_addr"])
@@ -850,7 +859,10 @@ class Server:
             self._ensure_initial_management_token()
             self._write_system_metadata()
         # raft membership follows serf server membership (autopilot)
-        servers = {s["rpc_addr"] for s in self._servers() if s["rpc_addr"]}
+        rows = self._servers()
+        servers = {s["rpc_addr"] for s in rows if s["rpc_addr"]}
+        replica_addrs = {s["rpc_addr"] for s in rows
+                         if s["rpc_addr"] and s.get("read_replica")}
         now = time.monotonic()
         for addr in servers - self.raft.peers:
             self._server_first_seen.setdefault(addr, now)
@@ -888,7 +900,7 @@ class Server:
                     self.log.debug("bootstrap marker write (will "
                                    "retry next tick): %s", e)
         for addr in servers - self.raft.peers:
-            if self._bootstrapped and \
+            if self._bootstrapped and addr not in replica_addrs and \
                     now - self._server_first_seen.get(addr, now) < stab:
                 # autopilot ServerStabilizationTime: a server joining an
                 # ESTABLISHED cluster must look healthy for the
@@ -898,11 +910,28 @@ class Server:
                 # peers still gates replacements (that is when an
                 # unstable voter hurts most)
                 continue
-            self.log.info("adding raft peer %s", addr)
+            voter = addr not in replica_addrs
+            self.log.info("adding raft peer %s%s", addr,
+                          "" if voter else " (read replica, non-voter)")
             try:
-                self.raft.add_peer(addr)
+                self.raft.add_peer(addr, voter=voter)
             except NotLeader:
                 return
+        # promote/demote EXISTING peers whose read_replica tag changed
+        # (e.g. a voter restarted as a replica): leaving raft's voter
+        # set out of sync with the members' own self-view can make the
+        # cluster unelectable — raft counts them as voters while the
+        # nodes refuse to campaign
+        for addr in servers & self.raft.peers - {self.rpc.addr}:
+            want_voter = addr not in replica_addrs
+            if want_voter != (addr not in self.raft.nonvoters):
+                self.log.info("%s raft peer %s",
+                              "promoting" if want_voter
+                              else "demoting", addr)
+                try:
+                    self.raft.add_peer(addr, voter=want_voter)
+                except NotLeader:
+                    return
         # dead-server cleanup (autopilot CleanupDeadServers — operator
         # configurable): remove raft peers whose serf member failed
         cleanup = ap_cfg.get("CleanupDeadServers", True)

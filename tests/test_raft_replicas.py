@@ -1,0 +1,180 @@
+"""Raft non-voting read replicas + chunked oversized applies.
+
+Reference: agent/consul/server_serf.go:124-129 (read_replica serf tag
+→ AddNonvoter), raft §4.2.1 (non-voters excluded from quorum),
+agent/consul/rpc.go:783-793 + go-raftchunking (applies larger than the
+suggested entry size are chunked through the log and reassembled at
+FSM apply time).
+"""
+
+import time
+
+import pytest
+
+from consul_tpu.config import load
+from consul_tpu.server import Server
+from consul_tpu.server.rpc import ConnPool
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture
+def replica_cluster():
+    """3 voters + 1 read replica, formed via gossip bootstrap."""
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"vot{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    rcfg = load(dev=True, overrides={
+        "node_name": "replica0", "bootstrap": False,
+        "bootstrap_expect": 3, "server": True, "read_replica": True})
+    replica = Server(rcfg)
+    replica.start()
+    servers.append(replica)
+    for s in servers[1:]:
+        assert s.join([servers[0].serf.memberlist.transport.addr]) == 1
+    leader = wait_for(
+        lambda: next((s for s in servers[:3] if s.is_leader()), None),
+        what="leader election")
+    wait_for(lambda: len(leader.raft.peers) == 4,
+             what="replica added to raft", timeout=30)
+    yield servers, leader, replica
+    for s in servers:
+        s.shutdown()
+
+
+def test_replica_replicates_serves_stale_never_votes(replica_cluster):
+    servers, leader, replica = replica_cluster
+    # the leader knows it as a non-voter
+    assert replica.rpc.addr in leader.raft.nonvoters
+    # writes replicate to it
+    leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "rep/key",
+                                "Value": b"hello"}}, "local")
+    wait_for(lambda: replica.state.kv_get("rep/key") is not None,
+             what="write reaches replica")
+    # it serves stale reads from LOCAL state over the network surface
+    pool = ConnPool()
+    try:
+        import base64
+
+        res = pool.call(replica.rpc.addr, "KVS.Get",
+                        {"Key": "rep/key", "AllowStale": True})
+        v = res["Entries"][0]["Value"]
+        assert (base64.b64decode(v) if isinstance(v, str) else v) \
+            == b"hello"
+    finally:
+        pool.close()
+    # quorum math: 4 peers but 3 voters — commit needs 2 of 3 voters,
+    # and the replica's ack is never counted
+    assert leader.raft.peers - leader.raft.nonvoters == {
+        s.rpc.addr for s in servers[:3]}
+    # the replica never campaigns: kill the leader, a VOTER wins
+    leader.shutdown()
+    new_leader = wait_for(
+        lambda: next((s for s in servers[:3]
+                      if s is not leader and s.is_leader()), None),
+        what="failover to a voter", timeout=30)
+    assert new_leader is not replica
+    assert not replica.is_leader()
+    # and the replica still refuses to campaign on its own timer
+    replica.raft._election_timeout()
+    time.sleep(0.5)
+    assert not replica.is_leader()
+
+
+def test_chunked_apply_roundtrips_multi_mb(replica_cluster):
+    """A KV write far above CHUNK_SIZE rides the log as chunk entries
+    and reassembles on every server (rpc.go:783-793)."""
+    from consul_tpu.raft.raft import CHUNK_SIZE
+
+    servers, leader, replica = replica_cluster
+    big = bytes(bytearray(range(256))) * ((2 * CHUNK_SIZE + 12345) // 256)
+    assert len(big) > 2 * CHUNK_SIZE
+    leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "big/blob", "Value": big}},
+        "local")
+    # the leader applied it whole
+    assert leader.state.kv_get("big/blob").value == big
+    # every follower AND the replica reassembled the same bytes
+    for s in servers[1:]:
+        wait_for(lambda s=s: (e := s.state.kv_get("big/blob"))
+                 is not None and e.value == big,
+                 what=f"chunked write on {s.name}", timeout=30)
+    # no partial reassembly state left anywhere
+    for s in servers:
+        assert not s.raft._chunks, f"{s.name} kept partial chunks"
+    # a normal write still works after the chunked one
+    leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "after", "Value": b"ok"}},
+        "local")
+    wait_for(lambda: replica.state.kv_get("after") is not None,
+             what="post-chunk write replicates")
+
+
+def test_chunked_apply_unit_single_node():
+    """Unit tier: chunk split/reassembly on a single dev server, exact
+    result indices for a mixed small+huge batch."""
+    from consul_tpu.raft.raft import CHUNK_SIZE
+    from consul_tpu.state import MessageType
+    from consul_tpu.state.fsm import encode_command
+
+    cfg = load(dev=True, overrides={"node_name": "chunk1",
+                                    "server": True})
+    s = Server(cfg)
+    s.start()
+    try:
+        wait_for(lambda: s.is_leader(), what="self-elect")
+        big = b"z" * (CHUNK_SIZE + 100)
+        cmds = [
+            encode_command(MessageType.KVS, {
+                "Op": "set", "DirEnt": {"Key": "a", "Value": b"1"}}),
+            encode_command(MessageType.KVS, {
+                "Op": "set", "DirEnt": {"Key": "b", "Value": big}}),
+            encode_command(MessageType.KVS, {
+                "Op": "set", "DirEnt": {"Key": "c", "Value": b"3"}}),
+        ]
+        results = s.raft.apply_many(cmds)
+        assert len(results) == 3
+        assert s.state.kv_get("b").value == big
+        assert s.state.kv_get("a").value == b"1"
+        assert s.state.kv_get("c").value == b"3"
+    finally:
+        s.shutdown()
+
+
+def test_transfer_leadership_refuses_replica(replica_cluster):
+    servers, leader, replica = replica_cluster
+    with pytest.raises(ValueError, match="read replica"):
+        leader.raft.transfer_leadership(replica.rpc.addr)
+    # the operator auto-pick never lands on the replica either
+    res = leader.handle_rpc("Operator.RaftTransferLeader", {}, "local")
+    assert res["Target"] != replica.rpc.addr
+
+
+def test_orphaned_chunk_group_evicted():
+    """An incomplete chunk group interrupted by another entry (the
+    deposed-leader case) must be evicted, or the snapshot guard would
+    block log compaction forever."""
+    cfg = load(dev=True, overrides={"node_name": "orphan1",
+                                    "server": True})
+    s = Server(cfg)
+    s.start()
+    try:
+        wait_for(lambda: s.is_leader(), what="self-elect")
+        # hand-plant a partial group, then apply a normal write
+        s.raft._chunks["deadbeef"] = [b"x", None, None]
+        s.handle_rpc("KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": "k", "Value": b"v"}},
+            "local")
+        assert not s.raft._chunks, "orphaned group survived"
+    finally:
+        s.shutdown()
