@@ -64,7 +64,12 @@ class MaterializedView:
                 # may be transient, or the token may get granted later)
                 if self._err is not None and self._result is None:
                     raise RPCError(self._err)
-                if self._live and self._index > min_index:
+                # live feed, OR warm failover (submatview semantics):
+                # while the feed reconnects after a leader change,
+                # readers keep getting the last materialized result
+                # instead of blocking on the resubscribe
+                if self._index > min_index and \
+                        (self._live or self._result is not None):
                     return self._result, self._index
                 remaining = end - _time.monotonic()
                 if remaining <= 0:

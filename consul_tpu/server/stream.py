@@ -11,6 +11,7 @@ translates commits into typed events.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator, Optional
@@ -63,12 +64,73 @@ class Subscription:
             self.pub._cv.notify_all()
 
 
+class SnapshotCache:
+    """TTL'd single-flight snapshot cache (event_publisher.go:16-33
+    snapCacheTTL): when a thundering herd of subscribers lands on the
+    same (topic, subject) — the leader-failover case — ONE of them
+    builds the snapshot and the rest reuse it. A slightly stale
+    snapshot is correct because subscriptions then follow the event
+    buffer from the snapshot's index."""
+
+    def __init__(self, ttl: float = 2.0, metrics=None) -> None:
+        self.ttl = ttl
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # key -> (expires_at, (payload, index)) | (None, building_cv)
+        self._entries: dict[Any, tuple] = {}
+        self.builds = 0  # total snapshot builds (telemetry/tests)
+
+    def get(self, key: Any, build: Callable[[], tuple[Any, int]]
+            ) -> tuple[Any, int]:
+        while True:
+            with self._lock:
+                ent = self._entries.get(key)
+                if ent is not None:
+                    exp, val = ent
+                    if exp is None:
+                        # someone is building: wait on their cv. The
+                        # cv shares self._lock, so check-and-wait is
+                        # atomic — no missed wakeup between the entry
+                        # check and the wait
+                        val.wait(1.0)
+                        continue
+                    if exp > time.monotonic():
+                        return val
+                cv = threading.Condition(self._lock)
+                self._entries[key] = (None, cv)
+                break
+        try:
+            result = build()
+        except BaseException:
+            with self._lock:
+                self._entries.pop(key, None)
+                cv.notify_all()
+            raise
+        with self._lock:
+            self.builds += 1
+            if self.metrics is not None:
+                self.metrics.incr("stream.snapshot.built")
+            now = time.monotonic()
+            self._entries[key] = (now + self.ttl, result)
+            if len(self._entries) > 256:
+                # client-supplied scopes must not pin payloads forever:
+                # purge everything expired whenever the table grows
+                self._entries = {
+                    k: (exp, val)
+                    for k, (exp, val) in self._entries.items()
+                    if exp is None or exp > now}
+            cv.notify_all()
+        return result
+
+
 class EventPublisher:
-    def __init__(self, buffer_size: int = 2048) -> None:
+    def __init__(self, buffer_size: int = 2048,
+                 snapshot_ttl: float = 2.0) -> None:
         self._buffers: dict[str, deque[Event]] = {}
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self.buffer_size = buffer_size
+        self.snapshots = SnapshotCache(ttl=snapshot_ttl)
 
     def publish(self, ev: Event) -> None:
         with self._cv:

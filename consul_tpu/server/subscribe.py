@@ -48,8 +48,20 @@ def register_stream_endpoints(srv) -> None:
         if build is None:
             raise RPCError(f"unknown subscription topic {topic!r}")
         query = build(args)  # raises on ACL denial before any data
-        idx = srv.state.index
-        last = query()
+        # single-flight TTL snapshot cache (event_publisher.go:16-33):
+        # a failover herd of resubscribers on the same scope costs ONE
+        # snapshot build; followers ride the event buffer from the
+        # cached snapshot's index. The ACL check above ran per-caller —
+        # only the (identically scoped) RESULT is shared.
+        scope = (topic, args.get("Key", ""), args.get("Partition", ""))
+
+        def build_snapshot():
+            # index read BEFORE the query: a write racing the build
+            # then re-notifies (at-least-once) instead of being lost
+            i = srv.state.index
+            return query(), i
+
+        last, idx = srv.publisher.snapshots.get(scope, build_snapshot)
         # snapshot, then the explicit end-of-snapshot marker the
         # reference emits so views know they're live (subscribe proto)
         if not push({"Type": "snapshot", "Index": idx, "Payload": last}):
@@ -58,6 +70,18 @@ def register_stream_endpoints(srv) -> None:
             return
         sub = srv.publisher.subscribe(topic, index=idx)
         try:
+            # gap check: the cached snapshot's index may predate writes
+            # whose events already fell out of the ring buffer — if the
+            # store moved past idx, requery ONCE now instead of waiting
+            # for a future event that may never reference the gap
+            if srv.state.index > idx:
+                cur = query()
+                if cur != last:
+                    last = cur
+                    if not push({"Type": "update",
+                                 "Index": srv.state.index,
+                                 "Payload": cur}):
+                        return
             while not cancel.is_set():
                 ev = sub.next(timeout=0.5)
                 if ev is None:
