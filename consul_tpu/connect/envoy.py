@@ -156,28 +156,70 @@ def _rbac_http_filters(intentions: list[dict[str, Any]],
 def _tls_context(snapshot: dict[str, Any],
                  leaf: Optional[dict[str, Any]] = None) -> dict[str, Any]:
     leaf = leaf or snapshot["Leaf"]
-    # trust bundle: every root plus any rotation bridge certs, so both
-    # pre- and post-rotation peers verify
-    roots_pem = "".join(
-        r["RootCert"] + r.get("CrossSignedIntermediate", "")
-        for r in snapshot["Roots"])
     return {
         "common_tls_context": {
             "tls_certificates": [{
-                "certificate_chain": {"inline_string":
-                                      leaf.get("CertChainPEM")
-                                      or leaf["CertPEM"]},
+                "certificate_chain": {
+                    "inline_string": _leaf_chain_pem(leaf)},
                 "private_key": {"inline_string": leaf["PrivateKeyPEM"]},
             }],
             "validation_context": {
-                "trusted_ca": {"inline_string": roots_pem}},
+                "trusted_ca": {"inline_string": _trust_bundle_pem(
+                    snapshot)}},
         },
         "require_client_certificate": True,
     }
 
 
+def _sds_tls_context(service: str) -> dict[str, Any]:
+    """CommonTlsContext referencing ADS-delivered secrets (the shape
+    the xDS server emits; static bootstraps keep inline PEM)."""
+    ads = {"ads": {}, "resource_api_version": "V3"}
+    return {
+        "common_tls_context": {
+            "tls_certificate_sds_secret_configs": [
+                {"name": f"leaf:{service}", "sds_config": ads}],
+            "validation_context_sds_secret_config":
+                {"name": "roots", "sds_config": ads},
+        },
+        "require_client_certificate": True,
+    }
+
+
+def _trust_bundle_pem(snapshot: dict[str, Any]) -> str:
+    """Trust bundle: every root plus rotation bridge certs, so both
+    pre- and post-rotation peers verify. ONE composition shared by the
+    inline (_tls_context) and SDS (secrets_from_snapshot) forms — the
+    two modes must never verify against different bundles."""
+    return "".join(r["RootCert"] + r.get("CrossSignedIntermediate", "")
+                   for r in snapshot["Roots"])
+
+
+def _leaf_chain_pem(leaf: dict[str, Any]) -> str:
+    return leaf.get("CertChainPEM") or leaf["CertPEM"]
+
+
+def secrets_from_snapshot(snapshot: dict[str, Any]
+                          ) -> list[dict[str, Any]]:
+    """The Secret resources an SDS-mode config references: the
+    service's leaf keypair + the root trust bundle."""
+    leaf = snapshot["Leaf"]
+    return [
+        {"name": f"leaf:{snapshot.get('Service', '')}",
+         "tls_certificate": {
+             "certificate_chain": {
+                 "inline_string": _leaf_chain_pem(leaf)},
+             "private_key": {"inline_string": leaf["PrivateKeyPEM"]}}},
+        {"name": "roots",
+         "validation_context": {
+             "trusted_ca": {"inline_string": _trust_bundle_pem(
+                 snapshot)}}},
+    ]
+
+
 def bootstrap_config(snapshot: dict[str, Any],
-                     admin_port: int = 19000) -> dict[str, Any]:
+                     admin_port: int = 19000,
+                     sds: bool = False) -> dict[str, Any]:
     kind = snapshot.get("Kind", "connect-proxy")
     if kind == "ingress-gateway":
         return _ingress_bootstrap(snapshot, admin_port)
@@ -185,7 +227,15 @@ def bootstrap_config(snapshot: dict[str, Any],
         return _terminating_bootstrap(snapshot, admin_port)
     if kind == "mesh-gateway":
         return _mesh_bootstrap(snapshot, admin_port)
-    tls_context = _tls_context(snapshot)
+    svc = snapshot.get("Service", "")
+    if sds:
+        # SDS mode (xds secrets.go:18-27): TLS contexts REFERENCE
+        # secrets by name over ADS instead of inlining PEM — leaf
+        # rotation re-pushes only the Secret resource, the
+        # listener/cluster payloads stay byte-identical (no churn)
+        tls_context = _sds_tls_context(svc)
+    else:
+        tls_context = _tls_context(snapshot)
     pub = snapshot["PublicListener"]
     clusters = [{
         "name": "local_app",
@@ -274,8 +324,13 @@ def bootstrap_config(snapshot: dict[str, Any],
                  "cluster": snapshot["Service"],
                  "metadata": {"namespace": "default",
                               "trust_domain": snapshot["TrustDomain"]}},
-        "static_resources": {"listeners": listeners,
-                             "clusters": clusters},
+        # static_resources.secrets is the Bootstrap proto's real home
+        # for Secret resources; omitted entirely in inline mode so the
+        # static bootstrap stays minimal
+        "static_resources": {
+            "listeners": listeners, "clusters": clusters,
+            **({"secrets": secrets_from_snapshot(snapshot)}
+               if sds else {})},
     }
 
 

@@ -317,6 +317,8 @@ def to_pb_struct(d: dict[str, Any]) -> dict[str, Any]:
 CDS_TYPE = "type.googleapis.com/envoy.config.cluster.v3.Cluster"
 EDS_TYPE = "type.googleapis.com/envoy.config.endpoint.v3.ClusterLoadAssignment"
 LDS_TYPE = "type.googleapis.com/envoy.config.listener.v3.Listener"
+# SDS_TYPE lives in xds_proto (one definition); imported lazily below
+# because xds_proto imports CLA from this module (circular at load)
 
 # -------------------------- true-proto ClusterLoadAssignment (EDS payload)
 
@@ -363,7 +365,12 @@ def build_config(agent, proxy_id: str) -> Optional[dict[str, Any]]:
     snap = assemble_snapshot(agent, proxy_id)
     if snap is None:
         return None
-    return bootstrap_config(snap)
+    # ADS-served SIDECAR configs run in SDS mode (xds secrets.go): TLS
+    # contexts reference Secret resources, so leaf rotation re-versions
+    # only the SDS payload and the listener/cluster blobs stay
+    # byte-identical. Gateway kinds still inline PEM (their builders
+    # return before the sds branch — SDS for gateways is future work).
+    return bootstrap_config(snap, sds=True)
 
 
 def resources_from_cfg(cfg: dict[str, Any],
@@ -387,9 +394,10 @@ def resources_from_cfg(cfg: dict[str, Any],
             blob = encode_cla(c["name"], eps)
             out[c["name"]] = (_version(blob), blob)
         return out
-    from consul_tpu.server.xds_proto import (UnloweredShape,
+    from consul_tpu.server.xds_proto import (SDS_TYPE, UnloweredShape,
                                              lower_cluster,
-                                             lower_listener)
+                                             lower_listener,
+                                             lower_secret)
 
     if type_url == CDS_TYPE:
         rows = cfg["static_resources"]["clusters"]
@@ -397,6 +405,9 @@ def resources_from_cfg(cfg: dict[str, Any],
     elif type_url == LDS_TYPE:
         rows = cfg["static_resources"]["listeners"]
         lower = lower_listener
+    elif type_url == SDS_TYPE:
+        rows = cfg["static_resources"].get("secrets") or []
+        lower = lower_secret
     else:
         return {}
     for r in rows:

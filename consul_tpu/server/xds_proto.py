@@ -56,10 +56,33 @@ _STRING_MATCHER = {"exact": Field(1, "string"),
 _TLS_CERT = {"certificate_chain": Field(1, "message", _DATA_SOURCE),
              "private_key": Field(2, "message", _DATA_SOURCE)}
 _CERT_VALIDATION = {"trusted_ca": Field(1, "message", _DATA_SOURCE)}
+#: config.core.v3.ConfigSource, ADS arm (config_source.proto):
+#: ads=3 (AggregatedConfigSource, empty), resource_api_version=6
+#: (V3=2). ONE schema serves EDS cluster configs and SDS refs.
+_CONFIG_SOURCE_ADS = {"ads": Field(3, "message", {}, presence=True),
+                      "resource_api_version": Field(6, "enum")}
+#: secret.proto SdsSecretConfig: name=1, sds_config=2
+_SDS_SECRET_CONFIG = {"name": Field(1, "string"),
+                      "sds_config": Field(2, "message",
+                                          _CONFIG_SOURCE_ADS)}
 _COMMON_TLS = {
     "tls_certificates": Field(2, "message", _TLS_CERT, repeated=True),
     "validation_context": Field(3, "message", _CERT_VALIDATION),
+    #: SDS references (secrets.go:18-27): certs/roots served as
+    #: separate Secret resources so leaf rotation never churns the
+    #: listener/cluster that references them
+    "tls_certificate_sds_secret_configs":
+        Field(6, "message", _SDS_SECRET_CONFIG, repeated=True),
+    "validation_context_sds_secret_config":
+        Field(7, "message", _SDS_SECRET_CONFIG),
 }
+#: secret.proto Secret: name=1, oneof {tls_certificate=2,
+#: validation_context=4}
+_SECRET = {"name": Field(1, "string"),
+           "tls_certificate": Field(2, "message", _TLS_CERT),
+           "validation_context": Field(4, "message", _CERT_VALIDATION)}
+SDS_TYPE = ("type.googleapis.com/envoy.extensions."
+            "transport_sockets.tls.v3.Secret")
 _UPSTREAM_TLS = {"common_tls_context": Field(1, "message", _COMMON_TLS),
                  "sni": Field(2, "string")}
 _DOWNSTREAM_TLS = {
@@ -73,9 +96,8 @@ DOWNSTREAM_TLS_TYPE = ("type.googleapis.com/envoy.extensions."
 
 # ------------------------------------------------------------- clusters
 
-#: config.cluster.v3.Cluster.EdsClusterConfig
-_CONFIG_SOURCE_ADS = {"ads": Field(3, "message", {}, presence=True),
-                      "resource_api_version": Field(6, "enum")}  # V3=2
+#: config.cluster.v3.Cluster.EdsClusterConfig (_CONFIG_SOURCE_ADS is
+#: defined with the TLS specs above — same ConfigSource schema)
 _EDS_CLUSTER_CONFIG = {
     "eds_config": Field(1, "message", _CONFIG_SOURCE_ADS),
     "service_name": Field(2, "string"),
@@ -421,7 +443,43 @@ def _common_tls(d: dict[str, Any]) -> dict[str, Any]:
     if vc:
         out["validation_context"] = {
             "trusted_ca": _data_source(vc["trusted_ca"])}
+
+    def sds_ref(sc: dict[str, Any]) -> dict[str, Any]:
+        src = sc.get("sds_config") or {}
+        if "ads" not in src:
+            # lowering a file-path/api_config_source SDS ref to the
+            # ADS arm would leave Envoy waiting forever for a secret
+            # nobody pushes — fall back visibly instead
+            raise UnloweredShape(f"non-ADS sds_config {src!r}")
+        return {"name": sc.get("name", ""),
+                "sds_config": {"ads": {}, "resource_api_version": 2}}
+
+    if d.get("tls_certificate_sds_secret_configs"):
+        out["tls_certificate_sds_secret_configs"] = [
+            sds_ref(sc)
+            for sc in d["tls_certificate_sds_secret_configs"]]
+    if d.get("validation_context_sds_secret_config"):
+        out["validation_context_sds_secret_config"] = sds_ref(
+            d["validation_context_sds_secret_config"])
     return out
+
+
+def lower_secret(s: dict[str, Any]) -> bytes:
+    """envoy.extensions.transport_sockets.tls.v3.Secret JSON → proto
+    (the SDS payload; xds secrets.go makeSecrets)."""
+    msg: dict[str, Any] = {"name": s.get("name", "")}
+    if s.get("tls_certificate"):
+        tc = s["tls_certificate"]
+        msg["tls_certificate"] = {
+            "certificate_chain": _data_source(tc["certificate_chain"]),
+            "private_key": _data_source(tc["private_key"])}
+    elif s.get("validation_context"):
+        msg["validation_context"] = {
+            "trusted_ca": _data_source(
+                s["validation_context"]["trusted_ca"])}
+    else:
+        raise UnloweredShape(f"secret without payload {s!r}")
+    return encode(_SECRET, msg)
 
 
 def _transport_socket(ts: dict[str, Any]) -> dict[str, Any]:
