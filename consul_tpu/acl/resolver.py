@@ -104,9 +104,14 @@ class ACLResolver:
             cutoff = now - self.token_ttl * _EXTEND_FACTOR
             self._cache = {k: v for k, v in self._cache.items()
                            if v[0] >= cutoff}
-            while len(self._cache) > _CACHE_MAX:  # still full: oldest out
-                self._cache.pop(min(self._cache,
-                                    key=lambda k: self._cache[k][0]))
+            if len(self._cache) > _CACHE_MAX:
+                # still full: keep the newest half in ONE sorted pass —
+                # a per-insert min-scan would be O(n) on every resolve
+                # while over cap (an unknown-token flood lives there)
+                keep = sorted(self._cache.items(),
+                              key=lambda kv: kv[1][0],
+                              reverse=True)[:_CACHE_MAX // 2]
+                self._cache = dict(keep)
         return authz
 
     def _apply_down_policy(

@@ -368,6 +368,8 @@ class Server:
 
         # leader-side session TTL bookkeeping (session_ttl.go)
         self._session_expiry: dict[str, float] = {}
+        self._session_heap: list[tuple[float, str]] = []
+        self._sessions_seen_index = -1
         self._coord_updates: dict[str, dict[str, Any]] = {}
         self._coord_lock = threading.Lock()
         self._maybe_bootstrapped = False
@@ -964,18 +966,22 @@ class Server:
         pdc = self.config.primary_datacenter
         if pdc and pdc != self.config.datacenter:
             return
-        from consul_tpu.acl.resolver import token_expired
-
-        now = time.time()
-        for tok in self.state.raw_list("acl_tokens"):
-            if token_expired(tok, now):
-                try:
-                    self.raft.apply(encode_command(
-                        MessageType.ACL_TOKEN,
-                        {"Op": "delete", "Token": tok}))
-                except Exception as e:  # noqa: BLE001
-                    self.log.debug("token reap (retry next tick): %s", e)
-                    return
+        # expiry-sorted index: the tick pops O(expiring) tokens, never
+        # walking the table (the reference reaps via a memdb expiration
+        # index, leader_acl.go startACLTokenReaping)
+        batch = self.state.expired_tokens(time.time())
+        for n, tok in enumerate(batch):
+            try:
+                self.raft.apply(encode_command(
+                    MessageType.ACL_TOKEN,
+                    {"Op": "delete", "Token": tok}))
+            except Exception as e:  # noqa: BLE001
+                self.log.debug("token reap (retry next tick): %s", e)
+                # the pops were destructive: re-arm EVERYTHING not yet
+                # deleted, not just the failing token
+                for rest in batch[n:]:
+                    self.state.requeue_token_expiry(rest)
+                return
 
     # --------------------------------------------------- peerstream (dialer)
 
@@ -1299,23 +1305,59 @@ class Server:
             self._reconcile_member(m.name, m.addr, m.tags, ev)
 
     def _expire_sessions(self) -> None:
-        """Leader-side TTL timers (session_ttl.go)."""
+        """Leader-side TTL timers (session_ttl.go). The per-tick cost
+        is O(changes + expiring), not O(sessions): the table is only
+        rescanned when its index moved (new/destroyed sessions), and
+        expirations pop off a deadline heap. Renewals just overwrite
+        the authoritative deadline in _session_expiry; the stale heap
+        entry is skipped at pop time."""
+        import heapq
+
         now = time.monotonic()
-        for sess in self.state.session_list():
-            if not sess.ttl:
-                self._session_expiry.pop(sess.id, None)
+        idx = self.state.table_index("sessions")
+        if idx != self._sessions_seen_index:
+            self._sessions_seen_index = idx
+            live = set()
+            for sess in self.state.session_list():
+                if not sess.ttl:
+                    self._session_expiry.pop(sess.id, None)
+                    continue
+                live.add(sess.id)
+                if sess.id not in self._session_expiry:
+                    # TTLs doubled as a grace window (reference)
+                    dl = now + 2 * _parse_ttl(sess.ttl)
+                    self._session_expiry[sess.id] = dl
+                    heapq.heappush(self._session_heap, (dl, sess.id))
+            for sid in [s for s in self._session_expiry
+                        if s not in live]:
+                self._session_expiry.pop(sid, None)
+        while self._session_heap and self._session_heap[0][0] <= now:
+            dl, sid = heapq.heappop(self._session_heap)
+            cur = self._session_expiry.get(sid)
+            if cur is None:
+                continue  # destroyed meanwhile
+            if cur > dl:
+                # renewed: re-arm at the authoritative deadline
+                heapq.heappush(self._session_heap, (cur, sid))
                 continue
-            ttl = _parse_ttl(sess.ttl)
-            exp = self._session_expiry.get(sess.id)
-            if exp is None:
-                # TTLs are doubled as a grace window (reference behavior)
-                self._session_expiry[sess.id] = now + 2 * ttl
-            elif now >= exp:
-                self.log.info("expiring session %s (TTL %s)", sess.id,
-                              sess.ttl)
+            sess = self.state.session_get(sid)
+            if sess is None:
+                self._session_expiry.pop(sid, None)
+                continue
+            self.log.info("expiring session %s (TTL %s)", sid,
+                          sess.ttl)
+            try:
                 self.raft.apply(encode_command(MessageType.SESSION, {
-                    "Op": "destroy", "Session": sess.id}))
-                self._session_expiry.pop(sess.id, None)
+                    "Op": "destroy", "Session": sid}))
+            except Exception as e:  # noqa: BLE001
+                # the pop was destructive: re-arm so the destroy
+                # retries next tick instead of leaking the session
+                # (and the KV locks it holds) forever
+                heapq.heappush(self._session_heap, (dl, sid))
+                self.log.debug("session expiry (retry next tick): %s",
+                               e)
+                return
+            self._session_expiry.pop(sid, None)
 
     def _usage_metrics(self) -> None:
         """Periodic usage gauges (agent/consul/usagemetrics)."""
@@ -1363,8 +1405,14 @@ class Server:
         if sess is None:
             return False
         if sess.ttl:
-            self._session_expiry[sid] = \
-                time.monotonic() + 2 * _parse_ttl(sess.ttl)
+            import heapq
+
+            dl = time.monotonic() + 2 * _parse_ttl(sess.ttl)
+            self._session_expiry[sid] = dl
+            # always push: a renew can land BEFORE the rescan tick ever
+            # armed this session, and the pop loop is the only expiry
+            # path (duplicate entries are skipped/re-armed at pop)
+            heapq.heappush(self._session_heap, (dl, sid))
         return True
 
     # ----------------------------------------------------- coordinate batch

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import fnmatch
+import heapq
 import threading
 from typing import Any, Callable, Iterable, Optional
 
@@ -61,6 +62,11 @@ class StateStore:
         # change hooks (the stream publisher seam — event streaming feeds
         # from here like catalog_events.go feeds the EventPublisher)
         self._change_hooks: list[Callable[[str, int], None]] = []
+        # expiry-sorted ACL token index (the reference reaps via a
+        # memdb expiration index, leader_acl.go): the leader tick pops
+        # O(expiring) instead of scanning the whole table. Entries are
+        # lazy — deleted tokens are skipped at pop time.
+        self._token_expiry: list[tuple[float, str]] = []
         # v2 resource table (internal/storage): its own watchable store,
         # bumping the "resources" index so v1-style blocking queries can
         # also ride it
@@ -632,7 +638,69 @@ class StateStore:
         one place for FSM handlers."""
         with self._lock:
             self.tables[table][key] = value
+            if table == "acl_tokens" and isinstance(value, dict) \
+                    and value.get("ExpirationTime"):
+                try:
+                    exp = float(value["ExpirationTime"])
+                except (TypeError, ValueError):
+                    exp = None  # unindexable junk must not break the
+                    #             upsert/_bump (watchers would starve)
+                if exp is not None:
+                    heapq.heappush(self._token_expiry, (exp, str(key)))
+                # followers never drain the heap and re-sets push
+                # duplicates: compact by rebuilding from the table
+                # once the heap outgrows it (amortized O(1)/insert)
+                if len(self._token_expiry) > \
+                        2 * len(self.tables["acl_tokens"]) + 1024:
+                    self._rebuild_token_expiry_locked()
             return self._bump(table)
+
+    def _rebuild_token_expiry_locked(self) -> None:
+        heap = []
+        for sid, t in self.tables["acl_tokens"].items():
+            if isinstance(t, dict) and t.get("ExpirationTime"):
+                try:
+                    heap.append((float(t["ExpirationTime"]), str(sid)))
+                except (TypeError, ValueError):
+                    pass
+        heapq.heapify(heap)
+        self._token_expiry = heap
+
+    def expired_tokens(self, now: float,
+                       limit: int = 256) -> list[dict[str, Any]]:
+        """Pop tokens whose ExpirationTime <= now — O(expired), not
+        O(table). Stale heap entries (token already deleted, or a
+        replication overwrite with no expiry) are skipped; expiration
+        is immutable after create, so an entry never needs re-pushing.
+        `limit` bounds one tick's raft work under a mass-expiry."""
+        out: list[dict[str, Any]] = []
+        seen: set[str] = set()  # duplicate heap entries → one delete
+        with self._lock:
+            heap = self._token_expiry
+            while heap and heap[0][0] <= now and len(out) < limit:
+                _, sid = heapq.heappop(heap)
+                if sid in seen:
+                    continue
+                tok = self.tables["acl_tokens"].get(sid)
+                if not isinstance(tok, dict):
+                    continue
+                exp = tok.get("ExpirationTime")
+                try:
+                    if exp and float(exp) <= now:
+                        seen.add(sid)
+                        out.append(tok)
+                except (TypeError, ValueError):
+                    continue
+        return out
+
+    def requeue_token_expiry(self, tok: dict[str, Any]) -> None:
+        """Re-arm a popped token whose reap raft-apply failed — it must
+        reap on a later tick, not linger forever."""
+        if tok.get("ExpirationTime"):
+            with self._lock:
+                heapq.heappush(self._token_expiry,
+                               (float(tok["ExpirationTime"]),
+                                str(tok.get("SecretID", ""))))
 
     def raw_delete(self, table: str, key: Any) -> int:
         with self._lock:
@@ -698,6 +766,9 @@ class StateStore:
             for t in RAW_TABLES:
                 self.tables[t] = blob.get(t, {})
             self._kv_tombstones = dict(blob.get("kv_tombstones", {}))
+            # rebuild the token expiry index from the restored table
+            # (a later promotion to leader reaps from this heap)
+            self._rebuild_token_expiry_locked()
             # replace (or, for pre-resource snapshots, clear) the v2
             # table — restore means the WHOLE store. Closes resource
             # watches: post-restore events can't extend the pre-restore
