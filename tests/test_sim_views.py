@@ -202,3 +202,31 @@ def test_sharded_views_on_device_mesh(devices8):
     m = view_metrics(jax.device_get(st))
     assert m["view_divergence"] == 0.0 and m["fp_rate"] == 0.0
     assert m["max_incarnation"] >= 1
+
+
+def test_all_to_all_exchange_matches_pmax(devices8):
+    """VERDICT round-3 #8: the grouped all_to_all max-reduce-scatter
+    must be BIT-IDENTICAL to the pmax all-reduce it replaces (same
+    keys, same per-device partials, only the collective differs) —
+    while moving half the bytes per gossip tick."""
+    from consul_tpu.sim.views import (make_sharded_views_round,
+                                      make_views_mesh)
+
+    p = SimParams(n=128, loss=0.10, fail_per_round=0.005)
+    mesh = make_views_mesh(devices8)
+    r_a2a, init_fn = make_sharded_views_round(p, mesh,
+                                              exchange="all_to_all")
+    r_pmax, _ = make_sharded_views_round(p, mesh, exchange="pmax")
+    st_a, st_p = init_fn(), init_fn()
+    key = jax.random.key(11)
+    # >30 rounds so the ~30-round push/pull sync fires — BOTH
+    # max_scatter call sites (gossip tick AND push/pull) must agree
+    for _ in range(35):
+        key, k = jax.random.split(key)
+        st_a = r_a2a(st_a, k)
+        st_p = r_pmax(st_p, k)
+    a = jax.device_get(st_a)
+    b = jax.device_get(st_p)
+    for f in ("status", "inc", "budget", "lh", "susp_deadline"):
+        assert (getattr(a, f) == getattr(b, f)).all(), \
+            f"{f} diverged between the exchanges"
