@@ -1,12 +1,17 @@
-"""The web UI: a single self-contained page served at /ui.
+"""The web UI: a self-contained single-page app served at /ui.
 
 Reference: ui/packages/consul-ui (an 841-file Ember app) served by
 agent/uiserver. This is deliberately NOT a port of that app — it is a
-dependency-free page over the same UI data API the reference's app
-consumes (ui_endpoint.go analogues at /v1/internal/ui/*), covering the
-operator's daily loop: service health rollups, node check detail, and
-KV browsing, live-updating via blocking queries (X-Consul-Index
-long-polls, the same change feed the Ember app rides)."""
+dependency-free SPA over the same UI data API the reference's app
+consumes (ui_endpoint.go analogues at /v1/internal/ui/* plus the
+public catalog/connect routes), covering the operator's daily loop:
+
+  services → service instances → sidecar proxy detail
+  intentions list + editor (L4 allow/deny and L7 permission JSON)
+  nodes with check detail, KV browser
+
+Every list view live-updates via blocking queries (X-Consul-Index
+long-polls — the same change feed the Ember app rides)."""
 
 from __future__ import annotations
 
@@ -27,7 +32,7 @@ INDEX_HTML = """<!doctype html>
   header nav a { color:#cbd5e1; text-decoration:none; margin-right:16px;
                  padding-bottom:2px; }
   header nav a.active { color:#fff; border-bottom:2px solid #60a5fa; }
-  main { max-width:980px; margin:20px auto; padding:0 16px; }
+  main { max-width:1080px; margin:20px auto; padding:0 16px; }
   table { width:100%; border-collapse:collapse; background:#fff;
           border:1px solid var(--line); }
   th,td { text-align:left; padding:8px 12px;
@@ -39,12 +44,28 @@ INDEX_HTML = """<!doctype html>
   .critical { background:var(--crit); }
   .tag { background:#eef2ff; border-radius:3px; padding:1px 6px;
          margin-right:4px; font-size:12px; }
+  .l7 { background:#fef3c7; border-radius:3px; padding:1px 6px;
+        font-size:12px; }
+  .deny { color:var(--crit); font-weight:600; }
+  .allow { color:var(--ok); font-weight:600; }
   .mut { color:var(--mut); font-size:12px; }
-  input[type=text] { padding:6px 10px; border:1px solid var(--line);
-                     border-radius:4px; width:320px; }
+  input[type=text], select { padding:6px 10px; border:1px solid
+       var(--line); border-radius:4px; }
+  input[type=text] { width:220px; }
+  textarea { width:100%; min-height:80px; font:12px/1.4 monospace;
+             border:1px solid var(--line); border-radius:4px; }
+  button { padding:6px 12px; border:1px solid var(--line);
+           border-radius:4px; background:#fff; cursor:pointer; }
+  button.primary { background:#1f2430; color:#fff; }
+  button.danger { color:var(--crit); }
   pre { background:#fff; border:1px solid var(--line); padding:10px;
         overflow:auto; }
   .crumb a { text-decoration:none; }
+  form.ixn { display:flex; gap:8px; flex-wrap:wrap; margin:14px 0;
+             align-items:center; background:#fff; padding:12px;
+             border:1px solid var(--line); }
+  .err { color:var(--crit); margin:8px 0; }
+  a.rowlink { text-decoration:none; color:inherit; font-weight:600; }
 </style>
 </head>
 <body>
@@ -53,6 +74,7 @@ INDEX_HTML = """<!doctype html>
   <nav id="nav">
     <a href="#services">Services</a>
     <a href="#nodes">Nodes</a>
+    <a href="#intentions">Intentions</a>
     <a href="#kv">Key/Value</a>
   </nav>
   <span class="mut" id="meta"></span>
@@ -82,11 +104,14 @@ function dot(status) {
   return `<span class="dot ${esc(status)}"></span>`;
 }
 
+// ------------------------------------------------------------ services
+
 async function services(wait) {
   const rows = await fetchIdx("/v1/internal/ui/services", "svc", wait);
   $("#view").innerHTML = `<table><tr><th>Service</th><th>Health</th>
     <th>Instances</th><th>Tags</th></tr>` + rows.map((s) => `<tr>
-    <td>${dot(s.Status)}${esc(s.Name)}
+    <td>${dot(s.Status)}<a class="rowlink"
+        href="#service:${esc(s.Name)}">${esc(s.Name)}</a>
         ${s.Kind ? `<span class="mut">(${esc(s.Kind)})</span>` : ""}</td>
     <td>${s.ChecksPassing} passing${s.ChecksWarning
           ? `, ${s.ChecksWarning} warning` : ""}${s.ChecksCritical
@@ -95,6 +120,203 @@ async function services(wait) {
     <td>${(s.Tags || []).map((t) => `<span class="tag">${esc(t)}</span>`)
          .join("")}</td></tr>`).join("") + "</table>";
 }
+
+// service detail: instances + their sidecar proxies (the app loop's
+// second hop; /v1/health/service carries Service.Proxy for sidecars)
+async function service(wait) {
+  // the browser percent-encodes fragments: decode before reuse
+  const name = decodeURIComponent(
+    location.hash.slice("#service:".length));
+  const [inst, side] = await Promise.all([
+    fetchIdx(`/v1/health/service/${encodeURIComponent(name)}`,
+             "inst:" + name, wait),
+    fetch(`/v1/health/service/${encodeURIComponent(name)}-sidecar-proxy`,
+          {signal: aborter.signal}).then((r) => r.json())
+      .catch(() => []),
+  ]);
+  const proxies = {};  // instance service id -> sidecar entry
+  for (const e of (Array.isArray(side) ? side : [])) {
+    const dst = e.Service.Proxy?.DestinationServiceID
+             || e.Service.Proxy?.DestinationServiceName;
+    proxies[dst] = e;
+  }
+  const rows = (Array.isArray(inst) ? inst : []).map((e) => {
+    const checks = (e.Checks || []).map((c) =>
+      `${dot(c.Status)}<span title="${esc(c.Output)}">${esc(c.Name)}
+       </span>`).join(" &nbsp; ");
+    const p = proxies[e.Service.ID] || proxies[e.Service.Service];
+    const plink = p
+      ? `<a href="#proxy:${esc(name)}:${esc(p.Service.ID)}">${
+          esc(p.Service.ID)}</a>`
+      : "<span class='mut'>—</span>";
+    return `<tr><td>${esc(e.Service.ID)}</td>
+      <td>${esc(e.Node.Node)}</td>
+      <td>${esc(e.Service.Address || e.Node.Address)}:${
+           e.Service.Port}</td>
+      <td>${checks}</td><td>${plink}</td></tr>`;
+  }).join("");
+  $("#view").innerHTML = `<p class="crumb">
+      <a href="#services">← services</a></p>
+    <h3>${esc(name)}</h3>
+    <table><tr><th>Instance</th><th>Node</th><th>Address</th>
+    <th>Checks</th><th>Sidecar proxy</th></tr>${rows ||
+      "<tr><td colspan=5 class='mut'>(no instances)</td></tr>"}</table>`;
+}
+
+// proxy detail: destination, local app address, upstreams (third hop)
+async function proxy() {
+  const rest = decodeURIComponent(
+    location.hash.slice("#proxy:".length));
+  const i = rest.indexOf(":");
+  const svc = rest.slice(0, i), pid = rest.slice(i + 1).trim();
+  const side = await fetch(
+    `/v1/health/service/${encodeURIComponent(svc)}-sidecar-proxy`,
+    {signal: aborter.signal}).then((r) => r.json()).catch(() => []);
+  const e = (Array.isArray(side) ? side : []).find(
+    (x) => x.Service.ID === pid);
+  if (!e) {
+    $("#view").innerHTML = `<p class="err">proxy ${esc(pid)} not
+      found</p>`;
+    return;
+  }
+  const p = e.Service.Proxy || {};
+  const ups = (p.Upstreams || []).map((u) => `<tr>
+    <td><a href="#service:${esc(u.DestinationName)}">${
+        esc(u.DestinationName)}</a></td>
+    <td>127.0.0.1:${u.LocalBindPort || "?"}</td>
+    <td id="chk-${esc(u.DestinationName)}" class="mut">checking…</td>
+    </tr>`).join("");
+  $("#view").innerHTML = `<p class="crumb">
+      <a href="#service:${esc(svc)}">← ${esc(svc)}</a></p>
+    <h3>${esc(pid)} <span class="mut">on ${esc(e.Node.Node)}</span></h3>
+    <table>
+      <tr><th>Destination</th><td>${esc(p.DestinationServiceName
+        || svc)}</td></tr>
+      <tr><th>Proxy address</th><td>${esc(e.Service.Address
+        || e.Node.Address)}:${e.Service.Port}</td></tr>
+      <tr><th>Local app</th><td>127.0.0.1:${p.LocalServicePort
+        || "?"}</td></tr>
+    </table>
+    <h4>Upstreams</h4>
+    <table><tr><th>Service</th><th>Local bind</th>
+      <th>Intention</th></tr>${ups ||
+      "<tr><td colspan=3 class='mut'>(none)</td></tr>"}</table>
+    <h4>Raw proxy config</h4>
+    <pre>${esc(JSON.stringify(p, null, 2))}</pre>`;
+  // live intention verdict per upstream (the check endpoint)
+  for (const u of (p.Upstreams || [])) {
+    const src = p.DestinationServiceName || svc;
+    fetch(`/v1/connect/intentions/check?source=${
+      encodeURIComponent(src)}&destination=${
+      encodeURIComponent(u.DestinationName)}`)
+      .then((r) => r.json()).then((c) => {
+        const el = document.getElementById("chk-" + u.DestinationName);
+        if (el) el.innerHTML = c.Allowed
+          ? "<span class='allow'>allowed</span>"
+          : `<span class='deny'>denied</span>
+             <span class="mut">${esc(c.Reason || "")}</span>`;
+      }).catch(() => {});
+  }
+}
+
+// ---------------------------------------------------------- intentions
+
+const onIntentions = () =>
+  (location.hash || "#services").startsWith("#intentions");
+
+async function intentions(wait) {
+  // the form renders ONCE and stays stable across live updates —
+  // only the table re-renders, so a long-poll completing mid-edit
+  // cannot wipe what the operator is typing
+  if (!$("#ixn-form")) {
+    $("#view").innerHTML = `
+    <form class="ixn" id="ixn-form">
+      <input type="text" id="ixn-src" placeholder="source (* ok)"
+             required>
+      <span>→</span>
+      <input type="text" id="ixn-dst" placeholder="destination"
+             required>
+      <select id="ixn-act">
+        <option value="allow">allow</option>
+        <option value="deny">deny</option>
+        <option value="l7">L7 permissions…</option>
+      </select>
+      <button class="primary" type="submit">Create</button>
+      <div id="ixn-l7-wrap" style="display:none; width:100%">
+        <textarea id="ixn-l7" placeholder='[{"Action": "deny",
+ "HTTP": {"PathPrefix": "/admin"}}, {"Action": "allow",
+ "HTTP": {"PathPrefix": "/", "Methods": ["GET"]}}]'></textarea>
+        <span class="mut">Ordered permission list (JSON). Requires the
+        destination's service-defaults Protocol http/http2/grpc.</span>
+      </div>
+      <div class="err" id="ixn-err"></div>
+    </form>
+    <div id="ixn-table"></div>`;
+    $("#ixn-act").addEventListener("change", (ev) => {
+      $("#ixn-l7-wrap").style.display =
+        ev.target.value === "l7" ? "block" : "none";
+    });
+    $("#ixn-form").addEventListener("submit", async (ev) => {
+      ev.preventDefault();
+      const body = {SourceName: $("#ixn-src").value.trim(),
+                    DestinationName: $("#ixn-dst").value.trim()};
+      const act = $("#ixn-act").value;
+      if (act === "l7") {
+        try { body.Permissions = JSON.parse($("#ixn-l7").value); }
+        catch (e) {
+          $("#ixn-err").textContent = "Permissions: " + e.message;
+          return;
+        }
+      } else { body.Action = act; }
+      const r = await fetch("/v1/connect/intentions", {
+        method: "PUT", body: JSON.stringify(body)});
+      if (!onIntentions()) return;  // user navigated away mid-flight
+      if (!r.ok) { $("#ixn-err").textContent = await r.text(); return; }
+      $("#ixn-err").textContent = "";
+      index["ixn"] = 0;  // immediate re-render
+      intentions(false).catch(() => {});
+    });
+  }
+  const rows = await fetchIdx("/v1/connect/intentions", "ixn", wait);
+  if (!onIntentions() || !$("#ixn-table")) return;
+  const list = (Array.isArray(rows) ? rows : []).sort((a, b) =>
+    (b.Precedence || 0) - (a.Precedence || 0));
+  $("#ixn-table").innerHTML =
+    `<table><tr><th>Source</th><th></th><th>Destination</th>
+      <th>Action</th><th>Precedence</th><th></th></tr>` +
+    list.map((i) => `<tr>
+      <td>${esc(i.SourceName)}</td><td>→</td>
+      <td>${esc(i.DestinationName)}</td>
+      <td>${i.Permissions && i.Permissions.length
+        ? `<span class="l7">L7 · ${i.Permissions.length}
+           permission${i.Permissions.length > 1 ? "s" : ""}</span>
+           <details><summary class="mut">show</summary>
+           <pre>${esc(JSON.stringify(i.Permissions, null, 1))}</pre>
+           </details>`
+        : `<span class="${esc(i.Action || "allow")}">${
+           esc(i.Action || "allow")}</span>`}</td>
+      <td>${i.Precedence ?? ""}</td>
+      <td><button class="danger" data-src="${esc(i.SourceName)}"
+          data-dst="${esc(i.DestinationName)}">delete</button></td>
+      </tr>`).join("") +
+    `${list.length ? "" : "<tr><td colspan=6 class='mut'>(no " +
+      "intentions — the mesh default applies)</td></tr>"}</table>`;
+  document.querySelectorAll("#ixn-table button[data-src]").forEach((b) =>
+    b.addEventListener("click", async () => {
+      const r = await fetch(`/v1/connect/intentions/exact?source=${
+        encodeURIComponent(b.dataset.src)}&destination=${
+        encodeURIComponent(b.dataset.dst)}`, {method: "DELETE"});
+      if (!onIntentions()) return;  // user navigated away mid-flight
+      if (!r.ok) {
+        $("#ixn-err").textContent = "delete failed: " + await r.text();
+        return;
+      }
+      index["ixn"] = 0;
+      intentions(false).catch(() => {});
+    }));
+}
+
+// --------------------------------------------------------------- nodes
 
 async function nodes(wait) {
   const rows = await fetchIdx("/v1/internal/ui/nodes", "node", wait);
@@ -105,6 +327,8 @@ async function nodes(wait) {
       `${dot(c.Status)}<span title="${esc(c.Output)}">${esc(c.Name)}
        </span>`).join(" &nbsp; ")}</td></tr>`).join("") + "</table>";
 }
+
+// ----------------------------------------------------------------- KV
 
 async function kv(wait, prefix) {
   prefix = prefix ?? (location.hash.split(":")[1] || "");
@@ -144,19 +368,24 @@ async function kvval() {
        Flags ${e ? e.Flags : "?"}</p>`;
 }
 
-const views = {services, nodes, kv};
+// -------------------------------------------------------------- router
+
+const views = {services, nodes, kv, intentions, service};
+const LIVE = new Set(["services", "nodes", "intentions", "service"]);
 async function route() {
   if (aborter) aborter.abort();
   aborter = new AbortController();
   const tab = (location.hash || "#services").slice(1).split(":")[0];
+  const navTab = {kvval: "kv", service: "services",
+                  proxy: "services"}[tab] || tab;
   document.querySelectorAll("#nav a").forEach((a) =>
-    a.classList.toggle("active", a.hash.slice(1) === tab ||
-      (tab === "kvval" && a.hash === "#kv")));
+    a.classList.toggle("active", a.hash.slice(1) === navTab));
   try {
     if (tab === "kvval") { await kvval(); return; }
+    if (tab === "proxy") { await proxy(); return; }
     const fn = views[tab] || services;
     await fn(false);
-    while (tab !== "kv") { await fn(true); }  // live updates
+    while (LIVE.has(tab)) { await fn(true); }  // live updates
   } catch (e) { /* aborted on navigation */ }
 }
 window.addEventListener("hashchange", route);

@@ -646,6 +646,32 @@ def test_web_ui_served(agent, client):
             body = r.read().decode()
         assert "consul-tpu" in body
         assert "/v1/internal/ui/services" in body  # data API wired
+        # the app loop's three hops + the intentions editor are wired
+        for marker in ("#service:", "#proxy:", "#intentions",
+                       "ixn-form", "/v1/connect/intentions",
+                       "/v1/connect/intentions/check",
+                       "-sidecar-proxy"):
+            assert marker in body, f"UI missing {marker!r}"
+
+
+def test_web_ui_app_loop_data(agent, client):
+    """The request sequence the SPA's three-hop drill-down performs
+    (services → instances+sidecars → proxy detail + intention check)
+    works against a live agent with a registered mesh service."""
+    client.service_register({
+        "Name": "uiapp", "ID": "uiapp1", "Port": 9000,
+        "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+            {"DestinationName": "uidb", "LocalBindPort": 9901}]}}}})
+    wait_for(lambda: client.health_service("uiapp"),
+             what="uiapp in catalog")
+    side = client.get("/v1/health/service/uiapp-sidecar-proxy")
+    assert side, "sidecar instance missing"
+    prox = side[0]["Service"]["Proxy"]
+    assert prox["DestinationServiceName"] == "uiapp"
+    assert prox["Upstreams"][0]["DestinationName"] == "uidb"
+    chk = client.get(
+        "/v1/connect/intentions/check?source=uiapp&destination=uidb")
+    assert "Allowed" in chk
 
 
 def test_agent_persists_registrations_across_restart(tmp_path):
