@@ -199,22 +199,38 @@ def _leaf_chain_pem(leaf: dict[str, Any]) -> str:
     return leaf.get("CertChainPEM") or leaf["CertPEM"]
 
 
+def _leaf_secret(name: str, leaf: dict[str, Any]) -> dict[str, Any]:
+    return {"name": f"leaf:{name}",
+            "tls_certificate": {
+                "certificate_chain": {
+                    "inline_string": _leaf_chain_pem(leaf)},
+                "private_key": {
+                    "inline_string": leaf["PrivateKeyPEM"]}}}
+
+
+def _roots_secret(snapshot: dict[str, Any]) -> dict[str, Any]:
+    return {"name": "roots",
+            "validation_context": {
+                "trusted_ca": {
+                    "inline_string": _trust_bundle_pem(snapshot)}}}
+
+
 def secrets_from_snapshot(snapshot: dict[str, Any]
                           ) -> list[dict[str, Any]]:
     """The Secret resources an SDS-mode config references: the
-    service's leaf keypair + the root trust bundle."""
-    leaf = snapshot["Leaf"]
-    return [
-        {"name": f"leaf:{snapshot.get('Service', '')}",
-         "tls_certificate": {
-             "certificate_chain": {
-                 "inline_string": _leaf_chain_pem(leaf)},
-             "private_key": {"inline_string": leaf["PrivateKeyPEM"]}}},
-        {"name": "roots",
-         "validation_context": {
-             "trusted_ca": {"inline_string": _trust_bundle_pem(
-                 snapshot)}}},
-    ]
+    service's (or gateway's) leaf keypair + the root trust bundle. A
+    terminating gateway serves one leaf PER LINKED SERVICE instead of
+    its own (its chains present each service's identity and nothing
+    references the gateway leaf). A linked service without a Leaf
+    raises here — loudly, like the inline path — rather than emitting
+    a dangling SDS ref that would leave Envoy's listener warming
+    forever."""
+    if snapshot.get("Kind") == "terminating-gateway":
+        return [_leaf_secret(s["Name"], s["Leaf"])
+                for s in snapshot.get("Services") or []] \
+            + [_roots_secret(snapshot)]
+    return [_leaf_secret(snapshot.get("Service", ""), snapshot["Leaf"]),
+            _roots_secret(snapshot)]
 
 
 def bootstrap_config(snapshot: dict[str, Any],
@@ -222,10 +238,11 @@ def bootstrap_config(snapshot: dict[str, Any],
                      sds: bool = False) -> dict[str, Any]:
     kind = snapshot.get("Kind", "connect-proxy")
     if kind == "ingress-gateway":
-        return _ingress_bootstrap(snapshot, admin_port)
+        return _ingress_bootstrap(snapshot, admin_port, sds=sds)
     if kind == "terminating-gateway":
-        return _terminating_bootstrap(snapshot, admin_port)
+        return _terminating_bootstrap(snapshot, admin_port, sds=sds)
     if kind == "mesh-gateway":
+        # pure SNI passthrough, no TLS termination → nothing to serve
         return _mesh_bootstrap(snapshot, admin_port)
     svc = snapshot.get("Service", "")
     if sds:
@@ -518,31 +535,35 @@ def _endpoints(cluster: str, eps: list[dict[str, Any]]) -> dict[str, Any]:
 
 
 def _assemble(snapshot: dict[str, Any], admin_port: int,
-              listeners: list, clusters: list) -> dict[str, Any]:
+              listeners: list, clusters: list,
+              secrets: list | None = None) -> dict[str, Any]:
     return {
         "admin": {"address": _addr("127.0.0.1", admin_port)},
         "node": {"id": snapshot["ProxyID"],
                  "cluster": snapshot["Service"],
                  "metadata": {"namespace": "default",
                               "trust_domain": snapshot["TrustDomain"]}},
-        "static_resources": {"listeners": listeners,
-                             "clusters": clusters},
+        "static_resources": {
+            "listeners": listeners, "clusters": clusters,
+            **({"secrets": secrets} if secrets is not None else {})},
     }
 
 
 def _ingress_bootstrap(snapshot: dict[str, Any],
-                       admin_port: int) -> dict[str, Any]:
+                       admin_port: int,
+                       sds: bool = False) -> dict[str, Any]:
     """Ingress gateway: outside traffic in, dialed into the mesh over
     mTLS with the GATEWAY's identity (agent/xds for ingress-gateway).
     One Envoy listener per config-entry listener; http listeners get a
     virtual host per service keyed on its Hosts."""
+    gw_ctx = _sds_tls_context(snapshot.get("Service", "")) if sds \
+        else _tls_context(snapshot)
     upstream_tls = {
         "name": "tls",
         "typed_config": {
             "@type": "type.googleapis.com/envoy.extensions."
                      "transport_sockets.tls.v3.UpstreamTlsContext",
-            "common_tls_context":
-                _tls_context(snapshot)["common_tls_context"]}}
+            "common_tls_context": gw_ctx["common_tls_context"]}}
     listeners, clusters, seen = [], [], set()
     addr = snapshot.get("Address") or "0.0.0.0"
     for lst in snapshot.get("Listeners") or []:
@@ -605,11 +626,14 @@ def _ingress_bootstrap(snapshot: dict[str, Any],
             listeners.append({
                 "name": lname, "address": _addr(addr, port),
                 "filter_chains": [{"filters": [hcm]}]})
-    return _assemble(snapshot, admin_port, listeners, clusters)
+    return _assemble(snapshot, admin_port, listeners, clusters,
+                     secrets=secrets_from_snapshot(snapshot)
+                     if sds else None)
 
 
 def _terminating_bootstrap(snapshot: dict[str, Any],
-                           admin_port: int) -> dict[str, Any]:
+                           admin_port: int,
+                           sds: bool = False) -> dict[str, Any]:
     """Terminating gateway: one mTLS listener whose filter chains match
     mesh SNI per linked service; each chain presents THAT service's
     leaf, enforces its intentions via RBAC, and forwards to the
@@ -641,7 +665,8 @@ def _terminating_bootstrap(snapshot: dict[str, Any],
                     "@type": "type.googleapis.com/envoy.extensions."
                              "transport_sockets.tls.v3."
                              "DownstreamTlsContext",
-                    **_tls_context(snapshot, leaf=s["Leaf"])}},
+                    **(_sds_tls_context(name) if sds else
+                       _tls_context(snapshot, leaf=s["Leaf"]))}},
             "filters": filters})
     listeners.append({
         "name": "terminating_gateway",
@@ -654,7 +679,9 @@ def _terminating_bootstrap(snapshot: dict[str, Any],
                          "filters.listener.tls_inspector.v3."
                          "TlsInspector"}}],
         "filter_chains": chains})
-    return _assemble(snapshot, admin_port, listeners, clusters)
+    return _assemble(snapshot, admin_port, listeners, clusters,
+                     secrets=secrets_from_snapshot(snapshot)
+                     if sds else None)
 
 
 def _mesh_bootstrap(snapshot: dict[str, Any],

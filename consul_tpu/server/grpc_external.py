@@ -365,11 +365,11 @@ def build_config(agent, proxy_id: str) -> Optional[dict[str, Any]]:
     snap = assemble_snapshot(agent, proxy_id)
     if snap is None:
         return None
-    # ADS-served SIDECAR configs run in SDS mode (xds secrets.go): TLS
-    # contexts reference Secret resources, so leaf rotation re-versions
-    # only the SDS payload and the listener/cluster blobs stay
-    # byte-identical. Gateway kinds still inline PEM (their builders
-    # return before the sds branch — SDS for gateways is future work).
+    # ADS-served configs run in SDS mode (xds secrets.go): TLS contexts
+    # reference Secret resources, so leaf rotation re-versions only the
+    # SDS payload and the listener/cluster blobs stay byte-identical.
+    # Covers sidecars, ingress (gateway leaf) and terminating gateways
+    # (one secret per linked service); mesh gateways terminate no TLS.
     return bootstrap_config(snap, sds=True)
 
 

@@ -225,3 +225,52 @@ def test_ingress_tcp_listener_keeps_split_weights():
     wc = filt["typed_config"]["weighted_clusters"]["clusters"]
     assert {(c["name"], c["weight"]) for c in wc} == \
         {("ingress_db_db", 90), ("ingress_db_db-canary", 10)}
+
+
+def test_gateway_sds_mode():
+    """SDS covers gateways too: ingress references the GATEWAY's leaf;
+    a terminating gateway serves one secret per linked service (it
+    presents THAT service's identity) — and the refs lower to true
+    proto alongside the secrets."""
+    from consul_tpu.connect.envoy import bootstrap_config
+    from consul_tpu.server import xds_proto as xp
+
+    leaf = {"CertPEM": "PEM-GW", "PrivateKeyPEM": "KEY-GW"}
+    base = {"ProxyID": "gw1", "Service": "gw", "Proxy": {},
+            "Roots": [{"RootCert": "ROOT"}], "TrustDomain": "td",
+            "Leaf": leaf, "Address": "0.0.0.0", "Port": 8443,
+            "Datacenter": "dc1"}
+
+    ing = bootstrap_config({**base, "Kind": "ingress-gateway",
+                            "Listeners": [{"Port": 8080,
+                                           "Protocol": "tcp",
+                                           "Services": []}]}, sds=True)
+    secrets = {s["name"] for s in ing["static_resources"]["secrets"]}
+    assert secrets == {"leaf:gw", "roots"}
+
+    term = bootstrap_config({
+        **base, "Kind": "terminating-gateway", "DefaultAllow": True,
+        "Services": [{"Name": "legacy",
+                      "Leaf": {"CertPEM": "PEM-L",
+                               "PrivateKeyPEM": "KEY-L"},
+                      "Endpoints": [], "Intentions": []}]}, sds=True)
+    secrets = {s["name"] for s in term["static_resources"]["secrets"]}
+    # per-linked-service leaves only: nothing references the gateway's
+    # own leaf on a terminating gateway
+    assert secrets == {"leaf:legacy", "roots"}
+    # the chain's downstream context REFERENCES the per-service leaf
+    chain = term["static_resources"]["listeners"][0][
+        "filter_chains"][0]
+    ctx = chain["transport_socket"]["typed_config"][
+        "common_tls_context"]
+    assert ctx["tls_certificate_sds_secret_configs"][0]["name"] \
+        == "leaf:legacy"
+    # and the whole listener lowers to true proto
+    blob = xp.lower_listener(term["static_resources"]["listeners"][0])
+    assert isinstance(blob, bytes) and len(blob) > 50
+    for s in term["static_resources"]["secrets"]:
+        assert isinstance(xp.lower_secret(s), bytes)
+    # inline mode is unchanged: no secrets key at all
+    inl = bootstrap_config({**base, "Kind": "ingress-gateway",
+                            "Listeners": []})
+    assert "secrets" not in inl["static_resources"]
