@@ -352,6 +352,10 @@ class Agent:
                     self._views = ViewStore(
                         self.client.pool, self.client.servers.find,
                         notify_failed=self.client.servers.notify_failed)
+                    # streams follow the router's periodic rebalance
+                    # (grpc-internal resolver/balancer seam)
+                    self.client.on_rebalance.append(
+                        self._views.rebalance)
             return self._views
 
     def rpc(self, method: str, args: dict[str, Any],

@@ -39,6 +39,8 @@ class MaterializedView:
         self._live = False  # end-of-snapshot seen on current stream
         self._err: Optional[str] = None  # last stream error, if any
         self._last_access = 0.0  # monotonic; ViewStore TTL eviction
+        self.addr: Optional[str] = None  # server feeding this view
+        self._migrate = threading.Event()  # rebalance: move servers
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"view-{topic}-{key}")
@@ -84,6 +86,13 @@ class MaterializedView:
     def stop(self) -> None:
         self._stop.set()
 
+    def request_migrate(self) -> None:
+        """Ask the feed to drop its stream and re-pick a server (the
+        grpc-internal balancer's graceful rebalance, balancer.go:
+        connections periodically shift so load spreads after topology
+        changes). Readers keep the warm result during the handoff."""
+        self._migrate.set()
+
     # ---------------------------------------------------------------- feed
 
     def _run(self) -> None:
@@ -95,6 +104,12 @@ class MaterializedView:
                     return
                 continue
             handle = None
+            # clear BEFORE picking would also work; clearing after
+            # could erase a migrate request that raced the pick, so
+            # only clear when the pick still matches the preference
+            self.addr = addr
+            if self._pick() == addr:
+                self._migrate.clear()
             try:
                 handle = self._pool.subscribe(addr, "Subscribe.Subscribe", {
                     "Topic": self.topic, "Key": self.key,
@@ -127,6 +142,8 @@ class MaterializedView:
     def _consume(self, handle) -> None:
         try:
             while not self._stop.is_set():
+                if self._migrate.is_set():
+                    return  # graceful handoff: _run re-picks a server
                 ev = handle.next(timeout=0.5)
                 if ev is None:
                     continue
@@ -184,6 +201,24 @@ class ViewStore:
                 self._views[k] = v
             v._last_access = _time.monotonic()
             return v
+
+    def rebalance(self) -> int:
+        """Migrate every view whose stream sits on a server the picker
+        no longer prefers (the grpc-internal resolver/balancer's
+        periodic rebalance: long-lived streams would otherwise pin the
+        first server forever, defeating the router's load spreading).
+        Returns how many views were asked to move."""
+        target = self._pick()
+        if target is None:
+            return 0
+        moved = 0
+        with self._lock:
+            views = list(self._views.values())
+        for v in views:
+            if v.addr is not None and v.addr != target:
+                v.request_migrate()
+                moved += 1
+        return moved
 
     def _reap_loop(self) -> None:
         import time as _time

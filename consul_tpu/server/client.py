@@ -53,6 +53,9 @@ class Client:
             config, "rebalance_interval", None) or DEFAULT_REBALANCE_INTERVAL
         self._rebalance_stop = threading.Event()
         self._rebalance_thread: Optional[threading.Thread] = None
+        # post-rebalance hooks: long-lived stream holders (ViewStore)
+        # register here to follow the new server preference
+        self.on_rebalance: list = []
         self.rng = random.Random()
 
         tags = {"role": "node", "dc": config.datacenter, "id": self.node_id,
@@ -146,6 +149,15 @@ class Client:
             if self._rebalance_stop.wait(period):
                 return
             self.servers.rebalance()
+            # long-lived internal streams follow the new preference
+            # (grpc-internal balancer rebalance; the ViewStore hooks
+            # in here)
+            for fn in self.on_rebalance:
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001 — best-effort,
+                    self.log.warning(    # but never silently
+                        "rebalance hook failed: %s", e)
 
     def _refresh_servers(self) -> None:
         self.servers.sync({m.tags["rpc_addr"]

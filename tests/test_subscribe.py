@@ -389,3 +389,48 @@ def test_view_serves_warm_during_failover():
                 s.shutdown()
             except Exception:  # noqa: BLE001 — leader already down
                 pass
+
+
+def test_view_streams_follow_rebalance():
+    """grpc-internal balancer analogue: when the router's preference
+    moves to another server, ViewStore.rebalance() migrates live
+    streams there gracefully (warm result retained throughout)."""
+    cfgs = [load(dev=True, overrides={
+        "node_name": f"reb{i}", "bootstrap": False,
+        "bootstrap_expect": 2, "server": True}) for i in range(2)]
+    servers = [Server(c) for c in cfgs]
+    for s in servers:
+        s.start()
+    try:
+        servers[1].join([servers[0].serf.memberlist.transport.addr])
+        leader = wait_for(
+            lambda: next((s for s in servers if s.is_leader()), None),
+            what="leader")
+        wait_for(lambda: len(leader.raft.peers) == 2, what="2 peers")
+        register(leader, "nr", "reb-svc")
+        other = next(s for s in servers if s is not leader)
+        wait_for(lambda: other.state.check_service_nodes("reb-svc"),
+                 what="replicated")
+
+        from consul_tpu.agent.views import ViewStore
+
+        picked = [leader.rpc.addr]
+        store = ViewStore(ConnPool(), lambda: picked[0])
+        try:
+            v = store.get_view("ServiceHealth", "reb-svc")
+            res, _ = v.get(timeout=10)
+            assert res and v.addr == leader.rpc.addr
+            # preference moves; rebalance migrates the live stream
+            picked[0] = other.rpc.addr
+            assert store.rebalance() == 1
+            wait_for(lambda: v.addr == other.rpc.addr and v._live,
+                     what="stream migrated", timeout=15)
+            res2, _ = v.get(timeout=10)
+            assert res2 == res
+            # already on the preferred server: nothing to move
+            assert store.rebalance() == 0
+        finally:
+            store.stop()
+    finally:
+        for s in servers:
+            s.shutdown()
