@@ -106,6 +106,11 @@ class RaftNode:
         # chunked-apply reassembly (go-raftchunking): id -> list of
         # pieces; rebuilt deterministically during log replay
         self._chunks: dict[str, list[Optional[bytes]]] = {}
+        # online log verification (raft-wal verifier): last index the
+        # leader published a checksum through, and this node's counters
+        self._verified_to = 0
+        self.verify_ok = 0
+        self.verify_failed = 0
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._election_timer = None
@@ -247,6 +252,80 @@ class RaftNode:
         """Commit an empty entry and wait for it: asserts leadership and
         gives a linearizable read point (hashicorp/raft Barrier)."""
         self.apply(b"", timeout=timeout)
+
+    #: verify-window caps: one verification round covers at most this
+    #: many entries / payload bytes, so checksum work never stalls the
+    #: node past an election timeout (a fresh leader starts from its
+    #: snapshot and catches up over several rounds)
+    VERIFY_MAX_ENTRIES = 4096
+    VERIFY_MAX_BYTES = 32 * 1024 * 1024
+
+    def checksum_range(self, lo: int, hi: int) -> Optional[bytes]:
+        """Order-independent XOR of per-entry sha256 digests over log
+        indexes [lo, hi] — the payload of a verify entry and what every
+        node recomputes from ITS OWN log on apply (the spirit of
+        hashicorp/raft-wal's online LogStore verifier,
+        agent/consul/server.go:1036-1040). None when the range is
+        partly compacted here (nothing to verify against). Entry
+        references are copied out under the lock; hashing runs WITHOUT
+        it — heartbeats and applies never wait on sha256."""
+        import hashlib
+
+        with self._lock:
+            if lo < self.store.first_index() \
+                    or hi > self.store.last_index() or lo > hi:
+                return None
+            entries = [self.store.entry(i) for i in range(lo, hi + 1)]
+        if any(e is None for e in entries):
+            return None
+        acc = bytearray(32)
+        for idx, e in zip(range(lo, hi + 1), entries):
+            h = hashlib.sha256(repr((
+                idx, e.get("term", 0), e.get("kind", ""),
+                bytes(e.get("data") or b""), e.get("add"),
+                e.get("remove"), e.get("voter"), e.get("cid"),
+                e.get("seq"), e.get("total"))).encode()).digest()
+            for i in range(32):
+                acc[i] ^= h[i]
+        return bytes(acc)
+
+    def verify_log(self) -> Optional[tuple[int, int]]:
+        """Leader: append a verify entry covering committed entries
+        since the last verification (window capped by entries AND
+        bytes); every node (self included) checks the range against
+        its own log at apply time. Returns the range published, or
+        None when there is nothing new to verify."""
+        with self._lock:
+            if self.role != Role.LEADER or self._stopped:
+                return None
+            lo = max(self.store.first_index(), self._verified_to + 1)
+            hi = min(self.commit_index,
+                     lo + self.VERIFY_MAX_ENTRIES - 1)
+            if hi < lo:
+                return None
+            size = 0
+            for idx in range(lo, hi + 1):
+                e = self.store.entry(idx)
+                size += len((e or {}).get("data") or b"")
+                if size > self.VERIFY_MAX_BYTES and idx > lo:
+                    hi = idx - 1
+                    break
+        s = self.checksum_range(lo, hi)
+        if s is None:
+            with self._lock:
+                # range compacted from under us: restart past it
+                self._verified_to = max(self._verified_to,
+                                        self.store.snapshot_index)
+            return None
+        with self._lock:
+            if self.role != Role.LEADER:
+                return None
+            self.store.append([{"term": self.store.term, "data": b"",
+                                "kind": "verify", "lo": lo, "hi": hi,
+                                "sum": s}])
+            self._verified_to = hi
+        self._replicate_all()
+        return (lo, hi)
 
     def apply_noop(self) -> None:
         with self._lock:
@@ -559,6 +638,10 @@ class RaftNode:
         for p in self.peers:
             self._next_index[p] = nxt
             self._match_index[p] = 0
+        # re-derive verification coverage: a stale high-water mark
+        # from a previous reign could skip entries rewritten by an
+        # intervening leader (rebuilt like _next_index)
+        self._verified_to = self.store.snapshot_index
         if self._election_timer is not None:
             self._election_timer.cancel()
         # commit a no-op to learn the commit frontier of prior terms, and
@@ -837,6 +920,27 @@ class RaftNode:
                         result = ex
                     if self.role == Role.LEADER:
                         self._apply_results[idx] = result
+            elif e["kind"] == "verify":
+                # recompute the published range from OUR OWN log: a
+                # replication/disk corruption on this node surfaces as
+                # a mismatch here (detection + telemetry, like the
+                # reference's log verifier — not correction)
+                want = e.get("sum")
+                got = self.checksum_range(e.get("lo", 0),
+                                          e.get("hi", -1))
+                if got is None:
+                    pass  # range compacted here (snapshot restore)
+                elif got == want:
+                    self.verify_ok += 1
+                    self.metrics.incr("raft.verify.ok")
+                else:
+                    self.verify_failed += 1
+                    self.metrics.incr("raft.verify.failed")
+                    self.log.error(
+                        "raft log verification FAILED for [%d, %d]: "
+                        "local log diverges from the leader's "
+                        "checksum — possible disk/replication "
+                        "corruption", e.get("lo"), e.get("hi"))
             elif e["kind"] == "config":
                 if e.get("add"):
                     self.peers.add(e["add"])

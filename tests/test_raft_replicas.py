@@ -178,3 +178,83 @@ def test_orphaned_chunk_group_evicted():
         assert not s.raft._chunks, "orphaned group survived"
     finally:
         s.shutdown()
+
+
+def test_online_log_verification_cluster(replica_cluster):
+    """raft-wal verifier analogue: the leader publishes checksum
+    entries; every node (followers AND the read replica) cross-checks
+    its own log and counts ok; a tampered follower log is DETECTED."""
+    servers, leader, replica = replica_cluster
+    for i in range(10):
+        leader.handle_rpc("KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": f"v/{i}",
+                                    "Value": b"x"}}, "local")
+    assert leader.raft.verify_log() is not None
+    wait_for(lambda: all(s.raft.verify_ok >= 1 for s in servers),
+             what="all nodes verified the range", timeout=20)
+    assert all(s.raft.verify_failed == 0 for s in servers)
+
+    # tamper one follower's log payload: the NEXT verification round
+    # must flag exactly that node
+    victim = next(s for s in servers
+                  if s is not leader and s is not replica)
+    with victim.raft._lock:
+        for e in victim.raft.store.log:
+            if e.get("kind") == "cmd" and e.get("data"):
+                e["data"] = e["data"][:-1] + b"!"
+                break
+    leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "after", "Value": b"y"}},
+        "local")
+    # force a fresh verification window covering old entries: reset
+    # the leader's high-water mark so the tampered entry is re-covered
+    leader.raft._verified_to = 0
+    assert leader.raft.verify_log() is not None
+    wait_for(lambda: victim.raft.verify_failed >= 1,
+             what="corruption detected on the tampered node",
+             timeout=20)
+    clean = [s for s in servers if s is not victim]
+    assert all(s.raft.verify_failed == 0 for s in clean), \
+        "clean nodes must not flag"
+
+
+def test_wal_on_disk_verification(tmp_path):
+    """verify_wal: a healthy on-disk WAL re-reads clean; a corrupted
+    frame is reported."""
+    from consul_tpu.raft.storage import RaftStorage
+
+    st = RaftStorage(str(tmp_path / "raft"), sync=False)
+    st.append([{"term": 1, "data": f"v{i}".encode(), "kind": "cmd"}
+               for i in range(20)])
+    frames, problems = st.verify_wal()
+    assert frames == 20 and problems == []
+    # flip a byte inside a stored VALUE on disk (silent bit rot)
+    wal = tmp_path / "raft" / "wal.log"
+    st._wal.flush()
+    blob = bytearray(wal.read_bytes())
+    pos = bytes(blob).find(b"v7")
+    assert pos > 0
+    blob[pos + 1] ^= 0xFF
+    wal.write_bytes(bytes(blob))
+    frames2, problems2 = st.verify_wal()
+    assert problems2, "corrupted frame not reported"
+    assert "diverges" in problems2[0]
+
+
+def test_wal_verify_honors_truncation_markers(tmp_path):
+    """A conflict rollback leaves superseded frames on disk behind a
+    _trunc marker — verify_wal must REPLAY the marker and not report
+    the stale frames as corruption (false alarms train operators to
+    ignore the verifier)."""
+    from consul_tpu.raft.storage import RaftStorage
+
+    st = RaftStorage(str(tmp_path / "raft"), sync=False)
+    st.append([{"term": 1, "data": f"old{i}".encode(), "kind": "cmd"}
+               for i in range(5)])
+    st.truncate_from(3)  # deposed-leader entries 3..5 rolled back
+    st.append([{"term": 2, "data": f"new{i}".encode(), "kind": "cmd"}
+               for i in range(4)])
+    st._wal.flush()
+    frames, problems = st.verify_wal()
+    assert problems == [], f"rollback misreported: {problems}"
+    assert frames == 10  # 5 old + marker(counted? no) + 4 new
