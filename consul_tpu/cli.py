@@ -1271,16 +1271,38 @@ def cmd_intention(args) -> int:
     """`consul intention` family (command/intention/*)."""
     c = _client(args)
     if args.intention_cmd == "create":
-        c.put("/v1/connect/intentions", body={
-            "SourceName": args.source, "DestinationName": args.destination,
-            "Action": "deny" if args.deny else "allow"})
-        print(f"Created: {args.source} => {args.destination} "
-              f"({'deny' if args.deny else 'allow'})")
+        body = {"SourceName": args.source,
+                "DestinationName": args.destination}
+        if getattr(args, "permissions", ""):
+            if args.deny:
+                print("Error: -deny and -permissions are mutually "
+                      "exclusive (the permission list carries its own "
+                      "allow/deny actions)", file=sys.stderr)
+                return 1
+            try:
+                perms = json.loads(args.permissions)
+            except json.JSONDecodeError as e:
+                print(f"Error: -permissions is not valid JSON: {e}",
+                      file=sys.stderr)
+                return 1
+            if not isinstance(perms, list):
+                print("Error: -permissions must be a JSON LIST of "
+                      "permission objects", file=sys.stderr)
+                return 1
+            body["Permissions"] = perms
+            what = f"L7 ({len(perms)} permissions)"
+        else:
+            body["Action"] = "deny" if args.deny else "allow"
+            what = body["Action"]
+        c.put("/v1/connect/intentions", body=body)
+        print(f"Created: {args.source} => {args.destination} ({what})")
         return 0
     if args.intention_cmd == "list":
         rows = [("Source", "Action", "Destination", "Precedence")]
         for i in c.get("/v1/connect/intentions"):
-            rows.append((i.get("SourceName"), i.get("Action"),
+            act = i.get("Action") or (
+                f"L7:{len(i.get('Permissions') or [])}")
+            rows.append((i.get("SourceName"), act,
                          i.get("DestinationName"),
                          i.get("Precedence", "")))
         _table(rows)
@@ -1294,8 +1316,10 @@ def cmd_intention(args) -> int:
         res = c.get("/v1/connect/intentions/match",
                     by=args.by or "destination", name=args.name)
         for i in (res if isinstance(res, list) else []):
+            act = i.get("Action") or (
+                f"L7:{len(i.get('Permissions') or [])}")
             print(f"{i.get('SourceName')} => {i.get('DestinationName')} "
-                  f"({i.get('Action')})")
+                  f"({act})")
         return 0
     if args.intention_cmd == "get":
         for i in c.get("/v1/connect/intentions"):
@@ -1767,6 +1791,10 @@ def build_parser() -> argparse.ArgumentParser:
     ic.add_argument("source")
     ic.add_argument("destination")
     ic.add_argument("-deny", action="store_true")
+    ic.add_argument("-permissions", default="",
+                    help="ordered L7 permission list as JSON "
+                         "(mutually exclusive with -deny; requires an "
+                         "http destination protocol)")
     isub.add_parser("list")
     for nm in ("check", "get", "delete"):
         ip = isub.add_parser(nm)

@@ -282,3 +282,49 @@ def test_http_rbac_lowering_roundtrip():
     assert second[1]["header"]["string_match"]["exact"] == "GET"
     assert pol["principals"][0]["authenticated"]["principal_name"][
         "suffix"] == "/svc/app"
+
+
+def test_default_allow_wildcard_l7_excludes_exact_sources():
+    """rbac.go removeSourcePrecedence: a wildcard-source L7 intention's
+    default-allow DENY policy must NOT swallow sources that have their
+    own higher-precedence exact intentions — they get not_id
+    principals, and the whole thing lowers to true proto."""
+    from consul_tpu.connect.envoy import bootstrap_config
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.utils.pbwire import decode
+
+    ixns = [
+        {"SourceName": "app", "DestinationName": "web",
+         "Action": "allow"},
+        {"SourceName": "*", "DestinationName": "web",
+         "Permissions": [{"Action": "allow",
+                          "HTTP": {"PathPrefix": "/public"}}]},
+    ]
+    cfg = bootstrap_config(_mk_snapshot("http", ixns,
+                                        default_allow=True))
+    hcm = cfg["static_resources"]["listeners"][0][
+        "filter_chains"][0]["filters"][0]["typed_config"]
+    rbac = [f for f in hcm["http_filters"]
+            if f["name"] == "envoy.filters.http.rbac"][0]
+    pol = rbac["typed_config"]["rules"]["policies"][
+        "consul-intentions-layer7-0"]
+    pr = pol["principals"][0]
+    ids = pr["and_ids"]["ids"]
+    assert ids[0] == {"any": True}
+    assert ids[1]["not_id"]["authenticated"]["principal_name"][
+        "suffix"] == "/svc/app"
+    # proto roundtrip keeps the principal combinators intact
+    blob = xp.lower_listener(cfg["static_resources"]["listeners"][0])
+    lst = decode(xp._LISTENER, blob)
+    h = decode(xp._HCM,
+               lst["filter_chains"][0]["filters"][0][
+                   "typed_config"]["value"])
+    rb = [f for f in h["http_filters"]
+          if f["typed_config"]["type_url"] == xp.HTTP_RBAC_TYPE][0]
+    rules = decode(xp._HTTP_RBAC, rb["typed_config"]["value"])["rules"]
+    l7pol = {p["key"]: p["value"] for p in rules["policies"]}[
+        "consul-intentions-layer7-0"]
+    pids = l7pol["principals"][0]["and_ids"]["ids"]
+    assert pids[0].get("any") is True
+    assert pids[1]["not_id"]["authenticated"]["principal_name"][
+        "suffix"] == "/svc/app"
