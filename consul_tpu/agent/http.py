@@ -1203,9 +1203,11 @@ class HTTPApi:
             return True, None
         if path == "/v1/operator/raft/configuration":
             stats = rpc("Status.RaftStats", {})
+            nonvoters = set(stats.get("nonvoters") or [])
             return {"Servers": [
                 {"Address": p, "Leader": p == stats.get("leader"),
-                 "Voter": True} for p in stats.get("peers", [])],
+                 "Voter": p not in nonvoters}
+                for p in stats.get("peers", [])],
                 "Index": stats.get("applied_index", 0)}, None
 
         # ------------------------------------------------------- config

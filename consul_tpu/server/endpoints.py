@@ -1630,6 +1630,11 @@ def register_endpoints(srv) -> None:
         healthy = True
         from consul_tpu.types import MemberStatus as MS
 
+        # one lock-consistent snapshot from stats(): reading the live
+        # peers/nonvoters sets here could tear against a concurrent
+        # membership change
+        peers = set(stats.get("peers") or [])
+        nonvoters = set(stats.get("nonvoters") or [])
         for m in srv.serf.members(include_left=True):
             if m.tags.get("role") != "consul":
                 continue
@@ -1638,14 +1643,19 @@ def register_endpoints(srv) -> None:
                 continue
             alive = int(m.status) == 1
             healthy = healthy and alive
+            addr = m.tags.get("rpc_addr", "")
             servers.append({
-                "Name": m.name, "Address": m.tags.get("rpc_addr", ""),
+                "Name": m.name, "Address": addr,
                 "SerfStatus": "alive" if alive else "failed",
-                "Leader": m.tags.get("rpc_addr") == stats.get("leader"),
-                "Voter": m.tags.get("rpc_addr") in srv.raft.peers,
+                "Leader": addr == stats.get("leader"),
+                # a read replica is IN the peer set but not a voter —
+                # counting it would overstate quorum health
+                "Voter": addr in peers and addr not in nonvoters,
+                "ReadReplica": addr in nonvoters,
                 "Healthy": alive})
+        voters = peers - nonvoters
         return {"Healthy": healthy,
-                "FailureTolerance": max(0, (len(srv.raft.peers) - 1) // 2),
+                "FailureTolerance": max(0, (len(voters) - 1) // 2),
                 "Servers": servers}
 
     e["Operator.AutopilotHealth"] = autopilot_health
@@ -1774,7 +1784,9 @@ def register_endpoints(srv) -> None:
             "Healthy": health["Healthy"],
             "FailureTolerance": health["FailureTolerance"],
             "Leader": stats.get("leader", ""),
-            "Voters": sorted(srv.raft.peers),
+            "Voters": sorted(set(stats.get("peers") or [])
+                             - set(stats.get("nonvoters") or [])),
+            "ReadReplicas": sorted(stats.get("nonvoters") or []),
             "Servers": {s["Name"]: {
                 **s, "LastTerm": stats.get("term", 0),
                 "LastIndex": stats.get("applied_index", 0)}

@@ -258,3 +258,31 @@ def test_wal_verify_honors_truncation_markers(tmp_path):
     frames, problems = st.verify_wal()
     assert problems == [], f"rollback misreported: {problems}"
     assert frames == 10  # 5 old + marker(counted? no) + 4 new
+
+
+def test_autopilot_health_reports_replica_as_nonvoter(replica_cluster):
+    """operator/autopilot health: a read replica appears with
+    Voter=false/ReadReplica=true and does NOT inflate
+    FailureTolerance (quorum math is voters-only)."""
+    servers, leader, replica = replica_cluster
+    h = leader.handle_rpc("Operator.AutopilotHealth", {}, "local")
+    by_addr = {s["Address"]: s for s in h["Servers"]}
+    rep = by_addr[replica.rpc.addr]
+    assert rep["ReadReplica"] is True and rep["Voter"] is False
+    voters = [s for s in h["Servers"] if s["Voter"]]
+    assert len(voters) == 3
+    assert h["FailureTolerance"] == 1
+    # divergent topology: pretend a SECOND nonvoter exists — the old
+    # all-peers formula would say (5-1)//2 = 2, voters-only says 1
+    leader.raft.peers.add("127.0.0.1:1")
+    leader.raft.nonvoters.add("127.0.0.1:1")
+    try:
+        h2 = leader.handle_rpc("Operator.AutopilotHealth", {}, "local")
+        assert h2["FailureTolerance"] == 1, \
+            "replicas inflated failure tolerance"
+    finally:
+        leader.raft.peers.discard("127.0.0.1:1")
+        leader.raft.nonvoters.discard("127.0.0.1:1")
+    # the raft configuration surface agrees (list-peers backing route)
+    st = leader.raft.stats()
+    assert replica.rpc.addr in st["nonvoters"]
