@@ -1623,8 +1623,10 @@ def register_endpoints(srv) -> None:
 
     e["Internal.Members"] = members
 
-    def autopilot_health(args):
-        require(authz(args).operator_read(), "operator read")
+    def _autopilot_view():
+        """One raft.stats() snapshot feeding BOTH operator surfaces —
+        a second snapshot could tear against a membership change and
+        disagree with the first inside one response."""
         stats = srv.raft.stats()
         servers = []
         healthy = True
@@ -1656,7 +1658,11 @@ def register_endpoints(srv) -> None:
         voters = peers - nonvoters
         return {"Healthy": healthy,
                 "FailureTolerance": max(0, (len(voters) - 1) // 2),
-                "Servers": servers}
+                "Servers": servers}, stats
+
+    def autopilot_health(args):
+        require(authz(args).operator_read(), "operator read")
+        return _autopilot_view()[0]
 
     e["Operator.AutopilotHealth"] = autopilot_health
 
@@ -1778,8 +1784,8 @@ def register_endpoints(srv) -> None:
 
     def autopilot_state(args):
         """Per-server operational detail (operator/autopilot/state)."""
-        health = autopilot_health(args)
-        stats = srv.raft.stats()
+        require(authz(args).operator_read(), "operator read")
+        health, stats = _autopilot_view()
         return {
             "Healthy": health["Healthy"],
             "FailureTolerance": health["FailureTolerance"],

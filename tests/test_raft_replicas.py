@@ -274,15 +274,17 @@ def test_autopilot_health_reports_replica_as_nonvoter(replica_cluster):
     assert h["FailureTolerance"] == 1
     # divergent topology: pretend a SECOND nonvoter exists — the old
     # all-peers formula would say (5-1)//2 = 2, voters-only says 1
-    leader.raft.peers.add("127.0.0.1:1")
-    leader.raft.nonvoters.add("127.0.0.1:1")
+    with leader.raft._lock:  # raft threads iterate these sets
+        leader.raft.peers.add("127.0.0.1:1")
+        leader.raft.nonvoters.add("127.0.0.1:1")
     try:
         h2 = leader.handle_rpc("Operator.AutopilotHealth", {}, "local")
         assert h2["FailureTolerance"] == 1, \
             "replicas inflated failure tolerance"
     finally:
-        leader.raft.peers.discard("127.0.0.1:1")
-        leader.raft.nonvoters.discard("127.0.0.1:1")
+        with leader.raft._lock:
+            leader.raft.peers.discard("127.0.0.1:1")
+            leader.raft.nonvoters.discard("127.0.0.1:1")
     # the raft configuration surface agrees (list-peers backing route)
     st = leader.raft.stats()
     assert replica.rpc.addr in st["nonvoters"]

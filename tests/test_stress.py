@@ -314,3 +314,96 @@ def test_sentinel_evaluate_directly():
         assert sentinel.evaluate("", {"key": "bad"}) is None  # no policy
     finally:
         sentinel.register(None)
+
+
+def test_group_commit_acked_writes_survive_leadership_transfer():
+    """Failover correctness for the round-4 write path (group-commit
+    batcher + async mux fast path): every write ACKED to a client is
+    durable on every server even when leadership transfers mid-flood.
+    Writes that error are retried by the client (not-leader races are
+    expected); ACKed-then-lost is the bug this test exists to catch."""
+    import threading
+    import time as _time
+
+    from consul_tpu.config import load
+    from consul_tpu.server import Server
+    from consul_tpu.server.rpc import ConnPool, RPCError
+    from helpers import wait_for
+
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"gc{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True})
+        try:
+            s = Server(cfg)
+        except OSError:
+            _time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    try:
+        for s in servers[1:]:
+            assert s.join(
+                [servers[0].serf.memberlist.transport.addr]) == 1
+        leader = wait_for(
+            lambda: next((s for s in servers if s.is_leader()), None),
+            what="leader election")
+        wait_for(lambda: len(leader.raft.peers) == 3, what="3 peers")
+
+        acked: list[str] = []
+        acked_lock = threading.Lock()
+
+        def writer(w):
+            pool = ConnPool()
+            try:
+                for i in range(200):
+                    key = f"gc/{w}/{i}"
+                    for attempt in range(8):
+                        lead = next((s for s in servers
+                                     if s.is_leader()), None)
+                        target = (lead or servers[0]).rpc.addr
+                        try:
+                            pool.call(target, "KVS.Apply", {
+                                "Op": "set", "DirEnt": {
+                                    "Key": key, "Value": b"d"}},
+                                timeout=10.0)
+                            with acked_lock:
+                                acked.append(key)
+                            break
+                        except (RPCError, OSError):
+                            _time.sleep(0.15)
+            finally:
+                pool.close()
+
+        threads = [threading.Thread(target=writer, args=(w,),
+                                    daemon=True) for w in range(8)]
+        for t in threads:
+            t.start()
+        # transfer leadership mid-flood, twice, while writes flow
+        for delay in (0.15, 0.5):
+            _time.sleep(delay)
+            lead = next((s for s in servers if s.is_leader()), None)
+            if lead is None:
+                continue
+            try:
+                lead.handle_rpc("Operator.RaftTransferLeader", {},
+                                "local")
+            except Exception:  # noqa: BLE001 — racing transfer is fine
+                pass
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "writer wedged past the deadline"
+        assert acked, "no writes were acked at all"
+        wait_for(lambda: next(
+            (s for s in servers if s.is_leader()), None) is not None,
+            what="post-transfer leader")
+        # EVERY acked key becomes durable on EVERY server (the waits
+        # absorb async FSM apply; an acked-then-lost write never does)
+        for s in servers:
+            wait_for(lambda s=s: all(
+                s.state.kv_get(k) is not None for k in acked),
+                what=f"all acked keys on {s.name}", timeout=30)
+    finally:
+        for s in servers:
+            s.shutdown()
