@@ -129,6 +129,16 @@ def main() -> None:
         "kv_get_stale_rps", get_stale, n_threads, n_ops * 3,
         baseline=9774.0))
 
+    # ---- KV GET ?consistent (leader barrier per read, batched) ----
+    def get_consistent(w, i):
+        pools[w].call(leader.rpc.addr, "KVS.Get",
+                      {"Key": f"bench/{w}/{i % n_ops}",
+                       "RequireConsistent": True})
+
+    results.append(run_workload(
+        "kv_get_consistent_rps", get_consistent, n_threads, n_ops * 3,
+        baseline=7344.0))
+
     for p in pools:
         p.close()
     for s in servers:

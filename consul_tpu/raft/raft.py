@@ -111,6 +111,8 @@ class RaftNode:
         self._verified_to = 0
         self.verify_ok = 0
         self.verify_failed = 0
+        self._verify_pool = None  # created under _lock on first verify
+        self._term_start_index = 0  # our election no-op's index
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._election_timer = None
@@ -155,6 +157,8 @@ class RaftNode:
             self._applied_cv.notify_all()
             self._repl_cv.notify_all()
             self._apply_cv.notify_all()
+        if self._verify_pool is not None:
+            self._verify_pool.shutdown(wait=False)
         with self._watchdog_cv:
             self._watchdog_cv.notify_all()
 
@@ -252,6 +256,86 @@ class RaftNode:
         """Commit an empty entry and wait for it: asserts leadership and
         gives a linearizable read point (hashicorp/raft Barrier)."""
         self.apply(b"", timeout=timeout)
+
+    def verify_leadership(self, timeout: float = 2.0) -> Optional[int]:
+        """VerifyLeader (hashicorp/raft verifyLeader, what consul's
+        ?consistent reads actually pay, rpc.go consistentRead): one
+        heartbeat round confirming a VOTER majority still recognizes
+        this term — NO log append, fsync, or FSM work. Returns a
+        linearizable read index (ReadIndex: commit_index at entry,
+        already applied when this returns) or None on lost leadership.
+        Any reply at term <= ours counts as recognition — a log-match
+        conflict is irrelevant to leadership."""
+        with self._lock:
+            if self.role != Role.LEADER or self._stopped:
+                return None
+            if self.commit_index < self._term_start_index:
+                # freshly elected: a prior leader's acknowledged writes
+                # may sit above our commit_index until our no-op
+                # commits — serving now could return stale data on a
+                # linearizable read. Callers retry/forward.
+                return None
+            term = self.store.term
+            read_index = self.commit_index
+            voters = [p for p in (self.peers - self.nonvoters)
+                      if p != self.transport.addr]
+            # pool creation under the lock: two concurrent direct
+            # callers must not each mint (and one leak) an executor
+            if voters and self._verify_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._verify_pool = ThreadPoolExecutor(
+                    max_workers=4,
+                    thread_name_prefix=f"raft-verify-{self.id}")
+        self.metrics.incr("raft.verify_leader")
+        if voters:
+            need = (len(voters) + 1) // 2 + 1  # majority incl. self
+            acks = [1]
+            alock = threading.Lock()
+            done = threading.Event()
+
+            def ask(peer: str) -> None:
+                try:
+                    reply = self.transport.call(peer, "append_entries", {
+                        "term": term, "leader": self.transport.addr,
+                        "prev_log_index": 0, "prev_log_term": 0,
+                        "entries": [], "leader_commit": 0},
+                        timeout=timeout)
+                except Exception:  # noqa: BLE001 — unreachable peer
+                    return
+                if reply.get("term", 0) > term:
+                    with self._lock:
+                        if self.store.term < reply["term"]:
+                            self._step_down(reply["term"])
+                    done.set()
+                    return
+                with alock:
+                    acks[0] += 1
+                    if acks[0] >= need:
+                        done.set()
+
+            # persistent worker pool (created above under the lock):
+            # verify rounds run continuously under ?consistent read
+            # load — per-round thread spawns were the dominant cost
+            for p in voters:
+                self._verify_pool.submit(ask, p)
+            done.wait(timeout)
+            if acks[0] < need:
+                return None
+        with self._lock:
+            if self.role != Role.LEADER or self.store.term != term:
+                return None
+            # ReadIndex: serve only once the read point is applied
+            deadline = self.clock.now() + timeout
+            while self.last_applied < read_index and not self._stopped:
+                remaining = deadline - self.clock.now()
+                if remaining <= 0:
+                    return None
+                self._applied_cv.wait(remaining)
+            if self.last_applied < read_index:
+                return None  # stopped mid-wait: never serve a lagging
+                #              FSM as a linearizable read
+        return read_index
 
     #: verify-window caps: one verification round covers at most this
     #: many entries / payload bytes, so checksum work never stalls the
@@ -652,6 +736,11 @@ class RaftNode:
             {"term": self.store.term, "data": b"", "kind": "noop"},
             {"term": self.store.term, "data": b"", "kind": "config",
              "add": self.transport.addr}])
+        # ReadIndex safety: until this no-op COMMITS, our commit_index
+        # may trail entries a deposed leader already acknowledged —
+        # verify_leadership refuses to serve before then (§6.4: a new
+        # leader needs a current-term committed entry first)
+        self._term_start_index = self.store.last_index() - 1
         self._replicate_all()
         self._schedule_heartbeat()
 

@@ -74,7 +74,10 @@ def register_endpoints(srv) -> None:
                 return srv._forward_to_leader(name, args)
             if args.get("RequireConsistent") and srv.is_leader():
                 try:
-                    srv.raft.barrier(timeout=5.0)
+                    # coalesced VerifyLeader (consul consistentRead):
+                    # concurrent ?consistent reads share ONE heartbeat
+                    # round — no log append, no fsync, no FSM work
+                    srv._verify_gate.verify(timeout=5.0)
                 except Exception as ex:  # noqa: BLE001
                     raise RPCError(
                         f"consistent read unavailable: {ex}") from ex
@@ -301,6 +304,40 @@ def register_endpoints(srv) -> None:
         return True
 
     srv.rpc.async_handlers["KVS.Apply"] = kv_apply_async
+
+    def kv_get_consistent_async(args, src, respond):
+        """Mux fast path for ?consistent reads on the leader: the
+        linearizability barrier rides the group-commit batcher via
+        callback, so the barrier wait parks no worker thread (same
+        shape as the write fast path). Declines to the sync path for
+        followers, stale/default reads, and blocking queries."""
+        if not srv.is_leader() or args.get("AllowStale") \
+                or not args.get("RequireConsistent") \
+                or args.get("MinQueryIndex") \
+                or args.get("MaxQueryTime"):
+            return False
+        srv.check_rate_limit("KVS.Get", src)
+        key = args.get("Key", "")
+        require(authz(args).key_read(key), f"key read on {key!r}")
+
+        def after_verify(read_index):
+            if read_index is None:
+                respond(RPCError(
+                    "consistent read unavailable: leadership lost"))
+                return
+            try:
+                e_ = state.kv_get(key)
+                # max(.., 1) matches blocking_query's sync contract: an
+                # Index of 0 fed back as MinQueryIndex busy-polls
+                respond({"Index": max(state.kv_key_index(key), 1),
+                         "Entries": [e_.to_dict()] if e_ else []})
+            except Exception as ex:  # noqa: BLE001
+                respond(ex)
+
+        srv._verify_gate.verify_async(after_verify)
+        return True
+
+    srv.rpc.async_handlers["KVS.Get"] = kv_get_consistent_async
 
     # KV reads return PER-PREFIX indexes (kv_prefix_index): a watcher
     # of one key/prefix re-blocks through writes elsewhere in the

@@ -263,3 +263,67 @@ def test_autopilot_stabilization_gates_new_server(cluster):
                  timeout=20, what="post-stabilization promotion")
     finally:
         late.shutdown()
+
+
+def test_verify_leader_consistent_reads(cluster):
+    """?consistent reads ride VerifyLeader (one coalesced heartbeat
+    round, no log append — consul rpc.go consistentRead): a healthy
+    leader serves them; a leader cut off from every follower cannot."""
+    servers, leader = cluster
+    from consul_tpu.server.rpc import ConnPool, RPCError
+
+    leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "cr/k", "Value": b"v"}},
+        "local")
+    # healthy: verify returns a read index at least the commit index
+    ri = leader.raft.verify_leadership()
+    assert ri is not None and ri >= 1
+    # over the network surface, coalesced: N concurrent reads cost
+    # far fewer verify rounds than N
+    before = telemetry_count(leader)
+    pools = [ConnPool() for _ in range(8)]
+    results = []
+    gate = threading.Barrier(8)  # release together: staggered starts
+    ths = []                     # would let each read pay its own round
+
+    def call(p):
+        gate.wait()
+        results.append(p.call(
+            leader.rpc.addr, "KVS.Get",
+            {"Key": "cr/k", "RequireConsistent": True}))
+
+    for p in pools:
+        t = threading.Thread(target=call, args=(p,), daemon=True)
+        t.start()
+        ths.append(t)
+    for t in ths:
+        t.join(15)
+    for p in pools:
+        p.close()
+    assert len(results) == 8
+    assert all(r["Entries"] for r in results)
+    rounds = telemetry_count(leader) - before
+    assert rounds < 8, f"8 concurrent reads cost {rounds} rounds"
+    # deposed/cut-off leader: kill both followers — verify must fail
+    # (no voter majority can confirm the term)
+    for s in servers:
+        if s is not leader:
+            s.shutdown()
+    assert leader.raft.verify_leadership(timeout=1.5) is None
+    pool = ConnPool()
+    try:
+        with pytest.raises((RPCError, OSError)):
+            pool.call(leader.rpc.addr, "KVS.Get",
+                      {"Key": "cr/k", "RequireConsistent": True},
+                      timeout=8.0)
+    finally:
+        pool.close()
+
+
+def telemetry_count(srv):
+    from consul_tpu.utils import telemetry
+
+    with telemetry.default._lock:
+        return sum(v for (name, _), v in
+                   telemetry.default._counters.items()
+                   if name == "raft.verify_leader")
