@@ -284,8 +284,11 @@ class RaftNode:
             if voters and self._verify_pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
+                # one worker per voter: a hung peer blocking its call
+                # for the full transport timeout must not starve the
+                # next round's heartbeats to HEALTHY peers
                 self._verify_pool = ThreadPoolExecutor(
-                    max_workers=4,
+                    max_workers=max(4, len(voters)),
                     thread_name_prefix=f"raft-verify-{self.id}")
         self.metrics.incr("raft.verify_leader")
         if voters:

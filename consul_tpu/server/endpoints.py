@@ -65,9 +65,10 @@ def register_endpoints(srv) -> None:
         """Register a read endpoint with consistency modes (rpc.go
         ForwardRPC): default → forwarded to the leader (read-your-writes);
         AllowStale → served from local replicated state; ?consistent →
-        the leader commits a BARRIER first, so the read is linearizable
-        even across an unnoticed leadership loss (consistentRead,
-        rpc.go RequiredConsistent path)."""
+        the leader confirms leadership via a coalesced VerifyLeader
+        heartbeat round (no log append) and serves at an APPLIED
+        ReadIndex, so the read is linearizable even across an unnoticed
+        leadership loss (consistentRead, rpc.go RequiredConsistent)."""
 
         def wrapper(args):
             if not args.get("AllowStale") and not srv.is_leader():
@@ -296,8 +297,9 @@ def register_endpoints(srv) -> None:
         and ride the group-commit batcher via callback — no worker
         thread parks for the commit wait. Declines (→ sync path, which
         forwards) everywhere else."""
-        if not srv.is_leader():
-            return False
+        if not srv.is_leader() or args.get("Datacenter") not in (
+                None, "", srv.config.datacenter):
+            return False  # cross-DC requests take the forwarding path
         srv.check_rate_limit("KVS.Apply", src)
         srv._batcher.apply_async(
             encode_command(MessageType.KVS, _kv_pre_apply(args)), respond)
@@ -314,8 +316,10 @@ def register_endpoints(srv) -> None:
         if not srv.is_leader() or args.get("AllowStale") \
                 or not args.get("RequireConsistent") \
                 or args.get("MinQueryIndex") \
-                or args.get("MaxQueryTime"):
-            return False
+                or args.get("MaxQueryTime") \
+                or args.get("Datacenter") not in (
+                    None, "", srv.config.datacenter):
+            return False  # incl. cross-DC → sync forwarding path
         srv.check_rate_limit("KVS.Get", src)
         key = args.get("Key", "")
         require(authz(args).key_read(key), f"key read on {key!r}")

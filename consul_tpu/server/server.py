@@ -137,18 +137,28 @@ class _VerifyGate:
         self._stopped = False
 
     def verify(self, timeout: float = 5.0):
-        """Blocking verify: returns the read index or raises."""
-        slot: list = [None]
-        done = threading.Event()
+        """Blocking verify: returns the read index or raises. Retries
+        within the timeout budget — a fresh leader legitimately
+        refuses until its election no-op commits (milliseconds), and
+        failing every ?consistent read in that window to clients would
+        be needless (the reference's consistentRead retries with
+        jitter until its deadline)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            slot: list = [None]
+            done = threading.Event()
 
-        def cb(ri) -> None:
-            slot[0] = ri
-            done.set()
+            def cb(ri) -> None:
+                slot[0] = ri
+                done.set()
 
-        self.verify_async(cb)
-        if not done.wait(timeout) or slot[0] is None:
-            raise NotLeader(self.raft.leader_id)
-        return slot[0]
+            self.verify_async(cb)
+            remaining = deadline - time.monotonic()
+            if done.wait(max(remaining, 0.05)) and slot[0] is not None:
+                return slot[0]
+            if time.monotonic() + 0.05 >= deadline:
+                raise NotLeader(self.raft.leader_id)
+            time.sleep(0.05)
 
     def verify_async(self, cb) -> None:
         with self._cv:

@@ -328,3 +328,111 @@ def test_default_allow_wildcard_l7_excludes_exact_sources():
     assert pids[0].get("any") is True
     assert pids[1]["not_id"]["authenticated"]["principal_name"][
         "suffix"] == "/svc/app"
+
+
+def _eval_rbac_perm(p, path, method, headers):
+    """Tiny interpreter for the envoy config.rbac.v3 Permission JSON
+    our builder emits — an INDEPENDENT algorithm (tree evaluation)
+    from authorize_l7's sequential first-match walk."""
+    import re as _re
+
+    if p.get("any"):
+        return True
+    if "url_path" in p:
+        m = p["url_path"]["path"]
+        if "exact" in m:
+            return path == m["exact"]
+        if "prefix" in m:
+            return path.startswith(m["prefix"])
+        if "safe_regex" in m:
+            return _re.fullmatch(m["safe_regex"]["regex"],
+                                 path) is not None
+    if "header" in p:
+        h = p["header"]
+        name = h["name"].lower()
+        val = method.upper() if name == ":method" else headers.get(name)
+        ok = False
+        if h.get("present_match"):
+            ok = val is not None
+        elif "string_match" in h:
+            sm = h["string_match"]
+            if val is None:
+                ok = False
+            elif "exact" in sm:
+                ok = val == sm["exact"]
+            elif "prefix" in sm:
+                ok = val.startswith(sm["prefix"])
+            elif "suffix" in sm:
+                ok = val.endswith(sm["suffix"])
+            elif "contains" in sm:
+                ok = sm["contains"] in val
+            elif "safe_regex" in sm:
+                ok = _re.fullmatch(sm["safe_regex"]["regex"],
+                                   val) is not None
+        if h.get("invert_match"):
+            ok = not ok
+        return ok
+    if "and_rules" in p:
+        return all(_eval_rbac_perm(r, path, method, headers)
+                   for r in p["and_rules"]["rules"])
+    if "or_rules" in p:
+        return any(_eval_rbac_perm(r, path, method, headers)
+                   for r in p["or_rules"]["rules"])
+    if "not_rule" in p:
+        return not _eval_rbac_perm(p["not_rule"], path, method, headers)
+    raise AssertionError(f"unknown permission {p}")
+
+
+def test_rbac_tree_matches_sequential_evaluator_differential():
+    """Differential conformance: for randomized permission lists and
+    requests, the Envoy RBAC tree our builder emits (OR of allows each
+    ANDed with NOT-of-prior-denies) must ALWAYS agree with
+    authorize_l7's sequential first-match evaluation — two independent
+    algorithms for the struct's documented precedence."""
+    import random
+
+    rng = random.Random(42)
+    paths = ["/", "/v1", "/v1/x", "/admin", "/admin/sub", "/healthz",
+             "/api/v2/items", "/metrics"]
+    methods = ["GET", "POST", "PUT", "DELETE"]
+
+    def rand_http():
+        http = {}
+        kind = rng.randrange(4)
+        if kind == 0:
+            http["PathExact"] = rng.choice(paths)
+        elif kind == 1:
+            http["PathPrefix"] = rng.choice(
+                ["/", "/v1", "/admin", "/api"])
+        elif kind == 2:
+            http["PathRegex"] = rng.choice(
+                [r"/v1/.*", r"/admin(/.*)?", r"/[a-z]+"])
+        if rng.random() < 0.5:
+            http["Methods"] = rng.sample(methods,
+                                         rng.randrange(1, 3))
+        if rng.random() < 0.4:
+            http["Header"] = [{"Name": "x-team",
+                               "Exact": rng.choice(["a", "b"])}]
+        if not http:
+            http["PathPrefix"] = "/"
+        return http
+
+    mismatches = []
+    for trial in range(300):
+        perms = [{"Action": rng.choice(["allow", "deny"]),
+                  "HTTP": rand_http()}
+                 for _ in range(rng.randrange(1, 5))]
+        tree = rbac_policy_permissions(perms)
+        for _ in range(8):
+            path = rng.choice(paths)
+            method = rng.choice(methods)
+            headers = {} if rng.random() < 0.5 else {
+                "x-team": rng.choice(["a", "b", "c"])}
+            seq, _ = authorize_l7(perms, path, method, headers)
+            via_tree = any(_eval_rbac_perm(p, path, method, headers)
+                           for p in tree)
+            if seq != via_tree:
+                mismatches.append((perms, path, method, headers,
+                                   seq, via_tree))
+    assert not mismatches, \
+        f"{len(mismatches)} divergences; first: {mismatches[0]}"
