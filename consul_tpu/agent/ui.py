@@ -156,11 +156,38 @@ async function service(wait) {
       <td>${checks}</td><td>${plink}</td></tr>`;
   }).join("");
   $("#view").innerHTML = `<p class="crumb">
-      <a href="#services">← services</a></p>
+      <a href="#services">← services</a> ·
+      <a href="#topology:${esc(name)}">topology</a></p>
     <h3>${esc(name)}</h3>
     <table><tr><th>Instance</th><th>Node</th><th>Address</th>
     <th>Checks</th><th>Sidecar proxy</th></tr>${rows ||
       "<tr><td colspan=5 class='mut'>(no instances)</td></tr>"}</table>`;
+}
+
+// topology: who this service may call / who may call it, from the
+// intention graph (ui_endpoint.go ServiceTopology)
+async function topology(wait) {
+  const name = decodeURIComponent(
+    location.hash.slice("#topology:".length));
+  const t = await fetchIdx(
+    `/v1/internal/ui/service-topology/${encodeURIComponent(name)}`,
+    "topo:" + name, wait);
+  const row = (s) => `<tr>
+    <td><a href="#service:${esc(s.Name)}">${esc(s.Name)}</a></td>
+    <td>${s.Intention === "l7"
+      ? '<span class="l7">L7 rules</span>'
+      : `<span class="allow">${esc(s.Intention)}</span>`}</td></tr>`;
+  const tbl = (title, rows) => `<h4>${title}</h4>
+    <table><tr><th>Service</th><th>Intention</th></tr>${
+      (rows || []).map(row).join("") ||
+      "<tr><td colspan=2 class='mut'>(none)</td></tr>"}</table>`;
+  $("#view").innerHTML = `<p class="crumb">
+      <a href="#service:${esc(name)}">← ${esc(name)}</a></p>
+    <h3>${esc(name)} topology</h3>
+    ${tbl("Upstreams — " + esc(name) + " may call",
+          t.Upstreams)}
+    ${tbl("Downstreams — may call " + esc(name),
+          t.Downstreams)}`;
 }
 
 // proxy detail: destination, local app address, upstreams (third hop)
@@ -370,14 +397,15 @@ async function kvval() {
 
 // -------------------------------------------------------------- router
 
-const views = {services, nodes, kv, intentions, service};
-const LIVE = new Set(["services", "nodes", "intentions", "service"]);
+const views = {services, nodes, kv, intentions, service, topology};
+const LIVE = new Set(["services", "nodes", "intentions", "service",
+                      "topology"]);
 async function route() {
   if (aborter) aborter.abort();
   aborter = new AbortController();
   const tab = (location.hash || "#services").slice(1).split(":")[0];
-  const navTab = {kvval: "kv", service: "services",
-                  proxy: "services"}[tab] || tab;
+  const navTab = {kvval: "kv", service: "services", proxy: "services",
+                  topology: "services"}[tab] || tab;
   document.querySelectorAll("#nav a").forEach((a) =>
     a.classList.toggle("active", a.hash.slice(1) === navTab));
   try {

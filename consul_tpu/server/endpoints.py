@@ -2060,20 +2060,36 @@ def register_endpoints(srv) -> None:
         (ui_endpoint.go ServiceTopology, simplified)."""
         name = args.get("ServiceName", "")
         require(authz(args).service_read(name), f"service read {name!r}")
-        from consul_tpu.connect.intentions import authorize as _iauthz
-
         default_allow = srv.config.acl_default_policy == "allow" \
             or not srv.config.acl_enabled
 
         def run():
+            from consul_tpu.connect.intentions import match_intention
+
             intentions = state.raw_list("intentions")
             services = set(state.services())
+
+            def edge(src, dst):
+                """allow | l7 | None — an L7-gated pair IS an edge
+                (traffic can flow, per-request rules apply). ONE
+                match per direction: authorize() would just re-run
+                the same match_intention scan."""
+                m = match_intention(intentions, src, dst)
+                if m is None:
+                    return "allow" if default_allow else None
+                if m.get("Permissions"):
+                    return "l7"
+                return "allow" \
+                    if m.get("Action", "allow") == "allow" else None
+
             ups, downs = [], []
             for other in sorted(services - {name}):
-                if _iauthz(intentions, name, other, default_allow)[0]:
-                    ups.append({"Name": other, "Intention": "allow"})
-                if _iauthz(intentions, other, name, default_allow)[0]:
-                    downs.append({"Name": other, "Intention": "allow"})
+                up = edge(name, other)
+                if up:
+                    ups.append({"Name": other, "Intention": up})
+                down = edge(other, name)
+                if down:
+                    downs.append({"Name": other, "Intention": down})
             return {"Upstreams": ups, "Downstreams": downs,
                     "FilteredByACLs": False}
 

@@ -762,3 +762,37 @@ def test_client_library_typed_helpers(agent, client):
     assert snap[:2] == b"\x1f\x8b"  # gzip magic
     meta = client.snapshot_restore(snap)
     assert meta.get("Index", 0) >= 0
+
+
+def test_service_topology_includes_l7_edges(agent, client):
+    """An L7-gated pair IS a topology edge (traffic can flow;
+    per-request rules apply) and is labeled 'l7' so the UI can badge
+    it; plain allows stay 'allow'."""
+    client.service_register({"Name": "topo-a", "ID": "ta1", "Port": 1})
+    client.service_register({"Name": "topo-b", "ID": "tb1", "Port": 2})
+    client.service_register({"Name": "topo-c", "ID": "tc1", "Port": 3})
+    client.put("/v1/config", body={"Kind": "service-defaults",
+                                   "Name": "topo-b",
+                                   "Protocol": "http"})
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "topo-a", "DestinationName": "topo-b",
+        "Permissions": [{"Action": "allow",
+                         "HTTP": {"PathPrefix": "/"}}]})
+    client.put("/v1/connect/intentions", body={
+        "SourceName": "topo-c", "DestinationName": "topo-b",
+        "Action": "deny"})
+    from helpers import wait_for
+
+    wait_for(lambda: client.catalog_service("topo-b"),
+             what="topo-b in catalog")
+    t = client.get("/v1/internal/ui/service-topology/topo-b")
+    downs = {d["Name"]: d["Intention"] for d in t["Downstreams"]}
+    assert downs.get("topo-a") == "l7"
+    assert "topo-c" not in downs  # denied edge is no edge
+    # the UI page carries the topology view
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://{agent.http.addr}/ui") as r:
+        body = r.read().decode()
+    assert "#topology:" in body and "topology" in body
