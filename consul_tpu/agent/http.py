@@ -1201,6 +1201,9 @@ class HTTPApi:
             rpc("Operator.RaftRemovePeer",
                 {"Address": q.get("address", "")})
             return True, None
+        if path == "/v1/operator/raft/verify" \
+                and method in ("PUT", "POST"):
+            return rpc("Operator.RaftVerify", {}), None
         if path == "/v1/operator/raft/configuration":
             stats = rpc("Status.RaftStats", {})
             nonvoters = set(stats.get("nonvoters") or [])

@@ -439,6 +439,20 @@ def cmd_operator(args) -> int:
                          str(s["Voter"]).lower()))
         _table(rows)
         return 0
+    if args.operator_cmd == "raft" and args.raft_cmd == "verify":
+        res = c.put("/v1/operator/raft/verify")
+        pub = res.get("Published")
+        print("Published checksum over entries "
+              f"[{pub[0]}, {pub[1]}]" if pub
+              else "Nothing new to verify")
+        rows = [("Server", "VerifyOk", "VerifyFailed", "VerifiedTo")]
+        for name, s in sorted(res.get("Servers", {}).items()):
+            rows.append((name, str(s.get("VerifyOk", "-")),
+                         str(s.get("VerifyFailed", "-")),
+                         str(s.get("VerifiedTo",
+                                   s.get("Error", "-")))))
+        _table(rows)
+        return 0 if res.get("VerifyFailed", 0) == 0 else 2
     return 1
 
 
@@ -1981,6 +1995,7 @@ def build_parser() -> argparse.ArgumentParser:
     raft = opsub.add_parser("raft")
     raftsub = raft.add_subparsers(dest="raft_cmd", required=True)
     raftsub.add_parser("list-peers")
+    raftsub.add_parser("verify")
     rrm = raftsub.add_parser("remove-peer")
     rrm.add_argument("-address", required=True)
     rtl = raftsub.add_parser("transfer-leader")

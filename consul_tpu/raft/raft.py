@@ -112,6 +112,7 @@ class RaftNode:
         self.verify_ok = 0
         self.verify_failed = 0
         self._verify_pool = None  # created under _lock on first verify
+        self._verify_inflight = False  # single-flight verify_log
         self._term_start_index = 0  # our election no-op's index
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
@@ -376,43 +377,54 @@ class RaftNode:
                 acc[i] ^= h[i]
         return bytes(acc)
 
-    def verify_log(self) -> Optional[tuple[int, int]]:
+    def verify_log(self) -> Optional[tuple[int, int, int]]:
         """Leader: append a verify entry covering committed entries
         since the last verification (window capped by entries AND
         bytes); every node (self included) checks the range against
-        its own log at apply time. Returns the range published, or
-        None when there is nothing new to verify."""
+        its own log at apply time. Returns (lo, hi, entry_index), or
+        None when there is nothing new to verify. Concurrent calls
+        (the 30s loop + the operator RPC) are single-flighted — two
+        publishers would double-count the same range."""
         with self._lock:
-            if self.role != Role.LEADER or self._stopped:
+            if self.role != Role.LEADER or self._stopped \
+                    or self._verify_inflight:
                 return None
-            lo = max(self.store.first_index(), self._verified_to + 1)
-            hi = min(self.commit_index,
-                     lo + self.VERIFY_MAX_ENTRIES - 1)
-            if hi < lo:
-                return None
-            size = 0
-            for idx in range(lo, hi + 1):
-                e = self.store.entry(idx)
-                size += len((e or {}).get("data") or b"")
-                if size > self.VERIFY_MAX_BYTES and idx > lo:
-                    hi = idx - 1
-                    break
-        s = self.checksum_range(lo, hi)
-        if s is None:
+            self._verify_inflight = True
+        try:
             with self._lock:
-                # range compacted from under us: restart past it
-                self._verified_to = max(self._verified_to,
-                                        self.store.snapshot_index)
-            return None
-        with self._lock:
-            if self.role != Role.LEADER:
+                lo = max(self.store.first_index(),
+                         self._verified_to + 1)
+                hi = min(self.commit_index,
+                         lo + self.VERIFY_MAX_ENTRIES - 1)
+                if hi < lo:
+                    return None
+                size = 0
+                for idx in range(lo, hi + 1):
+                    e = self.store.entry(idx)
+                    size += len((e or {}).get("data") or b"")
+                    if size > self.VERIFY_MAX_BYTES and idx > lo:
+                        hi = idx - 1
+                        break
+            s = self.checksum_range(lo, hi)
+            if s is None:
+                with self._lock:
+                    # range compacted from under us: restart past it
+                    self._verified_to = max(self._verified_to,
+                                            self.store.snapshot_index)
                 return None
-            self.store.append([{"term": self.store.term, "data": b"",
-                                "kind": "verify", "lo": lo, "hi": hi,
-                                "sum": s}])
-            self._verified_to = hi
-        self._replicate_all()
-        return (lo, hi)
+            with self._lock:
+                if self.role != Role.LEADER:
+                    return None
+                self.store.append([{"term": self.store.term,
+                                    "data": b"", "kind": "verify",
+                                    "lo": lo, "hi": hi, "sum": s}])
+                entry_idx = self.store.last_index()
+                self._verified_to = hi
+            self._replicate_all()
+            return (lo, hi, entry_idx)
+        finally:
+            with self._lock:
+                self._verify_inflight = False
 
     def apply_noop(self) -> None:
         with self._lock:
@@ -517,6 +529,9 @@ class RaftNode:
                 "num_peers": len(self.peers) - 1,
                 "peers": sorted(self.peers),
                 "nonvoters": sorted(self.nonvoters),
+                "verify_ok": self.verify_ok,
+                "verify_failed": self.verify_failed,
+                "verified_to": self._verified_to,
             }
 
     # ------------------------------------------------------------ elections

@@ -1896,6 +1896,48 @@ def register_endpoints(srv) -> None:
     e["Internal.JoinWAN"] = join_wan
 
     # ----------------------------------------- round-2 breadth endpoints
+    def raft_verify(args):
+        """operator/raft/verify: publish a verification checksum over
+        newly committed entries NOW (the 30s loop does this
+        continuously), wait for the round to APPLY locally, then
+        report EVERY server's verification counters — corruption is a
+        per-node condition (each node checks its OWN log), so
+        leader-only counters would hide a corrupted follower."""
+        require(authz(args).operator_write(), "operator write")
+        rng = srv.raft.verify_log()
+        if rng is not None:
+            # sample counters only after the round we just triggered
+            # has actually run here (the apply is asynchronous)
+            deadline = time.monotonic() + 5.0
+            while srv.raft.last_applied < rng[2] \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+        servers = {}
+        for row in srv._servers():
+            addr = row["rpc_addr"]
+            if not addr:
+                continue
+            try:
+                st = srv.handle_rpc(
+                    "Status.RaftStats", {"AllowStale": True},
+                    "local") if addr == srv.rpc.addr else \
+                    srv.pool.call(addr, "Status.RaftStats",
+                                  {"AllowStale": True}, timeout=3.0)
+            except Exception:  # noqa: BLE001 — unreachable node
+                servers[row["name"]] = {"Error": "unreachable"}
+                continue
+            servers[row["name"]] = {
+                "VerifyOk": st.get("verify_ok", 0),
+                "VerifyFailed": st.get("verify_failed", 0),
+                "VerifiedTo": st.get("verified_to", 0)}
+        return {"Published": list(rng[:2]) if rng else None,
+                "Servers": servers,
+                "VerifyFailed": sum(
+                    s.get("VerifyFailed", 0) for s in servers.values()
+                    if isinstance(s.get("VerifyFailed"), int))}
+
+    write("Operator.RaftVerify", raft_verify)
+
     def raft_transfer_leader(args):
         """operator/raft/transfer-leader (operator_endpoint.go): hand
         leadership to a named peer, or the most caught-up follower."""
