@@ -452,7 +452,14 @@ def cmd_operator(args) -> int:
                          str(s.get("VerifiedTo",
                                    s.get("Error", "-")))))
         _table(rows)
-        return 0 if res.get("VerifyFailed", 0) == 0 else 2
+        if res.get("VerifyFailed", 0):
+            return 2  # corruption detected somewhere
+        if res.get("Unreachable"):
+            # incomplete verification must not read as a clean pass
+            print("Unreachable: " + ", ".join(res["Unreachable"]),
+                  file=sys.stderr)
+            return 3
+        return 0
     return 1
 
 

@@ -385,11 +385,22 @@ class RaftNode:
         None when there is nothing new to verify. Concurrent calls
         (the 30s loop + the operator RPC) are single-flighted — two
         publishers would double-count the same range."""
-        with self._lock:
-            if self.role != Role.LEADER or self._stopped \
-                    or self._verify_inflight:
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        while True:
+            with self._lock:
+                if self.role != Role.LEADER or self._stopped:
+                    return None
+                if not self._verify_inflight:
+                    self._verify_inflight = True
+                    break
+            # another publisher (the 30s loop vs the operator RPC) is
+            # mid-round: wait it out rather than reporting "nothing to
+            # verify" for entries it may not cover
+            if _time.monotonic() >= deadline:
                 return None
-            self._verify_inflight = True
+            _time.sleep(0.01)
         try:
             with self._lock:
                 lo = max(self.store.first_index(),
@@ -1040,6 +1051,10 @@ class RaftNode:
                 elif got == want:
                     self.verify_ok += 1
                     self.metrics.incr("raft.verify.ok")
+                    # followers track coverage too — stats() reports
+                    # verified_to per NODE, not just the publisher
+                    self._verified_to = max(self._verified_to,
+                                            e.get("hi", 0))
                 else:
                     self.verify_failed += 1
                     self.metrics.incr("raft.verify.failed")

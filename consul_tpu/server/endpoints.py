@@ -1912,11 +1912,8 @@ def register_endpoints(srv) -> None:
             while srv.raft.last_applied < rng[2] \
                     and time.monotonic() < deadline:
                 time.sleep(0.02)
-        servers = {}
-        for row in srv._servers():
+        def poll(row):
             addr = row["rpc_addr"]
-            if not addr:
-                continue
             try:
                 st = srv.handle_rpc(
                     "Status.RaftStats", {"AllowStale": True},
@@ -1924,14 +1921,23 @@ def register_endpoints(srv) -> None:
                     srv.pool.call(addr, "Status.RaftStats",
                                   {"AllowStale": True}, timeout=3.0)
             except Exception:  # noqa: BLE001 — unreachable node
-                servers[row["name"]] = {"Error": "unreachable"}
-                continue
-            servers[row["name"]] = {
+                return row["name"], {"Error": "unreachable"}
+            return row["name"], {
                 "VerifyOk": st.get("verify_ok", 0),
                 "VerifyFailed": st.get("verify_failed", 0),
                 "VerifiedTo": st.get("verified_to", 0)}
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        rows = [r for r in srv._servers() if r["rpc_addr"]]
+        # concurrent polls: dead nodes must cost ONE timeout, not one
+        # each in sequence (this handler holds an RPC worker)
+        with ThreadPoolExecutor(max_workers=max(1, len(rows))) as ex:
+            servers = dict(ex.map(poll, rows))
         return {"Published": list(rng[:2]) if rng else None,
                 "Servers": servers,
+                "Unreachable": sorted(
+                    n for n, s in servers.items() if "Error" in s),
                 "VerifyFailed": sum(
                     s.get("VerifyFailed", 0) for s in servers.values()
                     if isinstance(s.get("VerifyFailed"), int))}

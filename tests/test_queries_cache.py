@@ -127,3 +127,44 @@ def test_rpc_rate_limit_enforced():
         assert hit_limit, "30 rapid writes should exceed 5 rps/burst 5"
     finally:
         a.shutdown()
+
+
+def test_consistent_blocking_query_takes_sync_path():
+    """?consistent + index= (a blocking query) must decline the mux
+    async fast path and still block/fire correctly through the sync
+    wrapper."""
+    import threading
+
+    from consul_tpu.api import ConsulClient
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import load
+    from helpers import wait_for
+
+    a = Agent(load(dev=True, overrides={"node_name": "cbq-agent"}))
+    a.start(serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="self-elect")
+        c = ConsulClient(a.http.addr)
+        c.kv_put("cbq/k", b"v0")
+        entry, idx = c.get_with_index("/v1/kv/cbq/k?consistent")
+        assert entry[0]["Key"] == "cbq/k" and idx > 0
+        got = {}
+
+        def blocker():
+            got["e"], got["i"] = c.get_with_index(
+                f"/v1/kv/cbq/k?consistent&index={idx}&wait=10s")
+
+        t = threading.Thread(target=blocker, daemon=True)
+        t.start()
+        import time as _t
+
+        _t.sleep(0.3)
+        assert t.is_alive(), "blocking ?consistent returned early"
+        c.kv_put("cbq/k", b"v1")
+        t.join(timeout=8)
+        assert not t.is_alive() and got["i"] > idx
+        import base64
+
+        assert base64.b64decode(got["e"][0]["Value"]) == b"v1"
+    finally:
+        a.shutdown()
