@@ -8,18 +8,33 @@ SURVEY.md §4): we spin N virtual devices on one host.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# CONSUL_TPU_TEST_PLATFORM overrides the default CPU pin so the slow
+# conformance tier can run on the chip (pyproject.toml's slow-marker text;
+# round-4 verdict item 4):
+#     CONSUL_TPU_TEST_PLATFORM=tpu python -m pytest tests/ -m slow -q
+# Default stays "cpu" with a virtual 8-device mesh. "tpu" is normalized
+# to this image's tunnel backend name ("axon") when that plugin is the
+# one registered, so the documented command works on both real-TPU and
+# tunneled images.
+_PLATFORM = os.environ.get("CONSUL_TPU_TEST_PLATFORM", "cpu")
+if _PLATFORM == "tpu" and os.environ.get("JAX_PLATFORMS") == "axon":
+    _PLATFORM = "axon"
+
+os.environ["JAX_PLATFORMS"] = _PLATFORM
+if _PLATFORM == "cpu":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 # The image's site hook (PYTHONPATH sitecustomize) pre-imports jax before
 # conftest runs, so env vars alone are too late — repoint the platform at
-# runtime as well (works as long as no arrays were created yet).
+# runtime as well (works as long as no arrays were created yet). On this
+# image the hook also re-pins jax_platforms at interpreter startup, so
+# the config update below is the one that actually takes effect.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms", _PLATFORM)
 
 import pytest  # noqa: E402
 
