@@ -116,6 +116,16 @@ class RaftNode:
         self._term_start_index = 0  # our election no-op's index
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
+        # read-index lease bookkeeping: peer -> (term, send-time of the
+        # last append_entries that peer answered AT that term). Send
+        # time, not receive time — the peer provably recognized the
+        # term at some instant >= send, so send is the safe bound.
+        # Fed by the replicator streams and by verify rounds; consumed
+        # by lease_read_index(). _lease_inhibit blocks the lease during
+        # a leadership transfer (TimeoutNow bypasses pre-vote, voiding
+        # the lease's soundness argument) until the next transition.
+        self._peer_ack: dict[str, tuple[int, float]] = {}
+        self._lease_inhibit = False
         self._election_timer = None
         # real-clock election watchdog (see _reset_election_timer)
         self._watchdog: Optional[threading.Thread] = None
@@ -299,6 +309,7 @@ class RaftNode:
             done = threading.Event()
 
             def ask(peer: str) -> None:
+                sent = self.clock.now()
                 try:
                     reply = self.transport.call(peer, "append_entries", {
                         "term": term, "leader": self.transport.addr,
@@ -313,6 +324,7 @@ class RaftNode:
                             self._step_down(reply["term"])
                     done.set()
                     return
+                self._record_peer_ack(peer, term, sent)
                 with alock:
                     acks[0] += 1
                     if acks[0] >= need:
@@ -339,6 +351,74 @@ class RaftNode:
             if self.last_applied < read_index:
                 return None  # stopped mid-wait: never serve a lagging
                 #              FSM as a linearizable read
+        return read_index
+
+    def _record_peer_ack(self, peer: str, term: int, sent: float) -> None:
+        with self._lock:
+            cur = self._peer_ack.get(peer)
+            if cur is None or cur < (term, sent):
+                self._peer_ack[peer] = (term, sent)
+
+    def lease_read_index(self, window: Optional[float] = None,
+                         timeout: float = 2.0) -> Optional[int]:
+        """Read-index lease (raft §6.4's lease-based read-only
+        optimization; what lets consul's consistentRead amortize
+        VerifyLeader rounds under sustained load): serve a linearizable
+        read WITHOUT a fresh quorum fan-out when a voter majority has
+        acknowledged this term within the last `window` seconds —
+        the heartbeats the replicator streams are already sending count,
+        so a steady-state leader pays zero extra RPCs per read.
+
+        Soundness: an ack at send-time T means that peer's election
+        timer was reset at some instant >= T. With acks from a majority
+        inside [now-w, now] and w << election_timeout_min, no competing
+        candidate can have assembled a majority of expired timers —
+        and pre-vote (this raft has it) stops a disruptive node from
+        bumping the term without one. The one protocol path that
+        voids this argument is leadership transfer (TimeoutNow skips
+        pre-vote and election timeouts), so transfer_leadership sets
+        _lease_inhibit for the remainder of the reign. The residual
+        assumption is bounded monotonic-clock RATE drift over a
+        sub-second window, the same assumption etcd's and TiKV's
+        lease reads make.
+        Returns None (caller falls back to a full verify round) when
+        the lease is cold, leadership is unconfirmed this term, or the
+        FSM hasn't applied up to the read point in time."""
+        w = self.heartbeat_interval if window is None else window
+        with self._lock:
+            if self.role != Role.LEADER or self._stopped \
+                    or self._lease_inhibit:
+                return None
+            if self.commit_index < self._term_start_index:
+                return None  # same fresh-leader guard as verify_leadership
+            term = self.store.term
+            voters = [p for p in (self.peers - self.nonvoters)
+                      if p != self.transport.addr]
+            if voters:
+                now = self.clock.now()
+                acks = sorted(
+                    (t for p in voters
+                     for tm, t in [self._peer_ack.get(p, (0, 0.0))]
+                     if tm == term),
+                    reverse=True)
+                need = (len(voters) + 1) // 2  # majority minus self
+                if len(acks) < need or now - acks[need - 1] > w:
+                    return None
+            read_index = self.commit_index
+            # ReadIndex discipline unchanged: only serve once applied.
+            # timeout=0 callers (the _VerifyGate fast path, which runs
+            # on the mux READER thread) never park here — a lagging FSM
+            # sends them to the queued verify round instead of
+            # head-of-line-blocking the connection.
+            deadline = self.clock.now() + timeout
+            while self.last_applied < read_index and not self._stopped:
+                remaining = deadline - self.clock.now()
+                if remaining <= 0:
+                    return None
+                self._applied_cv.wait(remaining)
+            if self.last_applied < read_index:
+                return None
+        self.metrics.incr("raft.lease_read")
         return read_index
 
     #: verify-window caps: one verification round covers at most this
@@ -523,6 +603,13 @@ class RaftNode:
             if self.role != Role.LEADER:
                 raise NotLeader(self.leader_id)
             term = self.store.term
+            # gate lease reads for the rest of this reign
+            # (hashicorp/raft leadershipTransferInProgress): TimeoutNow
+            # bypasses pre-vote, so the target can win term+1 and commit
+            # writes while OUR replicator acks at the old term are still
+            # inside the lease window — a lease read here could miss
+            # them. Cleared on the next role/term transition.
+            self._lease_inhibit = True
         resp = self.transport.call(target, "timeout_now", {"term": term},
                                    timeout=timeout)
         if not (resp or {}).get("scheduled"):
@@ -745,6 +832,7 @@ class RaftNode:
     def _become_leader(self) -> None:
         self.role = Role.LEADER
         self.leader_id = self.transport.addr
+        self._lease_inhibit = False
         self.metrics.incr("raft.election.won")
         self.log.info("won election for term %d", self.store.term)
         nxt = self.store.last_index() + 1
@@ -780,6 +868,7 @@ class RaftNode:
         if was_leader:
             self._leadership_era += 1
         self.role = Role.FOLLOWER
+        self._lease_inhibit = False
         if was_leader and self._heartbeat_timer is not None:
             self._heartbeat_timer.cancel()
         self._repl_cv.notify_all()  # parked replicators re-check and exit
@@ -890,6 +979,7 @@ class RaftNode:
                 }
         if send_snap:
             return self._send_snapshot(peer)
+        sent = self.clock.now()
         try:
             reply = self.transport.call(peer, "append_entries", args)
         except Exception:  # noqa: BLE001 — peer unreachable
@@ -901,6 +991,9 @@ class RaftNode:
             if reply.get("term", 0) > term:
                 self._step_down(reply["term"])
                 return True
+            # any reply at term <= ours — success OR log-conflict —
+            # means the peer recognizes the term: feed the read lease
+            self._record_peer_ack(peer, term, sent)
             if reply.get("success"):
                 if entries:
                     match = prev_idx + len(entries)

@@ -309,3 +309,99 @@ def test_transfer_bypasses_prevote():
     clock.advance(1.0)
     assert target.is_leader()
     assert not leader.is_leader()
+
+
+def test_lease_read_index_warm_after_heartbeats():
+    """Read-index lease (raft §6.4 read-only optimization, the fast
+    path under consul's consistentRead): once replicator heartbeats
+    have quorum-acked the term, the leader serves a read index with
+    NO fresh fan-out; followers never do."""
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    leader.apply(b"w1")
+    clock.advance(0.05)  # one heartbeat interval: acks recorded
+    ri = leader.lease_read_index()
+    assert ri is not None and ri >= 1
+    assert ri == leader.commit_index
+    for n in nodes:
+        if n is not leader:
+            assert n.lease_read_index() is None
+
+
+def test_lease_expires_without_quorum_contact():
+    """A partitioned leader's lease dies within one window: after
+    heartbeats stop reaching a voter majority, lease_read_index
+    refuses and callers fall back to a full verify round (which also
+    fails — linearizability preserved)."""
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    leader.apply(b"w1")
+    clock.advance(0.05)
+    assert leader.lease_read_index() is not None
+    others = {n.transport.addr for n in nodes if n is not leader}
+    net.partition({leader.transport.addr}, others)
+    # advance past the lease window without quorum contact. The old
+    # leader may not have noticed it lost leadership yet — the LEASE
+    # must refuse regardless.
+    clock.advance(0.2)
+    if leader.is_leader():  # pre-step-down window
+        assert leader.lease_read_index() is None
+    # meanwhile the majority side elects; a write there must never be
+    # invisible to a ?consistent read served by anyone
+    new_leader = wait_leader(clock, [n for n in nodes if n is not leader])
+    new_leader.apply(b"w2")
+    assert leader.lease_read_index() is None
+
+
+def test_lease_acks_are_term_scoped():
+    """Acks recorded under an old term never satisfy the lease in a
+    new one: a re-elected leader must re-earn quorum contact at its
+    own term before lease reads resume."""
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    leader.apply(b"w1")
+    clock.advance(0.05)
+    assert leader.lease_read_index() is not None
+    # force a term bump via transfer: the NEW leader starts with no
+    # acks at the new term until its no-op commits + heartbeats flow
+    term_before = leader.store.term
+    new_leader = wait_leader(clock, nodes)
+    assert new_leader.store.term >= term_before
+    # stale entries at the old term in _peer_ack must not count
+    stale = {p: (term_before - 1, clock.now())
+             for p in new_leader._peer_ack}
+    new_leader._peer_ack = stale
+    assert new_leader.lease_read_index() is None
+    clock.advance(0.1)  # heartbeats re-earn the lease at this term
+    assert new_leader.lease_read_index() is not None
+
+
+def test_lease_inhibited_during_leadership_transfer():
+    """TimeoutNow bypasses pre-vote, voiding the lease soundness
+    argument: the moment a transfer is initiated the OLD leader must
+    stop serving lease reads, even though its replicator acks are
+    still fresh (hashicorp/raft leadershipTransferInProgress)."""
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    leader.apply(b"w1")
+    clock.advance(0.05)
+    assert leader.lease_read_index() is not None
+    target = next(n for n in nodes if n is not leader)
+    import threading
+
+    t = threading.Thread(target=leader.transfer_leadership,
+                         args=(target.transport.addr,), daemon=True)
+    t.start()
+    # drive the sim clock so the catch-up + TimeoutNow + election run
+    for _ in range(40):
+        clock.advance(0.05)
+        if leader._lease_inhibit or not leader.is_leader():
+            break
+    # from inhibit-set onward the old leader refuses lease reads for
+    # the rest of its reign (acks ARE still warm — the flag is load-
+    # bearing), and after the transfer it isn't leader at all
+    assert leader.lease_read_index() is None
+    t.join(timeout=5)
+    new = wait_leader(clock, nodes)
+    assert new is target
+    assert leader.lease_read_index() is None
