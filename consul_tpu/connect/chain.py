@@ -169,6 +169,26 @@ def validate_entry(entry: dict) -> None:
             if not s.get("Name"):
                 raise ValueError(
                     "terminating-gateway service requires Name")
+    elif kind == "jwt-provider":
+        # structs.JWTProviderConfigEntry Validate: a provider must be
+        # nameable from intentions and carry a key set to verify with
+        if not entry.get("Name"):
+            raise ValueError("jwt-provider requires Name")
+        jwks = entry.get("JSONWebKeySet")
+        if not isinstance(jwks, dict) or not (
+                (jwks.get("Local") or {}).get("JWKS")
+                or (jwks.get("Local") or {}).get("Filename")
+                or (jwks.get("Remote") or {}).get("URI")):
+            raise ValueError(
+                "jwt-provider requires JSONWebKeySet.Local.JWKS, "
+                ".Local.Filename or .Remote.URI")
+        for loc in entry.get("Locations") or []:
+            if not isinstance(loc, dict) or not (
+                    loc.get("Header") or loc.get("QueryParam")
+                    or loc.get("Cookie")):
+                raise ValueError(
+                    "jwt-provider Location needs Header, QueryParam "
+                    "or Cookie")
     elif kind == "control-plane-request-limit":
         # runtime rate-limit retuning (structs.GlobalRateLimitConfig-
         # Entry): bad values must die here, not at the refresh loop
@@ -190,6 +210,19 @@ def validate_entry(entry: dict) -> None:
                 ok = False
             if not ok:
                 raise ValueError(f"{k} must be a number >= 0")
+
+    # proxy-defaults / service-defaults may carry EnvoyExtensions:
+    # every declared extension must construct cleanly BEFORE the entry
+    # is stored (registered_extensions.go ValidateExtensions) — a typo
+    # found at xDS-generation time would silently skip the extension
+    if entry.get("EnvoyExtensions") is not None:
+        from consul_tpu.connect.extensions import validate_extensions
+
+        if not isinstance(entry["EnvoyExtensions"], list):
+            raise ValueError("EnvoyExtensions must be a list")
+        errs = validate_extensions(entry["EnvoyExtensions"])
+        if errs:
+            raise ValueError("; ".join(errs))
 
 
 def _resolve(name: str,

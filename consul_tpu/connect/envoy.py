@@ -238,12 +238,15 @@ def bootstrap_config(snapshot: dict[str, Any],
                      sds: bool = False) -> dict[str, Any]:
     kind = snapshot.get("Kind", "connect-proxy")
     if kind == "ingress-gateway":
-        return _ingress_bootstrap(snapshot, admin_port, sds=sds)
+        return _post_process(_ingress_bootstrap(snapshot, admin_port,
+                                                sds=sds), snapshot)
     if kind == "terminating-gateway":
-        return _terminating_bootstrap(snapshot, admin_port, sds=sds)
+        return _post_process(_terminating_bootstrap(snapshot, admin_port,
+                                                    sds=sds), snapshot)
     if kind == "mesh-gateway":
         # pure SNI passthrough, no TLS termination → nothing to serve
-        return _mesh_bootstrap(snapshot, admin_port)
+        return _post_process(_mesh_bootstrap(snapshot, admin_port),
+                             snapshot)
     svc = snapshot.get("Service", "")
     if sds:
         # SDS mode (xds secrets.go:18-27): TLS contexts REFERENCE
@@ -335,7 +338,7 @@ def bootstrap_config(snapshot: dict[str, Any],
             "filter_chains": [{"filters": [filt]}],
         })
 
-    return {
+    cfg = {
         "admin": {"address": _addr("127.0.0.1", admin_port)},
         "node": {"id": snapshot["ProxyID"],
                  "cluster": snapshot["Service"],
@@ -349,6 +352,49 @@ def bootstrap_config(snapshot: dict[str, Any],
             **({"secrets": secrets_from_snapshot(snapshot)}
                if sds else {})},
     }
+    return _post_process(cfg, snapshot)
+
+
+def _post_process(cfg: dict[str, Any],
+                  snapshot: dict[str, Any]) -> dict[str, Any]:
+    """Post-generation passes over the assembled resources:
+
+    1. JWT authn (xds/jwt_authn.go:30): when the matched intentions
+       reference jwt-provider config entries, insert the jwt_authn
+       HTTP filter ahead of the RBAC filters in every inbound HCM —
+       claims must be validated before authorization consumes them.
+    2. Envoy extension runtime (envoyextensions/registered_extensions
+       .go + xds/extensionruntime): apply the snapshot's configured
+       extensions to the generated resources. Failures are isolated
+       per-extension (logged, resources untouched) unless Required.
+    """
+    from consul_tpu.connect.extensions import (apply_extensions,
+                                               collect_jwt_provider_names,
+                                               insert_http_filter,
+                                               jwks_clusters,
+                                               jwt_authn_filter,
+                                               _iter_hcms)
+    from consul_tpu.utils import log
+
+    jwt = jwt_authn_filter(snapshot.get("Intentions") or [],
+                           snapshot.get("JWTProviders") or {})
+    if jwt is not None:
+        for _, hcm in _iter_hcms(cfg, "inbound"):
+            has_rbac = any(f.get("name") == "envoy.filters.http.rbac"
+                           for f in hcm.get("http_filters") or [])
+            insert_http_filter(
+                hcm, dict(jwt),
+                before="envoy.filters.http.rbac" if has_rbac else None)
+        # remote-JWKS providers need a cluster Envoy can fetch from
+        cfg["static_resources"]["clusters"].extend(jwks_clusters(
+            snapshot.get("JWTProviders") or {},
+            collect_jwt_provider_names(
+                snapshot.get("Intentions") or [])))
+    errors = apply_extensions(cfg, snapshot)
+    for err in errors:
+        log.named("envoy.extensions").warning(
+            "extension skipped: %s", err)
+    return cfg
 
 
 def _addr(host: str, port: int) -> dict[str, Any]:

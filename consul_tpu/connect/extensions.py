@@ -1,0 +1,420 @@
+"""Envoy extension runtime: named plugins over generated xDS resources.
+
+Reference behavior: agent/envoyextensions/registered_extensions.go keeps
+a registry of built-in extension constructors; agent/xds applies each
+configured extension to the resources AFTER the core generator runs, so
+users inject lua scripts or external authorization without forking the
+generator. Extensions are declared on proxy-defaults / service-defaults
+config entries:
+
+    EnvoyExtensions = [
+      {"Name": "builtin/lua",
+       "Arguments": {"Script": "...", "Listener": "inbound"}},
+    ]
+
+and flow into the proxy snapshot (proxycfg assemble_snapshot), which
+`apply_extensions` consumes at the end of bootstrap_config. A failing
+extension is SKIPPED and reported (never breaks the proxy's xDS) unless
+it sets Required=true — matching the ref's isolation semantics
+(agent/xds/resources.go applyEnvoyExtensions).
+
+JWT authn (agent/xds/jwt_authn.go:30) is not an extension in the ref and
+isn't one here: `jwt_authn_filter` builds the
+envoy.filters.http.jwt_authn filter from jwt-provider config entries
+referenced by the service's intentions; the generator inserts it ahead
+of the RBAC filters so claims are validated before authorization runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+HCM = "envoy.filters.network.http_connection_manager"
+ROUTER = "envoy.filters.http.router"
+
+
+class ExtensionError(ValueError):
+    """Invalid extension configuration (bad name or arguments)."""
+
+
+REGISTERED: dict[str, type] = {}
+
+
+def register(name: str) -> Callable[[type], type]:
+    def deco(cls: type) -> type:
+        cls.name = name
+        REGISTERED[name] = cls
+        return cls
+    return deco
+
+
+def construct_extension(ext: dict[str, Any]) -> "EnvoyExtension":
+    """Lookup + build (registered_extensions.go ConstructExtension).
+    Raises ExtensionError for unknown names or invalid Arguments."""
+    name = ext.get("Name") or ""
+    cls = REGISTERED.get(name)
+    if cls is None:
+        raise ExtensionError(f"name {name!r} is not a built-in extension")
+    return cls(ext)
+
+
+def validate_extensions(exts: list[dict[str, Any]]) -> list[str]:
+    """Config-entry write-time validation (ValidateExtensions): build
+    every declared extension, collect error strings. An empty list
+    means the entry may be stored."""
+    errors = []
+    for i, ext in enumerate(exts or []):
+        if not ext.get("Name"):
+            errors.append(f"invalid EnvoyExtensions[{i}]: Name is required")
+            continue
+        try:
+            construct_extension(ext)
+        except ExtensionError as e:
+            errors.append(
+                f"invalid EnvoyExtensions[{i}][{ext['Name']}]: {e}")
+    return errors
+
+
+# ------------------------------------------------------------ application
+
+def apply_extensions(cfg: dict[str, Any], snapshot: dict[str, Any]
+                     ) -> list[str]:
+    """Run every extension in snapshot["EnvoyExtensions"] over the
+    bootstrap cfg IN PLACE, in declaration order (proxy-defaults before
+    service-defaults — assemble_snapshot stores them merged that way).
+    Returns the list of per-extension errors; a failed non-Required
+    extension leaves cfg exactly as the previous step left it."""
+    import copy
+
+    errors: list[str] = []
+    for ext in snapshot.get("EnvoyExtensions") or []:
+        name = ext.get("Name", "")
+        try:
+            plugin = construct_extension(ext)
+            if not plugin.matches_kind(snapshot.get("Kind",
+                                                    "connect-proxy")):
+                continue
+            # apply against a scratch copy: a half-applied mutation
+            # from a mid-flight failure must not leak into the output
+            scratch = copy.deepcopy(cfg)
+            plugin.update(scratch, snapshot)
+            cfg.clear()
+            cfg.update(scratch)
+        except Exception as e:  # noqa: BLE001 — isolation is the point
+            errors.append(f"{name}: {e}")
+            if ext.get("Required"):
+                raise ExtensionError(
+                    f"required extension {name!r} failed: {e}") from e
+    return errors
+
+
+def _iter_hcms(cfg: dict[str, Any], which: str):
+    """Yield (listener_name, hcm_typed_config) for the mesh listeners an
+    extension targets. `which`: "inbound" (public_listener / gateway
+    listeners), "outbound" (upstream_*), or "" for both. Non-mesh
+    resources (local_app, admin, SDS secrets) are never touched."""
+    for lst in cfg.get("static_resources", {}).get("listeners") or []:
+        lname = lst.get("name", "")
+        inbound = not lname.startswith("upstream_")
+        if which == "inbound" and not inbound:
+            continue
+        if which == "outbound" and inbound:
+            continue
+        for chain in lst.get("filter_chains") or []:
+            for f in chain.get("filters") or []:
+                if f.get("name") == HCM:
+                    yield lname, f["typed_config"]
+
+
+def insert_http_filter(hcm: dict[str, Any], filt: dict[str, Any],
+                       before: Optional[str] = None) -> None:
+    """Insert an HTTP filter into an HCM ahead of `before` (a filter
+    name; default: the terminal router filter — Envoy requires router
+    last, xds listeners.go keeps the same invariant)."""
+    filters = hcm.setdefault("http_filters", [])
+    target = before or ROUTER
+    for i, f in enumerate(filters):
+        if f.get("name") == target:
+            filters.insert(i, filt)
+            return
+    filters.append(filt)
+
+
+class EnvoyExtension:
+    """Base: Arguments validation in __init__, resource mutation in
+    update() (extensioncommon.BasicExtension Validate/Extend)."""
+
+    name = ""
+
+    def __init__(self, ext: dict[str, Any]) -> None:
+        self.args: dict[str, Any] = ext.get("Arguments") or {}
+        self.required = bool(ext.get("Required"))
+        self.proxy_types = self.args.get("ProxyType") or "connect-proxy"
+        self.validate()
+
+    def matches_kind(self, kind: str) -> bool:
+        pt = self.proxy_types
+        return kind in (pt if isinstance(pt, (list, tuple)) else [pt])
+
+    def validate(self) -> None:  # pragma: no cover - abstract seam
+        raise NotImplementedError
+
+    def update(self, cfg: dict[str, Any],
+               snapshot: dict[str, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+@register("builtin/lua")
+class LuaExtension(EnvoyExtension):
+    """Inject an inline lua HTTP filter
+    (agent/envoyextensions/builtin/lua: Script + ProxyType + Listener).
+    The filter lands ahead of the router (and after RBAC — authz
+    decisions stay first) in every matching HTTP connection manager."""
+
+    def validate(self) -> None:
+        if not isinstance(self.args.get("Script"), str) \
+                or not self.args["Script"].strip():
+            raise ExtensionError("missing Script (inline lua source)")
+        lst = self.args.get("Listener", "")
+        if lst not in ("", "inbound", "outbound"):
+            raise ExtensionError(
+                f"Listener must be inbound/outbound, got {lst!r}")
+
+    def update(self, cfg: dict[str, Any],
+               snapshot: dict[str, Any]) -> None:
+        filt = {
+            "name": "envoy.filters.http.lua",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "filters.http.lua.v3.Lua",
+                "default_source_code": {
+                    "inline_string": self.args["Script"]},
+            }}
+        for _, hcm in _iter_hcms(cfg, self.args.get("Listener", "")):
+            insert_http_filter(hcm, dict(filt))
+
+
+@register("builtin/ext-authz")
+class ExtAuthzExtension(EnvoyExtension):
+    """External authorization (builtin/ext-authz): every request on the
+    matching listeners is checked against a gRPC or HTTP authorization
+    service before the router runs. Target is either an explicit URI
+    (host:port — materialized as a dedicated STATIC cluster) or the
+    name of an existing upstream service (reuses its mesh cluster)."""
+
+    def validate(self) -> None:
+        cfg = self.args.get("Config") or {}
+        grpc = (cfg.get("GrpcService") or {}).get("Target") or {}
+        http = (cfg.get("HttpService") or {}).get("Target") or {}
+        if not grpc and not http:
+            raise ExtensionError(
+                "Config.GrpcService.Target or Config.HttpService.Target "
+                "is required")
+        tgt = grpc or http
+        if not tgt.get("URI") and not (tgt.get("Service") or {}).get(
+                "Name"):
+            raise ExtensionError("Target needs URI or Service.Name")
+        self.grpc = bool(grpc)
+        self.target = tgt
+
+    def _cluster_name(self, cfg: dict[str, Any]) -> str:
+        svc = (self.target.get("Service") or {}).get("Name")
+        if svc:
+            # reuse the mesh cluster for that upstream. Cluster names
+            # are "upstream_<dest>_<target-service>" (envoy.py) — match
+            # on the upstream prefix, never a bare suffix (a suffix
+            # test would let service "b" capture "upstream_db_db")
+            for c in cfg["static_resources"]["clusters"]:
+                if c["name"].startswith(f"upstream_{svc}_"):
+                    return c["name"]
+            raise ExtensionError(
+                f"ext-authz target service {svc!r} is not an upstream "
+                "of this proxy")
+        uri = self.target["URI"]
+        host, _, port = uri.rpartition(":")
+        cname = "extauthz_" + uri.replace(":", "_").replace("/", "_")
+        if not any(c["name"] == cname
+                   for c in cfg["static_resources"]["clusters"]):
+            cluster = {
+                "name": cname, "type": "STATIC",
+                "connect_timeout": "5s",
+                "load_assignment": {
+                    "cluster_name": cname,
+                    "endpoints": [{"lb_endpoints": [{"endpoint": {
+                        "address": {"socket_address": {
+                            "address": host or "127.0.0.1",
+                            "port_value": int(port or 0)}}}}]}]},
+            }
+            if self.grpc:
+                # gRPC authz requires an HTTP/2 cluster
+                cluster["http2_protocol_options"] = {}
+            cfg["static_resources"]["clusters"].append(cluster)
+        return cname
+
+    def update(self, cfg: dict[str, Any],
+               snapshot: dict[str, Any]) -> None:
+        cname = self._cluster_name(cfg)
+        svc_cfg: dict[str, Any]
+        if self.grpc:
+            svc_cfg = {"grpc_service": {
+                "envoy_grpc": {"cluster_name": cname},
+                "timeout": (self.args.get("Config") or {}).get(
+                    "Timeout", "1s")}}
+        else:
+            svc_cfg = {"http_service": {"server_uri": {
+                "uri": self.target.get("URI", cname),
+                "cluster": cname, "timeout": "1s"}}}
+        filt = {
+            "name": "envoy.filters.http.ext_authz",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.filters."
+                         "http.ext_authz.v3.ExtAuthz",
+                "stat_prefix": (self.args.get("Config") or {}).get(
+                    "StatPrefix", "ext_authz"),
+                "transport_api_version": "V3",
+                **svc_cfg,
+            }}
+        for _, hcm in _iter_hcms(cfg,
+                                 self.args.get("Listener", "inbound")):
+            insert_http_filter(hcm, dict(filt))
+
+
+# ------------------------------------------------------------- JWT authn
+
+def collect_jwt_provider_names(intentions: list[dict[str, Any]]
+                               ) -> list[str]:
+    """Provider names referenced by an intention set — top-level JWT
+    plus per-permission JWT (jwt_authn.go collectJWTProviders); order
+    preserved, deduped."""
+    seen: list[str] = []
+
+    def take(jwt: Optional[dict[str, Any]]) -> None:
+        for p in (jwt or {}).get("Providers") or []:
+            n = p.get("Name", "")
+            if n and n not in seen:
+                seen.append(n)
+
+    for ixn in intentions or []:
+        take(ixn.get("JWT"))
+        for perm in ixn.get("Permissions") or []:
+            take(perm.get("JWT"))
+    return seen
+
+
+def jwt_authn_filter(intentions: list[dict[str, Any]],
+                     providers: dict[str, dict[str, Any]]
+                     ) -> Optional[dict[str, Any]]:
+    """envoy.filters.http.jwt_authn limited to the providers the
+    intentions actually reference (jwt_authn.go makeJWTAuthFilter:
+    'If you have three providers and only okta is referenced ... this
+    will create a jwt-auth filter containing just okta'). None when no
+    intention carries a JWT requirement."""
+    names = [n for n in collect_jwt_provider_names(intentions)
+             if n in providers]
+    if not names:
+        return None
+    provs: dict[str, Any] = {}
+    reqs: list[dict[str, Any]] = []
+    for n in names:
+        ce = providers[n]
+        p: dict[str, Any] = {
+            # per-provider metadata key: claims land in dynamic
+            # metadata for the RBAC filter to evaluate per intention
+            # (jwt_authn.go buildPayloadInMetadataKey)
+            "payload_in_metadata": f"jwt_payload_{n}",
+        }
+        if ce.get("Issuer"):
+            p["issuer"] = ce["Issuer"]
+        if ce.get("Audiences"):
+            p["audiences"] = list(ce["Audiences"])
+        jwks = ce.get("JSONWebKeySet") or {}
+        local = jwks.get("Local") or {}
+        if local.get("JWKS"):
+            p["local_jwks"] = {"inline_string": local["JWKS"]}
+        elif local.get("Filename"):
+            p["local_jwks"] = {"filename": local["Filename"]}
+        elif (jwks.get("Remote") or {}).get("URI"):
+            p["remote_jwks"] = {
+                "http_uri": {
+                    "uri": jwks["Remote"]["URI"],
+                    "cluster": f"jwks_cluster_{n}",
+                    "timeout": "5s"},
+                "cache_duration": jwks["Remote"].get(
+                    "CacheDuration", "300s")}
+        for loc in ce.get("Locations") or []:
+            if loc.get("Header"):
+                if loc["Header"].get("Forward"):
+                    p["forward"] = True
+                p.setdefault("from_headers", []).append({
+                    "name": loc["Header"].get("Name", "Authorization"),
+                    "value_prefix": loc["Header"].get(
+                        "ValuePrefix", "")})
+            elif loc.get("QueryParam"):
+                p.setdefault("from_params", []).append(
+                    loc["QueryParam"].get("Name", ""))
+            elif loc.get("Cookie"):
+                p.setdefault("from_cookies", []).append(
+                    loc["Cookie"].get("Name", ""))
+        provs[n] = p
+        # requires_any(provider, allow_missing_or_failed): the filter
+        # VALIDATES and stamps metadata but never rejects on its own —
+        # the RBAC filter owns allow/deny per intention, so sources
+        # with no JWT requirement keep flowing (jwt_authn.go
+        # providerToJWTRequirement: "since the rbac filter is in
+        # charge ... this requirement uses allow_missing_or_failed to
+        # ensure it is always satisfied")
+        reqs.append({"requires_any": {"requirements": [
+            {"provider_name": n}, {"allow_missing_or_failed": {}}]}})
+    requires = reqs[0] if len(reqs) == 1 else {
+        "requires_all": {"requirements": reqs}}
+    return {
+        "name": "envoy.filters.http.jwt_authn",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters."
+                     "http.jwt_authn.v3.JwtAuthentication",
+            "providers": provs,
+            "rules": [{"match": {"prefix": "/"},
+                       "requires": requires}],
+        }}
+
+
+def jwks_clusters(providers: dict[str, dict[str, Any]],
+                  used: list[str]) -> list[dict[str, Any]]:
+    """One cluster per remote-JWKS provider the filter references
+    (clusters.go makeJWKSClusters: jwks_cluster_<name>): Envoy fetches
+    the key set itself, so the URI's host needs a real cluster. DNS
+    type because JWKS endpoints are normally named hosts; https URIs
+    get an upstream TLS socket."""
+    out = []
+    for n in used:
+        remote = ((providers.get(n) or {}).get("JSONWebKeySet")
+                  or {}).get("Remote") or {}
+        uri = remote.get("URI", "")
+        if not uri:
+            continue
+        scheme, _, rest = uri.partition("://")
+        hostport = rest.split("/", 1)[0]
+        host, _, port = hostport.partition(":")
+        port = int(port) if port else (443 if scheme == "https" else 80)
+        cluster: dict[str, Any] = {
+            "name": f"jwks_cluster_{n}",
+            "type": "LOGICAL_DNS",
+            "connect_timeout": "5s",
+            "load_assignment": {
+                "cluster_name": f"jwks_cluster_{n}",
+                "endpoints": [{"lb_endpoints": [{"endpoint": {
+                    "address": {"socket_address": {
+                        "address": host,
+                        "port_value": port}}}}]}]},
+        }
+        if scheme == "https":
+            cluster["transport_socket"] = {
+                "name": "tls",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "transport_sockets.tls.v3."
+                             "UpstreamTlsContext",
+                    "sni": host,
+                    "common_tls_context": {}}}
+        out.append(cluster)
+    return out

@@ -131,11 +131,24 @@ def assemble_snapshot(agent, proxy_id: str,
     # the LOCAL service's protocol decides the inbound listener shape
     # (http → HCM with L7 RBAC): service-defaults, then proxy-defaults
     sd = get_entry("service-defaults", dest_name) or {}
-    protocol = sd.get("Protocol")
-    if not protocol:  # proxy-defaults only consulted when needed
-        pd = get_entry("proxy-defaults", "global") or {}
-        protocol = pd.get("Protocol")
-    protocol = (protocol or "tcp").lower()
+    pd = get_entry("proxy-defaults", "global") or {}
+    protocol = (sd.get("Protocol") or pd.get("Protocol")
+                or "tcp").lower()
+    # Envoy extension runtime config (extensionruntime/runtime_config.go
+    # GetRuntimeConfigurations): global proxy-defaults extensions apply
+    # first, then the service's own — both ride the snapshot so every
+    # bootstrap/xDS consumer gets the same post-processed resources
+    extensions = list(pd.get("EnvoyExtensions") or []) \
+        + list(sd.get("EnvoyExtensions") or [])
+    # jwt-provider entries referenced by the matched intentions
+    # (jwt_authn.go makeJWTAuthFilter fetches only referenced providers)
+    from consul_tpu.connect.extensions import collect_jwt_provider_names
+
+    jwt_providers = {}
+    for pname in collect_jwt_provider_names(matches.get("Matches", [])):
+        e = get_entry("jwt-provider", pname)
+        if e:
+            jwt_providers[pname] = e
     return {
         "ProxyID": proxy_id,
         "Intentions": matches.get("Matches", []),
@@ -155,6 +168,8 @@ def assemble_snapshot(agent, proxy_id: str,
         "TrustDomain": roots.get("TrustDomain", ""),
         "Leaf": leaf,
         "Upstreams": upstreams,
+        "EnvoyExtensions": extensions,
+        "JWTProviders": jwt_providers,
     }
 
 
@@ -177,7 +192,11 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
     gw_name = proxy.service
     leaf = agent.leaf_cert(gw_name, rpc)
     roots = rpc("ConnectCA.Roots", {})
+    pd = get_entry("proxy-defaults", "global") or {}
+    sd = get_entry("service-defaults", gw_name) or {}
     snap: dict[str, Any] = {
+        "EnvoyExtensions": list(pd.get("EnvoyExtensions") or [])
+        + list(sd.get("EnvoyExtensions") or []),
         "ProxyID": proxy.id,
         "Kind": proxy.kind,
         "Service": gw_name,

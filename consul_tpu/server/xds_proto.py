@@ -110,6 +110,10 @@ _CLUSTER = {
     "type": Field(2, "enum"),  # STATIC=0, EDS=3 (cluster.proto)
     "eds_cluster_config": Field(3, "message", _EDS_CLUSTER_CONFIG),
     "connect_timeout": Field(4, "message", _DURATION),
+    #: Http2ProtocolOptions (deprecated in favor of
+    #: typed_extension_protocol_options but still honored): empty
+    #: message presence marks a gRPC-capable upstream
+    "http2_protocol_options": Field(14, "message", {}, presence=True),
     "transport_socket": Field(24, "message", _TRANSPORT_SOCKET),
     "load_assignment": Field(33, "message", CLA),
 }
@@ -170,6 +174,85 @@ _HTTP_RBAC = {"rules": Field(1, "message", _RBAC_RULES)}
 HTTP_RBAC_TYPE = ("type.googleapis.com/envoy.extensions.filters."
                   "http.rbac.v3.RBAC")
 
+# ------------------------------------------- extension-runtime filters
+# The filters the Envoy extension runtime (connect/extensions.py) and
+# the JWT authn pass inject. Field numbers cited per the public protos.
+
+#: extensions.filters.http.lua.v3.Lua (lua.proto): inline_code=1
+#: (deprecated), default_source_code=3 (DataSource)
+_LUA = {"inline_code": Field(1, "string"),
+        "default_source_code": Field(3, "message", _DATA_SOURCE)}
+LUA_TYPE = ("type.googleapis.com/envoy.extensions.filters.http."
+            "lua.v3.Lua")
+
+#: config.core.v3.HttpUri (http_uri.proto): uri=1, cluster=2, timeout=3
+_HTTP_URI = {"uri": Field(1, "string"), "cluster": Field(2, "string"),
+             "timeout": Field(3, "message", _DURATION)}
+#: config.core.v3.GrpcService (grpc_service.proto): envoy_grpc=1
+#: (EnvoyGrpc: cluster_name=1), timeout=3
+_ENVOY_GRPC = {"cluster_name": Field(1, "string")}
+_GRPC_SERVICE = {"envoy_grpc": Field(1, "message", _ENVOY_GRPC),
+                 "timeout": Field(3, "message", _DURATION)}
+#: extensions.filters.http.ext_authz.v3 HttpService: server_uri=1,
+#: path_prefix=2
+_AUTHZ_HTTP_SERVICE = {"server_uri": Field(1, "message", _HTTP_URI),
+                       "path_prefix": Field(2, "string")}
+#: ExtAuthz (ext_authz.proto): grpc_service=1, failure_mode_allow=2,
+#: http_service=3, transport_api_version=12 (V3=2), stat_prefix=13
+_EXT_AUTHZ = {
+    "grpc_service": Field(1, "message", _GRPC_SERVICE),
+    "failure_mode_allow": Field(2, "bool"),
+    "http_service": Field(3, "message", _AUTHZ_HTTP_SERVICE),
+    "transport_api_version": Field(12, "enum"),
+    "stat_prefix": Field(13, "string"),
+}
+EXT_AUTHZ_TYPE = ("type.googleapis.com/envoy.extensions.filters.http."
+                  "ext_authz.v3.ExtAuthz")
+
+#: extensions.filters.http.jwt_authn.v3 (config.proto)
+_JWT_HEADER = {"name": Field(1, "string"),
+               "value_prefix": Field(2, "string")}
+_REMOTE_JWKS = {"http_uri": Field(1, "message", _HTTP_URI),
+                "cache_duration": Field(2, "message", _DURATION)}
+#: JwtProvider: issuer=1, audiences=2, remote_jwks=3, local_jwks=4,
+#: forward=5, from_headers=6, from_params=7, forward_payload_header=8,
+#: payload_in_metadata=9, from_cookies=13
+_JWT_PROVIDER = {
+    "issuer": Field(1, "string"),
+    "audiences": Field(2, "string", repeated=True),
+    "remote_jwks": Field(3, "message", _REMOTE_JWKS),
+    "local_jwks": Field(4, "message", _DATA_SOURCE),
+    "forward": Field(5, "bool"),
+    "from_headers": Field(6, "message", _JWT_HEADER, repeated=True),
+    "from_params": Field(7, "string", repeated=True),
+    "forward_payload_header": Field(8, "string"),
+    "payload_in_metadata": Field(9, "string"),
+    "from_cookies": Field(13, "string", repeated=True),
+}
+#: JwtRequirement: provider_name=1, requires_any=3, requires_all=4,
+#: allow_missing_or_failed=5, allow_missing=6 (Empty presence arms)
+_JWT_REQUIREMENT: dict = {
+    "provider_name": Field(1, "string"),
+    "allow_missing_or_failed": Field(5, "message", {}, presence=True),
+    "allow_missing": Field(6, "message", {}, presence=True),
+}
+_JWT_REQ_LIST = {"requirements": Field(1, "message", _JWT_REQUIREMENT,
+                                       repeated=True)}
+_JWT_REQUIREMENT["requires_any"] = Field(3, "message", _JWT_REQ_LIST)
+_JWT_REQUIREMENT["requires_all"] = Field(4, "message", _JWT_REQ_LIST)
+#: providers map entry; RequirementRule: match=1, requires=2 —
+#: _ROUTE_MATCH is defined in the HTTP section below, patched there
+_JWT_PROVIDER_ENTRY = {"key": Field(1, "string"),
+                       "value": Field(2, "message", _JWT_PROVIDER)}
+_JWT_RULE: dict = {"requires": Field(2, "message", _JWT_REQUIREMENT)}
+_JWT_AUTHN = {
+    "providers": Field(1, "message", _JWT_PROVIDER_ENTRY,
+                       repeated=True),
+    "rules": Field(2, "message", _JWT_RULE, repeated=True),
+}
+JWT_AUTHN_TYPE = ("type.googleapis.com/envoy.extensions.filters.http."
+                  "jwt_authn.v3.JwtAuthentication")
+
 # ------------------------------------------------- HTTP / route configs
 # config.route.v3 (route.proto, route_components.proto) + the HTTP
 # connection manager — what the L7 discovery chain (service-router /
@@ -216,6 +299,9 @@ _ROUTE_MATCH = {
     "query_parameters": Field(7, "message", _QUERY_MATCHER,
                               repeated=True),
 }
+#: jwt_authn RequirementRule.match is a RouteMatch (forward ref from
+#: the extension-filter section above)
+_JWT_RULE["match"] = Field(1, "message", _ROUTE_MATCH)
 #: WeightedCluster.ClusterWeight: name=1, weight=2
 _CLUSTER_WEIGHT = {"name": Field(1, "string"),
                    "weight": Field(2, "message", _UINT32)}
@@ -388,6 +474,14 @@ def _lower_hcm(tc: dict[str, Any]) -> bytes:
             # makeRBACHTTPFilter → _rbac_http_filters in envoy.py)
             blob = encode(_HTTP_RBAC, {
                 "rules": _lower_rbac_rules(ftc.get("rules") or {})})
+        elif at == LUA_TYPE:
+            blob = encode(_LUA, {"default_source_code": {
+                "inline_string": (ftc.get("default_source_code")
+                                  or {}).get("inline_string", "")}})
+        elif at == EXT_AUTHZ_TYPE:
+            blob = _lower_ext_authz(ftc)
+        elif at == JWT_AUTHN_TYPE:
+            blob = _lower_jwt_authn(ftc)
         else:
             raise UnloweredShape(f"http filter {at!r}")
         filters.append({"name": f.get("name", ""),
@@ -397,6 +491,85 @@ def _lower_hcm(tc: dict[str, Any]) -> bytes:
         "route_config": {"name": rc.get("name", ""),
                          "virtual_hosts": vhosts},
         "http_filters": filters})
+
+def _lower_ext_authz(ftc: dict[str, Any]) -> bytes:
+    """ExtAuthz HTTP filter (ext-authz extension output)."""
+    msg: dict[str, Any] = {
+        "stat_prefix": ftc.get("stat_prefix", "ext_authz"),
+        "transport_api_version": 2,  # ApiVersion.V3
+    }
+    if ftc.get("grpc_service"):
+        gs = ftc["grpc_service"]
+        msg["grpc_service"] = {
+            "envoy_grpc": {"cluster_name": (gs.get("envoy_grpc")
+                                            or {}).get("cluster_name",
+                                                       "")},
+            **({"timeout": _duration(gs["timeout"])}
+               if gs.get("timeout") else {})}
+    elif ftc.get("http_service"):
+        su = ftc["http_service"].get("server_uri") or {}
+        msg["http_service"] = {"server_uri": {
+            "uri": su.get("uri", ""), "cluster": su.get("cluster", ""),
+            **({"timeout": _duration(su["timeout"])}
+               if su.get("timeout") else {})}}
+    else:
+        raise UnloweredShape("ext_authz without a service target")
+    return encode(_EXT_AUTHZ, msg)
+
+
+def _lower_jwt_authn(ftc: dict[str, Any]) -> bytes:
+    """JwtAuthentication (jwt_authn.go makeJWTAuthFilter output)."""
+    providers = []
+    for name, p in sorted((ftc.get("providers") or {}).items()):
+        msg: dict[str, Any] = {}
+        for k in ("issuer", "forward", "payload_in_metadata",
+                  "forward_payload_header"):
+            if p.get(k):
+                msg[k] = p[k]
+        if p.get("audiences"):
+            msg["audiences"] = list(p["audiences"])
+        if p.get("from_cookies"):
+            msg["from_cookies"] = list(p["from_cookies"])
+        if p.get("local_jwks"):
+            msg["local_jwks"] = _data_source(p["local_jwks"])
+        elif p.get("remote_jwks"):
+            rj = p["remote_jwks"]
+            hu = rj.get("http_uri") or {}
+            msg["remote_jwks"] = {
+                "http_uri": {"uri": hu.get("uri", ""),
+                             "cluster": hu.get("cluster", ""),
+                             **({"timeout": _duration(hu["timeout"])}
+                                if hu.get("timeout") else {})},
+                **({"cache_duration": _duration(rj["cache_duration"])}
+                   if rj.get("cache_duration") else {})}
+        if p.get("from_headers"):
+            msg["from_headers"] = [
+                {"name": h.get("name", ""),
+                 "value_prefix": h.get("value_prefix", "")}
+                for h in p["from_headers"]]
+        if p.get("from_params"):
+            msg["from_params"] = list(p["from_params"])
+        providers.append({"key": name, "value": msg})
+
+    def req(r: dict[str, Any]) -> dict[str, Any]:
+        if r.get("provider_name"):
+            return {"provider_name": r["provider_name"]}
+        for kind in ("allow_missing_or_failed", "allow_missing"):
+            if r.get(kind) is not None:
+                return {kind: {}}
+        for kind in ("requires_any", "requires_all"):
+            if r.get(kind):
+                return {kind: {"requirements": [
+                    req(x) for x in r[kind].get("requirements") or []]}}
+        raise UnloweredShape(f"jwt requirement {r!r}")
+
+    rules = []
+    for rule in ftc.get("rules") or []:
+        rules.append({
+            "match": _lower_route_match(rule.get("match") or {}),
+            "requires": req(rule.get("requires") or {})})
+    return encode(_JWT_AUTHN, {"providers": providers, "rules": rules})
+
 
 _FILTER = {"name": Field(1, "string"),
            "typed_config": Field(4, "message", _ANY)}
@@ -538,6 +711,9 @@ def lower_cluster(c: dict[str, Any]) -> bytes:
     if c.get("transport_socket"):
         msg["transport_socket"] = _transport_socket(
             c["transport_socket"])
+    if c.get("http2_protocol_options") is not None:
+        # gRPC upstreams (ext-authz extension): empty message presence
+        msg["http2_protocol_options"] = {}
     return encode(_CLUSTER, msg)
 
 

@@ -1,0 +1,394 @@
+"""Envoy extension runtime + JWT authn.
+
+Reference behavior:
+  agent/envoyextensions/registered_extensions.go — registry + write-time
+    validation of EnvoyExtensions on config entries;
+  agent/xds/extensionruntime/runtime_config.go — extensions flow from
+    proxy-defaults/service-defaults into the proxy snapshot and are
+    applied to the GENERATED resources;
+  agent/xds/jwt_authn.go:30 — jwt_authn filter built from jwt-provider
+    config entries referenced by intentions, inserted before RBAC.
+
+These tests pin: filter placement (lua/ext-authz/jwt vs RBAC vs
+router), non-mesh resources untouched, failure isolation, config-entry
+validation, and true-proto lowering of all three filters.
+"""
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api import ConsulClient
+from consul_tpu.config import load
+from consul_tpu.connect.extensions import (ExtensionError,
+                                           apply_extensions,
+                                           validate_extensions)
+
+from helpers import wait_for  # noqa: E402
+
+PROXY_ID = "web1-sidecar-proxy"
+HCM = "envoy.filters.network.http_connection_manager"
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(load(dev=True, overrides={"node_name": "ext-agent"}))
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="self-elect")
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client(agent):
+    c = ConsulClient(agent.http.addr)
+    c.service_register({
+        "Name": "db", "ID": "db1", "Port": 5432,
+        "Check": {"TTL": "600s", "Status": "passing"},
+        "Connect": {"SidecarService": {}}})
+    c.service_register({
+        "Name": "web", "ID": "web1", "Port": 8080,
+        "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+            {"DestinationName": "db", "LocalBindPort": 9191}]}}}})
+    c.put("/v1/connect/intentions", body={
+        "SourceName": "web", "DestinationName": "db",
+        "Action": "allow"})
+    # web terminates HTTP so the public listener is an HCM
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-defaults", "Name": "web",
+            "Protocol": "http"}}, "t")
+    wait_for(lambda: c.health_service("db"), what="db in catalog")
+    return c
+
+
+def _set_extensions(agent, exts):
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-defaults", "Name": "web",
+            "Protocol": "http", "EnvoyExtensions": exts}}, "t")
+
+
+def _public_http_filters(cfg):
+    for lst in cfg["static_resources"]["listeners"]:
+        if lst["name"] != "public_listener":
+            continue
+        for f in lst["filter_chains"][0]["filters"]:
+            if f["name"] == HCM:
+                return [x["name"] for x in
+                        f["typed_config"]["http_filters"]]
+    raise AssertionError("no public HCM")
+
+
+# ------------------------------------------------------------ validation
+
+def test_validate_extensions_errors():
+    assert validate_extensions([]) == []
+    errs = validate_extensions([{"Name": "builtin/nope"}])
+    assert errs and "not a built-in extension" in errs[0]
+    errs = validate_extensions([{"Name": "builtin/lua",
+                                 "Arguments": {}}])
+    assert errs and "Script" in errs[0]
+    errs = validate_extensions([{"Name": "builtin/ext-authz",
+                                 "Arguments": {"Config": {}}}])
+    assert errs and "Target" in errs[0]
+    assert validate_extensions([{
+        "Name": "builtin/lua",
+        "Arguments": {"Script": "function envoy_on_request(h) end"},
+    }]) == []
+
+
+def test_config_entry_write_rejects_bad_extension(agent, client):
+    """ValidateExtensions runs at ConfigEntry.Apply time — a typo'd
+    extension never reaches the store (registered_extensions.go)."""
+    from consul_tpu.server.rpc import RPCError
+
+    with pytest.raises(RPCError, match="not a built-in"):
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "service-defaults", "Name": "web",
+                "EnvoyExtensions": [{"Name": "builtin/typo"}]}}, "t")
+    with pytest.raises(RPCError, match="Script"):
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "proxy-defaults", "Name": "global",
+                "EnvoyExtensions": [{"Name": "builtin/lua"}]}}, "t")
+
+
+def test_jwt_provider_entry_validation(agent):
+    from consul_tpu.server.rpc import RPCError
+
+    with pytest.raises(RPCError, match="JSONWebKeySet"):
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "jwt-provider", "Name": "okta"}}, "t")
+
+
+# ------------------------------------------------------------------- lua
+
+def test_lua_filter_placement_inbound_only(agent, client):
+    """Lua lands in the public HCM ahead of the router and after RBAC
+    (authz first); outbound upstream listeners and non-mesh resources
+    stay untouched when Listener=inbound."""
+    from consul_tpu.server.grpc_external import build_config
+
+    _set_extensions(agent, [{
+        "Name": "builtin/lua",
+        "Arguments": {"Script": "function envoy_on_request(h) end",
+                      "Listener": "inbound"}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        names = _public_http_filters(cfg)
+        assert "envoy.filters.http.lua" in names
+        assert names.index("envoy.filters.http.lua") \
+            < names.index("envoy.filters.http.router")
+        # outbound untouched
+        for lst in cfg["static_resources"]["listeners"]:
+            if lst["name"].startswith("upstream_"):
+                for f in lst["filter_chains"][0]["filters"]:
+                    if f["name"] == HCM:
+                        assert not any(
+                            x["name"] == "envoy.filters.http.lua"
+                            for x in
+                            f["typed_config"]["http_filters"])
+        # non-mesh resources untouched
+        assert any(c["name"] == "local_app"
+                   for c in cfg["static_resources"]["clusters"])
+        baseline = build_config(agent, PROXY_ID)
+        _set_extensions(agent, [])
+        plain = build_config(agent, PROXY_ID)
+        assert "envoy.filters.http.lua" not in _public_http_filters(
+            plain)
+        assert baseline["static_resources"]["clusters"] \
+            == plain["static_resources"]["clusters"]
+    finally:
+        _set_extensions(agent, [])
+
+
+def test_lua_lowers_to_proto(agent, client):
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    _set_extensions(agent, [{
+        "Name": "builtin/lua",
+        "Arguments": {"Script": "function envoy_on_request(h) end"}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        pub = decode(xp._LISTENER, lds["public_listener"][1])
+        hcms = [f for f in pub["filter_chains"][0]["filters"]
+                if f["typed_config"]["type_url"] == xp.HCM_TYPE]
+        hcm = decode(xp._HCM, hcms[0]["typed_config"]["value"])
+        lua = [f for f in hcm["http_filters"]
+               if f["typed_config"]["type_url"] == xp.LUA_TYPE]
+        assert lua, "lua filter must survive proto lowering"
+        body = decode(xp._LUA, lua[0]["typed_config"]["value"])
+        assert "envoy_on_request" in \
+            body["default_source_code"]["inline_string"]
+    finally:
+        _set_extensions(agent, [])
+
+
+# ------------------------------------------------------------- ext-authz
+
+def test_ext_authz_uri_target_adds_cluster_and_filter(agent, client):
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE, CDS_TYPE,
+                                                 build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    _set_extensions(agent, [{
+        "Name": "builtin/ext-authz",
+        "Arguments": {"Config": {"GrpcService": {
+            "Target": {"URI": "127.0.0.1:9191"}}}}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        names = _public_http_filters(cfg)
+        assert "envoy.filters.http.ext_authz" in names
+        authz_clusters = [c for c in
+                          cfg["static_resources"]["clusters"]
+                          if c["name"].startswith("extauthz_")]
+        assert len(authz_clusters) == 1
+        # true-proto: filter body and the http2-enabled cluster
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        pub = decode(xp._LISTENER, lds["public_listener"][1])
+        hcms = [f for f in pub["filter_chains"][0]["filters"]
+                if f["typed_config"]["type_url"] == xp.HCM_TYPE]
+        hcm = decode(xp._HCM, hcms[0]["typed_config"]["value"])
+        ea = [f for f in hcm["http_filters"]
+              if f["typed_config"]["type_url"] == xp.EXT_AUTHZ_TYPE]
+        assert ea
+        body = decode(xp._EXT_AUTHZ, ea[0]["typed_config"]["value"])
+        assert body["grpc_service"]["envoy_grpc"]["cluster_name"] \
+            == authz_clusters[0]["name"]
+        cds = resources_from_cfg(cfg, CDS_TYPE)
+        assert authz_clusters[0]["name"] in cds
+    finally:
+        _set_extensions(agent, [])
+
+
+def test_ext_authz_upstream_service_target(agent, client):
+    """Target.Service.Name reuses the existing mesh cluster for that
+    upstream instead of minting a new one."""
+    from consul_tpu.server.grpc_external import build_config
+
+    _set_extensions(agent, [{
+        "Name": "builtin/ext-authz",
+        "Arguments": {"Config": {"GrpcService": {
+            "Target": {"Service": {"Name": "db"}}}}}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        assert "envoy.filters.http.ext_authz" in \
+            _public_http_filters(cfg)
+        assert not any(c["name"].startswith("extauthz_")
+                       for c in cfg["static_resources"]["clusters"])
+    finally:
+        _set_extensions(agent, [])
+
+
+def test_failing_extension_is_isolated(agent, client):
+    """A non-Required extension that fails mid-apply (target service
+    is not an upstream) leaves the resources exactly as generated —
+    isolation semantics of xds resources.go applyEnvoyExtensions."""
+    from consul_tpu.server.grpc_external import build_config
+
+    _set_extensions(agent, [{
+        "Name": "builtin/ext-authz",
+        "Arguments": {"Config": {"GrpcService": {
+            "Target": {"Service": {"Name": "not-an-upstream"}}}}}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        assert "envoy.filters.http.ext_authz" not in \
+            _public_http_filters(cfg)
+        assert not any(c["name"].startswith("extauthz_")
+                       for c in cfg["static_resources"]["clusters"])
+    finally:
+        _set_extensions(agent, [])
+
+
+def test_required_extension_failure_raises():
+    cfg = {"static_resources": {"listeners": [], "clusters": []}}
+    snap = {"Kind": "connect-proxy", "EnvoyExtensions": [{
+        "Name": "builtin/ext-authz", "Required": True,
+        "Arguments": {"Config": {"GrpcService": {
+            "Target": {"Service": {"Name": "ghost"}}}}}}]}
+    with pytest.raises(ExtensionError, match="required"):
+        apply_extensions(cfg, snap)
+
+
+# ------------------------------------------------------------- jwt-authn
+
+JWKS = '{"keys": [{"kty": "oct", "kid": "k1", "k": "c2VjcmV0"}]}'
+
+
+def test_jwt_authn_filter_from_provider_and_intention(agent, client):
+    """A jwt-provider entry + an intention referencing it produce the
+    jwt_authn filter ahead of RBAC in the public HCM; removing the
+    reference removes the filter (jwt_authn.go: only referenced
+    providers appear)."""
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "jwt-provider", "Name": "okta",
+            "Issuer": "https://okta.example",
+            "Audiences": ["web"],
+            "JSONWebKeySet": {"Local": {"JWKS": JWKS}},
+            "Locations": [{"Header": {
+                "Name": "Authorization",
+                "ValuePrefix": "Bearer "}}]}}, "t")
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "api", "DestinationName": "web",
+            "Action": "allow",
+            "JWT": {"Providers": [{"Name": "okta"}]}}}, "t")
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        names = _public_http_filters(cfg)
+        assert "envoy.filters.http.jwt_authn" in names
+        # claims validate BEFORE authorization consumes them (when a
+        # default-allow catalog emits no RBAC filter, the router is
+        # still behind the jwt filter)
+        authz_after = [n for n in ("envoy.filters.http.rbac",
+                                   "envoy.filters.http.router")
+                       if n in names]
+        assert all(names.index("envoy.filters.http.jwt_authn")
+                   < names.index(n) for n in authz_after)
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        pub = decode(xp._LISTENER, lds["public_listener"][1])
+        hcms = [f for f in pub["filter_chains"][0]["filters"]
+                if f["typed_config"]["type_url"] == xp.HCM_TYPE]
+        hcm = decode(xp._HCM, hcms[0]["typed_config"]["value"])
+        jf = [f for f in hcm["http_filters"]
+              if f["typed_config"]["type_url"] == xp.JWT_AUTHN_TYPE]
+        assert jf
+        body = decode(xp._JWT_AUTHN, jf[0]["typed_config"]["value"])
+        provs = {e["key"]: e["value"] for e in body["providers"]}
+        assert "okta" in provs
+        assert provs["okta"]["issuer"] == "https://okta.example"
+        assert provs["okta"]["local_jwks"]["inline_string"] == JWKS
+        assert provs["okta"]["from_headers"][0]["value_prefix"] \
+            == "Bearer "
+        # claims land in per-provider dynamic metadata for RBAC
+        assert provs["okta"]["payload_in_metadata"] \
+            == "jwt_payload_okta"
+        # requires_any(provider, allow_missing_or_failed): jwt_authn
+        # validates but never rejects on its own — RBAC owns the
+        # decision, so non-JWT intentions keep flowing
+        # (jwt_authn.go providerToJWTRequirement)
+        any_reqs = body["rules"][0]["requires"]["requires_any"][
+            "requirements"]
+        assert any_reqs[0]["provider_name"] == "okta"
+        assert "allow_missing_or_failed" in any_reqs[1]
+    finally:
+        agent.server.handle_rpc("Intention.Apply", {
+            "Op": "delete", "Intention": {
+                "SourceName": "api", "DestinationName": "web"}}, "t")
+    # reference gone -> filter gone
+    cfg = build_config(agent, PROXY_ID)
+    assert "envoy.filters.http.jwt_authn" not in \
+        _public_http_filters(cfg)
+
+
+def test_remote_jwks_provider_gets_fetch_cluster(agent, client):
+    """A Remote.URI provider must come with a jwks_cluster_<name>
+    cluster or Envoy can never fetch the key set (clusters.go
+    makeJWKSClusters)."""
+    from consul_tpu.server.grpc_external import (CDS_TYPE, build_config,
+                                                 resources_from_cfg)
+
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "jwt-provider", "Name": "auth0",
+            "Issuer": "https://auth0.example",
+            "JSONWebKeySet": {"Remote": {
+                "URI": "https://auth0.example/.well-known/jwks.json"}},
+        }}, "t")
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "mobile", "DestinationName": "web",
+            "Action": "allow",
+            "JWT": {"Providers": [{"Name": "auth0"}]}}}, "t")
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        clusters = {c["name"]: c
+                    for c in cfg["static_resources"]["clusters"]}
+        assert "jwks_cluster_auth0" in clusters
+        jc = clusters["jwks_cluster_auth0"]
+        sa = jc["load_assignment"]["endpoints"][0]["lb_endpoints"][0][
+            "endpoint"]["address"]["socket_address"]
+        assert sa == {"address": "auth0.example", "port_value": 443}
+        assert jc["transport_socket"]["typed_config"]["sni"] \
+            == "auth0.example"
+        # and it lowers through CDS
+        cds = resources_from_cfg(cfg, CDS_TYPE)
+        assert "jwks_cluster_auth0" in cds
+    finally:
+        agent.server.handle_rpc("Intention.Apply", {
+            "Op": "delete", "Intention": {
+                "SourceName": "mobile", "DestinationName": "web"}}, "t")
