@@ -1192,6 +1192,15 @@ def register_endpoints(srv) -> None:
                 return
         if not push({"Type": "end_of_snapshot"}):
             return
+        # outgoing heartbeats (peerstream server.go:26
+        # defaultOutgoingHeartbeatInterval = 15s): a quiet catalog
+        # must still prove the path alive, or the dialer's incoming
+        # timeout would tear down every idle-but-healthy stream.
+        # last_sent advances ONLY when a frame actually goes out —
+        # unrelated catalog churn that diffs to nothing for this peer
+        # must not starve the heartbeat.
+        hb_interval = getattr(srv, "peer_heartbeat_interval", 15.0)
+        last_sent = time.monotonic()
         while not cancel.is_set():
             state.block_until(tables, idx, 1.0)
             if cancel.is_set():
@@ -1200,19 +1209,28 @@ def register_endpoints(srv) -> None:
                 # peering deleted mid-stream: access is revoked NOW,
                 # not when the TCP session happens to die
                 return
+            if time.monotonic() - last_sent >= hb_interval:
+                if not push({"Type": "heartbeat"}):
+                    return
+                last_sent = time.monotonic()
             nidx = state.table_index(*tables)
             if nidx == idx:
                 continue  # timeout wake: nothing moved, skip the join
             idx = nidx
             cur = frame_all()
+            pushed = False
             for svc in sorted(set(last) - set(cur)):
                 if not push({"Type": "delete", "Service": svc}):
                     return
+                pushed = True
             for svc in sorted(cur):
                 if last.get(svc) != cur[svc]:
                     if not push({"Type": "upsert", "Service": svc,
                                  "Nodes": cur[svc]}):
                         return
+                    pushed = True
+            if pushed:
+                last_sent = time.monotonic()  # data frames count too
             last = cur
 
     srv.rpc.stream_handlers["PeerStream.StreamExported"] = \

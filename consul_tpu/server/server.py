@@ -40,6 +40,13 @@ class NoLeaderError(RPCError):
     pass
 
 
+class _PeerStreamTimeout(Exception):
+    """The dialer's OWN incoming-heartbeat window elapsed. Deliberately
+    NOT a TimeoutError subclass: socket.timeout IS TimeoutError since
+    py3.10, and a transient dial timeout must go through the stream-
+    down grace window, not masquerade as the window having elapsed."""
+
+
 class _ApplyBatcher:
     """Leader-side group commit: concurrent write RPCs coalesce into
     shared raft rounds. Callers enqueue their encoded command and park
@@ -246,6 +253,9 @@ class Server:
         self._bootstrapped = False
         # peerstream replication threads, one per ACTIVE dialed peering
         self._peer_repl: dict[str, threading.Thread] = {}
+        # first-failure time per peering, for the stream-down grace
+        # window (cleared on each successful end_of_snapshot)
+        self._peer_down_since: dict[str, float] = {}
 
         # L1: replicated state
         self.fsm = FSM()
@@ -1138,7 +1148,24 @@ class Server:
             self._peer_repl[name] = t
             t.start()
 
+    #: peerstream liveness (reference peerstream/server.go:26-27):
+    #: acceptor sends a heartbeat frame every 15s of quiet; the dialer
+    #: tears the stream down after 2 minutes without ANY frame.
+    #: Instance attributes so tests can compress the clock.
+    peer_heartbeat_interval = 15.0
+    peer_stream_timeout = 120.0
+
     def _peer_repl_loop(self, name: str) -> None:
+        try:
+            self._peer_repl_run(name)
+        finally:
+            # the outage clock must not outlive THIS loop: a stale
+            # hours-old first-failure stamp left behind by a lost
+            # leadership or a deleted peering would let a later
+            # outage's first transient blip bypass the grace window
+            self._peer_down_since.pop(name, None)
+
+    def _peer_repl_run(self, name: str) -> None:
         backoff = 0.5
         addr_i = 0  # rotate through the peer's servers on failure
         while not self._shutdown and self.is_leader():
@@ -1158,6 +1185,7 @@ class Server:
                 handle = self.pool.subscribe(
                     addrs[addr_i % len(addrs)],
                     "PeerStream.StreamExported", {"Secret": secret})
+                last_rx = time.monotonic()
                 while not self._shutdown and self.is_leader():
                     cur = self.state.raw_get("peerings", name)
                     if cur is None or cur.get("Secret") != secret \
@@ -1168,8 +1196,19 @@ class Server:
                         return
                     fr = handle.next(timeout=1.0)
                     if fr is None:
+                        # incoming-heartbeat timeout (server.go:27
+                        # defaultIncomingHeartbeatTimeout = 2min): a
+                        # silently dead TCP path must not leave
+                        # imported services stale forever
+                        if time.monotonic() - last_rx \
+                                > self.peer_stream_timeout:
+                            raise _PeerStreamTimeout(
+                                "peerstream heartbeat timeout")
                         continue
+                    last_rx = time.monotonic()
                     kind = fr.get("Type")
+                    if kind == "heartbeat":
+                        continue  # liveness only, nothing to apply
                     if kind == "upsert":
                         if in_snapshot:
                             snapshot_seen.add(fr.get("Service", ""))
@@ -1190,6 +1229,13 @@ class Server:
                         # alone lets an accept-then-close acceptor
                         # drive a full-snapshot hot loop
                         backoff = 0.5
+                        self._peer_down_since.pop(name, None)
+                        if (self.state.raw_get("peerings", name)
+                                or {}).get("StreamHealthy") is not True:
+                            self.raft.apply(encode_command(
+                                MessageType.PEERING, {
+                                    "Op": "stream_status",
+                                    "Peer": name, "Healthy": True}))
                         # reconcile: a delete delta that happened while
                         # the stream was down never replays, so purge
                         # imported records absent from the snapshot
@@ -1206,21 +1252,64 @@ class Server:
                                                            "")}))
             except StopIteration:
                 # acceptor ended cleanly; still pace the resubscribe —
-                # each cycle re-replays a full snapshot through raft
+                # each cycle re-replays a full snapshot through raft.
+                # Clean ends accrue the SAME outage clock as failures:
+                # a peer that keeps closing streams before
+                # end_of_snapshot is just as stale as a dead one
                 if self._shutdown:
                     return
+                self._mark_peer_stream_down(
+                    name, "stream ended before snapshot",
+                    timed_out=False)
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
             except Exception as e:  # noqa: BLE001
                 self.log.debug("peerstream %s: %s (retrying)", name, e)
                 if self._shutdown:
                     return
+                # only OUR last_rx timeout skips the grace window — a
+                # socket.timeout from a dial is TimeoutError too since
+                # py3.10, and a transient dial timeout must get the
+                # same grace as a refused connection
+                self._mark_peer_stream_down(
+                    name, str(e),
+                    timed_out=isinstance(e, _PeerStreamTimeout))
                 addr_i += 1  # next attempt tries the peer's next server
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
             finally:
                 if handle is not None:
                     handle.close()
+
+    def _mark_peer_stream_down(self, name: str, error: str,
+                               timed_out: bool) -> None:
+        """Stream teardown bookkeeping (peerstream Tracker disconnect
+        semantics): record the degraded stream on the peering, which
+        flips the peer's imported checks to critical in the same FSM
+        command — last-known-healthy must not outlive the path that
+        was vouching for it.
+
+        Grace period: a transient dial failure (leader restart, one
+        dead address in the rotation) must NOT nuke imported health —
+        the very next retry usually succeeds. Health degrades only
+        when (a) the heartbeat timeout itself fired (the window has
+        already elapsed on a silent path), or (b) reconnect attempts
+        have been failing for a full peer_stream_timeout window.
+        Idempotent per outage: only the healthy→down edge applies."""
+        now = time.monotonic()
+        down_since = self._peer_down_since.setdefault(name, now)
+        if not timed_out and now - down_since < self.peer_stream_timeout:
+            return
+        try:
+            cur = self.state.raw_get("peerings", name)
+            if cur is None or not self.is_leader() \
+                    or cur.get("StreamHealthy") is False:
+                return
+            self.raft.apply(encode_command(MessageType.PEERING, {
+                "Op": "stream_status", "Peer": name,
+                "Healthy": False, "Error": error}))
+        except Exception:  # noqa: BLE001 — lost leadership mid-mark;
+            pass  # the new leader's loop re-detects and re-marks
 
     def _flood_join(self) -> None:
         """Flood joiner (server_serf.go FloodJoins): every LAN server's

@@ -428,6 +428,42 @@ class FSM:
             return self.store.raw_delete(
                 "imported_services",
                 f"{b.get('Peer', '')}/{b.get('Service', '')}")
+        if op == "stream_status":
+            # peerstream liveness (peerstream Tracker status): the
+            # dialer's leader records stream health ON the peering so
+            # every server (and /v1/peering readers) sees a degraded
+            # stream without asking the leader. Healthy=False ALSO
+            # flips every imported check of the peer to critical in
+            # the SAME command — a silently dead path must not leave
+            # imported health frozen at last-known-passing (peerstream
+            # server.go:26-27), and doing both in one apply means a
+            # leadership change can never record the degraded stream
+            # without the health flip
+            peer = b.get("Peer", "")
+            cur = self.store.raw_get("peerings", peer)
+            if cur is None:
+                return None
+            cur = dict(cur)
+            cur["StreamHealthy"] = bool(b.get("Healthy"))
+            cur["StreamError"] = b.get("Error", "")
+            if not cur["StreamHealthy"]:
+                for key in [k for k in
+                            self.store.tables["imported_services"]
+                            if str(k).startswith(f"{peer}/")]:
+                    rec = dict(self.store.raw_get("imported_services",
+                                                  key) or {})
+                    nodes = []
+                    for n in rec.get("Nodes") or []:
+                        n = dict(n)
+                        n["Checks"] = [
+                            {**c, "Status": "critical",
+                             "Output": "peering stream down"}
+                            for c in n.get("Checks") or []]
+                        nodes.append(n)
+                    rec["Nodes"] = nodes
+                    self.store.raw_upsert("imported_services", key, rec)
+            return self.store.raw_upsert("peerings",
+                                         cur.get("Name"), cur)
         if op == "delete":
             self.store.raw_delete("peering_trust_bundles",
                                   p.get("Name"))

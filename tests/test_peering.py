@@ -209,3 +209,97 @@ def test_peerstream_replication_delivers_locally(clusters):
         ca.put("/v1/config", body={
             "Kind": "exported-services", "Name": "default",
             "Services": [{"Name": "billing"}]})
+
+
+def test_peerstream_heartbeat_timeout_and_recovery(clusters):
+    """Peerstream liveness (reference peerstream/server.go:26-27:
+    15s outgoing heartbeats / 2min incoming timeout, compressed here):
+    a silently dead path — the acceptor stops sending anything — must
+    flip the peering to StreamHealthy=False and mark every imported
+    check critical within one timeout window; when frames flow again
+    the reconnect's fresh snapshot restores health end to end."""
+    import time as _time
+
+    from consul_tpu.state.fsm import MessageType, encode_command
+
+    ca, cb, a, b = clusters
+    # fresh, known-good state: billing exported + passing in alpha
+    ca.service_register({"Name": "billing", "ID": "bill", "Port": 7000,
+                         "Check": {"TTL": "60s"}})
+    ca.check_pass("service:bill")
+    ca.put("/v1/config", body={
+        "Kind": "exported-services", "Name": "default",
+        "Services": [{"Name": "billing"}]})
+    # compressed liveness clock BEFORE establishing, so the acceptor
+    # stream starts with the short heartbeat interval
+    a.server.peer_heartbeat_interval = 0.5
+    b.server.peer_stream_timeout = 3.0
+    token = ca.put("/v1/peering/token",
+                   body={"PeerName": "beta"})["PeeringToken"]
+    cb.put("/v1/peering/establish",
+           body={"PeerName": "alpha", "PeeringToken": token})
+    wait_for(lambda: (b.server.state.raw_get("peerings", "alpha")
+                      or {}).get("StreamHealthy") is True,
+             timeout=20, what="stream healthy after snapshot")
+    wait_for(lambda: b.server.state.raw_get(
+        "imported_services", "alpha/billing") is not None,
+        timeout=15, what="billing imported")
+
+    # freeze the acceptor: a handler that accepts and never sends —
+    # the TCP path is up but silent, exactly the failure heartbeats
+    # exist to catch
+    orig = a.server.rpc.stream_handlers["PeerStream.StreamExported"]
+
+    def silent(args, src, push, cancel):
+        while not cancel.is_set():
+            _time.sleep(0.1)
+
+    def _set_state(state_val):
+        rec = dict(b.server.state.raw_get("peerings", "alpha"))
+        rec["State"] = state_val
+        b.server.raft.apply(encode_command(
+            MessageType.PEERING, {"Op": "set", "Peering": rec}))
+
+    try:
+        # bounce the dialer loop onto the silent handler
+        _set_state("PAUSED")
+        wait_for(lambda: not b.server._peer_repl["alpha"].is_alive(),
+                 timeout=10, what="dialer loop stopped")
+        a.server.rpc.stream_handlers[
+            "PeerStream.StreamExported"] = silent
+        _set_state("ACTIVE")
+        # incoming timeout fires -> teardown + degraded + critical
+        wait_for(lambda: (b.server.state.raw_get("peerings", "alpha")
+                          or {}).get("StreamHealthy") is False,
+                 timeout=25, what="heartbeat timeout detected")
+        rec = b.server.state.raw_get("imported_services",
+                                     "alpha/billing")
+        assert rec["Nodes"], "imported record must survive the outage"
+        assert all(c["Status"] == "critical"
+                   for n in rec["Nodes"] for c in n["Checks"])
+        # passing-only catalog reads now exclude the imported service
+        assert cb.get("/v1/health/service/billing", peer="alpha",
+                      passing="") == []
+        # path restored: reconnect-with-backoff replays the snapshot
+        # and flips the peering and the imported health back
+        a.server.rpc.stream_handlers[
+            "PeerStream.StreamExported"] = orig
+        wait_for(lambda: (b.server.state.raw_get("peerings", "alpha")
+                          or {}).get("StreamHealthy") is True,
+                 timeout=25, what="stream recovered")
+        wait_for(lambda: all(
+            c.get("Status") == "passing"
+            for n in (b.server.state.raw_get(
+                "imported_services", "alpha/billing") or {}).get(
+                    "Nodes")
+            or [] for c in n.get("Checks") or []),
+            timeout=15,
+            what="imported health restored by fresh snapshot")
+    finally:
+        # restore EVERYTHING even on mid-test failure: the clusters
+        # fixture is module-scoped, so leaked compressed timers would
+        # poison any test added after this one
+        a.server.rpc.stream_handlers[
+            "PeerStream.StreamExported"] = orig
+        a.server.peer_heartbeat_interval = 15.0
+        b.server.peer_stream_timeout = 120.0
