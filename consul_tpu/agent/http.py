@@ -758,6 +758,23 @@ class HTTPApi:
             return rpc("ACL.Bootstrap", {}), None
         if path == "/v1/acl/token" and method in ("PUT", "POST"):
             return rpc("ACL.TokenSet", {"Token": jbody()}), None
+        if (m := re.match(r"^/v1/acl/token/(.+)/clone$", path)) \
+                and method in ("PUT", "POST"):
+            # acl_endpoint.go TokenClone: same grants, fresh secret
+            tid = urllib.parse.unquote(m.group(1))
+            res = rpc("ACL.TokenRead", {"TokenID": tid})
+            tok = res.get("Token")
+            if tok is None:
+                raise HTTPError(404, "token not found")
+            # expiration MUST carry over — a clone of a 1h token that
+            # never expires silently outlives its grant's lifetime
+            new = {k: tok[k] for k in
+                   ("Policies", "Roles", "ServiceIdentities",
+                    "NodeIdentities", "Local", "ExpirationTime",
+                    "ExpirationTTL") if tok.get(k)}
+            new["Description"] = (jbody() or {}).get("Description") \
+                or f"clone of {tok.get('Description') or tid}"
+            return rpc("ACL.TokenSet", {"Token": new}), None
         if (m := re.match(r"^/v1/acl/token/(.+)$", path)):
             tid = urllib.parse.unquote(m.group(1))
             if method == "DELETE":

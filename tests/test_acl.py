@@ -523,3 +523,35 @@ def test_expiry_indexed_reaping_touches_only_expired():
     st2.restore(blob)
     assert len(st2._token_expiry) == 10_007
     assert len(st2.expired_tokens(now)) == 7
+
+
+def test_token_clone_http_route(acl_agent, root):
+    """PUT /v1/acl/token/<id>/clone (acl_endpoint.go TokenClone, the
+    UI's clone button): same grants, fresh secret/accessor."""
+    root.put("/v1/acl/policy", body={
+        "Name": "clone-pol",
+        "Rules": '{"key_prefix": {"c/": {"policy": "read"}}}'})
+    tok = root.put("/v1/acl/token", body={
+        "Description": "original",
+        "Policies": [{"Name": "clone-pol"}]})
+    clone = root.put(f"/v1/acl/token/{tok['AccessorID']}/clone")
+    assert clone["AccessorID"] != tok["AccessorID"]
+    assert clone["SecretID"] != tok["SecretID"]
+    assert [p["Name"] for p in clone["Policies"]] == ["clone-pol"]
+    assert "original" in clone["Description"]
+    # the clone actually carries the grants
+    c = ConsulClient(acl_agent.http.addr, token=clone["SecretID"])
+    root.kv_put("c/x", b"1")
+    assert c.kv_get("c/x") is not None
+    with pytest.raises(APIError, match="Permission denied"):
+        c.kv_put("c/x", b"2")
+
+
+def test_token_clone_carries_expiration(acl_agent, root):
+    """Cloning a TTL'd token must not mint an immortal one — the
+    reference's TokenClone copies expiration (structs/acl.go)."""
+    tok = root.put("/v1/acl/token", body={
+        "Description": "short", "ExpirationTTL": "1h"})
+    assert tok.get("ExpirationTime")
+    clone = root.put(f"/v1/acl/token/{tok['AccessorID']}/clone")
+    assert abs(clone["ExpirationTime"] - tok["ExpirationTime"]) < 1e-6

