@@ -69,7 +69,10 @@ def validate_extensions(exts: list[dict[str, Any]]) -> list[str]:
             continue
         try:
             construct_extension(ext)
-        except ExtensionError as e:
+        except Exception as e:  # noqa: BLE001 — ANY malformed input
+            # must die as a clean validation message, not escape
+            # ConfigEntry.Apply as an internal error (e.g. a non-dict
+            # Arguments reaching .get())
             errors.append(
                 f"invalid EnvoyExtensions[{i}][{ext['Name']}]: {e}")
     return errors
@@ -203,6 +206,12 @@ class ExtAuthzExtension(EnvoyExtension):
     name of an existing upstream service (reuses its mesh cluster)."""
 
     def validate(self) -> None:
+        lst = self.args.get("Listener", "inbound")
+        if lst not in ("", "inbound", "outbound"):
+            # _iter_hcms treats any unknown value as "both" — a typo
+            # must die here, not silently widen the filter's scope
+            raise ExtensionError(
+                f"Listener must be inbound/outbound, got {lst!r}")
         cfg = self.args.get("Config") or {}
         grpc = (cfg.get("GrpcService") or {}).get("Target") or {}
         http = (cfg.get("HttpService") or {}).get("Target") or {}
@@ -211,9 +220,17 @@ class ExtAuthzExtension(EnvoyExtension):
                 "Config.GrpcService.Target or Config.HttpService.Target "
                 "is required")
         tgt = grpc or http
-        if not tgt.get("URI") and not (tgt.get("Service") or {}).get(
-                "Name"):
+        uri = tgt.get("URI")
+        if not uri and not (tgt.get("Service") or {}).get("Name"):
             raise ExtensionError("Target needs URI or Service.Name")
+        if uri:
+            # apply-time int(port) must never be the first to notice a
+            # malformed URI — that would silently skip the filter
+            # (fail-open) on every xDS generation
+            host, _, port = str(uri).rpartition(":")
+            if not host or not port.isdigit():
+                raise ExtensionError(
+                    f"Target.URI must be host:port, got {uri!r}")
         self.grpc = bool(grpc)
         self.target = tgt
 
@@ -263,7 +280,9 @@ class ExtAuthzExtension(EnvoyExtension):
         else:
             svc_cfg = {"http_service": {"server_uri": {
                 "uri": self.target.get("URI", cname),
-                "cluster": cname, "timeout": "1s"}}}
+                "cluster": cname,
+                "timeout": (self.args.get("Config") or {}).get(
+                    "Timeout", "1s")}}}
         filt = {
             "name": "envoy.filters.http.ext_authz",
             "typed_config": {

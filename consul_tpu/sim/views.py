@@ -708,7 +708,10 @@ def make_sharded_views_round(p: SimParams, mesh,
             jnp.where(suspect_it, t_inc * 4 + 1, -1))
         st = merge(st, sus_key, jnp.zeros((nl, n), bool))
 
-        # -- gossip: partial segment_max + pmax all-reduce --------------
+        # -- gossip: partial segment_max + grouped exchange -------------
+        # (sharded runs route the merge through _merge_exchange above:
+        # a grouped all_to_all max-reduce-scatter by default, pmax
+        # only via exchange="pmax" for the pinned-equivalence test)
         # gossip_nodes receivers per tick per sender, batched into ONE
         # partial segment_max + all-reduce per tick (fewer collectives)
         ticks = int(p.gossip_ticks_per_round)
