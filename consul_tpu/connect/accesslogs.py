@@ -1,0 +1,135 @@
+"""Envoy access-log configuration from proxy-defaults.
+
+Reference: agent/xds/accesslogs/accesslogs.go MakeAccessLogs — the
+`AccessLogs` block on the global proxy-defaults entry
+(structs/connect_proxy_config.go:196 AccessLogsConfig) hydrates Envoy
+AccessLog configs attached to every mesh HTTP connection manager and,
+unless DisableListenerLogs, to the listeners themselves (listener-level
+logs fire on connections Envoy rejects before any filter runs — the
+filter pins response flag "NR", accesslogs.go
+getListenerAccessLogFilter).
+
+Sinks: stdout (default), stderr, file (requires Path). Format: the
+ref's default JSON command-operator map unless JSONFormat or
+TextFormat overrides (mutually exclusive, validated at write time in
+connect/chain.py).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+#: accesslogs.go defaultJSONFormat, as the dict the Struct encodes
+DEFAULT_JSON_FORMAT: dict[str, str] = {
+    "start_time": "%START_TIME%",
+    "route_name": "%ROUTE_NAME%",
+    "method": "%REQ(:METHOD)%",
+    "path": "%REQ(X-ENVOY-ORIGINAL-PATH?:PATH)%",
+    "protocol": "%PROTOCOL%",
+    "response_code": "%RESPONSE_CODE%",
+    "response_flags": "%RESPONSE_FLAGS%",
+    "response_code_details": "%RESPONSE_CODE_DETAILS%",
+    "connection_termination_details":
+        "%CONNECTION_TERMINATION_DETAILS%",
+    "bytes_received": "%BYTES_RECEIVED%",
+    "bytes_sent": "%BYTES_SENT%",
+    "duration": "%DURATION%",
+    "upstream_service_time": "%RESP(X-ENVOY-UPSTREAM-SERVICE-TIME)%",
+    "x_forwarded_for": "%REQ(X-FORWARDED-FOR)%",
+    "user_agent": "%REQ(USER-AGENT)%",
+    "request_id": "%REQ(X-REQUEST-ID)%",
+    "authority": "%REQ(:AUTHORITY)%",
+    "upstream_host": "%UPSTREAM_HOST%",
+    "upstream_cluster": "%UPSTREAM_CLUSTER%",
+    "upstream_local_address": "%UPSTREAM_LOCAL_ADDRESS%",
+    "downstream_local_address": "%DOWNSTREAM_LOCAL_ADDRESS%",
+    "downstream_remote_address": "%DOWNSTREAM_REMOTE_ADDRESS%",
+    "requested_server_name": "%REQUESTED_SERVER_NAME%",
+    "upstream_transport_failure_reason":
+        "%UPSTREAM_TRANSPORT_FAILURE_REASON%",
+}
+
+STDOUT_TYPE = ("type.googleapis.com/envoy.extensions.access_loggers."
+               "stream.v3.StdoutAccessLog")
+STDERR_TYPE = ("type.googleapis.com/envoy.extensions.access_loggers."
+               "stream.v3.StderrAccessLog")
+FILE_TYPE = ("type.googleapis.com/envoy.extensions.access_loggers."
+             "file.v3.FileAccessLog")
+
+
+def validate_access_logs(logs: dict[str, Any]) -> Optional[str]:
+    """Write-time validation (AccessLogsConfig.Validate): returns an
+    error string or None."""
+    if not isinstance(logs, dict):
+        return "AccessLogs must be a map"
+    typ = logs.get("Type") or "stdout"
+    if typ not in ("stdout", "stderr", "file"):
+        return f"AccessLogs.Type must be stdout/stderr/file, got {typ!r}"
+    if typ == "file" and not logs.get("Path"):
+        return "AccessLogs.Type 'file' requires Path"
+    if typ != "file" and logs.get("Path"):
+        return "AccessLogs.Path only applies to Type 'file'"
+    if logs.get("JSONFormat") and logs.get("TextFormat"):
+        return "AccessLogs allows only one of JSONFormat or TextFormat"
+    if logs.get("JSONFormat"):
+        try:
+            parsed = json.loads(logs["JSONFormat"])
+            if not isinstance(parsed, dict):
+                return "AccessLogs.JSONFormat must be a JSON object"
+            # the proto lowering encodes a FLAT Struct (string/number/
+            # bool values) — a nested object or null stored here would
+            # downgrade every listener to the JSON fallback at serve
+            # time, so it must die at write time instead
+            for k, v in parsed.items():
+                if not isinstance(v, (str, bool, int, float)):
+                    return ("AccessLogs.JSONFormat values must be "
+                            f"strings/numbers/bools; {k!r} is "
+                            f"{type(v).__name__}")
+        except json.JSONDecodeError as e:
+            return f"AccessLogs.JSONFormat is not valid JSON: {e}"
+    return None
+
+
+def _log_format(logs: dict[str, Any]) -> dict[str, Any]:
+    """SubstitutionFormatString dict (accesslogs.go getLogFormat)."""
+    if logs.get("JSONFormat"):
+        return {"json_format": json.loads(logs["JSONFormat"])}
+    if logs.get("TextFormat"):
+        text = logs["TextFormat"]
+        if not text.endswith("\n"):
+            text += "\n"  # lib.EnsureTrailingNewline
+        return {"text_format_source": {"inline_string": text}}
+    return {"json_format": dict(DEFAULT_JSON_FORMAT)}
+
+
+def make_access_logs(logs: Optional[dict[str, Any]],
+                     is_listener: bool) -> list[dict[str, Any]]:
+    """Dict-form envoy.config.accesslog.v3.AccessLog list for one
+    attachment point (accesslogs.go MakeAccessLogs). Empty when
+    disabled, or for listeners when DisableListenerLogs."""
+    if not logs or not logs.get("Enabled"):
+        return []
+    if is_listener and logs.get("DisableListenerLogs"):
+        return []
+    fmt = _log_format(logs)
+    typ = logs.get("Type") or "stdout"
+    if typ == "file":
+        typed: dict[str, Any] = {"@type": FILE_TYPE,
+                                 "path": logs.get("Path", ""),
+                                 "log_format": fmt}
+    elif typ == "stderr":
+        typed = {"@type": STDERR_TYPE, "log_format": fmt}
+    else:
+        typed = {"@type": STDOUT_TYPE, "log_format": fmt}
+    entry: dict[str, Any] = {
+        "name": ("Consul Listener Log" if is_listener
+                 else "Consul Listener Filter Log"),
+        "typed_config": typed,
+    }
+    if is_listener:
+        # listener-level logs fire only for connections rejected
+        # before any filter chain matched — response flag NR
+        # (accesslogs.go getListenerAccessLogFilter)
+        entry["filter"] = {"response_flag_filter": {"flags": ["NR"]}}
+    return [entry]

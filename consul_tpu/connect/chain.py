@@ -211,6 +211,13 @@ def validate_entry(entry: dict) -> None:
             if not ok:
                 raise ValueError(f"{k} must be a number >= 0")
 
+    if kind == "proxy-defaults" and entry.get("AccessLogs") is not None:
+        from consul_tpu.connect.accesslogs import validate_access_logs
+
+        err = validate_access_logs(entry["AccessLogs"])
+        if err:
+            raise ValueError(err)
+
     # proxy-defaults / service-defaults may carry EnvoyExtensions:
     # every declared extension must construct cleanly BEFORE the entry
     # is stored (registered_extensions.go ValidateExtensions) — a typo

@@ -390,6 +390,20 @@ def _post_process(cfg: dict[str, Any],
             snapshot.get("JWTProviders") or {},
             collect_jwt_provider_names(
                 snapshot.get("Intentions") or [])))
+    # access logs from proxy-defaults (accesslogs.go MakeAccessLogs):
+    # one config on every mesh HCM, and a listener-level NR-filtered
+    # one on every listener unless DisableListenerLogs
+    from consul_tpu.connect.accesslogs import make_access_logs
+
+    hcm_logs = make_access_logs(snapshot.get("AccessLogs"), False)
+    if hcm_logs:
+        for _, hcm in _iter_hcms(cfg, ""):
+            hcm["access_log"] = [dict(e) for e in hcm_logs]
+    lst_logs = make_access_logs(snapshot.get("AccessLogs"), True)
+    if lst_logs:
+        for lst in cfg.get("static_resources", {}).get(
+                "listeners") or []:
+            lst["access_log"] = [dict(e) for e in lst_logs]
     errors = apply_extensions(cfg, snapshot)
     for err in errors:
         log.named("envoy.extensions").warning(

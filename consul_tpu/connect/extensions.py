@@ -37,6 +37,21 @@ class ExtensionError(ValueError):
     """Invalid extension configuration (bad name or arguments)."""
 
 
+def _check_duration(val: Any, what: str) -> None:
+    """Write-time guard for the '<float>s' duration strings the proto
+    lowering accepts — a Go-style '500ms' stored here would make every
+    xDS build degrade at serve time."""
+    ok = isinstance(val, str) and val.endswith("s")
+    if ok:
+        try:
+            float(val[:-1])
+        except ValueError:
+            ok = False
+    if not ok:
+        raise ExtensionError(
+            f"{what} must be a '<seconds>s' duration, got {val!r}")
+
+
 REGISTERED: dict[str, type] = {}
 
 
@@ -231,6 +246,8 @@ class ExtAuthzExtension(EnvoyExtension):
             if not host or not port.isdigit():
                 raise ExtensionError(
                     f"Target.URI must be host:port, got {uri!r}")
+        if cfg.get("Timeout") is not None:
+            _check_duration(cfg["Timeout"], "Config.Timeout")
         self.grpc = bool(grpc)
         self.target = tgt
 
@@ -295,6 +312,195 @@ class ExtAuthzExtension(EnvoyExtension):
             }}
         for _, hcm in _iter_hcms(cfg,
                                  self.args.get("Listener", "inbound")):
+            insert_http_filter(hcm, dict(filt))
+
+
+@register("builtin/property-override")
+class PropertyOverrideExtension(EnvoyExtension):
+    """Patch fields on generated clusters/listeners
+    (builtin/property-override): Patches = [{ResourceFilter:
+    {ResourceType: cluster|listener, TrafficDirection:
+    inbound|outbound|""}, Op: add|remove, Path: "/field[/sub]",
+    Value}]. Paths are validated against the proto-lowering schema at
+    write time — a patch the CDS/LDS lowering would silently drop must
+    be rejected, not stored (the ref validates against the proto
+    descriptor for the same reason)."""
+
+    def validate(self) -> None:
+        patches = self.args.get("Patches")
+        if not isinstance(patches, list) or not patches:
+            raise ExtensionError("Patches is required")
+        from consul_tpu.server import xds_proto as xp
+
+        roots = {"cluster": xp._CLUSTER, "listener": xp._LISTENER}
+        for i, pt in enumerate(patches):
+            if not isinstance(pt, dict):
+                raise ExtensionError(f"Patches[{i}] must be a map")
+            rf = pt.get("ResourceFilter") or {}
+            rtype = rf.get("ResourceType", "")
+            if rtype not in roots:
+                raise ExtensionError(
+                    f"Patches[{i}].ResourceFilter.ResourceType must "
+                    "be cluster or listener")
+            td = rf.get("TrafficDirection", "")
+            if td not in ("", "inbound", "outbound"):
+                raise ExtensionError(
+                    f"Patches[{i}].TrafficDirection must be "
+                    "inbound/outbound")
+            if pt.get("Op") not in ("add", "remove"):
+                raise ExtensionError(
+                    f"Patches[{i}].Op must be add or remove")
+            path = pt.get("Path", "")
+            if not isinstance(path, str) or not path.startswith("/"):
+                raise ExtensionError(
+                    f"Patches[{i}].Path must start with '/'")
+            top = path.lstrip("/").split("/")[0]
+            if top not in roots[rtype]:
+                raise ExtensionError(
+                    f"Patches[{i}].Path {path!r}: field {top!r} is "
+                    f"outside the {rtype} lowering schema (supported: "
+                    f"{sorted(roots[rtype])})")
+            if pt["Op"] == "add" and "Value" not in pt:
+                raise ExtensionError(
+                    f"Patches[{i}]: add requires Value")
+
+    def update(self, cfg: dict[str, Any],
+               snapshot: dict[str, Any]) -> None:
+        for pt in self.args["Patches"]:
+            rf = pt["ResourceFilter"]
+            rtype = rf["ResourceType"]
+            td = rf.get("TrafficDirection", "")
+            key = "clusters" if rtype == "cluster" else "listeners"
+            for r in cfg["static_resources"][key]:
+                name = r.get("name", "")
+                if name.startswith(("extauthz_", "jwks_cluster_")):
+                    continue  # other extensions' support resources
+                if rtype == "cluster":
+                    inbound = name == "local_app"
+                else:
+                    inbound = not name.startswith("upstream_")
+                if (td == "inbound" and not inbound) \
+                        or (td == "outbound" and inbound):
+                    continue
+                parts = pt["Path"].lstrip("/").split("/")
+                cur = r
+                for p in parts[:-1]:
+                    nxt = cur.get(p)
+                    if nxt is None and pt["Op"] == "add":
+                        nxt = {}
+                        cur[p] = nxt
+                    if not isinstance(nxt, dict):
+                        # an existing SCALAR on the path (e.g.
+                        # connect_timeout="5s" under
+                        # /connect_timeout/seconds) must never be
+                        # destroyed by an add — skip the patch rather
+                        # than wreck the resource
+                        cur = None
+                        break
+                    cur = nxt
+                if cur is None:
+                    continue
+                if pt["Op"] == "remove":
+                    cur.pop(parts[-1], None)
+                else:
+                    cur[parts[-1]] = pt["Value"]
+
+
+@register("builtin/wasm")
+class WasmExtension(EnvoyExtension):
+    """Inject a wasm HTTP filter (builtin/wasm, HTTP protocol only):
+    Arguments.Plugin = {Name, VmConfig: {Runtime: wasmtime|v8|wamr,
+    Code: {Local: {Filename} | Remote: {HttpURI: {URI}, SHA256}}},
+    Configuration (opaque string handed to the plugin)}."""
+
+    def validate(self) -> None:
+        lst = self.args.get("Listener", "inbound")
+        if lst not in ("", "inbound", "outbound"):
+            raise ExtensionError(
+                f"Listener must be inbound/outbound, got {lst!r}")
+        plug = self.args.get("Plugin")
+        if not isinstance(plug, dict):
+            raise ExtensionError("Plugin is required")
+        code = (plug.get("VmConfig") or {}).get("Code") or {}
+        local = (code.get("Local") or {}).get("Filename")
+        remote = ((code.get("Remote") or {}).get("HttpURI")
+                  or {}).get("URI")
+        if not local and not remote:
+            raise ExtensionError(
+                "Plugin.VmConfig.Code needs Local.Filename or "
+                "Remote.HttpURI.URI")
+        if remote and not (code.get("Remote") or {}).get("SHA256"):
+            # Envoy's RemoteDataSource requires the checksum — an
+            # empty one stored here would NACK at every push
+            raise ExtensionError(
+                "Plugin.VmConfig.Code.Remote requires SHA256")
+        self.plugin = plug
+
+    def update(self, cfg: dict[str, Any],
+               snapshot: dict[str, Any]) -> None:
+        vm = self.plugin.get("VmConfig") or {}
+        code = vm.get("Code") or {}
+        if (code.get("Local") or {}).get("Filename"):
+            code_cfg: dict[str, Any] = {"local": {
+                "filename": code["Local"]["Filename"]}}
+        else:
+            remote = code["Remote"]
+            uri = remote["HttpURI"]["URI"]
+            # the fetch cluster must actually exist (same contract as
+            # jwks_cluster_*): one LOGICAL_DNS cluster per plugin
+            cname = "wasm_code_" + (self.plugin.get("Name") or "plugin")
+            scheme, _, rest = uri.partition("://")
+            hostport = rest.split("/", 1)[0]
+            host, _, port = hostport.partition(":")
+            portn = int(port) if port.isdigit() \
+                else (443 if scheme == "https" else 80)
+            if not any(c["name"] == cname for c in
+                       cfg["static_resources"]["clusters"]):
+                cluster: dict[str, Any] = {
+                    "name": cname, "type": "LOGICAL_DNS",
+                    "connect_timeout": "10s",
+                    "load_assignment": {
+                        "cluster_name": cname,
+                        "endpoints": [{"lb_endpoints": [{"endpoint": {
+                            "address": {"socket_address": {
+                                "address": host,
+                                "port_value": portn}}}}]}]}}
+                if scheme == "https":
+                    cluster["transport_socket"] = {
+                        "name": "tls",
+                        "typed_config": {
+                            "@type": "type.googleapis.com/envoy."
+                                     "extensions.transport_sockets."
+                                     "tls.v3.UpstreamTlsContext",
+                            "sni": host,
+                            "common_tls_context": {}}}
+                cfg["static_resources"]["clusters"].append(cluster)
+            code_cfg = {"remote": {
+                "http_uri": {"uri": uri, "cluster": cname,
+                             "timeout": "10s"},
+                "sha256": remote["SHA256"]}}
+        plugin_cfg: dict[str, Any] = {
+            "name": self.plugin.get("Name", "wasm"),
+            "vm_config": {
+                "vm_id": vm.get("VmID", ""),
+                "runtime": ("envoy.wasm.runtime."
+                            + (vm.get("Runtime") or "v8")),
+                "code": code_cfg,
+            }}
+        if self.plugin.get("Configuration"):
+            plugin_cfg["configuration"] = {
+                "@type": "type.googleapis.com/google.protobuf."
+                         "StringValue",
+                "value": self.plugin["Configuration"]}
+        filt = {
+            "name": "envoy.filters.http.wasm",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "filters.http.wasm.v3.Wasm",
+                "config": plugin_cfg,
+            }}
+        for _, hcm in _iter_hcms(cfg, self.args.get("Listener",
+                                                    "inbound")):
             insert_http_filter(hcm, dict(filt))
 
 

@@ -170,6 +170,7 @@ def assemble_snapshot(agent, proxy_id: str,
         "Upstreams": upstreams,
         "EnvoyExtensions": extensions,
         "JWTProviders": jwt_providers,
+        "AccessLogs": pd.get("AccessLogs") or {},
     }
 
 
@@ -197,6 +198,7 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
     snap: dict[str, Any] = {
         "EnvoyExtensions": list(pd.get("EnvoyExtensions") or [])
         + list(sd.get("EnvoyExtensions") or []),
+        "AccessLogs": pd.get("AccessLogs") or {},
         "ProxyID": proxy.id,
         "Kind": proxy.kind,
         "Service": gw_name,

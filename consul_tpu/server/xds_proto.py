@@ -253,6 +253,57 @@ _JWT_AUTHN = {
 JWT_AUTHN_TYPE = ("type.googleapis.com/envoy.extensions.filters.http."
                   "jwt_authn.v3.JwtAuthentication")
 
+#: wasm (extensions/wasm/v3/wasm.proto + filters/http/wasm/v3):
+#: RemoteDataSource http_uri=1, sha256=2; AsyncDataSource local=1,
+#: remote=2; VmConfig vm_id=1, runtime=2, code=3; PluginConfig name=1,
+#: vm_config=3, configuration=4 (Any); http Wasm filter config=1
+_REMOTE_DATA = {"http_uri": Field(1, "message", _HTTP_URI),
+                "sha256": Field(2, "string")}
+_ASYNC_DATA = {"local": Field(1, "message", _DATA_SOURCE),
+               "remote": Field(2, "message", _REMOTE_DATA)}
+_VM_CONFIG = {"vm_id": Field(1, "string"),
+              "runtime": Field(2, "string"),
+              "code": Field(3, "message", _ASYNC_DATA)}
+_PLUGIN_CONFIG = {"name": Field(1, "string"),
+                  "vm_config": Field(3, "message", _VM_CONFIG),
+                  "configuration": Field(4, "message", _ANY)}
+_WASM = {"config": Field(1, "message", _PLUGIN_CONFIG)}
+#: google.protobuf.StringValue: value=1
+_STRING_VALUE = {"value": Field(1, "string")}
+STRING_VALUE_TYPE = "type.googleapis.com/google.protobuf.StringValue"
+WASM_TYPE = ("type.googleapis.com/envoy.extensions.filters.http."
+             "wasm.v3.Wasm")
+
+# ----------------------------------------------------------- access logs
+#: google.protobuf.Struct/Value (struct.proto) — flat objects only
+#: (the access-log JSON formats are string maps); nesting falls back
+_VALUE = {"null_value": Field(1, "enum"),
+          "number_value": Field(2, "double"),
+          "string_value": Field(3, "string"),
+          "bool_value": Field(4, "bool")}
+_STRUCT_ENTRY = {"key": Field(1, "string"),
+                 "value": Field(2, "message", _VALUE)}
+_STRUCT = {"fields": Field(1, "message", _STRUCT_ENTRY, repeated=True)}
+#: core.v3.SubstitutionFormatString (substitution_format_string.proto):
+#: text_format=1 (deprecated), json_format=2, text_format_source=5
+_SUBST_FORMAT = {"json_format": Field(2, "message", _STRUCT),
+                 "text_format_source": Field(5, "message",
+                                             _DATA_SOURCE)}
+#: stream.v3 Stdout/StderrAccessLog: oneof access_log_format
+#: log_format=1; file.v3 FileAccessLog: path=1, log_format=5
+_STREAM_LOG = {"log_format": Field(1, "message", _SUBST_FORMAT)}
+_FILE_LOG = {"path": Field(1, "string"),
+             "log_format": Field(5, "message", _SUBST_FORMAT)}
+#: config.accesslog.v3 (accesslog.proto): ResponseFlagFilter.flags=1;
+#: AccessLogFilter.response_flag_filter=9; AccessLog name=1, filter=2,
+#: typed_config=4
+_RESP_FLAG_FILTER = {"flags": Field(1, "string", repeated=True)}
+_ACCESSLOG_FILTER = {"response_flag_filter":
+                     Field(9, "message", _RESP_FLAG_FILTER)}
+_ACCESS_LOG = {"name": Field(1, "string"),
+               "filter": Field(2, "message", _ACCESSLOG_FILTER),
+               "typed_config": Field(4, "message", _ANY)}
+
 # ------------------------------------------------- HTTP / route configs
 # config.route.v3 (route.proto, route_components.proto) + the HTTP
 # connection manager — what the L7 discovery chain (service-router /
@@ -333,7 +384,7 @@ _ROUTE_CONFIG = {"name": Field(1, "string"),
                  "virtual_hosts": Field(2, "message", _VIRTUAL_HOST,
                                         repeated=True)}
 #: HttpConnectionManager: codec_type=1, stat_prefix=2, route_config=4,
-#: http_filters=5
+#: http_filters=5, access_log=13
 _HCM = {
     "codec_type": Field(1, "enum"),  # AUTO = 0
     "stat_prefix": Field(2, "string"),
@@ -341,6 +392,7 @@ _HCM = {
     # HttpFilter shares (name=1, typed_config=4) with the network
     # Filter schema below - one spec serves both
     "http_filters": None,  # filled after _FILTER is defined
+    "access_log": Field(13, "message", _ACCESS_LOG, repeated=True),
 }
 HCM_TYPE = ("type.googleapis.com/envoy.extensions.filters.network."
             "http_connection_manager.v3.HttpConnectionManager")
@@ -482,15 +534,106 @@ def _lower_hcm(tc: dict[str, Any]) -> bytes:
             blob = _lower_ext_authz(ftc)
         elif at == JWT_AUTHN_TYPE:
             blob = _lower_jwt_authn(ftc)
+        elif at == WASM_TYPE:
+            blob = _lower_wasm(ftc)
         else:
             raise UnloweredShape(f"http filter {at!r}")
         filters.append({"name": f.get("name", ""),
                         "typed_config": {"type_url": at, "value": blob}})
-    return encode(_HCM, {
+    msg = {
         "stat_prefix": tc.get("stat_prefix", ""),
         "route_config": {"name": rc.get("name", ""),
                          "virtual_hosts": vhosts},
-        "http_filters": filters})
+        "http_filters": filters}
+    if tc.get("access_log"):
+        msg["access_log"] = _lower_access_logs(tc["access_log"])
+    return encode(_HCM, msg)
+
+def _pb_struct(d: dict[str, Any]) -> dict[str, Any]:
+    """google.protobuf.Struct from a FLAT json object (access-log
+    formats are string maps); nested objects fall back visibly."""
+    fields = []
+    for k, v in sorted(d.items()):
+        if isinstance(v, bool):
+            val: dict[str, Any] = {"bool_value": v}
+        elif isinstance(v, str):
+            val = {"string_value": v}
+        elif isinstance(v, (int, float)):
+            val = {"number_value": float(v)}
+        else:
+            raise UnloweredShape(f"struct value {type(v).__name__}")
+        fields.append({"key": k, "value": val})
+    return {"fields": fields}
+
+
+def _lower_access_logs(entries: list[dict[str, Any]]
+                       ) -> list[dict[str, Any]]:
+    """config.accesslog.v3.AccessLog list (accesslogs.py dict shapes:
+    stdout/stderr/file sinks with SubstitutionFormatString)."""
+    from consul_tpu.connect.accesslogs import (FILE_TYPE, STDERR_TYPE,
+                                               STDOUT_TYPE)
+
+    out = []
+    for e in entries or []:
+        tc = e.get("typed_config") or {}
+        at = tc.get("@type", "")
+        fmt = tc.get("log_format") or {}
+        sf: dict[str, Any] = {}
+        if fmt.get("json_format") is not None:
+            sf["json_format"] = _pb_struct(fmt["json_format"])
+        elif fmt.get("text_format_source"):
+            sf["text_format_source"] = _data_source(
+                fmt["text_format_source"])
+        if at == FILE_TYPE:
+            blob = encode(_FILE_LOG, {"path": tc.get("path", ""),
+                                      "log_format": sf})
+        elif at in (STDOUT_TYPE, STDERR_TYPE):
+            blob = encode(_STREAM_LOG, {"log_format": sf})
+        else:
+            raise UnloweredShape(f"access log sink {at!r}")
+        msg: dict[str, Any] = {
+            "name": e.get("name", ""),
+            "typed_config": {"type_url": at, "value": blob}}
+        filt = (e.get("filter") or {}).get("response_flag_filter")
+        if filt:
+            msg["filter"] = {"response_flag_filter": {
+                "flags": list(filt.get("flags") or [])}}
+        out.append(msg)
+    return out
+
+
+def _lower_wasm(ftc: dict[str, Any]) -> bytes:
+    """Wasm HTTP filter (wasm extension output)."""
+    pc = ftc.get("config") or {}
+    vm = pc.get("vm_config") or {}
+    code = vm.get("code") or {}
+    if code.get("local"):
+        code_msg: dict[str, Any] = {"local": _data_source(
+            code["local"])}
+    elif code.get("remote"):
+        rem = code["remote"]
+        hu = rem.get("http_uri") or {}
+        code_msg = {"remote": {
+            "http_uri": {"uri": hu.get("uri", ""),
+                         "cluster": hu.get("cluster", ""),
+                         **({"timeout": _duration(hu["timeout"])}
+                            if hu.get("timeout") else {})},
+            "sha256": rem.get("sha256", "")}}
+    else:
+        raise UnloweredShape("wasm plugin without code source")
+    msg: dict[str, Any] = {"config": {
+        "name": pc.get("name", ""),
+        "vm_config": {"vm_id": vm.get("vm_id", ""),
+                      "runtime": vm.get("runtime", ""),
+                      "code": code_msg}}}
+    conf = pc.get("configuration")
+    if conf and conf.get("@type") == STRING_VALUE_TYPE:
+        msg["config"]["configuration"] = {
+            "type_url": STRING_VALUE_TYPE,
+            "value": encode(_STRING_VALUE,
+                            {"value": conf.get("value", "")})}
+    return encode(_WASM, msg)
+
 
 def _lower_ext_authz(ftc: dict[str, Any]) -> bytes:
     """ExtAuthz HTTP filter (ext-authz extension output)."""
@@ -585,6 +728,9 @@ _LISTENER = {
     "name": Field(1, "string"),
     "address": Field(2, "message", _ADDRESS),
     "filter_chains": Field(3, "message", _FILTER_CHAIN, repeated=True),
+    #: listener.proto access_log=22 (the NR-filtered rejected-
+    #: connection logs, accesslogs.go MakeAccessLogs isListener)
+    "access_log": Field(22, "message", _ACCESS_LOG, repeated=True),
 }
 
 
@@ -595,7 +741,13 @@ class UnloweredShape(Exception):
 
 def _duration(s: Any) -> dict[str, int]:
     if isinstance(s, str) and s.endswith("s"):
-        val = float(s[:-1])
+        try:
+            val = float(s[:-1])
+        except ValueError as e:
+            # "500ms" passes endswith("s") but float("500m") raises —
+            # must degrade to the visible JSON fallback, not crash the
+            # whole resource build with an uncaught ValueError
+            raise UnloweredShape(f"duration {s!r}") from e
         return {"seconds": int(val),
                 "nanos": int((val - int(val)) * 1e9)}
     raise UnloweredShape(f"duration {s!r}")
@@ -844,4 +996,6 @@ def lower_listener(lst: dict[str, Any]) -> bytes:
             chain["transport_socket"] = _transport_socket(
                 fc["transport_socket"])
         msg["filter_chains"].append(chain)
+    if lst.get("access_log"):
+        msg["access_log"] = _lower_access_logs(lst["access_log"])
     return encode(_LISTENER, msg)

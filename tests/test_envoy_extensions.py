@@ -392,3 +392,218 @@ def test_remote_jwks_provider_gets_fetch_cluster(agent, client):
         agent.server.handle_rpc("Intention.Apply", {
             "Op": "delete", "Intention": {
                 "SourceName": "mobile", "DestinationName": "web"}}, "t")
+
+
+# ------------------------------------------------------------ access logs
+
+def _set_access_logs(agent, logs):
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "proxy-defaults", "Name": "global",
+            **({"AccessLogs": logs} if logs is not None else {})}}, "t")
+
+
+def test_access_logs_validation(agent):
+    from consul_tpu.server.rpc import RPCError
+
+    with pytest.raises(RPCError, match="stdout/stderr/file"):
+        _set_access_logs(agent, {"Enabled": True, "Type": "syslog"})
+    with pytest.raises(RPCError, match="requires Path"):
+        _set_access_logs(agent, {"Enabled": True, "Type": "file"})
+    with pytest.raises(RPCError, match="only one of"):
+        _set_access_logs(agent, {"Enabled": True,
+                                 "JSONFormat": "{}",
+                                 "TextFormat": "%START_TIME%"})
+    with pytest.raises(RPCError, match="not valid JSON"):
+        _set_access_logs(agent, {"Enabled": True,
+                                 "JSONFormat": "{nope"})
+
+
+def test_access_logs_attach_and_lower(agent, client):
+    """proxy-defaults AccessLogs materialize on every mesh HCM and as
+    NR-filtered listener logs, and lower to true proto (accesslogs.go
+    MakeAccessLogs; HCM access_log=13, Listener access_log=22)."""
+    from consul_tpu.connect.accesslogs import STDERR_TYPE
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    _set_access_logs(agent, {"Enabled": True, "Type": "stderr"})
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        pub = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "public_listener")
+        assert pub["access_log"][0]["filter"][
+            "response_flag_filter"]["flags"] == ["NR"]
+        hcm = next(f for f in pub["filter_chains"][0]["filters"]
+                   if f["name"] == HCM)
+        al = hcm["typed_config"]["access_log"][0]
+        assert al["typed_config"]["@type"] == STDERR_TYPE
+        # default JSON format rides along
+        jf = al["typed_config"]["log_format"]["json_format"]
+        assert jf["start_time"] == "%START_TIME%"
+        # true proto round-trip
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        plst = decode(xp._LISTENER, lds["public_listener"][1])
+        assert plst["access_log"][0]["filter"][
+            "response_flag_filter"]["flags"] == ["NR"]
+        hcms = [f for f in plst["filter_chains"][0]["filters"]
+                if f["typed_config"]["type_url"] == xp.HCM_TYPE]
+        hp = decode(xp._HCM, hcms[0]["typed_config"]["value"])
+        assert hp["access_log"][0]["typed_config"]["type_url"] \
+            == STDERR_TYPE
+        body = decode(xp._STREAM_LOG,
+                      hp["access_log"][0]["typed_config"]["value"])
+        fields = {f["key"]: f["value"] for f in
+                  body["log_format"]["json_format"]["fields"]}
+        assert fields["method"]["string_value"] == "%REQ(:METHOD)%"
+        # DisableListenerLogs strips ONLY the listener-level logs
+        _set_access_logs(agent, {"Enabled": True, "Type": "file",
+                                 "Path": "/tmp/envoy-access.log",
+                                 "DisableListenerLogs": True})
+        cfg = build_config(agent, PROXY_ID)
+        pub = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "public_listener")
+        assert "access_log" not in pub
+        hcm = next(f for f in pub["filter_chains"][0]["filters"]
+                   if f["name"] == HCM)
+        al = hcm["typed_config"]["access_log"][0]
+        assert al["typed_config"]["path"] == "/tmp/envoy-access.log"
+    finally:
+        _set_access_logs(agent, None)
+    cfg = build_config(agent, PROXY_ID)
+    pub = next(l for l in cfg["static_resources"]["listeners"]
+               if l["name"] == "public_listener")
+    assert "access_log" not in pub
+
+
+# ------------------------------------- property-override + wasm built-ins
+
+def test_property_override_patches_cluster(agent, client):
+    """builtin/property-override: add/remove fields on generated
+    resources, with write-time schema validation against the proto
+    lowering (a patch the lowering would drop is rejected)."""
+    errs = validate_extensions([{
+        "Name": "builtin/property-override",
+        "Arguments": {"Patches": [{
+            "ResourceFilter": {"ResourceType": "cluster"},
+            "Op": "add", "Path": "/not_a_field", "Value": 1}]}}])
+    assert errs and "outside the cluster lowering schema" in errs[0]
+
+    from consul_tpu.server.grpc_external import build_config
+
+    _set_extensions(agent, [{
+        "Name": "builtin/property-override",
+        "Arguments": {"Patches": [{
+            "ResourceFilter": {"ResourceType": "cluster",
+                               "TrafficDirection": "outbound"},
+            "Op": "add", "Path": "/connect_timeout",
+            "Value": "33s"}]}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        cl = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+        assert cl["upstream_db_db"]["connect_timeout"] == "33s"
+        # inbound (local_app) untouched by an outbound-scoped patch
+        assert cl["local_app"]["connect_timeout"] == "5s"
+    finally:
+        _set_extensions(agent, [])
+
+
+def test_wasm_filter_and_proto_lowering(agent, client):
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    assert validate_extensions([{
+        "Name": "builtin/wasm", "Arguments": {"Plugin": {}}}])
+    _set_extensions(agent, [{
+        "Name": "builtin/wasm",
+        "Arguments": {"Plugin": {
+            "Name": "auth-shim",
+            "VmConfig": {"Runtime": "wasmtime",
+                         "Code": {"Local": {
+                             "Filename": "/etc/shim.wasm"}}},
+            "Configuration": "{\"mode\": \"strict\"}"}}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        assert "envoy.filters.http.wasm" in _public_http_filters(cfg)
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        pub = decode(xp._LISTENER, lds["public_listener"][1])
+        hcms = [f for f in pub["filter_chains"][0]["filters"]
+                if f["typed_config"]["type_url"] == xp.HCM_TYPE]
+        hcm = decode(xp._HCM, hcms[0]["typed_config"]["value"])
+        wf = [f for f in hcm["http_filters"]
+              if f["typed_config"]["type_url"] == xp.WASM_TYPE]
+        assert wf
+        body = decode(xp._WASM, wf[0]["typed_config"]["value"])
+        assert body["config"]["name"] == "auth-shim"
+        assert body["config"]["vm_config"]["runtime"] \
+            == "envoy.wasm.runtime.wasmtime"
+        assert body["config"]["vm_config"]["code"]["local"][
+            "filename"] == "/etc/shim.wasm"
+        sv = decode(xp._STRING_VALUE,
+                    body["config"]["configuration"]["value"])
+        assert sv["value"] == '{"mode": "strict"}'
+    finally:
+        _set_extensions(agent, [])
+
+
+def test_wasm_remote_code_gets_fetch_cluster(agent, client):
+    """Remote wasm code requires SHA256 and must come with a real
+    fetch cluster, or Envoy could never resolve the download."""
+    errs = validate_extensions([{
+        "Name": "builtin/wasm",
+        "Arguments": {"Plugin": {"VmConfig": {"Code": {"Remote": {
+            "HttpURI": {"URI": "https://cdn.example/shim.wasm"},
+        }}}}}}])
+    assert errs and "SHA256" in errs[0]
+
+    from consul_tpu.server.grpc_external import build_config
+
+    _set_extensions(agent, [{
+        "Name": "builtin/wasm",
+        "Arguments": {"Plugin": {
+            "Name": "cdn-shim",
+            "VmConfig": {"Code": {"Remote": {
+                "HttpURI": {"URI": "https://cdn.example/shim.wasm"},
+                "SHA256": "ab" * 32}}}}}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        cl = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+        assert "wasm_code_cdn-shim" in cl
+        sa = cl["wasm_code_cdn-shim"]["load_assignment"]["endpoints"][
+            0]["lb_endpoints"][0]["endpoint"]["address"][
+            "socket_address"]
+        assert sa == {"address": "cdn.example", "port_value": 443}
+    finally:
+        _set_extensions(agent, [])
+
+
+def test_ext_authz_timeout_validated_at_write(agent):
+    errs = validate_extensions([{
+        "Name": "builtin/ext-authz",
+        "Arguments": {"Config": {
+            "Timeout": "500ms",
+            "GrpcService": {"Target": {"URI": "127.0.0.1:9000"}}}}}])
+    assert errs and "duration" in errs[0]
+
+
+def test_property_override_never_destroys_scalars(agent, client):
+    """An add through a path whose prefix is an existing scalar skips
+    rather than wrecking the resource (review finding)."""
+    from consul_tpu.server.grpc_external import build_config
+
+    _set_extensions(agent, [{
+        "Name": "builtin/property-override",
+        "Arguments": {"Patches": [{
+            "ResourceFilter": {"ResourceType": "cluster"},
+            "Op": "add", "Path": "/connect_timeout/seconds",
+            "Value": 5}]}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        cl = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+        assert cl["local_app"]["connect_timeout"] == "5s"  # untouched
+    finally:
+        _set_extensions(agent, [])
