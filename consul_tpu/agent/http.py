@@ -1141,11 +1141,12 @@ class HTTPApi:
                 return sorted(a.server.endpoints.keys()), None
             return rpc("Status.RPCMethods", {}), None
         if path == "/v1/operator/utilization":
-            # CE build: utilization bundle = usage counts + version
-            # (reporting is an enterprise license feature)
-            usage = rpc("Operator.Usage", {})["Usage"]
+            # utilization bundle = usage counts + the raft-replicated
+            # census snapshot history (consul/reporting census table)
+            res = rpc("Operator.Usage", {})
             return {"Version": __version__,
-                    "Usage": usage,
+                    "Usage": res["Usage"],
+                    "Snapshots": res.get("Censuses") or [],
                     "Generated": True}, None
 
         # -------------------------------------------------------- operator

@@ -1996,7 +1996,12 @@ def register_endpoints(srv) -> None:
             "Sessions": counts.get("sessions", 0),
             "ConnectServiceInstances": counts.get(
                 "connect_instances", 0),
-        }}}
+        }},
+            # census history (reporting.go CensusListAll): the
+            # raft-replicated periodic snapshots behind the
+            # utilization bundle
+            "Censuses": sorted(state.raw_list("censuses"),
+                               key=lambda s: s.get("Timestamp", 0.0))}
 
     read("Operator.Usage", operator_usage)
 

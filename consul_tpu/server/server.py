@@ -256,6 +256,8 @@ class Server:
         # first-failure time per peering, for the stream-down grace
         # window (cleared on each successful end_of_snapshot)
         self._peer_down_since: dict[str, float] = {}
+        # census cadence cache, seeded from the table on leadership
+        self._census_last = 0.0
 
         # L1: replicated state
         self.fsm = FSM()
@@ -1004,6 +1006,14 @@ class Server:
             self._full_reconcile()
             self._ensure_initial_management_token()
             self._write_system_metadata()
+            # seed the census cadence from the replicated table ONCE
+            # per reign — the tick itself must not re-scan the table
+            # every second
+            self._census_last = max(
+                (float(r.get("Timestamp", 0.0))
+                 for r in self.state.raw_list("censuses")),
+                default=0.0)
+        self._reporting_tick()
         # raft membership follows serf server membership (autopilot)
         rows = self._servers()
         servers = {s["rpc_addr"] for s in rows if s["rpc_addr"]}
@@ -1310,6 +1320,45 @@ class Server:
                 "Healthy": False, "Error": error}))
         except Exception:  # noqa: BLE001 — lost leadership mid-mark;
             pass  # the new leader's loop re-detects and re-marks
+
+    #: reporting cadence (consul/reporting/reporting.go: census writes
+    #: on the leader tick, DefaultSnapshotRetention = 400 days).
+    #: Instance attributes so tests can compress the clock.
+    reporting_interval = 3600.0
+    reporting_retention = 400 * 86400.0
+
+    def _reporting_tick(self) -> None:
+        """Census snapshots (ReportingManager's census path): the
+        leader persists periodic usage counts through raft, so the
+        utilization history survives leader changes and restarts and
+        is identical on every replica; old snapshots are pruned to the
+        retention window in the same pass. The cadence gate tolerates
+        wall-clock skew across leaders: a last-written Timestamp in
+        the FUTURE (previous leader's clock ran fast) triggers a write
+        rather than stalling collection until that time arrives."""
+        last = getattr(self, "_census_last", 0.0)
+        now = time.time()
+        if 0 <= now - last < self.reporting_interval:
+            return
+        counts = self.state.usage_counts()
+        snap = {
+            "Timestamp": now,
+            "Datacenter": self.config.datacenter,
+            "Nodes": counts.get("nodes", 0),
+            "ServiceNames": counts.get("service_names", 0),
+            "ServiceInstances": counts.get("services", 0),
+            "ConnectServiceInstances": counts.get(
+                "connect_instances", 0),
+        }
+        try:
+            self.raft.apply(encode_command(MessageType.CENSUS, {
+                "Op": "put", "Snapshot": snap}))
+            self.raft.apply(encode_command(MessageType.CENSUS, {
+                "Op": "prune",
+                "Cutoff": now - self.reporting_retention}))
+            self._census_last = now
+        except Exception:  # noqa: BLE001 — lost leadership mid-write;
+            pass  # the next leader's tick retries
 
     def _flood_join(self) -> None:
         """Flood joiner (server_serf.go FloodJoins): every LAN server's

@@ -43,6 +43,7 @@ class MessageType(enum.IntEnum):
     FEDERATION_STATE = 18
     TOMBSTONE_REAP = 19  # leader-driven KV tombstone GC (Tombstone.Reap)
     RESOURCE = 20  # v2 resource CRUD (internal/storage/raft log ops)
+    CENSUS = 21  # periodic usage snapshots (reporting.go census table)
 
 
 def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
@@ -75,6 +76,7 @@ class FSM:
             MessageType.FEDERATION_STATE: self._apply_federation_state,
             MessageType.TOMBSTONE_REAP: self._apply_tombstone_reap,
             MessageType.RESOURCE: self._apply_resource,
+            MessageType.CENSUS: self._apply_census,
         }
 
     def apply(self, data: bytes, raft_index: int) -> Any:
@@ -472,6 +474,27 @@ class FSM:
                         if str(k).startswith(f"{p.get('Name')}/")]:
                 self.store.raw_delete("imported_services", key)
         return self._raw_op("peerings", ("set",), op, p.get("Name"), p)
+
+    def _apply_census(self, b: dict[str, Any], idx: int) -> Any:
+        """Census usage snapshots (consul/reporting/reporting.go +
+        state censusTableSchema): the leader's reporting tick persists
+        periodic usage counts through raft so every replica carries
+        the same utilization history; prune enforces retention."""
+        op = b.get("Op", "put")
+        if op == "prune":
+            cutoff = float(b.get("Cutoff", 0.0))
+            removed = 0
+            for key in [k for k, v in
+                        self.store.tables["censuses"].items()
+                        if float(v.get("Timestamp", 0.0)) < cutoff]:
+                self.store.raw_delete("censuses", key)
+                removed += 1
+            return removed
+        snap = dict(b.get("Snapshot") or {})
+        # keyed by timestamp: naturally ordered, idempotent on replay
+        return self.store.raw_upsert(
+            "censuses", f"{float(snap.get('Timestamp', 0.0)):.3f}",
+            snap)
 
     def _apply_system_metadata(self, b: dict[str, Any], idx: int) -> Any:
         """Cluster-wide internal key/value metadata

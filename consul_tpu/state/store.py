@@ -39,7 +39,8 @@ RAW_TABLES = ("prepared_queries", "acl_tokens", "acl_policies",
               "config_entries", "intentions", "peerings", "acl_roles",
               "acl_auth_methods", "acl_binding_rules",
               "federation_states", "system_metadata",
-              "peering_trust_bundles", "imported_services")
+              "peering_trust_bundles", "imported_services",
+              "censuses")
 TABLES = ("nodes", "services", "checks", "kv", "sessions",
           "coordinates", "resources") + RAW_TABLES
 

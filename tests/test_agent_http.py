@@ -802,3 +802,48 @@ def test_service_topology_includes_l7_edges(agent, client):
             f"http://{agent.http.addr}/ui") as r:
         body = r.read().decode()
     assert "#topology:" in body and "topology" in body
+
+
+def test_census_reporting_snapshots_and_retention():
+    """Reporting census machinery (consul/reporting/reporting.go +
+    state censusTableSchema): the leader's reporting tick persists
+    usage snapshots through raft on a cadence, prunes past retention,
+    and /v1/operator/utilization serves the history."""
+    import time as _time
+
+    from consul_tpu.agent import Agent
+    from consul_tpu.api import ConsulClient
+    from consul_tpu.config import load
+    from helpers import wait_for
+
+    a = Agent(load(dev=True, overrides={"node_name": "census-a"}))
+    a.server.reporting_interval = 1.0
+    a.server.reporting_retention = 3600.0
+    a.start(serve_dns=False)
+    try:
+        wait_for(lambda: a.server.is_leader(), what="leader")
+        c = ConsulClient(a.http.addr)
+        c.service_register({"Name": "counted", "Port": 1234})
+        wait_for(lambda: len(a.server.state.raw_list("censuses")) >= 2,
+                 timeout=15, what="two census snapshots on cadence")
+        snaps = sorted(a.server.state.raw_list("censuses"),
+                       key=lambda s: s["Timestamp"])
+        assert snaps[-1]["Nodes"] >= 1
+        assert snaps[-1]["Datacenter"] == a.config.datacenter
+        assert snaps[1]["Timestamp"] - snaps[0]["Timestamp"] >= 0.9
+        # retention prune: an ancient snapshot dies on the next tick
+        from consul_tpu.state.fsm import MessageType, encode_command
+
+        a.server.raft.apply(encode_command(MessageType.CENSUS, {
+            "Op": "put", "Snapshot": {
+                "Timestamp": _time.time() - 7200.0, "Nodes": 99}}))
+        wait_for(lambda: not any(
+            s.get("Nodes") == 99
+            for s in a.server.state.raw_list("censuses")),
+            timeout=10, what="stale census pruned")
+        # served through the utilization bundle
+        util = c.get("/v1/operator/utilization")
+        assert util["Snapshots"] and \
+            util["Snapshots"][-1]["Nodes"] >= 1
+    finally:
+        a.shutdown()
