@@ -326,8 +326,12 @@ def bootstrap_config(snapshot: dict[str, Any],
                         cname, t.get("Endpoints", [])),
                 })
         is_http = up.get("Protocol", "tcp") in ("http", "http2", "grpc")
-        if is_http and len(routes) > 1:
-            # service-router → HTTP connection manager + route config
+        if is_http:
+            # HTTP upstreams ALWAYS get a connection manager (xds
+            # listeners.go makeUpstreamListener) — single-route chains
+            # included, so L7 features (lambda/ext filters, retries)
+            # have an HCM to land in; the route config is the chain's
+            # routes with the default catch-all last
             filt = _http_conn_manager(name, routes)
         else:
             # discovery-chain splits → weighted clusters

@@ -97,25 +97,48 @@ def validate_extensions(exts: list[dict[str, Any]]) -> list[str]:
 
 def apply_extensions(cfg: dict[str, Any], snapshot: dict[str, Any]
                      ) -> list[str]:
-    """Run every extension in snapshot["EnvoyExtensions"] over the
-    bootstrap cfg IN PLACE, in declaration order (proxy-defaults before
-    service-defaults — assemble_snapshot stores them merged that way).
+    """Run every extension over the bootstrap cfg IN PLACE:
+
+    1. snapshot["EnvoyExtensions"] — local-service extensions in
+       declaration order (proxy-defaults before service-defaults,
+       assemble_snapshot stores them merged that way);
+    2. each upstream's "EnvoyExtensions" — upstream-sourced configs
+       (extensioncommon.UpstreamEnvoyExtender): applied scoped to that
+       upstream's outbound resources, via update_upstream(). An
+       extension class without update_upstream is local-only and is
+       skipped for upstream-sourced configs (matching the ref, where
+       only Upstream extenders run there).
+
     Returns the list of per-extension errors; a failed non-Required
     extension leaves cfg exactly as the previous step left it."""
     import copy
 
     errors: list[str] = []
-    for ext in snapshot.get("EnvoyExtensions") or []:
+
+    def run(ext: dict[str, Any], upstream: Optional[str]) -> None:
         name = ext.get("Name", "")
         try:
             plugin = construct_extension(ext)
             if not plugin.matches_kind(snapshot.get("Kind",
                                                     "connect-proxy")):
-                continue
+                return
+            if upstream is not None \
+                    and type(plugin).update_upstream \
+                    is EnvoyExtension.update_upstream:
+                return  # local-only extension on an upstream entry
+            if upstream is None \
+                    and type(plugin).update is EnvoyExtension.update:
+                # upstream-only extension (aws-lambda) in the LOCAL
+                # merge — the lambda service's own sidecar carries the
+                # entry too; nothing to do there
+                return
             # apply against a scratch copy: a half-applied mutation
             # from a mid-flight failure must not leak into the output
             scratch = copy.deepcopy(cfg)
-            plugin.update(scratch, snapshot)
+            if upstream is not None:
+                plugin.update_upstream(scratch, snapshot, upstream)
+            else:
+                plugin.update(scratch, snapshot)
             cfg.clear()
             cfg.update(scratch)
         except Exception as e:  # noqa: BLE001 — isolation is the point
@@ -123,6 +146,15 @@ def apply_extensions(cfg: dict[str, Any], snapshot: dict[str, Any]
             if ext.get("Required"):
                 raise ExtensionError(
                     f"required extension {name!r} failed: {e}") from e
+
+    for ext in snapshot.get("EnvoyExtensions") or []:
+        run(ext, None)
+    for up in snapshot.get("Upstreams") or []:
+        if not up.get("Allowed", True):
+            continue  # intention-denied: its resources were never
+            #           materialized, there is nothing to patch
+        for ext in up.get("EnvoyExtensions") or []:
+            run(ext, up.get("DestinationName", ""))
     return errors
 
 
@@ -180,6 +212,15 @@ class EnvoyExtension:
     def update(self, cfg: dict[str, Any],
                snapshot: dict[str, Any]) -> None:  # pragma: no cover
         raise NotImplementedError
+
+    def update_upstream(self, cfg: dict[str, Any],
+                        snapshot: dict[str, Any],
+                        upstream: str) -> None:
+        """Upstream-sourced application (UpstreamEnvoyExtender seam):
+        overridden by extensions that patch the DOWNSTREAM proxy's
+        resources for one upstream. The base marker is how
+        apply_extensions tells local-only extensions apart."""
+        raise NotImplementedError  # pragma: no cover - marker
 
 
 @register("builtin/lua")
@@ -251,43 +292,13 @@ class ExtAuthzExtension(EnvoyExtension):
         self.grpc = bool(grpc)
         self.target = tgt
 
-    def _cluster_name(self, cfg: dict[str, Any]) -> str:
-        svc = (self.target.get("Service") or {}).get("Name")
-        if svc:
-            # reuse the mesh cluster for that upstream. Cluster names
-            # are "upstream_<dest>_<target-service>" (envoy.py) — match
-            # on the upstream prefix, never a bare suffix (a suffix
-            # test would let service "b" capture "upstream_db_db")
-            for c in cfg["static_resources"]["clusters"]:
-                if c["name"].startswith(f"upstream_{svc}_"):
-                    return c["name"]
-            raise ExtensionError(
-                f"ext-authz target service {svc!r} is not an upstream "
-                "of this proxy")
-        uri = self.target["URI"]
-        host, _, port = uri.rpartition(":")
-        cname = "extauthz_" + uri.replace(":", "_").replace("/", "_")
-        if not any(c["name"] == cname
-                   for c in cfg["static_resources"]["clusters"]):
-            cluster = {
-                "name": cname, "type": "STATIC",
-                "connect_timeout": "5s",
-                "load_assignment": {
-                    "cluster_name": cname,
-                    "endpoints": [{"lb_endpoints": [{"endpoint": {
-                        "address": {"socket_address": {
-                            "address": host or "127.0.0.1",
-                            "port_value": int(port or 0)}}}}]}]},
-            }
-            if self.grpc:
-                # gRPC authz requires an HTTP/2 cluster
-                cluster["http2_protocol_options"] = {}
-            cfg["static_resources"]["clusters"].append(cluster)
-        return cname
-
     def update(self, cfg: dict[str, Any],
                snapshot: dict[str, Any]) -> None:
-        cname = self._cluster_name(cfg)
+        # shared target resolution with otel-access-logging; the
+        # http2 flag matters — a gRPC authz service needs an HTTP/2
+        # cluster, a plain HTTP one must NOT get it
+        cname = _grpc_target_cluster(cfg, self.target, "extauthz",
+                                     http2=self.grpc)
         svc_cfg: dict[str, Any]
         if self.grpc:
             svc_cfg = {"grpc_service": {
@@ -373,7 +384,8 @@ class PropertyOverrideExtension(EnvoyExtension):
             key = "clusters" if rtype == "cluster" else "listeners"
             for r in cfg["static_resources"][key]:
                 name = r.get("name", "")
-                if name.startswith(("extauthz_", "jwks_cluster_")):
+                if name.startswith(("extauthz_", "jwks_cluster_",
+                                    "otel_", "wasm_code_")):
                     continue  # other extensions' support resources
                 if rtype == "cluster":
                     inbound = name == "local_app"
@@ -502,6 +514,197 @@ class WasmExtension(EnvoyExtension):
         for _, hcm in _iter_hcms(cfg, self.args.get("Listener",
                                                     "inbound")):
             insert_http_filter(hcm, dict(filt))
+
+
+@register("builtin/aws-lambda")
+class AwsLambdaExtension(EnvoyExtension):
+    """Turn an upstream into an AWS Lambda invocation
+    (builtin/aws-lambda/aws_lambda.go): declared on the LAMBDA
+    service's service-defaults, applied to each caller's outbound
+    resources for it — the cluster is rewritten to
+    lambda.<region>.amazonaws.com:443 over TLS (SNI *.amazonaws.com,
+    egress-gateway metadata) and the outbound HCM gains the
+    envoy.filters.http.aws_lambda filter ahead of the router, with
+    StripAnyHostPort so sigv4 signing validates."""
+
+    def validate(self) -> None:
+        arn = self.args.get("ARN", "")
+        if not arn:
+            raise ExtensionError("ARN is required")
+        parts = str(arn).split(":")
+        # arn:partition:lambda:region:account:function:name
+        if len(parts) < 6 or parts[0] != "arn" or not parts[3]:
+            raise ExtensionError(
+                f"ARN must be arn:<partition>:lambda:<region>:..., "
+                f"got {arn!r}")
+        self.region = parts[3]
+        mode = self.args.get("InvocationMode", "synchronous")
+        if mode not in ("synchronous", "asynchronous"):
+            raise ExtensionError(
+                f"InvocationMode must be synchronous/asynchronous, "
+                f"got {mode!r}")
+        self.mode = mode
+
+    def update_upstream(self, cfg: dict[str, Any],
+                        snapshot: dict[str, Any],
+                        upstream: str) -> None:
+        prefix = f"upstream_{upstream}"
+        res = cfg["static_resources"]
+        # exact cluster names from the upstream's own compiled routes:
+        # a prefix match would also capture a DIFFERENT upstream whose
+        # name extends this one past an underscore ("db" vs
+        # "db_replica" — upstream_db_replica_* starts with
+        # "upstream_db_")
+        up = next((u for u in snapshot.get("Upstreams") or []
+                   if u.get("DestinationName") == upstream), {})
+        targets = {t.get("Service", "")
+                   for route in up.get("Routes") or []
+                   for t in route.get("Targets") or []}
+        targets |= {t.get("Service", "")
+                    for t in up.get("Targets") or []}
+        names = {f"{prefix}_{t}" for t in targets if t} \
+            or {f"{prefix}_{upstream}"}
+        patched_cluster = False
+        for i, c in enumerate(res["clusters"]):
+            if c["name"] not in names:
+                continue
+            res["clusters"][i] = {
+                "name": c["name"],
+                "type": "LOGICAL_DNS",
+                "connect_timeout": c.get("connect_timeout", "5s"),
+                # per-cluster marker the aws_lambda filter requires
+                # (aws_lambda.go PatchCluster metadata)
+                "metadata": {"filter_metadata": {
+                    "com.amazonaws.lambda": {"egress_gateway": True}}},
+                "load_assignment": {
+                    "cluster_name": c["name"],
+                    "endpoints": [{"lb_endpoints": [{"endpoint": {
+                        "address": {"socket_address": {
+                            "address": ("lambda." + self.region
+                                        + ".amazonaws.com"),
+                            "port_value": 443}}}}]}]},
+                "transport_socket": {
+                    "name": "tls",
+                    "typed_config": {
+                        "@type": "type.googleapis.com/envoy."
+                                 "extensions.transport_sockets.tls."
+                                 "v3.UpstreamTlsContext",
+                        "sni": "*.amazonaws.com",
+                        "common_tls_context": {}}},
+            }
+            patched_cluster = True
+        if not patched_cluster:
+            raise ExtensionError(
+                f"no outbound clusters for upstream {upstream!r}")
+        filt = {
+            "name": "envoy.filters.http.aws_lambda",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "filters.http.aws_lambda.v3.Config",
+                "arn": self.args["ARN"],
+                "payload_passthrough": bool(
+                    self.args.get("PayloadPassthrough")),
+                "invocation_mode": self.mode,
+            }}
+        hit = False
+        for lname, hcm in _iter_hcms(cfg, "outbound"):
+            if lname != prefix:
+                continue
+            insert_http_filter(hcm, dict(filt))
+            # sigv4 signs the Host header — a port in it would be
+            # signed too and AWS would reject (aws_lambda.go
+            # PatchFilter StripAnyHostPort)
+            hcm["strip_any_host_port"] = True
+            hit = True
+        if not hit:
+            raise ExtensionError(
+                f"upstream {upstream!r} has no HTTP listener — lambda "
+                "upstreams need service-defaults Protocol http")
+
+
+@register("builtin/otel-access-logging")
+class OtelAccessLoggingExtension(EnvoyExtension):
+    """Ship access logs to an OpenTelemetry collector over gRPC
+    (builtin/otel-access-logging): appends an OpenTelemetry access
+    logger to the matching HCMs, targeting an upstream service's mesh
+    cluster or an explicit URI."""
+
+    def validate(self) -> None:
+        lst = self.args.get("Listener", "inbound")
+        if lst not in ("", "inbound", "outbound"):
+            raise ExtensionError(
+                f"Listener must be inbound/outbound, got {lst!r}")
+        cfg = self.args.get("Config") or {}
+        tgt = (cfg.get("GrpcService") or {}).get("Target") or {}
+        if not tgt.get("URI") and not (tgt.get("Service") or {}).get(
+                "Name"):
+            raise ExtensionError(
+                "Config.GrpcService.Target needs URI or Service.Name")
+        uri = tgt.get("URI")
+        if uri:
+            host, _, port = str(uri).rpartition(":")
+            if not host or not port.isdigit():
+                raise ExtensionError(
+                    f"Target.URI must be host:port, got {uri!r}")
+        self.target = tgt
+
+    def update(self, cfg: dict[str, Any],
+               snapshot: dict[str, Any]) -> None:
+        cname = _grpc_target_cluster(cfg, self.target, "otel")
+        log_name = (self.args.get("Config") or {}).get(
+            "LogName", "otel-access-log")
+        entry = {
+            "name": "envoy.access_loggers.open_telemetry",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "access_loggers.open_telemetry.v3."
+                         "OpenTelemetryAccessLogConfig",
+                "common_config": {
+                    "log_name": log_name,
+                    "transport_api_version": "V3",
+                    "grpc_service": {"envoy_grpc": {
+                        "cluster_name": cname}},
+                },
+            }}
+        for _, hcm in _iter_hcms(cfg, self.args.get("Listener",
+                                                    "inbound")):
+            hcm.setdefault("access_log", []).append(dict(entry))
+
+
+def _grpc_target_cluster(cfg: dict[str, Any], target: dict[str, Any],
+                         kind: str, http2: bool = True) -> str:
+    """Resolve a service Target to a cluster name: an existing mesh
+    upstream cluster for Service.Name, or a dedicated STATIC cluster
+    minted from a host:port URI (shared between ext-authz and
+    otel-access-logging targets). http2 marks gRPC targets — plain
+    HTTP authz services must not get an HTTP/2-only cluster."""
+    svc = (target.get("Service") or {}).get("Name")
+    if svc:
+        for c in cfg["static_resources"]["clusters"]:
+            if c["name"].startswith(f"upstream_{svc}_"):
+                return c["name"]
+        raise ExtensionError(
+            f"{kind} target service {svc!r} is not an upstream of "
+            "this proxy")
+    uri = target["URI"]
+    host, _, port = uri.rpartition(":")
+    cname = f"{kind}_" + uri.replace(":", "_").replace("/", "_")
+    if not any(c["name"] == cname
+               for c in cfg["static_resources"]["clusters"]):
+        cluster: dict[str, Any] = {
+            "name": cname, "type": "STATIC",
+            "connect_timeout": "5s",
+            "load_assignment": {
+                "cluster_name": cname,
+                "endpoints": [{"lb_endpoints": [{"endpoint": {
+                    "address": {"socket_address": {
+                        "address": host or "127.0.0.1",
+                        "port_value": int(port or 0)}}}}]}]},
+        }
+        if http2:
+            cluster["http2_protocol_options"] = {}
+        cfg["static_resources"]["clusters"].append(cluster)
+    return cname
 
 
 # ------------------------------------------------------------- JWT authn

@@ -90,6 +90,13 @@ def assemble_snapshot(agent, proxy_id: str,
     upstreams = []
     for u in proxy.proxy.get("Upstreams") or []:
         uname = u.get("DestinationName", "")
+        # upstream-sourced extensions (extensioncommon
+        # UpstreamEnvoyExtender, IsSourcedFromUpstream=true): the
+        # UPSTREAM's service-defaults extensions apply to THIS proxy's
+        # outbound resources for it — how builtin/aws-lambda turns an
+        # upstream into a lambda call without the caller knowing
+        u_sd = get_entry("service-defaults", uname) or {}
+        u_exts = list(u_sd.get("EnvoyExtensions") or [])
         error = ""
         # discovery chain: L7 routes + splitter weights + resolver
         # redirects; the LAST route is the default catch-all
@@ -116,6 +123,7 @@ def assemble_snapshot(agent, proxy_id: str,
             "DestinationName": uname,
             "LocalBindPort": u.get("LocalBindPort", 0),
             "Allowed": check.get("Allowed", False),
+            "EnvoyExtensions": u_exts,
             "Error": error,
             "Protocol": chain["Protocol"],
             "Routes": chain["Routes"],

@@ -115,6 +115,10 @@ _CLUSTER = {
     #: message presence marks a gRPC-capable upstream
     "http2_protocol_options": Field(14, "message", {}, presence=True),
     "transport_socket": Field(24, "message", _TRANSPORT_SOCKET),
+    #: core.Metadata (cluster.proto metadata=25) — the aws-lambda
+    #: extension's egress-gateway marker rides here; spec filled in
+    #: after the Struct schema exists (access-logs section)
+    "metadata": None,
     "load_assignment": Field(33, "message", CLA),
 }
 _CLUSTER_TYPE_ENUM = {"STATIC": 0, "STRICT_DNS": 1, "LOGICAL_DNS": 2,
@@ -253,6 +257,14 @@ _JWT_AUTHN = {
 JWT_AUTHN_TYPE = ("type.googleapis.com/envoy.extensions.filters.http."
                   "jwt_authn.v3.JwtAuthentication")
 
+#: filters/http/aws_lambda/v3 Config: arn=1, payload_passthrough=2,
+#: invocation_mode=3 (SYNCHRONOUS=0, ASYNCHRONOUS=1)
+_AWS_LAMBDA = {"arn": Field(1, "string"),
+               "payload_passthrough": Field(2, "bool"),
+               "invocation_mode": Field(3, "enum")}
+AWS_LAMBDA_TYPE = ("type.googleapis.com/envoy.extensions.filters."
+                   "http.aws_lambda.v3.Config")
+
 #: wasm (extensions/wasm/v3/wasm.proto + filters/http/wasm/v3):
 #: RemoteDataSource http_uri=1, sha256=2; AsyncDataSource local=1,
 #: remote=2; VmConfig vm_id=1, runtime=2, code=3; PluginConfig name=1,
@@ -303,6 +315,22 @@ _ACCESSLOG_FILTER = {"response_flag_filter":
 _ACCESS_LOG = {"name": Field(1, "string"),
                "filter": Field(2, "message", _ACCESSLOG_FILTER),
                "typed_config": Field(4, "message", _ANY)}
+#: access_loggers/grpc/v3/als.proto CommonGrpcAccessLogConfig:
+#: log_name=1, grpc_service=2, transport_api_version=6;
+#: open_telemetry.v3 OpenTelemetryAccessLogConfig: common_config=1
+_COMMON_GRPC_LOG = {"log_name": Field(1, "string"),
+                    "grpc_service": Field(2, "message", _GRPC_SERVICE),
+                    "transport_api_version": Field(6, "enum")}
+_OTEL_LOG = {"common_config": Field(1, "message", _COMMON_GRPC_LOG)}
+OTEL_LOG_TYPE = ("type.googleapis.com/envoy.extensions."
+                 "access_loggers.open_telemetry.v3."
+                 "OpenTelemetryAccessLogConfig")
+#: config.core.v3.Metadata: filter_metadata=1 (map<string, Struct>)
+_METADATA_ENTRY = {"key": Field(1, "string"),
+                   "value": Field(2, "message", _STRUCT)}
+_METADATA = {"filter_metadata": Field(1, "message", _METADATA_ENTRY,
+                                      repeated=True)}
+_CLUSTER["metadata"] = Field(25, "message", _METADATA)
 
 # ------------------------------------------------- HTTP / route configs
 # config.route.v3 (route.proto, route_components.proto) + the HTTP
@@ -393,6 +421,9 @@ _HCM = {
     # Filter schema below - one spec serves both
     "http_filters": None,  # filled after _FILTER is defined
     "access_log": Field(13, "message", _ACCESS_LOG, repeated=True),
+    #: oneof strip_port_mode: strip_any_host_port=42 (the aws-lambda
+    #: extension sets it so sigv4 Host-header signing validates)
+    "strip_any_host_port": Field(42, "bool"),
 }
 HCM_TYPE = ("type.googleapis.com/envoy.extensions.filters.network."
             "http_connection_manager.v3.HttpConnectionManager")
@@ -536,6 +567,14 @@ def _lower_hcm(tc: dict[str, Any]) -> bytes:
             blob = _lower_jwt_authn(ftc)
         elif at == WASM_TYPE:
             blob = _lower_wasm(ftc)
+        elif at == AWS_LAMBDA_TYPE:
+            blob = encode(_AWS_LAMBDA, {
+                "arn": ftc.get("arn", ""),
+                "payload_passthrough": bool(
+                    ftc.get("payload_passthrough")),
+                # SYNCHRONOUS=0, ASYNCHRONOUS=1
+                "invocation_mode": 1 if ftc.get("invocation_mode")
+                == "asynchronous" else 0})
         else:
             raise UnloweredShape(f"http filter {at!r}")
         filters.append({"name": f.get("name", ""),
@@ -547,6 +586,8 @@ def _lower_hcm(tc: dict[str, Any]) -> bytes:
         "http_filters": filters}
     if tc.get("access_log"):
         msg["access_log"] = _lower_access_logs(tc["access_log"])
+    if tc.get("strip_any_host_port"):
+        msg["strip_any_host_port"] = True
     return encode(_HCM, msg)
 
 def _pb_struct(d: dict[str, Any]) -> dict[str, Any]:
@@ -589,6 +630,14 @@ def _lower_access_logs(entries: list[dict[str, Any]]
                                       "log_format": sf})
         elif at in (STDOUT_TYPE, STDERR_TYPE):
             blob = encode(_STREAM_LOG, {"log_format": sf})
+        elif at == OTEL_LOG_TYPE:
+            cc = tc.get("common_config") or {}
+            gs = (cc.get("grpc_service") or {}).get("envoy_grpc") or {}
+            blob = encode(_OTEL_LOG, {"common_config": {
+                "log_name": cc.get("log_name", ""),
+                "grpc_service": {"envoy_grpc": {
+                    "cluster_name": gs.get("cluster_name", "")}},
+                "transport_api_version": 2}})  # V3
         else:
             raise UnloweredShape(f"access log sink {at!r}")
         msg: dict[str, Any] = {
@@ -866,6 +915,11 @@ def lower_cluster(c: dict[str, Any]) -> bytes:
     if c.get("http2_protocol_options") is not None:
         # gRPC upstreams (ext-authz extension): empty message presence
         msg["http2_protocol_options"] = {}
+    if c.get("metadata"):
+        msg["metadata"] = {"filter_metadata": [
+            {"key": k, "value": _pb_struct(v)}
+            for k, v in sorted((c["metadata"].get("filter_metadata")
+                                or {}).items())]}
     return encode(_CLUSTER, msg)
 
 

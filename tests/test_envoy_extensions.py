@@ -607,3 +607,108 @@ def test_property_override_never_destroys_scalars(agent, client):
         assert cl["local_app"]["connect_timeout"] == "5s"  # untouched
     finally:
         _set_extensions(agent, [])
+
+
+# --------------------------------------- upstream-sourced: aws-lambda
+
+def test_aws_lambda_upstream_sourced(agent, client):
+    """builtin/aws-lambda (aws_lambda.go): declared on the LAMBDA
+    service's own service-defaults, applied to each CALLER's outbound
+    resources — cluster rewritten to the regional lambda endpoint over
+    TLS with the egress-gateway metadata marker, aws_lambda HTTP
+    filter ahead of the router, StripAnyHostPort for sigv4."""
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (CDS_TYPE, LDS_TYPE,
+                                                 build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    ARN = "arn:aws:lambda:us-east-1:123456789012:function:billing"
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-defaults", "Name": "db",
+            "Protocol": "http",
+            "EnvoyExtensions": [{"Name": "builtin/aws-lambda",
+                                 "Arguments": {"ARN": ARN}}]}}, "t")
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        cl = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+        lam = cl["upstream_db_db"]
+        sa = lam["load_assignment"]["endpoints"][0]["lb_endpoints"][0][
+            "endpoint"]["address"]["socket_address"]
+        assert sa == {"address": "lambda.us-east-1.amazonaws.com",
+                      "port_value": 443}
+        assert lam["transport_socket"]["typed_config"]["sni"] \
+            == "*.amazonaws.com"
+        assert lam["metadata"]["filter_metadata"][
+            "com.amazonaws.lambda"]["egress_gateway"] is True
+        # outbound HCM: lambda filter before router + port stripping
+        up = next(l for l in cfg["static_resources"]["listeners"]
+                  if l["name"] == "upstream_db")
+        hcm = up["filter_chains"][0]["filters"][0]["typed_config"]
+        names = [f["name"] for f in hcm["http_filters"]]
+        assert names.index("envoy.filters.http.aws_lambda") \
+            < names.index("envoy.filters.http.router")
+        assert hcm["strip_any_host_port"] is True
+        # true-proto round trips for cluster AND listener
+        cds = resources_from_cfg(cfg, CDS_TYPE)
+        cmsg = decode(xp._CLUSTER, cds["upstream_db_db"][1])
+        md = {e["key"]: e["value"] for e in
+              cmsg["metadata"]["filter_metadata"]}
+        flds = {f["key"]: f["value"]
+                for f in md["com.amazonaws.lambda"]["fields"]}
+        assert flds["egress_gateway"]["bool_value"] is True
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        lmsg = decode(xp._LISTENER, lds["upstream_db"][1])
+        hmsg = decode(xp._HCM, lmsg["filter_chains"][0]["filters"][0][
+            "typed_config"]["value"])
+        assert hmsg["strip_any_host_port"] is True
+        lf = [f for f in hmsg["http_filters"]
+              if f["typed_config"]["type_url"] == xp.AWS_LAMBDA_TYPE]
+        body = decode(xp._AWS_LAMBDA, lf[0]["typed_config"]["value"])
+        assert body["arn"] == ARN
+    finally:
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "service-defaults", "Name": "db",
+                "Protocol": "http"}}, "t")
+
+
+def test_otel_access_logging_extension(agent, client):
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    _set_extensions(agent, [{
+        "Name": "builtin/otel-access-logging",
+        "Arguments": {"Config": {
+            "LogName": "mesh-logs",
+            "GrpcService": {"Target": {"URI": "127.0.0.1:4317"}}}}}])
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        pub = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "public_listener")
+        hcm = next(f for f in pub["filter_chains"][0]["filters"]
+                   if f["name"] == HCM)["typed_config"]
+        otel = [a for a in hcm.get("access_log", [])
+                if a["name"] == "envoy.access_loggers.open_telemetry"]
+        assert otel
+        cname = otel[0]["typed_config"]["common_config"][
+            "grpc_service"]["envoy_grpc"]["cluster_name"]
+        assert any(c["name"] == cname
+                   for c in cfg["static_resources"]["clusters"])
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        pmsg = decode(xp._LISTENER, lds["public_listener"][1])
+        hmsg = decode(xp._HCM, next(
+            f for f in pmsg["filter_chains"][0]["filters"]
+            if f["typed_config"]["type_url"] == xp.HCM_TYPE)[
+            "typed_config"]["value"])
+        ob = [a for a in hmsg["access_log"]
+              if a["typed_config"]["type_url"] == xp.OTEL_LOG_TYPE]
+        body = decode(xp._OTEL_LOG, ob[0]["typed_config"]["value"])
+        assert body["common_config"]["log_name"] == "mesh-logs"
+        assert body["common_config"]["grpc_service"]["envoy_grpc"][
+            "cluster_name"] == cname
+    finally:
+        _set_extensions(agent, [])
