@@ -171,9 +171,14 @@ def validate_entry(entry: dict) -> None:
                     "terminating-gateway service requires Name")
     elif kind == "jwt-provider":
         # structs.JWTProviderConfigEntry Validate: a provider must be
-        # nameable from intentions and carry a key set to verify with
+        # nameable from intentions and carry a key set to verify with.
+        # Issuer is required here because RBAC claim enforcement pins
+        # metadata[payload].iss == Issuer — an empty issuer would make
+        # every referencing intention unsatisfiable
         if not entry.get("Name"):
             raise ValueError("jwt-provider requires Name")
+        if not entry.get("Issuer"):
+            raise ValueError("jwt-provider requires Issuer")
         jwks = entry.get("JSONWebKeySet")
         if not isinstance(jwks, dict) or not (
                 (jwks.get("Local") or {}).get("JWKS")

@@ -73,6 +73,46 @@ def _rbac_filters(intentions: list[dict[str, Any]],
     return filters
 
 
+def _jwt_principal(jwt: Optional[dict[str, Any]],
+                   providers: dict[str, Any]) -> Optional[dict[str, Any]]:
+    """RBAC principal enforcing an intention's JWT requirement
+    (xds rbac.go addJWTPrincipal): the jwt_authn filter VALIDATES
+    tokens and stamps claims into dynamic metadata under
+    jwt_payload_<provider>; RBAC then requires metadata[payload].iss
+    == the provider's Issuer AND every VerifyClaims path == its value.
+    Multiple providers OR together. None when the intention carries no
+    resolvable JWT requirement."""
+    def meta(path_keys: list[str], value: str) -> dict[str, Any]:
+        return {"metadata": {
+            "filter": "envoy.filters.http.jwt_authn",
+            "path": [{"key": k} for k in path_keys],
+            "value": {"string_match": {"exact": value}}}}
+
+    provs = (jwt or {}).get("Providers") or []
+    if not provs:
+        return None
+    ps = []
+    for prov in provs:
+        name = prov.get("Name", "")
+        issuer = (providers.get(name) or {}).get("Issuer")
+        if not issuer:
+            continue  # unresolved: counted below, fails closed
+        key = f"jwt_payload_{name}"
+        p = meta([key, "iss"], issuer)
+        claims = [meta([key] + list(c.get("Path") or []),
+                       c.get("Value", ""))
+                  for c in prov.get("VerifyClaims") or []]
+        if claims:
+            p = {"and_ids": {"ids": [p] + claims}}
+        ps.append(p)
+    if not ps:
+        # providers are NAMED but none resolve (deleted entry, missing
+        # issuer): the requirement must fail CLOSED — an unmatchable
+        # principal, never a silent waiver
+        return {"not_id": {"any": True}}
+    return ps[0] if len(ps) == 1 else {"or_ids": {"ids": ps}}
+
+
 def _http_rbac(action: str,
                policies: dict[str, Any]) -> dict[str, Any]:
     return {
@@ -84,25 +124,43 @@ def _http_rbac(action: str,
 
 
 def _rbac_http_filters(intentions: list[dict[str, Any]],
-                       default_allow: bool) -> list[dict[str, Any]]:
+                       default_allow: bool,
+                       jwt_providers: Optional[dict[str, Any]] = None
+                       ) -> list[dict[str, Any]]:
     """HTTP-layer intention enforcement (xds rbac.go
     makeRBACHTTPFilter): same two-filter precedence structure as the
     network form, but sources with L7 Permissions get REAL per-request
     permission lists instead of any/deny. Once a source defines
     permissions, its unmatched requests are denied (the docs'
     "permissions default-deny"), which is why in default-allow mode an
-    L7 source contributes NOT(any of its allows) to the DENY filter."""
+    L7 source contributes NOT(any of its allows) to the DENY filter.
+
+    Intention-level JWT requirements are ENFORCED here (rbac.go
+    addJWTPrincipal): the jwt_authn filter upstream only validates and
+    stamps claims — the source principal is AND'd with metadata
+    matchers over jwt_payload_<provider> (issuer + VerifyClaims), so a
+    request without the required valid token never matches the allow
+    policy (or, under default-allow, matches a deny policy).
+    Permission-level JWT providers ride the validation filter but
+    claim enforcement is at intention granularity."""
     from consul_tpu.connect.intentions import rbac_policy_permissions
 
+    jwt_providers = jwt_providers or {}
     intentions = intentions or []
-    l4_allows = [i["SourceName"] for i in intentions
-                 if not i.get("Permissions")
-                 and i.get("Action", "allow") == "allow"]
+    l4_allow_ixns = [i for i in intentions
+                     if not i.get("Permissions")
+                     and i.get("Action", "allow") == "allow"]
     l4_denies = [i["SourceName"] for i in intentions
                  if not i.get("Permissions")
                  and i.get("Action") == "deny"]
-    l7 = [(i["SourceName"], i.get("Permissions") or [])
-          for i in intentions if i.get("Permissions")]
+    l7 = [i for i in intentions if i.get("Permissions")]
+
+    def src_principal(i: dict[str, Any]) -> dict[str, Any]:
+        p = _spiffe_principal(i["SourceName"])
+        jp = _jwt_principal(i.get("JWT"), jwt_providers)
+        if jp is not None:
+            p = {"and_ids": {"ids": [p, jp]}}
+        return p
 
     filters = []
     deny_policies: dict[str, Any] = {}
@@ -121,8 +179,10 @@ def _rbac_http_filters(intentions: list[dict[str, Any]],
         # removeSourcePrecedence folds these in as not_id principals)
         exact_named = [i["SourceName"] for i in intentions
                        if i.get("SourceName", "*") != "*"]
-        for n, (src, perms) in enumerate(l7):
-            allows = rbac_policy_permissions(perms)
+        for n, i in enumerate(l7):
+            src = i["SourceName"]
+            allows = rbac_policy_permissions(i.get("Permissions")
+                                             or [], jwt_providers)
             perm = {"not_rule": {"or_rules": {"rules": allows}}} \
                 if allows else {"any": True}
             principal = _spiffe_principal(src)
@@ -133,22 +193,41 @@ def _rbac_http_filters(intentions: list[dict[str, Any]],
             deny_policies[f"consul-intentions-layer7-{n}"] = {
                 "permissions": [perm],
                 "principals": [principal]}
+        # default-allow + JWT-gated intention: requests from that
+        # source WITHOUT the required valid token are denied outright.
+        # Same wildcard precedence folding as the L7 loop above: a
+        # '*' JWT intention must not deny sources holding their own
+        # higher-precedence exact intentions
+        for n, i in enumerate(l4_allow_ixns + l7):
+            jp = _jwt_principal(i.get("JWT"), jwt_providers)
+            if jp is None:
+                continue
+            src_p = _spiffe_principal(i["SourceName"])
+            if i["SourceName"] == "*" and exact_named:
+                src_p = {"and_ids": {"ids": [src_p] + [
+                    {"not_id": _spiffe_principal(t)}
+                    for t in exact_named]}}
+            deny_policies[f"consul-intentions-jwt-{n}"] = {
+                "permissions": [{"any": True}],
+                "principals": [{"and_ids": {"ids": [
+                    src_p, {"not_id": jp}]}}]}
     if deny_policies:
         filters.append(_http_rbac("DENY", deny_policies))
     if effective_deny:
         allow_policies: dict[str, Any] = {}
-        if l4_allows:
+        if l4_allow_ixns:
             allow_policies["consul-intentions-layer4"] = {
                 "permissions": [{"any": True}],
-                "principals": [_spiffe_principal(s)
-                               for s in l4_allows]}
-        for n, (src, perms) in enumerate(l7):
-            allows = rbac_policy_permissions(perms)
+                "principals": [src_principal(i)
+                               for i in l4_allow_ixns]}
+        for n, i in enumerate(l7):
+            allows = rbac_policy_permissions(i.get("Permissions")
+                                             or [], jwt_providers)
             if not allows:
                 continue  # only denies: nothing to grant
             allow_policies[f"consul-intentions-layer7-{n}"] = {
                 "permissions": allows,
-                "principals": [_spiffe_principal(src)]}
+                "principals": [src_principal(i)]}
         filters.append(_http_rbac("ALLOW", allow_policies))
     return filters
 
@@ -274,7 +353,8 @@ def bootstrap_config(snapshot: dict[str, Any],
     if is_http:
         inbound = [_public_hcm(
             snapshot.get("Intentions") or [],
-            snapshot.get("DefaultAllow", True))]
+            snapshot.get("DefaultAllow", True),
+            snapshot.get("JWTProviders") or {})]
     else:
         inbound = _rbac_filters(
             snapshot.get("Intentions") or [],
@@ -534,7 +614,9 @@ def _tcp_filter(stat_prefix: str, cluster_prefix: str,
 
 
 def _public_hcm(intentions: list[dict[str, Any]],
-                default_allow: bool) -> dict[str, Any]:
+                default_allow: bool,
+                jwt_providers: Optional[dict[str, Any]] = None
+                ) -> dict[str, Any]:
     """Inbound HTTP connection manager: RBAC http filters (the L7
     intention enforcement point) ahead of the router, one catch-all
     route to the local app (xds listeners.go makeInboundListener)."""
@@ -546,7 +628,8 @@ def _public_hcm(intentions: list[dict[str, Any]],
                      "HttpConnectionManager",
             "stat_prefix": "public_listener",
             "http_filters": _rbac_http_filters(intentions,
-                                               default_allow) + [{
+                                               default_allow,
+                                               jwt_providers) + [{
                 "name": "envoy.filters.http.router",
                 "typed_config": {
                     "@type": "type.googleapis.com/envoy.extensions."

@@ -44,6 +44,34 @@ def validate_intention(i: dict[str, Any]) -> None:
             "permission list")
     if i.get("Action") not in (None, "", "allow", "deny"):
         raise ValueError(f"invalid Action {i.get('Action')!r}")
+
+    def check_jwt(jwt: Any, where: str) -> None:
+        # IntentionJWTRequirement (config_entry_intentions.go:331):
+        # named providers, optional VerifyClaims of Path+Value
+        if jwt is None:
+            return
+        if not isinstance(jwt, dict):
+            raise ValueError(f"{where}JWT must be a map")
+        for pn, prov in enumerate(jwt.get("Providers") or []):
+            if not isinstance(prov, dict) or not prov.get("Name"):
+                raise ValueError(
+                    f"{where}JWT.Providers[{pn}]: Name is required")
+            for cn, c in enumerate(prov.get("VerifyClaims") or []):
+                ok = (isinstance(c, dict)
+                      and isinstance(c.get("Path"), list)
+                      and c["Path"]
+                      and all(isinstance(s, str) and s
+                              for s in c["Path"])
+                      and isinstance(c.get("Value"), str)
+                      and c["Value"])
+                if not ok:
+                    raise ValueError(
+                        f"{where}JWT.Providers[{pn}]."
+                        f"VerifyClaims[{cn}]: Path (non-empty "
+                        "strings) and Value (non-empty string) are "
+                        "required")
+
+    check_jwt(i.get("JWT"), "")
     for n, p in enumerate(perms):
         if p.get("Action") not in ("allow", "deny"):
             raise ValueError(
@@ -81,6 +109,7 @@ def validate_intention(i: dict[str, Any]) -> None:
             raise ValueError(
                 f"Permissions[{n}]: at least one of path, Header or "
                 "Methods is required")
+        check_jwt(p.get("JWT"), f"Permissions[{n}].")
 
 
 def precedence(i: dict[str, Any]) -> int:
@@ -259,7 +288,9 @@ def l7_permission_to_rbac(p: dict[str, Any]) -> dict[str, Any]:
 
 
 def rbac_policy_permissions(
-        permissions: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        permissions: list[dict[str, Any]],
+        jwt_providers: Optional[dict[str, Any]] = None
+        ) -> list[dict[str, Any]]:
     """Ordered L7 permissions → the ALLOW-policy permission list for
     one source principal, with precedence flattened exactly as the
     struct documents (config_entry_intentions.go:226-237): each allow
@@ -273,11 +304,57 @@ def rbac_policy_permissions(
         if p.get("Action") == "deny":
             denies.append(rp)
             continue
-        if denies:
+        extra: list[dict[str, Any]] = []
+        jwt_rule = jwt_claims_permission(p.get("JWT"),
+                                         jwt_providers or {})
+        if jwt_rule is not None:
+            # permission-level JWT (rbac.go jwtInfosToPermission):
+            # the allow matches only when the claims do too
+            extra.append(jwt_rule)
+        if denies or extra:
             # flatten an existing AND instead of nesting one
             base = rp["and_rules"]["rules"] if set(rp) == {"and_rules"} \
                 else [rp]
-            rp = {"and_rules": {"rules": base + [
+            rp = {"and_rules": {"rules": base + extra + [
                 {"not_rule": d} for d in denies]}}
         out.append(rp)
     return out
+
+
+def jwt_claims_permission(jwt: Optional[dict[str, Any]],
+                          providers: dict[str, Any]
+                          ) -> Optional[dict[str, Any]]:
+    """RBAC Permission rule for a JWT requirement (rbac.go
+    jwtInfosToPermission): per provider AND(issuer, VerifyClaims) over
+    the jwt_payload_<name> dynamic metadata, providers OR'd. None when
+    no JWT requirement; an UNMATCHABLE rule (fail closed) when
+    providers are named but none resolve to a usable config entry —
+    a deleted provider must never silently waive the requirement."""
+    provs = (jwt or {}).get("Providers") or []
+    if not provs:
+        return None
+
+    def meta(path_keys: list[str], value: str) -> dict[str, Any]:
+        return {"metadata": {
+            "filter": "envoy.filters.http.jwt_authn",
+            "path": [{"key": k} for k in path_keys],
+            "value": {"string_match": {"exact": value}}}}
+
+    rules = []
+    for prov in provs:
+        name = prov.get("Name", "")
+        issuer = (providers.get(name) or {}).get("Issuer")
+        if not issuer:
+            continue  # unresolved: counted below, fails closed
+        key = f"jwt_payload_{name}"
+        r = meta([key, "iss"], issuer)
+        claims = [meta([key] + list(c.get("Path") or []),
+                       c.get("Value", ""))
+                  for c in prov.get("VerifyClaims") or []]
+        if claims:
+            r = {"and_rules": {"rules": [r] + claims}}
+        rules.append(r)
+    if not rules:
+        return {"not_rule": {"any": True}}  # matches nothing
+    return rules[0] if len(rules) == 1 else {
+        "or_rules": {"rules": rules}}

@@ -1577,6 +1577,19 @@ def register_endpoints(srv) -> None:
                 validate_intention(i)
             except ValueError as ex:
                 raise RPCError(str(ex)) from ex
+            # referenced jwt-providers must EXIST (jwt_authn.go:
+            # "provider specified in intention does not exist") — a
+            # typo'd name would otherwise fail closed at enforcement
+            # time with no hint why requests are denied
+            from consul_tpu.connect.extensions import \
+                collect_jwt_provider_names
+
+            for pname in collect_jwt_provider_names([i]):
+                if state.raw_get("config_entries",
+                                 f"jwt-provider/{pname}") is None:
+                    raise RPCError(
+                        f"provider specified in intention does not "
+                        f"exist. Provider name: {pname}")
             if i.get("Permissions"):
                 # L7 permissions need an L7 destination: without an
                 # http-ish protocol there is no request to match

@@ -143,7 +143,9 @@ TCP_PROXY_TYPE = ("type.googleapis.com/envoy.extensions.filters."
 _PRINCIPAL_AUTHENTICATED = {
     "principal_name": Field(2, "message", _STRING_MATCHER)}
 #: config.rbac.v3 Principal: and_ids=1, or_ids=2, any=3,
-#: authenticated=4, not_id=8 (self-referential, patched below)
+#: authenticated=4, metadata=7 (MetadataMatcher — JWT claims
+#: enforcement, patched in after the matcher specs exist), not_id=8
+#: (self-referential, patched below)
 _PRINCIPAL: dict = {"any": Field(3, "bool"),
                     "authenticated": Field(4, "message",
                                            _PRINCIPAL_AUTHENTICATED)}
@@ -361,6 +363,21 @@ _HEADER_MATCHER = {
 _PATH_MATCHER = {"path": Field(1, "message", _STRING_MATCHER_RE)}
 _PERMISSION["header"] = Field(4, "message", _HEADER_MATCHER)
 _PERMISSION["url_path"] = Field(10, "message", _PATH_MATCHER)
+#: type.matcher.v3.MetadataMatcher (metadata.proto): filter=1,
+#: path=2 (PathSegment key=1), value=3 (ValueMatcher: string_match=3)
+#: — the RBAC principal arm JWT claim checks lower through
+#: (rbac.go segmentToPrincipal)
+_PATH_SEGMENT = {"key": Field(1, "string")}
+_VALUE_MATCHER = {"string_match": Field(3, "message",
+                                        _STRING_MATCHER_RE)}
+_METADATA_MATCHER = {"filter": Field(1, "string"),
+                     "path": Field(2, "message", _PATH_SEGMENT,
+                                   repeated=True),
+                     "value": Field(3, "message", _VALUE_MATCHER)}
+_PRINCIPAL["metadata"] = Field(7, "message", _METADATA_MATCHER)
+#: Permission.metadata=7 too (permission-level JWT claims,
+#: rbac.go jwtInfosToPermission)
+_PERMISSION["metadata"] = Field(7, "message", _METADATA_MATCHER)
 
 #: QueryParameterMatcher: name=1, string_match=5, present_match=6
 _QUERY_MATCHER = {
@@ -946,6 +963,15 @@ def _lower_rbac_permission(p: dict[str, Any]) -> dict[str, Any]:
         if h.get("invert_match"):
             out["invert_match"] = True
         return {"header": out}
+    if keys == {"metadata"}:
+        # permission-level JWT claims (jwt_claims_permission)
+        m = p["metadata"] or {}
+        return {"metadata": {
+            "filter": m.get("filter", ""),
+            "path": [{"key": s.get("key", "")}
+                     for s in m.get("path") or []],
+            "value": {"string_match": _string_match(
+                (m.get("value") or {}).get("string_match") or {})}}}
     if keys == {"and_rules"} or keys == {"or_rules"}:
         (kind, rules), = p.items()
         return {kind: {"rules": [_lower_rbac_permission(r)
@@ -978,6 +1004,14 @@ def _lower_rbac_rules(rules: dict[str, Any]) -> dict[str, Any]:
 def _lower_rbac_principal(pr: dict[str, Any]) -> dict[str, Any]:
     if pr.get("any"):
         return {"any": True}
+    if pr.get("metadata"):
+        m = pr["metadata"]
+        return {"metadata": {
+            "filter": m.get("filter", ""),
+            "path": [{"key": s.get("key", "")}
+                     for s in m.get("path") or []],
+            "value": {"string_match": _string_match(
+                (m.get("value") or {}).get("string_match") or {})}}}
     if pr.get("authenticated"):
         return {"authenticated": {
             "principal_name": {

@@ -117,10 +117,15 @@ def test_config_entry_write_rejects_bad_extension(agent, client):
 def test_jwt_provider_entry_validation(agent):
     from consul_tpu.server.rpc import RPCError
 
-    with pytest.raises(RPCError, match="JSONWebKeySet"):
+    with pytest.raises(RPCError, match="Issuer"):
         agent.server.handle_rpc("ConfigEntry.Apply", {
             "Op": "upsert", "Entry": {
                 "Kind": "jwt-provider", "Name": "okta"}}, "t")
+    with pytest.raises(RPCError, match="JSONWebKeySet"):
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "jwt-provider", "Name": "okta",
+                "Issuer": "https://okta.example"}}, "t")
 
 
 # ------------------------------------------------------------------- lua
@@ -712,3 +717,181 @@ def test_otel_access_logging_extension(agent, client):
             "cluster_name"] == cname
     finally:
         _set_extensions(agent, [])
+
+
+def test_jwt_claims_enforced_in_rbac(agent, client):
+    """Intention-level JWT requirements are ENFORCED by RBAC metadata
+    principals (rbac.go addJWTPrincipal): the allow policy's source
+    principal ANDs metadata[jwt_payload_<prov>].iss == Issuer plus
+    every VerifyClaims path == value — jwt_authn alone only validates
+    tokens, it never decides allow/deny."""
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "jwt-provider", "Name": "corp",
+            "Issuer": "https://corp.example",
+            "JSONWebKeySet": {"Local": {"JWKS": JWKS}}}}, "t")
+    # default policy is allow in dev mode: flip effective default with
+    # a wildcard deny so an ALLOW filter materializes
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "*", "DestinationName": "web",
+            "Action": "deny"}}, "t")
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "api", "DestinationName": "web",
+            "Action": "allow",
+            "JWT": {"Providers": [{
+                "Name": "corp",
+                "VerifyClaims": [{"Path": ["aud"],
+                                  "Value": "web"}]}]}}}, "t")
+    try:
+        cfg = build_config(agent, PROXY_ID)
+        pub = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "public_listener")
+        hcm = next(f for f in pub["filter_chains"][0]["filters"]
+                   if f["name"] == HCM)["typed_config"]
+        allow = next(f for f in hcm["http_filters"]
+                     if f["name"] == "envoy.filters.http.rbac"
+                     and f["typed_config"]["rules"]["action"]
+                     == "ALLOW")
+        pol = allow["typed_config"]["rules"]["policies"][
+            "consul-intentions-layer4"]
+        pr = pol["principals"][0]
+        ids = pr["and_ids"]["ids"]
+        assert ids[0]["authenticated"]  # SPIFFE identity first
+        jwt_and = ids[1]["and_ids"]["ids"]
+        iss = jwt_and[0]["metadata"]
+        assert iss["filter"] == "envoy.filters.http.jwt_authn"
+        assert [s["key"] for s in iss["path"]] \
+            == ["jwt_payload_corp", "iss"]
+        assert iss["value"]["string_match"]["exact"] \
+            == "https://corp.example"
+        claim = jwt_and[1]["metadata"]
+        assert [s["key"] for s in claim["path"]] \
+            == ["jwt_payload_corp", "aud"]
+        assert claim["value"]["string_match"]["exact"] == "web"
+        # true-proto round trip of the metadata principal
+        lds = resources_from_cfg(cfg, LDS_TYPE)
+        pmsg = decode(xp._LISTENER, lds["public_listener"][1])
+        hmsg = decode(xp._HCM, next(
+            f for f in pmsg["filter_chains"][0]["filters"]
+            if f["typed_config"]["type_url"] == xp.HCM_TYPE)[
+            "typed_config"]["value"])
+        allow_f = [f for f in hmsg["http_filters"]
+                   if f["typed_config"]["type_url"]
+                   == xp.HTTP_RBAC_TYPE]
+        assert allow_f, "RBAC must survive proto lowering"
+        rules = [decode(xp._HTTP_RBAC, f["typed_config"]["value"])
+                 for f in allow_f]
+        allow_rules = next(r["rules"] for r in rules
+                           if r["rules"].get("action", 0) == 0)
+        l4pol = next(p["value"] for p in allow_rules["policies"]
+                     if p["key"] == "consul-intentions-layer4")
+        jm = l4pol["principals"][0]["and_ids"]["ids"][1]["and_ids"][
+            "ids"][0]["metadata"]
+        assert jm["filter"] == "envoy.filters.http.jwt_authn"
+        assert [s["key"] for s in jm["path"]] \
+            == ["jwt_payload_corp", "iss"]
+        # deleted provider FAILS CLOSED: the requirement becomes an
+        # unmatchable principal, never a silent waiver
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "delete", "Entry": {
+                "Kind": "jwt-provider", "Name": "corp"}}, "t")
+        cfg = build_config(agent, PROXY_ID)
+        pub = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "public_listener")
+        hcm = next(f for f in pub["filter_chains"][0]["filters"]
+                   if f["name"] == HCM)["typed_config"]
+        allow = next(f for f in hcm["http_filters"]
+                     if f["name"] == "envoy.filters.http.rbac"
+                     and f["typed_config"]["rules"]["action"]
+                     == "ALLOW")
+        pr = allow["typed_config"]["rules"]["policies"][
+            "consul-intentions-layer4"]["principals"][0]
+        assert pr["and_ids"]["ids"][1] == {"not_id": {"any": True}}
+    finally:
+        for src in ("*", "api"):
+            agent.server.handle_rpc("Intention.Apply", {
+                "Op": "delete", "Intention": {
+                    "SourceName": src,
+                    "DestinationName": "web"}}, "t")
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "delete", "Entry": {
+                "Kind": "jwt-provider", "Name": "corp"}}, "t")
+
+
+def test_intention_jwt_validation(agent):
+    from consul_tpu.server.rpc import RPCError
+
+    with pytest.raises(RPCError, match="Name is required"):
+        agent.server.handle_rpc("Intention.Apply", {
+            "Op": "upsert", "Intention": {
+                "SourceName": "x", "DestinationName": "web",
+                "Action": "allow",
+                "JWT": {"Providers": [{}]}}}, "t")
+    with pytest.raises(RPCError, match="VerifyClaims"):
+        agent.server.handle_rpc("Intention.Apply", {
+            "Op": "upsert", "Intention": {
+                "SourceName": "x", "DestinationName": "web",
+                "Action": "allow",
+                "JWT": {"Providers": [{
+                    "Name": "corp",
+                    "VerifyClaims": [{"Path": []}]}]}}}, "t")
+
+
+def test_permission_level_jwt_enforced(agent, client):
+    """Permissions[n].JWT is AND'd into that permission's RBAC rule
+    (rbac.go jwtInfosToPermission) — a tokenless request matching the
+    path must not satisfy the allow."""
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "jwt-provider", "Name": "corp2",
+            "Issuer": "https://corp2.example",
+            "JSONWebKeySet": {"Local": {"JWKS": JWKS}}}}, "t")
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "*", "DestinationName": "web",
+            "Action": "deny"}}, "t")
+    agent.server.handle_rpc("Intention.Apply", {
+        "Op": "upsert", "Intention": {
+            "SourceName": "api", "DestinationName": "web",
+            "Permissions": [{
+                "Action": "allow",
+                "HTTP": {"PathPrefix": "/admin"},
+                "JWT": {"Providers": [{"Name": "corp2"}]}}]}}, "t")
+    try:
+        from consul_tpu.server.grpc_external import build_config
+
+        cfg = build_config(agent, PROXY_ID)
+        pub = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "public_listener")
+        hcm = next(f for f in pub["filter_chains"][0]["filters"]
+                   if f["name"] == HCM)["typed_config"]
+        allow = next(f for f in hcm["http_filters"]
+                     if f["name"] == "envoy.filters.http.rbac"
+                     and f["typed_config"]["rules"]["action"]
+                     == "ALLOW")
+        pol = next(v for k, v in
+                   allow["typed_config"]["rules"]["policies"].items()
+                   if k.startswith("consul-intentions-layer7"))
+        perm = pol["permissions"][0]
+        rules = perm["and_rules"]["rules"]
+        # path rule AND the jwt issuer metadata rule
+        assert any("url_path" in str(r) for r in rules)
+        metas = [r for r in rules if "metadata" in r]
+        assert metas and metas[0]["metadata"]["path"][0]["key"] \
+            == "jwt_payload_corp2"
+    finally:
+        for src in ("*", "api"):
+            agent.server.handle_rpc("Intention.Apply", {
+                "Op": "delete", "Intention": {
+                    "SourceName": src,
+                    "DestinationName": "web"}}, "t")
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "delete", "Entry": {
+                "Kind": "jwt-provider", "Name": "corp2"}}, "t")
