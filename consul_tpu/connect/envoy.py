@@ -422,6 +422,58 @@ def bootstrap_config(snapshot: dict[str, Any],
             "filter_chains": [{"filters": [filt]}],
         })
 
+    # exposed paths (xds listeners.go makeExposedCheckListener):
+    # PLAINTEXT listeners — no mTLS transport socket — each routing
+    # exactly its configured path to the local app's path port, so a
+    # non-mesh health checker can probe without a client cert while
+    # everything else on the app stays unreachable
+    for ep in snapshot.get("ExposePaths") or []:
+        try:
+            lport = int(ep.get("ListenerPort") or 0)
+            lpp = int(ep.get("LocalPathPort") or 0)
+        except (TypeError, ValueError):
+            continue  # non-numeric registration data
+        path = ep.get("Path") or "/"
+        if not lport or not lpp or not path.startswith("/"):
+            continue  # unbuildable entry: skip, never a broken listener
+        cname = f"exposed_cluster_{lpp}"
+        if not any(c["name"] == cname for c in clusters):
+            clusters.append({
+                "name": cname, "type": "STATIC",
+                "connect_timeout": "5s",
+                "load_assignment": _endpoints(cname, [{
+                    "Address": "127.0.0.1", "Port": lpp}]),
+            })
+        slug = path.strip("/").replace("/", "_") or "root"
+        lname = f"exposed_path_{slug}_{lport}"
+        listeners.append({
+            "name": lname,
+            "address": _addr(pub["Address"], lport),
+            "filter_chains": [{"filters": [{
+                "name": "envoy.filters.network."
+                        "http_connection_manager",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "filters.network."
+                             "http_connection_manager.v3."
+                             "HttpConnectionManager",
+                    "stat_prefix": lname,
+                    "http_filters": [{
+                        "name": "envoy.filters.http.router",
+                        "typed_config": {
+                            "@type": "type.googleapis.com/envoy."
+                                     "extensions.filters.http."
+                                     "router.v3.Router"}}],
+                    "route_config": {
+                        "name": lname,
+                        "virtual_hosts": [{
+                            "name": lname, "domains": ["*"],
+                            "routes": [{
+                                "match": {"path": path},
+                                "route": {"cluster": cname}}]}]},
+                }}]}],
+        })
+
     cfg = {
         "admin": {"address": _addr("127.0.0.1", admin_port)},
         "node": {"id": snapshot["ProxyID"],

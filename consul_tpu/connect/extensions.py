@@ -165,6 +165,10 @@ def _iter_hcms(cfg: dict[str, Any], which: str):
     resources (local_app, admin, SDS secrets) are never touched."""
     for lst in cfg.get("static_resources", {}).get("listeners") or []:
         lname = lst.get("name", "")
+        if lname.startswith("exposed_path_"):
+            # plaintext check-exposure listeners are NOT mesh traffic:
+            # no extension, jwt, or access-log pass may touch them
+            continue
         inbound = not lname.startswith("upstream_")
         if which == "inbound" and not inbound:
             continue
@@ -385,8 +389,11 @@ class PropertyOverrideExtension(EnvoyExtension):
             for r in cfg["static_resources"][key]:
                 name = r.get("name", "")
                 if name.startswith(("extauthz_", "jwks_cluster_",
-                                    "otel_", "wasm_code_")):
-                    continue  # other extensions' support resources
+                                    "otel_", "wasm_code_",
+                                    "exposed_path_",
+                                    "exposed_cluster_")):
+                    continue  # other extensions' support resources +
+                    #           plaintext check-exposure (non-mesh)
                 if rtype == "cluster":
                     inbound = name == "local_app"
                 else:

@@ -133,6 +133,59 @@ def assemble_snapshot(agent, proxy_id: str,
                           for e in t.get("Endpoints", [])],
         })
 
+    # Expose paths (structs Proxy.Expose + xds listeners.go
+    # makeExposedCheckListener): plaintext listeners that route ONE
+    # path to the local app, so non-mesh health checkers (kubelet)
+    # can probe through the proxy without client certs. Checks=true
+    # auto-derives paths from the destination service's HTTP checks,
+    # allocating listener ports from the reference's exposed-port
+    # range (agent.go 21500+).
+    expose = dict(proxy.proxy.get("Expose") or {})
+    expose_paths = [dict(p) for p in expose.get("Paths") or []]
+    if expose.get("Checks") and dest_id:
+        # dest_id gate: an empty DestinationServiceID would match
+        # node-level checks (service_id == "") and expose endpoints
+        # that belong to no service
+        import urllib.parse as _up
+
+        # agent-wide allocator (agent.go exposed-port range 21500+):
+        # ports must be stable across snapshot rebuilds AND unique
+        # across every proxy on this agent and the user's own
+        # configured ListenerPorts — a collision is a bind failure
+        alloc: dict = getattr(agent, "_exposed_port_alloc", None)
+        if alloc is None:
+            alloc = {}
+            agent._exposed_port_alloc = alloc
+        def _safe_port(v: Any) -> int:
+            try:
+                return int(v or 0)
+            except (TypeError, ValueError):
+                return 0
+
+        used = set(alloc.values()) | {
+            _safe_port(p.get("ListenerPort")) for p in expose_paths}
+        for cid, chk in sorted(agent.local.list_checks().items()):
+            if chk.service_id != dest_id:
+                continue
+            url = getattr(getattr(agent, "_runners", {}).get(cid),
+                          "url", "")
+            u = _up.urlparse(url) if url else None
+            if not u or not u.port:
+                continue
+            key = (proxy_id, cid)
+            port = alloc.get(key)
+            if port is None:
+                port = 21500
+                while port in used:
+                    port += 1
+                alloc[key] = port
+                used.add(port)
+            expose_paths.append({
+                "Path": u.path or "/",
+                "LocalPathPort": u.port,
+                "ListenerPort": port,
+                "Protocol": "http"})
+
     matches = rpc("Intention.Match", {"DestinationName": dest_name})
     default_allow = not agent.config.acl_enabled \
         or agent.config.acl_default_policy == "allow"
@@ -179,6 +232,7 @@ def assemble_snapshot(agent, proxy_id: str,
         "EnvoyExtensions": extensions,
         "JWTProviders": jwt_providers,
         "AccessLogs": pd.get("AccessLogs") or {},
+        "ExposePaths": expose_paths,
     }
 
 

@@ -537,3 +537,57 @@ def test_cross_sign_chain_passes_real_path_validation():
         [x509.load_pem_x509_certificate(bridge.encode())])
     # verified through old root -> bridge -> leaf
     assert chain.subjects is not None
+
+
+def test_expose_paths_listeners(agent, client):
+    """Proxy.Expose.Paths (xds listeners.go makeExposedCheckListener):
+    plaintext listeners routing ONE path to the local app so non-mesh
+    health checkers reach it without client certs; Expose.Checks=true
+    auto-derives paths from the service's HTTP checks."""
+    client.service_register({
+        "Name": "metrics-app", "ID": "m1", "Port": 7100,
+        "Check": {"HTTP": "http://127.0.0.1:7100/healthz",
+                  "Interval": "60s"},
+        "Connect": {"SidecarService": {"Proxy": {"Expose": {
+            "Checks": True,
+            "Paths": [{"Path": "/metrics", "LocalPathPort": 7100,
+                       "ListenerPort": 21999,
+                       "Protocol": "http"}]}}}}})
+    wait_for(lambda: client.health_service("metrics-app"),
+             what="metrics-app in catalog")
+    from consul_tpu.server.grpc_external import build_config
+
+    cfg = build_config(agent, "m1-sidecar-proxy")
+    listeners = {l["name"]: l
+                 for l in cfg["static_resources"]["listeners"]}
+    exp = listeners["exposed_path_metrics_21999"]
+    assert exp["address"]["socket_address"]["port_value"] == 21999
+    chain = exp["filter_chains"][0]
+    assert "transport_socket" not in chain  # PLAINTEXT by design
+    hcm = chain["filters"][0]["typed_config"]
+    route = hcm["route_config"]["virtual_hosts"][0]["routes"][0]
+    assert route["match"] == {"path": "/metrics"}
+    assert route["route"]["cluster"] == "exposed_cluster_7100"
+    assert any(c["name"] == "exposed_cluster_7100"
+               for c in cfg["static_resources"]["clusters"])
+    # Checks=true derived the health check's path on the 21500 range
+    derived = [n for n in listeners if n.startswith(
+        "exposed_path_healthz_215")]
+    assert derived, f"no derived check listener in {list(listeners)}"
+    # mesh filters must never leak onto exposure listeners: the HCM
+    # carries only the router
+    assert [f["name"] for f in hcm["http_filters"]] \
+        == ["envoy.filters.http.router"]
+    # and it lowers to true proto
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (LDS_TYPE,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    lds = resources_from_cfg(cfg, LDS_TYPE)
+    msg = decode(xp._LISTENER, lds["exposed_path_metrics_21999"][1])
+    r = decode(xp._HCM, msg["filter_chains"][0]["filters"][0][
+        "typed_config"]["value"])["route_config"]["virtual_hosts"][0][
+        "routes"][0]
+    assert r["match"]["path"] == "/metrics"
+    client.service_deregister("m1")
