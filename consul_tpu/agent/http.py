@@ -3,9 +3,11 @@
 Reference: agent/http.go + http_register.go (130 routes; the serving
 core implemented here). Wire-compatible behaviors: blocking queries via
 ``?index=&wait=``, ``X-Consul-Index`` response headers, consistency
-params (``?stale``/``?consistent``), base64 KV values, ``?raw``,
-``?recurse``, ``?keys``, CAS params, session ops, txn, agent-local
-registration endpoints, events, operator endpoints, and /v1/status.
+params (``?stale``/``?consistent``), ``?filter=`` go-bexpr expressions
+on the catalog/health/agent list endpoints (utils/bexpr.py), base64 KV
+values, ``?raw``, ``?recurse``, ``?keys``, CAS params, session ops,
+txn, agent-local registration endpoints, events, operator endpoints,
+and /v1/status.
 """
 
 from __future__ import annotations
@@ -238,6 +240,23 @@ class HTTPApi:
             except json.JSONDecodeError as e:
                 raise HTTPError(400, f"invalid JSON body: {e}") from e
 
+        def filtered(rows: Any) -> Any:
+            """?filter= go-bexpr evaluation over list results (and the
+            agent's id->record maps), http.go parseFilter + the ~20
+            filterable list endpoints. Absent filter: passthrough."""
+            expr = q.get("filter", "")
+            if not expr:
+                return rows
+            from consul_tpu.utils.bexpr import (FilterError,
+                                                compile_filter)
+            try:
+                f = compile_filter(expr)
+                if isinstance(rows, dict):
+                    return {k: v for k, v in rows.items() if f(v)}
+                return [r for r in rows or [] if f(r)]
+            except FilterError as e:
+                raise HTTPError(400, f"invalid filter: {e}") from e
+
         # --------------------------------------------------------------- UI
         if path == "/" or path == "/ui" or path.startswith("/ui/"):
             # the web UI (agent/uiserver pattern): one self-contained
@@ -280,11 +299,13 @@ class HTTPApi:
         if path == "/v1/agent/metrics":
             return telemetry.default.snapshot(), None
         if path == "/v1/agent/services":
-            return {sid: {**s.to_service_dict()}
-                    for sid, s in a.local.list_services().items()}, None
+            return filtered(
+                {sid: {**s.to_service_dict()}
+                 for sid, s in a.local.list_services().items()}), None
         if path == "/v1/agent/checks":
-            return {cid: {**c.to_check_dict(), "Node": a.name}
-                    for cid, c in a.local.list_checks().items()}, None
+            return filtered(
+                {cid: {**c.to_check_dict(), "Node": a.name}
+                 for cid, c in a.local.list_checks().items()}), None
         if path == "/v1/agent/service/register" and method in ("PUT",
                                                                "POST"):
             body = jbody()
@@ -429,7 +450,7 @@ class HTTPApi:
             return rpc("Catalog.ListDatacenters", {}), None
         if path == "/v1/catalog/nodes":
             res = rpc("Catalog.ListNodes", blocking_args())
-            return res["Nodes"], res["Index"]
+            return filtered(res["Nodes"]), res["Index"]
         if path == "/v1/catalog/services":
             res = rpc("Catalog.ListServices", blocking_args())
             return res["Services"], res["Index"]
@@ -441,7 +462,7 @@ class HTTPApi:
             if "near" in q:
                 args["Near"] = q["near"]
             res = rpc("Catalog.ServiceNodes", args)
-            return res["ServiceNodes"], res["Index"]
+            return filtered(res["ServiceNodes"]), res["Index"]
         if (m := re.match(r"^/v1/catalog/node/(.+)$", path)):
             res = rpc("Catalog.NodeServices", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
@@ -497,7 +518,7 @@ class HTTPApi:
                 "ServiceTag": q.get("tag", ""),
                 "Near": q.get("near", ""),
                 "MustBePassing": "passing" in q}))
-            return res["Nodes"], res.get("Index")
+            return filtered(res["Nodes"]), res.get("Index")
         if (m := re.match(r"^/v1/health/ingress/(.+)$", path)):
             # health of the INGRESS GATEWAYS fronting a service
             # (health_endpoint.go IngressServiceNodes)
@@ -532,7 +553,7 @@ class HTTPApi:
             if "peer" in q:
                 args["Peer"] = q["peer"]
                 res = rpc("Health.ServiceNodesPeer", args)
-                return res["Nodes"], res.get("Index")
+                return filtered(res["Nodes"]), res.get("Index")
             if a.config.use_streaming_backend and "dc" not in q \
                     and not any(
                     k in args for k in ("ServiceTag", "MustBePassing",
@@ -550,21 +571,21 @@ class HTTPApi:
                 result, idx = view.get(
                     min_index=args.get("MinQueryIndex", 0),
                     timeout=wait_s)
-                return result or [], idx
+                return filtered(result or []), idx
             res = rpc("Health.ServiceNodes", args)
-            return res["Nodes"], res["Index"]
+            return filtered(res["Nodes"]), res["Index"]
         if (m := re.match(r"^/v1/health/node/(.+)$", path)):
             res = rpc("Health.NodeChecks", blocking_args(
                 {"Node": urllib.parse.unquote(m.group(1))}))
-            return res["HealthChecks"], res["Index"]
+            return filtered(res["HealthChecks"]), res["Index"]
         if (m := re.match(r"^/v1/health/checks/(.+)$", path)):
             res = rpc("Health.ServiceChecks", blocking_args(
                 {"ServiceName": urllib.parse.unquote(m.group(1))}))
-            return res["HealthChecks"], res["Index"]
+            return filtered(res["HealthChecks"]), res["Index"]
         if (m := re.match(r"^/v1/health/state/(.+)$", path)):
             res = rpc("Health.ChecksInState", blocking_args(
                 {"State": urllib.parse.unquote(m.group(1))}))
-            return res["HealthChecks"], res["Index"]
+            return filtered(res["HealthChecks"]), res["Index"]
 
         # -------------------------------------------------------------- KV
         if (m := re.match(r"^/v1/kv/(.*)$", path)):

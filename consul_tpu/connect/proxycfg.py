@@ -15,6 +15,12 @@ from typing import Any, Optional
 GATEWAY_KINDS = ("ingress-gateway", "terminating-gateway",
                  "mesh-gateway")
 
+# guards the per-agent exposed-port allocator (Expose.Checks):
+# snapshot assembly runs concurrently on the xDS server's executor
+import threading  # noqa: E402
+
+_EXPOSED_PORT_LOCK = threading.Lock()
+
 
 def _entry_getter(rpc):
     def get_entry(kind: str, name: str):
@@ -151,40 +157,51 @@ def assemble_snapshot(agent, proxy_id: str,
         # agent-wide allocator (agent.go exposed-port range 21500+):
         # ports must be stable across snapshot rebuilds AND unique
         # across every proxy on this agent and the user's own
-        # configured ListenerPorts — a collision is a bind failure
-        alloc: dict = getattr(agent, "_exposed_port_alloc", None)
-        if alloc is None:
-            alloc = {}
-            agent._exposed_port_alloc = alloc
+        # configured ListenerPorts — a collision is a bind failure.
+        # Snapshots assemble concurrently (the xDS executor), so the
+        # allocator state lives under one lock; entries whose proxy
+        # or check is gone are pruned, or churn would leak the range.
         def _safe_port(v: Any) -> int:
             try:
                 return int(v or 0)
             except (TypeError, ValueError):
                 return 0
 
-        used = set(alloc.values()) | {
-            _safe_port(p.get("ListenerPort")) for p in expose_paths}
-        for cid, chk in sorted(agent.local.list_checks().items()):
-            if chk.service_id != dest_id:
-                continue
-            url = getattr(getattr(agent, "_runners", {}).get(cid),
-                          "url", "")
-            u = _up.urlparse(url) if url else None
-            if not u or not u.port:
-                continue
-            key = (proxy_id, cid)
-            port = alloc.get(key)
-            if port is None:
-                port = 21500
-                while port in used:
-                    port += 1
-                alloc[key] = port
-                used.add(port)
-            expose_paths.append({
-                "Path": u.path or "/",
-                "LocalPathPort": u.port,
-                "ListenerPort": port,
-                "Protocol": "http"})
+        with _EXPOSED_PORT_LOCK:
+            alloc = getattr(agent, "_exposed_port_alloc", None)
+            if alloc is None:
+                alloc = {}
+                agent._exposed_port_alloc = alloc
+            checks = agent.local.list_checks()
+            live_proxies = set(agent.local.list_services())
+            for key in [k for k in alloc
+                        if k[0] not in live_proxies
+                        or k[1] not in checks]:
+                del alloc[key]
+            used = set(alloc.values()) | {
+                _safe_port(p.get("ListenerPort"))
+                for p in expose_paths}
+            for cid, chk in sorted(checks.items()):
+                if chk.service_id != dest_id:
+                    continue
+                url = getattr(getattr(agent, "_runners", {}).get(cid),
+                              "url", "")
+                u = _up.urlparse(url) if url else None
+                if not u or not u.port:
+                    continue
+                key = (proxy_id, cid)
+                port = alloc.get(key)
+                if port is None:
+                    port = 21500
+                    while port in used:
+                        port += 1
+                    alloc[key] = port
+                    used.add(port)
+                expose_paths.append({
+                    "Path": u.path or "/",
+                    "LocalPathPort": u.port,
+                    "ListenerPort": port,
+                    "Protocol": "http"})
 
     matches = rpc("Intention.Match", {"DestinationName": dest_name})
     default_allow = not agent.config.acl_enabled \

@@ -1,0 +1,277 @@
+"""Boolean filter expressions over JSON-shaped records.
+
+Reference: the HTTP API's `?filter=` parameter evaluates go-bexpr
+expressions (hashicorp/go-bexpr; agent/http.go parseFilter feeds ~20
+list endpoints). This is a from-scratch evaluator for the documented
+grammar over plain dict/list records:
+
+    expr     := or
+    or       := and ( "or" and )*
+    and      := unary ( "and" unary )*
+    unary    := "not" unary | "(" expr ")" | match
+    match    := selector op value
+              | value ("in" | "not in") selector
+              | selector ("is empty" | "is not empty")
+              | selector ("contains" | "not contains") value
+              | selector ("matches" | "not matches") value
+              | selector                (bare truthiness, bexpr-style)
+    op       := "==" | "!="
+    selector := ident ( "." ident | "[" quoted "]" )*
+    value    := "quoted" | 'quoted' | bare-token
+
+Selectors walk nested dicts (map fields like Meta use the same dot or
+index syntax); `in`/`contains` test list membership, substring on
+strings, and key presence on maps — go-bexpr semantics. Comparisons
+coerce numbers so `Port == 8080` works against int fields.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+
+class FilterError(ValueError):
+    """Malformed filter expression (surfaces as HTTP 400)."""
+
+
+_TOKEN = re.compile(r"""
+    \s*(
+        \(|\)|
+        "(?:[^"\\]|\\.)*"|
+        '(?:[^'\\]|\\.)*'|
+        \[|\]|\.|
+        ==|!=|
+        [^\s()\[\].=!]+
+    )""", re.X)
+
+
+def _tokenize(src: str) -> list[str]:
+    out, i = [], 0
+    while i < len(src):
+        m = _TOKEN.match(src, i)
+        if m is None:
+            if src[i:].strip():
+                raise FilterError(f"bad token at {src[i:]!r}")
+            break
+        out.append(m.group(1))
+        i = m.end()
+    return out
+
+
+def _unquote(tok: str) -> str:
+    q = tok[0]
+    return tok[1:-1].replace("\\" + q, q).replace("\\\\", "\\")
+
+
+def _is_quoted(tok: str) -> bool:
+    return len(tok) >= 2 and tok[0] in "\"'" and tok[-1] == tok[0]
+
+
+class _Parser:
+    def __init__(self, tokens: list[str]) -> None:
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        tok = self.peek()
+        if tok is None:
+            raise FilterError("unexpected end of expression")
+        self.i += 1
+        return tok
+
+    def expect(self, want: str) -> None:
+        tok = self.next()
+        if tok != want:
+            raise FilterError(f"expected {want!r}, got {tok!r}")
+
+    # ------------------------------------------------------- grammar
+    def parse(self) -> Callable[[Any], bool]:
+        f = self.or_expr()
+        if self.peek() is not None:
+            raise FilterError(f"trailing input at {self.peek()!r}")
+        return f
+
+    def or_expr(self) -> Callable[[Any], bool]:
+        left = self.and_expr()
+        while self.peek() == "or":
+            self.next()
+            right = self.and_expr()
+            left = (lambda a, b: lambda rec: a(rec) or b(rec))(
+                left, right)
+        return left
+
+    def and_expr(self) -> Callable[[Any], bool]:
+        left = self.unary()
+        while self.peek() == "and":
+            self.next()
+            right = self.unary()
+            left = (lambda a, b: lambda rec: a(rec) and b(rec))(
+                left, right)
+        return left
+
+    def unary(self) -> Callable[[Any], bool]:
+        tok = self.peek()
+        if tok == "not":
+            self.next()
+            inner = self.unary()
+            return lambda rec: not inner(rec)
+        if tok == "(":
+            self.next()
+            inner = self.or_expr()
+            self.expect(")")
+            return inner
+        return self.match()
+
+    RESERVED = {"and", "or", "not", "in", "is", "empty",
+                "contains", "matches", "(", ")", "[", "]", ".",
+                "==", "!="}
+
+    def selector(self) -> list[str]:
+        def ident() -> str:
+            tok = self.next()
+            if _is_quoted(tok) or tok in self.RESERVED:
+                raise FilterError(
+                    f"expected selector segment, got {tok!r}")
+            return tok
+
+        path = [ident()]
+        while True:
+            if self.peek() == ".":
+                self.next()
+                path.append(ident())
+            elif self.peek() == "[":
+                self.next()
+                key = self.next()
+                if not _is_quoted(key):
+                    raise FilterError(
+                        f"index must be quoted, got {key!r}")
+                path.append(_unquote(key))
+                self.expect("]")
+            else:
+                return path
+
+    def match(self) -> Callable[[Any], bool]:
+        tok = self.peek()
+        if tok is None:
+            raise FilterError("unexpected end of expression")
+        if _is_quoted(tok):
+            # "<value>" in <selector> | "<value>" not in <selector>
+            value = _unquote(self.next())
+            op = self.next()
+            if op == "not":
+                self.expect("in")
+                path = self.selector()
+                return lambda rec: not _contains(_get(rec, path),
+                                                 value)
+            if op != "in":
+                raise FilterError(f"expected in/not in, got {op!r}")
+            path = self.selector()
+            return lambda rec: _contains(_get(rec, path), value)
+
+        path = self.selector()
+        op = self.peek()
+        if op == "==":
+            self.next()
+            value = self.value()
+            return lambda rec: _eq(_get(rec, path), value)
+        if op == "!=":
+            self.next()
+            value = self.value()
+            return lambda rec: not _eq(_get(rec, path), value)
+        if op == "is":
+            self.next()
+            neg = self.peek() == "not"
+            if neg:
+                self.next()
+            self.expect("empty")
+            return (lambda rec: not _empty(_get(rec, path))) if neg \
+                else (lambda rec: _empty(_get(rec, path)))
+        if op in ("contains", "matches"):
+            self.next()
+            value = self.value()
+            if op == "contains":
+                return lambda rec: _contains(_get(rec, path), value)
+            rx = _regex(value)
+            return lambda rec: bool(rx.search(_as_str(_get(rec,
+                                                           path))))
+        if op == "not" and self.toks[self.i + 1: self.i + 2] in (
+                ["contains"], ["matches"]):
+            self.next()
+            kind = self.next()
+            value = self.value()
+            if kind == "contains":
+                return lambda rec: not _contains(_get(rec, path),
+                                                 value)
+            rx = _regex(value)
+            return lambda rec: not rx.search(_as_str(_get(rec, path)))
+        # bare selector: truthy test (bexpr allows boolean fields)
+        return lambda rec: bool(_get(rec, path))
+
+    def value(self) -> str:
+        tok = self.next()
+        if _is_quoted(tok):
+            return _unquote(tok)
+        if tok in ("(", ")", "[", "]", ".", "and", "or", "not"):
+            raise FilterError(f"expected value, got {tok!r}")
+        return tok
+
+
+def _regex(value: str) -> "re.Pattern[str]":
+    try:
+        return re.compile(value)
+    except re.error as e:
+        raise FilterError(f"bad regex {value!r}: {e}") from e
+
+
+def _get(rec: Any, path: list[str]) -> Any:
+    cur = rec
+    for p in path:
+        if isinstance(cur, dict):
+            cur = cur.get(p)
+        else:
+            return None
+    return cur
+
+
+def _as_str(v: Any) -> str:
+    return v if isinstance(v, str) else ("" if v is None else str(v))
+
+
+def _eq(field: Any, value: str) -> bool:
+    if isinstance(field, bool):
+        return value.lower() in ("true", "1") if field \
+            else value.lower() in ("false", "0")
+    if isinstance(field, (int, float)):
+        try:
+            return float(field) == float(value)
+        except ValueError:
+            return False
+    return field == value
+
+
+def _empty(field: Any) -> bool:
+    return field is None or field == "" or field == [] or field == {}
+
+
+def _contains(field: Any, value: str) -> bool:
+    if isinstance(field, list):
+        return any(_eq(x, value) for x in field)
+    if isinstance(field, dict):
+        return value in field  # key presence, go-bexpr map semantics
+    if isinstance(field, str):
+        return value in field
+    return False
+
+
+def compile_filter(src: str) -> Callable[[Any], bool]:
+    """Parse once, evaluate many (bexpr.CreateFilter). Raises
+    FilterError on malformed input. The single entry point — HTTP's
+    filtered() helper handles both list and map results with it."""
+    tokens = _tokenize(src)
+    if not tokens:
+        raise FilterError("empty filter expression")
+    return _Parser(tokens).parse()

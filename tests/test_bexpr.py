@@ -1,0 +1,103 @@
+"""?filter= expressions (go-bexpr over the HTTP list endpoints;
+agent/http.go parseFilter). Unit grammar coverage + end-to-end over a
+real agent's catalog/health/agent endpoints."""
+
+import pytest
+
+from consul_tpu.utils.bexpr import FilterError, compile_filter
+
+from helpers import wait_for  # noqa: E402
+
+
+def ok(expr, rec):
+    return compile_filter(expr)(rec)
+
+
+def test_equality_and_selectors():
+    rec = {"Node": "n1", "ServicePort": 8080, "Connect": True,
+           "Meta": {"env": "prod", "ver": "2"},
+           "Service": {"Tags": ["a", "b"]}}
+    assert ok('Node == "n1"', rec)
+    assert not ok('Node != "n1"', rec)
+    assert ok('ServicePort == 8080', rec)
+    assert ok('ServicePort == "8080"', rec)
+    assert ok('Meta.env == "prod"', rec)
+    assert ok('Meta["env"] == "prod"', rec)
+    assert ok('Service.Tags contains "a"', rec)
+    assert not ok('Service.Tags contains "z"', rec)
+    assert ok('"b" in Service.Tags', rec)
+    assert ok('"z" not in Service.Tags', rec)
+    assert ok('Connect', rec)  # bare boolean selector
+    assert ok('Missing is empty', rec)
+    assert ok('Meta is not empty', rec)
+    assert ok('Node matches "^n[0-9]$"', rec)
+    assert ok('Node not matches "^x"', rec)
+    # map contains = key presence (go-bexpr semantics)
+    assert ok('Meta contains "env"', rec)
+
+
+def test_combinators_and_precedence():
+    rec = {"A": "1", "B": "2", "C": "3"}
+    assert ok('A == "1" and B == "2"', rec)
+    assert not ok('A == "1" and B == "9"', rec)
+    assert ok('A == "9" or B == "2"', rec)
+    # and binds tighter than or
+    assert ok('A == "9" and B == "9" or C == "3"', rec)
+    assert ok('not A == "9"', rec)
+    assert ok('not (A == "1" and B == "9")', rec)
+
+
+def test_errors_are_filter_errors():
+    for bad in ("", "Node ==", "(Node", 'Node == "x" trailing',
+                '"v" in', "Node matches \"(\"", "and",
+                'Meta."env" == "x"', 'a.and == "x"'):
+        with pytest.raises(FilterError):
+            compile_filter(bad)
+
+
+@pytest.fixture(scope="module")
+def agent():
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import load
+
+    a = Agent(load(dev=True, overrides={"node_name": "flt-agent"}))
+    a.start(serve_dns=False)
+    wait_for(lambda: a.server.is_leader(), what="leader")
+    yield a
+    a.shutdown()
+
+
+def test_filter_param_end_to_end(agent):
+    from consul_tpu.api import APIError, ConsulClient
+
+    c = ConsulClient(agent.http.addr)
+    c.service_register({"Name": "red", "ID": "r1", "Port": 1111,
+                        "Tags": ["primary"], "Meta": {"env": "prod"}})
+    c.service_register({"Name": "red", "ID": "r2", "Port": 2222,
+                        "Tags": ["backup"], "Meta": {"env": "dev"}})
+    wait_for(lambda: len(c.catalog_service("red")) == 2,
+             what="both instances in catalog")
+    rows = c.get("/v1/catalog/service/red",
+                 filter='ServiceMeta.env == "prod"')
+    assert [r["ServiceID"] for r in rows] == ["r1"]
+    rows = c.get("/v1/catalog/service/red",
+                 filter='ServiceTags contains "backup"')
+    assert [r["ServiceID"] for r in rows] == ["r2"]
+    rows = c.get("/v1/catalog/service/red",
+                 filter='ServicePort == 1111 or ServicePort == 2222')
+    assert len(rows) == 2
+    # agent-local map endpoints filter their record values
+    svcs = c.get("/v1/agent/services", filter='Port == 2222')
+    assert list(svcs) == ["r2"]
+    # catalog nodes
+    nodes = c.get("/v1/catalog/nodes", filter='Node == "flt-agent"')
+    assert len(nodes) == 1
+    assert c.get("/v1/catalog/nodes", filter='Node == "nope"') == []
+    # health/service rows filter on the nested entry shape
+    rows = c.get("/v1/health/service/red",
+                 filter='Service.Meta.env == "dev"')
+    assert [r["Service"]["ID"] for r in rows] == ["r2"]
+    # malformed filter -> 400, not 500
+    with pytest.raises(APIError) as ei:
+        c.get("/v1/catalog/nodes", filter='Node ==')
+    assert ei.value.code == 400
