@@ -374,6 +374,7 @@ def bootstrap_config(snapshot: dict[str, Any],
         }],
     }]
 
+    upstream_filters: list[tuple[dict[str, Any], dict[str, Any]]] = []
     for up in snapshot["Upstreams"]:
         if not up.get("Allowed", True):
             continue  # intention-denied upstreams are not materialized
@@ -416,10 +417,72 @@ def bootstrap_config(snapshot: dict[str, Any],
         else:
             # discovery-chain splits → weighted clusters
             filt = _tcp_filter(name, name, routes[-1]["Targets"])
+        if up.get("LocalBindPort"):
+            # explicit-dial listener only when a bind port was
+            # configured: pure-tproxy upstreams have none, and a
+            # listener on 127.0.0.1:0 would bind an arbitrary port
+            listeners.append({
+                "name": name,
+                "address": _addr("127.0.0.1", up["LocalBindPort"]),
+                "filter_chains": [{"filters": [filt]}],
+            })
+        upstream_filters.append((up, filt))
+
+    # transparent proxy (Proxy.Mode=transparent, xds listeners.go
+    # makeOutboundListener + the tproxy docs): ONE outbound capture
+    # listener on OutboundListenerPort (default 15001, where iptables
+    # REDIRECT lands every egress connection). An original_dst
+    # listener filter recovers the pre-redirect destination; each
+    # upstream's virtual IP (the address tproxy DNS answers) selects
+    # its filter chain, and everything else rides a passthrough
+    # ORIGINAL_DST cluster straight to wherever the app dialed.
+    if (snapshot.get("Proxy") or {}).get("Mode") == "transparent":
+        import copy as _copy
+
+        from consul_tpu.connect.virtualip import virtual_ip
+
+        tp = (snapshot.get("Proxy") or {}).get("TransparentProxy") \
+            or {}
+        try:
+            out_port = int(tp.get("OutboundListenerPort") or 15001)
+        except (TypeError, ValueError):
+            out_port = 15001
+        vip_chains = []
+        seen_vips: set[str] = set()
+        for up, filt in upstream_filters:
+            vip = virtual_ip(up["DestinationName"])
+            if vip in seen_vips:
+                # same DestinationName via two upstream entries (e.g.
+                # per-DC binds): one VIP chain only — duplicate
+                # matches would NACK the whole listener
+                continue
+            seen_vips.add(vip)
+            vip_chains.append({
+                "filter_chain_match": {"prefix_ranges": [{
+                    "address_prefix": vip,
+                    "prefix_len": 32}]},
+                # deep copy: the extension passes mutate HCMs in
+                # place, and a shared object would be patched twice
+                "filters": [_copy.deepcopy(filt)],
+            })
+        clusters.append({
+            "name": "original-destination",
+            "type": "ORIGINAL_DST",
+            "lb_policy": "CLUSTER_PROVIDED",
+            "connect_timeout": "5s",
+        })
         listeners.append({
-            "name": name,
-            "address": _addr("127.0.0.1", up["LocalBindPort"]),
-            "filter_chains": [{"filters": [filt]}],
+            "name": f"outbound_listener:{out_port}",
+            "address": _addr("127.0.0.1", out_port),
+            "listener_filters": [{
+                "name": "envoy.filters.listener.original_dst",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions."
+                             "filters.listener.original_dst.v3."
+                             "OriginalDst"}}],
+            "filter_chains": vip_chains,
+            "default_filter_chain": {"filters": [_tcp_proxy(
+                "passthrough", "original-destination")]},
         })
 
     # exposed paths (xds listeners.go makeExposedCheckListener):

@@ -169,7 +169,8 @@ def _iter_hcms(cfg: dict[str, Any], which: str):
             # plaintext check-exposure listeners are NOT mesh traffic:
             # no extension, jwt, or access-log pass may touch them
             continue
-        inbound = not lname.startswith("upstream_")
+        inbound = not lname.startswith(("upstream_",
+                                        "outbound_listener"))
         if which == "inbound" and not inbound:
             continue
         if which == "outbound" and inbound:
@@ -396,8 +397,11 @@ class PropertyOverrideExtension(EnvoyExtension):
                     #           plaintext check-exposure (non-mesh)
                 if rtype == "cluster":
                     inbound = name == "local_app"
+                    if name == "original-destination":
+                        continue  # tproxy passthrough: hands off
                 else:
-                    inbound = not name.startswith("upstream_")
+                    inbound = not name.startswith(
+                        ("upstream_", "outbound_listener"))
                 if (td == "inbound" and not inbound) \
                         or (td == "outbound" and inbound):
                     continue

@@ -110,6 +110,9 @@ _CLUSTER = {
     "type": Field(2, "enum"),  # STATIC=0, EDS=3 (cluster.proto)
     "eds_cluster_config": Field(3, "message", _EDS_CLUSTER_CONFIG),
     "connect_timeout": Field(4, "message", _DURATION),
+    #: lb_policy=6: ROUND_ROBIN=0, CLUSTER_PROVIDED=6 (the
+    #: ORIGINAL_DST passthrough cluster requires it)
+    "lb_policy": Field(6, "enum"),
     #: Http2ProtocolOptions (deprecated in favor of
     #: typed_extension_protocol_options but still honored): empty
     #: message presence marks a gRPC-capable upstream
@@ -783,20 +786,34 @@ def _lower_jwt_authn(ftc: dict[str, Any]) -> bytes:
 _FILTER = {"name": Field(1, "string"),
            "typed_config": Field(4, "message", _ANY)}
 _HCM["http_filters"] = Field(5, "message", _FILTER, repeated=True)
+#: config.core.v3.CidrRange (address.proto): address_prefix=1,
+#: prefix_len=2 (UInt32Value) — tproxy virtual-IP chain matches
+_CIDR_RANGE = {"address_prefix": Field(1, "string"),
+               "prefix_len": Field(2, "message", _UINT32)}
 _FILTER_CHAIN_MATCH = {
+    #: prefix_ranges=3, server_names=11 (listener_components.proto)
+    "prefix_ranges": Field(3, "message", _CIDR_RANGE, repeated=True),
     "server_names": Field(11, "string", repeated=True)}
 _FILTER_CHAIN = {
     "filter_chain_match": Field(1, "message", _FILTER_CHAIN_MATCH),
     "filters": Field(3, "message", _FILTER, repeated=True),
     "transport_socket": Field(6, "message", _TRANSPORT_SOCKET),
 }
+#: ListenerFilter (listener_components.proto): name=1, typed_config=3
+_LISTENER_FILTER = {"name": Field(1, "string"),
+                    "typed_config": Field(3, "message", _ANY)}
 _LISTENER = {
     "name": Field(1, "string"),
     "address": Field(2, "message", _ADDRESS),
     "filter_chains": Field(3, "message", _FILTER_CHAIN, repeated=True),
+    #: listener_filters=9 (original_dst for tproxy capture)
+    "listener_filters": Field(9, "message", _LISTENER_FILTER,
+                              repeated=True),
     #: listener.proto access_log=22 (the NR-filtered rejected-
     #: connection logs, accesslogs.go MakeAccessLogs isListener)
     "access_log": Field(22, "message", _ACCESS_LOG, repeated=True),
+    #: default_filter_chain=25 (the tproxy passthrough arm)
+    "default_filter_chain": Field(25, "message", _FILTER_CHAIN),
 }
 
 
@@ -901,6 +918,13 @@ def lower_cluster(c: dict[str, Any]) -> bytes:
         raise UnloweredShape(f"cluster type {ctype!r}")
     msg: dict[str, Any] = {"name": c["name"],
                            "type": _CLUSTER_TYPE_ENUM[ctype]}
+    if c.get("lb_policy"):
+        lb = {"ROUND_ROBIN": 0, "LEAST_REQUEST": 1, "RANDOM": 3,
+              "MAGLEV": 5, "CLUSTER_PROVIDED": 6,
+              "RING_HASH": 2}.get(c["lb_policy"])
+        if lb is None:
+            raise UnloweredShape(f"lb_policy {c['lb_policy']!r}")
+        msg["lb_policy"] = lb
     if c.get("connect_timeout"):
         msg["connect_timeout"] = _duration(c["connect_timeout"])
     if c.get("eds_cluster_config"):
@@ -1060,6 +1084,29 @@ def _lower_filter(f: dict[str, Any]) -> dict[str, Any]:
             "typed_config": {"type_url": at, "value": blob}}
 
 
+def _lower_filter_chain(fc: dict[str, Any]) -> dict[str, Any]:
+    chain: dict[str, Any] = {
+        "filters": [_lower_filter(f)
+                    for f in fc.get("filters") or []]}
+    fcm = fc.get("filter_chain_match")
+    if fcm:
+        if set(fcm) - {"server_names", "prefix_ranges"}:
+            raise UnloweredShape(f"filter_chain_match {fcm!r}")
+        m: dict[str, Any] = {}
+        if fcm.get("server_names"):
+            m["server_names"] = list(fcm["server_names"])
+        if fcm.get("prefix_ranges"):
+            m["prefix_ranges"] = [
+                {"address_prefix": r.get("address_prefix", ""),
+                 "prefix_len": {"value": int(r.get("prefix_len", 32))}}
+                for r in fcm["prefix_ranges"]]
+        chain["filter_chain_match"] = m
+    if fc.get("transport_socket"):
+        chain["transport_socket"] = _transport_socket(
+            fc["transport_socket"])
+    return chain
+
+
 def lower_listener(lst: dict[str, Any]) -> bytes:
     """envoy.config.listener.v3.Listener JSON → proto bytes."""
     sa = (lst.get("address") or {}).get("socket_address") or {}
@@ -1068,22 +1115,26 @@ def lower_listener(lst: dict[str, Any]) -> bytes:
         "address": {"socket_address": {
             "address": sa.get("address", ""),
             "port_value": sa.get("port_value", 0)}},
-        "filter_chains": [],
+        "filter_chains": [_lower_filter_chain(fc)
+                          for fc in lst.get("filter_chains") or []],
     }
-    for fc in lst.get("filter_chains") or []:
-        chain: dict[str, Any] = {
-            "filters": [_lower_filter(f)
-                        for f in fc.get("filters") or []]}
-        fcm = fc.get("filter_chain_match")
-        if fcm:
-            if set(fcm) - {"server_names"}:
-                raise UnloweredShape(f"filter_chain_match {fcm!r}")
-            chain["filter_chain_match"] = {
-                "server_names": list(fcm.get("server_names") or [])}
-        if fc.get("transport_socket"):
-            chain["transport_socket"] = _transport_socket(
-                fc["transport_socket"])
-        msg["filter_chains"].append(chain)
+    if lst.get("default_filter_chain"):
+        msg["default_filter_chain"] = _lower_filter_chain(
+            lst["default_filter_chain"])
+    if lst.get("listener_filters"):
+        lfs = []
+        for f in lst["listener_filters"]:
+            tc = f.get("typed_config") or {}
+            if set(tc) - {"@type"}:
+                # only config-less filters (original_dst) are covered;
+                # silently dropping real fields would run the filter
+                # with defaults — fall back visibly instead
+                raise UnloweredShape(f"listener filter config {tc!r}")
+            lfs.append({"name": f.get("name", ""),
+                        "typed_config": {
+                            "type_url": tc.get("@type", ""),
+                            "value": b""}})
+        msg["listener_filters"] = lfs
     if lst.get("access_log"):
         msg["access_log"] = _lower_access_logs(lst["access_log"])
     return encode(_LISTENER, msg)

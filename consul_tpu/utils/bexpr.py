@@ -158,9 +158,14 @@ class _Parser:
         tok = self.peek()
         if tok is None:
             raise FilterError("unexpected end of expression")
-        if _is_quoted(tok):
-            # "<value>" in <selector> | "<value>" not in <selector>
-            value = _unquote(self.next())
+        # value-first forms: <value> in <sel> | <value> not in <sel>.
+        # A bare token counts as a value here too (go-bexpr grammar:
+        # `8080 in Ports`), disambiguated from a selector by lookahead
+        nxt = self.toks[self.i + 1: self.i + 3]
+        if _is_quoted(tok) or nxt[:1] == ["in"] \
+                or nxt == ["not", "in"]:
+            value = _unquote(self.next()) if _is_quoted(tok) \
+                else self.next()
             op = self.next()
             if op == "not":
                 self.expect("in")

@@ -27,6 +27,8 @@ def test_equality_and_selectors():
     assert not ok('Service.Tags contains "z"', rec)
     assert ok('"b" in Service.Tags', rec)
     assert ok('"z" not in Service.Tags', rec)
+    assert ok('b in Service.Tags', rec)      # bare value form
+    assert ok('z not in Service.Tags', rec)  # (go-bexpr grammar)
     assert ok('Connect', rec)  # bare boolean selector
     assert ok('Missing is empty', rec)
     assert ok('Meta is not empty', rec)

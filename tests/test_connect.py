@@ -591,3 +591,68 @@ def test_expose_paths_listeners(agent, client):
         "routes"][0]
     assert r["match"]["path"] == "/metrics"
     client.service_deregister("m1")
+
+
+def test_transparent_proxy_outbound_listener(agent, client):
+    """Proxy.Mode=transparent (xds makeOutboundListener + tproxy):
+    one capture listener on OutboundListenerPort with an original_dst
+    listener filter; each upstream's VIRTUAL IP (what tproxy DNS
+    answers) selects its mTLS filter chain, everything else falls to
+    an ORIGINAL_DST passthrough cluster."""
+    from consul_tpu.connect.virtualip import virtual_ip
+
+    client.service_register({"Name": "payments", "ID": "pay1",
+                             "Port": 7300})
+    client.service_register({
+        "Name": "shop", "ID": "shop1", "Port": 7301,
+        "Connect": {"SidecarService": {"Proxy": {
+            "Mode": "transparent",
+            "TransparentProxy": {"OutboundListenerPort": 15009},
+            "Upstreams": [{"DestinationName": "payments",
+                           "LocalBindPort": 9393}]}}}})
+    wait_for(lambda: client.health_service("shop"),
+             what="shop in catalog")
+    from consul_tpu.server.grpc_external import build_config
+
+    cfg = build_config(agent, "shop1-sidecar-proxy")
+    listeners = {l["name"]: l
+                 for l in cfg["static_resources"]["listeners"]}
+    out = listeners["outbound_listener:15009"]
+    assert out["address"]["socket_address"]["port_value"] == 15009
+    assert out["listener_filters"][0]["name"] \
+        == "envoy.filters.listener.original_dst"
+    vip = virtual_ip("payments")
+    chain = out["filter_chains"][0]
+    assert chain["filter_chain_match"]["prefix_ranges"][0] \
+        == {"address_prefix": vip, "prefix_len": 32}
+    # default arm: passthrough to wherever the app actually dialed
+    df = out["default_filter_chain"]["filters"][0]["typed_config"]
+    assert df["cluster"] == "original-destination"
+    od = next(c for c in cfg["static_resources"]["clusters"]
+              if c["name"] == "original-destination")
+    assert od["type"] == "ORIGINAL_DST"
+    assert od["lb_policy"] == "CLUSTER_PROVIDED"
+    # explicit LocalBindPort listener still exists alongside capture
+    assert "upstream_payments" in listeners
+    # true-proto round trip
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (CDS_TYPE, LDS_TYPE,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    lds = resources_from_cfg(cfg, LDS_TYPE)
+    msg = decode(xp._LISTENER, lds["outbound_listener:15009"][1])
+    assert msg["listener_filters"][0]["name"] \
+        == "envoy.filters.listener.original_dst"
+    pr = msg["filter_chains"][0]["filter_chain_match"][
+        "prefix_ranges"][0]
+    assert pr["address_prefix"] == vip
+    assert pr["prefix_len"]["value"] == 32
+    assert decode(xp._TCP_PROXY, msg["default_filter_chain"][
+        "filters"][0]["typed_config"]["value"])["cluster"] \
+        == "original-destination"
+    cds = resources_from_cfg(cfg, CDS_TYPE)
+    cmsg = decode(xp._CLUSTER, cds["original-destination"][1])
+    assert cmsg["type"] == 4 and cmsg["lb_policy"] == 6
+    client.service_deregister("shop1")
+    client.service_deregister("pay1")
