@@ -89,16 +89,25 @@ def compile_chain(name: str,
     protocol = service_protocol(name, get_entry)
     routes: list[dict[str, Any]] = []
     router = get_entry("service-router", name)
+    def lb_of(svc: str) -> dict[str, Any]:
+        # the route DESTINATION's resolver drives the hash policies on
+        # that route (config_entry_discoverychain.go LoadBalancer)
+        return (get_entry("service-resolver", svc)
+                or {}).get("LoadBalancer") or {}
+
     if router is not None and protocol in ("http", "http2", "grpc"):
         for r in router.get("Routes") or []:
             dest = dict(r.get("Destination") or {})
             svc = dest.get("Service") or name
             routes.append({"Match": r.get("Match"),
                            "Destination": dest,
+                           "LoadBalancer": lb_of(svc),
                            "Targets": compile_targets(svc, get_entry)})
     routes.append({"Match": None, "Destination": {"Service": name},
+                   "LoadBalancer": lb_of(name),
                    "Targets": compile_targets(name, get_entry)})
-    return {"ServiceName": name, "Protocol": protocol, "Routes": routes}
+    return {"ServiceName": name, "Protocol": protocol,
+            "Routes": routes}
 
 
 def validate_entry(entry: dict) -> None:
@@ -124,6 +133,50 @@ def validate_entry(entry: dict) -> None:
         redirect = entry.get("Redirect")
         if redirect is not None and not isinstance(redirect, dict):
             raise ValueError("service-resolver Redirect must be a map")
+        lb = entry.get("LoadBalancer")
+        if lb is not None:
+            if not isinstance(lb, dict):
+                raise ValueError("LoadBalancer must be a map")
+            pol = (lb.get("Policy") or "").lower()
+            if pol not in ("", "random", "round_robin",
+                           "least_request", "ring_hash", "maglev"):
+                raise ValueError(f"invalid LoadBalancer.Policy {pol!r}")
+            if lb.get("HashPolicies") and pol not in ("ring_hash",
+                                                      "maglev"):
+                # the ref's LoadBalancer.Validate: hash policies with
+                # a non-hash policy would be accepted and silently
+                # ignored — surface the misconfiguration at write time
+                raise ValueError(
+                    "LoadBalancer.HashPolicies require Policy "
+                    "ring_hash or maglev")
+            for n, hp in enumerate(lb.get("HashPolicies") or []):
+                if not isinstance(hp, dict):
+                    raise ValueError(
+                        f"HashPolicies[{n}] must be a map")
+                if hp.get("SourceIP"):
+                    if hp.get("Field") or hp.get("FieldValue"):
+                        raise ValueError(
+                            f"HashPolicies[{n}]: SourceIP is "
+                            "exclusive with Field/FieldValue")
+                    continue
+                if hp.get("Field") not in ("header", "cookie",
+                                           "query_parameter"):
+                    raise ValueError(
+                        f"HashPolicies[{n}].Field must be header/"
+                        "cookie/query_parameter (or SourceIP)")
+                if not hp.get("FieldValue"):
+                    raise ValueError(
+                        f"HashPolicies[{n}]: FieldValue is required")
+                ttl = (hp.get("CookieConfig") or {}).get("TTL")
+                if ttl is not None:
+                    from consul_tpu.utils.duration import \
+                        parse_duration
+                    try:
+                        parse_duration(ttl)
+                    except (ValueError, TypeError) as exc:
+                        raise ValueError(
+                            f"HashPolicies[{n}].CookieConfig.TTL: "
+                            f"invalid duration {ttl!r}") from exc
     elif kind == "service-router":
         routes = entry.get("Routes")
         if not isinstance(routes, list):
@@ -254,5 +307,9 @@ def _resolve(name: str,
             continue
         failover = ((resolver.get("Failover") or {}).get("*") or {}) \
             .get("Service")
-        return {"Service": name, "Failover": failover}
-    return {"Service": name, "Failover": None}
+        # the FINAL (post-redirect) resolver's LoadBalancer travels
+        # with the target: each target's clusters take its OWN policy
+        # (xds clusters.go injectLBToCluster), never the chain head's
+        return {"Service": name, "Failover": failover,
+                "LoadBalancer": resolver.get("LoadBalancer") or {}}
+    return {"Service": name, "Failover": None, "LoadBalancer": {}}

@@ -398,10 +398,15 @@ def bootstrap_config(snapshot: dict[str, Any],
                 if cname in seen_clusters:
                     continue
                 seen_clusters.add(cname)
+                # the TARGET's resolver LoadBalancer.Policy (xds
+                # clusters.go injectLBToCluster — per target, never
+                # inherited from the chain head)
+                lbp = _lb_policy(t.get("LoadBalancer") or {})
                 clusters.append({
                     "name": cname,
                     "type": "STATIC",
                     "connect_timeout": "5s",
+                    **({"lb_policy": lbp} if lbp else {}),
                     "transport_socket": upstream_tls,
                     "load_assignment": _endpoints(
                         cname, t.get("Endpoints", [])),
@@ -706,6 +711,11 @@ def _route_action(prefix: str, route: dict[str, Any]) -> dict[str, Any]:
             "num_retries": int(dest.get("NumRetries", 1)),
             **({"retriable_status_codes": dest["RetryOnStatusCodes"]}
                if dest.get("RetryOnStatusCodes") else {})}
+    # the route destination's resolver hash policies (ring_hash/
+    # maglev); riding the SHARED builder covers sidecar AND ingress
+    hps = _hash_policies(route.get("LoadBalancer") or {})
+    if hps:
+        action["hash_policy"] = hps
     return action
 
 
@@ -756,6 +766,53 @@ def _public_hcm(intentions: list[dict[str, Any]],
                     "routes": [{"match": {"prefix": "/"},
                                 "route": {"cluster": "local_app"}}]}]},
         }}
+
+
+def _lb_policy(lb: dict[str, Any]) -> Optional[str]:
+    """Resolver LoadBalancer.Policy → Cluster.LbPolicy
+    (xds clusters.go injectLBToCluster)."""
+    return {"random": "RANDOM", "round_robin": "ROUND_ROBIN",
+            "least_request": "LEAST_REQUEST",
+            "ring_hash": "RING_HASH", "maglev": "MAGLEV"}.get(
+        (lb.get("Policy") or "").lower())
+
+
+def _hash_policies(lb: dict[str, Any]) -> list[dict[str, Any]]:
+    """LoadBalancer.HashPolicies → RouteAction.hash_policy (xds
+    routes.go injectHeaderManipulators/hash policy lowering): only
+    meaningful for hash-based policies (ring_hash, maglev)."""
+    if _lb_policy(lb) not in ("RING_HASH", "MAGLEV"):
+        return []
+    out = []
+    for hp in lb.get("HashPolicies") or []:
+        terminal = bool(hp.get("Terminal"))
+        if hp.get("SourceIP"):
+            out.append({"connection_properties": {"source_ip": True},
+                        "terminal": terminal})
+            continue
+        field = (hp.get("Field") or "").lower()
+        value = hp.get("FieldValue", "")
+        if field == "header" and value:
+            out.append({"header": {"header_name": value},
+                        "terminal": terminal})
+        elif field == "cookie" and value:
+            cookie: dict[str, Any] = {"name": value}
+            ck = hp.get("CookieConfig") or {}
+            if ck.get("TTL"):
+                # normalize go-style durations ("500ms", "10m") to the
+                # '<seconds>s' form the proto lowering accepts
+                from consul_tpu.utils.duration import parse_duration
+                try:
+                    cookie["ttl"] = f"{parse_duration(ck['TTL'])}s"
+                except ValueError:
+                    pass  # rejected at write time; belt here
+            if ck.get("Path"):
+                cookie["path"] = ck["Path"]
+            out.append({"cookie": cookie, "terminal": terminal})
+        elif field == "query_parameter" and value:
+            out.append({"query_parameter": {"name": value},
+                        "terminal": terminal})
+    return out
 
 
 def _http_conn_manager(name: str,
@@ -838,9 +895,11 @@ def _ingress_bootstrap(snapshot: dict[str, Any],
                     if cname in seen:
                         continue
                     seen.add(cname)
+                    lbp = _lb_policy(t.get("LoadBalancer") or {})
                     clusters.append({
                         "name": cname, "type": "STATIC",
                         "connect_timeout": "5s",
+                        **({"lb_policy": lbp} if lbp else {}),
                         "transport_socket": upstream_tls,
                         "load_assignment": _endpoints(
                             cname, t.get("Endpoints", []))})

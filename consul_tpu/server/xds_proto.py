@@ -411,14 +411,32 @@ _RETRY_POLICY = {"retry_on": Field(1, "string"),
                  "num_retries": Field(2, "message", _UINT32),
                  "retriable_status_codes": Field(7, "int",
                                                  repeated=True)}
+#: RouteAction.HashPolicy (route_components.proto): header=1
+#: (header_name=1), cookie=2 (name=1, ttl=2, path=3),
+#: connection_properties=3 (source_ip=1), terminal=4,
+#: query_parameter=5 (name=1) — ring_hash/maglev inputs
+_HP_HEADER = {"header_name": Field(1, "string")}
+_HP_COOKIE = {"name": Field(1, "string"),
+              "ttl": Field(2, "message", _DURATION),
+              "path": Field(3, "string")}
+_HP_CONN = {"source_ip": Field(1, "bool")}
+_HP_QUERY = {"name": Field(1, "string")}
+_HASH_POLICY = {
+    "header": Field(1, "message", _HP_HEADER),
+    "cookie": Field(2, "message", _HP_COOKIE),
+    "connection_properties": Field(3, "message", _HP_CONN),
+    "terminal": Field(4, "bool"),
+    "query_parameter": Field(5, "message", _HP_QUERY),
+}
 #: RouteAction: cluster=1, weighted_clusters=3, prefix_rewrite=5,
-#: timeout=8, retry_policy=9
+#: timeout=8, retry_policy=9, hash_policy=15
 _ROUTE_ACTION = {
     "cluster": Field(1, "string"),
     "weighted_clusters": Field(3, "message", _WEIGHTED),
     "prefix_rewrite": Field(5, "string"),
     "timeout": Field(8, "message", _DURATION),
     "retry_policy": Field(9, "message", _RETRY_POLICY),
+    "hash_policy": Field(15, "message", _HASH_POLICY, repeated=True),
 }
 #: Route: match=1, route=2
 _ROUTE = {"match": Field(1, "message", _ROUTE_MATCH),
@@ -548,6 +566,32 @@ def _lower_route_action(a: dict[str, Any]) -> dict[str, Any]:
             **({"retriable_status_codes":
                 [int(c) for c in rp["retriable_status_codes"]]}
                if rp.get("retriable_status_codes") else {})}
+    if a.get("hash_policy"):
+        hps = []
+        for hp in a["hash_policy"]:
+            msg: dict[str, Any] = {}
+            if hp.get("header"):
+                msg["header"] = {"header_name":
+                                 hp["header"].get("header_name", "")}
+            elif hp.get("cookie"):
+                ck = hp["cookie"]
+                msg["cookie"] = {
+                    "name": ck.get("name", ""),
+                    **({"ttl": _duration(ck["ttl"])}
+                       if ck.get("ttl") else {}),
+                    **({"path": ck["path"]}
+                       if ck.get("path") else {})}
+            elif hp.get("connection_properties"):
+                msg["connection_properties"] = {"source_ip": True}
+            elif hp.get("query_parameter"):
+                msg["query_parameter"] = {
+                    "name": hp["query_parameter"].get("name", "")}
+            else:
+                raise UnloweredShape(f"hash policy {hp!r}")
+            if hp.get("terminal"):
+                msg["terminal"] = True
+            hps.append(msg)
+        out["hash_policy"] = hps
     return out
 
 

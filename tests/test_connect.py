@@ -269,14 +269,15 @@ def test_discovery_chain_compile_unit():
     get = lambda kind, name: entries.get((kind, name))
     t = compile_targets("db", get)
     assert t == [{"Service": "db-v2", "Failover": "db-backup",
-                  "Weight": 100.0}]
+                  "LoadBalancer": {}, "Weight": 100.0}]
     t = compile_targets("api", get)
     assert [(x["Service"], x["Weight"]) for x in t] == \
         [("api", 90.0), ("api-canary", 10.0)]
     t = compile_targets("loop-a", get)  # bounded, no hang
     assert len(t) == 1
     t = compile_targets("plain", get)
-    assert t == [{"Service": "plain", "Failover": None, "Weight": 100.0}]
+    assert t == [{"Service": "plain", "Failover": None,
+                  "LoadBalancer": {}, "Weight": 100.0}]
 
 
 def test_discovery_chain_in_proxy_snapshot(agent, client):
@@ -656,3 +657,73 @@ def test_transparent_proxy_outbound_listener(agent, client):
     assert cmsg["type"] == 4 and cmsg["lb_policy"] == 6
     client.service_deregister("shop1")
     client.service_deregister("pay1")
+
+
+def test_resolver_load_balancer_policy(agent, client):
+    """service-resolver LoadBalancer (config_entry_discoverychain.go
+    :1739 + xds clusters.go injectLBToCluster): Policy sets the
+    upstream cluster's lb_policy; ring_hash/maglev HashPolicies become
+    RouteAction.hash_policy entries on the HTTP routes."""
+    from consul_tpu.server.rpc import RPCError
+    import pytest as _pytest
+
+    with _pytest.raises(RPCError, match="LoadBalancer.Policy"):
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "service-resolver", "Name": "lbsvc",
+                "LoadBalancer": {"Policy": "bogus"}}}, "t")
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-defaults", "Name": "lbsvc",
+            "Protocol": "http"}}, "t")
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-resolver", "Name": "lbsvc",
+            "LoadBalancer": {
+                "Policy": "ring_hash",
+                "HashPolicies": [
+                    {"Field": "header", "FieldValue": "x-user"},
+                    {"SourceIP": True, "Terminal": True}]}}}, "t")
+    client.service_register({"Name": "lbsvc", "ID": "lb1",
+                             "Port": 7400})
+    client.service_register({
+        "Name": "caller", "ID": "call1", "Port": 7401,
+        "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+            {"DestinationName": "lbsvc",
+             "LocalBindPort": 9494}]}}}})
+    wait_for(lambda: client.health_service("caller"),
+             what="caller in catalog")
+    from consul_tpu.server.grpc_external import build_config
+
+    cfg = build_config(agent, "call1-sidecar-proxy")
+    cl = next(c for c in cfg["static_resources"]["clusters"]
+              if c["name"] == "upstream_lbsvc_lbsvc")
+    assert cl["lb_policy"] == "RING_HASH"
+    up = next(l for l in cfg["static_resources"]["listeners"]
+              if l["name"] == "upstream_lbsvc")
+    hcm = up["filter_chains"][0]["filters"][0]["typed_config"]
+    hp = hcm["route_config"]["virtual_hosts"][0]["routes"][0][
+        "route"]["hash_policy"]
+    assert hp[0]["header"]["header_name"] == "x-user"
+    assert hp[1] == {"connection_properties": {"source_ip": True},
+                     "terminal": True}
+    # proto round trip
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (CDS_TYPE, LDS_TYPE,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    cds = resources_from_cfg(cfg, CDS_TYPE)
+    assert decode(xp._CLUSTER,
+                  cds["upstream_lbsvc_lbsvc"][1])["lb_policy"] == 2
+    lds = resources_from_cfg(cfg, LDS_TYPE)
+    lmsg = decode(xp._LISTENER, lds["upstream_lbsvc"][1])
+    hmsg = decode(xp._HCM, lmsg["filter_chains"][0]["filters"][0][
+        "typed_config"]["value"])
+    rhp = hmsg["route_config"]["virtual_hosts"][0]["routes"][0][
+        "route"]["hash_policy"]
+    assert rhp[0]["header"]["header_name"] == "x-user"
+    assert rhp[1]["connection_properties"]["source_ip"] is True
+    assert rhp[1]["terminal"] is True
+    client.service_deregister("call1")
+    client.service_deregister("lb1")
