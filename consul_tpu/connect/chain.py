@@ -222,6 +222,56 @@ def validate_entry(entry: dict) -> None:
             if not s.get("Name"):
                 raise ValueError(
                     "terminating-gateway service requires Name")
+    elif kind == "service-defaults":
+        uc = entry.get("UpstreamConfig")
+        if uc is not None:
+            if not isinstance(uc, dict):
+                raise ValueError("UpstreamConfig must be a map")
+
+            def check_phc(phc: Any, where: str) -> None:
+                if phc is None:
+                    return
+                if not isinstance(phc, dict):
+                    raise ValueError(f"{where} must be a map")
+                from consul_tpu.utils.duration import parse_duration
+                for k in ("Interval", "BaseEjectionTime"):
+                    if phc.get(k) is not None:
+                        try:
+                            secs = parse_duration(phc[k])
+                        except (ValueError, TypeError) as exc:
+                            raise ValueError(
+                                f"{where}.{k}: invalid duration "
+                                f"{phc[k]!r}") from exc
+                        if secs <= 0:
+                            # "-5s" parses fine but Envoy NACKs a
+                            # negative Duration at delivery time
+                            raise ValueError(
+                                f"{where}.{k} must be positive")
+                mf = phc.get("MaxFailures")
+                if mf is not None and not (
+                        isinstance(mf, int) and mf >= 0):
+                    raise ValueError(
+                        f"{where}.MaxFailures must be a "
+                        "non-negative integer")
+                for k in ("EnforcingConsecutive5xx",
+                          "MaxEjectionPercent"):
+                    v = phc.get(k)
+                    if v is not None and not (
+                            isinstance(v, int) and 0 <= v <= 100):
+                        raise ValueError(
+                            f"{where}.{k} must be 0-100")
+
+            check_phc((uc.get("Defaults") or {}).get(
+                "PassiveHealthCheck"),
+                "UpstreamConfig.Defaults.PassiveHealthCheck")
+            for n, o in enumerate(uc.get("Overrides") or []):
+                if not isinstance(o, dict) or not o.get("Name"):
+                    raise ValueError(
+                        f"UpstreamConfig.Overrides[{n}]: Name is "
+                        "required")
+                check_phc(o.get("PassiveHealthCheck"),
+                          f"UpstreamConfig.Overrides[{n}]."
+                          "PassiveHealthCheck")
     elif kind == "jwt-provider":
         # structs.JWTProviderConfigEntry Validate: a provider must be
         # nameable from intentions and carry a key set to verify with.

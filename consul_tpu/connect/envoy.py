@@ -391,6 +391,8 @@ def bootstrap_config(snapshot: dict[str, Any],
                          "transport_sockets.tls.v3.UpstreamTlsContext",
                 "common_tls_context":
                     tls_context["common_tls_context"]}}
+        outlier = _outlier_detection(up.get("PassiveHealthCheck")
+                                     or {})
         seen_clusters = set()
         for route in routes:
             for t in route["Targets"]:
@@ -407,6 +409,8 @@ def bootstrap_config(snapshot: dict[str, Any],
                     "type": "STATIC",
                     "connect_timeout": "5s",
                     **({"lb_policy": lbp} if lbp else {}),
+                    **({"outlier_detection": outlier}
+                       if outlier else {}),
                     "transport_socket": upstream_tls,
                     "load_assignment": _endpoints(
                         cname, t.get("Endpoints", [])),
@@ -766,6 +770,39 @@ def _public_hcm(intentions: list[dict[str, Any]],
                     "routes": [{"match": {"prefix": "/"},
                                 "route": {"cluster": "local_app"}}]}]},
         }}
+
+
+def _outlier_detection(phc: dict[str, Any]) -> Optional[dict[str, Any]]:
+    """UpstreamConfig.PassiveHealthCheck → Cluster.outlier_detection
+    (structs/config_entry.go:1198 PassiveHealthCheck; xds clusters.go
+    makeClusterFromUserConfig outlier lowering). None when unset."""
+    if not phc:
+        return None
+    from consul_tpu.utils.duration import parse_duration
+
+    out: dict[str, Any] = {}
+    if phc.get("MaxFailures"):
+        try:
+            out["consecutive_5xx"] = int(phc["MaxFailures"])
+        except (TypeError, ValueError):
+            pass  # rejected at write time; belt here
+    if phc.get("Interval"):
+        try:
+            out["interval"] = f"{parse_duration(phc['Interval'])}s"
+        except (ValueError, TypeError):
+            pass  # rejected at write time; belt here
+    if phc.get("BaseEjectionTime"):
+        try:
+            out["base_ejection_time"] = \
+                f"{parse_duration(phc['BaseEjectionTime'])}s"
+        except (ValueError, TypeError):
+            pass
+    if phc.get("EnforcingConsecutive5xx") is not None:
+        out["enforcing_consecutive_5xx"] = int(
+            phc["EnforcingConsecutive5xx"])
+    if phc.get("MaxEjectionPercent") is not None:
+        out["max_ejection_percent"] = int(phc["MaxEjectionPercent"])
+    return out or None
 
 
 def _lb_policy(lb: dict[str, Any]) -> Optional[str]:

@@ -93,6 +93,17 @@ def assemble_snapshot(agent, proxy_id: str,
             ep_memo[svc] = _lookup_endpoints(rpc, svc)
         return ep_memo[svc]
 
+    # UpstreamConfig (service-defaults of the LOCAL service,
+    # structs/config_entry.go UpstreamConfiguration): Defaults apply
+    # to every upstream, Overrides by upstream name win — carries
+    # PassiveHealthCheck for the outlier-detection lowering
+    _local_sd = get_entry("service-defaults", dest_name) or {}
+    _uc = _local_sd.get("UpstreamConfig") or {}
+    _uc_defaults = _uc.get("Defaults") or {}
+    _uc_overrides = {o.get("Name"): o
+                     for o in _uc.get("Overrides") or []
+                     if isinstance(o, dict)}
+
     upstreams = []
     for u in proxy.proxy.get("Upstreams") or []:
         uname = u.get("DestinationName", "")
@@ -125,11 +136,15 @@ def assemble_snapshot(agent, proxy_id: str,
         check = rpc("Intention.Check", {
             "SourceName": dest_name, "DestinationName": uname,
             "AllowPermissions": True})
+        phc = (_uc_overrides.get(uname) or {}).get(
+            "PassiveHealthCheck") \
+            or _uc_defaults.get("PassiveHealthCheck") or {}
         upstreams.append({
             "DestinationName": uname,
             "LocalBindPort": u.get("LocalBindPort", 0),
             "Allowed": check.get("Allowed", False),
             "EnvoyExtensions": u_exts,
+            "PassiveHealthCheck": phc,
             "Error": error,
             "Protocol": chain["Protocol"],
             "Routes": chain["Routes"],

@@ -33,6 +33,8 @@ from consul_tpu.utils.pbwire import Field, encode
 _DURATION = {"seconds": Field(1, "int"), "nanos": Field(2, "int")}
 #: google.protobuf.BoolValue
 _BOOL = {"value": Field(1, "bool")}
+#: google.protobuf.UInt32Value
+_UINT32 = {"value": Field(1, "int")}
 #: config.core.v3.DataSource (base.proto): oneof specifier
 _DATA_SOURCE = {"filename": Field(1, "string"),
                 "inline_bytes": Field(2, "bytes"),
@@ -113,6 +115,22 @@ _CLUSTER = {
     #: lb_policy=6: ROUND_ROBIN=0, CLUSTER_PROVIDED=6 (the
     #: ORIGINAL_DST passthrough cluster requires it)
     "lb_policy": Field(6, "enum"),
+    #: OutlierDetection (outlier_detection.proto: consecutive_5xx=1,
+    #: interval=2, base_ejection_time=3, max_ejection_percent=4,
+    #: enforcing_consecutive_5xx=5); Cluster.outlier_detection=19
+    #: the UInt32Value wrappers carry presence: {"value": 0} must
+    #: reach the wire (enforcing_consecutive_5xx=0 means NEVER eject;
+    #: eliding it would make Envoy enforce its 100% default)
+    "outlier_detection": Field(19, "message", {
+        "consecutive_5xx": Field(1, "message", _UINT32,
+                                 presence=True),
+        "interval": Field(2, "message", _DURATION),
+        "base_ejection_time": Field(3, "message", _DURATION),
+        "max_ejection_percent": Field(4, "message", _UINT32,
+                                      presence=True),
+        "enforcing_consecutive_5xx": Field(5, "message", _UINT32,
+                                           presence=True),
+    }),
     #: Http2ProtocolOptions (deprecated in favor of
     #: typed_extension_protocol_options but still honored): empty
     #: message presence marks a gRPC-capable upstream
@@ -342,8 +360,6 @@ _CLUSTER["metadata"] = Field(25, "message", _METADATA)
 # connection manager — what the L7 discovery chain (service-router /
 # splitter) lowers to. Field numbers cited per proto.
 
-#: google.protobuf.UInt32Value
-_UINT32 = {"value": Field(1, "int")}
 #: type.matcher.v3.RegexMatcher (regex.proto): google_re2=1, regex=2
 _REGEX = {"google_re2": Field(1, "message", {}, presence=True),
           "regex": Field(2, "string")}
@@ -1005,6 +1021,25 @@ def lower_cluster(c: dict[str, Any]) -> bytes:
             {"key": k, "value": _pb_struct(v)}
             for k, v in sorted((c["metadata"].get("filter_metadata")
                                 or {}).items())]}
+    od = c.get("outlier_detection")
+    if od:
+        msg["outlier_detection"] = {
+            **({"consecutive_5xx": {"value": int(
+                od["consecutive_5xx"])}}
+               if od.get("consecutive_5xx") is not None else {}),
+            **({"interval": _duration(od["interval"])}
+               if od.get("interval") else {}),
+            **({"base_ejection_time": _duration(
+                od["base_ejection_time"])}
+               if od.get("base_ejection_time") else {}),
+            **({"max_ejection_percent": {"value": int(
+                od["max_ejection_percent"])}}
+               if od.get("max_ejection_percent") is not None else {}),
+            **({"enforcing_consecutive_5xx": {"value": int(
+                od["enforcing_consecutive_5xx"])}}
+               if od.get("enforcing_consecutive_5xx") is not None
+               else {}),
+        }
     return encode(_CLUSTER, msg)
 
 

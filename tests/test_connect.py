@@ -727,3 +727,87 @@ def test_resolver_load_balancer_policy(agent, client):
     assert rhp[1]["terminal"] is True
     client.service_deregister("call1")
     client.service_deregister("lb1")
+
+
+def test_passive_health_check_outlier_detection(agent, client):
+    """UpstreamConfig.PassiveHealthCheck (config_entry.go:1198) →
+    Cluster.outlier_detection; Overrides by upstream name beat
+    Defaults; bad values die at write time."""
+    from consul_tpu.server.rpc import RPCError
+    import pytest as _pytest
+
+    with _pytest.raises(RPCError, match="invalid duration"):
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "service-defaults", "Name": "edge",
+                "UpstreamConfig": {"Defaults": {
+                    "PassiveHealthCheck": {"Interval": "soon"}}}}},
+            "t")
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-defaults", "Name": "edge",
+            "UpstreamConfig": {
+                "Defaults": {"PassiveHealthCheck": {
+                    "MaxFailures": 3, "Interval": "10s"}},
+                "Overrides": [{"Name": "backend2",
+                               "PassiveHealthCheck": {
+                                   "MaxFailures": 7,
+                                   "Interval": "500ms",
+                                   "EnforcingConsecutive5xx": 50}}],
+            }}}, "t")
+    client.service_register({"Name": "backend1", "Port": 7500})
+    client.service_register({"Name": "backend2", "Port": 7501})
+    client.service_register({
+        "Name": "edge", "ID": "edge1", "Port": 7502,
+        "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+            {"DestinationName": "backend1", "LocalBindPort": 9595},
+            {"DestinationName": "backend2",
+             "LocalBindPort": 9596}]}}}})
+    wait_for(lambda: client.health_service("edge"),
+             what="edge in catalog")
+    from consul_tpu.server.grpc_external import build_config
+
+    cfg = build_config(agent, "edge1-sidecar-proxy")
+    cl = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+    d1 = cl["upstream_backend1_backend1"]["outlier_detection"]
+    assert d1["consecutive_5xx"] == 3 and d1["interval"] == "10.0s"
+    d2 = cl["upstream_backend2_backend2"]["outlier_detection"]
+    assert d2["consecutive_5xx"] == 7
+    assert d2["interval"] == "0.5s"
+    assert d2["enforcing_consecutive_5xx"] == 50
+    # proto round trip
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (CDS_TYPE,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    cds = resources_from_cfg(cfg, CDS_TYPE)
+    od = decode(xp._CLUSTER, cds["upstream_backend2_backend2"][1])[
+        "outlier_detection"]
+    assert od["consecutive_5xx"]["value"] == 7
+    assert od["interval"] == {"nanos": 500000000}
+    assert od["enforcing_consecutive_5xx"]["value"] == 50
+    # a configured 0 must REACH the wire (0 = never eject; an elided
+    # wrapper would make Envoy enforce its 100% default)
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-defaults", "Name": "edge",
+            "UpstreamConfig": {"Defaults": {"PassiveHealthCheck": {
+                "MaxFailures": 2,
+                "EnforcingConsecutive5xx": 0}}}}}, "t")
+    cfg = build_config(agent, "edge1-sidecar-proxy")
+    cds = resources_from_cfg(cfg, CDS_TYPE)
+    blob = cds["upstream_backend1_backend1"][1]
+    od = decode(xp._CLUSTER, blob)["outlier_detection"]
+    assert od["enforcing_consecutive_5xx"] == {"value": 0} or \
+        od["enforcing_consecutive_5xx"].get("value", 0) == 0
+    # presence check at the wire level: field 5 bytes must exist
+    assert b"\x2a" in blob  # field 5, wire type 2 key
+    for sid in ("edge1",):
+        client.service_deregister(sid)
+    for name in ("backend1", "backend2"):
+        # module-scoped fixture: leave no catalog residue
+        svcs = [s for s in client.agent_services()
+                if client.agent_services()[s]["Service"] == name]
+        for s in svcs:
+            client.service_deregister(s)
