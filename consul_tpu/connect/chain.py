@@ -261,6 +261,36 @@ def validate_entry(entry: dict) -> None:
                         raise ValueError(
                             f"{where}.{k} must be 0-100")
 
+            def check_limits(block: Any, where: str) -> None:
+                if block is None:
+                    return
+                if not isinstance(block, dict):
+                    raise ValueError(f"{where} must be a map")
+                lim = block.get("Limits")
+                if lim is not None:
+                    if not isinstance(lim, dict):
+                        raise ValueError(
+                            f"{where}.Limits must be a map")
+                    for k in ("MaxConnections", "MaxPendingRequests",
+                              "MaxConcurrentRequests"):
+                        v = lim.get(k)
+                        if v is not None and not (
+                                isinstance(v, int) and v >= 0):
+                            raise ValueError(
+                                f"{where}.Limits.{k} must be a "
+                                "non-negative integer")
+                cto = block.get("ConnectTimeoutMs")
+                if cto is not None and not (
+                        isinstance(cto, (int, float)) and cto > 0):
+                    raise ValueError(
+                        f"{where}.ConnectTimeoutMs must be a "
+                        "positive number")
+
+            # shape check FIRST: check_phc's .get() on a non-dict
+            # Defaults would raise AttributeError before the clean
+            # validation message
+            check_limits(uc.get("Defaults"),
+                         "UpstreamConfig.Defaults")
             check_phc((uc.get("Defaults") or {}).get(
                 "PassiveHealthCheck"),
                 "UpstreamConfig.Defaults.PassiveHealthCheck")
@@ -272,6 +302,7 @@ def validate_entry(entry: dict) -> None:
                 check_phc(o.get("PassiveHealthCheck"),
                           f"UpstreamConfig.Overrides[{n}]."
                           "PassiveHealthCheck")
+                check_limits(o, f"UpstreamConfig.Overrides[{n}]")
     elif kind == "jwt-provider":
         # structs.JWTProviderConfigEntry Validate: a provider must be
         # nameable from intentions and carry a key set to verify with.

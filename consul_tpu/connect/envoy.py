@@ -393,6 +393,24 @@ def bootstrap_config(snapshot: dict[str, Any],
                     tls_context["common_tls_context"]}}
         outlier = _outlier_detection(up.get("PassiveHealthCheck")
                                      or {})
+        # UpstreamConfig.Limits (config_entry.go:1276) → circuit
+        # breakers; ConnectTimeoutMs overrides the 5s default
+        lim = up.get("Limits") or {}
+        thresholds = {
+            k: int(lim[src]) for src, k in (
+                ("MaxConnections", "max_connections"),
+                ("MaxPendingRequests", "max_pending_requests"),
+                ("MaxConcurrentRequests", "max_requests"))
+            if isinstance(lim.get(src), int) and lim[src] >= 0}
+        breakers = {"thresholds": [thresholds]} if thresholds else None
+        try:
+            # fixed-point, never scientific notation — Envoy's proto
+            # JSON Duration parser rejects "5e-05s"
+            cto_s = _secs_str(
+                float(up["ConnectTimeoutMs"]) / 1000.0) \
+                if up.get("ConnectTimeoutMs") else "5s"
+        except (TypeError, ValueError):
+            cto_s = "5s"
         seen_clusters = set()
         for route in routes:
             for t in route["Targets"]:
@@ -407,10 +425,12 @@ def bootstrap_config(snapshot: dict[str, Any],
                 clusters.append({
                     "name": cname,
                     "type": "STATIC",
-                    "connect_timeout": "5s",
+                    "connect_timeout": cto_s,
                     **({"lb_policy": lbp} if lbp else {}),
                     **({"outlier_detection": outlier}
                        if outlier else {}),
+                    **({"circuit_breakers": breakers}
+                       if breakers else {}),
                     "transport_socket": upstream_tls,
                     "load_assignment": _endpoints(
                         cname, t.get("Endpoints", [])),
@@ -772,6 +792,12 @@ def _public_hcm(intentions: list[dict[str, Any]],
         }}
 
 
+def _secs_str(seconds: float) -> str:
+    """'<seconds>s' in FIXED-POINT — Envoy's proto JSON Duration
+    parser rejects scientific notation ('5e-05s')."""
+    return "{:.9f}".format(seconds).rstrip("0").rstrip(".") + "s"
+
+
 def _outlier_detection(phc: dict[str, Any]) -> Optional[dict[str, Any]]:
     """UpstreamConfig.PassiveHealthCheck → Cluster.outlier_detection
     (structs/config_entry.go:1198 PassiveHealthCheck; xds clusters.go
@@ -788,13 +814,14 @@ def _outlier_detection(phc: dict[str, Any]) -> Optional[dict[str, Any]]:
             pass  # rejected at write time; belt here
     if phc.get("Interval"):
         try:
-            out["interval"] = f"{parse_duration(phc['Interval'])}s"
+            out["interval"] = _secs_str(
+                parse_duration(phc["Interval"]))
         except (ValueError, TypeError):
             pass  # rejected at write time; belt here
     if phc.get("BaseEjectionTime"):
         try:
-            out["base_ejection_time"] = \
-                f"{parse_duration(phc['BaseEjectionTime'])}s"
+            out["base_ejection_time"] = _secs_str(
+                parse_duration(phc["BaseEjectionTime"]))
         except (ValueError, TypeError):
             pass
     if phc.get("EnforcingConsecutive5xx") is not None:
@@ -840,7 +867,8 @@ def _hash_policies(lb: dict[str, Any]) -> list[dict[str, Any]]:
                 # '<seconds>s' form the proto lowering accepts
                 from consul_tpu.utils.duration import parse_duration
                 try:
-                    cookie["ttl"] = f"{parse_duration(ck['TTL'])}s"
+                    cookie["ttl"] = _secs_str(
+                        parse_duration(ck["TTL"]))
                 except ValueError:
                     pass  # rejected at write time; belt here
             if ck.get("Path"):

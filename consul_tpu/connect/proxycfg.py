@@ -139,12 +139,19 @@ def assemble_snapshot(agent, proxy_id: str,
         phc = (_uc_overrides.get(uname) or {}).get(
             "PassiveHealthCheck") \
             or _uc_defaults.get("PassiveHealthCheck") or {}
+        limits = (_uc_overrides.get(uname) or {}).get("Limits") \
+            or _uc_defaults.get("Limits") or {}
+        cto = (_uc_overrides.get(uname) or {}).get(
+            "ConnectTimeoutMs") \
+            or _uc_defaults.get("ConnectTimeoutMs")
         upstreams.append({
             "DestinationName": uname,
             "LocalBindPort": u.get("LocalBindPort", 0),
             "Allowed": check.get("Allowed", False),
             "EnvoyExtensions": u_exts,
             "PassiveHealthCheck": phc,
+            "Limits": limits,
+            "ConnectTimeoutMs": cto,
             "Error": error,
             "Protocol": chain["Protocol"],
             "Routes": chain["Routes"],

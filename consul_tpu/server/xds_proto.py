@@ -115,6 +115,18 @@ _CLUSTER = {
     #: lb_policy=6: ROUND_ROBIN=0, CLUSTER_PROVIDED=6 (the
     #: ORIGINAL_DST passthrough cluster requires it)
     "lb_policy": Field(6, "enum"),
+    #: CircuitBreakers (circuit_breaker.proto): thresholds=1 repeated
+    #: Thresholds {max_connections=2, max_pending_requests=3,
+    #: max_requests=4}; Cluster.circuit_breakers=10
+    "circuit_breakers": Field(10, "message", {
+        "thresholds": Field(1, "message", {
+            "max_connections": Field(2, "message", _UINT32,
+                                     presence=True),
+            "max_pending_requests": Field(3, "message", _UINT32,
+                                          presence=True),
+            "max_requests": Field(4, "message", _UINT32,
+                                  presence=True),
+        }, repeated=True)}),
     #: OutlierDetection (outlier_detection.proto: consecutive_5xx=1,
     #: interval=2, base_ejection_time=3, max_ejection_percent=4,
     #: enforcing_consecutive_5xx=5); Cluster.outlier_detection=19
@@ -1021,6 +1033,13 @@ def lower_cluster(c: dict[str, Any]) -> bytes:
             {"key": k, "value": _pb_struct(v)}
             for k, v in sorted((c["metadata"].get("filter_metadata")
                                 or {}).items())]}
+    cb = c.get("circuit_breakers")
+    if cb:
+        msg["circuit_breakers"] = {"thresholds": [
+            {k: {"value": int(v)} for k, v in t.items()
+             if k in ("max_connections", "max_pending_requests",
+                      "max_requests")}
+            for t in cb.get("thresholds") or []]}
     od = c.get("outlier_detection")
     if od:
         msg["outlier_detection"] = {

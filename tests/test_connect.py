@@ -770,7 +770,7 @@ def test_passive_health_check_outlier_detection(agent, client):
     cfg = build_config(agent, "edge1-sidecar-proxy")
     cl = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
     d1 = cl["upstream_backend1_backend1"]["outlier_detection"]
-    assert d1["consecutive_5xx"] == 3 and d1["interval"] == "10.0s"
+    assert d1["consecutive_5xx"] == 3 and d1["interval"] == "10s"
     d2 = cl["upstream_backend2_backend2"]["outlier_detection"]
     assert d2["consecutive_5xx"] == 7
     assert d2["interval"] == "0.5s"
@@ -810,4 +810,60 @@ def test_passive_health_check_outlier_detection(agent, client):
         svcs = [s for s in client.agent_services()
                 if client.agent_services()[s]["Service"] == name]
         for s in svcs:
+            client.service_deregister(s)
+
+
+def test_upstream_limits_circuit_breakers(agent, client):
+    """UpstreamConfig.Limits (config_entry.go:1276) -> Cluster circuit
+    breakers; ConnectTimeoutMs overrides the connect timeout."""
+    from consul_tpu.server.rpc import RPCError
+    import pytest as _pytest
+
+    with _pytest.raises(RPCError, match="MaxConnections"):
+        agent.server.handle_rpc("ConfigEntry.Apply", {
+            "Op": "upsert", "Entry": {
+                "Kind": "service-defaults", "Name": "gate",
+                "UpstreamConfig": {"Defaults": {
+                    "Limits": {"MaxConnections": -2}}}}}, "t")
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "service-defaults", "Name": "gate",
+            "UpstreamConfig": {"Defaults": {
+                "ConnectTimeoutMs": 1500,
+                "Limits": {"MaxConnections": 100,
+                           "MaxPendingRequests": 0,
+                           "MaxConcurrentRequests": 50}}}}}, "t")
+    client.service_register({"Name": "db9", "Port": 7600})
+    client.service_register({
+        "Name": "gate", "ID": "gate1", "Port": 7601,
+        "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+            {"DestinationName": "db9", "LocalBindPort": 9696}]}}}})
+    wait_for(lambda: client.health_service("gate"),
+             what="gate in catalog")
+    from consul_tpu.server.grpc_external import build_config
+
+    cfg = build_config(agent, "gate1-sidecar-proxy")
+    cl = next(c for c in cfg["static_resources"]["clusters"]
+              if c["name"] == "upstream_db9_db9")
+    assert cl["connect_timeout"] == "1.5s"
+    th = cl["circuit_breakers"]["thresholds"][0]
+    assert th == {"max_connections": 100, "max_pending_requests": 0,
+                  "max_requests": 50}
+    # proto round trip (a configured 0 survives via wrapper presence)
+    from consul_tpu.server import xds_proto as xp
+    from consul_tpu.server.grpc_external import (CDS_TYPE,
+                                                 resources_from_cfg)
+    from consul_tpu.utils.pbwire import decode
+
+    cds = resources_from_cfg(cfg, CDS_TYPE)
+    cmsg = decode(xp._CLUSTER, cds["upstream_db9_db9"][1])
+    tmsg = cmsg["circuit_breakers"]["thresholds"][0]
+    assert tmsg["max_connections"]["value"] == 100
+    assert tmsg.get("max_pending_requests", {}).get("value", 0) == 0
+    assert "max_pending_requests" in tmsg  # presence on the wire
+    assert tmsg["max_requests"]["value"] == 50
+    assert cmsg["connect_timeout"] == {"seconds": 1, "nanos": 500000000}
+    client.service_deregister("gate1")
+    for s in list(client.agent_services()):
+        if client.agent_services()[s]["Service"] == "db9":
             client.service_deregister(s)
