@@ -275,13 +275,16 @@ def validate_entry(entry: dict) -> None:
                               "MaxConcurrentRequests"):
                         v = lim.get(k)
                         if v is not None and not (
-                                isinstance(v, int) and v >= 0):
+                                isinstance(v, int)
+                                and not isinstance(v, bool)
+                                and v >= 0):
                             raise ValueError(
                                 f"{where}.Limits.{k} must be a "
                                 "non-negative integer")
                 cto = block.get("ConnectTimeoutMs")
                 if cto is not None and not (
-                        isinstance(cto, (int, float)) and cto > 0):
+                        isinstance(cto, (int, float))
+                        and not isinstance(cto, bool) and cto > 0):
                     raise ValueError(
                         f"{where}.ConnectTimeoutMs must be a "
                         "positive number")

@@ -391,6 +391,7 @@ def bootstrap_config(snapshot: dict[str, Any],
                          "transport_sockets.tls.v3.UpstreamTlsContext",
                 "common_tls_context":
                     tls_context["common_tls_context"]}}
+        via_gateway = up.get("MeshGatewayMode") in ("local", "remote")
         outlier = _outlier_detection(up.get("PassiveHealthCheck")
                                      or {})
         # UpstreamConfig.Limits (config_entry.go:1276) → circuit
@@ -422,6 +423,20 @@ def bootstrap_config(snapshot: dict[str, Any],
                 # clusters.go injectLBToCluster — per target, never
                 # inherited from the chain head)
                 lbp = _lb_policy(t.get("LoadBalancer") or {})
+                ts = upstream_tls
+                if via_gateway:
+                    # gateway dialing is SNI-routed (_mesh_bootstrap
+                    # chains on <svc>.default.<dc>.internal.<domain>):
+                    # each cluster presents ITS OWN target's SNI — a
+                    # redirect/split target must not ride the
+                    # upstream name's SNI to the wrong service
+                    ts = {"name": "tls", "typed_config": {
+                        **upstream_tls["typed_config"],
+                        "sni": (f"{t['Service']}.default."
+                                f"{up.get('Datacenter', '')}."
+                                f"internal."
+                                f"{snapshot.get('TrustDomain', '')}"),
+                    }}
                 clusters.append({
                     "name": cname,
                     "type": "STATIC",
@@ -431,7 +446,7 @@ def bootstrap_config(snapshot: dict[str, Any],
                        if outlier else {}),
                     **({"circuit_breakers": breakers}
                        if breakers else {}),
-                    "transport_socket": upstream_tls,
+                    "transport_socket": ts,
                     "load_assignment": _endpoints(
                         cname, t.get("Endpoints", [])),
                 })

@@ -53,6 +53,35 @@ def _lookup_endpoints(rpc, svc: str, sidecar: bool = True,
              "Port": e["Service"]["Port"]} for e in nodes]
 
 
+def _gateway_endpoints(rpc, mode: str, dc: str) -> list[dict[str, Any]]:
+    """Mesh-gateway endpoints for a cross-DC upstream: this DC's
+    gateways ("local") or the target DC's ("remote" — federation
+    states first, then the remote catalog by ServiceKind)."""
+    if mode == "local":
+        res = rpc("Catalog.ServiceNodes", {
+            "ServiceKind": "mesh-gateway", "AllowStale": True})
+        return [{"Address": e.get("ServiceAddress")
+                 or e.get("Address", ""),
+                 "Port": e.get("ServicePort", 0)}
+                for e in res.get("ServiceNodes") or []]
+    try:
+        res = rpc("Internal.ListMeshGateways", {"AllowStale": True})
+        for fs in res.get("States") or []:
+            if fs.get("Datacenter") == dc and fs.get("MeshGateways"):
+                return [{"Address": g.get("Address", ""),
+                         "Port": g.get("Port", 0)}
+                        for g in fs["MeshGateways"]]
+    except Exception:  # noqa: BLE001 — fall through to the catalog
+        pass
+    res = rpc("Catalog.ServiceNodes", {
+        "ServiceKind": "mesh-gateway", "Datacenter": dc,
+        "AllowStale": True})
+    return [{"Address": e.get("ServiceAddress")
+             or e.get("Address", ""),
+             "Port": e.get("ServicePort", 0)}
+            for e in res.get("ServiceNodes") or []]
+
+
 def assemble_snapshot(agent, proxy_id: str,
                       rpc=None) -> Optional[dict[str, Any]]:
     """Build the ConfigSnapshot for a locally-registered connect proxy
@@ -98,6 +127,7 @@ def assemble_snapshot(agent, proxy_id: str,
     # to every upstream, Overrides by upstream name win — carries
     # PassiveHealthCheck for the outlier-detection lowering
     _local_sd = get_entry("service-defaults", dest_name) or {}
+    _local_pd = get_entry("proxy-defaults", "global") or {}
     _uc = _local_sd.get("UpstreamConfig") or {}
     _uc_defaults = _uc.get("Defaults") or {}
     _uc_overrides = {o.get("Name"): o
@@ -118,13 +148,60 @@ def assemble_snapshot(agent, proxy_id: str,
         # discovery chain: L7 routes + splitter weights + resolver
         # redirects; the LAST route is the default catch-all
         chain = compile_chain(uname, get_entry)
+        # cross-DC upstreams (Upstream.Datacenter + MeshGateway.Mode,
+        # proxycfg upstreams.go): "local" dials THIS DC's mesh
+        # gateways, "remote" the target DC's, "none"/"" the remote
+        # sidecars directly. Gateway dialing is SNI-routed, so the
+        # xDS builder pins the remote service SNI on the cluster.
+        udc = u.get("Datacenter") or ""
+        gw_mode = ""
+        if udc and udc != agent.config.datacenter:
+            # resolution order (structs.MeshGatewayConfig overlay):
+            # upstream > proxy registration > service-defaults >
+            # proxy-defaults global
+            gw_mode = ((u.get("MeshGateway") or {}).get("Mode")
+                       or (proxy.proxy.get("MeshGateway")
+                           or {}).get("Mode")
+                       or (_local_sd.get("MeshGateway")
+                           or {}).get("Mode")
+                       or (_local_pd.get("MeshGateway")
+                           or {}).get("Mode") or "none")
         try:
-            for route in chain["Routes"]:
-                for t in route["Targets"]:
-                    t["Endpoints"] = lookup_endpoints(t["Service"])
-                    if not t["Endpoints"] and t.get("Failover"):
-                        t["Endpoints"] = lookup_endpoints(t["Failover"])
-                        t["UsingFailover"] = bool(t["Endpoints"])
+            if gw_mode in ("local", "remote"):
+                eps = _gateway_endpoints(rpc, gw_mode, udc)
+                if not eps:
+                    error = (f"no {gw_mode} mesh gateways for "
+                             f"dc {udc!r}")
+                for route in chain["Routes"]:
+                    for t in route["Targets"]:
+                        t["Endpoints"] = eps
+            elif udc and udc != agent.config.datacenter:
+                def lookup_remote(svc: str) -> list:
+                    # same memo as the local path, keyed per DC — a
+                    # router fanning out to one remote service must
+                    # not pay N WAN round-trips per snapshot
+                    key = f"{udc}/{svc}"
+                    if key not in ep_memo:
+                        ep_memo[key] = _lookup_endpoints(rpc, svc,
+                                                         dc=udc)
+                    return ep_memo[key]
+
+                for route in chain["Routes"]:
+                    for t in route["Targets"]:
+                        t["Endpoints"] = lookup_remote(t["Service"])
+                        if not t["Endpoints"] and t.get("Failover"):
+                            t["Endpoints"] = lookup_remote(
+                                t["Failover"])
+                            t["UsingFailover"] = bool(t["Endpoints"])
+            else:
+                for route in chain["Routes"]:
+                    for t in route["Targets"]:
+                        t["Endpoints"] = lookup_endpoints(
+                            t["Service"])
+                        if not t["Endpoints"] and t.get("Failover"):
+                            t["Endpoints"] = lookup_endpoints(
+                                t["Failover"])
+                            t["UsingFailover"] = bool(t["Endpoints"])
         except Exception as e:  # noqa: BLE001
             # a degraded lookup must be VISIBLE, not an empty cluster
             # that silently blackholes traffic
@@ -152,6 +229,8 @@ def assemble_snapshot(agent, proxy_id: str,
             "PassiveHealthCheck": phc,
             "Limits": limits,
             "ConnectTimeoutMs": cto,
+            "Datacenter": udc,
+            "MeshGatewayMode": gw_mode,
             "Error": error,
             "Protocol": chain["Protocol"],
             "Routes": chain["Routes"],
@@ -230,8 +309,9 @@ def assemble_snapshot(agent, proxy_id: str,
         or agent.config.acl_default_policy == "allow"
     # the LOCAL service's protocol decides the inbound listener shape
     # (http → HCM with L7 RBAC): service-defaults, then proxy-defaults
-    sd = get_entry("service-defaults", dest_name) or {}
-    pd = get_entry("proxy-defaults", "global") or {}
+    # (both already fetched once at the top of assembly)
+    sd = _local_sd
+    pd = _local_pd
     protocol = (sd.get("Protocol") or pd.get("Protocol")
                 or "tcp").lower()
     # Envoy extension runtime config (extensionruntime/runtime_config.go

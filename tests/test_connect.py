@@ -867,3 +867,40 @@ def test_upstream_limits_circuit_breakers(agent, client):
     for s in list(client.agent_services()):
         if client.agent_services()[s]["Service"] == "db9":
             client.service_deregister(s)
+
+
+def test_cross_dc_upstream_via_mesh_gateway(agent, client):
+    """Upstream.Datacenter + MeshGateway.Mode=local (proxycfg
+    upstreams.go): the cluster's endpoints become THIS DC's mesh
+    gateways and the upstream TLS pins the remote service's SNI so
+    the gateway SNI-routes without terminating."""
+    client.service_register({
+        "Name": "mgw", "ID": "mgw1", "Kind": "mesh-gateway",
+        "Port": 4431, "Address": "10.0.0.9"})
+    client.service_register({
+        "Name": "web2", "ID": "web2x", "Port": 7800,
+        "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+            {"DestinationName": "billing", "Datacenter": "dc-east",
+             "MeshGateway": {"Mode": "local"},
+             "LocalBindPort": 9898}]}}}})
+    wait_for(lambda: client.health_service("web2"),
+             what="web2 in catalog")
+    from consul_tpu.server.grpc_external import build_config
+
+    cfg = build_config(agent, "web2x-sidecar-proxy")
+    cl = next(c for c in cfg["static_resources"]["clusters"]
+              if c["name"] == "upstream_billing_billing")
+    eps = cl["load_assignment"]["endpoints"][0]["lb_endpoints"]
+    addrs = {(e["endpoint"]["address"]["socket_address"]["address"],
+              e["endpoint"]["address"]["socket_address"]["port_value"])
+             for e in eps}
+    assert ("10.0.0.9", 4431) in addrs  # the LOCAL gateway
+    sni = cl["transport_socket"]["typed_config"]["sni"]
+    assert sni.startswith("billing.default.dc-east.internal.")
+    # rebuild determinism: same SNI and cluster set every assembly
+    td = build_config(agent, "web2x-sidecar-proxy")
+    cl2 = next(c for c in td["static_resources"]["clusters"]
+               if c["name"] == "upstream_billing_billing")
+    assert cl2["transport_socket"]["typed_config"]["sni"] == sni
+    client.service_deregister("web2x")
+    client.service_deregister("mgw1")
