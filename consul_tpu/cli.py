@@ -246,8 +246,12 @@ def cmd_kv(args) -> int:
 def cmd_catalog(args) -> int:
     c = _client(args)
     if args.catalog_cmd == "nodes":
+        # -filter rides the go-bexpr ?filter= param (catalog list
+        # commands accept the same expressions as the HTTP API)
+        params = {"filter": args.filter} if getattr(
+            args, "filter", "") else {}
         rows = [("Node", "ID", "Address")]
-        for n in c.catalog_nodes():
+        for n in c.get("/v1/catalog/nodes", **params):
             rows.append((n["Node"], n["ID"][:8], n["Address"]))
         _table(rows)
         return 0
@@ -1625,7 +1629,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     cat = sub.add_parser("catalog")
     catsub = cat.add_subparsers(dest="catalog_cmd", required=True)
-    catsub.add_parser("nodes")
+    cnodes = catsub.add_parser("nodes")
+    cnodes.add_argument("-filter", default="",
+                        help="go-bexpr filter expression")
     catsub.add_parser("services")
     catsub.add_parser("datacenters")
     cat.set_defaults(fn=cmd_catalog)

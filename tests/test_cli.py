@@ -369,3 +369,12 @@ def test_watch_long_tail_types(agent, tmp_path):
     rc, out = run(agent, "watch", "-type", "event",
                   "-name", "deploy-done", "-once")
     assert rc == 0 and "deploy-done" in out
+
+
+def test_catalog_nodes_filter(agent):
+    rc, out = run(agent, "catalog", "nodes", "-filter",
+                  'Node == "cliagent"')
+    assert rc == 0 and "cliagent" in out
+    rc, out = run(agent, "catalog", "nodes", "-filter",
+                  'Node == "no-such-node"')
+    assert rc == 0 and "cliagent" not in out
