@@ -251,7 +251,7 @@ def cmd_catalog(args) -> int:
         params = {"filter": args.filter} if getattr(
             args, "filter", "") else {}
         rows = [("Node", "ID", "Address")]
-        for n in c.get("/v1/catalog/nodes", **params):
+        for n in c.catalog_nodes(**params):
             rows.append((n["Node"], n["ID"][:8], n["Address"]))
         _table(rows)
         return 0

@@ -965,6 +965,28 @@ def _ingress_bootstrap(snapshot: dict[str, Any],
             "common_tls_context": gw_ctx["common_tls_context"]}}
     listeners, clusters, seen = [], [], set()
     addr = snapshot.get("Address") or "0.0.0.0"
+    entry_tls_enabled = bool((snapshot.get("TLS") or {}).get(
+        "Enabled"))
+
+    def _downstream_tls(lst: dict[str, Any]
+                        ) -> Optional[dict[str, Any]]:
+        """Ingress TLS termination (GatewayTLSConfig + per-listener
+        override, xds makeDownstreamTLSContextFromSnapshotListener-
+        Config): the GATEWAY's cert for external clients — NO client
+        certificate requirement and no mesh-roots validation, these
+        are not mesh peers."""
+        ltls = lst.get("TLS") or {}
+        enabled = ltls.get("Enabled", entry_tls_enabled)
+        if not enabled:
+            return None
+        ctc = dict(gw_ctx["common_tls_context"])
+        ctc.pop("validation_context", None)
+        ctc.pop("validation_context_sds_secret_config", None)
+        return {"name": "tls", "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions."
+                     "transport_sockets.tls.v3.DownstreamTlsContext",
+            "common_tls_context": ctc}}
+
     for lst in snapshot.get("Listeners") or []:
         port = lst["Port"]
         lname = f"ingress_{port}"
@@ -991,9 +1013,12 @@ def _ingress_bootstrap(snapshot: dict[str, Any],
                 continue
             filt = _tcp_filter(lname, f"ingress_{svc['Name']}",
                                svc["Routes"][-1]["Targets"])
+            dtls = _downstream_tls(lst)
             listeners.append({
                 "name": lname, "address": _addr(addr, port),
-                "filter_chains": [{"filters": [filt]}]})
+                "filter_chains": [{
+                    **({"transport_socket": dtls} if dtls else {}),
+                    "filters": [filt]}]})
         else:
             vhosts = []
             for s in lst["Services"]:
@@ -1024,9 +1049,12 @@ def _ingress_bootstrap(snapshot: dict[str, Any],
                     "route_config": {
                         "name": lname, "virtual_hosts": vhosts},
                 }}
+            dtls = _downstream_tls(lst)
             listeners.append({
                 "name": lname, "address": _addr(addr, port),
-                "filter_chains": [{"filters": [hcm]}]})
+                "filter_chains": [{
+                    **({"transport_socket": dtls} if dtls else {}),
+                    "filters": [hcm]}]})
     return _assemble(snapshot, admin_port, listeners, clusters,
                      secrets=secrets_from_snapshot(snapshot)
                      if sds else None)

@@ -414,8 +414,12 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
             listeners.append({
                 "Port": int(lst.get("Port") or 0),
                 "Protocol": (lst.get("Protocol") or "tcp").lower(),
+                "TLS": lst.get("TLS") or {},
                 "Services": svcs})
         snap["Listeners"] = listeners
+        # gateway-level TLS block (config_entry_gateways.go
+        # GatewayTLSConfig): per-listener TLS overrides it
+        snap["TLS"] = entry.get("TLS") or {}
 
     elif proxy.kind == "terminating-gateway":
         entry = get_entry("terminating-gateway", gw_name) or {}

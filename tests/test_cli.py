@@ -372,6 +372,8 @@ def test_watch_long_tail_types(agent, tmp_path):
 
 
 def test_catalog_nodes_filter(agent):
+    wait_for(lambda: agent.server.state.get_node("cliagent")
+             is not None, what="self registration")
     rc, out = run(agent, "catalog", "nodes", "-filter",
                   'Node == "cliagent"')
     assert rc == 0 and "cliagent" in out

@@ -274,3 +274,50 @@ def test_gateway_sds_mode():
     inl = bootstrap_config({**base, "Kind": "ingress-gateway",
                             "Listeners": []})
     assert "secrets" not in inl["static_resources"]
+
+
+def test_ingress_tls_termination(agent, client):
+    """Ingress GatewayTLSConfig (config_entry_gateways.go): entry-level
+    TLS.Enabled terminates TLS on every listener with the GATEWAY's
+    cert — no client-cert requirement, no mesh-roots validation
+    (external clients are not mesh peers); a per-listener TLS block
+    overrides the entry level."""
+    client.service_register({"Name": "webt", "Port": 7900})
+    agent.server.handle_rpc("ConfigEntry.Apply", {
+        "Op": "upsert", "Entry": {
+            "Kind": "ingress-gateway", "Name": "igw-tls",
+            "TLS": {"Enabled": True},
+            "Listeners": [
+                {"Port": 8161, "Protocol": "http",
+                 "Services": [{"Name": "webt"}]},
+                {"Port": 8162, "Protocol": "http",
+                 "TLS": {"Enabled": False},
+                 "Services": [{"Name": "webt"}]}]}}, "t")
+    client.service_register({
+        "Name": "igw-tls", "ID": "igwtls1", "Kind": "ingress-gateway",
+        "Port": 8160})
+    wait_for(lambda: client.health_service("igw-tls"),
+             what="gateway in catalog")
+    from consul_tpu.server.grpc_external import build_config
+
+    cfg = build_config(agent, "igwtls1")
+    listeners = {l["name"]: l
+                 for l in cfg["static_resources"]["listeners"]}
+    tls_chain = listeners["ingress_8161"]["filter_chains"][0]
+    ts = tls_chain["transport_socket"]["typed_config"]
+    assert "DownstreamTlsContext" in ts["@type"]
+    ctc = ts["common_tls_context"]
+    # gateway cert present, NO mesh validation context
+    assert "validation_context" not in ctc
+    assert "validation_context_sds_secret_config" not in ctc
+    assert "require_client_certificate" not in ts
+    try:
+        # per-listener override wins
+        assert "transport_socket" not in \
+            listeners["ingress_8162"]["filter_chains"][0]
+    finally:
+        client.service_deregister("igwtls1")
+        client.delete("/v1/config/ingress-gateway/igw-tls")
+        for s in list(client.agent_services()):
+            if client.agent_services()[s]["Service"] == "webt":
+                client.service_deregister(s)
