@@ -405,3 +405,29 @@ def test_lease_inhibited_during_leadership_transfer():
     new = wait_leader(clock, nodes)
     assert new is target
     assert leader.lease_read_index() is None
+
+
+def test_lease_timeout_zero_never_blocks_on_lagging_fsm():
+    """The _VerifyGate fast path calls lease_read_index(timeout=0)
+    from the mux READER thread: when the async applier lags behind
+    commit_index the lease must return None IMMEDIATELY (the read
+    falls back to the queued verify round) instead of parking the
+    connection on _applied_cv."""
+    import time as _time
+
+    clock, net, nodes, applied = make_cluster(3)
+    leader = wait_leader(clock, nodes)
+    leader.apply(b"w1")
+    clock.advance(0.05)
+    assert leader.lease_read_index(timeout=0.0) is not None
+    # simulate applier lag: pretend the FSM is one entry behind
+    with leader._lock:
+        leader.last_applied -= 1
+    try:
+        t0 = _time.monotonic()
+        assert leader.lease_read_index(timeout=0.0) is None
+        assert _time.monotonic() - t0 < 0.5, "timeout=0 parked"
+    finally:
+        with leader._lock:
+            leader.last_applied += 1
+    assert leader.lease_read_index(timeout=0.0) is not None
