@@ -40,6 +40,7 @@ import hashlib
 import json
 import queue
 import threading
+import time
 from typing import Any, Iterator, Optional
 
 from consul_tpu.utils import log
@@ -513,6 +514,20 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
     pending: dict[str, tuple[str, dict, dict]] = {}
     node_id = ""
     nonce_ctr = 0
+    # change-driven rebuilds (the reference's proxycfg push model):
+    # the snapshot fan-in is the expensive part (catalog + intentions
+    # + CA + chain per tick), so it only reruns when the state tables
+    # feeding it moved, a request arrived, or the SLOW fallback
+    # interval lapsed (leaf renewal has no table to bump — the
+    # half-validity check needs an occasional rebuild to run).
+    _ADS_TABLES = ("nodes", "services", "checks", "config_entries",
+                   "intentions", "peerings", "resources",
+                   "federation_states")
+    _state = getattr(agent.server, "state", None) \
+        if getattr(agent, "server", None) is not None else None
+    _SLOW_REBUILD_S = 30.0
+    last_state_idx: Optional[int] = None
+    last_rebuild = 0.0
 
     while True:
         try:
@@ -521,6 +536,7 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
                 return
         except queue.Empty:
             req = None
+        needs_build = False
         if req is not None:
             if not node_id:
                 node_id = (req.get("node") or {}).get("id", "")
@@ -562,9 +578,36 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
             for kv in req.get("initial_resource_versions") or []:
                 st.sent.setdefault(kv.get("key", ""),
                                    kv.get("value", ""))
+            # only requests that change WHAT is subscribed warrant a
+            # fresh snapshot — a pure ACK after each pushed type must
+            # not refire the fan-in it just paid for
+            needs_build = bool(
+                req.get("resource_names_subscribe")
+                or req.get("resource_names_unsubscribe")
+                or req.get("initial_resource_versions")
+                or not nonce)
 
         if not any(st.wildcard or st.names for st in subs.values()):
             continue
+        now = time.monotonic()
+        cur_idx = _state.table_index(*_ADS_TABLES) \
+            if _state is not None else None
+        # cross-DC snapshot inputs (remote upstream endpoints, remote
+        # gateways) never bump LOCAL tables — streams for such proxies
+        # keep a short poll so remote changes still propagate fast
+        fallback = _SLOW_REBUILD_S
+        _proxy = agent.local.list_services().get(node_id) \
+            if node_id else None
+        if _proxy is not None and (
+                _proxy.kind == "mesh-gateway"
+                or any((u.get("Datacenter") or "")
+                       not in ("", agent.config.datacenter)
+                       for u in _proxy.proxy.get("Upstreams") or [])):
+            fallback = 2.0
+        if not needs_build and _state is not None \
+                and cur_idx == last_state_idx \
+                and now - last_rebuild < fallback:
+            continue  # nothing moved: skip the snapshot fan-in
         # ONE snapshot fan-in per tick; every subscribed type derives
         # from it (they all view the same bootstrap config)
         try:
@@ -574,6 +617,8 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
             # bootstrap) must not kill the stream; retry next tick
             logger.warning("snapshot for %s failed: %s", node_id, e)
             continue
+        last_state_idx = cur_idx
+        last_rebuild = now
         if cfg is None:
             continue  # proxy not registered (yet)
         for t, st in subs.items():

@@ -778,3 +778,46 @@ def test_sds_leaf_rotation_no_listener_churn(agent, client):
         == {n: v for n, (v, _) in lds1.items()}, "listener churn"
     assert {n: v for n, (v, _) in cds2.items()} \
         == {n: v for n, (v, _) in cds1.items()}, "cluster churn"
+
+
+def test_ads_rebuilds_are_change_driven(agent, client):
+    """The snapshot fan-in (the expensive part of serving a stream)
+    reruns only when the state tables feeding it move, a request
+    arrives, or the slow fallback lapses — NOT on every 0.5s tick
+    (the reference's proxycfg push model). Pinned by counting
+    build_config calls while a subscribed stream idles."""
+    from consul_tpu.server import grpc_external as ge
+
+    calls = []
+    orig = ge.build_config
+
+    def counting(agent_, proxy_id):
+        calls.append(time.monotonic())
+        return orig(agent_, proxy_id)
+
+    s = AdsStream(agent.grpc_port)
+    ge.build_config = counting
+    try:
+        s.send(type_url=CDS_TYPE,
+               node={"id": PROXY_ID},
+               resource_names_subscribe=["*"])
+        s.settle()
+        calls.clear()
+        time.sleep(3.0)  # idle: ~6 poll ticks
+        idle_builds = len(calls)
+        assert idle_builds <= 1, \
+            f"{idle_builds} snapshot rebuilds while idle"
+        # a catalog change triggers a rebuild + push promptly
+        client.service_register({"Name": "spark", "ID": "spark1",
+                                 "Port": 7950})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(calls) == idle_builds:
+            time.sleep(0.2)
+        assert len(calls) > idle_builds, "state change never rebuilt"
+    finally:
+        ge.build_config = orig
+        s.close()
+        try:
+            client.service_deregister("spark1")
+        except Exception:
+            pass  # not registered when an earlier assert fired
