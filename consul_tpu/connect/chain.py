@@ -214,6 +214,80 @@ def validate_entry(entry: dict) -> None:
             for s in dicts(svcs, "Services"):
                 if not s.get("Name"):
                     raise ValueError("ingress service requires Name")
+    elif kind == "api-gateway":
+        # structs/config_entry_gateways.go:983 APIGatewayListener
+        listeners = entry.get("Listeners")
+        if not isinstance(listeners, list) or not listeners:
+            raise ValueError("api-gateway requires Listeners")
+        names: set = set()
+        ports: set = set()
+        for lst in dicts(listeners, "Listeners"):
+            lname = lst.get("Name", "")
+            if not lname:
+                raise ValueError("api-gateway listener requires Name")
+            if lname in names:
+                raise ValueError(
+                    f"duplicate api-gateway listener name {lname!r}")
+            names.add(lname)
+            port = int(lst.get("Port") or 0)
+            if not port:
+                raise ValueError("api-gateway listener requires Port")
+            if port in ports:
+                # two listeners on one address:port would fail at
+                # Envoy bind time, taking the whole gateway down —
+                # reject the write instead
+                raise ValueError(
+                    f"duplicate api-gateway listener port {port}")
+            ports.add(port)
+            proto = (lst.get("Protocol") or "").lower()
+            if proto not in ("http", "tcp"):
+                raise ValueError(
+                    "api-gateway listener Protocol must be http or "
+                    "tcp")
+            for cert in (lst.get("TLS") or {}).get("Certificates") \
+                    or []:
+                if not isinstance(cert, dict) or not cert.get("Name"):
+                    raise ValueError(
+                        "api-gateway TLS certificate ref requires "
+                        "Name")
+    elif kind in ("http-route", "tcp-route"):
+        # structs/config_entry_routes.go HTTPRouteConfigEntry /
+        # TCPRouteConfigEntry: routes bind to gateways via Parents
+        parents = entry.get("Parents")
+        if not isinstance(parents, list) or not parents:
+            raise ValueError(f"{kind} requires Parents")
+        for p in dicts(parents, "Parents"):
+            if not p.get("Name"):
+                raise ValueError(f"{kind} parent requires Name")
+        if kind == "tcp-route":
+            svcs = entry.get("Services") or []
+            for s in dicts(svcs, "Services"):
+                if not s.get("Name"):
+                    raise ValueError("tcp-route service requires Name")
+        else:
+            for rn, rule in enumerate(dicts(
+                    entry.get("Rules") or [], "Rules")):
+                for s in dicts(rule.get("Services") or [],
+                               f"Rules[{rn}].Services"):
+                    if not s.get("Name"):
+                        raise ValueError(
+                            f"Rules[{rn}] service requires Name")
+                for m in dicts(rule.get("Matches") or [],
+                               f"Rules[{rn}].Matches"):
+                    path = m.get("Path")
+                    if path is not None and (
+                            not isinstance(path, dict)
+                            or path.get("Match") not in
+                            ("exact", "prefix", "regex")
+                            or not path.get("Value")):
+                        raise ValueError(
+                            f"Rules[{rn}] Path match needs Match "
+                            "exact/prefix/regex and Value")
+    elif kind == "inline-certificate":
+        if not entry.get("Certificate") or not entry.get("PrivateKey"):
+            raise ValueError(
+                "inline-certificate requires Certificate and "
+                "PrivateKey")
     elif kind == "terminating-gateway":
         svcs = entry.get("Services")
         if not isinstance(svcs, list) or not svcs:

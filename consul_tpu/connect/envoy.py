@@ -326,6 +326,11 @@ def bootstrap_config(snapshot: dict[str, Any],
         # pure SNI passthrough, no TLS termination → nothing to serve
         return _post_process(_mesh_bootstrap(snapshot, admin_port),
                              snapshot)
+    if kind == "api-gateway":
+        return _post_process(_api_gateway_bootstrap(snapshot,
+                                                    admin_port,
+                                                    sds=sds),
+                             snapshot)
     svc = snapshot.get("Service", "")
     if sds:
         # SDS mode (xds secrets.go:18-27): TLS contexts REFERENCE
@@ -1111,6 +1116,216 @@ def _terminating_bootstrap(snapshot: dict[str, Any],
     return _assemble(snapshot, admin_port, listeners, clusters,
                      secrets=secrets_from_snapshot(snapshot)
                      if sds else None)
+
+
+def _api_gateway_bootstrap(snapshot: dict[str, Any],
+                           admin_port: int,
+                           sds: bool = False) -> dict[str, Any]:
+    """API gateway (structs APIGateway + http-route/tcp-route/
+    inline-certificate, agent/proxycfg api_gateway.go): north-south
+    traffic in, routed by the gateway-API route entries, dialed into
+    the mesh over mTLS with the GATEWAY's identity. Listener TLS
+    terminates with the operator's inline-certificate — external
+    clients are not mesh peers."""
+    gw_ctx = _sds_tls_context(snapshot.get("Service", "")) if sds \
+        else _tls_context(snapshot)
+    upstream_tls = {
+        "name": "tls",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions."
+                     "transport_sockets.tls.v3.UpstreamTlsContext",
+            "common_tls_context": gw_ctx["common_tls_context"]}}
+    addr = snapshot.get("Address") or "0.0.0.0"
+    listeners, clusters, seen = [], [], set()
+
+    def cluster_for(svc: dict[str, Any]) -> str:
+        cname = f"apigw_{svc['Name']}"
+        if cname not in seen:
+            seen.add(cname)
+            clusters.append({
+                "name": cname, "type": "STATIC",
+                "connect_timeout": "5s",
+                "transport_socket": upstream_tls,
+                "load_assignment": _endpoints(
+                    cname, svc.get("Endpoints", []))})
+        return cname
+
+    def action(svcs: list[dict[str, Any]]) -> dict[str, Any]:
+        if len(svcs) == 1:
+            return {"cluster": cluster_for(svcs[0])}
+        return {"weighted_clusters": {"clusters": [
+            {"name": cluster_for(s),
+             "weight": int(s.get("Weight") or 1)} for s in svcs]}}
+
+    for lst in snapshot.get("Listeners") or []:
+        lname = f"apigw_{lst['Name']}"
+        dtls = None
+        if (lst.get("TLS") or {}).get("Error"):
+            # TLS configured but unresolvable (deleted/typo'd
+            # inline-certificate): FAIL CLOSED — drop the listener,
+            # never serve the HTTPS port as plaintext
+            continue
+        if lst.get("TLS"):
+            dtls = {"name": "tls", "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "transport_sockets.tls.v3."
+                         "DownstreamTlsContext",
+                "common_tls_context": {"tls_certificates": [{
+                    "certificate_chain": {"inline_string":
+                                          lst["TLS"]["Certificate"]},
+                    "private_key": {"inline_string":
+                                    lst["TLS"]["PrivateKey"]}}]}}}
+        if lst["Protocol"] == "tcp":
+            svcs = [s for r in lst.get("Routes") or []
+                    for s in r.get("Services") or []]
+            if not svcs:
+                continue
+            filt = {"name": "envoy.filters.network.tcp_proxy",
+                    "typed_config": {
+                        "@type": "type.googleapis.com/envoy."
+                                 "extensions.filters.network."
+                                 "tcp_proxy.v3.TcpProxy",
+                        "stat_prefix": lname, **action(svcs)}}
+            listeners.append({
+                "name": lname, "address": _addr(addr, lst["Port"]),
+                "filter_chains": [{
+                    **({"transport_socket": dtls} if dtls else {}),
+                    "filters": [filt]}]})
+            continue
+        # vhosts keyed by DOMAIN SET: two routes sharing hostnames
+        # (or both hostname-less -> "*") merge into one virtual host —
+        # duplicate domains across vhosts would make Envoy reject the
+        # whole route config. Route hostnames INTERSECT the listener's
+        # (gateway-API semantics): no intersection -> the route is not
+        # programmed on this listener.
+        by_domains: dict[tuple, dict[str, Any]] = {}
+        for r in lst.get("Routes") or []:
+            domains = _route_domains(r.get("Hostnames") or [],
+                                     lst.get("Hostname", ""))
+            if not domains:
+                continue  # hostname intersection is empty
+            envoy_routes = []
+            for rule in r.get("Rules") or []:
+                if not rule.get("Services"):
+                    continue
+                act = action(rule["Services"])
+                matches = rule.get("Matches") or [None]
+                for m in matches:
+                    envoy_routes.append({
+                        "match": _http_route_match(m),
+                        "route": act})
+            if not envoy_routes:
+                continue
+            key = tuple(domains)
+            vh = by_domains.setdefault(key, {
+                "name": r.get("Name", lname), "domains": domains,
+                "routes": []})
+            vh["routes"].extend(envoy_routes)
+        vhosts = list(by_domains.values())
+        if not vhosts:
+            continue
+        hcm = {
+            "name": "envoy.filters.network.http_connection_manager",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions."
+                         "filters.network.http_connection_manager."
+                         "v3.HttpConnectionManager",
+                "stat_prefix": lname,
+                "http_filters": [{
+                    "name": "envoy.filters.http.router",
+                    "typed_config": {
+                        "@type": "type.googleapis.com/envoy."
+                                 "extensions.filters.http.router."
+                                 "v3.Router"}}],
+                "route_config": {"name": lname,
+                                 "virtual_hosts": vhosts},
+            }}
+        listeners.append({
+            "name": lname, "address": _addr(addr, lst["Port"]),
+            "filter_chains": [{
+                **({"transport_socket": dtls} if dtls else {}),
+                "filters": [hcm]}]})
+    return _assemble(snapshot, admin_port, listeners, clusters,
+                     secrets=secrets_from_snapshot(snapshot)
+                     if sds else None)
+
+
+def _route_domains(route_hosts: list[str],
+                   listener_host: str) -> list[str]:
+    """Gateway-API hostname intersection: route hostnames restrict to
+    the listener's; empty intersection means the route is not
+    programmed. A '*.' wildcard on either side matches suffixes."""
+    if not listener_host:
+        return sorted(route_hosts) or ["*"]
+    if not route_hosts:
+        return [listener_host]
+
+    def compatible(rh: str) -> bool:
+        if rh == listener_host or rh == "*" or listener_host == "*":
+            return True
+        if listener_host.startswith("*.") \
+                and rh.endswith(listener_host[1:]):
+            return True
+        if rh.startswith("*.") and listener_host.endswith(rh[1:]):
+            return True
+        return False
+
+    out = []
+    for rh in sorted(route_hosts):
+        if compatible(rh):
+            # the MORE specific side wins (a wildcard route on an
+            # exact-host listener serves the listener's host)
+            out.append(listener_host if rh.startswith("*.")
+                       and not listener_host.startswith("*.") else rh)
+    return sorted(set(out))
+
+
+def _http_route_match(m: Optional[dict[str, Any]]) -> dict[str, Any]:
+    """gateway-API HTTPMatch (config_entry_routes.go:384) → Envoy
+    RouteMatch: Path exact/prefix/regex, header matches
+    (exact/prefix/suffix/regex/present), Method, Query params."""
+    if not m:
+        return {"prefix": "/"}
+    out: dict[str, Any] = {}
+    path = m.get("Path") or {}
+    if path.get("Match") == "exact":
+        out["path"] = path.get("Value", "/")
+    elif path.get("Match") == "regex":
+        out["safe_regex"] = {"regex": path.get("Value", "")}
+    else:
+        out["prefix"] = path.get("Value") or "/"
+    headers = []
+    for h in m.get("Headers") or []:
+        hm: dict[str, Any] = {"name": h.get("Name", "")}
+        kind = (h.get("Match") or "exact").lower()
+        if kind == "present":
+            hm["present_match"] = True
+        elif kind in ("exact", "prefix", "suffix"):
+            hm["string_match"] = {kind: h.get("Value", "")}
+        elif kind == "regex":
+            hm["string_match"] = {"safe_regex": {
+                "regex": h.get("Value", "")}}
+        headers.append(hm)
+    if m.get("Method"):
+        headers.append({"name": ":method", "string_match": {
+            "exact": str(m["Method"]).upper()}})
+    if headers:
+        out["headers"] = headers
+    qs = []
+    for q in m.get("Query") or []:
+        qm: dict[str, Any] = {"name": q.get("Name", "")}
+        qkind = (q.get("Match") or "exact").lower()
+        if qkind == "present":
+            qm["present_match"] = True
+        elif qkind == "regex":
+            qm["string_match"] = {"safe_regex": {
+                "regex": q.get("Value", "")}}
+        else:
+            qm["string_match"] = {"exact": q.get("Value", "")}
+        qs.append(qm)
+    if qs:
+        out["query_parameters"] = qs
+    return out
 
 
 def _mesh_bootstrap(snapshot: dict[str, Any],

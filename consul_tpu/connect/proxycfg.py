@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 GATEWAY_KINDS = ("ingress-gateway", "terminating-gateway",
-                 "mesh-gateway")
+                 "mesh-gateway", "api-gateway")
 
 # guards the per-agent exposed-port allocator (Expose.Checks):
 # snapshot assembly runs concurrently on the xDS server's executor
@@ -420,6 +420,96 @@ def _gateway_snapshot(agent, proxy, rpc) -> dict[str, Any]:
         # gateway-level TLS block (config_entry_gateways.go
         # GatewayTLSConfig): per-listener TLS overrides it
         snap["TLS"] = entry.get("TLS") or {}
+
+    elif proxy.kind == "api-gateway":
+        # structs/config_entry_gateways.go APIGateway + the route
+        # entries (config_entry_routes.go): routes BIND to gateway
+        # listeners via Parents {Name, SectionName}; listener TLS
+        # terminates with inline-certificate entries (external
+        # clients), upstream dialing rides the mesh with the
+        # GATEWAY's identity like ingress
+        entry = get_entry("api-gateway", gw_name) or {}
+        # route listing failures propagate: a transient RPC error
+        # must fail the snapshot loudly (the ADS loop retries), never
+        # silently serve a gateway with zero routes
+        http_routes = rpc("ConfigEntry.List", {
+            "Kind": "http-route"}).get("Entries") or []
+        tcp_routes = rpc("ConfigEntry.List", {
+            "Kind": "tcp-route"}).get("Entries") or []
+        ep_memo2: dict[str, list] = {}
+
+        def eps_of(svc: str) -> list:
+            if svc not in ep_memo2:
+                ep_memo2[svc] = _lookup_endpoints(rpc, svc)
+            return ep_memo2[svc]
+
+        def bound(route: dict[str, Any], lname: str) -> bool:
+            return any(
+                p.get("Name") == gw_name
+                and p.get("SectionName", "") in ("", lname)
+                for p in route.get("Parents") or [])
+
+        listeners = []
+        for lst in entry.get("Listeners") or []:
+            lname = lst.get("Name", "")
+            proto = (lst.get("Protocol") or "http").lower()
+            tls = None
+            cert_refs = (lst.get("TLS") or {}).get(
+                "Certificates") or []
+            for cert_ref in cert_refs:
+                ce = get_entry("inline-certificate",
+                               cert_ref.get("Name", ""))
+                if ce and ce.get("Certificate") \
+                        and ce.get("PrivateKey"):
+                    tls = {"Certificate": ce["Certificate"],
+                           "PrivateKey": ce["PrivateKey"]}
+                    break
+            if cert_refs and tls is None:
+                # TLS was CONFIGURED but no certificate resolves
+                # (deleted entry, typo'd name): fail closed — the
+                # builder must drop the listener, never serve the
+                # HTTPS port as plaintext
+                tls = {"Error": "unresolved inline-certificate"}
+            lroutes = []
+            if proto == "http":
+                for r in http_routes:
+                    if not bound(r, lname):
+                        continue
+                    rules = []
+                    for rule in r.get("Rules") or []:
+                        svcs = [{"Name": s.get("Name", ""),
+                                 "Weight": int(s.get("Weight") or 1),
+                                 "Endpoints": eps_of(
+                                     s.get("Name", ""))}
+                                for s in rule.get("Services") or []
+                                if s.get("Name")]
+                        rules.append({
+                            "Matches": rule.get("Matches") or [],
+                            "Services": svcs})
+                    lroutes.append({
+                        "Name": r.get("Name", ""),
+                        "Hostnames": r.get("Hostnames") or [],
+                        "Rules": rules})
+            else:
+                for r in tcp_routes:
+                    if not bound(r, lname):
+                        continue
+                    lroutes.append({
+                        "Name": r.get("Name", ""),
+                        "Services": [
+                            {"Name": s.get("Name", ""),
+                             "Weight": int(s.get("Weight") or 1),
+                             "Endpoints": eps_of(s.get("Name", ""))}
+                            for s in r.get("Services") or []
+                            if s.get("Name")]})
+            listeners.append({
+                "Name": lname,
+                "Port": int(lst.get("Port") or 0),
+                "Protocol": proto,
+                "Hostname": lst.get("Hostname", ""),
+                "TLS": tls,
+                "Routes": lroutes})
+        snap["Listeners"] = listeners
 
     elif proxy.kind == "terminating-gateway":
         entry = get_entry("terminating-gateway", gw_name) or {}
