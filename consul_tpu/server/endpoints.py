@@ -2086,6 +2086,48 @@ def register_endpoints(srv) -> None:
                         out.append({"Gateway": gw,
                                     "Service": s.get("Name"),
                                     "GatewayKind": kind})
+            # api-gateway fronts whatever its BOUND routes reference
+            # (config_entry_routes.go Parents) — binding honors
+            # SectionName AND listener protocol (a tcp-route naming
+            # an http listener never attaches, so it must not be
+            # reported), deduped (a service referenced by N rules is
+            # fronted once, or the UI drill-down would N-plicate it)
+            apigw = state.raw_get("config_entries",
+                                  f"api-gateway/{gw}")
+            if apigw is not None:
+                lst_proto = {(l.get("Name") or ""):
+                             (l.get("Protocol") or "").lower()
+                             for l in apigw.get("Listeners") or []}
+
+                def binds(r, want_proto):
+                    for p in r.get("Parents") or []:
+                        if p.get("Name") != gw:
+                            continue
+                        sec = p.get("SectionName", "")
+                        if sec:
+                            if lst_proto.get(sec) == want_proto:
+                                return True
+                        elif want_proto in lst_proto.values():
+                            return True
+                    return False
+
+                seen_svcs = set()
+                for r in state.raw_list("config_entries"):
+                    rkind = r.get("Kind")
+                    if rkind == "http-route" and binds(r, "http"):
+                        svcs = [s for rule in r.get("Rules") or []
+                                for s in rule.get("Services") or []]
+                    elif rkind == "tcp-route" and binds(r, "tcp"):
+                        svcs = r.get("Services") or []
+                    else:
+                        continue
+                    for s in svcs:
+                        name = s.get("Name")
+                        if name and name not in seen_svcs:
+                            seen_svcs.add(name)
+                            out.append({"Gateway": gw,
+                                        "Service": name,
+                                        "GatewayKind": "api-gateway"})
             return {"Services": out}
 
         return srv.blocking_query(args, ("config_entries",), run)

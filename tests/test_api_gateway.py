@@ -216,3 +216,40 @@ def test_api_gateway_fail_closed_and_vhost_merge(agent, client):
                            ("http-route", "rb"),
                            ("http-route", "rforeign")):
             client.delete(f"/v1/config/{kind}/{name}")
+
+
+def test_gateway_services_lists_api_gateway_routes(agent, client):
+    """catalog/gateway-services covers api-gateways: the fronted set
+    is whatever the BOUND routes reference (Parents), powering the
+    UI's gateway drill-down for this kind too."""
+    _apply(agent, {"Kind": "inline-certificate", "Name": "gsc",
+                   "Certificate": CERT, "PrivateKey": KEY})
+    _apply(agent, {"Kind": "api-gateway", "Name": "edge3",
+                   "Listeners": [{"Name": "l1", "Port": 9180,
+                                  "Protocol": "http"}]})
+    _apply(agent, {"Kind": "http-route", "Name": "gs-route",
+                   "Parents": [{"Name": "edge3"}],
+                   "Rules": [{"Services": [{"Name": "svc-a"},
+                                           {"Name": "svc-b"}]},
+                             {"Services": [{"Name": "svc-a"}]}]})
+    # a tcp-route bound to an http-only gateway never attaches and
+    # must NOT be reported as fronted
+    _apply(agent, {"Kind": "tcp-route", "Name": "gs-tcp",
+                   "Parents": [{"Name": "edge3",
+                                "SectionName": "l1"}],
+                   "Services": [{"Name": "svc-tcp"}]})
+    try:
+        res = agent.server.handle_rpc("Internal.GatewayServices",
+                                      {"Gateway": "edge3"}, "t")
+        rows = [(r["Service"], r["GatewayKind"])
+                for r in res["Services"]]
+        # deduped (svc-a referenced by two rules appears once) and
+        # protocol-aware (svc-tcp excluded)
+        assert sorted(rows) == [("svc-a", "api-gateway"),
+                                ("svc-b", "api-gateway")]
+    finally:
+        for kind, name in (("api-gateway", "edge3"),
+                           ("http-route", "gs-route"),
+                           ("tcp-route", "gs-tcp"),
+                           ("inline-certificate", "gsc")):
+            client.delete(f"/v1/config/{kind}/{name}")
