@@ -56,10 +56,62 @@ def _arm_watchdog(platform: str, metric: str) -> threading.Timer:
     return t
 
 
+def run_chaos_bench(smoke: bool) -> None:
+    """`bench.py --chaos [--smoke]`: the detection-quality chaos suite —
+    every named fault class (sim/scenarios.chaos_plans) through the
+    batched engine with per-phase stats tracing. Prints ONE JSON object
+    keyed by scenario; recorded alongside BENCH_*.json so the perf
+    trajectory carries a robustness axis."""
+    metric = "chaos_detection_quality" + ("_smoke" if smoke else "")
+    want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
+    watchdog = _arm_watchdog(want, metric)
+    try:
+        import jax
+
+        if smoke:
+            jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        print(_error_line(f"backend init failed: {e}", want, metric))
+        sys.exit(1)
+    watchdog.cancel()
+    # init proved the device answers; re-arm with a generous budget so
+    # a hung Mosaic compile still can't wedge the process while a
+    # legitimately slow 5-scenario run is left alone
+
+    def fire() -> None:
+        print(_error_line(
+            f"chaos suite exceeded {_INIT_TIMEOUT_S * 10:.0f}s "
+            "(compile or run hung)", want, metric), flush=True)
+        os._exit(1)
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S * 10, fire)
+    watchdog.daemon = True
+    watchdog.start()
+
+    from consul_tpu.sim.scenarios import run_chaos_suite
+
+    n = 1024 if smoke else 65_536
+    t0 = time.perf_counter()
+    suite = run_chaos_suite(n=n)
+    watchdog.cancel()
+    print(json.dumps({
+        "metric": metric,
+        "platform": jax.default_backend(),
+        "n": n,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "scenarios": suite,
+    }))
+
+
 def main() -> None:
     # Local CPU smoke mode (documented in README): tiny cluster, same
     # code path end to end, finishes in ~a minute on one core.
     smoke = "--smoke" in sys.argv[1:]
+    if "--chaos" in sys.argv[1:]:
+        run_chaos_bench(smoke)
+        return
     metric = ("gossip_rounds_per_sec_smoke" if smoke
               else "gossip_rounds_per_sec_1M_nodes")
     want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")

@@ -56,6 +56,8 @@ def cmd_agent(args) -> int:
         overrides["gossip_sim"] = args.gossip_sim
     if args.gossip_sim_nodes:
         overrides["gossip_sim_nodes"] = args.gossip_sim_nodes
+    if getattr(args, "gossip_sim_chaos", None):
+        overrides["gossip_sim_chaos"] = args.gossip_sim_chaos
     if any(x is not None for x in (args.http_port, args.dns_port,
                                    args.serf_port, args.server_port,
                                    args.serf_wan_port)):
@@ -129,22 +131,137 @@ def cmd_agent(args) -> int:
     return 0
 
 
+#: backend init deadline for `-gossip-sim` (seconds). Same failure
+#: mode bench.py guards against: on a host without the accelerator,
+#: libtpu blocks forever in C instead of erroring.
+_SIM_INIT_TIMEOUT_S = float(
+    os.environ.get("CONSUL_TPU_SIM_INIT_TIMEOUT", "60"))
+#: compile + run deadline, armed only after backend init succeeds —
+#: generous (a 1M-node run is legitimately slow) but finite, so a
+#: Mosaic compile hung in the tunnel still can't wedge the process
+_SIM_RUN_TIMEOUT_S = float(
+    os.environ.get("CONSUL_TPU_SIM_RUN_TIMEOUT",
+                   str(_SIM_INIT_TIMEOUT_S * 10)))
+
+_SIM_PLATFORMS = ("cpu", "tpu", "gpu")
+
+
+def _sim_error(msg: str, platform: str) -> int:
+    """One parseable JSON error line on stdout, non-zero exit."""
+    print(json.dumps({"gossip_sim_error": msg, "platform": platform}),
+          flush=True)
+    return 1
+
+
 def _run_gossip_sim(cfg) -> int:
-    """`agent -dev -gossip-sim=tpu`: the BASELINE north-star mode — run N
-    virtual members on the TPU simulation backend and report."""
-    import jax
+    """`agent -dev -gossip-sim=<platform>`: the BASELINE north-star mode
+    — run N virtual members on the simulation backend and report.
+
+    The platform argument is HONORED (VERDICT round 5: `-gossip-sim=cpu`
+    used to init the default backend anyway and hang on TPU-less
+    hosts): jax is pinned to the requested platform before backend
+    init, and a watchdog turns a hung init/compile into a structured
+    JSON error instead of a stuck process. With -gossip-sim-chaos the
+    run executes a named FaultPlan from the chaos suite end to end and
+    reports per-phase detection quality."""
+    import threading
+
+    platform = cfg.gossip_sim.lower()
+    if platform not in _SIM_PLATFORMS:
+        return _sim_error(
+            f"unknown -gossip-sim platform {cfg.gossip_sim!r} "
+            f"(expected one of {', '.join(_SIM_PLATFORMS)})", platform)
+
+    def arm(budget: float, what: str):
+        # the main thread is blocked inside C (libtpu init or Mosaic
+        # compile) and cannot be interrupted — hard-exit after the
+        # error line, exactly like bench.py's watchdog
+        def fire() -> None:
+            print(json.dumps({
+                "gossip_sim_error":
+                    f"{what} exceeded {budget:.0f}s "
+                    f"(device absent or tunnel hung)",
+                "platform": platform}), flush=True)
+            os._exit(1)
+
+        t = threading.Timer(budget, fire)
+        t.daemon = True
+        t.start()
+        return t
+
+    # The INIT watchdog must be a separate PROCESS: libtpu waiting for
+    # an absent device spins in C without releasing the GIL, so an
+    # in-process Timer thread never gets scheduled (observed with
+    # jax_platforms=tpu on a TPU-less host — the bench.py-style thread
+    # watchdog silently never fires there). The watcher shares our
+    # stdout: it prints the structured error line itself, then SIGKILLs
+    # us — the GIL can't block another process.
+    import subprocess
+
+    err_line = json.dumps({
+        "gossip_sim_error":
+            f"backend init exceeded {_SIM_INIT_TIMEOUT_S:.0f}s "
+            f"(device absent or tunnel hung)",
+        "platform": platform})
+    watcher = subprocess.Popen([sys.executable, "-c", (
+        "import os, signal, sys, time\n"
+        f"time.sleep({_SIM_INIT_TIMEOUT_S})\n"
+        f"print({err_line!r}, flush=True)\n"
+        "try:\n"
+        f"    os.kill({os.getpid()}, signal.SIGKILL)\n"
+        "except ProcessLookupError:\n"
+        "    pass\n")])
+    try:
+        import jax
+
+        # jax.config.update, NOT the env var: the image's site hook
+        # re-pins jax_platforms at interpreter startup (see bench.py) —
+        # only a runtime config update actually restricts backend init
+        jax.config.update("jax_platforms", platform)
+        jax.devices()  # blocking backend init, under the watcher
+    except Exception as e:  # noqa: BLE001 — plugin/init errors
+        watcher.kill()
+        return _sim_error(f"backend init failed: {e}", platform)
+    watcher.kill()
+    # init proved the device answers; compile/run release the GIL, so
+    # a plain Timer suffices, with a budget that bounds a hung Mosaic
+    # compile without killing a legitimately big simulation
+    watchdog = arm(_SIM_RUN_TIMEOUT_S, "simulation compile/run")
 
     from consul_tpu.sim import SimParams, init_state, run_rounds
     from consul_tpu.sim.metrics import fd_report
 
     n = cfg.gossip_sim_nodes
-    p = SimParams.from_gossip_config(cfg.gossip_lan, n=n, loss=0.01)
-    rounds = 100
-    print(f"==> gossip-sim={cfg.gossip_sim}: {n} virtual members, "
-          f"{rounds} rounds on {jax.devices()[0].platform}")
-    t0 = time.perf_counter()
-    state, _ = run_rounds(init_state(n), jax.random.key(0), p, rounds)
-    jax.block_until_ready(state)
+    chaos = getattr(cfg, "gossip_sim_chaos", "") or ""
+    try:
+        if chaos:
+            from consul_tpu.sim.scenarios import chaos_plans, run_chaos
+
+            if chaos not in chaos_plans(max(n, 16)):
+                watchdog.cancel()
+                return _sim_error(
+                    f"unknown chaos class {chaos!r} (expected one of "
+                    f"{', '.join(sorted(chaos_plans(max(n, 16))))})",
+                    platform)
+            print(f"==> gossip-sim={platform} chaos={chaos}: {n} virtual "
+                  f"members on {jax.devices()[0].platform}")
+            t0 = time.perf_counter()
+            rep = run_chaos(chaos, n=n)
+            watchdog.cancel()
+            rep["wall_s"] = round(time.perf_counter() - t0, 2)
+            print(json.dumps(rep, indent=2))
+            return 0
+        p = SimParams.from_gossip_config(cfg.gossip_lan, n=n, loss=0.01)
+        rounds = 100
+        print(f"==> gossip-sim={platform}: {n} virtual members, "
+              f"{rounds} rounds on {jax.devices()[0].platform}")
+        t0 = time.perf_counter()
+        state, _ = run_rounds(init_state(n), jax.random.key(0), p, rounds)
+        jax.block_until_ready(state)
+    except Exception as e:  # noqa: BLE001 — compile/run errors
+        watchdog.cancel()
+        return _sim_error(f"simulation failed: {e}", platform)
+    watchdog.cancel()
     dt = time.perf_counter() - t0
     rep = fd_report(state, p)
     print(json.dumps({"rounds_per_sec": round(rounds / dt, 1),
@@ -1597,6 +1714,11 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-gossip-sim", default=None, dest="gossip_sim")
     ag.add_argument("-gossip-sim-nodes", type=int, default=None,
                     dest="gossip_sim_nodes")
+    ag.add_argument("-gossip-sim-chaos", default=None,
+                    dest="gossip_sim_chaos",
+                    help="run a named chaos FaultPlan (e.g. "
+                         "asym_partition, per_node_loss, gc_pause, "
+                         "flapping, churn_burst)")
     ag.set_defaults(fn=cmd_agent)
 
     mem = sub.add_parser("members")

@@ -276,6 +276,9 @@ class RuntimeConfig:
     # Simulation backend (`agent -dev -gossip-sim=tpu`, BASELINE north star)
     gossip_sim: str = ""          # "" (off) | "tpu" | "cpu"
     gossip_sim_nodes: int = 1000
+    # named chaos FaultPlan to run instead of the plain benchmark
+    # (sim/scenarios.chaos_plans: asym_partition, per_node_loss, ...)
+    gossip_sim_chaos: str = ""
 
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log_level: str = "INFO"

@@ -13,10 +13,26 @@ import datetime
 import uuid
 from typing import Any, Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+# cryptography is optional at import time: containers without the
+# wheel must still be able to import consul_tpu.connect (xDS/extension
+# code has no crypto dependency) — CA operations then fail with a
+# clear error at CALL time instead of poisoning the whole package.
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover — dep present in CI images
+    x509 = hashes = serialization = ec = NameOID = None  # type: ignore
+    HAVE_CRYPTO = False
+
+
+def _require_crypto() -> None:
+    if not HAVE_CRYPTO:
+        raise RuntimeError(
+            "the 'cryptography' package is required for Connect CA "
+            "operations but is not installed")
 
 
 def spiffe_id(trust_domain: str, dc: str, service: str) -> str:
@@ -26,6 +42,7 @@ def spiffe_id(trust_domain: str, dc: str, service: str) -> str:
 def generate_root(trust_domain: str, dc: str,
                   ttl_days: int = 3650) -> dict[str, str]:
     """Create a self-signed EC root; returns PEM cert+key + metadata."""
+    _require_crypto()
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name([
         x509.NameAttribute(NameOID.COMMON_NAME,
@@ -71,6 +88,7 @@ def generate_root(trust_domain: str, dc: str,
 def sign_leaf(root: dict[str, str], service: str, dc: str,
               ttl_hours: float = 72.0) -> dict[str, str]:
     """Issue a leaf cert+key for a service (provider_consul.go Sign)."""
+    _require_crypto()
     ca_key = serialization.load_pem_private_key(
         root["PrivateKey"].encode(), password=None)
     ca_cert = x509.load_pem_x509_certificate(root["RootCert"].encode())
@@ -121,6 +139,7 @@ def csr_service(csr_pem: str) -> tuple[str, str]:
     """(service, spiffe_uri) from a CSR's SPIFFE URI SAN, falling back
     to the CN (connect/csr.go: the CSR carries the requested identity;
     the CA decides whether the caller may have it)."""
+    _require_crypto()
     csr = x509.load_pem_x509_csr(csr_pem.encode())
     uri = ""
     try:
@@ -143,6 +162,7 @@ def sign_csr(root: dict[str, str], csr_pem: str, dc: str,
     private key (pbconnectca Sign / provider_consul.go Sign — the
     reference's external-client path, unlike sign_leaf which mints the
     keypair server-side for in-process callers)."""
+    _require_crypto()
     ca_key = serialization.load_pem_private_key(
         root["PrivateKey"].encode(), password=None)
     ca_cert = x509.load_pem_x509_certificate(root["RootCert"].encode())
@@ -202,6 +222,7 @@ def cross_sign(old_root: dict[str, str],
     root's subject+public key, issued by the old root. Agents that
     still only trust the old root can then verify leaves signed by the
     new root through this bridge during rotation."""
+    _require_crypto()
     old_key = serialization.load_pem_private_key(
         old_root["PrivateKey"].encode(), password=None)
     old_cert = x509.load_pem_x509_certificate(
@@ -235,6 +256,7 @@ def cross_sign(old_root: dict[str, str],
 
 def verify_leaf(root_pem: str, leaf_pem: str) -> Optional[str]:
     """Verify chain + return the leaf's SPIFFE URI (or None)."""
+    _require_crypto()
     root = x509.load_pem_x509_certificate(root_pem.encode())
     leaf = x509.load_pem_x509_certificate(leaf_pem.encode())
     try:

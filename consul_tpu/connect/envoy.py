@@ -1192,13 +1192,10 @@ def _api_gateway_bootstrap(snapshot: dict[str, Any],
                     **({"transport_socket": dtls} if dtls else {}),
                     "filters": [filt]}]})
             continue
-        # vhosts keyed by DOMAIN SET: two routes sharing hostnames
-        # (or both hostname-less -> "*") merge into one virtual host —
-        # duplicate domains across vhosts would make Envoy reject the
-        # whole route config. Route hostnames INTERSECT the listener's
-        # (gateway-API semantics): no intersection -> the route is not
-        # programmed on this listener.
-        by_domains: dict[tuple, dict[str, Any]] = {}
+        # Route hostnames INTERSECT the listener's (gateway-API
+        # semantics): no intersection -> the route is not programmed on
+        # this listener.
+        batches: list[tuple[str, list, list]] = []
         for r in lst.get("Routes") or []:
             domains = _route_domains(r.get("Hostnames") or [],
                                      lst.get("Hostname", ""))
@@ -1216,12 +1213,9 @@ def _api_gateway_bootstrap(snapshot: dict[str, Any],
                         "route": act})
             if not envoy_routes:
                 continue
-            key = tuple(domains)
-            vh = by_domains.setdefault(key, {
-                "name": r.get("Name", lname), "domains": domains,
-                "routes": []})
-            vh["routes"].extend(envoy_routes)
-        vhosts = list(by_domains.values())
+            batches.append((r.get("Name", lname), domains,
+                            envoy_routes))
+        vhosts = _merge_route_vhosts(batches)
         if not vhosts:
             continue
         hcm = {
@@ -1248,6 +1242,39 @@ def _api_gateway_bootstrap(snapshot: dict[str, Any],
     return _assemble(snapshot, admin_port, listeners, clusters,
                      secrets=secrets_from_snapshot(snapshot)
                      if sds else None)
+
+
+def _merge_route_vhosts(
+        batches: list[tuple[str, list, list]]) -> list[dict[str, Any]]:
+    """Fold programmed routes [(name, domains, envoy_routes)] into
+    virtual hosts, deduped at DOMAIN granularity: a duplicate domain
+    across virtual_hosts makes Envoy reject the whole route config,
+    and routes with PARTIALLY-overlapping hostname sets ({a,b} and
+    {b,c}) would emit exactly that if vhosts were keyed by the full
+    domain tuple. Each domain collects every route that programs it
+    (in route order); domains served by the same route set fold into
+    one virtual host. Vhost NAMES are also made unique — Envoy
+    requires that per route config."""
+    dom_sig: dict[str, list[int]] = {}     # domain -> batch idxs
+    for idx, (_, domains, _) in enumerate(batches):
+        for d in domains:
+            dom_sig.setdefault(d, []).append(idx)
+    by_sig: dict[tuple, dict[str, Any]] = {}
+    for d, sig in dom_sig.items():
+        vh = by_sig.setdefault(tuple(sig), {
+            "name": batches[sig[0]][0], "domains": [],
+            "routes": [rt for i in sig for rt in batches[i][2]]})
+        vh["domains"].append(d)
+    vhosts = list(by_sig.values())
+    seen_names: set[str] = set()
+    for vh in vhosts:
+        base = vh["name"]
+        k = 2
+        while vh["name"] in seen_names:
+            vh["name"] = f"{base}_{k}"
+            k += 1
+        seen_names.add(vh["name"])
+    return vhosts
 
 
 def _route_domains(route_hosts: list[str],

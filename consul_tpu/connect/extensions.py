@@ -303,7 +303,8 @@ class ExtAuthzExtension(EnvoyExtension):
         # http2 flag matters — a gRPC authz service needs an HTTP/2
         # cluster, a plain HTTP one must NOT get it
         cname = _grpc_target_cluster(cfg, self.target, "extauthz",
-                                     http2=self.grpc)
+                                     http2=self.grpc,
+                                     snapshot=snapshot)
         svc_cfg: dict[str, Any]
         if self.grpc:
             svc_cfg = {"grpc_service": {
@@ -661,7 +662,8 @@ class OtelAccessLoggingExtension(EnvoyExtension):
 
     def update(self, cfg: dict[str, Any],
                snapshot: dict[str, Any]) -> None:
-        cname = _grpc_target_cluster(cfg, self.target, "otel")
+        cname = _grpc_target_cluster(cfg, self.target, "otel",
+                                     snapshot=snapshot)
         log_name = (self.args.get("Config") or {}).get(
             "LogName", "otel-access-log")
         entry = {
@@ -683,7 +685,9 @@ class OtelAccessLoggingExtension(EnvoyExtension):
 
 
 def _grpc_target_cluster(cfg: dict[str, Any], target: dict[str, Any],
-                         kind: str, http2: bool = True) -> str:
+                         kind: str, http2: bool = True,
+                         snapshot: Optional[dict[str, Any]] = None
+                         ) -> str:
     """Resolve a service Target to a cluster name: an existing mesh
     upstream cluster for Service.Name, or a dedicated STATIC cluster
     minted from a host:port URI (shared between ext-authz and
@@ -691,8 +695,21 @@ def _grpc_target_cluster(cfg: dict[str, Any], target: dict[str, Any],
     HTTP authz services must not get an HTTP/2-only cluster."""
     svc = (target.get("Service") or {}).get("Name")
     if svc:
+        # exact cluster names from the snapshot's upstream targets, as
+        # AwsLambdaExtension does: a prefix match on "upstream_{svc}_"
+        # would also capture a DIFFERENT upstream whose name extends
+        # this one past an underscore ("db" vs "db_replica")
+        up = next((u for u in (snapshot or {}).get("Upstreams") or []
+                   if u.get("DestinationName") == svc), {})
+        targets = {t.get("Service", "")
+                   for route in up.get("Routes") or []
+                   for t in route.get("Targets") or []}
+        targets |= {t.get("Service", "")
+                    for t in up.get("Targets") or []}
+        names = {f"upstream_{svc}_{t}" for t in targets if t} \
+            or {f"upstream_{svc}_{svc}"}
         for c in cfg["static_resources"]["clusters"]:
-            if c["name"].startswith(f"upstream_{svc}_"):
+            if c["name"] in names:
                 return c["name"]
         raise ExtensionError(
             f"{kind} target service {svc!r} is not an upstream of "

@@ -22,6 +22,73 @@ import threading  # noqa: E402
 _EXPOSED_PORT_LOCK = threading.Lock()
 
 
+def _append_exposed_check_paths(agent, proxy_id: str, dest_id: str,
+                                expose_paths: list) -> None:
+    """Expose.Checks=true: derive plaintext expose paths from the
+    destination service's HTTP checks, allocating listener ports from
+    the reference's exposed-port range (agent.go 21500+).
+
+    Agent-wide allocator: ports must be stable across snapshot
+    rebuilds AND unique across every proxy on this agent and every
+    user-configured Expose.Paths ListenerPort — a collision is a bind
+    failure. Snapshots assemble concurrently (the xDS executor), so
+    the allocator state lives under one lock; entries whose proxy or
+    check is gone are pruned, or churn would leak the range."""
+    import urllib.parse as _up
+
+    def _safe_port(v: Any) -> int:
+        try:
+            return int(v or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    with _EXPOSED_PORT_LOCK:
+        alloc = getattr(agent, "_exposed_port_alloc", None)
+        if alloc is None:
+            alloc = {}
+            agent._exposed_port_alloc = alloc
+        checks = agent.local.list_checks()
+        services = agent.local.list_services()
+        live_proxies = set(services)
+        for key in [k for k in alloc
+                    if k[0] not in live_proxies
+                    or k[1] not in checks]:
+            del alloc[key]
+        used = set(alloc.values()) | {
+            _safe_port(p.get("ListenerPort"))
+            for p in expose_paths}
+        # EVERY local proxy's configured Expose.Paths ports are taken
+        # too, not just this snapshot's: the allocator must never hand
+        # out a port another sidecar on this agent is already binding
+        # for its own user-configured paths
+        for _svc in services.values():
+            _exp = (getattr(_svc, "proxy", None) or {}) \
+                .get("Expose") or {}
+            used |= {_safe_port(_p.get("ListenerPort"))
+                     for _p in _exp.get("Paths") or []}
+        for cid, chk in sorted(checks.items()):
+            if chk.service_id != dest_id:
+                continue
+            url = getattr(getattr(agent, "_runners", {}).get(cid),
+                          "url", "")
+            u = _up.urlparse(url) if url else None
+            if not u or not u.port:
+                continue
+            key = (proxy_id, cid)
+            port = alloc.get(key)
+            if port is None:
+                port = 21500
+                while port in used:
+                    port += 1
+                alloc[key] = port
+                used.add(port)
+            expose_paths.append({
+                "Path": u.path or "/",
+                "LocalPathPort": u.port,
+                "ListenerPort": port,
+                "Protocol": "http"})
+
+
 def _entry_getter(rpc):
     def get_entry(kind: str, name: str):
         try:
@@ -253,56 +320,8 @@ def assemble_snapshot(agent, proxy_id: str,
         # dest_id gate: an empty DestinationServiceID would match
         # node-level checks (service_id == "") and expose endpoints
         # that belong to no service
-        import urllib.parse as _up
-
-        # agent-wide allocator (agent.go exposed-port range 21500+):
-        # ports must be stable across snapshot rebuilds AND unique
-        # across every proxy on this agent and the user's own
-        # configured ListenerPorts — a collision is a bind failure.
-        # Snapshots assemble concurrently (the xDS executor), so the
-        # allocator state lives under one lock; entries whose proxy
-        # or check is gone are pruned, or churn would leak the range.
-        def _safe_port(v: Any) -> int:
-            try:
-                return int(v or 0)
-            except (TypeError, ValueError):
-                return 0
-
-        with _EXPOSED_PORT_LOCK:
-            alloc = getattr(agent, "_exposed_port_alloc", None)
-            if alloc is None:
-                alloc = {}
-                agent._exposed_port_alloc = alloc
-            checks = agent.local.list_checks()
-            live_proxies = set(agent.local.list_services())
-            for key in [k for k in alloc
-                        if k[0] not in live_proxies
-                        or k[1] not in checks]:
-                del alloc[key]
-            used = set(alloc.values()) | {
-                _safe_port(p.get("ListenerPort"))
-                for p in expose_paths}
-            for cid, chk in sorted(checks.items()):
-                if chk.service_id != dest_id:
-                    continue
-                url = getattr(getattr(agent, "_runners", {}).get(cid),
-                              "url", "")
-                u = _up.urlparse(url) if url else None
-                if not u or not u.port:
-                    continue
-                key = (proxy_id, cid)
-                port = alloc.get(key)
-                if port is None:
-                    port = 21500
-                    while port in used:
-                        port += 1
-                    alloc[key] = port
-                    used.add(port)
-                expose_paths.append({
-                    "Path": u.path or "/",
-                    "LocalPathPort": u.port,
-                    "ListenerPort": port,
-                    "Protocol": "http"})
+        _append_exposed_check_paths(agent, proxy_id, dest_id,
+                                    expose_paths)
 
     matches = rpc("Intention.Match", {"DestinationName": dest_name})
     default_allow = not agent.config.acl_enabled \

@@ -1,0 +1,556 @@
+"""FaultPlan: a declarative, time-phased fault-injection program.
+
+The reference tests network robustness with iptables rules around real
+containers (sdk/iptables; test/integration netsplit suites). This module
+is that capability for BOTH engines in this repo:
+
+  * the batched JAX SWIM simulation (sim/round.py, sim/pallas_round.py):
+    a plan compiles to per-phase per-node delivery arrays + schedule
+    masks (`CompiledFaultPlan`) that ride the jitted `lax.scan` hot loop
+    — phase transitions are data (a `searchsorted` on the round index),
+    never a recompile;
+  * the discrete host engine (gossip/swim.py over gossip/transport.py):
+    the same plan drives an `InMemNetwork` through `FaultInjector`,
+    which schedules phase flips on the SimClock and sets the network's
+    directed-link/per-node-loss/delay/duplication knobs.
+
+Fault primitives (each scoped to a phase and a node selector):
+
+  Partition   — (a)symmetric partition between node groups: directed
+                drop probability on every a->b message leg
+  NodeLoss    — per-node ingress and/or egress packet loss
+  SlowNodes   — forced degraded nodes that process messages late (GC
+                pause / overload — Lifeguard's target failure mode)
+  Flap        — nodes that alternate crashed/recovered on a fixed
+                half-period schedule
+  Duplicate   — per-node egress message duplication (each copy is an
+                independent delivery attempt)
+  ChurnBurst  — seeded crash/rejoin/leave rate burst over a node group
+
+Mean-field compilation notes (JAX backend). The batched sim is
+rumor-centric mean-field (sim/round.py docstring): there is no per-pair
+wiring, so pairwise fault structure must be folded into per-node
+expectations at compile time. For each phase the compiler emits:
+
+  psend[i]  E[one outbound message leg from i to a uniformly-random
+            eligible peer is delivered]   (egress loss, the peers'
+            ingress loss, directed partitions, duplication)
+  precv[i]  the ingress mirror
+  suspw[i]  suspicion-weighted probe round-trip success at i: like
+            psend*precv but with each PROBER weighted by its own rumor
+            reach (psend*precv). A partitioned prober's failed probes
+            barely count — in the real protocol its suspicion rumor
+            cannot cross the partition it is stuck behind. This is what
+            makes an asymmetric partition suspect the minority side and
+            not the quorum side, matching agent-level SWIM.
+  hear_w[i] rumor-weighted ingress at i: how well gossip from the
+            rumor-carrying population reaches i. This scales the
+            refutation race — a cut-off node never hears it is
+            suspected, so it cannot refute, so it IS declared dead by
+            the quorum side (correct detection, as the partition-heal
+            scenario asserts).
+  mid       population mean of psend*precv — the relay-leg /
+            dissemination degradation factor
+
+Group fractions are computed from the phase's static node sets (churn
+drift within a phase is ignored — O(churn) per round, same order as the
+stale-scalars fast path). Overlapping partitions compose first-order
+(drop probabilities add, clipped to [0,1]).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Iterable, NamedTuple, Optional, Sequence, Union
+
+import numpy as np
+
+# jax is imported lazily inside compile_plan/fault_frame so the discrete
+# backend (FaultInjector over InMemNetwork) works without touching the
+# accelerator stack at all.
+
+NodeSpec = Union[None, float, tuple, Sequence[int]]
+
+
+def node_mask(spec: NodeSpec, n: int) -> np.ndarray:
+    """Resolve a node selector to a boolean mask of shape [n].
+
+    Accepted selectors:
+      None          — every node
+      float f       — the first ceil(f*n) node ids (0 < f <= 1)
+      (lo, hi)      — the id range [lo, hi)
+      sequence/ids  — explicit node ids
+    """
+    m = np.zeros((n,), bool)
+    if spec is None:
+        m[:] = True
+    elif isinstance(spec, float):
+        if not 0.0 < spec <= 1.0:
+            raise ValueError(f"fractional node spec must be in (0,1]: {spec}")
+        m[: max(1, math.ceil(spec * n))] = True
+    elif isinstance(spec, tuple) and len(spec) == 2 \
+            and all(isinstance(x, int) for x in spec):
+        lo, hi = spec
+        if not 0 <= lo < hi <= n:
+            raise ValueError(f"node range {spec} out of [0, {n})")
+        m[lo:hi] = True
+    else:
+        ids = np.asarray(list(spec), np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= n):
+            raise ValueError(f"node ids out of [0, {n})")
+        m[ids] = True
+    return m
+
+
+# ------------------------------------------------------------ primitives
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Drop traffic from group `a` to group `b` with probability `drop`
+    (and the reverse direction too unless symmetric=False)."""
+
+    a: NodeSpec
+    b: NodeSpec
+    drop: float = 1.0
+    symmetric: bool = True
+
+
+@dataclass(frozen=True)
+class NodeLoss:
+    """Per-node ingress/egress packet loss on the selected nodes."""
+
+    nodes: NodeSpec
+    ingress: float = 0.0
+    egress: float = 0.0
+
+
+@dataclass(frozen=True)
+class SlowNodes:
+    """Force the selected nodes into the degraded (slow) state for the
+    phase: they ack late (params.slow_factor timeliness), the failure
+    mode Lifeguard's local-health machinery exists for."""
+
+    nodes: NodeSpec
+
+
+@dataclass(frozen=True)
+class Flap:
+    """Selected nodes alternate up/down: up for `half_period` rounds,
+    then crashed for `half_period` rounds, repeating for the phase."""
+
+    nodes: NodeSpec
+    half_period: int = 5
+
+
+@dataclass(frozen=True)
+class Duplicate:
+    """Selected nodes send `copies` independent copies of each message
+    (duplication raises delivery odds; each copy faces loss alone)."""
+
+    nodes: NodeSpec = None
+    copies: int = 2
+
+
+@dataclass(frozen=True)
+class ChurnBurst:
+    """Per-round crash/rejoin/leave probability burst on the group."""
+
+    nodes: NodeSpec = None
+    crash: float = 0.0
+    rejoin: float = 0.0
+    leave: float = 0.0
+
+
+Primitive = Union[Partition, NodeLoss, SlowNodes, Flap, Duplicate,
+                  ChurnBurst]
+
+
+@dataclass(frozen=True)
+class Phase:
+    rounds: int
+    faults: tuple = ()
+    name: str = ""
+
+    def __post_init__(self):
+        if self.rounds <= 0:
+            raise ValueError(f"phase rounds must be positive: {self.rounds}")
+        object.__setattr__(self, "faults", tuple(self.faults))
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A time-phased program of fault primitives.
+
+    Phases run back to back; each phase's primitives are active for
+    exactly its round window. An empty `faults` tuple is a quiescent
+    phase (warm-up / recovery observation)."""
+
+    phases: tuple
+
+    def __post_init__(self):
+        phases = tuple(self.phases)
+        if not phases:
+            raise ValueError("a FaultPlan needs at least one phase")
+        object.__setattr__(self, "phases", phases)
+
+    @property
+    def total_rounds(self) -> int:
+        return sum(ph.rounds for ph in self.phases)
+
+    @property
+    def starts(self) -> list[int]:
+        """Start round of each phase."""
+        out, acc = [], 0
+        for ph in self.phases:
+            out.append(acc)
+            acc += ph.rounds
+        return out
+
+    def phase_names(self) -> list[str]:
+        return [ph.name or f"phase{i}" for i, ph in enumerate(self.phases)]
+
+
+# --------------------------------------------------- JAX-side compilation
+
+
+class CompiledFaultPlan(NamedTuple):
+    """Per-phase fault tensors (all jnp arrays; a jit-traceable pytree).
+
+    Leading axis is the phase; the per-round view is materialized inside
+    the scan body by `fault_frame` with one dynamic index — same shapes
+    every round, so a multi-phase plan costs ONE compile."""
+
+    starts: Any      # [P] int32 — phase start rounds
+    psend: Any       # [P,N] f32 — egress one-leg delivery multiplier
+    precv: Any       # [P,N] f32 — ingress one-leg delivery multiplier
+    suspw: Any       # [P,N] f32 — suspicion-weighted round-trip success
+    hear_w: Any      # [P,N] f32 — rumor-weighted ingress (refutation)
+    mid: Any         # [P]   f32 — mean(psend*precv): relay/dissemination
+    slow_f: Any      # [P,N] bool — forced-slow mask
+    crash_p: Any     # [P,N] f32 — extra per-round crash probability
+    rejoin_p: Any    # [P,N] f32
+    leave_p: Any     # [P,N] f32
+    flap_half: Any   # [P,N] int32 — flap half-period (0 = not flapping)
+    flap_release: Any  # [P,N] bool — flapped in prev phase, not in this
+    #                    one: revive on the phase's first round (mirrors
+    #                    FaultInjector's restore-on-phase-flip)
+
+
+class FaultFrame(NamedTuple):
+    """One round's fault view (what the round bodies consume)."""
+
+    psend: Any       # [N] f32
+    precv: Any       # [N] f32
+    suspw: Any       # [N] f32
+    hear_w: Any      # [N] f32
+    mid: Any         # scalar f32
+    slow_f: Any      # [N] bool
+    crash_p: Any     # [N] f32
+    rejoin_p: Any    # [N] f32
+    leave_p: Any     # [N] f32
+
+
+def _compose(p: np.ndarray, q) -> np.ndarray:
+    """Combine independent drop/event probabilities: 1-(1-p)(1-q)."""
+    return 1.0 - (1.0 - p) * (1.0 - q)
+
+
+def _phase_arrays(phase: Phase, n: int) -> dict[str, np.ndarray]:
+    """Numpy fault tensors for ONE phase (the compile-time fold)."""
+    e = np.zeros((n,))            # egress loss
+    g = np.zeros((n,))            # ingress loss
+    dup = np.ones((n,))
+    slow_f = np.zeros((n,), bool)
+    crash = np.zeros((n,))
+    rejoin = np.zeros((n,))
+    leave = np.zeros((n,))
+    flap = np.zeros((n,), np.int32)
+    links: list[tuple[np.ndarray, np.ndarray, float]] = []
+
+    for f in phase.faults:
+        if isinstance(f, Partition):
+            a, b = node_mask(f.a, n), node_mask(f.b, n)
+            links.append((a, b, float(f.drop)))
+            if f.symmetric:
+                links.append((b, a, float(f.drop)))
+        elif isinstance(f, NodeLoss):
+            m = node_mask(f.nodes, n)
+            e[m] = _compose(e[m], f.egress)
+            g[m] = _compose(g[m], f.ingress)
+        elif isinstance(f, SlowNodes):
+            slow_f |= node_mask(f.nodes, n)
+        elif isinstance(f, Flap):
+            if f.half_period <= 0:
+                raise ValueError("Flap half_period must be positive")
+            flap[node_mask(f.nodes, n)] = f.half_period
+        elif isinstance(f, Duplicate):
+            dup[node_mask(f.nodes, n)] = max(1, int(f.copies))
+        elif isinstance(f, ChurnBurst):
+            m = node_mask(f.nodes, n)
+            crash[m] = _compose(crash[m], f.crash)
+            rejoin[m] = _compose(rejoin[m], f.rejoin)
+            leave[m] = _compose(leave[m], f.leave)
+        else:
+            raise TypeError(f"unknown fault primitive: {f!r}")
+
+    def open_frac(loss_other: np.ndarray, weights: np.ndarray,
+                  incoming: bool) -> np.ndarray:
+        """E over a random (weighted) peer j of w_j(1-loss_j)(1-block),
+        normalized — the 'how open is my horizon' fold. `incoming`
+        selects which end of the directed links this node sits on."""
+        wq = weights * (1.0 - loss_other)
+        total_w = weights.sum() - weights        # exclude self
+        num = wq.sum() - wq                      # exclude self
+        for a, b, drop in links:
+            src, dst = (a, b) if not incoming else (b, a)
+            # this node in src: peers in dst are dropped with `drop`
+            blocked = (wq * dst).sum() - np.where(src & dst, wq, 0.0)
+            num = num - np.where(src, drop * blocked, 0.0)
+        return np.clip(num, 0.0, None) / np.maximum(total_w, 1e-12)
+
+    ones = np.ones((n,))
+    psend = (1.0 - e) * open_frac(g, ones, incoming=False)
+    precv = (1.0 - g) * open_frac(e, ones, incoming=True)
+    # duplication: each copy is an independent delivery attempt.
+    # Ingress from a random sender uses the population-mean factor.
+    psend = 1.0 - (1.0 - psend) ** dup
+    precv = 1.0 - (1.0 - precv) ** float(dup.mean())
+    # suspicion weighting: probers weighted by their own rumor reach —
+    # a prober stuck behind a partition cannot spread its suspicion.
+    # The carrier weights are mutually recursive (a peer only carries
+    # what IT could hear/say), so iterate each fold to its fixed point:
+    # under a total cut the minority's weight must go to 0 exactly, not
+    # to the one-step residual (which, times the ~40/round gossip rate,
+    # would let cut-off nodes keep "refuting" through same-side peers
+    # that never held the rumor).
+    reach = np.maximum(psend * precv, 1e-9)
+
+    def fixed_point(loss_other, w0, incoming):
+        w = w0
+        base = (1.0 - (g if incoming else e))
+        for _ in range(12):
+            w_next = base * open_frac(loss_other, np.maximum(w, 1e-12),
+                                      incoming=incoming)
+            if np.allclose(w_next, w, atol=1e-7):
+                w = w_next
+                break
+            w = w_next
+        return w
+
+    in_w = fixed_point(e, reach, incoming=True)
+    out_w = fixed_point(g, reach, incoming=False)
+    suspw = in_w * out_w
+    # refutation race: hear_w multiplies the per-round refute rate, so
+    # it must capture BOTH legs of a refutation —
+    #   hear: the suspicion rumor reaches me. One more fixed-point
+    #         iteration: a peer can only forward the quorum-side rumor
+    #         if it could hear that rumor itself, so carrier weight is
+    #         in_w, not raw reach (otherwise a cut-off node "refutes"
+    #         through same-side peers that never held the suspicion);
+    #   answer: my higher-incarnation alive rumor escapes back to the
+    #         suspecting population. The mirror fold: egress weighted
+    #         by the receivers' own spreading power out_w — peers stuck
+    #         on my side of a cut accept the refutation but cannot
+    #         relay it anywhere that matters.
+    # A one-way cut (ingress open, egress dropped) keeps hear≈1 but
+    # answer≈0: the node knows it is suspected and still gets declared,
+    # which is exactly agent-level SWIM.
+    hear_in = (1.0 - g) * open_frac(e, np.maximum(in_w, 1e-9),
+                                    incoming=True)
+    speak_out = (1.0 - e) * open_frac(g, np.maximum(out_w, 1e-9),
+                                      incoming=False)
+    hear_w = hear_in * speak_out
+    return dict(psend=psend, precv=precv, suspw=suspw, hear_w=hear_w,
+                mid=np.array(float((psend * precv).mean())),
+                slow_f=slow_f, crash_p=crash, rejoin_p=rejoin,
+                leave_p=leave, flap_half=flap)
+
+
+def compile_plan(plan: FaultPlan, n: int) -> CompiledFaultPlan:
+    """Fold a FaultPlan into per-phase device tensors for the batched
+    sim. One compile per (plan SHAPE, n): plans with the same number of
+    phases and the same n reuse the jitted round program."""
+    import jax.numpy as jnp
+
+    per_phase = [_phase_arrays(ph, n) for ph in plan.phases]
+    # restore-on-phase-flip for flapping nodes (the discrete backend's
+    # FaultInjector does the same in apply_phase)
+    for i, pa in enumerate(per_phase):
+        pa["flap_release"] = np.zeros((n,), bool) if i == 0 else (
+            (per_phase[i - 1]["flap_half"] > 0) & (pa["flap_half"] == 0))
+
+    def stack(key, dtype):
+        return jnp.asarray(np.stack([pa[key] for pa in per_phase]), dtype)
+
+    return CompiledFaultPlan(
+        starts=jnp.asarray(np.asarray(plan.starts), jnp.int32),
+        psend=stack("psend", jnp.float32),
+        precv=stack("precv", jnp.float32),
+        suspw=stack("suspw", jnp.float32),
+        hear_w=stack("hear_w", jnp.float32),
+        mid=stack("mid", jnp.float32),
+        slow_f=stack("slow_f", jnp.bool_),
+        crash_p=stack("crash_p", jnp.float32),
+        rejoin_p=stack("rejoin_p", jnp.float32),
+        leave_p=stack("leave_p", jnp.float32),
+        flap_half=stack("flap_half", jnp.int32),
+        flap_release=stack("flap_release", jnp.bool_),
+    )
+
+
+def fault_frame(cp: CompiledFaultPlan, round_idx) -> FaultFrame:
+    """The current round's fault view — pure indexing/elementwise math,
+    safe inside a jitted lax.scan body (no shape depends on round_idx).
+    Rounds past the plan's end hold the LAST phase's faults."""
+    import jax
+    import jax.numpy as jnp
+
+    n_phases = cp.starts.shape[0]
+    ph = jnp.clip(
+        jnp.searchsorted(cp.starts, round_idx, side="right") - 1,
+        0, n_phases - 1)
+
+    def take(x):
+        return jax.lax.dynamic_index_in_dim(x, ph, 0, keepdims=False)
+
+    crash_p, rejoin_p = take(cp.crash_p), take(cp.rejoin_p)
+    # flap schedule: deterministic level signal on the round counter.
+    # While "down" the crash channel fires with p=1 (idempotent once the
+    # node is down); while "up" the rejoin channel revives it — flapping
+    # rides the existing churn machinery with schedule masks.
+    half = take(cp.flap_half)
+    rel = round_idx - jax.lax.dynamic_index_in_dim(
+        cp.starts, ph, 0, keepdims=False)
+    cycle = (rel // jnp.maximum(half, 1)) % 2
+    flap_on = half > 0
+    down = flap_on & (cycle == 1)
+    crash_p = jnp.where(down, 1.0, crash_p)
+    rejoin_p = jnp.where(flap_on & ~down, 1.0, rejoin_p)
+    # phase flip out of a flap: revive former flappers on round 0 of
+    # the new phase, as FaultInjector.apply_phase restores transports
+    release = take(cp.flap_release) & (rel == 0)
+    rejoin_p = jnp.where(release, 1.0, rejoin_p)
+    return FaultFrame(
+        psend=take(cp.psend), precv=take(cp.precv), suspw=take(cp.suspw),
+        hear_w=take(cp.hear_w), mid=take(cp.mid), slow_f=take(cp.slow_f),
+        crash_p=crash_p, rejoin_p=rejoin_p, leave_p=take(cp.leave_p))
+
+
+# -------------------------------------------- discrete-engine backend
+
+
+class FaultInjector:
+    """Drive an InMemNetwork (gossip/transport.py) from a FaultPlan.
+
+    Rounds map to sim-clock seconds via `round_s` (one SWIM protocol
+    period, GossipConfig.probe_interval). Phase flips are scheduled on
+    the network's SimClock, so `clock.advance()` in a test walks the
+    plan exactly like the batched backend's round counter does.
+
+    `addrs[i]` is the transport address of node id i — the same node
+    selectors then mean the same nodes on both backends.
+    """
+
+    def __init__(self, net, plan: FaultPlan, addrs: Sequence[str],
+                 round_s: float = 1.0) -> None:
+        self.net = net
+        self.plan = plan
+        self.addrs = list(addrs)
+        self.round_s = float(round_s)
+        self._n = len(self.addrs)
+        # bumping the generation orphans every scheduled flip closure
+        # from earlier phases — a phase flip atomically replaces the
+        # whole flap schedule
+        self._flap_gen = 0
+        self._flapped_down: set = set()
+
+    # -- plan application ------------------------------------------------
+
+    def _sel(self, spec: NodeSpec) -> list[str]:
+        m = node_mask(spec, self._n)
+        return [a for a, on in zip(self.addrs, m) if on]
+
+    def apply_phase(self, idx: int) -> None:
+        """Reset the network to exactly phase `idx`'s fault set."""
+        net, phase = self.net, self.plan.phases[idx]
+        net.clear_faults()
+        self._flap_gen += 1
+        flapping_now: set = set()
+        for f in phase.faults:
+            if isinstance(f, Partition):
+                a, b = set(self._sel(f.a)), set(self._sel(f.b))
+                net.add_link_fault(a, b, f.drop)
+                if f.symmetric:
+                    net.add_link_fault(b, a, f.drop)
+            elif isinstance(f, NodeLoss):
+                for addr in self._sel(f.nodes):
+                    if f.egress:
+                        net.node_out_loss[addr] = float(_compose(
+                            np.float64(net.node_out_loss.get(addr, 0.0)),
+                            f.egress))
+                    if f.ingress:
+                        net.node_in_loss[addr] = float(_compose(
+                            np.float64(net.node_in_loss.get(addr, 0.0)),
+                            f.ingress))
+            elif isinstance(f, SlowNodes):
+                # slow processing: every inbound message to the node is
+                # dispatched late — acks miss the prober's probe timeout
+                # exactly like a GC-paused process
+                for addr in self._sel(f.nodes):
+                    net.node_delay[addr] = max(
+                        net.node_delay.get(addr, 0.0), self.round_s)
+            elif isinstance(f, Duplicate):
+                for addr in self._sel(f.nodes):
+                    net.node_dup[addr] = max(1, int(f.copies))
+            elif isinstance(f, Flap):
+                if f.half_period <= 0:
+                    raise ValueError("Flap half_period must be positive")
+                addrs = self._sel(f.nodes)
+                flapping_now.update(addrs)
+                self._start_flap(addrs, f.half_period)
+            elif isinstance(f, ChurnBurst):
+                # agent-level churn is the TEST's job (it owns process
+                # lifecycles); the injector only shapes the network
+                continue
+            else:
+                raise TypeError(f"unknown fault primitive: {f!r}")
+        # restore anything a previous phase's flap left crashed
+        for addr in list(self._flapped_down):
+            if addr not in flapping_now:
+                t = net.transports.get(addr)
+                if t is not None:
+                    t.closed = False
+                self._flapped_down.discard(addr)
+
+    def _start_flap(self, addrs: list[str], half_period: int) -> None:
+        gen = self._flap_gen
+        period_s = half_period * self.round_s
+
+        def flip(down: bool) -> None:
+            if gen != self._flap_gen:
+                return  # a later phase replaced this schedule
+            for a in addrs:
+                t = self.net.transports.get(a)
+                if t is not None:
+                    t.closed = down
+            if down:
+                self._flapped_down.update(addrs)
+            else:
+                self._flapped_down.difference_update(addrs)
+            self.net.clock.after(period_s, lambda: flip(not down))
+
+        # first half-period runs up, mirroring the batched schedule
+        self.net.clock.after(period_s, lambda: flip(True))
+
+    def schedule(self) -> None:
+        """Apply phase 0 now and schedule every later phase flip on the
+        network's SimClock."""
+        self.apply_phase(0)
+        for idx, start in enumerate(self.plan.starts):
+            if idx == 0:
+                continue
+            self.net.clock.after(
+                start * self.round_s,
+                lambda i=idx: self.apply_phase(i))

@@ -71,6 +71,15 @@ class InMemNetwork:
         self.latency = latency
         self.transports: dict[str, "InMemTransport"] = {}
         self._partitions: list[tuple[set[str], set[str]]] = []
+        # structured fault knobs (driven by faults.FaultInjector):
+        # directed link drops compose with per-node ingress/egress loss;
+        # node_delay postpones a node's inbound dispatch (slow/GC-paused
+        # processing); node_dup sends each egress packet N times.
+        self._link_faults: list[tuple[set[str], set[str], float]] = []
+        self.node_out_loss: dict[str, float] = {}
+        self.node_in_loss: dict[str, float] = {}
+        self.node_delay: dict[str, float] = {}
+        self.node_dup: dict[str, int] = {}
         self.log = log.named("memberlist.net")
 
     def attach(self, addr: str) -> "InMemTransport":
@@ -85,24 +94,77 @@ class InMemNetwork:
     def heal(self) -> None:
         self._partitions.clear()
 
+    def add_link_fault(self, a: set[str], b: set[str],
+                       drop: float = 1.0) -> None:
+        """Drop traffic on the DIRECTED legs a->b with probability
+        `drop` (iptables-style: applies to packets and streams alike).
+        Overlapping faults compose as independent drops."""
+        self._link_faults.append((set(a), set(b), float(drop)))
+
+    def clear_faults(self) -> None:
+        """Remove every structured fault (partition() entries persist —
+        they belong to the legacy two-sided API, healed separately)."""
+        self._link_faults.clear()
+        self.node_out_loss.clear()
+        self.node_in_loss.clear()
+        self.node_delay.clear()
+        self.node_dup.clear()
+
     def _blocked(self, src: str, dst: str) -> bool:
         for a, b in self._partitions:
             if (src in a and dst in b) or (src in b and dst in a):
                 return True
         return False
 
-    def deliver_packet(self, src: str, dst: str, payload: bytes) -> None:
-        if self._blocked(src, dst) or self.rng.random() < self.loss:
-            return
-        tgt = self.transports.get(dst)
-        if tgt is None or tgt.closed:
-            return
-        jitter = self.latency * (0.5 + self.rng.random())
-        self.clock.after(jitter, lambda: tgt._dispatch_packet(src, payload))
+    def _fault_drop_prob(self, src: str, dst: str) -> float:
+        """Combined structured-fault drop probability for one src->dst
+        leg: directed link faults and both endpoints' node loss."""
+        keep = (1.0 - self.node_out_loss.get(src, 0.0)) \
+            * (1.0 - self.node_in_loss.get(dst, 0.0))
+        for a, b, drop in self._link_faults:
+            if src in a and dst in b:
+                keep *= 1.0 - drop
+        return 1.0 - keep
 
-    def stream(self, src: str, dst: str, payload: bytes) -> bytes:
+    def deliver_packet(self, src: str, dst: str, payload: bytes) -> None:
+        if self._blocked(src, dst):
+            return
+        # duplication: every copy is an independent delivery attempt
+        # facing the loss/fault gauntlet alone
+        for _ in range(max(1, self.node_dup.get(src, 1))):
+            if self.rng.random() < self.loss:
+                continue
+            p_fault = self._fault_drop_prob(src, dst)
+            if p_fault and self.rng.random() < p_fault:
+                continue
+            tgt = self.transports.get(dst)
+            if tgt is None or tgt.closed:
+                return
+            jitter = self.latency * (0.5 + self.rng.random())
+            # slow-node model: the receiver PROCESSES late (GC pause) —
+            # its acks then miss the prober's deadline
+            delay = jitter + self.node_delay.get(dst, 0.0)
+            self.clock.after(delay,
+                             lambda: tgt._dispatch_packet(src, payload))
+
+    def stream(self, src: str, dst: str, payload: bytes,
+               timeout: float = 10.0) -> bytes:
         if self._blocked(src, dst):
             raise ConnectionError(f"partitioned: {src} -> {dst}")
+        # structured faults hit TCP as readily as UDP, and a stream
+        # needs BOTH directions: a one-way cut (or the responder's
+        # egress loss) stalls the SYN-ACK / response leg just as an
+        # iptables DROP would — compose the two directed legs
+        keep = (1.0 - self._fault_drop_prob(src, dst)) \
+            * (1.0 - self._fault_drop_prob(dst, src))
+        if keep < 1.0 and self.rng.random() >= keep:
+            raise ConnectionError(f"link fault: {src} -> {dst}")
+        # slow receiver (GC pause): the response lands node_delay late;
+        # streams are synchronous under the SimClock, so a delay past
+        # the caller's deadline IS a timeout
+        if self.node_delay.get(dst, 0.0) > timeout:
+            raise ConnectionError(
+                f"stream timeout after {timeout}s: {src} -> {dst}")
         tgt = self.transports.get(dst)
         if tgt is None or tgt.closed or tgt._on_stream is None:
             raise ConnectionError(f"connection refused: {dst}")
@@ -132,7 +194,8 @@ class InMemTransport(Transport):
                    timeout: float = 10.0) -> bytes:
         if self.closed:
             raise ConnectionError("transport closed")
-        return self.net.stream(self.addr, addr, payload)
+        return self.net.stream(self.addr, addr, payload,
+                               timeout=timeout)
 
     def _dispatch_packet(self, src: str, payload: bytes) -> None:
         if not self.closed and self._on_packet is not None:

@@ -528,6 +528,11 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
     _SLOW_REBUILD_S = 30.0
     last_state_idx: Optional[int] = None
     last_rebuild = 0.0
+    # a request-triggered rebuild that FAILED must retry next tick:
+    # the request that warranted it is consumed, so without this flag
+    # the rebuild would be deferred until a table moves or the slow
+    # fallback lapses — a new subscription could sit unserved for 30s
+    retry_build = False
 
     while True:
         try:
@@ -604,7 +609,7 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
                        not in ("", agent.config.datacenter)
                        for u in _proxy.proxy.get("Upstreams") or [])):
             fallback = 2.0
-        if not needs_build and _state is not None \
+        if not needs_build and not retry_build and _state is not None \
                 and cur_idx == last_state_idx \
                 and now - last_rebuild < fallback:
             continue  # nothing moved: skip the snapshot fan-in
@@ -616,7 +621,9 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
             # a transiently unbuildable snapshot (e.g. CA mid-
             # bootstrap) must not kill the stream; retry next tick
             logger.warning("snapshot for %s failed: %s", node_id, e)
+            retry_build = True
             continue
+        retry_build = False
         last_state_idx = cur_idx
         last_rebuild = now
         if cfg is None:

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.sim.params import SimParams
-from consul_tpu.sim.state import SimState
+from consul_tpu.sim.state import SimState, SimStats
 
 
 @dataclass
@@ -59,6 +59,70 @@ def fd_report(state: SimState, p: SimParams) -> FDReport:
         live_fraction=float(np.mean(state.up)),
         mean_informed=float(np.mean(state.informed)),
     )
+
+
+@dataclass
+class PhaseReport:
+    """FD-quality counters for ONE FaultPlan phase — the deltas of the
+    cumulative SimStats between the phase's boundary rounds."""
+
+    phase: str
+    start_round: int
+    rounds: int
+    suspicions: int
+    refutes: int
+    false_positives: int
+    true_deaths_declared: int
+    crashes: int
+    rejoins: int
+    leaves: int
+    mean_detect_latency_s: float
+    fp_per_node_hour: float
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+_COUNTERS = ("suspicions", "refutes", "false_positives",
+             "true_deaths_declared", "crashes", "rejoins", "leaves")
+
+
+def phase_reports(stats_trace: SimStats, plan, p: SimParams,
+                  ) -> list[PhaseReport]:
+    """Split a per-round cumulative stats trace (run_rounds_stats) into
+    per-phase detection-quality reports for a FaultPlan.
+
+    `stats_trace` is a SimStats pytree whose leaves carry a leading
+    [rounds] axis, round 0 of the trace being plan round 0. Phases
+    beyond the traced window are omitted; a trace longer than the plan
+    credits the excess rounds to the final phase (fault_frame holds the
+    last phase's faults past the plan's end)."""
+    tr = jax.device_get(stats_trace)
+    total = int(np.asarray(tr.false_positives).shape[0])
+    out: list[PhaseReport] = []
+    prev = {f: 0.0 for f in _COUNTERS}
+    prev_lat = 0.0
+    names, starts = plan.phase_names(), plan.starts
+    for i, (name, start) in enumerate(zip(names, starts)):
+        if start >= total:
+            break
+        end = starts[i + 1] if i + 1 < len(starts) else total
+        end = min(end, total)
+        cur = {f: float(np.asarray(getattr(tr, f))[end - 1])
+               for f in _COUNTERS}
+        lat = float(np.asarray(tr.detect_latency_sum)[end - 1])
+        d = {f: int(cur[f] - prev[f]) for f in _COUNTERS}
+        td = d["true_deaths_declared"]
+        phase_s = (end - start) * p.probe_interval
+        node_hours = p.n * phase_s / 3600.0
+        out.append(PhaseReport(
+            phase=name, start_round=start, rounds=end - start,
+            mean_detect_latency_s=(lat - prev_lat) / td if td else 0.0,
+            fp_per_node_hour=(d["false_positives"] / node_hours
+                              if node_hours > 0 else 0.0),
+            **d))
+        prev, prev_lat = cur, lat
+    return out
 
 
 def propagation_curve(trace: jnp.ndarray, probe_interval: float,

@@ -18,13 +18,14 @@ tests/test_pallas_round.py (TPU-gated).
 from __future__ import annotations
 
 import functools
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from consul_tpu.faults import CompiledFaultPlan, FaultFrame, fault_frame
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import (N_SCALARS, init_scalars,
                                   _pf_arrays, _shrink)
@@ -34,8 +35,15 @@ INF = 3.4e38  # python float: jnp constants can't be captured by kernels
 
 LANES = 1024  # row width: multiple of 128 lanes; int8 tiles need 32 rows
 # rows per block: 10-array (churn/slow) kernels must fit 16MB VMEM;
-# 8-array stable kernels take double blocks for fewer grid steps
-ROWS_FULL, ROWS_STABLE = 128, 256
+# 8-array stable kernels take double blocks for fewer grid steps;
+# fault kernels carry 8 extra per-node input lanes (~36B/node more), so
+# they halve the block again to stay inside VMEM with double buffering
+ROWS_FULL, ROWS_STABLE, ROWS_FAULT = 128, 256, 64
+
+#: per-round fault-injection inputs appended after the state arrays:
+#: psend, precv, suspw, hear_w (f32), slow_f (int8), crash_p,
+#: rejoin_p, leave_p
+N_FAULT_INS = 8
 
 
 def _u01(shape) -> jnp.ndarray:
@@ -49,41 +57,46 @@ def _u01(shape) -> jnp.ndarray:
     return top24.astype(jnp.float32) * (1.0 / (1 << 24))
 
 
-def _model_arrays(p: SimParams) -> bool:
+def _model_arrays(p: SimParams, fault: bool = False) -> bool:
     """Whether the config needs the down_time/slow arrays in the kernel
     (skipping them saves ~20%% of HBM traffic for stable configs).
-    Stats collection needs down_time for detection latency."""
+    Stats collection needs down_time for detection latency; a fault
+    plan can inject churn (bursts, flaps) regardless of params."""
     return bool(p.fail_per_round or p.leave_per_round
                 or p.rejoin_per_round or p.slow_per_round
-                or p.collect_stats)
+                or p.collect_stats or fault)
 
 
-def _has_churn(p: SimParams) -> bool:
+def _has_churn(p: SimParams, fault: bool = False) -> bool:
     return bool(p.fail_per_round or p.leave_per_round
-                or p.rejoin_per_round)
+                or p.rejoin_per_round or fault)
 
 
-def _write_mask(p: SimParams) -> list[bool]:
+def _write_mask(p: SimParams, fault: bool = False) -> list[bool]:
     """Which state arrays a round can actually MUTATE. down_time moves
     only under churn (crash stamps it, rejoin clears it) and slow only
     under the degradation model — a stats-only config reads them but
     never writes, so skipping their output copies saves their share of
     HBM write bandwidth on every round (the full-model bench config
-    drops from 50 to 46 bytes/node-round)."""
+    drops from 50 to 46 bytes/node-round). Forced-slow fault masks are
+    ephemeral (never stored), so `fault` widens down_time only."""
     mask = [True] * 8
-    if _model_arrays(p):
-        mask += [_has_churn(p), bool(p.slow_per_round)]
+    if _model_arrays(p, fault):
+        mask += [_has_churn(p, fault), bool(p.slow_per_round)]
     return mask
 
 
 def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
-                  *refs, p: SimParams):
+                  *refs, p: SimParams, fault: bool = False):
     """One block of one protocol period (grid = node blocks)."""
-    n_arrays = 10 if _model_arrays(p) else 8
-    mask = _write_mask(p)
+    n_arrays = 10 if _model_arrays(p, fault) else 8
+    mask = _write_mask(p, fault)
     n_out = sum(mask)
-    ins, outs = refs[:n_arrays], refs[n_arrays:n_arrays + n_out]
-    partial_o = refs[n_arrays + n_out]
+    n_fins = N_FAULT_INS if fault else 0
+    ins = refs[:n_arrays]
+    fins = refs[n_arrays:n_arrays + n_fins]
+    outs = refs[n_arrays + n_fins:n_arrays + n_fins + n_out]
+    partial_o = refs[n_arrays + n_fins + n_out]
     (up_ref, status_ref, inc_ref, informed_ref,
      s_start_ref, s_dead_ref, s_conf_ref, lh_ref) = ins[:8]
     (up_o, status_o, inc_o, informed_o,
@@ -110,6 +123,7 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     n_up_elig = scal_ref[2]
     n_slow = scal_ref[3]
     lfail_num, lfail_den = scal_ref[6], scal_ref[7]
+    mid = scal_ref[N_SCALARS] if fault else None  # plan's link quality
     frac_up_elig = n_up_elig / n_elig
     sbar = n_slow / jnp.maximum(n_up_elig, 1e-9)
     e_pf_fast = scal_ref[4] / jnp.maximum(n_live, 1e-9)
@@ -137,13 +151,30 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     new_rumor = jnp.zeros(shape, jnp.bool_)
     crash = leave = rejoin = jnp.zeros(shape, jnp.bool_)
 
+    # per-round fault-injection inputs (computed by fault_frame in the
+    # scan body — the kernel only consumes per-node data)
+    if fault:
+        (psend_ref, precv_ref, suspw_ref, hearw_ref,
+         slowf_ref, crashp_ref, rejoinp_ref, leavep_ref) = fins
+        psend, precv = psend_ref[:], precv_ref[:]
+        suspw, hear_w = suspw_ref[:], hearw_ref[:]
+        slow_f = slowf_ref[:].astype(jnp.int32) != 0
+        crash_p, rejoin_p = crashp_ref[:], rejoinp_ref[:]
+        leave_p = leavep_ref[:]
+
     # ------------------------------------------------------------- churn
-    if p.fail_per_round or p.leave_per_round or p.rejoin_per_round:
+    if _has_churn(p, fault):
         u_c = _u01(shape)
-        crash = up & (u_c < p.fail_per_round)  # noqa: F841 (stats)
-        leave = up & (u_c >= p.fail_per_round) & (
-            u_c < p.fail_per_round + p.leave_per_round)
-        rejoin = (~up) & (u_c < p.rejoin_per_round)
+        fail_p = jnp.zeros(shape, jnp.float32) + p.fail_per_round
+        rej_p = jnp.zeros(shape, jnp.float32) + p.rejoin_per_round
+        lv_p = jnp.zeros(shape, jnp.float32) + p.leave_per_round
+        if fault:
+            fail_p = fail_p + crash_p
+            rej_p = rej_p + rejoin_p
+            lv_p = lv_p + leave_p
+        crash = up & (u_c < fail_p)  # noqa: F841 (stats)
+        leave = up & (u_c >= fail_p) & (u_c < fail_p + lv_p)
+        rejoin = (~up) & (u_c < rej_p)
         up = (up & ~(crash | leave)) | rejoin
         t_v = jnp.zeros(shape, jnp.float32) + t
         down_time = jnp.where(crash | leave, t_v, down_time)
@@ -164,11 +195,20 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
         stay = (u_s >= p.slow_recover_per_round).astype(jnp.int32)
         enter = (u_s < p.slow_per_round).astype(jnp.int32)
         slow = (jnp.where(slow, stay, enter) != 0) & up
+    # forced-slow fault mask: ephemeral (state.slow stays stochastic)
+    slow_eff = (slow | slow_f) & up if fault else slow
 
     # prober-side ack: the SAME _pf_arrays the XLA paths use (pure
     # jnp elementwise — lowers under Mosaic; sharing it is what keeps
     # pallas/XLA statistical conformance from drifting)
-    g, pf_fast, pf_slow = _pf_arrays(slow, lh, sbar, n_live / n, p)
+    fx = None
+    if fault:
+        mid_v = jnp.zeros(shape, jnp.float32) + mid
+        fx = FaultFrame(psend=psend, precv=precv, suspw=suspw,
+                        hear_w=hear_w, mid=mid_v, slow_f=slow_f,
+                        crash_p=crash_p, rejoin_p=rejoin_p,
+                        leave_p=leave_p)
+    g, pf_fast, pf_slow = _pf_arrays(slow_eff, lh, sbar, n_live / n, p, fx)
     mix_i = (1.0 - sbar) * pf_fast + sbar * pf_slow
     # Mosaic: comparisons against SMEM-sourced scalars produce
     # replicated-layout masks that can't AND with memory-sourced masks —
@@ -187,8 +227,10 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     probe_rate = n_live / jnp.maximum(n_elig - 1.0, 1.0)
     e_pf_fast_v = jnp.zeros(shape, jnp.float32) + e_pf_fast
     e_pf_slow_v = jnp.zeros(shape, jnp.float32) + e_pf_slow
-    p_fail_j = jnp.where(up,
-                         jnp.where(slow, e_pf_slow_v, e_pf_fast_v), 1.0)
+    base_fail = jnp.where(slow_eff, e_pf_slow_v, e_pf_fast_v)
+    if fault:
+        base_fail = 1.0 - (1.0 - base_fail) * suspw
+    p_fail_j = jnp.where(up, base_fail, 1.0)
     lam = probe_rate * p_fail_j * eligf
     u_p = _u01(shape)
     term = jnp.exp(-lam)
@@ -219,9 +261,14 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     # refutation race
     lam_hear = (p.gossip_nodes * p.gossip_ticks_per_round * informed
                 * (1.0 - p.loss) * g)
-    p_hear = 1.0 - jnp.exp(-lam_hear)
     lam_grow = (p.gossip_nodes * p.gossip_ticks_per_round * informed
                 * (1.0 - p.loss))
+    if fault:
+        # hear_w folds both refutation legs (hear the suspicion AND get
+        # the answer back out) — see faults._phase_arrays
+        lam_hear = lam_hear * hear_w
+        lam_grow = lam_grow * mid_v
+    p_hear = 1.0 - jnp.exp(-lam_hear)
     u_h = _u01(shape)
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
     refute = wrongly & (u_h < p_hear)
@@ -301,21 +348,26 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     partial_o[:] = padded
 
 
-def _build_round(p: SimParams, n: int, interpret: bool = False):
+def _build_round(p: SimParams, n: int, interpret: bool = False,
+                 fault: bool = False):
     """The per-round pallas_call for an n-node (or n-node SLICE)
     tensor. `p.n` stays the GLOBAL population for the protocol math;
     `n` only sizes the arrays — that split is what lets the sharded
-    runner reuse the kernel per mesh shard."""
-    n_arrays = 10 if _model_arrays(p) else 8
-    mask = _write_mask(p)
+    runner reuse the kernel per mesh shard. With `fault`, the call
+    takes N_FAULT_INS extra per-node input blocks (this round's
+    FaultFrame view) after the state arrays."""
+    n_arrays = 10 if _model_arrays(p, fault) else 8
+    mask = _write_mask(p, fault)
     out_idx = [i for i, w in enumerate(mask) if w]
-    rows_per_block = ROWS_FULL if n_arrays == 10 else ROWS_STABLE
+    rows_per_block = ROWS_FAULT if fault else (
+        ROWS_FULL if n_arrays == 10 else ROWS_STABLE)
     block = rows_per_block * LANES
     assert n % block == 0, f"n={n} must be a multiple of {block}"
     grid = n // block
     rows = n // LANES
+    n_fins = N_FAULT_INS if fault else 0
 
-    kernel = functools.partial(_round_kernel, p=p)
+    kernel = functools.partial(_round_kernel, p=p, fault=fault)
 
     def row_spec():
         return pl.BlockSpec((rows_per_block, LANES),
@@ -324,14 +376,14 @@ def _build_round(p: SimParams, n: int, interpret: bool = False):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,  # scalars, seed, t
         grid=(grid,),
-        in_specs=[row_spec() for _ in range(n_arrays)],
+        in_specs=[row_spec() for _ in range(n_arrays + n_fins)],
         # outputs only for the arrays this config can mutate
         # (_write_mask) — constant arrays pass through by identity
         out_specs=[row_spec() for _ in out_idx]
         + [pl.BlockSpec((8, 128), lambda i, *_: (i, 0))],
     )
 
-    def one_round(args, scalars, seed, t):
+    def one_round(args, scalars, seed, t, fins=()):
         outs = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -340,7 +392,7 @@ def _build_round(p: SimParams, n: int, interpret: bool = False):
                        for i in out_idx]
             + [jax.ShapeDtypeStruct((grid * 8, 128), jnp.float32)],
             interpret=interpret,
-        )(scalars, seed, t, *args)
+        )(scalars, seed, t, *args, *fins)
         *state_out, partials = outs
         full = list(args)
         for k, i in enumerate(out_idx):
@@ -400,21 +452,32 @@ def _unpack(args, state: SimState, n_arrays: int, t_final, rounds,
 
 
 def make_run_rounds_pallas(p: SimParams, rounds: int,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           plan: Optional[CompiledFaultPlan] = None):
     """Compiled hot loop using the fused Pallas round kernel.
 
     Covers the full protocol model including churn, slow-node
     injection, and stats collection.
-    Requires n divisible by the block size."""
-    one_round, rows, n_arrays = _build_round(p, p.n, interpret)
+    Requires n divisible by the block size.
+
+    `plan` (faults.compile_plan output) threads a FaultPlan through the
+    kernel: the scan body materializes each round's FaultFrame with one
+    dynamic index on the per-phase tensors and hands the kernel 8 extra
+    per-node input lanes plus the plan's mean link quality as a 9th
+    prefetch scalar. Phases are data — one Mosaic compile per plan
+    SHAPE, like the XLA paths."""
+    fault = plan is not None
+    one_round, rows, n_arrays = _build_round(p, p.n, interpret, fault)
 
     @jax.jit
-    def _run(state: SimState, key: jax.Array) -> SimState:
+    def _run(state: SimState, key: jax.Array,
+             cp: Optional[CompiledFaultPlan] = None) -> SimState:
         scalars = init_scalars(state, p)
         # clamp the tiny epsilons the XLA path uses
         scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
         seeds = jax.random.randint(key, (rounds,), 0, 2**31 - 1,
                                    dtype=jnp.int32)
+        ridx = state.round_idx + jnp.arange(rounds, dtype=jnp.int32)
 
         def to2d(x):
             return x.reshape(rows, LANES)
@@ -429,9 +492,19 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
         def body(carry, x):
             args, scalars, t, acc = carry
-            seed = x
+            seed, r = x
+            if fault:
+                fx = fault_frame(cp, r)
+                fins = (to2d(fx.psend), to2d(fx.precv),
+                        to2d(fx.suspw), to2d(fx.hear_w),
+                        to2d(fx.slow_f.astype(jnp.int8)),
+                        to2d(fx.crash_p), to2d(fx.rejoin_p),
+                        to2d(fx.leave_p))
+                scal_in = jnp.concatenate([scalars, fx.mid[None]])
+            else:
+                fins, scal_in = (), scalars
             args2, partials, stat_sums = one_round(
-                args, scalars, seed[None], t[None])
+                args, scal_in, seed[None], t[None], fins)
             partials = partials.at[1].max(1.0).at[2].max(1e-9) \
                 .at[7].max(1e-9)
             # per-round block sums are < 2^24 (exact in f32); the
@@ -445,7 +518,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
         acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
         (args, scalars, t_final, acc), _ = jax.lax.scan(
-            body, (args, scalars, state.t, acc0), seeds)
+            body, (args, scalars, state.t, acc0), (seeds, ridx))
         acc_i, acc_lat = acc
         (up, status, inc, informed, s_start, s_dead, s_conf,
          lh) = args[:8]
@@ -477,6 +550,15 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             local_health=lh.reshape(-1),
             slow=slow_flat, t=t_final,
             round_idx=state.round_idx + rounds, stats=st)
+
+    if fault:
+        # bind the maker's plan; same-shape plans may be swapped in per
+        # call without recompiling (the tensors are traced arguments)
+        def run_fault(state: SimState, key: jax.Array,
+                      cp: Optional[CompiledFaultPlan] = None) -> SimState:
+            return _run(state, key, cp if cp is not None else plan)
+
+        return run_fault
 
     if n_arrays == 10:
         return _run

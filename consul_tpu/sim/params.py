@@ -40,7 +40,11 @@ class SimParams:
     gossip_nodes: int = 3
     retransmit_mult: int = 4
 
-    # Network model
+    # Network model. `loss` is the homogeneous i.i.d. floor; structured
+    # faults (asymmetric partitions, per-node loss, slow/flapping
+    # nodes, churn bursts) are a FaultPlan (consul_tpu/faults.py)
+    # passed to run_rounds/make_run_rounds_* as compiled per-phase
+    # tensors — they COMPOSE with this scalar, they don't replace it.
     loss: float = 0.0            # i.i.d. UDP packet-loss probability
     tcp_fail: float = 0.0        # TCP fallback connection-failure probability
 

@@ -38,6 +38,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from consul_tpu.faults import CompiledFaultPlan, FaultFrame, fault_frame
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import (ALIVE, DEAD, INF, LEFT, SUSPECT, SimState,
                                   SimStats)
@@ -69,13 +70,20 @@ def _trunc_poisson(u: jnp.ndarray, lam: jnp.ndarray, kmax: int = 4
 
 
 def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
-                reduce_sum: Reducer = jnp.sum):
+                reduce_sum: Reducer = jnp.sum,
+                fx: Optional[FaultFrame] = None):
     """ONE protocol period — the single copy of the protocol body.
 
     `scalars=None` → live mode: population scalars computed from the
     post-churn arrays (gossip_round). `scalars=vector` → stale mode:
     last round's scalars are used and the next round's are produced in
     the same fused pass (gossip_round_fast). Returns (state, scalars').
+
+    `fx` (faults.FaultFrame) carries this round's fault-injection view:
+    per-node delivery multipliers, forced-slow mask, and churn-burst /
+    flap schedule rates. All fault structure is per-node DATA — the
+    traced program is identical for every phase of a FaultPlan, so a
+    multi-phase plan costs one compile.
     """
     n = p.n
     t = state.t
@@ -96,12 +104,19 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     new_rumor = jnp.zeros((L,), jnp.bool_)
 
     # ------------------------------------------------------------------ churn
-    if p.fail_per_round or p.leave_per_round or p.rejoin_per_round:
+    if p.fail_per_round or p.leave_per_round or p.rejoin_per_round \
+            or fx is not None:
         u = jax.random.uniform(k_churn, (L,))
-        crash = up & (u < p.fail_per_round)
-        leave = up & (u >= p.fail_per_round) & (
-            u < p.fail_per_round + p.leave_per_round)
-        rejoin = (~up) & (u < p.rejoin_per_round)
+        # fault-plan churn bursts and flap schedules ride the same
+        # channels as the params churn model (rates add; flap uses
+        # deterministic p=1 level signals)
+        fail_p = p.fail_per_round + (fx.crash_p if fx is not None else 0.0)
+        leave_p = p.leave_per_round + (fx.leave_p if fx is not None else 0.0)
+        rejoin_p = p.rejoin_per_round \
+            + (fx.rejoin_p if fx is not None else 0.0)
+        crash = up & (u < fail_p)
+        leave = up & (u >= fail_p) & (u < fail_p + leave_p)
+        rejoin = (~up) & (u < rejoin_p)
         up = (up & ~(crash | leave)) | rejoin
         down_time = jnp.where(crash | leave, t, state.down_time)
         down_time = jnp.where(rejoin, INF, down_time)
@@ -129,6 +144,10 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         u_s = jax.random.uniform(k_slow, (L,))
         slow = jnp.where(slow, u_s >= p.slow_recover_per_round,
                          u_s < p.slow_per_round) & up
+    # forced-slow (GC-pause fault primitive) is ephemeral: it shapes this
+    # round's timeliness but is NOT stored, so the stochastic slow model
+    # and the fault schedule cannot entangle
+    slow_eff = (slow | fx.slow_f) & up if fx is not None else slow
 
     # --------------------------------------------- mean-field population
     upf = up.astype(jnp.float32)
@@ -140,7 +159,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         n_elig = jnp.maximum(reduce_sum(eligf), 1.0)
         n_up_elig = jnp.maximum(reduce_sum(upf * eligf), 1e-9)
         sbar = reduce_sum(
-            (slow & up & elig).astype(jnp.float32)) / n_up_elig
+            (slow_eff & up & elig).astype(jnp.float32)) / n_up_elig
     else:
         # stale mode: last round's scalars (populations drift O(churn)
         # per round; statistically equivalent, lets XLA fuse the whole
@@ -149,7 +168,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         sbar = scalars[3] / n_up_elig
     frac_up_elig = n_up_elig / n_elig
 
-    g, pf_fast, pf_slow = _pf_arrays(slow, lh, sbar, n_live / n, p)
+    g, pf_fast, pf_slow = _pf_arrays(slow_eff, lh, sbar, n_live / n, p, fx)
 
     # ---------------------------------------------------- prober-side probe
     # P(ack | this node probes): random eligible target; down targets never
@@ -179,7 +198,14 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         e_pf_fast = scalars[4] / jnp.maximum(n_live, 1e-9)
         e_pf_slow = scalars[5] / jnp.maximum(n_live, 1e-9)
     probe_rate = n_live / jnp.maximum(n_elig - 1.0, 1.0)
-    p_fail_j = jnp.where(up, jnp.where(slow, e_pf_slow, e_pf_fast), 1.0)
+    base_fail = jnp.where(slow_eff, e_pf_slow, e_pf_fast)
+    if fx is not None:
+        # suspicion-weighted round-trip success: an unreachable node's
+        # probes all fail (suspw→0 ⇒ p_fail→1), while probers stuck
+        # behind a partition barely contribute (their suspicion rumor
+        # cannot reach the quorum side) — see faults.py module notes
+        base_fail = 1.0 - (1.0 - base_fail) * fx.suspw
+    p_fail_j = jnp.where(up, base_fail, 1.0)
     lam_fail = probe_rate * p_fail_j * eligf
     n_fail = _trunc_poisson(jax.random.uniform(k_pois, (L,)), lam_fail)
 
@@ -222,6 +248,15 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     # spread. A slow suspect processes its incoming gossip late (factor g).
     lam_hear = (p.gossip_nodes * p.gossip_ticks_per_round
                 * informed * (1.0 - p.loss) * g)
+    if fx is not None:
+        # a partitioned/lossy node hears the rumor about itself late or
+        # never — the refutation race is exactly what faults break.
+        # hear_w folds both legs of a refutation (hear the suspicion,
+        # get the answer back out — see faults._phase_arrays): gossip
+        # from same-side-of-the-cut peers carries no quorum-side
+        # suspicion, and a node whose egress is cut (one-way partition)
+        # hears everything, answers nothing, and still gets declared
+        lam_hear = lam_hear * fx.hear_w
     p_hear = 1.0 - jnp.exp(-lam_hear)
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
     refute = wrongly & (jax.random.uniform(k_hear, (L,)) < p_hear)
@@ -261,6 +296,8 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     grow = (~new_rumor) & (informed < 1.0)
     lam_g = (p.gossip_nodes * p.gossip_ticks_per_round
              * informed * (1.0 - p.loss))
+    if fx is not None:
+        lam_g = lam_g * fx.mid  # population-mean link degradation
     informed = jnp.where(
         grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_g)), informed)
 
@@ -280,7 +317,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         reduce_sum(upf2),
         jnp.maximum(reduce_sum(elig2f), 1.0),
         jnp.maximum(reduce_sum(upf2 * elig2f), 1e-9),
-        reduce_sum((slow & up & elig2).astype(jnp.float32)),
+        reduce_sum((slow_eff & up & elig2).astype(jnp.float32)),
         reduce_sum(upf2 * pf_fast), reduce_sum(upf2 * pf_slow),
         reduce_sum(w_fail2 * (lh.astype(jnp.float32) + 1.0)),
         jnp.maximum(reduce_sum(w_fail2), 1e-9)])
@@ -288,13 +325,14 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
 
 
 def gossip_round(state: SimState, key: jax.Array, p: SimParams,
-                 reduce_sum: Reducer = jnp.sum) -> SimState:
+                 reduce_sum: Reducer = jnp.sum,
+                 fx: Optional[FaultFrame] = None) -> SimState:
     """Advance one protocol period with LIVE population scalars.
 
     `reduce_sum` turns a per-node array into the *global* scalar sum —
     jnp.sum on one device; psum-wrapped in the sharded engine. All
     cross-node coupling flows through these scalars (mean-field)."""
-    out, _ = _round_core(state, None, key, p, reduce_sum)
+    out, _ = _round_core(state, None, key, p, reduce_sum, fx)
     return out
 
 
@@ -304,26 +342,38 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
 N_SCALARS = 8
 
 
-def _pf_arrays(slow, lh, sbar, live_frac, p: SimParams):
+def _pf_arrays(slow, lh, sbar, live_frac, p: SimParams,
+               fx: Optional[FaultFrame] = None):
     """Per-prober miss probabilities for fast/slow targets given the
-    population scalars (same math as gossip_round's noack_given)."""
+    population scalars (same math as gossip_round's noack_given).
+
+    With a FaultFrame, every channel is additionally scaled by the
+    prober's fault delivery odds: direct probes and TCP fallback by the
+    node's round trip (psend·precv — iptables-style faults drop TCP as
+    readily as UDP), relay legs by round trip times the population-mean
+    link quality (the relay's own two legs)."""
     g = jnp.where(slow, p.slow_factor, 1.0)
-    if p.lifeguard and p.slow_per_round:
+    if p.lifeguard and (p.slow_per_round or fx is not None):
         patience = 1.0 - jnp.exp2(-lh.astype(jnp.float32))
     else:
         patience = jnp.zeros_like(g)
+    if fx is not None:
+        rt = fx.psend * fx.precv
+        relay_m = rt * fx.mid
+    else:
+        rt = relay_m = jnp.float32(1.0)
 
     def noack_given(gj_val):
         gj = jnp.asarray(gj_val, jnp.float32)
         ge_i = g + (1.0 - g) * patience
         ge_j = gj + (1.0 - gj) * patience
         pair2 = (ge_i * ge_j) ** 2
-        p_d = p.p_direct * pair2
+        p_d = p.p_direct * pair2 * rt
         ge_p_slow = p.slow_factor + (1.0 - p.slow_factor) * patience
         e_gp4 = (1.0 - sbar) * 1.0 + sbar * ge_p_slow ** 4
-        p_relay1 = live_frac * p.p_relay * pair2 * e_gp4
+        p_relay1 = live_frac * p.p_relay * pair2 * e_gp4 * relay_m
         p_no_relay = (1.0 - p_relay1) ** p.indirect_checks
-        p_tcp = p.p_tcp * ge_i * ge_j
+        p_tcp = p.p_tcp * ge_i * ge_j * rt
         return (1.0 - p_d) * p_no_relay * (1.0 - p_tcp)
 
     return g, noack_given(1.0), noack_given(p.slow_factor)
@@ -355,7 +405,8 @@ def init_scalars(state: SimState, p: SimParams,
 
 def gossip_round_fast(state: SimState, scalars: jnp.ndarray,
                       key: jax.Array, p: SimParams,
-                      reduce_sum: Reducer = jnp.sum
+                      reduce_sum: Reducer = jnp.sum,
+                      fx: Optional[FaultFrame] = None
                       ) -> tuple[SimState, jnp.ndarray]:
     """One protocol period using LAST round's population scalars.
 
@@ -363,19 +414,22 @@ def gossip_round_fast(state: SimState, scalars: jnp.ndarray,
     source differs, so the two paths cannot drift. Statistical
     conformance is additionally asserted in tests/test_sim_round.py.
     """
-    return _round_core(state, scalars, key, p, reduce_sum)
+    return _round_core(state, scalars, key, p, reduce_sum, fx)
 
 
 def make_run_rounds_fast(p: SimParams, rounds: int):
     """Stale-scalar hot loop: state, key -> state (max throughput)."""
 
     @jax.jit
-    def run(state: SimState, key: jax.Array) -> SimState:
+    def run(state: SimState, key: jax.Array,
+            plan: Optional[CompiledFaultPlan] = None) -> SimState:
         scalars = init_scalars(state, p)
 
         def body(carry, k):
             s, sc = carry
-            s2, sc2 = gossip_round_fast(s, sc, k, p)
+            fx = fault_frame(plan, s.round_idx) if plan is not None \
+                else None
+            s2, sc2 = gossip_round_fast(s, sc, k, p, fx=fx)
             return (s2, sc2), None
 
         keys = jax.random.split(key, rounds)
@@ -387,21 +441,51 @@ def make_run_rounds_fast(p: SimParams, rounds: int):
 
 @functools.partial(jax.jit, static_argnames=("p", "rounds", "trace_node"))
 def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
-               trace_node: Optional[int] = None):
+               trace_node: Optional[int] = None,
+               plan: Optional[CompiledFaultPlan] = None):
     """Run `rounds` periods on-device via lax.scan.
 
     Returns (final_state, trace) where trace is the per-round informed
     fraction of `trace_node` (for propagation/convergence curves) or None.
+
+    `plan` is a compiled FaultPlan (faults.compile_plan): the scan body
+    derives each round's FaultFrame by indexing the per-phase tensors
+    with the round counter — phase boundaries are data, so the whole
+    multi-phase program is ONE compilation (plan tensors are traced
+    arguments, not static).
     """
 
     def body(carry, k):
-        s = gossip_round(carry, k, p)
+        fx = fault_frame(plan, carry.round_idx) if plan is not None \
+            else None
+        s = gossip_round(carry, k, p, fx=fx)
         out = s.informed[trace_node] if trace_node is not None else None
         return s, out
 
     keys = jax.random.split(key, rounds)
     final, trace = jax.lax.scan(body, state, keys)
     return final, trace
+
+
+@functools.partial(jax.jit, static_argnames=("p", "rounds"))
+def run_rounds_stats(state: SimState, key: jax.Array, p: SimParams,
+                     rounds: int,
+                     plan: Optional[CompiledFaultPlan] = None):
+    """Like run_rounds but stacks the cumulative SimStats after every
+    round (a [rounds]-leaved SimStats pytree) — the raw material for
+    per-phase chaos metrics (sim/metrics.phase_reports). Stats are a
+    handful of scalars, so the trace costs ~nothing next to the state.
+    """
+
+    def body(carry, k):
+        fx = fault_frame(plan, carry.round_idx) if plan is not None \
+            else None
+        s = gossip_round(carry, k, p, fx=fx)
+        return s, s.stats
+
+    keys = jax.random.split(key, rounds)
+    final, stats_trace = jax.lax.scan(body, state, keys)
+    return final, stats_trace
 
 
 def make_run_rounds(p: SimParams, rounds: int):

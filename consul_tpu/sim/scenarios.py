@@ -17,16 +17,18 @@ other side always fail.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
 from consul_tpu.config import GossipConfig
-from consul_tpu.sim.metrics import fd_report
+from consul_tpu.faults import (ChurnBurst, FaultPlan, Flap, NodeLoss,
+                               Partition, Phase, SlowNodes, compile_plan)
+from consul_tpu.sim.metrics import fd_report, phase_reports
 from consul_tpu.sim.params import SimParams, baseline_configs
-from consul_tpu.sim.round import run_rounds
-from consul_tpu.sim.state import ALIVE, DEAD, INF, init_state
+from consul_tpu.sim.round import run_rounds, run_rounds_stats
+from consul_tpu.sim.state import ALIVE, DEAD, INF, SUSPECT, init_state
 
 
 @dataclass
@@ -54,14 +56,6 @@ def partition_heal(n_dcs: int = 3, servers_per_dc: int = 3,
     the per-DC LAN pools keep running undisturbed."""
     wan_cfg = GossipConfig.wan()
     n_wan = n_dcs * servers_per_dc
-    # WAN pool with the partition expressed as total loss toward/from the
-    # minority side: model by marking DC-0 servers down from the OTHERS'
-    # standpoint is wrong (they're up) — instead run two phases:
-    #   phase 1 (partition): DC0 servers probe-unreachable ⇒ up=False in
-    #     the majority's pool AND vice versa, tracked as two pools.
-    # Mean-field single-pool approximation: flip DC0's `up` to False for
-    # the partition phase (unreachable ≡ dead from the pool's view),
-    # then flip back and watch refutation/rejoin dynamics.
     # the WAN pool is tiny; the mean-field model needs a handful of
     # members to be meaningful — refuse degenerate pools rather than
     # padding with phantoms the report would misdescribe
@@ -73,22 +67,37 @@ def partition_heal(n_dcs: int = 3, servers_per_dc: int = 3,
     key = jax.random.key(seed)
 
     dc0 = jnp.arange(p_wan.n) < servers_per_dc
-    # partition: DC0 unreachable from the majority pool
-    state = state._replace(
-        up=jnp.where(dc0, False, state.up),
-        down_time=jnp.where(dc0, 0.0, state.down_time))
-    state, _ = run_rounds(state, key, p_wan, partition_rounds)
+    # the REAL partition primitive (faults.Partition): every DC0<->rest
+    # leg drops, DC0 stays up the whole time. The quorum side suspects
+    # DC0 (its probes go unanswered) and DC0's refutations cannot cross
+    # the cut, so it IS declared failed — correct FD behavior, now from
+    # fault structure instead of the old flip-up-to-False loss hack.
+    # The trailing quiescent phase is held for every round past the
+    # plan's end, which is what the heal loop below runs in.
+    plan = FaultPlan(phases=(
+        Phase(rounds=partition_rounds,
+              faults=(Partition(a=(0, servers_per_dc),
+                                b=(servers_per_dc, n_wan)),),
+              name="partition"),
+        Phase(rounds=10, name="heal"),
+    ))
+    cp = compile_plan(plan, n_wan)
+    state, _ = run_rounds(state, key, p_wan, partition_rounds, plan=cp)
     during = fd_report(state, p_wan)
     detected = int(jnp.sum((state.status == DEAD) & dc0))
+    # stats count DC0's declarations as "false positives" (the members
+    # ARE up) — during a partition those are the CORRECT detections;
+    # the report's FP field means spurious majority-side declarations
+    fp_during = max(0, during.false_positives
+                    - int(jnp.sum((state.status == DEAD) & dc0
+                                  & state.up)))
 
-    # heal: DC0 reachable again; members rejoin with bumped incarnations
-    state = state._replace(
-        up=jnp.where(dc0, True, state.up),
-        down_time=jnp.where(dc0, INF, state.down_time))
+    # heal: rounds past the plan's end run the quiescent phase; DC0
+    # refutes with bumped incarnations once its gossip flows again
     recovery = None
     for chunk in range(40):
         state, _ = run_rounds(state, jax.random.fold_in(key, chunk),
-                              p_wan, 10)
+                              p_wan, 10, plan=cp)
         alive = bool(jnp.all((state.status == ALIVE) | ~dc0))
         if alive:
             recovery = (chunk + 1) * 10
@@ -109,9 +118,92 @@ def partition_heal(n_dcs: int = 3, servers_per_dc: int = 3,
         lan_nodes_per_dc=lan_nodes_per_dc,
         partition_rounds=partition_rounds,
         detected_cross_dc_failures=detected,
-        false_positives_during_partition=during.false_positives,
+        false_positives_during_partition=fp_during,
         healed_recovery_rounds=float(recovery or -1),
         lan_false_positives=lan_fp)
+
+
+# ------------------------------------------------------------------ chaos
+#
+# The detection-quality chaos suite: ≥5 named fault classes, each a
+# three-phase FaultPlan (quiet warm-up, fault window, recovery window)
+# run through the batched engine with per-round stats tracing. The
+# per-phase deltas (metrics.phase_reports) are the numbers Lifeguard's
+# claims are expressed in: how fast real failures are detected, how
+# many live nodes get wrongly declared, and whether refutation wins the
+# race once the fault clears.
+
+CHAOS_WARMUP_ROUNDS = 10
+CHAOS_FAULT_ROUNDS = 60
+CHAOS_RECOVER_ROUNDS = 50
+
+
+def chaos_plans(n: int) -> dict[str, FaultPlan]:
+    """The named chaos classes, sized for an n-node pool."""
+    m = max(1, n // 16)
+
+    def tri(name: str, *faults) -> FaultPlan:
+        return FaultPlan(phases=(
+            Phase(rounds=CHAOS_WARMUP_ROUNDS, name="warmup"),
+            Phase(rounds=CHAOS_FAULT_ROUNDS, faults=tuple(faults),
+                  name=name),
+            Phase(rounds=CHAOS_RECOVER_ROUNDS, name="recover"),
+        ))
+
+    return {
+        # one-way cut: the minority hears the quorum but cannot answer
+        # it — probes of it fail and its refutations never escape, so
+        # it must be declared failed (the hack-free version of what
+        # partition_heal asserts)
+        "asym_partition": tri(
+            "asym_partition",
+            Partition(a=(0, m), b=(m, n), drop=1.0, symmetric=False)),
+        # heavy bidirectional per-node packet loss on a minority:
+        # Lifeguard's suspicion scaling should keep FP low while
+        # detection stays possible
+        "per_node_loss": tri(
+            "per_node_loss",
+            NodeLoss(nodes=(0, 2 * m), ingress=0.5, egress=0.5)),
+        # forced-degraded nodes (GC pause / overload): acks late, the
+        # local-health machinery's target failure mode
+        "gc_pause": tri("gc_pause", SlowNodes(nodes=(0, 2 * m))),
+        # crash/recover cycling faster than the suspicion timeout
+        "flapping": tri("flapping",
+                        Flap(nodes=(0, m), half_period=5)),
+        # seeded mass churn: a quarter of the pool crashing at 2%/round
+        # with fast rejoin — join/leave volume, not network damage
+        "churn_burst": tri(
+            "churn_burst",
+            ChurnBurst(nodes=(0, n // 4), crash=0.02, rejoin=0.25)),
+    }
+
+
+def run_chaos(name: str, n: int = 4096, seed: int = 0,
+              p: Optional[SimParams] = None) -> dict[str, Any]:
+    """Run ONE chaos class and report per-phase detection quality."""
+    plan = chaos_plans(n)[name]
+    if p is None:
+        p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
+                                         tcp_fallback=False)
+    cp = compile_plan(plan, n)
+    state, tr = run_rounds_stats(init_state(n), jax.random.key(seed),
+                                 p, plan.total_rounds, plan=cp)
+    return {
+        "scenario": name, "n": n, "rounds": plan.total_rounds,
+        "phases": [r.to_dict() for r in phase_reports(tr, plan, p)],
+        "final_live_fraction": float(jnp.mean(
+            state.up.astype(jnp.float32))),
+        "final_wrongly_dead": int(jnp.sum(
+            state.up & ((state.status == DEAD)
+                        | (state.status == SUSPECT)))),
+    }
+
+
+def run_chaos_suite(n: int = 4096, seed: int = 0) -> dict[str, Any]:
+    """Every chaos class once. All plans share one phase-count shape,
+    so the whole suite costs a single run_rounds_stats compilation."""
+    return {name: run_chaos(name, n=n, seed=seed)
+            for name in chaos_plans(n)}
 
 
 def run_baseline_config(name: str, rounds: int = 300,

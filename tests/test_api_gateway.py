@@ -253,3 +253,94 @@ def test_gateway_services_lists_api_gateway_routes(agent, client):
                            ("tcp-route", "gs-tcp"),
                            ("inline-certificate", "gsc")):
             client.delete(f"/v1/config/{kind}/{name}")
+
+
+def test_vhost_merge_partial_overlap_unit():
+    """ADVICE (medium) regression: vhosts were keyed by the full domain
+    TUPLE, so routes with partially-overlapping hostname sets ({a,b}
+    vs {b,c}) emitted the shared domain under TWO virtual_hosts —
+    Envoy rejects the whole route config on a duplicate domain. Now
+    deduped at domain granularity: each domain appears exactly once
+    and carries every route that programs it, and vhost names stay
+    unique."""
+    from consul_tpu.connect.envoy import _merge_route_vhosts
+
+    ra = [{"match": {"prefix": "/"}, "route": {"cluster": "ca"}}]
+    rb = [{"match": {"path": "/y"}, "route": {"cluster": "cb"}}]
+    vhosts = _merge_route_vhosts([
+        ("over", ["a.example", "b.example"], ra),
+        ("over", ["b.example", "c.example"], rb)])
+    all_domains = sorted(d for vh in vhosts for d in vh["domains"])
+    assert all_domains == ["a.example", "b.example", "c.example"]
+    shared = next(vh for vh in vhosts if "b.example" in vh["domains"])
+    assert shared["routes"] == ra + rb  # both, in route order
+    only_a = next(vh for vh in vhosts if "a.example" in vh["domains"])
+    assert only_a["routes"] == ra
+    only_c = next(vh for vh in vhosts if "c.example" in vh["domains"])
+    assert only_c["routes"] == rb
+    names = [vh["name"] for vh in vhosts]
+    assert len(set(names)) == len(names)  # deduped vhost names too
+    # identical domain sets still fold into ONE vhost (the old
+    # behavior that was correct stays correct)
+    merged = _merge_route_vhosts([("r1", ["x.example"], ra),
+                                  ("r2", ["x.example"], rb)])
+    assert len(merged) == 1 and merged[0]["routes"] == ra + rb
+
+
+def test_partial_hostname_overlap_never_duplicates_domains(agent, client):
+    """The same regression end to end: api-gateway entries through
+    build_config emit domain-disjoint virtual_hosts."""
+    pytest.importorskip(
+        "cryptography",
+        reason="build_config signs the gateway's mesh leaf")
+    client.service_register({"Name": "s9", "ID": "s9i", "Port": 8112,
+                             "Connect": {"SidecarService": {}}})
+    wait_for(lambda: client.health_service("s9"), what="s9 up")
+    _apply(agent, {
+        "Kind": "api-gateway", "Name": "edge5",
+        "Listeners": [{"Name": "multi", "Port": 9082,
+                       "Protocol": "http"}]})
+    _apply(agent, {"Kind": "http-route", "Name": "over-ab",
+                   "Parents": [{"Name": "edge5",
+                                "SectionName": "multi"}],
+                   "Hostnames": ["a.example", "b.example"],
+                   "Rules": [{"Services": [{"Name": "s9"}]}]})
+    _apply(agent, {"Kind": "http-route", "Name": "over-bc",
+                   "Parents": [{"Name": "edge5",
+                                "SectionName": "multi"}],
+                   "Hostnames": ["b.example", "c.example"],
+                   "Rules": [{"Matches": [{"Path": {
+                       "Match": "exact", "Value": "/y"}}],
+                       "Services": [{"Name": "s9"}]}]})
+    client.service_register({
+        "Name": "edge5", "ID": "edge5gw", "Kind": "api-gateway",
+        "Port": 9072})
+    wait_for(lambda: client.health_service("edge5"), what="gw up")
+    from consul_tpu.server.grpc_external import build_config
+
+    try:
+        cfg = build_config(agent, "edge5gw")
+        lst = next(l for l in cfg["static_resources"]["listeners"]
+                   if l["name"] == "apigw_multi")
+        hcm = lst["filter_chains"][0]["filters"][0]["typed_config"]
+        vhosts = hcm["route_config"]["virtual_hosts"]
+        all_domains = [d for vh in vhosts for d in vh["domains"]]
+        assert sorted(all_domains) == ["a.example", "b.example",
+                                       "c.example"]
+        assert len(set(all_domains)) == len(all_domains)
+        # the shared domain carries BOTH routes, the exclusive ones one
+        shared = next(vh for vh in vhosts
+                      if "b.example" in vh["domains"])
+        assert len(shared["routes"]) == 2
+        only_a = next(vh for vh in vhosts
+                      if "a.example" in vh["domains"])
+        assert len(only_a["routes"]) == 1
+        names = [vh["name"] for vh in vhosts]
+        assert len(set(names)) == len(names)
+    finally:
+        client.service_deregister("edge5gw")
+        client.service_deregister("s9i")
+        for kind, name in (("api-gateway", "edge5"),
+                           ("http-route", "over-ab"),
+                           ("http-route", "over-bc")):
+            client.delete(f"/v1/config/{kind}/{name}")

@@ -380,3 +380,65 @@ def test_catalog_nodes_filter(agent):
     rc, out = run(agent, "catalog", "nodes", "-filter",
                   'Node == "no-such-node"')
     assert rc == 0 and "cliagent" not in out
+
+
+# ------------------------------------------------- gossip-sim (north star)
+#
+# VERDICT round 5 regression: `agent -dev -gossip-sim=cpu` ignored its
+# argument, initialised the DEFAULT jax backend and hung >60s on hosts
+# without a TPU. The platform value must be honored, init/compile must
+# run under a watchdog, and failures must exit with one parseable JSON
+# error line instead of a stuck process.
+
+def _run_sim(*argv):
+    import io
+    import sys
+
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        rc = cli_mod.main(list(argv))
+    finally:
+        sys.stdout = old
+    return rc, buf.getvalue()
+
+
+def test_gossip_sim_cpu_honors_platform_and_returns():
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                       "-gossip-sim-nodes", "64")
+    assert rc == 0, out
+    rep = json.loads(out[out.index("{"):])
+    assert rep["rounds_per_sec"] > 0
+    import jax
+
+    # the requested platform actually restricted backend init
+    assert jax.default_backend() == "cpu"
+
+
+def test_gossip_sim_unknown_platform_structured_error():
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "axon9")
+    assert rc == 1
+    err = json.loads(out.strip().splitlines()[-1])
+    assert "unknown -gossip-sim platform" in err["gossip_sim_error"]
+
+
+def test_gossip_sim_chaos_unknown_class_structured_error():
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                       "-gossip-sim-chaos", "not-a-fault")
+    assert rc == 1
+    err = json.loads(out.strip().splitlines()[-1])
+    assert "unknown chaos class" in err["gossip_sim_error"]
+
+
+def test_gossip_sim_chaos_end_to_end():
+    """The CLI north-star mode runs a named FaultPlan end to end and
+    reports per-phase detection quality."""
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                       "-gossip-sim-nodes", "64",
+                       "-gossip-sim-chaos", "asym_partition")
+    assert rc == 0, out
+    rep = json.loads(out[out.index("{"):])
+    assert rep["scenario"] == "asym_partition"
+    assert [p["phase"] for p in rep["phases"]] \
+        == ["warmup", "asym_partition", "recover"]

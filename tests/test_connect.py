@@ -904,3 +904,44 @@ def test_cross_dc_upstream_via_mesh_gateway(agent, client):
     assert cl2["transport_socket"]["typed_config"]["sni"] == sni
     client.service_deregister("web2x")
     client.service_deregister("mgw1")
+
+
+def test_exposed_check_ports_skip_other_proxies_configured_paths(
+        agent, client):
+    """The exposed-check port allocator folds EVERY local proxy's
+    configured Expose.Paths ListenerPorts into its used set
+    (regression): a neighbor sidecar already binding 21500 for its own
+    configured path means a derived Checks=true listener must never be
+    handed 21500 — that collision is a bind failure at proxy start."""
+    client.service_register({
+        "Name": "squatter", "ID": "sq1", "Port": 7110,
+        "Connect": {"SidecarService": {"Proxy": {"Expose": {
+            "Paths": [{"Path": "/stats", "LocalPathPort": 7110,
+                       "ListenerPort": 21500,
+                       "Protocol": "http"}]}}}}})
+    client.service_register({
+        "Name": "checked-app", "ID": "ck1", "Port": 7111,
+        "Check": {"HTTP": "http://127.0.0.1:7111/live",
+                  "Interval": "60s"},
+        "Connect": {"SidecarService": {"Proxy": {"Expose": {
+            "Checks": True}}}}})
+    wait_for(lambda: client.health_service("checked-app"),
+             what="checked-app in catalog")
+    # the allocator directly (build_config needs the crypto stack):
+    # derive checked-app's check paths for its sidecar snapshot
+    from consul_tpu.connect.proxycfg import _append_exposed_check_paths
+
+    try:
+        expose_paths: list = []
+        _append_exposed_check_paths(agent, "ck1-sidecar-proxy", "ck1",
+                                    expose_paths)
+        derived = [p for p in expose_paths if p["Path"] == "/live"]
+        assert derived, f"no derived check path in {expose_paths}"
+        assert derived[0]["LocalPathPort"] == 7111
+        assert derived[0]["ListenerPort"] != 21500, \
+            "derived check port collides with squatter's configured " \
+            "ListenerPort"
+        assert derived[0]["ListenerPort"] >= 21500
+    finally:
+        client.service_deregister("sq1")
+        client.service_deregister("ck1")

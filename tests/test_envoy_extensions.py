@@ -895,3 +895,36 @@ def test_permission_level_jwt_enforced(agent, client):
         agent.server.handle_rpc("ConfigEntry.Apply", {
             "Op": "delete", "Entry": {
                 "Kind": "jwt-provider", "Name": "corp2"}}, "t")
+
+
+def test_grpc_target_cluster_exact_names():
+    """Target.Service resolution matches EXACT upstream cluster names
+    derived from the snapshot's targets (as AwsLambdaExtension does).
+    Regression: the old prefix match on "upstream_{svc}_" also
+    captured a DIFFERENT upstream whose name extends this one past an
+    underscore ("db" vs "db_replica")."""
+    from consul_tpu.connect.extensions import (ExtensionError,
+                                               _grpc_target_cluster)
+
+    cfg = {"static_resources": {"clusters": [
+        {"name": "upstream_db_replica_db_replica"}]}}
+    snap = {"Upstreams": [{"DestinationName": "db_replica",
+                           "Targets": [{"Service": "db_replica"}]}]}
+    # "db" must NOT capture db_replica's cluster via the shared prefix
+    with pytest.raises(ExtensionError, match="not an upstream"):
+        _grpc_target_cluster(cfg, {"Service": {"Name": "db"}},
+                             "extauthz", snapshot=snap)
+    assert _grpc_target_cluster(
+        cfg, {"Service": {"Name": "db_replica"}}, "extauthz",
+        snapshot=snap) == "upstream_db_replica_db_replica"
+    # split-target upstream (service-resolver redirect): the cluster
+    # carries the TARGET service's name, not the destination's
+    cfg2 = {"static_resources": {"clusters": [
+        {"name": "upstream_db_v2"}]}}
+    snap2 = {"Upstreams": [{
+        "DestinationName": "db",
+        "Routes": [{"Targets": [{"Service": "v2"}]}],
+        "Targets": [{"Service": "v2"}]}]}
+    assert _grpc_target_cluster(
+        cfg2, {"Service": {"Name": "db"}}, "extauthz",
+        snapshot=snap2) == "upstream_db_v2"

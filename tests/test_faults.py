@@ -1,0 +1,507 @@
+"""FaultPlan structured fault injection (consul_tpu/faults.py).
+
+Covers every primitive on BOTH backends:
+
+  * compile-time folds: the per-phase mean-field tensors the batched
+    sim consumes (partition asymmetry, loss composition, duplication);
+  * the jitted hot path: phases are data — one compile per plan shape,
+    multi-phase plans never retrace;
+  * behavioral equivalence at small N: the same plan drives the JAX
+    mean-field engine and the discrete Serf engine (FaultInjector over
+    InMemNetwork) to the same qualitative detector outcomes;
+  * the chaos suite: >=5 named fault classes with per-phase
+    detection-latency / false-positive / refute metrics.
+"""
+
+import numpy as np
+import pytest
+
+from consul_tpu.faults import (ChurnBurst, Duplicate, FaultInjector,
+                               FaultPlan, Flap, NodeLoss, Partition,
+                               Phase, SlowNodes, _phase_arrays,
+                               compile_plan, fault_frame, node_mask)
+
+# ------------------------------------------------------------ selectors
+
+
+def test_node_mask_selectors():
+    assert node_mask(None, 4).all()
+    assert list(node_mask(0.5, 4)) == [True, True, False, False]
+    # fractions round UP and never select zero nodes
+    assert node_mask(0.01, 4).sum() == 1
+    assert list(node_mask((1, 3), 4)) == [False, True, True, False]
+    assert list(node_mask([0, 3], 4)) == [True, False, False, True]
+
+
+def test_node_mask_validation():
+    with pytest.raises(ValueError):
+        node_mask(1.5, 4)
+    with pytest.raises(ValueError):
+        node_mask((2, 9), 4)
+    with pytest.raises(ValueError):
+        node_mask([4], 4)
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError):
+        FaultPlan(phases=())
+    with pytest.raises(ValueError):
+        Phase(rounds=0)
+    plan = FaultPlan(phases=(Phase(rounds=3, name="a"),
+                             Phase(rounds=7)))
+    assert plan.total_rounds == 10
+    assert plan.starts == [0, 3]
+    assert plan.phase_names() == ["a", "phase1"]
+
+
+# ------------------------------------------------- compile-time folds
+
+
+def test_partition_total_cut_fold():
+    """Full symmetric cut: the minority's suspicion-weighted round trip
+    and refutation reach iterate to ~0 (its only carriers sit behind
+    the same cut), the quorum side's to ~1."""
+    pa = _phase_arrays(Phase(rounds=1, faults=(
+        Partition(a=(0, 3), b=(3, 9)),)), 9)
+    assert pa["suspw"][:3].max() < 1e-4
+    assert pa["hear_w"][:3].max() < 1e-4
+    assert pa["suspw"][3:].min() > 0.95
+    assert pa["hear_w"][3:].min() > 0.95
+    # one leg to a same-side peer still works: 2 of 8 peers reachable
+    np.testing.assert_allclose(pa["psend"][:3], 0.25, atol=1e-6)
+
+
+def test_partition_one_way_cut_fold():
+    """Egress-only cut (asymmetric): the minority still HEARS the
+    quorum (ingress open) but its answers cannot escape — refutation
+    reach collapses, which is what lets the quorum correctly declare
+    it (agent-level SWIM does the same)."""
+    pa = _phase_arrays(Phase(rounds=1, faults=(
+        Partition(a=(0, 2), b=(2, 16), symmetric=False),)), 16)
+    # ingress untouched, egress cut to 1/15 reachable peers
+    assert pa["precv"][:2].min() > 0.9
+    assert pa["psend"][:2].max() < 0.1
+    assert pa["hear_w"][:2].max() < 1e-3
+    assert pa["suspw"][:2].max() < 1e-3
+    # the quorum keeps most of its reach (the two mute peers no longer
+    # count as refutation carriers: 11/13 of its horizon remains)
+    assert pa["hear_w"][2:].min() > 0.8
+
+
+def test_node_loss_composes_and_duplicate_raises_delivery():
+    pa = _phase_arrays(Phase(rounds=1, faults=(
+        NodeLoss(nodes=[0], egress=0.5),
+        NodeLoss(nodes=[0], egress=0.5),)), 8)
+    # independent-drop composition: 1-(1-.5)(1-.5) = .75 kept-rate .25
+    assert pa["psend"][0] == pytest.approx(0.25, abs=1e-6)
+    lossy = _phase_arrays(Phase(rounds=1, faults=(
+        NodeLoss(nodes=[0], egress=0.5),)), 8)
+    dup = _phase_arrays(Phase(rounds=1, faults=(
+        NodeLoss(nodes=[0], egress=0.5), Duplicate(nodes=[0],
+                                                   copies=3),)), 8)
+    assert dup["psend"][0] > lossy["psend"][0]
+
+
+def test_unknown_primitive_rejected():
+    with pytest.raises(TypeError):
+        _phase_arrays(Phase(rounds=1, faults=("not-a-fault",)), 8)
+
+
+# --------------------------------------------------- jitted hot path
+
+
+def test_fault_frame_phase_boundaries_and_flap_schedule():
+    import jax.numpy as jnp
+
+    plan = FaultPlan(phases=(
+        Phase(rounds=4, name="quiet"),
+        Phase(rounds=6, faults=(NodeLoss(nodes=[0], egress=1.0),
+                                Flap(nodes=[1], half_period=2)),
+              name="fault"),
+        Phase(rounds=5, name="recover"),
+    ))
+    cp = compile_plan(plan, 4)
+
+    def frame(r):
+        return fault_frame(cp, jnp.int32(r))
+
+    assert float(frame(0).psend[0]) == pytest.approx(1.0)
+    assert float(frame(3).psend[0]) == pytest.approx(1.0)
+    # phase 2 starts at round 4; node0's egress is fully cut
+    assert float(frame(4).psend[0]) == pytest.approx(0.0)
+    assert float(frame(9).psend[0]) == pytest.approx(0.0)
+    assert float(frame(10).psend[0]) == pytest.approx(1.0)
+    # past the plan's end the LAST phase holds
+    assert float(frame(99).psend[0]) == pytest.approx(1.0)
+    # flap: rel rounds 0-1 up (rejoin), 2-3 down (crash), 4-5 up ...
+    assert float(frame(4).rejoin_p[1]) == pytest.approx(1.0)
+    assert float(frame(6).crash_p[1]) == pytest.approx(1.0)
+    assert float(frame(8).rejoin_p[1]) == pytest.approx(1.0)
+    # phase flip out of the flap revives the flapper on round 0 of the
+    # next phase (mirrors FaultInjector's restore-on-phase-flip)
+    assert float(frame(10).rejoin_p[1]) == pytest.approx(1.0)
+    assert float(frame(11).rejoin_p[1]) == pytest.approx(0.0)
+
+
+def test_one_compile_per_plan_shape():
+    """Acceptance: a multi-phase plan runs inside the scanned hot loop
+    with ONE compilation, and same-shape plans reuse it (the per-phase
+    tensors are traced arguments, never static)."""
+    import jax
+
+    from consul_tpu.sim.params import SimParams
+    from consul_tpu.sim.round import make_run_rounds_fast
+    from consul_tpu.sim.state import init_state
+
+    p = SimParams(n=64, collect_stats=False)
+    run = make_run_rounds_fast(p, 12)
+    plan_a = FaultPlan(phases=(
+        Phase(rounds=4),
+        Phase(rounds=4, faults=(Partition(a=(0, 8), b=(8, 64)),)),
+        Phase(rounds=4)))
+    plan_b = FaultPlan(phases=(
+        Phase(rounds=2, faults=(NodeLoss(nodes=0.25, egress=0.6),)),
+        Phase(rounds=6, faults=(Flap(nodes=[3], half_period=2),)),
+        Phase(rounds=4)))
+    key = jax.random.key(0)
+    run(init_state(64), key, plan=compile_plan(plan_a, 64))
+    run(init_state(64), key, plan=compile_plan(plan_b, 64))
+    assert run._cache_size() == 1, \
+        "same-shape fault plans must not retrace the hot loop"
+
+
+# ------------------------------------------- batched engine behavior
+
+
+def _run_plan(plan, n=256, seed=0, **params):
+    import jax
+
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim.params import SimParams
+    from consul_tpu.sim.round import run_rounds
+    from consul_tpu.sim.state import init_state
+
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
+                                     tcp_fallback=False, **params)
+    cp = compile_plan(plan, n)
+    state, _ = run_rounds(init_state(n), jax.random.key(seed), p,
+                          plan.total_rounds, plan=cp)
+    return state, p
+
+
+def test_batched_asymmetric_partition_declares_minority():
+    from consul_tpu.sim.state import DEAD
+
+    n, m = 256, 16
+    plan = FaultPlan(phases=(
+        Phase(rounds=10),
+        Phase(rounds=60, faults=(
+            Partition(a=(0, m), b=(m, n), symmetric=False),)),
+    ))
+    state, _ = _run_plan(plan, n=n)
+    status = np.asarray(state.status)
+    up = np.asarray(state.up)
+    # the egress-cut minority cannot answer probes nor push refutations
+    # out: the quorum declares it even though the processes are up
+    assert (status[:m] == DEAD).mean() > 0.8
+    assert up[:m].all()
+    # the quorum side itself stays undamaged
+    assert (status[m:] == DEAD).sum() == 0
+
+
+def test_batched_slow_nodes_lifeguard_vs_not():
+    """Forced-degraded (GC pause) nodes draw suspicion; Lifeguard's
+    patience keeps them from being declared dead. With Lifeguard OFF
+    the same plan produces strictly more false positives — the
+    quantitative claim the chaos suite exists to measure."""
+    n = 256
+    plan = FaultPlan(phases=(
+        Phase(rounds=10),
+        Phase(rounds=60, faults=(SlowNodes(nodes=(0, 32)),)),
+        Phase(rounds=30),
+    ))
+    state_lg, _ = _run_plan(plan, n=n, lifeguard=True)
+    state_off, _ = _run_plan(plan, n=n, lifeguard=False)
+    fp_lg = int(state_lg.stats.false_positives)
+    fp_off = int(state_off.stats.false_positives)
+    susp = int(state_lg.stats.suspicions)
+    assert susp > 0, "slow nodes must draw suspicion"
+    assert fp_lg <= fp_off, \
+        f"lifeguard should not increase FP ({fp_lg} vs {fp_off})"
+
+
+def test_batched_churn_burst_counted_and_detected():
+    n = 256
+    plan = FaultPlan(phases=(
+        Phase(rounds=10),
+        Phase(rounds=60, faults=(
+            ChurnBurst(nodes=(0, 64), crash=0.02, rejoin=0.25),)),
+        Phase(rounds=40),
+    ))
+    state, _ = _run_plan(plan, n=n)
+    st = state.stats
+    assert int(st.crashes) > 0
+    assert int(st.rejoins) > 0
+    # churn outside the selected group: none
+    assert not np.asarray(state.up)[64:].sum() < 192
+
+
+def test_batched_churn_burst_leave_channel():
+    """ChurnBurst.leave drives the graceful-LEFT channel: members in
+    the group leave (no suspicion race — intent gossip), the stats
+    trace counts them, and nobody outside the group departs."""
+    from consul_tpu.sim.state import LEFT
+
+    n = 256
+    plan = FaultPlan(phases=(
+        Phase(rounds=10),
+        Phase(rounds=60, faults=(
+            ChurnBurst(nodes=(0, 64), leave=0.05),)),
+    ))
+    state, _ = _run_plan(plan, n=n)
+    status = np.asarray(state.status)
+    assert int(state.stats.leaves) > 0
+    assert (status[:64] == LEFT).sum() > 0
+    assert (status[64:] == LEFT).sum() == 0
+
+
+def test_chaos_suite_runs_all_classes_with_phase_metrics():
+    """Acceptance: >=5 named fault classes on CPU, each reporting
+    per-phase detection latency / FP / refute counters."""
+    from consul_tpu.sim.scenarios import chaos_plans, run_chaos_suite
+
+    plans = chaos_plans(256)
+    assert {"asym_partition", "per_node_loss", "gc_pause",
+            "flapping", "churn_burst"} <= set(plans)
+    suite = run_chaos_suite(n=256)
+    for name, rep in suite.items():
+        assert [ph["phase"] for ph in rep["phases"]] == \
+            ["warmup", name, "recover"]
+        for ph in rep["phases"]:
+            for fld in ("suspicions", "refutes", "false_positives",
+                        "true_deaths_declared", "mean_detect_latency_s",
+                        "fp_per_node_hour"):
+                assert fld in ph
+        # a quiet warm-up precedes every fault window
+        assert rep["phases"][0]["suspicions"] == 0
+        assert rep["phases"][0]["false_positives"] == 0
+    # class-specific detector signatures
+    assert suite["asym_partition"]["phases"][1]["suspicions"] > 0
+    assert suite["per_node_loss"]["phases"][1]["refutes"] > 0
+    assert suite["gc_pause"]["phases"][1]["suspicions"] > 0
+    assert suite["gc_pause"]["phases"][1]["false_positives"] == 0
+    assert suite["flapping"]["phases"][1]["crashes"] > 0
+    assert suite["churn_burst"]["phases"][1]["crashes"] > 0
+    # every class ends healed: nobody stays wrongly suspected/declared
+    for rep in suite.values():
+        assert rep["final_wrongly_dead"] == 0
+        assert rep["final_live_fraction"] > 0.95
+
+
+# -------------------------------------------- discrete-engine backend
+
+
+def _serf_cluster(n, loss=0.0, seed=0):
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.gossip import InMemNetwork, Serf
+
+    cfg = GossipConfig.local()
+    net = InMemNetwork(seed=seed, loss=loss, latency=0.001)
+    serfs = []
+    for i in range(n):
+        t = net.attach(f"127.0.0.1:{8000 + i}")
+        s = Serf(f"node{i}", t, config=cfg, clock=net.clock, seed=i)
+        s.start()
+        serfs.append(s)
+    for s in serfs[1:]:
+        assert s.join([serfs[0].memberlist.transport.addr]) == 1
+    net.clock.advance(2.0)
+    return net, serfs, cfg
+
+
+def _statuses(serf):
+    return {ns.name: ns.status
+            for ns in serf.members(include_left=True)}
+
+
+def test_injector_partition_detects_then_heals():
+    from consul_tpu.types import MemberStatus
+
+    net, serfs, cfg = _serf_cluster(4)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    round_s = cfg.probe_interval
+    plan = FaultPlan(phases=(
+        Phase(rounds=75, faults=(Partition(a=[3], b=(0, 3)),),
+              name="cut"),
+        Phase(rounds=100, name="heal"),
+    ))
+    inj = FaultInjector(net, plan, addrs, round_s=round_s)
+    inj.schedule()
+    net.clock.advance(75 * round_s)
+    st = _statuses(serfs[0])
+    assert st["node3"] != MemberStatus.ALIVE, st
+    # heal phase flip was scheduled on the same clock; the partitioned
+    # node refutes with a bumped incarnation and rejoins
+    net.clock.advance(60 * round_s)
+    for s in serfs[:3]:
+        assert _statuses(s)["node3"] == MemberStatus.ALIVE
+
+
+def test_injector_node_loss_total_egress_is_detected():
+    from consul_tpu.types import MemberStatus
+
+    net, serfs, cfg = _serf_cluster(4)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    plan = FaultPlan(phases=(
+        Phase(rounds=75, faults=(NodeLoss(nodes=[3], egress=1.0),)),))
+    FaultInjector(net, plan, addrs,
+                  round_s=cfg.probe_interval).schedule()
+    net.clock.advance(75 * cfg.probe_interval)
+    # acks never escape node3: equivalent to the batched one-way cut —
+    # the quorum declares it
+    assert _statuses(serfs[0])["node3"] != MemberStatus.ALIVE
+
+
+def test_injector_slow_node_suspected_but_refutes():
+    """GC-pause semantics, Lifeguard's target case: every ack misses
+    its prober's deadline (probes AND the stream fallback time out on
+    the delayed responder), so the node draws suspicion — but its
+    EGRESS is healthy, the refutation race is winnable, and it must
+    end alive. Same signature the batched gc_pause chaos class pins."""
+    from consul_tpu.types import MemberStatus
+
+    net, serfs, cfg = _serf_cluster(4)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    plan = FaultPlan(phases=(
+        Phase(rounds=75, faults=(SlowNodes(nodes=[3]),)),))
+    FaultInjector(net, plan, addrs,
+                  round_s=cfg.probe_interval).schedule()
+    assert net.node_delay[addrs[3]] >= cfg.probe_interval
+    seen = set()
+    for _ in range(150):
+        net.clock.advance(0.5 * cfg.probe_interval)
+        for s in serfs[:3]:
+            seen.add(_statuses(s)["node3"])
+    assert MemberStatus.SUSPECT in seen, \
+        "a GC-paused node must draw suspicion"
+    assert _statuses(serfs[0])["node3"] == MemberStatus.ALIVE, \
+        "a live-but-slow node must refute and survive"
+
+
+def test_injector_flap_toggles_and_phase_flip_restores():
+    net, serfs, cfg = _serf_cluster(3)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    round_s = cfg.probe_interval
+    plan = FaultPlan(phases=(
+        Phase(rounds=8, faults=(Flap(nodes=[2], half_period=2),),
+              name="flap"),
+        Phase(rounds=10, name="calm"),
+    ))
+    FaultInjector(net, plan, addrs, round_s=round_s).schedule()
+    t2 = net.transports[addrs[2]]
+    assert not t2.closed                      # first half-period: up
+    net.clock.advance(2.5 * round_s)
+    assert t2.closed                          # second: down
+    net.clock.advance(2.0 * round_s)
+    assert not t2.closed                      # third: up again
+    net.clock.advance(4.0 * round_s)          # into the calm phase
+    assert not t2.closed, \
+        "phase flip must restore a flapped-down transport"
+
+
+def test_injector_duplicate_and_loss_on_raw_network():
+    """Transport-level semantics: per-node duplication sends N
+    independent copies; per-node ingress loss drops them
+    independently (matching the compile-time fold the batched backend
+    uses)."""
+    from consul_tpu.gossip.transport import InMemNetwork
+
+    net = InMemNetwork(seed=7, latency=0.0)
+    got = []
+    a = net.attach("a")
+    b = net.attach("b")
+    b.set_handlers(lambda src, pl: got.append(pl), None)
+    plan = FaultPlan(phases=(
+        Phase(rounds=10, faults=(Duplicate(nodes=[0], copies=3),)),))
+    FaultInjector(net, plan, ["a", "b"]).schedule()
+    a.send_packet("b", b"x")
+    net.clock.advance(0.1)
+    assert len(got) == 3
+    # ingress loss gates every copy independently
+    got.clear()
+    plan2 = FaultPlan(phases=(
+        Phase(rounds=10, faults=(Duplicate(nodes=[0], copies=40),
+                                 NodeLoss(nodes=[1], ingress=0.5),)),))
+    FaultInjector(net, plan2, ["a", "b"]).schedule()
+    a.send_packet("b", b"y")
+    net.clock.advance(0.1)
+    assert 5 < len(got) < 40
+
+
+def test_injector_phase_flip_clears_previous_faults():
+    from consul_tpu.gossip.transport import InMemNetwork
+
+    net = InMemNetwork(seed=1)
+    net.attach("a"), net.attach("b")
+    plan = FaultPlan(phases=(
+        Phase(rounds=5, faults=(NodeLoss(nodes=[0], egress=0.9),
+                                Partition(a=[0], b=[1]))),
+        Phase(rounds=5, name="clean"),
+    ))
+    inj = FaultInjector(net, plan, ["a", "b"], round_s=1.0)
+    inj.schedule()
+    assert net.node_out_loss and net._link_faults
+    net.clock.advance(5.0)
+    assert not net.node_out_loss and not net._link_faults
+    assert net._fault_drop_prob("a", "b") == 0.0
+
+
+def test_backends_agree_quiescent_plan_keeps_everyone_alive():
+    """Cross-backend equivalence, null case: a plan with no faults
+    changes nothing on either engine."""
+    from consul_tpu.sim.state import ALIVE
+    from consul_tpu.types import MemberStatus
+
+    plan = FaultPlan(phases=(Phase(rounds=40),))
+    state, _ = _run_plan(plan, n=256)
+    assert (np.asarray(state.status) == ALIVE).all()
+    assert np.asarray(state.up).all()
+
+    net, serfs, cfg = _serf_cluster(4)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    FaultInjector(net, plan, addrs,
+                  round_s=cfg.probe_interval).schedule()
+    net.clock.advance(40 * cfg.probe_interval)
+    for s in serfs:
+        assert all(v == MemberStatus.ALIVE
+                   for v in _statuses(s).values())
+
+
+def test_backends_agree_symmetric_cut_is_detected_and_heals():
+    """Cross-backend equivalence, partition case: both engines declare
+    the cut-off node during the fault window and revive it after."""
+    from consul_tpu.sim.state import DEAD
+    from consul_tpu.types import MemberStatus
+
+    n, m = 256, 16
+    jplan = FaultPlan(phases=(
+        Phase(rounds=60, faults=(Partition(a=(0, m), b=(m, n)),)),
+        Phase(rounds=110),
+    ))
+    state, _ = _run_plan(jplan, n=n)
+    status = np.asarray(state.status)
+    # healed: refutation won everywhere
+    assert (status[:m] == DEAD).sum() == 0
+
+    net, serfs, cfg = _serf_cluster(4)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    dplan = FaultPlan(phases=(
+        Phase(rounds=75, faults=(Partition(a=[3], b=(0, 3)),)),
+        Phase(rounds=110),
+    ))
+    FaultInjector(net, dplan, addrs,
+                  round_s=cfg.probe_interval).schedule()
+    net.clock.advance(75 * cfg.probe_interval)
+    assert _statuses(serfs[0])["node3"] != MemberStatus.ALIVE
+    net.clock.advance(80 * cfg.probe_interval)
+    assert _statuses(serfs[0])["node3"] == MemberStatus.ALIVE

@@ -821,3 +821,52 @@ def test_ads_rebuilds_are_change_driven(agent, client):
             client.service_deregister("spark1")
         except Exception:
             pass  # not registered when an earlier assert fired
+
+
+def test_ads_failed_rebuild_retries_next_tick(agent, client):
+    """A request-triggered rebuild that FAILS must retry on the next
+    tick: the request that warranted it is consumed, so without the
+    retry flag the rebuild would be deferred until a state table moved
+    or the 30s slow fallback lapsed — a new subscription could sit
+    unserved for 30s. Pinned with a stubbed snapshot builder: one
+    success commits last_state_idx (the deferral bug only bites then),
+    then a request-triggered build fails twice and the new resource
+    must still arrive within a few ticks, not after the fallback."""
+    from consul_tpu.server import grpc_external as ge
+
+    def cla_cfg(*names):
+        return {"static_resources": {"listeners": [], "clusters": [
+            {"name": n, "load_assignment": {"endpoints": []}}
+            for n in names]}}
+
+    state = {"fails": 0, "cfg": cla_cfg("stub_a")}
+
+    def stub(agent_, proxy_id):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise RuntimeError("transient snapshot failure")
+        return state["cfg"]
+
+    s = AdsStream(agent.grpc_port)
+    orig = ge.build_config
+    ge.build_config = stub
+    try:
+        s.send(type_url=EDS_TYPE, node={"id": PROXY_ID},
+               resource_names_subscribe=["*"])
+        s.recv_type(EDS_TYPE)  # successful build: last_state_idx set
+        s.settle()
+        state["cfg"] = cla_cfg("stub_a", "stub_b")
+        state["fails"] = 2
+        t0 = time.monotonic()
+        # request-triggered rebuild (subscribe changes the watch set)
+        s.send(type_url=EDS_TYPE,
+               resource_names_subscribe=["stub_b"])
+        resp = s.recv_type(
+            EDS_TYPE, timeout=10.0,
+            want=lambda r: any(x["name"] == "stub_b"
+                               for x in r["resources"]))
+        assert time.monotonic() - t0 < 10.0
+        assert state["fails"] == 0, "flaky build never exercised"
+    finally:
+        ge.build_config = orig
+        s.close()
