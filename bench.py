@@ -11,6 +11,11 @@ The workload is the "1m-lan" BASELINE config: 1M virtual members,
 DefaultLANConfig SWIM timing, Lifeguard on, 1% packet loss — the full
 failure-detector pipeline per round (probe/ack/indirect, suspicion
 scatter, Lifeguard timers, refutation race, epidemic dissemination).
+
+`--profile` adds a "profile" object to the JSON: a jax.profiler.trace
+capture dir, a compile/dispatch/device wall-time split, and the flight
+recorder's (sim/flight.py) measured overhead at the default decimation
+stride on the full-model kernel (recorded as PROFILE_r*.json).
 """
 
 import json
@@ -109,7 +114,14 @@ def main() -> None:
     # Local CPU smoke mode (documented in README): tiny cluster, same
     # code path end to end, finishes in ~a minute on one core.
     smoke = "--smoke" in sys.argv[1:]
+    # --profile: wrap one extra run in jax.profiler.trace (dir recorded
+    # in the JSON), split wall time into compile/dispatch/device stages,
+    # and measure the flight recorder's overhead at the default stride
+    profile = "--profile" in sys.argv[1:]
     if "--chaos" in sys.argv[1:]:
+        if profile:
+            print("--profile applies to the throughput bench only; "
+                  "ignored with --chaos", file=sys.stderr)
         run_chaos_bench(smoke)
         return
     metric = ("gossip_rounds_per_sec_smoke" if smoke
@@ -161,6 +173,8 @@ def main() -> None:
     key = jax.random.key(0)
     kernel = "xla-sharded"       # which TIMED kernel actually ran
     diag_kernel = "xla-sharded"  # and which full-model kernel
+    first_call_s = None          # wall time of the FIRST traced call
+    #                              (compile + one chunk), per engine
 
     diag_chunk = 20 if smoke else 200
     if len(devices) > 1:
@@ -178,8 +192,10 @@ def main() -> None:
             run = make_run_rounds_pallas(p, chunk)
             # Mosaic lowering only happens at first trace — force it HERE
             # so non-TPU hosts actually reach the fallback
+            t0 = time.perf_counter()
             probe = run(init_state(n), key)
             jax.block_until_ready(probe)
+            first_call_s = time.perf_counter() - t0
             del probe
             kernel = "pallas-stable-8array"
         except Exception as e:  # noqa: BLE001 — fall back to XLA path
@@ -207,9 +223,18 @@ def main() -> None:
 
     # compile + warmup (still under the init watchdog: a dead tunnel can
     # hang here just as easily as in jax.devices())
+    t0 = time.perf_counter()
     state = run(state, key)
-    state = run(state, jax.random.fold_in(key, 1))
     jax.block_until_ready(state)
+    if first_call_s is None:  # pallas timed its own compile probe
+        first_call_s = time.perf_counter() - t0
+    # steady-state stage split: dispatch (async call returns) vs device
+    # (block_until_ready drains the computation)
+    t0 = time.perf_counter()
+    state = run(state, jax.random.fold_in(key, 1))
+    dispatch_s = time.perf_counter() - t0
+    jax.block_until_ready(state)
+    steady_s = time.perf_counter() - t0
     watchdog.cancel()
 
     # best-of-3 trials (the shared-chip tunnel adds scheduling noise).
@@ -245,6 +270,68 @@ def main() -> None:
         full_best = min(full_best, time.perf_counter() - t0)
         assert checksum > 0
     full_rps = diag_chunk * diag_iters / full_best
+
+    profile_info = None
+    if profile:
+        import tempfile
+
+        # one extra (untimed) chunk under the JAX profiler; the trace
+        # dir rides the BENCH json so a perf PR can attach the capture
+        trace_dir = os.environ.get("CONSUL_TPU_PROFILE_DIR") or \
+            tempfile.mkdtemp(prefix="consul_tpu_profile_")
+        try:
+            with jax.profiler.trace(trace_dir):
+                pstate = run(state, jax.random.fold_in(key, 999))
+                jax.block_until_ready(pstate)
+        except Exception as e:  # noqa: BLE001 — profiler optional
+            print(f"jax.profiler.trace unavailable: {e}",
+                  file=sys.stderr)
+            trace_dir = None
+        # flight-recorder overhead at the default stride, on the same
+        # full-model kernel the diag numbers come from (accepts <5%)
+        flight_info = None
+        if len(devices) == 1:
+            from consul_tpu.sim.flight import DEFAULT_RECORD_EVERY
+
+            if diag_kernel == "pallas-full-10array":
+                from consul_tpu.sim.pallas_round import \
+                    make_run_rounds_pallas
+
+                fl_run = make_run_rounds_pallas(
+                    p_diag, diag_chunk,
+                    flight_every=DEFAULT_RECORD_EVERY)
+            else:
+                from consul_tpu.sim.round import make_run_rounds_flight
+
+                fl_run = make_run_rounds_flight(p_diag, diag_chunk,
+                                                DEFAULT_RECORD_EVERY)
+            fs, tr = fl_run(dstate, jax.random.fold_in(key, 2000))
+            jax.block_until_ready((fs, tr))  # compile before timing
+            fl_best = float("inf")
+            for trial in range(2):
+                t0 = time.perf_counter()
+                fs = dstate
+                for i in range(diag_iters):
+                    fs, tr = fl_run(fs, jax.random.fold_in(
+                        key, 2001 + 10 * trial + i))
+                checksum = float(fs.informed.sum())
+                fl_best = min(fl_best, time.perf_counter() - t0)
+                assert checksum > 0
+            flight_info = {
+                "record_every": DEFAULT_RECORD_EVERY,
+                "rounds_per_sec": round(
+                    diag_chunk * diag_iters / fl_best, 1),
+                "overhead_frac": round(fl_best / full_best - 1.0, 4),
+            }
+        profile_info = {
+            "trace_dir": trace_dir,
+            # first traced call minus a steady chunk ≈ compile+lower
+            "compile_s": round(max(first_call_s - steady_s, 0.0), 3),
+            "dispatch_s": round(dispatch_s, 4),
+            "device_s": round(steady_s - dispatch_s, 4),
+            "flight": flight_info,
+        }
+
     print(json.dumps({
         "metric": metric,
         "value": round(rps, 1),
@@ -257,6 +344,7 @@ def main() -> None:
         "full_model_rounds_per_sec": round(full_rps, 1),
         "platform": platform,
         **({"smoke": True, "n": n} if smoke else {}),
+        **({"profile": profile_info} if profile else {}),
     }))
     # detector-quality diagnostics from an instrumented run (stderr;
     # driver parses stdout only). Stats ride the state through EVERY

@@ -34,6 +34,15 @@ class StreamingBody:
         self.gen = gen
 
 
+class RawBody:
+    """A route result with an explicit content type (the prometheus
+    exposition dump is text/plain with a version param, not JSON)."""
+
+    def __init__(self, data: bytes, content_type: str) -> None:
+        self.data = data
+        self.content_type = content_type
+
+
 class HTTPError(Exception):
     def __init__(self, code: int, msg: str) -> None:
         super().__init__(msg)
@@ -83,11 +92,18 @@ class HTTPApi:
                             self.wfile.write(chunk)
                             self.wfile.flush()
                         return
+                    if isinstance(result, RawBody):
+                        result, forced_ctype = result.data, \
+                            result.content_type
+                    else:
+                        forced_ctype = None
                     payload = b"" if result is None else (
                         result if isinstance(result, bytes)
                         else json.dumps(result).encode())
-                    ctype = "application/octet-stream" \
-                        if isinstance(result, bytes) else "application/json"
+                    ctype = forced_ctype or (
+                        "application/octet-stream"
+                        if isinstance(result, bytes)
+                        else "application/json")
                     if path == "/" or path.startswith("/ui"):
                         ctype = "text/html; charset=utf-8"
                     self.send_response(200)
@@ -297,6 +313,11 @@ class HTTPApi:
                     "LoadAverage": {"load1": la[0], "load5": la[1],
                                     "load15": la[2]}}, None
         if path == "/v1/agent/metrics":
+            if q.get("format") == "prometheus":
+                # exposition-format dump (agent/http.go wires the
+                # prometheus handler behind the same route)
+                return RawBody(telemetry.default.prometheus().encode(),
+                               "text/plain; version=0.0.4"), None
             return telemetry.default.snapshot(), None
         if path == "/v1/agent/services":
             return filtered(
@@ -396,14 +417,21 @@ class HTTPApi:
                 interval = float(q.get("interval", "1.0"))
             except ValueError as exc:
                 raise HTTPError(400, f"bad stream params: {exc}") from exc
+            if interval <= 0 or intervals <= 0:
+                # a zero/negative interval would busy-loop the handler
+                # thread flat out; refuse before streaming starts
+                raise HTTPError(400, "interval and intervals must be "
+                                     "positive")
+            interval = max(interval, 0.1)  # floor: 10 snapshots/s
 
             def metrics_stream():
                 import time as time_mod
 
-                for _ in range(intervals):
+                for i in range(intervals):
                     yield (json.dumps(
                         telemetry.default.snapshot()) + "\n").encode()
-                    time_mod.sleep(interval)
+                    if i + 1 < intervals:  # no sleep after the final
+                        time_mod.sleep(interval)  # snapshot
 
             return StreamingBody(metrics_stream()), None
         if path == "/v1/agent/maintenance" and method in ("PUT", "POST"):

@@ -228,7 +228,8 @@ def _run_gossip_sim(cfg) -> int:
     # compile without killing a legitimately big simulation
     watchdog = arm(_SIM_RUN_TIMEOUT_S, "simulation compile/run")
 
-    from consul_tpu.sim import SimParams, init_state, run_rounds
+    from consul_tpu.sim import init_state, run_rounds_flight, SimParams
+    from consul_tpu.sim.flight import FlightPublisher, publish_report
     from consul_tpu.sim.metrics import fd_report
 
     n = cfg.gossip_sim_nodes
@@ -252,11 +253,21 @@ def _run_gossip_sim(cfg) -> int:
             print(json.dumps(rep, indent=2))
             return 0
         p = SimParams.from_gossip_config(cfg.gossip_lan, n=n, loss=0.01)
-        rounds = 100
+        rounds, chunk = 100, 20
         print(f"==> gossip-sim={platform}: {n} virtual members, "
               f"{rounds} rounds on {jax.devices()[0].platform}")
+        # the flight recorder rides the scan; each chunk's trace is
+        # published into the process-global telemetry registry as
+        # sim.* gauges/counters, so /v1/agent/metrics (and the debug
+        # bundle) see sim health as it evolves, not only at exit
+        pub = FlightPublisher()
+        key = jax.random.key(0)
+        state = init_state(n)
         t0 = time.perf_counter()
-        state, _ = run_rounds(init_state(n), jax.random.key(0), p, rounds)
+        for c in range(rounds // chunk):
+            state, trace = run_rounds_flight(
+                state, jax.random.fold_in(key, c), p, chunk)
+            pub.publish_trace(trace)
         jax.block_until_ready(state)
     except Exception as e:  # noqa: BLE001 — compile/run errors
         watchdog.cancel()
@@ -264,6 +275,7 @@ def _run_gossip_sim(cfg) -> int:
     watchdog.cancel()
     dt = time.perf_counter() - t0
     rep = fd_report(state, p)
+    publish_report(rep)
     print(json.dumps({"rounds_per_sec": round(rounds / dt, 1),
                       **rep.to_dict()}, indent=2))
     return 0

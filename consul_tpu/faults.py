@@ -400,6 +400,19 @@ def compile_plan(plan: FaultPlan, n: int) -> CompiledFaultPlan:
     )
 
 
+def active_phase(cp: CompiledFaultPlan, round_idx):
+    """Index of the phase whose faults shape round `round_idx` (0-d
+    int32; clipped, so rounds past the plan's end report the LAST
+    phase). Safe inside a jitted scan body; also what the flight
+    recorder (sim/flight.py) stores as its fault-phase column."""
+    import jax.numpy as jnp
+
+    n_phases = cp.starts.shape[0]
+    return jnp.clip(
+        jnp.searchsorted(cp.starts, round_idx, side="right") - 1,
+        0, n_phases - 1)
+
+
 def fault_frame(cp: CompiledFaultPlan, round_idx) -> FaultFrame:
     """The current round's fault view — pure indexing/elementwise math,
     safe inside a jitted lax.scan body (no shape depends on round_idx).
@@ -407,10 +420,7 @@ def fault_frame(cp: CompiledFaultPlan, round_idx) -> FaultFrame:
     import jax
     import jax.numpy as jnp
 
-    n_phases = cp.starts.shape[0]
-    ph = jnp.clip(
-        jnp.searchsorted(cp.starts, round_idx, side="right") - 1,
-        0, n_phases - 1)
+    ph = active_phase(cp, round_idx)
 
     def take(x):
         return jax.lax.dynamic_index_in_dim(x, ph, 0, keepdims=False)

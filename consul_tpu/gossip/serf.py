@@ -392,11 +392,20 @@ class Serf(MemberlistDelegate):
                              payload=payload, ltime=ltime))
 
     def _emit(self, ev: SerfEvent) -> None:
+        # dispatch latency per event TYPE (bounded label set: the
+        # EventType enum) — the agent's whole control plane hangs off
+        # these handlers (server_serf.go's eventCh consumer), so a slow
+        # one shows up here before it shows up as a stuck cluster
+        start = telemetry.time_now()
         for fn in list(self._handlers):
             try:
                 fn(ev)
             except Exception as e:  # noqa: BLE001
                 self.log.error("event handler error on %s: %s", ev.type, e)
+                self.metrics.incr("serf.events.handler_error",
+                                  labels={"type": ev.type.value})
+        self.metrics.measure_since("serf.events.dispatch", start,
+                                   {"type": ev.type.value})
 
     def _reap_tick(self) -> None:
         """Evict tombstoned members (serf reaper: failed after

@@ -1096,11 +1096,16 @@ class RaftNode:
                                  len(self._chunks))
                 self._chunks.clear()
             if e["kind"] == "cmd" and e["data"]:
+                start = telemetry.time_now()
                 try:
                     result = self.apply_fn(e["data"], idx)
                 except Exception as ex:  # noqa: BLE001
                     self.log.error("fsm apply failed at %d: %s", idx, ex)
                     result = ex
+                # commit->apply wall time per entry (the reference's
+                # consul.raft.fsm.apply) — the number that explains a
+                # growing commit/applied gap
+                self.metrics.measure_since("raft.fsm.apply", start)
                 if self.role == Role.LEADER:
                     self._apply_results[idx] = result
                     if len(self._apply_results) > 4096:
@@ -1123,12 +1128,14 @@ class RaftNode:
                 buf[seq] = e["data"]
                 if all(p is not None for p in buf):
                     del self._chunks[cid]
+                    start = telemetry.time_now()
                     try:
                         result = self.apply_fn(b"".join(buf), idx)
                     except Exception as ex:  # noqa: BLE001
                         self.log.error("fsm apply (chunked) failed "
                                        "at %d: %s", idx, ex)
                         result = ex
+                    self.metrics.measure_since("raft.fsm.apply", start)
                     if self.role == Role.LEADER:
                         self._apply_results[idx] = result
             elif e["kind"] == "verify":

@@ -43,7 +43,7 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
-from consul_tpu.utils import log
+from consul_tpu.utils import log, telemetry
 from consul_tpu.utils.pbwire import Field, decode, encode
 
 # guards lazy construction of the codec-only DNS instance (dns_query)
@@ -615,14 +615,19 @@ def _delta_ads_run(agent, request_iterator: Iterator[dict],
             continue  # nothing moved: skip the snapshot fan-in
         # ONE snapshot fan-in per tick; every subscribed type derives
         # from it (they all view the same bootstrap config)
+        build_start = telemetry.time_now()
         try:
             cfg = build_config(agent, node_id)
         except Exception as e:  # noqa: BLE001
             # a transiently unbuildable snapshot (e.g. CA mid-
             # bootstrap) must not kill the stream; retry next tick
             logger.warning("snapshot for %s failed: %s", node_id, e)
+            telemetry.default.incr("xds.rebuild.failed")
             retry_build = True
             continue
+        # rebuild duration, unlabeled: per-proxy labels would be
+        # unbounded cardinality at fleet scale
+        telemetry.default.measure_since("xds.rebuild", build_start)
         retry_build = False
         last_state_idx = cur_idx
         last_rebuild = now

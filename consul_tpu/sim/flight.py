@@ -1,0 +1,214 @@
+"""Flight recorder: per-round device-side telemetry for the gossip sim.
+
+The jitted scan loops used to surface exactly eight cumulative SimStats
+scalars per RUN — nothing about *when* detection quality degrades inside
+a run. This module defines a per-round trace row of rich aggregates
+(live fraction, Lifeguard health, suspicion/refutation counters, rumor
+spread, active fault phase, incarnation bumps) that both engines
+(sim/round.py XLA paths and sim/pallas_round.py) compute on-device and
+stack through their existing ``lax.scan``:
+
+  * every round writes its row into a carried ``[n_rows, N_COLS]``
+    buffer with one ``dynamic_update_slice`` — row ``i // record_every``
+    — so within a decimation window the LAST round's write wins and the
+    recorded row is the state at the window's end;
+  * the buffer is bounded by the ``record_every`` stride (a 1M-node ×
+    10k-round run at stride 10 is a 1000×17 f32 array, ~68KB) and is
+    fetched with a SINGLE ``device_get`` after the run — no per-round
+    host syncs, which is what keeps recorder overhead in the noise;
+  * counter columns store the SimStats DELTA over the row's decimation
+    window (in ``state.STATS_FIELDS`` order). Deltas, not cumulative:
+    a single window's event count is far below f32's 2^24 integer
+    range even at 1M nodes, so every row is exact, while cumulative
+    f32 counters would silently drop increments a few thousand rounds
+    into the flagship workload (the engines accumulate cumulative
+    stats in int32 for the same reason). ``stats_from_trace`` rebuilds
+    the cumulative series host-side in f64.
+
+The row builder is shared by both engines (it accepts flat [N] or the
+Pallas runner's packed 2-D arrays), which is what keeps the XLA and
+Pallas traces comparable column by column; conformance is asserted in
+tests/test_flight.py.
+
+``FlightPublisher`` bridges traces into the process-global
+``telemetry.Metrics`` registry as ``sim.*`` gauges/counters, so
+``/v1/agent/metrics`` (JSON and prometheus), the metrics stream, and
+``consul_tpu.cli debug`` capture all see sim health — the same
+always-on surface the reference gives its agent internals
+(lib/telemetry.go inmem sink).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.sim.state import (DEAD, STATS_FIELDS, SUSPECT, SimStats,
+                                  stats_vector)
+
+#: default decimation stride: bounds a 10k-round trace at 1k rows while
+#: keeping per-window resolution well under any suspicion timeout
+DEFAULT_RECORD_EVERY = 10
+
+#: instantaneous columns — the state at the recorded round's end
+GAUGE_COLUMNS = (
+    "t",                  # sim time (s) at the recorded round's end
+    "live_frac",          # mean(up) — ground-truth process liveness
+    "mean_informed",      # rumor-spread informed fraction, cluster mean
+    "suspect_frac",       # fraction of nodes currently rumored SUSPECT
+    "wrong_frac",         # live nodes rumored SUSPECT/DEAD (FP pressure)
+    "mean_local_health",  # Lifeguard awareness, cluster mean
+    "max_local_health",   # Lifeguard awareness, worst node
+    "inc_bumps",          # cumulative incarnation bumps (sum inc; f32 —
+    #                       exact below 2^24 total bumps)
+    "fault_phase",        # active FaultPlan phase index (-1: no plan)
+)
+
+#: full row layout: gauges then per-window SimStats deltas
+FLIGHT_COLUMNS = GAUGE_COLUMNS + STATS_FIELDS
+N_COLS = len(FLIGHT_COLUMNS)
+COL = {name: i for i, name in enumerate(FLIGHT_COLUMNS)}
+
+
+def n_trace_rows(rounds: int, record_every: int) -> int:
+    """Rows a `rounds`-round trace occupies at the given stride (the
+    final window may be short; its row still records the run's end)."""
+    if record_every <= 0:
+        raise ValueError(f"record_every must be positive: {record_every}")
+    return -(-rounds // record_every)
+
+
+def empty_trace(rounds: int, record_every: int) -> jnp.ndarray:
+    return jnp.zeros((n_trace_rows(rounds, record_every), N_COLS),
+                     jnp.float32)
+
+
+def flight_row(*, up, status, informed, local_health, incarnation, t,
+               stats_delta: SimStats, phase) -> jnp.ndarray:
+    """One [N_COLS] f32 trace row from post-round state (on-device).
+
+    `stats_delta` is the SimStats change over this row's decimation
+    window (current minus last-recorded cumulative; both engines keep
+    the cumulative side in int32, so the subtraction is exact and the
+    small delta survives the f32 cast). Accepts flat [N] arrays (XLA
+    engines) or the Pallas runner's packed [rows, LANES] arrays —
+    every aggregate reduces over all elements, so the two layouts
+    produce identical rows for identical state. `up` may be bool or
+    the packed int8 0/1 encoding."""
+    upf = (up.astype(jnp.int32) != 0)
+    statusi = status.astype(jnp.int32)
+    suspect = statusi == SUSPECT
+    wrong = upf & (suspect | (statusi == DEAD))
+    lh = local_health.astype(jnp.float32)
+    gauges = jnp.stack([
+        jnp.asarray(t, jnp.float32),
+        jnp.mean(upf.astype(jnp.float32)),
+        jnp.mean(informed),
+        jnp.mean(suspect.astype(jnp.float32)),
+        jnp.mean(wrong.astype(jnp.float32)),
+        jnp.mean(lh),
+        jnp.max(lh),
+        jnp.sum(incarnation.astype(jnp.float32)),
+        jnp.asarray(phase, jnp.float32),
+    ])
+    return jnp.concatenate([gauges, stats_vector(stats_delta)])
+
+
+def record_row(buf: jnp.ndarray, row: jnp.ndarray, i,
+               record_every: int) -> jnp.ndarray:
+    """Write `row` (round-local index `i`) into its decimation slot
+    (the min-clamp keeps a truncated final window in the last row)."""
+    slot = jnp.minimum(i // record_every, buf.shape[0] - 1)
+    return jax.lax.dynamic_update_slice(buf, row[None, :], (slot, 0))
+
+
+def maybe_record(carry, i, rounds: int, record_every: int, rec_fn):
+    """Run `rec_fn(carry)` iff round-local index `i` ENDS a decimation
+    window (or the run). `carry` is the engine's (trace buffer,
+    last-recorded cumulative stats) pair; `rec_fn` computes the window
+    delta, records the row, and advances the stats snapshot — all
+    inside the lax.cond's taken branch only, so decimation skips the
+    row's reduction work on the other record_every-1 rounds. That,
+    plus the single end-of-run fetch, is the recorder's whole overhead
+    story."""
+    is_end = ((i + 1) % record_every == 0) | (i + 1 >= rounds)
+    return jax.lax.cond(is_end, rec_fn, lambda c: c, carry)
+
+
+def stats_delta(cur: SimStats, prev: SimStats) -> SimStats:
+    """Elementwise SimStats subtraction (int32/f32 leaves — exact)."""
+    return jax.tree.map(lambda a, b: a - b, cur, prev)
+
+
+# ---------------------------------------------------------- host side
+
+
+def trace_columns(trace) -> dict[str, np.ndarray]:
+    """Device trace -> {column name: [n_rows] numpy array}. The single
+    end-of-run fetch: callers hold the result, not the device array."""
+    tr = np.asarray(jax.device_get(trace))
+    if tr.ndim != 2 or tr.shape[1] != N_COLS:
+        raise ValueError(f"not a flight trace: shape {tr.shape}, "
+                         f"expected [rows, {N_COLS}]")
+    return {name: tr[:, i] for i, name in enumerate(FLIGHT_COLUMNS)}
+
+
+def stats_from_trace(trace) -> SimStats:
+    """Rebuild the per-round CUMULATIVE SimStats pytree (f64 numpy
+    leaves, one leading [n_rows] axis) from a stride-1 flight trace —
+    the exact shape sim/metrics.phase_reports consumes, so chaos
+    reports can ride the flight recorder instead of a second
+    stats-only run. The trace stores per-window deltas; this f64
+    cumsum is where the cumulative series is reconstructed free of
+    f32's 2^24 integer range. Assumes the run started from zeroed
+    stats (fresh init_state), like every scenario runner."""
+    tr = np.asarray(jax.device_get(trace), np.float64)
+    return SimStats(**{f: np.cumsum(tr[:, COL[f]])
+                       for f in STATS_FIELDS})
+
+
+class FlightPublisher:
+    """Publish flight traces into a telemetry.Metrics registry.
+
+    Gauge columns become ``sim.<col>`` gauges (set from the trace's
+    final row); counter columns are per-window deltas, so a trace's
+    column SUM increments the ``sim.<col>`` counter by exactly that
+    trace's events. Publish each trace once — the chunked
+    ``-gossip-sim`` loop publishes disjoint traces, so the registry's
+    totals track the whole run. Metric names live under the registry's
+    prefix exactly like the reference's ``consul.*`` namespace carries
+    its serf/raft families."""
+
+    def __init__(self, metrics=None, prefix: str = "sim") -> None:
+        if metrics is None:
+            from consul_tpu.utils import telemetry
+
+            metrics = telemetry.default
+        self.metrics = metrics
+        self.prefix = prefix
+
+    def publish_trace(self, trace) -> None:
+        tr = np.asarray(jax.device_get(trace), np.float64)
+        if not tr.shape[0]:
+            return
+        for name in GAUGE_COLUMNS:
+            self.metrics.gauge(f"{self.prefix}.{name}",
+                               float(tr[-1, COL[name]]))
+        for f in STATS_FIELDS:
+            total = float(tr[:, COL[f]].sum())
+            if total:
+                self.metrics.incr(f"{self.prefix}.{f}", total)
+
+
+def publish_report(report, metrics=None, prefix: str = "sim") -> None:
+    """Publish an FDReport's numeric fields as ``sim.fd.*`` gauges."""
+    if metrics is None:
+        from consul_tpu.utils import telemetry
+
+        metrics = telemetry.default
+    for k, v in report.to_dict().items():
+        if isinstance(v, (int, float)):
+            metrics.gauge(f"{prefix}.fd.{k}", float(v))

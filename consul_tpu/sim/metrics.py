@@ -10,6 +10,7 @@ internal/gossip/libserf/serf.go:29-33).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +88,19 @@ _COUNTERS = ("suspicions", "refutes", "false_positives",
              "true_deaths_declared", "crashes", "rejoins", "leaves")
 
 
+def _phase_quality(d: dict, lat: float, phase_s: float, n: int) -> dict:
+    """The derived FD-quality rates of one phase window — single copy
+    shared by phase_reports and trace_report so the two report forms
+    cannot drift."""
+    td = d["true_deaths_declared"]
+    node_hours = n * phase_s / 3600.0
+    return {
+        "mean_detect_latency_s": lat / td if td else 0.0,
+        "fp_per_node_hour": (d["false_positives"] / node_hours
+                             if node_hours > 0 else 0.0),
+    }
+
+
 def phase_reports(stats_trace: SimStats, plan, p: SimParams,
                   ) -> list[PhaseReport]:
     """Split a per-round cumulative stats trace (run_rounds_stats) into
@@ -112,17 +126,85 @@ def phase_reports(stats_trace: SimStats, plan, p: SimParams,
                for f in _COUNTERS}
         lat = float(np.asarray(tr.detect_latency_sum)[end - 1])
         d = {f: int(cur[f] - prev[f]) for f in _COUNTERS}
-        td = d["true_deaths_declared"]
-        phase_s = (end - start) * p.probe_interval
-        node_hours = p.n * phase_s / 3600.0
         out.append(PhaseReport(
             phase=name, start_round=start, rounds=end - start,
-            mean_detect_latency_s=(lat - prev_lat) / td if td else 0.0,
-            fp_per_node_hour=(d["false_positives"] / node_hours
-                              if node_hours > 0 else 0.0),
+            **_phase_quality(d, lat - prev_lat,
+                             (end - start) * p.probe_interval, p.n),
             **d))
         prev, prev_lat = cur, lat
     return out
+
+
+def trace_report(trace, p: SimParams, plan=None, record_every: int = 1,
+                 rounds: Optional[int] = None) -> dict:
+    """Per-phase detection-latency / false-positive curves from a
+    flight trace (sim/flight.py).
+
+    `trace` is the [n_rows, N_COLS] recorder output; `plan` an optional
+    faults.FaultPlan whose phase windows split the curves (without one
+    the whole run is a single "run" phase). Counter columns are
+    per-window deltas, so a phase's totals are plain sums over its rows
+    — exact at any stride whose windows align with phase boundaries,
+    off by at most one window otherwise (a boundary-straddling window's
+    row belongs to the phase containing its end).
+    """
+    from consul_tpu.sim.flight import FLIGHT_COLUMNS, trace_columns
+
+    cols = trace_columns(trace)
+    n_rows = len(cols["t"])
+    if rounds is not None:
+        total = rounds
+    elif n_rows > 1:
+        # infer the (possibly truncated) final window from the t
+        # column — assuming full windows would inflate the last
+        # phase's duration and deflate its per-node-hour rates
+        last_w = int(round((cols["t"][-1] - cols["t"][-2])
+                           / p.probe_interval))
+        total = (n_rows - 1) * record_every + max(last_w, 1)
+    else:
+        total = n_rows * record_every
+    # round recorded by each row: its decimation window's end (the last
+    # window may be truncated by the run's end)
+    row_round = np.minimum((np.arange(n_rows) + 1) * record_every, total)
+
+    if plan is not None:
+        names, starts = plan.phase_names(), list(plan.starts)
+    else:
+        names, starts = ["run"], [0]
+
+    phases = []
+    for i, (name, start) in enumerate(zip(names, starts)):
+        if start >= total:
+            break
+        end = min(starts[i + 1] if i + 1 < len(starts) else total, total)
+        sel = (row_round > start) & (row_round <= end)
+        d = {f: int(cols[f][sel].sum()) for f in _COUNTERS}
+        lat = float(cols["detect_latency_sum"][sel].sum())
+        phases.append({
+            "phase": name, "start_round": int(start),
+            "rounds": int(end - start), **d,
+            **_phase_quality(d, lat, (end - start) * p.probe_interval,
+                             p.n),
+            "min_live_frac": (float(cols["live_frac"][sel].min())
+                              if sel.any() else 1.0),
+            "max_wrong_frac": (float(cols["wrong_frac"][sel].max())
+                               if sel.any() else 0.0),
+            # per-row curves inside the phase: gauges as sampled,
+            # counters as the per-window deltas the rows already are
+            # (the "when did it degrade" signal)
+            "curve": {
+                "round": [int(r) for r in row_round[sel]],
+                "live_frac": [round(float(v), 6)
+                              for v in cols["live_frac"][sel]],
+                "wrong_frac": [round(float(v), 6)
+                               for v in cols["wrong_frac"][sel]],
+                "false_positives": [int(v)
+                                    for v in cols["false_positives"][sel]],
+            },
+        })
+    return {"record_every": int(record_every), "rows": int(n_rows),
+            "rounds": int(total), "columns": list(FLIGHT_COLUMNS),
+            "phases": phases}
 
 
 def propagation_curve(trace: jnp.ndarray, probe_interval: float,

@@ -25,11 +25,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from consul_tpu.faults import CompiledFaultPlan, FaultFrame, fault_frame
+from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
+                               fault_frame)
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import (N_SCALARS, init_scalars,
                                   _pf_arrays, _shrink)
-from consul_tpu.sim.state import ALIVE, DEAD, LEFT, SUSPECT, SimState
+from consul_tpu.sim.state import (ALIVE, DEAD, LEFT, SUSPECT, SimState,
+                                  SimStats)
 
 INF = 3.4e38  # python float: jnp constants can't be captured by kernels
 
@@ -453,7 +455,8 @@ def _unpack(args, state: SimState, n_arrays: int, t_final, rounds,
 
 def make_run_rounds_pallas(p: SimParams, rounds: int,
                            interpret: bool = False,
-                           plan: Optional[CompiledFaultPlan] = None):
+                           plan: Optional[CompiledFaultPlan] = None,
+                           flight_every: Optional[int] = None):
     """Compiled hot loop using the fused Pallas round kernel.
 
     Covers the full protocol model including churn, slow-node
@@ -465,13 +468,27 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     dynamic index on the per-phase tensors and hands the kernel 8 extra
     per-node input lanes plus the plan's mean link quality as a 9th
     prefetch scalar. Phases are data — one Mosaic compile per plan
-    SHAPE, like the XLA paths."""
+    SHAPE, like the XLA paths.
+
+    `flight_every` arms the flight recorder (sim/flight.py): the scan
+    body assembles each round's trace row with plain jnp reductions
+    over the kernel's OUTPUT blocks (the same flight_row the XLA
+    engines use — the kernel itself is untouched) and the runner
+    returns (state, trace) instead of state. Counter columns ride the
+    kernel's existing stat partial-sum lanes, so collect_stats must be
+    on."""
     fault = plan is not None
+    if flight_every is not None and not p.collect_stats:
+        raise ValueError(
+            "flight recording rides the kernel's stats lanes; build "
+            "SimParams with collect_stats=True")
     one_round, rows, n_arrays = _build_round(p, p.n, interpret, fault)
 
     @jax.jit
     def _run(state: SimState, key: jax.Array,
-             cp: Optional[CompiledFaultPlan] = None) -> SimState:
+             cp: Optional[CompiledFaultPlan] = None):
+        from consul_tpu.sim import flight
+
         scalars = init_scalars(state, p)
         # clamp the tiny epsilons the XLA path uses
         scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
@@ -491,7 +508,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                            to2d(state.slow.astype(jnp.int8)))
 
         def body(carry, x):
-            args, scalars, t, acc = carry
+            args, scalars, t, acc, rec = carry
             seed, r = x
             if fault:
                 fx = fault_frame(cp, r)
@@ -511,15 +528,47 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             # CARRY accumulates in int32 — a long scan would pass f32's
             # integer range and silently drop counts. Latency (lane 4)
             # stays f32: it is a genuine real-valued sum.
-            return (args2, partials, t + p.probe_interval,
-                    (acc[0]
-                     + stat_sums.at[4].set(0.0).astype(jnp.int32),
-                     acc[1] + stat_sums[4])), None
+            acc_i = acc[0] + stat_sums.at[4].set(0.0).astype(jnp.int32)
+            acc_lat = acc[1] + stat_sums[4]
+            t2 = t + p.probe_interval
+            if flight_every is not None:
+                ph = active_phase(cp, r) if fault else jnp.int32(-1)
+
+                def rec_fn(c):
+                    # the row's counter lanes are the DELTA of the
+                    # int32 run accumulator against its last-recorded
+                    # snapshot (STATS_FIELDS lane order — the same the
+                    # kernel emits its sums in); the run's carried-in
+                    # stats cancel out of the subtraction entirely
+                    buf_c, (pi, pl) = c
+                    di = acc_i - pi
+                    delta = SimStats(
+                        suspicions=di[0], refutes=di[1],
+                        false_positives=di[2],
+                        true_deaths_declared=di[3],
+                        detect_latency_sum=acc_lat - pl,
+                        crashes=di[5], rejoins=di[6], leaves=di[7])
+                    row = flight.flight_row(
+                        up=args2[0], status=args2[1],
+                        informed=args2[3], local_health=args2[7],
+                        incarnation=args2[2], t=t2,
+                        stats_delta=delta, phase=ph)
+                    return (flight.record_row(
+                        buf_c, row, r - state.round_idx, flight_every),
+                        (acc_i, acc_lat))
+
+                rec = flight.maybe_record(rec, r - state.round_idx,
+                                          rounds, flight_every, rec_fn)
+            return (args2, partials, t2, (acc_i, acc_lat), rec), None
 
         acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
-        (args, scalars, t_final, acc), _ = jax.lax.scan(
-            body, (args, scalars, state.t, acc0), (seeds, ridx))
+        rec0 = (flight.empty_trace(rounds, flight_every), acc0) \
+            if flight_every is not None \
+            else jnp.zeros((0,), jnp.float32)
+        (args, scalars, t_final, acc, rec), _ = jax.lax.scan(
+            body, (args, scalars, state.t, acc0, rec0), (seeds, ridx))
         acc_i, acc_lat = acc
+        trace = rec[0] if flight_every is not None else None
         (up, status, inc, informed, s_start, s_dead, s_conf,
          lh) = args[:8]
         if n_arrays == 10:
@@ -540,7 +589,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                 crashes=st.crashes + acc_i[5],
                 rejoins=st.rejoins + acc_i[6],
                 leaves=st.leaves + acc_i[7])
-        return SimState(
+        out = SimState(
             up=up.reshape(-1) != 0, down_time=down_flat,
             status=status.reshape(-1), incarnation=inc.reshape(-1),
             informed=informed.reshape(-1),
@@ -550,12 +599,13 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             local_health=lh.reshape(-1),
             slow=slow_flat, t=t_final,
             round_idx=state.round_idx + rounds, stats=st)
+        return (out, trace) if flight_every is not None else out
 
     if fault:
         # bind the maker's plan; same-shape plans may be swapped in per
         # call without recompiling (the tensors are traced arguments)
         def run_fault(state: SimState, key: jax.Array,
-                      cp: Optional[CompiledFaultPlan] = None) -> SimState:
+                      cp: Optional[CompiledFaultPlan] = None):
             return _run(state, key, cp if cp is not None else plan)
 
         return run_fault

@@ -38,7 +38,8 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from consul_tpu.faults import CompiledFaultPlan, FaultFrame, fault_frame
+from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
+                               fault_frame)
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import (ALIVE, DEAD, INF, LEFT, SUSPECT, SimState,
                                   SimStats)
@@ -501,3 +502,62 @@ def make_run_rounds(p: SimParams, rounds: int):
         return final
 
     return run
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("p", "rounds", "record_every"))
+def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
+                      rounds: int, record_every: int = 1,
+                      plan: Optional[CompiledFaultPlan] = None):
+    """Run `rounds` periods with the flight recorder riding the scan.
+
+    Returns (final_state, trace) where trace is a
+    [ceil(rounds/record_every), flight.N_COLS] f32 array of per-round
+    aggregates (sim/flight.py): gauge columns are the state at the END
+    of each decimation window, counter columns the SimStats DELTA over
+    the window. Everything stays on device — the caller fetches the
+    bounded trace with ONE device_get after the run; no per-round host
+    syncs. PRNG use is identical to run_rounds/run_rounds_stats, so the
+    same key yields the same dynamics with or without the recorder.
+    """
+    from consul_tpu.sim import flight
+
+    if not p.collect_stats:
+        raise ValueError(
+            "the flight recorder's counter columns ride the SimStats "
+            "counters; build SimParams with collect_stats=True")
+
+    def body(carry, xs):
+        s, buf, prev = carry
+        k, i = xs
+        fx = fault_frame(plan, s.round_idx) if plan is not None else None
+        ph = active_phase(plan, s.round_idx) if plan is not None \
+            else jnp.int32(-1)
+        s2 = gossip_round(s, k, p, fx=fx)
+
+        def rec(c):
+            b, pv = c
+            row = flight.flight_row(
+                up=s2.up, status=s2.status, informed=s2.informed,
+                local_health=s2.local_health,
+                incarnation=s2.incarnation, t=s2.t,
+                stats_delta=flight.stats_delta(s2.stats, pv), phase=ph)
+            return flight.record_row(b, row, i, record_every), s2.stats
+
+        buf, prev = flight.maybe_record((buf, prev), i, rounds,
+                                        record_every, rec)
+        return (s2, buf, prev), None
+
+    keys = jax.random.split(key, rounds)
+    buf0 = flight.empty_trace(rounds, record_every)
+    (final, trace, _), _ = jax.lax.scan(
+        body, (state, buf0, state.stats),
+        (keys, jnp.arange(rounds, dtype=jnp.int32)))
+    return final, trace
+
+
+def make_run_rounds_flight(p: SimParams, rounds: int,
+                           record_every: int = 1):
+    """Pre-bound flight-recorded runner: state, key -> (state, trace)."""
+    return functools.partial(run_rounds_flight, p=p, rounds=rounds,
+                             record_every=record_every)

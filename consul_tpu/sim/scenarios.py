@@ -25,9 +25,11 @@ import jax.numpy as jnp
 from consul_tpu.config import GossipConfig
 from consul_tpu.faults import (ChurnBurst, FaultPlan, Flap, NodeLoss,
                                Partition, Phase, SlowNodes, compile_plan)
-from consul_tpu.sim.metrics import fd_report, phase_reports
+from consul_tpu.sim.flight import stats_from_trace
+from consul_tpu.sim.metrics import fd_report, phase_reports, trace_report
 from consul_tpu.sim.params import SimParams, baseline_configs
-from consul_tpu.sim.round import run_rounds, run_rounds_stats
+from consul_tpu.sim.round import (run_rounds, run_rounds_flight,
+                                  run_rounds_stats)
 from consul_tpu.sim.state import ALIVE, DEAD, INF, SUSPECT, init_state
 
 
@@ -180,17 +182,25 @@ def chaos_plans(n: int) -> dict[str, FaultPlan]:
 
 def run_chaos(name: str, n: int = 4096, seed: int = 0,
               p: Optional[SimParams] = None) -> dict[str, Any]:
-    """Run ONE chaos class and report per-phase detection quality."""
+    """Run ONE chaos class and report per-phase detection quality.
+
+    Rides the flight recorder at stride 1: the one trace both feeds the
+    per-phase SimStats deltas (phase_reports, via stats_from_trace) and
+    the per-round degradation curves (trace_report) — run_rounds_stats
+    remains for callers that only want the raw stats pytree."""
     plan = chaos_plans(n)[name]
     if p is None:
         p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
                                          tcp_fallback=False)
     cp = compile_plan(plan, n)
-    state, tr = run_rounds_stats(init_state(n), jax.random.key(seed),
-                                 p, plan.total_rounds, plan=cp)
+    state, trace = run_rounds_flight(init_state(n), jax.random.key(seed),
+                                     p, plan.total_rounds, plan=cp)
+    tr = stats_from_trace(trace)
     return {
         "scenario": name, "n": n, "rounds": plan.total_rounds,
         "phases": [r.to_dict() for r in phase_reports(tr, plan, p)],
+        "flight": trace_report(trace, p, plan=plan,
+                               rounds=plan.total_rounds),
         "final_live_fraction": float(jnp.mean(
             state.up.astype(jnp.float32))),
         "final_wrongly_dead": int(jnp.sum(

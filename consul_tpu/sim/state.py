@@ -40,6 +40,22 @@ class SimStats(NamedTuple):
         return SimStats(z, z, z, z, jnp.zeros((), jnp.float32), z, z, z)
 
 
+#: Canonical lane order for vectorized SimStats traces. This is the
+#: order the Pallas kernel emits its per-round stat partial sums in and
+#: the order the flight recorder (sim/flight.py) stores counter columns
+#: in — both engines keying off ONE tuple is what keeps their traces
+#: comparable column by column.
+STATS_FIELDS = ("suspicions", "refutes", "false_positives",
+                "true_deaths_declared", "detect_latency_sum",
+                "crashes", "rejoins", "leaves")
+
+
+def stats_vector(st: SimStats) -> jnp.ndarray:
+    """SimStats as an [8] f32 vector in STATS_FIELDS order (on-device)."""
+    return jnp.stack([getattr(st, f).astype(jnp.float32)
+                      for f in STATS_FIELDS])
+
+
 class SimState(NamedTuple):
     """Struct-of-arrays cluster state; all [N] unless noted."""
 

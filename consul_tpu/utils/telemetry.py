@@ -48,6 +48,13 @@ class Metrics:
         self._counters: dict[tuple[str, _Label], float] = defaultdict(float)
         self._gauges: dict[tuple[str, _Label], float] = {}
         self._samples: dict[tuple[str, _Label], list[float]] = defaultdict(list)
+        # lifetime sum/count per sample key: the buffer above is a
+        # sliding window (percentiles for the JSON snapshot), but a
+        # prometheus summary's _sum/_count must be MONOTONIC — exporting
+        # windowed values would read as counter resets under sustained
+        # load
+        self._sample_totals: dict[tuple[str, _Label], list[float]] = \
+            defaultdict(lambda: [0.0, 0.0])
 
     def incr(self, name: str, value: float = 1.0,
              labels: Optional[dict[str, str]] = None) -> None:
@@ -62,10 +69,14 @@ class Metrics:
     def sample(self, name: str, value: float,
                labels: Optional[dict[str, str]] = None) -> None:
         with self._lock:
-            buf = self._samples[_key(name, labels)]
+            k = _key(name, labels)
+            buf = self._samples[k]
             buf.append(value)
             if len(buf) > 4096:
                 del buf[: len(buf) - 4096]
+            tot = self._sample_totals[k]
+            tot[0] += value
+            tot[1] += 1
 
     def measure_since(self, name: str, start: float,
                       labels: Optional[dict[str, str]] = None) -> None:
@@ -102,12 +113,38 @@ class Metrics:
             return out
 
     def prometheus(self) -> str:
-        lines = []
+        """Prometheus text exposition format (version 0.0.4): one
+        ``# TYPE`` line per metric family, label values escaped, labels
+        in sorted-key order (the registry keys them sorted). Counters
+        get the ``_total`` suffix; timers/samples export as summaries
+        (``_sum``/``_count``), matching how the reference's prometheus
+        sink exposes its go-metrics timers."""
         with self._lock:
-            for (name, labels), v in sorted(self._counters.items()):
-                lines.append(_prom_line(self.prefix, name, labels, v, "_total"))
-            for (name, labels), v in sorted(self._gauges.items()):
-                lines.append(_prom_line(self.prefix, name, labels, v))
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            samples = [(k, (tot[0], int(tot[1])))
+                       for k, tot in sorted(self._sample_totals.items())
+                       if tot[1]]
+        lines: list[str] = []
+
+        def family(items, kind: str, suffix: str = "") -> None:
+            last = None
+            for (name, labels), v in items:
+                metric = _prom_name(self.prefix, name) + suffix
+                if metric != last:
+                    lines.append(f"# TYPE {metric} {kind}")
+                    last = metric
+                if kind == "summary":
+                    s, cnt = v
+                    lines.append(_prom_sample(metric + "_sum", labels, s))
+                    lines.append(
+                        _prom_sample(metric + "_count", labels, cnt))
+                else:
+                    lines.append(_prom_sample(metric, labels, v))
+
+        family(counters, "counter", "_total")
+        family(gauges, "gauge")
+        family(samples, "summary")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -115,13 +152,25 @@ class Metrics:
             self._counters.clear()
             self._gauges.clear()
             self._samples.clear()
+            self._sample_totals.clear()
 
 
-def _prom_line(prefix: str, name: str, labels: _Label, v: float,
-               suffix: str = "") -> str:
-    metric = (prefix + "_" + name).replace(".", "_").replace("-", "_") + suffix
+def _prom_name(prefix: str, name: str) -> str:
+    return (prefix + "_" + name).replace(".", "_").replace("-", "_")
+
+
+def _prom_escape(v: str) -> str:
+    """Escape a label VALUE per the exposition format: backslash,
+    double-quote, and newline."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_sample(metric: str, labels: _Label, v: float) -> str:
     if labels:
-        lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+        lbl = ",".join(
+            f'{k.replace(".", "_").replace("-", "_")}="{_prom_escape(val)}"'
+            for k, val in labels)
         return f"{metric}{{{lbl}}} {v}"
     return f"{metric} {v}"
 

@@ -272,6 +272,72 @@ def test_metrics_endpoint(agent, client):
     assert "Counters" in snap and "Samples" in snap
 
 
+def test_metrics_prometheus_format(agent, client):
+    """?format=prometheus serves the exposition-format dump as
+    text/plain (Consul parity: agent/http.go prometheus handler), and
+    sim.* gauges published by a sim run are visible on it."""
+    from consul_tpu.utils import telemetry
+
+    # a flight-recorded sim run publishes into the process-global
+    # registry — exactly what `agent -dev -gossip-sim` does
+    import jax
+
+    from consul_tpu.sim import SimParams, init_state, run_rounds_flight
+    from consul_tpu.sim.flight import FlightPublisher
+
+    p = SimParams(n=256, loss=0.2, tcp_fallback=False)
+    _, trace = run_rounds_flight(init_state(p.n), jax.random.key(0), p, 10)
+    FlightPublisher().publish_trace(trace)
+
+    # guarantee at least one fully-recorded http.request sample before
+    # the dump (a standalone run of this test has no prior traffic)
+    client.get("/v1/agent/metrics")
+    raw, headers = client._call("GET", "/v1/agent/metrics",
+                                {"format": "prometheus"})
+    assert isinstance(raw, bytes)
+    assert headers["Content-Type"] == "text/plain; version=0.0.4"
+    text = raw.decode()
+    assert "# TYPE consul_sim_live_frac gauge" in text
+    assert "consul_sim_live_frac " in text
+    # request-latency samples export as summaries
+    assert "# TYPE consul_http_request summary" in text
+    assert 'method="GET"' in text
+    # every sample line's metric name was sanitized (no dots/dashes)
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            name = line.split("{")[0].split(" ")[0]
+            assert "." not in name and "-" not in name, line
+    # escaping: a hostile label value survives the round trip escaped
+    telemetry.default.gauge("test.escape", 1.0,
+                            labels={"v": 'a"b\\c\nd'})
+    text2 = client._call("GET", "/v1/agent/metrics",
+                         {"format": "prometheus"})[0].decode()
+    assert r'v="a\"b\\c\nd"' in text2
+
+
+def test_metrics_stream_rejects_nonpositive_interval(agent, client):
+    # interval<=0 used to busy-loop the handler thread flat out
+    for params in ({"interval": "0"}, {"interval": "-1"},
+                   {"intervals": "0"}):
+        with pytest.raises(APIError) as ei:
+            client.get("/v1/agent/metrics/stream", **params)
+        assert ei.value.code == 400
+
+    # a valid stream returns `intervals` snapshots and does NOT sleep
+    # after the final one (3 snapshots at 0.1s floor ≈ 0.2s, not 0.3+)
+    t0 = time.time()
+    with urllib.request.urlopen(
+            f"http://{agent.http.addr}/v1/agent/metrics/stream"
+            "?intervals=3&interval=0.01", timeout=10) as resp:
+        body = resp.read()
+    elapsed = time.time() - t0
+    lines = [ln for ln in body.decode().splitlines() if ln]
+    assert len(lines) == 3
+    for ln in lines:
+        assert "Counters" in json.loads(ln)
+    assert elapsed < 2.0, "stream slept after the final snapshot"
+
+
 def test_prepared_query_crud_and_execute(agent, client):
     client.service_register({
         "Name": "api", "ID": "api1", "Port": 9090,

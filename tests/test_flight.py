@@ -1,0 +1,305 @@
+"""Flight-recorder correctness (fast CPU tier-1 coverage).
+
+The recorder is the observability surface every perf/robustness PR
+reports through, so it gets the same protection as the protocol body:
+counter columns must be EXACTLY the cumulative SimStats (same key ⇒
+same dynamics with or without the recorder), decimation must be pure
+row-sampling, and the row builder shared by the XLA and Pallas engines
+must be layout-invariant. Engine-level XLA↔Pallas trace conformance at
+scale is TPU-gated in tests/test_pallas_round.py style below.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.faults import (ChurnBurst, FaultPlan, Phase, active_phase,
+                               compile_plan)
+from consul_tpu.sim import (SimParams, init_state, run_rounds_flight,
+                            run_rounds_stats)
+from consul_tpu.sim.flight import (COL, DEFAULT_RECORD_EVERY,
+                                   FLIGHT_COLUMNS, GAUGE_COLUMNS,
+                                   FlightPublisher, flight_row,
+                                   n_trace_rows, publish_report,
+                                   stats_from_trace, trace_columns)
+from consul_tpu.sim.metrics import fd_report, phase_reports, trace_report
+from consul_tpu.sim.state import STATS_FIELDS
+
+tpu_only = pytest.mark.skipif(
+    jax.devices()[0].platform not in ("tpu", "axon"),
+    reason="pallas kernel targets TPU; CPU suite runs the XLA paths")
+
+_P = SimParams(n=1024, loss=0.2, tcp_fallback=False,
+               fail_per_round=0.002, rejoin_per_round=0.02)
+
+
+def test_trace_shape_and_columns():
+    state, trace = run_rounds_flight(init_state(_P.n), jax.random.key(0),
+                                     _P, 24, record_every=5)
+    assert trace.shape == (n_trace_rows(24, 5), len(FLIGHT_COLUMNS))
+    assert trace.shape[0] == 5  # ceil(24/5): final window is short
+    cols = trace_columns(trace)
+    assert set(cols) == set(FLIGHT_COLUMNS)
+    # rows are chronological: t strictly increases
+    assert np.all(np.diff(cols["t"]) > 0)
+
+
+def test_counter_columns_are_exact_per_round_stats_deltas():
+    """Trace row t's counter columns must equal the cumulative-stats
+    DELTA at that round (stride 1: the per-round event counts): the
+    flight run and a run_rounds_stats run with the same key use
+    identical PRNG, so the comparison is exact, not statistical.
+    Deltas rather than cumulative is what keeps rows exact in f32 at
+    the 1M-node × 10k-round scale (a window's events sit far below
+    2^24; the cumulative series does not)."""
+    key = jax.random.key(1)
+    _, trace = run_rounds_flight(init_state(_P.n), key, _P, 40)
+    _, st = run_rounds_stats(init_state(_P.n), key, _P, 40)
+    tr = np.asarray(trace, np.float64)
+    for f in STATS_FIELDS:
+        cum = np.asarray(getattr(st, f), np.float64)
+        np.testing.assert_allclose(
+            tr[:, COL[f]], np.diff(cum, prepend=0.0), err_msg=f)
+    # and stats_from_trace reconstructs the cumulative series exactly
+    rebuilt = stats_from_trace(trace)
+    for f in STATS_FIELDS:
+        np.testing.assert_allclose(getattr(rebuilt, f),
+                                   np.asarray(getattr(st, f), np.float64),
+                                   err_msg=f)
+    # something actually happened in this config
+    assert tr[:, COL["suspicions"]].sum() > 0
+    assert tr[:, COL["crashes"]].sum() > 0
+
+
+def test_decimation_is_pure_sampling():
+    """Stride-k gauge columns are every k-th row of the stride-1 trace
+    and stride-k counter columns are the window sums — the recorder
+    must not perturb dynamics or leak events across windows."""
+    key = jax.random.key(2)
+    _, t1 = run_rounds_flight(init_state(_P.n), key, _P, 40)
+    _, t4 = run_rounds_flight(init_state(_P.n), key, _P, 40,
+                              record_every=4)
+    tr1, tr4 = np.asarray(t1, np.float64), np.asarray(t4, np.float64)
+    for g in GAUGE_COLUMNS:
+        np.testing.assert_array_equal(tr4[:, COL[g]],
+                                      tr1[3::4, COL[g]], err_msg=g)
+    for f in STATS_FIELDS:
+        np.testing.assert_allclose(
+            tr4[:, COL[f]],
+            np.add.reduceat(tr1[:, COL[f]], np.arange(0, 40, 4)),
+            err_msg=f)
+    # truncated final window: last row still records the run's end
+    _, t7 = run_rounds_flight(init_state(_P.n), key, _P, 40,
+                              record_every=7)
+    tr7 = np.asarray(t7, np.float64)
+    for g in GAUGE_COLUMNS:
+        np.testing.assert_array_equal(
+            tr7[:, COL[g]], tr1[[6, 13, 20, 27, 34, 39], COL[g]],
+            err_msg=g)
+    for f in STATS_FIELDS:
+        np.testing.assert_allclose(
+            tr7[:, COL[f]],
+            np.add.reduceat(tr1[:, COL[f]], np.arange(0, 40, 7)),
+            err_msg=f)
+
+
+def test_final_row_matches_final_state():
+    state, trace = run_rounds_flight(init_state(_P.n), jax.random.key(3),
+                                     _P, 30, record_every=3)
+    last = np.asarray(trace)[-1]
+    assert last[COL["live_frac"]] == pytest.approx(
+        float(np.mean(np.asarray(state.up))), abs=1e-6)
+    assert last[COL["mean_informed"]] == pytest.approx(
+        float(np.mean(np.asarray(state.informed))), rel=1e-5)
+    assert last[COL["max_local_health"]] == float(
+        np.max(np.asarray(state.local_health)))
+    assert last[COL["inc_bumps"]] == float(
+        np.sum(np.asarray(state.incarnation)))
+    assert last[COL["t"]] == pytest.approx(float(state.t), rel=1e-6)
+    assert last[COL["fault_phase"]] == -1.0  # no plan
+
+
+def test_fault_phase_column_tracks_plan():
+    plan = FaultPlan(phases=(
+        Phase(rounds=5, name="quiet"),
+        Phase(rounds=5, faults=(ChurnBurst(nodes=0.25, crash=0.2),),
+              name="burst"),
+        Phase(rounds=5, name="recover")))
+    cp = compile_plan(plan, _P.n)
+    _, trace = run_rounds_flight(init_state(_P.n), jax.random.key(4),
+                                 _P, 15, plan=cp)
+    phases = np.asarray(trace)[:, COL["fault_phase"]]
+    np.testing.assert_array_equal(phases, [0] * 5 + [1] * 5 + [2] * 5)
+    # the host-side mirror agrees with the on-device column
+    assert int(active_phase(cp, jnp.int32(7))) == 1
+    # the burst actually registered in the counters: delta rows land
+    # in the burst window, far above the baseline-churn floor
+    tr = np.asarray(trace)
+    assert tr[5:10, COL["crashes"]].sum() > \
+        5 * tr[:5, COL["crashes"]].sum()
+
+
+def test_flight_requires_collect_stats():
+    p = _P.with_(collect_stats=False)
+    with pytest.raises(ValueError, match="collect_stats"):
+        run_rounds_flight(init_state(p.n), jax.random.key(0), p, 4)
+
+
+def test_row_builder_is_layout_invariant():
+    """The XLA engines hand flight_row flat [N] arrays; the Pallas
+    runner hands it the kernel's packed 2-D int8 blocks. Identical
+    state must produce identical rows — this is the CPU-side leg of
+    XLA/Pallas trace conformance (the PRNG-level leg is TPU-gated)."""
+    state, _ = run_rounds_flight(init_state(_P.n), jax.random.key(5),
+                                 _P, 20)
+    flat = flight_row(
+        up=state.up, status=state.status, informed=state.informed,
+        local_health=state.local_health, incarnation=state.incarnation,
+        t=state.t, stats_delta=state.stats, phase=jnp.int32(-1))
+    packed = flight_row(
+        up=state.up.astype(jnp.int8).reshape(4, -1),
+        status=state.status.reshape(4, -1),
+        informed=state.informed.reshape(4, -1),
+        local_health=state.local_health.reshape(4, -1),
+        incarnation=state.incarnation.reshape(4, -1),
+        t=state.t, stats_delta=state.stats, phase=jnp.int32(-1))
+    # reduction ORDER differs between layouts, so means can differ by
+    # an ulp; everything else (counts, maxes, sums of small ints) is
+    # exact
+    np.testing.assert_allclose(np.asarray(flat), np.asarray(packed),
+                               rtol=1e-6)
+
+
+def test_stats_from_trace_feeds_phase_reports():
+    """Chaos reports rebuilt from the flight trace must match the
+    run_rounds_stats pathway they replaced."""
+    plan = FaultPlan(phases=(
+        Phase(rounds=8, name="warmup"),
+        Phase(rounds=12, faults=(ChurnBurst(nodes=0.25, crash=0.1),),
+              name="burst")))
+    cp = compile_plan(plan, _P.n)
+    key = jax.random.key(6)
+    _, trace = run_rounds_flight(init_state(_P.n), key, _P, 20, plan=cp)
+    _, st = run_rounds_stats(init_state(_P.n), key, _P, 20, plan=cp)
+    a = phase_reports(stats_from_trace(trace), plan, _P)
+    b = phase_reports(st, plan, _P)
+    assert [r.to_dict() for r in a] == [r.to_dict() for r in b]
+
+
+def test_trace_report_per_phase_curves():
+    plan = FaultPlan(phases=(
+        Phase(rounds=10, name="warmup"),
+        Phase(rounds=10, faults=(ChurnBurst(nodes=0.5, crash=0.15),),
+              name="burst"),
+        Phase(rounds=10, name="recover")))
+    cp = compile_plan(plan, _P.n)
+    _, trace = run_rounds_flight(init_state(_P.n), jax.random.key(7),
+                                 _P, 30, plan=cp)
+    rep = trace_report(trace, _P, plan=plan, rounds=30)
+    assert [ph["phase"] for ph in rep["phases"]] == \
+        ["warmup", "burst", "recover"]
+    burst = rep["phases"][1]
+    assert burst["crashes"] > rep["phases"][0]["crashes"]
+    assert burst["min_live_frac"] < 1.0
+    assert len(burst["curve"]["round"]) == 10
+    # per-phase counter deltas agree with the PhaseReport pathway
+    for ph, pr in zip(rep["phases"],
+                      phase_reports(stats_from_trace(trace), plan, _P)):
+        for f in ("suspicions", "refutes", "false_positives",
+                  "true_deaths_declared", "crashes"):
+            assert ph[f] == getattr(pr, f), f
+    # decimated trace: phase totals survive stride-aligned decimation
+    _, tr5 = run_rounds_flight(init_state(_P.n), jax.random.key(7),
+                               _P, 30, plan=cp, record_every=5)
+    rep5 = trace_report(tr5, _P, plan=plan, record_every=5, rounds=30)
+    for ph, ph5 in zip(rep["phases"], rep5["phases"]):
+        assert ph["crashes"] == ph5["crashes"]
+        assert ph["false_positives"] == ph5["false_positives"]
+
+
+def test_publisher_chunked_counters_track_run_totals():
+    """The -gossip-sim loop publishes one trace per chunk; registry
+    counters must end at the whole run's totals (counter columns are
+    per-window deltas, so each publish adds its trace's sum)."""
+    from consul_tpu.utils.telemetry import Metrics
+
+    m = Metrics(prefix="consul")
+    pub = FlightPublisher(metrics=m)
+    state = init_state(_P.n)
+    for c in range(3):
+        state, trace = run_rounds_flight(state, jax.random.key(c),
+                                         _P, 10)
+        pub.publish_trace(trace)
+    snap = m.snapshot()
+    gauges = {g["Name"]: g["Value"] for g in snap["Gauges"]}
+    for name in GAUGE_COLUMNS:
+        assert f"consul.sim.{name}" in gauges
+    assert gauges["consul.sim.live_frac"] == pytest.approx(
+        float(np.mean(np.asarray(state.up))), abs=1e-6)
+    counters = {c["Name"]: c["Count"] for c in snap["Counters"]}
+    # cumulative stats ride the state across chunks, so the final
+    # state's counters ARE the run totals the registry must show
+    assert counters["consul.sim.suspicions"] == pytest.approx(
+        float(state.stats.suspicions))
+    assert counters["consul.sim.crashes"] == pytest.approx(
+        float(state.stats.crashes))
+    # FDReport bridge
+    publish_report(fd_report(state, _P), metrics=m)
+    gauges2 = {g["Name"] for g in m.snapshot()["Gauges"]}
+    assert "consul.sim.fd.false_positives" in gauges2
+    assert "consul.sim.fd.live_fraction" in gauges2
+    # and the prometheus dump carries the sim family
+    text = m.prometheus()
+    assert "# TYPE consul_sim_live_frac gauge" in text
+    assert "consul_sim_suspicions_total" in text
+
+
+def test_prometheus_summary_totals_are_monotonic():
+    """Timers export as summary _sum/_count from lifetime totals, not
+    the sliding sample window — a scrape must never see the count go
+    backwards once the 4096-entry window starts evicting."""
+    from consul_tpu.utils.telemetry import Metrics
+
+    m = Metrics(prefix="consul")
+    for i in range(5000):
+        m.sample("req", 1.0)
+    text = m.prometheus()
+    assert "consul_req_count 5000" in text
+    assert "consul_req_sum 5000.0" in text
+    # the JSON snapshot keeps the windowed percentile view
+    s = m.snapshot()["Samples"][0]
+    assert s["Count"] == 4096
+
+
+def test_default_stride_bounds_trace():
+    rows = n_trace_rows(10_000, DEFAULT_RECORD_EVERY)
+    assert rows == 1000  # 1M×10k-round run: ~68KB trace, one fetch
+
+
+@tpu_only
+def test_pallas_flight_trace_matches_xla():
+    """Engine-level conformance: the Pallas runner's trace must agree
+    with the XLA recorder on every shared column (statistically — the
+    engines use different PRNGs)."""
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.20, tcp_fallback=False,
+                  fail_per_round=0.001, rejoin_per_round=0.01)
+    rounds = 150
+    _, tr_pal = make_run_rounds_pallas(p, rounds, flight_every=1)(
+        init_state(n), jax.random.key(0))
+    _, tr_xla = run_rounds_flight(init_state(n), jax.random.key(1),
+                                  p, rounds)
+    a, b = np.asarray(tr_pal), np.asarray(tr_xla)
+    assert a.shape == b.shape == (rounds, len(FLIGHT_COLUMNS))
+    np.testing.assert_allclose(a[:, COL["t"]], b[:, COL["t"]], rtol=1e-6)
+    for col in ("live_frac", "mean_informed"):
+        np.testing.assert_allclose(a[:, COL[col]], b[:, COL[col]],
+                                   atol=0.02, err_msg=col)
+    for col in ("suspicions", "refutes", "crashes", "rejoins",
+                "true_deaths_declared"):
+        pa, xa = a[:, COL[col]].sum(), b[:, COL[col]].sum()
+        assert xa > 0, col
+        assert 0.8 < pa / xa < 1.25, (col, pa, xa)
