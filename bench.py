@@ -61,13 +61,14 @@ def _arm_watchdog(platform: str, metric: str) -> threading.Timer:
     return t
 
 
-def run_chaos_bench(smoke: bool) -> None:
-    """`bench.py --chaos [--smoke]`: the detection-quality chaos suite —
-    every named fault class (sim/scenarios.chaos_plans) through the
-    batched engine with per-phase stats tracing. Prints ONE JSON object
-    keyed by scenario; recorded alongside BENCH_*.json so the perf
-    trajectory carries a robustness axis."""
-    metric = "chaos_detection_quality" + ("_smoke" if smoke else "")
+def _scenario_bench(metric_base: str, smoke: bool, n: int,
+                    runner) -> None:
+    """Shared harness for the scenario benches (--chaos, --coords):
+    watchdogged backend init, a 10x compile/run deadline (a hung
+    Mosaic compile can't wedge the process while a legitimately slow
+    run is left alone), ONE JSON envelope on stdout. `runner(n)`
+    returns the payload dict merged into the envelope."""
+    metric = metric_base + ("_smoke" if smoke else "")
     want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
     watchdog = _arm_watchdog(want, metric)
     try:
@@ -81,33 +82,57 @@ def run_chaos_bench(smoke: bool) -> None:
         print(_error_line(f"backend init failed: {e}", want, metric))
         sys.exit(1)
     watchdog.cancel()
-    # init proved the device answers; re-arm with a generous budget so
-    # a hung Mosaic compile still can't wedge the process while a
-    # legitimately slow 5-scenario run is left alone
 
     def fire() -> None:
         print(_error_line(
-            f"chaos suite exceeded {_INIT_TIMEOUT_S * 10:.0f}s "
+            f"{metric_base} exceeded {_INIT_TIMEOUT_S * 10:.0f}s "
             "(compile or run hung)", want, metric), flush=True)
         os._exit(1)
 
     watchdog = threading.Timer(_INIT_TIMEOUT_S * 10, fire)
     watchdog.daemon = True
     watchdog.start()
-
-    from consul_tpu.sim.scenarios import run_chaos_suite
-
-    n = 1024 if smoke else 65_536
     t0 = time.perf_counter()
-    suite = run_chaos_suite(n=n)
+    payload = runner(n)
     watchdog.cancel()
     print(json.dumps({
         "metric": metric,
         "platform": jax.default_backend(),
         "n": n,
         "wall_s": round(time.perf_counter() - t0, 2),
-        "scenarios": suite,
+        **payload,
     }))
+
+
+def run_chaos_bench(smoke: bool) -> None:
+    """`bench.py --chaos [--smoke]`: the detection-quality chaos suite —
+    every named fault class (sim/scenarios.chaos_plans) through the
+    batched engine with per-phase stats tracing. Prints ONE JSON object
+    keyed by scenario; recorded alongside BENCH_*.json so the perf
+    trajectory carries a robustness axis."""
+    def runner(n):
+        from consul_tpu.sim.scenarios import run_chaos_suite
+
+        return {"scenarios": run_chaos_suite(n=n)}
+
+    _scenario_bench("chaos_detection_quality", smoke,
+                    1024 if smoke else 65_536, runner)
+
+
+def run_coords_bench(smoke: bool) -> None:
+    """`bench.py --coords [--smoke]`: the network-coordinate scenario
+    (sim/scenarios.run_coords) — cold-start Vivaldi convergence through
+    a partition/heal plan, RTT-aware probe deadlines on. Prints ONE
+    JSON object whose `scenarios.coords.flight` carries the per-phase
+    median-relative-error curves; recorded as COORDS_r*.json."""
+    def runner(n):
+        from consul_tpu.sim.scenarios import run_coords
+
+        report, _ = run_coords(n=n)
+        return {"scenarios": {"coords": report}}
+
+    _scenario_bench("coords_convergence", smoke,
+                    4096 if smoke else 65_536, runner)
 
 
 def main() -> None:
@@ -123,6 +148,12 @@ def main() -> None:
             print("--profile applies to the throughput bench only; "
                   "ignored with --chaos", file=sys.stderr)
         run_chaos_bench(smoke)
+        return
+    if "--coords" in sys.argv[1:]:
+        if profile:
+            print("--profile applies to the throughput bench only; "
+                  "ignored with --coords", file=sys.stderr)
+        run_coords_bench(smoke)
         return
     metric = ("gossip_rounds_per_sec_smoke" if smoke
               else "gossip_rounds_per_sec_1M_nodes")

@@ -256,6 +256,13 @@ class HTTPApi:
             except json.JSONDecodeError as e:
                 raise HTTPError(400, f"invalid JSON body: {e}") from e
 
+        def near() -> str:
+            """?near= value with `_agent` resolved to the serving
+            agent's node name (catalog_endpoint.go parseSource: the
+            magic `_agent` source means "sort relative to me")."""
+            v = q.get("near", "")
+            return a.name if v == "_agent" else v
+
         def filtered(rows: Any) -> Any:
             """?filter= go-bexpr evaluation over list results (and the
             agent's id->record maps), http.go parseFilter + the ~20
@@ -477,7 +484,10 @@ class HTTPApi:
         if path == "/v1/catalog/datacenters":
             return rpc("Catalog.ListDatacenters", {}), None
         if path == "/v1/catalog/nodes":
-            res = rpc("Catalog.ListNodes", blocking_args())
+            args = blocking_args()
+            if "near" in q:
+                args["Near"] = near()
+            res = rpc("Catalog.ListNodes", args)
             return filtered(res["Nodes"]), res["Index"]
         if path == "/v1/catalog/services":
             res = rpc("Catalog.ListServices", blocking_args())
@@ -488,7 +498,7 @@ class HTTPApi:
             if "tag" in q:
                 args["ServiceTag"] = q["tag"]
             if "near" in q:
-                args["Near"] = q["near"]
+                args["Near"] = near()
             res = rpc("Catalog.ServiceNodes", args)
             return filtered(res["ServiceNodes"]), res["Index"]
         if (m := re.match(r"^/v1/catalog/node/(.+)$", path)):
@@ -544,7 +554,7 @@ class HTTPApi:
                 "ServiceName": urllib.parse.unquote(m.group(1)),
                 "Connect": True,
                 "ServiceTag": q.get("tag", ""),
-                "Near": q.get("near", ""),
+                "Near": near(),
                 "MustBePassing": "passing" in q}))
             return filtered(res["Nodes"]), res.get("Index")
         if (m := re.match(r"^/v1/health/ingress/(.+)$", path)):
@@ -577,7 +587,7 @@ class HTTPApi:
             if "passing" in q:
                 args["MustBePassing"] = True
             if "near" in q:
-                args["Near"] = q["near"]
+                args["Near"] = near()
             if "peer" in q:
                 args["Peer"] = q["peer"]
                 res = rpc("Health.ServiceNodesPeer", args)

@@ -298,6 +298,23 @@ class ConsulClient:
     def coordinate_datacenters(self) -> list[dict]:
         return self.get("/v1/coordinate/datacenters")
 
+    def rtt(self, a: str, b: Optional[str] = None) -> Optional[float]:
+        """Estimated RTT in seconds between two nodes, from the stored
+        Vivaldi coordinates (`consul rtt` / lib/rtt.go semantics; `b`
+        defaults to the serving agent's node). None if either node has
+        no coordinate yet — including `-gossip-sim`-published virtual
+        members, which carry coordinates but no serf presence."""
+        from consul_tpu.gossip.coordinate import distance
+        from consul_tpu.types import Coordinate
+
+        if b is None:
+            b = self.agent_self()["Config"]["NodeName"]
+        coords = {c["Node"]: c["Coord"] for c in self.coordinate_nodes()}
+        ca, cb = coords.get(a), coords.get(b)
+        if ca is None or cb is None:
+            return None
+        return distance(Coordinate.from_dict(ca), Coordinate.from_dict(cb))
+
     # ------------------------------------------------------ prepared queries
 
     def query_create(self, body: dict) -> dict:

@@ -58,6 +58,8 @@ def cmd_agent(args) -> int:
         overrides["gossip_sim_nodes"] = args.gossip_sim_nodes
     if getattr(args, "gossip_sim_chaos", None):
         overrides["gossip_sim_chaos"] = args.gossip_sim_chaos
+    if getattr(args, "gossip_sim_coords", False):
+        overrides["gossip_sim_coords"] = True
     if any(x is not None for x in (args.http_port, args.dns_port,
                                    args.serf_port, args.server_port,
                                    args.serf_wan_port)):
@@ -235,6 +237,26 @@ def _run_gossip_sim(cfg) -> int:
     n = cfg.gossip_sim_nodes
     chaos = getattr(cfg, "gossip_sim_chaos", "") or ""
     try:
+        if getattr(cfg, "gossip_sim_coords", False):
+            from consul_tpu.sim.scenarios import run_coords
+
+            print(f"==> gossip-sim={platform} coords: {n} virtual "
+                  f"members on {jax.devices()[0].platform}")
+            t0 = time.perf_counter()
+            rep, coords = run_coords(n=n)
+            watchdog.cancel()
+            rep["wall_s"] = round(time.perf_counter() - t0, 2)
+            # trim the per-round curves from the CLI report (bench.py
+            # --coords is the recorded-curve surface); keep the
+            # per-phase summaries
+            fl = rep.pop("flight", None)
+            if fl:
+                rep["phases"] = [
+                    {k: v for k, v in ph.items() if k != "curve"}
+                    for ph in fl["phases"]]
+            _publish_sim_coords(cfg, coords, rep)
+            print(json.dumps(rep, indent=2))
+            return 0
         if chaos:
             from consul_tpu.sim.scenarios import chaos_plans, run_chaos
 
@@ -279,6 +301,52 @@ def _run_gossip_sim(cfg) -> int:
     print(json.dumps({"rounds_per_sec": round(rounds / dt, 1),
                       **rep.to_dict()}, indent=2))
     return 0
+
+
+def _publish_sim_coords(cfg, coords, rep: dict) -> None:
+    """Publish the first K sim coordinates into a freshly-started dev
+    agent through the REAL path — /v1/coordinate/update PUTs, raft
+    apply, coordinate batch in the state store — then prove
+    /v1/coordinate/nodes and the api client's rtt helper serve them.
+    Outcome (or the failure) is folded into `rep`; the sim report
+    itself is never lost to a publish problem."""
+    import time as _t
+
+    from consul_tpu.agent import Agent
+    from consul_tpu.api import ConsulClient
+    from consul_tpu.sim.coords import coordinate_updates
+
+    k = min(int(rep.get("n", 0)), 128)
+    try:
+        a = Agent(cfg)
+    except Exception as e:  # noqa: BLE001
+        rep["coords_publish_error"] = f"dev agent unavailable: {e}"
+        return
+    try:
+        a.start(serve_dns=False)
+        deadline = _t.time() + 30
+        while not (a.server is not None and a.server.is_leader()):
+            if _t.time() > deadline:
+                raise RuntimeError("dev agent never won leadership")
+            _t.sleep(0.1)
+        c = ConsulClient(a.http.addr)
+        for u in coordinate_updates(coords, count=k):
+            c.put("/v1/coordinate/update", body=u)
+        # coordinate updates are batched asynchronously server-side
+        deadline = _t.time() + 30
+        while sum(1 for x in c.coordinate_nodes()
+                  if x["Node"].startswith("sim-")) < k:
+            if _t.time() > deadline:
+                raise RuntimeError("published coordinates never "
+                                   "appeared in /v1/coordinate/nodes")
+            _t.sleep(0.1)
+        rep["coords_published"] = k
+        rep["coordinate_nodes_served"] = len(c.coordinate_nodes())
+        rep["rtt_sim_0_1_s"] = c.rtt("sim-0", "sim-1")
+    except Exception as e:  # noqa: BLE001
+        rep["coords_publish_error"] = str(e)
+    finally:
+        a.shutdown()
 
 
 def cmd_members(args) -> int:
@@ -1731,6 +1799,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run a named chaos FaultPlan (e.g. "
                          "asym_partition, per_node_loss, gc_pause, "
                          "flapping, churn_burst)")
+    ag.add_argument("-gossip-sim-coords", action="store_true",
+                    default=False, dest="gossip_sim_coords",
+                    help="run the network-coordinate scenario and "
+                         "publish sim Vivaldi coordinates into the dev "
+                         "agent's store (/v1/coordinate/nodes)")
     ag.set_defaults(fn=cmd_agent)
 
     mem = sub.add_parser("members")

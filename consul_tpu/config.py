@@ -279,6 +279,10 @@ class RuntimeConfig:
     # named chaos FaultPlan to run instead of the plain benchmark
     # (sim/scenarios.chaos_plans: asym_partition, per_node_loss, ...)
     gossip_sim_chaos: str = ""
+    # run the network-coordinate scenario (sim/scenarios.run_coords)
+    # and publish the virtual members' Vivaldi coordinates into a dev
+    # agent's catalog store (served by /v1/coordinate/nodes)
+    gossip_sim_coords: bool = False
 
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log_level: str = "INFO"

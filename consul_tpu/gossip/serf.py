@@ -324,6 +324,12 @@ class Serf(MemberlistDelegate):
             return None
         return distance(ca, cb)
 
+    def estimate_rtt(self, node: str) -> Optional[float]:
+        """Memberlist delegate hook: coordinate-estimated RTT to `node`
+        (None until an ack has carried its coordinate) — feeds the
+        RTT-aware probe deadline (swim.RTT_TIMEOUT_MULT)."""
+        return self.rtt(node)
+
     # ----------------------------------------------------- delegate callbacks
 
     def notify_merge(self, peers) -> Optional[str]:

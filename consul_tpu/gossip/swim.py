@@ -41,6 +41,19 @@ PROTOCOL_MIN = 1
 PROTOCOL_CUR = 2
 PROTOCOL_MAX = 2
 
+#: RTT-aware probe deadline: when the delegate can estimate the RTT to
+#: the target (serf's Vivaldi coordinates), the ack deadline becomes
+#: max(probe_timeout, min(RTT_TIMEOUT_MULT·estimate, probe_interval))
+#: ·(awareness+1) — the awareness scaling memberlist applies, with an
+#: RTT-aware base instead of one flat constant, so a far (cross-DC)
+#: target gets deadline headroom while a near target keeps the tight
+#: floor. The RTT term is CAPPED at probe_interval: a corrupted or
+#: inflated coordinate must never push the direct-probe phase past the
+#: protocol period and starve indirect probing/suspicion for that
+#: target. The batched sim mirrors this constant as
+#: SimParams.coord_timeout_mult (same cap).
+RTT_TIMEOUT_MULT = 3.0
+
 
 @dataclass
 class NodeState:
@@ -83,6 +96,12 @@ class MemberlistDelegate:
 
     def notify_ack(self, node: str, rtt: float,
                    payload: dict[str, Any]) -> None: ...
+
+    def estimate_rtt(self, node: str) -> Optional[float]:
+        """Estimated RTT seconds to `node`, or None when unknown (serf
+        answers from its Vivaldi coordinates). Drives the RTT-aware
+        probe deadline — see RTT_TIMEOUT_MULT."""
+        return None
 
 
 class _Suspicion:
@@ -381,8 +400,17 @@ class Memberlist:
             self.delegate.notify_ack(target.name, self._now() - sent_at,
                                      payload)
 
-        # Lifeguard: ack deadline scaled by local health (state.go probeNode)
+        # Lifeguard: ack deadline scaled by local health (state.go
+        # probeNode), floored at the configured timeout and widened for
+        # far targets when the delegate knows the coordinate-estimated
+        # RTT — a cross-DC probe must not eat the suspicion machinery's
+        # budget just for being far away
         timeout = cfg.scaled_probe_timeout(self.awareness)
+        est = self.delegate.estimate_rtt(target.name)
+        if est is not None and est > 0:
+            timeout = max(timeout,
+                          min(est * RTT_TIMEOUT_MULT, cfg.probe_interval)
+                          * (self.awareness + 1))
 
         def on_timeout() -> None:
             if acked["ok"]:

@@ -123,10 +123,13 @@ def register_endpoints(srv) -> None:
 
     def catalog_list_nodes(args):
         az = authz(args)
+        near = args.get("Near", "")
         return srv.blocking_query(args, ("nodes",), lambda: {
-            "Nodes": [n.to_dict()
-                      for n in state.nodes(args.get("Partition"))
-                      if az.node_read(n.node)]})
+            "Nodes": _near_sort([
+                n.to_dict()
+                for n in state.nodes(args.get("Partition"))
+                if az.node_read(n.node)],
+                near, lambda e: e["Node"])})
 
     def catalog_list_services(args):
         az = authz(args)

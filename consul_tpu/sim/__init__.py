@@ -53,8 +53,14 @@ ENVELOPE — what this model can and cannot answer:
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import SimState, init_state, ALIVE, SUSPECT, DEAD, LEFT
 from consul_tpu.sim.round import (gossip_round, run_rounds,
+                                  run_rounds_coords,
                                   run_rounds_stats, run_rounds_flight,
                                   make_run_rounds, make_run_rounds_flight)
+from consul_tpu.sim.topology import (Topology, TopologyParams,
+                                     make_topology, true_rtt, sample_rtt)
+from consul_tpu.sim.coords import (CoordState, init_coords, vivaldi_step,
+                                   estimate_rtt, nearest_k,
+                                   coordinate_updates)
 from consul_tpu.sim.mesh import (make_sharded_run, make_mesh,
                                  make_multidc_run, make_segmented_run)
 from consul_tpu.sim.views import (ViewState, init_views, views_round,
@@ -64,8 +70,14 @@ from consul_tpu.sim.views import (ViewState, init_views, views_round,
 
 __all__ = [
     "SimParams", "SimState", "init_state", "gossip_round", "run_rounds",
+    "run_rounds_coords",
     "run_rounds_stats", "run_rounds_flight", "make_run_rounds",
-    "make_run_rounds_flight", "make_sharded_run", "make_mesh",
+    "make_run_rounds_flight",
+    "Topology", "TopologyParams", "make_topology", "true_rtt",
+    "sample_rtt",
+    "CoordState", "init_coords", "vivaldi_step", "estimate_rtt",
+    "nearest_k", "coordinate_updates",
+    "make_sharded_run", "make_mesh",
     "make_multidc_run", "make_segmented_run",
     "ViewState", "init_views", "views_round", "run_views",
     "view_metrics", "make_views_mesh", "make_sharded_views_round",

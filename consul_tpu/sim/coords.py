@@ -1,0 +1,241 @@
+"""Batched Vivaldi network coordinates — the TPU-native RTT estimator.
+
+The scalar reference client (gossip/coordinate.py, mirroring
+serf/coordinate consumed at internal/gossip/librtt/rtt.go) maintains ONE
+node's coordinate from its probe RTTs. This module is the same
+algorithm, constant-for-constant, over the whole population at once:
+
+  vec        [N, DIMS] f32 — Vivaldi position (distances in seconds)
+  error      [N] f32       — confidence estimate (VIVALDI_ERROR_MAX cap)
+  height     [N] f32       — access-link term (HEIGHT_MIN floor)
+  adjustment [N] f32       — smoothed residual term, the mean of an
+  adj_samples[N, W] f32      on-device ring buffer of the last W
+  adj_idx    [N] int32       update residuals (ADJUSTMENT_WINDOW),
+                             exactly the scalar client's ring
+
+`vivaldi_step` is the spring-relaxation update vectorized over probe
+pairs: node i[k] observed rtt[k] seconds to node j[k] and relaxes
+toward j's coordinate. All constants are IMPORTED from
+gossip/coordinate.py — one source, so the scalar client and the batched
+engine cannot drift (parity pinned to 1e-5 in tests/test_coords.py,
+including the coincident-point random-direction branch, which here is
+deterministic under the step's PRNG key).
+
+Everything is elementwise math plus [N]-sized gathers of the partner
+rows — no N×N structure — so the update rides the jitted round scans of
+both sim engines (sim/round.py threads it through `_round_core`;
+sim/pallas_round.py applies it over the kernel's outputs).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.gossip.coordinate import (ADJUSTMENT_WINDOW, DIMENSION,
+                                          GRAVITY_RHO, HEIGHT_MIN,
+                                          VIVALDI_CC, VIVALDI_CE,
+                                          VIVALDI_ERROR_MAX, ZERO_THRESHOLD)
+from consul_tpu.sim.topology import Topology, true_rtt
+
+
+class CoordState(NamedTuple):
+    """Population coordinate tensors (a jit-traceable pytree)."""
+
+    vec: jnp.ndarray          # [N, DIMS] f32
+    error: jnp.ndarray        # [N] f32
+    height: jnp.ndarray       # [N] f32
+    adjustment: jnp.ndarray   # [N] f32 — cached smoothed adjustment
+    adj_samples: jnp.ndarray  # [N, ADJUSTMENT_WINDOW] f32 ring buffer
+    adj_idx: jnp.ndarray      # [N] int32 ring cursor
+
+
+def init_coords(n: int, dims: int = DIMENSION) -> CoordState:
+    """Cold start: everyone at the origin with max error — exactly the
+    scalar client's fresh Coordinate()."""
+    return CoordState(
+        vec=jnp.zeros((n, dims), jnp.float32),
+        error=jnp.full((n,), VIVALDI_ERROR_MAX, jnp.float32),
+        height=jnp.full((n,), HEIGHT_MIN, jnp.float32),
+        adjustment=jnp.zeros((n,), jnp.float32),
+        adj_samples=jnp.zeros((n, ADJUSTMENT_WINDOW), jnp.float32),
+        adj_idx=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def _row_distance(vec_a, h_a, vec_b, h_b) -> jnp.ndarray:
+    """raw_distance over row batches: vec norm + both heights."""
+    d = vec_a - vec_b
+    return jnp.sqrt(jnp.sum(d * d, axis=-1)) + h_a + h_b
+
+
+def estimate_rtt(coords: CoordState, i, j) -> jnp.ndarray:
+    """RTT estimate (s) for index batches i, j — librtt.ComputeDistance
+    semantics: raw distance plus both adjustment terms unless that goes
+    non-positive (matches gossip.coordinate.distance)."""
+    dist = _row_distance(coords.vec[i], coords.height[i],
+                         coords.vec[j], coords.height[j])
+    adjusted = dist + coords.adjustment[i] + coords.adjustment[j]
+    return jnp.where(adjusted > 0, adjusted, dist)
+
+
+def nearest_k(coords: CoordState, q, k: int
+              ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The k nodes with the lowest estimated RTT to node `q` (self
+    excluded) — the `?near=` / prepared-query top-k as one device op.
+    Returns (indices [k], rtt estimates [k]), ascending."""
+    n = coords.vec.shape[0]
+    q = jnp.asarray(q, jnp.int32)
+    d = estimate_rtt(coords, q, jnp.arange(n, dtype=jnp.int32))
+    d = jnp.where(jnp.arange(n) == q, jnp.inf, d)
+    neg, idx = jax.lax.top_k(-d, k)
+    return idx, -neg
+
+
+def vivaldi_step(coords: CoordState, i, j, rtt_s, key: jax.Array,
+                 upd: Optional[jnp.ndarray] = None) -> CoordState:
+    """One batched Vivaldi update: node i[k] relaxes toward node j[k]
+    at measured rtt_s[k] seconds.
+
+    `i` is an index batch with UNIQUE entries (each node updates at
+    most once per call — the scans pass i = arange(N)); `i=None` means
+    all rows in order, skipping the scatter entirely. Rows with
+    `upd[k]` false or rtt_s[k] <= 0 keep their coordinate unchanged
+    (the scalar client's rtt<=0 early return). The coincident-point
+    branch draws its random direction from `key` — deterministic for a
+    fixed key, unlike the scalar client's stateful rng."""
+    full = i is None
+    idx = jnp.arange(coords.vec.shape[0], dtype=jnp.int32) if full \
+        else jnp.asarray(i, jnp.int32)
+    vec_i, h_i, e_i = coords.vec[idx], coords.height[idx], coords.error[idx]
+    vec_j, h_j, e_j = coords.vec[j], coords.height[j], coords.error[j]
+    samples_i = coords.adj_samples[idx]
+    adj_idx_i = coords.adj_idx[idx]
+
+    rtt = jnp.asarray(rtt_s, jnp.float32)
+    live = rtt > 0
+    upd = live if upd is None else (jnp.asarray(upd, bool) & live)
+    rtt_safe = jnp.maximum(rtt, 1e-12)
+
+    diff = vec_i - vec_j
+    mag = jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    dist = mag + h_i + h_j
+    err = jnp.maximum(e_i + e_j, ZERO_THRESHOLD)
+    weight = e_i / err
+    rel_err = jnp.abs(dist - rtt_safe) / rtt_safe
+    new_error = jnp.minimum(
+        rel_err * VIVALDI_CE * weight + e_i * (1.0 - VIVALDI_CE * weight),
+        VIVALDI_ERROR_MAX)
+    force = VIVALDI_CC * weight * (rtt_safe - dist)
+
+    # unit vector toward/away from j; coincident points get a random
+    # direction (CoordinateClient._unit_vector), drawn from `key`
+    coincident = mag <= ZERO_THRESHOLD
+    safe_mag = jnp.where(coincident, 1.0, mag)
+    rv = jax.random.uniform(key, vec_i.shape, jnp.float32) - 0.5
+    rmag = jnp.sqrt(jnp.sum(rv * rv, axis=-1))
+    rv = rv / jnp.where(rmag > 0, rmag, 1.0)[..., None]
+    unit = jnp.where(coincident[..., None], rv, diff / safe_mag[..., None])
+
+    new_vec = vec_i + unit * force[..., None]
+    new_height = jnp.where(
+        coincident, h_i,
+        jnp.maximum(HEIGHT_MIN, (h_i + h_j) * force / safe_mag + h_i))
+    # gravity toward the origin keeps the cloud from drifting
+    new_vec = new_vec - (new_vec / GRAVITY_RHO) ** 3
+
+    # adjustment ring: residual against the POST-move coordinate
+    sample = rtt_safe - _row_distance(new_vec, new_height, vec_j, h_j)
+    lane = jnp.arange(ADJUSTMENT_WINDOW, dtype=jnp.int32)[None, :]
+    write = upd[..., None] & (lane == adj_idx_i[..., None])
+    new_samples = jnp.where(write, sample[..., None], samples_i)
+    new_adj = jnp.sum(new_samples, axis=-1) / (2.0 * ADJUSTMENT_WINDOW)
+    new_adj_idx = jnp.where(upd, (adj_idx_i + 1) % ADJUSTMENT_WINDOW,
+                            adj_idx_i)
+
+    def merge(new, old):
+        mask = upd if new.ndim == 1 else upd[..., None]
+        return jnp.where(mask, new, old)
+
+    vec = merge(new_vec, vec_i)
+    error = merge(new_error, e_i)
+    height = merge(new_height, h_i)
+    if full:
+        return CoordState(vec=vec, error=error, height=height,
+                          adjustment=new_adj, adj_samples=new_samples,
+                          adj_idx=new_adj_idx)
+    return CoordState(
+        vec=coords.vec.at[idx].set(vec),
+        error=coords.error.at[idx].set(error),
+        height=coords.height.at[idx].set(height),
+        adjustment=coords.adjustment.at[idx].set(new_adj),
+        adj_samples=coords.adj_samples.at[idx].set(new_samples),
+        adj_idx=coords.adj_idx.at[idx].set(new_adj_idx),
+    )
+
+
+#: flight-recorder coord column values, in sim/flight.COORD_COLUMNS order
+N_COORD_METRICS = 3
+
+
+class CoordRoundAux(NamedTuple):
+    """Cheap per-round byproducts of one coords round — the raw
+    material for `coord_metrics`, so the EXPENSIVE part (two
+    full-population percentile sorts) can run only on flight-recorded
+    rounds, inside the recorder's lax.cond branch."""
+
+    pair_j: jnp.ndarray  # [N] int32 — this round's probe targets
+    drift: jnp.ndarray   # 0-d f32 — mean position moved this round (s)
+
+
+def round_drift(prev: CoordState, cur: CoordState) -> jnp.ndarray:
+    """Mean Vivaldi position moved between two states (seconds) —
+    elementwise, cheap enough to compute every round."""
+    return jnp.mean(jnp.sqrt(jnp.sum((cur.vec - prev.vec) ** 2,
+                                     axis=-1)))
+
+
+def coord_metrics(cur: CoordState, topo: Topology,
+                  aux: CoordRoundAux) -> jnp.ndarray:
+    """[3] f32 on-device quality row for one round's probe pairs
+    (i = arange(N), targets aux.pair_j): median and p99 RELATIVE
+    RTT-estimate error against the no-jitter ground truth, and the
+    round's mean coordinate drift. The percentiles sort the whole
+    population — call this only where the row is actually consumed
+    (the flight recorder invokes it inside its decimation cond)."""
+    n = cur.vec.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)
+    est = estimate_rtt(cur, i, aux.pair_j)
+    truth = true_rtt(topo, i, aux.pair_j)
+    rel = jnp.abs(est - truth) / jnp.maximum(truth, 1e-9)
+    return jnp.stack([jnp.percentile(rel, 50.0),
+                      jnp.percentile(rel, 99.0),
+                      aux.drift]).astype(jnp.float32)
+
+
+# ---------------------------------------------------------- host bridge
+
+
+def coordinate_updates(coords: CoordState, count: Optional[int] = None,
+                       names: Optional[Sequence[str]] = None,
+                       prefix: str = "sim-") -> list[dict]:
+    """Coordinate.Update-shaped dicts for the first `count` rows (or
+    one per `names` entry) — the bridge that lets `-gossip-sim` publish
+    sim coordinates into the catalog store so `/v1/coordinate/nodes`
+    and the api client's rtt helper serve them."""
+    vec = np.asarray(jax.device_get(coords.vec), np.float64)
+    err = np.asarray(jax.device_get(coords.error), np.float64)
+    adj = np.asarray(jax.device_get(coords.adjustment), np.float64)
+    hgt = np.asarray(jax.device_get(coords.height), np.float64)
+    if names is None:
+        k = vec.shape[0] if count is None else min(count, vec.shape[0])
+        names = [f"{prefix}{i}" for i in range(k)]
+    return [{"Node": name,
+             "Coord": {"Vec": [float(x) for x in vec[i]],
+                       "Error": float(err[i]),
+                       "Adjustment": float(adj[i]),
+                       "Height": float(hgt[i])}}
+            for i, name in enumerate(names)]

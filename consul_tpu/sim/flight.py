@@ -13,7 +13,7 @@ stack through their existing ``lax.scan``:
     — so within a decimation window the LAST round's write wins and the
     recorded row is the state at the window's end;
   * the buffer is bounded by the ``record_every`` stride (a 1M-node ×
-    10k-round run at stride 10 is a 1000×17 f32 array, ~68KB) and is
+    10k-round run at stride 10 is a 1000×20 f32 array, ~80KB) and is
     fetched with a SINGLE ``device_get`` after the run — no per-round
     host syncs, which is what keeps recorder overhead in the noise;
   * counter columns store the SimStats DELTA over the row's decimation
@@ -67,8 +67,17 @@ GAUGE_COLUMNS = (
     "fault_phase",        # active FaultPlan phase index (-1: no plan)
 )
 
-#: full row layout: gauges then per-window SimStats deltas
-FLIGHT_COLUMNS = GAUGE_COLUMNS + STATS_FIELDS
+#: network-coordinate quality columns (sim/coords.coord_metrics order).
+#: Gauge semantics: the recorded round's value. Zero-filled when the
+#: run carries no CoordState, so the row layout never changes shape.
+COORD_COLUMNS = (
+    "rtt_err_med",   # median relative RTT-estimate error vs ground truth
+    "rtt_err_p99",   # p99 relative RTT-estimate error
+    "coord_drift",   # mean Vivaldi position moved this round (s)
+)
+
+#: full row layout: gauges, per-window SimStats deltas, coord quality
+FLIGHT_COLUMNS = GAUGE_COLUMNS + STATS_FIELDS + COORD_COLUMNS
 N_COLS = len(FLIGHT_COLUMNS)
 COL = {name: i for i, name in enumerate(FLIGHT_COLUMNS)}
 
@@ -87,8 +96,13 @@ def empty_trace(rounds: int, record_every: int) -> jnp.ndarray:
 
 
 def flight_row(*, up, status, informed, local_health, incarnation, t,
-               stats_delta: SimStats, phase) -> jnp.ndarray:
+               stats_delta: SimStats, phase,
+               coord_row: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """One [N_COLS] f32 trace row from post-round state (on-device).
+
+    `coord_row` is the round's [len(COORD_COLUMNS)] coordinate-quality
+    vector (sim/coords.coord_metrics) or None for coord-less runs
+    (zero-filled — layout invariant either way).
 
     `stats_delta` is the SimStats change over this row's decimation
     window (current minus last-recorded cumulative; both engines keep
@@ -114,7 +128,10 @@ def flight_row(*, up, status, informed, local_health, incarnation, t,
         jnp.sum(incarnation.astype(jnp.float32)),
         jnp.asarray(phase, jnp.float32),
     ])
-    return jnp.concatenate([gauges, stats_vector(stats_delta)])
+    if coord_row is None:
+        coord_row = jnp.zeros((len(COORD_COLUMNS),), jnp.float32)
+    return jnp.concatenate([gauges, stats_vector(stats_delta),
+                            jnp.asarray(coord_row, jnp.float32)])
 
 
 def record_row(buf: jnp.ndarray, row: jnp.ndarray, i,
@@ -201,6 +218,13 @@ class FlightPublisher:
             total = float(tr[:, COL[f]].sum())
             if total:
                 self.metrics.incr(f"{self.prefix}.{f}", total)
+        # coord-quality gauges only for coord-carrying traces (the
+        # columns are zero-filled otherwise — a sim.rtt_err_med of 0.0
+        # would read as a perfectly converged estimator, not "off")
+        if tr[:, [COL[c] for c in COORD_COLUMNS]].any():
+            for name in COORD_COLUMNS:
+                self.metrics.gauge(f"{self.prefix}.{name}",
+                                   float(tr[-1, COL[name]]))
 
 
 def publish_report(report, metrics=None, prefix: str = "sim") -> None:

@@ -200,6 +200,10 @@ def trace_report(trace, p: SimParams, plan=None, record_every: int = 1,
                                for v in cols["wrong_frac"][sel]],
                 "false_positives": [int(v)
                                     for v in cols["false_positives"][sel]],
+                # coordinate convergence (zeros on coord-less runs):
+                # THE curve bench.py --coords records
+                "rtt_err_med": [round(float(v), 6)
+                                for v in cols["rtt_err_med"][sel]],
             },
         })
     return {"record_every": int(record_every), "rows": int(n_rows),

@@ -456,7 +456,8 @@ def _unpack(args, state: SimState, n_arrays: int, t_final, rounds,
 def make_run_rounds_pallas(p: SimParams, rounds: int,
                            interpret: bool = False,
                            plan: Optional[CompiledFaultPlan] = None,
-                           flight_every: Optional[int] = None):
+                           flight_every: Optional[int] = None,
+                           coords: bool = False):
     """Compiled hot loop using the fused Pallas round kernel.
 
     Covers the full protocol model including churn, slow-node
@@ -476,18 +477,43 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     engines use — the kernel itself is untouched) and the runner
     returns (state, trace) instead of state. Counter columns ride the
     kernel's existing stat partial-sum lanes, so collect_stats must be
-    on."""
+    on.
+
+    `coords=True` threads the Vivaldi RTT subsystem (sim/coords.py /
+    sim/topology.py) through the scan: the runner takes a
+    (CoordState, Topology) pair after its other arguments and returns
+    the updated CoordState alongside the state (and before the flight
+    trace). The coordinate update is plain jnp over the KERNEL'S OUTPUT
+    blocks — the Mosaic kernel is untouched; the one modeling
+    difference vs the XLA path is the update gate: the kernel's
+    per-node ack draw is internal, so probers here ack with the
+    round's POPULATION ack rate (mean-field gate; statistical
+    coordinate-trace conformance asserted in tests/test_coords.py).
+    p.coords_timeout is refused — the RTT-deadline feedback needs the
+    per-pair gate inside the round body, which only the XLA engines
+    have."""
     fault = plan is not None
+    with_coords = bool(coords)
     if flight_every is not None and not p.collect_stats:
         raise ValueError(
             "flight recording rides the kernel's stats lanes; build "
             "SimParams with collect_stats=True")
+    if with_coords and p.coords_timeout:
+        raise ValueError(
+            "coords_timeout gates each probe's ack on its pair's RTT "
+            "inside the round body — the Pallas kernel's ack draw is "
+            "internal, so this combination would silently diverge; use "
+            "the XLA engines (run_rounds_coords/run_rounds_flight) for "
+            "RTT-aware timeout studies")
     one_round, rows, n_arrays = _build_round(p, p.n, interpret, fault)
 
     @jax.jit
     def _run(state: SimState, key: jax.Array,
-             cp: Optional[CompiledFaultPlan] = None):
+             cp: Optional[CompiledFaultPlan] = None,
+             coo=None, topo=None):
+        from consul_tpu.sim import coords as coords_mod
         from consul_tpu.sim import flight
+        from consul_tpu.sim import topology as topo_mod
 
         scalars = init_scalars(state, p)
         # clamp the tiny epsilons the XLA path uses
@@ -508,8 +534,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                            to2d(state.slow.astype(jnp.int8)))
 
         def body(carry, x):
-            args, scalars, t, acc, rec = carry
-            seed, r = x
+            args, scalars, t, acc, rec, coo_c = carry
+            seed, r, ck = x
             if fault:
                 fx = fault_frame(cp, r)
                 fins = (to2d(fx.psend), to2d(fx.precv),
@@ -531,6 +557,34 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             acc_i = acc[0] + stat_sums.at[4].set(0.0).astype(jnp.int32)
             acc_lat = acc[1] + stat_sums[4]
             t2 = t + p.probe_interval
+            aux = None
+            if with_coords:
+                # Vivaldi relaxation over the kernel's output blocks:
+                # explicit pairs + ground-truth RTT, prober acks drawn
+                # at the round's population rate from the SAME stale
+                # scalars the kernel consumed (its per-node draw is
+                # internal to Mosaic)
+                k_pair, k_jit, k_dir, k_ack = jax.random.split(ck, 4)
+                i_all = jnp.arange(p.n, dtype=jnp.int32)
+                pair_j = topo_mod.sample_pairs(p.n, k_pair)
+                rtt_obs = topo_mod.sample_rtt(topo, i_all, pair_j, k_jit)
+                up_flat = args2[0].reshape(-1).astype(jnp.int32) != 0
+                n_live, n_elig = scalars[0], scalars[1]
+                n_up_elig, n_slow = scalars[2], scalars[3]
+                sbar = n_slow / jnp.maximum(n_up_elig, 1e-9)
+                e_f = scalars[4] / jnp.maximum(n_live, 1e-9)
+                e_s = scalars[5] / jnp.maximum(n_live, 1e-9)
+                p_ack = (n_up_elig / n_elig) * (
+                    1.0 - ((1.0 - sbar) * e_f + sbar * e_s))
+                acked = up_flat & (
+                    jax.random.uniform(k_ack, (p.n,)) < p_ack)
+                upd = acked & up_flat[pair_j]
+                coo2 = coords_mod.vivaldi_step(coo_c, None, pair_j,
+                                               rtt_obs, k_dir, upd)
+                aux = coords_mod.CoordRoundAux(
+                    pair_j=pair_j,
+                    drift=coords_mod.round_drift(coo_c, coo2))
+                coo_c = coo2
             if flight_every is not None:
                 ph = active_phase(cp, r) if fault else jnp.int32(-1)
 
@@ -548,25 +602,36 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                         true_deaths_declared=di[3],
                         detect_latency_sum=acc_lat - pl,
                         crashes=di[5], rejoins=di[6], leaves=di[7])
+                    # coord quality row computed INSIDE the decimation
+                    # cond (matching the XLA recorder): skipped rounds
+                    # skip the percentile sorts
+                    crow = coords_mod.coord_metrics(coo_c, topo, aux) \
+                        if with_coords else None
                     row = flight.flight_row(
                         up=args2[0], status=args2[1],
                         informed=args2[3], local_health=args2[7],
                         incarnation=args2[2], t=t2,
-                        stats_delta=delta, phase=ph)
+                        stats_delta=delta, phase=ph, coord_row=crow)
                     return (flight.record_row(
                         buf_c, row, r - state.round_idx, flight_every),
                         (acc_i, acc_lat))
 
                 rec = flight.maybe_record(rec, r - state.round_idx,
                                           rounds, flight_every, rec_fn)
-            return (args2, partials, t2, (acc_i, acc_lat), rec), None
+            return (args2, partials, t2, (acc_i, acc_lat), rec,
+                    coo_c), None
 
         acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
         rec0 = (flight.empty_trace(rounds, flight_every), acc0) \
             if flight_every is not None \
             else jnp.zeros((0,), jnp.float32)
-        (args, scalars, t_final, acc, rec), _ = jax.lax.scan(
-            body, (args, scalars, state.t, acc0, rec0), (seeds, ridx))
+        # per-round coord keys, folded off a salted key so the seeds the
+        # KERNEL consumes are untouched by coords mode
+        ckeys = jax.random.split(jax.random.fold_in(key, 0x5EED), rounds)
+        coo0 = coo if with_coords else jnp.zeros((0,), jnp.float32)
+        (args, scalars, t_final, acc, rec, coo_f), _ = jax.lax.scan(
+            body, (args, scalars, state.t, acc0, rec0, coo0),
+            (seeds, ridx, ckeys))
         acc_i, acc_lat = acc
         trace = rec[0] if flight_every is not None else None
         (up, status, inc, informed, s_start, s_dead, s_conf,
@@ -599,14 +664,19 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             local_health=lh.reshape(-1),
             slow=slow_flat, t=t_final,
             round_idx=state.round_idx + rounds, stats=st)
+        if with_coords:
+            return (out, coo_f, trace) if flight_every is not None \
+                else (out, coo_f)
         return (out, trace) if flight_every is not None else out
 
     if fault:
         # bind the maker's plan; same-shape plans may be swapped in per
         # call without recompiling (the tensors are traced arguments)
         def run_fault(state: SimState, key: jax.Array,
-                      cp: Optional[CompiledFaultPlan] = None):
-            return _run(state, key, cp if cp is not None else plan)
+                      cp: Optional[CompiledFaultPlan] = None,
+                      coo=None, topo=None):
+            return _run(state, key, cp if cp is not None else plan,
+                        coo, topo)
 
         return run_fault
 
@@ -615,7 +685,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
     seen_ok: list = [None]
 
-    def run(state: SimState, key: jax.Array) -> SimState:
+    def run(state: SimState, key: jax.Array, coo=None, topo=None):
         # the 8-array kernel carries no slow array: running it over a
         # state with residual slow nodes would silently drop their
         # degraded dynamics (the XLA paths honor state.slow regardless
@@ -630,11 +700,11 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     "slow-node model; use a SimParams with "
                     "slow_per_round>0 (10-array kernel) or the XLA "
                     "run_rounds for this state")
-        out = _run(state, key)
+        out = _run(state, key, None, coo, topo)
         # cache the OUTPUT buffer: jit returns a fresh Array object even
         # for a passed-through input, so caching state.slow would never
         # hit on chained calls
-        seen_ok[0] = out.slow
+        seen_ok[0] = (out[0] if isinstance(out, tuple) else out).slow
         return out
 
     return run

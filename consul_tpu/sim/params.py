@@ -56,6 +56,19 @@ class SimParams:
     slow_recover_per_round: float = 0.05
     slow_factor: float = 0.1
 
+    # Network-coordinate subsystem (sim/coords.py + sim/topology.py).
+    # Coordinates are ENABLED by passing a CoordState/Topology pair to
+    # the runners (data, not a static flag — one compile per shape);
+    # these knobs only shape the optional timeout feedback:
+    # coords_timeout=True gates each probe's ack on the RTT-vs-deadline
+    # race, deadline = max(probe_timeout, coord_timeout_mult·estimated
+    # RTT)·(LH+1) — memberlist's awareness scaling with an RTT-aware
+    # base, mirroring gossip/swim.py's RTT_TIMEOUT_MULT. XLA engines
+    # only (the Pallas kernel's ack draw is internal; its maker refuses
+    # the combination rather than silently diverging).
+    coords_timeout: bool = False
+    coord_timeout_mult: float = 3.0
+
     # Keep cumulative detector statistics (a few extra scalar reductions
     # per round). Disable for pure-throughput benchmarking.
     collect_stats: bool = True

@@ -72,19 +72,31 @@ def _trunc_poisson(u: jnp.ndarray, lam: jnp.ndarray, kmax: int = 4
 
 def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
                 reduce_sum: Reducer = jnp.sum,
-                fx: Optional[FaultFrame] = None):
+                fx: Optional[FaultFrame] = None,
+                coords=None, topo=None):
     """ONE protocol period — the single copy of the protocol body.
 
     `scalars=None` → live mode: population scalars computed from the
     post-churn arrays (gossip_round). `scalars=vector` → stale mode:
     last round's scalars are used and the next round's are produced in
-    the same fused pass (gossip_round_fast). Returns (state, scalars').
+    the same fused pass (gossip_round_fast). Returns
+    (state, scalars', coords', coord_metrics).
 
     `fx` (faults.FaultFrame) carries this round's fault-injection view:
     per-node delivery multipliers, forced-slow mask, and churn-burst /
     flap schedule rates. All fault structure is per-node DATA — the
     traced program is identical for every phase of a FaultPlan, so a
     multi-phase plan costs one compile.
+
+    `coords`/`topo` (sim/coords.CoordState, sim/topology.Topology) arm
+    the Vivaldi RTT subsystem: explicit probe targets are sampled (the
+    one place the mean-field model materializes pairs), observed RTTs
+    ride the ground-truth embedding, and the batched `vivaldi_step`
+    relaxes the acked probers' coordinates. With p.coords_timeout the
+    probe's ack is additionally gated on the RTT-vs-deadline race —
+    detection becomes topology-sensitive. Both tensors are DATA: one
+    compile per shape, coords-off tracing is bit-identical to the seed
+    (the coord PRNG keys are folded off the round key separately).
     """
     n = p.n
     t = state.t
@@ -171,6 +183,57 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
 
     g, pf_fast, pf_slow = _pf_arrays(slow_eff, lh, sbar, n_live / n, p, fx)
 
+    # ------------------------------------------------ Vivaldi probe pairs
+    # Explicit probe targets exist ONLY in coords mode (the mean-field
+    # statistics need none): the pair's ground-truth RTT is one jittered
+    # draw off the latency embedding, and — with coords_timeout — the
+    # prober's ack must beat an awareness-scaled, RTT-aware deadline
+    # (memberlist state.go probeNode semantics, see params.py). Keys are
+    # folded off the round key separately so coords-off dynamics stay
+    # bit-identical to a coords-less build.
+    timely = late_in = None
+    if coords is not None:
+        from consul_tpu.sim import coords as coords_mod
+        from consul_tpu.sim import topology as topo_mod
+
+        k_pair, k_jit, k_dir, k_q = jax.random.split(
+            jax.random.fold_in(key, 0x5EED), 4)
+        i_all = jnp.arange(L, dtype=jnp.int32)
+        pair_j = topo_mod.sample_pairs(L, k_pair)
+        rtt_obs = topo_mod.sample_rtt(topo, i_all, pair_j, k_jit)
+        if p.coords_timeout:
+            # deadline = max(floor, min(mult·est, interval))·(LH+1) —
+            # the RTT term caps at the protocol period, like the agent
+            # engine (swim.RTT_TIMEOUT_MULT): a corrupted coordinate
+            # must not disable detection of its node
+            est = coords_mod.estimate_rtt(coords, i_all, pair_j)
+            deadline = jnp.maximum(
+                p.probe_timeout,
+                jnp.minimum(p.coord_timeout_mult * est,
+                            p.probe_interval)) \
+                * (lh.astype(jnp.float32) + 1.0)
+            timely = rtt_obs <= deadline
+            # target-side mirror: each node is probed ~once per round
+            # by a RANDOM prober q; the probability that probe's RTT
+            # beats q's deadline folds into the node's failed-probe
+            # rate exactly like a lost packet (lognormal jitter tail:
+            # P(rtt·e^{σZ} > d) = 1 − Φ(ln(d/rtt)/σ)), which is what
+            # lets a timeout-induced miss START suspicions — the
+            # rumor-centric model generates suspicion arrivals from
+            # the target's miss rate, not the prober's draw
+            q_in = topo_mod.sample_pairs(L, k_q)
+            rtt_in = topo_mod.true_rtt(topo, q_in, i_all)
+            est_in = coords_mod.estimate_rtt(coords, q_in, i_all)
+            dl_in = jnp.maximum(
+                p.probe_timeout,
+                jnp.minimum(p.coord_timeout_mult * est_in,
+                            p.probe_interval)) \
+                * (lh[q_in].astype(jnp.float32) + 1.0)
+            sig = jnp.maximum(topo.jitter_sigma, 1e-6)
+            z = jnp.log(jnp.maximum(dl_in, 1e-9)
+                        / jnp.maximum(rtt_in, 1e-9)) / sig
+            late_in = 1.0 - jax.scipy.stats.norm.cdf(z)
+
     # ---------------------------------------------------- prober-side probe
     # P(ack | this node probes): random eligible target; down targets never
     # ack. One Bernoulli draw ≡ drawing target + channels separately.
@@ -178,7 +241,27 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     p_ack = frac_up_elig * (1.0 - mix_i)
     prober = up
     ack = prober & (jax.random.uniform(k_ack, (L,)) < p_ack)
+    if timely is not None:
+        # a late ack is a missed deadline: the prober escalates
+        # (awareness +1, suspicion machinery) exactly like a lost one
+        ack = ack & timely
     failed = prober & ~ack
+
+    # ------------------------------------------------ Vivaldi relaxation
+    # Coordinates update where the probe round-trip completed: the ack
+    # carries the pair's observed RTT (serf piggybacks coordinates on
+    # ack payloads; swim.py notify_ack drives the scalar client). Only
+    # the CHEAP byproducts (pair targets, drift) are computed here —
+    # the percentile-sorting quality row (coords.coord_metrics) runs
+    # where it is consumed, inside the flight recorder's cond.
+    coords_out = coord_aux = None
+    if coords is not None:
+        upd = ack & up[pair_j]
+        coords_out = coords_mod.vivaldi_step(coords, None, pair_j,
+                                             rtt_obs, k_dir, upd)
+        coord_aux = coords_mod.CoordRoundAux(
+            pair_j=pair_j, drift=coords_mod.round_drift(coords,
+                                                        coords_out))
 
     # Lifeguard awareness: successful probe −1, missed ack +1
     # (memberlist awareness.go deltas applied in state.go probeNode).
@@ -206,6 +289,10 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         # behind a partition barely contribute (their suspicion rumor
         # cannot reach the quorum side) — see faults.py module notes
         base_fail = 1.0 - (1.0 - base_fail) * fx.suspw
+    if late_in is not None:
+        # RTT-timeout misses compose with loss-driven misses as an
+        # independent failure leg (coords_timeout, see above)
+        base_fail = 1.0 - (1.0 - base_fail) * (1.0 - late_in)
     p_fail_j = jnp.where(up, base_fail, 1.0)
     lam_fail = probe_rate * p_fail_j * eligf
     n_fail = _trunc_poisson(jax.random.uniform(k_pois, (L,)), lam_fail)
@@ -308,7 +395,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         susp_deadline=s_dead, susp_conf=s_conf, local_health=lh, slow=slow,
         t=t_end, round_idx=state.round_idx + 1, stats=st)
     if scalars is None:
-        return out, None
+        return out, None, coords_out, coord_aux
     # stale mode: produce next round's scalars in this same fused pass
     upf2 = up.astype(jnp.float32)
     elig2 = (status == ALIVE) | (status == SUSPECT)
@@ -322,19 +409,30 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         reduce_sum(upf2 * pf_fast), reduce_sum(upf2 * pf_slow),
         reduce_sum(w_fail2 * (lh.astype(jnp.float32) + 1.0)),
         jnp.maximum(reduce_sum(w_fail2), 1e-9)])
-    return out, new_scalars
+    return out, new_scalars, coords_out, coord_aux
 
 
 def gossip_round(state: SimState, key: jax.Array, p: SimParams,
                  reduce_sum: Reducer = jnp.sum,
-                 fx: Optional[FaultFrame] = None) -> SimState:
+                 fx: Optional[FaultFrame] = None,
+                 coords=None, topo=None):
     """Advance one protocol period with LIVE population scalars.
 
     `reduce_sum` turns a per-node array into the *global* scalar sum —
     jnp.sum on one device; psum-wrapped in the sharded engine. All
-    cross-node coupling flows through these scalars (mean-field)."""
-    out, _ = _round_core(state, None, key, p, reduce_sum, fx)
-    return out
+    cross-node coupling flows through these scalars (mean-field).
+
+    With a `coords`/`topo` pair the Vivaldi subsystem rides the round
+    and the return value becomes (state, coords', coords.CoordRoundAux)
+    — the aux carries the round's probe targets and drift, from which
+    coords.coord_metrics builds the quality row where it is consumed;
+    without one the return stays the bare state. Coords mode is
+    single-device only (the pair gathers don't cross mesh shards)."""
+    out, _, c2, aux = _round_core(state, None, key, p, reduce_sum, fx,
+                                  coords, topo)
+    if coords is None:
+        return out
+    return out, c2, aux
 
 
 #: scalar vector layout for the stale-scalars fast path
@@ -407,15 +505,21 @@ def init_scalars(state: SimState, p: SimParams,
 def gossip_round_fast(state: SimState, scalars: jnp.ndarray,
                       key: jax.Array, p: SimParams,
                       reduce_sum: Reducer = jnp.sum,
-                      fx: Optional[FaultFrame] = None
-                      ) -> tuple[SimState, jnp.ndarray]:
+                      fx: Optional[FaultFrame] = None,
+                      coords=None, topo=None):
     """One protocol period using LAST round's population scalars.
 
     Same protocol body as gossip_round (_round_core) — only the scalar
     source differs, so the two paths cannot drift. Statistical
     conformance is additionally asserted in tests/test_sim_round.py.
+    Returns (state, scalars'), extended to (state, scalars', coords',
+    coords.CoordRoundAux) when a coords/topo pair is supplied.
     """
-    return _round_core(state, scalars, key, p, reduce_sum, fx)
+    out, sc, c2, aux = _round_core(state, scalars, key, p, reduce_sum,
+                                   fx, coords, topo)
+    if coords is None:
+        return out, sc
+    return out, sc, c2, aux
 
 
 def make_run_rounds_fast(p: SimParams, rounds: int):
@@ -469,6 +573,34 @@ def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
 
 
 @functools.partial(jax.jit, static_argnames=("p", "rounds"))
+def run_rounds_coords(state: SimState, coords, topo, key: jax.Array,
+                      p: SimParams, rounds: int,
+                      plan: Optional[CompiledFaultPlan] = None):
+    """Run `rounds` periods with the Vivaldi subsystem riding the scan.
+
+    Returns (final_state, final_coords, metrics_trace) where the trace
+    is a [rounds, 3] f32 array of per-round coordinate quality in
+    flight.COORD_COLUMNS order (median / p99 relative RTT-estimate
+    error vs the no-jitter ground truth, mean coordinate drift). The
+    coords/topo/plan tensors are traced data — one compile per shape.
+    """
+
+    from consul_tpu.sim import coords as coords_mod
+
+    def body(carry, k):
+        s, c = carry
+        fx = fault_frame(plan, s.round_idx) if plan is not None else None
+        s2, c2, aux = gossip_round(s, k, p, fx=fx, coords=c, topo=topo)
+        # stride-1 runner: every round's row is consumed, so the
+        # percentile sorts run unconditionally here by design
+        return (s2, c2), coords_mod.coord_metrics(c2, topo, aux)
+
+    keys = jax.random.split(key, rounds)
+    (final, cf), trace = jax.lax.scan(body, (state, coords), keys)
+    return final, cf, trace
+
+
+@functools.partial(jax.jit, static_argnames=("p", "rounds"))
 def run_rounds_stats(state: SimState, key: jax.Array, p: SimParams,
                      rounds: int,
                      plan: Optional[CompiledFaultPlan] = None):
@@ -508,7 +640,8 @@ def make_run_rounds(p: SimParams, rounds: int):
                    static_argnames=("p", "rounds", "record_every"))
 def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
                       rounds: int, record_every: int = 1,
-                      plan: Optional[CompiledFaultPlan] = None):
+                      plan: Optional[CompiledFaultPlan] = None,
+                      coords=None, topo=None):
     """Run `rounds` periods with the flight recorder riding the scan.
 
     Returns (final_state, trace) where trace is a
@@ -519,6 +652,11 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
     bounded trace with ONE device_get after the run; no per-round host
     syncs. PRNG use is identical to run_rounds/run_rounds_stats, so the
     same key yields the same dynamics with or without the recorder.
+
+    A `coords`/`topo` pair threads the Vivaldi subsystem through the
+    scan: the trace's coord columns (flight.COORD_COLUMNS) carry the
+    recorded round's estimate quality and the return value becomes
+    (final_state, final_coords, trace).
     """
     from consul_tpu.sim import flight
 
@@ -528,32 +666,46 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
             "counters; build SimParams with collect_stats=True")
 
     def body(carry, xs):
-        s, buf, prev = carry
+        s, c, buf, prev = carry
         k, i = xs
         fx = fault_frame(plan, s.round_idx) if plan is not None else None
         ph = active_phase(plan, s.round_idx) if plan is not None \
             else jnp.int32(-1)
-        s2 = gossip_round(s, k, p, fx=fx)
+        if coords is None:
+            s2 = gossip_round(s, k, p, fx=fx)
+            c2 = aux = None
+        else:
+            s2, c2, aux = gossip_round(s, k, p, fx=fx, coords=c,
+                                       topo=topo)
 
-        def rec(c):
-            b, pv = c
+        def rec(cc):
+            b, pv = cc
+            crow = None
+            if coords is not None:
+                # the percentile sorts behind the quality row run HERE,
+                # inside the decimation cond's taken branch — skipped
+                # rounds skip the reduction work, coord columns included
+                from consul_tpu.sim import coords as coords_mod
+
+                crow = coords_mod.coord_metrics(c2, topo, aux)
             row = flight.flight_row(
                 up=s2.up, status=s2.status, informed=s2.informed,
                 local_health=s2.local_health,
                 incarnation=s2.incarnation, t=s2.t,
-                stats_delta=flight.stats_delta(s2.stats, pv), phase=ph)
+                stats_delta=flight.stats_delta(s2.stats, pv), phase=ph,
+                coord_row=crow)
             return flight.record_row(b, row, i, record_every), s2.stats
 
         buf, prev = flight.maybe_record((buf, prev), i, rounds,
                                         record_every, rec)
-        return (s2, buf, prev), None
+        return (s2, c2, buf, prev), None
 
     keys = jax.random.split(key, rounds)
     buf0 = flight.empty_trace(rounds, record_every)
-    (final, trace, _), _ = jax.lax.scan(
-        body, (state, buf0, state.stats),
+    (final, cf, trace, _), _ = jax.lax.scan(
+        body, (state, coords, buf0, state.stats),
         (keys, jnp.arange(rounds, dtype=jnp.int32)))
-    return final, trace
+    return (final, trace) if coords is None else (final, cf, trace)
 
 
 def make_run_rounds_flight(p: SimParams, rounds: int,

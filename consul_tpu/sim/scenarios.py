@@ -216,6 +216,81 @@ def run_chaos_suite(n: int = 4096, seed: int = 0) -> dict[str, Any]:
             for name in chaos_plans(n)}
 
 
+# ------------------------------------------------------------- coords
+#
+# Network-coordinate convergence scenario: a cold-start population
+# learns Vivaldi coordinates from probe RTTs against the synthetic
+# ground-truth topology, with an asymmetric partition in the middle —
+# partitioned nodes stop acking, their coordinates freeze, and the
+# estimate error's recovery after the heal is the curve this scenario
+# (and `bench.py --coords`) records.
+
+COORDS_WARMUP_ROUNDS = 60
+COORDS_PARTITION_ROUNDS = 40
+COORDS_HEAL_ROUNDS = 40
+#: the acceptance bar `bench.py --coords` and tests/test_coords.py pin:
+#: median relative RTT-estimate error after 60 cold-start rounds
+COORDS_CONVERGED_MED_ERR = 0.25
+
+
+def coords_plan(n: int) -> FaultPlan:
+    return FaultPlan(phases=(
+        Phase(rounds=COORDS_WARMUP_ROUNDS, name="warmup"),
+        Phase(rounds=COORDS_PARTITION_ROUNDS,
+              faults=(Partition(a=(0, max(1, n // 8)),
+                                b=(max(1, n // 8), n)),),
+              name="partition"),
+        Phase(rounds=COORDS_HEAL_ROUNDS, name="heal"),
+    ))
+
+
+def run_coords(n: int = 4096, seed: int = 0,
+               p: Optional[SimParams] = None,
+               topo_params=None):
+    """Run the coords scenario; returns (report dict, final CoordState).
+
+    Rides the flight recorder at stride 1 with the Vivaldi subsystem
+    threaded through the scan: the report's per-phase curves carry the
+    median relative RTT-estimate error (trace_report `rtt_err_med`)
+    through partition and heal, plus the cold-start convergence round
+    (first round with median error under COORDS_CONVERGED_MED_ERR).
+    RTT-aware probe deadlines (p.coords_timeout) are ON: detection is
+    topology-sensitive, so the partition phase's FD counters are the
+    latency-aware numbers."""
+    from consul_tpu.sim.coords import init_coords
+    from consul_tpu.sim.flight import COL, trace_columns
+    from consul_tpu.sim.topology import TopologyParams, make_topology
+
+    plan = coords_plan(n)
+    if p is None:
+        p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
+                                         tcp_fallback=False,
+                                         coords_timeout=True)
+    topo = make_topology(topo_params if topo_params is not None
+                         else TopologyParams(n=n, seed=seed))
+    cp = compile_plan(plan, n)
+    state, coords, trace = run_rounds_flight(
+        init_state(n), jax.random.key(seed), p, plan.total_rounds,
+        plan=cp, coords=init_coords(n), topo=topo)
+    cols = trace_columns(trace)
+    med = cols["rtt_err_med"]
+    below = (med < COORDS_CONVERGED_MED_ERR).nonzero()[0]
+    report = {
+        "scenario": "coords", "n": n, "rounds": plan.total_rounds,
+        "converged_med_err": COORDS_CONVERGED_MED_ERR,
+        "convergence_round": int(below[0] + 1) if below.size else -1,
+        "med_err_at_60": float(med[COORDS_WARMUP_ROUNDS - 1]),
+        "final_med_err": float(med[-1]),
+        "final_p99_err": float(cols["rtt_err_p99"][-1]),
+        "final_drift": float(cols["coord_drift"][-1]),
+        "flight": trace_report(trace, p, plan=plan,
+                               rounds=plan.total_rounds),
+        "final_live_fraction": float(jnp.mean(
+            state.up.astype(jnp.float32))),
+    }
+    return report, coords
+
+
 def run_baseline_config(name: str, rounds: int = 300,
                         seed: int = 0) -> dict[str, Any]:
     """Run one of the named BASELINE configs and report FD quality."""

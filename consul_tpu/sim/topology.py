@@ -1,0 +1,105 @@
+"""Synthetic ground-truth RTT topology for the batched gossip sim.
+
+The sim models probe *outcomes* but was latency-blind: FaultPlan (PR 1)
+gave the population loss heterogeneity, this module gives it latency
+heterogeneity — the per-link structure that gossip-timing work (PAPERS:
+pipelined gossiping, tuneable gossip) shows dominates dissemination
+quality, and the signal the reference's Vivaldi subsystem
+(internal/gossip/librtt/rtt.go) actually estimates.
+
+Model: nodes are embedded in a low-dimensional latency space —
+per-DC cluster centers (inter-DC legs), per-node scatter around the
+center (intra-DC legs), and a per-node "height" term for the access
+link (the off-mesh last hop Vivaldi's height vector models). Pairwise
+RTT is then
+
+    rtt(i, j) = ||pos_i - pos_j|| + h_i + h_j            (seconds)
+
+computable ON DEVICE for any batch of (i, j) pairs with two gathers —
+never an N×N matrix, which is what keeps 1M nodes feasible. Observed
+probe RTTs multiply a lognormal jitter (unit median), so repeated
+samples of one pair scatter the way real probe RTTs do.
+
+By construction the no-jitter RTT is symmetric (the norm is) and
+strictly positive (heights are floored) — pinned in tests/test_coords.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class TopologyParams:
+    """Static knobs of the ground-truth latency embedding (hashable).
+
+    Distances are in seconds. Defaults sketch a 4-DC WAN: ~50-100ms
+    cross-DC legs, ~2ms intra-DC scatter, a few ms of per-node access
+    latency, 10% lognormal probe jitter.
+    """
+
+    n: int = 1024
+    dims: int = 4                 # latent latency-space dimension
+    n_dcs: int = 4
+    dc_spread_s: float = 0.025    # DC centers ~ N(0, spread²) per dim
+    intra_spread_s: float = 0.002 # node scatter around its DC center
+    height_min_s: float = 1e-4    # access-link floor
+    height_mean_s: float = 0.003  # mean extra access-link latency
+    jitter_sigma: float = 0.10    # lognormal sigma of observed RTTs
+    seed: int = 0
+
+    def with_(self, **kw) -> "TopologyParams":
+        return replace(self, **kw)
+
+
+class Topology(NamedTuple):
+    """Materialized embedding (device tensors; a jit-traceable pytree)."""
+
+    pos: jnp.ndarray           # [N, dims] f32 — latency-space position
+    height: jnp.ndarray        # [N] f32 — access-link term (> 0)
+    dc: jnp.ndarray            # [N] int32 — datacenter id
+    jitter_sigma: jnp.ndarray  # 0-d f32 — observation noise (data, so
+    #                            one compile serves any jitter level)
+
+
+def make_topology(tp: TopologyParams) -> Topology:
+    """Draw the ground-truth embedding for `tp` (deterministic in seed)."""
+    k_dc, k_pos, k_h = jax.random.split(jax.random.key(tp.seed), 3)
+    centers = tp.dc_spread_s * jax.random.normal(
+        k_dc, (tp.n_dcs, tp.dims), jnp.float32)
+    # contiguous DC blocks, so FaultPlan node-range selectors align with
+    # DC boundaries (a Partition over (0, n//n_dcs) cuts exactly DC 0)
+    dc = (jnp.arange(tp.n) * tp.n_dcs // tp.n).astype(jnp.int32)
+    pos = centers[dc] + tp.intra_spread_s * jax.random.normal(
+        k_pos, (tp.n, tp.dims), jnp.float32)
+    height = tp.height_min_s + tp.height_mean_s * jax.random.exponential(
+        k_h, (tp.n,), jnp.float32)
+    return Topology(pos=pos, height=height, dc=dc,
+                    jitter_sigma=jnp.float32(tp.jitter_sigma))
+
+
+def true_rtt(topo: Topology, i, j) -> jnp.ndarray:
+    """No-jitter ground-truth RTT (s) for index batches i, j — the
+    quantity coordinate estimates are scored against."""
+    d = topo.pos[i] - topo.pos[j]
+    return jnp.sqrt(jnp.sum(d * d, axis=-1)) \
+        + topo.height[i] + topo.height[j]
+
+
+def sample_rtt(topo: Topology, i, j, key: jax.Array) -> jnp.ndarray:
+    """One observed probe RTT per (i, j) pair: ground truth times a
+    unit-median lognormal jitter draw."""
+    base = true_rtt(topo, i, j)
+    z = jax.random.normal(key, base.shape, jnp.float32)
+    return base * jnp.exp(topo.jitter_sigma * z)
+
+
+def sample_pairs(n: int, key: jax.Array) -> jnp.ndarray:
+    """Uniform probe target j[i] != i for every node i (the batched
+    stand-in for memberlist's shuffled probe ring position)."""
+    off = jax.random.randint(key, (n,), 1, n, dtype=jnp.int32)
+    return (jnp.arange(n, dtype=jnp.int32) + off) % n

@@ -466,6 +466,70 @@ def test_near_sorting_with_coordinates(agent, client):
     assert [e["Node"] for e in svc] == ["far-node", "near-node"]
 
 
+def test_catalog_nodes_near_sort_and_agent_alias(agent, client):
+    """/v1/catalog/nodes honors ?near=<node>, and ?near=_agent
+    resolves to the serving agent's own node (catalog_endpoint.go
+    parseSource) — Consul's near-sort semantics on the node list."""
+    _seed_geo_coordinates(agent, client)
+    nodes = client.get("/v1/catalog/nodes", near="far-node")
+    names = [e["Node"] for e in nodes]
+    assert names[0] == "far-node"
+    # secondary order is real RTT order: near-node (|0.5−0.001|) sits
+    # closer to far-node than dev-agent (|0.5−0.0|) does
+    assert names.index("near-node") < names.index("dev-agent")
+    # _agent alias: the serving agent itself sorts first (self-distance
+    # is the minimum), its nearest coordinate neighbor next
+    nodes = client.get("/v1/catalog/nodes", near="_agent")
+    names = [e["Node"] for e in nodes]
+    assert names[0] == "dev-agent"
+    assert names.index("near-node") < names.index("far-node")
+    # unknown ?near target: unsorted but intact (reference behavior)
+    nodes = client.get("/v1/catalog/nodes", near="no-such-node")
+    assert {"near-node", "far-node"} <= {e["Node"] for e in nodes}
+
+
+def test_health_service_near_agent_alias(agent, client):
+    """/v1/health/service/<name>?near=_agent RTT-sorts instances
+    relative to the serving agent."""
+    _seed_geo_coordinates(agent, client)
+    res = client.get("/v1/health/service/geo", near="_agent")
+    assert [e["Node"]["Node"] for e in res] == ["near-node", "far-node"]
+    res = client.get("/v1/health/service/geo", near="far-node")
+    assert [e["Node"]["Node"] for e in res] == ["far-node", "near-node"]
+
+
+def test_api_rtt_helper(agent, client):
+    """api.ConsulClient.rtt computes the coordinate distance between
+    two stored nodes (`consul rtt` semantics), defaulting the second
+    node to the serving agent."""
+    _seed_geo_coordinates(agent, client)
+    near = client.rtt("near-node")          # vs the agent (default)
+    far = client.rtt("far-node", "dev-agent")
+    assert near is not None and far is not None
+    assert 0 < near < far
+    assert client.rtt("no-such-node") is None
+
+
+def _seed_geo_coordinates(agent, client):
+    """Idempotent fixture shared by the near-sort tests: two catalog
+    nodes running "geo" at different coordinate distances from the
+    agent."""
+    agent.rpc("Catalog.Register", {
+        "Node": "near-node", "Address": "10.0.0.10",
+        "Service": {"ID": "geo", "Service": "geo", "Port": 1}})
+    agent.rpc("Catalog.Register", {
+        "Node": "far-node", "Address": "10.0.0.11",
+        "Service": {"ID": "geo", "Service": "geo", "Port": 2}})
+    for node, x in (("dev-agent", 0.0), ("near-node", 0.001),
+                    ("far-node", 0.5)):
+        agent.rpc("Coordinate.Update", {
+            "Node": node, "Coord": {"Vec": [x] + [0.0] * 7,
+                                    "Error": 0.1, "Adjustment": 0,
+                                    "Height": 1e-5}})
+    wait_for(lambda: len(client.get("/v1/coordinate/nodes")) >= 3,
+             what="coordinate batch flush")
+
+
 def test_autopilot_health_endpoint(agent, client):
     h = client.get("/v1/operator/autopilot/health")
     assert h["Healthy"] is True

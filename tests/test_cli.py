@@ -442,3 +442,24 @@ def test_gossip_sim_chaos_end_to_end():
     assert rep["scenario"] == "asym_partition"
     assert [p["phase"] for p in rep["phases"]] \
         == ["warmup", "asym_partition", "recover"]
+
+
+def test_gossip_sim_coords_publishes_into_store():
+    """`agent -dev -gossip-sim=cpu -gossip-sim-coords` runs the
+    network-coordinate scenario AND publishes the virtual members'
+    Vivaldi coordinates through the real /v1/coordinate/update path of
+    a dev agent, so /v1/coordinate/nodes and the api rtt helper serve
+    sim coordinates."""
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                       "-gossip-sim-nodes", "256", "-gossip-sim-coords")
+    assert rc == 0, out
+    rep = json.loads(out[out.index("{"):])
+    assert rep["scenario"] == "coords"
+    assert rep["convergence_round"] > 0
+    assert [p["phase"] for p in rep["phases"]] \
+        == ["warmup", "partition", "heal"]
+    assert "coords_publish_error" not in rep, rep.get(
+        "coords_publish_error")
+    assert rep["coords_published"] == 128
+    assert rep["coordinate_nodes_served"] >= 128
+    assert rep["rtt_sim_0_1_s"] > 0

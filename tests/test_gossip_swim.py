@@ -285,3 +285,90 @@ def test_broadcast_queue_dynamic_depth():
     assert len(q) == 50
     q.get_batch(n_nodes=3, budget=0)    # prunes to max_depth(3)=8
     assert len(q) == 8
+
+
+def test_rtt_scaled_probe_timeout_floor_and_scaling():
+    """The ack deadline is max(configured floor, RTT-estimate ×
+    RTT_TIMEOUT_MULT), both scaled by awareness: a near (or unknown)
+    target keeps the tight floor, a far target gets headroom
+    proportional to its coordinate-estimated RTT."""
+    from consul_tpu.gossip.swim import RTT_TIMEOUT_MULT
+    from consul_tpu.types import Coordinate
+
+    net, serfs, events = make_cluster(2)
+    net.clock.advance(2.0)
+    ml = serfs[0].memberlist
+    recorded = []
+    orig = ml._register_ack
+
+    def spy(seq, on_ack, on_timeout, timeout):
+        recorded.append(timeout)
+        orig(seq, on_ack, on_timeout, timeout)
+
+    ml._register_ack = spy
+    cfg = ml.config
+    # no coordinate for the target yet -> configured floor
+    with serfs[0]._coord_lock:
+        serfs[0]._coords.pop("node1", None)
+    ml._probe_node(ml._members["node1"])
+    assert recorded[0] == pytest.approx(
+        cfg.scaled_probe_timeout(ml.awareness))
+    # a near target's estimate stays under the floor -> floor holds
+    with serfs[0]._coord_lock:
+        serfs[0]._coords["node1"] = Coordinate(
+            vec=(0.001,) + (0.0,) * 7)
+    ml._probe_node(ml._members["node1"])
+    assert recorded[-1] == pytest.approx(
+        cfg.scaled_probe_timeout(ml.awareness))
+    # a far target scales: est * mult * (awareness + 1)
+    with serfs[0]._coord_lock:
+        serfs[0]._coords["node1"] = Coordinate(vec=(0.05,) + (0.0,) * 7)
+    est = serfs[0].estimate_rtt("node1")
+    assert est * RTT_TIMEOUT_MULT < cfg.probe_interval  # below the cap
+    ml._probe_node(ml._members["node1"])
+    assert recorded[-1] == pytest.approx(
+        est * RTT_TIMEOUT_MULT * (ml.awareness + 1))
+    assert recorded[-1] > recorded[0]
+    # a corrupted/inflated coordinate caps at the protocol period — it
+    # must never disable timely failure detection of the target
+    with serfs[0]._coord_lock:
+        serfs[0]._coords["node1"] = Coordinate(vec=(30.0,) + (0.0,) * 7)
+    ml._probe_node(ml._members["node1"])
+    assert recorded[-1] == pytest.approx(
+        cfg.probe_interval * (ml.awareness + 1))
+
+
+def test_rtt_aware_timeout_stops_far_node_false_suspicion_cycle():
+    """Regression: a slow-but-alive FAR member misses the flat ack
+    deadline every probe, gets suspected, and burns a refutation
+    (incarnation bump) forever. With RTT-aware deadlines the Vivaldi
+    loop LEARNS the member's RTT from the very acks that keep arriving
+    late-but-arriving, the deadline widens past it, and the
+    suspect/refute cycle stops — while near members keep the tight
+    floor (fast false-positive refutation is unchanged for them)."""
+    cfg = GossipConfig.local()
+
+    def run(rtt_aware):
+        net, serfs, events = make_cluster(3, cfg=cfg)
+        if not rtt_aware:
+            for s in serfs:  # the pre-coordinate flat-deadline world
+                s.estimate_rtt = lambda node: None
+        net.clock.advance(2.0)
+        far_addr = serfs[2].memberlist.transport.addr
+        # node2 now sits behind a slow access link: inbound dispatch
+        # delayed past the flat probe_timeout, well inside the interval
+        net.node_delay[far_addr] = cfg.probe_timeout * 1.3
+        net.clock.advance(6.0)  # learning window
+        inc_mid = serfs[0].memberlist._members["node2"].incarnation
+        net.clock.advance(6.0)  # steady-state window
+        inc_end = serfs[0].memberlist._members["node2"].incarnation
+        assert alive_names(serfs[0]) == {"node0", "node1", "node2"}
+        return inc_mid, inc_end
+
+    flat_mid, flat_end = run(rtt_aware=False)
+    rtt_mid, rtt_end = run(rtt_aware=True)
+    # flat deadline: the false-suspicion treadmill never stops
+    assert flat_mid > 0 and flat_end > flat_mid
+    # RTT-aware: once the coordinate converged, a clean record
+    assert rtt_end == rtt_mid
+    assert rtt_end <= flat_end
