@@ -45,15 +45,34 @@ def _error_line(error: str, platform: str, metric: str) -> str:
     })
 
 
+def _skipped_line(reason: str, platform: str, metric: str) -> str:
+    """Missing hardware is NOT a perf regression: the init/compile
+    watchdog emits `skipped: true` with rc 0 (see BENCH_r05.json — the
+    old rc-1 + value:null envelope made a TPU-less judging round
+    indistinguishable from a broken bench). Real errors (backend
+    raised, run hung AFTER the device answered) keep _error_line and
+    rc 1."""
+    return json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": "rounds/s",
+        "vs_baseline": None,
+        "skipped": True,
+        "reason": reason,
+        "platform": platform,
+    })
+
+
 def _arm_watchdog(platform: str, metric: str) -> threading.Timer:
-    """Bounded init: if not cancelled within the deadline, print the JSON
-    error and kill the process (round-4 verdict item 2: never hang)."""
+    """Bounded init: if not cancelled within the deadline, print the
+    JSON skip envelope and exit 0 (round-4 verdict item 2: never hang;
+    this PR: absent hardware reads as skipped, not failed)."""
     def fire() -> None:
-        print(_error_line(
+        print(_skipped_line(
             f"backend init/compile exceeded {_INIT_TIMEOUT_S:.0f}s "
             "(TPU device absent or tunnel hung)", platform, metric),
             flush=True)
-        os._exit(1)
+        os._exit(0)
 
     t = threading.Timer(_INIT_TIMEOUT_S, fire)
     t.daemon = True
@@ -200,6 +219,22 @@ def main() -> None:
         watchdog.cancel()
         print(_error_line(f"backend init failed: {e}", want, metric))
         sys.exit(1)
+    # the device ANSWERED: from here a hang is a real regression, not
+    # missing hardware — swap the skip-mode init watchdog for an
+    # error-mode compile/run one (the _scenario_bench two-stage
+    # pattern; budget 10x, a 1M-node first compile is legitimately
+    # slow)
+    watchdog.cancel()
+
+    def _fire_hung() -> None:
+        print(_error_line(
+            f"compile/run exceeded {_INIT_TIMEOUT_S * 10:.0f}s (hung "
+            "after backend init succeeded)", want, metric), flush=True)
+        os._exit(1)
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S * 10, _fire_hung)
+    watchdog.daemon = True
+    watchdog.start()
     platform = jax.default_backend()
     key = jax.random.key(0)
     kernel = "xla-sharded"       # which TIMED kernel actually ran
@@ -252,8 +287,8 @@ def main() -> None:
             diag_kernel = "xla-reference"
         state = init_state(n)
 
-    # compile + warmup (still under the init watchdog: a dead tunnel can
-    # hang here just as easily as in jax.devices())
+    # compile + warmup (under the error-mode watchdog: the device
+    # answered, so a hang here is a regression, never a skip)
     t0 = time.perf_counter()
     state = run(state, key)
     jax.block_until_ready(state)
@@ -320,8 +355,9 @@ def main() -> None:
             trace_dir = None
         # flight-recorder overhead at the default stride, on the same
         # full-model kernel the diag numbers come from (accepts <5%)
-        flight_info = None
+        flight_info = blackbox_info = None
         if len(devices) == 1:
+            from consul_tpu.sim.blackbox import default_tracked
             from consul_tpu.sim.flight import DEFAULT_RECORD_EVERY
 
             if diag_kernel == "pallas-full-10array":
@@ -331,18 +367,48 @@ def main() -> None:
                 fl_run = make_run_rounds_pallas(
                     p_diag, diag_chunk,
                     flight_every=DEFAULT_RECORD_EVERY)
+                bb_maker = make_run_rounds_pallas(
+                    p_diag, diag_chunk,
+                    flight_every=DEFAULT_RECORD_EVERY, blackbox=True)
+
+                def bb_run(s, k, t):
+                    return bb_maker(s, k, tracked=t)
             else:
                 from consul_tpu.sim.round import make_run_rounds_flight
 
                 fl_run = make_run_rounds_flight(p_diag, diag_chunk,
                                                 DEFAULT_RECORD_EVERY)
+
+                def bb_run(s, k, t):
+                    return fl_run(s, k, tracked=t)
+            # overhead numbers divide two timings over MATCHED windows.
+            # Smoke mode stretches them (5x iters, retimed baseline): a
+            # 0.1s window read ±20% of pure scheduler noise as
+            # "overhead". Non-smoke windows already span 1000 rounds,
+            # so the full_best measurement above IS the matched
+            # baseline — no duplicate full-kernel timing pass.
+            ov_iters = diag_iters * (5 if smoke else 1)
+            if ov_iters == diag_iters:
+                base_best = full_best
+            else:
+                base_best = float("inf")
+                for trial in range(3):
+                    t0 = time.perf_counter()
+                    fs = dstate
+                    for i in range(ov_iters):
+                        fs = diag(fs, jax.random.fold_in(
+                            key, 1900 + 10 * trial + i))
+                    checksum = float(fs.informed.sum())
+                    base_best = min(base_best,
+                                    time.perf_counter() - t0)
+                    assert checksum > 0
             fs, tr = fl_run(dstate, jax.random.fold_in(key, 2000))
             jax.block_until_ready((fs, tr))  # compile before timing
             fl_best = float("inf")
-            for trial in range(2):
+            for trial in range(3):
                 t0 = time.perf_counter()
                 fs = dstate
-                for i in range(diag_iters):
+                for i in range(ov_iters):
                     fs, tr = fl_run(fs, jax.random.fold_in(
                         key, 2001 + 10 * trial + i))
                 checksum = float(fs.informed.sum())
@@ -351,8 +417,33 @@ def main() -> None:
             flight_info = {
                 "record_every": DEFAULT_RECORD_EVERY,
                 "rounds_per_sec": round(
-                    diag_chunk * diag_iters / fl_best, 1),
-                "overhead_frac": round(fl_best / full_best - 1.0, 4),
+                    diag_chunk * ov_iters / fl_best, 1),
+                "overhead_frac": round(fl_best / base_best - 1.0, 4),
+            }
+            # black-box event rings on top of the flight recorder:
+            # K tracked agents at the default stride (the acceptance
+            # bar is <5% vs the bare full-model kernel)
+            tracked = default_tracked(n, p_diag.blackbox_k)
+            fs, tr, bb = bb_run(dstate, jax.random.fold_in(key, 2100),
+                                tracked)
+            jax.block_until_ready((fs, tr, bb.ring))
+            bb_best = float("inf")
+            for trial in range(3):
+                t0 = time.perf_counter()
+                fs = dstate
+                for i in range(ov_iters):
+                    fs, tr, bb = bb_run(fs, jax.random.fold_in(
+                        key, 2101 + 10 * trial + i), tracked)
+                checksum = float(fs.informed.sum())
+                bb_best = min(bb_best, time.perf_counter() - t0)
+                assert checksum > 0
+            blackbox_info = {
+                "tracked": int(tracked.shape[0]),
+                "ring_len": p_diag.blackbox_ring,
+                "record_every": DEFAULT_RECORD_EVERY,
+                "rounds_per_sec": round(
+                    diag_chunk * ov_iters / bb_best, 1),
+                "overhead_frac": round(bb_best / base_best - 1.0, 4),
             }
         profile_info = {
             "trace_dir": trace_dir,
@@ -361,6 +452,7 @@ def main() -> None:
             "dispatch_s": round(dispatch_s, 4),
             "device_s": round(steady_s - dispatch_s, 4),
             "flight": flight_info,
+            "blackbox": blackbox_info,
         }
 
     print(json.dumps({

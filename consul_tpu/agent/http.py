@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional
 from consul_tpu.server.rpc import RPCError
 from consul_tpu.types import CheckStatus
 from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import trace as trace_mod
 from consul_tpu.version import __version__
 
 
@@ -32,6 +33,42 @@ class StreamingBody:
 
     def __init__(self, gen) -> None:
         self.gen = gen
+
+
+def _sink_stream(total: float, attach, encode):
+    """The monitor-pattern live stream, shared by `/v1/agent/monitor`
+    and `/v1/agent/trace/stream`: a bounded queue fed by a sink that
+    DROPS on full (a slow reader sheds items, it never back-pressures
+    the instrumented hot path), drained until the window closes, sink
+    detached on any exit — including a client disconnect surfacing as
+    a write error in the handler. `attach(sink) -> detach` hooks the
+    producer (do any filtering in the producer wrapper, before the
+    queue); `encode(item) -> bytes` frames one item."""
+    import queue as queue_mod
+    import time as _t
+
+    items: "queue_mod.Queue" = queue_mod.Queue(maxsize=4096)
+
+    def sink(item) -> None:
+        try:
+            items.put_nowait(item)
+        except queue_mod.Full:
+            pass  # drop semantics (agent/log-drop)
+
+    detach = attach(sink)
+    end = _t.monotonic() + total
+    try:
+        while True:
+            remaining = end - _t.monotonic()
+            if remaining <= 0:
+                return
+            try:
+                item = items.get(timeout=min(remaining, 0.25))
+            except queue_mod.Empty:
+                continue
+            yield encode(item)
+    finally:
+        detach()
 
 
 class RawBody:
@@ -80,9 +117,21 @@ class HTTPApi:
                     or query.pop("token", "")
                 start = telemetry.time_now()
                 try:
-                    result, index = api.route(method, path, query, body,
-                                              token)
-                    if isinstance(result, StreamingBody):
+                    # span covers route dispatch end to end — on write
+                    # paths that is HTTP -> server RPC -> raft apply
+                    # commit-wait on THIS thread, so the raft.apply
+                    # child span nests under it (utils/trace.py); the
+                    # fsm commit runs on the applier thread as its own
+                    # root span, correlated by time
+                    with trace_mod.default.span(
+                            "http.request", method=method,
+                            path=path) as sp:
+                        result, index = api.route(method, path, query,
+                                                  body, token)
+                        streaming = isinstance(result, StreamingBody)
+                        if streaming:
+                            sp.tag(streaming=True)
+                    if streaming:
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "application/json")
@@ -441,6 +490,58 @@ class HTTPApi:
                         time_mod.sleep(interval)  # snapshot
 
             return StreamingBody(metrics_stream()), None
+        if path == "/v1/agent/trace":
+            # recent finished spans from the in-process span tracer
+            # (utils/trace.py) — the snapshot `cli debug` bundles.
+            # Same ACL tier as the monitor log stream: agent read.
+            rpc("Internal.AgentRead", {})
+            try:
+                limit = int(q.get("limit", "512"))
+                min_ms = float(q.get("min_ms", "0"))
+            except ValueError as exc:
+                raise HTTPError(400,
+                                f"bad trace params: {exc}") from exc
+            if limit < 0 or min_ms < 0:
+                raise HTTPError(400, "limit and min_ms must be "
+                                     "non-negative")
+            spans = trace_mod.default.recent(
+                limit=limit, min_ms=min_ms, prefix=q.get("prefix", ""))
+            if q.get("format") == "perfetto":
+                return trace_mod.default.to_perfetto(spans), None
+            return {"Spans": spans}, None
+        if path == "/v1/agent/trace/stream":
+            # LIVE span stream (the `/v1/agent/monitor` pattern for
+            # spans): one JSON line per finished span for ?duration=
+            # seconds. Validation BEFORE streaming starts; the sink
+            # feeds a bounded queue with drop-on-full so a slow reader
+            # sheds spans instead of back-pressuring hot paths.
+            rpc("Internal.AgentRead", {})
+            try:
+                total = min(_dur(q.get("duration", "2s")), 60.0)
+                min_ms = float(q.get("min_ms", "0"))
+            except ValueError as exc:
+                raise HTTPError(400,
+                                f"bad trace params: {exc}") from exc
+            if total <= 0 or min_ms < 0:
+                raise HTTPError(400, "duration must be positive and "
+                                     "min_ms non-negative")
+            prefix = q.get("prefix", "")
+
+            def attach(sink):
+                # filter in the producer wrapper, BEFORE the queue —
+                # filtered-out spans must not occupy drop-budget slots
+                def filtered(rec: dict) -> None:
+                    if min_ms and rec["duration_ms"] < min_ms:
+                        return
+                    if prefix and not rec["name"].startswith(prefix):
+                        return
+                    sink(rec)
+
+                return trace_mod.default.add_sink(filtered)
+
+            return StreamingBody(_sink_stream(
+                total, attach,
+                lambda rec: (json.dumps(rec) + "\n").encode())), None
         if path == "/v1/agent/maintenance" and method in ("PUT", "POST"):
             enable = q.get("enable", "true") == "true"
             a.set_maintenance(enable, q.get("reason", ""))
@@ -1231,42 +1332,27 @@ class HTTPApi:
             return res["State"], res.get("Index")
         if path == "/v1/agent/monitor":
             # LIVE log stream (logging/monitor/monitor.go): lines flush
-            # as they happen for ?duration= seconds (default 2, cap 60)
+            # as they happen for ?duration= seconds (default 2, cap
+            # 60). ?loglevel= filters like the reference's monitor
+            # (agent_endpoint.go AgentMonitor LogLevel) — validated
+            # BEFORE streaming starts, like the metrics stream's
+            # params: an error after the 200 header would corrupt the
+            # response.
             rpc("Internal.AgentRead", {})  # ACL: agent read
             from consul_tpu.utils import log as log_mod
 
             total = min(_dur(q.get("duration", "2s")), 60.0)
-
-            def monitor_stream():
-                import queue as queue_mod
-                import time as _t
-
-                lines: "queue_mod.Queue[str]" = queue_mod.Queue(
-                    maxsize=4096)
-
-                def sink(line: str) -> None:
-                    try:
-                        lines.put_nowait(line)
-                    except queue_mod.Full:
-                        pass  # log-drop semantics (agent/log-drop)
-
-                detach = log_mod.add_sink(sink)
-                end = _t.monotonic() + total
+            loglevel = q.get("loglevel") or None
+            if loglevel is not None:
                 try:
-                    while True:
-                        remaining = end - _t.monotonic()
-                        if remaining <= 0:
-                            return
-                        try:
-                            line = lines.get(
-                                timeout=min(remaining, 0.25))
-                        except queue_mod.Empty:
-                            continue
-                        yield (line + "\n").encode()
-                finally:
-                    detach()
+                    log_mod.level_no(loglevel)
+                except ValueError as exc:
+                    raise HTTPError(400, str(exc)) from exc
 
-            return StreamingBody(monitor_stream()), None
+            return StreamingBody(_sink_stream(
+                total,
+                lambda sink: log_mod.add_sink(sink, level=loglevel),
+                lambda line: (line + "\n").encode())), None
         if path == "/v1/operator/raft/transfer-leader" and \
                 method in ("PUT", "POST"):
             return rpc("Operator.RaftTransferLeader",

@@ -58,6 +58,24 @@ class ConsulClient:
     def get(self, path: str, **params) -> Any:
         return self._call("GET", path, params)[0]
 
+    def get_raw(self, path: str, timeout: float = 120.0,
+                **params) -> bytes:
+        """GET a streaming/raw endpoint's bytes UNPARSED (`_call`
+        json-decodes anything with a JSON content type, which a JSONL
+        stream or a monitor log window is not)."""
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in params.items() if v is not None})
+        url = f"{self.base}{path}" + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("X-Consul-Token", self.token)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise APIError(e.code,
+                           e.read().decode(errors="replace")) from e
+
     def get_with_index(self, path: str, **params) -> tuple[Any, int]:
         result, headers = self._call("GET", path, params)
         return result, int(headers.get("X-Consul-Index", 0))

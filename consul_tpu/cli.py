@@ -269,7 +269,9 @@ def _run_gossip_sim(cfg) -> int:
             print(f"==> gossip-sim={platform} chaos={chaos}: {n} virtual "
                   f"members on {jax.devices()[0].platform}")
             t0 = time.perf_counter()
-            rep = run_chaos(chaos, n=n)
+            # blackbox on: the chaos report carries decoded per-event
+            # totals for the tracked sample alongside the phase stats
+            rep = run_chaos(chaos, n=n, blackbox=True)
             watchdog.cancel()
             rep["wall_s"] = round(time.perf_counter() - t0, 2)
             print(json.dumps(rep, indent=2))
@@ -1062,44 +1064,246 @@ def cmd_peering(args) -> int:
     return 1
 
 
-def cmd_debug(args) -> int:
-    """Capture a diagnostic bundle (command/debug): self/members/
-    metrics/raft config/log window into a gzip tar. Every capture is
-    best-effort — a partial bundle always beats no bundle."""
+#: bundle members every capture must produce (content may be an error
+#: record — a partial bundle beats no bundle — but the FILE must exist
+#: and parse, which is what --self-check pins in CI)
+DEBUG_BUNDLE_REQUIRED = (
+    "manifest.json", "self.json", "members.json", "metrics.json",
+    "metrics.prom", "metrics_stream.jsonl", "spans.json",
+    "trace.perfetto.json", "raft.json", "host.json", "consul.log",
+)
+
+
+def _capture_flight_trace(nodes: int, rounds: int) -> dict:
+    """A small flight-recorded + black-box-traced sim run on the CPU
+    backend — the bundle's proof that the sim observability stack
+    works in THIS build, plus a ready-made trace/timeline sample for
+    whoever reads the archive."""
+    import jax
+
+    from consul_tpu.sim import (SimParams, blackbox, init_state,
+                                run_rounds_flight)
+    from consul_tpu.sim.flight import FLIGHT_COLUMNS
+    from consul_tpu.sim.metrics import blackbox_report
+
+    p = SimParams(n=nodes, loss=0.2, tcp_fallback=False)
+    tracked = blackbox.default_tracked(nodes, min(p.blackbox_k, nodes))
+    state, trace, bb = run_rounds_flight(
+        init_state(nodes), jax.random.key(0), p, rounds,
+        tracked=tracked)
+    import numpy as np
+
+    return {
+        "n": nodes, "rounds": rounds,
+        "columns": list(FLIGHT_COLUMNS),
+        "rows": np.asarray(trace, np.float64).round(6).tolist(),
+        "blackbox": blackbox_report(bb, p, trace=trace),
+    }
+
+
+def _capture_debug_bundle(c, duration: float, sim_nodes: int,
+                          sim_rounds: int) -> bytes:
+    """Assemble the debug archive (the reference's `consul debug`
+    capture set, plus the span/black-box layers this stack adds).
+    Every capture is best-effort — a failing endpoint contributes an
+    error record, never an absent file, so the manifest contract
+    --self-check validates holds even on a degraded agent."""
     import time as _t
 
     from consul_tpu.server.snapshot import tar_gz
+    from consul_tpu.version import __version__
 
-    c = _client(args)
-    # the agent caps the monitor window at 10s; record the EFFECTIVE one
-    duration = min(args.duration, 10.0)
+    errors: dict[str, str] = {}
 
-    def capture(fn):
+    def capture(name: str, fn):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001
+            errors[name] = str(e)
             return {"error": str(e)}
 
     captures = {
-        "self.json": capture(c.agent_self),
-        "members.json": capture(c.agent_members),
-        "metrics.json": capture(lambda: c.get("/v1/agent/metrics")),
-        "raft.json": capture(c.raft_configuration),
-        "host.json": {"CollectedAt": _t.strftime("%Y-%m-%dT%H:%M:%S"),
-                      "Duration": duration},
-        "consul.log": capture(lambda: c.get(
+        "self.json": capture("self.json", c.agent_self),
+        "members.json": capture("members.json", c.agent_members),
+        "metrics.json": capture("metrics.json",
+                                lambda: c.get("/v1/agent/metrics")),
+        # the prometheus dump and two metrics-stream snapshots give a
+        # RATE view (the JSON snapshot alone can't distinguish a busy
+        # agent from a long-lived one)
+        "metrics.prom": capture("metrics.prom", lambda: c.get_raw(
+            "/v1/agent/metrics", format="prometheus")),
+        "metrics_stream.jsonl": capture(
+            "metrics_stream.jsonl", lambda: c.get_raw(
+                "/v1/agent/metrics/stream", intervals=2,
+                interval=0.25)),
+        # recent spans, raw + perfetto (utils/trace.py ring via
+        # /v1/agent/trace) — the causal layer next to the counters
+        "spans.json": capture("spans.json",
+                              lambda: c.get("/v1/agent/trace")),
+        "trace.perfetto.json": capture(
+            "trace.perfetto.json",
+            lambda: c.get("/v1/agent/trace", format="perfetto")),
+        "raft.json": capture("raft.json", c.raft_configuration),
+        "host.json": capture("host.json",
+                             lambda: c.get("/v1/agent/host")),
+        "consul.log": capture("consul.log", lambda: c.get_raw(
             "/v1/agent/monitor", duration=f"{duration}s") or b""),
     }
-    files = {}
+    if sim_rounds > 0:
+        captures["flight.json"] = capture(
+            "flight.json",
+            lambda: _capture_flight_trace(sim_nodes, sim_rounds))
+    files: dict[str, bytes] = {}
     for name, data in captures.items():
         files[name] = data if isinstance(data, bytes) else (
             data if isinstance(data, str)
             else json.dumps(data, indent=2)).encode()
+    manifest = {
+        "version": __version__,
+        "agent": c.addr,
+        "captured_at": _t.strftime("%Y-%m-%dT%H:%M:%S"),
+        "duration_s": duration,
+        "required": list(DEBUG_BUNDLE_REQUIRED),
+        "files": {name: {"bytes": len(data),
+                         **({"error": errors[name]}
+                            if name in errors else {})}
+                  for name, data in files.items()},
+    }
+    files = {"manifest.json": json.dumps(manifest, indent=2).encode(),
+             **files}
+    return tar_gz(files)
+
+
+def _validate_debug_bundle(data: bytes) -> list[str]:
+    """Manifest-contract check for a captured bundle; returns the list
+    of violations (empty ⇒ valid). Shared by --self-check and tests —
+    capture must never rot silently."""
+    import gzip as _gzip
+    import io as _io
+    import tarfile as _tarfile
+
+    errors: list[str] = []
+    try:
+        with _gzip.GzipFile(fileobj=_io.BytesIO(data)) as gz:
+            with _tarfile.open(fileobj=_io.BytesIO(gz.read())) as tar:
+                members = {m.name: tar.extractfile(m).read()
+                           for m in tar.getmembers() if m.isfile()}
+    except Exception as e:  # noqa: BLE001
+        return [f"unreadable archive: {e}"]
+    if "manifest.json" not in members:
+        return ["manifest.json missing"]
+    try:
+        manifest = json.loads(members["manifest.json"])
+    except ValueError as e:
+        return [f"manifest.json unparseable: {e}"]
+    for name in manifest.get("required", []):
+        if name != "manifest.json" and name not in members:
+            errors.append(f"required file missing: {name}")
+    for name, meta in manifest.get("files", {}).items():
+        if name not in members:
+            errors.append(f"manifest lists absent file: {name}")
+            continue
+        if len(members[name]) != meta.get("bytes"):
+            errors.append(
+                f"{name}: size {len(members[name])} != manifest "
+                f"{meta.get('bytes')}")
+        if name.endswith(".json"):
+            try:
+                json.loads(members[name])
+            except ValueError as e:
+                errors.append(f"{name}: invalid JSON: {e}")
+        elif name.endswith(".jsonl"):
+            for i, line in enumerate(
+                    members[name].decode(errors="replace")
+                    .splitlines()):
+                if not line:
+                    continue
+                try:
+                    json.loads(line)
+                except ValueError as e:
+                    errors.append(f"{name}:{i + 1}: invalid JSON "
+                                  f"line: {e}")
+                    break
+    return errors
+
+
+def cmd_debug(args) -> int:
+    """Capture a diagnostic bundle (command/debug): agent identity,
+    metrics (snapshot + prometheus + stream), recent spans (raw and
+    perfetto), raft config, a monitor log window, and a small
+    flight-recorded sim trace, into one gzip tar with a validated
+    manifest. `--self-check` spins a throwaway dev agent, captures a
+    bundle from it, and validates the manifest — the CI smoke that
+    keeps capture from rotting."""
+    import time as _t
+
+    if getattr(args, "self_check", False):
+        return _debug_self_check(args)
+    c = _client(args)
+    # the agent caps the monitor window at 10s; record the EFFECTIVE one
+    duration = min(args.duration, 10.0)
+    bundle = _capture_debug_bundle(c, duration, args.sim_nodes,
+                                   args.sim_rounds)
     out = args.output or f"consul-debug-{int(_t.time())}.tar.gz"
     with open(out, "wb") as f:
-        f.write(tar_gz(files))
+        f.write(bundle)
+    problems = _validate_debug_bundle(bundle)
     print(f"Saved debug archive: {out}")
+    for p in problems:
+        print(f"warning: {p}", file=sys.stderr)
     return 0
+
+
+def _debug_self_check(args) -> int:
+    """`debug --self-check`: dev agent (ephemeral ports) -> capture ->
+    validate -> structured JSON verdict on stdout. rc 0 iff the bundle
+    honors the manifest contract."""
+    import tempfile
+    import time as _t
+
+    from consul_tpu.agent import Agent
+    from consul_tpu.api import ConsulClient
+
+    t0 = _t.perf_counter()
+    a = Agent(config_mod.load(dev=True,
+                              overrides={"node_name": "debug-check"}))
+    try:
+        a.start(serve_dns=False)
+        deadline = _t.time() + 30
+        while not (a.server is not None and a.server.is_leader()):
+            if _t.time() > deadline:
+                print(json.dumps({"debug_self_check": "error",
+                                  "error": "dev agent never won "
+                                           "leadership"}))
+                return 1
+            _t.sleep(0.1)
+        c = ConsulClient(a.http.addr)
+        c.kv_put("debug/self-check", b"1")  # seed spans + metrics
+        bundle = _capture_debug_bundle(c, duration=0.3,
+                                       sim_nodes=args.sim_nodes,
+                                       sim_rounds=args.sim_rounds)
+    finally:
+        a.shutdown()
+    problems = _validate_debug_bundle(bundle)
+    if args.output:
+        out = args.output
+        with open(out, "wb") as f:
+            f.write(bundle)
+    else:
+        with tempfile.NamedTemporaryFile(
+                prefix="consul-debug-check-", suffix=".tar.gz",
+                delete=False) as f:
+            f.write(bundle)
+            out = f.name
+    verdict = {
+        "debug_self_check": "ok" if not problems else "invalid",
+        "bundle": out,
+        "bundle_bytes": len(bundle),
+        "problems": problems,
+        "wall_s": round(_t.perf_counter() - t0, 2),
+    }
+    print(json.dumps(verdict, indent=2))
+    return 0 if not problems else 1
 
 
 def cmd_tls(args) -> int:
@@ -2017,6 +2221,16 @@ def build_parser() -> argparse.ArgumentParser:
     dbg = sub.add_parser("debug")
     dbg.add_argument("-duration", type=float, default=2.0)
     dbg.add_argument("-output", default=None)
+    # the bundled flight trace's sim size; -sim-rounds 0 disables the
+    # sim capture entirely (no jax import on constrained hosts)
+    dbg.add_argument("-sim-nodes", dest="sim_nodes", type=int,
+                     default=256)
+    dbg.add_argument("-sim-rounds", dest="sim_rounds", type=int,
+                     default=20)
+    dbg.add_argument("-self-check", "--self-check", dest="self_check",
+                     action="store_true",
+                     help="capture a bundle from a throwaway dev agent "
+                          "and validate its manifest (CI smoke)")
     dbg.set_defaults(fn=cmd_debug)
 
     intent = sub.add_parser("intention")

@@ -23,6 +23,7 @@ from consul_tpu.gossip.swim import Memberlist, MemberlistDelegate, NodeState
 from consul_tpu.gossip.transport import Transport
 from consul_tpu.types import Coordinate, MemberStatus
 from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import trace as trace_mod
 
 
 class EventType(str, enum.Enum):
@@ -401,15 +402,22 @@ class Serf(MemberlistDelegate):
         # dispatch latency per event TYPE (bounded label set: the
         # EventType enum) — the agent's whole control plane hangs off
         # these handlers (server_serf.go's eventCh consumer), so a slow
-        # one shows up here before it shows up as a stuck cluster
+        # one shows up here before it shows up as a stuck cluster. The
+        # span records WHICH dispatch was slow (utils/trace.py ring);
+        # the timer keeps the aggregate percentiles.
         start = telemetry.time_now()
-        for fn in list(self._handlers):
-            try:
-                fn(ev)
-            except Exception as e:  # noqa: BLE001
-                self.log.error("event handler error on %s: %s", ev.type, e)
-                self.metrics.incr("serf.events.handler_error",
-                                  labels={"type": ev.type.value})
+        with trace_mod.default.span("serf.event.dispatch",
+                                    type=ev.type.value,
+                                    handlers=len(self._handlers)) as sp:
+            for fn in list(self._handlers):
+                try:
+                    fn(ev)
+                except Exception as e:  # noqa: BLE001
+                    self.log.error("event handler error on %s: %s",
+                                   ev.type, e)
+                    sp.tag(handler_error=True)
+                    self.metrics.incr("serf.events.handler_error",
+                                      labels={"type": ev.type.value})
         self.metrics.measure_since("serf.events.dispatch", start,
                                    {"type": ev.type.value})
 

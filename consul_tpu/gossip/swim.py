@@ -32,6 +32,7 @@ from consul_tpu.gossip.broadcast import TransmitLimitedQueue
 from consul_tpu.gossip.transport import MAX_PACKET_SIZE, Transport
 from consul_tpu.types import MemberStatus
 from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import trace as trace_mod
 
 
 # memberlist protocol versioning (memberlist ProtocolVersionMin/Max):
@@ -393,24 +394,39 @@ class Memberlist:
         seq = self._next_seq()
         sent_at = self._now()
         acked = {"ok": False}
-
-        def on_ack(payload: dict[str, Any]) -> None:
-            acked["ok"] = True
-            self._awareness_delta(-1)
-            self.delegate.notify_ack(target.name, self._now() - sent_at,
-                                     payload)
+        # probe lifecycle span (utils/trace.py): begun here, finished
+        # by whichever completion wins — direct ack, indirect ack, or
+        # the final timeout that starts a suspicion
+        span = trace_mod.default.begin("swim.probe", target=target.name)
 
         # Lifeguard: ack deadline scaled by local health (state.go
         # probeNode), floored at the configured timeout and widened for
         # far targets when the delegate knows the coordinate-estimated
         # RTT — a cross-DC probe must not eat the suspicion machinery's
         # budget just for being far away
-        timeout = cfg.scaled_probe_timeout(self.awareness)
+        base_timeout = cfg.scaled_probe_timeout(self.awareness)
+        timeout = base_timeout
         est = self.delegate.estimate_rtt(target.name)
         if est is not None and est > 0:
             timeout = max(timeout,
                           min(est * RTT_TIMEOUT_MULT, cfg.probe_interval)
                           * (self.awareness + 1))
+
+        def on_ack(payload: dict[str, Any]) -> None:
+            acked["ok"] = True
+            rtt = self._now() - sent_at
+            rescued = timeout > base_timeout and rtt > base_timeout
+            if rescued:
+                # the ack landed AFTER the flat Lifeguard deadline but
+                # inside the RTT-widened one: without the coordinate
+                # estimate this probe would have gone indirect and fed
+                # the suspicion machinery — the counter that makes the
+                # PR 3 coords win visible in /v1/agent/metrics
+                self.metrics.incr("swim.probe.rtt_rescued")
+            self._awareness_delta(-1)
+            span.finish(outcome="ack", rtt_ms=round(rtt * 1000.0, 3),
+                        rescued=rescued)
+            self.delegate.notify_ack(target.name, rtt, payload)
 
         def on_timeout() -> None:
             if acked["ok"]:
@@ -418,7 +434,8 @@ class Memberlist:
             # phase 2: k indirect probes + stream fallback
             self._awareness_delta(1)
             self.metrics.incr("memberlist.probe.timeout")
-            self._indirect_probe(target, seq, acked)
+            span.tag(direct_timeout=True)
+            self._indirect_probe(target, seq, acked, span)
 
         self._register_ack(seq, on_ack, on_timeout, timeout)
         self._send(target.addr, m.encode(m.PING, {
@@ -426,7 +443,7 @@ class Memberlist:
             "addr": self.transport.addr}))
 
     def _indirect_probe(self, target: NodeState, orig_seq: int,
-                        acked: dict) -> None:
+                        acked: dict, span=None) -> None:
         cfg = self.config
         with self._lock:
             peers = [ns for n, ns in self._members.items()
@@ -438,6 +455,8 @@ class Memberlist:
 
         def on_ack(payload: dict[str, Any]) -> None:
             acked["ok"] = True
+            if span is not None:
+                span.finish(outcome="indirect_ack", relays=len(peers))
 
         remaining = max(cfg.probe_interval - cfg.probe_timeout, 0.05)
 
@@ -445,6 +464,8 @@ class Memberlist:
             if acked["ok"]:
                 return
             self.metrics.incr("memberlist.probe.failed")
+            if span is not None:
+                span.finish(outcome="failed", relays=len(peers))
             self._suspect_node(target.name, target.incarnation, self.name)
 
         self._register_ack(seq, on_ack, on_final_timeout, remaining)

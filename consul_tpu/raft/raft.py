@@ -31,6 +31,7 @@ from consul_tpu.raft.transport import RaftTransport
 # MAX_FRAME (64MB) so a replication batch of chunks still frames.
 CHUNK_SIZE = 4 * 1024 * 1024
 from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import trace as trace_mod
 from consul_tpu.utils.clock import Clock, RealTimers, SimClock
 
 
@@ -207,6 +208,18 @@ class RaftNode:
         re-raises per-op — one bad command must not poison its
         batchmates). Batch-level failures (not leader, timeout) raise.
         """
+        # span covers append -> replicate -> commit-wait. Direct
+        # callers see it nested under their own spans; the server's
+        # group-commit batcher calls from its raft-batcher thread, so
+        # there it roots that thread's timeline while the HTTP side's
+        # wait shows up as raft.commit_wait (server.py _ApplyBatcher)
+        # and the FSM side as raft.fsm.apply on the applier thread —
+        # the three-thread chain a slow-write postmortem walks
+        with trace_mod.default.span("raft.apply", entries=len(datas)):
+            return self._apply_many_impl(datas, timeout)
+
+    def _apply_many_impl(self, datas: list[bytes],
+                           timeout: float = 10.0) -> list[Any]:
         with self._lock:
             if self.role != Role.LEADER or self._stopped:
                 raise NotLeader(self.leader_id)
@@ -1097,11 +1110,15 @@ class RaftNode:
                 self._chunks.clear()
             if e["kind"] == "cmd" and e["data"]:
                 start = telemetry.time_now()
-                try:
-                    result = self.apply_fn(e["data"], idx)
-                except Exception as ex:  # noqa: BLE001
-                    self.log.error("fsm apply failed at %d: %s", idx, ex)
-                    result = ex
+                with trace_mod.default.span("raft.fsm.apply",
+                                            index=idx) as sp:
+                    try:
+                        result = self.apply_fn(e["data"], idx)
+                    except Exception as ex:  # noqa: BLE001
+                        self.log.error("fsm apply failed at %d: %s",
+                                       idx, ex)
+                        sp.tag(error=type(ex).__name__)
+                        result = ex
                 # commit->apply wall time per entry (the reference's
                 # consul.raft.fsm.apply) — the number that explains a
                 # growing commit/applied gap
@@ -1129,12 +1146,16 @@ class RaftNode:
                 if all(p is not None for p in buf):
                     del self._chunks[cid]
                     start = telemetry.time_now()
-                    try:
-                        result = self.apply_fn(b"".join(buf), idx)
-                    except Exception as ex:  # noqa: BLE001
-                        self.log.error("fsm apply (chunked) failed "
-                                       "at %d: %s", idx, ex)
-                        result = ex
+                    with trace_mod.default.span(
+                            "raft.fsm.apply", index=idx,
+                            chunked=True) as sp:
+                        try:
+                            result = self.apply_fn(b"".join(buf), idx)
+                        except Exception as ex:  # noqa: BLE001
+                            self.log.error("fsm apply (chunked) failed "
+                                           "at %d: %s", idx, ex)
+                            sp.tag(error=type(ex).__name__)
+                            result = ex
                     self.metrics.measure_since("raft.fsm.apply", start)
                     if self.role == Role.LEADER:
                         self._apply_results[idx] = result

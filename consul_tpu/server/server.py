@@ -31,6 +31,7 @@ from consul_tpu.state.fsm import encode_command
 from consul_tpu.types import (CheckStatus, MemberStatus, SERF_CHECK_ID,
                               SERF_CHECK_NAME)
 from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import trace as trace_mod
 from consul_tpu.utils.ratelimit import RateLimitError, RateLimitHandler
 from consul_tpu.utils.clock import RealTimers
 from consul_tpu.utils.duration import parse_duration
@@ -77,7 +78,16 @@ class _ApplyBatcher:
             done.set()
 
         self.apply_async(data, cb)
-        if not done.wait(timeout):
+        # span on the CALLER thread: under an HTTP write it nests in
+        # that request's http.request span and measures the time spent
+        # parked on the group-commit queue — the batcher's own
+        # raft.apply span (raft-batcher thread) and the applier's
+        # raft.fsm.apply span carry the other two thirds of the write's
+        # wall time (utils/trace.py; cross-thread, correlated by time)
+        with trace_mod.default.span("raft.commit_wait",
+                                    bytes=len(data)):
+            ok = done.wait(timeout)
+        if not ok:
             raise RPCError("apply timed out in commit queue")
         result = slot[0]
         if isinstance(result, Exception):

@@ -61,6 +61,10 @@ from consul_tpu.sim.topology import (Topology, TopologyParams,
 from consul_tpu.sim.coords import (CoordState, init_coords, vivaldi_step,
                                    estimate_rtt, nearest_k,
                                    coordinate_updates)
+from consul_tpu.sim.blackbox import (BlackboxState, init_blackbox,
+                                     default_tracked, decode_timeline,
+                                     event_totals, suspicion_episodes,
+                                     to_perfetto)
 from consul_tpu.sim.mesh import (make_sharded_run, make_mesh,
                                  make_multidc_run, make_segmented_run)
 from consul_tpu.sim.views import (ViewState, init_views, views_round,
@@ -77,6 +81,9 @@ __all__ = [
     "sample_rtt",
     "CoordState", "init_coords", "vivaldi_step", "estimate_rtt",
     "nearest_k", "coordinate_updates",
+    "BlackboxState", "init_blackbox", "default_tracked",
+    "decode_timeline", "event_totals", "suspicion_episodes",
+    "to_perfetto",
     "make_sharded_run", "make_mesh",
     "make_multidc_run", "make_segmented_run",
     "ViewState", "init_views", "views_round", "run_views",

@@ -1,0 +1,344 @@
+"""Black-box event tracer: per-agent on-device event rings for the sim.
+
+The flight recorder (sim/flight.py) answers population questions —
+live/suspect fractions, per-window counter deltas — but not causal
+ones: *why* did agent X get falsely suspected, which probe →
+indirect-probe → refutation race lost, which fault phase triggered the
+incarnation storm. This module is the per-agent layer: K sampled
+("tracked") agents each get an on-device ``[R, 4]`` int32 ring of
+``(round, event_code, peer, detail)`` records plus a cursor, carried
+through the engines' existing ``lax.scan``:
+
+  * event codes live in sim/registry.py (BLACKBOX_EVENTS — the tuple
+    index IS the on-device code), shared with the host-side decoder so
+    the two cannot drift (pinned by the registry layout digest test);
+  * rings are written ONLY inside the flight recorder's decimation
+    ``lax.cond`` (flight.maybe_record): skipped rounds skip all ring
+    work, so black-box overhead rides the same budget as the trace row
+    — at stride 1 every round's events are captured, at stride k the
+    recorder samples window-boundary transitions (an agent suspected
+    AND refuted inside one window shows neither; causal timelines want
+    stride 1, long perf runs want the default stride);
+  * state-machine events (suspect start/confirm, refute, declare,
+    churn, incarnation bumps) are derived from the tracked agents'
+    state DIFF between recorded rounds — the same derivation on the
+    XLA and Pallas engines, which is what makes their rings comparable
+    (the Mosaic kernel is untouched; the Pallas runner diffs the
+    kernel's output blocks exactly like flight/coords). Prober-side
+    probe lifecycle events (ack / timeout / indirect fan-out /
+    coords-deadline gating) additionally ride the XLA round body's own
+    masks (registry.BLACKBOX_PROBE_EVENTS — XLA engines only, the
+    kernel's probe draws never leave VMEM);
+  * everything returns in ONE end-of-run ``device_get``: a K=64,
+    R=256 ring set is 256KB — noise next to the state tensors.
+
+Host-side, ``decode_timeline`` rebuilds per-agent chronological
+timelines (ring unwrap + code → name), ``event_totals`` aggregates
+them (cross-checked against flight counter columns in
+sim/metrics.blackbox_report and tests/test_blackbox.py), and
+``to_perfetto`` exports Chrome-trace JSON — suspicion windows as
+duration spans, everything else as instants — so sim timelines open in
+the same Perfetto/chrome://tracing viewer as ``bench.py --profile``
+XLA captures and the real agent's span tracer (utils/trace.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.sim.registry import (BLACKBOX_EVENTS,
+                                     BLACKBOX_PROBE_EVENTS,
+                                     BLACKBOX_RECORD_FIELDS)
+from consul_tpu.sim.state import ALIVE, DEAD, LEFT, SUSPECT
+
+#: decoder tables — index IS the on-device event code
+EVENT_NAMES = BLACKBOX_EVENTS
+EV = {name: i for i, name in enumerate(EVENT_NAMES)}
+RECORD_FIELDS = BLACKBOX_RECORD_FIELDS
+N_REC = len(RECORD_FIELDS)
+
+#: defaults mirrored by SimParams.blackbox_k / blackbox_ring
+DEFAULT_TRACKED_K = 64
+DEFAULT_RING_LEN = 256
+
+
+class BlackboxState(NamedTuple):
+    """Per-run ring state (a jit-traceable pytree, carried in the
+    engines' scan). ``count`` is the TOTAL events emitted per agent —
+    the write slot is ``count % ring_len``, so the ring holds the most
+    recent ``ring_len`` records and the decoder can report how many
+    older ones wrapped away. ``prev_*`` hold the tracked agents' state
+    slices at the LAST recorded round (K-sized — the recorder never
+    snapshots full [N] state)."""
+
+    tracked: jnp.ndarray      # [K] int32 — tracked node ids
+    ring: jnp.ndarray         # [K, R, 4] int32 — event records
+    count: jnp.ndarray        # [K] int32 — total events emitted
+    prev_status: jnp.ndarray  # [K] int32
+    prev_inc: jnp.ndarray     # [K] int32
+    prev_conf: jnp.ndarray    # [K] int32
+    prev_up: jnp.ndarray      # [K] bool
+    last_phase: jnp.ndarray   # 0-d int32 — for phase_enter edges
+
+
+class ProbeEvents(NamedTuple):
+    """One round's prober-side probe lifecycle, as [N] masks straight
+    from the XLA round body (round._round_core). ``late``/``pair_j``/
+    ``rtt_us`` are None outside coords mode (trace-time gating — this
+    tuple is built and consumed within one round, never carried)."""
+
+    ack: jnp.ndarray               # [N] bool — probe round-trip done
+    failed: jnp.ndarray            # [N] bool — all channels missed
+    late: Optional[jnp.ndarray]    # [N] bool — lost the deadline race
+    pair_j: Optional[jnp.ndarray]  # [N] int32 — this round's target
+    rtt_us: Optional[jnp.ndarray]  # [N] int32 — observed RTT (µs)
+
+
+def default_tracked(n: int, k: int = DEFAULT_TRACKED_K) -> jnp.ndarray:
+    """K evenly spaced node ids. Even spacing intersects every fault
+    range selector (faults.py primitives address contiguous [lo, hi)
+    blocks), so a default-tracked run always watches some victims."""
+    k = min(k, n)
+    return jnp.asarray((np.arange(k) * (n // k)).astype(np.int32))
+
+
+def init_blackbox(state, tracked, ring_len: int = DEFAULT_RING_LEN
+                  ) -> BlackboxState:
+    """Fresh rings for `tracked` (a [K] int32 index array) seeded with
+    the run's initial state (so round-0 diffs are real transitions)."""
+    tracked = jnp.asarray(tracked, jnp.int32)
+    k = tracked.shape[0]
+    return BlackboxState(
+        tracked=tracked,
+        ring=jnp.zeros((k, ring_len, N_REC), jnp.int32),
+        count=jnp.zeros((k,), jnp.int32),
+        prev_status=state.status.reshape(-1)[tracked].astype(jnp.int32),
+        prev_inc=state.incarnation.reshape(-1)[tracked],
+        prev_conf=state.susp_conf.reshape(-1)[tracked].astype(jnp.int32),
+        prev_up=state.up.reshape(-1)[tracked].astype(jnp.int32) != 0,
+        last_phase=jnp.int32(-1),
+    )
+
+
+def _emit(ring, count, mask, code: int, round_idx, peer, detail):
+    """Append one record per tracked agent where `mask` — at the
+    agent's cursor slot (count % R), bumping its count."""
+    k = ring.shape[0]
+    rows = jnp.arange(k, dtype=jnp.int32)
+    slot = count % ring.shape[1]
+    rec = jnp.stack([
+        jnp.broadcast_to(jnp.asarray(round_idx, jnp.int32), (k,)),
+        jnp.full((k,), code, jnp.int32),
+        jnp.broadcast_to(jnp.asarray(peer, jnp.int32), (k,)),
+        jnp.broadcast_to(jnp.asarray(detail, jnp.int32), (k,)),
+    ], axis=-1)
+    cur = ring[rows, slot]
+    ring = ring.at[rows, slot].set(jnp.where(mask[:, None], rec, cur))
+    return ring, count + mask.astype(jnp.int32)
+
+
+def record(bb: BlackboxState, *, round_idx, phase, status, incarnation,
+           susp_conf, up, probe: Optional[ProbeEvents] = None,
+           indirect_checks: int = 0) -> BlackboxState:
+    """Write one recorded round's events into the rings (on-device).
+
+    Call ONLY inside the flight recorder's decimation cond — that
+    placement is the overhead contract. `status`/`incarnation`/
+    `susp_conf`/`up` are the post-round population arrays (flat [N] or
+    the Pallas runner's packed 2-D blocks; gathered at `bb.tracked`
+    here). `round_idx` is the ABSOLUTE protocol round (0-based,
+    including any warm-start offset in state.round_idx — rings from
+    chained runs stay on one timeline); `phase` the active FaultPlan
+    phase (-1 without a plan). `probe` adds the XLA round body's
+    prober-side lifecycle events.
+
+    Events land in registry emit order (churn → probe lifecycle →
+    suspicion machinery), which keeps one round's records causally
+    readable inside a ring."""
+    t = bb.tracked
+    cur_status = status.reshape(-1)[t].astype(jnp.int32)
+    cur_inc = incarnation.reshape(-1)[t].astype(jnp.int32)
+    cur_conf = susp_conf.reshape(-1)[t].astype(jnp.int32)
+    cur_up = up.reshape(-1)[t].astype(jnp.int32) != 0
+    ring, count = bb.ring, bb.count
+    phase = jnp.asarray(phase, jnp.int32)
+
+    k = t.shape[0]
+    went_down = bb.prev_up & ~cur_up
+    suspectish = (bb.prev_status == SUSPECT) | (bb.prev_status == DEAD)
+    masks: dict[str, Any] = {
+        "phase_enter": jnp.broadcast_to(phase != bb.last_phase, (k,)),
+        "crash": went_down & (cur_status != LEFT),
+        "leave": went_down & (cur_status == LEFT),
+        "rejoin": ~bb.prev_up & cur_up,
+        "suspect_start": (bb.prev_status != SUSPECT)
+        & (cur_status == SUSPECT),
+        "suspect_confirm": (bb.prev_status == SUSPECT)
+        & (cur_status == SUSPECT) & (cur_conf > bb.prev_conf),
+        "refute": bb.prev_up & cur_up & suspectish
+        & (cur_status == ALIVE) & (cur_inc > bb.prev_inc),
+        "inc_bump": cur_inc > bb.prev_inc,
+        "declare_dead": (bb.prev_status == SUSPECT)
+        & (cur_status == DEAD),
+    }
+    details = {
+        "phase_enter": phase,
+        "suspect_confirm": cur_conf,
+        "refute": cur_inc,
+        "inc_bump": cur_inc,
+        "declare_dead": cur_up.astype(jnp.int32),  # 1 ⇒ false positive
+    }
+    peers: dict[str, Any] = {}
+    if probe is not None:
+        masks["probe_ack"] = probe.ack.reshape(-1)[t]
+        masks["probe_timeout"] = probe.failed.reshape(-1)[t]
+        masks["indirect_fanout"] = masks["probe_timeout"]
+        details["indirect_fanout"] = jnp.int32(indirect_checks)
+        if probe.late is not None:
+            masks["coord_late"] = probe.late.reshape(-1)[t]
+        if probe.pair_j is not None:
+            pj = probe.pair_j.reshape(-1)[t]
+            for name in ("probe_ack", "probe_timeout",
+                         "indirect_fanout", "coord_late"):
+                peers[name] = pj
+        if probe.rtt_us is not None:
+            ru = probe.rtt_us.reshape(-1)[t]
+            details["probe_ack"] = ru
+            details["coord_late"] = ru
+
+    for code, name in enumerate(EVENT_NAMES):
+        if name not in masks:
+            continue
+        ring, count = _emit(
+            ring, count, masks[name], code, round_idx,
+            peers.get(name, jnp.int32(-1)),
+            details.get(name, jnp.int32(0)))
+
+    return BlackboxState(
+        tracked=t, ring=ring, count=count, prev_status=cur_status,
+        prev_inc=cur_inc, prev_conf=cur_conf, prev_up=cur_up,
+        last_phase=phase)
+
+
+# ---------------------------------------------------------- host side
+
+
+def decode_timeline(bb: BlackboxState, probe_interval: float = 1.0
+                    ) -> dict[int, dict[str, Any]]:
+    """ONE end-of-run fetch → per-agent chronological timelines.
+
+    Returns ``{node_id: {"events": [...], "dropped": n}}`` where each
+    event is ``{"round", "t", "event", "peer", "detail"}`` (``t`` =
+    the recorded round's END, matching the flight trace's t column)
+    and ``dropped`` counts records that wrapped out of the ring (the
+    OLDEST go first — the ring keeps the most recent R)."""
+    tracked = np.asarray(jax.device_get(bb.tracked))
+    ring = np.asarray(jax.device_get(bb.ring))
+    count = np.asarray(jax.device_get(bb.count))
+    r_len = ring.shape[1]
+    out: dict[int, dict[str, Any]] = {}
+    for k, node in enumerate(tracked):
+        c = int(count[k])
+        if c <= r_len:
+            recs = ring[k, :c]
+            dropped = 0
+        else:
+            start = c % r_len
+            recs = np.concatenate([ring[k, start:], ring[k, :start]])
+            dropped = c - r_len
+        events = [{
+            "round": int(rd), "t": float((rd + 1) * probe_interval),
+            "event": EVENT_NAMES[int(ev)], "peer": int(peer),
+            "detail": int(det),
+        } for rd, ev, peer, det in recs]
+        out[int(node)] = {"events": events, "dropped": dropped}
+    return out
+
+
+def event_totals(timelines: dict[int, dict[str, Any]]
+                 ) -> dict[str, int]:
+    """Total events per code across all tracked agents — the side the
+    flight recorder's aggregate counters are cross-checked against
+    (sim/metrics.blackbox_report)."""
+    totals = {name: 0 for name in EVENT_NAMES}
+    for tl in timelines.values():
+        for ev in tl["events"]:
+            totals[ev["event"]] += 1
+    return totals
+
+
+def suspicion_episodes(timeline: dict[str, Any]) -> list[dict[str, Any]]:
+    """Fold one agent's events into suspicion episodes: each opens at
+    a suspect_start and closes at the next refute or declare_dead
+    (open-ended if the run finished mid-suspicion). The causal chain a
+    false-positive postmortem reads: which round the suspicion opened,
+    how many confirmations piled on, and which side won the race."""
+    episodes: list[dict[str, Any]] = []
+    open_ep: Optional[dict[str, Any]] = None
+    for ev in timeline["events"]:
+        name = ev["event"]
+        if name == "suspect_start":
+            open_ep = {"start_round": ev["round"], "start_t": ev["t"],
+                       "confirms": 0, "outcome": None,
+                       "end_round": None, "end_t": None}
+            episodes.append(open_ep)
+        elif open_ep is not None and name == "suspect_confirm":
+            open_ep["confirms"] = ev["detail"]
+        elif open_ep is not None and name in ("refute", "declare_dead"):
+            open_ep["outcome"] = name
+            open_ep["end_round"] = ev["round"]
+            open_ep["end_t"] = ev["t"]
+            if name == "declare_dead":
+                open_ep["false_positive"] = bool(ev["detail"])
+            open_ep = None
+    return episodes
+
+
+def to_perfetto(timelines: dict[int, dict[str, Any]],
+                pid: int = 1, process_name: str = "consul-tpu-sim",
+                time_scale: float = 1e6) -> dict[str, Any]:
+    """Chrome-trace JSON (catapult TraceEvent format) from decoded
+    timelines: one thread per tracked agent, suspicion episodes as
+    complete ("X") duration spans, every raw event as a thread-scoped
+    instant. `time_scale` maps sim SECONDS to trace µs (1e6 ⇒ 1 sim
+    second renders as one second). Open the result in ui.perfetto.dev
+    or chrome://tracing next to a `bench.py --profile` capture or a
+    `utils/trace.py` span export — one viewer, all three layers."""
+    events: list[dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": process_name},
+    }]
+    for node in sorted(timelines):
+        tl = timelines[node]
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": node, "args": {"name": f"agent-{node}"}})
+        for ep in suspicion_episodes(tl):
+            end_t = ep["end_t"]
+            if end_t is None:
+                continue  # open at run end — no honest duration
+            events.append({
+                "name": "suspected", "ph": "X", "pid": pid,
+                "tid": node, "ts": ep["start_t"] * time_scale,
+                "dur": max((end_t - ep["start_t"]) * time_scale, 1.0),
+                "args": {"outcome": ep["outcome"],
+                         "confirms": ep["confirms"],
+                         **({"false_positive": ep["false_positive"]}
+                            if "false_positive" in ep else {})},
+            })
+        for ev in tl["events"]:
+            events.append({
+                "name": ev["event"], "ph": "i", "s": "t", "pid": pid,
+                "tid": node, "ts": ev["t"] * time_scale,
+                "args": {"round": ev["round"], "peer": ev["peer"],
+                         "detail": ev["detail"]},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: host-side view of which codes the Pallas post-pass can record
+TRANSITION_EVENTS = tuple(n for n in EVENT_NAMES
+                          if n not in BLACKBOX_PROBE_EVENTS)

@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consul_tpu.sim import registry
 from consul_tpu.sim.state import (DEAD, STATS_FIELDS, SUSPECT, SimStats,
                                   stats_vector)
 
@@ -53,28 +54,17 @@ from consul_tpu.sim.state import (DEAD, STATS_FIELDS, SUSPECT, SimStats,
 #: keeping per-window resolution well under any suspicion timeout
 DEFAULT_RECORD_EVERY = 10
 
-#: instantaneous columns — the state at the recorded round's end
-GAUGE_COLUMNS = (
-    "t",                  # sim time (s) at the recorded round's end
-    "live_frac",          # mean(up) — ground-truth process liveness
-    "mean_informed",      # rumor-spread informed fraction, cluster mean
-    "suspect_frac",       # fraction of nodes currently rumored SUSPECT
-    "wrong_frac",         # live nodes rumored SUSPECT/DEAD (FP pressure)
-    "mean_local_health",  # Lifeguard awareness, cluster mean
-    "max_local_health",   # Lifeguard awareness, worst node
-    "inc_bumps",          # cumulative incarnation bumps (sum inc; f32 —
-    #                       exact below 2^24 total bumps)
-    "fault_phase",        # active FaultPlan phase index (-1: no plan)
-)
+#: instantaneous columns — the state at the recorded round's end.
+#: The NAMES (and their order — the device layout) live in the shared
+#: sim/registry.py, alongside the black-box event codes: one registry,
+#: one layout-digest test, no silent column drift between the device
+#: writers here and any host-side decoder.
+GAUGE_COLUMNS = registry.FLIGHT_GAUGE_COLUMNS
 
 #: network-coordinate quality columns (sim/coords.coord_metrics order).
 #: Gauge semantics: the recorded round's value. Zero-filled when the
 #: run carries no CoordState, so the row layout never changes shape.
-COORD_COLUMNS = (
-    "rtt_err_med",   # median relative RTT-estimate error vs ground truth
-    "rtt_err_p99",   # p99 relative RTT-estimate error
-    "coord_drift",   # mean Vivaldi position moved this round (s)
-)
+COORD_COLUMNS = registry.FLIGHT_COORD_COLUMNS
 
 #: full row layout: gauges, per-window SimStats deltas, coord quality
 FLIGHT_COLUMNS = GAUGE_COLUMNS + STATS_FIELDS + COORD_COLUMNS

@@ -211,6 +211,53 @@ def trace_report(trace, p: SimParams, plan=None, record_every: int = 1,
             "phases": phases}
 
 
+def blackbox_report(bb, p: SimParams, trace=None,
+                    record_every: int = 1) -> dict:
+    """Decoded black-box summary for a scenario report: per-code event
+    totals across the tracked agents, ring-wrap accounting, and — when
+    the run tracked EVERY agent at stride 1 with no ring drops and the
+    run's flight trace is supplied — an exact cross-check of ring
+    totals against the recorder's aggregate counter columns. The two
+    observability layers share one PRNG stream per run, so any
+    disagreement is a decoder/layout bug, not noise; the per-run
+    cross-check makes that class of bug self-announcing in every chaos
+    report instead of latent until the next postmortem."""
+    from consul_tpu.sim import blackbox as blackbox_mod
+    from consul_tpu.sim.flight import trace_columns
+
+    timelines = blackbox_mod.decode_timeline(bb, p.probe_interval)
+    totals = blackbox_mod.event_totals(timelines)
+    dropped = sum(tl["dropped"] for tl in timelines.values())
+    out: dict = {
+        "tracked": len(timelines),
+        "ring_len": int(bb.ring.shape[1]),
+        "events": {k: v for k, v in totals.items() if v},
+        "dropped_events": dropped,
+    }
+    exhaustive = (len(timelines) == p.n and record_every == 1
+                  and dropped == 0)
+    if trace is not None and exhaustive:
+        cols = trace_columns(trace)
+        pairs = {
+            "suspect_start": ("suspicions",
+                              int(cols["suspicions"].sum())),
+            "refute": ("refutes", int(cols["refutes"].sum())),
+            "crash": ("crashes", int(cols["crashes"].sum())),
+            "rejoin": ("rejoins", int(cols["rejoins"].sum())),
+            "leave": ("leaves", int(cols["leaves"].sum())),
+            "declare_dead": ("false_positives+true_deaths",
+                             int(cols["false_positives"].sum()
+                                 + cols["true_deaths_declared"].sum())),
+        }
+        out["crosscheck"] = {
+            ev: {"ring": totals[ev], "flight": flight_total,
+                 "column": col, "agree": totals[ev] == flight_total}
+            for ev, (col, flight_total) in pairs.items()}
+        out["crosscheck_agree"] = all(
+            c["agree"] for c in out["crosscheck"].values())
+    return out
+
+
 def propagation_curve(trace: jnp.ndarray, probe_interval: float,
                       threshold: float = 0.9999) -> tuple[np.ndarray, float]:
     """From a per-round informed-fraction trace of one rumor, the time (s)

@@ -457,7 +457,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                            interpret: bool = False,
                            plan: Optional[CompiledFaultPlan] = None,
                            flight_every: Optional[int] = None,
-                           coords: bool = False):
+                           coords: bool = False,
+                           blackbox: bool = False):
     """Compiled hot loop using the fused Pallas round kernel.
 
     Covers the full protocol model including churn, slow-node
@@ -491,13 +492,30 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     coordinate-trace conformance asserted in tests/test_coords.py).
     p.coords_timeout is refused — the RTT-deadline feedback needs the
     per-pair gate inside the round body, which only the XLA engines
-    have."""
+    have.
+
+    `blackbox=True` arms the black-box event tracer (sim/blackbox.py):
+    the runner takes a `tracked` [K] int32 id array after its other
+    arguments and appends the final BlackboxState to its returns. Ring
+    writes are plain jnp gathers/scatters over the KERNEL'S OUTPUT
+    blocks inside the flight recorder's decimation cond (the Mosaic
+    kernel is untouched), so the rings carry the state-transition
+    events (registry.BLACKBOX_EVENTS minus BLACKBOX_PROBE_EVENTS) —
+    the prober-side probe lifecycle is internal to the kernel's
+    on-chip PRNG and is an XLA-engine-only feature. Requires
+    flight_every (the tracer shares the recorder's cond by design)."""
     fault = plan is not None
     with_coords = bool(coords)
+    with_bb = bool(blackbox)
     if flight_every is not None and not p.collect_stats:
         raise ValueError(
             "flight recording rides the kernel's stats lanes; build "
             "SimParams with collect_stats=True")
+    if with_bb and flight_every is None:
+        raise ValueError(
+            "the black-box tracer writes rings inside the flight "
+            "recorder's decimation cond; pass flight_every (stride 1 "
+            "for full causal timelines)")
     if with_coords and p.coords_timeout:
         raise ValueError(
             "coords_timeout gates each probe's ack on its pair's RTT "
@@ -510,10 +528,15 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     @jax.jit
     def _run(state: SimState, key: jax.Array,
              cp: Optional[CompiledFaultPlan] = None,
-             coo=None, topo=None):
+             coo=None, topo=None, tracked=None):
+        from consul_tpu.sim import blackbox as blackbox_mod
         from consul_tpu.sim import coords as coords_mod
         from consul_tpu.sim import flight
         from consul_tpu.sim import topology as topo_mod
+
+        if with_bb and tracked is None:
+            raise ValueError("blackbox=True runner needs a tracked "
+                             "id array (blackbox.default_tracked)")
 
         scalars = init_scalars(state, p)
         # clamp the tiny epsilons the XLA path uses
@@ -594,7 +617,10 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     # snapshot (STATS_FIELDS lane order — the same the
                     # kernel emits its sums in); the run's carried-in
                     # stats cancel out of the subtraction entirely
-                    buf_c, (pi, pl) = c
+                    if with_bb:
+                        buf_c, (pi, pl), bbc = c
+                    else:
+                        buf_c, (pi, pl) = c
                     di = acc_i - pi
                     delta = SimStats(
                         suspicions=di[0], refutes=di[1],
@@ -612,9 +638,22 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                         informed=args2[3], local_health=args2[7],
                         incarnation=args2[2], t=t2,
                         stats_delta=delta, phase=ph, coord_row=crow)
-                    return (flight.record_row(
-                        buf_c, row, r - state.round_idx, flight_every),
-                        (acc_i, acc_lat))
+                    buf2 = flight.record_row(
+                        buf_c, row, r - state.round_idx, flight_every)
+                    if not with_bb:
+                        return (buf2, (acc_i, acc_lat))
+                    # black-box rings from the kernel's OUTPUT blocks
+                    # (state-transition events; the kernel's internal
+                    # probe draws never surface) — K-sized gathers in
+                    # the cond's taken branch only, like the trace row
+                    # r is the ABSOLUTE round (warm-start offset
+                    # included) — matching the XLA recorder's ring
+                    # timestamps across chained runs
+                    bbc = blackbox_mod.record(
+                        bbc, round_idx=r, phase=ph,
+                        status=args2[1], incarnation=args2[2],
+                        susp_conf=args2[6], up=args2[0])
+                    return (buf2, (acc_i, acc_lat), bbc)
 
                 rec = flight.maybe_record(rec, r - state.round_idx,
                                           rounds, flight_every, rec_fn)
@@ -622,9 +661,13 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     coo_c), None
 
         acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
-        rec0 = (flight.empty_trace(rounds, flight_every), acc0) \
-            if flight_every is not None \
-            else jnp.zeros((0,), jnp.float32)
+        if flight_every is not None:
+            rec0 = (flight.empty_trace(rounds, flight_every), acc0)
+            if with_bb:
+                rec0 = rec0 + (blackbox_mod.init_blackbox(
+                    state, tracked, p.blackbox_ring),)
+        else:
+            rec0 = jnp.zeros((0,), jnp.float32)
         # per-round coord keys, folded off a salted key so the seeds the
         # KERNEL consumes are untouched by coords mode
         ckeys = jax.random.split(jax.random.fold_in(key, 0x5EED), rounds)
@@ -634,6 +677,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             (seeds, ridx, ckeys))
         acc_i, acc_lat = acc
         trace = rec[0] if flight_every is not None else None
+        bb_out = rec[2] if with_bb else None
         (up, status, inc, informed, s_start, s_dead, s_conf,
          lh) = args[:8]
         if n_arrays == 10:
@@ -664,19 +708,21 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             local_health=lh.reshape(-1),
             slow=slow_flat, t=t_final,
             round_idx=state.round_idx + rounds, stats=st)
-        if with_coords:
-            return (out, coo_f, trace) if flight_every is not None \
-                else (out, coo_f)
-        return (out, trace) if flight_every is not None else out
+        res = (out, coo_f) if with_coords else (out,)
+        if flight_every is not None:
+            res = res + (trace,)
+        if with_bb:
+            res = res + (bb_out,)
+        return res[0] if len(res) == 1 else res
 
     if fault:
         # bind the maker's plan; same-shape plans may be swapped in per
         # call without recompiling (the tensors are traced arguments)
         def run_fault(state: SimState, key: jax.Array,
                       cp: Optional[CompiledFaultPlan] = None,
-                      coo=None, topo=None):
+                      coo=None, topo=None, tracked=None):
             return _run(state, key, cp if cp is not None else plan,
-                        coo, topo)
+                        coo, topo, tracked)
 
         return run_fault
 
@@ -685,7 +731,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
     seen_ok: list = [None]
 
-    def run(state: SimState, key: jax.Array, coo=None, topo=None):
+    def run(state: SimState, key: jax.Array, coo=None, topo=None,
+            tracked=None):
         # the 8-array kernel carries no slow array: running it over a
         # state with residual slow nodes would silently drop their
         # degraded dynamics (the XLA paths honor state.slow regardless
@@ -700,7 +747,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     "slow-node model; use a SimParams with "
                     "slow_per_round>0 (10-array kernel) or the XLA "
                     "run_rounds for this state")
-        out = _run(state, key, None, coo, topo)
+        out = _run(state, key, None, coo, topo, tracked)
         # cache the OUTPUT buffer: jit returns a fresh Array object even
         # for a passed-through input, so caching state.slow would never
         # hit on chained calls

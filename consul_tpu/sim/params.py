@@ -73,6 +73,16 @@ class SimParams:
     # per round). Disable for pure-throughput benchmarking.
     collect_stats: bool = True
 
+    # Black-box event tracer defaults (sim/blackbox.py). The tracer is
+    # ARMED by passing a tracked-id array to run_rounds_flight /
+    # make_run_rounds_pallas — data, not a static flag (one compile per
+    # K) — these knobs only size the default sampling: how many agents
+    # the scenario/bench surfaces track (blackbox.default_tracked) and
+    # how many of each agent's most recent events the on-device ring
+    # retains before wrapping.
+    blackbox_k: int = 64
+    blackbox_ring: int = 256
+
     # Workload model (churn injection)
     fail_per_round: float = 0.0     # P(live node crashes) per round
     rejoin_per_round: float = 0.0   # P(dead node rejoins) per round

@@ -1,0 +1,101 @@
+"""Shared device-layout registry for the sim's telemetry surfaces.
+
+The flight recorder (sim/flight.py) and the black-box event tracer
+(sim/blackbox.py) both pair an ON-DEVICE layout (trace columns; ring
+record lanes and event codes) with HOST-SIDE decoder tables. Those
+pairs live in different modules and historically in different PRs —
+exactly the setup where one side gains a column and the other silently
+keeps decoding the old offsets. This module is the single source both
+sides import, and ``layout_digest`` is a fingerprint over every name
+tuple that a tier-1 test (tests/test_blackbox.py) pins: adding,
+removing, or reordering ANY column or event code forces the pinned
+digest — and therefore every decoder — to be revisited in the same
+change.
+
+Nothing here imports jax: the registry is pure data so the CLI/host
+decoders can consult it without touching an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+#: flight-recorder instantaneous columns (sim/flight.GAUGE_COLUMNS)
+FLIGHT_GAUGE_COLUMNS = (
+    "t",                  # sim time (s) at the recorded round's end
+    "live_frac",          # mean(up) — ground-truth process liveness
+    "mean_informed",      # rumor-spread informed fraction, cluster mean
+    "suspect_frac",       # fraction of nodes currently rumored SUSPECT
+    "wrong_frac",         # live nodes rumored SUSPECT/DEAD (FP pressure)
+    "mean_local_health",  # Lifeguard awareness, cluster mean
+    "max_local_health",   # Lifeguard awareness, worst node
+    "inc_bumps",          # cumulative incarnation bumps (sum inc)
+    "fault_phase",        # active FaultPlan phase index (-1: no plan)
+)
+
+#: flight-recorder network-coordinate quality columns
+FLIGHT_COORD_COLUMNS = (
+    "rtt_err_med",   # median relative RTT-estimate error vs ground truth
+    "rtt_err_p99",   # p99 relative RTT-estimate error
+    "coord_drift",   # mean Vivaldi position moved this round (s)
+)
+
+#: black-box ring record lanes: every event is one int32[4] record
+BLACKBOX_RECORD_FIELDS = ("round", "event", "peer", "detail")
+
+#: black-box event codes, in EMIT ORDER — the order events of one
+#: recorded round land in an agent's ring (churn first, then the probe
+#: lifecycle, then the suspicion state machine). The tuple INDEX is the
+#: on-device event code.
+BLACKBOX_EVENTS = (
+    "phase_enter",      # detail = new FaultPlan phase index
+    "crash",            # ground-truth process death (churn/fault)
+    "leave",            # graceful leave (status -> LEFT)
+    "rejoin",           # dead node rejoined (alive rumor, inc bump)
+    "probe_ack",        # this agent's probe completed (peer/rtt in
+    #                     coords mode; -1/0 mean-field otherwise)
+    "probe_timeout",    # this agent's probe missed every channel
+    "indirect_fanout",  # k indirect ping-reqs dispatched after the
+    #                     direct miss (detail = indirect_checks)
+    "coord_late",       # ack existed but lost the RTT-vs-deadline race
+    #                     (coords_timeout gating; detail = rtt µs)
+    "suspect_start",    # cluster rumor turned SUSPECT on this agent
+    "suspect_confirm",  # extra independent confirmations arrived
+    #                     (detail = new confirmation count)
+    "refute",           # this agent's alive rumor won the race
+    "inc_bump",         # incarnation bumped (detail = new incarnation)
+    "declare_dead",     # suspicion timer fired (detail = 1 if the
+    #                     agent was actually up: a false positive)
+)
+
+#: events only the XLA engines can record: the prober-side probe
+#: lifecycle is internal to the Mosaic kernel (its PRNG draws never
+#: leave VMEM), so the Pallas post-pass records the state-transition
+#: events only. XLA ↔ Pallas ring conformance is asserted over
+#: BLACKBOX_EVENTS minus this set.
+BLACKBOX_PROBE_EVENTS = ("probe_ack", "probe_timeout",
+                         "indirect_fanout", "coord_late")
+
+#: SimStats counter lanes (mirror of state.STATS_FIELDS — re-declared
+#: here so the digest covers the flight counter columns without the
+#: registry importing jax; tests assert the two tuples stay identical)
+STATS_FIELDS = ("suspicions", "refutes", "false_positives",
+                "true_deaths_declared", "detect_latency_sum",
+                "crashes", "rejoins", "leaves")
+
+
+def flight_columns() -> tuple[str, ...]:
+    """The full flight-trace row layout, in column order."""
+    return FLIGHT_GAUGE_COLUMNS + STATS_FIELDS + FLIGHT_COORD_COLUMNS
+
+
+def layout_digest() -> str:
+    """Fingerprint over every layout tuple (order-sensitive). Pinned by
+    tests/test_blackbox.py::test_layout_registry_digest_pinned."""
+    h = hashlib.sha256()
+    for group in (FLIGHT_GAUGE_COLUMNS, STATS_FIELDS,
+                  FLIGHT_COORD_COLUMNS, BLACKBOX_RECORD_FIELDS,
+                  BLACKBOX_EVENTS, BLACKBOX_PROBE_EVENTS):
+        h.update("|".join(group).encode())
+        h.update(b";")
+    return h.hexdigest()[:16]

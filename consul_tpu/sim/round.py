@@ -73,14 +73,21 @@ def _trunc_poisson(u: jnp.ndarray, lam: jnp.ndarray, kmax: int = 4
 def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
                 reduce_sum: Reducer = jnp.sum,
                 fx: Optional[FaultFrame] = None,
-                coords=None, topo=None):
+                coords=None, topo=None, events: bool = False):
     """ONE protocol period — the single copy of the protocol body.
 
     `scalars=None` → live mode: population scalars computed from the
     post-churn arrays (gossip_round). `scalars=vector` → stale mode:
     last round's scalars are used and the next round's are produced in
     the same fused pass (gossip_round_fast). Returns
-    (state, scalars', coords', coord_metrics).
+    (state, scalars', coords', coord_metrics, probe_events).
+
+    `events=True` additionally surfaces the round's prober-side probe
+    lifecycle masks (blackbox.ProbeEvents) for the black-box event
+    tracer — pure views of values the round computes anyway (no extra
+    PRNG draws, so recorded and unrecorded runs share dynamics
+    key-for-key); XLA dead-code elimination drops them wherever the
+    recorder's decimation cond doesn't consume them.
 
     `fx` (faults.FaultFrame) carries this round's fault-injection view:
     per-node delivery multipliers, forced-slow mask, and churn-burst /
@@ -191,7 +198,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     # (memberlist state.go probeNode semantics, see params.py). Keys are
     # folded off the round key separately so coords-off dynamics stay
     # bit-identical to a coords-less build.
-    timely = late_in = None
+    timely = late_in = pair_j = rtt_obs = None
     if coords is not None:
         from consul_tpu.sim import coords as coords_mod
         from consul_tpu.sim import topology as topo_mod
@@ -241,9 +248,11 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     p_ack = frac_up_elig * (1.0 - mix_i)
     prober = up
     ack = prober & (jax.random.uniform(k_ack, (L,)) < p_ack)
+    late = None
     if timely is not None:
         # a late ack is a missed deadline: the prober escalates
         # (awareness +1, suspicion machinery) exactly like a lost one
+        late = ack & ~timely
         ack = ack & timely
     failed = prober & ~ack
 
@@ -394,8 +403,16 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         informed=informed, susp_start=s_start,
         susp_deadline=s_dead, susp_conf=s_conf, local_health=lh, slow=slow,
         t=t_end, round_idx=state.round_idx + 1, stats=st)
+    ev = None
+    if events:
+        from consul_tpu.sim import blackbox as blackbox_mod
+
+        ev = blackbox_mod.ProbeEvents(
+            ack=ack, failed=failed, late=late, pair_j=pair_j,
+            rtt_us=None if rtt_obs is None
+            else (rtt_obs * 1e6).astype(jnp.int32))
     if scalars is None:
-        return out, None, coords_out, coord_aux
+        return out, None, coords_out, coord_aux, ev
     # stale mode: produce next round's scalars in this same fused pass
     upf2 = up.astype(jnp.float32)
     elig2 = (status == ALIVE) | (status == SUSPECT)
@@ -409,13 +426,13 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         reduce_sum(upf2 * pf_fast), reduce_sum(upf2 * pf_slow),
         reduce_sum(w_fail2 * (lh.astype(jnp.float32) + 1.0)),
         jnp.maximum(reduce_sum(w_fail2), 1e-9)])
-    return out, new_scalars, coords_out, coord_aux
+    return out, new_scalars, coords_out, coord_aux, ev
 
 
 def gossip_round(state: SimState, key: jax.Array, p: SimParams,
                  reduce_sum: Reducer = jnp.sum,
                  fx: Optional[FaultFrame] = None,
-                 coords=None, topo=None):
+                 coords=None, topo=None, events: bool = False):
     """Advance one protocol period with LIVE population scalars.
 
     `reduce_sum` turns a per-node array into the *global* scalar sum —
@@ -427,12 +444,16 @@ def gossip_round(state: SimState, key: jax.Array, p: SimParams,
     — the aux carries the round's probe targets and drift, from which
     coords.coord_metrics builds the quality row where it is consumed;
     without one the return stays the bare state. Coords mode is
-    single-device only (the pair gathers don't cross mesh shards)."""
-    out, _, c2, aux = _round_core(state, None, key, p, reduce_sum, fx,
-                                  coords, topo)
-    if coords is None:
-        return out
-    return out, c2, aux
+    single-device only (the pair gathers don't cross mesh shards).
+
+    `events=True` appends the round's blackbox.ProbeEvents to the
+    return tuple (the black-box recorder's prober-side feed)."""
+    out, _, c2, aux, ev = _round_core(state, None, key, p, reduce_sum,
+                                      fx, coords, topo, events)
+    res = (out,) if coords is None else (out, c2, aux)
+    if events:
+        res = res + (ev,)
+    return res[0] if len(res) == 1 else res
 
 
 #: scalar vector layout for the stale-scalars fast path
@@ -515,8 +536,8 @@ def gossip_round_fast(state: SimState, scalars: jnp.ndarray,
     Returns (state, scalars'), extended to (state, scalars', coords',
     coords.CoordRoundAux) when a coords/topo pair is supplied.
     """
-    out, sc, c2, aux = _round_core(state, scalars, key, p, reduce_sum,
-                                   fx, coords, topo)
+    out, sc, c2, aux, _ = _round_core(state, scalars, key, p,
+                                      reduce_sum, fx, coords, topo)
     if coords is None:
         return out, sc
     return out, sc, c2, aux
@@ -637,11 +658,13 @@ def make_run_rounds(p: SimParams, rounds: int):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("p", "rounds", "record_every"))
+                   static_argnames=("p", "rounds", "record_every",
+                                    "ring_len"))
 def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
                       rounds: int, record_every: int = 1,
                       plan: Optional[CompiledFaultPlan] = None,
-                      coords=None, topo=None):
+                      coords=None, topo=None, tracked=None,
+                      ring_len: Optional[int] = None):
     """Run `rounds` periods with the flight recorder riding the scan.
 
     Returns (final_state, trace) where trace is a
@@ -657,29 +680,47 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
     scan: the trace's coord columns (flight.COORD_COLUMNS) carry the
     recorded round's estimate quality and the return value becomes
     (final_state, final_coords, trace).
+
+    `tracked` (a [K] int32 node-id array, e.g.
+    blackbox.default_tracked) arms the black-box event tracer
+    (sim/blackbox.py): each tracked agent gets a [ring_len, 4] event
+    ring written inside the SAME decimation cond as the trace row, and
+    the final BlackboxState is appended to the return tuple. The
+    tracked ids are traced DATA (one compile per K, any id set);
+    `ring_len` defaults to p.blackbox_ring.
     """
-    from consul_tpu.sim import flight
+    from consul_tpu.sim import blackbox, flight
 
     if not p.collect_stats:
         raise ValueError(
             "the flight recorder's counter columns ride the SimStats "
             "counters; build SimParams with collect_stats=True")
+    with_bb = tracked is not None
+    bb0 = blackbox.init_blackbox(
+        state, tracked, ring_len or p.blackbox_ring) if with_bb else None
 
     def body(carry, xs):
-        s, c, buf, prev = carry
+        s, c, buf, prev, bb = carry
         k, i = xs
         fx = fault_frame(plan, s.round_idx) if plan is not None else None
         ph = active_phase(plan, s.round_idx) if plan is not None \
             else jnp.int32(-1)
+        ev = None
         if coords is None:
-            s2 = gossip_round(s, k, p, fx=fx)
+            if with_bb:
+                s2, ev = gossip_round(s, k, p, fx=fx, events=True)
+            else:
+                s2 = gossip_round(s, k, p, fx=fx)
             c2 = aux = None
+        elif with_bb:
+            s2, c2, aux, ev = gossip_round(s, k, p, fx=fx, coords=c,
+                                           topo=topo, events=True)
         else:
             s2, c2, aux = gossip_round(s, k, p, fx=fx, coords=c,
                                        topo=topo)
 
         def rec(cc):
-            b, pv = cc
+            b, pv, bbc = cc
             crow = None
             if coords is not None:
                 # the percentile sorts behind the quality row run HERE,
@@ -694,18 +735,35 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
                 incarnation=s2.incarnation, t=s2.t,
                 stats_delta=flight.stats_delta(s2.stats, pv), phase=ph,
                 coord_row=crow)
-            return flight.record_row(b, row, i, record_every), s2.stats
+            if with_bb:
+                # ring writes share the trace row's decimation budget:
+                # black-box overhead is K-sized gathers/scatters on
+                # recorded rounds only
+                # ABSOLUTE protocol round (s.round_idx carries any
+                # warm-start offset), so decoded timelines line up
+                # with the flight t column across chained runs
+                bbc = blackbox.record(
+                    bbc, round_idx=s.round_idx, phase=ph,
+                    status=s2.status, incarnation=s2.incarnation,
+                    susp_conf=s2.susp_conf, up=s2.up, probe=ev,
+                    indirect_checks=p.indirect_checks)
+            return (flight.record_row(b, row, i, record_every),
+                    s2.stats, bbc)
 
-        buf, prev = flight.maybe_record((buf, prev), i, rounds,
-                                        record_every, rec)
-        return (s2, c2, buf, prev), None
+        buf, prev, bb = flight.maybe_record((buf, prev, bb), i, rounds,
+                                            record_every, rec)
+        return (s2, c2, buf, prev, bb), None
 
     keys = jax.random.split(key, rounds)
     buf0 = flight.empty_trace(rounds, record_every)
-    (final, cf, trace, _), _ = jax.lax.scan(
-        body, (state, coords, buf0, state.stats),
+    (final, cf, trace, _, bbf), _ = jax.lax.scan(
+        body, (state, coords, buf0, state.stats, bb0),
         (keys, jnp.arange(rounds, dtype=jnp.int32)))
-    return (final, trace) if coords is None else (final, cf, trace)
+    out = (final,) if coords is None else (final, cf)
+    out = out + (trace,)
+    if with_bb:
+        out = out + (bbf,)
+    return out
 
 
 def make_run_rounds_flight(p: SimParams, rounds: int,

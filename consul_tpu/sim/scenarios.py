@@ -181,26 +181,43 @@ def chaos_plans(n: int) -> dict[str, FaultPlan]:
 
 
 def run_chaos(name: str, n: int = 4096, seed: int = 0,
-              p: Optional[SimParams] = None) -> dict[str, Any]:
+              p: Optional[SimParams] = None,
+              blackbox: bool = False) -> dict[str, Any]:
     """Run ONE chaos class and report per-phase detection quality.
 
     Rides the flight recorder at stride 1: the one trace both feeds the
     per-phase SimStats deltas (phase_reports, via stats_from_trace) and
     the per-round degradation curves (trace_report) — run_rounds_stats
-    remains for callers that only want the raw stats pytree."""
+    remains for callers that only want the raw stats pytree.
+
+    `blackbox=True` additionally tracks p.blackbox_k sampled agents
+    through the black-box event tracer (sim/blackbox.py) riding the
+    same run, and folds the decoded per-event totals (plus the
+    ring↔flight cross-check when the sample covers all of n) into the
+    report under ``"blackbox"`` — the causal layer for asking WHY a
+    phase's false positives happened, not just how many."""
+    from consul_tpu.sim import blackbox as blackbox_mod
+    from consul_tpu.sim.metrics import blackbox_report
+
     plan = chaos_plans(n)[name]
     if p is None:
         p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
                                          tcp_fallback=False)
     cp = compile_plan(plan, n)
-    state, trace = run_rounds_flight(init_state(n), jax.random.key(seed),
-                                     p, plan.total_rounds, plan=cp)
+    tracked = blackbox_mod.default_tracked(n, p.blackbox_k) \
+        if blackbox else None
+    out = run_rounds_flight(init_state(n), jax.random.key(seed),
+                            p, plan.total_rounds, plan=cp,
+                            tracked=tracked)
+    (state, trace), bb = out[:2], (out[2] if blackbox else None)
     tr = stats_from_trace(trace)
     return {
         "scenario": name, "n": n, "rounds": plan.total_rounds,
         "phases": [r.to_dict() for r in phase_reports(tr, plan, p)],
         "flight": trace_report(trace, p, plan=plan,
                                rounds=plan.total_rounds),
+        **({"blackbox": blackbox_report(bb, p, trace=trace)}
+           if blackbox else {}),
         "final_live_fraction": float(jnp.mean(
             state.up.astype(jnp.float32))),
         "final_wrongly_dead": int(jnp.sum(

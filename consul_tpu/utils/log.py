@@ -29,7 +29,32 @@ SIM = "sim"
 _root = logging.getLogger("consul_tpu")
 _configured = False
 _lock = threading.Lock()
-_sinks: list[Callable[[str], None]] = []
+#: (sink, minimum levelno or None) — None means every record
+_sinks: list[tuple[Callable[[str], None], Optional[int]]] = []
+
+#: hclog level names accepted by `/v1/agent/monitor?loglevel=` (the
+#: reference's logging/logger.go LevelFromString set); "trace" maps to
+#: DEBUG — python logging has no finer built-in tier
+LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "err": logging.ERROR,
+}
+
+
+def level_no(name: str) -> int:
+    """hclog-style level name -> python levelno; raises ValueError on
+    an unknown name (the monitor endpoint's 400 validation)."""
+    try:
+        return LEVELS[name.strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown log level {name!r} (expected one of "
+            f"{', '.join(sorted(set(LEVELS)))})") from None
 
 
 class _SinkHandler(logging.Handler):
@@ -37,7 +62,9 @@ class _SinkHandler(logging.Handler):
         if not _sinks:
             return
         msg = self.format(record)
-        for sink in list(_sinks):
+        for sink, min_level in list(_sinks):
+            if min_level is not None and record.levelno < min_level:
+                continue
             try:
                 sink(msg)
             except Exception:  # noqa: BLE001 — sinks must never kill logging
@@ -71,13 +98,19 @@ def named(name: str) -> logging.Logger:
     return _root.getChild(name)
 
 
-def add_sink(fn: Callable[[str], None]) -> Callable[[], None]:
-    """Attach a log sink (for `/v1/agent/monitor`); returns a detach fn."""
-    _sinks.append(fn)
+def add_sink(fn: Callable[[str], None],
+             level: Optional[str] = None) -> Callable[[], None]:
+    """Attach a log sink (for `/v1/agent/monitor`); returns a detach
+    fn. `level` filters to records at or above that hclog level name
+    (validate with ``level_no`` FIRST when the name came off the wire
+    — here an unknown name raises, which is too late for a clean
+    400)."""
+    entry = (fn, level_no(level) if level is not None else None)
+    _sinks.append(entry)
 
     def detach() -> None:
         try:
-            _sinks.remove(fn)
+            _sinks.remove(entry)
         except ValueError:
             pass
 

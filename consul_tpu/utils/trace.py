@@ -1,0 +1,227 @@
+"""Lightweight in-process span tracer — the real agent's black box.
+
+telemetry.py (PR 2) meters the agent's hot paths as counters and
+latency samples; this module records the INDIVIDUAL operations as
+spans, so a postmortem can see that one slow `http.request` spent its
+time waiting on a chunked `raft.fsm.apply`, not just that p99 moved.
+Mirrors what the sim side's event rings (sim/blackbox.py) do for
+virtual agents, at the same tier Consul ships with `consul debug` and
+`/v1/agent/monitor`.
+
+Design constraints, in order:
+
+  * near-zero cost when nobody is looking: a finished span is one dict
+    appended to a bounded deque (the ring buffer) — no I/O, no
+    formatting, no allocation beyond the record itself;
+  * safe on hot paths: sink callbacks (the `/v1/agent/trace/stream`
+    endpoint attaches one per client) may never raise into or block
+    the instrumented code — exceptions are swallowed, and the monitor
+    pattern's bounded-queue-with-drop lives in the endpoint, not here;
+  * parent/child nesting is PER THREAD (a contextvar stack): a span
+    opened inside another on the same thread records its parent id.
+    Cross-thread work (the raft applier consuming a leader's entry)
+    records its own root span — correlation is by time and tags,
+    which is honest about what the process actually knows;
+  * async lifecycles (the SWIM prober's ack-vs-timeout race) use
+    ``begin()``/``Span.finish()`` instead of the context manager: the
+    span starts on the probe tick and finishes from whichever timer or
+    packet handler wins.
+
+Export: ``Tracer.recent()`` feeds the `consul_tpu.cli debug` bundle
+and the trace endpoints; ``to_perfetto`` renders the ring as
+Chrome-trace JSON (one thread row per real thread), loadable in the
+same Perfetto viewer as `bench.py --profile` XLA captures and
+``sim.blackbox.to_perfetto`` timelines.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+#: per-thread (and per-async-context) open-span stack
+_stack: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "consul_tpu_trace_stack", default=())
+
+
+class Span:
+    """One traced operation. Use as a context manager (nested spans on
+    the same thread pick this up as their parent) or keep the handle
+    and call ``finish()`` from wherever the operation actually ends."""
+
+    __slots__ = ("tracer", "span_id", "parent_id", "name", "tags",
+                 "start_wall", "_start_perf", "_token", "_done")
+
+    def __init__(self, tracer: "Tracer", name: str, parent_id, tags,
+                 on_stack: bool) -> None:
+        self.tracer = tracer
+        self.span_id = next(tracer._ids)
+        self.parent_id = parent_id
+        self.name = name
+        self.tags = tags
+        self.start_wall = time.time()
+        self._start_perf = time.perf_counter()
+        self._done = False
+        self._token = None
+        if on_stack:
+            self._token = _stack.set(_stack.get() + (self.span_id,))
+
+    def tag(self, **tags: Any) -> "Span":
+        self.tags.update(tags)
+        return self
+
+    def finish(self, **tags: Any) -> None:
+        if self._done:  # idempotent: the ack/timeout race may try both
+            return
+        self._done = True
+        if tags:
+            self.tags.update(tags)
+        if self._token is not None:
+            try:
+                _stack.reset(self._token)
+            except ValueError:
+                # finished on a different thread/context than it
+                # started on — the stack entry dies with that context
+                pass
+        self.tracer._record(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.tags["error"] = exc_type.__name__
+        self.finish()
+        return False
+
+    def _duration_ms(self) -> float:
+        return (time.perf_counter() - self._start_perf) * 1000.0
+
+
+class Tracer:
+    """Bounded ring of finished spans + live sinks."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._sinks: list[Callable[[dict[str, Any]], None]] = []
+
+    # ------------------------------------------------------- recording
+
+    def span(self, name: str, **tags: Any) -> Span:
+        """Context-managed span: parented to the current thread's open
+        span, pushed on the nesting stack until ``__exit__``."""
+        stack = _stack.get()
+        return Span(self, name, stack[-1] if stack else None, tags,
+                    on_stack=True)
+
+    def begin(self, name: str, **tags: Any) -> Span:
+        """Manual span for async lifecycles: captures the current
+        parent but does NOT join the nesting stack (it would never be
+        popped by the thread that finishes it). Finish with
+        ``Span.finish()`` — idempotent, so racing completions are
+        safe."""
+        stack = _stack.get()
+        return Span(self, name, stack[-1] if stack else None, tags,
+                    on_stack=False)
+
+    def _record(self, span: Span) -> None:
+        rec = {
+            "id": span.span_id,
+            "parent": span.parent_id,
+            "name": span.name,
+            "start": span.start_wall,
+            "duration_ms": round(span._duration_ms(), 4),
+            "thread": threading.current_thread().name,
+            "tags": span.tags,
+        }
+        with self._lock:
+            self._ring.append(rec)
+            sinks = list(self._sinks)
+        for fn in sinks:
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — sinks never hurt hot paths
+                pass
+
+    # -------------------------------------------------------- querying
+
+    def recent(self, limit: Optional[int] = None, min_ms: float = 0.0,
+               prefix: str = "") -> list[dict[str, Any]]:
+        """Most recent finished spans, oldest first. `min_ms` and
+        `prefix` filter (slow-only / one family) without the caller
+        touching ring internals."""
+        with self._lock:
+            spans = list(self._ring)
+        if prefix:
+            spans = [s for s in spans if s["name"].startswith(prefix)]
+        if min_ms > 0:
+            spans = [s for s in spans if s["duration_ms"] >= min_ms]
+        if limit is not None and limit >= 0:
+            # explicit: [-0:] would slice the WHOLE ring, not none
+            spans = spans[-limit:] if limit else []
+        return spans
+
+    def add_sink(self, fn: Callable[[dict[str, Any]], None]
+                 ) -> Callable[[], None]:
+        """Live span feed (the streaming endpoint); returns detach."""
+        with self._lock:
+            self._sinks.append(fn)
+
+        def detach() -> None:
+            with self._lock:
+                try:
+                    self._sinks.remove(fn)
+                except ValueError:
+                    pass
+
+        return detach
+
+    def sink_count(self) -> int:
+        with self._lock:
+            return len(self._sinks)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # ------------------------------------------------------- exporting
+
+    def to_perfetto(self, spans: Optional[list[dict[str, Any]]] = None,
+                    pid: int = 2,
+                    process_name: str = "consul-tpu-agent"
+                    ) -> dict[str, Any]:
+        """Chrome-trace JSON: spans as complete ("X") events on one
+        thread row per real thread. Wall-clock µs timestamps — a
+        bundle's span export lines up with any other wall-clocked
+        capture in the same viewer."""
+        spans = self.recent() if spans is None else spans
+        tids: dict[str, int] = {}
+        events: list[dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": process_name}}]
+        for s in spans:
+            tid = tids.setdefault(s["thread"], len(tids) + 1)
+            events.append({
+                "name": s["name"], "ph": "X", "pid": pid, "tid": tid,
+                "ts": s["start"] * 1e6,
+                "dur": max(s["duration_ms"] * 1000.0, 1.0),
+                "args": {**s["tags"], "span_id": s["id"],
+                         **({"parent": s["parent"]}
+                            if s["parent"] else {})},
+            })
+        for name, tid in tids.items():
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": name}})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: process-global tracer (the go-metrics-style default the agent's hot
+#: paths record into; `/v1/agent/trace*` and `cli debug` read it)
+default = Tracer()

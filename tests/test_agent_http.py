@@ -977,3 +977,142 @@ def test_census_reporting_snapshots_and_retention():
             util["Snapshots"][-1]["Nodes"] >= 1
     finally:
         a.shutdown()
+
+
+# ---------------------------------------------------- span trace + monitor
+
+
+def test_trace_endpoint_serves_recent_spans(agent, client):
+    """/v1/agent/trace: the span tracer's ring over HTTP — a KV write
+    leaves the full cross-layer chain (http.request on the handler
+    thread, raft.commit_wait parked under it, raft.apply on the
+    batcher thread, raft.fsm.apply on the applier)."""
+    client.kv_put("trace/seed", b"1")
+    wait_for(lambda: any(
+        s["name"] == "raft.fsm.apply"
+        for s in client.get("/v1/agent/trace")["Spans"]),
+        what="fsm apply span recorded")
+    spans = client.get("/v1/agent/trace")["Spans"]
+    names = {s["name"] for s in spans}
+    assert {"http.request", "raft.commit_wait", "raft.apply",
+            "raft.fsm.apply"} <= names
+    # nesting: the commit wait is parented under its http.request
+    by_id = {s["id"]: s for s in spans}
+    waits = [s for s in spans if s["name"] == "raft.commit_wait"
+             and s["parent"] in by_id]
+    assert any(by_id[s["parent"]]["name"] == "http.request"
+               for s in waits)
+    # filters narrow without touching ring internals
+    only_fsm = client.get("/v1/agent/trace", prefix="raft.fsm.")
+    assert only_fsm["Spans"]
+    assert all(s["name"].startswith("raft.fsm.")
+               for s in only_fsm["Spans"])
+    # perfetto export is chrome-trace shaped
+    pf = client.get("/v1/agent/trace", format="perfetto")
+    assert any(e.get("ph") == "X" for e in pf["traceEvents"])
+    # param validation: 400 BEFORE any body is written
+    for params in ({"limit": "x"}, {"min_ms": "nope"},
+                   {"limit": "-1"}, {"min_ms": "-2"}):
+        with pytest.raises(APIError) as ei:
+            client.get("/v1/agent/trace", **params)
+        assert ei.value.code == 400
+
+
+def test_trace_stream_live_spans_and_clean_detach(agent, client):
+    """/v1/agent/trace/stream: finished spans flush live as JSON
+    lines; the sink detaches when the window closes (no leak)."""
+    from consul_tpu.utils import trace as trace_mod
+
+    base = trace_mod.default.sink_count()
+    for params in ({"duration": "0s"}, {"min_ms": "-1"},
+                   {"duration": "bogus"}):
+        with pytest.raises(APIError) as ei:
+            client.get("/v1/agent/trace/stream", **params)
+        assert ei.value.code == 400
+
+    got = {"lines": []}
+
+    def reader():
+        with urllib.request.urlopen(
+                f"http://{agent.http.addr}/v1/agent/trace/stream"
+                "?duration=1.5s&prefix=http.", timeout=10) as resp:
+            got["lines"] = [json.loads(ln) for ln in
+                            resp.read().decode().splitlines() if ln]
+
+    t = threading.Thread(target=reader)
+    t.start()
+    wait_for(lambda: trace_mod.default.sink_count() > base,
+             what="stream sink attached")
+    for i in range(3):
+        client.kv_put(f"trace/stream/{i}", b"x")
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got["lines"], "spans must stream live"
+    assert all(s["name"].startswith("http.") for s in got["lines"])
+    wait_for(lambda: trace_mod.default.sink_count() == base,
+             what="stream sink detached")
+
+
+def test_monitor_loglevel_filter_and_validation(agent, client):
+    """?loglevel= parity with the metrics stream's validation: unknown
+    level is a 400 before streaming; a valid level filters lines."""
+    from consul_tpu.utils import log as log_mod
+
+    with pytest.raises(APIError) as ei:
+        client.get("/v1/agent/monitor", loglevel="shout")
+    assert ei.value.code == 400
+
+    logger = log_mod.named("monitor-test")
+    got = {"body": b""}
+
+    def reader():
+        with urllib.request.urlopen(
+                f"http://{agent.http.addr}/v1/agent/monitor"
+                "?duration=1.5s&loglevel=error", timeout=10) as resp:
+            got["body"] = resp.read()
+
+    sinks_before = len(log_mod._sinks)
+    t = threading.Thread(target=reader)
+    t.start()
+    wait_for(lambda: len(log_mod._sinks) > sinks_before,
+             what="monitor sink attached")
+    logger.info("monitor-filter-info-marker")
+    logger.error("monitor-filter-error-marker")
+    t.join(timeout=10)
+    body = got["body"].decode()
+    assert "monitor-filter-error-marker" in body
+    assert "monitor-filter-info-marker" not in body
+
+
+def test_monitor_slow_reader_sheds_instead_of_blocking(agent, client):
+    """Backpressure: a monitor client that never drains its stream
+    must not block the logging hot path (bounded queue, drop-on-full)
+    nor the agent's other endpoints."""
+    from consul_tpu.utils import log as log_mod
+
+    logger = log_mod.named("backpressure-test")
+    sinks_before = len(log_mod._sinks)
+    # open the stream but never read the body
+    host, _, port = agent.http.addr.rpartition(":")
+    sock = socket.create_connection((host, int(port)), timeout=10)
+    sock.sendall(b"GET /v1/agent/monitor?duration=10s HTTP/1.1\r\n"
+                 b"Host: x\r\nConnection: close\r\n\r\n")
+    wait_for(lambda: len(log_mod._sinks) > sinks_before,
+             what="monitor sink attached")
+    # flood well past the 4096-entry queue; the producer side must
+    # stay fast (put_nowait + drop), reader be damned
+    t0 = time.time()
+    for i in range(6000):
+        logger.warning("flood %d", i)
+    produce_s = time.time() - t0
+    assert produce_s < 5.0, f"logging blocked: {produce_s:.1f}s"
+    # the agent still serves other requests while the stream is stuck
+    assert client.get("/v1/agent/self")["Config"]["NodeName"] \
+        == "dev-agent"
+    sock.close()
+    # the handler notices the dead peer on a later write and detaches
+    def poke():
+        logger.warning("disconnect-poke")
+        return len(log_mod._sinks) == sinks_before
+    wait_for(poke, timeout=15, what="monitor sink detached after "
+                                    "client disconnect")

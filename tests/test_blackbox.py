@@ -1,0 +1,324 @@
+"""Black-box event tracer correctness (fast CPU tier-1 coverage).
+
+Three contracts protect the tracer:
+
+  * LAYOUT: device writers and host decoders share sim/registry.py;
+    the pinned digest makes any column/event-code drift a loud test
+    failure that forces every decoder to be revisited in one change;
+  * FIDELITY: with every agent tracked at stride 1 the decoded event
+    totals equal the flight recorder's aggregate counters EXACTLY
+    (same run, same PRNG — disagreement is a decoder bug, not noise),
+    and arming the tracer never perturbs dynamics;
+  * CAUSALITY: a chaos run's decoded timeline shows the false-
+    suspicion chain the aggregates can only count — probe timeout →
+    suspicion start → refutation — per agent, in order.
+
+Engine-level XLA ↔ Pallas ring conformance is TPU-gated below, in the
+tests/test_pallas_round.py style.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.config import GossipConfig
+from consul_tpu.sim import (SimParams, init_state, run_rounds_flight,
+                            blackbox)
+from consul_tpu.sim import registry
+from consul_tpu.sim.flight import (COL, COORD_COLUMNS, FLIGHT_COLUMNS,
+                                   GAUGE_COLUMNS)
+from consul_tpu.sim.metrics import blackbox_report
+from consul_tpu.sim.scenarios import chaos_plans
+from consul_tpu.sim.state import STATS_FIELDS
+from consul_tpu.faults import compile_plan
+
+tpu_only = pytest.mark.skipif(
+    jax.devices()[0].platform not in ("tpu", "axon"),
+    reason="pallas kernel targets TPU; CPU suite runs the XLA paths")
+
+_P = SimParams(n=256, loss=0.2, tcp_fallback=False,
+               fail_per_round=0.002, rejoin_per_round=0.02)
+
+
+def _run_tracked_all(p, rounds, key=0, plan=None, ring_len=512):
+    tracked = jnp.arange(p.n, dtype=jnp.int32)
+    return run_rounds_flight(init_state(p.n), jax.random.key(key), p,
+                             rounds, plan=plan, tracked=tracked,
+                             ring_len=ring_len)
+
+
+# ------------------------------------------------------- layout guard
+
+
+def test_layout_registry_digest_pinned():
+    """Adding/removing/reordering ANY flight column or black-box event
+    code must change this digest — update the pin AND audit every
+    decoder (flight.COL consumers, blackbox.decode_timeline,
+    metrics.blackbox_report, ARCHITECTURE.md tables) in the same
+    change."""
+    assert registry.layout_digest() == "6e8863da10de6dba"
+
+
+def test_device_layouts_and_decoder_tables_stay_in_sync():
+    # flight: module tables ARE the registry's (identity, not copies)
+    assert GAUGE_COLUMNS is registry.FLIGHT_GAUGE_COLUMNS
+    assert COORD_COLUMNS is registry.FLIGHT_COORD_COLUMNS
+    assert FLIGHT_COLUMNS == registry.flight_columns()
+    assert [FLIGHT_COLUMNS[i] for i in sorted(COL.values())] == \
+        list(FLIGHT_COLUMNS)
+    # the registry's STATS_FIELDS mirror (kept jax-free for host-side
+    # consumers) must match the canonical tuple in sim/state.py
+    assert registry.STATS_FIELDS == STATS_FIELDS
+    # blackbox: decoder tables derive from the registry
+    assert blackbox.EVENT_NAMES is registry.BLACKBOX_EVENTS
+    assert blackbox.RECORD_FIELDS is registry.BLACKBOX_RECORD_FIELDS
+    assert sorted(blackbox.EV.values()) == \
+        list(range(len(registry.BLACKBOX_EVENTS)))
+    assert set(registry.BLACKBOX_PROBE_EVENTS) <= \
+        set(registry.BLACKBOX_EVENTS)
+    # device record width == decoder field count
+    st, _, bb = _run_tracked_all(_P, 4)
+    assert bb.ring.shape[-1] == len(registry.BLACKBOX_RECORD_FIELDS)
+
+
+# ---------------------------------------------------------- fidelity
+
+
+def test_event_totals_match_flight_aggregates_exactly():
+    """Tracking ALL agents at stride 1, decoded ring totals must equal
+    the flight counter columns exactly — same run, same key, one PRNG
+    stream."""
+    state, trace, bb = _run_tracked_all(_P, 40, key=1)
+    tl = blackbox.decode_timeline(bb, _P.probe_interval)
+    tot = blackbox.event_totals(tl)
+    tr = np.asarray(trace, np.float64)
+    assert sum(t["dropped"] for t in tl.values()) == 0
+    for ev, col in (("suspect_start", "suspicions"),
+                    ("refute", "refutes"), ("crash", "crashes"),
+                    ("rejoin", "rejoins"), ("leave", "leaves")):
+        assert tot[ev] == int(tr[:, COL[col]].sum()), (ev, col)
+    assert tot["declare_dead"] == int(
+        tr[:, COL["false_positives"]].sum()
+        + tr[:, COL["true_deaths_declared"]].sum())
+    # something actually happened
+    assert tot["suspect_start"] > 0 and tot["probe_ack"] > 0
+    # inc bumps are refutes + rejoins in this config (no tag updates)
+    assert tot["inc_bump"] == tot["refute"] + tot["rejoin"]
+    # and the report-layer cross-check agrees with itself
+    rep = blackbox_report(bb, _P, trace=trace)
+    assert rep["crosscheck_agree"] is True
+    assert rep["dropped_events"] == 0
+
+
+def test_tracer_does_not_perturb_dynamics():
+    """Arming the tracer adds no PRNG draws: the same key yields a
+    bit-identical flight trace with or without rings."""
+    _, t_plain = run_rounds_flight(init_state(_P.n), jax.random.key(2),
+                                   _P, 30)
+    _, t_bb, _ = _run_tracked_all(_P, 30, key=2)
+    np.testing.assert_array_equal(np.asarray(t_plain),
+                                  np.asarray(t_bb))
+
+
+def test_decimation_gates_ring_writes():
+    """At stride k the rings record window-boundary transitions only —
+    strictly fewer events than stride 1, written only on recorded
+    rounds (the overhead contract: skipped rounds skip ALL ring
+    work)."""
+    tracked = jnp.arange(_P.n, dtype=jnp.int32)
+    _, _, bb1 = _run_tracked_all(_P, 40, key=3)
+    _, _, bb10 = run_rounds_flight(
+        init_state(_P.n), jax.random.key(3), _P, 40, record_every=10,
+        tracked=tracked, ring_len=512)
+    t1 = blackbox.event_totals(
+        blackbox.decode_timeline(bb1, _P.probe_interval))
+    t10 = blackbox.event_totals(
+        blackbox.decode_timeline(bb10, _P.probe_interval))
+    assert sum(t10.values()) < sum(t1.values())
+    # every recorded round index is a window end (9, 19, 29, 39)
+    rounds_seen = {ev["round"]
+                   for tl in blackbox.decode_timeline(
+                       bb10, _P.probe_interval).values()
+                   for ev in tl["events"]}
+    assert rounds_seen <= {9, 19, 29, 39}
+
+
+def test_ring_wraps_keep_most_recent_events():
+    p = _P.with_(loss=0.3)  # busy: probe events every round
+    tracked = jnp.arange(8, dtype=jnp.int32)
+    _, _, bb = run_rounds_flight(init_state(p.n), jax.random.key(4), p,
+                                 60, tracked=tracked, ring_len=16)
+    tl = blackbox.decode_timeline(bb, p.probe_interval)
+    wrapped = [t for t in tl.values() if t["dropped"] > 0]
+    assert wrapped, "60 busy rounds must overflow a 16-slot ring"
+    for t in wrapped:
+        assert len(t["events"]) == 16
+        rounds = [ev["round"] for ev in t["events"]]
+        assert rounds == sorted(rounds)  # chronological after unwrap
+        assert rounds[-1] >= 50  # the RECENT end survived, not the old
+
+
+# --------------------------------------------------------- causality
+
+
+def test_chaos_false_suspicion_timeline_pinned():
+    """The acceptance chain: a live agent behind per-node loss sees
+    its probes time out, gets suspected, and refutes — decoded in
+    causal order from its own ring, while the run's totals still match
+    the flight recorder's aggregates exactly."""
+    n = _P.n
+    plan = chaos_plans(n)["per_node_loss"]
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
+                                     tcp_fallback=False)
+    state, trace, bb = _run_tracked_all(p, plan.total_rounds, key=5,
+                                        plan=compile_plan(plan, n),
+                                        ring_len=512)
+    tl = blackbox.decode_timeline(bb, p.probe_interval)
+    rep = blackbox_report(bb, p, trace=trace)
+    assert rep["crosscheck_agree"] is True
+
+    chains = 0
+    for node, t in tl.items():
+        # walk this agent's ring for probe_timeout -> suspect_start ->
+        # refute, in order (round-monotonic by construction)
+        saw_timeout = saw_suspect = None
+        for ev in t["events"]:
+            if ev["event"] == "probe_timeout" and saw_timeout is None:
+                saw_timeout = ev["round"]
+            elif ev["event"] == "suspect_start" \
+                    and saw_timeout is not None and saw_suspect is None:
+                saw_suspect = ev["round"]
+            elif ev["event"] == "refute" and saw_suspect is not None:
+                assert saw_timeout <= saw_suspect <= ev["round"]
+                chains += 1
+                break
+    assert chains > 0, "no probe_timeout -> suspect_start -> refute " \
+                       "chain decoded under per-node loss"
+    # the episode folder pairs the same story: refuted suspicions of
+    # LIVE agents (false suspicions) exist and carry their outcome
+    refuted = [ep for t in tl.values()
+               for ep in blackbox.suspicion_episodes(t)
+               if ep["outcome"] == "refute"]
+    assert len(refuted) > 0
+    for ep in refuted:
+        assert ep["end_round"] >= ep["start_round"]
+    # phase entries recorded once per phase change for every agent
+    tot = blackbox.event_totals(tl)
+    assert tot["phase_enter"] == len(plan.phases) * n
+
+
+def test_coords_probe_events_carry_peer_and_rtt():
+    """In coords mode probe events carry the explicit pair target and
+    observed RTT; with coords_timeout the deadline race records
+    coord_late events."""
+    from consul_tpu.sim.coords import init_coords
+    from consul_tpu.sim.topology import TopologyParams, make_topology
+
+    n = 256
+    # tight probe_timeout: the deadline floor sits UNDER the ~50-100ms
+    # cross-DC ground-truth RTTs, so cold-start coordinates (est≈0 ⇒
+    # floor deadline) lose the race until Vivaldi learns the topology
+    p = SimParams.from_gossip_config(
+        GossipConfig.lan(), n=n, tcp_fallback=False,
+        coords_timeout=True).with_(probe_timeout=0.02)
+    topo = make_topology(TopologyParams(n=n, seed=0))
+    tracked = jnp.arange(n, dtype=jnp.int32)
+    state, coords, trace, bb = run_rounds_flight(
+        init_state(n), jax.random.key(6), p, 30,
+        coords=init_coords(n), topo=topo, tracked=tracked,
+        ring_len=512)
+    tl = blackbox.decode_timeline(bb, p.probe_interval)
+    acks = [ev for t in tl.values() for ev in t["events"]
+            if ev["event"] == "probe_ack"]
+    assert acks
+    assert all(ev["peer"] >= 0 for ev in acks)
+    assert any(ev["detail"] > 0 for ev in acks)  # rtt µs rides detail
+    tot = blackbox.event_totals(tl)
+    # cold-start coordinates misestimate wildly: the deadline race
+    # must actually fire
+    assert tot["coord_late"] > 0
+
+
+def test_perfetto_export_shape():
+    _, _, bb = _run_tracked_all(_P, 30, key=7)
+    tl = blackbox.decode_timeline(bb, _P.probe_interval)
+    pf = blackbox.to_perfetto(tl)
+    evs = pf["traceEvents"]
+    assert any(e["ph"] == "M" and e["args"].get("name") ==
+               "consul-tpu-sim" for e in evs)
+    spans = [e for e in evs if e["ph"] == "X"]
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert instants, "every raw event exports as an instant"
+    # suspicion spans only exist when episodes closed inside the run
+    for s in spans:
+        assert s["name"] == "suspected"
+        assert s["dur"] >= 1.0
+        assert s["args"]["outcome"] in ("refute", "declare_dead")
+    # instants carry the decoded record
+    assert {"round", "peer", "detail"} <= set(instants[0]["args"])
+
+
+def test_report_without_full_tracking_has_no_crosscheck():
+    tracked = blackbox.default_tracked(_P.n, 16)
+    _, trace, bb = run_rounds_flight(
+        init_state(_P.n), jax.random.key(8), _P, 20, tracked=tracked)
+    rep = blackbox_report(bb, _P, trace=trace)
+    assert rep["tracked"] == 16
+    assert "crosscheck" not in rep  # a 16/256 sample can't reconcile
+
+
+def test_pallas_maker_refuses_blackbox_without_flight():
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    with pytest.raises(ValueError, match="decimation cond"):
+        make_run_rounds_pallas(
+            SimParams(n=262_144, loss=0.1, fail_per_round=0.001),
+            10, blackbox=True)
+
+
+def test_default_tracked_intersects_fault_ranges():
+    t = np.asarray(blackbox.default_tracked(4096, 64))
+    assert t.shape == (64,)
+    assert len(set(t.tolist())) == 64
+    # chaos fault selectors address [0, n//16) — the default sample
+    # must watch some victims
+    assert (t < 4096 // 16).sum() >= 4
+
+
+# ------------------------------------------------- engine conformance
+
+
+@tpu_only
+def test_pallas_blackbox_rings_match_xla():
+    """Engine-level ring conformance: the Pallas post-pass derives the
+    state-transition events from the kernel's output blocks exactly
+    like the XLA recorder derives them from its round output — shared
+    event codes must agree statistically (different PRNGs), and the
+    kernel-internal probe lifecycle must be absent from Pallas rings
+    by construction."""
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.20, tcp_fallback=False,
+                  fail_per_round=0.001, rejoin_per_round=0.01)
+    rounds = 150
+    tracked = blackbox.default_tracked(n, 512)
+    _, _, bb_pal = make_run_rounds_pallas(
+        p, rounds, flight_every=1, blackbox=True)(
+            init_state(n), jax.random.key(0), tracked=tracked)
+    _, _, bb_xla = run_rounds_flight(
+        init_state(n), jax.random.key(1), p, rounds, tracked=tracked)
+    t_pal = blackbox.event_totals(
+        blackbox.decode_timeline(bb_pal, p.probe_interval))
+    t_xla = blackbox.event_totals(
+        blackbox.decode_timeline(bb_xla, p.probe_interval))
+    for ev in ("suspect_start", "refute", "inc_bump", "crash",
+               "rejoin"):
+        assert t_xla[ev] > 0, ev
+        assert 0.75 < t_pal[ev] / t_xla[ev] < 1.33, \
+            (ev, t_pal[ev], t_xla[ev])
+    for ev in registry.BLACKBOX_PROBE_EVENTS:
+        assert t_pal[ev] == 0, ev  # kernel-internal, never surfaced
+        assert t_xla[ev] >= 0
+    assert t_xla["probe_ack"] > 0

@@ -372,3 +372,39 @@ def test_rtt_aware_timeout_stops_far_node_false_suspicion_cycle():
     # RTT-aware: once the coordinate converged, a clean record
     assert rtt_end == rtt_mid
     assert rtt_end <= flat_end
+
+
+def test_rtt_rescued_counter_counts_deadline_saves():
+    """`swim.probe.rtt_rescued`: every ack that lands AFTER the flat
+    Lifeguard deadline but inside the RTT-widened one is a probe the
+    coordinate subsystem saved from the indirect-probe/suspicion path
+    — the counter that makes the PR 3 win visible in
+    /v1/agent/metrics."""
+    from consul_tpu.utils import telemetry
+
+    def rescued_total():
+        snap = telemetry.default.snapshot()
+        for c in snap["Counters"]:
+            if c["Name"] == "consul.swim.probe.rtt_rescued":
+                return c["Count"]
+        return 0.0
+
+    cfg = GossipConfig.local()
+    net, serfs, events = make_cluster(3, cfg=cfg)
+    net.clock.advance(2.0)
+    far_addr = serfs[2].memberlist.transport.addr
+    # node2 behind a slow access link: acks arrive past the flat
+    # probe_timeout but well inside the protocol period
+    net.node_delay[far_addr] = cfg.probe_timeout * 1.3
+    before_learning = rescued_total()
+    net.clock.advance(6.0)  # Vivaldi learns node2's RTT
+    net.clock.advance(6.0)  # steady state: every late ack is a rescue
+    assert rescued_total() > before_learning
+    # and the member stayed cleanly alive throughout the window
+    assert alive_names(serfs[0]) == {"node0", "node1", "node2"}
+
+    # near members keep the tight floor: a fast cluster rescues nothing
+    net2, serfs2, _ = make_cluster(3, cfg=cfg, seed=7)
+    base = rescued_total()
+    net2.clock.advance(6.0)
+    assert rescued_total() == base
