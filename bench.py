@@ -16,6 +16,11 @@ scatter, Lifeguard timers, refutation race, epidemic dissemination).
 capture dir, a compile/dispatch/device wall-time split, and the flight
 recorder's (sim/flight.py) measured overhead at the default decimation
 stride on the full-model kernel (recorded as PROFILE_r*.json).
+
+`--mesh [--smoke]` runs the sharded engine's weak-scaling ladder
+(rounds/s per device count + efficiency + the compiled HLO's
+collectives-per-round count) and records it into MULTICHIP_r06.json —
+see run_mesh_bench.
 """
 
 import json
@@ -123,6 +128,162 @@ def _scenario_bench(metric_base: str, smoke: bool, n: int,
     }))
 
 
+def run_mesh_bench(smoke: bool) -> None:
+    """`bench.py --mesh [--smoke]`: the sharded engine's scaling ladder.
+
+    Runs the fused-lane mesh runner (sim/mesh.py) at a FIXED per-device
+    population over growing device counts and records rounds/s plus
+    weak-scaling efficiency (rps at d devices / rps at 1 — ideal is
+    1.0 since work scales with the mesh). The compiled HLO's collective
+    count rides along as proof of the one-psum-per-round property. The
+    JSON envelope is printed AND written to MULTICHIP_r06.json next to
+    this script; with no TPU attached the non-smoke run records the
+    BENCH_r05 `{"skipped": true}` watchdog convention instead (missing
+    hardware is not a perf regression), and `--smoke` measures the
+    real ladder on 8 virtual CPU devices, labeled as such."""
+    metric = "mesh_weak_scaling" + ("_smoke" if smoke else "")
+    want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
+    record_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r06.json")
+
+    def _emit(payload: dict, rc: int = 0) -> None:
+        line = json.dumps(payload, indent=2)
+        print(line, flush=True)
+        try:
+            with open(record_path, "w") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+        if rc:
+            sys.exit(rc)
+
+    if smoke:
+        # 8 virtual CPU devices; the flag is read at backend init, so
+        # setting it before the first jax.devices() call is in time
+        # even though the site hook pre-imported jax
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    def fire() -> None:
+        _emit({"metric": metric, "skipped": True,
+               "reason": f"backend init/compile exceeded "
+                         f"{_INIT_TIMEOUT_S:.0f}s (TPU device absent "
+                         "or tunnel hung)",
+               "platform": want})
+        os._exit(0)
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S, fire)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        import jax
+
+        if smoke:
+            jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        _emit({"metric": metric, "skipped": True,
+               "reason": f"backend init failed: {e}",
+               "platform": want})
+        return
+    watchdog.cancel()
+    platform = jax.default_backend()
+    if not smoke and platform == "cpu":
+        _emit({"metric": metric, "skipped": True,
+               "reason": "no TPU attached (cpu backend); run "
+                         "`bench.py --mesh --smoke` for the "
+                         "virtual-device ladder",
+               "platform": platform})
+        return
+
+    import re
+
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams, make_mesh, make_sharded_run
+    from consul_tpu.sim.mesh import init_sharded_state
+
+    def fire_hung() -> None:
+        _emit({"metric": metric, "skipped": False, "error":
+               f"mesh ladder exceeded {_INIT_TIMEOUT_S * 10:.0f}s "
+               "(compile or run hung)", "platform": platform})
+        os._exit(1)
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S * 10, fire_hung)
+    watchdog.daemon = True
+    watchdog.start()
+    per_dev = 8192 if smoke else 131_072
+    rounds = 50 if smoke else 500
+    iters = 2
+    key = jax.random.key(0)
+    ladder = []
+    collectives = None
+    counts = [d for d in (1, 2, 4, 8, 16, 32, 64)
+              if d <= len(devices)]
+    for d in counts:
+        n = per_dev * d
+        p = SimParams.from_gossip_config(
+            GossipConfig.lan(), n=n, loss=0.01, tcp_fallback=False,
+            collect_stats=False)
+        mesh = make_mesh(devices[:d])
+        run = make_sharded_run(p, rounds, mesh)
+        state = init_sharded_state(n, mesh)
+        if d == counts[-1]:
+            # one-collective-per-round proof from the compiled HLO:
+            # total all-reduces minus the two staged init_lanes
+            # reductions that run once, before the scan. Counted on a
+            # deliberately tiny 2-round build of the SAME mesh (the
+            # count is round- and size-invariant, asserted in tier-1)
+            # so the ladder's big program is never compiled twice.
+            p_probe = p.with_(n=128 * d)
+            probe = make_sharded_run(p_probe, 2, mesh)
+            txt = probe.lower(init_sharded_state(p_probe.n, mesh),
+                              key).compile().as_text()
+            total = len(re.findall(r"= \S+ all-reduce(?:-start)?\(",
+                                   txt))
+            collectives = total - 2
+        state = run(state, key)  # compile + warmup (donates input)
+        jax.block_until_ready(state)
+        best = float("inf")
+        for trial in range(3):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                state = run(state, jax.random.fold_in(
+                    key, 10 * trial + i))
+            checksum = float(state.informed.sum())
+            best = min(best, time.perf_counter() - t0)
+            assert checksum > 0
+        rps = rounds * iters / best
+        ladder.append({
+            "devices": d, "n": n,
+            "rounds_per_sec": round(rps, 1),
+            "ms_per_round": round(best / (rounds * iters) * 1e3, 4),
+        })
+    watchdog.cancel()
+    base = ladder[0]["rounds_per_sec"]
+    for row in ladder:
+        row["weak_scaling_efficiency"] = round(
+            row["rounds_per_sec"] / base, 4)
+    payload = {
+        "metric": metric,
+        "platform": platform,
+        "per_device_n": per_dev,
+        "rounds_per_chunk": rounds,
+        "collectives_per_round": collectives,
+        "ladder": ladder,
+        **({"smoke": True} if smoke else {}),
+    }
+    if platform != "tpu":
+        payload["tpu"] = {
+            "skipped": True,
+            "reason": "no TPU attached; ladder above measured on "
+                      f"{len(devices)} virtual {platform} devices"}
+    _emit(payload)
+
+
 def run_chaos_bench(smoke: bool) -> None:
     """`bench.py --chaos [--smoke]`: the detection-quality chaos suite —
     every named fault class (sim/scenarios.chaos_plans) through the
@@ -162,6 +323,12 @@ def main() -> None:
     # in the JSON), split wall time into compile/dispatch/device stages,
     # and measure the flight recorder's overhead at the default stride
     profile = "--profile" in sys.argv[1:]
+    if "--mesh" in sys.argv[1:]:
+        if profile:
+            print("--profile applies to the throughput bench only; "
+                  "ignored with --mesh", file=sys.stderr)
+        run_mesh_bench(smoke)
+        return
     if "--chaos" in sys.argv[1:]:
         if profile:
             print("--profile applies to the throughput bench only; "
@@ -303,6 +470,14 @@ def main() -> None:
     steady_s = time.perf_counter() - t0
     watchdog.cancel()
 
+    # every compiled runner DONATES its input state (in-place update;
+    # peak HBM ~1x state_bytes) — anywhere a state feeds two different
+    # runners, hand one of them a clone
+    def _clone(s):
+        import jax.numpy as jnp
+
+        return jax.tree.map(jnp.copy, s)
+
     # best-of-3 trials (the shared-chip tunnel adds scheduling noise).
     # Every trial ends with a device->host VALUE fetch: block_until_ready
     # alone has proven unreliable through the tunnel, and a fetched
@@ -323,7 +498,8 @@ def main() -> None:
     # flagship configs' shape) is timed too: VERDICT round-1 asked the
     # bench to say which kernel the headline number comes from and to
     # report both, not just the stable-config fast path
-    dstate = diag(state, jax.random.fold_in(key, 998))
+    timed_round_idx = int(state.round_idx)
+    dstate = diag(_clone(state), jax.random.fold_in(key, 998))
     jax.block_until_ready(dstate)  # compile before timing
     full_best = float("inf")
     diag_iters = 2 if smoke else 5  # 1000 rounds/trial amortizes overhead
@@ -347,7 +523,8 @@ def main() -> None:
             tempfile.mkdtemp(prefix="consul_tpu_profile_")
         try:
             with jax.profiler.trace(trace_dir):
-                pstate = run(state, jax.random.fold_in(key, 999))
+                pstate = run(_clone(state),
+                             jax.random.fold_in(key, 999))
                 jax.block_until_ready(pstate)
         except Exception as e:  # noqa: BLE001 — profiler optional
             print(f"jax.profiler.trace unavailable: {e}",
@@ -393,8 +570,8 @@ def main() -> None:
             else:
                 base_best = float("inf")
                 for trial in range(3):
+                    fs = _clone(dstate)
                     t0 = time.perf_counter()
-                    fs = dstate
                     for i in range(ov_iters):
                         fs = diag(fs, jax.random.fold_in(
                             key, 1900 + 10 * trial + i))
@@ -402,12 +579,13 @@ def main() -> None:
                     base_best = min(base_best,
                                     time.perf_counter() - t0)
                     assert checksum > 0
-            fs, tr = fl_run(dstate, jax.random.fold_in(key, 2000))
+            fs, tr = fl_run(_clone(dstate),
+                            jax.random.fold_in(key, 2000))
             jax.block_until_ready((fs, tr))  # compile before timing
             fl_best = float("inf")
             for trial in range(3):
+                fs = _clone(dstate)
                 t0 = time.perf_counter()
-                fs = dstate
                 for i in range(ov_iters):
                     fs, tr = fl_run(fs, jax.random.fold_in(
                         key, 2001 + 10 * trial + i))
@@ -424,13 +602,14 @@ def main() -> None:
             # K tracked agents at the default stride (the acceptance
             # bar is <5% vs the bare full-model kernel)
             tracked = default_tracked(n, p_diag.blackbox_k)
-            fs, tr, bb = bb_run(dstate, jax.random.fold_in(key, 2100),
+            fs, tr, bb = bb_run(_clone(dstate),
+                                jax.random.fold_in(key, 2100),
                                 tracked)
             jax.block_until_ready((fs, tr, bb.ring))
             bb_best = float("inf")
             for trial in range(3):
+                fs = _clone(dstate)
                 t0 = time.perf_counter()
-                fs = dstate
                 for i in range(ov_iters):
                     fs, tr, bb = bb_run(fs, jax.random.fold_in(
                         key, 2101 + 10 * trial + i), tracked)
@@ -479,7 +658,7 @@ def main() -> None:
     # and promptly refuted — pinned by
     # tests/test_conformance.py::test_bench_diag_suspicion_rate_calibration.
     st = jax.device_get(dstate.stats)
-    diag_rounds = max(int(dstate.round_idx) - int(state.round_idx), 1)
+    diag_rounds = max(int(dstate.round_idx) - timed_round_idx, 1)
     nr = n * diag_rounds
     print(f"devices={len(devices)} rounds={rounds} wall={dt:.2f}s "
           f"ms_per_round={dt/rounds*1000:.3f} kernel={kernel} | "

@@ -52,10 +52,12 @@ ENVELOPE — what this model can and cannot answer:
 
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import SimState, init_state, ALIVE, SUSPECT, DEAD, LEFT
-from consul_tpu.sim.round import (gossip_round, run_rounds,
+from consul_tpu.sim.round import (gossip_round, gossip_round_lanes,
+                                  run_rounds,
                                   run_rounds_coords,
                                   run_rounds_stats, run_rounds_flight,
-                                  make_run_rounds, make_run_rounds_flight)
+                                  make_run_rounds, make_run_rounds_flight,
+                                  make_run_rounds_lanes)
 from consul_tpu.sim.topology import (Topology, TopologyParams,
                                      make_topology, true_rtt, sample_rtt)
 from consul_tpu.sim.coords import (CoordState, init_coords, vivaldi_step,
@@ -73,10 +75,11 @@ from consul_tpu.sim.views import (ViewState, init_views, views_round,
                                   make_sharded_views_round)
 
 __all__ = [
-    "SimParams", "SimState", "init_state", "gossip_round", "run_rounds",
+    "SimParams", "SimState", "init_state", "gossip_round",
+    "gossip_round_lanes", "run_rounds",
     "run_rounds_coords",
     "run_rounds_stats", "run_rounds_flight", "make_run_rounds",
-    "make_run_rounds_flight",
+    "make_run_rounds_flight", "make_run_rounds_lanes",
     "Topology", "TopologyParams", "make_topology", "true_rtt",
     "sample_rtt",
     "CoordState", "init_coords", "vivaldi_step", "estimate_rtt",

@@ -124,6 +124,38 @@ def flight_row(*, up, status, informed, local_health, incarnation, t,
                             jnp.asarray(coord_row, jnp.float32)])
 
 
+def row_from_lanes(lanes: jnp.ndarray, n_pool: int, t, phase,
+                   stats_delta: SimStats) -> jnp.ndarray:
+    """One [N_COLS] trace row from an already-reduced lane vector
+    (registry.REDUCE_LANES — the fused lane engine's per-round output).
+
+    The gauge means divide the lane numerators by the pool size and the
+    max-health gauge decodes the exceedance histogram; nothing here
+    touches per-node arrays, so on the sharded engine a recorded round
+    costs NO reduction or collective beyond the round's one psum. The
+    lane indices come from the shared registry, same as the writers —
+    the pinned layout digest covers both sides."""
+    from consul_tpu.sim import lanes as lanes_mod
+    from consul_tpu.sim import registry
+
+    lane = registry.LANE
+    inv = 1.0 / float(n_pool)
+    gauges = jnp.stack([
+        jnp.asarray(t, jnp.float32),
+        lanes[lane["up_sum"]] * inv,
+        lanes[lane["informed_sum"]] * inv,
+        lanes[lane["suspect_sum"]] * inv,
+        lanes[lane["wrong_sum"]] * inv,
+        lanes[lane["lh_sum"]] * inv,
+        lanes_mod.max_lh_from_lanes(lanes),
+        lanes[lane["inc_sum"]],
+        jnp.asarray(phase, jnp.float32),
+    ])
+    coord_row = jnp.zeros((len(COORD_COLUMNS),), jnp.float32)
+    return jnp.concatenate([gauges, stats_vector(stats_delta),
+                            coord_row])
+
+
 def record_row(buf: jnp.ndarray, row: jnp.ndarray, i,
                record_every: int) -> jnp.ndarray:
     """Write `row` (round-local index `i`) into its decimation slot

@@ -1,0 +1,195 @@
+"""Fused reduction lanes: one reduction (and one collective) per round.
+
+Before this module, a gossip round issued ~37 independent scalar
+``reduce_sum`` sites (sim/round.py): population scalars, SimStats
+counters, and the flight recorder's gauges each reduced on their own.
+On one device XLA fuses most of that; under ``shard_map`` every site
+became its OWN tiny ``psum`` collective — ~10+ all-reduces per round of
+a few bytes each, which is exactly the per-event-message overhead that
+*The Algorithm of Pipelined Gossiping* (PAPERS.md) batches away. Here
+every per-round statistic is a named lane (sim/registry.REDUCE_LANES)
+of one stacked ``[N_REDUCE_LANES, nodes]`` contribution matrix, and the
+whole round reduces it ONCE.
+
+Two properties beyond the collective count:
+
+* **Shard-invariant sums.** The reduction always goes through a fixed
+  ``LANE_BLOCKS``-wide block table: contributions reduce to per-block
+  partials (block = a contiguous ``pool/LANE_BLOCKS`` node range), the
+  sharded engine psums the scattered ``[K, LANE_BLOCKS]`` table (each
+  shard owns its blocks, zeros elsewhere — adding zeros is exact for
+  the nonnegative lanes), and every shard then folds the SAME table in
+  the SAME order. f32 addition order — and therefore every lane value,
+  and therefore the dynamics they feed — is identical on 1 device and
+  on k devices.
+
+* **Shard-invariant PRNG.** Per-node uniforms are threefry bits of the
+  (round key, GLOBAL node index) pair, so a node draws the same value
+  no matter which shard holds it. Together with the block table this
+  makes the sharded engine's output BITWISE equal to the single-device
+  lane engine's (asserted in tests/test_sim_mesh.py), not just
+  statistically conformant.
+
+The lane layout itself lives in sim/registry.py next to the black-box
+event codes, covered by the pinned ``layout_digest`` — writers
+(sim/round.py lane mode, the Pallas kernel's partial-sum lanes) and
+consumers (sim/mesh.py, sim/flight.py) cannot drift silently.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.sim import registry
+from consul_tpu.sim.state import STATS_FIELDS, SimStats
+
+N_LANES = registry.N_REDUCE_LANES
+LANE = registry.LANE
+LANE_BLOCKS = registry.LANE_BLOCKS
+
+_N_SC = len(registry.LANE_SCALARS)
+_LAT = STATS_FIELDS.index("detect_latency_sum")
+_STATS_SLICE = slice(_N_SC, _N_SC + len(STATS_FIELDS))
+_GAUGE0 = _N_SC + len(STATS_FIELDS)
+_HIST_SLICE = slice(_GAUGE0 + len(registry.LANE_GAUGES), N_LANES)
+
+
+def check_pool(n: int) -> None:
+    if n % LANE_BLOCKS:
+        raise ValueError(
+            f"lane engine pools must divide LANE_BLOCKS={LANE_BLOCKS} "
+            f"blocks evenly: n={n}")
+
+
+def check_flight_config(p, flight_every) -> None:
+    """Shared flight-recorder precondition for BOTH lane-engine entry
+    points (round.make_run_rounds_lanes, mesh._make_mesh_run) — one
+    copy so the two factories cannot drift on what they accept.
+
+    Counter columns ride the SimStats lanes, so stats must be on; and
+    the max_local_health gauge decodes the lh exceedance histogram,
+    which covers lh >= 1..len(LANE_LH_HIST) — a larger awareness_max
+    would silently saturate the recorded gauge while the XLA recorder
+    reports the true max for the same run, so refuse loudly instead."""
+    if flight_every is None:
+        return
+    if not p.collect_stats:
+        raise ValueError(
+            "the flight recorder's counter columns ride the SimStats "
+            "lanes; build SimParams with collect_stats=True")
+    limit = len(registry.LANE_LH_HIST)
+    if p.awareness_max > limit:
+        raise ValueError(
+            f"the lane engine's flight max_local_health gauge covers "
+            f"awareness_max <= {limit} (registry.LANE_LH_HIST); got "
+            f"{p.awareness_max} — use the XLA run_rounds_flight "
+            "recorder for larger awareness ceilings")
+
+
+# ------------------------------------------------- shard-invariant PRNG
+
+
+def u01_global(key: jax.Array, offset, length: int) -> jnp.ndarray:
+    """[length] uniforms in [0,1) keyed by (key, GLOBAL node index).
+
+    One threefry2x32 evaluation per node on the counter pair
+    ``(0, offset+i)`` — explicitly paired so the value at global index
+    i is independent of the slice being computed (jax.random.uniform's
+    counter pairing is length-dependent, which is why it cannot give a
+    shard its slice of the global draw). 24-bit mantissa like the
+    Pallas kernel's on-chip generator."""
+    from jax.extend.random import threefry_2x32
+
+    kd = jax.random.key_data(key)
+    hi = jnp.zeros((length,), jnp.uint32)
+    lo = jnp.uint32(offset) + jax.lax.iota(jnp.uint32, length)
+    bits = threefry_2x32(kd, jnp.concatenate([hi, lo]))[:length]
+    return (bits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+# -------------------------------------------------- two-stage reduction
+
+
+def _block_partials(stack: jnp.ndarray, blocks: int) -> jnp.ndarray:
+    """[K, L] -> [K, blocks] contiguous-range partial sums. The inner
+    length L//blocks equals pool/LANE_BLOCKS for every shard count, so
+    the per-block f32 sums are bitwise identical however the pool is
+    sliced (the property the exactness tests pin)."""
+    k, length = stack.shape
+    return stack.reshape(k, blocks, length // blocks).sum(axis=2)
+
+
+def reduce_lanes_single(stack: jnp.ndarray) -> jnp.ndarray:
+    """Single-device lane reducer: ONE fused sum of the stacked
+    contribution matrix, via the same fixed block table the mesh
+    reducer psums — [K, L] -> [K, LANE_BLOCKS] -> [K].
+
+    The barrier between the stages is load-bearing: without it XLA's
+    algebraic simplifier merges the two reduces into one flat [K, L]
+    sum whose f32 accumulation order differs from the mesh's
+    block-then-table order (the psum is a natural barrier there), and
+    single-vs-sharded conformance degrades from bitwise to
+    approximate."""
+    part = jax.lax.optimization_barrier(
+        _block_partials(stack, LANE_BLOCKS))
+    return part.sum(axis=1)
+
+
+def mesh_lane_reducer(reduce_axes: Sequence[str], scope_shards: int):
+    """Lane reducer for a shard_map body: per-shard block partials are
+    scattered into the shard's own columns of a zero
+    ``[K, LANE_BLOCKS]`` table and the table is psummed over
+    `reduce_axes` — the round's ONE cross-device collective. Every
+    shard then folds the identical table exactly like
+    ``reduce_lanes_single`` does on one device.
+
+    `scope_shards` is the static number of shards inside the reduction
+    scope (all devices for the global pool; the "nodes" axis size for
+    per-DC pools)."""
+    if LANE_BLOCKS % scope_shards:
+        raise ValueError(
+            f"device count {scope_shards} must divide "
+            f"LANE_BLOCKS={LANE_BLOCKS}")
+    per = LANE_BLOCKS // scope_shards
+
+    def reducer(stack: jnp.ndarray) -> jnp.ndarray:
+        k = stack.shape[0]
+        part = jax.lax.optimization_barrier(_block_partials(stack, per))
+        idx = jnp.int32(0)
+        for ax in reduce_axes:
+            idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+        table = jnp.zeros((k, LANE_BLOCKS), jnp.float32)
+        table = jax.lax.dynamic_update_slice(table, part, (0, idx * per))
+        table = jax.lax.psum(table, tuple(reduce_axes))
+        return table.sum(axis=1)
+
+    return reducer
+
+
+# ------------------------------------------------------- lane consumers
+
+
+def scalars_from_lanes(lanes: jnp.ndarray) -> jnp.ndarray:
+    """The stale population-scalar vector (round.N_SCALARS layout) from
+    a reduced lane vector — consumption clamps applied HERE, after the
+    global reduction, never to the per-shard partials."""
+    s = lanes[:_N_SC]
+    return s.at[1].max(1.0).at[2].max(1e-9).at[7].max(1e-9)
+
+
+def stats_delta_from_lanes(lanes: jnp.ndarray) -> SimStats:
+    """This round's SimStats delta from the reduced lane vector
+    (int32-exact counter lanes; latency stays a genuine f32 sum)."""
+    d = lanes[_STATS_SLICE]
+    return SimStats(**{
+        f: d[i] if i == _LAT else d[i].astype(jnp.int32)
+        for i, f in enumerate(STATS_FIELDS)})
+
+
+def max_lh_from_lanes(lanes: jnp.ndarray) -> jnp.ndarray:
+    """Cluster max local health from the exceedance-count lanes."""
+    hist = lanes[_HIST_SLICE]
+    return jnp.sum((hist > 0.0).astype(jnp.float32))

@@ -7,24 +7,52 @@ mesh ("dc", "nodes") — "dc" models the WAN/multi-datacenter dimension and
 
 Because the round is fully Poissonized (sim/round.py), all cross-node
 coupling flows through a handful of *scalar* mean-field statistics. The
-sharded engine is therefore the SAME round function with its reducer
-swapped for a psum-wrapped sum — per-round ICI traffic is O(1) scalars,
-so scaling across chips is essentially free and the single-device and
-multi-device engines are behaviorally identical by construction (the
-conformance property the reference gets from its shared storage
-conformance suite, internal/storage/conformance).
+sharded engine is therefore the SAME round function — in fused-lane mode
+(sim/lanes.py): every per-round statistic (stale population scalars,
+SimStats counter deltas, flight gauge numerators) is one named lane of a
+single stacked contribution matrix, reduced with ONE psum collective per
+round. Batching the ~37 formerly-independent scalar reductions into one
+wire-efficient exchange is the lesson of *The Algorithm of Pipelined
+Gossiping* (PAPERS.md); per-round ICI traffic is one
+[N_REDUCE_LANES, LANE_BLOCKS] f32 table (~7.7KB), so scaling across
+chips is essentially free.
+
+Two conformance properties, both pinned in tests/test_sim_mesh.py:
+
+  * exactly ONE cross-device collective per round (asserted from the
+    compiled HLO — the two staged init_lanes reductions run once,
+    before the scan);
+  * the sharded engine's output is BITWISE equal to the single-device
+    lane engine's (round.make_run_rounds_lanes): per-node randomness is
+    keyed by global node index and the lane reduction always folds the
+    same fixed block table in the same f32 order, whatever the device
+    count — the conformance property the reference gets from its shared
+    storage conformance suite (internal/storage/conformance), here made
+    exact instead of statistical.
+
+Every runner DONATES its input state: the [N]-row buffers update in
+place, peak HBM stays ~1x state_bytes instead of double-buffering the
+cluster, and the passed-in SimState must not be reused after the call.
+
+FaultPlans (compile_plan output) and the flight recorder both thread
+through shard_body: plan phase tensors shard along the node axis and
+the decimated trace rows are assembled from the round's already-reduced
+lane vector — multi-chip chaos and telemetry cost no extra collectives.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from consul_tpu.faults import CompiledFaultPlan
+from consul_tpu.sim import lanes as lanes_mod
 from consul_tpu.sim.params import SimParams
-from consul_tpu.sim.round import gossip_round
+from consul_tpu.sim.round import _lane_scan
 from consul_tpu.sim.state import SimState, SimStats, init_state
 
 AXES = ("dc", "nodes")
@@ -51,76 +79,125 @@ def state_sharding(mesh: Mesh) -> SimState:
         stats=SimStats(*[rep] * len(SimStats._fields)))
 
 
+def _plan_specs() -> CompiledFaultPlan:
+    """PartitionSpecs for a CompiledFaultPlan: per-node [P, N] phase
+    tensors shard along the node axis; starts/mid stay replicated."""
+    row2 = P(None, AXES)
+    rep = P()
+    return CompiledFaultPlan(
+        starts=rep, psend=row2, precv=row2, suspw=row2, hear_w=row2,
+        mid=rep, slow_f=row2, crash_p=row2, rejoin_p=row2, leave_p=row2,
+        flap_half=row2, flap_release=row2)
+
+
 def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
-                   reduce_axes) -> "jax.stages.Wrapped":
-    """One factory for both mesh runners: `reduce_axes` scopes the
+                   reduce_axes,
+                   flight_every: Optional[int] = None,
+                   plan: Optional[CompiledFaultPlan] = None):
+    """One factory for every mesh runner: `reduce_axes` scopes the
     population coupling — ("dc","nodes") = one global pool,
-    ("nodes",) = independent per-DC pools."""
-    if p.collect_stats and tuple(reduce_axes) != AXES:
+    ("nodes",) = independent per-DC pools. `flight_every` arms the
+    flight recorder (rows from the reduced lane vector — no extra
+    collectives); `plan` threads a compiled FaultPlan through
+    shard_body (same-shape plans may be swapped per call)."""
+    reduce_axes = tuple(reduce_axes)
+    if p.collect_stats and reduce_axes != AXES:
         # stats out-specs are replicated; axis-scoped psums would leave
         # per-DC partial counters masquerading as global totals
         raise ValueError(
             "per-DC pools cannot carry global stats counters; build "
             "SimParams with collect_stats=False")
+    lanes_mod.check_flight_config(p, flight_every)
+    lanes_mod.check_pool(p.n)
+    scope_shards = 1
+    for ax in reduce_axes:
+        scope_shards *= mesh.shape[ax]
+    nodes_size = mesh.shape["nodes"]
+    with_plan = plan is not None
+    with_flight = flight_every is not None
     shardings = state_sharding(mesh)
     specs = jax.tree.map(lambda s: s.spec, shardings,
                          is_leaf=lambda x: isinstance(x, NamedSharding))
+    reducer = lanes_mod.mesh_lane_reducer(reduce_axes, scope_shards)
 
-    def psum_reduce(x: jnp.ndarray) -> jnp.ndarray:
-        return jax.lax.psum(jnp.sum(x), reduce_axes)
-
-    def shard_body(state: SimState, keys: jax.Array) -> SimState:
-        # per-shard independent RNG streams; with the psum reducer every
-        # shard (within the reduced axes) holds identical totals, so
-        # carried-in stats stay exact across rounds
-        shard = (jax.lax.axis_index("dc") * jax.lax.psum(1, "nodes")
+    def shard_body(state: SimState, keys: jax.Array, cp=None):
+        # global node offset of this shard's rows: the lane engine keys
+        # per-node randomness by GLOBAL index, so every shard draws its
+        # slice of the same global stream — no per-shard key folds
+        shard = (jax.lax.axis_index("dc") * nodes_size
                  + jax.lax.axis_index("nodes"))
+        offset = shard * state.up.shape[0]
+        return _lane_scan(state, keys, cp, p, rounds, flight_every,
+                          with_plan, reducer, offset)
 
-        def body(carry, k):
-            k = jax.random.fold_in(k, shard)
-            return gossip_round(carry, k, p, reduce_sum=psum_reduce), None
+    out_specs = (specs, P()) if with_flight else specs
+    if with_plan:
+        mapped = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(specs, P(), _plan_specs()),
+            out_specs=out_specs, check_rep=False)
 
-        final, _ = jax.lax.scan(body, state, keys)
-        return final
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run_plan(state: SimState, key: jax.Array, cp):
+            return mapped(state, jax.random.split(key, rounds), cp)
 
-    mapped = jax.shard_map(
-        shard_body, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
-        check_vma=False)
+        def run(state: SimState, key: jax.Array,
+                cp: Optional[CompiledFaultPlan] = None):
+            return run_plan(state, key, cp if cp is not None else plan)
 
-    @jax.jit
-    def run(state: SimState, key: jax.Array) -> SimState:
+        return run
+
+    mapped = shard_map(
+        shard_body, mesh=mesh, in_specs=(specs, P()),
+        out_specs=out_specs, check_rep=False)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(state: SimState, key: jax.Array):
         return mapped(state, jax.random.split(key, rounds))
 
     return run
 
 
-def make_sharded_run(p: SimParams, rounds: int, mesh: Mesh):
-    """Compiled multi-device runner over ONE global pool."""
-    return _make_mesh_run(p, rounds, mesh, AXES)
+def make_sharded_run(p: SimParams, rounds: int, mesh: Mesh,
+                     flight_every: Optional[int] = None,
+                     plan: Optional[CompiledFaultPlan] = None):
+    """Compiled multi-device runner over ONE global pool: exactly one
+    psum collective per gossip round; with `flight_every` the return
+    becomes (state, trace) — the decimated flight rows riding the same
+    collective."""
+    return _make_mesh_run(p, rounds, mesh, AXES,
+                          flight_every=flight_every, plan=plan)
 
 
-def make_multidc_run(p: SimParams, rounds: int, mesh: Mesh):
+def make_multidc_run(p: SimParams, rounds: int, mesh: Mesh,
+                     plan: Optional[CompiledFaultPlan] = None):
     """Per-DC independent LAN pools on the mesh's "dc" axis.
 
     The reference's datacenters are ISOLATED LAN gossip pools
-    (SURVEY.md §2.4): population scalars psum over "nodes" ONLY, so
+    (SURVEY.md §2.4): population lanes psum over "nodes" ONLY, so
     pools never couple. p.n is the PER-DC pool size."""
-    return _make_mesh_run(p, rounds, mesh, ("nodes",))
+    return _make_mesh_run(p, rounds, mesh, ("nodes",), plan=plan)
 
 
-def make_segmented_run(p: SimParams, rounds: int, mesh: Mesh):
+def make_segmented_run(p: SimParams, rounds: int, mesh: Mesh,
+                       plan: Optional[CompiledFaultPlan] = None):
     """Network segments as a sim axis (agent/consul/segment_ce.go):
     isolated LAN gossip pools WITHIN one datacenter. Mechanically
     identical to the multi-DC shape — each mesh row along the "dc"
-    axis is one segment's pool and population scalars psum over
+    axis is one segment's pool and population lanes psum over
     "nodes" only — so this shares make_multidc_run's kernel; the
     distinct entry point keeps the framework axis (Server.segment_serfs)
     and its sim twin visibly paired. p.n is the PER-SEGMENT pool size."""
-    return _make_mesh_run(p, rounds, mesh, ("nodes",))
+    return _make_mesh_run(p, rounds, mesh, ("nodes",), plan=plan)
 
 
 def init_sharded_state(n: int, mesh: Mesh) -> SimState:
-    """Device-placed initial state with the node axis partitioned."""
+    """Device-placed initial state with the node axis partitioned.
+
+    Built UNDER jit with out_shardings: each leaf materializes directly
+    into its shards — a 1M-node init never allocates an unsharded
+    host-side copy (the old path device_put a full [N] array per
+    leaf)."""
     shardings = state_sharding(mesh)
-    state = init_state(n)
-    return jax.tree.map(jax.device_put, state, shardings)
+    return jax.jit(functools.partial(init_state, n),
+                   out_shardings=shardings)()

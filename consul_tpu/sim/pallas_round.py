@@ -27,11 +27,36 @@ from jax.experimental.pallas import tpu as pltpu
 
 from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
                                fault_frame)
+from consul_tpu.sim import registry
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import (N_SCALARS, init_scalars,
                                   _pf_arrays, _shrink)
 from consul_tpu.sim.state import (ALIVE, DEAD, LEFT, SUSPECT, SimState,
                                   SimStats)
+
+#: the kernel's partial-sum lane order IS the registry's reduction-lane
+#: prefix: population scalars first, then the SimStats counters — one
+#: layout shared with the XLA lane engine (sim/lanes.py), covered by
+#: the pinned registry digest. The latency lane index drives which
+#: accumulator lane stays f32 (a genuine real-valued sum) while the
+#: others accumulate int32-exact.
+_LAT = registry.STATS_FIELDS.index("detect_latency_sum")
+assert registry.REDUCE_LANES[:N_SCALARS] == registry.LANE_SCALARS
+assert registry.REDUCE_LANES[N_SCALARS:N_SCALARS + 8] \
+    == registry.STATS_FIELDS
+
+
+def _stats_delta(acc_i, acc_lat) -> SimStats:
+    """SimStats from the int32 counter accumulator + f32 latency, in
+    registry.STATS_FIELDS lane order (the kernel's emit order)."""
+    return SimStats(**{
+        f: acc_lat if i == _LAT else acc_i[i]
+        for i, f in enumerate(registry.STATS_FIELDS)})
+
+
+def _stats_add(st: SimStats, acc_i, acc_lat) -> SimStats:
+    return SimStats(*[a + b for a, b in
+                      zip(st, _stats_delta(acc_i, acc_lat))])
 
 INF = 3.4e38  # python float: jnp constants can't be captured by kernels
 
@@ -325,8 +350,9 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
             jnp.sum(w_fail)]
     if p.collect_stats:
         # cumulative counters (round.py collect_stats blocks), appended
-        # as extra partial-sum lanes: [suspicions, refutes, fp, td,
-        # latency_sum, crashes, rejoins, leaves]
+        # as extra partial-sum lanes in registry.STATS_FIELDS order —
+        # the same registry.REDUCE_LANES prefix the XLA lane engine
+        # reduces (module-level asserts pin the alignment)
         fp = declare & up
         td = declare & ~up
         sums += [
@@ -407,52 +433,6 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
     return one_round, rows, n_arrays
 
 
-def _pack(state: SimState, rows: int, n_arrays: int):
-    def to2d(x):
-        return x.reshape(rows, LANES)
-
-    args = (to2d(state.up.astype(jnp.int8)), to2d(state.status),
-            to2d(state.incarnation), to2d(state.informed),
-            to2d(state.susp_start), to2d(state.susp_deadline),
-            to2d(state.susp_conf), to2d(state.local_health))
-    if n_arrays == 10:
-        args = args + (to2d(state.down_time),
-                       to2d(state.slow.astype(jnp.int8)))
-    return args
-
-
-def _unpack(args, state: SimState, n_arrays: int, t_final, rounds,
-            acc_i, acc_lat, p: SimParams) -> SimState:
-    (up, status, inc, informed, s_start, s_dead, s_conf,
-     lh) = args[:8]
-    if n_arrays == 10:
-        down, slow = args[8], args[9]
-        down_flat, slow_flat = down.reshape(-1), slow.reshape(-1) != 0
-    else:
-        down_flat, slow_flat = state.down_time, state.slow
-    st = state.stats
-    if p.collect_stats:
-        st = st._replace(
-            suspicions=st.suspicions + acc_i[0],
-            refutes=st.refutes + acc_i[1],
-            false_positives=st.false_positives + acc_i[2],
-            true_deaths_declared=st.true_deaths_declared + acc_i[3],
-            detect_latency_sum=st.detect_latency_sum + acc_lat,
-            crashes=st.crashes + acc_i[5],
-            rejoins=st.rejoins + acc_i[6],
-            leaves=st.leaves + acc_i[7])
-    return SimState(
-        up=up.reshape(-1) != 0, down_time=down_flat,
-        status=status.reshape(-1), incarnation=inc.reshape(-1),
-        informed=informed.reshape(-1),
-        susp_start=s_start.reshape(-1),
-        susp_deadline=s_dead.reshape(-1),
-        susp_conf=s_conf.reshape(-1),
-        local_health=lh.reshape(-1),
-        slow=slow_flat, t=t_final,
-        round_idx=state.round_idx + rounds, stats=st)
-
-
 def make_run_rounds_pallas(p: SimParams, rounds: int,
                            interpret: bool = False,
                            plan: Optional[CompiledFaultPlan] = None,
@@ -525,7 +505,11 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             "RTT-aware timeout studies")
     one_round, rows, n_arrays = _build_round(p, p.n, interpret, fault)
 
-    @jax.jit
+    # the 1M-row state is DONATED: the packed buffers update in place
+    # (peak HBM ~1x state_bytes, not 2x) and the passed-in SimState is
+    # dead after the call — chained hot loops rebind, everyone else
+    # keeps a copy first
+    @functools.partial(jax.jit, donate_argnums=0)
     def _run(state: SimState, key: jax.Array,
              cp: Optional[CompiledFaultPlan] = None,
              coo=None, topo=None, tracked=None):
@@ -575,10 +559,11 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                 .at[7].max(1e-9)
             # per-round block sums are < 2^24 (exact in f32); the
             # CARRY accumulates in int32 — a long scan would pass f32's
-            # integer range and silently drop counts. Latency (lane 4)
+            # integer range and silently drop counts. The latency lane
             # stays f32: it is a genuine real-valued sum.
-            acc_i = acc[0] + stat_sums.at[4].set(0.0).astype(jnp.int32)
-            acc_lat = acc[1] + stat_sums[4]
+            acc_i = acc[0] + stat_sums.at[_LAT].set(0.0) \
+                .astype(jnp.int32)
+            acc_lat = acc[1] + stat_sums[_LAT]
             t2 = t + p.probe_interval
             aux = None
             if with_coords:
@@ -621,13 +606,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                         buf_c, (pi, pl), bbc = c
                     else:
                         buf_c, (pi, pl) = c
-                    di = acc_i - pi
-                    delta = SimStats(
-                        suspicions=di[0], refutes=di[1],
-                        false_positives=di[2],
-                        true_deaths_declared=di[3],
-                        detect_latency_sum=acc_lat - pl,
-                        crashes=di[5], rejoins=di[6], leaves=di[7])
+                    delta = _stats_delta(acc_i - pi, acc_lat - pl)
                     # coord quality row computed INSIDE the decimation
                     # cond (matching the XLA recorder): skipped rounds
                     # skip the percentile sorts
@@ -686,18 +665,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                                     slow.reshape(-1) != 0)
         else:
             down_flat, slow_flat = state.down_time, state.slow
-        st = state.stats
-        if p.collect_stats:
-            st = st._replace(
-                suspicions=st.suspicions + acc_i[0],
-                refutes=st.refutes + acc_i[1],
-                false_positives=st.false_positives + acc_i[2],
-                true_deaths_declared=st.true_deaths_declared
-                + acc_i[3],
-                detect_latency_sum=st.detect_latency_sum + acc_lat,
-                crashes=st.crashes + acc_i[5],
-                rejoins=st.rejoins + acc_i[6],
-                leaves=st.leaves + acc_i[7])
+        st = (_stats_add(state.stats, acc_i, acc_lat)
+              if p.collect_stats else state.stats)
         out = SimState(
             up=up.reshape(-1) != 0, down_time=down_flat,
             status=status.reshape(-1), incarnation=inc.reshape(-1),

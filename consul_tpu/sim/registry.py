@@ -83,6 +83,75 @@ STATS_FIELDS = ("suspicions", "refutes", "false_positives",
                 "true_deaths_declared", "detect_latency_sum",
                 "crashes", "rejoins", "leaves")
 
+# ------------------------------------------------------ reduction lanes
+#
+# The fused reduction-lane plan (sim/lanes.py): every per-round
+# population statistic the engines reduce — the stale-scalar inputs for
+# the next round, the SimStats counter deltas, and the flight
+# recorder's gauge numerators — is one named lane of a single stacked
+# [N_REDUCE_LANES, nodes_local] contribution matrix, reduced with ONE
+# fused sum (and, on the sharded mesh engine, ONE psum collective) per
+# round. Writers (sim/round.py lane mode, sim/pallas_round.py partial
+# lanes) and consumers (sim/mesh.py, sim/flight.py row_from_lanes,
+# sim/metrics.py via the flight columns) all index THIS tuple; the
+# digest below pins it so a lane added on one side without the other
+# fails tier-1 loudly.
+
+#: stale-scalar population lanes, in the exact order sim/round.py's
+#: N_SCALARS vector has always used (raw sums; consumption clamps —
+#: n_elig>=1, n_up_elig/lfail_den>=1e-9 — are applied at READ time by
+#: lanes.scalars_from_lanes, never before the cross-device reduction)
+LANE_SCALARS = (
+    "n_live",          # sum(up)
+    "n_elig",          # sum(status in {ALIVE, SUSPECT})
+    "n_up_elig",       # sum(up & elig)
+    "n_slow_up_elig",  # sum(slow_eff & up & elig) — sbar numerator
+    "pf_fast_sum",     # sum(up · pf_fast): E[miss | fast target] num.
+    "pf_slow_sum",     # sum(up · pf_slow): E[miss | slow target] num.
+    "lfail_num",       # sum(w_fail · (LH+1)) — Lifeguard timer scale
+    "lfail_den",       # sum(w_fail)
+)
+
+#: flight-recorder gauge numerators — post-round state sums; the row's
+#: means divide by the pool size at consumption (flight.row_from_lanes)
+LANE_GAUGES = (
+    "up_sum",        # live_frac numerator
+    "informed_sum",  # mean_informed numerator
+    "suspect_sum",   # suspect_frac numerator
+    "wrong_sum",     # wrong_frac numerator
+    "lh_sum",        # mean_local_health numerator
+    "inc_sum",       # inc_bumps (sum of incarnations)
+)
+
+#: Lifeguard-health exceedance histogram: lane k = count of nodes with
+#: local_health >= k+1. A max is not a sum, so the cluster-wide
+#: max_local_health gauge rides the one psum as these count lanes —
+#: max = #{k : count > 0}, exact while awareness_max <= 8 (the default;
+#: larger maxima saturate the reported gauge at 8).
+LANE_LH_HIST = tuple(f"lh_ge_{k}" for k in range(1, 9))
+
+#: the full lane layout: population scalars, per-round SimStats counter
+#: deltas (int32-exact values carried in f32 lanes — each round's delta
+#: is far below f32's 2^24 integer range), then the flight gauges.
+#: The first len(LANE_SCALARS)+len(STATS_FIELDS) lanes are exactly the
+#: partial-sum lane order the Pallas kernel has always emitted.
+REDUCE_LANES = LANE_SCALARS + STATS_FIELDS + LANE_GAUGES + LANE_LH_HIST
+
+N_REDUCE_LANES = len(REDUCE_LANES)
+
+#: lane index by name — the device writers and every consumer share it
+LANE = {name: i for i, name in enumerate(REDUCE_LANES)}
+
+#: fixed block count for the shard-invariant two-stage lane reduction
+#: (sim/lanes.py): contributions reduce to per-block partials first,
+#: then the [N_REDUCE_LANES, LANE_BLOCKS] block table reduces to the
+#: lane vector. The block grid is the SAME for every device count, so
+#: 1-device and k-device runs sum in the same f32 order — bitwise-equal
+#: lane values, which is what makes sharded-vs-single-device
+#: conformance EXACT instead of statistical. Pool sizes must divide by
+#: LANE_BLOCKS; device counts must divide LANE_BLOCKS.
+LANE_BLOCKS = 64
+
 
 def flight_columns() -> tuple[str, ...]:
     """The full flight-trace row layout, in column order."""
@@ -95,7 +164,8 @@ def layout_digest() -> str:
     h = hashlib.sha256()
     for group in (FLIGHT_GAUGE_COLUMNS, STATS_FIELDS,
                   FLIGHT_COORD_COLUMNS, BLACKBOX_RECORD_FIELDS,
-                  BLACKBOX_EVENTS, BLACKBOX_PROBE_EVENTS):
+                  BLACKBOX_EVENTS, BLACKBOX_PROBE_EVENTS,
+                  REDUCE_LANES, (str(LANE_BLOCKS),)):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

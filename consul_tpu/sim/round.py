@@ -73,7 +73,8 @@ def _trunc_poisson(u: jnp.ndarray, lam: jnp.ndarray, kmax: int = 4
 def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
                 reduce_sum: Reducer = jnp.sum,
                 fx: Optional[FaultFrame] = None,
-                coords=None, topo=None, events: bool = False):
+                coords=None, topo=None, events: bool = False,
+                lane_sink: Optional[dict] = None, u01=None):
     """ONE protocol period — the single copy of the protocol body.
 
     `scalars=None` → live mode: population scalars computed from the
@@ -104,12 +105,27 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     detection becomes topology-sensitive. Both tensors are DATA: one
     compile per shape, coords-off tracing is bit-identical to the seed
     (the coord PRNG keys are folded off the round key separately).
+
+    `lane_sink` (a dict, lane-mode only; requires stale `scalars`) arms
+    the fused reduction-lane plan (sim/lanes.py): NO reduce_sum call
+    runs — every population statistic instead lands as a per-node
+    contribution array keyed by its registry.REDUCE_LANES name, for the
+    caller (gossip_round_lanes) to stack and reduce ONCE. Stats are
+    left on the carried SimStats untouched; the caller applies the
+    reduced deltas. `u01` overrides the per-node uniform source (lane
+    mode passes the shard-invariant global-counter generator); the
+    default is jax.random.uniform, bit-identical to the seed engine.
     """
     n = p.n
     t = state.t
     t_end = t + p.probe_interval
     k_churn, k_slow, k_ack, k_pois, k_hear = jax.random.split(key, 5)
     L = state.up.shape[0]  # local rows (== n on a single device)
+    if lane_sink is not None and scalars is None:
+        raise ValueError("lane mode runs on stale scalars only")
+    if u01 is None:
+        def u01(k):
+            return jax.random.uniform(k, (L,))
 
     up = state.up
     status = state.status
@@ -126,7 +142,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     # ------------------------------------------------------------------ churn
     if p.fail_per_round or p.leave_per_round or p.rejoin_per_round \
             or fx is not None:
-        u = jax.random.uniform(k_churn, (L,))
+        u = u01(k_churn)
         # fault-plan churn bursts and flap schedules ride the same
         # channels as the params churn model (rates add; flap uses
         # deterministic p=1 level signals)
@@ -151,7 +167,11 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         informed = jnp.where(started, 1.0 / n, informed)
         s_dead = jnp.where(started, INF, s_dead)
         new_rumor |= started
-        if p.collect_stats:
+        if lane_sink is not None:
+            lane_sink["crashes"] = crash.astype(jnp.float32)
+            lane_sink["leaves"] = leave.astype(jnp.float32)
+            lane_sink["rejoins"] = rejoin.astype(jnp.float32)
+        elif p.collect_stats:
             st = st._replace(
                 crashes=st.crashes + reduce_sum(crash.astype(jnp.int32)),
                 leaves=st.leaves + reduce_sum(leave.astype(jnp.int32)),
@@ -161,7 +181,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
 
     # -------------------------------------------------- degraded-node churn
     if p.slow_per_round:
-        u_s = jax.random.uniform(k_slow, (L,))
+        u_s = u01(k_slow)
         slow = jnp.where(slow, u_s >= p.slow_recover_per_round,
                          u_s < p.slow_per_round) & up
     # forced-slow (GC-pause fault primitive) is ephemeral: it shapes this
@@ -247,7 +267,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     mix_i = (1.0 - sbar) * pf_fast + sbar * pf_slow
     p_ack = frac_up_elig * (1.0 - mix_i)
     prober = up
-    ack = prober & (jax.random.uniform(k_ack, (L,)) < p_ack)
+    ack = prober & (u01(k_ack) < p_ack)
     late = None
     if timely is not None:
         # a late ack is a missed deadline: the prober escalates
@@ -304,7 +324,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         base_fail = 1.0 - (1.0 - base_fail) * (1.0 - late_in)
     p_fail_j = jnp.where(up, base_fail, 1.0)
     lam_fail = probe_rate * p_fail_j * eligf
-    n_fail = _trunc_poisson(jax.random.uniform(k_pois, (L,)), lam_fail)
+    n_fail = _trunc_poisson(u01(k_pois), lam_fail)
 
     # Mean Lifeguard (LH+1) scale of failing probers — the timer that
     # declares dead runs at a suspector, scaled by ITS local health.
@@ -327,7 +347,9 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     s_conf = jnp.where(starts, c0, s_conf.astype(jnp.int32))
     informed = jnp.where(starts, 1.0 / n, informed)
     new_rumor |= starts
-    if p.collect_stats:
+    if lane_sink is not None:
+        lane_sink["suspicions"] = starts.astype(jnp.float32)
+    elif p.collect_stats:
         st = st._replace(
             suspicions=st.suspicions + reduce_sum(starts.astype(jnp.int32)))
 
@@ -356,7 +378,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         lam_hear = lam_hear * fx.hear_w
     p_hear = 1.0 - jnp.exp(-lam_hear)
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
-    refute = wrongly & (jax.random.uniform(k_hear, (L,)) < p_hear)
+    refute = wrongly & (u01(k_hear) < p_hear)
     status = jnp.where(refute, jnp.int8(ALIVE), status)
     inc = jnp.where(refute, inc + 1, inc)
     informed = jnp.where(refute, 1.0 / n, informed)
@@ -366,7 +388,9 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     if p.lifeguard:
         lh = jnp.clip(lh.astype(jnp.int32) + refute.astype(jnp.int32), 0,
                       p.awareness_max).astype(lh.dtype)
-    if p.collect_stats:
+    if lane_sink is not None:
+        lane_sink["refutes"] = refute.astype(jnp.float32)
+    elif p.collect_stats:
         st = st._replace(
             refutes=st.refutes + reduce_sum(refute.astype(jnp.int32)))
 
@@ -376,7 +400,13 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     informed = jnp.where(declare, 1.0 / n, informed)
     s_dead = jnp.where(declare, INF, s_dead)
     new_rumor |= declare
-    if p.collect_stats:
+    if lane_sink is not None:
+        fp, tp = declare & up, declare & ~up
+        lane_sink["false_positives"] = fp.astype(jnp.float32)
+        lane_sink["true_deaths_declared"] = tp.astype(jnp.float32)
+        lane_sink["detect_latency_sum"] = jnp.where(
+            tp, t_end - down_time, 0.0)
+    elif p.collect_stats:
         fp, tp = declare & up, declare & ~up
         st = st._replace(
             false_positives=st.false_positives
@@ -413,11 +443,40 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
             else (rtt_obs * 1e6).astype(jnp.int32))
     if scalars is None:
         return out, None, coords_out, coord_aux, ev
-    # stale mode: produce next round's scalars in this same fused pass
     upf2 = up.astype(jnp.float32)
     elig2 = (status == ALIVE) | (status == SUSPECT)
     elig2f = elig2.astype(jnp.float32)
     w_fail2 = upf2 * (1.0 - p_ack)
+    if lane_sink is not None:
+        # fused-lane mode: every population statistic is a per-node
+        # CONTRIBUTION array — the caller stacks registry.REDUCE_LANES
+        # order and reduces once. Raw sums only; consumption clamps
+        # (n_elig>=1 etc.) live in lanes.scalars_from_lanes, applied
+        # AFTER the global reduction.
+        lhf = lh.astype(jnp.float32)
+        lane_sink.update({
+            "n_live": upf2,
+            "n_elig": elig2f,
+            "n_up_elig": upf2 * elig2f,
+            "n_slow_up_elig": (slow_eff & up & elig2).astype(jnp.float32),
+            "pf_fast_sum": upf2 * pf_fast,
+            "pf_slow_sum": upf2 * pf_slow,
+            "lfail_num": w_fail2 * (lhf + 1.0),
+            "lfail_den": w_fail2,
+            # flight gauge numerators (post-round state)
+            "up_sum": upf2,
+            "informed_sum": informed,
+            "suspect_sum": (status == SUSPECT).astype(jnp.float32),
+            "wrong_sum": (up & ((status == SUSPECT) | (status == DEAD))
+                          ).astype(jnp.float32),
+            "lh_sum": lhf,
+            "inc_sum": inc.astype(jnp.float32),
+        })
+        lhi = lh.astype(jnp.int32)
+        for k in range(1, 9):
+            lane_sink[f"lh_ge_{k}"] = (lhi >= k).astype(jnp.float32)
+        return out, None, coords_out, coord_aux, ev
+    # stale mode: produce next round's scalars in this same fused pass
     new_scalars = jnp.stack([
         reduce_sum(upf2),
         jnp.maximum(reduce_sum(elig2f), 1.0),
@@ -543,10 +602,170 @@ def gossip_round_fast(state: SimState, scalars: jnp.ndarray,
     return out, sc, c2, aux
 
 
-def make_run_rounds_fast(p: SimParams, rounds: int):
-    """Stale-scalar hot loop: state, key -> state (max throughput)."""
+# ----------------------------------------------------- fused lane engine
 
-    @jax.jit
+
+def gossip_round_lanes(state: SimState, lanes_prev: jnp.ndarray,
+                       key: jax.Array, p: SimParams, *,
+                       lane_reducer, shard_offset=0,
+                       fx: Optional[FaultFrame] = None):
+    """One protocol period on the fused reduction-lane plan.
+
+    The SAME protocol body as every other engine (_round_core), in lane
+    mode: stale population scalars come from `lanes_prev`
+    (registry.LANE_SCALARS prefix, clamped at read), every per-round
+    statistic lands as a per-node contribution array, and the whole
+    round reduces the stacked [N_REDUCE_LANES, L] matrix with ONE
+    `lane_reducer` call — `lanes.reduce_lanes_single` on one device,
+    `lanes.mesh_lane_reducer` (one psum collective) per shard_map
+    shard. Per-node randomness is keyed by GLOBAL node index
+    (`shard_offset` + local row), so any sharding of the same pool
+    draws identical values — sharded output is bitwise equal to the
+    single-device lane engine, not merely statistically conformant.
+
+    Returns (state', lanes'): the reduced lane vector feeds the next
+    round's scalars AND carries this round's stats deltas and flight
+    gauge numerators — consumers read it instead of re-reducing.
+    """
+    from consul_tpu.sim import lanes as lanes_mod
+    from consul_tpu.sim import registry
+
+    L = state.up.shape[0]
+    sink: dict = {}
+
+    def u01(k):
+        return lanes_mod.u01_global(k, shard_offset, L)
+
+    scalars = lanes_mod.scalars_from_lanes(lanes_prev)
+    out, _, _, _, _ = _round_core(state, scalars, key, p, fx=fx,
+                                  lane_sink=sink, u01=u01)
+    zeros = jnp.zeros((L,), jnp.float32)
+    stack = jnp.stack([sink.get(name, zeros)
+                       for name in registry.REDUCE_LANES])
+    lanes = lane_reducer(stack)
+    if p.collect_stats:
+        delta = lanes_mod.stats_delta_from_lanes(lanes)
+        out = out._replace(stats=jax.tree.map(
+            lambda a, b: a + b, out.stats, delta))
+    return out, lanes
+
+
+def init_lanes(state: SimState, p: SimParams, lane_reducer) -> jnp.ndarray:
+    """Exact first-round lane vector (init_scalars' math through the
+    lane reducer): two staged reductions — population counts first,
+    then the pf/Lifeguard sums that need sbar — so warm-started states
+    (pre-crashed nodes, chained runs) enter the loop with exact
+    scalars. These are the only reductions outside the per-round ONE;
+    both run before the scan, so the one-collective-per-ROUND property
+    is untouched."""
+    up, status, slow, lh = (state.up, state.status, state.slow,
+                            state.local_health)
+    upf = up.astype(jnp.float32)
+    elig = (status == ALIVE) | (status == SUSPECT)
+    eligf = elig.astype(jnp.float32)
+    a = lane_reducer(jnp.stack([
+        upf, eligf, upf * eligf,
+        (slow & up & elig).astype(jnp.float32)]))
+    n_live = a[0]
+    n_elig = jnp.maximum(a[1], 1.0)
+    n_up_elig = jnp.maximum(a[2], 1e-9)
+    sbar = a[3] / n_up_elig
+    _, pf_fast, pf_slow = _pf_arrays(slow, lh, sbar, n_live / p.n, p)
+    mix = (1.0 - sbar) * pf_fast + sbar * pf_slow
+    p_ack = (n_up_elig / n_elig) * (1.0 - mix)
+    w_fail = upf * (1.0 - p_ack)
+    b = lane_reducer(jnp.stack([
+        upf * pf_fast, upf * pf_slow,
+        w_fail * (lh.astype(jnp.float32) + 1.0), w_fail]))
+    from consul_tpu.sim import lanes as lanes_mod
+
+    lanes = jnp.zeros((lanes_mod.N_LANES,), jnp.float32)
+    return lanes.at[0:4].set(a).at[4:8].set(b)
+
+
+def _lane_scan(state: SimState, keys: jax.Array, cp, p: SimParams,
+               rounds: int, flight_every: Optional[int],
+               with_plan: bool, lane_reducer, shard_offset):
+    """The lane engine's scan loop — ONE copy shared by the
+    single-device runner (make_run_rounds_lanes) and every mesh shard
+    (sim/mesh.shard_body), so the two paths cannot drift: only the
+    reducer and the node-index offset differ. Flight rows are built
+    from the already-reduced lane vector (flight.row_from_lanes) inside
+    the decimation cond — recording costs no extra reduction and, on
+    the mesh, no extra collective."""
+    from consul_tpu.sim import flight
+
+    with_flight = flight_every is not None
+    lanes0 = init_lanes(state, p, lane_reducer)
+    buf0 = (flight.empty_trace(rounds, flight_every) if with_flight
+            else jnp.zeros((0,), jnp.float32))
+
+    def body(carry, x):
+        s, lv, buf, prev = carry
+        k, i = x
+        fx = fault_frame(cp, s.round_idx) if with_plan else None
+        s2, lv2 = gossip_round_lanes(s, lv, k, p,
+                                     lane_reducer=lane_reducer,
+                                     shard_offset=shard_offset, fx=fx)
+        if with_flight:
+            ph = active_phase(cp, s.round_idx) if with_plan \
+                else jnp.int32(-1)
+
+            def rec(cc):
+                b, pv = cc
+                row = flight.row_from_lanes(
+                    lv2, p.n, s2.t, ph,
+                    flight.stats_delta(s2.stats, pv))
+                return (flight.record_row(b, row, i, flight_every),
+                        s2.stats)
+
+            buf, prev = flight.maybe_record((buf, prev), i, rounds,
+                                            flight_every, rec)
+        return (s2, lv2, buf, prev), None
+
+    (final, _, buf, _), _ = jax.lax.scan(
+        body, (state, lanes0, buf0, state.stats),
+        (keys, jnp.arange(rounds, dtype=jnp.int32)))
+    return (final, buf) if with_flight else final
+
+
+def make_run_rounds_lanes(p: SimParams, rounds: int,
+                          flight_every: Optional[int] = None,
+                          plan: Optional[CompiledFaultPlan] = None):
+    """Single-device fused-lane runner: state, key -> state (or
+    (state, trace) with `flight_every`). The exact engine the sharded
+    mesh wraps — same scan, same shard-invariant PRNG, same block-table
+    reduction — so its output is the bitwise reference for
+    multi-device conformance (tests/test_sim_mesh.py). The input state
+    is DONATED: the [N]-row buffers update in place and the passed
+    SimState must not be reused after the call."""
+    from consul_tpu.sim import lanes as lanes_mod
+
+    lanes_mod.check_pool(p.n)
+    lanes_mod.check_flight_config(p, flight_every)
+    with_plan = plan is not None
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def _run(state: SimState, key: jax.Array, cp):
+        keys = jax.random.split(key, rounds)
+        return _lane_scan(state, keys, cp, p, rounds, flight_every,
+                          with_plan, lanes_mod.reduce_lanes_single, 0)
+
+    def run(state: SimState, key: jax.Array,
+            cp: Optional[CompiledFaultPlan] = None):
+        if cp is not None and not with_plan:
+            raise ValueError("this runner was built without a fault "
+                             "plan; rebuild with plan= to inject one")
+        return _run(state, key, cp if cp is not None else plan)
+
+    return run
+
+
+def make_run_rounds_fast(p: SimParams, rounds: int):
+    """Stale-scalar hot loop: state, key -> state (max throughput).
+    The input state is donated (updates in place)."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
     def run(state: SimState, key: jax.Array,
             plan: Optional[CompiledFaultPlan] = None) -> SimState:
         scalars = init_scalars(state, p)
@@ -565,7 +784,8 @@ def make_run_rounds_fast(p: SimParams, rounds: int):
     return run
 
 
-@functools.partial(jax.jit, static_argnames=("p", "rounds", "trace_node"))
+@functools.partial(jax.jit, static_argnames=("p", "rounds", "trace_node"),
+                   donate_argnums=(0,))
 def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
                trace_node: Optional[int] = None,
                plan: Optional[CompiledFaultPlan] = None):
@@ -573,6 +793,12 @@ def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
 
     Returns (final_state, trace) where trace is the per-round informed
     fraction of `trace_node` (for propagation/convergence curves) or None.
+
+    The input `state` is DONATED: the [N]-row buffers are updated in
+    place (peak HBM stays ~1x state_bytes instead of double-buffering
+    the cluster), and the passed-in SimState must not be touched after
+    the call — reuse raises jax's deleted-array error. Callers that
+    need the pre-run state keep their own copy first.
 
     `plan` is a compiled FaultPlan (faults.compile_plan): the scan body
     derives each round's FaultFrame by indexing the per-phase tensors
@@ -593,7 +819,8 @@ def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
     return final, trace
 
 
-@functools.partial(jax.jit, static_argnames=("p", "rounds"))
+@functools.partial(jax.jit, static_argnames=("p", "rounds"),
+                   donate_argnums=(0,))
 def run_rounds_coords(state: SimState, coords, topo, key: jax.Array,
                       p: SimParams, rounds: int,
                       plan: Optional[CompiledFaultPlan] = None):
@@ -621,7 +848,8 @@ def run_rounds_coords(state: SimState, coords, topo, key: jax.Array,
     return final, cf, trace
 
 
-@functools.partial(jax.jit, static_argnames=("p", "rounds"))
+@functools.partial(jax.jit, static_argnames=("p", "rounds"),
+                   donate_argnums=(0,))
 def run_rounds_stats(state: SimState, key: jax.Array, p: SimParams,
                      rounds: int,
                      plan: Optional[CompiledFaultPlan] = None):
@@ -643,9 +871,10 @@ def run_rounds_stats(state: SimState, key: jax.Array, p: SimParams,
 
 
 def make_run_rounds(p: SimParams, rounds: int):
-    """A pre-bound compiled runner: state, key -> state (bench hot loop)."""
+    """A pre-bound compiled runner: state, key -> state (bench hot
+    loop). The input state is donated (updates in place)."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=0)
     def run(state: SimState, key: jax.Array) -> SimState:
         def body(carry, k):
             return gossip_round(carry, k, p), None
@@ -659,7 +888,8 @@ def make_run_rounds(p: SimParams, rounds: int):
 
 @functools.partial(jax.jit,
                    static_argnames=("p", "rounds", "record_every",
-                                    "ring_len"))
+                                    "ring_len"),
+                   donate_argnums=(0,))
 def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
                       rounds: int, record_every: int = 1,
                       plan: Optional[CompiledFaultPlan] = None,

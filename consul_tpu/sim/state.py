@@ -36,8 +36,14 @@ class SimStats(NamedTuple):
 
     @staticmethod
     def zeros() -> "SimStats":
-        z = jnp.zeros((), jnp.int32)
-        return SimStats(z, z, z, z, jnp.zeros((), jnp.float32), z, z, z)
+        # one buffer PER field: the compiled runners donate the whole
+        # SimState, and donating the same (shared) buffer twice is an
+        # XLA error
+        def z():
+            return jnp.zeros((), jnp.int32)
+
+        return SimStats(z(), z(), z(), z(),
+                        jnp.zeros((), jnp.float32), z(), z(), z())
 
 
 #: Canonical lane order for vectorized SimStats traces. This is the

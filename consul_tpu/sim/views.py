@@ -596,7 +596,7 @@ def make_sharded_views_round(p: SimParams, mesh,
     Returns (round_fn, init_fn); round_fn(state, key) is jit-compiled
     over the mesh, state lives sharded P("viewers", None).
     """
-    from jax import shard_map
+    from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     assert exchange in ("all_to_all", "pmax"), \
@@ -869,7 +869,7 @@ def make_sharded_views_round(p: SimParams, mesh,
     smapped = shard_map(
         local_round, mesh=mesh,
         in_specs=(spec_state, P()),
-        out_specs=spec_state, check_vma=False)
+        out_specs=spec_state, check_rep=False)
     round_fn = jax.jit(smapped)
 
     def init_fn() -> ViewState:

@@ -52,12 +52,43 @@ def _run_tracked_all(p, rounds, key=0, plan=None, ring_len=512):
 
 
 def test_layout_registry_digest_pinned():
-    """Adding/removing/reordering ANY flight column or black-box event
-    code must change this digest — update the pin AND audit every
-    decoder (flight.COL consumers, blackbox.decode_timeline,
-    metrics.blackbox_report, ARCHITECTURE.md tables) in the same
+    """Adding/removing/reordering ANY flight column, black-box event
+    code, or reduction lane must change this digest — update the pin
+    AND audit every decoder (flight.COL consumers, lanes.py consumers,
+    blackbox.decode_timeline, metrics.blackbox_report, the Pallas
+    partial-sum lane slices, ARCHITECTURE.md tables) in the same
     change."""
-    assert registry.layout_digest() == "6e8863da10de6dba"
+    assert registry.layout_digest() == "8abcce46bb67b7d3"
+
+
+def test_reduce_lane_layout_pinned():
+    """The fused reduction-lane plan (sim/lanes.py): writers
+    (round.py lane mode, the Pallas kernel's partial sums) and
+    consumers (mesh.py, flight.row_from_lanes) all index
+    registry.REDUCE_LANES — drift on either side must fail HERE, not
+    as silently-wrong telemetry."""
+    from consul_tpu.sim import lanes as lanes_mod
+
+    n_sc = len(registry.LANE_SCALARS)
+    # the Pallas kernel's historical partial-sum emit order IS the
+    # lane prefix: population scalars then the stats counters
+    assert registry.REDUCE_LANES[:n_sc] == registry.LANE_SCALARS
+    assert registry.REDUCE_LANES[n_sc:n_sc + len(STATS_FIELDS)] \
+        == registry.STATS_FIELDS
+    assert registry.N_REDUCE_LANES == (
+        n_sc + len(STATS_FIELDS) + len(registry.LANE_GAUGES)
+        + len(registry.LANE_LH_HIST))
+    assert registry.N_REDUCE_LANES == 30
+    # index table round-trips
+    assert [registry.REDUCE_LANES[i]
+            for i in sorted(registry.LANE.values())] \
+        == list(registry.REDUCE_LANES)
+    # the block-table geometry every engine assumes
+    assert lanes_mod.LANE_BLOCKS == registry.LANE_BLOCKS == 64
+    assert lanes_mod.N_LANES == registry.N_REDUCE_LANES
+    from consul_tpu.sim.round import N_SCALARS
+
+    assert N_SCALARS == n_sc
 
 
 def test_device_layouts_and_decoder_tables_stay_in_sync():

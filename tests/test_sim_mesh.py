@@ -4,7 +4,15 @@ Runs on 8 virtual CPU devices (conftest.py). Verifies that the multi-chip
 program compiles and executes, that cross-shard suspicion delivery works
 (a crash in one shard is detected by probers in other shards), and that
 the sharded engine's detector statistics match the single-device engine.
+
+The fused-lane engine (sim/lanes.py) upgrades part of that conformance
+from statistical to EXACT: shard-invariant per-node PRNG + the fixed
+block-table reduction make the sharded runner's output bitwise equal to
+the single-device lane runner's, the flight trace included; and the
+compiled HLO carries exactly ONE cross-device collective per round.
 """
+
+import re
 
 import jax
 import jax.numpy as jnp
@@ -12,9 +20,196 @@ import numpy as np
 import pytest
 
 from consul_tpu.sim import (DEAD, SimParams, init_state, make_mesh,
-                            make_sharded_run, run_rounds)
+                            make_run_rounds_lanes, make_sharded_run,
+                            run_rounds)
 from consul_tpu.sim.mesh import init_sharded_state
 from consul_tpu.sim.metrics import fd_report
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(jax.device_get(x)),
+                       np.asarray(jax.device_get(y)))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+_P_EXACT = SimParams(n=512, loss=0.08, tcp_fallback=False,
+                     fail_per_round=0.005, rejoin_per_round=0.02,
+                     slow_per_round=0.002)
+
+
+@pytest.mark.parametrize("dc", [1, 2])
+def test_sharded_bitwise_equals_single_device(devices8, dc):
+    """The headline conformance claim: same pool, same key — the
+    8-device mesh run and the single-device lane runner produce the
+    SAME SimState bit for bit (every per-node array and every stats
+    counter), because per-node draws are keyed by global node index and
+    the lane reduction folds a device-count-invariant block table."""
+    rounds = 60
+    single = make_run_rounds_lanes(_P_EXACT, rounds)(
+        init_state(_P_EXACT.n), jax.random.key(7))
+    mesh = make_mesh(devices8, dc=dc)
+    sharded = make_sharded_run(_P_EXACT, rounds, mesh)(
+        init_sharded_state(_P_EXACT.n, mesh), jax.random.key(7))
+    assert _leaves_equal(single, sharded)
+    # and the run actually exercised the detector
+    assert int(single.stats.suspicions) > 0
+    assert int(single.stats.crashes) > 0
+
+
+def test_sharded_flight_trace_exact(devices8):
+    """Flight rows are assembled from the round's already-reduced lane
+    vector on both engines — the decimated traces match EXACTLY, gauge
+    columns included."""
+    rounds, stride = 40, 5
+    s1, tr1 = make_run_rounds_lanes(_P_EXACT, rounds, flight_every=stride)(
+        init_state(_P_EXACT.n), jax.random.key(3))
+    mesh = make_mesh(devices8, dc=2)
+    s8, tr8 = make_sharded_run(_P_EXACT, rounds, mesh,
+                               flight_every=stride)(
+        init_sharded_state(_P_EXACT.n, mesh), jax.random.key(3))
+    from consul_tpu.sim import flight
+
+    a, b = np.asarray(tr1), np.asarray(tr8)
+    assert a.shape == (rounds // stride, flight.N_COLS)
+    assert np.array_equal(a, b)
+    assert _leaves_equal(s1, s8)
+    # rows carry real telemetry (live fraction sane, counters move)
+    cols = flight.trace_columns(tr1)
+    assert 0.5 < cols["live_frac"][-1] <= 1.0
+    assert cols["suspicions"].sum() > 0
+
+
+def test_fault_plan_threads_through_mesh(devices8):
+    """FaultPlan phase tensors shard along the node axis and ride
+    shard_body — multi-chip chaos runs bitwise-match the single-device
+    lane engine under the same plan."""
+    from consul_tpu.faults import (ChurnBurst, FaultPlan, Partition,
+                                   Phase, compile_plan)
+
+    plan = FaultPlan(phases=(
+        Phase(rounds=10, faults=(Partition(a=(0, 128), b=(128, 512)),),
+              name="cut"),
+        Phase(rounds=10, faults=(ChurnBurst(nodes=(0, 64), crash=0.05),),
+              name="burst"),
+        Phase(rounds=10, name="quiet")))
+    cp = compile_plan(plan, _P_EXACT.n)
+    single = make_run_rounds_lanes(_P_EXACT, 30, plan=cp)(
+        init_state(_P_EXACT.n), jax.random.key(5))
+    mesh = make_mesh(devices8, dc=2)
+    sharded = make_sharded_run(_P_EXACT, 30, mesh, plan=cp)(
+        init_sharded_state(_P_EXACT.n, mesh), jax.random.key(5))
+    assert _leaves_equal(single, sharded)
+    # the burst phase visibly injected crashes beyond the params churn
+    assert int(single.stats.crashes) > 30
+
+
+def _count_all_reduces(compiled_text: str) -> int:
+    return len(re.findall(r"= \S+ all-reduce(?:-start)?\(",
+                          compiled_text))
+
+
+def test_one_collective_per_round_in_hlo(devices8):
+    """The tentpole property, asserted from compiled HLO: ONE round of
+    the sharded engine contains exactly one cross-device collective
+    (the [N_REDUCE_LANES, LANE_BLOCKS] lane-table psum), and a full
+    runner carries only the two staged init_lanes reductions on top —
+    independent of the round count. No other collective op type
+    appears at all."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from consul_tpu.sim import gossip_round_lanes
+    from consul_tpu.sim import lanes as lanes_mod
+    from consul_tpu.sim.mesh import AXES, state_sharding
+
+    p = SimParams(n=512)
+    mesh = make_mesh(devices8, dc=2)
+    specs = jax.tree.map(
+        lambda s: s.spec, state_sharding(mesh),
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+
+    def one_round(state, lanes, key):
+        red = lanes_mod.mesh_lane_reducer(AXES, 8)
+        shard = (jax.lax.axis_index("dc") * mesh.shape["nodes"]
+                 + jax.lax.axis_index("nodes"))
+        return gossip_round_lanes(
+            state, lanes, key, p, lane_reducer=red,
+            shard_offset=shard * state.up.shape[0])
+
+    mapped = shard_map(one_round, mesh=mesh,
+                       in_specs=(specs, P(), P()),
+                       out_specs=(specs, P()), check_rep=False)
+    state = init_sharded_state(p.n, mesh)
+    lanes0 = jnp.zeros((lanes_mod.N_LANES,), jnp.float32)
+    txt = jax.jit(mapped).lower(
+        state, lanes0, jax.random.key(0)).compile().as_text()
+    assert _count_all_reduces(txt) == 1, \
+        "one gossip round must lower to exactly one collective"
+
+    for rounds in (3, 9):
+        run = make_sharded_run(p, rounds, mesh)
+        full = run.lower(init_sharded_state(p.n, mesh),
+                         jax.random.key(0)).compile().as_text()
+        # 2 staged init_lanes reductions (outside the scan) + 1 in the
+        # scan body — invariant in the round count
+        assert _count_all_reduces(full) == 3, rounds
+        for op in ("all-gather", "all-to-all", "collective-permute",
+                   "reduce-scatter"):
+            assert not re.search(rf"= \S+ {op}\(", full), op
+
+
+def test_mesh_runner_donates_state(devices8):
+    """Donation regression (mesh side): the input SimState's buffers
+    are consumed in place — reuse raises, and the compiled memory
+    analysis shows the state aliased input->output instead of
+    double-buffered."""
+    from consul_tpu.sim.state import state_bytes
+
+    p = SimParams(n=512)
+    mesh = make_mesh(devices8, dc=2)
+    run = make_sharded_run(p, rounds=3, mesh=mesh)
+    state = init_sharded_state(p.n, mesh)
+    sb = state_bytes(state)
+    ma = run.lower(state, jax.random.key(0)).compile().memory_analysis()
+    # memory analysis is per device: each shard aliases its slice of
+    # the row buffers (the replicated scalar legs may not alias)
+    assert ma.alias_size_in_bytes >= 0.9 * sb / len(devices8), \
+        (ma.alias_size_in_bytes, sb)
+    out = run(state, jax.random.key(0))
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    with pytest.raises(RuntimeError, match="deleted"):
+        _ = state.up + 0
+
+
+def test_lane_flight_refuses_oversized_awareness(devices8):
+    """max_local_health rides the 8-lane lh exceedance histogram: an
+    awareness ceiling past the histogram must refuse loudly instead of
+    silently saturating the recorded gauge."""
+    p = SimParams(n=512, awareness_max=12)
+    with pytest.raises(ValueError, match="awareness"):
+        make_run_rounds_lanes(p, 4, flight_every=2)
+    mesh = make_mesh(devices8, dc=2)
+    with pytest.raises(ValueError, match="awareness"):
+        make_sharded_run(p, 4, mesh, flight_every=2)
+    # without flight recording the lane engines are unaffected
+    make_run_rounds_lanes(p, 4)
+
+
+def test_init_sharded_state_builds_sharded(devices8):
+    """init_sharded_state materializes each leaf directly into its
+    shards (jit + out_shardings): the row leaves carry the mesh
+    sharding, no unsharded host copy in between."""
+    from jax.sharding import NamedSharding
+
+    mesh = make_mesh(devices8, dc=2)
+    state = init_sharded_state(1024, mesh)
+    sh = state.up.sharding
+    assert isinstance(sh, NamedSharding)
+    assert sh.mesh.shape == {"dc": 2, "nodes": 4}
+    assert not state.up.sharding.is_fully_replicated
+    assert state.t.sharding.is_fully_replicated
+    assert bool(np.all(np.asarray(jax.device_get(state.up))))
 
 
 @pytest.mark.parametrize("dc", [1, 2])
