@@ -21,6 +21,11 @@ stride on the full-model kernel (recorded as PROFILE_r*.json).
 (rounds/s per device count + efficiency + the compiled HLO's
 collectives-per-round count) and records it into MULTICHIP_r06.json —
 see run_mesh_bench.
+
+`--sweep [--smoke]` runs the parameter-sweep engine: one compiled
+vmapped runner per topology class executing the 64-point gossip-
+constant grid, Pareto-ranked (detection latency vs FP rate vs message
+load) and recorded into SWEEP_r01.json — see run_sweep_bench.
 """
 
 import json
@@ -284,6 +289,145 @@ def run_mesh_bench(smoke: bool) -> None:
     _emit(payload)
 
 
+def run_sweep_bench(smoke: bool) -> None:
+    """`bench.py --sweep [--smoke]`: the parameter-sweep engine
+    (sim/sweep.py) — one compiled vmapped runner executing a 64-point
+    grid of gossip constants (sim/scenarios.AUTOTUNE_GRID) over the
+    lan/wan/lossy topology classes, Pareto-ranked on detection latency
+    vs false-positive rate vs message load (sim/metrics.sweep_report).
+
+    Reports grid size, end-to-end scenarios/sec (grid points / wall,
+    compile included) and steady-state scenario-rounds/sec (a second
+    timed call on the already-compiled runner), plus each class's
+    Pareto table and chosen constants. Printed AND written to
+    SWEEP_r01.json next to this script (the MULTICHIP convention);
+    with no TPU attached the non-smoke run records the
+    `{"skipped": true}` envelope instead."""
+    metric = "param_sweep" + ("_smoke" if smoke else "")
+    want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
+    record_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "SWEEP_r01.json")
+
+    def _emit(payload: dict, rc: int = 0) -> None:
+        line = json.dumps(payload, indent=2)
+        print(line, flush=True)
+        try:
+            with open(record_path, "w") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+        if rc:
+            sys.exit(rc)
+
+    def fire() -> None:
+        _emit({"metric": metric, "skipped": True,
+               "reason": f"backend init/compile exceeded "
+                         f"{_INIT_TIMEOUT_S:.0f}s (TPU device absent "
+                         "or tunnel hung)",
+               "platform": want})
+        os._exit(0)
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S, fire)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        import jax
+
+        if smoke:
+            jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        _emit({"metric": metric, "skipped": True,
+               "reason": f"backend init failed: {e}", "platform": want})
+        return
+    watchdog.cancel()
+    platform = jax.default_backend()
+    if not smoke and platform == "cpu":
+        _emit({"metric": metric, "skipped": True,
+               "reason": "no TPU attached (cpu backend); run "
+                         "`bench.py --sweep --smoke` for the CPU grid",
+               "platform": platform})
+        return
+
+    def fire_hung() -> None:
+        _emit({"metric": metric, "skipped": False, "error":
+               f"sweep exceeded {_INIT_TIMEOUT_S * 10:.0f}s "
+               "(compile or run hung)", "platform": platform})
+        os._exit(1)
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S * 10, fire_hung)
+    watchdog.daemon = True
+    watchdog.start()
+
+    from consul_tpu.sim.metrics import sweep_report
+    from consul_tpu.sim.params import SweepAxes, grid_params
+    from consul_tpu.sim.scenarios import (AUTOTUNE_GRID,
+                                          AUTOTUNE_TOPOLOGIES,
+                                          autotune_params)
+    from consul_tpu.sim.sweep import SweepResult, make_run_sweep
+
+    n = 1024 if smoke else 65_536
+    rounds = 100 if smoke else 300
+    axes = SweepAxes.of(**AUTOTUNE_GRID)
+    key = jax.random.key(0)
+    classes = {}
+    for topology in AUTOTUNE_TOPOLOGIES:
+        p = autotune_params(topology, n)
+        tp, points = grid_params(p, axes)
+        run = make_run_sweep(p, rounds)
+        # end-to-end: trace + compile + the grid's first execution
+        t0 = time.perf_counter()
+        states, trace = run(tp, key)
+        jax.block_until_ready(states.t)
+        e2e_s = time.perf_counter() - t0
+        # steady state: the compiled runner, best of 2
+        steady_s = float("inf")
+        for trial in range(2):
+            t0 = time.perf_counter()
+            states, trace = run(tp, jax.random.fold_in(key, trial + 1))
+            jax.block_until_ready(states.t)
+            steady_s = min(steady_s, time.perf_counter() - t0)
+        result = SweepResult(states=states, trace=trace, tp=tp,
+                             points=points, rounds=rounds,
+                             flight_every=None)
+        rep = sweep_report(result)
+        compiles = run.jitted._cache_size()
+        classes[topology] = {
+            "grid_size": rep["grid_size"],
+            "compiles": compiles,
+            "end_to_end_s": round(e2e_s, 3),
+            "steady_s": round(steady_s, 3),
+            "scenarios_per_sec": round(rep["grid_size"] / steady_s, 1),
+            "scenario_rounds_per_sec": round(
+                rep["grid_size"] * rounds / steady_s, 1),
+            "chosen": rep["winner"]["params"],
+            "pareto": [
+                {k: v for k, v in rep["points"][i].items()
+                 if k in ("point", "params", "mean_detect_latency_s",
+                          "fp_per_node_hour", "msg_load")}
+                for i in rep["pareto"]],
+        }
+    watchdog.cancel()
+    payload = {
+        "metric": metric,
+        "platform": platform,
+        "n": n,
+        "rounds": rounds,
+        "grid": {k: list(v) for k, v in AUTOTUNE_GRID.items()},
+        "objectives": ["mean_detect_latency_s", "fp_per_node_hour",
+                       "msg_load"],
+        "classes": classes,
+        **({"smoke": True} if smoke else {}),
+    }
+    if platform != "tpu":
+        payload["tpu"] = {
+            "skipped": True,
+            "reason": "no TPU attached; grid above measured on "
+                      f"the {platform} backend"}
+    _emit(payload)
+
+
 def run_chaos_bench(smoke: bool) -> None:
     """`bench.py --chaos [--smoke]`: the detection-quality chaos suite —
     every named fault class (sim/scenarios.chaos_plans) through the
@@ -328,6 +472,12 @@ def main() -> None:
             print("--profile applies to the throughput bench only; "
                   "ignored with --mesh", file=sys.stderr)
         run_mesh_bench(smoke)
+        return
+    if "--sweep" in sys.argv[1:]:
+        if profile:
+            print("--profile applies to the throughput bench only; "
+                  "ignored with --sweep", file=sys.stderr)
+        run_sweep_bench(smoke)
         return
     if "--chaos" in sys.argv[1:]:
         if profile:

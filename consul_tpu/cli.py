@@ -60,6 +60,8 @@ def cmd_agent(args) -> int:
         overrides["gossip_sim_chaos"] = args.gossip_sim_chaos
     if getattr(args, "gossip_sim_coords", False):
         overrides["gossip_sim_coords"] = True
+    if getattr(args, "gossip_sim_sweep", None):
+        overrides["gossip_sim_sweep"] = args.gossip_sim_sweep
     if any(x is not None for x in (args.http_port, args.dns_port,
                                    args.serf_port, args.server_port,
                                    args.serf_wan_port)):
@@ -236,7 +238,46 @@ def _run_gossip_sim(cfg) -> int:
 
     n = cfg.gossip_sim_nodes
     chaos = getattr(cfg, "gossip_sim_chaos", "") or ""
+    sweep_spec = getattr(cfg, "gossip_sim_sweep", "") or ""
     try:
+        if sweep_spec:
+            from consul_tpu.sim.scenarios import (AUTOTUNE_TOPOLOGIES,
+                                                  run_autotune)
+
+            topology, _, rounds_s = sweep_spec.partition(":")
+            if topology not in AUTOTUNE_TOPOLOGIES:
+                watchdog.cancel()
+                return _sim_error(
+                    f"unknown sweep topology class {topology!r} "
+                    f"(expected one of "
+                    f"{', '.join(AUTOTUNE_TOPOLOGIES)}, with an "
+                    "optional :rounds suffix)", platform)
+            try:
+                rounds = int(rounds_s) if rounds_s else 120
+                if rounds <= 0:
+                    raise ValueError(rounds)
+            except ValueError:
+                watchdog.cancel()
+                return _sim_error(
+                    f"bad sweep rounds suffix in {sweep_spec!r} "
+                    "(expected a positive integer)", platform)
+            print(f"==> gossip-sim={platform} sweep={topology}: "
+                  f"{n} virtual members x 64-point grid, {rounds} "
+                  f"rounds on {jax.devices()[0].platform}")
+            t0 = time.perf_counter()
+            rep = run_autotune(topology, n=n, rounds=rounds)
+            watchdog.cancel()
+            rep["wall_s"] = round(time.perf_counter() - t0, 2)
+            _publish_sim_sweep(rep)
+            # trim the full 64-row table from the CLI report (bench.py
+            # --sweep is the recorded-table surface); keep the winner,
+            # the chosen constants, and the Pareto front rows
+            pareto_rows = [rep["points"][i] for i in rep["pareto"]]
+            for k in ("points",):
+                rep.pop(k, None)
+            rep["pareto"] = pareto_rows
+            print(json.dumps(rep, indent=2))
+            return 0
         if getattr(cfg, "gossip_sim_coords", False):
             from consul_tpu.sim.scenarios import run_coords
 
@@ -303,6 +344,26 @@ def _run_gossip_sim(cfg) -> int:
     print(json.dumps({"rounds_per_sec": round(rounds / dt, 1),
                       **rep.to_dict()}, indent=2))
     return 0
+
+
+def _publish_sim_sweep(rep: dict) -> None:
+    """Publish the sweep winner through the sim.* metrics bridge: the
+    chosen constants and its quality numbers as ``sim.sweep.*`` gauges
+    in the process-global telemetry registry, alongside the gauges the
+    flight publisher uses — /v1/agent/metrics (JSON and prometheus)
+    and the debug bundle see the tuner's verdict like any other sim
+    health signal."""
+    from consul_tpu.utils import telemetry
+
+    m = telemetry.default
+    m.gauge("sim.sweep.grid_size", float(rep["grid_size"]))
+    m.gauge("sim.sweep.pareto_points", float(len(rep["pareto"])))
+    for k, v in rep["chosen"].items():
+        m.gauge(f"sim.sweep.chosen.{k}", float(v))
+    w = rep["winner"]
+    for k in ("mean_detect_latency_s", "fp_per_node_hour", "msg_load"):
+        if w.get(k) is not None:
+            m.gauge(f"sim.sweep.winner.{k}", float(w[k]))
 
 
 def _publish_sim_coords(cfg, coords, rep: dict) -> None:
@@ -2003,6 +2064,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run a named chaos FaultPlan (e.g. "
                          "asym_partition, per_node_loss, gc_pause, "
                          "flapping, churn_burst)")
+    ag.add_argument("-gossip-sim-sweep", default=None,
+                    dest="gossip_sim_sweep",
+                    help="run the parameter-sweep auto-tuner for a "
+                         "topology class (lan, wan, lossy; optional "
+                         ":rounds suffix, e.g. lossy:120) and publish "
+                         "the winning gossip constants + Pareto "
+                         "summary (structured JSON + sim.sweep.* "
+                         "metrics)")
     ag.add_argument("-gossip-sim-coords", action="store_true",
                     default=False, dest="gossip_sim_coords",
                     help="run the network-coordinate scenario and "

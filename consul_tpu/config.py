@@ -283,6 +283,10 @@ class RuntimeConfig:
     # and publish the virtual members' Vivaldi coordinates into a dev
     # agent's catalog store (served by /v1/coordinate/nodes)
     gossip_sim_coords: bool = False
+    # run the parameter-sweep auto-tuner (sim/scenarios.run_autotune)
+    # for a topology class: "lan" | "wan" | "lossy", with an optional
+    # ":rounds" suffix (e.g. "lossy:120")
+    gossip_sim_sweep: str = ""
 
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     log_level: str = "INFO"

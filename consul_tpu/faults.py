@@ -413,6 +413,40 @@ def active_phase(cp: CompiledFaultPlan, round_idx):
         0, n_phases - 1)
 
 
+def scale_frame(fx: FaultFrame, gain) -> FaultFrame:
+    """Blend a round's fault view toward the no-fault identity.
+
+    ``gain`` is a scalar intensity (traced or Python float; the sweep
+    engine feeds the per-grid-point ``SimParams.fault_gain`` leaf):
+    1.0 returns the frame as compiled, 0.0 the identity frame (all
+    delivery multipliers 1, all churn rates 0), values between
+    interpolate the continuous channels linearly —
+    ``1 - gain*(1 - mult)`` for the delivery/suspicion/hearing
+    multipliers, ``gain*rate`` for the churn probabilities. The
+    forced-slow mask is on/off by nature (it flows into a boolean OR),
+    so it stays armed for any positive gain and disarms only at 0.
+    Gains above 1 extrapolate (rates clip implicitly through the
+    Bernoulli draws; multipliers may go negative — callers wanting
+    over-driving should clip their axis instead).
+
+    Applied by the round bodies AFTER ``fault_frame`` materializes the
+    phase view, so flap schedules scale too (a half-gain flap revives/
+    crashes with p=0.5 per scheduled round instead of certainty)."""
+    import jax.numpy as jnp
+
+    g = jnp.asarray(gain, jnp.float32)
+
+    def blend(m):
+        return 1.0 - g * (1.0 - m)
+
+    return FaultFrame(
+        psend=blend(fx.psend), precv=blend(fx.precv),
+        suspw=blend(fx.suspw), hear_w=blend(fx.hear_w),
+        mid=blend(fx.mid), slow_f=fx.slow_f & (g > 0.0),
+        crash_p=g * fx.crash_p, rejoin_p=g * fx.rejoin_p,
+        leave_p=g * fx.leave_p)
+
+
 def fault_frame(cp: CompiledFaultPlan, round_idx) -> FaultFrame:
     """The current round's fault view — pure indexing/elementwise math,
     safe inside a jitted lax.scan body (no shape depends on round_idx).

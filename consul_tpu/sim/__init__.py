@@ -50,7 +50,8 @@ ENVELOPE — what this model can and cannot answer:
   the criterion, but directionally low, not noise.
 """
 
-from consul_tpu.sim.params import SimParams
+from consul_tpu.sim.params import (SimParams, SweepAxes, TracedParams,
+                                   grid_params, point_params)
 from consul_tpu.sim.state import SimState, init_state, ALIVE, SUSPECT, DEAD, LEFT
 from consul_tpu.sim.round import (gossip_round, gossip_round_lanes,
                                   run_rounds,
@@ -73,9 +74,14 @@ from consul_tpu.sim.views import (ViewState, init_views, views_round,
                                   run_views, view_metrics,
                                   make_views_mesh,
                                   make_sharded_views_round)
+from consul_tpu.sim.sweep import (SweepResult, make_run_point,
+                                  make_run_sweep, run_sweep)
 
 __all__ = [
-    "SimParams", "SimState", "init_state", "gossip_round",
+    "SimParams", "SweepAxes", "TracedParams", "grid_params",
+    "point_params",
+    "SweepResult", "make_run_sweep", "make_run_point", "run_sweep",
+    "SimState", "init_state", "gossip_round",
     "gossip_round_lanes", "run_rounds",
     "run_rounds_coords",
     "run_rounds_stats", "run_rounds_flight", "make_run_rounds",

@@ -195,6 +195,22 @@ def trace_columns(trace) -> dict[str, np.ndarray]:
     return {name: tr[:, i] for i, name in enumerate(FLIGHT_COLUMNS)}
 
 
+def sweep_trace_columns(trace) -> list[dict[str, np.ndarray]]:
+    """Batched sweep trace ([G, rows, N_COLS] — sim/sweep.py records
+    one flight trace PER GRID POINT) -> per-point column dicts, one
+    device fetch for the whole grid. Each entry is exactly what
+    ``trace_columns`` returns for that point's solo trace, so every
+    per-point consumer (``trace_report``, ``stats_from_trace``,
+    ``FlightPublisher``) works unchanged on a grid row."""
+    tr = np.asarray(jax.device_get(trace))
+    if tr.ndim != 3 or tr.shape[2] != N_COLS:
+        raise ValueError(f"not a sweep trace: shape {tr.shape}, "
+                         f"expected [grid, rows, {N_COLS}]")
+    return [{name: tr[g, :, i]
+             for i, name in enumerate(FLIGHT_COLUMNS)}
+            for g in range(tr.shape[0])]
+
+
 def stats_from_trace(trace) -> SimStats:
     """Rebuild the per-round CUMULATIVE SimStats pytree (f64 numpy
     leaves, one leading [n_rows] axis) from a stride-1 flight trace —

@@ -89,6 +89,39 @@ def check_flight_config(p, flight_every) -> None:
             "recorder for larger awareness ceilings")
 
 
+# --------------------------------------------- sweep (vmap) batching
+#
+# The parameter-sweep engine (sim/sweep.py) vmaps the lane scan over a
+# grid axis, which batches the two-stage reduction below. jax 0.4.x
+# ships no batching rule for lax.optimization_barrier; the primitive is
+# an identity on its operands (it only pins the op order), so batching
+# is the identity rule too — the barrier still separates the block-
+# partial stage from the table fold inside every grid row, preserving
+# the fixed f32 summation order that makes a vmapped grid point bitwise
+# equal to its solo run.
+
+
+def _register_barrier_batching() -> None:
+    try:
+        from jax._src.lax import lax as _lax_internal
+
+        prim = _lax_internal.optimization_barrier_p
+    except (ImportError, AttributeError):  # pragma: no cover — jax drift
+        return
+    from jax.interpreters import batching
+
+    if prim in batching.primitive_batchers:
+        return
+
+    def rule(args, dims):
+        return prim.bind(*args), dims
+
+    batching.primitive_batchers[prim] = rule
+
+
+_register_barrier_batching()
+
+
 # ------------------------------------------------- shard-invariant PRNG
 
 

@@ -258,6 +258,120 @@ def blackbox_report(bb, p: SimParams, trace=None,
     return out
 
 
+# ------------------------------------------------------------- sweeps
+
+
+def message_load(p: SimParams) -> float:
+    """Expected protocol messages per node per round — the sweep's
+    third quality axis (the tunable-gossip family trades detection
+    speed against exactly this budget). Analytic, from the point's own
+    constants: the direct probe round trip (2 legs), the indirect
+    fan-out a direct miss triggers (`indirect_checks` ping-reqs at 4
+    legs each, plus the 2-leg TCP fallback when enabled), and the
+    piggyback gossip fanout per protocol period."""
+    miss = 1.0 - p.p_direct
+    indirect = 4.0 * p.indirect_checks + (2.0 if p.tcp_fallback else 0.0)
+    return 2.0 + miss * indirect + p.gossip_nodes * p.gossip_ticks_per_round
+
+
+def pareto_front(rows: list[dict], keys: tuple[str, ...]) -> list[int]:
+    """Indices of the non-dominated rows, minimizing every key (None
+    reads as +inf: a point that never measured the metric cannot
+    dominate one that did)."""
+    def val(r, k):
+        v = r[k]
+        return float("inf") if v is None else float(v)
+
+    out = []
+    for i, a in enumerate(rows):
+        dominated = False
+        for j, b in enumerate(rows):
+            if i == j:
+                continue
+            if all(val(b, k) <= val(a, k) for k in keys) and \
+                    any(val(b, k) < val(a, k) for k in keys):
+                dominated = True
+                break
+        if not dominated:
+            out.append(i)
+    return out
+
+
+#: the sweep's quality axes, all minimized
+SWEEP_OBJECTIVES = ("mean_detect_latency_s", "fp_per_node_hour",
+                    "msg_load")
+
+
+def sweep_report(result, fp_budget: float = 1.0) -> dict:
+    """Pareto-rank a sweep (sim/sweep.SweepResult) on detection latency
+    vs false-positive rate vs message load.
+
+    Each grid point's counters come off the batched final SimStats in
+    ONE device fetch; its message load is analytic (message_load). The
+    report carries the full per-point table (swept constants + metrics
+    + pareto membership), the Pareto-front indices, and a ``winner``:
+    the front point with the lowest detection latency among those
+    within ``fp_budget`` false positives per node-hour (falling back to
+    the lowest-FP front point when none qualifies — a sweep whose every
+    point breaches the budget should say so, not crash). Points that
+    declared no real death have latency None and never win."""
+    from consul_tpu.sim.params import SWEEPABLE_FIELDS
+
+    states = jax.device_get(result.states)
+    st = states.stats
+    # report the raw axes only (derived leaves like p_direct ride along
+    # for the device math but are not knobs anyone set)
+    swept = sorted(k for k in result.tp.leaves
+                   if k in SWEEPABLE_FIELDS)
+    sim_s = np.asarray(states.t, np.float64)
+    rows: list[dict] = []
+    for i, pp in enumerate(result.points):
+        tdd = int(np.asarray(st.true_deaths_declared)[i])
+        fp = int(np.asarray(st.false_positives)[i])
+        node_hours = pp.n * float(sim_s[i]) / 3600.0
+        lat = (float(np.asarray(st.detect_latency_sum)[i]) / tdd
+               if tdd else None)
+        rows.append({
+            "point": i,
+            "params": {k: (getattr(pp, k)) for k in swept},
+            "mean_detect_latency_s": lat,
+            "fp_per_node_hour": (fp / node_hours if node_hours > 0
+                                 else 0.0),
+            "msg_load": round(message_load(pp), 4),
+            "false_positives": fp,
+            "true_deaths_declared": tdd,
+            "suspicions": int(np.asarray(st.suspicions)[i]),
+            "refutes": int(np.asarray(st.refutes)[i]),
+            "live_fraction": float(np.mean(np.asarray(states.up)[i])),
+        })
+    front = pareto_front(rows, SWEEP_OBJECTIVES)
+    for i in front:
+        rows[i]["pareto"] = True
+    eligible = [i for i in front
+                if rows[i]["mean_detect_latency_s"] is not None
+                and rows[i]["fp_per_node_hour"] <= fp_budget]
+    if eligible:
+        winner = min(eligible,
+                     key=lambda i: (rows[i]["mean_detect_latency_s"],
+                                    rows[i]["msg_load"]))
+    else:
+        measured = [i for i in front
+                    if rows[i]["mean_detect_latency_s"] is not None]
+        pool = measured or front
+        winner = min(pool, key=lambda i: (rows[i]["fp_per_node_hour"],
+                                          rows[i]["msg_load"]))
+    return {
+        "grid_size": len(rows),
+        "rounds": result.rounds,
+        "swept": swept,
+        "objectives": list(SWEEP_OBJECTIVES),
+        "fp_budget_per_node_hour": fp_budget,
+        "pareto": front,
+        "winner": rows[winner],
+        "points": rows,
+    }
+
+
 def propagation_curve(trace: jnp.ndarray, probe_interval: float,
                       threshold: float = 0.9999) -> tuple[np.ndarray, float]:
     """From a per-round informed-fraction trace of one rumor, the time (s)

@@ -26,7 +26,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
-                               fault_frame)
+                               fault_frame, scale_frame)
 from consul_tpu.sim import registry
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import (N_SCALARS, init_scalars,
@@ -545,6 +545,11 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             seed, r, ck = x
             if fault:
                 fx = fault_frame(cp, r)
+                if p.fault_gain != 1.0:
+                    # same intensity blend as the XLA engines
+                    # (round._round_core): the frame tensors are plain
+                    # jnp here, before the kernel consumes them
+                    fx = scale_frame(fx, p.fault_gain)
                 fins = (to2d(fx.psend), to2d(fx.precv),
                         to2d(fx.suspw), to2d(fx.hear_w),
                         to2d(fx.slow_f.astype(jnp.int8)),

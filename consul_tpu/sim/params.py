@@ -1,15 +1,43 @@
-"""Static simulation parameters (hashable → usable as jit static args).
+"""Simulation parameters: the static/traced split.
 
-Derived from the same ``GossipConfig`` the host engine uses; plus the
-network/workload model (loss, churn) that the reference's container tests
-inject with iptables (sdk/iptables) and the BASELINE.json configs specify.
+``SimParams`` is the hashable dataclass every engine has always taken
+as a jit STATIC argument — one compile per value. The parameter-sweep
+engine (sim/sweep.py) needs hundreds of parameterizations to share ONE
+compile, so this module splits the fields into two tiers:
+
+  * STATIC fields shape the traced program itself — ``n`` (array
+    shapes), ``lifeguard``/``tcp_fallback``/``coords_timeout``/
+    ``collect_stats`` (Python branches), ``indirect_checks`` (an
+    integer-power exponent XLA unrolls), ``blackbox_*`` (ring shapes).
+    These stay on the frozen dataclass and must be identical across a
+    sweep grid.
+  * SWEEPABLE scalars (registry.SWEEP_AXES) only feed arithmetic.
+    ``grid_params`` lifts them into traced f32/int32 pytree leaves — a
+    ``TracedParams`` view that duck-types SimParams inside the round
+    bodies, with one leading [G] axis that ``jax.vmap`` maps over.
+
+Derived quantities (suspicion timeouts, channel success probabilities)
+are precomputed per grid point on the HOST in f64 — the exact property
+formulas below, shared with the host engine via ``GossipConfig`` — and
+shipped as their own leaves, so the traced math never re-derives them
+with different rounding than the static path folds.
+
+The round bodies gate Python control flow through ``enabled()`` /
+``sweeps()`` (identical truthiness for static params; leaf-presence for
+traced ones), never through ``bool(leaf)`` — the tier-1 concretization
+guard in tests/test_sweep.py traces every engine with every sweepable
+field abstract and fails loudly on any regression.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
+from typing import Any, Mapping, Sequence, Union
+
+import numpy as np
 
 from consul_tpu.config import GossipConfig
+from consul_tpu.sim import registry
 
 
 @dataclass(frozen=True)
@@ -88,6 +116,14 @@ class SimParams:
     rejoin_per_round: float = 0.0   # P(dead node rejoins) per round
     leave_per_round: float = 0.0    # P(live node gracefully leaves) per round
 
+    # FaultPlan intensity multiplier (faults.scale_frame): 1.0 runs a
+    # compiled plan as written, 0.0 blends every frame to the no-fault
+    # identity, values between interpolate the continuous channels and
+    # scale the churn rates linearly. Exists chiefly as a SWEEP axis —
+    # one compiled plan, per-grid-point severity — but the static
+    # engines honor a non-default value too (same code path).
+    fault_gain: float = 1.0
+
     # --- derived (computed at trace time; all Python floats/ints) ---------
 
     def _gc(self) -> GossipConfig:
@@ -124,6 +160,34 @@ class SimParams:
         """Expected independent confirmations that drive the timer to its
         minimum (memberlist uses SuspicionMult-2 as the k of its log-shrink)."""
         return max(1, self.suspicion_mult - 2)
+
+    # The next four properties are HOST-FOLDED subexpressions of the
+    # round bodies. They exist so the static and traced paths round
+    # identically: a Python-float compound like ``1 - r`` folds in f64
+    # before its single f32 cast at op time, while the same compound on
+    # f32 leaves rounds at every step — a 1-ulp divergence that a
+    # bitwise static<->traced conformance test catches. grid_params
+    # ships each as its own f64-computed leaf (registry.SWEEP_DERIVED).
+
+    @property
+    def shrink_r(self) -> float:
+        """Lifeguard shrink floor: min/max suspicion-timeout ratio."""
+        return self.suspicion_min_s / self.suspicion_max_s
+
+    @property
+    def shrink_omr(self) -> float:
+        """1 - shrink_r, folded on host like the static trace does."""
+        return 1.0 - self.shrink_r
+
+    @property
+    def fanout_ticks(self) -> float:
+        """gossip_nodes * gossip_ticks_per_round — the per-round
+        epidemic fan-out factor."""
+        return self.gossip_nodes * self.gossip_ticks_per_round
+
+    @property
+    def one_minus_loss(self) -> float:
+        return 1.0 - self.loss
 
     @property
     def retransmit_limit(self) -> int:
@@ -163,6 +227,21 @@ class SimParams:
     def with_(self, **kw) -> "SimParams":
         return replace(self, **kw)
 
+    # --- static/traced gate protocol (shared with TracedParams) -------
+
+    def enabled(self, *names: str) -> bool:
+        """Python-control-flow gate: is any of these features active?
+        For static params this is plain truthiness (the historical
+        ``if p.field or ...`` gates); a TracedParams answers True for
+        any SWEPT field regardless of value, so every grid point shares
+        one traced program."""
+        return any(bool(getattr(self, n)) for n in names)
+
+    def sweeps(self, *names: str) -> bool:
+        """Is any of these fields a traced sweep leaf? Always False on
+        the static dataclass."""
+        return False
+
 
 # The BASELINE.json benchmark configurations (see BASELINE.md):
 def baseline_configs() -> dict[str, SimParams]:
@@ -190,3 +269,221 @@ def baseline_configs() -> dict[str, SimParams]:
         # headline perf config: 1M nodes, LAN timing (1 round = 1s simulated)
         "1m-lan": SimParams.from_gossip_config(lan, n=1_000_000, loss=0.01),
     }
+
+
+# ---------------------------------------------------------------- sweep
+#
+# SweepAxes → grid_params → TracedParams: the parameter grid as data.
+
+#: SimParams fields that may become traced sweep leaves (the canonical
+#: tuple lives in the pinned sim/registry.py layout digest)
+SWEEPABLE_FIELDS = registry.SWEEP_AXES
+
+#: derived property -> the sweepable fields it depends on
+DERIVED_DEPS: dict[str, tuple[str, ...]] = dict(registry.SWEEP_DERIVED)
+
+_INT_LEAVES = frozenset(registry.SWEEP_INT_LEAVES)
+
+
+class TracedParams:
+    """A SimParams view whose sweepable scalars are traced leaves.
+
+    Duck-types SimParams inside the round bodies: attribute reads hit
+    the ``leaves`` mapping first (jnp scalars — or [G] vectors before
+    ``jax.vmap`` strips the grid axis), then fall through to the static
+    dataclass. Registered as a jax pytree (leaves are children, the
+    static params are hashable aux data), so it passes straight through
+    jit/vmap/scan boundaries.
+
+    Derived properties whose dependencies are swept must arrive as
+    precomputed leaves (``grid_params`` does this); reading one that is
+    missing raises instead of silently using the stale static value.
+    """
+
+    __slots__ = ("static", "leaves")
+
+    def __init__(self, static: SimParams,
+                 leaves: Mapping[str, Any]) -> None:
+        unknown = [k for k in leaves
+                   if k not in SWEEPABLE_FIELDS and k not in DERIVED_DEPS]
+        if unknown:
+            raise ValueError(
+                f"not sweepable leaves: {sorted(unknown)} (sweepable "
+                f"fields: {', '.join(SWEEPABLE_FIELDS)}; derived: "
+                f"{', '.join(DERIVED_DEPS)})")
+        object.__setattr__(self, "static", static)
+        object.__setattr__(self, "leaves", dict(leaves))
+
+    def __getattr__(self, name: str):
+        # only reached when `name` is not a slot/method
+        leaves = object.__getattribute__(self, "leaves")
+        if name in leaves:
+            return leaves[name]
+        deps = DERIVED_DEPS.get(name)
+        if deps and any(d in leaves for d in deps):
+            raise AttributeError(
+                f"derived SimParams.{name} depends on swept "
+                f"{sorted(set(deps) & set(leaves))} but was not "
+                "precomputed as a leaf — build TracedParams via "
+                "grid_params, which ships host-f64 derived leaves")
+        return getattr(object.__getattribute__(self, "static"), name)
+
+    def enabled(self, *names: str) -> bool:
+        leaves = object.__getattribute__(self, "leaves")
+        static = object.__getattribute__(self, "static")
+        return any(n in leaves or bool(getattr(static, n))
+                   for n in names)
+
+    def sweeps(self, *names: str) -> bool:
+        leaves = object.__getattribute__(self, "leaves")
+        return any(n in leaves for n in names)
+
+    @property
+    def grid_shape(self) -> tuple:
+        """Leading (grid) shape of the leaves — () for a single point."""
+        leaves = object.__getattribute__(self, "leaves")
+        for v in leaves.values():
+            return tuple(np.shape(v))
+        return ()
+
+    def __repr__(self) -> str:
+        return (f"TracedParams(n={self.static.n}, "
+                f"leaves={sorted(self.leaves)})")
+
+
+def _tp_flatten(tp: TracedParams):
+    keys = tuple(sorted(tp.leaves))
+    return tuple(tp.leaves[k] for k in keys), (tp.static, keys)
+
+
+def _tp_unflatten(aux, children) -> TracedParams:
+    static, keys = aux
+    return TracedParams(static, dict(zip(keys, children)))
+
+
+def _register_traced_params() -> None:
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        TracedParams, _tp_flatten, _tp_unflatten)
+
+
+_register_traced_params()
+
+
+@dataclass(frozen=True)
+class SweepAxes:
+    """A named parameter grid: ``axes`` is an ordered (field, values)
+    tuple; the grid is their cartesian product, first axis slowest
+    (numpy meshgrid 'ij' order). Only registry.SWEEP_AXES fields are
+    accepted — shape/branch-affecting fields (``n``, ``lifeguard``,
+    ``indirect_checks``, ...) must be identical across a grid and are
+    rejected with the reason."""
+
+    axes: tuple
+
+    def __post_init__(self):
+        axes = tuple((name, tuple(float(v) for v in values))
+                     for name, values in self.axes)
+        for name, values in axes:
+            if name not in SWEEPABLE_FIELDS:
+                hint = ("a STATIC field — it affects compiled shapes "
+                        "or Python branches, so it cannot vary inside "
+                        "one compiled grid"
+                        if name in SimParams.__dataclass_fields__
+                        else "not a SimParams field")
+                raise ValueError(
+                    f"cannot sweep {name!r}: {hint}. Sweepable: "
+                    f"{', '.join(SWEEPABLE_FIELDS)}")
+            if not values:
+                raise ValueError(f"sweep axis {name!r} has no values")
+        object.__setattr__(self, "axes", axes)
+
+    @staticmethod
+    def of(**axes: Sequence[float]) -> "SweepAxes":
+        return SweepAxes(tuple(axes.items()))
+
+    @property
+    def size(self) -> int:
+        out = 1
+        for _, values in self.axes:
+            out *= len(values)
+        return out
+
+    def points(self) -> list[dict[str, float]]:
+        """The grid as a list of {field: value} dicts (product order)."""
+        out: list[dict[str, float]] = [{}]
+        for name, values in self.axes:
+            out = [{**pt, name: v} for pt in out for v in values]
+        return out
+
+
+GridSpec = Union[SweepAxes, Sequence[Mapping[str, float]]]
+
+#: int-valued SimParams fields a float sweep value must round-trip to
+_INT_FIELDS = frozenset(
+    name for name, f in SimParams.__dataclass_fields__.items()
+    if f.type in ("int", int))
+
+
+def _point_param(base: SimParams, pt: Mapping[str, float]) -> SimParams:
+    kw = {}
+    for name, v in pt.items():
+        if name in _INT_FIELDS:
+            iv = int(round(v))
+            if iv != v:
+                raise ValueError(
+                    f"sweep axis {name!r} is integer-valued: {v}")
+            v = iv
+        kw[name] = v
+    return base.with_(**kw)
+
+
+def grid_params(p: SimParams, grid: GridSpec
+                ) -> tuple[TracedParams, list[SimParams]]:
+    """Build the traced grid: (TracedParams with [G] leaves, the G
+    concrete per-point SimParams).
+
+    Every swept field becomes a leaf, and every DERIVED property whose
+    dependencies are swept is precomputed per point on the host in f64
+    — via the concrete SimParams' own property formulas, the same fold
+    the static engine would do — then cast once to its device dtype.
+    The returned point list is the host-side mirror (reports, winner
+    selection, solo-reference runs)."""
+    if isinstance(grid, SweepAxes):
+        pts = grid.points()
+    else:
+        pts = [dict(pt) for pt in grid]
+        if not pts:
+            raise ValueError("empty sweep grid")
+        keys = set(pts[0])
+        for pt in pts:
+            if set(pt) != keys:
+                raise ValueError(
+                    "every sweep grid point must set the same fields: "
+                    f"{sorted(keys)} vs {sorted(pt)}")
+        # route through SweepAxes validation for the field names
+        SweepAxes(tuple((k, (0.0,)) for k in sorted(keys)))
+    swept = sorted(set().union(*pts)) if pts else []
+    points = [_point_param(p, pt) for pt in pts]
+    leaf_names = list(swept) + [
+        d for d, deps in DERIVED_DEPS.items()
+        if any(dep in swept for dep in deps)]
+
+    import jax.numpy as jnp
+
+    leaves = {}
+    for name in leaf_names:
+        dtype = jnp.int32 if name in _INT_LEAVES or name in _INT_FIELDS \
+            else jnp.float32
+        leaves[name] = jnp.asarray(
+            np.asarray([getattr(pp, name) for pp in points], np.float64),
+            dtype)
+    return TracedParams(p, leaves), points
+
+
+def point_params(tp: TracedParams, i: int) -> TracedParams:
+    """Grid point i as a TracedParams with scalar (0-d) leaves — the
+    solo-reference view the bitwise conformance tests run un-vmapped."""
+    return TracedParams(tp.static,
+                        {k: v[i] for k, v in tp.leaves.items()})

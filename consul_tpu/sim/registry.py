@@ -152,6 +152,67 @@ LANE = {name: i for i, name in enumerate(REDUCE_LANES)}
 #: LANE_BLOCKS; device counts must divide LANE_BLOCKS.
 LANE_BLOCKS = 64
 
+# ---------------------------------------------------------- sweep axes
+#
+# The parameter-sweep engine (sim/sweep.py): SimParams splits into
+# STATIC fields (shape/feature-affecting — n, lifeguard, tcp_fallback,
+# indirect_checks, coords_timeout, collect_stats, blackbox_*) and the
+# SWEEPABLE dynamic scalars below, which params.grid_params turns into
+# traced [G] pytree leaves so ONE compiled runner executes the whole
+# grid. The tuples are the device/host layout contract: sim/params.py
+# builds TracedParams leaves from them and the digest pins them — a
+# field moved between the static and traced sides without updating
+# every consumer fails tier-1 loudly.
+
+#: SimParams fields that may become traced sweep leaves, in canonical
+#: axis order (params.SWEEPABLE_FIELDS re-exports this tuple)
+SWEEP_AXES = (
+    "probe_interval",
+    "probe_timeout",
+    "gossip_interval",
+    "gossip_nodes",
+    "suspicion_mult",
+    "suspicion_max_timeout_mult",
+    "awareness_max",
+    "loss",
+    "tcp_fail",
+    "slow_per_round",
+    "slow_recover_per_round",
+    "slow_factor",
+    "coord_timeout_mult",
+    "fail_per_round",
+    "rejoin_per_round",
+    "leave_per_round",
+    "fault_gain",
+)
+
+#: derived SimParams properties the round bodies read, each with the
+#: sweepable fields it depends on: when any dep is swept, the derived
+#: value is precomputed per grid point on the HOST (f64, the exact
+#: formulas the static engine folds) and shipped as its own traced
+#: leaf — TracedParams refuses to silently fall back to the static
+#: value (params.TracedParams.__getattr__).
+SWEEP_DERIVED = (
+    ("gossip_ticks_per_round", ("probe_interval", "gossip_interval")),
+    ("suspicion_min_s", ("probe_interval", "suspicion_mult")),
+    ("suspicion_max_s", ("probe_interval", "suspicion_mult",
+                         "suspicion_max_timeout_mult")),
+    ("confirmation_k", ("suspicion_mult",)),
+    ("shrink_r", ("probe_interval", "suspicion_mult",
+                  "suspicion_max_timeout_mult")),
+    ("shrink_omr", ("probe_interval", "suspicion_mult",
+                    "suspicion_max_timeout_mult")),
+    ("fanout_ticks", ("probe_interval", "gossip_interval",
+                      "gossip_nodes")),
+    ("one_minus_loss", ("loss",)),
+    ("p_direct", ("loss",)),
+    ("p_relay", ("loss",)),
+    ("p_tcp", ("tcp_fail",)),
+)
+
+#: sweep leaves carried as int32 (clip bounds / counts); all others f32
+SWEEP_INT_LEAVES = ("awareness_max", "confirmation_k")
+
 
 def flight_columns() -> tuple[str, ...]:
     """The full flight-trace row layout, in column order."""
@@ -165,7 +226,11 @@ def layout_digest() -> str:
     for group in (FLIGHT_GAUGE_COLUMNS, STATS_FIELDS,
                   FLIGHT_COORD_COLUMNS, BLACKBOX_RECORD_FIELDS,
                   BLACKBOX_EVENTS, BLACKBOX_PROBE_EVENTS,
-                  REDUCE_LANES, (str(LANE_BLOCKS),)):
+                  REDUCE_LANES, (str(LANE_BLOCKS),),
+                  SWEEP_AXES,
+                  tuple(f"{d}<-{','.join(deps)}"
+                        for d, deps in SWEEP_DERIVED),
+                  SWEEP_INT_LEAVES):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

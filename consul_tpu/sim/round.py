@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
-                               fault_frame)
+                               fault_frame, scale_frame)
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import (ALIVE, DEAD, INF, LEFT, SUSPECT, SimState,
                                   SimStats)
@@ -48,13 +48,23 @@ Reducer = Callable[[jnp.ndarray], jnp.ndarray]
 
 
 def _shrink(c: jnp.ndarray, p: SimParams) -> jnp.ndarray:
-    """Normalized Lifeguard timeout shrink factor for c confirmations."""
-    if not p.lifeguard or p.suspicion_max_s <= p.suspicion_min_s:
+    """Normalized Lifeguard timeout shrink factor for c confirmations.
+
+    `p` may be a params.TracedParams whose suspicion constants are
+    traced leaves: the degenerate max<=min fast path then folds into
+    the formula itself (r >= 1 makes the maximum return ones exactly),
+    so no Python comparison ever touches a tracer."""
+    if not p.lifeguard:
         return jnp.ones_like(c, jnp.float32)
-    r = p.suspicion_min_s / p.suspicion_max_s
+    if not p.sweeps("suspicion_mult", "suspicion_max_timeout_mult",
+                    "probe_interval") \
+            and p.suspicion_max_s <= p.suspicion_min_s:
+        return jnp.ones_like(c, jnp.float32)
+    # shrink_r / shrink_omr are host-folded properties (f64) so the
+    # traced leaves round exactly like the static constants do
     frac = jnp.log(c.astype(jnp.float32) + 1.0) / jnp.log(
-        float(p.confirmation_k) + 1.0)
-    return jnp.maximum(r, 1.0 - (1.0 - r) * frac)
+        jnp.asarray(p.confirmation_k, jnp.float32) + 1.0)
+    return jnp.maximum(p.shrink_r, 1.0 - p.shrink_omr * frac)
 
 
 def _trunc_poisson(u: jnp.ndarray, lam: jnp.ndarray, kmax: int = 4
@@ -123,6 +133,12 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     L = state.up.shape[0]  # local rows (== n on a single device)
     if lane_sink is not None and scalars is None:
         raise ValueError("lane mode runs on stale scalars only")
+    if fx is not None and (p.sweeps("fault_gain")
+                           or p.fault_gain != 1.0):
+        # per-grid-point fault intensity (sweep engine) or a static
+        # non-default gain: blend the frame toward the no-fault
+        # identity BEFORE any channel consumes it
+        fx = scale_frame(fx, p.fault_gain)
     if u01 is None:
         def u01(k):
             return jax.random.uniform(k, (L,))
@@ -140,8 +156,10 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     new_rumor = jnp.zeros((L,), jnp.bool_)
 
     # ------------------------------------------------------------------ churn
-    if p.fail_per_round or p.leave_per_round or p.rejoin_per_round \
-            or fx is not None:
+    # (enabled() not bool(field): churn rates may be traced sweep
+    # leaves — the gate is static per compiled grid, the rates data)
+    if p.enabled("fail_per_round", "leave_per_round",
+                 "rejoin_per_round") or fx is not None:
         u = u01(k_churn)
         # fault-plan churn bursts and flap schedules ride the same
         # channels as the params churn model (rates add; flap uses
@@ -180,7 +198,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         down_time = state.down_time
 
     # -------------------------------------------------- degraded-node churn
-    if p.slow_per_round:
+    if p.enabled("slow_per_round"):
         u_s = u01(k_slow)
         slow = jnp.where(slow, u_s >= p.slow_recover_per_round,
                          u_s < p.slow_per_round) & up
@@ -365,8 +383,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     # A live node refutes a suspect/dead rumor about itself once the rumor
     # reaches it; hearing probability per round follows the epidemic
     # spread. A slow suspect processes its incoming gossip late (factor g).
-    lam_hear = (p.gossip_nodes * p.gossip_ticks_per_round
-                * informed * (1.0 - p.loss) * g)
+    lam_hear = p.fanout_ticks * informed * p.one_minus_loss * g
     if fx is not None:
         # a partitioned/lossy node hears the rumor about itself late or
         # never — the refutation race is exactly what faults break.
@@ -421,8 +438,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     # gossip_nodes messages per tick; an uninformed node misses them all
     # with probability exp(-fanout·ticks·informed·(1−loss)).
     grow = (~new_rumor) & (informed < 1.0)
-    lam_g = (p.gossip_nodes * p.gossip_ticks_per_round
-             * informed * (1.0 - p.loss))
+    lam_g = p.fanout_ticks * informed * p.one_minus_loss
     if fx is not None:
         lam_g = lam_g * fx.mid  # population-mean link degradation
     informed = jnp.where(
@@ -532,7 +548,7 @@ def _pf_arrays(slow, lh, sbar, live_frac, p: SimParams,
     readily as UDP), relay legs by round trip times the population-mean
     link quality (the relay's own two legs)."""
     g = jnp.where(slow, p.slow_factor, 1.0)
-    if p.lifeguard and (p.slow_per_round or fx is not None):
+    if p.lifeguard and (p.enabled("slow_per_round") or fx is not None):
         patience = 1.0 - jnp.exp2(-lh.astype(jnp.float32))
     else:
         patience = jnp.zeros_like(g)

@@ -308,6 +308,82 @@ def run_coords(n: int = 4096, seed: int = 0,
     return report, coords
 
 
+# ----------------------------------------------------------- autotune
+#
+# Parameter-sweep auto-tuner (sim/sweep.py): ONE compiled vmapped
+# runner executes a ≥64-point grid of gossip constants per topology
+# class, and the Pareto report (sim/metrics.sweep_report) picks the
+# constants that minimize detection latency within a false-positive
+# budget at the lowest message load — the Robust-and-Tuneable gossip
+# family's trade-off, measured instead of hand-tuned.
+
+#: per-topology-class base environments the tuner optimizes FOR. Each
+#: carries enough churn that detection latency is measurable and the
+#: network conditions that distinguish the class.
+AUTOTUNE_TOPOLOGIES = ("lan", "wan", "lossy")
+
+#: the default 4x4x4 = 64-point grid of tunable gossip constants:
+#: dissemination fanout, suspicion timer multiplier, gossip tick
+#: period. The suspicion axis deliberately reaches below memberlist's
+#: default (4) down to 1: aggressive timers are where the detection-
+#: latency / false-positive trade-off actually appears, which is what
+#: gives the Pareto front its shape on lossy topologies.
+AUTOTUNE_GRID = {
+    "gossip_nodes": (2.0, 3.0, 4.0, 5.0),
+    "suspicion_mult": (1.0, 2.0, 4.0, 6.0),
+    "gossip_interval": (0.1, 0.2, 0.35, 0.5),
+}
+
+
+def autotune_params(topology: str, n: int) -> SimParams:
+    """The base SimParams a topology class is tuned against."""
+    crash = 0.002
+    common = dict(n=n, tcp_fallback=False, fail_per_round=crash,
+                  rejoin_per_round=crash * 10.0)
+    if topology == "lan":
+        return SimParams.from_gossip_config(GossipConfig.lan(),
+                                            loss=0.01, **common)
+    if topology == "wan":
+        return SimParams.from_gossip_config(GossipConfig.wan(),
+                                            loss=0.03, **common)
+    if topology == "lossy":
+        return SimParams.from_gossip_config(GossipConfig.lan(),
+                                            loss=0.10, **common)
+    raise ValueError(f"unknown autotune topology {topology!r} "
+                     f"(expected one of {AUTOTUNE_TOPOLOGIES})")
+
+
+def run_autotune(topology: str = "lan", n: int = 1024,
+                 rounds: int = 150, seed: int = 0,
+                 grid: Optional[dict] = None,
+                 fp_budget: float = 1.0,
+                 engine: str = "xla") -> dict[str, Any]:
+    """Sweep the gossip constants for one topology class and pick the
+    winner. Returns the sweep_report plus the chosen constants under
+    ``"chosen"`` — the dict a config surface can apply directly."""
+    from consul_tpu.sim.metrics import sweep_report
+    from consul_tpu.sim.params import SweepAxes
+    from consul_tpu.sim.sweep import run_sweep
+
+    p = autotune_params(topology, n)
+    axes = SweepAxes.of(**(grid if grid is not None else AUTOTUNE_GRID))
+    result = run_sweep(p, axes, rounds, seed=seed, engine=engine)
+    report = sweep_report(result, fp_budget=fp_budget)
+    report["scenario"] = "autotune"
+    report["topology"] = topology
+    report["n"] = n
+    report["engine"] = engine
+    report["chosen"] = dict(report["winner"]["params"])
+    return report
+
+
+def run_autotune_suite(n: int = 1024, rounds: int = 150,
+                       seed: int = 0) -> dict[str, Any]:
+    """Every topology class once — the per-class constants table."""
+    return {t: run_autotune(t, n=n, rounds=rounds, seed=seed)
+            for t in AUTOTUNE_TOPOLOGIES}
+
+
 def run_baseline_config(name: str, rounds: int = 300,
                         seed: int = 0) -> dict[str, Any]:
     """Run one of the named BASELINE configs and report FD quality."""

@@ -1,0 +1,293 @@
+"""Parameter-sweep engine: vmap the whole sim over a SimParams grid.
+
+The sim has always compiled once per PARAMETER VALUE — SimParams is a
+jit static argument, so comparing 64 fanout/suspicion configurations
+meant 64 compiles and 64 dispatch streams. This module turns the
+parameter axis into a device axis: ``grid_params`` (sim/params.py)
+lifts the sweepable scalars into traced ``[G]`` pytree leaves, and
+``make_run_sweep`` vmaps the UNMODIFIED round bodies over them, so ONE
+compiled runner executes the whole grid simultaneously — *Robust and
+Tuneable Family of Gossiping Algorithms*' push/pull/fanout family
+(PAPERS.md) explored at hardware speed, Pareto-ranked with the
+detection-latency / false-positive / message-load metrics *Fair and
+Efficient Gossip in Hyperledger Fabric* frames
+(sim/metrics.sweep_report).
+
+Exactness contract (tests/test_sweep.py): every vmapped grid point is
+BITWISE equal — state, stats, flight trace — to the same parameters run
+solo through ``make_run_point`` on the same key. That holds by
+construction: both paths share one scan body (``_make_solo``), the PRNG
+key stream is unbatched (vmap broadcasts the identical draws to every
+point), and parameter scalars enter only elementwise arithmetic, which
+vmap batches without reassociating the [N]-axis reductions.
+
+Engines:
+
+  * ``engine="xla"`` — live-scalar ``gossip_round`` with the flight
+    recorder riding the scan (per-grid-point traces), optional Vivaldi
+    coords (so ``coord_timeout_mult`` is a real axis), optional
+    CompiledFaultPlan shared across the grid with per-point
+    ``fault_gain`` intensity (faults.scale_frame).
+  * ``engine="lanes"`` — the fused reduction-lane scan
+    (round._lane_scan with lanes.reduce_lanes_single): the [30, N]
+    contribution matrix simply gains a leading grid axis, so the whole
+    grid still reduces through the same fixed block table.
+
+A FaultPlan compiles ONCE for the grid (phase tensors are shared data);
+sweeping ``fault_gain`` scales its intensity per grid point without
+recompiling or re-folding the plan.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.faults import (CompiledFaultPlan, active_phase,
+                               fault_frame)
+from consul_tpu.sim import flight
+from consul_tpu.sim import lanes as lanes_mod
+from consul_tpu.sim.params import (GridSpec, SimParams, TracedParams,
+                                   grid_params, point_params)
+from consul_tpu.sim.round import _lane_scan, gossip_round
+from consul_tpu.sim.state import SimState, init_state
+
+ENGINES = ("xla", "lanes")
+
+
+def _xla_scan(state: SimState, tp, keys: jax.Array, rounds: int,
+              flight_every: Optional[int], cp, coords=None, topo=None):
+    """One grid point's full run on the XLA engine — the single scan
+    body both the vmapped grid and the solo reference execute. Mirrors
+    round.run_rounds_flight (same per-round PRNG stream, same
+    decimation cond) with traced params instead of static ones."""
+    with_flight = flight_every is not None
+    with_plan = cp is not None
+    buf0 = (flight.empty_trace(rounds, flight_every) if with_flight
+            else None)
+
+    def body(carry, xs):
+        s, c, buf, prev = carry
+        k, i = xs
+        fx = fault_frame(cp, s.round_idx) if with_plan else None
+        ph = active_phase(cp, s.round_idx) if with_plan \
+            else jnp.int32(-1)
+        if coords is None:
+            s2 = gossip_round(s, k, tp, fx=fx)
+            c2 = aux = None
+        else:
+            s2, c2, aux = gossip_round(s, k, tp, fx=fx, coords=c,
+                                       topo=topo)
+        if with_flight:
+            def rec(cc):
+                b, pv = cc
+                crow = None
+                if coords is not None:
+                    from consul_tpu.sim import coords as coords_mod
+
+                    crow = coords_mod.coord_metrics(c2, topo, aux)
+                row = flight.flight_row(
+                    up=s2.up, status=s2.status, informed=s2.informed,
+                    local_health=s2.local_health,
+                    incarnation=s2.incarnation, t=s2.t,
+                    stats_delta=flight.stats_delta(s2.stats, pv),
+                    phase=ph, coord_row=crow)
+                return (flight.record_row(b, row, i, flight_every),
+                        s2.stats)
+
+            buf, prev = flight.maybe_record((buf, prev), i, rounds,
+                                            flight_every, rec)
+        return (s2, c2, buf, prev), None
+
+    prev0 = state.stats if with_flight else None
+    (final, _, buf, _), _ = jax.lax.scan(
+        body, (state, coords, buf0, prev0),
+        (keys, jnp.arange(rounds, dtype=jnp.int32)))
+    return final, buf
+
+
+def _make_solo(p: SimParams, rounds: int, flight_every: Optional[int],
+               engine: str, with_plan: bool, topo=None):
+    """The per-point runner (state, tp, keys, cp, coords) ->
+    (final_state, trace|None). ONE function object serves the vmapped
+    grid and the un-vmapped solo reference, so the two cannot drift —
+    that identity is the bitwise-conformance argument."""
+    if engine not in ENGINES:
+        raise ValueError(f"unknown sweep engine {engine!r} "
+                         f"(expected one of {ENGINES})")
+    if engine == "lanes":
+        lanes_mod.check_pool(p.n)
+
+        def solo(state, tp, keys, cp, coords):
+            if coords is not None:
+                raise ValueError("the lane engine has no coords mode; "
+                                 "use engine='xla'")
+            out = _lane_scan(state, keys, cp, tp, rounds, flight_every,
+                             with_plan, lanes_mod.reduce_lanes_single,
+                             0)
+            return out if flight_every is not None else (out, None)
+
+        return solo
+
+    def solo(state, tp, keys, cp, coords):
+        return _xla_scan(state, tp, keys, rounds, flight_every, cp,
+                         coords=coords, topo=topo)
+
+    return solo
+
+
+def _broadcast_state(p: SimParams, g: int) -> SimState:
+    s0 = init_state(p.n)
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (g,) + a.shape), s0)
+
+
+def make_run_sweep(p: SimParams, rounds: int, *,
+                   flight_every: Optional[int] = None,
+                   plan: Optional[CompiledFaultPlan] = None,
+                   engine: str = "xla",
+                   coords: bool = False, topo=None):
+    """Build the batched grid runner: ``run(tp, key) -> (states,
+    trace)`` where ``tp`` is a [G]-leaved TracedParams (grid_params),
+    ``states`` the [G]-batched final SimState and ``trace`` the
+    per-grid-point ``[G, rows, flight.N_COLS]`` flight traces (None
+    without ``flight_every``). Every grid point starts from the same
+    ``init_state`` and consumes the SAME key stream — point g is
+    bitwise the solo ``make_run_point`` run of ``point_params(tp, g)``.
+
+    The ENTIRE grid is one jit compilation (``run.jitted`` is exposed
+    so tests can assert ``_cache_size() == 1``) and one dispatch: a
+    G-point sweep costs one trace, one XLA program, G× the FLOPs.
+
+    ``coords=True`` (XLA engine only) threads the Vivaldi subsystem
+    with a shared ground-truth ``topo`` and per-point coordinate state,
+    making ``coord_timeout_mult``/``probe_timeout`` real axes."""
+    if flight_every is not None and not p.collect_stats:
+        raise ValueError("flight recording rides the SimStats "
+                         "counters; build SimParams with "
+                         "collect_stats=True")
+    if coords and engine != "xla":
+        raise ValueError("coords sweeps run on the XLA engine only")
+    if coords and topo is None:
+        raise ValueError("coords=True needs the ground-truth topo "
+                         "(sim/topology.make_topology)")
+    solo = _make_solo(p, rounds, flight_every, engine,
+                      plan is not None, topo=topo)
+
+    @jax.jit
+    def _run(tp: TracedParams, key: jax.Array, cp):
+        g = tp.grid_shape[0]
+        states = _broadcast_state(p, g)
+        keys = jax.random.split(key, rounds)
+        if coords:
+            from consul_tpu.sim.coords import init_coords
+
+            c0 = jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (g,) + a.shape),
+                init_coords(p.n))
+        else:
+            c0 = None
+        return jax.vmap(
+            lambda tpp, st, c: solo(st, tpp, keys, cp, c),
+            in_axes=(0, 0, 0 if coords else None))(tp, states, c0)
+
+    def run(tp: TracedParams, key: jax.Array):
+        if not tp.grid_shape:
+            raise ValueError("expected [G]-leaved grid TracedParams "
+                             "(build with grid_params); for a single "
+                             "point use make_run_point")
+        return _run(tp, key, plan)
+
+    run.jitted = _run
+    return run
+
+
+def make_run_point(p: SimParams, rounds: int, *,
+                   flight_every: Optional[int] = None,
+                   plan: Optional[CompiledFaultPlan] = None,
+                   engine: str = "xla",
+                   coords: bool = False, topo=None):
+    """The solo (un-vmapped) reference runner: ``run(tp_point, key) ->
+    (state, trace)`` for a scalar-leaved TracedParams
+    (params.point_params). Same scan body, same init, same key stream
+    as one grid row of make_run_sweep — the bitwise-equality oracle."""
+    if coords and engine != "xla":
+        raise ValueError("coords sweeps run on the XLA engine only")
+    solo = _make_solo(p, rounds, flight_every, engine,
+                      plan is not None, topo=topo)
+
+    @jax.jit
+    def _run(tp: TracedParams, key: jax.Array, cp):
+        keys = jax.random.split(key, rounds)
+        c0 = None
+        if coords:
+            from consul_tpu.sim.coords import init_coords
+
+            c0 = init_coords(p.n)
+        return solo(init_state(p.n), tp, keys, cp, c0)
+
+    def run(tp: TracedParams, key: jax.Array):
+        if tp.grid_shape:
+            raise ValueError("expected scalar-leaved point params "
+                             "(params.point_params)")
+        return _run(tp, key, plan)
+
+    run.jitted = _run
+    return run
+
+
+class SweepResult(NamedTuple):
+    """One sweep's on-device results plus the host-side grid mirror."""
+
+    states: SimState                 # [G]-batched leaves
+    trace: Optional[jnp.ndarray]     # [G, rows, flight.N_COLS] or None
+    tp: TracedParams                 # the [G]-leaved traced grid
+    points: list                     # G concrete SimParams
+    rounds: int
+    flight_every: Optional[int]
+
+
+def run_sweep(p: SimParams, grid: GridSpec, rounds: int,
+              key: Optional[jax.Array] = None, seed: int = 0, *,
+              flight_every: Optional[int] = None,
+              plan: Optional[CompiledFaultPlan] = None,
+              engine: str = "xla",
+              coords: bool = False, topo=None) -> SweepResult:
+    """Convenience wrapper: build the grid (params.grid_params),
+    validate per-point lane preconditions, execute the WHOLE grid in
+    one compiled vmapped call, return the batched results."""
+    tp, points = grid_params(p, grid)
+    if engine == "lanes" and flight_every is not None:
+        for pp in points:
+            lanes_mod.check_flight_config(pp, flight_every)
+    run = make_run_sweep(p, rounds, flight_every=flight_every,
+                         plan=plan, engine=engine, coords=coords,
+                         topo=topo)
+    if key is None:
+        key = jax.random.key(seed)
+    states, trace = run(tp, key)
+    return SweepResult(states=states, trace=trace, tp=tp,
+                       points=points, rounds=rounds,
+                       flight_every=flight_every)
+
+
+def point_trace(result: SweepResult, i: int):
+    """Grid point i's flight trace (host decode via
+    flight.trace_columns)."""
+    if result.trace is None:
+        return None
+    return result.trace[i]
+
+
+def solo_reference(result: SweepResult, i: int, p: SimParams,
+                   key: jax.Array, *,
+                   plan: Optional[CompiledFaultPlan] = None,
+                   engine: str = "xla"):
+    """Re-run grid point i solo (the conformance oracle) — convenience
+    for tests and spot audits."""
+    run = make_run_point(p, result.rounds,
+                         flight_every=result.flight_every, plan=plan,
+                         engine=engine)
+    return run(point_params(result.tp, i), key)

@@ -53,12 +53,13 @@ def _run_tracked_all(p, rounds, key=0, plan=None, ring_len=512):
 
 def test_layout_registry_digest_pinned():
     """Adding/removing/reordering ANY flight column, black-box event
-    code, or reduction lane must change this digest — update the pin
-    AND audit every decoder (flight.COL consumers, lanes.py consumers,
-    blackbox.decode_timeline, metrics.blackbox_report, the Pallas
-    partial-sum lane slices, ARCHITECTURE.md tables) in the same
-    change."""
-    assert registry.layout_digest() == "8abcce46bb67b7d3"
+    code, reduction lane, or sweep-axis layout entry must change this
+    digest — update the pin AND audit every decoder (flight.COL
+    consumers, lanes.py consumers, blackbox.decode_timeline,
+    metrics.blackbox_report, the Pallas partial-sum lane slices,
+    params.grid_params/TracedParams leaf builders, ARCHITECTURE.md
+    tables) in the same change."""
+    assert registry.layout_digest() == "8e74b32a10117b0e"
 
 
 def test_reduce_lane_layout_pinned():

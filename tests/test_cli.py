@@ -444,6 +444,48 @@ def test_gossip_sim_chaos_end_to_end():
         == ["warmup", "asym_partition", "recover"]
 
 
+def test_gossip_sim_sweep_unknown_topology_structured_error():
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                       "-gossip-sim-sweep", "underwater")
+    assert rc == 1
+    err = json.loads(out.strip().splitlines()[-1])
+    assert "unknown sweep topology" in err["gossip_sim_error"]
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                       "-gossip-sim-sweep", "lan:-3")
+    assert rc == 1
+    err = json.loads(out.strip().splitlines()[-1])
+    assert "rounds" in err["gossip_sim_error"]
+
+
+def test_gossip_sim_sweep_end_to_end_publishes_winner():
+    """`agent -dev -gossip-sim=cpu -gossip-sim-sweep=lan:30` runs the
+    64-point auto-tuner grid in one compiled vmapped call, prints the
+    winner + Pareto front as structured JSON, and publishes the chosen
+    constants through the sim.* metrics bridge."""
+    from consul_tpu.utils import telemetry
+
+    rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                       "-gossip-sim-nodes", "256",
+                       "-gossip-sim-sweep", "lan:30")
+    assert rc == 0, out
+    rep = json.loads(out[out.index("{"):])
+    assert rep["scenario"] == "autotune"
+    assert rep["topology"] == "lan"
+    assert rep["grid_size"] == 64
+    assert set(rep["chosen"]) == {"gossip_nodes", "suspicion_mult",
+                                  "gossip_interval"}
+    assert rep["pareto"], "pareto front must be non-empty"
+    assert rep["winner"]["params"] == rep["chosen"]
+    assert "points" not in rep, "CLI report trims the full table"
+    # the sim.* metrics bridge carries the tuner's verdict
+    snap = telemetry.default.snapshot()
+    prefix = telemetry.default.prefix
+    gauges = {g["Name"]: g["Value"] for g in snap["Gauges"]}
+    assert gauges.get(f"{prefix}.sim.sweep.grid_size") == 64.0
+    for k, v in rep["chosen"].items():
+        assert gauges.get(f"{prefix}.sim.sweep.chosen.{k}") == float(v)
+
+
 def test_gossip_sim_coords_publishes_into_store():
     """`agent -dev -gossip-sim=cpu -gossip-sim-coords` runs the
     network-coordinate scenario AND publishes the virtual members'

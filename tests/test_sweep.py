@@ -1,0 +1,377 @@
+"""Parameter-sweep engine (sim/sweep.py) — exactness and guards.
+
+The contracts pinned here:
+
+  * a >=64-point grid executes in ONE compile (the whole point of the
+    subsystem), and every vmapped grid point is BITWISE equal — state,
+    stats, flight trace — to the same parameters run solo
+    (make_run_point) AND to the static-params engines
+    (run_rounds_flight / make_run_rounds_lanes) on the pinned seed;
+  * no traced SimParams leaf ever reaches Python control flow: the
+    concretization guard traces every engine with EVERY sweepable
+    field abstract, so a regression fails here as a loud
+    TracerBoolConversionError instead of deep inside someone's scan;
+  * fault_gain scales a shared CompiledFaultPlan per grid point
+    (gain=1 reproduces the plan bitwise, gain=0 its absence);
+  * sweep_report Pareto-ranks latency / FP rate / message load and
+    picks a winner inside the FP budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.faults import ChurnBurst, FaultPlan, Partition, Phase, \
+    compile_plan
+from consul_tpu.sim import registry, sweep
+from consul_tpu.sim.metrics import pareto_front, sweep_report
+from consul_tpu.sim.params import (SWEEPABLE_FIELDS, SimParams,
+                                   SweepAxes, TracedParams,
+                                   grid_params, point_params)
+from consul_tpu.sim.round import (make_run_rounds_lanes,
+                                  run_rounds_flight)
+from consul_tpu.sim.state import init_state
+
+_P = SimParams(n=256, loss=0.01, tcp_fallback=False,
+               fail_per_round=0.002, rejoin_per_round=0.02,
+               slow_per_round=0.001)
+
+#: the 4x4x4 = 64-point conformance grid
+_AXES = SweepAxes.of(gossip_nodes=[2, 3, 4, 5],
+                     suspicion_mult=[1, 2, 4, 6],
+                     gossip_interval=[0.1, 0.2, 0.35, 0.5])
+
+_ROUNDS = 10
+_KEY = jax.random.key(7)
+
+
+def _state_point(states, i):
+    return jax.tree.map(lambda x: x[i], states)
+
+
+def _assert_bitwise(a, b, what):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), what
+
+
+# ------------------------------------------------------ grid building
+
+
+def test_sweep_axes_reject_static_fields():
+    with pytest.raises(ValueError, match="STATIC"):
+        SweepAxes.of(n=[256, 512])
+    with pytest.raises(ValueError, match="not a SimParams field"):
+        SweepAxes.of(bogus=[1.0])
+    with pytest.raises(ValueError, match="no values"):
+        SweepAxes.of(loss=[])
+    with pytest.raises(ValueError, match="integer-valued"):
+        grid_params(_P, SweepAxes.of(gossip_nodes=[2.5]))
+
+
+def test_grid_params_ships_derived_leaves():
+    tp, points = grid_params(_P, _AXES)
+    assert tp.grid_shape == (64,)
+    assert len(points) == 64
+    # suspicion_mult swept -> its derived quantities are leaves too
+    for d in ("suspicion_min_s", "suspicion_max_s", "confirmation_k",
+              "gossip_ticks_per_round"):
+        assert d in tp.leaves, d
+    # loss NOT swept -> channel probabilities stay static
+    assert "p_direct" not in tp.leaves
+    # derived leaves match the host f64 property formulas exactly
+    want = np.float32([pp.suspicion_min_s for pp in points])
+    assert np.array_equal(np.asarray(tp.leaves["suspicion_min_s"]),
+                          want)
+
+
+def test_traced_params_refuse_stale_derived():
+    """Reading a derived property whose dependency is swept but whose
+    leaf is missing must raise, never silently use the static value."""
+    tp = TracedParams(_P, {"suspicion_mult": jnp.float32(5.0)})
+    with pytest.raises(AttributeError, match="derived"):
+        _ = tp.suspicion_min_s
+    with pytest.raises(ValueError, match="not sweepable"):
+        TracedParams(_P, {"n": jnp.float32(1.0)})
+    # un-swept reads fall through to the static dataclass
+    assert tp.loss == _P.loss
+    assert tp.enabled("fail_per_round")
+    assert tp.sweeps("suspicion_mult")
+    assert not tp.sweeps("loss")
+
+
+def test_registry_digest_covers_sweep_layout():
+    """The pinned layout digest (tests/test_blackbox.py) must move if
+    the sweep-axes layout moves — same drift guard as the lanes."""
+    base = registry.layout_digest()
+    orig = registry.SWEEP_AXES
+    try:
+        registry.SWEEP_AXES = orig + ("made_up",)
+        assert registry.layout_digest() != base
+    finally:
+        registry.SWEEP_AXES = orig
+    assert registry.layout_digest() == base
+    assert SWEEPABLE_FIELDS == registry.SWEEP_AXES
+    # every sweepable/derived name is a real SimParams attribute
+    for name in registry.SWEEP_AXES:
+        assert name in SimParams.__dataclass_fields__, name
+    for d, deps in registry.SWEEP_DERIVED:
+        assert isinstance(getattr(SimParams, d), property), d
+        for dep in deps:
+            assert dep in registry.SWEEP_AXES, (d, dep)
+
+
+# --------------------------------------------- bitwise grid exactness
+
+
+def test_sweep_64_points_one_compile_bitwise_vs_solo():
+    """The acceptance property: a 64-point grid runs in ONE compile
+    and each vmapped grid point is bitwise its solo run — final state,
+    cumulative stats, and flight trace."""
+    tp, points = grid_params(_P, _AXES)
+    run = sweep.make_run_sweep(_P, _ROUNDS, flight_every=2)
+    states, trace = run(tp, _KEY)
+    jax.block_until_ready(states.t)
+    assert run.jitted._cache_size() == 1, \
+        "the whole grid must cost one trace/compile"
+    # a second call must reuse the compilation
+    states, trace = run(tp, _KEY)
+    assert run.jitted._cache_size() == 1
+    assert trace.shape == (64, 5, len(registry.flight_columns()))
+
+    solo = sweep.make_run_point(_P, _ROUNDS, flight_every=2)
+    from consul_tpu.sim.flight import sweep_trace_columns, trace_columns
+
+    per_point = sweep_trace_columns(trace)
+    for i in (0, 17, 42, 63):
+        st, tr = solo(point_params(tp, i), _KEY)
+        _assert_bitwise(st, _state_point(states, i), f"state[{i}]")
+        assert np.array_equal(np.asarray(tr), np.asarray(trace[i]))
+        # the batched host decoder slices exactly the solo columns
+        solo_cols = trace_columns(tr)
+        for name, col in per_point[i].items():
+            assert np.array_equal(col, solo_cols[name]), (i, name)
+    # the grid is not degenerate: different constants, different runs
+    # (suspicion_mult 1 declares within 10 rounds, 6 cannot)
+    assert not np.array_equal(np.asarray(states.susp_deadline[0]),
+                              np.asarray(states.susp_deadline[63]))
+
+
+def test_sweep_point_vs_static_run_rounds():
+    """Grid point <-> the STATIC engine (run_rounds_flight with the
+    same SimParams).
+
+    A field that is NOT swept stays a compile-time constant in the
+    traced program too, and XLA then emits the identical fusions —
+    test_fault_gain_scales_shared_plan pins that case BITWISE. A field
+    that IS swept becomes a runtime scalar, and XLA's constant-only
+    rewrites (FMA formation, divide-by-constant) legally perturb the
+    last f32 bit; the derived leaves are host-f64 folds of the exact
+    static formulas, so the divergence is bounded at 1 ulp on a few
+    elements (2 when an FMA chain compounds). Pinned here: every
+    integer/bool field (statuses, incarnations, liveness, all SimStats
+    counters) is EXACT, and every f32 field agrees to a few ulp."""
+    tp, points = grid_params(_P, _AXES)
+    run = sweep.make_run_sweep(_P, _ROUNDS, flight_every=2)
+    states, trace = run(tp, _KEY)
+    for i in (5, 17, 60):
+        st, tr = run_rounds_flight(init_state(_P.n), _KEY, points[i],
+                                   _ROUNDS, record_every=2)
+        gs = _state_point(states, i)
+        for f in ("up", "status", "incarnation", "susp_conf",
+                  "local_health", "slow", "round_idx"):
+            assert np.array_equal(np.asarray(getattr(st, f)),
+                                  np.asarray(getattr(gs, f))), (i, f)
+        _assert_bitwise(st.stats, gs.stats, f"stats[{i}]")
+        for f in ("down_time", "informed", "susp_start",
+                  "susp_deadline", "t"):
+            a = np.asarray(getattr(st, f))
+            b = np.asarray(getattr(gs, f))
+            tol = 4 * np.spacing(np.maximum(np.abs(a), np.abs(b))
+                                 .astype(np.float32))
+            assert np.all(np.abs(a - b) <= tol), (i, f)
+        np.testing.assert_allclose(np.asarray(tr), np.asarray(trace[i]),
+                                   rtol=3e-7, atol=1e-7)
+
+
+def test_lane_engine_sweep_bitwise():
+    """engine='lanes': the vmapped lane scan (one batched block-table
+    reduction per round) is bitwise the solo lane runner AND the
+    static make_run_rounds_lanes."""
+    axes = SweepAxes.of(gossip_nodes=[2, 4], suspicion_mult=[2, 6])
+    tp, points = grid_params(_P, axes)
+    run = sweep.make_run_sweep(_P, _ROUNDS, flight_every=2,
+                               engine="lanes")
+    states, trace = run(tp, _KEY)
+    assert run.jitted._cache_size() == 1
+    solo = sweep.make_run_point(_P, _ROUNDS, flight_every=2,
+                                engine="lanes")
+    for i in range(4):
+        st, tr = solo(point_params(tp, i), _KEY)
+        _assert_bitwise(st, _state_point(states, i), f"state[{i}]")
+        assert np.array_equal(np.asarray(tr), np.asarray(trace[i])), i
+    static_run = make_run_rounds_lanes(points[2], _ROUNDS,
+                                       flight_every=2)
+    st, tr = static_run(init_state(_P.n), _KEY)
+    _assert_bitwise(st, _state_point(states, 2), "static lane state")
+    assert np.array_equal(np.asarray(tr), np.asarray(trace[2]))
+
+
+def test_fault_gain_scales_shared_plan():
+    """ONE compiled FaultPlan, per-grid-point intensity: gain=1
+    reproduces the plan's static run bitwise, gain=0 its absence
+    (channel-for-channel on the churn counters), and intensity is
+    monotone in between."""
+    plan = FaultPlan(phases=(
+        Phase(rounds=3, name="warm"),
+        Phase(rounds=6, faults=(
+            ChurnBurst(nodes=(0, 64), crash=0.1, rejoin=0.2),
+            Partition(a=(0, 32), b=(32, 256), drop=1.0)), name="hit"),
+        Phase(rounds=3, name="recover")))
+    cp = compile_plan(plan, _P.n)
+    tp, _ = grid_params(_P, SweepAxes.of(fault_gain=[0.0, 0.5, 1.0]))
+    run = sweep.make_run_sweep(_P, 12, flight_every=12, plan=cp)
+    states, trace = run(tp, _KEY)
+    crashes = np.asarray(states.stats.crashes)
+    assert crashes[0] < crashes[1] < crashes[2]
+    # gain=1.0 == the plan as compiled, through the static engine
+    st1, tr1 = run_rounds_flight(init_state(_P.n), _KEY, _P, 12,
+                                 record_every=12, plan=cp)
+    assert np.array_equal(np.asarray(tr1), np.asarray(trace[2]))
+    _assert_bitwise(st1, _state_point(states, 2), "gain=1 state")
+    # gain=0.0 == no plan at all, on the injected-churn channel
+    st0, _ = run_rounds_flight(init_state(_P.n), _KEY, _P, 12,
+                               record_every=12)
+    assert int(crashes[0]) == int(st0.stats.crashes)
+    assert int(np.asarray(states.stats.false_positives)[0]) \
+        == int(st0.stats.false_positives)
+
+
+# ------------------------------------------------ concretization guard
+
+
+def _all_sweep_points():
+    """Two grid points that sweep EVERY sweepable field — the maximal
+    traced surface."""
+    base = {
+        "probe_interval": (1.0, 1.2), "probe_timeout": (0.5, 0.6),
+        "gossip_interval": (0.2, 0.25), "gossip_nodes": (3, 4),
+        "suspicion_mult": (4, 5), "suspicion_max_timeout_mult": (6, 5),
+        "awareness_max": (8, 6), "loss": (0.01, 0.05),
+        "tcp_fail": (0.0, 0.1), "slow_per_round": (0.001, 0.002),
+        "slow_recover_per_round": (0.05, 0.1),
+        "slow_factor": (0.1, 0.2), "coord_timeout_mult": (3.0, 2.0),
+        "fail_per_round": (0.002, 0.004),
+        "rejoin_per_round": (0.02, 0.04),
+        "leave_per_round": (0.0, 0.001), "fault_gain": (1.0, 0.5),
+    }
+    assert set(base) == set(SWEEPABLE_FIELDS), \
+        "new sweepable field: add it to the concretization guard"
+    return [{k: v[i] for k, v in base.items()} for i in range(2)]
+
+
+def test_no_traced_leaf_in_python_control_flow():
+    """The guard the satellite asks for: trace every engine with EVERY
+    sweepable SimParams field abstract (jit-under-concretization via
+    eval_shape — no FLOPs). A traced leaf reaching `if`/`bool()`
+    anywhere in the sweep.py/round.py call graph dies here as a
+    TracerBoolConversionError with a named test, instead of deep in a
+    user's scan."""
+    p = SimParams(n=256, tcp_fallback=True, coords_timeout=True)
+    tp, points = grid_params(p, _all_sweep_points())
+    plan = FaultPlan(phases=(
+        Phase(rounds=2, name="a"),
+        Phase(rounds=4, faults=(Partition(a=(0, 32), b=(32, 256)),),
+              name="b")))
+    cp = compile_plan(plan, p.n)
+    # XLA engine, flight recorder + fault plan armed
+    run = sweep.make_run_sweep(p, 6, flight_every=2, plan=cp)
+    jax.eval_shape(run.jitted, tp, _KEY, cp)
+    # lane engine (awareness_max is swept, so no lane flight here —
+    # check_flight_config is a host-side per-point gate)
+    run_l = sweep.make_run_sweep(p, 6, engine="lanes", plan=cp)
+    jax.eval_shape(run_l.jitted, tp, _KEY, cp)
+    # coords mode: probe deadlines consume the traced
+    # coord_timeout_mult / probe_timeout leaves
+    from consul_tpu.sim.topology import TopologyParams, make_topology
+
+    topo = make_topology(TopologyParams(n=p.n, seed=0))
+    run_c = sweep.make_run_sweep(p, 6, flight_every=2, coords=True,
+                                 topo=topo)
+    jax.eval_shape(run_c.jitted, tp, _KEY, None)
+    # and the solo reference path
+    solo = sweep.make_run_point(p, 6, flight_every=2, plan=cp)
+    jax.eval_shape(solo.jitted, point_params(tp, 0), _KEY, cp)
+
+
+# ------------------------------------------------------- guard rails
+
+
+def test_sweep_maker_validation():
+    tp, _ = grid_params(_P, SweepAxes.of(loss=[0.0, 0.1]))
+    with pytest.raises(ValueError, match="collect_stats"):
+        sweep.make_run_sweep(_P.with_(collect_stats=False), 4,
+                             flight_every=1)
+    with pytest.raises(ValueError, match="XLA engine"):
+        sweep.make_run_sweep(_P, 4, engine="lanes", coords=True)
+    with pytest.raises(ValueError, match="unknown sweep engine"):
+        sweep.make_run_sweep(_P, 4, engine="pallas")
+    with pytest.raises(ValueError, match="topo"):
+        sweep.make_run_sweep(_P, 4, coords=True)
+    run = sweep.make_run_sweep(_P, 4)
+    with pytest.raises(ValueError, match="grid"):
+        run(point_params(tp, 0), _KEY)
+    solo = sweep.make_run_point(_P, 4)
+    with pytest.raises(ValueError, match="point"):
+        solo(tp, _KEY)
+    # lane engine pools must divide the block table
+    with pytest.raises(ValueError, match="LANE_BLOCKS"):
+        sweep.make_run_sweep(_P.with_(n=100), 4, engine="lanes")
+
+
+# --------------------------------------------------- report & pareto
+
+
+def test_pareto_front_excludes_dominated():
+    rows = [
+        {"lat": 1.0, "fp": 1.0, "load": 5.0},   # front
+        {"lat": 2.0, "fp": 0.5, "load": 5.0},   # front (fp better)
+        {"lat": 2.0, "fp": 1.0, "load": 6.0},   # dominated by 0
+        {"lat": None, "fp": 0.0, "load": 4.0},  # front (fp+load best)
+        {"lat": None, "fp": 0.0, "load": 4.5},  # dominated by 3
+    ]
+    front = pareto_front(rows, ("lat", "fp", "load"))
+    assert front == [0, 1, 3]
+
+
+def test_sweep_report_winner_and_budget():
+    tp, points = grid_params(_P, _AXES)
+    res = sweep.run_sweep(_P, _AXES, rounds=40, key=_KEY)
+    rep = sweep_report(res, fp_budget=1.0)
+    assert rep["grid_size"] == 64
+    assert rep["swept"] == ["gossip_interval", "gossip_nodes",
+                            "suspicion_mult"]
+    assert rep["pareto"], "a 64-point grid must have a Pareto front"
+    for i in rep["pareto"]:
+        assert rep["points"][i]["pareto"] is True
+    w = rep["winner"]
+    assert w["point"] in rep["pareto"]
+    assert w["mean_detect_latency_s"] is None \
+        or w["fp_per_node_hour"] <= 1.0
+    # the winner's reported constants are the grid point's own
+    pp = res.points[w["point"]]
+    for k, v in w["params"].items():
+        assert getattr(pp, k) == v
+
+
+def test_autotune_picks_constants_per_topology():
+    from consul_tpu.sim.scenarios import run_autotune
+
+    rep = run_autotune("lan", n=256, rounds=40)
+    assert rep["grid_size"] == 64
+    assert set(rep["chosen"]) == {"gossip_nodes", "suspicion_mult",
+                                  "gossip_interval"}
+    assert rep["chosen"] == rep["winner"]["params"]
+    assert rep["topology"] == "lan"
+    with pytest.raises(ValueError, match="unknown autotune topology"):
+        run_autotune("underwater", n=256, rounds=4)
