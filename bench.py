@@ -19,8 +19,9 @@ stride on the full-model kernel (recorded as PROFILE_r*.json).
 
 `--mesh [--smoke]` runs the sharded engine's weak-scaling ladder
 (rounds/s per device count + efficiency + the compiled HLO's
-collectives-per-round count) and records it into MULTICHIP_r06.json —
-see run_mesh_bench.
+collectives-per-round count, every row stamped with stale_k and
+loadavg_1m) plus the staleness-k amortization ladder at the top device
+count, recorded into MULTICHIP_r07.json — see run_mesh_bench.
 
 `--sweep [--smoke]` runs the parameter-sweep engine: one compiled
 vmapped runner per topology class executing the 64-point gossip-
@@ -42,6 +43,16 @@ import time
 # stuck in init/compile at the deadline — the main thread can't be
 # interrupted while blocked in C, but os._exit() doesn't need it to be.
 _INIT_TIMEOUT_S = float(os.environ.get("CONSUL_TPU_BENCH_INIT_TIMEOUT", "180"))
+
+
+def _loadavg_1m():
+    """1-minute loadavg (bench_kv convention): a ladder row taken on a
+    contended host is uninterpretable without it — MULTICHIP_r06's
+    0.22 'efficiency' on shared cores is exactly that lesson."""
+    try:
+        return round(os.getloadavg()[0], 2)
+    except OSError:  # platform without getloadavg
+        return None
 
 
 def _error_line(error: str, platform: str, metric: str) -> str:
@@ -140,8 +151,11 @@ def run_mesh_bench(smoke: bool) -> None:
     population over growing device counts and records rounds/s plus
     weak-scaling efficiency (rps at d devices / rps at 1 — ideal is
     1.0 since work scales with the mesh). The compiled HLO's collective
-    count rides along as proof of the one-psum-per-round property. The
-    JSON envelope is printed AND written to MULTICHIP_r06.json next to
+    count rides along as proof of the one-psum-per-round property, and
+    a second ladder at the top device count measures the staleness-k
+    amortization (stale_k in {1,2,4,8} + the overlap schedule); every
+    row records loadavg_1m (shared-core honesty) and its stale_k. The
+    JSON envelope is printed AND written to MULTICHIP_r07.json next to
     this script; with no TPU attached the non-smoke run records the
     BENCH_r05 `{"skipped": true}` watchdog convention instead (missing
     hardware is not a perf regression), and `--smoke` measures the
@@ -149,7 +163,7 @@ def run_mesh_bench(smoke: bool) -> None:
     metric = "mesh_weak_scaling" + ("_smoke" if smoke else "")
     want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
     record_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r06.json")
+        os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r07.json")
 
     def _emit(payload: dict, rc: int = 0) -> None:
         line = json.dumps(payload, indent=2)
@@ -221,7 +235,7 @@ def run_mesh_bench(smoke: bool) -> None:
     watchdog.daemon = True
     watchdog.start()
     per_dev = 8192 if smoke else 131_072
-    rounds = 50 if smoke else 500
+    rounds = 48 if smoke else 480  # divisible by every STALE_KS rung
     iters = 2
     key = jax.random.key(0)
     ladder = []
@@ -252,6 +266,7 @@ def run_mesh_bench(smoke: bool) -> None:
             collectives = total - 2
         state = run(state, key)  # compile + warmup (donates input)
         jax.block_until_ready(state)
+        load = _loadavg_1m()
         best = float("inf")
         for trial in range(3):
             t0 = time.perf_counter()
@@ -264,6 +279,8 @@ def run_mesh_bench(smoke: bool) -> None:
         rps = rounds * iters / best
         ladder.append({
             "devices": d, "n": n,
+            "stale_k": 1,
+            "loadavg_1m": load,
             "rounds_per_sec": round(rps, 1),
             "ms_per_round": round(best / (rounds * iters) * 1e3, 4),
         })
@@ -272,6 +289,49 @@ def run_mesh_bench(smoke: bool) -> None:
     for row in ladder:
         row["weak_scaling_efficiency"] = round(
             row["rounds_per_sec"] / base, 4)
+
+    # staleness-k amortization at the TOP device count: same pool,
+    # reductions every k rounds (frozen scalars in between) and the
+    # double-buffered overlap schedule — the collective-amortization
+    # claim measured, not asserted. loadavg rides every row for the
+    # same shared-core honesty reason as the main ladder.
+    from consul_tpu.sim.registry import STALE_KS
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S * 10, fire_hung)
+    watchdog.daemon = True
+    watchdog.start()
+    d = counts[-1]
+    n = per_dev * d
+    mesh = make_mesh(devices[:d])
+    stale_rows = []
+    for k, overlap in [(k, False) for k in STALE_KS] \
+            + [(STALE_KS[-1], True)]:
+        if rounds % k:
+            continue
+        p = SimParams.from_gossip_config(
+            GossipConfig.lan(), n=n, loss=0.01, tcp_fallback=False,
+            collect_stats=False, stale_k=k)
+        run = make_sharded_run(p, rounds, mesh, overlap=overlap)
+        state = init_sharded_state(n, mesh)
+        state = run(state, key)
+        jax.block_until_ready(state)
+        load = _loadavg_1m()
+        best = float("inf")
+        for trial in range(3):
+            t0 = time.perf_counter()
+            for i in range(iters):
+                state = run(state, jax.random.fold_in(
+                    key, 500 + 10 * trial + i))
+            checksum = float(state.informed.sum())
+            best = min(best, time.perf_counter() - t0)
+            assert checksum > 0
+        stale_rows.append({
+            "devices": d, "n": n, "stale_k": k, "overlap": overlap,
+            "loadavg_1m": load,
+            "rounds_per_sec": round(rounds * iters / best, 1),
+            "ms_per_round": round(best / (rounds * iters) * 1e3, 4),
+        })
+    watchdog.cancel()
     payload = {
         "metric": metric,
         "platform": platform,
@@ -279,6 +339,7 @@ def run_mesh_bench(smoke: bool) -> None:
         "rounds_per_chunk": rounds,
         "collectives_per_round": collectives,
         "ladder": ladder,
+        "stale_k_ladder": stale_rows,
         **({"smoke": True} if smoke else {}),
     }
     if platform != "tpu":
@@ -603,6 +664,10 @@ def main() -> None:
             diag = make_run_rounds(p_diag, diag_chunk)
             diag_kernel = "xla-reference"
         state = init_state(n)
+    # which PER-ROUND engine `diag` actually is — the profile sections
+    # dispatch on this; diag_kernel may later be relabeled to the
+    # megakernel for the headline full-model number
+    diag_engine = diag_kernel
 
     # compile + warmup (under the error-mode watchdog: the device
     # answered, so a hang here is a regression, never a skip)
@@ -663,6 +728,67 @@ def main() -> None:
         assert checksum > 0
     full_rps = diag_chunk * diag_iters / full_best
 
+    # the MEGAKERNEL tier (rounds_per_call fused into one Mosaic
+    # launch): per-round dispatch overhead dominates the full-model
+    # kernel at sub-0.1ms rounds (BENCH_r03: 0.063 ms/round), so the
+    # fused runner is the path to the 10k full-model target. Timed for
+    # BOTH configs when the kernel lowers; the headline full-model
+    # number reports whichever kernel is faster, named.
+    mega_info = None
+    if len(devices) == 1 and kernel.startswith("pallas"):
+        mega_rpc = 8
+        mega_chunk = 64 if smoke else 512    # must divide by mega_rpc
+        mega_diag_chunk = 24 if smoke else 240
+        try:
+            from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+            mega = make_run_rounds_pallas(p, mega_chunk,
+                                          rounds_per_call=mega_rpc)
+            mstate = mega(_clone(state), jax.random.fold_in(key, 3000))
+            jax.block_until_ready(mstate)
+            mbest = float("inf")
+            for trial in range(3):
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    mstate = mega(mstate, jax.random.fold_in(
+                        key, 3001 + 10 * trial + i))
+                checksum = float(mstate.informed.sum())
+                mbest = min(mbest, time.perf_counter() - t0)
+                assert checksum > 0
+            mega_rps = mega_chunk * iters / mbest
+            mega_diag = make_run_rounds_pallas(p_diag, mega_diag_chunk,
+                                               rounds_per_call=mega_rpc)
+            mdstate = mega_diag(_clone(dstate),
+                                jax.random.fold_in(key, 3100))
+            jax.block_until_ready(mdstate)
+            mfbest = float("inf")
+            for trial in range(2):
+                t0 = time.perf_counter()
+                for i in range(diag_iters):
+                    mdstate = mega_diag(mdstate, jax.random.fold_in(
+                        key, 3101 + 10 * trial + i))
+                checksum = float(mdstate.informed.sum())
+                mfbest = min(mfbest, time.perf_counter() - t0)
+                assert checksum > 0
+            mega_full_rps = mega_diag_chunk * diag_iters / mfbest
+            mega_info = {
+                "rounds_per_call": mega_rpc,
+                "rounds_per_sec": round(mega_rps, 1),
+                "full_model_rounds_per_sec": round(mega_full_rps, 1),
+            }
+            if mega_rps > rps:
+                rps = mega_rps
+                kernel = f"pallas-mega-x{mega_rpc}"
+                dt, rounds = mbest, mega_chunk * iters
+            if mega_full_rps > full_rps:
+                full_rps = mega_full_rps
+                # headline label only — diag_engine below keeps naming
+                # the PER-ROUND runner the profile sections dispatch on
+                diag_kernel = f"pallas-mega-full-x{mega_rpc}"
+        except Exception as e:  # noqa: BLE001 — mega optional tier
+            print(f"megakernel unavailable ({e}); per-round kernel "
+                  "numbers stand", file=sys.stderr)
+
     profile_info = None
     if profile:
         import tempfile
@@ -687,7 +813,7 @@ def main() -> None:
             from consul_tpu.sim.blackbox import default_tracked
             from consul_tpu.sim.flight import DEFAULT_RECORD_EVERY
 
-            if diag_kernel == "pallas-full-10array":
+            if diag_engine == "pallas-full-10array":
                 from consul_tpu.sim.pallas_round import \
                     make_run_rounds_pallas
 
@@ -774,6 +900,46 @@ def main() -> None:
                     diag_chunk * ov_iters / bb_best, 1),
                 "overhead_frac": round(bb_best / base_best - 1.0, 4),
             }
+        # megakernel dispatch-amortization curve: ms/round vs
+        # rounds_per_call on the FULL-MODEL kernel (rpc=1 is the
+        # per-round kernel at a matched chunk — the baseline whose
+        # dispatch overhead the fusion removes)
+        mega_profile = None
+        if len(devices) == 1 and diag_engine.startswith("pallas"):
+            try:
+                from consul_tpu.sim.pallas_round import \
+                    make_run_rounds_pallas
+
+                mega_profile = []
+                prof_chunk = 24 if smoke else 240
+                for rpc in (1, 2, 4, 8):
+                    r_mega = make_run_rounds_pallas(
+                        p_diag, prof_chunk, rounds_per_call=rpc)
+                    ms = r_mega(_clone(dstate),
+                                jax.random.fold_in(key, 4000 + rpc))
+                    jax.block_until_ready(ms)
+                    mp_best = float("inf")
+                    for trial in range(3):
+                        t0 = time.perf_counter()
+                        for i in range(diag_iters):
+                            ms = r_mega(ms, jax.random.fold_in(
+                                key, 4001 + 100 * rpc
+                                + 10 * trial + i))
+                        checksum = float(ms.informed.sum())
+                        mp_best = min(mp_best,
+                                      time.perf_counter() - t0)
+                        assert checksum > 0
+                    nr_prof = prof_chunk * diag_iters
+                    mega_profile.append({
+                        "rounds_per_call": rpc,
+                        "ms_per_round": round(
+                            mp_best / nr_prof * 1e3, 4),
+                        "rounds_per_sec": round(nr_prof / mp_best, 1),
+                    })
+            except Exception as e:  # noqa: BLE001
+                print(f"megakernel profile unavailable ({e})",
+                      file=sys.stderr)
+                mega_profile = None
         profile_info = {
             "trace_dir": trace_dir,
             # first traced call minus a steady chunk ≈ compile+lower
@@ -782,6 +948,7 @@ def main() -> None:
             "device_s": round(steady_s - dispatch_s, 4),
             "flight": flight_info,
             "blackbox": blackbox_info,
+            "megakernel": mega_profile,
         }
 
     print(json.dumps({
@@ -795,6 +962,8 @@ def main() -> None:
         "full_model_kernel": diag_kernel,
         "full_model_rounds_per_sec": round(full_rps, 1),
         "platform": platform,
+        "loadavg_1m": _loadavg_1m(),
+        **({"megakernel": mega_info} if mega_info else {}),
         **({"smoke": True, "n": n} if smoke else {}),
         **({"profile": profile_info} if profile else {}),
     }))

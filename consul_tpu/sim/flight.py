@@ -172,7 +172,15 @@ def maybe_record(carry, i, rounds: int, record_every: int, rec_fn):
     inside the lax.cond's taken branch only, so decimation skips the
     row's reduction work on the other record_every-1 rounds. That,
     plus the single end-of-run fetch, is the recorder's whole overhead
-    story."""
+    story.
+
+    Under the amortized-reduction schedules (lane engines with
+    ``SimParams.stale_k`` > 1; the Pallas megakernel's
+    ``rounds_per_call``) the engines invoke this only on
+    reduction/call-boundary rounds — the stride must be a multiple of
+    the cadence (registry.STALE_EMISSION_RULE, enforced by the
+    factories), which keeps every emitted row reduction-fresh and its
+    counter delta an exact window total."""
     is_end = ((i + 1) % record_every == 0) | (i + 1 >= rounds)
     return jax.lax.cond(is_end, rec_fn, lambda c: c, carry)
 

@@ -56,6 +56,12 @@ _STATS_SLICE = slice(_N_SC, _N_SC + len(STATS_FIELDS))
 _GAUGE0 = _N_SC + len(STATS_FIELDS)
 _HIST_SLICE = slice(_GAUGE0 + len(registry.LANE_GAUGES), N_LANES)
 
+#: the SimStats counter rows of a contribution stack — public so the
+#: staleness-k window (round._lane_window) can accumulate exactly these
+#: rows per node across a k-round window while the instantaneous rows
+#: (population scalars, flight gauges) keep only the LAST round's state
+STATS_SLICE = _STATS_SLICE
+
 
 def check_pool(n: int) -> None:
     if n % LANE_BLOCKS:
@@ -73,7 +79,11 @@ def check_flight_config(p, flight_every) -> None:
     the max_local_health gauge decodes the lh exceedance histogram,
     which covers lh >= 1..len(LANE_LH_HIST) — a larger awareness_max
     would silently saturate the recorded gauge while the XLA recorder
-    reports the true max for the same run, so refuse loudly instead."""
+    reports the true max for the same run, so refuse loudly instead.
+
+    With staleness-k the lane vector is fresh only on reduction rounds,
+    so rows can only be emitted there: the stride must be a multiple of
+    stale_k (registry.STALE_EMISSION_RULE)."""
     if flight_every is None:
         return
     if not p.collect_stats:
@@ -87,6 +97,40 @@ def check_flight_config(p, flight_every) -> None:
             f"awareness_max <= {limit} (registry.LANE_LH_HIST); got "
             f"{p.awareness_max} — use the XLA run_rounds_flight "
             "recorder for larger awareness ceilings")
+    if flight_every % p.stale_k:
+        raise ValueError(
+            f"flight rows are emitted only on reduction rounds: "
+            f"record stride {flight_every} must be a multiple of "
+            f"stale_k={p.stale_k} (registry.STALE_EMISSION_RULE)")
+
+
+def check_schedule(p, rounds: int, flight_every, overlap: bool) -> None:
+    """Staleness/overlap schedule preconditions shared by every lane
+    engine factory (single-device and mesh), ONE copy so they cannot
+    drift.
+
+    * ``stale_k`` must be a positive static int; with ``stale_k > 1``
+      a partial final window (rounds % stale_k) runs as an unrolled
+      epilogue ending in its own reduction, so any round count works —
+      EXCEPT under overlap, where the drain schedule needs uniform
+      windows (keep rounds a multiple of stale_k).
+    * ``overlap`` consumes each reduction one window LATE (the psum is
+      in flight while the next window's local compute runs); flight
+      rows need the synchronous reduction, so the two are exclusive.
+    """
+    k = p.stale_k
+    if not isinstance(k, int) or k < 1:
+        raise ValueError(f"stale_k must be a positive int: {k!r}")
+    if overlap and rounds % k:
+        raise ValueError(
+            f"overlap needs uniform reduction windows: rounds={rounds} "
+            f"must be a multiple of stale_k={k}")
+    if overlap and flight_every is not None:
+        raise ValueError(
+            "overlap consumes each lane reduction one window late — "
+            "flight rows need the synchronous reduction; record with "
+            "overlap=False (the amortization still comes from stale_k)")
+    check_flight_config(p, flight_every)
 
 
 # --------------------------------------------- sweep (vmap) batching
@@ -155,7 +199,33 @@ def _block_partials(stack: jnp.ndarray, blocks: int) -> jnp.ndarray:
     return stack.reshape(k, blocks, length // blocks).sum(axis=2)
 
 
-def reduce_lanes_single(stack: jnp.ndarray) -> jnp.ndarray:
+class LaneReducer:
+    """A lane reduction split at the block-table seam.
+
+    ``partials(stack)`` builds the scattered ``[K, LANE_BLOCKS]`` block
+    table (pure LOCAL compute — on the mesh each shard fills only its
+    own columns) and ``fold(table)`` turns the table into the reduced
+    lane vector (the mesh's psum collective lives HERE). Calling the
+    reducer runs both stages back to back — the classic synchronous
+    reduction, op-for-op what the pre-split function did.
+
+    The seam exists for the double-buffered overlap schedule
+    (round._lane_scan overlap=True): the scan carries the in-flight
+    table and ``fold``s it one window late, so the collective has NO
+    consumer inside the current window's local compute and XLA's async
+    scheduler can hide it behind the round math."""
+
+    def partials(self, stack: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def fold(self, table: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, stack: jnp.ndarray) -> jnp.ndarray:
+        return self.fold(self.partials(stack))
+
+
+class _SingleDeviceReducer(LaneReducer):
     """Single-device lane reducer: ONE fused sum of the stacked
     contribution matrix, via the same fixed block table the mesh
     reducer psums — [K, L] -> [K, LANE_BLOCKS] -> [K].
@@ -166,40 +236,69 @@ def reduce_lanes_single(stack: jnp.ndarray) -> jnp.ndarray:
     block-then-table order (the psum is a natural barrier there), and
     single-vs-sharded conformance degrades from bitwise to
     approximate."""
-    part = jax.lax.optimization_barrier(
-        _block_partials(stack, LANE_BLOCKS))
-    return part.sum(axis=1)
 
+    def partials(self, stack: jnp.ndarray) -> jnp.ndarray:
+        return jax.lax.optimization_barrier(
+            _block_partials(stack, LANE_BLOCKS))
 
-def mesh_lane_reducer(reduce_axes: Sequence[str], scope_shards: int):
-    """Lane reducer for a shard_map body: per-shard block partials are
-    scattered into the shard's own columns of a zero
-    ``[K, LANE_BLOCKS]`` table and the table is psummed over
-    `reduce_axes` — the round's ONE cross-device collective. Every
-    shard then folds the identical table exactly like
-    ``reduce_lanes_single`` does on one device.
-
-    `scope_shards` is the static number of shards inside the reduction
-    scope (all devices for the global pool; the "nodes" axis size for
-    per-DC pools)."""
-    if LANE_BLOCKS % scope_shards:
-        raise ValueError(
-            f"device count {scope_shards} must divide "
-            f"LANE_BLOCKS={LANE_BLOCKS}")
-    per = LANE_BLOCKS // scope_shards
-
-    def reducer(stack: jnp.ndarray) -> jnp.ndarray:
-        k = stack.shape[0]
-        part = jax.lax.optimization_barrier(_block_partials(stack, per))
-        idx = jnp.int32(0)
-        for ax in reduce_axes:
-            idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
-        table = jnp.zeros((k, LANE_BLOCKS), jnp.float32)
-        table = jax.lax.dynamic_update_slice(table, part, (0, idx * per))
-        table = jax.lax.psum(table, tuple(reduce_axes))
+    def fold(self, table: jnp.ndarray) -> jnp.ndarray:
         return table.sum(axis=1)
 
-    return reducer
+
+#: module-level instance — the name every caller has always passed as
+#: ``lane_reducer=`` (instances are callable, so the API is unchanged)
+reduce_lanes_single = _SingleDeviceReducer()
+
+
+class _MeshReducer(LaneReducer):
+    """Lane reducer for a shard_map body: per-shard block partials are
+    scattered into the shard's own columns of a zero
+    ``[K, LANE_BLOCKS]`` table (``partials`` — local) and the table is
+    psummed over `reduce_axes` (``fold`` — the round's ONE cross-device
+    collective). Every shard then folds the identical table exactly
+    like the single-device reducer does."""
+
+    def __init__(self, reduce_axes: Sequence[str], scope_shards: int):
+        if LANE_BLOCKS % scope_shards:
+            raise ValueError(
+                f"device count {scope_shards} must divide "
+                f"LANE_BLOCKS={LANE_BLOCKS}")
+        self.reduce_axes = tuple(reduce_axes)
+        self.per = LANE_BLOCKS // scope_shards
+
+    def partials(self, stack: jnp.ndarray) -> jnp.ndarray:
+        k = stack.shape[0]
+        part = jax.lax.optimization_barrier(
+            _block_partials(stack, self.per))
+        idx = jnp.int32(0)
+        for ax in self.reduce_axes:
+            idx = idx * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+        table = jnp.zeros((k, LANE_BLOCKS), jnp.float32)
+        return jax.lax.dynamic_update_slice(table, part,
+                                            (0, idx * self.per))
+
+    def fold(self, table: jnp.ndarray) -> jnp.ndarray:
+        return jax.lax.psum(table, self.reduce_axes).sum(axis=1)
+
+
+def mesh_lane_reducer(reduce_axes: Sequence[str],
+                      scope_shards: int) -> LaneReducer:
+    """The mesh lane reducer (see _MeshReducer). `scope_shards` is the
+    static number of shards inside the reduction scope (all devices for
+    the global pool; the "nodes" axis size for per-DC pools)."""
+    return _MeshReducer(reduce_axes, scope_shards)
+
+
+def seed_table(lanes0: jnp.ndarray, shard_offset) -> jnp.ndarray:
+    """A block table whose ``fold`` yields exactly ``lanes0`` — the
+    overlap schedule's initial in-flight carry, so the FIRST window's
+    fold hands the second window the same exact init_lanes vector the
+    first window consumed. Only the shard at global offset 0 carries
+    the values (psum adds them once); the column-0 placement plus zeros
+    elsewhere keeps the fold's f32 sums exact on any device count."""
+    table = jnp.zeros((lanes0.shape[0], LANE_BLOCKS), jnp.float32)
+    first = jnp.asarray(shard_offset == 0, jnp.float32)
+    return table.at[:, 0].set(lanes0 * first)
 
 
 # ------------------------------------------------------- lane consumers

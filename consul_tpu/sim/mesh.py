@@ -93,13 +93,23 @@ def _plan_specs() -> CompiledFaultPlan:
 def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
                    reduce_axes,
                    flight_every: Optional[int] = None,
-                   plan: Optional[CompiledFaultPlan] = None):
+                   plan: Optional[CompiledFaultPlan] = None,
+                   overlap: bool = False,
+                   unroll: bool = False):
     """One factory for every mesh runner: `reduce_axes` scopes the
     population coupling — ("dc","nodes") = one global pool,
     ("nodes",) = independent per-DC pools. `flight_every` arms the
     flight recorder (rows from the reduced lane vector — no extra
     collectives); `plan` threads a compiled FaultPlan through
-    shard_body (same-shape plans may be swapped per call)."""
+    shard_body (same-shape plans may be swapped per call).
+
+    ``p.stale_k`` amortizes the one-collective-per-round property k×
+    (one psum per k-round super-round; the in-between rounds consume
+    frozen scalars and are collective-free in compiled HLO);
+    ``overlap`` additionally folds each psum one super-round late so
+    the collective overlaps the next window's local compute (flight
+    recording refused — see round._lane_scan). ``unroll`` fully
+    unrolls the super-round scan for HLO collective audits."""
     reduce_axes = tuple(reduce_axes)
     if p.collect_stats and reduce_axes != AXES:
         # stats out-specs are replicated; axis-scoped psums would leave
@@ -107,7 +117,15 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
         raise ValueError(
             "per-DC pools cannot carry global stats counters; build "
             "SimParams with collect_stats=False")
-    lanes_mod.check_flight_config(p, flight_every)
+    if overlap and reduce_axes != AXES:
+        # lanes.seed_table keys the init carry on GLOBAL shard offset
+        # 0; in a per-DC psum scope every shard of DC >= 1 has a
+        # nonzero offset, so the first fold would hand those pools an
+        # all-zero scalar vector. Refuse rather than silently corrupt.
+        raise ValueError(
+            "overlap is implemented for the global reduction scope "
+            "only; per-DC/segment pools run the synchronous schedule")
+    lanes_mod.check_schedule(p, rounds, flight_every, overlap)
     lanes_mod.check_pool(p.n)
     scope_shards = 1
     for ax in reduce_axes:
@@ -128,7 +146,8 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
                  + jax.lax.axis_index("nodes"))
         offset = shard * state.up.shape[0]
         return _lane_scan(state, keys, cp, p, rounds, flight_every,
-                          with_plan, reducer, offset)
+                          with_plan, reducer, offset,
+                          overlap=overlap, unroll=unroll)
 
     out_specs = (specs, P()) if with_flight else specs
     if with_plan:
@@ -160,13 +179,18 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
 
 def make_sharded_run(p: SimParams, rounds: int, mesh: Mesh,
                      flight_every: Optional[int] = None,
-                     plan: Optional[CompiledFaultPlan] = None):
+                     plan: Optional[CompiledFaultPlan] = None,
+                     overlap: bool = False,
+                     unroll: bool = False):
     """Compiled multi-device runner over ONE global pool: exactly one
-    psum collective per gossip round; with `flight_every` the return
+    psum collective per ``p.stale_k``-round reduction window (one per
+    round at the default stale_k=1); with `flight_every` the return
     becomes (state, trace) — the decimated flight rows riding the same
-    collective."""
+    collective. ``overlap`` double-buffers the psum against the next
+    window's compute; ``unroll`` is the HLO-audit knob."""
     return _make_mesh_run(p, rounds, mesh, AXES,
-                          flight_every=flight_every, plan=plan)
+                          flight_every=flight_every, plan=plan,
+                          overlap=overlap, unroll=unroll)
 
 
 def make_multidc_run(p: SimParams, rounds: int, mesh: Mesh,

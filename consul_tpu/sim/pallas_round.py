@@ -113,64 +113,49 @@ def _write_mask(p: SimParams, fault: bool = False) -> list[bool]:
     return mask
 
 
-def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
-                  *refs, p: SimParams, fault: bool = False):
-    """One block of one protocol period (grid = node blocks)."""
-    n_arrays = 10 if _model_arrays(p, fault) else 8
-    mask = _write_mask(p, fault)
-    n_out = sum(mask)
-    n_fins = N_FAULT_INS if fault else 0
-    ins = refs[:n_arrays]
-    fins = refs[n_arrays:n_arrays + n_fins]
-    outs = refs[n_arrays + n_fins:n_arrays + n_fins + n_out]
-    partial_o = refs[n_arrays + n_fins + n_out]
-    (up_ref, status_ref, inc_ref, informed_ref,
-     s_start_ref, s_dead_ref, s_conf_ref, lh_ref) = ins[:8]
-    (up_o, status_o, inc_o, informed_o,
-     s_start_o, s_dead_o, s_conf_o, lh_o) = outs[:8]
-    down_ref = slow_ref = down_o = slow_o = None
-    if n_arrays == 10:
-        down_ref, slow_ref = ins[8], ins[9]
-        k = 8
-        if mask[8]:
-            down_o = outs[k]
-            k += 1
-        if mask[9]:
-            slow_o = outs[k]
-    blk = pl.program_id(0)
-    pltpu.prng_seed(seed_ref[0] + blk)
+def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
+    """One block's protocol period as PURE VALUE math — the single copy
+    of the kernel-side round body, shared by the per-round kernel
+    (_round_kernel) and the multi-round megakernel (_mega_kernel) so
+    the two cannot drift (the Mosaic twin of round._round_core's
+    one-body-many-engines structure).
 
-    t = t_ref[0]
+    `vals` is the 10-tuple of RAW block arrays as loaded from refs
+    (down_time/slow None for 8-array configs), `fxv` the raw
+    fault-input arrays or None, `scal` the 9 SMEM scalars
+    (N_SCALARS stale sums + the plan's mean link quality or None).
+    Returns (outs, sums): the updated block values (caller stores per
+    its write mask) and the partial-sum list in registry.REDUCE_LANES
+    prefix order. All casts happen HERE in the original op order —
+    small ints to int32 first, so i1 masks keep combinable tilings."""
+    (up_raw, status_raw, inc_raw, informed_raw, s_start_raw,
+     s_dead_raw, s_conf_raw, lh_raw, down_raw, slow_raw) = vals
     t_end = t + p.probe_interval
     n = p.n
 
     # stale scalars for this round
-    n_live = scal_ref[0]
-    n_elig = scal_ref[1]
-    n_up_elig = scal_ref[2]
-    n_slow = scal_ref[3]
-    lfail_num, lfail_den = scal_ref[6], scal_ref[7]
-    mid = scal_ref[N_SCALARS] if fault else None  # plan's link quality
+    (n_live, n_elig, n_up_elig, n_slow, pf_fast_sum, pf_slow_sum,
+     lfail_num, lfail_den, mid) = scal
     frac_up_elig = n_up_elig / n_elig
     sbar = n_slow / jnp.maximum(n_up_elig, 1e-9)
-    e_pf_fast = scal_ref[4] / jnp.maximum(n_live, 1e-9)
-    e_pf_slow = scal_ref[5] / jnp.maximum(n_live, 1e-9)
+    e_pf_fast = pf_fast_sum / jnp.maximum(n_live, 1e-9)
+    e_pf_slow = pf_slow_sum / jnp.maximum(n_live, 1e-9)
     scale = lfail_num / lfail_den if p.lifeguard else jnp.float32(1.0)
 
     # load small ints as int32 FIRST: i1 masks inherit the source's
     # tiling, and int8-derived (32,128) masks cannot combine with
     # f32/int32-derived (8,128) masks under Mosaic
-    up = up_ref[:].astype(jnp.int32) != 0
-    status = status_ref[:].astype(jnp.int32)
-    inc = inc_ref[:]
-    informed = informed_ref[:]
-    s_start = s_start_ref[:]
-    s_dead = s_dead_ref[:]
-    s_conf = s_conf_ref[:].astype(jnp.int32)
-    lh = lh_ref[:].astype(jnp.int32)
-    if down_ref is not None:
-        down_time = down_ref[:]
-        slow = slow_ref[:].astype(jnp.int32) != 0
+    up = up_raw.astype(jnp.int32) != 0
+    status = status_raw.astype(jnp.int32)
+    inc = inc_raw
+    informed = informed_raw
+    s_start = s_start_raw
+    s_dead = s_dead_raw
+    s_conf = s_conf_raw.astype(jnp.int32)
+    lh = lh_raw.astype(jnp.int32)
+    if down_raw is not None:
+        down_time = down_raw
+        slow = slow_raw.astype(jnp.int32) != 0
     else:
         down_time = None
         slow = jnp.zeros(up.shape, jnp.bool_)
@@ -181,13 +166,9 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     # per-round fault-injection inputs (computed by fault_frame in the
     # scan body — the kernel only consumes per-node data)
     if fault:
-        (psend_ref, precv_ref, suspw_ref, hearw_ref,
-         slowf_ref, crashp_ref, rejoinp_ref, leavep_ref) = fins
-        psend, precv = psend_ref[:], precv_ref[:]
-        suspw, hear_w = suspw_ref[:], hearw_ref[:]
-        slow_f = slowf_ref[:].astype(jnp.int32) != 0
-        crash_p, rejoin_p = crashp_ref[:], rejoinp_ref[:]
-        leave_p = leavep_ref[:]
+        (psend, precv, suspw, hear_w,
+         slowf_raw, crash_p, rejoin_p, leave_p) = fxv
+        slow_f = slowf_raw.astype(jnp.int32) != 0
 
     # ------------------------------------------------------------- churn
     if _has_churn(p, fault):
@@ -322,20 +303,6 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
         grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_grow)),
         informed)
 
-    # write back
-    up_o[:] = up.astype(up_ref.dtype)
-    status_o[:] = status.astype(status_ref.dtype)
-    inc_o[:] = inc
-    informed_o[:] = informed
-    s_start_o[:] = s_start
-    s_dead_o[:] = s_dead
-    s_conf_o[:] = s_conf.astype(s_conf_ref.dtype)
-    lh_o[:] = lh.astype(lh_ref.dtype)
-    if down_o is not None:
-        down_o[:] = down_time
-    if slow_o is not None:
-        slow_o[:] = slow.astype(slow_ref.dtype)
-
     # next round's partial sums for this block
     upf = up.astype(jnp.float32)
     elig2 = (status == ALIVE) | (status == SUSPECT)
@@ -365,15 +332,54 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
             jnp.sum(rejoin.astype(jnp.float32)),
             jnp.sum(leave.astype(jnp.float32)),
         ]
-    # TPU blocks must be (8,128)-tiled: place the sums at row 0,
-    # cols 0..7 (population scalars) and, with collect_stats, cols
-    # 8..15 (cumulative counters) of a padded tile
+    outs = (up, status, inc, informed, s_start, s_dead, s_conf, lh,
+            down_time, slow)
+    return outs, sums
+
+
+def _pad_sums(sums, col0: int = 0) -> jnp.ndarray:
+    """Scalar sums -> a (8,128) f32 tile with the values at row 0,
+    cols col0..col0+len-1 (TPU blocks must be (8,128)-tiled)."""
     row = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
     col = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1)
     padded = jnp.zeros((8, 128), jnp.float32)
     for k, v in enumerate(sums):
-        padded = padded + jnp.where((row == 0) & (col == k), v, 0.0)
-    partial_o[:] = padded
+        padded = padded + jnp.where((row == 0) & (col == col0 + k),
+                                    v, 0.0)
+    return padded
+
+
+def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
+                  *refs, p: SimParams, fault: bool = False):
+    """One block of one protocol period (grid = node blocks)."""
+    n_arrays = 10 if _model_arrays(p, fault) else 8
+    mask = _write_mask(p, fault)
+    n_out = sum(mask)
+    n_fins = N_FAULT_INS if fault else 0
+    ins = refs[:n_arrays]
+    fins = refs[n_arrays:n_arrays + n_fins]
+    outs = refs[n_arrays + n_fins:n_arrays + n_fins + n_out]
+    partial_o = refs[n_arrays + n_fins + n_out]
+    blk = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0] + blk)
+
+    vals = tuple(r[:] for r in ins)
+    if n_arrays == 8:
+        vals = vals + (None, None)
+    fxv = tuple(r[:] for r in fins) if fault else None
+    scal = tuple(scal_ref[i] for i in range(N_SCALARS)) \
+        + ((scal_ref[N_SCALARS],) if fault else (None,))
+    new_vals, sums = _block_round(p, fault, vals, fxv, scal, t_ref[0])
+
+    # write back (only the arrays this config can mutate)
+    k = 0
+    for i, w in enumerate(mask):
+        if w:
+            outs[k][:] = new_vals[i].astype(ins[i].dtype)
+            k += 1
+    # place the sums at row 0, cols 0..7 (population scalars) and,
+    # with collect_stats, cols 8..15 (cumulative counters)
+    partial_o[:] = _pad_sums(sums)
 
 
 def _build_round(p: SimParams, n: int, interpret: bool = False,
@@ -433,12 +439,286 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
     return one_round, rows, n_arrays
 
 
+def _mega_kernel(scal_ref, seeds_ref, t_ref,  # scalar-prefetch operands
+                 *refs, p: SimParams, rpc: int):
+    """One block of `rpc` consecutive protocol periods.
+
+    Grid is (node blocks, rounds) with rounds INNERMOST: the TPU grid
+    iterates sequentially with the last dimension fastest, and every
+    block spec's index map ignores the round index — so a block's state
+    stays RESIDENT in VMEM for all rpc inner rounds (Pallas only
+    refetches/writes back when an index map output changes). One HBM
+    read + one write per block per CALL instead of per round: the
+    megakernel amortizes kernel-dispatch overhead rpc× AND cuts the
+    bandwidth-bound round's HBM traffic by the same factor.
+
+    The population scalars are FROZEN for the whole call (read once
+    from SMEM prefetch) — exactly the lane engine's stale_k == rpc
+    schedule, with the same exactness story: the partial-sum tile
+    persists across the inner rounds (its index map ignores r too), the
+    SimStats counter columns ACCUMULATE every round so the emitted
+    sums are exact call totals, and the population-scalar columns are
+    written on the LAST round only — the freshest state for the next
+    call's scalars. Round r reads what round r-1 wrote: the out refs
+    are the working state (round 0 copies in→out first), so no
+    input/output aliasing — and no cross-round DMA ordering hazards —
+    is ever needed."""
+    n_arrays = 10 if _model_arrays(p) else 8
+    mask = _write_mask(p)
+    n_out = sum(mask)
+    ins = refs[:n_arrays]
+    outs = refs[n_arrays:n_arrays + n_out]
+    partial_o = refs[n_arrays + n_out]
+    blk = pl.program_id(0)
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        # round 0 promotes the out refs to the block's working state
+        # and zeroes the persistent partial tile
+        k = 0
+        for i, w in enumerate(mask):
+            if w:
+                outs[k][:] = ins[i][:]
+                k += 1
+        partial_o[:] = jnp.zeros((8, 128), jnp.float32)
+
+    # fresh per-(round, block) seed — the SAME stream shape the
+    # per-round kernel draws with seed + blk per call
+    pltpu.prng_seed(seeds_ref[r] + blk)
+    t = t_ref[0] + r.astype(jnp.float32) * p.probe_interval
+
+    # working state: mutated arrays live in the out refs, constant
+    # arrays pass through from the in refs
+    vals = []
+    k = 0
+    for i, w in enumerate(mask):
+        if w:
+            vals.append(outs[k][:])
+            k += 1
+        else:
+            vals.append(ins[i][:])
+    if n_arrays == 8:
+        vals += [None, None]
+    scal = tuple(scal_ref[i] for i in range(N_SCALARS)) + (None,)
+    new_vals, sums = _block_round(p, False, tuple(vals), None, scal, t)
+
+    k = 0
+    for i, w in enumerate(mask):
+        if w:
+            outs[k][:] = new_vals[i].astype(ins[i].dtype)
+            k += 1
+    if p.collect_stats:
+        # counter lanes accumulate across the inner rounds (cols 8..15)
+        partial_o[:] = partial_o[:] + _pad_sums(sums[N_SCALARS:],
+                                                col0=N_SCALARS)
+
+    @pl.when(r == rpc - 1)
+    def _last():
+        # population-scalar lanes: the LAST round's post-state sums
+        # (cols 0..7) — the next call's stale scalars
+        partial_o[:] = partial_o[:] + _pad_sums(sums[:N_SCALARS])
+
+
+def _build_mega(p: SimParams, n: int, rpc: int, interpret: bool = False):
+    """The rpc-rounds-per-call pallas_call (see _mega_kernel). Same
+    block structure and write mask as _build_round — only the grid
+    gains the inner round dimension."""
+    n_arrays = 10 if _model_arrays(p) else 8
+    mask = _write_mask(p)
+    out_idx = [i for i, w in enumerate(mask) if w]
+    rows_per_block = ROWS_FULL if n_arrays == 10 else ROWS_STABLE
+    block = rows_per_block * LANES
+    assert n % block == 0, f"n={n} must be a multiple of {block}"
+    grid_b = n // block
+    rows = n // LANES
+
+    kernel = functools.partial(_mega_kernel, p=p, rpc=rpc)
+
+    def row_spec():
+        return pl.BlockSpec((rows_per_block, LANES),
+                            lambda b, r, *_: (b, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # scalars, seeds[rpc], t
+        grid=(grid_b, rpc),
+        in_specs=[row_spec() for _ in range(n_arrays)],
+        out_specs=[row_spec() for _ in out_idx]
+        + [pl.BlockSpec((8, 128), lambda b, r, *_: (b, 0))],
+    )
+
+    def mega_rounds(args, scalars, seeds, t):
+        outs = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=[jax.ShapeDtypeStruct((rows, LANES),
+                                            args[i].dtype)
+                       for i in out_idx]
+            + [jax.ShapeDtypeStruct((grid_b * 8, 128), jnp.float32)],
+            interpret=interpret,
+        )(scalars, seeds, t, *args)
+        *state_out, partials = outs
+        full = list(args)
+        for k, i in enumerate(out_idx):
+            full[i] = state_out[k]
+        row0 = partials.reshape(grid_b, 8, 128)[:, 0, :].sum(axis=0)
+        return tuple(full), row0[:N_SCALARS], \
+            row0[N_SCALARS:N_SCALARS + 8]
+
+    return mega_rounds, rows, n_arrays
+
+
+def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
+                   flight_every: Optional[int], with_bb: bool):
+    """The rounds_per_call > 1 runner: an outer scan of rounds/rpc
+    megakernel launches (see _mega_kernel). Scalars update between
+    CALLS from the kernel's emitted last-round partials — the stale_k
+    == rpc schedule with kernel-dispatch and HBM round-trip costs
+    amortized rpc×."""
+    mega, rows, n_arrays = _build_mega(p, p.n, rpc, interpret)
+    steps = rounds // rpc
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def _run(state: SimState, key: jax.Array, tracked=None):
+        from consul_tpu.sim import blackbox as blackbox_mod
+        from consul_tpu.sim import flight
+
+        if with_bb and tracked is None:
+            raise ValueError("blackbox=True runner needs a tracked "
+                             "id array (blackbox.default_tracked)")
+        scalars = init_scalars(state, p)
+        scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
+        seeds = jax.random.randint(key, (steps, rpc), 0, 2**31 - 1,
+                                   dtype=jnp.int32)
+        r0s = state.round_idx + jnp.arange(steps, dtype=jnp.int32) * rpc
+
+        def to2d(x):
+            return x.reshape(rows, LANES)
+
+        args = (to2d(state.up.astype(jnp.int8)), to2d(state.status),
+                to2d(state.incarnation), to2d(state.informed),
+                to2d(state.susp_start), to2d(state.susp_deadline),
+                to2d(state.susp_conf), to2d(state.local_health))
+        if n_arrays == 10:
+            args = args + (to2d(state.down_time),
+                           to2d(state.slow.astype(jnp.int8)))
+
+        def body(carry, x):
+            args, scalars, t, acc, rec = carry
+            seed_row, r0 = x
+            args2, partials, stat_sums = mega(args, scalars, seed_row,
+                                              t[None])
+            partials = partials.at[1].max(1.0).at[2].max(1e-9) \
+                .at[7].max(1e-9)
+            # per-call sums stay < 2^24 (exact in f32); the carry
+            # accumulates in int32 like the per-round runner
+            acc_i = acc[0] + stat_sums.at[_LAT].set(0.0) \
+                .astype(jnp.int32)
+            acc_lat = acc[1] + stat_sums[_LAT]
+            t2 = t + jnp.float32(rpc) * p.probe_interval
+            if flight_every is not None:
+                r_last = r0 + (rpc - 1)
+
+                def rec_fn(c):
+                    # same delta-against-snapshot recording as the
+                    # per-round runner; rows can only land on call
+                    # boundaries (the kernel's inner state never
+                    # surfaces), hence the stride % rpc gate
+                    if with_bb:
+                        buf_c, (pi, plat), bbc = c
+                    else:
+                        buf_c, (pi, plat) = c
+                    delta = _stats_delta(acc_i - pi, acc_lat - plat)
+                    row = flight.flight_row(
+                        up=args2[0], status=args2[1],
+                        informed=args2[3], local_health=args2[7],
+                        incarnation=args2[2], t=t2,
+                        stats_delta=delta, phase=jnp.int32(-1))
+                    buf2 = flight.record_row(
+                        buf_c, row, r_last - state.round_idx,
+                        flight_every)
+                    if not with_bb:
+                        return (buf2, (acc_i, acc_lat))
+                    bbc = blackbox_mod.record(
+                        bbc, round_idx=r_last, phase=jnp.int32(-1),
+                        status=args2[1], incarnation=args2[2],
+                        susp_conf=args2[6], up=args2[0])
+                    return (buf2, (acc_i, acc_lat), bbc)
+
+                rec = flight.maybe_record(
+                    rec, r_last - state.round_idx, rounds,
+                    flight_every, rec_fn)
+            return (args2, partials, t2, (acc_i, acc_lat), rec), None
+
+        acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
+        if flight_every is not None:
+            rec0 = (flight.empty_trace(rounds, flight_every), acc0)
+            if with_bb:
+                rec0 = rec0 + (blackbox_mod.init_blackbox(
+                    state, tracked, p.blackbox_ring),)
+        else:
+            rec0 = jnp.zeros((0,), jnp.float32)
+        (args, scalars, t_final, acc, rec), _ = jax.lax.scan(
+            body, (args, scalars, state.t, acc0, rec0), (seeds, r0s))
+        acc_i, acc_lat = acc
+        trace = rec[0] if flight_every is not None else None
+        bb_out = rec[2] if with_bb else None
+        (up, status, inc, informed, s_start, s_dead, s_conf,
+         lh) = args[:8]
+        if n_arrays == 10:
+            down, slow = args[8], args[9]
+            down_flat, slow_flat = (down.reshape(-1),
+                                    slow.reshape(-1) != 0)
+        else:
+            down_flat, slow_flat = state.down_time, state.slow
+        st = (_stats_add(state.stats, acc_i, acc_lat)
+              if p.collect_stats else state.stats)
+        out = SimState(
+            up=up.reshape(-1) != 0, down_time=down_flat,
+            status=status.reshape(-1), incarnation=inc.reshape(-1),
+            informed=informed.reshape(-1),
+            susp_start=s_start.reshape(-1),
+            susp_deadline=s_dead.reshape(-1),
+            susp_conf=s_conf.reshape(-1),
+            local_health=lh.reshape(-1),
+            slow=slow_flat, t=t_final,
+            round_idx=state.round_idx + rounds, stats=st)
+        res = (out,)
+        if flight_every is not None:
+            res = res + (trace,)
+        if with_bb:
+            res = res + (bb_out,)
+        return res[0] if len(res) == 1 else res
+
+    if n_arrays == 10:
+        return _run
+
+    seen_ok: list = [None]
+
+    def run(state: SimState, key: jax.Array, tracked=None):
+        # same residual-slow-node refusal as the per-round 8-array
+        # runner (see make_run_rounds_pallas below)
+        if state.slow is not seen_ok[0]:
+            if bool(state.slow.any()):
+                raise ValueError(
+                    "state has slow nodes but params disable the "
+                    "slow-node model; use a SimParams with "
+                    "slow_per_round>0 (10-array kernel) or the XLA "
+                    "run_rounds for this state")
+        out = _run(state, key, tracked)
+        seen_ok[0] = (out[0] if isinstance(out, tuple) else out).slow
+        return out
+
+    return run
+
+
 def make_run_rounds_pallas(p: SimParams, rounds: int,
                            interpret: bool = False,
                            plan: Optional[CompiledFaultPlan] = None,
                            flight_every: Optional[int] = None,
                            coords: bool = False,
-                           blackbox: bool = False):
+                           blackbox: bool = False,
+                           rounds_per_call: int = 1):
     """Compiled hot loop using the fused Pallas round kernel.
 
     Covers the full protocol model including churn, slow-node
@@ -474,6 +754,20 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     per-pair gate inside the round body, which only the XLA engines
     have.
 
+    `rounds_per_call=R` (R > 1) switches to the MEGAKERNEL: R
+    consecutive protocol periods fused into one kernel launch — the
+    grid grows an inner round dimension, each node block stays resident
+    in VMEM for all R rounds (one HBM read + write per block per CALL),
+    and the population scalars are frozen per call, i.e. the lane
+    engines' ``stale_k == R`` schedule hand-scheduled into Mosaic.
+    Cuts the per-round dispatch overhead that dominates the full-model
+    kernel at sub-0.1ms rounds. Requires rounds % R == 0; fault plans
+    and coords need per-round inputs/outputs and are refused; flight
+    rows and black-box rings land on call boundaries only (stride must
+    be a multiple of R — registry.STALE_EMISSION_RULE with R playing
+    stale_k; the stats columns stay exact call totals via the kernel's
+    accumulated counter lanes).
+
     `blackbox=True` arms the black-box event tracer (sim/blackbox.py):
     the runner takes a `tracked` [K] int32 id array after its other
     arguments and appends the final BlackboxState to its returns. Ring
@@ -487,6 +781,45 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     fault = plan is not None
     with_coords = bool(coords)
     with_bb = bool(blackbox)
+    if rounds_per_call < 1:
+        raise ValueError(
+            f"rounds_per_call must be >= 1: {rounds_per_call}")
+    if rounds_per_call > 1:
+        # the MEGAKERNEL tier: rounds_per_call consecutive periods per
+        # kernel launch (grid = (blocks, rounds), block state resident
+        # in VMEM across the inner rounds, population scalars frozen
+        # per call — the lane engines' stale_k == rounds_per_call
+        # schedule). See _mega_kernel for the structure and limits.
+        if fault:
+            raise ValueError(
+                "the megakernel freezes its inputs for the whole call "
+                "but fault frames vary per round; run fault plans with "
+                "rounds_per_call=1")
+        if with_coords:
+            raise ValueError(
+                "coords updates run between kernel launches on "
+                "per-round probe pairs; the megakernel surfaces state "
+                "only at call boundaries — use rounds_per_call=1")
+        if rounds % rounds_per_call:
+            raise ValueError(
+                f"rounds={rounds} must be a multiple of "
+                f"rounds_per_call={rounds_per_call}")
+        if flight_every is not None and not p.collect_stats:
+            raise ValueError(
+                "flight recording rides the kernel's stats lanes; "
+                "build SimParams with collect_stats=True")
+        if flight_every is not None and flight_every % rounds_per_call:
+            raise ValueError(
+                f"the megakernel surfaces state every "
+                f"rounds_per_call={rounds_per_call} rounds: flight "
+                f"stride {flight_every} must be a multiple of it "
+                "(registry.STALE_EMISSION_RULE, rpc playing stale_k)")
+        if with_bb and flight_every is None:
+            raise ValueError(
+                "the black-box tracer writes rings inside the flight "
+                "recorder's decimation cond; pass flight_every")
+        return _make_run_mega(p, rounds, rounds_per_call, interpret,
+                              flight_every, with_bb)
     if flight_every is not None and not p.collect_stats:
         raise ValueError(
             "flight recording rides the kernel's stats lanes; build "

@@ -101,6 +101,21 @@ class SimParams:
     # per round). Disable for pure-throughput benchmarking.
     collect_stats: bool = True
 
+    # Lane-engine reduction cadence (sim/round._lane_scan, sim/mesh.py):
+    # reduce the fused lane matrix once every stale_k rounds; the
+    # between-reduction rounds consume FROZEN population scalars (the
+    # engine's deliberate 1-round staleness generalized to k), amortizing
+    # the mesh's one-collective-per-round k×. Flight rows and stats
+    # deltas are emitted only on reduction rounds (registry
+    # STALE_EMISSION_RULE: strides must be multiples of stale_k).
+    # STATIC — each k compiles a different super-round structure, so it
+    # can never be a traced sweep leaf (see registry.py near SWEEP_AXES);
+    # the XLA live/stale engines (run_rounds*) and the single-round
+    # Pallas kernel ignore it. The Pallas MEGAkernel
+    # (pallas_round.make_run_rounds_pallas(rounds_per_call=R)) is the
+    # same schedule with R == stale_k, fused into one kernel launch.
+    stale_k: int = 1
+
     # Black-box event tracer defaults (sim/blackbox.py). The tracer is
     # ARMED by passing a tracked-id array to run_rounds_flight /
     # make_run_rounds_pallas — data, not a static flag (one compile per

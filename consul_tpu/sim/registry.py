@@ -152,6 +152,40 @@ LANE = {name: i for i, name in enumerate(REDUCE_LANES)}
 #: LANE_BLOCKS; device counts must divide LANE_BLOCKS.
 LANE_BLOCKS = 64
 
+# ------------------------------------------------ reduction cadence (k)
+#
+# Staleness-k (sim/round._lane_scan / sim/mesh.py): the lane engines
+# reduce the contribution matrix once every ``stale_k`` rounds instead
+# of every round — collectives amortized k× on the mesh. The rounds
+# between reductions consume FROZEN population scalars (the sim's
+# deliberate 1-round staleness generalized to k), and the per-round
+# SimStats event contributions accumulate PER NODE across the window so
+# the reduced stats lanes still carry the exact window totals. The
+# emission-cadence contract below is what keeps the flight recorder's
+# exactness story intact under amortization; it is part of the pinned
+# layout digest so a cadence change forces every consumer to be
+# revisited.
+
+#: flight rows / stats deltas are emitted ONLY on reduction rounds
+#: (the lane vector is stale in between), so a lane-engine flight
+#: stride must be a multiple of stale_k — enforced by
+#: lanes.check_schedule, pinned here for the digest.
+STALE_EMISSION_RULE = "record_every % stale_k == 0"
+
+#: the supported/benched staleness ladder (any k >= 1 compiles — the
+#: window is a Python-unrolled static loop — but these are the values
+#: the conformance/drift tests and bench.py --mesh exercise)
+STALE_KS = (1, 2, 4, 8)
+
+# ``stale_k`` is deliberately NOT in SWEEP_AXES below: each k value
+# compiles a different program structure (the reduction cadence is the
+# scan's super-round shape, not arithmetic a traced leaf can feed), so
+# it can never be a traced grid axis without breaking the sweep
+# engine's one-compile contract. Sweeping k means one compiled runner
+# per k — sim/sweep.run_sweep accepts it as a static per-call knob via
+# SimParams.stale_k, and SweepAxes rejects it with the static-field
+# hint like every other structure-affecting field.
+
 # ---------------------------------------------------------- sweep axes
 #
 # The parameter-sweep engine (sim/sweep.py): SimParams splits into
@@ -227,6 +261,8 @@ def layout_digest() -> str:
                   FLIGHT_COORD_COLUMNS, BLACKBOX_RECORD_FIELDS,
                   BLACKBOX_EVENTS, BLACKBOX_PROBE_EVENTS,
                   REDUCE_LANES, (str(LANE_BLOCKS),),
+                  (STALE_EMISSION_RULE,),
+                  tuple(str(k) for k in STALE_KS),
                   SWEEP_AXES,
                   tuple(f"{d}<-{','.join(deps)}"
                         for d, deps in SWEEP_DERIVED),

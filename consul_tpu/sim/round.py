@@ -621,6 +621,30 @@ def gossip_round_fast(state: SimState, scalars: jnp.ndarray,
 # ----------------------------------------------------- fused lane engine
 
 
+def _lane_contributions(state: SimState, scalars: jnp.ndarray,
+                        key: jax.Array, p: SimParams, shard_offset,
+                        fx: Optional[FaultFrame] = None):
+    """One protocol period in lane mode WITHOUT the reduction: the
+    round's every statistic lands as a per-node contribution row of the
+    returned [N_REDUCE_LANES, L] stack. The staleness-k window and the
+    synchronous per-round reduction are both built from this."""
+    from consul_tpu.sim import lanes as lanes_mod
+    from consul_tpu.sim import registry
+
+    L = state.up.shape[0]
+    sink: dict = {}
+
+    def u01(k):
+        return lanes_mod.u01_global(k, shard_offset, L)
+
+    out, _, _, _, _ = _round_core(state, scalars, key, p, fx=fx,
+                                  lane_sink=sink, u01=u01)
+    zeros = jnp.zeros((L,), jnp.float32)
+    stack = jnp.stack([sink.get(name, zeros)
+                       for name in registry.REDUCE_LANES])
+    return out, stack
+
+
 def gossip_round_lanes(state: SimState, lanes_prev: jnp.ndarray,
                        key: jax.Array, p: SimParams, *,
                        lane_reducer, shard_offset=0,
@@ -642,28 +666,66 @@ def gossip_round_lanes(state: SimState, lanes_prev: jnp.ndarray,
     Returns (state', lanes'): the reduced lane vector feeds the next
     round's scalars AND carries this round's stats deltas and flight
     gauge numerators — consumers read it instead of re-reducing.
-    """
+    This is the stale_k=1 schedule; the scan loops amortize further
+    via `_lane_window`."""
     from consul_tpu.sim import lanes as lanes_mod
-    from consul_tpu.sim import registry
-
-    L = state.up.shape[0]
-    sink: dict = {}
-
-    def u01(k):
-        return lanes_mod.u01_global(k, shard_offset, L)
 
     scalars = lanes_mod.scalars_from_lanes(lanes_prev)
-    out, _, _, _, _ = _round_core(state, scalars, key, p, fx=fx,
-                                  lane_sink=sink, u01=u01)
-    zeros = jnp.zeros((L,), jnp.float32)
-    stack = jnp.stack([sink.get(name, zeros)
-                       for name in registry.REDUCE_LANES])
+    out, stack = _lane_contributions(state, scalars, key, p,
+                                     shard_offset, fx)
     lanes = lane_reducer(stack)
     if p.collect_stats:
         delta = lanes_mod.stats_delta_from_lanes(lanes)
         out = out._replace(stats=jax.tree.map(
             lambda a, b: a + b, out.stats, delta))
     return out, lanes
+
+
+def _lane_window(state: SimState, lanes_prev: jnp.ndarray,
+                 keys_k: jax.Array, cp, p: SimParams, k: int,
+                 with_plan: bool, shard_offset):
+    """A staleness-k window: k protocol periods on FROZEN population
+    scalars (read once from `lanes_prev`), no reduction inside.
+
+    Returns (state', stack, phase) where `stack` is the window's
+    [N_REDUCE_LANES, L] contribution matrix ready for the window-ending
+    reduction: the instantaneous rows (population scalars, flight gauge
+    numerators, lh histogram) are the LAST round's post-state — reduced
+    they become the next window's k-round-stale scalars — while the
+    SimStats counter rows are the PER-NODE SUM over all k rounds, so
+    the reduced stats lanes carry the exact window event totals and the
+    flight recorder's delta exactness survives amortization. `phase` is
+    the last round's active fault phase (the value a row emitted at the
+    window end records). k is STATIC (Python-unrolled): the windows are
+    the scan's super-rounds, which is what keeps the k-1 non-reducing
+    rounds collective-free in compiled HLO rather than cond-guarded.
+
+    k=1 degenerates to exactly the one-round body `gossip_round_lanes`
+    reduces (the stats rows pass through untouched), which is the
+    bitwise stale_k=1 conformance story pinned in tests."""
+    from consul_tpu.sim import lanes as lanes_mod
+
+    scalars = lanes_mod.scalars_from_lanes(lanes_prev)
+    s = state
+    pend = ph = None
+    stack = None
+    for j in range(k):
+        if with_plan:
+            fx = fault_frame(cp, s.round_idx)
+            if j == k - 1:
+                ph = active_phase(cp, s.round_idx)
+        else:
+            fx = None
+        s, stack = _lane_contributions(s, scalars, keys_k[j], p,
+                                       shard_offset, fx)
+        if p.collect_stats:
+            rows = stack[lanes_mod.STATS_SLICE]
+            pend = rows if j == 0 else pend + rows
+    if p.collect_stats:
+        stack = stack.at[lanes_mod.STATS_SLICE].set(pend)
+    if ph is None:
+        ph = jnp.int32(-1)
+    return s, stack, ph
 
 
 def init_lanes(state: SimState, p: SimParams, lane_reducer) -> jnp.ndarray:
@@ -699,73 +761,161 @@ def init_lanes(state: SimState, p: SimParams, lane_reducer) -> jnp.ndarray:
     return lanes.at[0:4].set(a).at[4:8].set(b)
 
 
+def _apply_lane_stats(s: SimState, lv: jnp.ndarray,
+                      p: SimParams) -> SimState:
+    """Fold a reduced lane vector's window stats delta into the carried
+    cumulative SimStats (int32-exact counter lanes)."""
+    from consul_tpu.sim import lanes as lanes_mod
+
+    if not p.collect_stats:
+        return s
+    delta = lanes_mod.stats_delta_from_lanes(lv)
+    return s._replace(stats=jax.tree.map(
+        lambda a, b: a + b, s.stats, delta))
+
+
 def _lane_scan(state: SimState, keys: jax.Array, cp, p: SimParams,
                rounds: int, flight_every: Optional[int],
-               with_plan: bool, lane_reducer, shard_offset):
+               with_plan: bool, lane_reducer, shard_offset, *,
+               overlap: bool = False, unroll: bool = False):
     """The lane engine's scan loop — ONE copy shared by the
     single-device runner (make_run_rounds_lanes) and every mesh shard
     (sim/mesh.shard_body), so the two paths cannot drift: only the
     reducer and the node-index offset differ. Flight rows are built
     from the already-reduced lane vector (flight.row_from_lanes) inside
     the decimation cond — recording costs no extra reduction and, on
-    the mesh, no extra collective."""
-    from consul_tpu.sim import flight
+    the mesh, no extra collective.
 
+    Staleness-k (``p.stale_k``): the scan iterates SUPER-ROUNDS of k
+    protocol periods (`_lane_window`) with ONE reduction at each
+    window's end — on the mesh, collectives amortize k× and the k-1
+    non-reducing rounds are collective-free in the compiled HLO by
+    construction (they are unrolled window steps, not cond branches).
+    A partial final window (rounds % k) runs as an unrolled epilogue
+    ending in its own reduction, so the run's final state, stats, and
+    flight row are always reduction-fresh: a compiled R-round mesh
+    runner executes exactly ceil(R/k) in-loop collectives (+ the two
+    staged init_lanes reductions; audited with ``unroll=True``, which
+    fully unrolls the scan so the HLO text count IS the executed
+    count).
+
+    ``overlap=True`` (double-buffered reductions): the scan carries the
+    in-flight PRE-psum block table (lanes.LaneReducer.partials) and
+    ``fold``s it one window late — window m consumes window m-2's
+    reduction (m-1's psum is on the wire during m's compute), giving
+    XLA's async-collective scheduler a full window of independent
+    compute to hide the all-reduce behind. Costs one extra drain fold
+    after the scan (the final window's stats must land), so the budget
+    is ceil(R/k)+1 in-loop+drain collectives; the first in-loop fold
+    consumes a synthetic table (lanes.seed_table) that yields exactly
+    init_lanes' vector, so windows 1 AND 2 both start from the exact
+    staged init. Flight recording is refused under overlap
+    (lanes.check_schedule) — rows need the synchronous reduction."""
+    from consul_tpu.sim import flight
+    from consul_tpu.sim import lanes as lanes_mod
+
+    k = p.stale_k
     with_flight = flight_every is not None
     lanes0 = init_lanes(state, p, lane_reducer)
     buf0 = (flight.empty_trace(rounds, flight_every) if with_flight
             else jnp.zeros((0,), jnp.float32))
+    n_super, rem = divmod(rounds, k)
+    win_keys = keys[:n_super * k].reshape((n_super, k))
+
+    def record(buf, prev, s2, lv2, ph, i):
+        """Window-end flight hook: `i` is the round-local index of the
+        window's LAST round, so the decimation condition fires exactly
+        on stride-ending reduction rounds (stride % stale_k == 0 is
+        enforced) and on the run's final round."""
+        def rec(cc):
+            b, pv = cc
+            row = flight.row_from_lanes(
+                lv2, p.n, s2.t, ph, flight.stats_delta(s2.stats, pv))
+            return (flight.record_row(b, row, i, flight_every),
+                    s2.stats)
+
+        return flight.maybe_record((buf, prev), i, rounds,
+                                   flight_every, rec)
+
+    if overlap:
+        def body(carry, keys_k):
+            s, lv_ready, table = carry
+            # the fold of the PREVIOUS window's table: no consumer in
+            # this window's compute below — the all-reduce and the k
+            # rounds of local math are independent, which is the whole
+            # overlap claim (asserted structurally via HLO in tier-1)
+            lv_new = lane_reducer.fold(table)
+            s = _apply_lane_stats(s, lv_new, p)
+            s2, stack, _ = _lane_window(s, lv_ready, keys_k, cp, p, k,
+                                        with_plan, shard_offset)
+            return (s2, lv_new, lane_reducer.partials(stack)), None
+
+        (final, _, table), _ = jax.lax.scan(
+            body,
+            (state, lanes0, lanes_mod.seed_table(lanes0, shard_offset)),
+            win_keys, unroll=True if unroll else 1)
+        # drain: the last window's reduction must still land (stats
+        # totals stay exact; the lane vector simply arrives after the
+        # final round instead of one window later)
+        final = _apply_lane_stats(final, lane_reducer.fold(table), p)
+        return final
 
     def body(carry, x):
         s, lv, buf, prev = carry
-        k, i = x
-        fx = fault_frame(cp, s.round_idx) if with_plan else None
-        s2, lv2 = gossip_round_lanes(s, lv, k, p,
-                                     lane_reducer=lane_reducer,
-                                     shard_offset=shard_offset, fx=fx)
+        keys_k, i0 = x
+        s2, stack, ph = _lane_window(s, lv, keys_k, cp, p, k,
+                                     with_plan, shard_offset)
+        lv2 = lane_reducer(stack)
+        s2 = _apply_lane_stats(s2, lv2, p)
         if with_flight:
-            ph = active_phase(cp, s.round_idx) if with_plan \
-                else jnp.int32(-1)
-
-            def rec(cc):
-                b, pv = cc
-                row = flight.row_from_lanes(
-                    lv2, p.n, s2.t, ph,
-                    flight.stats_delta(s2.stats, pv))
-                return (flight.record_row(b, row, i, flight_every),
-                        s2.stats)
-
-            buf, prev = flight.maybe_record((buf, prev), i, rounds,
-                                            flight_every, rec)
+            buf, prev = record(buf, prev, s2, lv2, ph, i0 + (k - 1))
         return (s2, lv2, buf, prev), None
 
-    (final, _, buf, _), _ = jax.lax.scan(
-        body, (state, lanes0, buf0, state.stats),
-        (keys, jnp.arange(rounds, dtype=jnp.int32)))
+    i0s = jnp.arange(n_super, dtype=jnp.int32) * k
+    (final, lv, buf, prev), _ = jax.lax.scan(
+        body, (state, lanes0, buf0, state.stats), (win_keys, i0s),
+        unroll=True if unroll else 1)
+    if rem:
+        # partial final window: unrolled epilogue with its own
+        # reduction, so the run still ends reduction-fresh
+        final, stack, ph = _lane_window(final, lv, keys[n_super * k:],
+                                        cp, p, rem, with_plan,
+                                        shard_offset)
+        lv = lane_reducer(stack)
+        final = _apply_lane_stats(final, lv, p)
+        if with_flight:
+            buf, prev = record(buf, prev, final, lv, ph, rounds - 1)
     return (final, buf) if with_flight else final
 
 
 def make_run_rounds_lanes(p: SimParams, rounds: int,
                           flight_every: Optional[int] = None,
-                          plan: Optional[CompiledFaultPlan] = None):
+                          plan: Optional[CompiledFaultPlan] = None,
+                          overlap: bool = False,
+                          unroll: bool = False):
     """Single-device fused-lane runner: state, key -> state (or
     (state, trace) with `flight_every`). The exact engine the sharded
     mesh wraps — same scan, same shard-invariant PRNG, same block-table
     reduction — so its output is the bitwise reference for
-    multi-device conformance (tests/test_sim_mesh.py). The input state
-    is DONATED: the [N]-row buffers update in place and the passed
-    SimState must not be reused after the call."""
+    multi-device conformance (tests/test_sim_mesh.py), at every
+    ``p.stale_k`` reduction cadence and under the ``overlap``
+    (one-reduction-late) schedule alike. The input state is DONATED:
+    the [N]-row buffers update in place and the passed SimState must
+    not be reused after the call. ``unroll`` fully unrolls the
+    super-round scan — an HLO-audit knob (tests count the per-window
+    reductions in the unrolled text), not a perf setting."""
     from consul_tpu.sim import lanes as lanes_mod
 
     lanes_mod.check_pool(p.n)
-    lanes_mod.check_flight_config(p, flight_every)
+    lanes_mod.check_schedule(p, rounds, flight_every, overlap)
     with_plan = plan is not None
 
     @functools.partial(jax.jit, donate_argnums=0)
     def _run(state: SimState, key: jax.Array, cp):
         keys = jax.random.split(key, rounds)
         return _lane_scan(state, keys, cp, p, rounds, flight_every,
-                          with_plan, lanes_mod.reduce_lanes_single, 0)
+                          with_plan, lanes_mod.reduce_lanes_single, 0,
+                          overlap=overlap, unroll=unroll)
 
     def run(state: SimState, key: jax.Array,
             cp: Optional[CompiledFaultPlan] = None):

@@ -31,7 +31,19 @@ Engines:
   * ``engine="lanes"`` — the fused reduction-lane scan
     (round._lane_scan with lanes.reduce_lanes_single): the [30, N]
     contribution matrix simply gains a leading grid axis, so the whole
-    grid still reduces through the same fixed block table.
+    grid still reduces through the same fixed block table. Honors the
+    staleness-k schedule via ``SimParams.stale_k`` (static, identical
+    across the grid — the reduction cadence is program STRUCTURE, not a
+    sweepable leaf; see sim/registry.py near SWEEP_AXES): item-1's
+    Pareto tooling sweeps k by comparing runs, one compile per k.
+  * ``engine="pallas"`` — the multi-round MEGAKERNEL
+    (pallas_round.make_run_rounds_pallas(rounds_per_call=R)), where
+    shapes allow (pool must divide the kernel's block structure; TPU
+    only). Mosaic kernels neither vmap nor take traced params, so this
+    engine executes the grid as a COMPILED-PER-POINT sequential loop —
+    it exists so k/R schedules can join the same Pareto reports, not
+    for grid throughput; the one-compile contract belongs to the
+    xla/lanes engines.
 
 A FaultPlan compiles ONCE for the grid (phase tensors are shared data);
 sweeping ``fault_gain`` scales its intensity per grid point without
@@ -50,11 +62,12 @@ from consul_tpu.faults import (CompiledFaultPlan, active_phase,
 from consul_tpu.sim import flight
 from consul_tpu.sim import lanes as lanes_mod
 from consul_tpu.sim.params import (GridSpec, SimParams, TracedParams,
-                                   grid_params, point_params)
+                                   _point_param, grid_params,
+                                   point_params)
 from consul_tpu.sim.round import _lane_scan, gossip_round
 from consul_tpu.sim.state import SimState, init_state
 
-ENGINES = ("xla", "lanes")
+ENGINES = ("xla", "lanes", "pallas")
 
 
 def _xla_scan(state: SimState, tp, keys: jax.Array, rounds: int,
@@ -117,8 +130,18 @@ def _make_solo(p: SimParams, rounds: int, flight_every: Optional[int],
     if engine not in ENGINES:
         raise ValueError(f"unknown sweep engine {engine!r} "
                          f"(expected one of {ENGINES})")
+    if engine == "pallas":
+        raise ValueError(
+            "the pallas megakernel engine compiles per point (no "
+            "traced-params solo reference); its conformance oracle is "
+            "pallas_round.make_run_rounds_pallas on the point's "
+            "concrete SimParams")
     if engine == "lanes":
         lanes_mod.check_pool(p.n)
+        # stale_k emission cadence is static and grid-wide — gate it
+        # here so make_run_sweep callers fail as loudly as run_sweep's
+        # per-point validation does
+        lanes_mod.check_flight_config(p, flight_every)
 
         def solo(state, tp, keys, cp, coords):
             if coords is not None:
@@ -144,11 +167,100 @@ def _broadcast_state(p: SimParams, g: int) -> SimState:
         lambda a: jnp.broadcast_to(a, (g,) + a.shape), s0)
 
 
+def _make_pallas_sweep(p: SimParams, rounds: int,
+                       flight_every: Optional[int],
+                       rounds_per_call: int):
+    """The megakernel sweep engine: a compiled-per-point sequential
+    loop over the grid (Mosaic kernels neither vmap nor take traced
+    params — documented in the module notes). Each point rebuilds the
+    concrete SimParams from the traced leaves' values, runs
+    make_run_rounds_pallas(rounds_per_call=...) on the SAME key every
+    other engine would consume, and the per-point results stack into
+    the [G]-leading layout make_run_sweep's callers expect."""
+    from consul_tpu.sim import pallas_round
+
+    # shape gate ("where shapes allow"): the pool must divide the
+    # kernel's block structure. NOTE the block size is NOT purely
+    # static — _model_arrays reads the churn/slow rates, which are
+    # sweepable, so a grid point that zeroes them switches the kernel
+    # from the 10-array to the wider 8-array block. This early gate
+    # catches the base config; the per-point loop below re-checks each
+    # CONCRETE point before running anything, so a mixed grid fails as
+    # one loud ValueError, not an assert mid-sweep.
+    def _check_block(pp: SimParams, where: str) -> None:
+        block = (pallas_round.ROWS_FULL
+                 if pallas_round._model_arrays(pp)
+                 else pallas_round.ROWS_STABLE) * pallas_round.LANES
+        if pp.n % block:
+            raise ValueError(
+                f"the megakernel engine needs n divisible by its "
+                f"{block}-node block ({where}): n={pp.n} — use "
+                "engine='xla'/'lanes' for this pool size")
+
+    _check_block(p, "base params")
+    # surface maker-level refusals (cadence, stats) immediately
+    pallas_round.make_run_rounds_pallas(
+        p, rounds, flight_every=flight_every,
+        rounds_per_call=rounds_per_call)
+
+    def run(tp: TracedParams, key: jax.Array, points=None):
+        """`points` (the concrete SimParams list grid_params returned —
+        run_sweep passes it) keeps the executed configs EXACT; without
+        it each point is rebuilt from the f32 leaf values, which rounds
+        f64-precise axis values by an ulp — fine for the statistical
+        megakernel tier, but the exact list is preferred when in
+        hand."""
+        if not tp.grid_shape:
+            raise ValueError("expected [G]-leaved grid TracedParams "
+                             "(build with grid_params)")
+        g = tp.grid_shape[0]
+        import numpy as np
+
+        # materialize every concrete point and validate ALL shapes
+        # before running point 0 — one loud error, no partial sweeps
+        if points is not None:
+            if len(points) != g:
+                raise ValueError(
+                    f"points list ({len(points)}) does not match the "
+                    f"grid ({g})")
+            pts = list(points)
+        else:
+            pts = []
+            for i in range(g):
+                kw = {}
+                for name, leaf in tp.leaves.items():
+                    if name not in SimParams.__dataclass_fields__:
+                        continue  # derived leaves: with_() recomputes
+                    kw[name] = float(np.asarray(leaf)[i])
+                pts.append(_point_param(tp.static, kw))
+        for i, pp in enumerate(pts):
+            _check_block(pp, f"grid point {i}")
+        states, traces = [], []
+        for i, pp in enumerate(pts):
+            runner = pallas_round.make_run_rounds_pallas(
+                pp, rounds, flight_every=flight_every,
+                rounds_per_call=rounds_per_call)
+            out = runner(init_state(pp.n), key)
+            if flight_every is not None:
+                st, tr = out
+                traces.append(tr)
+            else:
+                st = out
+            states.append(st)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+        trace = jnp.stack(traces) if traces else None
+        return stacked, trace
+
+    run.compiled_per_point = True  # no run.jitted: G Mosaic compiles
+    return run
+
+
 def make_run_sweep(p: SimParams, rounds: int, *,
                    flight_every: Optional[int] = None,
                    plan: Optional[CompiledFaultPlan] = None,
                    engine: str = "xla",
-                   coords: bool = False, topo=None):
+                   coords: bool = False, topo=None,
+                   rounds_per_call: int = 1):
     """Build the batched grid runner: ``run(tp, key) -> (states,
     trace)`` where ``tp`` is a [G]-leaved TracedParams (grid_params),
     ``states`` the [G]-batched final SimState and ``trace`` the
@@ -163,7 +275,26 @@ def make_run_sweep(p: SimParams, rounds: int, *,
 
     ``coords=True`` (XLA engine only) threads the Vivaldi subsystem
     with a shared ground-truth ``topo`` and per-point coordinate state,
-    making ``coord_timeout_mult``/``probe_timeout`` real axes."""
+    making ``coord_timeout_mult``/``probe_timeout`` real axes.
+
+    ``engine="lanes"`` honors ``p.stale_k`` (static, grid-wide — see
+    module notes); ``engine="pallas"`` runs the megakernel at
+    ``rounds_per_call`` as a compiled-per-point loop where shapes
+    allow (no ``run.jitted``; ``run.compiled_per_point`` instead)."""
+    if engine == "pallas":
+        if coords:
+            raise ValueError("coords sweeps run on the XLA engine only")
+        if plan is not None:
+            raise ValueError(
+                "the megakernel freezes its inputs per call; run fault "
+                "plans on engine='xla'/'lanes'")
+        return _make_pallas_sweep(p, rounds, flight_every,
+                                  rounds_per_call)
+    if rounds_per_call != 1:
+        raise ValueError(
+            "rounds_per_call is the megakernel's knob — pass "
+            "engine='pallas' (the xla/lanes engines amortize via "
+            "SimParams.stale_k instead)")
     if flight_every is not None and not p.collect_stats:
         raise ValueError("flight recording rides the SimStats "
                          "counters; build SimParams with "
@@ -254,20 +385,27 @@ def run_sweep(p: SimParams, grid: GridSpec, rounds: int,
               flight_every: Optional[int] = None,
               plan: Optional[CompiledFaultPlan] = None,
               engine: str = "xla",
-              coords: bool = False, topo=None) -> SweepResult:
+              coords: bool = False, topo=None,
+              rounds_per_call: int = 1) -> SweepResult:
     """Convenience wrapper: build the grid (params.grid_params),
     validate per-point lane preconditions, execute the WHOLE grid in
-    one compiled vmapped call, return the batched results."""
+    one compiled vmapped call (one compiled loop per point for the
+    pallas megakernel engine), return the batched results."""
     tp, points = grid_params(p, grid)
     if engine == "lanes" and flight_every is not None:
         for pp in points:
             lanes_mod.check_flight_config(pp, flight_every)
     run = make_run_sweep(p, rounds, flight_every=flight_every,
                          plan=plan, engine=engine, coords=coords,
-                         topo=topo)
+                         topo=topo, rounds_per_call=rounds_per_call)
     if key is None:
         key = jax.random.key(seed)
-    states, trace = run(tp, key)
+    if engine == "pallas":
+        # hand the runner the EXACT concrete point list (see
+        # _make_pallas_sweep.run) instead of the f32 leaf round-trip
+        states, trace = run(tp, key, points=points)
+    else:
+        states, trace = run(tp, key)
     return SweepResult(states=states, trace=trace, tp=tp,
                        points=points, rounds=rounds,
                        flight_every=flight_every)

@@ -59,7 +59,7 @@ def test_layout_registry_digest_pinned():
     metrics.blackbox_report, the Pallas partial-sum lane slices,
     params.grid_params/TracedParams leaf builders, ARCHITECTURE.md
     tables) in the same change."""
-    assert registry.layout_digest() == "8e74b32a10117b0e"
+    assert registry.layout_digest() == "af3368b2e4244681"
 
 
 def test_reduce_lane_layout_pinned():

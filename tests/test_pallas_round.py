@@ -106,3 +106,143 @@ def test_pallas_stats_conformance():
     pl = float(ps.detect_latency_sum) / max(int(ps.true_deaths_declared), 1)
     rl = float(rs.detect_latency_sum) / max(int(rs.true_deaths_declared), 1)
     assert 0.7 < pl / rl < 1.4, (pl, rl)
+
+
+# ------------------------------------------------------- megakernel
+
+
+def test_megakernel_maker_validation():
+    """The rounds_per_call maker gates run on CPU (they fire before
+    any Mosaic lowering): divisibility, per-round-input refusals, and
+    the call-boundary emission cadence."""
+    from consul_tpu.faults import FaultPlan, Phase, compile_plan
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.01, collect_stats=False)
+    pd = SimParams(n=131_072, loss=0.01, tcp_fallback=False,
+                   slow_per_round=0.001)
+    make_run_rounds_pallas(p, 64, rounds_per_call=8)  # builds
+    make_run_rounds_pallas(pd, 64, rounds_per_call=8, flight_every=8)
+    with pytest.raises(ValueError, match=">= 1"):
+        make_run_rounds_pallas(p, 8, rounds_per_call=0)
+    with pytest.raises(ValueError, match="multiple of"):
+        make_run_rounds_pallas(p, 60, rounds_per_call=8)
+    with pytest.raises(ValueError, match="stride"):
+        make_run_rounds_pallas(pd, 64, rounds_per_call=8,
+                               flight_every=4)
+    with pytest.raises(ValueError, match="fault"):
+        cp = compile_plan(FaultPlan(phases=(Phase(rounds=8),)), p.n)
+        make_run_rounds_pallas(p, 8, rounds_per_call=8, plan=cp)
+    with pytest.raises(ValueError, match="rounds_per_call=1"):
+        make_run_rounds_pallas(p, 8, rounds_per_call=8, coords=True)
+
+
+@tpu_only
+def test_megakernel_matches_frozen_scalar_sequence():
+    """The megakernel's exactness oracle: R fused inner rounds must be
+    BITWISE the R-fold sequence of the per-round kernel driven with
+    the SAME frozen scalars and the same per-round seeds — the two
+    kernels share one block body (_block_round), one PRNG stream shape
+    (seed[r] + blk), and one block structure, so fusing the loop into
+    the grid moves no bit."""
+    import consul_tpu.sim.pallas_round as pr
+    from consul_tpu.sim.round import init_scalars
+
+    n = 262_144
+    R = 4
+    p = SimParams(n=n, loss=0.05, tcp_fallback=False,
+                  collect_stats=False)
+    state = init_state(n)
+    scal = init_scalars(state, p)
+    scal = scal.at[7].set(jnp.maximum(scal[7], 1e-9))
+    seeds = jnp.arange(1000, 1000 + R, dtype=jnp.int32)
+    t0 = jnp.zeros((1,), jnp.float32)
+
+    def to2d(x, rows):
+        return x.reshape(rows, pr.LANES)
+
+    mega, rows, _ = pr._build_mega(p, n, R)
+    one, rows1, _ = pr._build_round(p, n)
+    assert rows == rows1
+    args = (to2d(state.up.astype(jnp.int8), rows),
+            to2d(state.status, rows),
+            to2d(state.incarnation, rows),
+            to2d(state.informed, rows),
+            to2d(state.susp_start, rows),
+            to2d(state.susp_deadline, rows),
+            to2d(state.susp_conf, rows),
+            to2d(state.local_health, rows))
+
+    @jax.jit
+    def run_mega(args):
+        return mega(args, scal, seeds, t0)
+
+    @jax.jit
+    def run_seq(args):
+        a = args
+        for r in range(R):
+            t = t0 + jnp.float32(r) * p.probe_interval
+            a, sums, stat_sums = one(a, scal, seeds[r][None], t)
+        return a, sums, stat_sums
+
+    m_args, m_sums, _ = run_mega(args)
+    s_args, s_sums, _ = run_seq(args)
+    for ma, sa in zip(m_args, s_args):
+        assert jnp.array_equal(ma, sa).item()
+    # scalar lanes = the LAST round's sums in both schedules
+    assert jnp.array_equal(m_sums, s_sums).item()
+
+
+@tpu_only
+def test_megakernel_full_model_statistics():
+    """Full model (churn + slow + stats) through the megakernel at
+    rounds_per_call=8: aggregate FD behavior within the same
+    tolerances the per-round kernel is held to, and the accumulated
+    counter lanes carry exact call totals (counters move, latency
+    sums positive)."""
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 262_144
+    p = SimParams(n=n, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.002, rejoin_per_round=0.02,
+                  slow_per_round=0.002, slow_recover_per_round=0.03,
+                  slow_factor=0.05)
+    pal = make_run_rounds_pallas(p, 200, rounds_per_call=8)(
+        init_state(n), jax.random.key(0))
+    ref, _ = run_rounds(init_state(n), jax.random.key(1), p, 200)
+    assert abs(float(pal.up.mean()) - float(ref.up.mean())) < 0.02
+    ps, rs = pal.stats, ref.stats
+    for field in ("suspicions", "refutes", "crashes", "rejoins"):
+        pv, rv = int(getattr(ps, field)), int(getattr(rs, field))
+        assert rv > 0, field
+        assert 0.75 < pv / rv < 1.35, (field, pv, rv)
+    assert int(ps.true_deaths_declared) > 0
+    assert float(ps.detect_latency_sum) > 0
+
+
+@tpu_only
+def test_megakernel_flight_rows_on_call_boundaries():
+    """flight_every == rounds_per_call: one row per call, counter
+    columns exact call totals (sum equals the final cumulative
+    stats)."""
+    import numpy as np
+
+    from consul_tpu.sim import flight
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+    from consul_tpu.sim.state import STATS_FIELDS
+
+    n = 131_072
+    p = SimParams(n=n, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.002, rejoin_per_round=0.02,
+                  slow_per_round=0.001)
+    rounds, rpc = 64, 8
+    final, tr = make_run_rounds_pallas(
+        p, rounds, rounds_per_call=rpc, flight_every=rpc)(
+        init_state(n), jax.random.key(0))
+    cols = flight.trace_columns(tr)
+    assert np.asarray(tr).shape[0] == rounds // rpc
+    for f in STATS_FIELDS:
+        want = float(np.asarray(jax.device_get(getattr(final.stats, f))))
+        assert float(cols[f].sum()) == pytest.approx(want), f
+    assert 0.5 < cols["live_frac"][-1] <= 1.0

@@ -38,21 +38,41 @@ _P_EXACT = SimParams(n=512, loss=0.08, tcp_fallback=False,
                      slow_per_round=0.002)
 
 
-@pytest.mark.parametrize("dc", [1, 2])
-def test_sharded_bitwise_equals_single_device(devices8, dc):
+@pytest.mark.parametrize("dc,stale_k", [(1, 1), (2, 1), (2, 4)])
+def test_sharded_bitwise_equals_single_device(devices8, dc, stale_k):
     """The headline conformance claim: same pool, same key — the
     8-device mesh run and the single-device lane runner produce the
     SAME SimState bit for bit (every per-node array and every stats
     counter), because per-node draws are keyed by global node index and
-    the lane reduction folds a device-count-invariant block table."""
+    the lane reduction folds a device-count-invariant block table. The
+    invariance is engine-level, so it holds at every staleness-k
+    reduction cadence alike (stale_k=4: one psum per 4 rounds)."""
     rounds = 60
-    single = make_run_rounds_lanes(_P_EXACT, rounds)(
-        init_state(_P_EXACT.n), jax.random.key(7))
+    p = _P_EXACT.with_(stale_k=stale_k)
+    single = make_run_rounds_lanes(p, rounds)(
+        init_state(p.n), jax.random.key(7))
     mesh = make_mesh(devices8, dc=dc)
-    sharded = make_sharded_run(_P_EXACT, rounds, mesh)(
-        init_sharded_state(_P_EXACT.n, mesh), jax.random.key(7))
+    sharded = make_sharded_run(p, rounds, mesh)(
+        init_sharded_state(p.n, mesh), jax.random.key(7))
     assert _leaves_equal(single, sharded)
     # and the run actually exercised the detector
+    assert int(single.stats.suspicions) > 0
+    assert int(single.stats.crashes) > 0
+
+
+def test_overlap_bitwise_equals_single_device(devices8):
+    """The double-buffered overlap schedule (fold one window late so
+    the psum rides the wire during the next window's compute) is the
+    same deterministic program on 1 and 8 devices — bitwise, like the
+    synchronous schedule — and still drives the detector."""
+    p = _P_EXACT.with_(stale_k=2)
+    rounds = 60
+    single = make_run_rounds_lanes(p, rounds, overlap=True)(
+        init_state(p.n), jax.random.key(7))
+    mesh = make_mesh(devices8, dc=2)
+    sharded = make_sharded_run(p, rounds, mesh, overlap=True)(
+        init_sharded_state(p.n, mesh), jax.random.key(7))
+    assert _leaves_equal(single, sharded)
     assert int(single.stats.suspicions) > 0
     assert int(single.stats.crashes) > 0
 
@@ -157,6 +177,102 @@ def test_one_collective_per_round_in_hlo(devices8):
         for op in ("all-gather", "all-to-all", "collective-permute",
                    "reduce-scatter"):
             assert not re.search(rf"= \S+ {op}\(", full), op
+
+
+def _assert_no_other_collectives(txt: str) -> None:
+    for op in ("all-gather", "all-to-all", "collective-permute",
+               "reduce-scatter"):
+        assert not re.search(rf"= \S+ {op}\(", txt), op
+
+
+@pytest.mark.parametrize("stale_k", [1, 2, 4, 8])
+def test_stale_k_hlo_collective_budget(devices8, stale_k):
+    """Staleness-k collective BUDGET, asserted from compiled HLO: an
+    R-round mesh runner executes exactly ceil(R/stale_k) lane psums
+    plus the 2 staged init_lanes reductions, and NO other collective op
+    type. Compiled with ``unroll=True`` (the factories' HLO-audit knob:
+    the super-round scan fully unrolls, so the static all-reduce count
+    in the text IS the executed count — a cond-shaped implementation
+    whose non-reducing rounds secretly carried a collective would fail
+    here). Extends the PR 5 one-collective test: stale_k=1 reproduces
+    its 1-per-round budget."""
+    R = 8
+    mesh = make_mesh(devices8, dc=2)
+    p = SimParams(n=512, stale_k=stale_k)
+    run = make_sharded_run(p, R, mesh, unroll=True)
+    txt = run.lower(init_sharded_state(p.n, mesh),
+                    jax.random.key(0)).compile().as_text()
+    assert _count_all_reduces(txt) == R // stale_k + 2, stale_k
+    _assert_no_other_collectives(txt)
+
+
+def test_stale_k_hlo_budget_partial_window(devices8):
+    """Non-divisible round counts: the rounds % k epilogue window ends
+    in its own reduction — ceil(R/k), not floor."""
+    mesh = make_mesh(devices8, dc=2)
+    p = SimParams(n=512, stale_k=4)
+    run = make_sharded_run(p, 6, mesh, unroll=True)
+    txt = run.lower(init_sharded_state(p.n, mesh),
+                    jax.random.key(0)).compile().as_text()
+    assert _count_all_reduces(txt) == 2 + 2  # ceil(6/4)=2 + init
+    _assert_no_other_collectives(txt)
+
+
+def test_overlap_hlo_budget_and_independence(devices8):
+    """Overlap budget: ceil(R/k) in-loop folds + 1 drain + 2 init. The
+    structural independence claim — the fold's psum has NO consumer in
+    the same iteration's window compute — is what lets XLA's
+    async-collective scheduler bracket independent compute between
+    all-reduce-start/done; backends that split collectives (TPU) are
+    asserted on the bracketing, backends that don't (CPU) on the
+    budget alone."""
+    R, k = 8, 2
+    mesh = make_mesh(devices8, dc=2)
+    p = SimParams(n=512, stale_k=k)
+    run = make_sharded_run(p, R, mesh, overlap=True, unroll=True)
+    txt = run.lower(init_sharded_state(p.n, mesh),
+                    jax.random.key(0)).compile().as_text()
+    assert _count_all_reduces(txt) == R // k + 1 + 2
+    _assert_no_other_collectives(txt)
+    if "all-reduce-start" in txt:  # async-splitting backend
+        # every start must be bracketed away from its done by real
+        # compute: the done exists, and more than a couple of HLO
+        # instruction lines separate the pair (a back-to-back
+        # start/done means the scheduler hid nothing)
+        for m in re.finditer(r"= \S+ all-reduce-start\(", txt):
+            tail = txt[m.end():]
+            first_done = tail.find("all-reduce-done")
+            assert first_done > 0, "unmatched all-reduce-start"
+            between = tail[:first_done]
+            assert between.count("\n") > 2, \
+                "all-reduce-start/done not bracketing compute"
+
+
+def test_schedule_validation(devices8):
+    """lanes.check_schedule: one shared gate for both factories."""
+    mesh = make_mesh(devices8, dc=2)
+    p4 = SimParams(n=512, stale_k=4)
+    # flight stride must be a multiple of stale_k (emission cadence)
+    with pytest.raises(ValueError, match="multiple of"):
+        make_run_rounds_lanes(p4, 8, flight_every=2)
+    with pytest.raises(ValueError, match="multiple of"):
+        make_sharded_run(p4, 8, mesh, flight_every=2)
+    # overlap: no flight rows, uniform windows only
+    with pytest.raises(ValueError, match="synchronous"):
+        make_run_rounds_lanes(p4, 8, flight_every=4, overlap=True)
+    with pytest.raises(ValueError, match="uniform"):
+        make_sharded_run(p4, 6, mesh, overlap=True)
+    with pytest.raises(ValueError, match="positive"):
+        make_run_rounds_lanes(SimParams(n=512, stale_k=0), 8)
+    # overlap's init carry is keyed on the GLOBAL scope — per-DC pools
+    # must refuse it rather than feed DC >= 1 zero scalars
+    from consul_tpu.sim.mesh import _make_mesh_run
+
+    with pytest.raises(ValueError, match="global reduction scope"):
+        _make_mesh_run(SimParams(n=512, collect_stats=False), 8, mesh,
+                       ("nodes",), overlap=True)
+    # divisible strides and partial final windows are fine
+    make_run_rounds_lanes(p4, 10, flight_every=8)
 
 
 def test_mesh_runner_donates_state(devices8):

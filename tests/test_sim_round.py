@@ -163,6 +163,126 @@ def test_run_rounds_bit_identical_pinned_seed():
     assert h.hexdigest()[:16] == "e9d5a0ff14b12636"
 
 
+def test_lane_stale_k1_bitwise_pinned_seed():
+    """stale_k=1 is the PR 5 lane engine, BIT FOR BIT — pinned two
+    ways next to the reference engine's seed-digest pin above:
+
+      * against an inline scan of the public per-round body
+        (gossip_round_lanes), i.e. the exact schedule the lane engine
+        ran before staleness-k existed;
+      * against a CPU-lowering output digest, so a refactor of the
+        window/scan structure that moves any bit fails loudly even if
+        the inline reference drifts with it.
+    """
+    import hashlib
+
+    from consul_tpu.sim import lanes as lanes_mod
+    from consul_tpu.sim.round import (gossip_round_lanes, init_lanes,
+                                      make_run_rounds_lanes)
+
+    p = SimParams(n=512, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.01, rejoin_per_round=0.05,
+                  slow_per_round=0.01)
+    rounds = 60
+    final = make_run_rounds_lanes(p, rounds)(init_state(p.n),
+                                             jax.random.key(42))
+
+    @jax.jit
+    def pr5_schedule(state, key):
+        lv = init_lanes(state, p, lanes_mod.reduce_lanes_single)
+
+        def body(carry, k):
+            s, lv = carry
+            s2, lv2 = gossip_round_lanes(
+                s, lv, k, p,
+                lane_reducer=lanes_mod.reduce_lanes_single)
+            return (s2, lv2), None
+
+        (f, _), _ = jax.lax.scan(body, (state, lv),
+                                 jax.random.split(key, rounds))
+        return f
+
+    ref = pr5_schedule(init_state(p.n), jax.random.key(42))
+    for la, lb in zip(jax.tree.leaves(final), jax.tree.leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    if jax.default_backend() != "cpu":
+        return  # the digest below is this image's XLA:CPU lowering
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(final)):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    assert h.hexdigest()[:16] == "6ef488a32c6dee46"
+
+
+def test_stale_k_drift_bounded_under_chaos():
+    """k-round staleness is a MEASURED dynamics trade, not an assumed
+    one: under a chaos-suite fault plan (asymmetric partition class —
+    warmup/fault/recover), detection latency and FP/suspicion volumes
+    at k in {2,4,8} stay within stated tolerances of the k=1 engine.
+    Tolerances are deliberately loose bounds on model drift (frozen
+    scalars lag churn by up to k rounds), not flake margins: at k=8
+    the measured latency delta is already ~20%, so a regression that
+    broke the window accumulation would blow far past them."""
+    from consul_tpu.faults import compile_plan
+    from consul_tpu.sim.round import make_run_rounds_lanes
+    from consul_tpu.sim.scenarios import chaos_plans
+
+    n = 2048
+    p = SimParams(n=n, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.002, rejoin_per_round=0.02)
+    plan = chaos_plans(n)["asym_partition"]
+    rounds = sum(ph.rounds for ph in plan.phases)
+    cp = compile_plan(plan, n)
+
+    def run_k(k):
+        s = make_run_rounds_lanes(p.with_(stale_k=k), rounds, plan=cp)(
+            init_state(n), jax.random.key(11))
+        st = s.stats
+        td = int(st.true_deaths_declared)
+        return {
+            "susp": int(st.suspicions),
+            "fp": int(st.false_positives),
+            "td": td,
+            "lat": float(st.detect_latency_sum) / max(td, 1),
+        }
+
+    base = run_k(1)
+    assert base["td"] > 50 and base["susp"] > 1000  # suite is live
+    for k in (2, 4, 8):
+        got = run_k(k)
+        # detection latency within 25% of k=1
+        assert got["lat"] == pytest.approx(base["lat"], rel=0.25), k
+        # detection/suspicion volumes within 10-20%
+        assert got["td"] == pytest.approx(base["td"], rel=0.20), k
+        assert got["susp"] == pytest.approx(base["susp"], rel=0.10), k
+        # false-positive count within 20% (the partition class pins
+        # most FPs on the cut, which staleness does not move)
+        assert got["fp"] == pytest.approx(base["fp"], rel=0.20), k
+
+
+def test_stale_k_flight_counters_exact():
+    """Amortized emission keeps the exactness contract: every flight
+    row's counter columns are the exact event totals of its window
+    (rows land only on reduction rounds), so the trace's column sums
+    equal the final cumulative stats counter for counter."""
+    from consul_tpu.sim import flight
+    from consul_tpu.sim.round import make_run_rounds_lanes
+    from consul_tpu.sim.state import STATS_FIELDS
+
+    p = SimParams(n=512, loss=0.08, tcp_fallback=False,
+                  fail_per_round=0.005, rejoin_per_round=0.02,
+                  stale_k=4)
+    rounds, stride = 40, 8
+    final, tr = make_run_rounds_lanes(p, rounds, flight_every=stride)(
+        init_state(p.n), jax.random.key(3))
+    cols = flight.trace_columns(tr)
+    for f in STATS_FIELDS:
+        want = float(np.asarray(jax.device_get(getattr(final.stats, f))))
+        assert cols[f].sum() == pytest.approx(want), f
+    assert cols["suspicions"].sum() > 0
+    # gauge rows are reduction-fresh: live_frac sane at the run end
+    assert 0.5 < cols["live_frac"][-1] <= 1.0
+
+
 def test_run_rounds_donates_state():
     """Donation regression: every compiled runner consumes its input
     SimState in place — reusing the donated state raises, and the

@@ -102,7 +102,11 @@ def test_traced_params_refuse_stale_derived():
 
 def test_registry_digest_covers_sweep_layout():
     """The pinned layout digest (tests/test_blackbox.py) must move if
-    the sweep-axes layout moves — same drift guard as the lanes."""
+    the sweep-axes layout moves — same drift guard as the lanes. The
+    emission-cadence constants (staleness-k ladder + the
+    reduction-round emission rule) are covered too: relaxing the
+    cadence contract on one side without auditing the flight/lane
+    consumers must fail here."""
     base = registry.layout_digest()
     orig = registry.SWEEP_AXES
     try:
@@ -110,6 +114,19 @@ def test_registry_digest_covers_sweep_layout():
         assert registry.layout_digest() != base
     finally:
         registry.SWEEP_AXES = orig
+    assert registry.layout_digest() == base
+    orig_ks = registry.STALE_KS
+    try:
+        registry.STALE_KS = orig_ks + (16,)
+        assert registry.layout_digest() != base
+    finally:
+        registry.STALE_KS = orig_ks
+    orig_rule = registry.STALE_EMISSION_RULE
+    try:
+        registry.STALE_EMISSION_RULE = "anything goes"
+        assert registry.layout_digest() != base
+    finally:
+        registry.STALE_EMISSION_RULE = orig_rule
     assert registry.layout_digest() == base
     assert SWEEPABLE_FIELDS == registry.SWEEP_AXES
     # every sweepable/derived name is a real SimParams attribute
@@ -217,6 +234,34 @@ def test_lane_engine_sweep_bitwise():
     assert np.array_equal(np.asarray(tr), np.asarray(trace[2]))
 
 
+def test_lane_engine_sweep_honors_stale_k():
+    """engine='lanes' with SimParams.stale_k: the amortized-reduction
+    schedule vmaps like any other static structure — every grid point
+    is bitwise its solo run AND the static k-round lane runner.
+    stale_k itself can never be a grid axis (static structure; the
+    registry documents the choice) and SweepAxes says so."""
+    p2 = _P.with_(stale_k=2)
+    axes = SweepAxes.of(gossip_nodes=[2, 4], suspicion_mult=[2, 6])
+    tp, points = grid_params(p2, axes)
+    run = sweep.make_run_sweep(p2, _ROUNDS, flight_every=2,
+                               engine="lanes")
+    states, trace = run(tp, _KEY)
+    assert run.jitted._cache_size() == 1
+    solo = sweep.make_run_point(p2, _ROUNDS, flight_every=2,
+                                engine="lanes")
+    for i in range(4):
+        st, tr = solo(point_params(tp, i), _KEY)
+        _assert_bitwise(st, _state_point(states, i), f"k2 state[{i}]")
+        assert np.array_equal(np.asarray(tr), np.asarray(trace[i])), i
+    static_run = make_run_rounds_lanes(points[2], _ROUNDS,
+                                       flight_every=2)
+    st, tr = static_run(init_state(p2.n), _KEY)
+    _assert_bitwise(st, _state_point(states, 2), "static k2 state")
+    assert np.array_equal(np.asarray(tr), np.asarray(trace[2]))
+    with pytest.raises(ValueError, match="STATIC field"):
+        SweepAxes.of(stale_k=[1, 2])
+
+
 def test_fault_gain_scales_shared_plan():
     """ONE compiled FaultPlan, per-grid-point intensity: gain=1
     reproduces the plan's static run bitwise, gain=0 its absence
@@ -315,7 +360,17 @@ def test_sweep_maker_validation():
     with pytest.raises(ValueError, match="XLA engine"):
         sweep.make_run_sweep(_P, 4, engine="lanes", coords=True)
     with pytest.raises(ValueError, match="unknown sweep engine"):
+        sweep.make_run_sweep(_P, 4, engine="bogus")
+    # the megakernel engine gates on the kernel's block structure
+    # ("where shapes allow") and refuses per-round-varying inputs
+    with pytest.raises(ValueError, match="divisible"):
         sweep.make_run_sweep(_P, 4, engine="pallas")
+    with pytest.raises(ValueError, match="XLA engine"):
+        sweep.make_run_sweep(_P, 4, engine="pallas", coords=True)
+    # rounds_per_call is megakernel-only: silently running the plain
+    # schedule would mislabel Pareto rows
+    with pytest.raises(ValueError, match="engine='pallas'"):
+        sweep.make_run_sweep(_P, 4, engine="lanes", rounds_per_call=8)
     with pytest.raises(ValueError, match="topo"):
         sweep.make_run_sweep(_P, 4, coords=True)
     run = sweep.make_run_sweep(_P, 4)
