@@ -491,14 +491,51 @@ def run_sweep_bench(smoke: bool) -> None:
 
 def run_chaos_bench(smoke: bool) -> None:
     """`bench.py --chaos [--smoke]`: the detection-quality chaos suite —
-    every named fault class (sim/scenarios.chaos_plans) through the
-    batched engine with per-phase stats tracing. Prints ONE JSON object
-    keyed by scenario; recorded alongside BENCH_*.json so the perf
-    trajectory carries a robustness axis."""
+    every named fault class (sim/scenarios.chaos_plans), now including
+    the BYZANTINE tier (forged_acks/spurious_suspicion/eclipse/
+    stale_replay), through the batched engine with per-phase stats
+    tracing. Prints ONE JSON object keyed by scenario, and additionally
+    records the byzantine cut — per-attack detection quality with the
+    honest-vs-attack FP split plus the corroboration_k defense sweep
+    (sim/scenarios.run_byzantine_defense) — into BYZ_r01.json next to
+    this script (the MULTICHIP_r* convention)."""
     def runner(n):
-        from consul_tpu.sim.scenarios import run_chaos_suite
+        from consul_tpu.sim.scenarios import (BYZANTINE_CHAOS,
+                                              run_byzantine_defense,
+                                              run_chaos_suite)
 
-        return {"scenarios": run_chaos_suite(n=n)}
+        suite = run_chaos_suite(n=n)
+        defense = run_byzantine_defense(
+            n=min(n, 1024) if smoke else 4096,
+            rounds=100 if smoke else 200)
+        byz = {
+            "metric": "byzantine_detection_quality"
+            + ("_smoke" if smoke else ""),
+            "n": n,
+            "classes": {
+                name: {
+                    "phases": [
+                        {k: ph[k] for k in
+                         ("phase", "suspicions", "attack_suspicions",
+                          "false_positives", "attack_false_positives",
+                          "true_deaths_declared", "crashes",
+                          "mean_detect_latency_s", "fp_per_node_hour",
+                          "attack_fp_per_node_hour",
+                          "honest_fp_per_node_hour")}
+                        for ph in suite[name]["phases"]],
+                    "final_live_fraction":
+                        suite[name]["final_live_fraction"],
+                    "final_wrongly_dead":
+                        suite[name]["final_wrongly_dead"],
+                } for name in BYZANTINE_CHAOS},
+            "corroboration_sweep": defense,
+        }
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BYZ_r01.json")
+        with open(path, "w") as f:
+            f.write(json.dumps(byz, indent=2))
+        return {"scenarios": suite, "byzantine": byz,
+                "byz_json": path}
 
     _scenario_bench("chaos_detection_quality", smoke,
                     1024 if smoke else 65_536, runner)

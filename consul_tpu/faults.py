@@ -62,7 +62,8 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Any, Iterable, NamedTuple, Optional, Sequence, Union
+from typing import (Any, Callable, Iterable, NamedTuple, Optional,
+                    Sequence, Union)
 
 import numpy as np
 
@@ -163,8 +164,125 @@ class ChurnBurst:
     leave: float = 0.0
 
 
+# ------------------------------------------- byzantine primitives
+#
+# The adversarial tier (ROADMAP item 3): every fault above is HONEST —
+# processes crash, links drop — while these model LYING members, the
+# failure mode SWIM's quorumless epidemic design is actually weakest
+# against at scale (*Scalable Byzantine Reliable Broadcast*, PAPERS.md,
+# supplies the sample-based-quorum defense evaluated through
+# SimParams.corroboration_k; *Fair and Efficient Gossip in Hyperledger
+# Fabric* frames the eclipse/starvation fairness metrics). Each
+# primitive names an `adversaries` selector (the lying members) and a
+# `victims` selector (the nodes whose detection/refutation the lie
+# targets); the two may never overlap — an adversary lying about
+# itself is a different machine (refutation handles it already).
+
+
+@dataclass(frozen=True)
+class ForgedAcks:
+    """Adversaries vouch for dead victims: when a probe of a dead
+    victim goes indirect, an adversary-captured relay forges an ack,
+    suppressing the suspicion that would have started.
+
+    ``coverage`` is the probability that any given indirect-probe relay
+    slot for a victim is adversary-controlled (defaults to the
+    adversaries' population fraction — uniform relay sampling; set it
+    explicitly to model targeted relay-position capture). ``rate``
+    scales how often a captured relay actually forges. The defense is
+    ``SimParams.corroboration_k``: k-of-m failure-report corroboration
+    before a failed probe starts a suspicion."""
+
+    adversaries: NodeSpec
+    victims: NodeSpec = None
+    coverage: Optional[float] = None
+    rate: float = 1.0
+
+
+@dataclass(frozen=True)
+class SpuriousSuspicion:
+    """Adversaries broadcast forged suspect/inc-bump rumors about live
+    victims: each adversary injects ``rate`` forged suspicion messages
+    per round, spread over the victim set — driving false positives
+    unless the victims' refutation (incarnation bump) wins the race."""
+
+    adversaries: NodeSpec
+    victims: NodeSpec = None
+    rate: float = 1.0
+
+
+@dataclass(frozen=True)
+class Eclipse:
+    """Adversary-controlled relays selectively drop a victim set's
+    traffic (both directions): the victims starve — their probes go
+    unanswered, their refutations never escape — while the rest of the
+    cluster stays healthy. ``coverage`` is the fraction of a victim's
+    traffic routed through adversary relays (defaults to the
+    adversaries' population fraction); ``drop`` the per-message drop
+    probability on that captured fraction."""
+
+    adversaries: NodeSpec
+    victims: NodeSpec
+    drop: float = 1.0
+    coverage: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class StaleReplay:
+    """Adversaries replay recorded old-incarnation alive rumors about
+    the victims. Incarnation ordering makes the replays unable to
+    resurrect anyone (the defense this attack quantifies), but they
+    still (a) compete with the victims' CURRENT rumors for piggyback
+    budget — death/suspicion rumors about victims disseminate slower —
+    and (b) force live victims into refutation-style incarnation bumps
+    as stale claims about them keep resurfacing. ``rate`` is the
+    per-victim per-round replay pressure in [0, 1)."""
+
+    adversaries: NodeSpec
+    victims: NodeSpec = None
+    rate: float = 0.5
+
+
+BYZANTINE = (ForgedAcks, SpuriousSuspicion, Eclipse, StaleReplay)
+
 Primitive = Union[Partition, NodeLoss, SlowNodes, Flap, Duplicate,
-                  ChurnBurst]
+                  ChurnBurst, ForgedAcks, SpuriousSuspicion, Eclipse,
+                  StaleReplay]
+
+
+def _byz_masks(f, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Resolve a byzantine primitive's (adversaries, victims) masks,
+    refusing overlap — the structured error tests assert by name."""
+    adv = node_mask(f.adversaries, n)
+    vic = node_mask(f.victims, n) if f.victims is not None else ~adv
+    overlap = adv & vic
+    if overlap.any():
+        ids = np.nonzero(overlap)[0]
+        raise ValueError(
+            f"{type(f).__name__}: adversary and victim selectors "
+            f"overlap on {overlap.sum()} node(s) "
+            f"(first ids {ids[:8].tolist()}) — a byzantine primitive's "
+            "adversaries may not be their own victims")
+    if not adv.any():
+        raise ValueError(
+            f"{type(f).__name__}: empty adversary selector")
+    if not vic.any():
+        # a no-op "attack" would read as "the defense worked" in every
+        # report — refuse loudly instead
+        raise ValueError(
+            f"{type(f).__name__}: empty victim selector (a mis-sized "
+            "range? the armed primitive would attack nobody)")
+    return adv, vic
+
+
+def _byz_coverage(f, adv: np.ndarray, n: int) -> float:
+    cov = getattr(f, "coverage", None)
+    if cov is None:
+        return float(adv.sum()) / n
+    if not 0.0 <= cov <= 1.0:
+        raise ValueError(
+            f"{type(f).__name__}: coverage must be in [0, 1]: {cov}")
+    return float(cov)
 
 
 @dataclass(frozen=True)
@@ -236,6 +354,16 @@ class CompiledFaultPlan(NamedTuple):
     flap_release: Any  # [P,N] bool — flapped in prev phase, not in this
     #                    one: revive on the phase's first round (mirrors
     #                    FaultInjector's restore-on-phase-flip)
+    # byzantine tensors (PR 8) — present ONLY when the plan carries a
+    # byzantine primitive, None otherwise, so an honest plan keeps the
+    # exact pre-byzantine pytree structure (and therefore the exact
+    # traced program: the honest-plan bitwise pin). NamedTuple defaults
+    # keep older positional constructors working.
+    forge_ack: Any = None   # [P,N] f32 — P(an indirect-relay slot for a
+    #                         probe of node i forges an ack)
+    spur_susp: Any = None   # [P,N] f32 — forged suspicion arrivals/round
+    replay: Any = None      # [P,N] f32 — stale-replay pressure in [0,1)
+    attacked: Any = None    # [P,N] bool — adversary-attribution mask
 
 
 class FaultFrame(NamedTuple):
@@ -250,6 +378,11 @@ class FaultFrame(NamedTuple):
     crash_p: Any     # [N] f32
     rejoin_p: Any    # [N] f32
     leave_p: Any     # [N] f32
+    # byzantine channels — None on honest plans (see CompiledFaultPlan)
+    forge_ack: Any = None   # [N] f32
+    spur_susp: Any = None   # [N] f32
+    replay: Any = None      # [N] f32
+    attacked: Any = None    # [N] bool
 
 
 def _compose(p: np.ndarray, q) -> np.ndarray:
@@ -267,6 +400,13 @@ def _phase_arrays(phase: Phase, n: int) -> dict[str, np.ndarray]:
     rejoin = np.zeros((n,))
     leave = np.zeros((n,))
     flap = np.zeros((n,), np.int32)
+    # byzantine channels (zero/False when the phase carries no
+    # byzantine primitive; compile_plan ships them only for plans that
+    # have one somewhere)
+    forge = np.zeros((n,))
+    spur = np.zeros((n,))
+    replay = np.zeros((n,))
+    attacked = np.zeros((n,), bool)
     links: list[tuple[np.ndarray, np.ndarray, float]] = []
 
     for f in phase.faults:
@@ -292,6 +432,44 @@ def _phase_arrays(phase: Phase, n: int) -> dict[str, np.ndarray]:
             crash[m] = _compose(crash[m], f.crash)
             rejoin[m] = _compose(rejoin[m], f.rejoin)
             leave[m] = _compose(leave[m], f.leave)
+        elif isinstance(f, ForgedAcks):
+            adv, vic = _byz_masks(f, n)
+            af = _byz_coverage(f, adv, n) * float(f.rate)
+            if not 0.0 <= f.rate <= 1.0:
+                raise ValueError(
+                    f"ForgedAcks: rate must be in [0, 1]: {f.rate}")
+            forge[vic] = _compose(forge[vic], af)
+            attacked |= vic
+        elif isinstance(f, SpuriousSuspicion):
+            adv, vic = _byz_masks(f, n)
+            if f.rate < 0:
+                raise ValueError(
+                    f"SpuriousSuspicion: rate must be >= 0: {f.rate}")
+            # each adversary forges `rate` suspicions per round, spread
+            # uniformly over the victim set: per-victim Poisson rate
+            spur[vic] += adv.sum() * float(f.rate) / max(vic.sum(), 1)
+            attacked |= vic
+        elif isinstance(f, Eclipse):
+            adv, vic = _byz_masks(f, n)
+            cut = _byz_coverage(f, adv, n) * float(f.drop)
+            if not 0.0 <= f.drop <= 1.0:
+                raise ValueError(
+                    f"Eclipse: drop must be in [0, 1]: {f.drop}")
+            # selective drop by adversary relays = per-victim loss on
+            # the captured traffic fraction, BOTH directions — the
+            # existing loss fold then produces the starvation dynamics
+            # (suspw collapses: probes of victims fail; hear_w
+            # collapses: refutations cannot escape)
+            e[vic] = _compose(e[vic], cut)
+            g[vic] = _compose(g[vic], cut)
+            attacked |= vic
+        elif isinstance(f, StaleReplay):
+            adv, vic = _byz_masks(f, n)
+            if not 0.0 <= f.rate < 1.0:
+                raise ValueError(
+                    f"StaleReplay: rate must be in [0, 1): {f.rate}")
+            replay[vic] = _compose(replay[vic], float(f.rate))
+            attacked |= vic
         else:
             raise TypeError(f"unknown fault primitive: {f!r}")
 
@@ -365,7 +543,17 @@ def _phase_arrays(phase: Phase, n: int) -> dict[str, np.ndarray]:
     return dict(psend=psend, precv=precv, suspw=suspw, hear_w=hear_w,
                 mid=np.array(float((psend * precv).mean())),
                 slow_f=slow_f, crash_p=crash, rejoin_p=rejoin,
-                leave_p=leave, flap_half=flap)
+                leave_p=leave, flap_half=flap,
+                forge_ack=forge, spur_susp=spur, replay=replay,
+                attacked=attacked)
+
+
+def plan_is_byzantine(plan: FaultPlan) -> bool:
+    """Does any phase carry a byzantine primitive? Decides whether the
+    compiled plan ships the byzantine tensors (an honest plan keeps the
+    exact pre-byzantine pytree structure — the bitwise pin)."""
+    return any(isinstance(f, BYZANTINE)
+               for ph in plan.phases for f in ph.faults)
 
 
 def compile_plan(plan: FaultPlan, n: int) -> CompiledFaultPlan:
@@ -384,6 +572,7 @@ def compile_plan(plan: FaultPlan, n: int) -> CompiledFaultPlan:
     def stack(key, dtype):
         return jnp.asarray(np.stack([pa[key] for pa in per_phase]), dtype)
 
+    byz = plan_is_byzantine(plan)
     return CompiledFaultPlan(
         starts=jnp.asarray(np.asarray(plan.starts), jnp.int32),
         psend=stack("psend", jnp.float32),
@@ -397,6 +586,13 @@ def compile_plan(plan: FaultPlan, n: int) -> CompiledFaultPlan:
         leave_p=stack("leave_p", jnp.float32),
         flap_half=stack("flap_half", jnp.int32),
         flap_release=stack("flap_release", jnp.bool_),
+        # byzantine tensors only for plans that carry the primitives:
+        # honest plans keep the pre-byzantine pytree structure, so
+        # their traced programs are IDENTICAL to pre-byzantine builds
+        forge_ack=stack("forge_ack", jnp.float32) if byz else None,
+        spur_susp=stack("spur_susp", jnp.float32) if byz else None,
+        replay=stack("replay", jnp.float32) if byz else None,
+        attacked=stack("attacked", jnp.bool_) if byz else None,
     )
 
 
@@ -444,7 +640,15 @@ def scale_frame(fx: FaultFrame, gain) -> FaultFrame:
         suspw=blend(fx.suspw), hear_w=blend(fx.hear_w),
         mid=blend(fx.mid), slow_f=fx.slow_f & (g > 0.0),
         crash_p=g * fx.crash_p, rejoin_p=g * fx.rejoin_p,
-        leave_p=g * fx.leave_p)
+        leave_p=g * fx.leave_p,
+        # byzantine channels are rates/probabilities: scale linearly,
+        # like the churn rates (gain 0 exactly zeroes them — the
+        # honest-run bitwise story); the attribution mask is on/off
+        forge_ack=None if fx.forge_ack is None else g * fx.forge_ack,
+        spur_susp=None if fx.spur_susp is None else g * fx.spur_susp,
+        replay=None if fx.replay is None else g * fx.replay,
+        attacked=None if fx.attacked is None
+        else fx.attacked & (g > 0.0))
 
 
 def fault_frame(cp: CompiledFaultPlan, round_idx) -> FaultFrame:
@@ -479,7 +683,89 @@ def fault_frame(cp: CompiledFaultPlan, round_idx) -> FaultFrame:
     return FaultFrame(
         psend=take(cp.psend), precv=take(cp.precv), suspw=take(cp.suspw),
         hear_w=take(cp.hear_w), mid=take(cp.mid), slow_f=take(cp.slow_f),
-        crash_p=crash_p, rejoin_p=rejoin_p, leave_p=take(cp.leave_p))
+        crash_p=crash_p, rejoin_p=rejoin_p, leave_p=take(cp.leave_p),
+        forge_ack=None if cp.forge_ack is None else take(cp.forge_ack),
+        spur_susp=None if cp.spur_susp is None else take(cp.spur_susp),
+        replay=None if cp.replay is None else take(cp.replay),
+        attacked=None if cp.attacked is None else take(cp.attacked))
+
+
+# ------------------------------------------ byzantine detection gate
+
+
+def _binom_tail_ge(m: int, q, k):
+    """P(Binomial(m, q) >= k), elementwise over `q`. `m` is STATIC
+    (Python-unrolled — it is SimParams.indirect_checks, a compile-time
+    constant in every engine); `q` may be traced, and `k` may be a
+    Python int (static engines, the Mosaic kernel — the skipped terms
+    never enter the graph) or a traced int32 scalar (the sweepable
+    corroboration_k leaf). k <= 0 yields 1 exactly. Pure jnp
+    elementwise math, so it lowers under Mosaic like _pf_arrays."""
+    import math as _math
+
+    import jax.numpy as jnp
+
+    q = jnp.asarray(q, jnp.float32)
+    static_k = isinstance(k, int)
+    total = jnp.zeros_like(q)
+    for j in range(m + 1):
+        if static_k and j < k:
+            continue
+        pmf = _math.comb(m, j) * q ** j * (1.0 - q) ** (m - j)
+        total = total + (pmf if static_k
+                         else jnp.where(j >= k, pmf, 0.0))
+    return jnp.clip(total, 0.0, 1.0)
+
+
+def detection_gate(up, fx: Optional[FaultFrame], p):
+    """Per-node multiplier on the failed-probe (suspicion-start) rate,
+    folding the ForgedAcks byzantine channel and the corroboration_k
+    defense. Both round bodies (sim/round._round_core and the Pallas
+    kernel's _block_round) call THIS function, so the two engines
+    cannot drift on the byzantine model.
+
+    Rules (m = indirect_checks, af = P(an indirect-relay slot forges an
+    ack for this target), k = corroboration_k):
+
+      * k == 0 — memberlist's classic any-ack-cancels rule: a dead
+        target's failed probe survives only if NO sampled relay forges,
+        so the gate is (1-af)^m on down nodes and exactly 1 on live
+        ones (forged acks vouch for the dead; live-target misses pass
+        through unchanged).
+      * k >= 1 — k-of-m corroboration: the suspicion additionally needs
+        at least k definitive failure REPORTS back from the relays.
+        Each relay independently returns one with probability
+        q = p_direct·mid·(1-af): the report's two legs survive the
+        i.i.d. loss floor and any plan-wide link degradation, and the
+        relay is not a forging adversary. The gate is then
+        P(Binom(m, q) >= k) for every target — which is what makes the
+        defense's honest cost (detection latency under loss, FP-rate
+        reduction) measurable alongside its forged-ack resistance.
+
+    With af = 0 and k = 0 the gate is exactly 1.0 (and callers skip it
+    entirely on honest static configs, keeping the pre-byzantine
+    programs bit-identical)."""
+    import jax.numpy as jnp
+
+    m = int(p.indirect_checks)
+    one = jnp.float32(1.0)
+    af = fx.forge_ack if (fx is not None and fx.forge_ack is not None) \
+        else jnp.float32(0.0)
+    legacy = jnp.where(up, one, (one - af) ** m)
+    ck_on = p.sweeps("corroboration_k") or p.corroboration_k > 0
+    if not ck_on:
+        return legacy
+    mid = fx.mid if fx is not None else one
+    q = p.p_direct * mid * (one - af)
+    if not p.sweeps("corroboration_k"):
+        # static k >= 1 (the Mosaic kernel and un-swept XLA configs):
+        # fold the rule selection at trace time
+        return _binom_tail_ge(m, q, int(p.corroboration_k))
+    # traced k: a sweep may place k=0 points next to k>=1 points in
+    # one compiled grid — select the legacy rule per point
+    ck = jnp.asarray(p.corroboration_k, jnp.int32)
+    tail = _binom_tail_ge(m, q, jnp.maximum(ck, 1))
+    return jnp.where(ck >= 1, tail, legacy)
 
 
 # -------------------------------------------- discrete-engine backend
@@ -495,13 +781,26 @@ class FaultInjector:
 
     `addrs[i]` is the transport address of node id i — the same node
     selectors then mean the same nodes on both backends.
+
+    Byzantine primitives need protocol-level identity, not just
+    addresses: `names[i]` is node id i's memberlist name (forged
+    SUSPECT/ALIVE rumors carry names), and `inc_of(name)` answers the
+    incarnation a snooping adversary would currently know for a member
+    (default 0 — a fresh cluster's real incarnation). The injector
+    works on UNencrypted test networks, like every other structured
+    fault here (an encrypted pool already defeats packet forgery at
+    the keyring, which is its own defense claim).
     """
 
     def __init__(self, net, plan: FaultPlan, addrs: Sequence[str],
-                 round_s: float = 1.0) -> None:
+                 round_s: float = 1.0,
+                 names: Optional[Sequence[str]] = None,
+                 inc_of: Optional[Callable[[str], int]] = None) -> None:
         self.net = net
         self.plan = plan
         self.addrs = list(addrs)
+        self.names = list(names) if names is not None else None
+        self.inc_of = inc_of
         self.round_s = float(round_s)
         self._n = len(self.addrs)
         # bumping the generation orphans every scheduled flip closure
@@ -509,6 +808,16 @@ class FaultInjector:
         # whole flap schedule
         self._flap_gen = 0
         self._flapped_down: set = set()
+        # byzantine state: shimmed transport attributes
+        # (addr -> {attr: original}), each shimmed adversary's live
+        # victim scope (addr -> (victim addrs, victim names) — MUTABLE
+        # sets the shim closures read, so a second ForgedAcks sharing
+        # an adversary merges its victims instead of being dropped),
+        # and the forging-loop generation (same orphaning trick as
+        # flaps — a phase flip atomically replaces schedules)
+        self._shimmed: dict[str, dict[str, Any]] = {}
+        self._forge_scope: dict[str, tuple[set, set]] = {}
+        self._byz_gen = 0
 
     # -- plan application ------------------------------------------------
 
@@ -520,6 +829,7 @@ class FaultInjector:
         """Reset the network to exactly phase `idx`'s fault set."""
         net, phase = self.net, self.plan.phases[idx]
         net.clear_faults()
+        self._clear_byzantine()
         self._flap_gen += 1
         flapping_now: set = set()
         for f in phase.faults:
@@ -558,6 +868,24 @@ class FaultInjector:
                 # agent-level churn is the TEST's job (it owns process
                 # lifecycles); the injector only shapes the network
                 continue
+            elif isinstance(f, ForgedAcks):
+                self._start_forged_acks(f)
+            elif isinstance(f, SpuriousSuspicion):
+                self._start_spurious_suspicion(f)
+            elif isinstance(f, Eclipse):
+                adv, vic = _byz_masks(f, self._n)
+                cut = _byz_coverage(f, adv, self._n) * float(f.drop)
+                vic_addrs = {a for a, on in zip(self.addrs, vic) if on}
+                others = {a for a, on in zip(self.addrs, ~(vic | adv))
+                          if on}
+                # the captured relay fraction of the victims' traffic
+                # drops, both directions (adversaries' own links to the
+                # victims stay up: they want to keep eclipsing, not
+                # partition themselves away)
+                net.add_link_fault(vic_addrs, others, cut)
+                net.add_link_fault(others, vic_addrs, cut)
+            elif isinstance(f, StaleReplay):
+                self._start_stale_replay(f)
             else:
                 raise TypeError(f"unknown fault primitive: {f!r}")
         # restore anything a previous phase's flap left crashed
@@ -567,6 +895,231 @@ class FaultInjector:
                 if t is not None:
                     t.closed = False
                 self._flapped_down.discard(addr)
+
+    # -- byzantine behaviors ---------------------------------------------
+
+    def _require_names(self, what: str) -> list[str]:
+        if self.names is None:
+            raise ValueError(
+                f"{what} needs member names: construct FaultInjector "
+                "with names=[member name per node id] — forged rumors "
+                "carry protocol identities, not transport addresses")
+        return self.names
+
+    def _inc(self, name: str) -> int:
+        return int(self.inc_of(name)) if self.inc_of is not None else 0
+
+    def _clear_byzantine(self) -> None:
+        """Un-shim adversary transports and orphan forging loops (the
+        byzantine mirror of clear_faults, run on every phase flip)."""
+        self._byz_gen += 1
+        for addr, originals in self._shimmed.items():
+            t = self.net.transports.get(addr)
+            if t is not None:
+                for attr, orig in originals.items():
+                    setattr(t, attr, orig)
+        self._shimmed.clear()
+        self._forge_scope.clear()
+
+    def _start_forged_acks(self, f: ForgedAcks) -> None:
+        """Shim each adversary's transport BOTH ways: an inbound
+        INDIRECT_PING whose target is a victim is answered with a
+        forged ACK straight back to the origin (the relay vouches for
+        a peer it never probed — memberlist handleIndirectPing,
+        subverted), and outbound SUSPECT/DEAD rumors ABOUT victims are
+        swallowed — a lying member does not tell on the peers it
+        vouches for, even though its own honest SWIM engine keeps
+        suspecting them internally. Non-matching traffic passes through
+        untouched, so the adversary otherwise behaves as a healthy
+        member."""
+        from consul_tpu.gossip import messages as m
+
+        names = self._require_names("ForgedAcks")
+        adv, vic = _byz_masks(f, self._n)
+        new_addrs = {a for a, on in zip(self.addrs, vic) if on}
+        new_names = {nm for nm, on in zip(names, vic) if on}
+
+        def pp_filter(raw, vic_names):
+            """Strip non-ALIVE victim entries out of a push/pull body:
+            the adversary's streams must not leak the suspicion its
+            honest internal engine still runs."""
+            if not vic_names:
+                return raw
+            try:
+                typ, body = m.decode(raw)
+            except Exception:  # noqa: BLE001
+                return raw
+            if typ != m.PUSH_PULL:
+                return raw
+            nodes = body.get("nodes") or []
+            kept = [d for d in nodes
+                    if d.get("name") not in vic_names
+                    or d.get("status") == 1]  # MemberStatus.ALIVE
+            if len(kept) == len(nodes):
+                return raw
+            body = dict(body)
+            body["nodes"] = kept
+            return m.encode(m.PUSH_PULL, body)
+
+        for addr, on in zip(self.addrs, adv):
+            if not on:
+                continue
+            if addr in self._forge_scope:
+                # a second ForgedAcks sharing this adversary: MERGE its
+                # victims into the live scope the installed shims read
+                # — never silently drop a primitive's protection
+                sa, sn = self._forge_scope[addr]
+                sa |= new_addrs
+                sn |= new_names
+                continue
+            t = self.net.transports.get(addr)
+            if t is None or t._on_packet is None:
+                continue
+            vic_addrs, vic_names = set(new_addrs), set(new_names)
+            self._forge_scope[addr] = (vic_addrs, vic_names)
+            orig = t._on_packet
+            orig_send = t.send_packet
+            orig_rpc = t.stream_rpc
+            orig_stream = t._on_stream
+
+            def on_packet(src, raw, _orig=orig, _t=t,
+                          _vic=vic_addrs):
+                parts = (m.split_compound(raw)
+                         if raw[:1] == bytes([m.COMPOUND]) else [raw])
+                passthrough = []
+                for part in parts:
+                    try:
+                        typ, body = m.decode(part)
+                    except Exception:  # noqa: BLE001 — not ours
+                        passthrough.append(part)
+                        continue
+                    if typ == m.INDIRECT_PING \
+                            and body.get("addr") in _vic:
+                        origin = body.get("from_addr") or src
+                        _t.send_packet(origin, m.encode(m.ACK, {
+                            "seq": body["seq"], "payload": {}}))
+                        continue  # the lie replaces the relay probe
+                    passthrough.append(part)
+                if len(passthrough) == len(parts):
+                    return _orig(src, raw)  # untouched packet
+                for part in passthrough:
+                    _orig(src, part)
+
+            def send_packet(dst, raw, _send=orig_send,
+                            _vic=vic_names):
+                parts = (m.split_compound(raw)
+                         if raw[:1] == bytes([m.COMPOUND]) else [raw])
+                kept = []
+                for part in parts:
+                    try:
+                        typ, body = m.decode(part)
+                    except Exception:  # noqa: BLE001
+                        kept.append(part)
+                        continue
+                    if typ in (m.SUSPECT, m.DEAD) \
+                            and body.get("node") in _vic:
+                        continue  # never tell on a protected victim
+                    kept.append(part)
+                if not kept:
+                    return
+                if len(kept) == len(parts):
+                    return _send(dst, raw)
+                _send(dst, kept[0] if len(kept) == 1
+                      else m.make_compound(kept))
+
+            def stream_rpc(dst, payload, timeout=10.0, _orig=orig_rpc,
+                           _vic=vic_names):
+                # filter both stream directions: our push AND what we
+                # answer back ride the same PUSH_PULL body shape
+                return pp_filter(_orig(dst, pp_filter(payload, _vic),
+                                       timeout=timeout), _vic)
+
+            def on_stream(src, req, _orig=orig_stream,
+                          _vic=vic_names):
+                return pp_filter(_orig(src, req), _vic)
+
+            self._shimmed[addr] = {
+                "_on_packet": orig, "send_packet": orig_send,
+                "stream_rpc": orig_rpc, "_on_stream": orig_stream}
+            t._on_packet = on_packet
+            t.send_packet = send_packet
+            t.stream_rpc = stream_rpc
+            if orig_stream is not None:
+                t._on_stream = on_stream
+
+    def _start_spurious_suspicion(self, f: SpuriousSuspicion) -> None:
+        """Each adversary broadcasts `rate` forged SUSPECT rumors per
+        round about random victims, carrying the victim's CURRENT
+        incarnation (a gossip-snooping adversary knows it via inc_of).
+        Live victims must burn a refutation — the incarnation-bump
+        regression test_gossip_swim pins."""
+        from consul_tpu.gossip import messages as m
+
+        names = self._require_names("SpuriousSuspicion")
+        adv, vic = _byz_masks(f, self._n)
+        adv_ids = [i for i, on in enumerate(adv) if on]
+        vic_ids = [i for i, on in enumerate(vic) if on]
+        gen = self._byz_gen
+        rng = self.net.rng
+
+        def forge() -> None:
+            if gen != self._byz_gen:
+                return
+            for i in adv_ids:
+                # fractional rates match the sim backend's per-round
+                # intensity: floor(rate) certain forgeries plus one
+                # Bernoulli(frac) — rate=0.25 really is ~0.25/round
+                whole, frac = divmod(float(f.rate), 1.0)
+                n_forge = int(whole) + (1 if rng.random() < frac else 0)
+                for _ in range(n_forge):
+                    v = vic_ids[rng.randrange(len(vic_ids))]
+                    payload = m.encode(m.SUSPECT, {
+                        "node": names[v], "inc": self._inc(names[v]),
+                        "from": names[i]})
+                    # gossip the lie to a few random members, like a
+                    # real rumor would travel
+                    for dst in rng.sample(
+                            self.addrs, min(3, len(self.addrs))):
+                        if dst != self.addrs[i]:
+                            self.net.deliver_packet(self.addrs[i], dst,
+                                                    payload)
+            self.net.clock.after(self.round_s, forge)
+
+        self.net.clock.after(self.round_s, forge)
+
+    def _start_stale_replay(self, f: StaleReplay) -> None:
+        """Adversaries replay recorded OLD-incarnation alive rumors
+        about the victims every round. Incarnation ordering must make
+        these no-ops (the defense the sim quantifies as dissemination
+        drag) — the agent-level test asserts nothing resurrects."""
+        from consul_tpu.gossip import messages as m
+
+        names = self._require_names("StaleReplay")
+        adv, vic = _byz_masks(f, self._n)
+        adv_ids = [i for i, on in enumerate(adv) if on]
+        vic_ids = [i for i, on in enumerate(vic) if on]
+        gen = self._byz_gen
+        rng = self.net.rng
+
+        def replay() -> None:
+            if gen != self._byz_gen:
+                return
+            for i in adv_ids:
+                v = vic_ids[rng.randrange(len(vic_ids))]
+                # a recorded rumor from the victim's PAST: inc 0, its
+                # original address — strictly stale once the victim
+                # ever refuted or rejoined
+                payload = m.encode(m.ALIVE, {
+                    "node": names[v], "inc": 0,
+                    "addr": self.addrs[v], "tags": {}})
+                for dst in rng.sample(self.addrs,
+                                      min(3, len(self.addrs))):
+                    if dst != self.addrs[i]:
+                        self.net.deliver_packet(self.addrs[i], dst,
+                                                payload)
+            self.net.clock.after(self.round_s, replay)
+
+        self.net.clock.after(self.round_s, replay)
 
     def _start_flap(self, addrs: list[str], half_period: int) -> None:
         gen = self._flap_gen

@@ -21,7 +21,7 @@ from __future__ import annotations
 import enum
 import threading
 import uuid
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 from consul_tpu.raft.storage import RaftStorage
 from consul_tpu.raft.transport import RaftTransport
@@ -100,10 +100,20 @@ class RaftNode:
         self.last_applied = self.store.snapshot_index
         # configuration: voting members (including self), from log or static
         self.peers: set[str] = set(peers or []) | {transport.addr}
+        # snapshot-carried configuration (storage.save_snapshot embeds
+        # it, like hashicorp/raft's Configuration-in-snapshot): a
+        # restarted node recovers the peer set even after the config
+        # log entries compacted away
+        if self.store.snapshot_peers is not None:
+            self.peers = set(self.store.snapshot_peers) \
+                | {transport.addr}
         # non-voting read replicas (server_serf.go:124-129): replicated
         # to, excluded from quorum counting and elections. Subset of
         # peers; maintained by config log entries like peers itself.
         self.nonvoters: set[str] = set()
+        if self.store.snapshot_peers is not None:
+            self.nonvoters = set(self.store.snapshot_nonvoters) \
+                & self.peers
         # chunked-apply reassembly (go-raftchunking): id -> list of
         # pieces; rebuilt deterministically during log replay
         self._chunks: dict[str, list[Optional[bytes]]] = {}
@@ -537,6 +547,57 @@ class RaftNode:
             self.store.append([{"term": self.store.term, "data": b"",
                                 "kind": "noop"}])
         self._replicate_all()
+
+    def recover_configuration(self, voters: Sequence[str],
+                              nonvoters: Sequence[str] = ()) -> None:
+        """Manual disaster recovery (hashicorp/raft RecoverCluster —
+        the peers.json path, agent/consul/server.go:1061-1110): force a
+        NEW membership configuration before start().
+
+        Like the reference, every logged entry is treated as possibly
+        committed: the WAL replays into the FSM, a fresh snapshot is
+        cut at the log's end with the recovered configuration embedded,
+        and the log compacts away — so stale config entries can never
+        replay the lost peers back in and wedge the quorum again. Call
+        only on a STOPPED node (before start()); data divergence is on
+        the operator, exactly as peers.json documents."""
+        with self._lock:
+            if self.role != Role.FOLLOWER \
+                    or self._election_timer is not None:
+                raise RuntimeError(
+                    "recover_configuration must run before start()")
+            voters = list(voters)
+            if not voters:
+                raise ValueError(
+                    "recover_configuration needs at least one voter")
+            # apply everything the WAL holds (RecoverCluster semantics:
+            # any logged entry may have committed somewhere)
+            self.commit_index = max(self.commit_index,
+                                    self.store.last_index())
+            self._apply_committed_locked()
+            self.peers = set(voters) | set(nonvoters) \
+                | {self.transport.addr}
+            # the operator's declaration is authoritative — a survivor
+            # listed as non_voter stays one (it replicates but cannot
+            # vote); peers.json validation upstream already requires
+            # at least one voter in the file
+            self.nonvoters = set(nonvoters) & self.peers
+            if self.snapshot_fn is not None:
+                self._take_snapshot()
+            else:
+                # no FSM snapshotter (bare log nodes): persist the
+                # configuration through the storage layer directly
+                self.store.save_snapshot(
+                    self.store.last_index(),
+                    self.store.term_at(self.store.last_index()),
+                    self.store.snapshot_data or b"",
+                    peers=sorted(self.peers),
+                    nonvoters=sorted(self.nonvoters))
+            self.log.warning(
+                "raft configuration RECOVERED from operator input: "
+                "voters=%s nonvoters=%s (log folded into snapshot at "
+                "index %d)", sorted(self.peers),
+                sorted(self.nonvoters), self.store.snapshot_index)
 
     def add_peer(self, addr: str, voter: bool = True) -> None:
         """Single-server membership change (AddVoter / AddNonvoter).
@@ -1033,7 +1094,13 @@ class RaftNode:
             args = {"term": self.store.term, "leader": self.transport.addr,
                     "last_index": self.store.snapshot_index,
                     "last_term": self.store.snapshot_term,
-                    "data": snap_data}
+                    "data": snap_data,
+                    # ship the membership configuration with the
+                    # snapshot (hashicorp/raft does the same): a
+                    # snapshot-restored follower that reboots must not
+                    # forget the cluster
+                    "peers": sorted(self.peers),
+                    "nonvoters": sorted(self.nonvoters)}
         try:
             reply = self.transport.call(peer, "install_snapshot", args)
         except Exception:  # noqa: BLE001
@@ -1219,7 +1286,9 @@ class RaftNode:
     def _take_snapshot(self) -> None:
         data = self.snapshot_fn()
         term = self.store.term_at(self.last_applied)
-        self.store.save_snapshot(self.last_applied, term, data)
+        self.store.save_snapshot(self.last_applied, term, data,
+                                 peers=sorted(self.peers),
+                                 nonvoters=sorted(self.nonvoters))
         self.metrics.incr("raft.snapshot.taken")
 
     # ------------------------------------------------------------- handlers
@@ -1330,7 +1399,13 @@ class RaftNode:
                 return {"term": self.store.term}
             self.store.log.clear()
             self.store.snapshot_index = 0  # force save to re-point
-            self.store.save_snapshot(idx, sterm, args["data"])
+            self.store.save_snapshot(idx, sterm, args["data"],
+                                     peers=args.get("peers"),
+                                     nonvoters=args.get("nonvoters"))
+            if args.get("peers"):
+                self.peers = set(args["peers"]) | {self.transport.addr}
+                self.nonvoters = set(args.get("nonvoters") or []) \
+                    & self.peers
             if self.restore_fn is not None:
                 self.restore_fn(args["data"])
             # partial chunk groups predate the snapshot: their missing

@@ -34,6 +34,10 @@ class RaftStorage:
         self.snapshot_index = 0   # last log index covered by snapshot
         self.snapshot_term = 0
         self.snapshot_data: Optional[bytes] = None
+        # membership configuration embedded in the snapshot (None on
+        # legacy snapshots that predate it — see save_snapshot)
+        self.snapshot_peers: Optional[list[str]] = None
+        self.snapshot_nonvoters: list[str] = []
         self._wal = None
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
@@ -65,6 +69,10 @@ class RaftStorage:
             self.snapshot_index = snap["index"]
             self.snapshot_term = snap["term"]
             self.snapshot_data = snap["data"]
+            if snap.get("peers") is not None:
+                self.snapshot_peers = list(snap["peers"])
+                self.snapshot_nonvoters = list(snap.get("nonvoters")
+                                               or [])
         if os.path.exists(self._wal_path()):
             with open(self._wal_path(), "rb") as f:
                 buf = f.read()
@@ -226,19 +234,38 @@ class RaftStorage:
                         "from memory")
         return frames, problems
 
-    def save_snapshot(self, index: int, term: int, data: bytes) -> None:
-        """Persist snapshot and compact the log (keep a trailing window)."""
+    def save_snapshot(self, index: int, term: int, data: bytes,
+                      peers: Optional[list[str]] = None,
+                      nonvoters: Optional[list[str]] = None) -> None:
+        """Persist snapshot and compact the log (keep a trailing window).
+
+        `peers`/`nonvoters` carry the membership configuration INTO the
+        snapshot (hashicorp/raft snapshots embed Configuration the same
+        way): a restarted node then recovers its peer set even when
+        every config log entry has been compacted away — without this,
+        a reboot after compaction silently forgets the cluster and
+        waits passively forever."""
         self.snapshot_data = data
         # keep entries after `index` only
         keep_from = index - self.snapshot_index
         self.log = self.log[keep_from:] if keep_from > 0 else self.log
         self.snapshot_index = index
         self.snapshot_term = term
+        if peers is not None:
+            self.snapshot_peers = list(peers)
+            self.snapshot_nonvoters = list(nonvoters or [])
         if self.data_dir:
             tmp = self._snap_path() + ".tmp"
             with open(tmp, "wb") as f:
+                # always persist whatever configuration we hold — a
+                # peers-less caller (e.g. a legacy install_snapshot
+                # without the peers field) must not strip a previously
+                # embedded configuration from disk
                 f.write(msgpack.packb(
-                    {"index": index, "term": term, "data": data}))
+                    {"index": index, "term": term, "data": data,
+                     **({"peers": self.snapshot_peers,
+                         "nonvoters": self.snapshot_nonvoters}
+                        if self.snapshot_peers is not None else {})}))
                 if self.sync:
                     os.fsync(f.fileno())
             os.replace(tmp, self._snap_path())

@@ -335,6 +335,15 @@ class Server:
             heartbeat_interval=config.raft_heartbeat_timeout / 10,
             election_timeout=config.raft_election_timeout,
             snapshot_threshold=config.raft_snapshot_threshold)
+        # peers.json disaster recovery (server.go:1061-1110): an
+        # operator-written recovery file in the raft data dir rewrites
+        # the replicated configuration before anything starts — the
+        # manual escape hatch when a majority of servers is permanently
+        # lost. The file is archived after a successful recovery so a
+        # later reboot cannot silently re-apply it.
+        self._peers_recovered = False
+        if data_dir:
+            self._maybe_recover_peers_json(data_dir)
         self._batcher = _ApplyBatcher(self.raft)
         self._verify_gate = _VerifyGate(self.raft)
 
@@ -572,10 +581,69 @@ class Server:
 
     # ------------------------------------------------------------- lifecycle
 
+    def _maybe_recover_peers_json(self, raft_dir: str) -> None:
+        """Boot-time peers.json recovery. Accepts both formats the
+        reference documents: a bare JSON array of RPC addresses, or an
+        array of {"id"/"address", "non_voter"} objects. On success the
+        file is archived to peers.json.applied (operator forensics;
+        never re-applied) and the raft configuration is force-rewritten
+        via RaftNode.recover_configuration."""
+        import json
+        import os
+
+        path = os.path.join(raft_dir, "peers.json")
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError(
+                f"peers.json recovery: cannot parse {path}: {e}") from e
+        if not isinstance(raw, list) or not raw:
+            raise ValueError(
+                "peers.json recovery: expected a non-empty JSON array "
+                "of addresses or {address, non_voter} objects, got "
+                f"{type(raw).__name__}")
+        voters, nonvoters = [], []
+        for ent in raw:
+            if isinstance(ent, str):
+                addr, nv = ent, False
+            elif isinstance(ent, dict):
+                addr = ent.get("address") or ent.get("Address") \
+                    or ent.get("addr")
+                nv = bool(ent.get("non_voter") or ent.get("NonVoter"))
+            else:
+                raise ValueError(
+                    "peers.json recovery: entries must be address "
+                    f"strings or objects, got {ent!r}")
+            if not addr or ":" not in str(addr):
+                raise ValueError(
+                    "peers.json recovery: entry missing a host:port "
+                    f"address: {ent!r}")
+            (nonvoters if nv else voters).append(str(addr))
+        if not voters:
+            raise ValueError(
+                "peers.json recovery: at least one VOTER required — "
+                "a cluster of non-voters can never elect a leader")
+        self.log.warning(
+            "found peers.json: RECOVERING raft configuration "
+            "(voters=%s nonvoters=%s)", voters, nonvoters)
+        self.raft.recover_configuration(voters, nonvoters)
+        os.replace(path, path + ".applied")
+        self._peers_recovered = True
+
     def start(self) -> None:
         self.rpc.start(self.handle_rpc, self.raft_transport.handle)
         # passive raft start: no self-elections until bootstrapped/contacted
         if self.config.bootstrap:
+            self.raft.start()
+            self._maybe_bootstrapped = True
+        elif self._peers_recovered:
+            # a recovered configuration IS the operator's quorum
+            # declaration: arm elections immediately (a lone survivor
+            # listed as the only voter elects itself and the cluster
+            # is writable again), and never gossip-bootstrap over it
             self.raft.start()
             self._maybe_bootstrapped = True
         self.serf.start()

@@ -143,7 +143,7 @@ def _emit(ring, count, mask, code: int, round_idx, peer, detail):
 
 def record(bb: BlackboxState, *, round_idx, phase, status, incarnation,
            susp_conf, up, probe: Optional[ProbeEvents] = None,
-           indirect_checks: int = 0) -> BlackboxState:
+           indirect_checks: int = 0, attacked=None) -> BlackboxState:
     """Write one recorded round's events into the rings (on-device).
 
     Call ONLY inside the flight recorder's decimation cond — that
@@ -154,7 +154,12 @@ def record(bb: BlackboxState, *, round_idx, phase, status, incarnation,
     including any warm-start offset in state.round_idx — rings from
     chained runs stay on one timeline); `phase` the active FaultPlan
     phase (-1 without a plan). `probe` adds the XLA round body's
-    prober-side lifecycle events.
+    prober-side lifecycle events. `attacked` (an [N] bool mask — the
+    round's FaultFrame.attacked, None on honest runs) arms the
+    adversary-attribution twins: suspect starts and false-positive
+    declarations on attacked agents additionally emit
+    attack_suspect_start / attack_false_positive records — the
+    ring-side counterpart of the attack_* flight columns.
 
     Events land in registry emit order (churn → probe lifecycle →
     suspicion machinery), which keeps one round's records causally
@@ -192,6 +197,11 @@ def record(bb: BlackboxState, *, round_idx, phase, status, incarnation,
         "inc_bump": cur_inc,
         "declare_dead": cur_up.astype(jnp.int32),  # 1 ⇒ false positive
     }
+    if attacked is not None:
+        atk = attacked.reshape(-1)[t]
+        masks["attack_suspect_start"] = masks["suspect_start"] & atk
+        masks["attack_false_positive"] = \
+            masks["declare_dead"] & cur_up & atk
     peers: dict[str, Any] = {}
     if probe is not None:
         masks["probe_ack"] = probe.ack.reshape(-1)[t]

@@ -79,15 +79,24 @@ def state_sharding(mesh: Mesh) -> SimState:
         stats=SimStats(*[rep] * len(SimStats._fields)))
 
 
-def _plan_specs() -> CompiledFaultPlan:
+def _plan_specs(cp: CompiledFaultPlan) -> CompiledFaultPlan:
     """PartitionSpecs for a CompiledFaultPlan: per-node [P, N] phase
-    tensors shard along the node axis; starts/mid stay replicated."""
+    tensors shard along the node axis; starts/mid stay replicated. The
+    byzantine tensors mirror the plan's structure — None for honest
+    plans (whose pytree must match pre-byzantine builds exactly),
+    node-sharded rows when the plan carries adversarial primitives.
+    Same-shape plan swaps per call must keep the same byzantine-ness."""
     row2 = P(None, AXES)
     rep = P()
+    byz = cp is not None and cp.attacked is not None
     return CompiledFaultPlan(
         starts=rep, psend=row2, precv=row2, suspw=row2, hear_w=row2,
         mid=rep, slow_f=row2, crash_p=row2, rejoin_p=row2, leave_p=row2,
-        flap_half=row2, flap_release=row2)
+        flap_half=row2, flap_release=row2,
+        forge_ack=row2 if byz else None,
+        spur_susp=row2 if byz else None,
+        replay=row2 if byz else None,
+        attacked=row2 if byz else None)
 
 
 def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
@@ -153,7 +162,7 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
     if with_plan:
         mapped = shard_map(
             shard_body, mesh=mesh,
-            in_specs=(specs, P(), _plan_specs()),
+            in_specs=(specs, P(), _plan_specs(plan)),
             out_specs=out_specs, check_rep=False)
 
         @functools.partial(jax.jit, donate_argnums=0)
@@ -164,6 +173,7 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
                 cp: Optional[CompiledFaultPlan] = None):
             return run_plan(state, key, cp if cp is not None else plan)
 
+        run.jitted = run_plan  # the jit object (HLO audits: .lower)
         return run
 
     mapped = shard_map(

@@ -77,27 +77,42 @@ class PhaseReport:
     crashes: int
     rejoins: int
     leaves: int
+    attack_suspicions: int
+    attack_false_positives: int
     mean_detect_latency_s: float
     fp_per_node_hour: float
+    attack_fp_per_node_hour: float
+    honest_fp_per_node_hour: float
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
 
 
 _COUNTERS = ("suspicions", "refutes", "false_positives",
-             "true_deaths_declared", "crashes", "rejoins", "leaves")
+             "true_deaths_declared", "crashes", "rejoins", "leaves",
+             "attack_suspicions", "attack_false_positives")
 
 
 def _phase_quality(d: dict, lat: float, phase_s: float, n: int) -> dict:
     """The derived FD-quality rates of one phase window — single copy
     shared by phase_reports and trace_report so the two report forms
-    cannot drift."""
+    cannot drift. The attack/honest FP split rides the adversary-
+    attribution counters (PR 8): attack_false_positives are the wrong
+    declarations landing on nodes inside an armed byzantine
+    primitive's blast radius, honest_* the remainder — zero/total on
+    honest runs."""
     td = d["true_deaths_declared"]
     node_hours = n * phase_s / 3600.0
+    fp = d["false_positives"]
+    afp = d.get("attack_false_positives", 0)
     return {
         "mean_detect_latency_s": lat / td if td else 0.0,
-        "fp_per_node_hour": (d["false_positives"] / node_hours
+        "fp_per_node_hour": (fp / node_hours
                              if node_hours > 0 else 0.0),
+        "attack_fp_per_node_hour": (afp / node_hours
+                                    if node_hours > 0 else 0.0),
+        "honest_fp_per_node_hour": (max(fp - afp, 0) / node_hours
+                                    if node_hours > 0 else 0.0),
     }
 
 
@@ -248,6 +263,15 @@ def blackbox_report(bb, p: SimParams, trace=None,
             "declare_dead": ("false_positives+true_deaths",
                              int(cols["false_positives"].sum()
                                  + cols["true_deaths_declared"].sum())),
+            # adversary-attribution twins (byzantine tier): ring-side
+            # attack events vs the attack_* flight columns — both zero
+            # on honest runs, exactly equal under an armed plan
+            "attack_suspect_start": (
+                "attack_suspicions",
+                int(cols["attack_suspicions"].sum())),
+            "attack_false_positive": (
+                "attack_false_positives",
+                int(cols["attack_false_positives"].sum())),
         }
         out["crosscheck"] = {
             ev: {"ring": totals[ev], "flight": flight_total,
@@ -328,6 +352,7 @@ def sweep_report(result, fp_budget: float = 1.0) -> dict:
     for i, pp in enumerate(result.points):
         tdd = int(np.asarray(st.true_deaths_declared)[i])
         fp = int(np.asarray(st.false_positives)[i])
+        crashes = int(np.asarray(st.crashes)[i])
         node_hours = pp.n * float(sim_s[i]) / 3600.0
         lat = (float(np.asarray(st.detect_latency_sum)[i]) / tdd
                if tdd else None)
@@ -342,6 +367,17 @@ def sweep_report(result, fp_budget: float = 1.0) -> dict:
             "true_deaths_declared": tdd,
             "suspicions": int(np.asarray(st.suspicions)[i]),
             "refutes": int(np.asarray(st.refutes)[i]),
+            # byzantine axes: crashes vs declarations gives the
+            # missed-detection rate a forged-ack defense sweep reads;
+            # the attack_* counters split FP pressure by attribution
+            "crashes": crashes,
+            "missed_detections": max(crashes - tdd, 0),
+            "missed_detection_rate": (max(crashes - tdd, 0) / crashes
+                                      if crashes else 0.0),
+            "attack_suspicions": int(
+                np.asarray(st.attack_suspicions)[i]),
+            "attack_false_positives": int(
+                np.asarray(st.attack_false_positives)[i]),
             "live_fraction": float(np.mean(np.asarray(states.up)[i])),
         })
     front = pareto_front(rows, SWEEP_OBJECTIVES)

@@ -26,7 +26,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
-                               fault_frame, scale_frame)
+                               detection_gate, fault_frame, scale_frame)
 from consul_tpu.sim import registry
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import (N_SCALARS, init_scalars,
@@ -41,8 +41,9 @@ from consul_tpu.sim.state import (ALIVE, DEAD, LEFT, SUSPECT, SimState,
 #: accumulator lane stays f32 (a genuine real-valued sum) while the
 #: others accumulate int32-exact.
 _LAT = registry.STATS_FIELDS.index("detect_latency_sum")
+N_STATS = len(registry.STATS_FIELDS)
 assert registry.REDUCE_LANES[:N_SCALARS] == registry.LANE_SCALARS
-assert registry.REDUCE_LANES[N_SCALARS:N_SCALARS + 8] \
+assert registry.REDUCE_LANES[N_SCALARS:N_SCALARS + N_STATS] \
     == registry.STATS_FIELDS
 
 
@@ -71,6 +72,12 @@ ROWS_FULL, ROWS_STABLE, ROWS_FAULT = 128, 256, 64
 #: psend, precv, suspw, hear_w (f32), slow_f (int8), crash_p,
 #: rejoin_p, leave_p
 N_FAULT_INS = 8
+
+#: extra per-node inputs for BYZANTINE plans (faults.plan_is_byzantine):
+#: forge_ack, spur_susp, replay (f32), attacked (int8) — appended after
+#: the honest fault lanes, so honest plans keep the historical call
+#: signature (and compiled kernel) exactly
+N_BYZ_INS = 4
 
 
 def _u01(shape) -> jnp.ndarray:
@@ -113,7 +120,8 @@ def _write_mask(p: SimParams, fault: bool = False) -> list[bool]:
     return mask
 
 
-def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
+def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
+                 byz: bool = False):
     """One block's protocol period as PURE VALUE math — the single copy
     of the kernel-side round body, shared by the per-round kernel
     (_round_kernel) and the multi-round megakernel (_mega_kernel) so
@@ -124,6 +132,12 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
     (down_time/slow None for 8-array configs), `fxv` the raw
     fault-input arrays or None, `scal` the 9 SMEM scalars
     (N_SCALARS stale sums + the plan's mean link quality or None).
+    `byz` marks a byzantine plan (faults.plan_is_byzantine): `fxv`
+    then carries N_BYZ_INS extra lanes (forge/spur/replay/attacked)
+    and the body applies the SAME adversarial channels as
+    round._round_core — the suspicion gate via the shared
+    faults.detection_gate, spurious-suspicion arrival rates, and the
+    stale-replay dissemination drag + incarnation churn.
     Returns (outs, sums): the updated block values (caller stores per
     its write mask) and the partial-sum list in registry.REDUCE_LANES
     prefix order. All casts happen HERE in the original op order —
@@ -141,6 +155,11 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
     e_pf_fast = pf_fast_sum / jnp.maximum(n_live, 1e-9)
     e_pf_slow = pf_slow_sum / jnp.maximum(n_live, 1e-9)
     scale = lfail_num / lfail_den if p.lifeguard else jnp.float32(1.0)
+    if byz and p.lifeguard:
+        # degenerate-denominator guard (round._round_core twin): a
+        # forged suspicion in a zero-failure cluster must race the
+        # full Lifeguard timer, not a 0/epsilon one
+        scale = jnp.maximum(scale, 1.0)
 
     # load small ints as int32 FIRST: i1 masks inherit the source's
     # tiling, and int8-derived (32,128) masks cannot combine with
@@ -167,8 +186,11 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
     # scan body — the kernel only consumes per-node data)
     if fault:
         (psend, precv, suspw, hear_w,
-         slowf_raw, crash_p, rejoin_p, leave_p) = fxv
+         slowf_raw, crash_p, rejoin_p, leave_p) = fxv[:N_FAULT_INS]
         slow_f = slowf_raw.astype(jnp.int32) != 0
+    if byz:
+        forge_v, spur_v, replay_v, attacked_raw = fxv[N_FAULT_INS:]
+        attacked = attacked_raw.astype(jnp.int32) != 0
 
     # ------------------------------------------------------------- churn
     if _has_churn(p, fault):
@@ -215,7 +237,11 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
         fx = FaultFrame(psend=psend, precv=precv, suspw=suspw,
                         hear_w=hear_w, mid=mid_v, slow_f=slow_f,
                         crash_p=crash_p, rejoin_p=rejoin_p,
-                        leave_p=leave_p)
+                        leave_p=leave_p,
+                        forge_ack=forge_v if byz else None,
+                        spur_susp=spur_v if byz else None,
+                        replay=replay_v if byz else None,
+                        attacked=attacked if byz else None)
     g, pf_fast, pf_slow = _pf_arrays(slow_eff, lh, sbar, n_live / n, p, fx)
     mix_i = (1.0 - sbar) * pf_fast + sbar * pf_slow
     # Mosaic: comparisons against SMEM-sourced scalars produce
@@ -239,7 +265,14 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
     if fault:
         base_fail = 1.0 - (1.0 - base_fail) * suspw
     p_fail_j = jnp.where(up, base_fail, 1.0)
+    if byz or p.corroboration_k > 0:
+        # the SAME shared gate as round._round_core: forged-ack
+        # suppression + k-of-m corroboration (pure jnp elementwise —
+        # lowers under Mosaic like _pf_arrays)
+        p_fail_j = p_fail_j * detection_gate(up, fx, p)
     lam = probe_rate * p_fail_j * eligf
+    if byz:
+        lam = lam + spur_v * eligf
     u_p = _u01(shape)
     term = jnp.exp(-lam)
     c = term
@@ -276,6 +309,10 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
         # the answer back out) — see faults._phase_arrays
         lam_hear = lam_hear * hear_w
         lam_grow = lam_grow * mid_v
+    if byz:
+        # stale-replay dissemination drag (round._round_core twin)
+        lam_hear = lam_hear * (1.0 - replay_v)
+        lam_grow = lam_grow * (1.0 - replay_v)
     p_hear = 1.0 - jnp.exp(-lam_hear)
     u_h = _u01(shape)
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
@@ -288,6 +325,17 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
     new_rumor |= refute
     if p.lifeguard:
         lh = jnp.clip(lh + refute.astype(jnp.int32), 0, p.awareness_max)
+
+    if byz:
+        # stale-replay incarnation churn: live victims re-assert with
+        # bumped incarnations against resurfacing stale claims (the
+        # extra on-chip draw exists only in byzantine-plan kernels —
+        # honest kernels keep their historical PRNG stream)
+        u_rep = _u01(shape)
+        bump = up & (status == ALIVE) & ~new_rumor & (u_rep < replay_v)
+        inc = jnp.where(bump, inc + 1, inc)
+        informed = jnp.where(bump, 1.0 / n, informed)
+        new_rumor |= bump
 
     # declaration
     t_end_v = jnp.zeros(shape, jnp.float32) + t_end
@@ -332,6 +380,11 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t):
             jnp.sum(rejoin.astype(jnp.float32)),
             jnp.sum(leave.astype(jnp.float32)),
         ]
+        if byz:
+            sums += [jnp.sum((starts & attacked).astype(jnp.float32)),
+                     jnp.sum((fp & attacked).astype(jnp.float32))]
+        else:
+            sums += [jnp.float32(0.0), jnp.float32(0.0)]
     outs = (up, status, inc, informed, s_start, s_dead, s_conf, lh,
             down_time, slow)
     return outs, sums
@@ -350,12 +403,13 @@ def _pad_sums(sums, col0: int = 0) -> jnp.ndarray:
 
 
 def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
-                  *refs, p: SimParams, fault: bool = False):
+                  *refs, p: SimParams, fault: bool = False,
+                  byz: bool = False):
     """One block of one protocol period (grid = node blocks)."""
     n_arrays = 10 if _model_arrays(p, fault) else 8
     mask = _write_mask(p, fault)
     n_out = sum(mask)
-    n_fins = N_FAULT_INS if fault else 0
+    n_fins = (N_FAULT_INS + (N_BYZ_INS if byz else 0)) if fault else 0
     ins = refs[:n_arrays]
     fins = refs[n_arrays:n_arrays + n_fins]
     outs = refs[n_arrays + n_fins:n_arrays + n_fins + n_out]
@@ -369,7 +423,8 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
     fxv = tuple(r[:] for r in fins) if fault else None
     scal = tuple(scal_ref[i] for i in range(N_SCALARS)) \
         + ((scal_ref[N_SCALARS],) if fault else (None,))
-    new_vals, sums = _block_round(p, fault, vals, fxv, scal, t_ref[0])
+    new_vals, sums = _block_round(p, fault, vals, fxv, scal, t_ref[0],
+                                  byz=byz)
 
     # write back (only the arrays this config can mutate)
     k = 0
@@ -383,13 +438,14 @@ def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
 
 
 def _build_round(p: SimParams, n: int, interpret: bool = False,
-                 fault: bool = False):
+                 fault: bool = False, byz: bool = False):
     """The per-round pallas_call for an n-node (or n-node SLICE)
     tensor. `p.n` stays the GLOBAL population for the protocol math;
     `n` only sizes the arrays — that split is what lets the sharded
     runner reuse the kernel per mesh shard. With `fault`, the call
     takes N_FAULT_INS extra per-node input blocks (this round's
-    FaultFrame view) after the state arrays."""
+    FaultFrame view) after the state arrays — plus N_BYZ_INS byzantine
+    lanes when `byz` (the plan carries adversarial primitives)."""
     n_arrays = 10 if _model_arrays(p, fault) else 8
     mask = _write_mask(p, fault)
     out_idx = [i for i, w in enumerate(mask) if w]
@@ -399,9 +455,9 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
     assert n % block == 0, f"n={n} must be a multiple of {block}"
     grid = n // block
     rows = n // LANES
-    n_fins = N_FAULT_INS if fault else 0
+    n_fins = (N_FAULT_INS + (N_BYZ_INS if byz else 0)) if fault else 0
 
-    kernel = functools.partial(_round_kernel, p=p, fault=fault)
+    kernel = functools.partial(_round_kernel, p=p, fault=fault, byz=byz)
 
     def row_spec():
         return pl.BlockSpec((rows_per_block, LANES),
@@ -433,7 +489,7 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
             full[i] = state_out[k]
         row0 = partials.reshape(grid, 8, 128)[:, 0, :].sum(axis=0)
         sums = row0[:N_SCALARS]
-        stat_sums = row0[N_SCALARS:N_SCALARS + 8]
+        stat_sums = row0[N_SCALARS:N_SCALARS + N_STATS]
         return tuple(full), sums, stat_sums
 
     return one_round, rows, n_arrays
@@ -563,7 +619,7 @@ def _build_mega(p: SimParams, n: int, rpc: int, interpret: bool = False):
             full[i] = state_out[k]
         row0 = partials.reshape(grid_b, 8, 128)[:, 0, :].sum(axis=0)
         return tuple(full), row0[:N_SCALARS], \
-            row0[N_SCALARS:N_SCALARS + 8]
+            row0[N_SCALARS:N_SCALARS + N_STATS]
 
     return mega_rounds, rows, n_arrays
 
@@ -650,7 +706,8 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
                     flight_every, rec_fn)
             return (args2, partials, t2, (acc_i, acc_lat), rec), None
 
-        acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
+        acc0 = (jnp.zeros((N_STATS,), jnp.int32),
+                jnp.zeros((), jnp.float32))
         if flight_every is not None:
             rec0 = (flight.empty_trace(rounds, flight_every), acc0)
             if with_bb:
@@ -836,7 +893,14 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             "internal, so this combination would silently diverge; use "
             "the XLA engines (run_rounds_coords/run_rounds_flight) for "
             "RTT-aware timeout studies")
-    one_round, rows, n_arrays = _build_round(p, p.n, interpret, fault)
+    # byzantine-ness is STRUCTURAL (the plan either ships the
+    # adversarial tensors or None — faults.compile_plan): honest plans
+    # build the historical kernel, byzantine plans the widened one.
+    # Same-shape plan swaps per call must keep the same byzantine-ness
+    # (the fins signature is compiled in).
+    byz = fault and plan.attacked is not None
+    one_round, rows, n_arrays = _build_round(p, p.n, interpret, fault,
+                                             byz)
 
     # the 1M-row state is DONATED: the packed buffers update in place
     # (peak HBM ~1x state_bytes, not 2x) and the passed-in SimState is
@@ -888,6 +952,11 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                         to2d(fx.slow_f.astype(jnp.int8)),
                         to2d(fx.crash_p), to2d(fx.rejoin_p),
                         to2d(fx.leave_p))
+                if byz:
+                    fins = fins + (to2d(fx.forge_ack),
+                                   to2d(fx.spur_susp),
+                                   to2d(fx.replay),
+                                   to2d(fx.attacked.astype(jnp.int8)))
                 scal_in = jnp.concatenate([scalars, fx.mid[None]])
             else:
                 fins, scal_in = (), scalars
@@ -969,7 +1038,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     bbc = blackbox_mod.record(
                         bbc, round_idx=r, phase=ph,
                         status=args2[1], incarnation=args2[2],
-                        susp_conf=args2[6], up=args2[0])
+                        susp_conf=args2[6], up=args2[0],
+                        attacked=fx.attacked if byz else None)
                     return (buf2, (acc_i, acc_lat), bbc)
 
                 rec = flight.maybe_record(rec, r - state.round_idx,
@@ -977,7 +1047,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             return (args2, partials, t2, (acc_i, acc_lat), rec,
                     coo_c), None
 
-        acc0 = (jnp.zeros((8,), jnp.int32), jnp.zeros((), jnp.float32))
+        acc0 = (jnp.zeros((N_STATS,), jnp.int32),
+                jnp.zeros((), jnp.float32))
         if flight_every is not None:
             rec0 = (flight.empty_trace(rounds, flight_every), acc0)
             if with_bb:

@@ -57,6 +57,19 @@ class SimParams:
     indirect_checks: int = 3
     tcp_fallback: bool = True
 
+    # Byzantine-resilience defense knob (the sample-based-quorum idea of
+    # *Scalable Byzantine Reliable Broadcast*, PAPERS.md, folded into
+    # SWIM's indirect-probe machinery): with corroboration_k = k >= 1 a
+    # failed probe starts a suspicion only once at least k of the
+    # indirect_checks relays return a definitive failure report — a
+    # single forged ack from an adversary-captured relay no longer
+    # cancels detection of a dead victim (faults.ForgedAcks), at the
+    # cost of honest detection latency under packet loss (the report
+    # legs must survive). 0 = memberlist's classic any-ack-cancels
+    # rule. SWEEPABLE (registry.SWEEP_AXES): run_autotune/run_sweep
+    # trade detection latency against forged-ack resistance per point.
+    corroboration_k: int = 0
+
     # Lifeguard suspicion
     suspicion_mult: int = 4
     suspicion_max_timeout_mult: int = 6
@@ -138,6 +151,17 @@ class SimParams:
     # one compiled plan, per-grid-point severity — but the static
     # engines honor a non-default value too (same code path).
     fault_gain: float = 1.0
+
+    def __post_init__(self):
+        # structured validation, asserted by name in tests: the
+        # corroboration quorum can never exceed the relay pool it
+        # samples — a silently-unsatisfiable k would disable detection
+        if not 0 <= self.corroboration_k <= self.indirect_checks:
+            raise ValueError(
+                f"corroboration_k={self.corroboration_k} out of range: "
+                f"must satisfy 0 <= corroboration_k <= indirect_checks "
+                f"(indirect_checks={self.indirect_checks}) — k-of-m "
+                "corroboration samples the indirect-probe relay set")
 
     # --- derived (computed at trace time; all Python floats/ints) ---------
 

@@ -66,6 +66,14 @@ BLACKBOX_EVENTS = (
     "inc_bump",         # incarnation bumped (detail = new incarnation)
     "declare_dead",     # suspicion timer fired (detail = 1 if the
     #                     agent was actually up: a false positive)
+    # adversary-attribution twins (PR 8 byzantine tier): emitted IN
+    # ADDITION to the plain events above when the agent sits inside an
+    # armed byzantine primitive's blast radius this round (the
+    # FaultFrame `attacked` mask) — the ring-side counterpart of the
+    # attack_* flight columns, cross-checked exactly in
+    # metrics.blackbox_report
+    "attack_suspect_start",   # suspect_start on an attacked agent
+    "attack_false_positive",  # a LIVE attacked agent declared dead
 )
 
 #: events only the XLA engines can record: the prober-side probe
@@ -78,10 +86,26 @@ BLACKBOX_PROBE_EVENTS = ("probe_ack", "probe_timeout",
 
 #: SimStats counter lanes (mirror of state.STATS_FIELDS — re-declared
 #: here so the digest covers the flight counter columns without the
-#: registry importing jax; tests assert the two tuples stay identical)
+#: registry importing jax; tests assert the two tuples stay identical).
+#: The attack_* tail (PR 8) splits detector quality by adversary
+#: attribution: a suspicion/false positive counts there too when the
+#: node sat inside an armed byzantine primitive's victim set that round
+#: (FaultFrame.attacked), so metrics.phase_reports can separate the
+#: honest FP rate from the attack-induced one.
 STATS_FIELDS = ("suspicions", "refutes", "false_positives",
                 "true_deaths_declared", "detect_latency_sum",
-                "crashes", "rejoins", "leaves")
+                "crashes", "rejoins", "leaves",
+                "attack_suspicions", "attack_false_positives")
+
+#: every FaultPlan primitive kind, honest then byzantine — the
+#: byzantine tail is PR 8's adversarial tier (lying members, not
+#: crashed ones); pinned in the digest so a new fault kind forces the
+#: chaos suite, the agent-level injector, and the docs' threat-model
+#: table to be revisited together
+FAULT_KINDS = ("Partition", "NodeLoss", "SlowNodes", "Flap",
+               "Duplicate", "ChurnBurst")
+BYZANTINE_FAULT_KINDS = ("ForgedAcks", "SpuriousSuspicion", "Eclipse",
+                         "StaleReplay")
 
 # ------------------------------------------------------ reduction lanes
 #
@@ -218,6 +242,7 @@ SWEEP_AXES = (
     "rejoin_per_round",
     "leave_per_round",
     "fault_gain",
+    "corroboration_k",
 )
 
 #: derived SimParams properties the round bodies read, each with the
@@ -245,7 +270,8 @@ SWEEP_DERIVED = (
 )
 
 #: sweep leaves carried as int32 (clip bounds / counts); all others f32
-SWEEP_INT_LEAVES = ("awareness_max", "confirmation_k")
+SWEEP_INT_LEAVES = ("awareness_max", "confirmation_k",
+                    "corroboration_k")
 
 
 def flight_columns() -> tuple[str, ...]:
@@ -266,7 +292,8 @@ def layout_digest() -> str:
                   SWEEP_AXES,
                   tuple(f"{d}<-{','.join(deps)}"
                         for d, deps in SWEEP_DERIVED),
-                  SWEEP_INT_LEAVES):
+                  SWEEP_INT_LEAVES,
+                  FAULT_KINDS, BYZANTINE_FAULT_KINDS):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
-                               fault_frame, scale_frame)
+                               detection_gate, fault_frame, scale_frame)
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.state import (ALIVE, DEAD, INF, LEFT, SUSPECT, SimState,
                                   SimStats)
@@ -139,6 +139,11 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         # non-default gain: blend the frame toward the no-fault
         # identity BEFORE any channel consumes it
         fx = scale_frame(fx, p.fault_gain)
+    # byzantine channels are STRUCTURAL: an honest plan compiles with
+    # forge/spur/replay/attacked = None (faults.compile_plan), so this
+    # gate is Python-static per compiled program and honest plans trace
+    # the exact pre-byzantine body
+    byz = fx is not None and fx.attacked is not None
     if u01 is None:
         def u01(k):
             return jax.random.uniform(k, (L,))
@@ -341,7 +346,19 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         # independent failure leg (coords_timeout, see above)
         base_fail = 1.0 - (1.0 - base_fail) * (1.0 - late_in)
     p_fail_j = jnp.where(up, base_fail, 1.0)
+    if byz or p.sweeps("corroboration_k") or p.corroboration_k > 0:
+        # forged acks mask dead victims' failed probes; k-of-m
+        # corroboration (SimParams.corroboration_k) gates suspicion
+        # starts on definitive relay failure reports — ONE shared gate
+        # (faults.detection_gate) for both engines. At gain=0 / no
+        # forging / ck=0 the gate is exactly 1.0.
+        p_fail_j = p_fail_j * detection_gate(up, fx, p)
     lam_fail = probe_rate * p_fail_j * eligf
+    if byz:
+        # spurious-suspicion floods: forged suspect/inc-bump rumors
+        # arrive as extra Poisson suspicion events at the victims,
+        # riding the same arrival machinery as honest failed probes
+        lam_fail = lam_fail + fx.spur_susp * eligf
     n_fail = _trunc_poisson(u01(k_pois), lam_fail)
 
     # Mean Lifeguard (LH+1) scale of failing probers — the timer that
@@ -353,6 +370,18 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     else:
         lfail_num, lfail_den = scalars[6], scalars[7]
     scale = lfail_num / lfail_den if p.lifeguard else jnp.float32(1.0)
+    if byz and p.lifeguard:
+        # degenerate-denominator guard, byzantine plans only: in a
+        # pristine zero-loss cluster NO probe ever fails, so the mean
+        # (LH+1)-of-failing-probers ratio is 0/epsilon ~= 0 — and a
+        # FORGED suspicion (which needs no failed probe) would then
+        # declare its victim instantly instead of racing refutation.
+        # The true mean of (LH+1) weights is >= 1 by construction
+        # whenever the denominator is real, so the clamp is exact
+        # identity outside the degenerate case — honest-plan and
+        # gain=0 bitwise pins are untouched (honest plans never take
+        # this branch at all).
+        scale = jnp.maximum(scale, 1.0)
 
     starts = (n_fail > 0) & (status == ALIVE)
     confirms = (n_fail > 0) & (status == SUSPECT)
@@ -367,9 +396,16 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     new_rumor |= starts
     if lane_sink is not None:
         lane_sink["suspicions"] = starts.astype(jnp.float32)
+        if byz:
+            lane_sink["attack_suspicions"] = \
+                (starts & fx.attacked).astype(jnp.float32)
     elif p.collect_stats:
         st = st._replace(
             suspicions=st.suspicions + reduce_sum(starts.astype(jnp.int32)))
+        if byz:
+            st = st._replace(attack_suspicions=st.attack_suspicions
+                             + reduce_sum((starts & fx.attacked)
+                                          .astype(jnp.int32)))
 
     # Existing suspicions: independent confirmations shrink the deadline
     # (ratio update is exact — see module docstring).
@@ -393,6 +429,12 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         # suspicion, and a node whose egress is cut (one-way partition)
         # hears everything, answers nothing, and still gets declared
         lam_hear = lam_hear * fx.hear_w
+    if byz:
+        # stale-replay interference: replayed old-incarnation rumors
+        # about a victim compete with its CURRENT rumor for piggyback
+        # budget — both the suspicion reaching the victim and (below)
+        # the rumor's epidemic growth slow by the replay pressure
+        lam_hear = lam_hear * (1.0 - fx.replay)
     p_hear = 1.0 - jnp.exp(-lam_hear)
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
     refute = wrongly & (u01(k_hear) < p_hear)
@@ -411,6 +453,21 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         st = st._replace(
             refutes=st.refutes + reduce_sum(refute.astype(jnp.int32)))
 
+    if byz:
+        # stale-replay incarnation churn: a live victim keeps hearing
+        # replayed stale claims about itself and re-asserts with a
+        # bumped-incarnation alive rumor (a refutation-shaped bump
+        # without a real suspicion — visible as inc_bump storms in the
+        # black-box rings and the flight inc_bumps gauge). The key is
+        # folded off the round key (like the coords subsystem), so the
+        # base PRNG stream is untouched and a zero replay tensor
+        # reproduces the honest dynamics bit for bit.
+        u_rep = u01(jax.random.fold_in(key, 0xB12A))
+        bump = up & (status == ALIVE) & ~new_rumor & (u_rep < fx.replay)
+        inc = jnp.where(bump, inc + 1, inc)
+        informed = jnp.where(bump, 1.0 / n, informed)
+        new_rumor |= bump
+
     # ------------------------------------------------------ dead declaration
     declare = (status == SUSPECT) & (t_end >= s_dead)
     status = jnp.where(declare, jnp.int8(DEAD), status)
@@ -423,6 +480,9 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         lane_sink["true_deaths_declared"] = tp.astype(jnp.float32)
         lane_sink["detect_latency_sum"] = jnp.where(
             tp, t_end - down_time, 0.0)
+        if byz:
+            lane_sink["attack_false_positives"] = \
+                (fp & fx.attacked).astype(jnp.float32)
     elif p.collect_stats:
         fp, tp = declare & up, declare & ~up
         st = st._replace(
@@ -432,6 +492,10 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
             + reduce_sum(tp.astype(jnp.int32)),
             detect_latency_sum=st.detect_latency_sum
             + reduce_sum(jnp.where(tp, t_end - down_time, 0.0)))
+        if byz:
+            st = st._replace(
+                attack_false_positives=st.attack_false_positives
+                + reduce_sum((fp & fx.attacked).astype(jnp.int32)))
 
     # ------------------------------------------------- epidemic dissemination
     # Mean-field piggyback gossip: each of the ~informed·N carriers sends
@@ -441,6 +505,11 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     lam_g = p.fanout_ticks * informed * p.one_minus_loss
     if fx is not None:
         lam_g = lam_g * fx.mid  # population-mean link degradation
+    if byz:
+        # replayed stale rumors about a victim crowd out its current
+        # rumor's piggyback slots — death/suspicion news about replay
+        # victims spreads slower (the attack's dissemination drag)
+        lam_g = lam_g * (1.0 - fx.replay)
     informed = jnp.where(
         grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_g)), informed)
 
@@ -1101,6 +1170,14 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
         fx = fault_frame(plan, s.round_idx) if plan is not None else None
         ph = active_phase(plan, s.round_idx) if plan is not None \
             else jnp.int32(-1)
+        # adversary-attribution mask for the black-box rings, disarmed
+        # exactly like the in-core stats when a static fault_gain
+        # blends the plan away (keeps ring↔flight cross-checks exact)
+        atk = None
+        if fx is not None and fx.attacked is not None:
+            atk = fx.attacked
+            if p.fault_gain != 1.0:
+                atk = atk & (jnp.float32(p.fault_gain) > 0.0)
         ev = None
         if coords is None:
             if with_bb:
@@ -1142,7 +1219,7 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
                     bbc, round_idx=s.round_idx, phase=ph,
                     status=s2.status, incarnation=s2.incarnation,
                     susp_conf=s2.susp_conf, up=s2.up, probe=ev,
-                    indirect_checks=p.indirect_checks)
+                    indirect_checks=p.indirect_checks, attacked=atk)
             return (flight.record_row(b, row, i, record_every),
                     s2.stats, bbc)
 

@@ -23,8 +23,10 @@ import jax
 import jax.numpy as jnp
 
 from consul_tpu.config import GossipConfig
-from consul_tpu.faults import (ChurnBurst, FaultPlan, Flap, NodeLoss,
-                               Partition, Phase, SlowNodes, compile_plan)
+from consul_tpu.faults import (ChurnBurst, Eclipse, FaultPlan, Flap,
+                               ForgedAcks, NodeLoss, Partition, Phase,
+                               SlowNodes, SpuriousSuspicion, StaleReplay,
+                               compile_plan)
 from consul_tpu.sim.flight import stats_from_trace
 from consul_tpu.sim.metrics import fd_report, phase_reports, trace_report
 from consul_tpu.sim.params import SimParams, baseline_configs
@@ -141,15 +143,27 @@ CHAOS_RECOVER_ROUNDS = 50
 
 
 def chaos_plans(n: int) -> dict[str, FaultPlan]:
-    """The named chaos classes, sized for an n-node pool."""
-    m = max(1, n // 16)
+    """The named chaos classes, sized for an n-node pool.
 
-    def tri(name: str, *faults) -> FaultPlan:
+    The honest classes share one quiescent-recovery plan shape; the
+    BYZANTINE classes (forged_acks/spurious_suspicion/eclipse/
+    stale_replay — the adversarial tier) carry the extra adversarial
+    tensors, so they compile separately (faults.compile_plan ships the
+    byzantine leaves only for plans that need them), and the classes
+    that kill victims recover them with a rejoin burst so every class
+    still ends healed."""
+    m = max(1, n // 16)
+    # adversaries: the top 1/8th of the pool — disjoint by construction
+    # from every victim range below (victims live at the bottom)
+    adv = (n - max(1, n // 8), n)
+
+    def tri(name: str, *faults, recover=()) -> FaultPlan:
         return FaultPlan(phases=(
             Phase(rounds=CHAOS_WARMUP_ROUNDS, name="warmup"),
             Phase(rounds=CHAOS_FAULT_ROUNDS, faults=tuple(faults),
                   name=name),
-            Phase(rounds=CHAOS_RECOVER_ROUNDS, name="recover"),
+            Phase(rounds=CHAOS_RECOVER_ROUNDS, faults=tuple(recover),
+                  name="recover"),
         ))
 
     return {
@@ -177,7 +191,52 @@ def chaos_plans(n: int) -> dict[str, FaultPlan]:
         "churn_burst": tri(
             "churn_burst",
             ChurnBurst(nodes=(0, n // 4), crash=0.02, rejoin=0.25)),
+        # ---- byzantine tier: lying members, not broken networks ----
+        # adversaries vouch for dead peers: victims crash but every
+        # indirect probe of them hits a forging relay — detection is
+        # SUPPRESSED (the class whose failure the report quantifies;
+        # SimParams.corroboration_k is the defense, see
+        # run_byzantine_defense). Recovery rejoins the hidden dead.
+        "forged_acks": tri(
+            "forged_acks",
+            ChurnBurst(nodes=(0, m), crash=0.05),
+            ForgedAcks(adversaries=adv, victims=(0, m), coverage=0.9),
+            recover=(ChurnBurst(nodes=(0, m), rejoin=0.5),)),
+        # forged suspect/inc-bump broadcasts about LIVE victims. The
+        # measured result: Lifeguard's refutation race WINS against
+        # pure rumor forgery (refutes ~= suspicions, FP 0) — the
+        # attack's real cost is refutation LOAD: a suspicion storm and
+        # the incarnation churn it forces, all adversary-attributed via
+        # the attack_* columns. FPs appear only when the victims are
+        # also muted, which is the eclipse class (the dangerous combo
+        # is forge+eclipse, not forgery alone — compose them to see).
+        "spurious_suspicion": tri(
+            "spurious_suspicion",
+            SpuriousSuspicion(adversaries=adv, victims=(0, 2 * m),
+                              rate=2.0)),
+        # adversary relays selectively drop the victims' traffic: the
+        # victims starve — probes of them fail AND their refutations
+        # never escape, so the quorum wrongly declares them (the
+        # eclipse timeline: probe_timeout → suspect_start → declare)
+        "eclipse": tri(
+            "eclipse",
+            Eclipse(adversaries=adv, victims=(0, m), coverage=0.95,
+                    drop=1.0)),
+        # replayed old-incarnation alive rumors: cannot resurrect
+        # anyone (incarnation ordering — the defense this class
+        # quantifies) but drag rumor dissemination about the victims
+        # and force live victims into incarnation-bump churn
+        "stale_replay": tri(
+            "stale_replay",
+            ChurnBurst(nodes=(0, m), crash=0.05),
+            StaleReplay(adversaries=adv, victims=(0, 2 * m), rate=0.4),
+            recover=(ChurnBurst(nodes=(0, m), rejoin=0.5),)),
     }
+
+
+#: the byzantine chaos classes (subset of chaos_plans keys)
+BYZANTINE_CHAOS = ("forged_acks", "spurious_suspicion", "eclipse",
+                   "stale_replay")
 
 
 def run_chaos(name: str, n: int = 4096, seed: int = 0,
@@ -227,10 +286,101 @@ def run_chaos(name: str, n: int = 4096, seed: int = 0,
 
 
 def run_chaos_suite(n: int = 4096, seed: int = 0) -> dict[str, Any]:
-    """Every chaos class once. All plans share one phase-count shape,
-    so the whole suite costs a single run_rounds_stats compilation."""
+    """Every chaos class once. The honest plans share one phase-count
+    shape (one compilation); the byzantine classes carry the extra
+    adversarial tensors, so they share a second."""
     return {name: run_chaos(name, n=n, seed=seed)
             for name in chaos_plans(n)}
+
+
+# ------------------------------------------------- byzantine defense
+#
+# The corroboration_k defense sweep (the acceptance number of the
+# byzantine tier): ONE compiled vmapped sweep runs every k against a
+# ForgedAcks attack hiding a crashing victim set, and a second honest
+# sweep prices the defense — missed-detection rate under attack vs
+# honest detection latency, per k. Recorded by `bench.py --chaos`
+# into BYZ_r01.json and quoted in the README.
+
+BYZ_DEFENSE_KS = (0, 1, 2, 3)
+
+
+def run_byzantine_defense(n: int = 1024, rounds: int = 120,
+                          seed: int = 0,
+                          ks=BYZ_DEFENSE_KS) -> dict[str, Any]:
+    """Sweep SimParams.corroboration_k against a ForgedAcks attack.
+
+    Setup: baseline churn kills nodes everywhere (honest detection
+    latency is measurable), and an armed plan adds adversaries forging
+    acks for a quarter-pool victim set at 0.9 relay coverage — at
+    k = 0 (memberlist's any-ack-cancels rule) the victims' deaths go
+    undetected. Two `run_sweep` calls over the same k axis — attack
+    plan armed vs honest — yield, per k:
+
+      * attack missed-detection rate (1 - declared/crashed),
+      * honest mean detection latency (the defense's price),
+      * FP rates with the attack/honest attribution split.
+
+    The report names the best k (lowest attack missed rate, ties to
+    the lower k), its defense factor vs k=0, and the honest latency
+    ratio it costs."""
+    from consul_tpu.sim.metrics import sweep_report
+    from consul_tpu.sim.params import SweepAxes
+    from consul_tpu.sim.sweep import run_sweep
+
+    p = SimParams.from_gossip_config(
+        GossipConfig.lan(), n=n, tcp_fallback=False, loss=0.05,
+        fail_per_round=0.003)
+    vic = (0, n // 4)
+    adv = (n - max(1, n // 8), n)
+    plan = FaultPlan(phases=(
+        Phase(rounds=rounds,
+              faults=(ForgedAcks(adversaries=adv, victims=vic,
+                                 coverage=0.9),),
+              name="forged"),))
+    cp = compile_plan(plan, n)
+    axes = SweepAxes.of(corroboration_k=[float(k) for k in ks])
+    attack = sweep_report(run_sweep(p, axes, rounds, seed=seed,
+                                    plan=cp))
+    honest = sweep_report(run_sweep(p, axes, rounds, seed=seed))
+
+    def col(rep, key):
+        return [r[key] for r in rep["points"]]
+
+    a_missed = col(attack, "missed_detection_rate")
+    h_missed = col(honest, "missed_detection_rate")
+    h_lat = col(honest, "mean_detect_latency_s")
+    # the attack-INDUCED missed rate: the honest run misses only the
+    # recently-crashed tail (suspicions still pending at run end) —
+    # subtracting it isolates what the forging actually hides
+    induced = [max(a - h, 0.0) for a, h in zip(a_missed, h_missed)]
+    best = min(range(len(ks)), key=lambda i: (induced[i], ks[i]))
+    base = induced[0] if induced[0] > 0 else 1.0
+    return {
+        "scenario": "byzantine_defense",
+        "n": n, "rounds": rounds,
+        "ks": list(ks),
+        "victims": list(vic), "adversaries": list(adv),
+        "coverage": 0.9,
+        "attack_missed_detection_rate": a_missed,
+        "attack_induced_missed_rate": induced,
+        "attack_mean_detect_latency_s": col(
+            attack, "mean_detect_latency_s"),
+        "attack_fp_per_node_hour": col(attack, "fp_per_node_hour"),
+        "attack_suspicions": col(attack, "attack_suspicions"),
+        "honest_missed_detection_rate": h_missed,
+        "honest_mean_detect_latency_s": h_lat,
+        "honest_fp_per_node_hour": col(honest, "fp_per_node_hour"),
+        "best_k": int(ks[best]),
+        # None = the defense eliminated the attack-induced excess
+        # entirely (a finite factor would be infinity — kept
+        # JSON-portable)
+        "defense_factor": (base / induced[best]
+                           if induced[best] > 0 else None),
+        "induced_eliminated": induced[best] == 0.0,
+        "honest_latency_ratio": (h_lat[best] / h_lat[0]
+                                 if h_lat[0] else None),
+    }
 
 
 # ------------------------------------------------------------- coords

@@ -33,6 +33,13 @@ class SimStats(NamedTuple):
     crashes: jnp.ndarray              # churn-injected crashes
     rejoins: jnp.ndarray
     leaves: jnp.ndarray
+    # adversary attribution (PR 8 byzantine fault tier): the subset of
+    # suspicions/false positives landing on nodes inside an armed
+    # byzantine primitive's blast radius that round (the FaultFrame
+    # `attacked` mask) — zero on honest runs, which is what lets
+    # metrics.phase_reports split honest FP rate from attack-induced
+    attack_suspicions: jnp.ndarray
+    attack_false_positives: jnp.ndarray
 
     @staticmethod
     def zeros() -> "SimStats":
@@ -43,7 +50,8 @@ class SimStats(NamedTuple):
             return jnp.zeros((), jnp.int32)
 
         return SimStats(z(), z(), z(), z(),
-                        jnp.zeros((), jnp.float32), z(), z(), z())
+                        jnp.zeros((), jnp.float32), z(), z(), z(),
+                        z(), z())
 
 
 #: Canonical lane order for vectorized SimStats traces. This is the
@@ -53,7 +61,8 @@ class SimStats(NamedTuple):
 #: comparable column by column.
 STATS_FIELDS = ("suspicions", "refutes", "false_positives",
                 "true_deaths_declared", "detect_latency_sum",
-                "crashes", "rejoins", "leaves")
+                "crashes", "rejoins", "leaves",
+                "attack_suspicions", "attack_false_positives")
 
 
 def stats_vector(st: SimStats) -> jnp.ndarray:

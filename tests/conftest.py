@@ -13,12 +13,44 @@ import os
 # round-4 verdict item 4):
 #     CONSUL_TPU_TEST_PLATFORM=tpu python -m pytest tests/ -m slow -q
 # Default stays "cpu" with a virtual 8-device mesh. "tpu" is normalized
-# to this image's tunnel backend name ("axon") when that plugin is the
-# one registered, so the documented command works on both real-TPU and
-# tunneled images.
+# to whatever accelerator plugin the image actually REGISTERS with jax
+# (real TPU images register "tpu"; tunneled images register e.g.
+# "axon") by probing the registered backend factories — NOT by trusting
+# a JAX_PLATFORMS env var someone may have left unset or stale — so the
+# documented command works on any image.
 _PLATFORM = os.environ.get("CONSUL_TPU_TEST_PLATFORM", "cpu")
-if _PLATFORM == "tpu" and os.environ.get("JAX_PLATFORMS") == "axon":
-    _PLATFORM = "axon"
+
+
+def _normalize_tpu(requested: str) -> str:
+    """Map the documented "tpu" alias to this image's registered
+    accelerator plugin. Probes jax's backend-factory registry (the
+    authoritative list of what THIS install can initialize); falls
+    back to the env-var hint only if the probe itself is unavailable
+    on some future jax."""
+    if requested != "tpu":
+        return requested
+    try:
+        # the registration dict, NOT xla_bridge.backends(): probing
+        # must not initialize any backend before the platform pin
+        # below takes effect
+        from jax._src import xla_bridge
+
+        registered = set(xla_bridge._backend_factories)
+    except Exception:  # noqa: BLE001 — jax internals moved
+        hint = os.environ.get("JAX_PLATFORMS", "")
+        return hint if hint and hint != "cpu" else requested
+    if "tpu" in registered:
+        return "tpu"
+    # no native tpu plugin: pick the image's (single) non-CPU/GPU
+    # accelerator plugin — e.g. the tunnel backend
+    accel = sorted(registered
+                   - {"cpu", "gpu", "cuda", "rocm", "metal",
+                      "interpreter"})
+    return accel[0] if accel else requested
+
+
+if _PLATFORM == "tpu":
+    _PLATFORM = _normalize_tpu(_PLATFORM)
 
 os.environ["JAX_PLATFORMS"] = _PLATFORM
 if _PLATFORM == "cpu":

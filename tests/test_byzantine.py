@@ -1,0 +1,527 @@
+"""Byzantine fault tier (PR 8): adversarial FaultPlan primitives.
+
+Lying members as first-class fault structure — ForgedAcks /
+SpuriousSuspicion / Eclipse / StaleReplay compiled into BOTH engines,
+the SimParams.corroboration_k sample-quorum defense (*Scalable
+Byzantine Reliable Broadcast*, PAPERS.md), and the adversary-
+attribution telemetry (attack_* stats/flight columns + black-box event
+twins) that splits honest from attack-induced detector noise.
+
+Exactness pins (the acceptance criteria):
+  * honest plans keep the pre-byzantine pytree structure, so their
+    traced programs are IDENTICAL to pre-byzantine builds;
+  * an armed byzantine plan at fault_gain=0 reproduces the no-plan run
+    BITWISE (state and every trace column but the fault_phase marker);
+  * the 8-device mesh matches the single-device lane engine bitwise
+    under an armed byzantine plan at stale_k in {1, 4}, with the HLO
+    collective budget unchanged;
+  * black-box ring totals cross-check the attack_* flight columns
+    exactly.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.faults import (ChurnBurst, Eclipse, FaultPlan,
+                               ForgedAcks, Phase, SpuriousSuspicion,
+                               StaleReplay, _phase_arrays, compile_plan,
+                               detection_gate, fault_frame,
+                               plan_is_byzantine, scale_frame)
+from consul_tpu.sim.params import SimParams, SweepAxes, grid_params
+from consul_tpu.sim.round import (make_run_rounds_lanes, run_rounds,
+                                  run_rounds_flight)
+from consul_tpu.sim.state import init_state
+
+_KEY = jax.random.key(0)
+
+
+def _p(n=256, **kw):
+    kw.setdefault("tcp_fallback", False)
+    kw.setdefault("loss", 0.05)
+    return SimParams(n=n, **kw)
+
+
+# ------------------------------------------------- compile-time folds
+
+
+def test_forged_acks_fold_targets_victims_only():
+    pa = _phase_arrays(Phase(rounds=1, faults=(
+        ForgedAcks(adversaries=(56, 64), victims=(0, 8),
+                   coverage=0.9),)), 64)
+    assert pa["forge_ack"][:8].min() == pytest.approx(0.9)
+    assert pa["forge_ack"][8:].max() == 0.0
+    assert pa["attacked"][:8].all() and not pa["attacked"][8:].any()
+    # victims default to everyone-but-the-adversaries, coverage to the
+    # adversary population fraction
+    pa2 = _phase_arrays(Phase(rounds=1, faults=(
+        ForgedAcks(adversaries=(56, 64)),)), 64)
+    assert pa2["forge_ack"][:56].min() == pytest.approx(8 / 64)
+    assert pa2["forge_ack"][56:].max() == 0.0
+
+
+def test_spurious_and_replay_folds():
+    pa = _phase_arrays(Phase(rounds=1, faults=(
+        SpuriousSuspicion(adversaries=(56, 64), victims=(0, 16),
+                          rate=2.0),
+        StaleReplay(adversaries=(56, 64), victims=(16, 32),
+                    rate=0.4),)), 64)
+    # 8 adversaries x rate 2.0 spread over 16 victims = 1.0/round each
+    assert pa["spur_susp"][:16].min() == pytest.approx(1.0)
+    assert pa["spur_susp"][16:].max() == 0.0
+    assert pa["replay"][16:32].min() == pytest.approx(0.4)
+    assert pa["attacked"][:32].all() and not pa["attacked"][32:].any()
+
+
+def test_eclipse_folds_into_loss_channels():
+    """Eclipse compiles through the existing loss machinery: victims'
+    delivery multipliers collapse by coverage*drop on both directions,
+    which is what produces starvation (suspw) AND refutation blockage
+    (hear_w) via the fixed-point folds."""
+    pa = _phase_arrays(Phase(rounds=1, faults=(
+        Eclipse(adversaries=(56, 64), victims=(0, 8), coverage=0.95,
+                drop=1.0),)), 64)
+    assert pa["psend"][:8].max() < 0.1
+    assert pa["precv"][:8].max() < 0.1
+    assert pa["suspw"][:8].max() < 0.05
+    assert pa["hear_w"][:8].max() < 0.05
+    assert pa["psend"][8:].min() > 0.8
+    assert pa["attacked"][:8].all()
+
+
+def test_honest_plans_carry_no_byzantine_tensors():
+    """The structural pin: an honest plan's compiled pytree has None in
+    every byzantine slot — identical structure (and therefore identical
+    traced programs) to pre-byzantine builds."""
+    honest = compile_plan(FaultPlan(phases=(
+        Phase(rounds=4, faults=(ChurnBurst(nodes=(0, 8),
+                                           crash=0.1),)),)), 64)
+    assert honest.forge_ack is None and honest.attacked is None
+    assert not plan_is_byzantine(FaultPlan(phases=(Phase(rounds=1),)))
+    fx = fault_frame(honest, jnp.int32(0))
+    assert fx.forge_ack is None and fx.attacked is None
+    # and scale_frame passes the Nones through
+    assert scale_frame(fx, 0.5).attacked is None
+
+
+# ----------------------------------------------- validation (by name)
+
+
+def test_overlapping_adversary_victim_selectors_rejected():
+    for prim in (ForgedAcks, SpuriousSuspicion, StaleReplay):
+        with pytest.raises(ValueError,
+                           match=f"{prim.__name__}: adversary and "
+                                 "victim selectors overlap"):
+            compile_plan(FaultPlan(phases=(Phase(rounds=1, faults=(
+                prim(adversaries=(0, 8), victims=(4, 12)),)),)), 16)
+    with pytest.raises(ValueError, match="Eclipse: adversary and "
+                                         "victim selectors overlap"):
+        compile_plan(FaultPlan(phases=(Phase(rounds=1, faults=(
+            Eclipse(adversaries=(0, 8), victims=(4, 12)),)),)), 16)
+    with pytest.raises(ValueError, match="empty adversary"):
+        compile_plan(FaultPlan(phases=(Phase(rounds=1, faults=(
+            SpuriousSuspicion(adversaries=[], victims=[1]),)),)), 16)
+    # an armed primitive that attacks NOBODY would read as "defense
+    # worked" in every report — refused by name
+    with pytest.raises(ValueError, match="empty victim"):
+        compile_plan(FaultPlan(phases=(Phase(rounds=1, faults=(
+            ForgedAcks(adversaries=(0, 8), victims=[]),)),)), 16)
+
+
+def test_injector_merges_forged_ack_scopes_per_adversary():
+    """Two ForgedAcks primitives sharing an adversary in one phase
+    merge their victim sets into the installed shim's live scope —
+    neither primitive's protection is silently dropped."""
+    from consul_tpu.faults import FaultInjector
+    from consul_tpu.gossip.transport import InMemNetwork
+
+    net = InMemNetwork(seed=0)
+    addrs = [f"n{i}" for i in range(4)]
+    for a in addrs:
+        net.attach(a).set_handlers(lambda src, pl: None,
+                                   lambda src, req: b"")
+    plan = FaultPlan(phases=(Phase(rounds=5, faults=(
+        ForgedAcks(adversaries=[3], victims=[1]),
+        ForgedAcks(adversaries=[3], victims=[2]),)),))
+    inj = FaultInjector(net, plan, addrs, names=addrs)
+    inj.schedule()
+    vic_addrs, vic_names = inj._forge_scope["n3"]
+    assert vic_addrs == {"n1", "n2"}
+    assert vic_names == {"n1", "n2"}
+
+
+def test_byzantine_parameter_ranges_rejected():
+    with pytest.raises(ValueError, match="coverage must be in"):
+        compile_plan(FaultPlan(phases=(Phase(rounds=1, faults=(
+            ForgedAcks(adversaries=[0], victims=[1],
+                       coverage=1.5),)),)), 8)
+    with pytest.raises(ValueError, match="StaleReplay: rate"):
+        compile_plan(FaultPlan(phases=(Phase(rounds=1, faults=(
+            StaleReplay(adversaries=[0], victims=[1], rate=1.0),)),)),
+            8)
+    with pytest.raises(ValueError, match="Eclipse: drop"):
+        compile_plan(FaultPlan(phases=(Phase(rounds=1, faults=(
+            Eclipse(adversaries=[0], victims=[1], drop=2.0),)),)), 8)
+
+
+def test_corroboration_k_range_validated():
+    """corroboration_k > indirect_checks is structurally unsatisfiable
+    (the quorum samples the relay set) — refused by name, including
+    through the sweep's per-point parameter construction."""
+    with pytest.raises(ValueError, match="corroboration_k=5 out of "
+                                         "range"):
+        SimParams(n=64, corroboration_k=5)
+    with pytest.raises(ValueError, match="corroboration_k"):
+        SimParams(n=64, corroboration_k=-1)
+    # via grid_params / _point_param (the sweep path)
+    with pytest.raises(ValueError, match="corroboration_k"):
+        grid_params(_p(64), SweepAxes.of(corroboration_k=[0.0, 9.0]))
+    # the boundary is allowed
+    assert SimParams(n=64, corroboration_k=3).corroboration_k == 3
+
+
+# --------------------------------------------------- gain-0 exactness
+
+
+def _byz_plan(n):
+    return FaultPlan(phases=(
+        Phase(rounds=5, name="warm"),
+        Phase(rounds=25, faults=(
+            SpuriousSuspicion(adversaries=(n - 32, n), victims=(0, 32),
+                              rate=2.0),
+            ForgedAcks(adversaries=(n - 32, n), victims=(32, 48),
+                       coverage=0.9),
+            Eclipse(adversaries=(n - 32, n), victims=(48, 64),
+                    coverage=0.95),
+            StaleReplay(adversaries=(n - 32, n), victims=(64, 96),
+                        rate=0.3),
+        ), name="attack"),))
+
+
+def test_gain_zero_bitwise_reproduces_honest_run():
+    """The fault_gain=0 pin over the FULL byzantine primitive set: the
+    armed plan blends to the no-fault identity exactly — state and
+    every flight column bitwise-equal to the no-plan run (the
+    fault_phase column is bookkeeping: it records the armed plan's
+    phase index by design)."""
+    from consul_tpu.sim.flight import COL
+
+    p = _p()
+    s0, tr0 = run_rounds_flight(init_state(p.n), _KEY, p, 30,
+                                record_every=5)
+    cp = compile_plan(_byz_plan(p.n), p.n)
+    p_off = p.with_(fault_gain=0.0)
+    s1, tr1 = run_rounds_flight(init_state(p.n), _KEY, p_off, 30,
+                                record_every=5, plan=cp)
+    for a, b in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    a, b = np.asarray(tr0), np.asarray(tr1)
+    mask = np.ones(a.shape[1], bool)
+    mask[COL["fault_phase"]] = False
+    np.testing.assert_array_equal(a[:, mask], b[:, mask])
+
+
+def test_gain_scales_attack_intensity_monotonically():
+    """One compiled sweep grid scales a shared byzantine plan's
+    intensity per point (faults.scale_frame through the traced
+    fault_gain leaf) — also the byz+sweep integration check."""
+    from consul_tpu.sim.sweep import run_sweep
+
+    p = _p()
+    cp = compile_plan(_byz_plan(p.n), p.n)
+    res = run_sweep(p, SweepAxes.of(fault_gain=[0.0, 0.5, 1.0]), 30,
+                    plan=cp)
+    susp = [int(v) for v in np.asarray(res.states.stats
+                                       .attack_suspicions)]
+    assert susp[0] == 0
+    assert susp[0] < susp[1] < susp[2]
+
+
+# ------------------------------------------- engine behavior per class
+
+
+def test_forged_acks_suppress_detection_and_corroboration_defends():
+    """The headline byzantine claim: at corroboration_k=0 (memberlist's
+    any-ack-cancels rule) a 0.9-coverage forging adversary hides nearly
+    every victim death; k=1 corroboration recovers detection by a large
+    factor while honest detection latency stays within a bounded ratio.
+    Fixed seeds — the sim is deterministic per key."""
+    n = 256
+    p = _p(n)
+    attack = FaultPlan(phases=(Phase(rounds=60, faults=(
+        ChurnBurst(nodes=(0, 32), crash=0.05),
+        ForgedAcks(adversaries=(224, 256), victims=(0, 32),
+                   coverage=0.9),)),))
+    honest = FaultPlan(phases=(Phase(rounds=60, faults=(
+        ChurnBurst(nodes=(0, 32), crash=0.05),)),))
+    cp_a, cp_h = compile_plan(attack, n), compile_plan(honest, n)
+
+    def run(pp, cp):
+        s, _ = run_rounds(init_state(n), _KEY, pp, 60, plan=cp)
+        crashes = int(s.stats.crashes)
+        tdd = int(s.stats.true_deaths_declared)
+        lat = (float(s.stats.detect_latency_sum) / tdd if tdd
+               else float("inf"))
+        return crashes, tdd, lat
+
+    c0, d0, _ = run(p, cp_a)
+    assert c0 > 10
+    missed0 = 1.0 - d0 / c0
+    assert missed0 > 0.9, "0.9-coverage forging must suppress detection"
+    c1, d1, _ = run(p.with_(corroboration_k=1), cp_a)
+    missed1 = 1.0 - d1 / c1
+    assert missed1 < missed0 / 3, (missed0, missed1)
+    # honest price: detection latency ratio bounded
+    _, dh0, lat0 = run(p, cp_h)
+    _, dh1, lat1 = run(p.with_(corroboration_k=1), cp_h)
+    assert dh0 > 0 and dh1 > 0
+    assert lat1 / lat0 < 1.5, (lat0, lat1)
+
+
+def test_spurious_suspicion_attribution_and_refutation_load():
+    """Forged suspicion floods: the attack_* counters attribute every
+    forged start, and the measured outcome is the Lifeguard claim —
+    refutation WINS against pure rumor forgery (no false positives),
+    at the cost of a suspicion/refutation storm the victims must keep
+    paying for. (FPs from muted victims are the eclipse class.)"""
+    from consul_tpu.sim.scenarios import run_chaos
+
+    rep = run_chaos("spurious_suspicion", n=256)
+    ph = rep["phases"][1]
+    assert ph["attack_suspicions"] > 100
+    assert ph["attack_suspicions"] <= ph["suspicions"]
+    # the refutation race wins: the storm is refuted, not declared
+    assert ph["refutes"] >= ph["suspicions"] * 0.9
+    assert ph["false_positives"] == ph["attack_false_positives"] == 0
+    assert ph["honest_fp_per_node_hour"] == 0.0
+    # warmup clean, recovery heals
+    assert rep["phases"][0]["attack_suspicions"] == 0
+    assert rep["final_wrongly_dead"] == 0
+
+
+def test_eclipse_starves_victims_into_false_declarations():
+    from consul_tpu.sim.scenarios import run_chaos
+
+    rep = run_chaos("eclipse", n=256)
+    ph = rep["phases"][1]
+    assert ph["false_positives"] > 0
+    assert ph["attack_false_positives"] == ph["false_positives"]
+    # recovery: refutation revives the eclipsed victims
+    assert rep["final_wrongly_dead"] == 0
+    assert rep["final_live_fraction"] == pytest.approx(1.0)
+
+
+def test_stale_replay_cannot_block_detection_but_churns_incarnations():
+    """Replay pressure drags rumor dissemination and forces live
+    victims into incarnation bumps, but incarnation ordering keeps
+    detection working — deaths are still declared."""
+    n = 256
+    p = _p(n)
+    attack = FaultPlan(phases=(Phase(rounds=60, faults=(
+        ChurnBurst(nodes=(0, 16), crash=0.05),
+        StaleReplay(adversaries=(224, 256), victims=(16, 96),
+                    rate=0.5),)),))
+    honest = FaultPlan(phases=(Phase(rounds=60, faults=(
+        ChurnBurst(nodes=(0, 16), crash=0.05),)),))
+    sa, _ = run_rounds(init_state(n), _KEY, p, 60,
+                       plan=compile_plan(attack, n))
+    sh, _ = run_rounds(init_state(n), _KEY, p, 60,
+                       plan=compile_plan(honest, n))
+    # the defense holds: detection not suppressed (within one straggler)
+    assert int(sa.stats.true_deaths_declared) \
+        >= int(sh.stats.true_deaths_declared) - 2
+    # but the victims burned incarnation bumps on the replay storm
+    inc_a = int(jnp.sum(sa.incarnation[16:96]))
+    inc_h = int(jnp.sum(sh.incarnation[16:96]))
+    assert inc_a > inc_h * 2 + 10, (inc_a, inc_h)
+
+
+def test_chaos_suite_includes_byzantine_classes():
+    from consul_tpu.sim.scenarios import BYZANTINE_CHAOS, chaos_plans
+
+    plans = chaos_plans(256)
+    assert set(BYZANTINE_CHAOS) <= set(plans)
+    for name in BYZANTINE_CHAOS:
+        assert plan_is_byzantine(plans[name]), name
+
+
+def test_blackbox_crosscheck_covers_attack_columns():
+    """Exhaustive tracking at stride 1: decoded attack_suspect_start /
+    attack_false_positive ring totals equal the attack_* flight
+    columns EXACTLY, alongside every pre-existing pair."""
+    from consul_tpu.sim import blackbox
+    from consul_tpu.sim.metrics import blackbox_report
+    from consul_tpu.sim.scenarios import chaos_plans
+
+    n = 256
+    p = _p(n)
+    plan = chaos_plans(n)["eclipse"]
+    cp = compile_plan(plan, n)
+    st, tr, bb = run_rounds_flight(
+        init_state(n), jax.random.key(3), p, plan.total_rounds,
+        plan=cp, tracked=jnp.arange(n, dtype=jnp.int32), ring_len=512)
+    rep = blackbox_report(bb, p, trace=tr)
+    assert rep["crosscheck_agree"] is True
+    assert rep["crosscheck"]["attack_suspect_start"]["ring"] > 0
+    # the eclipse victim's starvation timeline: its OWN probes time out
+    # (egress captured), then the cluster turns on it
+    tl = blackbox.decode_timeline(bb, p.probe_interval)
+    names = [e["event"] for e in tl[0]["events"]]
+    assert "probe_timeout" in names and "suspect_start" in names
+    assert "attack_suspect_start" in names
+    assert names.index("probe_timeout") <= names.index("suspect_start")
+
+
+def test_defense_sweep_reports_factor_and_bounded_cost():
+    """run_byzantine_defense (the BYZ_r01.json payload): ONE compiled
+    sweep over corroboration_k demonstrates a measurable forged-ack
+    defense — attack-induced missed detections drop by a recorded
+    factor at best_k while honest latency degrades by a bounded,
+    reported ratio."""
+    from consul_tpu.sim.scenarios import run_byzantine_defense
+
+    rep = run_byzantine_defense(n=512, rounds=100)
+    assert rep["best_k"] >= 1
+    # None = the induced excess was eliminated entirely (factor = inf)
+    assert rep["defense_factor"] is None or rep["defense_factor"] > 2.0
+    assert rep["honest_latency_ratio"] is not None
+    assert rep["honest_latency_ratio"] < 1.5
+    induced = rep["attack_induced_missed_rate"]
+    assert induced[0] > 0.15  # k=0: the attack genuinely hides deaths
+    assert min(induced[1:]) < induced[0] / 2
+
+
+# ------------------------------------------------ cross-engine pins
+
+
+@pytest.mark.parametrize("stale_k", [1, 4])
+def test_mesh_bitwise_under_byzantine_plan(devices8, stale_k):
+    """Acceptance: 8-device mesh == single-device lane engine BITWISE
+    under an armed byzantine plan, at stale_k 1 and 4 — the byzantine
+    tensors shard along the node axis and every adversarial channel is
+    elementwise, so the shard-invariance story survives the largest
+    fault-model extension since PR 1."""
+    from consul_tpu.sim import make_mesh, make_sharded_run
+    from consul_tpu.sim.mesh import init_sharded_state
+
+    n = 512
+    p = _p(n, fail_per_round=0.005, stale_k=stale_k)
+    plan = FaultPlan(phases=(
+        Phase(rounds=10, name="warm"),
+        Phase(rounds=30, faults=(
+            SpuriousSuspicion(adversaries=(448, 512), victims=(0, 64),
+                              rate=1.0),
+            ForgedAcks(adversaries=(448, 512), victims=(64, 96),
+                       coverage=0.8),
+            StaleReplay(adversaries=(448, 512), victims=(96, 160),
+                        rate=0.3),
+        ), name="attack"),))
+    cp = compile_plan(plan, n)
+    rounds = 40
+    single = make_run_rounds_lanes(p, rounds, plan=cp)(
+        init_state(n), jax.random.key(7))
+    mesh = make_mesh(devices8, dc=2)
+    sharded = make_sharded_run(p, rounds, mesh, plan=cp)(
+        init_sharded_state(n, mesh), jax.random.key(7))
+    for a, b in zip(jax.tree.leaves(single), jax.tree.leaves(sharded)):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)))
+    assert int(single.stats.attack_suspicions) > 0
+
+
+def test_byzantine_hlo_collective_budget_unchanged(devices8):
+    """Acceptance: the byzantine channels add NO collectives — an
+    R-round mesh runner under an armed byzantine plan still lowers to
+    ceil(R/stale_k) lane psums + the 2 staged init reductions, and no
+    other collective op type."""
+    from consul_tpu.sim import make_mesh, make_sharded_run
+    from consul_tpu.sim.mesh import init_sharded_state
+
+    n = 512
+    mesh = make_mesh(devices8, dc=2)
+    plan = FaultPlan(phases=(Phase(rounds=8, faults=(
+        ForgedAcks(adversaries=(448, 512), victims=(0, 64)),
+        SpuriousSuspicion(adversaries=(448, 512),
+                          victims=(64, 128)),)),))
+    cp = compile_plan(plan, n)
+    # one unrolled compile covers both claims: byzantine channels +
+    # armed corroboration add no collectives, and the staleness-k
+    # amortization survives them (ceil(4/2)=2 lane psums + 2 init)
+    stale_k, rounds = 2, 4
+    p = _p(n, stale_k=stale_k, corroboration_k=2)
+    run = make_sharded_run(p, rounds, mesh, plan=cp, unroll=True)
+    txt = run.jitted.lower(init_sharded_state(n, mesh),
+                           jax.random.key(0), cp).compile().as_text()
+    n_ar = len(re.findall(r"= \S+ all-reduce(?:-start)?\(", txt))
+    assert n_ar == rounds // stale_k + 2, n_ar
+    for op in ("all-gather", "all-to-all", "collective-permute",
+               "reduce-scatter"):
+        assert not re.search(rf"= \S+ {op}\(", txt), op
+
+
+def test_corroboration_k_sweepable_and_gate_identity():
+    """detection_gate identities: af=0,k=0 is exactly 1; the traced-k
+    sweep path selects legacy vs corroboration per point."""
+    p = _p(256)
+    up = jnp.ones((256,), bool)
+    g = detection_gate(up, None, p)
+    assert float(jnp.max(jnp.abs(g - 1.0))) == 0.0
+    # swept corroboration_k traces without concretization errors
+    tp, pts = grid_params(p, SweepAxes.of(corroboration_k=[0, 1, 3]))
+    from consul_tpu.sim import sweep as sweep_mod
+
+    cp = compile_plan(_byz_plan(256), 256)
+    run = sweep_mod.make_run_sweep(p, 6, plan=cp)
+    jax.eval_shape(run.jitted, tp, _KEY, cp)
+
+
+def test_registry_digest_covers_byzantine_layout(monkeypatch):
+    """The pinned layout digest must move when the byzantine surface
+    moves: fault kinds, the attack event codes, and the attack stats
+    columns are all under the digest (the drift test the CI satellite
+    asks for)."""
+    from consul_tpu.sim import registry
+
+    base = registry.layout_digest()
+    monkeypatch.setattr(registry, "BYZANTINE_FAULT_KINDS",
+                        registry.BYZANTINE_FAULT_KINDS + ("NewLie",))
+    assert registry.layout_digest() != base
+    monkeypatch.setattr(registry, "BYZANTINE_FAULT_KINDS",
+                        registry.BYZANTINE_FAULT_KINDS[:-1])
+    assert registry.layout_digest() == base
+    monkeypatch.setattr(registry, "FAULT_KINDS",
+                        registry.FAULT_KINDS[::-1])
+    assert registry.layout_digest() != base
+    # the byzantine kinds tuple mirrors the primitive classes
+    import consul_tpu.faults as faults_mod
+
+    assert tuple(c.__name__ for c in faults_mod.BYZANTINE) \
+        == ("ForgedAcks", "SpuriousSuspicion", "Eclipse", "StaleReplay")
+    assert registry.BYZANTINE_FAULT_KINDS \
+        == tuple(c.__name__ for c in faults_mod.BYZANTINE)
+    # attack columns/events are digest-covered members of the layout
+    assert "attack_suspicions" in registry.STATS_FIELDS
+    assert "attack_false_positives" in registry.STATS_FIELDS
+    assert "attack_suspect_start" in registry.BLACKBOX_EVENTS
+    assert "attack_false_positive" in registry.BLACKBOX_EVENTS
+
+
+def test_pallas_maker_accepts_byzantine_plan():
+    """CPU-side maker coverage for the Mosaic tier: a byzantine plan
+    builds (the widened fins signature), the megakernel still refuses
+    plans, and honest plans keep the historical path."""
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    n = 65_536  # ROWS_FAULT * LANES — one fault-kernel block
+    p = SimParams(n=n, tcp_fallback=False)
+    plan = FaultPlan(phases=(Phase(rounds=4, faults=(
+        ForgedAcks(adversaries=(0, n // 8),
+                   victims=(n // 4, n // 2)),)),))
+    cp = compile_plan(plan, n)
+    run = make_run_rounds_pallas(p, 4, plan=cp)
+    assert callable(run)
+    with pytest.raises(ValueError, match="megakernel"):
+        make_run_rounds_pallas(p, 4, plan=cp, rounds_per_call=4)

@@ -210,7 +210,9 @@ def test_flight_layout_invariant_with_and_without_coords():
                                         "false_positives",
                                         "true_deaths_declared",
                                         "detect_latency_sum",
-                                        "crashes", "rejoins", "leaves")
+                                        "crashes", "rejoins", "leaves",
+                                        "attack_suspicions",
+                                        "attack_false_positives")
                                      + flight.COORD_COLUMNS)
     n = 1024
     p = SimParams.from_gossip_config(GossipConfig.lan(), n=n, loss=0.05,

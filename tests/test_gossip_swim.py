@@ -287,6 +287,104 @@ def test_broadcast_queue_dynamic_depth():
     assert len(q) == 8
 
 
+def test_byzantine_forged_suspicion_triggers_refutation():
+    """Agent-level byzantine seam (FaultInjector + SpuriousSuspicion):
+    adversaries broadcast forged suspect rumors about a LIVE member
+    carrying its current incarnation — the Lifeguard/refutation path
+    must react with incarnation bumps and the victim must stay alive
+    (the real 3-agent twin of the sim's spurious_suspicion class)."""
+    from consul_tpu.faults import (FaultInjector, FaultPlan, Phase,
+                                   SpuriousSuspicion)
+
+    net, serfs, events = make_cluster(3)
+    net.clock.advance(2.0)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    names = [s.name for s in serfs]
+    inc0 = serfs[0].memberlist.incarnation
+    plan = FaultPlan(phases=(
+        Phase(rounds=20, faults=(
+            SpuriousSuspicion(adversaries=[2], victims=[0],
+                              rate=1.0),)),))
+
+    # a gossip-snooping adversary knows the victim's incarnation
+    def inc_of(name):
+        return serfs[2].memberlist._members[name].incarnation
+
+    cfg = serfs[0].memberlist.config
+    FaultInjector(net, plan, addrs, round_s=cfg.probe_interval,
+                  names=names, inc_of=inc_of).schedule()
+    net.clock.advance(10 * cfg.probe_interval)
+    # the victim refuted: incarnation bumped past the forged claims
+    assert serfs[0].memberlist.incarnation > inc0
+    # and the cluster believes it alive everywhere
+    for s in serfs:
+        st = {ns.name: ns.status for ns in s.members(include_left=True)}
+        assert st["node0"] == MemberStatus.ALIVE, st
+
+
+def test_byzantine_forged_acks_suppress_detection():
+    """Agent-level ForgedAcks: the victim crashes, but every indirect
+    probe of it goes through an adversary that forges an ack — the
+    cluster keeps believing the dead member alive (the detection
+    failure the corroboration_k defense quantifies in the sim), while
+    a control cluster without the adversary declares it dead."""
+    from consul_tpu.faults import (FaultInjector, FaultPlan, ForgedAcks,
+                                   Phase)
+
+    def run(forge: bool):
+        net, serfs, events = make_cluster(4, seed=11)
+        net.clock.advance(2.0)
+        addrs = [s.memberlist.transport.addr for s in serfs]
+        names = [s.name for s in serfs]
+        if forge:
+            plan = FaultPlan(phases=(
+                Phase(rounds=60, faults=(
+                    ForgedAcks(adversaries=[3], victims=[2]),)),))
+            FaultInjector(
+                net, plan, addrs,
+                round_s=serfs[0].memberlist.config.probe_interval,
+                names=names).schedule()
+            net.clock.advance(0.01)  # apply phase 0 shims
+        serfs[2].memberlist.transport.closed = True  # crash, no goodbye
+        net.clock.advance(15.0)
+        st = {ns.name: ns.status
+              for ns in serfs[0].members(include_left=True)}
+        return st.get("node2")
+
+    assert run(forge=False) == MemberStatus.DEAD
+    assert run(forge=True) == MemberStatus.ALIVE, \
+        "forged acks must keep the dead victim looking alive"
+
+
+def test_byzantine_stale_replay_cannot_resurrect():
+    """Agent-level StaleReplay: replayed old-incarnation alive rumors
+    about a declared-dead member must be no-ops — incarnation ordering
+    is the defense this attack quantifies."""
+    from consul_tpu.faults import (FaultInjector, FaultPlan, Phase,
+                                   StaleReplay)
+
+    net, serfs, events = make_cluster(4, seed=5)
+    net.clock.advance(2.0)
+    addrs = [s.memberlist.transport.addr for s in serfs]
+    names = [s.name for s in serfs]
+    serfs[2].memberlist.transport.closed = True
+    net.clock.advance(15.0)
+    st = {ns.name: ns.status for ns in serfs[0].members(include_left=True)}
+    assert st["node2"] == MemberStatus.DEAD
+    plan = FaultPlan(phases=(
+        Phase(rounds=40, faults=(
+            StaleReplay(adversaries=[3], victims=[2], rate=0.9),)),))
+    FaultInjector(net, plan, addrs,
+                  round_s=serfs[0].memberlist.config.probe_interval,
+                  names=names).schedule()
+    net.clock.advance(20.0)
+    for s in (serfs[0], serfs[1]):
+        st = {ns.name: ns.status
+              for ns in s.members(include_left=True)}
+        assert st.get("node2", MemberStatus.DEAD) != MemberStatus.ALIVE, \
+            "a stale replay resurrected a dead member"
+
+
 def test_rtt_scaled_probe_timeout_floor_and_scaling():
     """The ack deadline is max(configured floor, RTT-estimate ×
     RTT_TIMEOUT_MULT), both scaled by awareness: a near (or unknown)

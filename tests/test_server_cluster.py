@@ -218,6 +218,90 @@ def test_agent_data_dir_persistence(tmp_path):
         b.shutdown()
 
 
+def test_peers_json_disaster_recovery(tmp_path):
+    """peers.json manual recovery (agent/consul/server.go:1061-1110):
+    2 of 3 servers are permanently lost (no quorum — the survivor can
+    never elect), the operator writes peers.json naming the survivor as
+    the only voter, and on restart the server rewrites the raft
+    configuration from it, archives the file, and comes back as a
+    WRITABLE single-node cluster with its replicated state intact."""
+    import json
+    import os
+
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"pj{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True,
+            "data_dir": str(tmp_path / f"srv{i}")})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    try:
+        for s in servers[1:]:
+            assert s.join(
+                [servers[0].serf.memberlist.transport.addr]) == 1
+        leader = wait_for(
+            lambda: next((s for s in servers if s.is_leader()), None),
+            what="leader election")
+        wait_for(lambda: len(leader.raft.peers) == 3,
+                 what="3 raft peers")
+        assert leader.handle_rpc("KVS.Apply", {
+            "Op": "set",
+            "DirEnt": {"Key": "dr/key", "Value": b"precious"}},
+            "t") is True
+        survivor = next(s for s in servers if s is not leader)
+        wait_for(lambda: survivor.state.kv_get("dr/key") is not None,
+                 what="replication to the survivor")
+        surv_addr = survivor.rpc.addr
+        surv_port = int(surv_addr.rsplit(":", 1)[1])
+        surv_dir = survivor.config.data_dir
+    finally:
+        for s in servers:
+            s.shutdown()
+
+    # the operator's recovery file: the survivor is the only voter
+    pj = os.path.join(surv_dir, "raft", "peers.json")
+    with open(pj, "w") as f:
+        json.dump([surv_addr], f)
+
+    # restart the survivor alone, on its old RPC port (the address in
+    # peers.json must match the one it binds)
+    cfg = load(dev=True, overrides={
+        "node_name": "pj-survivor-reborn", "bootstrap": False,
+        "bootstrap_expect": 3, "server": True,
+        "data_dir": surv_dir,
+        "ports": {"server": surv_port}})
+    try:
+        reborn = Server(cfg)
+    except OSError:
+        time.sleep(0.3)
+        reborn = Server(cfg)
+    try:
+        # the file was consumed and archived before start
+        assert not os.path.exists(pj)
+        assert os.path.exists(pj + ".applied")
+        reborn.start()
+        wait_for(reborn.is_leader, timeout=20.0,
+                 what="single-node leadership after recovery")
+        assert reborn.raft.peers == {reborn.rpc.addr}
+        # replicated state survived the recovery snapshot fold
+        assert reborn.state.kv_get("dr/key") is not None
+        # and the cluster is WRITABLE again
+        assert reborn.handle_rpc("KVS.Apply", {
+            "Op": "set",
+            "DirEnt": {"Key": "dr/after", "Value": b"alive"}},
+            "t") is True
+        wait_for(lambda: reborn.state.kv_get("dr/after") is not None,
+                 what="post-recovery write")
+    finally:
+        reborn.shutdown()
+
+
 def test_operator_transfer_leader(cluster):
     """operator raft transfer-leader: leadership moves to the chosen
     peer without an availability gap long enough to drop writes."""

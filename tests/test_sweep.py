@@ -309,6 +309,7 @@ def _all_sweep_points():
         "fail_per_round": (0.002, 0.004),
         "rejoin_per_round": (0.02, 0.04),
         "leave_per_round": (0.0, 0.001), "fault_gain": (1.0, 0.5),
+        "corroboration_k": (0, 2),
     }
     assert set(base) == set(SWEEPABLE_FIELDS), \
         "new sweepable field: add it to the concretization guard"
