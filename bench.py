@@ -45,6 +45,22 @@ import time
 _INIT_TIMEOUT_S = float(os.environ.get("CONSUL_TPU_BENCH_INIT_TIMEOUT", "180"))
 
 
+def _ckpt_args(argv):
+    """--ckpt-dir D / --resume for the long-run modes: D arms the
+    preemption guard + checkpoint/progress persistence
+    (consul_tpu.sim.checkpoint), --resume splices a preempted
+    invocation back together. Without --ckpt-dir the modes behave as
+    before (SIGTERM just kills them)."""
+    ckpt_dir = None
+    if "--ckpt-dir" in argv:
+        try:
+            ckpt_dir = argv[argv.index("--ckpt-dir") + 1]
+        except IndexError:
+            print("--ckpt-dir needs a directory", file=sys.stderr)
+            sys.exit(2)
+    return ckpt_dir, "--resume" in argv
+
+
 def _loadavg_1m():
     """1-minute loadavg (bench_kv convention): a ladder row taken on a
     contended host is uninterpretable without it — MULTICHIP_r06's
@@ -144,7 +160,8 @@ def _scenario_bench(metric_base: str, smoke: bool, n: int,
     }))
 
 
-def run_mesh_bench(smoke: bool) -> None:
+def run_mesh_bench(smoke: bool, ckpt_dir=None,
+                   resume: bool = False) -> None:
     """`bench.py --mesh [--smoke]`: the sharded engine's scaling ladder.
 
     Runs the fused-lane mesh runner (sim/mesh.py) at a FIXED per-device
@@ -223,7 +240,31 @@ def run_mesh_bench(smoke: bool) -> None:
 
     from consul_tpu.config import GossipConfig
     from consul_tpu.sim import SimParams, make_mesh, make_sharded_run
+    from consul_tpu.sim.checkpoint import (PREEMPTED_RC,
+                                           PreemptionGuard,
+                                           ProgressManifest)
     from consul_tpu.sim.mesh import init_sharded_state
+
+    # preemption: every ladder rung is one unit — a tripped guard
+    # stops between rungs, completed ones persist in the progress
+    # manifest, and --resume replays them instead of re-measuring
+    guard = PreemptionGuard().install() if ckpt_dir else None
+    manifest = ProgressManifest(
+        ckpt_dir, config={"mode": "mesh", "smoke": smoke,
+                          "per_device_n": 8192 if smoke else 131_072,
+                          "rounds": 48 if smoke else 480}) \
+        if ckpt_dir else None
+
+    def _preempt_emit(unit, partial):
+        watchdog.cancel()
+        if guard is not None:
+            guard.uninstall()
+        _emit({"metric": metric, "platform": platform,
+               "preempted": True, "preempted_rung": unit,
+               **partial,
+               "resume": f"bench.py --mesh --ckpt-dir {ckpt_dir} "
+                         "--resume"},
+              rc=PREEMPTED_RC)
 
     def fire_hung() -> None:
         _emit({"metric": metric, "skipped": False, "error":
@@ -243,6 +284,19 @@ def run_mesh_bench(smoke: bool) -> None:
     counts = [d for d in (1, 2, 4, 8, 16, 32, 64)
               if d <= len(devices)]
     for d in counts:
+        unit = f"ladder/{d}"
+        if manifest is not None and resume and manifest.done(unit):
+            # replay a COPY: the payload assembly pops _collectives
+            # from ladder rows, and mutating the manifest's own dict
+            # would persist the stripped row on the next mark()
+            row = dict(manifest.result(unit))
+            ladder.append(row)
+            if collectives is None:
+                collectives = row.get("_collectives")
+            continue
+        if guard is not None and guard.preempted:
+            _preempt_emit(unit, {"ladder": ladder})
+            return
         n = per_dev * d
         p = SimParams.from_gossip_config(
             GossipConfig.lan(), n=n, loss=0.01, tcp_fallback=False,
@@ -277,14 +331,19 @@ def run_mesh_bench(smoke: bool) -> None:
             best = min(best, time.perf_counter() - t0)
             assert checksum > 0
         rps = rounds * iters / best
-        ladder.append({
+        row = {
             "devices": d, "n": n,
             "stale_k": 1,
             "loadavg_1m": load,
             "rounds_per_sec": round(rps, 1),
             "ms_per_round": round(best / (rounds * iters) * 1e3, 4),
-        })
+        }
+        ladder.append(row)
+        if manifest is not None:
+            manifest.mark(unit, {**row, "_collectives": collectives})
     watchdog.cancel()
+    for row in ladder:
+        row.pop("_collectives", None)
     base = ladder[0]["rounds_per_sec"]
     for row in ladder:
         row["weak_scaling_efficiency"] = round(
@@ -308,6 +367,14 @@ def run_mesh_bench(smoke: bool) -> None:
             + [(STALE_KS[-1], True)]:
         if rounds % k:
             continue
+        unit = f"stale/{k}/{int(overlap)}"
+        if manifest is not None and resume and manifest.done(unit):
+            stale_rows.append(dict(manifest.result(unit)))
+            continue
+        if guard is not None and guard.preempted:
+            _preempt_emit(unit, {"ladder": ladder,
+                                 "stale_k_ladder": stale_rows})
+            return
         p = SimParams.from_gossip_config(
             GossipConfig.lan(), n=n, loss=0.01, tcp_fallback=False,
             collect_stats=False, stale_k=k)
@@ -325,13 +392,18 @@ def run_mesh_bench(smoke: bool) -> None:
             checksum = float(state.informed.sum())
             best = min(best, time.perf_counter() - t0)
             assert checksum > 0
-        stale_rows.append({
+        srow = {
             "devices": d, "n": n, "stale_k": k, "overlap": overlap,
             "loadavg_1m": load,
             "rounds_per_sec": round(rounds * iters / best, 1),
             "ms_per_round": round(best / (rounds * iters) * 1e3, 4),
-        })
+        }
+        stale_rows.append(srow)
+        if manifest is not None:
+            manifest.mark(unit, srow)
     watchdog.cancel()
+    if guard is not None:
+        guard.uninstall()
     payload = {
         "metric": metric,
         "platform": platform,
@@ -350,7 +422,8 @@ def run_mesh_bench(smoke: bool) -> None:
     _emit(payload)
 
 
-def run_sweep_bench(smoke: bool) -> None:
+def run_sweep_bench(smoke: bool, ckpt_dir=None,
+                    resume: bool = False) -> None:
     """`bench.py --sweep [--smoke]`: the parameter-sweep engine
     (sim/sweep.py) — one compiled vmapped runner executing a 64-point
     grid of gossip constants (sim/scenarios.AUTOTUNE_GRID) over the
@@ -428,12 +501,41 @@ def run_sweep_bench(smoke: bool) -> None:
                                           autotune_params)
     from consul_tpu.sim.sweep import SweepResult, make_run_sweep
 
+    from consul_tpu.sim.checkpoint import (PREEMPTED_RC,
+                                           PreemptionGuard,
+                                           ProgressManifest)
+
     n = 1024 if smoke else 65_536
     rounds = 100 if smoke else 300
     axes = SweepAxes.of(**AUTOTUNE_GRID)
     key = jax.random.key(0)
     classes = {}
+    # preemption: each topology class is one unit of work — a tripped
+    # guard stops BETWEEN classes, completed ones persist in the
+    # progress manifest, and --resume replays them without re-running
+    # (the grid itself is one compiled call; the class boundary is its
+    # natural consistent cut)
+    guard = PreemptionGuard().install() if ckpt_dir else None
+    manifest = ProgressManifest(
+        ckpt_dir, config={"mode": "sweep", "smoke": smoke,
+                          "n": n, "rounds": rounds}) \
+        if ckpt_dir else None
     for topology in AUTOTUNE_TOPOLOGIES:
+        if manifest is not None and resume \
+                and manifest.done(topology):
+            classes[topology] = manifest.result(topology)
+            continue
+        if guard is not None and guard.preempted:
+            watchdog.cancel()
+            guard.uninstall()
+            _emit({"metric": metric, "platform": platform,
+                   "preempted": True, "preempted_class": topology,
+                   "completed": sorted(classes),
+                   "classes": classes,
+                   "resume": f"bench.py --sweep --ckpt-dir {ckpt_dir}"
+                             " --resume"},
+                  rc=PREEMPTED_RC)
+            return
         p = autotune_params(topology, n)
         tp, points = grid_params(p, axes)
         run = make_run_sweep(p, rounds)
@@ -469,7 +571,11 @@ def run_sweep_bench(smoke: bool) -> None:
                           "fp_per_node_hour", "msg_load")}
                 for i in rep["pareto"]],
         }
+        if manifest is not None:
+            manifest.mark(topology, classes[topology])
     watchdog.cancel()
+    if guard is not None:
+        guard.uninstall()
     payload = {
         "metric": metric,
         "platform": platform,
@@ -489,7 +595,8 @@ def run_sweep_bench(smoke: bool) -> None:
     _emit(payload)
 
 
-def run_chaos_bench(smoke: bool) -> None:
+def run_chaos_bench(smoke: bool, ckpt_dir=None,
+                    resume: bool = False) -> None:
     """`bench.py --chaos [--smoke]`: the detection-quality chaos suite —
     every named fault class (sim/scenarios.chaos_plans), now including
     the BYZANTINE tier (forged_acks/spurious_suspicion/eclipse/
@@ -499,15 +606,49 @@ def run_chaos_bench(smoke: bool) -> None:
     honest-vs-attack FP split plus the corroboration_k defense sweep
     (sim/scenarios.run_byzantine_defense) — into BYZ_r01.json next to
     this script (the MULTICHIP_r* convention)."""
+    from consul_tpu.sim.checkpoint import (PREEMPTED_RC,
+                                           PreemptionGuard,
+                                           ProgressManifest)
+
+    guard = PreemptionGuard().install() if ckpt_dir else None
+    preempted = {}
+
     def runner(n):
         from consul_tpu.sim.scenarios import (BYZANTINE_CHAOS,
                                               run_byzantine_defense,
                                               run_chaos_suite)
 
-        suite = run_chaos_suite(n=n)
-        defense = run_byzantine_defense(
-            n=min(n, 1024) if smoke else 4096,
-            rounds=100 if smoke else 200)
+        suite = run_chaos_suite(n=n, ckpt_dir=ckpt_dir, guard=guard,
+                                resume=resume)
+        if isinstance(suite.get("preempted"), str):
+            # SIGTERM/SIGINT landed: the in-flight class saved at its
+            # last super-round boundary; completed classes live in the
+            # progress manifest. The envelope stays valid JSON and the
+            # process exits with the documented PREEMPTED_RC.
+            preempted["at"] = suite.pop("preempted")
+            return {"preempted": True, "preempted_class": preempted["at"],
+                    "scenarios": suite,
+                    "resume": f"bench.py --chaos --ckpt-dir "
+                              f"{ckpt_dir} --resume"}
+        manifest = ProgressManifest(
+            ckpt_dir, config={"mode": "chaos", "smoke": smoke,
+                              "n": n}) if ckpt_dir else None
+        if manifest is not None and resume \
+                and manifest.done("byz_defense"):
+            defense = manifest.result("byz_defense")
+        elif guard is not None and guard.preempted:
+            preempted["at"] = "byz_defense"
+            return {"preempted": True,
+                    "preempted_class": "byz_defense",
+                    "scenarios": suite,
+                    "resume": f"bench.py --chaos --ckpt-dir "
+                              f"{ckpt_dir} --resume"}
+        else:
+            defense = run_byzantine_defense(
+                n=min(n, 1024) if smoke else 4096,
+                rounds=100 if smoke else 200)
+            if manifest is not None:
+                manifest.mark("byz_defense", defense)
         byz = {
             "metric": "byzantine_detection_quality"
             + ("_smoke" if smoke else ""),
@@ -539,6 +680,10 @@ def run_chaos_bench(smoke: bool) -> None:
 
     _scenario_bench("chaos_detection_quality", smoke,
                     1024 if smoke else 65_536, runner)
+    if guard is not None:
+        guard.uninstall()
+    if preempted:
+        sys.exit(PREEMPTED_RC)
 
 
 def run_coords_bench(smoke: bool) -> None:
@@ -565,23 +710,24 @@ def main() -> None:
     # in the JSON), split wall time into compile/dispatch/device stages,
     # and measure the flight recorder's overhead at the default stride
     profile = "--profile" in sys.argv[1:]
+    ckpt_dir, resume = _ckpt_args(sys.argv[1:])
     if "--mesh" in sys.argv[1:]:
         if profile:
             print("--profile applies to the throughput bench only; "
                   "ignored with --mesh", file=sys.stderr)
-        run_mesh_bench(smoke)
+        run_mesh_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
     if "--sweep" in sys.argv[1:]:
         if profile:
             print("--profile applies to the throughput bench only; "
                   "ignored with --sweep", file=sys.stderr)
-        run_sweep_bench(smoke)
+        run_sweep_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
     if "--chaos" in sys.argv[1:]:
         if profile:
             print("--profile applies to the throughput bench only; "
                   "ignored with --chaos", file=sys.stderr)
-        run_chaos_bench(smoke)
+        run_chaos_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
     if "--coords" in sys.argv[1:]:
         if profile:

@@ -11,6 +11,7 @@ import math
 import threading
 from typing import Any, Optional
 
+from consul_tpu.types import CONSUL_SERVICE_ID
 from consul_tpu.utils import log
 from consul_tpu.utils.clock import RealTimers
 
@@ -130,6 +131,12 @@ class StateSyncer:
                 chk.in_sync = True
         # deregister remote extras this agent no longer has
         for sid in remote_services - set(local_services):
+            if sid == CONSUL_SERVICE_ID and a.server is not None:
+                # the `consul` service row on a SERVER node is owned by
+                # the leader reconcile loop (leader_registrator_v1.go),
+                # exactly like the serfHealth check below — anti-entropy
+                # must not fight the leader over it
+                continue
             a.agent_rpc("Catalog.Deregister",
                         {"Node": node, "ServiceID": sid})
         for cid in set(remote_checks) - set(local_checks):

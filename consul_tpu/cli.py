@@ -165,16 +165,24 @@ def _run_gossip_sim(cfg) -> int:
     used to init the default backend anyway and hang on TPU-less
     hosts): jax is pinned to the requested platform before backend
     init, and a watchdog turns a hung init/compile into a structured
-    JSON error instead of a stuck process. With -gossip-sim-chaos the
-    run executes a named FaultPlan from the chaos suite end to end and
-    reports per-phase detection quality."""
+    JSON error instead of a stuck process. The documented "tpu" alias
+    is first normalized to whatever accelerator plugin THIS image
+    actually registers (utils/platform.normalize_platform — the same
+    probe tests/conftest.py uses): on tunneled images the plugin is
+    not named "tpu", and pinning the literal name is exactly the
+    libtpu-blocks-forever hang the watchdog exists for. With
+    -gossip-sim-chaos the run executes a named FaultPlan from the
+    chaos suite end to end and reports per-phase detection quality."""
     import threading
+
+    from consul_tpu.utils.platform import normalize_platform
 
     platform = cfg.gossip_sim.lower()
     if platform not in _SIM_PLATFORMS:
         return _sim_error(
             f"unknown -gossip-sim platform {cfg.gossip_sim!r} "
             f"(expected one of {', '.join(_SIM_PLATFORMS)})", platform)
+    platform = normalize_platform(platform)
 
     def arm(budget: float, what: str):
         # the main thread is blocked inside C (libtpu init or Mosaic

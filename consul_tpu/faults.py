@@ -596,6 +596,30 @@ def compile_plan(plan: FaultPlan, n: int) -> CompiledFaultPlan:
     )
 
 
+def plan_digest(cp: Optional[CompiledFaultPlan]) -> Optional[str]:
+    """Content fingerprint of a compiled plan — 16 hex chars over every
+    tensor's name, dtype, shape, and bytes (None leaves hashed by
+    name). Checkpoints (sim/checkpoint.py) embed it so a snapshot taken
+    under an armed plan REFUSES to resume under a different one: the
+    phase tensors are dynamics inputs, and a silent swap would produce
+    a run that is neither the old one nor a fresh one."""
+    if cp is None:
+        return None
+    import hashlib
+
+    h = hashlib.sha256()
+    for name, leaf in zip(CompiledFaultPlan._fields, cp):
+        h.update(name.encode() + b"=")
+        if leaf is None:
+            h.update(b"none;")
+            continue
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.dtype).encode() + str(a.shape).encode())
+        h.update(a.tobytes())
+        h.update(b";")
+    return h.hexdigest()[:16]
+
+
 def active_phase(cp: CompiledFaultPlan, round_idx):
     """Index of the phase whose faults shape round `round_idx` (0-d
     int32; clipped, so rounds past the plan's end report the LAST

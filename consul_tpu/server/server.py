@@ -28,8 +28,9 @@ from consul_tpu.server.rpc import (ConnPool, PooledRaftTransport, RPCError,
                                    RPCServer)
 from consul_tpu.state import FSM, MessageType
 from consul_tpu.state.fsm import encode_command
-from consul_tpu.types import (CheckStatus, MemberStatus, SERF_CHECK_ID,
-                              SERF_CHECK_NAME)
+from consul_tpu.types import (CheckStatus, CONSUL_SERVICE_ID,
+                              CONSUL_SERVICE_NAME, MemberStatus,
+                              SERF_CHECK_ID, SERF_CHECK_NAME)
 from consul_tpu.utils import log, telemetry
 from consul_tpu.utils import trace as trace_mod
 from consul_tpu.utils.ratelimit import RateLimitError, RateLimitHandler
@@ -1595,15 +1596,40 @@ class Server:
                 except Exception as e:  # noqa: BLE001
                     self.log.error("reconcile %s: %s", member.name, e)
 
+    @staticmethod
+    def _consul_service(tags: dict[str, str]) -> Optional[dict]:
+        """The `consul` service registration for a SERVER member
+        (reference: leader_registrator_v1.go:45 registers every server
+        under structs.ConsulServiceName with its RPC port) — what makes
+        `consul.service.consul` DNS bootstrap discovery answer and a
+        fresh dev agent's /v1/catalog/services non-empty. None for
+        non-server members."""
+        if tags.get("role") != "consul":
+            return None
+        port = 0
+        rpc = tags.get("rpc_addr", "")
+        if ":" in rpc:
+            try:
+                port = int(rpc.rsplit(":", 1)[1])
+            except ValueError:
+                port = 0
+        return {"ID": CONSUL_SERVICE_ID, "Service": CONSUL_SERVICE_NAME,
+                "Port": port,
+                "Meta": {"raft_version": tags.get("raft_vsn", "3")}}
+
     def _reconcile_member(self, name: str, addr: str,
                           tags: dict[str, str], ev: EventType) -> None:
         """§3.4: serf membership → catalog registration with the implicit
-        serfHealth check (leader_registrator_v1.go:221-231)."""
+        serfHealth check (leader_registrator_v1.go:221-231); servers
+        additionally register the `consul` service
+        (leader_registrator_v1.go:45)."""
         if ev in (EventType.MEMBER_JOIN, EventType.MEMBER_UPDATE):
+            svc = self._consul_service(tags)
             self.raft.apply(encode_command(MessageType.REGISTER, {
                 "Node": name, "Address": addr.rsplit(":", 1)[0],
                 "ID": tags.get("id", ""),
                 "Partition": tags.get("ap", ""),
+                **({"Service": svc} if svc else {}),
                 "Check": {"CheckID": SERF_CHECK_ID, "Name": SERF_CHECK_NAME,
                           "Status": "passing",
                           "Output": "Agent alive and reachable"}}))
@@ -1646,12 +1672,18 @@ class Server:
                   }.get(m.status)
             if ev is None:
                 continue
-            # only repair drift: skip if catalog already agrees
+            # only repair drift: skip if catalog already agrees — for
+            # servers "agrees" includes the `consul` service row, so a
+            # catalog that lost it (restore, manual deregister) heals
+            # on the next full reconcile
             if ev == EventType.MEMBER_JOIN and name in catalog:
                 checks = {c.check_id: c for c in self.state.node_checks(name)}
                 sh = checks.get(SERF_CHECK_ID)
                 if sh is not None and sh.status == CheckStatus.PASSING:
-                    continue
+                    if m.tags.get("role") != "consul" or any(
+                            s.service == CONSUL_SERVICE_NAME
+                            for s in self.state.node_services(name)):
+                        continue
             self._reconcile_member(m.name, m.addr, m.tags, ev)
 
     def _expire_sessions(self) -> None:

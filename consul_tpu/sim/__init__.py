@@ -58,7 +58,10 @@ from consul_tpu.sim.round import (gossip_round, gossip_round_lanes,
                                   run_rounds_coords,
                                   run_rounds_stats, run_rounds_flight,
                                   make_run_rounds, make_run_rounds_flight,
-                                  make_run_rounds_lanes)
+                                  make_run_rounds_lanes,
+                                  round_keys, round_seeds)
+from consul_tpu.sim.checkpoint import (CheckpointError, PreemptionGuard,
+                                       Snapshot, run_resumable)
 from consul_tpu.sim.topology import (Topology, TopologyParams,
                                      make_topology, true_rtt, sample_rtt)
 from consul_tpu.sim.coords import (CoordState, init_coords, vivaldi_step,
@@ -86,6 +89,8 @@ __all__ = [
     "run_rounds_coords",
     "run_rounds_stats", "run_rounds_flight", "make_run_rounds",
     "make_run_rounds_flight", "make_run_rounds_lanes",
+    "round_keys", "round_seeds",
+    "CheckpointError", "PreemptionGuard", "Snapshot", "run_resumable",
     "Topology", "TopologyParams", "make_topology", "true_rtt",
     "sample_rtt",
     "CoordState", "init_coords", "vivaldi_step", "estimate_rtt",

@@ -1,0 +1,860 @@
+"""Preemption-tolerant checkpoint/resume for the gossip sim engines.
+
+The north star is 1M-agent runs on preemptible accelerators, where the
+dominant failure mode is the HOST dying mid-scan — a SIGTERM at round
+40k of a 50k-round run used to lose everything. This module makes any
+run cut-and-resumable, and makes resume BITWISE: run R rounds straight
+== run r₁ rounds, checkpoint, restore in a fresh process, run R−r₁ —
+state, stats, flight trace, black-box rings — on every engine, at any
+``stale_k``, under an armed FaultPlan mid-phase, and across device
+counts (checkpoint on an 8-device mesh, restore resharded on 1).
+
+Three pieces make that true:
+
+  * **Segment-invariant PRNG** (round.round_keys / round_seeds): round
+    r's key is ``fold_in(base_key, r)`` — a pure function of the base
+    key and the ABSOLUTE round index, with the offset read from
+    ``state.round_idx`` (a traced scalar, so chunked drivers never
+    recompile per offset). The historical ``split(key, rounds)``
+    schedule baked the segment length into every key.
+  * **Carry capture** (the engines' ``carry=``/``lanes0=`` seam): the
+    scan carries more than the SimState — the lane engines' reduced
+    lane vector (stale scalars for the next window), the overlap
+    schedule's in-flight pre-psum block table, the fast/Pallas paths'
+    stale-scalar vector, the flight recorder's trace prefix, and the
+    black-box rings. A snapshot captures all of it; ``init_lanes`` /
+    ``init_scalars`` recompute LIVE sums, which are NOT what the
+    straight run's next window consumes.
+  * **Super-round consistent cuts**: a cut lands only on a reduction
+    boundary (``round_cursor % stale_k == 0``, and ``% record_every``
+    when recording) — the one point in the schedule where the carried
+    lane vector is reduction-fresh and the trace delta windows align,
+    so segment traces concatenate into exactly the straight trace.
+
+The FILE format is torn-write-proof and drift-proof: MAGIC + JSON
+header + npz payload, written tmp + fsync + atomic rename with keep-
+last-k rotation; the header embeds a sha256 over the payload (a torn
+or bit-flipped file is detected and ``latest`` falls back to the
+previous one) and binds ``registry.layout_digest()`` plus a SimParams
+field digest and the compiled plan's digest — a stale layout, changed
+params, or swapped fault plan refuses to load BY NAME instead of
+resuming a run that is neither the old one nor a fresh one. The header
+schema itself is part of the pinned registry digest
+(registry.CHECKPOINT_HEADER_FIELDS).
+
+Host-side, ``PreemptionGuard`` turns SIGTERM/SIGINT into a flag the
+chunked driver (``run_resumable``) polls at super-round boundaries: on
+preemption it performs one bounded-deadline save and returns a
+``preempted`` result; ``bench.py --chaos/--sweep/--mesh`` map that to
+a structured JSON envelope and the documented ``PREEMPTED_RC`` exit
+code, and ``--resume`` splices the run back together (proven by the
+crash-injection test in tests/test_checkpoint.py: SIGKILL mid-run,
+torn-checkpoint fallback, final output bitwise-equal to an
+uninterrupted run).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field, fields as dc_fields
+from typing import Any, Optional
+
+import numpy as np
+
+from consul_tpu.sim import registry
+from consul_tpu.sim.params import SimParams
+from consul_tpu.sim.state import SimState, SimStats
+
+#: file magic: "consul-tpu checkpoint" + format version byte
+MAGIC = b"CTPUCKPT" + bytes([registry.CHECKPOINT_VERSION])
+SUFFIX = ".ckpt"
+
+#: documented process exit code for a preempted-but-saved run
+#: (EX_TEMPFAIL: the run is resumable, not failed — distinct from 0
+#: and from every error rc the benches use)
+PREEMPTED_RC = 75
+
+
+class CheckpointError(ValueError):
+    """A checkpoint file that must not be loaded: torn/corrupt payload
+    (checksum), stale layout, mismatched params or fault plan. The
+    message names WHICH guard refused."""
+
+
+class CheckpointMismatch(CheckpointError):
+    """A checkpoint that is INTACT but must not resume under the
+    caller's configuration: stale layout digest, changed SimParams,
+    swapped fault plan, wrong format version. Distinguished from the
+    torn/corrupt base class because ``latest`` treats them oppositely:
+    a torn newest file falls back to the previous boundary (older
+    files are still exact), while a mismatch refuses the WHOLE
+    directory loudly — every older file would mismatch identically,
+    and silently starting a fresh run would both lie about resuming
+    and rotate the interrupted run's snapshots away."""
+
+
+# ------------------------------------------------------------- digests
+
+
+def params_fields(p: SimParams) -> dict[str, Any]:
+    """The SimParams field dict a header embeds (JSON-portable)."""
+    return {f.name: getattr(p, f.name) for f in dc_fields(SimParams)}
+
+
+def params_digest(p: SimParams) -> str:
+    """16-hex-char fingerprint over every SimParams field, by name and
+    value — layout drift in the params themselves refuses to load."""
+    blob = json.dumps(params_fields(p), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _params_mismatch(saved: dict[str, Any], p: SimParams) -> list[str]:
+    """Field NAMES whose saved value differs from the given params —
+    the refuse-by-name error body."""
+    cur = params_fields(p)
+    names = sorted(set(saved) | set(cur))
+    return [n for n in names if saved.get(n) != cur.get(n)]
+
+
+# ------------------------------------------------------------ snapshot
+
+
+@dataclass
+class Snapshot:
+    """One consistent cut of a run: meta + a flat name->ndarray payload.
+
+    ``arrays`` keys: ``state/<field>`` and ``state/stats/<field>`` for
+    the SimState pytree, plus any of registry.CHECKPOINT_CARRIES —
+    ``lanes`` (reduced lane vector), ``scalars`` (stale-scalar
+    vector), ``table`` (overlap in-flight pre-psum table, GLOBAL),
+    ``flight`` (trace rows recorded so far), ``blackbox/<field>``
+    (rings + cursors + diff baselines), ``coords/<field>`` and
+    ``topo/<field>`` (the Vivaldi pytrees)."""
+
+    engine: str
+    round_cursor: int
+    total_rounds: int
+    base_key: np.ndarray               # uint32 key words
+    params: dict[str, Any]
+    plan_digest: Optional[str]
+    arrays: dict[str, np.ndarray] = field(default_factory=dict)
+    #: paths `latest` skipped as torn/corrupt before finding this one
+    fallbacks: list[str] = field(default_factory=list)
+
+    # ---- device-side reconstruction -------------------------------
+
+    def state(self) -> SimState:
+        import jax.numpy as jnp
+
+        st = SimStats(**{f: jnp.asarray(self.arrays[f"state/stats/{f}"])
+                         for f in SimStats._fields})
+        kw = {f: jnp.asarray(self.arrays[f"state/{f}"])
+              for f in SimState._fields if f != "stats"}
+        return SimState(stats=st, **kw)
+
+    def key(self):
+        import jax
+
+        return jax.random.wrap_key_data(
+            np.asarray(self.base_key, np.uint32))
+
+    def _opt(self, name: str):
+        import jax.numpy as jnp
+
+        a = self.arrays.get(name)
+        return None if a is None else jnp.asarray(a)
+
+    def lanes(self):
+        return self._opt("lanes")
+
+    def scalars(self):
+        return self._opt("scalars")
+
+    def table(self):
+        return self._opt("table")
+
+    def flight(self) -> Optional[np.ndarray]:
+        return self.arrays.get("flight")
+
+    def blackbox(self):
+        from consul_tpu.sim.blackbox import BlackboxState
+
+        if "blackbox/ring" not in self.arrays:
+            return None
+        import jax.numpy as jnp
+
+        return BlackboxState(**{
+            f: jnp.asarray(self.arrays[f"blackbox/{f}"])
+            for f in BlackboxState._fields})
+
+    def _tree(self, prefix: str, cls):
+        if not any(k.startswith(prefix + "/") for k in self.arrays):
+            return None
+        import jax.numpy as jnp
+
+        return cls(**{f: jnp.asarray(self.arrays[f"{prefix}/{f}"])
+                      for f in cls._fields})
+
+    def coords(self):
+        from consul_tpu.sim.coords import CoordState
+
+        return self._tree("coords", CoordState)
+
+    def topo(self):
+        from consul_tpu.sim.topology import Topology
+
+        return self._tree("topo", Topology)
+
+
+def _np(x) -> np.ndarray:
+    import jax
+
+    a = np.asarray(jax.device_get(x))
+    # ascontiguousarray promotes 0-d to 1-d; the reshape restores the
+    # true shape so restored scalars (t, round_idx) stay 0-d
+    return np.ascontiguousarray(a).reshape(a.shape)
+
+
+def snapshot(p: SimParams, key, state: SimState, *, engine: str,
+             total_rounds: int, lanes=None, scalars=None, table=None,
+             flight=None, blackbox=None, coords=None, topo=None,
+             plan=None, record_every: Optional[int] = None) -> Snapshot:
+    """Build a Snapshot from a run's device-side cut (one device_get
+    per leaf; the state may be sharded across a mesh — fetching
+    gathers it, which is what makes restore-on-any-device-count work).
+
+    Boundary validation happens HERE, not at load time: the cursor
+    must sit on a super-round boundary (stale_k) or the captured lane
+    vector would be stale mid-window and resume could not be bitwise.
+    """
+    from consul_tpu.faults import plan_digest as _plan_digest
+
+    cursor = int(_np(state.round_idx))
+    if cursor % p.stale_k:
+        raise ValueError(
+            f"checkpoint cut at round {cursor} is not a super-round "
+            f"boundary (stale_k={p.stale_k}): the carried lane vector "
+            "is only reduction-fresh at window ends")
+    if record_every and cursor % record_every:
+        # flight-recorded cuts must also land on a stride boundary or
+        # the resumed segment's rows record on a shifted stride and
+        # the concatenated trace is not the straight run's (pass the
+        # run's record_every whenever a flight prefix is captured —
+        # run_resumable does)
+        raise ValueError(
+            f"checkpoint cut at round {cursor} is not a flight-stride "
+            f"boundary (record_every={record_every}): segment traces "
+            "would not concatenate into the straight trace")
+    import jax
+
+    arrays: dict[str, np.ndarray] = {}
+    for f in SimState._fields:
+        if f == "stats":
+            continue
+        arrays[f"state/{f}"] = _np(getattr(state, f))
+    for f in SimStats._fields:
+        arrays[f"state/stats/{f}"] = _np(getattr(state.stats, f))
+    for name, val in (("lanes", lanes), ("scalars", scalars),
+                      ("table", table)):
+        if val is not None:
+            arrays[name] = _np(val)
+    if flight is not None:
+        arrays["flight"] = _np(flight)
+    if blackbox is not None:
+        for f in type(blackbox)._fields:
+            arrays[f"blackbox/{f}"] = _np(getattr(blackbox, f))
+    for prefix, tree in (("coords", coords), ("topo", topo)):
+        if tree is not None:
+            for f in type(tree)._fields:
+                arrays[f"{prefix}/{f}"] = _np(getattr(tree, f))
+    return Snapshot(
+        engine=engine, round_cursor=cursor, total_rounds=total_rounds,
+        base_key=_np(jax.random.key_data(key)).astype(np.uint32),
+        params=params_fields(p),
+        plan_digest=_plan_digest(plan),
+        arrays=arrays)
+
+
+# ------------------------------------------------------- file format
+
+
+def _ckpt_name(cursor: int) -> str:
+    return f"ckpt-r{cursor:010d}{SUFFIX}"
+
+
+def save(path_or_dir: str, snap: Snapshot, keep_last: int = 3) -> str:
+    """Atomically write `snap`. A directory target uses the rotation
+    convention (``ckpt-r<cursor>.ckpt``, oldest beyond `keep_last`
+    unlinked AFTER the new file is durable — the fallback chain the
+    torn-file recovery path walks). Write order is torn-proof: tmp
+    file, flush+fsync, atomic rename, directory fsync."""
+    if os.path.isdir(path_or_dir) or path_or_dir.endswith(os.sep) \
+            or not path_or_dir.endswith(SUFFIX):
+        os.makedirs(path_or_dir, exist_ok=True)
+        path = os.path.join(path_or_dir, _ckpt_name(snap.round_cursor))
+        directory = path_or_dir
+    else:
+        path = path_or_dir
+        directory = os.path.dirname(path) or "."
+
+    payload = io.BytesIO()
+    np.savez(payload, **snap.arrays)
+    body = payload.getvalue()
+    header = {
+        "version": registry.CHECKPOINT_VERSION,
+        "engine": snap.engine,
+        "round_cursor": snap.round_cursor,
+        "total_rounds": snap.total_rounds,
+        "base_key": [int(w) for w in snap.base_key.reshape(-1)],
+        "layout_digest": registry.layout_digest(),
+        "params_digest": hashlib.sha256(json.dumps(
+            snap.params, sort_keys=True).encode()).hexdigest()[:16],
+        "params": snap.params,
+        "plan_digest": snap.plan_digest,
+        "arrays": {k: [str(v.dtype), list(v.shape)]
+                   for k, v in sorted(snap.arrays.items())},
+        "payload_sha256": hashlib.sha256(body).hexdigest(),
+    }
+    assert set(header) == set(registry.CHECKPOINT_HEADER_FIELDS), \
+        "header schema drifted from registry.CHECKPOINT_HEADER_FIELDS"
+    hb = json.dumps(header, sort_keys=True).encode()
+    blob = MAGIC + len(hb).to_bytes(4, "big") + hb + body
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platform without directory fsync
+
+    # rotation: only after the new checkpoint is durable
+    if keep_last and keep_last > 0:
+        peers = sorted(
+            f for f in os.listdir(directory)
+            if f.startswith("ckpt-r") and f.endswith(SUFFIX))
+        for old in peers[:-keep_last]:
+            try:
+                os.unlink(os.path.join(directory, old))
+            except OSError:
+                pass
+    return path
+
+
+def load(path: str, p: Optional[SimParams] = None,
+         plan=None) -> Snapshot:
+    """Read + verify one checkpoint file. Raises CheckpointError
+    naming the failed guard: checksum (torn/corrupt), format version,
+    layout digest (stale registry layout), params fields (by name),
+    plan digest. `p`/`plan` arm the params/plan guards — pass the
+    exact objects the resume intends to run with."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(MAGIC[:-1]):
+        raise CheckpointError(f"{path}: not a consul-tpu checkpoint "
+                              "(bad magic)")
+    if len(blob) < len(MAGIC):
+        # torn inside the magic itself (e.g. exactly the 8 name bytes)
+        raise CheckpointError(f"{path}: truncated before the format "
+                              "version byte")
+    if blob[: len(MAGIC)] != MAGIC:
+        raise CheckpointMismatch(
+            f"{path}: checkpoint format version "
+            f"{blob[len(MAGIC) - 1]} != {registry.CHECKPOINT_VERSION} "
+            "(refusing to guess a schema)")
+    off = len(MAGIC)
+    if len(blob) < off + 4:
+        raise CheckpointError(f"{path}: truncated header length")
+    hlen = int.from_bytes(blob[off:off + 4], "big")
+    off += 4
+    if len(blob) < off + hlen:
+        raise CheckpointError(f"{path}: truncated header")
+    try:
+        header = json.loads(blob[off:off + hlen])
+    except ValueError as e:
+        raise CheckpointError(f"{path}: corrupt header JSON: {e}")
+    missing = [k for k in registry.CHECKPOINT_HEADER_FIELDS
+               if k not in header]
+    if missing:
+        raise CheckpointError(
+            f"{path}: header missing {missing} "
+            "(registry.CHECKPOINT_HEADER_FIELDS)")
+    body = blob[off + hlen:]
+    got = hashlib.sha256(body).hexdigest()
+    if got != header["payload_sha256"]:
+        raise CheckpointError(
+            f"{path}: payload checksum mismatch (torn or corrupt "
+            f"write): {got[:16]} != {header['payload_sha256'][:16]}")
+    if header["layout_digest"] != registry.layout_digest():
+        raise CheckpointMismatch(
+            f"{path}: layout digest {header['layout_digest']} != "
+            f"current registry {registry.layout_digest()} — the "
+            "flight/lane/event layout changed since this checkpoint "
+            "was written; its arrays no longer decode")
+    if p is not None:
+        bad = _params_mismatch(header["params"], p)
+        if bad:
+            raise CheckpointMismatch(
+                f"{path}: SimParams mismatch on field(s) "
+                f"{', '.join(bad)} — a checkpoint resumes only under "
+                "the exact params that wrote it")
+    if plan is not None or header.get("plan_digest"):
+        from consul_tpu.faults import plan_digest as _plan_digest
+
+        want, have = header.get("plan_digest"), _plan_digest(plan)
+        if want != have:
+            raise CheckpointMismatch(
+                f"{path}: fault-plan digest mismatch (checkpoint "
+                f"{want}, resume {have}) — the plan's phase tensors "
+                "are dynamics inputs; resume under the same compiled "
+                "plan")
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    return Snapshot(
+        engine=header["engine"],
+        round_cursor=int(header["round_cursor"]),
+        total_rounds=int(header["total_rounds"]),
+        base_key=np.asarray(header["base_key"], np.uint32),
+        params=header["params"],
+        plan_digest=header.get("plan_digest"),
+        arrays=arrays)
+
+
+def latest(directory: str, p: Optional[SimParams] = None,
+           plan=None) -> Optional[Snapshot]:
+    """The newest LOADABLE checkpoint in `directory`, or None.
+
+    Walks newest-first and falls back past TORN/CORRUPT files (the
+    preemption story's torn-last-write recovery: a host killed
+    mid-save leaves at worst one bad newest file, and the previous
+    boundary's checkpoint is still exact). Skipped paths are recorded
+    on the returned Snapshot's ``fallbacks``. A ``CheckpointMismatch``
+    (wrong params/plan/layout/version) propagates instead — every
+    older file would mismatch the same way, and "resume" silently
+    becoming "fresh run" is exactly the lie the refuse-by-name guards
+    exist to prevent."""
+    try:
+        names = sorted((f for f in os.listdir(directory)
+                        if f.startswith("ckpt-r")
+                        and f.endswith(SUFFIX)), reverse=True)
+    except FileNotFoundError:
+        return None
+    skipped: list[str] = []
+    for name in names:
+        path = os.path.join(directory, name)
+        try:
+            snap = load(path, p=p, plan=plan)
+        except CheckpointMismatch:
+            raise
+        except CheckpointError:
+            skipped.append(path)
+            continue
+        snap.fallbacks = skipped
+        return snap
+    if skipped:
+        raise CheckpointError(
+            f"{directory}: every checkpoint is torn/corrupt "
+            f"({len(skipped)} file(s)) — refusing to silently start "
+            "over; clear the directory to begin a fresh run")
+    return None
+
+
+# -------------------------------------------------- preemption guard
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT → a flag the chunked drivers poll at super-round
+    boundaries. ``deadline_s`` bounds the save window: once preempted,
+    ``past_deadline`` tells a driver it must stop launching chunks and
+    save NOW (preemptible hosts give ~30s of grace)."""
+
+    def __init__(self, deadline_s: float = 30.0,
+                 signals=(signal.SIGTERM, signal.SIGINT)):
+        self.deadline_s = deadline_s
+        self.signals = tuple(signals)
+        self._evt = threading.Event()
+        self._at: Optional[float] = None
+        self._old: dict[int, Any] = {}
+
+    def install(self) -> "PreemptionGuard":
+        for sig in self.signals:
+            self._old[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, old in self._old.items():
+            signal.signal(sig, old)
+        self._old.clear()
+
+    def _handler(self, signum, frame) -> None:
+        self.trip()
+
+    def trip(self) -> None:
+        """Mark preemption (signal handler body; also callable from
+        tests)."""
+        if not self._evt.is_set():
+            self._at = time.monotonic()
+        self._evt.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._evt.is_set()
+
+    @property
+    def past_deadline(self) -> bool:
+        return (self._at is not None
+                and time.monotonic() - self._at > self.deadline_s)
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+# ---------------------------------------------------- chunked driver
+
+
+@dataclass
+class RunResult:
+    """What ``run_resumable`` hands back (fields None where the run
+    shape doesn't produce them)."""
+
+    state: Optional[SimState]
+    trace: Optional[np.ndarray]        # spliced flight rows (host)
+    blackbox: Any = None               # final BlackboxState
+    coords: Any = None                 # evolved CoordState (xla+flight)
+    preempted: bool = False
+    checkpoint_path: Optional[str] = None
+    rounds_done: int = 0
+    resumed_from: Optional[int] = None  # cursor the run restarted at
+    fallbacks: list = field(default_factory=list)
+
+
+def _chunk_for(p: SimParams, rounds: int, chunk: Optional[int],
+               record_every: Optional[int]) -> int:
+    """Validate/derive the chunk size: a chunk boundary must be a
+    consistent cut (multiple of stale_k, and of the flight stride so
+    segment traces concatenate into exactly the straight trace)."""
+    import math
+
+    align = p.stale_k
+    if record_every:
+        align = math.lcm(align, record_every)
+    if chunk is None:
+        chunk = max(align, ((64 + align - 1) // align) * align)
+    if chunk % align:
+        raise ValueError(
+            f"chunk={chunk} is not a consistent-cut cadence: needs a "
+            f"multiple of lcm(stale_k={p.stale_k}, "
+            f"record_every={record_every or 1}) = {align}")
+    return min(chunk, rounds) if rounds else chunk
+
+
+def run_resumable(p: SimParams, rounds: int, key=None, *, seed: int = 0,
+                  engine: str = "lanes", plan=None,
+                  flight_every: Optional[int] = None, tracked=None,
+                  coords=None, topo=None,
+                  chunk: Optional[int] = None,
+                  ckpt_dir: Optional[str] = None, keep_last: int = 3,
+                  save_every: int = 1,
+                  guard: Optional[PreemptionGuard] = None,
+                  resume: bool = False) -> RunResult:
+    """Run `rounds` protocol periods in checkpoint-aligned chunks.
+
+    The chunked schedule is BITWISE the one-call straight run (the
+    engines' carry seam, tests/test_checkpoint.py): this driver adds
+    preemption on top — after every chunk it saves to `ckpt_dir`
+    (rotating, keep-last-k) and polls `guard`; on preemption it stops
+    at the boundary, saves, and returns ``preempted=True`` without
+    raising (the caller maps that to PREEMPTED_RC). ``resume=True``
+    restores from the newest loadable checkpoint in `ckpt_dir`
+    (falling back past torn files) and splices flight/blackbox state
+    so the finished run's outputs equal an uninterrupted run's.
+
+    Engines: ``"lanes"`` (make_run_rounds_lanes — stale_k honored,
+    plan + flight supported) and ``"xla"`` (run_rounds /
+    run_rounds_flight — plan, flight, blackbox `tracked`, coords).
+
+    Each snapshot is SELF-CONTAINED — it re-serializes the whole
+    flight prefix recorded so far, so any single surviving file
+    restores the full trace (chained delta files would lose the
+    prefix whenever a middle link tears, defeating the fallback
+    walk). That makes cumulative checkpoint I/O grow with the prefix:
+    for very long flight-recorded runs raise ``save_every`` (save
+    once per N chunks) and/or the chunk size — preemption then loses
+    at most ``save_every·chunk`` rounds of work, never correctness.
+    """
+    import jax
+
+    from consul_tpu.sim import round as round_mod
+    from consul_tpu.sim.state import init_state
+
+    if engine not in ("lanes", "xla"):
+        raise ValueError(f"unknown resumable engine {engine!r} "
+                         "(expected 'lanes' or 'xla')")
+    if coords is not None and (engine != "xla"
+                               or flight_every is None):
+        # the Vivaldi subsystem rides run_rounds_flight only; a bare
+        # run_rounds chunk loop would silently freeze the coordinates
+        # while snapshotting them as if current — refuse instead
+        raise ValueError("coords resumable runs need engine='xla' "
+                         "with flight_every set (the coords update "
+                         "rides the flight scan)")
+    if key is None:
+        key = jax.random.key(seed)
+    chunk = _chunk_for(p, rounds, chunk, flight_every)
+
+    state = None
+    lv = table = bb = None
+    flight_parts: list[np.ndarray] = []
+    cursor = 0
+    resumed_from = None
+    fallbacks: list = []
+    if resume:
+        if not ckpt_dir:
+            raise ValueError("resume=True needs ckpt_dir")
+        snap = latest(ckpt_dir, p=p, plan=plan)
+        if snap is not None:
+            if snap.engine != engine:
+                raise CheckpointError(
+                    f"checkpoint engine {snap.engine!r} != {engine!r}")
+            state = snap.state()
+            key = snap.key()
+            cursor = resumed_from = snap.round_cursor
+            rounds = snap.total_rounds
+            lv, table, bb = snap.lanes(), snap.table(), snap.blackbox()
+            if coords is not None:
+                coords = snap.coords()
+            fl = snap.flight()
+            if fl is not None:
+                flight_parts.append(fl)
+            fallbacks = snap.fallbacks
+    if state is None:
+        state = init_state(p.n)
+
+    def save_cut(st, cur) -> Optional[str]:
+        if not ckpt_dir:
+            return None
+        snap = snapshot(
+            p, key, st, engine=engine, total_rounds=rounds,
+            lanes=lv, table=table,
+            flight=(np.concatenate(flight_parts)
+                    if flight_parts else None),
+            blackbox=bb, coords=coords, topo=topo, plan=plan,
+            record_every=flight_every)
+        return save(ckpt_dir, snap, keep_last=keep_last)
+
+    runners: dict[int, Any] = {}
+
+    def runner(n_rounds: int):
+        if n_rounds not in runners:
+            if engine == "lanes":
+                runners[n_rounds] = round_mod.make_run_rounds_lanes(
+                    p, n_rounds, flight_every=flight_every, plan=plan,
+                    carry=True)
+            else:
+                runners[n_rounds] = None  # run_rounds* jit directly
+        return runners[n_rounds]
+
+    if save_every < 1:
+        raise ValueError(f"save_every must be >= 1: {save_every}")
+    path = None
+    chunk_i = 0
+    while cursor < rounds:
+        step = min(chunk, rounds - cursor)
+        if guard is not None and guard.preempted:
+            path = save_cut(state, cursor)
+            return RunResult(state=state,
+                             trace=(np.concatenate(flight_parts)
+                                    if flight_parts else None),
+                             blackbox=bb, coords=coords,
+                             preempted=True,
+                             checkpoint_path=path, rounds_done=cursor,
+                             resumed_from=resumed_from,
+                             fallbacks=fallbacks)
+        if engine == "lanes":
+            run = runner(step)
+            out = run(state, key, plan, lanes0=lv)
+            if flight_every is not None:
+                state, tr, lv = out
+                flight_parts.append(np.asarray(jax.device_get(tr)))
+            else:
+                state, lv = out
+        else:
+            if flight_every is not None:
+                out = round_mod.run_rounds_flight(
+                    state, key, p, step, record_every=flight_every,
+                    plan=plan, coords=coords, topo=topo,
+                    tracked=(tracked if bb is None else None),
+                    bb0=bb)
+                out = list(out)
+                state = out.pop(0)
+                if coords is not None:
+                    coords = out.pop(0)
+                tr = out.pop(0)
+                flight_parts.append(np.asarray(jax.device_get(tr)))
+                if out:
+                    bb = out.pop(0)
+            else:
+                state, _ = round_mod.run_rounds(state, key, p, step,
+                                                plan=plan)
+        cursor += step
+        chunk_i += 1
+        if ckpt_dir and cursor < rounds and chunk_i % save_every == 0:
+            path = save_cut(state, cursor)
+    return RunResult(state=state,
+                     trace=(np.concatenate(flight_parts)
+                            if flight_parts else None),
+                     blackbox=bb, coords=coords, preempted=False,
+                     checkpoint_path=path, rounds_done=cursor,
+                     resumed_from=resumed_from, fallbacks=fallbacks)
+
+
+# ------------------------------------------------- bench progress log
+
+
+def _selftest_main(argv=None) -> int:
+    """``python -m consul_tpu.sim.checkpoint --ckpt-dir D [...]`` — the
+    minimal preemptible long-run driver the crash-injection tests
+    SIGKILL/SIGTERM (tests/test_checkpoint.py) and the smallest
+    end-to-end example of the bench wiring: installs the guard, runs a
+    lanes-engine sim in checkpointed chunks, prints ONE JSON line, and
+    exits PREEMPTED_RC when a signal interrupted it. ``--sleep``
+    stretches each chunk so a test can reliably land its signal
+    mid-run."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="consul_tpu.sim.checkpoint")
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--stale-k", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sleep", type=float, default=0.0)
+    ap.add_argument("--resume", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu") or "cpu")
+    p = SimParams(n=args.n, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.01, rejoin_per_round=0.05,
+                  stale_k=args.stale_k)
+    guard = PreemptionGuard().install()
+
+    # chunk pacing hook for the signal-injection tests: wrap the guard
+    # poll with a sleep so the parent can land SIGTERM/SIGKILL between
+    # chunks deterministically
+    if args.sleep > 0:
+        orig = PreemptionGuard.preempted.fget
+
+        def paced(self):
+            time.sleep(args.sleep)
+            return orig(self)
+
+        type(guard).preempted = property(paced)  # type: ignore
+
+    rr = run_resumable(
+        p, args.rounds, seed=args.seed, engine="lanes",
+        chunk=args.chunk, ckpt_dir=args.ckpt_dir, guard=guard,
+        resume=args.resume)
+    digest = hashlib.sha256()
+    import jax as _jax
+
+    for leaf in _jax.tree.leaves(_jax.device_get(rr.state)):
+        digest.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    print(json.dumps({
+        "preempted": rr.preempted,
+        "rounds_done": rr.rounds_done,
+        "rounds": args.rounds,
+        "resumed_from": rr.resumed_from,
+        "checkpoint": rr.checkpoint_path,
+        "state_digest": digest.hexdigest()[:16],
+    }), flush=True)
+    return PREEMPTED_RC if rr.preempted else 0
+
+
+
+class ProgressManifest:
+    """Suite-level resume for the benches: a tiny JSON ledger of
+    completed work units (chaos classes, sweep topology classes, mesh
+    ladder rungs) next to the sim checkpoints, atomically rewritten
+    per completion. ``bench.py --resume`` skips completed units and
+    the interrupted unit's sim run resumes from ITS checkpoints — the
+    two layers together splice a whole bench invocation."""
+
+    #: reserved key holding the writing invocation's configuration
+    CONFIG_KEY = "__config__"
+
+    def __init__(self, directory: str, name: str = "progress.json",
+                 config: Optional[dict] = None):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, name)
+        self._done: dict[str, Any] = {}
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    self._done = json.load(f)
+            except (OSError, ValueError):
+                self._done = {}  # torn manifest: redo, never crash
+        if config is not None:
+            # bind the ledger to the invocation's configuration: a
+            # resume under different smoke/n/rounds must not splice
+            # another config's measurements in as fresh (the manifest
+            # twin of the checkpoints' params-digest refusal)
+            saved = self._done.get(self.CONFIG_KEY)
+            if saved is not None and saved != config:
+                bad = sorted(k for k in set(saved) | set(config)
+                             if saved.get(k) != config.get(k))
+                raise ValueError(
+                    f"{self.path}: progress manifest was written "
+                    f"under a different configuration (mismatched: "
+                    f"{', '.join(bad)}) — resume with the same flags "
+                    "or point --ckpt-dir at a fresh directory")
+            if saved is None:
+                self._done[self.CONFIG_KEY] = config
+                self._flush()
+
+    def done(self, unit: str) -> bool:
+        return unit != self.CONFIG_KEY and unit in self._done
+
+    def result(self, unit: str) -> Any:
+        return self._done.get(unit)
+
+    def mark(self, unit: str, result: Any = True) -> None:
+        self._done[unit] = result
+        self._flush()
+
+    def _flush(self) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self._done, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    @property
+    def completed(self) -> list[str]:
+        return sorted(k for k in self._done if k != self.CONFIG_KEY)
+
+
+if __name__ == "__main__":  # pragma: no cover — subprocess surface
+    import sys
+
+    sys.exit(_selftest_main())

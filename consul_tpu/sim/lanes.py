@@ -221,6 +221,15 @@ class LaneReducer:
     def fold(self, table: jnp.ndarray) -> jnp.ndarray:
         raise NotImplementedError
 
+    def gather_table(self, table: jnp.ndarray) -> jnp.ndarray:
+        """The GLOBAL block table from a per-shard one (the checkpoint
+        capture of the overlap schedule's in-flight carry): identity on
+        one device, a psum on the mesh. ``fold(gather_table(t))`` ==
+        ``fold(t)`` value for value — gathering only materializes the
+        sum the fold's collective would compute, which is what lets an
+        8-device overlap checkpoint restore on 1 device bitwise."""
+        raise NotImplementedError
+
     def __call__(self, stack: jnp.ndarray) -> jnp.ndarray:
         return self.fold(self.partials(stack))
 
@@ -243,6 +252,9 @@ class _SingleDeviceReducer(LaneReducer):
 
     def fold(self, table: jnp.ndarray) -> jnp.ndarray:
         return table.sum(axis=1)
+
+    def gather_table(self, table: jnp.ndarray) -> jnp.ndarray:
+        return table  # one device: the local table IS the global one
 
 
 #: module-level instance — the name every caller has always passed as
@@ -280,6 +292,13 @@ class _MeshReducer(LaneReducer):
     def fold(self, table: jnp.ndarray) -> jnp.ndarray:
         return jax.lax.psum(table, self.reduce_axes).sum(axis=1)
 
+    def gather_table(self, table: jnp.ndarray) -> jnp.ndarray:
+        # one extra psum OUTSIDE the scan (checkpoint capture only):
+        # materializes exactly the column sums fold's own psum would —
+        # each column has one owning shard, so summing the zeros the
+        # others hold is exact
+        return jax.lax.psum(table, self.reduce_axes)
+
 
 def mesh_lane_reducer(reduce_axes: Sequence[str],
                       scope_shards: int) -> LaneReducer:
@@ -299,6 +318,17 @@ def seed_table(lanes0: jnp.ndarray, shard_offset) -> jnp.ndarray:
     table = jnp.zeros((lanes0.shape[0], LANE_BLOCKS), jnp.float32)
     first = jnp.asarray(shard_offset == 0, jnp.float32)
     return table.at[:, 0].set(lanes0 * first)
+
+
+def carry_table(table0: jnp.ndarray, shard_offset) -> jnp.ndarray:
+    """Re-scatter a checkpoint's GLOBAL in-flight table for a resumed
+    overlap scan: the shard at global offset 0 carries the whole table,
+    every other shard zeros (seed_table's placement) — the fold's psum
+    reassembles exactly ``table0``, so the resumed first fold is
+    bitwise the interrupted run's, on ANY device count (including one
+    that differs from the count that wrote the checkpoint)."""
+    first = jnp.asarray(shard_offset == 0, jnp.float32)
+    return jnp.asarray(table0, jnp.float32) * first
 
 
 # ------------------------------------------------------- lane consumers

@@ -52,7 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from consul_tpu.faults import CompiledFaultPlan
 from consul_tpu.sim import lanes as lanes_mod
 from consul_tpu.sim.params import SimParams
-from consul_tpu.sim.round import _lane_scan
+from consul_tpu.sim.round import _lane_scan, round_keys
 from consul_tpu.sim.state import SimState, SimStats, init_state
 
 AXES = ("dc", "nodes")
@@ -104,7 +104,9 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
                    flight_every: Optional[int] = None,
                    plan: Optional[CompiledFaultPlan] = None,
                    overlap: bool = False,
-                   unroll: bool = False):
+                   unroll: bool = False,
+                   carry: bool = False,
+                   resume: bool = False):
     """One factory for every mesh runner: `reduce_axes` scopes the
     population coupling — ("dc","nodes") = one global pool,
     ("nodes",) = independent per-DC pools. `flight_every` arms the
@@ -118,7 +120,20 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
     ``overlap`` additionally folds each psum one super-round late so
     the collective overlaps the next window's local compute (flight
     recording refused — see round._lane_scan). ``unroll`` fully
-    unrolls the super-round scan for HLO collective audits."""
+    unrolls the super-round scan for HLO collective audits.
+
+    ``carry``/``resume`` are the checkpoint seam (round._lane_scan):
+    ``carry=True`` appends the scan's non-state carry to the outputs —
+    the reduced lane vector (replicated: the fold's psum already made
+    it identical on every shard) and, under overlap, the GLOBAL
+    in-flight pre-psum table (one extra psum outside the scan) —
+    ``resume=True`` makes the runner accept that carry back
+    (``lanes0``, and ``table0`` under overlap) as replicated inputs.
+    Because the lane engine is bitwise shard-invariant, a carry
+    captured on THIS mesh restores on any other device count — the
+    8-device-checkpoint → 1-device-restore pin in
+    tests/test_checkpoint.py. Round keys are
+    ``round_keys(key, state.round_idx, rounds)`` like every engine."""
     reduce_axes = tuple(reduce_axes)
     if p.collect_stats and reduce_axes != AXES:
         # stats out-specs are replicated; axis-scoped psums would leave
@@ -147,43 +162,91 @@ def _make_mesh_run(p: SimParams, rounds: int, mesh: Mesh,
                          is_leaf=lambda x: isinstance(x, NamedSharding))
     reducer = lanes_mod.mesh_lane_reducer(reduce_axes, scope_shards)
 
-    def shard_body(state: SimState, keys: jax.Array, cp=None):
+    with_table = resume and overlap
+
+    def shard_body(state: SimState, keys: jax.Array, *rest):
         # global node offset of this shard's rows: the lane engine keys
         # per-node randomness by GLOBAL index, so every shard draws its
         # slice of the same global stream — no per-shard key folds
+        i = 0
+        cp = rest[i] if with_plan else None
+        i += 1 if with_plan else 0
+        lanes0 = rest[i] if resume else None
+        i += 1 if resume else 0
+        table0 = rest[i] if with_table else None
         shard = (jax.lax.axis_index("dc") * nodes_size
                  + jax.lax.axis_index("nodes"))
         offset = shard * state.up.shape[0]
         return _lane_scan(state, keys, cp, p, rounds, flight_every,
                           with_plan, reducer, offset,
-                          overlap=overlap, unroll=unroll)
+                          overlap=overlap, unroll=unroll,
+                          lanes0=lanes0, table0=table0,
+                          return_carry=carry)
 
-    out_specs = (specs, P()) if with_flight else specs
+    in_specs = [specs, P()]
     if with_plan:
-        mapped = shard_map(
-            shard_body, mesh=mesh,
-            in_specs=(specs, P(), _plan_specs(plan)),
-            out_specs=out_specs, check_rep=False)
+        in_specs.append(_plan_specs(plan))
+    if resume:
+        in_specs.append(P())      # lanes0 — replicated lane vector
+    if with_table:
+        in_specs.append(P())      # table0 — replicated global table
+    out_specs = specs if not with_flight else (specs, P())
+    if carry:
+        # the reduced lane vector (and under overlap the gathered
+        # table) is a psum product — identical on every shard, so the
+        # replicated out-spec is honest (check_rep is off mesh-wide)
+        extra = (P(), P()) if overlap else (P(),)
+        base = out_specs if with_flight else (out_specs,)
+        out_specs = tuple(base) + extra
 
-        @functools.partial(jax.jit, donate_argnums=0)
-        def run_plan(state: SimState, key: jax.Array, cp):
-            return mapped(state, jax.random.split(key, rounds), cp)
-
-        def run(state: SimState, key: jax.Array,
-                cp: Optional[CompiledFaultPlan] = None):
-            return run_plan(state, key, cp if cp is not None else plan)
-
-        run.jitted = run_plan  # the jit object (HLO audits: .lower)
-        return run
-
-    mapped = shard_map(
-        shard_body, mesh=mesh, in_specs=(specs, P()),
-        out_specs=out_specs, check_rep=False)
+    mapped = shard_map(shard_body, mesh=mesh,
+                       in_specs=tuple(in_specs),
+                       out_specs=out_specs, check_rep=False)
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def run(state: SimState, key: jax.Array):
-        return mapped(state, jax.random.split(key, rounds))
+    def run_args(state: SimState, key: jax.Array, *rest):
+        keys = round_keys(key, state.round_idx, rounds)
+        return mapped(state, keys, *rest)
 
+    if not with_plan and not resume:
+        # the historical shape: the runner IS the jit object (HLO
+        # audits call .lower on it directly)
+        return run_args
+
+    def run(state: SimState, key: jax.Array,
+            cp: Optional[CompiledFaultPlan] = None,
+            lanes0=None, table0=None):
+        if (lanes0 is not None or table0 is not None) and not resume:
+            raise ValueError("resume carries need a resume=True mesh "
+                             "runner (shard_map signatures are fixed "
+                             "at build time)")
+        rest = []
+        if with_plan:
+            rest.append(cp if cp is not None else plan)
+        elif cp is not None:
+            raise ValueError("this runner was built without a fault "
+                             "plan; rebuild with plan= to inject one")
+        if resume:
+            if lanes0 is None:
+                raise ValueError("resume=True mesh runners take the "
+                                 "checkpoint's lane vector (lanes0)")
+            rest.append(lanes0)
+        if table0 is not None and not with_table:
+            # same refusal as make_run_rounds_lanes: a checkpoint that
+            # carries an in-flight table came from an OVERLAP run —
+            # silently dropping it would lose the undrained window's
+            # stats and the resume would not be bitwise
+            raise ValueError("table0 is the overlap schedule's "
+                             "in-flight carry; rebuild the mesh "
+                             "runner with overlap=True (and resume=)")
+        if with_table:
+            if table0 is None:
+                raise ValueError("overlap resume needs the in-flight "
+                                 "table (table0)")
+            rest.append(table0)
+        return run_args(state, key, *rest)
+
+    run.jitted = run_args  # the jit object (HLO audits: .lower)
     return run
 
 
@@ -191,16 +254,20 @@ def make_sharded_run(p: SimParams, rounds: int, mesh: Mesh,
                      flight_every: Optional[int] = None,
                      plan: Optional[CompiledFaultPlan] = None,
                      overlap: bool = False,
-                     unroll: bool = False):
+                     unroll: bool = False,
+                     carry: bool = False,
+                     resume: bool = False):
     """Compiled multi-device runner over ONE global pool: exactly one
     psum collective per ``p.stale_k``-round reduction window (one per
     round at the default stale_k=1); with `flight_every` the return
     becomes (state, trace) — the decimated flight rows riding the same
     collective. ``overlap`` double-buffers the psum against the next
-    window's compute; ``unroll`` is the HLO-audit knob."""
+    window's compute; ``unroll`` is the HLO-audit knob; ``carry``/
+    ``resume`` are the checkpoint seam (see _make_mesh_run)."""
     return _make_mesh_run(p, rounds, mesh, AXES,
                           flight_every=flight_every, plan=plan,
-                          overlap=overlap, unroll=unroll)
+                          overlap=overlap, unroll=unroll,
+                          carry=carry, resume=resume)
 
 
 def make_multidc_run(p: SimParams, rounds: int, mesh: Mesh,

@@ -30,7 +30,8 @@ from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
 from consul_tpu.sim import registry
 from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import (N_SCALARS, init_scalars,
-                                  _pf_arrays, _shrink)
+                                  _pf_arrays, _shrink, round_keys,
+                                  round_seeds)
 from consul_tpu.sim.state import (ALIVE, DEAD, LEFT, SUSPECT, SimState,
                                   SimStats)
 
@@ -625,27 +626,38 @@ def _build_mega(p: SimParams, n: int, rpc: int, interpret: bool = False):
 
 
 def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
-                   flight_every: Optional[int], with_bb: bool):
+                   flight_every: Optional[int], with_bb: bool,
+                   carry: bool = False):
     """The rounds_per_call > 1 runner: an outer scan of rounds/rpc
     megakernel launches (see _mega_kernel). Scalars update between
     CALLS from the kernel's emitted last-round partials — the stale_k
     == rpc schedule with kernel-dispatch and HBM round-trip costs
-    amortized rpc×."""
+    amortized rpc×. ``carry`` exposes/accepts the stale-scalar carry
+    (the checkpoint seam, like the per-round runner below); resume
+    cuts must land on call boundaries (state.round_idx % rpc == 0)."""
     mega, rows, n_arrays = _build_mega(p, p.n, rpc, interpret)
     steps = rounds // rpc
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def _run(state: SimState, key: jax.Array, tracked=None):
+    def _run(state: SimState, key: jax.Array, tracked=None,
+             scalars0=None, bb0=None):
         from consul_tpu.sim import blackbox as blackbox_mod
         from consul_tpu.sim import flight
 
-        if with_bb and tracked is None:
+        if with_bb and tracked is None and bb0 is None:
             raise ValueError("blackbox=True runner needs a tracked "
                              "id array (blackbox.default_tracked)")
-        scalars = init_scalars(state, p)
-        scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
-        seeds = jax.random.randint(key, (steps, rpc), 0, 2**31 - 1,
-                                   dtype=jnp.int32)
+        if scalars0 is None:
+            scalars = init_scalars(state, p)
+            scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
+        else:
+            scalars = scalars0
+        # fold_in-keyed absolute-round seed stream (round.round_seeds):
+        # a resumed segment draws the SAME per-round seeds the straight
+        # run would — jax.random.randint over (steps, rpc) baked the
+        # segment shape into every draw
+        seeds = round_seeds(key, state.round_idx,
+                            steps * rpc).reshape(steps, rpc)
         r0s = state.round_idx + jnp.arange(steps, dtype=jnp.int32) * rpc
 
         def to2d(x):
@@ -711,8 +723,9 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
         if flight_every is not None:
             rec0 = (flight.empty_trace(rounds, flight_every), acc0)
             if with_bb:
-                rec0 = rec0 + (blackbox_mod.init_blackbox(
-                    state, tracked, p.blackbox_ring),)
+                rec0 = rec0 + (bb0 if bb0 is not None
+                               else blackbox_mod.init_blackbox(
+                                   state, tracked, p.blackbox_ring),)
         else:
             rec0 = jnp.zeros((0,), jnp.float32)
         (args, scalars, t_final, acc, rec), _ = jax.lax.scan(
@@ -745,6 +758,8 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
             res = res + (trace,)
         if with_bb:
             res = res + (bb_out,)
+        if carry:
+            res = res + (scalars,)
         return res[0] if len(res) == 1 else res
 
     if n_arrays == 10:
@@ -752,7 +767,8 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
 
     seen_ok: list = [None]
 
-    def run(state: SimState, key: jax.Array, tracked=None):
+    def run(state: SimState, key: jax.Array, tracked=None,
+            scalars0=None, bb0=None):
         # same residual-slow-node refusal as the per-round 8-array
         # runner (see make_run_rounds_pallas below)
         if state.slow is not seen_ok[0]:
@@ -762,7 +778,7 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
                     "slow-node model; use a SimParams with "
                     "slow_per_round>0 (10-array kernel) or the XLA "
                     "run_rounds for this state")
-        out = _run(state, key, tracked)
+        out = _run(state, key, tracked, scalars0, bb0)
         seen_ok[0] = (out[0] if isinstance(out, tuple) else out).slow
         return out
 
@@ -775,7 +791,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                            flight_every: Optional[int] = None,
                            coords: bool = False,
                            blackbox: bool = False,
-                           rounds_per_call: int = 1):
+                           rounds_per_call: int = 1,
+                           carry: bool = False):
     """Compiled hot loop using the fused Pallas round kernel.
 
     Covers the full protocol model including churn, slow-node
@@ -834,7 +851,17 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     events (registry.BLACKBOX_EVENTS minus BLACKBOX_PROBE_EVENTS) —
     the prober-side probe lifecycle is internal to the kernel's
     on-chip PRNG and is an XLA-engine-only feature. Requires
-    flight_every (the tracer shares the recorder's cond by design)."""
+    flight_every (the tracer shares the recorder's cond by design).
+
+    `carry=True` is the checkpoint seam (sim/checkpoint.py): the
+    runner additionally returns its stale-scalar carry and accepts it
+    back as `scalars0=` (plus `bb0=` for an interrupted run's
+    black-box rings) — the Pallas twin of the lane engine's
+    lanes0/table0. Per-round kernel seeds and coord keys come from the
+    fold_in-keyed absolute-round streams (round.round_seeds /
+    round_keys with state.round_idx as the offset), so a run cut at a
+    call boundary and resumed from its captured scalars is the same
+    seed-for-seed program as the uncut run."""
     fault = plan is not None
     with_coords = bool(coords)
     with_bb = bool(blackbox)
@@ -876,7 +903,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                 "the black-box tracer writes rings inside the flight "
                 "recorder's decimation cond; pass flight_every")
         return _make_run_mega(p, rounds, rounds_per_call, interpret,
-                              flight_every, with_bb)
+                              flight_every, with_bb, carry)
     if flight_every is not None and not p.collect_stats:
         raise ValueError(
             "flight recording rides the kernel's stats lanes; build "
@@ -909,21 +936,30 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     @functools.partial(jax.jit, donate_argnums=0)
     def _run(state: SimState, key: jax.Array,
              cp: Optional[CompiledFaultPlan] = None,
-             coo=None, topo=None, tracked=None):
+             coo=None, topo=None, tracked=None, scalars0=None,
+             bb0=None):
         from consul_tpu.sim import blackbox as blackbox_mod
         from consul_tpu.sim import coords as coords_mod
         from consul_tpu.sim import flight
         from consul_tpu.sim import topology as topo_mod
 
-        if with_bb and tracked is None:
+        if with_bb and tracked is None and bb0 is None:
             raise ValueError("blackbox=True runner needs a tracked "
                              "id array (blackbox.default_tracked)")
 
-        scalars = init_scalars(state, p)
-        # clamp the tiny epsilons the XLA path uses
-        scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
-        seeds = jax.random.randint(key, (rounds,), 0, 2**31 - 1,
-                                   dtype=jnp.int32)
+        if scalars0 is None:
+            scalars = init_scalars(state, p)
+            # clamp the tiny epsilons the XLA path uses
+            scalars = scalars.at[7].set(jnp.maximum(scalars[7], 1e-9))
+        else:
+            # resume: the interrupted run's stale-scalar carry, verbatim
+            # (init_scalars would recompute LIVE sums — not what the
+            # straight run's next round consumes)
+            scalars = scalars0
+        # fold_in-keyed absolute-round streams (round.round_seeds /
+        # round_keys): segment-invariant, so a checkpoint cut resumes
+        # the exact seed/key sequence the straight run would draw
+        seeds = round_seeds(key, state.round_idx, rounds)
         ridx = state.round_idx + jnp.arange(rounds, dtype=jnp.int32)
 
         def to2d(x):
@@ -1052,13 +1088,15 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         if flight_every is not None:
             rec0 = (flight.empty_trace(rounds, flight_every), acc0)
             if with_bb:
-                rec0 = rec0 + (blackbox_mod.init_blackbox(
-                    state, tracked, p.blackbox_ring),)
+                rec0 = rec0 + (bb0 if bb0 is not None
+                               else blackbox_mod.init_blackbox(
+                                   state, tracked, p.blackbox_ring),)
         else:
             rec0 = jnp.zeros((0,), jnp.float32)
         # per-round coord keys, folded off a salted key so the seeds the
         # KERNEL consumes are untouched by coords mode
-        ckeys = jax.random.split(jax.random.fold_in(key, 0x5EED), rounds)
+        ckeys = round_keys(jax.random.fold_in(key, 0x5EED),
+                           state.round_idx, rounds)
         coo0 = coo if with_coords else jnp.zeros((0,), jnp.float32)
         (args, scalars, t_final, acc, rec, coo_f), _ = jax.lax.scan(
             body, (args, scalars, state.t, acc0, rec0, coo0),
@@ -1091,6 +1129,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             res = res + (trace,)
         if with_bb:
             res = res + (bb_out,)
+        if carry:
+            res = res + (scalars,)
         return res[0] if len(res) == 1 else res
 
     if fault:
@@ -1098,9 +1138,10 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         # call without recompiling (the tensors are traced arguments)
         def run_fault(state: SimState, key: jax.Array,
                       cp: Optional[CompiledFaultPlan] = None,
-                      coo=None, topo=None, tracked=None):
+                      coo=None, topo=None, tracked=None,
+                      scalars0=None, bb0=None):
             return _run(state, key, cp if cp is not None else plan,
-                        coo, topo, tracked)
+                        coo, topo, tracked, scalars0, bb0)
 
         return run_fault
 
@@ -1110,7 +1151,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     seen_ok: list = [None]
 
     def run(state: SimState, key: jax.Array, coo=None, topo=None,
-            tracked=None):
+            tracked=None, scalars0=None, bb0=None):
         # the 8-array kernel carries no slow array: running it over a
         # state with residual slow nodes would silently drop their
         # degraded dynamics (the XLA paths honor state.slow regardless
@@ -1125,7 +1166,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     "slow-node model; use a SimParams with "
                     "slow_per_round>0 (10-array kernel) or the XLA "
                     "run_rounds for this state")
-        out = _run(state, key, None, coo, topo, tracked)
+        out = _run(state, key, None, coo, topo, tracked, scalars0,
+                   bb0)
         # cache the OUTPUT buffer: jit returns a fresh Array object even
         # for a passed-through input, so caching state.slow would never
         # hit on chained calls

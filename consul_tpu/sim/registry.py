@@ -274,6 +274,46 @@ SWEEP_INT_LEAVES = ("awareness_max", "confirmation_k",
                     "corroboration_k")
 
 
+# ----------------------------------------------------- checkpoint format
+#
+# Preemption-tolerant snapshots (sim/checkpoint.py): a checkpoint file
+# is MAGIC + header JSON + npz payload, and the header is a HOST/DEVICE
+# layout contract exactly like the flight columns — a loader decoding
+# yesterday's header schema against today's writer must fail loudly,
+# not misread offsets. The schema tuples below are folded into
+# ``layout_digest()`` (each checkpoint header also EMBEDS the digest,
+# so a stale-layout file refuses to load by name).
+
+#: on-disk checkpoint format version (bumped on any incompatible
+#: header/payload change; loaders refuse other versions by name)
+CHECKPOINT_VERSION = 1
+
+#: required header fields, in canonical order — the loader validates
+#: presence of every one before touching the payload
+CHECKPOINT_HEADER_FIELDS = (
+    "version",         # CHECKPOINT_VERSION
+    "engine",          # which runner family wrote it (xla/lanes/...)
+    "round_cursor",    # absolute round index of the snapshot boundary
+    "total_rounds",    # the interrupted run's intended total
+    "base_key",        # uint32 words of the run's base PRNG key
+    "layout_digest",   # registry.layout_digest() at write time
+    "params_digest",   # sim/checkpoint.params_digest(SimParams)
+    "params",          # the full SimParams field dict (refuse-by-name)
+    "plan_digest",     # faults.plan_digest or None (honest runs)
+    "arrays",          # payload array names (dtype/shape manifest)
+    "payload_sha256",  # checksum over the npz payload bytes
+)
+
+#: optional carry arrays a snapshot may ship beyond the SimState leaves
+#: — the engines' scan carries that a mid-run cut must capture to stay
+#: bitwise (sim/round._lane_scan docstrings): the reduced lane vector,
+#: the stale-scalar vector, the overlap schedule's in-flight pre-psum
+#: block table, the flight-trace prefix, the black-box rings, and the
+#: coords/topology pytrees
+CHECKPOINT_CARRIES = ("lanes", "scalars", "table", "flight",
+                      "blackbox", "coords", "topo")
+
+
 def flight_columns() -> tuple[str, ...]:
     """The full flight-trace row layout, in column order."""
     return FLIGHT_GAUGE_COLUMNS + STATS_FIELDS + FLIGHT_COORD_COLUMNS
@@ -293,7 +333,9 @@ def layout_digest() -> str:
                   tuple(f"{d}<-{','.join(deps)}"
                         for d, deps in SWEEP_DERIVED),
                   SWEEP_INT_LEAVES,
-                  FAULT_KINDS, BYZANTINE_FAULT_KINDS):
+                  FAULT_KINDS, BYZANTINE_FAULT_KINDS,
+                  (str(CHECKPOINT_VERSION),),
+                  CHECKPOINT_HEADER_FIELDS, CHECKPOINT_CARRIES):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

@@ -47,6 +47,35 @@ from consul_tpu.sim.state import (ALIVE, DEAD, INF, LEFT, SUSPECT, SimState,
 Reducer = Callable[[jnp.ndarray], jnp.ndarray]
 
 
+def round_keys(key: jax.Array, start, count: int) -> jax.Array:
+    """[count] per-round PRNG keys for ABSOLUTE rounds start..start+count-1.
+
+    Round r's key is ``fold_in(base_key, r)`` — a pure function of the
+    base key and the absolute round index, independent of how the run is
+    cut into calls. The historical schedule, ``jax.random.split(key,
+    rounds)``, bakes the SEGMENT LENGTH into every key (threefry counts
+    are ``iota(2*rounds)``, so ``split(k, R)[i] != split(k, r)[i]`` for
+    R != r), which made a run impossible to cut at a checkpoint and
+    resume bitwise. Every engine now derives its round keys here with
+    ``start = state.round_idx`` (a traced scalar — no per-offset
+    recompiles), so resume is: restore the state, pass the SAME base
+    key. Segment-invariance is pinned in tests/test_checkpoint.py."""
+    idx = jnp.asarray(start, jnp.int32) + jnp.arange(count,
+                                                     dtype=jnp.int32)
+    return jax.vmap(lambda r: jax.random.fold_in(key, r))(idx)
+
+
+def round_seeds(key: jax.Array, start, count: int) -> jnp.ndarray:
+    """[count] non-negative int32 kernel seeds for absolute rounds
+    start..start+count-1 — the Pallas engine's on-chip PRNG twin of
+    ``round_keys`` (same fold_in-keyed stream, same segment-invariance;
+    ``jax.random.randint`` over a (rounds,) shape had the same
+    length-dependence as split)."""
+    ks = round_keys(key, start, count)
+    bits = jax.vmap(lambda k: jax.random.bits(k, dtype=jnp.uint32))(ks)
+    return (bits >> 1).astype(jnp.int32)
+
+
 def _shrink(c: jnp.ndarray, p: SimParams) -> jnp.ndarray:
     """Normalized Lifeguard timeout shrink factor for c confirmations.
 
@@ -846,7 +875,8 @@ def _apply_lane_stats(s: SimState, lv: jnp.ndarray,
 def _lane_scan(state: SimState, keys: jax.Array, cp, p: SimParams,
                rounds: int, flight_every: Optional[int],
                with_plan: bool, lane_reducer, shard_offset, *,
-               overlap: bool = False, unroll: bool = False):
+               overlap: bool = False, unroll: bool = False,
+               lanes0=None, table0=None, return_carry: bool = False):
     """The lane engine's scan loop — ONE copy shared by the
     single-device runner (make_run_rounds_lanes) and every mesh shard
     (sim/mesh.shard_body), so the two paths cannot drift: only the
@@ -879,13 +909,30 @@ def _lane_scan(state: SimState, keys: jax.Array, cp, p: SimParams,
     consumes a synthetic table (lanes.seed_table) that yields exactly
     init_lanes' vector, so windows 1 AND 2 both start from the exact
     staged init. Flight recording is refused under overlap
-    (lanes.check_schedule) — rows need the synchronous reduction."""
+    (lanes.check_schedule) — rows need the synchronous reduction.
+
+    CHECKPOINT SEAM (``lanes0``/``table0``/``return_carry``): the scan
+    carry beyond the SimState — the reduced lane vector whose stale
+    scalars feed the next window, and under overlap the in-flight
+    pre-psum block table — is exactly what a mid-run cut must capture
+    to stay bitwise (init_lanes recomputes LIVE population scalars,
+    which are NOT the stale window-end lane sums the straight run's
+    next window would consume). ``return_carry`` appends that carry to
+    the return value; ``lanes0``/``table0`` re-inject a captured carry
+    so a resumed segment continues the straight run bit for bit.
+    ``table0`` is the GLOBAL pre-psum table (the shard tables' sum);
+    re-scattering it onto shard offset 0 only (lanes.carry_table — the
+    seed_table placement) keeps every fold exact on any device count,
+    which is what lets an 8-device checkpoint restore on 1 device.
+    Under overlap ``return_carry`` skips the drain fold — a resumed
+    chain finishes with ``drain_overlap``."""
     from consul_tpu.sim import flight
     from consul_tpu.sim import lanes as lanes_mod
 
     k = p.stale_k
     with_flight = flight_every is not None
-    lanes0 = init_lanes(state, p, lane_reducer)
+    if lanes0 is None:
+        lanes0 = init_lanes(state, p, lane_reducer)
     buf0 = (flight.empty_trace(rounds, flight_every) if with_flight
             else jnp.zeros((0,), jnp.float32))
     n_super, rem = divmod(rounds, k)
@@ -919,10 +966,20 @@ def _lane_scan(state: SimState, keys: jax.Array, cp, p: SimParams,
                                         with_plan, shard_offset)
             return (s2, lv_new, lane_reducer.partials(stack)), None
 
-        (final, _, table), _ = jax.lax.scan(
-            body,
-            (state, lanes0, lanes_mod.seed_table(lanes0, shard_offset)),
+        carry_table = (lanes_mod.seed_table(lanes0, shard_offset)
+                       if table0 is None
+                       else lanes_mod.carry_table(table0, shard_offset))
+        (final, lv_ready, table), _ = jax.lax.scan(
+            body, (state, lanes0, carry_table),
             win_keys, unroll=True if unroll else 1)
+        if return_carry:
+            # checkpoint cut: hand back the UNdrained carry — the
+            # resumed segment's first fold must consume this table, so
+            # draining here would double-count its stats. The table is
+            # returned GLOBAL (gather_table: identity on one device,
+            # one psum on the mesh — outside the scan, so the
+            # per-round collective budget is untouched).
+            return final, lv_ready, lane_reducer.gather_table(table)
         # drain: the last window's reduction must still land (stats
         # totals stay exact; the lane vector simply arrives after the
         # final round instead of one window later)
@@ -954,14 +1011,31 @@ def _lane_scan(state: SimState, keys: jax.Array, cp, p: SimParams,
         final = _apply_lane_stats(final, lv, p)
         if with_flight:
             buf, prev = record(buf, prev, final, lv, ph, rounds - 1)
-    return (final, buf) if with_flight else final
+    out = (final, buf) if with_flight else (final,)
+    if return_carry:
+        out = out + (lv,)
+    return out[0] if len(out) == 1 else out
+
+
+def drain_overlap(state: SimState, table: jnp.ndarray, p: SimParams,
+                  lane_reducer=None) -> SimState:
+    """Finish a checkpoint-cut overlap chain: fold the captured GLOBAL
+    in-flight table into the state's stats — the drain the straight
+    runner applies after its scan. Single-device fold (the table is
+    already global, so this is exact wherever the chain ran)."""
+    from consul_tpu.sim import lanes as lanes_mod
+
+    if lane_reducer is None:
+        lane_reducer = lanes_mod.reduce_lanes_single
+    return _apply_lane_stats(state, lane_reducer.fold(table), p)
 
 
 def make_run_rounds_lanes(p: SimParams, rounds: int,
                           flight_every: Optional[int] = None,
                           plan: Optional[CompiledFaultPlan] = None,
                           overlap: bool = False,
-                          unroll: bool = False):
+                          unroll: bool = False,
+                          carry: bool = False):
     """Single-device fused-lane runner: state, key -> state (or
     (state, trace) with `flight_every`). The exact engine the sharded
     mesh wraps — same scan, same shard-invariant PRNG, same block-table
@@ -972,7 +1046,17 @@ def make_run_rounds_lanes(p: SimParams, rounds: int,
     the [N]-row buffers update in place and the passed SimState must
     not be reused after the call. ``unroll`` fully unrolls the
     super-round scan — an HLO-audit knob (tests count the per-window
-    reductions in the unrolled text), not a perf setting."""
+    reductions in the unrolled text), not a perf setting.
+
+    Round keys are ``round_keys(key, state.round_idx, rounds)``: a
+    segment of the run is the same program as the whole run, which is
+    the checkpoint/resume contract. ``carry=True`` exposes the scan's
+    non-state carry (see _lane_scan's checkpoint seam): the runner
+    additionally returns the reduced lane vector (and under overlap
+    the undrained in-flight table), and accepts ``lanes0``/``table0``
+    to resume from a captured carry — a run cut at any super-round
+    boundary and resumed this way is BITWISE the uncut run
+    (tests/test_checkpoint.py)."""
     from consul_tpu.sim import lanes as lanes_mod
 
     lanes_mod.check_pool(p.n)
@@ -980,41 +1064,60 @@ def make_run_rounds_lanes(p: SimParams, rounds: int,
     with_plan = plan is not None
 
     @functools.partial(jax.jit, donate_argnums=0)
-    def _run(state: SimState, key: jax.Array, cp):
-        keys = jax.random.split(key, rounds)
+    def _run(state: SimState, key: jax.Array, cp, lanes0, table0):
+        keys = round_keys(key, state.round_idx, rounds)
         return _lane_scan(state, keys, cp, p, rounds, flight_every,
                           with_plan, lanes_mod.reduce_lanes_single, 0,
-                          overlap=overlap, unroll=unroll)
+                          overlap=overlap, unroll=unroll,
+                          lanes0=lanes0, table0=table0,
+                          return_carry=carry)
 
     def run(state: SimState, key: jax.Array,
-            cp: Optional[CompiledFaultPlan] = None):
+            cp: Optional[CompiledFaultPlan] = None,
+            lanes0=None, table0=None):
         if cp is not None and not with_plan:
             raise ValueError("this runner was built without a fault "
                              "plan; rebuild with plan= to inject one")
-        return _run(state, key, cp if cp is not None else plan)
+        if (lanes0 is not None or table0 is not None) and not carry:
+            raise ValueError("resume carries need a carry=True runner "
+                             "(the checkpoint seam is symmetric: what "
+                             "it returns is what it accepts)")
+        if table0 is not None and not overlap:
+            raise ValueError("table0 is the overlap schedule's "
+                             "in-flight carry; this runner is "
+                             "synchronous")
+        return _run(state, key, cp if cp is not None else plan,
+                    lanes0, table0)
 
     return run
 
 
-def make_run_rounds_fast(p: SimParams, rounds: int):
+def make_run_rounds_fast(p: SimParams, rounds: int,
+                         carry: bool = False):
     """Stale-scalar hot loop: state, key -> state (max throughput).
-    The input state is donated (updates in place)."""
+    The input state is donated (updates in place). ``carry=True``
+    exposes the stale-scalar vector (returned alongside the state,
+    accepted back as ``scalars0``) — the fast path's checkpoint seam:
+    init_scalars recomputes LIVE sums, not the one-round-stale carry a
+    straight run would consume next, so a bitwise mid-run cut must
+    capture it."""
 
     @functools.partial(jax.jit, donate_argnums=0)
     def run(state: SimState, key: jax.Array,
-            plan: Optional[CompiledFaultPlan] = None) -> SimState:
-        scalars = init_scalars(state, p)
+            plan: Optional[CompiledFaultPlan] = None, scalars0=None):
+        scalars = init_scalars(state, p) if scalars0 is None \
+            else scalars0
 
-        def body(carry, k):
-            s, sc = carry
+        def body(carry_in, k):
+            s, sc = carry_in
             fx = fault_frame(plan, s.round_idx) if plan is not None \
                 else None
             s2, sc2 = gossip_round_fast(s, sc, k, p, fx=fx)
             return (s2, sc2), None
 
-        keys = jax.random.split(key, rounds)
-        (final, _), _ = jax.lax.scan(body, (state, scalars), keys)
-        return final
+        keys = round_keys(key, state.round_idx, rounds)
+        (final, sc), _ = jax.lax.scan(body, (state, scalars), keys)
+        return (final, sc) if carry else final
 
     return run
 
@@ -1040,6 +1143,12 @@ def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
     with the round counter — phase boundaries are data, so the whole
     multi-phase program is ONE compilation (plan tensors are traced
     arguments, not static).
+
+    Round keys are the fold_in-keyed absolute-round stream
+    (``round_keys`` with ``state.round_idx`` as the offset): r₁ rounds
+    followed by R−r₁ rounds on the restored state IS the R-round run,
+    bitwise — the live-scalar engine's whole carry is the state, so a
+    checkpoint here is just the state plus the base key.
     """
 
     def body(carry, k):
@@ -1049,7 +1158,7 @@ def run_rounds(state: SimState, key: jax.Array, p: SimParams, rounds: int,
         out = s.informed[trace_node] if trace_node is not None else None
         return s, out
 
-    keys = jax.random.split(key, rounds)
+    keys = round_keys(key, state.round_idx, rounds)
     final, trace = jax.lax.scan(body, state, keys)
     return final, trace
 
@@ -1078,7 +1187,7 @@ def run_rounds_coords(state: SimState, coords, topo, key: jax.Array,
         # percentile sorts run unconditionally here by design
         return (s2, c2), coords_mod.coord_metrics(c2, topo, aux)
 
-    keys = jax.random.split(key, rounds)
+    keys = round_keys(key, state.round_idx, rounds)
     (final, cf), trace = jax.lax.scan(body, (state, coords), keys)
     return final, cf, trace
 
@@ -1100,7 +1209,7 @@ def run_rounds_stats(state: SimState, key: jax.Array, p: SimParams,
         s = gossip_round(carry, k, p, fx=fx)
         return s, s.stats
 
-    keys = jax.random.split(key, rounds)
+    keys = round_keys(key, state.round_idx, rounds)
     final, stats_trace = jax.lax.scan(body, state, keys)
     return final, stats_trace
 
@@ -1114,7 +1223,7 @@ def make_run_rounds(p: SimParams, rounds: int):
         def body(carry, k):
             return gossip_round(carry, k, p), None
 
-        keys = jax.random.split(key, rounds)
+        keys = round_keys(key, state.round_idx, rounds)
         final, _ = jax.lax.scan(body, state, keys)
         return final
 
@@ -1129,7 +1238,7 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
                       rounds: int, record_every: int = 1,
                       plan: Optional[CompiledFaultPlan] = None,
                       coords=None, topo=None, tracked=None,
-                      ring_len: Optional[int] = None):
+                      ring_len: Optional[int] = None, bb0=None):
     """Run `rounds` periods with the flight recorder riding the scan.
 
     Returns (final_state, trace) where trace is a
@@ -1153,6 +1262,16 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
     the final BlackboxState is appended to the return tuple. The
     tracked ids are traced DATA (one compile per K, any id set);
     `ring_len` defaults to p.blackbox_ring.
+
+    `bb0` (a BlackboxState) resumes the tracer from a captured ring
+    set instead of fresh rings — the checkpoint seam: a restored run
+    keeps appending to the interrupted run's rings (cursors, wrap
+    accounting and prev_* diff baselines included), so the decoded
+    timelines of a cut-and-resumed run are identical to the uncut
+    run's. Round keys are the fold_in-keyed absolute-round stream
+    (round_keys; offset = state.round_idx), so the dynamics — and the
+    trace rows, when the cut lands on a record_every boundary — splice
+    bitwise too.
     """
     from consul_tpu.sim import blackbox, flight
 
@@ -1160,9 +1279,10 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
         raise ValueError(
             "the flight recorder's counter columns ride the SimStats "
             "counters; build SimParams with collect_stats=True")
-    with_bb = tracked is not None
-    bb0 = blackbox.init_blackbox(
-        state, tracked, ring_len or p.blackbox_ring) if with_bb else None
+    with_bb = tracked is not None or bb0 is not None
+    if bb0 is None and with_bb:
+        bb0 = blackbox.init_blackbox(state, tracked,
+                                     ring_len or p.blackbox_ring)
 
     def body(carry, xs):
         s, c, buf, prev, bb = carry
@@ -1227,7 +1347,7 @@ def run_rounds_flight(state: SimState, key: jax.Array, p: SimParams,
                                             record_every, rec)
         return (s2, c2, buf, prev, bb), None
 
-    keys = jax.random.split(key, rounds)
+    keys = round_keys(key, state.round_idx, rounds)
     buf0 = flight.empty_trace(rounds, record_every)
     (final, cf, trace, _, bbf), _ = jax.lax.scan(
         body, (state, coords, buf0, state.stats, bb0),

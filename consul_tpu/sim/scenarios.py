@@ -16,6 +16,7 @@ other side always fail.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -241,7 +242,10 @@ BYZANTINE_CHAOS = ("forged_acks", "spurious_suspicion", "eclipse",
 
 def run_chaos(name: str, n: int = 4096, seed: int = 0,
               p: Optional[SimParams] = None,
-              blackbox: bool = False) -> dict[str, Any]:
+              blackbox: bool = False,
+              ckpt_dir: Optional[str] = None,
+              guard=None, resume: bool = False,
+              chunk: Optional[int] = None) -> dict[str, Any]:
     """Run ONE chaos class and report per-phase detection quality.
 
     Rides the flight recorder at stride 1: the one trace both feeds the
@@ -254,8 +258,19 @@ def run_chaos(name: str, n: int = 4096, seed: int = 0,
     same run, and folds the decoded per-event totals (plus the
     ring↔flight cross-check when the sample covers all of n) into the
     report under ``"blackbox"`` — the causal layer for asking WHY a
-    phase's false positives happened, not just how many."""
+    phase's false positives happened, not just how many.
+
+    PREEMPTION (`ckpt_dir`/`guard`/`resume` — sim/checkpoint.py): with
+    a checkpoint directory the run executes in consistent-cut chunks
+    through ``checkpoint.run_resumable`` — same dynamics BITWISE (the
+    fold_in-keyed round stream is segment-invariant) — saving a
+    rotating snapshot per chunk. A tripped guard returns a
+    ``{"preempted": True, ...}`` stub instead of a report; `resume`
+    restores from the newest loadable snapshot (falling back past a
+    torn last write) and the finished report equals an uninterrupted
+    run's."""
     from consul_tpu.sim import blackbox as blackbox_mod
+    from consul_tpu.sim import checkpoint as checkpoint_mod
     from consul_tpu.sim.metrics import blackbox_report
 
     plan = chaos_plans(n)[name]
@@ -265,10 +280,23 @@ def run_chaos(name: str, n: int = 4096, seed: int = 0,
     cp = compile_plan(plan, n)
     tracked = blackbox_mod.default_tracked(n, p.blackbox_k) \
         if blackbox else None
-    out = run_rounds_flight(init_state(n), jax.random.key(seed),
-                            p, plan.total_rounds, plan=cp,
-                            tracked=tracked)
-    (state, trace), bb = out[:2], (out[2] if blackbox else None)
+    if ckpt_dir or guard is not None:
+        rr = checkpoint_mod.run_resumable(
+            p, plan.total_rounds, jax.random.key(seed), engine="xla",
+            plan=cp, flight_every=1, tracked=tracked,
+            chunk=chunk, ckpt_dir=ckpt_dir, guard=guard,
+            resume=resume)
+        if rr.preempted:
+            return {"scenario": name, "n": n, "preempted": True,
+                    "rounds_done": rr.rounds_done,
+                    "rounds": plan.total_rounds,
+                    "checkpoint": rr.checkpoint_path}
+        state, trace, bb = rr.state, rr.trace, rr.blackbox
+    else:
+        out = run_rounds_flight(init_state(n), jax.random.key(seed),
+                                p, plan.total_rounds, plan=cp,
+                                tracked=tracked)
+        (state, trace), bb = out[:2], (out[2] if blackbox else None)
     tr = stats_from_trace(trace)
     return {
         "scenario": name, "n": n, "rounds": plan.total_rounds,
@@ -285,12 +313,49 @@ def run_chaos(name: str, n: int = 4096, seed: int = 0,
     }
 
 
-def run_chaos_suite(n: int = 4096, seed: int = 0) -> dict[str, Any]:
+def run_chaos_suite(n: int = 4096, seed: int = 0,
+                    ckpt_dir: Optional[str] = None,
+                    guard=None, resume: bool = False) -> dict[str, Any]:
     """Every chaos class once. The honest plans share one phase-count
     shape (one compilation); the byzantine classes carry the extra
-    adversarial tensors, so they share a second."""
-    return {name: run_chaos(name, n=n, seed=seed)
-            for name in chaos_plans(n)}
+    adversarial tensors, so they share a second.
+
+    With `ckpt_dir` the suite is preemption-tolerant two levels deep:
+    a ProgressManifest skips classes already completed (their reports
+    are replayed from the manifest) and the in-flight class's sim run
+    checkpoints per chunk in its own subdirectory — SIGTERM mid-suite
+    loses at most one chunk of one class. A tripped guard returns the
+    partial suite with ``"preempted"`` set."""
+    from consul_tpu.sim import checkpoint as checkpoint_mod
+
+    if not ckpt_dir and guard is None:
+        return {name: run_chaos(name, n=n, seed=seed)
+                for name in chaos_plans(n)}
+    manifest = (checkpoint_mod.ProgressManifest(
+        ckpt_dir, config={"mode": "chaos", "n": n, "seed": seed})
+        if ckpt_dir else None)
+    out: dict[str, Any] = {}
+    for name in chaos_plans(n):
+        # completed classes replay ONLY under resume=True — a plain
+        # --ckpt-dir run must re-measure, matching the --mesh/--sweep
+        # rung semantics (a stale manifest must never masquerade as a
+        # fresh measurement)
+        if manifest is not None and resume and manifest.done(name):
+            out[name] = manifest.result(name)
+            continue
+        rep = run_chaos(
+            name, n=n, seed=seed,
+            ckpt_dir=(os.path.join(ckpt_dir, name) if ckpt_dir
+                      else None),
+            guard=guard, resume=resume)
+        if rep.get("preempted"):
+            out[name] = rep
+            out["preempted"] = name
+            return out
+        out[name] = rep
+        if manifest is not None:
+            manifest.mark(name, rep)
+    return out
 
 
 # ------------------------------------------------- byzantine defense

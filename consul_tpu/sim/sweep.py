@@ -64,7 +64,7 @@ from consul_tpu.sim import lanes as lanes_mod
 from consul_tpu.sim.params import (GridSpec, SimParams, TracedParams,
                                    _point_param, grid_params,
                                    point_params)
-from consul_tpu.sim.round import _lane_scan, gossip_round
+from consul_tpu.sim.round import _lane_scan, gossip_round, round_keys
 from consul_tpu.sim.state import SimState, init_state
 
 ENGINES = ("xla", "lanes", "pallas")
@@ -311,7 +311,11 @@ def make_run_sweep(p: SimParams, rounds: int, *,
     def _run(tp: TracedParams, key: jax.Array, cp):
         g = tp.grid_shape[0]
         states = _broadcast_state(p, g)
-        keys = jax.random.split(key, rounds)
+        # the fold_in-keyed absolute-round stream (round.round_keys):
+        # the SAME keys the static engines draw from a fresh state, so
+        # sweep-vs-static bitwise conformance survives the PR 9
+        # checkpointable key schedule
+        keys = round_keys(key, 0, rounds)
         if coords:
             from consul_tpu.sim.coords import init_coords
 
@@ -351,7 +355,7 @@ def make_run_point(p: SimParams, rounds: int, *,
 
     @jax.jit
     def _run(tp: TracedParams, key: jax.Array, cp):
-        keys = jax.random.split(key, rounds)
+        keys = round_keys(key, 0, rounds)
         c0 = None
         if coords:
             from consul_tpu.sim.coords import init_coords

@@ -58,6 +58,12 @@ class CheckStatus(str, enum.Enum):
 #: structs.SerfCheckID / "serfHealth" in leader_registrator_v1.go).
 SERF_CHECK_ID = "serfHealth"
 SERF_CHECK_NAME = "Serf Health Status"
+#: the service name every server registers under (reference:
+#: structs.ConsulServiceName, agent/consul/leader_registrator_v1.go:45)
+#: — what makes `consul.service.consul` DNS bootstrap discovery work
+#: and gives a fresh agent a non-empty catalog
+CONSUL_SERVICE_ID = "consul"
+CONSUL_SERVICE_NAME = "consul"
 
 
 def new_node_id() -> str:

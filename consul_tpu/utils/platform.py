@@ -1,0 +1,50 @@
+"""Accelerator-platform normalization — ONE copy of the plugin probe.
+
+The documented platform names are "cpu"/"tpu"/"gpu", but this image
+family registers its accelerator under varying plugin names (a real
+TPU image registers "tpu"; tunneled images register e.g. "axon").
+Pinning jax to the literal string "tpu" on such an image does not
+error — libtpu blocks forever in C waiting for a device that is not
+there (the VERDICT r5 hang). The fix is to resolve the alias BEFORE
+the pin by probing jax's backend-factory registry: the authoritative
+list of what THIS install can actually initialize, unlike a
+JAX_PLATFORMS env var someone may have left unset or stale.
+
+tests/conftest.py and `agent -dev -gossip-sim` (consul_tpu/cli.py)
+both consume this; keeping the probe here (no jax import at module
+scope, no heavy package imports) lets conftest use it before any
+backend initializes.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: plugin names that are never "the accelerator" for the tpu alias
+_NON_ACCEL = frozenset(
+    {"cpu", "gpu", "cuda", "rocm", "metal", "interpreter"})
+
+
+def normalize_platform(requested: str) -> str:
+    """Map the documented "tpu" alias to this image's registered
+    accelerator plugin; every other name passes through unchanged.
+
+    Probes the registration dict, NOT ``xla_bridge.backends()`` —
+    probing must not initialize any backend before the caller's
+    platform pin takes effect. Falls back to the JAX_PLATFORMS hint
+    only if jax's internals moved."""
+    if requested != "tpu":
+        return requested
+    try:
+        from jax._src import xla_bridge
+
+        registered = set(xla_bridge._backend_factories)
+    except Exception:  # noqa: BLE001 — jax internals moved
+        hint = os.environ.get("JAX_PLATFORMS", "")
+        return hint if hint and hint != "cpu" else requested
+    if "tpu" in registered:
+        return "tpu"
+    # no native tpu plugin: pick the image's (single) non-CPU/GPU
+    # accelerator plugin — e.g. the tunnel backend
+    accel = sorted(registered - _NON_ACCEL)
+    return accel[0] if accel else requested

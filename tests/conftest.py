@@ -20,37 +20,14 @@ import os
 # documented command works on any image.
 _PLATFORM = os.environ.get("CONSUL_TPU_TEST_PLATFORM", "cpu")
 
-
-def _normalize_tpu(requested: str) -> str:
-    """Map the documented "tpu" alias to this image's registered
-    accelerator plugin. Probes jax's backend-factory registry (the
-    authoritative list of what THIS install can initialize); falls
-    back to the env-var hint only if the probe itself is unavailable
-    on some future jax."""
-    if requested != "tpu":
-        return requested
-    try:
-        # the registration dict, NOT xla_bridge.backends(): probing
-        # must not initialize any backend before the platform pin
-        # below takes effect
-        from jax._src import xla_bridge
-
-        registered = set(xla_bridge._backend_factories)
-    except Exception:  # noqa: BLE001 — jax internals moved
-        hint = os.environ.get("JAX_PLATFORMS", "")
-        return hint if hint and hint != "cpu" else requested
-    if "tpu" in registered:
-        return "tpu"
-    # no native tpu plugin: pick the image's (single) non-CPU/GPU
-    # accelerator plugin — e.g. the tunnel backend
-    accel = sorted(registered
-                   - {"cpu", "gpu", "cuda", "rocm", "metal",
-                      "interpreter"})
-    return accel[0] if accel else requested
-
+# ONE copy of the plugin-probing normalization, shared with the CLI's
+# `-gossip-sim` platform pin (consul_tpu/utils/platform.py — importing
+# it touches neither jax nor any backend, so the pin below still lands
+# first)
+from consul_tpu.utils.platform import normalize_platform  # noqa: E402
 
 if _PLATFORM == "tpu":
-    _PLATFORM = _normalize_tpu(_PLATFORM)
+    _PLATFORM = normalize_platform(_PLATFORM)
 
 os.environ["JAX_PLATFORMS"] = _PLATFORM
 if _PLATFORM == "cpu":

@@ -1,0 +1,494 @@
+"""Preemption-tolerant checkpoint/resume (sim/checkpoint.py).
+
+The bitwise contract, pinned per engine: run R rounds straight ==
+run r₁ rounds, checkpoint to a FILE, restore, run R−r₁ — state, stats,
+flight trace, black-box rings — at stale_k ∈ {1, 4}, under the overlap
+schedule, under an armed FaultPlan mid-phase, and across device counts
+(8-device mesh checkpoint → 1-device restore). Plus the adversarial
+file cases (torn/corrupt/stale-layout/wrong-params/wrong-plan refused
+by name, keep-last-k rotation) and the crash-injection subprocess
+tests (SIGKILL → torn-fallback → bitwise finish; SIGTERM → documented
+PREEMPTED_RC + valid JSON).
+
+Everything here runs tier-1 on CPU with small pools — the fast
+round-trip IS the per-PR enforcement of the bitwise guarantee.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.faults import FaultPlan, Phase, Partition, compile_plan
+from consul_tpu.sim import SimParams, init_state, registry, run_rounds
+from consul_tpu.sim import checkpoint as ck
+from consul_tpu.sim.round import (drain_overlap, make_run_rounds_lanes,
+                                  round_keys, round_seeds)
+
+#: the shared full-model config (small: this file is tier-1)
+P = SimParams(n=256, loss=0.05, tcp_fallback=False, fail_per_round=0.01,
+              rejoin_per_round=0.05, slow_per_round=0.01)
+KEY = jax.random.key(42)
+
+
+def _eq(a, b, what=""):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        na, nb = np.asarray(la), np.asarray(lb)
+        # shapes too: assert_array_equal broadcasts, which would let a
+        # () leaf restored as (1,) slip through
+        assert na.shape == nb.shape, (what, na.shape, nb.shape)
+        np.testing.assert_array_equal(na, nb, err_msg=what)
+
+
+def _digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state)):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()[:16]
+
+
+# ------------------------------------------------- key-stream contract
+
+
+def test_round_keys_segment_invariant():
+    """The whole design rests on this: the per-round key/seed streams
+    are pure functions of (base key, ABSOLUTE round) — any segmentation
+    draws the same values. jax.random.split/randint do NOT have this
+    property (their counts depend on the segment length), which is why
+    the engines moved off them in this PR."""
+    k = jax.random.key(7)
+    full = jax.random.key_data(round_keys(k, 0, 20))
+    tail = jax.random.key_data(round_keys(k, 5, 15))
+    np.testing.assert_array_equal(np.asarray(full)[5:], np.asarray(tail))
+    s_full = np.asarray(round_seeds(k, 0, 20))
+    s_tail = np.asarray(round_seeds(k, 12, 8))
+    np.testing.assert_array_equal(s_full[12:], s_tail)
+    assert (s_full >= 0).all()
+    # and split really is NOT segment-invariant (the property is not
+    # vacuous): if jax ever changes this, the comment above is stale
+    a = np.asarray(jax.random.key_data(jax.random.split(k, 20)))[:5]
+    b = np.asarray(jax.random.key_data(jax.random.split(k, 5)))
+    assert not np.array_equal(a, b)
+
+
+# ------------------------------------------- bitwise resume, per engine
+
+
+def test_xla_engine_file_roundtrip_bitwise(tmp_path):
+    """run_rounds: straight 30 == 12 + save-to-file + load + 18. The
+    live-scalar engine's whole carry is the state, so the snapshot is
+    state + base key."""
+    full, _ = run_rounds(init_state(P.n), KEY, P, 30)
+    seg, _ = run_rounds(init_state(P.n), KEY, P, 12)
+    snap = ck.snapshot(P, KEY, seg, engine="xla", total_rounds=30)
+    path = ck.save(str(tmp_path), snap)
+    loaded = ck.load(path, p=P)
+    assert loaded.round_cursor == 12 and loaded.total_rounds == 30
+    res, _ = run_rounds(loaded.state(), loaded.key(), P, 18)
+    _eq(full, res, "xla resume")
+
+
+@pytest.mark.parametrize("stale_k", [1, 4])
+def test_lanes_engine_file_roundtrip_bitwise(tmp_path, stale_k):
+    """The lane engine at stale_k ∈ {1, 4}: the snapshot must carry the
+    reduced lane vector (stale scalars for the next window) — and does;
+    resume from the FILE is bitwise the straight run. The stale_k=4
+    case also runs the NEGATIVE control: resuming from the state alone
+    (letting init_lanes recompute LIVE scalars) diverges — the
+    captured lane vector is load-bearing, not ceremony."""
+    p = P.with_(stale_k=stale_k)
+    full = make_run_rounds_lanes(p, 32)(init_state(p.n), KEY)
+    r1 = make_run_rounds_lanes(p, 16, carry=True)
+    s, lv = r1(init_state(p.n), KEY)
+    snap = ck.snapshot(p, KEY, s, engine="lanes", total_rounds=32,
+                       lanes=lv)
+    path = ck.save(str(tmp_path), snap)
+    loaded = ck.load(path, p=p)
+    s2, _ = r1(loaded.state(), loaded.key(), lanes0=loaded.lanes())
+    _eq(full, s2, f"lanes stale_k={stale_k} resume")
+    if stale_k == 4:
+        bad, _ = r1(loaded.state(), loaded.key())  # lanes0 dropped
+        leaves_full = [np.asarray(x) for x in jax.tree.leaves(full)]
+        leaves_bad = [np.asarray(x) for x in jax.tree.leaves(bad)]
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(leaves_full, leaves_bad)), \
+            "dropping the lane carry should have diverged the run"
+
+
+def test_overlap_engine_file_roundtrip_bitwise(tmp_path):
+    """The overlap schedule's extra carry — the in-flight pre-psum
+    block table — rides the snapshot; the resumed chain finishes with
+    drain_overlap and equals the straight (self-draining) run."""
+    p = P.with_(stale_k=2)
+    full = make_run_rounds_lanes(p, 32, overlap=True)(
+        init_state(p.n), KEY)
+    r1 = make_run_rounds_lanes(p, 16, overlap=True, carry=True)
+    s, lv, table = r1(init_state(p.n), KEY)
+    snap = ck.snapshot(p, KEY, s, engine="lanes", total_rounds=32,
+                       lanes=lv, table=table)
+    path = ck.save(str(tmp_path), snap)
+    loaded = ck.load(path, p=p)
+    s2, lv2, t2 = r1(loaded.state(), loaded.key(),
+                     lanes0=loaded.lanes(), table0=loaded.table())
+    s2 = drain_overlap(s2, t2, p)
+    _eq(full, s2, "overlap resume")
+
+
+def test_fault_plan_resume_mid_phase_bitwise(tmp_path):
+    """Cut INSIDE an armed plan's fault phase: the phase position rides
+    state.round_idx (fault_frame indexes the per-phase tensors with
+    it), the snapshot binds the plan's digest, and resume under the
+    same compiled plan is bitwise — while a DIFFERENT plan refuses by
+    digest."""
+    from consul_tpu.faults import active_phase
+
+    n = P.n
+    plan = FaultPlan(phases=(
+        Phase(rounds=8, name="warmup"),
+        Phase(rounds=16, faults=(Partition(a=(0, 32), b=(32, n)),),
+              name="cut"),
+        Phase(rounds=8, name="heal")))
+    cp = compile_plan(plan, n)
+    p = P.with_(stale_k=2)  # k-coverage lives in the lanes pins above
+    full = make_run_rounds_lanes(p, 32, plan=cp)(init_state(n), KEY)
+    r1 = make_run_rounds_lanes(p, 16, plan=cp, carry=True)
+    s, lv = r1(init_state(n), KEY)
+    # the cut lands mid-"cut"-phase; the restored cursor re-derives the
+    # correct phase tensor row
+    assert int(active_phase(cp, s.round_idx)) == 1
+    snap = ck.snapshot(p, KEY, s, engine="lanes", total_rounds=32,
+                       lanes=lv, plan=cp)
+    path = ck.save(str(tmp_path), snap)
+    loaded = ck.load(path, p=p, plan=cp)
+    assert int(active_phase(cp, loaded.state().round_idx)) == 1
+    s2, _ = r1(loaded.state(), loaded.key(), lanes0=loaded.lanes())
+    _eq(full, s2, "armed-plan resume")
+    # wrong plan: refused by digest, by name
+    other = compile_plan(FaultPlan(phases=(
+        Phase(rounds=8, name="warmup"),
+        Phase(rounds=16, faults=(Partition(a=(0, 64), b=(64, n)),),
+              name="cut"),
+        Phase(rounds=8, name="heal"))), n)
+    with pytest.raises(ck.CheckpointError, match="fault-plan digest"):
+        ck.load(path, p=p, plan=other)
+    # honest resume of an armed-plan checkpoint: also refused
+    with pytest.raises(ck.CheckpointError, match="fault-plan digest"):
+        ck.load(path, p=p, plan=None)
+
+
+def test_mesh_checkpoint_restores_on_single_device(tmp_path, devices8):
+    """The resharding pin: checkpoint on an 8-device mesh, restore the
+    snapshot on ONE device — bitwise the single-device straight run
+    (the lane engine's shard-invariant PRNG + block-table reduction
+    make the carry device-count-free; snapshotting gathers the sharded
+    state through device_get)."""
+    from consul_tpu.sim.mesh import (init_sharded_state, make_mesh,
+                                     make_sharded_run)
+
+    p = P.with_(stale_k=2)
+    full = make_run_rounds_lanes(p, 32)(init_state(p.n), KEY)
+    mesh = make_mesh(devices8[:8])
+    m1 = make_sharded_run(p, 16, mesh, carry=True)
+    s, lv = m1(init_sharded_state(p.n, mesh), KEY)
+    snap = ck.snapshot(p, KEY, s, engine="lanes", total_rounds=32,
+                       lanes=lv)
+    path = ck.save(str(tmp_path), snap)
+    loaded = ck.load(path, p=p)
+    r2 = make_run_rounds_lanes(p, 16, carry=True)
+    s2, _ = r2(loaded.state(), loaded.key(), lanes0=loaded.lanes())
+    _eq(full, s2, "mesh->single resume")
+
+
+def test_flight_and_blackbox_resume_exact():
+    """run_rounds_flight with rings armed: the spliced trace equals the
+    straight trace row for row, and the resumed BlackboxState keeps the
+    interrupted run's rings/cursors so decoded timelines are identical
+    (bb0 re-injection)."""
+    from consul_tpu.sim.blackbox import decode_timeline, default_tracked
+    from consul_tpu.sim.round import run_rounds_flight
+
+    tracked = default_tracked(P.n, 16)
+    sf, trf, bbf = run_rounds_flight(init_state(P.n), KEY, P, 16,
+                                     record_every=4, tracked=tracked)
+    s1, tr1, bb1 = run_rounds_flight(init_state(P.n), KEY, P, 8,
+                                     record_every=4, tracked=tracked)
+    s2, tr2, bb2 = run_rounds_flight(s1, KEY, P, 8, record_every=4,
+                                     bb0=bb1)
+    np.testing.assert_array_equal(
+        np.asarray(trf),
+        np.concatenate([np.asarray(tr1), np.asarray(tr2)]))
+    _eq(sf, s2, "flight resume state")
+    assert decode_timeline(bbf) == decode_timeline(bb2)
+
+
+def test_run_resumable_chunked_equals_straight():
+    """The chunked driver (what the benches use) is bitwise the
+    one-call run, flight splice included."""
+    from consul_tpu.sim.round import run_rounds_flight
+
+    p = P.with_(stale_k=2)
+    sf, trf = run_rounds_flight(init_state(p.n), jax.random.key(0), p,
+                                16, record_every=2)
+    rr = ck.run_resumable(p, 16, jax.random.key(0), engine="xla",
+                          flight_every=2, chunk=8)
+    _eq(sf, rr.state, "run_resumable state")
+    np.testing.assert_array_equal(np.asarray(trf), rr.trace)
+    assert rr.rounds_done == 16 and not rr.preempted
+
+
+# --------------------------------------------- adversarial file cases
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir_two(tmp_path_factory):
+    """ONE compiled 8-round chunk run feeding every file-guard test:
+    a directory with checkpoints at cursors 8 and 16 (tests that
+    tamper copy the files into their own tmp_path)."""
+    d = tmp_path_factory.mktemp("guards")
+    r = make_run_rounds_lanes(P, 8, carry=True)
+    s, lv = r(init_state(P.n), KEY)
+    ck.save(str(d), ck.snapshot(P, KEY, s, engine="lanes",
+                                total_rounds=24, lanes=lv))
+    s, lv = r(s, KEY, lanes0=lv)
+    ck.save(str(d), ck.snapshot(P, KEY, s, engine="lanes",
+                                total_rounds=24, lanes=lv))
+    return d
+
+
+def _copy_ckpts(src_dir, dst_dir):
+    import shutil
+
+    out = []
+    for name in sorted(os.listdir(src_dir)):
+        if name.endswith(ck.SUFFIX):
+            out.append(shutil.copy(os.path.join(src_dir, name),
+                                   dst_dir))
+    return [str(p) for p in out]
+
+
+def test_truncated_checkpoint_rejected_then_fallback(tmp_path,
+                                                     ckpt_dir_two):
+    p1, p2 = _copy_ckpts(ckpt_dir_two, tmp_path)
+    with open(p2, "r+b") as f:
+        f.truncate(os.path.getsize(p2) // 2)
+    with pytest.raises(ck.CheckpointError, match="checksum|truncated"):
+        ck.load(p2, p=P)
+    snap = ck.latest(str(tmp_path), p=P)
+    assert snap is not None and snap.round_cursor == 8
+    assert snap.fallbacks == [p2]
+
+
+def test_resume_never_silently_starts_over(tmp_path, ckpt_dir_two):
+    """The refuse-by-name guards hold on the RESUME path, not just on
+    direct load(): a mismatch (changed params) propagates out of
+    latest()/run_resumable instead of being treated as a torn-file
+    fallback — silently starting a fresh run would both lie about
+    resuming and rotate the interrupted run's snapshots away. And a
+    directory where EVERY checkpoint is torn refuses too."""
+    paths = _copy_ckpts(ckpt_dir_two, tmp_path)
+    with pytest.raises(ck.CheckpointMismatch, match="loss"):
+        ck.latest(str(tmp_path), p=P.with_(loss=0.2))
+    with pytest.raises(ck.CheckpointMismatch, match="loss"):
+        ck.run_resumable(P.with_(loss=0.2), 24, KEY, engine="lanes",
+                         chunk=8, ckpt_dir=str(tmp_path), resume=True)
+    # a file torn down to the bare magic name must read as TORN
+    # (fallback), not crash the walk with an IndexError
+    with open(paths[1], "r+b") as f:
+        f.truncate(len(ck.MAGIC) - 1)
+    snap = ck.latest(str(tmp_path), p=P)
+    assert snap.round_cursor == 8 and snap.fallbacks == [paths[1]]
+    # every file torn: loud refusal, never a quiet fresh start
+    with open(paths[0], "r+b") as f:
+        f.truncate(4)
+    with pytest.raises(ck.CheckpointError, match="every checkpoint"):
+        ck.latest(str(tmp_path), p=P)
+
+
+def test_corrupted_payload_rejected_by_checksum(tmp_path,
+                                                ckpt_dir_two):
+    path = _copy_ckpts(ckpt_dir_two, tmp_path)[0]
+    blob = bytearray(open(path, "rb").read())
+    blob[-3] ^= 0xFF  # flip one payload bit
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(ck.CheckpointError, match="checksum"):
+        ck.load(path, p=P)
+
+
+def test_params_mismatch_refused_by_name(ckpt_dir_two):
+    path = os.path.join(ckpt_dir_two,
+                        sorted(os.listdir(ckpt_dir_two))[0])
+    with pytest.raises(ck.CheckpointError) as ei:
+        ck.load(path, p=P.with_(loss=0.2, stale_k=4))
+    msg = str(ei.value)
+    assert "loss" in msg and "stale_k" in msg
+
+
+def test_stale_layout_digest_refused(tmp_path, ckpt_dir_two):
+    """A checkpoint whose embedded layout digest differs from the
+    current registry refuses to load — the file's arrays no longer
+    decode under a drifted layout."""
+    path = _copy_ckpts(ckpt_dir_two, tmp_path)[0]
+    blob = open(path, "rb").read()
+    cur = registry.layout_digest().encode()
+    assert blob.count(cur) == 1  # the header embeds it exactly once
+    open(path, "wb").write(blob.replace(cur, b"0" * 16))
+    with pytest.raises(ck.CheckpointError, match="layout digest"):
+        ck.load(path, p=P)
+
+
+def test_format_version_refused(tmp_path, ckpt_dir_two):
+    path = _copy_ckpts(ckpt_dir_two, tmp_path)[0]
+    blob = bytearray(open(path, "rb").read())
+    blob[len(ck.MAGIC) - 1] = registry.CHECKPOINT_VERSION + 1
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(ck.CheckpointMismatch, match="format version"):
+        ck.load(path, p=P)
+
+
+def test_keep_last_k_rotation(tmp_path):
+    runner = make_run_rounds_lanes(P, 4, carry=True)
+    s, lv = runner(init_state(P.n), KEY)
+    for _ in range(5):
+        snap = ck.snapshot(P, KEY, s, engine="lanes", total_rounds=64,
+                           lanes=lv)
+        ck.save(str(tmp_path), snap, keep_last=3)
+        s, lv = runner(s, KEY, lanes0=lv)
+    names = sorted(f for f in os.listdir(tmp_path)
+                   if f.endswith(ck.SUFFIX))
+    assert len(names) == 3
+    # saves landed at cursors 4,8,12,16,20 — the newest three survive
+    assert names == ["ckpt-r0000000012.ckpt", "ckpt-r0000000016.ckpt",
+                     "ckpt-r0000000020.ckpt"]
+
+
+def test_registry_digest_covers_checkpoint_schema(monkeypatch):
+    """The drift test the CI satellite asks for: the pinned layout
+    digest must move when the checkpoint header schema moves, so a
+    schema change forces the loader + this file to be revisited."""
+    base = registry.layout_digest()
+    monkeypatch.setattr(registry, "CHECKPOINT_HEADER_FIELDS",
+                        registry.CHECKPOINT_HEADER_FIELDS + ("extra",))
+    assert registry.layout_digest() != base
+    monkeypatch.undo()
+    assert registry.layout_digest() == base
+    monkeypatch.setattr(registry, "CHECKPOINT_VERSION", 99)
+    assert registry.layout_digest() != base
+    monkeypatch.undo()
+    monkeypatch.setattr(registry, "CHECKPOINT_CARRIES",
+                        registry.CHECKPOINT_CARRIES[1:])
+    assert registry.layout_digest() != base
+
+
+# ------------------------------------------------- crash injection
+
+
+def _spawn(ckpt_dir, *extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "consul_tpu.sim.checkpoint",
+         "--ckpt-dir", str(ckpt_dir), "--n", "256", "--rounds", "48",
+         "--chunk", "12", "--stale-k", "2", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait_ckpts(ckpt_dir, k, proc, timeout=120.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        n = len([f for f in os.listdir(ckpt_dir)
+                 if f.endswith(ck.SUFFIX)]) if os.path.isdir(ckpt_dir) \
+            else 0
+        if n >= k:
+            return
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"driver exited rc={proc.returncode} before writing "
+                f"{k} checkpoints")
+        time.sleep(0.05)
+    raise AssertionError("timed out waiting for checkpoints")
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _straight_digest() -> str:
+    p = SimParams(n=256, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.01, rejoin_per_round=0.05,
+                  stale_k=2)
+    final = make_run_rounds_lanes(p, 48)(init_state(p.n),
+                                         jax.random.key(0))
+    return _digest(final)
+
+
+def test_crash_injection_sigkill_torn_fallback_bitwise(tmp_path):
+    """The acceptance scenario end to end: SIGKILL a subprocess
+    mid-run, tear its newest checkpoint (atomic rename means a SIGKILL
+    itself cannot tear one — we simulate the non-atomic-storage torn
+    write the checksum exists for), resume — the loader detects the
+    torn file, falls back to the previous checkpoint, and the finished
+    run's state is bitwise an uninterrupted run's."""
+    d = tmp_path / "ck"
+    proc = _spawn(d, "--sleep", "0.3")
+    try:
+        _wait_ckpts(d, 2, proc)
+        proc.kill()  # SIGKILL: no handler, no save, no cleanup
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == -signal.SIGKILL
+    names = sorted(f for f in os.listdir(d) if f.endswith(ck.SUFFIX))
+    assert len(names) >= 2
+    newest = os.path.join(d, names[-1])
+    with open(newest, "r+b") as f:  # torn-storage simulation
+        f.truncate(os.path.getsize(newest) * 2 // 3)
+    p = SimParams(n=256, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.01, rejoin_per_round=0.05,
+                  stale_k=2)
+    rr = ck.run_resumable(p, 48, seed=0, engine="lanes", chunk=12,
+                          ckpt_dir=str(d), resume=True)
+    assert rr.fallbacks == [newest], "must fall back past the torn file"
+    assert rr.resumed_from is not None \
+        and rr.resumed_from < int(names[-1][6:16].lstrip("0") or 0) + 1
+    assert rr.rounds_done == 48
+    assert _digest(rr.state) == _straight_digest()
+
+
+def test_crash_injection_sigterm_preempted_rc_and_resume(tmp_path):
+    """SIGTERM: the guard saves at the next super-round boundary, the
+    driver prints valid JSON with preempted=true, and exits with the
+    documented PREEMPTED_RC; a --resume invocation finishes the run
+    with the straight run's exact state digest."""
+    d = tmp_path / "ck"
+    proc = _spawn(d, "--sleep", "0.3")
+    try:
+        _wait_ckpts(d, 1, proc)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == ck.PREEMPTED_RC, out
+    rep = json.loads(out.decode().strip().splitlines()[-1])
+    assert rep["preempted"] is True
+    assert rep["rounds_done"] < 48 and rep["checkpoint"]
+    # resume HERE — a process that never wrote those checkpoints (the
+    # fresh-process restore proof, without a third jax interpreter):
+    # bitwise the straight run
+    p = SimParams(n=256, loss=0.05, tcp_fallback=False,
+                  fail_per_round=0.01, rejoin_per_round=0.05,
+                  stale_k=2)
+    rr = ck.run_resumable(p, 48, seed=0, engine="lanes", chunk=12,
+                          ckpt_dir=str(d), resume=True)
+    assert rr.resumed_from == rep["rounds_done"]
+    assert rr.rounds_done == 48
+    assert _digest(rr.state) == _straight_digest()

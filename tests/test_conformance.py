@@ -525,3 +525,30 @@ def test_views_mf_smoke_fast():
     _assert_ratio(mfr["susp"], vwr["susp"], 2.5, "suspicion rate")
     _assert_ratio(mfr["ref"], vwr["ref"], 2.5, "refute rate")
     _assert_fp_criterion(mfr, vwr)
+
+
+def test_bench_kv_headline_refuses_unstable_ratios():
+    """bench_kv's median+IQR headline gate (VERDICT next #3): the
+    vs_baseline ratio prints only from >= 3 in-process samples whose
+    IQR/median sits inside the stated stability band — a noisy host
+    or a single quiet-host sample can no longer mint a claim."""
+    import bench_kv
+
+    # stable: tight samples -> median + ratio
+    out = bench_kv._headline([1000.0, 1010.0, 990.0, 1005.0],
+                             baseline=2000.0)
+    assert out["value"] == 1002.5
+    assert out["vs_baseline"] == round(1002.5 / 2000.0, 3)
+    assert out["iqr_over_median"] <= bench_kv.STABILITY_BAND
+    assert "unstable" not in out
+
+    # noisy: spread beyond the band -> ratio refused, reason stated
+    out = bench_kv._headline([600.0, 1000.0, 1400.0], baseline=2000.0)
+    assert out["vs_baseline"] is None
+    assert "exceeds" in out["unstable"]
+    assert out["stability_band"] == bench_kv.STABILITY_BAND
+
+    # too few samples: no spread estimate, no ratio
+    out = bench_kv._headline([1000.0], baseline=2000.0)
+    assert out["vs_baseline"] is None and out["iqr"] is None
+    assert "3 in-process samples" in out["unstable"]

@@ -246,3 +246,24 @@ def test_megakernel_flight_rows_on_call_boundaries():
         want = float(np.asarray(jax.device_get(getattr(final.stats, f))))
         assert float(cols[f].sum()) == pytest.approx(want), f
     assert 0.5 < cols["live_frac"][-1] <= 1.0
+
+
+@tpu_only
+def test_pallas_resume_from_scalars_carry_bitwise():
+    """The Pallas checkpoint seam: 16 straight rounds == 8 + 8 resumed
+    from the captured stale-scalar carry (carry=True / scalars0=) —
+    the kernel's fold_in-keyed seed stream (round.round_seeds) is
+    segment-invariant, so the on-chip draws line up seed for seed."""
+    import numpy as np
+
+    from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+    p = SimParams(n=262_144, loss=0.02, tcp_fallback=False,
+                  collect_stats=True)
+    key = jax.random.key(5)
+    full = make_run_rounds_pallas(p, 16)(init_state(p.n), key)
+    r8 = make_run_rounds_pallas(p, 8, carry=True)
+    s, sc = r8(init_state(p.n), key)
+    s2, _ = r8(s, key, scalars0=sc)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -150,10 +150,14 @@ def test_run_rounds_bit_identical_pinned_seed():
     slow nodes, Lifeguard, stats). CPU-only — the pin is this image's
     XLA:CPU lowering.
 
-    PR 8 re-pin: SimStats appended two always-zero attack-attribution
-    counters (extra zero leaves in the hash), so the full-tree digest
-    moved; the DYNAMICS arrays are pinned separately below and are
-    unchanged from the pre-byzantine engine (b49a7c76f4b9908b)."""
+    PR 9 re-pin (both values): the per-round PRNG schedule moved from
+    split(key, rounds) — which bakes the RUN LENGTH into every key —
+    to the fold_in-keyed absolute-round stream (round.round_keys), the
+    property that makes checkpoint/resume bitwise (a run cut at round
+    r and resumed draws the same keys the uncut run would). Same
+    protocol, same per-round body, a different (and now
+    segment-invariant) random stream; tests/test_checkpoint.py pins
+    the segment-invariance this re-pin buys."""
     import hashlib
 
     if jax.default_backend() != "cpu":
@@ -165,10 +169,11 @@ def test_run_rounds_bit_identical_pinned_seed():
     h = hashlib.sha256()
     for leaf in jax.tree.leaves(jax.device_get(final)):
         h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
-    assert h.hexdigest()[:16] == "c6b32e859a29a36b"
-    # the per-node dynamics arrays, hashed WITHOUT the stats pytree:
-    # this value is identical before and after the PR 8 SimStats
-    # extension — the honest engine itself did not move a bit
+    assert h.hexdigest()[:16] == "181cb5a86bc1b3ca"
+    # the per-node dynamics arrays, hashed WITHOUT the stats pytree
+    # (PR 9: re-pinned with the key-schedule change above — unlike the
+    # PR 8 SimStats extension this one IS a stream change, recorded
+    # deliberately)
     hd = hashlib.sha256()
     for name in ("up", "down_time", "status", "incarnation",
                  "informed", "susp_start", "susp_deadline",
@@ -176,7 +181,7 @@ def test_run_rounds_bit_identical_pinned_seed():
                  "round_idx"):
         hd.update(np.ascontiguousarray(
             np.asarray(jax.device_get(getattr(final, name)))).tobytes())
-    assert hd.hexdigest()[:16] == "b49a7c76f4b9908b"
+    assert hd.hexdigest()[:16] == "fb96d8407d92b22f"
 
 
 def test_lane_stale_k1_bitwise_pinned_seed():
@@ -194,7 +199,7 @@ def test_lane_stale_k1_bitwise_pinned_seed():
 
     from consul_tpu.sim import lanes as lanes_mod
     from consul_tpu.sim.round import (gossip_round_lanes, init_lanes,
-                                      make_run_rounds_lanes)
+                                      make_run_rounds_lanes, round_keys)
 
     p = SimParams(n=512, loss=0.05, tcp_fallback=False,
                   fail_per_round=0.01, rejoin_per_round=0.05,
@@ -214,8 +219,10 @@ def test_lane_stale_k1_bitwise_pinned_seed():
                 lane_reducer=lanes_mod.reduce_lanes_single)
             return (s2, lv2), None
 
+        # the PR 9 key schedule (round.round_keys): the inline
+        # reference must draw the same absolute-round stream
         (f, _), _ = jax.lax.scan(body, (state, lv),
-                                 jax.random.split(key, rounds))
+                                 round_keys(key, 0, rounds))
         return f
 
     ref = pr5_schedule(init_state(p.n), jax.random.key(42))
@@ -226,11 +233,10 @@ def test_lane_stale_k1_bitwise_pinned_seed():
     h = hashlib.sha256()
     for leaf in jax.tree.leaves(jax.device_get(final)):
         h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
-    # PR 8 re-pin (was 6ef488a32c6dee46): SimStats gained two
-    # always-zero attack counters — extra zero leaves in the hash; the
-    # dynamics-only pin in test_run_rounds_bit_identical_pinned_seed
-    # covers the no-bit-moved claim
-    assert h.hexdigest()[:16] == "4d961bbadbc536b4"
+    # PR 9 re-pin (was 4d961bbadbc536b4): the checkpointable
+    # fold_in-keyed round stream replaced split(key, rounds) — see
+    # test_run_rounds_bit_identical_pinned_seed's docstring
+    assert h.hexdigest()[:16] == "22c52b89235ab901"
 
 
 def test_stale_k_drift_bounded_under_chaos():
