@@ -21,7 +21,7 @@ stride on the full-model kernel (recorded as PROFILE_r*.json).
 (rounds/s per device count + efficiency + the compiled HLO's
 collectives-per-round count, every row stamped with stale_k and
 loadavg_1m) plus the staleness-k amortization ladder at the top device
-count, recorded into MULTICHIP_r07.json — see run_mesh_bench.
+count, recorded into MULTICHIP_r08.json — see run_mesh_bench.
 
 `--sweep [--smoke]` runs the parameter-sweep engine: one compiled
 vmapped runner per topology class executing the 64-point gossip-
@@ -59,6 +59,39 @@ def _ckpt_args(argv):
             print("--ckpt-dir needs a directory", file=sys.stderr)
             sys.exit(2)
     return ckpt_dir, "--resume" in argv
+
+
+def _device_round_skew(devs):
+    """Per-device round-time skew for one ladder rung: the SAME small
+    jitted body (a short matmul chain) timed on EACH device, min of 3
+    — a straggler device (thermally throttled chip, noisy shared core)
+    shows up as dev_skew = max/min > 1 right next to loadavg_1m, so a
+    sub-linear rung can be attributed to the slow device instead of
+    blamed on the collective. Row keys are pinned in
+    sim/registry.MESH_LADDER_ROW (schema growth re-pins the digest)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def body(a):
+        for _ in range(4):
+            a = a @ a
+        return a.sum()
+
+    times = []
+    for dev in devs:
+        x = jax.device_put(jnp.full((256, 256), 1e-3, jnp.float32),
+                           dev)
+        body(x).block_until_ready()  # compile + warm on THIS device
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            body(x).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        times.append(best * 1e3)
+    lo, hi = min(times), max(times)
+    return {"dev_ms_min": round(lo, 4), "dev_ms_max": round(hi, 4),
+            "dev_skew": round(hi / lo, 3) if lo > 0 else None}
 
 
 def _loadavg_1m():
@@ -172,7 +205,7 @@ def run_mesh_bench(smoke: bool, ckpt_dir=None,
     a second ladder at the top device count measures the staleness-k
     amortization (stale_k in {1,2,4,8} + the overlap schedule); every
     row records loadavg_1m (shared-core honesty) and its stale_k. The
-    JSON envelope is printed AND written to MULTICHIP_r07.json next to
+    JSON envelope is printed AND written to MULTICHIP_r08.json next to
     this script; with no TPU attached the non-smoke run records the
     BENCH_r05 `{"skipped": true}` watchdog convention instead (missing
     hardware is not a perf regression), and `--smoke` measures the
@@ -180,7 +213,7 @@ def run_mesh_bench(smoke: bool, ckpt_dir=None,
     metric = "mesh_weak_scaling" + ("_smoke" if smoke else "")
     want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
     record_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r07.json")
+        os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r08.json")
 
     def _emit(payload: dict, rc: int = 0) -> None:
         line = json.dumps(payload, indent=2)
@@ -337,7 +370,14 @@ def run_mesh_bench(smoke: bool, ckpt_dir=None,
             "loadavg_1m": load,
             "rounds_per_sec": round(rps, 1),
             "ms_per_round": round(best / (rounds * iters) * 1e3, 4),
+            # straggler visibility: per-device probe wall-times for
+            # THIS rung's device set (max/min + their ratio)
+            **_device_round_skew(devices[:d]),
         }
+        from consul_tpu.sim.registry import MESH_LADDER_ROW
+
+        assert set(row) | {"weak_scaling_efficiency"} \
+            == set(MESH_LADDER_ROW), sorted(row)
         ladder.append(row)
         if manifest is not None:
             manifest.mark(unit, {**row, "_collectives": collectives})

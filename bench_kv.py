@@ -17,6 +17,26 @@ the reason) when fewer than 3 samples exist or when IQR/median exceeds
 the stated stability band: VERDICT round 5 could not reproduce the
 README's old best-of-N claims, and a ratio whose own spread swallows
 it is not a claim — no more quiet-host-only numbers (VERDICT next #3).
+
+Sustained-load mode (the serving-plane latency observatory's harness,
+utils/perf.py):
+
+    python bench_kv.py --concurrency C --duration S \
+        [--open-loop RPS] [--levels a,b,c] [--out SERVE_rXX.json]
+
+drives a throughput-vs-latency ladder of concurrency levels (default
+C/4, C/2, C) of closed-loop clients — each running a mixed KV
+workload (1 PUT : 2 GET : 2 stale-GET) — PLUS a blocking-query herd
+parked on watched keys that a toucher thread wakes 4×/s, for
+`--duration` seconds per level. `--open-loop RPS` switches the TOP
+level to open-loop arrivals (latency measured from the scheduled send
+time, so queueing delay is not coordinated-omission'd away). Emits a
+latency-attribution report per level: per-stage p50/p99 and the share
+of the end-to-end p50 each top-level stage carries (from the
+process-global perf registry — the SAME histograms `/v1/agent/perf`
+serves), per-client fairness (Jain index + max/min spread), and a
+headline throughput that honors the median+IQR refusal band above
+(3 duration windows are the samples).
 """
 
 from __future__ import annotations
@@ -87,13 +107,18 @@ def _one_trial(name, fn, n_threads, n_ops):
 STABILITY_BAND = 0.10
 
 
-def _headline(samples, baseline, band=STABILITY_BAND):
+def _headline(samples, baseline=None, band=STABILITY_BAND):
     """Median + IQR over per-trial throughput samples, and the
     stability verdict. Pure (unit-tested in tests/test_conformance.py):
     returns the dict fragment run_workload merges — `value` is the
     MEDIAN sample, `vs_baseline` is None with an `unstable` reason
     whenever the spread (IQR/median > band) or the sample count (< 3)
-    makes a headline ratio dishonest."""
+    makes a headline ratio dishonest.
+
+    With baseline=None (the sustained-load harness: there is no
+    published reference row for an arbitrary concurrency ladder) the
+    SAME refusal band gates a `headline` field instead: the median is
+    promoted to the headline number only when stable."""
     med = statistics.median(samples)
     iqr = None
     if len(samples) >= 3:
@@ -107,18 +132,21 @@ def _headline(samples, baseline, band=STABILITY_BAND):
                             else round(iqr / med, 4)),
         "stability_band": band,
     }
+    key = "vs_baseline" if baseline is not None else "headline"
     if len(samples) < 3:
-        out["vs_baseline"] = None
+        out[key] = None
         out["unstable"] = (f"need >= 3 in-process samples for a "
                            f"headline ratio (got {len(samples)}); "
                            "run with --repeat 3")
     elif med and iqr / med > band:
-        out["vs_baseline"] = None
+        out[key] = None
         out["unstable"] = (f"IQR/median {iqr / med:.3f} exceeds the "
                            f"{band:.0%} stability band — host too "
                            "noisy for a headline ratio")
+    elif baseline is not None:
+        out[key] = round(med / baseline, 3)
     else:
-        out["vs_baseline"] = round(med / baseline, 3)
+        out[key] = round(med, 1)
     return out
 
 
@@ -155,6 +183,271 @@ def run_workload(name, fn, n_threads, n_ops, baseline, repeat=3):
             "host_cores": os.cpu_count()}
 
 
+def build_cluster(n: int = 3):
+    """The baseline topology in-process: n servers over loopback TCP.
+    Returns (servers, leader, follower) — shared by the legacy
+    workloads, the sustained-load harness, and the tier-1 smoke."""
+    from consul_tpu.config import load
+    from consul_tpu.server import Server
+
+    print(f"building {n}-server cluster...", file=sys.stderr)
+    servers = []
+    for i in range(n):
+        cfg = load(dev=True, overrides={
+            "node_name": f"bench{i}", "bootstrap": n == 1,
+            "bootstrap_expect": 0 if n == 1 else n, "server": True})
+        s = Server(cfg)
+        s.start()
+        servers.append(s)
+    for s in servers[1:]:
+        s.join([servers[0].serf.memberlist.transport.addr])
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="leader election")
+    if n > 1:
+        wait_for(lambda: len(leader.raft.peers) == n,
+                 what=f"{n} raft peers")
+    follower = next((s for s in servers if s is not leader), leader)
+    return servers, leader, follower
+
+
+# ------------------------------------------------- sustained-load mode
+
+#: blocking-query herd shape: `threads` watchers parked across `keys`
+#: watched KV keys, woken by a toucher writing one key every
+#: `touch_interval_s` — the long-poll population a real fleet parks on
+#: every server (queue-depth visible as the rpc.blocking.parked gauge)
+HERD = {"threads": 16, "keys": 8, "touch_interval_s": 0.25}
+
+
+def _jain(xs):
+    """Jain's fairness index over per-client throughput: 1.0 =
+    perfectly fair, 1/n = one client got everything."""
+    if not xs or not any(xs):
+        return None
+    return round(sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs)), 4)
+
+
+def _start_herd(leader, follower, stop, threads, keys,
+                touch_interval):
+    """Park `threads` blocking KV GETs on `keys` watched keys against
+    the FOLLOWER (where a real fleet's stale watchers sit), plus one
+    toucher thread PUTting through the leader so the herd keeps
+    waking. Returns the thread list (daemons; `stop` ends them)."""
+    from consul_tpu.server.rpc import ConnPool
+
+    pool = ConnPool()
+
+    def toucher():
+        i = 0
+        while not stop.is_set():
+            try:
+                pool.call(leader.rpc.addr, "KVS.Apply", {
+                    "Op": "set",
+                    "DirEnt": {"Key": f"herd/{i % keys}",
+                               "Value": b"t" * 16}})
+            except Exception:  # noqa: BLE001 — bench keeps going
+                pass
+            i += 1
+            stop.wait(touch_interval)
+
+    def watcher(w):
+        idx = 1
+        while not stop.is_set():
+            try:
+                res = pool.call(follower.rpc.addr, "KVS.Get", {
+                    "Key": f"herd/{w % keys}", "AllowStale": True,
+                    "MinQueryIndex": idx, "MaxQueryTime": 2.0})
+                idx = max(res.get("Index", 1), 1)
+            except Exception:  # noqa: BLE001
+                stop.wait(0.2)
+
+    ts = [threading.Thread(target=toucher, daemon=True,
+                           name="herd-toucher")]
+    ts += [threading.Thread(target=watcher, args=(w,), daemon=True,
+                            name=f"herd-{w}") for w in range(threads)]
+    for t in ts:
+        t.start()
+    return ts
+
+
+def _level_pass(leader, follower, concurrency, duration,
+                open_rps=None):
+    """One concurrency level of the sustained ladder: `concurrency`
+    clients running the mixed workload (1 PUT : 2 GET : 2 stale-GET)
+    for `duration` seconds. Closed loop by default; `open_rps` total
+    switches to scheduled open-loop arrivals with latency measured
+    from the INTENDED send time (no coordinated omission). Returns
+    (per_client_ops, latencies_with_stamps, errors, wall)."""
+    from consul_tpu.server.rpc import ConnPool
+
+    pools = [ConnPool() for _ in range(concurrency)]
+    lat: list[list[tuple[float, float]]] = [
+        [] for _ in range(concurrency)]
+    errors = [0] * concurrency
+    gate = threading.Barrier(concurrency + 1)
+    t_end = [0.0]
+
+    def one_op(w, i, pool):
+        kind = i % 5
+        if kind == 0:
+            pool.call(leader.rpc.addr, "KVS.Apply", {
+                "Op": "set",
+                "DirEnt": {"Key": f"sust/{w}/{i % 64}",
+                           "Value": b"x" * 64}})
+        elif kind in (1, 2):
+            pool.call(leader.rpc.addr, "KVS.Get",
+                      {"Key": f"sust/{w}/{(i - 1) % 64}"})
+        else:
+            pool.call(follower.rpc.addr, "KVS.Get",
+                      {"Key": f"sust/{w}/{(i - 1) % 64}",
+                       "AllowStale": True})
+
+    def worker(w):
+        pool = pools[w]
+        mine = lat[w]
+        # open loop: this client's schedule is every C/RPS seconds
+        period = concurrency / open_rps if open_rps else 0.0
+        gate.wait()
+        start = time.perf_counter()
+        i = 0
+        while True:
+            now = time.perf_counter()
+            if now - start >= duration:
+                break
+            if period:
+                sched = start + i * period
+                wait = sched - now
+                if wait > 0:
+                    time.sleep(wait)
+                t0 = sched  # latency from INTENDED send time
+            else:
+                t0 = now
+            try:
+                one_op(w, i, pool)
+            except Exception:  # noqa: BLE001
+                errors[w] += 1
+            done = time.perf_counter()
+            mine.append((done - start, done - t0))
+            i += 1
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    gate.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    t_end[0] = time.perf_counter() - t0
+    for p in pools:
+        p.close()
+    return lat, errors, t_end[0]
+
+
+def run_sustained(leader, follower, levels, duration,
+                  open_rps=None, herd=HERD, windows=3):
+    """The sustained-load report: one pass per concurrency level with
+    the blocking-query herd parked throughout. Per level: throughput,
+    client-observed p50/p99, per-window rps samples, per-client
+    fairness, and the SERVER-side per-stage latency attribution from
+    the process-global perf registry (utils/perf.py stage_report —
+    the same histograms `/v1/agent/perf` serves)."""
+    from consul_tpu.utils import perf
+
+    stop = threading.Event()
+    herd_threads = []
+    if herd and herd.get("threads"):
+        herd_threads = _start_herd(leader, follower, stop,
+                                   herd["threads"], herd["keys"],
+                                   herd["touch_interval_s"])
+        time.sleep(0.3)  # let the herd park before measuring
+    out_levels = []
+    curve = []
+    top_samples = None
+    try:
+        for concurrency in levels:
+            load0 = _loadavg_1m()
+            snap0 = perf.default.raw()
+            use_open = open_rps if (
+                open_rps and concurrency == levels[-1]) else None
+            lat, errors, wall = _level_pass(
+                leader, follower, concurrency, duration,
+                open_rps=use_open)
+            snap1 = perf.default.raw()
+            all_lat = sorted(x for lane in lat for _, x in lane)
+            total = len(all_lat)
+            if not total:
+                out_levels.append({"concurrency": concurrency,
+                                   "error": "no ops completed"})
+                continue
+            rps = total / wall
+            p50 = statistics.quantiles(all_lat, n=100)[49] * 1e3 \
+                if total >= 100 else statistics.median(all_lat) * 1e3
+            p99 = statistics.quantiles(all_lat, n=100)[98] * 1e3 \
+                if total >= 100 else all_lat[-1] * 1e3
+            # per-window throughput: the stability samples the
+            # headline's refusal band runs on
+            win = duration / windows
+            wcounts = [0] * windows
+            for lane in lat:
+                for t_done, _ in lane:
+                    wcounts[min(int(t_done / win), windows - 1)] += 1
+            wsamples = [c / win for c in wcounts]
+            client_rps = [len(lane) / wall for lane in lat]
+            row = {
+                "concurrency": concurrency,
+                "open_loop_rps": use_open,
+                "duration_s": duration,
+                "rps": round(rps, 1),
+                "p50_ms": round(p50, 2),
+                "p99_ms": round(p99, 2),
+                "total_ops": total,
+                "errors": sum(errors),
+                "loadavg_1m": load0,
+                "window_rps": [round(s, 1) for s in wsamples],
+                "fairness": {
+                    "jain": _jain(client_rps),
+                    "min_client_rps": round(min(client_rps), 1),
+                    "max_client_rps": round(max(client_rps), 1),
+                    "spread": (round(max(client_rps)
+                                     / min(client_rps), 2)
+                               if min(client_rps) else None),
+                },
+                "attribution": perf.stage_report(snap1, snap0, "rpc"),
+                "gauges": snap1["gauges"],
+            }
+            out_levels.append(row)
+            curve.append([concurrency, round(rps, 1),
+                          round(p50, 2), round(p99, 2)])
+            if concurrency == levels[-1]:
+                top_samples = wsamples
+            print(f"  C={concurrency}: {rps:,.0f} req/s "
+                  f"p50={p50:.1f}ms p99={p99:.1f}ms "
+                  f"share_p50={row['attribution'].get('share_p50_total')}",
+                  file=sys.stderr)
+    finally:
+        stop.set()
+        for t in herd_threads:
+            t.join(timeout=3.0)
+    report = {
+        "metric": "kv_sustained",
+        "unit": "req/s",
+        "host_cores": os.cpu_count(),
+        "herd": dict(herd) if herd else None,
+        "levels": out_levels,
+        "throughput_latency_curve": curve,
+        "perf_source": "process-global consul_tpu.utils.perf registry "
+                       "(served live at /v1/agent/perf)",
+    }
+    if top_samples:
+        # PR 9 refusal band: the headline number is the top level's
+        # median window throughput, refused when the spread (or sample
+        # count) makes it dishonest
+        report["headline_rps"] = _headline(top_samples)
+    return report
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     repeat = 3
@@ -165,26 +458,58 @@ def main() -> None:
             print("usage: bench_kv.py [--quick] [--repeat N]",
                   file=sys.stderr)
             sys.exit(2)
-    from consul_tpu.config import load
-    from consul_tpu.server import Server
+
+    def flag(name, cast, default=None):
+        if name in sys.argv:
+            try:
+                return cast(sys.argv[sys.argv.index(name) + 1])
+            except (IndexError, ValueError):
+                print(f"usage: bench_kv.py {name} <value>",
+                      file=sys.stderr)
+                sys.exit(2)
+        return default
+
+    concurrency = flag("--concurrency", int)
+    levels_arg = flag("--levels", str)
+    if concurrency is None and levels_arg is None:
+        # sustained-only flags must not be silently swallowed by the
+        # legacy workload below (a --out that never writes looks like
+        # a recorded run that wasn't)
+        orphans = [n for n in ("--duration", "--open-loop", "--out")
+                   if n in sys.argv]
+        if orphans:
+            print("usage: bench_kv.py --concurrency C [--levels a,b,c]"
+                  " [--duration S] [--open-loop RPS] [--out F] — "
+                  f"{', '.join(orphans)} require(s) --concurrency or "
+                  "--levels", file=sys.stderr)
+            sys.exit(2)
+    if concurrency is not None or levels_arg is not None:
+        duration = flag("--duration", float, 5.0)
+        open_rps = flag("--open-loop", float)
+        if levels_arg:
+            levels = sorted({int(x) for x in levels_arg.split(",")})
+        else:
+            levels = sorted({max(1, concurrency // 4),
+                             max(1, concurrency // 2), concurrency})
+        out_path = flag("--out", str)
+        servers, leader, follower = build_cluster()
+        try:
+            report = run_sustained(leader, follower, levels, duration,
+                                   open_rps=open_rps)
+        finally:
+            for s in servers:
+                s.shutdown()
+        blob = json.dumps(report, indent=2)
+        if out_path:
+            with open(out_path, "w") as f:
+                f.write(blob + "\n")
+            print(f"wrote {out_path}", file=sys.stderr)
+        print(blob)
+        return
+
     from consul_tpu.server.rpc import ConnPool
 
-    print("building 3-server cluster...", file=sys.stderr)
-    servers = []
-    for i in range(3):
-        cfg = load(dev=True, overrides={
-            "node_name": f"bench{i}", "bootstrap": False,
-            "bootstrap_expect": 3, "server": True})
-        s = Server(cfg)
-        s.start()
-        servers.append(s)
-    for s in servers[1:]:
-        s.join([servers[0].serf.memberlist.transport.addr])
-    leader = wait_for(
-        lambda: next((s for s in servers if s.is_leader()), None),
-        what="leader election")
-    wait_for(lambda: len(leader.raft.peers) == 3, what="3 raft peers")
-    follower = next(s for s in servers if s is not leader)
+    servers, leader, follower = build_cluster()
 
     n_threads = 16 if quick else 32
     n_ops = 30 if quick else 120

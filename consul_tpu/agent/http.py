@@ -22,7 +22,7 @@ from typing import Any, Callable, Optional
 
 from consul_tpu.server.rpc import RPCError
 from consul_tpu.types import CheckStatus
-from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import log, perf, telemetry
 from consul_tpu.utils import trace as trace_mod
 from consul_tpu.version import __version__
 
@@ -103,78 +103,118 @@ class HTTPApi:
             def log_message(self, fmt, *args):  # route to our logger
                 api.log.debug(fmt, *args)
 
+            def parse_request(self):
+                # time the request-line + header parse (the bytes are
+                # already in the socket buffer once the request line
+                # arrived, so this is service time, not the keep-alive
+                # idle wait) — seeds the http.read stage of the ledger
+                import time as _t
+
+                t0 = _t.perf_counter()
+                ok = super().parse_request()
+                self._perf_read = _t.perf_counter() - t0
+                return ok
+
             def _handle(self, method: str) -> None:
-                parsed = urllib.parse.urlparse(self.path)
-                path = parsed.path
-                query = {k: v[-1] for k, v in
-                         urllib.parse.parse_qs(
-                             parsed.query, keep_blank_values=True).items()}
-                body = b""
-                ln = int(self.headers.get("Content-Length") or 0)
-                if ln:
-                    body = self.rfile.read(ln)
-                token = self.headers.get("X-Consul-Token") \
-                    or query.pop("token", "")
-                start = telemetry.time_now()
+                # per-request stage ledger (utils/perf.py): read →
+                # decode → route → encode → write, with store/raft
+                # stages nesting inside route via the contextvar
+                led = perf.ledger("http",
+                                  read_s=getattr(self, "_perf_read",
+                                                 0.0))
+                tok = perf.attach(led)
+                streaming = False
                 try:
-                    # span covers route dispatch end to end — on write
-                    # paths that is HTTP -> server RPC -> raft apply
-                    # commit-wait on THIS thread, so the raft.apply
-                    # child span nests under it (utils/trace.py); the
-                    # fsm commit runs on the applier thread as its own
-                    # root span, correlated by time
-                    with trace_mod.default.span(
-                            "http.request", method=method,
-                            path=path) as sp:
-                        result, index = api.route(method, path, query,
-                                                  body, token)
-                        streaming = isinstance(result, StreamingBody)
+                    with perf.stage("http.decode"):
+                        parsed = urllib.parse.urlparse(self.path)
+                        path = parsed.path
+                        query = {k: v[-1] for k, v in
+                                 urllib.parse.parse_qs(
+                                     parsed.query,
+                                     keep_blank_values=True).items()}
+                        body = b""
+                        ln = int(self.headers.get("Content-Length")
+                                 or 0)
+                        if ln:
+                            body = self.rfile.read(ln)
+                        token = self.headers.get("X-Consul-Token") \
+                            or query.pop("token", "")
+                    start = telemetry.time_now()
+                    try:
+                        # span covers route dispatch end to end — on
+                        # write paths that is HTTP -> server RPC ->
+                        # raft apply commit-wait on THIS thread, so the
+                        # raft.apply child span nests under it
+                        # (utils/trace.py); the fsm commit runs on the
+                        # applier thread as its own root span,
+                        # correlated by time
+                        with trace_mod.default.span(
+                                "http.request", method=method,
+                                path=path) as sp:
+                            with perf.stage("http.route"):
+                                result, index = api.route(
+                                    method, path, query, body, token)
+                            streaming = isinstance(result,
+                                                   StreamingBody)
+                            if streaming:
+                                sp.tag(streaming=True)
                         if streaming:
-                            sp.tag(streaming=True)
-                    if streaming:
-                        self.send_response(200)
-                        self.send_header("Content-Type",
-                                         "application/json")
-                        self.send_header("Connection", "close")
-                        self.end_headers()
-                        for chunk in result.gen:
-                            self.wfile.write(chunk)
-                            self.wfile.flush()
-                        return
-                    if isinstance(result, RawBody):
-                        result, forced_ctype = result.data, \
-                            result.content_type
-                    else:
-                        forced_ctype = None
-                    payload = b"" if result is None else (
-                        result if isinstance(result, bytes)
-                        else json.dumps(result).encode())
-                    ctype = forced_ctype or (
-                        "application/octet-stream"
-                        if isinstance(result, bytes)
-                        else "application/json")
-                    if path == "/" or path.startswith("/ui"):
-                        ctype = "text/html; charset=utf-8"
-                    self.send_response(200)
-                    if index is not None:
-                        self.send_header("X-Consul-Index", str(index))
-                    self.send_header("Content-Type", ctype)
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                except HTTPError as e:
-                    self._err(e.code, str(e))
-                except RPCError as e:
-                    msg = str(e)
-                    code = 403 if "Permission denied" in msg else \
-                        400 if "bad request" in msg else 500
-                    self._err(code, msg)
-                except Exception as e:  # noqa: BLE001
-                    api.log.warning("%s %s failed: %s", method, path, e)
-                    self._err(500, f"internal error: {e}")
+                            self.send_response(200)
+                            self.send_header("Content-Type",
+                                             "application/json")
+                            self.send_header("Connection", "close")
+                            self.end_headers()
+                            for chunk in result.gen:
+                                self.wfile.write(chunk)
+                                self.wfile.flush()
+                            return
+                        with perf.stage("http.encode"):
+                            if isinstance(result, RawBody):
+                                result, forced_ctype = result.data, \
+                                    result.content_type
+                            else:
+                                forced_ctype = None
+                            payload = b"" if result is None else (
+                                result if isinstance(result, bytes)
+                                else json.dumps(result).encode())
+                            ctype = forced_ctype or (
+                                "application/octet-stream"
+                                if isinstance(result, bytes)
+                                else "application/json")
+                            if path == "/" or path.startswith("/ui"):
+                                ctype = "text/html; charset=utf-8"
+                        with perf.stage("http.write"):
+                            self.send_response(200)
+                            if index is not None:
+                                self.send_header("X-Consul-Index",
+                                                 str(index))
+                            self.send_header("Content-Type", ctype)
+                            self.send_header("Content-Length",
+                                             str(len(payload)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                    except HTTPError as e:
+                        self._err(e.code, str(e))
+                    except RPCError as e:
+                        msg = str(e)
+                        code = 403 if "Permission denied" in msg else \
+                            400 if "bad request" in msg else 500
+                        self._err(code, msg)
+                    except Exception as e:  # noqa: BLE001
+                        api.log.warning("%s %s failed: %s", method,
+                                        path, e)
+                        self._err(500, f"internal error: {e}")
+                    finally:
+                        telemetry.default.measure_hist(
+                            "http.request", start, {"method": method})
                 finally:
-                    telemetry.default.measure_since(
-                        "http.request", start, {"method": method})
+                    perf.detach(tok)
+                    if streaming:
+                        # a stream's lifetime is the client's window,
+                        # not a latency — drop without observing e2e
+                        perf.abandon(led)
+                    else:
+                        perf.close(led)
 
             def _err(self, code: int, msg: str) -> None:
                 if code == 304:
@@ -490,6 +530,30 @@ class HTTPApi:
                         time_mod.sleep(interval)  # snapshot
 
             return StreamingBody(metrics_stream()), None
+        if path == "/v1/agent/perf":
+            # the serving-plane latency observatory (utils/perf.py):
+            # per-stage streaming histograms + queue gauges. Same ACL
+            # tier as trace/monitor: agent read. ?format=prometheus
+            # serves the native histogram exposition; JSON otherwise,
+            # with ?prefix= and ?min_count= filters. Validation BEFORE
+            # any work, like the trace endpoint's params.
+            rpc("Internal.AgentRead", {})
+            fmt = q.get("format", "")
+            if fmt not in ("", "json", "prometheus"):
+                raise HTTPError(400, f"unknown format {fmt!r} "
+                                     "(want json or prometheus)")
+            try:
+                min_count = int(q.get("min_count", "0"))
+            except ValueError as exc:
+                raise HTTPError(400,
+                                f"bad perf params: {exc}") from exc
+            if min_count < 0:
+                raise HTTPError(400, "min_count must be non-negative")
+            if fmt == "prometheus":
+                return RawBody(perf.default.prometheus().encode(),
+                               "text/plain; version=0.0.4"), None
+            return perf.default.snapshot(
+                min_count=min_count, prefix=q.get("prefix", "")), None
         if path == "/v1/agent/trace":
             # recent finished spans from the in-process span tracer
             # (utils/trace.py) — the snapshot `cli debug` bundles.

@@ -1139,7 +1139,8 @@ def cmd_peering(args) -> int:
 DEBUG_BUNDLE_REQUIRED = (
     "manifest.json", "self.json", "members.json", "metrics.json",
     "metrics.prom", "metrics_stream.jsonl", "spans.json",
-    "trace.perfetto.json", "raft.json", "host.json", "consul.log",
+    "trace.perfetto.json", "perf.json", "raft.json", "host.json",
+    "consul.log",
 )
 
 
@@ -1212,6 +1213,11 @@ def _capture_debug_bundle(c, duration: float, sim_nodes: int,
         "trace.perfetto.json": capture(
             "trace.perfetto.json",
             lambda: c.get("/v1/agent/trace", format="perfetto")),
+        # per-stage latency histograms + queue gauges (utils/perf.py
+        # via /v1/agent/perf) — the attribution layer a slow-request
+        # postmortem starts from
+        "perf.json": capture("perf.json",
+                             lambda: c.get("/v1/agent/perf")),
         "raft.json": capture("raft.json", c.raft_configuration),
         "host.json": capture("host.json",
                              lambda: c.get("/v1/agent/host")),

@@ -30,7 +30,7 @@ from consul_tpu.raft.transport import RaftTransport
 # chunk entries (rpc.go:783-793 / go-raftchunking). Far below the RPC
 # MAX_FRAME (64MB) so a replication batch of chunks still frames.
 CHUNK_SIZE = 4 * 1024 * 1024
-from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import log, perf, telemetry
 from consul_tpu.utils import trace as trace_mod
 from consul_tpu.utils.clock import Clock, RealTimers, SimClock
 
@@ -226,7 +226,10 @@ class RaftNode:
         # and the FSM side as raft.fsm.apply on the applier thread —
         # the three-thread chain a slow-write postmortem walks
         with trace_mod.default.span("raft.apply", entries=len(datas)):
-            return self._apply_many_impl(datas, timeout)
+            # global histogram for the whole append→replicate→commit
+            # batch (runs on the batcher thread — no request ledger)
+            with perf.stage("raft.apply_batch"):
+                return self._apply_many_impl(datas, timeout)
 
     def _apply_many_impl(self, datas: list[bytes],
                            timeout: float = 10.0) -> list[Any]:
@@ -1164,6 +1167,11 @@ class RaftNode:
                 self._apply_committed_locked()
 
     def _apply_committed_locked(self) -> None:
+        # applier backpressure gauge: how far the FSM lags commit
+        # (the queue the applier is about to drain; re-set post-drain
+        # below so the steady-state read is the residual lag)
+        perf.default.gauge_set("raft.applier.depth",
+                               self.commit_index - self.last_applied)
         while self.last_applied < self.commit_index:
             idx = self.last_applied + 1
             e = self.store.entry(idx)
@@ -1188,8 +1196,11 @@ class RaftNode:
                         result = ex
                 # commit->apply wall time per entry (the reference's
                 # consul.raft.fsm.apply) — the number that explains a
-                # growing commit/applied gap
-                self.metrics.measure_since("raft.fsm.apply", start)
+                # growing commit/applied gap. Log-bucketed histogram:
+                # this is a hot-path timer under sustained load
+                self.metrics.measure_hist("raft.fsm.apply", start)
+                perf.default.observe("raft.fsm.apply",
+                                     telemetry.time_now() - start)
                 if self.role == Role.LEADER:
                     self._apply_results[idx] = result
                     if len(self._apply_results) > 4096:
@@ -1223,7 +1234,9 @@ class RaftNode:
                                            "at %d: %s", idx, ex)
                             sp.tag(error=type(ex).__name__)
                             result = ex
-                    self.metrics.measure_since("raft.fsm.apply", start)
+                    self.metrics.measure_hist("raft.fsm.apply", start)
+                    perf.default.observe("raft.fsm.apply",
+                                         telemetry.time_now() - start)
                     if self.role == Role.LEADER:
                         self._apply_results[idx] = result
             elif e["kind"] == "verify":
@@ -1267,6 +1280,8 @@ class RaftNode:
                     self.peers.discard(e["remove"])
                     self.nonvoters.discard(e["remove"])
             self.last_applied = idx
+        perf.default.gauge_set("raft.applier.depth",
+                               self.commit_index - self.last_applied)
         self._applied_cv.notify_all()
         self._maybe_snapshot()
 

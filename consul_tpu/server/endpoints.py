@@ -14,6 +14,7 @@ from typing import Any
 
 from consul_tpu.server.rpc import RPCError
 from consul_tpu.state import MessageType
+from consul_tpu.utils import perf
 from consul_tpu.state.fsm import encode_command
 from consul_tpu.types import CheckStatus
 
@@ -333,7 +334,10 @@ def register_endpoints(srv) -> None:
                     "consistent read unavailable: leadership lost"))
                 return
             try:
-                e_ = state.kv_get(key)
+                # store-read stage without a ledger (this runs on the
+                # verify-gate thread): feeds the global histogram
+                with perf.stage("store.read"):
+                    e_ = state.kv_get(key)
                 # max(.., 1) matches blocking_query's sync contract: an
                 # Index of 0 fed back as MinQueryIndex busy-polls
                 respond({"Index": max(state.kv_key_index(key), 1),

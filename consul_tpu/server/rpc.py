@@ -37,7 +37,7 @@ from typing import Any, Callable, Optional
 
 import msgpack
 
-from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import log, perf, telemetry
 
 RPC_CONSUL = 0x00
 RPC_RAFT = 0x01
@@ -50,6 +50,23 @@ MAX_FRAME = 64 * 1024 * 1024
 SNAPSHOT_CHUNK = 1 << 20  # 1MB snapshot stream chunks
 MAX_SNAPSHOT_STREAM = 1 << 30  # 1GB cumulative restore-upload cap
 MAX_MUX_STREAMS = 1024  # concurrent streams per mux session
+
+#: process-wide live mux streams, across every session of every
+#: RPCServer in the process — a counter polled by the perf registry.
+#: Guarded by its own tiny lock: `lst[0] += 1` is NOT atomic under the
+#: GIL (read-modify-write), and a gauge never self-corrects a lost
+#: update the way a histogram absorbs one. The lock the overhead gate
+#: punished was the CONTENDED registry lock (gauge_set races the
+#: merge-on-read path); this one is touched only here.
+_MUX_IN_FLIGHT = [0]
+_MUX_FLIGHT_LOCK = threading.Lock()
+perf.default.gauge_fn("rpc.mux.in_flight",
+                      lambda: _MUX_IN_FLIGHT[0])
+
+
+def _mux_flight(delta: int) -> None:
+    with _MUX_FLIGHT_LOCK:
+        _MUX_IN_FLIGHT[0] += delta
 
 
 class RPCError(Exception):
@@ -111,6 +128,27 @@ def read_frame(sock: socket.socket) -> Optional[dict[str, Any]]:
     if body is None:
         return None
     return msgpack.unpackb(body, raw=False)
+
+
+def read_frame_timed(sock: socket.socket
+                     ) -> tuple[Optional[dict[str, Any]], float]:
+    """read_frame plus the SERVICE time it cost: the clock starts
+    after the 4-byte header arrives (the wait for the header is idle
+    time between requests on a keep-alive/mux conn, not work) and
+    covers body read + msgpack decode — the `rpc.read` stage of the
+    perf ledger (utils/perf.py)."""
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None, 0.0
+    t0 = time.perf_counter()
+    (ln,) = struct.unpack(">I", hdr)
+    if ln > MAX_FRAME:
+        raise ValueError(f"frame too large: {ln}")
+    body = _read_exact(sock, ln)
+    if body is None:
+        return None, 0.0
+    return msgpack.unpackb(body, raw=False), \
+        time.perf_counter() - t0
 
 
 def write_frame(sock: socket.socket, obj: dict[str, Any]) -> None:
@@ -281,23 +319,30 @@ class RPCServer:
 
     def _serve_consul(self, sock: socket.socket, src: str) -> None:
         while True:
-            req = read_frame(sock)
+            req, read_s = read_frame_timed(sock)
             if req is None:
                 return
             seq = req.get("seq", 0)
             method = req.get("method", "")
             start = telemetry.time_now()
+            led = perf.ledger("rpc", read_s=read_s)
+            tok = perf.attach(led)
             try:
-                result = self._rpc_handler(method, req.get("args") or {},
-                                           src)
-                write_frame(sock, {"seq": seq, "result": result})
+                with perf.stage("rpc.handler"):
+                    result = self._rpc_handler(method,
+                                               req.get("args") or {},
+                                               src)
+                with perf.stage("rpc.write"):
+                    write_frame(sock, {"seq": seq, "result": result})
             except RPCError as e:
                 write_frame(sock, {"seq": seq, "error": str(e)})
             except Exception as e:  # noqa: BLE001
                 self.log.warning("rpc %s failed: %s", method, e)
                 write_frame(sock, {"seq": seq, "error": f"internal: {e}"})
             finally:
-                self.metrics.measure_since(
+                perf.detach(tok)
+                perf.close(led)
+                self.metrics.measure_hist(
                     "rpc.request", start, {"method": method})
 
     def _serve_mux(self, sock: socket.socket, src: str) -> None:
@@ -333,7 +378,7 @@ class RPCServer:
     def _mux_loop(self, sock, src, wlock, in_flight, closed, cancels,
                   safe_write) -> None:
         while True:
-            req = read_frame(sock)
+            req, read_s = read_frame_timed(sock)
             if req is None:
                 return
             sid = req.get("sid", 0)
@@ -357,16 +402,22 @@ class RPCServer:
                 safe_write({"sid": sid,
                             "error": "too many concurrent streams"})
                 continue
+            _mux_flight(+1)
             if method in self.stream_handlers:
                 def release():
                     with wlock:
                         in_flight[0] -= 1
+                    _mux_flight(-1)
 
                 self._run_stream(sid, method, req.get("args") or {}, src,
                                  closed, cancels, safe_write, release)
                 continue
 
             req_args = req.get("args") or {}
+            # per-request stage ledger: opens at frame-header arrival
+            # (rpc.read seeded with the frame's body+decode service
+            # time), closed by whichever thread writes the reply
+            led = perf.ledger("rpc", read_s=read_s)
 
             # async fast path: a handler that validates inline and
             # completes via callback (e.g. the KV write path riding the
@@ -379,13 +430,37 @@ class RPCServer:
             if afn is not None:
                 start = telemetry.time_now()
 
-                def respond(result, sid=sid, method=method, start=start):
+                def respond(result, sid=sid, method=method, start=start,
+                            led=led):
                     # the reply write goes through the worker pool: the
                     # completer (e.g. the single group-commit thread)
                     # must never block on one client's full socket
                     # buffer — that would stall every other caller's
                     # commit behind a slow reader
                     def write_reply():
+                        if led is not None:
+                            # handler-end (led.mark) → here: the
+                            # thread-free group-commit wait, plus the
+                            # reply's own pool hop. led.mark < 0 means
+                            # the mux thread hasn't published the
+                            # handler record yet (an inline completion
+                            # can reach this pool thread first) — wait
+                            # for it, bounded, so commit_wait never
+                            # absorbs the handler interval and the
+                            # ledger's Σ(depth-0) ≤ e2e invariant
+                            # stays by-construction
+                            m = led.mark
+                            for _ in range(100):
+                                if m >= 0.0:
+                                    break
+                                time.sleep(0)
+                                m = led.mark
+                            if m >= 0.0:
+                                perf.record(
+                                    led, "rpc.commit_wait",
+                                    max(0.0, time.perf_counter() - m),
+                                    off=m - led.t0_pc)
+                            t_w = time.perf_counter()
                         if isinstance(result, RPCError):
                             safe_write({"sid": sid,
                                         "error": str(result)})
@@ -396,10 +471,15 @@ class RPCServer:
                                         "error": f"internal: {result}"})
                         else:
                             safe_write({"sid": sid, "result": result})
+                        if led is not None:
+                            perf.record(led, "rpc.write",
+                                        time.perf_counter() - t_w)
                         with wlock:
                             in_flight[0] -= 1
-                        self.metrics.measure_since(
+                        _mux_flight(-1)
+                        self.metrics.measure_hist(
                             "rpc.request", start, {"method": method})
+                        perf.close(led)
 
                     try:
                         self._workers.submit(write_reply)
@@ -407,30 +487,74 @@ class RPCServer:
                         pass
 
                 try:
+                    t_h = time.perf_counter()
+                    if led is not None:
+                        # sentinel: handler end not yet published —
+                        # write_reply (possibly already racing on a
+                        # pool thread) waits for a real mark
+                        led.mark = -1.0
                     handled = afn(req_args, src, respond)
                 except Exception as e:  # noqa: BLE001 — validation
+                    if led is not None:
+                        end_h = time.perf_counter()
+                        perf.record(led, "rpc.handler", end_h - t_h,
+                                    off=t_h - led.t0_pc)
+                        led.mark = end_h
                     respond(e if isinstance(e, RPCError)
                             else RPCError(f"internal: {e}"))
                     continue
                 if handled:
+                    # inline validation+enqueue IS the handler stage on
+                    # this path; the commit wait that follows costs no
+                    # thread and is measured by write_reply above.
+                    # Record BEFORE publishing the mark: the GIL makes
+                    # the mark store visible only after the append, so
+                    # any thread that sees mark ≥ 0 also sees the
+                    # handler entry — no double-count, no missed stage
+                    if led is not None:
+                        end_h = time.perf_counter()
+                        perf.record(led, "rpc.handler", end_h - t_h,
+                                    off=t_h - led.t0_pc)
+                        led.mark = end_h
                     continue  # respond() owns the reply + bookkeeping
+                if led is not None:
+                    # async handler declined → sync path: restart the
+                    # dispatch clock (the queue wait starts now, and
+                    # the -1 sentinel must never reach run())
+                    led.mark = time.perf_counter()
 
-            def run(sid=sid, method=method, args=req_args):
+            def run(sid=sid, method=method, args=req_args, led=led):
                 start = telemetry.time_now()
+                # worker-pool / thread-spawn queueing ahead of the
+                # handler — visible as its own stage so pool
+                # saturation shows up in the attribution report
+                if led is not None:
+                    perf.record(led, "rpc.dispatch",
+                                time.perf_counter() - led.mark,
+                                off=led.mark - led.t0_pc)
+                tok = perf.attach(led)
                 try:
-                    safe_write({"sid": sid,
-                                "result": self._rpc_handler(method, args,
-                                                            src)})
-                except RPCError as e:
-                    safe_write({"sid": sid, "error": str(e)})
-                except Exception as e:  # noqa: BLE001
-                    self.log.warning("rpc %s failed: %s", method, e)
-                    safe_write({"sid": sid, "error": f"internal: {e}"})
+                    try:
+                        with perf.stage("rpc.handler"):
+                            result = self._rpc_handler(method, args,
+                                                       src)
+                        with perf.stage("rpc.write"):
+                            safe_write({"sid": sid, "result": result})
+                    except RPCError as e:
+                        safe_write({"sid": sid, "error": str(e)})
+                    except Exception as e:  # noqa: BLE001
+                        self.log.warning("rpc %s failed: %s", method, e)
+                        safe_write({"sid": sid,
+                                    "error": f"internal: {e}"})
+                    finally:
+                        with wlock:
+                            in_flight[0] -= 1
+                        _mux_flight(-1)
+                        self.metrics.measure_hist(
+                            "rpc.request", start, {"method": method})
                 finally:
-                    with wlock:
-                        in_flight[0] -= 1
-                    self.metrics.measure_since(
-                        "rpc.request", start, {"method": method})
+                    perf.detach(tok)
+                    perf.close(led)
 
             # blocking queries park for up to MaxQueryTime (600s) — they
             # get a dedicated thread. Everything else runs on the shared

@@ -31,7 +31,7 @@ from consul_tpu.state.fsm import encode_command
 from consul_tpu.types import (CheckStatus, CONSUL_SERVICE_ID,
                               CONSUL_SERVICE_NAME, MemberStatus,
                               SERF_CHECK_ID, SERF_CHECK_NAME)
-from consul_tpu.utils import log, telemetry
+from consul_tpu.utils import log, perf, telemetry
 from consul_tpu.utils import trace as trace_mod
 from consul_tpu.utils.ratelimit import RateLimitError, RateLimitHandler
 from consul_tpu.utils.clock import RealTimers
@@ -40,6 +40,21 @@ from consul_tpu.utils.duration import parse_duration
 
 class NoLeaderError(RPCError):
     pass
+
+
+#: process-wide parked blocking queries (the long-poll herd), a
+#: counter polled by the perf registry — own tiny lock, see
+#: rpc._MUX_IN_FLIGHT for why (`lst[0] += 1` is not atomic and a
+#: gauge never self-corrects a lost update; the registry lock stays
+#: off the hot path)
+_PARKED = [0]
+_PARKED_LOCK = threading.Lock()
+perf.default.gauge_fn("rpc.blocking.parked", lambda: _PARKED[0])
+
+
+def _parked(delta: int) -> None:
+    with _PARKED_LOCK:
+        _PARKED[0] += delta
 
 
 class _PeerStreamTimeout(Exception):
@@ -87,7 +102,10 @@ class _ApplyBatcher:
         # wall time (utils/trace.py; cross-thread, correlated by time)
         with trace_mod.default.span("raft.commit_wait",
                                     bytes=len(data)):
-            ok = done.wait(timeout)
+            # perf stage nests under the caller's request ledger (an
+            # HTTP write parks HERE for most of its wall time)
+            with perf.stage("raft.commit_wait"):
+                ok = done.wait(timeout)
         if not ok:
             raise RPCError("apply timed out in commit queue")
         result = slot[0]
@@ -956,7 +974,11 @@ class Server:
         deadline = time.monotonic() + max_time
         while True:
             idx = self.state.table_index(*tables)
-            result = run()
+            # the store-read slice of the request (utils/perf.py):
+            # each loop iteration reads the state once; the PARKED
+            # time between reads is the herd gauge below, not a stage
+            with perf.stage("store.read"):
+                result = run()
             ridx = result.pop("Index", idx)
             if ridx > min_index or min_index == 0:
                 return {"Index": max(ridx, 1), **result}
@@ -965,8 +987,12 @@ class Server:
                 return {"Index": max(ridx, 1), **result}
             # wait past the TABLE snapshot (idx), not min_index: with a
             # per-result index the table may already be far ahead
-            self.state.block_until(tables, idx,
-                                   min(remaining, 1.0))
+            _parked(+1)
+            try:
+                self.state.block_until(tables, idx,
+                                       min(remaining, 1.0))
+            finally:
+                _parked(-1)
 
     # ----------------------------------------------------- serf event plane
 

@@ -313,6 +313,19 @@ CHECKPOINT_HEADER_FIELDS = (
 CHECKPOINT_CARRIES = ("lanes", "scalars", "table", "flight",
                       "blackbox", "coords", "topo")
 
+#: `bench.py --mesh` weak-scaling ladder row schema, in canonical
+#: order — MULTICHIP_r*.json consumers (README tables, the verdict's
+#: reproduction scripts) decode these keys, so growth re-pins the
+#: digest. PR 10 adds the per-device round-time skew triple
+#: (dev_ms_min/dev_ms_max/dev_skew): mesh stragglers visible next to
+#: loadavg_1m.
+MESH_LADDER_ROW = (
+    "devices", "n", "stale_k", "loadavg_1m",
+    "rounds_per_sec", "ms_per_round",
+    "dev_ms_min", "dev_ms_max", "dev_skew",
+    "weak_scaling_efficiency",
+)
+
 
 def flight_columns() -> tuple[str, ...]:
     """The full flight-trace row layout, in column order."""
@@ -335,7 +348,8 @@ def layout_digest() -> str:
                   SWEEP_INT_LEAVES,
                   FAULT_KINDS, BYZANTINE_FAULT_KINDS,
                   (str(CHECKPOINT_VERSION),),
-                  CHECKPOINT_HEADER_FIELDS, CHECKPOINT_CARRIES):
+                  CHECKPOINT_HEADER_FIELDS, CHECKPOINT_CARRIES,
+                  MESH_LADDER_ROW):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

@@ -1,0 +1,720 @@
+"""Serving-plane latency observatory: per-stage request attribution
+and constant-memory streaming histograms.
+
+`telemetry.py` (PR 2) counts and samples; `trace.py` (PR 4) records
+individual spans. Neither can answer the question ROADMAP item 4's
+optimization PR will be judged against: *which stage* of a KV request
+is slow under sustained load. The sample buffers cap at 4096 entries —
+a 10-minute soak at 5k req/s throws away 99.9% of its measurements and
+the percentiles quietly become "percentiles of the last 0.8 seconds".
+
+This module adds the two missing primitives:
+
+  * ``StreamingHistogram`` — HDR-style log-bucketed latency histogram:
+    ~94 fixed buckets covering 1µs..60s at 12 buckets per decade
+    (bucket boundaries at ``1e-6 * 10**(i/12)``), int64 counts, O(1)
+    constant memory forever, mergeable across threads/registries, with
+    p50/p90/p99/p999 reconstruction whose error is bounded by one
+    bucket's width (a factor of ``10**(1/12) ≈ 1.21``).
+
+  * the **stage ledger** — every HTTP/RPC request carries a list of
+    (stage, offset, duration, depth) records through its thread
+    (a contextvar, so nested stages — ``store.read`` inside
+    ``rpc.handler`` — attribute without plumbing). Stage timings feed
+    one process-global histogram per stage name AND, for requests
+    slower than ``SPAN_MIN_MS``, are mirrored into the PR 4 span ring
+    so `/v1/agent/trace?format=perfetto` shows socket→raft→fsm as one
+    flamegraph.
+
+Stage taxonomy (the request's life, in order — ``STAGES`` below):
+
+  HTTP:  http.read (request line+header parse) → http.decode (query +
+         body) → http.route (the handler; store/raft stages nest
+         inside) → http.encode (json) → http.write (socket)
+  RPC:   rpc.read (frame body + msgpack decode; the idle wait for the
+         header is deliberately NOT counted) → rpc.dispatch (worker
+         queue) → rpc.handler → rpc.commit_wait (async write path:
+         group-commit wait, no thread parked) → rpc.write
+  inner: store.read (blocking_query's state closure),
+         raft.commit_wait (sync batcher park), raft.apply_batch
+         (append→replicate→commit), raft.fsm.apply (applier thread)
+
+Depth-0 ledger entries are non-overlapping intervals of one request's
+wall time, so their sum is ≤ the end-to-end latency by construction —
+pinned by tests/test_perf.py. Per-request end-to-end lands in
+``<kind>.e2e``.
+
+Kill switch: ``CONSUL_TPU_PERF=off`` (env, read at import) or
+``disarm()`` turns every hook into a no-op; the <2% overhead gate in
+tier-1 measures armed-vs-disarmed KV round-trips.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import math
+import os
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Any, Callable, Optional
+
+# --------------------------------------------------------------- buckets
+
+#: log-bucket scheme: 12 buckets per decade, 1µs .. >=60s
+BUCKETS_PER_DECADE = 12
+LO_S = 1e-6
+HI_S = 60.0
+_N_EDGES = int(math.ceil(
+    BUCKETS_PER_DECADE * math.log10(HI_S / LO_S))) + 1  # 95
+#: bucket upper bounds in seconds; bucket i holds v <= EDGES_S[i]
+#: (and > EDGES_S[i-1]); one final overflow bucket is +Inf
+EDGES_S = tuple(LO_S * 10 ** (i / BUCKETS_PER_DECADE)
+                for i in range(_N_EDGES))
+N_BUCKETS = _N_EDGES + 1  # + the +Inf overflow bucket
+
+#: the serving-plane stage taxonomy (order = request lifecycle).
+#: Consumers — /v1/agent/perf, bench_kv's attribution report, the
+#: ARCHITECTURE.md table — all key off these names; pinned by
+#: tests/test_perf.py::test_stage_taxonomy_pinned.
+STAGES = (
+    "http.read", "http.decode", "http.route",
+    "http.encode", "http.write", "http.e2e", "http.stages_sum",
+    "rpc.read", "rpc.dispatch", "rpc.handler",
+    "rpc.commit_wait", "rpc.write", "rpc.e2e", "rpc.stages_sum",
+    "store.read",
+    "raft.commit_wait", "raft.apply_batch", "raft.fsm.apply",
+)
+
+#: the DEPTH-0 partition per request kind: disjoint sub-intervals of
+#: one request's wall time (everything else nests inside these or runs
+#: on another thread). Attribution reports sum THESE against
+#: ``<kind>.e2e`` — summing nested stages too would double-count.
+TOP_STAGES = {
+    "http": ("http.read", "http.decode", "http.route",
+             "http.encode", "http.write"),
+    "rpc": ("rpc.read", "rpc.dispatch", "rpc.handler",
+            "rpc.commit_wait", "rpc.write"),
+}
+
+
+#: sorted edge list for bisect (bucket_index is on the per-request
+#: hot path: C bisect beats a log10 + correction loop)
+_EDGE_LIST = list(EDGES_S)
+
+
+def bucket_index(v: float) -> int:
+    """Bucket for a duration (seconds): smallest i with
+    v <= EDGES_S[i] (exact `le` semantics via bisect);
+    N_BUCKETS-1 (the +Inf bucket) past the last edge."""
+    return bisect_left(_EDGE_LIST, v)
+
+
+class StreamingHistogram:
+    """Fixed-bucket log histogram: int counts, O(1) memory, exact
+    sum/min/max, mergeable. LOCK-FREE: observe is the per-request hot
+    path and a lock there measurably moved the <2% overhead gate, so
+    writers rely on the GIL's per-bytecode atomicity instead. A
+    SHARED histogram written by many threads can in principle lose an
+    increment on a preemption mid `+=` (monitoring-grade; the perf
+    registry avoids even that by sharding per thread, merge-on-read).
+    Readers recompute the total from a bucket-counts copy so a
+    snapshot is always self-consistent (Σbuckets == count)."""
+
+    __slots__ = ("counts", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.counts = [0] * N_BUCKETS
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts)
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect_left(_EDGE_LIST, v)] += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def merge(self, other: "StreamingHistogram") -> None:
+        """Add `other`'s counts into self (bucket-wise — associative
+        and commutative, pinned by test_perf)."""
+        oc = list(other.counts)
+        counts = self.counts
+        for i, c in enumerate(oc):
+            if c:
+                counts[i] += c
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+
+    def quantile(self, q: float) -> float:
+        """Reconstructed q-quantile (seconds). The true value lies in
+        the same bucket, so the error is bounded by one bucket width:
+        a factor of 10**(1/12) ≈ 1.2115 (tested against exact sorts).
+        Linear interpolation inside the bucket; the overflow bucket
+        reports the observed max (the only honest point we have)."""
+        counts = list(self.counts)
+        total = sum(counts)
+        if not total:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            prev = cum
+            cum += c
+            if cum >= rank:
+                if i >= _N_EDGES:  # overflow bucket
+                    return self.max
+                lo = EDGES_S[i - 1] if i else \
+                    min(self.min, EDGES_S[0])
+                hi = EDGES_S[i]
+                frac = (rank - prev) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return self.max
+
+    def state(self) -> dict[str, Any]:
+        """Raw state for snapshots/diffs. `count` is recomputed from
+        the counts COPY, so the returned dict is self-consistent even
+        against concurrent lock-free writers."""
+        counts = list(self.counts)
+        total = sum(counts)
+        return {"counts": counts, "count": total,
+                "sum": self.sum,
+                "min": None if total == 0 or self.min is math.inf
+                else self.min,
+                "max": self.max}
+
+    @classmethod
+    def from_state(cls, st: dict[str, Any]) -> "StreamingHistogram":
+        h = cls()
+        h.counts = list(st["counts"])
+        h.sum = st["sum"]
+        h.min = math.inf if st.get("min") is None else st["min"]
+        h.max = st.get("max", 0.0)
+        return h
+
+
+def cumulative_buckets(counts: list) -> "list[tuple[str, int]]":
+    """(le_label, cumulative_count) pairs for prometheus histogram
+    exposition: le in seconds (%.9g), the overflow bucket as "+Inf".
+    The one shared definition of the cumulative-le encoding — both
+    exporters (PerfRegistry.prometheus, telemetry.Metrics.prometheus)
+    emit from this so they cannot drift."""
+    out = []
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        out.append((f"{EDGES_S[i]:.9g}" if i < _N_EDGES else "+Inf",
+                    cum))
+    return out
+
+
+def diff_state(cur: dict[str, Any],
+               prev: Optional[dict[str, Any]]) -> dict[str, Any]:
+    """Histogram-state delta cur - prev (both from ``state()``): the
+    sustained-load harness measures one concurrency level as the
+    difference of two registry snapshots. min/max are window-unknown
+    (counts are, exactly) — the delta keeps cur's."""
+    if prev is None:
+        counts = list(cur["counts"])
+    else:
+        counts = [a - b for a, b in zip(cur["counts"],
+                                        prev["counts"])]
+    return {
+        "counts": counts,
+        "count": sum(counts),
+        "sum": cur["sum"] - (prev["sum"] if prev else 0.0),
+        "min": cur.get("min"), "max": cur.get("max", 0.0),
+    }
+
+
+# ---------------------------------------------------------------- arming
+
+def _env_armed(val: Optional[str]) -> bool:
+    """CONSUL_TPU_PERF parse: off/0/false/no disable, anything else
+    (including unset) keeps the observatory armed."""
+    return (val or "").strip().lower() not in ("off", "0", "false",
+                                               "no")
+
+
+_armed = _env_armed(os.environ.get("CONSUL_TPU_PERF"))
+
+#: stage spans are mirrored into the PR 4 trace ring only for requests
+#: at least this slow — keeps the flamegraph layer off the fast-path
+#: cost (the mirror is ~4µs/request) while the requests worth a
+#: flamegraph — the slow tail under load — stay fully visible
+SPAN_MIN_MS = 5.0
+
+
+def armed() -> bool:
+    return _armed
+
+
+def arm() -> None:
+    global _armed
+    _armed = True
+
+
+def disarm() -> None:
+    global _armed
+    _armed = False
+
+
+# ---------------------------------------------------------------- ledger
+
+#: per-thread (and per-async-context) current request ledger
+_ledger_var: contextvars.ContextVar[Optional["Ledger"]] = \
+    contextvars.ContextVar("consul_tpu_perf_ledger", default=None)
+
+
+class Ledger:
+    """One request's stage records: (name, start_offset_s, dur_s,
+    depth). Depth-0 entries are disjoint intervals, so their durations
+    sum to ≤ the end-to-end latency (pinned in tier-1)."""
+
+    __slots__ = ("kind", "t0_pc", "t0_wall", "stages", "depth",
+                 "mark", "e2e")
+
+    def __init__(self, kind: str, read_s: float = 0.0) -> None:
+        now = time.perf_counter()
+        self.kind = kind
+        # the ledger opens read_s BEFORE its creation: the frame/header
+        # service time measured by the transport loop is part of this
+        # request's life. t0_wall (for span export) is derived at
+        # close() — no time.time() syscall on the open path.
+        self.t0_pc = now - read_s
+        self.t0_wall = 0.0
+        self.stages: list[tuple[str, float, float, int]] = []
+        self.depth = 0
+        self.mark = now  # free-use timestamp (async commit-wait seam)
+        self.e2e = 0.0
+        if read_s > 0.0:
+            self.stages.append((f"{kind}.read", 0.0, read_s, 0))
+
+    def add(self, name: str, dur: float,
+            off: Optional[float] = None, depth: int = 0) -> None:
+        self.stages.append((
+            name,
+            (time.perf_counter() - self.t0_pc - dur)
+            if off is None else off,
+            dur, depth))
+
+
+class _NoopStage:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopStage()
+
+
+class _Stage:
+    """Armed stage context: times itself, feeds the global stage
+    histogram, and attributes to the current ledger (nested depth)."""
+
+    __slots__ = ("name", "_t0", "_led")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        led = _ledger_var.get()
+        self._led = led
+        if led is not None:
+            led.depth += 1
+        self._t0 = time.perf_counter()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        led = self._led
+        if led is not None:
+            led.depth -= 1
+            led.stages.append((self.name,
+                               self._t0 - led.t0_pc, dur, led.depth))
+        default.observe(self.name, dur)
+        return False
+
+
+def stage(name: str):
+    """Time one stage of the current request. No-op when disarmed."""
+    if not _armed:
+        return _NOOP
+    return _Stage(name)
+
+
+def ledger(kind: str, read_s: float = 0.0) -> Optional[Ledger]:
+    """Open a request ledger (None when disarmed — every consumer is
+    None-safe). A transport-measured read_s seeds the <kind>.read
+    stage, ledger AND global histogram."""
+    if not _armed:
+        return None
+    if read_s > 0.0:
+        default.observe(f"{kind}.read", read_s)
+    return Ledger(kind, read_s)
+
+
+def record(led: Optional[Ledger], name: str, dur: float,
+           off: Optional[float] = None, depth: int = 0) -> None:
+    """Record an externally-timed stage (the transport loops measure
+    read/dispatch outside any context manager): feeds the global
+    histogram and, when a ledger is given, attributes to it."""
+    if not _armed:
+        return
+    default.observe(name, dur)
+    if led is not None:
+        led.add(name, dur, off, depth)
+
+
+def attach(led: Optional[Ledger]):
+    """Bind `led` as the current context's ledger (stages on this
+    thread attribute to it). Returns a token for ``detach``."""
+    if led is None:
+        return None
+    return _ledger_var.set(led)
+
+
+def detach(token) -> None:
+    if token is not None:
+        _ledger_var.reset(token)
+
+
+#: bounded ring of recently-closed ledgers, for tests and debugging.
+#: maxlen 0 = disabled (the default: closed ledgers are not retained).
+LEDGER_RING: deque = deque(maxlen=0)
+
+
+def keep_ledgers(n: int) -> None:
+    """Retain the last n closed ledgers in LEDGER_RING (tests; n=0
+    disables again)."""
+    global LEDGER_RING
+    LEDGER_RING = deque(maxlen=n)
+
+
+def close(led: Optional[Ledger]) -> None:
+    """Finish a request ledger: observe <kind>.e2e, optionally retain,
+    and mirror the stages into the span ring for slow requests."""
+    if led is None:
+        return
+    led.e2e = time.perf_counter() - led.t0_pc
+    default.observe(f"{led.kind}.e2e", led.e2e)
+    # the request's attributed total: sum of its depth-0 stages (≤ e2e
+    # by construction — disjoint intervals). Its own histogram makes
+    # the p50 coverage claim sound: p50(stages_sum)/p50(e2e) compares
+    # the same request population, where summing per-stage p50s across
+    # mixed read/write classes would not be additive.
+    default.observe(f"{led.kind}.stages_sum",
+                    sum(s[2] for s in led.stages if s[3] == 0))
+    if LEDGER_RING.maxlen:
+        LEDGER_RING.append(led)
+    if led.e2e * 1000.0 >= SPAN_MIN_MS and led.stages:
+        led.t0_wall = time.time() - led.e2e
+        _emit_stage_spans(led)
+
+
+def abandon(led: Optional[Ledger]) -> None:
+    """Drop a ledger without observing e2e (streaming responses: the
+    chunk loop's lifetime is the client's window, not a latency)."""
+    return None
+
+
+def _emit_stage_spans(led: Ledger) -> None:
+    """Mirror one slow request's stage ledger into the PR 4 span ring
+    (utils/trace.py) so `/v1/agent/trace?format=perfetto` renders the
+    stages nested under the request's span by time containment."""
+    try:
+        from consul_tpu.utils import trace as trace_mod
+
+        emit = trace_mod.default.emit
+        for name, off, dur, depth in led.stages:
+            emit(name, led.t0_wall + off, dur * 1000.0,
+                 stage=True, depth=depth, kind=led.kind)
+    except Exception:  # noqa: BLE001 — observability never raises
+        pass
+
+
+# -------------------------------------------------------------- registry
+
+class PerfRegistry:
+    """Process-global stage histograms + queue-depth gauges. Served by
+    `/v1/agent/perf`, diffed by the sustained-load harness, dumped
+    into `cli debug` bundles.
+
+    Hot-path design: histograms are sharded PER THREAD (a
+    threading.local dict of name → StreamingHistogram) so observe()
+    takes no lock at all — each shard has exactly one writer, and
+    readers merge every shard bucket-wise on demand (the histograms
+    are associative, pinned by test_perf). The registry lock guards
+    only shard registration and the low-rate gauges."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._shards: list[
+            tuple[threading.Thread, dict[str, StreamingHistogram]]] = []
+        # dead threads' shards folded here at read time — blocking
+        # queries get a dedicated thread each (rpc.py), so without
+        # reaping, _shards would grow one entry per query forever
+        self._retired: dict[str, StreamingHistogram] = {}
+        self._gauges: dict[str, float] = {}
+        self._gauge_fns: dict[str, Callable[[], float]] = {}
+
+    # hot path ----------------------------------------------------------
+    def observe(self, name: str, seconds: float) -> None:
+        if not _armed:
+            return
+        try:
+            shard = self._tls.hists
+        except AttributeError:
+            shard = self._tls.hists = {}
+            with self._lock:
+                self._shards.append((threading.current_thread(),
+                                     shard))
+        h = shard.get(name)
+        if h is None:
+            h = shard[name] = StreamingHistogram()
+        h.observe(seconds)
+
+    def gauge_set(self, name: str, value: float) -> None:
+        if not _armed:
+            return
+        with self._lock:
+            self._gauges[name] = value
+
+    def gauge_add(self, name: str, delta: float) -> None:
+        if not _armed:
+            return
+        with self._lock:
+            self._gauges[name] = self._gauges.get(name, 0.0) + delta
+
+    def gauge_fn(self, name: str, fn: Callable[[], float]) -> None:
+        """Register a POLLED gauge: evaluated at snapshot time instead
+        of paying a registry lock on every transition (the mux
+        in-flight and blocking-herd counters are per-request-rate)."""
+        with self._lock:
+            self._gauge_fns[name] = fn
+
+    def _gauges_now(self) -> dict[str, float]:
+        with self._lock:
+            gauges = dict(self._gauges)
+            fns = list(self._gauge_fns.items())
+        for name, fn in fns:
+            try:
+                gauges[name] = fn()
+            except Exception:  # noqa: BLE001 — gauges never raise
+                pass
+        return gauges
+
+    # export ------------------------------------------------------------
+    def _merged(self) -> dict[str, StreamingHistogram]:
+        """Merge every thread shard into fresh per-stage histograms
+        (read path only; shards keep being written concurrently —
+        bucket counts read under the GIL are consistent). Shards whose
+        owning thread has exited are folded into the retired
+        accumulator first and dropped: they have no writer anymore, so
+        the fold is exact, and a thread-per-blocking-query server stays
+        at O(live threads) shards instead of growing forever."""
+        agg: dict[str, StreamingHistogram] = {}
+        with self._lock:
+            if any(not t.is_alive() for t, _ in self._shards):
+                live = []
+                for t, shard in self._shards:
+                    if t.is_alive():
+                        live.append((t, shard))
+                        continue
+                    for name, h in shard.items():
+                        acc = self._retired.get(name)
+                        if acc is None:
+                            acc = self._retired[name] = \
+                                StreamingHistogram()
+                        acc.merge(h)
+                self._shards[:] = live
+            for name, h in self._retired.items():
+                acc = agg[name] = StreamingHistogram()
+                acc.merge(h)
+            shards = [s for _, s in self._shards]
+        for shard in shards:
+            for name in list(shard):
+                h = shard.get(name)
+                if h is None:
+                    continue
+                acc = agg.get(name)
+                if acc is None:
+                    acc = agg[name] = StreamingHistogram()
+                acc.merge(h)
+        return agg
+
+    def raw(self) -> dict[str, Any]:
+        """Raw histogram states keyed by stage (diffable; the harness
+        snapshots this before/after each load level)."""
+        hists = self._merged()
+        return {"hists": {n: h.state()
+                          for n, h in sorted(hists.items())},
+                "gauges": self._gauges_now()}
+
+    def snapshot(self, min_count: int = 0,
+                 prefix: str = "") -> dict[str, Any]:
+        """The `/v1/agent/perf` JSON shape: per-stage quantiles +
+        non-zero buckets, queue gauges, and the bucket scheme."""
+        hists = self._merged()
+        gauges = self._gauges_now()
+        stages: dict[str, Any] = {}
+        for name in sorted(hists):
+            if prefix and not name.startswith(prefix):
+                continue
+            h = hists[name]
+            st = h.state()
+            if st["count"] < max(min_count, 1):
+                continue
+            stages[name] = {
+                "Count": st["count"],
+                "SumMs": round(st["sum"] * 1000.0, 4),
+                "MinMs": round((st["min"] or 0.0) * 1000.0, 5),
+                "MaxMs": round(st["max"] * 1000.0, 4),
+                "P50Ms": round(h.quantile(0.50) * 1000.0, 5),
+                "P90Ms": round(h.quantile(0.90) * 1000.0, 5),
+                "P99Ms": round(h.quantile(0.99) * 1000.0, 5),
+                "P999Ms": round(h.quantile(0.999) * 1000.0, 5),
+                # non-zero buckets as [upper_bound_s, count] pairs
+                # (+Inf bound serialized as null)
+                "Buckets": [
+                    [EDGES_S[i] if i < _N_EDGES else None, c]
+                    for i, c in enumerate(st["counts"]) if c],
+            }
+        return {
+            "Enabled": _armed,
+            "BucketScheme": {"PerDecade": BUCKETS_PER_DECADE,
+                             "LoS": LO_S, "HiS": HI_S,
+                             "NumBuckets": N_BUCKETS},
+            "Stages": stages,
+            "Gauges": {k: gauges[k] for k in sorted(gauges)},
+        }
+
+    def prometheus(self) -> str:
+        """Native Prometheus histogram exposition: one family
+        ``consul_perf_stage_duration_seconds`` with a ``stage`` label,
+        cumulative ``_bucket`` counts with ``le`` in seconds, plus the
+        queue gauges."""
+        hists = self._merged()
+        gauges = self._gauges_now()
+        lines = ["# TYPE consul_perf_stage_duration_seconds histogram"]
+        for name in sorted(hists):
+            st = hists[name].state()
+            if not st["count"]:
+                continue
+            for le, cum in cumulative_buckets(st["counts"]):
+                lines.append(
+                    'consul_perf_stage_duration_seconds_bucket'
+                    f'{{stage="{name}",le="{le}"}} {cum}')
+            lines.append('consul_perf_stage_duration_seconds_sum'
+                         f'{{stage="{name}"}} {st["sum"]:.9g}')
+            lines.append('consul_perf_stage_duration_seconds_count'
+                         f'{{stage="{name}"}} {st["count"]}')
+        for name in sorted(gauges):
+            metric = "consul_perf_" + name.replace(".", "_") \
+                .replace("-", "_")
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {gauges[name]:g}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            # clear shard CONTENTS (other threads hold references to
+            # their shard dicts — dropping the list would silently
+            # orphan their future observations)
+            for _, shard in self._shards:
+                shard.clear()
+            self._retired.clear()
+            self._gauges.clear()
+
+
+def stage_report(cur: dict[str, Any], prev: Optional[dict[str, Any]],
+                 kind: str) -> dict[str, Any]:
+    """Latency-attribution report over a snapshot window: per-stage
+    count/p50/p99 + the share each DEPTH-0 stage contributes to the
+    end-to-end p50 and mean. `cur`/`prev` come from
+    ``PerfRegistry.raw()``; kind is "rpc" or "http".
+
+    Share math: depth-0 stages are disjoint intervals of one request,
+    so per-request their durations sum to ≤ the end-to-end latency.
+    Two totals are reported:
+
+      * ``share_p50_total`` = p50(<kind>.stages_sum) / p50(<kind>.e2e)
+        — the attributed fraction of the MEDIAN request's wall time
+        (both histograms cover the same request population, so the
+        ratio is sound where summing per-stage p50s across mixed
+        read/write classes would not be; ≥ 0.9 is the coverage bar);
+      * ``share_mean_total`` = Σ stage_mean·rate / e2e_mean — exactly
+        additive, but a blocking-query herd's parked seconds dominate
+        means, so the p50 figure is the headline.
+
+    Per-stage ``share_mean`` uses the additive basis."""
+    hists = {}
+    for name, st in cur["hists"].items():
+        d = diff_state(st, (prev or {"hists": {}})["hists"].get(name))
+        if d["count"] > 0:
+            hists[name] = StreamingHistogram.from_state(d)
+    e2e = hists.get(f"{kind}.e2e")
+    out: dict[str, Any] = {"kind": kind, "stages": {}, "inner": {}}
+    if e2e is None or not e2e.count:
+        out["error"] = f"no {kind}.e2e observations in window"
+        return out
+    e2e_p50 = e2e.quantile(0.5)
+    e2e_mean = e2e.sum / e2e.count
+    out["e2e"] = {"count": e2e.count,
+                  "p50_ms": round(e2e_p50 * 1e3, 4),
+                  "p99_ms": round(e2e.quantile(0.99) * 1e3, 4),
+                  "mean_ms": round(e2e_mean * 1e3, 4)}
+    sum_mean = 0.0
+    for name in TOP_STAGES[kind]:
+        h = hists.get(name)
+        if h is None or not h.count:
+            continue
+        mean = h.sum / h.count
+        # per-request weight: stages occur at most once per request,
+        # but not every request has every stage (commit_wait is
+        # write-path only) — weight by occurrence rate
+        rate = min(h.count / e2e.count, 1.0)
+        sum_mean += mean * rate
+        out["stages"][name] = {
+            "count": h.count,
+            "p50_ms": round(h.quantile(0.5) * 1e3, 4),
+            "p99_ms": round(h.quantile(0.99) * 1e3, 4),
+            "mean_ms": round(mean * 1e3, 4),
+            "share_mean": round(mean * rate / e2e_mean, 4),
+        }
+    ssum = hists.get(f"{kind}.stages_sum")
+    out["share_p50_total"] = (
+        round(ssum.quantile(0.5) / e2e_p50, 4)
+        if ssum is not None and ssum.count else None)
+    out["share_mean_total"] = round(sum_mean / e2e_mean, 4)
+    for name in ("store.read", "raft.commit_wait",
+                 "raft.apply_batch", "raft.fsm.apply"):
+        h = hists.get(name)
+        if h is None or not h.count:
+            continue
+        out["inner"][name] = {
+            "count": h.count,
+            "p50_ms": round(h.quantile(0.5) * 1e3, 4),
+            "p99_ms": round(h.quantile(0.99) * 1e3, 4),
+        }
+    return out
+
+
+#: process-global registry (the go-metrics-style default every hot
+#: path records into; `/v1/agent/perf` and `cli debug` read it)
+default = PerfRegistry()

@@ -13,6 +13,8 @@ import time
 from collections import defaultdict
 from typing import Iterable, Optional
 
+from consul_tpu.utils.perf import StreamingHistogram, cumulative_buckets
+
 _Label = tuple[tuple[str, str], ...]
 
 
@@ -55,6 +57,12 @@ class Metrics:
         # load
         self._sample_totals: dict[tuple[str, _Label], list[float]] = \
             defaultdict(lambda: [0.0, 0.0])
+        # log-bucketed hot-path timers (utils/perf.py buckets):
+        # constant memory under sustained load where the sample
+        # buffer's sliding window silently becomes "percentiles of
+        # the last second" — and natively exportable as a prometheus
+        # `histogram` family instead of a summary
+        self._hists: dict[tuple[str, _Label], StreamingHistogram] = {}
 
     def incr(self, name: str, value: float = 1.0,
              labels: Optional[dict[str, str]] = None) -> None:
@@ -85,6 +93,24 @@ class Metrics:
     def time(self, name: str, labels: Optional[dict[str, str]] = None):
         return _TimeCtx(self, name, labels)
 
+    def hist(self, name: str, value_ms: float,
+             labels: Optional[dict[str, str]] = None) -> None:
+        """Observe into a log-bucketed streaming histogram (stored in
+        seconds; JSON snapshot reports ms like the samples, prometheus
+        exports the native histogram family in seconds)."""
+        k = _key(name, labels)
+        h = self._hists.get(k)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(k, StreamingHistogram())
+        h.observe(value_ms / 1000.0)
+
+    def measure_hist(self, name: str, start: float,
+                     labels: Optional[dict[str, str]] = None) -> None:
+        """measure_since for histogram-backed hot-path timers
+        (http.request / rpc.request / raft.fsm.apply)."""
+        self.hist(name, (time.monotonic() - start) * 1000.0, labels)
+
     # --- export ----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -110,6 +136,23 @@ class Metrics:
                     "P50": srt[len(srt) // 2],
                     "P99": srt[min(len(srt) - 1, int(len(srt) * 0.99))],
                     "Labels": dict(labels)})
+            # histogram timers keep the same Sample row shape (ms,
+            # reconstructed percentiles) so JSON consumers are
+            # unchanged; "Histogram": true marks the backing store
+            for (name, labels), h in sorted(self._hists.items()):
+                st = h.state()
+                if not st["count"]:
+                    continue
+                out["Samples"].append({
+                    "Name": f"{self.prefix}.{name}",
+                    "Count": st["count"],
+                    "Min": (st["min"] or 0.0) * 1000.0,
+                    "Max": st["max"] * 1000.0,
+                    "Mean": st["sum"] / st["count"] * 1000.0,
+                    "P50": h.quantile(0.50) * 1000.0,
+                    "P99": h.quantile(0.99) * 1000.0,
+                    "Histogram": True,
+                    "Labels": dict(labels)})
             return out
 
     def prometheus(self) -> str:
@@ -125,6 +168,7 @@ class Metrics:
             samples = [(k, (tot[0], int(tot[1])))
                        for k, tot in sorted(self._sample_totals.items())
                        if tot[1]]
+            hists = sorted(self._hists.items())
         lines: list[str] = []
 
         def family(items, kind: str, suffix: str = "") -> None:
@@ -145,6 +189,26 @@ class Metrics:
         family(counters, "counter", "_total")
         family(gauges, "gauge")
         family(samples, "summary")
+        # log-bucketed timers as NATIVE histogram families: cumulative
+        # _bucket counts with le in SECONDS (the exposition-format
+        # convention for durations), _sum/_count to match. The legacy
+        # timers above stay summaries.
+        last = None
+        for (name, labels), h in hists:
+            st = h.state()
+            if not st["count"]:
+                continue
+            metric = _prom_name(self.prefix, name)
+            if metric != last:
+                lines.append(f"# TYPE {metric} histogram")
+                last = metric
+            for le, cum in cumulative_buckets(st["counts"]):
+                lines.append(_prom_sample(
+                    metric + "_bucket", labels + (("le", le),), cum))
+            lines.append(_prom_sample(metric + "_sum", labels,
+                                      st["sum"]))
+            lines.append(_prom_sample(metric + "_count", labels,
+                                      st["count"]))
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -153,6 +217,7 @@ class Metrics:
             self._gauges.clear()
             self._samples.clear()
             self._sample_totals.clear()
+            self._hists.clear()
 
 
 def _prom_name(prefix: str, name: str) -> str:

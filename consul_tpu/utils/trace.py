@@ -149,6 +149,31 @@ class Tracer:
             except Exception:  # noqa: BLE001 — sinks never hurt hot paths
                 pass
 
+    def emit(self, name: str, start_wall: float, duration_ms: float,
+             parent: Optional[int] = None, **tags: Any) -> None:
+        """Record an externally-timed span (the perf stage ledger
+        mirrors a slow request's stages here after the fact): same
+        ring/sink path as a finished Span, with caller-supplied
+        start/duration instead of live clocks. Perfetto nests these
+        under the request's own span by time containment."""
+        rec = {
+            "id": next(self._ids),
+            "parent": parent,
+            "name": name,
+            "start": start_wall,
+            "duration_ms": round(duration_ms, 4),
+            "thread": threading.current_thread().name,
+            "tags": tags,
+        }
+        with self._lock:
+            self._ring.append(rec)
+            sinks = list(self._sinks)
+        for fn in sinks:
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — sinks never hurt hot paths
+                pass
+
     # -------------------------------------------------------- querying
 
     def recent(self, limit: Optional[int] = None, min_ms: float = 0.0,

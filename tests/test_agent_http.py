@@ -340,9 +340,30 @@ def test_metrics_prometheus_format(agent, client):
     text = raw.decode()
     assert "# TYPE consul_sim_live_frac gauge" in text
     assert "consul_sim_live_frac " in text
-    # request-latency samples export as summaries
-    assert "# TYPE consul_http_request summary" in text
+    # the http.request hot-path timer is a log-bucketed histogram now
+    # (utils/perf.py buckets): NATIVE prometheus histogram family with
+    # cumulative le buckets, not a summary
+    assert "# TYPE consul_http_request histogram" in text
+    assert "consul_http_request_bucket" in text
+    assert 'le="+Inf"' in text
+    assert "consul_http_request_sum" in text
+    assert "consul_http_request_count" in text
     assert 'method="GET"' in text
+    # legacy (sample-buffer) timers still export as summaries
+    telemetry.default.sample("test.legacy_timer", 1.5)
+    text_l = client._call("GET", "/v1/agent/metrics",
+                          {"format": "prometheus"})[0].decode()
+    assert "# TYPE consul_test_legacy_timer summary" in text_l
+    # cumulative bucket counts are monotone and end at _count
+    buckets = [ln for ln in text.splitlines()
+               if ln.startswith("consul_http_request_bucket")
+               and 'method="GET"' in ln]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)
+    total = [ln for ln in text.splitlines()
+             if ln.startswith("consul_http_request_count")
+             and 'method="GET"' in ln]
+    assert counts[-1] == int(total[0].rsplit(" ", 1)[1])
     # every sample line's metric name was sanitized (no dots/dashes)
     for line in text.splitlines():
         if not line.startswith("#"):
@@ -354,6 +375,80 @@ def test_metrics_prometheus_format(agent, client):
     text2 = client._call("GET", "/v1/agent/metrics",
                          {"format": "prometheus"})[0].decode()
     assert r'v="a\"b\\c\nd"' in text2
+
+
+def test_perf_endpoint_stage_breakdown(agent, client):
+    """/v1/agent/perf: the serving-plane latency observatory
+    (utils/perf.py) over HTTP — per-stage streaming histograms with
+    reconstructed percentiles, non-zero buckets, and queue gauges.
+    The endpoint serves the SAME process-global registry the stage
+    hooks feed (cross-checked against perf.default below)."""
+    from consul_tpu.utils import perf
+
+    # guarantee stage observations: one write (commit_wait path) and
+    # one read (store.read path) through the real agent surface
+    client.kv_put("perf/seed", b"1")
+    client.kv_get("perf/seed")
+    snap = client.get("/v1/agent/perf")
+    assert snap["Enabled"] is True
+    assert snap["BucketScheme"]["NumBuckets"] == perf.N_BUCKETS
+    stages = snap["Stages"]
+    for name in ("http.route", "http.e2e", "store.read",
+                 "raft.commit_wait", "raft.fsm.apply"):
+        assert name in stages, (name, sorted(stages))
+        s = stages[name]
+        assert s["Count"] >= 1
+        assert s["P50Ms"] <= s["P99Ms"] <= s["P999Ms"]
+        # bucket counts conserve the total
+        assert sum(c for _, c in s["Buckets"]) == s["Count"]
+    # the endpoint is a VIEW of the process registry, not a copy:
+    # every stage it reports matches the registry's own counts at
+    # this instant (counts only grow, so >= guards racing traffic)
+    reg = perf.default.snapshot()
+    for name, s in stages.items():
+        assert reg["Stages"][name]["Count"] >= s["Count"]
+    # prometheus exposition: native histogram family, stage label,
+    # cumulative le buckets
+    raw = client.get_raw("/v1/agent/perf", format="prometheus")
+    text = raw.decode()
+    assert "# TYPE consul_perf_stage_duration_seconds histogram" \
+        in text
+    assert 'stage="http.route"' in text and 'le="+Inf"' in text
+    # filters
+    only_http = client.get("/v1/agent/perf", prefix="http.")
+    assert only_http["Stages"]
+    assert all(n.startswith("http.") for n in only_http["Stages"])
+
+
+def test_perf_endpoint_validation(agent, client):
+    for params in ({"format": "bogus"}, {"min_count": "-1"},
+                   {"min_count": "x"}):
+        with pytest.raises(APIError) as ei:
+            client.get("/v1/agent/perf", **params)
+        assert ei.value.code == 400
+
+
+def test_trace_perfetto_shows_stage_spans(agent, client):
+    """Stage ledgers of slow requests mirror into the span ring: the
+    Perfetto export shows socket→raft→fsm stages nested (by time
+    containment) under the request — one flamegraph per slow write."""
+    from consul_tpu.utils import perf
+
+    old = perf.SPAN_MIN_MS
+    perf.SPAN_MIN_MS = 0.0  # every request mirrors, however fast
+    try:
+        client.kv_put("perf/flame", b"1")
+    finally:
+        perf.SPAN_MIN_MS = old
+    spans = client.get("/v1/agent/trace")["Spans"]
+    staged = {s["name"] for s in spans if s["tags"].get("stage")}
+    assert {"http.decode", "http.route",
+            "http.write"} <= staged, staged
+    # the perfetto export renders them as complete events like any
+    # other span
+    pf = client.get("/v1/agent/trace", format="perfetto")
+    names = {e["name"] for e in pf["traceEvents"]}
+    assert "http.route" in names
 
 
 def test_metrics_stream_rejects_nonpositive_interval(agent, client):

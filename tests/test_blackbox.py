@@ -59,12 +59,12 @@ def test_layout_registry_digest_pinned():
     metrics.blackbox_report, the Pallas partial-sum lane slices,
     params.grid_params/TracedParams leaf builders, ARCHITECTURE.md
     tables) in the same change."""
-    # PR 9 re-pin (was 5f6df2b30d8a48eb): the digest now additionally
-    # covers the checkpoint header schema
-    # (registry.CHECKPOINT_HEADER_FIELDS / CHECKPOINT_CARRIES /
-    # CHECKPOINT_VERSION) — checkpoint files embed this digest, so a
-    # layout change refuses to load old snapshots by name
-    assert registry.layout_digest() == "821af5d83bff15bb"
+    # PR 10 re-pin (was 821af5d83bff15bb): the digest now additionally
+    # covers the `bench.py --mesh` ladder row schema
+    # (registry.MESH_LADDER_ROW) — PR 10 grew the rows by the
+    # per-device round-time skew triple (dev_ms_min/dev_ms_max/
+    # dev_skew), and MULTICHIP consumers decode those keys
+    assert registry.layout_digest() == "1113a9e8cf99fbd1"
 
 
 def test_reduce_lane_layout_pinned():

@@ -1,0 +1,542 @@
+"""Serving-plane latency observatory (consul_tpu/utils/perf.py):
+histogram bucket math, stage-ledger invariants, the sustained-load
+harness smoke, and the pinned instrumentation-overhead gate.
+
+The slow sustained-load soak is `-m slow`; everything else is tier-1
+(the 2-second harness smoke included — the observatory must stay
+measured every PR, same contract as PR 4's blackbox overhead bar).
+"""
+
+import math
+import random
+import threading
+import time
+
+import pytest
+
+from consul_tpu.utils import perf
+from consul_tpu.utils.perf import StreamingHistogram
+
+from helpers import wait_for  # noqa: E402
+
+
+# ------------------------------------------------------- bucket math
+
+
+def test_bucket_scheme_pinned():
+    """~90 log buckets covering 1µs..60s at 12/decade — consumers
+    (ARCHITECTURE.md table, /v1/agent/perf clients) assume this."""
+    assert perf.BUCKETS_PER_DECADE == 12
+    assert perf.EDGES_S[0] == 1e-6
+    assert perf.EDGES_S[-1] >= 60.0
+    assert 90 <= len(perf.EDGES_S) <= 96
+    assert perf.N_BUCKETS == len(perf.EDGES_S) + 1
+    # geometric spacing: every adjacent pair is one twelfth-decade
+    step = 10 ** (1 / 12)
+    for a, b in zip(perf.EDGES_S, perf.EDGES_S[1:]):
+        assert b / a == pytest.approx(step, rel=1e-9)
+
+
+def test_stage_taxonomy_pinned():
+    """The stage names are a host-side contract: the endpoint, the
+    bench harness's TOP_STAGES partition, and the docs key off them."""
+    assert perf.STAGES == (
+        "http.read", "http.decode", "http.route",
+        "http.encode", "http.write", "http.e2e", "http.stages_sum",
+        "rpc.read", "rpc.dispatch", "rpc.handler",
+        "rpc.commit_wait", "rpc.write", "rpc.e2e", "rpc.stages_sum",
+        "store.read",
+        "raft.commit_wait", "raft.apply_batch", "raft.fsm.apply",
+    )
+    for kind, tops in perf.TOP_STAGES.items():
+        for name in tops:
+            assert name in perf.STAGES, name
+        assert f"{kind}.e2e" in perf.STAGES
+
+
+def test_bucket_boundary_values():
+    """le semantics, float-exact on the edges: an observation equal to
+    an edge lands in THAT bucket; just above goes one up."""
+    for k in (0, 1, 17, 46, 93, len(perf.EDGES_S) - 1):
+        assert perf.bucket_index(perf.EDGES_S[k]) == k
+        assert perf.bucket_index(perf.EDGES_S[k] * 1.0000001) == k + 1
+    # below range → first bucket; above range → overflow (+Inf)
+    assert perf.bucket_index(0.0) == 0
+    assert perf.bucket_index(1e-9) == 0
+    assert perf.bucket_index(perf.EDGES_S[-1] * 1.01) \
+        == perf.N_BUCKETS - 1
+    assert perf.bucket_index(1e9) == perf.N_BUCKETS - 1
+    # count conservation across a spread of magnitudes
+    h = StreamingHistogram()
+    vals = [10 ** random.Random(3).uniform(-7, 2.2)
+            for _ in range(1000)]
+    for v in vals:
+        h.observe(v)
+    assert sum(h.counts) == h.count == 1000
+    assert h.min == min(vals) and h.max == max(vals)
+    assert h.sum == pytest.approx(sum(vals))
+
+
+def test_merge_associativity():
+    rng = random.Random(7)
+    hs = []
+    for _ in range(3):
+        h = StreamingHistogram()
+        for _ in range(500):
+            h.observe(rng.lognormvariate(-6, 2.5))
+        hs.append(h)
+
+    def merged(order):
+        acc = StreamingHistogram()
+        for i in order:
+            acc.merge(hs[i])
+        return acc
+
+    ab_c = merged([0, 1, 2])
+    c_ba = merged([2, 1, 0])
+    assert ab_c.counts == c_ba.counts
+    assert ab_c.count == c_ba.count == 1500
+    assert ab_c.sum == pytest.approx(c_ba.sum)
+    assert ab_c.min == c_ba.min and ab_c.max == c_ba.max
+    # merge equals observing the union
+    union = StreamingHistogram()
+    rng = random.Random(7)
+    for _ in range(3):
+        for _ in range(500):
+            union.observe(rng.lognormvariate(-6, 2.5))
+    assert union.counts == ab_c.counts
+
+
+def test_quantile_reconstruction_error_bound():
+    """Reconstructed quantiles vs an exact sort: the true value lies
+    in the same bucket, so the estimate is within one bucket width —
+    a factor of 10**(1/12) ≈ 1.2115 — of the exact order statistic."""
+    rng = random.Random(11)
+    vals = [rng.lognormvariate(-7, 2) for _ in range(5000)]
+    h = StreamingHistogram()
+    for v in vals:
+        h.observe(v)
+    vals.sort()
+    bound = 10 ** (1 / 12) * 1.001  # one bucket + float slack
+    for q in (0.5, 0.9, 0.99, 0.999):
+        exact = vals[min(len(vals) - 1, math.ceil(q * len(vals)) - 1)]
+        est = h.quantile(q)
+        assert exact / bound <= est <= exact * bound, (q, exact, est)
+    # monotone in q
+    qs = [h.quantile(q) for q in (0.1, 0.5, 0.9, 0.99, 0.999)]
+    assert qs == sorted(qs)
+
+
+def test_histogram_state_diff_window():
+    """diff_state: the harness's per-level window is the exact count
+    delta of two snapshots."""
+    h = StreamingHistogram()
+    for v in (1e-4, 2e-3, 5e-1):
+        h.observe(v)
+    before = h.state()
+    for v in (3e-3, 4e-3):
+        h.observe(v)
+    delta = perf.diff_state(h.state(), before)
+    assert delta["count"] == 2
+    assert sum(delta["counts"]) == 2
+    assert delta["sum"] == pytest.approx(7e-3)
+    w = StreamingHistogram.from_state(delta)
+    assert 2.9e-3 <= w.quantile(0.5) <= 4.4e-3
+
+
+# --------------------------------------------------- ledger invariants
+
+
+def test_stage_ledger_nesting_and_psum():
+    """Depth-0 stages are disjoint intervals → their sum is ≤ the
+    end-to-end latency; nested stages carry their depth."""
+    perf.keep_ledgers(8)
+    try:
+        led = perf.ledger("rpc", read_s=0.0005)
+        tok = perf.attach(led)
+        with perf.stage("rpc.handler"):
+            with perf.stage("store.read"):
+                time.sleep(0.001)
+            with perf.stage("store.read"):
+                pass
+        perf.detach(tok)
+        perf.close(led)
+        rec = perf.LEDGER_RING[-1]
+        assert rec.e2e > 0
+        by_depth = {}
+        for name, off, dur, depth in rec.stages:
+            assert off >= 0 and dur >= 0
+            by_depth.setdefault(depth, []).append(name)
+        assert by_depth[0] == ["rpc.read", "rpc.handler"]
+        assert by_depth[1] == ["store.read", "store.read"]
+        top = sum(d for _, _, d, dep in rec.stages if dep == 0)
+        assert top <= rec.e2e + 1e-9
+    finally:
+        perf.keep_ledgers(0)
+
+
+def test_kill_switch_disarms_everything():
+    """CONSUL_TPU_PERF=off semantics: no ledger, no-op stages, no
+    histogram writes, no gauges — the <2% gate's baseline arm."""
+    assert perf._env_armed(None) is True
+    assert perf._env_armed("on") is True
+    for v in ("off", "0", "false", "no", " OFF "):
+        assert perf._env_armed(v) is False, v
+    was = perf.armed()
+    reg = perf.PerfRegistry()
+    try:
+        perf.disarm()
+        assert perf.ledger("rpc") is None
+        assert perf.stage("rpc.handler") is perf._NOOP
+        reg.observe("x", 1.0)
+        reg.gauge_add("g", 1)
+        assert reg.raw() == {"hists": {}, "gauges": {}}
+        assert reg.snapshot()["Enabled"] is False
+        perf.arm()
+        reg.observe("x", 1.0)
+        assert reg.raw()["hists"]["x"]["count"] == 1
+    finally:
+        (perf.arm if was else perf.disarm)()
+
+
+def test_registry_reaps_dead_thread_shards():
+    """Blocking queries park a dedicated thread each (rpc.py), so the
+    per-thread histogram shards MUST be reclaimed when threads exit:
+    dead shards fold into the retired accumulator at read time with
+    counts preserved exactly, and the shard list stays O(live
+    threads) instead of growing one entry per query forever."""
+    reg = perf.PerfRegistry()
+
+    def worker():
+        reg.observe("rpc.handler", 0.001)
+
+    for _ in range(64):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    reg.observe("rpc.handler", 0.002)  # live main-thread shard
+    snap = reg.raw()
+    assert snap["hists"]["rpc.handler"]["count"] == 65
+    assert len(reg._shards) <= 2  # main + at most one racing
+    # the diff window stays exact across a reap boundary
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    delta = perf.diff_state(reg.raw()["hists"]["rpc.handler"],
+                            snap["hists"]["rpc.handler"])
+    assert delta["count"] == 1
+
+
+def test_registry_snapshot_and_prometheus():
+    reg = perf.PerfRegistry()
+    for v in (0.0001, 0.001, 0.01, 0.01, 2.0):
+        reg.observe("rpc.handler", v)
+    reg.gauge_set("rpc.blocking.parked", 7)
+    snap = reg.snapshot()
+    s = snap["Stages"]["rpc.handler"]
+    assert s["Count"] == 5
+    assert sum(c for _, c in s["Buckets"]) == 5
+    assert s["P50Ms"] <= s["P99Ms"] <= s["P999Ms"]
+    assert snap["Gauges"]["rpc.blocking.parked"] == 7
+    # min_count / prefix filters
+    assert "rpc.handler" not in reg.snapshot(min_count=6)["Stages"]
+    assert reg.snapshot(prefix="http.")["Stages"] == {}
+    text = reg.prometheus()
+    assert "# TYPE consul_perf_stage_duration_seconds histogram" \
+        in text
+    assert 'stage="rpc.handler",le="+Inf"} 5' in text
+    assert "consul_perf_stage_duration_seconds_count" \
+           '{stage="rpc.handler"} 5' in text
+    assert "# TYPE consul_perf_rpc_blocking_parked gauge" in text
+    # cumulative bucket counts are monotone
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+            if ln.startswith("consul_perf_stage_duration_seconds_"
+                             "bucket")]
+    assert cums == sorted(cums)
+
+
+# ------------------------------------------------ cluster-level tests
+
+
+@pytest.fixture(scope="module")
+def kv_cluster():
+    """One dev server over real loopback RPC (the gate and smoke
+    drive the same mux port bench_kv does)."""
+    import bench_kv
+
+    servers, leader, follower = bench_kv.build_cluster(n=1)
+    yield servers, leader, follower
+    for s in servers:
+        s.shutdown()
+
+
+def _kv_round_trips(leader, pool, n_ops, threads=4):
+    """`threads` closed-loop clients, each n_ops mixed PUT/GET round
+    trips; returns total wall seconds."""
+    gate = threading.Barrier(threads + 1)
+
+    def worker(w):
+        gate.wait()
+        for i in range(n_ops):
+            if i % 4 == 0:
+                pool.call(leader.rpc.addr, "KVS.Apply", {
+                    "Op": "set",
+                    "DirEnt": {"Key": f"gate/{w}/{i % 16}",
+                               "Value": b"x" * 64}})
+            else:
+                pool.call(leader.rpc.addr, "KVS.Get",
+                          {"Key": f"gate/{w}/{(i - 1) % 16}"})
+
+    ts = [threading.Thread(target=worker, args=(w,), daemon=True)
+          for w in range(threads)]
+    for t in ts:
+        t.start()
+    gate.wait()
+    t0 = time.perf_counter()
+    for t in ts:
+        t.join()
+    return time.perf_counter() - t0
+
+
+def test_rpc_stage_attribution_psum(kv_cluster):
+    """End-to-end over the real mux port: every request's depth-0
+    stage sum is ≤ its end-to-end latency (the cross-check that the
+    ledger partition never double-counts), and the stage histograms
+    the harness reports actually filled."""
+    servers, leader, _ = kv_cluster
+    from consul_tpu.server.rpc import ConnPool
+
+    perf.keep_ledgers(256)
+    pool = ConnPool()
+    try:
+        before = perf.default.raw()
+        _kv_round_trips(leader, pool, n_ops=40, threads=4)
+        after = perf.default.raw()
+        ledgers = [led for led in perf.LEDGER_RING
+                   if led.kind == "rpc"]
+        assert len(ledgers) >= 100
+        for led in ledgers:
+            top = sum(d for _, _, d, dep in led.stages if dep == 0)
+            # strict: the async write path publishes the handler
+            # record before the commit-wait mark, so the depth-0
+            # intervals are disjoint even when an inline completion
+            # races the mux thread — only float summation slack left
+            assert top <= led.e2e + 1e-9, \
+                (top, led.e2e, led.stages)
+        rep = perf.stage_report(after, before, "rpc")
+        assert rep["e2e"]["count"] >= 160
+        for name in ("rpc.read", "rpc.handler", "rpc.write"):
+            assert rep["stages"][name]["count"] >= 100, name
+        assert rep["inner"]["store.read"]["count"] >= 100
+        assert rep["share_p50_total"] is not None
+        assert 0.5 <= rep["share_p50_total"] <= 1.01
+        assert rep["share_mean_total"] <= 1.01
+    finally:
+        perf.keep_ledgers(0)
+        pool.close()
+
+
+def test_harness_smoke_closed_loop(kv_cluster):
+    """`bench_kv --concurrency 4 --duration 2` equivalent, in-process:
+    the 2-second tier-1 smoke of the sustained-load harness (the full
+    multi-level soak with the herd is the slow-marked test below)."""
+    import bench_kv
+
+    servers, leader, follower = kv_cluster
+    rep = bench_kv.run_sustained(leader, follower, [4], 2.0,
+                                 herd=None)
+    assert len(rep["levels"]) == 1
+    row = rep["levels"][0]
+    assert row["concurrency"] == 4
+    assert row["total_ops"] > 0 and row["errors"] == 0
+    assert row["p50_ms"] <= row["p99_ms"]
+    att = row["attribution"]
+    assert att["e2e"]["count"] >= row["total_ops"]
+    assert att["share_p50_total"] is not None
+    assert 0.5 <= att["share_p50_total"] <= 1.01
+    assert len(row["window_rps"]) == 3
+    # the headline honors the PR 9 refusal band protocol: either a
+    # stable median or an explicit refusal reason — never a bare claim
+    hl = rep["headline_rps"]
+    assert ("unstable" in hl) != (hl["headline"] is not None)
+    assert rep["throughput_latency_curve"][0][0] == 4
+
+
+def test_harness_open_loop_paces_arrivals(kv_cluster):
+    """--open-loop RPS: scheduled arrivals — the measured throughput
+    tracks the offered rate (not the closed-loop maximum), and
+    latency is measured from the INTENDED send time."""
+    import bench_kv
+
+    servers, leader, follower = kv_cluster
+    rep = bench_kv.run_sustained(leader, follower, [2], 1.5,
+                                 open_rps=120.0, herd=None)
+    row = rep["levels"][0]
+    assert row["open_loop_rps"] == 120.0
+    # offered 120/s for 1.5s ≈ 180 ops; closed-loop would do 1000+/s
+    assert 100 <= row["rps"] <= 150, row["rps"]
+
+
+@pytest.mark.slow
+def test_sustained_load_with_herd_slow(kv_cluster):
+    """The full soak: two concurrency levels with the blocking-query
+    herd parked throughout — stage coverage stays ≥85% of the median
+    request and the herd gauge shows parked watchers."""
+    import bench_kv
+
+    servers, leader, follower = kv_cluster
+    herd = {"threads": 8, "keys": 4, "touch_interval_s": 0.25}
+    rep = bench_kv.run_sustained(leader, follower, [4, 8], 4.0,
+                                 herd=herd)
+    assert [r["concurrency"] for r in rep["levels"]] == [4, 8]
+    for row in rep["levels"]:
+        assert row["attribution"]["share_p50_total"] >= 0.85
+        assert row["fairness"]["jain"] > 0.5
+    assert any(r["gauges"].get("rpc.blocking.parked", 0) > 0
+               for r in rep["levels"])
+    assert len(rep["throughput_latency_curve"]) == 2
+
+
+#: overhead bar for the armed observatory on a KV round-trip
+#: (ISSUE 10 satellite: <2%, same blackbox-bar protocol as PR 4)
+OVERHEAD_BAR = 0.02
+
+
+def _perf_request_sequence():
+    """EXACTLY the per-request instrumentation sequence rpc.py wires
+    (ledger with seeded read, dispatch record, contextvar attach,
+    handler stage with a nested store.read, write stage, close with
+    e2e + stages_sum). Keep in sync with server/rpc.py — the gate
+    below times THIS against real round-trips."""
+    led = perf.ledger("rpc", read_s=2e-5)
+    if led is not None:
+        perf.record(led, "rpc.dispatch",
+                    time.perf_counter() - led.mark,
+                    off=led.mark - led.t0_pc)
+    tok = perf.attach(led)
+    with perf.stage("rpc.handler"):
+        with perf.stage("store.read"):
+            pass
+    with perf.stage("rpc.write"):
+        pass
+    perf.detach(tok)
+    perf.close(led)
+
+
+def test_instrumentation_overhead_gate(kv_cluster):
+    """Pinned <2% gate: stage ledger + histograms armed vs the
+    CONSUL_TPU_PERF=off kill switch, on KV PUT/GET round-trips
+    through the mux port (4 concurrent clients — the sustained-load
+    harness's shape).
+
+    A 2-core shared container cannot resolve 2% by differencing two
+    macro wall-time runs (paired A/B trials here measure ±50% trial
+    noise; process_time quantizes at ~10ms), so the gate measures the
+    two factors separately, each where it IS resolvable:
+
+      1. the ADDED work per request: the exact instrumented sequence
+         (above) timed armed-vs-disarmed over 20k reps — stable to
+         well under a microsecond;
+      2. the round-trip it dilutes: client-observed p50 of real KV
+         GETs and PUTs, measured armed under the harness's 4-client
+         concurrency.
+
+    Gate: added/p50 < 2% for BOTH op classes (GET is the tight one),
+    with a loose macro A/B sanity bound (median paired ratio < 1.5,
+    the host's actual A/B resolution — paired-trial medians of an
+    UNCHANGED binary measure up to ~1.4 here) so a contention bug the
+    microbench cannot see — a new lock on the request path — still
+    fails loudly."""
+    servers, leader, _ = kv_cluster
+    from consul_tpu.server.rpc import ConnPool
+
+    assert perf.armed(), "gate must measure the default-armed config"
+    import statistics
+
+    # --- factor 1: per-request instrumentation cost, armed/disarmed
+    def seq_cost(reps=20000):
+        _perf_request_sequence()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _perf_request_sequence()
+        return (time.perf_counter() - t0) / reps
+
+    try:
+        armed_costs, off_costs = [], []
+        for _ in range(3):  # min-of-3: robust to one GC pause
+            perf.arm()
+            armed_costs.append(seq_cost())
+            perf.disarm()
+            off_costs.append(seq_cost())
+        perf.arm()
+        added = min(armed_costs) - min(off_costs)
+        # the kill switch itself must be near-free
+        assert min(off_costs) < 3e-6, \
+            f"disarmed sequence costs {min(off_costs) * 1e6:.2f}µs"
+
+        # --- factor 2: real round-trip p50s under 4-client load
+        pool = ConnPool()
+        lat = {"get": [], "put": []}
+        gate = threading.Barrier(5)
+
+        def worker(w):
+            gate.wait()
+            for i in range(80):
+                kind = "put" if i % 4 == 0 else "get"
+                t0 = time.perf_counter()
+                if kind == "put":
+                    pool.call(leader.rpc.addr, "KVS.Apply", {
+                        "Op": "set",
+                        "DirEnt": {"Key": f"gate2/{w}/{i % 16}",
+                                   "Value": b"x" * 64}})
+                else:
+                    pool.call(leader.rpc.addr, "KVS.Get",
+                              {"Key": f"gate2/{w}/{(i - 1) % 16}"})
+                lat[kind].append(time.perf_counter() - t0)
+
+        ts = [threading.Thread(target=worker, args=(w,), daemon=True)
+              for w in range(4)]
+        for t in ts:
+            t.start()
+        gate.wait()
+        for t in ts:
+            t.join()
+        for kind in ("get", "put"):
+            p50 = statistics.median(lat[kind])
+            share = added / p50
+            assert share < OVERHEAD_BAR, (
+                f"stage ledger + histograms add {added * 1e6:.2f}µs "
+                f"per request = {share:.2%} of the {kind.upper()} "
+                f"p50 ({p50 * 1e3:.3f}ms) — over the "
+                f"{OVERHEAD_BAR:.0%} bar")
+
+        # --- macro sanity: armed/disarmed paired A/B, loose bound
+        def trial():
+            return _kv_round_trips(leader, pool, n_ops=40)
+
+        macro = None
+        for attempt in range(2):
+            ratios = []
+            for pair in range(6):
+                if pair % 2 == 0:
+                    perf.disarm()
+                    off = trial()
+                    perf.arm()
+                    on = trial()
+                else:
+                    perf.arm()
+                    on = trial()
+                    perf.disarm()
+                    off = trial()
+                ratios.append(on / off)
+            perf.arm()
+            macro = statistics.median(ratios)
+            if macro < 1.5:
+                break
+        assert macro < 1.5, (
+            f"macro armed/disarmed ratio {macro:.3f}: the armed path "
+            "is contending in a way the sequence microbench cannot "
+            "see (a lock on the request path?)")
+        pool.close()
+    finally:
+        perf.arm()
