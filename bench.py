@@ -45,6 +45,140 @@ import time
 _INIT_TIMEOUT_S = float(os.environ.get("CONSUL_TPU_BENCH_INIT_TIMEOUT", "180"))
 
 
+#: the mutually-exclusive top-level modes; everything else (--smoke,
+#: --profile, --ckpt-dir D, --resume) modifies one of them
+_MODES = ("--mesh", "--sweep", "--chaos", "--coords",
+          "--history", "--check-regression")
+
+
+def _usage(err: str) -> None:
+    """Flag-combination errors exit 2 with usage (the bench_kv
+    convention from PR 10) — the old behavior for `--profile --mesh`
+    was a stderr warning followed by silently running the OTHER mode,
+    which is exactly how a recorded number ends up measuring something
+    different from what its command line says."""
+    print(f"bench.py: {err}\n"
+          "usage: bench.py [--smoke] [--profile]\n"
+          "       bench.py --mesh|--sweep|--chaos [--smoke] "
+          "[--ckpt-dir D [--resume]]\n"
+          "       bench.py --coords [--smoke]\n"
+          "       bench.py --history\n"
+          "       bench.py --check-regression [--smoke]\n"
+          "(--profile applies to the throughput bench only; modes are "
+          "mutually exclusive)", file=sys.stderr)
+    sys.exit(2)
+
+
+def _record_root() -> str:
+    """Where the recorded *_r*.json artifacts live: next to this
+    script, overridable for tests via CONSUL_TPU_RECORD_ROOT."""
+    return os.environ.get("CONSUL_TPU_RECORD_ROOT") or \
+        os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_ledger_or_die():
+    """Load + schema-validate every recorded artifact; a broken
+    record is a hard error (rc 1), never silently skipped."""
+    from consul_tpu.sim import costmodel
+
+    try:
+        return costmodel.load_ledger(_record_root())
+    except costmodel.LedgerError as e:
+        print(f"recorded-artifact validation failed: {e}",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def run_history() -> None:
+    """`bench.py --history`: the perf-regression ledger's trajectory
+    table — every recorded BENCH/MULTICHIP/SWEEP/SERVE/PROFILE/BYZ/
+    CHAOS/COORDS artifact in the repo root, schema-validated and
+    reduced to one headline row each (sim/costmodel.py), so the bench
+    history is reconstructable from the loose files in one command."""
+    from consul_tpu.sim import costmodel
+
+    records = _load_ledger_or_die()
+    if not records:
+        print(f"no recorded *_r*.json artifacts under {_record_root()}",
+              file=sys.stderr)
+        sys.exit(2)
+    print(costmodel.format_history(costmodel.history_rows(records)))
+    print(f"\n{len(records)} records, "
+          f"{len({r['family'] for r in records})} families "
+          f"(root: {_record_root()})")
+
+
+def run_check_regression(smoke: bool) -> None:
+    """`bench.py --check-regression [--smoke]`: measure a fresh
+    headline and compare it against the LATEST recorded value of the
+    same metric under the PR 9 median+IQR refusal band
+    (costmodel.check_regression). Exit codes: 0 = pass (or the host
+    was too noisy to certify either way — printed, never silent),
+    1 = regression confirmed, 2 = no prior record of this metric
+    (a baseline is never fabricated; checked BEFORE the expensive
+    measurement)."""
+    from consul_tpu.sim import costmodel
+
+    metric = ("gossip_rounds_per_sec_smoke" if smoke
+              else "gossip_rounds_per_sec_1M_nodes")
+    records = _load_ledger_or_die()
+    base = costmodel.latest_metric(records, metric)
+    if base is None:
+        print(f"--check-regression: no recorded value of {metric!r} "
+              f"under {_record_root()} — record one first "
+              "(bench.py --profile writes PROFILE_r*.json); a "
+              "baseline is never fabricated", file=sys.stderr)
+        sys.exit(2)
+
+    want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
+    watchdog = _arm_watchdog(want, metric)
+    try:
+        import jax
+
+        if smoke:
+            jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        print(_error_line(f"backend init failed: {e}", want, metric))
+        sys.exit(1)
+    watchdog.cancel()
+
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams, init_state
+    from consul_tpu.sim.round import make_run_rounds_fast
+
+    n = 65_536 if smoke else 1_048_576
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
+                                     loss=0.01, tcp_fallback=False,
+                                     collect_stats=False)
+    chunk = 50 if smoke else 500
+    kernel = "xla-fused"
+    run = make_run_rounds_fast(p, chunk)
+    key = jax.random.key(0)
+    state = run(init_state(n), key)  # compile + warm (donates input)
+    jax.block_until_ready(state)
+    # one sample per trial, NOT best-of: the refusal band needs the
+    # honest spread to decide whether this host can claim anything
+    samples = []
+    for trial in range(5):
+        t0 = time.perf_counter()
+        state = run(state, jax.random.fold_in(key, trial + 1))
+        checksum = float(state.informed.sum())
+        samples.append(chunk / (time.perf_counter() - t0))
+        assert checksum > 0
+    res = costmodel.check_regression(samples, base["value"])
+    print(json.dumps({
+        "metric": metric,
+        "kernel": kernel,
+        "platform": jax.default_backend(),
+        "loadavg_1m": _loadavg_1m(),
+        "baseline_file": base["file"],
+        **res,
+    }))
+    sys.exit(1 if res["verdict"] == "regression" else 0)
+
+
 def _ckpt_args(argv):
     """--ckpt-dir D / --resume for the long-run modes: D arms the
     preemption guard + checkpoint/progress persistence
@@ -102,6 +236,80 @@ def _loadavg_1m():
         return round(os.getloadavg()[0], 2)
     except OSError:  # platform without getloadavg
         return None
+
+
+def _print_roofline(roofline: dict) -> None:
+    """The human roofline ladder (stderr — the driver parses stdout's
+    one JSON line; the same table rides the recorded PROFILE json
+    under profile.roofline)."""
+    bw = roofline["bandwidth"]
+    print(f"roofline peak: {bw['peak_gbps']} GB/s achievable "
+          f"(copy {bw['copy_gbps']}, triad {bw['triad_gbps']}; "
+          f"{bw['mbytes']} MB f32, {bw['platform']})", file=sys.stderr)
+    hdr = (f"{'config':<12} {'ms/round':>9} {'r/s':>9} "
+           f"{'model MB':>9} {'meas MB':>8} {'m/m':>6} {'GB/s':>7} "
+           f"{'util':>6} {'coll/r':>6}")
+    print(hdr, file=sys.stderr)
+    print("-" * len(hdr), file=sys.stderr)
+    for r in roofline["rows"]:
+        if "skipped" in r:
+            print(f"{r['config']:<12} skipped: {r['skipped'][:64]}",
+                  file=sys.stderr)
+            continue
+        meas = ("-" if r["bytes_measured"] is None
+                else f"{r['bytes_measured'] / 1e6:.2f}")
+        mm = ("-" if r["model_vs_measured"] is None
+              else f"{r['model_vs_measured']:.2f}"
+              + ("!" if r["flagged"] else ""))
+        util = "-" if r["util"] is None else f"{r['util']:.1%}"
+        print(f"{r['config']:<12} {r['ms_per_round']:>9.4f} "
+              f"{r['rounds_per_sec']:>9,.0f} "
+              f"{r['bytes_model'] / 1e6:>9.2f} {meas:>8} {mm:>6} "
+              f"{r['achieved_gbps']:>7.2f} {util:>6} "
+              f"{r['collectives_per_round']:>6.2f}", file=sys.stderr)
+    if roofline["flags"]:
+        print(f"FLAGGED (model vs measured beyond the pinned bound): "
+              f"{', '.join(roofline['flags'])}", file=sys.stderr)
+
+
+def _profile_schema_version() -> int:
+    from consul_tpu.sim import registry
+
+    return registry.PROFILE_SCHEMA_VERSION
+
+
+def _record_profile(envelope: dict) -> None:
+    """Record a v3 profile envelope as the next PROFILE_r<NN>.json
+    next to this script (the perf-regression ledger's input). The
+    record is schema-validated BEFORE writing — an envelope the ledger
+    would refuse is never recorded, it is reported."""
+    import re
+
+    from consul_tpu.sim import costmodel, registry
+
+    roofline = (envelope.get("profile") or {}).get("roofline")
+    measured = sum(1 for r in (roofline or {}).get("rows", ())
+                   if "skipped" not in r)
+    if measured < 6:
+        print(f"profile NOT recorded: a v{registry.PROFILE_SCHEMA_VERSION} "
+              f"PROFILE record needs >= 6 measured roofline configs, "
+              f"got {measured}", file=sys.stderr)
+        return
+    root = _record_root()
+    taken = [int(m.group(1)) for fn in os.listdir(root)
+             for m in [re.match(r"PROFILE_r(\d+)\.json$", fn)] if m]
+    name = f"PROFILE_r{max(taken, default=0) + 1:02d}.json"
+    try:
+        costmodel.validate_record(name, envelope)
+    except costmodel.LedgerError as e:
+        print(f"profile NOT recorded (would fail the ledger): {e}",
+              file=sys.stderr)
+        return
+    path = os.path.join(root, name)
+    with open(path, "w") as f:
+        json.dump(envelope, f, indent=1)
+        f.write("\n")
+    print(f"profile recorded: {path}", file=sys.stderr)
 
 
 def _error_line(error: str, platform: str, metric: str) -> str:
@@ -745,35 +953,41 @@ def run_coords_bench(smoke: bool) -> None:
 def main() -> None:
     # Local CPU smoke mode (documented in README): tiny cluster, same
     # code path end to end, finishes in ~a minute on one core.
-    smoke = "--smoke" in sys.argv[1:]
+    argv = sys.argv[1:]
+    smoke = "--smoke" in argv
     # --profile: wrap one extra run in jax.profiler.trace (dir recorded
     # in the JSON), split wall time into compile/dispatch/device stages,
-    # and measure the flight recorder's overhead at the default stride
-    profile = "--profile" in sys.argv[1:]
-    ckpt_dir, resume = _ckpt_args(sys.argv[1:])
-    if "--mesh" in sys.argv[1:]:
-        if profile:
-            print("--profile applies to the throughput bench only; "
-                  "ignored with --mesh", file=sys.stderr)
+    # measure the flight recorder's overhead at the default stride, and
+    # run the kernel-plane roofline ladder (sim/costmodel.py) — the
+    # result is recorded as PROFILE_r03.json next to this script
+    profile = "--profile" in argv
+    modes = [m for m in _MODES if m in argv]
+    if len(modes) > 1:
+        _usage(f"{' and '.join(modes)} are mutually exclusive modes")
+    if profile and modes:
+        _usage(f"--profile applies to the throughput bench only; it "
+               f"cannot be combined with {modes[0]}")
+    ckpt_dir, resume = _ckpt_args(argv)
+    if modes and modes[0] in ("--history", "--check-regression") \
+            and (ckpt_dir is not None or resume):
+        _usage(f"{modes[0]} takes no checkpoint flags")
+    if "--mesh" in argv:
         run_mesh_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
-    if "--sweep" in sys.argv[1:]:
-        if profile:
-            print("--profile applies to the throughput bench only; "
-                  "ignored with --sweep", file=sys.stderr)
+    if "--sweep" in argv:
         run_sweep_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
-    if "--chaos" in sys.argv[1:]:
-        if profile:
-            print("--profile applies to the throughput bench only; "
-                  "ignored with --chaos", file=sys.stderr)
+    if "--chaos" in argv:
         run_chaos_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
-    if "--coords" in sys.argv[1:]:
-        if profile:
-            print("--profile applies to the throughput bench only; "
-                  "ignored with --coords", file=sys.stderr)
+    if "--coords" in argv:
         run_coords_bench(smoke)
+        return
+    if "--history" in argv:
+        run_history()
+        return
+    if "--check-regression" in argv:
+        run_check_regression(smoke)
         return
     metric = ("gossip_rounds_per_sec_smoke" if smoke
               else "gossip_rounds_per_sec_1M_nodes")
@@ -1163,6 +1377,23 @@ def main() -> None:
                 print(f"megakernel profile unavailable ({e})",
                       file=sys.stderr)
                 mega_profile = None
+        # kernel-plane roofline ladder (sim/costmodel.py): analytic
+        # byte/FLOP model vs the compiled programs' own accounting vs
+        # measured achievable bandwidth, across the engine configs the
+        # tentpole names (xla, fast, lanes k in {1,2,4}, overlap,
+        # pallas rpc in {1,4,8}) — on the FULL-MODEL params, since the
+        # 7,717-r/s full-model kernel is the number needing explaining
+        roofline = None
+        if len(devices) == 1:
+            try:
+                from consul_tpu.sim import costmodel
+
+                roofline = costmodel.roofline_table(
+                    p_diag, rounds=24, reps=3)
+                _print_roofline(roofline)
+            except Exception as e:  # noqa: BLE001 — profile optional
+                print(f"roofline ladder unavailable ({e})",
+                      file=sys.stderr)
         profile_info = {
             "trace_dir": trace_dir,
             # first traced call minus a steady chunk ≈ compile+lower
@@ -1172,9 +1403,10 @@ def main() -> None:
             "flight": flight_info,
             "blackbox": blackbox_info,
             "megakernel": mega_profile,
+            "roofline": roofline,
         }
 
-    print(json.dumps({
+    envelope = {
         "metric": metric,
         "value": round(rps, 1),
         "unit": "rounds/s",
@@ -1189,7 +1421,17 @@ def main() -> None:
         **({"megakernel": mega_info} if mega_info else {}),
         **({"smoke": True, "n": n} if smoke else {}),
         **({"profile": profile_info} if profile else {}),
-    }))
+    }
+    # the schema claim is earned, not asserted: only an envelope whose
+    # roofline actually measured >= 6 configs calls itself v3 (the
+    # ledger validator holds v3 records to exactly that bar)
+    if profile and sum(1 for r in ((profile_info or {}).get("roofline")
+                                   or {}).get("rows", ())
+                       if "skipped" not in r) >= 6:
+        envelope["schema"] = _profile_schema_version()
+    print(json.dumps(envelope))
+    if profile:
+        _record_profile(envelope)
     # detector-quality diagnostics from an instrumented run (stderr;
     # driver parses stdout only). Stats ride the state through EVERY
     # diag call, so the honest denominator is the state's own round

@@ -243,6 +243,7 @@ def _run_gossip_sim(cfg) -> int:
     from consul_tpu.sim import init_state, run_rounds_flight, SimParams
     from consul_tpu.sim.flight import FlightPublisher, publish_report
     from consul_tpu.sim.metrics import fd_report
+    from consul_tpu.utils import perf
 
     n = cfg.gossip_sim_nodes
     chaos = getattr(cfg, "gossip_sim_chaos", "") or ""
@@ -338,8 +339,22 @@ def _run_gossip_sim(cfg) -> int:
         state = init_state(n)
         t0 = time.perf_counter()
         for c in range(rounds // chunk):
+            tc = time.perf_counter()
             state, trace = run_rounds_flight(
                 state, jax.random.fold_in(key, c), p, chunk)
+            jax.block_until_ready(trace)
+            # kernel-plane attribution: each chunk's per-round wall
+            # time lands in the PR 10 perf registry as sim.round.*,
+            # so /v1/agent/perf (and the debug bundle) attribute the
+            # gossip kernel next to the serving-plane stages — the
+            # same stage names costmodel.measure_config() records,
+            # comparable against the recorded roofline ladder. The
+            # first chunk is compile+run and would poison the
+            # steady-state histogram — it lands under .compile.
+            perf.default.observe(
+                "sim.round.xla-flight" if c else
+                "sim.round.xla-flight.compile",
+                (time.perf_counter() - tc) / chunk)
             pub.publish_trace(trace)
         jax.block_until_ready(state)
     except Exception as e:  # noqa: BLE001 — compile/run errors

@@ -79,6 +79,11 @@ from consul_tpu.sim.views import (ViewState, init_views, views_round,
                                   make_sharded_views_round)
 from consul_tpu.sim.sweep import (SweepResult, make_run_point,
                                   make_run_sweep, run_sweep)
+from consul_tpu.sim.costmodel import (LedgerError, analytic_cost,
+                                      check_regression, load_ledger,
+                                      measure_bandwidth,
+                                      measure_config, roofline_table,
+                                      validate_record)
 
 __all__ = [
     "SimParams", "SweepAxes", "TracedParams", "grid_params",
@@ -102,5 +107,8 @@ __all__ = [
     "make_multidc_run", "make_segmented_run",
     "ViewState", "init_views", "views_round", "run_views",
     "view_metrics", "make_views_mesh", "make_sharded_views_round",
+    "LedgerError", "analytic_cost", "check_regression", "load_ledger",
+    "measure_bandwidth", "measure_config", "roofline_table",
+    "validate_record",
     "ALIVE", "SUSPECT", "DEAD", "LEFT",
 ]

@@ -1,0 +1,880 @@
+"""Kernel-plane roofline observatory: analytic cost model, measured
+HBM/collective attribution, and the perf-regression ledger.
+
+The full-model kernel has been stuck at 7,717 r/s vs the 10k target
+since BENCH_r03, and no layer could say WHY: PR 2/4 observe the sim's
+events and PR 10 observes the serving plane, but nothing attributed
+where a round's time goes (HBM bytes, collectives, dispatch) or
+whether a run is anywhere near the hardware roofline. That attribution
+is the prerequisite for ROADMAP item 5's bit-packing ("roughly halve
+HBM traffic on a bandwidth-bound kernel" is unfalsifiable without a
+byte model) and its rounds_per_call x block-shape autotuner. Three
+layers, same discipline as the flight recorder:
+
+* **Analytic model** (`analytic_cost`): per-round HBM bytes and FLOPs
+  per engine config, derived from the registry and SimParams — the
+  state pytree's dtypes x N (the bit-packing lever: ONLY this term
+  halves when int8/int16 lanes land), one f32 write+read per PRNG draw
+  site, a per-engine materialized-intermediate count (pinned in
+  sim/registry.py, calibrated against the optimized HLO's own byte
+  accounting — a drift pin, not physics), the lane block table
+  amortized over the pinned ceil(R/stale_k)+2 reduction budget (the
+  mesh engine's collective payload), and flight/blackbox rows under
+  decimation. Terms are itemized so reports attribute, not just total.
+
+* **Measured attribution** (`measure_bandwidth`, `measure_config`):
+  a per-device copy/triad microbench establishes achievable bandwidth;
+  each engine config is compiled and asked for its OWN byte/FLOP
+  accounting via ``lower().compile().cost_analysis()`` — using the
+  marginal difference of two UNROLLED compiles, because XLA counts a
+  ``lax.scan`` body once regardless of trip count — plus wall-clock
+  ms/round from the real scan runner. Roofline utilization =
+  achieved bytes/s / measured peak; model-vs-measured deltas beyond
+  registry.COSTMODEL_BOUND (2x) are flagged. ``measure_config`` is the
+  exact seam ROADMAP item 5's autotuner will sweep. Timings also land
+  in utils/perf's process registry as ``sim.round.<config>`` so
+  ``/v1/agent/perf`` covers the kernel plane.
+
+* **Perf-regression ledger** (`load_ledger`, `history_rows`,
+  `check_regression`): every recorded ``<FAMILY>_r<NN>.json`` artifact
+  in the repo root is loaded and schema-validated (a hand-edited or
+  shape-broken record fails tier-1 by name), ``bench.py --history``
+  prints the one trajectory table the loose files never offered, and
+  ``--check-regression`` compares a fresh headline against the latest
+  record of the same metric under the PR 9 median+IQR refusal band —
+  a silent slowdown fails loudly, an unstable host refuses to claim.
+
+Nothing above the measurement section imports jax: the analytic model
+and the ledger are pure host data, importable by the CLI and the
+tier-1 validators without touching an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import statistics
+from typing import Any, Optional
+
+from consul_tpu.sim import registry
+
+# ------------------------------------------------------ analytic model
+
+#: the SimState per-node field widths (bytes), mirrored from
+#: sim/state.py's dtypes WITHOUT importing jax — tier-1 asserts this
+#: table matches the real init_state leaves, so a packed-state PR
+#: (ROADMAP item 5) must update the model in the same change and the
+#: predicted traffic halves exactly when the state does.
+STATE_FIELD_BYTES = (
+    ("up", 1),            # bool
+    ("down_time", 4),     # f32
+    ("status", 1),        # int8
+    ("incarnation", 4),   # int32
+    ("informed", 4),      # f32
+    ("susp_start", 4),    # f32
+    ("susp_deadline", 4), # f32
+    ("susp_conf", 2),     # int16
+    ("local_health", 1),  # int8
+    ("slow", 1),          # bool
+)
+
+#: model bytes per node per PRNG draw site: one threefry f32 vector
+#: materialized (4B write) and consumed (4B read)
+_DRAW_BYTES = 8
+
+_VECS = dict(registry.COSTMODEL_INTERMEDIATE_VECS)
+_FLOPS = dict(registry.COSTMODEL_FLOPS)
+
+
+def state_bytes_per_node() -> int:
+    """Per-node state-pytree bytes from the declared dtype table."""
+    return sum(b for _, b in STATE_FIELD_BYTES)
+
+
+def n_draw_sites(p) -> int:
+    """Per-round per-node uniform draw sites the round core executes
+    for these params (sim/round._round_core: ack + suspicion-arrival
+    Poisson + refutation-hearing always; churn and the slow-node model
+    each add one gated draw)."""
+    draws = 3
+    if p.fail_per_round or p.rejoin_per_round or p.leave_per_round:
+        draws += 1
+    if p.slow_per_round:
+        draws += 1
+    return draws
+
+
+def reductions_per_run(rounds: int, stale_k: int,
+                       overlap: bool = False) -> int:
+    """The pinned lane-reduction budget for an R-round run: one per
+    super-round window plus the two staged init_lanes reductions
+    (tests assert the compiled HLO matches), plus the overlap
+    schedule's drain fold."""
+    return -(-rounds // max(1, stale_k)) + 2 + (1 if overlap else 0)
+
+
+def analytic_cost(p, rounds: int, engine: str = "lanes",
+                  record_every: Optional[int] = None,
+                  blackbox: bool = False,
+                  rounds_per_call: int = 1) -> dict[str, Any]:
+    """The analytic per-round cost of one engine config.
+
+    Returns itemized per-round byte terms (registry.COSTMODEL_BYTE_TERMS
+    order), their total, a FLOP estimate, and the predicted arithmetic
+    intensity (flops/byte). ``engine`` is a registry.COSTMODEL_ENGINES
+    name; lane-cadence engines read ``p.stale_k``, the pallas engine
+    reads ``rounds_per_call`` (its stale_k equivalent)."""
+    if engine not in registry.COSTMODEL_ENGINES:
+        raise ValueError(
+            f"unknown cost-model engine {engine!r} (expected one of "
+            f"{', '.join(registry.COSTMODEL_ENGINES)})")
+    n = p.n
+    k = p.stale_k if engine in ("lanes", "overlap") else 1
+    state_rw = 2 * state_bytes_per_node() * n
+    draws = _DRAW_BYTES * n_draw_sites(p) * n
+    vecs = float(_VECS[engine])
+    if k > 1:
+        vecs += registry.COSTMODEL_WINDOW_VECS * (k - 1) ** 2 / k
+    intermediates = 8.0 * vecs * n
+    flops = float(_FLOPS[engine]) * n
+    if k > 1:
+        flops += registry.COSTMODEL_FLOP_WINDOW * (k - 1) ** 2 / k * n
+
+    # the lane block table, amortized over the pinned reduction budget
+    # — on the mesh this term is the psum's payload, bytes ON THE WIRE
+    lane_reduce = 0.0
+    collectives = 0
+    if engine in ("lanes", "overlap"):
+        collectives = reductions_per_run(rounds, k, engine == "overlap")
+        payload = registry.N_REDUCE_LANES * registry.LANE_BLOCKS * 4
+        lane_reduce = payload * collectives / rounds
+    elif engine == "pallas":
+        # the megakernel's partial tile accumulates the stat lanes
+        # once per call; no cross-device collective
+        payload = registry.N_REDUCE_LANES * registry.LANE_BLOCKS * 4
+        lane_reduce = payload / max(1, rounds_per_call)
+
+    flight = 0.0
+    if record_every:
+        from consul_tpu.sim.flight import trace_bytes
+
+        flight = trace_bytes(rounds, record_every) / rounds
+    bb = 0.0
+    if blackbox and record_every:
+        # K tracked agents, one int32[4] record per event, a handful of
+        # events per tracked agent per recorded window
+        bb = p.blackbox_k * 4 * 4 * 2 / record_every
+
+    terms = {"state_rw": float(state_rw), "uniform_draws": float(draws),
+             "intermediates": intermediates, "lane_reduce": lane_reduce,
+             "flight": flight, "blackbox": bb}
+    assert set(terms) == set(registry.COSTMODEL_BYTE_TERMS)
+    total = sum(terms.values())
+    return {
+        "engine": engine,
+        "n": n,
+        "stale_k": k,
+        "rounds_per_call": rounds_per_call if engine == "pallas" else 1,
+        "terms": terms,
+        "bytes_per_round": total,
+        "bytes_per_round_per_node": total / n,
+        "flops_per_round": flops,
+        "arithmetic_intensity": flops / total,
+        "collectives_per_round": (collectives / rounds
+                                  if collectives else 0.0),
+    }
+
+
+# -------------------------------------------------- measured attribution
+#
+# Everything below imports jax lazily: the ledger/validators above and
+# below must stay importable on accelerator-less hosts.
+
+
+def _cost_of(fn, *args) -> tuple[float, float, float]:
+    """(bytes accessed, flops, temp bytes) of the OPTIMIZED compiled
+    program — op-level traffic from ``cost_analysis()``, peak scratch
+    footprint from ``memory_analysis()`` (the donation story's other
+    half: state_bytes is the floor, temp is what XLA adds on top)."""
+    import jax
+
+    c = jax.jit(fn).lower(*args).compile()
+    ca = c.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    temp = 0.0
+    try:
+        ma = c.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0] if ma else None
+        if ma is not None:
+            temp = float(getattr(ma, "temp_size_in_bytes", 0.0))
+    except Exception:  # noqa: BLE001 — not every backend reports
+        pass
+    return (float(ca.get("bytes accessed", 0.0)),
+            float(ca.get("flops", 0.0)), temp)
+
+
+def _unrolled_fn(p, engine: str, rounds: int):
+    """An R-round fully-UNROLLED callable for `engine` — the byte-
+    accounting probe. XLA's cost analysis counts a lax.scan body ONCE
+    regardless of trip count (measured: an 8-round and a 16-round scan
+    report the same total), so per-round bytes must come from the
+    marginal difference of two unrolled compiles, where every round's
+    ops are actually in the graph."""
+    from consul_tpu.sim import lanes as lanes_mod
+    from consul_tpu.sim.round import (_lane_scan, gossip_round,
+                                      gossip_round_fast, init_scalars,
+                                      round_keys)
+
+    if engine == "xla":
+        def f(state, key):
+            keys = round_keys(key, state.round_idx, rounds)
+            for i in range(rounds):
+                state = gossip_round(state, keys[i], p)
+            return state
+        return f
+    if engine == "fast":
+        def f(state, key):
+            sc = init_scalars(state, p)
+            keys = round_keys(key, state.round_idx, rounds)
+            for i in range(rounds):
+                state, sc = gossip_round_fast(state, sc, keys[i], p)
+            return state
+        return f
+    if engine in ("lanes", "overlap"):
+        overlap = engine == "overlap"
+
+        def f(state, key):
+            keys = round_keys(key, state.round_idx, rounds)
+            return _lane_scan(state, keys, None, p, rounds, None,
+                              False, lanes_mod.reduce_lanes_single, 0,
+                              overlap=overlap, unroll=True)
+        return f
+    raise ValueError(f"no unrolled byte probe for engine {engine!r} "
+                     "(the Mosaic kernel's traffic is custom-call "
+                     "opaque — its row reports the model bytes)")
+
+
+def measured_cost(p, engine: str) -> tuple[float, float, float]:
+    """Per-round (bytes, flops) of the compiled program, via the
+    marginal difference of two unrolled depths — init/epilogue work
+    (init_scalars, the staged init_lanes reductions) cancels exactly,
+    leaving the steady-state per-round cost the scan body pays. The
+    third element is the DEEPER unroll's peak temp bytes
+    (memory_analysis — a footprint, not a rate, so no marginal)."""
+    from consul_tpu.sim.state import init_state
+
+    import jax
+
+    k = p.stale_k if engine in ("lanes", "overlap") else 1
+    r1, r2 = k, 2 * k
+    key = jax.random.key(0)
+    b1, f1, _ = _cost_of(_unrolled_fn(p, engine, r1),
+                         init_state(p.n), key)
+    b2, f2, temp = _cost_of(_unrolled_fn(p, engine, r2),
+                            init_state(p.n), key)
+    return (b2 - b1) / (r2 - r1), (f2 - f1) / (r2 - r1), temp
+
+
+def measure_bandwidth(mbytes: int = 64, reps: int = 5) -> dict[str, Any]:
+    """Achievable device memory bandwidth: STREAM-style copy and triad
+    over ``mbytes``-MB f32 arrays, best of ``reps`` (jitted, timed to
+    ``block_until_ready``). ``peak_gbps`` — the larger of the two — is
+    the roofline's denominator: an ACHIEVABLE ceiling measured on this
+    device, not a datasheet number this host may never reach."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    n = mbytes * (1 << 20) // 4
+
+    @jax.jit
+    def copy(x):
+        return x + 0.0
+
+    @jax.jit
+    def triad(a, b):
+        return a + 0.5 * b
+
+    x = jnp.arange(n, dtype=jnp.float32)
+    y = jnp.ones((n,), jnp.float32)
+    copy(x).block_until_ready()
+    triad(x, y).block_until_ready()
+    best_c = best_t = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        copy(x).block_until_ready()
+        best_c = min(best_c, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        triad(x, y).block_until_ready()
+        best_t = min(best_t, time.perf_counter() - t0)
+    copy_gbps = 2 * n * 4 / best_c / 1e9
+    triad_gbps = 3 * n * 4 / best_t / 1e9
+    return {
+        "mbytes": mbytes,
+        "copy_gbps": round(copy_gbps, 2),
+        "triad_gbps": round(triad_gbps, 2),
+        "peak_gbps": round(max(copy_gbps, triad_gbps), 2),
+        "platform": jax.default_backend(),
+    }
+
+
+def _scan_runner(p, engine: str, rounds: int, rounds_per_call: int):
+    """The REAL (scan/megakernel) runner for wall-clock timing — the
+    program production runs, not the unrolled byte probe."""
+    from consul_tpu.sim.round import (make_run_rounds,
+                                      make_run_rounds_fast,
+                                      make_run_rounds_lanes)
+
+    if engine == "xla":
+        return make_run_rounds(p, rounds)
+    if engine == "fast":
+        return make_run_rounds_fast(p, rounds)
+    if engine in ("lanes", "overlap"):
+        return make_run_rounds_lanes(p, rounds,
+                                     overlap=engine == "overlap")
+    if engine == "pallas":
+        from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+
+        return make_run_rounds_pallas(p, rounds,
+                                      rounds_per_call=rounds_per_call)
+    raise ValueError(f"unknown engine {engine!r}")
+
+
+def measure_config(p, rounds: int = 24, engine: str = "lanes",
+                   rounds_per_call: int = 1, reps: int = 3,
+                   peak_gbps: Optional[float] = None,
+                   measure_bytes: bool = True,
+                   perf_registry=None) -> dict[str, Any]:
+    """Measure ONE engine config end to end — the seam ROADMAP item
+    5's rounds_per_call x block-shape autotuner sweeps.
+
+    Returns the PROFILE_ROOFLINE_ROW dict: wall-clock ms/round (best
+    of ``reps`` timed calls on the real scan runner, compile excluded),
+    the analytic model's bytes, the compiled program's own byte count
+    (marginal-unroll protocol; None for the Mosaic kernel, whose
+    custom-call traffic XLA cannot see), the model-vs-measured ratio
+    with the >COSTMODEL_BOUND flag, achieved GB/s and roofline
+    utilization against ``peak_gbps`` (pass measure_bandwidth()'s
+    result; None skips util), and the per-round collective count.
+    Every timed rep also lands in the utils/perf registry as
+    ``sim.round.<config>`` so /v1/agent/perf covers the kernel plane.
+    """
+    import time
+
+    import jax
+
+    from consul_tpu.utils import perf as perf_mod
+
+    if perf_registry is None:
+        perf_registry = perf_mod.default
+    k = p.stale_k if engine in ("lanes", "overlap") else 1
+    if rounds % max(k, rounds_per_call):
+        raise ValueError(
+            f"rounds={rounds} must be a multiple of the reduction "
+            f"cadence (stale_k={k}, rounds_per_call={rounds_per_call})")
+    label = config_label(engine, k, rounds_per_call)
+    model = analytic_cost(p, rounds, engine,
+                          rounds_per_call=rounds_per_call)
+
+    run = _scan_runner(p, engine, rounds, rounds_per_call)
+    key = jax.random.key(0)
+    from consul_tpu.sim.state import init_state
+
+    state = run(init_state(p.n), key)  # compile + warm (donates input)
+    jax.block_until_ready(state)
+    best = float("inf")
+    for i in range(reps):
+        t0 = time.perf_counter()
+        state = run(state, jax.random.fold_in(key, i + 1))
+        checksum = float(state.informed.sum())  # end-to-end honest
+        dt = time.perf_counter() - t0
+        assert checksum > 0
+        best = min(best, dt)
+        perf_registry.observe(f"sim.round.{label}", dt / rounds)
+    ms_per_round = best / rounds * 1e3
+
+    bytes_measured = flops_measured = temp_measured = None
+    if measure_bytes and engine != "pallas":
+        bytes_measured, flops_measured, temp_measured = \
+            measured_cost(p, engine)
+
+    bytes_model = model["bytes_per_round"]
+    ratio = (None if not bytes_measured
+             else bytes_measured / bytes_model)
+    flagged = bool(ratio is not None
+                   and not (1.0 / registry.COSTMODEL_BOUND
+                            <= ratio <= registry.COSTMODEL_BOUND))
+    # achieved traffic rate: the compiled program's own byte count when
+    # it has one; the Mosaic kernel reports the model's (its traffic is
+    # custom-call opaque to cost_analysis — stated in the row)
+    bytes_eff = bytes_measured if bytes_measured else bytes_model
+    achieved_gbps = bytes_eff / (ms_per_round / 1e3) / 1e9
+    return {
+        "config": label,
+        "engine": engine,
+        "stale_k": k,
+        "rounds_per_call": rounds_per_call,
+        "ms_per_round": round(ms_per_round, 4),
+        "rounds_per_sec": round(1e3 / ms_per_round, 1),
+        "bytes_model": round(bytes_model, 1),
+        "bytes_measured": (None if bytes_measured is None
+                           else round(bytes_measured, 1)),
+        "model_vs_measured": (None if ratio is None
+                              else round(ratio, 3)),
+        "flagged": flagged,
+        "flops_model": round(model["flops_per_round"], 1),
+        "flops_measured": (None if flops_measured is None
+                           else round(flops_measured, 1)),
+        "temp_bytes_measured": (None if temp_measured is None
+                                else round(temp_measured, 1)),
+        "arithmetic_intensity": round(model["arithmetic_intensity"], 4),
+        "achieved_gbps": round(achieved_gbps, 3),
+        "util": (None if not peak_gbps
+                 else round(achieved_gbps / peak_gbps, 4)),
+        "collectives_per_round": round(model["collectives_per_round"],
+                                       4),
+    }
+
+
+def config_label(engine: str, stale_k: int = 1,
+                 rounds_per_call: int = 1) -> str:
+    if engine in ("lanes", "overlap") and stale_k != 1:
+        return f"{engine}-k{stale_k}"
+    if engine == "pallas" and rounds_per_call != 1:
+        return f"pallas-x{rounds_per_call}"
+    return engine
+
+
+#: the default --profile roofline ladder: (engine, stale_k,
+#: rounds_per_call) per the tentpole spec — xla, lanes at
+#: stale_k in {1,2,4}, overlap, pallas at rounds_per_call in {1,4,8};
+#: the fast stale-scalar engine rides along as the timed-config
+#: reference. >= 6 of these measure on a CPU-only host (pallas rows
+#: record their skip honestly).
+ROOFLINE_CONFIGS = (
+    ("xla", 1, 1),
+    ("fast", 1, 1),
+    ("lanes", 1, 1),
+    ("lanes", 2, 1),
+    ("lanes", 4, 1),
+    ("overlap", 4, 1),
+    ("pallas", 1, 1),
+    ("pallas", 1, 4),
+    ("pallas", 1, 8),
+)
+
+
+def roofline_table(p, rounds: int = 24, reps: int = 3,
+                   bandwidth: Optional[dict] = None,
+                   configs=ROOFLINE_CONFIGS) -> dict[str, Any]:
+    """Measure the full engine ladder against the measured roofline.
+
+    ``p`` is the base (stale_k=1) SimParams; each config derives its
+    own. Configs whose engine cannot build on this backend (the Mosaic
+    kernel on CPU) record ``{"config", "skipped"}`` rows instead of
+    failing the table. Returns {bandwidth, rows, flags}; ``flags``
+    names every row whose model-vs-measured ratio left the pinned
+    COSTMODEL_BOUND — the disagree-loudly contract."""
+    if bandwidth is None:
+        bandwidth = measure_bandwidth()
+    rows = []
+    for engine, k, rpc in configs:
+        pk = p.with_(stale_k=k) if engine in ("lanes", "overlap") \
+            else p
+        r = rounds
+        cadence = max(k, rpc)
+        if r % cadence:
+            r = cadence * max(1, r // cadence)
+        try:
+            rows.append(measure_config(
+                pk, rounds=r, engine=engine, rounds_per_call=rpc,
+                reps=reps, peak_gbps=bandwidth["peak_gbps"]))
+        except Exception as e:  # noqa: BLE001 — per-row honesty
+            rows.append({"config": config_label(engine, k, rpc),
+                         "engine": engine, "stale_k": k,
+                         "rounds_per_call": rpc,
+                         "skipped": f"{type(e).__name__}: {e}"})
+    flags = [r["config"] for r in rows if r.get("flagged")]
+    return {"bandwidth": bandwidth, "rows": rows, "flags": flags}
+
+
+# --------------------------------------------- perf-regression ledger
+#
+# Pure host code (no jax): the recorded-artifact loader, the per-family
+# schema validators, the trajectory table, and the refusal-band
+# regression check. The validators run in tier-1 over every *_r*.json
+# in the repo root, so a PR that hand-edits a record fails loudly.
+
+
+class LedgerError(ValueError):
+    """A recorded artifact failed schema validation (named file+key)."""
+
+
+_RECORD_RE = re.compile(r"^([A-Z]+)_r(\d+)\.json$")
+
+#: refusal band shared with bench_kv.STABILITY_BAND (PR 9): a fresh
+#: headline's IQR/median above this refuses the comparison
+STABILITY_BAND = 0.10
+
+
+def _require(name: str, data: dict, keys) -> None:
+    missing = [k for k in keys if k not in data]
+    if missing:
+        raise LedgerError(
+            f"{name}: missing required keys {sorted(missing)} "
+            f"(present: {sorted(data)[:12]})")
+
+
+def _require_num(name: str, data: dict, keys) -> None:
+    for k in keys:
+        v = data.get(k)
+        if v is not None and not isinstance(v, (int, float)):
+            raise LedgerError(
+                f"{name}: key {k!r} must be numeric or null, "
+                f"got {type(v).__name__} ({v!r})")
+
+
+def _validate_bench_envelope(name: str, parsed: dict) -> None:
+    _require(name, parsed, ("metric", "value", "unit", "vs_baseline"))
+    _require_num(name, parsed, ("value", "vs_baseline"))
+
+
+def _validate_bench(name: str, d: dict) -> None:
+    """Driver-recorded BENCH round: {n, cmd, rc, tail, parsed} where
+    parsed is the bench's ONE JSON stdout line (None when the round
+    errored before printing one — the tail carries the traceback)."""
+    _require(name, d, ("n", "cmd", "rc", "tail", "parsed"))
+    if d["parsed"] is not None:
+        if not isinstance(d["parsed"], dict):
+            raise LedgerError(f"{name}: parsed must be an object or "
+                              f"null, got {type(d['parsed']).__name__}")
+        _validate_bench_envelope(f"{name}.parsed", d["parsed"])
+
+
+def _validate_multichip(name: str, d: dict) -> None:
+    if "n_devices" in d:  # driver-recorded rounds 1-5
+        _require(name, d, ("n_devices", "rc", "ok", "skipped", "tail"))
+        return
+    _require(name, d, ("metric", "platform"))
+    if d.get("skipped"):
+        return
+    _require(name, d, ("ladder",))
+    core = ("devices", "n", "rounds_per_sec", "ms_per_round",
+            "weak_scaling_efficiency")
+    for i, row in enumerate(d["ladder"]):
+        _require(f"{name}.ladder[{i}]", row, core)
+        _require_num(f"{name}.ladder[{i}]", row, core)
+
+
+def _validate_profile(name: str, d: dict) -> None:
+    _require(name, d, ("metric", "value", "unit", "platform",
+                       "profile"))
+    _require_num(name, d, ("value",))
+    prof = d["profile"]
+    if not isinstance(prof, dict):
+        raise LedgerError(f"{name}: profile must be an object")
+    if d.get("schema", 0) >= registry.PROFILE_SCHEMA_VERSION:
+        _require(f"{name}.profile", prof, ("roofline",))
+        roof = prof["roofline"]
+        _require(f"{name}.profile.roofline", roof,
+                 ("bandwidth", "rows", "flags"))
+        measured = 0
+        for i, row in enumerate(roof["rows"]):
+            rn = f"{name}.profile.roofline.rows[{i}]"
+            if "skipped" in row:
+                _require(rn, row, ("config", "engine"))
+                continue
+            _require(rn, row, registry.PROFILE_ROOFLINE_ROW)
+            _require_num(rn, row, ("ms_per_round", "bytes_model",
+                                   "achieved_gbps"))
+            measured += 1
+        if measured < 6:
+            raise LedgerError(
+                f"{name}: a v{registry.PROFILE_SCHEMA_VERSION} "
+                f"roofline table needs >= 6 measured engine configs, "
+                f"got {measured}")
+
+
+def _validate_sweep(name: str, d: dict) -> None:
+    _require(name, d, ("metric", "platform"))
+    if d.get("skipped"):
+        return
+    _require(name, d, ("n", "rounds", "grid", "objectives", "classes"))
+    for cls, row in d["classes"].items():
+        _require(f"{name}.classes[{cls}]", row,
+                 ("grid_size", "scenarios_per_sec", "chosen", "pareto"))
+
+
+def _validate_serve(name: str, d: dict) -> None:
+    _require(name, d, ("metric", "unit", "levels", "headline_rps"))
+    for i, lvl in enumerate(d["levels"]):
+        _require(f"{name}.levels[{i}]", lvl,
+                 ("concurrency", "rps", "p50_ms", "p99_ms"))
+        _require_num(f"{name}.levels[{i}]", lvl, ("rps", "p50_ms"))
+    _require(f"{name}.headline_rps", d["headline_rps"],
+             ("value", "samples", "stability_band"))
+
+
+def _validate_byz(name: str, d: dict) -> None:
+    _require(name, d, ("metric", "n", "classes", "corroboration_sweep"))
+
+
+def _validate_scenario(name: str, d: dict) -> None:
+    if d.get("skipped"):
+        _require(name, d, ("metric",))
+        return
+    _require(name, d, ("metric", "n", "platform", "scenarios",
+                       "wall_s"))
+    _require_num(name, d, ("wall_s",))
+
+
+_VALIDATORS = {
+    "BENCH": _validate_bench,
+    "MULTICHIP": _validate_multichip,
+    "PROFILE": _validate_profile,
+    "SWEEP": _validate_sweep,
+    "SERVE": _validate_serve,
+    "BYZ": _validate_byz,
+    "CHAOS": _validate_scenario,
+    "COORDS": _validate_scenario,
+}
+assert set(_VALIDATORS) == set(registry.LEDGER_FAMILIES)
+
+
+def validate_record(filename: str, data: Any) -> None:
+    """Schema-validate one recorded artifact by family. Raises
+    LedgerError naming the file and the offending key; unknown
+    ``<NAME>_r<NN>.json`` families fail too (a new family must
+    register a validator + extend registry.LEDGER_FAMILIES in the
+    same change)."""
+    m = _RECORD_RE.match(os.path.basename(filename))
+    if not m:
+        raise LedgerError(
+            f"{filename}: not a recorded-artifact name "
+            "(expected <FAMILY>_r<NN>.json)")
+    family = m.group(1)
+    if family not in _VALIDATORS:
+        raise LedgerError(
+            f"{filename}: unknown record family {family!r} (known: "
+            f"{', '.join(registry.LEDGER_FAMILIES)}) — register a "
+            "validator in sim/costmodel.py and extend "
+            "registry.LEDGER_FAMILIES")
+    if not isinstance(data, dict):
+        raise LedgerError(f"{filename}: record must be a JSON object, "
+                          f"got {type(data).__name__}")
+    _VALIDATORS[family](os.path.basename(filename), data)
+
+
+def iter_record_files(root: str) -> list[str]:
+    """Every recorded-artifact path in `root`, (family, round)-sorted."""
+    out = []
+    for fn in os.listdir(root):
+        m = _RECORD_RE.match(fn)
+        if m:
+            out.append((m.group(1), int(m.group(2)),
+                        os.path.join(root, fn)))
+    return [p for _, _, p in sorted(out)]
+
+
+def load_ledger(root: str) -> list[dict[str, Any]]:
+    """Load + validate every recorded artifact under `root`. Returns
+    [{file, family, round, data}] sorted by (family, round). A record
+    that fails to parse or validate raises LedgerError by name — the
+    ledger never silently drops a broken record."""
+    records = []
+    for path in iter_record_files(root):
+        fn = os.path.basename(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise LedgerError(f"{fn}: unreadable record: {e}") from e
+        validate_record(fn, data)
+        m = _RECORD_RE.match(fn)
+        records.append({"file": fn, "family": m.group(1),
+                        "round": int(m.group(2)), "data": data})
+    return records
+
+
+def _headline_of(rec: dict[str, Any]):
+    """(metric, value, unit, note) extracted per family — the one
+    trajectory number each record contributes to --history."""
+    d, fam = rec["data"], rec["family"]
+    if fam == "BENCH":
+        p = d.get("parsed")
+        if not p:
+            tail = (d.get("tail") or "").strip().splitlines()
+            return (None, None, None,
+                    f"errored (rc={d.get('rc')}): "
+                    f"{tail[-1][:60] if tail else 'no output'}")
+        note = ""
+        if p.get("error"):
+            note = f"error: {p['error'][:60]}"
+        elif p.get("skipped"):
+            note = f"skipped: {p.get('reason', '')[:60]}"
+        elif p.get("full_model_rounds_per_sec") is not None:
+            note = (f"full-model "
+                    f"{p['full_model_rounds_per_sec']:,.0f} r/s "
+                    f"({p.get('full_model_kernel', '?')})")
+        return p.get("metric"), p.get("value"), p.get("unit"), note
+    if fam == "PROFILE":
+        note = ""
+        if d.get("full_model_rounds_per_sec") is not None:
+            note = (f"full-model "
+                    f"{d['full_model_rounds_per_sec']:,.0f} r/s")
+        roof = (d.get("profile") or {}).get("roofline")
+        if roof:
+            utils = [r.get("util") for r in roof["rows"]
+                     if r.get("util") is not None]
+            if utils:
+                note += f"; best util {max(utils):.1%}"
+        return d.get("metric"), d.get("value"), d.get("unit"), note
+    if fam == "MULTICHIP":
+        if "n_devices" in d:
+            note = ("ok" if d.get("ok")
+                    else "skipped" if d.get("skipped") else "failed")
+            return ("mesh_weak_scaling", None, None,
+                    f"driver probe ({d['n_devices']} devices): {note}")
+        if d.get("skipped"):
+            return d.get("metric"), None, None, \
+                f"skipped: {d.get('reason', '')[:60]}"
+        top = d["ladder"][-1]
+        return (d.get("metric"), top.get("rounds_per_sec"), "rounds/s",
+                f"{top['devices']} devices, eff "
+                f"{top['weak_scaling_efficiency']}")
+    if fam == "SWEEP":
+        if d.get("skipped"):
+            return d.get("metric"), None, None, "skipped"
+        best = max(row.get("scenarios_per_sec", 0)
+                   for row in d["classes"].values())
+        return (d.get("metric"), best, "scenarios/s",
+                f"{len(d['classes'])} classes, grid "
+                f"{next(iter(d['classes'].values()))['grid_size']}")
+    if fam == "SERVE":
+        hl = d["headline_rps"]
+        note = ("REFUSED: " + hl.get("unstable", "")[:60]
+                if hl.get("headline") is None else "stable")
+        top = d["levels"][-1]
+        return (d.get("metric"), top.get("rps"), d.get("unit"),
+                f"C={top['concurrency']}; headline {note}")
+    if fam == "BYZ":
+        ks = [row.get("corroboration_k")
+              for row in d.get("corroboration_sweep", {}).get(
+                  "sweep", [])] if isinstance(
+                      d.get("corroboration_sweep"), dict) else []
+        return (d.get("metric"), None, None,
+                f"{len(d['classes'])} attack classes"
+                + (f", k sweep {len(ks)} pts" if ks else ""))
+    # CHAOS / COORDS
+    if d.get("skipped"):
+        return d.get("metric"), None, None, "skipped"
+    return (d.get("metric"), d.get("wall_s"), "s (wall)",
+            f"{len(d.get('scenarios', {}))} scenario(s)")
+
+
+def history_rows(records: list[dict]) -> list[dict[str, Any]]:
+    """The trajectory table: one row per record, (family, round)
+    ordered — the bench history that was unreconstructable from the
+    loose files."""
+    rows = []
+    for rec in records:
+        metric, value, unit, note = _headline_of(rec)
+        rows.append({"file": rec["file"], "family": rec["family"],
+                     "round": rec["round"], "metric": metric,
+                     "value": value, "unit": unit, "note": note})
+    return rows
+
+
+def format_history(rows: list[dict]) -> str:
+    """Human table for bench.py --history."""
+    cols = ("file", "metric", "value", "unit", "note")
+    widths = {c: len(c) for c in cols}
+    printable = []
+    for r in rows:
+        pr = {
+            "file": r["file"],
+            "metric": r["metric"] or "-",
+            "value": ("-" if r["value"] is None
+                      else f"{r['value']:,.1f}"),
+            "unit": r["unit"] or "-",
+            "note": r["note"] or "",
+        }
+        printable.append(pr)
+        for c in cols:
+            widths[c] = max(widths[c], len(pr[c]))
+    lines = ["  ".join(c.ljust(widths[c]) for c in cols),
+             "  ".join("-" * widths[c] for c in cols)]
+    for pr in printable:
+        lines.append("  ".join(pr[c].ljust(widths[c]) for c in cols))
+    return "\n".join(lines)
+
+
+def latest_metric(records: list[dict], metric: str
+                  ) -> Optional[dict[str, Any]]:
+    """The newest record carrying a non-null value for `metric` —
+    the --check-regression baseline. Never fabricates: None when no
+    record of that metric exists."""
+    best = None
+    for rec in records:
+        m, value, unit, _ = _headline_of(rec)
+        if m == metric and value is not None:
+            if best is None or (rec["family"], rec["round"]) >= \
+                    (best["family"], best["round"]):
+                best = {"file": rec["file"], "family": rec["family"],
+                        "round": rec["round"], "metric": m,
+                        "value": value, "unit": unit}
+    return best
+
+
+def check_regression(samples: list[float], baseline: float,
+                     band: float = STABILITY_BAND) -> dict[str, Any]:
+    """The PR 9 median+IQR refusal band applied to a regression gate.
+
+    ``samples`` are fresh throughput trials (higher is better),
+    ``baseline`` the latest recorded value of the same metric. Verdicts:
+
+    * ``regression`` — the fresh median is below baseline x (1-band)
+      AND the spread is tight enough to claim it (IQR/median <= band).
+    * ``pass`` — median within (or above) the band.
+    * ``unstable`` — <3 samples or IQR/median > band: the measurement
+      refuses to CLAIM either way (same contract as bench_kv's
+      headline refusal — an unstable host never certifies, and never
+      convicts).
+    """
+    if baseline is None or not isinstance(baseline, (int, float)) \
+            or baseline <= 0:
+        raise ValueError(f"check_regression needs a positive recorded "
+                         f"baseline, got {baseline!r} — the caller "
+                         "must refuse (exit 2) before measuring")
+    med = statistics.median(samples)
+    out = {"samples": [round(s, 1) for s in samples],
+           "median": round(med, 1),
+           "baseline": round(float(baseline), 1),
+           "ratio": round(med / baseline, 4),
+           "band": band}
+    if len(samples) < 3:
+        out["verdict"] = "unstable"
+        out["reason"] = (f"need >= 3 fresh samples for a regression "
+                         f"claim (got {len(samples)})")
+        return out
+    qs = statistics.quantiles(samples, n=4)
+    iqr = qs[2] - qs[0]
+    out["iqr_over_median"] = round(iqr / med, 4) if med else None
+    if med and iqr / med > band:
+        out["verdict"] = "unstable"
+        out["reason"] = (f"IQR/median {iqr / med:.3f} exceeds the "
+                         f"{band:.0%} refusal band — host too noisy "
+                         "to certify or convict")
+        return out
+    if med < baseline * (1.0 - band):
+        out["verdict"] = "regression"
+        out["reason"] = (f"fresh median {med:,.1f} is "
+                         f"{1 - med / baseline:.1%} below the recorded "
+                         f"{baseline:,.1f} (band {band:.0%})")
+    else:
+        out["verdict"] = "pass"
+    return out

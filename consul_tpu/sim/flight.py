@@ -85,6 +85,13 @@ def empty_trace(rounds: int, record_every: int) -> jnp.ndarray:
                      jnp.float32)
 
 
+def trace_bytes(rounds: int, record_every: int) -> int:
+    """Device bytes a recorded trace occupies (and the recorder writes
+    over a run) — the cost model's flight term (sim/costmodel.py), kept
+    HERE so the decimation math has exactly one owner."""
+    return n_trace_rows(rounds, record_every) * N_COLS * 4
+
+
 def flight_row(*, up, status, informed, local_health, incarnation, t,
                stats_delta: SimStats, phase,
                coord_row: Optional[jnp.ndarray] = None) -> jnp.ndarray:

@@ -327,6 +327,99 @@ MESH_LADDER_ROW = (
 )
 
 
+# ----------------------------------------------- kernel-plane cost model
+#
+# The roofline observatory (sim/costmodel.py): an analytic per-round
+# HBM-byte/FLOP model per engine config, cross-checked against the
+# compiled program's own accounting (cost_analysis) and wall-clock
+# timings. The constants below are the model's HOST/DEVICE contract in
+# the same sense as the flight columns — bench.py --profile records
+# rows decoded by README tables and item 5's autotuner sweeps
+# measure_config() — so they are folded into ``layout_digest()`` and a
+# change forces every consumer (costmodel formulas, the PROFILE record
+# validator, the docs' cost tables) to be revisited together.
+
+#: PROFILE_r*.json record schema version: r01/r02 are the legacy flat
+#: profile envelopes; version 3 adds the roofline table + bandwidth
+#: microbench (costmodel.validate_record accepts both, by version)
+PROFILE_SCHEMA_VERSION = 3
+
+#: engine configs the cost model knows how to price, canonical order —
+#: "xla" (live-scalar reference scan), "fast" (stale-scalar hot loop),
+#: "lanes" (fused-lane engine, any stale_k), "overlap" (lanes +
+#: double-buffered psum), "pallas" (fused Mosaic kernel, any
+#: rounds_per_call)
+COSTMODEL_ENGINES = ("xla", "fast", "lanes", "overlap", "pallas")
+
+#: the analytic model's per-round byte terms, canonical order (the
+#: formula is their sum; costmodel.analytic_cost returns one value per
+#: term so reports can attribute, not just total):
+#:   state_rw       — 2 x state pytree bytes (read + write per round)
+#:   uniform_draws  — 8 bytes/node per PRNG draw site (f32 write+read)
+#:   intermediates  — 8 bytes/node per materialized [N] intermediate
+#:                    (the op-level traffic term; per-engine vec counts
+#:                    below)
+#:   lane_reduce    — the [N_REDUCE_LANES, LANE_BLOCKS] block table,
+#:                    amortized over the pinned ceil(R/stale_k)+2
+#:                    reduction budget (+1 under overlap) — this term
+#:                    IS the mesh engine's collective payload
+#:   flight         — trace rows under decimation (N_COLS f32 / stride)
+#:   blackbox       — tracked agents' ring records under decimation
+COSTMODEL_BYTE_TERMS = ("state_rw", "uniform_draws", "intermediates",
+                        "lane_reduce", "flight", "blackbox")
+
+#: per-engine materialized-intermediate vector counts (4-byte [N]
+#: vectors touched per round beyond state and draws), CALIBRATED
+#: against the optimized-HLO op-level byte accounting of jax 0.4.37
+#: XLA:CPU (costmodel's marginal-unroll protocol, 2026-08-03). These
+#: are drift pins, not physics: the tier-1 smoke asserts the compiled
+#: program still agrees within COSTMODEL_BOUND, so an XLA upgrade or a
+#: round-body rewrite that doubles traffic fails loudly. The pallas
+#: entry is the VMEM-resident kernel's HBM story (state in/out only —
+#: intermediates never leave the chip), which is exactly why the
+#: megakernel is the 10k-target path.
+COSTMODEL_INTERMEDIATE_VECS = (
+    ("xla", 151), ("fast", 96), ("lanes", 124), ("overlap", 124),
+    ("pallas", 3),
+)
+
+#: extra per-round vec count inside a stale_k>1 super-round window,
+#: empirically quadratic in the window length on XLA:CPU (the unrolled
+#: window's fusion pattern): + WINDOW_VECS x (k-1)^2 / k vecs/round
+COSTMODEL_WINDOW_VECS = 50
+
+#: per-engine FLOP/node/round estimates (same calibration protocol;
+#: window term shares the quadratic shape at FLOP_WINDOW scale)
+COSTMODEL_FLOPS = (
+    ("xla", 2250), ("fast", 1500), ("lanes", 1410), ("overlap", 1410),
+    ("pallas", 1410),
+)
+COSTMODEL_FLOP_WINDOW = 1000
+
+#: the model-vs-measured agreement bound: a config whose compiled
+#: byte count disagrees with the analytic model by more than this
+#: factor (either direction) is FLAGGED in the roofline table, and the
+#: tier-1 CPU smoke asserts the reference engines stay inside it
+COSTMODEL_BOUND = 2.0
+
+#: roofline table row schema (bench.py --profile; PROFILE_r03+ records
+#: and README tables decode these keys)
+PROFILE_ROOFLINE_ROW = (
+    "config", "engine", "stale_k", "rounds_per_call",
+    "ms_per_round", "rounds_per_sec",
+    "bytes_model", "bytes_measured", "model_vs_measured", "flagged",
+    "flops_model", "flops_measured", "temp_bytes_measured",
+    "arithmetic_intensity",
+    "achieved_gbps", "util", "collectives_per_round",
+)
+
+#: recorded-artifact families the perf-regression ledger
+#: (costmodel.load_ledger / bench.py --history) loads and
+#: schema-validates from the repo root — every `<FAMILY>_r<NN>.json`
+LEDGER_FAMILIES = ("BENCH", "MULTICHIP", "SWEEP", "SERVE", "PROFILE",
+                   "BYZ", "CHAOS", "COORDS")
+
+
 def flight_columns() -> tuple[str, ...]:
     """The full flight-trace row layout, in column order."""
     return FLIGHT_GAUGE_COLUMNS + STATS_FIELDS + FLIGHT_COORD_COLUMNS
@@ -349,7 +442,15 @@ def layout_digest() -> str:
                   FAULT_KINDS, BYZANTINE_FAULT_KINDS,
                   (str(CHECKPOINT_VERSION),),
                   CHECKPOINT_HEADER_FIELDS, CHECKPOINT_CARRIES,
-                  MESH_LADDER_ROW):
+                  MESH_LADDER_ROW,
+                  (str(PROFILE_SCHEMA_VERSION),),
+                  COSTMODEL_ENGINES, COSTMODEL_BYTE_TERMS,
+                  tuple(f"{e}={v}"
+                        for e, v in COSTMODEL_INTERMEDIATE_VECS),
+                  (str(COSTMODEL_WINDOW_VECS),),
+                  tuple(f"{e}={v}" for e, v in COSTMODEL_FLOPS),
+                  (str(COSTMODEL_FLOP_WINDOW), str(COSTMODEL_BOUND)),
+                  PROFILE_ROOFLINE_ROW, LEDGER_FAMILIES):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

@@ -21,6 +21,8 @@ from consul_tpu.agent import Agent
 from consul_tpu.api import APIError, ConsulClient
 from consul_tpu.config import load
 
+from helpers import requires_crypto  # noqa: E402
+
 
 def _es256_keypair():
     from cryptography.hazmat.primitives import serialization
@@ -49,6 +51,7 @@ def _jwt(key, claims: dict) -> str:
     return f"{head}.{body}.{sig}"
 
 
+@requires_crypto
 def test_jwt_verify_unit():
     key, pub = _es256_keypair()
     cfg = {"JWTValidationPubKeys": [pub], "BoundIssuer": "idp",
@@ -122,6 +125,7 @@ def acl_agent():
     a.shutdown()
 
 
+@requires_crypto
 def test_login_logout_end_to_end(acl_agent):
     root = ConsulClient(acl_agent.http.addr, token="root-secret")
     anon = ConsulClient(acl_agent.http.addr)
@@ -171,6 +175,7 @@ def test_login_logout_end_to_end(acl_agent):
         logged_in.service_register({"Name": "web", "Port": 80})
 
 
+@requires_crypto
 def test_auth_method_delete_cascades(acl_agent):
     root = ConsulClient(acl_agent.http.addr, token="root-secret")
     anon = ConsulClient(acl_agent.http.addr)
@@ -197,6 +202,7 @@ def test_auth_method_delete_cascades(acl_agent):
             "Name": "k8s", "Type": "kubernetes"})
 
 
+@requires_crypto
 def test_role_binds_resolve_at_login(acl_agent):
     """BindType=role resolves at LOGIN (binder.go): a nonexistent role
     is dropped — no dormant token that acquires privileges when a
@@ -229,6 +235,7 @@ def test_role_binds_resolve_at_login(acl_agent):
             "Selector": 'value.team == "research and development"'})
 
 
+@requires_crypto
 def test_acl_grpc_login_logout(acl_agent):
     """pbacl over the external gRPC port: Login mints the same scoped
     token the HTTP path does; Logout destroys it; a no-match bearer

@@ -59,12 +59,15 @@ def test_layout_registry_digest_pinned():
     metrics.blackbox_report, the Pallas partial-sum lane slices,
     params.grid_params/TracedParams leaf builders, ARCHITECTURE.md
     tables) in the same change."""
-    # PR 10 re-pin (was 821af5d83bff15bb): the digest now additionally
-    # covers the `bench.py --mesh` ladder row schema
-    # (registry.MESH_LADDER_ROW) — PR 10 grew the rows by the
-    # per-device round-time skew triple (dev_ms_min/dev_ms_max/
-    # dev_skew), and MULTICHIP consumers decode those keys
-    assert registry.layout_digest() == "1113a9e8cf99fbd1"
+    # PR 11 re-pin (was 1113a9e8cf99fbd1): the digest now additionally
+    # covers the kernel-plane cost-model contract — the per-engine
+    # byte/FLOP formula constants (COSTMODEL_*), the roofline row
+    # schema (PROFILE_ROOFLINE_ROW), the PROFILE record schema version,
+    # and the recorded-artifact families the perf-regression ledger
+    # validates (LEDGER_FAMILIES). Consumers: sim/costmodel.py
+    # formulas + validators, bench.py --profile/--history,
+    # ARCHITECTURE.md cost tables.
+    assert registry.layout_digest() == "6f12d6ba8f4378b0"
 
 
 def test_reduce_lane_layout_pinned():

@@ -11,7 +11,7 @@ from consul_tpu import cli as cli_mod
 from consul_tpu.agent import Agent
 from consul_tpu.config import load
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -349,6 +349,7 @@ def test_resource_grpc_crud(agent, tmp_path):
     assert rc == 0 and "grpc-one" not in out
 
 
+@requires_crypto
 def test_watch_long_tail_types(agent, tmp_path):
     """api/watch/funcs.go long tail: event, connect_roots,
     connect_leaf, agent_service watch types resolve and print."""
@@ -414,6 +415,39 @@ def test_gossip_sim_cpu_honors_platform_and_returns():
 
     # the requested platform actually restricted backend init
     assert jax.default_backend() == "cpu"
+
+
+def test_gossip_sim_lands_kernel_timings_in_perf_registry():
+    """The kernel plane reaches /v1/agent/perf (PR 11): each steady
+    chunk of a `-gossip-sim` run observes its per-round wall time into
+    the process-global utils/perf registry as sim.round.*, with the
+    compile+run first chunk split off under .compile so it cannot
+    poison the steady-state histogram. Same stage namespace
+    costmodel.measure_config() records — one registry covers both
+    planes."""
+    from consul_tpu.utils import perf
+
+    def counts():
+        snap = perf.default.snapshot()
+        return {k: v["Count"] for k, v in snap["Stages"].items()
+                if k.startswith("sim.round.")}
+
+    was_armed = perf.armed()
+    perf.arm()
+    before = counts()
+    try:
+        rc, out = _run_sim("agent", "-dev", "-gossip-sim", "cpu",
+                           "-gossip-sim-nodes", "64")
+    finally:
+        if not was_armed:
+            perf.disarm()
+    assert rc == 0, out
+    after = counts()
+    # rounds=100 / chunk=20: 1 compile chunk + 4 steady chunks
+    assert after.get("sim.round.xla-flight", 0) \
+        - before.get("sim.round.xla-flight", 0) == 4
+    assert after.get("sim.round.xla-flight.compile", 0) \
+        - before.get("sim.round.xla-flight.compile", 0) == 1
 
 
 def test_gossip_sim_cpu_1000_nodes_bounded():

@@ -15,7 +15,10 @@ from consul_tpu.config import load
 from consul_tpu.connect.ca import generate_root, sign_leaf, verify_leaf
 from consul_tpu.connect.intentions import authorize, match_intention
 
+from helpers import requires_crypto  # noqa: E402
 
+
+@requires_crypto
 def test_root_and_leaf_crypto_roundtrip():
     root = generate_root("test-domain.consul", "dc1")
     leaf = sign_leaf(root, "web", "dc1")
@@ -59,6 +62,7 @@ def client(agent):
     return ConsulClient(agent.http.addr)
 
 
+@requires_crypto
 def test_ca_leaf_over_http(agent, client):
     leaf = client.get("/v1/agent/connect/ca/leaf/web")
     assert "BEGIN CERTIFICATE" in leaf["CertPEM"]
@@ -72,6 +76,7 @@ def test_ca_leaf_over_http(agent, client):
                        leaf["CertPEM"]) == leaf["ServiceURI"]
 
 
+@requires_crypto
 def test_ca_rotation_keeps_old_root_verifiable(agent, client):
     leaf_old = client.get("/v1/agent/connect/ca/leaf/api")
     client.put("/v1/connect/ca/rotate")
@@ -126,6 +131,7 @@ def test_ca_private_key_not_leaked_via_config_api(agent, client):
                                        "Name": "root", "Root": {}})
 
 
+@requires_crypto
 def test_double_rotation_keeps_all_roots(agent, client):
     leaf_a = client.get("/v1/agent/connect/ca/leaf/svc-a")
     client.put("/v1/connect/ca/rotate")
@@ -161,6 +167,7 @@ def test_sidecar_service_expansion(agent, client):
              what="sidecar in catalog")
 
 
+@requires_crypto
 def test_proxy_config_snapshot_and_envoy_bootstrap(agent, client):
     # mesh topology: api -> db, with an intention allowing it
     client.service_register({
@@ -214,6 +221,7 @@ def test_proxy_config_snapshot_and_envoy_bootstrap(agent, client):
     assert tls["require_client_certificate"] is True
 
 
+@requires_crypto
 def test_bootstrap_rbac_enforces_intentions(agent, client):
     """The public listener must carry destination-side RBAC — mTLS alone
     only proves mesh membership, not authorization."""
@@ -280,6 +288,7 @@ def test_discovery_chain_compile_unit():
                   "LoadBalancer": {}, "Weight": 100.0}]
 
 
+@requires_crypto
 def test_discovery_chain_in_proxy_snapshot(agent, client):
     # canary split for db2 + a new canary instance
     client.service_register({
@@ -364,6 +373,7 @@ def test_service_router_compile_unit():
         validate_entry({"Kind": "service-splitter"})
 
 
+@requires_crypto
 def test_service_router_in_snapshot_and_envoy(agent, client):
     """An L7 router on an upstream materializes as an HTTP connection
     manager with ordered route matches (xds routes.go)."""
@@ -424,6 +434,7 @@ def test_service_router_in_snapshot_and_envoy(agent, client):
         client.delete("/v1/config/service-defaults/db2")
 
 
+@requires_crypto
 def test_rest_xds_discovery(agent, client):
     """REST xDS (connect/xds.py): Envoy polls /v3/discovery:* for live
     config; unchanged version_info gets 304, config changes flip the
@@ -463,6 +474,7 @@ def test_rest_xds_discovery(agent, client):
         client.delete("/v1/config/service-splitter/db2")
 
 
+@requires_crypto
 def test_ca_rotation_cross_signs(agent, client):
     """Rotation cross-signs the new root with the old key
     (provider_consul.go CrossSignCA): agents still pinning the old root
@@ -492,6 +504,7 @@ def test_ca_rotation_cross_signs(agent, client):
     assert lc.issuer == xc.subject
 
 
+@requires_crypto
 def test_leaf_renewal_cache(agent, client):
     """The agent's leaf manager caches certs and only re-signs past
     half validity (agent/leafcert)."""
@@ -516,6 +529,7 @@ def test_leaf_renewal_cache(agent, client):
     assert l4.get("CertChainPEM", "").count("BEGIN CERTIFICATE") == 2
 
 
+@requires_crypto
 def test_cross_sign_chain_passes_real_path_validation():
     """The rotation bridge must survive REAL chain validation (pathlen
     constraints included) — signature-only checks miss a root whose
@@ -540,6 +554,7 @@ def test_cross_sign_chain_passes_real_path_validation():
     assert chain.subjects is not None
 
 
+@requires_crypto
 def test_expose_paths_listeners(agent, client):
     """Proxy.Expose.Paths (xds listeners.go makeExposedCheckListener):
     plaintext listeners routing ONE path to the local app so non-mesh
@@ -594,6 +609,7 @@ def test_expose_paths_listeners(agent, client):
     client.service_deregister("m1")
 
 
+@requires_crypto
 def test_transparent_proxy_outbound_listener(agent, client):
     """Proxy.Mode=transparent (xds makeOutboundListener + tproxy):
     one capture listener on OutboundListenerPort with an original_dst
@@ -659,6 +675,7 @@ def test_transparent_proxy_outbound_listener(agent, client):
     client.service_deregister("pay1")
 
 
+@requires_crypto
 def test_resolver_load_balancer_policy(agent, client):
     """service-resolver LoadBalancer (config_entry_discoverychain.go
     :1739 + xds clusters.go injectLBToCluster): Policy sets the
@@ -729,6 +746,7 @@ def test_resolver_load_balancer_policy(agent, client):
     client.service_deregister("lb1")
 
 
+@requires_crypto
 def test_passive_health_check_outlier_detection(agent, client):
     """UpstreamConfig.PassiveHealthCheck (config_entry.go:1198) →
     Cluster.outlier_detection; Overrides by upstream name beat
@@ -813,6 +831,7 @@ def test_passive_health_check_outlier_detection(agent, client):
             client.service_deregister(s)
 
 
+@requires_crypto
 def test_upstream_limits_circuit_breakers(agent, client):
     """UpstreamConfig.Limits (config_entry.go:1276) -> Cluster circuit
     breakers; ConnectTimeoutMs overrides the connect timeout."""
@@ -869,6 +888,7 @@ def test_upstream_limits_circuit_breakers(agent, client):
             client.service_deregister(s)
 
 
+@requires_crypto
 def test_cross_dc_upstream_via_mesh_gateway(agent, client):
     """Upstream.Datacenter + MeshGateway.Mode=local (proxycfg
     upstreams.go): the cluster's endpoints become THIS DC's mesh

@@ -16,7 +16,7 @@ from consul_tpu.api import ConsulClient
 from consul_tpu.config import load
 from consul_tpu.connect.proxy import ConnectProxy
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -59,6 +59,7 @@ def echo_port():
     lsock.close()
 
 
+@requires_crypto
 def test_mtls_end_to_end_and_intention_deny(agent, echo_port):
     client = ConsulClient(agent.http.addr)
 
@@ -123,6 +124,7 @@ def test_mtls_end_to_end_and_intention_deny(agent, echo_port):
         backend.stop()
 
 
+@requires_crypto
 def test_upstream_identity_mismatch_refused(agent, echo_port):
     """An impostor presenting the WRONG service's leaf is refused by
     the upstream's SPIFFE URI check."""

@@ -1,0 +1,392 @@
+"""Kernel-plane roofline observatory tests (sim/costmodel.py).
+
+Three contracts, all tier-1 on CPU:
+
+* the ANALYTIC model's inputs can't silently drift: the state-byte
+  table is pinned against the real init_state pytree, the per-engine
+  formula constants are folded into registry.layout_digest(), and a
+  CPU smoke asserts the compiled programs' own byte accounting
+  (cost_analysis, marginal-unroll protocol) agrees with the model
+  within the pinned COSTMODEL_BOUND;
+* the PERF-REGRESSION LEDGER schema-validates every recorded
+  ``<FAMILY>_r<NN>.json`` in the repo root on every test run — a PR
+  that hand-edits or breaks a record's shape fails HERE by name — and
+  ``check_regression`` refuses a synthetic 20% slowdown while an
+  unstable spread refuses to convict;
+* bench.py's flag validation: mode combinations that used to warn and
+  silently run something else now exit 2 with usage, and
+  ``--check-regression`` without a prior record of the metric exits 2
+  instead of fabricating a baseline.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from consul_tpu.sim import costmodel, registry
+from consul_tpu.sim.costmodel import LedgerError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+
+# ------------------------------------------------------- analytic model
+
+
+def test_state_byte_table_matches_real_state():
+    """costmodel.STATE_FIELD_BYTES mirrors sim/state.py's dtypes
+    without importing jax — this pin is what makes the bit-packing
+    claim (ROADMAP item 5) falsifiable: packing status/local_health
+    into narrower lanes must shrink the MODEL in the same change, or
+    this test names the drifted field."""
+    import jax
+
+    from consul_tpu.sim.state import init_state
+
+    n = 64
+    leaves = jax.tree_util.tree_flatten_with_path(init_state(n))[0]
+    per_node = {}
+    for path, v in leaves:
+        if getattr(v, "shape", None) == (n,):
+            name = jax.tree_util.keystr(path).lstrip(".")
+            per_node[name] = v.dtype.itemsize
+    declared = dict(costmodel.STATE_FIELD_BYTES)
+    assert declared == per_node, (
+        "costmodel.STATE_FIELD_BYTES drifted from the real per-node "
+        f"state pytree: declared {declared}, actual {per_node}")
+    assert costmodel.state_bytes_per_node() == sum(per_node.values())
+
+
+def test_analytic_cost_terms_match_registry():
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams
+
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=4096,
+                                     loss=0.01, tcp_fallback=False)
+    c = costmodel.analytic_cost(p, 24, "lanes")
+    assert tuple(sorted(c["terms"])) == \
+        tuple(sorted(registry.COSTMODEL_BYTE_TERMS))
+    assert c["bytes_per_round"] == pytest.approx(sum(
+        c["terms"].values()))
+    # state term is exactly 2 x declared pytree bytes (read + write)
+    assert c["terms"]["state_rw"] == \
+        2 * costmodel.state_bytes_per_node() * 4096
+    assert c["arithmetic_intensity"] > 0
+    with pytest.raises(ValueError, match="unknown cost-model engine"):
+        costmodel.analytic_cost(p, 24, "tpuv9")
+
+
+def test_analytic_cost_amortization_levers():
+    """The model must MOVE along the axes the autotuner sweeps: more
+    staleness amortizes the collective, a deeper megakernel amortizes
+    the partial tile, decimation scales the flight term."""
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams
+
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=4096,
+                                     loss=0.01, tcp_fallback=False)
+    k1 = costmodel.analytic_cost(p, 24, "lanes")
+    k4 = costmodel.analytic_cost(p.with_(stale_k=4), 24, "lanes")
+    assert k4["terms"]["lane_reduce"] < k1["terms"]["lane_reduce"]
+    assert k4["collectives_per_round"] < k1["collectives_per_round"]
+    # pinned reduction budget: ceil(R/k) + 2 (+1 under overlap)
+    assert costmodel.reductions_per_run(24, 4) == 8
+    assert costmodel.reductions_per_run(25, 4) == 9
+    assert costmodel.reductions_per_run(24, 4, overlap=True) == 9
+    p1 = costmodel.analytic_cost(p, 24, "pallas", rounds_per_call=1)
+    p8 = costmodel.analytic_cost(p, 24, "pallas", rounds_per_call=8)
+    assert p8["terms"]["lane_reduce"] < p1["terms"]["lane_reduce"]
+    f10 = costmodel.analytic_cost(p, 100, "xla", record_every=10)
+    f50 = costmodel.analytic_cost(p, 100, "xla", record_every=50)
+    assert 0 < f50["terms"]["flight"] < f10["terms"]["flight"]
+
+
+def test_registry_digest_covers_costmodel_layout():
+    """The drift guard (same idiom as the sweep/lane pins): moving any
+    cost-model constant — the per-engine byte formulas, the roofline
+    row schema, the record schema version, the ledger families — must
+    move the pinned layout digest so every consumer (costmodel
+    formulas, PROFILE validators, README/ARCHITECTURE tables) is
+    audited in the same change."""
+    base = registry.layout_digest()
+    for name, mutated in (
+        ("COSTMODEL_INTERMEDIATE_VECS",
+         registry.COSTMODEL_INTERMEDIATE_VECS[:-1] + (("pallas", 99),)),
+        ("COSTMODEL_FLOPS", registry.COSTMODEL_FLOPS + (("made_up", 1),)),
+        ("COSTMODEL_WINDOW_VECS", 1),
+        ("COSTMODEL_BOUND", 16.0),
+        ("PROFILE_SCHEMA_VERSION", 99),
+        ("PROFILE_ROOFLINE_ROW",
+         registry.PROFILE_ROOFLINE_ROW + ("bogus",)),
+        ("LEDGER_FAMILIES", registry.LEDGER_FAMILIES + ("VIBES",)),
+        ("COSTMODEL_BYTE_TERMS",
+         registry.COSTMODEL_BYTE_TERMS + ("dark_matter",)),
+    ):
+        orig = getattr(registry, name)
+        try:
+            setattr(registry, name, mutated)
+            assert registry.layout_digest() != base, name
+        finally:
+            setattr(registry, name, orig)
+    assert registry.layout_digest() == base
+
+
+def test_model_vs_measured_within_bound_cpu_smoke():
+    """THE calibration gate (ISSUE satellite): the compiled programs'
+    own byte accounting (cost_analysis over the marginal unroll) must
+    agree with the analytic model within registry.COSTMODEL_BOUND on a
+    small n — an XLA upgrade or a round-body rewrite that doubles
+    traffic fails loudly here, not as a silently-wrong roofline."""
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams
+
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=2048,
+                                     loss=0.01, tcp_fallback=False)
+    for engine in ("fast", "lanes"):
+        bytes_meas, flops_meas, temp_meas = \
+            costmodel.measured_cost(p, engine)
+        model = costmodel.analytic_cost(p, 8, engine)
+        ratio = bytes_meas / model["bytes_per_round"]
+        assert 1.0 / registry.COSTMODEL_BOUND <= ratio \
+            <= registry.COSTMODEL_BOUND, (
+                f"{engine}: measured {bytes_meas:.0f} B/round vs model "
+                f"{model['bytes_per_round']:.0f} — ratio {ratio:.2f} "
+                f"outside the pinned {registry.COSTMODEL_BOUND}x bound")
+        assert flops_meas > 0
+
+
+def test_measure_config_row_schema_and_perf_registry():
+    """measure_config is the autotuner's seam: its row must carry
+    exactly the pinned PROFILE_ROOFLINE_ROW keys, and every timed rep
+    must land in the utils/perf registry as sim.round.<config> so
+    /v1/agent/perf covers the kernel plane."""
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams
+    from consul_tpu.utils import perf
+
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=1024,
+                                     loss=0.01, tcp_fallback=False)
+    reg = perf.PerfRegistry()
+    was_armed = perf.armed()
+    perf.arm()
+    try:
+        row = costmodel.measure_config(p, rounds=4, engine="fast",
+                                       reps=2, peak_gbps=10.0,
+                                       measure_bytes=False,
+                                       perf_registry=reg)
+    finally:
+        if not was_armed:
+            perf.disarm()
+    assert tuple(sorted(row)) == \
+        tuple(sorted(registry.PROFILE_ROOFLINE_ROW))
+    assert row["ms_per_round"] > 0
+    assert row["util"] == pytest.approx(
+        row["achieved_gbps"] / 10.0, rel=1e-3)
+    snap = reg.snapshot()
+    assert "sim.round.fast" in snap["Stages"]
+    assert snap["Stages"]["sim.round.fast"]["Count"] == 2
+    # cadence validation: rounds must cover whole super-rounds
+    with pytest.raises(ValueError, match="multiple of the reduction"):
+        costmodel.measure_config(p.with_(stale_k=3), rounds=4,
+                                 engine="lanes")
+
+
+def test_measure_bandwidth_smoke():
+    bw = costmodel.measure_bandwidth(mbytes=4, reps=1)
+    assert bw["peak_gbps"] >= max(bw["copy_gbps"], bw["triad_gbps"]) \
+        or bw["peak_gbps"] == pytest.approx(
+            max(bw["copy_gbps"], bw["triad_gbps"]))
+    assert bw["copy_gbps"] > 0 and bw["triad_gbps"] > 0
+    assert bw["platform"] == "cpu"
+
+
+# ------------------------------------------------ perf-regression ledger
+
+
+def test_ledger_validates_every_recorded_artifact():
+    """THE satellite contract: every ``*_r*.json`` in the repo root
+    loads and passes its family's schema validator — a PR that
+    hand-edits or shape-breaks a recorded artifact fails tier-1 by
+    name. (BENCH/MULTICHIP/SWEEP/SERVE/PROFILE/BYZ/CHAOS/COORDS are
+    all present in this repo, so every validator actually runs.)"""
+    records = costmodel.load_ledger(REPO_ROOT)
+    assert len(records) >= 20
+    families = {r["family"] for r in records}
+    assert families <= set(registry.LEDGER_FAMILIES)
+    # the trajectory's anchor points are present and readable
+    files = {r["file"] for r in records}
+    assert {"BENCH_r03.json", "PROFILE_r01.json",
+            "SERVE_r01.json"} <= files
+
+
+def test_latest_profile_record_is_roofline_grade():
+    """The acceptance pin: the newest PROFILE record carries the v3
+    roofline table with >= 6 measured engine configs (model bytes,
+    measured bytes, ms/round, utilization, collectives) plus the
+    bandwidth microbench — the artifact bench.py --profile records."""
+    records = [r for r in costmodel.load_ledger(REPO_ROOT)
+               if r["family"] == "PROFILE"]
+    newest = max(records, key=lambda r: r["round"])
+    assert newest["data"].get("schema", 0) >= \
+        registry.PROFILE_SCHEMA_VERSION, (
+            f"{newest['file']} predates the roofline observatory — "
+            "run `python bench.py --smoke --profile` to record one")
+    roof = newest["data"]["profile"]["roofline"]
+    measured = [r for r in roof["rows"] if "skipped" not in r]
+    assert len(measured) >= 6
+    assert roof["bandwidth"]["peak_gbps"] > 0
+    for row in measured:
+        assert set(registry.PROFILE_ROOFLINE_ROW) <= set(row)
+
+
+def test_validator_rejects_broken_records(tmp_path):
+    good = {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+            "parsed": {"metric": "m", "value": 1.0, "unit": "u",
+                       "vs_baseline": 0.1}}
+    costmodel.validate_record("BENCH_r09.json", good)
+    # a hand-edit that drops a required envelope key fails BY NAME
+    broken = {**good, "parsed": {"metric": "m", "value": 1.0}}
+    with pytest.raises(LedgerError, match=r"BENCH_r09.*vs_baseline"):
+        costmodel.validate_record("BENCH_r09.json", broken)
+    with pytest.raises(LedgerError, match="unknown record family"):
+        costmodel.validate_record("VIBES_r01.json", {})
+    with pytest.raises(LedgerError, match="JSON object"):
+        costmodel.validate_record("BENCH_r09.json", [1, 2])
+    with pytest.raises(LedgerError, match="not a recorded-artifact"):
+        costmodel.validate_record("notes.json", {})
+    # a v3 PROFILE record must actually carry the roofline it claims
+    with pytest.raises(LedgerError, match="roofline"):
+        costmodel.validate_record("PROFILE_r09.json", {
+            "metric": "m", "value": 1.0, "unit": "u", "platform": "cpu",
+            "schema": registry.PROFILE_SCHEMA_VERSION, "profile": {}})
+    # and >= 6 measured configs — all-skipped rows can't claim v3
+    with pytest.raises(LedgerError, match=">= 6 measured"):
+        costmodel.validate_record("PROFILE_r09.json", {
+            "metric": "m", "value": 1.0, "unit": "u", "platform": "cpu",
+            "schema": registry.PROFILE_SCHEMA_VERSION,
+            "profile": {"roofline": {
+                "bandwidth": {}, "flags": [],
+                "rows": [{"config": "pallas", "engine": "pallas",
+                          "skipped": "no TPU"}]}}})
+    # load_ledger: a corrupt file on disk fails by filename
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text("{not json")
+    with pytest.raises(LedgerError, match="BENCH_r01.json"):
+        costmodel.load_ledger(str(tmp_path))
+
+
+def test_history_reconstructs_trajectory():
+    """--history's core: one headline row per record, in (family,
+    round) order — the bench trajectory the loose files never
+    offered. The BENCH rounds must surface the full-model r/s story
+    (the stuck-at-7717 number this PR exists to explain)."""
+    records = costmodel.load_ledger(REPO_ROOT)
+    rows = costmodel.history_rows(records)
+    assert len(rows) == len(records)
+    by_file = {r["file"]: r for r in rows}
+    b3 = by_file["BENCH_r03.json"]
+    assert b3["value"] is not None and b3["value"] > 0
+    assert "full-model" in b3["note"]
+    # every row renders; the table carries header + separator + rows
+    table = costmodel.format_history(rows)
+    assert len(table.splitlines()) == len(rows) + 2
+    assert "BENCH_r03.json" in table
+
+
+def test_latest_metric_never_fabricates():
+    records = costmodel.load_ledger(REPO_ROOT)
+    assert costmodel.latest_metric(records, "no_such_metric") is None
+    hit = costmodel.latest_metric(records,
+                                  "gossip_rounds_per_sec_1M_nodes")
+    assert hit is not None and hit["value"] > 0
+    # newest round of that family wins
+    rounds = [r["round"] for r in records
+              if r["family"] == hit["family"]
+              and costmodel._headline_of(r)[0] == hit["metric"]
+              and costmodel._headline_of(r)[1] is not None]
+    assert hit["round"] == max(rounds)
+
+
+def test_check_regression_refuses_synthetic_20pct_slowdown():
+    """The acceptance criterion, verbatim: a tight fresh sample set
+    20% below the recorded baseline is a REGRESSION verdict; the same
+    slowdown measured with a noisy spread refuses to convict
+    (unstable), and too few samples never certify."""
+    base = 7717.0
+    slow = [base * 0.8 * f for f in (0.99, 1.0, 1.0, 1.01, 1.0)]
+    res = costmodel.check_regression(slow, base)
+    assert res["verdict"] == "regression"
+    assert "below the recorded" in res["reason"]
+    # within the band: passes
+    ok = [base * f for f in (0.97, 1.0, 1.01, 0.99, 1.02)]
+    assert costmodel.check_regression(ok, base)["verdict"] == "pass"
+    # same 20% slowdown but the host is noisy: REFUSES to convict
+    noisy = [base * 0.8 * f for f in (0.6, 1.0, 1.4, 0.7, 1.3)]
+    res = costmodel.check_regression(noisy, base)
+    assert res["verdict"] == "unstable"
+    assert "refusal band" in res["reason"]
+    # <3 samples: never certifies either way
+    res = costmodel.check_regression([base * 0.5], base)
+    assert res["verdict"] == "unstable"
+    # a baseline is never fabricated downstream of a None/zero
+    with pytest.raises(ValueError, match="positive recorded baseline"):
+        costmodel.check_regression(ok, None)
+    with pytest.raises(ValueError, match="positive recorded baseline"):
+        costmodel.check_regression(ok, 0.0)
+
+
+# --------------------------------------------- bench.py flag validation
+
+
+def _bench(*argv, env_extra=None, timeout=120):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, BENCH, *argv], capture_output=True,
+        text=True, timeout=timeout, env=env, cwd=REPO_ROOT)
+
+
+def test_bench_mode_combinations_exit_2():
+    """--profile with a non-throughput mode used to warn on stderr and
+    silently run the OTHER mode — a recorded number measuring
+    something different from its command line. Now: exit 2 + usage,
+    nothing runs (fast: fails before any backend init)."""
+    for argv in (("--profile", "--mesh"), ("--profile", "--sweep"),
+                 ("--profile", "--chaos"), ("--profile", "--coords"),
+                 ("--profile", "--history"),
+                 ("--mesh", "--sweep"),
+                 ("--history", "--check-regression"),
+                 ("--history", "--ckpt-dir", "/tmp/nope"),
+                 ("--history", "--resume")):
+        r = _bench(*argv)
+        assert r.returncode == 2, (argv, r.stderr)
+        assert "usage:" in r.stderr, (argv, r.stderr)
+
+
+def test_bench_check_regression_without_record_exits_2(tmp_path):
+    """--check-regression with no prior record of the metric exits 2
+    and never fabricates a baseline (checked BEFORE measuring)."""
+    r = _bench("--check-regression", "--smoke",
+               env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
+    assert r.returncode == 2, r.stderr
+    assert "never fabricated" in r.stderr
+
+
+def test_bench_history_over_tmp_ledger(tmp_path):
+    """--history renders the trajectory from whatever root it is
+    pointed at, and a broken record is rc 1 naming the file."""
+    shutil.copy(os.path.join(REPO_ROOT, "BENCH_r03.json"),
+                tmp_path / "BENCH_r03.json")
+    r = _bench("--history",
+               env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
+    assert r.returncode == 0, r.stderr
+    assert "BENCH_r03.json" in r.stdout
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps({"n": 1}))
+    r = _bench("--history",
+               env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
+    assert r.returncode == 1
+    assert "BENCH_r04.json" in r.stderr

@@ -23,7 +23,7 @@ from consul_tpu.connect.extensions import (ExtensionError,
                                            apply_extensions,
                                            validate_extensions)
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 PROXY_ID = "web1-sidecar-proxy"
 HCM = "envoy.filters.network.http_connection_manager"
@@ -130,6 +130,7 @@ def test_jwt_provider_entry_validation(agent):
 
 # ------------------------------------------------------------------- lua
 
+@requires_crypto
 def test_lua_filter_placement_inbound_only(agent, client):
     """Lua lands in the public HCM ahead of the router and after RBAC
     (authz first); outbound upstream listeners and non-mesh resources
@@ -169,6 +170,7 @@ def test_lua_filter_placement_inbound_only(agent, client):
         _set_extensions(agent, [])
 
 
+@requires_crypto
 def test_lua_lowers_to_proto(agent, client):
     from consul_tpu.server import xds_proto as xp
     from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
@@ -197,6 +199,7 @@ def test_lua_lowers_to_proto(agent, client):
 
 # ------------------------------------------------------------- ext-authz
 
+@requires_crypto
 def test_ext_authz_uri_target_adds_cluster_and_filter(agent, client):
     from consul_tpu.server import xds_proto as xp
     from consul_tpu.server.grpc_external import (LDS_TYPE, CDS_TYPE,
@@ -234,6 +237,7 @@ def test_ext_authz_uri_target_adds_cluster_and_filter(agent, client):
         _set_extensions(agent, [])
 
 
+@requires_crypto
 def test_ext_authz_upstream_service_target(agent, client):
     """Target.Service.Name reuses the existing mesh cluster for that
     upstream instead of minting a new one."""
@@ -253,6 +257,7 @@ def test_ext_authz_upstream_service_target(agent, client):
         _set_extensions(agent, [])
 
 
+@requires_crypto
 def test_failing_extension_is_isolated(agent, client):
     """A non-Required extension that fails mid-apply (target service
     is not an upstream) leaves the resources exactly as generated —
@@ -288,6 +293,7 @@ def test_required_extension_failure_raises():
 JWKS = '{"keys": [{"kty": "oct", "kid": "k1", "k": "c2VjcmV0"}]}'
 
 
+@requires_crypto
 def test_jwt_authn_filter_from_provider_and_intention(agent, client):
     """A jwt-provider entry + an intention referencing it produce the
     jwt_authn filter ahead of RBAC in the public HCM; removing the
@@ -360,6 +366,7 @@ def test_jwt_authn_filter_from_provider_and_intention(agent, client):
         _public_http_filters(cfg)
 
 
+@requires_crypto
 def test_remote_jwks_provider_gets_fetch_cluster(agent, client):
     """A Remote.URI provider must come with a jwks_cluster_<name>
     cluster or Envoy can never fetch the key set (clusters.go
@@ -424,6 +431,7 @@ def test_access_logs_validation(agent):
                                  "JSONFormat": "{nope"})
 
 
+@requires_crypto
 def test_access_logs_attach_and_lower(agent, client):
     """proxy-defaults AccessLogs materialize on every mesh HCM and as
     NR-filtered listener logs, and lower to true proto (accesslogs.go
@@ -485,6 +493,7 @@ def test_access_logs_attach_and_lower(agent, client):
 
 # ------------------------------------- property-override + wasm built-ins
 
+@requires_crypto
 def test_property_override_patches_cluster(agent, client):
     """builtin/property-override: add/remove fields on generated
     resources, with write-time schema validation against the proto
@@ -515,6 +524,7 @@ def test_property_override_patches_cluster(agent, client):
         _set_extensions(agent, [])
 
 
+@requires_crypto
 def test_wasm_filter_and_proto_lowering(agent, client):
     from consul_tpu.server import xds_proto as xp
     from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
@@ -555,6 +565,7 @@ def test_wasm_filter_and_proto_lowering(agent, client):
         _set_extensions(agent, [])
 
 
+@requires_crypto
 def test_wasm_remote_code_gets_fetch_cluster(agent, client):
     """Remote wasm code requires SHA256 and must come with a real
     fetch cluster, or Envoy could never resolve the download."""
@@ -595,6 +606,7 @@ def test_ext_authz_timeout_validated_at_write(agent):
     assert errs and "duration" in errs[0]
 
 
+@requires_crypto
 def test_property_override_never_destroys_scalars(agent, client):
     """An add through a path whose prefix is an existing scalar skips
     rather than wrecking the resource (review finding)."""
@@ -616,6 +628,7 @@ def test_property_override_never_destroys_scalars(agent, client):
 
 # --------------------------------------- upstream-sourced: aws-lambda
 
+@requires_crypto
 def test_aws_lambda_upstream_sourced(agent, client):
     """builtin/aws-lambda (aws_lambda.go): declared on the LAMBDA
     service's own service-defaults, applied to each CALLER's outbound
@@ -679,6 +692,7 @@ def test_aws_lambda_upstream_sourced(agent, client):
                 "Protocol": "http"}}, "t")
 
 
+@requires_crypto
 def test_otel_access_logging_extension(agent, client):
     from consul_tpu.server import xds_proto as xp
     from consul_tpu.server.grpc_external import (LDS_TYPE, build_config,
@@ -719,6 +733,7 @@ def test_otel_access_logging_extension(agent, client):
         _set_extensions(agent, [])
 
 
+@requires_crypto
 def test_jwt_claims_enforced_in_rbac(agent, client):
     """Intention-level JWT requirements are ENFORCED by RBAC metadata
     principals (rbac.go addJWTPrincipal): the allow policy's source
@@ -844,6 +859,7 @@ def test_intention_jwt_validation(agent):
                     "VerifyClaims": [{"Path": []}]}]}}}, "t")
 
 
+@requires_crypto
 def test_permission_level_jwt_enforced(agent, client):
     """Permissions[n].JWT is AND'd into that permission's RBAC rule
     (rbac.go jwtInfosToPermission) — a tokenless request matching the

@@ -15,7 +15,7 @@ from consul_tpu.api import APIError, ConsulClient
 from consul_tpu.config import load
 from consul_tpu.connect.envoy import bootstrap_config
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -32,6 +32,7 @@ def client(agent):
     return ConsulClient(agent.http.addr)
 
 
+@requires_crypto
 def test_ingress_gateway_snapshot_and_bootstrap(agent, client):
     # a mesh service behind a sidecar, reachable through the gateway
     client.service_register({
@@ -91,6 +92,7 @@ def test_ingress_gateway_snapshot_and_bootstrap(agent, client):
         client.delete("/v1/config/service-defaults/web")
 
 
+@requires_crypto
 def test_terminating_gateway_snapshot_and_bootstrap(agent, client):
     # an EXTERNAL service: registered directly, no sidecar
     client.service_register({
@@ -140,6 +142,7 @@ def test_terminating_gateway_snapshot_and_bootstrap(agent, client):
         client.delete("/v1/config/terminating-gateway/my-term")
 
 
+@requires_crypto
 def test_mesh_gateway_snapshot_and_bootstrap(agent, client):
     client.service_register({
         "Name": "mesh-gateway", "ID": "mesh-gateway", "Port": 8445,
@@ -276,6 +279,7 @@ def test_gateway_sds_mode():
     assert "secrets" not in inl["static_resources"]
 
 
+@requires_crypto
 def test_ingress_tls_termination(agent, client):
     """Ingress GatewayTLSConfig (config_entry_gateways.go): entry-level
     TLS.Enabled terminates TLS on every listener with the GATEWAY's

@@ -14,6 +14,8 @@ from consul_tpu.gossip.messages import Keyring
 from consul_tpu.gossip.serf import EventType
 from consul_tpu.types import MemberStatus
 
+from helpers import requires_crypto  # noqa: E402
+
 
 def make_cluster(n, cfg=None, loss=0.0, seed=0, keys=None, net=None):
     cfg = cfg or GossipConfig.local()
@@ -145,6 +147,7 @@ def test_lossy_network_still_converges():
         assert not dead, f"{s.name} wrongly declared {dead}"
 
 
+@requires_crypto
 def test_encrypted_cluster_and_plaintext_rejection():
     key = b"0123456789abcdef"
     net, serfs, events = make_cluster(3, keys=[key])

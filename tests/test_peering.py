@@ -8,7 +8,7 @@ from consul_tpu.api import APIError, ConsulClient
 from consul_tpu.config import load
 from consul_tpu.server import Server
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -91,6 +91,7 @@ def test_peering_delete(clusters):
         cb.get("/v1/health/service/billing", peer="alpha")
 
 
+@requires_crypto
 def test_trust_bundle_exchange_and_system_metadata():
     """Establish exchanges CA trust bundles both ways
     (pbpeering PeeringTrustBundle); leaders record system metadata

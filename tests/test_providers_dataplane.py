@@ -20,7 +20,7 @@ from consul_tpu.connect.providers import (
 )
 from consul_tpu.server import Server
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 
 class FakeVault:
@@ -89,6 +89,7 @@ class FakePCA:
 
 # ------------------------------------------------------------ providers
 
+@requires_crypto
 def test_consul_provider_root_contains_key():
     p = ConsulCAProvider()
     root = p.generate_root("td.consul", "dc1")
@@ -101,6 +102,7 @@ def test_consul_provider_root_contains_key():
     lambda: VaultCAProvider({"RootPKIPath": "pki"}, client=FakeVault()),
     lambda: AWSPCAProvider({}, client=FakePCA()),
 ])
+@requires_crypto
 def test_external_provider_key_never_in_root(provider_f):
     p = provider_f()
     root = p.generate_root("ext.consul", "dc1")
@@ -112,6 +114,7 @@ def test_external_provider_key_never_in_root(provider_f):
     assert uri and uri.endswith("/svc/api")
 
 
+@requires_crypto
 def test_vault_provider_cross_sign():
     p = VaultCAProvider({}, client=FakeVault())
     old = p.generate_root("old.consul", "dc1")
@@ -121,6 +124,7 @@ def test_vault_provider_cross_sign():
     assert "BEGIN CERTIFICATE" in bridge
 
 
+@requires_crypto
 def test_aws_provider_declines_cross_sign():
     p = AWSPCAProvider({}, client=FakePCA())
     r = p.generate_root("a.consul", "dc1")
@@ -134,6 +138,7 @@ def test_make_provider_rejects_unknown():
         make_provider("nope")
 
 
+@requires_crypto
 def test_server_with_vault_provider_signs_leaves():
     """Full server path: ConnectCA.Sign rides the vault provider; the
     replicated root entry has no private key."""
@@ -245,6 +250,7 @@ def test_dataplane_bootstrap_unknown_service(dp_agent):
     ch.close()
 
 
+@requires_crypto
 def test_provider_switch_rotates_root():
     """connect ca set-config with a DIFFERENT provider must rotate the
     root via the new provider, so signing keeps working (the old

@@ -18,7 +18,7 @@ from consul_tpu.config import load
 from consul_tpu.server import Server
 from consul_tpu.server.rpc import ConnPool, RPCError
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 
 @pytest.fixture
@@ -109,6 +109,7 @@ def test_raft_rpc_requires_keyring_hmac():
         srv.shutdown()
 
 
+@requires_crypto
 def test_encrypted_cluster_still_forms():
     """Signed raft traffic between keyring members works end to end."""
     import base64

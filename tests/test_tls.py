@@ -5,6 +5,17 @@ import urllib.request
 
 import pytest
 
+# tlsutil generates CA/cert material at import time via the
+# `cryptography` wheel the jax_graft image does not ship — on a
+# crypto-less container this whole file is a clean module skip
+# (it used to be a COLLECTION ERROR, unreadable in tier-1); on a
+# crypto-enabled host nothing skips. Same contract as
+# helpers.requires_crypto.
+pytest.importorskip(
+    "cryptography",
+    reason="cryptography not installed (crypto-less container); "
+           "TLS configurator cannot generate certs")
+
 from consul_tpu.agent import Agent
 from consul_tpu.api import ConsulClient
 from consul_tpu.config import load

@@ -13,7 +13,7 @@ from consul_tpu.api import ConsulClient
 from consul_tpu.config import load
 
 
-from helpers import wait_for  # noqa: E402
+from helpers import wait_for, requires_crypto  # noqa: E402
 
 
 @pytest.fixture(scope="module")
@@ -83,6 +83,7 @@ def test_unknown_dc_fails_cleanly(two_dcs):
         c1.kv_get("x", dc="dc-mars")
 
 
+@requires_crypto
 def test_mesh_gateway_discovers_remote_dc_gateways(two_dcs):
     """Mesh gateways find remote-DC gateways by KIND over the WAN
     (mesh_gateway.go watches ServiceKind=mesh-gateway per DC) — the
@@ -157,6 +158,7 @@ def test_flood_join_brings_lan_peers_into_wan(two_dcs):
         extra.shutdown()
 
 
+@requires_crypto
 def test_acl_and_config_replication_to_secondary():
     """Leader replication routines (leader.go startACLReplication /
     startConfigReplication): the secondary mirrors primary-owned tables
