@@ -34,6 +34,7 @@ import os
 import sys
 import threading
 import time
+from typing import Optional
 
 # Deadline covering backend init + first compile. TPU init through the
 # tunnel normally takes <30s and the first Mosaic compile 20-40s; when the
@@ -46,9 +47,16 @@ _INIT_TIMEOUT_S = float(os.environ.get("CONSUL_TPU_BENCH_INIT_TIMEOUT", "180"))
 
 
 #: the mutually-exclusive top-level modes; everything else (--smoke,
-#: --profile, --ckpt-dir D, --resume) modifies one of them
+#: --profile, --ckpt-dir D, --resume, --family, --metric) modifies
+#: one of them
 _MODES = ("--mesh", "--sweep", "--chaos", "--coords",
-          "--history", "--check-regression")
+          "--history", "--check-regression", "--autotune")
+
+#: record families --check-regression knows how to RE-MEASURE (the
+#: selector satellite): BENCH re-times the rounds/s headline, PROFILE
+#: re-times the recorded best-utilization roofline config against a
+#: fresh bandwidth peak — both under the same median+IQR refusal band
+_GUARDED_FAMILIES = ("BENCH", "PROFILE")
 
 
 def _usage(err: str) -> None:
@@ -62,8 +70,10 @@ def _usage(err: str) -> None:
           "       bench.py --mesh|--sweep|--chaos [--smoke] "
           "[--ckpt-dir D [--resume]]\n"
           "       bench.py --coords [--smoke]\n"
+          "       bench.py --autotune [--smoke]\n"
           "       bench.py --history\n"
-          "       bench.py --check-regression [--smoke]\n"
+          "       bench.py --check-regression [--smoke] "
+          "[--family BENCH|PROFILE] [--metric NAME]\n"
           "(--profile applies to the throughput bench only; modes are "
           "mutually exclusive)", file=sys.stderr)
     sys.exit(2)
@@ -108,20 +118,51 @@ def run_history() -> None:
           f"(root: {_record_root()})")
 
 
-def run_check_regression(smoke: bool) -> None:
-    """`bench.py --check-regression [--smoke]`: measure a fresh
-    headline and compare it against the LATEST recorded value of the
-    same metric under the PR 9 median+IQR refusal band
-    (costmodel.check_regression). Exit codes: 0 = pass (or the host
-    was too noisy to certify either way — printed, never silent),
-    1 = regression confirmed, 2 = no prior record of this metric
-    (a baseline is never fabricated; checked BEFORE the expensive
-    measurement)."""
+def run_check_regression(smoke: bool, family: str = "BENCH",
+                         metric: Optional[str] = None) -> None:
+    """`bench.py --check-regression [--smoke] [--family F]
+    [--metric NAME]`: measure a fresh value and compare it against the
+    LATEST recorded value of the same metric under the PR 9 median+IQR
+    refusal band (costmodel.check_regression).
+
+    The --family selector (PR 12 satellite) picks WHICH recorded
+    number is guarded — previously only the BENCH headline was
+    checkable:
+
+    * ``BENCH`` (default) — re-times the gossip rounds/s headline.
+    * ``PROFILE`` — re-times the newest roofline's best-utilization
+      config against a freshly measured bandwidth peak and guards the
+      utilization number (in percent, so the band math reads sanely).
+
+    --metric NAME overrides the recorded metric key to baseline
+    against (it must still be one this family knows how to
+    RE-MEASURE — guarding a number with a fresh measurement of a
+    different quantity would be regression theater).
+
+    Exit codes: 0 = pass (or the host was too noisy to certify either
+    way — printed, never silent), 1 = regression confirmed, 2 = no
+    prior record of this metric (a baseline is never fabricated;
+    checked BEFORE the expensive measurement)."""
     from consul_tpu.sim import costmodel
 
-    metric = ("gossip_rounds_per_sec_smoke" if smoke
-              else "gossip_rounds_per_sec_1M_nodes")
     records = _load_ledger_or_die()
+    if family == "PROFILE":
+        _check_profile_regression(smoke, records, metric)
+        return
+    expected = ("gossip_rounds_per_sec_smoke" if smoke
+                else "gossip_rounds_per_sec_1M_nodes")
+    if metric is None:
+        metric = expected
+    elif metric != expected:
+        # the fresh measurement is driven by --smoke alone, so any
+        # other recorded metric would be compared against a different
+        # workload than the one it names — refuse the apples-to-
+        # oranges setup instead of "confirming" a fake regression
+        _usage(f"--family BENCH under "
+               f"{'--smoke' if smoke else 'the 1M-node workload'} "
+               f"re-measures {expected!r}; it cannot baseline that "
+               f"measurement against {metric!r} (--family PROFILE "
+               "guards the utilization number)")
     base = costmodel.latest_metric(records, metric)
     if base is None:
         print(f"--check-regression: no recorded value of {metric!r} "
@@ -177,6 +218,176 @@ def run_check_regression(smoke: bool) -> None:
         **res,
     }))
     sys.exit(1 if res["verdict"] == "regression" else 0)
+
+
+def _check_profile_regression(smoke: bool, records,
+                              metric: Optional[str]) -> None:
+    """--check-regression --family PROFILE: guard the roofline
+    utilization number. Re-times the newest PROFILE record's
+    best-utilization config (same engine/stale_k/rounds_per_call/
+    lane_blocks, same full-model diag params the --profile ladder
+    measures) against a freshly measured STREAM peak, 5 honest single
+    samples, and applies the same median+IQR band to util-in-percent.
+    """
+    from consul_tpu.sim import costmodel
+
+    if metric is not None and metric != "roofline_best_util_pct":
+        _usage(f"--family PROFILE re-measures the roofline's best "
+               f"utilization (metric 'roofline_best_util_pct'); it "
+               f"cannot re-measure {metric!r}")
+    metric = "roofline_best_util_pct"
+    base = costmodel.latest_profile_util(records)
+    if base is None:
+        print(f"--check-regression --family PROFILE: no recorded "
+              f"roofline utilization under {_record_root()} — record "
+              "one first (bench.py --profile); a baseline is never "
+              "fabricated", file=sys.stderr)
+        sys.exit(2)
+    if base["smoke"] != smoke:
+        # utilization at 65k (cache-resident) and 1M (HBM-streaming)
+        # nodes are different physical quantities — refuse the
+        # apples-to-oranges comparison BEFORE measuring, like the
+        # BENCH family's smoke/1M metric split does
+        _usage(f"the recorded roofline baseline ({base['file']}) was "
+               f"measured {'with' if base['smoke'] else 'without'} "
+               f"--smoke (n={base['n'] or 1_048_576}); re-run "
+               f"{'with' if base['smoke'] else 'without'} --smoke or "
+               "record a matching profile first")
+
+    want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
+    watchdog = _arm_watchdog(want, metric)
+    try:
+        import jax
+
+        if smoke:
+            jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        print(_error_line(f"backend init failed: {e}", want, metric))
+        sys.exit(1)
+    watchdog.cancel()
+
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams
+
+    n = 65_536 if smoke else 1_048_576
+    # the --profile roofline runs on the FULL-MODEL diag params
+    # (stats lanes on, slow-node model armed) — match them so the
+    # fresh util compares against the recorded one
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
+                                     loss=0.01, tcp_fallback=False,
+                                     collect_stats=True,
+                                     slow_per_round=0.001)
+    engine = base["engine"]
+    if engine in ("lanes", "overlap"):
+        p = p.with_(stale_k=int(base["stale_k"]))
+    cadence = max(int(base["stale_k"]), int(base["rounds_per_call"]))
+    rounds = 24 if 24 % cadence == 0 else cadence * max(1, 24 // cadence)
+    bw = costmodel.measure_bandwidth()
+    row = costmodel.measure_config(
+        p, rounds=rounds, engine=engine,
+        rounds_per_call=int(base["rounds_per_call"]),
+        lane_blocks=(base["lane_blocks"] if engine == "lanes"
+                     else None),
+        reps=5, peak_gbps=bw["peak_gbps"], return_samples=True)
+    # util per honest sample (NOT best-of), in percent so the band
+    # arithmetic and the printed samples stay legible
+    bytes_eff = row["bytes_measured"] or row["bytes_model"]
+    samples = [bytes_eff / (ms / 1e3) / 1e9 / bw["peak_gbps"] * 100.0
+               for ms in row["samples_ms_per_round"]]
+    res = costmodel.check_regression(samples, base["util"] * 100.0)
+    print(json.dumps({
+        "metric": metric,
+        "config": base["config"],
+        "platform": bw["platform"],
+        "peak_gbps": bw["peak_gbps"],
+        "loadavg_1m": _loadavg_1m(),
+        "baseline_file": base["file"],
+        **res,
+    }))
+    sys.exit(1 if res["verdict"] == "regression" else 0)
+
+
+def _record_tune(payload: dict) -> Optional[str]:
+    """Record an autotune payload as the next TUNE_r<NN>.json (the
+    perf-regression ledger's input; --history reconstructs the tuning
+    trajectory from these)."""
+    return _record_next("TUNE", payload)
+
+
+def run_autotune(smoke: bool) -> None:
+    """`bench.py --autotune [--smoke]`: sweep the rounds_per_call x
+    lane-block-shape x stale_k space on THIS platform's real runners
+    (sim/autotune.py over the costmodel.measure_config seam), print
+    the ladder, record the swept rows + winner as the next
+    TUNE_rNN.json, and persist the winner in AUTOTUNE_CACHE.json keyed
+    (platform, n) — the headline bench times the cached winner next to
+    its fixed ladder and names it in the envelope."""
+    metric = ("autotune_rounds_per_sec_smoke" if smoke
+              else "autotune_rounds_per_sec_1M_nodes")
+    want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
+    watchdog = _arm_watchdog(want, metric)
+    try:
+        import jax
+
+        if smoke:
+            jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        print(_error_line(f"backend init failed: {e}", want, metric))
+        sys.exit(1)
+    watchdog.cancel()
+
+    def fire_hung() -> None:
+        print(_error_line(
+            f"autotune exceeded {_INIT_TIMEOUT_S * 10:.0f}s (hung "
+            "after backend init succeeded)", want, metric), flush=True)
+        os._exit(1)
+
+    watchdog = threading.Timer(_INIT_TIMEOUT_S * 10, fire_hung)
+    watchdog.daemon = True
+    watchdog.start()
+
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams
+    from consul_tpu.sim import autotune as autotune_mod
+
+    n = 65_536 if smoke else 1_048_576
+    # tune the HEADLINE workload (protocol-only, stats off) — the
+    # winner feeds the headline bench's tuned tier, so it must be
+    # picked on the same params the headline times
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=n,
+                                     loss=0.01, tcp_fallback=False,
+                                     collect_stats=False)
+    rec = autotune_mod.autotune(p, rounds=24 if smoke else 48,
+                                reps=3, metric=metric)
+    watchdog.cancel()
+    rec["loadavg_1m"] = _loadavg_1m()
+
+    print(f"autotune ({rec['platform']}, n={n}): "
+          f"{len(rec['rows'])} configs", file=sys.stderr)
+    for row in rec["rows"]:
+        if "skipped" in row:
+            print(f"  {row['config']:<14} skipped: "
+                  f"{row['skipped'][:60]}", file=sys.stderr)
+        else:
+            print(f"  {row['config']:<14} "
+                  f"{row['rounds_per_sec']:>9,.0f} r/s "
+                  f"({row['ms_per_round']:.4f} ms/round)",
+                  file=sys.stderr)
+    w = rec["winner"]
+    print(f"winner: {w['config']} at {w['rounds_per_sec']:,.1f} r/s",
+          file=sys.stderr)
+
+    _record_tune(rec)
+    cache_path = autotune_mod.save_winner(
+        _record_root(), rec["platform"], n, w)
+    print(f"winner cached: {cache_path} "
+          f"[{autotune_mod.cache_key(rec['platform'], n)}]",
+          file=sys.stderr)
+    print(json.dumps(rec))
 
 
 def _ckpt_args(argv):
@@ -278,14 +489,50 @@ def _profile_schema_version() -> int:
     return registry.PROFILE_SCHEMA_VERSION
 
 
-def _record_profile(envelope: dict) -> None:
-    """Record a v3 profile envelope as the next PROFILE_r<NN>.json
-    next to this script (the perf-regression ledger's input). The
-    record is schema-validated BEFORE writing — an envelope the ledger
-    would refuse is never recorded, it is reported."""
+def _record_next(family: str, payload: dict) -> Optional[str]:
+    """Record ``payload`` as the next ``<family>_r<NN>.json`` in the
+    record root (the perf-regression ledger's input) — ONE writer for
+    every recorded family. Schema-validated BEFORE writing (a payload
+    the ledger would refuse is never recorded, it is reported) and
+    written atomically (tmp+rename — a preempted bench can't leave a
+    torn record for the tier-1 ledger walk to choke on)."""
     import re
+    import tempfile
 
-    from consul_tpu.sim import costmodel, registry
+    from consul_tpu.sim import costmodel
+
+    root = _record_root()
+    taken = [int(m.group(1)) for fn in os.listdir(root)
+             for m in [re.match(rf"{family}_r(\d+)\.json$", fn)] if m]
+    name = f"{family}_r{max(taken, default=0) + 1:02d}.json"
+    try:
+        costmodel.validate_record(name, payload)
+    except costmodel.LedgerError as e:
+        print(f"{family} NOT recorded (would fail the ledger): {e}",
+              file=sys.stderr)
+        return None
+    path = os.path.join(root, name)
+    fd, tmp = tempfile.mkstemp(dir=root, prefix=name + ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        os.chmod(tmp, 0o644)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    print(f"{family} recorded: {path}", file=sys.stderr)
+    return path
+
+
+def _record_profile(envelope: dict) -> None:
+    """PROFILE-specific gate over _record_next: an envelope that
+    measured fewer than 6 roofline configs is reported, not recorded."""
+    from consul_tpu.sim import registry
 
     roofline = (envelope.get("profile") or {}).get("roofline")
     measured = sum(1 for r in (roofline or {}).get("rows", ())
@@ -295,21 +542,7 @@ def _record_profile(envelope: dict) -> None:
               f"PROFILE record needs >= 6 measured roofline configs, "
               f"got {measured}", file=sys.stderr)
         return
-    root = _record_root()
-    taken = [int(m.group(1)) for fn in os.listdir(root)
-             for m in [re.match(r"PROFILE_r(\d+)\.json$", fn)] if m]
-    name = f"PROFILE_r{max(taken, default=0) + 1:02d}.json"
-    try:
-        costmodel.validate_record(name, envelope)
-    except costmodel.LedgerError as e:
-        print(f"profile NOT recorded (would fail the ledger): {e}",
-              file=sys.stderr)
-        return
-    path = os.path.join(root, name)
-    with open(path, "w") as f:
-        json.dump(envelope, f, indent=1)
-        f.write("\n")
-    print(f"profile recorded: {path}", file=sys.stderr)
+    _record_next("PROFILE", envelope)
 
 
 def _error_line(error: str, platform: str, metric: str) -> str:
@@ -968,9 +1201,30 @@ def main() -> None:
         _usage(f"--profile applies to the throughput bench only; it "
                f"cannot be combined with {modes[0]}")
     ckpt_dir, resume = _ckpt_args(argv)
-    if modes and modes[0] in ("--history", "--check-regression") \
+    if modes and modes[0] in ("--history", "--check-regression",
+                              "--autotune") \
             and (ckpt_dir is not None or resume):
         _usage(f"{modes[0]} takes no checkpoint flags")
+
+    def _flag_value(flag: str) -> Optional[str]:
+        if flag not in argv:
+            return None
+        i = argv.index(flag)
+        if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+            _usage(f"{flag} needs a value")
+        return argv[i + 1]
+
+    family = _flag_value("--family")
+    metric_sel = _flag_value("--metric")
+    if (family is not None or metric_sel is not None) \
+            and "--check-regression" not in argv:
+        _usage("--family/--metric select what --check-regression "
+               "guards; they apply to no other mode")
+    if family is not None and family not in _GUARDED_FAMILIES:
+        _usage(f"--family must be one of "
+               f"{'/'.join(_GUARDED_FAMILIES)} (the families "
+               f"--check-regression knows how to RE-MEASURE), "
+               f"got {family!r}")
     if "--mesh" in argv:
         run_mesh_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
@@ -987,7 +1241,10 @@ def main() -> None:
         run_history()
         return
     if "--check-regression" in argv:
-        run_check_regression(smoke)
+        run_check_regression(smoke, family or "BENCH", metric_sel)
+        return
+    if "--autotune" in argv:
+        run_autotune(smoke)
         return
     metric = ("gossip_rounds_per_sec_smoke" if smoke
               else "gossip_rounds_per_sec_1M_nodes")
@@ -1226,6 +1483,57 @@ def main() -> None:
             print(f"megakernel unavailable ({e}); per-round kernel "
                   "numbers stand", file=sys.stderr)
 
+    # the AUTOTUNED tier (PR 12): when `bench.py --autotune` persisted
+    # a winner for (platform, n), time the tuned config next to the
+    # fixed ladder and headline whichever is faster, NAMED — the
+    # envelope always says which schedule produced its number. A
+    # corrupt cache is a hard error (it feeds a recorded headline),
+    # never a silent fallback.
+    tuned_info = None
+    if len(devices) == 1:
+        from consul_tpu.sim import autotune as autotune_mod
+
+        try:
+            winner = autotune_mod.cached_winner(_record_root(),
+                                                platform, n)
+        except autotune_mod.AutotuneCacheError as e:
+            print(_error_line(f"autotune cache refused: {e}",
+                              platform, metric))
+            sys.exit(1)
+        if winner is not None:
+            cadence = max(int(winner["stale_k"]),
+                          int(winner["rounds_per_call"]))
+            tuned_chunk = chunk if chunk % cadence == 0 \
+                else cadence * max(1, chunk // cadence)
+            try:
+                trun = autotune_mod.tuned_runner(p, winner,
+                                                 tuned_chunk)
+                tstate = trun(_clone(state),
+                              jax.random.fold_in(key, 5000))
+                jax.block_until_ready(tstate)
+                tbest = float("inf")
+                for trial in range(3):
+                    t0 = time.perf_counter()
+                    for i in range(iters):
+                        tstate = trun(tstate, jax.random.fold_in(
+                            key, 5001 + 10 * trial + i))
+                    checksum = float(tstate.informed.sum())
+                    tbest = min(tbest, time.perf_counter() - t0)
+                    assert checksum > 0
+                tuned_rps = tuned_chunk * iters / tbest
+                tuned_info = {
+                    "config": winner["config"],
+                    "source": autotune_mod.cache_key(platform, n),
+                    "rounds_per_sec": round(tuned_rps, 1),
+                }
+                if tuned_rps > rps:
+                    rps = tuned_rps
+                    kernel = f"tuned-{winner['config']}"
+                    dt, rounds = tbest, tuned_chunk * iters
+            except Exception as e:  # noqa: BLE001 — optional tier
+                print(f"tuned config {winner['config']} unavailable "
+                      f"({e}); ladder numbers stand", file=sys.stderr)
+
     profile_info = None
     if profile:
         import tempfile
@@ -1377,6 +1685,76 @@ def main() -> None:
                 print(f"megakernel profile unavailable ({e})",
                       file=sys.stderr)
                 mega_profile = None
+        # packed-vs-unpacked A/B (PR 12): the SAME lanes runner timed
+        # on packed (int16/int8 tick) and wide (int32 twin) storage,
+        # interleaved on this host, 5 honest samples each under the
+        # median+IQR refusal band — the apples-to-apples form of the
+        # "packing pays on the bandwidth-bound engine" claim (cross-
+        # record comparisons confound host state; this one can't).
+        # The engines are dtype-polymorphic, so the wide twin runs the
+        # identical program with 26 B/node instead of 15.
+        packing_ab = None
+        if len(devices) == 1:
+            try:
+                import statistics as _st
+
+                from consul_tpu.sim.costmodel import STABILITY_BAND
+                from consul_tpu.sim.round import make_run_rounds_lanes
+
+                ab_rounds = 24 if smoke else 96
+                ab_run = make_run_rounds_lanes(p, ab_rounds)
+
+                def _ab_samples(packed: bool, salt: int):
+                    s = ab_run(init_state(n, packed=packed),
+                               jax.random.fold_in(key, salt))
+                    jax.block_until_ready(s)
+                    out = []
+                    for i in range(5):
+                        t0 = time.perf_counter()
+                        s = ab_run(s, jax.random.fold_in(
+                            key, salt + 1 + i))
+                        checksum = float(s.informed.sum())
+                        out.append(ab_rounds
+                                   / (time.perf_counter() - t0))
+                        assert checksum > 0
+                    return out
+
+                sp = _ab_samples(True, 6000)
+                sw = _ab_samples(False, 6100)
+                med_p, med_w = _st.median(sp), _st.median(sw)
+
+                def _iqr_over_med(xs, med):
+                    q = _st.quantiles(xs, n=4)
+                    return (q[2] - q[0]) / med
+
+                spread = max(_iqr_over_med(sp, med_p),
+                             _iqr_over_med(sw, med_w))
+                packing_ab = {
+                    "engine": "lanes",
+                    "rounds": ab_rounds,
+                    "packed_samples": [round(x, 1) for x in sp],
+                    "unpacked_samples": [round(x, 1) for x in sw],
+                    "packed_median": round(med_p, 1),
+                    "unpacked_median": round(med_w, 1),
+                    "band": STABILITY_BAND,
+                }
+                if spread > STABILITY_BAND:
+                    # the refusal band refuses to certify OR convict
+                    packing_ab["ratio"] = None
+                    packing_ab["unstable"] = (
+                        f"IQR/median {spread:.3f} exceeds the "
+                        f"{STABILITY_BAND:.0%} band")
+                else:
+                    packing_ab["ratio"] = round(med_p / med_w, 3)
+                print(f"packing A/B (lanes, n={n}): packed "
+                      f"{med_p:,.1f} vs unpacked {med_w:,.1f} r/s "
+                      f"-> ratio "
+                      f"{packing_ab['ratio'] if packing_ab['ratio'] is not None else 'REFUSED (unstable)'}",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — profile optional
+                print(f"packing A/B unavailable ({e})",
+                      file=sys.stderr)
+
         # kernel-plane roofline ladder (sim/costmodel.py): analytic
         # byte/FLOP model vs the compiled programs' own accounting vs
         # measured achievable bandwidth, across the engine configs the
@@ -1403,6 +1781,7 @@ def main() -> None:
             "flight": flight_info,
             "blackbox": blackbox_info,
             "megakernel": mega_profile,
+            "packing_ab": packing_ab,
             "roofline": roofline,
         }
 
@@ -1419,6 +1798,7 @@ def main() -> None:
         "platform": platform,
         "loadavg_1m": _loadavg_1m(),
         **({"megakernel": mega_info} if mega_info else {}),
+        **({"tuned": tuned_info} if tuned_info else {}),
         **({"smoke": True, "n": n} if smoke else {}),
         **({"profile": profile_info} if profile else {}),
     }

@@ -1,0 +1,260 @@
+"""Megakernel autotuner — sweep the measure_config seam, persist winners.
+
+PR 12's second tentpole half: with the state bit-packed the round is
+bytes-optimal, so the remaining throughput levers are SCHEDULE shaped —
+how many rounds fuse into one launch (``rounds_per_call``), how wide
+the lane-reduction block table sums (``lane_blocks``), and how many
+rounds share one frozen-scalar window (``stale_k``). None of those have
+a portable best: the winner depends on the platform's dispatch overhead
+vs bandwidth balance and on n. So this module measures instead of
+guessing:
+
+* ``sweep_space(platform)`` — the per-platform config grid, every point
+  a (engine, stale_k, rounds_per_call, lane_blocks) tuple the
+  ``costmodel.measure_config`` seam can time. Engines that cannot build
+  on the platform (the Mosaic kernel off-TPU) stay IN the space and
+  record their skip honestly, matching the roofline table's convention.
+* ``autotune(p, ...)`` — times every point on the real scan/megakernel
+  runners (compile excluded, end-to-end checksum) and picks the winner
+  by rounds/s. The returned payload is the ``TUNE`` ledger family
+  (registry.LEDGER_FAMILIES): ``bench.py --autotune`` records it as
+  ``TUNE_rNN.json`` so ``--history`` reconstructs the tuning trajectory.
+* the winner cache — ``AUTOTUNE_CACHE.json`` in the record root, keyed
+  ``{platform}/n{n}``, each entry exactly the digest-pinned
+  ``registry.AUTOTUNE_WINNER_KEYS`` schema. The headline bench consults
+  it (``cached_winner``) and times the tuned config next to its fixed
+  ladder, naming the choice in the envelope; a corrupt or
+  schema-drifted cache REFUSES by file+key (``AutotuneCacheError``)
+  instead of silently mis-tuning a recorded number.
+
+Host-side file code here is jax-free (importable on accelerator-less
+hosts, same contract as costmodel's ledger half); only ``autotune()``
+and ``tuned_runner()`` touch jax, lazily.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Optional
+
+from consul_tpu.sim import registry
+from consul_tpu.sim.costmodel import config_label, measure_config
+
+#: the persisted winner cache, next to the recorded *_r*.json artifacts
+CACHE_FILE = "AUTOTUNE_CACHE.json"
+
+#: stale_k points the lanes/overlap axes sweep (⊆ registry.STALE_KS so
+#: every point's HLO collective budget is already conformance-pinned)
+SWEEP_STALE_KS = (1, 2, 4)
+
+#: rounds_per_call points the megakernel axis sweeps (the PR 7/11
+#: dispatch-amortization ladder)
+SWEEP_ROUNDS_PER_CALL = (1, 4, 8)
+
+
+class AutotuneCacheError(ValueError):
+    """AUTOTUNE_CACHE.json failed to load or validate (named file+key).
+
+    The cache feeds the HEADLINE bench config — a silently-tolerated
+    corrupt entry would make a recorded number measure something other
+    than what its envelope says, so the loader refuses instead."""
+
+
+def sweep_space(platform: str) -> tuple[dict[str, Any], ...]:
+    """The per-platform autotune grid: rounds_per_call x lane block
+    shape x stale_k, as measure_config kwargs.
+
+    Every platform sweeps the fast reference, the lanes engine over
+    stale_k x AUTOTUNE_LANE_BLOCKS, and the overlap schedule over
+    stale_k>1 (pinned block width — the overlap seed/carry tables are
+    keyed to it). The Mosaic megakernel axis is swept everywhere too:
+    off-TPU it records per-row skips, on TPU it is the expected winner,
+    and keeping the space identical makes TUNE records comparable
+    across platforms."""
+    space: list[dict[str, Any]] = [
+        {"engine": "fast", "stale_k": 1, "rounds_per_call": 1,
+         "lane_blocks": None},
+    ]
+    for k in SWEEP_STALE_KS:
+        for blocks in registry.AUTOTUNE_LANE_BLOCKS:
+            space.append({"engine": "lanes", "stale_k": k,
+                          "rounds_per_call": 1, "lane_blocks": blocks})
+    for k in SWEEP_STALE_KS:
+        if k > 1:
+            space.append({"engine": "overlap", "stale_k": k,
+                          "rounds_per_call": 1, "lane_blocks": None})
+    for rpc in SWEEP_ROUNDS_PER_CALL:
+        space.append({"engine": "pallas", "stale_k": 1,
+                      "rounds_per_call": rpc, "lane_blocks": None})
+    return tuple(space)
+
+
+def _config_params(p, cfg: dict[str, Any]):
+    """Derive the per-point SimParams + aligned round count."""
+    k = cfg["stale_k"]
+    pk = p.with_(stale_k=k) if cfg["engine"] in ("lanes", "overlap") \
+        else p
+    return pk, k
+
+
+def _aligned_rounds(rounds: int, cadence: int) -> int:
+    if rounds % cadence:
+        return cadence * max(1, rounds // cadence)
+    return rounds
+
+
+def autotune(p, rounds: int = 24, reps: int = 3,
+             platform: Optional[str] = None,
+             space: Optional[tuple] = None,
+             metric: str = "autotune_rounds_per_sec",
+             measure=measure_config) -> dict[str, Any]:
+    """Time every sweep-space point and pick the rounds/s winner.
+
+    Returns the TUNE-family record payload: {metric, platform, n,
+    rounds, rows, winner}. Rows are full PROFILE_ROOFLINE_ROW dicts
+    (bytes measurement skipped — the tuner ranks wall clock, and the
+    marginal-unroll byte probe would double-compile every point);
+    points that cannot build record ``{"config", "engine", "skipped"}``
+    per the roofline convention. ``measure`` is injectable for tests.
+
+    Raises ValueError when NO point measures (an autotuner that cannot
+    time anything must not fabricate a winner)."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    if space is None:
+        space = sweep_space(platform)
+    rows = []
+    for cfg in space:
+        pk, k = _config_params(p, cfg)
+        r = _aligned_rounds(rounds, max(k, cfg["rounds_per_call"]))
+        try:
+            rows.append(measure(
+                pk, rounds=r, engine=cfg["engine"],
+                rounds_per_call=cfg["rounds_per_call"],
+                lane_blocks=cfg["lane_blocks"],
+                reps=reps, measure_bytes=False))
+        except Exception as e:  # noqa: BLE001 — per-row honesty
+            rows.append({
+                "config": config_label(cfg["engine"], k,
+                                       cfg["rounds_per_call"],
+                                       cfg["lane_blocks"]),
+                "engine": cfg["engine"],
+                "skipped": f"{type(e).__name__}: {e}"})
+    measured = [r for r in rows if "skipped" not in r]
+    if not measured:
+        raise ValueError(
+            f"autotune measured 0 of {len(rows)} configs on "
+            f"{platform} — every point skipped; a winner is never "
+            "fabricated")
+    best = max(measured, key=lambda r: r["rounds_per_sec"])
+    winner = {key: best[key] for key in registry.AUTOTUNE_WINNER_KEYS}
+    return {"metric": metric, "platform": platform, "n": p.n,
+            "rounds": rounds, "rows": rows, "winner": winner}
+
+
+# ------------------------------------------------------- winner cache
+
+
+def cache_key(platform: str, n: int) -> str:
+    return f"{platform}/n{n}"
+
+
+def _cache_path(root: str) -> str:
+    return os.path.join(root, CACHE_FILE)
+
+
+def validate_winner(where: str, winner: Any) -> None:
+    """The AUTOTUNE_WINNER_KEYS schema check, shared by the cache
+    loader and the TUNE record validator's callers."""
+    if not isinstance(winner, dict):
+        raise AutotuneCacheError(
+            f"{where}: winner must be an object, got "
+            f"{type(winner).__name__}")
+    missing = [k for k in registry.AUTOTUNE_WINNER_KEYS
+               if k not in winner]
+    if missing:
+        raise AutotuneCacheError(
+            f"{where}: missing winner keys {sorted(missing)} "
+            f"(schema: {list(registry.AUTOTUNE_WINNER_KEYS)})")
+    if not isinstance(winner.get("rounds_per_sec"), (int, float)):
+        raise AutotuneCacheError(
+            f"{where}: rounds_per_sec must be numeric, got "
+            f"{winner.get('rounds_per_sec')!r}")
+
+
+def load_cache(root: str) -> dict[str, dict[str, Any]]:
+    """Load + validate the winner cache. Missing file -> {} (an
+    untuned host is normal); an unreadable or schema-drifted cache
+    raises AutotuneCacheError by file+key."""
+    path = _cache_path(root)
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise AutotuneCacheError(
+            f"{CACHE_FILE}: unreadable winner cache: {e} — delete the "
+            "file and re-run bench.py --autotune") from e
+    if not isinstance(data, dict):
+        raise AutotuneCacheError(
+            f"{CACHE_FILE}: cache must be an object keyed by "
+            f"'{{platform}}/n{{N}}', got {type(data).__name__}")
+    for key, winner in data.items():
+        validate_winner(f"{CACHE_FILE}[{key}]", winner)
+    return data
+
+
+def save_winner(root: str, platform: str, n: int,
+                winner: dict[str, Any]) -> str:
+    """Merge one (platform, n) winner into the cache, atomically
+    (tmp+rename — a preempted write can't tear the cache). Returns the
+    cache path. The existing cache must validate first: a corrupt file
+    refuses rather than being silently papered over."""
+    validate_winner(f"{cache_key(platform, n)} winner", winner)
+    cache = load_cache(root)
+    cache[cache_key(platform, n)] = winner
+    fd, tmp = tempfile.mkstemp(dir=root, prefix=CACHE_FILE + ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, _cache_path(root))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return _cache_path(root)
+
+
+def cached_winner(root: str, platform: str, n: int
+                  ) -> Optional[dict[str, Any]]:
+    """The persisted winner for (platform, n), or None when this
+    combination was never tuned. Validation errors propagate — the
+    caller (the headline bench) must not fall back silently."""
+    return load_cache(root).get(cache_key(platform, n))
+
+
+def tuned_runner(p, winner: dict[str, Any], rounds: int):
+    """Build the REAL runner for a winner config — the headline
+    bench's tuned path. ``rounds`` must cover whole reduction/fusion
+    cadences (same contract as measure_config)."""
+    from consul_tpu.sim.costmodel import _scan_runner
+
+    validate_winner("tuned_runner winner", winner)
+    engine = winner["engine"]
+    k = int(winner["stale_k"])
+    rpc = int(winner["rounds_per_call"])
+    pk = p.with_(stale_k=k) if engine in ("lanes", "overlap") else p
+    blocks = winner["lane_blocks"] if engine == "lanes" else None
+    if rounds % max(k, rpc):
+        raise ValueError(
+            f"rounds={rounds} must be a multiple of the tuned "
+            f"config's cadence (stale_k={k}, rounds_per_call={rpc})")
+    return _scan_runner(pk, engine, rounds, rpc, blocks)

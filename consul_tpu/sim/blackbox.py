@@ -117,7 +117,10 @@ def init_blackbox(state, tracked, ring_len: int = DEFAULT_RING_LEN
         ring=jnp.zeros((k, ring_len, N_REC), jnp.int32),
         count=jnp.zeros((k,), jnp.int32),
         prev_status=state.status.reshape(-1)[tracked].astype(jnp.int32),
-        prev_inc=state.incarnation.reshape(-1)[tracked],
+        # widen the packed int16 lane: the scan-carried diff baseline
+        # must keep one dtype across rounds (record() stores int32)
+        prev_inc=state.incarnation.reshape(-1)[tracked]
+        .astype(jnp.int32),
         prev_conf=state.susp_conf.reshape(-1)[tracked].astype(jnp.int32),
         prev_up=state.up.reshape(-1)[tracked].astype(jnp.int32) != 0,
         last_phase=jnp.int32(-1),

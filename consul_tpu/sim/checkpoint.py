@@ -258,6 +258,21 @@ def snapshot(p: SimParams, key, state: SimState, *, engine: str,
         if f == "stats":
             continue
         arrays[f"state/{f}"] = _np(getattr(state, f))
+    # refuse-by-name on the packed saturation caps (PR 12): a snapshot
+    # whose int16 lanes clamped mid-run would resume from corrupt
+    # values — fail loudly at the cut instead (cheap: the arrays are
+    # already on host). One shared (field, cap) table with the chaos
+    # suite's check (state.SATURATING_FIELDS).
+    from consul_tpu.sim.state import SaturationError, saturated_fields
+
+    saturated = saturated_fields(
+        lambda f: int(arrays[f"state/{f}"].max(initial=0)))
+    if saturated:
+        raise SaturationError(
+            f"refusing checkpoint at round {cursor}: packed lanes "
+            f"{', '.join(saturated)} hit the int16 saturation cap "
+            f"({registry.TICK_MAX}) — the snapshot would resume from "
+            "clamped values")
     for f in SimStats._fields:
         arrays[f"state/stats/{f}"] = _np(getattr(state.stats, f))
     for name, val in (("lanes", lanes), ("scalars", scalars),

@@ -61,23 +61,16 @@ from consul_tpu.sim import registry
 
 # ------------------------------------------------------ analytic model
 
-#: the SimState per-node field widths (bytes), mirrored from
-#: sim/state.py's dtypes WITHOUT importing jax — tier-1 asserts this
-#: table matches the real init_state leaves, so a packed-state PR
-#: (ROADMAP item 5) must update the model in the same change and the
-#: predicted traffic halves exactly when the state does.
-STATE_FIELD_BYTES = (
-    ("up", 1),            # bool
-    ("down_time", 4),     # f32
-    ("status", 1),        # int8
-    ("incarnation", 4),   # int32
-    ("informed", 4),      # f32
-    ("susp_start", 4),    # f32
-    ("susp_deadline", 4), # f32
-    ("susp_conf", 2),     # int16
-    ("local_health", 1),  # int8
-    ("slow", 1),          # bool
-)
+#: the SimState per-node field widths (bytes), derived from the
+#: digest-pinned packed layout (registry.STATE_PACKED_FIELDS) WITHOUT
+#: importing jax — tier-1 asserts this table matches the real
+#: init_state leaves, so the packed-state layout, this model, and the
+#: engines can only move together. PR 12's bit-packing shrank it
+#: 26 -> 15 B/node (f32 time fields -> int16 tick counts, int32
+#: incarnation -> int16, the up/slow bools folded into down_age's
+#: sentinel range), cutting the modeled state_rw term 42.3%.
+STATE_FIELD_BYTES = tuple(
+    (name, nbytes) for name, _, nbytes in registry.STATE_PACKED_FIELDS)
 
 #: model bytes per node per PRNG draw site: one threefry f32 vector
 #: materialized (4B write) and consumed (4B read)
@@ -216,7 +209,7 @@ def _cost_of(fn, *args) -> tuple[float, float, float]:
             float(ca.get("flops", 0.0)), temp)
 
 
-def _unrolled_fn(p, engine: str, rounds: int):
+def _unrolled_fn(p, engine: str, rounds: int, lane_blocks=None):
     """An R-round fully-UNROLLED callable for `engine` — the byte-
     accounting probe. XLA's cost analysis counts a lax.scan body ONCE
     regardless of trip count (measured: an 8-round and a 16-round scan
@@ -245,11 +238,16 @@ def _unrolled_fn(p, engine: str, rounds: int):
         return f
     if engine in ("lanes", "overlap"):
         overlap = engine == "overlap"
+        # the probe must compile the SAME block-table width the timed
+        # runner uses, or a lane_blocks row would pair one program's
+        # wall clock with another's byte count
+        reducer = (lanes_mod.reduce_lanes_single if lane_blocks is None
+                   else lanes_mod._SingleDeviceReducer(lane_blocks))
 
         def f(state, key):
             keys = round_keys(key, state.round_idx, rounds)
             return _lane_scan(state, keys, None, p, rounds, None,
-                              False, lanes_mod.reduce_lanes_single, 0,
+                              False, reducer, 0,
                               overlap=overlap, unroll=True)
         return f
     raise ValueError(f"no unrolled byte probe for engine {engine!r} "
@@ -257,7 +255,8 @@ def _unrolled_fn(p, engine: str, rounds: int):
                      "opaque — its row reports the model bytes)")
 
 
-def measured_cost(p, engine: str) -> tuple[float, float, float]:
+def measured_cost(p, engine: str, lane_blocks=None
+                  ) -> tuple[float, float, float]:
     """Per-round (bytes, flops) of the compiled program, via the
     marginal difference of two unrolled depths — init/epilogue work
     (init_scalars, the staged init_lanes reductions) cancels exactly,
@@ -271,9 +270,9 @@ def measured_cost(p, engine: str) -> tuple[float, float, float]:
     k = p.stale_k if engine in ("lanes", "overlap") else 1
     r1, r2 = k, 2 * k
     key = jax.random.key(0)
-    b1, f1, _ = _cost_of(_unrolled_fn(p, engine, r1),
+    b1, f1, _ = _cost_of(_unrolled_fn(p, engine, r1, lane_blocks),
                          init_state(p.n), key)
-    b2, f2, temp = _cost_of(_unrolled_fn(p, engine, r2),
+    b2, f2, temp = _cost_of(_unrolled_fn(p, engine, r2, lane_blocks),
                             init_state(p.n), key)
     return (b2 - b1) / (r2 - r1), (f2 - f1) / (r2 - r1), temp
 
@@ -322,20 +321,28 @@ def measure_bandwidth(mbytes: int = 64, reps: int = 5) -> dict[str, Any]:
     }
 
 
-def _scan_runner(p, engine: str, rounds: int, rounds_per_call: int):
+def _scan_runner(p, engine: str, rounds: int, rounds_per_call: int,
+                 lane_blocks=None):
     """The REAL (scan/megakernel) runner for wall-clock timing — the
-    program production runs, not the unrolled byte probe."""
+    program production runs, not the unrolled byte probe.
+    ``lane_blocks`` is the autotuner's block-shape axis (lanes engine
+    only; the factory refuses it under overlap)."""
     from consul_tpu.sim.round import (make_run_rounds,
                                       make_run_rounds_fast,
                                       make_run_rounds_lanes)
 
+    if engine != "lanes" and lane_blocks is not None:
+        raise ValueError(
+            f"lane_blocks is the lanes engine's block-shape knob; "
+            f"engine {engine!r} has no block table to resize")
     if engine == "xla":
         return make_run_rounds(p, rounds)
     if engine == "fast":
         return make_run_rounds_fast(p, rounds)
     if engine in ("lanes", "overlap"):
         return make_run_rounds_lanes(p, rounds,
-                                     overlap=engine == "overlap")
+                                     overlap=engine == "overlap",
+                                     lane_blocks=lane_blocks)
     if engine == "pallas":
         from consul_tpu.sim.pallas_round import make_run_rounds_pallas
 
@@ -348,9 +355,16 @@ def measure_config(p, rounds: int = 24, engine: str = "lanes",
                    rounds_per_call: int = 1, reps: int = 3,
                    peak_gbps: Optional[float] = None,
                    measure_bytes: bool = True,
+                   lane_blocks: Optional[int] = None,
+                   return_samples: bool = False,
                    perf_registry=None) -> dict[str, Any]:
-    """Measure ONE engine config end to end — the seam ROADMAP item
-    5's rounds_per_call x block-shape autotuner sweeps.
+    """Measure ONE engine config end to end — the seam the
+    rounds_per_call x block-shape x stale_k autotuner
+    (sim/autotune.py) sweeps. ``lane_blocks`` overrides the lanes
+    engine's reduction block-table width (registry.AUTOTUNE_LANE_
+    BLOCKS); the default pinned width is the only one the bitwise
+    shard-invariance conformance covers, so a non-default row is a
+    single-device throughput knob, labeled ``lanes[-kK]-bB``.
 
     Returns the PROFILE_ROOFLINE_ROW dict: wall-clock ms/round (best
     of ``reps`` timed calls on the real scan runner, compile excluded),
@@ -376,17 +390,18 @@ def measure_config(p, rounds: int = 24, engine: str = "lanes",
         raise ValueError(
             f"rounds={rounds} must be a multiple of the reduction "
             f"cadence (stale_k={k}, rounds_per_call={rounds_per_call})")
-    label = config_label(engine, k, rounds_per_call)
+    label = config_label(engine, k, rounds_per_call, lane_blocks)
     model = analytic_cost(p, rounds, engine,
                           rounds_per_call=rounds_per_call)
 
-    run = _scan_runner(p, engine, rounds, rounds_per_call)
+    run = _scan_runner(p, engine, rounds, rounds_per_call, lane_blocks)
     key = jax.random.key(0)
     from consul_tpu.sim.state import init_state
 
     state = run(init_state(p.n), key)  # compile + warm (donates input)
     jax.block_until_ready(state)
     best = float("inf")
+    samples_ms = []
     for i in range(reps):
         t0 = time.perf_counter()
         state = run(state, jax.random.fold_in(key, i + 1))
@@ -394,13 +409,14 @@ def measure_config(p, rounds: int = 24, engine: str = "lanes",
         dt = time.perf_counter() - t0
         assert checksum > 0
         best = min(best, dt)
+        samples_ms.append(dt / rounds * 1e3)
         perf_registry.observe(f"sim.round.{label}", dt / rounds)
     ms_per_round = best / rounds * 1e3
 
     bytes_measured = flops_measured = temp_measured = None
     if measure_bytes and engine != "pallas":
         bytes_measured, flops_measured, temp_measured = \
-            measured_cost(p, engine)
+            measured_cost(p, engine, lane_blocks)
 
     bytes_model = model["bytes_per_round"]
     ratio = (None if not bytes_measured
@@ -413,11 +429,27 @@ def measure_config(p, rounds: int = 24, engine: str = "lanes",
     # custom-call opaque to cost_analysis — stated in the row)
     bytes_eff = bytes_measured if bytes_measured else bytes_model
     achieved_gbps = bytes_eff / (ms_per_round / 1e3) / 1e9
+    if engine in ("lanes", "overlap"):
+        blocks = lane_blocks if lane_blocks is not None \
+            else registry.LANE_BLOCKS
+    else:
+        blocks = None  # no block table in this engine
+    extra = {}
+    if return_samples:
+        # the --check-regression --family PROFILE protocol: the row
+        # schema stays exactly PROFILE_ROOFLINE_ROW unless the caller
+        # explicitly asks for the honest per-rep spread (NOT best-of —
+        # the refusal band needs it to decide whether this host can
+        # claim anything)
+        extra["samples_ms_per_round"] = [round(s, 4)
+                                         for s in samples_ms]
     return {
+        **extra,
         "config": label,
         "engine": engine,
         "stale_k": k,
         "rounds_per_call": rounds_per_call,
+        "lane_blocks": blocks,
         "ms_per_round": round(ms_per_round, 4),
         "rounds_per_sec": round(1e3 / ms_per_round, 1),
         "bytes_model": round(bytes_model, 1),
@@ -441,12 +473,17 @@ def measure_config(p, rounds: int = 24, engine: str = "lanes",
 
 
 def config_label(engine: str, stale_k: int = 1,
-                 rounds_per_call: int = 1) -> str:
+                 rounds_per_call: int = 1,
+                 lane_blocks: Optional[int] = None) -> str:
+    label = engine
     if engine in ("lanes", "overlap") and stale_k != 1:
-        return f"{engine}-k{stale_k}"
+        label = f"{engine}-k{stale_k}"
     if engine == "pallas" and rounds_per_call != 1:
-        return f"pallas-x{rounds_per_call}"
-    return engine
+        label = f"pallas-x{rounds_per_call}"
+    if engine == "lanes" and lane_blocks is not None \
+            and lane_blocks != registry.LANE_BLOCKS:
+        label = f"{label}-b{lane_blocks}"
+    return label
 
 
 #: the default --profile roofline ladder: (engine, stale_k,
@@ -623,6 +660,27 @@ def _validate_byz(name: str, d: dict) -> None:
     _require(name, d, ("metric", "n", "classes", "corroboration_sweep"))
 
 
+def _validate_tune(name: str, d: dict) -> None:
+    """Autotuner record (sim/autotune.py): the swept config rows plus
+    the per-(platform, n) winner the cache persists."""
+    _require(name, d, ("metric", "platform", "n", "rounds", "rows",
+                       "winner"))
+    if not isinstance(d["rows"], list) or not d["rows"]:
+        raise LedgerError(f"{name}: rows must be a non-empty list")
+    for i, row in enumerate(d["rows"]):
+        rn = f"{name}.rows[{i}]"
+        if not isinstance(row, dict):
+            raise LedgerError(f"{rn}: row must be an object")
+        if "skipped" in row:
+            _require(rn, row, ("config", "engine"))
+            continue
+        _require(rn, row, registry.AUTOTUNE_WINNER_KEYS)
+        _require_num(rn, row, ("rounds_per_sec",))
+    _require(f"{name}.winner", d["winner"],
+             registry.AUTOTUNE_WINNER_KEYS)
+    _require_num(f"{name}.winner", d["winner"], ("rounds_per_sec",))
+
+
 def _validate_scenario(name: str, d: dict) -> None:
     if d.get("skipped"):
         _require(name, d, ("metric",))
@@ -641,6 +699,7 @@ _VALIDATORS = {
     "BYZ": _validate_byz,
     "CHAOS": _validate_scenario,
     "COORDS": _validate_scenario,
+    "TUNE": _validate_tune,
 }
 assert set(_VALIDATORS) == set(registry.LEDGER_FAMILIES)
 
@@ -769,6 +828,12 @@ def _headline_of(rec: dict[str, Any]):
         return (d.get("metric"), None, None,
                 f"{len(d['classes'])} attack classes"
                 + (f", k sweep {len(ks)} pts" if ks else ""))
+    if fam == "TUNE":
+        w = d["winner"]
+        measured = sum(1 for r in d["rows"] if "skipped" not in r)
+        return (d.get("metric"), w.get("rounds_per_sec"), "rounds/s",
+                f"winner {w.get('config')} of {measured} measured "
+                f"configs (n={d.get('n')})")
     # CHAOS / COORDS
     if d.get("skipped"):
         return d.get("metric"), None, None, "skipped"
@@ -828,6 +893,43 @@ def latest_metric(records: list[dict], metric: str
                         "round": rec["round"], "metric": m,
                         "value": value, "unit": unit}
     return best
+
+
+def latest_profile_util(records: list[dict]
+                        ) -> Optional[dict[str, Any]]:
+    """The newest PROFILE record's best roofline utilization row —
+    the --check-regression --family PROFILE baseline: {file, round,
+    util, config, engine, stale_k, rounds_per_call, lane_blocks,
+    smoke, n}. ``smoke``/``n`` name the WORKLOAD the baseline was
+    measured at, so the caller can refuse a fresh measurement at a
+    different n (the BENCH family's apples-to-oranges guard, here).
+
+    Rows with util > 1 are cache artifacts, not roofline points (the
+    working set fit in LLC and beat the streaming ceiling — recorded
+    honestly, but "139% of peak" is not a physical utilization), so
+    the baseline PREFERS the best util <= 1 row and falls back to the
+    overall max only when every row is cache-resident. Never
+    fabricates: None when no recorded roofline carries a utilization
+    number (legacy v1/v2 profiles, all-skipped ladders)."""
+    profs = sorted((r for r in records if r["family"] == "PROFILE"),
+                   key=lambda r: r["round"], reverse=True)
+    for rec in profs:
+        roof = (rec["data"].get("profile") or {}).get("roofline")
+        rows = [row for row in (roof or {}).get("rows", ())
+                if row.get("util") is not None]
+        if not rows:
+            continue
+        physical = [row for row in rows if row["util"] <= 1.0]
+        best = max(physical or rows, key=lambda row: row["util"])
+        return {"file": rec["file"], "round": rec["round"],
+                "util": best["util"], "config": best["config"],
+                "engine": best["engine"],
+                "stale_k": best.get("stale_k", 1),
+                "rounds_per_call": best.get("rounds_per_call", 1),
+                "lane_blocks": best.get("lane_blocks"),
+                "smoke": bool(rec["data"].get("smoke")),
+                "n": rec["data"].get("n")}
+    return None
 
 
 def check_regression(samples: list[float], baseline: float,

@@ -63,11 +63,11 @@ _HIST_SLICE = slice(_GAUGE0 + len(registry.LANE_GAUGES), N_LANES)
 STATS_SLICE = _STATS_SLICE
 
 
-def check_pool(n: int) -> None:
-    if n % LANE_BLOCKS:
+def check_pool(n: int, blocks: int = LANE_BLOCKS) -> None:
+    if n % blocks:
         raise ValueError(
-            f"lane engine pools must divide LANE_BLOCKS={LANE_BLOCKS} "
-            f"blocks evenly: n={n}")
+            f"lane engine pools must divide the {blocks}-wide block "
+            f"table evenly: n={n}")
 
 
 def check_flight_config(p, flight_every) -> None:
@@ -237,18 +237,28 @@ class LaneReducer:
 class _SingleDeviceReducer(LaneReducer):
     """Single-device lane reducer: ONE fused sum of the stacked
     contribution matrix, via the same fixed block table the mesh
-    reducer psums — [K, L] -> [K, LANE_BLOCKS] -> [K].
+    reducer psums — [K, L] -> [K, blocks] -> [K].
 
     The barrier between the stages is load-bearing: without it XLA's
     algebraic simplifier merges the two reduces into one flat [K, L]
     sum whose f32 accumulation order differs from the mesh's
     block-then-table order (the psum is a natural barrier there), and
     single-vs-sharded conformance degrades from bitwise to
-    approximate."""
+    approximate.
+
+    ``blocks`` defaults to the digest-pinned LANE_BLOCKS — the ONLY
+    width the bitwise shard-invariance pins cover. Other widths
+    (registry.AUTOTUNE_LANE_BLOCKS) are a single-device throughput
+    knob the autotuner sweeps: a different block table sums in a
+    different f32 order, so its output is statistically (not bitwise)
+    conformant with the default."""
+
+    def __init__(self, blocks: int = LANE_BLOCKS) -> None:
+        self.blocks = blocks
 
     def partials(self, stack: jnp.ndarray) -> jnp.ndarray:
         return jax.lax.optimization_barrier(
-            _block_partials(stack, LANE_BLOCKS))
+            _block_partials(stack, self.blocks))
 
     def fold(self, table: jnp.ndarray) -> jnp.ndarray:
         return table.sum(axis=1)

@@ -73,9 +73,9 @@ def state_sharding(mesh: Mesh) -> SimState:
     rep = NamedSharding(mesh, P())
 
     return SimState(
-        up=row, down_time=row, status=row, incarnation=row, informed=row,
-        susp_start=row, susp_deadline=row, susp_conf=row,
-        local_health=row, slow=row, t=rep, round_idx=rep,
+        status=row, incarnation=row, informed=row, down_age=row,
+        susp_len=row, susp_ttl=row, susp_conf=row,
+        local_health=row, t=rep, round_idx=rep,
         stats=SimStats(*[rep] * len(SimStats._fields)))
 
 

@@ -32,8 +32,9 @@ from consul_tpu.sim.params import SimParams
 from consul_tpu.sim.round import (N_SCALARS, init_scalars,
                                   _pf_arrays, _shrink, round_keys,
                                   round_seeds)
-from consul_tpu.sim.state import (ALIVE, DEAD, LEFT, SUSPECT, SimState,
-                                  SimStats)
+from consul_tpu.sim.state import (ALIVE, ALIVE_AGE, CONF_MAX, DEAD, LEFT,
+                                  SLOW_AGE, SUSPECT, TICK_MAX, TTL_NEVER,
+                                  SimState, SimStats)
 
 #: the kernel's partial-sum lane order IS the registry's reduction-lane
 #: prefix: population scalars first, then the SimStats counters — one
@@ -63,10 +64,18 @@ def _stats_add(st: SimStats, acc_i, acc_lat) -> SimStats:
 INF = 3.4e38  # python float: jnp constants can't be captured by kernels
 
 LANES = 1024  # row width: multiple of 128 lanes; int8 tiles need 32 rows
-# rows per block: 10-array (churn/slow) kernels must fit 16MB VMEM;
-# 8-array stable kernels take double blocks for fewer grid steps;
-# fault kernels carry 8 extra per-node input lanes (~36B/node more), so
-# they halve the block again to stay inside VMEM with double buffering
+
+#: the packed state's kernel array order — SimState's per-node fields
+#: (registry.STATE_PACKED_FIELDS order). Liveness/slow ride the
+#: down_age sentinels, so the old separate up/slow arrays are gone:
+#: every config is 8 arrays, 15 B/node of HBM traffic.
+N_ARRAYS = 8
+_AGE_IDX = 3  # down_age's slot in the array tuple
+
+# rows per block: mutable-age (churn/slow/stats) kernels must fit 16MB
+# VMEM with double buffering; stable kernels take double blocks for
+# fewer grid steps; fault kernels carry 8 extra per-node input lanes,
+# so they halve the block again
 ROWS_FULL, ROWS_STABLE, ROWS_FAULT = 128, 256, 64
 
 #: per-round fault-injection inputs appended after the state arrays:
@@ -92,11 +101,20 @@ def _u01(shape) -> jnp.ndarray:
     return top24.astype(jnp.float32) * (1.0 / (1 << 24))
 
 
-def _model_arrays(p: SimParams, fault: bool = False) -> bool:
-    """Whether the config needs the down_time/slow arrays in the kernel
-    (skipping them saves ~20%% of HBM traffic for stable configs).
-    Stats collection needs down_time for detection latency; a fault
-    plan can inject churn (bursts, flaps) regardless of params."""
+def _age_mutable(p: SimParams, fault: bool = False) -> bool:
+    """Whether the config can MUTATE the down_age lane: churn moves
+    the crash stamps, the slow model toggles the -1/-2 sentinels, and
+    stats collection needs dead nodes to age (detection latency). A
+    config with none of those runs the lane READ-ONLY: residual
+    dead/slow rows keep their full dynamics (the kernel reads the
+    sentinels every round — a pre-crashed node is probed, suspected,
+    and declared like anywhere else) but a dead row's AGE stays
+    frozen at its entry value while the XLA engines tick it up — the
+    packed analogue of the old constant ``down_time`` stamp. That is
+    bookkeeping-only divergence: age feeds detection-latency stats
+    (off here) and rejoin (churn, off here), never the dynamics. Run
+    an age-mutable config (collect_stats=True) when the age lane must
+    track the reference."""
     return bool(p.fail_per_round or p.leave_per_round
                 or p.rejoin_per_round or p.slow_per_round
                 or p.collect_stats or fault)
@@ -107,21 +125,23 @@ def _has_churn(p: SimParams, fault: bool = False) -> bool:
                 or p.rejoin_per_round or fault)
 
 
+def _rows_per_block(p: SimParams, fault: bool = False) -> int:
+    return ROWS_FAULT if fault else (
+        ROWS_FULL if _age_mutable(p, fault) else ROWS_STABLE)
+
+
 def _write_mask(p: SimParams, fault: bool = False) -> list[bool]:
-    """Which state arrays a round can actually MUTATE. down_time moves
-    only under churn (crash stamps it, rejoin clears it) and slow only
-    under the degradation model — a stats-only config reads them but
-    never writes, so skipping their output copies saves their share of
-    HBM write bandwidth on every round (the full-model bench config
-    drops from 50 to 46 bytes/node-round). Forced-slow fault masks are
-    ephemeral (never stored), so `fault` widens down_time only."""
-    mask = [True] * 8
-    if _model_arrays(p, fault):
-        mask += [_has_churn(p, fault), bool(p.slow_per_round)]
+    """Which state arrays a round can actually MUTATE. All packed
+    lanes but down_age rewrite every round; down_age only moves under
+    churn / the slow model / stats aging (_age_mutable) — a stable
+    config skips its output copy, saving its share of HBM write
+    bandwidth every round."""
+    mask = [True] * N_ARRAYS
+    mask[_AGE_IDX] = _age_mutable(p, fault)
     return mask
 
 
-def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
+def _block_round(p: SimParams, fault: bool, vals, fxv, scal,
                  byz: bool = False):
     """One block's protocol period as PURE VALUE math — the single copy
     of the kernel-side round body, shared by the per-round kernel
@@ -129,9 +149,9 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
     the two cannot drift (the Mosaic twin of round._round_core's
     one-body-many-engines structure).
 
-    `vals` is the 10-tuple of RAW block arrays as loaded from refs
-    (down_time/slow None for 8-array configs), `fxv` the raw
-    fault-input arrays or None, `scal` the 9 SMEM scalars
+    `vals` is the N_ARRAYS-tuple of RAW block arrays as loaded from
+    refs (packed dtypes — registry.STATE_PACKED_FIELDS order), `fxv`
+    the raw fault-input arrays or None, `scal` the 9 SMEM scalars
     (N_SCALARS stale sums + the plan's mean link quality or None).
     `byz` marks a byzantine plan (faults.plan_is_byzantine): `fxv`
     then carries N_BYZ_INS extra lanes (forge/spur/replay/attacked)
@@ -142,10 +162,11 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
     Returns (outs, sums): the updated block values (caller stores per
     its write mask) and the partial-sum list in registry.REDUCE_LANES
     prefix order. All casts happen HERE in the original op order —
-    small ints to int32 first, so i1 masks keep combinable tilings."""
-    (up_raw, status_raw, inc_raw, informed_raw, s_start_raw,
-     s_dead_raw, s_conf_raw, lh_raw, down_raw, slow_raw) = vals
-    t_end = t + p.probe_interval
+    small ints to int32 first, so i1 masks keep combinable tilings.
+    Widen-on-load / saturate-on-store mirrors round._round_core's
+    tick semantics exactly (same caps, same ceil quantization)."""
+    (status_raw, inc_raw, informed_raw, age_raw, slen_raw, sttl_raw,
+     conf_raw, lh_raw) = vals
     n = p.n
 
     # stale scalars for this round
@@ -163,25 +184,25 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
         scale = jnp.maximum(scale, 1.0)
 
     # load small ints as int32 FIRST: i1 masks inherit the source's
-    # tiling, and int8-derived (32,128) masks cannot combine with
+    # tiling, and int8/int16-derived masks cannot combine with
     # f32/int32-derived (8,128) masks under Mosaic
-    up = up_raw.astype(jnp.int32) != 0
     status = status_raw.astype(jnp.int32)
-    inc = inc_raw
+    inc = inc_raw.astype(jnp.int32)
     informed = informed_raw
-    s_start = s_start_raw
-    s_dead = s_dead_raw
-    s_conf = s_conf_raw.astype(jnp.int32)
+    age = age_raw.astype(jnp.int32)
+    up = age < 0
+    slow = age == SLOW_AGE
+    slen = slen_raw.astype(jnp.int32)
+    sttl = sttl_raw.astype(jnp.int32)
+    s_conf = conf_raw.astype(jnp.int32)
     lh = lh_raw.astype(jnp.int32)
-    if down_raw is not None:
-        down_time = down_raw
-        slow = slow_raw.astype(jnp.int32) != 0
-    else:
-        down_time = None
-        slow = jnp.zeros(up.shape, jnp.bool_)
     shape = up.shape
     new_rumor = jnp.zeros(shape, jnp.bool_)
     crash = leave = rejoin = jnp.zeros(shape, jnp.bool_)
+
+    # dead nodes age one tick per round (saturating — round._round_core
+    # twin; the latency stamp at declare is (age + 1) ticks)
+    age = jnp.where(age >= 0, jnp.minimum(age + 1, TICK_MAX), age)
 
     # per-round fault-injection inputs (computed by fault_frame in the
     # scan body — the kernel only consumes per-node data)
@@ -207,16 +228,17 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
         leave = up & (u_c >= fail_p) & (u_c < fail_p + lv_p)
         rejoin = (~up) & (u_c < rej_p)
         up = (up & ~(crash | leave)) | rejoin
-        t_v = jnp.zeros(shape, jnp.float32) + t
-        down_time = jnp.where(crash | leave, t_v, down_time)
-        down_time = jnp.where(rejoin, INF, down_time)
+        age = jnp.where(crash | leave, 0, age)
+        # rejoin = fresh process: full-speed liveness (round._round_core)
+        age = jnp.where(rejoin, ALIVE_AGE, age)
+        slow = slow & up
         status = jnp.where(leave, LEFT, status)
         status = jnp.where(rejoin, ALIVE, status)
-        inc = jnp.where(rejoin, inc + 1, inc)
+        inc = jnp.where(rejoin, jnp.minimum(inc + 1, TICK_MAX), inc)
         lh = jnp.where(rejoin, 0, lh)
         started = leave | rejoin
         informed = jnp.where(started, 1.0 / n, informed)
-        s_dead = jnp.where(started, INF, s_dead)
+        sttl = jnp.where(started, TTL_NEVER, sttl)
         new_rumor |= started
 
     # ------------------------------------------------ degraded-node churn
@@ -283,21 +305,27 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
         term = term * lam / k
         c = c + term
 
+    # carried suspicion timers advance one tick (round._round_core)
+    sttl = jnp.where(status == SUSPECT, sttl - 1, sttl)
+
     starts = (n_fail > 0) & (status == ALIVE)
     confirms = (n_fail > 0) & (status == SUSPECT)
     c0 = jnp.maximum(n_fail - 1, 0)
     timeout0 = scale * p.suspicion_max_s * _shrink(c0, p)
+    len0 = jnp.minimum(jnp.ceil(timeout0 / p.probe_interval),
+                       float(TICK_MAX)).astype(jnp.int32)
     status = jnp.where(starts, SUSPECT, status)
-    s_start = jnp.where(starts, t_end, s_start)
-    s_dead = jnp.where(starts, t_end + timeout0, s_dead)
+    slen = jnp.where(starts, len0, slen)
+    sttl = jnp.where(starts, len0, sttl)
     s_conf = jnp.where(starts, c0, s_conf)
     informed = jnp.where(starts, 1.0 / n, informed)
     new_rumor |= starts
 
-    c_new = s_conf + n_fail
+    c_new = jnp.minimum(s_conf + n_fail, CONF_MAX)
     ratio = _shrink(c_new, p) / _shrink(s_conf, p)
-    s_dead = jnp.where(confirms, s_start + (s_dead - s_start) * ratio,
-                       s_dead)
+    len2 = jnp.ceil(slen.astype(jnp.float32) * ratio).astype(jnp.int32)
+    sttl = jnp.where(confirms, sttl - (slen - len2), sttl)
+    slen = jnp.where(confirms, len2, slen)
     s_conf = jnp.where(confirms, c_new, s_conf)
 
     # refutation race
@@ -319,9 +347,10 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
     refute = wrongly & (u_h < p_hear)
     status = jnp.where(refute, ALIVE, status)
-    inc = jnp.where(refute, inc + 1, inc)
+    inc = jnp.where(refute, jnp.minimum(inc + 1, TICK_MAX), inc)
     informed = jnp.where(refute, 1.0 / n, informed)
-    s_dead = jnp.where(refute, INF, s_dead)
+    sttl = jnp.where(refute, TTL_NEVER, sttl)
+    slen = jnp.where(refute, 0, slen)
     s_conf = jnp.where(refute, 0, s_conf)
     new_rumor |= refute
     if p.lifeguard:
@@ -334,16 +363,15 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
         # honest kernels keep their historical PRNG stream)
         u_rep = _u01(shape)
         bump = up & (status == ALIVE) & ~new_rumor & (u_rep < replay_v)
-        inc = jnp.where(bump, inc + 1, inc)
+        inc = jnp.where(bump, jnp.minimum(inc + 1, TICK_MAX), inc)
         informed = jnp.where(bump, 1.0 / n, informed)
         new_rumor |= bump
 
-    # declaration
-    t_end_v = jnp.zeros(shape, jnp.float32) + t_end
-    declare = (status == SUSPECT) & (t_end_v >= s_dead)
+    # declaration: the packed ttl lane crossed zero
+    declare = (status == SUSPECT) & (sttl <= 0)
     status = jnp.where(declare, DEAD, status)
     informed = jnp.where(declare, 1.0 / n, informed)
-    s_dead = jnp.where(declare, INF, s_dead)
+    sttl = jnp.where(declare, TTL_NEVER, sttl)
     new_rumor |= declare
 
     # dissemination
@@ -371,12 +399,15 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
         # reduces (module-level asserts pin the alignment)
         fp = declare & up
         td = declare & ~up
+        # latency from the tick-packed crash stamp: (age + 1) whole
+        # protocol periods at declare (round._round_core twin)
+        lat = (age + 1).astype(jnp.float32) * p.probe_interval
         sums += [
             jnp.sum(starts.astype(jnp.float32)),
             jnp.sum(refute.astype(jnp.float32)),
             jnp.sum(fp.astype(jnp.float32)),
             jnp.sum(td.astype(jnp.float32)),
-            jnp.sum(jnp.where(td, t_end - down_time, 0.0)),
+            jnp.sum(jnp.where(td, lat, 0.0)),
             jnp.sum(crash.astype(jnp.float32)),
             jnp.sum(rejoin.astype(jnp.float32)),
             jnp.sum(leave.astype(jnp.float32)),
@@ -386,8 +417,10 @@ def _block_round(p: SimParams, fault: bool, vals, fxv, scal, t,
                      jnp.sum((fp & attacked).astype(jnp.float32))]
         else:
             sums += [jnp.float32(0.0), jnp.float32(0.0)]
-    outs = (up, status, inc, informed, s_start, s_dead, s_conf, lh,
-            down_time, slow)
+    # narrow-on-store: liveness folds back into the age sentinels; the
+    # caller casts each lane to its ref dtype (packed int16/int8)
+    age_out = jnp.where(up, jnp.where(slow, SLOW_AGE, ALIVE_AGE), age)
+    outs = (status, inc, informed, age_out, slen, sttl, s_conf, lh)
     return outs, sums
 
 
@@ -403,29 +436,25 @@ def _pad_sums(sums, col0: int = 0) -> jnp.ndarray:
     return padded
 
 
-def _round_kernel(scal_ref, seed_ref, t_ref,  # scalar-prefetch operands
+def _round_kernel(scal_ref, seed_ref,  # scalar-prefetch operands
                   *refs, p: SimParams, fault: bool = False,
                   byz: bool = False):
     """One block of one protocol period (grid = node blocks)."""
-    n_arrays = 10 if _model_arrays(p, fault) else 8
     mask = _write_mask(p, fault)
     n_out = sum(mask)
     n_fins = (N_FAULT_INS + (N_BYZ_INS if byz else 0)) if fault else 0
-    ins = refs[:n_arrays]
-    fins = refs[n_arrays:n_arrays + n_fins]
-    outs = refs[n_arrays + n_fins:n_arrays + n_fins + n_out]
-    partial_o = refs[n_arrays + n_fins + n_out]
+    ins = refs[:N_ARRAYS]
+    fins = refs[N_ARRAYS:N_ARRAYS + n_fins]
+    outs = refs[N_ARRAYS + n_fins:N_ARRAYS + n_fins + n_out]
+    partial_o = refs[N_ARRAYS + n_fins + n_out]
     blk = pl.program_id(0)
     pltpu.prng_seed(seed_ref[0] + blk)
 
     vals = tuple(r[:] for r in ins)
-    if n_arrays == 8:
-        vals = vals + (None, None)
     fxv = tuple(r[:] for r in fins) if fault else None
     scal = tuple(scal_ref[i] for i in range(N_SCALARS)) \
         + ((scal_ref[N_SCALARS],) if fault else (None,))
-    new_vals, sums = _block_round(p, fault, vals, fxv, scal, t_ref[0],
-                                  byz=byz)
+    new_vals, sums = _block_round(p, fault, vals, fxv, scal, byz=byz)
 
     # write back (only the arrays this config can mutate)
     k = 0
@@ -447,11 +476,9 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
     takes N_FAULT_INS extra per-node input blocks (this round's
     FaultFrame view) after the state arrays — plus N_BYZ_INS byzantine
     lanes when `byz` (the plan carries adversarial primitives)."""
-    n_arrays = 10 if _model_arrays(p, fault) else 8
     mask = _write_mask(p, fault)
     out_idx = [i for i, w in enumerate(mask) if w]
-    rows_per_block = ROWS_FAULT if fault else (
-        ROWS_FULL if n_arrays == 10 else ROWS_STABLE)
+    rows_per_block = _rows_per_block(p, fault)
     block = rows_per_block * LANES
     assert n % block == 0, f"n={n} must be a multiple of {block}"
     grid = n // block
@@ -465,16 +492,16 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
                             lambda i, *_: (i, 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # scalars, seed, t
+        num_scalar_prefetch=2,  # scalars, seed
         grid=(grid,),
-        in_specs=[row_spec() for _ in range(n_arrays + n_fins)],
+        in_specs=[row_spec() for _ in range(N_ARRAYS + n_fins)],
         # outputs only for the arrays this config can mutate
         # (_write_mask) — constant arrays pass through by identity
         out_specs=[row_spec() for _ in out_idx]
         + [pl.BlockSpec((8, 128), lambda i, *_: (i, 0))],
     )
 
-    def one_round(args, scalars, seed, t, fins=()):
+    def one_round(args, scalars, seed, fins=()):
         outs = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -483,7 +510,7 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
                        for i in out_idx]
             + [jax.ShapeDtypeStruct((grid * 8, 128), jnp.float32)],
             interpret=interpret,
-        )(scalars, seed, t, *args, *fins)
+        )(scalars, seed, *args, *fins)
         *state_out, partials = outs
         full = list(args)
         for k, i in enumerate(out_idx):
@@ -493,10 +520,10 @@ def _build_round(p: SimParams, n: int, interpret: bool = False,
         stat_sums = row0[N_SCALARS:N_SCALARS + N_STATS]
         return tuple(full), sums, stat_sums
 
-    return one_round, rows, n_arrays
+    return one_round, rows
 
 
-def _mega_kernel(scal_ref, seeds_ref, t_ref,  # scalar-prefetch operands
+def _mega_kernel(scal_ref, seeds_ref,  # scalar-prefetch operands
                  *refs, p: SimParams, rpc: int):
     """One block of `rpc` consecutive protocol periods.
 
@@ -520,12 +547,11 @@ def _mega_kernel(scal_ref, seeds_ref, t_ref,  # scalar-prefetch operands
     are the working state (round 0 copies in→out first), so no
     input/output aliasing — and no cross-round DMA ordering hazards —
     is ever needed."""
-    n_arrays = 10 if _model_arrays(p) else 8
     mask = _write_mask(p)
     n_out = sum(mask)
-    ins = refs[:n_arrays]
-    outs = refs[n_arrays:n_arrays + n_out]
-    partial_o = refs[n_arrays + n_out]
+    ins = refs[:N_ARRAYS]
+    outs = refs[N_ARRAYS:N_ARRAYS + n_out]
+    partial_o = refs[N_ARRAYS + n_out]
     blk = pl.program_id(0)
     r = pl.program_id(1)
 
@@ -543,7 +569,6 @@ def _mega_kernel(scal_ref, seeds_ref, t_ref,  # scalar-prefetch operands
     # fresh per-(round, block) seed — the SAME stream shape the
     # per-round kernel draws with seed + blk per call
     pltpu.prng_seed(seeds_ref[r] + blk)
-    t = t_ref[0] + r.astype(jnp.float32) * p.probe_interval
 
     # working state: mutated arrays live in the out refs, constant
     # arrays pass through from the in refs
@@ -555,10 +580,8 @@ def _mega_kernel(scal_ref, seeds_ref, t_ref,  # scalar-prefetch operands
             k += 1
         else:
             vals.append(ins[i][:])
-    if n_arrays == 8:
-        vals += [None, None]
     scal = tuple(scal_ref[i] for i in range(N_SCALARS)) + (None,)
-    new_vals, sums = _block_round(p, False, tuple(vals), None, scal, t)
+    new_vals, sums = _block_round(p, False, tuple(vals), None, scal)
 
     k = 0
     for i, w in enumerate(mask):
@@ -581,10 +604,9 @@ def _build_mega(p: SimParams, n: int, rpc: int, interpret: bool = False):
     """The rpc-rounds-per-call pallas_call (see _mega_kernel). Same
     block structure and write mask as _build_round — only the grid
     gains the inner round dimension."""
-    n_arrays = 10 if _model_arrays(p) else 8
     mask = _write_mask(p)
     out_idx = [i for i, w in enumerate(mask) if w]
-    rows_per_block = ROWS_FULL if n_arrays == 10 else ROWS_STABLE
+    rows_per_block = _rows_per_block(p)
     block = rows_per_block * LANES
     assert n % block == 0, f"n={n} must be a multiple of {block}"
     grid_b = n // block
@@ -597,14 +619,14 @@ def _build_mega(p: SimParams, n: int, rpc: int, interpret: bool = False):
                             lambda b, r, *_: (b, 0))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # scalars, seeds[rpc], t
+        num_scalar_prefetch=2,  # scalars, seeds[rpc]
         grid=(grid_b, rpc),
-        in_specs=[row_spec() for _ in range(n_arrays)],
+        in_specs=[row_spec() for _ in range(N_ARRAYS)],
         out_specs=[row_spec() for _ in out_idx]
         + [pl.BlockSpec((8, 128), lambda b, r, *_: (b, 0))],
     )
 
-    def mega_rounds(args, scalars, seeds, t):
+    def mega_rounds(args, scalars, seeds):
         outs = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
@@ -613,7 +635,7 @@ def _build_mega(p: SimParams, n: int, rpc: int, interpret: bool = False):
                        for i in out_idx]
             + [jax.ShapeDtypeStruct((grid_b * 8, 128), jnp.float32)],
             interpret=interpret,
-        )(scalars, seeds, t, *args)
+        )(scalars, seeds, *args)
         *state_out, partials = outs
         full = list(args)
         for k, i in enumerate(out_idx):
@@ -622,7 +644,7 @@ def _build_mega(p: SimParams, n: int, rpc: int, interpret: bool = False):
         return tuple(full), row0[:N_SCALARS], \
             row0[N_SCALARS:N_SCALARS + N_STATS]
 
-    return mega_rounds, rows, n_arrays
+    return mega_rounds, rows
 
 
 def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
@@ -635,7 +657,7 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
     amortized rpc×. ``carry`` exposes/accepts the stale-scalar carry
     (the checkpoint seam, like the per-round runner below); resume
     cuts must land on call boundaries (state.round_idx % rpc == 0)."""
-    mega, rows, n_arrays = _build_mega(p, p.n, rpc, interpret)
+    mega, rows = _build_mega(p, p.n, rpc, interpret)
     steps = rounds // rpc
 
     @functools.partial(jax.jit, donate_argnums=0)
@@ -663,19 +685,17 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
         def to2d(x):
             return x.reshape(rows, LANES)
 
-        args = (to2d(state.up.astype(jnp.int8)), to2d(state.status),
-                to2d(state.incarnation), to2d(state.informed),
-                to2d(state.susp_start), to2d(state.susp_deadline),
+        # kernel array order == SimState per-node field order
+        # (registry.STATE_PACKED_FIELDS); liveness rides down_age
+        args = (to2d(state.status), to2d(state.incarnation),
+                to2d(state.informed), to2d(state.down_age),
+                to2d(state.susp_len), to2d(state.susp_ttl),
                 to2d(state.susp_conf), to2d(state.local_health))
-        if n_arrays == 10:
-            args = args + (to2d(state.down_time),
-                           to2d(state.slow.astype(jnp.int8)))
 
         def body(carry, x):
             args, scalars, t, acc, rec = carry
             seed_row, r0 = x
-            args2, partials, stat_sums = mega(args, scalars, seed_row,
-                                              t[None])
+            args2, partials, stat_sums = mega(args, scalars, seed_row)
             partials = partials.at[1].max(1.0).at[2].max(1e-9) \
                 .at[7].max(1e-9)
             # per-call sums stay < 2^24 (exact in f32); the carry
@@ -697,10 +717,11 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
                     else:
                         buf_c, (pi, plat) = c
                     delta = _stats_delta(acc_i - pi, acc_lat - plat)
+                    up2 = args2[_AGE_IDX].astype(jnp.int32) < 0
                     row = flight.flight_row(
-                        up=args2[0], status=args2[1],
-                        informed=args2[3], local_health=args2[7],
-                        incarnation=args2[2], t=t2,
+                        up=up2, status=args2[0],
+                        informed=args2[2], local_health=args2[7],
+                        incarnation=args2[1], t=t2,
                         stats_delta=delta, phase=jnp.int32(-1))
                     buf2 = flight.record_row(
                         buf_c, row, r_last - state.round_idx,
@@ -709,8 +730,8 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
                         return (buf2, (acc_i, acc_lat))
                     bbc = blackbox_mod.record(
                         bbc, round_idx=r_last, phase=jnp.int32(-1),
-                        status=args2[1], incarnation=args2[2],
-                        susp_conf=args2[6], up=args2[0])
+                        status=args2[0], incarnation=args2[1],
+                        susp_conf=args2[6], up=up2)
                     return (buf2, (acc_i, acc_lat), bbc)
 
                 rec = flight.maybe_record(
@@ -733,25 +754,17 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
         acc_i, acc_lat = acc
         trace = rec[0] if flight_every is not None else None
         bb_out = rec[2] if with_bb else None
-        (up, status, inc, informed, s_start, s_dead, s_conf,
-         lh) = args[:8]
-        if n_arrays == 10:
-            down, slow = args[8], args[9]
-            down_flat, slow_flat = (down.reshape(-1),
-                                    slow.reshape(-1) != 0)
-        else:
-            down_flat, slow_flat = state.down_time, state.slow
+        (status, inc, informed, age, slen, sttl, s_conf,
+         lh) = args
         st = (_stats_add(state.stats, acc_i, acc_lat)
               if p.collect_stats else state.stats)
         out = SimState(
-            up=up.reshape(-1) != 0, down_time=down_flat,
             status=status.reshape(-1), incarnation=inc.reshape(-1),
             informed=informed.reshape(-1),
-            susp_start=s_start.reshape(-1),
-            susp_deadline=s_dead.reshape(-1),
+            down_age=age.reshape(-1),
+            susp_len=slen.reshape(-1), susp_ttl=sttl.reshape(-1),
             susp_conf=s_conf.reshape(-1),
-            local_health=lh.reshape(-1),
-            slow=slow_flat, t=t_final,
+            local_health=lh.reshape(-1), t=t_final,
             round_idx=state.round_idx + rounds, stats=st)
         res = (out,)
         if flight_every is not None:
@@ -762,27 +775,7 @@ def _make_run_mega(p: SimParams, rounds: int, rpc: int, interpret: bool,
             res = res + (scalars,)
         return res[0] if len(res) == 1 else res
 
-    if n_arrays == 10:
-        return _run
-
-    seen_ok: list = [None]
-
-    def run(state: SimState, key: jax.Array, tracked=None,
-            scalars0=None, bb0=None):
-        # same residual-slow-node refusal as the per-round 8-array
-        # runner (see make_run_rounds_pallas below)
-        if state.slow is not seen_ok[0]:
-            if bool(state.slow.any()):
-                raise ValueError(
-                    "state has slow nodes but params disable the "
-                    "slow-node model; use a SimParams with "
-                    "slow_per_round>0 (10-array kernel) or the XLA "
-                    "run_rounds for this state")
-        out = _run(state, key, tracked, scalars0, bb0)
-        seen_ok[0] = (out[0] if isinstance(out, tuple) else out).slow
-        return out
-
-    return run
+    return _run
 
 
 def make_run_rounds_pallas(p: SimParams, rounds: int,
@@ -926,8 +919,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
     # Same-shape plan swaps per call must keep the same byzantine-ness
     # (the fins signature is compiled in).
     byz = fault and plan.attacked is not None
-    one_round, rows, n_arrays = _build_round(p, p.n, interpret, fault,
-                                             byz)
+    one_round, rows = _build_round(p, p.n, interpret, fault, byz)
 
     # the 1M-row state is DONATED: the packed buffers update in place
     # (peak HBM ~1x state_bytes, not 2x) and the passed-in SimState is
@@ -965,13 +957,12 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         def to2d(x):
             return x.reshape(rows, LANES)
 
-        args = (to2d(state.up.astype(jnp.int8)), to2d(state.status),
-                to2d(state.incarnation), to2d(state.informed),
-                to2d(state.susp_start), to2d(state.susp_deadline),
+        # kernel array order == SimState per-node field order
+        # (registry.STATE_PACKED_FIELDS); liveness rides down_age
+        args = (to2d(state.status), to2d(state.incarnation),
+                to2d(state.informed), to2d(state.down_age),
+                to2d(state.susp_len), to2d(state.susp_ttl),
                 to2d(state.susp_conf), to2d(state.local_health))
-        if n_arrays == 10:
-            args = args + (to2d(state.down_time),
-                           to2d(state.slow.astype(jnp.int8)))
 
         def body(carry, x):
             args, scalars, t, acc, rec, coo_c = carry
@@ -997,7 +988,7 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
             else:
                 fins, scal_in = (), scalars
             args2, partials, stat_sums = one_round(
-                args, scal_in, seed[None], t[None], fins)
+                args, scal_in, seed[None], fins)
             partials = partials.at[1].max(1.0).at[2].max(1e-9) \
                 .at[7].max(1e-9)
             # per-round block sums are < 2^24 (exact in f32); the
@@ -1019,7 +1010,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                 i_all = jnp.arange(p.n, dtype=jnp.int32)
                 pair_j = topo_mod.sample_pairs(p.n, k_pair)
                 rtt_obs = topo_mod.sample_rtt(topo, i_all, pair_j, k_jit)
-                up_flat = args2[0].reshape(-1).astype(jnp.int32) != 0
+                up_flat = args2[_AGE_IDX].reshape(-1) \
+                    .astype(jnp.int32) < 0
                 n_live, n_elig = scalars[0], scalars[1]
                 n_up_elig, n_slow = scalars[2], scalars[3]
                 sbar = n_slow / jnp.maximum(n_up_elig, 1e-9)
@@ -1055,10 +1047,11 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     # skip the percentile sorts
                     crow = coords_mod.coord_metrics(coo_c, topo, aux) \
                         if with_coords else None
+                    up2 = args2[_AGE_IDX].astype(jnp.int32) < 0
                     row = flight.flight_row(
-                        up=args2[0], status=args2[1],
-                        informed=args2[3], local_health=args2[7],
-                        incarnation=args2[2], t=t2,
+                        up=up2, status=args2[0],
+                        informed=args2[2], local_health=args2[7],
+                        incarnation=args2[1], t=t2,
                         stats_delta=delta, phase=ph, coord_row=crow)
                     buf2 = flight.record_row(
                         buf_c, row, r - state.round_idx, flight_every)
@@ -1073,8 +1066,8 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
                     # timestamps across chained runs
                     bbc = blackbox_mod.record(
                         bbc, round_idx=r, phase=ph,
-                        status=args2[1], incarnation=args2[2],
-                        susp_conf=args2[6], up=args2[0],
+                        status=args2[0], incarnation=args2[1],
+                        susp_conf=args2[6], up=up2,
                         attacked=fx.attacked if byz else None)
                     return (buf2, (acc_i, acc_lat), bbc)
 
@@ -1104,25 +1097,17 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
         acc_i, acc_lat = acc
         trace = rec[0] if flight_every is not None else None
         bb_out = rec[2] if with_bb else None
-        (up, status, inc, informed, s_start, s_dead, s_conf,
-         lh) = args[:8]
-        if n_arrays == 10:
-            down, slow = args[8], args[9]
-            down_flat, slow_flat = (down.reshape(-1),
-                                    slow.reshape(-1) != 0)
-        else:
-            down_flat, slow_flat = state.down_time, state.slow
+        (status, inc, informed, age, slen, sttl, s_conf,
+         lh) = args
         st = (_stats_add(state.stats, acc_i, acc_lat)
               if p.collect_stats else state.stats)
         out = SimState(
-            up=up.reshape(-1) != 0, down_time=down_flat,
             status=status.reshape(-1), incarnation=inc.reshape(-1),
             informed=informed.reshape(-1),
-            susp_start=s_start.reshape(-1),
-            susp_deadline=s_dead.reshape(-1),
+            down_age=age.reshape(-1),
+            susp_len=slen.reshape(-1), susp_ttl=sttl.reshape(-1),
             susp_conf=s_conf.reshape(-1),
-            local_health=lh.reshape(-1),
-            slow=slow_flat, t=t_final,
+            local_health=lh.reshape(-1), t=t_final,
             round_idx=state.round_idx + rounds, stats=st)
         res = (out, coo_f) if with_coords else (out,)
         if flight_every is not None:
@@ -1145,33 +1130,12 @@ def make_run_rounds_pallas(p: SimParams, rounds: int,
 
         return run_fault
 
-    if n_arrays == 10:
+    if _age_mutable(p):
         return _run
 
-    seen_ok: list = [None]
+    def plain(state, key, coo=None, topo=None, tracked=None,
+              scalars0=None, bb0=None):
+        return _run(state, key, None, coo, topo, tracked, scalars0,
+                    bb0)
 
-    def run(state: SimState, key: jax.Array, coo=None, topo=None,
-            tracked=None, scalars0=None, bb0=None):
-        # the 8-array kernel carries no slow array: running it over a
-        # state with residual slow nodes would silently drop their
-        # degraded dynamics (the XLA paths honor state.slow regardless
-        # of params) — refuse rather than diverge. The check costs a
-        # host round-trip, so it runs once per slow buffer: this path
-        # passes state.slow through BY IDENTITY, making chained calls
-        # (the hot loop) free.
-        if state.slow is not seen_ok[0]:
-            if bool(state.slow.any()):
-                raise ValueError(
-                    "state has slow nodes but params disable the "
-                    "slow-node model; use a SimParams with "
-                    "slow_per_round>0 (10-array kernel) or the XLA "
-                    "run_rounds for this state")
-        out = _run(state, key, None, coo, topo, tracked, scalars0,
-                   bb0)
-        # cache the OUTPUT buffer: jit returns a fresh Array object even
-        # for a passed-through input, so caching state.slow would never
-        # hit on chained calls
-        seen_ok[0] = (out[0] if isinstance(out, tuple) else out).slow
-        return out
-
-    return run
+    return plain

@@ -84,6 +84,52 @@ BLACKBOX_EVENTS = (
 BLACKBOX_PROBE_EVENTS = ("probe_ack", "probe_timeout",
                          "indirect_fanout", "coord_late")
 
+# ------------------------------------------------- bit-packed state
+#
+# PR 12: the per-node SimState lanes store the NARROWEST dtype their
+# semantics need (sim/state.py module docstring has the full design).
+# This table is the HOST/DEVICE layout contract for the packing: the
+# state pytree builds from it, costmodel.STATE_FIELD_BYTES prices it,
+# the checkpoint format embeds the digest it folds into, and the
+# engines' widen-on-load/narrow-on-store sites must agree with it —
+# so it is part of ``layout_digest()`` and a width change forces every
+# consumer (engines, cost model, docs' dtype table) to be revisited
+# together.
+
+#: per-node field -> (packed dtype, bytes), in SimState field order.
+#: ``up``/``slow`` are NOT fields: liveness packs into down_age's
+#: sentinel range (-1 live, -2 live+slow, >= 0 dead-for-that-many-
+#: ticks) and surfaces as SimState properties.
+STATE_PACKED_FIELDS = (
+    ("status", "int8", 1),
+    ("incarnation", "int16", 2),
+    ("informed", "float32", 4),   # continuous — cannot round-trip ticks
+    ("down_age", "int16", 2),
+    ("susp_len", "int16", 2),
+    ("susp_ttl", "int16", 2),
+    ("susp_conf", "int8", 1),
+    ("local_health", "int8", 1),
+)
+
+#: the tick quantum: every per-node time field counts protocol periods
+#: (sim time only ever advances by SimParams.probe_interval per round,
+#: so tick ints round-trip the reachable value range exactly; suspicion
+#: deadlines ceil-quantize — declares only happen at tick boundaries)
+TICK_QUANTUM = "probe_interval"
+
+#: saturation caps for the narrowing stores: int16 tick/count lanes
+#: (incarnation, down_age, susp_len) clamp at TICK_MAX and
+#: state.check_saturation REFUSES a run that hit the cap by field
+#: name; the int8 confirmation counter clamps at CONF_MAX, which is
+#: dynamics-inert (the Lifeguard shrink is floored for any count >=
+#: confirmation_k, far below the cap)
+TICK_MAX = 32767
+CONF_MAX = 127
+
+#: the down_age liveness encoding, spelled out for the digest
+LIVENESS_ENCODING = ("-1=live", "-2=live+slow", ">=0=dead_age_ticks")
+
+
 #: SimStats counter lanes (mirror of state.STATS_FIELDS — re-declared
 #: here so the digest covers the flight counter columns without the
 #: registry importing jax; tests assert the two tuples stay identical).
@@ -341,8 +387,10 @@ MESH_LADDER_ROW = (
 
 #: PROFILE_r*.json record schema version: r01/r02 are the legacy flat
 #: profile envelopes; version 3 adds the roofline table + bandwidth
-#: microbench (costmodel.validate_record accepts both, by version)
-PROFILE_SCHEMA_VERSION = 3
+#: microbench; version 4 (PR 12) prices the bit-packed state and adds
+#: the autotuner's ``lane_blocks`` axis to every roofline row
+#: (costmodel.validate_record accepts all of them, by version)
+PROFILE_SCHEMA_VERSION = 4
 
 #: engine configs the cost model knows how to price, canonical order —
 #: "xla" (live-scalar reference scan), "fast" (stale-scalar hot loop),
@@ -378,23 +426,26 @@ COSTMODEL_BYTE_TERMS = ("state_rw", "uniform_draws", "intermediates",
 #: entry is the VMEM-resident kernel's HBM story (state in/out only —
 #: intermediates never leave the chip), which is exactly why the
 #: megakernel is the 10k-target path.
+#: (re-calibrated 2026-08-03 for PR 12's bit-packed tick state: the
+#: packed round bodies materialize measurably fewer widened
+#: intermediates, so every constant moved DOWN with the packing)
 COSTMODEL_INTERMEDIATE_VECS = (
-    ("xla", 151), ("fast", 96), ("lanes", 124), ("overlap", 124),
+    ("xla", 104), ("fast", 103), ("lanes", 70), ("overlap", 75),
     ("pallas", 3),
 )
 
 #: extra per-round vec count inside a stale_k>1 super-round window,
 #: empirically quadratic in the window length on XLA:CPU (the unrolled
 #: window's fusion pattern): + WINDOW_VECS x (k-1)^2 / k vecs/round
-COSTMODEL_WINDOW_VECS = 50
+COSTMODEL_WINDOW_VECS = 30
 
 #: per-engine FLOP/node/round estimates (same calibration protocol;
 #: window term shares the quadratic shape at FLOP_WINDOW scale)
 COSTMODEL_FLOPS = (
-    ("xla", 2250), ("fast", 1500), ("lanes", 1410), ("overlap", 1410),
-    ("pallas", 1410),
+    ("xla", 1940), ("fast", 1820), ("lanes", 1360), ("overlap", 1460),
+    ("pallas", 1360),
 )
-COSTMODEL_FLOP_WINDOW = 1000
+COSTMODEL_FLOP_WINDOW = 750
 
 #: the model-vs-measured agreement bound: a config whose compiled
 #: byte count disagrees with the analytic model by more than this
@@ -405,7 +456,7 @@ COSTMODEL_BOUND = 2.0
 #: roofline table row schema (bench.py --profile; PROFILE_r03+ records
 #: and README tables decode these keys)
 PROFILE_ROOFLINE_ROW = (
-    "config", "engine", "stale_k", "rounds_per_call",
+    "config", "engine", "stale_k", "rounds_per_call", "lane_blocks",
     "ms_per_round", "rounds_per_sec",
     "bytes_model", "bytes_measured", "model_vs_measured", "flagged",
     "flops_model", "flops_measured", "temp_bytes_measured",
@@ -415,9 +466,26 @@ PROFILE_ROOFLINE_ROW = (
 
 #: recorded-artifact families the perf-regression ledger
 #: (costmodel.load_ledger / bench.py --history) loads and
-#: schema-validates from the repo root — every `<FAMILY>_r<NN>.json`
+#: schema-validates from the repo root — every `<FAMILY>_r<NN>.json`.
+#: TUNE (PR 12) is the megakernel autotuner's record family
+#: (sim/autotune.py): each round persists the swept configs + the
+#: per-(platform, n) winner, so --history reconstructs the tuning
+#: trajectory like every other family.
 LEDGER_FAMILIES = ("BENCH", "MULTICHIP", "SWEEP", "SERVE", "PROFILE",
-                   "BYZ", "CHAOS", "COORDS")
+                   "BYZ", "CHAOS", "COORDS", "TUNE")
+
+#: the autotuner's winner schema: what a TUNE record's ``winner`` and
+#: every AUTOTUNE_CACHE.json entry must carry (validator + cache
+#: loader both decode these keys)
+AUTOTUNE_WINNER_KEYS = ("config", "engine", "stale_k",
+                        "rounds_per_call", "lane_blocks",
+                        "rounds_per_sec")
+
+#: lane-reduction block-table widths the autotuner may sweep; the
+#: DEFAULT (LANE_BLOCKS) is the only width the bitwise shard-
+#: invariance conformance pins cover — overrides are a single-device
+#: throughput knob (lanes.py check_pool enforces divisibility)
+AUTOTUNE_LANE_BLOCKS = (32, 64, 128)
 
 
 def flight_columns() -> tuple[str, ...]:
@@ -432,6 +500,12 @@ def layout_digest() -> str:
     for group in (FLIGHT_GAUGE_COLUMNS, STATS_FIELDS,
                   FLIGHT_COORD_COLUMNS, BLACKBOX_RECORD_FIELDS,
                   BLACKBOX_EVENTS, BLACKBOX_PROBE_EVENTS,
+                  tuple(f"{n}:{d}:{b}"
+                        for n, d, b in STATE_PACKED_FIELDS),
+                  (TICK_QUANTUM, str(TICK_MAX), str(CONF_MAX)),
+                  LIVENESS_ENCODING,
+                  AUTOTUNE_WINNER_KEYS,
+                  tuple(str(b) for b in AUTOTUNE_LANE_BLOCKS),
                   REDUCE_LANES, (str(LANE_BLOCKS),),
                   (STALE_EMISSION_RULE,),
                   tuple(str(k) for k in STALE_KS),

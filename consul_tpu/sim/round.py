@@ -41,8 +41,9 @@ import jax.numpy as jnp
 from consul_tpu.faults import (CompiledFaultPlan, FaultFrame, active_phase,
                                detection_gate, fault_frame, scale_frame)
 from consul_tpu.sim.params import SimParams
-from consul_tpu.sim.state import (ALIVE, DEAD, INF, LEFT, SUSPECT, SimState,
-                                  SimStats)
+from consul_tpu.sim.state import (ALIVE, ALIVE_AGE, CONF_MAX, DEAD, LEFT,
+                                  SLOW_AGE, SUSPECT, TICK_MAX, TTL_NEVER,
+                                  SimState, SimStats)
 
 Reducer = Callable[[jnp.ndarray], jnp.ndarray]
 
@@ -177,17 +178,28 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         def u01(k):
             return jax.random.uniform(k, (L,))
 
-    up = state.up
+    # widen-on-load: the packed int16/int8 lanes compute in int32 — the
+    # SAME int32 values the unpacked (wide-storage) twin carries, which
+    # is what makes packed<->unpacked bitwise (the narrowing stores at
+    # the end cast back to each input array's own dtype)
+    age = state.down_age.astype(jnp.int32)
+    up = age < 0
+    slow = age == SLOW_AGE
     status = state.status
-    inc = state.incarnation
+    inc = state.incarnation.astype(jnp.int32)
     informed = state.informed
-    s_start = state.susp_start
-    s_dead = state.susp_deadline
-    s_conf = state.susp_conf
+    slen = state.susp_len.astype(jnp.int32)
+    sttl = state.susp_ttl.astype(jnp.int32)
+    s_conf = state.susp_conf.astype(jnp.int32)
     lh = state.local_health
-    slow = state.slow
     st = state.stats
     new_rumor = jnp.zeros((L,), jnp.bool_)
+
+    # dead nodes age one tick per round (saturating — the cap is
+    # refused by name via state.check_saturation); the stamp feeds
+    # detection latency: crash round ends at age 0, so latency at
+    # declare is (age + 1) ticks
+    age = jnp.where(age >= 0, jnp.minimum(age + 1, TICK_MAX), age)
 
     # ------------------------------------------------------------------ churn
     # (enabled() not bool(field): churn rates may be traced sweep
@@ -206,18 +218,21 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         leave = up & (u >= fail_p) & (u < fail_p + leave_p)
         rejoin = (~up) & (u < rejoin_p)
         up = (up & ~(crash | leave)) | rejoin
-        down_time = jnp.where(crash | leave, t, state.down_time)
-        down_time = jnp.where(rejoin, INF, down_time)
+        age = jnp.where(crash | leave, 0, age)
+        # rejoin = a fresh process: back to full-speed liveness (the
+        # degraded flag does not survive a restart)
+        age = jnp.where(rejoin, ALIVE_AGE, age)
+        slow = slow & up
         # Graceful leave: intent broadcast starts immediately (serf leave).
         status = jnp.where(leave, jnp.int8(LEFT), status)
         # Rejoin: alive rumor with bumped incarnation beats any dead rumor
         # (max-incarnation resolution, as in memberlist aliveNode()).
         status = jnp.where(rejoin, jnp.int8(ALIVE), status)
-        inc = jnp.where(rejoin, inc + 1, inc)
+        inc = jnp.where(rejoin, jnp.minimum(inc + 1, TICK_MAX), inc)
         lh = jnp.where(rejoin, jnp.int8(0), lh)
         started = leave | rejoin
         informed = jnp.where(started, 1.0 / n, informed)
-        s_dead = jnp.where(started, INF, s_dead)
+        sttl = jnp.where(started, TTL_NEVER, sttl)
         new_rumor |= started
         if lane_sink is not None:
             lane_sink["crashes"] = crash.astype(jnp.float32)
@@ -228,8 +243,6 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
                 crashes=st.crashes + reduce_sum(crash.astype(jnp.int32)),
                 leaves=st.leaves + reduce_sum(leave.astype(jnp.int32)),
                 rejoins=st.rejoins + reduce_sum(rejoin.astype(jnp.int32)))
-    else:
-        down_time = state.down_time
 
     # -------------------------------------------------- degraded-node churn
     if p.enabled("slow_per_round"):
@@ -412,15 +425,26 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         # this branch at all).
         scale = jnp.maximum(scale, 1.0)
 
+    # carried suspicion timers advance one tick per round — the clock
+    # leg of the historical ``t_end >= deadline`` comparison, now an
+    # int decrement on the packed ttl lane
+    sttl = jnp.where(status == SUSPECT, sttl - 1, sttl)
+
     starts = (n_fail > 0) & (status == ALIVE)
     confirms = (n_fail > 0) & (status == SUSPECT)
     # New suspicions: c = n_fail−1 extra confirmers arrived simultaneously.
     c0 = jnp.maximum(n_fail - 1, 0)
     timeout0 = scale * p.suspicion_max_s * _shrink(c0, p)
+    # ceil-quantize the timeout to ticks (registry.TICK_QUANTUM):
+    # declares only ever happen at tick boundaries, so the initial
+    # deadline is EXACTLY the old continuous one's first reachable
+    # declare round; saturate at the int16 cap (refused by name)
+    len0 = jnp.minimum(jnp.ceil(timeout0 / p.probe_interval),
+                       float(TICK_MAX)).astype(jnp.int32)
     status = jnp.where(starts, jnp.int8(SUSPECT), status)
-    s_start = jnp.where(starts, t_end, s_start)
-    s_dead = jnp.where(starts, t_end + timeout0, s_dead)
-    s_conf = jnp.where(starts, c0, s_conf.astype(jnp.int32))
+    slen = jnp.where(starts, len0, slen)
+    sttl = jnp.where(starts, len0, sttl)
+    s_conf = jnp.where(starts, c0, s_conf)
     informed = jnp.where(starts, 1.0 / n, informed)
     new_rumor |= starts
     if lane_sink is not None:
@@ -436,13 +460,18 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
                              + reduce_sum((starts & fx.attacked)
                                           .astype(jnp.int32)))
 
-    # Existing suspicions: independent confirmations shrink the deadline
-    # (ratio update is exact — see module docstring).
-    c_new = s_conf + n_fail
+    # Existing suspicions: independent confirmations shrink the timer.
+    # The ratio rewrites the timer's FULL length (ceil back to ticks)
+    # and moves the ttl by the same delta, preserving the len - ttl ==
+    # elapsed invariant the next shrink needs. The confirmation count
+    # clips at CONF_MAX — dynamics-inert, since _shrink is already
+    # floored for any count >= confirmation_k (far below the cap).
+    c_new = jnp.minimum(s_conf + n_fail, CONF_MAX)
     ratio = _shrink(c_new, p) / _shrink(s_conf, p)
-    s_dead = jnp.where(confirms, s_start + (s_dead - s_start) * ratio, s_dead)
-    s_conf = jnp.where(confirms, c_new,
-                       s_conf.astype(jnp.int32)).astype(jnp.int16)
+    len2 = jnp.ceil(slen.astype(jnp.float32) * ratio).astype(jnp.int32)
+    sttl = jnp.where(confirms, sttl - (slen - len2), sttl)
+    slen = jnp.where(confirms, len2, slen)
+    s_conf = jnp.where(confirms, c_new, s_conf)
 
     # ------------------------------------------------- refutation (the race)
     # A live node refutes a suspect/dead rumor about itself once the rumor
@@ -468,10 +497,11 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     wrongly = up & ((status == SUSPECT) | (status == DEAD)) & ~new_rumor
     refute = wrongly & (u01(k_hear) < p_hear)
     status = jnp.where(refute, jnp.int8(ALIVE), status)
-    inc = jnp.where(refute, inc + 1, inc)
+    inc = jnp.where(refute, jnp.minimum(inc + 1, TICK_MAX), inc)
     informed = jnp.where(refute, 1.0 / n, informed)
-    s_dead = jnp.where(refute, INF, s_dead)
-    s_conf = jnp.where(refute, 0, s_conf).astype(jnp.int16)
+    sttl = jnp.where(refute, TTL_NEVER, sttl)
+    slen = jnp.where(refute, 0, slen)
+    s_conf = jnp.where(refute, 0, s_conf)
     new_rumor |= refute
     if p.lifeguard:
         lh = jnp.clip(lh.astype(jnp.int32) + refute.astype(jnp.int32), 0,
@@ -493,22 +523,26 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
         # reproduces the honest dynamics bit for bit.
         u_rep = u01(jax.random.fold_in(key, 0xB12A))
         bump = up & (status == ALIVE) & ~new_rumor & (u_rep < fx.replay)
-        inc = jnp.where(bump, inc + 1, inc)
+        inc = jnp.where(bump, jnp.minimum(inc + 1, TICK_MAX), inc)
         informed = jnp.where(bump, 1.0 / n, informed)
         new_rumor |= bump
 
     # ------------------------------------------------------ dead declaration
-    declare = (status == SUSPECT) & (t_end >= s_dead)
+    declare = (status == SUSPECT) & (sttl <= 0)
     status = jnp.where(declare, jnp.int8(DEAD), status)
     informed = jnp.where(declare, 1.0 / n, informed)
-    s_dead = jnp.where(declare, INF, s_dead)
+    sttl = jnp.where(declare, TTL_NEVER, sttl)
     new_rumor |= declare
+    # detection latency in seconds from the tick-packed crash stamp:
+    # a node crashing in round r ends that round at age 0, so a
+    # declare at age a means (a + 1) whole protocol periods elapsed —
+    # exactly the old t_end - down_time difference, tick-exact
+    lat = (age + 1).astype(jnp.float32) * p.probe_interval
     if lane_sink is not None:
         fp, tp = declare & up, declare & ~up
         lane_sink["false_positives"] = fp.astype(jnp.float32)
         lane_sink["true_deaths_declared"] = tp.astype(jnp.float32)
-        lane_sink["detect_latency_sum"] = jnp.where(
-            tp, t_end - down_time, 0.0)
+        lane_sink["detect_latency_sum"] = jnp.where(tp, lat, 0.0)
         if byz:
             lane_sink["attack_false_positives"] = \
                 (fp & fx.attacked).astype(jnp.float32)
@@ -520,7 +554,7 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
             true_deaths_declared=st.true_deaths_declared
             + reduce_sum(tp.astype(jnp.int32)),
             detect_latency_sum=st.detect_latency_sum
-            + reduce_sum(jnp.where(tp, t_end - down_time, 0.0)))
+            + reduce_sum(jnp.where(tp, lat, 0.0)))
         if byz:
             st = st._replace(
                 attack_false_positives=st.attack_false_positives
@@ -542,10 +576,20 @@ def _round_core(state: SimState, scalars, key: jax.Array, p: SimParams,
     informed = jnp.where(
         grow, informed + (1.0 - informed) * (1.0 - jnp.exp(-lam_g)), informed)
 
+    # narrow-on-store: fold liveness back into the age sentinels and
+    # cast every widened lane to ITS input array's dtype — int16/int8
+    # for the packed layout, int32 for the unpacked conformance twin
+    # (same values either way: every cap was applied above)
+    age_out = jnp.where(up, jnp.where(slow, SLOW_AGE, ALIVE_AGE), age)
     out = SimState(
-        up=up, down_time=down_time, status=status, incarnation=inc,
-        informed=informed, susp_start=s_start,
-        susp_deadline=s_dead, susp_conf=s_conf, local_health=lh, slow=slow,
+        status=status,
+        incarnation=inc.astype(state.incarnation.dtype),
+        informed=informed,
+        down_age=age_out.astype(state.down_age.dtype),
+        susp_len=slen.astype(state.susp_len.dtype),
+        susp_ttl=sttl.astype(state.susp_ttl.dtype),
+        susp_conf=s_conf.astype(state.susp_conf.dtype),
+        local_health=lh,
         t=t_end, round_idx=state.round_idx + 1, stats=st)
     ev = None
     if events:
@@ -1035,7 +1079,8 @@ def make_run_rounds_lanes(p: SimParams, rounds: int,
                           plan: Optional[CompiledFaultPlan] = None,
                           overlap: bool = False,
                           unroll: bool = False,
-                          carry: bool = False):
+                          carry: bool = False,
+                          lane_blocks: Optional[int] = None):
     """Single-device fused-lane runner: state, key -> state (or
     (state, trace) with `flight_every`). The exact engine the sharded
     mesh wraps — same scan, same shard-invariant PRNG, same block-table
@@ -1059,7 +1104,22 @@ def make_run_rounds_lanes(p: SimParams, rounds: int,
     (tests/test_checkpoint.py)."""
     from consul_tpu.sim import lanes as lanes_mod
 
-    lanes_mod.check_pool(p.n)
+    if lane_blocks is not None and lane_blocks != lanes_mod.LANE_BLOCKS:
+        # the autotuner's block-shape axis (registry.AUTOTUNE_LANE_
+        # BLOCKS): a non-default table is a single-device throughput
+        # knob — the overlap schedule's seed/carry tables are keyed to
+        # the pinned width, so refuse the combination rather than
+        # silently mis-fold
+        if overlap:
+            raise ValueError(
+                "lane_blocks overrides are single-device synchronous "
+                "only (seed_table/carry_table are keyed to the pinned "
+                f"LANE_BLOCKS={lanes_mod.LANE_BLOCKS}); run overlap "
+                "at the default width")
+        reducer = lanes_mod._SingleDeviceReducer(lane_blocks)
+    else:
+        reducer = lanes_mod.reduce_lanes_single
+    lanes_mod.check_pool(p.n, reducer.blocks)
     lanes_mod.check_schedule(p, rounds, flight_every, overlap)
     with_plan = plan is not None
 
@@ -1067,7 +1127,7 @@ def make_run_rounds_lanes(p: SimParams, rounds: int,
     def _run(state: SimState, key: jax.Array, cp, lanes0, table0):
         keys = round_keys(key, state.round_idx, rounds)
         return _lane_scan(state, keys, cp, p, rounds, flight_every,
-                          with_plan, lanes_mod.reduce_lanes_single, 0,
+                          with_plan, reducer, 0,
                           overlap=overlap, unroll=unroll,
                           lanes0=lanes0, table0=table0,
                           return_carry=carry)

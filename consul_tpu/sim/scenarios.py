@@ -33,7 +33,8 @@ from consul_tpu.sim.metrics import fd_report, phase_reports, trace_report
 from consul_tpu.sim.params import SimParams, baseline_configs
 from consul_tpu.sim.round import (run_rounds, run_rounds_flight,
                                   run_rounds_stats)
-from consul_tpu.sim.state import ALIVE, DEAD, INF, SUSPECT, init_state
+from consul_tpu.sim.state import (ALIVE, DEAD, SUSPECT, check_saturation,
+                                  init_state)
 
 
 @dataclass
@@ -297,6 +298,10 @@ def run_chaos(name: str, n: int = 4096, seed: int = 0,
                                 p, plan.total_rounds, plan=cp,
                                 tracked=tracked)
         (state, trace), bb = out[:2], (out[2] if blackbox else None)
+    # refuse-by-name on the packed saturation caps: a ChurnBurst that
+    # wrapped an int16 incarnation must fail HERE, not publish a
+    # silently-corrupt report (state.SaturationError names the field)
+    check_saturation(state)
     tr = stats_from_trace(trace)
     return {
         "scenario": name, "n": n, "rounds": plan.total_rounds,

@@ -1,9 +1,51 @@
-"""Per-node simulation state tensors.
+"""Per-node simulation state tensors — bit-packed tick layout.
 
-One row per virtual agent; the whole cluster is a struct-of-arrays pytree.
-At 1M nodes this is ~30 bytes/node ≈ 30MB — single-chip HBM is not the
-constraint; the sharding axis (sim/mesh.py) exists for bandwidth and
-multi-DC topology, mirroring SURVEY.md §5's long-context analysis.
+One row per virtual agent; the whole cluster is a struct-of-arrays
+pytree. PR 12 packs the hot lanes: the round is bandwidth-bound
+(PROFILE_r03: lanes 47% -> overlap-k4 58% of achievable STREAM
+bandwidth) and ``state_rw = 2 x STATE_FIELD_BYTES`` was the largest
+priced byte term, so every per-node field now stores the NARROWEST
+dtype its semantics need — 15 B/node, down from the f32/int32-heavy
+26 B/node — and the engines widen on load / narrow on store.
+
+The packing levers (registry.STATE_PACKED_FIELDS, pinned in the layout
+digest):
+
+* **Tick counts, not f32 times.** Sim time only ever advances by one
+  protocol period per round (the tick quantum, registry.TICK_QUANTUM
+  = ``probe_interval``), so the three per-node time fields became
+  small RELATIVE tick ints whose reachable range is bounded by the
+  protocol, not the run length: ``down_age`` (rounds since crash),
+  ``susp_len`` (the suspicion timer's current full length in ticks,
+  ceil-quantized — declares only happen at tick boundaries, so the
+  initial-deadline quantization is exact) and ``susp_ttl`` (ticks
+  until declare-dead; the Lifeguard shrink update rewrites len/ttl
+  together, preserving ``len - ttl == elapsed``).
+* **Derived liveness.** ``up`` was always equivalent to "no crash
+  stamp", and ``slow`` only ever applies to live nodes, so both bool
+  arrays fold into ``down_age``'s sentinel range: -1 live, -2 live
+  and degraded, >= 0 dead for that many ticks. They remain available
+  as PROPERTIES (free inside a fused round; recomputed on host reads)
+  so every consumer keeps reading ``state.up`` / ``state.slow``.
+* **Saturating narrow stores that REFUSE by name.** int16 incarnation
+  under a ChurnBurst must not wrap silently: every narrowing site
+  saturates at ``registry.TICK_MAX`` (incarnation, down_age,
+  susp_len) / ``registry.CONF_MAX`` (susp_conf), saturation is
+  detectable in the final state, and ``check_saturation`` raises
+  ``SaturationError`` naming the field — wired into
+  ``checkpoint.snapshot`` and the chaos suite, pinned by a chaos test.
+* **fields that cannot round-trip exactly stay wide**: ``informed`` is
+  a genuinely continuous epidemic fraction — f32.
+
+Packed <-> unpacked is BITWISE: ``init_state(n, packed=False)`` builds
+the same state with int32 storage, the round cores are
+dtype-polymorphic (widen to int32, compute, ``astype`` back to the
+input's dtype, with the SAME semantic clips in both modes), so
+``pack(run(unpacked))`` equals ``run(packed)`` bit for bit — pinned in
+tier-1 for every engine (tests/test_state_packing.py).
+
+At 1M nodes the pytree is ~15 MB; single-chip HBM is not the
+constraint — bandwidth is, which is exactly why the bytes matter.
 """
 
 from __future__ import annotations
@@ -13,13 +55,29 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from consul_tpu.sim import registry
+
 # Rumor/member status encodings — match consul_tpu.types.MemberStatus.
 ALIVE = 1
 SUSPECT = 2
 DEAD = 3
 LEFT = 5
 
+#: legacy float "never" sentinel (pre-packing deadlines); kept for the
+#: host-side views engine and old tests
 INF = jnp.float32(3.4e38)
+
+#: down_age sentinels: the liveness/slow bools live in the age lane's
+#: negative range (slow implies up in every engine — the update rules
+#: AND slow with liveness, so the encoding loses nothing)
+ALIVE_AGE = -1   # live, full-speed
+SLOW_AGE = -2    # live, degraded (slow message processing)
+
+#: saturation caps for the narrowing stores (registry re-exports are
+#: the digest-pinned source)
+TICK_MAX = registry.TICK_MAX    # int16 tick/count lanes (inc, ages, len)
+TTL_NEVER = registry.TICK_MAX   # susp_ttl value when no timer is armed
+CONF_MAX = registry.CONF_MAX    # int8 confirmation counter
 
 
 class SimStats(NamedTuple):
@@ -72,53 +130,171 @@ def stats_vector(st: SimStats) -> jnp.ndarray:
 
 
 class SimState(NamedTuple):
-    """Struct-of-arrays cluster state; all [N] unless noted."""
+    """Struct-of-arrays cluster state; all [N] unless noted.
 
-    # Ground truth
-    up: jnp.ndarray           # bool — process liveness
-    down_time: jnp.ndarray    # f32  — sim time of crash (INF while up)
+    Per-node dtypes are the PACKED widths of
+    ``registry.STATE_PACKED_FIELDS`` by default; ``init_state(...,
+    packed=False)`` builds the bitwise-equivalent wide (int32) storage
+    — the engines widen on load and ``astype`` back to each array's
+    own dtype on store, so the two layouts run the same program.
+    """
 
     # Cluster-wide rumor about each node
     status: jnp.ndarray       # int8 — ALIVE/SUSPECT/DEAD/LEFT
-    incarnation: jnp.ndarray  # int32 — incarnation the rumor carries
+    incarnation: jnp.ndarray  # int16 — incarnation the rumor carries
+    #                           (saturates at TICK_MAX; check_saturation
+    #                           refuses a run that hit the cap)
     informed: jnp.ndarray     # f32 — fraction of cluster that has the rumor
 
-    # Lifeguard suspicion timer (valid while status == SUSPECT)
-    susp_start: jnp.ndarray    # f32 — sim time suspicion began
-    susp_deadline: jnp.ndarray # f32 — current declare-dead deadline
-    susp_conf: jnp.ndarray     # int16 — independent confirmations
+    # Ground truth, tick-packed: -1 live, -2 live+slow, >= 0 dead for
+    # that many protocol periods (the crash stamp, as an age)
+    down_age: jnp.ndarray     # int16
+
+    # Lifeguard suspicion timer (valid while status == SUSPECT), in
+    # protocol-period ticks: len is the timer's current full length
+    # (ceil-quantized), ttl the remaining ticks until declare-dead.
+    # Invariant while a timer runs: len - ttl == ticks elapsed since
+    # the suspicion started (the shrink update preserves it).
+    susp_len: jnp.ndarray     # int16
+    susp_ttl: jnp.ndarray     # int16 — TTL_NEVER when no timer is armed
+    susp_conf: jnp.ndarray    # int8 — independent confirmations
+    #                           (clipped at CONF_MAX; dynamics-inert
+    #                           beyond confirmation_k — shrink is
+    #                           already floored there)
 
     # Lifeguard local-health awareness score (0..awareness_max)
     local_health: jnp.ndarray  # int8
-
-    # Degraded-node model: slow nodes delay acks/processing (GC pause,
-    # overload) — the failure mode Lifeguard exists for (its paper's "slow
-    # message processing"; memberlist awareness.go).
-    slow: jnp.ndarray         # bool
 
     # Scalars
     t: jnp.ndarray            # f32 — sim time, seconds
     round_idx: jnp.ndarray    # int32
     stats: SimStats
 
+    # ---- derived liveness (packed into down_age's sentinel range) ----
 
-def init_state(n: int, dtype_small: jnp.dtype = jnp.int8) -> SimState:
-    """Everyone alive, fully converged, health perfect."""
+    @property
+    def up(self) -> jnp.ndarray:
+        """[N] bool — process liveness (down_age < 0)."""
+        return self.down_age < 0
+
+    @property
+    def slow(self) -> jnp.ndarray:
+        """[N] bool — live-and-degraded (down_age == SLOW_AGE)."""
+        return self.down_age == SLOW_AGE
+
+
+#: per-node field -> packed dtype, mirrored from the digest-pinned
+#: registry table (tests assert init_state agrees)
+_PACKED = {name: dtype for name, dtype, _ in registry.STATE_PACKED_FIELDS}
+
+#: fields whose UNPACKED twin widens to int32 (the conformance
+#: reference layout); int8 status/local_health and f32 informed are
+#: the same in both — their widths are semantic, not packing
+_WIDENED = ("incarnation", "down_age", "susp_len", "susp_ttl",
+            "susp_conf")
+
+
+def _dtype(field: str, packed: bool):
+    if packed or field not in _WIDENED:
+        return jnp.dtype(_PACKED[field])
+    return jnp.int32
+
+
+def init_state(n: int, packed: bool = True) -> SimState:
+    """Everyone alive, fully converged, health perfect.
+
+    ``packed=False`` builds the wide (int32) storage twin — same
+    values, same dynamics bit for bit (the packed<->unpacked
+    conformance reference)."""
     return SimState(
-        up=jnp.ones((n,), jnp.bool_),
-        down_time=jnp.full((n,), INF, jnp.float32),
-        status=jnp.full((n,), ALIVE, dtype_small),
-        incarnation=jnp.zeros((n,), jnp.int32),
+        status=jnp.full((n,), ALIVE, _dtype("status", packed)),
+        incarnation=jnp.zeros((n,), _dtype("incarnation", packed)),
         informed=jnp.ones((n,), jnp.float32),
-        susp_start=jnp.zeros((n,), jnp.float32),
-        susp_deadline=jnp.full((n,), INF, jnp.float32),
-        susp_conf=jnp.zeros((n,), jnp.int16),
-        local_health=jnp.zeros((n,), dtype_small),
-        slow=jnp.zeros((n,), jnp.bool_),
+        down_age=jnp.full((n,), ALIVE_AGE, _dtype("down_age", packed)),
+        susp_len=jnp.zeros((n,), _dtype("susp_len", packed)),
+        susp_ttl=jnp.full((n,), TTL_NEVER, _dtype("susp_ttl", packed)),
+        susp_conf=jnp.zeros((n,), _dtype("susp_conf", packed)),
+        local_health=jnp.zeros((n,), _dtype("local_health", packed)),
         t=jnp.zeros((), jnp.float32),
         round_idx=jnp.zeros((), jnp.int32),
         stats=SimStats.zeros(),
     )
+
+
+def pack(state: SimState) -> SimState:
+    """Narrow a wide-storage state to the packed dtypes (exact for
+    every reachable value — the engines clip at the packed caps in
+    BOTH layouts, so conformance tests compare pack(wide) bitwise)."""
+    return state._replace(**{
+        f: getattr(state, f).astype(jnp.dtype(_PACKED[f]))
+        for f in _WIDENED})
+
+
+def unpack(state: SimState) -> SimState:
+    """Widen a packed state to int32 storage (the conformance twin)."""
+    return state._replace(**{
+        f: getattr(state, f).astype(jnp.int32) for f in _WIDENED})
+
+
+def with_crashed(state: SimState, idx, age: int = 0) -> SimState:
+    """Scenario/test helper: mark node(s) `idx` crashed ``age`` ticks
+    ago — the packed equivalent of the historical ``up=False`` +
+    ``down_time`` stamp (one write instead of two)."""
+    return state._replace(
+        down_age=state.down_age.at[idx].set(
+            jnp.asarray(age, state.down_age.dtype)))
+
+
+def with_slow(state: SimState, idx) -> SimState:
+    """Scenario/test helper: mark LIVE node(s) `idx` degraded (slow) —
+    the packed equivalent of the historical ``slow=True`` write."""
+    return state._replace(
+        down_age=state.down_age.at[idx].set(
+            jnp.asarray(SLOW_AGE, state.down_age.dtype)))
+
+
+class SaturationError(ValueError):
+    """A narrowing store hit its saturation cap mid-run: the packed
+    value range was exceeded and the clamped field no longer carries
+    the true value (an int16 incarnation wrap under a ChurnBurst would
+    otherwise be silent corruption). Names the field(s)."""
+
+
+#: the saturating narrow stores and their caps — the ONE table every
+#: refusal site reads (check_saturation here, checkpoint.snapshot's
+#: already-on-host twin), so adding or widening a saturating lane is
+#: a single edit
+SATURATING_FIELDS = (("incarnation", TICK_MAX),
+                     ("down_age", TICK_MAX),
+                     ("susp_len", TICK_MAX))
+
+
+def saturated_fields(get_max) -> list:
+    """Names of saturated lanes; ``get_max(field)`` returns the
+    lane's max as a host int (injectable so checkpoint.snapshot can
+    read its already-fetched numpy arrays without a second device
+    round-trip)."""
+    return [f for f, cap in SATURATING_FIELDS if get_max(f) >= cap]
+
+
+def check_saturation(state: SimState) -> None:
+    """Refuse-by-name guard over the saturating narrow stores.
+
+    Host-side (one tiny device fetch per checked field). Incarnation
+    saturation is STICKY (the counter never decreases), so any run
+    that ever hit the cap fails here; age/len saturation is detected
+    conservatively from the final state. Wired into
+    ``checkpoint.snapshot`` and ``scenarios.run_chaos``; callers that
+    hand-manage states call it directly."""
+    saturated = saturated_fields(
+        lambda f: int(jax.device_get(jnp.max(getattr(state, f)))))
+    if saturated:
+        raise SaturationError(
+            f"packed state saturated: {', '.join(saturated)} hit the "
+            f"int16 cap ({TICK_MAX}) — the narrowed lane no longer "
+            "carries the true value. Shorten the run, checkpoint and "
+            "reset incarnations, or use init_state(packed=False) "
+            "(wide int32 storage) for this workload.")
 
 
 def state_bytes(s: SimState) -> int:

@@ -181,16 +181,14 @@ def _make_pallas_sweep(p: SimParams, rounds: int,
 
     # shape gate ("where shapes allow"): the pool must divide the
     # kernel's block structure. NOTE the block size is NOT purely
-    # static — _model_arrays reads the churn/slow rates, which are
+    # static — _rows_per_block reads the churn/slow rates, which are
     # sweepable, so a grid point that zeroes them switches the kernel
-    # from the 10-array to the wider 8-array block. This early gate
-    # catches the base config; the per-point loop below re-checks each
-    # CONCRETE point before running anything, so a mixed grid fails as
-    # one loud ValueError, not an assert mid-sweep.
+    # between the mutable-age and the wider stable block. This early
+    # gate catches the base config; the per-point loop below re-checks
+    # each CONCRETE point before running anything, so a mixed grid
+    # fails as one loud ValueError, not an assert mid-sweep.
     def _check_block(pp: SimParams, where: str) -> None:
-        block = (pallas_round.ROWS_FULL
-                 if pallas_round._model_arrays(pp)
-                 else pallas_round.ROWS_STABLE) * pallas_round.LANES
+        block = pallas_round._rows_per_block(pp) * pallas_round.LANES
         if pp.n % block:
             raise ValueError(
                 f"the megakernel engine needs n divisible by its "

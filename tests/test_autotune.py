@@ -1,0 +1,350 @@
+"""Megakernel autotuner tests (sim/autotune.py + bench.py --autotune).
+
+Contracts, all tier-1 on CPU:
+
+* the SWEEP SPACE covers the three tuning axes (rounds_per_call x
+  lane block shape x stale_k) and the winner is picked by measured
+  rounds/s — never fabricated when nothing measures;
+* the WINNER CACHE (AUTOTUNE_CACHE.json) round-trips, validates every
+  entry against the digest-pinned AUTOTUNE_WINNER_KEYS schema, and a
+  corrupt or drifted cache REFUSES by file+key (it feeds the headline
+  bench's tuned tier — a silently-tolerated bad entry would mis-label
+  a recorded number);
+* the TUNE ledger family validates/rejects like every other recorded
+  artifact (missing key by name, corrupt file by filename), so
+  ``bench.py --history`` can reconstruct the tuning trajectory;
+* bench.py flag validation: --autotune is mutually exclusive with the
+  other modes and takes no checkpoint flags; --family/--metric apply
+  to --check-regression only (exit 2 + usage, nothing runs).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from consul_tpu.sim import autotune, costmodel, registry
+from consul_tpu.sim.autotune import AutotuneCacheError
+from consul_tpu.sim.costmodel import LedgerError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+_WINNER = {"config": "lanes-k2-b128", "engine": "lanes", "stale_k": 2,
+           "rounds_per_call": 1, "lane_blocks": 128,
+           "rounds_per_sec": 1234.5}
+
+
+# ------------------------------------------------------- sweep space
+
+
+def test_sweep_space_covers_the_three_axes():
+    space = autotune.sweep_space("cpu")
+    engines = {c["engine"] for c in space}
+    assert {"fast", "lanes", "overlap", "pallas"} <= engines
+    lane_blocks = {c["lane_blocks"] for c in space
+                   if c["engine"] == "lanes"}
+    assert lane_blocks == set(registry.AUTOTUNE_LANE_BLOCKS)
+    stale_ks = {c["stale_k"] for c in space if c["engine"] == "lanes"}
+    assert stale_ks == set(autotune.SWEEP_STALE_KS)
+    rpcs = {c["rounds_per_call"] for c in space
+            if c["engine"] == "pallas"}
+    assert rpcs == set(autotune.SWEEP_ROUNDS_PER_CALL)
+    # every stale_k point is conformance-pinned territory
+    assert set(autotune.SWEEP_STALE_KS) <= set(registry.STALE_KS)
+
+
+def test_autotune_picks_winner_and_skips_honestly():
+    """Stubbed measure: the tuner ranks by rounds_per_sec, keeps skip
+    rows (per-row honesty, the roofline convention), and the payload
+    passes the TUNE ledger validator."""
+    speed = {"fast": 100.0, "lanes": 300.0, "overlap": 200.0}
+
+    def fake_measure(p, rounds, engine, rounds_per_call,
+                     lane_blocks, reps, measure_bytes):
+        if engine == "pallas":
+            raise RuntimeError("no TPU in this stub")
+        rps = speed[engine] + (lane_blocks or 0)
+        return {
+            "config": costmodel.config_label(
+                engine, p.stale_k if engine != "fast" else 1,
+                rounds_per_call, lane_blocks),
+            "engine": engine, "stale_k": p.stale_k,
+            "rounds_per_call": rounds_per_call,
+            "lane_blocks": lane_blocks, "rounds_per_sec": rps,
+            "ms_per_round": 1e3 / rps,
+        }
+
+    from consul_tpu.sim import SimParams
+
+    p = SimParams(n=512, loss=0.05)
+    rec = autotune.autotune(p, rounds=8, reps=1, platform="cpu",
+                            measure=fake_measure)
+    assert rec["n"] == 512 and rec["platform"] == "cpu"
+    skipped = [r for r in rec["rows"] if "skipped" in r]
+    assert len(skipped) == len(autotune.SWEEP_ROUNDS_PER_CALL)
+    assert all("no TPU" in r["skipped"] for r in skipped)
+    # lanes + the widest block table wins under the stub's scoring
+    assert rec["winner"]["engine"] == "lanes"
+    assert rec["winner"]["lane_blocks"] == \
+        max(registry.AUTOTUNE_LANE_BLOCKS)
+    assert set(rec["winner"]) == set(registry.AUTOTUNE_WINNER_KEYS)
+    costmodel.validate_record("TUNE_r01.json", rec)
+
+
+def test_autotune_never_fabricates_a_winner():
+    def all_skip(*a, **k):
+        raise RuntimeError("nothing builds here")
+
+    from consul_tpu.sim import SimParams
+
+    with pytest.raises(ValueError, match="never.*fabricated|fabricate"):
+        autotune.autotune(SimParams(n=512), rounds=8, platform="cpu",
+                          measure=all_skip)
+
+
+@pytest.mark.slow
+def test_autotune_real_measurement_smoke():
+    """The real seam end to end on a tiny pool: a 3-point space over
+    the actual runners measures, picks a winner, and the record
+    validates."""
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.sim import SimParams
+
+    p = SimParams.from_gossip_config(GossipConfig.lan(), n=512,
+                                     loss=0.01, tcp_fallback=False,
+                                     collect_stats=False)
+    space = ({"engine": "fast", "stale_k": 1, "rounds_per_call": 1,
+              "lane_blocks": None},
+             {"engine": "lanes", "stale_k": 2, "rounds_per_call": 1,
+              "lane_blocks": 32},
+             {"engine": "overlap", "stale_k": 2, "rounds_per_call": 1,
+              "lane_blocks": None})
+    rec = autotune.autotune(p, rounds=8, reps=1, platform="cpu",
+                            space=space)
+    assert all("skipped" not in r for r in rec["rows"])
+    assert rec["winner"]["rounds_per_sec"] > 0
+    costmodel.validate_record("TUNE_r01.json", rec)
+
+
+# ------------------------------------------------------ winner cache
+
+
+def test_cache_round_trip_and_missing(tmp_path):
+    root = str(tmp_path)
+    assert autotune.load_cache(root) == {}
+    assert autotune.cached_winner(root, "cpu", 65536) is None
+    path = autotune.save_winner(root, "cpu", 65536, _WINNER)
+    assert os.path.basename(path) == autotune.CACHE_FILE
+    assert autotune.cached_winner(root, "cpu", 65536) == _WINNER
+    # other (platform, n) keys stay independent
+    assert autotune.cached_winner(root, "tpu", 65536) is None
+    w2 = {**_WINNER, "config": "pallas-x8", "engine": "pallas",
+          "lane_blocks": None, "rounds_per_call": 8}
+    autotune.save_winner(root, "tpu", 1 << 20, w2)
+    assert autotune.cached_winner(root, "cpu", 65536) == _WINNER
+    assert autotune.cached_winner(root, "tpu", 1 << 20) == w2
+
+
+def test_cache_refuses_corruption_by_name(tmp_path):
+    root = str(tmp_path)
+    cache = tmp_path / autotune.CACHE_FILE
+    cache.write_text("{broken json")
+    with pytest.raises(AutotuneCacheError,
+                       match=r"AUTOTUNE_CACHE\.json.*unreadable"):
+        autotune.load_cache(root)
+    # a corrupt cache is never silently papered over by a save
+    with pytest.raises(AutotuneCacheError):
+        autotune.save_winner(root, "cpu", 65536, _WINNER)
+    # schema drift inside one entry refuses by key
+    bad = {k: v for k, v in _WINNER.items() if k != "lane_blocks"}
+    cache.write_text(json.dumps({"cpu/n65536": bad}))
+    with pytest.raises(AutotuneCacheError,
+                       match=r"cpu/n65536.*lane_blocks"):
+        autotune.cached_winner(root, "cpu", 65536)
+    # non-object cache refuses
+    cache.write_text(json.dumps([1, 2]))
+    with pytest.raises(AutotuneCacheError, match="object"):
+        autotune.load_cache(root)
+    # save validates the winner before touching the file
+    cache.unlink()
+    with pytest.raises(AutotuneCacheError, match="rounds_per_sec"):
+        autotune.save_winner(root, "cpu", 65536,
+                             {**_WINNER, "rounds_per_sec": "fast"})
+    assert not cache.exists()
+
+
+def test_tuned_runner_builds_and_validates():
+    import jax
+
+    from consul_tpu.sim import SimParams, init_state
+
+    p = SimParams(n=512, loss=0.05, tcp_fallback=False)
+    run = autotune.tuned_runner(p, _WINNER, rounds=8)
+    out = run(init_state(p.n), jax.random.key(0))
+    assert int(out.round_idx) == 8
+    # cadence misalignment refuses (same contract as measure_config)
+    with pytest.raises(ValueError, match="cadence"):
+        autotune.tuned_runner(p, _WINNER, rounds=7)
+    with pytest.raises(AutotuneCacheError, match="rounds_per_sec"):
+        autotune.tuned_runner(p, {"engine": "fast"}, rounds=8)
+
+
+# ------------------------------------------------- TUNE ledger family
+
+
+def _tune_payload():
+    row = {**_WINNER, "ms_per_round": 0.8}
+    return {"metric": "autotune_rounds_per_sec_smoke",
+            "platform": "cpu", "n": 65536, "rounds": 24,
+            "rows": [row, {"config": "pallas", "engine": "pallas",
+                           "skipped": "no TPU"}],
+            "winner": dict(_WINNER)}
+
+
+def test_tune_validator_accepts_and_rejects():
+    costmodel.validate_record("TUNE_r01.json", _tune_payload())
+    # missing top-level key, by name
+    broken = _tune_payload()
+    del broken["winner"]
+    with pytest.raises(LedgerError, match=r"TUNE_r01.*winner"):
+        costmodel.validate_record("TUNE_r01.json", broken)
+    # a measured row missing a winner-schema key, by name
+    broken = _tune_payload()
+    del broken["rows"][0]["lane_blocks"]
+    with pytest.raises(LedgerError, match=r"rows\[0\].*lane_blocks"):
+        costmodel.validate_record("TUNE_r01.json", broken)
+    # winner schema drift, by name
+    broken = _tune_payload()
+    broken["winner"].pop("config")
+    with pytest.raises(LedgerError, match=r"winner.*config"):
+        costmodel.validate_record("TUNE_r01.json", broken)
+    # rows must be a non-empty list
+    broken = _tune_payload()
+    broken["rows"] = []
+    with pytest.raises(LedgerError, match="non-empty"):
+        costmodel.validate_record("TUNE_r01.json", broken)
+    # non-numeric winner rounds/s
+    broken = _tune_payload()
+    broken["winner"]["rounds_per_sec"] = "quick"
+    with pytest.raises(LedgerError, match="rounds_per_sec"):
+        costmodel.validate_record("TUNE_r01.json", broken)
+
+
+def test_tune_records_load_in_ledger(tmp_path):
+    """A TUNE record on disk loads through load_ledger and surfaces a
+    --history headline row; a corrupt one fails by filename."""
+    (tmp_path / "TUNE_r01.json").write_text(json.dumps(_tune_payload()))
+    records = costmodel.load_ledger(str(tmp_path))
+    assert [r["family"] for r in records] == ["TUNE"]
+    rows = costmodel.history_rows(records)
+    assert rows[0]["value"] == _WINNER["rounds_per_sec"]
+    assert _WINNER["config"] in rows[0]["note"]
+    (tmp_path / "TUNE_r02.json").write_text("{nope")
+    with pytest.raises(LedgerError, match="TUNE_r02.json"):
+        costmodel.load_ledger(str(tmp_path))
+
+
+# --------------------------------------------- bench.py flag validation
+
+
+def _bench(*argv, env_extra=None, timeout=120):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, BENCH, *argv], capture_output=True,
+        text=True, timeout=timeout, env=env, cwd=REPO_ROOT)
+
+
+def test_bench_autotune_flag_combinations_exit_2():
+    """--autotune is a top-level mode: mutually exclusive with every
+    other mode, no --profile, no checkpoint flags — exit 2 + usage,
+    nothing runs (fails before any backend init)."""
+    for argv in (("--autotune", "--mesh"), ("--autotune", "--sweep"),
+                 ("--autotune", "--chaos"), ("--autotune", "--coords"),
+                 ("--autotune", "--history"),
+                 ("--autotune", "--check-regression"),
+                 ("--profile", "--autotune"),
+                 ("--autotune", "--ckpt-dir", "/tmp/nope"),
+                 ("--autotune", "--resume")):
+        r = _bench(*argv)
+        assert r.returncode == 2, (argv, r.stderr)
+        assert "usage:" in r.stderr, (argv, r.stderr)
+
+
+def test_bench_family_metric_selector_validation():
+    """--family/--metric belong to --check-regression alone, name
+    their guardable families, and always take a value."""
+    cases = (("--family", "BENCH"),                  # no mode
+             ("--autotune", "--family", "BENCH"),    # wrong mode
+             ("--metric", "x"),                      # no mode
+             ("--check-regression", "--family", "VIBES"),
+             ("--check-regression", "--family"),     # missing value
+             ("--check-regression", "--metric"),     # missing value
+             ("--check-regression", "--family", "--smoke"))
+    for argv in cases:
+        r = _bench(*argv)
+        assert r.returncode == 2, (argv, r.stderr)
+        assert "usage:" in r.stderr, (argv, r.stderr)
+    # a metric naming a DIFFERENT workload than the one --smoke
+    # re-measures is refused — comparing a fresh smoke run against
+    # the 1M-node record would be apples to oranges
+    for argv in (("--check-regression", "--smoke",
+                  "--metric", "gossip_rounds_per_sec_1M_nodes"),
+                 ("--check-regression", "--smoke",
+                  "--metric", "kv_put_per_sec")):
+        r = _bench(*argv)
+        assert r.returncode == 2, (argv, r.stderr)
+        assert "cannot baseline" in r.stderr, (argv, r.stderr)
+    # PROFILE re-measures exactly one metric; any other name refuses
+    r = _bench("--check-regression", "--smoke", "--family", "PROFILE",
+               "--metric", "gossip_rounds_per_sec_smoke")
+    assert r.returncode == 2
+    assert "cannot re-measure" in r.stderr
+
+
+def test_bench_check_regression_profile_without_record_exits_2(
+        tmp_path):
+    """--family PROFILE with no recorded roofline utilization exits 2
+    before measuring (a baseline is never fabricated)."""
+    r = _bench("--check-regression", "--smoke", "--family", "PROFILE",
+               env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
+    assert r.returncode == 2, r.stderr
+    assert "never" in r.stderr and "fabricated" in r.stderr
+
+
+def test_bench_check_regression_profile_workload_mismatch_exits_2():
+    """The recorded roofline baseline in this repo was measured under
+    --smoke (n=65,536, cache-resident); re-measuring at 1M nodes and
+    banding against it would compare different physical quantities —
+    refused BEFORE any backend init, like the BENCH family's smoke/1M
+    metric split."""
+    r = _bench("--check-regression", "--family", "PROFILE")
+    assert r.returncode == 2, r.stderr
+    assert "--smoke" in r.stderr and "usage:" in r.stderr
+
+
+def test_latest_profile_util_prefers_physical_rows():
+    """util > 1 rows are cache artifacts (the 65k working set beats
+    the STREAM ceiling in LLC), not roofline points: the PROFILE
+    regression baseline must anchor to the best util <= 1 row and
+    surface the workload (smoke/n) it was measured at."""
+    base = costmodel.latest_profile_util(
+        costmodel.load_ledger(REPO_ROOT))
+    assert base is not None
+    assert base["util"] <= 1.0
+    assert base["engine"] in ("lanes", "overlap")
+    assert isinstance(base["smoke"], bool)
+    # a ledger whose every row is cache-resident still yields a
+    # baseline (fallback to the overall max), and legacy profiles
+    # without rooflines yield None
+    rows = [{"config": "fast", "engine": "fast", "util": 2.5}]
+    rec = {"family": "PROFILE", "round": 9, "file": "PROFILE_r09.json",
+           "data": {"smoke": True, "n": 1024, "profile": {"roofline": {
+               "rows": rows}}}}
+    assert costmodel.latest_profile_util([rec])["util"] == 2.5
+    assert costmodel.latest_profile_util(
+        [{"family": "PROFILE", "round": 1, "file": "f",
+          "data": {"profile": {}}}]) is None

@@ -67,7 +67,21 @@ def test_layout_registry_digest_pinned():
     # validates (LEDGER_FAMILIES). Consumers: sim/costmodel.py
     # formulas + validators, bench.py --profile/--history,
     # ARCHITECTURE.md cost tables.
-    assert registry.layout_digest() == "6f12d6ba8f4378b0"
+    # PR 12 re-pin (was 6f12d6ba8f4378b0): the digest now additionally
+    # covers the bit-packed state contract — the per-field packed
+    # dtype table (STATE_PACKED_FIELDS), the tick quantum + saturation
+    # caps (TICK_QUANTUM/TICK_MAX/CONF_MAX), the down_age liveness
+    # encoding, the autotuner's winner/cache schema
+    # (AUTOTUNE_WINNER_KEYS, AUTOTUNE_LANE_BLOCKS, the TUNE ledger
+    # family), and the RE-CALIBRATED cost-model constants for the
+    # packed round bodies. Consumers: sim/state.py init/pack/unpack,
+    # every engine's widen/narrow sites, checkpoint headers (old
+    # snapshots refuse by stale layout), costmodel.STATE_FIELD_BYTES,
+    # sim/autotune.py, ARCHITECTURE.md's dtype table. The roofline
+    # row schema also grew the autotuner's ``lane_blocks`` axis and
+    # the PROFILE record schema bumped to v4 (v3 records validate
+    # under their own version).
+    assert registry.layout_digest() == "142fb9f86f0d9ad7"
 
 
 def test_reduce_lane_layout_pinned():

@@ -29,6 +29,7 @@ from consul_tpu.config import GossipConfig
 from consul_tpu.gossip import InMemNetwork, Serf
 from consul_tpu.sim import SimParams, init_state, run_rounds
 from consul_tpu.sim.metrics import fd_report, propagation_curve
+from consul_tpu.sim.state import with_crashed
 from consul_tpu.types import MemberStatus
 from consul_tpu.utils import telemetry
 
@@ -70,9 +71,7 @@ def host_detection_time(n=20, seed=0):
 
 def sim_detection_time(n=20, seed=0):
     p = SimParams.from_gossip_config(CFG, n=n)
-    state = init_state(n)
-    state = state._replace(up=state.up.at[n - 1].set(False),
-                           down_time=state.down_time.at[n - 1].set(0.0))
+    state = with_crashed(init_state(n), n - 1)
     state, _ = run_rounds(state, jax.random.key(seed), p, 200)
     rep = fd_report(state, p)
     assert rep.true_deaths_declared == 1
@@ -150,9 +149,8 @@ def test_leave_propagation_same_ballpark():
     from consul_tpu.sim.state import LEFT as SIM_LEFT
 
     p = SimParams.from_gossip_config(CFG, n=20)
-    state = init_state(p.n)
+    state = with_crashed(init_state(p.n), 3)
     state = state._replace(
-        up=state.up.at[3].set(False),
         status=state.status.at[3].set(SIM_LEFT),
         informed=state.informed.at[3].set(1.0 / p.n))
     state, trace = run_rounds(state, jax.random.key(13), p, 50,

@@ -61,27 +61,34 @@ def test_pallas_crash_detection():
 
     n = 262_144
     p = SimParams(n=n, loss=0.01, collect_stats=False)
-    s = init_state(n)
-    s = s._replace(up=s.up.at[7].set(False),
-                   down_time=s.down_time.at[7].set(0.0))
+    from consul_tpu.sim.state import with_crashed
+
+    s = with_crashed(init_state(n), 7)
     out = make_run_rounds_pallas(p, 60)(s, jax.random.key(2))
     assert int(out.status[7]) == DEAD
     assert int(jnp.sum(out.status == DEAD)) == 1  # no false positives
     assert float(out.informed[7]) > 0.99
 
-def test_stable_kernel_refuses_stale_slow_state():
-    """A no-churn config builds the 8-array kernel, which carries no
-    slow array — feeding it a state with residual slow nodes must be
-    refused, not silently treated as all-fast (runs on CPU: the guard
-    fires before any Mosaic lowering)."""
+@tpu_only
+def test_stable_kernel_holds_residual_liveness_rows_frozen():
+    """A no-churn/no-stats config runs the packed down_age lane
+    READ-ONLY. Residual dead/slow sentinel rows keep their full
+    dynamics (the kernel reads the sentinels every round —
+    test_pallas_crash_detection is the detection half of this
+    contract) but the lane itself passes through frozen: a dead row's
+    age stays at its entry value (the XLA engines tick it; age feeds
+    only stats/rejoin, both off here) and a slow row stays slow (the
+    XLA engines hold it too when the slow model is off)."""
     from consul_tpu.sim.pallas_round import make_run_rounds_pallas
+    from consul_tpu.sim.state import SLOW_AGE, with_crashed, with_slow
 
     n = 262_144
     p = SimParams(n=n, loss=0.01, collect_stats=False)
-    s = init_state(n)
-    with pytest.raises(ValueError, match="slow nodes"):
-        make_run_rounds_pallas(p, 1)(
-            s._replace(slow=s.slow.at[3].set(True)), jax.random.key(0))
+    s = with_slow(with_crashed(init_state(n), 5, age=7), 3)
+    out = make_run_rounds_pallas(p, 30)(s, jax.random.key(0))
+    assert int(out.down_age[5]) == 7      # frozen, not aged, not wrapped
+    assert int(out.down_age[3]) == SLOW_AGE
+    assert not bool(out.up[5]) and bool(out.slow[3])
 
 
 @tpu_only
@@ -157,33 +164,31 @@ def test_megakernel_matches_frozen_scalar_sequence():
     scal = init_scalars(state, p)
     scal = scal.at[7].set(jnp.maximum(scal[7], 1e-9))
     seeds = jnp.arange(1000, 1000 + R, dtype=jnp.int32)
-    t0 = jnp.zeros((1,), jnp.float32)
 
     def to2d(x, rows):
         return x.reshape(rows, pr.LANES)
 
-    mega, rows, _ = pr._build_mega(p, n, R)
-    one, rows1, _ = pr._build_round(p, n)
+    mega, rows = pr._build_mega(p, n, R)
+    one, rows1 = pr._build_round(p, n)
     assert rows == rows1
-    args = (to2d(state.up.astype(jnp.int8), rows),
-            to2d(state.status, rows),
+    args = (to2d(state.status, rows),
             to2d(state.incarnation, rows),
             to2d(state.informed, rows),
-            to2d(state.susp_start, rows),
-            to2d(state.susp_deadline, rows),
+            to2d(state.down_age, rows),
+            to2d(state.susp_len, rows),
+            to2d(state.susp_ttl, rows),
             to2d(state.susp_conf, rows),
             to2d(state.local_health, rows))
 
     @jax.jit
     def run_mega(args):
-        return mega(args, scal, seeds, t0)
+        return mega(args, scal, seeds)
 
     @jax.jit
     def run_seq(args):
         a = args
         for r in range(R):
-            t = t0 + jnp.float32(r) * p.probe_interval
-            a, sums, stat_sums = one(a, scal, seeds[r][None], t)
+            a, sums, stat_sums = one(a, scal, seeds[r][None])
         return a, sums, stat_sums
 
     m_args, m_sums, _ = run_mega(args)

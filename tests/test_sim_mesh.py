@@ -335,8 +335,7 @@ def test_sharded_crash_detection(devices8, dc):
     state = init_sharded_state(p.n, mesh)
     # crash a node owned by the last shard
     state = state._replace(
-        up=state.up.at[p.n - 3].set(False),
-        down_time=state.down_time.at[p.n - 3].set(0.0))
+        down_age=state.down_age.at[p.n - 3].set(0))
     run = make_sharded_run(p, rounds=40, mesh=mesh)
     out = run(state, jax.random.key(0))
     assert int(out.status[p.n - 3]) == DEAD
@@ -394,8 +393,7 @@ def test_multidc_pools_are_isolated(devices8):
 
     kill = jnp.arange(5)
     state = state._replace(
-        up=state.up.at[kill].set(False),
-        down_time=state.down_time.at[kill].set(0.0))
+        down_age=state.down_age.at[kill].set(0))
     run = make_multidc_run(p, rounds=60, mesh=mesh)
     out = run(state, jax.random.key(0))
     host = jax.device_get(out)

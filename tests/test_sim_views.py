@@ -152,9 +152,9 @@ def test_views_vs_meanfield_detection_agreement():
     vs = run_views(vs, jax.random.key(1), p, rounds_budget)
     assert view_metrics(vs)["detected_frac"] == 1.0
     # mean-field tier: same workload via injected crash mask
-    ms = init_state(N)
-    ms = ms._replace(up=ms.up.at[:6].set(False),
-                     down_time=ms.down_time.at[:6].set(0.0))
+    from consul_tpu.sim.state import with_crashed
+
+    ms = with_crashed(init_state(N), slice(0, 6))
     run = make_run_rounds(p, rounds_budget)
     ms = run(ms, jax.random.key(1))
     # every crashed node's cluster rumor must be DEAD by now

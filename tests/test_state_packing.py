@@ -1,0 +1,252 @@
+"""Bit-packed SWIM state: packed<->unpacked bitwise conformance pins.
+
+PR 12 packs SimState's hot lanes (registry.STATE_PACKED_FIELDS:
+int16 incarnation, tick-count timer fields, the up/slow bools folded
+into down_age's sentinel range) and the engines widen on load / narrow
+on store via each array's OWN dtype — so the packed (int16/int8) and
+wide (int32) layouts run the same program. These tests pin that claim
+the PR 5/7 way: not statistically, BITWISE, for every engine, in
+tier-1 on CPU (the Pallas kernel's twin is TPU-gated next to the other
+Mosaic conformance pins in tests/test_pallas_round.py).
+
+Also pinned here: the saturate-and-REFUSE contract. Narrowing stores
+clamp at registry.TICK_MAX instead of wrapping (an int16 incarnation
+wrap under a ChurnBurst would be silent corruption), saturation is
+detectable in the final state, and state.check_saturation /
+checkpoint.snapshot refuse BY FIELD NAME.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.sim import (SUSPECT, SimParams, init_state, make_mesh,
+                            make_run_rounds_lanes, make_sharded_run,
+                            registry, run_rounds)
+from consul_tpu.sim import state as state_mod
+from consul_tpu.sim.mesh import init_sharded_state
+from consul_tpu.sim.round import make_run_rounds_fast
+from consul_tpu.sim.state import (ALIVE_AGE, SLOW_AGE, TICK_MAX,
+                                  SaturationError, check_saturation,
+                                  pack, unpack)
+
+_P = SimParams(n=512, loss=0.08, tcp_fallback=False,
+               fail_per_round=0.005, rejoin_per_round=0.02,
+               slow_per_round=0.002)
+_KEY = jax.random.key(7)
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(jax.device_get(x)),
+                       np.asarray(jax.device_get(y)))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# ------------------------------------------------------- layout pins
+
+
+def test_packed_layout_matches_registry_table():
+    """init_state's per-node dtypes are EXACTLY the digest-pinned
+    registry.STATE_PACKED_FIELDS table, the per-node footprint is
+    <= 16 B (the acceptance bar; 15 B here, down from the unpacked
+    26 B), and the wide twin widens exactly the narrowed fields."""
+    s = init_state(64)
+    per_node = 0
+    for name, dtype, nbytes in registry.STATE_PACKED_FIELDS:
+        arr = getattr(s, name)
+        assert str(arr.dtype) == dtype, name
+        assert arr.dtype.itemsize == nbytes, name
+        per_node += nbytes
+    assert per_node <= 16
+    w = init_state(64, packed=False)
+    for name in ("incarnation", "down_age", "susp_len", "susp_ttl",
+                 "susp_conf"):
+        assert getattr(w, name).dtype == jnp.int32, name
+    # semantic widths stay put in both layouts
+    for name in ("status", "local_health"):
+        assert getattr(w, name).dtype == jnp.int8, name
+    assert w.informed.dtype == jnp.float32
+
+
+def test_liveness_properties_derive_from_down_age():
+    """up/slow are PROPERTIES over down_age's sentinel encoding
+    (-1 live, -2 live+slow, >= 0 dead-for-that-many-ticks) — the two
+    historical bool arrays cost 2 B/node and were always derivable."""
+    s = init_state(8)
+    assert bool(jnp.all(s.up)) and not bool(jnp.any(s.slow))
+    s = state_mod.with_crashed(s, 3, age=5)
+    s = state_mod.with_slow(s, 1)
+    up = np.asarray(s.up)
+    slow = np.asarray(s.slow)
+    assert not up[3] and up[1] and up[0]
+    assert slow[1] and not slow[3] and not slow[0]
+    assert int(s.down_age[3]) == 5
+    assert int(s.down_age[1]) == SLOW_AGE
+    assert int(s.down_age[0]) == ALIVE_AGE
+
+
+def test_pack_unpack_round_trip():
+    s = init_state(64)
+    w = init_state(64, packed=False)
+    assert _leaves_equal(unpack(s), w)
+    assert _leaves_equal(pack(w), s)
+    assert _leaves_equal(pack(unpack(s)), s)
+
+
+# -------------------------------------- bitwise engine conformance
+#
+# The tier-1 acceptance matrix: xla, fast, lanes at stale_k in {1,4},
+# overlap — each run twice from the same key, once on packed storage,
+# once on the wide twin, and pack(wide result) must equal the packed
+# result BIT FOR BIT (state, stats, and — via the shared scan — the
+# same program structure). The clips are semantic (applied in BOTH
+# layouts), so the wide run cannot reach values the packed one clamps.
+
+
+def test_xla_engine_packed_unpacked_bitwise():
+    a, _ = run_rounds(init_state(_P.n), _KEY, _P, 60)
+    b, _ = run_rounds(init_state(_P.n, packed=False), _KEY, _P, 60)
+    assert _leaves_equal(a, pack(b))
+    assert _leaves_equal(unpack(a), b)
+    assert int(a.stats.suspicions) > 0  # the run exercised the detector
+
+
+def test_fast_engine_packed_unpacked_bitwise():
+    a = make_run_rounds_fast(_P, 60)(init_state(_P.n), _KEY)
+    b = make_run_rounds_fast(_P, 60)(init_state(_P.n, packed=False),
+                                     _KEY)
+    assert _leaves_equal(a, pack(b))
+
+
+@pytest.mark.parametrize("stale_k,overlap", [(1, False), (4, False),
+                                             (2, True)])
+def test_lanes_engine_packed_unpacked_bitwise(stale_k, overlap):
+    p = _P.with_(stale_k=stale_k)
+    a = make_run_rounds_lanes(p, 60, overlap=overlap)(
+        init_state(p.n), _KEY)
+    b = make_run_rounds_lanes(p, 60, overlap=overlap)(
+        init_state(p.n, packed=False), _KEY)
+    assert _leaves_equal(a, pack(b))
+    assert int(a.stats.crashes) > 0
+
+
+def test_mesh_packed_equals_single_device_wide(devices8):
+    """The sharded engine runs the PACKED layout natively; the
+    single-device wide twin packs to the same bits — so mesh<->single
+    conformance (PR 5) and packed<->unpacked conformance compose into
+    one triangle instead of multiplying the test matrix."""
+    rounds = 60
+    p = _P.with_(stale_k=4)
+    mesh = make_mesh(devices8)
+    sharded = make_sharded_run(p, rounds, mesh)(
+        init_sharded_state(p.n, mesh), _KEY)
+    wide = make_run_rounds_lanes(p, rounds)(
+        init_state(p.n, packed=False), _KEY)
+    assert _leaves_equal(sharded, pack(wide))
+
+
+# ---------------------------------------------- saturation refusals
+
+
+def test_incarnation_saturates_and_refuses_by_name():
+    """The churn-burst wrap hazard, pinned: nodes one increment below
+    the int16 cap whose suspicion rumors get refuted (the inc-bump
+    site) CLAMP at TICK_MAX — never wrap negative — and
+    check_saturation names the field. The wide layout applies the same
+    semantic clip, so packed<->unpacked stays bitwise even at the cap."""
+    n = 256
+    runs = {}
+    for packed in (True, False):
+        s = init_state(n, packed=packed)
+        # every node suspected with a long timer, fully informed —
+        # the refutation race fires with near-certainty each round
+        s = s._replace(
+            status=jnp.full((n,), SUSPECT, s.status.dtype),
+            incarnation=jnp.full((n,), TICK_MAX - 1,
+                                 s.incarnation.dtype),
+            susp_len=jnp.full((n,), 40, s.susp_len.dtype),
+            susp_ttl=jnp.full((n,), 40, s.susp_ttl.dtype))
+        p = _P.with_(fail_per_round=0.0, rejoin_per_round=0.0,
+                     slow_per_round=0.0)
+        final, _ = run_rounds(s, _KEY, p, 20)
+        inc = np.asarray(jax.device_get(final.incarnation),
+                         dtype=np.int64)
+        assert inc.min() >= TICK_MAX - 1, "an int16 store wrapped"
+        assert inc.max() == TICK_MAX, "no refutation fired — the " \
+            "saturation site was never exercised"
+        runs[packed] = final
+        with pytest.raises(SaturationError, match="incarnation"):
+            check_saturation(final)
+    assert _leaves_equal(runs[True], pack(runs[False]))
+
+
+def test_down_age_saturates_at_cap():
+    """A node dead longer than the int16 tick range stops counting at
+    TICK_MAX instead of wrapping back into the live sentinel range
+    (which would resurrect it)."""
+    s = init_state(64)
+    s = state_mod.with_crashed(s, 0, age=TICK_MAX - 2)
+    final, _ = run_rounds(s, _KEY, _P.with_(rejoin_per_round=0.0), 10)
+    age0 = int(final.down_age[0])
+    assert age0 == TICK_MAX
+    assert not bool(final.up[0])
+    with pytest.raises(SaturationError, match="down_age"):
+        check_saturation(final)
+
+
+def test_checkpoint_snapshot_refuses_saturated_state():
+    """The chaos/checkpoint wiring: a snapshot cut on a saturated
+    state refuses by field name instead of persisting clamped values
+    a resume would silently trust."""
+    from consul_tpu.sim import checkpoint
+
+    s = init_state(64)
+    s = s._replace(incarnation=s.incarnation.at[3].set(TICK_MAX))
+    with pytest.raises(SaturationError, match="incarnation"):
+        checkpoint.snapshot(_P, _KEY, s, engine="xla",
+                            total_rounds=10)
+    # the same state, unsaturated, snapshots fine
+    ok = init_state(64)
+    snap = checkpoint.snapshot(_P, _KEY, ok, engine="xla",
+                               total_rounds=10)
+    assert snap is not None
+
+
+def test_clean_run_passes_saturation_check():
+    final, _ = run_rounds(init_state(_P.n), _KEY, _P, 60)
+    check_saturation(final)  # must not raise
+
+
+def test_registry_digest_covers_packing_layout():
+    """The drift guard (same idiom as the costmodel/sweep pins):
+    moving ANY packing constant — a field's dtype, the tick quantum,
+    a saturation cap, the liveness encoding, the autotuner's winner
+    schema or block-table axis — must move the pinned layout digest so
+    every consumer (state init/pack/unpack, the engines' widen/narrow
+    sites, checkpoint headers, costmodel.STATE_FIELD_BYTES,
+    sim/autotune.py, the docs' dtype table) is audited in the same
+    change."""
+    base = registry.layout_digest()
+    for name, mutated in (
+        ("STATE_PACKED_FIELDS",
+         registry.STATE_PACKED_FIELDS[:-1]
+         + (("local_health", "int32", 4),)),
+        ("TICK_QUANTUM", "gossip_interval"),
+        ("TICK_MAX", 127),
+        ("CONF_MAX", 3),
+        ("LIVENESS_ENCODING",
+         registry.LIVENESS_ENCODING + ("-3=zombie",)),
+        ("AUTOTUNE_WINNER_KEYS",
+         registry.AUTOTUNE_WINNER_KEYS + ("vibes",)),
+        ("AUTOTUNE_LANE_BLOCKS",
+         registry.AUTOTUNE_LANE_BLOCKS + (256,)),
+    ):
+        orig = getattr(registry, name)
+        try:
+            setattr(registry, name, mutated)
+            assert registry.layout_digest() != base, name
+        finally:
+            setattr(registry, name, orig)
+    assert registry.layout_digest() == base

@@ -170,8 +170,8 @@ def test_sweep_64_points_one_compile_bitwise_vs_solo():
             assert np.array_equal(col, solo_cols[name]), (i, name)
     # the grid is not degenerate: different constants, different runs
     # (suspicion_mult 1 declares within 10 rounds, 6 cannot)
-    assert not np.array_equal(np.asarray(states.susp_deadline[0]),
-                              np.asarray(states.susp_deadline[63]))
+    assert not np.array_equal(np.asarray(states.susp_ttl[0]),
+                              np.asarray(states.susp_ttl[63]))
 
 
 def test_sweep_point_vs_static_run_rounds():
@@ -196,12 +196,19 @@ def test_sweep_point_vs_static_run_rounds():
                                    _ROUNDS, record_every=2)
         gs = _state_point(states, i)
         for f in ("up", "status", "incarnation", "susp_conf",
-                  "local_health", "slow", "round_idx"):
+                  "local_health", "slow", "down_age", "round_idx"):
             assert np.array_equal(np.asarray(getattr(st, f)),
                                   np.asarray(getattr(gs, f))), (i, f)
         _assert_bitwise(st.stats, gs.stats, f"stats[{i}]")
-        for f in ("down_time", "informed", "susp_start",
-                  "susp_deadline", "t"):
+        # the packed tick lanes quantize through ONE f32 ceil
+        # (round._round_core len0/len2): a swept leaf's 1-ulp rewrite
+        # can legally flip that ceil across an integer boundary, so
+        # static<->traced agreement on them is exact-or-one-tick
+        for f in ("susp_len", "susp_ttl"):
+            a = np.asarray(getattr(st, f), np.int32)
+            b = np.asarray(getattr(gs, f), np.int32)
+            assert np.all(np.abs(a - b) <= 1), (i, f)
+        for f in ("informed", "t"):
             a = np.asarray(getattr(st, f))
             b = np.asarray(getattr(gs, f))
             tol = 4 * np.spacing(np.maximum(np.abs(a), np.abs(b))
@@ -381,7 +388,7 @@ def test_sweep_maker_validation():
     with pytest.raises(ValueError, match="point"):
         solo(tp, _KEY)
     # lane engine pools must divide the block table
-    with pytest.raises(ValueError, match="LANE_BLOCKS"):
+    with pytest.raises(ValueError, match="block table"):
         sweep.make_run_sweep(_P.with_(n=100), 4, engine="lanes")
 
 
