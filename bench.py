@@ -55,8 +55,10 @@ _MODES = ("--mesh", "--sweep", "--chaos", "--coords",
 #: record families --check-regression knows how to RE-MEASURE (the
 #: selector satellite): BENCH re-times the rounds/s headline, PROFILE
 #: re-times the recorded best-utilization roofline config against a
-#: fresh bandwidth peak — both under the same median+IQR refusal band
-_GUARDED_FAMILIES = ("BENCH", "PROFILE")
+#: fresh bandwidth peak, SERVE re-runs the recorded top concurrency
+#: rung of the bench_kv sustained ladder in-process — all under the
+#: same median+IQR refusal band
+_GUARDED_FAMILIES = ("BENCH", "PROFILE", "SERVE")
 
 
 def _usage(err: str) -> None:
@@ -73,7 +75,7 @@ def _usage(err: str) -> None:
           "       bench.py --autotune [--smoke]\n"
           "       bench.py --history\n"
           "       bench.py --check-regression [--smoke] "
-          "[--family BENCH|PROFILE] [--metric NAME]\n"
+          "[--family BENCH|PROFILE|SERVE] [--metric NAME]\n"
           "(--profile applies to the throughput bench only; modes are "
           "mutually exclusive)", file=sys.stderr)
     sys.exit(2)
@@ -133,6 +135,11 @@ def run_check_regression(smoke: bool, family: str = "BENCH",
     * ``PROFILE`` — re-times the newest roofline's best-utilization
       config against a freshly measured bandwidth peak and guards the
       utilization number (in percent, so the band math reads sanely).
+    * ``SERVE`` — rebuilds the bench_kv cluster in-process and re-runs
+      the newest SERVE record's TOP concurrency rung (same herd
+      shape), guarding its req/s; the 5 duration-window samples feed
+      the band. SERVE_r* headlines sit under the same refusal
+      protocol as the kernel numbers (PR 13 satellite).
 
     --metric NAME overrides the recorded metric key to baseline
     against (it must still be one this family knows how to
@@ -148,6 +155,9 @@ def run_check_regression(smoke: bool, family: str = "BENCH",
     records = _load_ledger_or_die()
     if family == "PROFILE":
         _check_profile_regression(smoke, records, metric)
+        return
+    if family == "SERVE":
+        _check_serve_regression(smoke, records, metric)
         return
     expected = ("gossip_rounds_per_sec_smoke" if smoke
                 else "gossip_rounds_per_sec_1M_nodes")
@@ -215,6 +225,73 @@ def run_check_regression(smoke: bool, family: str = "BENCH",
         "platform": jax.default_backend(),
         "loadavg_1m": _loadavg_1m(),
         "baseline_file": base["file"],
+        **res,
+    }))
+    sys.exit(1 if res["verdict"] == "regression" else 0)
+
+
+def _check_serve_regression(smoke: bool, records,
+                            metric: Optional[str]) -> None:
+    """--check-regression --family SERVE: guard the serving-plane
+    throughput record. Rebuilds the bench_kv loopback cluster
+    in-process and re-runs the newest SERVE record's TOP concurrency
+    rung — same concurrency, same herd shape — for one pass whose 5
+    duration-window throughput samples feed the median+IQR band
+    against the recorded rung's req/s. --smoke shortens the pass (2s
+    window instead of 5s) without changing what is measured: the
+    rung's concurrency comes from the record either way, so there is
+    no apples-to-oranges workload split to refuse over (unlike the
+    BENCH smoke/1M metric pair). Needs no accelerator — the serving
+    plane is pure CPU."""
+    from consul_tpu.sim import costmodel
+
+    if metric is not None and metric != "kv_sustained":
+        _usage(f"--family SERVE re-measures the sustained KV ladder's "
+               f"top rung (metric 'kv_sustained'); it cannot "
+               f"re-measure {metric!r}")
+    metric = "kv_sustained"
+    base = costmodel.latest_metric(records, metric)
+    if base is None:
+        print("--check-regression --family SERVE: no recorded "
+              f"value of {metric!r} under {_record_root()} — record "
+              "one first (bench_kv.py --levels ... --out "
+              "SERVE_rNN.json); a baseline is never fabricated",
+              file=sys.stderr)
+        sys.exit(2)
+    rec = next(r for r in records
+               if r["file"] == base["file"])["data"]
+    top = rec["levels"][-1]
+    concurrency = int(top["concurrency"])
+    herd = rec.get("herd")
+
+    import bench_kv
+
+    windows = 5
+    duration = (2.0 if smoke else 5.0) * windows
+    servers = []
+    try:
+        servers, leader, follower = bench_kv.build_cluster()
+        rep = bench_kv.run_sustained(
+            leader, follower, [concurrency], duration,
+            herd=herd, windows=windows)
+    finally:
+        for s in servers:
+            s.shutdown()
+    row = rep["levels"][0]
+    samples = row.get("window_rps") or []
+    if len(samples) < 3:
+        print(f"--check-regression --family SERVE: only "
+              f"{len(samples)} window samples measured — cannot "
+              "apply the band", file=sys.stderr)
+        sys.exit(2)
+    res = costmodel.check_regression(samples, base["value"])
+    print(json.dumps({
+        "metric": metric,
+        "concurrency": concurrency,
+        "herd": herd,
+        "loadavg_1m": _loadavg_1m(),
+        "baseline_file": base["file"],
+        "fresh_p50_ms": row.get("p50_ms"),
         **res,
     }))
     sys.exit(1 if res["verdict"] == "regression" else 0)

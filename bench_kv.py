@@ -22,7 +22,8 @@ Sustained-load mode (the serving-plane latency observatory's harness,
 utils/perf.py):
 
     python bench_kv.py --concurrency C --duration S \
-        [--open-loop RPS] [--levels a,b,c] [--out SERVE_rXX.json]
+        [--open-loop RPS] [--levels a,b,c] [--herd N] \
+        [--out SERVE_rXX.json]
 
 drives a throughput-vs-latency ladder of concurrency levels (default
 C/4, C/2, C) of closed-loop clients — each running a mixed KV
@@ -31,12 +32,24 @@ parked on watched keys that a toucher thread wakes 4×/s, for
 `--duration` seconds per level. `--open-loop RPS` switches the TOP
 level to open-loop arrivals (latency measured from the scheduled send
 time, so queueing delay is not coordinated-omission'd away). Emits a
-latency-attribution report per level: per-stage p50/p99 and the share
-of the end-to-end p50 each top-level stage carries (from the
-process-global perf registry — the SAME histograms `/v1/agent/perf`
-serves), per-client fairness (Jain index + max/min spread), and a
-headline throughput that honors the median+IQR refusal band above
-(3 duration windows are the samples).
+latency-attribution report per level: per-stage p50/p99 (incl. the
+reactor's `park_wait` stage — blocking queries park as thread-free
+continuations, server/rpc.py) and the share of the end-to-end p50
+each top-level stage carries (from the process-global perf registry —
+the SAME histograms `/v1/agent/perf` serves), per-client fairness
+(Jain index + max/min spread), process thread counts (the
+thread-per-watcher regression canary), and a headline throughput that
+honors the median+IQR refusal band above (3 duration windows are the
+samples).
+
+`--herd N` is the blocking-watcher mode: N <= 64 replaces the
+ladder's default 16-thread herd; N > 64 additionally runs a
+post-ladder HERD-SCALE pass that parks N watchers through pipelined
+raw mux sessions (no client thread per watcher either — ~16 sockets
+carry the whole herd), proving the server parks them as
+continuations: the rpc.blocking.parked gauge reaches ~N while the
+process thread count stays O(clients + worker pool), and a touch of
+one watched key wakes exactly that key's cohort.
 """
 
 from __future__ import annotations
@@ -195,7 +208,12 @@ def build_cluster(n: int = 3):
     for i in range(n):
         cfg = load(dev=True, overrides={
             "node_name": f"bench{i}", "bootstrap": n == 1,
-            "bootstrap_expect": 0 if n == 1 else n, "server": True})
+            "bootstrap_expect": 0 if n == 1 else n, "server": True,
+            # every bench client shares 127.0.0.1, so the reference's
+            # per-client-IP conn cap (100) would refuse a C>=64 fleet
+            # that production would see as 64 distinct IPs — loopback
+            # topology artifact, not load shedding
+            "rpc_max_conns_per_client": 4096})
         s = Server(cfg)
         s.start()
         servers.append(s)
@@ -271,6 +289,198 @@ def _start_herd(leader, follower, stop, threads, keys,
     return ts
 
 
+def _thread_census():
+    """Process thread counts, split so the thread-per-watcher
+    regression is visible: `mux_dedicated` counts the server's
+    dedicated per-request mux threads (named mux-<src>-<sid>; the
+    reactor keeps this ~0 — forwarded blocking queries only), next to
+    the reactor/worker/stream populations."""
+    total = 0
+    mux_dedicated = 0
+    mux_streams = 0
+    rpc_workers = 0
+    reactors = 0
+    for t in threading.enumerate():
+        total += 1
+        name = t.name
+        if name.startswith("mux-stream-"):
+            mux_streams += 1
+        elif name.startswith("mux-reader-"):
+            pass  # client-side demux readers
+        elif name.startswith("mux-"):
+            mux_dedicated += 1
+        elif name.startswith("rpc-worker"):
+            rpc_workers += 1
+        elif name.startswith("rpc-reactor"):
+            reactors += 1
+    return {"total": total, "mux_dedicated": mux_dedicated,
+            "mux_streams": mux_streams, "rpc_workers": rpc_workers,
+            "reactors": reactors}
+
+
+def _start_pipelined_herd(follower, stop, threads, keys,
+                          max_query_time=30.0, sockets=16):
+    """Client side of a LARGE blocking-watcher herd with NO thread per
+    watcher on either end: `sockets` raw RPC_MUX sessions each carry
+    ~threads/sockets concurrently parked KVS.Get watches (distinct
+    sids, pipelined frames), re-armed by ONE reader thread per socket
+    as responses arrive. 10k parked watches cost ~16 client threads,
+    so the process's thread count measures the SERVER's threading
+    model — the claim under test (O(pool), not O(watchers)).
+
+    Returns {"threads", "close", "responses", "key0_cohort"}: close()
+    unblocks the readers by closing the sockets; responses() is the
+    cumulative count of watch completions (wake-delivery accounting);
+    key0_cohort is the EXACT number of watchers parked on herd/0 —
+    sids restart per socket, so the cohort is a per-socket sum, not
+    n//keys."""
+    from consul_tpu.server.rpc import RPC_MUX, read_frame, write_frame
+    import socket as socket_mod
+
+    host, port = follower.rpc.addr.rsplit(":", 1)
+    per = (threads + sockets - 1) // sockets
+    resp_count = [0]
+    resp_lock = threading.Lock()
+    socks = []
+    ts = []
+    made = 0
+    key0_cohort = 0
+    for s_i in range(sockets):
+        n_here = min(per, threads - made)
+        if n_here <= 0:
+            break
+        made += n_here
+        # sids 0..n_here-1 on THIS socket; sid % keys == 0 watches
+        # herd/0
+        key0_cohort += (n_here + keys - 1) // keys
+        sock = socket_mod.create_connection((host, int(port)),
+                                            timeout=10.0)
+        sock.sendall(bytes([RPC_MUX]))
+        wlock = threading.Lock()
+
+        def arm(sock, wlock, sid, min_idx):
+            with wlock:
+                write_frame(sock, {
+                    "sid": sid, "method": "KVS.Get",
+                    "args": {"Key": f"herd/{sid % keys}",
+                             "AllowStale": True,
+                             "MinQueryIndex": max(min_idx, 1),
+                             "MaxQueryTime": max_query_time}})
+
+        for sid in range(n_here):
+            arm(sock, wlock, sid, 1)
+
+        def reader(sock=sock, wlock=wlock):
+            while not stop.is_set():
+                try:
+                    resp = read_frame(sock)
+                except Exception:  # noqa: BLE001 — closed mid-read
+                    return
+                if resp is None:
+                    return
+                with resp_lock:
+                    resp_count[0] += 1
+                if stop.is_set():
+                    return
+                idx = (resp.get("result") or {}).get("Index", 1)
+                try:
+                    arm(sock, wlock, resp.get("sid", 0), idx)
+                except OSError:
+                    return
+
+        socks.append(sock)
+        ts.append(threading.Thread(target=reader, daemon=True,
+                                   name=f"herd-mux-{s_i}"))
+    for t in ts:
+        t.start()
+
+    def close():
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def responses():
+        with resp_lock:
+            return resp_count[0]
+
+    return {"threads": ts, "close": close, "responses": responses,
+            "key0_cohort": key0_cohort}
+
+
+def run_herd_scale(leader, follower, n, keys=None, sockets=16,
+                   park_timeout=90.0):
+    """The 10k-watcher proof: park `n` blocking watchers as thread-free
+    continuations on the follower and measure what they cost. Reports
+    the parked-gauge peak (must reach ~n), the process thread census
+    before/during (the pre-reactor design held one server thread per
+    watcher — 10k watchers meant 10k threads), and wake scoping: one
+    touch of one watched key wakes ~n/keys watchers, nobody else."""
+    from consul_tpu.server.rpc import ConnPool
+    from consul_tpu.utils import perf
+
+    keys = keys or max(8, n // 128)
+    stop = threading.Event()
+    before = _thread_census()
+    t0 = time.perf_counter()
+    herd = _start_pipelined_herd(follower, stop, n, keys,
+                                 sockets=sockets)
+    try:
+        def parked():
+            return perf.default.raw()["gauges"].get(
+                "rpc.blocking.parked", 0)
+
+        target = int(n * 0.95)
+        t_park = time.perf_counter()
+        while parked() < target and \
+                time.perf_counter() - t_park < park_timeout:
+            time.sleep(0.25)
+        peak = parked()
+        during = _thread_census()
+        print(f"  herd-scale: {peak}/{n} parked, threads "
+              f"{before['total']}->{during['total']} "
+              f"(mux_dedicated={during['mux_dedicated']})",
+              file=sys.stderr)
+        # wake exactly one key's cohort: responses == that cohort
+        # (scoped registry walk — nobody else wakes)
+        pool = ConnPool()
+        r0 = herd["responses"]()
+        pool.call(leader.rpc.addr, "KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": "herd/0",
+                                    "Value": b"wake"}})
+        cohort = herd["key0_cohort"]  # exact: sids restart per socket
+        t_wake = time.perf_counter()
+        woken = 0
+        while time.perf_counter() - t_wake < 20.0:
+            woken = herd["responses"]() - r0
+            if woken >= cohort:
+                break
+            time.sleep(0.1)
+        wake_s = time.perf_counter() - t_wake
+        pool.close()
+        return {
+            "watchers": n,
+            "keys": keys,
+            "client_sockets": sockets,
+            "parked_peak": peak,
+            "park_ratio": round(peak / n, 4),
+            "park_wall_s": round(time.perf_counter() - t0, 2),
+            "threads_before": before,
+            "threads_during": during,
+            "threads_added": during["total"] - before["total"],
+            "wake_cohort_expected": cohort,
+            "wake_cohort_woken": woken,
+            "wake_wall_s": round(wake_s, 3),
+            "gauges": perf.default.raw()["gauges"],
+        }
+    finally:
+        stop.set()
+        herd["close"]()
+        for t in herd["threads"]:
+            t.join(timeout=3.0)
+
+
 def _level_pass(leader, follower, concurrency, duration,
                 open_rps=None):
     """One concurrency level of the sustained ladder: `concurrency`
@@ -281,7 +491,12 @@ def _level_pass(leader, follower, concurrency, duration,
     (per_client_ops, latencies_with_stamps, errors, wall)."""
     from consul_tpu.server.rpc import ConnPool
 
-    pools = [ConnPool() for _ in range(concurrency)]
+    # one mux session per (client, server): a single-threaded
+    # closed-loop client never has two requests in flight, so the
+    # default mux_per_addr=2 just doubled the client-side reader
+    # threads (256 of them at C=64 on this 2-core host — measured as
+    # client overhead, not server throughput)
+    pools = [ConnPool(mux_per_addr=1) for _ in range(concurrency)]
     lat: list[list[tuple[float, float]]] = [
         [] for _ in range(concurrency)]
     errors = [0] * concurrency
@@ -416,6 +631,10 @@ def run_sustained(leader, follower, levels, duration,
                 },
                 "attribution": perf.stage_report(snap1, snap0, "rpc"),
                 "gauges": snap1["gauges"],
+                # thread-per-watcher/request regression canary: the
+                # reactor keeps mux_dedicated ~0 and total O(clients
+                # + worker pools), independent of the parked herd
+                "threads": _thread_census(),
             }
             out_levels.append(row)
             curve.append([concurrency, round(rps, 1),
@@ -471,15 +690,18 @@ def main() -> None:
 
     concurrency = flag("--concurrency", int)
     levels_arg = flag("--levels", str)
+    herd_n = flag("--herd", int)
     if concurrency is None and levels_arg is None:
         # sustained-only flags must not be silently swallowed by the
         # legacy workload below (a --out that never writes looks like
         # a recorded run that wasn't)
-        orphans = [n for n in ("--duration", "--open-loop", "--out")
+        orphans = [n for n in ("--duration", "--open-loop", "--out",
+                               "--herd")
                    if n in sys.argv]
         if orphans:
             print("usage: bench_kv.py --concurrency C [--levels a,b,c]"
-                  " [--duration S] [--open-loop RPS] [--out F] — "
+                  " [--duration S] [--open-loop RPS] [--herd N] "
+                  "[--out F] — "
                   f"{', '.join(orphans)} require(s) --concurrency or "
                   "--levels", file=sys.stderr)
             sys.exit(2)
@@ -492,10 +714,23 @@ def main() -> None:
             levels = sorted({max(1, concurrency // 4),
                              max(1, concurrency // 2), concurrency})
         out_path = flag("--out", str)
+        herd = dict(HERD)
+        if herd_n is not None and herd_n <= 64:
+            # small --herd N replaces the ladder's parked population
+            herd = {"threads": herd_n, "keys": max(4, herd_n // 2),
+                    "touch_interval_s": 0.25}
         servers, leader, follower = build_cluster()
         try:
             report = run_sustained(leader, follower, levels, duration,
-                                   open_rps=open_rps)
+                                   open_rps=open_rps, herd=herd)
+            if herd_n is not None and herd_n > 64:
+                # the blocking-watcher scale pass: measured AFTER the
+                # ladder so its background churn never pollutes the
+                # throughput rungs
+                print(f"herd-scale: parking {herd_n} watchers...",
+                      file=sys.stderr)
+                report["herd_scale"] = run_herd_scale(
+                    leader, follower, herd_n)
         finally:
             for s in servers:
                 s.shutdown()

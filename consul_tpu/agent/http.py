@@ -295,8 +295,11 @@ class HTTPApi:
 
         self._srv = _Server((bind, port), Handler)
         self.addr = "%s:%d" % self._srv.server_address
-        self._thread = threading.Thread(target=self._srv.serve_forever,
-                                        daemon=True, name="http-api")
+        # poll_interval bounds stop() latency (serve_forever's select
+        # timeout) — same teardown-cost rationale as the RPC listener
+        self._thread = threading.Thread(
+            target=lambda: self._srv.serve_forever(poll_interval=0.05),
+            daemon=True, name="http-api")
 
     def start(self) -> None:
         self._thread.start()
@@ -532,11 +535,17 @@ class HTTPApi:
             return StreamingBody(metrics_stream()), None
         if path == "/v1/agent/perf":
             # the serving-plane latency observatory (utils/perf.py):
-            # per-stage streaming histograms + queue gauges. Same ACL
-            # tier as trace/monitor: agent read. ?format=prometheus
-            # serves the native histogram exposition; JSON otherwise,
-            # with ?prefix= and ?min_count= filters. Validation BEFORE
-            # any work, like the trace endpoint's params.
+            # per-stage streaming histograms (incl. rpc.park_wait —
+            # the reactor's thread-free blocking-query parks) + queue
+            # gauges: rpc.blocking.parked[_continuations],
+            # rpc.mux.in_flight, and the worker-pool saturation pair
+            # rpc.workers.size / rpc.workers.queue_depth (the pool is
+            # a config knob, rpc_workers — this surface is how its
+            # sizing is judged instead of guessed). Same ACL tier as
+            # trace/monitor: agent read. ?format=prometheus serves the
+            # native histogram exposition; JSON otherwise, with
+            # ?prefix= and ?min_count= filters. Validation BEFORE any
+            # work, like the trace endpoint's params.
             rpc("Internal.AgentRead", {})
             fmt = q.get("format", "")
             if fmt not in ("", "json", "prometheus"):

@@ -256,6 +256,11 @@ class RuntimeConfig:
     rpc_rate_burst: int = 500
     # per-client-IP RPC connection cap (limits.rpc_max_conns_per_client)
     rpc_max_conns_per_client: int = 100
+    # RPC handler worker-pool size (the reactor's CPU-bound lane;
+    # blocking queries park as continuations and never hold a worker).
+    # Surfaced as rpc.workers.size / rpc.workers.queue_depth in
+    # /v1/agent/perf so saturation is observable rather than guessed.
+    rpc_workers: int = 32
     # per-client-IP HTTP connection cap (limits.http_max_conns_per_client)
     http_max_conns_per_client: int = 200
     # Non-voting read replica (reference read_replica, formerly

@@ -254,8 +254,14 @@ class UDPTransport(Transport):
 
         self._udp_thread = threading.Thread(
             target=self._udp_loop, name=f"udp-{port}", daemon=True)
+        # poll_interval bounds shutdown() latency (serve_forever's
+        # select timeout): the 0.5s default cost half a second PER
+        # TRANSPORT teardown — every server runs a LAN and usually a
+        # WAN transport, so a test suite tearing down hundreds of
+        # agents paid ~1s each
         self._tcp_thread = threading.Thread(
-            target=self._tcp.serve_forever, name=f"tcp-{port}", daemon=True)
+            target=lambda: self._tcp.serve_forever(poll_interval=0.05),
+            name=f"tcp-{port}", daemon=True)
 
     def set_handlers(self, on_packet: PacketHandler,
                      on_stream: StreamHandler) -> None:

@@ -350,15 +350,20 @@ def register_endpoints(srv) -> None:
 
     srv.rpc.async_handlers["KVS.Get"] = kv_get_consistent_async
 
-    # KV reads return PER-PREFIX indexes (kv_prefix_index): a watcher
-    # of one key/prefix re-blocks through writes elsewhere in the
-    # keyspace instead of waking its client (memdb radix subtree index)
+    # KV reads return PER-PREFIX indexes (kv_prefix_index) AND scope
+    # their watch registration by key/prefix (watch_key/watch_prefix →
+    # the store's WatchRegistry): a watcher of one key/prefix SLEEPS
+    # through writes elsewhere in the keyspace — it is never even
+    # woken to re-check, where the index-only scheme woke every kv
+    # watcher per table bump (memdb radix subtree index, now at the
+    # wakeup layer too)
     def kv_get(args):
         key = args.get("Key", "")
         require(authz(args).key_read(key), f"key read on {key!r}")
         return srv.blocking_query(args, ("kv",), lambda: {
             "Index": state.kv_key_index(key),
-            "Entries": [e_.to_dict()] if (e_ := state.kv_get(key)) else []})
+            "Entries": [e_.to_dict()] if (e_ := state.kv_get(key)) else []},
+            watch_key=key)
 
     def kv_list(args):
         prefix = args.get("Key", "")
@@ -366,7 +371,8 @@ def register_endpoints(srv) -> None:
         return srv.blocking_query(args, ("kv",), lambda: {
             "Index": state.kv_prefix_index(prefix),
             "Entries": [x.to_dict() for x in state.kv_list(prefix)
-                        if az.key_read(x.key)]})
+                        if az.key_read(x.key)]},
+            watch_prefix=prefix)
 
     def kv_keys(args):
         az = authz(args)
@@ -377,7 +383,8 @@ def register_endpoints(srv) -> None:
                      state.kv_keys(prefix,
                                    args.get("Seperator",
                                             args.get("Separator", "")))
-                     if az.key_read(k)]})
+                     if az.key_read(k)]},
+            watch_prefix=prefix)
 
     write("KVS.Apply", kv_apply)
     read("KVS.Get", kv_get)

@@ -13,11 +13,20 @@ agent/pool/conn.go:33-49). Tags served:
   RPC_TLS (0x02): TLS handshake, then the REAL tag inside.
   RPC_MUX (0x04): the workhorse — many concurrent logical streams on
       one conn, like the reference's yamux RPCMultiplexV2 sessions
-      (rpc.go:369-374): frames carry a stream id, each request runs in
-      its own handler thread, responses interleave out of order. A
-      thousand parked blocking queries cost one socket, not a
-      thousand (the round-1 one-req-per-conn scheme burned a socket
-      per watcher — VERDICT weak #4).
+      (rpc.go:369-374): frames carry a stream id, responses interleave
+      out of order. Plain-socket mux sessions are owned by a
+      selector-based REACTOR (``MuxReactor``): one event-loop thread
+      reads/decodes frames for every session, handler bodies run on a
+      fixed worker pool, and blocking queries park as CONTINUATIONS —
+      no thread held while waiting (``ParkRequest`` below; the old
+      design parked a dedicated thread per watcher and plateaued at
+      C=16, SERVE_r01). Egress is batched: responses append to a
+      per-session outbox and the reactor flushes whatever accumulated
+      with one ``sendmsg`` (writev) per tick. A thousand parked
+      blocking queries cost one socket AND zero threads. TLS-wrapped
+      mux sessions keep the legacy thread-per-session loop
+      (non-blocking SSL wants its own state machine; verify_incoming
+      clusters trade threads for it).
   RPC_SNAPSHOT (0x05): dedicated chunked snapshot stream
       (snapshot/snapshot.go:31; agent/pool/conn.go:40) — archives
       never squeeze through the 64MB frame cap.
@@ -27,11 +36,16 @@ Frames: 4-byte big-endian length + msgpack body. 64MB frame cap.
 
 from __future__ import annotations
 
+import contextvars
+import heapq
+import selectors
 import socket
 import socketserver
+import ssl
 import struct
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Optional
 
@@ -67,6 +81,106 @@ perf.default.gauge_fn("rpc.mux.in_flight",
 def _mux_flight(delta: int) -> None:
     with _MUX_FLIGHT_LOCK:
         _MUX_IN_FLIGHT[0] += delta
+
+
+#: process-wide parked CONTINUATIONS (thread-free blocking queries on
+#: the reactor path). Folded into the rpc.blocking.parked gauge by
+#: server.py next to the legacy thread-parked count, and exported on
+#: its own so the two park modes stay distinguishable.
+_PARKED_CONT = [0]
+_PARKED_CONT_LOCK = threading.Lock()
+perf.default.gauge_fn("rpc.blocking.parked_continuations",
+                      lambda: _PARKED_CONT[0])
+
+
+def _parked_cont(delta: int) -> None:
+    with _PARKED_CONT_LOCK:
+        _PARKED_CONT[0] += delta
+
+
+def parked_continuations() -> int:
+    return _PARKED_CONT[0]
+
+
+#: live RPCServer instances, for the process-wide worker-pool gauges
+#: (the bench cluster runs several servers in one process, and the
+#: perf registry is process-global — same aggregation rule as
+#: _MUX_IN_FLIGHT above)
+_RPC_SERVERS: "weakref.WeakSet[RPCServer]" = weakref.WeakSet()
+
+
+def _workers_size() -> float:
+    return float(sum(s._workers._max_workers for s in list(_RPC_SERVERS)))
+
+
+def _workers_queue_depth() -> float:
+    # _work_queue is ThreadPoolExecutor internals, but it is the only
+    # honest measure of dispatch backlog — the rpc.dispatch stage
+    # histogram shows the TIME cost, this gauge the instantaneous depth
+    return float(sum(s._workers._work_queue.qsize()
+                     for s in list(_RPC_SERVERS)))
+
+
+perf.default.gauge_fn("rpc.workers.size", _workers_size)
+perf.default.gauge_fn("rpc.workers.queue_depth", _workers_queue_depth)
+
+
+class ParkContext:
+    """Per-request park state, set by the reactor's worker wrapper:
+    its presence tells ``Server.blocking_query`` that raising
+    ``ParkRequest`` is allowed (the caller can park the request as a
+    continuation); ``deadline`` carries the query's ORIGINAL
+    MaxQueryTime deadline across continuation re-runs, so a query that
+    wakes and re-parks never restarts its clock. ``resumed`` marks a
+    continuation RE-RUN: the client sent one request, so rate limiting
+    charged its token at first dispatch — wakes must not drain the
+    bucket again (the legacy in-handler loop re-checked for free)."""
+
+    __slots__ = ("deadline", "resumed")
+
+    def __init__(self, deadline: Optional[float] = None,
+                 resumed: bool = False) -> None:
+        self.deadline = deadline
+        self.resumed = resumed
+
+
+_park_var: contextvars.ContextVar[Optional[ParkContext]] = \
+    contextvars.ContextVar("consul_tpu_rpc_park", default=None)
+
+
+def park_context() -> Optional[ParkContext]:
+    """The current request's park context (None outside the reactor's
+    park-capable dispatch — HTTP threads, one-shot conns, and the TLS
+    fallback keep the legacy block-a-thread path)."""
+    return _park_var.get()
+
+
+class ParkRequest(BaseException):
+    """Raised by ``Server.blocking_query`` INSTEAD of blocking when a
+    park context is present: the reactor layer catches it, registers a
+    one-shot watch with the state store's WatchRegistry, and frees the
+    worker thread. When the watch fires (or the deadline passes) the
+    whole request re-runs — blocking-query semantics are already
+    "re-run the query when the table moves", so the continuation is
+    simply the request itself.
+
+    Deliberately a BaseException: it must tunnel through every
+    ``except Exception`` between the endpoint and the dispatch layer
+    (handlers log-and-wrap unknown exceptions; a swallowed park would
+    turn a watch into an instant stale answer).
+
+    ``park(fire)`` registers `fire` with the store (returns None when
+    the watched index already moved — the caller re-runs immediately);
+    ``cancel(handle)`` drops a registered watch (deadline expiry /
+    client disconnect)."""
+
+    def __init__(self, deadline: float,
+                 park: Callable[[Callable[[], None]], Optional[int]],
+                 cancel: Callable[[int], None]) -> None:
+        super().__init__("blocking query parked")
+        self.deadline = deadline
+        self.park = park
+        self.cancel = cancel
 
 
 class RPCError(Exception):
@@ -169,10 +283,469 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
+#: per-session egress backlog cap: a reader this far behind is dead
+#: weight (same order as the frame cap — one maximal frame must fit)
+MAX_SESSION_BACKLOG = 64 * 1024 * 1024
+#: scatter-gather bounds per sendmsg flush (IOV_MAX safety + keep one
+#: slow session from monopolizing a reactor tick)
+_FLUSH_MAX_BUFS = 64
+_FLUSH_MAX_BYTES = 1 << 20
+
+
+class _MuxSession:
+    """One reactor-owned RPC_MUX session: read buffer, response
+    outbox, stream-cancel events, parked continuations, and the yamux
+    stream cap. ``lock`` guards every mutable field — producers
+    (workers, the group-commit batcher, stream threads) enqueue
+    responses concurrently with the reactor's flush."""
+
+    __slots__ = ("sock", "src", "ip", "reactor", "rbuf", "outbox",
+                 "out_bytes", "lock", "closed", "overflow",
+                 "write_armed", "sel_write", "in_flight", "cancels",
+                 "parked")
+
+    def __init__(self, sock: socket.socket, src: str, ip: str,
+                 reactor: "MuxReactor") -> None:
+        self.sock = sock
+        self.src = src
+        self.ip = ip
+        self.reactor = reactor
+        self.rbuf = bytearray()
+        # outbox entries: [frame_bytes, sent_offset, ledger, t_enqueue]
+        self.outbox: deque = deque()
+        self.out_bytes = 0
+        self.lock = threading.Lock()
+        self.closed = False
+        self.overflow = False
+        self.write_armed = False
+        self.sel_write = False  # reactor-thread-only selector state
+        self.in_flight = 0
+        self.cancels: dict[int, threading.Event] = {}
+        self.parked: dict[int, "_ParkedQuery"] = {}
+
+    def send_obj(self, obj: dict[str, Any],
+                 led: Optional[perf.Ledger] = None) -> None:
+        """Append one encoded response frame to the egress outbox
+        (msgpack pack happens HERE, on the producer's thread) and arm
+        the reactor's write interest. The actual socket write is the
+        reactor's batched sendmsg — producers never block on a slow
+        reader's socket buffer. The frame's ledger rides along: the
+        reactor records rpc.write (enqueue→flushed) and closes it when
+        the frame's last byte leaves."""
+        blob = msgpack.packb(obj, use_bin_type=True)
+        frame = struct.pack(">I", len(blob)) + blob
+        t_enq = time.perf_counter()
+        need_wake = False
+        drop = False
+        done = False
+        with self.lock:
+            if self.closed:
+                drop = True
+            elif not self.outbox and not self.write_armed:
+                # DIRECT-SEND fast path: the egress is idle, so try
+                # the (non-blocking) write right here instead of
+                # paying a wake round-trip through the reactor. Safe
+                # against the flush: every socket write happens under
+                # this lock; safe against close: `closed` flips under
+                # this lock BEFORE the fd closes. Under pressure the
+                # send comes up short and the remainder queues — the
+                # reactor's batched sendmsg takes over exactly when
+                # batching starts paying
+                sent = 0
+                try:
+                    sent = self.sock.send(frame)
+                except (BlockingIOError, ssl.SSLWantWriteError):
+                    sent = 0
+                except OSError:
+                    drop = True  # dying socket: reactor reaps on read
+                if not drop:
+                    if sent == len(frame):
+                        done = True
+                        if led is not None:
+                            perf.record(led, "rpc.write",
+                                        time.perf_counter() - t_enq,
+                                        off=t_enq - led.t0_pc)
+                    else:
+                        self.outbox.append([frame, sent, led, t_enq])
+                        self.out_bytes += len(frame)
+                        self.write_armed = True
+                        need_wake = True
+            else:
+                self.outbox.append([frame, 0, led, t_enq])
+                self.out_bytes += len(frame)
+                if self.out_bytes > MAX_SESSION_BACKLOG:
+                    # slow-reader shed: mark for the reactor to close
+                    # (selector surgery belongs to the reactor thread)
+                    self.overflow = True
+                need_wake = not self.write_armed
+                self.write_armed = True
+        if drop:
+            perf.abandon(led)
+            return
+        if done:
+            perf.close(led)
+            return
+        if need_wake or self.overflow:
+            self.reactor.request_write(self)
+
+    def complete(self, sid: int) -> None:
+        """Stream-count bookkeeping at request completion (response
+        enqueued, stream ended, or parked continuation dropped)."""
+        with self.lock:
+            self.in_flight -= 1
+        _mux_flight(-1)
+
+
+class _ParkedQuery:
+    """A blocking query parked as a continuation: everything needed to
+    re-run the request when its watch fires or its deadline passes,
+    plus the claim token that makes the three racing owners — watch
+    fire, deadline sweep, client disconnect — act EXACTLY once."""
+
+    __slots__ = ("server", "sess", "sid", "method", "args", "src",
+                 "led", "deadline", "t_park", "start", "handle",
+                 "cancel_cb", "_lock", "_claimed")
+
+    def __init__(self, server: "RPCServer", sess: _MuxSession, sid: int,
+                 method: str, args: dict, src: str,
+                 led: Optional[perf.Ledger], deadline: float,
+                 t_park: float, start: float,
+                 cancel_cb: Callable[[int], None]) -> None:
+        self.server = server
+        self.sess = sess
+        self.sid = sid
+        self.method = method
+        self.args = args
+        self.src = src
+        self.led = led
+        self.deadline = deadline
+        self.t_park = t_park  # perf_counter at park (park_wait stage)
+        self.start = start  # telemetry clock at FIRST dispatch
+        self.handle: Optional[int] = None
+        self.cancel_cb = cancel_cb
+        self._lock = threading.Lock()
+        self._claimed = False
+
+    def claim(self) -> bool:
+        """True exactly once, for whichever owner acts on this park."""
+        with self._lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def claimed(self) -> bool:
+        with self._lock:
+            return self._claimed
+
+    def cancel_watch(self) -> None:
+        """Idempotent store-registry cleanup (fired one-shot entries
+        are already gone; unregister tolerates that)."""
+        h = self.handle
+        if h is not None:
+            try:
+                self.cancel_cb(h)
+            except Exception:  # noqa: BLE001 — cleanup never raises
+                pass
+
+    def fire(self) -> None:
+        """The store WatchRegistry callback (runs on the WRITER's
+        thread, under the store lock — must stay nonblocking): claim
+        and resubmit the continuation to the worker pool."""
+        if self.claim():
+            self.server._resubmit_parked(self)
+
+
+class MuxReactor:
+    """The mux port's event loop: one thread, every plain-socket mux
+    session. Owns all selector surgery; other threads communicate via
+    thread-safe deques + the wakeup socketpair (the classic self-pipe).
+    Also owns the parked-query deadline heap — the select timeout
+    shrinks to the next deadline, so expiry costs no dedicated timer
+    thread."""
+
+    def __init__(self, server: "RPCServer") -> None:
+        self.server = server
+        self.log = server.log
+        self._sel = selectors.DefaultSelector()
+        self._rsock, self._wsock = socket.socketpair()
+        self._rsock.setblocking(False)
+        self._wsock.setblocking(False)
+        self._sel.register(self._rsock, selectors.EVENT_READ, None)
+        self._sessions: set[_MuxSession] = set()
+        self._pending_adopt: deque = deque()
+        self._pending_write: deque = deque()
+        self._deadlines: list = []  # heap of (deadline, seq, parked)
+        self._dl_lock = threading.Lock()
+        self._dl_seq = 0
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"rpc-reactor-{id(server):x}")
+        self._thread.start()
+
+    # ---- cross-thread entry points (all nonblocking) ----
+
+    def wake(self) -> None:
+        try:
+            self._wsock.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass  # a pending byte already wakes the loop
+
+    def adopt(self, sock: socket.socket, src: str, ip: str) -> None:
+        """Take ownership of a freshly-tagged mux socket (called from
+        the accept handler's thread)."""
+        sock.setblocking(False)
+        self._pending_adopt.append(_MuxSession(sock, src, ip, self))
+        self.wake()
+
+    def request_write(self, sess: _MuxSession) -> None:
+        self._pending_write.append(sess)
+        self.wake()
+
+    def add_deadline(self, parked: _ParkedQuery) -> None:
+        with self._dl_lock:
+            self._dl_seq += 1
+            heapq.heappush(self._deadlines,
+                           (parked.deadline, self._dl_seq, parked))
+        self.wake()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self.wake()
+        self._thread.join(timeout=3.0)
+
+    # ---- the loop (everything below runs on the reactor thread) ----
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop:
+                events = self._sel.select(self._next_timeout())
+                try:
+                    while True:
+                        self._rsock.recv(4096)
+                except (BlockingIOError, OSError):
+                    pass
+                while self._pending_adopt:
+                    sess = self._pending_adopt.popleft()
+                    self._sessions.add(sess)
+                    try:
+                        self._sel.register(sess.sock,
+                                           selectors.EVENT_READ, sess)
+                    except (ValueError, OSError):
+                        self._close_session(sess)
+                while self._pending_write:
+                    # OPPORTUNISTIC flush: the socket is almost always
+                    # writable, so flush right now instead of arming
+                    # write interest and paying a second select
+                    # round-trip per response (measured ~5ms of
+                    # rpc.write latency under load); _flush arms
+                    # EVENT_WRITE only for the partial-send remainder
+                    self._flush(self._pending_write.popleft())
+                for key, mask in events:
+                    sess = key.data
+                    if sess is None:
+                        continue  # the wakeup pipe
+                    if mask & selectors.EVENT_READ:
+                        self._readable(sess)
+                    if mask & selectors.EVENT_WRITE and not sess.closed:
+                        self._flush(sess)
+                self._fire_deadlines()
+        except Exception as e:  # noqa: BLE001 — must never die silently
+            if not self._stop:
+                self.log.warning("mux reactor crashed: %s", e,
+                                 exc_info=True)
+        finally:
+            for sess in list(self._sessions):
+                self._close_session(sess)
+            try:
+                self._sel.close()
+            except OSError:
+                pass
+            for s in (self._rsock, self._wsock):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _next_timeout(self) -> float:
+        with self._dl_lock:
+            dl = self._deadlines[0][0] if self._deadlines else None
+        if dl is None:
+            return 0.5
+        return min(max(dl - time.monotonic(), 0.0), 0.5)
+
+    def _fire_deadlines(self) -> None:
+        now = time.monotonic()
+        while True:
+            with self._dl_lock:
+                if not self._deadlines or self._deadlines[0][0] > now:
+                    return
+                _, _, parked = heapq.heappop(self._deadlines)
+            # lazy deletion: claimed entries (woken/dropped) are inert
+            if parked.claim():
+                parked.cancel_watch()
+                self.server._resubmit_parked(parked)
+
+    def _set_write_interest(self, sess: _MuxSession,
+                            want: bool) -> None:
+        if sess.sel_write == want:
+            return
+        sess.sel_write = want
+        events = selectors.EVENT_READ
+        if want:
+            events |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(sess.sock, events, sess)
+        except (KeyError, ValueError, OSError):
+            pass  # raced a close
+
+    def _readable(self, sess: _MuxSession) -> None:
+        try:
+            while True:
+                chunk = sess.sock.recv(1 << 16)
+                if not chunk:
+                    self._close_session(sess)
+                    return
+                sess.rbuf += chunk
+                if len(chunk) < (1 << 16):
+                    break
+        except (BlockingIOError, ssl.SSLWantReadError):
+            pass
+        except OSError:
+            self._close_session(sess)
+            return
+        rbuf = sess.rbuf
+        while True:
+            if len(rbuf) < 4:
+                return
+            ln = int.from_bytes(rbuf[:4], "big")
+            if ln > MAX_FRAME:
+                self.log.warning("mux frame too large from %s: %d",
+                                 sess.src, ln)
+                self._close_session(sess)
+                return
+            if len(rbuf) < 4 + ln:
+                return
+            body = bytes(rbuf[4:4 + ln])
+            del rbuf[:4 + ln]
+            # rpc.read on the reactor = the frame's DECODE service
+            # time (socket reads are shared across frames in a tick,
+            # so per-frame byte-arrival spans are not attributable)
+            t0 = time.perf_counter()
+            try:
+                req = msgpack.unpackb(body, raw=False)
+            except Exception:  # noqa: BLE001 — protocol violation
+                self._close_session(sess)
+                return
+            read_s = time.perf_counter() - t0
+            try:
+                self.server._dispatch_mux(sess, req, read_s)
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("mux dispatch failed: %s", e)
+
+    def _flush(self, sess: _MuxSession) -> None:
+        """Batched egress: ONE sendmsg (writev) covering whatever
+        responses accumulated since the last tick. Fully-flushed
+        frames record their rpc.write stage (enqueue→last byte out)
+        and close their ledgers — e2e honestly includes egress
+        queueing."""
+        if sess.closed:
+            return
+        if sess.overflow:
+            self.log.warning(
+                "closing mux session %s: egress backlog over %dMB "
+                "(reader too slow)", sess.src,
+                MAX_SESSION_BACKLOG >> 20)
+            self._close_session(sess)
+            return
+        with sess.lock:
+            bufs = []
+            total = 0
+            for ent in sess.outbox:
+                mv = memoryview(ent[0])[ent[1]:]
+                bufs.append(mv)
+                total += len(mv)
+                if len(bufs) >= _FLUSH_MAX_BUFS \
+                        or total >= _FLUSH_MAX_BYTES:
+                    break
+            if not bufs:
+                sess.write_armed = False
+                self._set_write_interest(sess, False)
+                return
+            try:
+                n = sess.sock.sendmsg(bufs)
+            except (BlockingIOError, ssl.SSLWantWriteError):
+                self._set_write_interest(sess, True)
+                return
+            except OSError:
+                pass  # close below, outside the flush bookkeeping
+            else:
+                now = time.perf_counter()
+                while n > 0 and sess.outbox:
+                    ent = sess.outbox[0]
+                    remaining = len(ent[0]) - ent[1]
+                    if n >= remaining:
+                        n -= remaining
+                        sess.outbox.popleft()
+                        sess.out_bytes -= len(ent[0])
+                        led = ent[2]
+                        if led is not None:
+                            perf.record(led, "rpc.write", now - ent[3],
+                                        off=ent[3] - led.t0_pc)
+                            perf.close(led)
+                    else:
+                        ent[1] += n
+                        n = 0
+                if sess.outbox:
+                    # partial send (or more than one flush window):
+                    # let the selector call us back when writable
+                    self._set_write_interest(sess, True)
+                else:
+                    sess.write_armed = False
+                    self._set_write_interest(sess, False)
+                return
+        self._close_session(sess)
+
+    def _close_session(self, sess: _MuxSession) -> None:
+        """Exactly-once teardown: EOF, error, overflow, or shutdown.
+        Streams get their cancel events, parked continuations are
+        claimed and dropped (the in-flight gauge returns to zero —
+        pinned by tests), undelivered ledgers are abandoned."""
+        with sess.lock:
+            if sess.closed:
+                return
+            sess.closed = True
+            parked = list(sess.parked.values())
+            sess.parked.clear()
+            cancels = list(sess.cancels.values())
+            outbox = list(sess.outbox)
+            sess.outbox.clear()
+            sess.out_bytes = 0
+        self._sessions.discard(sess)
+        try:
+            self._sel.unregister(sess.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            sess.sock.close()
+        except OSError:
+            pass
+        for ev in cancels:
+            ev.set()  # conn gone: unblock every streaming handler
+        for p in parked:
+            if p.claim():
+                self.server._drop_parked(p)
+        for ent in outbox:
+            perf.abandon(ent[2])
+        self.server._release_conn(sess.sock, sess.ip)
+
+
 class RPCServer:
     """The server side of the multiplexed port."""
 
-    def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0,
+                 workers: int = 32) -> None:
         self.log = log.named("rpc.server")
         self.metrics = telemetry.default
         self._rpc_handler: Optional[Callable[[str, dict, str], Any]] = None
@@ -248,7 +821,16 @@ class RPCServer:
                     elif tag[0] == RPC_RAFT:
                         outer._serve_raft(sock, src)
                     elif tag[0] == RPC_MUX:
-                        outer._serve_mux(sock, src)
+                        if isinstance(sock, ssl.SSLSocket):
+                            # TLS fallback: thread-per-session loop
+                            # (non-blocking SSL needs its own
+                            # want-read/want-write state machine)
+                            outer._serve_mux(sock, src)
+                        else:
+                            # hand the socket to the reactor and
+                            # return this accept thread to the pool —
+                            # the session lives on, event-driven
+                            outer._adopt_mux(sock, self.client_address)
                     elif tag[0] == RPC_SNAPSHOT:
                         outer._serve_snapshot(sock, src)
                     elif tag[0] == RPC_GOSSIP:
@@ -282,17 +864,43 @@ class RPCServer:
         self._conns_lock = threading.Lock()
         from concurrent.futures import ThreadPoolExecutor
 
-        # shared pool for NON-blocking mux requests (blocking queries
-        # spawn their own threads — they'd starve a fixed pool)
+        # the shared handler pool: CPU-bound request bodies run here.
+        # Blocking queries ride it too — they park as CONTINUATIONS
+        # (ParkRequest) instead of holding a worker, so the pool no
+        # longer starves under a watcher herd. Size is a constructor/
+        # config knob (config.rpc_workers) surfaced as the
+        # rpc.workers.size / rpc.workers.queue_depth gauges in
+        # /v1/agent/perf, so saturation is observable, not guessed.
+        self.workers = max(1, int(workers))
         self._workers = ThreadPoolExecutor(
-            max_workers=32, thread_name_prefix="rpc-worker")
-        # method → fn(args, src, respond) -> bool; see _mux_loop
+            max_workers=self.workers, thread_name_prefix="rpc-worker")
+        # method → fn(args, src, respond) -> bool; see _dispatch_mux
         self.async_handlers: dict[str, Callable] = {}
+        # set by Server: (method, args) → True when the handler is a
+        # cheap read that provably cannot block (no forwarding, no
+        # consistency barrier — a blocking query PARKS, which is
+        # nonblocking) and may run INLINE on the reactor thread. Under
+        # the GIL a pure-Python handler body parallelizes with nothing
+        # anyway, so inlining the hot reads trades zero parallelism
+        # for two fewer thread handoffs per request
+        self.inline_capable: Optional[Callable[[str, dict], bool]] = None
+        # set by Server: args → True when a blocking query will be
+        # served from LOCAL state (stale, or we are the leader) and can
+        # therefore park as a continuation; False means the request
+        # will FORWARD and block inside pool.call — those still get a
+        # dedicated thread so they cannot starve the worker pool
+        self.park_capable: Optional[Callable[[dict], bool]] = None
+        self._reactor = MuxReactor(self)
         self._srv = _Server((bind_addr, port), _Handler)
         self.addr = "%s:%d" % self._srv.server_address
+        # poll_interval bounds shutdown() latency (serve_forever's
+        # select timeout): the default 0.5s costs a quarter second per
+        # server teardown, which a test suite tearing down hundreds of
+        # servers pays in full
         self._thread = threading.Thread(
-            target=self._srv.serve_forever, daemon=True,
-            name=f"rpc-{self.addr}")
+            target=lambda: self._srv.serve_forever(poll_interval=0.05),
+            daemon=True, name=f"rpc-{self.addr}")
+        _RPC_SERVERS.add(self)
 
     def start(self, rpc_handler: Callable[[str, dict, str], Any],
               raft_handler: Optional[Callable[[str, str, dict], dict]] = None
@@ -304,6 +912,9 @@ class RPCServer:
     def shutdown(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+        # reactor first: it drops parked continuations and abandons
+        # undelivered ledgers before the sockets get yanked
+        self._reactor.shutdown()
         self._workers.shutdown(wait=False, cancel_futures=True)
         with self._conns_lock:
             conns, self._conns = set(self._conns), set()
@@ -344,6 +955,367 @@ class RPCServer:
                 perf.close(led)
                 self.metrics.measure_hist(
                     "rpc.request", start, {"method": method})
+
+    # ------------------------------------------------ reactor mux path
+
+    def _adopt_mux(self, sock: socket.socket,
+                   client_address: tuple) -> None:
+        """Transfer a tagged mux socket from its accept thread to the
+        reactor: detach the fd into a fresh socket object (socketserver
+        may close the original wrapper after handle() returns) and
+        re-take the per-IP conn accounting for the session's lifetime
+        (the accept thread's finally releases its own count)."""
+        ip = client_address[0]
+        src = f"{client_address[0]}:{client_address[1]}"
+        new = socket.socket(fileno=sock.detach())
+        with self._conns_lock:
+            self._conns.add(new)
+            self._conns_by_ip[ip] = self._conns_by_ip.get(ip, 0) + 1
+        self._reactor.adopt(new, src, ip)
+
+    def _release_conn(self, sock: socket.socket, ip: str) -> None:
+        """Session teardown's half of the _adopt_mux accounting."""
+        with self._conns_lock:
+            self._conns.discard(sock)
+            left = self._conns_by_ip.get(ip, 1) - 1
+            if left <= 0:
+                self._conns_by_ip.pop(ip, None)
+            else:
+                self._conns_by_ip[ip] = left
+
+    def _dispatch_mux(self, sess: _MuxSession, req: dict,
+                      read_s: float) -> None:
+        """One decoded mux frame, on the REACTOR thread — must stay
+        quick. Cancels and stream starts are handled here; async fast
+        paths (validate-and-enqueue handlers like the KV write's
+        group-commit ride) run INLINE — their commit wait costs no
+        thread and their validation is microseconds; everything else
+        goes to the worker pool, where blocking queries park as
+        continuations instead of holding the worker."""
+        sid = req.get("sid", 0)
+        if req.get("cancel"):
+            with sess.lock:
+                ev = sess.cancels.get(sid)
+            if ev is not None:
+                ev.set()
+            return
+        method = req.get("method", "")
+        with sess.lock:
+            over = sess.in_flight >= MAX_MUX_STREAMS
+            if not over:
+                sess.in_flight += 1
+        if over:
+            # unauthenticated resource exhaustion guard: one conn must
+            # not park unbounded streams (yamux caps per session the
+            # same way) — parked continuations count too
+            sess.send_obj({"sid": sid,
+                           "error": "too many concurrent streams"})
+            return
+        _mux_flight(+1)
+        if method in self.stream_handlers:
+            self._run_stream_reactor(sess, sid, method,
+                                     req.get("args") or {})
+            return
+        req_args = req.get("args") or {}
+        led = perf.ledger("rpc", read_s=read_s)
+        afn = self.async_handlers.get(method)
+        if afn is not None:
+            if self._dispatch_async(sess, sid, method, req_args, afn,
+                                    led):
+                return
+            if led is not None:
+                # async handler declined → pool path: restart the
+                # dispatch clock (the queue wait starts now)
+                led.mark = time.perf_counter()
+        inline = self.inline_capable
+        if inline is not None:
+            try:
+                ok = inline(method, req_args)
+            except Exception:  # noqa: BLE001 — predicate never kills
+                ok = False
+            if ok:
+                # hot-read fast path: handler runs right here on the
+                # reactor (blocking queries park via ParkRequest —
+                # registration is nonblocking; continuations re-run on
+                # the pool). The predicate guarantees no forwarding
+                # and no consistency barrier
+                self._run_mux_request(sess, sid, method, req_args,
+                                      sess.src, led)
+                return
+        blocking = req_args.get("MinQueryIndex") \
+            or req_args.get("MaxQueryTime")
+        if blocking and self.park_capable is not None \
+                and not self.park_capable(req_args):
+            # this blocking query will FORWARD (non-stale on a
+            # follower): it blocks inside pool.call, not on the local
+            # store, so a continuation can't free its thread — give it
+            # a dedicated one rather than a pool slot it would hold
+            # for up to MaxQueryTime
+            threading.Thread(
+                target=self._run_mux_request,
+                args=(sess, sid, method, req_args, sess.src, led),
+                kwargs={"park": False},
+                daemon=True, name=f"mux-{sess.src}-{sid}").start()
+            return
+        try:
+            self._workers.submit(self._run_mux_request, sess, sid,
+                                 method, req_args, sess.src, led)
+        except RuntimeError:  # pool shut down mid-dispatch
+            sess.complete(sid)
+
+    def _dispatch_async(self, sess: _MuxSession, sid: int, method: str,
+                        req_args: dict, afn: Callable,
+                        led: Optional[perf.Ledger]) -> bool:
+        """The async fast path on the reactor thread. Returns True
+        when the handler accepted the request (respond() owns the
+        reply + bookkeeping)."""
+        start = telemetry.time_now()
+
+        def respond(result, sid=sid, method=method, start=start,
+                    led=led, sess=sess):
+            # runs on whichever thread completes the commit (the
+            # group-commit batcher, the verify gate, or inline here).
+            # The reply is ENQUEUED, never written synchronously — the
+            # completer can't stall behind one client's socket buffer,
+            # and the reactor's next flush batches it with neighbors.
+            if led is not None:
+                # handler-end (led.mark) → here: the thread-free
+                # group-commit wait. mark < 0 means the reactor hasn't
+                # published the handler record yet (an inline
+                # completion can get here first) — wait, bounded, so
+                # commit_wait never absorbs the handler interval
+                m = led.mark
+                for _ in range(100):
+                    if m >= 0.0:
+                        break
+                    time.sleep(0)
+                    m = led.mark
+                if m >= 0.0:
+                    perf.record(led, "rpc.commit_wait",
+                                max(0.0, time.perf_counter() - m),
+                                off=m - led.t0_pc)
+            if isinstance(result, RPCError):
+                obj = {"sid": sid, "error": str(result)}
+            elif isinstance(result, Exception):
+                self.log.warning("rpc %s failed: %s", method, result)
+                obj = {"sid": sid, "error": f"internal: {result}"}
+            else:
+                obj = {"sid": sid, "result": result}
+            sess.send_obj(obj, led=led)
+            sess.complete(sid)
+            self.metrics.measure_hist("rpc.request", start,
+                                      {"method": method})
+
+        try:
+            t_h = time.perf_counter()
+            if led is not None:
+                # sentinel: handler end not yet published — respond
+                # (possibly already racing on a completer thread)
+                # waits for a real mark
+                led.mark = -1.0
+            handled = afn(req_args, sess.src, respond)
+        except Exception as e:  # noqa: BLE001 — validation
+            if led is not None:
+                end_h = time.perf_counter()
+                perf.record(led, "rpc.handler", end_h - t_h,
+                            off=t_h - led.t0_pc)
+                led.mark = end_h
+            respond(e if isinstance(e, RPCError)
+                    else RPCError(f"internal: {e}"))
+            return True
+        if handled and led is not None:
+            # inline validation+enqueue IS the handler stage on this
+            # path. Record BEFORE publishing the mark (same GIL
+            # visibility argument as the threaded path had)
+            end_h = time.perf_counter()
+            perf.record(led, "rpc.handler", end_h - t_h,
+                        off=t_h - led.t0_pc)
+            led.mark = end_h
+        return bool(handled)
+
+    def _run_mux_request(self, sess: _MuxSession, sid: int, method: str,
+                         args: dict, src: str,
+                         led: Optional[perf.Ledger], park: bool = True,
+                         deadline: Optional[float] = None,
+                         t_park: Optional[float] = None,
+                         start: Optional[float] = None) -> None:
+        """One handler run on a worker (or dedicated) thread. First
+        runs record their queue wait as rpc.dispatch; continuation
+        re-runs record the parked interval as rpc.park_wait. A
+        ParkRequest escaping the handler parks the request instead of
+        completing it — the thread returns to the pool."""
+        if start is None:
+            start = telemetry.time_now()
+        now = time.perf_counter()
+        if led is not None:
+            if t_park is not None:
+                perf.record(led, "rpc.park_wait", now - t_park,
+                            off=t_park - led.t0_pc)
+            else:
+                perf.record(led, "rpc.dispatch", now - led.mark,
+                            off=led.mark - led.t0_pc)
+        ptok = _park_var.set(
+            ParkContext(deadline, resumed=t_park is not None)) \
+            if park else None
+        tok = perf.attach(led)
+        if led is not None:
+            # the handler stage is timed externally (the park split
+            # needs its end even when ParkRequest unwinds), so nest
+            # inner stages (store.read) by hand — depth-0 disjointness
+            # is the ledger's Σstages ≤ e2e invariant
+            led.depth += 1
+        t_h = time.perf_counter()
+        try:
+            result = self._rpc_handler(method, args, src)
+            obj = {"sid": sid, "result": result}
+        except ParkRequest as p:
+            end_h = time.perf_counter()
+            if led is not None:
+                led.depth -= 1
+                perf.record(led, "rpc.handler", end_h - t_h,
+                            off=t_h - led.t0_pc)
+            perf.detach(tok)
+            if ptok is not None:
+                _park_var.reset(ptok)
+            self._park_query(sess, sid, method, args, src, led, p,
+                             end_h, start)
+            return
+        except RPCError as e:
+            obj = {"sid": sid, "error": str(e)}
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("rpc %s failed: %s", method, e)
+            obj = {"sid": sid, "error": f"internal: {e}"}
+        end_h = time.perf_counter()
+        if led is not None:
+            led.depth -= 1
+            perf.record(led, "rpc.handler", end_h - t_h,
+                        off=t_h - led.t0_pc)
+        perf.detach(tok)
+        if ptok is not None:
+            _park_var.reset(ptok)
+        sess.send_obj(obj, led=led)
+        sess.complete(sid)
+        self.metrics.measure_hist("rpc.request", start,
+                                  {"method": method})
+
+    def _park_query(self, sess: _MuxSession, sid: int, method: str,
+                    args: dict, src: str, led: Optional[perf.Ledger],
+                    preq: ParkRequest, t_park: float,
+                    start: float) -> None:
+        """Park one blocking query as a continuation: register the
+        re-run with the store's WatchRegistry and free the thread."""
+        parked = _ParkedQuery(self, sess, sid, method, args, src, led,
+                              preq.deadline, t_park, start, preq.cancel)
+        with sess.lock:
+            dead = sess.closed
+            if not dead:
+                sess.parked[sid] = parked
+        if dead:
+            # the client vanished while the handler ran: drop, once
+            perf.abandon(led)
+            sess.complete(sid)
+            return
+        _parked_cont(+1)
+        handle = preq.park(parked.fire)
+        if handle is None:
+            # a commit landed between the handler's read and the park
+            # registration — re-run immediately instead of sleeping on
+            # a watch that already fired
+            if parked.claim():
+                self._resubmit_parked(parked)
+            return
+        parked.handle = handle
+        if parked.claimed():
+            # disconnect raced the registration: the close path saw
+            # handle=None and couldn't cancel — do it here
+            parked.cancel_watch()
+            return
+        self._reactor.add_deadline(parked)
+
+    def _resubmit_parked(self, parked: _ParkedQuery) -> None:
+        """A claimed park re-enters the worker pool (watch fired or
+        deadline passed — blocking_query's own remaining<=0 check
+        turns the latter into the final stale answer). park_capable is
+        RE-CHECKED: a query parked on a leader that has since lost
+        leadership would re-run into _forward_to_leader and block a
+        pool worker for minutes — route it to a dedicated thread, the
+        same escape hatch first dispatch uses."""
+        sess = parked.sess
+        with sess.lock:
+            sess.parked.pop(parked.sid, None)
+        _parked_cont(-1)
+        if self.park_capable is not None \
+                and not self.park_capable(parked.args):
+            # park=False: the re-run blocks legacy-style inside the
+            # forward (the new leader re-runs the full MaxQueryTime,
+            # as any forwarded blocking query does); t_park still
+            # attributes the parked interval
+            threading.Thread(
+                target=self._run_mux_request,
+                args=(sess, parked.sid, parked.method, parked.args,
+                      parked.src, parked.led, False, None,
+                      parked.t_park, parked.start),
+                daemon=True,
+                name=f"mux-{parked.src}-{parked.sid}").start()
+            return
+        try:
+            self._workers.submit(
+                self._run_mux_request, sess, parked.sid, parked.method,
+                parked.args, parked.src, parked.led, True,
+                parked.deadline, parked.t_park, parked.start)
+        except RuntimeError:  # pool shut down
+            sess.complete(parked.sid)
+
+    def _drop_parked(self, parked: _ParkedQuery) -> None:
+        """Mid-park client disconnect: cancel the store watch, release
+        the stream slot, abandon the ledger. The caller holds the
+        claim, so this runs exactly once per park."""
+        parked.cancel_watch()
+        _parked_cont(-1)
+        perf.abandon(parked.led)
+        parked.sess.complete(parked.sid)
+
+    def _run_stream_reactor(self, sess: _MuxSession, sid: int,
+                            method: str, args: dict) -> None:
+        """One server-streaming call on a reactor session: the handler
+        keeps its dedicated thread (push loops are long-lived and few
+        relative to watchers), but every pushed frame rides the
+        batched egress."""
+        cancel = threading.Event()
+        with sess.lock:
+            dead = sess.closed
+            if not dead:
+                sess.cancels[sid] = cancel
+        if dead:
+            sess.complete(sid)
+            return
+
+        def push(payload: Any) -> bool:
+            """False once the stream should stop (cancel/conn gone)."""
+            if cancel.is_set() or sess.closed:
+                return False
+            sess.send_obj({"sid": sid, "more": True, "event": payload})
+            return not (sess.closed or cancel.is_set())
+
+        def run() -> None:
+            fn = self.stream_handlers[method]
+            try:
+                fn(args, sess.src, push, cancel)
+                sess.send_obj({"sid": sid, "result": True})
+            except RPCError as e:
+                sess.send_obj({"sid": sid, "error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("stream %s failed: %s", method, e)
+                sess.send_obj({"sid": sid, "error": f"internal: {e}"})
+            finally:
+                with sess.lock:
+                    sess.cancels.pop(sid, None)
+                sess.complete(sid)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"mux-stream-{sess.src}-{sid}").start()
+
+    # ------------------------------- threaded mux path (TLS fallback)
 
     def _serve_mux(self, sock: socket.socket, src: str) -> None:
         """Yamux-session equivalent: every request frame ({sid, method,

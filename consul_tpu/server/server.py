@@ -24,7 +24,9 @@ from consul_tpu.raft import RaftNode
 from consul_tpu.raft.raft import NotLeader
 from consul_tpu.raft.storage import RaftStorage
 from consul_tpu.server.endpoints import register_endpoints
-from consul_tpu.server.rpc import (ConnPool, PooledRaftTransport, RPCError,
+from consul_tpu.server import rpc as rpc_mod
+from consul_tpu.server.rpc import (ConnPool, ParkRequest,
+                                   PooledRaftTransport, RPCError,
                                    RPCServer)
 from consul_tpu.state import FSM, MessageType
 from consul_tpu.state.fsm import encode_command
@@ -42,14 +44,18 @@ class NoLeaderError(RPCError):
     pass
 
 
-#: process-wide parked blocking queries (the long-poll herd), a
-#: counter polled by the perf registry — own tiny lock, see
-#: rpc._MUX_IN_FLIGHT for why (`lst[0] += 1` is not atomic and a
-#: gauge never self-corrects a lost update; the registry lock stays
-#: off the hot path)
+#: process-wide THREAD-parked blocking queries (HTTP threads, one-shot
+#: conns, the TLS mux fallback, forwarded queries), a counter polled
+#: by the perf registry — own tiny lock, see rpc._MUX_IN_FLIGHT for
+#: why (`lst[0] += 1` is not atomic and a gauge never self-corrects a
+#: lost update; the registry lock stays off the hot path). The
+#: rpc.blocking.parked gauge is the TOTAL parked herd: thread-parked
+#: plus the reactor's thread-free continuations.
 _PARKED = [0]
 _PARKED_LOCK = threading.Lock()
-perf.default.gauge_fn("rpc.blocking.parked", lambda: _PARKED[0])
+perf.default.gauge_fn(
+    "rpc.blocking.parked",
+    lambda: _PARKED[0] + rpc_mod.parked_continuations())
 
 
 def _parked(delta: int) -> None:
@@ -294,8 +300,23 @@ class Server:
 
         # RPC port (serves consul RPC + raft)
         self.rpc = RPCServer(rpc_bind or config.bind_addr,
-                             config.port("server"))
+                             config.port("server"),
+                             workers=config.rpc_workers)
         self.rpc.max_conns_per_ip = config.rpc_max_conns_per_client
+        # a blocking query can park as a thread-free continuation only
+        # when it is served from LOCAL state: stale reads anywhere,
+        # anything on the leader — and never a cross-DC query, which
+        # blocks inside _forward_dc regardless of staleness. Anything
+        # that will forward gets a dedicated thread instead of a pool
+        # slot it would hold for up to MaxQueryTime
+        def _park_capable(args):
+            dc = args.get("Datacenter")
+            if dc and dc != self.config.datacenter:
+                return False
+            return bool(args.get("AllowStale")) or self.is_leader()
+
+        self.rpc.park_capable = _park_capable
+        self.rpc.inline_capable = self._inline_capable
         self.pool = ConnPool()
         # per-(area, dc) server tracking with failover + rebalance
         # (agent/router; WAN managers feed _forward_dc)
@@ -824,8 +845,17 @@ class Server:
         are limited; the agent's own control loops (anti-entropy, DNS,
         reconcile) must never starve. Updates to the rate-limit config
         entry ITSELF are exempt — otherwise an exhausted write budget
-        locks the operator out of the one knob that could fix it."""
+        locks the operator out of the one knob that could fix it.
+        Continuation RE-RUNS are exempt too: the client sent exactly
+        one request, charged at first dispatch — a watch wake must not
+        consume a second token (a registration burst waking N parked
+        watchers would otherwise drain the bucket against real
+        traffic, and long-polls would start failing with rate-limit
+        errors the same workload never produced pre-reactor)."""
         if src == "local":
+            return
+        pc = rpc_mod.park_context()
+        if pc is not None and pc.resumed:
             return
         if method == "ConfigEntry.Apply" and args is not None and \
                 (args.get("Entry") or {}).get("Kind") \
@@ -954,29 +984,77 @@ class Server:
             time.sleep(min(0.1, max(0.0, deadline - time.monotonic())))
         raise NoLeaderError(f"failed to reach leader: {last}")
 
+    #: RPC reads cheap and provably nonblocking enough to run INLINE
+    #: on the reactor thread (server/rpc.py inline_capable): pure
+    #: local-store lookups on the serving hot path. A blocking query
+    #: among these still qualifies — it PARKS (nonblocking
+    #: registration) rather than waiting. Anything that can forward,
+    #: take the verify-gate barrier, or walk a large join stays on the
+    #: worker pool.
+    INLINE_RPC_READS = frozenset((
+        "KVS.Get", "KVS.List", "KVS.ListKeys",
+        "Status.Ping", "Status.Leader", "Status.Peers",
+        "Session.Get", "Session.List",
+    ))
+
+    def _inline_capable(self, method: str, args: dict) -> bool:
+        if method not in self.INLINE_RPC_READS:
+            return False
+        dc = args.get("Datacenter")
+        if dc and dc != self.config.datacenter:
+            return False  # cross-DC: forwards
+        if args.get("RequireConsistent"):
+            return False  # verify-gate barrier can block
+        if not args.get("AllowStale") and not self.is_leader():
+            return False  # follower default-consistency: forwards
+        return True
+
     # --------------------------------------------------- blocking queries
 
     def blocking_query(self, args: dict[str, Any], tables: tuple[str, ...],
-                       run) -> dict[str, Any]:
+                       run, watch_key: Optional[str] = None,
+                       watch_prefix: Optional[str] = None
+                       ) -> dict[str, Any]:
         """agent/blockingquery/blockingquery.go:117 — run the query; if
         index <= MinQueryIndex, wait for a change and re-run.
 
         A query fn may return its own "Index" (e.g. a per-prefix KV
         index from kv_prefix_index): the loop then keeps waiting until
-        THAT index moves, so a watch on one prefix sleeps through
-        writes elsewhere in the table (memdb radix subtree semantics).
-        The wait itself always rides the table WatchSet: we park until
-        the table moves past the snapshot we just read."""
+        THAT index moves. ``watch_key``/``watch_prefix`` scope the wait
+        itself in the store's WatchRegistry — a watch on one prefix
+        SLEEPS through writes elsewhere in the table instead of waking
+        to re-check (memdb radix subtree semantics, now at the wakeup
+        layer too).
+
+        Two park modes, chosen by the caller's context:
+
+        * legacy (HTTP threads, one-shot conns, TLS mux fallback,
+          forwarded queries): block THIS thread on the registry via
+          ``block_until`` — the pre-reactor behavior;
+        * continuation (the RPC reactor's park-capable dispatch,
+          server/rpc.py): raise ``ParkRequest`` instead of blocking —
+          the reactor registers the re-run with the WatchRegistry and
+          the worker thread goes back to the pool. The deadline rides
+          the park context so re-runs never restart the clock, and the
+          parked interval lands in the ledger as its own ``park_wait``
+          stage rather than inflating ``rpc.handler``."""
+        pc = rpc_mod.park_context()
         min_index = int(args.get("MinQueryIndex") or 0)
-        max_time = min(float(args.get("MaxQueryTime")
-                             or self.config.default_query_time),
-                       self.config.max_query_time)
-        deadline = time.monotonic() + max_time
+        if pc is not None and pc.deadline is not None:
+            deadline = pc.deadline
+        else:
+            max_time = min(float(args.get("MaxQueryTime")
+                                 or self.config.default_query_time),
+                           self.config.max_query_time)
+            deadline = time.monotonic() + max_time
+            if pc is not None:
+                pc.deadline = deadline
         while True:
             idx = self.state.table_index(*tables)
             # the store-read slice of the request (utils/perf.py):
             # each loop iteration reads the state once; the PARKED
-            # time between reads is the herd gauge below, not a stage
+            # time between reads is park_wait / the herd gauge, not a
+            # stage of this read
             with perf.stage("store.read"):
                 result = run()
             ridx = result.pop("Index", idx)
@@ -987,10 +1065,19 @@ class Server:
                 return {"Index": max(ridx, 1), **result}
             # wait past the TABLE snapshot (idx), not min_index: with a
             # per-result index the table may already be far ahead
+            if pc is not None:
+                raise ParkRequest(
+                    deadline,
+                    park=lambda fire, _idx=idx: self.state.watch_park(
+                        tables, _idx, fire,
+                        key=watch_key, prefix=watch_prefix),
+                    cancel=self.state.watch_cancel)
             _parked(+1)
             try:
                 self.state.block_until(tables, idx,
-                                       min(remaining, 1.0))
+                                       min(remaining, 1.0),
+                                       key=watch_key,
+                                       prefix=watch_prefix)
             finally:
                 _parked(-1)
 

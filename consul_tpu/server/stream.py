@@ -42,11 +42,16 @@ class Subscription:
         self.closed = False
 
     def next(self, timeout: float = 10.0) -> Optional[Event]:
-        """Block until an event newer than next_index arrives."""
+        """Block until an event newer than next_index arrives. Waits
+        on the TOPIC's condition — a publish to another topic never
+        wakes this subscriber (the shared-cv design broadcast every
+        event to every subscription of every topic; same N-wakeups
+        shape the state store's WatchRegistry retired)."""
         import time as _time
 
         end = _time.monotonic() + timeout
-        with self.pub._cv:
+        cv = self.pub._topic_cv(self.topic)
+        with cv:
             while not self.closed:
                 ev = self.pub._first_after(self.topic, self.next_index)
                 if ev is not None:
@@ -55,13 +60,14 @@ class Subscription:
                 remaining = end - _time.monotonic()
                 if remaining <= 0:
                     return None
-                self.pub._cv.wait(remaining)
+                cv.wait(remaining)
         return None
 
     def close(self) -> None:
-        with self.pub._cv:
+        cv = self.pub._topic_cv(self.topic)
+        with cv:
             self.closed = True
-            self.pub._cv.notify_all()
+            cv.notify_all()
 
 
 class SnapshotCache:
@@ -128,16 +134,26 @@ class EventPublisher:
                  snapshot_ttl: float = 2.0) -> None:
         self._buffers: dict[str, deque[Event]] = {}
         self._lock = threading.RLock()
-        self._cv = threading.Condition(self._lock)
+        # one condition PER TOPIC (all sharing the lock): a publish
+        # wakes only its own topic's subscribers
+        self._cvs: dict[str, threading.Condition] = {}
         self.buffer_size = buffer_size
         self.snapshots = SnapshotCache(ttl=snapshot_ttl)
 
+    def _topic_cv(self, topic: str) -> threading.Condition:
+        with self._lock:
+            cv = self._cvs.get(topic)
+            if cv is None:
+                cv = self._cvs[topic] = threading.Condition(self._lock)
+            return cv
+
     def publish(self, ev: Event) -> None:
-        with self._cv:
+        cv = self._topic_cv(ev.topic)
+        with cv:
             buf = self._buffers.setdefault(
                 ev.topic, deque(maxlen=self.buffer_size))
             buf.append(ev)
-            self._cv.notify_all()
+            cv.notify_all()
 
     def subscribe(self, topic: str, index: int = 0) -> Subscription:
         return Subscription(self, topic, index)

@@ -12,13 +12,18 @@ Tables (subset of the reference's ~32, the serving core):
   sessions                  — session/lock machinery
   coordinates               — Vivaldi coordinates
 
-Concurrency: one RWLock-ish mutex; watchers register per-table WatchSet
-events (memdb WatchSet semantics, SURVEY §3.2): a commit wakes ONLY the
-watchers of the touched tables — a KV watcher sleeps through catalog
-churn. KV deletions leave tombstones so prefix watchers see a
-monotonic, per-prefix X-Consul-Index; a leader-driven raft command
-reaps them after tombstone_ttl (state_store.go tombstone GC,
-config.go:561-562).
+Concurrency: one RWLock-ish mutex; watchers register in a shared
+``WatchRegistry`` keyed by (table, key/key-prefix) — memdb WatchSet
+semantics (SURVEY §3.2) at radix granularity: a commit wakes ONLY the
+matching watchers of the touched tables with ONE registry walk (a KV
+watcher on prefix ``a/`` sleeps through catalog churn AND through
+writes under sibling prefix ``b/``). Watchers come in two shapes:
+thread waiters (``block_until`` — a threading.Event fired by the
+registry) and parked continuations (``watch_park`` — the RPC
+reactor's thread-free blocking queries, server/rpc.py). KV deletions
+leave tombstones so prefix watchers see a monotonic, per-prefix
+X-Consul-Index; a leader-driven raft command reaps them after
+tombstone_ttl (state_store.go tombstone GC, config.go:561-562).
 """
 
 from __future__ import annotations
@@ -45,6 +50,137 @@ TABLES = ("nodes", "services", "checks", "kv", "sessions",
           "coordinates", "resources") + RAW_TABLES
 
 
+class _WatchEntry:
+    __slots__ = ("handle", "tables", "key", "prefix", "fire")
+
+    def __init__(self, handle: int, tables: tuple[str, ...],
+                 key: Optional[str], prefix: Optional[str],
+                 fire: Callable[[], None]) -> None:
+        self.handle = handle
+        self.tables = tables
+        self.key = key
+        self.prefix = prefix
+        self.fire = fire
+
+
+class WatchRegistry:
+    """Shared watch registry: one-shot waiters keyed by (table,
+    key / key-prefix / whole-table). A write wakes exactly the
+    matching entries with one walk — O(matching + distinct prefixes)
+    per written key — instead of setting every watcher Event of the
+    table (the thread-per-watcher design this replaced woke N events
+    per bump and let each watcher re-check and re-park).
+
+    NOT thread-safe on its own: every method runs under the owning
+    StateStore's lock (registration happens inside the same critical
+    section that checks the table index, so a commit landing between
+    the check and the park still fires).
+
+    Entries are ONE-SHOT: ``notify`` removes what it fires, and
+    callers re-register per wait/park iteration — a continuation that
+    re-parks gets a fresh entry, so a fired entry can never fire
+    twice."""
+
+    def __init__(self) -> None:
+        self._next = 0
+        self._entries: dict[int, _WatchEntry] = {}
+        # per-table indexes: unscoped entries, exact-key entries, and
+        # prefix entries grouped by prefix string
+        self._table: dict[str, dict[int, _WatchEntry]] = {}
+        self._by_key: dict[str, dict[str, dict[int, _WatchEntry]]] = {}
+        self._by_prefix: dict[str, dict[str, dict[int, _WatchEntry]]] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def register(self, tables: Iterable[str], fire: Callable[[], None],
+                 key: Optional[str] = None,
+                 prefix: Optional[str] = None) -> int:
+        """Register a one-shot watch over `tables`. With `key` the
+        entry fires only for writes naming exactly that key; with
+        `prefix` only for keys under it; unscoped fires on any bump of
+        its tables. Key scoping applies per-table (in practice only
+        the kv table ships per-key change sets; other tables notify
+        unscoped). Returns a handle for ``unregister``."""
+        self._next += 1
+        ent = _WatchEntry(self._next, tuple(tables), key, prefix, fire)
+        self._entries[ent.handle] = ent
+        for t in ent.tables:
+            if key is not None:
+                self._by_key.setdefault(t, {}).setdefault(
+                    key, {})[ent.handle] = ent
+            elif prefix is not None:
+                self._by_prefix.setdefault(t, {}).setdefault(
+                    prefix, {})[ent.handle] = ent
+            else:
+                self._table.setdefault(t, {})[ent.handle] = ent
+        return ent.handle
+
+    def unregister(self, handle: int) -> None:
+        """Idempotent: a fired (one-shot) entry is already gone."""
+        ent = self._entries.pop(handle, None)
+        if ent is not None:
+            self._remove_indexed(ent)
+
+    def _remove_indexed(self, ent: _WatchEntry) -> None:
+        for t in ent.tables:
+            if ent.key is not None:
+                keyed = self._by_key.get(t, {})
+                bucket = keyed.get(ent.key)
+                if bucket is not None:
+                    bucket.pop(ent.handle, None)
+                    if not bucket:
+                        keyed.pop(ent.key, None)
+            elif ent.prefix is not None:
+                pref = self._by_prefix.get(t, {})
+                bucket = pref.get(ent.prefix)
+                if bucket is not None:
+                    bucket.pop(ent.handle, None)
+                    if not bucket:
+                        pref.pop(ent.prefix, None)
+            else:
+                self._table.get(t, {}).pop(ent.handle, None)
+
+    def collect(self, table: str,
+                keys: Optional[list[str]] = None
+                ) -> list[Callable[[], None]]:
+        """Remove and return the fire callbacks matching one table
+        bump. ``keys=None`` means the change set is unknown —
+        conservative full-table wake (correct, never lossy); with
+        keys, exact-key entries match by dict lookup and prefix
+        entries by a walk of the DISTINCT registered prefixes."""
+        matched: dict[int, _WatchEntry] = dict(self._table.get(table, ()))
+        if keys is None:
+            for bucket in self._by_key.get(table, {}).values():
+                matched.update(bucket)
+            for bucket in self._by_prefix.get(table, {}).values():
+                matched.update(bucket)
+        else:
+            keyed = self._by_key.get(table, {})
+            prefixed = self._by_prefix.get(table, {})
+            for k in keys:
+                bucket = keyed.get(k)
+                if bucket:
+                    matched.update(bucket)
+                for p, bucket in prefixed.items():
+                    if k.startswith(p):
+                        matched.update(bucket)
+        for ent in matched.values():
+            self._entries.pop(ent.handle, None)
+            self._remove_indexed(ent)
+        return [ent.fire for ent in matched.values()]
+
+    def collect_all(self) -> list[Callable[[], None]]:
+        """Remove and return every entry's fire (snapshot restore:
+        the whole store changed, every watcher must re-check)."""
+        fires = [ent.fire for ent in self._entries.values()]
+        self._entries.clear()
+        self._table.clear()
+        self._by_key.clear()
+        self._by_prefix.clear()
+        return fires
+
+
 class StateStore:
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -54,10 +190,10 @@ class StateStore:
         # sessions[id] = Session; coordinates[node] = Coordinate dict
         self.tables: dict[str, dict[Any, Any]] = {t: {} for t in TABLES}
         self._table_index: dict[str, int] = {t: 0 for t in TABLES}
-        # per-table WatchSets: block_until registers an Event under each
-        # watched table; _bump fires only the touched tables' events
-        self._watchers: dict[str, set[threading.Event]] = {
-            t: set() for t in TABLES}
+        # the shared watch registry: block_until registers an Event
+        # waiter, the RPC reactor parks continuations (watch_park);
+        # _bump fires only the touched tables' MATCHING entries
+        self._watches = WatchRegistry()
         # kv tombstones: key -> deletion index (reaped via raft)
         self._kv_tombstones: dict[str, int] = {}
         # change hooks (the stream publisher seam — event streaming feeds
@@ -93,14 +229,25 @@ class StateStore:
     def add_change_hook(self, fn: Callable[[str, int], None]) -> None:
         self._change_hooks.append(fn)
 
-    def _bump(self, *tables: str) -> int:
+    def _bump(self, *tables: str,
+              kv_keys: Optional[list[str]] = None) -> int:
+        """Advance the store index and wake the touched tables'
+        MATCHING watchers (one registry walk). ``kv_keys`` names the
+        kv keys this commit wrote/deleted, so key- and prefix-scoped
+        kv watchers under OTHER keys sleep through it; tables without
+        a change set wake all their watchers (conservative)."""
         self._index += 1
-        fired: set[threading.Event] = set()
+        fires: list[Callable[[], None]] = []
         for t in tables:
             self._table_index[t] = self._index
-            fired |= self._watchers[t]
-        for ev in fired:
-            ev.set()
+            fires.extend(self._watches.collect(
+                t, keys=kv_keys if t == "kv" else None))
+        # fire AFTER every touched table's index moved: a woken waiter
+        # re-reading the store must observe the whole commit. Still
+        # under the store lock (same as the Event sets this replaced);
+        # fires are nonblocking (Event.set / continuation resubmit)
+        for fire in fires:
+            fire()
         for fn in self._change_hooks:
             try:
                 fn(",".join(tables), self._index)
@@ -108,11 +255,46 @@ class StateStore:
                 pass
         return self._index
 
+    def watch_park(self, tables: Iterable[str], idx: int,
+                   fire: Callable[[], None],
+                   key: Optional[str] = None,
+                   prefix: Optional[str] = None) -> Optional[int]:
+        """Park a CONTINUATION: register `fire` as a one-shot watch
+        over `tables`, scoped to `key`/`prefix` when given — unless a
+        table already moved past `idx`, in which case nothing is
+        registered and None returns (the caller must re-run instead
+        of parking: a commit landed between its read and this call).
+        Returns the registry handle; cancel with ``watch_cancel``.
+        This is the thread-free blocking-query seam the RPC reactor
+        parks on (server/rpc.py)."""
+        with self._lock:
+            cur = max((self._table_index[t] for t in tables),
+                      default=self._index)
+            if cur > idx:
+                return None
+            return self._watches.register(tables, fire,
+                                          key=key, prefix=prefix)
+
+    def watch_cancel(self, handle: int) -> None:
+        """Drop a parked watch (idempotent — fired entries are
+        already gone): deadline expiry and client disconnect both
+        land here."""
+        with self._lock:
+            self._watches.unregister(handle)
+
+    def watch_count(self) -> int:
+        """Registered watch entries (tests/observability)."""
+        with self._lock:
+            return len(self._watches)
+
     def block_until(self, tables: Iterable[str], min_index: int,
-                    timeout: float) -> int:
+                    timeout: float, key: Optional[str] = None,
+                    prefix: Optional[str] = None) -> int:
         """Wait until any of `tables` moves past min_index (or timeout).
         Returns the current max index over the tables. Scoped: commits
-        to OTHER tables never wake this waiter (memdb WatchSet).
+        to OTHER tables never wake this waiter, and with `key`/`prefix`
+        neither do kv commits under other keys (memdb WatchSet at
+        radix granularity).
 
         Real-time only: Event waits can't ride the SimClock, so
         deterministic tests drive this with short timeouts."""
@@ -121,26 +303,23 @@ class StateStore:
         tables = tuple(tables)
         end = _time.monotonic() + timeout
         ev = threading.Event()
-        try:
-            while True:
-                with self._lock:
-                    cur = max((self._table_index[t] for t in tables),
-                              default=self._index)
-                    if cur > min_index:
-                        return cur
-                    # register BEFORE releasing the lock: a commit that
-                    # lands between the check and the wait still fires ev
-                    for t in tables:
-                        self._watchers[t].add(ev)
-                remaining = end - _time.monotonic()
-                if remaining <= 0:
-                    return cur
-                ev.wait(remaining)
-                ev.clear()
-        finally:
+        while True:
             with self._lock:
-                for t in tables:
-                    self._watchers[t].discard(ev)
+                cur = max((self._table_index[t] for t in tables),
+                          default=self._index)
+                if cur > min_index:
+                    return cur
+                # register BEFORE releasing the lock: a commit that
+                # lands between the check and the wait still fires ev
+                handle = self._watches.register(tables, ev.set,
+                                                key=key, prefix=prefix)
+            remaining = end - _time.monotonic()
+            if remaining <= 0:
+                self.watch_cancel(handle)
+                return cur
+            ev.wait(remaining)
+            self.watch_cancel(handle)  # no-op when the fire consumed it
+            ev.clear()  # loop re-checks the index (and the deadline)
 
     # ---------------------------------------------------------------- catalog
 
@@ -226,12 +405,13 @@ class StateStore:
             # invalidate sessions bound to the node (session_ttl semantics)
             dead_sessions = [s for s in self.tables["sessions"].values()
                              if s.node == node]
+            kv_touched: list[str] = []
             for s in dead_sessions:
-                self._destroy_session_locked(s.id)
+                kv_touched.extend(self._destroy_session_locked(s.id))
             # sessions/kv watchers must wake too: session destruction
             # releases or deletes held locks
             return self._bump("nodes", "services", "checks", "coordinates",
-                              "sessions", "kv")
+                              "sessions", "kv", kv_keys=kv_touched)
 
     def delete_service(self, node: str, service_id: str) -> int:
         with self._lock:
@@ -457,7 +637,7 @@ class StateStore:
                 e.session = acquire
             if release:
                 e.session = ""
-            idx = self._bump("kv")
+            idx = self._bump("kv", kv_keys=[key])
             e.modify_index = idx
             self.tables["kv"][key] = e
             return idx, True
@@ -507,7 +687,7 @@ class StateStore:
                 return self._index, True
             for k in victims:
                 del self.tables["kv"][k]
-            idx = self._bump("kv")
+            idx = self._bump("kv", kv_keys=victims)
             for k in victims:
                 # tombstone: a prefix watcher's X-Consul-Index must move
                 # FORWARD on deletion even though the live entries'
@@ -573,16 +753,21 @@ class StateStore:
 
     def session_destroy(self, sid: str) -> int:
         with self._lock:
-            self._destroy_session_locked(sid)
-            return self._bump("sessions", "kv")
+            touched = self._destroy_session_locked(sid)
+            return self._bump("sessions", "kv", kv_keys=touched)
 
-    def _destroy_session_locked(self, sid: str) -> None:
+    def _destroy_session_locked(self, sid: str) -> list[str]:
+        """Returns the kv keys this destruction touched (released or
+        deleted locks) — the callers' _bump change set, so scoped kv
+        watchers elsewhere in the keyspace sleep through it."""
         sess = self.tables["sessions"].pop(sid, None)
         if sess is None:
-            return
+            return []
         # release or delete held locks per session behavior
+        touched: list[str] = []
         for k, e in list(self.tables["kv"].items()):
             if e.session == sid:
+                touched.append(k)
                 if sess.behavior == "delete":
                     del self.tables["kv"][k]
                     # callers _bump right after; that index is this one
@@ -590,6 +775,7 @@ class StateStore:
                 else:
                     e.session = ""
                     e.modify_index = self._index + 1
+        return touched
 
     def invalidate_sessions_for_check(self, node: str,
                                       check_id: str) -> None:
@@ -598,10 +784,11 @@ class StateStore:
         with self._lock:
             doomed = [s.id for s in self.tables["sessions"].values()
                       if s.node == node and check_id in s.checks]
+            kv_touched: list[str] = []
             for sid in doomed:
-                self._destroy_session_locked(sid)
+                kv_touched.extend(self._destroy_session_locked(sid))
             if doomed:
-                self._bump("sessions", "kv")
+                self._bump("sessions", "kv", kv_keys=kv_touched)
 
     # ------------------------------------------------------------ coordinates
 
@@ -776,9 +963,10 @@ class StateStore:
             # history (inmem/snapshot.go)
             self.resources.restore(blob.get("resources")
                                    or msgpack.packb([]))
-            for watchers in self._watchers.values():
-                for ev in watchers:
-                    ev.set()
+            # restore means the WHOLE store changed: every watcher —
+            # scoped or not — must wake and re-read
+            for fire in self._watches.collect_all():
+                fire()
             for fn in self._change_hooks:
                 try:
                     fn(",".join(TABLES), self._index)

@@ -33,8 +33,12 @@ Stage taxonomy (the request's life, in order — ``STAGES`` below):
          inside) → http.encode (json) → http.write (socket)
   RPC:   rpc.read (frame body + msgpack decode; the idle wait for the
          header is deliberately NOT counted) → rpc.dispatch (worker
-         queue) → rpc.handler → rpc.commit_wait (async write path:
-         group-commit wait, no thread parked) → rpc.write
+         queue) → rpc.handler → rpc.park_wait (blocking query parked
+         as a thread-free continuation on the reactor; handler re-runs
+         on wake, so handler/park_wait pairs may repeat) →
+         rpc.commit_wait (async write path: group-commit wait, no
+         thread parked) → rpc.write (egress: enqueue → last byte
+         flushed by the reactor's batched writev)
   inner: store.read (blocking_query's state closure),
          raft.commit_wait (sync batcher park), raft.apply_batch
          (append→replicate→commit), raft.fsm.apply (applier thread)
@@ -81,7 +85,7 @@ N_BUCKETS = _N_EDGES + 1  # + the +Inf overflow bucket
 STAGES = (
     "http.read", "http.decode", "http.route",
     "http.encode", "http.write", "http.e2e", "http.stages_sum",
-    "rpc.read", "rpc.dispatch", "rpc.handler",
+    "rpc.read", "rpc.dispatch", "rpc.handler", "rpc.park_wait",
     "rpc.commit_wait", "rpc.write", "rpc.e2e", "rpc.stages_sum",
     "store.read",
     "raft.commit_wait", "raft.apply_batch", "raft.fsm.apply",
@@ -94,7 +98,7 @@ STAGES = (
 TOP_STAGES = {
     "http": ("http.read", "http.decode", "http.route",
              "http.encode", "http.write"),
-    "rpc": ("rpc.read", "rpc.dispatch", "rpc.handler",
+    "rpc": ("rpc.read", "rpc.dispatch", "rpc.handler", "rpc.park_wait",
             "rpc.commit_wait", "rpc.write"),
 }
 

@@ -303,6 +303,12 @@ def test_bench_family_metric_selector_validation():
                "--metric", "gossip_rounds_per_sec_smoke")
     assert r.returncode == 2
     assert "cannot re-measure" in r.stderr
+    # SERVE likewise: it re-runs the recorded top rung of the
+    # kv_sustained ladder and nothing else
+    r = _bench("--check-regression", "--smoke", "--family", "SERVE",
+               "--metric", "gossip_rounds_per_sec_smoke")
+    assert r.returncode == 2
+    assert "cannot re-measure" in r.stderr
 
 
 def test_bench_check_regression_profile_without_record_exits_2(

@@ -96,6 +96,82 @@ def test_mux_interleaving_and_errors(dev_server):
     pool.close()
 
 
+def test_stream_cancel_releases_slot(dev_server):
+    """Mux stream cancellation under the reactor: closing a
+    subscription fires the server-side cancel event, the stream
+    thread drains, and the in-flight gauge returns to its baseline —
+    a cancelled stream must release its yamux slot exactly once."""
+    from consul_tpu.server import rpc as rpc_mod
+
+    srv = dev_server
+    pool = ConnPool()
+    try:
+        base = rpc_mod._MUX_IN_FLIGHT[0]
+        handle = pool.subscribe(srv.rpc.addr, "Subscribe.Subscribe",
+                                {"Topic": "KV", "Key": "cancel/"})
+        first = handle.next(timeout=5.0)
+        assert first["Type"] == "snapshot"
+        wait_for(lambda: rpc_mod._MUX_IN_FLIGHT[0] == base + 1,
+                 what="stream counted in-flight")
+        handle.close()
+        wait_for(lambda: rpc_mod._MUX_IN_FLIGHT[0] == base,
+                 what="in-flight gauge back to baseline after cancel")
+        # the session keeps working after the cancel
+        assert pool.call(srv.rpc.addr, "Status.Ping", {}) == "pong"
+    finally:
+        pool.close()
+
+
+def test_mid_park_disconnect_drops_continuation_once(dev_server):
+    """A parked blocking query whose client disconnects mid-park must
+    be dropped EXACTLY once: the store watch unregisters, the parked
+    gauge and the in-flight gauge return to baseline, and a later
+    write to the watched key fires into nothing (no crash, no double
+    accounting)."""
+    import socket
+    import struct
+
+    import msgpack
+
+    from consul_tpu.server import rpc as rpc_mod
+
+    srv = dev_server
+    srv.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "dis/k", "Value": b"0"}},
+        "local")
+    idx = srv.state.kv_key_index("dis/k")
+    base_flight = rpc_mod._MUX_IN_FLIGHT[0]
+    base_parked = rpc_mod.parked_continuations()
+    base_watches = srv.state.watch_count()
+
+    host, port = srv.rpc.addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=5.0)
+    sock.sendall(bytes([rpc_mod.RPC_MUX]))
+    blob = msgpack.packb({"sid": 1, "method": "KVS.Get",
+                          "args": {"Key": "dis/k", "AllowStale": True,
+                                   "MinQueryIndex": idx,
+                                   "MaxQueryTime": 30.0}},
+                         use_bin_type=True)
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+    wait_for(lambda: rpc_mod.parked_continuations() == base_parked + 1,
+             what="query parked as a continuation")
+    assert rpc_mod._MUX_IN_FLIGHT[0] == base_flight + 1
+    assert srv.state.watch_count() == base_watches + 1
+    sock.close()
+    wait_for(lambda: rpc_mod.parked_continuations() == base_parked,
+             what="parked continuation dropped on disconnect")
+    wait_for(lambda: rpc_mod._MUX_IN_FLIGHT[0] == base_flight,
+             what="in-flight gauge back to zero")
+    wait_for(lambda: srv.state.watch_count() == base_watches,
+             what="store watch unregistered")
+    # the watched key's next write finds nobody — and nothing breaks
+    srv.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "dis/k", "Value": b"1"}},
+        "local")
+    assert rpc_mod.parked_continuations() == base_parked
+    assert rpc_mod._MUX_IN_FLIGHT[0] == base_flight
+
+
 def test_snapshot_stream_roundtrip(dev_server):
     srv = dev_server
     pool = ConnPool()

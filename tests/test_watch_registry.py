@@ -1,0 +1,206 @@
+"""The shared watch registry (consul_tpu/state/store.py): key/prefix-
+scoped wake isolation, one-shot semantics, and the continuation-park
+seam the RPC reactor rides.
+
+The load-bearing invariant (ISSUE 13 satellite): a watcher on
+key-prefix A never wakes for writes OR tombstones under sibling
+prefix B — previously only asserted indirectly through blocking-query
+index math (the old per-table Event sets woke every kv watcher per
+bump and relied on each one re-checking kv_prefix_index and
+re-parking). Now the wake itself is scoped, and these tests pin it
+directly at the registry layer.
+"""
+
+import threading
+import time
+
+from consul_tpu.state.store import StateStore
+
+
+def _fresh():
+    return StateStore()
+
+
+def _park(store, fired, label, **kw):
+    h = store.watch_park(("kv",), store.table_index("kv"),
+                         lambda: fired.append(label), **kw)
+    assert h is not None, "registration must succeed at current index"
+    return h
+
+
+# ------------------------------------------------ prefix isolation
+
+
+def test_prefix_watch_ignores_sibling_writes():
+    s = _fresh()
+    fired = []
+    _park(s, fired, "a", prefix="a/")
+    s.kv_set("b/x", b"1")
+    s.kv_set("b/y", b"2")
+    assert fired == [], "sibling-prefix writes woke a scoped watcher"
+    s.kv_set("a/k", b"3")
+    assert fired == ["a"]
+    # one-shot: consumed on fire
+    assert s.watch_count() == 0
+
+
+def test_prefix_watch_ignores_sibling_tombstones():
+    """Deletion is the subtle half: tombstones under prefix B bump the
+    kv table index but must not wake a prefix-A watcher (the :521-533
+    invariant — kv_prefix_index stays put for A, and now the wake
+    itself is scoped too)."""
+    s = _fresh()
+    s.kv_set("a/k", b"1")
+    s.kv_set("b/k", b"1")
+    idx_a = s.kv_prefix_index("a/")
+    fired = []
+    _park(s, fired, "a", prefix="a/")
+    s.kv_delete("b/k")
+    assert fired == [], "sibling tombstone woke a prefix watcher"
+    assert s.kv_prefix_index("a/") == idx_a  # index math unchanged
+    # deletion UNDER the prefix does wake (and moves the index)
+    s.kv_delete("a/k")
+    assert fired == ["a"]
+    assert s.kv_prefix_index("a/") > idx_a
+
+
+def test_exact_key_watch_ignores_byte_prefix_sibling():
+    """KVS.Get watches one exact key: a sibling key that merely shares
+    a byte prefix (a/x vs a/xy) must not wake it — prefix semantics
+    are for list/keys only, as in the reference."""
+    s = _fresh()
+    s.kv_set("a/x", b"1")
+    fired = []
+    _park(s, fired, "k", key="a/x")
+    s.kv_set("a/xy", b"2")
+    assert fired == []
+    s.kv_set("a/x", b"3")
+    assert fired == ["k"]
+
+
+def test_recursive_delete_wakes_each_scoped_watcher_once():
+    s = _fresh()
+    for k in ("p/1", "p/2", "q/1"):
+        s.kv_set(k, b"v")
+    fired = []
+    _park(s, fired, "p", prefix="p/")
+    _park(s, fired, "q", prefix="q/")
+    _park(s, fired, "p1", key="p/1")
+    s.kv_delete("p/", recurse=True)
+    # both p-scoped watchers fire exactly once; q sleeps
+    assert sorted(fired) == ["p", "p1"]
+
+
+def test_session_lock_release_carries_kv_keys():
+    """Session destruction releases/deletes held locks: only the keys
+    the session actually held wake their watchers."""
+    s = _fresh()
+    from consul_tpu.types import Session
+
+    sess = Session(id="s1", node="n1", behavior="release")
+    s.session_create(sess)
+    s.kv_set("lock/a", b"1", acquire="s1")
+    s.kv_set("other/b", b"1")
+    fired = []
+    _park(s, fired, "lock", prefix="lock/")
+    _park(s, fired, "other", prefix="other/")
+    s.session_destroy("s1")
+    assert fired == ["lock"], fired
+
+
+# ---------------------------------------------- registry mechanics
+
+
+def test_unscoped_table_watch_wakes_on_any_kv_write():
+    s = _fresh()
+    fired = []
+    _park(s, fired, "t")  # whole-table
+    s.kv_set("anything", b"1")
+    assert fired == ["t"]
+
+
+def test_other_table_commit_never_wakes_kv_watchers():
+    s = _fresh()
+    fired = []
+    _park(s, fired, "kv", prefix="a/")
+    _park(s, fired, "kv2")
+    s.ensure_registration("n1", address="1.2.3.4")
+    assert fired == []
+    assert s.watch_count() == 2
+
+
+def test_stale_index_registration_refused():
+    """A commit landing between the caller's read and the park must
+    surface as a refused registration (None) — the caller re-runs
+    instead of sleeping on a watch that already fired."""
+    s = _fresh()
+    idx = s.table_index("kv")
+    s.kv_set("a/x", b"1")
+    assert s.watch_park(("kv",), idx, lambda: None) is None
+    assert s.watch_count() == 0
+
+
+def test_watch_cancel_idempotent():
+    s = _fresh()
+    fired = []
+    h = _park(s, fired, "x", key="k")
+    s.watch_cancel(h)
+    s.watch_cancel(h)  # second cancel: no-op
+    s.kv_set("k", b"1")
+    assert fired == []
+    # cancel of a FIRED handle is also a no-op
+    h2 = _park(s, fired, "y", key="k")
+    s.kv_set("k", b"2")
+    assert fired == ["y"]
+    s.watch_cancel(h2)
+
+
+def test_restore_wakes_every_watcher():
+    s = _fresh()
+    blob = s.dump()
+    fired = []
+    _park(s, fired, "scoped", prefix="zz/")
+    _park(s, fired, "table")
+    s.restore(blob)
+    assert sorted(fired) == ["scoped", "table"]
+    assert s.watch_count() == 0
+
+
+# ----------------------------------------- block_until integration
+
+
+def test_block_until_prefix_scoped_sleep_and_wake():
+    """The thread-waiter path through the same registry: a scoped
+    block_until sleeps through sibling writes (it would previously
+    wake, re-check, re-park) and returns promptly on a matching one."""
+    s = _fresh()
+    s.kv_set("a/x", b"1")
+    idx = s.table_index("kv")
+    out = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        out["idx"] = s.block_until(("kv",), idx, 5.0, prefix="a/")
+        out["dt"] = time.monotonic() - t0
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    s.kv_set("b/noise", b"1")
+    time.sleep(0.2)
+    assert "idx" not in out, "sibling write returned a scoped waiter"
+    s.kv_set("a/x", b"2")
+    t.join(timeout=5.0)
+    assert out["idx"] > idx
+    assert out["dt"] < 2.0
+    assert s.watch_count() == 0
+
+
+def test_block_until_timeout_returns_current_index():
+    s = _fresh()
+    idx = s.table_index("kv")
+    t0 = time.monotonic()
+    cur = s.block_until(("kv",), idx, 0.3, prefix="never/")
+    assert 0.25 <= time.monotonic() - t0 < 2.0
+    assert cur == idx
+    assert s.watch_count() == 0
