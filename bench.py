@@ -49,7 +49,7 @@ _INIT_TIMEOUT_S = float(os.environ.get("CONSUL_TPU_BENCH_INIT_TIMEOUT", "180"))
 #: the mutually-exclusive top-level modes; everything else (--smoke,
 #: --profile, --ckpt-dir D, --resume, --family, --metric) modifies
 #: one of them
-_MODES = ("--mesh", "--sweep", "--chaos", "--coords",
+_MODES = ("--mesh", "--sweep", "--chaos", "--coords", "--twin",
           "--history", "--check-regression", "--autotune")
 
 #: record families --check-regression knows how to RE-MEASURE (the
@@ -58,7 +58,7 @@ _MODES = ("--mesh", "--sweep", "--chaos", "--coords",
 #: fresh bandwidth peak, SERVE re-runs the recorded top concurrency
 #: rung of the bench_kv sustained ladder in-process — all under the
 #: same median+IQR refusal band
-_GUARDED_FAMILIES = ("BENCH", "PROFILE", "SERVE")
+_GUARDED_FAMILIES = ("BENCH", "PROFILE", "SERVE", "TWIN")
 
 
 def _usage(err: str) -> None:
@@ -69,13 +69,13 @@ def _usage(err: str) -> None:
     different from what its command line says."""
     print(f"bench.py: {err}\n"
           "usage: bench.py [--smoke] [--profile]\n"
-          "       bench.py --mesh|--sweep|--chaos [--smoke] "
+          "       bench.py --mesh|--sweep|--chaos|--twin [--smoke] "
           "[--ckpt-dir D [--resume]]\n"
           "       bench.py --coords [--smoke]\n"
           "       bench.py --autotune [--smoke]\n"
           "       bench.py --history\n"
           "       bench.py --check-regression [--smoke] "
-          "[--family BENCH|PROFILE|SERVE] [--metric NAME]\n"
+          "[--family BENCH|PROFILE|SERVE|TWIN] [--metric NAME]\n"
           "(--profile applies to the throughput bench only; modes are "
           "mutually exclusive)", file=sys.stderr)
     sys.exit(2)
@@ -158,6 +158,9 @@ def run_check_regression(smoke: bool, family: str = "BENCH",
         return
     if family == "SERVE":
         _check_serve_regression(smoke, records, metric)
+        return
+    if family == "TWIN":
+        _check_twin_regression(smoke, records, metric)
         return
     expected = ("gossip_rounds_per_sec_smoke" if smoke
                 else "gossip_rounds_per_sec_1M_nodes")
@@ -1260,6 +1263,190 @@ def run_coords_bench(smoke: bool) -> None:
                     4096 if smoke else 65_536, runner)
 
 
+def run_twin_bench(smoke: bool, ckpt_dir=None, resume: bool = False
+                   ) -> None:
+    """`bench.py --twin [--smoke] [--ckpt-dir D [--resume]]`: the
+    million-member digital twin — ONE real agent (catalog, health,
+    watches, serf event pipeline, RPC/HTTP surfaces) against a
+    sim-backed virtual-member ladder (sim/twin.py) under FaultPlan
+    churn + partition, gossip timers on a SimClock, the sim side
+    checkpointed through the PR 9 machinery (SIGTERM mid-soak saves
+    at the next chunk boundary and exits PREEMPTED_RC; --resume
+    restores). Each measured rung records join time, post-heal member
+    view convergence, agent p50/p99 + Jain fairness under a live RPC
+    client herd, /v1/agent/perf stage attribution, and the
+    checkpoint-resume digest proof; rungs past the host's budget are
+    recorded as HONEST SKIPS naming the reason. Recorded as
+    TWIN_r*.json."""
+    from consul_tpu.sim import twin as twin_mod
+    from consul_tpu.sim.checkpoint import (PREEMPTED_RC,
+                                           PreemptionGuard,
+                                           ProgressManifest)
+
+    metric = "twin_soak" + ("_smoke" if smoke else "")
+    want = "cpu" if smoke else os.environ.get("JAX_PLATFORMS", "tpu")
+    watchdog = _arm_watchdog(want, metric)
+    try:
+        import jax
+
+        if smoke:
+            jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+    except Exception as e:  # noqa: BLE001
+        watchdog.cancel()
+        print(_error_line(f"backend init failed: {e}", want, metric))
+        sys.exit(1)
+    watchdog.cancel()
+
+    ladder = [twin_mod.TWIN_SMOKE_N] if smoke \
+        else list(twin_mod.TWIN_LADDER)
+    #: wall budget per rung; a rung projected (from the previous
+    #: rung's actuals, linear in n) to blow it is skipped honestly
+    rung_budget_s = 120.0 if smoke else float(os.environ.get(
+        "CONSUL_TPU_TWIN_RUNG_BUDGET_S", "900"))
+    guard = PreemptionGuard().install()
+    manifest = ProgressManifest(
+        ckpt_dir, name="twin-progress.json",
+        config={"smoke": smoke, "ladder": ladder}) if ckpt_dir else None
+    rungs = []
+    # budget projection keys off the last MEASURED rung — a skipped
+    # rung (by projection or OOM) must not disable the guard for the
+    # even-larger rung after it
+    prev: Optional[dict] = None
+    preempted_at = None
+    for n in ladder:
+        unit = f"n{n}"
+        if manifest is not None and manifest.done(unit):
+            replayed = manifest.result(unit)
+            rungs.append(replayed)
+            if not replayed.get("skipped"):
+                prev = replayed
+            continue
+        if guard.preempted:
+            preempted_at = n
+            break
+        if prev is not None:
+            used = prev.get("join_s", 0) + prev.get("soak_wall_s", 0)
+            projected = used * (n / max(prev["n"], 1))
+            if projected > rung_budget_s:
+                rung = {"n": n, "skipped": True,
+                        "reason": f"projected {projected:.0f}s wall "
+                                  f"from the n={prev['n']} rung's "
+                                  f"{used:.0f}s exceeds the "
+                                  f"{rung_budget_s:.0f}s rung budget"}
+                rungs.append(rung)
+                if manifest is not None:
+                    manifest.mark(unit, rung)
+                print(f"twin rung n={n}: SKIPPED ({rung['reason']})",
+                      file=sys.stderr)
+                continue
+        rung_ckpt = os.path.join(ckpt_dir, unit) if ckpt_dir else None
+        try:
+            rung = twin_mod.run_twin_soak(
+                n, seed=0, guard=guard, ckpt_dir=rung_ckpt,
+                resume=resume,
+                progress=lambda msg: print(f"twin {msg}",
+                                           file=sys.stderr))
+        except MemoryError:
+            rung = {"n": n, "skipped": True,
+                    "reason": "out of memory building the twin"}
+        if rung.get("preempted"):
+            preempted_at = n
+            break
+        rungs.append(rung)
+        if manifest is not None and not rung.get("skipped"):
+            manifest.mark(unit, rung)
+        if not rung.get("skipped"):
+            prev = rung
+    guard.uninstall()
+    if preempted_at is not None:
+        print(json.dumps({"metric": metric, "preempted": True,
+                          "preempted_rung": preempted_at,
+                          "ladder": rungs}, indent=1))
+        sys.exit(PREEMPTED_RC)
+
+    import jax
+
+    print("twin: measuring the smoke-guard envelope", file=sys.stderr)
+    smoke_guard = twin_mod.smoke_guard_samples(
+        samples=3, n=min(twin_mod.TWIN_SMOKE_N, min(ladder)))
+    payload = {
+        "metric": metric,
+        "platform": jax.default_backend(),
+        "loadavg_1m": _loadavg_1m(),
+        "smoke": smoke,
+        "ladder": rungs,
+        "smoke_guard": smoke_guard,
+    }
+    print(json.dumps(payload, indent=1))
+    # the smoke ladder is a workflow check, not a soak worth pinning a
+    # regression baseline to — only full runs enter the ledger
+    if not smoke and any(not r.get("skipped") for r in rungs):
+        _record_next("TWIN", payload)
+
+
+def _check_twin_regression(smoke: bool, records,
+                           metric: Optional[str]) -> None:
+    """--check-regression --family TWIN: re-run the newest TWIN
+    record's smoke-guard workload (same n/rounds — apples to apples
+    without re-soaking a 10⁵-member rung) and guard its convergence
+    SPEED (1000/converge_rounds; higher is better, so the shared
+    refusal-band math reads the same way as every other family)."""
+    from consul_tpu.sim import costmodel
+    from consul_tpu.sim import twin as twin_mod
+
+    if metric is not None and metric != "twin_converge_speed":
+        _usage(f"--family TWIN guards 'twin_converge_speed' "
+               f"(1000/converge_rounds of the recorded smoke-guard "
+               f"workload); it cannot re-measure {metric!r}")
+    base = costmodel.latest_twin_guard(records)
+    if base is None:
+        print("--check-regression --family TWIN: no recorded "
+              f"TWIN_r*.json with a smoke_guard under {_record_root()}"
+              " — record one first (bench.py --twin); a baseline is "
+              "never fabricated", file=sys.stderr)
+        sys.exit(2)
+    import jax
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    plan = twin_mod.twin_plan(base["n"], warmup=4, churn=12,
+                              partition=12, heal=24)
+    if plan.total_rounds != base["rounds"]:
+        print("--check-regression --family TWIN: the recorded "
+              f"smoke_guard ran {base['rounds']} rounds but today's "
+              f"guard plan has {plan.total_rounds} — the workloads "
+              "no longer match; re-record with bench.py --twin",
+              file=sys.stderr)
+        sys.exit(2)
+    samples = []
+    for i in range(3):
+        rung = twin_mod.run_twin_soak(
+            base["n"], seed=100 + i, plan=plan, load_clients=2,
+            serve_http=False)
+        if rung["member_view_err_post_heal"] > twin_mod.CONVERGE_TOL:
+            # non-convergence is a confirmed regression, not a "slow"
+            # sample — a capped converge_rounds must not enter the band
+            print(json.dumps({
+                "metric": "twin_converge_speed",
+                "verdict": "regression",
+                "reason": "fresh sample never converged (view err "
+                          f"{rung['member_view_err_post_heal']})",
+                "baseline_file": base["file"]}))
+            sys.exit(1)
+        samples.append(1000.0 / max(rung["converge_rounds"], 1))
+    res = costmodel.check_regression(
+        samples, 1000.0 / max(base["converge_rounds"], 1))
+    print(json.dumps({
+        "metric": "twin_converge_speed",
+        "platform": jax.default_backend(),
+        "loadavg_1m": _loadavg_1m(),
+        "baseline_file": base["file"],
+        **res,
+    }))
+    sys.exit(1 if res["verdict"] == "regression" else 0)
+
+
 def main() -> None:
     # Local CPU smoke mode (documented in README): tiny cluster, same
     # code path end to end, finishes in ~a minute on one core.
@@ -1313,6 +1500,9 @@ def main() -> None:
         return
     if "--coords" in argv:
         run_coords_bench(smoke)
+        return
+    if "--twin" in argv:
+        run_twin_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
         return
     if "--history" in argv:
         run_history()

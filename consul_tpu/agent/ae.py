@@ -1,13 +1,19 @@
 """Anti-entropy: local state ↔ server catalog synchronization.
 
 Reference: agent/ae/ae.go:57,120 + agent/local/state.go:1227 SyncChanges.
-Periodic full sync with cluster-size-scaled stagger, plus triggered
-syncs coalesced over a short window when local state changes.
+Periodic full sync with cluster-size-scaled stagger + jitter, plus
+triggered syncs coalesced over a short window when local state changes.
+Failed syncs retry with jittered exponential backoff (ae.go
+retryFailTimer): under a member storm (the digital-twin soak's
+ChurnBurst against a straining server) every agent backing off
+independently is what keeps the server from being stampeded by
+synchronized retries the moment it staggers.
 """
 
 from __future__ import annotations
 
 import math
+import random
 import threading
 from typing import Any, Optional
 
@@ -15,18 +21,32 @@ from consul_tpu.types import CONSUL_SERVICE_ID
 from consul_tpu.utils import log
 from consul_tpu.utils.clock import RealTimers
 
+#: failure backoff window (reference ae.go retryFailIntv is a flat 15s;
+#: we start lower and double so a single blip retries fast while a
+#: down server sees exponentially thinning traffic)
+RETRY_BASE_S = 1.0
+RETRY_MAX_S = 60.0
+#: fraction of the periodic interval randomized away (scaleFactor's
+#: stagger companion: desynchronizes a fleet whose agents all started
+#: at once)
+PERIODIC_JITTER = 0.10
+
 
 class StateSyncer:
     def __init__(self, agent, interval: float = 60.0,
-                 coalesce: float = 0.2) -> None:
+                 coalesce: float = 0.2,
+                 rng: Optional[random.Random] = None) -> None:
         self.agent = agent
         self.base_interval = interval
         self.coalesce = coalesce
         self.log = log.named("anti_entropy")
         self.scheduler = RealTimers()
+        self.rng = rng or random.Random()
         self._stopped = False
         self._trigger_timer = None
         self._periodic_timer = None
+        self._retry_timer = None
+        self.failures = 0  # consecutive failed full syncs
         self._lock = threading.Lock()
 
     def start(self) -> None:
@@ -35,6 +55,16 @@ class StateSyncer:
     def stop(self) -> None:
         self._stopped = True
         self.scheduler.cancel_all()
+
+    def retry_backoff(self) -> float:
+        """Current jittered retry delay: RETRY_BASE_S doubling per
+        consecutive failure, capped at RETRY_MAX_S, ±50% jitter — the
+        one shared backoff helper at anti-entropy timing."""
+        from consul_tpu.server.rpc import retry_backoff_delay
+
+        return retry_backoff_delay(max(self.failures - 1, 0),
+                                   base=RETRY_BASE_S, cap=RETRY_MAX_S,
+                                   rng=self.rng)
 
     def trigger(self) -> None:
         """Coalesced sync request (called on every local-state change)."""
@@ -53,11 +83,13 @@ class StateSyncer:
         if self._stopped:
             return
         # interval scaled by cluster size (ae.go scaleFactor: stagger
-        # grows log-scale past 128 nodes so servers aren't stampeded)
+        # grows log-scale past 128 nodes so servers aren't stampeded),
+        # then jittered so a fleet started in lockstep spreads out
         n = max(len(self.agent.members()), 1)
         scale = max(1.0, math.log2(max(n, 2)) / math.log2(128.0)) \
             if n > 128 else 1.0
-        interval = self.base_interval * scale
+        interval = self.base_interval * scale \
+            * (1.0 + self.rng.random() * PERIODIC_JITTER)
         self._periodic_timer = self.scheduler.after(
             interval, self._periodic)
 
@@ -70,13 +102,30 @@ class StateSyncer:
     # ------------------------------------------------------------------ sync
 
     def sync(self) -> None:
-        """Full diff-and-push (local/state.go SyncFull)."""
+        """Full diff-and-push (local/state.go SyncFull). A failure
+        schedules ONE jittered-backoff retry (doubling per consecutive
+        failure) instead of waiting a whole periodic interval — and
+        instead of hammering a server that is already in trouble."""
         if self._stopped:
             return
         try:
             self._sync_once()
+            self.failures = 0
         except Exception as e:  # noqa: BLE001
-            self.log.warning("sync failed: %s", e)
+            self.failures += 1
+            delay = self.retry_backoff()
+            self.log.warning("sync failed (%d consecutive, retry in "
+                             "%.1fs): %s", self.failures, delay, e)
+            with self._lock:
+                if self._stopped or self._retry_timer is not None:
+                    return
+                self._retry_timer = self.scheduler.after(
+                    delay, self._retry)
+
+    def _retry(self) -> None:
+        with self._lock:
+            self._retry_timer = None
+        self.sync()
 
     def _sync_once(self) -> None:
         a = self.agent

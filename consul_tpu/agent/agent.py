@@ -27,7 +27,7 @@ from consul_tpu.version import __version__
 
 class Agent:
     def __init__(self, config: RuntimeConfig,
-                 serf_transport=None) -> None:
+                 serf_transport=None, serf_clock=None) -> None:
         self.config = config
         self.name = config.node_name or f"agent-{uuid.uuid4().hex[:8]}"
         if not config.node_name:
@@ -63,13 +63,14 @@ class Agent:
 
         if config.server_mode:
             self.server: Optional[Server] = Server(
-                config, serf_transport=serf_transport, tls=self.tls)
+                config, serf_transport=serf_transport, tls=self.tls,
+                serf_clock=serf_clock)
             self.client: Optional[Client] = None
             self.node_id = self.server.node_id
         else:
             self.server = None
             self.client = Client(config, serf_transport=serf_transport,
-                                 tls=self.tls)
+                                 tls=self.tls, serf_clock=serf_clock)
             self.node_id = self.client.node_id
 
         self.local = LocalState(

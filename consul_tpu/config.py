@@ -261,6 +261,16 @@ class RuntimeConfig:
     # Surfaced as rpc.workers.size / rpc.workers.queue_depth in
     # /v1/agent/perf so saturation is observable rather than guessed.
     rpc_workers: int = 32
+    # Worker-pool admission bound: dispatches past this queue depth are
+    # SHED with a structured retryable error instead of queueing
+    # unboundedly behind a stall (rpc.workers.rejected counts them next
+    # to the rpc.workers.queue_depth gauge). 0 disables shedding.
+    rpc_queue_limit: int = 1024
+    # `?near=` RTT-sort bound: result sets past this size get the full
+    # RTT order only for the nearest `limit` entries (the remainder is
+    # appended unsorted) — a twin-scale catalog must not pay an O(N
+    # log N) Vivaldi sort per DNS query
+    rpc_near_sort_limit: int = 512
     # per-client-IP HTTP connection cap (limits.http_max_conns_per_client)
     http_max_conns_per_client: int = 200
     # Non-voting read replica (reference read_replica, formerly

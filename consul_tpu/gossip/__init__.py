@@ -23,11 +23,14 @@ pluggable (agent/consul/wanfed/wanfed.go:42-68).
 """
 
 from consul_tpu.gossip.transport import (InMemNetwork, InMemTransport,
-                                         Transport, UDPTransport)
+                                         PeerEndpoint, Transport,
+                                         UDPTransport)
 from consul_tpu.gossip.swim import Memberlist, MemberlistDelegate
 from consul_tpu.gossip.serf import Serf, SerfEvent, EventType
+from consul_tpu.gossip.virtual import VirtualPeerProvider
 
 __all__ = [
     "Transport", "InMemNetwork", "InMemTransport", "UDPTransport",
+    "PeerEndpoint", "VirtualPeerProvider",
     "Memberlist", "MemberlistDelegate", "Serf", "SerfEvent", "EventType",
 ]

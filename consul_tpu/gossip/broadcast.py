@@ -9,6 +9,7 @@ limits (internal/gossip/libserf/serf.go:25-27 MinQueueDepth=4096).
 
 from __future__ import annotations
 
+import heapq
 import math
 import threading
 from typing import Optional
@@ -34,6 +35,11 @@ class TransmitLimitedQueue:
         self.queue_depth_warning = queue_depth_warning
         self._warned = False
         self._by_key: dict[str, Broadcast] = {}
+        # subject -> live key index: invalidation used to scan every
+        # queued key per enqueue, which made a digital-twin join storm
+        # (N alive rumors queued back to back) O(N²) — the index keeps
+        # enqueue O(1) at any depth
+        self._key_by_subject: dict[str, str] = {}
         # accessed from packet-handler threads and timer threads in
         # real-clock mode
         self._lock = threading.Lock()
@@ -56,11 +62,11 @@ class TransmitLimitedQueue:
         prefix (e.g. a new alive:node7 replaces suspect:node7)."""
         subject = key.split(":", 1)[-1]
         with self._lock:
-            stale = [k for k in self._by_key
-                     if k.split(":", 1)[-1] == subject]
-            for k in stale:
-                del self._by_key[k]
+            stale = self._key_by_subject.get(subject)
+            if stale is not None:
+                self._by_key.pop(stale, None)
             self._by_key[key] = Broadcast(key, payload)
+            self._key_by_subject[subject] = key
 
     def get_batch(self, n_nodes: int, budget: int,
                   overhead: int = 3) -> list[bytes]:
@@ -85,8 +91,19 @@ class TransmitLimitedQueue:
         out: list[bytes] = []
         used = 0
         with self._lock:
-            for b in sorted(self._by_key.values(),
-                            key=lambda b: b.transmits):
+            # bounded candidate selection: a packet fits ~budget/24
+            # rumors at most, so rank only that many fewest-transmit
+            # entries (O(Q + k log Q)) instead of fully sorting the
+            # queue — at twin-scale depths (10⁵ rumors after a join
+            # storm) the full sort per gossip tick was the hot path
+            k = max(8, budget // 24)
+            if len(self._by_key) > k:
+                cand = heapq.nsmallest(k, self._by_key.values(),
+                                       key=lambda b: b.transmits)
+            else:
+                cand = sorted(self._by_key.values(),
+                              key=lambda b: b.transmits)
+            for b in cand:
                 cost = len(b.payload) + overhead
                 if used + cost > budget:
                     continue
@@ -94,17 +111,24 @@ class TransmitLimitedQueue:
                 used += cost
                 b.transmits += 1
                 if b.transmits >= limit:
-                    del self._by_key[b.key]
+                    self._drop(b.key)
         return out
+
+    def _drop(self, key: str) -> None:
+        """Remove one entry + its subject-index row (lock held)."""
+        if self._by_key.pop(key, None) is not None:
+            subject = key.split(":", 1)[-1]
+            if self._key_by_subject.get(subject) == key:
+                del self._key_by_subject[subject]
 
     def prune(self, max_depth: Optional[int] = None) -> None:
         """Drop oldest-by-transmit-count entries beyond max queue depth."""
         depth = max_depth if max_depth is not None else self.min_queue_depth
         with self._lock:
-            if len(self._by_key) <= depth:
+            over = len(self._by_key) - depth
+            if over <= 0:
                 return
-            victims = sorted(
-                self._by_key.values(),
-                key=lambda b: -b.transmits)[:len(self._by_key) - depth]
+            victims = heapq.nlargest(over, self._by_key.values(),
+                                     key=lambda b: b.transmits)
             for v in victims:
-                del self._by_key[v.key]
+                self._drop(v.key)

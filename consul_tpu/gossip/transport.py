@@ -54,6 +54,26 @@ class Transport:
         pass
 
 
+class PeerEndpoint:
+    """What the network needs from a deliverable peer — the provider
+    seam. A real `InMemTransport` satisfies it natively; a virtual-peer
+    provider (gossip/virtual.py) synthesizes endpoints for addresses no
+    transport was ever attached for, so one registry can mix a handful
+    of real processes with millions of sim-backed members. Faults
+    (loss, partitions, delays — the knobs FaultInjector drives) apply
+    BEFORE endpoint lookup, so virtual peers face the same gauntlet
+    real ones do."""
+
+    closed: bool = False
+
+    def _dispatch_packet(self, src: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def handle_stream(self, src: str, payload: bytes) -> bytes:
+        """Synchronous stream exchange (push/pull, fallback ping)."""
+        raise ConnectionError("endpoint accepts no streams")
+
+
 class InMemNetwork:
     """Registry of in-memory transports with fault injection.
 
@@ -61,6 +81,11 @@ class InMemNetwork:
     delivery is scheduled as a clock timer at now+latency; loss and
     partitions drop packets. This is the test vehicle for SWIM semantics
     (deterministic-clock validation, SURVEY.md §7 stage 2).
+
+    Besides attached transports, the network consults registered
+    endpoint PROVIDERS (`register_provider`) for unknown destination
+    addresses — the virtual-peer seam the million-member digital twin
+    plugs into (gossip/virtual.py).
     """
 
     def __init__(self, clock: Optional[SimClock] = None, seed: int = 0,
@@ -70,6 +95,7 @@ class InMemNetwork:
         self.loss = loss
         self.latency = latency
         self.transports: dict[str, "InMemTransport"] = {}
+        self.providers: list = []  # endpoint providers, in order
         self._partitions: list[tuple[set[str], set[str]]] = []
         # structured fault knobs (driven by faults.FaultInjector):
         # directed link drops compose with per-node ingress/egress loss;
@@ -86,6 +112,25 @@ class InMemNetwork:
         t = InMemTransport(self, addr)
         self.transports[addr] = t
         return t
+
+    def register_provider(self, provider) -> None:
+        """Register an endpoint provider: `provider.endpoint(addr)`
+        returns a PeerEndpoint for addresses it owns, None otherwise.
+        Attached transports always win (a real node shadows a virtual
+        one at the same address)."""
+        self.providers.append(provider)
+
+    def endpoint(self, addr: str):
+        """Resolve `addr` to a deliverable endpoint (transport or
+        provider-synthesized), or None."""
+        t = self.transports.get(addr)
+        if t is not None:
+            return t
+        for p in self.providers:
+            ep = p.endpoint(addr)
+            if ep is not None:
+                return ep
+        return None
 
     def partition(self, a: set[str], b: set[str]) -> None:
         """Drop all traffic between address sets a and b."""
@@ -137,7 +182,7 @@ class InMemNetwork:
             p_fault = self._fault_drop_prob(src, dst)
             if p_fault and self.rng.random() < p_fault:
                 continue
-            tgt = self.transports.get(dst)
+            tgt = self.endpoint(dst)
             if tgt is None or tgt.closed:
                 return
             jitter = self.latency * (0.5 + self.rng.random())
@@ -165,10 +210,10 @@ class InMemNetwork:
         if self.node_delay.get(dst, 0.0) > timeout:
             raise ConnectionError(
                 f"stream timeout after {timeout}s: {src} -> {dst}")
-        tgt = self.transports.get(dst)
-        if tgt is None or tgt.closed or tgt._on_stream is None:
+        tgt = self.endpoint(dst)
+        if tgt is None or tgt.closed:
             raise ConnectionError(f"connection refused: {dst}")
-        return tgt._on_stream(src, payload)
+        return tgt.handle_stream(src, payload)
 
 
 class InMemTransport(Transport):
@@ -200,6 +245,11 @@ class InMemTransport(Transport):
     def _dispatch_packet(self, src: str, payload: bytes) -> None:
         if not self.closed and self._on_packet is not None:
             self._on_packet(src, payload)
+
+    def handle_stream(self, src: str, payload: bytes) -> bytes:
+        if self._on_stream is None:
+            raise ConnectionError(f"connection refused: {self.addr}")
+        return self._on_stream(src, payload)
 
     def shutdown(self) -> None:
         self.closed = True

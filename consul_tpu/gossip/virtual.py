@@ -1,0 +1,473 @@
+"""Sim-backed virtual-peer membership plane — the digital-twin bridge.
+
+`VirtualPeerProvider` plugs into `InMemNetwork`'s endpoint-provider
+seam (gossip/transport.py) and synthesizes wire-level SWIM traffic for
+N virtual members from live `SimState` snapshots, so ONE real agent
+(catalog, health, DNS, watches, serf event pipeline) experiences an
+N-member cluster without N processes:
+
+  * probe plane — PINGs addressed to a virtual peer are ACKed after the
+    pair's topology RTT (sim/topology.py embedding; the ack carries a
+    coordinate synthesized from the peer's latency-space position, so
+    the agent's Vivaldi client and RTT-aware probe deadlines see real
+    structure). Dead peers stay silent; slow peers answer past the
+    probe deadline, exactly the GC-pause model the batched sim runs.
+  * indirect-probe plane — INDIRECT_PINGs are relayed against the
+    target's ground-truth liveness (ACK/NACK back to the requester).
+  * anti-entropy plane — push/pull streams answer with a full member
+    digest built from the state arrays, encoded through the SAME
+    messages codec real members use (the digest round-trips
+    `m.decode(m.encode(...))` bitwise — pinned in tests/test_twin.py).
+  * rumor plane — `ingest(state)` diffs consecutive sim snapshots and
+    gossips the deltas (suspect/alive/dead, left on LEFT) to every
+    attached real transport as compound packets, paced across the
+    ingest horizon and bounded by a backlog cap that SHEDS visibly
+    (`stats["rumors_shed"]`) instead of stalling the bridge.
+  * refutation plane — a SUSPECT/DEAD claim about a virtual peer that
+    is alive in the sim is refuted with a higher-incarnation ALIVE
+    broadcast, the same race real SWIM runs (so agent-side false
+    positives heal instead of sticking).
+
+Churn/partitions come from the EXISTING FaultPlan machinery: the sim
+side runs the compiled plan (faults.compile_plan) and this bridge
+reflects the resulting state deltas; the network side can additionally
+arm `FaultInjector` over the same node ids — `addr_of(i)` gives the
+virtual address of sim node i, so one NodeSpec selector means the same
+nodes on both halves.
+
+Everything is scheduled on the network's clock (SimClock in tests and
+soaks: advancing virtual time drives probe acks, rumor pacing and
+refutations deterministically).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from consul_tpu.gossip import messages as m
+from consul_tpu.gossip.transport import MAX_PACKET_SIZE, PeerEndpoint
+from consul_tpu.utils import log
+
+#: member-status wire encodings (match types.MemberStatus / sim.state)
+_ALIVE, _SUSPECT, _DEAD, _LEFT = 1, 2, 3, 5
+
+#: sim down_age sentinel for live-but-degraded (state.SLOW_AGE)
+_SLOW_AGE = -2
+
+#: Vivaldi coordinate dimensionality on the wire (types.Coordinate)
+_COORD_DIMS = 8
+
+
+class _VirtualEndpoint(PeerEndpoint):
+    """One virtual peer's deliverable endpoint (provider-backed)."""
+
+    __slots__ = ("p", "i")
+
+    def __init__(self, provider: "VirtualPeerProvider", i: int) -> None:
+        self.p = provider
+        self.i = i
+
+    @property
+    def closed(self) -> bool:
+        # a crashed peer's endpoint swallows traffic the way a dead
+        # process would (no RST on UDP; streams refuse below)
+        return False
+
+    def _dispatch_packet(self, src: str, payload: bytes) -> None:
+        self.p._on_peer_packet(self.i, src, payload)
+
+    def handle_stream(self, src: str, payload: bytes) -> bytes:
+        return self.p._on_peer_stream(self.i, src, payload)
+
+
+class VirtualPeerProvider:
+    """Synthesize an N-member SWIM cluster from sim state snapshots.
+
+    Parameters
+    ----------
+    net : InMemNetwork — the registry to serve endpoints into (the
+        provider registers itself).
+    n : number of virtual members (sim node ids 0..n-1).
+    topo : sim/topology.Topology for n+1 nodes (index n is the real
+        agent's position) or None to draw one from `topo_params`.
+    gossip : GossipConfig the timing constants come from (probe
+        deadline for NACKs, slow-peer penalty).
+    rumor_horizon_s : default pacing window rumors spread over per
+        ingest (overridable per call).
+    max_rumor_backlog : rumor queue bound; overflow drops OLDEST and
+        counts `stats["rumors_shed"]` (graceful shedding, never a
+        stall — push/pull repairs what shedding lost, exactly
+        memberlist's own story for dropped gossip).
+    """
+
+    def __init__(self, net, n: int, topo=None, topo_params=None,
+                 gossip=None, prefix: str = "vp-", seed: int = 0,
+                 rumor_horizon_s: float = 1.0,
+                 max_rumor_backlog: int = 65536,
+                 digest_cache: bool = True) -> None:
+        from consul_tpu.config import GossipConfig
+
+        self.net = net
+        self.n = int(n)
+        self.prefix = prefix
+        self.gossip = gossip or GossipConfig.lan()
+        self.rng = random.Random(seed)
+        self.rumor_horizon_s = float(rumor_horizon_s)
+        self.max_rumor_backlog = int(max_rumor_backlog)
+        self.log = log.named("gossip.virtual")
+
+        # ---- topology: n virtual positions + the agent at index n
+        if topo is None:
+            from consul_tpu.sim.topology import (TopologyParams,
+                                                 make_topology)
+
+            tp = (topo_params or TopologyParams()).with_(n=self.n + 1,
+                                                         seed=seed)
+            topo = make_topology(tp)
+        self._pos = np.asarray(topo.pos, np.float32)
+        self._height = np.asarray(topo.height, np.float32)
+        if self._pos.shape[0] < self.n + 1:
+            raise ValueError(
+                f"topology has {self._pos.shape[0]} nodes; the twin "
+                f"needs n+1={self.n + 1} (index n is the real agent)")
+
+        # ---- ground-truth member state (host mirrors of SimState)
+        self.status = np.full(self.n, _ALIVE, np.int16)
+        self.incarnation = np.zeros(self.n, np.int32)
+        self.alive = np.ones(self.n, bool)
+        self.slow = np.zeros(self.n, bool)
+        self.version = 0          # bumps per ingest (digest cache key)
+        self._inc_bump: dict[int, int] = {}  # refutation overrides
+        self._rumors: list[tuple[int, int]] = []  # (node id, status)
+        self._digest_cache: Optional[tuple[int, list]] = None
+        self._use_digest_cache = digest_cache
+
+        self._endpoints: dict[int, _VirtualEndpoint] = {}
+        #: real members observed on the wire: addr -> memberlist name
+        self._real_names: dict[str, str] = {}
+        self.stats: dict[str, int] = {
+            "pings_acked": 0, "pings_dead": 0, "indirect": 0,
+            "push_pulls": 0, "rumors_sent": 0, "rumors_shed": 0,
+            "refutes": 0, "user_msgs": 0}
+        net.register_provider(self)
+
+    # ------------------------------------------------------- addressing
+
+    def addr_of(self, i: int) -> str:
+        return f"vp://{i}"
+
+    def name_of(self, i: int) -> str:
+        return f"{self.prefix}{i}"
+
+    def id_of_addr(self, addr: str) -> Optional[int]:
+        if not addr.startswith("vp://"):
+            return None
+        try:
+            i = int(addr[5:])
+        except ValueError:
+            return None
+        return i if 0 <= i < self.n else None
+
+    def id_of_name(self, name: str) -> Optional[int]:
+        if not name.startswith(self.prefix):
+            return None
+        try:
+            i = int(name[len(self.prefix):])
+        except ValueError:
+            return None
+        return i if 0 <= i < self.n else None
+
+    def endpoint(self, addr: str):
+        i = self.id_of_addr(addr)
+        if i is None:
+            return None
+        ep = self._endpoints.get(i)
+        if ep is None:
+            ep = self._endpoints[i] = _VirtualEndpoint(self, i)
+        return ep
+
+    # ------------------------------------------------------- state feed
+
+    def ingest(self, state, horizon_s: Optional[float] = None) -> int:
+        """Pull a SimState snapshot (device or host) and gossip the
+        deltas. Returns how many member transitions were queued."""
+        import jax
+
+        st = jax.device_get((state.status, state.incarnation,
+                             state.down_age))
+        return self.ingest_arrays(
+            np.asarray(st[0]), np.asarray(st[1]), np.asarray(st[2]),
+            horizon_s=horizon_s)
+
+    def ingest_arrays(self, status: np.ndarray, incarnation: np.ndarray,
+                      down_age: np.ndarray,
+                      horizon_s: Optional[float] = None) -> int:
+        """Host-array twin of `ingest` (tests; host-side runners)."""
+        status = status.astype(np.int16, copy=False)
+        incarnation = incarnation.astype(np.int32, copy=False)
+        changed = np.flatnonzero((status != self.status)
+                                 | (incarnation != self.incarnation))
+        self.status = np.array(status, copy=True)
+        self.incarnation = np.array(incarnation, copy=True)
+        self.alive = np.asarray(down_age) < 0
+        self.slow = np.asarray(down_age) == _SLOW_AGE
+        self.version += 1
+        # a sim-side incarnation step supersedes any refutation bump
+        for j in changed.tolist():
+            self._inc_bump.pop(j, None)
+        if changed.size:
+            self._queue_rumors(changed.tolist())
+            self._flush_rumors(self.rumor_horizon_s if horizon_s is None
+                               else float(horizon_s))
+        return int(changed.size)
+
+    def effective_inc(self, j: int) -> int:
+        """Incarnation on the wire: sim incarnation plus any refutation
+        bump this bridge had to mint to beat agent-side claims."""
+        return int(self.incarnation[j]) + self._inc_bump.get(j, 0)
+
+    # ----------------------------------------------------------- rumors
+
+    def _queue_rumors(self, ids: Sequence[int]) -> None:
+        for j in ids:
+            self._rumors.append((j, int(self.status[j])))
+        over = len(self._rumors) - self.max_rumor_backlog
+        if over > 0:
+            # shed OLDEST: the newest transition per node is the one
+            # that matters, and push/pull repairs anything dropped
+            del self._rumors[:over]
+            self.stats["rumors_shed"] += over
+
+    def _rumor_body(self, j: int, status: int) -> tuple[int, dict]:
+        inc = self.effective_inc(j)
+        name = self.name_of(j)
+        if status == _SUSPECT:
+            return m.SUSPECT, {"node": name, "inc": inc,
+                               "from": self.name_of((j + 1) % self.n)}
+        if status in (_DEAD, _LEFT):
+            return m.DEAD, {"node": name, "inc": inc,
+                            "from": self.name_of((j + 1) % self.n),
+                            "left": status == _LEFT}
+        return m.ALIVE, {"node": name, "inc": inc,
+                         "addr": self.addr_of(j), "tags": {}}
+
+    def _flush_rumors(self, horizon_s: float) -> None:
+        """Pack queued rumors into compound gossip packets toward every
+        attached real transport, paced across `horizon_s` seconds."""
+        if not self._rumors:
+            return
+        targets = list(self.net.transports)
+        if not targets:
+            self._rumors.clear()
+            return
+        rumors, self._rumors = self._rumors, []
+        packets: list[bytes] = []
+        batch: list[bytes] = []
+        used = 0
+        for j, status in rumors:
+            enc = m.encode(*self._rumor_body(j, status))
+            if used + len(enc) + 3 > MAX_PACKET_SIZE - 16 and batch:
+                packets.append(batch[0] if len(batch) == 1
+                               else m.make_compound(batch))
+                batch, used = [], 0
+            batch.append(enc)
+            used += len(enc) + 3
+        if batch:
+            packets.append(batch[0] if len(batch) == 1
+                           else m.make_compound(batch))
+        gap = max(horizon_s, 1e-6) / max(len(packets), 1)
+        for k, pkt in enumerate(packets):
+            src = self.addr_of(self.rng.randrange(self.n))
+            for tgt in targets:
+                self.net.clock.after(
+                    k * gap + self._rtt_to_agent(self.id_of_addr(src)),
+                    lambda p=pkt, s=src, t=tgt:
+                        self.net.deliver_packet(s, t, p))
+        self.stats["rumors_sent"] += len(rumors)
+
+    # ------------------------------------------------------ wire planes
+
+    def _rtt(self, i: int, j: int) -> float:
+        d = self._pos[i] - self._pos[j]
+        return float(np.sqrt(np.dot(d, d))
+                     + self._height[i] + self._height[j])
+
+    def _rtt_to_agent(self, i: Optional[int]) -> float:
+        # index n is the real agent's slot in the embedding
+        return self._rtt(i, self.n) if i is not None else 0.001
+
+    def _coord_of(self, i: int) -> dict[str, Any]:
+        vec = [0.0] * _COORD_DIMS
+        for d in range(min(self._pos.shape[1], _COORD_DIMS)):
+            vec[d] = float(self._pos[i][d])
+        return {"Vec": vec, "Error": 0.2, "Adjustment": 0.0,
+                "Height": max(float(self._height[i]), 1e-5)}
+
+    def _delay_for(self, i: int, extra_slow: bool = True) -> float:
+        rtt = self._rtt_to_agent(i)
+        if extra_slow and self.slow[i]:
+            # GC-pause model: the ack lands past the scaled probe
+            # deadline, pushing the prober to the indirect phase —
+            # same dynamics as the batched sim's slow mask
+            rtt += self.gossip.probe_timeout * 2.0
+        return rtt
+
+    def _send_later(self, delay: float, src_addr: str, dst: str,
+                    payload: bytes) -> None:
+        self.net.clock.after(
+            delay, lambda: self.net.deliver_packet(src_addr, dst,
+                                                   payload))
+
+    def _on_peer_packet(self, i: int, src: str, raw: bytes) -> None:
+        try:
+            if raw and raw[0] == m.COMPOUND:
+                for part in m.split_compound(raw):
+                    self._handle_one(i, src, part)
+            else:
+                self._handle_one(i, src, raw)
+        except Exception as e:  # noqa: BLE001 — a bad packet must not
+            self.log.debug("virtual peer %d bad packet: %s", i, e)
+
+    def _handle_one(self, i: int, src: str, raw: bytes) -> None:
+        t, body = m.decode(raw)
+        if t == m.PING:
+            self._learn_real(body.get("addr") or src, body.get("from"))
+            if body.get("node") != self.name_of(i) or not self.alive[i]:
+                self.stats["pings_dead"] += not self.alive[i]
+                return
+            ack = m.encode(m.ACK, {"seq": body.get("seq", 0),
+                                   "payload": {
+                                       "coord": self._coord_of(i),
+                                       "node": self.name_of(i)}})
+            self._send_later(self._delay_for(i),
+                             self.addr_of(i), body.get("addr") or src,
+                             ack)
+            self.stats["pings_acked"] += 1
+        elif t == m.INDIRECT_PING:
+            self._learn_real(body.get("from_addr") or src,
+                             body.get("from"))
+            if not self.alive[i]:
+                return  # a dead relay relays nothing
+            self.stats["indirect"] += 1
+            origin = body.get("from_addr") or src
+            tgt = self.id_of_addr(body.get("addr", ""))
+            # virtual target: answer from ground truth; real target:
+            # it is a live attached process (the fault gauntlet
+            # already shaped whether this request arrived at all)
+            up = self.alive[tgt] if tgt is not None \
+                else body.get("addr", "") in self.net.transports
+            if up:
+                delay = self._delay_for(i, extra_slow=False) \
+                    + (self._rtt(i, tgt) if tgt is not None else 0.001)
+                if tgt is not None and self.slow[tgt]:
+                    delay += self.gossip.probe_timeout * 2.0
+                self._send_later(delay, self.addr_of(i), origin,
+                                 m.encode(m.ACK, {
+                                     "seq": body.get("seq", 0),
+                                     "payload": {}}))
+            else:
+                self._send_later(
+                    self.gossip.probe_timeout, self.addr_of(i), origin,
+                    m.encode(m.NACK, {"seq": body.get("seq", 0)}))
+        elif t in (m.SUSPECT, m.DEAD):
+            j = self.id_of_name(body.get("node", ""))
+            if j is not None and self.alive[j]:
+                self._refute(j, int(body.get("inc", 0)))
+        elif t == m.ACK or t == m.NACK:
+            pass  # answers to our synthetic probes of real members
+        elif t in (m.USER, m.QUERY, m.QUERY_RESPONSE, m.LEAVE_INTENT,
+                   m.JOIN_INTENT):
+            self.stats["user_msgs"] += 1
+        # ALIVE rumors about virtual peers are ignored: the sim is
+        # authoritative for virtual ground truth
+
+    def _refute(self, j: int, claimed_inc: int) -> None:
+        """Alive-with-higher-incarnation broadcast beating `claimed`,
+        to every real transport (the SWIM refutation race)."""
+        cur = self.effective_inc(j)
+        if claimed_inc >= cur:
+            self._inc_bump[j] = claimed_inc + 1 - int(self.incarnation[j])
+            # the bump changes what push/pull must serve: a cached
+            # pre-bump digest would let the agent's DEAD@k win the
+            # merge if this refutation packet is lost to the fault
+            # gauntlet — exactly the repair push/pull exists for
+            self._digest_cache = None
+        body = {"node": self.name_of(j), "inc": self.effective_inc(j),
+                "addr": self.addr_of(j), "tags": {}}
+        pkt = m.encode(m.ALIVE, body)
+        for tgt in list(self.net.transports):
+            self._send_later(self._rtt_to_agent(j), self.addr_of(j),
+                             tgt, pkt)
+        self.stats["refutes"] += 1
+
+    def _learn_real(self, addr: Optional[str], name: Optional[str]
+                    ) -> None:
+        if addr and name and addr in self.net.transports:
+            self._real_names[addr] = name
+
+    # -------------------------------------------------------- push/pull
+
+    def member_digest(self) -> list[dict[str, Any]]:
+        """Full member-state digest (memberlist push/pull `nodes` list)
+        in codec-exact shape — every entry round-trips
+        ``m.decode(m.encode(m.PUSH_PULL, {"nodes": [...]}))`` bitwise.
+        Cached per ingest version (the arrays only move at ingest)."""
+        if self._use_digest_cache and self._digest_cache is not None \
+                and self._digest_cache[0] == self.version:
+            return self._digest_cache[1]
+        status = self.status
+        inc = self.incarnation
+        nodes = [{"name": self.name_of(j), "addr": self.addr_of(j),
+                  "inc": int(inc[j]) + self._inc_bump.get(j, 0),
+                  "status": int(status[j])}
+                 for j in range(self.n)]
+        if self._use_digest_cache:
+            self._digest_cache = (self.version, nodes)
+        return nodes
+
+    def _on_peer_stream(self, i: int, src: str, raw: bytes) -> bytes:
+        if not self.alive[i]:
+            raise ConnectionError(
+                f"connection refused: {self.addr_of(i)} (peer down)")
+        t, body = m.decode(raw)
+        if t == m.PUSH_PULL:
+            self.stats["push_pulls"] += 1
+            self._learn_real(src, body.get("from"))
+            return m.encode(m.PUSH_PULL, {
+                "nodes": self.member_digest(),
+                "from": self.name_of(i)})
+        if t == m.PING:
+            if self.slow[i]:
+                # GC-pause model on the STREAM plane too: the fallback
+                # ping's deadline is the sub-second indirect-phase
+                # remainder, and a slow peer's answer lands past it —
+                # an instant stream ACK here would cancel the very
+                # timeout the UDP plane just modelled (same semantics
+                # as InMemNetwork.stream's node_delay timeout)
+                raise ConnectionError(
+                    f"stream timeout: {self.addr_of(i)} (slow peer)")
+            return m.encode(m.ACK, {"seq": body.get("seq", 0),
+                                    "payload": {
+                                        "coord": self._coord_of(i),
+                                        "node": self.name_of(i)}})
+        raise ValueError(f"unexpected stream type {t}")
+
+    # --------------------------------------------------------- topology
+
+    def near_rank(self, near_id: int, k: int) -> dict[str, int]:
+        """Rank map {member name -> ascending RTT rank} of the k
+        virtual peers nearest `near_id` in the ground-truth embedding
+        — the device-free twin of sim/coords.nearest_k, used to wire
+        the server's bounded `?near=` sort to the sim topology."""
+        d = self._pos - self._pos[near_id]
+        rtt = np.sqrt((d * d).sum(axis=1))[:self.n] \
+            + self._height[:self.n] + self._height[near_id]
+        if 0 <= near_id < self.n:
+            rtt[near_id] = np.inf
+        k = min(k, self.n)
+        idx = np.argpartition(rtt, k - 1)[:k]
+        idx = idx[np.argsort(rtt[idx])]
+        return {self.name_of(int(j)): r for r, j in enumerate(idx)}

@@ -42,8 +42,8 @@ class Role(enum.Enum):
 
 
 class NotLeader(Exception):
-    def __init__(self, leader: Optional[str]) -> None:
-        super().__init__(f"not leader (leader hint: {leader})")
+    def __init__(self, leader: Optional[str], note: str = "") -> None:
+        super().__init__(f"not leader (leader hint: {leader}){note}")
         self.leader = leader
 
 
@@ -283,9 +283,17 @@ class RaftNode:
             # leadership never lapsed.
             if last > self.store.snapshot_index:
                 if self.store.term_at(last) != term:
+                    # our entry was OVERWRITTEN by the new leader's log
+                    # — it never applied, so the caller may re-submit
                     raise NotLeader(self.leader_id)
             elif self._leadership_era != era:
-                raise NotLeader(self.leader_id)
+                # compacted AND leadership lapsed: the entry committed,
+                # but possibly under the usurper — the outcome is
+                # unknowable here. The note makes retry loops
+                # (rpc.is_retryable_rpc_error) refuse to re-send: a
+                # blind retry could apply a committed write twice.
+                raise NotLeader(self.leader_id,
+                                note="; commit indeterminate")
             return [self._apply_results.pop(first + off, None)
                     for off in result_offsets]
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 import uuid
 from typing import Any, Optional
 
@@ -18,7 +19,9 @@ from consul_tpu.config import RuntimeConfig
 from consul_tpu.gossip import Serf
 from consul_tpu.gossip.serf import EventType, SerfEvent
 from consul_tpu.gossip.transport import Transport, UDPTransport
-from consul_tpu.server.rpc import ConnPool, RPCError
+from consul_tpu.server.rpc import (ConnPool, RPCError,
+                                   is_retryable_rpc_error,
+                                   retry_backoff_delay)
 from consul_tpu.types import MemberStatus
 from consul_tpu.utils import log
 
@@ -30,8 +33,9 @@ class NoServersError(RPCError):
 class Client:
     def __init__(self, config: RuntimeConfig,
                  serf_transport: Optional[Transport] = None,
-                 tls=None) -> None:
+                 tls=None, serf_clock=None) -> None:
         self.config = config
+        self._serf_clock = serf_clock
         self.name = config.node_name or f"client-{uuid.uuid4().hex[:8]}"
         self.node_id = config.node_id or str(uuid.uuid4())
         self.log = log.named(f"client.{self.name}")
@@ -72,6 +76,7 @@ class Client:
             transport=serf_transport or UDPTransport(
                 config.bind_addr,
                 config.port("serf_lan")),
+            clock=serf_clock,
             config=config.gossip_lan,
             tags=tags,
             event_handler=self._serf_event,
@@ -100,14 +105,27 @@ class Client:
 
     # ----------------------------------------------------------------- RPC
 
+    #: client-side hold window for leader-transition retries —
+    #: the reference's RPCHoldTimeout (consul/config.go, 7s): a "no
+    #: leader" inside this window is an election in progress, not an
+    #: outage, and must not surface to the caller
+    RPC_HOLD_TIMEOUT = 7.0
+
     def rpc(self, method: str, args: dict[str, Any],
             retries: int = 3) -> Any:
         """Forward to a server; retry on transport errors with another
-        server (router rebalancing-lite). Snapshot ops ride the
-        dedicated RPC_SNAPSHOT stream — archives must not squeeze
-        through the request/response frame cap (pool.RPCSnapshot)."""
+        server (router rebalancing-lite), and retry leader-transition
+        / admission-shed errors (rpc.is_retryable_rpc_error) with
+        jittered exponential backoff inside RPC_HOLD_TIMEOUT — a
+        leader kill under load shows up as a latency blip, never as a
+        client-visible "no leader". Snapshot ops ride the dedicated
+        RPC_SNAPSHOT stream — archives must not squeeze through the
+        request/response frame cap (pool.RPCSnapshot)."""
         last: Exception = NoServersError("no known servers")
-        for _ in range(retries):
+        deadline = time.monotonic() + self.RPC_HOLD_TIMEOUT
+        transport_failures = 0
+        backoffs = 0
+        while True:
             server = self.servers.find()
             if server is None:
                 self._refresh_servers()
@@ -124,10 +142,22 @@ class Client:
                 return self.pool.call(server, method, args)
             except ConnectionError as e:
                 last = e
+                transport_failures += 1
                 # cycle the failed head to the tail: the retry hits a
                 # DIFFERENT server (manager.go NotifyFailedServer)
                 self.servers.notify_failed(server)
-        raise last
+                if transport_failures >= retries:
+                    raise last
+            except RPCError as e:
+                if not is_retryable_rpc_error(e):
+                    raise
+                last = e
+                backoffs += 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise last
+                time.sleep(min(retry_backoff_delay(backoffs),
+                               remaining))
 
     def _ping_server(self, addr: str) -> bool:
         try:

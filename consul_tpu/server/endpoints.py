@@ -189,24 +189,60 @@ def register_endpoints(srv) -> None:
 
     # ------------------------------------------------------------ Health
     def _near_sort(entries, near, node_of):
-        """RTT-sort results relative to `near` using Vivaldi coordinates
-        (agent/consul/rtt.go nodeSorter / ?near=)."""
+        """RTT-sort results relative to `near` (agent/consul/rtt.go
+        nodeSorter / ?near=), BOUNDED for twin-scale catalogs: past
+        `rpc_near_sort_limit` entries only the nearest `limit` get the
+        full RTT order (heapq.nsmallest, O(N log k)) and the remainder
+        rides behind unsorted — DNS and API consumers read the head,
+        and a 1M-row full sort per query is exactly the kind of cliff
+        the digital-twin soaks exist to find. A sim-backed provider
+        (`srv.near_rank`, wired by the twin bridge over the
+        ground-truth topology / coords.nearest_k) supplies ranks
+        without any per-entry coordinate lookups."""
         if not near:
             return entries
-        from consul_tpu.gossip.coordinate import distance
-        from consul_tpu.types import Coordinate
+        import heapq
 
-        ref = state.coordinate_get(near)
-        if ref is None:
-            return entries
-        ref_c = Coordinate.from_dict(ref["Coord"])
+        limit = max(int(getattr(srv.config, "rpc_near_sort_limit",
+                                512) or 512), 1)
+        inf = float("inf")
+        provider = getattr(srv, "near_rank", None)
+        key = None
+        if provider is not None:
+            try:
+                rank = provider(near, limit)
+            except Exception:  # noqa: BLE001 — provider never breaks reads
+                rank = None
+            if rank is not None:
+                # the provider ranks the GLOBALLY nearest k nodes; a
+                # filtered result set (one service's instances) may
+                # barely intersect it, and "rank or inf" would then
+                # order an arbitrary head. Use it only when it covers
+                # the head it is supposed to order; otherwise fall
+                # through to per-entry coordinate distances.
+                covered = sum(1 for e in entries if node_of(e) in rank)
+                if covered >= min(limit, len(entries)):
+                    key = lambda e: rank.get(node_of(e), inf)  # noqa: E731
+        if key is None:
+            from consul_tpu.gossip.coordinate import distance
+            from consul_tpu.types import Coordinate
 
-        def key(e):
-            c = state.coordinate_get(node_of(e))
-            if c is None:
-                return float("inf")
-            return distance(ref_c, Coordinate.from_dict(c["Coord"]))
+            ref = state.coordinate_get(near)
+            if ref is None:
+                return entries
+            ref_c = Coordinate.from_dict(ref["Coord"])
 
+            def key(e):
+                c = state.coordinate_get(node_of(e))
+                if c is None:
+                    return inf
+                return distance(ref_c, Coordinate.from_dict(c["Coord"]))
+
+        if len(entries) > limit:
+            perf.default.gauge_add("catalog.near_sort.bounded", 1)
+            head = heapq.nsmallest(limit, entries, key=key)
+            chosen = set(map(id, head))
+            return head + [e for e in entries if id(e) not in chosen]
         return sorted(entries, key=key)
 
     def health_service_nodes(args):

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextvars
 import heapq
+import random
 import selectors
 import socket
 import socketserver
@@ -121,8 +122,35 @@ def _workers_queue_depth() -> float:
                      for s in list(_RPC_SERVERS)))
 
 
+#: process-wide dispatches shed by worker-pool admission control (the
+#: bounded-queue refusal, next to the queue_depth gauge it guards)
+_WORKERS_REJECTED = [0]
+_WORKERS_REJECTED_LOCK = threading.Lock()
+
+
+def _workers_rejected(delta: int = 0) -> float:
+    if delta:
+        with _WORKERS_REJECTED_LOCK:
+            _WORKERS_REJECTED[0] += delta
+    return float(_WORKERS_REJECTED[0])
+
+
 perf.default.gauge_fn("rpc.workers.size", _workers_size)
 perf.default.gauge_fn("rpc.workers.queue_depth", _workers_queue_depth)
+perf.default.gauge_fn("rpc.workers.rejected",
+                      lambda: _workers_rejected())
+
+#: the admission-shed error string (clients see it inside a
+#: RetryableError; the wire frame additionally carries retryable=True)
+ERR_POOL_SATURATED = "server overloaded: rpc worker queue is full, retry"
+
+#: leader-transition error fragments: app-level RPCErrors carrying one
+#: of these are safe to retry with backoff inside the rpcHoldTimeout
+#: window (consul/rpc.go canRetry: structs.ErrNoLeader + "leadership
+#: lost" — the write was never applied, or was rejected before apply)
+_LEADER_TRANSITION = ("no known leader", "not leader",
+                      "failed to reach leader", "leadership lost",
+                      "no leader")
 
 
 class ParkContext:
@@ -196,6 +224,43 @@ class StreamTimeout(RPCError):
     (_forward_to_leader, Client.rpc, _forward_dc) treats
     ConnectionError as safe-to-resend, which a timed-out in-flight
     write is not."""
+
+
+class RetryableError(RPCError):
+    """Structured retryable refusal (admission shed, leader in
+    transition): the request was NOT executed, so re-sending it is
+    safe — unlike a StreamTimeout, whose handler may still be running."""
+
+
+def is_retryable_rpc_error(e: Exception) -> bool:
+    """Would retrying this app-level error be both SAFE (the request
+    was never applied) and USEFUL (the condition is transient)? True
+    for structured RetryableErrors and for leader-transition messages
+    — EXCEPT raft's commit-indeterminate branch (NotLeader raised
+    after the entry may have committed under a usurping leader, tagged
+    "commit indeterminate"), where a blind re-send could apply a
+    non-idempotent write twice."""
+    if isinstance(e, RetryableError):
+        return True
+    if isinstance(e, StreamTimeout) or not isinstance(e, RPCError):
+        return False
+    msg = str(e).lower()
+    if "indeterminate" in msg:
+        return False
+    return any(frag in msg for frag in _LEADER_TRANSITION)
+
+
+def retry_backoff_delay(attempt: int, base: float = 0.025,
+                        cap: float = 0.4, rng=None) -> float:
+    """Jittered exponential backoff — ONE implementation for every
+    retry loop in the stack: Client.rpc and Server._forward_to_leader
+    at RPC timing (consul/rpc.go retryLoop jitter — a leadership race
+    wakes every forwarding caller at once; without jitter they
+    re-dial the new leader in lockstep), and anti-entropy's failed
+    full syncs at their own base/cap (agent/ae.py). `rng` lets tests
+    seed the jitter."""
+    r = (rng or random).random()
+    return min(cap, base * (2.0 ** min(attempt, 12))) * (0.5 + r)
 
 
 def keyring_raft_auth(get_keyring):
@@ -745,7 +810,8 @@ class RPCServer:
     """The server side of the multiplexed port."""
 
     def __init__(self, bind_addr: str = "127.0.0.1", port: int = 0,
-                 workers: int = 32) -> None:
+                 workers: int = 32,
+                 queue_limit: Optional[int] = 1024) -> None:
         self.log = log.named("rpc.server")
         self.metrics = telemetry.default
         self._rpc_handler: Optional[Callable[[str, dict, str], Any]] = None
@@ -874,6 +940,11 @@ class RPCServer:
         self.workers = max(1, int(workers))
         self._workers = ThreadPoolExecutor(
             max_workers=self.workers, thread_name_prefix="rpc-worker")
+        # admission control (config.rpc_queue_limit): dispatches past
+        # this backlog are SHED with a structured retryable error —
+        # bounded degradation instead of a queue that grows until
+        # every request times out. 0/None disables.
+        self.queue_limit = int(queue_limit or 0)
         # method → fn(args, src, respond) -> bool; see _dispatch_mux
         self.async_handlers: dict[str, Callable] = {}
         # set by Server: (method, args) → True when the handler is a
@@ -1056,6 +1127,20 @@ class RPCServer:
                 args=(sess, sid, method, req_args, sess.src, led),
                 kwargs={"park": False},
                 daemon=True, name=f"mux-{sess.src}-{sid}").start()
+            return
+        if self.queue_limit \
+                and self._workers._work_queue.qsize() >= self.queue_limit:
+            # admission control: past the bound the pool is already
+            # minutes behind — queueing deeper only converts overload
+            # into timeouts. Shed with a STRUCTURED retryable error
+            # (the client's backoff loop re-submits; the handler never
+            # ran, so the retry is safe) and count it next to the
+            # queue_depth gauge that predicts it.
+            _workers_rejected(1)
+            self.metrics.incr("rpc.workers.rejected")
+            sess.send_obj({"sid": sid, "error": ERR_POOL_SATURATED,
+                           "retryable": True}, led=led)
+            sess.complete(sid)
             return
         try:
             self._workers.submit(self._run_mux_request, sess, sid,
@@ -1537,6 +1622,18 @@ class RPCServer:
                     req_args.get("MaxQueryTime"):
                 threading.Thread(target=run, daemon=True,
                                  name=f"mux-{src}-{sid}").start()
+            elif self.queue_limit and \
+                    self._workers._work_queue.qsize() >= self.queue_limit:
+                # same admission bound as the reactor path (TLS mux
+                # sessions ride this thread-per-session loop)
+                _workers_rejected(1)
+                self.metrics.incr("rpc.workers.rejected")
+                safe_write({"sid": sid, "error": ERR_POOL_SATURATED,
+                            "retryable": True})
+                with wlock:
+                    in_flight[0] -= 1
+                _mux_flight(-1)
+                perf.abandon(led)
             else:
                 self._workers.submit(run)
 
@@ -1861,6 +1958,10 @@ class _MuxConn:
         if resp is None:
             raise ConnectionError(f"connection closed by {self.addr}")
         if resp.get("error") is not None:
+            if resp.get("retryable"):
+                # structured refusal (admission shed / leader hold
+                # expiry): the handler never ran — safe to re-send
+                raise RetryableError(resp["error"])
             raise RPCError(resp["error"])
         return resp.get("result")
 

@@ -271,7 +271,12 @@ class Server:
     def __init__(self, config: RuntimeConfig,
                  serf_transport: Optional[Transport] = None,
                  rpc_bind: Optional[str] = None, tls=None,
-                 wan_transport: Optional[Transport] = None) -> None:
+                 wan_transport: Optional[Transport] = None,
+                 serf_clock=None) -> None:
+        # serf_clock: optional Clock/SimClock driving the LAN gossip
+        # engine's timers (the digital-twin soak advances a SimClock
+        # shared with its InMemNetwork; None = real time)
+        self._serf_clock = serf_clock
         self.config = config
         self.name = config.node_name or f"server-{uuid.uuid4().hex[:8]}"
         self.node_id = config.node_id or str(uuid.uuid4())
@@ -301,7 +306,9 @@ class Server:
         # RPC port (serves consul RPC + raft)
         self.rpc = RPCServer(rpc_bind or config.bind_addr,
                              config.port("server"),
-                             workers=config.rpc_workers)
+                             workers=config.rpc_workers,
+                             queue_limit=getattr(config,
+                                                 "rpc_queue_limit", 1024))
         self.rpc.max_conns_per_ip = config.rpc_max_conns_per_client
         # a blocking query can park as a thread-free continuation only
         # when it is served from LOCAL state: stale reads anywhere,
@@ -455,6 +462,7 @@ class Server:
             tags=tags,
             event_handler=self._serf_event,
             keyring=self._keyring(),
+            clock=serf_clock,
             merge_check=segment_merge_check(config.datacenter, ""))
         self.segment_serfs: dict[str, Serf] = {}
         for seg_name, transport in seg_transports.items():
@@ -962,6 +970,10 @@ class Server:
         hold = max(7.0, 6.0 * self.raft.election_timeout)
         deadline = time.monotonic() + hold
         last: Exception = NoLeaderError("no known leader")
+        from consul_tpu.server.rpc import (is_retryable_rpc_error,
+                                           retry_backoff_delay)
+
+        attempt = 0
         while True:
             if self.is_leader():
                 # leadership arrived mid-retry — serve locally
@@ -973,15 +985,17 @@ class Server:
                 except ConnectionError as e:
                     last = e
                 except RPCError as e:
-                    # retry only leadership races — application errors
-                    # must not be re-submitted (a bad command would be
-                    # re-committed on every retry)
-                    if "not leader" not in str(e):
+                    # retry only leadership races / structured sheds —
+                    # application errors must not be re-submitted (a
+                    # bad command would be re-committed every retry)
+                    if not is_retryable_rpc_error(e):
                         raise
                     last = e
             if time.monotonic() >= deadline:
                 break
-            time.sleep(min(0.1, max(0.0, deadline - time.monotonic())))
+            attempt += 1
+            time.sleep(min(retry_backoff_delay(attempt),
+                           max(0.0, deadline - time.monotonic())))
         raise NoLeaderError(f"failed to reach leader: {last}")
 
     #: RPC reads cheap and provably nonblocking enough to run INLINE

@@ -690,6 +690,50 @@ def _validate_scenario(name: str, d: dict) -> None:
     _require_num(name, d, ("wall_s",))
 
 
+def _validate_twin(name: str, d: dict) -> None:
+    """Digital-twin soak record (bench.py --twin): a virtual-member
+    ladder of rungs, each a real-agent soak (registry.TWIN_RUNG_KEYS)
+    or an honest skip naming its reason, plus the smoke-scale
+    re-measurement envelope --check-regression --family TWIN re-runs."""
+    _require(name, d, ("metric", "platform", "ladder", "smoke_guard"))
+    if not isinstance(d["ladder"], list) or not d["ladder"]:
+        raise LedgerError(f"{name}: ladder must be a non-empty list")
+    measured = 0
+    for i, rung in enumerate(d["ladder"]):
+        rn = f"{name}.ladder[{i}]"
+        if not isinstance(rung, dict):
+            raise LedgerError(f"{rn}: rung must be an object")
+        if rung.get("skipped"):
+            _require(rn, rung, ("n", "reason"))
+            continue
+        measured += 1
+        _require(rn, rung, registry.TWIN_RUNG_KEYS)
+        _require_num(rn, rung, ("join_s", "agent_p99_ms",
+                                "jain_fairness"))
+        if not rung.get("resume_digest_equal"):
+            raise LedgerError(
+                f"{rn}: resume_digest_equal must be true — a rung "
+                "whose checkpoint resume diverged is a broken run, "
+                "not a record")
+        err = rung["member_view_err_post_heal"]
+        if not isinstance(err, (int, float)) \
+                or err > registry.TWIN_CONVERGE_TOL:
+            raise LedgerError(
+                f"{rn}: member_view_err_post_heal {err!r} exceeds the "
+                f"convergence tolerance {registry.TWIN_CONVERGE_TOL} "
+                "— a rung that never converged must be an honest "
+                "skip, not a record whose capped converge_rounds "
+                "reads as merely slow")
+    if not measured:
+        raise LedgerError(
+            f"{name}: every rung skipped — record the failure as a "
+            "skipped BENCH-style envelope, not an empty twin ladder")
+    sg = d["smoke_guard"]
+    _require(f"{name}.smoke_guard", sg,
+             ("n", "rounds", "converge_rounds", "samples"))
+    _require_num(f"{name}.smoke_guard", sg, ("converge_rounds",))
+
+
 _VALIDATORS = {
     "BENCH": _validate_bench,
     "MULTICHIP": _validate_multichip,
@@ -700,6 +744,7 @@ _VALIDATORS = {
     "CHAOS": _validate_scenario,
     "COORDS": _validate_scenario,
     "TUNE": _validate_tune,
+    "TWIN": _validate_twin,
 }
 assert set(_VALIDATORS) == set(registry.LEDGER_FAMILIES)
 
@@ -834,6 +879,14 @@ def _headline_of(rec: dict[str, Any]):
         return (d.get("metric"), w.get("rounds_per_sec"), "rounds/s",
                 f"winner {w.get('config')} of {measured} measured "
                 f"configs (n={d.get('n')})")
+    if fam == "TWIN":
+        rungs = [r for r in d["ladder"] if not r.get("skipped")]
+        top = max(rungs, key=lambda r: r.get("n", 0))
+        skipped = len(d["ladder"]) - len(rungs)
+        return (d.get("metric"), top.get("agent_p99_ms"), "ms (p99)",
+                f"{top['n']:,} virtual members, jain "
+                f"{top.get('jain_fairness', 0):.3f}"
+                + (f", {skipped} rung(s) skipped" if skipped else ""))
     # CHAOS / COORDS
     if d.get("skipped"):
         return d.get("metric"), None, None, "skipped"
@@ -929,6 +982,23 @@ def latest_profile_util(records: list[dict]
                 "lane_blocks": best.get("lane_blocks"),
                 "smoke": bool(rec["data"].get("smoke")),
                 "n": rec["data"].get("n")}
+    return None
+
+
+def latest_twin_guard(records: list[dict]) -> Optional[dict[str, Any]]:
+    """The newest TWIN record's smoke-guard envelope — the
+    --check-regression --family TWIN baseline: {file, round, n,
+    rounds, converge_rounds, samples}. The guard re-runs the
+    smoke-scale twin (same n/rounds — the apples-to-apples workload
+    recorded alongside the at-scale soak) and compares convergence
+    rounds under the shared refusal band. None when no TWIN record
+    exists."""
+    twins = sorted((r for r in records if r["family"] == "TWIN"),
+                   key=lambda r: r["round"], reverse=True)
+    for rec in twins:
+        sg = rec["data"].get("smoke_guard")
+        if sg:
+            return {"file": rec["file"], "round": rec["round"], **sg}
     return None
 
 

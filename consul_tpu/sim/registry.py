@@ -470,9 +470,27 @@ PROFILE_ROOFLINE_ROW = (
 #: TUNE (PR 12) is the megakernel autotuner's record family
 #: (sim/autotune.py): each round persists the swept configs + the
 #: per-(platform, n) winner, so --history reconstructs the tuning
-#: trajectory like every other family.
+#: trajectory like every other family. TWIN (PR 15) is the digital-twin
+#: soak family (bench.py --twin): one real agent against a sim-backed
+#: virtual-member ladder under FaultPlan churn, each rung carrying
+#: convergence, /v1/agent/perf latency attribution, Jain fairness, and
+#: the checkpoint-resume digest proof.
 LEDGER_FAMILIES = ("BENCH", "MULTICHIP", "SWEEP", "SERVE", "PROFILE",
-                   "BYZ", "CHAOS", "COORDS", "TUNE")
+                   "BYZ", "CHAOS", "COORDS", "TUNE", "TWIN")
+
+#: per-rung keys every non-skipped TWIN ladder row must carry (the
+#: validator + README tables decode these)
+TWIN_RUNG_KEYS = ("n", "rounds", "join_s", "member_view_err_post_heal",
+                  "converge_rounds", "agent_p50_ms", "agent_p99_ms",
+                  "jain_fairness", "rumors_sent", "rumors_shed",
+                  "resume_digest_equal")
+
+#: post-heal member-view tolerance: a rung whose real agent never got
+#: back within this fraction of the sim's ground truth DID NOT
+#: CONVERGE — the validator refuses it (a capped converge_rounds must
+#: not read as merely "slow" in the ledger), and the soak harness
+#: (sim/twin.py) uses the same constant as its settling target
+TWIN_CONVERGE_TOL = 0.005
 
 #: the autotuner's winner schema: what a TUNE record's ``winner`` and
 #: every AUTOTUNE_CACHE.json entry must carry (validator + cache
@@ -524,7 +542,8 @@ def layout_digest() -> str:
                   (str(COSTMODEL_WINDOW_VECS),),
                   tuple(f"{e}={v}" for e, v in COSTMODEL_FLOPS),
                   (str(COSTMODEL_FLOP_WINDOW), str(COSTMODEL_BOUND)),
-                  PROFILE_ROOFLINE_ROW, LEDGER_FAMILIES):
+                  PROFILE_ROOFLINE_ROW, LEDGER_FAMILIES,
+                  TWIN_RUNG_KEYS, (str(TWIN_CONVERGE_TOL),)):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

@@ -1,0 +1,481 @@
+"""Digital-twin soak harness: one real agent vs a sim-backed cluster.
+
+The bridge halves live elsewhere — `gossip/virtual.VirtualPeerProvider`
+synthesizes the wire traffic, the batched sim (sim/round.py) advances
+the ground truth under a compiled FaultPlan. This module is the driver
+that runs them in lockstep and MEASURES the real agent while it
+happens:
+
+    sim rounds (chunked, checkpointed)    real agent (full stack)
+      │ run_rounds(plan=cp)                 ▲ serf/memberlist view
+      │ provider.ingest(state)  ──rumors──▶ │ catalog reconcile
+      │ clock.advance(chunk·round_s)        │ RPC load clients
+      └ checkpoint.save / guard poll        └ /v1/agent/perf
+
+Used by ``bench.py --twin`` (the TWIN ledger family) and by the tier-1
+smoke tests (tests/test_twin.py) at small N. The sim side is the
+PR 9 checkpoint machinery verbatim: the chunked schedule is bitwise
+the straight run, so a SIGTERM mid-soak resumes to an identical sim
+digest — ``resume_digest_proof`` re-runs the second half from the
+mid-run snapshot and compares hashes to prove it on every rung.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import statistics
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from consul_tpu.config import GossipConfig
+from consul_tpu.sim import registry
+
+#: default virtual-member ladder for the full soak (the 1M rung is
+#: wired and honest-skips when the host runs out of budget — see
+#: bench.py run_twin_bench)
+TWIN_LADDER = (65_536, 262_144, 1_048_576)
+TWIN_SMOKE_N = 4096
+
+#: post-heal member-view tolerance: the agent's alive count must come
+#: within this fraction of the sim's ground truth to count as
+#: converged (suspicion timers keep a small tail in flight). The
+#: digest-pinned registry constant is the one source — the TWIN
+#: validator refuses rungs past it.
+CONVERGE_TOL = registry.TWIN_CONVERGE_TOL
+
+
+def twin_gossip_config() -> GossipConfig:
+    """LAN SWIM timing with push/pull effectively disabled after the
+    join: at twin scale a periodic FULL state sync means the agent
+    serializing N member snapshots every 30s — real 10⁵-member
+    deployments tune this up for the same reason."""
+    return GossipConfig(push_pull_interval=3600.0)
+
+
+def twin_plan(n: int, warmup: int = 8, churn: int = 24,
+              partition: int = 24, heal: int = 32):
+    """The soak's FaultPlan: quiesce, ChurnBurst over the low eighth,
+    a hard partition of the low quarter, then heal + recovery
+    observation — the same primitives every chaos-suite class uses."""
+    from consul_tpu.faults import ChurnBurst, FaultPlan, Partition, Phase
+
+    lo8 = (0, max(n // 8, 1))
+    lo4 = (0, max(n // 4, 1))
+    return FaultPlan(phases=(
+        Phase(rounds=warmup, name="warmup"),
+        Phase(rounds=churn, name="churn", faults=(
+            ChurnBurst(nodes=lo8, crash=0.02, rejoin=0.01),)),
+        Phase(rounds=partition, name="partition", faults=(
+            Partition(a=lo4, b=(lo4[1], n), drop=1.0, symmetric=True),)),
+        Phase(rounds=heal, name="heal"),
+    ))
+
+
+@dataclass
+class TwinHandle:
+    """A built twin: the network, the bridge, and the real agent."""
+
+    net: Any
+    provider: Any
+    agent: Any
+    gossip: GossipConfig
+    seed: int
+
+    @property
+    def clock(self):
+        return self.net.clock
+
+    @property
+    def n(self) -> int:
+        return self.provider.n
+
+    def agent_alive(self) -> int:
+        """Real agent's alive VIRTUAL member count (self excluded)."""
+        return self.agent.serf.memberlist.num_alive() - 1
+
+    def sim_alive(self) -> int:
+        return int(self.provider.alive.sum())
+
+    def view_error(self) -> float:
+        """|agent view − sim ground truth| / n."""
+        return abs(self.agent_alive() - self.sim_alive()) / max(self.n, 1)
+
+    def shutdown(self) -> None:
+        self.agent.shutdown()
+
+
+def build_twin(n: int, seed: int = 0,
+               gossip: Optional[GossipConfig] = None,
+               serve_http: bool = False,
+               node_name: str = "twin-agent",
+               config_overrides: Optional[dict] = None) -> TwinHandle:
+    """One real server-mode agent on an InMemNetwork whose every other
+    member is synthesized by a VirtualPeerProvider, gossip timers on
+    the network's SimClock (tests and soaks advance virtual time)."""
+    from consul_tpu import config as config_mod
+    from consul_tpu.agent.agent import Agent
+    from consul_tpu.gossip import InMemNetwork, VirtualPeerProvider
+
+    gossip = gossip or twin_gossip_config()
+    net = InMemNetwork(seed=seed, latency=0.0005)
+    provider = VirtualPeerProvider(net, n=n, gossip=gossip, seed=seed)
+    cfg = config_mod.load(dev=True, overrides={
+        "node_name": node_name,
+        "gossip_lan": {f.name: getattr(gossip, f.name)
+                       for f in dataclasses.fields(GossipConfig)},
+        # the WAN pool and external gRPC add nothing to the twin
+        "ports": {"serf_wan": -1, "grpc": -1, "dns": -1,
+                  **({} if serve_http else {"http": -1})},
+        **(config_overrides or {}),
+    })
+    transport = net.attach(f"{node_name}:1")
+    agent = Agent(cfg, serf_transport=transport, serf_clock=net.clock)
+    # bounded ?near= sort rides the ground-truth embedding instead of
+    # per-entry Vivaldi lookups (endpoints._near_sort provider seam)
+    srv = agent.server
+
+    def _near_rank(near: str, k: int):
+        i = provider.id_of_name(near)
+        return provider.near_rank(provider.n if i is None else i, k)
+
+    srv.near_rank = _near_rank
+    agent.start(serve_http=serve_http, serve_dns=False)
+    return TwinHandle(net=net, provider=provider, agent=agent,
+                      gossip=gossip, seed=seed)
+
+
+def join_twin(handle: TwinHandle, max_virtual_s: float = 300.0,
+              step_s: float = 2.0) -> float:
+    """Join the agent to the virtual cluster (one push/pull learns the
+    full digest) and advance virtual time until the member view is
+    complete. Returns WALL seconds spent (the join storm is the first
+    real stress: N merge handlers, N serf events, N catalog
+    reconciles queued)."""
+    t0 = time.monotonic()
+    got = handle.agent.join([handle.provider.addr_of(0)])
+    if not got:
+        raise RuntimeError("twin join failed: push/pull with vp://0 "
+                           "did not complete")
+    advanced = 0.0
+    while handle.agent_alive() < handle.sim_alive() \
+            and advanced < max_virtual_s:
+        handle.clock.advance(step_s)
+        advanced += step_s
+    return time.monotonic() - t0
+
+
+# ------------------------------------------------------------ load gen
+
+
+@dataclass
+class LoadReport:
+    p50_ms: float
+    p99_ms: float
+    jain: float
+    per_client: list = field(default_factory=list)
+    errors: int = 0
+
+
+def jain_fairness(xs: list) -> float:
+    """Jain's index (Σx)²/(k·Σx²) — 1.0 when every client got equal
+    service, 1/k when one client got everything (the fairness lens
+    the Fabric gossip paper applies to dissemination service).
+    Starved clients count: a zero row pulls the index DOWN, it is not
+    filtered away."""
+    xs = [float(x) for x in xs]
+    if not xs:
+        return 0.0
+    s, s2 = sum(xs), sum(x * x for x in xs)
+    return (s * s) / (len(xs) * s2) if s2 else 0.0
+
+
+class TwinLoad:
+    """Background RPC clients against the real agent's mux port —
+    per-client latency samples for p50/p99 and Jain fairness."""
+
+    METHODS = (("Status.Ping", {}),
+               ("Catalog.NodeServices", {"Node": "twin-agent",
+                                         "AllowStale": True}),
+               ("KVS.Get", {"Key": "twin/probe", "AllowStale": True}))
+
+    def __init__(self, addr: str, clients: int = 8) -> None:
+        from consul_tpu.server.rpc import ConnPool
+
+        self.addr = addr
+        self.clients = clients
+        self.pool = ConnPool(mux_per_addr=2)
+        self.stop_ev = threading.Event()
+        self.samples: list[list[float]] = [[] for _ in range(clients)]
+        self.errors = 0
+        self._threads: list[threading.Thread] = []
+
+    def _client(self, ci: int) -> None:
+        k = 0
+        while not self.stop_ev.is_set():
+            method, args = self.METHODS[k % len(self.METHODS)]
+            k += 1
+            t0 = time.perf_counter()
+            try:
+                self.pool.call(self.addr, method, args, timeout=10.0)
+                self.samples[ci].append(time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — counted, not raised
+                self.errors += 1
+            time.sleep(0.002)
+
+    def start(self) -> None:
+        for ci in range(self.clients):
+            t = threading.Thread(target=self._client, args=(ci,),
+                                 daemon=True, name=f"twin-load-{ci}")
+            t.start()
+            self._threads.append(t)
+
+    def finish(self) -> LoadReport:
+        self.stop_ev.set()
+        for t in self._threads:
+            t.join(timeout=15.0)
+        self.pool.close()
+        flat = sorted(s for col in self.samples for s in col)
+        if not flat:
+            return LoadReport(0.0, 0.0, 0.0, errors=self.errors)
+        p50 = flat[len(flat) // 2] * 1000.0
+        p99 = flat[min(int(len(flat) * 0.99), len(flat) - 1)] * 1000.0
+        return LoadReport(
+            round(p50, 3), round(p99, 3),
+            round(jain_fairness([len(c) for c in self.samples]), 4),
+            per_client=[len(c) for c in self.samples],
+            errors=self.errors)
+
+
+# ------------------------------------------------------------ the soak
+
+
+def _state_digest(state) -> str:
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(state)):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def fetch_perf(http_addr: str) -> dict[str, Any]:
+    """`/v1/agent/perf` over the real HTTP surface (stage attribution
+    the soak record quotes). {} when the fetch fails."""
+    try:
+        with urllib.request.urlopen(
+                f"http://{http_addr}/v1/agent/perf?min_count=1",
+                timeout=10.0) as resp:
+            return json.loads(resp.read())
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def run_twin_soak(n: int, seed: int = 0,
+                  plan=None, chunk: int = 8,
+                  load_clients: int = 8,
+                  guard=None, ckpt_dir: Optional[str] = None,
+                  resume: bool = False,
+                  serve_http: bool = True,
+                  progress: Optional[Callable[[str], None]] = None
+                  ) -> dict[str, Any]:
+    """One full rung: build the twin, join, drive the FaultPlan
+    through the sim in checkpoint-aligned chunks with the bridge
+    reflecting every chunk, measure the agent throughout, and prove
+    the checkpoint-resume digest. Returns the TWIN rung dict
+    (registry.TWIN_RUNG_KEYS) or a ``{"preempted": ...}`` stub when
+    `guard` trips mid-soak."""
+    import jax
+
+    from consul_tpu.faults import compile_plan, plan_digest
+    from consul_tpu.sim import checkpoint as ckpt_mod
+    from consul_tpu.sim import round as round_mod
+    from consul_tpu.sim.params import SimParams
+    from consul_tpu.sim.state import init_state
+    from consul_tpu.utils import perf
+
+    say = progress or (lambda msg: None)
+    plan = plan or twin_plan(n)
+    rounds = plan.total_rounds
+    heal_start = plan.starts[-1]
+    handle = build_twin(n, seed=seed, serve_http=serve_http)
+    gossip = handle.gossip
+    round_s = gossip.probe_interval
+    p = SimParams.from_gossip_config(gossip, n=n, tcp_fallback=False)
+    cp = compile_plan(plan, n)
+    perf.arm()
+    try:
+        say(f"n={n}: joining the virtual cluster")
+        join_s = join_twin(handle)
+        join_err = handle.view_error()
+        say(f"n={n}: joined in {join_s:.1f}s wall "
+            f"(view err {join_err:.4f}); soaking {rounds} rounds")
+
+        key = jax.random.key(seed)
+        state = init_state(n)
+        cursor = 0
+        if resume and ckpt_dir:
+            snap = ckpt_mod.latest(ckpt_dir, p, plan=cp)
+            if snap is not None:
+                state = snap.state()
+                cursor = snap.round_cursor
+                say(f"n={n}: resumed @ round {cursor}")
+        # keep the bridge's view consistent with a resumed cursor
+        handle.provider.ingest(state, horizon_s=0.001)
+        handle.clock.advance(0.01)
+
+        load = TwinLoad(handle.agent.server.rpc.addr,
+                        clients=load_clients)
+        load.start()
+        mid_cursor = (rounds // (2 * chunk)) * chunk
+        mid_snap = None
+        converge_rounds = None
+        preempted = False
+        t_soak = time.monotonic()
+        while cursor < rounds:
+            if guard is not None and guard.preempted:
+                preempted = True
+                break
+            step = min(chunk, rounds - cursor)
+            state, _ = round_mod.run_rounds(state, key, p, step,
+                                            plan=cp)
+            cursor += step
+            handle.provider.ingest(state,
+                                   horizon_s=step * round_s * 0.8)
+            handle.clock.advance(step * round_s)
+            if ckpt_dir or cursor == mid_cursor:
+                snap = ckpt_mod.snapshot(
+                    p, key, state, engine="xla", total_rounds=rounds,
+                    plan=cp)
+                if ckpt_dir:
+                    ckpt_mod.save(ckpt_dir, snap)
+                if cursor == mid_cursor:
+                    # the mid-soak cut for the resume proof: held
+                    # in-memory (the proof must run even without a
+                    # checkpoint dir) and, when a dir exists, saved
+                    # OUTSIDE the rotating window (later saves would
+                    # reap it) so a resumed-past-midpoint run can
+                    # reload it
+                    mid_snap = snap
+                    if ckpt_dir:
+                        import os as _os
+
+                        ckpt_mod.save(_os.path.join(ckpt_dir, "mid"),
+                                      snap)
+            if cursor >= heal_start and converge_rounds is None \
+                    and handle.view_error() <= CONVERGE_TOL:
+                converge_rounds = cursor - heal_start
+        if preempted:
+            load.finish()
+            return {"preempted": True, "n": n, "rounds_done": cursor,
+                    "rounds": rounds}
+        # post-heal settling: let suspicion timers and rumors drain
+        extra = 0
+        while handle.view_error() > CONVERGE_TOL and extra < 120:
+            handle.clock.advance(round_s * 4)
+            extra += 4
+        if converge_rounds is None:
+            converge_rounds = (rounds - heal_start) + extra
+        report = load.finish()
+        soak_wall = time.monotonic() - t_soak
+        say(f"n={n}: soak done in {soak_wall:.1f}s wall, view err "
+            f"{handle.view_error():.4f}")
+
+        perf_snap = {}
+        if serve_http and handle.agent.http is not None:
+            perf_snap = fetch_perf(handle.agent.http.addr)
+
+        # checkpoint-resume digest proof: restore the mid-soak cut and
+        # re-run the remaining rounds — the fold_in-keyed round stream
+        # makes the spliced schedule bitwise the straight one
+        final_digest = _state_digest(state)
+        resume_equal = None
+        if mid_snap is None and ckpt_dir:
+            # resumed past the midpoint in THIS process: the cut was
+            # written by the preempted invocation — reload it
+            import os as _os
+
+            mid_snap = ckpt_mod.latest(
+                _os.path.join(ckpt_dir, "mid"), p, plan=cp)
+        if mid_snap is not None:
+            s2 = mid_snap.state()
+            left = rounds - mid_snap.round_cursor
+            if left > 0:
+                s2, _ = round_mod.run_rounds(s2, mid_snap.key(), p,
+                                             left, plan=cp)
+            resume_equal = _state_digest(s2) == final_digest
+        stats = jax.device_get(state.stats)
+        return {
+            "n": n, "rounds": rounds, "seed": seed,
+            "join_s": round(join_s, 2),
+            "join_view_err": round(join_err, 5),
+            "soak_wall_s": round(soak_wall, 2),
+            "member_view_err_post_heal": round(handle.view_error(), 5),
+            "converge_rounds": int(converge_rounds),
+            "agent_p50_ms": report.p50_ms,
+            "agent_p99_ms": report.p99_ms,
+            "jain_fairness": report.jain,
+            "load_requests": int(sum(report.per_client)),
+            "load_errors": int(report.errors),
+            "rumors_sent": int(handle.provider.stats["rumors_sent"]),
+            "rumors_shed": int(handle.provider.stats["rumors_shed"]),
+            "refutes": int(handle.provider.stats["refutes"]),
+            "sim_stats": {
+                "crashes": int(stats.crashes),
+                "rejoins": int(stats.rejoins),
+                "false_positives": int(stats.false_positives),
+                "refutes": int(stats.refutes)},
+            "sim_digest": final_digest,
+            "plan_digest": plan_digest(cp),
+            "resume_digest_equal": bool(resume_equal),
+            "perf": _perf_excerpt(perf_snap),
+        }
+    finally:
+        handle.shutdown()
+
+
+def _perf_excerpt(snap: dict[str, Any]) -> dict[str, Any]:
+    """The stage-attribution lines the record quotes: every rpc.* and
+    http.* stage's count/p50/p99 + the worker-pool gauges."""
+    stages = {}
+    for name, st in (snap.get("Stages") or {}).items():
+        if name.startswith(("rpc.", "http.")):
+            stages[name] = {"Count": st.get("Count"),
+                            "P50Ms": st.get("P50Ms"),
+                            "P99Ms": st.get("P99Ms")}
+    gauges = {k: v for k, v in (snap.get("Gauges") or {}).items()
+              if k.startswith(("rpc.workers.", "rpc.blocking.",
+                               "catalog.near_sort."))}
+    return {"stages": stages, "gauges": gauges}
+
+
+def smoke_guard_samples(samples: int = 3, n: int = TWIN_SMOKE_N,
+                        seed: int = 0) -> dict[str, Any]:
+    """The apples-to-apples envelope --check-regression --family TWIN
+    re-measures: `samples` short smoke twins, convergence rounds each
+    (recorded alongside the at-scale soak so the guard never has to
+    re-run a 10⁵-member rung to detect a bridge regression)."""
+    plan = twin_plan(n, warmup=4, churn=12, partition=12, heal=24)
+    rows = []
+    for i in range(samples):
+        rung = run_twin_soak(n, seed=seed + i, plan=plan,
+                             load_clients=2, serve_http=False,
+                             ckpt_dir=None)
+        if rung["member_view_err_post_heal"] > CONVERGE_TOL:
+            # a capped converge_rounds from a run that never actually
+            # converged must not become a regression baseline
+            raise RuntimeError(
+                "smoke-guard sample never converged (view err "
+                f"{rung['member_view_err_post_heal']}) — the bridge "
+                "is broken; refusing to bake the capped "
+                "converge_rounds into a baseline")
+        rows.append(int(rung["converge_rounds"]))
+    return {"n": n, "rounds": plan.total_rounds,
+            "converge_rounds": int(statistics.median(rows)),
+            "samples": rows}

@@ -81,7 +81,14 @@ def test_layout_registry_digest_pinned():
     # row schema also grew the autotuner's ``lane_blocks`` axis and
     # the PROFILE record schema bumped to v4 (v3 records validate
     # under their own version).
-    assert registry.layout_digest() == "142fb9f86f0d9ad7"
+    # PR 15 re-pin (was 142fb9f86f0d9ad7): the digest now additionally
+    # covers the digital-twin soak contract — the TWIN ledger family,
+    # its per-rung record schema (TWIN_RUNG_KEYS), and the convergence
+    # tolerance the validator refuses past (TWIN_CONVERGE_TOL).
+    # Consumers: sim/costmodel.py _validate_twin/latest_twin_guard,
+    # sim/twin.py CONVERGE_TOL, bench.py --twin/--check-regression
+    # --family TWIN, README soak tables.
+    assert registry.layout_digest() == "1cc9085b38df7e62"
 
 
 def test_reduce_lane_layout_pinned():

@@ -361,7 +361,14 @@ def test_bench_mode_combinations_exit_2():
                  ("--mesh", "--sweep"),
                  ("--history", "--check-regression"),
                  ("--history", "--ckpt-dir", "/tmp/nope"),
-                 ("--history", "--resume")):
+                 ("--history", "--resume"),
+                 # the --twin mode (PR 15) rides the same exclusions
+                 ("--profile", "--twin"), ("--twin", "--mesh"),
+                 ("--twin", "--history"),
+                 ("--twin", "--check-regression"),
+                 # --family TWIN re-measures only its own guard metric
+                 ("--check-regression", "--family", "TWIN",
+                  "--metric", "gossip_rounds_per_sec_smoke")):
         r = _bench(*argv)
         assert r.returncode == 2, (argv, r.stderr)
         assert "usage:" in r.stderr, (argv, r.stderr)

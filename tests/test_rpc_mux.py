@@ -189,3 +189,64 @@ def test_snapshot_stream_roundtrip(dev_server):
     wait_for(lambda: srv.state.kv_get("snap/k").value == b"v" * 4096,
              what="restored value")
     pool.close()
+
+
+def test_worker_pool_admission_control_sheds_retryable():
+    """PR 15 satellite: past config.rpc_queue_limit the reactor SHEDS
+    dispatches with a structured retryable error and counts them in
+    rpc.workers.rejected, next to the queue_depth gauge."""
+    import consul_tpu.server.rpc as rpc_mod
+    from consul_tpu.server.rpc import (RPCServer, RetryableError,
+                                       is_retryable_rpc_error)
+    from consul_tpu.utils import perf
+
+    release = threading.Event()
+
+    def handler(method, args, src):
+        if method == "Slow.Block":
+            release.wait(20.0)
+        return "ok"
+
+    srv = RPCServer(workers=1, queue_limit=1)
+    srv.start(handler)
+    pool = ConnPool(mux_per_addr=1)
+    base_rejected = rpc_mod._workers_rejected()
+    results, sheds, others = [], [], []
+
+    def call(i):
+        try:
+            results.append(pool.call(srv.addr, "Slow.Block", {},
+                                     timeout=30.0))
+        except RetryableError as e:
+            sheds.append(e)
+        except Exception as e:  # noqa: BLE001
+            others.append(e)
+
+    threads = []
+    try:
+        # 1st occupies the single worker, 2nd fills the queue slot,
+        # the rest must be shed at dispatch
+        for i in range(6):
+            t = threading.Thread(target=call, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+            time.sleep(0.15)
+        wait_for(lambda: len(sheds) >= 1, what="admission shed")
+        release.set()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not others, others
+        # shed errors are the STRUCTURED kind: classified retryable,
+        # and nothing that did run was lost
+        assert all(is_retryable_rpc_error(e) for e in sheds)
+        assert all("overloaded" in str(e) for e in sheds)
+        assert len(results) + len(sheds) == 6
+        assert rpc_mod._workers_rejected() - base_rejected == len(sheds)
+        # the counter is exported next to the queue-depth gauge
+        gauges = perf.default.snapshot()["Gauges"]
+        assert "rpc.workers.rejected" in gauges
+        assert "rpc.workers.queue_depth" in gauges
+    finally:
+        release.set()
+        pool.close()
+        srv.shutdown()

@@ -411,3 +411,46 @@ def telemetry_count(srv):
         return sum(v for (name, _), v in
                    telemetry.default._counters.items()
                    if name == "raft.verify_leader")
+
+
+def test_leader_kill_under_load_no_client_visible_errors(cluster):
+    """PR 15 satellite: a leader killed under a live write stream is a
+    latency blip, not a client-visible error — "no leader" inside the
+    rpcHoldTimeout window retries with jittered backoff in
+    _forward_to_leader (and Client.rpc), never surfacing while a new
+    leader can still be elected from the surviving quorum."""
+    servers, leader = cluster
+    followers = [s for s in servers if s is not leader]
+    stop = threading.Event()
+    oks, errs = [], []
+
+    def writer(wi):
+        k = 0
+        while not stop.is_set():
+            try:
+                followers[wi % len(followers)].handle_rpc(
+                    "KVS.Apply", {"Op": "set", "DirEnt": {
+                        "Key": f"lk/{wi}/{k}", "Value": b"v"}}, "test")
+                oks.append(1)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+            k += 1
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    wait_for(lambda: len(oks) >= 10, what="write stream warm")
+    before_kill = len(oks)
+    leader.shutdown()
+    # the stream must keep making progress THROUGH the transition
+    wait_for(lambda: len(oks) >= before_kill + 30, timeout=30.0,
+             what="writes resuming after leader kill")
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    leader_errs = [e for e in errs if "leader" in str(e).lower()]
+    assert not leader_errs, (
+        f"{len(leader_errs)} leader-transition errors surfaced to "
+        f"clients: {leader_errs[:3]}")

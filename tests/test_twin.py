@@ -1,0 +1,422 @@
+"""Digital-twin bridge smoke (PR 15 tier-1): one real agent against a
+sim-backed virtual-peer membership plane.
+
+Covers the VirtualPeerProvider seam (gossip/virtual.py + the
+transport.py endpoint-provider refactor), the twin soak harness
+(sim/twin.py, including the checkpoint-resume digest proof with a real
+ChurnBurst FaultPlan), the agent-surface hardening that rode along
+(anti-entropy backoff, bounded ?near= sort, event-stream coalescing,
+broadcast-queue subject index), and the TWIN ledger family's
+validator.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from consul_tpu.config import GossipConfig
+from consul_tpu.gossip import messages as m
+from consul_tpu.sim import twin as twin_mod
+
+from helpers import wait_for  # noqa: E402
+
+#: the satellite's tier-1 scale: ≈4096 virtual peers against the one
+#: real agent
+N = 4096
+
+
+@pytest.fixture(scope="module")
+def twin():
+    handle = twin_mod.build_twin(
+        N, seed=1,
+        config_overrides={"rpc_near_sort_limit": 16})
+    twin_mod.join_twin(handle)
+    yield handle
+    handle.shutdown()
+
+
+def test_join_learns_full_membership(twin):
+    # one push/pull digest teaches the real agent all N virtual peers
+    assert twin.agent_alive() == N
+    assert twin.view_error() == 0.0
+    # and the serf layer sees them as ordinary members
+    members = twin.agent.members()
+    assert len(members) == N + 1
+
+
+def test_push_pull_digest_roundtrips_codec_exactly(twin):
+    """The synthesized digest must survive the memberlist codec
+    bitwise — the agent's _merge_state consumes exactly these keys."""
+    nodes = twin.provider.member_digest()
+    body = {"nodes": nodes, "from": twin.provider.name_of(0)}
+    typ, decoded = m.decode(m.encode(m.PUSH_PULL, body))
+    assert typ == m.PUSH_PULL
+    assert decoded == body
+    # entries carry the member-snapshot schema the agent merges
+    assert set(nodes[0]) == {"name", "addr", "inc", "status"}
+
+
+def test_member_view_tracks_sim_churn(twin):
+    """Sim-side deaths reach the agent as rumors; rejoins refute."""
+    prov = twin.provider
+    status = prov.status.copy()
+    inc = prov.incarnation.copy()
+    down = np.where(prov.alive, -1, 0).astype(np.int32)
+    dead = list(range(100, 164))
+    status[dead] = 3  # DEAD
+    down[dead] = 0
+    prov.ingest_arrays(status, inc, down)
+    twin.clock.advance(5.0)
+    assert twin.agent_alive() == twin.sim_alive() == N - len(dead)
+    # rejoin with a higher incarnation: the view heals
+    status[dead] = 1
+    inc[dead] += 1
+    down[dead] = -1
+    prov.ingest_arrays(status, inc, down)
+    twin.clock.advance(5.0)
+    assert twin.agent_alive() == N
+
+
+def test_parked_watcher_survives_churn(twin):
+    """A blocking query parked on the real agent's mux port must FIRE
+    on the churn-driven catalog change, not be dropped mid-churn."""
+    from consul_tpu.server.rpc import ConnPool
+
+    srv = twin.agent.server
+    # the leader reconcile loop turns serf joins into catalog rows
+    wait_for(lambda: len(list(srv.state.nodes())) >= N,
+             timeout=60.0, what="catalog reconcile of the twin join")
+    res = srv.handle_rpc("Catalog.ListNodes", {"AllowStale": True},
+                         "local")
+    idx = res["Index"]
+    pool = ConnPool()
+    out: dict = {}
+
+    def watch():
+        try:
+            out["res"] = pool.call(srv.rpc.addr, "Catalog.ListNodes", {
+                "MinQueryIndex": idx, "MaxQueryTime": 30.0,
+                "AllowStale": True}, timeout=45.0)
+        except Exception as e:  # noqa: BLE001
+            out["err"] = e
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    time.sleep(0.5)  # let it park
+    # churn: kill a slice of virtual peers; rumors → serf failed
+    # events → reconcile marks serfHealth critical → watch fires
+    prov = twin.provider
+    status = prov.status.copy()
+    inc = prov.incarnation.copy()
+    down = np.where(prov.alive, -1, 0).astype(np.int32)
+    dead = list(range(200, 232))
+    status[dead] = 3
+    down[dead] = 0
+    prov.ingest_arrays(status, inc, down)
+    twin.clock.advance(5.0)
+    t.join(timeout=45.0)
+    assert "err" not in out, out.get("err")
+    assert out["res"]["Index"] > idx
+    # the watch plane still accepts new parks after the churn
+    res2 = pool.call(srv.rpc.addr, "Catalog.ListNodes", {
+        "MinQueryIndex": 0, "AllowStale": True}, timeout=15.0)
+    assert res2["Index"] >= out["res"]["Index"]
+    pool.close()
+    # heal for the tests that follow
+    status[dead] = 1
+    inc[dead] += 1
+    down[dead] = -1
+    prov.ingest_arrays(status, inc, down)
+    twin.clock.advance(5.0)
+
+
+def test_near_sort_is_bounded_and_topology_ranked(twin):
+    """?near= over the twin catalog rides the provider's ground-truth
+    ranks (coords.nearest_k semantics) and only fully orders the
+    nearest rpc_near_sort_limit entries."""
+    from consul_tpu.utils import perf
+
+    srv = twin.agent.server
+    wait_for(lambda: len(list(srv.state.nodes())) >= N,
+             timeout=60.0, what="catalog reconcile of the twin join")
+    near = twin.provider.name_of(7)
+    before = perf.default._gauges_now().get(
+        "catalog.near_sort.bounded", 0)
+    res = srv.handle_rpc("Catalog.ListNodes",
+                         {"Near": near, "AllowStale": True}, "local")
+    nodes = [e["Node"] for e in res["Nodes"]]
+    assert len(nodes) >= N
+    limit = srv.config.rpc_near_sort_limit
+    rank = twin.provider.near_rank(7, limit)
+    want_head = sorted(rank, key=rank.get)
+    # the fully-ordered head is exactly the provider's nearest-k
+    # (the agent's own row carries no rank and sorts behind)
+    assert nodes[:limit] == want_head
+    after = perf.default._gauges_now().get(
+        "catalog.near_sort.bounded", 0)
+    assert after == before + 1
+
+
+def test_slow_virtual_peer_times_out_stream_fallback(twin):
+    """A sim-slow peer must not be instantly confirmed alive by the
+    TCP-fallback stream ping — the stream plane models the GC pause
+    too, or the UDP-plane delay the sim runs would never matter."""
+    prov = twin.provider
+    agent_addr = twin.agent.serf.memberlist.transport.addr
+    prov.slow = prov.slow.copy()
+    prov.slow[5] = True
+    try:
+        with pytest.raises(ConnectionError, match="slow peer"):
+            twin.net.stream(agent_addr, prov.addr_of(5),
+                            m.encode(m.PING, {"seq": 9}))
+        # push/pull (10s deadline) still answers: only the sub-second
+        # fallback-ping plane is past its budget
+        resp = twin.net.stream(agent_addr, prov.addr_of(5),
+                               m.encode(m.PUSH_PULL, {"nodes": []}))
+        assert m.decode(resp)[0] == m.PUSH_PULL
+    finally:
+        prov.slow[5] = False
+
+
+def test_virtual_peers_face_the_fault_gauntlet(twin):
+    """FaultInjector-style network faults apply to virtual peers too:
+    a partition between the agent and a virtual peer kills the
+    synthesized ack path (the provider seam sits BEHIND the fault
+    fold, not beside it)."""
+    net = twin.net
+    agent_addr = twin.agent.serf.memberlist.transport.addr
+    vp = twin.provider.addr_of(3)
+    net.partition({agent_addr}, {vp})
+    try:
+        with pytest.raises(ConnectionError):
+            net.stream(agent_addr, vp, m.encode(m.PING, {"seq": 1}))
+    finally:
+        net.heal()
+
+
+# --------------------------------------------------- the jax soak rung
+
+
+def test_twin_soak_churnburst_converges_and_resumes(tmp_path):
+    """The full rung at the satellite's ≈4096 scale: a real ChurnBurst
+    + Partition FaultPlan drives the sim, the agent's member view
+    converges post-heal, and the mid-soak checkpoint resumes to a
+    bitwise-equal sim digest."""
+    rung = twin_mod.run_twin_soak(
+        4096, seed=0,
+        plan=twin_mod.twin_plan(4096, warmup=4, churn=8, partition=8,
+                                heal=12),
+        load_clients=2, serve_http=False, ckpt_dir=str(tmp_path))
+    assert rung["member_view_err_post_heal"] <= twin_mod.CONVERGE_TOL
+    assert rung["resume_digest_equal"] is True
+    assert rung["rumors_sent"] > 0
+    assert rung["sim_stats"]["crashes"] > 0
+    assert rung["converge_rounds"] <= rung["rounds"]
+    assert rung["jain_fairness"] > 0.5
+
+
+# ------------------------------------------------- hardening riders
+
+
+def test_ae_backoff_on_failed_sync():
+    """Anti-entropy failures retry with jittered exponential backoff
+    instead of hammering a straining server (agent/ae.py)."""
+    from consul_tpu.agent.ae import RETRY_MAX_S, StateSyncer
+
+    class _Agent:
+        name = "x"
+        node_id = "nid"
+
+        class config:
+            partition = "default"
+
+        server = None
+
+        class local:
+            @staticmethod
+            def list_services():
+                return {}
+
+            @staticmethod
+            def list_checks():
+                return {}
+
+        @staticmethod
+        def members():
+            return []
+
+        @staticmethod
+        def advertise_addr():
+            return "127.0.0.1"
+
+        @staticmethod
+        def agent_rpc(method, args):
+            raise ConnectionError("server down")
+
+    sy = StateSyncer(_Agent())
+    try:
+        for want in (1, 2, 3):
+            sy.sync()
+            assert sy.failures == want
+            # cancel the scheduled retry so we drive sync() by hand
+            with sy._lock:
+                if sy._retry_timer is not None:
+                    sy._retry_timer.cancel()
+                    sy._retry_timer = None
+        # backoff doubles and stays jittered inside [0.5x, 1.5x] base
+        sy.failures = 1
+        assert 0.5 <= sy.retry_backoff() <= 1.5
+        sy.failures = 3
+        assert 2.0 <= sy.retry_backoff() <= 6.0
+        sy.failures = 50
+        assert sy.retry_backoff() <= RETRY_MAX_S * 1.5
+        # success resets the ladder
+        _Agent.agent_rpc = staticmethod(
+            lambda method, args: {"NodeServices": None,
+                                  "HealthChecks": []})
+        sy.sync()
+        assert sy.failures == 0
+    finally:
+        sy.stop()
+
+
+def test_stream_publish_coalesces_identical_bursts():
+    """A rumor burst committing the same {Tables} notification 10⁴
+    times folds into a handful of buffer entries; subscribers still
+    wake and see the NEWEST index (server/stream.py shedding)."""
+    from consul_tpu.server.stream import Event, EventPublisher
+
+    pub = EventPublisher(buffer_size=256)
+    sub = pub.subscribe("ServiceHealth", index=0)
+    for i in range(1, 10_001):
+        pub.publish(Event(topic="ServiceHealth", index=i,
+                          payload={"Tables": "nodes,checks"}))
+    buf = pub._buffers["ServiceHealth"]
+    assert len(buf) == 1
+    assert pub.coalesced == 9_999
+    ev = sub.next(timeout=1.0)
+    assert ev is not None and ev.index == 10_000
+    # distinct payloads never coalesce
+    pub.publish(Event(topic="ServiceHealth", index=10_001,
+                      payload={"Tables": "kv"}))
+    assert len(buf) == 2
+    sub.close()
+
+
+def test_broadcast_queue_subject_index():
+    """O(1) enqueue invalidation keeps the memberlist semantics: a new
+    rumor about a subject replaces the old one across kinds."""
+    from consul_tpu.gossip.broadcast import TransmitLimitedQueue
+
+    q = TransmitLimitedQueue()
+    q.queue("alive:node7", b"a")
+    q.queue("suspect:node7", b"s")
+    assert len(q) == 1
+    batch = q.get_batch(8, 1400)
+    assert batch == [b"s"]
+    # exhausted rumors drop from the index too (no stale invalidation)
+    for _ in range(64):
+        q.get_batch(8, 1400)
+    assert len(q) == 0
+    q.queue("alive:node7", b"a2")
+    assert q.get_batch(8, 1400) == [b"a2"]
+
+
+def test_broadcast_queue_bounded_batch_prefers_fresh():
+    from consul_tpu.gossip.broadcast import TransmitLimitedQueue
+
+    q = TransmitLimitedQueue()
+    for i in range(5000):
+        q.queue(f"alive:n{i}", b"x" * 40)
+    batch = q.get_batch(5000, 1400 - 16)
+    assert batch  # budget-bound, fewest-transmits-first
+    assert sum(len(b) + 3 for b in batch) <= 1400 - 16
+
+
+# --------------------------------------------------- TWIN ledger family
+
+
+def _twin_payload():
+    rung = {"n": 65_536, "rounds": 88, "join_s": 30.0,
+            "member_view_err_post_heal": 0.001, "converge_rounds": 8,
+            "agent_p50_ms": 1.0, "agent_p99_ms": 9.5,
+            "jain_fairness": 0.98, "rumors_sent": 20_000,
+            "rumors_shed": 0, "resume_digest_equal": True}
+    return {"metric": "twin_soak", "platform": "cpu",
+            "ladder": [rung,
+                       {"n": 1_048_576, "skipped": True,
+                        "reason": "projected past the rung budget"}],
+            "smoke_guard": {"n": 4096, "rounds": 52,
+                            "converge_rounds": 4, "samples": [4, 4, 4]}}
+
+
+def test_twin_record_validates_and_rejects_by_key():
+    from consul_tpu.sim import costmodel
+    from consul_tpu.sim.costmodel import LedgerError
+
+    costmodel.validate_record("TWIN_r01.json", _twin_payload())
+
+    broken = _twin_payload()
+    del broken["ladder"][0]["jain_fairness"]
+    with pytest.raises(LedgerError, match=r"ladder\[0\].*jain_fairness"):
+        costmodel.validate_record("TWIN_r01.json", broken)
+
+    broken = _twin_payload()
+    broken["ladder"][0]["resume_digest_equal"] = False
+    with pytest.raises(LedgerError, match="resume_digest_equal"):
+        costmodel.validate_record("TWIN_r01.json", broken)
+
+    # a rung that never converged must be an honest skip, not a
+    # record whose capped converge_rounds reads as merely slow
+    broken = _twin_payload()
+    broken["ladder"][0]["member_view_err_post_heal"] = 0.2
+    with pytest.raises(LedgerError, match="convergence tolerance"):
+        costmodel.validate_record("TWIN_r01.json", broken)
+
+    broken = _twin_payload()
+    broken["ladder"] = [{"n": 65_536, "skipped": True, "reason": "x"}]
+    with pytest.raises(LedgerError, match="every rung skipped"):
+        costmodel.validate_record("TWIN_r01.json", broken)
+
+    broken = _twin_payload()
+    del broken["smoke_guard"]["converge_rounds"]
+    with pytest.raises(LedgerError, match="smoke_guard"):
+        costmodel.validate_record("TWIN_r01.json", broken)
+
+
+def test_twin_record_rejects_by_file():
+    from consul_tpu.sim import costmodel
+    from consul_tpu.sim.costmodel import LedgerError
+
+    # an unregistered family name fails even with a valid-shaped body
+    with pytest.raises(LedgerError, match="unknown record family"):
+        costmodel.validate_record("TWINX_r01.json", _twin_payload())
+    with pytest.raises(LedgerError, match="not a recorded-artifact"):
+        costmodel.validate_record("twin.json", _twin_payload())
+
+
+def test_latest_twin_guard_picks_newest():
+    from consul_tpu.sim import costmodel
+
+    recs = [{"file": "TWIN_r01.json", "family": "TWIN", "round": 1,
+             "data": _twin_payload()},
+            {"file": "TWIN_r02.json", "family": "TWIN", "round": 2,
+             "data": {**_twin_payload(),
+                      "smoke_guard": {"n": 4096, "rounds": 52,
+                                      "converge_rounds": 6,
+                                      "samples": [6, 6, 7]}}}]
+    g = costmodel.latest_twin_guard(recs)
+    assert g["file"] == "TWIN_r02.json"
+    assert g["converge_rounds"] == 6
+    assert costmodel.latest_twin_guard([]) is None
+
+
+def test_jain_fairness_math():
+    assert twin_mod.jain_fairness([5, 5, 5, 5]) == pytest.approx(1.0)
+    # a starved client pulls the index down — 1/k when one client
+    # got everything
+    assert twin_mod.jain_fairness([10, 0, 0, 0]) == pytest.approx(0.25)
+    assert twin_mod.jain_fairness([10, 10, 0, 0]) == pytest.approx(0.5)
+    assert twin_mod.jain_fairness([]) == 0.0
