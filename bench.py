@@ -50,15 +50,17 @@ _INIT_TIMEOUT_S = float(os.environ.get("CONSUL_TPU_BENCH_INIT_TIMEOUT", "180"))
 #: --profile, --ckpt-dir D, --resume, --family, --metric) modifies
 #: one of them
 _MODES = ("--mesh", "--sweep", "--chaos", "--coords", "--twin",
-          "--history", "--check-regression", "--autotune")
+          "--users", "--history", "--check-regression", "--autotune")
 
 #: record families --check-regression knows how to RE-MEASURE (the
 #: selector satellite): BENCH re-times the rounds/s headline, PROFILE
 #: re-times the recorded best-utilization roofline config against a
 #: fresh bandwidth peak, SERVE re-runs the recorded top concurrency
 #: rung of the bench_kv sustained ladder in-process — all under the
-#: same median+IQR refusal band
-_GUARDED_FAMILIES = ("BENCH", "PROFILE", "SERVE", "TWIN")
+#: same median+IQR refusal band. USERS re-runs the newest open-loop
+#: traffic record's HEADLINE rung (same virtual-user population, same
+#: pool config) and guards its achieved req/s.
+_GUARDED_FAMILIES = ("BENCH", "PROFILE", "SERVE", "TWIN", "USERS")
 
 
 def _usage(err: str) -> None:
@@ -72,10 +74,11 @@ def _usage(err: str) -> None:
           "       bench.py --mesh|--sweep|--chaos|--twin [--smoke] "
           "[--ckpt-dir D [--resume]]\n"
           "       bench.py --coords [--smoke]\n"
+          "       bench.py --users [--smoke]\n"
           "       bench.py --autotune [--smoke]\n"
           "       bench.py --history\n"
           "       bench.py --check-regression [--smoke] "
-          "[--family BENCH|PROFILE|SERVE|TWIN] [--metric NAME]\n"
+          "[--family BENCH|PROFILE|SERVE|TWIN|USERS] [--metric NAME]\n"
           "(--profile applies to the throughput bench only; modes are "
           "mutually exclusive)", file=sys.stderr)
     sys.exit(2)
@@ -161,6 +164,9 @@ def run_check_regression(smoke: bool, family: str = "BENCH",
         return
     if family == "TWIN":
         _check_twin_regression(smoke, records, metric)
+        return
+    if family == "USERS":
+        _check_users_regression(smoke, records, metric)
         return
     expected = ("gossip_rounds_per_sec_smoke" if smoke
                 else "gossip_rounds_per_sec_1M_nodes")
@@ -295,6 +301,81 @@ def _check_serve_regression(smoke: bool, records,
         "loadavg_1m": _loadavg_1m(),
         "baseline_file": base["file"],
         "fresh_p50_ms": row.get("p50_ms"),
+        **res,
+    }))
+    sys.exit(1 if res["verdict"] == "regression" else 0)
+
+
+def _check_users_regression(smoke: bool, records,
+                            metric: Optional[str]) -> None:
+    """--check-regression --family USERS: guard the open-loop traffic
+    observatory's headline. Rebuilds the observatory (same virtual-
+    user population parameters, same catalog shape, same worker-pool
+    config — all read from the record) and re-runs the newest USERS
+    record's HEADLINE rung at its recorded open-loop target rate; the
+    5 duration-window completion-rate samples feed the median+IQR
+    band against the recorded rung's achieved req/s. --smoke shortens
+    the windows (2s instead of 5s) without changing what is measured:
+    the rate and population come from the record either way. Pure
+    CPU — no accelerator needed."""
+    from consul_tpu.sim import costmodel
+
+    if metric is not None and metric != "users_open_loop":
+        _usage(f"--family USERS re-measures the recorded headline "
+               f"rung of the open-loop ladder (metric "
+               f"'users_open_loop'); it cannot re-measure {metric!r}")
+    base = costmodel.latest_users_guard(records)
+    if base is None:
+        print("--check-regression --family USERS: no recorded "
+              f"USERS_r*.json under {_record_root()} — record one "
+              "first (bench.py --users); a baseline is never "
+              "fabricated", file=sys.stderr)
+        sys.exit(2)
+    rec = next(r for r in records
+               if r["file"] == base["file"])["data"]
+    eng = rec["engine"]
+    pool_cfg = rec.get("pool") or {}
+    cat = rec.get("catalog") or {}
+
+    from consul_tpu.serve import users as users_mod
+
+    windows = 5
+    duration = (2.0 if smoke else 5.0) * windows
+    obs = None
+    try:
+        obs = users_mod.build_observatory(
+            n=3,
+            catalog_nodes=int(cat.get("nodes", 64)),
+            services=int(cat.get("services", 8)),
+            overrides={k: int(v) for k, v in pool_cfg.items()
+                       if k in ("rpc_workers", "rpc_queue_limit")})
+        pop = users_mod.UserPopulation(
+            int(eng["users"]), seed=int(eng["seed"]),
+            zipf_s=float(eng["zipf_s"]),
+            n_keys=int(eng.get("n_keys", 4096)),
+            mix=eng["surface_mix"],
+            session_mean_ops=float(eng.get("session_mean_ops", 8.0)))
+        row = users_mod.run_rung(obs, pop, base["target_rps"],
+                                 duration, windows=windows)
+    finally:
+        if obs is not None:
+            obs.close()
+    samples = row.get("window_rps") or []
+    if len(samples) < 3:
+        print(f"--check-regression --family USERS: only "
+              f"{len(samples)} window samples measured — cannot "
+              "apply the band", file=sys.stderr)
+        sys.exit(2)
+    res = costmodel.check_regression(samples, base["value"])
+    print(json.dumps({
+        "metric": "users_open_loop",
+        "target_rps": base["target_rps"],
+        "users": eng.get("users"),
+        "loadavg_1m": _loadavg_1m(),
+        "baseline_file": base["file"],
+        "fresh_p50_ms": row.get("p50_ms"),
+        "fresh_p99_ms": row.get("p99_ms"),
+        "fresh_rejected": row.get("rejected"),
         **res,
     }))
     sys.exit(1 if res["verdict"] == "regression" else 0)
@@ -1447,6 +1528,108 @@ def _check_twin_regression(smoke: bool, records,
     sys.exit(1 if res["verdict"] == "regression" else 0)
 
 
+def run_users_bench(smoke: bool) -> None:
+    """`bench.py --users [--smoke]`: the million-user traffic
+    observatory (consul_tpu/serve/users.py). Synthesizes a vectorized
+    open-loop virtual-user population (Zipf key popularity, session
+    lifecycles, mixed DNS/KV/catalog/health/watch surfaces) and
+    drives a 3-server loopback cluster — node 0 a full Agent with
+    live DNS + HTTP — up an ascending RPS ladder with latency
+    measured from the INTENDED send time, so coordinated omission
+    cannot hide overload. The worker pool is deliberately small
+    (recorded under "pool") so the ladder reaches the admission-
+    control regime within this host's client budget: the
+    graceful-degradation claim is that at the shedding rung,
+    rpc.workers.rejected > 0 while the p99 of ADMITTED requests stays
+    bounded. Also runs the wake-storm (one write waking a parked
+    mux-pipelined watcher cohort through the claim-token path), a
+    pure-DNS qps flood with dns.* stage attribution, and
+    event-stream fanout under catalog churn. Recorded as
+    USERS_r*.json (full runs only; --smoke prints the payload)."""
+    from consul_tpu.serve import users as users_mod
+
+    if smoke:
+        n_users, cat_nodes, services = 4_096, 64, 8
+        targets = [300.0, 1000.0, 2500.0, 5000.0]
+        duration, windows = 2.0, 3
+        storm_watchers, flood_rps, fanout_subs = 1_024, 500.0, 16
+    else:
+        n_users, cat_nodes, services = 1_000_000, 2_048, 64
+        targets = [250.0, 500.0, 750.0, 1000.0, 1500.0,
+                   2000.0, 3000.0]
+        duration, windows = 6.0, 4
+        storm_watchers = int(os.environ.get(
+            "CONSUL_TPU_USERS_STORM", "100000"))
+        flood_rps, fanout_subs = 2000.0, 64
+    #: the admission-control experiment: a deliberately constrained
+    #: worker pool (vs the 32/1024 defaults) so open-loop load this
+    #: host's client can offer actually drives the queue-limit shed
+    #: path — with the defaults, the inline-read fast path absorbs
+    #: everything the client can send before the pool ever fills
+    pool_cfg = {"rpc_workers": 2, "rpc_queue_limit": 16}
+
+    pop = users_mod.UserPopulation(n_users, seed=0)
+    print(f"virtual users: {n_users:,} (digest "
+          f"{pop.digest()})", file=sys.stderr)
+    obs = users_mod.build_observatory(
+        n=3, catalog_nodes=cat_nodes, services=services,
+        overrides=pool_cfg)
+    try:
+        out = users_mod.run_ladder(obs, pop, targets, duration,
+                                   windows=windows)
+        print(f"wake storm: parking {storm_watchers:,} watchers...",
+              file=sys.stderr)
+        storm = users_mod.run_wake_storm(
+            obs, storm_watchers,
+            sockets=32 if not smoke else 8,
+            park_timeout=300.0 if not smoke else 60.0)
+        print(f"  woke {storm['woken']:,}/{storm['cohort_expected']:,}"
+              f" in p99={storm['wake_p99_ms']}ms", file=sys.stderr)
+        flood = users_mod.run_dns_flood(
+            obs, pop, flood_rps, duration)
+        print(f"dns flood: {flood['achieved_rps']:,.0f} qps "
+              f"p99={flood['p99_ms']}ms", file=sys.stderr)
+        fanout = users_mod.run_stream_fanout(
+            obs, fanout_subs, churn_s=duration)
+        print(f"stream fanout: {fanout['events_per_sec']:,.0f} "
+              f"events/s to {fanout_subs} subscribers",
+              file=sys.stderr)
+    finally:
+        obs.close()
+
+    payload = {
+        "metric": "users_open_loop",
+        "unit": "req/s",
+        "host_cores": os.cpu_count(),
+        "loadavg_1m": _loadavg_1m(),
+        "engine": pop.params(),
+        "catalog": {"nodes": cat_nodes, "services": services},
+        "pool": pool_cfg,
+        **out,
+        "wake_storm": storm,
+        "dns_flood": {k: flood[k] for k in
+                      ("target_rps", "achieved_rps", "p50_ms",
+                       "p99_ms", "errors", "attribution")
+                      if k in flood},
+        "stream_fanout": fanout,
+    }
+    print(json.dumps({
+        "metric": payload["metric"],
+        "headline": out["headline"].get("headline"),
+        "unit": "req/s",
+        "headline_rung": out["headline_rung"],
+        "saturation": out.get("saturation"),
+    }))
+    if smoke:
+        # smoke proves the path end to end but is not ledger
+        # evidence: tiny population, short rungs
+        print("USERS not recorded under --smoke (the ledger only "
+              "carries full-scale runs)", file=sys.stderr)
+        print(json.dumps(payload, indent=1), file=sys.stderr)
+    else:
+        _record_next("USERS", payload)
+
+
 def main() -> None:
     # Local CPU smoke mode (documented in README): tiny cluster, same
     # code path end to end, finishes in ~a minute on one core.
@@ -1466,7 +1649,7 @@ def main() -> None:
                f"cannot be combined with {modes[0]}")
     ckpt_dir, resume = _ckpt_args(argv)
     if modes and modes[0] in ("--history", "--check-regression",
-                              "--autotune") \
+                              "--autotune", "--users") \
             and (ckpt_dir is not None or resume):
         _usage(f"{modes[0]} takes no checkpoint flags")
 
@@ -1503,6 +1686,9 @@ def main() -> None:
         return
     if "--twin" in argv:
         run_twin_bench(smoke, ckpt_dir=ckpt_dir, resume=resume)
+        return
+    if "--users" in argv:
+        run_users_bench(smoke)
         return
     if "--history" in argv:
         run_history()

@@ -62,21 +62,15 @@ import threading
 import time
 
 
-def wait_for(cond, timeout=20.0, what="condition"):
-    t0 = time.time()
-    while time.time() - t0 < timeout:
-        v = cond()
-        if v:
-            return v
-        time.sleep(0.1)
-    raise RuntimeError(f"timed out: {what}")
+# PR 17 moved the shared load-harness primitives (wait_for, Jain,
+# the stability-band headline, the thread census, the pipelined mux
+# watch herd) into the open-loop engine package so bench_kv's
+# closed-loop harness and the virtual-user observatory measure with
+# ONE set of instruments; the local names stay for every caller.
+from consul_tpu.serve import users as _users  # noqa: E402
 
-
-def _loadavg_1m():
-    try:
-        return round(os.getloadavg()[0], 2)
-    except OSError:  # platform without getloadavg
-        return None
+wait_for = _users.wait_for
+_loadavg_1m = _users.loadavg_1m
 
 
 def _one_trial(name, fn, n_threads, n_ops):
@@ -116,51 +110,15 @@ def _one_trial(name, fn, n_threads, n_ops):
 
 #: headline-ratio stability band: a vs_baseline ratio is printed only
 #: when the trials' IQR/median is at or under this (and >= 3 samples
-#: exist) — above it the spread swallows the claim
-STABILITY_BAND = 0.10
+#: exist) — above it the spread swallows the claim. One band,
+#: every harness (consul_tpu/serve/users.py owns the definition).
+STABILITY_BAND = _users.STABILITY_BAND
 
-
-def _headline(samples, baseline=None, band=STABILITY_BAND):
-    """Median + IQR over per-trial throughput samples, and the
-    stability verdict. Pure (unit-tested in tests/test_conformance.py):
-    returns the dict fragment run_workload merges — `value` is the
-    MEDIAN sample, `vs_baseline` is None with an `unstable` reason
-    whenever the spread (IQR/median > band) or the sample count (< 3)
-    makes a headline ratio dishonest.
-
-    With baseline=None (the sustained-load harness: there is no
-    published reference row for an arbitrary concurrency ladder) the
-    SAME refusal band gates a `headline` field instead: the median is
-    promoted to the headline number only when stable."""
-    med = statistics.median(samples)
-    iqr = None
-    if len(samples) >= 3:
-        qs = statistics.quantiles(samples, n=4)
-        iqr = qs[2] - qs[0]
-    out = {
-        "value": round(med, 1),
-        "samples": [round(s, 1) for s in samples],
-        "iqr": None if iqr is None else round(iqr, 1),
-        "iqr_over_median": (None if iqr is None or not med
-                            else round(iqr / med, 4)),
-        "stability_band": band,
-    }
-    key = "vs_baseline" if baseline is not None else "headline"
-    if len(samples) < 3:
-        out[key] = None
-        out["unstable"] = (f"need >= 3 in-process samples for a "
-                           f"headline ratio (got {len(samples)}); "
-                           "run with --repeat 3")
-    elif med and iqr / med > band:
-        out[key] = None
-        out["unstable"] = (f"IQR/median {iqr / med:.3f} exceeds the "
-                           f"{band:.0%} stability band — host too "
-                           "noisy for a headline ratio")
-    elif baseline is not None:
-        out[key] = round(med / baseline, 3)
-    else:
-        out[key] = round(med, 1)
-    return out
+#: median + IQR + refusal verdict over per-trial throughput samples
+#: (unit-tested in tests/test_conformance.py; the implementation
+#: lives in consul_tpu/serve/users.py so the open-loop ladder
+#: refuses headlines under the SAME band as the closed-loop trials)
+_headline = _users.headline
 
 
 def run_workload(name, fn, n_threads, n_ops, baseline, repeat=3):
@@ -238,12 +196,10 @@ def build_cluster(n: int = 3):
 HERD = {"threads": 16, "keys": 8, "touch_interval_s": 0.25}
 
 
-def _jain(xs):
-    """Jain's fairness index over per-client throughput: 1.0 =
-    perfectly fair, 1/n = one client got everything."""
-    if not xs or not any(xs):
-        return None
-    return round(sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs)), 4)
+#: Jain's fairness index over per-client throughput: 1.0 = perfectly
+#: fair, 1/n = one client got everything (shared with the open-loop
+#: engine's per-user-per-surface fairness rows)
+_jain = _users.jain
 
 
 def _start_herd(leader, follower, stop, threads, keys,
@@ -289,33 +245,11 @@ def _start_herd(leader, follower, stop, threads, keys,
     return ts
 
 
-def _thread_census():
-    """Process thread counts, split so the thread-per-watcher
-    regression is visible: `mux_dedicated` counts the server's
-    dedicated per-request mux threads (named mux-<src>-<sid>; the
-    reactor keeps this ~0 — forwarded blocking queries only), next to
-    the reactor/worker/stream populations."""
-    total = 0
-    mux_dedicated = 0
-    mux_streams = 0
-    rpc_workers = 0
-    reactors = 0
-    for t in threading.enumerate():
-        total += 1
-        name = t.name
-        if name.startswith("mux-stream-"):
-            mux_streams += 1
-        elif name.startswith("mux-reader-"):
-            pass  # client-side demux readers
-        elif name.startswith("mux-"):
-            mux_dedicated += 1
-        elif name.startswith("rpc-worker"):
-            rpc_workers += 1
-        elif name.startswith("rpc-reactor"):
-            reactors += 1
-    return {"total": total, "mux_dedicated": mux_dedicated,
-            "mux_streams": mux_streams, "rpc_workers": rpc_workers,
-            "reactors": reactors}
+#: process thread counts split so the thread-per-watcher regression
+#: is visible (moved to consul_tpu/serve/users.py; `mux_dedicated`
+#: counts the server's dedicated per-request mux threads — the
+#: reactor keeps this ~0)
+_thread_census = _users.thread_census
 
 
 def _start_pipelined_herd(follower, stop, threads, keys,
@@ -328,85 +262,17 @@ def _start_pipelined_herd(follower, stop, threads, keys,
     so the process's thread count measures the SERVER's threading
     model — the claim under test (O(pool), not O(watchers)).
 
-    Returns {"threads", "close", "responses", "key0_cohort"}: close()
-    unblocks the readers by closing the sockets; responses() is the
-    cumulative count of watch completions (wake-delivery accounting);
-    key0_cohort is the EXACT number of watchers parked on herd/0 —
-    sids restart per socket, so the cohort is a per-socket sum, not
-    n//keys."""
-    from consul_tpu.server.rpc import RPC_MUX, read_frame, write_frame
-    import socket as socket_mod
-
-    host, port = follower.rpc.addr.rsplit(":", 1)
-    per = (threads + sockets - 1) // sockets
-    resp_count = [0]
-    resp_lock = threading.Lock()
-    socks = []
-    ts = []
-    made = 0
-    key0_cohort = 0
-    for s_i in range(sockets):
-        n_here = min(per, threads - made)
-        if n_here <= 0:
-            break
-        made += n_here
-        # sids 0..n_here-1 on THIS socket; sid % keys == 0 watches
-        # herd/0
-        key0_cohort += (n_here + keys - 1) // keys
-        sock = socket_mod.create_connection((host, int(port)),
-                                            timeout=10.0)
-        sock.sendall(bytes([RPC_MUX]))
-        wlock = threading.Lock()
-
-        def arm(sock, wlock, sid, min_idx):
-            with wlock:
-                write_frame(sock, {
-                    "sid": sid, "method": "KVS.Get",
-                    "args": {"Key": f"herd/{sid % keys}",
-                             "AllowStale": True,
-                             "MinQueryIndex": max(min_idx, 1),
-                             "MaxQueryTime": max_query_time}})
-
-        for sid in range(n_here):
-            arm(sock, wlock, sid, 1)
-
-        def reader(sock=sock, wlock=wlock):
-            while not stop.is_set():
-                try:
-                    resp = read_frame(sock)
-                except Exception:  # noqa: BLE001 — closed mid-read
-                    return
-                if resp is None:
-                    return
-                with resp_lock:
-                    resp_count[0] += 1
-                if stop.is_set():
-                    return
-                idx = (resp.get("result") or {}).get("Index", 1)
-                try:
-                    arm(sock, wlock, resp.get("sid", 0), idx)
-                except OSError:
-                    return
-
-        socks.append(sock)
-        ts.append(threading.Thread(target=reader, daemon=True,
-                                   name=f"herd-mux-{s_i}"))
-    for t in ts:
-        t.start()
-
-    def close():
-        for s in socks:
-            try:
-                s.close()
-            except OSError:
-                pass
-
-    def responses():
-        with resp_lock:
-            return resp_count[0]
-
-    return {"threads": ts, "close": close, "responses": responses,
-            "key0_cohort": key0_cohort}
+    Thin wrapper over the generalized herd in
+    consul_tpu/serve/users.py (the open-loop wake-storm scenario
+    shares it); keeps bench_kv's follower-object signature and the
+    herd/ key prefix. Returns {"threads", "close", "responses",
+    "key0_cohort"} — key0_cohort is the EXACT number of watchers
+    parked on herd/0 (sids restart per socket, so the cohort is a
+    per-socket sum, not n//keys)."""
+    return _users.start_pipelined_watch_herd(
+        follower.rpc.addr, stop, threads, keys,
+        max_query_time=max_query_time, sockets=sockets,
+        key_prefix="herd")
 
 
 def run_herd_scale(leader, follower, n, keys=None, sockets=16,

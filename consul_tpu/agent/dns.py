@@ -22,7 +22,7 @@ import struct
 import threading
 from typing import Any, Optional
 
-from consul_tpu.utils import log
+from consul_tpu.utils import log, perf
 
 QTYPE_A = 1
 QTYPE_NS = 2
@@ -143,12 +143,21 @@ class DNSServer:
                 data, src = self._udp.recvfrom(4096)
             except OSError:
                 return
+            # stage ledger per query (utils/perf.py): the idle recvfrom
+            # wait is NOT counted — the ledger opens when the datagram
+            # is in hand, same contract as rpc.read
+            led = perf.ledger("dns")
+            tok = perf.attach(led)
             try:
                 resp = self.handle(data)
                 if resp is not None:
-                    self._udp.sendto(resp, src)
+                    with perf.stage("dns.write"):
+                        self._udp.sendto(resp, src)
             except Exception as e:  # noqa: BLE001
                 self.log.warning("query failed: %s", e)
+            finally:
+                perf.detach(tok)
+                perf.close(led)
 
     # ------------------------------------------------------------ protocol
 
@@ -156,62 +165,68 @@ class DNSServer:
         """Answer one wire-format DNS message. tcp=True lifts the UDP
         512-byte/EDNS truncation (RFC 1035 §4.2.2 — TCP and the pbdns
         gRPC transport carry up to 64KB, so no TC bit)."""
-        if len(data) < 12:
-            return None
-        (qid, flags, qd, an, ns, ar) = struct.unpack_from(">HHHHHH", data)
-        if qd < 1:
-            return None
-        qname, off = _decode_name(data, 12)
-        qtype, qclass = struct.unpack_from(">HH", data, off)
-        off += 4
-        # EDNS advertised UDP size from OPT in additional section
-        udp_size = 512
-        try:
-            for _ in range(ar):
-                _, o2 = _decode_name(data, off)
-                rtype, rclass, _ttl, rdlen = struct.unpack_from(
-                    ">HHIH", data, o2)
-                if rtype == QTYPE_OPT:
-                    udp_size = max(512, rclass)
-                off = o2 + 10 + rdlen
-        except Exception:  # noqa: BLE001 — ignore malformed additionals
-            pass
+        with perf.stage("dns.read"):
+            if len(data) < 12:
+                return None
+            (qid, flags, qd, an, ns, ar) = struct.unpack_from(
+                ">HHHHHH", data)
+            if qd < 1:
+                return None
+            qname, off = _decode_name(data, 12)
+            qtype, qclass = struct.unpack_from(">HH", data, off)
+            off += 4
+            # EDNS advertised UDP size from OPT in additional section
+            udp_size = 512
+            try:
+                for _ in range(ar):
+                    _, o2 = _decode_name(data, off)
+                    rtype, rclass, _ttl, rdlen = struct.unpack_from(
+                        ">HHIH", data, o2)
+                    if rtype == QTYPE_OPT:
+                        udp_size = max(512, rclass)
+                    off = o2 + 10 + rdlen
+            except Exception:  # noqa: BLE001 — malformed additionals
+                pass
 
-        answers, authoritative, forced_rcode = self.resolve(
-            qname, qtype)
-        if answers is None:
-            # outside our domain → recurse if configured
-            fwd = self._recurse(data)
-            if fwd is not None:
-                return fwd
-            answers, authoritative = [], False
+        with perf.stage("dns.lookup"):
+            answers, authoritative, forced_rcode = self.resolve(
+                qname, qtype)
+            if answers is None:
+                # outside our domain → recurse if configured
+                fwd = self._recurse(data)
+                if fwd is not None:
+                    return fwd
+                answers, authoritative = [], False
 
-        rcode = 0 if answers else 3  # NXDOMAIN when we own it but no data
-        if answers is not None and not authoritative and not answers:
-            rcode = 2  # SERVFAIL for failed recursion
-        if forced_rcode is not None:
-            rcode = forced_rcode
-        hdr_flags = 0x8000 | (0x0400 if authoritative else 0) \
-            | (flags & 0x0100) | rcode
-        # rebuild question section canonically
-        question = _encode_name(qname) + struct.pack(">HH", qtype, qclass)
-        payload = b"".join(answers)
-        authority = b""
-        ns_count = 0
-        if authoritative and not answers:
-            # negative answer (NXDOMAIN or NODATA) in OUR domain: the
-            # SOA rides the authority section so resolvers can cache
-            # the negative per RFC 2308 (dns.go addSOA)
-            authority = self._soa_record()
-            ns_count = 1
-        resp = struct.pack(">HHHHHH", qid, hdr_flags, 1, len(answers),
-                           ns_count, 0) + question + payload + authority
-        if tcp:
-            udp_size = 65535
-        if len(resp) > udp_size:
-            # truncate: header with TC bit, no answers
-            resp = struct.pack(">HHHHHH", qid, hdr_flags | 0x0200, 1, 0,
-                               0, 0) + question
+        with perf.stage("dns.encode"):
+            rcode = 0 if answers else 3  # NXDOMAIN: ours but no data
+            if answers is not None and not authoritative and not answers:
+                rcode = 2  # SERVFAIL for failed recursion
+            if forced_rcode is not None:
+                rcode = forced_rcode
+            hdr_flags = 0x8000 | (0x0400 if authoritative else 0) \
+                | (flags & 0x0100) | rcode
+            # rebuild question section canonically
+            question = _encode_name(qname) \
+                + struct.pack(">HH", qtype, qclass)
+            payload = b"".join(answers)
+            authority = b""
+            ns_count = 0
+            if authoritative and not answers:
+                # negative answer (NXDOMAIN or NODATA) in OUR domain:
+                # the SOA rides the authority section so resolvers can
+                # cache the negative per RFC 2308 (dns.go addSOA)
+                authority = self._soa_record()
+                ns_count = 1
+            resp = struct.pack(">HHHHHH", qid, hdr_flags, 1,
+                               len(answers), ns_count, 0) \
+                + question + payload + authority
+            if tcp:
+                udp_size = 65535
+            if len(resp) > udp_size:
+                # truncate: header with TC bit, no answers
+                resp = struct.pack(">HHHHHH", qid, hdr_flags | 0x0200,
+                                   1, 0, 0, 0) + question
         return resp
 
     def _recurse(self, raw: bytes) -> Optional[bytes]:

@@ -1,0 +1,13 @@
+"""Serving-plane load engines.
+
+`users.py` is the open-loop virtual-user traffic engine (PR 17): it
+synthesizes a vectorized population of distinct virtual users and
+drives the agent's real serving surfaces — DNS, KV reads/writes,
+catalog, health, watch long-polls — at scheduled arrival rates with
+latency measured from the *intended* send time, so coordinated
+omission cannot hide overload. bench_kv.py's closed-loop harness
+imports its shared primitives (Jain fairness, the stability-band
+headline, the pipelined mux watch herd, the thread census) from here.
+"""
+
+from consul_tpu.serve import users  # noqa: F401

@@ -1,0 +1,1026 @@
+"""Open-loop virtual-user traffic engine: the "millions of users"
+headline made literal and falsifiable.
+
+Every load number before PR 17 was a small closed-loop KV herd: N
+client threads, each waiting for its own response before sending the
+next request. Closed loops self-throttle — when the server slows
+down, the offered load drops with it, and the latency percentiles
+quietly measure a lighter workload than the one claimed (coordinated
+omission). This engine inverts the contract:
+
+  * a **vectorized user population** (numpy): each of up to millions
+    of distinct virtual users gets a Zipf-ranked favorite key, a
+    primary serving surface drawn from a realistic mix (DNS lookups
+    incl. the RTT-sorted ``?near=`` path, watch long-polls, health
+    queries, catalog reads, KV reads/writes), and a session lifecycle
+    — ops arrive in geometric-length user sessions, so per-user
+    request counts are skewed the way real fleets are. The whole
+    synthesis is deterministic under a pinned seed (tier-1 pins the
+    op-stream digest).
+  * an **open-loop scheduler**: every op has an *intended* send time
+    ``start + i/target_rps`` fixed before the rung begins. Latency is
+    measured from that intended time — if the client falls behind or
+    the server queues, the backlog shows up as latency instead of
+    disappearing into a slower send rate.
+  * **pipelined mux framing** (the PR 13 herd-scale client): RPC ops
+    ride a small fixed pool of raw RPC_MUX sockets with distinct
+    sids, one demux reader thread per socket — thousands of in-flight
+    requests cost ~a dozen client threads, so the client can offer
+    load past the server's capacity instead of saturating itself
+    first. DNS ops ride UDP datagrams with qid-matched readers.
+  * **refusal semantics**: a shed response (the server's structured
+    retryable ERR_POOL_SATURATED) counts as *rejected*, never as a
+    completion — the graceful-degradation story is "p99 of admitted
+    requests stays bounded because the excess is refused", and that
+    claim is only honest when refusals are first-class.
+
+Per-surface SLO rows (p50/p99 from intended send time, Jain fairness
+over per-user completions, offered/completed/rejected/errors) feed the
+USERS record family (bench.py --users → USERS_rNN.json, schema
+registry.USERS_RUNG_KEYS / USERS_SURFACE_KEYS).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket as socket_mod
+import statistics
+import struct
+import sys
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+#: headline-ratio stability band (shared with bench_kv.STABILITY_BAND
+#: and costmodel.STABILITY_BAND — the PR 9 refusal protocol): a
+#: median whose IQR/median exceeds this refuses to be a headline
+STABILITY_BAND = 0.10
+
+#: the serving surfaces the engine drives, in mix order — mirrors
+#: sim/registry.USERS_SURFACES (pinned there; folded into the layout
+#: digest)
+SURFACES = ("dns", "kv_get", "kv_get_stale", "kv_put",
+            "catalog", "health", "watch")
+
+#: default surface mix: read-heavy with DNS dominating, the shape of
+#: a service-discovery fleet (Consul's production surveys put DNS +
+#: stale reads well past half of all agent traffic)
+DEFAULT_MIX = {"dns": 0.35, "kv_get_stale": 0.20, "kv_get": 0.15,
+               "health": 0.10, "catalog": 0.08, "kv_put": 0.07,
+               "watch": 0.05}
+
+#: watch-surface long-poll window: a watch op parks on the follower
+#: (MinQueryIndex far future) and completes at MaxQueryTime — its
+#: latency-from-intended-send includes this window BY DESIGN, which
+#: is why attribution is per-surface
+WATCH_POLL_S = 0.25
+
+
+def wait_for(cond, timeout=20.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise RuntimeError(f"timed out: {what}")
+
+
+def loadavg_1m():
+    try:
+        return round(os.getloadavg()[0], 2)
+    except OSError:  # platform without getloadavg
+        return None
+
+
+def jain(xs):
+    """Jain's fairness index over per-client (or per-user) throughput:
+    1.0 = perfectly fair, 1/n = one client got everything."""
+    if xs is None or len(xs) == 0 or not any(xs):
+        return None
+    xs = [float(x) for x in xs]
+    return round(sum(xs) ** 2 / (len(xs) * sum(x * x for x in xs)), 4)
+
+
+def headline(samples, baseline=None, band=STABILITY_BAND):
+    """Median + IQR over per-trial throughput samples, and the
+    stability verdict (moved here from bench_kv.py — one band, every
+    harness). Returns the dict fragment callers merge: `value` is the
+    MEDIAN sample; `vs_baseline` (with a baseline) or `headline`
+    (without) is None with an `unstable` reason whenever the spread
+    (IQR/median > band) or the sample count (< 3) makes the number
+    dishonest."""
+    med = statistics.median(samples)
+    iqr = None
+    if len(samples) >= 3:
+        qs = statistics.quantiles(samples, n=4)
+        iqr = qs[2] - qs[0]
+    out = {
+        "value": round(med, 1),
+        "samples": [round(s, 1) for s in samples],
+        "iqr": None if iqr is None else round(iqr, 1),
+        "iqr_over_median": (None if iqr is None or not med
+                            else round(iqr / med, 4)),
+        "stability_band": band,
+    }
+    key = "vs_baseline" if baseline is not None else "headline"
+    if len(samples) < 3:
+        out[key] = None
+        out["unstable"] = (f"need >= 3 in-process samples for a "
+                           f"headline ratio (got {len(samples)}); "
+                           "run with --repeat 3")
+    elif med and iqr / med > band:
+        out[key] = None
+        out["unstable"] = (f"IQR/median {iqr / med:.3f} exceeds the "
+                           f"{band:.0%} stability band — host too "
+                           "noisy for a headline ratio")
+    elif baseline is not None:
+        out[key] = round(med / baseline, 3)
+    else:
+        out[key] = round(med, 1)
+    return out
+
+
+def thread_census():
+    """Process thread counts, split so the thread-per-watcher
+    regression is visible (moved here from bench_kv.py):
+    `mux_dedicated` counts the server's dedicated per-request mux
+    threads (the reactor keeps this ~0)."""
+    total = 0
+    mux_dedicated = 0
+    mux_streams = 0
+    rpc_workers = 0
+    reactors = 0
+    for t in threading.enumerate():
+        total += 1
+        name = t.name
+        if name.startswith("mux-stream-"):
+            mux_streams += 1
+        elif name.startswith("mux-reader-"):
+            pass  # client-side demux readers
+        elif name.startswith("mux-"):
+            mux_dedicated += 1
+        elif name.startswith("rpc-worker"):
+            rpc_workers += 1
+        elif name.startswith("rpc-reactor"):
+            reactors += 1
+    return {"total": total, "mux_dedicated": mux_dedicated,
+            "mux_streams": mux_streams, "rpc_workers": rpc_workers,
+            "reactors": reactors}
+
+
+# ------------------------------------------------ pipelined watch herd
+
+def start_pipelined_watch_herd(addr: str, stop: threading.Event,
+                               threads: int, keys: int,
+                               max_query_time: float = 30.0,
+                               sockets: int = 16,
+                               key_prefix: str = "herd",
+                               on_response: Optional[Callable] = None
+                               ) -> dict[str, Any]:
+    """Client side of a LARGE blocking-watcher herd with NO thread per
+    watcher on either end (the PR 13 herd-scale path, generalized from
+    bench_kv so the wake-storm scenario shares it): `sockets` raw
+    RPC_MUX sessions each carry ~threads/sockets concurrently parked
+    KVS.Get watches (distinct sids, pipelined frames), re-armed by ONE
+    reader thread per socket as responses arrive.
+
+    Returns {"threads", "close", "responses", "key0_cohort"}; the
+    optional ``on_response(sid, resp, t_done)`` hook runs on the
+    reader thread per completion (the wake storm timestamps wake
+    delivery through it)."""
+    from consul_tpu.server.rpc import RPC_MUX, read_frame, write_frame
+
+    host, port = addr.rsplit(":", 1)
+    per = (threads + sockets - 1) // sockets
+    resp_count = [0]
+    resp_lock = threading.Lock()
+    socks = []
+    ts = []
+    made = 0
+    key0_cohort = 0
+    for s_i in range(sockets):
+        n_here = min(per, threads - made)
+        if n_here <= 0:
+            break
+        made += n_here
+        # sids 0..n_here-1 on THIS socket; sid % keys == 0 watches
+        # <prefix>/0 — cohort is a per-socket sum, not n//keys
+        key0_cohort += (n_here + keys - 1) // keys
+        sock = socket_mod.create_connection((host, int(port)),
+                                            timeout=10.0)
+        sock.sendall(bytes([RPC_MUX]))
+        wlock = threading.Lock()
+
+        def arm(sock, wlock, sid, min_idx):
+            with wlock:
+                write_frame(sock, {
+                    "sid": sid, "method": "KVS.Get",
+                    "args": {"Key": f"{key_prefix}/{sid % keys}",
+                             "AllowStale": True,
+                             "MinQueryIndex": max(min_idx, 1),
+                             "MaxQueryTime": max_query_time}})
+
+        for sid in range(n_here):
+            arm(sock, wlock, sid, 1)
+
+        def reader(sock=sock, wlock=wlock):
+            while not stop.is_set():
+                try:
+                    resp = read_frame(sock)
+                except Exception:  # noqa: BLE001 — closed mid-read
+                    return
+                if resp is None:
+                    return
+                t_done = time.perf_counter()
+                with resp_lock:
+                    resp_count[0] += 1
+                if on_response is not None:
+                    try:
+                        on_response(resp.get("sid", 0), resp, t_done)
+                    except Exception:  # noqa: BLE001
+                        pass
+                if stop.is_set():
+                    return
+                idx = (resp.get("result") or {}).get("Index", 1)
+                try:
+                    arm(sock, wlock, resp.get("sid", 0), idx)
+                except OSError:
+                    return
+
+        socks.append(sock)
+        ts.append(threading.Thread(target=reader, daemon=True,
+                                   name=f"herd-mux-{s_i}"))
+    for t in ts:
+        t.start()
+
+    def close():
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def responses():
+        with resp_lock:
+            return resp_count[0]
+
+    return {"threads": ts, "close": close, "responses": responses,
+            "key0_cohort": key0_cohort}
+
+
+# ----------------------------------------------------- user population
+
+class UserPopulation:
+    """A vectorized population of distinct virtual users. Per user:
+    a Zipf-ranked favorite key (rank drawn by inverse-CDF over
+    ``n_keys`` ranks with exponent ``zipf_s`` — a handful of hot keys
+    carry most traffic), a primary serving surface drawn from ``mix``,
+    and a session process (ops arrive in geometric-length bursts of
+    mean ``session_mean_ops``). Fully deterministic under ``seed``."""
+
+    def __init__(self, n_users: int, seed: int = 0,
+                 zipf_s: float = 1.1, n_keys: int = 4096,
+                 mix: Optional[dict[str, float]] = None,
+                 session_mean_ops: float = 8.0) -> None:
+        self.n_users = int(n_users)
+        self.seed = int(seed)
+        self.zipf_s = float(zipf_s)
+        self.n_keys = int(n_keys)
+        self.mix = dict(mix or DEFAULT_MIX)
+        self.session_mean_ops = float(session_mean_ops)
+        unknown = set(self.mix) - set(SURFACES)
+        if unknown:
+            raise ValueError(f"unknown surfaces in mix: {unknown}")
+        rng = np.random.default_rng(self.seed)
+        # Zipf key ranks by inverse CDF: p(rank k) ∝ 1/k^s over the
+        # finite key space (np.random.zipf is unbounded — a finite
+        # catalog needs the truncated law)
+        ranks = np.arange(1, self.n_keys + 1, dtype=np.float64)
+        pmf = ranks ** -self.zipf_s
+        cdf = np.cumsum(pmf / pmf.sum())
+        u = rng.random(self.n_users)
+        self.user_key = np.searchsorted(cdf, u).astype(np.int32)
+        # primary surface per user, multinomial over the mix
+        names = [s for s in SURFACES if s in self.mix]
+        probs = np.array([self.mix[s] for s in names], dtype=np.float64)
+        probs = probs / probs.sum()
+        draw = rng.random(self.n_users)
+        edges = np.cumsum(probs)
+        idx = np.searchsorted(edges, draw).clip(0, len(names) - 1)
+        surf_codes = np.array([SURFACES.index(s) for s in names],
+                              dtype=np.int8)
+        self.user_surface = surf_codes[idx]
+
+    def ops(self, total: int, salt: int = 0
+            ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The deterministic op stream for one rung: ``total`` ops as
+        (user_id, surface_code, key_rank) arrays. Users arrive in
+        sessions — one user issues a geometric-length burst, then the
+        next session's user takes over — so per-user op counts are
+        skewed the way real fleets are (the Jain-per-surface rows
+        measure shedding fairness against exactly this skew)."""
+        total = int(total)
+        rng = np.random.default_rng((self.seed, 0xC0FFEE, salt))
+        ids = np.empty(0, dtype=np.int64)
+        while ids.size < total:
+            est = max(16, int(total / self.session_mean_ops) + 16)
+            users = rng.integers(0, self.n_users, est)
+            lens = rng.geometric(1.0 / self.session_mean_ops, est)
+            ids = np.concatenate([ids, np.repeat(users, lens)])
+        ids = ids[:total]
+        return ids, self.user_surface[ids], self.user_key[ids]
+
+    def digest(self, total: int = 4096) -> str:
+        """Stable fingerprint of the population + op stream head —
+        the tier-1 determinism pin."""
+        ids, surfs, keys = self.ops(total)
+        h = hashlib.sha256()
+        for a in (ids, surfs, keys):
+            h.update(np.ascontiguousarray(a).tobytes())
+        h.update(self.user_key[: min(self.n_users, 65536)].tobytes())
+        return h.hexdigest()[:16]
+
+    def params(self) -> dict[str, Any]:
+        """The engine envelope recorded into USERS_r*.json."""
+        return {"users": self.n_users, "seed": self.seed,
+                "zipf_s": self.zipf_s, "n_keys": self.n_keys,
+                "surface_mix": {k: round(v, 4)
+                                for k, v in self.mix.items()},
+                "session_mean_ops": self.session_mean_ops,
+                "digest": self.digest()}
+
+
+# -------------------------------------------------------- observatory
+
+def _dns_query(name: str, qid: int, qtype: int = 1) -> bytes:
+    q = struct.pack(">HHHHHH", qid, 0x0100, 1, 0, 0, 0)
+    for label in name.rstrip(".").split("."):
+        q += bytes([len(label)]) + label.encode()
+    return q + b"\x00" + struct.pack(">HH", qtype, 1)
+
+
+class Observatory:
+    """The serving fabric under observation: a 3-server loopback
+    cluster whose first node is a FULL Agent (HTTP + DNS listeners),
+    so the engine's DNS floods and /v1/agent/perf scrapes hit the
+    same process-global stage ledger the RPC surfaces feed."""
+
+    def __init__(self, agent, servers, leader, follower,
+                 services: int) -> None:
+        self.agent = agent
+        self.servers = servers
+        self.leader = leader
+        self.follower = follower
+        self.services = services
+        self.dns_addr = (agent.dns.addr.rsplit(":", 1)[0],
+                         agent.dns.port)
+
+    def close(self) -> None:
+        try:
+            self.agent.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        for s in self.servers:
+            if s is not getattr(self.agent, "server", None):
+                try:
+                    s.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+def build_observatory(n: int = 3, catalog_nodes: int = 64,
+                      services: int = 8,
+                      overrides: Optional[dict] = None) -> Observatory:
+    """Build the n-server cluster with node 0 as a full Agent (DNS +
+    HTTP), then register a synthetic catalog: ``catalog_nodes`` nodes
+    spread across ``services`` service names (svc-0..svc-K), each a
+    real replicated Catalog.Register commit — the population the DNS
+    and catalog surfaces read."""
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import load
+    from consul_tpu.server import Server
+
+    base = {"server": True, "bootstrap": n == 1,
+            "bootstrap_expect": 0 if n == 1 else n,
+            # loopback topology artifact (bench_kv.build_cluster):
+            # every client shares 127.0.0.1
+            "rpc_max_conns_per_client": 4096,
+            # the ?near= path: RTT-sort service answers relative to
+            # the serving agent's Vivaldi coordinate
+            "dns_sort_rtt": True}
+    base.update(overrides or {})
+    print(f"building {n}-server observatory...", file=sys.stderr)
+    agent = Agent(load(dev=True, overrides={
+        **base, "node_name": "users0"}))
+    agent.start(serve_http=True, serve_dns=True)
+    servers = [agent.server]
+    for i in range(1, n):
+        cfg = load(dev=True, overrides={
+            **base, "node_name": f"users{i}"})
+        s = Server(cfg)
+        s.start()
+        s.join([agent.server.serf.memberlist.transport.addr])
+        servers.append(s)
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="leader election")
+    if n > 1:
+        wait_for(lambda: len(leader.raft.peers) == n,
+                 what=f"{n} raft peers")
+    follower = next((s for s in servers if s is not leader), leader)
+    obs = Observatory(agent, servers, leader, follower, services)
+    if catalog_nodes:
+        from consul_tpu.server.rpc import ConnPool
+
+        pool = ConnPool()
+        for i in range(catalog_nodes):
+            svc = f"svc-{i % services}"
+            pool.call(leader.rpc.addr, "Catalog.Register", {
+                "Node": f"vnode-{i}",
+                "Address": f"10.{(i >> 16) & 255}.{(i >> 8) & 255}"
+                           f".{i & 255}",
+                "Service": {"ID": svc, "Service": svc,
+                            "Port": 8000 + (i % services)}})
+        pool.close()
+        wait_for(lambda: len(
+            (follower.handle_rpc("Catalog.ListNodes",
+                                 {"AllowStale": True}, "users-bench")
+             .get("Nodes") or [])) >= catalog_nodes,
+            what="catalog replication")
+    return obs
+
+
+# ---------------------------------------------------- open-loop rung
+
+class _Results:
+    """Per-reader-thread completion records, merged after the rung
+    (no shared lock on the completion hot path)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.lanes: list[list] = []
+
+    def lane(self) -> list:
+        rows: list = []
+        with self.lock:
+            self.lanes.append(rows)
+        return rows
+
+    def merged(self) -> list:
+        with self.lock:
+            return [r for lane in self.lanes for r in lane]
+
+
+def run_rung(obs: Observatory, pop: UserPopulation, target_rps: float,
+             duration: float, windows: int = 3, senders: int = 4,
+             rpc_sockets: int = 8, salt: int = 0,
+             drain_s: float = 5.0,
+             stall_hook: Optional[Callable[[int], None]] = None
+             ) -> dict[str, Any]:
+    """One open-loop rung: ``target_rps * duration`` ops with intended
+    send times fixed up front, fanned across the mixed surfaces.
+    Returns the USERS_RUNG_KEYS row. ``stall_hook(i)`` (tests) runs on
+    the sender thread before op i is sent — an injected client stall
+    must GROW the measured p99 even though server service time is
+    unchanged, which is the whole point of intended-send-time
+    accounting."""
+    from consul_tpu.server.rpc import RPC_MUX, read_frame, write_frame
+    from consul_tpu.utils import perf
+
+    total = max(1, int(target_rps * duration))
+    ids, surfs, keys = pop.ops(total, salt=salt)
+    results = _Results()
+    rejected = [0]
+    errored = [0]
+    counters_lock = threading.Lock()
+
+    # --- RPC lanes: raw pipelined mux sockets, one reader each ------
+    leader_addr = obs.leader.rpc.addr
+    follower_addr = obs.follower.rpc.addr
+    lanes = []  # (sock, wlock, pending{sid: (surf, user, sched)}, plk)
+    readers = []
+    stop = threading.Event()
+    for li in range(rpc_sockets):
+        addr = leader_addr if li % 2 == 0 else follower_addr
+        host, port = addr.rsplit(":", 1)
+        sock = socket_mod.create_connection((host, int(port)),
+                                            timeout=10.0)
+        sock.sendall(bytes([RPC_MUX]))
+        pending: dict[int, tuple] = {}
+        lane = (sock, threading.Lock(), pending, threading.Lock())
+        lanes.append(lane)
+        rows = results.lane()
+
+        def reader(sock=sock, pending=pending, plk=lane[3], rows=rows):
+            while True:
+                try:
+                    resp = read_frame(sock)
+                except Exception:  # noqa: BLE001 — closed mid-read
+                    return
+                if resp is None:
+                    return
+                t_done = time.perf_counter()
+                with plk:
+                    meta = pending.pop(resp.get("sid", -1), None)
+                if meta is None:
+                    continue
+                surf, user, sched = meta
+                err = resp.get("error")
+                if err:
+                    with counters_lock:
+                        if resp.get("retryable") \
+                                or "overloaded" in str(err):
+                            rejected[0] += 1
+                            rows.append((surf, user, sched, t_done,
+                                         "rejected"))
+                        else:
+                            errored[0] += 1
+                            rows.append((surf, user, sched, t_done,
+                                         "error"))
+                else:
+                    rows.append((surf, user, sched, t_done, "ok"))
+
+        t = threading.Thread(target=reader, daemon=True,
+                             name=f"users-mux-{li}")
+        readers.append(t)
+        t.start()
+
+    # --- DNS lanes: one UDP socket per sender, qid-matched ----------
+    dns_socks = []
+    dns_pend: list[dict[int, tuple]] = []
+    dns_plks = []
+    for si in range(senders):
+        s = socket_mod.socket(socket_mod.AF_INET,
+                              socket_mod.SOCK_DGRAM)
+        s.connect(obs.dns_addr)
+        s.settimeout(0.5)
+        dns_socks.append(s)
+        dns_pend.append({})
+        dns_plks.append(threading.Lock())
+        rows = results.lane()
+
+        def dns_reader(s=s, pending=dns_pend[si], plk=dns_plks[si],
+                       rows=rows):
+            while not stop.is_set():
+                try:
+                    data = s.recv(4096)
+                except socket_mod.timeout:
+                    continue
+                except OSError:
+                    return
+                if len(data) < 12:
+                    continue
+                t_done = time.perf_counter()
+                qid, flags = struct.unpack_from(">HH", data)
+                with plk:
+                    meta = pending.pop(qid, None)
+                if meta is None:
+                    continue
+                surf, user, sched = meta
+                rcode = flags & 0x000F
+                rows.append((surf, user, sched, t_done,
+                             "ok" if rcode == 0 else "error"))
+                if rcode != 0:
+                    with counters_lock:
+                        errored[0] += 1
+
+        t = threading.Thread(target=dns_reader, daemon=True,
+                             name=f"users-dns-{si}")
+        readers.append(t)
+        t.start()
+
+    # --- senders: walk the schedule, never wait for responses -------
+    dns_code = SURFACES.index("dns")
+    watch_code = SURFACES.index("watch")
+    period = 1.0 / float(target_rps)
+    unsent = [0]
+    start_gate = threading.Barrier(senders + 1)
+    t_start = [0.0]
+
+    def method_args(code: int, key: int):
+        name = SURFACES[code]
+        if name == "kv_put":
+            return leader_addr, "KVS.Apply", {
+                "Op": "set", "DirEnt": {"Key": f"users/k{key}",
+                                        "Value": b"u" * 64}}
+        if name == "kv_get":
+            return leader_addr, "KVS.Get", {"Key": f"users/k{key}"}
+        if name == "kv_get_stale":
+            return follower_addr, "KVS.Get", {
+                "Key": f"users/k{key}", "AllowStale": True}
+        if name == "catalog":
+            return follower_addr, "Catalog.ServiceNodes", {
+                "ServiceName": f"svc-{key % obs.services}",
+                "AllowStale": True}
+        if name == "health":
+            return follower_addr, "Health.ServiceNodes", {
+                "ServiceName": f"svc-{key % obs.services}",
+                "MustBePassing": True, "AllowStale": True}
+        # watch: park on the follower, complete at MaxQueryTime
+        return follower_addr, "KVS.Get", {
+            "Key": f"users/w{key % 32}", "AllowStale": True,
+            "MinQueryIndex": 1 << 30, "MaxQueryTime": WATCH_POLL_S}
+
+    def sender(si: int):
+        start_gate.wait()
+        start = t_start[0]
+        seq = 0
+        for i in range(si, total, senders):
+            sched = start + i * period
+            now = time.perf_counter()
+            wait = sched - now
+            if wait > 0:
+                time.sleep(wait)
+            elif now - sched > duration:
+                # the client itself is hopelessly behind (not the
+                # server): stop offering, count the remainder
+                # honestly instead of stretching the rung
+                with counters_lock:
+                    unsent[0] += (total - i + senders - 1) // senders
+                return
+            if stall_hook is not None:
+                stall_hook(i)
+            code = int(surfs[i])
+            user = int(ids[i])
+            key = int(keys[i])
+            if code == dns_code:
+                qid = (si * 7919 + seq) & 0xFFFF
+                seq += 1
+                q = _dns_query(
+                    f"svc-{key % obs.services}.service.consul.", qid)
+                with dns_plks[si]:
+                    old = dns_pend[si].get(qid)
+                    dns_pend[si][qid] = (code, user, sched)
+                if old is not None:
+                    with counters_lock:
+                        errored[0] += 1  # qid reused before answer
+                try:
+                    dns_socks[si].send(q)
+                except OSError:
+                    with counters_lock:
+                        errored[0] += 1
+            else:
+                addr, method, args = method_args(code, key)
+                lane_ix = [li for li in range(rpc_sockets)
+                           if (li % 2 == 0) == (addr == leader_addr)]
+                sock, wlock, pending, plk = \
+                    lanes[lane_ix[i % len(lane_ix)]]
+                with plk:
+                    pending[i] = (code, user, sched)
+                try:
+                    with wlock:
+                        write_frame(sock, {"sid": i, "method": method,
+                                           "args": args})
+                except OSError:
+                    with plk:
+                        pending.pop(i, None)
+                    with counters_lock:
+                        errored[0] += 1
+
+    sender_threads = [threading.Thread(target=sender, args=(si,),
+                                       daemon=True,
+                                       name=f"users-send-{si}")
+                      for si in range(senders)]
+    load0 = loadavg_1m()
+    gauges0 = perf.default.raw()["gauges"]
+    for t in sender_threads:
+        t.start()
+    start_gate.wait()
+    t_start[0] = time.perf_counter()
+    for t in sender_threads:
+        t.join()
+    # drain: watches complete at WATCH_POLL_S; shed replies are fast
+    deadline = time.perf_counter() + max(drain_s, WATCH_POLL_S + 1.0)
+
+    def in_flight():
+        n = 0
+        for _, _, pending, plk in lanes:
+            with plk:
+                n += len(pending)
+        for pending, plk in zip(dns_pend, dns_plks):
+            with plk:
+                n += len(pending)
+        return n
+
+    while in_flight() and time.perf_counter() < deadline:
+        time.sleep(0.05)
+    timeouts = in_flight()
+    stop.set()
+    for sock, _, _, _ in lanes:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    for s in dns_socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+    for t in readers:
+        t.join(timeout=3.0)
+    gauges1 = perf.default.raw()["gauges"]
+
+    # --- aggregate --------------------------------------------------
+    rows = results.merged()
+    start = t_start[0]
+    completed = [(s, u, sc, d) for (s, u, sc, d, st) in rows
+                 if st == "ok"]
+    lat_all = sorted(d - sc for (_, _, sc, d) in completed)
+
+    def pct(sorted_lats, q):
+        if not sorted_lats:
+            return None
+        k = min(len(sorted_lats) - 1,
+                max(0, int(q * len(sorted_lats)) - 1))
+        return round(sorted_lats[k] * 1e3, 3)
+
+    win = duration / windows
+    wcounts = [0] * windows
+    for (_, _, _, d) in completed:
+        wcounts[min(max(int((d - start) / win), 0), windows - 1)] += 1
+    surfaces_out: dict[str, Any] = {}
+    for code, name in enumerate(SURFACES):
+        offered_mask = surfs == code
+        offered_n = int(offered_mask.sum())
+        if not offered_n:
+            continue
+        srows = [(u, sc, d, st) for (s, u, sc, d, st) in rows
+                 if s == code]
+        lats = sorted(d - sc for (u, sc, d, st) in srows
+                      if st == "ok")
+        comp_users = np.array([u for (u, sc, d, st) in srows
+                               if st == "ok"], dtype=np.int64)
+        # shedding fairness: per-user completions over every user that
+        # OFFERED on this surface (zeros count — a user whose whole
+        # session was shed is the unfairness being measured)
+        off_users = ids[offered_mask]
+        uniq = np.unique(off_users)
+        per_user = np.zeros(uniq.size, dtype=np.int64)
+        if comp_users.size:
+            pos = np.searchsorted(uniq, comp_users)
+            ok = (pos < uniq.size) & (uniq[np.minimum(
+                pos, uniq.size - 1)][..., ] == comp_users)
+            np.add.at(per_user, pos[ok], 1)
+        surfaces_out[name] = {
+            "offered": offered_n,
+            "completed": len(lats),
+            "rejected": sum(1 for (_, _, _, st) in srows
+                            if st == "rejected"),
+            "errors": sum(1 for (_, _, _, st) in srows
+                          if st == "error"),
+            "p50_ms": pct(lats, 0.50),
+            "p99_ms": pct(lats, 0.99),
+            "jain_users": jain(per_user.tolist()),
+        }
+    row = {
+        "target_rps": float(target_rps),
+        "duration_s": float(duration),
+        "offered": total,
+        "completed": len(completed),
+        "rejected": rejected[0],
+        "errors": errored[0] + timeouts + unsent[0],
+        "timeouts": timeouts,
+        "unsent": unsent[0],
+        "achieved_rps": round(len(completed) / duration, 1),
+        "p50_ms": pct(lat_all, 0.50),
+        "p99_ms": pct(lat_all, 0.99),
+        "window_rps": [round(c / win, 1) for c in wcounts],
+        "surfaces": surfaces_out,
+        "gauges": {
+            "rpc.workers.rejected_delta": (
+                gauges1.get("rpc.workers.rejected", 0)
+                - gauges0.get("rpc.workers.rejected", 0)),
+            **{k: gauges1[k] for k in sorted(gauges1)
+               if k.startswith("rpc.workers")}},
+        "loadavg_1m": load0,
+        "threads": thread_census(),
+    }
+    return row
+
+
+# -------------------------------------------------- ladder + scenarios
+
+def run_ladder(obs: Observatory, pop: UserPopulation,
+               targets: list[float], duration: float,
+               windows: int = 3, **rung_kw) -> dict[str, Any]:
+    """The admission-control ladder: ascending open-loop RPS rungs.
+    Once a rung drives the server past saturation (rejected > 0 — the
+    measured graceful-degradation evidence), every higher rung is an
+    HONEST SKIP: offering more past the shed point only re-measures
+    the client's own backlog. The headline is the best fully-admitted
+    rung's achieved req/s under the stability band."""
+    ladder = []
+    saturated = None
+    for salt, target in enumerate(sorted(targets)):
+        if saturated is not None:
+            ladder.append({
+                "skipped": True, "target_rps": float(target),
+                "reason": f"past host budget: admission control "
+                          f"already shedding at {saturated:g} rps"})
+            continue
+        row = run_rung(obs, pop, target, duration, windows=windows,
+                       salt=salt, **rung_kw)
+        ladder.append(row)
+        print(f"  rung {target:g} rps: achieved "
+              f"{row['achieved_rps']:,.0f}/s p50={row['p50_ms']}ms "
+              f"p99={row['p99_ms']}ms rejected={row['rejected']}",
+              file=sys.stderr)
+        if row["rejected"] > 0:
+            saturated = float(target)
+    admitted = [r for r in ladder
+                if not r.get("skipped") and not r["rejected"]]
+    measured = [r for r in ladder if not r.get("skipped")]
+    head_rung = max(admitted or measured,
+                    key=lambda r: r["achieved_rps"])
+    out = {
+        "ladder": ladder,
+        "headline": headline(head_rung["window_rps"]),
+        "headline_rung": {"target_rps": head_rung["target_rps"]},
+    }
+    shed = [r for r in measured if r["rejected"] > 0]
+    if shed:
+        top = shed[-1]
+        out["saturation"] = {
+            "target_rps": top["target_rps"],
+            "rejected": top["rejected"],
+            # p99 of the requests that WERE admitted at the shedding
+            # rung: the bounded-degradation claim
+            "admitted_p99_ms": top["p99_ms"],
+            "admitted_rps": top["achieved_rps"],
+        }
+    return out
+
+
+def run_wake_storm(obs: Observatory, watchers: int,
+                   sockets: int = 16,
+                   park_timeout: float = 90.0) -> dict[str, Any]:
+    """Park ``watchers`` blocking watchers on ONE key through the
+    reactor's claim-token path (pipelined mux — no thread per watcher
+    on either end), then commit one write to that key and measure the
+    wake-delivery latency distribution across the cohort that
+    actually parked. The server's per-session stream cap bounds
+    concurrent watches per socket, so ``parked_peak`` may honestly
+    sit below ``watchers`` — the wake numbers are reported against
+    the parked population, never the requested one."""
+    from consul_tpu.server.rpc import ConnPool
+    from consul_tpu.utils import perf
+
+    stop = threading.Event()
+    wake_times: list[float] = []
+    wlock = threading.Lock()
+    armed = threading.Event()
+
+    def on_response(sid, resp, t_done):
+        # only SUCCESSFUL watch completions are wakes — a watcher
+        # refused by the session stream cap cycles error responses,
+        # and counting those would overstate the delivery story
+        if armed.is_set() and not resp.get("error"):
+            with wlock:
+                wake_times.append(t_done)
+
+    herd = start_pipelined_watch_herd(
+        obs.follower.rpc.addr, stop, watchers, keys=1,
+        sockets=sockets, key_prefix="storm", on_response=on_response)
+    try:
+        def parked():
+            return perf.default.raw()["gauges"].get(
+                "rpc.blocking.parked", 0)
+
+        # wait until ~everything parked OR the gauge plateaus (the
+        # stream cap holds it below the request — waiting longer
+        # would just burn the timeout)
+        target = int(watchers * 0.95)
+        t0 = time.perf_counter()
+        last, stable = -1.0, 0
+        while time.perf_counter() - t0 < park_timeout:
+            cur = parked()
+            if cur >= target:
+                break
+            stable = stable + 1 if cur == last else 0
+            if stable >= 20:  # ~5s without growth: plateaued
+                break
+            last = cur
+            time.sleep(0.25)
+        peak = int(parked())
+        armed.set()
+        pool = ConnPool()
+        t_touch = time.perf_counter()
+        pool.call(obs.leader.rpc.addr, "KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": "storm/0",
+                                    "Value": b"wake"}})
+        cohort = min(herd["key0_cohort"], peak)
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            with wlock:
+                if len(wake_times) >= cohort:
+                    break
+            time.sleep(0.05)
+        pool.close()
+        with wlock:
+            lats = sorted(t - t_touch for t in wake_times)
+        n = len(lats)
+
+        def pct(q):
+            return (round(lats[min(n - 1, max(0, int(q * n) - 1))]
+                          * 1e3, 2) if n else None)
+
+        return {
+            "watchers": watchers,
+            "parked_peak": peak,
+            "park_wall_s": round(time.perf_counter() - t0, 2),
+            "woken": n,
+            "cohort_expected": cohort,
+            "wake_p50_ms": pct(0.50),
+            "wake_p99_ms": pct(0.99),
+            "wake_last_ms": round(lats[-1] * 1e3, 2) if n else None,
+            "threads": thread_census(),
+        }
+    finally:
+        stop.set()
+        herd["close"]()
+        for t in herd["threads"]:
+            t.join(timeout=3.0)
+
+
+def run_stream_fanout(obs: Observatory, subscribers: int,
+                      churn_s: float, churn_rps: float = 50.0
+                      ) -> dict[str, Any]:
+    """Event-stream fanout under churn: ``subscribers`` blocking
+    subscriptions on the ServiceHealth topic (the same per-topic
+    buffers the Subscribe stream serves) while a churn thread commits
+    register/deregister cycles; measures delivered events/sec and the
+    publisher's coalescing shed."""
+    pub = obs.leader.publisher
+    delivered = [0] * subscribers
+    stop = threading.Event()
+
+    def subscriber(i):
+        sub = pub.subscribe("ServiceHealth", index=0)
+        try:
+            while not stop.is_set():
+                ev = sub.next(timeout=0.5)
+                if ev is not None:
+                    delivered[i] += 1
+        finally:
+            sub.close()
+
+    threads = [threading.Thread(target=subscriber, args=(i,),
+                                daemon=True, name=f"fanout-{i}")
+               for i in range(subscribers)]
+    for t in threads:
+        t.start()
+    coalesced0 = pub.coalesced
+    from consul_tpu.server.rpc import ConnPool
+
+    pool = ConnPool()
+    commits = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < churn_s:
+        i = commits % 16
+        pool.call(obs.leader.rpc.addr, "Catalog.Register", {
+            "Node": f"churn-{i}", "Address": f"10.99.0.{i + 1}",
+            "Service": {"ID": "churn", "Service": "churn",
+                        "Port": 9000 + i}})
+        commits += 1
+        stop.wait(max(0.0, 1.0 / churn_rps))
+    wall = time.perf_counter() - t0
+    time.sleep(0.3)  # let the last publish fan out
+    stop.set()
+    for t in threads:
+        t.join(timeout=2.0)
+    pool.close()
+    total = sum(delivered)
+    return {
+        "subscribers": subscribers,
+        "churn_commits": commits,
+        "churn_wall_s": round(wall, 2),
+        "events_delivered": total,
+        "events_per_sec": round(total / wall, 1),
+        "min_per_subscriber": min(delivered) if delivered else 0,
+        "jain_subscribers": jain(delivered),
+        "coalesced": pub.coalesced - coalesced0,
+    }
+
+
+def run_dns_flood(obs: Observatory, pop: UserPopulation,
+                  target_rps: float, duration: float,
+                  **rung_kw) -> dict[str, Any]:
+    """A pure-DNS open-loop rung over the observatory's catalog — the
+    qps flood the DNS stage ledger (dns.read/lookup/encode/write) is
+    measured under."""
+    from consul_tpu.utils import perf
+
+    dns_pop = UserPopulation(
+        pop.n_users, seed=pop.seed, zipf_s=pop.zipf_s,
+        n_keys=pop.n_keys, mix={"dns": 1.0},
+        session_mean_ops=pop.session_mean_ops)
+    snap0 = perf.default.raw()
+    row = run_rung(obs, dns_pop, target_rps, duration,
+                   salt=7, **rung_kw)
+    snap1 = perf.default.raw()
+    row["attribution"] = perf.stage_report(snap1, snap0, "dns")
+    return row

@@ -734,6 +734,82 @@ def _validate_twin(name: str, d: dict) -> None:
     _require_num(f"{name}.smoke_guard", sg, ("converge_rounds",))
 
 
+def _validate_users(name: str, d: dict) -> None:
+    """Open-loop traffic-observatory record (bench.py --users): an RPS
+    ladder over the mixed virtual-user surface workload, each rung a
+    measured row (registry.USERS_RUNG_KEYS, latency from the INTENDED
+    send time) with per-surface SLO attribution, or an honest skip
+    naming its reason. The record must carry saturation evidence — a
+    rung driven past admission control with `rejected > 0` and a
+    bounded p99 for the requests that were admitted — because
+    graceful degradation is the claim the family exists to pin."""
+    _require(name, d, ("metric", "unit", "engine", "ladder",
+                       "headline", "headline_rung", "saturation"))
+    eng = d["engine"]
+    if not isinstance(eng, dict):
+        raise LedgerError(f"{name}: engine must be an object")
+    _require(f"{name}.engine", eng, ("users", "seed", "zipf_s",
+                                     "surface_mix"))
+    mix = eng["surface_mix"]
+    if not isinstance(mix, dict) or not mix:
+        raise LedgerError(f"{name}.engine: surface_mix must be a "
+                          "non-empty object")
+    unknown = set(mix) - set(registry.USERS_SURFACES)
+    if unknown:
+        raise LedgerError(
+            f"{name}.engine: unknown surface(s) {sorted(unknown)} "
+            f"(known: {', '.join(registry.USERS_SURFACES)})")
+    if not isinstance(d["ladder"], list) or not d["ladder"]:
+        raise LedgerError(f"{name}: ladder must be a non-empty list")
+    measured = 0
+    saturated = 0
+    for i, rung in enumerate(d["ladder"]):
+        rn = f"{name}.ladder[{i}]"
+        if not isinstance(rung, dict):
+            raise LedgerError(f"{rn}: rung must be an object")
+        if rung.get("skipped"):
+            _require(rn, rung, ("target_rps", "reason"))
+            continue
+        measured += 1
+        _require(rn, rung, registry.USERS_RUNG_KEYS)
+        _require_num(rn, rung, ("target_rps", "achieved_rps",
+                                "p50_ms", "p99_ms", "rejected"))
+        surfaces = rung["surfaces"]
+        if not isinstance(surfaces, dict) or not surfaces:
+            raise LedgerError(f"{rn}: surfaces must be a non-empty "
+                              "object")
+        bad = set(surfaces) - set(registry.USERS_SURFACES)
+        if bad:
+            raise LedgerError(f"{rn}: unknown surface(s) "
+                              f"{sorted(bad)}")
+        for sname, row in surfaces.items():
+            _require(f"{rn}.surfaces[{sname}]", row,
+                     registry.USERS_SURFACE_KEYS)
+        if rung.get("rejected", 0) > 0:
+            saturated += 1
+    if not measured:
+        raise LedgerError(
+            f"{name}: every rung skipped — record the failure as a "
+            "skipped BENCH-style envelope, not an empty users ladder")
+    if not saturated:
+        raise LedgerError(
+            f"{name}: no rung shows rejected > 0 — the ladder never "
+            "drove admission control past saturation, so the record "
+            "carries no graceful-degradation evidence (raise the top "
+            "target_rps or lower rpc_queue_limit and re-record)")
+    sat = d["saturation"]
+    _require(f"{name}.saturation", sat,
+             ("target_rps", "rejected", "admitted_p99_ms"))
+    _require_num(f"{name}.saturation", sat,
+                 ("rejected", "admitted_p99_ms"))
+    if not sat.get("rejected"):
+        raise LedgerError(f"{name}.saturation: rejected must be > 0")
+    _require(f"{name}.headline", d["headline"],
+             ("value", "samples", "stability_band"))
+    _require(f"{name}.headline_rung", d["headline_rung"],
+             ("target_rps",))
+
+
 _VALIDATORS = {
     "BENCH": _validate_bench,
     "MULTICHIP": _validate_multichip,
@@ -745,6 +821,7 @@ _VALIDATORS = {
     "COORDS": _validate_scenario,
     "TUNE": _validate_tune,
     "TWIN": _validate_twin,
+    "USERS": _validate_users,
 }
 assert set(_VALIDATORS) == set(registry.LEDGER_FAMILIES)
 
@@ -887,6 +964,18 @@ def _headline_of(rec: dict[str, Any]):
                 f"{top['n']:,} virtual members, jain "
                 f"{top.get('jain_fairness', 0):.3f}"
                 + (f", {skipped} rung(s) skipped" if skipped else ""))
+    if fam == "USERS":
+        hl = d["headline"]
+        note = ("REFUSED: " + hl.get("unstable", "")[:60]
+                if hl.get("headline") is None else "stable")
+        rungs = [r for r in d["ladder"] if not r.get("skipped")]
+        top = max(rungs, key=lambda r: r.get("achieved_rps") or 0)
+        sat = d.get("saturation") or {}
+        return (d.get("metric"), top.get("achieved_rps"),
+                d.get("unit"),
+                f"{d['engine'].get('users', 0):,} users, shed "
+                f"{sat.get('rejected', 0)} @ {sat.get('target_rps')} "
+                f"rps; headline {note}")
     # CHAOS / COORDS
     if d.get("skipped"):
         return d.get("metric"), None, None, "skipped"
@@ -999,6 +1088,33 @@ def latest_twin_guard(records: list[dict]) -> Optional[dict[str, Any]]:
         sg = rec["data"].get("smoke_guard")
         if sg:
             return {"file": rec["file"], "round": rec["round"], **sg}
+    return None
+
+
+def latest_users_guard(records: list[dict]) -> Optional[dict[str, Any]]:
+    """The newest USERS record's re-measurement envelope — the
+    --check-regression --family USERS baseline: {file, round,
+    target_rps, engine, value} where `value` is the recorded headline
+    rung's achieved (admitted) req/s and `target_rps`/`engine` name
+    the workload the guard re-runs (same open-loop rate, same
+    virtual-user population parameters — apples to apples). None when
+    no USERS record exists."""
+    users = sorted((r for r in records if r["family"] == "USERS"),
+                   key=lambda r: r["round"], reverse=True)
+    for rec in users:
+        d = rec["data"]
+        hr = d.get("headline_rung")
+        if not hr:
+            continue
+        target = hr.get("target_rps")
+        rung = next((r for r in d.get("ladder", ())
+                     if not r.get("skipped")
+                     and r.get("target_rps") == target), None)
+        if rung is None:
+            continue
+        return {"file": rec["file"], "round": rec["round"],
+                "target_rps": target, "engine": d.get("engine", {}),
+                "value": rung.get("achieved_rps")}
     return None
 
 

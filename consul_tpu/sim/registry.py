@@ -475,8 +475,13 @@ PROFILE_ROOFLINE_ROW = (
 #: virtual-member ladder under FaultPlan churn, each rung carrying
 #: convergence, /v1/agent/perf latency attribution, Jain fairness, and
 #: the checkpoint-resume digest proof.
+#: USERS (PR 17) is the
+#: open-loop traffic observatory family (bench.py --users): a
+#: vectorized virtual-user engine drives the mixed serving surfaces at
+#: scheduled arrival rates, each rung carrying per-surface SLO rows
+#: with latency measured from the INTENDED send time.
 LEDGER_FAMILIES = ("BENCH", "MULTICHIP", "SWEEP", "SERVE", "PROFILE",
-                   "BYZ", "CHAOS", "COORDS", "TUNE", "TWIN")
+                   "BYZ", "CHAOS", "COORDS", "TUNE", "TWIN", "USERS")
 
 #: per-rung keys every non-skipped TWIN ladder row must carry (the
 #: validator + README tables decode these)
@@ -491,6 +496,27 @@ TWIN_RUNG_KEYS = ("n", "rounds", "join_s", "member_view_err_post_heal",
 #: not read as merely "slow" in the ledger), and the soak harness
 #: (sim/twin.py) uses the same constant as its settling target
 TWIN_CONVERGE_TOL = 0.005
+
+#: the open-loop engine's serving surfaces (consul_tpu/serve/users.py
+#: drives exactly these; a USERS rung's per-surface attribution rows
+#: are keyed by them — the validator refuses unknown surface names)
+USERS_SURFACES = ("dns", "kv_get", "kv_get_stale", "kv_put",
+                  "catalog", "health", "watch")
+
+#: per-rung keys every non-skipped USERS ladder row must carry (the
+#: validator + README tables decode these). `p50_ms`/`p99_ms` are
+#: measured from the INTENDED send time (open-loop — no coordinated
+#: omission), `rejected` counts the server's structured
+#: ERR_POOL_SATURATED sheds, and `window_rps` carries the per-window
+#: completed-throughput samples the refusal band runs on.
+USERS_RUNG_KEYS = ("target_rps", "duration_s", "offered", "completed",
+                   "rejected", "errors", "achieved_rps", "p50_ms",
+                   "p99_ms", "window_rps", "surfaces", "gauges")
+
+#: per-surface SLO-row keys inside a USERS rung (`jain_users` is
+#: Jain's fairness index over per-user completions on that surface)
+USERS_SURFACE_KEYS = ("offered", "completed", "rejected", "errors",
+                      "p50_ms", "p99_ms", "jain_users")
 
 #: the autotuner's winner schema: what a TUNE record's ``winner`` and
 #: every AUTOTUNE_CACHE.json entry must carry (validator + cache
@@ -543,7 +569,9 @@ def layout_digest() -> str:
                   tuple(f"{e}={v}" for e, v in COSTMODEL_FLOPS),
                   (str(COSTMODEL_FLOP_WINDOW), str(COSTMODEL_BOUND)),
                   PROFILE_ROOFLINE_ROW, LEDGER_FAMILIES,
-                  TWIN_RUNG_KEYS, (str(TWIN_CONVERGE_TOL),)):
+                  TWIN_RUNG_KEYS, (str(TWIN_CONVERGE_TOL),),
+                  USERS_SURFACES, USERS_RUNG_KEYS,
+                  USERS_SURFACE_KEYS):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

@@ -39,6 +39,11 @@ Stage taxonomy (the request's life, in order — ``STAGES`` below):
          rpc.commit_wait (async write path: group-commit wait, no
          thread parked) → rpc.write (egress: enqueue → last byte
          flushed by the reactor's batched writev)
+  DNS:   dns.read (wire header + question + EDNS parse) → dns.lookup
+         (the resolve: catalog/health reads through the agent cache,
+         or recursion) → dns.encode (RR assembly + truncation) →
+         dns.write (UDP sendto). The idle recvfrom wait is not
+         counted, same contract as rpc.read.
   inner: store.read (blocking_query's state closure),
          raft.commit_wait (sync batcher park), raft.apply_batch
          (append→replicate→commit), raft.fsm.apply (applier thread)
@@ -87,6 +92,8 @@ STAGES = (
     "http.encode", "http.write", "http.e2e", "http.stages_sum",
     "rpc.read", "rpc.dispatch", "rpc.handler", "rpc.park_wait",
     "rpc.commit_wait", "rpc.write", "rpc.e2e", "rpc.stages_sum",
+    "dns.read", "dns.lookup", "dns.encode", "dns.write",
+    "dns.e2e", "dns.stages_sum",
     "store.read",
     "raft.commit_wait", "raft.apply_batch", "raft.fsm.apply",
 )
@@ -100,6 +107,7 @@ TOP_STAGES = {
              "http.encode", "http.write"),
     "rpc": ("rpc.read", "rpc.dispatch", "rpc.handler", "rpc.park_wait",
             "rpc.commit_wait", "rpc.write"),
+    "dns": ("dns.read", "dns.lookup", "dns.encode", "dns.write"),
 }
 
 

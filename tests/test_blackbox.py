@@ -88,7 +88,15 @@ def test_layout_registry_digest_pinned():
     # Consumers: sim/costmodel.py _validate_twin/latest_twin_guard,
     # sim/twin.py CONVERGE_TOL, bench.py --twin/--check-regression
     # --family TWIN, README soak tables.
-    assert registry.layout_digest() == "1cc9085b38df7e62"
+    # PR 17 re-pin (was 1cc9085b38df7e62): the digest now additionally
+    # covers the open-loop traffic observatory's record contract — the
+    # USERS ledger family, its serving-surface vocabulary
+    # (USERS_SURFACES), the per-rung row schema (USERS_RUNG_KEYS,
+    # latency from the INTENDED send time), and the per-surface SLO
+    # row schema (USERS_SURFACE_KEYS). Consumers: sim/costmodel.py
+    # _validate_users/latest_users_guard, consul_tpu/serve/users.py,
+    # bench.py --users/--check-regression --family USERS.
+    assert registry.layout_digest() == "c0deff21a8f5a60c"
 
 
 def test_reduce_lane_layout_pinned():

@@ -397,3 +397,121 @@ def test_bench_history_over_tmp_ledger(tmp_path):
                env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
     assert r.returncode == 1
     assert "BENCH_r04.json" in r.stderr
+
+
+# ------------------------------------------- USERS family (PR 17)
+
+
+def _users_payload():
+    """Minimal schema-valid USERS record: one measured rung that shed
+    (the graceful-degradation evidence the validator demands), one
+    honest skip above it."""
+    surf = {"offered": 100, "completed": 90, "rejected": 10,
+            "errors": 0, "p50_ms": 1.2, "p99_ms": 8.0,
+            "jain_users": 0.91}
+    rung = {"target_rps": 1000.0, "duration_s": 4.0, "offered": 4000,
+            "completed": 3600, "rejected": 400, "errors": 0,
+            "achieved_rps": 900.0, "p50_ms": 1.2, "p99_ms": 8.0,
+            "window_rps": [900.0, 905.0, 895.0],
+            "surfaces": {"dns": surf, "kv_put": dict(surf)},
+            "gauges": {"rpc.workers.rejected_delta": 400}}
+    return {
+        "metric": "users_open_loop", "unit": "req/s",
+        "engine": {"users": 4096, "seed": 0, "zipf_s": 1.1,
+                   "n_keys": 4096,
+                   "surface_mix": {"dns": 0.5, "kv_put": 0.5}},
+        "pool": {"rpc_workers": 2, "rpc_queue_limit": 16},
+        "ladder": [rung,
+                   {"skipped": True, "target_rps": 2000.0,
+                    "reason": "past host budget: shedding at 1000"}],
+        "headline": {"value": 900.0,
+                     "samples": [900.0, 905.0, 895.0],
+                     "stability_band": 0.10, "headline": 900.0},
+        "headline_rung": {"target_rps": 1000.0},
+        "saturation": {"target_rps": 1000.0, "rejected": 400,
+                       "admitted_p99_ms": 8.0},
+    }
+
+
+def test_users_validator_rejects_by_name(tmp_path):
+    """A USERS record missing its load-bearing evidence fails BY KEY
+    NAME; a corrupt file on disk fails BY FILENAME — the ledger never
+    shrugs."""
+    good = _users_payload()
+    costmodel.validate_record("USERS_r01.json", good)
+    # dropping the saturation evidence is named
+    broken = {k: v for k, v in good.items() if k != "saturation"}
+    with pytest.raises(LedgerError, match=r"USERS_r01.*saturation"):
+        costmodel.validate_record("USERS_r01.json", broken)
+    # a ladder that never shed carries no graceful-degradation story
+    no_shed = json.loads(json.dumps(good))
+    no_shed["ladder"][0]["rejected"] = 0
+    with pytest.raises(LedgerError, match="rejected > 0"):
+        costmodel.validate_record("USERS_r01.json", no_shed)
+    # an unmeasurable surface name can't sneak into the schema
+    alien = json.loads(json.dumps(good))
+    alien["ladder"][0]["surfaces"]["graphql"] = \
+        alien["ladder"][0]["surfaces"]["dns"]
+    with pytest.raises(LedgerError, match="unknown surface"):
+        costmodel.validate_record("USERS_r01.json", alien)
+    # a measured rung missing a per-surface SLO key is named
+    thin = json.loads(json.dumps(good))
+    del thin["ladder"][0]["surfaces"]["dns"]["jain_users"]
+    with pytest.raises(LedgerError, match="jain_users"):
+        costmodel.validate_record("USERS_r01.json", thin)
+    # every rung skipped = no record, not an empty ladder
+    all_skip = json.loads(json.dumps(good))
+    all_skip["ladder"] = [all_skip["ladder"][1]]
+    with pytest.raises(LedgerError, match="every rung skipped"):
+        costmodel.validate_record("USERS_r01.json", all_skip)
+    # corrupt ON DISK: load_ledger names the file
+    (tmp_path / "USERS_r01.json").write_text("{not json")
+    with pytest.raises(LedgerError, match="USERS_r01.json"):
+        costmodel.load_ledger(str(tmp_path))
+
+
+def test_users_history_row_and_guard(tmp_path):
+    """--history renders a USERS headline row, and the
+    --check-regression guard envelope re-derives the headline rung's
+    achieved req/s (never a fabricated number)."""
+    (tmp_path / "USERS_r01.json").write_text(
+        json.dumps(_users_payload()))
+    records = costmodel.load_ledger(str(tmp_path))
+    rows = costmodel.history_rows(records)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["file"] == "USERS_r01.json"
+    assert row["metric"] == "users_open_loop"
+    assert row["value"] == 900.0
+    assert "4,096 users" in row["note"] and "shed 400" in row["note"]
+    table = costmodel.format_history(rows)
+    assert "USERS_r01.json" in table
+    guard = costmodel.latest_users_guard(records)
+    assert guard["target_rps"] == 1000.0
+    assert guard["value"] == 900.0
+    assert guard["engine"]["users"] == 4096
+    # no USERS record → None, never a synthetic baseline
+    assert costmodel.latest_users_guard([]) is None
+
+
+def test_bench_users_flag_combinations_exit_2(tmp_path):
+    """--users is a top-level mode: combining it with another mode,
+    a checkpoint flag, or pointing --family USERS at a metric the
+    guard cannot RE-MEASURE exits 2 with usage before anything
+    runs."""
+    for argv in (("--users", "--mesh"), ("--users", "--sweep"),
+                 ("--users", "--chaos"), ("--users", "--twin"),
+                 ("--users", "--autotune"),
+                 ("--profile", "--users"),
+                 ("--users", "--check-regression"),
+                 ("--users", "--ckpt-dir", "/tmp/nope"),
+                 ("--check-regression", "--family", "USERS",
+                  "--metric", "kv_sustained")):
+        r = _bench(*argv)
+        assert r.returncode == 2, (argv, r.stderr)
+        assert "usage:" in r.stderr, (argv, r.stderr)
+    # and with no recorded USERS ledger the guard refuses to invent
+    r = _bench("--check-regression", "--family", "USERS",
+               env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
+    assert r.returncode == 2, r.stderr
+    assert "never fabricated" in r.stderr

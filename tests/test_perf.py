@@ -45,6 +45,8 @@ def test_stage_taxonomy_pinned():
         "http.encode", "http.write", "http.e2e", "http.stages_sum",
         "rpc.read", "rpc.dispatch", "rpc.handler", "rpc.park_wait",
         "rpc.commit_wait", "rpc.write", "rpc.e2e", "rpc.stages_sum",
+        "dns.read", "dns.lookup", "dns.encode", "dns.write",
+        "dns.e2e", "dns.stages_sum",
         "store.read",
         "raft.commit_wait", "raft.apply_batch", "raft.fsm.apply",
     )

@@ -1,0 +1,271 @@
+"""Open-loop virtual-user traffic engine (consul_tpu/serve/users.py):
+population determinism, intended-send-time accounting (the
+coordinated-omission guard), per-surface SLO rows, DNS stage-ledger
+parity, and the admission-control shed path end to end — the tier-1
+pins behind the USERS record family (bench.py --users)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from consul_tpu.serve import users
+from consul_tpu.sim import registry
+
+from helpers import wait_for  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def observatory():
+    obs = users.build_observatory(n=3, catalog_nodes=16, services=4)
+    yield obs
+    obs.close()
+
+
+def test_population_deterministic_and_zipf_shaped():
+    """The virtual-user synthesis is a pinned function of the seed:
+    same seed → identical population AND op stream (the recorded
+    engine digest is re-derivable forever); different seed → a
+    different fleet. The key law is the truncated Zipf: rank 0 must
+    dominate, and the tail must still be populated."""
+    a = users.UserPopulation(4096, seed=1)
+    b = users.UserPopulation(4096, seed=1)
+    c = users.UserPopulation(4096, seed=2)
+    assert a.digest() == b.digest()
+    assert a.digest() != c.digest()
+    # ops are part of the determinism contract, not just the arrays
+    ia, sa, ka = a.ops(2000)
+    ib, sb, kb = b.ops(2000)
+    assert (ia == ib).all() and (sa == sb).all() and (ka == kb).all()
+    # Zipf head: rank 0 is the modal key and carries a large multiple
+    # of the uniform share; the tail is not empty
+    counts = np.bincount(a.user_key, minlength=a.n_keys)
+    assert counts.argmax() == 0
+    assert counts[0] > 10 * (a.n_users / a.n_keys)
+    assert (counts[100:] > 0).any()
+    # sessions skew per-user op counts: geometric bursts mean some
+    # users issue many ops while most issue none in a finite stream
+    per_user = np.bincount(ia, minlength=a.n_users)
+    assert per_user.max() >= 4
+    # every surface in the default mix appears in the stream
+    seen = {users.SURFACES[s] for s in set(int(x) for x in sa)}
+    assert seen == set(users.DEFAULT_MIX)
+
+
+def test_mix_rejects_unknown_surface():
+    with pytest.raises(ValueError, match="unknown surfaces"):
+        users.UserPopulation(16, mix={"graphql": 1.0})
+
+
+def test_open_loop_rung_covers_every_surface(observatory):
+    """One small open-loop rung against the live 3-server fabric:
+    every surface in the mix completes real requests, the row carries
+    the full USERS_RUNG_KEYS schema with per-surface
+    USERS_SURFACE_KEYS rows, and the watch surface's latency visibly
+    includes its long-poll window (attribution is per-surface for
+    exactly this reason)."""
+    pop = users.UserPopulation(4096, seed=7)
+    row = users.run_rung(observatory, pop, target_rps=250,
+                         duration=2.0)
+    assert set(registry.USERS_RUNG_KEYS) <= set(row)
+    assert row["offered"] == 500
+    # open loop on a healthy fabric: nearly everything completes
+    assert row["completed"] >= 0.95 * row["offered"]
+    assert row["errors"] + row["rejected"] <= 0.05 * row["offered"]
+    assert set(row["surfaces"]) == set(users.DEFAULT_MIX)
+    for name, srow in row["surfaces"].items():
+        assert set(registry.USERS_SURFACE_KEYS) <= set(srow), name
+        assert srow["completed"] > 0, name
+        assert srow["jain_users"] is None or 0 < srow["jain_users"] <= 1
+    # the watch long-poll window dominates that surface's latency
+    assert row["surfaces"]["watch"]["p50_ms"] > \
+        users.WATCH_POLL_S * 1e3 * 0.8
+    # ...and the non-watch surfaces answer far faster than the window
+    assert row["surfaces"]["kv_get_stale"]["p50_ms"] < 100
+    # per-window completion rate tracks the offered rate
+    assert all(w > 0 for w in row["window_rps"])
+
+
+def test_intended_send_time_exposes_client_stall(observatory):
+    """The coordinated-omission pin: latency is measured from the
+    INTENDED send time, so a stall anywhere upstream of the server
+    (here: the sender thread itself freezes 600ms mid-rung) must
+    surface as tail latency even though the server's service time
+    never changed. A closed-loop client — or an open-loop one that
+    resets its clock after the stall — would report the same small
+    p99 in both runs, which is exactly the lie this engine exists to
+    make untellable."""
+    pop = users.UserPopulation(1024, seed=3,
+                               mix={"kv_get_stale": 1.0})
+    clean = users.run_rung(observatory, pop, target_rps=200,
+                           duration=2.0, senders=1)
+
+    stalled_once = [False]
+
+    def stall(i):
+        if i >= 200 and not stalled_once[0]:
+            stalled_once[0] = True
+            time.sleep(0.6)
+
+    stalled = users.run_rung(observatory, pop, target_rps=200,
+                             duration=2.0, senders=1,
+                             stall_hook=stall)
+    assert stalled_once[0]
+    assert clean["p99_ms"] < 300
+    # the backlog after the stall is charged to latency, not hidden
+    assert stalled["p99_ms"] > 500
+    assert stalled["p99_ms"] > 3 * clean["p99_ms"]
+    # service time unchanged: the stall happened in the CLIENT, and
+    # the early (pre-stall) half of the rung still saw normal latency
+    assert stalled["p50_ms"] < stalled["p99_ms"] / 2
+
+
+def test_dns_stage_ledger_parity(observatory):
+    """Satellite: agent/dns.py now carries the PR 10 stage ledger —
+    a real UDP query must observe dns.read → dns.lookup → dns.encode
+    → dns.write plus the dns.e2e envelope in the SAME process-global
+    registry /v1/agent/perf serves, and stage_report must attribute
+    the DNS pipeline like any other kind."""
+    import json
+    import socket
+    import struct
+    import urllib.request
+
+    from consul_tpu.utils import perf
+
+    snap0 = perf.default.raw()
+    before = perf.default.snapshot().get("Stages", {})
+    n0 = before.get("dns.e2e", {}).get("Count", 0)
+    q = struct.pack(">HHHHHH", 0xBEEF, 0x0100, 1, 0, 0, 0)
+    for label in ("svc-0", "service", "consul"):
+        q += bytes([len(label)]) + label.encode()
+    q += b"\x00" + struct.pack(">HH", 1, 1)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(5.0)
+    s.sendto(q, observatory.dns_addr)
+    data, _ = s.recvfrom(4096)
+    s.close()
+    assert struct.unpack_from(">H", data)[0] == 0xBEEF
+    wait_for(lambda: perf.default.snapshot()["Stages"]
+             .get("dns.e2e", {}).get("Count", 0) > n0,
+             what="dns ledger observation")
+    stages = perf.default.snapshot()["Stages"]
+    for name in ("dns.read", "dns.lookup", "dns.encode", "dns.write",
+                 "dns.e2e", "dns.stages_sum"):
+        assert stages[name]["Count"] >= 1, name
+    # the taxonomy indexes the DNS pipeline for attribution reports
+    rep = perf.stage_report(perf.default.raw(), snap0, "dns")
+    assert set(rep["stages"]) == set(perf.TOP_STAGES["dns"])
+    # and the HTTP observatory serves the same registry
+    with urllib.request.urlopen(
+            f"http://{observatory.agent.http.addr}/v1/agent/perf"
+            "?prefix=dns.", timeout=10) as r:
+        via_http = json.load(r)
+    assert via_http["Stages"]["dns.lookup"]["Count"] >= 1
+
+
+def test_admission_shed_reaches_client_and_perf_endpoint():
+    """Satellite: the worker-pool admission-control path END TO END —
+    previously only unit-exercised. A 1-worker/1-slot agent whose
+    only worker is pinned inside a gated handler must shed the
+    overflow with the STRUCTURED retryable error (client raises
+    RetryableError, so backoff loops re-submit instead of hanging),
+    and the shed must be visible to operators as the
+    rpc.workers.rejected gauge on /v1/agent/perf."""
+    import json
+    import urllib.request
+
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import load
+    from consul_tpu.server.rpc import ConnPool, RetryableError
+
+    cfg = load(dev=True, overrides={
+        "node_name": "shed-agent", "rpc_workers": 1,
+        "rpc_queue_limit": 1})
+    a = Agent(cfg)
+    a.start()
+    try:
+        wait_for(lambda: a.server.is_leader(), what="self-elect")
+        srv = a.server
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = srv.endpoints["Catalog.ServiceNodes"]
+
+        def gated(args):
+            entered.set()
+            gate.wait(20.0)
+            return orig(args)
+
+        srv.endpoints["Catalog.ServiceNodes"] = gated
+        pool = ConnPool()
+        addr = srv.rpc.addr
+        try:
+            occupiers = [threading.Thread(
+                target=lambda: pool.call(
+                    addr, "Catalog.ServiceNodes",
+                    {"ServiceName": "x"}, timeout=30.0),
+                daemon=True) for _ in range(2)]
+            for t in occupiers:
+                t.start()
+            # worker 1 of 1 is inside the gate; request 2 fills the
+            # single queue slot
+            assert entered.wait(10.0)
+            wait_for(lambda: srv.rpc._workers._work_queue.qsize() >= 1,
+                     what="queue slot filled")
+            # request 3 must be SHED, not queued: structured +
+            # retryable all the way to the client exception type
+            with pytest.raises(RetryableError, match="overloaded"):
+                pool.call(addr, "Catalog.ServiceNodes",
+                          {"ServiceName": "x"}, timeout=30.0)
+        finally:
+            gate.set()
+            for t in occupiers:
+                t.join(timeout=15.0)
+            pool.close()
+        with urllib.request.urlopen(
+                f"http://{a.http.addr}/v1/agent/perf",
+                timeout=10) as r:
+            snap = json.load(r)
+        assert snap["Gauges"]["rpc.workers.rejected"] >= 1
+    finally:
+        a.shutdown()
+
+
+def test_ladder_skips_past_saturation():
+    """run_ladder on canned rows is pure control flow, but the skip
+    semantics are ledger-visible: everything above the first shedding
+    rung must be an honest skip naming the reason, never a fabricated
+    measurement. Exercised through the public API with a stub
+    engine."""
+    calls = []
+
+    real_run_rung = users.run_rung
+
+    def fake_rung(obs, pop, target, duration, windows=3, salt=0,
+                  **kw):
+        calls.append(target)
+        return {
+            "target_rps": float(target), "duration_s": duration,
+            "offered": 100, "completed": 90,
+            "rejected": 25 if target >= 1000 else 0, "errors": 0,
+            "achieved_rps": min(target, 900.0) * 0.9,
+            "p50_ms": 1.0, "p99_ms": 20.0,
+            "window_rps": [90.0, 91.0, 89.0],
+            "surfaces": {}, "gauges": {},
+        }
+
+    users.run_rung = fake_rung
+    try:
+        out = users.run_ladder(None, None, [500, 1000, 2000, 4000],
+                               duration=1.0)
+    finally:
+        users.run_rung = real_run_rung
+    assert calls == [500, 1000]  # 2000/4000 never measured
+    skipped = [r for r in out["ladder"] if r.get("skipped")]
+    assert [r["target_rps"] for r in skipped] == [2000.0, 4000.0]
+    assert all("shedding" in r["reason"] for r in skipped)
+    # headline comes from the best fully-admitted rung
+    assert out["headline_rung"]["target_rps"] == 500.0
+    assert out["saturation"]["rejected"] == 25
+    assert out["saturation"]["admitted_p99_ms"] == 20.0
