@@ -50,7 +50,8 @@ _INIT_TIMEOUT_S = float(os.environ.get("CONSUL_TPU_BENCH_INIT_TIMEOUT", "180"))
 #: --profile, --ckpt-dir D, --resume, --family, --metric) modifies
 #: one of them
 _MODES = ("--mesh", "--sweep", "--chaos", "--coords", "--twin",
-          "--users", "--history", "--check-regression", "--autotune")
+          "--users", "--raft", "--history", "--check-regression",
+          "--autotune")
 
 #: record families --check-regression knows how to RE-MEASURE (the
 #: selector satellite): BENCH re-times the rounds/s headline, PROFILE
@@ -59,8 +60,11 @@ _MODES = ("--mesh", "--sweep", "--chaos", "--coords", "--twin",
 #: rung of the bench_kv sustained ladder in-process — all under the
 #: same median+IQR refusal band. USERS re-runs the newest open-loop
 #: traffic record's HEADLINE rung (same virtual-user population, same
-#: pool config) and guards its achieved req/s.
-_GUARDED_FAMILIES = ("BENCH", "PROFILE", "SERVE", "TWIN", "USERS")
+#: pool config) and guards its achieved req/s. RAFT re-runs the
+#: newest commit-path record's HEADLINE rung (same 3-server sync-WAL
+#: cluster, same open-loop PUT rate) and guards its achieved put/s.
+_GUARDED_FAMILIES = ("BENCH", "PROFILE", "SERVE", "TWIN", "USERS",
+                     "RAFT")
 
 
 def _usage(err: str) -> None:
@@ -75,10 +79,12 @@ def _usage(err: str) -> None:
           "[--ckpt-dir D [--resume]]\n"
           "       bench.py --coords [--smoke]\n"
           "       bench.py --users [--smoke]\n"
+          "       bench.py --raft [--smoke]\n"
           "       bench.py --autotune [--smoke]\n"
           "       bench.py --history\n"
           "       bench.py --check-regression [--smoke] "
-          "[--family BENCH|PROFILE|SERVE|TWIN|USERS] [--metric NAME]\n"
+          "[--family BENCH|PROFILE|SERVE|TWIN|USERS|RAFT] "
+          "[--metric NAME]\n"
           "(--profile applies to the throughput bench only; modes are "
           "mutually exclusive)", file=sys.stderr)
     sys.exit(2)
@@ -167,6 +173,9 @@ def run_check_regression(smoke: bool, family: str = "BENCH",
         return
     if family == "USERS":
         _check_users_regression(smoke, records, metric)
+        return
+    if family == "RAFT":
+        _check_raft_regression(smoke, records, metric)
         return
     expected = ("gossip_rounds_per_sec_smoke" if smoke
                 else "gossip_rounds_per_sec_1M_nodes")
@@ -376,6 +385,65 @@ def _check_users_regression(smoke: bool, records,
         "fresh_p50_ms": row.get("p50_ms"),
         "fresh_p99_ms": row.get("p99_ms"),
         "fresh_rejected": row.get("rejected"),
+        **res,
+    }))
+    sys.exit(1 if res["verdict"] == "regression" else 0)
+
+
+def _check_raft_regression(smoke: bool, records,
+                           metric: Optional[str]) -> None:
+    """--check-regression --family RAFT: guard the consensus-plane
+    commit-path headline. Rebuilds the 3-server sync-WAL loopback
+    cluster (same server count and durability mode, read from the
+    record) and re-runs the newest RAFT record's HEADLINE rung at its
+    recorded open-loop PUT rate; the 5 duration-window completion-rate
+    samples feed the median+IQR band against the recorded rung's
+    achieved put/s. --smoke shortens the windows (2s instead of 5s)
+    without changing what is measured. Pure CPU — no accelerator
+    needed."""
+    from consul_tpu.sim import costmodel
+
+    if metric is not None and metric != "raft_commit_path":
+        _usage(f"--family RAFT re-measures the recorded headline "
+               f"rung of the commit-path ladder (metric "
+               f"'raft_commit_path'); it cannot re-measure {metric!r}")
+    base = costmodel.latest_raft_guard(records)
+    if base is None:
+        print("--check-regression --family RAFT: no recorded "
+              f"RAFT_r*.json under {_record_root()} — record one "
+              "first (bench.py --raft); a baseline is never "
+              "fabricated", file=sys.stderr)
+        sys.exit(2)
+
+    from consul_tpu.serve import raftbench
+
+    windows = 5
+    duration = (2.0 if smoke else 5.0) * windows
+    cluster = None
+    try:
+        cluster = raftbench.build_cluster(
+            n=int(base["cluster"].get("servers", 3)))
+        row = raftbench.run_put_rung(cluster, base["target_rps"],
+                                     duration, windows=windows)
+    finally:
+        if cluster is not None:
+            cluster.close()
+    samples = row.get("window_rps") or []
+    if len(samples) < 3:
+        print(f"--check-regression --family RAFT: only "
+              f"{len(samples)} window samples measured — cannot "
+              "apply the band", file=sys.stderr)
+        sys.exit(2)
+    res = costmodel.check_regression(samples, base["value"])
+    print(json.dumps({
+        "metric": "raft_commit_path",
+        "target_rps": base["target_rps"],
+        "loadavg_1m": _loadavg_1m(),
+        "baseline_file": base["file"],
+        "fresh_p50_ms": row.get("p50_ms"),
+        "fresh_p99_ms": row.get("p99_ms"),
+        "fresh_commit_p50_ms": row.get("commit_p50_ms"),
+        "fresh_coverage_p50": row.get("coverage_p50"),
         **res,
     }))
     sys.exit(1 if res["verdict"] == "regression" else 0)
@@ -1630,6 +1698,58 @@ def run_users_bench(smoke: bool) -> None:
         _record_next("USERS", payload)
 
 
+def run_raft_bench(smoke: bool) -> None:
+    """`bench.py --raft [--smoke]`: the consensus-plane commit-path
+    observatory (consul_tpu/serve/raftbench.py). A real 3-server
+    loopback cluster with on-disk fsync'ing WALs, driven by an
+    ascending open-loop KV PUT ladder with mixed entry sizes; each
+    rung records client latency from the INTENDED send time plus the
+    leader's per-stage commit-pipeline attribution (append | fsync |
+    replicate.rtt | quorum_wait | apply_batch), group-commit and
+    apply batch-size distributions, and per-follower replication lag.
+    The validator refuses any rung whose depth-0 stage windows
+    explain < 90% of the commit e2e p50 — the observatory must not
+    ship blind spots as data. Recorded as RAFT_r*.json (full runs
+    only; --smoke prints the payload). Pure CPU."""
+    from consul_tpu.serve import raftbench
+
+    if smoke:
+        targets = [100.0, 300.0, 600.0]
+        duration, windows = 2.0, 3
+    else:
+        targets = [100.0, 250.0, 500.0, 1000.0, 2000.0, 4000.0]
+        duration, windows = 6.0, 4
+    cluster = raftbench.build_cluster(n=3)
+    try:
+        out = raftbench.run_put_ladder(cluster, targets, duration,
+                                       windows=windows)
+    finally:
+        cluster.close()
+    payload = {
+        "metric": "raft_commit_path",
+        "unit": "put/s",
+        "host_cores": os.cpu_count(),
+        "loadavg_1m": _loadavg_1m(),
+        "cluster": {"servers": 3, "sync": True,
+                    "payload_bytes": list(raftbench.PAYLOAD_BYTES)},
+        **out,
+    }
+    print(json.dumps({
+        "metric": payload["metric"],
+        "headline": out["headline"].get("headline"),
+        "unit": "put/s",
+        "headline_rung": out["headline_rung"],
+    }))
+    if smoke:
+        # smoke proves the path end to end but is not ledger
+        # evidence: short rungs on a possibly-shared host
+        print("RAFT not recorded under --smoke (the ledger only "
+              "carries full-scale runs)", file=sys.stderr)
+        print(json.dumps(payload, indent=1), file=sys.stderr)
+    else:
+        _record_next("RAFT", payload)
+
+
 def main() -> None:
     # Local CPU smoke mode (documented in README): tiny cluster, same
     # code path end to end, finishes in ~a minute on one core.
@@ -1649,7 +1769,7 @@ def main() -> None:
                f"cannot be combined with {modes[0]}")
     ckpt_dir, resume = _ckpt_args(argv)
     if modes and modes[0] in ("--history", "--check-regression",
-                              "--autotune", "--users") \
+                              "--autotune", "--users", "--raft") \
             and (ckpt_dir is not None or resume):
         _usage(f"{modes[0]} takes no checkpoint flags")
 
@@ -1689,6 +1809,9 @@ def main() -> None:
         return
     if "--users" in argv:
         run_users_bench(smoke)
+        return
+    if "--raft" in argv:
+        run_raft_bench(smoke)
         return
     if "--history" in argv:
         run_history()

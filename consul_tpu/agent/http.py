@@ -577,9 +577,19 @@ class HTTPApi:
             if limit < 0 or min_ms < 0:
                 raise HTTPError(400, "limit and min_ms must be "
                                      "non-negative")
+            group = q.get("group", "")
+            if group not in ("", "node"):
+                raise HTTPError(400, f"unknown group {group!r} "
+                                     "(want node)")
             spans = trace_mod.default.recent(
                 limit=limit, min_ms=min_ms, prefix=q.get("prefix", ""))
             if q.get("format") == "perfetto":
+                # ?group=node renders the merged cross-node view: one
+                # Perfetto process row per `node` span tag, so one
+                # traced write stacks leader and follower timelines
+                if group == "node":
+                    return trace_mod.default.to_perfetto_nodes(spans), \
+                        None
                 return trace_mod.default.to_perfetto(spans), None
             return {"Spans": spans}, None
         if path == "/v1/agent/trace/stream":

@@ -1154,8 +1154,8 @@ def cmd_peering(args) -> int:
 DEBUG_BUNDLE_REQUIRED = (
     "manifest.json", "self.json", "members.json", "metrics.json",
     "metrics.prom", "metrics_stream.jsonl", "spans.json",
-    "trace.perfetto.json", "perf.json", "raft.json", "host.json",
-    "consul.log",
+    "trace.perfetto.json", "trace.crossnode.perfetto.json",
+    "perf.json", "raft.json", "host.json", "consul.log",
 )
 
 
@@ -1228,6 +1228,13 @@ def _capture_debug_bundle(c, duration: float, sim_nodes: int,
         "trace.perfetto.json": capture(
             "trace.perfetto.json",
             lambda: c.get("/v1/agent/trace", format="perfetto")),
+        # the merged cross-node view (?group=node): one process row
+        # per `node` span tag, so a replicated write's leader and
+        # follower timelines stack in a single Perfetto load
+        "trace.crossnode.perfetto.json": capture(
+            "trace.crossnode.perfetto.json",
+            lambda: c.get("/v1/agent/trace", format="perfetto",
+                          group="node")),
         # per-stage latency histograms + queue gauges (utils/perf.py
         # via /v1/agent/perf) — the attribution layer a slow-request
         # postmortem starts from

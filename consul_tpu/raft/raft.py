@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
 import uuid
 from typing import Any, Callable, Optional, Sequence
 
@@ -146,6 +147,12 @@ class RaftNode:
         self._stopped = False
         self._last_leader_contact = 0.0
         self._apply_results: dict[int, Any] = {}
+        # commit-pipeline probes (PR 19): one dict per in-flight
+        # apply_many batch — {"last": idx, "first_ack": t|None,
+        # "quorum": t|None} — stamped by the replicator acks and
+        # _advance_commit under self._lock, read back when the batch's
+        # ledger closes. Only populated while a ledger is armed.
+        self._commit_probes: list[dict[str, Any]] = []
         self._leadership_era = 0  # bumps on every role transition
         # pipelined replication (real clock only): per-peer streamer
         # threads parked on this condition; apply() just appends+notifies
@@ -204,14 +211,19 @@ class RaftNode:
             raise result
         return result
 
-    def apply_many(self, datas: list[bytes],
-                   timeout: float = 10.0) -> list[Any]:
+    def apply_many(self, datas: list[bytes], timeout: float = 10.0,
+                   traces: Optional[list] = None) -> list[Any]:
         """Group commit: append k commands under ONE lock acquisition,
         kick replication ONCE, and wait for the LAST index to apply —
         the per-entry raft overhead (lock churn, replicator wakeups,
         commit-wait broadcasts) is paid once per batch instead of once
         per command (the spirit of hashicorp/raft's applyBatch /
         rpc.go:926-1000 leader-side write coalescing).
+
+        ``traces`` (optional, parallel to ``datas``): per-command trace
+        ids captured at the client-facing socket; they are stamped onto
+        the replicated log entries so follower-side spans stitch into
+        the same cross-node timeline (PR 19).
 
         Returns one FSM result per command IN ORDER; a command whose
         FSM handler raised gets the exception AS A VALUE (the caller
@@ -224,44 +236,80 @@ class RaftNode:
         # there it roots that thread's timeline while the HTTP side's
         # wait shows up as raft.commit_wait (server.py _ApplyBatcher)
         # and the FSM side as raft.fsm.apply on the applier thread —
-        # the three-thread chain a slow-write postmortem walks
-        with trace_mod.default.span("raft.apply", entries=len(datas)):
-            # global histogram for the whole append→replicate→commit
-            # batch (runs on the batcher thread — no request ledger)
-            with perf.stage("raft.apply_batch"):
-                return self._apply_many_impl(datas, timeout)
+        # the three-thread chain a slow-write postmortem walks.
+        # Per-stage attribution (raft.append/fsync/replicate.rtt/
+        # quorum_wait/apply_batch) lives in the commit ledger that
+        # _apply_many_impl opens per batch.
+        with trace_mod.default.span("raft.apply", entries=len(datas),
+                                    node=self.id):
+            return self._apply_many_impl(datas, timeout, traces)
 
     def _apply_many_impl(self, datas: list[bytes],
-                           timeout: float = 10.0) -> list[Any]:
-        with self._lock:
-            if self.role != Role.LEADER or self._stopped:
-                raise NotLeader(self.leader_id)
-            term = self.store.term
-            era = self._leadership_era
-            entries: list[dict[str, Any]] = []
-            result_offsets: list[int] = []  # per-command result entry
-            for d in datas:
-                if len(d) > CHUNK_SIZE:
-                    # oversized command → chunk entries (rpc.go:783-793
-                    # via go-raftchunking); the FSM result lands at the
-                    # FINAL piece's index
-                    cid = uuid.uuid4().hex
-                    pieces = [d[i:i + CHUNK_SIZE]
-                              for i in range(0, len(d), CHUNK_SIZE)]
-                    for seq, piece in enumerate(pieces):
-                        entries.append({"term": term, "kind": "chunk",
-                                        "data": piece, "cid": cid,
-                                        "seq": seq,
-                                        "total": len(pieces)})
-                else:
-                    entries.append({"term": term, "data": d,
-                                    "kind": "cmd"})
-                result_offsets.append(len(entries) - 1)
-            self.store.append(entries)
-            last = self.store.last_index()
-            first = last - len(entries) + 1
-            self.metrics.incr("raft.apply", len(datas))
-        self._replicate_all()
+                         timeout: float = 10.0,
+                         traces: Optional[list] = None) -> list[Any]:
+        # the commit-pipeline ledger (PR 19): one "raft" ledger per
+        # group-commit batch, partitioned into the disjoint depth-0
+        # windows [append | replicate.rtt | quorum_wait | apply_batch]
+        # so Σ(depth-0) ≤ raft.e2e holds float-exact by construction
+        led = perf.ledger("raft")
+        probe: Optional[dict[str, Any]] = None
+        try:
+            with self._lock:
+                if self.role != Role.LEADER or self._stopped:
+                    raise NotLeader(self.leader_id)
+                term = self.store.term
+                era = self._leadership_era
+                entries: list[dict[str, Any]] = []
+                result_offsets: list[int] = []  # per-command result
+                for j, d in enumerate(datas):
+                    tid = traces[j] if traces and j < len(traces) \
+                        else None
+                    if len(d) > CHUNK_SIZE:
+                        # oversized command → chunk entries
+                        # (rpc.go:783-793 via go-raftchunking); the FSM
+                        # result lands at the FINAL piece's index
+                        cid = uuid.uuid4().hex
+                        pieces = [d[i:i + CHUNK_SIZE]
+                                  for i in range(0, len(d), CHUNK_SIZE)]
+                        for seq, piece in enumerate(pieces):
+                            e = {"term": term, "kind": "chunk",
+                                 "data": piece, "cid": cid, "seq": seq,
+                                 "total": len(pieces)}
+                            if tid:
+                                e["trace"] = tid
+                            entries.append(e)
+                    else:
+                        e = {"term": term, "data": d, "kind": "cmd"}
+                        if tid:
+                            e["trace"] = tid
+                        entries.append(e)
+                    result_offsets.append(len(entries) - 1)
+                t_a0 = time.perf_counter()
+                self.store.append(entries)
+                t_a1 = time.perf_counter()
+                fsync_s = self.store.last_fsync_s
+                last = self.store.last_index()
+                first = last - len(entries) + 1
+                self.metrics.incr("raft.apply", len(datas))
+                if led is not None:
+                    probe = {"last": last, "first_ack": None,
+                             "quorum": None}
+                    self._commit_probes.append(probe)
+            self._replicate_all()
+            return self._wait_applied(led, probe, traces, term, era,
+                                      first, last, result_offsets,
+                                      t_a0, t_a1, fsync_s, timeout)
+        finally:
+            if probe is not None:
+                with self._lock:
+                    try:
+                        self._commit_probes.remove(probe)
+                    except ValueError:
+                        pass
+
+    def _wait_applied(self, led, probe, traces, term, era, first, last,
+                      result_offsets, t_a0, t_a1, fsync_s,
+                      timeout: float) -> list[Any]:
         # wait for the whole batch to be applied locally
         deadline = self.clock.now() + timeout
         with self._lock:
@@ -294,8 +342,46 @@ class RaftNode:
                 # blind retry could apply a committed write twice.
                 raise NotLeader(self.leader_id,
                                 note="; commit indeterminate")
+            if led is not None:
+                self._close_commit_ledger(led, probe, traces,
+                                          t_a0, t_a1, fsync_s)
             return [self._apply_results.pop(first + off, None)
                     for off in result_offsets]
+
+    def _close_commit_ledger(self, led, probe, traces,
+                             t_a0: float, t_a1: float,
+                             fsync_s: float) -> None:
+        """Partition one committed batch's wall time into the depth-0
+        commit-pipeline stages and close the ledger. The windows meet
+        end-to-end — [append | replicate.rtt | quorum_wait |
+        apply_batch] — so their sum is exactly now - t_a0 ≤ e2e; probe
+        stamps are clamped into [append_end, now] (a single-node
+        cluster commits inline with no follower ack, and stamp order
+        must survive clock-read interleavings)."""
+        now = time.perf_counter()
+        t0 = led.t0_pc
+        perf.record(led, "raft.append", t_a1 - t_a0, off=t_a0 - t0)
+        # the disk barrier, measured where it happened: nested at
+        # depth 1 inside raft.append's tail (0.0 when sync=off)
+        perf.record(led, "raft.fsync", fsync_s,
+                    off=(t_a1 - fsync_s) - t0, depth=1)
+        t_first = probe["first_ack"]
+        t_first = t_a1 if t_first is None \
+            else min(max(t_first, t_a1), now)
+        t_q = probe["quorum"]
+        t_q = t_first if t_q is None else min(max(t_q, t_first), now)
+        perf.record(led, "raft.replicate.rtt", t_first - t_a1,
+                    off=t_a1 - t0)
+        perf.record(led, "raft.quorum_wait", t_q - t_first,
+                    off=t_first - t0)
+        perf.record(led, "raft.apply_batch", now - t_q, off=t_q - t0)
+        led.node = self.id
+        # commit batches are rare relative to requests and the span
+        # mirror is what stitches the cross-node timeline — always emit
+        led.mirror_min_ms = 0.0
+        if traces:
+            led.trace = next((t for t in traces if t), None)
+        perf.close(led)
 
     def barrier(self, timeout: float = 10.0) -> None:
         """Commit an empty entry and wait for it: asserts leadership and
@@ -631,6 +717,7 @@ class RaftNode:
             if addr not in self._next_index:
                 self._next_index[addr] = self.store.first_index()
                 self._match_index[addr] = 0
+                self._register_lag_gauge(addr)
         self._replicate_all()
 
     def remove_peer(self, addr: str) -> None:
@@ -943,8 +1030,35 @@ class RaftNode:
         # verify_leadership refuses to serve before then (§6.4: a new
         # leader needs a current-term committed entry first)
         self._term_start_index = self.store.last_index() - 1
+        # observatory gauges (PR 19), polled at snapshot time: local
+        # log depth and per-follower replication lag (match_index
+        # delta). Registered on every win so an in-process multi-node
+        # cluster exposes the CURRENT leader's view; the closures
+        # self-zero after step-down.
+        perf.default.gauge_fn("raft.log.depth",
+                              lambda: float(len(self.store.log)))
+        for p in self.peers:
+            self._register_lag_gauge(p)
         self._replicate_all()
         self._schedule_heartbeat()
+
+    def _register_lag_gauge(self, p: str) -> None:
+        """Per-follower replication-lag gauge (match_index delta),
+        polled at snapshot time. Registered whenever a peer enters the
+        leader's tracking set (_become_leader for the elected view,
+        add_peer / the config-apply branch for later joins); the
+        closure self-zeroes after step-down."""
+        if p == self.transport.addr:
+            return
+
+        def lag(p=p):
+            if self.role != Role.LEADER:
+                return 0.0
+            return float(max(
+                0, self.store.last_index()
+                - self._match_index.get(p, 0)))
+
+        perf.default.gauge_fn(f"raft.peer.lag.{p}", lag)
 
     def _step_down(self, term: int) -> None:
         if term > self.store.term:
@@ -1065,10 +1179,13 @@ class RaftNode:
         if send_snap:
             return self._send_snapshot(peer)
         sent = self.clock.now()
+        t_rpc = time.perf_counter()
+        wall_rpc = time.time()
         try:
             reply = self.transport.call(peer, "append_entries", args)
         except Exception:  # noqa: BLE001 — peer unreachable
             return False
+        rtt = time.perf_counter() - t_rpc
         with self._lock:
             if self._stopped or self.store.term != term \
                     or self.role != Role.LEADER:
@@ -1085,6 +1202,30 @@ class RaftNode:
                     self._match_index[peer] = max(
                         self._match_index.get(peer, 0), match)
                     self._next_index[peer] = match + 1
+                    # per-follower AppendEntries round-trip: last-rtt
+                    # gauge per peer, plus the follower-ack span of the
+                    # cross-node write timeline (tagged with the
+                    # batch's trace id so Perfetto stitches it)
+                    perf.default.gauge_set(
+                        f"raft.replicate.rtt_ms.{peer}",
+                        round(rtt * 1000.0, 4))
+                    tid = next((en.get("trace") for en in entries
+                                if en.get("trace")), None)
+                    tags = {"node": self.id, "peer": peer,
+                            "entries": len(entries)}
+                    if tid:
+                        tags["trace"] = tid
+                    trace_mod.default.emit("raft.replicate.rtt",
+                                           wall_rpc, rtt * 1000.0,
+                                           **tags)
+                    # first covering ack per in-flight batch probe: the
+                    # boundary between replicate.rtt and quorum_wait in
+                    # that batch's commit ledger
+                    t_ack = t_rpc + rtt
+                    for pr in self._commit_probes:
+                        if pr["first_ack"] is None \
+                                and match >= pr["last"]:
+                            pr["first_ack"] = t_ack
             else:
                 # conflict rollback, optionally accelerated by hint
                 hint = reply.get("conflict_index")
@@ -1142,6 +1283,12 @@ class RaftNode:
                 if votes * 2 > len(voters):
                     self.commit_index = idx
                     break
+            if self._commit_probes:
+                t_c = time.perf_counter()
+                for pr in self._commit_probes:
+                    if pr["quorum"] is None \
+                            and self.commit_index >= pr["last"]:
+                        pr["quorum"] = t_c
             self._apply_committed()
 
     def _apply_committed(self) -> None:
@@ -1180,6 +1327,7 @@ class RaftNode:
         # below so the steady-state read is the residual lag)
         perf.default.gauge_set("raft.applier.depth",
                                self.commit_index - self.last_applied)
+        drained = 0
         while self.last_applied < self.commit_index:
             idx = self.last_applied + 1
             e = self.store.entry(idx)
@@ -1284,10 +1432,17 @@ class RaftNode:
                         self._next_index[e["add"]] = \
                             self.store.last_index() + 1
                         self._match_index[e["add"]] = 0
+                        self._register_lag_gauge(e["add"])
                 if e.get("remove"):
                     self.peers.discard(e["remove"])
                     self.nonvoters.discard(e["remove"])
             self.last_applied = idx
+            drained += 1
+        if drained:
+            # apply-batch coalescing distribution: how many committed
+            # entries one applier pass drained (pairs with the group-
+            # commit batch histogram the server-side batcher feeds)
+            perf.default.size_observe("raft.apply.batch", drained)
         perf.default.gauge_set("raft.applier.depth",
                                self.commit_index - self.last_applied)
         self._applied_cv.notify_all()
@@ -1396,16 +1551,47 @@ class RaftNode:
                 if idx <= self.store.last_index():
                     if self.store.term_at(idx) != e["term"]:
                         self.store.truncate_from(idx)
-                        self.store.append(strip(new_entries[i:]))
+                        self._follower_append(strip(new_entries[i:]))
                         break
                 else:
-                    self.store.append(strip(new_entries[i:]))
+                    self._follower_append(strip(new_entries[i:]))
                     break
             if args["leader_commit"] > self.commit_index:
                 self.commit_index = min(args["leader_commit"],
                                         self.store.last_index())
                 self._apply_committed()
             return {"term": self.store.term, "success": True}
+
+    def _follower_append(self, entries: list[dict[str, Any]]) -> None:
+        """Follower-side log+WAL write, timed. Observed under SEPARATE
+        stage names (raft.follower.append / raft.follower.fsync): every
+        in-process node feeds the same perf registry, so reusing the
+        leader names would pollute the critical-path histograms — and
+        semantically this write happens INSIDE the leader's
+        raft.replicate.rtt window, not beside it. Emits one span tagged
+        with the replicated entries' trace id so the cross-node
+        timeline shows the follower's durable write under the leader's
+        round-trip."""
+        t0 = time.perf_counter()
+        self.store.append(entries)
+        dur = time.perf_counter() - t0
+        fsync_s = self.store.last_fsync_s
+        perf.default.observe("raft.follower.append", dur)
+        perf.default.observe("raft.follower.fsync", fsync_s)
+        try:
+            tags: dict[str, Any] = {"node": self.id,
+                                    "entries": len(entries),
+                                    "fsync_ms": round(
+                                        fsync_s * 1000.0, 4)}
+            tid = next((e.get("trace") for e in entries
+                        if e.get("trace")), None)
+            if tid:
+                tags["trace"] = tid
+            trace_mod.default.emit("raft.follower.append",
+                                   time.time() - dur, dur * 1000.0,
+                                   **tags)
+        except Exception:  # noqa: BLE001 — observability never raises
+            pass
 
     def _on_install_snapshot(self, args: dict[str, Any]) -> dict[str, Any]:
         with self._lock:

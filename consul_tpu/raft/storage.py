@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 from typing import Any, Optional
 
 import msgpack
@@ -39,6 +40,14 @@ class RaftStorage:
         self.snapshot_peers: Optional[list[str]] = None
         self.snapshot_nonvoters: list[str] = []
         self._wal = None
+        # commit-pipeline attribution (PR 19): wall time of the last
+        # append() call and of its fsync barrier, read by the caller
+        # under the raft lock (append is always lock-serialized, so a
+        # pair of plain floats is race-free). Storage itself stays
+        # perf-free — the ledger lives in raft.py where the request
+        # context is.
+        self.last_append_s = 0.0
+        self.last_fsync_s = 0.0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load()
@@ -150,6 +159,8 @@ class RaftStorage:
             os.replace(tmp, self._meta_path())
 
     def append(self, entries: list[dict[str, Any]]) -> None:
+        t0 = time.perf_counter()
+        fsync_s = 0.0
         for e in entries:
             e.setdefault("idx", self.last_index() + 1)
             self.log.append(e)
@@ -159,7 +170,14 @@ class RaftStorage:
                 self._wal.write(struct.pack(">I", len(blob)) + blob)
             self._wal.flush()
             if self.sync:
+                # the disk barrier is measured HERE — where it actually
+                # happens — not inferred from the append envelope; an
+                # in-memory or sync=False store honestly reports 0
+                tf = time.perf_counter()
                 os.fsync(self._wal.fileno())
+                fsync_s = time.perf_counter() - tf
+        self.last_fsync_s = fsync_s
+        self.last_append_s = time.perf_counter() - t0
 
     def truncate_from(self, index: int) -> None:
         """Drop entries at raft index >= index (conflict rollback)."""

@@ -1,0 +1,379 @@
+"""Consensus-plane commit-path observatory (bench.py --raft).
+
+The USERS observatory (users.py) measures the SERVING plane under a
+mixed open-loop workload; this module points the same open-loop
+discipline at the WRITE path alone: a real 3-server loopback cluster
+with on-disk WALs (``sync=True`` — the fsync barrier is the stage
+being measured, so an in-memory cluster would record a lie), driven by
+an ascending ladder of KV PUT rungs with mixed entry sizes.
+
+What a rung records, beyond the client-side latency row:
+
+  * the leader's per-batch commit-pipeline attribution — the
+    raft-kind stage ledger (raft/raft.py) partitions every
+    group-commit batch's e2e into the disjoint depth-0 windows
+    ``registry.RAFT_STAGES`` (append | replicate.rtt | quorum_wait |
+    apply_batch, with fsync nested inside append), so
+    p50(stages_sum)/p50(e2e) is the COVERAGE of the observatory and
+    must clear ``registry.RAFT_COVERAGE_MIN``;
+  * group-commit and apply batch-size distributions
+    (``raft.commit.batch`` / ``raft.apply.batch`` size histograms);
+  * per-follower replication lag (``raft.peer.lag.*`` gauges) and the
+    leader's log depth at rung end.
+
+Latency is measured from the INTENDED send time (open-loop — no
+coordinated omission), exactly like users.run_rung.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket as socket_mod
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Optional
+
+from consul_tpu.serve.users import (STABILITY_BAND, headline,
+                                    loadavg_1m, wait_for)
+from consul_tpu.sim import registry
+
+#: the mixed entry sizes a rung cycles through — small KV writes batch
+#: under group commit, 16K entries stress the WAL write + fsync window
+PAYLOAD_BYTES = (64, 1024, 16384)
+
+
+# ------------------------------------------------------------- cluster
+
+class RaftCluster:
+    """A real n-server loopback cluster with on-disk, fsync'ing WALs
+    under a throwaway temp directory — the consensus plane under
+    observation."""
+
+    def __init__(self, servers, leader, tmpdir: str) -> None:
+        self.servers = servers
+        self.leader = leader
+        self.followers = [s for s in servers if s is not leader]
+        self.tmpdir = tmpdir
+
+    def close(self) -> None:
+        for s in self.servers:
+            try:
+                s.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(self.tmpdir, ignore_errors=True)
+
+
+def build_cluster(n: int = 3,
+                  overrides: Optional[dict] = None) -> RaftCluster:
+    """Build the n-server cluster with per-server data dirs (real WAL
+    + fsync — RaftStorage defaults to sync=True when given a dir).
+    The bench's durability claim rides on this: a PUT acked here hit
+    a disk barrier on a quorum."""
+    from consul_tpu.config import load
+    from consul_tpu.server import Server
+
+    tmpdir = tempfile.mkdtemp(prefix="raftbench-")
+    base = {"server": True, "bootstrap": n == 1,
+            "bootstrap_expect": 0 if n == 1 else n,
+            # loopback topology artifact: every client shares 127.0.0.1
+            "rpc_max_conns_per_client": 4096}
+    base.update(overrides or {})
+    print(f"building {n}-server raft cluster (sync WALs)...",
+          file=sys.stderr)
+    servers = []
+    for i in range(n):
+        cfg = load(dev=True, overrides={
+            **base, "node_name": f"raft{i}",
+            "data_dir": os.path.join(tmpdir, f"srv{i}")})
+        s = Server(cfg)
+        s.start()
+        if servers:
+            s.join([servers[0].serf.memberlist.transport.addr])
+        servers.append(s)
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="leader election")
+    if n > 1:
+        wait_for(lambda: len(leader.raft.peers) == n,
+                 what=f"{n} raft peers")
+    return RaftCluster(servers, leader, tmpdir)
+
+
+# ------------------------------------------------------ one PUT rung
+
+def _size_stats(cur: dict, prev: dict, name: str
+                ) -> Optional[dict[str, Any]]:
+    """Windowed batch-size distribution from two raw() snapshots."""
+    from consul_tpu.utils import perf
+
+    st = (cur.get("sizes") or {}).get(name)
+    if st is None:
+        return None
+    d = perf.diff_state(st, (prev.get("sizes") or {}).get(name))
+    if d["count"] <= 0:
+        return None
+    h = perf.SizeHistogram.from_state(d)
+    return {"count": d["count"],
+            "mean": round(d["sum"] / d["count"], 2),
+            "p50": round(h.quantile(0.50), 2),
+            "p99": round(h.quantile(0.99), 2),
+            "max": d.get("max", 0.0)}
+
+
+def run_put_rung(cluster: RaftCluster, target_rps: float,
+                 duration: float, windows: int = 3, senders: int = 2,
+                 rpc_sockets: int = 4, salt: int = 0,
+                 drain_s: float = 5.0) -> dict[str, Any]:
+    """One open-loop write rung: ``target_rps * duration`` KV PUTs at
+    fixed intended send times, mixed entry sizes, all lanes pipelined
+    mux sockets to the LEADER (the commit pipeline under test —
+    forward hops are the serving plane's story, not this family's).
+    Returns the registry.RAFT_RUNG_KEYS row."""
+    from consul_tpu.server.rpc import RPC_MUX, read_frame, write_frame
+    from consul_tpu.utils import perf
+
+    total = max(1, int(target_rps * duration))
+    leader_addr = cluster.leader.rpc.addr
+    host, port = leader_addr.rsplit(":", 1)
+    completions: list[list] = []
+    counters_lock = threading.Lock()
+    rejected = [0]
+    errored = [0]
+    unsent = [0]
+
+    lanes = []  # (sock, wlock, pending{sid: sched}, plk)
+    readers = []
+    for li in range(rpc_sockets):
+        sock = socket_mod.create_connection((host, int(port)),
+                                            timeout=10.0)
+        sock.sendall(bytes([RPC_MUX]))
+        pending: dict[int, float] = {}
+        lane = (sock, threading.Lock(), pending, threading.Lock())
+        lanes.append(lane)
+        rows: list = []
+        completions.append(rows)
+
+        def reader(sock=sock, pending=pending, plk=lane[3],
+                   rows=rows):
+            while True:
+                try:
+                    resp = read_frame(sock)
+                except Exception:  # noqa: BLE001 — closed mid-read
+                    return
+                if resp is None:
+                    return
+                t_done = time.perf_counter()
+                with plk:
+                    sched = pending.pop(resp.get("sid", -1), None)
+                if sched is None:
+                    continue
+                err = resp.get("error")
+                if err:
+                    with counters_lock:
+                        if resp.get("retryable") \
+                                or "overloaded" in str(err):
+                            rejected[0] += 1
+                        else:
+                            errored[0] += 1
+                else:
+                    rows.append((sched, t_done))
+
+        t = threading.Thread(target=reader, daemon=True,
+                             name=f"raftbench-mux-{li}")
+        readers.append(t)
+        t.start()
+
+    period = 1.0 / float(target_rps)
+    start_gate = threading.Barrier(senders + 1)
+    t_start = [0.0]
+
+    def sender(si: int):
+        start_gate.wait()
+        start = t_start[0]
+        for i in range(si, total, senders):
+            sched = start + i * period
+            now = time.perf_counter()
+            wait = sched - now
+            if wait > 0:
+                time.sleep(wait)
+            elif now - sched > duration:
+                # the client itself is hopelessly behind (not the
+                # server): stop offering, count the rest honestly
+                with counters_lock:
+                    unsent[0] += (total - i + senders - 1) // senders
+                return
+            size = PAYLOAD_BYTES[(i + salt) % len(PAYLOAD_BYTES)]
+            sock, wlock, pending, plk = lanes[i % rpc_sockets]
+            with plk:
+                pending[i] = sched
+            try:
+                with wlock:
+                    write_frame(sock, {
+                        "sid": i, "method": "KVS.Apply",
+                        "args": {"Op": "set", "DirEnt": {
+                            "Key": f"raftbench/k{i % 512}",
+                            "Value": b"w" * size}}})
+            except OSError:
+                with plk:
+                    pending.pop(i, None)
+                with counters_lock:
+                    errored[0] += 1
+
+    sender_threads = [threading.Thread(target=sender, args=(si,),
+                                       daemon=True,
+                                       name=f"raftbench-send-{si}")
+                      for si in range(senders)]
+    load0 = loadavg_1m()
+    raw0 = perf.default.raw()
+    for t in sender_threads:
+        t.start()
+    start_gate.wait()
+    t_start[0] = time.perf_counter()
+    for t in sender_threads:
+        t.join()
+    deadline = time.perf_counter() + drain_s
+
+    def in_flight():
+        n = 0
+        for _, _, pending, plk in lanes:
+            with plk:
+                n += len(pending)
+        return n
+
+    while in_flight() and time.perf_counter() < deadline:
+        time.sleep(0.05)
+    timeouts = in_flight()
+    for sock, _, _, _ in lanes:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    for t in readers:
+        t.join(timeout=3.0)
+    raw1 = perf.default.raw()
+
+    # --- aggregate: client view -------------------------------------
+    rows = [r for lane_rows in completions for r in lane_rows]
+    start = t_start[0]
+    lats = sorted(d - sc for (sc, d) in rows)
+
+    def pct(sorted_lats, q):
+        if not sorted_lats:
+            return None
+        k = min(len(sorted_lats) - 1,
+                max(0, int(q * len(sorted_lats)) - 1))
+        return round(sorted_lats[k] * 1e3, 3)
+
+    win = duration / windows
+    wcounts = [0] * windows
+    for (_, d) in rows:
+        wcounts[min(max(int((d - start) / win), 0), windows - 1)] += 1
+
+    # --- aggregate: the leader's commit-pipeline attribution --------
+    report = perf.stage_report(raw1, raw0, "raft")
+    e2e = report.get("e2e") or {}
+    commit_p50 = e2e.get("p50_ms")
+    stage_p50: dict[str, Any] = {}
+    stage_share: dict[str, Any] = {}
+    for name in registry.RAFT_STAGES:
+        srow = report["stages"].get(name) or {}
+        stage_p50[name] = srow.get("p50_ms", 0.0)
+        stage_share[name] = (
+            round(srow.get("p50_ms", 0.0) / commit_p50, 4)
+            if commit_p50 else 0.0)
+    gauges1 = raw1["gauges"]
+    follower_lag = {k[len("raft.peer.lag."):]: gauges1[k]
+                    for k in sorted(gauges1)
+                    if k.startswith("raft.peer.lag.")}
+    return {
+        "target_rps": float(target_rps),
+        "duration_s": float(duration),
+        "offered": total,
+        "completed": len(rows),
+        "rejected": rejected[0],
+        "errors": errored[0] + timeouts + unsent[0],
+        "timeouts": timeouts,
+        "unsent": unsent[0],
+        "achieved_rps": round(len(rows) / duration, 1),
+        "p50_ms": pct(lats, 0.50),
+        "p99_ms": pct(lats, 0.99),
+        "commit_p50_ms": commit_p50,
+        "commit_p99_ms": e2e.get("p99_ms"),
+        "commit_batches": e2e.get("count", 0),
+        "stage_p50_ms": stage_p50,
+        "stage_share_p50": stage_share,
+        # the coverage claim: p50(raft.stages_sum)/p50(raft.e2e) over
+        # the SAME batch population (see perf.stage_report) — NOT the
+        # sum of per-stage p50s, which is not additive
+        "coverage_p50": report.get("share_p50_total") or 0.0,
+        "commit_batch": _size_stats(raw1, raw0, "raft.commit.batch"),
+        "apply_batch": _size_stats(raw1, raw0, "raft.apply.batch"),
+        "follower_lag": follower_lag,
+        "log_depth": gauges1.get("raft.log.depth"),
+        "window_rps": [round(c / win, 1) for c in wcounts],
+        "loadavg_1m": load0,
+    }
+
+
+# --------------------------------------------------------- the ladder
+
+def run_put_ladder(cluster: RaftCluster, targets: list[float],
+                   duration: float, windows: int = 3,
+                   **rung_kw) -> dict[str, Any]:
+    """Ascending open-loop PUT rungs. Once a rung saturates the write
+    path — admission shedding, client falling behind its own schedule
+    (unsent > 0), or achieved throughput under 80% of offered — every
+    higher rung is an HONEST SKIP: offering more past that point only
+    re-measures the backlog. The headline is the best saturation-free
+    rung's achieved PUT/s under the stability band."""
+    ladder = []
+    saturated = None
+    for salt, target in enumerate(sorted(targets)):
+        if saturated is not None:
+            ladder.append({
+                "skipped": True, "target_rps": float(target),
+                "reason": f"past host budget: write path already "
+                          f"saturated at {saturated:g} rps"})
+            continue
+        row = run_put_rung(cluster, target, duration,
+                           windows=windows, salt=salt, **rung_kw)
+        ladder.append(row)
+        print(f"  rung {target:g} put/s: achieved "
+              f"{row['achieved_rps']:,.0f}/s p99={row['p99_ms']}ms "
+              f"commit p50={row['commit_p50_ms']}ms coverage="
+              f"{row['coverage_p50']:.0%}", file=sys.stderr)
+        if row["rejected"] > 0 or row["unsent"] > 0 \
+                or row["achieved_rps"] < 0.8 * target:
+            saturated = float(target)
+    clean = [r for r in ladder if not r.get("skipped")
+             and not r["rejected"] and not r["unsent"]
+             and r["achieved_rps"] >= 0.8 * r["target_rps"]]
+    measured = [r for r in ladder if not r.get("skipped")]
+    # the headline is the HIGHEST load at which this host can make a
+    # stable throughput claim: walk clean rungs top-down and take the
+    # first whose windows pass the IQR/median band. Rungs above it
+    # are named as unstable — they stay in the ladder as measured
+    # data, they just can't anchor a regression guard. If no rung is
+    # stable the top rung's REFUSAL is the record (SERVE precedent).
+    candidates = sorted(clean or measured,
+                        key=lambda r: r["achieved_rps"], reverse=True)
+    head_rung, head, unstable_above = candidates[0], None, []
+    for r in candidates:
+        hl = headline(r["window_rps"], band=STABILITY_BAND)
+        if head is None:
+            head_rung, head = r, hl
+        if hl.get("headline") is not None:
+            head_rung, head = r, hl
+            break
+        unstable_above.append(r["target_rps"])
+    if unstable_above and head.get("headline") is not None:
+        head["unstable_above"] = unstable_above
+    return {
+        "ladder": ladder,
+        "headline": head,
+        "headline_rung": {"target_rps": head_rung["target_rps"]},
+    }

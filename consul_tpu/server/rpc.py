@@ -53,6 +53,7 @@ from typing import Any, Callable, Optional
 import msgpack
 
 from consul_tpu.utils import log, perf, telemetry
+from consul_tpu.utils import trace as trace_mod
 
 RPC_CONSUL = 0x00
 RPC_RAFT = 0x01
@@ -1006,14 +1007,22 @@ class RPCServer:
                 return
             seq = req.get("seq", 0)
             method = req.get("method", "")
+            args = req.get("args") or {}
             start = telemetry.time_now()
             led = perf.ledger("rpc", read_s=read_s)
+            # client-facing seam: adopt the caller's trace id or mint
+            # one here (same contract as the mux paths)
+            tid = args.get("_trace")
+            if not tid:
+                tid = trace_mod.mint()
+                args["_trace"] = tid
+            if led is not None:
+                led.trace = tid
             tok = perf.attach(led)
+            prev_tr = trace_mod.set_current(tid)
             try:
                 with perf.stage("rpc.handler"):
-                    result = self._rpc_handler(method,
-                                               req.get("args") or {},
-                                               src)
+                    result = self._rpc_handler(method, args, src)
                 with perf.stage("rpc.write"):
                     write_frame(sock, {"seq": seq, "result": result})
             except RPCError as e:
@@ -1022,6 +1031,7 @@ class RPCServer:
                 self.log.warning("rpc %s failed: %s", method, e)
                 write_frame(sock, {"seq": seq, "error": f"internal: {e}"})
             finally:
+                trace_mod.set_current(prev_tr)
                 perf.detach(tok)
                 perf.close(led)
                 self.metrics.measure_hist(
@@ -1089,6 +1099,19 @@ class RPCServer:
             return
         req_args = req.get("args") or {}
         led = perf.ledger("rpc", read_s=read_s)
+        # cross-node trace id (PR 19): minted HERE, at the client-
+        # facing socket — or ADOPTED when the frame is a leader-forward
+        # (the forwarder passes its args dict verbatim, so "_trace"
+        # rides the mux frame for free). Stored back into the args so
+        # forwarding and the group-commit batcher propagate it without
+        # per-handler plumbing; the ledger carries it so this request's
+        # mirrored stage spans join the same timeline.
+        tid = req_args.get("_trace")
+        if not tid:
+            tid = trace_mod.mint()
+            req_args["_trace"] = tid
+        if led is not None:
+            led.trace = tid
         afn = self.async_handlers.get(method)
         if afn is not None:
             if self._dispatch_async(sess, sid, method, req_args, afn,
@@ -1198,7 +1221,14 @@ class RPCServer:
                 # (possibly already racing on a completer thread)
                 # waits for a real mark
                 led.mark = -1.0
-            handled = afn(req_args, sess.src, respond)
+            # thread-local trace binding: the handler enqueues to the
+            # group-commit batcher INLINE here, and the batcher reads
+            # current_trace() on the enqueuing thread
+            prev_tr = trace_mod.set_current(req_args.get("_trace"))
+            try:
+                handled = afn(req_args, sess.src, respond)
+            finally:
+                trace_mod.set_current(prev_tr)
         except Exception as e:  # noqa: BLE001 — validation
             if led is not None:
                 end_h = time.perf_counter()
@@ -1250,6 +1280,7 @@ class RPCServer:
             # is the ledger's Σstages ≤ e2e invariant
             led.depth += 1
         t_h = time.perf_counter()
+        prev_tr = trace_mod.set_current(args.get("_trace"))
         try:
             result = self._rpc_handler(method, args, src)
             obj = {"sid": sid, "result": result}
@@ -1270,6 +1301,8 @@ class RPCServer:
         except Exception as e:  # noqa: BLE001
             self.log.warning("rpc %s failed: %s", method, e)
             obj = {"sid": sid, "error": f"internal: {e}"}
+        finally:
+            trace_mod.set_current(prev_tr)
         end_h = time.perf_counter()
         if led is not None:
             led.depth -= 1
@@ -1475,6 +1508,14 @@ class RPCServer:
             # (rpc.read seeded with the frame's body+decode service
             # time), closed by whichever thread writes the reply
             led = perf.ledger("rpc", read_s=read_s)
+            # adopt or mint the cross-node trace id (PR 19) — same
+            # contract as the reactor dispatch path
+            tid = req_args.get("_trace")
+            if not tid:
+                tid = trace_mod.mint()
+                req_args["_trace"] = tid
+            if led is not None:
+                led.trace = tid
 
             # async fast path: a handler that validates inline and
             # completes via callback (e.g. the KV write path riding the
@@ -1550,7 +1591,12 @@ class RPCServer:
                         # write_reply (possibly already racing on a
                         # pool thread) waits for a real mark
                         led.mark = -1.0
-                    handled = afn(req_args, src, respond)
+                    prev_tr = trace_mod.set_current(
+                        req_args.get("_trace"))
+                    try:
+                        handled = afn(req_args, src, respond)
+                    finally:
+                        trace_mod.set_current(prev_tr)
                 except Exception as e:  # noqa: BLE001 — validation
                     if led is not None:
                         end_h = time.perf_counter()
@@ -1590,6 +1636,7 @@ class RPCServer:
                                 time.perf_counter() - led.mark,
                                 off=led.mark - led.t0_pc)
                 tok = perf.attach(led)
+                prev_tr = trace_mod.set_current(args.get("_trace"))
                 try:
                     try:
                         with perf.stage("rpc.handler"):
@@ -1610,6 +1657,7 @@ class RPCServer:
                         self.metrics.measure_hist(
                             "rpc.request", start, {"method": method})
                 finally:
+                    trace_mod.set_current(prev_tr)
                     perf.detach(tok)
                     perf.close(led)
 

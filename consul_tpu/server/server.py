@@ -86,7 +86,10 @@ class _ApplyBatcher:
     def __init__(self, raft) -> None:
         self.raft = raft
         self._cv = threading.Condition()
-        self._pending: list[tuple[bytes, Any]] = []  # (data, callback)
+        # (data, callback, trace-id) — the trace id is captured from
+        # the enqueuing thread (rpc.py binds it around handler runs) so
+        # the replicated entries carry the client-minted id (PR 19)
+        self._pending: list[tuple[bytes, Any, Any]] = []
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
 
@@ -100,14 +103,18 @@ class _ApplyBatcher:
             done.set()
 
         self.apply_async(data, cb)
+        tid = trace_mod.current_trace()
         # span on the CALLER thread: under an HTTP write it nests in
         # that request's http.request span and measures the time spent
         # parked on the group-commit queue — the batcher's own
         # raft.apply span (raft-batcher thread) and the applier's
         # raft.fsm.apply span carry the other two thirds of the write's
-        # wall time (utils/trace.py; cross-thread, correlated by time)
+        # wall time (utils/trace.py; cross-thread, correlated by time
+        # AND by the propagated trace id)
         with trace_mod.default.span("raft.commit_wait",
-                                    bytes=len(data)):
+                                    bytes=len(data),
+                                    **({"trace": tid} if tid
+                                       else {})):
             # perf stage nests under the caller's request ledger (an
             # HTTP write parks HERE for most of its wall time)
             with perf.stage("raft.commit_wait"):
@@ -128,7 +135,8 @@ class _ApplyBatcher:
         with self._cv:
             if self._stopped:
                 raise RPCError("server shutting down")
-            self._pending.append((data, cb))
+            self._pending.append((data, cb,
+                                  trace_mod.current_trace()))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._run, daemon=True, name="raft-batcher")
@@ -140,7 +148,7 @@ class _ApplyBatcher:
             self._stopped = True
             pending, self._pending = self._pending, []
             self._cv.notify_all()
-        for _, cb in pending:
+        for _, cb, _tid in pending:
             try:
                 cb(RPCError("server shutting down"))
             except Exception:  # noqa: BLE001 — shutdown best-effort
@@ -154,11 +162,16 @@ class _ApplyBatcher:
                 if self._stopped:
                     return
                 batch, self._pending = self._pending, []
+            # group-commit coalescing distribution: how many writes one
+            # raft round carried (the size histogram on /v1/agent/perf)
+            perf.default.size_observe("raft.commit.batch", len(batch))
             try:
-                results = self.raft.apply_many([d for d, _ in batch])
+                results = self.raft.apply_many(
+                    [d for d, _, _ in batch],
+                    traces=[t for _, _, t in batch])
             except Exception as e:  # noqa: BLE001 — batch-level failure
                 results = [e] * len(batch)
-            for (_, cb), res in zip(batch, results):
+            for (_, cb, _tid), res in zip(batch, results):
                 try:
                     cb(res)
                 except Exception:  # noqa: BLE001 — one bad callback

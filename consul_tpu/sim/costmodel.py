@@ -810,6 +810,69 @@ def _validate_users(name: str, d: dict) -> None:
              ("target_rps",))
 
 
+def _validate_raft(name: str, d: dict) -> None:
+    """Consensus-plane commit-path record (bench.py --raft): a
+    write-heavy open-loop PUT ladder against a real 3-server loopback
+    cluster, each rung a measured row (registry.RAFT_RUNG_KEYS) or an
+    honest skip naming its reason. The family's claim is per-stage
+    ATTRIBUTION, so a rung whose depth-0 stage windows explain less
+    than RAFT_COVERAGE_MIN of the commit e2e p50 is refused — an
+    observatory with a >10% blind spot must not ship as data."""
+    _require(name, d, ("metric", "unit", "cluster", "ladder",
+                       "headline", "headline_rung"))
+    cl = d["cluster"]
+    if not isinstance(cl, dict):
+        raise LedgerError(f"{name}: cluster must be an object")
+    _require(f"{name}.cluster", cl, ("servers", "sync",
+                                     "payload_bytes"))
+    if not isinstance(d["ladder"], list) or not d["ladder"]:
+        raise LedgerError(f"{name}: ladder must be a non-empty list")
+    measured = 0
+    for i, rung in enumerate(d["ladder"]):
+        rn = f"{name}.ladder[{i}]"
+        if not isinstance(rung, dict):
+            raise LedgerError(f"{rn}: rung must be an object")
+        if rung.get("skipped"):
+            _require(rn, rung, ("target_rps", "reason"))
+            continue
+        measured += 1
+        _require(rn, rung, registry.RAFT_RUNG_KEYS)
+        _require_num(rn, rung, ("target_rps", "achieved_rps",
+                                "p50_ms", "p99_ms", "commit_p50_ms",
+                                "commit_p99_ms", "coverage_p50"))
+        shares = rung["stage_share_p50"]
+        if not isinstance(shares, dict):
+            raise LedgerError(f"{rn}: stage_share_p50 must be an "
+                              "object")
+        missing = set(registry.RAFT_STAGES) - set(shares)
+        if missing:
+            raise LedgerError(
+                f"{rn}.stage_share_p50: missing stage(s) "
+                f"{sorted(missing)} — every depth-0 commit window "
+                "must be attributed")
+        unknown = set(shares) - set(registry.RAFT_STAGES)
+        if unknown:
+            raise LedgerError(
+                f"{rn}.stage_share_p50: unknown stage(s) "
+                f"{sorted(unknown)} (known: "
+                f"{', '.join(registry.RAFT_STAGES)})")
+        cov = rung["coverage_p50"]
+        if cov < registry.RAFT_COVERAGE_MIN:
+            raise LedgerError(
+                f"{rn}: stage coverage {cov:.3f} is below "
+                f"{registry.RAFT_COVERAGE_MIN:.0%} of commit e2e p50 "
+                "— the attribution has a blind spot; fix the ledger, "
+                "don't record around it")
+    if not measured:
+        raise LedgerError(
+            f"{name}: every rung skipped — record the failure as a "
+            "skipped BENCH-style envelope, not an empty raft ladder")
+    _require(f"{name}.headline", d["headline"],
+             ("value", "samples", "stability_band"))
+    _require(f"{name}.headline_rung", d["headline_rung"],
+             ("target_rps",))
+
+
 _VALIDATORS = {
     "BENCH": _validate_bench,
     "MULTICHIP": _validate_multichip,
@@ -822,6 +885,7 @@ _VALIDATORS = {
     "TUNE": _validate_tune,
     "TWIN": _validate_twin,
     "USERS": _validate_users,
+    "RAFT": _validate_raft,
 }
 assert set(_VALIDATORS) == set(registry.LEDGER_FAMILIES)
 
@@ -976,6 +1040,17 @@ def _headline_of(rec: dict[str, Any]):
                 f"{d['engine'].get('users', 0):,} users, shed "
                 f"{sat.get('rejected', 0)} @ {sat.get('target_rps')} "
                 f"rps; headline {note}")
+    if fam == "RAFT":
+        hl = d["headline"]
+        note = ("REFUSED: " + hl.get("unstable", "")[:60]
+                if hl.get("headline") is None else "stable")
+        rungs = [r for r in d["ladder"] if not r.get("skipped")]
+        top = max(rungs, key=lambda r: r.get("achieved_rps") or 0)
+        return (d.get("metric"), top.get("achieved_rps"),
+                d.get("unit"),
+                f"commit p50 {top.get('commit_p50_ms', 0):.2f} ms, "
+                f"stage coverage {top.get('coverage_p50', 0):.0%}; "
+                f"headline {note}")
     # CHAOS / COORDS
     if d.get("skipped"):
         return d.get("metric"), None, None, "skipped"
@@ -1114,6 +1189,33 @@ def latest_users_guard(records: list[dict]) -> Optional[dict[str, Any]]:
             continue
         return {"file": rec["file"], "round": rec["round"],
                 "target_rps": target, "engine": d.get("engine", {}),
+                "value": rung.get("achieved_rps")}
+    return None
+
+
+def latest_raft_guard(records: list[dict]) -> Optional[dict[str, Any]]:
+    """The newest RAFT record's re-measurement envelope — the
+    --check-regression --family RAFT baseline: {file, round,
+    target_rps, cluster, value} where `value` is the recorded headline
+    rung's achieved PUT req/s and `target_rps`/`cluster` name the
+    workload the guard re-runs (same open-loop rate, same server
+    count and durability mode — apples to apples). None when no RAFT
+    record exists."""
+    rafts = sorted((r for r in records if r["family"] == "RAFT"),
+                   key=lambda r: r["round"], reverse=True)
+    for rec in rafts:
+        d = rec["data"]
+        hr = d.get("headline_rung")
+        if not hr:
+            continue
+        target = hr.get("target_rps")
+        rung = next((r for r in d.get("ladder", ())
+                     if not r.get("skipped")
+                     and r.get("target_rps") == target), None)
+        if rung is None:
+            continue
+        return {"file": rec["file"], "round": rec["round"],
+                "target_rps": target, "cluster": d.get("cluster", {}),
                 "value": rung.get("achieved_rps")}
     return None
 

@@ -480,8 +480,16 @@ PROFILE_ROOFLINE_ROW = (
 #: vectorized virtual-user engine drives the mixed serving surfaces at
 #: scheduled arrival rates, each rung carrying per-surface SLO rows
 #: with latency measured from the INTENDED send time.
+#: RAFT (PR 19) is the consensus-plane commit-path observatory family
+#: (bench.py --raft): a write-heavy open-loop PUT ladder against a
+#: real 3-server loopback cluster, each rung carrying commit e2e
+#: latency plus the per-stage attribution shares of the leader's
+#: commit pipeline (append/fsync/replicate.rtt/quorum_wait/
+#: apply_batch), group-commit batch-size distributions, and
+#: follower-lag gauges.
 LEDGER_FAMILIES = ("BENCH", "MULTICHIP", "SWEEP", "SERVE", "PROFILE",
-                   "BYZ", "CHAOS", "COORDS", "TUNE", "TWIN", "USERS")
+                   "BYZ", "CHAOS", "COORDS", "TUNE", "TWIN", "USERS",
+                   "RAFT")
 
 #: per-rung keys every non-skipped TWIN ladder row must carry (the
 #: validator + README tables decode these)
@@ -517,6 +525,34 @@ USERS_RUNG_KEYS = ("target_rps", "duration_s", "offered", "completed",
 #: Jain's fairness index over per-user completions on that surface)
 USERS_SURFACE_KEYS = ("offered", "completed", "rejected", "errors",
                       "p50_ms", "p99_ms", "jain_users")
+
+#: the leader commit pipeline's depth-0 attribution windows, canonical
+#: order (consul_tpu/raft/raft.py partitions every group-commit
+#: batch's e2e into exactly these disjoint intervals, so their sum is
+#: ≤ the commit e2e by construction; `raft.fsync` nests inside
+#: `raft.append` at depth 1 and is deliberately NOT in this tuple —
+#: counting it here would double-book the disk barrier)
+RAFT_STAGES = ("raft.append", "raft.replicate.rtt", "raft.quorum_wait",
+               "raft.apply_batch")
+
+#: per-rung keys every non-skipped RAFT ladder row must carry (the
+#: validator + README tables decode these). `p50_ms`/`p99_ms` are
+#: client-observed PUT latency from the INTENDED send time
+#: (open-loop); `commit_p50_ms`/`commit_p99_ms` are the leader's
+#: raft.e2e commit latency; `stage_share_p50` maps each RAFT_STAGES
+#: window to its share of commit_p50_ms and `coverage_p50` is their
+#: sum — the fraction of the commit path the ledger explains.
+RAFT_RUNG_KEYS = ("target_rps", "duration_s", "offered", "completed",
+                  "errors", "achieved_rps", "p50_ms", "p99_ms",
+                  "commit_p50_ms", "commit_p99_ms", "stage_p50_ms",
+                  "stage_share_p50", "coverage_p50", "commit_batch",
+                  "apply_batch", "follower_lag", "window_rps")
+
+#: minimum fraction of the commit e2e p50 the depth-0 stage windows
+#: must explain at every measured rung — a record whose attribution
+#: has a >10% hole is refused (the observatory must not ship blind
+#: spots as data)
+RAFT_COVERAGE_MIN = 0.90
 
 #: the autotuner's winner schema: what a TUNE record's ``winner`` and
 #: every AUTOTUNE_CACHE.json entry must carry (validator + cache
@@ -571,7 +607,9 @@ def layout_digest() -> str:
                   PROFILE_ROOFLINE_ROW, LEDGER_FAMILIES,
                   TWIN_RUNG_KEYS, (str(TWIN_CONVERGE_TOL),),
                   USERS_SURFACES, USERS_RUNG_KEYS,
-                  USERS_SURFACE_KEYS):
+                  USERS_SURFACE_KEYS,
+                  RAFT_STAGES, RAFT_RUNG_KEYS,
+                  (str(RAFT_COVERAGE_MIN),)):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

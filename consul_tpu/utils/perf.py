@@ -47,6 +47,16 @@ Stage taxonomy (the request's life, in order — ``STAGES`` below):
   inner: store.read (blocking_query's state closure),
          raft.commit_wait (sync batcher park), raft.apply_batch
          (append→replicate→commit), raft.fsm.apply (applier thread)
+  raft:  the commit pipeline itself (PR 19) — one depth-0 ledger per
+         leader group-commit batch: raft.append (log+WAL write, with
+         raft.fsync nested at depth 1 where the barrier actually
+         happens) → raft.replicate.rtt (append-end to the first
+         covering follower ack) → raft.quorum_wait (first ack to
+         majority commit) → raft.apply_batch (commit to applied).
+         Follower-side WAL writes land in raft.follower.append /
+         raft.follower.fsync — separate names because every in-process
+         node feeds the same registry and the leader's critical-path
+         histograms must stay unmixed.
 
 Depth-0 ledger entries are non-overlapping intervals of one request's
 wall time, so their sum is ≤ the end-to-end latency by construction —
@@ -95,7 +105,10 @@ STAGES = (
     "dns.read", "dns.lookup", "dns.encode", "dns.write",
     "dns.e2e", "dns.stages_sum",
     "store.read",
-    "raft.commit_wait", "raft.apply_batch", "raft.fsm.apply",
+    "raft.commit_wait", "raft.append", "raft.fsync",
+    "raft.replicate.rtt", "raft.quorum_wait", "raft.apply_batch",
+    "raft.fsm.apply", "raft.e2e", "raft.stages_sum",
+    "raft.follower.append", "raft.follower.fsync",
 )
 
 #: the DEPTH-0 partition per request kind: disjoint sub-intervals of
@@ -108,6 +121,13 @@ TOP_STAGES = {
     "rpc": ("rpc.read", "rpc.dispatch", "rpc.handler", "rpc.park_wait",
             "rpc.commit_wait", "rpc.write"),
     "dns": ("dns.read", "dns.lookup", "dns.encode", "dns.write"),
+    # the leader commit pipeline: one ledger per group-commit batch,
+    # windows [open→append_end | append_end→first_ack | first_ack→
+    # quorum | quorum→applied] — disjoint by construction, so the
+    # PR 10 coverage law (Σ depth-0 ≤ e2e) holds float-exact.
+    # raft.fsync nests inside raft.append at depth 1.
+    "raft": ("raft.append", "raft.replicate.rtt", "raft.quorum_wait",
+             "raft.apply_batch"),
 }
 
 
@@ -136,8 +156,12 @@ class StreamingHistogram:
 
     __slots__ = ("counts", "sum", "min", "max")
 
+    #: bucket upper bounds; subclasses override to reuse the streaming
+    #: machinery on a different ruler (SizeHistogram below)
+    EDGES = _EDGE_LIST
+
     def __init__(self) -> None:
-        self.counts = [0] * N_BUCKETS
+        self.counts = [0] * (len(self.EDGES) + 1)
         self.sum = 0.0
         self.min = math.inf
         self.max = 0.0
@@ -147,7 +171,7 @@ class StreamingHistogram:
         return sum(self.counts)
 
     def observe(self, v: float) -> None:
-        self.counts[bisect_left(_EDGE_LIST, v)] += 1
+        self.counts[bisect_left(self.EDGES, v)] += 1
         self.sum += v
         if v < self.min:
             self.min = v
@@ -176,6 +200,7 @@ class StreamingHistogram:
         total = sum(counts)
         if not total:
             return 0.0
+        edges = self.EDGES
         rank = q * total
         cum = 0.0
         for i, c in enumerate(counts):
@@ -184,11 +209,11 @@ class StreamingHistogram:
             prev = cum
             cum += c
             if cum >= rank:
-                if i >= _N_EDGES:  # overflow bucket
+                if i >= len(edges):  # overflow bucket
                     return self.max
-                lo = EDGES_S[i - 1] if i else \
-                    min(self.min, EDGES_S[0])
-                hi = EDGES_S[i]
+                lo = edges[i - 1] if i else \
+                    min(self.min, edges[0])
+                hi = edges[i]
                 frac = (rank - prev) / c
                 return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
         return self.max
@@ -215,18 +240,36 @@ class StreamingHistogram:
         return h
 
 
-def cumulative_buckets(counts: list) -> "list[tuple[str, int]]":
+#: batch-size bucket ruler: powers of two 1..16384 + overflow. Group
+#: commit and apply batches are small integers, and the question the
+#: histogram answers is "how often did the batcher coalesce ≥ k
+#: writes" — a log-2 ruler reads as that directly.
+SIZE_EDGES = tuple(float(1 << i) for i in range(15))
+
+
+class SizeHistogram(StreamingHistogram):
+    """Batch-size histogram: the same streaming machinery on the
+    power-of-two ruler. Values are entry counts, not seconds."""
+
+    __slots__ = ()
+
+    EDGES = list(SIZE_EDGES)
+
+
+def cumulative_buckets(counts: list,
+                       edges: tuple = EDGES_S) -> "list[tuple[str, int]]":
     """(le_label, cumulative_count) pairs for prometheus histogram
-    exposition: le in seconds (%.9g), the overflow bucket as "+Inf".
-    The one shared definition of the cumulative-le encoding — both
-    exporters (PerfRegistry.prometheus, telemetry.Metrics.prometheus)
-    emit from this so they cannot drift."""
+    exposition: le formatted %.9g, the overflow bucket as "+Inf".
+    The one shared definition of the cumulative-le encoding — every
+    exporter (PerfRegistry.prometheus for both latency and batch-size
+    families, telemetry.Metrics.prometheus) emits from this so they
+    cannot drift."""
     out = []
     cum = 0
+    n = len(edges)
     for i, c in enumerate(counts):
         cum += c
-        out.append((f"{EDGES_S[i]:.9g}" if i < _N_EDGES else "+Inf",
-                    cum))
+        out.append((f"{edges[i]:.9g}" if i < n else "+Inf", cum))
     return out
 
 
@@ -294,7 +337,7 @@ class Ledger:
     sum to ≤ the end-to-end latency (pinned in tier-1)."""
 
     __slots__ = ("kind", "t0_pc", "t0_wall", "stages", "depth",
-                 "mark", "e2e")
+                 "mark", "e2e", "trace", "node", "mirror_min_ms")
 
     def __init__(self, kind: str, read_s: float = 0.0) -> None:
         now = time.perf_counter()
@@ -309,6 +352,14 @@ class Ledger:
         self.depth = 0
         self.mark = now  # free-use timestamp (async commit-wait seam)
         self.e2e = 0.0
+        # cross-node stitching (PR 19): when set, the mirrored stage
+        # spans carry trace=/node= tags so per-node rings merge into
+        # one Perfetto timeline. mirror_min_ms overrides SPAN_MIN_MS
+        # per ledger (the raft commit ledger sets 0.0: commit batches
+        # are rare relative to requests and always worth a flamegraph).
+        self.trace: Optional[str] = None
+        self.node: Optional[str] = None
+        self.mirror_min_ms: Optional[float] = None
         if read_s > 0.0:
             self.stages.append((f"{kind}.read", 0.0, read_s, 0))
 
@@ -433,7 +484,9 @@ def close(led: Optional[Ledger]) -> None:
                     sum(s[2] for s in led.stages if s[3] == 0))
     if LEDGER_RING.maxlen:
         LEDGER_RING.append(led)
-    if led.e2e * 1000.0 >= SPAN_MIN_MS and led.stages:
+    min_ms = SPAN_MIN_MS if led.mirror_min_ms is None \
+        else led.mirror_min_ms
+    if led.e2e * 1000.0 >= min_ms and led.stages:
         led.t0_wall = time.time() - led.e2e
         _emit_stage_spans(led)
 
@@ -452,9 +505,14 @@ def _emit_stage_spans(led: Ledger) -> None:
         from consul_tpu.utils import trace as trace_mod
 
         emit = trace_mod.default.emit
+        extra: dict[str, Any] = {}
+        if led.trace is not None:
+            extra["trace"] = led.trace
+        if led.node is not None:
+            extra["node"] = led.node
         for name, off, dur, depth in led.stages:
             emit(name, led.t0_wall + off, dur * 1000.0,
-                 stage=True, depth=depth, kind=led.kind)
+                 stage=True, depth=depth, kind=led.kind, **extra)
     except Exception:  # noqa: BLE001 — observability never raises
         pass
 
@@ -482,6 +540,11 @@ class PerfRegistry:
         # queries get a dedicated thread each (rpc.py), so without
         # reaping, _shards would grow one entry per query forever
         self._retired: dict[str, StreamingHistogram] = {}
+        # batch-size histograms: same per-thread sharding, separate
+        # namespace (values are counts, not seconds)
+        self._size_shards: list[
+            tuple[threading.Thread, dict[str, SizeHistogram]]] = []
+        self._size_retired: dict[str, SizeHistogram] = {}
         self._gauges: dict[str, float] = {}
         self._gauge_fns: dict[str, Callable[[], float]] = {}
 
@@ -500,6 +563,24 @@ class PerfRegistry:
         if h is None:
             h = shard[name] = StreamingHistogram()
         h.observe(seconds)
+
+    def size_observe(self, name: str, n: float) -> None:
+        """Observe a batch size (an entry count) into the size-
+        histogram namespace — same lock-free per-thread sharding as
+        observe()."""
+        if not _armed:
+            return
+        try:
+            shard = self._tls.sizes
+        except AttributeError:
+            shard = self._tls.sizes = {}
+            with self._lock:
+                self._size_shards.append((threading.current_thread(),
+                                          shard))
+        h = shard.get(name)
+        if h is None:
+            h = shard[name] = SizeHistogram()
+        h.observe(float(n))
 
     def gauge_set(self, name: str, value: float) -> None:
         if not _armed:
@@ -540,25 +621,32 @@ class PerfRegistry:
         accumulator first and dropped: they have no writer anymore, so
         the fold is exact, and a thread-per-blocking-query server stays
         at O(live threads) shards instead of growing forever."""
-        agg: dict[str, StreamingHistogram] = {}
+        return self._merge_shards(self._shards, self._retired,
+                                  StreamingHistogram)
+
+    def _merged_sizes(self) -> dict[str, SizeHistogram]:
+        return self._merge_shards(self._size_shards,
+                                  self._size_retired, SizeHistogram)
+
+    def _merge_shards(self, shards_list, retired, cls):
+        agg: dict[str, Any] = {}
         with self._lock:
-            if any(not t.is_alive() for t, _ in self._shards):
+            if any(not t.is_alive() for t, _ in shards_list):
                 live = []
-                for t, shard in self._shards:
+                for t, shard in shards_list:
                     if t.is_alive():
                         live.append((t, shard))
                         continue
                     for name, h in shard.items():
-                        acc = self._retired.get(name)
+                        acc = retired.get(name)
                         if acc is None:
-                            acc = self._retired[name] = \
-                                StreamingHistogram()
+                            acc = retired[name] = cls()
                         acc.merge(h)
-                self._shards[:] = live
-            for name, h in self._retired.items():
-                acc = agg[name] = StreamingHistogram()
+                shards_list[:] = live
+            for name, h in retired.items():
+                acc = agg[name] = cls()
                 acc.merge(h)
-            shards = [s for _, s in self._shards]
+            shards = [s for _, s in shards_list]
         for shard in shards:
             for name in list(shard):
                 h = shard.get(name)
@@ -566,7 +654,7 @@ class PerfRegistry:
                     continue
                 acc = agg.get(name)
                 if acc is None:
-                    acc = agg[name] = StreamingHistogram()
+                    acc = agg[name] = cls()
                 acc.merge(h)
         return agg
 
@@ -576,6 +664,9 @@ class PerfRegistry:
         hists = self._merged()
         return {"hists": {n: h.state()
                           for n, h in sorted(hists.items())},
+                "sizes": {n: h.state()
+                          for n, h in
+                          sorted(self._merged_sizes().items())},
                 "gauges": self._gauges_now()}
 
     def snapshot(self, min_count: int = 0,
@@ -607,12 +698,32 @@ class PerfRegistry:
                     [EDGES_S[i] if i < _N_EDGES else None, c]
                     for i, c in enumerate(st["counts"]) if c],
             }
+        sizes: dict[str, Any] = {}
+        for name, h in sorted(self._merged_sizes().items()):
+            if prefix and not name.startswith(prefix):
+                continue
+            st = h.state()
+            if st["count"] < max(min_count, 1):
+                continue
+            sizes[name] = {
+                "Count": st["count"],
+                "Sum": int(st["sum"]),
+                "Min": st["min"] or 0.0,
+                "Max": st["max"],
+                "P50": round(h.quantile(0.50), 2),
+                "P90": round(h.quantile(0.90), 2),
+                "P99": round(h.quantile(0.99), 2),
+                "Buckets": [
+                    [SIZE_EDGES[i] if i < len(SIZE_EDGES) else None, c]
+                    for i, c in enumerate(st["counts"]) if c],
+            }
         return {
             "Enabled": _armed,
             "BucketScheme": {"PerDecade": BUCKETS_PER_DECADE,
                              "LoS": LO_S, "HiS": HI_S,
                              "NumBuckets": N_BUCKETS},
             "Stages": stages,
+            "Sizes": sizes,
             "Gauges": {k: gauges[k] for k in sorted(gauges)},
         }
 
@@ -636,9 +747,28 @@ class PerfRegistry:
                          f'{{stage="{name}"}} {st["sum"]:.9g}')
             lines.append('consul_perf_stage_duration_seconds_count'
                          f'{{stage="{name}"}} {st["count"]}')
+        size_hists = self._merged_sizes()
+        typed = False
+        for name in sorted(size_hists):
+            st = size_hists[name].state()
+            if not st["count"]:
+                continue
+            if not typed:
+                lines.append("# TYPE consul_perf_batch_size histogram")
+                typed = True
+            for le, cum in cumulative_buckets(st["counts"],
+                                              SIZE_EDGES):
+                lines.append('consul_perf_batch_size_bucket'
+                             f'{{hist="{name}",le="{le}"}} {cum}')
+            lines.append('consul_perf_batch_size_sum'
+                         f'{{hist="{name}"}} {st["sum"]:.9g}')
+            lines.append('consul_perf_batch_size_count'
+                         f'{{hist="{name}"}} {st["count"]}')
         for name in sorted(gauges):
+            # ':' appears in per-peer gauge names (host:port) and is
+            # illegal in a prometheus metric name
             metric = "consul_perf_" + name.replace(".", "_") \
-                .replace("-", "_")
+                .replace("-", "_").replace(":", "_")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {gauges[name]:g}")
         return "\n".join(lines) + "\n"
@@ -650,7 +780,10 @@ class PerfRegistry:
             # orphan their future observations)
             for _, shard in self._shards:
                 shard.clear()
+            for _, shard in self._size_shards:
+                shard.clear()
             self._retired.clear()
+            self._size_retired.clear()
             self._gauges.clear()
 
 
@@ -714,8 +847,13 @@ def stage_report(cur: dict[str, Any], prev: Optional[dict[str, Any]],
         round(ssum.quantile(0.5) / e2e_p50, 4)
         if ssum is not None and ssum.count else None)
     out["share_mean_total"] = round(sum_mean / e2e_mean, 4)
-    for name in ("store.read", "raft.commit_wait",
-                 "raft.apply_batch", "raft.fsm.apply"):
+    for name in ("store.read", "raft.commit_wait", "raft.append",
+                 "raft.fsync", "raft.replicate.rtt",
+                 "raft.quorum_wait", "raft.apply_batch",
+                 "raft.fsm.apply", "raft.follower.append",
+                 "raft.follower.fsync"):
+        if name in TOP_STAGES.get(kind, ()):
+            continue  # already reported as a depth-0 stage above
         h = hists.get(name)
         if h is None or not h.count:
             continue

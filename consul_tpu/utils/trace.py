@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import contextvars
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -46,6 +47,41 @@ from typing import Any, Callable, Optional
 #: per-thread (and per-async-context) open-span stack
 _stack: contextvars.ContextVar[tuple] = contextvars.ContextVar(
     "consul_tpu_trace_stack", default=())
+
+# ------------------------------------------------ cross-node trace ids
+#
+# PR 19: a trace id is minted ONCE at the client-facing socket
+# (rpc.py's dispatch seams) and then rides, verbatim, (a) the mux
+# leader-forward frames as ``args["_trace"]`` and (b) the replicated
+# log entries as ``entry["trace"]`` inside AppendEntries — so every
+# node that touches the write tags its spans with the same id and the
+# per-node rings stitch into one Perfetto timeline. The id is an
+# opaque 16-hex string; propagation is schemaless msgpack, so old
+# nodes simply ignore the key.
+
+_tls = threading.local()
+
+
+def mint() -> str:
+    """A fresh 16-hex trace id (64 random bits — collision-safe at
+    ring scale, short enough to eyeball in a Perfetto search box)."""
+    return os.urandom(8).hex()
+
+
+def set_current(trace_id: Optional[str]) -> Optional[str]:
+    """Bind the current thread's trace id (the dispatch seams set it
+    around handler invocation). Returns the previous binding so
+    nested/re-entrant callers can restore it."""
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = trace_id
+    return prev
+
+
+def current_trace() -> Optional[str]:
+    """The trace id bound to this thread, or None outside a traced
+    request (the group-commit batcher reads this on the caller's
+    thread to stamp pending writes)."""
+    return getattr(_tls, "trace", None)
 
 
 class Span:
@@ -244,6 +280,29 @@ class Tracer:
             events.append({"name": "thread_name", "ph": "M",
                            "pid": pid, "tid": tid,
                            "args": {"name": name}})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def to_perfetto_nodes(self,
+                          spans: Optional[list[dict[str, Any]]] = None,
+                          default_node: str = "agent"
+                          ) -> dict[str, Any]:
+        """The merged cross-node view: spans grouped by their ``node``
+        tag, one Perfetto PROCESS row per node (stable pids in node
+        order), so a replicated write renders as leader and follower
+        timelines stacked in one viewer — search the trace id to light
+        up every span of one request across all of them. Untagged
+        spans land under ``default_node`` (the serving agent's own
+        plane)."""
+        spans = self.recent() if spans is None else spans
+        groups: dict[str, list[dict[str, Any]]] = {}
+        for s in spans:
+            node = str(s.get("tags", {}).get("node", default_node))
+            groups.setdefault(node, []).append(s)
+        events: list[dict[str, Any]] = []
+        for pid, node in enumerate(sorted(groups), start=2):
+            events.extend(self.to_perfetto(
+                groups[node], pid=pid,
+                process_name=f"consul-tpu-{node}")["traceEvents"])
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -1252,3 +1252,53 @@ def test_monitor_slow_reader_sheds_instead_of_blocking(agent, client):
         return len(log_mod._sinks) == sinks_before
     wait_for(poke, timeout=15, what="monitor sink detached after "
                                     "client disconnect")
+
+
+def test_perf_prometheus_commit_pipeline_families(agent, client):
+    """PR 19 exposition parity: the commit-pipeline observatory's new
+    families ride the SAME /v1/agent/perf?format=prometheus dump as
+    the serving-plane stages — batch-size histograms as a native
+    histogram family keyed by a `hist` label, raft stage windows under
+    the existing stage family, and the leader's log-depth gauge."""
+    client.kv_put("perf/raftprom", b"p" * 64)
+    text = client.get_raw("/v1/agent/perf",
+                          format="prometheus").decode()
+    # group-commit and apply batch sizes: cumulative le buckets
+    assert "# TYPE consul_perf_batch_size histogram" in text
+    assert 'consul_perf_batch_size_bucket{hist="raft.commit.batch"' \
+        in text
+    assert 'consul_perf_batch_size_bucket{hist="raft.apply.batch"' \
+        in text
+    assert 'consul_perf_batch_size_count{hist="raft.commit.batch"}' \
+        in text
+    # per-entry commit-pipeline stages join the stage family (the
+    # replicate window needs followers, so a dev agent has none —
+    # single-voter quorum is still a measured wait)
+    for st in ("raft.append", "raft.fsync", "raft.quorum_wait",
+               "raft.apply_batch"):
+        assert f'consul_perf_stage_duration_seconds_bucket' \
+               f'{{stage="{st}"' in text, st
+    # the leader's replication log depth gauge
+    assert "consul_perf_raft_log_depth" in text
+    # and the JSON view serves the same batch histograms
+    snap = client.get("/v1/agent/perf")
+    assert "raft.commit.batch" in snap["Sizes"]
+    assert snap["Sizes"]["raft.commit.batch"]["Count"] >= 1
+
+
+def test_trace_group_node_merged_view(agent, client):
+    """?format=perfetto&group=node renders the merged cross-node
+    timeline: one Perfetto process row per node tag (a dev agent's own
+    spans land under its node row / the default agent row)."""
+    client.kv_put("trace/group", b"1")
+    pf = client.get("/v1/agent/trace", format="perfetto",
+                    group="node")
+    procs = {e["args"]["name"] for e in pf["traceEvents"]
+             if e["name"] == "process_name"}
+    assert procs and all(p.startswith("consul-tpu-") for p in procs)
+    # pids are stable from 2 in node order
+    assert min(e["pid"] for e in pf["traceEvents"]) == 2
+    # validation: an unknown grouping is a 400, never a silent default
+    with pytest.raises(APIError) as ei:
+        client.get("/v1/agent/trace", group="cluster")
+    assert ei.value.code == 400

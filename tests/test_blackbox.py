@@ -96,7 +96,16 @@ def test_layout_registry_digest_pinned():
     # row schema (USERS_SURFACE_KEYS). Consumers: sim/costmodel.py
     # _validate_users/latest_users_guard, consul_tpu/serve/users.py,
     # bench.py --users/--check-regression --family USERS.
-    assert registry.layout_digest() == "c0deff21a8f5a60c"
+    # PR 19 re-pin (was c0deff21a8f5a60c): the digest now additionally
+    # covers the consensus-plane commit-path observatory's record
+    # contract — the RAFT ledger family, the leader commit pipeline's
+    # depth-0 attribution windows (RAFT_STAGES), the per-rung row
+    # schema (RAFT_RUNG_KEYS), and the minimum stage-coverage fraction
+    # the validator refuses below (RAFT_COVERAGE_MIN). Consumers:
+    # sim/costmodel.py _validate_raft/latest_raft_guard,
+    # consul_tpu/serve/raftbench.py, consul_tpu/raft/raft.py's ledger
+    # partition, bench.py --raft/--check-regression --family RAFT.
+    assert registry.layout_digest() == "e2a2650d8f4af040"
 
 
 def test_reduce_lane_layout_pinned():

@@ -610,11 +610,17 @@ def test_debug_bundle_capture_and_validation(agent, tmp_path):
             manifest = json.loads(
                 tar.extractfile("manifest.json").read())
             spans = json.loads(tar.extractfile("spans.json").read())
+            crossnode = json.loads(tar.extractfile(
+                "trace.crossnode.perfetto.json").read())
     assert set(cli_mod.DEBUG_BUNDLE_REQUIRED) <= names
     assert "flight.json" not in names  # -sim-rounds 0 disables it
     assert not any("error" in meta
                    for meta in manifest["files"].values()), manifest
     assert isinstance(spans["Spans"], list)
+    # PR 19: the bundle carries the merged cross-node trace view
+    # (?group=node) next to the flat perfetto export
+    assert "trace.crossnode.perfetto.json" in names
+    assert isinstance(crossnode["traceEvents"], list)
 
 
 def test_debug_self_check_smoke():

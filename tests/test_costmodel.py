@@ -515,3 +515,129 @@ def test_bench_users_flag_combinations_exit_2(tmp_path):
                env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
     assert r.returncode == 2, r.stderr
     assert "never fabricated" in r.stderr
+
+
+# --------------------------------------------- RAFT family (PR 19)
+
+
+def _raft_payload():
+    """Minimal schema-valid RAFT record: two measured rungs whose
+    stage attribution covers the commit e2e, one honest skip."""
+    shares = {"raft.append": 0.18, "raft.replicate.rtt": 0.55,
+              "raft.quorum_wait": 0.05, "raft.apply_batch": 0.17}
+    stage_p50 = {"raft.append": 0.45, "raft.replicate.rtt": 1.38,
+                 "raft.quorum_wait": 0.13, "raft.apply_batch": 0.43}
+
+    def rung(target, achieved):
+        return {"target_rps": target, "duration_s": 4.0,
+                "offered": int(target * 4), "completed": int(achieved * 4),
+                "errors": 0, "achieved_rps": achieved,
+                "p50_ms": 3.1, "p99_ms": 11.0,
+                "commit_p50_ms": 2.5, "commit_p99_ms": 9.0,
+                "stage_p50_ms": dict(stage_p50),
+                "stage_share_p50": dict(shares),
+                "coverage_p50": 0.95,
+                "commit_batch": {"count": 400, "mean": 2.1,
+                                 "p50": 1.8, "p99": 6.0, "max": 9.0},
+                "apply_batch": {"count": 1200, "mean": 2.1,
+                                "p50": 1.8, "p99": 6.0, "max": 9.0},
+                "follower_lag": {"127.0.0.1:9001": 0.0,
+                                 "127.0.0.1:9002": 1.0},
+                "window_rps": [achieved, achieved + 5, achieved - 5]}
+
+    return {
+        "metric": "raft_commit_path", "unit": "put/s",
+        "cluster": {"servers": 3, "sync": True,
+                    "payload_bytes": [64, 1024, 16384]},
+        "ladder": [rung(500.0, 498.0), rung(1000.0, 991.0),
+                   {"skipped": True, "target_rps": 2000.0,
+                    "reason": "past host budget: saturated at 1000"}],
+        "headline": {"value": 991.0,
+                     "samples": [991.0, 996.0, 986.0],
+                     "stability_band": 0.10, "headline": 991.0},
+        "headline_rung": {"target_rps": 1000.0},
+    }
+
+
+def test_raft_validator_rejects_by_name(tmp_path):
+    """A RAFT record with an attribution blind spot or a missing
+    stage fails BY KEY NAME; a corrupt file on disk fails BY FILENAME
+    — the ledger never shrugs."""
+    good = _raft_payload()
+    costmodel.validate_record("RAFT_r01.json", good)
+    # a rung whose stage windows explain <90% of the commit e2e p50
+    # is a blind spot, not data
+    blind = json.loads(json.dumps(good))
+    blind["ladder"][0]["coverage_p50"] = 0.62
+    with pytest.raises(LedgerError, match=r"coverage 0\.62.*blind"):
+        costmodel.validate_record("RAFT_r01.json", blind)
+    # dropping a commit-pipeline window is named
+    hole = json.loads(json.dumps(good))
+    del hole["ladder"][1]["stage_share_p50"]["raft.quorum_wait"]
+    with pytest.raises(LedgerError, match="raft.quorum_wait"):
+        costmodel.validate_record("RAFT_r01.json", hole)
+    # an unknown stage name can't sneak into the schema
+    alien = json.loads(json.dumps(good))
+    alien["ladder"][0]["stage_share_p50"]["raft.vibes"] = 0.1
+    with pytest.raises(LedgerError, match="raft.vibes"):
+        costmodel.validate_record("RAFT_r01.json", alien)
+    # a measured rung missing a per-rung key is named
+    thin = json.loads(json.dumps(good))
+    del thin["ladder"][0]["follower_lag"]
+    with pytest.raises(LedgerError, match="follower_lag"):
+        costmodel.validate_record("RAFT_r01.json", thin)
+    # every rung skipped = no record, not an empty ladder
+    all_skip = json.loads(json.dumps(good))
+    all_skip["ladder"] = [all_skip["ladder"][2]]
+    with pytest.raises(LedgerError, match="every rung skipped"):
+        costmodel.validate_record("RAFT_r01.json", all_skip)
+    # corrupt ON DISK: load_ledger names the file
+    (tmp_path / "RAFT_r01.json").write_text("{not json")
+    with pytest.raises(LedgerError, match="RAFT_r01.json"):
+        costmodel.load_ledger(str(tmp_path))
+
+
+def test_raft_history_row_and_guard(tmp_path):
+    """--history renders a RAFT headline row, and the
+    --check-regression guard envelope re-derives the headline rung's
+    achieved put/s (never a fabricated number)."""
+    (tmp_path / "RAFT_r01.json").write_text(
+        json.dumps(_raft_payload()))
+    records = costmodel.load_ledger(str(tmp_path))
+    rows = costmodel.history_rows(records)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["file"] == "RAFT_r01.json"
+    assert row["metric"] == "raft_commit_path"
+    assert row["value"] == 991.0
+    assert "commit p50" in row["note"] and "coverage 95%" in row["note"]
+    table = costmodel.format_history(rows)
+    assert "RAFT_r01.json" in table
+    guard = costmodel.latest_raft_guard(records)
+    assert guard["target_rps"] == 1000.0
+    assert guard["value"] == 991.0
+    assert guard["cluster"]["servers"] == 3
+    # no RAFT record → None, never a synthetic baseline
+    assert costmodel.latest_raft_guard([]) is None
+
+
+def test_bench_raft_flag_combinations_exit_2(tmp_path):
+    """--raft is a top-level mode: combining it with another mode, a
+    checkpoint flag, or pointing --family RAFT at a metric the guard
+    cannot RE-MEASURE exits 2 with usage before anything runs."""
+    for argv in (("--raft", "--mesh"), ("--raft", "--sweep"),
+                 ("--raft", "--chaos"), ("--raft", "--twin"),
+                 ("--raft", "--users"), ("--raft", "--autotune"),
+                 ("--profile", "--raft"),
+                 ("--raft", "--check-regression"),
+                 ("--raft", "--ckpt-dir", "/tmp/nope"),
+                 ("--check-regression", "--family", "RAFT",
+                  "--metric", "users_open_loop")):
+        r = _bench(*argv)
+        assert r.returncode == 2, (argv, r.stderr)
+        assert "usage:" in r.stderr, (argv, r.stderr)
+    # and with no recorded RAFT ledger the guard refuses to invent
+    r = _bench("--check-regression", "--family", "RAFT",
+               env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
+    assert r.returncode == 2, r.stderr
+    assert "never fabricated" in r.stderr

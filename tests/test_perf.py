@@ -48,7 +48,14 @@ def test_stage_taxonomy_pinned():
         "dns.read", "dns.lookup", "dns.encode", "dns.write",
         "dns.e2e", "dns.stages_sum",
         "store.read",
-        "raft.commit_wait", "raft.apply_batch", "raft.fsm.apply",
+        # the commit-pipeline taxonomy (PR 19): disjoint depth-0
+        # windows of the leader's group-commit batch, plus the
+        # follower-side write stages kept separate so in-process
+        # multi-node clusters don't pollute the leader's critical path
+        "raft.commit_wait", "raft.append", "raft.fsync",
+        "raft.replicate.rtt", "raft.quorum_wait", "raft.apply_batch",
+        "raft.fsm.apply", "raft.e2e", "raft.stages_sum",
+        "raft.follower.append", "raft.follower.fsync",
     )
     for kind, tops in perf.TOP_STAGES.items():
         for name in tops:
@@ -192,7 +199,8 @@ def test_kill_switch_disarms_everything():
         assert perf.stage("rpc.handler") is perf._NOOP
         reg.observe("x", 1.0)
         reg.gauge_add("g", 1)
-        assert reg.raw() == {"hists": {}, "gauges": {}}
+        reg.size_observe("raft.commit.batch", 4)
+        assert reg.raw() == {"hists": {}, "gauges": {}, "sizes": {}}
         assert reg.snapshot()["Enabled"] is False
         perf.arm()
         reg.observe("x", 1.0)
