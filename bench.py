@@ -79,7 +79,7 @@ def _usage(err: str) -> None:
           "[--ckpt-dir D [--resume]]\n"
           "       bench.py --coords [--smoke]\n"
           "       bench.py --users [--smoke]\n"
-          "       bench.py --raft [--smoke]\n"
+          "       bench.py --raft [--smoke] [--raft-shards N]\n"
           "       bench.py --autotune [--smoke]\n"
           "       bench.py --history\n"
           "       bench.py --check-regression [--smoke] "
@@ -284,6 +284,12 @@ def _check_serve_regression(smoke: bool, records,
 
     import bench_kv
 
+    # the recorded op blend is part of the workload contract: a
+    # write-heavy SERVE record must be re-measured write-heavy, not
+    # silently against the read-leaning default
+    mix_rec = rec.get("mix")
+    mix = (tuple(int(mix_rec[k]) for k in ("put", "get", "get_stale"))
+           if mix_rec else bench_kv.DEFAULT_MIX)
     windows = 5
     duration = (2.0 if smoke else 5.0) * windows
     servers = []
@@ -291,7 +297,7 @@ def _check_serve_regression(smoke: bool, records,
         servers, leader, follower = bench_kv.build_cluster()
         rep = bench_kv.run_sustained(
             leader, follower, [concurrency], duration,
-            herd=herd, windows=windows)
+            herd=herd, windows=windows, mix=mix)
     finally:
         for s in servers:
             s.shutdown()
@@ -307,6 +313,7 @@ def _check_serve_regression(smoke: bool, records,
         "metric": metric,
         "concurrency": concurrency,
         "herd": herd,
+        "mix": mix_rec,
         "loadavg_1m": _loadavg_1m(),
         "baseline_file": base["file"],
         "fresh_p50_ms": row.get("p50_ms"),
@@ -419,12 +426,17 @@ def _check_raft_regression(smoke: bool, records,
 
     windows = 5
     duration = (2.0 if smoke else 5.0) * windows
+    # the recorded topology IS the workload contract: a sharded
+    # record is re-measured against the same shard count, never
+    # silently re-run single-group
+    shards = int(base["cluster"].get("raft_shards", 1))
     cluster = None
     try:
         cluster = raftbench.build_cluster(
-            n=int(base["cluster"].get("servers", 3)))
+            n=int(base["cluster"].get("servers", 3)), shards=shards)
         row = raftbench.run_put_rung(cluster, base["target_rps"],
-                                     duration, windows=windows)
+                                     duration, windows=windows,
+                                     shards=shards)
     finally:
         if cluster is not None:
             cluster.close()
@@ -438,6 +450,7 @@ def _check_raft_regression(smoke: bool, records,
     print(json.dumps({
         "metric": "raft_commit_path",
         "target_rps": base["target_rps"],
+        "raft_shards": shards,
         "loadavg_1m": _loadavg_1m(),
         "baseline_file": base["file"],
         "fresh_p50_ms": row.get("p50_ms"),
@@ -1698,19 +1711,23 @@ def run_users_bench(smoke: bool) -> None:
         _record_next("USERS", payload)
 
 
-def run_raft_bench(smoke: bool) -> None:
-    """`bench.py --raft [--smoke]`: the consensus-plane commit-path
-    observatory (consul_tpu/serve/raftbench.py). A real 3-server
-    loopback cluster with on-disk fsync'ing WALs, driven by an
-    ascending open-loop KV PUT ladder with mixed entry sizes; each
-    rung records client latency from the INTENDED send time plus the
-    leader's per-stage commit-pipeline attribution (append | fsync |
-    replicate.rtt | quorum_wait | apply_batch), group-commit and
-    apply batch-size distributions, and per-follower replication lag.
-    The validator refuses any rung whose depth-0 stage windows
-    explain < 90% of the commit e2e p50 — the observatory must not
-    ship blind spots as data. Recorded as RAFT_r*.json (full runs
-    only; --smoke prints the payload). Pure CPU."""
+def run_raft_bench(smoke: bool, shards: int = 1) -> None:
+    """`bench.py --raft [--smoke] [--raft-shards N]`: the
+    consensus-plane commit-path observatory
+    (consul_tpu/serve/raftbench.py). A real 3-server loopback cluster
+    with on-disk fsync'ing WALs, driven by an ascending open-loop KV
+    PUT ladder with mixed entry sizes; each rung records client
+    latency from the INTENDED send time plus the leader's per-stage
+    commit-pipeline attribution (append | fsync | replicate.rtt |
+    quorum_wait | apply_batch), group-commit and apply batch-size
+    distributions, and per-follower replication lag. The validator
+    refuses any rung whose depth-0 stage windows explain < 90% of the
+    commit e2e p50 — the observatory must not ship blind spots as
+    data. ``--raft-shards N`` runs the multi-raft store (PR 20): N
+    consensus groups behind the digest-pinned key router, each rung
+    additionally carrying per-shard attribution rows held to the same
+    coverage floor. Recorded as RAFT_r*.json (full runs only; --smoke
+    prints the payload). Pure CPU."""
     from consul_tpu.serve import raftbench
 
     if smoke:
@@ -1719,10 +1736,10 @@ def run_raft_bench(smoke: bool) -> None:
     else:
         targets = [100.0, 250.0, 500.0, 1000.0, 2000.0, 4000.0]
         duration, windows = 6.0, 4
-    cluster = raftbench.build_cluster(n=3)
+    cluster = raftbench.build_cluster(n=3, shards=shards)
     try:
         out = raftbench.run_put_ladder(cluster, targets, duration,
-                                       windows=windows)
+                                       windows=windows, shards=shards)
     finally:
         cluster.close()
     payload = {
@@ -1731,6 +1748,7 @@ def run_raft_bench(smoke: bool) -> None:
         "host_cores": os.cpu_count(),
         "loadavg_1m": _loadavg_1m(),
         "cluster": {"servers": 3, "sync": True,
+                    "raft_shards": shards,
                     "payload_bytes": list(raftbench.PAYLOAD_BYTES)},
         **out,
     }
@@ -1787,6 +1805,23 @@ def main() -> None:
             and "--check-regression" not in argv:
         _usage("--family/--metric select what --check-regression "
                "guards; they apply to no other mode")
+    raft_shards_sel = _flag_value("--raft-shards")
+    raft_shards = 1
+    if raft_shards_sel is not None:
+        if "--raft" not in argv:
+            # --check-regression --family RAFT reads the shard count
+            # from the RECORD — an override flag there would let the
+            # guard re-measure a different topology than the baseline
+            _usage("--raft-shards applies to --raft only (the "
+                   "regression guard re-reads the recorded topology)")
+        try:
+            raft_shards = int(raft_shards_sel)
+        except ValueError:
+            _usage(f"--raft-shards needs a positive integer, "
+                   f"got {raft_shards_sel!r}")
+        if raft_shards < 1:
+            _usage(f"--raft-shards needs a positive integer, "
+                   f"got {raft_shards_sel!r}")
     if family is not None and family not in _GUARDED_FAMILIES:
         _usage(f"--family must be one of "
                f"{'/'.join(_GUARDED_FAMILIES)} (the families "
@@ -1811,7 +1846,7 @@ def main() -> None:
         run_users_bench(smoke)
         return
     if "--raft" in argv:
-        run_raft_bench(smoke)
+        run_raft_bench(smoke, shards=raft_shards)
         return
     if "--history" in argv:
         run_history()

@@ -195,6 +195,14 @@ def build_cluster(n: int = 3):
 #: every server (queue-depth visible as the rpc.blocking.parked gauge)
 HERD = {"threads": 16, "keys": 8, "touch_interval_s": 0.25}
 
+#: the sustained ladder's op-cycle weights (PUT, GET, stale-GET).
+#: DEFAULT_MIX is the PR 10 read-leaning blend every SERVE_r01/r02
+#: rung used; WRITE_HEAVY_MIX (--write-heavy, PR 20) inverts it so
+#: the raft commit path — not the read path — is what the ladder
+#: saturates (the SERVE_r03 multi-raft evidence).
+DEFAULT_MIX = (1, 2, 2)
+WRITE_HEAVY_MIX = (3, 1, 1)
+
 
 #: Jain's fairness index over per-client throughput: 1.0 = perfectly
 #: fair, 1/n = one client got everything (shared with the open-loop
@@ -348,14 +356,21 @@ def run_herd_scale(leader, follower, n, keys=None, sockets=16,
 
 
 def _level_pass(leader, follower, concurrency, duration,
-                open_rps=None):
+                open_rps=None, mix=DEFAULT_MIX):
     """One concurrency level of the sustained ladder: `concurrency`
-    clients running the mixed workload (1 PUT : 2 GET : 2 stale-GET)
-    for `duration` seconds. Closed loop by default; `open_rps` total
-    switches to scheduled open-loop arrivals with latency measured
-    from the INTENDED send time (no coordinated omission). Returns
+    clients running the mixed workload (`mix` = (PUT, GET, stale-GET)
+    cycle weights; the default 1:2:2 is the PR 10 read-leaning blend,
+    WRITE_HEAVY_MIX is 3:1:1) for `duration` seconds. Closed loop by
+    default; `open_rps` total switches to scheduled open-loop
+    arrivals with latency measured from the INTENDED send time (no
+    coordinated omission). Returns
     (per_client_ops, latencies_with_stamps, errors, wall)."""
     from consul_tpu.server.rpc import ConnPool
+
+    # op schedule for one cycle: n_put PUTs then the reads — the
+    # modulus walk below keeps every client on the same blend
+    n_put, n_get, n_stale = mix
+    cycle = ("put",) * n_put + ("get",) * n_get + ("stale",) * n_stale
 
     # one mux session per (client, server): a single-threaded
     # closed-loop client never has two requests in flight, so the
@@ -370,13 +385,13 @@ def _level_pass(leader, follower, concurrency, duration,
     t_end = [0.0]
 
     def one_op(w, i, pool):
-        kind = i % 5
-        if kind == 0:
+        kind = cycle[i % len(cycle)]
+        if kind == "put":
             pool.call(leader.rpc.addr, "KVS.Apply", {
                 "Op": "set",
                 "DirEnt": {"Key": f"sust/{w}/{i % 64}",
                            "Value": b"x" * 64}})
-        elif kind in (1, 2):
+        elif kind == "get":
             pool.call(leader.rpc.addr, "KVS.Get",
                       {"Key": f"sust/{w}/{(i - 1) % 64}"})
         else:
@@ -427,13 +442,16 @@ def _level_pass(leader, follower, concurrency, duration,
 
 
 def run_sustained(leader, follower, levels, duration,
-                  open_rps=None, herd=HERD, windows=3):
+                  open_rps=None, herd=HERD, windows=3,
+                  mix=DEFAULT_MIX):
     """The sustained-load report: one pass per concurrency level with
     the blocking-query herd parked throughout. Per level: throughput,
     client-observed p50/p99, per-window rps samples, per-client
     fairness, and the SERVER-side per-stage latency attribution from
     the process-global perf registry (utils/perf.py stage_report —
-    the same histograms `/v1/agent/perf` serves)."""
+    the same histograms `/v1/agent/perf` serves). `mix` picks the
+    op-cycle blend and is recorded in the report so the regression
+    guard re-runs the SAME workload, never a silently different one."""
     from consul_tpu.utils import perf
 
     stop = threading.Event()
@@ -454,7 +472,7 @@ def run_sustained(leader, follower, levels, duration,
                 open_rps and concurrency == levels[-1]) else None
             lat, errors, wall = _level_pass(
                 leader, follower, concurrency, duration,
-                open_rps=use_open)
+                open_rps=use_open, mix=mix)
             snap1 = perf.default.raw()
             all_lat = sorted(x for lane in lat for _, x in lane)
             total = len(all_lat)
@@ -520,6 +538,7 @@ def run_sustained(leader, follower, levels, duration,
         "unit": "req/s",
         "host_cores": os.cpu_count(),
         "herd": dict(herd) if herd else None,
+        "mix": {"put": mix[0], "get": mix[1], "get_stale": mix[2]},
         "levels": out_levels,
         "throughput_latency_curve": curve,
         "perf_source": "process-global consul_tpu.utils.perf registry "
@@ -557,17 +576,18 @@ def main() -> None:
     concurrency = flag("--concurrency", int)
     levels_arg = flag("--levels", str)
     herd_n = flag("--herd", int)
+    write_heavy = "--write-heavy" in sys.argv
     if concurrency is None and levels_arg is None:
         # sustained-only flags must not be silently swallowed by the
         # legacy workload below (a --out that never writes looks like
         # a recorded run that wasn't)
         orphans = [n for n in ("--duration", "--open-loop", "--out",
-                               "--herd")
+                               "--herd", "--write-heavy")
                    if n in sys.argv]
         if orphans:
             print("usage: bench_kv.py --concurrency C [--levels a,b,c]"
                   " [--duration S] [--open-loop RPS] [--herd N] "
-                  "[--out F] — "
+                  "[--write-heavy] [--out F] — "
                   f"{', '.join(orphans)} require(s) --concurrency or "
                   "--levels", file=sys.stderr)
             sys.exit(2)
@@ -587,8 +607,10 @@ def main() -> None:
                     "touch_interval_s": 0.25}
         servers, leader, follower = build_cluster()
         try:
-            report = run_sustained(leader, follower, levels, duration,
-                                   open_rps=open_rps, herd=herd)
+            report = run_sustained(
+                leader, follower, levels, duration,
+                open_rps=open_rps, herd=herd,
+                mix=WRITE_HEAVY_MIX if write_heavy else DEFAULT_MIX)
             if herd_n is not None and herd_n > 64:
                 # the blocking-watcher scale pass: measured AFTER the
                 # ladder so its background churn never pollutes the

@@ -140,6 +140,13 @@ class RuntimeConfig:
     encrypt_key: str = ""  # base64 16/24/32-byte gossip key
 
     # Raft (reference: agent/consul/config.go:639-648)
+    # Multi-raft state store (PR 20): number of independent consensus
+    # groups. 1 = the classic single-group layout; >1 shards the KV
+    # keyspace over N groups (each with its own log/WAL/applier) with
+    # all non-KV tables anchored to shard 0. Must be identical on
+    # every server in the cluster (the shard router is part of the
+    # replicated contract).
+    raft_shards: int = 1
     raft_heartbeat_timeout: float = 1.0
     raft_election_timeout: float = 1.0
     raft_snapshot_interval: float = 30.0

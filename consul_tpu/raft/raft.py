@@ -68,10 +68,25 @@ class RaftNode:
         snapshot_fn: Optional[Callable[[], bytes]] = None,
         restore_fn: Optional[Callable[[bytes], None]] = None,
         seed: Optional[int] = None,
+        shard_id: Optional[int] = None,
+        txn_gate=None,
     ) -> None:
         import random
 
         self.id = node_id
+        # multi-raft shard identity (PR 20): shard_id=None is the
+        # classic single-group store and keeps every PR 19 ledger/gauge
+        # name byte-identical ("raft", "raft.append", ...). A sharded
+        # node prefixes its whole observability surface with
+        # "raft.shard.<id>." so the observatory attributes per shard.
+        self.shard_id = shard_id
+        self._px = "raft." if shard_id is None else f"raft.shard.{shard_id}."
+        self._ledger_kind = ("raft" if shard_id is None
+                             else f"raft.shard.{shard_id}")
+        # cross-shard fence gate (sharded.TxnGate): consulted by the
+        # applier when it reaches a "fence" log entry; None = fences
+        # apply as no-ops (single-group store never appends them)
+        self._txn_gate = txn_gate
         self.transport = transport
         self.apply_fn = apply_fn
         self.snapshot_fn = snapshot_fn
@@ -164,6 +179,24 @@ class RaftNode:
         # off the replication hot path (hashicorp/raft runFSM)
         self._apply_cv = threading.Condition(self._lock)
         self._applier: Optional[threading.Thread] = None
+        # pipelined commit path (PR 20, real clock + sync WAL only):
+        # append() skips the inline os.fsync and a dedicated group-sync
+        # thread runs the barrier OUTSIDE the raft lock while the
+        # replicators are already shipping the batch — raft.fsync and
+        # raft.replicate.rtt overlap instead of summing. Safety: the
+        # leader's self-vote in _advance_commit is gated on
+        # store.synced_index, so an unflushed leader never certifies
+        # its own entry (a follower quorum is durable regardless —
+        # followers fsync inline before acking).
+        self._pipeline_fsync = (self.store.sync
+                                and not isinstance(self.clock, SimClock))
+        self._fsync_cv = threading.Condition(self._lock)
+        self._fsync_thread: Optional[threading.Thread] = None
+        # lease-loss fencing (PR 20): a deposed leader that held a live
+        # quorum lease refuses consistent reads BY NAME until the lease
+        # it granted itself could have expired everywhere — the window
+        # in which a stale read could race the new leader's commits.
+        self._fence_until = 0.0
 
         # restore FSM from snapshot if present
         if self.store.snapshot_data is not None and restore_fn is not None:
@@ -186,6 +219,7 @@ class RaftNode:
             self._applied_cv.notify_all()
             self._repl_cv.notify_all()
             self._apply_cv.notify_all()
+            self._fsync_cv.notify_all()
         if self._verify_pool is not None:
             self._verify_pool.shutdown(wait=False)
         with self._watchdog_cv:
@@ -199,20 +233,29 @@ class RaftNode:
     def leader(self) -> Optional[str]:
         return self.transport.addr if self.is_leader() else self.leader_id
 
-    def apply(self, data: bytes, timeout: float = 10.0) -> Any:
+    def apply(self, data: bytes, timeout: float = 10.0,
+              txn: Optional[str] = None, txn_waits: int = 0) -> Any:
         """Replicate one command; returns the FSM's apply result.
 
         Raises NotLeader on followers (reference: callers forward to the
         leader, rpc.go:637 ForwardRPC), and if the FSM handler raised, its
         exception propagates here rather than being returned as a value.
+
+        ``txn``: cross-shard transaction id (sharded.MultiRaft) stamped
+        onto the log entry; applying it releases the matching fence
+        entries parked on the other involved shards — on every replica,
+        deterministically, because the release rides the log itself.
         """
-        result = self.apply_many([data], timeout=timeout)[0]
+        result = self.apply_many([data], timeout=timeout, txn=txn,
+                                 txn_waits=txn_waits)[0]
         if isinstance(result, Exception):
             raise result
         return result
 
     def apply_many(self, datas: list[bytes], timeout: float = 10.0,
-                   traces: Optional[list] = None) -> list[Any]:
+                   traces: Optional[list] = None,
+                   txn: Optional[str] = None,
+                   txn_waits: int = 0) -> list[Any]:
         """Group commit: append k commands under ONE lock acquisition,
         kick replication ONCE, and wait for the LAST index to apply —
         the per-entry raft overhead (lock churn, replicator wakeups,
@@ -241,17 +284,21 @@ class RaftNode:
         # quorum_wait/apply_batch) lives in the commit ledger that
         # _apply_many_impl opens per batch.
         with trace_mod.default.span("raft.apply", entries=len(datas),
-                                    node=self.id):
-            return self._apply_many_impl(datas, timeout, traces)
+                                    node=self.id, shard=self.shard_id):
+            return self._apply_many_impl(datas, timeout, traces, txn,
+                                         txn_waits)
 
     def _apply_many_impl(self, datas: list[bytes],
                          timeout: float = 10.0,
-                         traces: Optional[list] = None) -> list[Any]:
-        # the commit-pipeline ledger (PR 19): one "raft" ledger per
-        # group-commit batch, partitioned into the disjoint depth-0
-        # windows [append | replicate.rtt | quorum_wait | apply_batch]
+                         traces: Optional[list] = None,
+                         txn: Optional[str] = None,
+                         txn_waits: int = 0) -> list[Any]:
+        # the commit-pipeline ledger (PR 19): one ledger per
+        # group-commit batch ("raft", or "raft.shard.<i>" per shard),
+        # partitioned into the disjoint depth-0 windows
+        # [append | replicate.rtt | quorum_wait | apply_batch]
         # so Σ(depth-0) ≤ raft.e2e holds float-exact by construction
-        led = perf.ledger("raft")
+        led = perf.ledger(self._ledger_kind)
         probe: Optional[dict[str, Any]] = None
         try:
             with self._lock:
@@ -284,8 +331,18 @@ class RaftNode:
                             e["trace"] = tid
                         entries.append(e)
                     result_offsets.append(len(entries) - 1)
+                if txn:
+                    for e in entries:
+                        e["txn"] = txn
+                        if txn_waits:
+                            e["txn_waits"] = txn_waits
+                pipelined = self._pipeline_fsync
                 t_a0 = time.perf_counter()
-                self.store.append(entries)
+                # pipelined: frame-write+flush inline (order preserved
+                # under the lock), barrier deferred to the group-sync
+                # thread so replication starts immediately
+                self.store.append(entries,
+                                  fsync=False if pipelined else None)
                 t_a1 = time.perf_counter()
                 fsync_s = self.store.last_fsync_s
                 last = self.store.last_index()
@@ -294,7 +351,14 @@ class RaftNode:
                 if led is not None:
                     probe = {"last": last, "first_ack": None,
                              "quorum": None}
+                    if pipelined:
+                        # stamped by the group-sync thread when the
+                        # barrier covering this batch lands
+                        probe["sync0"] = probe["sync1"] = None
                     self._commit_probes.append(probe)
+                if pipelined:
+                    self._ensure_fsync_thread()
+                    self._fsync_cv.notify()
             self._replicate_all()
             return self._wait_applied(led, probe, traces, term, era,
                                       first, last, result_offsets,
@@ -310,10 +374,23 @@ class RaftNode:
     def _wait_applied(self, led, probe, traces, term, era, first, last,
                       result_offsets, t_a0, t_a1, fsync_s,
                       timeout: float) -> list[Any]:
-        # wait for the whole batch to be applied locally
+        # wait for the whole batch to be applied locally. With an armed
+        # ledger on the pipelined path, also wait for the group barrier
+        # covering the batch: the fsync window must be stamped before
+        # the ledger closes (and the measured ack is then strictly
+        # conservative — it includes leader-local durability, which
+        # commit itself does not require once a follower quorum holds
+        # the entry on disk).
         deadline = self.clock.now() + timeout
+
+        def _pending() -> bool:
+            if self.last_applied < last:
+                return True
+            return (probe is not None and "sync1" in probe
+                    and probe["sync1"] is None)
+
         with self._lock:
-            while self.last_applied < last and not self._stopped:
+            while _pending() and not self._stopped:
                 if isinstance(self.clock, SimClock):
                     raise ApplyTimeout(
                         f"index {last} not committed (commit="
@@ -324,6 +401,11 @@ class RaftNode:
                 self._applied_cv.wait(remaining)
             if self._stopped and self.last_applied < last:
                 raise ApplyTimeout("node stopped")
+            if probe is not None and "sync1" in probe \
+                    and probe["sync1"] is None:
+                # stopped (or raced shutdown) before the barrier
+                # stamped: close honestly with a zero-width window
+                probe["sync0"] = probe["sync1"] = time.perf_counter()
             # a new leader may have overwritten our uncommitted entries —
             # success only if OUR entries (same term) survived. They are
             # contiguous and same-term, so checking the LAST one covers
@@ -360,21 +442,32 @@ class RaftNode:
         must survive clock-read interleavings)."""
         now = time.perf_counter()
         t0 = led.t0_pc
-        perf.record(led, "raft.append", t_a1 - t_a0, off=t_a0 - t0)
-        # the disk barrier, measured where it happened: nested at
-        # depth 1 inside raft.append's tail (0.0 when sync=off)
-        perf.record(led, "raft.fsync", fsync_s,
-                    off=(t_a1 - fsync_s) - t0, depth=1)
+        px = self._px
+        perf.record(led, px + "append", t_a1 - t_a0, off=t_a0 - t0)
+        # the disk barrier, measured where it happened, at depth 1:
+        # inline (nested in append's tail) on the classic path, or at
+        # the group-sync thread's real offset on the pipelined path —
+        # where it OVERLAPS the replicate.rtt window instead of
+        # preceding it (that overlap is the PR 20 win, and the ledger
+        # shows it rather than flattening it)
+        if probe.get("sync1") is not None:
+            fs1 = min(probe["sync1"], now)
+            fs0 = min(max(probe["sync0"], t_a0), fs1)
+            perf.record(led, px + "fsync", fs1 - fs0,
+                        off=fs0 - t0, depth=1)
+        else:
+            perf.record(led, px + "fsync", fsync_s,
+                        off=(t_a1 - fsync_s) - t0, depth=1)
         t_first = probe["first_ack"]
         t_first = t_a1 if t_first is None \
             else min(max(t_first, t_a1), now)
         t_q = probe["quorum"]
         t_q = t_first if t_q is None else min(max(t_q, t_first), now)
-        perf.record(led, "raft.replicate.rtt", t_first - t_a1,
+        perf.record(led, px + "replicate.rtt", t_first - t_a1,
                     off=t_a1 - t0)
-        perf.record(led, "raft.quorum_wait", t_q - t_first,
+        perf.record(led, px + "quorum_wait", t_q - t_first,
                     off=t_first - t0)
-        perf.record(led, "raft.apply_batch", now - t_q, off=t_q - t0)
+        perf.record(led, px + "apply_batch", now - t_q, off=t_q - t0)
         led.node = self.id
         # commit batches are rare relative to requests and the span
         # mirror is what stitches the cross-node timeline — always emit
@@ -382,6 +475,104 @@ class RaftNode:
         if traces:
             led.trace = next((t for t in traces if t), None)
         perf.close(led)
+
+    # ------------------------------------------------- pipelined barrier
+
+    def _ensure_fsync_thread(self) -> None:
+        """Lazily start the group-sync thread (caller holds _lock)."""
+        if self._fsync_thread is None and not self._stopped:
+            t = threading.Thread(target=self._fsync_loop,
+                                 name=f"raft-fsync-{self.id}",
+                                 daemon=True)
+            self._fsync_thread = t
+            t.start()
+
+    def _fsync_loop(self) -> None:
+        """One barrier per wakeup covering every WAL frame flushed so
+        far (group commit for the disk). Runs os.fsync OUTSIDE the raft
+        lock — appends and replication proceed during the barrier, then
+        the loop stamps the covered probes, advances durable-gated
+        commitment, and wakes ledger waiters."""
+        while True:
+            with self._lock:
+                while (not self._stopped and self.store.synced_index
+                        >= self.store.last_index()):
+                    self._fsync_cv.wait(1.0)
+                if self._stopped:
+                    return
+            try:
+                target, dur = self.store.sync_to()
+            except (OSError, ValueError):
+                # store closed under us mid-shutdown
+                with self._lock:
+                    if self._stopped:
+                        return
+                continue
+            t1 = time.perf_counter()
+            with self._lock:
+                for pr in self._commit_probes:
+                    if "sync1" in pr and pr["sync1"] is None \
+                            and pr["last"] <= target:
+                        pr["sync0"] = t1 - dur
+                        pr["sync1"] = t1
+                if self.role == Role.LEADER:
+                    self._advance_commit()
+                self._applied_cv.notify_all()
+
+    # ------------------------------------------------ cross-shard fences
+
+    def append_fence(self, txn: str, timeout: float = 10.0) -> int:
+        """Phase 1 of the cross-shard two-phase path (sharded.MultiRaft
+        apply_cross_shard): commit a fence entry carrying the txn id and
+        return its index. Waits for COMMITMENT only, not apply — the
+        fence's apply intentionally parks this shard's applier until the
+        executing shard applies the real command (TxnGate), so waiting
+        for apply here would deadlock by construction."""
+        with self._lock:
+            if self.role != Role.LEADER or self._stopped:
+                raise NotLeader(self.leader_id)
+            term = self.store.term
+            entry = {"term": term, "kind": "fence", "data": b"",
+                     "txn": txn}
+            pipelined = self._pipeline_fsync
+            self.store.append([entry],
+                              fsync=False if pipelined else None)
+            idx = self.store.last_index()
+            if pipelined:
+                self._ensure_fsync_thread()
+                self._fsync_cv.notify()
+        self._replicate_all()
+        deadline = self.clock.now() + timeout
+        with self._lock:
+            while self.commit_index < idx and not self._stopped:
+                if isinstance(self.clock, SimClock):
+                    raise ApplyTimeout(
+                        f"fence {idx} not committed; sim-clock fence "
+                        "cannot block")
+                remaining = deadline - self.clock.now()
+                if remaining <= 0:
+                    raise ApplyTimeout(f"fence {idx} commit timed out")
+                self._applied_cv.wait(remaining)
+            if self._stopped and self.commit_index < idx:
+                raise ApplyTimeout("node stopped")
+            if idx > self.store.snapshot_index \
+                    and self.store.term_at(idx) != term:
+                # overwritten by a new leader's log: never committed
+                raise NotLeader(self.leader_id)
+        return idx
+
+    # ---------------------------------------------------- lease fencing
+
+    def lease_fence_remaining(self) -> float:
+        """Seconds left on the lease this node granted itself before it
+        was deposed — > 0 means a consistent read served here could
+        race commits the NEW leader has already acknowledged, so the
+        read path must refuse (by name) rather than forward. 0.0 on a
+        current leader or once the fence expires."""
+        with self._lock:
+            if self.role == Role.LEADER or self._fence_until <= 0.0:
+                return 0.0
+            return max(0.0, self._fence_until - self.clock.now())
 
     def barrier(self, timeout: float = 10.0) -> None:
         """Commit an empty entry and wait for it: asserts leadership and
@@ -504,7 +695,12 @@ class RaftNode:
         Returns None (caller falls back to a full verify round) when
         the lease is cold, leadership is unconfirmed this term, or the
         FSM hasn't applied up to the read point in time."""
-        w = self.heartbeat_interval if window is None else window
+        # skew guard: only honor acks inside a SHRUNK window — the
+        # slack absorbs bounded monotonic-clock rate drift between
+        # nodes over the lease window (10% is far beyond real crystal
+        # drift; etcd uses the same style of margin on its leases)
+        w = (self.heartbeat_interval if window is None else window) \
+            * self.LEASE_SKEW_GUARD
         with self._lock:
             if self.role != Role.LEADER or self._stopped \
                     or self._lease_inhibit:
@@ -540,6 +736,12 @@ class RaftNode:
                 return None
         self.metrics.incr("raft.lease_read")
         return read_index
+
+    #: lease skew guard: fraction of the lease window acks must fall
+    #: inside to count (the shaved remainder absorbs monotonic-clock
+    #: RATE drift between nodes); also stretches the post-deposal
+    #: fence so the fence outlives any read the lease could have served
+    LEASE_SKEW_GUARD = 0.9
 
     #: verify-window caps: one verification round covers at most this
     #: many entries / payload bytes, so checksum work never stalls the
@@ -1005,6 +1207,7 @@ class RaftNode:
         self.role = Role.LEADER
         self.leader_id = self.transport.addr
         self._lease_inhibit = False
+        self._fence_until = 0.0  # we ARE the lease holder again
         self.metrics.incr("raft.election.won")
         self.log.info("won election for term %d", self.store.term)
         nxt = self.store.last_index() + 1
@@ -1035,7 +1238,7 @@ class RaftNode:
         # delta). Registered on every win so an in-process multi-node
         # cluster exposes the CURRENT leader's view; the closures
         # self-zero after step-down.
-        perf.default.gauge_fn("raft.log.depth",
+        perf.default.gauge_fn(self._px + "log.depth",
                               lambda: float(len(self.store.log)))
         for p in self.peers:
             self._register_lag_gauge(p)
@@ -1058,12 +1261,34 @@ class RaftNode:
                 0, self.store.last_index()
                 - self._match_index.get(p, 0)))
 
-        perf.default.gauge_fn(f"raft.peer.lag.{p}", lag)
+        perf.default.gauge_fn(f"{self._px}peer.lag.{p}", lag)
 
     def _step_down(self, term: int) -> None:
+        was_leader = self.role == Role.LEADER
+        if was_leader and not self._lease_inhibit:
+            # lease-loss fencing: if a voter majority acked us recently
+            # enough that lease_read_index COULD still say yes, pin the
+            # moment that lease provably expires (newest-majority ack +
+            # the UNSHAVED window — strictly later than any read the
+            # shaved lease window would have served). Until then this
+            # deposed node refuses consistent reads by name instead of
+            # silently forwarding a potentially-stale view.
+            voters = [p for p in (self.peers - self.nonvoters)
+                      if p != self.transport.addr]
+            if voters:
+                cur_term = self.store.term
+                acks = sorted(
+                    (t for p in voters
+                     for tm, t in [self._peer_ack.get(p, (0, 0.0))]
+                     if tm == cur_term),
+                    reverse=True)
+                need = (len(voters) + 1) // 2
+                if len(acks) >= need:
+                    until = acks[need - 1] + self.heartbeat_interval
+                    if until > self.clock.now():
+                        self._fence_until = until
         if term > self.store.term:
             self.store.set_term_vote(term, None)
-        was_leader = self.role == Role.LEADER
         if was_leader:
             self._leadership_era += 1
         self.role = Role.FOLLOWER
@@ -1207,7 +1432,7 @@ class RaftNode:
                     # cross-node write timeline (tagged with the
                     # batch's trace id so Perfetto stitches it)
                     perf.default.gauge_set(
-                        f"raft.replicate.rtt_ms.{peer}",
+                        f"{self._px}replicate.rtt_ms.{peer}",
                         round(rtt * 1000.0, 4))
                     tid = next((en.get("trace") for en in entries
                                 if en.get("trace")), None)
@@ -1273,16 +1498,29 @@ class RaftNode:
             # never commit an entry a voter majority hasn't stored
             # (raft §4.2.1 non-voting members)
             voters = self.peers - self.nonvoters
+            prev_commit = self.commit_index
             for idx in range(self.store.last_index(), self.commit_index, -1):
                 if self.store.term_at(idx) != self.store.term:
                     break  # only current-term entries commit by counting
-                votes = 1 + sum(
+                # the leader's own vote counts only once ITS copy is
+                # durable (synced_index) — on the pipelined path the
+                # group barrier may still be in flight while followers
+                # (which fsync inline before acking) already answered;
+                # a follower quorum commits without us, never because
+                # of our unflushed copy
+                votes = (1 if self.store.synced_index >= idx else 0) \
+                    + sum(
                     1 for p, mi in self._match_index.items()
                     if p != self.transport.addr and p in voters
                     and mi >= idx)
                 if votes * 2 > len(voters):
                     self.commit_index = idx
                     break
+            if self.commit_index > prev_commit:
+                # fence waiters (append_fence) park on commitment, not
+                # apply — a parked-applier shard would otherwise never
+                # wake them
+                self._applied_cv.notify_all()
             if self._commit_probes:
                 t_c = time.perf_counter()
                 for pr in self._commit_probes:
@@ -1319,20 +1557,57 @@ class RaftNode:
                     self._apply_cv.wait(0.5)
                 if self._stopped:
                     return
-                self._apply_committed_locked()
+                parked = self._apply_committed_locked()
+                if parked and not self._stopped:
+                    # parked at a cross-shard fence: poll until the
+                    # executing shard applies (TxnGate) or the fence
+                    # times out — never busy-spin on the commit gap
+                    self._apply_cv.wait(0.05)
 
-    def _apply_committed_locked(self) -> None:
+    def _apply_committed_locked(self) -> bool:
+        """Drain committed entries into the FSM. Returns True when the
+        drain PARKED at an unresolved cross-shard fence (the caller
+        re-polls); False when it drained everything available."""
         # applier backpressure gauge: how far the FSM lags commit
         # (the queue the applier is about to drain; re-set post-drain
         # below so the steady-state read is the residual lag)
-        perf.default.gauge_set("raft.applier.depth",
+        perf.default.gauge_set(self._px + "applier.depth",
                                self.commit_index - self.last_applied)
         drained = 0
+        parked = False
         while self.last_applied < self.commit_index:
             idx = self.last_applied + 1
             e = self.store.entry(idx)
             if e is None:
                 break
+            if e["kind"] == "fence":
+                # cross-shard ordering barrier (sharded.MultiRaft):
+                # entries past it must not apply before the executing
+                # shard's command does — on THIS replica, which is what
+                # keeps per-key history identical across replicas when
+                # a key's writes arrive via two logs
+                gate = self._txn_gate
+                if gate is not None:
+                    # tell the executing shard this replica's view of
+                    # the fenced shard is frozen here (exec barriers on
+                    # every fence being reached before it applies)
+                    gate.fence_reached(e.get("txn", ""),
+                                       self.shard_id or 0)
+                    if not gate.passable(e.get("txn", "")):
+                        parked = True
+                        break
+            if e["kind"] == "cmd" and e.get("txn") \
+                    and e.get("txn_waits"):
+                # executing-shard side of the barrier: the command
+                # reads state owned by the fenced shards, so it must
+                # not apply until each of them has parked at its fence
+                # on THIS replica — otherwise the read set's position
+                # would be replica-dependent and FSMs would diverge
+                gate = self._txn_gate
+                if gate is not None \
+                        and not gate.ready(e["txn"], e["txn_waits"]):
+                    parked = True
+                    break
             if e["kind"] != "chunk" and self._chunks:
                 # any non-chunk entry interrupts (and so orphans) an
                 # in-flight group — same contiguity argument as above
@@ -1355,7 +1630,7 @@ class RaftNode:
                 # growing commit/applied gap. Log-bucketed histogram:
                 # this is a hot-path timer under sustained load
                 self.metrics.measure_hist("raft.fsm.apply", start)
-                perf.default.observe("raft.fsm.apply",
+                perf.default.observe(self._px + "fsm.apply",
                                      telemetry.time_now() - start)
                 if self.role == Role.LEADER:
                     self._apply_results[idx] = result
@@ -1391,7 +1666,7 @@ class RaftNode:
                             sp.tag(error=type(ex).__name__)
                             result = ex
                     self.metrics.measure_hist("raft.fsm.apply", start)
-                    perf.default.observe("raft.fsm.apply",
+                    perf.default.observe(self._px + "fsm.apply",
                                          telemetry.time_now() - start)
                     if self.role == Role.LEADER:
                         self._apply_results[idx] = result
@@ -1436,17 +1711,24 @@ class RaftNode:
                 if e.get("remove"):
                     self.peers.discard(e["remove"])
                     self.nonvoters.discard(e["remove"])
+            if e.get("txn") and self._txn_gate is not None:
+                # the executing shard's command applied: release the
+                # fences parked on the other involved shards — a
+                # log-replayed fact, so every replica releases at the
+                # same point in its own history
+                self._txn_gate.complete(e["txn"])
             self.last_applied = idx
             drained += 1
         if drained:
             # apply-batch coalescing distribution: how many committed
             # entries one applier pass drained (pairs with the group-
             # commit batch histogram the server-side batcher feeds)
-            perf.default.size_observe("raft.apply.batch", drained)
-        perf.default.gauge_set("raft.applier.depth",
+            perf.default.size_observe(self._px + "apply.batch", drained)
+        perf.default.gauge_set(self._px + "applier.depth",
                                self.commit_index - self.last_applied)
         self._applied_cv.notify_all()
         self._maybe_snapshot()
+        return parked
 
     def _maybe_snapshot(self) -> None:
         if self.snapshot_fn is None:
@@ -1576,8 +1858,8 @@ class RaftNode:
         self.store.append(entries)
         dur = time.perf_counter() - t0
         fsync_s = self.store.last_fsync_s
-        perf.default.observe("raft.follower.append", dur)
-        perf.default.observe("raft.follower.fsync", fsync_s)
+        perf.default.observe(self._px + "follower.append", dur)
+        perf.default.observe(self._px + "follower.fsync", fsync_s)
         try:
             tags: dict[str, Any] = {"node": self.id,
                                     "entries": len(entries),

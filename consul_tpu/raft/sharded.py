@@ -1,0 +1,406 @@
+"""Multi-raft state store (PR 20): sharded consensus groups behind one
+facade.
+
+Three pieces:
+
+  * ``ShardRouter`` — the digest-pinned map from (table, key) to shard:
+    KV keys spread over contiguous hash ranges across ALL shards;
+    every other table (catalog, sessions, coordinates, ACLs, ...) is
+    anchored to the SYSTEM shard (shard 0) where their total order —
+    session create/destroy, lock grants — is preserved exactly as in
+    the single-group store. Routing is pure and deterministic; its
+    digest is pinned by a tier-1 test so a silent remap (which would
+    break per-key linearizability across a rolling upgrade) fails CI
+    by name.
+
+  * ``TxnGate`` — the cross-shard ordering gate. A multi-shard command
+    commits a ``fence`` entry in every involved shard except the
+    executing one (phase 1), then commits the real command on the
+    executing shard with the txn id stamped on it (phase 2). Each
+    replica's applier, on reaching a fence, parks THAT shard until its
+    own apply of the executing shard's command releases the txn — the
+    release is a log-replayed fact, so every replica serializes the
+    cross-shard op against the fenced shard's subsequent entries at
+    the same point in history. A 2s timeout bounds the stall if a
+    fence's txn never lands (leader died between phases): availability
+    over cross-shard ordering for that one orphaned op.
+
+  * ``MultiRaft`` — the facade the server talks to. Single-key ops
+    route to exactly one shard (one log, one WAL, one fsync, one
+    applier — per-key linearizability is per-shard linearizability);
+    cross-shard ops take the fence path; everything else (membership,
+    recovery, leadership, stats) fans out to every shard. Attribute
+    access falls through to shard 0, so the entire existing
+    server/test surface (``raft.id``, ``raft.store``, ``raft.peers``,
+    ``raft._handle_rpc`` ...) works unchanged — and with n=1 the
+    facade is exactly the classic store plus one pointer hop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+from consul_tpu.raft.raft import NotLeader, RaftNode
+from consul_tpu.state import fsm as fsm_mod
+
+#: how long a replica's applier will hold a shard at an unresolved
+#: fence before giving up on the ordering guarantee for that one txn
+#: (executing-shard leader death between the two phases)
+FENCE_TIMEOUT_S = 2.0
+
+
+class ShardRouter:
+    """Deterministic (table, key) → shard map.
+
+    KV keys hash (md5, first 16 bits) onto contiguous ranges:
+    ``shard = point * n >> 16`` — the same split consul's own
+    partitioning literature uses for range-balanced ownership. Every
+    non-KV table pins to the system shard (0). The router never looks
+    at runtime state, so two nodes with the same ``n`` agree forever;
+    ``digest()`` folds the version string, the shard count, and a
+    golden probe of concrete mappings so ANY behavioural change—
+    algorithm, bit-width, range math — moves a pinned constant."""
+
+    VERSION = "multiraft-v1/md5-16bit-contiguous"
+    SYSTEM_SHARD = 0
+
+    #: fixed probe keys folded into the digest: a remap of any of them
+    #: (or of the system tables) changes the digest
+    _PROBE_KEYS = ("", "a", "foo/bar", "service/web/lock",
+                   "deep/nested/key/with/segments", "éclair",
+                   "zzzz", "0", "session/abc123")
+    _SYSTEM_TABLES = ("nodes", "services", "checks", "sessions",
+                      "coordinates", "acl_tokens", "config_entries")
+
+    def __init__(self, n_shards: int = 1) -> None:
+        self.n = max(1, int(n_shards))
+
+    def shard_of_key(self, key: str) -> int:
+        if self.n == 1:
+            return 0
+        point = int.from_bytes(
+            hashlib.md5(key.encode("utf-8", "surrogatepass"))
+            .digest()[:2], "big")
+        return (point * self.n) >> 16
+
+    def shard_of(self, table: str, key: Optional[str] = None) -> int:
+        if table == "kv" and key is not None:
+            return self.shard_of_key(key)
+        return self.SYSTEM_SHARD
+
+    def all_shards(self) -> set[int]:
+        return set(range(self.n))
+
+    def digest(self) -> str:
+        h = hashlib.sha256()
+        h.update(self.VERSION.encode())
+        h.update(str(self.n).encode())
+        for t in self._SYSTEM_TABLES:
+            h.update(f"{t}={self.shard_of(t)};".encode())
+        for k in self._PROBE_KEYS:
+            h.update(f"kv:{k}={self.shard_of_key(k)};".encode())
+        return h.hexdigest()[:16]
+
+
+class TxnGate:
+    """Cross-shard fence gate, one per server process (all of a node's
+    shards share it). ``passable`` is called by appliers holding their
+    OWN shard lock only; ``complete`` records the txn and the parked
+    appliers re-poll — no gate→raft-lock call ever happens, so there
+    is no cross-shard lock ordering to get wrong."""
+
+    def __init__(self, timeout_s: float = FENCE_TIMEOUT_S) -> None:
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._done: set[str] = set()
+        self._done_ring: deque[str] = deque(maxlen=4096)
+        self._first_seen: dict[str, float] = {}
+        # which shards' appliers are parked at this txn's fence — the
+        # executing shard's apply barriers on this (see ready())
+        self._reached: dict[str, set[int]] = {}
+        self.timed_out = 0  # observability: orphaned fences
+
+    def complete(self, txn: str) -> None:
+        with self._lock:
+            if txn in self._done:
+                return
+            self._done.add(txn)
+            self._done_ring.append(txn)
+            if len(self._done) > self._done_ring.maxlen:
+                # evict beyond the ring window (replay of ancient logs
+                # re-records; the window only bounds memory)
+                old = self._done_ring.popleft()
+                self._done.discard(old)
+            self._first_seen.pop(txn, None)
+            self._reached.pop(txn, None)
+
+    def fence_reached(self, txn: str, shard_id: int) -> None:
+        """A shard's applier has parked at (or passed) the fence for
+        ``txn`` — recorded so the executing shard knows the fenced
+        shard's state is frozen at the fence point on THIS replica."""
+        if not txn:
+            return
+        with self._lock:
+            if txn in self._done:
+                return
+            self._reached.setdefault(txn, set()).add(shard_id)
+
+    def ready(self, txn: str, expected: int) -> bool:
+        """Exec-side barrier: may the executing shard apply the command
+        for ``txn``? Only once ``expected`` fenced shards have parked —
+        otherwise the command could read a fenced shard's state at a
+        replica-dependent position and replicas would diverge. Timeout
+        matches the fence's (a compacted-away fence on replay must not
+        wedge the applier forever)."""
+        if not txn or expected <= 0:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            if txn in self._done:
+                return True  # replay after completion
+            if len(self._reached.get(txn, ())) >= expected:
+                return True
+            first = self._first_seen.setdefault(txn, now)
+            if now - first > self.timeout_s:
+                self.timed_out += 1
+                return True
+            return False
+
+    def passable(self, txn: str) -> bool:
+        """True when the fence for ``txn`` may be crossed: its command
+        applied, or the fence has waited past the timeout."""
+        if not txn:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            if txn in self._done:
+                return True
+            first = self._first_seen.setdefault(txn, now)
+            if now - first > self.timeout_s:
+                self.timed_out += 1
+                self._first_seen.pop(txn, None)
+                return True
+            return False
+
+
+class MultiRaft:
+    """Facade over N per-shard RaftNodes sharing one FSM/StateStore.
+
+    The shards argument is ordered by shard id; shard 0 is the system
+    shard and the delegation target for any attribute not explicitly
+    routed here."""
+
+    def __init__(self, shards: list[RaftNode], router: ShardRouter,
+                 txn_gate: Optional[TxnGate] = None) -> None:
+        assert len(shards) == router.n
+        self.shards = shards
+        self.router = router
+        self.txn_gate = txn_gate
+        # serializes cross-shard two-phase applies on THIS leader: the
+        # global order (fences, then exec) must be identical in every
+        # shard's log, or two in-flight txns could park each other's
+        # appliers on replicas (A's exec waiting for a fence behind B's
+        # unresolved fence). Cross-shard ops are the rare path; a mutex
+        # is the honest price of shared-store multi-raft.
+        self._cross_lock = threading.Lock()
+
+    #: attributes that live on the facade itself; everything else
+    #: delegates to the system shard in BOTH directions
+    _OWN_ATTRS = frozenset(("shards", "router", "txn_gate",
+                            "_cross_lock"))
+
+    # any attribute MultiRaft does not define falls through to the
+    # system shard: .id, .store, .peers, ._lock, .transport, ...
+    def __getattr__(self, name: str):
+        return getattr(self.shards[0], name)
+
+    # ... and symmetrically for writes: callers (tests, admin paths)
+    # that poke node state (`raft._verified_to = 0`) must reach the
+    # real node, not silently shadow it on the facade
+    def __setattr__(self, name: str, value) -> None:
+        if name in self._OWN_ATTRS or "shards" not in self.__dict__:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self.shards[0], name, value)
+
+    @property
+    def n_shards(self) -> int:
+        return self.router.n
+
+    def shard(self, sid: int) -> RaftNode:
+        return self.shards[sid]
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        for sh in self.shards:
+            sh.start()
+
+    def shutdown(self) -> None:
+        for sh in self.shards:
+            sh.shutdown()
+
+    # ---------------------------------------------------------- routing
+
+    def route_command(self, data: bytes) -> tuple[str, Any]:
+        """Classify one encoded FSM command: ("single", shard_id) or
+        ("cross", involved_shard_set). The classification itself lives
+        with the command vocabulary (state/fsm.command_route); this
+        just maps route classes onto this router's shard ids. With one
+        shard nothing is even unpacked."""
+        if self.router.n == 1 or not data:
+            return "single", 0
+        cls, keys = fsm_mod.command_route(data)
+        if cls == fsm_mod.ROUTE_SYSTEM:
+            return "single", ShardRouter.SYSTEM_SHARD
+        if cls == fsm_mod.ROUTE_KEY:
+            return "single", self.router.shard_of_key(keys[0])
+        if cls == fsm_mod.ROUTE_FAN:
+            involved = {ShardRouter.SYSTEM_SHARD}
+            involved.update(self.router.shard_of_key(k) for k in keys)
+            if involved == {ShardRouter.SYSTEM_SHARD}:
+                return "single", ShardRouter.SYSTEM_SHARD
+            return "cross", involved
+        return "cross", self.router.all_shards()  # ROUTE_ALL
+
+    # ------------------------------------------------------------ apply
+
+    def apply(self, data: bytes, timeout: float = 10.0) -> Any:
+        kind, where = self.route_command(data)
+        if kind == "single":
+            return self.shards[where].apply(data, timeout=timeout)
+        return self.apply_cross_shard(data, where, timeout=timeout)
+
+    def apply_many(self, datas: list[bytes], timeout: float = 10.0,
+                   traces: Optional[list] = None,
+                   shard: Optional[int] = None) -> list[Any]:
+        """Group commit on ONE shard. The server's per-shard batchers
+        pass ``shard`` explicitly (they route before batching); with it
+        absent every command must single-route to the same shard."""
+        if shard is not None:
+            return self.shards[shard].apply_many(
+                datas, timeout=timeout, traces=traces)
+        routes = {self.route_command(d) for d in datas}
+        if len(routes) != 1 or next(iter(routes))[0] != "single":
+            raise ValueError(
+                "apply_many batch mixes shards or contains a "
+                "cross-shard command — route before batching")
+        return self.shards[next(iter(routes))[1]].apply_many(
+            datas, timeout=timeout, traces=traces)
+
+    def apply_cross_shard(self, data: bytes, involved: set[int],
+                          timeout: float = 10.0) -> Any:
+        """Deterministic shard-ordered two-phase apply. Phase 1 commits
+        a fence (carrying a fresh txn id) in every involved shard above
+        the executing one, in ascending shard order; phase 2 commits
+        and applies the command on the executing shard (the minimum —
+        always the system shard for today's cross ops, where session
+        and lock total order lives). Each fence parks its shard's
+        applier until the command applies on THAT replica, so the
+        cross-shard op and any later single-key write to a fenced
+        shard apply in the same order everywhere."""
+        involved = set(involved) or {0}
+        exec_shard = min(involved)
+        txn = uuid.uuid4().hex
+        with self._cross_lock:
+            for sid in sorted(involved - {exec_shard}):
+                self.shards[sid].append_fence(txn, timeout=timeout)
+            return self.shards[exec_shard].apply(
+                data, timeout=timeout, txn=txn,
+                txn_waits=len(involved) - 1)
+
+    # ------------------------------------------------- reads and leases
+
+    def is_leader(self) -> bool:
+        return self.shards[0].is_leader()
+
+    def leader(self) -> Optional[str]:
+        return self.shards[0].leader()
+
+    def leads_all_shards(self) -> bool:
+        return all(sh.is_leader() for sh in self.shards)
+
+    def lease_read_index(self, window: Optional[float] = None,
+                         timeout: float = 2.0) -> Optional[int]:
+        """Lease-based linearizable read point. Consistent reads serve
+        the SHARED store, so every shard's lease must hold here — a
+        single shard led elsewhere could have acknowledged a write this
+        replica's applier hasn't caught. Returns the system shard's
+        read index (the caller treats it as opaque) or None."""
+        ri0: Optional[int] = None
+        for sh in self.shards:
+            ri = sh.lease_read_index(window=window, timeout=timeout)
+            if ri is None:
+                return None
+            if sh is self.shards[0]:
+                ri0 = ri
+        return ri0
+
+    def verify_leadership(self, timeout: float = 2.0) -> Optional[int]:
+        ri0: Optional[int] = None
+        for sh in self.shards:
+            ri = sh.verify_leadership(timeout=timeout)
+            if ri is None:
+                return None
+            if sh is self.shards[0]:
+                ri0 = ri
+        return ri0
+
+    def lease_fence_remaining(self) -> float:
+        return max(sh.lease_fence_remaining() for sh in self.shards)
+
+    # ------------------------------------------------------- membership
+
+    def add_peer(self, addr: str, voter: bool = True) -> None:
+        # system shard LAST: membership observers (reconcile, autopilot)
+        # read shard 0's peer set, so a partial fan-out failure leaves
+        # shard 0 unchanged and the next reconcile tick retries the
+        # whole change instead of silently stranding a tail shard
+        for sh in reversed(self.shards):
+            sh.add_peer(addr, voter=voter)
+
+    def remove_peer(self, addr: str) -> None:
+        for sh in reversed(self.shards):
+            sh.remove_peer(addr)
+
+    def recover_configuration(self, voters: list[str],
+                              nonvoters: tuple = ()) -> None:
+        for sh in self.shards:
+            sh.recover_configuration(voters, nonvoters)
+
+    def transfer_leadership(self, target: str,
+                            timeout: float = 5.0) -> None:
+        for sh in self.shards:
+            try:
+                sh.transfer_leadership(target, timeout=timeout)
+            except NotLeader:
+                continue  # only the shards we lead can transfer
+
+    def colocation_deficit(self) -> list[tuple[int, Optional[str]]]:
+        """Shards this node does NOT lead while leading the system
+        shard: [(shard_id, current_leader_addr)]. The server's leader
+        tick uses this to pull stray shard leaderships home so one
+        node answers for every shard (forwarding stays single-hop and
+        lease reads can cover all shards)."""
+        if not self.shards[0].is_leader():
+            return []
+        out = []
+        for sid, sh in enumerate(self.shards):
+            if sid == 0 or sh.is_leader():
+                continue
+            out.append((sid, sh.leader()))
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        s = dict(self.shards[0].stats())
+        if self.router.n > 1:
+            s["shards"] = {
+                str(sid): sh.stats()
+                for sid, sh in enumerate(self.shards)}
+            s["router_digest"] = self.router.digest()
+        return s

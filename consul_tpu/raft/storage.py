@@ -48,10 +48,19 @@ class RaftStorage:
         # context is.
         self.last_append_s = 0.0
         self.last_fsync_s = 0.0
+        # highest raft index known durable (fsync'd). For sync=False /
+        # in-memory stores this tracks last_index (nothing to defer).
+        # Advanced inline by append(), or out-of-band by sync_to() when
+        # the caller pipelines the barrier (PR 20 — append returns
+        # before fsync; the raft layer gates its own commit vote on
+        # synced_index so an unflushed leader never self-certifies).
+        self.synced_index = 0
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load()
             self._wal = open(self._wal_path(), "ab")
+        # everything loaded from disk is by definition durable
+        self.synced_index = self.last_index()
 
     # ------------------------------------------------------------- paths
 
@@ -158,9 +167,17 @@ class RaftStorage:
                     os.fsync(f.fileno())
             os.replace(tmp, self._meta_path())
 
-    def append(self, entries: list[dict[str, Any]]) -> None:
+    def append(self, entries: list[dict[str, Any]],
+               fsync: Optional[bool] = None) -> None:
+        """Append entries: log + WAL frame-write + flush are ALWAYS
+        inline (WAL byte order must match log order, and append is
+        lock-serialized by the raft layer). The os.fsync barrier is
+        inline too unless the caller passes fsync=False to pipeline it
+        — then sync_to() later makes the tail durable and advances
+        synced_index (append→replicate overlaps the barrier)."""
         t0 = time.perf_counter()
         fsync_s = 0.0
+        want_sync = self.sync if fsync is None else (fsync and self.sync)
         for e in entries:
             e.setdefault("idx", self.last_index() + 1)
             self.log.append(e)
@@ -169,20 +186,44 @@ class RaftStorage:
                 blob = msgpack.packb(e)
                 self._wal.write(struct.pack(">I", len(blob)) + blob)
             self._wal.flush()
-            if self.sync:
+            if want_sync:
                 # the disk barrier is measured HERE — where it actually
                 # happens — not inferred from the append envelope; an
                 # in-memory or sync=False store honestly reports 0
                 tf = time.perf_counter()
                 os.fsync(self._wal.fileno())
                 fsync_s = time.perf_counter() - tf
+        if want_sync or not self.sync:
+            # barrier done (or store has no barrier at all): the whole
+            # log is as durable as it will ever be
+            self.synced_index = self.last_index()
         self.last_fsync_s = fsync_s
         self.last_append_s = time.perf_counter() - t0
+
+    def sync_to(self) -> tuple[int, float]:
+        """Group fsync for pipelined appends: one barrier covers every
+        entry whose WAL frame was flushed before the call (append's
+        write+flush completes before it releases the raft lock, so a
+        last_index read here is covered by the barrier). Returns
+        (covered_index, fsync_seconds). Safe to call WITHOUT the raft
+        lock — os.fsync on an append-only fd is concurrency-safe with
+        further writes; they just wait for the next barrier."""
+        target = self.last_index()
+        if target <= self.synced_index:
+            return self.synced_index, 0.0
+        fsync_s = 0.0
+        if self._wal is not None and self.sync:
+            tf = time.perf_counter()
+            os.fsync(self._wal.fileno())
+            fsync_s = time.perf_counter() - tf
+        self.synced_index = max(self.synced_index, target)
+        return target, fsync_s
 
     def truncate_from(self, index: int) -> None:
         """Drop entries at raft index >= index (conflict rollback)."""
         keep = index - 1 - self.snapshot_index
         del self.log[max(keep, 0):]
+        self.synced_index = min(self.synced_index, self.last_index())
         if self._wal is not None:
             blob = msgpack.packb({"_trunc": index - 1})
             self._wal.write(struct.pack(">I", len(blob)) + blob)
@@ -269,6 +310,9 @@ class RaftStorage:
         self.log = self.log[keep_from:] if keep_from > 0 else self.log
         self.snapshot_index = index
         self.snapshot_term = term
+        # the snapshot file itself is fsync'd below: indices it covers
+        # are durable regardless of pending WAL barriers
+        self.synced_index = max(self.synced_index, index)
         if peers is not None:
             self.snapshot_peers = list(peers)
             self.snapshot_nonvoters = list(nonvoters or [])

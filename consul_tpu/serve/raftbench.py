@@ -67,22 +67,30 @@ class RaftCluster:
         shutil.rmtree(self.tmpdir, ignore_errors=True)
 
 
-def build_cluster(n: int = 3,
+def build_cluster(n: int = 3, shards: int = 1,
                   overrides: Optional[dict] = None) -> RaftCluster:
     """Build the n-server cluster with per-server data dirs (real WAL
     + fsync — RaftStorage defaults to sync=True when given a dir).
     The bench's durability claim rides on this: a PUT acked here hit
-    a disk barrier on a quorum."""
+    a disk barrier on a quorum.
+
+    ``shards > 1`` builds a multi-raft store (PR 20): one consensus
+    group per shard, each with its own WAL under
+    ``raft/shard-<id>/``. The bench waits for leader colocation
+    (every group led by the same node) before measuring — the sharded
+    headline is the COLOCATED steady state, not the transfer churn."""
     from consul_tpu.config import load
     from consul_tpu.server import Server
 
     tmpdir = tempfile.mkdtemp(prefix="raftbench-")
     base = {"server": True, "bootstrap": n == 1,
             "bootstrap_expect": 0 if n == 1 else n,
+            "raft_shards": shards,
             # loopback topology artifact: every client shares 127.0.0.1
             "rpc_max_conns_per_client": 4096}
     base.update(overrides or {})
-    print(f"building {n}-server raft cluster (sync WALs)...",
+    print(f"building {n}-server raft cluster (sync WALs, "
+          f"{shards} shard{'s' if shards != 1 else ''})...",
           file=sys.stderr)
     servers = []
     for i in range(n):
@@ -98,8 +106,12 @@ def build_cluster(n: int = 3,
         lambda: next((s for s in servers if s.is_leader()), None),
         what="leader election")
     if n > 1:
-        wait_for(lambda: len(leader.raft.peers) == n,
-                 what=f"{n} raft peers")
+        wait_for(lambda: all(len(sh.peers) == n
+                             for sh in leader.raft.shards),
+                 what=f"{n} raft peers on every shard")
+    if shards > 1:
+        wait_for(lambda: leader.raft.leads_all_shards(), timeout=60.0,
+                 what="shard-leader colocation")
     return RaftCluster(servers, leader, tmpdir)
 
 
@@ -127,12 +139,20 @@ def _size_stats(cur: dict, prev: dict, name: str
 def run_put_rung(cluster: RaftCluster, target_rps: float,
                  duration: float, windows: int = 3, senders: int = 2,
                  rpc_sockets: int = 4, salt: int = 0,
-                 drain_s: float = 5.0) -> dict[str, Any]:
+                 drain_s: float = 5.0, shards: int = 1
+                 ) -> dict[str, Any]:
     """One open-loop write rung: ``target_rps * duration`` KV PUTs at
     fixed intended send times, mixed entry sizes, all lanes pipelined
     mux sockets to the LEADER (the commit pipeline under test —
     forward hops are the serving plane's story, not this family's).
-    Returns the registry.RAFT_RUNG_KEYS row."""
+    Returns the registry.RAFT_RUNG_KEYS row.
+
+    ``shards > 1``: each consensus group has its own commit pipeline
+    and its own ``raft.shard.<id>`` stage ledger; the rung grows a
+    per-shard ``shards`` map (registry.RAFT_SHARD_KEYS rows, stage
+    names re-rooted per registry.raft_shard_stages) and the top-level
+    stage rows quote the BUSIEST shard's pipeline under the plain
+    names so single-group consumers keep decoding."""
     from consul_tpu.server.rpc import RPC_MUX, read_frame, write_frame
     from consul_tpu.utils import perf
 
@@ -274,21 +294,78 @@ def run_put_rung(cluster: RaftCluster, target_rps: float,
         wcounts[min(max(int((d - start) / win), 0), windows - 1)] += 1
 
     # --- aggregate: the leader's commit-pipeline attribution --------
-    report = perf.stage_report(raw1, raw0, "raft")
-    e2e = report.get("e2e") or {}
-    commit_p50 = e2e.get("p50_ms")
-    stage_p50: dict[str, Any] = {}
-    stage_share: dict[str, Any] = {}
-    for name in registry.RAFT_STAGES:
-        srow = report["stages"].get(name) or {}
-        stage_p50[name] = srow.get("p50_ms", 0.0)
-        stage_share[name] = (
-            round(srow.get("p50_ms", 0.0) / commit_p50, 4)
-            if commit_p50 else 0.0)
     gauges1 = raw1["gauges"]
-    follower_lag = {k[len("raft.peer.lag."):]: gauges1[k]
-                    for k in sorted(gauges1)
-                    if k.startswith("raft.peer.lag.")}
+    shard_rows: dict[str, Any] = {}
+    if shards > 1:
+        # one ledger kind per consensus group. The busiest group's
+        # pipeline (most group-commit batches in the window) is
+        # re-quoted at the top level under the plain PR 19 names —
+        # single-group consumers (README tables, the regression
+        # guard's fresh_* fields) keep decoding unchanged.
+        for sid in range(shards):
+            kind = f"{registry.RAFT_SHARD_STAGE_PREFIX}{sid}"
+            rep = perf.stage_report(raw1, raw0, kind)
+            se2e = rep.get("e2e") or {}
+            sp50 = se2e.get("p50_ms")
+            s_stage_p50: dict[str, Any] = {}
+            s_share: dict[str, Any] = {}
+            for name in registry.raft_shard_stages(sid):
+                srow = (rep.get("stages") or {}).get(name) or {}
+                s_stage_p50[name] = srow.get("p50_ms", 0.0)
+                s_share[name] = (
+                    round(srow.get("p50_ms", 0.0) / sp50, 4)
+                    if sp50 else 0.0)
+            shard_rows[str(sid)] = {
+                "commit_p50_ms": sp50,
+                "commit_p99_ms": se2e.get("p99_ms"),
+                "commit_batches": se2e.get("count", 0),
+                "stage_p50_ms": s_stage_p50,
+                "stage_share_p50": s_share,
+                "coverage_p50": rep.get("share_p50_total") or 0.0,
+                "commit_batch": _size_stats(
+                    raw1, raw0, f"{kind}.commit.batch"),
+                "apply_batch": _size_stats(
+                    raw1, raw0, f"{kind}.apply.batch"),
+            }
+        busiest = max(range(shards), key=lambda s: shard_rows[str(s)]
+                      ["commit_batches"])
+        busy = shard_rows[str(busiest)]
+        bp = f"{registry.RAFT_SHARD_STAGE_PREFIX}{busiest}."
+        commit_p50 = busy["commit_p50_ms"]
+        e2e = {"p50_ms": commit_p50,
+               "p99_ms": busy["commit_p99_ms"],
+               "count": busy["commit_batches"]}
+        stage_p50 = {f"raft.{k[len(bp):]}": v
+                     for k, v in busy["stage_p50_ms"].items()}
+        stage_share = {f"raft.{k[len(bp):]}": v
+                       for k, v in busy["stage_share_p50"].items()}
+        coverage = busy["coverage_p50"]
+        commit_batch = busy["commit_batch"]
+        apply_batch = busy["apply_batch"]
+        lag_px = f"{bp}peer.lag."
+        follower_lag = {k[len(lag_px):]: gauges1[k]
+                        for k in sorted(gauges1)
+                        if k.startswith(lag_px)}
+        log_depth = gauges1.get(bp + "log.depth")
+    else:
+        report = perf.stage_report(raw1, raw0, "raft")
+        e2e = report.get("e2e") or {}
+        commit_p50 = e2e.get("p50_ms")
+        stage_p50 = {}
+        stage_share = {}
+        for name in registry.RAFT_STAGES:
+            srow = report["stages"].get(name) or {}
+            stage_p50[name] = srow.get("p50_ms", 0.0)
+            stage_share[name] = (
+                round(srow.get("p50_ms", 0.0) / commit_p50, 4)
+                if commit_p50 else 0.0)
+        coverage = report.get("share_p50_total") or 0.0
+        commit_batch = _size_stats(raw1, raw0, "raft.commit.batch")
+        apply_batch = _size_stats(raw1, raw0, "raft.apply.batch")
+        follower_lag = {k[len("raft.peer.lag."):]: gauges1[k]
+                        for k in sorted(gauges1)
+                        if k.startswith("raft.peer.lag.")}
+        log_depth = gauges1.get("raft.log.depth")
     return {
         "target_rps": float(target_rps),
         "duration_s": float(duration),
@@ -309,13 +386,14 @@ def run_put_rung(cluster: RaftCluster, target_rps: float,
         # the coverage claim: p50(raft.stages_sum)/p50(raft.e2e) over
         # the SAME batch population (see perf.stage_report) — NOT the
         # sum of per-stage p50s, which is not additive
-        "coverage_p50": report.get("share_p50_total") or 0.0,
-        "commit_batch": _size_stats(raw1, raw0, "raft.commit.batch"),
-        "apply_batch": _size_stats(raw1, raw0, "raft.apply.batch"),
+        "coverage_p50": coverage,
+        "commit_batch": commit_batch,
+        "apply_batch": apply_batch,
         "follower_lag": follower_lag,
-        "log_depth": gauges1.get("raft.log.depth"),
+        "log_depth": log_depth,
         "window_rps": [round(c / win, 1) for c in wcounts],
         "loadavg_1m": load0,
+        **({"shards": shard_rows} if shard_rows else {}),
     }
 
 

@@ -12,7 +12,7 @@ import time
 import uuid
 from typing import Any
 
-from consul_tpu.server.rpc import RPCError
+from consul_tpu.server.rpc import RetryableError, RPCError
 from consul_tpu.state import MessageType
 from consul_tpu.utils import perf
 from consul_tpu.state.fsm import encode_command
@@ -72,6 +72,22 @@ def register_endpoints(srv) -> None:
         leadership loss (consistentRead, rpc.go RequiredConsistent)."""
 
         def wrapper(args):
+            if args.get("RequireConsistent") and not srv.is_leader():
+                # lease-loss fencing (PR 20): a JUST-deposed leader may
+                # have served lease reads moments ago; while its
+                # computed fence (last quorum ack + one UNSHAVED lease
+                # window) is still running, it refuses ?consistent
+                # reads BY NAME instead of silently forwarding — the
+                # refusal is the observable proof that the lease-read
+                # path is closed during the handover, and the error is
+                # structured-retryable so clients re-send once the new
+                # leader settles.
+                fence = srv.raft.lease_fence_remaining()
+                if fence > 0:
+                    raise RetryableError(
+                        f"node {srv.name} was deposed with an "
+                        f"un-expired leader lease: consistent reads "
+                        f"fenced for {fence:.3f}s more")
             if not args.get("AllowStale") and not srv.is_leader():
                 return srv._forward_to_leader(name, args)
             if args.get("RequireConsistent") and srv.is_leader():
@@ -341,8 +357,13 @@ def register_endpoints(srv) -> None:
                 None, "", srv.config.datacenter):
             return False  # cross-DC requests take the forwarding path
         srv.check_rate_limit("KVS.Apply", src)
-        srv._batcher.apply_async(
-            encode_command(MessageType.KVS, _kv_pre_apply(args)), respond)
+        data = encode_command(MessageType.KVS, _kv_pre_apply(args))
+        kind, where = srv.raft.route_command(data)
+        if kind != "single":
+            # cross-shard (lock/unlock/delete-tree): the fenced
+            # two-phase path needs a thread — decline to the sync path
+            return False
+        srv._batchers[where].apply_async(data, respond)
         return True
 
     srv.rpc.async_handlers["KVS.Apply"] = kv_apply_async
@@ -364,7 +385,7 @@ def register_endpoints(srv) -> None:
         key = args.get("Key", "")
         require(authz(args).key_read(key), f"key read on {key!r}")
 
-        def after_verify(read_index):
+        def after_verify(read_index, lease=False):
             if read_index is None:
                 respond(RPCError(
                     "consistent read unavailable: leadership lost"))
@@ -375,9 +396,12 @@ def register_endpoints(srv) -> None:
                 with perf.stage("store.read"):
                     e_ = state.kv_get(key)
                 # max(.., 1) matches blocking_query's sync contract: an
-                # Index of 0 fed back as MinQueryIndex busy-polls
+                # Index of 0 fed back as MinQueryIndex busy-polls.
+                # lease-served reads propagate the lease fact so the
+                # request ledger provably drops rpc.commit_wait
                 respond({"Index": max(state.kv_key_index(key), 1),
-                         "Entries": [e_.to_dict()] if e_ else []})
+                         "Entries": [e_.to_dict()] if e_ else []},
+                        lease=lease)
             except Exception as ex:  # noqa: BLE001
                 respond(ex)
 

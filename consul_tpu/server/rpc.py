@@ -1180,13 +1180,16 @@ class RPCServer:
         start = telemetry.time_now()
 
         def respond(result, sid=sid, method=method, start=start,
-                    led=led, sess=sess):
+                    led=led, sess=sess, lease=False):
             # runs on whichever thread completes the commit (the
             # group-commit batcher, the verify gate, or inline here).
             # The reply is ENQUEUED, never written synchronously — the
             # completer can't stall behind one client's socket buffer,
             # and the reactor's next flush batches it with neighbors.
-            if led is not None:
+            # lease=True marks a lease-served consistent read: there was
+            # no commit to wait on, so the stage is omitted rather than
+            # recorded as a ~0 row that would hide the lease win.
+            if led is not None and not lease:
                 # handler-end (led.mark) → here: the thread-free
                 # group-commit wait. mark < 0 means the reactor hasn't
                 # published the handler record yet (an inline
@@ -1529,7 +1532,7 @@ class RPCServer:
                 start = telemetry.time_now()
 
                 def respond(result, sid=sid, method=method, start=start,
-                            led=led):
+                            led=led, lease=False):
                     # the reply write goes through the worker pool: the
                     # completer (e.g. the single group-commit thread)
                     # must never block on one client's full socket
@@ -1553,7 +1556,12 @@ class RPCServer:
                                     break
                                 time.sleep(0)
                                 m = led.mark
-                            if m >= 0.0:
+                            # lease-served reads (PR 20): the leader's
+                            # lease answered on the caller thread with
+                            # no quorum round and no queue park — there
+                            # IS no commit wait, and the ledger proves
+                            # it by carrying no such stage at all
+                            if m >= 0.0 and not lease:
                                 perf.record(
                                     led, "rpc.commit_wait",
                                     max(0.0, time.perf_counter() - m),
@@ -1807,6 +1815,28 @@ class RPCServer:
                         return
 
     def _serve_raft(self, sock: socket.socket, src: str) -> None:
+        # sid-tagged frames (the PR 20 shared per-peer mux) are handled
+        # CONCURRENTLY — N shards' AppendEntries share one socket and
+        # one group's fsync must not head-of-line-block another's —
+        # with replies serialized by a per-connection write lock.
+        # Untagged frames keep the strict sequential legacy protocol.
+        wlock = threading.Lock()
+
+        def _dispatch(req: dict, sid) -> None:
+            try:
+                reply = self._raft_handler(req["method"], src,
+                                           req.get("args") or {})
+                out = {"result": reply}
+            except Exception as e:  # noqa: BLE001
+                out = {"error": str(e)}
+            if sid is not None:
+                out["sid"] = sid
+            with wlock:
+                try:
+                    write_frame(sock, out)
+                except OSError:
+                    pass
+
         while True:
             req = read_frame(sock)
             if req is None:
@@ -1823,11 +1853,16 @@ class RPCServer:
                         write_frame(sock, {"error": "raft auth failed"})
                         return
                     req = msgpack.unpackb(body, raw=False)
-                reply = self._raft_handler(req["method"], src,
-                                           req.get("args") or {})
-                write_frame(sock, {"result": reply})
+                sid = req.get("sid")
+                if sid is not None:
+                    threading.Thread(
+                        target=_dispatch, args=(req, sid), daemon=True,
+                        name=f"raft-srv-{src}").start()
+                else:
+                    _dispatch(req, None)
             except Exception as e:  # noqa: BLE001
-                write_frame(sock, {"error": str(e)})
+                with wlock:
+                    write_frame(sock, {"error": str(e)})
 
 
 class _Conn:
@@ -2058,6 +2093,7 @@ class ConnPool:
         self.tls_context = tls_context  # client ctx for RPC_TLS dials
         self.raft_sign = None  # keyring_raft_auth signer, if any
         self._mux: dict[str, list[_MuxConn]] = {}
+        self._raft_mux: dict[str, "_RaftMux"] = {}
         self._dialing: dict[str, int] = {}
         self._lock = threading.Lock()
         self._dial_cv = threading.Condition(self._lock)
@@ -2234,20 +2270,160 @@ class ConnPool:
         finally:
             conn.close()
 
+    def raft_call_mux(self, addr: str, method: str,
+                      args: dict[str, Any],
+                      timeout: float = 5.0) -> dict:
+        """Raft RPC over the SHARED per-peer connection (PR 20): all
+        shards' AppendEntries to one follower ride a single socket
+        whose writer coalesces queued frames through one sendmsg
+        (writev) flush — N consensus groups do not mean N× syscalls
+        or N× connections per peer."""
+        with self._lock:
+            mux = self._raft_mux.get(addr)
+            if mux is None or mux.dead:
+                mux = _RaftMux(addr, self.connect_timeout,
+                               self.tls_context, self.raft_sign)
+                self._raft_mux[addr] = mux
+        return mux.call(method, args, timeout)
+
     def close(self) -> None:
         with self._lock:
             for conns in self._mux.values():
                 for c in conns:
                     c.close()
             self._mux.clear()
+            for m in self._raft_mux.values():
+                m.close()
+            self._raft_mux.clear()
+
+
+class _RaftMux:
+    """One shared, persistent raft connection to one peer with
+    coalesced egress (PR 20): callers enqueue sid-tagged frames; a
+    writer thread drains the whole backlog through a single
+    sock.sendmsg (writev) per flush, and a reader thread fans replies
+    back out by sid. This is what keeps a multi-raft node's syscall
+    budget flat in the shard count — concurrent AppendEntries from N
+    shards to the same follower become one gathered write.
+
+    Failure model: any socket error kills the mux, fails every
+    in-flight call with ConnectionError (the replicators' back-off
+    signal), and the pool re-dials lazily on the next call."""
+
+    def __init__(self, addr: str, connect_timeout: float,
+                 tls_context, raft_sign) -> None:
+        self.addr = addr
+        self.dead = False
+        self._sign = raft_sign
+        self._conn = _Conn(addr, RPC_RAFT, connect_timeout, tls_context)
+        self._conn.sock.settimeout(None)
+        self._lock = threading.Lock()
+        self._wcv = threading.Condition(self._lock)
+        self._wq: list[bytes] = []
+        self._next_sid = 1
+        # sid -> [event, reply-or-None]
+        self._waiters: dict[int, list] = {}
+        threading.Thread(target=self._writer, daemon=True,
+                         name=f"raft-mux-w-{addr}").start()
+        threading.Thread(target=self._reader, daemon=True,
+                         name=f"raft-mux-r-{addr}").start()
+
+    def call(self, method: str, args: dict[str, Any],
+             timeout: float = 5.0) -> dict:
+        ev = threading.Event()
+        slot = [ev, None]
+        with self._lock:
+            if self.dead:
+                raise ConnectionError(f"raft mux to {self.addr} down")
+            sid = self._next_sid
+            self._next_sid += 1
+            self._waiters[sid] = slot
+            frame = {"sid": sid, "method": method, "args": args}
+            if self._sign is not None:
+                body = msgpack.packb(frame, use_bin_type=True)
+                frame = {"b": body, "sig": self._sign(body)}
+            blob = msgpack.packb(frame, use_bin_type=True)
+            self._wq.append(struct.pack(">I", len(blob)) + blob)
+            self._wcv.notify()
+        try:
+            if not ev.wait(timeout):
+                raise ConnectionError(
+                    f"raft RPC {method} to {self.addr} timed out")
+        finally:
+            with self._lock:
+                self._waiters.pop(sid, None)
+        resp = slot[1]
+        if resp is None:
+            raise ConnectionError(f"raft mux to {self.addr} died")
+        if resp.get("error") is not None:
+            raise ConnectionError(resp["error"])
+        return resp.get("result") or {}
+
+    def _writer(self) -> None:
+        while True:
+            with self._lock:
+                while not self._wq and not self.dead:
+                    self._wcv.wait(1.0)
+                if self.dead:
+                    return
+                bufs = self._wq
+                self._wq = []
+            try:
+                # the batched-writev egress: every queued frame in one
+                # gathered syscall (partial sends drain via sendall)
+                sent = self._conn.sock.sendmsg(bufs)
+                total = sum(len(b) for b in bufs)
+                if sent < total:
+                    rest = b"".join(bufs)[sent:]
+                    self._conn.sock.sendall(rest)
+            except OSError:
+                self._fail()
+                return
+
+    def _reader(self) -> None:
+        while True:
+            try:
+                resp = read_frame(self._conn.sock)
+            except OSError:
+                resp = None
+            if resp is None:
+                self._fail()
+                return
+            with self._lock:
+                slot = self._waiters.pop(resp.get("sid"), None)
+            if slot is not None:
+                slot[1] = resp
+                slot[0].set()
+
+    def _fail(self) -> None:
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
+            self._wcv.notify_all()
+        self._conn.close()
+        for slot in waiters:
+            slot[0].set()
+
+    def close(self) -> None:
+        self._fail()
 
 
 class PooledRaftTransport:
-    """RaftTransport over the multiplexed port (RaftLayer equivalent)."""
+    """RaftTransport over the multiplexed port (RaftLayer equivalent).
 
-    def __init__(self, addr: str, pool: ConnPool) -> None:
+    ``shard`` (PR 20): a sharded node runs one transport per consensus
+    group; outbound RPCs are tagged with the shard id (the remote's
+    dispatch routes to the right group) and ride the shared per-peer
+    mux connection so cross-shard traffic to one follower coalesces."""
+
+    def __init__(self, addr: str, pool: ConnPool,
+                 shard: Optional[int] = None) -> None:
         self.addr = addr
         self.pool = pool
+        self.shard = shard
         self._handler = None
 
     def set_handler(self, handler) -> None:
@@ -2260,4 +2436,7 @@ class PooledRaftTransport:
 
     def call(self, peer: str, method: str, args: dict[str, Any],
              timeout: float = 5.0) -> dict[str, Any]:
-        return self.pool.raft_call(peer, method, args, timeout)
+        if self.shard is None:
+            return self.pool.raft_call(peer, method, args, timeout)
+        return self.pool.raft_call_mux(
+            peer, method, {**args, "_shard": self.shard}, timeout)

@@ -83,8 +83,12 @@ class _ApplyBatcher:
     Latency cost when idle: one cv wakeup (the drain begins
     immediately — there is no batching delay timer)."""
 
-    def __init__(self, raft) -> None:
+    def __init__(self, raft, prefix: str = "raft.") -> None:
         self.raft = raft
+        # sharded store: one batcher per consensus group, each with its
+        # own stage/size names ("raft.shard.<i>.commit_wait") so the
+        # perf ledgers attribute the park time to the right group
+        self.prefix = prefix
         self._cv = threading.Condition()
         # (data, callback, trace-id) — the trace id is captured from
         # the enqueuing thread (rpc.py binds it around handler runs) so
@@ -117,7 +121,7 @@ class _ApplyBatcher:
                                        else {})):
             # perf stage nests under the caller's request ledger (an
             # HTTP write parks HERE for most of its wall time)
-            with perf.stage("raft.commit_wait"):
+            with perf.stage(self.prefix + "commit_wait"):
                 ok = done.wait(timeout)
         if not ok:
             raise RPCError("apply timed out in commit queue")
@@ -164,7 +168,8 @@ class _ApplyBatcher:
                 batch, self._pending = self._pending, []
             # group-commit coalescing distribution: how many writes one
             # raft round carried (the size histogram on /v1/agent/perf)
-            perf.default.size_observe("raft.commit.batch", len(batch))
+            perf.default.size_observe(self.prefix + "commit.batch",
+                                      len(batch))
             try:
                 results = self.raft.apply_many(
                     [d for d, _, _ in batch],
@@ -211,7 +216,7 @@ class _VerifyGate:
             slot: list = [None]
             done = threading.Event()
 
-            def cb(ri) -> None:
+            def cb(ri, lease: bool = False) -> None:
                 slot[0] = ri
                 done.set()
 
@@ -233,7 +238,10 @@ class _VerifyGate:
             except Exception:  # noqa: BLE001 — lease is best-effort
                 ri = None
             if ri is not None:
-                cb(ri)
+                # lease=True: served inline by the leader lease, no
+                # quorum round, no queue park — callers that feed perf
+                # ledgers drop their commit-wait stage accordingly
+                cb(ri, True)
                 return
         with self._cv:
             if self._stopped:
@@ -377,24 +385,63 @@ class Server:
             if config.encrypt_key else None)
         self.pool.raft_sign = sign
         self.rpc.raft_verify = verify
-        self.raft_transport = PooledRaftTransport(self.rpc.addr, self.pool)
 
-        data_dir = None
+        # Multi-raft state store (PR 20): N independent consensus
+        # groups over ONE shared FSM/state store. n=1 keeps the exact
+        # classic layout (raft/ dir, unprefixed stage names, legacy
+        # one-shot raft conns); n>1 gives every shard its own log, WAL,
+        # applier, and commit index under raft/shard-<i>/, with
+        # outbound AppendEntries shard-tagged and coalesced through the
+        # shared per-peer mux connection (rpc._RaftMux).
+        n_shards = max(1, int(getattr(config, "raft_shards", 1) or 1))
+        from consul_tpu.raft.sharded import (MultiRaft, ShardRouter,
+                                             TxnGate)
+
+        self.txn_gate = TxnGate()
+        shard_router = ShardRouter(n_shards)
+        shard_nodes = []
+        self.raft_transports: list[PooledRaftTransport] = []
+        raft_dir = None
         if config.data_dir:
             import os
 
-            data_dir = os.path.join(config.data_dir, "raft")
-        self.raft = RaftNode(
-            node_id=self.name,
-            transport=self.raft_transport,
-            apply_fn=self.fsm.apply,
-            snapshot_fn=self.fsm.snapshot,
-            restore_fn=self.fsm.restore,
-            storage=RaftStorage(data_dir),
-            peers=[self.rpc.addr],
-            heartbeat_interval=config.raft_heartbeat_timeout / 10,
-            election_timeout=config.raft_election_timeout,
-            snapshot_threshold=config.raft_snapshot_threshold)
+            raft_dir = os.path.join(config.data_dir, "raft")
+        for sid in range(n_shards):
+            transport = PooledRaftTransport(
+                self.rpc.addr, self.pool,
+                shard=None if n_shards == 1 else sid)
+            self.raft_transports.append(transport)
+            shard_dir = raft_dir
+            if raft_dir is not None and n_shards > 1:
+                shard_dir = os.path.join(raft_dir, f"shard-{sid}")
+            if n_shards == 1:
+                snap_fn, rest_fn = self.fsm.snapshot, self.fsm.restore
+            else:
+                # per-shard snapshots carry ONLY the shard-owned slice
+                # of the shared store — a restore must never clobber
+                # keys another shard's log is authoritative for
+                snap_fn = (lambda sid=sid:
+                           self.fsm.snapshot_shard(shard_router, sid))
+                rest_fn = (lambda data, sid=sid:
+                           self.fsm.restore_shard(shard_router, sid,
+                                                  data))
+            shard_nodes.append(RaftNode(
+                node_id=self.name,
+                transport=transport,
+                apply_fn=self.fsm.apply,
+                snapshot_fn=snap_fn,
+                restore_fn=rest_fn,
+                storage=RaftStorage(shard_dir),
+                peers=[self.rpc.addr],
+                heartbeat_interval=config.raft_heartbeat_timeout / 10,
+                election_timeout=config.raft_election_timeout,
+                snapshot_threshold=config.raft_snapshot_threshold,
+                shard_id=None if n_shards == 1 else sid,
+                txn_gate=self.txn_gate))
+        self.raft = MultiRaft(shard_nodes, shard_router,
+                              self.txn_gate)
+        self.raft_transport = self.raft_transports[0]
+        self._last_colocate = 0.0
         # peers.json disaster recovery (server.go:1061-1110): an
         # operator-written recovery file in the raft data dir rewrites
         # the replicated configuration before anything starts — the
@@ -402,9 +449,20 @@ class Server:
         # lost. The file is archived after a successful recovery so a
         # later reboot cannot silently re-apply it.
         self._peers_recovered = False
-        if data_dir:
-            self._maybe_recover_peers_json(data_dir)
-        self._batcher = _ApplyBatcher(self.raft)
+        if raft_dir:
+            self._maybe_recover_peers_json(raft_dir)
+        # one group-commit batcher per shard: concurrent writes to the
+        # SAME shard coalesce into shared raft rounds; writes to
+        # different shards pipeline independently. Stage names carry
+        # the shard ("raft.shard.<i>.commit_wait") so ledgers attribute
+        # the park time to the right group.
+        if n_shards == 1:
+            self._batchers = [_ApplyBatcher(self.raft)]
+        else:
+            self._batchers = [
+                _ApplyBatcher(sh, prefix=f"raft.shard.{sid}.")
+                for sid, sh in enumerate(self.raft.shards)]
+        self._batcher = self._batchers[0]
         self._verify_gate = _VerifyGate(self.raft)
 
         # L0: gossip membership. Tags advertise the server role + RPC addr
@@ -694,8 +752,39 @@ class Server:
         os.replace(path, path + ".applied")
         self._peers_recovered = True
 
+    def _raft_dispatch(self, method: str, src: str,
+                       args: dict) -> dict:
+        """Incoming raft RPC router: shard-tagged frames (``_shard``,
+        stamped by the sender's PooledRaftTransport) go to that
+        consensus group's handler; untagged frames are the classic
+        single-group path. ``transfer_leadership`` is the one
+        shard-admin RPC: the system-shard leader uses it to pull a
+        stray shard leadership home (colocation), and the transfer's
+        catch-up loop runs on a background thread so the mux reader is
+        never parked behind it."""
+        sid = 0
+        if isinstance(args, dict) and "_shard" in args:
+            sid = int(args.pop("_shard"))
+        if not 0 <= sid < len(self.raft.shards):
+            raise RPCError(f"unknown raft shard {sid}")
+        if method == "transfer_leadership":
+            target = str(args.get("target", ""))
+            node = self.raft.shards[sid]
+
+            def _xfer() -> None:
+                try:
+                    node.transfer_leadership(target)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    self.log.debug("shard %d leadership transfer to "
+                                   "%s failed: %s", sid, target, e)
+
+            threading.Thread(target=_xfer, daemon=True,
+                             name=f"shard-xfer-{sid}").start()
+            return {"ok": True}
+        return self.raft.shards[sid].transport.handle(method, src, args)
+
     def start(self) -> None:
-        self.rpc.start(self.handle_rpc, self.raft_transport.handle)
+        self.rpc.start(self.handle_rpc, self._raft_dispatch)
         # passive raft start: no self-elections until bootstrapped/contacted
         if self.config.bootstrap:
             self.raft.start()
@@ -748,7 +837,8 @@ class Server:
             self.serf_wan.shutdown()
         if self._controller_manager is not None:
             self._controller_manager.stop()
-        self._batcher.stop()
+        for b in self._batchers:
+            b.stop()
         self._verify_gate.stop()
         self.raft.shutdown()
         self.rpc.shutdown()
@@ -966,10 +1056,18 @@ class Server:
         "not leader" error sends the client back through forwarding.
 
         Writes go through the group-commit batcher: concurrent applies
-        coalesce into shared raft rounds (rpc.go:926-1000 spirit)."""
+        coalesce into shared raft rounds (rpc.go:926-1000 spirit).
+        Sharded store: single-shard commands route to that shard's own
+        batcher (independent group-commit pipelines); cross-shard
+        commands take the fenced two-phase path — no batching, the
+        rare-path price of multi-key atomicity."""
         if not self.is_leader():
             raise RPCError("not leader")
-        return self._batcher.apply(encode_command(msg_type, body))
+        data = encode_command(msg_type, body)
+        kind, where = self.raft.route_command(data)
+        if kind == "single":
+            return self._batchers[where].apply(data)
+        return self.raft.apply_cross_shard(data, where)
 
     def _forward_to_leader(self, method: str,
                            args: dict[str, Any]) -> Any:
@@ -1204,6 +1302,39 @@ class Server:
             except Exception as e:  # noqa: BLE001
                 self.log.warning("tombstone reap failed: %s", e)
 
+    def _colocate_shards(self) -> None:
+        """Pull stray shard leaderships onto the system-shard leader.
+        Elections are per-shard, so after a failover the N groups can
+        land on different nodes; writes to a shard led elsewhere then
+        bounce with NotLeader until it comes home. The system-shard
+        leader (the node clients forward to) asks each stray shard's
+        current leader — via the shard-tagged ``transfer_leadership``
+        raft RPC — to hand that one group over. Throttled: transfers
+        take a catch-up round; hammering every tick would flap."""
+        if self.raft.n_shards == 1:
+            return
+        now = time.monotonic()
+        if now - self._last_colocate < 5.0:
+            return
+        deficit = self.raft.colocation_deficit()
+        if not deficit:
+            return
+        self._last_colocate = now
+        for sid, leader_addr in deficit:
+            if not leader_addr or leader_addr == self.rpc.addr:
+                continue  # no leader yet (election will settle it)
+            try:
+                self.pool.raft_call_mux(
+                    leader_addr, "transfer_leadership",
+                    {"target": self.rpc.addr, "_shard": sid},
+                    timeout=2.0)
+                self.log.info(
+                    "colocation: asked %s to hand over raft shard %d",
+                    leader_addr, sid)
+            except Exception as e:  # noqa: BLE001 — retried next window
+                self.log.debug("colocation request for shard %d to %s "
+                               "failed: %s", sid, leader_addr, e)
+
     def _leader_tick(self) -> None:
         """Leader duties (leader.go leaderLoop): raft membership from serf,
         reconcile queued member events, expire TTL sessions."""
@@ -1233,6 +1364,7 @@ class Server:
                  for r in self.state.raw_list("censuses")),
                 default=0.0)
         self._reporting_tick()
+        self._colocate_shards()
         # raft membership follows serf server membership (autopilot)
         rows = self._servers()
         servers = {s["rpc_addr"] for s in rows if s["rpc_addr"]}

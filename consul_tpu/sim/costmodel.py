@@ -810,6 +810,62 @@ def _validate_users(name: str, d: dict) -> None:
              ("target_rps",))
 
 
+def _validate_raft_shards(rn: str, rung: dict, n_shards: int) -> None:
+    """Per-shard attribution rows inside one sharded rung. Each shard
+    is its own commit pipeline, so each row repeats the single-group
+    contract — stage names re-rooted under ``raft.shard.<id>.`` and
+    the RAFT_COVERAGE_MIN floor enforced PER SHARD. Every refusal
+    names the shard and the offending key."""
+    shards = rung.get("shards")
+    if not isinstance(shards, dict):
+        raise LedgerError(
+            f"{rn}: sharded record (raft_shards={n_shards}) but rung "
+            "has no per-shard 'shards' map — a multi-raft headline "
+            "without per-shard attribution is a blind spot")
+    want = {str(s) for s in range(n_shards)}
+    if set(shards) != want:
+        raise LedgerError(
+            f"{rn}.shards: shard ids {sorted(shards)} != expected "
+            f"{sorted(want)} — every consensus group must report")
+    for sid_s in sorted(shards, key=int):
+        sid = int(sid_s)
+        srow = shards[sid_s]
+        sn = f"{rn}.shards[{sid}]"
+        if not isinstance(srow, dict):
+            raise LedgerError(f"{sn}: shard row must be an object")
+        _require(sn, srow, registry.RAFT_SHARD_KEYS)
+        _require_num(sn, srow, ("commit_p50_ms", "commit_p99_ms",
+                                "coverage_p50"))
+        expected = set(registry.raft_shard_stages(sid))
+        shares = srow["stage_share_p50"]
+        if not isinstance(shares, dict):
+            raise LedgerError(f"{sn}: stage_share_p50 must be an "
+                              "object")
+        missing = expected - set(shares)
+        if missing:
+            raise LedgerError(
+                f"{sn}.stage_share_p50: shard {sid} is missing "
+                f"stage(s) {sorted(missing)} — every depth-0 commit "
+                "window must be attributed per shard")
+        unknown = set(shares) - expected
+        if unknown:
+            raise LedgerError(
+                f"{sn}.stage_share_p50: shard {sid} has unknown "
+                f"stage(s) {sorted(unknown)} (known: "
+                f"{', '.join(sorted(expected))})")
+        cov = srow["coverage_p50"]
+        # a shard that committed nothing this rung (possible under a
+        # skewed key mix) records commit_batches == 0 and is exempt —
+        # there is no pipeline to attribute
+        if srow.get("commit_batches") and \
+                cov < registry.RAFT_COVERAGE_MIN:
+            raise LedgerError(
+                f"{sn}: shard {sid} stage coverage {cov:.3f} is "
+                f"below {registry.RAFT_COVERAGE_MIN:.0%} of its "
+                "commit e2e p50 — a shard must not hide behind a "
+                "well-attributed sibling")
+
+
 def _validate_raft(name: str, d: dict) -> None:
     """Consensus-plane commit-path record (bench.py --raft): a
     write-heavy open-loop PUT ladder against a real 3-server loopback
@@ -817,7 +873,14 @@ def _validate_raft(name: str, d: dict) -> None:
     honest skip naming its reason. The family's claim is per-stage
     ATTRIBUTION, so a rung whose depth-0 stage windows explain less
     than RAFT_COVERAGE_MIN of the commit e2e p50 is refused — an
-    observatory with a >10% blind spot must not ship as data."""
+    observatory with a >10% blind spot must not ship as data.
+
+    Sharded records (cluster.raft_shards > 1, PR 20) additionally
+    carry a per-shard ``shards`` map on every measured rung; the
+    top-level stage rows then quote the BUSIEST shard's pipeline
+    under the plain PR 19 names so single-group consumers keep
+    working, while _validate_raft_shards holds every group to the
+    same coverage floor."""
     _require(name, d, ("metric", "unit", "cluster", "ladder",
                        "headline", "headline_rung"))
     cl = d["cluster"]
@@ -825,6 +888,10 @@ def _validate_raft(name: str, d: dict) -> None:
         raise LedgerError(f"{name}: cluster must be an object")
     _require(f"{name}.cluster", cl, ("servers", "sync",
                                      "payload_bytes"))
+    n_shards = cl.get("raft_shards", 1)
+    if not isinstance(n_shards, int) or n_shards < 1:
+        raise LedgerError(f"{name}.cluster: raft_shards must be a "
+                          f"positive int, got {n_shards!r}")
     if not isinstance(d["ladder"], list) or not d["ladder"]:
         raise LedgerError(f"{name}: ladder must be a non-empty list")
     measured = 0
@@ -863,6 +930,8 @@ def _validate_raft(name: str, d: dict) -> None:
                 f"{registry.RAFT_COVERAGE_MIN:.0%} of commit e2e p50 "
                 "— the attribution has a blind spot; fix the ledger, "
                 "don't record around it")
+        if n_shards > 1:
+            _validate_raft_shards(rn, rung, n_shards)
     if not measured:
         raise LedgerError(
             f"{name}: every rung skipped — record the failure as a "

@@ -554,6 +554,33 @@ RAFT_RUNG_KEYS = ("target_rps", "duration_s", "offered", "completed",
 #: spots as data)
 RAFT_COVERAGE_MIN = 0.90
 
+#: the multi-raft shard dimension (PR 20): a sharded store runs one
+#: consensus group per shard and emits one stage ledger per group,
+#: kind "raft.shard.<i>" with RAFT_STAGES re-rooted under the same
+#: prefix ("raft.shard.0.append", ...). Mirrors
+#: consul_tpu.utils.perf.SHARD_KIND_PREFIX — the two must agree or
+#: the validator and the ledger speak different languages.
+RAFT_SHARD_STAGE_PREFIX = "raft.shard."
+
+
+def raft_shard_stages(shard_id: int) -> tuple:
+    """The depth-0 commit-pipeline stage names for ONE consensus
+    group: every RAFT_STAGES entry re-rooted under
+    ``raft.shard.<id>.`` (same transform as perf.top_stages_for)."""
+    p = f"{RAFT_SHARD_STAGE_PREFIX}{int(shard_id)}."
+    return tuple(p + s.split("raft.", 1)[1] for s in RAFT_STAGES)
+
+
+#: per-shard attribution-row keys inside a sharded RAFT rung's
+#: ``shards`` map (keyed by decimal shard id). Each shard is its own
+#: commit pipeline with its own WAL + fsync + applier, so each row
+#: repeats the single-group attribution contract — including the
+#: RAFT_COVERAGE_MIN floor PER SHARD: an unexplained shard must not
+#: hide behind a well-attributed sibling.
+RAFT_SHARD_KEYS = ("commit_p50_ms", "commit_p99_ms", "commit_batches",
+                   "stage_p50_ms", "stage_share_p50", "coverage_p50",
+                   "commit_batch", "apply_batch")
+
 #: the autotuner's winner schema: what a TUNE record's ``winner`` and
 #: every AUTOTUNE_CACHE.json entry must carry (validator + cache
 #: loader both decode these keys)
@@ -609,7 +636,8 @@ def layout_digest() -> str:
                   USERS_SURFACES, USERS_RUNG_KEYS,
                   USERS_SURFACE_KEYS,
                   RAFT_STAGES, RAFT_RUNG_KEYS,
-                  (str(RAFT_COVERAGE_MIN),)):
+                  (str(RAFT_COVERAGE_MIN),),
+                  (RAFT_SHARD_STAGE_PREFIX,), RAFT_SHARD_KEYS):
         h.update("|".join(group).encode())
         h.update(b";")
     return h.hexdigest()[:16]

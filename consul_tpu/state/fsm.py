@@ -50,6 +50,76 @@ def encode_command(msg_type: MessageType, body: dict[str, Any]) -> bytes:
     return bytes([int(msg_type)]) + msgpack.packb(body, use_bin_type=True)
 
 
+# ---------------------------------------------------------------- routing
+#
+# Shard-routing classification for the multi-raft store (raft/sharded).
+# This lives HERE, next to the command vocabulary, because the answer to
+# "which shards can this command's handler touch" is a property of the
+# handlers above — a new op must update its routing class in the same
+# file that defines its effect.
+
+ROUTE_SYSTEM = "system"  # single op, system shard (total order of
+#                          catalog / sessions / ACLs / config lives there)
+ROUTE_KEY = "key"        # single-key KV op: exactly the key's shard
+ROUTE_FAN = "fan"        # system shard + the listed keys' shards
+ROUTE_ALL = "all"        # may touch kv keys on every shard
+
+#: KV ops whose handler touches exactly body["DirEnt"]["Key"]
+_KV_SINGLE_KEY_OPS = frozenset(("set", "cas", "delete", "delete-cas"))
+#: KV ops that couple a key with the session table (acquire/release)
+_KV_SESSION_OPS = frozenset(("lock", "unlock"))
+
+
+def command_route(data: bytes) -> tuple[str, tuple[str, ...]]:
+    """Classify one encoded command: (route_class, kv_keys_involved).
+
+    Derived from the handlers' write sets:
+      * KVS set/cas/delete/delete-cas touch exactly one key
+      * KVS lock/unlock also read/write the session table → fan
+        {system, key}
+      * KVS delete-tree removes a whole prefix → any shard
+      * SESSION destroy cascades into held locks anywhere → all
+      * TXN touches the system shard plus each KV op's key
+      * REGISTER with a critical check runs the session-invalidation
+        cascade (held locks anywhere) → all
+      * everything else mutates system tables only
+    """
+    if not data:
+        return ROUTE_SYSTEM, ()
+    mt = data[0]
+    if mt == MessageType.KVS:
+        body = msgpack.unpackb(data[1:], raw=False)
+        op = body.get("Op", "set")
+        key = (body.get("DirEnt") or {}).get("Key", "")
+        if op in _KV_SINGLE_KEY_OPS:
+            return ROUTE_KEY, (key,)
+        if op in _KV_SESSION_OPS:
+            return ROUTE_FAN, (key,)
+        return ROUTE_ALL, ()  # delete-tree (and any future prefix op)
+    if mt == MessageType.SESSION:
+        body = msgpack.unpackb(data[1:], raw=False)
+        if body.get("Op", "create") == "destroy":
+            return ROUTE_ALL, ()
+        return ROUTE_SYSTEM, ()
+    if mt == MessageType.TXN:
+        body = msgpack.unpackb(data[1:], raw=False)
+        keys = tuple((op.get("KV") or {}).get("Key", "")
+                     for op in body.get("Ops") or [] if op.get("KV"))
+        if not keys:
+            return ROUTE_SYSTEM, ()
+        return ROUTE_FAN, keys
+    if mt == MessageType.REGISTER:
+        body = msgpack.unpackb(data[1:], raw=False)
+        checks = list(body.get("Checks") or [])
+        if body.get("Check"):
+            checks.append(body["Check"])
+        if any((c or {}).get("Status") == CheckStatus.CRITICAL
+               for c in checks):
+            return ROUTE_ALL, ()
+        return ROUTE_SYSTEM, ()
+    return ROUTE_SYSTEM, ()
+
+
 class FSM:
     def __init__(self, store: Optional[StateStore] = None) -> None:
         self.store = store or StateStore()
@@ -97,6 +167,14 @@ class FSM:
 
     def restore(self, data: bytes) -> None:
         self.store.restore(data)
+
+    def snapshot_shard(self, router, shard_id: int) -> bytes:
+        """Multi-raft: snapshot only the slice of the store this shard's
+        log is authoritative for (store.dump_shard)."""
+        return self.store.dump_shard(router, shard_id)
+
+    def restore_shard(self, router, shard_id: int, data: bytes) -> None:
+        self.store.restore_shard(data, router, shard_id)
 
     # ------------------------------------------------------------- handlers
 

@@ -777,6 +777,17 @@ class StateStore:
                     e.modify_index = self._index + 1
         return touched
 
+    def session_held_keys(self, sid: str) -> list[str]:
+        """KV keys whose lock the session currently holds — the write
+        set a destroy of this session would touch. The multi-raft
+        router's ALL classification for session destroy is conservative
+        precisely because this set is volatile between routing time and
+        apply time; this accessor exists for observability and tests,
+        not for routing."""
+        with self._lock:
+            return [k for k, e in self.tables["kv"].items()
+                    if e.session == sid]
+
     def invalidate_sessions_for_check(self, node: str,
                                       check_id: str) -> None:
         """A critical check invalidates sessions bound to it
@@ -928,6 +939,93 @@ class StateStore:
                 **{t: dict(self.tables[t]) for t in RAW_TABLES},
             }
             return msgpack.packb(blob, use_bin_type=True)
+
+    def dump_shard(self, router, shard_id: int) -> bytes:
+        """Per-shard snapshot slice (multi-raft store). Shard 0 (the
+        system shard) owns every non-KV table plus its KV range; shard
+        i>0 owns exactly its KV range. A shard snapshot must contain
+        ONLY owned state — on restore it replaces the owned slice and
+        never clobbers keys another shard's log is authoritative for."""
+        if router is None or getattr(router, "n", 1) == 1:
+            return self.dump()
+        with self._lock:
+            owned_kv = {k: v.__dict__ for k, v in self.tables["kv"].items()
+                        if router.shard_of_key(k) == shard_id}
+            owned_tomb = {k: i for k, i in self._kv_tombstones.items()
+                          if router.shard_of_key(k) == shard_id}
+            if shard_id != 0:
+                return msgpack.packb(
+                    {"index": self._index, "shard": shard_id,
+                     "kv": owned_kv, "kv_tombstones": owned_tomb},
+                    use_bin_type=True)
+            blob = {
+                "index": self._index, "shard": 0,
+                "table_index": dict(self._table_index),
+                "nodes": {k: v.__dict__ for k, v in
+                          self.tables["nodes"].items()},
+                "services": [[list(k), v.__dict__] for k, v in
+                             self.tables["services"].items()],
+                "checks": [[list(k),
+                            {**v.__dict__, "status": v.status.value}]
+                           for k, v in self.tables["checks"].items()],
+                "kv": owned_kv,
+                "sessions": {k: v.__dict__ for k, v in
+                             self.tables["sessions"].items()},
+                "coordinates": dict(self.tables["coordinates"]),
+                "kv_tombstones": owned_tomb,
+                "resources": self.resources.dump(),
+                **{t: dict(self.tables[t]) for t in RAW_TABLES},
+            }
+            return msgpack.packb(blob, use_bin_type=True)
+
+    def restore_shard(self, data: bytes, router, shard_id: int) -> None:
+        """Install one shard's snapshot slice: replace the owned slice,
+        keep everything the other shards' logs own."""
+        if router is None or getattr(router, "n", 1) == 1:
+            return self.restore(data)
+        blob = msgpack.unpackb(data, raw=False)
+        with self._lock:
+            self._index = max(self._index, blob["index"]) + 1
+            kv = {k: v for k, v in self.tables["kv"].items()
+                  if router.shard_of_key(k) != shard_id}
+            kv.update({k: KVEntry(**v)
+                       for k, v in blob.get("kv", {}).items()})
+            self.tables["kv"] = kv
+            tomb = {k: i for k, i in self._kv_tombstones.items()
+                    if router.shard_of_key(k) != shard_id}
+            tomb.update(blob.get("kv_tombstones", {}))
+            self._kv_tombstones = tomb
+            self._table_index["kv"] = self._index
+            if shard_id == 0:
+                for t in self._table_index:
+                    self._table_index[t] = self._index
+                self.tables["nodes"] = {
+                    k: Node(**v) for k, v in blob["nodes"].items()}
+                self.tables["services"] = {
+                    tuple(k): NodeService(**v)
+                    for k, v in blob["services"]}
+                self.tables["checks"] = {
+                    tuple(k): HealthCheck(
+                        **{**v, "status": CheckStatus(v["status"])})
+                    for k, v in blob["checks"]}
+                self.tables["sessions"] = {
+                    k: Session(**v)
+                    for k, v in blob["sessions"].items()}
+                self.tables["coordinates"] = blob.get("coordinates", {})
+                for t in RAW_TABLES:
+                    self.tables[t] = blob.get(t, {})
+                self._rebuild_token_expiry_locked()
+                self.resources.restore(blob.get("resources")
+                                       or msgpack.packb([]))
+            # the slice changed wholesale: wake every watcher and let
+            # them re-read (same conservative policy as full restore)
+            for fire in self._watches.collect_all():
+                fire()
+            for fn in self._change_hooks:
+                try:
+                    fn(",".join(TABLES), self._index)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def restore(self, data: bytes) -> None:
         blob = msgpack.unpackb(data, raw=False)

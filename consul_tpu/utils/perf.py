@@ -130,6 +130,23 @@ TOP_STAGES = {
              "raft.apply_batch"),
 }
 
+#: the multi-raft shard dimension (PR 20): a sharded store emits one
+#: ledger kind per consensus group — "raft.shard.<i>" — whose stage
+#: names are the "raft" taxonomy with the same prefix substituted
+#: ("raft.shard.0.append", ...). Single-group stores keep the exact
+#: PR 19 names, so every pinned consumer is untouched.
+SHARD_KIND_PREFIX = "raft.shard."
+
+
+def top_stages_for(kind: str) -> tuple[str, ...]:
+    """Depth-0 partition for a ledger kind, resolving per-shard raft
+    kinds against the "raft" template."""
+    tops = TOP_STAGES.get(kind)
+    if tops is None and kind.startswith(SHARD_KIND_PREFIX):
+        tops = tuple(kind + "." + n.split("raft.", 1)[1]
+                     for n in TOP_STAGES["raft"])
+    return tops or ()
+
 
 #: sorted edge list for bisect (bucket_index is on the per-request
 #: hot path: C bisect beats a log10 + correction loop)
@@ -825,7 +842,7 @@ def stage_report(cur: dict[str, Any], prev: Optional[dict[str, Any]],
                   "p99_ms": round(e2e.quantile(0.99) * 1e3, 4),
                   "mean_ms": round(e2e_mean * 1e3, 4)}
     sum_mean = 0.0
-    for name in TOP_STAGES[kind]:
+    for name in top_stages_for(kind):
         h = hists.get(name)
         if h is None or not h.count:
             continue
@@ -847,12 +864,17 @@ def stage_report(cur: dict[str, Any], prev: Optional[dict[str, Any]],
         round(ssum.quantile(0.5) / e2e_p50, 4)
         if ssum is not None and ssum.count else None)
     out["share_mean_total"] = round(sum_mean / e2e_mean, 4)
-    for name in ("store.read", "raft.commit_wait", "raft.append",
-                 "raft.fsync", "raft.replicate.rtt",
-                 "raft.quorum_wait", "raft.apply_batch",
-                 "raft.fsm.apply", "raft.follower.append",
-                 "raft.follower.fsync"):
-        if name in TOP_STAGES.get(kind, ()):
+    inner_names = ["store.read", "raft.commit_wait", "raft.append",
+                   "raft.fsync", "raft.replicate.rtt",
+                   "raft.quorum_wait", "raft.apply_batch",
+                   "raft.fsm.apply", "raft.follower.append",
+                   "raft.follower.fsync"]
+    if kind.startswith(SHARD_KIND_PREFIX):
+        # per-shard kinds nest the same inner stages, shard-prefixed
+        inner_names = [kind + "." + n.split("raft.", 1)[1]
+                       for n in inner_names if n.startswith("raft.")]
+    for name in inner_names:
+        if name in top_stages_for(kind):
             continue  # already reported as a depth-0 stage above
         h = hists.get(name)
         if h is None or not h.count:

@@ -105,7 +105,16 @@ def test_layout_registry_digest_pinned():
     # sim/costmodel.py _validate_raft/latest_raft_guard,
     # consul_tpu/serve/raftbench.py, consul_tpu/raft/raft.py's ledger
     # partition, bench.py --raft/--check-regression --family RAFT.
-    assert registry.layout_digest() == "e2a2650d8f4af040"
+    # PR 20 re-pin (was e2a2650d8f4af040): the digest now additionally
+    # covers the multi-raft shard dimension — the per-shard stage-row
+    # naming root (RAFT_SHARD_STAGE_PREFIX, which must agree with
+    # perf.SHARD_KIND_PREFIX) and the per-shard attribution row schema
+    # inside a sharded rung's `shards` map (RAFT_SHARD_KEYS, coverage
+    # floor enforced PER SHARD). Consumers: sim/costmodel.py
+    # _validate_raft_shards, consul_tpu/serve/raftbench.py sharded
+    # rungs, consul_tpu/raft/sharded.py's router + per-shard ledgers,
+    # bench.py --raft --raft-shards N.
+    assert registry.layout_digest() == "ab98137fa786bf5b"
 
 
 def test_reduce_lane_layout_pinned():

@@ -641,3 +641,137 @@ def test_bench_raft_flag_combinations_exit_2(tmp_path):
                env_extra={"CONSUL_TPU_RECORD_ROOT": str(tmp_path)})
     assert r.returncode == 2, r.stderr
     assert "never fabricated" in r.stderr
+
+
+# ------------------------------------- sharded RAFT records (PR 20)
+
+
+def _sharded_raft_payload(n_shards=2):
+    """Minimal schema-valid SHARDED RAFT record: the single-group
+    payload with cluster.raft_shards set and a per-shard attribution
+    map (registry.RAFT_SHARD_KEYS rows, stage names re-rooted under
+    raft.shard.<id>.) on every measured rung."""
+    d = _raft_payload()
+    d["cluster"]["raft_shards"] = n_shards
+
+    def shard_row(sid):
+        stages = registry.raft_shard_stages(sid)
+        return {"commit_p50_ms": 2.1, "commit_p99_ms": 7.5,
+                "commit_batches": 200 + sid,
+                "stage_p50_ms": {s: 0.4 for s in stages},
+                "stage_share_p50": {s: 0.24 for s in stages},
+                "coverage_p50": 0.96,
+                "commit_batch": {"count": 200, "mean": 2.0,
+                                 "p50": 1.7, "p99": 5.0, "max": 8.0},
+                "apply_batch": {"count": 600, "mean": 2.0,
+                                "p50": 1.7, "p99": 5.0, "max": 8.0}}
+
+    for rung in d["ladder"]:
+        if not rung.get("skipped"):
+            rung["shards"] = {str(s): shard_row(s)
+                              for s in range(n_shards)}
+    return d
+
+
+def test_sharded_raft_validator_names_shard_and_key():
+    """Per-shard attribution is held to the same contract as the
+    single group, PER SHARD — and every refusal names the shard and
+    the offending key, because 'some shard somewhere is broken' is
+    not an actionable rejection."""
+    good = _sharded_raft_payload()
+    costmodel.validate_record("RAFT_r02.json", good)
+    # a sharded record whose rung lost its per-shard map is refused
+    bare = json.loads(json.dumps(good))
+    del bare["ladder"][0]["shards"]
+    with pytest.raises(LedgerError, match="no per-shard 'shards' map"):
+        costmodel.validate_record("RAFT_r02.json", bare)
+    # a missing consensus group is named by id
+    gone = json.loads(json.dumps(good))
+    del gone["ladder"][0]["shards"]["1"]
+    with pytest.raises(LedgerError,
+                       match=r"shard ids \['0'\] != expected"):
+        costmodel.validate_record("RAFT_r02.json", gone)
+    # a shard row missing a required key names shard AND key
+    thin = json.loads(json.dumps(good))
+    del thin["ladder"][0]["shards"]["1"]["apply_batch"]
+    with pytest.raises(LedgerError,
+                       match=r"shards\[1\].*apply_batch"):
+        costmodel.validate_record("RAFT_r02.json", thin)
+    # a dropped per-shard stage window names shard and stage
+    hole = json.loads(json.dumps(good))
+    del hole["ladder"][1]["shards"]["0"]["stage_share_p50"][
+        "raft.shard.0.quorum_wait"]
+    with pytest.raises(LedgerError,
+                       match=r"shard 0.*raft\.shard\.0\.quorum_wait"):
+        costmodel.validate_record("RAFT_r02.json", hole)
+    # stage names must be re-rooted under THIS shard's prefix — a
+    # sibling shard's row can't be pasted in
+    alien = json.loads(json.dumps(good))
+    alien["ladder"][0]["shards"]["1"]["stage_share_p50"][
+        "raft.shard.0.append"] = 0.2
+    with pytest.raises(LedgerError,
+                       match=r"shard 1.*unknown.*raft\.shard\.0\.append"):
+        costmodel.validate_record("RAFT_r02.json", alien)
+    # the coverage floor binds per shard: one blind shard is refused
+    # even when its sibling (and the top-level row) are well-explained
+    blind = json.loads(json.dumps(good))
+    blind["ladder"][0]["shards"]["1"]["coverage_p50"] = 0.55
+    with pytest.raises(LedgerError,
+                       match=r"shard 1.*0\.55.*sibling"):
+        costmodel.validate_record("RAFT_r02.json", blind)
+    # ...but a shard that committed NOTHING this rung has no pipeline
+    # to attribute — commit_batches == 0 exempts it honestly
+    idle = json.loads(json.dumps(good))
+    idle["ladder"][0]["shards"]["1"]["commit_batches"] = 0
+    idle["ladder"][0]["shards"]["1"]["coverage_p50"] = 0.0
+    costmodel.validate_record("RAFT_r02.json", idle)
+    # raft_shards itself is validated
+    bogus = json.loads(json.dumps(good))
+    bogus["cluster"]["raft_shards"] = "two"
+    with pytest.raises(LedgerError, match="raft_shards"):
+        costmodel.validate_record("RAFT_r02.json", bogus)
+
+
+def test_registry_digest_covers_shard_schema():
+    """The PR 20 drift guard (same mutate-and-restore idiom as the
+    costmodel/sweep pins): moving the per-shard stage-row naming root
+    or the per-shard row schema must move the pinned layout digest so
+    every consumer (perf.SHARD_KIND_PREFIX, _validate_raft_shards,
+    raftbench sharded rungs) is audited in the same change."""
+    base = registry.layout_digest()
+    for name, mutated in (
+        ("RAFT_SHARD_STAGE_PREFIX", "raft.group."),
+        ("RAFT_SHARD_KEYS", registry.RAFT_SHARD_KEYS + ("vibes",)),
+        ("RAFT_RUNG_KEYS", registry.RAFT_RUNG_KEYS + ("shards",)),
+    ):
+        orig = getattr(registry, name)
+        try:
+            setattr(registry, name, mutated)
+            assert registry.layout_digest() != base, name
+        finally:
+            setattr(registry, name, orig)
+    assert registry.layout_digest() == base
+    # the naming root must agree with the perf taxonomy's — two
+    # vocabularies for the same ledger would validate one and record
+    # the other
+    from consul_tpu.utils import perf
+    assert registry.RAFT_SHARD_STAGE_PREFIX == perf.SHARD_KIND_PREFIX
+
+
+def test_bench_raft_shards_flag_combinations_exit_2():
+    """--raft-shards parameterizes --raft only: combined with any
+    other mode (or bare, or non-integer, or < 1) it exits 2 with
+    usage before anything runs — the regression guard re-reads the
+    recorded topology instead of taking an override."""
+    for argv in (("--raft-shards", "2"),
+                 ("--users", "--raft-shards", "2"),
+                 ("--mesh", "--raft-shards", "2"),
+                 ("--check-regression", "--family", "RAFT",
+                  "--raft-shards", "2"),
+                 ("--raft", "--raft-shards", "zero"),
+                 ("--raft", "--raft-shards", "0"),
+                 ("--raft", "--raft-shards", "-1"),
+                 ("--raft", "--raft-shards")):
+        r = _bench(*argv)
+        assert r.returncode == 2, (argv, r.stdout, r.stderr)
+        assert "usage:" in r.stderr, (argv, r.stderr)

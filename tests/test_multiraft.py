@@ -1,0 +1,423 @@
+"""Multi-raft state store (PR 20): sharded consensus groups.
+
+Tier-1 coverage for the sharded write path: routing determinism (the
+digest-pinned contract), cross-shard fenced applies, per-shard disaster
+recovery, and leader-lease safety (fencing + the commit-wait-free read
+ledger shape).
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.config import load
+from consul_tpu.raft.sharded import MultiRaft, ShardRouter, TxnGate
+from consul_tpu.server import Server
+from consul_tpu.server.rpc import RetryableError
+from consul_tpu.state.fsm import (MessageType, ROUTE_ALL, ROUTE_FAN,
+                                  ROUTE_KEY, ROUTE_SYSTEM,
+                                  command_route, encode_command)
+
+from helpers import wait_for  # noqa: E402
+
+
+# ------------------------------------------------------------ router unit
+
+#: PINNED routing digests. These fold the router version string, the
+#: system-table anchoring, and a golden probe of concrete key→shard
+#: mappings. If one of these changes, the (table, key)→shard map moved:
+#: a rolling upgrade would route the same key to two different groups
+#: on two servers and per-key linearizability is gone. Bump ONLY with a
+#: versioned migration story (and say so in ARCHITECTURE.md).
+PINNED_DIGESTS = {
+    1: "14dff8545c03a9d0",
+    2: "f2441c43620b91a7",
+    4: "c519150e97b38be2",
+}
+
+
+def test_router_digest_pinned():
+    for n, want in PINNED_DIGESTS.items():
+        assert ShardRouter(n).digest() == want, \
+            f"shard router remapped for n={n} — see PINNED_DIGESTS"
+
+
+def test_router_digest_drift_detection():
+    """Mutate-and-restore: the digest must actually cover the version
+    string and the range math (a digest that ignored them would pin
+    nothing)."""
+    r = ShardRouter(4)
+    base = r.digest()
+    old_version = ShardRouter.VERSION
+    try:
+        ShardRouter.VERSION = "multiraft-v2/tampered"
+        assert r.digest() != base, "digest ignores the router version"
+    finally:
+        ShardRouter.VERSION = old_version
+    assert r.digest() == base
+    # shard count is part of the identity too
+    assert ShardRouter(8).digest() != base
+
+
+def test_router_determinism_and_balance():
+    a, b = ShardRouter(4), ShardRouter(4)
+    keys = [f"k/{i}" for i in range(2000)]
+    assert [a.shard_of_key(k) for k in keys] == \
+        [b.shard_of_key(k) for k in keys]
+    counts = [0, 0, 0, 0]
+    for k in keys:
+        counts[a.shard_of_key(k)] += 1
+    # contiguous md5 ranges: no shard should be starved or hot by >2x
+    assert min(counts) > 250 and max(counts) < 1000, counts
+    # non-KV tables all anchor to the system shard
+    for t in ("nodes", "services", "sessions", "acl_tokens"):
+        assert a.shard_of(t) == ShardRouter.SYSTEM_SHARD
+    # single-shard router degenerates to the classic store
+    assert all(ShardRouter(1).shard_of_key(k) == 0 for k in keys[:50])
+
+
+def test_command_route_classification():
+    """The routing table is derived from the FSM handlers' write sets —
+    each class pins the contract between state/fsm and raft/sharded."""
+    def kvs(op, key):
+        return encode_command(MessageType.KVS,
+                              {"Op": op, "DirEnt": {"Key": key}})
+
+    assert command_route(kvs("set", "a")) == (ROUTE_KEY, ("a",))
+    assert command_route(kvs("cas", "a")) == (ROUTE_KEY, ("a",))
+    assert command_route(kvs("delete", "a")) == (ROUTE_KEY, ("a",))
+    assert command_route(kvs("delete-cas", "a")) == (ROUTE_KEY, ("a",))
+    # session-coupled ops fan to {system, key}
+    assert command_route(kvs("lock", "a")) == (ROUTE_FAN, ("a",))
+    assert command_route(kvs("unlock", "a")) == (ROUTE_FAN, ("a",))
+    # prefix ops can touch any shard
+    assert command_route(kvs("delete-tree", "p/")) == (ROUTE_ALL, ())
+    # sessions: create is system-ordered, destroy cascades anywhere
+    assert command_route(encode_command(
+        MessageType.SESSION, {"Op": "create", "Session": {"ID": "s"}}
+    )) == (ROUTE_SYSTEM, ())
+    assert command_route(encode_command(
+        MessageType.SESSION, {"Op": "destroy", "Session": {"ID": "s"}}
+    )) == (ROUTE_ALL, ())
+    # txn: system + each KV op's key
+    assert command_route(encode_command(MessageType.TXN, {"Ops": [
+        {"KV": {"Verb": "set", "Key": "x"}},
+        {"KV": {"Verb": "set", "Key": "y"}},
+        {"Node": {"Verb": "set", "Node": {"Node": "n"}}},
+    ]})) == (ROUTE_FAN, ("x", "y"))
+    # register: plain is system; a critical check runs the session
+    # invalidation cascade (held locks live anywhere)
+    assert command_route(encode_command(
+        MessageType.REGISTER, {"Node": "n"})) == (ROUTE_SYSTEM, ())
+    assert command_route(encode_command(MessageType.REGISTER, {
+        "Node": "n", "Check": {"Status": "critical", "CheckID": "c"},
+    })) == (ROUTE_ALL, ())
+    # everything else is system-ordered
+    assert command_route(encode_command(
+        MessageType.ACL_TOKEN, {"Op": "set"})) == (ROUTE_SYSTEM, ())
+
+
+def test_txn_gate_fence_protocol():
+    g = TxnGate(timeout_s=0.2)
+    # unresolved txn: fence parks, exec barrier holds
+    assert not g.passable("t1")
+    g.fence_reached("t1", 1)
+    assert g.ready("t1", 1)
+    assert not g.ready("t1", 2)  # second fence not parked yet
+    g.complete("t1")
+    assert g.passable("t1")
+    assert g.ready("t1", 2)  # done wins over reached-count (replay)
+    # orphaned fence times out rather than wedging the shard forever
+    assert not g.passable("t2")
+    time.sleep(0.25)
+    assert g.passable("t2")
+    assert g.timed_out >= 1
+    # empty txn (non-cross entries) always passes
+    assert g.passable("")
+    assert g.ready("", 0)
+
+
+# ------------------------------------------------------- sharded cluster
+
+@pytest.fixture
+def shard_cluster(tmp_path):
+    """3 servers, 2 consensus groups each, real loopback RPC."""
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"msh{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True, "raft_shards": 2,
+            "data_dir": str(tmp_path / f"srv{i}")})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    for s in servers[1:]:
+        assert s.join([servers[0].serf.memberlist.transport.addr]) == 1
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="system-shard leader election")
+    wait_for(lambda: all(len(sh.peers) == 3
+                         for sh in leader.raft.shards),
+             timeout=30.0, what="3 peers in every shard")
+    # colocation: the system-shard leader pulls every group home
+    wait_for(leader.raft.leads_all_shards, timeout=30.0,
+             what="shard leadership colocation")
+    yield servers, leader
+    for s in servers:
+        s.shutdown()
+
+
+def test_sharded_kv_replicates_across_groups(shard_cluster):
+    """Single-key ops route to exactly one group; keys on both shards
+    replicate to every server; per-shard dirs exist on disk."""
+    import os
+
+    servers, leader = shard_cluster
+    r = leader.raft.router
+    # one key per shard ("alpha"→0, "beta"→1 under n=2)
+    assert r.shard_of_key("alpha") == 0 and r.shard_of_key("beta") == 1
+    follower = next(s for s in servers if s is not leader)
+    for key in ("alpha", "beta"):
+        assert follower.handle_rpc("KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": key, "Value": b"v-" + key.encode()},
+        }, "test") is True
+    wait_for(lambda: all(
+        s.state.kv_get("alpha") is not None
+        and s.state.kv_get("beta") is not None for s in servers),
+        what="both shards replicated everywhere")
+    # each write landed in ITS shard's log only (entry data routing)
+    s0_last = leader.raft.shards[0].store.last_index()
+    s1_last = leader.raft.shards[1].store.last_index()
+    assert s0_last > 0 and s1_last > 0
+    # per-shard raft dirs on disk, each with its own WAL
+    for s in servers:
+        for sid in (0, 1):
+            d = os.path.join(s.config.data_dir, "raft", f"shard-{sid}")
+            assert os.path.isdir(d), d
+            assert os.path.exists(os.path.join(d, "wal.log")), d
+
+
+def test_cross_shard_session_and_tree_ops(shard_cluster):
+    """Cross-shard commands (lock/unlock, session destroy cascade,
+    delete-tree, multi-key txn) stay atomic and replicate identically
+    everywhere through the fenced two-phase path."""
+    servers, leader = shard_cluster
+    # session lock on a shard-1 key (exec system, fence shard 1)
+    sid = leader.handle_rpc("Session.Apply", {
+        "Op": "create", "Session": {"ID": "", "Node": leader.name,
+                                    "Checks": []}}, "test")
+    assert leader.handle_rpc("KVS.Apply", {
+        "Op": "lock", "DirEnt": {"Key": "lockk", "Value": b"1",
+                                 "Session": sid}}, "test") is True
+    wait_for(lambda: all(
+        (e := s.state.kv_get("lockk")) is not None and e.session == sid
+        for s in servers), what="lock replicated with session")
+    # destroy cascades into the held lock on ANOTHER shard
+    leader.handle_rpc("Session.Apply", {
+        "Op": "destroy", "Session": {"ID": sid}}, "test")
+    wait_for(lambda: all(
+        (e := s.state.kv_get("lockk")) is not None and e.session == ""
+        for s in servers), what="destroy released the lock everywhere")
+    # delete-tree across both shards ("tree/a,b"→1, "tree/c,d"→0)
+    for k in ("tree/a", "tree/b", "tree/c", "tree/d"):
+        assert leader.handle_rpc("KVS.Apply", {
+            "Op": "set", "DirEnt": {"Key": k, "Value": b"x"}},
+            "test") is True
+    assert leader.handle_rpc("KVS.Apply", {
+        "Op": "delete-tree", "DirEnt": {"Key": "tree/"}},
+        "test") is True
+    wait_for(lambda: all(
+        not s.state.kv_list("tree/") for s in servers),
+        what="tree deleted on both shards everywhere")
+    # multi-key txn spanning both shards commits atomically
+    res = leader.handle_rpc("Txn.Apply", {"Ops": [
+        {"KV": {"Verb": "set", "Key": "txn/a", "Value": b"1"}},
+        {"KV": {"Verb": "set", "Key": "txn/c", "Value": b"2"}},
+    ]}, "test")
+    assert not res.get("Errors")
+    wait_for(lambda: all(
+        s.state.kv_get("txn/a") is not None
+        and s.state.kv_get("txn/c") is not None for s in servers),
+        what="cross-shard txn replicated")
+
+
+def test_sharded_peers_json_recovery(tmp_path):
+    """Satellite: per-shard disaster recovery. 2 of 3 servers are
+    permanently lost; one peers.json names the survivor; on restart
+    EVERY shard recovers to a writable single-node group with KV
+    intact on both shards."""
+    import json
+    import os
+
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"sdr{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True, "raft_shards": 2,
+            "data_dir": str(tmp_path / f"srv{i}")})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    try:
+        for s in servers[1:]:
+            assert s.join(
+                [servers[0].serf.memberlist.transport.addr]) == 1
+        leader = wait_for(
+            lambda: next((s for s in servers if s.is_leader()), None),
+            what="leader election")
+        wait_for(lambda: all(len(sh.peers) == 3
+                             for sh in leader.raft.shards),
+                 timeout=30.0, what="3 peers in every shard")
+        wait_for(leader.raft.leads_all_shards, timeout=30.0,
+                 what="shard colocation")
+        # one key per shard — recovery must preserve BOTH
+        for key in ("alpha", "beta"):
+            assert leader.handle_rpc("KVS.Apply", {
+                "Op": "set",
+                "DirEnt": {"Key": key, "Value": b"precious"}},
+                "t") is True
+        survivor = next(s for s in servers if s is not leader)
+        wait_for(lambda: survivor.state.kv_get("alpha") is not None
+                 and survivor.state.kv_get("beta") is not None,
+                 what="replication to the survivor")
+        surv_addr = survivor.rpc.addr
+        surv_port = int(surv_addr.rsplit(":", 1)[1])
+        surv_dir = survivor.config.data_dir
+    finally:
+        for s in servers:
+            s.shutdown()
+
+    # operator recovery: ONE peers.json under raft/ covers every shard
+    pj = os.path.join(surv_dir, "raft", "peers.json")
+    with open(pj, "w") as f:
+        json.dump([surv_addr], f)
+
+    cfg = load(dev=True, overrides={
+        "node_name": "sdr-reborn", "bootstrap": False,
+        "bootstrap_expect": 3, "server": True, "raft_shards": 2,
+        "data_dir": surv_dir, "ports": {"server": surv_port}})
+    try:
+        reborn = Server(cfg)
+    except OSError:
+        time.sleep(0.3)
+        reborn = Server(cfg)
+    try:
+        assert not os.path.exists(pj)
+        assert os.path.exists(pj + ".applied")
+        reborn.start()
+        wait_for(reborn.raft.leads_all_shards, timeout=20.0,
+                 what="single-node leadership on EVERY shard")
+        for sh in reborn.raft.shards:
+            assert sh.peers == {reborn.rpc.addr}
+        # state survived on both shards
+        assert reborn.state.kv_get("alpha") is not None
+        assert reborn.state.kv_get("beta") is not None
+        # and both shards are writable again
+        for key in ("alpha2", "beta"):
+            assert reborn.handle_rpc("KVS.Apply", {
+                "Op": "set", "DirEnt": {"Key": key, "Value": b"alive"}},
+                "t") is True
+    finally:
+        reborn.shutdown()
+
+
+# ------------------------------------------------------------ lease safety
+
+@pytest.fixture
+def lease_cluster():
+    servers = []
+    for i in range(3):
+        cfg = load(dev=True, overrides={
+            "node_name": f"lse{i}", "bootstrap": False,
+            "bootstrap_expect": 3, "server": True})
+        try:
+            s = Server(cfg)
+        except OSError:
+            time.sleep(0.2)
+            s = Server(cfg)
+        s.start()
+        servers.append(s)
+    for s in servers[1:]:
+        assert s.join([servers[0].serf.memberlist.transport.addr]) == 1
+    leader = wait_for(
+        lambda: next((s for s in servers if s.is_leader()), None),
+        what="leader election")
+    wait_for(lambda: len(leader.raft.peers) == 3, what="3 raft peers")
+    yield servers, leader
+    for s in servers:
+        s.shutdown()
+
+
+def test_lease_fencing_refuses_deposed_leader(lease_cluster):
+    """Satellite: a JUST-deposed leader whose computed lease fence has
+    not expired refuses ?consistent reads BY NAME with a structured
+    retryable error instead of serving (or silently forwarding)."""
+    servers, leader = lease_cluster
+    assert leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "lf/k", "Value": b"v"}},
+        "t") is True
+    node = leader.raft.shards[0]
+    # steady state: quorum acks are fresh → the lease is warm
+    wait_for(lambda: node.lease_read_index(timeout=1.0) is not None,
+             what="warm leader lease")
+    # depose: a higher term arrives (disturbance election elsewhere)
+    with node._lock:
+        node._step_down(node.store.term + 1)
+    rem = leader.raft.lease_fence_remaining()
+    assert rem > 0, "deposal with fresh quorum acks must arm the fence"
+    # the refusal is structured-retryable and names the node
+    with pytest.raises(RetryableError) as ei:
+        leader.handle_rpc("KVS.Get", {
+            "Key": "lf/k", "RequireConsistent": True}, "t")
+    assert leader.name in str(ei.value)
+    assert "fenced" in str(ei.value)
+    # the fence expires on its own; consistent reads then resume
+    # (forwarded to whoever leads by now)
+    wait_for(lambda: leader.raft.lease_fence_remaining() == 0.0,
+             timeout=10.0, what="fence expiry")
+
+
+def test_lease_read_ledger_has_no_commit_wait(lease_cluster):
+    """Satellite: a lease-served ?consistent read's perf ledger
+    provably contains NO commit-wait stage — the lease skipped the
+    quorum round AND the async queue park, and the ledger shape is
+    the proof (ISSUE: rpc.commit_wait vanishes from the read ledger)."""
+    from consul_tpu.server.rpc import ConnPool
+    from consul_tpu.utils import perf
+
+    servers, leader = lease_cluster
+    assert leader.handle_rpc("KVS.Apply", {
+        "Op": "set", "DirEnt": {"Key": "lr/k", "Value": b"v"}},
+        "t") is True
+    node = leader.raft.shards[0]
+    wait_for(lambda: node.lease_read_index(timeout=1.0) is not None,
+             what="warm leader lease")
+    perf.keep_ledgers(64)
+    pool = ConnPool()
+    try:
+        for _ in range(10):
+            res = pool.call(leader.rpc.addr, "KVS.Get", {
+                "Key": "lr/k", "RequireConsistent": True})
+            assert res["Entries"][0]["Key"] == "lr/k"
+    finally:
+        pool.close()
+    leds = [led for led in perf.LEDGER_RING if led.kind == "rpc"]
+    assert len(leds) >= 10
+    lease_served = [led for led in leds
+                    if not any(n == "rpc.commit_wait"
+                               for n, _, _, _ in led.stages)]
+    # the warm-lease steady state serves (at least) the vast majority
+    # inline; every lease-served ledger still carries its handler stage
+    assert len(lease_served) >= 8, \
+        [(led.stages) for led in leds[:3]]
+    for led in lease_served:
+        assert any(n == "rpc.handler" for n, _, _, _ in led.stages)
